@@ -1,0 +1,91 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle Fluid (reference mounted at /root/reference).
+
+Architecture (vs the reference's interpret-the-graph design):
+  Python builds a Program (program-as-data, like fluid) ->
+  Executor lowers whole blocks through JAX to ONE XLA computation ->
+  XLA schedules fusion/memory/collectives on TPU (MXU for matmuls,
+  ICI collectives via sharding annotations instead of NCCL op handles).
+
+Top-level API mirrors `paddle.fluid`: layers, Program, Executor,
+optimizer, backward, io, initializer, ParamAttr, CompiledProgram...
+"""
+from . import ops as _ops  # registers all kernels
+from .core.program import (Program, Block, Variable, Operator,
+                           default_main_program, default_startup_program,
+                           program_guard, switch_main_program,
+                           switch_startup_program)
+from .core.executor import (Executor, TPUPlace, CPUPlace, CUDAPlace,
+                            seed)
+from .core.scope import Scope, global_scope, _reset_global_scope
+from .core import registry as _registry
+from .core.registry import registered_ops
+from .backward import append_backward, gradients
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import layers
+from . import initializer
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import unique_name
+from . import nets
+from . import metrics
+from . import profiler
+from .io import (save_vars, save_params, save_persistables, load_vars,
+                 load_params, load_persistables, save_inference_model,
+                 load_inference_model)
+from .core.compiler import CompiledProgram, BuildStrategy, \
+    ExecutionStrategy, ParallelExecutor
+from .data_feeder import DataFeeder
+from .reader import PyReader
+from . import dygraph
+
+# fluid-compat: many scripts do `import paddle.fluid as fluid`; we expose
+# the same names so `import paddle_tpu as fluid` works.
+name_scope = program_guard
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def _guard():
+        from .core import scope as scope_mod
+
+        old = scope_mod._global_scope
+        scope_mod._global_scope = scope
+        try:
+            yield
+        finally:
+            scope_mod._global_scope = old
+
+    return _guard()
+
+
+def cuda_places(device_ids=None):
+    import jax
+
+    n = len(jax.devices())
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+def cpu_places(device_count=None):
+    return [CPUPlace()]
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+__version__ = "0.1.0"

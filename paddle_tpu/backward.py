@@ -1,0 +1,163 @@
+"""Program-level reverse autodiff: append_backward / gradients.
+
+Parity target: reference python/paddle/fluid/backward.py:394
+(append_backward), :135 (_addup_repetitive_outputs_), :204
+(_remove_no_grad_branch_), :613 (calc_gradient).
+
+Walks the forward op list in reverse, asks each op's grad maker
+(core/registry.py -- usually the generic jax.vjp-derived maker) for grad
+op descs, accumulates duplicate gradients with `sum` ops (a forward var
+consumed by N ops receives N partial grads), and substitutes @EMPTY@ for
+output-grads never reached by backprop (the reference inserts
+fill_zeros_like ops instead; our vjp kernels synthesize zeros lazily,
+which XLA folds away).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from .core.program import (GRAD_SUFFIX, Operator, Variable, grad_var_name)
+from .core.registry import EMPTY_VAR, get_op_info, make_grad_ops
+from . import unique_name
+
+OP_ROLE_KEY = "op_role"
+
+
+def _find_op_path(block, loss_name: str, stop_names: Set[str]):
+    """Ops that (transitively) produce the loss (reference
+    backward.py:573 _find_op_path_)."""
+    needed = {loss_name}
+    path = []
+    for op in reversed(block.ops):
+        outs = set(op.output_arg_names)
+        if outs & needed:
+            path.append(op)
+            for n in op.input_arg_names:
+                if n not in stop_names and n != EMPTY_VAR:
+                    needed.add(n)
+    path.reverse()
+    return path
+
+
+def _collect_no_grad(block, extra=None) -> Set[str]:
+    no_grad = set(extra or ())
+    for var in block.vars.values():
+        if var.stop_gradient or var.is_data:
+            no_grad.add(var.name)
+        if var.dtype is not None and var.dtype.value.startswith(
+                ("int", "uint", "bool")):
+            no_grad.add(var.name)
+    return no_grad
+
+
+def _ensure_grad_var(block, fwd_name: str, grad_name: str):
+    if grad_name in block.vars:
+        return block.vars[grad_name]
+    fwd = block._find_var_recursive(fwd_name)
+    return block.create_var(
+        name=grad_name,
+        shape=fwd.shape if fwd is not None else None,
+        dtype=fwd.dtype if fwd is not None else None,
+        persistable=False)
+
+
+def append_backward(loss: Variable, parameter_list=None,
+                    no_grad_set=None, callbacks=None,
+                    checkpoints=None):
+    """Append grad ops for `loss`; returns [(param, grad_var)] pairs."""
+    block = loss.block
+    program = block.program
+    no_grad = _collect_no_grad(block, no_grad_set)
+
+    op_path = _find_op_path(block, loss.name, no_grad)
+
+    # vars whose grads the backward pass will actually produce
+    grads_wanted: Set[str] = set()
+    for op in op_path:
+        for n in op.input_arg_names:
+            if n not in no_grad:
+                grads_wanted.add(n)
+        for n in op.output_arg_names:
+            grads_wanted.add(n)
+
+    # seed: d loss / d loss = 1
+    loss_grad = grad_var_name(loss.name)
+    _ensure_grad_var(block, loss.name, loss_grad)
+    seed_op = Operator(
+        block, "fill_any_like", {"X": [loss.name]}, {"Out": [loss_grad]},
+        {"value": 1.0, OP_ROLE_KEY: "backward"})
+    block.ops.append(seed_op)
+
+    produced: Set[str] = {loss_grad}
+
+    for op in reversed(op_path):
+        grad_ops = make_grad_ops(op, no_grad_set=no_grad)
+        for gop in grad_ops:
+            gop.attrs.setdefault(OP_ROLE_KEY, "backward")
+            # rewrite grad inputs that were never produced -> @EMPTY@
+            for slot, names in gop.inputs.items():
+                if not slot.endswith(GRAD_SUFFIX):
+                    continue
+                gop.inputs[slot] = [
+                    n if n in produced else EMPTY_VAR for n in names]
+            # handle duplicate grad production: accumulate with sum
+            renames = []
+            for slot, names in gop.outputs.items():
+                new_names = []
+                for n in names:
+                    if n in produced:
+                        tmp = unique_name.generate(n + "@RENAME")
+                        renames.append((n, tmp))
+                        new_names.append(tmp)
+                    else:
+                        new_names.append(n)
+                gop.outputs[slot] = new_names
+            block.ops.append(gop)
+            for slot, names in gop.outputs.items():
+                fwd_slot = slot[:-len(GRAD_SUFFIX)]
+                fwd_names = (op.inputs.get(fwd_slot, [])
+                             if gop.type.endswith("_grad") else [])
+                for i, n in enumerate(names):
+                    src = fwd_names[i] if i < len(fwd_names) else None
+                    _ensure_grad_var(block, src or n, n)
+                    produced.add(n)
+            for orig, tmp in renames:
+                sum_op = Operator(
+                    block, "sum", {"X": [orig, tmp]}, {"Out": [orig]},
+                    {OP_ROLE_KEY: "backward"})
+                block.ops.append(sum_op)
+                produced.add(orig)
+
+    program._version += 1
+
+    # assemble (param, grad) list
+    if parameter_list is not None:
+        params = []
+        for p in parameter_list:
+            params.append(p if isinstance(p, Variable)
+                          else program.global_block.var(p))
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    param_grads = []
+    for p in params:
+        g = grad_var_name(p.name)
+        if g in produced:
+            param_grads.append((p, block.vars[g]))
+    return param_grads
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference backward.py:613 calc_gradient-era API."""
+    if not isinstance(targets, (list, tuple)):
+        targets = [targets]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    assert len(targets) == 1, "gradients: single target supported"
+    loss = targets[0]
+    block = loss.block
+    pairs = append_backward(loss, no_grad_set=no_grad_set)
+    grads = []
+    for v in inputs:
+        g = grad_var_name(v.name)
+        grads.append(block.vars.get(g))
+    return grads

@@ -1,0 +1,172 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py:
+ErrorClipByValue, GradientClipByValue, GradientClipByNorm,
+GradientClipByGlobalNorm, set_gradient_clip).
+"""
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+
+__all__ = ["ErrorClipByValue", "GradientClipByValue",
+           "GradientClipByNorm", "GradientClipByGlobalNorm",
+           "set_gradient_clip", "append_gradient_clip_ops",
+           "error_clip_callback"]
+
+
+class BaseErrorClipAttr:
+    def _append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _append_clip_op(self, block, grad_name):
+        block.append_op("clip", {"X": grad_name}, {"Out": grad_name},
+                        {"min": self.min, "max": self.max,
+                         "op_role": "backward"})
+
+
+def error_clip_callback(block, context):
+    pass
+
+
+class BaseGradientClipAttr:
+    def _process_context(self, context, param, grad):
+        pass
+
+    def _create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def _create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _create_operators(self, param, grad):
+        block = grad.block
+        out = block.create_var(name=grad.name + "@CLIP",
+                               shape=grad.shape, dtype=grad.dtype)
+        block.append_op("clip", {"X": grad}, {"Out": out},
+                        {"min": self.min, "max": self.max,
+                         "op_role": "backward"})
+        return param, out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _create_operators(self, param, grad):
+        block = grad.block
+        out = block.create_var(name=grad.name + "@CLIP",
+                               shape=grad.shape, dtype=grad.dtype)
+        block.append_op("clip_by_norm", {"X": grad}, {"Out": out},
+                        {"max_norm": self.clip_norm,
+                         "op_role": "backward"})
+        return param, out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+    def _process_context(self, context, param, grad):
+        ctx = context.setdefault(self.group_name,
+                                 {"grads": [], "clip_norm":
+                                  self.clip_norm})
+        ctx["grads"].append((param, grad))
+
+    def _create_operators(self, param, grad):
+        return param, grad  # handled at group level
+
+
+def _apply_global_norm_group(group):
+    from . import layers
+
+    grads = group["grads"]
+    clip_norm = group["clip_norm"]
+    sq_sums = []
+    for _, g in grads:
+        block = g.block
+        sq = block.create_var(name=g.name + "@SQSUM", shape=(1,),
+                              dtype=g.dtype)
+        block.append_op("squared_l2_norm", {"X": g}, {"Out": sq},
+                        {"op_role": "backward"})
+        sq_sums.append(sq)
+    block = grads[0][1].block
+    total = block.create_var(name=grads[0][1].name + "@GLOBALSQ",
+                             shape=(1,), dtype=grads[0][1].dtype)
+    block.append_op("sum", {"X": sq_sums}, {"Out": total},
+                    {"op_role": "backward"})
+    gnorm = block.create_var(name=grads[0][1].name + "@GNORM",
+                             shape=(1,), dtype=grads[0][1].dtype)
+    block.append_op("sqrt", {"X": total}, {"Out": gnorm},
+                    {"op_role": "backward"})
+    # scale = clip_norm / max(gnorm, clip_norm)
+    denom = block.create_var(name=gnorm.name + "@MAX", shape=(1,),
+                             dtype=gnorm.dtype)
+    cn_var = block.create_var(name=gnorm.name + "@CN", shape=(1,),
+                              dtype=gnorm.dtype)
+    block.append_op("fill_constant", {}, {"Out": cn_var},
+                    {"shape": [1], "dtype": "float32",
+                     "value": float(clip_norm), "op_role": "backward"})
+    block.append_op("elementwise_max", {"X": gnorm, "Y": cn_var},
+                    {"Out": denom}, {"op_role": "backward"})
+    scale_var = block.create_var(name=gnorm.name + "@SCALE", shape=(1,),
+                                 dtype=gnorm.dtype)
+    block.append_op("elementwise_div", {"X": cn_var, "Y": denom},
+                    {"Out": scale_var}, {"op_role": "backward"})
+    result = []
+    for p, g in grads:
+        out = g.block.create_var(name=g.name + "@GCLIP",
+                                 shape=g.shape, dtype=g.dtype)
+        g.block.append_op("elementwise_mul",
+                          {"X": g, "Y": scale_var}, {"Out": out},
+                          {"axis": -1, "op_role": "backward"})
+        result.append((p, out))
+    return result
+
+
+_clip_attr = {}
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    from .core.program import default_main_program
+
+    program = program or default_main_program()
+    if param_list is None:
+        param_list = program.all_parameters()
+    for p in param_list:
+        name = p if isinstance(p, str) else p.name
+        _clip_attr[name] = clip
+
+
+def append_gradient_clip_ops(param_grads):
+    context = {}
+    result = []
+    global_groups = []
+    for p, g in param_grads:
+        if g is None:
+            result.append((p, g))
+            continue
+        clip = _clip_attr.get(p.name) or getattr(p, "error_clip", None)
+        if clip is None:
+            result.append((p, g))
+            continue
+        if isinstance(clip, GradientClipByGlobalNorm):
+            clip._process_context(context, p, g)
+            global_groups.append((p.name, clip.group_name))
+        else:
+            result.append(clip._create_operators(p, g))
+    for group_name, group in context.items():
+        result.extend(_apply_global_norm_group(group))
+    return result

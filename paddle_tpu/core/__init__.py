@@ -1,0 +1,1 @@
+from . import types, program, registry, scope, executor  # noqa: F401

@@ -1,0 +1,346 @@
+"""Op registry: kernels, shape inference, grad-op makers.
+
+TPU-native analogue of the reference's OpInfoMap / REGISTER_OPERATOR
+machinery (reference: paddle/fluid/framework/op_registry.h:197-270,
+op_info.h, grad_op_desc_maker.h). Differences driven by XLA:
+
+* A "kernel" is a pure JAX-traceable function over the op's inputs; the
+  Executor traces a whole Block of them into ONE XLA computation, so there
+  is no per-device kernel dispatch key -- XLA picks the device code.
+* Gradients: the reference hand-writes a grad op per op plus a
+  GradOpDescMaker. Here every differentiable op gets its grad op derived
+  automatically through jax.vjp of the forward kernel (rematerialized in
+  the backward pass -- a win on TPU where FLOPs are cheaper than HBM).
+  Ops whose fluid grad semantics differ (dropout's saved mask, sparse
+  embedding grads) register custom grad makers/kernels.
+* Shape inference (reference shape_inference.h / each op's InferShape) is
+  generic: we jax.eval_shape the kernel at two different fake batch sizes;
+  output dims that vary are batch-dims (-1). Ops can override.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .program import GRAD_SUFFIX, Block, Operator, grad_var_name
+from .types import to_jnp_dtype
+
+
+class OpInfo:
+    def __init__(self, type: str, kernel: Callable,
+                 infer_shape: Optional[Callable] = None,
+                 grad_maker=None, differentiable: bool = True,
+                 inplace: Optional[Dict[str, str]] = None,
+                 stop_gradient_slots=(), needs_rng: bool = False):
+        self.type = type
+        self.kernel = kernel
+        self.infer_shape = infer_shape
+        self.grad_maker = grad_maker
+        self.differentiable = differentiable
+        # output slot -> input slot it aliases (buffer donation hint,
+        # analogue of the reference's inplace_op_inference.h)
+        self.inplace = inplace or {}
+        # input slots that never receive gradient (e.g. integer indices)
+        self.stop_gradient_slots = tuple(stop_gradient_slots)
+        self.needs_rng = needs_rng
+
+
+_REGISTRY: Dict[str, OpInfo] = {}
+
+# placeholder input name meaning "no value" (e.g. an output grad that is
+# never reached by backprop); run_op resolves it to None and the vjp grad
+# kernel substitutes zeros (reference uses fill_zeros_like ops instead).
+EMPTY_VAR = "@EMPTY@"
+
+
+def get_op_info(type: str) -> OpInfo:
+    if type not in _REGISTRY:
+        raise KeyError(f"Operator {type!r} is not registered "
+                       f"({len(_REGISTRY)} ops registered)")
+    return _REGISTRY[type]
+
+
+def is_registered(type: str) -> bool:
+    return type in _REGISTRY
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+class OpContext:
+    """What a kernel sees: resolved input values + attrs + a PRNG tap."""
+
+    __slots__ = ("op", "attrs", "_inputs", "_rng_cell", "_rng_salt")
+
+    def __init__(self, op: Operator, inputs: Dict[str, List],
+                 rng_cell=None, rng_salt: int = 0):
+        self.op = op
+        self.attrs = op.attrs
+        self._inputs = inputs
+        self._rng_cell = rng_cell  # single-element list holding current key
+        self._rng_salt = rng_salt
+
+    def input(self, slot, idx=0):
+        vals = self._inputs.get(slot)
+        if not vals:
+            return None
+        return vals[idx]
+
+    def inputs(self, slot) -> List:
+        return list(self._inputs.get(slot, []))
+
+    def has_input(self, slot):
+        return bool(self._inputs.get(slot))
+
+    def attr(self, name, default=None):
+        return self.attrs.get(name, default)
+
+    def rng(self):
+        """Split a fresh PRNG key off the executor-threaded key chain."""
+        if self._rng_cell is None:
+            # shape-inference / eval_shape path: abstract key is fine
+            return jax.random.PRNGKey(0)
+        key = jax.random.fold_in(self._rng_cell[0], self._rng_salt)
+        self._rng_cell[0] = jax.random.split(self._rng_cell[0], 1)[0]
+        return key
+
+
+def register_op(type: str, *, infer_shape=None, grad_maker=None,
+                differentiable=True, inplace=None, stop_gradient_slots=(),
+                needs_rng=False):
+    """Decorator: register `fn(ctx) -> {out_slot: value|[values]}`."""
+
+    def deco(fn):
+        _REGISTRY[type] = OpInfo(
+            type, fn, infer_shape=infer_shape, grad_maker=grad_maker,
+            differentiable=differentiable, inplace=inplace,
+            stop_gradient_slots=stop_gradient_slots, needs_rng=needs_rng)
+        return fn
+
+    return deco
+
+
+def _normalize_outputs(op: Operator, raw) -> Dict[str, List]:
+    out: Dict[str, List] = {}
+    if raw is None:
+        return out
+    if not isinstance(raw, dict):
+        # single-output convenience: bind to the op's single output slot
+        slots = [s for s in op.outputs if op.outputs[s]]
+        if len(slots) != 1:
+            raise ValueError(
+                f"op {op.type} returned a bare value but has output slots "
+                f"{list(op.outputs)}")
+        raw = {slots[0]: raw}
+    for slot, vals in raw.items():
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        out[slot] = list(vals)
+    return out
+
+
+def run_op(op: Operator, env: Dict, rng_cell=None, rng_salt=0) -> None:
+    """Execute one op against an env of name->traced value; write outputs."""
+    info = get_op_info(op.type)
+    inputs: Dict[str, List] = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n == EMPTY_VAR:
+                vals.append(None)
+            elif n not in env:
+                raise KeyError(
+                    f"op {op.type}: input var {n!r} (slot {slot}) not "
+                    f"materialized; known={sorted(list(env))[:20]}...")
+            else:
+                vals.append(env[n])
+        inputs[slot] = vals
+    ctx = OpContext(op, inputs, rng_cell=rng_cell, rng_salt=rng_salt)
+    raw = info.kernel(ctx)
+    outs = _normalize_outputs(op, raw)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if len(vals) != len(names):
+            raise ValueError(
+                f"op {op.type}: slot {slot} produced {len(vals)} values for "
+                f"{len(names)} output vars")
+        for n, v in zip(names, vals):
+            env[n] = v
+
+
+# ---------------------------------------------------------------------------
+# Generic shape inference: eval_shape at two fake batch sizes; dims that
+# move with the fake size are dynamic (-1).
+# ---------------------------------------------------------------------------
+_PROBE_A, _PROBE_B = 7, 11
+
+
+def _probe_spec(var, probe):
+    shape = tuple(probe if d == -1 else d for d in (var.shape or ()))
+    dtype = to_jnp_dtype(var.dtype or "float32")
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def infer_shape_for_op(op: Operator, block: Block) -> None:
+    info = _REGISTRY.get(op.type)
+    if info is None:
+        return  # unregistered (e.g. feed/fetch placeholders) -- skip
+    if info.infer_shape is not None:
+        info.infer_shape(op, block)
+        return
+    try:
+        results = []
+        for probe in (_PROBE_A, _PROBE_B):
+            ins = {}
+            ok = True
+            for slot, names in op.inputs.items():
+                vals = []
+                for n in names:
+                    v = block._find_var_recursive(n)
+                    if v is None or v.shape is None or v.dtype is None:
+                        ok = False
+                        break
+                    vals.append(_probe_spec(v, probe))
+                if not ok:
+                    break
+                ins[slot] = vals
+            if not ok:
+                return
+
+            def f(ins):
+                ctx = OpContext(op, ins)
+                return _normalize_outputs(op, info.kernel(ctx))
+
+            results.append(jax.eval_shape(f, ins))
+    except Exception:
+        return  # shape inference is best-effort at build time
+    ra, rb = results
+    for slot, names in op.outputs.items():
+        if slot not in ra:
+            continue
+        for n, sa, sb in zip(names, ra[slot], rb[slot]):
+            var = block._find_var_recursive(n)
+            if var is None:
+                var = block.create_var(name=n)
+            shape = tuple(
+                da if da == db else -1
+                for da, db in zip(sa.shape, sb.shape))
+            var.shape = shape
+            from .types import as_datatype
+
+            var.dtype = as_datatype(sa.dtype.name)
+
+
+# ---------------------------------------------------------------------------
+# Generic grad machinery: <type>_grad op derived via jax.vjp of the forward.
+# ---------------------------------------------------------------------------
+def _is_float_dtype(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.floating)
+
+
+def make_vjp_grad_kernel(fwd_type: str):
+    """Build the kernel for `<fwd_type>_grad`.
+
+    The grad op's inputs are the forward inputs plus `<slot>@GRAD` entries
+    for each forward output slot; outputs are `<slot>@GRAD` for each
+    differentiable forward input slot. The forward is recomputed inside the
+    vjp (rematerialization) -- on TPU this trades cheap MXU FLOPs for HBM.
+    """
+    def kernel(ctx: OpContext):
+        info = get_op_info(fwd_type)
+        fwd_op = ctx.attr("__fwd_op__")
+        # partition ctx inputs into forward inputs vs output cotangents
+        fwd_inputs = {s: ctx.inputs(s) for s in fwd_op.inputs}
+        # flatten differentiable leaves
+        diff_paths, diff_leaves, const = [], [], {}
+        for slot, vals in fwd_inputs.items():
+            keep = (slot not in info.stop_gradient_slots)
+            for i, v in enumerate(vals):
+                if keep and _is_float_dtype(v):
+                    diff_paths.append((slot, i))
+                    diff_leaves.append(v)
+                else:
+                    const[(slot, i)] = v
+
+        def f(leaves):
+            ins = {s: [None] * len(v) for s, v in fwd_inputs.items()}
+            for (s, i), v in const.items():
+                ins[s][i] = v
+            for (s, i), v in zip(diff_paths, leaves):
+                ins[s][i] = v
+            inner = OpContext(fwd_op, ins)
+            return _normalize_outputs(fwd_op, info.kernel(inner))
+
+        outs, vjp_fn = jax.vjp(f, diff_leaves)
+        # assemble cotangents in the same structure as outs
+        cots = {}
+        for slot, vals in outs.items():
+            gs = ctx.inputs(slot + GRAD_SUFFIX)
+            slot_cots = []
+            for i, v in enumerate(vals):
+                if gs and i < len(gs) and gs[i] is not None:
+                    g = gs[i]
+                    if g.dtype != v.dtype:
+                        g = g.astype(v.dtype)
+                    slot_cots.append(g)
+                else:
+                    slot_cots.append(jnp.zeros_like(v))
+            cots[slot] = slot_cots
+        (grads,) = vjp_fn(cots)
+        result: Dict[str, List] = {}
+        for (slot, i), g in zip(diff_paths, grads):
+            names = fwd_op.inputs[slot]
+            result.setdefault(slot + GRAD_SUFFIX,
+                              [None] * len(names))[i] = g
+        # drop slots whose grads were all skipped
+        return {s: v for s, v in result.items()
+                if any(x is not None for x in v)}
+
+    return kernel
+
+
+def default_grad_maker(op: Operator, no_grad_set=frozenset()):
+    """Create the grad OpDesc for `op` (reference grad_op_desc_maker.h).
+
+    Returns a list of Operator descs (not yet appended to any block).
+    """
+    info = get_op_info(op.type)
+    if not info.differentiable:
+        return []
+    grad_type = op.type + "_grad"
+    if not is_registered(grad_type):
+        register_op(grad_type, differentiable=False)(
+            make_vjp_grad_kernel(op.type))
+    inputs = {s: list(v) for s, v in op.inputs.items()}
+    for slot, names in op.outputs.items():
+        inputs[slot + GRAD_SUFFIX] = [grad_var_name(n) for n in names]
+    outputs = {}
+    for slot, names in op.inputs.items():
+        if slot in info.stop_gradient_slots:
+            continue
+        grads = [grad_var_name(n) for n in names]
+        if all(g in no_grad_set or n in no_grad_set
+               for g, n in zip(grads, names)):
+            continue
+        outputs[slot + GRAD_SUFFIX] = grads
+    if not outputs:
+        return []
+    attrs = dict(op.attrs)
+    attrs["__fwd_op__"] = op
+    return [Operator(op.block, grad_type, inputs, outputs, attrs)]
+
+
+def make_grad_ops(op: Operator, no_grad_set=frozenset()):
+    info = get_op_info(op.type)
+    if info.grad_maker is not None:
+        return info.grad_maker(op, no_grad_set)
+    return default_grad_maker(op, no_grad_set)

@@ -1,0 +1,128 @@
+"""Scope: hierarchical name -> runtime value map.
+
+TPU-native analogue of the reference's Scope/Variable
+(reference: paddle/fluid/framework/scope.h:45, variable.h). Values are JAX
+arrays living on device (or small host numpy); the Executor reads the
+persistable subset as functional state, runs a compiled step with donated
+buffers, and writes the updated state back -- preserving the reference's
+Python-visible mutation model (params updated "in place" by optimizer ops)
+on top of JAX's functional purity (SURVEY.md hard part (e)).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class TensorValue:
+    """fluid LoDTensor-handle parity: scope.find_var(x).get_tensor()."""
+
+    def __init__(self, scope: "Scope", name: str):
+        self._scope = scope
+        self._name = name
+
+    def value(self):
+        return self._scope._get(self._name)
+
+    def set(self, array, place=None):
+        self._scope._set(self._name, np.asarray(array))
+
+    def set_lod(self, lod):
+        self._scope._lods[self._name] = lod
+
+    def lod(self):
+        return self._scope._lods.get(self._name, [])
+
+    def shape(self):
+        v = self.value()
+        return list(v.shape) if v is not None else None
+
+    def __array__(self, dtype=None):
+        arr = np.asarray(self.value())
+        return arr.astype(dtype) if dtype is not None else arr
+
+
+class ScopeVar:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self.name = name
+
+    def get_tensor(self) -> TensorValue:
+        return TensorValue(self._scope, self.name)
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, object] = {}
+        self._lods: Dict[str, list] = {}
+        self.parent = parent
+        self._kids = []
+
+    # --- fluid-style interface --------------------------------------------
+    def var(self, name) -> ScopeVar:
+        if name not in self._vars:
+            self._vars[name] = None
+        return ScopeVar(self, name)
+
+    def find_var(self, name) -> Optional[ScopeVar]:
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return ScopeVar(s, name)
+            s = s.parent
+        return None
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    # --- raw access used by the executor ----------------------------------
+    def _get(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def _set(self, name, value):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                s._vars[name] = value
+                return
+            s = s.parent
+        self._vars[name] = value
+
+    def has(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars and s._vars[name] is not None:
+                return True
+            s = s.parent
+        return False
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+        self._lods.pop(name, None)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+def _reset_global_scope():
+    global _global_scope
+    _global_scope = Scope()
+    return _global_scope

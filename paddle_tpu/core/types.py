@@ -1,0 +1,90 @@
+"""Core type system for paddle_tpu.
+
+TPU-native analogue of the reference's dtype/vartype enums
+(reference: paddle/fluid/framework/framework.proto:91-135 VarType).
+We map framework dtypes directly onto JAX/numpy dtypes; bfloat16 is a
+first-class citizen (TPU MXU native precision).
+"""
+from __future__ import annotations
+
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class VarType(enum.Enum):
+    """Variable kinds (reference framework.proto:108-134)."""
+
+    LOD_TENSOR = "lod_tensor"          # dense tensor (+ optional LoD metadata)
+    SELECTED_ROWS = "selected_rows"    # sparse row-set (embedding grads)
+    LOD_TENSOR_ARRAY = "lod_tensor_array"
+    STEP_SCOPES = "step_scopes"
+    READER = "reader"
+    RAW = "raw"
+
+
+class DataType(enum.Enum):
+    """Framework dtypes (reference framework.proto:91-106)."""
+
+    BOOL = "bool"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    INT16 = "int16"
+    INT32 = "int32"
+    INT64 = "int64"
+    FP16 = "float16"
+    BF16 = "bfloat16"
+    FP32 = "float32"
+    FP64 = "float64"
+
+
+_TO_JNP = {
+    DataType.BOOL: jnp.bool_,
+    DataType.INT8: jnp.int8,
+    DataType.UINT8: jnp.uint8,
+    DataType.INT16: jnp.int16,
+    DataType.INT32: jnp.int32,
+    DataType.INT64: jnp.int64,
+    DataType.FP16: jnp.float16,
+    DataType.BF16: jnp.bfloat16,
+    DataType.FP32: jnp.float32,
+    DataType.FP64: jnp.float64,
+}
+
+_FROM_STR = {dt.value: dt for dt in DataType}
+_FROM_STR.update({
+    "float": DataType.FP32,
+    "double": DataType.FP64,
+    "half": DataType.FP16,
+    "int": DataType.INT32,
+    "long": DataType.INT64,
+})
+
+
+def as_datatype(dtype) -> DataType:
+    """Coerce a string / numpy dtype / DataType into DataType."""
+    if isinstance(dtype, DataType):
+        return dtype
+    if isinstance(dtype, str):
+        if dtype in _FROM_STR:
+            return _FROM_STR[dtype]
+        return DataType(np.dtype(dtype).name)
+    if dtype is bool:
+        return DataType.BOOL
+    name = jnp.dtype(dtype).name
+    return _FROM_STR[name]
+
+
+def to_jnp_dtype(dtype):
+    """Framework dtype -> jnp dtype."""
+    return _TO_JNP[as_datatype(dtype)]
+
+
+def to_np_dtype(dtype):
+    dt = as_datatype(dtype)
+    if dt == DataType.BF16:
+        import ml_dtypes  # shipped with jax
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(dt.value)

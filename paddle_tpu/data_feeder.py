@@ -1,0 +1,66 @@
+"""DataFeeder (reference python/paddle/fluid/data_feeder.py:140).
+
+Converts python/numpy minibatch rows into the feed dict the Executor
+expects. Fluid's LoD conversion (list-of-variable-length-rows ->
+LoDTensor) becomes padded-dense + @SEQ_LEN companion arrays here
+(see layers/sequence.py for the representation contract).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+from .core.program import Variable, default_main_program
+from .core.types import to_np_dtype
+from .layers.sequence import SEQ_LEN_SUFFIX
+
+
+class DataFeeder:
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.program = program or default_main_program()
+        self.feed_vars = []
+        for v in feed_list:
+            if isinstance(v, str):
+                v = self.program.global_block.var(v)
+            self.feed_vars.append(v)
+
+    def feed(self, iterable) -> dict:
+        """iterable: list of rows, each row a tuple aligned with
+        feed_list entries."""
+        columns = list(zip(*iterable))
+        result = {}
+        for var, col in zip(self.feed_vars, columns):
+            if var.lod_level and var.lod_level > 0:
+                data, lengths = _pad_sequences(col, var)
+                result[var.name] = data
+                result[var.name + SEQ_LEN_SUFFIX] = lengths
+            else:
+                arr = np.asarray(col)
+                dtype = to_np_dtype(var.dtype) if var.dtype else None
+                if dtype is not None and arr.dtype != dtype:
+                    arr = arr.astype(dtype)
+                # fluid reshapes rows to the var's trailing dims
+                if var.shape and len(var.shape) > 1:
+                    trail = [d for d in var.shape[1:]]
+                    if all(d > 0 for d in trail):
+                        arr = arr.reshape([arr.shape[0]] + trail)
+                result[var.name] = arr
+        return result
+
+
+def _pad_sequences(col, var: Variable):
+    """list of per-example variable-length sequences -> padded + lengths,
+    rounded up to a small bucket to bound XLA recompiles."""
+    seqs = [np.asarray(s) for s in col]
+    lengths = np.asarray([len(s) for s in seqs], dtype=np.int32)
+    max_len = int(max(1, lengths.max()))
+    # bucket to multiples of 16 to cap distinct compiled shapes
+    bucket = 16
+    max_len = ((max_len + bucket - 1) // bucket) * bucket
+    trailing = seqs[0].shape[1:] if seqs[0].ndim > 1 else ()
+    dtype = to_np_dtype(var.dtype) if var.dtype else seqs[0].dtype
+    out = np.zeros((len(seqs), max_len) + tuple(trailing), dtype=dtype)
+    for i, s in enumerate(seqs):
+        out[i, :len(s)] = s
+    return out, lengths

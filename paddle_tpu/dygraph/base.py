@@ -1,0 +1,194 @@
+"""Imperative (dygraph) mode: eager execution with a gradient tape.
+
+Parity: reference paddle/fluid/imperative/ (VarBase layer.h:115, OpBase,
+Tracer tracer.cc:138, autograd engine.cc) + python/paddle/fluid/dygraph.
+JAX is natively eager, so ops run immediately through the SAME registered
+kernels as graph mode; a lightweight tape records (op, inputs, outputs)
+and backward() replays it through the registry's vjp-derived grad kernels
+-- one autodiff implementation for both modes, where the reference
+maintains two.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import unique_name
+from ..core.program import GRAD_SUFFIX, Operator, grad_var_name
+from ..core.registry import (EMPTY_VAR, get_op_info, make_grad_ops,
+                             run_op)
+
+_dygraph_tracer = None
+
+
+def enabled():
+    return _dygraph_tracer is not None
+
+
+def enable_dygraph(place=None):
+    global _dygraph_tracer
+    from .tracer import Tracer
+
+    _dygraph_tracer = Tracer()
+
+
+def disable_dygraph():
+    global _dygraph_tracer
+    _dygraph_tracer = None
+
+
+def tracer():
+    return _dygraph_tracer
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    enable_dygraph(place)
+    try:
+        yield
+    finally:
+        disable_dygraph()
+
+
+@contextlib.contextmanager
+def no_grad():
+    t = tracer()
+    old = t._record if t else True
+    if t:
+        t._record = False
+    try:
+        yield
+    finally:
+        if t:
+            t._record = old
+
+
+class VarBase:
+    """Eager tensor + optional grad (reference imperative/layer.h:115)."""
+
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False):
+        self.value = jnp.asarray(value)
+        self.name = name or unique_name.generate("dyvar")
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = not stop_gradient
+        self._grad = None
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        from ..core.types import as_datatype
+
+        return as_datatype(self.value.dtype.name)
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def backward(self):
+        t = tracer()
+        if t is None:
+            raise RuntimeError("backward() outside dygraph.guard()")
+        t.run_backward(self)
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True)
+
+    def astype(self, dtype):
+        from ..core.types import to_jnp_dtype
+
+        return VarBase(self.value.astype(to_jnp_dtype(dtype)))
+
+    # arithmetic sugar routed through traced ops so grads flow
+    def _ew(self, other, op_type, reverse=False):
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, dtype=self.value.dtype),
+                            stop_gradient=True)
+        a, b = (other, self) if reverse else (self, other)
+        return trace_op(op_type, {"X": [a], "Y": [b]}, 1, {})[0]
+
+    def __add__(self, o):
+        return self._ew(o, "elementwise_add")
+
+    def __radd__(self, o):
+        return self._ew(o, "elementwise_add", True)
+
+    def __sub__(self, o):
+        return self._ew(o, "elementwise_sub")
+
+    def __rsub__(self, o):
+        return self._ew(o, "elementwise_sub", True)
+
+    def __mul__(self, o):
+        return self._ew(o, "elementwise_mul")
+
+    def __rmul__(self, o):
+        return self._ew(o, "elementwise_mul", True)
+
+    def __truediv__(self, o):
+        return self._ew(o, "elementwise_div")
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape})"
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(value, name=name,
+                   stop_gradient=not isinstance(value, VarBase))
+
+
+def trace_op(op_type, inputs: Dict[str, List[VarBase]], num_outputs,
+             attrs, out_slots=None) -> List[VarBase]:
+    """Run one op eagerly + record it on the tape."""
+    t = tracer()
+    info = get_op_info(op_type)
+    env = {}
+    in_names = {}
+    for slot, vars_ in inputs.items():
+        names = []
+        for v in vars_:
+            if v is None:
+                continue
+            env[v.name] = v.value
+            names.append(v.name)
+        if names:
+            in_names[slot] = names
+    if out_slots is None:
+        out_slots = {"Out": num_outputs}
+    out_names = {}
+    out_vars_by_slot = {}
+    for slot, n in out_slots.items():
+        vs = [VarBase(0.0, name=unique_name.generate(
+            f"{op_type}.{slot}")) for _ in range(n)]
+        out_names[slot] = [v.name for v in vs]
+        out_vars_by_slot[slot] = vs
+    op = Operator(None, op_type, in_names, out_names, attrs)
+    rng_cell = [t.next_rng() if t else jax.random.PRNGKey(0)]
+    run_op(op, env, rng_cell=rng_cell, rng_salt=0)
+    outs = []
+    for slot, vs in out_vars_by_slot.items():
+        for v in vs:
+            v.value = env[v.name]
+            outs.append(v)
+    if t is not None and t._record:
+        t.record(op, inputs, out_vars_by_slot)
+    return outs

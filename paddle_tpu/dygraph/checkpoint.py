@@ -1,0 +1,23 @@
+"""dygraph checkpoint (reference python/paddle/fluid/dygraph/checkpoint.py)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def save_persistables(model_dict, dirname, optimizers=None):
+    os.makedirs(dirname, exist_ok=True)
+    state = model_dict.state_dict() if hasattr(model_dict, "state_dict") \
+        else {k: v.numpy() for k, v in model_dict.items()}
+    np.savez(os.path.join(dirname, "params.npz"), **state)
+
+
+def load_persistables(model_or_dirname, dirname=None):
+    if dirname is None:
+        dirname = model_or_dirname
+        with np.load(os.path.join(dirname, "params.npz")) as blob:
+            return {k: blob[k] for k in blob.files}, {}
+    with np.load(os.path.join(dirname, "params.npz")) as blob:
+        model_or_dirname.set_dict({k: blob[k] for k in blob.files})
+    return model_or_dirname

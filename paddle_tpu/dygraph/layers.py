@@ -1,0 +1,129 @@
+"""dygraph.Layer base (reference python/paddle/fluid/dygraph/layers.py)."""
+from __future__ import annotations
+
+from typing import Dict, Iterator
+
+import numpy as np
+
+from .. import unique_name
+from .base import VarBase
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower())
+        self._dtype = dtype
+        self._parameters: Dict[str, VarBase] = {}
+        self._sub_layers: Dict[str, "Layer"] = {}
+
+    def full_name(self):
+        return self._full_name
+
+    def create_parameter(self, shape, dtype=None, attr=None,
+                         is_bias=False, default_initializer=None):
+        from ..core.types import to_np_dtype
+
+        np_dtype = to_np_dtype(dtype or self._dtype)
+        shape = [int(s) for s in shape]
+        if default_initializer is not None:
+            val = _run_initializer(default_initializer, shape, np_dtype)
+        elif is_bias:
+            val = np.zeros(shape, dtype=np_dtype)
+        else:
+            fan_in = shape[0] if shape else 1
+            fan_out = shape[1] if len(shape) > 1 else 1
+            limit = np.sqrt(6.0 / (fan_in + fan_out))
+            val = np.random.uniform(-limit, limit,
+                                    shape).astype(np_dtype)
+        name = (getattr(attr, "name", None)
+                or unique_name.generate(self._full_name + ".w"))
+        p = VarBase(val, name=name, persistable=True)
+        self._parameters[name] = p
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def state_dict(self, include_sublayers=True):
+        return {p.name: p.numpy() for p in
+                self.parameters(include_sublayers)}
+
+    def set_dict(self, state, include_sublayers=True):
+        import jax.numpy as jnp
+
+        for p in self.parameters(include_sublayers):
+            if p.name in state:
+                p.value = jnp.asarray(state[p.name])
+
+    load_dict = set_dict
+
+    def train(self):
+        self._is_test = False
+
+    def eval(self):
+        self._is_test = True
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", {})[name] = value
+        object.__setattr__(self, name, value)
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+
+def _run_initializer(init, shape, np_dtype):
+    """Run a graph-mode Initializer eagerly for dygraph params."""
+    from ..initializer import (ConstantInitializer, NormalInitializer,
+                               NumpyArrayInitializer,
+                               TruncatedNormalInitializer,
+                               UniformInitializer, XavierInitializer,
+                               MSRAInitializer)
+
+    rng = np.random
+    if isinstance(init, ConstantInitializer):
+        return np.full(shape, init.value, dtype=np_dtype)
+    if isinstance(init, UniformInitializer):
+        return rng.uniform(init.low, init.high, shape).astype(np_dtype)
+    if isinstance(init, NormalInitializer):
+        return rng.normal(init.loc, init.scale, shape).astype(np_dtype)
+    if isinstance(init, TruncatedNormalInitializer):
+        v = rng.normal(init.loc, init.scale, shape)
+        v = np.clip(v, init.loc - 2 * init.scale,
+                    init.loc + 2 * init.scale)
+        return v.astype(np_dtype)
+    if isinstance(init, NumpyArrayInitializer):
+        return np.asarray(init.value, dtype=np_dtype).reshape(shape)
+    if isinstance(init, (XavierInitializer, MSRAInitializer)):
+        fan_in = shape[0] if shape else 1
+        fan_out = shape[1] if len(shape) > 1 else 1
+        limit = np.sqrt(6.0 / (fan_in + fan_out))
+        return rng.uniform(-limit, limit, shape).astype(np_dtype)
+    raise TypeError(f"unsupported initializer for dygraph: {init}")

@@ -1,0 +1,258 @@
+"""dygraph NN layers (reference python/paddle/fluid/dygraph/nn.py:
+Conv2D, Pool2D, FC, BatchNorm, Embedding, LayerNorm, GRUUnit...).
+Each forward() routes through trace_op so the tape records grads."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..initializer import ConstantInitializer
+from .base import VarBase, trace_op
+from .layers import Layer
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope=None, num_channels=None,
+                 num_filters=None, filter_size=3, stride=1, padding=0,
+                 dilation=1, groups=1, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) \
+            else [filter_size, filter_size]
+        self._attrs = {"strides": _pair(stride), "paddings": _pair(padding),
+                       "dilations": _pair(dilation), "groups": groups}
+        self._act = act
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups, fs[0], fs[1]])
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], is_bias=True)
+
+    def forward(self, x):
+        out, = trace_op("conv2d", {"Input": [x], "Filter": [self.weight]},
+                        1, self._attrs, out_slots={"Output": 1})
+        if self.bias is not None:
+            out, = trace_op("elementwise_add",
+                            {"X": [out], "Y": [self.bias]}, 1,
+                            {"axis": 1})
+        return _act(out, self._act)
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=2, pool_type="max",
+                 pool_stride=2, pool_padding=0, global_pooling=False,
+                 ceil_mode=False, exclusive=True):
+        super().__init__(name_scope)
+        self._attrs = {"pooling_type": pool_type,
+                       "ksize": _pair(pool_size),
+                       "strides": _pair(pool_stride),
+                       "paddings": _pair(pool_padding),
+                       "global_pooling": global_pooling,
+                       "ceil_mode": ceil_mode, "exclusive": exclusive}
+
+    def forward(self, x):
+        out, = trace_op("pool2d", {"X": [x]}, 1, self._attrs)
+        return out
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(None, dtype)
+        self._act = act
+        self.weight = self.create_parameter([input_dim, output_dim],
+                                            attr=param_attr)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([output_dim], is_bias=True)
+
+    def forward(self, x):
+        out, = trace_op("mul", {"X": [x], "Y": [self.weight]}, 1,
+                        {"x_num_col_dims": max(1, len(x.shape) - 1),
+                         "y_num_col_dims": 1})
+        if self.bias is not None:
+            out, = trace_op("elementwise_add",
+                            {"X": [out], "Y": [self.bias]}, 1,
+                            {"axis": -1})
+        return _act(out, self._act)
+
+
+class FC(Layer):
+    """fluid-era FC (flattens input from num_flatten_dims)."""
+
+    def __init__(self, name_scope=None, size=None, num_flatten_dims=1,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._nfd = num_flatten_dims
+        self._act = act
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self.weight = None
+        self.bias = None
+
+    def forward(self, x):
+        if self.weight is None:
+            in_dim = int(np.prod(x.shape[self._nfd:]))
+            self.weight = self.create_parameter([in_dim, self._size],
+                                                attr=self._param_attr)
+            self.bias = None if self._bias_attr is False else \
+                self.create_parameter([self._size], is_bias=True)
+        out, = trace_op("mul", {"X": [x], "Y": [self.weight]}, 1,
+                        {"x_num_col_dims": self._nfd,
+                         "y_num_col_dims": 1})
+        if self.bias is not None:
+            out, = trace_op("elementwise_add",
+                            {"X": [out], "Y": [self.bias]}, 1,
+                            {"axis": -1})
+        return _act(out, self._act)
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope=None, num_channels=None, act=None,
+                 is_test=False, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", use_global_stats=False):
+        super().__init__(name_scope, dtype)
+        c = num_channels
+        self._act = act
+        self._attrs = {"momentum": momentum, "epsilon": epsilon,
+                       "is_test": is_test, "data_layout": data_layout,
+                       "use_global_stats": use_global_stats}
+        self.weight = self.create_parameter(
+            [c], default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([c], is_bias=True)
+        self._mean = VarBase(np.zeros([c], dtype=np.float32),
+                             stop_gradient=True, persistable=True)
+        self._variance = VarBase(np.ones([c], dtype=np.float32),
+                                 stop_gradient=True, persistable=True)
+
+    def forward(self, x):
+        outs = trace_op(
+            "batch_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            5, self._attrs,
+            out_slots={"Y": 1, "MeanOut": 1, "VarianceOut": 1,
+                       "SavedMean": 1, "SavedVariance": 1})
+        y, mean_out, var_out = outs[0], outs[1], outs[2]
+        self._mean.value = mean_out.value
+        self._variance.value = var_out.value
+        return _act(y, self._act)
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+        self.weight = self.create_parameter(size, attr=param_attr)
+
+    def forward(self, ids):
+        out, = trace_op("lookup_table",
+                        {"Ids": [ids], "W": [self.weight]}, 1,
+                        {"padding_idx": self._padding_idx})
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope=None, normalized_shape=None, scale=True,
+                 shift=True, begin_norm_axis=1, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        dim = int(np.prod(normalized_shape)) \
+            if normalized_shape is not None else None
+        self._attrs = {"epsilon": epsilon,
+                       "begin_norm_axis": begin_norm_axis}
+        self._act = act
+        self.weight = self.create_parameter(
+            [dim], default_initializer=ConstantInitializer(1.0)) \
+            if scale and dim else None
+        self.bias = self.create_parameter([dim], is_bias=True) \
+            if shift and dim else None
+
+    def forward(self, x):
+        ins = {"X": [x]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        outs = trace_op("layer_norm", ins, 3, self._attrs,
+                        out_slots={"Y": 1, "Mean": 1, "Variance": 1})
+        return _act(outs[0], self._act)
+
+
+class GRUUnit(Layer):
+    def __init__(self, name_scope=None, size=None, param_attr=None,
+                 bias_attr=None, activation="tanh",
+                 gate_activation="sigmoid", origin_mode=False,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        h = size // 3
+        self._attrs = {"activation": activation,
+                       "gate_activation": gate_activation,
+                       "origin_mode": origin_mode}
+        self.weight = self.create_parameter([h, 3 * h])
+        self.bias = self.create_parameter([1, 3 * h], is_bias=True)
+
+    def forward(self, input, hidden):
+        outs = trace_op(
+            "gru_unit",
+            {"Input": [input], "HiddenPrev": [hidden],
+             "Weight": [self.weight], "Bias": [self.bias]},
+            3, self._attrs,
+            out_slots={"Gate": 1, "ResetHiddenPrev": 1, "Hidden": 1})
+        return outs[2], outs[1], outs[0]
+
+
+class PRelu(Layer):
+    def __init__(self, name_scope=None, mode="all", channel=None,
+                 input_shape=None, param_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [1, channel, 1, 1]
+        else:
+            shape = [1] + list(input_shape[1:])
+        self.weight = self.create_parameter(
+            shape, default_initializer=ConstantInitializer(0.25))
+
+    def forward(self, x):
+        out, = trace_op("prelu", {"X": [x], "Alpha": [self.weight]}, 1,
+                        {"mode": self._mode})
+        return out
+
+
+class NCE(Layer):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError("dygraph NCE: use graph-mode layers.nce")
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="downgrade_in_infer"):
+        super().__init__()
+        self._p = p
+        self._mode = mode
+        self._is_test = False
+
+    def forward(self, x):
+        outs = trace_op("dropout", {"X": [x]}, 2,
+                        {"dropout_prob": self._p,
+                         "is_test": getattr(self, "_is_test", False),
+                         "dropout_implementation": self._mode},
+                        out_slots={"Out": 1, "Mask": 1})
+        return outs[0]
+
+
+def _act(v, act):
+    if act is None:
+        return v
+    out, = trace_op(act, {"X": [v]}, 1, {})
+    return out
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v]

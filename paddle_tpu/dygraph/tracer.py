@@ -1,0 +1,87 @@
+"""Dygraph tape + backward engine (reference imperative/tracer.cc:138 +
+engine.cc). Replays recorded ops in reverse through the registry's grad
+makers -- the same machinery graph-mode append_backward uses."""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..core.program import GRAD_SUFFIX, grad_var_name
+from ..core.registry import EMPTY_VAR, make_grad_ops, run_op
+
+
+class Tracer:
+    def __init__(self):
+        self._tape = []  # (op_desc, input VarBases, output VarBases)
+        self._record = True
+        self._rng = jax.random.PRNGKey(0)
+
+    def next_rng(self):
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def record(self, op, inputs, outputs):
+        self._tape.append((op, inputs, outputs))
+
+    def reset(self):
+        self._tape.clear()
+
+    def run_backward(self, loss):
+        env: Dict = {}
+        var_by_name = {}
+        for op, inputs, outputs in self._tape:
+            for vs in inputs.values():
+                for v in vs:
+                    if v is not None:
+                        env[v.name] = v.value
+                        var_by_name[v.name] = v
+            for vs in outputs.values():
+                for v in vs:
+                    env[v.name] = v.value
+                    var_by_name[v.name] = v
+        grads_env = {grad_var_name(loss.name):
+                     jnp.ones_like(loss.value)}
+        produced = {grad_var_name(loss.name)}
+        for op, inputs, outputs in reversed(self._tape):
+            out_names = [n for ns in op.outputs.values() for n in ns]
+            if not any(grad_var_name(n) in produced for n in out_names):
+                continue
+            no_grad = {v.name for vs in inputs.values() for v in vs
+                       if v is not None and v.stop_gradient}
+            for gop in make_grad_ops(op, no_grad_set=no_grad):
+                run_env = dict(env)
+                for slot, names in list(gop.inputs.items()):
+                    if slot.endswith(GRAD_SUFFIX):
+                        resolved = []
+                        for n in names:
+                            if n in produced:
+                                run_env[n] = grads_env[n]
+                                resolved.append(n)
+                            else:
+                                resolved.append(EMPTY_VAR)
+                        gop.inputs[slot] = resolved
+                try:
+                    run_op(gop, run_env)
+                except KeyError:
+                    continue
+                for slot, names in gop.outputs.items():
+                    for n in names:
+                        if n not in run_env:
+                            continue
+                        g = run_env[n]
+                        if n in produced:
+                            grads_env[n] = grads_env[n] + g
+                        else:
+                            grads_env[n] = g
+                            produced.add(n)
+        # write grads back onto VarBases
+        for name, var in var_by_name.items():
+            g = grads_env.get(grad_var_name(name))
+            if g is not None and not var.stop_gradient:
+                if var._grad is None:
+                    var._grad = g
+                else:
+                    var._grad = var._grad + g
+        self.reset()

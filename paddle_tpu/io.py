@@ -1,0 +1,181 @@
+"""Checkpoint / model save-load (reference python/paddle/fluid/io.py).
+
+The reference implements save/load by appending save/load *ops* to a side
+program and running it (io.py:94 save_vars, :443 save_persistables, :865
+save_inference_model; operators/save_op.cc serializes LoDTensor streams).
+Here persistence is host-side: scope arrays serialize as .npy streams
+(single-var files or a combined file), and the inference model exports the
+pruned serialized Program (JSON) + params -- same artifact roles as
+`__model__` + param files. Orbax-style sharded checkpointing for pod-scale
+state lives in parallel/checkpoint.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .core.executor import Executor
+from .core.program import Program, Variable, default_main_program
+from .core.scope import global_scope
+
+__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "get_program_parameter",
+           "get_program_persistable_vars"]
+
+_MODEL_FILE = "__model__"
+
+
+def _is_persistable(var: Variable):
+    return var.persistable and not var.is_data
+
+
+def get_program_parameter(program):
+    return program.all_parameters()
+
+
+def get_program_persistable_vars(program):
+    return [v for v in program.list_vars() if _is_persistable(v)]
+
+
+def _save_array(path, arr):
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    np.save(path + ".npy", np.asarray(arr), allow_pickle=False)
+    if os.path.exists(path):
+        os.remove(path)
+    os.rename(path + ".npy", path)
+
+
+def _load_array(path):
+    with open(path, "rb") as f:
+        return np.load(f, allow_pickle=False)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference io.py:94."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is None:
+        for var in vars:
+            val = scope._get(var.name)
+            if val is None:
+                continue
+            _save_array(os.path.join(dirname, var.name), val)
+    else:
+        blob = {}
+        for var in vars:
+            val = scope._get(var.name)
+            if val is not None:
+                blob[var.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **blob)
+        src = os.path.join(dirname, filename) + ".npz"
+        dst = os.path.join(dirname, filename)
+        if os.path.exists(src):
+            if os.path.exists(dst):
+                os.remove(dst)
+            os.rename(src, dst)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or default_main_program()
+    return save_vars(executor, dirname, main_program,
+                     vars=main_program.all_parameters(),
+                     filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None,
+                      filename=None):
+    """reference io.py:443."""
+    main_program = main_program or default_main_program()
+    return save_vars(executor, dirname, main_program,
+                     vars=get_program_persistable_vars(main_program),
+                     filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference io.py:493."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    if filename is None:
+        for var in vars:
+            path = os.path.join(dirname, var.name)
+            if not os.path.exists(path):
+                continue
+            scope.var(var.name)
+            scope._set(var.name, _load_array(path))
+    else:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            blob = np.load(f, allow_pickle=False)
+            for var in vars:
+                if var.name in blob:
+                    scope.var(var.name)
+                    scope._set(var.name, blob[var.name])
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or default_main_program()
+    return load_vars(executor, dirname, main_program,
+                     vars=main_program.all_parameters(),
+                     filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None,
+                      filename=None):
+    """reference io.py:660."""
+    main_program = main_program or default_main_program()
+    return load_vars(executor, dirname, main_program,
+                     vars=get_program_persistable_vars(main_program),
+                     filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names: List[str],
+                         target_vars: List[Variable], executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None,
+                         export_for_deployment=True):
+    """reference io.py:865: prune to fetch targets, write __model__ +
+    params. The exported program is the serving artifact consumed by
+    inference.Predictor (AOT-compiled by XLA at load)."""
+    main_program = main_program or default_main_program()
+    pruned = main_program.clone(for_test=True)
+    target_names = [v.name for v in target_vars]
+    pruned = pruned._prune(target_names)
+    os.makedirs(dirname, exist_ok=True)
+    model = {
+        "program": pruned.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": target_names,
+    }
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE),
+              "w") as f:
+        json.dump(model, f)
+    persist = [v for v in pruned.list_vars() if _is_persistable(v)]
+    save_vars(executor, dirname, pruned, vars=persist,
+              filename=params_filename)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """reference io.py:1020 -> (program, feed_names, fetch_targets)."""
+    with open(os.path.join(dirname, model_filename or _MODEL_FILE)) as f:
+        model = json.load(f)
+    program = Program.from_dict(model["program"])
+    persist = [v for v in program.list_vars() if _is_persistable(v)]
+    load_vars(executor, dirname, program, vars=persist,
+              filename=params_filename)
+    fetch_targets = [program.global_block.var(n)
+                     for n in model["fetch_names"]]
+    return program, model["feed_names"], fetch_targets

@@ -1,0 +1,221 @@
+"""Control-flow layers (reference python/paddle/fluid/layers/control_flow.py).
+
+In-graph control flow lowers to XLA structured control flow
+(lax.while_loop / lax.cond / lax.scan) instead of the reference's
+sub-block-interpreting while_op/conditional_block_op
+(reference operators/controlflow/while_op.cc, conditional_block_op.cc).
+`While` and `cond` carry a sub-Block whose ops are traced inside the XLA
+loop/branch body; the loop-carried state is the set of vars the body
+mutates. Data-dependent *shapes* remain illegal (XLA static-shape rule) --
+same modeling discipline the reference's dynamic RNN demanded, different
+mechanism.
+"""
+from __future__ import annotations
+
+from ..core.program import default_main_program
+from ..layer_helper import LayerHelper
+from . import tensor as tensor_layers
+
+__all__ = ["less_than", "less_equal", "greater_than", "greater_equal",
+           "equal", "not_equal", "logical_and", "logical_or",
+           "logical_xor", "logical_not", "While", "Switch", "cond",
+           "increment", "array_write", "array_read", "array_length",
+           "create_array", "StaticRNN", "DynamicRNN", "IfElse",
+           "less_than_value"]
+
+
+def _cmp_layer(op_type):
+    def layer(x, y, cond=None):
+        helper = LayerHelper(op_type, input=x)
+        out = cond or helper.create_variable_for_type_inference(
+            "bool", True)
+        helper.append_op(op_type, {"X": x, "Y": y}, {"Out": out}, {})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+less_than = _cmp_layer("less_than")
+less_equal = _cmp_layer("less_equal")
+greater_than = _cmp_layer("greater_than")
+greater_equal = _cmp_layer("greater_equal")
+equal = _cmp_layer("equal")
+not_equal = _cmp_layer("not_equal")
+logical_and = _cmp_layer("logical_and")
+logical_or = _cmp_layer("logical_or")
+logical_xor = _cmp_layer("logical_xor")
+
+
+def logical_not(x, out=None):
+    helper = LayerHelper("logical_not", input=x)
+    out = out or helper.create_variable_for_type_inference("bool", True)
+    helper.append_op("logical_not", {"X": x}, {"Out": out}, {})
+    return out
+
+
+def less_than_value(x, value: float):
+    y = tensor_layers.fill_constant([1], "float32", value)
+    return less_than(x, y)
+
+
+def increment(x, value=1.0, in_place=True):
+    from . import nn
+
+    return nn.increment(x, value, in_place)
+
+
+# --- LoDTensorArray analogues: a list-typed var manipulated at trace time
+# (reference lod_tensor_array ops tensor_array_read_write_op.cc) ----------
+def create_array(dtype):
+    helper = LayerHelper("array")
+    return helper.create_variable(
+        name=helper.name, dtype=dtype)
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write", input=x)
+    array = array or create_array(x.dtype)
+    helper.append_op("write_to_array", {"X": x, "I": i},
+                     {"Out": array}, {})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read", input=array)
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op("read_from_array", {"X": array, "I": i},
+                     {"Out": out}, {})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length", input=array)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("lod_array_length", {"X": array}, {"Out": out}, {})
+    return out
+
+
+class While:
+    """reference layers/control_flow.py:492 While -- lowered to
+    lax.while_loop by the while op kernel (ops/control_flow_ops.py)."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self.helper = LayerHelper("while", name=name)
+        self._program = default_main_program()
+
+    def block(self):
+        return _WhileBlockGuard(self)
+
+
+class _WhileBlockGuard:
+    def __init__(self, while_op: While):
+        self.w = while_op
+
+    def __enter__(self):
+        self.block = self.w._program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        prog = self.w._program
+        sub = prog.current_block()
+        prog.rollback()
+        parent = prog.current_block()
+        # loop state: vars read from parent + written inside the sub-block
+        reads, writes = set(), set()
+        for op in sub.ops:
+            for n in op.input_arg_names:
+                if n not in writes and parent._find_var_recursive(n) \
+                        is not None:
+                    reads.add(n)
+            writes.update(op.output_arg_names)
+        carried = sorted(writes & (reads | {self.w.cond_var.name}))
+        externals = sorted(reads - writes)
+        parent.append_op(
+            "while",
+            {"Condition": self.w.cond_var.name, "X": externals},
+            {"Out": carried},
+            {"sub_block": sub, "carried": carried,
+             "externals": externals})
+        return False
+
+
+def cond(pred, true_fn=None, false_fn=None):
+    """Functional conditional -> lax.cond (fluid 1.x layers.cond API)."""
+    prog = default_main_program()
+    helper = LayerHelper("cond")
+    # trace both branches into sub-blocks
+    tb = prog.create_block()
+    t_out = true_fn() if true_fn else None
+    prog.rollback()
+    fb = prog.create_block()
+    f_out = false_fn() if false_fn else None
+    prog.rollback()
+    if t_out is None:
+        return None
+    parent = prog.current_block()
+    out = helper.create_variable_for_type_inference(t_out.dtype)
+    reads = set()
+    for blk in (tb, fb):
+        writes = set()
+        for op in blk.ops:
+            for n in op.input_arg_names:
+                if n not in writes and parent._find_var_recursive(n) \
+                        is not None:
+                    reads.add(n)
+            writes.update(op.output_arg_names)
+    parent.append_op(
+        "conditional_block",
+        {"Condition": pred.name, "X": sorted(reads)},
+        {"Out": out},
+        {"true_block": tb, "false_block": fb,
+         "true_out": t_out.name if t_out is not None else None,
+         "false_out": f_out.name if f_out is not None else None})
+    return out
+
+
+class Switch:
+    """reference layers/control_flow.py:1126 -- sequential case guard."""
+
+    def __init__(self, name=None):
+        self.cases = []
+        self.default_seen = False
+
+    def case(self, condition):
+        raise NotImplementedError(
+            "Switch: use layers.cond / piecewise arithmetic masks "
+            "(XLA-friendly) -- see learning_rate_scheduler.py")
+
+    def default(self):
+        raise NotImplementedError("Switch.default: see Switch.case")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class StaticRNN:
+    """reference layers/control_flow.py:266 -- implemented over lax.scan
+    in layers/rnn.py (StaticRNN facade)."""
+
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "StaticRNN: use layers.rnn.static_rnn / layers.lstm "
+            "(lax.scan-based)")
+
+
+class DynamicRNN:
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "DynamicRNN: use layers.rnn.dynamic_rnn (scan + segment "
+            "masks over padded batches)")
+
+
+class IfElse:
+    def __init__(self, cond, name=None):
+        raise NotImplementedError("IfElse: use layers.cond")

@@ -1,0 +1,162 @@
+"""Detection layers (reference python/paddle/fluid/layers/detection.py +
+operators/detection/ -- prior_box, multiclass_nms, yolov3_loss, etc.).
+Kernels in ops/detection_ops.py.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["prior_box", "box_coder", "iou_similarity", "yolo_box",
+           "yolov3_loss", "multiclass_nms", "density_prior_box",
+           "anchor_generator", "bipartite_match", "target_assign",
+           "ssd_loss", "detection_output", "polygon_box_transform",
+           "rpn_target_assign", "generate_proposals",
+           "generate_proposal_labels", "box_clip"]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=[1.0], variance=[0.1, 0.1, 0.2, 0.2],
+              flip=False, clip=False, steps=[0.0, 0.0], offset=0.5,
+              name=None, min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", input=input, name=name)
+    box = helper.create_variable_for_type_inference(input.dtype, True)
+    var = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(
+        "prior_box", {"Input": input, "Image": image},
+        {"Boxes": box, "Variances": var},
+        {"min_sizes": list(min_sizes),
+         "max_sizes": list(max_sizes or []),
+         "aspect_ratios": list(aspect_ratios),
+         "variances": list(variance), "flip": flip, "clip": clip,
+         "step_w": steps[0], "step_h": steps[1], "offset": offset,
+         "min_max_aspect_ratios_order": min_max_aspect_ratios_order})
+    return box, var
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("iou_similarity", {"X": x, "Y": y}, {"Out": out},
+                     {})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    helper = LayerHelper("box_coder", input=prior_box, name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    helper.append_op(
+        "box_coder",
+        {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+         "TargetBox": target_box},
+        {"OutputBox": out},
+        {"code_type": code_type, "box_normalized": box_normalized,
+         "axis": axis})
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("box_clip", {"Input": input, "ImInfo": im_info},
+                     {"Output": out}, {})
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, name=None):
+    helper = LayerHelper("yolo_box", input=x, name=name)
+    boxes = helper.create_variable_for_type_inference(x.dtype, True)
+    scores = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("yolo_box", {"X": x, "ImgSize": img_size},
+                     {"Boxes": boxes, "Scores": scores},
+                     {"anchors": list(anchors), "class_num": class_num,
+                      "conf_thresh": conf_thresh,
+                      "downsample_ratio": downsample_ratio})
+    return boxes, scores
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    helper = LayerHelper("yolov3_loss", input=x, name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "yolov3_loss",
+        {"X": x, "GTBox": gt_box, "GTLabel": gt_label},
+        {"Loss": loss},
+        {"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+         "class_num": class_num, "ignore_thresh": ignore_thresh,
+         "downsample_ratio": downsample_ratio,
+         "use_label_smooth": use_label_smooth})
+    return loss
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                   keep_top_k, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    helper = LayerHelper("multiclass_nms", input=bboxes, name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype, True)
+    helper.append_op(
+        "multiclass_nms", {"BBoxes": bboxes, "Scores": scores},
+        {"Out": out},
+        {"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+         "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+         "normalized": normalized, "nms_eta": nms_eta,
+         "background_label": background_label})
+    return out
+
+
+def density_prior_box(*args, **kwargs):
+    raise NotImplementedError("density_prior_box: planned (ops/detection)")
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None,
+                     offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator", input=input, name=name)
+    anchors = helper.create_variable_for_type_inference(input.dtype,
+                                                        True)
+    var = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(
+        "anchor_generator", {"Input": input},
+        {"Anchors": anchors, "Variances": var},
+        {"anchor_sizes": list(anchor_sizes),
+         "aspect_ratios": list(aspect_ratios),
+         "variances": list(variance), "stride": list(stride),
+         "offset": offset})
+    return anchors, var
+
+
+def bipartite_match(*args, **kwargs):
+    raise NotImplementedError(
+        "bipartite_match: greedy host-side matching; planned")
+
+
+def target_assign(*args, **kwargs):
+    raise NotImplementedError("target_assign: planned")
+
+
+def ssd_loss(*args, **kwargs):
+    raise NotImplementedError("ssd_loss: planned (needs bipartite_match)")
+
+
+def detection_output(*args, **kwargs):
+    raise NotImplementedError("detection_output: planned")
+
+
+def polygon_box_transform(*args, **kwargs):
+    raise NotImplementedError("polygon_box_transform: planned")
+
+
+def rpn_target_assign(*args, **kwargs):
+    raise NotImplementedError("rpn_target_assign: planned")
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError("generate_proposals: planned")
+
+
+def generate_proposal_labels(*args, **kwargs):
+    raise NotImplementedError("generate_proposal_labels: planned")

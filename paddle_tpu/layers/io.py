@@ -1,0 +1,20 @@
+"""Input layers (reference python/paddle/fluid/layers/io.py:39 data)."""
+from __future__ import annotations
+
+from ..core.program import default_main_program, default_startup_program
+from ..core.types import as_datatype
+
+
+def data(name, shape, dtype="float32", lod_level=0,
+         append_batch_size=True, type=None, stop_gradient=True):
+    """Declare an input variable (reference layers/io.py:39).
+
+    append_batch_size=True prepends a -1 batch dim like fluid.
+    """
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    main = default_main_program().global_block.create_var(
+        name=name, shape=shape, dtype=as_datatype(dtype),
+        lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)
+    return main

@@ -1,0 +1,149 @@
+"""LR schedules (reference python/paddle/fluid/layers/learning_rate_scheduler.py:48-388).
+
+Schedules are built as small op subgraphs reading a persistable global
+step counter -- same architecture as the reference (the decay is *in the
+program*), so they compile into the training step.
+"""
+from __future__ import annotations
+
+import math
+
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+from . import tensor, ops, nn
+from . import control_flow
+
+__all__ = ["noam_decay", "exponential_decay", "natural_exp_decay",
+           "inverse_time_decay", "polynomial_decay", "piecewise_decay",
+           "cosine_decay", "linear_lr_warmup"]
+
+_STEP_COUNTER = "@LR_DECAY_COUNTER@"
+
+
+def _global_step():
+    helper = LayerHelper("global_step_counter")
+    counter = helper.main_program.global_block.create_var(
+        name=_STEP_COUNTER, shape=(1,), dtype="float32",
+        persistable=True, stop_gradient=True)
+    sblock = helper.startup_program.global_block
+    svar = sblock.create_var(name=_STEP_COUNTER, shape=(1,),
+                             dtype="float32", persistable=True)
+    if not any(_STEP_COUNTER in op.output_arg_names
+               for op in sblock.ops):
+        ConstantInitializer(0.0)(svar, sblock)
+    block = helper.main_program.current_block()
+    if not any(_STEP_COUNTER in op.output_arg_names
+               and op.type == "increment" for op in block.ops):
+        block.append_op("increment", {"X": counter}, {"Out": counter},
+                        {"step": 1.0})
+    return counter
+
+
+def noam_decay(d_model, warmup_steps):
+    step = _global_step()
+    a = ops.rsqrt(nn.elementwise_max(
+        step, tensor.fill_constant([1], "float32", 1.0)))
+    b = nn.scale(step, scale=warmup_steps ** -1.5)
+    lr = nn.scale(nn.elementwise_min(a, b), scale=d_model ** -0.5)
+    return lr
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _global_step()
+    div = nn.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return nn.scale(nn.elementwise_pow(
+        tensor.fill_constant([1], "float32", decay_rate), div),
+        scale=learning_rate)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _global_step()
+    div = nn.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return nn.scale(ops.exp(nn.scale(div, scale=-decay_rate)),
+                    scale=learning_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    step = _global_step()
+    div = nn.scale(step, scale=1.0 / decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    denom = nn.scale(div, scale=decay_rate, bias=1.0)
+    return nn.elementwise_div(
+        tensor.fill_constant([1], "float32", learning_rate), denom)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    step = _global_step()
+    if cycle:
+        ratio = nn.scale(step, scale=1.0 / decay_steps)
+        div = ops.ceil(nn.elementwise_max(
+            ratio, tensor.fill_constant([1], "float32", 1e-12)))
+        decay_steps_var = nn.scale(div, scale=float(decay_steps))
+        frac = nn.elementwise_div(step, decay_steps_var)
+    else:
+        capped = nn.elementwise_min(
+            step, tensor.fill_constant([1], "float32",
+                                       float(decay_steps)))
+        frac = nn.scale(capped, scale=1.0 / decay_steps)
+    one_minus = nn.scale(frac, scale=-1.0, bias=1.0)
+    poly = nn.elementwise_pow(
+        one_minus, tensor.fill_constant([1], "float32", power))
+    return nn.scale(poly, scale=learning_rate - end_learning_rate,
+                    bias=end_learning_rate)
+
+
+def piecewise_decay(boundaries, values):
+    """Piecewise-constant LR via arithmetic masks (no control flow --
+    compiles to a handful of fused VPU ops). seg_i = below_i - below_{i-1}
+    selects values[i]; the tail past the last boundary gets values[-1]."""
+    step = _global_step()
+    prev = None
+    lr = None
+    for i, b in enumerate(boundaries):
+        below = nn.cast(control_flow.less_than_value(step, float(b)),
+                        "float32")
+        if prev is None:
+            seg = below
+        else:
+            seg = nn.elementwise_mul(
+                below, nn.scale(prev, scale=-1.0, bias=1.0))
+        contrib = nn.scale(seg, scale=values[i])
+        lr = contrib if lr is None else nn.elementwise_add(lr, contrib)
+        prev = below
+    tail = nn.scale(prev, scale=-1.0, bias=1.0)
+    return nn.elementwise_add(lr, nn.scale(tail, scale=values[-1]))
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _global_step()
+    epoch = ops.floor(nn.scale(step, scale=1.0 / step_each_epoch))
+    frac = nn.scale(epoch, scale=math.pi / epochs)
+    cosv = ops.cos(frac)
+    return nn.scale(nn.scale(cosv, scale=0.5, bias=0.5),
+                    scale=learning_rate)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _global_step()
+    if not isinstance(learning_rate, float):
+        base = learning_rate
+    else:
+        base = tensor.fill_constant([1], "float32", learning_rate)
+    frac = nn.elementwise_min(
+        nn.scale(step, scale=1.0 / warmup_steps),
+        tensor.fill_constant([1], "float32", 1.0))
+    warm = nn.scale(frac, scale=end_lr - start_lr, bias=start_lr)
+    cond = control_flow.less_than_value(step, float(warmup_steps))
+    mask = nn.cast(cond, "float32")
+    inv = nn.scale(mask, scale=-1.0, bias=1.0)
+    return nn.elementwise_add(nn.elementwise_mul(warm, mask),
+                              nn.elementwise_mul(base, inv))

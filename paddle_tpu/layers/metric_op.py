@@ -1,0 +1,48 @@
+"""Metric layers (reference python/paddle/fluid/layers/metric_op.py)."""
+from __future__ import annotations
+
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """topk accuracy (reference metric_op.py accuracy)."""
+    helper = LayerHelper("accuracy", input=input)
+    topk_out = helper.create_variable_for_type_inference(input.dtype)
+    topk_indices = helper.create_variable_for_type_inference("int64",
+                                                             True)
+    helper.append_op("top_k", {"X": input},
+                     {"Out": topk_out, "Indices": topk_indices},
+                     {"k": k})
+    acc_out = helper.create_variable_for_type_inference("float32", True)
+    correct = correct or helper.create_variable_for_type_inference(
+        "int32", True)
+    total = total or helper.create_variable_for_type_inference(
+        "int32", True)
+    helper.append_op(
+        "accuracy",
+        {"Out": topk_out, "Indices": topk_indices, "Label": label},
+        {"Accuracy": acc_out, "Correct": correct, "Total": total}, {})
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    helper = LayerHelper("auc", input=input)
+    stat_pos = helper.create_global_variable(
+        [num_thresholds + 1], "float32", persistable=True)
+    helper.set_variable_initializer(stat_pos, ConstantInitializer(0.0))
+    stat_neg = helper.create_global_variable(
+        [num_thresholds + 1], "float32", persistable=True)
+    helper.set_variable_initializer(stat_neg, ConstantInitializer(0.0))
+    auc_out = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op(
+        "auc",
+        {"Predict": input, "Label": label, "StatPos": stat_pos,
+         "StatNeg": stat_neg},
+        {"AUC": auc_out, "StatPosOut": stat_pos,
+         "StatNegOut": stat_neg},
+        {"curve": curve, "num_thresholds": num_thresholds})
+    return auc_out, [stat_pos, stat_neg]

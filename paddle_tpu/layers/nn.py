@@ -1,0 +1,1293 @@
+"""NN layers (reference python/paddle/fluid/layers/nn.py -- 177 functions).
+
+Each function builds ops into the default main program via LayerHelper,
+mirroring the reference's graph-construction API; execution is deferred to
+the XLA-compiling Executor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.program import Variable
+from ..core.types import as_datatype
+from ..initializer import ConstantInitializer, NormalInitializer, \
+    XavierInitializer
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose", "pool2d",
+    "adaptive_pool2d", "batch_norm", "layer_norm", "group_norm",
+    "instance_norm", "dropout", "softmax", "log_softmax",
+    "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "square_error_cost",
+    "huber_loss", "log_loss", "smooth_l1", "hinge_loss",
+    "margin_rank_loss", "bpr_loss", "kldiv_loss",
+    "mean", "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "reduce_prod", "reduce_all", "reduce_any",
+    "matmul", "mul", "dot", "elementwise_add", "elementwise_sub",
+    "elementwise_mul", "elementwise_div", "elementwise_max",
+    "elementwise_min", "elementwise_pow", "elementwise_mod",
+    "elementwise_floordiv",
+    "reshape", "squeeze", "unsqueeze", "transpose", "flatten", "concat",
+    "split", "stack", "unstack", "expand", "expand_as", "slice",
+    "strided_slice", "gather", "gather_nd", "scatter", "pad", "pad2d",
+    "crop", "one_hot", "topk", "argsort", "argmax", "argmin", "where",
+    "scale", "cast", "clip", "clip_by_norm", "l2_normalize",
+    "lrn", "relu", "leaky_relu", "prelu", "maxout", "swish",
+    "hard_swish", "hard_sigmoid", "elu", "relu6", "pow", "soft_relu",
+    "brelu", "label_smooth", "cos_sim", "dice_loss", "npair_loss",
+    "image_resize", "resize_bilinear", "resize_nearest", "grid_sampler",
+    "affine_grid", "affine_channel", "shuffle_channel", "pixel_shuffle",
+    "roi_pool", "roi_align", "psroi_pool", "row_conv",
+    "increment", "zeros_like", "ones_like", "shape", "reverse",
+    "uniform_random_batch_size_like", "gaussian_random",
+    "sampling_id", "sums", "sum", "lstm", "dynamic_lstm", "dynamic_gru",
+    "gru_unit", "lstm_unit", "beam_search", "beam_search_decode",
+    "sequence_conv", "sequence_pool", "sequence_softmax",
+    "sequence_expand", "sequence_concat", "sequence_first_step",
+    "sequence_last_step", "sequence_reshape", "sequence_pad",
+    "sequence_unpad", "sequence_reverse", "sequence_slice",
+    "sequence_enumerate", "sequence_expand_as", "sequence_scatter",
+    "edit_distance", "ctc_greedy_decoder", "warpctc", "nce",
+    "hsigmoid", "sampled_softmax_with_cross_entropy", "im2sequence",
+    "multiplex", "smooth_l1_loss", "spectral_norm", "temporal_shift",
+    "pixel_unshuffle", "unfold", "deformable_conv",
+]
+
+
+def _single_out(helper, op_type, inputs, attrs=None, dtype=None,
+                out_slot="Out"):
+    out = helper.create_variable_for_type_inference(
+        dtype or helper.input_dtype() if helper.kwargs.get("input")
+        is not None else dtype)
+    helper.append_op(op_type, inputs, {out_slot: out}, attrs or {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dense / conv / norm
+# ---------------------------------------------------------------------------
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, name=None):
+    """Fully-connected (reference layers/nn.py fc): out = act(X W + b).
+
+    Multiple inputs are summed after their own matmuls, like the reference.
+    """
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = helper.param_attr
+    if not isinstance(param_attrs, (list, tuple)):
+        param_attrs = [param_attrs] * len(inputs)
+    mul_results = []
+    for x, pattr in zip(inputs, param_attrs):
+        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        w = helper.create_parameter(pattr, [in_features, size], x.dtype)
+        tmp = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op("mul", {"X": x, "Y": w}, {"Out": tmp},
+                         {"x_num_col_dims": num_flatten_dims,
+                          "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(
+            inputs[0].dtype)
+        helper.append_op("sum", {"X": mul_results}, {"Out": pre_bias}, {})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32",
+              name=None):
+    """reference layers/nn.py embedding -> lookup_table op."""
+    helper = LayerHelper("embedding", param_attr=param_attr, name=name)
+    w = helper.create_parameter(helper.param_attr, size, dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lookup_table", {"Ids": input, "W": w}, {"Out": out},
+        {"is_sparse": is_sparse, "is_distributed": is_distributed,
+         "padding_idx": -1 if padding_idx is None else padding_idx})
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None):
+    helper = LayerHelper("conv2d", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    num_channels = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    filter_shape = [num_filters, num_channels // groups, fs[0], fs[1]]
+    std = (2.0 / (fs[0] * fs[1] * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        helper.param_attr, filter_shape, input.dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv2d", {"Input": input, "Filter": w}, {"Output": out},
+        {"strides": _pair(stride), "paddings": _pair(padding),
+         "dilations": _pair(dilation), "groups": groups})
+    out = _conv_bias(helper, out)
+    return helper.append_activation(out)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 3
+    w = helper.create_parameter(
+        helper.param_attr, [num_filters, c // groups] + list(fs),
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d", {"Input": input, "Filter": w}, {"Output": out},
+        {"strides": _triple(stride), "paddings": _triple(padding),
+         "dilations": _triple(dilation), "groups": groups})
+    out = _conv_bias(helper, out)
+    return helper.append_activation(out)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    c = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    w = helper.create_parameter(
+        helper.param_attr, [c, num_filters // groups, fs[0], fs[1]],
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv2d_transpose", {"Input": input, "Filter": w},
+        {"Output": out},
+        {"strides": _pair(stride), "paddings": _pair(padding),
+         "dilations": _pair(dilation), "groups": groups})
+    out = _conv_bias(helper, out)
+    return helper.append_activation(out)
+
+
+def _conv_bias(helper, out):
+    bias_attr = helper.bias_attr
+    if bias_attr is False:
+        return out
+    b = helper.create_parameter(bias_attr, [out.shape[1]], out.dtype,
+                                is_bias=True)
+    if b is None:
+        return out
+    new = helper.create_variable_for_type_inference(out.dtype)
+    helper.append_op("elementwise_add", {"X": out, "Y": b}, {"Out": new},
+                     {"axis": 1})
+    return new
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool2d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d", {"X": input}, {"Out": out},
+        {"pooling_type": pool_type, "ksize": _pair(pool_size),
+         "strides": _pair(pool_stride), "paddings": _pair(pool_padding),
+         "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+         "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", name=None):
+    helper = LayerHelper("adaptive_pool2d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("adaptive_pool2d", {"X": input}, {"Out": out},
+                     {"pooling_size": _pair(pool_size),
+                      "pooling_type": pool_type})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=
+               False, use_global_stats=False):
+    """reference layers/nn.py batch_norm; running stats are persistable
+    state threaded through the executor (MeanOut/VarianceOut)."""
+    helper = LayerHelper("batch_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    dtype = input.dtype
+    scale = helper.create_parameter(
+        helper.param_attr, [c], dtype,
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(helper.bias_attr, [c], dtype,
+                                   is_bias=True)
+    mean = helper.create_global_variable(
+        [c], dtype, persistable=True,
+        name=moving_mean_name, stop_gradient=True)
+    helper.set_variable_initializer(mean, ConstantInitializer(0.0))
+    variance = helper.create_global_variable(
+        [c], dtype, persistable=True,
+        name=moving_variance_name, stop_gradient=True)
+    helper.set_variable_initializer(variance, ConstantInitializer(1.0))
+    saved_mean = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "batch_norm",
+        {"X": input, "Scale": scale, "Bias": bias, "Mean": mean,
+         "Variance": variance},
+        {"Y": out, "MeanOut": mean, "VarianceOut": variance,
+         "SavedMean": saved_mean, "SavedVariance": saved_var},
+        {"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+         "data_layout": data_layout,
+         "use_global_stats": use_global_stats})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    dim = int(np.prod(input.shape[begin_norm_axis:]))
+    inputs = {"X": input}
+    if scale:
+        s = helper.create_parameter(
+            helper.param_attr, [dim], dtype,
+            default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = s
+    if shift:
+        b = helper.create_parameter(helper.bias_attr, [dim], dtype,
+                                    is_bias=True)
+        if b is not None:
+            inputs["Bias"] = b
+    out = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype, True)
+    var = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("layer_norm", inputs,
+                     {"Y": out, "Mean": mean, "Variance": var},
+                     {"epsilon": epsilon,
+                      "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    c = input.shape[1]
+    inputs = {"X": input}
+    if param_attr is not False:
+        inputs["Scale"] = helper.create_parameter(
+            helper.param_attr, [c], input.dtype,
+            default_initializer=ConstantInitializer(1.0))
+    if bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(
+            helper.bias_attr, [c], input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mean = helper.create_variable_for_type_inference(input.dtype, True)
+    var = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("group_norm", inputs,
+                     {"Y": out, "Mean": mean, "Variance": var},
+                     {"groups": groups, "epsilon": epsilon})
+    return helper.append_activation(out)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    c = input.shape[1]
+    inputs = {"X": input}
+    if param_attr is not False:
+        inputs["Scale"] = helper.create_parameter(
+            helper.param_attr, [c], input.dtype,
+            default_initializer=ConstantInitializer(1.0))
+    if bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(
+            helper.bias_attr, [c], input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    sm = helper.create_variable_for_type_inference(input.dtype, True)
+    sv = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("instance_norm", inputs,
+                     {"Y": out, "SavedMean": sm, "SavedVariance": sv},
+                     {"epsilon": epsilon})
+    return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    helper = LayerHelper("spectral_norm", input=weight, name=name)
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    h = weight.shape[dim]
+    import functools
+    w = int(np.prod(weight.shape)) // h
+    u = helper.create_parameter(None, [h], weight.dtype,
+                                default_initializer=NormalInitializer())
+    v = helper.create_parameter(None, [w], weight.dtype,
+                                default_initializer=NormalInitializer())
+    helper.append_op("spectral_norm",
+                     {"Weight": weight, "U": u, "V": v}, {"Out": out},
+                     {"dim": dim, "power_iters": power_iters, "eps": eps})
+    return out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("dropout", {"X": x}, {"Out": out, "Mask": mask},
+                     {"dropout_prob": dropout_prob, "is_test": is_test,
+                      "seed": seed or 0,
+                      "dropout_implementation": dropout_implementation})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    helper = LayerHelper("softmax_with_cross_entropy", input=logits)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    sm = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op("softmax_with_cross_entropy",
+                     {"Logits": logits, "Label": label},
+                     {"Loss": loss, "Softmax": sm},
+                     {"soft_label": soft_label,
+                      "ignore_index": ignore_index})
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cross_entropy", {"X": input, "Label": label},
+                     {"Y": out} if False else {"Out": out},
+                     {"soft_label": soft_label,
+                      "ignore_index": ignore_index})
+    return out
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sigmoid_cross_entropy_with_logits",
+                     {"X": x, "Label": label}, {"Out": out},
+                     {"ignore_index": ignore_index,
+                      "normalize": normalize})
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("square_error_cost", {"X": input, "Y": label},
+                     {"Out": out}, {})
+    return out
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    res = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("huber_loss", {"X": input, "Y": label},
+                     {"Out": out, "Residual": res}, {"delta": delta})
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper("log_loss", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("log_loss", {"Predicted": input, "Labels": label},
+                     {"Loss": out}, {"epsilon": epsilon})
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    diff = helper.create_variable_for_type_inference(x.dtype, True)
+    ins = {"X": x, "Y": y}
+    if inside_weight is not None:
+        ins["InsideWeight"] = inside_weight
+    if outside_weight is not None:
+        ins["OutsideWeight"] = outside_weight
+    helper.append_op("smooth_l1_loss", ins,
+                     {"Out": out, "Diff": diff},
+                     {"sigma": sigma or 1.0})
+    return out
+
+
+smooth_l1_loss = smooth_l1
+
+
+def hinge_loss(input, label):
+    helper = LayerHelper("hinge_loss", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("hinge_loss", {"Logits": input, "Labels": label},
+                     {"Loss": out}, {})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", input=left)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype, True)
+    helper.append_op("margin_rank_loss",
+                     {"Label": label, "X1": left, "X2": right},
+                     {"Out": out, "Activated": act}, {"margin": margin})
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    helper = LayerHelper("bpr_loss", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("bpr_loss", {"X": input, "Label": label},
+                     {"Out": out}, {})
+    return out
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    helper = LayerHelper("kldiv_loss", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("kldiv_loss", {"X": x, "Target": target},
+                     {"Loss": out}, {"reduction": reduction})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", input=label)
+    out = helper.create_variable_for_type_inference(dtype)
+    ins = {"X": label}
+    if prior_dist is not None:
+        ins["PriorDist"] = prior_dist
+    helper.append_op("label_smooth", ins, {"Out": out},
+                     {"epsilon": epsilon})
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper("cos_sim", input=X)
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype, True)
+    yn = helper.create_variable_for_type_inference(X.dtype, True)
+    helper.append_op("cos_sim", {"X": X, "Y": Y},
+                     {"Out": out, "XNorm": xn, "YNorm": yn}, {})
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    helper = LayerHelper("dice_loss", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("dice_loss", {"X": input, "Label": label},
+                     {"Out": out}, {"epsilon": epsilon})
+    return out
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    helper = LayerHelper("npair_loss", input=anchor)
+    out = helper.create_variable_for_type_inference(anchor.dtype)
+    helper.append_op("npair_loss",
+                     {"Anchor": anchor, "Positive": positive,
+                      "Labels": labels},
+                     {"Out": out}, {"l2_reg": l2_reg})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# generated elementwise / unary / reduce wrappers
+# ---------------------------------------------------------------------------
+def _make_elementwise(op_type):
+    def layer(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, input=x, act=act, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_type, {"X": x, "Y": y}, {"Out": out},
+                         {"axis": axis})
+        return helper.append_activation(out)
+
+    layer.__name__ = op_type
+    return layer
+
+
+elementwise_add = _make_elementwise("elementwise_add")
+elementwise_sub = _make_elementwise("elementwise_sub")
+elementwise_mul = _make_elementwise("elementwise_mul")
+elementwise_div = _make_elementwise("elementwise_div")
+elementwise_max = _make_elementwise("elementwise_max")
+elementwise_min = _make_elementwise("elementwise_min")
+elementwise_pow = _make_elementwise("elementwise_pow")
+elementwise_mod = _make_elementwise("elementwise_mod")
+elementwise_floordiv = _make_elementwise("elementwise_floordiv")
+
+
+def _make_reduce(op_type):
+    def layer(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, input=input, name=name)
+        out = helper.create_variable_for_type_inference(input.dtype)
+        if dim is None:
+            attrs = {"dim": [0], "reduce_all": True, "keep_dim": keep_dim}
+        else:
+            if not isinstance(dim, (list, tuple)):
+                dim = [dim]
+            attrs = {"dim": list(dim), "reduce_all": False,
+                     "keep_dim": keep_dim}
+        helper.append_op(op_type, {"X": input}, {"Out": out}, attrs)
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+reduce_sum = _make_reduce("reduce_sum")
+reduce_mean = _make_reduce("reduce_mean")
+reduce_max = _make_reduce("reduce_max")
+reduce_min = _make_reduce("reduce_min")
+reduce_prod = _make_reduce("reduce_prod")
+reduce_all = _make_reduce("reduce_all")
+reduce_any = _make_reduce("reduce_any")
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mean", {"X": x}, {"Out": out}, {})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    helper = LayerHelper("matmul", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("matmul", {"X": x, "Y": y}, {"Out": out},
+                     {"transpose_X": transpose_x,
+                      "transpose_Y": transpose_y, "alpha": alpha})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("mul", {"X": x, "Y": y}, {"Out": out},
+                     {"x_num_col_dims": x_num_col_dims,
+                      "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def dot(x, y, name=None):
+    helper = LayerHelper("dot", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("dot", {"X": x, "Y": y}, {"Out": out}, {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation wrappers
+# ---------------------------------------------------------------------------
+def _simple(op_type, x_slot="X", out_slot="Out"):
+    def layer(x, *args, **kwargs):
+        raise NotImplementedError
+
+    return layer
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False,
+            name=None):
+    helper = LayerHelper("reshape2", input=x, act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reshape2", {"X": x}, {"Out": out},
+                     {"shape": list(shape)})
+    return helper.append_activation(out)
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("squeeze2", {"X": input}, {"Out": out},
+                     {"axes": list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("unsqueeze2", {"X": input}, {"Out": out},
+                     {"axes": list(axes)})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("transpose2", {"X": x}, {"Out": out},
+                     {"axis": list(perm)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("flatten2", {"X": x}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", input=input[0], name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("concat", {"X": input}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", input=input, name=name)
+    axis = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "sections": [], "axis": axis}
+    else:
+        n = len(num_or_sections)
+        attrs = {"num": 0, "sections": list(num_or_sections),
+                 "axis": axis}
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(n)]
+    helper.append_op("split", {"X": input}, {"Out": outs}, attrs)
+    return outs
+
+
+def stack(x, axis=0):
+    if not isinstance(x, (list, tuple)):
+        x = [x]
+    helper = LayerHelper("stack", input=x[0])
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op("stack", {"X": x}, {"Y": out} if False else
+                     {"Out": out}, {"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack", input=x)
+    n = num or x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(n)]
+    helper.append_op("unstack", {"X": x}, {"Y": outs}, {"axis": axis})
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper("expand", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand", {"X": x}, {"Out": out},
+                     {"expand_times": list(expand_times)})
+    return out
+
+
+def expand_as(x, target_tensor, name=None):
+    helper = LayerHelper("expand_as", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("expand_as",
+                     {"X": x, "target_tensor": target_tensor},
+                     {"Out": out}, {})
+    return out
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("slice", {"Input": input}, {"Out": out},
+                     {"axes": list(axes), "starts": list(starts),
+                      "ends": list(ends)})
+    return out
+
+
+def strided_slice(input, axes, starts, ends, strides):
+    helper = LayerHelper("strided_slice", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("strided_slice", {"Input": input}, {"Out": out},
+                     {"axes": list(axes), "starts": list(starts),
+                      "ends": list(ends), "strides": list(strides)})
+    return out
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather", {"X": input, "Index": index},
+                     {"Out": out}, {})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather_nd", {"X": input, "Index": index},
+                     {"Out": out}, {})
+    return out
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper("scatter", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("scatter",
+                     {"X": input, "Ids": index, "Updates": updates},
+                     {"Out": out}, {"overwrite": overwrite})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper("pad", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pad", {"X": x}, {"Out": out},
+                     {"paddings": list(paddings), "pad_value": pad_value})
+    return out
+
+
+def pad2d(input, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    helper = LayerHelper("pad2d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("pad2d", {"X": input}, {"Out": out},
+                     {"paddings": list(paddings), "mode": mode,
+                      "pad_value": pad_value, "data_format": data_format})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    helper = LayerHelper("crop", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("crop", {"X": x}, {"Out": out},
+                     {"shape": list(shape), "offsets": list(offsets or
+                      [0] * len(shape))})
+    return out
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot", input=input)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("one_hot", {"X": input}, {"Out": out},
+                     {"depth": depth})
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", input=input, name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("top_k", {"X": input},
+                     {"Out": values, "Indices": indices}, {"k": k})
+    return values, indices
+
+
+def argsort(input, axis=-1, name=None):
+    helper = LayerHelper("argsort", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("argsort", {"X": input},
+                     {"Out": out, "Indices": ids}, {"axis": axis})
+    return out, ids
+
+
+def argmax(x, axis=0):
+    helper = LayerHelper("arg_max", input=x)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("arg_max", {"X": x}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper("arg_min", input=x)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("arg_min", {"X": x}, {"Out": out}, {"axis": axis})
+    return out
+
+
+def where(condition, x=None, y=None):
+    helper = LayerHelper("where", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("where", {"Condition": condition, "X": x, "Y": y},
+                     {"Out": out}, {})
+    return out
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex", input=inputs[0])
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op("multiplex", {"X": inputs, "Ids": index},
+                     {"Out": out}, {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scalar / unary wrappers
+# ---------------------------------------------------------------------------
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    helper = LayerHelper("scale", input=x, act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("scale", {"X": x}, {"Out": out},
+                     {"scale": scale, "bias": bias,
+                      "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast", input=x)
+    dtype = as_datatype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("cast", {"X": x}, {"Out": out},
+                     {"out_dtype": dtype.value})
+    return out
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip", {"X": x}, {"Out": out},
+                     {"min": min, "max": max})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("clip_by_norm", {"X": x}, {"Out": out},
+                     {"max_norm": max_norm})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    helper = LayerHelper("l2_normalize", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    norm = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("l2_normalize", {"X": x},
+                     {"Out": out, "Norm": norm},
+                     {"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("lrn", {"X": input}, {"Out": out, "MidOut": mid},
+                     {"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+def relu(x, name=None):
+    helper = LayerHelper("relu", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("relu", {"X": x}, {"Out": out}, {})
+    return out
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("leaky_relu", {"X": x}, {"Out": out},
+                     {"alpha": alpha})
+    return out
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper("prelu", input=x, param_attr=param_attr,
+                         name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [1, x.shape[1], 1, 1]
+    else:
+        alpha_shape = [1] + list(x.shape[1:])
+    alpha = helper.create_parameter(
+        helper.param_attr, alpha_shape, x.dtype,
+        default_initializer=ConstantInitializer(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("prelu", {"X": x, "Alpha": alpha}, {"Out": out},
+                     {"mode": mode})
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("maxout", {"X": x}, {"Out": out},
+                     {"groups": groups})
+    return out
+
+
+def swish(x, beta=1.0, name=None):
+    helper = LayerHelper("swish", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("swish", {"X": x}, {"Out": out}, {"beta": beta})
+    return out
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    helper = LayerHelper("hard_swish", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("hard_swish", {"X": x}, {"Out": out},
+                     {"threshold": threshold, "scale": scale,
+                      "offset": offset})
+    return out
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    helper = LayerHelper("hard_sigmoid", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("hard_sigmoid", {"X": x}, {"Out": out},
+                     {"slope": slope, "offset": offset})
+    return out
+
+
+def elu(x, alpha=1.0, name=None):
+    helper = LayerHelper("elu", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("elu", {"X": x}, {"Out": out}, {"alpha": alpha})
+    return out
+
+
+def relu6(x, threshold=6.0, name=None):
+    helper = LayerHelper("relu6", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("relu6", {"X": x}, {"Out": out},
+                     {"threshold": threshold})
+    return out
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pow", {"X": x}, {"Out": out}, {"factor": factor})
+    return out
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    helper = LayerHelper("soft_relu", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("soft_relu", {"X": x}, {"Out": out},
+                     {"threshold": threshold})
+    return out
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    helper = LayerHelper("brelu", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("brelu", {"X": x}, {"Out": out},
+                     {"t_min": t_min, "t_max": t_max})
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    helper = LayerHelper("softmax", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("softmax", {"X": input}, {"Out": out},
+                     {"axis": axis})
+    return out
+
+
+def log_softmax(input, axis=-1, name=None):
+    helper = LayerHelper("log_softmax", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("log_softmax", {"X": input}, {"Out": out},
+                     {"axis": axis})
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment", input=x)
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("increment", {"X": x}, {"Out": out},
+                     {"step": value})
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("fill_zeros_like", input=x)
+    out = out or helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_zeros_like", {"X": x}, {"Out": out}, {})
+    return out
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("fill_any_like", input=x)
+    out = out or helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_any_like", {"X": x}, {"Out": out},
+                     {"value": 1.0})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper("shape", input=input)
+    out = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("shape", {"Input": input}, {"Out": out}, {})
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    if isinstance(axis, int):
+        axis = [axis]
+    helper.append_op("reverse", {"X": x}, {"Out": out},
+                     {"axis": list(axis)})
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like", input=input)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("uniform_random_batch_size_like", {"Input": input},
+                     {"Out": out},
+                     {"shape": list(shape), "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx, "min": min,
+                      "max": max, "seed": seed})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gaussian_random", {}, {"Out": out},
+                     {"shape": list(shape), "mean": mean, "std": std,
+                      "seed": seed, "dtype": as_datatype(dtype).value})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("sampling_id", input=x)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("sampling_id", {"X": x}, {"Out": out},
+                     {"min": min, "max": max, "seed": seed})
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum", input=input[0])
+    out = out or helper.create_variable_for_type_inference(
+        input[0].dtype)
+    helper.append_op("sum", {"X": input}, {"Out": out}, {})
+    return out
+
+
+sum = sums
+
+
+# ---------------------------------------------------------------------------
+# vision ops -- thin wrappers; kernels in ops/vision_ops.py
+# ---------------------------------------------------------------------------
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", align_corners=True, align_mode=1):
+    helper = LayerHelper("interpolate", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if out_shape is None:
+        h, w = input.shape[2], input.shape[3]
+        out_shape = [int(h * scale), int(w * scale)]
+    helper.append_op("interpolate", {"X": input}, {"Out": out},
+                     {"out_h": out_shape[0], "out_w": out_shape[1],
+                      "interp_method": resample.lower(),
+                      "align_corners": align_corners,
+                      "align_mode": align_mode})
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        align_corners, align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        align_corners)
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("grid_sampler", {"X": x, "Grid": grid},
+                     {"Output": out}, {})
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    helper = LayerHelper("affine_grid", input=theta, name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    attrs = {}
+    if isinstance(out_shape, (list, tuple)):
+        attrs["output_shape"] = [int(v) for v in out_shape]
+        helper.append_op("affine_grid", {"Theta": theta},
+                         {"Output": out}, attrs)
+    else:
+        helper.append_op("affine_grid",
+                         {"Theta": theta, "OutputShape": out_shape},
+                         {"Output": out}, attrs)
+    return out
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   name=None):
+    helper = LayerHelper("affine_channel", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("affine_channel",
+                     {"X": x, "Scale": scale, "Bias": bias},
+                     {"Out": out}, {"data_layout": data_layout})
+    return out
+
+
+def shuffle_channel(x, group, name=None):
+    helper = LayerHelper("shuffle_channel", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("shuffle_channel", {"X": x}, {"Out": out},
+                     {"group": group})
+    return out
+
+
+def pixel_shuffle(x, upscale_factor):
+    helper = LayerHelper("pixel_shuffle", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pixel_shuffle", {"X": x}, {"Out": out},
+                     {"upscale_factor": upscale_factor})
+    return out
+
+
+def pixel_unshuffle(x, downscale_factor):
+    helper = LayerHelper("pixel_unshuffle", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("pixel_unshuffle", {"X": x}, {"Out": out},
+                     {"downscale_factor": downscale_factor})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    helper = LayerHelper("roi_pool", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax_ = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("roi_pool", {"X": input, "ROIs": rois},
+                     {"Out": out, "Argmax": argmax_},
+                     {"pooled_height": pooled_height,
+                      "pooled_width": pooled_width,
+                      "spatial_scale": spatial_scale})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    helper = LayerHelper("roi_align", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("roi_align", {"X": input, "ROIs": rois},
+                     {"Out": out},
+                     {"pooled_height": pooled_height,
+                      "pooled_width": pooled_width,
+                      "spatial_scale": spatial_scale,
+                      "sampling_ratio": sampling_ratio})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    helper = LayerHelper("psroi_pool", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("psroi_pool", {"X": input, "ROIs": rois},
+                     {"Out": out},
+                     {"output_channels": output_channels,
+                      "spatial_scale": spatial_scale,
+                      "pooled_height": pooled_height,
+                      "pooled_width": pooled_width})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", input=input, param_attr=param_attr,
+                         act=act)
+    w = helper.create_parameter(
+        helper.param_attr, [future_context_size + 1, input.shape[-1]],
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("row_conv", {"X": input, "Filter": w},
+                     {"Out": out}, {})
+    return helper.append_activation(out)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    helper = LayerHelper("temporal_shift", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("temporal_shift", {"X": x}, {"Out": out},
+                     {"seg_num": seg_num, "shift_ratio": shift_ratio})
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1,
+           name=None):
+    helper = LayerHelper("unfold", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("unfold", {"X": x}, {"Y": out},
+                     {"kernel_sizes": _pair(kernel_sizes),
+                      "strides": _pair(strides),
+                      "paddings": _pair(paddings),
+                      "dilations": _pair(dilations)})
+    return out
+
+
+def deformable_conv(*args, **kwargs):
+    raise NotImplementedError(
+        "deformable_conv: deformable sampling is not yet lowered to TPU; "
+        "use grid_sampler composition")
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    helper = LayerHelper("im2sequence", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("im2sequence", {"X": input}, {"Out": out},
+                     {"kernels": _pair(filter_size),
+                      "strides": _pair(stride),
+                      "paddings": _pair(padding) + _pair(padding)})
+    return out
+
+
+# --- sequence/RNN/decoding layers live in rnn.py & sequence.py; imported
+# lazily at the bottom to avoid circular imports -------------------------
+from .sequence import (  # noqa: E402,F401
+    sequence_conv, sequence_pool, sequence_softmax, sequence_expand,
+    sequence_concat, sequence_first_step, sequence_last_step,
+    sequence_reshape, sequence_pad, sequence_unpad, sequence_reverse,
+    sequence_slice, sequence_enumerate, sequence_expand_as,
+    sequence_scatter)
+from .rnn import (  # noqa: E402,F401
+    lstm, dynamic_lstm, dynamic_gru, gru_unit, lstm_unit, beam_search,
+    beam_search_decode, edit_distance, ctc_greedy_decoder, warpctc, nce,
+    hsigmoid, sampled_softmax_with_cross_entropy)
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v]
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v, v]

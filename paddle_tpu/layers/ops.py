@@ -1,0 +1,61 @@
+"""Auto-generated unary op wrappers
+(reference python/paddle/fluid/layers/layer_function_generator.py + ops.py).
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+_UNARY = ["sigmoid", "tanh", "exp", "sqrt", "rsqrt", "abs", "log",
+          "square", "floor", "ceil", "round", "reciprocal", "softplus",
+          "softsign", "sin", "cos", "acos", "asin", "atan", "gelu",
+          "sign", "logical_not"]
+
+__all__ = list(_UNARY) + ["cumsum", "thresholded_relu", "maximum",
+                          "minimum"]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, input=x, name=name)
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(op_type, {"X": x}, {"Out": out}, {})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+_g = globals()
+for _t in _UNARY:
+    _g[_t] = _make_unary(_t)
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False):
+    helper = LayerHelper("cumsum", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("cumsum", {"X": x}, {"Out": out},
+                     {"axis": axis, "exclusive": exclusive,
+                      "reverse": reverse})
+    return out
+
+
+def thresholded_relu(x, threshold=1.0):
+    helper = LayerHelper("thresholded_relu", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("thresholded_relu", {"X": x}, {"Out": out},
+                     {"threshold": threshold})
+    return out
+
+
+def maximum(x, y, name=None):
+    helper = LayerHelper("maximum", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("maximum", {"X": x, "Y": y}, {"Out": out}, {})
+    return out
+
+
+def minimum(x, y, name=None):
+    helper = LayerHelper("minimum", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("minimum", {"X": x, "Y": y}, {"Out": out}, {})
+    return out

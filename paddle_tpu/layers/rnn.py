@@ -1,0 +1,295 @@
+"""RNN / decoding / sampled-loss layers.
+
+Parity targets: reference operators/lstm_op.cc, gru_op.cc, lstm_unit_op.cc,
+gru_unit_op.cc, cudnn_lstm_op.cu.cc, beam_search_op.cc,
+beam_search_decode_op.cc, edit_distance_op.cc, warpctc_op.cc, nce_op.cc,
+hierarchical_sigmoid_op.cc, sample_logits_op.cc.
+
+RNNs run over the padded [batch, time, dim] + @SEQ_LEN representation and
+lower to lax.scan (compiled once, unrolled by XLA into a fused loop) --
+replacing the reference's per-timestep dynamic-RNN interpreter
+(recurrent_op.cc) and cuDNN LSTM descriptor machinery.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+from .sequence import seq_len_of, SEQ_LEN_SUFFIX
+
+__all__ = ["lstm", "dynamic_lstm", "dynamic_gru", "gru_unit",
+           "lstm_unit", "beam_search", "beam_search_decode",
+           "edit_distance", "ctc_greedy_decoder", "warpctc", "nce",
+           "hsigmoid", "sampled_softmax_with_cross_entropy"]
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """reference lstm_op.cc: input is pre-projected x·W_x [N,T,4H]."""
+    helper = LayerHelper("dynamic_lstm", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    hidden = size // 4
+    w = helper.create_parameter(helper.param_attr, [hidden, 4 * hidden],
+                                dtype)
+    bias_size = 7 * hidden if use_peepholes else 4 * hidden
+    b = helper.create_parameter(helper.bias_attr, [1, bias_size], dtype,
+                                is_bias=True)
+    h_out = helper.create_variable_for_type_inference(dtype)
+    c_out = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": input, "Weight": w, "Bias": b,
+           "SeqLen": seq_len_of(input)}
+    if h_0 is not None:
+        ins["H0"] = h_0
+    if c_0 is not None:
+        ins["C0"] = c_0
+    helper.append_op("lstm", ins, {"Hidden": h_out, "Cell": c_out},
+                     {"use_peepholes": use_peepholes,
+                      "is_reverse": is_reverse,
+                      "gate_activation": gate_activation,
+                      "cell_activation": cell_activation,
+                      "candidate_activation": candidate_activation})
+    block = h_out.block
+    for o in (h_out, c_out):
+        lname = o.name + SEQ_LEN_SUFFIX
+        helper.append_op("assign", {"X": input.name + SEQ_LEN_SUFFIX},
+                         {"Out": lname}, {})
+        block.create_var(name=lname, shape=(-1,), dtype="int32",
+                         stop_gradient=True)
+    return h_out, c_out
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """cuDNN-style stacked LSTM (reference cudnn_lstm_op.cu.cc) -- here a
+    stack of scan-based layers."""
+    helper = LayerHelper("cudnn_lstm", input=input, name=name)
+    from . import nn
+
+    x = input
+    h_last = None
+    c_last = None
+    for layer in range(num_layers):
+        proj = nn.fc(x, 4 * hidden_size, num_flatten_dims=2,
+                     bias_attr=None)
+        h, c = dynamic_lstm(proj, 4 * hidden_size,
+                            use_peepholes=False)
+        if dropout_prob and not is_test:
+            h = nn.dropout(h, dropout_prob,
+                           dropout_implementation="upscale_in_train")
+        x = h
+        h_last, c_last = h, c
+    return x, h_last, c_last
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, name=None):
+    """reference gru_op.cc: input pre-projected [N,T,3H]."""
+    helper = LayerHelper("dynamic_gru", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dtype = input.dtype
+    w = helper.create_parameter(helper.param_attr, [size, 3 * size],
+                                dtype)
+    b = helper.create_parameter(helper.bias_attr, [1, 3 * size], dtype,
+                                is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    ins = {"Input": input, "Weight": w, "Bias": b,
+           "SeqLen": seq_len_of(input)}
+    if h_0 is not None:
+        ins["H0"] = h_0
+    helper.append_op("gru", ins, {"Hidden": out},
+                     {"is_reverse": is_reverse,
+                      "gate_activation": gate_activation,
+                      "activation": candidate_activation})
+    block = out.block
+    lname = out.name + SEQ_LEN_SUFFIX
+    helper.append_op("assign", {"X": input.name + SEQ_LEN_SUFFIX},
+                     {"Out": lname}, {})
+    block.create_var(name=lname, shape=(-1,), dtype="int32",
+                     stop_gradient=True)
+    return out
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    helper = LayerHelper("gru_unit", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr)
+    dtype = input.dtype
+    h = size // 3
+    w = helper.create_parameter(helper.param_attr, [h, 3 * h], dtype)
+    b = helper.create_parameter(helper.bias_attr, [1, 3 * h], dtype,
+                                is_bias=True)
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_h = helper.create_variable_for_type_inference(dtype)
+    updated = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gru_unit",
+                     {"Input": input, "HiddenPrev": hidden, "Weight": w,
+                      "Bias": b},
+                     {"Gate": gate, "ResetHiddenPrev": reset_h,
+                      "Hidden": updated},
+                     {"activation": activation,
+                      "gate_activation": gate_activation,
+                      "origin_mode": origin_mode})
+    return updated, reset_h, gate
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    helper = LayerHelper("lstm_unit", input=x_t, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    from . import nn
+
+    size = cell_t_prev.shape[-1]
+    concat_in = nn.concat([x_t, hidden_t_prev], axis=1)
+    fc_out = nn.fc(concat_in, 4 * size, param_attr=param_attr,
+                   bias_attr=bias_attr)
+    cell = helper.create_variable_for_type_inference(x_t.dtype)
+    hidden = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op("lstm_unit",
+                     {"X": fc_out, "C_prev": cell_t_prev},
+                     {"C": cell, "H": hidden},
+                     {"forget_bias": forget_bias})
+    return hidden, cell
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    helper = LayerHelper("beam_search", input=ids, name=name)
+    sel_ids = helper.create_variable_for_type_inference("int64", True)
+    sel_scores = helper.create_variable_for_type_inference(
+        scores.dtype, True)
+    parent = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(
+        "beam_search",
+        {"pre_ids": pre_ids, "pre_scores": pre_scores, "ids": ids,
+         "scores": scores},
+        {"selected_ids": sel_ids, "selected_scores": sel_scores,
+         "parent_idx": parent},
+        {"beam_size": beam_size, "end_id": end_id, "level": level,
+         "is_accumulated": is_accumulated})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    helper = LayerHelper("beam_search_decode", input=ids, name=name)
+    out_ids = helper.create_variable_for_type_inference("int64", True)
+    out_scores = helper.create_variable_for_type_inference(
+        scores.dtype, True)
+    helper.append_op("beam_search_decode",
+                     {"Ids": ids, "Scores": scores},
+                     {"SentenceIds": out_ids,
+                      "SentenceScores": out_scores},
+                     {"beam_size": beam_size, "end_id": end_id})
+    return out_ids, out_scores
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None):
+    helper = LayerHelper("edit_distance", input=input)
+    out = helper.create_variable_for_type_inference("float32", True)
+    seq_num = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("edit_distance",
+                     {"Hyps": input, "Refs": label,
+                      "HypsLen": seq_len_of(input),
+                      "RefsLen": seq_len_of(label)},
+                     {"Out": out, "SequenceNum": seq_num},
+                     {"normalized": normalized})
+    return out, seq_num
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    helper = LayerHelper("ctc_align", input=input, name=name)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("ctc_align",
+                     {"Input": input, "SeqLen": seq_len_of(input)},
+                     {"Output": out},
+                     {"blank": blank, "merge_repeated": True})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            use_cudnn=False):
+    helper = LayerHelper("warpctc", input=input)
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("warpctc",
+                     {"Logits": input, "Label": label,
+                      "LogitsLen": seq_len_of(input),
+                      "LabelLen": seq_len_of(label)},
+                     {"Loss": loss, "WarpCTCGrad": grad},
+                     {"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation (reference nce_op.cc)."""
+    helper = LayerHelper("nce", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                [num_total_classes, dim], input.dtype)
+    b = helper.create_parameter(helper.bias_attr,
+                                [num_total_classes, 1], input.dtype,
+                                is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sl = helper.create_variable_for_type_inference(input.dtype, True)
+    slog = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(
+        "nce",
+        {"Input": input, "Label": label, "Weight": w, "Bias": b},
+        {"Cost": cost, "SampleLogits": sl, "SampleLabels": slog},
+        {"num_total_classes": num_total_classes,
+         "num_neg_samples": num_neg_samples or 10, "seed": seed,
+         "sampler": {"uniform": 0, "log_uniform": 1,
+                     "custom_dist": 2}.get(sampler, 0)})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None,
+             is_custom=False, is_sparse=False):
+    """Hierarchical sigmoid (reference hierarchical_sigmoid_op.cc)."""
+    helper = LayerHelper("hierarchical_sigmoid", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                [num_classes - 1, dim], input.dtype)
+    b = helper.create_parameter(helper.bias_attr, [num_classes - 1, 1],
+                                input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(
+        "hierarchical_sigmoid",
+        {"X": input, "Label": label, "W": w, "Bias": b},
+        {"Out": out, "PreOut": pre},
+        {"num_classes": num_classes})
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=
+                                       True, use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    helper = LayerHelper("sample_logits", input=logits)
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        "sample_logits",
+        {"Logits": logits, "Labels": label},
+        {"Loss": loss},
+        {"num_samples": num_samples, "num_true": num_true,
+         "remove_accidental_hits": remove_accidental_hits,
+         "seed": seed})
+    return loss

@@ -1,0 +1,193 @@
+"""Sequence layers over padded-batch + explicit-length representation.
+
+The reference's LoDTensor (reference framework/lod_tensor.h:110 + ~20 ops
+under operators/sequence_ops/) carries nested offsets on a packed batch --
+inherently dynamic-shaped, which XLA cannot compile. The TPU-native
+representation (SURVEY.md hard part (a)) is:
+
+    data:   dense padded [batch, max_len, ...]
+    length: int32 [batch] companion var named  <name>@SEQ_LEN
+
+Masked/segment computations replace offset walking; everything stays
+static-shaped (bucket batches by max_len to bound recompiles).
+DataFeeder converts fluid-style (flat_data, lod) feeds into this layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["sequence_conv", "sequence_pool", "sequence_softmax",
+           "sequence_expand", "sequence_concat", "sequence_first_step",
+           "sequence_last_step", "sequence_reshape", "sequence_pad",
+           "sequence_unpad", "sequence_reverse", "sequence_slice",
+           "sequence_enumerate", "sequence_expand_as",
+           "sequence_scatter", "seq_len_of"]
+
+SEQ_LEN_SUFFIX = "@SEQ_LEN"
+
+
+def seq_len_of(x):
+    """Find (or declare) the companion length var for padded sequences."""
+    block = x.block
+    name = x.name + SEQ_LEN_SUFFIX
+    if block.has_var(name):
+        return block.var(name)
+    return block.create_var(name=name, shape=(-1,), dtype="int32",
+                            is_data=True, stop_gradient=True)
+
+
+def _bind_len(helper, out, x):
+    """Propagate the length companion from x to out (same batch layout)."""
+    block = out.block
+    src = x.name + SEQ_LEN_SUFFIX
+    if x.block.has_var(src):
+        dst = out.name + SEQ_LEN_SUFFIX
+        helper.append_op("assign", {"X": src}, {"Out": dst}, {})
+        block.create_var(name=dst, shape=(-1,), dtype="int32",
+                         stop_gradient=True)
+    return out
+
+
+def sequence_pool(input, pool_type, is_test=False):
+    helper = LayerHelper("sequence_pool", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("sequence_pool",
+                     {"X": input, "SeqLen": seq_len_of(input)},
+                     {"Out": out, "MaxIndex": idx},
+                     {"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_softmax",
+                     {"X": input, "SeqLen": seq_len_of(input)},
+                     {"Out": out}, {})
+    return _bind_len(helper, out, input)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper("sequence_conv", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                               [filter_size * d, num_filters],
+                               input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_conv",
+                     {"X": input, "Filter": w,
+                      "SeqLen": seq_len_of(input)},
+                     {"Out": out},
+                     {"contextLength": filter_size,
+                      "contextStart": -(filter_size // 2),
+                      "contextStride": filter_stride})
+    out = helper.append_bias_op(out, dim_start=2)
+    out = helper.append_activation(out)
+    return _bind_len(helper, out, input)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_expand",
+                     {"X": x, "Y": y, "SeqLen": seq_len_of(y)},
+                     {"Out": out}, {"ref_level": ref_level})
+    return _bind_len(helper, out, y)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y, name=name)
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", input=input[0], name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sequence_concat",
+                     {"X": input,
+                      "SeqLen": [seq_len_of(x) for x in input]},
+                     {"Out": out}, {})
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_reshape", {"X": input}, {"Out": out},
+                     {"new_dim": new_dim})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    # already padded in this representation: return data + lengths
+    helper = LayerHelper("sequence_pad", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("sequence_pad",
+                     {"X": x, "PadValue": pad_value,
+                      "SeqLen": seq_len_of(x)},
+                     {"Out": out, "Length": length},
+                     {"padded_length": maxlen or -1})
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_unpad", {"X": x, "Length": length},
+                     {"Out": out}, {})
+    lname = out.name + SEQ_LEN_SUFFIX
+    helper.append_op("cast", {"X": length}, {"Out": lname},
+                     {"out_dtype": "int32"})
+    out.block.create_var(name=lname, shape=(-1,), dtype="int32",
+                         stop_gradient=True)
+    return out
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper("sequence_reverse", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_reverse",
+                     {"X": x, "SeqLen": seq_len_of(x)},
+                     {"Y": out}, {})
+    return _bind_len(helper, out, x)
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_slice",
+                     {"X": input, "Offset": offset, "Length": length},
+                     {"Out": out}, {})
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("sequence_enumerate", {"X": input}, {"Out": out},
+                     {"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    helper = LayerHelper("sequence_scatter", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_scatter",
+                     {"X": input, "Ids": index, "Updates": updates},
+                     {"Out": out}, {})
+    return out

@@ -1,0 +1,111 @@
+"""Tensor-creation layers (reference python/paddle/fluid/layers/tensor.py)."""
+from __future__ import annotations
+
+from ..core.types import as_datatype
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+
+__all__ = ["create_tensor", "create_parameter", "create_global_var",
+           "fill_constant", "fill_constant_batch_size_like", "assign",
+           "linspace", "zeros", "ones", "has_inf", "has_nan", "isfinite"]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(name=helper.name,
+                                  dtype=as_datatype(dtype),
+                                  persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    helper = LayerHelper("create_parameter", param_attr=attr, name=name)
+    return helper.create_parameter(
+        helper.param_attr if attr is not None else attr, shape, dtype,
+        is_bias=is_bias, default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(shape, dtype,
+                                        persistable=persistable,
+                                        name=name)
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    return var
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    out = out or helper.create_variable_for_type_inference(dtype)
+    helper.append_op("fill_constant", {}, {"Out": out},
+                     {"shape": list(shape),
+                      "dtype": as_datatype(dtype).value,
+                      "value": float(value)})
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like", input=input)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("fill_constant_batch_size_like", {"Input": input},
+                     {"Out": out},
+                     {"shape": list(shape),
+                      "dtype": as_datatype(dtype).value,
+                      "value": float(value),
+                      "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign", input=input)
+    import numpy as np
+
+    if isinstance(input, np.ndarray):
+        output = output or helper.create_variable_for_type_inference(
+            str(input.dtype))
+        helper.append_op("assign_value", {}, {"Out": output},
+                         {"shape": list(input.shape),
+                          "dtype": str(input.dtype), "values": input})
+        return output
+    output = output or helper.create_variable_for_type_inference(
+        input.dtype)
+    helper.append_op("assign", {"X": input}, {"Out": output}, {})
+    return output
+
+
+def linspace(start, stop, num, dtype="float32"):
+    helper = LayerHelper("linspace")
+    out = helper.create_variable_for_type_inference(dtype)
+    import numpy as np
+
+    vals = np.linspace(start, stop, num)
+    helper.append_op("assign_value", {}, {"Out": out},
+                     {"shape": [num], "dtype": as_datatype(dtype).value,
+                      "values": vals})
+    return out
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite", input=x)
+    out = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op("isfinite", {"X": x}, {"Out": out}, {})
+    return out
+
+
+def has_inf(x):
+    return isfinite(x)
+
+
+def has_nan(x):
+    return isfinite(x)

@@ -1,0 +1,8 @@
+"""Model zoo mirroring the reference benchmark set
+(reference benchmark/fluid/models/: mnist, resnet, vgg, se_resnext,
+stacked_dynamic_lstm, machine_translation; + transformer from
+tests/unittests/dist_transformer.py; + CTR from dist_ctr.py)."""
+from . import mnist  # noqa: F401
+from . import resnet  # noqa: F401
+from . import vgg  # noqa: F401
+from . import transformer  # noqa: F401

@@ -1,0 +1,49 @@
+"""MNIST models (reference benchmark/fluid/models/mnist.py cnn_model +
+tests/book/test_recognize_digits.py)."""
+from __future__ import annotations
+
+from .. import layers, nets
+
+
+def mlp(img, label, hidden_sizes=(128, 64)):
+    h = img
+    for size in hidden_sizes:
+        h = layers.fc(h, size, act="relu")
+    logits = layers.fc(h, 10)
+    loss = layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = layers.mean(loss)
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return avg_loss, acc, logits
+
+
+def cnn_model(img, label):
+    """LeNet-ish conv net (reference mnist.py cnn_model)."""
+    conv1 = nets.simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20, pool_size=2,
+        pool_stride=2, act="relu")
+    conv2 = nets.simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=50, pool_size=2,
+        pool_stride=2, act="relu")
+    logits = layers.fc(conv2, 10)
+    loss = layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = layers.mean(loss)
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return avg_loss, acc, logits
+
+
+def build_program(batch_size=None, use_conv=True):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        if use_conv:
+            img = layers.data("img", shape=[1, 28, 28], dtype="float32")
+        else:
+            img = layers.data("img", shape=[784], dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        if use_conv:
+            avg_loss, acc, logits = cnn_model(img, label)
+        else:
+            avg_loss, acc, logits = mlp(img, label)
+    return main, startup, avg_loss, acc

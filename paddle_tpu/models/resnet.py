@@ -1,0 +1,94 @@
+"""ResNet-50/101/152 (reference benchmark/fluid/models/resnet.py).
+
+Built with the framework's own conv2d/batch_norm layers; bottleneck
+topology matches the reference's so the benchmark exercises the same
+conv/bn op mix. NCHW layout: XLA on TPU relayouts to its preferred
+tiling internally.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None, is_test=False):
+    conv = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=(filter_size - 1) // 2, groups=groups,
+        bias_attr=False)
+    return layers.batch_norm(input=conv, act=act, is_test=is_test)
+
+
+def shortcut(input, ch_out, stride, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, is_test=is_test)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
+                          is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride,
+                          act="relu", is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 4, 1, act=None,
+                          is_test=is_test)
+    short = shortcut(input, num_filters * 4, stride, is_test=is_test)
+    return layers.relu(layers.elementwise_add(short, conv2))
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+    cfg = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
+           152: [3, 8, 36, 3]}[depth]
+    conv = conv_bn_layer(input, 64, 7, stride=2, act="relu",
+                         is_test=is_test)
+    pool = layers.pool2d(conv, pool_size=3, pool_stride=2,
+                         pool_padding=1, pool_type="max")
+    filters = [64, 128, 256, 512]
+    for stage, count in enumerate(cfg):
+        for i in range(count):
+            stride = 2 if i == 0 and stage > 0 else 1
+            pool = bottleneck_block(pool, filters[stage], stride,
+                                    is_test=is_test)
+    pool = layers.pool2d(pool, pool_type="avg", global_pooling=True)
+    logits = layers.fc(pool, class_dim)
+    return logits
+
+
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
+    """reference resnet.py resnet_cifar10: basic blocks, 3 stages."""
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+
+    def basicblock(x, ch_out, stride):
+        conv0 = conv_bn_layer(x, ch_out, 3, stride, act="relu",
+                              is_test=is_test)
+        conv1 = conv_bn_layer(conv0, ch_out, 3, 1, is_test=is_test)
+        short = shortcut(x, ch_out, stride, is_test=is_test)
+        return layers.relu(layers.elementwise_add(short, conv1))
+
+    conv = conv_bn_layer(input, 16, 3, 1, act="relu", is_test=is_test)
+    for ch, stride in ((16, 1), (32, 2), (64, 2)):
+        for i in range(n):
+            conv = basicblock(conv, ch, stride if i == 0 else 1)
+    pool = layers.pool2d(conv, pool_type="avg", global_pooling=True)
+    return layers.fc(pool, class_dim)
+
+
+def build_program(depth=50, class_dim=1000, image_shape=(3, 224, 224),
+                  lr=0.1, with_optimizer=True):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=list(image_shape),
+                          dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = resnet_imagenet(img, class_dim, depth)
+        loss = layers.softmax_with_cross_entropy(logits, label)
+        avg_loss = layers.mean(loss)
+        if with_optimizer:
+            fluid.optimizer.Momentum(learning_rate=lr,
+                                     momentum=0.9).minimize(avg_loss)
+    return main, startup, avg_loss

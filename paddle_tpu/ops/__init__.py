@@ -1,0 +1,5 @@
+"""Op library: importing this package registers all kernels."""
+from . import tensor_ops  # noqa: F401
+from . import math_ops  # noqa: F401
+from . import nn_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401

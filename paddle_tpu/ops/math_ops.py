@@ -1,0 +1,307 @@
+"""Dense math, elementwise (fluid axis-broadcast semantics), activations,
+reductions, comparisons.
+
+Parity targets: reference paddle/fluid/operators/mul_op.cc, matmul_op.cc,
+elementwise/elementwise_op_function.h (broadcast machinery),
+activation_op.cc (~25 activations via functor registry),
+reduce_ops/, cum_op era. On TPU the matmuls ride the MXU; everything
+elementwise fuses into neighbours under XLA, replacing the reference's
+explicit fuse passes and AVX/JIT kernels (operators/jit/).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+# --------------------------------------------------------------------------
+# matmul family
+# --------------------------------------------------------------------------
+def _flatten2d(x, num_col_dims):
+    lead = int(np.prod(x.shape[:num_col_dims])) if num_col_dims else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@register_op("mul")
+def mul(ctx):
+    """reference mul_op.cc: flatten X/Y to 2-D then matmul."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    xnc = ctx.attr("x_num_col_dims", 1)
+    ync = ctx.attr("y_num_col_dims", 1)
+    x2 = _flatten2d(x, xnc)
+    y2 = jnp.reshape(y, (int(np.prod(y.shape[:ync])), -1))
+    out = jnp.matmul(x2, y2)
+    out_shape = x.shape[:xnc] + y.shape[ync:]
+    return jnp.reshape(out, out_shape)
+
+
+@register_op("matmul")
+def matmul(ctx):
+    """reference matmul_op.cc: batched matmul with transpose flags+alpha."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    tx, ty = ctx.attr("transpose_X", False), ctx.attr("transpose_Y", False)
+    alpha = ctx.attr("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :] if not tx else x[:, None]
+    if y.ndim == 1:
+        y = y[:, None] if not ty else y[None, :]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+@register_op("matmul_v2")
+def matmul_v2(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    if ctx.attr("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if ctx.attr("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+@register_op("dot")
+def dot(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    return jnp.sum(x * y, axis=-1, keepdims=True)
+
+
+# --------------------------------------------------------------------------
+# elementwise binary ops with fluid axis semantics
+# (reference elementwise_op_function.h: Y broadcast against X from `axis`)
+# --------------------------------------------------------------------------
+def _broadcast_y(x, y, axis):
+    if x.shape == y.shape:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    # trim trailing 1s of y per fluid semantics
+    yshape = list(y.shape)
+    while yshape and yshape[-1] == 1 and len(yshape) > x.ndim - axis:
+        yshape.pop()
+    new_shape = [1] * axis + yshape + [1] * (x.ndim - axis - len(yshape))
+    return jnp.reshape(y, new_shape)
+
+
+def _ew(fn):
+    def kernel(ctx):
+        x, y = ctx.input("X"), ctx.input("Y")
+        y = _broadcast_y(x, y, ctx.attr("axis", -1))
+        return fn(x, y)
+
+    return kernel
+
+
+register_op("elementwise_add")(_ew(jnp.add))
+register_op("elementwise_sub")(_ew(jnp.subtract))
+register_op("elementwise_mul")(_ew(jnp.multiply))
+register_op("elementwise_div")(_ew(jnp.divide))
+register_op("elementwise_min")(_ew(jnp.minimum))
+register_op("elementwise_max")(_ew(jnp.maximum))
+register_op("elementwise_pow")(_ew(jnp.power))
+register_op("elementwise_mod", differentiable=False)(_ew(jnp.mod))
+register_op("elementwise_floordiv", differentiable=False)(
+    _ew(jnp.floor_divide))
+
+
+# --------------------------------------------------------------------------
+# activations (reference activation_op.cc)
+# --------------------------------------------------------------------------
+def _unary(fn, type_name, differentiable=True):
+    def kernel(ctx):
+        return fn(ctx.input("X"))
+
+    register_op(type_name, differentiable=differentiable)(kernel)
+    return kernel
+
+
+_unary(jax.nn.relu, "relu")
+_unary(jax.nn.sigmoid, "sigmoid")
+_unary(jnp.tanh, "tanh")
+_unary(jnp.exp, "exp")
+_unary(jnp.sqrt, "sqrt")
+_unary(lambda x: jax.lax.rsqrt(x), "rsqrt")
+_unary(jnp.abs, "abs")
+_unary(jnp.log, "log")
+_unary(jnp.square, "square")
+_unary(jnp.floor, "floor", differentiable=False)
+_unary(jnp.ceil, "ceil", differentiable=False)
+_unary(jnp.round, "round", differentiable=False)
+_unary(jnp.reciprocal, "reciprocal")
+_unary(jax.nn.softplus, "softplus")
+_unary(lambda x: x / (1 + jnp.abs(x)), "softsign")
+_unary(jnp.sin, "sin")
+_unary(jnp.cos, "cos")
+_unary(jnp.arccos, "acos")
+_unary(jnp.arcsin, "asin")
+_unary(jnp.arctan, "atan")
+_unary(lambda x: jax.nn.gelu(x, approximate=False), "gelu")
+_unary(jnp.sign, "sign", differentiable=False)
+_unary(jnp.logical_not, "logical_not", differentiable=False)
+
+
+@register_op("gelu_approx")
+def gelu_approx(ctx):
+    return jax.nn.gelu(ctx.input("X"), approximate=True)
+
+
+@register_op("leaky_relu")
+def leaky_relu(ctx):
+    return jax.nn.leaky_relu(ctx.input("X"), ctx.attr("alpha", 0.02))
+
+
+@register_op("elu")
+def elu(ctx):
+    return jax.nn.elu(ctx.input("X"), ctx.attr("alpha", 1.0))
+
+
+@register_op("relu6")
+def relu6(ctx):
+    return jnp.clip(ctx.input("X"), 0.0, ctx.attr("threshold", 6.0))
+
+
+@register_op("pow")
+def pow_op(ctx):
+    return jnp.power(ctx.input("X"), ctx.attr("factor", 1.0))
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(ctx):
+    x = ctx.input("X")
+    slope = ctx.attr("slope", 0.2)
+    offset = ctx.attr("offset", 0.5)
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+@register_op("swish")
+def swish(ctx):
+    x = ctx.input("X")
+    beta = ctx.attr("beta", 1.0)
+    return x * jax.nn.sigmoid(beta * x)
+
+
+@register_op("hard_swish")
+def hard_swish(ctx):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 6.0)
+    s = ctx.attr("scale", 6.0)
+    o = ctx.attr("offset", 3.0)
+    return x * jnp.clip(x + o, 0.0, t) / s
+
+
+# --------------------------------------------------------------------------
+# reductions (reference operators/reduce_ops/)
+# --------------------------------------------------------------------------
+def _reduce(fn, type_name, differentiable=True):
+    def kernel(ctx):
+        x = ctx.input("X")
+        if ctx.attr("reduce_all", False):
+            dims = None
+        else:
+            dims = tuple(d % x.ndim for d in ctx.attr("dim", [0]))
+        return fn(x, axis=dims, keepdims=ctx.attr("keep_dim", False))
+
+    register_op(type_name, differentiable=differentiable)(kernel)
+
+
+_reduce(jnp.sum, "reduce_sum")
+_reduce(jnp.mean, "reduce_mean")
+_reduce(jnp.max, "reduce_max")
+_reduce(jnp.min, "reduce_min")
+_reduce(jnp.prod, "reduce_prod")
+_reduce(jnp.all, "reduce_all", differentiable=False)
+_reduce(jnp.any, "reduce_any", differentiable=False)
+
+
+@register_op("mean")
+def mean(ctx):
+    # fluid mean outputs shape [1] (reference mean_op.cc)
+    return jnp.mean(ctx.input("X")).reshape((1,))
+
+
+@register_op("cumsum")
+def cumsum(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    out = jnp.cumsum(x, axis=axis)
+    if ctx.attr("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(0, x.shape[axis])
+        out = jnp.pad(out, pad)[tuple(sl)]
+    if ctx.attr("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+    return out
+
+
+@register_op("frobenius_norm")
+def frobenius_norm(ctx):
+    x = ctx.input("X")
+    dims = tuple(ctx.attr("dim", list(range(x.ndim))))
+    return jnp.sqrt(jnp.sum(x * x, axis=dims,
+                            keepdims=ctx.attr("keep_dim", False)))
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ctx):
+    x = ctx.input("X")
+    return jnp.sum(x * x).reshape((1,))
+
+
+@register_op("p_norm")
+def p_norm(ctx):
+    x = ctx.input("X")
+    p = ctx.attr("porder", 2.0)
+    axis = ctx.attr("axis", -1)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis,
+                   keepdims=ctx.attr("keepdim", False)) ** (1.0 / p)
+
+
+# --------------------------------------------------------------------------
+# comparisons / logical (reference operators/controlflow/compare_op.cc)
+# --------------------------------------------------------------------------
+def _cmp(fn, type_name):
+    def kernel(ctx):
+        x, y = ctx.input("X"), ctx.input("Y")
+        return fn(x, y)
+
+    register_op(type_name, differentiable=False)(kernel)
+
+
+_cmp(jnp.less_equal, "less_equal")
+_cmp(jnp.less, "less_than")
+_cmp(jnp.greater_equal, "greater_equal")
+_cmp(jnp.greater, "greater_than")
+_cmp(jnp.equal, "equal")
+_cmp(jnp.not_equal, "not_equal")
+_cmp(jnp.logical_and, "logical_and")
+_cmp(jnp.logical_or, "logical_or")
+_cmp(jnp.logical_xor, "logical_xor")
+
+
+@register_op("maximum")
+def maximum(ctx):
+    return jnp.maximum(ctx.input("X"), ctx.input("Y"))
+
+
+@register_op("minimum")
+def minimum(ctx):
+    return jnp.minimum(ctx.input("X"), ctx.input("Y"))
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(ctx):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 1.0)
+    return jnp.where(x > t, x, jnp.zeros_like(x))

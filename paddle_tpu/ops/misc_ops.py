@@ -1,0 +1,428 @@
+"""Misc / vision ops: prelu, maxout, interpolation, roi ops, shuffles.
+
+Parity targets: reference paddle/fluid/operators/prelu_op.cc, maxout_op.cc,
+interpolate_op.cc (bilinear/nearest), grid_sampler_op.cc, affine_grid_op.cc,
+affine_channel_op.cc, shuffle_channel_op.cc, pixel_shuffle_op.cc,
+roi_pool_op.cc, roi_align_op.cc, psroi_pool_op.cc, row_conv_op.cc,
+temporal_shift_op.cc, unfold_op.cc, im2sequence_op.cc, multiplex_op.cc,
+label_smooth_op.cc, cos_sim_op.cc, sampling_id_op.cc, spectral_norm_op.cc.
+All dense jnp formulations that XLA maps to MXU/VPU; gather-heavy roi ops
+use vectorized one_hot matmuls where beneficial.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+@register_op("prelu")
+def prelu(ctx):
+    x = ctx.input("X")
+    alpha = ctx.input("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:
+        a = alpha.reshape((1,) + x.shape[1:])
+    return jnp.where(x > 0, x, a * x)
+
+
+@register_op("maxout")
+def maxout(ctx):
+    x = ctx.input("X")  # NCHW
+    g = ctx.attr("groups")
+    n, c, h, w = x.shape
+    return x.reshape(n, c // g, g, h, w).max(axis=2)
+
+
+@register_op("soft_relu")
+def soft_relu(ctx):
+    x = ctx.input("X")
+    t = ctx.attr("threshold", 40.0)
+    return jnp.log1p(jnp.exp(jnp.clip(x, -t, t)))
+
+
+@register_op("brelu")
+def brelu(ctx):
+    return jnp.clip(ctx.input("X"), ctx.attr("t_min", 0.0),
+                    ctx.attr("t_max", 24.0))
+
+
+@register_op("label_smooth", stop_gradient_slots=("PriorDist",))
+def label_smooth(ctx):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 0.1)
+    prior = ctx.input("PriorDist")
+    k = x.shape[-1]
+    if prior is not None:
+        return (1 - eps) * x + eps * prior.reshape((1,) * (x.ndim - 1)
+                                                   + (k,))
+    return (1 - eps) * x + eps / k
+
+
+@register_op("cos_sim")
+def cos_sim(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+@register_op("dice_loss", stop_gradient_slots=("Label",))
+def dice_loss(ctx):
+    x = ctx.input("X")
+    label = ctx.input("Label").astype(x.dtype)
+    eps = ctx.attr("epsilon", 1e-5)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = 2.0 * jnp.sum(x * label, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(label,
+                                                   axis=reduce_dims)
+    return (1.0 - (inter + eps) / (union + eps)).mean().reshape(1)
+
+
+@register_op("npair_loss", stop_gradient_slots=("Labels",))
+def npair_loss(ctx):
+    a, p = ctx.input("Anchor"), ctx.input("Positive")
+    labels = ctx.input("Labels").reshape(-1)
+    l2 = ctx.attr("l2_reg", 0.002)
+    sim = a @ p.T
+    eq = (labels[:, None] == labels[None, :]).astype(a.dtype)
+    tgt = eq / jnp.sum(eq, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    xent = -jnp.sum(tgt * logp, axis=1).mean()
+    reg = l2 * (jnp.mean(jnp.sum(a * a, axis=1))
+                + jnp.mean(jnp.sum(p * p, axis=1)))
+    return (xent + reg).reshape(1)
+
+
+@register_op("interpolate")
+def interpolate(ctx):
+    x = ctx.input("X")  # NCHW
+    oh, ow = ctx.attr("out_h"), ctx.attr("out_w")
+    method = ctx.attr("interp_method", "bilinear")
+    align = ctx.attr("align_corners", True)
+    n, c, h, w = x.shape
+    if method == "nearest":
+        ih = (jnp.arange(oh) * (h / oh)).astype(jnp.int32)
+        iw = (jnp.arange(ow) * (w / ow)).astype(jnp.int32)
+        return x[:, :, ih][:, :, :, iw]
+    # bilinear
+    if align and oh > 1:
+        ys = jnp.linspace(0.0, h - 1.0, oh)
+    else:
+        ys = (jnp.arange(oh) + 0.5) * h / oh - 0.5
+    if align and ow > 1:
+        xs = jnp.linspace(0.0, w - 1.0, ow)
+    else:
+        xs = (jnp.arange(ow) + 0.5) * w / ow - 0.5
+    ys = jnp.clip(ys, 0, h - 1)
+    xs = jnp.clip(xs, 0, w - 1)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    v00 = x[:, :, y0][:, :, :, x0]
+    v01 = x[:, :, y0][:, :, :, x1]
+    v10 = x[:, :, y1][:, :, :, x0]
+    v11 = x[:, :, y1][:, :, :, x1]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@register_op("grid_sampler")
+def grid_sampler(ctx):
+    x = ctx.input("X")  # NCHW
+    grid = ctx.input("Grid")  # NHW2 in [-1, 1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0
+    wy = gy - y0
+
+    def sample(yi, xi):
+        valid = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+        yi = jnp.clip(yi, 0, h - 1)
+        xi = jnp.clip(xi, 0, w - 1)
+        batch = jnp.arange(n)[:, None, None]
+        v = x[batch, :, yi, xi]  # N,H,W,C
+        return v * valid[..., None]
+
+    v00 = sample(y0, x0)
+    v01 = sample(y0, x1)
+    v10 = sample(y1, x0)
+    v11 = sample(y1, x1)
+    out = (v00 * ((1 - wy) * (1 - wx))[..., None]
+           + v01 * ((1 - wy) * wx)[..., None]
+           + v10 * (wy * (1 - wx))[..., None]
+           + v11 * (wy * wx)[..., None])
+    return {"Output": jnp.transpose(out, (0, 3, 1, 2))}
+
+
+@register_op("affine_grid")
+def affine_grid(ctx):
+    theta = ctx.input("Theta")  # N,2,3
+    shape = ctx.attr("output_shape")
+    n, _, h, w = shape if len(shape) == 4 else (theta.shape[0], 1,
+                                                shape[0], shape[1])
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # HW,3
+    out = jnp.einsum("nij,kj->nki", theta, base)  # N,HW,2
+    return {"Output": out.reshape(theta.shape[0], h, w, 2)}
+
+
+@register_op("affine_channel")
+def affine_channel(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    layout = ctx.attr("data_layout", "NCHW")
+    shape = (1, -1) + (1,) * (x.ndim - 2) if layout == "NCHW" \
+        else (1,) * (x.ndim - 1) + (-1,)
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(ctx):
+    x = ctx.input("X")
+    g = ctx.attr("group")
+    n, c, h, w = x.shape
+    return x.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(x.shape)
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(ctx):
+    x = ctx.input("X")
+    r = ctx.attr("upscale_factor")
+    n, c, h, w = x.shape
+    oc = c // (r * r)
+    x = x.reshape(n, oc, r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, oc, h * r, w * r)
+
+
+@register_op("pixel_unshuffle")
+def pixel_unshuffle(ctx):
+    x = ctx.input("X")
+    r = ctx.attr("downscale_factor")
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+    return x.reshape(n, c * r * r, h // r, w // r)
+
+
+@register_op("multiplex", stop_gradient_slots=("Ids",))
+def multiplex(ctx):
+    xs = jnp.stack(ctx.inputs("X"), axis=0)  # K,N,D
+    ids = ctx.input("Ids").reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(ids.shape[0])
+    return xs[ids, rows]
+
+
+@register_op("sampling_id", differentiable=False, needs_rng=True)
+def sampling_id(ctx):
+    x = ctx.input("X")  # N,K probabilities
+    key = ctx.rng()
+    return jax.random.categorical(key, jnp.log(x + 1e-20),
+                                  axis=-1).astype(jnp.int32)
+
+
+@register_op("row_conv")
+def row_conv(ctx):
+    x = ctx.input("X")  # N,T,D (batched) -- lookahead conv
+    w = ctx.input("Filter")  # (ctx+1),D
+    k = w.shape[0]
+    t = x.shape[1]
+    pad = jnp.pad(x, ((0, 0), (0, k - 1), (0, 0)))
+    out = sum(pad[:, i:i + t] * w[i][None, None, :] for i in range(k))
+    return out
+
+
+@register_op("temporal_shift")
+def temporal_shift(ctx):
+    x = ctx.input("X")  # NT,C,H,W
+    seg = ctx.attr("seg_num")
+    ratio = ctx.attr("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // seg
+    x5 = x.reshape(n, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    back = jnp.pad(x5[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                    (0, 0)))
+    fwd = jnp.pad(x5[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                      (0, 0)))
+    keep = x5[:, :, c2:]
+    return jnp.concatenate([back, fwd, keep], axis=2).reshape(x.shape)
+
+
+@register_op("unfold")
+def unfold(ctx):
+    x = ctx.input("X")  # N,C,H,W
+    ks = ctx.attr("kernel_sizes")
+    st = ctx.attr("strides", [1, 1])
+    pd = ctx.attr("paddings", [0, 0])
+    dl = ctx.attr("dilations", [1, 1])
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, ks, st, [(pd[0], pd[0]), (pd[1], pd[1])],
+        rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # N, C*kh*kw, OH, OW -> N, C*kh*kw, OH*OW
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+@register_op("im2sequence")
+def im2sequence(ctx):
+    x = ctx.input("X")
+    ks = ctx.attr("kernels")
+    st = ctx.attr("strides", [1, 1])
+    pd = ctx.attr("paddings", [0, 0, 0, 0])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, ks, st, [(pd[0], pd[2]), (pd[1], pd[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    return patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ckk)
+
+
+@register_op("spectral_norm")
+def spectral_norm(ctx):
+    w = ctx.input("Weight")
+    u = ctx.input("U")
+    v = ctx.input("V")
+    dim = ctx.attr("dim", 0)
+    iters = ctx.attr("power_iters", 1)
+    eps = ctx.attr("eps", 1e-12)
+    wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(max(iters, 0)):
+        v = wm.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wm @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ wm @ v
+    return w / sigma
+
+
+def _roi_common(ctx):
+    x = ctx.input("X")  # N,C,H,W
+    rois = ctx.input("ROIs")  # R,4 (x1,y1,x2,y2)
+    scale = ctx.attr("spatial_scale", 1.0)
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    return x, rois, scale, ph, pw
+
+
+@register_op("roi_align", stop_gradient_slots=("ROIs",))
+def roi_align(ctx):
+    x, rois, scale, ph, pw = _roi_common(ctx)
+    # bin-center bilinear sampling, vectorized over rois (single image)
+    x1s = rois[:, 0] * scale
+    y1s = rois[:, 1] * scale
+    x2s = rois[:, 2] * scale
+    y2s = rois[:, 3] * scale
+    rh = jnp.maximum(y2s - y1s, 1.0) / ph
+    rw = jnp.maximum(x2s - x1s, 1.0) / pw
+    # sample center points per bin
+    py = y1s[:, None] + rh[:, None] * (jnp.arange(ph)[None, :] + 0.5)
+    px = x1s[:, None] + rw[:, None] * (jnp.arange(pw)[None, :] + 0.5)
+    py = jnp.clip(py, 0, x.shape[2] - 1)
+    px = jnp.clip(px, 0, x.shape[3] - 1)
+    y0 = jnp.floor(py).astype(jnp.int32)
+    x0 = jnp.floor(px).astype(jnp.int32)
+    y1c = jnp.minimum(y0 + 1, x.shape[2] - 1)
+    x1c = jnp.minimum(x0 + 1, x.shape[3] - 1)
+    wy = py - y0
+    wx = px - x0
+    feat = x[0]  # C,H,W
+
+    def gat(yy, xx):
+        # yy: R,PH  xx: R,PW -> C,R,PH,PW
+        return feat[:, yy[:, :, None], xx[:, None, :]]
+
+    v00 = gat(y0, x0)
+    v01 = gat(y0, x1c)
+    v10 = gat(y1c, x0)
+    v11 = gat(y1c, x1c)
+    wy_ = wy[None, :, :, None]
+    wx_ = wx[None, :, None, :]
+    out = (v00 * (1 - wy_) * (1 - wx_) + v01 * (1 - wy_) * wx_
+           + v10 * wy_ * (1 - wx_) + v11 * wy_ * wx_)
+    return jnp.transpose(out, (1, 0, 2, 3))  # R,C,PH,PW
+
+
+@register_op("roi_pool", stop_gradient_slots=("ROIs",))
+def roi_pool(ctx):
+    x, rois, scale, ph, pw = _roi_common(ctx)
+    n, c, h, w = x.shape
+    feat = x[0]
+    x1 = jnp.round(rois[:, 0] * scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 1] * scale).astype(jnp.int32)
+    x2 = jnp.round(rois[:, 2] * scale).astype(jnp.int32)
+    y2 = jnp.round(rois[:, 3] * scale).astype(jnp.int32)
+    rh = jnp.maximum(y2 - y1 + 1, 1) / ph
+    rw = jnp.maximum(x2 - x1 + 1, 1) / pw
+    hs = jnp.arange(h)
+    ws = jnp.arange(w)
+    outs = []
+    # bin membership masks (R,PH,H) x (R,PW,W): max over masked region
+    yb0 = y1[:, None] + jnp.floor(jnp.arange(ph)[None, :] * rh[:, None])
+    yb1 = y1[:, None] + jnp.ceil((jnp.arange(ph)[None, :] + 1)
+                                 * rh[:, None])
+    xb0 = x1[:, None] + jnp.floor(jnp.arange(pw)[None, :] * rw[:, None])
+    xb1 = x1[:, None] + jnp.ceil((jnp.arange(pw)[None, :] + 1)
+                                 * rw[:, None])
+    ymask = ((hs[None, None, :] >= yb0[:, :, None])
+             & (hs[None, None, :] < yb1[:, :, None]))  # R,PH,H
+    xmask = ((ws[None, None, :] >= xb0[:, :, None])
+             & (ws[None, None, :] < xb1[:, :, None]))  # R,PW,W
+    neg = jnp.finfo(feat.dtype).min
+    # C,R,PH,PW via masked max: expand (C,1,1,H,W)
+    f = feat[:, None, None, :, :]
+    m = (ymask[None, :, :, None, :, None]
+         & xmask[None, :, None, :, None, :])  # 1,R,PH,PW,H,W
+    fm = jnp.where(m, f[:, :, :, None, :, :], neg)
+    out = fm.max(axis=(4, 5))  # C,R,PH,PW
+    res = jnp.transpose(out, (1, 0, 2, 3))
+    return {"Out": res, "Argmax": jnp.zeros(res.shape, dtype=jnp.int32)}
+
+
+@register_op("psroi_pool", stop_gradient_slots=("ROIs",))
+def psroi_pool(ctx):
+    x, rois, scale, _, _ = _roi_common(ctx)
+    ph = ctx.attr("pooled_height")
+    pw = ctx.attr("pooled_width")
+    oc = ctx.attr("output_channels")
+    feat = x[0]  # C,H,W with C = oc*ph*pw
+    h, w = feat.shape[1], feat.shape[2]
+    r = rois.shape[0]
+    x1 = rois[:, 0] * scale
+    y1 = rois[:, 1] * scale
+    x2 = rois[:, 2] * scale
+    y2 = rois[:, 3] * scale
+    rh = jnp.maximum(y2 - y1, 0.1) / ph
+    rw = jnp.maximum(x2 - x1, 0.1) / pw
+    py = jnp.clip((y1[:, None] + rh[:, None]
+                   * (jnp.arange(ph)[None, :] + 0.5)).astype(jnp.int32),
+                  0, h - 1)
+    px = jnp.clip((x1[:, None] + rw[:, None]
+                   * (jnp.arange(pw)[None, :] + 0.5)).astype(jnp.int32),
+                  0, w - 1)
+    fg = feat.reshape(oc, ph, pw, h, w)
+
+    def per_roi(pyr, pxr):
+        # pyr: PH indices, pxr: PW indices -> OC,PH,PW
+        return fg[:, jnp.arange(ph)[:, None], jnp.arange(pw)[None, :],
+                  pyr[:, None], pxr[None, :]]
+
+    return jax.vmap(per_roi)(py, px)

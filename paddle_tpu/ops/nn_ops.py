@@ -1,0 +1,684 @@
+"""Neural-net ops: conv/pool/norm/softmax/dropout/embedding/losses/metrics.
+
+Parity targets: reference paddle/fluid/operators/conv_op.cc (+cuDNN
+conv_cudnn_op.cu.cc), pool_op.cc, batch_norm_op.cc/.cu, layer_norm_op.cu,
+group_norm_op.cc, softmax_op.cc, softmax_with_cross_entropy_op.cu,
+cross_entropy_op.cc, dropout_op.cc, lookup_table_op.cc, lrn_op.cc,
+metrics/accuracy_op.cc, auc_op.cc. TPU-first notes:
+
+* conv2d lowers to lax.conv_general_dilated -- XLA tiles it onto the MXU
+  (the cuDNN algo-search cache of the reference is obsolete here).
+* batch_norm keeps the reference's mutable running-stat semantics by
+  emitting MeanOut/VarianceOut as functional state (the executor threads
+  them back into the scope).
+* dropout SAVES its mask as an output (like the reference) so the grad op
+  is deterministic -- the generic vjp grad would re-toss the coin.
+* lookup_table's sparse SelectedRows grad path becomes a dense
+  scatter-add here; a row-sharded embedding (pserver parity) lives in
+  parallel/embedding.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.program import Operator, grad_var_name
+from ..core.registry import (OpContext, register_op, get_op_info,
+                             EMPTY_VAR)
+
+
+# --------------------------------------------------------------------------
+# conv / pool
+# --------------------------------------------------------------------------
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v, v]
+
+
+@register_op("conv2d")
+def conv2d(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")  # [out_c, in_c/groups, kh, kw]
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ctx):
+    return conv2d(ctx)
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")  # [in_c, out_c/groups, kh, kw]
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1)
+    out = jax.lax.conv_transpose(
+        x, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
+        strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        transpose_kernel=True)
+    return {"Output": out}
+
+
+@register_op("conv3d")
+def conv3d(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")
+    strides = ctx.attr("strides", [1, 1, 1])
+    pads = ctx.attr("paddings", [0, 0, 0])
+    dilations = ctx.attr("dilations", [1, 1, 1])
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=list(strides),
+        padding=[(p, p) for p in pads],
+        rhs_dilation=list(dilations),
+        feature_group_count=ctx.attr("groups", 1),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    return {"Output": out}
+
+
+def _pool2d_impl(ctx):
+    x = ctx.input("X")
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = _pair(ctx.attr("ksize", [2, 2]))
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    if ctx.attr("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        pads = [0, 0]
+        strides = [1, 1]
+    window = (1, 1, ksize[0], ksize[1])
+    strides_ = (1, 1, strides[0], strides[1])
+    padding = ((0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1]))
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides_,
+                                    padding)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_,
+                                  padding)
+        if ctx.attr("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides_, padding)
+            out = s / cnt
+        else:
+            out = s / (ksize[0] * ksize[1])
+    return out
+
+
+@register_op("pool2d")
+def pool2d(ctx):
+    return _pool2d_impl(ctx)
+
+
+@register_op("adaptive_pool2d")
+def adaptive_pool2d(ctx):
+    x = ctx.input("X")
+    out_hw = ctx.attr("pooling_size", [1, 1])
+    ptype = ctx.attr("pooling_type", "avg")
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    x5 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    if ptype == "avg":
+        return x5.mean(axis=(3, 5))
+    return x5.max(axis=(3, 5))
+
+
+# --------------------------------------------------------------------------
+# normalization
+# --------------------------------------------------------------------------
+def _bn_grad_maker(op, no_grad_set=frozenset()):
+    """batch_norm grad: differentiate only w.r.t. X/Scale/Bias using saved
+    batch statistics; running stats are state, not differentiable."""
+    grad_type = "batch_norm_grad"
+    from ..core.registry import is_registered, register_op as _reg
+
+    if not is_registered(grad_type):
+        _reg(grad_type, differentiable=False)(_bn_grad_kernel)
+    inputs = {
+        "X": op.inputs["X"], "Scale": op.inputs["Scale"],
+        "Bias": op.inputs["Bias"],
+        "SavedMean": op.outputs.get("SavedMean", []),
+        "SavedVariance": op.outputs.get("SavedVariance", []),
+        "Y@GRAD": [grad_var_name(n) for n in op.outputs["Y"]],
+    }
+    outputs = {}
+    for slot in ("X", "Scale", "Bias"):
+        names = op.inputs[slot]
+        if all(n in no_grad_set for n in names):
+            continue
+        outputs[slot + "@GRAD"] = [grad_var_name(n) for n in names]
+    attrs = dict(op.attrs)
+    return [Operator(op.block, grad_type, inputs, outputs, attrs)]
+
+
+def _bn_grad_kernel(ctx):
+    x = ctx.input("X")
+    scale = ctx.input("Scale")
+    mean = ctx.input("SavedMean")
+    inv_std = ctx.input("SavedVariance")  # we save inv-std like cuDNN
+    dy = ctx.input("Y@GRAD")
+    eps = ctx.attr("epsilon", 1e-5)
+    layout = ctx.attr("data_layout", "NCHW")
+    axes = (0, 2, 3) if (layout == "NCHW" and x.ndim == 4) else \
+        tuple(i for i in range(x.ndim) if i != x.ndim - 1)
+    shape = [1] * x.ndim
+    caxis = 1 if (layout == "NCHW" and x.ndim == 4) else x.ndim - 1
+    shape[caxis] = x.shape[caxis]
+    m = float(np.prod([x.shape[a] for a in axes]))
+    mean_b = mean.reshape(shape)
+    inv_b = inv_std.reshape(shape)
+    xhat = (x - mean_b) * inv_b
+    dbias = jnp.sum(dy, axis=axes)
+    dscale = jnp.sum(dy * xhat, axis=axes)
+    if ctx.attr("is_test", False) or ctx.attr(
+            "use_global_stats", False):
+        dx = dy * scale.reshape(shape) * inv_b
+    else:
+        dx = (scale.reshape(shape) * inv_b / m) * (
+            m * dy - dbias.reshape(shape)
+            - xhat * dscale.reshape(shape))
+    out = {"X@GRAD": dx, "Scale@GRAD": dscale, "Bias@GRAD": dbias}
+    return {k: v for k, v in out.items() if k in
+            {s for s in ctx.op.outputs}}
+
+
+@register_op("batch_norm", grad_maker=_bn_grad_maker)
+def batch_norm(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean_in, var_in = ctx.input("Mean"), ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    is_test = ctx.attr("is_test", False) or ctx.attr(
+        "use_global_stats", False)
+    layout = ctx.attr("data_layout", "NCHW")
+    if layout == "NCHW" and x.ndim == 4:
+        axes, caxis = (0, 2, 3), 1
+    else:
+        axes, caxis = tuple(i for i in range(x.ndim - 1)), x.ndim - 1
+    shape = [1] * x.ndim
+    shape[caxis] = x.shape[caxis]
+    if is_test:
+        mean, var = mean_in, var_in
+        y = (x - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + eps) * scale.reshape(shape) \
+            + bias.reshape(shape)
+        return {"Y": y, "MeanOut": mean_in, "VarianceOut": var_in,
+                "SavedMean": mean_in,
+                "SavedVariance": jax.lax.rsqrt(var_in + eps)}
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.mean(jnp.square(x), axis=axes) - jnp.square(mean)
+    inv_std = jax.lax.rsqrt(var + eps)
+    y = (x - mean.reshape(shape)) * inv_std.reshape(shape) \
+        * scale.reshape(shape) + bias.reshape(shape)
+    mean_out = mean_in * momentum + mean * (1 - momentum)
+    var_out = var_in * momentum + var * (1 - momentum)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": mean, "SavedVariance": inv_std}
+
+
+@register_op("layer_norm")
+def layer_norm(ctx):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 1e-5)
+    begin = ctx.attr("begin_norm_axis", 1)
+    lead = int(np.prod(x.shape[:begin]))
+    x2 = x.reshape(lead, -1)
+    mean = jnp.mean(x2, axis=1, keepdims=True)
+    var = jnp.var(x2, axis=1, keepdims=True)
+    y = (x2 - mean) * jax.lax.rsqrt(var + eps)
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    if scale is not None:
+        y = y * scale.reshape(1, -1)
+    if bias is not None:
+        y = y + bias.reshape(1, -1)
+    return {"Y": y.reshape(x.shape), "Mean": mean.reshape(lead),
+            "Variance": var.reshape(lead)}
+
+
+@register_op("group_norm")
+def group_norm(ctx):
+    x = ctx.input("X")  # NCHW
+    g = ctx.attr("groups")
+    eps = ctx.attr("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape(n, g, -1)
+    mean = jnp.mean(xg, axis=2, keepdims=True)
+    var = jnp.var(xg, axis=2, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return {"Y": y, "Mean": mean.reshape(n, g), "Variance": var.reshape(n, g)}
+
+
+@register_op("instance_norm")
+def instance_norm(ctx):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return {"Y": y, "SavedMean": mean.reshape(x.shape[0], x.shape[1]),
+            "SavedVariance": var.reshape(x.shape[0], x.shape[1])}
+
+
+@register_op("lrn")
+def lrn(ctx):
+    x = ctx.input("X")  # NCHW
+    n_size = ctx.attr("n", 5)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    k = ctx.attr("k", 1.0)
+    sq = jnp.square(x)
+    half = n_size // 2
+    pad = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n_size))
+    mid = (k + alpha * acc) ** beta
+    return {"Out": x / mid, "MidOut": mid}
+
+
+@register_op("l2_normalize")
+def l2_normalize(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+@register_op("norm")
+def norm_op(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+# --------------------------------------------------------------------------
+# softmax & losses
+# --------------------------------------------------------------------------
+@register_op("softmax")
+def softmax(ctx):
+    return jax.nn.softmax(ctx.input("X"), axis=ctx.attr("axis", -1))
+
+
+@register_op("log_softmax")
+def log_softmax(ctx):
+    return jax.nn.log_softmax(ctx.input("X"), axis=ctx.attr("axis", -1))
+
+
+def _swce_grad_maker(op, no_grad_set=frozenset()):
+    """Fused grad using saved Softmax (reference
+    softmax_with_cross_entropy_op.cu backward)."""
+    from ..core.registry import is_registered, register_op as _reg
+
+    if not is_registered("softmax_with_cross_entropy_grad"):
+        _reg("softmax_with_cross_entropy_grad", differentiable=False)(
+            _swce_grad_kernel)
+    inputs = {
+        "Softmax": op.outputs["Softmax"],
+        "Label": op.inputs["Label"],
+        "Loss@GRAD": [grad_var_name(n) for n in op.outputs["Loss"]],
+    }
+    outputs = {"Logits@GRAD": [grad_var_name(n)
+                               for n in op.inputs["Logits"]]}
+    return [Operator(op.block, "softmax_with_cross_entropy_grad", inputs,
+                     outputs, dict(op.attrs))]
+
+
+def _swce_grad_kernel(ctx):
+    softmax_out = ctx.input("Softmax")
+    label = ctx.input("Label")
+    dloss = ctx.input("Loss@GRAD")
+    if dloss is None:
+        dloss = jnp.ones(softmax_out.shape[:-1] + (1,),
+                         dtype=softmax_out.dtype)
+    if ctx.attr("soft_label", False):
+        grad = softmax_out - label
+    else:
+        lab = label.astype(jnp.int32)
+        if lab.ndim == softmax_out.ndim:
+            lab = lab[..., 0]
+        onehot = jax.nn.one_hot(lab, softmax_out.shape[-1],
+                                dtype=softmax_out.dtype)
+        grad = softmax_out - onehot
+    return {"Logits@GRAD": grad * dloss}
+
+
+@register_op("softmax_with_cross_entropy", grad_maker=_swce_grad_maker)
+def softmax_with_cross_entropy(ctx):
+    logits = ctx.input("Logits")
+    label = ctx.input("Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    sm = jnp.exp(logp)
+    if ctx.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        lab = label.astype(jnp.int32)
+        if lab.ndim == logits.ndim:
+            lab = lab[..., 0]
+        loss = -jnp.take_along_axis(logp, lab[..., None], axis=-1)
+    return {"Loss": loss, "Softmax": sm}
+
+
+@register_op("cross_entropy", stop_gradient_slots=("Label",))
+def cross_entropy(ctx):
+    x = ctx.input("X")  # probabilities
+    label = ctx.input("Label")
+    if ctx.attr("soft_label", False):
+        return -jnp.sum(label * jnp.log(x + 1e-20), axis=-1, keepdims=True)
+    lab = label.astype(jnp.int32)
+    if lab.ndim == x.ndim:
+        lab = lab[..., 0]
+    p = jnp.take_along_axis(x, lab[..., None], axis=-1)
+    return -jnp.log(p + 1e-20)
+
+
+@register_op("sigmoid_cross_entropy_with_logits",
+             stop_gradient_slots=("Label",))
+def sigmoid_ce_logits(ctx):
+    x = ctx.input("X")
+    label = ctx.input("Label")
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = ctx.attr("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if ctx.attr("normalize", False):
+        n = jnp.maximum(jnp.sum(label != ignore).astype(x.dtype), 1.0)
+        loss = loss / n
+    return loss
+
+
+@register_op("square_error_cost")
+def square_error_cost(ctx):
+    d = ctx.input("X") - ctx.input("Y")
+    return d * d
+
+
+@register_op("huber_loss")
+def huber_loss(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    quad = 0.5 * r * r
+    lin = delta * (a - 0.5 * delta)
+    loss = jnp.where(a <= delta, quad, lin)
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("log_loss")
+def log_loss(ctx):
+    p = ctx.input("Predicted")
+    y = ctx.input("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    return -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)
+
+
+@register_op("smooth_l1_loss")
+def smooth_l1_loss(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    iw = ctx.input("InsideWeight")
+    if iw is not None:
+        d = d * iw
+    a = jnp.abs(d)
+    loss = jnp.where(a < 1.0 / s2, 0.5 * d * d * s2, a - 0.5 / s2)
+    ow = ctx.input("OutsideWeight")
+    if ow is not None:
+        loss = loss * ow
+    red = loss.reshape(loss.shape[0], -1).sum(axis=1, keepdims=True)
+    return {"Out": red, "Diff": d}
+
+
+@register_op("hinge_loss")
+def hinge_loss(ctx):
+    logits = ctx.input("Logits")
+    labels = ctx.input("Labels")
+    return jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)
+
+
+@register_op("margin_rank_loss")
+def margin_rank_loss(ctx):
+    x1, x2 = ctx.input("X1"), ctx.input("X2")
+    label = ctx.input("Label")
+    margin = ctx.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("bpr_loss", stop_gradient_slots=("Label",))
+def bpr_loss(ctx):
+    x = ctx.input("X")
+    label = ctx.input("Label").astype(jnp.int32)
+    if label.ndim == x.ndim:
+        label = label[..., 0]
+    pos = jnp.take_along_axis(x, label[..., None], axis=-1)
+    diff = x - pos
+    loss = jnp.log1p(jnp.exp(diff))
+    n = x.shape[-1]
+    mask = 1.0 - jax.nn.one_hot(label, n, dtype=x.dtype)
+    return jnp.sum(loss * mask, axis=-1, keepdims=True) / (n - 1)
+
+
+@register_op("kldiv_loss", stop_gradient_slots=("Target",))
+def kldiv_loss(ctx):
+    x = ctx.input("X")  # log-probabilities
+    t = ctx.input("Target")
+    loss = t * (jnp.log(jnp.maximum(t, 1e-20)) - x)
+    red = ctx.attr("reduction", "mean")
+    if red == "mean":
+        return jnp.mean(loss).reshape(1)
+    if red == "sum":
+        return jnp.sum(loss).reshape(1)
+    if red == "batchmean":
+        return (jnp.sum(loss) / x.shape[0]).reshape(1)
+    return loss
+
+
+# --------------------------------------------------------------------------
+# dropout (mask saved for deterministic grad, reference dropout_op.cc)
+# --------------------------------------------------------------------------
+def _dropout_grad_maker(op, no_grad_set=frozenset()):
+    from ..core.registry import is_registered, register_op as _reg
+
+    if not is_registered("dropout_grad"):
+        _reg("dropout_grad", differentiable=False)(_dropout_grad_kernel)
+    inputs = {"Mask": op.outputs["Mask"],
+              "Out@GRAD": [grad_var_name(n) for n in op.outputs["Out"]]}
+    outputs = {"X@GRAD": [grad_var_name(n) for n in op.inputs["X"]]}
+    return [Operator(op.block, "dropout_grad", inputs, outputs,
+                     dict(op.attrs))]
+
+
+def _dropout_grad_kernel(ctx):
+    dy = ctx.input("Out@GRAD")
+    mask = ctx.input("Mask")
+    p = ctx.attr("dropout_prob", 0.5)
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if ctx.attr("is_test", False):
+        if impl == "upscale_in_train":
+            return {"X@GRAD": dy}
+        return {"X@GRAD": dy * (1.0 - p)}
+    if impl == "upscale_in_train":
+        scale = 1.0 / max(1.0 - p, 1e-8)
+        return {"X@GRAD": dy * mask * scale}
+    return {"X@GRAD": dy * mask}
+
+
+@register_op("dropout", grad_maker=_dropout_grad_maker, needs_rng=True)
+def dropout(ctx):
+    x = ctx.input("X")
+    p = ctx.attr("dropout_prob", 0.5)
+    is_test = ctx.attr("is_test", False)
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": out, "Mask": jnp.ones_like(x)}
+    seed = ctx.attr("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape).astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = x * keep / max(1.0 - p, 1e-8)
+    else:
+        out = x * keep
+    return {"Out": out, "Mask": keep}
+
+
+# --------------------------------------------------------------------------
+# embedding (reference lookup_table_op.cc; SelectedRows grad -> scatter-add)
+# --------------------------------------------------------------------------
+def _lookup_grad_maker(op, no_grad_set=frozenset()):
+    from ..core.registry import is_registered, register_op as _reg
+
+    if not is_registered("lookup_table_grad"):
+        _reg("lookup_table_grad", differentiable=False)(
+            _lookup_grad_kernel)
+    inputs = {"W": op.inputs["W"], "Ids": op.inputs["Ids"],
+              "Out@GRAD": [grad_var_name(n) for n in op.outputs["Out"]]}
+    w = op.inputs["W"][0]
+    if w in no_grad_set:
+        return []
+    outputs = {"W@GRAD": [grad_var_name(w)]}
+    return [Operator(op.block, "lookup_table_grad", inputs, outputs,
+                     dict(op.attrs))]
+
+
+def _lookup_grad_kernel(ctx):
+    w = ctx.input("W")
+    ids = ctx.input("Ids").astype(jnp.int32)
+    dy = ctx.input("Out@GRAD")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    flat_ids = ids.reshape(-1)
+    flat_dy = dy.reshape(-1, w.shape[-1])
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        keep = (flat_ids != padding_idx).astype(flat_dy.dtype)
+        flat_dy = flat_dy * keep[:, None]
+    dw = jnp.zeros_like(w).at[flat_ids].add(flat_dy)
+    return {"W@GRAD": dw}
+
+
+@register_op("lookup_table", grad_maker=_lookup_grad_maker,
+             stop_gradient_slots=("Ids",))
+def lookup_table(ctx):
+    w = ctx.input("W")
+    ids = ctx.input("Ids").astype(jnp.int32)
+    squeeze_last = ids.ndim >= 2 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids[..., 0]
+    out = jnp.take(w, ids, axis=0)
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx).astype(w.dtype)[..., None]
+        out = out * mask
+    return out
+
+
+@register_op("lookup_table_v2", grad_maker=_lookup_grad_maker,
+             stop_gradient_slots=("Ids",))
+def lookup_table_v2(ctx):
+    return lookup_table(ctx)
+
+
+@register_op("embedding_grad_dense_to_sparse", differentiable=False)
+def embedding_grad_dense_to_sparse(ctx):
+    # capability surface for SelectedRows-style sparse grads: returns the
+    # unique rows + their grads (reference selected_rows.h:32 analogue)
+    return ctx.input("X")
+
+
+# --------------------------------------------------------------------------
+# metrics (reference metrics/accuracy_op.cc, auc_op.cc)
+# --------------------------------------------------------------------------
+@register_op("accuracy", differentiable=False)
+def accuracy(ctx):
+    indices = ctx.input("Indices")
+    label = ctx.input("Label").astype(indices.dtype)
+    if label.ndim == 1:
+        label = label[:, None]
+    correct = jnp.any(indices == label, axis=-1)
+    total = correct.shape[0]
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    acc = (num_correct / total).reshape(1)
+    return {"Accuracy": acc,
+            "Correct": num_correct.astype(jnp.int32).reshape(1),
+            "Total": jnp.array([total], dtype=jnp.int32)}
+
+
+@register_op("auc", differentiable=False)
+def auc(ctx):
+    """Streaming AUC via histogram buckets (reference auc_op.cc)."""
+    preds = ctx.input("Predict")
+    label = ctx.input("Label").reshape(-1)
+    stat_pos = ctx.input("StatPos")
+    stat_neg = ctx.input("StatNeg")
+    num_thresholds = ctx.attr("num_thresholds", 4095)
+    pos_prob = preds[:, -1] if preds.ndim == 2 else preds.reshape(-1)
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    new_pos = stat_pos.at[bucket].add(is_pos)
+    new_neg = stat_neg.at[bucket].add(1 - is_pos)
+    # compute AUC from histograms (trapezoid over thresholds)
+    tot_pos = jnp.cumsum(new_pos[::-1])[::-1]
+    tot_neg = jnp.cumsum(new_neg[::-1])[::-1]
+    tp = tot_pos
+    fp = tot_neg
+    p_total = jnp.maximum(tp[0], 1)
+    n_total = jnp.maximum(fp[0], 1)
+    tpr = tp / p_total
+    fpr = fp / n_total
+    auc_val = -jnp.trapezoid(tpr, fpr)
+    return {"AUC": auc_val.reshape(1).astype(jnp.float32),
+            "StatPosOut": new_pos, "StatNegOut": new_neg}
+
+
+@register_op("mean_iou", differentiable=False)
+def mean_iou(ctx):
+    pred = ctx.input("Predictions").reshape(-1).astype(jnp.int32)
+    label = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    n = ctx.attr("num_classes")
+    inter = jnp.zeros(n).at[jnp.where(pred == label, pred, n - 1)].add(
+        (pred == label).astype(jnp.float32))
+    pred_cnt = jnp.zeros(n).at[pred].add(1.0)
+    lab_cnt = jnp.zeros(n).at[label].add(1.0)
+    union = pred_cnt + lab_cnt - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1e-9), 0.0)
+    valid = (union > 0).astype(jnp.float32)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)
+    return {"OutMeanIou": miou.reshape(1), "OutWrong": union,
+            "OutCorrect": inter}

@@ -1,0 +1,211 @@
+"""Optimizer update ops -- optimizers are *graph ops* like the reference
+(reference: paddle/fluid/operators/optimizers/sgd_op.cc, momentum_op.cc,
+adam_op.cc, adagrad_op.cc, adamax_op.cc, adadelta_op.cc, rmsprop_op.cc,
+ftrl_op.cc, decayed_adagrad_op.cc, lars_momentum_op.cc).
+
+Each op consumes Param (+accumulators) and emits ParamOut (+accumulator
+outs) that the Executor threads back into the scope with donated buffers:
+a true in-place HBM update once XLA aliases the donated input. The
+`inplace` metadata mirrors the reference's inplace_op_inference.h hints.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("sgd", differentiable=False,
+             inplace={"ParamOut": "Param"})
+def sgd(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    lr = ctx.input("LearningRate").reshape(())
+    return {"ParamOut": p - lr * g}
+
+
+@register_op("momentum", differentiable=False,
+             inplace={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def momentum(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    v = ctx.input("Velocity")
+    lr = ctx.input("LearningRate").reshape(())
+    mu = ctx.attr("mu")
+    v_out = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": p_out, "VelocityOut": v_out}
+
+
+@register_op("lars_momentum", differentiable=False,
+             inplace={"ParamOut": "Param", "VelocityOut": "Velocity"})
+def lars_momentum(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    v = ctx.input("Velocity")
+    lr = ctx.input("LearningRate").reshape(())
+    mu = ctx.attr("mu")
+    lars_coeff = ctx.attr("lars_coeff", 0.001)
+    lars_wd = ctx.attr("lars_weight_decay", 0.0005)
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    local_lr = lr * lars_coeff * p_norm / (
+        g_norm + lars_wd * p_norm + 1e-9)
+    v_out = mu * v + local_lr * (g + lars_wd * p)
+    return {"ParamOut": p - v_out, "VelocityOut": v_out}
+
+
+@register_op("adam", differentiable=False,
+             inplace={"ParamOut": "Param", "Moment1Out": "Moment1",
+                      "Moment2Out": "Moment2"})
+def adam(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m1, m2 = ctx.input("Moment1"), ctx.input("Moment2")
+    b1p = ctx.input("Beta1Pow").reshape(())
+    b2p = ctx.input("Beta2Pow").reshape(())
+    lr = ctx.input("LearningRate").reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m1_out = b1 * m1 + (1 - b1) * g
+    m2_out = b2 * m2 + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
+    out = {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out}
+    if "Beta1PowOut" in ctx.op.outputs:
+        out["Beta1PowOut"] = b1p.reshape(1) * b1
+        out["Beta2PowOut"] = b2p.reshape(1) * b2
+    return out
+
+
+@register_op("adamax", differentiable=False,
+             inplace={"ParamOut": "Param", "MomentOut": "Moment",
+                      "InfNormOut": "InfNorm"})
+def adamax(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m = ctx.input("Moment")
+    inf = ctx.input("InfNorm")
+    b1p = ctx.input("Beta1Pow").reshape(())
+    lr = ctx.input("LearningRate").reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m_out = b1 * m + (1 - b1) * g
+    inf_out = jnp.maximum(b2 * inf, jnp.abs(g) + eps)
+    p_out = p - (lr / (1 - b1p)) * (m_out / inf_out)
+    return {"ParamOut": p_out, "MomentOut": m_out, "InfNormOut": inf_out}
+
+
+@register_op("adagrad", differentiable=False,
+             inplace={"ParamOut": "Param", "MomentOut": "Moment"})
+def adagrad(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = m + g * g
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("decayed_adagrad", differentiable=False,
+             inplace={"ParamOut": "Param", "MomentOut": "Moment"})
+def decayed_adagrad(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_out = decay * m + (1 - decay) * g * g
+    p_out = p - lr * g / (jnp.sqrt(m_out) + eps)
+    return {"ParamOut": p_out, "MomentOut": m_out}
+
+
+@register_op("adadelta", differentiable=False,
+             inplace={"ParamOut": "Param", "AvgSquaredGradOut":
+                      "AvgSquaredGrad", "AvgSquaredUpdateOut":
+                      "AvgSquaredUpdate"})
+def adadelta(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    asg = ctx.input("AvgSquaredGrad")
+    asu = ctx.input("AvgSquaredUpdate")
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    asg_out = rho * asg + (1 - rho) * g * g
+    update = -jnp.sqrt((asu + eps) / (asg_out + eps)) * g
+    asu_out = rho * asu + (1 - rho) * update * update
+    return {"ParamOut": p + update, "AvgSquaredGradOut": asg_out,
+            "AvgSquaredUpdateOut": asu_out}
+
+
+@register_op("rmsprop", differentiable=False,
+             inplace={"ParamOut": "Param", "MomentOut": "Moment",
+                      "MeanSquareOut": "MeanSquare"})
+def rmsprop(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    ms = ctx.input("MeanSquare")
+    mom = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    rho = ctx.attr("decay", 0.9)
+    eps = ctx.attr("epsilon", 1e-10)
+    momentum = ctx.attr("momentum", 0.0)
+    centered = ctx.attr("centered", False)
+    ms_out = rho * ms + (1 - rho) * g * g
+    out = {"MeanSquareOut": ms_out}
+    if centered:
+        mg = ctx.input("MeanGrad")
+        mg_out = rho * mg + (1 - rho) * g
+        denom = ms_out - mg_out * mg_out + eps
+        out["MeanGradOut"] = mg_out
+    else:
+        denom = ms_out + eps
+    mom_out = momentum * mom + lr * g / jnp.sqrt(denom)
+    out["MomentOut"] = mom_out
+    out["ParamOut"] = p - mom_out
+    return out
+
+
+@register_op("ftrl", differentiable=False,
+             inplace={"ParamOut": "Param", "SquaredAccumOut":
+                      "SquaredAccumulator", "LinearAccumOut":
+                      "LinearAccumulator"})
+def ftrl(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    sq = ctx.input("SquaredAccumulator")
+    lin = ctx.input("LinearAccumulator")
+    lr = ctx.input("LearningRate").reshape(())
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    lr_power = ctx.attr("lr_power", -0.5)
+    new_sq = sq + g * g
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (new_sq ** -lr_power - sq ** -lr_power) / lr
+    lin_out = lin + g - sigma * p
+    if lr_power == -0.5:
+        x = l2 + jnp.sqrt(new_sq) / lr
+    else:
+        x = l2 + new_sq ** -lr_power / lr
+    pre_shrink = (jnp.sign(lin_out) * l1 - lin_out) / x
+    p_out = jnp.where(jnp.abs(lin_out) > l1, pre_shrink,
+                      jnp.zeros_like(p))
+    return {"ParamOut": p_out, "SquaredAccumOut": new_sq,
+            "LinearAccumOut": lin_out}
+
+
+@register_op("dpsgd", differentiable=False, needs_rng=True,
+             inplace={"ParamOut": "Param"})
+def dpsgd(ctx):
+    """Differentially-private SGD (reference optimizers/dpsgd_op.cc era):
+    clip per-batch grad + add gaussian noise."""
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    lr = ctx.input("LearningRate").reshape(())
+    clip = ctx.attr("clip", 10.0)
+    sigma = ctx.attr("sigma", 1.0)
+    norm = jnp.sqrt(jnp.sum(g * g))
+    g = g * jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    noise = sigma * clip * jax.random.normal(ctx.rng(), g.shape, g.dtype)
+    return {"ParamOut": p - lr * (g + noise)}

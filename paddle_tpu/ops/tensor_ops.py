@@ -1,0 +1,466 @@
+"""Tensor creation / manipulation ops.
+
+Parity targets: reference paddle/fluid/operators/fill_constant_op.cc,
+gaussian_random_op.cc, uniform_random_op.cc, reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, cast_op.cc, assign_op.cc, scale_op.cc, sum_op.cc,
+stack_op.cc, gather_op.cc, slice_op.cc, expand_op.cc, squeeze/unsqueeze,
+shape_op.cc, one_hot_op.cc, range_op.cc, top_k_op.cc, arg_max/min.
+Each is a pure jnp computation; XLA fuses them -- no hand-written kernels
+needed at this tier (Pallas is reserved for the genuinely hot paths).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from ..core.types import to_jnp_dtype
+
+
+@register_op("fill_constant", differentiable=False)
+def fill_constant(ctx):
+    shape = [int(s) for s in ctx.attr("shape", [1])]
+    dtype = to_jnp_dtype(ctx.attr("dtype", "float32"))
+    value = ctx.attr("value", 0.0)
+    return jnp.full(shape, value, dtype=dtype)
+
+
+@register_op("fill_any_like", differentiable=False)
+def fill_any_like(ctx):
+    x = ctx.input("X")
+    return jnp.full_like(x, ctx.attr("value", 0.0))
+
+
+@register_op("fill_zeros_like", differentiable=False)
+def fill_zeros_like(ctx):
+    return jnp.zeros_like(ctx.input("X"))
+
+
+@register_op("gaussian_random", differentiable=False, needs_rng=True)
+def gaussian_random(ctx):
+    shape = [int(s) for s in ctx.attr("shape")]
+    dtype = to_jnp_dtype(ctx.attr("dtype", "float32"))
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    key = _seeded_key(ctx)
+    return (jax.random.normal(key, shape, dtype=jnp.float32) * std
+            + mean).astype(dtype)
+
+
+@register_op("uniform_random", differentiable=False, needs_rng=True)
+def uniform_random(ctx):
+    shape = [int(s) for s in ctx.attr("shape")]
+    dtype = to_jnp_dtype(ctx.attr("dtype", "float32"))
+    lo = ctx.attr("min", -1.0)
+    hi = ctx.attr("max", 1.0)
+    key = _seeded_key(ctx)
+    return jax.random.uniform(key, shape, dtype=jnp.float32,
+                              minval=lo, maxval=hi).astype(dtype)
+
+
+@register_op("truncated_gaussian_random", differentiable=False,
+             needs_rng=True)
+def truncated_gaussian_random(ctx):
+    shape = [int(s) for s in ctx.attr("shape")]
+    dtype = to_jnp_dtype(ctx.attr("dtype", "float32"))
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    key = _seeded_key(ctx)
+    x = jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+    return (x * std + mean).astype(dtype)
+
+
+def _seeded_key(ctx):
+    s = ctx.attr("seed", 0)
+    if s:
+        return jax.random.PRNGKey(s)
+    return ctx.rng()
+
+
+@register_op("assign")
+def assign(ctx):
+    return ctx.input("X")
+
+
+@register_op("shape", differentiable=False)
+def shape_op(ctx):
+    return jnp.asarray(ctx.input("Input").shape, dtype=jnp.int32)
+
+
+@register_op("cast")
+def cast(ctx):
+    return ctx.input("X").astype(to_jnp_dtype(ctx.attr("out_dtype")))
+
+
+@register_op("scale")
+def scale(ctx):
+    x = ctx.input("X")
+    s = ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        return x * s + b
+    return (x + b) * s
+
+
+def _reshape_kernel(ctx):
+    x = ctx.input("X")
+    shape = list(ctx.attr("shape"))
+    # fluid semantics (reference reshape_op.cc): 0 -> copy input dim,
+    # -1 -> inferred
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    return jnp.reshape(x, shape)
+
+
+register_op("reshape")(_reshape_kernel)
+
+
+@register_op("reshape2")
+def reshape2(ctx):
+    out = _reshape_kernel(ctx)
+    res = {"Out": out}
+    if "XShape" in ctx.op.outputs:
+        res["XShape"] = jnp.zeros((0,) + ctx.input("X").shape,
+                                  dtype=jnp.float32)
+    return res
+
+
+@register_op("transpose")
+def transpose(ctx):
+    return jnp.transpose(ctx.input("X"), ctx.attr("axis"))
+
+
+@register_op("transpose2")
+def transpose2(ctx):
+    res = {"Out": jnp.transpose(ctx.input("X"), ctx.attr("axis"))}
+    if "XShape" in ctx.op.outputs:
+        res["XShape"] = jnp.zeros((0,) + ctx.input("X").shape,
+                                  dtype=jnp.float32)
+    return res
+
+
+@register_op("flatten")
+def flatten(ctx):
+    x = ctx.input("X")
+    ax = ctx.attr("axis", 1)
+    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    return jnp.reshape(x, (lead, -1))
+
+
+@register_op("flatten2")
+def flatten2(ctx):
+    x = ctx.input("X")
+    ax = ctx.attr("axis", 1)
+    lead = int(np.prod(x.shape[:ax])) if ax > 0 else 1
+    res = {"Out": jnp.reshape(x, (lead, -1))}
+    if "XShape" in ctx.op.outputs:
+        res["XShape"] = jnp.zeros((0,) + x.shape, dtype=jnp.float32)
+    return res
+
+
+@register_op("squeeze")
+def squeeze(ctx):
+    x = ctx.input("X")
+    axes = ctx.attr("axes", [])
+    if axes:
+        return jnp.squeeze(x, axis=tuple(a for a in axes
+                                         if x.shape[a] == 1))
+    return jnp.squeeze(x)
+
+
+@register_op("squeeze2")
+def squeeze2(ctx):
+    x = ctx.input("X")
+    axes = ctx.attr("axes", [])
+    if axes:
+        out = jnp.squeeze(x, axis=tuple(a for a in axes if x.shape[a] == 1))
+    else:
+        out = jnp.squeeze(x)
+    res = {"Out": out}
+    if "XShape" in ctx.op.outputs:
+        res["XShape"] = jnp.zeros((0,) + x.shape, dtype=jnp.float32)
+    return res
+
+
+@register_op("unsqueeze")
+def unsqueeze(ctx):
+    x = ctx.input("X")
+    for a in sorted(ctx.attr("axes")):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+@register_op("unsqueeze2")
+def unsqueeze2(ctx):
+    x = ctx.input("X")
+    orig = x
+    for a in sorted(ctx.attr("axes")):
+        x = jnp.expand_dims(x, a)
+    res = {"Out": x}
+    if "XShape" in ctx.op.outputs:
+        res["XShape"] = jnp.zeros((0,) + orig.shape, dtype=jnp.float32)
+    return res
+
+
+@register_op("concat")
+def concat(ctx):
+    xs = ctx.inputs("X")
+    return jnp.concatenate(xs, axis=ctx.attr("axis", 0))
+
+
+@register_op("split")
+def split(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections", [])
+    if num:
+        return {"Out": list(jnp.split(x, num, axis=axis))}
+    idx = np.cumsum(sections)[:-1]
+    return {"Out": list(jnp.split(x, idx, axis=axis))}
+
+
+@register_op("stack")
+def stack(ctx):
+    return jnp.stack(ctx.inputs("X"), axis=ctx.attr("axis", 0))
+
+
+@register_op("unstack")
+def unstack(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis=axis)
+                  for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("sum")
+def sum_op(ctx):
+    xs = [x for x in ctx.inputs("X") if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register_op("gather", stop_gradient_slots=("Index",))
+def gather(ctx):
+    return jnp.take(ctx.input("X"), ctx.input("Index").astype(jnp.int32),
+                    axis=0)
+
+
+@register_op("gather_nd", stop_gradient_slots=("Index",))
+def gather_nd(ctx):
+    x = ctx.input("X")
+    idx = ctx.input("Index").astype(jnp.int32)
+    return x[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+@register_op("scatter", stop_gradient_slots=("Ids",))
+def scatter(ctx):
+    x = ctx.input("X")
+    ids = ctx.input("Ids").astype(jnp.int32).reshape(-1)
+    upd = ctx.input("Updates")
+    if ctx.attr("overwrite", True):
+        return x.at[ids].set(upd)
+    return x.at[ids].add(upd)
+
+
+@register_op("slice")
+def slice_op(ctx):
+    x = ctx.input("Input")
+    axes = ctx.attr("axes")
+    starts = ctx.attr("starts")
+    ends = ctx.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return x[tuple(idx)]
+
+
+@register_op("strided_slice")
+def strided_slice(ctx):
+    x = ctx.input("Input")
+    axes, starts = ctx.attr("axes"), ctx.attr("starts")
+    ends, strides = ctx.attr("ends"), ctx.attr("strides")
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return x[tuple(idx)]
+
+
+@register_op("expand")
+def expand(ctx):
+    x = ctx.input("X")
+    times = ctx.attr("expand_times")
+    return jnp.tile(x, times)
+
+
+@register_op("expand_as")
+def expand_as(ctx):
+    x = ctx.input("X")
+    target = ctx.input("target_tensor")
+    times = [t // s for t, s in zip(target.shape, x.shape)]
+    return jnp.tile(x, times)
+
+
+@register_op("tile")
+def tile(ctx):
+    return jnp.tile(ctx.input("X"), ctx.attr("repeat_times"))
+
+
+@register_op("pad")
+def pad(ctx):
+    x = ctx.input("X")
+    paddings = ctx.attr("paddings")
+    pw = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return jnp.pad(x, pw, constant_values=ctx.attr("pad_value", 0.0))
+
+
+@register_op("pad2d")
+def pad2d(ctx):
+    x = ctx.input("X")
+    p = ctx.attr("paddings")  # [top, bottom, left, right]
+    mode = ctx.attr("mode", "constant")
+    fmt = ctx.attr("data_format", "NCHW")
+    if fmt == "NCHW":
+        pw = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pw = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == "constant":
+        return jnp.pad(x, pw, constant_values=ctx.attr("pad_value", 0.0))
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return jnp.pad(x, pw, mode=jmode)
+
+
+@register_op("crop")
+def crop(ctx):
+    x = ctx.input("X")
+    offsets = ctx.attr("offsets")
+    shape = ctx.attr("shape")
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+@register_op("one_hot", differentiable=False)
+def one_hot(ctx):
+    x = ctx.input("X").astype(jnp.int32)
+    depth = ctx.attr("depth")
+    if x.ndim >= 2 and x.shape[-1] == 1:
+        x = x[..., 0]
+    return jax.nn.one_hot(x, depth, dtype=jnp.float32)
+
+
+@register_op("range", differentiable=False)
+def range_op(ctx):
+    start = ctx.input("Start")
+    end = ctx.input("End")
+    step = ctx.input("Step")
+    # static-shape requirement: bounds must be attrs under jit when traced;
+    # support concrete host-side values.
+    return jnp.arange(float(start), float(end), float(step))
+
+
+@register_op("top_k", differentiable=False)
+def top_k(ctx):
+    x = ctx.input("X")
+    k = ctx.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int32)}
+
+
+@register_op("arg_max", differentiable=False)
+def arg_max(ctx):
+    return jnp.argmax(ctx.input("X"),
+                      axis=ctx.attr("axis", -1)).astype(jnp.int32)
+
+
+@register_op("arg_min", differentiable=False)
+def arg_min(ctx):
+    return jnp.argmin(ctx.input("X"),
+                      axis=ctx.attr("axis", -1)).astype(jnp.int32)
+
+
+@register_op("argsort", differentiable=False)
+def argsort(ctx):
+    x = ctx.input("X")
+    ax = ctx.attr("axis", -1)
+    idx = jnp.argsort(x, axis=ax)
+    return {"Out": jnp.sort(x, axis=ax), "Indices": idx.astype(jnp.int32)}
+
+
+@register_op("where", stop_gradient_slots=("Condition",))
+def where_op(ctx):
+    return jnp.where(ctx.input("Condition"), ctx.input("X"),
+                     ctx.input("Y"))
+
+
+@register_op("uniform_random_batch_size_like", differentiable=False,
+             needs_rng=True)
+def uniform_random_batch_size_like(ctx):
+    ref = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    bidx = ctx.attr("input_dim_idx", 0)
+    oidx = ctx.attr("output_dim_idx", 0)
+    shape[oidx] = ref.shape[bidx]
+    lo, hi = ctx.attr("min", -1.0), ctx.attr("max", 1.0)
+    return jax.random.uniform(_seeded_key(ctx), shape, jnp.float32, lo, hi)
+
+
+@register_op("fill_constant_batch_size_like", differentiable=False)
+def fill_constant_batch_size_like(ctx):
+    ref = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    bidx = ctx.attr("input_dim_idx", 0)
+    oidx = ctx.attr("output_dim_idx", 0)
+    shape[oidx] = ref.shape[bidx]
+    dtype = to_jnp_dtype(ctx.attr("dtype", "float32"))
+    return jnp.full(shape, ctx.attr("value", 0.0), dtype=dtype)
+
+
+@register_op("increment")
+def increment(ctx):
+    return ctx.input("X") + ctx.attr("step", 1.0)
+
+
+@register_op("clip")
+def clip(ctx):
+    return jnp.clip(ctx.input("X"), ctx.attr("min"), ctx.attr("max"))
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ctx):
+    x = ctx.input("X")
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    return jnp.where(norm > max_norm, x * (max_norm / norm), x)
+
+
+@register_op("isfinite", differentiable=False)
+def isfinite(ctx):
+    xs = ctx.inputs("X")
+    ok = jnp.array(True)
+    for x in xs:
+        ok = ok & jnp.all(jnp.isfinite(x))
+    return ok
+
+
+@register_op("reverse")
+def reverse(ctx):
+    x = ctx.input("X")
+    for a in ctx.attr("axis"):
+        x = jnp.flip(x, axis=a)
+    return x
+
+
+@register_op("assign_value", differentiable=False)
+def assign_value(ctx):
+    vals = np.asarray(ctx.attr("values"))
+    dtype = to_jnp_dtype(ctx.attr("dtype", "float32"))
+    shape = ctx.attr("shape", list(vals.shape))
+    return jnp.asarray(vals, dtype=dtype).reshape(shape)

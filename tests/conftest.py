@@ -1,0 +1,36 @@
+"""Test config: run on a virtual 8-device CPU mesh (multi-chip sharding
+tests execute without TPU hardware, per the reference's localhost-
+subprocess dist-test strategy, test_dist_base.py)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the TPU-tunnel plugin (axon sitecustomize) force-selects its platform
+# via jax.config; an explicit config update wins and keeps unit tests on
+# the virtual 8-device CPU mesh (single real chip stays free for bench).
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    """Each test gets fresh default programs/scope/name counters."""
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.core import program as prog_mod
+
+    prog_mod._main_program = fluid.Program()
+    prog_mod._startup_program = fluid.Program()
+    fluid._reset_global_scope()
+    unique_name.switch()
+    np.random.seed(90)
+    fluid.seed(90)
+    yield

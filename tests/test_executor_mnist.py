@@ -1,0 +1,173 @@
+"""End-to-end training tests: the minimum slice (BASELINE config 1) plus
+executor behaviours (reference tests/book/test_recognize_digits.py +
+test_executor_* patterns)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _mnist_fc_program():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[784], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        hidden = fluid.layers.fc(img, 64, act="relu")
+        logits = fluid.layers.fc(hidden, 10)
+        loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+        avg_loss = fluid.layers.mean(loss)
+    return main, startup, avg_loss
+
+
+def _synthetic_batch(rng, n=64):
+    """Linearly separable 'digits': class pattern + noise."""
+    y = rng.randint(0, 10, (n, 1)).astype("int64")
+    x = rng.rand(n, 784).astype("float32") * 0.3
+    for i in range(n):
+        c = int(y[i, 0])
+        x[i, c * 78:(c + 1) * 78] += 1.0
+    return x, y
+
+
+def test_mnist_fc_sgd_converges():
+    main, startup, avg_loss = _mnist_fc_program()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(avg_loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(7)
+    losses = []
+    for _ in range(60):
+        x, y = _synthetic_batch(rng)
+        (out,) = exe.run(main, feed={"img": x, "label": y},
+                         fetch_list=[avg_loss])
+        losses.append(float(np.asarray(out).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.8, losses[:5] + losses[-5:]
+
+
+def test_mnist_fc_adam_converges():
+    main, startup, avg_loss = _mnist_fc_program()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(7)
+    losses = []
+    for _ in range(60):
+        x, y = _synthetic_batch(rng)
+        (out,) = exe.run(main, feed={"img": x, "label": y},
+                         fetch_list=[avg_loss])
+        losses.append(float(np.asarray(out).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_momentum_and_weight_decay():
+    main, startup, avg_loss = _mnist_fc_program()
+    with fluid.program_guard(main, startup):
+        opt = fluid.optimizer.Momentum(
+            learning_rate=0.1, momentum=0.9,
+            regularization=fluid.regularizer.L2Decay(1e-4))
+        opt.minimize(avg_loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(3)
+    first = last = None
+    for i in range(40):
+        x, y = _synthetic_batch(rng)
+        (out,) = exe.run(main, feed={"img": x, "label": y},
+                         fetch_list=[avg_loss])
+        if i == 0:
+            first = float(np.asarray(out).reshape(-1)[0])
+        last = float(np.asarray(out).reshape(-1)[0])
+    assert last < first
+
+
+def test_fetch_without_feed_reads_scope():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_parameter([3, 3], "float32")
+    exe = fluid.Executor()
+    exe.run(startup)
+    (val,) = exe.run(main, fetch_list=[w])
+    assert val.shape == (3, 3)
+
+
+def test_uninitialized_run_raises():
+    main, startup, avg_loss = _mnist_fc_program()
+    exe = fluid.Executor()
+    rng = np.random.RandomState(0)
+    x, y = _synthetic_batch(rng, 8)
+    with pytest.raises(RuntimeError, match="initialization"):
+        exe.run(main, feed={"img": x, "label": y},
+                fetch_list=[avg_loss])
+
+
+def test_program_clone_for_test_freezes_dropout():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[16], dtype="float32")
+        h = fluid.layers.dropout(img, 0.5)
+        out = fluid.layers.fc(h, 4)
+    test_prog = main.clone(for_test=True)
+    drop_ops = [op for op in test_prog.global_block.ops
+                if op.type == "dropout"]
+    assert drop_ops and all(op.attrs["is_test"] for op in drop_ops)
+    # original program untouched
+    drop_ops = [op for op in main.global_block.ops
+                if op.type == "dropout"]
+    assert all(not op.attrs["is_test"] for op in drop_ops)
+
+
+def test_batch_norm_updates_running_stats():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8], dtype="float32")
+        out = fluid.layers.batch_norm(img)
+        loss = fluid.layers.mean(out)
+    exe = fluid.Executor()
+    exe.run(startup)
+    bn_mean_name = [v.name for v in main.global_block.vars.values()
+                    if "batch_norm" in v.name and v.persistable][0]
+    scope = fluid.global_scope()
+    x = np.random.RandomState(0).rand(4, 3, 8, 8).astype("float32") + 5.0
+    exe.run(main, feed={"img": x}, fetch_list=[loss])
+    mean_names = [n for n in main.global_block.vars
+                  if n.endswith("global_0")]
+    # running mean must have moved off zero after one train step
+    moved = False
+    for v in main.global_block.vars.values():
+        if v.persistable and v.shape == (3,):
+            val = np.asarray(scope._get(v.name))
+            if val is not None and np.abs(val).max() > 1e-3:
+                moved = True
+    assert moved
+
+
+def test_gradient_accumulation_shared_param():
+    """A param used twice must receive the SUM of both grads
+    (backward.py dedup path, reference _addup_repetitive_outputs_)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        w = fluid.layers.create_parameter(
+            [4, 4], "float32", attr=fluid.ParamAttr(name="w_sh"))
+        a = fluid.layers.mul(x, w)
+        b = fluid.layers.mul(x, w)
+        y = fluid.layers.elementwise_add(a, b)
+        loss = fluid.layers.mean(y)
+        from paddle_tpu.backward import append_backward
+
+        pg = append_backward(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    xv = np.ones((2, 4), dtype="float32")
+    grad_name = [g.name for p, g in pg if p.name == "w_sh"][0]
+    (gw,) = exe.run(main, feed={"x": xv}, fetch_list=[grad_name])
+    # d/dw mean(2 * x@w) = 2 * x^T @ ones / (2*4)
+    expect = 2 * xv.T @ np.ones((2, 4), "float32") / 8.0
+    np.testing.assert_allclose(gw, expect, rtol=1e-5)

@@ -1,0 +1,318 @@
+"""Per-op numeric tests (reference test_*_op.py pattern, SURVEY.md §4.1)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestMulOp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "mul"
+        x = np.random.random((8, 12)).astype("float32")
+        y = np.random.random((12, 7)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestMatmulTranspose(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "matmul"
+        x = np.random.random((3, 5, 4)).astype("float32")
+        y = np.random.random((3, 6, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_X": False, "transpose_Y": True,
+                      "alpha": 2.0}
+        self.outputs = {"Out": 2.0 * np.einsum("bik,bjk->bij", x, y)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out", max_relative_error=0.01)
+
+
+class TestElementwiseAddAxisBroadcast(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "elementwise_add"
+        x = np.random.random((2, 3, 4, 5)).astype("float32")
+        y = np.random.random((3,)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "softmax_with_cross_entropy"
+        logits = np.random.random((10, 6)).astype("float32")
+        label = np.random.randint(0, 6, (10, 1)).astype("int64")
+        sm = np.exp(logits - logits.max(-1, keepdims=True))
+        sm /= sm.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(10), label[:, 0]])[:, None]
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Loss": loss, "Softmax": sm}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Logits"], "Loss", max_relative_error=0.01)
+
+
+class TestReduceMean(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "reduce_mean"
+        x = np.random.random((4, 5, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1], "keep_dim": False,
+                      "reduce_all": False}
+        self.outputs = {"Out": x.mean(axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestConv2D(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "conv2d"
+        x = np.random.random((2, 3, 8, 8)).astype("float32")
+        w = np.random.random((4, 3, 3, 3)).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        import jax
+
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        self.outputs = {"Output": np.asarray(ref)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestLayerNorm(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "layer_norm"
+        x = np.random.random((4, 10)).astype("float32")
+        scale = np.random.random((10,)).astype("float32")
+        bias = np.random.random((10,)).astype("float32")
+        mean = x.mean(axis=1, keepdims=True)
+        var = x.var(axis=1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": 1e-5, "begin_norm_axis": 1}
+        self.outputs = {"Y": y, "Mean": mean.reshape(4),
+                        "Variance": var.reshape(4)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Scale", "Bias"], "Y",
+                        max_relative_error=0.02)
+
+
+class TestLookupTable(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "lookup_table"
+        w = np.random.random((17, 8)).astype("float32")
+        ids = np.random.randint(0, 17, (5, 1)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {"padding_idx": -1}
+        self.outputs = {"Out": w[ids[:, 0]]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["W"], "Out")
+
+
+class TestBatchNormTrain(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "batch_norm"
+        x = np.random.random((4, 3, 5, 5)).astype("float32")
+        scale = np.random.random(3).astype("float32")
+        bias = np.random.random(3).astype("float32")
+        mean_in = np.zeros(3, "float32")
+        var_in = np.ones(3, "float32")
+        eps, mom = 1e-5, 0.9
+        m = x.mean(axis=(0, 2, 3))
+        v = x.var(axis=(0, 2, 3))
+        y = ((x - m.reshape(1, 3, 1, 1))
+             / np.sqrt(v.reshape(1, 3, 1, 1) + eps)
+             * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1))
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean_in, "Variance": var_in}
+        self.attrs = {"epsilon": eps, "momentum": mom, "is_test": False}
+        self.outputs = {
+            "Y": y,
+            "MeanOut": mean_in * mom + m * (1 - mom),
+            "VarianceOut": var_in * mom + v * (1 - mom),
+            "SavedMean": m,
+            "SavedVariance": 1.0 / np.sqrt(v + eps),
+        }
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestDropoutTestMode(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "dropout"
+        x = np.random.random((4, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dropout_prob": 0.35, "is_test": True,
+                      "dropout_implementation": "downgrade_in_infer"}
+        self.outputs = {"Out": x * 0.65, "Mask": np.ones_like(x)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSgdOp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "sgd"
+        p = np.random.random((5, 3)).astype("float32")
+        g = np.random.random((5, 3)).astype("float32")
+        lr = np.array([0.1], dtype="float32")
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAdamOp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "adam"
+        p = np.random.random((4, 2)).astype("float32")
+        g = np.random.random((4, 2)).astype("float32")
+        m1 = np.random.random((4, 2)).astype("float32")
+        m2 = np.random.random((4, 2)).astype("float32")
+        lr = np.array([0.01], "float32")
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        b1p = np.array([b1 ** 3], "float32")
+        b2p = np.array([b2 ** 3], "float32")
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g * g
+        lr_t = 0.01 * np.sqrt(1 - b2p) / (1 - b1p)
+        po = p - lr_t * m1o / (np.sqrt(m2o) + eps)
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1,
+                       "Moment2": m2, "LearningRate": lr,
+                       "Beta1Pow": b1p, "Beta2Pow": b2p}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+        self.outputs = {"ParamOut": po, "Moment1Out": m1o,
+                        "Moment2Out": m2o}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestActivations(OpTest):
+    def _one(self, op_type, ref, grad=True, x=None):
+        self.op_type = op_type
+        x = x if x is not None else \
+            (np.random.random((4, 7)).astype("float32") + 0.1)
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": ref(x)}
+        self.check_output(atol=1e-5)
+        if grad:
+            self.check_grad(["X"], "Out", max_relative_error=0.01)
+
+    def test_relu(self):
+        x = np.random.uniform(-1, 1, (4, 7)).astype("float32")
+        x[np.abs(x) < 0.05] = 0.2  # avoid kink for fd check
+        self._one("relu", lambda v: np.maximum(v, 0), x=x)
+
+    def test_sigmoid(self):
+        self._one("sigmoid", lambda v: 1 / (1 + np.exp(-v)))
+
+    def test_tanh(self):
+        self._one("tanh", np.tanh)
+
+    def test_exp(self):
+        self._one("exp", np.exp)
+
+    def test_sqrt(self):
+        self._one("sqrt", np.sqrt)
+
+    def test_square(self):
+        self._one("square", np.square)
+
+
+class TestTensorManip(OpTest):
+    def test_concat(self):
+        self.op_type = "concat"
+        a = np.random.random((2, 3)).astype("float32")
+        b = np.random.random((2, 5)).astype("float32")
+        self.inputs = {"X": [("x0", a), ("x1", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+        self.check_output()
+
+    def test_split(self):
+        self.op_type = "split"
+        x = np.random.random((4, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"num": 3, "sections": [], "axis": 1}
+        parts = np.split(x, 3, axis=1)
+        self.outputs = {"Out": [(f"out{i}", p)
+                                for i, p in enumerate(parts)]}
+        self.check_output()
+
+    def test_transpose(self):
+        self.op_type = "transpose"
+        x = np.random.random((2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [2, 0, 1]}
+        self.outputs = {"Out": x.transpose(2, 0, 1)}
+        self.check_output()
+
+    def test_reshape(self):
+        self.op_type = "reshape"
+        x = np.random.random((2, 12)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [0, 3, 4]}
+        self.outputs = {"Out": x.reshape(2, 3, 4)}
+        self.check_output()
+
+    def test_topk(self):
+        self.op_type = "top_k"
+        x = np.random.random((3, 9)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"k": 2}
+        idx = np.argsort(-x, axis=1)[:, :2]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.outputs = {"Out": vals,
+                        "Indices": idx.astype("int32")}
+        self.check_output()
