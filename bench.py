@@ -1,0 +1,70 @@
+"""Benchmark: Transformer-base training throughput (tokens/sec/chip).
+
+Mirrors the reference harness semantics (reference benchmark/fluid/
+fluid_benchmark.py:296-300: examples/sec = num_samples / elapsed) on the
+flagship BASELINE.md config 3 workload (Transformer base: d_model=512,
+8 heads, 6+6 layers, ffn 2048, Adam). Runs on whatever accelerator jax
+exposes (the driver provides one real TPU chip).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline: measured tokens/sec/chip vs the BASELINE.json north-star
+per-chip target (v5e-16 pod >= 1x H100 => H100-equivalent 100k tok/s
+/ 16 chips = 6250 tok/s/chip).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+PER_CHIP_TARGET_TOKENS_PER_SEC = 6250.0
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as T
+
+    seq, batch = 128, 16
+    steps, warmup = 10, 3
+
+    main_prog, startup, cost = T.build_program(
+        seq_len=seq, d_model=512, n_heads=8, n_layers=6, d_inner=2048,
+        vocab=32000, dropout_rate=0.0, with_optimizer=True,
+        learning_rate=2.0, warmup_steps=4000)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    feed = {
+        "src_ids": r.randint(0, 32000, (batch, seq)).astype(np.int64),
+        "tgt_ids": r.randint(0, 32000, (batch, seq)).astype(np.int64),
+        "label": r.randint(0, 32000, (batch, seq)).astype(np.int64),
+    }
+    for _ in range(warmup):
+        out = exe.run(main_prog, feed=feed, fetch_list=[cost])
+    loss0 = float(np.asarray(out[0]).reshape(-1)[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = exe.run(main_prog, feed=feed, fetch_list=[cost])
+    # fetch forces sync (numpy conversion)
+    elapsed = time.perf_counter() - t0
+    loss1 = float(np.asarray(out[0]).reshape(-1)[0])
+    tokens_per_sec = steps * batch * seq / elapsed
+    result = {
+        "metric": "transformer_base_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(
+            tokens_per_sec / PER_CHIP_TARGET_TOKENS_PER_SEC, 3),
+    }
+    print(json.dumps(result))
+    print(f"# device={jax.devices()[0].device_kind} "
+          f"loss {loss0:.4f}->{loss1:.4f} elapsed {elapsed:.2f}s",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -365,7 +365,10 @@ class Program:
         """Deep structural copy (reference Program.clone framework.py:3059).
 
         for_test=True switches is_test-style attrs (dropout/batch_norm) to
-        inference behaviour, mirroring the reference's test-program cloning.
+        inference behaviour, mirroring the reference's test-program
+        cloning -- and additionally prunes backward/optimize-role ops, so
+        cloning AFTER minimize() still yields a pure eval program (the
+        reference requires cloning before append_backward).
         """
         p = Program()
         p.blocks = []
@@ -378,6 +381,9 @@ class Program:
                 nv.block = nb
                 nb.vars[name] = nv
             for op in blk.ops:
+                if for_test and op.attrs.get("op_role") in (
+                        "backward", "optimize"):
+                    continue
                 attrs = dict(op.attrs)
                 if for_test and "is_test" in attrs:
                     attrs["is_test"] = True

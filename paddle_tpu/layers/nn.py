@@ -1291,3 +1291,20 @@ def _triple(v):
     if isinstance(v, (list, tuple)):
         return list(v)
     return [v, v, v]
+
+
+def attention(q, k, v, causal=False, scale=None, dropout_rate=0.0,
+              is_test=False, name=None):
+    """Fused scaled-dot-product attention over [B,H,T,D] heads -- the
+    framework's flash-attention entry point (Pallas kernel on TPU)."""
+    helper = LayerHelper("attention", input=q, name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op("attention", {"Q": q, "K": k, "V": v},
+                     {"Out": out},
+                     {"causal": causal, "scale": scale,
+                      "dropout_rate": dropout_rate,
+                      "is_test": is_test})
+    return out
+
+
+__all__.append("attention")

@@ -18,7 +18,8 @@ import numpy as np
 
 from ..layer_helper import LayerHelper
 
-__all__ = ["sequence_conv", "sequence_pool", "sequence_softmax",
+__all__ = ["bind_seq_len",
+           "sequence_conv", "sequence_pool", "sequence_softmax",
            "sequence_expand", "sequence_concat", "sequence_first_step",
            "sequence_last_step", "sequence_reshape", "sequence_pad",
            "sequence_unpad", "sequence_reverse", "sequence_slice",
@@ -36,6 +37,23 @@ def seq_len_of(x):
         return block.var(name)
     return block.create_var(name=name, shape=(-1,), dtype="int32",
                             is_data=True, stop_gradient=True)
+
+
+def bind_seq_len(dst_var, src_var):
+    """Propagate/declare the @SEQ_LEN companion from src to dst -- THE
+    public contract for keeping padded-batch lengths attached as data
+    flows through batch-preserving layers (fc over time, embedding...).
+    Declares src's companion as a data var if it doesn't exist yet."""
+    blk = dst_var.block
+    src = src_var.name + SEQ_LEN_SUFFIX
+    if not blk.has_var(src):
+        blk.create_var(name=src, shape=(-1,), dtype="int32",
+                       is_data=True, stop_gradient=True)
+    dst = dst_var.name + SEQ_LEN_SUFFIX
+    blk.append_op("assign", {"X": src}, {"Out": dst}, {})
+    blk.create_var(name=dst, shape=(-1,), dtype="int32",
+                   stop_gradient=True)
+    return dst_var
 
 
 def _bind_len(helper, out, x):

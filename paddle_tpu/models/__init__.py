@@ -5,4 +5,9 @@ tests/unittests/dist_transformer.py; + CTR from dist_ctr.py)."""
 from . import mnist  # noqa: F401
 from . import resnet  # noqa: F401
 from . import vgg  # noqa: F401
+from . import se_resnext  # noqa: F401
 from . import transformer  # noqa: F401
+from . import stacked_dynamic_lstm  # noqa: F401
+from . import ctr  # noqa: F401
+from . import word2vec  # noqa: F401
+from . import machine_translation  # noqa: F401

@@ -1,0 +1,53 @@
+"""CTR model with high-dim sparse embeddings (reference
+python/paddle/fluid/tests/unittests/dist_ctr.py + ctr_dataset_reader:
+sparse id features -> embedding + sequence pooling -> fc tower -> ctc
+binary softmax). BASELINE.md config 5's sparse/embedding path.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..layers.sequence import bind_seq_len
+
+
+def ctr_dnn_model(dnn_ids, lr_ids, label, dnn_dict_dim=10001,
+                  lr_dict_dim=10001, embedding_size=10,
+                  layer_dims=(128, 64, 32, 1)):
+    """dnn_ids/lr_ids: [B, T] int64 padded sparse features."""
+    dnn_embedding = layers.embedding(
+        dnn_ids, size=[dnn_dict_dim, embedding_size])
+    bind_seq_len(dnn_embedding, dnn_ids)
+    dnn_pool = layers.sequence_pool(dnn_embedding, "sum")
+    dnn_out = dnn_pool
+    for dim in layer_dims:
+        dnn_out = layers.fc(dnn_out, dim, act="relu")
+    lr_embedding = layers.embedding(lr_ids, size=[lr_dict_dim, 1])
+    bind_seq_len(lr_embedding, lr_ids)
+    lr_pool = layers.sequence_pool(lr_embedding, "sum")
+    merge = layers.concat([dnn_out, lr_pool], axis=1)
+    logits = layers.fc(merge, 2)
+    predict = layers.softmax(logits)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(predict, label)
+    auc_var, _ = layers.auc(predict, label)
+    return loss, acc, auc_var, predict
+
+
+def build_program(dnn_dict_dim=10001, lr_dict_dim=10001, lr=0.0001,
+                  with_optimizer=True):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        dnn_ids = layers.data("dnn_data", shape=[-1], dtype="int64",
+                              lod_level=1, append_batch_size=False)
+        dnn_ids.shape = (-1, -1)
+        lr_ids = layers.data("lr_data", shape=[-1], dtype="int64",
+                             lod_level=1, append_batch_size=False)
+        lr_ids.shape = (-1, -1)
+        label = layers.data("click", shape=[1], dtype="int64")
+        loss, acc, auc_var, predict = ctr_dnn_model(
+            dnn_ids, lr_ids, label, dnn_dict_dim, lr_dict_dim)
+        if with_optimizer:
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, loss, auc_var

@@ -1,0 +1,56 @@
+"""Seq2seq encoder-decoder NMT (reference benchmark/fluid/
+machine_translation.py / tests/book/test_machine_translation.py:
+GRU encoder -> attention-free decoder with teacher forcing)."""
+from __future__ import annotations
+
+from .. import layers
+from ..layers.sequence import bind_seq_len
+
+
+def seq_to_seq_net(src_ids, tgt_ids, label, src_dict_dim, tgt_dict_dim,
+                   embedding_dim=512, encoder_size=512,
+                   decoder_size=512):
+    src_emb = layers.embedding(src_ids,
+                               size=[src_dict_dim, embedding_dim])
+    bind_seq_len(src_emb, src_ids)
+    enc_proj = layers.fc(src_emb, encoder_size * 3, num_flatten_dims=2)
+    bind_seq_len(enc_proj, src_emb)
+    enc = layers.dynamic_gru(enc_proj, encoder_size)
+    enc_last = layers.sequence_pool(enc, "last")
+
+    tgt_emb = layers.embedding(tgt_ids,
+                               size=[tgt_dict_dim, embedding_dim])
+    bind_seq_len(tgt_emb, tgt_ids)
+    dec_proj = layers.fc(tgt_emb, decoder_size * 3, num_flatten_dims=2)
+    bind_seq_len(dec_proj, tgt_emb)
+    dec_init = layers.fc(enc_last, decoder_size, act="tanh")
+    dec = layers.dynamic_gru(dec_proj, decoder_size, h_0=dec_init)
+    logits = layers.fc(dec, tgt_dict_dim, num_flatten_dims=2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        logits, layers.unsqueeze(label, [2])))
+    return loss, logits
+
+
+def build_program(src_dict_dim=10000, tgt_dict_dim=10000, lr=0.0002,
+                  with_optimizer=True):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_word_id", shape=[-1], dtype="int64",
+                          lod_level=1, append_batch_size=False)
+        src.shape = (-1, -1)
+        tgt = layers.data("target_language_word", shape=[-1],
+                          dtype="int64", lod_level=1,
+                          append_batch_size=False)
+        tgt.shape = (-1, -1)
+        label = layers.data("target_language_next_word", shape=[-1],
+                            dtype="int64", lod_level=1,
+                            append_batch_size=False)
+        label.shape = (-1, -1)
+        loss, logits = seq_to_seq_net(src, tgt, label, src_dict_dim,
+                                      tgt_dict_dim)
+        if with_optimizer:
+            fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, loss

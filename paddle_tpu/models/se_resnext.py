@@ -1,0 +1,74 @@
+"""SE-ResNeXt-50 (reference benchmark/fluid/models/se_resnext.py)."""
+from __future__ import annotations
+
+from .. import layers
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None, is_test=False):
+    conv = layers.conv2d(input, num_filters, filter_size, stride=stride,
+                         padding=(filter_size - 1) // 2, groups=groups,
+                         bias_attr=False)
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def squeeze_excitation(input, num_channels, reduction_ratio=16):
+    pool = layers.pool2d(input, pool_type="avg", global_pooling=True)
+    squeeze = layers.fc(pool, num_channels // reduction_ratio,
+                        act="relu")
+    excitation = layers.fc(squeeze, num_channels, act="sigmoid")
+    return layers.elementwise_mul(input, excitation, axis=0)
+
+
+def shortcut(input, ch_out, stride, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, is_test=is_test)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, cardinality=32,
+                     reduction_ratio=16, is_test=False):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu",
+                          is_test=is_test)
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride,
+                          groups=cardinality, act="relu",
+                          is_test=is_test)
+    conv2 = conv_bn_layer(conv1, num_filters * 2, 1, is_test=is_test)
+    se = squeeze_excitation(conv2, num_filters * 2, reduction_ratio)
+    short = shortcut(input, num_filters * 2, stride, is_test=is_test)
+    return layers.relu(layers.elementwise_add(short, se))
+
+
+def se_resnext50(input, class_dim=1000, is_test=False):
+    conv = conv_bn_layer(input, 64, 7, stride=2, act="relu",
+                         is_test=is_test)
+    pool = layers.pool2d(conv, pool_size=3, pool_stride=2,
+                         pool_padding=1, pool_type="max")
+    for filters, count, stride0 in ((128, 3, 1), (256, 4, 2),
+                                    (512, 6, 2), (1024, 3, 2)):
+        for i in range(count):
+            pool = bottleneck_block(
+                pool, filters, stride0 if i == 0 else 1,
+                is_test=is_test)
+    pool = layers.pool2d(pool, pool_type="avg", global_pooling=True)
+    drop = layers.dropout(pool, 0.5, is_test=is_test)
+    return layers.fc(drop, class_dim)
+
+
+def build_program(class_dim=1000, image_shape=(3, 224, 224), lr=0.1,
+                  with_optimizer=True):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=list(image_shape),
+                          dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = se_resnext50(img, class_dim)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        if with_optimizer:
+            fluid.optimizer.Momentum(lr, momentum=0.9).minimize(loss)
+    return main, startup, loss

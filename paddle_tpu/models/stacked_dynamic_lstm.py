@@ -1,0 +1,54 @@
+"""Stacked dynamic LSTM for PTB/IMDB-style language tasks
+(reference benchmark/fluid/models/stacked_dynamic_lstm.py: embedding ->
+N x (fc + dynamic_lstm) -> sequence max-pool -> fc -> softmax).
+
+Variable-length sequences ride the padded+@SEQ_LEN representation
+(layers/sequence.py); the LSTM time loop is one lax.scan per layer
+(ops/rnn_ops.py), so the whole model is a single XLA program.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..layers.sequence import bind_seq_len
+
+
+def stacked_lstm_net(sent_ids, label, dict_dim, emb_dim=512,
+                     hid_dim=512, stacked_num=3, class_dim=2):
+    emb = layers.embedding(sent_ids, size=[dict_dim, emb_dim])
+    bind_seq_len(emb, sent_ids)
+
+    fc1 = layers.fc(emb, hid_dim, num_flatten_dims=2)
+    bind_seq_len(fc1, emb)
+    lstm1, _ = layers.dynamic_lstm(fc1, hid_dim, use_peepholes=False)
+    inputs = [fc1, lstm1]
+    for _ in range(2, stacked_num + 1):
+        fc = layers.fc(layers.concat(inputs, axis=2), hid_dim,
+                       num_flatten_dims=2)
+        bind_seq_len(fc, inputs[0])
+        lstm, _ = layers.dynamic_lstm(fc, hid_dim, use_peepholes=False)
+        inputs = [fc, lstm]
+    fc_last = layers.sequence_pool(inputs[0], "max")
+    lstm_last = layers.sequence_pool(inputs[1], "max")
+    logits = layers.fc([fc_last, lstm_last], class_dim)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+    acc = layers.accuracy(layers.softmax(logits), label)
+    return loss, acc
+
+
+def build_program(dict_dim=10000, emb_dim=512, hid_dim=512,
+                  stacked_num=3, class_dim=2, lr=0.002,
+                  with_optimizer=True):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        sent = layers.data("words", shape=[-1], dtype="int64",
+                           lod_level=1, append_batch_size=False)
+        sent.shape = (-1, -1)
+        label = layers.data("label", shape=[1], dtype="int64")
+        loss, acc = stacked_lstm_net(sent, label, dict_dim, emb_dim,
+                                     hid_dim, stacked_num, class_dim)
+        if with_optimizer:
+            fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, loss, acc

@@ -1,0 +1,156 @@
+"""Transformer base (reference python/paddle/fluid/tests/unittests/
+dist_transformer.py + the original benchmark config: WMT en-de base --
+d_model=512, 8 heads, 6+6 layers, ffn 2048, Adam + noam decay).
+
+Built entirely from the framework's own layers; attention goes through
+the flash-attention path (ops/pallas/attention.py) when enabled, else
+the jnp composition -- either way one XLA program per step with all
+matmuls on the MXU in bf16-friendly shapes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..initializer import NumpyArrayInitializer
+from ..param_attr import ParamAttr
+
+
+def _position_encoding(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype("float64")
+    dim = np.arange(0, d_model, 2).astype("float64")
+    angle = pos / np.power(10000.0, dim / d_model)
+    enc = np.zeros((max_len, d_model), dtype="float32")
+    enc[:, 0::2] = np.sin(angle)
+    enc[:, 1::2] = np.cos(angle)
+    return enc
+
+
+def multi_head_attention(q_in, kv_in, d_model, n_heads, dropout_rate,
+                         causal=False, is_test=False):
+    head_dim = d_model // n_heads
+    q = layers.fc(q_in, d_model, num_flatten_dims=2, bias_attr=False)
+    k = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False)
+    v = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False)
+
+    def split_heads(x):
+        x = layers.reshape(x, [0, 0, n_heads, head_dim])
+        return layers.transpose(x, [0, 2, 1, 3])
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    ctx = layers.attention(q, k, v, causal=causal,
+                           scale=head_dim ** -0.5,
+                           dropout_rate=0.0 if is_test else dropout_rate)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, d_model])
+    return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False)
+
+
+def _ffn(x, d_model, d_inner, dropout_rate, is_test):
+    h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu")
+    if dropout_rate and not is_test:
+        h = layers.dropout(h, dropout_rate,
+                           dropout_implementation="upscale_in_train")
+    return layers.fc(h, d_model, num_flatten_dims=2)
+
+
+def _add_norm(x, residual, dropout_rate, is_test):
+    if dropout_rate and not is_test:
+        x = layers.dropout(x, dropout_rate,
+                           dropout_implementation="upscale_in_train")
+    return layers.layer_norm(layers.elementwise_add(x, residual),
+                             begin_norm_axis=2)
+
+
+def encoder_layer(x, d_model, n_heads, d_inner, dropout_rate, is_test):
+    attn = multi_head_attention(x, x, d_model, n_heads, dropout_rate,
+                                is_test=is_test)
+    x = _add_norm(attn, x, dropout_rate, is_test)
+    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
+    return _add_norm(ffn, x, dropout_rate, is_test)
+
+
+def decoder_layer(x, enc_out, d_model, n_heads, d_inner, dropout_rate,
+                  is_test):
+    self_attn = multi_head_attention(x, x, d_model, n_heads,
+                                     dropout_rate, causal=True,
+                                     is_test=is_test)
+    x = _add_norm(self_attn, x, dropout_rate, is_test)
+    cross = multi_head_attention(x, enc_out, d_model, n_heads,
+                                 dropout_rate, is_test=is_test)
+    x = _add_norm(cross, x, dropout_rate, is_test)
+    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
+    return _add_norm(ffn, x, dropout_rate, is_test)
+
+
+def _embed(ids, vocab_size, d_model, max_len, dropout_rate, is_test,
+           emb_name):
+    emb = layers.embedding(
+        ids, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name=emb_name))
+    emb = layers.scale(emb, scale=d_model ** 0.5)
+    pos_table = _position_encoding(max_len, d_model)
+    seq_len = emb.shape[1] if emb.shape[1] and emb.shape[1] > 0 \
+        else max_len
+    pos = layers.assign(pos_table[:seq_len])
+    emb = layers.elementwise_add(emb, pos, axis=1)
+    if dropout_rate and not is_test:
+        emb = layers.dropout(emb, dropout_rate,
+                             dropout_implementation="upscale_in_train")
+    return emb
+
+
+def transformer(src_ids, tgt_ids, label, src_vocab=30000, tgt_vocab=30000,
+                max_len=256, d_model=512, n_heads=8, n_layers=6,
+                d_inner=2048, dropout_rate=0.1, is_test=False,
+                label_smooth_eps=0.1):
+    """Returns (avg_cost, logits). src_ids/tgt_ids: [B,T] int64;
+    label: [B,T] int64 (next-token targets)."""
+    enc = _embed(src_ids, src_vocab, d_model, max_len, dropout_rate,
+                 is_test, "src_word_emb")
+    for _ in range(n_layers):
+        enc = encoder_layer(enc, d_model, n_heads, d_inner,
+                            dropout_rate, is_test)
+    dec = _embed(tgt_ids, tgt_vocab, d_model, max_len, dropout_rate,
+                 is_test, "tgt_word_emb")
+    for _ in range(n_layers):
+        dec = decoder_layer(dec, enc, d_model, n_heads, d_inner,
+                            dropout_rate, is_test)
+    logits = layers.fc(dec, tgt_vocab, num_flatten_dims=2,
+                       bias_attr=False)
+    if label_smooth_eps:
+        onehot = layers.one_hot(label, tgt_vocab)
+        soft = layers.label_smooth(onehot, epsilon=label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(logits, soft,
+                                                 soft_label=True)
+    else:
+        cost = layers.softmax_with_cross_entropy(
+            logits, layers.unsqueeze(label, [2]))
+    avg_cost = layers.mean(cost)
+    return avg_cost, logits
+
+
+def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
+                  n_layers=6, d_inner=2048, vocab=30000,
+                  learning_rate=2.0, warmup_steps=4000,
+                  with_optimizer=True, dropout_rate=0.1):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
+        tgt = layers.data("tgt_ids", shape=[seq_len], dtype="int64")
+        label = layers.data("label", shape=[seq_len], dtype="int64")
+        avg_cost, logits = transformer(
+            src, tgt, label, src_vocab=vocab, tgt_vocab=vocab,
+            max_len=max(seq_len, 256), d_model=d_model, n_heads=n_heads,
+            n_layers=n_layers, d_inner=d_inner,
+            dropout_rate=dropout_rate)
+        if with_optimizer:
+            lr = layers.learning_rate_scheduler.noam_decay(
+                d_model, warmup_steps)
+            opt = fluid.optimizer.Adam(
+                learning_rate=lr, beta1=0.9, beta2=0.997, epsilon=1e-9)
+            opt.minimize(avg_cost)
+    return main, startup, avg_cost

@@ -1,0 +1,44 @@
+"""VGG-16 (reference benchmark/fluid/models/vgg.py vgg16_bn_drop)."""
+from __future__ import annotations
+
+from .. import layers, nets
+
+
+def vgg16_bn_drop(input, class_dim=1000, is_test=False):
+    def conv_block(ipt, num_filter, groups):
+        return nets.img_conv_group(
+            input=ipt, pool_size=2, pool_stride=2,
+            conv_num_filter=[num_filter] * groups, conv_filter_size=3,
+            conv_act="relu", conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=0.0, pool_type="max")
+
+    conv1 = conv_block(input, 64, 2)
+    conv2 = conv_block(conv1, 128, 2)
+    conv3 = conv_block(conv2, 256, 3)
+    conv4 = conv_block(conv3, 512, 3)
+    conv5 = conv_block(conv4, 512, 3)
+    drop = layers.dropout(conv5, 0.5, is_test=is_test)
+    fc1 = layers.fc(drop, 512)
+    bn = layers.batch_norm(fc1, act="relu", is_test=is_test,
+                           data_layout="NHWC")
+    drop2 = layers.dropout(bn, 0.5, is_test=is_test)
+    fc2 = layers.fc(drop2, 512)
+    return layers.fc(fc2, class_dim)
+
+
+def build_program(class_dim=10, image_shape=(3, 32, 32), lr=0.01,
+                  with_optimizer=True):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", shape=list(image_shape),
+                          dtype="float32")
+        label = layers.data("label", shape=[1], dtype="int64")
+        logits = vgg16_bn_drop(img, class_dim)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        if with_optimizer:
+            fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, loss

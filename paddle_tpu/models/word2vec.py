@@ -1,0 +1,38 @@
+"""Word2vec skip-gram-era N-gram LM (reference tests/book/test_word2vec.py:
+4-word context -> shared embeddings -> concat -> fc -> softmax)."""
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def ngram_lm(words, dict_size, embed_size=32, hidden_size=256):
+    """words: list of 4 context id vars + 1 target var."""
+    embs = []
+    for w in words[:-1]:
+        emb = layers.embedding(
+            w, size=[dict_size, embed_size],
+            param_attr=ParamAttr(name="shared_w"))
+        embs.append(emb)
+    concat = layers.concat(embs, axis=1)
+    hidden = layers.fc(concat, hidden_size, act="sigmoid")
+    logits = layers.fc(hidden, dict_size)
+    loss = layers.mean(
+        layers.softmax_with_cross_entropy(logits, words[-1]))
+    return loss, logits
+
+
+def build_program(dict_size=1500, embed_size=32, hidden_size=256,
+                  lr=0.001, with_optimizer=True):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        ws = [layers.data(n, shape=[1], dtype="int64")
+              for n in ("firstw", "secondw", "thirdw", "fourthw",
+                        "nextw")]
+        loss, logits = ngram_lm(ws, dict_size, embed_size, hidden_size)
+        if with_optimizer:
+            fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    return main, startup, loss

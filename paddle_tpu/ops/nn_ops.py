@@ -243,10 +243,18 @@ def layer_norm(ctx):
     begin = ctx.attr("begin_norm_axis", 1)
     lead = int(np.prod(x.shape[:begin]))
     x2 = x.reshape(lead, -1)
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
     mean = jnp.mean(x2, axis=1, keepdims=True)
     var = jnp.var(x2, axis=1, keepdims=True)
+    from .pallas import layer_norm as pallas_ln
+
+    if (scale is not None and bias is not None
+            and pallas_ln.usable(lead, x2.shape[1])):
+        y = pallas_ln.layer_norm(x2, scale.reshape(-1),
+                                 bias.reshape(-1), eps)
+        return {"Y": y.reshape(x.shape), "Mean": mean.reshape(lead),
+                "Variance": var.reshape(lead)}
     y = (x2 - mean) * jax.lax.rsqrt(var + eps)
-    scale, bias = ctx.input("Scale"), ctx.input("Bias")
     if scale is not None:
         y = y * scale.reshape(1, -1)
     if bias is not None:
@@ -682,3 +690,41 @@ def mean_iou(ctx):
     miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1.0)
     return {"OutMeanIou": miou.reshape(1), "OutWrong": union,
             "OutCorrect": inter}
+
+
+# --------------------------------------------------------------------------
+# fused scaled-dot-product attention -- the framework-level attention op.
+# Routes to the Pallas flash-attention kernel on TPU for supported shapes
+# (ops/pallas/attention.py); falls back to the jnp composition (which XLA
+# still fuses well). The reference has no fused attention op -- attention
+# exists only as a layer composition (reference nets.py
+# scaled_dot_product_attention) -- so this op is a TPU-first upgrade.
+# --------------------------------------------------------------------------
+@register_op("attention", needs_rng=True)
+def attention(ctx):
+    q = ctx.input("Q")  # B,H,T,D
+    k = ctx.input("K")
+    v = ctx.input("V")
+    scale = ctx.attr("scale", None)
+    causal = ctx.attr("causal", False)
+    dropout_rate = ctx.attr("dropout_rate", 0.0)
+    if ctx.attr("is_test", False):
+        dropout_rate = 0.0
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    from .pallas import attention as pallas_attn
+
+    if dropout_rate == 0.0 and pallas_attn.usable(q, k, v):
+        return pallas_attn.flash_attention(q, k, v, scale=scale,
+                                           causal=causal)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate:
+        keep = jax.random.bernoulli(ctx.rng(), 1.0 - dropout_rate,
+                                    weights.shape)
+        weights = weights * keep / (1.0 - dropout_rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)

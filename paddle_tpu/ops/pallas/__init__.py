@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the genuinely hot paths (SURVEY.md §7 step 7):
+flash attention, layer_norm. Each module exposes usable() gating so ops
+fall back to jnp compositions off-TPU or on unsupported shapes."""

@@ -1,0 +1,159 @@
+"""Flash attention Pallas TPU kernel.
+
+The hot path of the Transformer benchmark (BASELINE.md config 3). Online-
+softmax tiling keeps the full [Tq,Tk] logits matrix out of HBM: per
+(batch*head, q-block) grid cell we stream k/v blocks through VMEM,
+carrying running max/denominator -- the standard flash pattern expressed
+in Pallas (see /opt/skills/guides/pallas_guide.md).
+
+Differentiation: pallas_call has no autodiff rule, so flash_attention is
+a jax.custom_vjp whose backward is the jnp composition (fully fused by
+XLA); a Pallas backward kernel is a later optimization. Both paths use
+BOTTOM-RIGHT causal alignment (query i sees keys <= i + tk - tq), the
+same convention as the jnp fallback in ops/nn_ops.py, so kernel/fallback
+numerics agree for tq != tk.
+
+Block sizes adapt to the sequence length (min(t, 256) when divisible),
+so the kernel engages for seq-128 benchmark shapes, not just multiples
+of 256.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_MAX_BLOCK = 256
+
+
+def _pick_block(t: int) -> int:
+    for b in (_MAX_BLOCK, 128, 64, 32, 16, 8):
+        if t % b == 0:
+            return b
+    return 0
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def usable(q, k, v) -> bool:
+    if not _on_tpu():
+        return False
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    return (_pick_block(tq) >= 8 and _pick_block(tk) >= 8
+            and d in (64, 128, 256) and q.dtype == k.dtype == v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, scale=1.0, causal=False):
+    """q,k,v: [B,H,T,D] -> [B,H,T,D]."""
+    return _flash_fwd_impl(q, k, v, scale, causal)
+
+
+def _reference_attention(q, k, v, scale, causal):
+    logits = jnp.einsum("bhqd,bhkd->bhqk",
+                        q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v.astype(
+        jnp.float32)).astype(q.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal):
+    out = _flash_fwd_impl(q, k, v, scale, causal)
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, causal, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _reference_attention(q_, k_, v_, scale,
+                                                causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_fwd_impl(q, k, v, scale, causal):
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _pick_block(tq)
+    block_k = _pick_block(tk)
+    bh = b * h
+    q3 = q.reshape(bh, tq, d)
+    k3 = k.reshape(bh, tk, d)
+    v3 = v.reshape(bh, tk, d)
+
+    grid = (bh, tq // block_q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               tq=tq, tk=tk, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+    )(q3, k3, v3)
+    return out.reshape(b, h, tq, d)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, tq, tk,
+                block_k):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    m = jnp.full((block_q,), -jnp.inf, dtype=jnp.float32)
+    l = jnp.zeros((block_q,), dtype=jnp.float32)
+    acc = jnp.zeros(q.shape, dtype=jnp.float32)
+    # bottom-right causal alignment: query row i attends keys
+    # <= i + (tk - tq), matching the jnp fallback's tril offset.
+    offset = tk - tq
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.dslice(kb * block_k, block_k)].astype(
+            jnp.float32)
+        v_blk = v_ref[0, pl.dslice(kb * block_k, block_k)].astype(
+            jnp.float32)
+        s = q @ k_blk.T  # [BQ, BK]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + offset >= k_pos, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=1))
+        # rows with no valid key yet keep m=-inf; guard the exp
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        correction = jnp.where(jnp.isfinite(m),
+                               jnp.exp(m - m_safe), 0.0)
+        l_new = l * correction + p.sum(axis=1)
+        acc_new = acc * correction[:, None] + p @ v_blk
+        return m_new, l_new, acc_new
+
+    n_blocks = tk // block_k
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)

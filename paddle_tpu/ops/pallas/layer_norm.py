@@ -1,0 +1,79 @@
+"""Fused layer_norm Pallas TPU kernel (reference layer_norm_op.cu's
+fused-kernel role). One VMEM pass per row-block: mean/var/normalize/
+affine without materializing intermediates in HBM. Forward-only -- the
+layer_norm op wraps it in custom_vjp with the jnp backward.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def usable(n: int, d: int) -> bool:
+    return _on_tpu() and d % 128 == 0 and n >= 8
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def layer_norm(x, scale, bias, eps=1e-5):
+    """x: [N,D]; scale/bias: [D]."""
+    return _ln_impl(x, scale, bias, eps)
+
+
+def _ln_ref(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mean = x32.mean(axis=1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)[None]
+            + bias.astype(jnp.float32)[None]).astype(x.dtype)
+
+
+def _ln_fwd(x, scale, bias, eps):
+    return _ln_impl(x, scale, bias, eps), (x, scale, bias)
+
+
+def _ln_bwd(eps, res, g):
+    x, scale, bias = res
+    _, vjp = jax.vjp(lambda x_, s_, b_: _ln_ref(x_, s_, b_, eps),
+                     x, scale, bias)
+    return vjp(g)
+
+
+layer_norm.defvjp(_ln_fwd, _ln_bwd)
+
+
+def _ln_impl(x, scale, bias, eps):
+    from jax.experimental import pallas as pl
+
+    n, d = x.shape
+    block_n = next((b for b in (256, 128, 64, 32, 8, 1) if n % b == 0))
+
+    def kernel(x_ref, s_ref, b_ref, o_ref):
+        xb = x_ref[...].astype(jnp.float32)
+        mean = xb.mean(axis=1, keepdims=True)
+        var = jnp.mean(jnp.square(xb - mean), axis=1, keepdims=True)
+        y = (xb - mean) * jax.lax.rsqrt(var + eps)
+        y = y * s_ref[...].astype(jnp.float32)[None, :] \
+            + b_ref[...].astype(jnp.float32)[None, :]
+        o_ref[...] = y.astype(o_ref.dtype)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n // block_n,),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+            pl.BlockSpec((d,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((block_n, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+    )(x, scale, bias)

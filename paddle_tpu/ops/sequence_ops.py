@@ -1,0 +1,270 @@
+"""Sequence ops over padded [batch, time, ...] + length representation.
+
+Parity targets: reference paddle/fluid/operators/sequence_ops/ (~20 ops:
+sequence_pool_op.cc, sequence_conv_op.cc, sequence_softmax_op.cc,
+sequence_expand_op.cc, sequence_concat_op.cc, sequence_reverse_op.h,
+sequence_pad_op.cc, sequence_unpad_op.cc, sequence_slice_op.cc,
+sequence_enumerate_op.cc, sequence_reshape_op.cc) and the LoD machinery
+they walk (framework/lod_tensor.h:110).
+
+Design (SURVEY.md hard part (a)): LoD offset walking is replaced by mask/
+segment arithmetic over static padded shapes -- every op is a dense
+masked computation XLA can fuse and tile; no dynamic shapes ever reach
+the compiler. `SeqLen` is an int32[batch] companion input.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def _mask(x, seq_len):
+    """[B,T,...] validity mask from lengths -> same-rank float mask."""
+    b, t = x.shape[0], x.shape[1]
+    m = (jnp.arange(t)[None, :] < seq_len[:, None])
+    return m.reshape((b, t) + (1,) * (x.ndim - 2)).astype(x.dtype)
+
+
+@register_op("sequence_pool", stop_gradient_slots=("SeqLen",))
+def sequence_pool(ctx):
+    x = ctx.input("X")  # B,T,D
+    seq_len = ctx.input("SeqLen")
+    if seq_len is None:
+        seq_len = jnp.full((x.shape[0],), x.shape[1], dtype=jnp.int32)
+    ptype = ctx.attr("pooltype", "SUM").upper()
+    m = _mask(x, seq_len)
+    denom = jnp.maximum(seq_len.astype(x.dtype), 1)
+    denom = denom.reshape((-1,) + (1,) * (x.ndim - 2))
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / denom
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(denom)
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(seq_len - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(
+                jnp.int32).repeat(x.shape[-1], axis=-1) if x.ndim == 3
+            else idx[:, None].astype(jnp.int32), axis=1)
+        out = out[:, 0] if x.ndim == 3 else out
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"sequence_pool: unknown pooltype {ptype}")
+    return {"Out": out,
+            "MaxIndex": jnp.zeros(out.shape, dtype=jnp.int32)}
+
+
+@register_op("sequence_softmax", stop_gradient_slots=("SeqLen",))
+def sequence_softmax(ctx):
+    x = ctx.input("X")  # B,T or B,T,1
+    seq_len = ctx.input("SeqLen")
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    x2 = x[..., 0] if squeeze else x
+    m = (jnp.arange(x2.shape[1])[None, :] < seq_len[:, None])
+    logits = jnp.where(m, x2, jnp.finfo(x2.dtype).min)
+    sm = jax.nn.softmax(logits, axis=1)
+    sm = jnp.where(m, sm, 0.0)
+    return sm[..., None] if squeeze else sm
+
+
+@register_op("sequence_conv", stop_gradient_slots=("SeqLen",))
+def sequence_conv(ctx):
+    """Context-window conv (reference sequence_conv_op.cc): for each
+    timestep, concat [t+start, t+start+len) rows (zero past boundaries)
+    then project -- formulated as shifted adds feeding ONE matmul so the
+    MXU does the work."""
+    x = ctx.input("X")  # B,T,D
+    w = ctx.input("Filter")  # ctxLen*D, M
+    seq_len = ctx.input("SeqLen")
+    clen = ctx.attr("contextLength", 3)
+    cstart = ctx.attr("contextStart", -1)
+    b, t, d = x.shape
+    if seq_len is not None:
+        x = x * _mask(x, seq_len)
+    cols = []
+    for i in range(clen):
+        off = cstart + i
+        if off < 0:
+            pad = jnp.pad(x, ((0, 0), (-off, 0), (0, 0)))[:, :t]
+        elif off > 0:
+            pad = jnp.pad(x, ((0, 0), (0, off), (0, 0)))[:, off:]
+        else:
+            pad = x
+        cols.append(pad)
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # B,T,clen*D
+    out = jnp.einsum("btc,cm->btm", ctx_mat, w)
+    if seq_len is not None:
+        out = out * _mask(out, seq_len)
+    return out
+
+
+@register_op("sequence_expand", stop_gradient_slots=("SeqLen",))
+def sequence_expand(ctx):
+    """Broadcast per-sequence rows of X across Y's time dim (the common
+    ref_level=0 use: expand [B,D] or [B,1,D] to [B,T,D])."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    t = y.shape[1]
+    if x.ndim == 2:
+        out = jnp.repeat(x[:, None, :], t, axis=1)
+    elif x.shape[1] == 1:
+        out = jnp.repeat(x, t, axis=1)
+    else:
+        out = x
+    seq_len = ctx.input("SeqLen")
+    if seq_len is not None:
+        out = out * _mask(out, seq_len)
+    return out
+
+
+@register_op("sequence_concat", stop_gradient_slots=("SeqLen",))
+def sequence_concat(ctx):
+    """Concat along time (padded): place each input's valid prefix
+    back-to-back per batch row."""
+    xs = ctx.inputs("X")
+    lens = ctx.inputs("SeqLen")
+    if not lens or lens[0] is None:
+        return jnp.concatenate(xs, axis=1)
+    b = xs[0].shape[0]
+    total_t = sum(x.shape[1] for x in xs)
+    d_shape = xs[0].shape[2:]
+    out = jnp.zeros((b, total_t) + d_shape, dtype=xs[0].dtype)
+    offset = jnp.zeros((b,), dtype=jnp.int32)
+    t_idx = jnp.arange(total_t)
+    for x, l in zip(xs, lens):
+        t = x.shape[1]
+        # scatter rows: out[b, offset[b]+j] = x[b, j] for j < l[b]
+        src_idx = jnp.arange(t)
+        pos = offset[:, None] + src_idx[None, :]  # B,t
+        valid = src_idx[None, :] < l[:, None]
+        onehot = (t_idx[None, None, :] == pos[:, :, None]) \
+            & valid[:, :, None]
+        out = out + jnp.einsum(
+            "bts,bt...->bs...", onehot.astype(x.dtype), x)
+        offset = offset + l.astype(jnp.int32)
+    return out
+
+
+@register_op("sequence_reverse", stop_gradient_slots=("SeqLen",))
+def sequence_reverse(ctx):
+    x = ctx.input("X")
+    seq_len = ctx.input("SeqLen")
+    t = x.shape[1]
+    if seq_len is None:
+        return {"Y": jnp.flip(x, axis=1)}
+    idx = jnp.arange(t)[None, :]
+    rev = seq_len[:, None] - 1 - idx
+    gather_idx = jnp.where(idx < seq_len[:, None], rev, idx)
+    if x.ndim > 2:
+        idx_full = jnp.broadcast_to(
+            gather_idx.reshape(gather_idx.shape + (1,) * (x.ndim - 2))
+            .astype(jnp.int32), (x.shape[0], t) + x.shape[2:])
+    else:
+        idx_full = gather_idx.astype(jnp.int32)
+    out = jnp.take_along_axis(x, idx_full, axis=1)
+    return {"Y": out}
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ctx):
+    x = ctx.input("X")
+    new_dim = ctx.attr("new_dim")
+    b = x.shape[0]
+    return x.reshape(b, -1, new_dim)
+
+
+@register_op("sequence_pad", stop_gradient_slots=("SeqLen", "PadValue"))
+def sequence_pad(ctx):
+    x = ctx.input("X")
+    seq_len = ctx.input("SeqLen")
+    pad_value = ctx.input("PadValue")
+    padded_len = ctx.attr("padded_length", -1)
+    t = x.shape[1] if padded_len in (-1, None) else padded_len
+    if t > x.shape[1]:
+        x = jnp.pad(x, ((0, 0), (0, t - x.shape[1]))
+                    + ((0, 0),) * (x.ndim - 2))
+    elif t < x.shape[1]:
+        x = x[:, :t]
+    if seq_len is None:
+        seq_len = jnp.full((x.shape[0],), t, dtype=jnp.int32)
+    m = _mask(x, seq_len)
+    pv = pad_value.reshape(()) if pad_value is not None else 0.0
+    out = x * m + (1 - m) * pv
+    return {"Out": out, "Length": seq_len.astype(jnp.int64)}
+
+
+@register_op("sequence_unpad", stop_gradient_slots=("Length",))
+def sequence_unpad(ctx):
+    x = ctx.input("X")
+    length = ctx.input("Length")
+    m = _mask(x, length.astype(jnp.int32))
+    return x * m
+
+
+@register_op("sequence_slice", stop_gradient_slots=("Offset", "Length"))
+def sequence_slice(ctx):
+    x = ctx.input("X")  # B,T,...
+    offset = ctx.input("Offset").reshape(-1).astype(jnp.int32)
+    length = ctx.input("Length").reshape(-1).astype(jnp.int32)
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]
+    gidx = jnp.minimum(offset[:, None] + idx, t - 1)
+    if x.ndim > 2:
+        gidx_full = jnp.broadcast_to(
+            gidx.reshape(gidx.shape + (1,) * (x.ndim - 2)).astype(
+                jnp.int32), (x.shape[0], t) + x.shape[2:])
+    else:
+        gidx_full = gidx
+    gat = jnp.take_along_axis(x, gidx_full, axis=1)
+    m = (idx < length[:, None]).reshape(
+        (x.shape[0], t) + (1,) * (x.ndim - 2)).astype(x.dtype)
+    return gat * m
+
+
+@register_op("sequence_enumerate", differentiable=False)
+def sequence_enumerate(ctx):
+    x = ctx.input("X")  # B,T int ids
+    win = ctx.attr("win_size")
+    pad = ctx.attr("pad_value", 0)
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+    b, t = x.shape
+    outs = []
+    for i in range(win):
+        if i == 0:
+            outs.append(x)
+        else:
+            outs.append(jnp.pad(x, ((0, 0), (0, i)),
+                                constant_values=pad)[:, i:])
+    return jnp.stack(outs, axis=-1)
+
+
+@register_op("sequence_scatter", stop_gradient_slots=("Ids",))
+def sequence_scatter(ctx):
+    x = ctx.input("X")
+    ids = ctx.input("Ids").astype(jnp.int32)
+    upd = ctx.input("Updates")
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    b = x.shape[0]
+    batch_idx = jnp.arange(b)[:, None].repeat(ids.shape[1], axis=1)
+    return x.at[batch_idx, ids].add(upd)
+
+
+@register_op("lod_reset", stop_gradient_slots=("Y",))
+def lod_reset(ctx):
+    # lengths live in the @SEQ_LEN companion; data passes through
+    return ctx.input("X")
+
+
+@register_op("shrink_memory")
+def shrink_memory(ctx):
+    return ctx.input("X")

@@ -1,0 +1,12 @@
+"""Parallelism: mesh/sharding rules, distributed bootstrap, collectives.
+
+TPU-native replacement for the reference's two distributed stacks
+(SURVEY.md §2.4): NCCL op-handle data parallelism and the gRPC/BRPC
+parameter-server transpiler. Communication is compiler-scheduled XLA
+collectives over ICI/DCN via jax.sharding annotations -- not runtime
+op handles.
+"""
+from .mesh import make_mesh, MeshConfig  # noqa: F401
+from .sharding import (ShardingRules, default_transformer_rules,
+                       shard_state, replicate)  # noqa: F401
+from .env import DistributedEnv, init_distributed_env  # noqa: F401

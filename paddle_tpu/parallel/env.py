@@ -1,0 +1,57 @@
+"""Multi-host bootstrap (replaces reference gen_nccl_id_op.cc:31 raw-RPC
+ncclUniqueId broadcast + PADDLE_* env topology of test_dist_base.py).
+
+jax.distributed's coordination service fills the gen_nccl_id role: rank 0
+hosts the coordinator, others connect, and XLA's runtime builds the
+ICI/DCN communicator -- no framework-level RPC plumbing. The PADDLE_*
+env-var contract is honored for drop-in launch-script compatibility.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+
+
+@dataclass
+class DistributedEnv:
+    trainer_id: int = 0
+    num_trainers: int = 1
+    coordinator: Optional[str] = None
+    role: str = "TRAINER"
+
+    @property
+    def is_chief(self):
+        return self.trainer_id == 0
+
+
+def _from_env() -> DistributedEnv:
+    """Reads both the reference's PADDLE_* contract and jax-style vars."""
+    env = os.environ
+    trainer_id = int(env.get("PADDLE_TRAINER_ID",
+                             env.get("JAX_PROCESS_ID", 0)))
+    num = int(env.get("PADDLE_TRAINERS_NUM",
+                      env.get("JAX_NUM_PROCESSES", 1)))
+    eps = env.get("PADDLE_TRAINER_ENDPOINTS", "")
+    coordinator = env.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator is None and eps:
+        coordinator = eps.split(",")[0]
+    role = env.get("PADDLE_TRAINING_ROLE", "TRAINER")
+    return DistributedEnv(trainer_id, num, coordinator, role)
+
+
+_initialized = [False]
+
+
+def init_distributed_env(env: Optional[DistributedEnv] = None
+                         ) -> DistributedEnv:
+    env = env or _from_env()
+    if env.num_trainers > 1 and not _initialized[0]:
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator,
+            num_processes=env.num_trainers,
+            process_id=env.trainer_id)
+        _initialized[0] = True
+    return env

@@ -1,0 +1,64 @@
+"""Device mesh construction (replaces reference NCCLContextMap
+nccl_helper.h:90 device-ring setup with jax.sharding.Mesh topology).
+
+Axes follow the scaling-book convention:
+  dp  -- data parallel (batch)
+  tp  -- tensor parallel (weight matrices' inner dims)
+  sp  -- sequence/context parallel (time dim; ring attention)
+  pp  -- pipeline parallel (layer groups)
+  ep  -- expert parallel (MoE experts)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+AXES = ("dp", "tp", "sp", "pp", "ep")
+
+
+@dataclass
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    def total(self):
+        return self.dp * self.tp * self.sp * self.pp * self.ep
+
+    def axis_sizes(self):
+        return {"dp": self.dp, "tp": self.tp, "sp": self.sp,
+                "pp": self.pp, "ep": self.ep}
+
+
+def factorize(n_devices: int, want_tp=True, want_sp=False) -> MeshConfig:
+    """Reasonable default factorization of a device count."""
+    cfg = MeshConfig()
+    n = n_devices
+    if want_tp and n % 2 == 0:
+        cfg.tp = 2
+        n //= 2
+    if want_sp and n % 2 == 0:
+        cfg.sp = 2
+        n //= 2
+    cfg.dp = n
+    return cfg
+
+
+def make_mesh(config: Optional[MeshConfig] = None, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if config is None:
+        config = MeshConfig(dp=len(devices))
+    assert config.total() == len(devices), \
+        f"mesh {config} needs {config.total()} devices, have " \
+        f"{len(devices)}"
+    arr = np.array(devices).reshape(
+        config.dp, config.tp, config.sp, config.pp, config.ep)
+    return Mesh(arr, AXES)

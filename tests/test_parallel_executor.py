@@ -1,0 +1,81 @@
+"""Data-parallel tests on the virtual 8-device CPU mesh (reference
+parallel_executor_test_base.py: PE-vs-Executor loss parity;
+test_dist_base.py oracle: dist loss must match single-process)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build(seed=5):
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[32], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(img, 32, act="relu")
+        logits = fluid.layers.fc(h, 4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n_steps, batch=32):
+    rng = np.random.RandomState(11)
+    for _ in range(n_steps):
+        y = rng.randint(0, 4, (batch, 1)).astype("int64")
+        x = rng.rand(batch, 32).astype("float32") * 0.1
+        for i in range(batch):
+            x[i, y[i, 0] * 8:(y[i, 0] + 1) * 8] += 1.0
+        yield x, y
+
+
+def test_data_parallel_loss_parity():
+    """CompiledProgram.with_data_parallel over 8 devices must track the
+    single-device loss (same global batch, same init)."""
+    fluid.seed(3)
+    main, startup, loss = _build()
+    scope_single = fluid.Scope()
+    exe = fluid.Executor()
+    exe.run(startup, scope=scope_single)
+    single_losses = []
+    for x, y in _batches(8):
+        out = exe.run(main, feed={"img": x, "label": y},
+                      fetch_list=[loss], scope=scope_single)
+        single_losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+
+    fluid.seed(3)
+    scope_dp = fluid.Scope()
+    exe.run(startup, scope=scope_dp)
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name)
+    dp_losses = []
+    for x, y in _batches(8):
+        out = exe.run(compiled, feed={"img": x, "label": y},
+                      fetch_list=[loss], scope=scope_dp)
+        dp_losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    np.testing.assert_allclose(single_losses, dp_losses, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_parallel_executor_facade():
+    fluid.seed(7)
+    main, startup, loss = _build()
+    exe = fluid.Executor()
+    exe.run(startup)
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=main)
+    for x, y in _batches(3):
+        out = pe.run(feed={"img": x, "label": y},
+                     fetch_list=[loss.name])
+        val = float(np.asarray(out[0]).reshape(-1)[0])
+        assert np.isfinite(val)
+
+
+def test_dryrun_multichip_entrypoint():
+    import importlib
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    m = importlib.import_module("__graft_entry__")
+    m.dryrun_multichip(8)

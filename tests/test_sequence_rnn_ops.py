@@ -1,0 +1,161 @@
+"""Sequence + RNN op tests (reference test_sequence_pool.py,
+test_lstm_op.py, test_gru_op.py patterns, masked-padded representation)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.layers.sequence import SEQ_LEN_SUFFIX
+
+
+def _run_single_op(op_type, inputs, attrs, out_slots):
+    prog = fluid.Program()
+    block = prog.global_block
+    in_names = {}
+    feed = {}
+    for slot, arr in inputs.items():
+        name = slot.lower()
+        block.create_var(name=name, shape=arr.shape, dtype=str(arr.dtype),
+                         is_data=True)
+        feed[name] = arr
+        in_names[slot] = [name]
+    out_names = {s: [s.lower() + "_out"] for s in out_slots}
+    for s in out_slots:
+        block.create_var(name=s.lower() + "_out")
+    block.append_op(op_type, in_names, out_names, attrs)
+    exe = fluid.Executor()
+    return exe.run(prog, feed=feed,
+                   fetch_list=[out_names[s][0] for s in out_slots])
+
+
+def test_sequence_pool_modes():
+    x = np.random.rand(3, 5, 4).astype("float32")
+    lens = np.array([5, 2, 4], dtype="int32")
+    mask = (np.arange(5)[None, :] < lens[:, None])[..., None]
+    for mode, ref in [
+        ("SUM", (x * mask).sum(1)),
+        ("AVERAGE", (x * mask).sum(1) / lens[:, None]),
+        ("MAX", np.where(mask, x, -np.inf).max(1)),
+        ("FIRST", x[:, 0]),
+        ("LAST", x[np.arange(3), lens - 1]),
+    ]:
+        out, _ = _run_single_op(
+            "sequence_pool", {"X": x, "SeqLen": lens},
+            {"pooltype": mode}, ["Out", "MaxIndex"])
+        np.testing.assert_allclose(out, ref, rtol=1e-5,
+                                   err_msg=f"mode {mode}")
+
+
+def test_sequence_softmax_masks_padding():
+    x = np.random.rand(2, 6).astype("float32")
+    lens = np.array([4, 6], dtype="int32")
+    (out,) = _run_single_op("sequence_softmax",
+                            {"X": x, "SeqLen": lens}, {}, ["Out"])
+    assert np.allclose(out[0, 4:], 0.0)
+    np.testing.assert_allclose(out.sum(axis=1), [1.0, 1.0], rtol=1e-5)
+
+
+def test_sequence_reverse():
+    x = np.arange(12, dtype="float32").reshape(2, 6)
+    lens = np.array([3, 6], dtype="int32")
+    (out,) = _run_single_op("sequence_reverse",
+                            {"X": x, "SeqLen": lens}, {}, ["Y"])
+    np.testing.assert_allclose(out[0, :3], x[0, :3][::-1])
+    np.testing.assert_allclose(out[0, 3:], x[0, 3:])
+    np.testing.assert_allclose(out[1], x[1][::-1])
+
+
+def test_lstm_op_shapes_and_length_masking():
+    b, t, h = 2, 5, 8
+    x = np.random.rand(b, t, 4 * h).astype("float32") * 0.1
+    w = np.random.rand(h, 4 * h).astype("float32") * 0.1
+    bias = np.random.rand(1, 4 * h).astype("float32") * 0.1
+    lens = np.array([3, 5], dtype="int32")
+    hidden, cell = _run_single_op(
+        "lstm", {"Input": x, "Weight": w, "Bias": bias, "SeqLen": lens},
+        {"use_peepholes": False}, ["Hidden", "Cell"])
+    assert hidden.shape == (b, t, h)
+    # state frozen past the sequence end for row 0
+    np.testing.assert_allclose(hidden[0, 2], hidden[0, 3], rtol=1e-6)
+    np.testing.assert_allclose(hidden[0, 3], hidden[0, 4], rtol=1e-6)
+    assert not np.allclose(hidden[1, 3], hidden[1, 4])
+
+
+def test_gru_op_matches_manual_step():
+    b, t, h = 2, 3, 4
+    x = np.random.rand(b, t, 3 * h).astype("float32") * 0.2
+    w = np.random.rand(h, 3 * h).astype("float32") * 0.2
+    (hidden,) = _run_single_op(
+        "gru", {"Input": x, "Weight": w},
+        {"origin_mode": False}, ["Hidden"])
+    # manual first step from h=0
+    xu, xr, xc = np.split(x[:, 0], 3, axis=-1)
+    u = 1 / (1 + np.exp(-xu))
+    cand = np.tanh(xc)
+    h1 = u * cand
+    np.testing.assert_allclose(hidden[:, 0], h1, rtol=1e-4)
+
+
+def test_dynamic_lstm_trains():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = fluid.layers.data("w", shape=[-1, 6], dtype="float32",
+                                  append_batch_size=False)
+        words.shape = (-1, 8, 6)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        proj = fluid.layers.fc(words, 16 * 4, num_flatten_dims=2)
+        blk = proj.block
+        blk.create_var(name="w" + SEQ_LEN_SUFFIX, shape=(-1,),
+                       dtype="int32", is_data=True)
+        blk.append_op("assign", {"X": "w" + SEQ_LEN_SUFFIX},
+                      {"Out": proj.name + SEQ_LEN_SUFFIX}, {})
+        blk.create_var(name=proj.name + SEQ_LEN_SUFFIX, shape=(-1,),
+                       dtype="int32")
+        h, c = fluid.layers.dynamic_lstm(proj, 16 * 4,
+                                         use_peepholes=False)
+        last = fluid.layers.sequence_pool(h, "last")
+        logits = fluid.layers.fc(last, 2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 8, 6).astype("float32")
+    lens = np.full((16,), 8, dtype="int32")
+    ys = (xs[:, 0, 0] > 0.5).astype("int64")[:, None]
+    losses = []
+    for _ in range(30):
+        out = exe.run(main, feed={"w": xs, "w" + SEQ_LEN_SUFFIX: lens,
+                                  "label": ys}, fetch_list=[loss])
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_attention_op_causal():
+    q = np.random.rand(2, 2, 4, 8).astype("float32")
+    out, = _run_single_op("attention", {"Q": q, "K": q, "V": q},
+                          {"causal": True, "scale": 0.5,
+                           "dropout_rate": 0.0}, ["Out"])
+    # first position attends only to itself -> output == v[:, :, 0]
+    np.testing.assert_allclose(out[:, :, 0], q[:, :, 0], rtol=1e-5)
+
+
+def test_transformer_tiny_trains():
+    from paddle_tpu.models import transformer as T
+
+    main, startup, cost = T.build_program(
+        seq_len=8, d_model=32, n_heads=2, n_layers=1, d_inner=64,
+        vocab=50, dropout_rate=0.0, with_optimizer=False)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(0.01).minimize(cost)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    src = rng.randint(0, 50, (4, 8)).astype("int64")
+    losses = []
+    for _ in range(15):
+        out = exe.run(main, feed={"src_ids": src, "tgt_ids": src,
+                                  "label": src}, fetch_list=[cost])
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    assert losses[-1] < losses[0]
