@@ -93,7 +93,36 @@ class _CompiledBlock:
 
 
 def _analyze_block(block, feed_names, fetch_names):
-    """Classify vars: feed / state-in (from scope) / produced / fetched."""
+    """Classify vars: feed / state-in (from scope) / produced / fetched.
+
+    Prefers the native C++ analyzer (paddle_tpu/native/src/analysis.cc,
+    the reference's executor_gc_helper/reference_count_pass analogue);
+    the Python path below is the fallback and the cross-check oracle
+    (tests/test_native.py asserts both agree). Skipped for programs with
+    unregistered op types so the error below still fires.
+    """
+    from .. import native
+
+    if native.available():
+        ok = True
+        for op in block.ops:
+            if op.type not in _SKIP_OP_TYPES and not is_registered(op.type):
+                ok = False
+                break
+        if ok:
+            try:
+                nprog = native.NativeProgram.from_dict(
+                    block.program.to_dict())
+                mutated, const, state_out = nprog.analyze_block(
+                    block.idx, list(feed_names), list(fetch_names),
+                    list(_SKIP_OP_TYPES))
+                return mutated, const, state_out
+            except Exception:
+                pass  # fall back to the Python analyzer
+    return _analyze_block_py(block, feed_names, fetch_names)
+
+
+def _analyze_block_py(block, feed_names, fetch_names):
     produced = set(feed_names)
     state_in = []
     written = []

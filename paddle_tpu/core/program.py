@@ -180,7 +180,9 @@ class Operator:
             if isinstance(v, Block):
                 attrs[k] = {"__block__": v.idx}
             elif isinstance(v, np.ndarray):
-                attrs[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+                attrs[k] = {"__ndarray__": v.reshape(-1).tolist(),
+                            "dtype": str(v.dtype),
+                            "shape": list(v.shape)}
             else:
                 attrs[k] = v
         return {"type": self.type, "inputs": self.inputs,
@@ -193,7 +195,10 @@ class Operator:
             if isinstance(v, dict) and "__block__" in v:
                 attrs[k] = program.blocks[v["__block__"]]
             elif isinstance(v, dict) and "__ndarray__" in v:
-                attrs[k] = np.asarray(v["__ndarray__"], dtype=v["dtype"])
+                arr = np.asarray(v["__ndarray__"], dtype=v["dtype"])
+                if "shape" in v:
+                    arr = arr.reshape(v["shape"])
+                attrs[k] = arr
             else:
                 attrs[k] = v
         return Operator(block, d["type"], d["inputs"], d["outputs"], attrs)
@@ -382,7 +387,7 @@ class Program:
                 nb.vars[name] = nv
             for op in blk.ops:
                 if for_test and op.attrs.get("op_role") in (
-                        "backward", "optimize"):
+                        "backward", "optimize", "lr_sched"):
                     continue
                 attrs = dict(op.attrs)
                 if for_test and "is_test" in attrs:

@@ -158,9 +158,22 @@ def save_inference_model(dirname, feeded_var_names: List[str],
         "feed_names": list(feeded_var_names),
         "fetch_names": target_names,
     }
-    with open(os.path.join(dirname, model_filename or _MODEL_FILE),
-              "w") as f:
-        json.dump(model, f)
+    path = os.path.join(dirname, model_filename or _MODEL_FILE)
+    from . import native
+
+    if native.available():
+        # native binary program artifact (reference serializes a protobuf
+        # ProgramDesc as __model__, io.py:865; here the C++ core writes
+        # its compact PTPF format; feed/fetch ride alongside as JSON)
+        blob = native.NativeProgram.from_dict(model["program"]).to_bytes()
+        with open(path, "wb") as f:
+            f.write(blob)
+        with open(path + ".meta", "w") as f:
+            json.dump({"feed_names": model["feed_names"],
+                       "fetch_names": model["fetch_names"]}, f)
+    else:
+        with open(path, "w") as f:
+            json.dump(model, f)
     persist = [v for v in pruned.list_vars() if _is_persistable(v)]
     save_vars(executor, dirname, pruned, vars=persist,
               filename=params_filename)
@@ -170,8 +183,18 @@ def save_inference_model(dirname, feeded_var_names: List[str],
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     """reference io.py:1020 -> (program, feed_names, fetch_targets)."""
-    with open(os.path.join(dirname, model_filename or _MODEL_FILE)) as f:
-        model = json.load(f)
+    path = os.path.join(dirname, model_filename or _MODEL_FILE)
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:4] == b"PTPF":
+        from . import native
+
+        prog_dict = native.NativeProgram.from_bytes(raw).to_dict()
+        with open(path + ".meta") as f:
+            model = json.load(f)
+        model["program"] = prog_dict
+    else:
+        model = json.loads(raw.decode())
     program = Program.from_dict(model["program"])
     persist = [v for v in program.list_vars() if _is_persistable(v)]
     load_vars(executor, dirname, program, vars=persist,

@@ -34,8 +34,12 @@ def _global_step():
     block = helper.main_program.current_block()
     if not any(_STEP_COUNTER in op.output_arg_names
                and op.type == "increment" for op in block.ops):
+        # op_role marks this as schedule bookkeeping so
+        # Program.clone(for_test=True) prunes it and eval runs don't
+        # advance the training LR schedule (reference tags LR ops with
+        # OpRole.LRSched, framework.py op_role attr)
         block.append_op("increment", {"X": counter}, {"Out": counter},
-                        {"step": 1.0})
+                        {"step": 1.0, "op_role": "lr_sched"})
     return counter
 
 

@@ -1,0 +1,118 @@
+#include "analysis.h"
+
+#include <algorithm>
+#include <unordered_map>
+#include <unordered_set>
+
+namespace ptp {
+
+namespace {
+constexpr const char* kEmptyVar = "@EMPTY@";
+}
+
+BlockAnalysis analyzeBlock(const ProgramDesc& prog, int32_t block_idx,
+                           const std::vector<std::string>& feed_names,
+                           const std::vector<std::string>& fetch_names,
+                           const std::vector<std::string>& skip_op_types) {
+  BlockAnalysis out;
+  const BlockDesc& blk = prog.blocks[block_idx];
+  std::unordered_set<std::string> skip(skip_op_types.begin(),
+                                       skip_op_types.end());
+  std::unordered_set<std::string> produced(feed_names.begin(),
+                                           feed_names.end());
+  std::unordered_set<std::string> seen_in;
+  std::vector<std::string> state_in;
+  std::vector<std::string> written;
+
+  for (const auto& op : blk.ops) {
+    if (skip.count(op.type)) continue;
+    for (const auto& name : op.inputArgNames()) {
+      if (name == kEmptyVar || produced.count(name) || seen_in.count(name))
+        continue;
+      seen_in.insert(name);
+      state_in.push_back(name);
+    }
+    for (const auto& name : op.outputArgNames()) {
+      if (!produced.count(name)) {
+        produced.insert(name);
+        written.push_back(name);
+      }
+    }
+  }
+
+  std::unordered_set<std::string> state_out_set;
+  for (const auto& name : written) {
+    const VarDesc* v = prog.findVarRecursive(block_idx, name);
+    if (v && v->persistable) {
+      out.state_out.push_back(name);
+      state_out_set.insert(name);
+    }
+  }
+  std::unordered_set<std::string> feeds(feed_names.begin(),
+                                        feed_names.end());
+  for (const auto& name : fetch_names) {
+    if (!produced.count(name) && !seen_in.count(name) &&
+        !feeds.count(name)) {
+      state_in.push_back(name);
+      seen_in.insert(name);
+    }
+  }
+  for (const auto& n : state_in) {
+    if (state_out_set.count(n))
+      out.mutated.push_back(n);
+    else
+      out.constant.push_back(n);
+  }
+  return out;
+}
+
+std::vector<std::vector<std::string>> lastUsePlan(
+    const ProgramDesc& prog, int32_t block_idx,
+    const std::vector<std::string>& feed_names,
+    const std::vector<std::string>& fetch_names) {
+  const BlockDesc& blk = prog.blocks[block_idx];
+  std::unordered_set<std::string> protect(feed_names.begin(),
+                                          feed_names.end());
+  for (const auto& n : fetch_names) protect.insert(n);
+
+  std::unordered_map<std::string, size_t> last_use;
+  for (size_t i = 0; i < blk.ops.size(); ++i) {
+    for (const auto& n : blk.ops[i].inputArgNames()) last_use[n] = i;
+    for (const auto& n : blk.ops[i].outputArgNames()) last_use[n] = i;
+  }
+  std::vector<std::vector<std::string>> plan(blk.ops.size());
+  for (const auto& kv : last_use) {
+    const std::string& name = kv.first;
+    if (name == kEmptyVar || protect.count(name)) continue;
+    const VarDesc* v = prog.findVarRecursive(block_idx, name);
+    if (v && v->persistable) continue;
+    plan[kv.second].push_back(name);
+  }
+  for (auto& names : plan) std::sort(names.begin(), names.end());
+  return plan;
+}
+
+std::vector<int32_t> dependencyWaves(const ProgramDesc& prog,
+                                     int32_t block_idx) {
+  const BlockDesc& blk = prog.blocks[block_idx];
+  std::unordered_map<std::string, int32_t> producer_wave;
+  std::vector<int32_t> waves(blk.ops.size(), 0);
+  for (size_t i = 0; i < blk.ops.size(); ++i) {
+    int32_t wave = 0;
+    for (const auto& n : blk.ops[i].inputArgNames()) {
+      auto it = producer_wave.find(n);
+      if (it != producer_wave.end()) wave = std::max(wave, it->second + 1);
+    }
+    // WAR hazard: writing a var some earlier op produced serializes too
+    for (const auto& n : blk.ops[i].outputArgNames()) {
+      auto it = producer_wave.find(n);
+      if (it != producer_wave.end()) wave = std::max(wave, it->second + 1);
+    }
+    waves[i] = wave;
+    for (const auto& n : blk.ops[i].outputArgNames())
+      producer_wave[n] = wave;
+  }
+  return waves;
+}
+
+}  // namespace ptp

@@ -1,0 +1,49 @@
+// Block dataflow analysis: feed/state classification for the executor,
+// last-use (eager-GC) planning, and dependency-wave scheduling.
+//
+// TPU-native counterpart of the reference's compile-time GC analysis
+// (reference paddle/fluid/framework/executor_gc_helper.cc,
+// details/reference_count_pass.cc) and the FastThreaded dependency-count
+// scheduler (details/fast_threaded_ssa_graph_executor.cc). On TPU the
+// per-step op loop is compiled away by XLA, so these analyses feed buffer
+// *donation* decisions and host-side pipeline planning instead of a
+// runtime interpreter.
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "program.h"
+
+namespace ptp {
+
+struct BlockAnalysis {
+  // vars read from the enclosing Scope before being written (state-in),
+  // split by whether the block later writes them back (donation-eligible)
+  std::vector<std::string> mutated;
+  std::vector<std::string> constant;
+  // persistable outputs that must be written back to the Scope
+  std::vector<std::string> state_out;
+};
+
+// Mirrors paddle_tpu.core.executor._analyze_block (Python) — the Python
+// side cross-checks against this in tests and prefers this when loaded.
+BlockAnalysis analyzeBlock(const ProgramDesc& prog, int32_t block_idx,
+                           const std::vector<std::string>& feed_names,
+                           const std::vector<std::string>& fetch_names,
+                           const std::vector<std::string>& skip_op_types);
+
+// For each op index, the variables whose last use is that op and which
+// can be freed right after it (excludes persistables, feeds, fetches).
+std::vector<std::vector<std::string>> lastUsePlan(
+    const ProgramDesc& prog, int32_t block_idx,
+    const std::vector<std::string>& feed_names,
+    const std::vector<std::string>& fetch_names);
+
+// Dependency waves: wave[i] = earliest parallel step at which op i can
+// run (all producers in earlier waves). Ops in the same wave are
+// data-independent.
+std::vector<int32_t> dependencyWaves(const ProgramDesc& prog,
+                                     int32_t block_idx);
+
+}  // namespace ptp

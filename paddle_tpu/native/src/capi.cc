@@ -1,0 +1,297 @@
+// C ABI exported to Python via ctypes (pybind11 is not available in this
+// environment; the reference used pybind11, paddle/fluid/pybind/pybind.cc).
+//
+// Conventions:
+//  - handles are opaque pointers returned as void*
+//  - strings/buffers returned as malloc'd memory the caller frees with
+//    ptp_free
+//  - functions that can fail return NULL / -1 and set a thread-local
+//    error string readable via ptp_last_error
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "analysis.h"
+#include "json.h"
+#include "lod.h"
+#include "program.h"
+#include "recordio.h"
+#include "scope.h"
+
+using ptp::Json;
+using ptp::ProgramDesc;
+
+namespace {
+
+thread_local std::string g_error;
+
+char* dupString(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.data(), s.size());
+  out[s.size()] = '\0';
+  return out;
+}
+
+std::vector<std::string> splitNames(const char* csv) {
+  // '\n'-separated name list ('\n' cannot appear in var names)
+  std::vector<std::string> out;
+  if (!csv || !*csv) return out;
+  const char* p = csv;
+  while (*p) {
+    const char* nl = strchr(p, '\n');
+    if (!nl) {
+      out.emplace_back(p);
+      break;
+    }
+    out.emplace_back(p, nl - p);
+    p = nl + 1;
+  }
+  return out;
+}
+
+ptp::JsonPtr namesToJson(const std::vector<std::string>& names) {
+  auto arr = Json::makeArray();
+  for (auto& n : names) arr->push(Json::makeString(n));
+  return arr;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* ptp_last_error() { return g_error.c_str(); }
+
+void ptp_free(void* p) { free(p); }
+
+int ptp_version() { return 1; }
+
+// ------------------------------------------------------------- program
+void* ptp_program_from_json(const char* json_text) {
+  std::string err;
+  auto j = Json::parse(json_text, &err);
+  if (!j) {
+    g_error = "json parse: " + err;
+    return nullptr;
+  }
+  auto prog = ProgramDesc::fromJson(*j, &err);
+  if (!prog) {
+    g_error = "program build: " + err;
+    return nullptr;
+  }
+  return prog.release();
+}
+
+char* ptp_program_to_json(void* handle) {
+  auto* prog = static_cast<ProgramDesc*>(handle);
+  return dupString(prog->toJson()->dump());
+}
+
+uint8_t* ptp_program_serialize(void* handle, size_t* out_size) {
+  auto* prog = static_cast<ProgramDesc*>(handle);
+  std::string bytes = prog->serialize();
+  *out_size = bytes.size();
+  uint8_t* buf = static_cast<uint8_t*>(malloc(bytes.size()));
+  memcpy(buf, bytes.data(), bytes.size());
+  return buf;
+}
+
+void* ptp_program_deserialize(const uint8_t* data, size_t size) {
+  std::string err;
+  auto prog = ProgramDesc::deserialize(data, size, &err);
+  if (!prog) {
+    g_error = err;
+    return nullptr;
+  }
+  return prog.release();
+}
+
+void ptp_program_destroy(void* handle) {
+  delete static_cast<ProgramDesc*>(handle);
+}
+
+int ptp_program_num_blocks(void* handle) {
+  return static_cast<int>(static_cast<ProgramDesc*>(handle)->blocks.size());
+}
+
+int ptp_program_num_ops(void* handle, int block_idx) {
+  auto* prog = static_cast<ProgramDesc*>(handle);
+  if (block_idx < 0 ||
+      block_idx >= static_cast<int>(prog->blocks.size()))
+    return -1;
+  return static_cast<int>(prog->blocks[block_idx].ops.size());
+}
+
+char* ptp_program_op_type(void* handle, int block_idx, int op_idx) {
+  auto* prog = static_cast<ProgramDesc*>(handle);
+  if (block_idx < 0 || block_idx >= static_cast<int>(prog->blocks.size()))
+    return nullptr;
+  auto& blk = prog->blocks[block_idx];
+  if (op_idx < 0 || op_idx >= static_cast<int>(blk.ops.size()))
+    return nullptr;
+  return dupString(blk.ops[op_idx].type);
+}
+
+// ------------------------------------------------------------ analysis
+// feed/fetch/skip are '\n'-separated name lists. Returns JSON
+// {"mutated": [...], "constant": [...], "state_out": [...]}.
+char* ptp_analyze_block(void* handle, int block_idx, const char* feeds,
+                        const char* fetches, const char* skip_ops) {
+  auto* prog = static_cast<ProgramDesc*>(handle);
+  auto res = ptp::analyzeBlock(*prog, block_idx, splitNames(feeds),
+                               splitNames(fetches), splitNames(skip_ops));
+  auto obj = Json::makeObject();
+  obj->set("mutated", namesToJson(res.mutated));
+  obj->set("constant", namesToJson(res.constant));
+  obj->set("state_out", namesToJson(res.state_out));
+  return dupString(obj->dump());
+}
+
+// Returns JSON [[names freed after op 0], [after op 1], ...]
+char* ptp_last_use_plan(void* handle, int block_idx, const char* feeds,
+                        const char* fetches) {
+  auto* prog = static_cast<ProgramDesc*>(handle);
+  auto plan = ptp::lastUsePlan(*prog, block_idx, splitNames(feeds),
+                               splitNames(fetches));
+  auto arr = Json::makeArray();
+  for (auto& names : plan) arr->push(namesToJson(names));
+  return dupString(arr->dump());
+}
+
+// Returns JSON [wave_of_op_0, wave_of_op_1, ...]
+char* ptp_dependency_waves(void* handle, int block_idx) {
+  auto* prog = static_cast<ProgramDesc*>(handle);
+  auto waves = ptp::dependencyWaves(*prog, block_idx);
+  auto arr = Json::makeArray();
+  for (auto w : waves) arr->push(Json::makeInt(w));
+  return dupString(arr->dump());
+}
+
+// --------------------------------------------------------------- scope
+void* ptp_scope_new() { return new ptp::Scope(); }
+
+void ptp_scope_destroy(void* handle) {
+  delete static_cast<ptp::Scope*>(handle);
+}
+
+int64_t ptp_scope_var(void* handle, const char* name) {
+  return static_cast<ptp::Scope*>(handle)->var(name);
+}
+
+int64_t ptp_scope_find_var(void* handle, const char* name) {
+  return static_cast<ptp::Scope*>(handle)->findVar(name);
+}
+
+void* ptp_scope_new_child(void* handle) {
+  return static_cast<ptp::Scope*>(handle)->newScope();
+}
+
+void ptp_scope_drop_kids(void* handle) {
+  static_cast<ptp::Scope*>(handle)->dropKids();
+}
+
+int ptp_scope_num_kids(void* handle) {
+  return static_cast<int>(static_cast<ptp::Scope*>(handle)->numKids());
+}
+
+int ptp_scope_erase(void* handle, const char* name) {
+  return static_cast<ptp::Scope*>(handle)->eraseLocal(name) ? 1 : 0;
+}
+
+char* ptp_scope_local_var_names(void* handle) {
+  auto names = static_cast<ptp::Scope*>(handle)->localVarNames();
+  return dupString(namesToJson(names)->dump());
+}
+
+// ------------------------------------------------------------- recordio
+void* ptp_recordio_writer_new(const char* path, uint32_t compressor,
+                              uint32_t max_records, uint32_t max_bytes) {
+  auto* w = new ptp::RecordIOWriter(path, compressor, max_records,
+                                    max_bytes);
+  if (!w->ok()) {
+    g_error = std::string("cannot open for write: ") + path;
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int ptp_recordio_write(void* handle, const uint8_t* data, size_t size) {
+  return static_cast<ptp::RecordIOWriter*>(handle)->write(data, size) ? 1
+                                                                      : 0;
+}
+
+int ptp_recordio_writer_close(void* handle) {
+  return static_cast<ptp::RecordIOWriter*>(handle)->close() ? 1 : 0;
+}
+
+void ptp_recordio_writer_destroy(void* handle) {
+  delete static_cast<ptp::RecordIOWriter*>(handle);
+}
+
+void* ptp_recordio_scanner_new(const char* path) {
+  auto* s = new ptp::RecordIOScanner(path);
+  if (!s->ok()) {
+    g_error = s->error();
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+// Returns 1 and fills *out/*out_size (caller frees with ptp_free) on
+// success; 0 at EOF or error (check ptp_recordio_scanner_error).
+int ptp_recordio_next(void* handle, uint8_t** out, size_t* out_size) {
+  auto* s = static_cast<ptp::RecordIOScanner*>(handle);
+  std::string rec;
+  if (!s->next(&rec)) return 0;
+  *out_size = rec.size();
+  *out = static_cast<uint8_t*>(malloc(rec.size() ? rec.size() : 1));
+  memcpy(*out, rec.data(), rec.size());
+  return 1;
+}
+
+char* ptp_recordio_scanner_error(void* handle) {
+  return dupString(static_cast<ptp::RecordIOScanner*>(handle)->error());
+}
+
+void ptp_recordio_scanner_reset(void* handle) {
+  static_cast<ptp::RecordIOScanner*>(handle)->reset();
+}
+
+void ptp_recordio_scanner_destroy(void* handle) {
+  delete static_cast<ptp::RecordIOScanner*>(handle);
+}
+
+// ------------------------------------------------------------------ lod
+// All take/return int64 arrays; out buffers are malloc'd.
+int64_t* ptp_lod_lengths_to_offsets(const int64_t* lengths, size_t n,
+                                    size_t* out_n) {
+  auto res = ptp::lengthsToOffsets(
+      std::vector<int64_t>(lengths, lengths + n));
+  *out_n = res.size();
+  auto* buf = static_cast<int64_t*>(malloc(res.size() * 8));
+  memcpy(buf, res.data(), res.size() * 8);
+  return buf;
+}
+
+int64_t* ptp_lod_offsets_to_lengths(const int64_t* offsets, size_t n,
+                                    size_t* out_n) {
+  auto res = ptp::offsetsToLengths(
+      std::vector<int64_t>(offsets, offsets + n));
+  *out_n = res.size();
+  auto* buf = static_cast<int64_t*>(malloc(res.size() * 8 + 8));
+  memcpy(buf, res.data(), res.size() * 8);
+  return buf;
+}
+
+int64_t* ptp_lod_offsets_to_segment_ids(const int64_t* offsets, size_t n,
+                                        size_t* out_n) {
+  auto res = ptp::offsetsToSegmentIds(
+      std::vector<int64_t>(offsets, offsets + n));
+  *out_n = res.size();
+  auto* buf = static_cast<int64_t*>(malloc(res.size() * 8 + 8));
+  memcpy(buf, res.data(), res.size() * 8);
+  return buf;
+}
+
+}  // extern "C"

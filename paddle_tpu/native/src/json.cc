@@ -1,0 +1,334 @@
+#include "json.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+#include <sstream>
+
+namespace ptp {
+
+void Json::set(const std::string& k, JsonPtr v) {
+  for (auto& kv : members_) {
+    if (kv.first == k) {
+      kv.second = std::move(v);
+      return;
+    }
+  }
+  members_.emplace_back(k, std::move(v));
+}
+
+JsonPtr Json::get(const std::string& k) const {
+  for (auto& kv : members_) {
+    if (kv.first == k) return kv.second;
+  }
+  return nullptr;
+}
+
+namespace {
+
+void dumpString(const std::string& s, std::string* out) {
+  out->push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\r': *out += "\\r"; break;
+      case '\t': *out += "\\t"; break;
+      case '\b': *out += "\\b"; break;
+      case '\f': *out += "\\f"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          out->push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out->push_back('"');
+}
+
+void dumpValue(const Json& j, std::string* out) {
+  switch (j.type()) {
+    case Json::Type::Null: *out += "null"; break;
+    case Json::Type::Bool: *out += j.asBool() ? "true" : "false"; break;
+    case Json::Type::Int: {
+      char buf[32];
+      snprintf(buf, sizeof(buf), "%lld",
+               static_cast<long long>(j.asInt()));
+      *out += buf;
+      break;
+    }
+    case Json::Type::Double: {
+      double d = j.asDouble();
+      if (std::isfinite(d)) {
+        char buf[40];
+        snprintf(buf, sizeof(buf), "%.17g", d);
+        // keep the double-ness through a reparse (2.0 -> "2.0", not "2")
+        if (!strpbrk(buf, ".eEnN")) strcat(buf, ".0");
+        *out += buf;
+      } else {
+        // JSON has no inf/nan; mirror Python json.dumps defaults
+        *out += std::isnan(d) ? "NaN" : (d > 0 ? "Infinity" : "-Infinity");
+      }
+      break;
+    }
+    case Json::Type::String: dumpString(j.asString(), out); break;
+    case Json::Type::Array: {
+      out->push_back('[');
+      bool first = true;
+      for (auto& it : j.items()) {
+        if (!first) out->push_back(',');
+        first = false;
+        dumpValue(*it, out);
+      }
+      out->push_back(']');
+      break;
+    }
+    case Json::Type::Object: {
+      out->push_back('{');
+      bool first = true;
+      for (auto& kv : j.members()) {
+        if (!first) out->push_back(',');
+        first = false;
+        dumpString(kv.first, out);
+        out->push_back(':');
+        dumpValue(*kv.second, out);
+      }
+      out->push_back('}');
+      break;
+    }
+  }
+}
+
+class Parser {
+ public:
+  Parser(const char* p, size_t n) : p_(p), end_(p + n) {}
+
+  JsonPtr parse(std::string* err) {
+    JsonPtr v = parseValue(err);
+    if (!v) return nullptr;
+    skipWs();
+    if (p_ != end_) {
+      *err = "trailing characters";
+      return nullptr;
+    }
+    return v;
+  }
+
+ private:
+  void skipWs() {
+    while (p_ != end_ && (*p_ == ' ' || *p_ == '\t' || *p_ == '\n' ||
+                          *p_ == '\r'))
+      ++p_;
+  }
+
+  bool consume(const char* lit) {
+    size_t n = strlen(lit);
+    if (static_cast<size_t>(end_ - p_) < n) return false;
+    if (strncmp(p_, lit, n) != 0) return false;
+    p_ += n;
+    return true;
+  }
+
+  JsonPtr parseValue(std::string* err) {
+    skipWs();
+    if (p_ == end_) {
+      *err = "unexpected end";
+      return nullptr;
+    }
+    char c = *p_;
+    if (c == '{') return parseObject(err);
+    if (c == '[') return parseArray(err);
+    if (c == '"') {
+      std::string s;
+      if (!parseString(&s, err)) return nullptr;
+      return Json::makeString(std::move(s));
+    }
+    if (consume("null")) return Json::makeNull();
+    if (consume("true")) return Json::makeBool(true);
+    if (consume("false")) return Json::makeBool(false);
+    if (consume("NaN")) return Json::makeDouble(NAN);
+    if (consume("Infinity")) return Json::makeDouble(INFINITY);
+    if (consume("-Infinity")) return Json::makeDouble(-INFINITY);
+    return parseNumber(err);
+  }
+
+  bool parseString(std::string* out, std::string* err) {
+    ++p_;  // opening quote
+    while (p_ != end_) {
+      unsigned char c = static_cast<unsigned char>(*p_);
+      if (c == '"') {
+        ++p_;
+        return true;
+      }
+      if (c == '\\') {
+        ++p_;
+        if (p_ == end_) break;
+        char e = *p_++;
+        switch (e) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'u': {
+            if (end_ - p_ < 4) {
+              *err = "bad \\u escape";
+              return false;
+            }
+            unsigned code = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = *p_++;
+              code <<= 4;
+              if (h >= '0' && h <= '9') code |= h - '0';
+              else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+              else {
+                *err = "bad hex in \\u";
+                return false;
+              }
+            }
+            // encode UTF-8 (surrogate pairs for BMP-external not handled;
+            // program descs are ASCII-dominant)
+            if (code < 0x80) {
+              out->push_back(static_cast<char>(code));
+            } else if (code < 0x800) {
+              out->push_back(static_cast<char>(0xC0 | (code >> 6)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            } else {
+              out->push_back(static_cast<char>(0xE0 | (code >> 12)));
+              out->push_back(static_cast<char>(0x80 | ((code >> 6) & 0x3F)));
+              out->push_back(static_cast<char>(0x80 | (code & 0x3F)));
+            }
+            break;
+          }
+          default:
+            *err = "bad escape";
+            return false;
+        }
+      } else {
+        out->push_back(static_cast<char>(c));
+        ++p_;
+      }
+    }
+    *err = "unterminated string";
+    return false;
+  }
+
+  JsonPtr parseNumber(std::string* err) {
+    const char* start = p_;
+    if (p_ != end_ && (*p_ == '-' || *p_ == '+')) ++p_;
+    bool isDouble = false;
+    while (p_ != end_ &&
+           (isdigit(static_cast<unsigned char>(*p_)) || *p_ == '.' ||
+            *p_ == 'e' || *p_ == 'E' || *p_ == '-' || *p_ == '+')) {
+      if (*p_ == '.' || *p_ == 'e' || *p_ == 'E') isDouble = true;
+      ++p_;
+    }
+    if (p_ == start) {
+      *err = "bad number";
+      return nullptr;
+    }
+    std::string tok(start, p_ - start);
+    if (isDouble) return Json::makeDouble(strtod(tok.c_str(), nullptr));
+    return Json::makeInt(strtoll(tok.c_str(), nullptr, 10));
+  }
+
+  JsonPtr parseArray(std::string* err) {
+    ++p_;  // [
+    auto arr = Json::makeArray();
+    skipWs();
+    if (p_ != end_ && *p_ == ']') {
+      ++p_;
+      return arr;
+    }
+    while (true) {
+      JsonPtr v = parseValue(err);
+      if (!v) return nullptr;
+      arr->push(std::move(v));
+      skipWs();
+      if (p_ == end_) {
+        *err = "unterminated array";
+        return nullptr;
+      }
+      if (*p_ == ',') {
+        ++p_;
+        continue;
+      }
+      if (*p_ == ']') {
+        ++p_;
+        return arr;
+      }
+      *err = "expected , or ]";
+      return nullptr;
+    }
+  }
+
+  JsonPtr parseObject(std::string* err) {
+    ++p_;  // {
+    auto obj = Json::makeObject();
+    skipWs();
+    if (p_ != end_ && *p_ == '}') {
+      ++p_;
+      return obj;
+    }
+    while (true) {
+      skipWs();
+      if (p_ == end_ || *p_ != '"') {
+        *err = "expected object key";
+        return nullptr;
+      }
+      std::string key;
+      if (!parseString(&key, err)) return nullptr;
+      skipWs();
+      if (p_ == end_ || *p_ != ':') {
+        *err = "expected :";
+        return nullptr;
+      }
+      ++p_;
+      JsonPtr v = parseValue(err);
+      if (!v) return nullptr;
+      obj->set(key, std::move(v));
+      skipWs();
+      if (p_ == end_) {
+        *err = "unterminated object";
+        return nullptr;
+      }
+      if (*p_ == ',') {
+        ++p_;
+        continue;
+      }
+      if (*p_ == '}') {
+        ++p_;
+        return obj;
+      }
+      *err = "expected , or }";
+      return nullptr;
+    }
+  }
+
+  const char* p_;
+  const char* end_;
+};
+
+}  // namespace
+
+std::string Json::dump() const {
+  std::string out;
+  dumpValue(*this, &out);
+  return out;
+}
+
+JsonPtr Json::parse(const std::string& text, std::string* err) {
+  Parser p(text.data(), text.size());
+  return p.parse(err);
+}
+
+}  // namespace ptp

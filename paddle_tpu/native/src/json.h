@@ -1,0 +1,85 @@
+// Minimal JSON value + parser + writer used as the Python<->C++ bridge
+// for program descriptions. TPU-native counterpart of the reference's
+// protobuf text/binary bridge (reference framework/framework.proto); we
+// use JSON for the in-memory bridge and a custom compact binary format
+// (program.cc) for the on-disk `__model__` artifact.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace ptp {
+
+class Json;
+using JsonPtr = std::shared_ptr<Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  explicit Json(bool b) : type_(Type::Bool), bool_(b) {}
+  explicit Json(int64_t i) : type_(Type::Int), int_(i) {}
+  explicit Json(double d) : type_(Type::Double), dbl_(d) {}
+  explicit Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+
+  static JsonPtr makeNull() { return std::make_shared<Json>(); }
+  static JsonPtr makeBool(bool b) { return std::make_shared<Json>(b); }
+  static JsonPtr makeInt(int64_t i) { return std::make_shared<Json>(i); }
+  static JsonPtr makeDouble(double d) { return std::make_shared<Json>(d); }
+  static JsonPtr makeString(std::string s) {
+    return std::make_shared<Json>(std::move(s));
+  }
+  static JsonPtr makeArray() {
+    auto j = std::make_shared<Json>();
+    j->type_ = Type::Array;
+    return j;
+  }
+  static JsonPtr makeObject() {
+    auto j = std::make_shared<Json>();
+    j->type_ = Type::Object;
+    return j;
+  }
+
+  Type type() const { return type_; }
+  bool isNull() const { return type_ == Type::Null; }
+  bool asBool() const { return bool_; }
+  int64_t asInt() const {
+    return type_ == Type::Double ? static_cast<int64_t>(dbl_) : int_;
+  }
+  double asDouble() const {
+    return type_ == Type::Int ? static_cast<double>(int_) : dbl_;
+  }
+  const std::string& asString() const { return str_; }
+
+  std::vector<JsonPtr>& items() { return items_; }
+  const std::vector<JsonPtr>& items() const { return items_; }
+  void push(JsonPtr v) { items_.push_back(std::move(v)); }
+
+  // object access (insertion-ordered)
+  void set(const std::string& k, JsonPtr v);
+  JsonPtr get(const std::string& k) const;  // nullptr if missing
+  bool has(const std::string& k) const { return get(k) != nullptr; }
+  const std::vector<std::pair<std::string, JsonPtr>>& members() const {
+    return members_;
+  }
+
+  std::string dump() const;
+
+  // Parse; returns nullptr on error and fills *err.
+  static JsonPtr parse(const std::string& text, std::string* err);
+
+ private:
+  Type type_;
+  bool bool_ = false;
+  int64_t int_ = 0;
+  double dbl_ = 0.0;
+  std::string str_;
+  std::vector<JsonPtr> items_;                            // Array
+  std::vector<std::pair<std::string, JsonPtr>> members_;  // Object
+};
+
+}  // namespace ptp

@@ -1,0 +1,61 @@
+#include "lod.h"
+
+namespace ptp {
+
+std::vector<int64_t> lengthsToOffsets(const std::vector<int64_t>& lengths) {
+  std::vector<int64_t> offsets(1, 0);
+  offsets.reserve(lengths.size() + 1);
+  for (int64_t len : lengths) offsets.push_back(offsets.back() + len);
+  return offsets;
+}
+
+std::vector<int64_t> offsetsToLengths(const std::vector<int64_t>& offsets) {
+  std::vector<int64_t> lengths;
+  if (offsets.empty()) return lengths;
+  lengths.reserve(offsets.size() - 1);
+  for (size_t i = 1; i < offsets.size(); ++i)
+    lengths.push_back(offsets[i] - offsets[i - 1]);
+  return lengths;
+}
+
+std::vector<int64_t> offsetsToSegmentIds(
+    const std::vector<int64_t>& offsets) {
+  std::vector<int64_t> ids;
+  if (offsets.empty()) return ids;
+  ids.reserve(offsets.back());
+  for (size_t seg = 1; seg < offsets.size(); ++seg)
+    for (int64_t i = offsets[seg - 1]; i < offsets[seg]; ++i)
+      ids.push_back(static_cast<int64_t>(seg - 1));
+  return ids;
+}
+
+bool validateLod(const Lod& lod, int64_t tensor_outer_dim,
+                 std::string* err) {
+  for (size_t lvl = 0; lvl < lod.size(); ++lvl) {
+    const auto& offs = lod[lvl];
+    if (offs.empty() || offs.front() != 0) {
+      *err = "lod level must start at 0";
+      return false;
+    }
+    for (size_t i = 1; i < offs.size(); ++i) {
+      if (offs[i] < offs[i - 1]) {
+        *err = "lod offsets must be non-decreasing";
+        return false;
+      }
+    }
+    if (lvl + 1 < lod.size()) {
+      // this level's last offset indexes into next level's sequences
+      if (offs.back() !=
+          static_cast<int64_t>(lod[lvl + 1].size()) - 1) {
+        *err = "lod level nesting mismatch";
+        return false;
+      }
+    } else if (tensor_outer_dim >= 0 && offs.back() != tensor_outer_dim) {
+      *err = "last lod level must cover the tensor outer dim";
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace ptp

@@ -1,0 +1,31 @@
+// LoD (level-of-detail) utilities for variable-length sequence batching.
+//
+// TPU-native counterpart of the reference's LoD machinery (reference
+// paddle/fluid/framework/lod_tensor.h:110, lod_tensor.cc — nested offset
+// vectors describing ragged batches). Under XLA's static shapes the
+// runtime representation becomes segment-ids + padded dense tensors;
+// these helpers convert between offsets / lengths / segment ids and
+// validate nesting, serving the Python sequence ops and data feeders.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace ptp {
+
+using Lod = std::vector<std::vector<int64_t>>;
+
+// [3,1,2] -> [0,3,4,6]
+std::vector<int64_t> lengthsToOffsets(const std::vector<int64_t>& lengths);
+// [0,3,4,6] -> [3,1,2]
+std::vector<int64_t> offsetsToLengths(const std::vector<int64_t>& offsets);
+// [0,3,4,6] -> [0,0,0,1,2,2]
+std::vector<int64_t> offsetsToSegmentIds(
+    const std::vector<int64_t>& offsets);
+// Validate nesting: each level's offsets start at 0, are non-decreasing,
+// and level i's last offset equals level i+1's sequence count.
+bool validateLod(const Lod& lod, int64_t tensor_outer_dim,
+                 std::string* err);
+
+}  // namespace ptp

@@ -1,0 +1,641 @@
+#include "program.h"
+
+#include <cstring>
+
+namespace ptp {
+
+std::vector<std::string> OpDesc::inputArgNames() const {
+  std::vector<std::string> out;
+  for (auto& kv : inputs)
+    for (auto& n : kv.second) out.push_back(n);
+  return out;
+}
+
+std::vector<std::string> OpDesc::outputArgNames() const {
+  std::vector<std::string> out;
+  for (auto& kv : outputs)
+    for (auto& n : kv.second) out.push_back(n);
+  return out;
+}
+
+const Attr* OpDesc::findAttr(const std::string& name) const {
+  for (auto& kv : attrs)
+    if (kv.first == name) return &kv.second;
+  return nullptr;
+}
+
+const VarDesc* BlockDesc::findVar(const std::string& name) const {
+  for (auto& v : vars)
+    if (v.name == name) return &v;
+  return nullptr;
+}
+
+const VarDesc* ProgramDesc::findVarRecursive(int32_t block_idx,
+                                             const std::string& name) const {
+  int32_t idx = block_idx;
+  while (idx >= 0 && idx < static_cast<int32_t>(blocks.size())) {
+    const VarDesc* v = blocks[idx].findVar(name);
+    if (v) return v;
+    idx = blocks[idx].parent_idx;
+  }
+  return nullptr;
+}
+
+// ---------------------------------------------------------------- JSON in
+namespace {
+
+bool jsonToAttr(const Json& j, Attr* a, std::string* err) {
+  switch (j.type()) {
+    case Json::Type::Null: a->tag = Attr::Tag::None; return true;
+    case Json::Type::Bool:
+      a->tag = Attr::Tag::Bool;
+      a->b = j.asBool();
+      return true;
+    case Json::Type::Int:
+      a->tag = Attr::Tag::Int;
+      a->i = j.asInt();
+      return true;
+    case Json::Type::Double:
+      a->tag = Attr::Tag::Float;
+      a->f = j.asDouble();
+      return true;
+    case Json::Type::String:
+      a->tag = Attr::Tag::String;
+      a->s = j.asString();
+      return true;
+    case Json::Type::Array: {
+      // classify list element kind; empty list -> Ints
+      bool anyDouble = false, anyString = false, anyBool = false;
+      for (auto& it : j.items()) {
+        switch (it->type()) {
+          case Json::Type::Double: anyDouble = true; break;
+          case Json::Type::Int: break;
+          case Json::Type::String: anyString = true; break;
+          case Json::Type::Bool: anyBool = true; break;
+          default:
+            *err = "unsupported nested list attribute";
+            return false;
+        }
+      }
+      if (anyString) {
+        a->tag = Attr::Tag::Strings;
+        for (auto& it : j.items()) a->strings.push_back(it->asString());
+      } else if (anyBool) {
+        a->tag = Attr::Tag::Bools;
+        for (auto& it : j.items())
+          a->bools.push_back(it->asBool() ? 1 : 0);
+      } else if (anyDouble) {
+        a->tag = Attr::Tag::Floats;
+        for (auto& it : j.items()) a->floats.push_back(it->asDouble());
+      } else {
+        a->tag = Attr::Tag::Ints;
+        for (auto& it : j.items()) a->ints.push_back(it->asInt());
+      }
+      return true;
+    }
+    case Json::Type::Object: {
+      if (auto blk = j.get("__block__")) {
+        a->tag = Attr::Tag::Block;
+        a->block_idx = static_cast<int32_t>(blk->asInt());
+        return true;
+      }
+      if (auto nd = j.get("__ndarray__")) {
+        // flat f64/i64 list + dtype + shape
+        a->tag = Attr::Tag::NdArray;
+        auto dt = j.get("dtype");
+        a->nd_dtype = dt ? dt->asString() : "float32";
+        if (auto sh = j.get("shape"))
+          for (auto& d : sh->items()) a->nd_dims.push_back(d->asInt());
+        bool isFloat = a->nd_dtype.find("float") != std::string::npos;
+        for (auto& it : nd->items()) {
+          if (isFloat) {
+            double v = it->asDouble();
+            float f32 = static_cast<float>(v);
+            if (a->nd_dtype == "float64") {
+              const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+              a->nd_data.insert(a->nd_data.end(), p, p + 8);
+            } else {
+              const uint8_t* p = reinterpret_cast<const uint8_t*>(&f32);
+              a->nd_data.insert(a->nd_data.end(), p, p + 4);
+            }
+          } else {
+            int64_t v = it->asInt();
+            const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+            a->nd_data.insert(a->nd_data.end(), p, p + 8);
+          }
+        }
+        if (a->nd_dims.empty() && !nd->items().empty())
+          a->nd_dims.push_back(static_cast<int64_t>(nd->items().size()));
+        return true;
+      }
+      *err = "unsupported object attribute";
+      return false;
+    }
+  }
+  *err = "unsupported attribute type";
+  return false;
+}
+
+JsonPtr attrToJson(const Attr& a) {
+  switch (a.tag) {
+    case Attr::Tag::None: return Json::makeNull();
+    case Attr::Tag::Bool: return Json::makeBool(a.b);
+    case Attr::Tag::Int: return Json::makeInt(a.i);
+    case Attr::Tag::Float: return Json::makeDouble(a.f);
+    case Attr::Tag::String: return Json::makeString(a.s);
+    case Attr::Tag::Bools: {
+      auto arr = Json::makeArray();
+      for (auto b : a.bools) arr->push(Json::makeBool(b != 0));
+      return arr;
+    }
+    case Attr::Tag::Ints: {
+      auto arr = Json::makeArray();
+      for (auto i : a.ints) arr->push(Json::makeInt(i));
+      return arr;
+    }
+    case Attr::Tag::Floats: {
+      auto arr = Json::makeArray();
+      for (auto f : a.floats) arr->push(Json::makeDouble(f));
+      return arr;
+    }
+    case Attr::Tag::Strings: {
+      auto arr = Json::makeArray();
+      for (auto& s : a.strings) arr->push(Json::makeString(s));
+      return arr;
+    }
+    case Attr::Tag::Block: {
+      auto obj = Json::makeObject();
+      obj->set("__block__", Json::makeInt(a.block_idx));
+      return obj;
+    }
+    case Attr::Tag::NdArray: {
+      auto obj = Json::makeObject();
+      auto flat = Json::makeArray();
+      bool isFloat = a.nd_dtype.find("float") != std::string::npos;
+      size_t elem = (a.nd_dtype == "float32") ? 4 : 8;
+      for (size_t off = 0; off + elem <= a.nd_data.size(); off += elem) {
+        if (isFloat) {
+          if (elem == 4) {
+            float f;
+            memcpy(&f, a.nd_data.data() + off, 4);
+            flat->push(Json::makeDouble(f));
+          } else {
+            double d;
+            memcpy(&d, a.nd_data.data() + off, 8);
+            flat->push(Json::makeDouble(d));
+          }
+        } else {
+          int64_t v;
+          memcpy(&v, a.nd_data.data() + off, 8);
+          flat->push(Json::makeInt(v));
+        }
+      }
+      obj->set("__ndarray__", flat);
+      obj->set("dtype", Json::makeString(a.nd_dtype));
+      auto sh = Json::makeArray();
+      for (auto d : a.nd_dims) sh->push(Json::makeInt(d));
+      obj->set("shape", sh);
+      return obj;
+    }
+  }
+  return Json::makeNull();
+}
+
+bool jsonToIo(
+    const Json& j,
+    std::vector<std::pair<std::string, std::vector<std::string>>>* io) {
+  if (j.type() != Json::Type::Object) return false;
+  for (auto& kv : j.members()) {
+    std::vector<std::string> names;
+    if (kv.second->type() != Json::Type::Array) return false;
+    for (auto& n : kv.second->items()) names.push_back(n->asString());
+    io->emplace_back(kv.first, std::move(names));
+  }
+  return true;
+}
+
+}  // namespace
+
+std::unique_ptr<ProgramDesc> ProgramDesc::fromJson(const Json& j,
+                                                   std::string* err) {
+  auto prog = std::make_unique<ProgramDesc>();
+  auto blocks = j.get("blocks");
+  if (!blocks || blocks->type() != Json::Type::Array) {
+    *err = "missing blocks";
+    return nullptr;
+  }
+  for (auto& bj : blocks->items()) {
+    BlockDesc blk;
+    blk.idx = static_cast<int32_t>(bj->get("idx")->asInt());
+    blk.parent_idx = static_cast<int32_t>(bj->get("parent_idx")->asInt());
+    if (auto vars = bj->get("vars")) {
+      for (auto& vj : vars->items()) {
+        VarDesc v;
+        v.name = vj->get("name")->asString();
+        if (auto sh = vj->get("shape"); sh && !sh->isNull()) {
+          v.has_shape = true;
+          for (auto& d : sh->items()) v.shape.push_back(d->asInt());
+        }
+        if (auto dt = vj->get("dtype"); dt && !dt->isNull())
+          v.dtype = dt->asString();
+        if (auto x = vj->get("lod_level"))
+          v.lod_level = static_cast<int32_t>(x->asInt());
+        if (auto x = vj->get("persistable")) v.persistable = x->asBool();
+        if (auto x = vj->get("stop_gradient")) v.stop_gradient = x->asBool();
+        if (auto x = vj->get("trainable")) v.trainable = x->asBool();
+        if (auto x = vj->get("is_data")) v.is_data = x->asBool();
+        if (auto x = vj->get("type")) v.type = x->asString();
+        blk.vars.push_back(std::move(v));
+      }
+    }
+    if (auto ops = bj->get("ops")) {
+      for (auto& oj : ops->items()) {
+        OpDesc op;
+        op.type = oj->get("type")->asString();
+        if (auto x = oj->get("inputs"))
+          if (!jsonToIo(*x, &op.inputs)) {
+            *err = "bad op inputs";
+            return nullptr;
+          }
+        if (auto x = oj->get("outputs"))
+          if (!jsonToIo(*x, &op.outputs)) {
+            *err = "bad op outputs";
+            return nullptr;
+          }
+        if (auto attrs = oj->get("attrs")) {
+          for (auto& kv : attrs->members()) {
+            Attr a;
+            if (!jsonToAttr(*kv.second, &a, err)) return nullptr;
+            op.attrs.emplace_back(kv.first, std::move(a));
+          }
+        }
+        blk.ops.push_back(std::move(op));
+      }
+    }
+    prog->blocks.push_back(std::move(blk));
+  }
+  if (auto params = j.get("parameters"))
+    for (auto& p : params->items())
+      prog->parameters.push_back(p->asString());
+  return prog;
+}
+
+JsonPtr ProgramDesc::toJson() const {
+  auto root = Json::makeObject();
+  auto blocksArr = Json::makeArray();
+  for (auto& blk : blocks) {
+    auto bj = Json::makeObject();
+    bj->set("idx", Json::makeInt(blk.idx));
+    bj->set("parent_idx", Json::makeInt(blk.parent_idx));
+    auto vars = Json::makeArray();
+    for (auto& v : blk.vars) {
+      auto vj = Json::makeObject();
+      vj->set("name", Json::makeString(v.name));
+      if (v.has_shape) {
+        auto sh = Json::makeArray();
+        for (auto d : v.shape) sh->push(Json::makeInt(d));
+        vj->set("shape", sh);
+      } else {
+        vj->set("shape", Json::makeNull());
+      }
+      vj->set("dtype", v.dtype.empty() ? Json::makeNull()
+                                       : Json::makeString(v.dtype));
+      vj->set("lod_level", Json::makeInt(v.lod_level));
+      vj->set("persistable", Json::makeBool(v.persistable));
+      vj->set("stop_gradient", Json::makeBool(v.stop_gradient));
+      vj->set("trainable", Json::makeBool(v.trainable));
+      vj->set("type", Json::makeString(v.type));
+      vj->set("is_data", Json::makeBool(v.is_data));
+      vars->push(vj);
+    }
+    bj->set("vars", vars);
+    auto ops = Json::makeArray();
+    for (auto& op : blk.ops) {
+      auto oj = Json::makeObject();
+      oj->set("type", Json::makeString(op.type));
+      auto inputs = Json::makeObject();
+      for (auto& kv : op.inputs) {
+        auto arr = Json::makeArray();
+        for (auto& n : kv.second) arr->push(Json::makeString(n));
+        inputs->set(kv.first, arr);
+      }
+      oj->set("inputs", inputs);
+      auto outputs = Json::makeObject();
+      for (auto& kv : op.outputs) {
+        auto arr = Json::makeArray();
+        for (auto& n : kv.second) arr->push(Json::makeString(n));
+        outputs->set(kv.first, arr);
+      }
+      oj->set("outputs", outputs);
+      auto attrs = Json::makeObject();
+      for (auto& kv : op.attrs) attrs->set(kv.first, attrToJson(kv.second));
+      oj->set("attrs", attrs);
+      ops->push(oj);
+    }
+    bj->set("ops", ops);
+    blocksArr->push(bj);
+  }
+  root->set("blocks", blocksArr);
+  auto params = Json::makeArray();
+  for (auto& p : parameters) params->push(Json::makeString(p));
+  root->set("parameters", params);
+  root->set("version", Json::makeInt(1));
+  return root;
+}
+
+// ------------------------------------------------------------ binary serde
+namespace {
+
+constexpr uint32_t kMagic = 0x46505450;  // "PTPF" little-endian
+constexpr uint32_t kVersion = 1;
+
+struct Writer {
+  std::string buf;
+  void u8(uint8_t v) { buf.push_back(static_cast<char>(v)); }
+  void u32(uint32_t v) {
+    buf.append(reinterpret_cast<const char*>(&v), 4);
+  }
+  void i32(int32_t v) { buf.append(reinterpret_cast<const char*>(&v), 4); }
+  void i64(int64_t v) { buf.append(reinterpret_cast<const char*>(&v), 8); }
+  void f64(double v) { buf.append(reinterpret_cast<const char*>(&v), 8); }
+  void str(const std::string& s) {
+    u32(static_cast<uint32_t>(s.size()));
+    buf.append(s);
+  }
+  void bytes(const std::vector<uint8_t>& b) {
+    u32(static_cast<uint32_t>(b.size()));
+    buf.append(reinterpret_cast<const char*>(b.data()), b.size());
+  }
+};
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool fail = false;
+
+  bool need(size_t n) {
+    if (static_cast<size_t>(end - p) < n) {
+      fail = true;
+      return false;
+    }
+    return true;
+  }
+  uint8_t u8() {
+    if (!need(1)) return 0;
+    return *p++;
+  }
+  uint32_t u32() {
+    if (!need(4)) return 0;
+    uint32_t v;
+    memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  int32_t i32() {
+    if (!need(4)) return 0;
+    int32_t v;
+    memcpy(&v, p, 4);
+    p += 4;
+    return v;
+  }
+  int64_t i64() {
+    if (!need(8)) return 0;
+    int64_t v;
+    memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+  double f64() {
+    if (!need(8)) return 0;
+    double v;
+    memcpy(&v, p, 8);
+    p += 8;
+    return v;
+  }
+  std::string str() {
+    uint32_t n = u32();
+    if (!need(n)) return "";
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+  std::vector<uint8_t> bytes() {
+    uint32_t n = u32();
+    std::vector<uint8_t> b;
+    if (!need(n)) return b;
+    b.assign(p, p + n);
+    p += n;
+    return b;
+  }
+};
+
+void writeAttr(Writer* w, const Attr& a) {
+  w->u8(static_cast<uint8_t>(a.tag));
+  switch (a.tag) {
+    case Attr::Tag::None: break;
+    case Attr::Tag::Bool: w->u8(a.b ? 1 : 0); break;
+    case Attr::Tag::Int: w->i64(a.i); break;
+    case Attr::Tag::Float: w->f64(a.f); break;
+    case Attr::Tag::String: w->str(a.s); break;
+    case Attr::Tag::Bools: w->bytes(a.bools); break;
+    case Attr::Tag::Ints:
+      w->u32(static_cast<uint32_t>(a.ints.size()));
+      for (auto v : a.ints) w->i64(v);
+      break;
+    case Attr::Tag::Floats:
+      w->u32(static_cast<uint32_t>(a.floats.size()));
+      for (auto v : a.floats) w->f64(v);
+      break;
+    case Attr::Tag::Strings:
+      w->u32(static_cast<uint32_t>(a.strings.size()));
+      for (auto& v : a.strings) w->str(v);
+      break;
+    case Attr::Tag::Block: w->i32(a.block_idx); break;
+    case Attr::Tag::NdArray:
+      w->str(a.nd_dtype);
+      w->u32(static_cast<uint32_t>(a.nd_dims.size()));
+      for (auto d : a.nd_dims) w->i64(d);
+      w->bytes(a.nd_data);
+      break;
+  }
+}
+
+bool readAttr(Reader* r, Attr* a) {
+  a->tag = static_cast<Attr::Tag>(r->u8());
+  switch (a->tag) {
+    case Attr::Tag::None: break;
+    case Attr::Tag::Bool: a->b = r->u8() != 0; break;
+    case Attr::Tag::Int: a->i = r->i64(); break;
+    case Attr::Tag::Float: a->f = r->f64(); break;
+    case Attr::Tag::String: a->s = r->str(); break;
+    case Attr::Tag::Bools: a->bools = r->bytes(); break;
+    case Attr::Tag::Ints: {
+      uint32_t n = r->u32();
+      for (uint32_t i = 0; i < n && !r->fail; ++i)
+        a->ints.push_back(r->i64());
+      break;
+    }
+    case Attr::Tag::Floats: {
+      uint32_t n = r->u32();
+      for (uint32_t i = 0; i < n && !r->fail; ++i)
+        a->floats.push_back(r->f64());
+      break;
+    }
+    case Attr::Tag::Strings: {
+      uint32_t n = r->u32();
+      for (uint32_t i = 0; i < n && !r->fail; ++i)
+        a->strings.push_back(r->str());
+      break;
+    }
+    case Attr::Tag::Block: a->block_idx = r->i32(); break;
+    case Attr::Tag::NdArray:
+      a->nd_dtype = r->str();
+      {
+        uint32_t n = r->u32();
+        for (uint32_t i = 0; i < n && !r->fail; ++i)
+          a->nd_dims.push_back(r->i64());
+      }
+      a->nd_data = r->bytes();
+      break;
+    default:
+      return false;
+  }
+  return !r->fail;
+}
+
+void writeIo(
+    Writer* w,
+    const std::vector<std::pair<std::string, std::vector<std::string>>>& io) {
+  w->u32(static_cast<uint32_t>(io.size()));
+  for (auto& kv : io) {
+    w->str(kv.first);
+    w->u32(static_cast<uint32_t>(kv.second.size()));
+    for (auto& n : kv.second) w->str(n);
+  }
+}
+
+bool readIo(
+    Reader* r,
+    std::vector<std::pair<std::string, std::vector<std::string>>>* io) {
+  uint32_t n = r->u32();
+  for (uint32_t i = 0; i < n && !r->fail; ++i) {
+    std::string key = r->str();
+    uint32_t m = r->u32();
+    std::vector<std::string> names;
+    for (uint32_t k = 0; k < m && !r->fail; ++k) names.push_back(r->str());
+    io->emplace_back(std::move(key), std::move(names));
+  }
+  return !r->fail;
+}
+
+}  // namespace
+
+std::string ProgramDesc::serialize() const {
+  Writer w;
+  w.u32(kMagic);
+  w.u32(kVersion);
+  w.u32(static_cast<uint32_t>(blocks.size()));
+  for (auto& blk : blocks) {
+    w.i32(blk.idx);
+    w.i32(blk.parent_idx);
+    w.u32(static_cast<uint32_t>(blk.vars.size()));
+    for (auto& v : blk.vars) {
+      w.str(v.name);
+      w.u8(v.has_shape ? 1 : 0);
+      if (v.has_shape) {
+        w.u32(static_cast<uint32_t>(v.shape.size()));
+        for (auto d : v.shape) w.i64(d);
+      }
+      w.str(v.dtype);
+      w.i32(v.lod_level);
+      uint8_t flags = 0;
+      if (v.persistable) flags |= 1;
+      if (v.stop_gradient) flags |= 2;
+      if (v.trainable) flags |= 4;
+      if (v.is_data) flags |= 8;
+      w.u8(flags);
+      w.str(v.type);
+    }
+    w.u32(static_cast<uint32_t>(blk.ops.size()));
+    for (auto& op : blk.ops) {
+      w.str(op.type);
+      writeIo(&w, op.inputs);
+      writeIo(&w, op.outputs);
+      w.u32(static_cast<uint32_t>(op.attrs.size()));
+      for (auto& kv : op.attrs) {
+        w.str(kv.first);
+        writeAttr(&w, kv.second);
+      }
+    }
+  }
+  w.u32(static_cast<uint32_t>(parameters.size()));
+  for (auto& p : parameters) w.str(p);
+  return std::move(w.buf);
+}
+
+std::unique_ptr<ProgramDesc> ProgramDesc::deserialize(const uint8_t* data,
+                                                      size_t size,
+                                                      std::string* err) {
+  Reader r{data, data + size};
+  if (r.u32() != kMagic) {
+    *err = "bad magic (not a PTPF program)";
+    return nullptr;
+  }
+  uint32_t version = r.u32();
+  if (version != kVersion) {
+    *err = "unsupported program version";
+    return nullptr;
+  }
+  auto prog = std::make_unique<ProgramDesc>();
+  uint32_t nblocks = r.u32();
+  for (uint32_t bi = 0; bi < nblocks && !r.fail; ++bi) {
+    BlockDesc blk;
+    blk.idx = r.i32();
+    blk.parent_idx = r.i32();
+    uint32_t nvars = r.u32();
+    for (uint32_t vi = 0; vi < nvars && !r.fail; ++vi) {
+      VarDesc v;
+      v.name = r.str();
+      v.has_shape = r.u8() != 0;
+      if (v.has_shape) {
+        uint32_t nd = r.u32();
+        for (uint32_t d = 0; d < nd && !r.fail; ++d)
+          v.shape.push_back(r.i64());
+      }
+      v.dtype = r.str();
+      v.lod_level = r.i32();
+      uint8_t flags = r.u8();
+      v.persistable = flags & 1;
+      v.stop_gradient = flags & 2;
+      v.trainable = flags & 4;
+      v.is_data = flags & 8;
+      v.type = r.str();
+      blk.vars.push_back(std::move(v));
+    }
+    uint32_t nops = r.u32();
+    for (uint32_t oi = 0; oi < nops && !r.fail; ++oi) {
+      OpDesc op;
+      op.type = r.str();
+      if (!readIo(&r, &op.inputs) || !readIo(&r, &op.outputs)) break;
+      uint32_t nattrs = r.u32();
+      for (uint32_t ai = 0; ai < nattrs && !r.fail; ++ai) {
+        std::string key = r.str();
+        Attr a;
+        if (!readAttr(&r, &a)) break;
+        op.attrs.emplace_back(std::move(key), std::move(a));
+      }
+      blk.ops.push_back(std::move(op));
+    }
+    prog->blocks.push_back(std::move(blk));
+  }
+  uint32_t nparams = r.u32();
+  for (uint32_t i = 0; i < nparams && !r.fail; ++i)
+    prog->parameters.push_back(r.str());
+  if (r.fail) {
+    *err = "truncated or corrupt program";
+    return nullptr;
+  }
+  return prog;
+}
+
+}  // namespace ptp

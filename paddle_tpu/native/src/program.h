@@ -1,0 +1,109 @@
+// Native program representation: ProgramDesc / BlockDesc / OpDesc /
+// VarDesc with JSON bridge and a compact binary on-disk format.
+//
+// TPU-native counterpart of the reference's protobuf program
+// (reference paddle/fluid/framework/framework.proto:24-186 — message
+// OpDesc/VarDesc/BlockDesc/ProgramDesc — and the C++ wrappers
+// framework/program_desc.h, block_desc.h, op_desc.h). The reference
+// serializes ProgramDesc protobufs as the `__model__` artifact
+// (python/paddle/fluid/io.py:865 save_inference_model); here the binary
+// format is a hand-rolled tag/length encoding (magic "PTPF") written and
+// parsed only by this library.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "json.h"
+
+namespace ptp {
+
+// Attribute value (reference framework.proto:26 AttrType)
+struct Attr {
+  enum class Tag : uint8_t {
+    None = 0,
+    Bool = 1,
+    Int = 2,
+    Float = 3,
+    String = 4,
+    Bools = 5,
+    Ints = 6,
+    Floats = 7,
+    Strings = 8,
+    Block = 9,    // sub-block index (control-flow ops)
+    NdArray = 10  // dtype + dims + raw little-endian payload
+  };
+  Tag tag = Tag::None;
+  bool b = false;
+  int64_t i = 0;
+  double f = 0.0;
+  std::string s;
+  std::vector<uint8_t> bools;
+  std::vector<int64_t> ints;
+  std::vector<double> floats;
+  std::vector<std::string> strings;
+  int32_t block_idx = -1;
+  std::string nd_dtype;
+  std::vector<int64_t> nd_dims;
+  std::vector<uint8_t> nd_data;
+};
+
+struct VarDesc {
+  std::string name;
+  bool has_shape = false;
+  std::vector<int64_t> shape;   // -1 = dynamic (batch) dim
+  std::string dtype;            // "float32" etc.; empty = unset
+  int32_t lod_level = 0;
+  bool persistable = false;
+  bool stop_gradient = false;
+  bool trainable = true;
+  bool is_data = false;
+  std::string type = "lod_tensor";  // lod_tensor | lod_tensor_array | ...
+};
+
+struct OpDesc {
+  std::string type;
+  // slot -> argument names, insertion ordered
+  std::vector<std::pair<std::string, std::vector<std::string>>> inputs;
+  std::vector<std::pair<std::string, std::vector<std::string>>> outputs;
+  std::vector<std::pair<std::string, Attr>> attrs;
+
+  std::vector<std::string> inputArgNames() const;
+  std::vector<std::string> outputArgNames() const;
+  const Attr* findAttr(const std::string& name) const;
+};
+
+struct BlockDesc {
+  int32_t idx = 0;
+  int32_t parent_idx = -1;
+  std::vector<VarDesc> vars;  // insertion ordered
+  std::vector<OpDesc> ops;
+
+  const VarDesc* findVar(const std::string& name) const;
+};
+
+struct ProgramDesc {
+  std::vector<BlockDesc> blocks;
+  std::vector<std::string> parameters;
+
+  // Recursive var lookup following parent links (reference
+  // framework/block_desc.cc FindVarRecursive).
+  const VarDesc* findVarRecursive(int32_t block_idx,
+                                  const std::string& name) const;
+
+  // JSON bridge (schema = Python Program.to_dict)
+  static std::unique_ptr<ProgramDesc> fromJson(const Json& j,
+                                               std::string* err);
+  JsonPtr toJson() const;
+
+  // Binary on-disk format
+  std::string serialize() const;
+  static std::unique_ptr<ProgramDesc> deserialize(const uint8_t* data,
+                                                  size_t size,
+                                                  std::string* err);
+};
+
+}  // namespace ptp

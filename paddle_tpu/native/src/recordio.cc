@@ -1,0 +1,189 @@
+#include "recordio.h"
+
+#include <zlib.h>
+
+#include <cstring>
+
+namespace ptp {
+
+namespace {
+constexpr uint32_t kChunkMagic = 0x43525450;  // "PTRC" little-endian
+
+bool writeU32(FILE* f, uint32_t v) {
+  return fwrite(&v, 4, 1, f) == 1;
+}
+
+bool readU32(FILE* f, uint32_t* v) {
+  return fread(v, 4, 1, f) == 1;
+}
+}  // namespace
+
+RecordIOWriter::RecordIOWriter(const std::string& path, uint32_t compressor,
+                               uint32_t max_records_per_chunk,
+                               uint32_t max_chunk_bytes)
+    : compressor_(compressor),
+      max_records_(max_records_per_chunk),
+      max_bytes_(max_chunk_bytes) {
+  file_ = fopen(path.c_str(), "wb");
+}
+
+RecordIOWriter::~RecordIOWriter() { close(); }
+
+bool RecordIOWriter::write(const void* data, size_t size) {
+  if (!file_) return false;
+  pending_.emplace_back(static_cast<const char*>(data), size);
+  pending_bytes_ += size + 4;
+  ++total_records_;
+  if (pending_.size() >= max_records_ || pending_bytes_ >= max_bytes_)
+    return flushChunk();
+  return true;
+}
+
+bool RecordIOWriter::flushChunk() {
+  if (!file_) return false;
+  if (pending_.empty()) return true;
+  std::string payload;
+  payload.reserve(pending_bytes_);
+  for (const auto& rec : pending_) {
+    uint32_t len = static_cast<uint32_t>(rec.size());
+    payload.append(reinterpret_cast<const char*>(&len), 4);
+    payload.append(rec);
+  }
+  std::string body;
+  if (compressor_ == 1) {
+    uLongf bound = compressBound(payload.size());
+    body.resize(bound);
+    if (compress2(reinterpret_cast<Bytef*>(&body[0]), &bound,
+                  reinterpret_cast<const Bytef*>(payload.data()),
+                  payload.size(), Z_DEFAULT_COMPRESSION) != Z_OK)
+      return false;
+    body.resize(bound);
+  } else {
+    body = payload;
+  }
+  uint32_t crc = static_cast<uint32_t>(
+      crc32(0, reinterpret_cast<const Bytef*>(body.data()), body.size()));
+  if (!writeU32(file_, kChunkMagic) || !writeU32(file_, compressor_) ||
+      !writeU32(file_, static_cast<uint32_t>(pending_.size())) ||
+      !writeU32(file_, static_cast<uint32_t>(body.size())) ||
+      !writeU32(file_, crc))
+    return false;
+  if (fwrite(body.data(), 1, body.size(), file_) != body.size())
+    return false;
+  pending_.clear();
+  pending_bytes_ = 0;
+  return true;
+}
+
+bool RecordIOWriter::close() {
+  if (!file_) return true;
+  bool ok = flushChunk();
+  fclose(file_);
+  file_ = nullptr;
+  return ok;
+}
+
+RecordIOScanner::RecordIOScanner(const std::string& path) {
+  file_ = fopen(path.c_str(), "rb");
+  if (!file_) error_ = "cannot open " + path;
+}
+
+RecordIOScanner::~RecordIOScanner() {
+  if (file_) fclose(file_);
+}
+
+void RecordIOScanner::reset() {
+  if (file_) fseek(file_, 0, SEEK_SET);
+  chunk_.clear();
+  cursor_ = 0;
+  error_.clear();
+}
+
+bool RecordIOScanner::loadChunk() {
+  uint32_t magic;
+  if (!readU32(file_, &magic)) return false;  // EOF
+  if (magic != kChunkMagic) {
+    error_ = "bad chunk magic";
+    return false;
+  }
+  uint32_t compressor, nrec, body_len, crc;
+  if (!readU32(file_, &compressor) || !readU32(file_, &nrec) ||
+      !readU32(file_, &body_len) || !readU32(file_, &crc)) {
+    error_ = "truncated chunk header";
+    return false;
+  }
+  std::string body(body_len, '\0');
+  if (body_len &&
+      fread(&body[0], 1, body_len, file_) != body_len) {
+    error_ = "truncated chunk body";
+    return false;
+  }
+  uint32_t actual = static_cast<uint32_t>(
+      crc32(0, reinterpret_cast<const Bytef*>(body.data()), body.size()));
+  if (actual != crc) {
+    error_ = "chunk CRC mismatch";
+    return false;
+  }
+  std::string payload;
+  if (compressor == 1) {
+    // payload size unknown up front: inflate incrementally
+    z_stream zs;
+    memset(&zs, 0, sizeof(zs));
+    if (inflateInit(&zs) != Z_OK) {
+      error_ = "inflateInit failed";
+      return false;
+    }
+    zs.next_in =
+        reinterpret_cast<Bytef*>(const_cast<char*>(body.data()));
+    zs.avail_in = static_cast<uInt>(body.size());
+    char buf[1 << 16];
+    int ret = Z_OK;
+    while (ret != Z_STREAM_END) {
+      zs.next_out = reinterpret_cast<Bytef*>(buf);
+      zs.avail_out = sizeof(buf);
+      ret = inflate(&zs, Z_NO_FLUSH);
+      if (ret != Z_OK && ret != Z_STREAM_END) {
+        inflateEnd(&zs);
+        error_ = "inflate failed";
+        return false;
+      }
+      payload.append(buf, sizeof(buf) - zs.avail_out);
+    }
+    inflateEnd(&zs);
+  } else if (compressor == 0) {
+    payload = std::move(body);
+  } else {
+    error_ = "unknown compressor";
+    return false;
+  }
+  chunk_.clear();
+  size_t off = 0;
+  for (uint32_t i = 0; i < nrec; ++i) {
+    if (off + 4 > payload.size()) {
+      error_ = "corrupt record length";
+      return false;
+    }
+    uint32_t len;
+    memcpy(&len, payload.data() + off, 4);
+    off += 4;
+    if (off + len > payload.size()) {
+      error_ = "corrupt record payload";
+      return false;
+    }
+    chunk_.emplace_back(payload.data() + off, len);
+    off += len;
+  }
+  cursor_ = 0;
+  return true;
+}
+
+bool RecordIOScanner::next(std::string* record) {
+  if (!file_ || !error_.empty()) return false;
+  while (cursor_ >= chunk_.size()) {
+    if (!loadChunk()) return false;
+  }
+  *record = std::move(chunk_[cursor_++]);
+  return true;
+}
+
+}  // namespace ptp

@@ -1,0 +1,64 @@
+// RecordIO: chunked binary record file format with per-chunk CRC32 and
+// optional zlib compression.
+//
+// TPU-native counterpart of the reference's recordio package (reference
+// paddle/fluid/recordio/chunk.cc, scanner.cc, writer.cc — chunked record
+// files used by create_recordio_file_reader). The wire format here is
+// its own: per chunk [magic u32 | compressor u32 | num_records u32 |
+// payload_len u32 | crc32 u32 | payload], payload = concat(len u32,
+// bytes) per record, compressor 0=none 1=zlib.
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace ptp {
+
+class RecordIOWriter {
+ public:
+  // compressor: 0 = none, 1 = zlib
+  RecordIOWriter(const std::string& path, uint32_t compressor = 1,
+                 uint32_t max_records_per_chunk = 1000,
+                 uint32_t max_chunk_bytes = 16 << 20);
+  ~RecordIOWriter();
+
+  bool ok() const { return file_ != nullptr; }
+  bool write(const void* data, size_t size);
+  bool flushChunk();
+  bool close();
+  uint64_t numRecords() const { return total_records_; }
+
+ private:
+  FILE* file_ = nullptr;
+  uint32_t compressor_;
+  uint32_t max_records_;
+  uint32_t max_bytes_;
+  std::vector<std::string> pending_;
+  size_t pending_bytes_ = 0;
+  uint64_t total_records_ = 0;
+};
+
+class RecordIOScanner {
+ public:
+  explicit RecordIOScanner(const std::string& path);
+  ~RecordIOScanner();
+
+  bool ok() const { return file_ != nullptr; }
+  // Returns false at EOF; throws no exceptions — corrupt chunks set
+  // error() and stop the scan.
+  bool next(std::string* record);
+  const std::string& error() const { return error_; }
+  void reset();
+
+ private:
+  bool loadChunk();
+
+  FILE* file_ = nullptr;
+  std::vector<std::string> chunk_;
+  size_t cursor_ = 0;
+  std::string error_;
+};
+
+}  // namespace ptp

@@ -1,0 +1,56 @@
+#include "scope.h"
+
+#include <atomic>
+
+namespace ptp {
+
+namespace {
+std::atomic<int64_t> g_next_slot{1};
+}
+
+int64_t Scope::var(const std::string& name) {
+  auto it = vars_.find(name);
+  if (it != vars_.end()) return it->second;
+  int64_t slot = g_next_slot.fetch_add(1);
+  vars_.emplace(name, slot);
+  return slot;
+}
+
+int64_t Scope::findVar(const std::string& name) const {
+  const Scope* s = this;
+  while (s != nullptr) {
+    auto it = s->vars_.find(name);
+    if (it != s->vars_.end()) return it->second;
+    s = s->parent_;
+  }
+  return -1;
+}
+
+const Scope* Scope::findScope(const std::string& name) const {
+  const Scope* s = this;
+  while (s != nullptr) {
+    if (s->vars_.count(name)) return s;
+    s = s->parent_;
+  }
+  return nullptr;
+}
+
+Scope* Scope::newScope() {
+  kids_.push_back(std::make_unique<Scope>(this));
+  return kids_.back().get();
+}
+
+void Scope::dropKids() { kids_.clear(); }
+
+bool Scope::eraseLocal(const std::string& name) {
+  return vars_.erase(name) > 0;
+}
+
+std::vector<std::string> Scope::localVarNames() const {
+  std::vector<std::string> names;
+  names.reserve(vars_.size());
+  for (auto& kv : vars_) names.push_back(kv.first);
+  return names;
+}
+
+}  // namespace ptp

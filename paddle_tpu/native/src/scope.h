@@ -1,0 +1,45 @@
+// Hierarchical Scope: name -> variable-slot map with parent fallback.
+//
+// TPU-native counterpart of the reference Scope/Variable
+// (reference paddle/fluid/framework/scope.h:45 — Var/FindVar/NewScope/
+// DropKids — and variable.h). Runtime payloads (JAX device arrays) stay
+// on the Python side, keyed by the int64 slot ids this scope allocates;
+// the C++ side owns naming, hierarchy, and lifetime bookkeeping.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace ptp {
+
+class Scope {
+ public:
+  explicit Scope(Scope* parent = nullptr) : parent_(parent) {}
+
+  // Find-or-create in THIS scope (reference Scope::Var)
+  int64_t var(const std::string& name);
+  // Recursive lookup through parents (reference Scope::FindVar); -1 if
+  // absent.
+  int64_t findVar(const std::string& name) const;
+  // Recursive: which scope (this or ancestor) holds name? nullptr if none.
+  const Scope* findScope(const std::string& name) const;
+
+  Scope* newScope();
+  void dropKids();
+  size_t numKids() const { return kids_.size(); }
+  bool eraseLocal(const std::string& name);
+
+  std::vector<std::string> localVarNames() const;
+
+  Scope* parent() const { return parent_; }
+
+ private:
+  Scope* parent_;
+  std::unordered_map<std::string, int64_t> vars_;
+  std::vector<std::unique_ptr<Scope>> kids_;
+};
+
+}  // namespace ptp

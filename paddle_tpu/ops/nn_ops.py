@@ -712,11 +712,15 @@ def attention(ctx):
         dropout_rate = 0.0
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    from . import pallas
     from .pallas import attention as pallas_attn
 
-    if dropout_rate == 0.0 and pallas_attn.usable(q, k, v):
-        return pallas_attn.flash_attention(q, k, v, scale=scale,
-                                           causal=causal)
+    if dropout_rate == 0.0:
+        if pallas_attn.usable(q, k, v):
+            return pallas_attn.flash_attention(q, k, v, scale=scale,
+                                               causal=causal)
+        return pallas.reference_attention(q, k, v, scale, causal)
+    # dropout between softmax and the V product forces the inline form
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if causal:
         tq, tk = logits.shape[-2], logits.shape[-1]

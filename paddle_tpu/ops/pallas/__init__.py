@@ -1,3 +1,37 @@
 """Pallas TPU kernels for the genuinely hot paths (SURVEY.md §7 step 7):
 flash attention, layer_norm. Each module exposes usable() gating so ops
-fall back to jnp compositions off-TPU or on unsupported shapes."""
+fall back to jnp compositions off-TPU or on unsupported shapes.
+
+Shared helpers live here so backend detection and the attention oracle
+exist exactly once (kernel modules and the nn_ops fallback all import
+them).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def on_tpu() -> bool:
+    """True when the default backend is a real or tunneled TPU."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def reference_attention(q, k, v, scale, causal):
+    """Masked-softmax attention oracle: q,k,v [B,H,T,D] -> [B,H,T,D].
+
+    Used as the custom_vjp backward composition for the flash kernel and
+    as the off-TPU forward fallback. Masking uses finfo.min (not -inf) so
+    fully-masked rows yield a uniform distribution instead of NaN.
+    """
+    logits = jnp.einsum("bhqd,bhkd->bhqk",
+                        q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v.astype(
+        jnp.float32)).astype(q.dtype)

@@ -35,15 +35,10 @@ def _pick_block(t: int) -> int:
     return 0
 
 
-def _on_tpu() -> bool:
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        return False
-
-
 def usable(q, k, v) -> bool:
-    if not _on_tpu():
+    from . import on_tpu
+
+    if not on_tpu():
         return False
     b, h, tq, d = q.shape
     tk = k.shape[2]
@@ -58,16 +53,9 @@ def flash_attention(q, k, v, scale=1.0, causal=False):
 
 
 def _reference_attention(q, k, v, scale, causal):
-    logits = jnp.einsum("bhqd,bhkd->bhqk",
-                        q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    if causal:
-        tq, tk = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
-        logits = jnp.where(mask, logits, -jnp.inf)
-    weights = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", weights, v.astype(
-        jnp.float32)).astype(q.dtype)
+    from . import reference_attention
+
+    return reference_attention(q, k, v, scale, causal)
 
 
 def _flash_fwd(q, k, v, scale, causal):

@@ -11,15 +11,10 @@ import jax
 import jax.numpy as jnp
 
 
-def _on_tpu() -> bool:
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        return False
-
-
 def usable(n: int, d: int) -> bool:
-    return _on_tpu() and d % 128 == 0 and n >= 8
+    from . import on_tpu
+
+    return on_tpu() and d % 128 == 0 and n >= 8
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))

@@ -53,7 +53,7 @@ def sequence_pool(ctx):
             x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(
                 jnp.int32).repeat(x.shape[-1], axis=-1) if x.ndim == 3
             else idx[:, None].astype(jnp.int32), axis=1)
-        out = out[:, 0] if x.ndim == 3 else out
+        out = out[:, 0]  # drop the gathered time axis for every rank
     elif ptype == "FIRST":
         out = x[:, 0]
     else:

@@ -104,13 +104,7 @@ def test_dynamic_lstm_trains():
         words.shape = (-1, 8, 6)
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         proj = fluid.layers.fc(words, 16 * 4, num_flatten_dims=2)
-        blk = proj.block
-        blk.create_var(name="w" + SEQ_LEN_SUFFIX, shape=(-1,),
-                       dtype="int32", is_data=True)
-        blk.append_op("assign", {"X": "w" + SEQ_LEN_SUFFIX},
-                      {"Out": proj.name + SEQ_LEN_SUFFIX}, {})
-        blk.create_var(name=proj.name + SEQ_LEN_SUFFIX, shape=(-1,),
-                       dtype="int32")
+        fluid.layers.sequence.bind_seq_len(proj, words)
         h, c = fluid.layers.dynamic_lstm(proj, 16 * 4,
                                          use_peepholes=False)
         last = fluid.layers.sequence_pool(h, "last")
