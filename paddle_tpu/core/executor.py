@@ -112,7 +112,7 @@ def _analyze_block(block, feed_names, fetch_names):
         if ok:
             try:
                 nprog = native.NativeProgram.from_dict(
-                    block.program.to_dict())
+                    block.program._to_analysis_dict())
                 mutated, const, state_out = nprog.analyze_block(
                     block.idx, list(feed_names), list(fetch_names),
                     list(_SKIP_OP_TYPES))

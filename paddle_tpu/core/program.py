@@ -426,6 +426,23 @@ class Program:
         return p
 
     # --- serialization -----------------------------------------------------
+    def _to_analysis_dict(self):
+        """Minimal structural dict for the native dataflow analyzer:
+        op types + io names + var persistability only — skips attribute
+        payloads (ndarrays etc.) that analysis never reads."""
+        blocks = []
+        for blk in self.blocks:
+            blocks.append({
+                "idx": blk.idx,
+                "parent_idx": blk.parent_idx,
+                "vars": [{"name": v.name, "persistable": v.persistable}
+                         for v in blk.vars.values()],
+                "ops": [{"type": op.type, "inputs": op.inputs,
+                         "outputs": op.outputs}
+                        for op in blk.ops],
+            })
+        return {"blocks": blocks, "parameters": list(self._parameters)}
+
     def to_dict(self):
         return {"blocks": [b.to_dict() for b in self.blocks],
                 "parameters": list(self._parameters),

@@ -164,13 +164,14 @@ def save_inference_model(dirname, feeded_var_names: List[str],
     if native.available():
         # native binary program artifact (reference serializes a protobuf
         # ProgramDesc as __model__, io.py:865; here the C++ core writes
-        # its compact PTPF format; feed/fetch ride alongside as JSON)
+        # its compact PTPF format). The full JSON model rides in the
+        # .meta sidecar so the artifact loads on hosts without a C++
+        # toolchain.
         blob = native.NativeProgram.from_dict(model["program"]).to_bytes()
         with open(path, "wb") as f:
             f.write(blob)
         with open(path + ".meta", "w") as f:
-            json.dump({"feed_names": model["feed_names"],
-                       "fetch_names": model["fetch_names"]}, f)
+            json.dump(model, f)
     else:
         with open(path, "w") as f:
             json.dump(model, f)
@@ -189,10 +190,12 @@ def load_inference_model(dirname, executor, model_filename=None,
     if raw[:4] == b"PTPF":
         from . import native
 
-        prog_dict = native.NativeProgram.from_bytes(raw).to_dict()
         with open(path + ".meta") as f:
             model = json.load(f)
-        model["program"] = prog_dict
+        if native.available():
+            model["program"] = native.NativeProgram.from_bytes(
+                raw).to_dict()
+        # else: the .meta sidecar already carries the full program JSON
     else:
         model = json.loads(raw.decode())
     program = Program.from_dict(model["program"])

@@ -69,14 +69,16 @@ def increment(x, value=1.0, in_place=True):
 # (reference lod_tensor_array ops tensor_array_read_write_op.cc) ----------
 def create_array(dtype):
     helper = LayerHelper("array")
-    return helper.create_variable(
-        name=helper.name, dtype=dtype)
+    arr = helper.create_variable(name=helper.name, dtype=dtype)
+    helper.append_op("create_array", {}, {"Out": arr}, {})
+    return arr
 
 
 def array_write(x, i, array=None):
     helper = LayerHelper("array_write", input=x)
     array = array or create_array(x.dtype)
-    helper.append_op("write_to_array", {"X": x, "I": i},
+    helper.append_op("write_to_array",
+                     {"X": x, "I": i, "Array": array},
                      {"Out": array}, {})
     return array
 
@@ -124,7 +126,10 @@ class _WhileBlockGuard:
         sub = prog.current_block()
         prog.rollback()
         parent = prog.current_block()
-        # loop state: vars read from parent + written inside the sub-block
+        # loop state: every parent-visible var the body writes persists
+        # after the loop (fluid While writes through to the enclosing
+        # scope) -- including write-only vars; sub-block-local temps are
+        # not carried (invisible outside, like fluid's step scopes)
         reads, writes = set(), set()
         for op in sub.ops:
             for n in op.input_arg_names:
@@ -132,11 +137,22 @@ class _WhileBlockGuard:
                         is not None:
                     reads.add(n)
             writes.update(op.output_arg_names)
-        carried = sorted(writes & (reads | {self.w.cond_var.name}))
-        externals = sorted(reads - writes)
+        cond_name = self.w.cond_var.name
+        if cond_name not in writes:
+            raise ValueError(
+                "While: the loop body never writes the condition var "
+                f"{cond_name!r} -- the compiled lax.while_loop would "
+                "spin forever. Update it inside the block, e.g. "
+                "layers.less_than(i, limit, cond=cond).")
+        carried = sorted(
+            n for n in writes
+            if n not in sub.vars
+            and parent._find_var_recursive(n) is not None)
+        externals = sorted(reads - set(carried))
         parent.append_op(
             "while",
-            {"Condition": self.w.cond_var.name, "X": externals},
+            {"Condition": self.w.cond_var.name, "X": externals,
+             "Init": carried},
             {"Out": carried},
             {"sub_block": sub, "carried": carried,
              "externals": externals})

@@ -1278,7 +1278,8 @@ from .sequence import (  # noqa: E402,F401
 from .rnn import (  # noqa: E402,F401
     lstm, dynamic_lstm, dynamic_gru, gru_unit, lstm_unit, beam_search,
     beam_search_decode, edit_distance, ctc_greedy_decoder, warpctc, nce,
-    hsigmoid, sampled_softmax_with_cross_entropy)
+    hsigmoid, sampled_softmax_with_cross_entropy, linear_chain_crf,
+    linear_chain_crf_raw, crf_decoding, crf_decoding_raw)
 
 
 def _pair(v):
@@ -1308,3 +1309,5 @@ def attention(q, k, v, causal=False, scale=None, dropout_rate=0.0,
 
 
 __all__.append("attention")
+__all__.extend(["linear_chain_crf", "linear_chain_crf_raw",
+                "crf_decoding", "crf_decoding_raw"])

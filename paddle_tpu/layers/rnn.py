@@ -20,7 +20,9 @@ from .sequence import seq_len_of, SEQ_LEN_SUFFIX
 __all__ = ["lstm", "dynamic_lstm", "dynamic_gru", "gru_unit",
            "lstm_unit", "beam_search", "beam_search_decode",
            "edit_distance", "ctc_greedy_decoder", "warpctc", "nce",
-           "hsigmoid", "sampled_softmax_with_cross_entropy"]
+           "hsigmoid", "sampled_softmax_with_cross_entropy",
+           "linear_chain_crf", "linear_chain_crf_raw", "crf_decoding",
+           "crf_decoding_raw"]
 
 
 def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
@@ -179,13 +181,22 @@ def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
     return sel_ids, sel_scores
 
 
-def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+def beam_search_decode(ids, scores, beam_size, end_id, name=None,
+                       parents=None):
+    """Backtrack stacked beam selections (reference
+    beam_search_decode_op.cc). The reference recovers lineage from LoD
+    offsets; the static-shape port takes it as the explicit `parents`
+    tensor produced by beam_search(return_parent_idx=True). Without
+    `parents`, each beam is treated as its own ancestor (greedy/
+    already-aligned stacks)."""
     helper = LayerHelper("beam_search_decode", input=ids, name=name)
     out_ids = helper.create_variable_for_type_inference("int64", True)
     out_scores = helper.create_variable_for_type_inference(
         scores.dtype, True)
-    helper.append_op("beam_search_decode",
-                     {"Ids": ids, "Scores": scores},
+    inputs = {"Ids": ids, "Scores": scores}
+    if parents is not None:
+        inputs["Parents"] = parents
+    helper.append_op("beam_search_decode", inputs,
                      {"SentenceIds": out_ids,
                       "SentenceScores": out_scores},
                      {"beam_size": beam_size, "end_id": end_id})
@@ -293,3 +304,55 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
          "remove_accidental_hits": remove_accidental_hits,
          "seed": seed})
     return loss
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Linear-chain CRF cost (reference layers/nn.py linear_chain_crf,
+    linear_chain_crf_op.h). Creates the [size+2, size] transition
+    parameter (row 0 start, row 1 end weights); returns the per-sequence
+    negative log-likelihood to minimize."""
+    helper = LayerHelper("linear_chain_crf", input=input,
+                         param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        helper.param_attr, [size + 2, size], input.dtype)
+    return linear_chain_crf_raw(input, transition, label, length=length)
+
+
+def linear_chain_crf_raw(emission, transition, label, length=None):
+    helper = LayerHelper("linear_chain_crf", input=emission)
+    ll = helper.create_variable_for_type_inference(emission.dtype)
+    alpha = helper.create_variable_for_type_inference(emission.dtype,
+                                                      True)
+    inputs = {"Emission": emission, "Transition": transition,
+              "Label": label}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("linear_chain_crf", inputs,
+                     {"LogLikelihood": ll, "Alpha": alpha}, {})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode with the transition param created by
+    linear_chain_crf (reference crf_decoding_op.h); pass the same
+    ParamAttr name to share it."""
+    helper = LayerHelper("crf_decoding", input=input,
+                         param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        helper.param_attr, [size + 2, size], input.dtype)
+    return crf_decoding_raw(input, transition, label=label,
+                            length=length)
+
+
+def crf_decoding_raw(emission, transition, label=None, length=None):
+    helper = LayerHelper("crf_decoding", input=emission)
+    path = helper.create_variable_for_type_inference("int64", True)
+    inputs = {"Emission": emission, "Transition": transition}
+    if label is not None:
+        inputs["Label"] = label
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("crf_decoding", inputs, {"ViterbiPath": path}, {})
+    return path

@@ -44,6 +44,67 @@ const VarDesc* ProgramDesc::findVarRecursive(int32_t block_idx,
 // ---------------------------------------------------------------- JSON in
 namespace {
 
+size_t ndElemSize(const std::string& dtype) {
+  if (dtype == "float64" || dtype == "int64" || dtype == "uint64")
+    return 8;
+  if (dtype == "float16" || dtype == "bfloat16") return 2;
+  if (dtype.find("float") != std::string::npos) return 4;
+  return 8;  // all integer dtypes ride as int64
+}
+
+uint16_t floatToBf16(float f) {
+  uint32_t bits;
+  memcpy(&bits, &f, 4);
+  // round-to-nearest-even on the dropped mantissa bits
+  uint32_t rounded = bits + 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+float bf16ToFloat(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
+uint16_t floatToHalf(float f) {
+  uint32_t x;
+  memcpy(&x, &f, 4);
+  uint32_t sign = (x >> 16) & 0x8000;
+  int32_t exp = static_cast<int32_t>((x >> 23) & 0xFF) - 127 + 15;
+  uint32_t mant = x & 0x7FFFFF;
+  if (exp <= 0) return static_cast<uint16_t>(sign);  // flush to zero
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7C00);  // inf
+  return static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
+}
+
+float halfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal: normalize
+      int shift = 0;
+      while (!(mant & 0x400)) {
+        mant <<= 1;
+        ++shift;
+      }
+      mant &= 0x3FF;
+      bits = sign | ((127 - 15 - shift + 1) << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7F800000 | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  memcpy(&f, &bits, 4);
+  return f;
+}
+
 bool jsonToAttr(const Json& j, Attr* a, std::string* err) {
   switch (j.type()) {
     case Json::Type::Null: a->tag = Attr::Tag::None; return true;
@@ -100,23 +161,31 @@ bool jsonToAttr(const Json& j, Attr* a, std::string* err) {
         return true;
       }
       if (auto nd = j.get("__ndarray__")) {
-        // flat f64/i64 list + dtype + shape
+        // flat numeric list + dtype + shape; packed per element width
         a->tag = Attr::Tag::NdArray;
         auto dt = j.get("dtype");
         a->nd_dtype = dt ? dt->asString() : "float32";
         if (auto sh = j.get("shape"))
           for (auto& d : sh->items()) a->nd_dims.push_back(d->asInt());
-        bool isFloat = a->nd_dtype.find("float") != std::string::npos;
+        bool isFloat = a->nd_dtype.find("float") != std::string::npos ||
+                       a->nd_dtype == "bfloat16";
+        size_t elem = ndElemSize(a->nd_dtype);
         for (auto& it : nd->items()) {
           if (isFloat) {
             double v = it->asDouble();
-            float f32 = static_cast<float>(v);
-            if (a->nd_dtype == "float64") {
+            if (elem == 8) {
               const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
               a->nd_data.insert(a->nd_data.end(), p, p + 8);
-            } else {
+            } else if (elem == 4) {
+              float f32 = static_cast<float>(v);
               const uint8_t* p = reinterpret_cast<const uint8_t*>(&f32);
               a->nd_data.insert(a->nd_data.end(), p, p + 4);
+            } else {  // float16 / bfloat16
+              uint16_t bits = (a->nd_dtype == "bfloat16")
+                                  ? floatToBf16(static_cast<float>(v))
+                                  : floatToHalf(static_cast<float>(v));
+              const uint8_t* p = reinterpret_cast<const uint8_t*>(&bits);
+              a->nd_data.insert(a->nd_data.end(), p, p + 2);
             }
           } else {
             int64_t v = it->asInt();
@@ -171,18 +240,25 @@ JsonPtr attrToJson(const Attr& a) {
     case Attr::Tag::NdArray: {
       auto obj = Json::makeObject();
       auto flat = Json::makeArray();
-      bool isFloat = a.nd_dtype.find("float") != std::string::npos;
-      size_t elem = (a.nd_dtype == "float32") ? 4 : 8;
+      bool isFloat = a.nd_dtype.find("float") != std::string::npos ||
+                     a.nd_dtype == "bfloat16";
+      size_t elem = ndElemSize(a.nd_dtype);
       for (size_t off = 0; off + elem <= a.nd_data.size(); off += elem) {
         if (isFloat) {
           if (elem == 4) {
             float f;
             memcpy(&f, a.nd_data.data() + off, 4);
             flat->push(Json::makeDouble(f));
-          } else {
+          } else if (elem == 8) {
             double d;
             memcpy(&d, a.nd_data.data() + off, 8);
             flat->push(Json::makeDouble(d));
+          } else {
+            uint16_t h;
+            memcpy(&h, a.nd_data.data() + off, 2);
+            flat->push(Json::makeDouble(
+                a.nd_dtype == "bfloat16" ? bf16ToFloat(h)
+                                         : halfToFloat(h)));
           }
         } else {
           int64_t v;

@@ -6,3 +6,6 @@ from . import optimizer_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import rnn_ops  # noqa: F401
+from . import control_flow_ops  # noqa: F401
+from . import decode_ops  # noqa: F401
+from . import loss_extra_ops  # noqa: F401

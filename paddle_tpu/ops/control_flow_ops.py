@@ -1,0 +1,155 @@
+"""Control-flow op kernels: while / conditional_block / tensor arrays.
+
+TPU-native counterparts of the reference's sub-block-interpreting ops
+(reference operators/controlflow/while_op.cc — runs the sub-block via an
+inner Executor per iteration — and conditional_block_op.cc,
+tensor_array_read_write_op.cc). Here the sub-block is *traced* into the
+enclosing XLA computation: `while` lowers to lax.while_loop over an
+explicit carry (the vars the body mutates), `conditional_block` to
+lax.cond over both traced branches. Data-dependent trip counts stay on
+device; data-dependent *shapes* remain illegal (XLA static-shape rule).
+
+Tensor arrays are trace-time Python lists of traced values: writes
+append in program order, reads index statically when possible and fall
+back to a stacked dynamic gather. Inside a lax.while_loop body the carry
+must be jax types, so arrays cannot be loop-carried — scan-based RNNs
+(ops/rnn_ops.py) are the supported dynamic-sequence path, matching the
+SURVEY §5 obligation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, run_op
+
+
+class TensorArray(list):
+    """Marker type for LoDTensorArray values living in the executor env."""
+
+
+def _no_infer(op, block):
+    return None
+
+
+@register_op("while", differentiable=False, infer_shape=_no_infer,
+             stop_gradient_slots=("Condition",))
+def while_op(ctx):
+    """lax.while_loop over the traced sub-block.
+
+    inputs: Condition (bool, must be among the carried writes or the
+    loop never ends), X = externals (read-only), Init = carried initial
+    values. outputs: Out = carried finals. attrs: sub_block, carried,
+    externals.
+    """
+    sub = ctx.attr("sub_block")
+    carried = list(ctx.attr("carried"))
+    externals = list(ctx.attr("externals"))
+    cond_name = ctx.op.inputs["Condition"][0]
+    ext_env = dict(zip(externals, ctx.inputs("X")))
+    init = tuple(ctx.inputs("Init"))
+
+    def as_pred(v):
+        return jnp.reshape(v, ()).astype(bool)
+
+    def cond_fn(carry):
+        env = dict(ext_env)
+        env.update(zip(carried, carry))
+        if cond_name in env:
+            return as_pred(env[cond_name])
+        raise ValueError(
+            f"while: condition var {cond_name!r} is neither carried nor "
+            f"external — the loop body must update it")
+
+    def body_fn(carry):
+        env = dict(ext_env)
+        env.update(zip(carried, carry))
+        for i, op in enumerate(sub.ops):
+            run_op(op, env, rng_cell=None, rng_salt=i)
+        return tuple(env[n] for n in carried)
+
+    final = lax.while_loop(cond_fn, body_fn, init)
+    return {"Out": list(final)}
+
+
+@register_op("conditional_block", infer_shape=_no_infer,
+             stop_gradient_slots=("Condition",))
+def conditional_block(ctx):
+    """lax.cond over two traced branches (reference
+    conditional_block_op.cc; the fluid layers.cond API)."""
+    tb = ctx.attr("true_block")
+    fb = ctx.attr("false_block")
+    t_out = ctx.attr("true_out")
+    f_out = ctx.attr("false_out")
+    x_names = list(ctx.op.inputs.get("X", []))
+    x_vals = ctx.inputs("X")
+    pred = jnp.reshape(ctx.input("Condition"), ()).astype(bool)
+
+    def branch(blk, out_name):
+        def f(vals):
+            env = dict(zip(x_names, vals))
+            for i, op in enumerate(blk.ops):
+                run_op(op, env, rng_cell=None, rng_salt=i)
+            return env[out_name]
+
+        return f
+
+    if f_out is None:
+        raise ValueError("cond: both true_fn and false_fn must return a "
+                         "value (XLA branches need matching outputs)")
+    return lax.cond(pred, branch(tb, t_out), branch(fb, f_out), x_vals)
+
+
+# --------------------------------------------------------------------------
+# LoDTensorArray ops (reference tensor_array_read_write_op.cc,
+# lod_array_length_op.cc). Arrays are trace-time lists (see module doc).
+# --------------------------------------------------------------------------
+def _static_index(i):
+    """Extract a Python int from a traced index if it is concrete."""
+    try:
+        return int(i)
+    except Exception:
+        return None
+
+
+@register_op("create_array", differentiable=False, infer_shape=_no_infer)
+def create_array_op(ctx):
+    return {"Out": [TensorArray()]}
+
+
+@register_op("write_to_array", differentiable=False,
+             infer_shape=_no_infer, stop_gradient_slots=("I",))
+def write_to_array(ctx):
+    x = ctx.input("X")
+    prev = ctx.input("Array")
+    arr = TensorArray(prev) if isinstance(prev, list) else TensorArray()
+    i = ctx.input("I")
+    idx = _static_index(i) if i is not None else len(arr)
+    if idx is None or idx >= len(arr):
+        arr.append(x)  # append-only fill (program-order writes)
+    else:
+        arr[idx] = x
+    return {"Out": [arr]}
+
+
+@register_op("read_from_array", differentiable=False,
+             infer_shape=_no_infer, stop_gradient_slots=("I",))
+def read_from_array(ctx):
+    arr = ctx.input("X")
+    if not isinstance(arr, list):
+        raise TypeError("read_from_array: input is not a tensor array")
+    i = ctx.input("I")
+    idx = _static_index(i)
+    if idx is not None:
+        return {"Out": arr[idx]}
+    # dynamic index: stack (uniform shapes) and gather on device
+    stacked = jnp.stack(list(arr))
+    return {"Out": stacked[jnp.reshape(i, ()).astype(jnp.int32)]}
+
+
+@register_op("lod_array_length", differentiable=False,
+             infer_shape=_no_infer)
+def lod_array_length(ctx):
+    arr = ctx.input("X")
+    return {"Out": jnp.asarray([len(arr)], dtype=jnp.int64)}

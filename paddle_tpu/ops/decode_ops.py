@@ -1,0 +1,219 @@
+"""Decoding ops: beam search, beam-search decode, edit distance,
+ctc alignment.
+
+TPU-native counterparts of the reference's decode machinery (reference
+operators/beam_search_op.cc, beam_search_decode_op.cc, ctc_align_op.cc,
+edit_distance_op.cc, math/beam_search.cc). The reference represents beams
+via LoD offsets mutated on the host between steps; here everything is
+static-shape device math — beams are a dense [batch, beam] axis, parents
+are explicit index tensors, and the backtrack is a lax.scan — so whole
+decode loops compile into one XLA program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+@register_op("beam_search", differentiable=False,
+             stop_gradient_slots=("pre_ids", "ids"))
+def beam_search(ctx):
+    """One beam-search step over dense [batch*beam, K] candidates.
+
+    inputs: pre_ids [B*beam, 1] int64 (last selected ids), pre_scores
+    [B*beam, 1] f32 (cumulative log-probs), ids [B*beam, K] int64
+    (top-K candidate token ids per beam), scores [B*beam, K] f32
+    (their log-probs). attrs: beam_size, end_id.
+    outputs: selected_ids [B*beam, 1], selected_scores [B*beam, 1],
+    parent_idx [B*beam] int32 (which source beam each selection extends,
+    absolute row index — the fluid 1.4 op encodes this via LoD; the
+    explicit tensor is the static-shape equivalent).
+
+    Finished beams (pre_id == end_id) are frozen: their only candidate is
+    end_id with unchanged score (reference math/beam_search.cc same rule).
+    """
+    pre_ids = ctx.input("pre_ids")
+    pre_scores = ctx.input("pre_scores")
+    ids = ctx.input("ids")
+    scores = ctx.input("scores")
+    beam = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id", 0))
+
+    rows = ids.shape[0]
+    k = ids.shape[1]
+    b = rows // beam
+    finished = (pre_ids.reshape(rows) == end_id)
+
+    total = pre_scores.reshape(rows, 1) + scores  # [rows, K]
+    neg = jnp.finfo(total.dtype).min
+    # frozen beams: candidate 0 = end_id @ pre_score, others impossible
+    frozen_scores = jnp.concatenate(
+        [pre_scores.reshape(rows, 1),
+         jnp.full((rows, k - 1), neg, total.dtype)], axis=1)
+    frozen_ids = jnp.full((rows, k), end_id, ids.dtype)
+    total = jnp.where(finished[:, None], frozen_scores, total)
+    cand_ids = jnp.where(finished[:, None], frozen_ids, ids)
+
+    # per batch: pick top beam among beam*K candidates
+    total_b = total.reshape(b, beam * k)
+    ids_b = cand_ids.reshape(b, beam * k)
+    top_scores, top_pos = lax.top_k(total_b, beam)      # [b, beam]
+    sel_ids = jnp.take_along_axis(ids_b, top_pos, axis=1)
+    src_beam = top_pos // k                             # [b, beam]
+    parent = (src_beam +
+              jnp.arange(b, dtype=src_beam.dtype)[:, None] * beam)
+
+    return {"selected_ids": sel_ids.reshape(rows, 1),
+            "selected_scores": top_scores.reshape(rows, 1),
+            "parent_idx": parent.reshape(rows).astype(jnp.int32)}
+
+
+@register_op("beam_search_decode", differentiable=False,
+             stop_gradient_slots=("Ids", "Parents"))
+def beam_search_decode(ctx):
+    """Backtrack stacked per-step selections into full sequences.
+
+    inputs: Ids — tensor array (or stacked [T, B*beam, 1]) of selected
+    ids; Parents — same-shaped parent_idx per step; Scores (optional) —
+    per-step cumulative scores. attrs: beam_size, end_id.
+    outputs: SentenceIds [T, B*beam] int64 (backtracked token per step),
+    SentenceScores [B*beam] f32 (final cumulative score of each beam).
+    Reference beam_search_decode_op.cc builds LoDTensor sentences on
+    host; the static-shape output pads finished rows with end_id.
+    """
+    ids = ctx.input("Ids")
+    parents = ctx.input("Parents")
+    scores = ctx.input("Scores")
+    if isinstance(ids, list):
+        ids = jnp.stack(list(ids))
+    if isinstance(parents, list):
+        parents = jnp.stack(list(parents))
+    if isinstance(scores, list):
+        scores = jnp.stack(list(scores))
+    t = ids.shape[0]
+    ids2 = ids.reshape(t, -1)          # [T, rows]
+    rows = ids2.shape[1]
+    if parents is None:
+        # no lineage: each beam is its own ancestor
+        parents = jnp.broadcast_to(
+            jnp.arange(rows, dtype=jnp.int32)[None, :], (t, rows))
+    par2 = parents.reshape(t, -1).astype(jnp.int32)
+
+    # backward scan: carry = beam assignment at step s+1
+    def step(carry, xs):
+        step_ids, step_par = xs
+        tok = step_ids[carry]
+        carry_prev = step_par[carry]
+        return carry_prev, tok
+
+    init = jnp.arange(rows, dtype=jnp.int32)
+    _, toks = lax.scan(step, init, (ids2[::-1], par2[::-1]))
+    sentence = toks[::-1]              # [T, rows]
+    if scores is None:
+        final_scores = jnp.zeros((rows,), jnp.float32)
+    elif scores.shape[0] == t and scores.size == t * rows:
+        final_scores = scores.reshape(t, -1)[-1]  # per-step stack
+    else:
+        final_scores = scores.reshape(-1)         # already final [rows]
+    return {"SentenceIds": sentence.astype(jnp.int64),
+            "SentenceScores": final_scores}
+
+
+@register_op("edit_distance", differentiable=False,
+             stop_gradient_slots=("Hyps", "Refs", "HypsLen", "RefsLen"))
+def edit_distance(ctx):
+    """Batched Levenshtein distance over padded int sequences.
+
+    inputs: Hyps [B, Th], Refs [B, Tr] int64 (padded), HypsLen/RefsLen
+    [B] actual lengths (optional; default full width). attr: normalized.
+    outputs: Out [B, 1] f32 distances, SequenceNum [1] int64.
+    Reference edit_distance_op.cc runs the same DP per LoD sequence on
+    host/CUDA; here one lax.scan over ref positions updates all batch
+    rows' DP columns in parallel (vectorized over B and Th).
+    """
+    hyps = ctx.input("Hyps")
+    refs = ctx.input("Refs")
+    b, th = hyps.shape[0], hyps.shape[1]
+    tr = refs.shape[1]
+    hlen = ctx.input("HypsLen")
+    rlen = ctx.input("RefsLen")
+    if hlen is None:
+        hlen = jnp.full((b,), th, jnp.int32)
+    if rlen is None:
+        rlen = jnp.full((b,), tr, jnp.int32)
+    hlen = hlen.reshape(b).astype(jnp.int32)
+    rlen = rlen.reshape(b).astype(jnp.int32)
+
+    # DP over ref prefix length i: row[j] = dist(ref[:i], hyp[:j]).
+    # Positions j > hlen are clamped by masking at the end; interior
+    # cells beyond length are computed but unused.
+    j_idx = jnp.arange(th + 1)
+    row0 = jnp.broadcast_to(j_idx.astype(jnp.float32),
+                            (b, th + 1))  # dist(ref[:0], hyp[:j]) = j
+
+    def step(row, i):
+        ref_tok = refs[:, i]                              # [B]
+        sub_cost = (hyps != ref_tok[:, None]).astype(jnp.float32)
+        base = jnp.full((b,), jnp.float32(i + 1))
+
+        def inner(carry, j):
+            # carry = new_row[j-1]; row is the previous DP row (closure)
+            delete = row[:, j] + 1.0
+            insert = carry + 1.0
+            substitute = row[:, j - 1] + sub_cost[:, j - 1]
+            val = jnp.minimum(jnp.minimum(delete, insert), substitute)
+            return val, val
+
+        _, inner_vals = lax.scan(inner, base, jnp.arange(1, th + 1))
+        new_row = jnp.concatenate([base[:, None], inner_vals.T], axis=1)
+        # rows whose ref is shorter than i+1 keep their old DP row
+        active = (i < rlen)[:, None]
+        new_row = jnp.where(active, new_row, row)
+        return new_row, None
+
+    final_row, _ = lax.scan(step, row0, jnp.arange(tr))
+    dist = jnp.take_along_axis(final_row, hlen[:, None], axis=1)[:, 0]
+    if ctx.attr("normalized", False):
+        dist = dist / jnp.maximum(rlen.astype(jnp.float32), 1.0)
+    return {"Out": dist.reshape(b, 1),
+            "SequenceNum": jnp.asarray([b], jnp.int64)}
+
+
+@register_op("ctc_align", differentiable=False,
+             stop_gradient_slots=("Input", "InputLen"))
+def ctc_align(ctx):
+    """CTC post-alignment: merge repeats, drop blanks (reference
+    ctc_align_op.cc). inputs: Input [B, T] int (argmax path), optional
+    InputLen [B]. attr: blank. outputs: Output [B, T] with the merged
+    tokens left-aligned and `blank`-padded, OutputLen [B].
+    """
+    x = ctx.input("Input")
+    b, t = x.shape[0], x.shape[1]
+    blank = int(ctx.attr("blank", 0))
+    xlen = ctx.input("InputLen")
+    if xlen is None:
+        xlen = ctx.input("SeqLen")
+    if xlen is None:
+        xlen = jnp.full((b,), t, jnp.int32)
+    xlen = xlen.reshape(b).astype(jnp.int32)
+
+    pos_idx = jnp.arange(t)
+    valid = pos_idx[None, :] < xlen[:, None]
+    prev = jnp.concatenate(
+        [jnp.full((b, 1), -1, x.dtype), x[:, :-1]], axis=1)
+    keep = valid & (x != blank) & (x != prev)
+    # left-align kept tokens: target position = exclusive cumsum of keep
+    tgt = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out = jnp.full((b, t), blank, x.dtype)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    # scatter only kept entries (dump non-kept into a trash column)
+    tgt_safe = jnp.where(keep, tgt, t)
+    out_pad = jnp.full((b, t + 1), blank, x.dtype)
+    out_pad = out_pad.at[rows, tgt_safe].set(
+        jnp.where(keep, x, blank))
+    out = out_pad[:, :t]
+    out_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+    return {"Output": out, "OutputLen": out_len}

@@ -1,0 +1,321 @@
+"""Sampled / structured losses: CTC, linear-chain CRF (+viterbi), NCE,
+hierarchical sigmoid, sampled logits.
+
+TPU-native counterparts of the reference ops (reference
+operators/warpctc_op.cc — binds the external warp-ctc library —
+linear_chain_crf_op.cc/.h, crf_decoding_op.cc, nce_op.cc,
+hierarchical_sigmoid_op.cc, sample_logits_op.cc). The reference computes
+these on host/CUDA with hand-written gradients; here each forward is a
+pure lax.scan/jnp composition over padded dense batches, and gradients
+fall out of jax.vjp through the scan (no hand-written backward).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+_NEG = -1e30
+
+
+@register_op("warpctc", stop_gradient_slots=("Label", "LogitsLen",
+                                             "LabelLen"))
+def warpctc(ctx):
+    """CTC loss via the log-space alpha recursion (replaces the warp-ctc
+    external binding, reference warpctc_op.cc).
+
+    inputs: Logits [B, T, C] raw (softmax applied inside, matching
+    fluid's norm_by_times-free default), Label [B, L] int (no blanks),
+    LogitsLen [B], LabelLen [B]. attr: blank (default 0).
+    outputs: Loss [B, 1] = -log p(label | logits).
+    """
+    logits = ctx.input("Logits")
+    label = ctx.input("Label")
+    b, t, c = logits.shape
+    l = label.shape[1]
+    blank = int(ctx.attr("blank", 0))
+    tlen = ctx.input("LogitsLen")
+    llen = ctx.input("LabelLen")
+    tlen = (jnp.full((b,), t, jnp.int32) if tlen is None
+            else tlen.reshape(b).astype(jnp.int32))
+    llen = (jnp.full((b,), l, jnp.int32) if llen is None
+            else llen.reshape(b).astype(jnp.int32))
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    s = 2 * l + 1
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((b, s), blank, label.dtype)
+    ext = ext.at[:, 1::2].set(label)
+    ext_valid = jnp.arange(s)[None, :] < (2 * llen + 1)[:, None]
+
+    # allowed skip: alpha[s] can come from s-2 when ext[s] != blank and
+    # ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate(
+        [jnp.full((b, 2), -1, ext.dtype), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_prev2)
+
+    def emit(t_idx):
+        return jnp.take_along_axis(logp[:, t_idx, :], ext.astype(jnp.int32),
+                                   axis=1)  # [B, S]
+
+    alpha0 = jnp.full((b, s), _NEG, jnp.float32)
+    alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(llen > 0, emit(0)[:, 1], _NEG))
+
+    def step(alpha, t_idx):
+        shifted1 = jnp.concatenate(
+            [jnp.full((b, 1), _NEG), alpha[:, :-1]], axis=1)
+        shifted2 = jnp.concatenate(
+            [jnp.full((b, 2), _NEG), alpha[:, :-2]], axis=1)
+        stay = jnp.logaddexp(alpha, shifted1)
+        new = jnp.where(can_skip, jnp.logaddexp(stay, shifted2), stay)
+        new = new + emit(t_idx)
+        new = jnp.where(ext_valid, new, _NEG)
+        # frames beyond this row's length keep old alpha
+        active = (t_idx < tlen)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, t))
+    last = 2 * llen  # index of final blank in ext
+    a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(
+        alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+    a_prev = jnp.where(llen > 0, a_prev, _NEG)
+    loss = -jnp.logaddexp(a_last, a_prev)
+    return {"Loss": loss.reshape(b, 1)}
+
+
+@register_op("linear_chain_crf",
+             stop_gradient_slots=("Label", "Length"))
+def linear_chain_crf(ctx):
+    """Negative log-likelihood of a linear-chain CRF (reference
+    linear_chain_crf_op.h — same parameterization: Transition row 0 =
+    start weights, row 1 = end weights, rows 2.. = [C, C] transitions).
+
+    inputs: Emission [B, T, C], Transition [C+2, C], Label [B, T] int,
+    Length [B] (optional). outputs: LogLikelihood [B, 1] (negative NLL,
+    i.e. log p — matching fluid, which returns the log-likelihood and
+    trains on its negation via mean+scale), Alpha [B, T, C].
+    """
+    em = ctx.input("Emission").astype(jnp.float32)
+    trans = ctx.input("Transition").astype(jnp.float32)
+    label = ctx.input("Label")
+    b, t, c = em.shape
+    length = ctx.input("Length")
+    length = (jnp.full((b,), t, jnp.int32) if length is None
+              else length.reshape(b).astype(jnp.int32))
+    label = label.reshape(b, t).astype(jnp.int32)
+    start_w, end_w, pair = trans[0], trans[1], trans[2:]
+
+    # partition function via forward algorithm
+    alpha0 = start_w[None, :] + em[:, 0, :]
+
+    def step(alpha, t_idx):
+        # [B, C_prev, 1] + [C_prev, C] -> logsumexp over prev
+        scores = alpha[:, :, None] + pair[None, :, :]
+        new = jax.scipy.special.logsumexp(scores, axis=1) + em[:, t_idx, :]
+        active = (t_idx < length)[:, None]
+        return jnp.where(active, new, alpha), jnp.where(active, new, alpha)
+
+    alpha_last, alphas = lax.scan(step, alpha0, jnp.arange(1, t))
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, C]
+    logz = jax.scipy.special.logsumexp(alpha_last + end_w[None, :], axis=1)
+
+    # gold path score
+    pos = jnp.arange(t)
+    valid = pos[None, :] < length[:, None]
+    em_score = jnp.take_along_axis(em, label[:, :, None],
+                                   axis=2)[:, :, 0]
+    em_score = jnp.sum(jnp.where(valid, em_score, 0.0), axis=1)
+    prev_lab = label[:, :-1]
+    next_lab = label[:, 1:]
+    pair_score = pair[prev_lab, next_lab]  # [B, T-1]
+    pair_valid = pos[None, 1:] < length[:, None]
+    pair_score = jnp.sum(jnp.where(pair_valid, pair_score, 0.0), axis=1)
+    last_lab = jnp.take_along_axis(
+        label, jnp.maximum(length - 1, 0)[:, None], axis=1)[:, 0]
+    path = (start_w[label[:, 0]] + em_score + pair_score +
+            end_w[last_lab])
+    ll = path - logz
+    return {"LogLikelihood": -ll.reshape(b, 1),
+            "Alpha": jnp.transpose(alphas, (1, 0, 2))}
+
+
+@register_op("crf_decoding", differentiable=False,
+             stop_gradient_slots=("Emission", "Transition", "Label",
+                                  "Length"))
+def crf_decoding(ctx):
+    """Viterbi decode (reference crf_decoding_op.h). outputs
+    ViterbiPath [B, T] int64 (0 beyond length); with a Label input,
+    outputs the per-position correctness indicator instead (fluid
+    semantics)."""
+    em = ctx.input("Emission").astype(jnp.float32)
+    trans = ctx.input("Transition").astype(jnp.float32)
+    b, t, c = em.shape
+    length = ctx.input("Length")
+    length = (jnp.full((b,), t, jnp.int32) if length is None
+              else length.reshape(b).astype(jnp.int32))
+    start_w, end_w, pair = trans[0], trans[1], trans[2:]
+
+    v0 = start_w[None, :] + em[:, 0, :]
+
+    def fwd(v, t_idx):
+        scores = v[:, :, None] + pair[None, :, :]       # [B, Cp, C]
+        best_prev = jnp.argmax(scores, axis=1)          # [B, C]
+        new = jnp.max(scores, axis=1) + em[:, t_idx, :]
+        active = (t_idx < length)[:, None]
+        new = jnp.where(active, new, v)
+        best_prev = jnp.where(
+            active, best_prev,
+            jnp.broadcast_to(jnp.arange(c)[None, :], (b, c)))
+        return new, best_prev
+
+    v_last, backptrs = lax.scan(fwd, v0, jnp.arange(1, t))  # [T-1, B, C]
+    last_tag = jnp.argmax(v_last + end_w[None, :], axis=1)  # [B]
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    tag0, tags_rev = lax.scan(back, last_tag, backptrs[::-1])
+    # tags_rev = [tag_{T-1} .. tag_1]; the final carry is tag_0
+    path = jnp.concatenate(
+        [tag0[None], tags_rev[::-1]], axis=0).T         # [B, T]
+    valid = jnp.arange(t)[None, :] < length[:, None]
+    path = jnp.where(valid, path, 0).astype(jnp.int64)
+    label = ctx.input("Label")
+    if label is not None:
+        correct = (path == label.reshape(b, t).astype(jnp.int64))
+        return {"ViterbiPath": jnp.where(valid, correct, 0
+                                         ).astype(jnp.int64)}
+    return {"ViterbiPath": path}
+
+
+@register_op("nce",
+             stop_gradient_slots=("Label", "SampleWeight"))
+def nce(ctx):
+    """Noise-contrastive estimation loss (reference nce_op.h — uniform
+    sampler default). Deterministic per `seed` attr so the vjp-based
+    grad recomputation sees identical noise samples.
+
+    inputs: Input [B, D], Label [B, num_true], Weight [V, D], Bias [V].
+    attrs: num_neg_samples, num_total_classes, seed.
+    outputs: Cost [B, 1], plus SampleLogits/SampleLabels for parity.
+    """
+    x = ctx.input("Input")
+    label = ctx.input("Label")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    b, d = x.shape
+    v = int(ctx.attr("num_total_classes", w.shape[0]))
+    num_neg = int(ctx.attr("num_neg_samples", 10))
+    seed = int(ctx.attr("seed", 0))
+    label = label.reshape(b, -1).astype(jnp.int32)
+    nt = label.shape[1]
+
+    key = jax.random.PRNGKey(seed)
+    noise = jax.random.randint(key, (b, num_neg), 0, v)   # [B, S]
+    samples = jnp.concatenate([label, noise], axis=1)     # [B, nt+S]
+    sw = w[samples]                                       # [B, nt+S, D]
+    logits = jnp.einsum("bd,bsd->bs", x, sw)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[samples]
+    # uniform sampler: q = 1/V for every class
+    logq = -math.log(v)
+    adj = logits - (logq + math.log(max(num_neg, 1)))
+    targets = jnp.concatenate(
+        [jnp.ones((b, nt)), jnp.zeros((b, num_neg))], axis=1)
+    per = (jax.nn.softplus(adj) - targets * adj)
+    cost = jnp.sum(per, axis=1, keepdims=True) / nt
+    return {"Cost": cost, "SampleLogits": logits,
+            "SampleLabels": samples.astype(jnp.int64)}
+
+
+@register_op("hierarchical_sigmoid", stop_gradient_slots=("Label",))
+def hierarchical_sigmoid(ctx):
+    """Hierarchical softmax over the default complete binary tree
+    (reference hierarchical_sigmoid_op.h, matrix_bit_code.h — same
+    node/code derivation: leaf id = label + V - 1, ancestors by
+    (i-1)//2, code bit = is-right-child).
+
+    inputs: X [B, D], W [V-1, D], Label [B, 1], Bias [V-1] optional.
+    attr: num_classes. outputs: Out [B, 1] loss, PreOut [B, depth].
+    """
+    x = ctx.input("X")
+    w = ctx.input("W")
+    label = ctx.input("Label")
+    bias = ctx.input("Bias")
+    b, d = x.shape
+    v = int(ctx.attr("num_classes"))
+    depth = max(1, math.ceil(math.log2(max(v, 2)))) + 1  # masked slack
+    lab = label.reshape(b).astype(jnp.int32)
+
+    node = lab + (v - 1)          # leaf index in the implicit full tree
+    node_ids, codes, masks = [], [], []
+    for _ in range(depth):
+        parent = (node - 1) // 2
+        is_right = (node % 2 == 0)        # right child has even index
+        valid = node > 0
+        node_ids.append(jnp.where(valid, parent, 0))
+        codes.append(jnp.where(valid, is_right, False))
+        masks.append(valid)
+        node = jnp.where(valid, parent, node)
+    nid = jnp.stack(node_ids, axis=1)     # [B, depth]
+    code = jnp.stack(codes, axis=1).astype(x.dtype)
+    mask = jnp.stack(masks, axis=1).astype(x.dtype)
+
+    wn = w[nid]                           # [B, depth, D]
+    pre = jnp.einsum("bd,bkd->bk", x, wn)
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[nid]
+    # BCE with target = code
+    per = jax.nn.softplus(pre) - code * pre
+    loss = jnp.sum(per * mask, axis=1, keepdims=True)
+    return {"Out": loss, "PreOut": pre}
+
+
+@register_op("sample_logits",
+             stop_gradient_slots=("Labels",))
+def sample_logits(ctx):
+    """Sampled-softmax helper (reference sample_logits_op.cc): gather
+    logits at true + uniformly sampled classes with log-Q correction.
+
+    inputs: Logits [B, C], Labels [B, num_true]. attrs: num_samples,
+    seed, remove_accidental_hits. outputs: SampledLogits
+    [B, nt+num_samples], SampledLabels [B, nt] (positions of true
+    classes in the sampled axis), Samples, Probabilities.
+    """
+    logits = ctx.input("Logits")
+    labels = ctx.input("Labels").astype(jnp.int32)
+    b, c = logits.shape
+    ns = int(ctx.attr("num_samples", 10))
+    seed = int(ctx.attr("seed", 0))
+    labels = labels.reshape(b, -1)
+    nt = labels.shape[1]
+    key = jax.random.PRNGKey(seed)
+    sampled = jax.random.randint(key, (b, ns), 0, c)
+    samples = jnp.concatenate([labels, sampled], axis=1)
+    gathered = jnp.take_along_axis(logits, samples, axis=1)
+    q = jnp.full((b, nt + ns), 1.0 / c, logits.dtype)
+    out = gathered - jnp.log(q * c) - math.log(c)  # logQ correction
+    if ctx.attr("remove_accidental_hits", True):
+        # a sampled class equal to a true label gets masked out
+        hit = (sampled[:, None, :] == labels[:, :, None]).any(axis=1)
+        pad = jnp.concatenate(
+            [jnp.zeros((b, nt), bool), hit], axis=1)
+        out = jnp.where(pad, _NEG, out)
+    # softmax CE over the sampled axis, true classes at positions [:nt]
+    logz = jax.scipy.special.logsumexp(out, axis=1, keepdims=True)
+    loss = logz - out[:, :nt].sum(axis=1, keepdims=True) / nt \
+        if nt > 1 else logz - out[:, :1]
+    return {"Loss": loss,
+            "SampledLogits": out,
+            "SampledLabels": jnp.broadcast_to(
+                jnp.arange(nt)[None, :], (b, nt)).astype(jnp.int64),
+            "Samples": samples.astype(jnp.int64),
+            "Probabilities": q}

@@ -1,0 +1,439 @@
+"""Control-flow lowering + decode/structured-loss ops.
+
+Mirrors the reference's test_while_op.py, test_beam_search_op.py,
+test_edit_distance_op.py, test_warpctc_op.py, test_linear_chain_crf_op.py,
+test_crf_decoding_op.py, test_nce.py, test_hsigmoid.py (reference
+python/paddle/fluid/tests/unittests/) — numpy oracles computed in-test,
+framework output compared against them.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+class TestWhile:
+    def test_while_sums_to_limit(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            i = layers.fill_constant([1], "float32", 0.0)
+            acc = layers.fill_constant([1], "float32", 0.0)
+            limit = layers.fill_constant([1], "float32", 10.0)
+            cond = layers.less_than(i, limit)
+            w = layers.While(cond)
+            with w.block():
+                layers.increment(acc, 2.0)
+                layers.increment(i, 1.0)
+                layers.less_than(i, limit, cond=cond)
+        out, = _run(main, startup, {}, [acc])
+        assert float(np.ravel(out)[0]) == pytest.approx(20.0)
+
+    def test_while_with_external_read(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[4], dtype="float32")
+            i = layers.fill_constant([1], "float32", 0.0)
+            acc = layers.fill_constant([1, 4], "float32", 0.0)
+            limit = layers.fill_constant([1], "float32", 3.0)
+            cond = layers.less_than(i, limit)
+            w = layers.While(cond)
+            with w.block():
+                s = layers.elementwise_add(acc, x)
+                layers.assign(s, acc)
+                layers.increment(i, 1.0)
+                layers.less_than(i, limit, cond=cond)
+        xv = np.arange(4, dtype="float32").reshape(1, 4)
+        out, = _run(main, startup, {"x": xv}, [acc])
+        np.testing.assert_allclose(np.asarray(out), 3 * xv)
+
+
+class TestCond:
+    def test_cond_branches(self):
+        for flag, expect in ((1.0, 30.0), (-1.0, 8.0)):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                p = layers.data(name="p", shape=[1], dtype="float32",
+                                append_batch_size=False)
+                zero = layers.fill_constant([1], "float32", 0.0)
+                pred = layers.greater_than(p, zero)
+                out = layers.cond(
+                    pred,
+                    lambda: layers.fill_constant([1], "float32", 30.0),
+                    lambda: layers.fill_constant([1], "float32", 8.0))
+            got, = _run(main, startup,
+                        {"p": np.asarray([flag], "float32")}, [out])
+            assert float(np.ravel(got)[0]) == expect
+
+
+class TestTensorArray:
+    def test_write_read_stack(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[3], dtype="float32")
+            arr = layers.create_array("float32")
+            i0 = layers.fill_constant([1], "int64", 0)
+            i1 = layers.fill_constant([1], "int64", 1)
+            layers.array_write(x, i0, array=arr)
+            two = layers.scale(x, scale=2.0)
+            layers.array_write(two, i1, array=arr)
+            n = layers.array_length(arr)
+            back = layers.array_read(arr, i1)
+        xv = np.ones((2, 3), "float32")
+        nv, bv = _run(main, startup, {"x": xv}, [n, back])
+        assert int(np.ravel(nv)[0]) == 2
+        np.testing.assert_allclose(np.asarray(bv), 2 * xv)
+
+
+class TestBeamSearch:
+    def test_step_and_decode(self):
+        # 1 batch, beam 2, vocab 5; hand-computed oracle
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            pre_ids = layers.data(name="pre_ids", shape=[2, 1],
+                                  dtype="int64", append_batch_size=False)
+            pre_scores = layers.data(name="pre_scores", shape=[2, 1],
+                                     dtype="float32",
+                                     append_batch_size=False)
+            ids = layers.data(name="ids", shape=[2, 3], dtype="int64",
+                              append_batch_size=False)
+            scores = layers.data(name="scores", shape=[2, 3],
+                                 dtype="float32", append_batch_size=False)
+            s_ids, s_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0,
+                return_parent_idx=True)
+        feed = {
+            "pre_ids": np.array([[1], [2]], dtype="int64"),
+            "pre_scores": np.array([[-1.0], [-2.0]], dtype="float32"),
+            "ids": np.array([[3, 4, 2], [4, 2, 1]], dtype="int64"),
+            "scores": np.log(np.array([[0.6, 0.3, 0.1],
+                                       [0.5, 0.3, 0.2]], "float32")),
+        }
+        si, ss, pi = _run(main, startup, feed, [s_ids, s_scores, parent])
+        # candidates: beam0: -1+log(.6/.3/.1); beam1: -2+log(.5/.3/.2)
+        # best two: beam0 tok3 (-1.51), beam0 tok4 (-2.20)
+        assert list(np.ravel(si)) == [3, 4]
+        assert list(np.ravel(pi)) == [0, 0]
+        np.testing.assert_allclose(
+            np.ravel(ss), [-1 + np.log(0.6), -1 + np.log(0.3)],
+            rtol=1e-5)
+
+    def test_finished_beam_frozen(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            pre_ids = layers.data(name="pre_ids", shape=[2, 1],
+                                  dtype="int64", append_batch_size=False)
+            pre_scores = layers.data(name="pre_scores", shape=[2, 1],
+                                     dtype="float32",
+                                     append_batch_size=False)
+            ids = layers.data(name="ids", shape=[2, 2], dtype="int64",
+                              append_batch_size=False)
+            scores = layers.data(name="scores", shape=[2, 2],
+                                 dtype="float32", append_batch_size=False)
+            s_ids, s_scores = layers.beam_search(
+                pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0)
+        feed = {
+            "pre_ids": np.array([[0], [2]], dtype="int64"),  # beam0 done
+            "pre_scores": np.array([[-0.5], [-3.0]], dtype="float32"),
+            "ids": np.array([[3, 4], [4, 2]], dtype="int64"),
+            "scores": np.array([[-0.1, -0.2], [-0.4, -0.9]], "float32"),
+        }
+        si, ss = _run(main, startup, feed, [s_ids, s_scores])
+        # finished beam keeps end_id at unchanged score -0.5 (best)
+        assert np.ravel(si)[0] == 0
+        assert np.ravel(ss)[0] == pytest.approx(-0.5)
+
+    def test_decode_backtrack(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data(name="ids", shape=[2, 2, 1], dtype="int64",
+                              append_batch_size=False)
+            parents = layers.data(name="par", shape=[2, 2, 1],
+                                  dtype="int64", append_batch_size=False)
+            scores = layers.data(name="sc", shape=[2, 2, 1],
+                                 dtype="float32", append_batch_size=False)
+            out_ids, out_scores = layers.beam_search_decode(
+                ids, scores, beam_size=2, end_id=0)
+            # wire parents through the op's optional input
+            main.global_block.ops[-1].inputs["Parents"] = ["par"]
+        # step0 picks tokens [5, 6]; step1 beams both extend beam 1
+        feed = {
+            "ids": np.array([[[5], [6]], [[7], [8]]], "int64"),
+            "par": np.array([[[0], [1]], [[1], [1]]], "int64"),
+            "sc": np.array([[[-1.], [-2.]], [[-3.], [-4.]]], "float32"),
+        }
+        oi, osc = _run(main, startup, feed, [out_ids, out_scores])
+        oi = np.asarray(oi)  # [T, rows]
+        # row0 final: step1 tok 7 from parent beam 1 (tok 6)
+        assert list(oi[:, 0]) == [6, 7]
+        assert list(oi[:, 1]) == [6, 8]
+        np.testing.assert_allclose(np.ravel(osc), [-3.0, -4.0])
+
+
+class TestEditDistance:
+    @staticmethod
+    def _lev(a, b):
+        la, lb = len(a), len(b)
+        d = np.zeros((la + 1, lb + 1))
+        d[:, 0] = np.arange(la + 1)
+        d[0, :] = np.arange(lb + 1)
+        for i in range(1, la + 1):
+            for j in range(1, lb + 1):
+                d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                              d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return d[la, lb]
+
+    def test_matches_numpy_oracle(self):
+        rng = np.random.RandomState(0)
+        hyps = rng.randint(1, 6, (4, 7)).astype("int64")
+        refs = rng.randint(1, 6, (4, 9)).astype("int64")
+        hlen = np.array([7, 5, 3, 1], "int32")
+        rlen = np.array([9, 4, 3, 2], "int32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            h = layers.data(name="h", shape=[4, 7], dtype="int64",
+                            append_batch_size=False)
+            r = layers.data(name="r", shape=[4, 9], dtype="int64",
+                            append_batch_size=False)
+            dist, seq_num = layers.edit_distance(h, r, normalized=False)
+        feed = {"h": hyps, "r": refs, "h@SEQ_LEN": hlen,
+                "r@SEQ_LEN": rlen}
+        out, n = _run(main, startup, feed, [dist, seq_num])
+        expect = [self._lev(hyps[i, :hlen[i]], refs[i, :rlen[i]])
+                  for i in range(4)]
+        np.testing.assert_allclose(np.ravel(out), expect)
+        assert int(np.ravel(n)[0]) == 4
+
+
+class TestCTC:
+    def test_ctc_align_greedy_decode(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[2, 6], dtype="int64",
+                            append_batch_size=False)
+            out = layers.ctc_greedy_decoder(x, blank=0)
+        xv = np.array([[1, 1, 0, 2, 2, 0],
+                       [0, 3, 0, 3, 3, 1]], dtype="int64")
+        feed = {"x": xv, "x@SEQ_LEN": np.array([6, 6], "int32")}
+        got, = _run(main, startup, feed, [out])
+        got = np.asarray(got)
+        assert list(got[0][:2]) == [1, 2]
+        assert list(got[1][:3]) == [3, 3, 1]
+
+    @staticmethod
+    def _ctc_loss_brute(logits, label, blank):
+        # brute-force: sum prob over all alignments (tiny T)
+        from itertools import product
+        t, c = logits.shape
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+
+        def collapse(path):
+            out = []
+            prev = -1
+            for s in path:
+                if s != prev and s != blank:
+                    out.append(s)
+                prev = s
+            return out
+
+        total = 0.0
+        for path in product(range(c), repeat=t):
+            if collapse(path) == list(label):
+                pr = 1.0
+                for i, s in enumerate(path):
+                    pr *= p[i, s]
+                total += pr
+        return -np.log(total)
+
+    def test_warpctc_matches_bruteforce(self):
+        rng = np.random.RandomState(3)
+        t, c = 4, 3
+        logits = rng.randn(1, t, c).astype("float32")
+        label = np.array([[1, 2]], dtype="int64")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            lg = layers.data(name="lg", shape=[1, t, c], dtype="float32",
+                             append_batch_size=False)
+            lb = layers.data(name="lb", shape=[1, 2], dtype="int64",
+                             append_batch_size=False)
+            loss = layers.warpctc(lg, lb, blank=0)
+        feed = {"lg": logits, "lb": label,
+                "lg@SEQ_LEN": np.array([t], "int32"),
+                "lb@SEQ_LEN": np.array([2], "int32")}
+        got, = _run(main, startup, feed, [loss])
+        expect = self._ctc_loss_brute(logits[0], label[0], 0)
+        np.testing.assert_allclose(np.ravel(got)[0], expect, rtol=1e-4)
+
+    def test_warpctc_trains(self):
+        rng = np.random.RandomState(0)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            feat = layers.data(name="feat", shape=[2, 8, 16],
+                               dtype="float32", append_batch_size=False)
+            lb = layers.data(name="lb", shape=[2, 3], dtype="int64",
+                             append_batch_size=False)
+            logits = layers.fc(feat, size=5, num_flatten_dims=2)
+            layers.sequence.bind_seq_len(logits, feat)
+            loss = layers.mean(layers.warpctc(logits, lb, blank=0))
+            fluid.optimizer.Adam(0.05).minimize(loss)
+        feat = rng.randn(2, 8, 16).astype("float32")
+        lb = np.array([[1, 2, 3], [2, 1, 4]], "int64")
+        feed = {"feat": feat, "lb": lb,
+                "logits" : None}
+        feed.pop("logits")
+        feed["feat@SEQ_LEN"] = np.array([8, 8], "int32")
+        feed["lb@SEQ_LEN"] = np.array([3, 3], "int32")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ls = [float(np.ravel(exe.run(main, feed=feed,
+                                     fetch_list=[loss])[0])[0])
+              for _ in range(25)]
+        assert ls[-1] < ls[0] * 0.5
+
+
+class TestCRF:
+    @staticmethod
+    def _crf_oracle(em, trans, label):
+        # enumerate all paths (tiny)
+        from itertools import product
+        t, c = em.shape
+        start_w, end_w, pair = trans[0], trans[1], trans[2:]
+
+        def score(path):
+            s = start_w[path[0]] + em[0, path[0]] + end_w[path[-1]]
+            for i in range(1, t):
+                s += pair[path[i - 1], path[i]] + em[i, path[i]]
+            return s
+
+        logz = np.log(sum(np.exp(score(p))
+                          for p in product(range(c), repeat=t)))
+        best = max(product(range(c), repeat=t), key=score)
+        return score(tuple(label)) - logz, list(best)
+
+    def test_crf_ll_and_viterbi(self):
+        rng = np.random.RandomState(1)
+        t, c = 4, 3
+        em = rng.randn(1, t, c).astype("float32")
+        trans = (0.1 * rng.randn(c + 2, c)).astype("float32")
+        label = np.array([[0, 2, 1, 1]], dtype="int64")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            e = layers.data(name="e", shape=[1, t, c], dtype="float32",
+                            append_batch_size=False)
+            tr = layers.data(name="tr", shape=[c + 2, c],
+                             dtype="float32", append_batch_size=False)
+            lb = layers.data(name="lb", shape=[1, t], dtype="int64",
+                             append_batch_size=False)
+            nll = layers.linear_chain_crf_raw(e, tr, lb)
+            path = layers.crf_decoding_raw(e, tr)
+        feed = {"e": em, "tr": trans, "lb": label}
+        got_nll, got_path = _run(main, startup, feed, [nll, path])
+        ll, best = self._crf_oracle(em[0], trans, label[0])
+        np.testing.assert_allclose(np.ravel(got_nll)[0], -ll, rtol=1e-4)
+        assert list(np.asarray(got_path)[0]) == best
+
+
+class TestSampledLosses:
+    def _train(self, build_loss, steps=30, lr=0.1):
+        rng = np.random.RandomState(0)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data(name="x", shape=[16], dtype="float32")
+            y = layers.data(name="y", shape=[1], dtype="int64")
+            loss = build_loss(x, y)
+            fluid.optimizer.Adam(lr).minimize(loss)
+        X = rng.randn(32, 16).astype("float32")
+        Y = rng.randint(0, 8, (32, 1)).astype("int64")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ls = [float(np.ravel(exe.run(main, feed={"x": X, "y": Y},
+                                     fetch_list=[loss])[0])[0])
+              for _ in range(steps)]
+        return ls
+
+    def test_nce_trains(self):
+        ls = self._train(lambda x, y: layers.mean(
+            layers.nce(x, y, num_total_classes=8, num_neg_samples=4)))
+        assert ls[-1] < ls[0] * 0.7
+
+    def test_hsigmoid_trains(self):
+        ls = self._train(lambda x, y: layers.mean(
+            layers.hsigmoid(x, y, num_classes=8)))
+        assert ls[-1] < ls[0] * 0.7
+
+    def test_sampled_softmax_trains(self):
+        ls = self._train(lambda x, y: layers.mean(
+            layers.sampled_softmax_with_cross_entropy(
+                layers.fc(x, size=8), y, num_samples=4)))
+        assert ls[-1] < ls[0] * 0.9
+
+
+class TestReviewRegressions:
+    def test_while_write_only_var_persists(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            i = layers.fill_constant([1], "float32", 0.0)
+            s = layers.fill_constant([1], "float32", -7.0)
+            limit = layers.fill_constant([1], "float32", 3.0)
+            cond = layers.less_than(i, limit)
+            w = layers.While(cond)
+            with w.block():
+                t = layers.scale(i, scale=10.0)
+                layers.assign(t, s)  # write-only from the loop's view
+                layers.increment(i, 1.0)
+                layers.less_than(i, limit, cond=cond)
+        out, = _run(main, startup, {}, [s])
+        assert float(np.ravel(out)[0]) == pytest.approx(20.0)
+
+    def test_while_unwritten_condition_rejected(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            i = layers.fill_constant([1], "float32", 0.0)
+            limit = layers.fill_constant([1], "float32", 3.0)
+            cond = layers.less_than(i, limit)
+            w = layers.While(cond)
+            with pytest.raises(ValueError, match="condition"):
+                with w.block():
+                    layers.increment(i, 1.0)  # forgot to update cond
+
+    def test_beam_search_decode_public_api_with_parents(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data(name="ids", shape=[2, 2], dtype="int64",
+                              append_batch_size=False)
+            parents = layers.data(name="par", shape=[2, 2],
+                                  dtype="int64",
+                                  append_batch_size=False)
+            scores = layers.data(name="sc", shape=[2, 2],
+                                 dtype="float32",
+                                 append_batch_size=False)
+            out_ids, _ = layers.beam_search_decode(
+                ids, scores, beam_size=2, end_id=0, parents=parents)
+        feed = {"ids": np.array([[5, 6], [7, 8]], "int64"),
+                "par": np.array([[0, 1], [1, 1]], "int64"),
+                "sc": np.array([[-1.0, -2.0], [-3.0, -4.0]], "float32")}
+        oi, = _run(main, startup, feed, [out_ids])
+        assert list(np.asarray(oi)[:, 0]) == [6, 7]
+
+    def test_beam_search_decode_without_parents(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = layers.data(name="ids", shape=[2, 2], dtype="int64",
+                              append_batch_size=False)
+            scores = layers.data(name="sc", shape=[2, 2],
+                                 dtype="float32",
+                                 append_batch_size=False)
+            out_ids, _ = layers.beam_search_decode(ids, scores,
+                                                   beam_size=2, end_id=0)
+        feed = {"ids": np.array([[5, 6], [7, 8]], "int64"),
+                "sc": np.array([[-1.0, -2.0], [-3.0, -4.0]], "float32")}
+        oi, = _run(main, startup, feed, [out_ids])
+        # identity lineage: column i is just ids[:, i]
+        assert list(np.asarray(oi)[:, 0]) == [5, 7]

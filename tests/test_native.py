@@ -251,3 +251,32 @@ class TestInferenceModelSerde:
         out2 = exe.run(prog2, feed={feeds[0]: img}, fetch_list=fetches)[0]
         np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
                                    rtol=2e-5, atol=2e-5)
+
+
+class TestHalfPrecisionAttrs:
+    def test_fp16_bf16_ndarray_round_trip(self):
+        for dt in ("float16", "bfloat16"):
+            if dt == "bfloat16":
+                import jax.numpy as jnp
+                arr = np.asarray(
+                    jnp.asarray([1.5, 2.5, -3.5, 4.5], dtype=jnp.bfloat16)
+                ).astype("float32")
+                src = {"__ndarray__": [1.5, 2.5, -3.5, 4.5],
+                       "dtype": "bfloat16", "shape": [4]}
+            else:
+                src = {"__ndarray__": [1.5, 2.5, -3.5, 4.5],
+                       "dtype": "float16", "shape": [4]}
+                arr = np.asarray([1.5, 2.5, -3.5, 4.5], "float16")
+            d = {"blocks": [{"idx": 0, "parent_idx": -1, "vars": [],
+                             "ops": [{"type": "assign_value",
+                                      "inputs": {},
+                                      "outputs": {"Out": ["v"]},
+                                      "attrs": {"values": src}}]}],
+                 "parameters": []}
+            blob = native.NativeProgram.from_dict(d).to_bytes()
+            back = native.NativeProgram.from_bytes(blob).to_dict()
+            vals = back["blocks"][0]["ops"][0]["attrs"]["values"]
+            assert vals["shape"] == [4]
+            np.testing.assert_allclose(
+                np.asarray(vals["__ndarray__"], "float32"),
+                np.asarray(arr, "float32"))
