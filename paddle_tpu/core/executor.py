@@ -169,13 +169,15 @@ def _build_step_fn(block, feed_names, mutated, const, state_out,
         env.update(mut_state)
         env.update(feeds)
         rng_cell = [rng]
-        for i, op in enumerate(block.ops):
+        for op in block.ops:
             if op.type in _SKIP_OP_TYPES:
                 continue
-            run_op(op, env, rng_cell=rng_cell, rng_salt=i)
+            run_op(op, env, rng_cell=rng_cell, rng_salt=op._uid)
         new_state = {n: env[n] for n in state_out if n in env}
         fetches = [env[n] for n in fetch_names]
-        return new_state, fetches, rng_cell[0]
+        # ops derive keys functionally (fold_in(step_key, uid)); the
+        # step key itself advances exactly once per step here
+        return new_state, fetches, jax.random.split(rng, 1)[0]
 
     return step
 

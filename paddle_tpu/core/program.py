@@ -146,6 +146,14 @@ class Operator:
 
     def __init__(self, block, type: str, inputs: Dict[str, List[str]],
                  outputs: Dict[str, List[str]], attrs: Optional[Dict] = None):
+        # structural per-op id: the PRNG salt for ops that sample
+        # (dropout, nce, ...). Derived from (block idx, op position) so
+        # identical program builds get identical salts (seeded
+        # reproducibility), and the grad op can re-derive the forward's
+        # exact noise via its __fwd_op__ attr.
+        blk_idx = getattr(block, "idx", 0) or 0
+        n_ops = len(getattr(block, "ops", ()) or ())
+        self._uid = blk_idx * 100003 + n_ops
         self.block = block
         self.type = type
         self.inputs = {k: list(v) for k, v in inputs.items()}

@@ -75,15 +75,17 @@ def registered_ops() -> List[str]:
 class OpContext:
     """What a kernel sees: resolved input values + attrs + a PRNG tap."""
 
-    __slots__ = ("op", "attrs", "_inputs", "_rng_cell", "_rng_salt")
+    __slots__ = ("op", "attrs", "_inputs", "_rng_cell", "_rng_salt",
+                 "_rng_calls")
 
     def __init__(self, op: Operator, inputs: Dict[str, List],
                  rng_cell=None, rng_salt: int = 0):
         self.op = op
         self.attrs = op.attrs
         self._inputs = inputs
-        self._rng_cell = rng_cell  # single-element list holding current key
+        self._rng_cell = rng_cell  # single-element list holding step key
         self._rng_salt = rng_salt
+        self._rng_calls = 0
 
     def input(self, slot, idx=0):
         vals = self._inputs.get(slot)
@@ -101,12 +103,19 @@ class OpContext:
         return self.attrs.get(name, default)
 
     def rng(self):
-        """Split a fresh PRNG key off the executor-threaded key chain."""
+        """Derive this op's PRNG key from the per-step key.
+
+        Purely functional: key = fold_in(step_key, op uid) -- never
+        advances shared state, so the vjp grad kernel can reproduce the
+        exact forward noise by re-deriving with the same salt. The
+        executor advances the step key once per step instead."""
         if self._rng_cell is None:
             # shape-inference / eval_shape path: abstract key is fine
             return jax.random.PRNGKey(0)
         key = jax.random.fold_in(self._rng_cell[0], self._rng_salt)
-        self._rng_cell[0] = jax.random.split(self._rng_cell[0], 1)[0]
+        if self._rng_calls:
+            key = jax.random.fold_in(key, self._rng_calls)
+        self._rng_calls += 1
         return key
 
 
@@ -277,7 +286,10 @@ def make_vjp_grad_kernel(fwd_type: str):
                 ins[s][i] = v
             for (s, i), v in zip(diff_paths, leaves):
                 ins[s][i] = v
-            inner = OpContext(fwd_op, ins)
+            # same step key + the FORWARD op's salt: the recomputed
+            # forward draws the identical noise the real forward drew
+            inner = OpContext(fwd_op, ins, rng_cell=ctx._rng_cell,
+                              rng_salt=fwd_op._uid)
             return _normalize_outputs(fwd_op, info.kernel(inner))
 
         outs, vjp_fn = jax.vjp(f, diff_leaves)

@@ -164,14 +164,15 @@ def save_inference_model(dirname, feeded_var_names: List[str],
     if native.available():
         # native binary program artifact (reference serializes a protobuf
         # ProgramDesc as __model__, io.py:865; here the C++ core writes
-        # its compact PTPF format). The full JSON model rides in the
-        # .meta sidecar so the artifact loads on hosts without a C++
-        # toolchain.
+        # its compact PTPF format). PTPF is the single authoritative
+        # program encoding; the .meta sidecar holds only the feed/fetch
+        # contract, so nothing is stored twice.
         blob = native.NativeProgram.from_dict(model["program"]).to_bytes()
         with open(path, "wb") as f:
             f.write(blob)
         with open(path + ".meta", "w") as f:
-            json.dump(model, f)
+            json.dump({"feed_names": model["feed_names"],
+                       "fetch_names": model["fetch_names"]}, f)
     else:
         with open(path, "w") as f:
             json.dump(model, f)
@@ -192,10 +193,15 @@ def load_inference_model(dirname, executor, model_filename=None,
 
         with open(path + ".meta") as f:
             model = json.load(f)
-        if native.available():
+        if "program" not in model:  # PTPF is the sole program encoding
+            if not native.available():
+                raise RuntimeError(
+                    f"'{path}' is a native PTPF model but the C++ core "
+                    "is unavailable on this host; re-export with "
+                    "save_inference_model on a host without the native "
+                    "core to get a JSON artifact")
             model["program"] = native.NativeProgram.from_bytes(
                 raw).to_dict()
-        # else: the .meta sidecar already carries the full program JSON
     else:
         model = json.loads(raw.decode())
     program = Program.from_dict(model["program"])

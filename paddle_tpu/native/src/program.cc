@@ -55,6 +55,9 @@ size_t ndElemSize(const std::string& dtype) {
 uint16_t floatToBf16(float f) {
   uint32_t bits;
   memcpy(&bits, &f, 4);
+  if ((bits & 0x7FFFFFFF) > 0x7F800000) {  // NaN: keep quiet, not Inf
+    return static_cast<uint16_t>((bits >> 16) | 0x0040);
+  }
   // round-to-nearest-even on the dropped mantissa bits
   uint32_t rounded = bits + 0x7FFF + ((bits >> 16) & 1);
   return static_cast<uint16_t>(rounded >> 16);
@@ -73,9 +76,18 @@ uint16_t floatToHalf(float f) {
   uint32_t sign = (x >> 16) & 0x8000;
   int32_t exp = static_cast<int32_t>((x >> 23) & 0xFF) - 127 + 15;
   uint32_t mant = x & 0x7FFFFF;
+  if (((x >> 23) & 0xFF) == 0xFF) {  // inf / nan: preserve the class
+    uint32_t m = mant ? (0x0200 | (mant >> 13)) : 0;  // quiet NaN bit
+    return static_cast<uint16_t>(sign | 0x7C00 | m);
+  }
   if (exp <= 0) return static_cast<uint16_t>(sign);  // flush to zero
   if (exp >= 31) return static_cast<uint16_t>(sign | 0x7C00);  // inf
-  return static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
+  uint16_t h = static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
+  // round-to-nearest-even on the 13 dropped bits (carry may ripple
+  // into the exponent, which is the correct RNE behavior)
+  uint32_t rem = mant & 0x1FFF;
+  if (rem > 0x1000 || (rem == 0x1000 && (h & 1))) ++h;
+  return h;
 }
 
 float halfToFloat(uint16_t h) {

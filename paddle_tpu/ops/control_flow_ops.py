@@ -65,8 +65,8 @@ def while_op(ctx):
     def body_fn(carry):
         env = dict(ext_env)
         env.update(zip(carried, carry))
-        for i, op in enumerate(sub.ops):
-            run_op(op, env, rng_cell=None, rng_salt=i)
+        for op in sub.ops:
+            run_op(op, env, rng_cell=None, rng_salt=op._uid)
         return tuple(env[n] for n in carried)
 
     final = lax.while_loop(cond_fn, body_fn, init)
@@ -89,8 +89,8 @@ def conditional_block(ctx):
     def branch(blk, out_name):
         def f(vals):
             env = dict(zip(x_names, vals))
-            for i, op in enumerate(blk.ops):
-                run_op(op, env, rng_cell=None, rng_salt=i)
+            for op in blk.ops:
+                run_op(op, env, rng_cell=None, rng_salt=op._uid)
             return env[out_name]
 
         return f
@@ -126,10 +126,18 @@ def write_to_array(ctx):
     arr = TensorArray(prev) if isinstance(prev, list) else TensorArray()
     i = ctx.input("I")
     idx = _static_index(i) if i is not None else len(arr)
-    if idx is None or idx >= len(arr):
-        arr.append(x)  # append-only fill (program-order writes)
+    if idx is None:
+        arr.append(x)  # dynamic index: append in program order
     else:
-        arr[idx] = x
+        # grow to idx+1 like the reference WriteToArrayOp, so an
+        # out-of-order static write lands at its index (gap slots hold
+        # zeros until their own write arrives)
+        while len(arr) < idx:
+            arr.append(jnp.zeros_like(x))
+        if idx < len(arr):
+            arr[idx] = x
+        else:
+            arr.append(x)
     return {"Out": [arr]}
 
 

@@ -207,7 +207,6 @@ def ctc_align(ctx):
     keep = valid & (x != blank) & (x != prev)
     # left-align kept tokens: target position = exclusive cumsum of keep
     tgt = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
-    out = jnp.full((b, t), blank, x.dtype)
     rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
     # scatter only kept entries (dump non-kept into a trash column)
     tgt_safe = jnp.where(keep, tgt, t)

@@ -196,12 +196,14 @@ def crf_decoding(ctx):
     return {"ViterbiPath": path}
 
 
-@register_op("nce",
+@register_op("nce", needs_rng=True,
              stop_gradient_slots=("Label", "SampleWeight"))
 def nce(ctx):
     """Noise-contrastive estimation loss (reference nce_op.h — uniform
-    sampler default). Deterministic per `seed` attr so the vjp-based
-    grad recomputation sees identical noise samples.
+    sampler default). Nonzero `seed` attr pins the noise samples
+    (reference deterministic mode); seed=0 draws fresh noise per step
+    from the executor key chain (ctx.rng() is stable within one step's
+    fwd/vjp recomputation, varying across steps).
 
     inputs: Input [B, D], Label [B, num_true], Weight [V, D], Bias [V].
     attrs: num_neg_samples, num_total_classes, seed.
@@ -218,7 +220,7 @@ def nce(ctx):
     label = label.reshape(b, -1).astype(jnp.int32)
     nt = label.shape[1]
 
-    key = jax.random.PRNGKey(seed)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng()
     noise = jax.random.randint(key, (b, num_neg), 0, v)   # [B, S]
     samples = jnp.concatenate([label, noise], axis=1)     # [B, nt+S]
     sw = w[samples]                                       # [B, nt+S, D]
@@ -279,7 +281,7 @@ def hierarchical_sigmoid(ctx):
     return {"Out": loss, "PreOut": pre}
 
 
-@register_op("sample_logits",
+@register_op("sample_logits", needs_rng=True,
              stop_gradient_slots=("Labels",))
 def sample_logits(ctx):
     """Sampled-softmax helper (reference sample_logits_op.cc): gather
@@ -297,12 +299,12 @@ def sample_logits(ctx):
     seed = int(ctx.attr("seed", 0))
     labels = labels.reshape(b, -1)
     nt = labels.shape[1]
-    key = jax.random.PRNGKey(seed)
+    key = jax.random.PRNGKey(seed) if seed else ctx.rng()
     sampled = jax.random.randint(key, (b, ns), 0, c)
     samples = jnp.concatenate([labels, sampled], axis=1)
     gathered = jnp.take_along_axis(logits, samples, axis=1)
     q = jnp.full((b, nt + ns), 1.0 / c, logits.dtype)
-    out = gathered - jnp.log(q * c) - math.log(c)  # logQ correction
+    out = gathered - jnp.log(q)  # logQ correction: logits - log q(y)
     if ctx.attr("remove_accidental_hits", True):
         # a sampled class equal to a true label gets masked out
         hit = (sampled[:, None, :] == labels[:, :, None]).any(axis=1)

@@ -714,7 +714,10 @@ def attention(ctx):
         scale = q.shape[-1] ** -0.5
     from . import pallas
     from .pallas import attention as pallas_attn
+    from ..parallel import ring_attention as ra
 
+    if ra.cp_applicable(q, k, v, dropout_rate):
+        return ra.cp_attention(q, k, v, scale, causal)
     if dropout_rate == 0.0:
         if pallas_attn.usable(q, k, v):
             return pallas_attn.flash_attention(q, k, v, scale=scale,

@@ -10,3 +10,8 @@ from .mesh import make_mesh, MeshConfig  # noqa: F401
 from .sharding import (ShardingRules, default_transformer_rules,
                        shard_state, replicate)  # noqa: F401
 from .env import DistributedEnv, init_distributed_env  # noqa: F401
+from .ring_attention import (ring_self_attention, context_parallel,
+                             ring_attention_local,
+                             ulysses_attention_local)  # noqa: F401
+from .pipeline import pipeline_apply  # noqa: F401
+from .moe import moe_apply  # noqa: F401

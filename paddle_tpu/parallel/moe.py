@@ -1,0 +1,110 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+Beyond-reference capability (SURVEY.md §2.4: expert parallelism ABSENT):
+Switch-Transformer-style top-1 routing with fixed expert capacity,
+experts sharded over 'ep', token dispatch/return as `lax.all_to_all`
+over ICI -- the standard TPU MoE dataflow (dispatch einsum -> a2a ->
+expert FFN -> a2a -> combine einsum), fully differentiable.
+
+Layout contract inside shard_map:
+  x_local:  [t, d]            tokens sharded over ep
+  wg:       [d, E]            router weights, replicated (E global experts)
+  w1_local: [e_local, d, f]   this shard's experts
+  w2_local: [e_local, f, d]
+Over-capacity tokens are dropped (output zero), matching the canonical
+Switch formulation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def moe_local(x, wg, w1, w2, axis_name: str, capacity: int):
+    n = lax.psum(1, axis_name)
+    t, d = x.shape
+    e_local = w1.shape[0]
+    E = e_local * n
+    C = capacity
+
+    logits = x @ wg                                     # [t, E]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_val = gates.max(axis=-1)                       # [t]
+    expert = gates.argmax(axis=-1)                      # [t]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # position in expert
+    keep = (pos < C) & (onehot > 0)
+    # dispatch tensor [t, E, C]
+    posC = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
+    dispatch = posC * keep[..., None]
+    xs = jnp.einsum("tec,td->ecd", dispatch,
+                    x.astype(jnp.float32))              # [E, C, d]
+    # scatter expert groups to their owner shards; gather this shard's
+    # experts' tokens from every shard: [E, C, d] -> [e_local, n*C, d]
+    recv = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=1,
+                          tiled=True)
+    h = jax.nn.relu(jnp.einsum("ekd,edf->ekf", recv,
+                               w1.astype(jnp.float32)))
+    y = jnp.einsum("ekf,efd->ekd", h, w2.astype(jnp.float32))
+    # route results back: [e_local, n*C, d] -> [E, C, d]
+    back = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
+                          tiled=True)
+    combine = dispatch * gate_val[:, None, None]
+    out = jnp.einsum("ecd,tec->td", back, combine)
+    return out.astype(x.dtype)
+
+
+def moe_apply(x, wg, w1, w2, mesh: Mesh, axis: str = "ep",
+              capacity_factor: float = 2.0):
+    """x: [tokens, d] global; wg: [d, E]; w1: [E, d, f]; w2: [E, f, d].
+    Tokens and experts are sharded over `axis`; returns [tokens, d]."""
+    n = mesh.shape[axis]
+    t, E = x.shape[0], w1.shape[0]
+    assert t % n == 0 and E % n == 0, \
+        f"tokens({t}) and experts({E}) must divide ep({n})"
+    cap = max(1, int(capacity_factor * (t // n) / E))
+    body = functools.partial(moe_local, axis_name=axis, capacity=cap)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P(axis)),
+        out_specs=P(axis))
+    put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
+    return fn(put(x, P(axis)), put(wg, P()), put(w1, P(axis)),
+              put(w2, P(axis)))
+
+
+def dryrun(n_devices: int) -> None:
+    """Driver smoke: EP MoE vs dense per-token expert application (big
+    capacity so nothing drops)."""
+    import numpy as np
+
+    from .mesh import make_mesh, MeshConfig
+
+    ep = 2 if n_devices % 2 == 0 else 1
+    if ep == 1:
+        print("dryrun ep: skipped (odd device count)")
+        return
+    mesh = make_mesh(MeshConfig(ep=ep), devices=jax.devices()[:ep])
+
+    t, d, f, E = 16, 8, 16, 4
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(t, d).astype(np.float32))
+    wg = jnp.asarray(r.randn(d, E).astype(np.float32))
+    w1 = jnp.asarray(r.randn(E, d, f).astype(np.float32) * 0.3)
+    w2 = jnp.asarray(r.randn(E, f, d).astype(np.float32) * 0.3)
+
+    got = moe_apply(x, wg, w1, w2, mesh, capacity_factor=float(E * 2))
+
+    gates = jax.nn.softmax(x @ wg, axis=-1)
+    idx = jnp.argmax(gates, axis=-1)
+    want = jnp.stack([
+        gates[i, idx[i]] * (jax.nn.relu(x[i] @ w1[idx[i]]) @ w2[idx[i]])
+        for i in range(t)])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-4)
+    print(f"dryrun ep: {ep}-shard expert-parallel MoE matches dense ok")

@@ -1,0 +1,211 @@
+"""Ring attention: exact sequence/context-parallel attention over an
+'sp' mesh axis.
+
+This is a capability the reference does not have (SURVEY.md §5: no
+ring/context parallelism -- its long-sequence story is LoDTensor
+batching); it is the TPU-native mechanism that lets attention scale past
+one chip's HBM: Q stays put, K/V blocks rotate around the ICI ring via
+`ppermute` while each device accumulates flash-style online softmax
+(running max / denominator) in fp32, so the full [T, T] logits matrix
+never materializes anywhere.
+
+Two context-parallel schemes are provided:
+  * ring_attention      -- K/V rotation (ring; comm ~ T*D per step,
+                           overlappable with compute on ICI)
+  * ulysses_attention   -- all_to_all head-scatter (DeepSpeed-Ulysses
+                           style): re-shard seq->heads, run dense local
+                           attention, re-shard back. Cheaper at modest
+                           sp when heads % sp == 0.
+
+Both are pure jax (scan + ppermute / all_to_all), differentiable with
+standard AD (ppermute transposes to the inverse permutation), and are
+meant to be called inside `shard_map` -- `ring_self_attention` wraps
+that for [B, H, T, D] operands sharded on T.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    # q: [B,H,Tq,D], k: [B,H,Tk,D] -> [B,H,Tq,Tk] fp32
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def ring_attention_local(q, k, v, axis_name: str, *, scale: float,
+                         causal: bool = True):
+    """Per-shard body: call inside shard_map. q/k/v: [B,H,Tl,D] local
+    sequence blocks; returns local attention output [B,H,Tl,D].
+
+    Device i's Q block attends to every K/V block as they rotate by
+    `ppermute`; online-softmax carry (m, l, o) merges partial results
+    exactly (same math as the Pallas flash kernel in
+    ops/pallas/attention.py, but across chips).
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, tl, d = q.shape
+    q32 = q.astype(jnp.float32)
+
+    q_pos = my * tl + jnp.arange(tl)                      # global q rows
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, s):
+        k_blk, v_blk, m, l, o = carry
+        # after s rotations device `my` holds the block that started on
+        # device (my - s) mod n
+        src = (my - s) % n
+        scores = _block_scores(q32, k_blk.astype(jnp.float32), scale)
+        if causal:
+            kv_pos = src * tl + jnp.arange(tl)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        safe_m = jnp.where(m_new <= NEG_INF, 0.0, m_new)
+        p = jnp.exp(scores - safe_m[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.where(m <= NEG_INF, 0.0, jnp.exp(m - safe_m))
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m_new, l, o), None
+
+    # derive zero-inits from the operands so they inherit the operands'
+    # varying mesh axes (sp, and dp/tp when composed) -- shard_map's
+    # varying-axes check requires scan carry in/out types to match
+    qk0 = q32[..., 0] * 0.0 + (k[..., 0, 0] * 0.0)[..., None]
+    m0 = qk0 + NEG_INF
+    l0 = qk0
+    o0 = (q32 * 0.0) + (v[..., 0, 0] * 0.0)[..., None, None]
+    (_, _, _, l, o), _ = lax.scan(step, (k, v, m0, l0, o0),
+                                  jnp.arange(n))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, axis_name: str, *, scale: float,
+                            causal: bool = True):
+    """All-to-all context parallelism: re-shard [B, H, T/n, D] ->
+    [B, H/n, T, D], dense local attention over the FULL sequence, then
+    re-shard back. Requires H % axis_size == 0."""
+    n = lax.psum(1, axis_name)
+    b, h, tl, d = q.shape
+    assert h % n == 0, f"ulysses needs heads({h}) % sp({n}) == 0"
+
+    def seq2head(x):   # [B,H,Tl,D] -> [B,H/n,T,D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head2seq(x):   # [B,H/n,T,D] -> [B,H,Tl,D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qf, kf, vf = seq2head(q), seq2head(k), seq2head(v)
+    t = qf.shape[2]
+    scores = _block_scores(qf.astype(jnp.float32),
+                           kf.astype(jnp.float32), scale)
+    if causal:
+        pos = jnp.arange(t)
+        scores = jnp.where(pos[:, None] >= pos[None, :], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    of = jnp.einsum("bhqk,bhkd->bhqd", p, vf.astype(jnp.float32))
+    return head2seq(of.astype(q.dtype))
+
+
+def _sp_sharded_call(local_fn, mesh: Mesh, axis: str, q, k, v):
+    # [B, H, T, D]: T over the sp axis; batch/heads additionally ride
+    # any dp/tp axes in the same mesh so context parallelism composes
+    # with data/tensor parallelism in one shard_map
+    def ax(name):
+        return name if mesh.shape.get(name, 1) > 1 and name != axis \
+            else None
+
+    spec = P(ax("dp"), ax("tp"), axis, None)
+    fn = jax.shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec)
+    return fn(q, k, v)
+
+
+# --- context-parallel activation scope -----------------------------------
+# The Program executor's `attention` op (ops/nn_ops.py) consults this so
+# sequence parallelism composes with the graph path: inside the scope,
+# eligible self-attention ops lower to shard_map ring attention over the
+# given mesh axis instead of single-shard flash attention.
+_ACTIVE_CP = None
+
+
+class context_parallel:
+    """`with context_parallel(mesh, axis='sp', impl='ring'):` -- route
+    framework attention ops through sequence-parallel attention."""
+
+    def __init__(self, mesh: Mesh, axis: str = "sp", impl: str = "ring"):
+        self.cfg = (mesh, axis, impl)
+
+    def __enter__(self):
+        global _ACTIVE_CP
+        self._prev = _ACTIVE_CP
+        _ACTIVE_CP = self.cfg
+        return self
+
+    def __exit__(self, *a):
+        global _ACTIVE_CP
+        _ACTIVE_CP = self._prev
+
+
+def active_context_parallel():
+    return _ACTIVE_CP
+
+
+def cp_applicable(q, k, v, dropout_rate) -> bool:
+    """Self-attention with equal q/kv length, no attention dropout, and
+    a sequence length divisible by the sp axis size."""
+    if _ACTIVE_CP is None or dropout_rate:
+        return False
+    mesh, axis, _ = _ACTIVE_CP
+    n = mesh.shape[axis]
+    dp = mesh.shape.get("dp", 1)
+    tp = mesh.shape.get("tp", 1)
+    return (q.shape == k.shape == v.shape and n > 1
+            and q.shape[2] % n == 0 and q.shape[0] % dp == 0
+            and q.shape[1] % tp == 0)
+
+
+def cp_attention(q, k, v, scale, causal):
+    mesh, axis, impl = _ACTIVE_CP
+    body = {"ring": ring_attention_local,
+            "ulysses": ulysses_attention_local}[impl]
+    local = functools.partial(body, axis_name=axis, scale=scale,
+                              causal=causal)
+    return _sp_sharded_call(local, mesh, axis, q, k, v)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                        scale: Optional[float] = None,
+                        causal: bool = True, impl: str = "ring"):
+    """Context-parallel attention over `mesh` axis `axis`.
+
+    q, k, v: [B, H, T, D] global operands (host or device arrays); the
+    sequence dim is sharded over the axis and attention runs exactly as
+    if on one device. `impl` in {"ring", "ulysses"}.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    body = {"ring": ring_attention_local,
+            "ulysses": ulysses_attention_local}[impl]
+    local = functools.partial(body, axis_name=axis, scale=scale,
+                              causal=causal)
+    spec = NamedSharding(mesh, P(None, None, axis, None))
+    q, k, v = (jax.device_put(x, spec) for x in (q, k, v))
+    return _sp_sharded_call(local, mesh, axis, q, k, v)

@@ -1,0 +1,115 @@
+"""Pipeline (pp) and expert-parallel MoE (ep) numerics on the virtual
+8-device CPU mesh — beyond-reference parallelism (SURVEY.md §2.4 marks
+PP/EP absent upstream)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.parallel import make_mesh, MeshConfig
+from paddle_tpu.parallel.pipeline import pipeline_apply
+from paddle_tpu.parallel.moe import moe_apply
+
+
+class TestPipeline:
+    def _setup(self, pp, d=8, batch=16, seed=3):
+        mesh = make_mesh(MeshConfig(pp=pp),
+                         devices=jax.devices()[:pp])
+        r = np.random.RandomState(seed)
+        w = jnp.asarray(r.randn(pp, d, d).astype(np.float32) * 0.3)
+        b = jnp.asarray(r.randn(pp, d).astype(np.float32) * 0.1)
+        x = jnp.asarray(r.randn(batch, d).astype(np.float32))
+        return mesh, w, b, x
+
+    @staticmethod
+    def _stage(params, h):
+        wi, bi = params
+        return jnp.tanh(h @ wi + bi)
+
+    def _sequential(self, w, b, x):
+        for i in range(w.shape[0]):
+            x = jnp.tanh(x @ w[i] + b[i])
+        return x
+
+    def test_4stage_matches_sequential(self):
+        mesh, w, b, x = self._setup(pp=4)
+        got = pipeline_apply(self._stage, (w, b), x, mesh, n_micro=8)
+        want = self._sequential(w, b, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_8stage_single_micro_per_tick(self):
+        mesh, w, b, x = self._setup(pp=8)
+        got = pipeline_apply(self._stage, (w, b), x, mesh, n_micro=4)
+        want = self._sequential(w, b, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_pipeline_grads_match_sequential(self):
+        mesh, w, b, x = self._setup(pp=4)
+
+        def loss_pipe(w, b):
+            y = pipeline_apply(self._stage, (w, b), x, mesh, n_micro=4)
+            return (y ** 2).sum()
+
+        def loss_seq(w, b):
+            return (self._sequential(w, b, x) ** 2).sum()
+
+        gp = jax.grad(loss_pipe, argnums=(0, 1))(w, b)
+        gs = jax.grad(loss_seq, argnums=(0, 1))(w, b)
+        for a, e in zip(gp, gs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       atol=1e-4, rtol=1e-4)
+
+
+class TestMoE:
+    def _setup(self, ep, t=32, d=8, f=16, E=8, seed=1):
+        mesh = make_mesh(MeshConfig(ep=ep),
+                         devices=jax.devices()[:ep])
+        r = np.random.RandomState(seed)
+        x = jnp.asarray(r.randn(t, d).astype(np.float32))
+        wg = jnp.asarray(r.randn(d, E).astype(np.float32))
+        w1 = jnp.asarray(r.randn(E, d, f).astype(np.float32) * 0.3)
+        w2 = jnp.asarray(r.randn(E, f, d).astype(np.float32) * 0.3)
+        return mesh, x, wg, w1, w2
+
+    @staticmethod
+    def _dense(x, wg, w1, w2):
+        gates = jax.nn.softmax(x @ wg, axis=-1)
+        idx = jnp.argmax(gates, axis=-1)
+        return jnp.stack([
+            gates[i, idx[i]] *
+            (jax.nn.relu(x[i] @ w1[idx[i]]) @ w2[idx[i]])
+            for i in range(x.shape[0])])
+
+    def test_ep4_matches_dense_when_no_drops(self):
+        mesh, x, wg, w1, w2 = self._setup(ep=4)
+        got = moe_apply(x, wg, w1, w2, mesh, capacity_factor=64.0)
+        want = self._dense(x, wg, w1, w2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_capacity_drops_zero_tokens(self):
+        """Tiny capacity: over-capacity tokens produce zero rows, and
+        every produced row matches its dense counterpart."""
+        mesh, x, wg, w1, w2 = self._setup(ep=2)
+        got = np.asarray(moe_apply(x, wg, w1, w2, mesh,
+                                   capacity_factor=0.25))
+        want = np.asarray(self._dense(x, wg, w1, w2))
+        for i in range(got.shape[0]):
+            if np.allclose(got[i], 0.0, atol=1e-7):
+                continue
+            np.testing.assert_allclose(got[i], want[i], atol=1e-5,
+                                       rtol=1e-4)
+        assert (np.abs(got).sum(axis=1) > 1e-7).sum() >= 4
+
+    def test_moe_grads_flow(self):
+        mesh, x, wg, w1, w2 = self._setup(ep=2)
+
+        def loss(w1, w2):
+            return (moe_apply(x, wg, w1, w2, mesh,
+                              capacity_factor=64.0) ** 2).sum()
+
+        g1, g2 = jax.grad(loss, argnums=(0, 1))(w1, w2)
+        assert np.isfinite(np.asarray(g1)).all()
+        assert np.abs(np.asarray(g1)).sum() > 0
+        assert np.abs(np.asarray(g2)).sum() > 0
