@@ -39,6 +39,9 @@ from .core.compiler import CompiledProgram, BuildStrategy, \
 from .data_feeder import DataFeeder
 from .reader import PyReader
 from . import dygraph
+from . import readers
+from .readers import batch
+from . import dataset
 
 # fluid-compat: many scripts do `import paddle.fluid as fluid`; we expose
 # the same names so `import paddle_tpu as fluid` works.

@@ -1,0 +1,15 @@
+"""Dataset package (parity: reference python/paddle/dataset/).
+
+All readers are deterministic synthetic generators with the real
+datasets' shapes/vocabulary structure (zero-egress environment); see
+common.py. Usage matches the reference:
+
+    train_reader = paddle_tpu.batch(
+        paddle_tpu.readers.shuffle(paddle_tpu.dataset.mnist.train(), 500),
+        batch_size=128)
+"""
+from . import (cifar, common, conll05, flowers, image, imdb, mnist,
+               movielens, uci_housing, wmt14, wmt16)
+
+__all__ = ["mnist", "cifar", "imdb", "uci_housing", "movielens", "wmt14",
+           "wmt16", "conll05", "flowers", "image", "common"]

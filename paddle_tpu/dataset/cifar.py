@@ -1,0 +1,52 @@
+"""CIFAR-10/100 readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/cifar.py — readers yield
+(image, label); image is float32[3072] (3x32x32) scaled to [0, 1].
+"""
+from __future__ import annotations
+
+import numpy as np
+
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+IMG_DIM = 3 * 32 * 32
+
+
+def _protos(n_classes, seed):
+    rng = np.random.RandomState(seed)
+    return rng.uniform(0.2, 0.8, size=(n_classes, IMG_DIM)).astype(np.float32)
+
+
+_P10 = _protos(10, 10)
+_P100 = _protos(100, 100)
+
+
+def _make_reader(protos, n, seed):
+    n_classes = protos.shape[0]
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        labels = rng.randint(0, n_classes, size=n)
+        for i in range(n):
+            lab = int(labels[i])
+            img = protos[lab] + rng.normal(
+                0, 0.15, size=IMG_DIM).astype(np.float32)
+            yield np.clip(img, 0.0, 1.0).astype(np.float32), lab
+
+    return reader
+
+
+def train10():
+    return _make_reader(_P10, TRAIN_SIZE, seed=92)
+
+
+def test10():
+    return _make_reader(_P10, TEST_SIZE, seed=93)
+
+
+def train100():
+    return _make_reader(_P100, TRAIN_SIZE, seed=94)
+
+
+def test100():
+    return _make_reader(_P100, TEST_SIZE, seed=95)

@@ -1,0 +1,94 @@
+"""Shared dataset utilities.
+
+Parity: reference python/paddle/dataset/common.py (download cache, md5
+check, reader conversion). This environment has no network egress, so
+every dataset module in this package generates *deterministic synthetic*
+data with the exact shapes/dtypes/vocab structure of the real dataset;
+`download` is kept as an API surface that resolves to the local cache or
+raises with a clear message.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Callable
+
+DATA_HOME = os.path.expanduser(os.environ.get("PADDLE_TPU_DATA_HOME",
+                                              "~/.cache/paddle_tpu/dataset"))
+
+
+def must_mkdirs(path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str | None = None) -> str:
+    """Resolve a dataset file from the local cache (no network egress)."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    must_mkdirs(dirname)
+    filename = os.path.join(dirname, url.split("/")[-1])
+    if os.path.exists(filename) and (
+            md5sum is None or md5file(filename) == md5sum):
+        return filename
+    raise RuntimeError(
+        "dataset file %s is not in the local cache (%s) and this "
+        "environment has no network access; synthetic readers do not "
+        "require it" % (url, dirname))
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Split files among trainers; parity with reference common.py."""
+    import glob
+
+    def reader():
+        flist = sorted(glob.glob(files_pattern))
+        my = flist[trainer_id::trainer_count]
+        for fn in my:
+            if loader is None:
+                with open(fn, "rb") as f:
+                    yield f.read()
+            else:
+                for item in loader(fn):
+                    yield item
+
+    return reader
+
+
+def convert(output_path: str, reader: Callable, line_count: int,
+            name_prefix: str) -> None:
+    """Serialize a reader's items into chunked recordio files via the
+    native writer (parity: reference common.py convert -> recordio)."""
+    import pickle
+
+    from ..native import RecordIOWriter
+
+    must_mkdirs(output_path)
+    idx = 0
+    items = []
+
+    def flush():
+        nonlocal idx, items
+        if not items:
+            return
+        path = os.path.join(output_path,
+                            "%s-%05d" % (name_prefix, idx))
+        w = RecordIOWriter(path)
+        for it in items:
+            w.write(pickle.dumps(it))
+        w.close()
+        idx += 1
+        items = []
+
+    for item in reader():
+        items.append(item)
+        if len(items) >= line_count:
+            flush()
+    flush()

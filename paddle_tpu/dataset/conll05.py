@@ -1,0 +1,51 @@
+"""CoNLL-2005 semantic-role-labeling readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/conll05.py — get_dict() returns
+(word_dict, verb_dict, label_dict); test() yields 9 slots:
+(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids, mark, label).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_WORD_VOCAB = 44068
+_VERB_VOCAB = 3162
+_N_LABELS = 67
+
+TEST_SIZE = 1024
+
+
+def get_dict():
+    word_dict = {"w%d" % i: i for i in range(_WORD_VOCAB)}
+    verb_dict = {"v%d" % i: i for i in range(_VERB_VOCAB)}
+    label_dict = {"L%d" % i: i for i in range(_N_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    return None
+
+
+def _make_reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = int(rng.randint(4, 40))
+            words = rng.randint(0, _WORD_VOCAB, size=length)
+
+            def ctx(shift):
+                idx = np.clip(np.arange(length) + shift, 0, length - 1)
+                return [int(w) for w in words[idx]]
+
+            verb = int(rng.randint(0, _VERB_VOCAB))
+            vpos = int(rng.randint(0, length))
+            mark = [1 if i == vpos else 0 for i in range(length)]
+            labels = [int(x) for x in rng.randint(0, _N_LABELS, length)]
+            yield ([int(w) for w in words], ctx(-2), ctx(-1), ctx(0),
+                   ctx(1), ctx(2), [verb] * length, mark, labels)
+
+    return reader
+
+
+def test():
+    return _make_reader(TEST_SIZE, seed=107)

@@ -1,0 +1,52 @@
+"""Oxford-102 flowers readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/flowers.py — yields
+(float32[3*224*224] image, int label in [0,102)). Used by the
+resnet/se_resnext benchmark models.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+N_CLASSES = 102
+TRAIN_SIZE = 1024
+TEST_SIZE = 128
+
+
+def _make_reader(n, seed, shape=(3, 224, 224)):
+    dim = int(np.prod(shape))
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            lab = int(rng.randint(0, N_CLASSES))
+            base = (lab / N_CLASSES) - 0.5
+            img = (base + rng.normal(0, 0.3, size=dim)).astype(np.float32)
+            yield img, lab
+
+    return reader
+
+
+def _with_mapper(reader, mapper, buffered_size, use_xmap):
+    if mapper is None:
+        return reader
+    from ..readers import map_readers, xmap_readers
+
+    if use_xmap:
+        return xmap_readers(mapper, reader, 4, buffered_size, order=True)
+    return map_readers(mapper, reader)
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _with_mapper(_make_reader(TRAIN_SIZE, seed=108), mapper,
+                        buffered_size, use_xmap)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _with_mapper(_make_reader(TEST_SIZE, seed=109), mapper,
+                        buffered_size, use_xmap)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _with_mapper(_make_reader(TEST_SIZE, seed=110), mapper,
+                        buffered_size, use_xmap)

@@ -1,0 +1,77 @@
+"""Image preprocessing utilities (numpy; no cv2 dependency).
+
+Parity: reference python/paddle/dataset/image.py — resize, center/random
+crop, flip, normalization, CHW conversion, and the simple_transform /
+load_and_transform composition used by flowers/imagenet pipelines.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the short edge == size (nearest-neighbor, HWC input)."""
+    h, w = im.shape[:2]
+    if h < w:
+        new_h, new_w = size, int(round(w * size / h))
+    else:
+        new_h, new_w = int(round(h * size / w)), size
+    ri = np.clip((np.arange(new_h) * h / new_h), 0, h - 1).astype(int)
+    ci = np.clip((np.arange(new_w) * w / new_w), 0, w - 1).astype(int)
+    return im[ri][:, ci]
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color=True) -> np.ndarray:
+    h, w = im.shape[:2]
+    h0 = max((h - size) // 2, 0)
+    w0 = max((w - size) // 2, 0)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color=True,
+                rng=None) -> np.ndarray:
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    h0 = rng.randint(0, max(h - size, 0) + 1)
+    w0 = rng.randint(0, max(w - size, 0) + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im: np.ndarray, is_color=True) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color=True, mean=None,
+                     rng=None) -> np.ndarray:
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if (rng or np.random).randint(2) == 1:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_image(path: str, is_color=True) -> np.ndarray:
+    raise RuntimeError("image file loading requires a local image; this "
+                       "environment uses synthetic dataset readers")
+
+
+def load_and_transform(path, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(path, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

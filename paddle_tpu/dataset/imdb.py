@@ -1,0 +1,47 @@
+"""IMDB sentiment readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/imdb.py — word_dict() maps token
+-> id (with '<unk>'); train(word_dict)/test(word_dict) yield
+(word_id_list, label in {0,1}). Synthetic corpus: two vocab regions are
+class-correlated so sentiment models converge.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB_SIZE = 5148  # matches reference imdb.word_dict() cardinality order
+TRAIN_SIZE = 2048
+TEST_SIZE = 512
+
+
+def word_dict():
+    d = {"w%d" % i: i for i in range(VOCAB_SIZE - 1)}
+    d["<unk>"] = VOCAB_SIZE - 1
+    return d
+
+
+def _make_reader(word_idx, n, seed):
+    vocab = len(word_idx)
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 100))
+            # class-correlated halves of the vocabulary + common words
+            lo = 0 if label == 0 else vocab // 2
+            ids = np.where(
+                rng.uniform(size=length) < 0.7,
+                rng.randint(lo, lo + vocab // 2, size=length),
+                rng.randint(0, vocab, size=length))
+            yield [int(i) for i in ids], label
+
+    return reader
+
+
+def train(word_idx):
+    return _make_reader(word_idx, TRAIN_SIZE, seed=98)
+
+
+def test(word_idx):
+    return _make_reader(word_idx, TEST_SIZE, seed=99)

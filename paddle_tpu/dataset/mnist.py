@@ -1,0 +1,49 @@
+"""MNIST dataset readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/mnist.py — readers yield
+(image, label) where image is a flat float32[784] scaled to [-1, 1] and
+label an int in [0, 10). Zero-egress environment: images are generated
+deterministically per (split, index) so loss curves are reproducible;
+each class has a distinct mean pattern so small models actually learn.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+TRAIN_SIZE = 8192
+TEST_SIZE = 1024
+IMG_DIM = 784
+
+
+def _class_prototypes():
+    rng = np.random.RandomState(1234)
+    return rng.uniform(-0.6, 0.6, size=(10, IMG_DIM)).astype(np.float32)
+
+
+_PROTOS = _class_prototypes()
+
+
+def _make_reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        labels = rng.randint(0, 10, size=n)
+        for i in range(n):
+            lab = int(labels[i])
+            img = _PROTOS[lab] + rng.normal(
+                0, 0.3, size=IMG_DIM).astype(np.float32)
+            yield np.clip(img, -1.0, 1.0).astype(np.float32), lab
+
+    return reader
+
+
+def train():
+    """Reader yielding (float32[784] in [-1,1], int label)."""
+    return _make_reader(TRAIN_SIZE, seed=90)
+
+
+def test():
+    return _make_reader(TEST_SIZE, seed=91)
+
+
+def fetch():
+    return None

@@ -1,0 +1,73 @@
+"""MovieLens-1M readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/movielens.py — items are
+[user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+rating]; max_user_id/max_movie_id/... expose vocab sizes for embeddings.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_N_USERS = 6040
+_N_MOVIES = 3952
+_N_AGES = 7
+_N_JOBS = 21
+_N_CATEGORIES = 18
+_TITLE_VOCAB = 5174
+
+TRAIN_SIZE = 4096
+TEST_SIZE = 512
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {"cat%d" % i: i for i in range(_N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {"t%d" % i: i for i in range(_TITLE_VOCAB)}
+
+
+def _make_reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            uid = int(rng.randint(1, _N_USERS + 1))
+            mid = int(rng.randint(1, _N_MOVIES + 1))
+            gender = int(rng.randint(0, 2))
+            age = int(rng.randint(0, _N_AGES))
+            job = int(rng.randint(0, _N_JOBS))
+            n_cat = int(rng.randint(1, 4))
+            cats = [int(c) for c in rng.randint(0, _N_CATEGORIES, n_cat)]
+            n_tit = int(rng.randint(1, 6))
+            title = [int(t) for t in rng.randint(0, _TITLE_VOCAB, n_tit)]
+            # deterministic preference structure for convergence
+            score = 1.0 + 4.0 * (((uid * 2654435761 + mid * 40503) %
+                                  1000) / 999.0)
+            yield [uid, gender, age, job, mid, cats, title,
+                   np.array([score], dtype=np.float32)]
+
+    return reader
+
+
+def train():
+    return _make_reader(TRAIN_SIZE, seed=105)
+
+
+def test():
+    return _make_reader(TEST_SIZE, seed=106)

@@ -1,0 +1,39 @@
+"""UCI housing regression readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/uci_housing.py — yields
+(float32[13] features, float32[1] price); features are standardized.
+A fixed linear ground truth + noise keeps fit_a_line convergence real.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD",
+    "TAX", "PTRATIO", "B", "LSTAT"
+]
+
+TRAIN_SIZE = 404
+TEST_SIZE = 102
+
+_W = np.random.RandomState(7).uniform(-2, 2, size=13).astype(np.float32)
+_B = 22.5
+
+
+def _make_reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            x = rng.normal(0, 1, size=13).astype(np.float32)
+            y = float(x @ _W + _B + rng.normal(0, 1.0))
+            yield x, np.array([y], dtype=np.float32)
+
+    return reader
+
+
+def train():
+    return _make_reader(TRAIN_SIZE, seed=96)
+
+
+def test():
+    return _make_reader(TEST_SIZE, seed=97)

@@ -1,0 +1,53 @@
+"""WMT14 en-fr translation readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/wmt14.py — train(dict_size)
+yields (src_ids, trg_ids, trg_next_ids) with <s>=0, <e>=1, <unk>=2.
+Synthetic parallel corpus: target is a deterministic per-token mapping of
+source (plus sentinels), so seq2seq models have learnable structure.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+START_ID, END_ID, UNK_ID = 0, 1, 2
+
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def _make_reader(dict_size, n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        shift = dict_size // 3
+        for _ in range(n):
+            length = int(rng.randint(4, 30))
+            src = rng.randint(3, dict_size, size=length)
+            trg = (src - 3 + shift) % (dict_size - 3) + 3
+            trg_in = np.concatenate([[START_ID], trg])
+            trg_next = np.concatenate([trg, [END_ID]])
+            yield ([int(i) for i in src], [int(i) for i in trg_in],
+                   [int(i) for i in trg_next])
+
+    return reader
+
+
+def train(dict_size):
+    return _make_reader(dict_size, TRAIN_SIZE, seed=100)
+
+
+def test(dict_size):
+    return _make_reader(dict_size, TEST_SIZE, seed=101)
+
+
+def get_dict(dict_size, reverse=False):
+    src = {w: i for i, w in enumerate(
+        [START, END, UNK] + ["src%d" % i for i in range(dict_size - 3)])}
+    trg = {w: i for i, w in enumerate(
+        [START, END, UNK] + ["trg%d" % i for i in range(dict_size - 3)])}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

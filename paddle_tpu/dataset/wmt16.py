@@ -1,0 +1,35 @@
+"""WMT16 en-de translation readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/wmt16.py — same item structure as
+wmt14 but with configurable src/trg dict sizes and language direction.
+"""
+from __future__ import annotations
+
+from . import wmt14
+
+TRAIN_SIZE = 2048
+TEST_SIZE = 256
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14._make_reader(min(src_dict_size, trg_dict_size),
+                              TRAIN_SIZE, seed=102)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14._make_reader(min(src_dict_size, trg_dict_size),
+                              TEST_SIZE, seed=103)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return wmt14._make_reader(min(src_dict_size, trg_dict_size),
+                              TEST_SIZE, seed=104)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    words = (["<s>", "<e>", "<unk>"] +
+             ["%s%d" % (lang, i) for i in range(dict_size - 3)])
+    d = {w: i for i, w in enumerate(words)}
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d

@@ -1,0 +1,275 @@
+"""Reader composition decorators + batching.
+
+Parity: reference python/paddle/reader/decorator.py (map_readers,
+shuffle, buffered, compose, chain, firstn, xmap_readers, cache,
+multiprocess_reader) and python/paddle/batch.py (batch). A "reader" is a
+zero-arg callable returning an iterator of samples.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+from typing import Callable, Iterable, List
+
+__all__ = ["map_readers", "shuffle", "buffered", "compose", "chain",
+           "firstn", "xmap_readers", "cache", "multiprocess_reader",
+           "batch"]
+
+
+def map_readers(func: Callable, *readers):
+    """Yield func applied across items of several readers in lockstep."""
+
+    def reader():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int, seed=None):
+    """Buffered shuffle (reference decorator.py shuffle)."""
+
+    def data_reader():
+        rng = random.Random(seed)
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def buffered(reader, size: int):
+    """Background-thread prefetch buffer (reference buffered)."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+        exc = []
+
+        def fill():
+            try:
+                for d in reader():
+                    q.put(d)
+            except BaseException as e:  # propagate to consumer
+                exc.append(e)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                if exc:
+                    raise exc[0]
+                return
+            yield e
+
+    return data_reader
+
+
+def compose(*readers, check_alignment=True):
+    """Zip several readers into flat tuples (reference compose)."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        its = [r() for r in readers]
+        if check_alignment:
+            # pull manually (not zip) so a reader that is exactly one
+            # item longer than another is still detected as ragged
+            while True:
+                items = []
+                stopped = 0
+                for it in its:
+                    try:
+                        items.append(next(it))
+                    except StopIteration:
+                        stopped += 1
+                if stopped == len(its):
+                    return
+                if stopped:
+                    raise RuntimeError("readers have different lengths")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in itertools.zip_longest(*its):
+                yield sum((make_tuple(i) for i in items if i is not None),
+                          ())
+
+    return reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+
+    return reader
+
+
+def firstn(reader, n: int):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                return
+            yield item
+
+    return data_reader
+
+
+def cache(reader):
+    """Materialize once; replay from memory afterwards. A partially
+    consumed first pass discards its partial cache and refills on the
+    next call (so early `break`/`firstn` can't corrupt the cache)."""
+    all_data: List = []
+    filled = [False]
+
+    def data_reader():
+        if not filled[0]:
+            all_data.clear()
+            for d in reader():
+                all_data.append(d)
+                yield d
+            filled[0] = True
+        else:
+            for d in all_data:
+                yield d
+
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order=False):
+    """Parallel map over a reader with worker threads (reference
+    xmap_readers; threads not processes — mappers are numpy-bound)."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+        errors: List[BaseException] = []
+
+        def feed():
+            try:
+                for i, d in enumerate(reader()):
+                    in_q.put((i, d))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_End)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is _End:
+                        return
+                    i, d = item
+                    out_q.put((i, mapper(d)))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                out_q.put(_End)
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if order:
+            pending = {}
+            next_i = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                pending[item[0]] = item[1]
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            if not errors:
+                for i in sorted(pending):
+                    yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                yield item[1]
+        if errors:
+            raise errors[0]
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers via worker threads (API parity with the
+    reference's multiprocess_reader; thread-backed here since samples are
+    numpy arrays and the GIL is released in numpy)."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        q: queue.Queue = queue.Queue(queue_size)
+        errors: List[BaseException] = []
+
+        def work(r):
+            try:
+                for d in r():
+                    q.put(d)
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                q.put(_End)
+
+        for r in readers:
+            threading.Thread(target=work, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is _End:
+                finished += 1
+                continue
+            yield item
+        if errors:
+            raise errors[0]
+
+    return data_reader
+
+
+def batch(reader, batch_size: int, drop_last=False):
+    """Group samples into lists of batch_size (reference batch.py)."""
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,225 @@
+"""Dataset package + reader decorator tests.
+
+Parity model: reference python/paddle/reader/tests/decorator_test.py and
+python/paddle/dataset/tests/*_test.py (shape/dtype/range assertions).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dataset, readers
+
+
+class TestDatasets:
+    def test_mnist_shapes(self):
+        it = dataset.mnist.train()()
+        img, lab = next(it)
+        assert img.shape == (784,) and img.dtype == np.float32
+        assert -1.0 <= img.min() and img.max() <= 1.0
+        assert 0 <= lab < 10
+
+    def test_mnist_deterministic(self):
+        first = [(img.sum(), lab) for _, (img, lab) in
+                 zip(range(10), dataset.mnist.train()())]
+        second = [(img.sum(), lab) for _, (img, lab) in
+                  zip(range(10), dataset.mnist.train()())]
+        assert first == second
+
+    def test_cifar(self):
+        img, lab = next(dataset.cifar.train10()())
+        assert img.shape == (3072,)
+        assert 0 <= lab < 10
+        img, lab = next(dataset.cifar.train100()())
+        assert 0 <= lab < 100
+
+    def test_uci_housing_linear_structure(self):
+        xs, ys = [], []
+        for x, y in dataset.uci_housing.train()():
+            xs.append(x)
+            ys.append(y[0])
+        X = np.stack(xs)
+        Y = np.array(ys)
+        w, *_ = np.linalg.lstsq(
+            np.concatenate([X, np.ones((len(X), 1))], 1), Y, rcond=None)
+        resid = Y - np.concatenate([X, np.ones((len(X), 1))], 1) @ w
+        assert np.std(resid) < 2.0  # learnable linear signal
+
+    def test_imdb(self):
+        wd = dataset.imdb.word_dict()
+        assert "<unk>" in wd
+        ids, lab = next(dataset.imdb.train(wd)())
+        assert all(0 <= i < len(wd) for i in ids)
+        assert lab in (0, 1)
+
+    def test_wmt14(self):
+        src, trg_in, trg_next = next(dataset.wmt14.train(1000)())
+        assert trg_in[0] == dataset.wmt14.START_ID
+        assert trg_next[-1] == dataset.wmt14.END_ID
+        assert trg_in[1:] == trg_next[:-1]
+        sd, td = dataset.wmt14.get_dict(1000)
+        assert len(sd) == 1000 and len(td) == 1000
+
+    def test_movielens(self):
+        item = next(dataset.movielens.train()())
+        uid, gender, age, job, mid, cats, title, score = item
+        assert 1 <= uid <= dataset.movielens.max_user_id()
+        assert 1 <= mid <= dataset.movielens.max_movie_id()
+        assert 1.0 <= score[0] <= 5.0
+
+    def test_conll05(self):
+        wd, vd, ld = dataset.conll05.get_dict()
+        item = next(dataset.conll05.test()())
+        assert len(item) == 9
+        length = len(item[0])
+        assert all(len(s) == length for s in item)
+        assert sum(item[7]) == 1  # exactly one predicate mark
+
+    def test_flowers(self):
+        img, lab = next(dataset.flowers.train()())
+        assert img.shape == (3 * 224 * 224,)
+        assert 0 <= lab < 102
+
+    def test_image_transforms(self):
+        im = np.arange(40 * 60 * 3, dtype=np.float32).reshape(40, 60, 3)
+        out = dataset.image.resize_short(im, 32)
+        assert min(out.shape[:2]) == 32
+        out = dataset.image.simple_transform(im, 36, 32, is_train=False)
+        assert out.shape == (3, 32, 32)
+
+
+class TestReaderDecorators:
+    def _range_reader(self, n):
+        def reader():
+            return iter(range(n))
+
+        return reader
+
+    def test_batch(self):
+        b = readers.batch(self._range_reader(10), 3)
+        batches = list(b())
+        assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+        b = readers.batch(self._range_reader(10), 3, drop_last=True)
+        assert len(list(b())) == 3
+
+    def test_shuffle_preserves_items(self):
+        out = list(readers.shuffle(self._range_reader(20), 5, seed=1)())
+        assert sorted(out) == list(range(20))
+
+    def test_buffered(self):
+        out = list(readers.buffered(self._range_reader(50), 8)())
+        assert out == list(range(50))
+
+    def test_buffered_propagates_errors(self):
+        def bad():
+            yield 1
+            raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            list(readers.buffered(lambda: bad(), 2)())
+
+    def test_compose_chain_firstn(self):
+        r1 = self._range_reader(3)
+        r2 = lambda: iter("abc")  # noqa: E731
+        assert list(readers.compose(r1, r2)()) == [(0, "a"), (1, "b"),
+                                                   (2, "c")]
+        assert list(readers.chain(r1, r1)()) == [0, 1, 2, 0, 1, 2]
+        assert list(readers.firstn(self._range_reader(100), 4)()) == \
+            [0, 1, 2, 3]
+
+    def test_map_readers(self):
+        out = list(readers.map_readers(lambda a, b: a + b,
+                                       self._range_reader(3),
+                                       self._range_reader(3))())
+        assert out == [0, 2, 4]
+
+    def test_cache(self):
+        calls = [0]
+
+        def src():
+            calls[0] += 1
+            return iter(range(5))
+
+        r = readers.cache(src)
+        assert list(r()) == list(range(5))
+        assert list(r()) == list(range(5))
+        assert calls[0] == 1
+
+    def test_xmap_ordered(self):
+        out = list(readers.xmap_readers(lambda x: x * 2,
+                                        self._range_reader(30), 4, 8,
+                                        order=True)())
+        assert out == [x * 2 for x in range(30)]
+
+    def test_xmap_unordered(self):
+        out = list(readers.xmap_readers(lambda x: x * 2,
+                                        self._range_reader(30), 4, 8)())
+        assert sorted(out) == [x * 2 for x in range(30)]
+
+    def test_multiprocess_reader(self):
+        out = list(readers.multiprocess_reader(
+            [self._range_reader(10), self._range_reader(10)])())
+        assert sorted(out) == sorted(list(range(10)) * 2)
+
+    def test_batch_exposed_at_top_level(self):
+        assert fluid.batch is readers.batch
+
+    def test_xmap_propagates_mapper_error(self):
+        def bad_map(x):
+            if x == 5:
+                raise ValueError("boom")
+            return x
+
+        with pytest.raises(ValueError):
+            list(readers.xmap_readers(bad_map, self._range_reader(10),
+                                      2, 4, order=True)())
+
+    def test_multiprocess_propagates_reader_error(self):
+        def bad():
+            yield 1
+            raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            list(readers.multiprocess_reader(
+                [self._range_reader(5), lambda: bad()])())
+
+    def test_cache_partial_first_pass_not_corrupted(self):
+        r = readers.cache(self._range_reader(5))
+        assert list(readers.firstn(r, 3)()) == [0, 1, 2]
+        assert list(r()) == list(range(5))
+        assert list(r()) == list(range(5))
+
+    def test_compose_off_by_one_detected(self):
+        with pytest.raises(RuntimeError):
+            list(readers.compose(self._range_reader(4),
+                                 self._range_reader(3))())
+
+    def test_flowers_mapper_applied(self):
+        r = dataset.flowers.test(mapper=lambda s: (s[0] * 0 + 1.0, s[1]))
+        img, lab = next(r())
+        assert float(img.max()) == 1.0 and float(img.min()) == 1.0
+
+
+class TestEndToEndWithExecutor:
+    def test_mnist_reader_feeds_training(self):
+        import paddle_tpu.layers as layers
+
+        img = layers.data(name="img", shape=[784], dtype="float32")
+        label = layers.data(name="label", shape=[1], dtype="int64")
+        fc = layers.fc(input=img, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=fc, label=label))
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.5)
+        opt.minimize(loss)
+
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(fluid.default_startup_program())
+        feeder = fluid.DataFeeder(feed_list=[img, label])
+        train_reader = fluid.batch(
+            fluid.readers.shuffle(fluid.dataset.mnist.train(), 500,
+                                  seed=0), batch_size=64)
+        losses = []
+        for i, batch in enumerate(train_reader()):
+            if i >= 30:
+                break
+            out, = exe.run(feed=feeder.feed(batch), fetch_list=[loss])
+            losses.append(float(out))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1
