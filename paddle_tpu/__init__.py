@@ -44,6 +44,9 @@ from .readers import batch
 from . import dataset
 from . import ir
 from . import inference
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
+    memory_optimize, release_memory
 
 # fluid-compat: many scripts do `import paddle.fluid as fluid`; we expose
 # the same names so `import paddle_tpu as fluid` works.

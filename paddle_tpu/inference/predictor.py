@@ -106,8 +106,9 @@ class AnalysisPredictor(PaddlePredictor):
             import os
 
             dirname = os.path.dirname(cfg.prog_file) or "."
-            model_filename = os.path.basename(cfg.prog_file)
-            params_filename = (os.path.basename(cfg.params_file)
+            model_filename = os.path.relpath(cfg.prog_file, dirname)
+            # params may live in a different directory than the program
+            params_filename = (os.path.relpath(cfg.params_file, dirname)
                                if cfg.params_file else None)
         old = scope_mod._global_scope
         scope_mod._global_scope = self._scope
@@ -130,7 +131,8 @@ class AnalysisPredictor(PaddlePredictor):
         from .. import ir
 
         ir.apply_passes(self._program, self._config.all_passes(),
-                        scope=self._scope)
+                        scope=self._scope,
+                        protected=set(self._fetch_names))
 
     def _cast_params_bf16(self):
         """bf16 serving: cast float32 params once at load; XLA then runs

@@ -176,8 +176,11 @@ def get_pass(name: str) -> Pass:
 
 
 def apply_passes(program: Program, pass_names: List[str], scope=None,
-                 block_idx: int = 0) -> Program:
+                 block_idx: int = 0, protected=None) -> Program:
+    """`protected` vars (e.g. the predictor's fetch targets) must still
+    be produced by the rewritten program — passes may not erase them."""
     graph = Graph(program, block_idx)
+    graph.attrs["protected"] = set(protected or ())
     for name in pass_names:
         get_pass(name).apply(graph, scope)
     return graph.to_program()
@@ -200,6 +203,14 @@ class DropoutEliminatePass(Pass):
             out, = op.output("Out")
             impl = op.attr("dropout_implementation", "downgrade_in_infer")
             if impl == "upscale_in_train":
+                if out in graph.attrs.get("protected", ()):
+                    # fetched var must stay produced: identity instead
+                    # of rewiring it away (XLA elides the copy)
+                    idx = graph.block.ops.index(op)
+                    graph.remove_op(op)
+                    graph.block.insert_op(idx, "assign", {"X": [x]},
+                                          {"Out": [out]}, {})
+                    continue
                 graph.replace_input_everywhere(out, x, after=op)
                 graph.remove_op(op)
             else:
@@ -275,8 +286,13 @@ class ConvBNFusePass(Pass):
                 scope._set(b_name, ((b0.reshape(-1) - mean) * inv_std
                                     + beta).astype(b0.dtype).reshape(
                                         b0.shape))
+                idx = graph.block.ops.index(bn)
                 graph.remove_op(bn)
-                graph.replace_input_everywhere(bn_out, mid)
+                if bn_out in graph.attrs.get("protected", ()):
+                    graph.block.insert_op(idx, "assign", {"X": [mid]},
+                                          {"Out": [bn_out]}, {})
+                else:
+                    graph.replace_input_everywhere(bn_out, mid)
             else:
                 bias_name = w_name + "@bn_fused_bias"
                 bias_val = (beta - mean * inv_std).astype(w.dtype)
@@ -301,45 +317,52 @@ class FCFusePass(Pass):
     is a smaller program (one traced op instead of three)."""
 
     def apply_impl(self, graph: Graph, scope):
-        changed = True
-        while changed:
-            changed = False
-            for add in list(graph.block.ops):
-                if add.type != "elementwise_add":
-                    continue
-                mul = graph.producer(add, "X")
-                if mul is None or mul.type != "mul":
-                    continue
-                # Y must be a 1-D persistable bias param (reference
-                # fc_fuse_pass.cc checks the same) — a residual add of
-                # an activation is NOT an fc bias
-                y_name = add.input("Y")[0]
-                y_var = (graph.block.vars.get(y_name)
-                         or graph.block._find_var_recursive(y_name))
-                if (y_var is None or not y_var.persistable
-                        or y_var.shape is None or len(y_var.shape) != 1):
-                    continue
-                if graph.producer(add, "Y") is not None:
-                    continue
-                mul_out, = mul.output("Out")
-                if [c is add for c in
-                        graph.consumers(mul, mul_out)] != [True]:
-                    continue
-                add_out, = add.output("Out")
-                act = None
-                consumers = graph.consumers(add, add_out)
-                if len(consumers) == 1 and consumers[0].type == "relu":
-                    act = consumers[0]
-                out_name = act.output("Out")[0] if act else add_out
-                idx = graph.block.ops.index(mul)
-                for dead in ([mul, add] + ([act] if act else [])):
-                    graph.remove_op(dead)
-                graph.block.insert_op(
-                    idx, "fc",
-                    {"Input": mul.input("X"), "W": mul.input("Y"),
-                     "Bias": add.input("Y")},
-                    {"Out": [out_name]},
-                    {"in_num_col_dims": mul.attr("x_num_col_dims", 1),
-                     "activation_type": "relu" if act else ""})
-                changed = True
-                break
+        protected = graph.attrs.get("protected", set())
+        i = 0
+        # single forward sweep (no restart after a fuse): fusing at
+        # position i only touches ops up to the optional act right
+        # after the add, so continuing from i stays correct and keeps
+        # the pass O(n^2) instead of O(n^3) on big serving programs
+        while i < len(graph.block.ops):
+            add = graph.block.ops[i]
+            i += 1
+            if add.type != "elementwise_add":
+                continue
+            mul = graph.producer(add, "X")
+            if mul is None or mul.type != "mul":
+                continue
+            # Y must be a 1-D persistable bias param (reference
+            # fc_fuse_pass.cc checks the same) — a residual add of
+            # an activation is NOT an fc bias
+            y_name = add.input("Y")[0]
+            y_var = (graph.block.vars.get(y_name)
+                     or graph.block._find_var_recursive(y_name))
+            if (y_var is None or not y_var.persistable
+                    or y_var.shape is None or len(y_var.shape) != 1):
+                continue
+            if graph.producer(add, "Y") is not None:
+                continue
+            mul_out, = mul.output("Out")
+            if mul_out in protected:
+                continue
+            if [c is add for c in
+                    graph.consumers(mul, mul_out)] != [True]:
+                continue
+            add_out, = add.output("Out")
+            act = None
+            consumers = graph.consumers(add, add_out)
+            if (len(consumers) == 1 and consumers[0].type == "relu"
+                    and add_out not in protected):
+                act = consumers[0]
+            out_name = act.output("Out")[0] if act else add_out
+            idx = graph.block.ops.index(mul)
+            for dead in ([mul, add] + ([act] if act else [])):
+                graph.remove_op(dead)
+            graph.block.insert_op(
+                idx, "fc",
+                {"Input": mul.input("X"), "W": mul.input("Y"),
+                 "Bias": add.input("Y")},
+                {"Out": [out_name]},
+                {"in_num_col_dims": mul.attr("x_num_col_dims", 1),
+                 "activation_type": "relu" if act else ""})
+            i = idx  # continue right after the new fc op

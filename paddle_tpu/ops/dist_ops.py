@@ -1,0 +1,154 @@
+"""Distributed graph ops: send/recv/barriers/split_byref/prefetch.
+
+Parity: reference paddle/fluid/operators/distributed_ops/ (send_op.cc,
+recv_op.cc, send_barrier_op.cc, fetch_barrier_op.cc, prefetch_op.cc,
+listen_and_serv_op.cc) + split_byref_op.cc.
+
+TPU-native: these are *host* effects reached from inside the compiled
+XLA program via `jax.experimental.io_callback(ordered=True)` — the XLA
+analogue of the reference's RPC client calls made from graph ops. The
+endpoint table they talk to is transpiler/pserver_runtime.py. ordered=
+True pins the send -> barrier -> recv sequence exactly like the
+reference's per-op program order.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from ..core.registry import register_op
+from ..core.types import to_jnp_dtype
+
+
+def _endpoint(ep: str):
+    from ..transpiler.pserver_runtime import get_endpoint
+
+    return get_endpoint(ep)
+
+
+@register_op("send", differentiable=False)
+def send(ctx):
+    """Push grads (or init values) to endpoints; attrs: epmap aligned
+    with X, varnames = remote names, init (startup push vs grad push)."""
+    vals = ctx.inputs("X")
+    epmap = ctx.attr("epmap")
+    names = ctx.attr("varnames")
+    is_init = ctx.attr("init", False)
+
+    def _do(*arrays):
+        for arr, ep, name in zip(arrays, epmap, names):
+            rt = _endpoint(ep)
+            if is_init:
+                rt.push_init(name, arr)
+            else:
+                rt.push_grad(name, arr)
+        return np.int32(0)
+
+    io_callback(_do, jax.ShapeDtypeStruct((), jnp.int32), *vals,
+                ordered=True)
+    return {}
+
+
+@register_op("send_barrier", differentiable=False)
+def send_barrier(ctx):
+    endpoints = ctx.attr("endpoints")
+
+    def _do():
+        for ep in endpoints:
+            _endpoint(ep).barrier()
+        return np.int32(0)
+
+    io_callback(_do, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
+    return {}
+
+
+@register_op("recv", differentiable=False)
+def recv(ctx):
+    """Pull param blocks; attrs: epmap aligned with Out slot vars,
+    varnames = remote names."""
+    epmap = ctx.attr("epmap")
+    names = ctx.attr("varnames")
+    out_names = ctx.op.output("Out")
+    block = ctx.op.block
+    specs = []
+    for n in out_names:
+        var = block.var(n)
+        specs.append(jax.ShapeDtypeStruct(
+            tuple(var.shape), to_jnp_dtype(var.dtype or "float32")))
+
+    def _do():
+        outs = []
+        for ep, name, spec in zip(epmap, names, specs):
+            v = np.asarray(_endpoint(ep).pull(name))
+            outs.append(v.astype(spec.dtype).reshape(spec.shape))
+        return tuple(outs)
+
+    vals = io_callback(_do, tuple(specs), ordered=True)
+    return {"Out": list(vals)}
+
+
+@register_op("fetch_barrier", differentiable=False)
+def fetch_barrier(ctx):
+    def _do():
+        return np.int32(0)
+
+    io_callback(_do, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
+    return {}
+
+
+@register_op("split_byref", differentiable=False)
+def split_byref(ctx):
+    """Split X along dim0 into given sections (reference
+    split_byref_op.cc; feeds per-endpoint send)."""
+    x = ctx.input("X")
+    sections = ctx.attr("sections")
+    outs = []
+    off = 0
+    for s in sections:
+        outs.append(jax.lax.slice_in_dim(x, off, off + s, axis=0))
+        off += s
+    return {"Out": outs}
+
+
+@register_op("prefetch", differentiable=False)
+def prefetch(ctx):
+    """Distributed-lookup-table row fetch (reference prefetch_op.cc +
+    parameter_prefetch.cc): gather rows of a row-sharded table from the
+    endpoints that own them. Rows are mod-sharded across endpoints
+    (ps_dispatcher round-robin row placement)."""
+    ids = ctx.input("Ids")
+    epmap = ctx.attr("epmap")
+    names = ctx.attr("varnames")
+    emb_dim = ctx.attr("emb_dim")
+    n_shards = len(epmap)
+    flat = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    spec = jax.ShapeDtypeStruct((int(flat.shape[0]), int(emb_dim)),
+                                jnp.float32)
+
+    def _do(idv):
+        idv = np.asarray(idv)
+        out = np.zeros((idv.shape[0], emb_dim), np.float32)
+        for shard, (ep, name) in enumerate(zip(epmap, names)):
+            mask = (idv % n_shards) == shard
+            if not mask.any():
+                continue
+            table = np.asarray(_endpoint(ep).pull(name))
+            out[mask] = table[idv[mask] // n_shards]
+        return out
+
+    rows = io_callback(_do, spec, flat, ordered=True)
+    out_shape = tuple(ids.shape) + (int(emb_dim),)
+    if ids.ndim and ids.shape[-1] == 1:
+        out_shape = tuple(ids.shape[:-1]) + (int(emb_dim),)
+    return {"Out": jnp.reshape(rows, out_shape)}
+
+
+@register_op("listen_and_serv", differentiable=False)
+def listen_and_serv(ctx):
+    raise RuntimeError(
+        "listen_and_serv is a host server loop, not a compiled op; run "
+        "the pserver program via transpiler.pserver_runtime."
+        "configure_endpoint(...) (the reference equivalent is "
+        "listen_and_serv_op.cc RunImpl blocking the process)")

@@ -1,0 +1,11 @@
+"""Transpilers (parity: reference python/paddle/fluid/transpiler/)."""
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig, VarBlock)
+from .memory_optimization_transpiler import memory_optimize, \
+    release_memory
+from .ps_dispatcher import HashName, PSDispatcher, RoundRobin
+from . import pserver_runtime
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "VarBlock", "memory_optimize", "release_memory", "HashName",
+           "PSDispatcher", "RoundRobin", "pserver_runtime"]

@@ -1,0 +1,395 @@
+"""DistributeTranspiler: rewrite a local program for distributed
+training.
+
+Parity: reference python/paddle/fluid/transpiler/distribute_transpiler.py
+(DistributeTranspiler:161, transpile:280, get_trainer_program:554,
+get_pserver_program:674, VarBlock:69, _init_splited_vars:1131) and
+DistributeTranspilerConfig:130.
+
+Two modes, like the reference:
+
+* pserver (default): params are sliced into VarBlocks, placed on
+  endpoints by a PSDispatcher; the trainer program's optimize ops are
+  replaced by split_byref -> send -> send_barrier -> recv -> concat
+  -> fetch_barrier; the pserver program is one listen_and_serv op whose
+  sub-blocks hold the per-block optimize ops. Transport is the
+  io_callback host bridge (ops/dist_ops.py) to in-process endpoint
+  runtimes — a real multi-host deployment would place those runtimes in
+  separate processes (the capability, not the sockets, is the parity
+  target).
+* collective ("nccl2" in the reference): the program is left whole;
+  gradients get in-graph allreduce semantics via data-parallel pjit
+  (compiler.CompiledProgram.with_data_parallel) — on TPU the transpiler
+  only needs to record num_trainers/trainer_id (XLA GSPMD inserts the
+  ICI collectives; no gen_nccl_id bootstrap op is needed because
+  jax.distributed owns rendezvous).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.program import Program, default_main_program, \
+    default_startup_program
+from .ps_dispatcher import PSDispatcher, RoundRobin
+
+_OPTIMIZE_ROLES = ("optimize", "lr_sched")
+
+
+class DistributeTranspilerConfig:
+    """reference distribute_transpiler.py:130."""
+
+    slice_var_up = True
+    min_block_size = 8192
+    split_method = RoundRobin
+    # "pserver" | "collective" (the reference spells collective "nccl2")
+    mode = "pserver"
+    sync_mode = True
+
+
+class VarBlock:
+    """A slice of a variable placed on one endpoint (reference
+    distribute_transpiler.py:69)."""
+
+    def __init__(self, varname: str, idx: int, begin: int, size: int,
+                 n_blocks: int):
+        self.varname = varname
+        self.idx = idx
+        self.begin = begin  # row offset
+        self.size = size  # rows
+        self.n_blocks = n_blocks
+
+    @property
+    def block_name(self):
+        if self.n_blocks == 1:
+            return self.varname
+        return f"{self.varname}.block{self.idx}"
+
+    def __repr__(self):
+        return f"VarBlock({self.block_name}[{self.begin}:+{self.size}])"
+
+
+def _split_rows(var, n_parts: int, min_block_size: int,
+                slice_var_up: bool) -> List[VarBlock]:
+    shape = list(var.shape)
+    rows = shape[0]
+    row_numel = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+    numel = rows * row_numel
+    if (not slice_var_up or n_parts <= 1 or numel < min_block_size * 2
+            or rows < n_parts):
+        return [VarBlock(var.name, 0, 0, rows, 1)]
+    n = min(n_parts, rows)
+    per = rows // n
+    rem = rows % n
+    blocks, off = [], 0
+    for i in range(n):
+        size = per + (1 if i < rem else 0)
+        blocks.append(VarBlock(var.name, i, off, size, n))
+        off += size
+    return blocks
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+
+    # ------------------------------------------------------------------
+    def transpile(self, trainer_id: int, program: Optional[Program] = None,
+                  pservers: str = "127.0.0.1:6174", trainers: int = 1,
+                  sync_mode: bool = True,
+                  startup_program: Optional[Program] = None,
+                  current_endpoint: str = ""):
+        self.trainer_id = trainer_id
+        self.trainer_num = trainers
+        self.sync_mode = sync_mode and self.config.sync_mode
+        self.origin_program = program or default_main_program()
+        self.startup_program = (startup_program
+                                or default_startup_program())
+        if self.config.mode == "collective" or self.config.mode == "nccl2":
+            # nothing to rewrite: record topology; data-parallel pjit
+            # compiles the collectives (reference _transpile_nccl2 :226
+            # appends gen_nccl_id; jax.distributed replaces that)
+            self.trainer_program = self.origin_program
+            return
+
+        self.pserver_endpoints = [e.strip() for e in pservers.split(",")
+                                  if e.strip()]
+        eps = self.pserver_endpoints
+        dispatcher: PSDispatcher = self.config.split_method(eps)
+
+        # 1. param/grad pairs from optimize ops (reference
+        #    _get_optimize_pass :2050 splits at the op-role boundary)
+        block = self.origin_program.global_block
+        self._optimize_ops = [op for op in block.ops
+                              if op.attr("op_role") in _OPTIMIZE_ROLES]
+        pg: List[Tuple] = []
+        for op in self._optimize_ops:
+            if op.input("Param") and op.input("Grad"):
+                pg.append((block.var(op.input("Param")[0]),
+                           block.var(op.input("Grad")[0]), op))
+        self.params_grads = [(p, g) for p, g, _ in pg]
+
+        # 2. slice into VarBlocks (reference _init_splited_vars :1131)
+        self.param_blocks: Dict[str, List[VarBlock]] = {}
+        self.grad_blocks: Dict[str, List[VarBlock]] = {}
+        self.param_block_ep: Dict[str, str] = {}  # block_name -> endpoint
+        for p, g, _ in pg:
+            pbs = _split_rows(p, len(eps), self.config.min_block_size,
+                              self.config.slice_var_up)
+            placed = dispatcher.dispatch(pbs)
+            self.param_blocks[p.name] = pbs
+            gbs = [VarBlock(g.name, b.idx, b.begin, b.size, b.n_blocks)
+                   for b in pbs]
+            self.grad_blocks[g.name] = gbs
+            for b, ep in zip(pbs, placed):
+                self.param_block_ep[b.block_name] = ep
+
+        # endpoint -> [(param VarBlock, grad VarBlock, optimize op)]
+        self.ep_blocks: Dict[str, List[Tuple]] = {e: [] for e in eps}
+        for p, g, op in pg:
+            for pb, gb in zip(self.param_blocks[p.name],
+                              self.grad_blocks[g.name]):
+                ep = self.param_block_ep[pb.block_name]
+                self.ep_blocks[ep].append((pb, gb, op))
+
+        self._build_trainer_program()
+        self._build_trainer_startup()
+
+    # ------------------------------------------------------------------
+    def _block_var(self, block, vb: VarBlock, proto):
+        shape = list(proto.shape)
+        shape[0] = vb.size
+        return block.create_var(
+            name=vb.block_name, shape=shape, dtype=proto.dtype,
+            persistable=False)
+
+    def _build_trainer_program(self):
+        """reference transpile:280-554: replace optimize ops with the
+        send/recv choreography."""
+        prog = self.origin_program.clone()
+        block = prog.global_block
+        # drop optimize-role ops (they move to the pservers); keep
+        # lr_sched on the trainer so the lr value is computed locally
+        # and shipped with the grads
+        kept, dropped = [], []
+        for op in block.ops:
+            (dropped if op.attr("op_role") == "optimize" else
+             kept).append(op)
+        block.ops = kept
+
+        lr_names = sorted({op.input("LearningRate")[0]
+                           for op in dropped if op.input("LearningRate")})
+
+        send_vals, send_eps, send_names = [], [], []
+        for p, g in self.params_grads:
+            gbs = self.grad_blocks[g.name]
+            if len(gbs) > 1:
+                outs = []
+                for gb in gbs:
+                    self._block_var(block, gb, g)
+                    outs.append(gb.block_name)
+                block.append_op(
+                    "split_byref", {"X": [g.name]}, {"Out": outs},
+                    {"sections": [b.size for b in gbs],
+                     "op_role": "dist"})
+            for gb, pb in zip(gbs, self.param_blocks[p.name]):
+                send_vals.append(gb.block_name)
+                send_eps.append(self.param_block_ep[pb.block_name])
+                send_names.append(gb.block_name)
+        # lr values replicate to every endpoint as store updates (they
+        # are state the optimize blocks read, not grads to merge); they
+        # go BEFORE the grad sends because async mode applies each grad
+        # the moment it arrives
+        lr_vals, lr_eps, lr_remote = [], [], []
+        for lr in lr_names:
+            for ep in self.pserver_endpoints:
+                lr_vals.append(lr)
+                lr_eps.append(ep)
+                lr_remote.append(lr)
+        if lr_vals:
+            block.append_op("send", {"X": lr_vals}, {},
+                            {"epmap": lr_eps, "varnames": lr_remote,
+                             "init": True, "op_role": "dist"})
+        if send_vals:
+            block.append_op("send", {"X": send_vals}, {},
+                            {"epmap": send_eps, "varnames": send_names,
+                             "op_role": "dist"})
+            block.append_op("send_barrier", {}, {},
+                            {"endpoints": self.pserver_endpoints,
+                             "trainer_id": self.trainer_id,
+                             "op_role": "dist"})
+        for p, g in self.params_grads:
+            pbs = self.param_blocks[p.name]
+            if len(pbs) == 1:
+                block.append_op(
+                    "recv", {}, {"Out": [p.name]},
+                    {"epmap": [self.param_block_ep[pbs[0].block_name]],
+                     "varnames": [pbs[0].block_name],
+                     "op_role": "dist"})
+            else:
+                outs = []
+                for pb in pbs:
+                    self._block_var(block, pb, p)
+                    outs.append(pb.block_name)
+                block.append_op(
+                    "recv", {}, {"Out": outs},
+                    {"epmap": [self.param_block_ep[b.block_name]
+                               for b in pbs],
+                     "varnames": [b.block_name for b in pbs],
+                     "op_role": "dist"})
+                block.append_op("concat", {"X": outs}, {"Out": [p.name]},
+                                {"axis": 0, "op_role": "dist"})
+        if send_vals:
+            block.append_op("fetch_barrier", {}, {},
+                            {"endpoints": self.pserver_endpoints,
+                             "op_role": "dist"})
+        self.trainer_program = prog
+
+    def _build_trainer_startup(self):
+        """Append init-sends: push initial param + accumulator slices to
+        their endpoints. (Deviation from the reference, which re-runs
+        init ops on each pserver; pushing trainer-0 values gives
+        byte-identical init across roles, which the reference needs
+        BCastParamsToDevices for.)"""
+        prog = self.startup_program.clone()
+        if self.trainer_id != 0:
+            self.trainer_startup_program = prog
+            return
+        block = prog.global_block
+        vals, eps_l, names = [], [], []
+        main_block = self.origin_program.global_block
+        for pb_list_name, pbs in self.param_blocks.items():
+            p = main_block.var(pb_list_name)
+            opt_op = next(o for o in self._optimize_ops
+                          if o.input("Param")
+                          and o.input("Param")[0] == p.name)
+            state_slots = [s for s in opt_op.inputs
+                           if s not in ("Param", "Grad", "LearningRate")]
+            for pb in pbs:
+                ep = self.param_block_ep[pb.block_name]
+                if pb.n_blocks == 1:
+                    vals.append(p.name)
+                else:
+                    sl = block.create_var(
+                        name=pb.block_name + "@init",
+                        shape=[pb.size] + list(p.shape[1:]),
+                        dtype=p.dtype)
+                    block.append_op(
+                        "slice", {"Input": [p.name]},
+                        {"Out": [sl.name]},
+                        {"axes": [0], "starts": [pb.begin],
+                         "ends": [pb.begin + pb.size],
+                         "op_role": "dist"})
+                    vals.append(sl.name)
+                eps_l.append(ep)
+                names.append(pb.block_name)
+                # accumulators: same-shape ones are sliced alongside,
+                # scalars replicate
+                for slot in state_slots:
+                    for acc_name in opt_op.input(slot):
+                        acc = main_block._find_var_recursive(acc_name)
+                        if acc is None:
+                            continue
+                        if (acc.shape and p.shape
+                                and tuple(acc.shape) == tuple(p.shape)
+                                and pb.n_blocks > 1):
+                            sl = block.create_var(
+                                name=f"{acc_name}.block{pb.idx}@init",
+                                shape=[pb.size] + list(acc.shape[1:]),
+                                dtype=acc.dtype)
+                            block.append_op(
+                                "slice", {"Input": [acc_name]},
+                                {"Out": [sl.name]},
+                                {"axes": [0], "starts": [pb.begin],
+                                 "ends": [pb.begin + pb.size],
+                                 "op_role": "dist"})
+                            vals.append(sl.name)
+                            names.append(f"{acc_name}.block{pb.idx}")
+                        else:
+                            vals.append(acc_name)
+                            names.append(acc_name)
+                        eps_l.append(ep)
+        if vals:
+            block.append_op("send", {"X": vals}, {},
+                            {"epmap": eps_l, "varnames": names,
+                             "init": True, "op_role": "dist"})
+        self.trainer_startup_program = prog
+
+    # ------------------------------------------------------------------
+    def get_trainer_program(self, wait_port=True) -> Program:
+        return self.trainer_program
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None) -> Program:
+        """Trainer-side startup (with init pushes for trainer 0)."""
+        return self.trainer_startup_program
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        """reference get_pserver_program:674: one listen_and_serv op
+        whose sub-blocks each hold one param-block's optimize ops."""
+        prog = Program()
+        main = prog.global_block
+        main_src = self.origin_program.global_block
+        grad_to_block_id = []
+        for pb, gb, opt_op in self.ep_blocks[endpoint]:
+            blk = prog.create_block(parent_idx=0)
+            p = main_src.var(pb.varname)
+            shape = [pb.size] + list(p.shape[1:])
+            blk.create_var(name=pb.block_name, shape=shape,
+                           dtype=p.dtype, persistable=True)
+            grad_shape = list(shape)
+            blk.create_var(name=gb.block_name, shape=grad_shape,
+                           dtype=p.dtype)
+            inputs, outputs = {}, {}
+            for slot, vnames in opt_op.inputs.items():
+                if slot == "Param":
+                    inputs[slot] = [pb.block_name]
+                elif slot == "Grad":
+                    inputs[slot] = [gb.block_name]
+                elif slot == "LearningRate":
+                    inputs[slot] = list(vnames)
+                else:
+                    inputs[slot] = [
+                        (f"{n}.block{pb.idx}" if self._acc_is_sliced(
+                            n, pb) else n) for n in vnames]
+            for slot, vnames in opt_op.outputs.items():
+                mapped = []
+                for n in vnames:
+                    if n == pb.varname:
+                        mapped.append(pb.block_name)
+                    elif self._acc_is_sliced(n, pb):
+                        mapped.append(f"{n}.block{pb.idx}")
+                    else:
+                        mapped.append(n)
+                outputs[slot] = mapped
+            from ..core.program import Operator
+
+            blk.ops.append(Operator(blk, opt_op.type, inputs, outputs,
+                                    dict(opt_op.attrs)))
+            grad_to_block_id.append(f"{gb.block_name}:{blk.idx}")
+        main.append_op(
+            "listen_and_serv", {}, {},
+            {"endpoint": endpoint,
+             "sync_mode": self.sync_mode,
+             "Fanin": self.trainer_num,
+             "grad_to_block_id": grad_to_block_id,
+             "optimize_blocks": [int(e.rsplit(":", 1)[1])
+                                 for e in grad_to_block_id],
+             "op_role": "dist"})
+        prog.current_block_idx = 0
+        prog._pserver_endpoint = endpoint
+        return prog
+
+    def _acc_is_sliced(self, name: str, pb: VarBlock) -> bool:
+        if pb.n_blocks == 1:
+            return False
+        var = self.origin_program.global_block._find_var_recursive(name)
+        p = self.origin_program.global_block.var(pb.varname)
+        return (var is not None and var.shape and p.shape
+                and tuple(var.shape) == tuple(p.shape))
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver_program(endpoint), \
+            self.get_startup_program(endpoint)

@@ -1,0 +1,58 @@
+"""Parameter-block -> pserver placement policies.
+
+Parity: reference python/paddle/fluid/transpiler/ps_dispatcher.py
+(PSDispatcher, RoundRobin, HashName).
+"""
+from __future__ import annotations
+
+from typing import List
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints: List[str]):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    """reference ps_dispatcher.py RoundRobin."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(PSDispatcher):
+    """reference ps_dispatcher.py HashName: stable placement by name
+    hash, so re-transpiling yields identical placement."""
+
+    @staticmethod
+    def _hash(name: str) -> int:
+        h = 2166136261
+        for ch in name:
+            h = ((h ^ ord(ch)) * 16777619) & 0xFFFFFFFF
+        return h
+
+    def dispatch(self, varlist):
+        # VarBlocks hash by their stable block_name (w.block0), plain
+        # vars by .name — placement must not depend on slice geometry
+        # encoded in repr()
+        def key(v):
+            return getattr(v, "block_name", None) or \
+                getattr(v, "name", None) or str(v)
+
+        return [self._eps[self._hash(key(v)) % len(self._eps)]
+                for v in varlist]

@@ -1,0 +1,165 @@
+"""In-process parameter-server runtime.
+
+Parity: reference operators/distributed/ (RPCServer rpc_server.h:48,
+RequestHandlerImpl request_handler_impl.cc: Send=merge grads, Get=serve
+params) + listen_and_serv_op.cc (RunSyncLoop :107, RunAsyncLoop :223).
+
+TPU-native inversion: the reference runs a gRPC server process per
+pserver. Here the transport is a host-side endpoint registry reached
+from inside the XLA program via ordered io_callback (the graph-visible
+send/recv ops in ops/dist_ops.py) — same program semantics (send ->
+barrier -> merge -> optimize -> recv), no sockets needed for the
+in-process capability. A real multi-host deployment replaces this
+registry with jax.distributed + DCN collectives (parallel/env.py); the
+pserver *capability* (sharded params + async updates) is what this
+module keeps alive for CTR-style workloads.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["PServerRuntime", "get_endpoint", "reset_endpoints",
+           "configure_endpoint"]
+
+_REGISTRY: Dict[str, "PServerRuntime"] = {}
+_LOCK = threading.Lock()
+
+
+def get_endpoint(endpoint: str) -> "PServerRuntime":
+    with _LOCK:
+        if endpoint not in _REGISTRY:
+            _REGISTRY[endpoint] = PServerRuntime(endpoint)
+        return _REGISTRY[endpoint]
+
+
+def configure_endpoint(endpoint: str, pserver_program, num_trainers: int,
+                       sync_mode: bool) -> "PServerRuntime":
+    rt = get_endpoint(endpoint)
+    rt.configure(pserver_program, num_trainers, sync_mode)
+    return rt
+
+
+def reset_endpoints():
+    with _LOCK:
+        _REGISTRY.clear()
+
+
+class PServerRuntime:
+    """One endpoint's state: param blocks + grad merge + optimize blocks
+    (the reference's per-param optimize sub-blocks of listen_and_serv,
+    distribute_transpiler.py:674 get_pserver_program)."""
+
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint
+        self.store: Dict[str, np.ndarray] = {}
+        self._grad_bufs: Dict[str, List[np.ndarray]] = {}
+        self._program = None
+        self._grad_to_block: Dict[str, int] = {}
+        self.num_trainers = 1
+        self.sync_mode = True
+        self._barrier_count = 0
+        self._generation = 0
+        self.barrier_timeout = 60.0
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+
+    # --- setup ---------------------------------------------------------
+    def configure(self, pserver_program, num_trainers: int,
+                  sync_mode: bool):
+        with self._lock:
+            self._program = pserver_program
+            self.num_trainers = num_trainers
+            self.sync_mode = sync_mode
+            ls = pserver_program.global_block.ops[0]
+            assert ls.type == "listen_and_serv"
+            self._grad_to_block = {}
+            for entry in ls.attr("grad_to_block_id", []):
+                g, idx = entry.rsplit(":", 1)
+                self._grad_to_block[g] = int(idx)
+
+    # --- RPC-handler equivalents --------------------------------------
+    def push_init(self, name: str, value):
+        """CheckpointNotify-era param placement: store an initial value
+        (reference pserver startup initializes its own slices)."""
+        with self._lock:
+            self.store[name] = np.asarray(value)
+
+    def push_grad(self, name: str, value):
+        """RequestSend handler (request_handler_impl.cc): buffer the
+        grad; async mode applies immediately."""
+        with self._lock:
+            self._grad_bufs.setdefault(name, []).append(np.asarray(value))
+            if not self.sync_mode:
+                self._apply_for_grad(name)
+
+    def barrier(self):
+        """kRequestSend barrier (listen_and_serv_op.cc:143): BLOCKS the
+        caller until every trainer has signalled, then the last arrival
+        merges + runs the optimize blocks and releases the others — so
+        a recv after the barrier always sees this step's update. With
+        num_trainers > 1 the trainers must run in separate threads (the
+        reference uses separate processes); a single-threaded caller
+        would otherwise deadlock, so the wait raises after
+        barrier_timeout seconds."""
+        with self._cond:
+            self._barrier_count += 1
+            if not self.sync_mode:
+                return
+            if self._barrier_count >= self.num_trainers:
+                self._barrier_count = 0
+                for g in list(self._grad_bufs):
+                    self._apply_for_grad(g)
+                self._generation += 1
+                self._cond.notify_all()
+                return
+            gen = self._generation
+            if not self._cond.wait_for(
+                    lambda: self._generation != gen,
+                    timeout=self.barrier_timeout):
+                raise RuntimeError(
+                    f"pserver {self.endpoint}: sync barrier timed out "
+                    f"waiting for {self.num_trainers} trainers "
+                    f"({self._barrier_count} arrived); with "
+                    f"num_trainers > 1 run each trainer in its own "
+                    f"thread/process")
+
+    def pull(self, name: str) -> np.ndarray:
+        """RequestGet handler: serve the current param block."""
+        with self._lock:
+            if name not in self.store:
+                raise KeyError(
+                    f"pserver {self.endpoint}: param block {name!r} not "
+                    f"initialized (run the transpiled startup program "
+                    f"first)")
+            return self.store[name]
+
+    # --- optimize-block execution --------------------------------------
+    def _apply_for_grad(self, grad_name: str):
+        grads = self._grad_bufs.pop(grad_name, [])
+        if not grads or self._program is None:
+            return
+        # merge: sum then scale 1/N (reference
+        # _append_pserver_grad_merge_ops distribute_transpiler.py:1649)
+        merged = grads[0]
+        for g in grads[1:]:
+            merged = merged + g
+        if len(grads) > 1:
+            merged = merged / float(len(grads))
+        blk_idx = self._grad_to_block.get(grad_name)
+        if blk_idx is None:
+            return
+        block = self._program.blocks[blk_idx]
+        env = dict(self.store)
+        env[grad_name] = merged
+        from ..core.registry import run_op
+
+        for op in block.ops:
+            run_op(op, env)
+        # persist every var the block wrote (ParamOut/accumulators)
+        for op in block.ops:
+            for out in op.output_arg_names:
+                if out in env:
+                    self.store[out] = np.asarray(env[out])
