@@ -42,7 +42,11 @@ def _find_op_path(block, loss_name: str, stop_names: Set[str]):
 def _collect_no_grad(block, extra=None) -> Set[str]:
     no_grad = set(extra or ())
     for var in block.vars.values():
-        if var.stop_gradient or var.is_data:
+        # data vars default stop_gradient=True via layers.data(); an
+        # explicit stop_gradient=False on a data var lets gradients
+        # flow to it (fluid semantics — e.g. adversarial-example or
+        # detection-loss grad checks)
+        if var.stop_gradient:
             no_grad.add(var.name)
         if var.dtype is not None and var.dtype.value.startswith(
                 ("int", "uint", "bool")):

@@ -108,8 +108,27 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
     return out
 
 
-def density_prior_box(*args, **kwargs):
-    raise NotImplementedError("density_prior_box: planned (ops/detection)")
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=[0.1, 0.1, 0.2, 0.2],
+                      clip=False, steps=[0.0, 0.0], offset=0.5,
+                      flatten_to_2d=False, name=None):
+    helper = LayerHelper("density_prior_box", input=input, name=name)
+    box = helper.create_variable_for_type_inference(input.dtype, True)
+    var = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(
+        "density_prior_box", {"Input": input, "Image": image},
+        {"Boxes": box, "Variances": var},
+        {"densities": list(densities or []),
+         "fixed_sizes": list(fixed_sizes or []),
+         "fixed_ratios": list(fixed_ratios or [1.0]),
+         "variances": list(variance), "clip": clip,
+         "step_w": steps[0], "step_h": steps[1], "offset": offset})
+    if flatten_to_2d:
+        from .nn import reshape
+
+        box = reshape(box, shape=[-1, 4])
+        var = reshape(var, shape=[-1, 4])
+    return box, var
 
 
 def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
@@ -129,34 +148,176 @@ def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
     return anchors, var
 
 
-def bipartite_match(*args, **kwargs):
-    raise NotImplementedError(
-        "bipartite_match: greedy host-side matching; planned")
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    helper = LayerHelper("bipartite_match", input=dist_matrix, name=name)
+    match_indices = helper.create_variable_for_type_inference("int32",
+                                                              True)
+    match_distance = helper.create_variable_for_type_inference(
+        dist_matrix.dtype, True)
+    helper.append_op(
+        "bipartite_match", {"DistMat": dist_matrix},
+        {"ColToRowMatchIndices": match_indices,
+         "ColToRowMatchDist": match_distance},
+        {"match_type": match_type or "bipartite",
+         "dist_threshold": (0.5 if dist_threshold is None
+                            else dist_threshold)})
+    return match_indices, match_distance
 
 
-def target_assign(*args, **kwargs):
-    raise NotImplementedError("target_assign: planned")
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    helper = LayerHelper("target_assign", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, True)
+    out_weight = helper.create_variable_for_type_inference("float32",
+                                                           True)
+    helper.append_op(
+        "target_assign",
+        {"X": input, "MatchIndices": matched_indices},
+        {"Out": out, "OutWeight": out_weight},
+        {"mismatch_value": mismatch_value or 0})
+    return out, out_weight
 
 
-def ssd_loss(*args, **kwargs):
-    raise NotImplementedError("ssd_loss: planned (needs bipartite_match)")
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0,
+             neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None, name=None):
+    """Fused SSD multibox loss (the reference composes ~10 ops in
+    layers/detection.py ssd_loss; here one fused XLA kernel)."""
+    helper = LayerHelper("ssd_loss", input=location, name=name)
+    loss = helper.create_variable_for_type_inference(location.dtype)
+    ins = {"Location": location, "Confidence": confidence,
+           "GTBox": gt_box, "GTLabel": gt_label, "PriorBox": prior_box}
+    if prior_box_var is not None:
+        ins["PriorBoxVar"] = prior_box_var
+    helper.append_op(
+        "ssd_loss", ins, {"Loss": loss},
+        {"background_label": background_label,
+         "overlap_threshold": overlap_threshold,
+         "neg_pos_ratio": neg_pos_ratio, "neg_overlap": neg_overlap,
+         "loc_loss_weight": loc_loss_weight,
+         "conf_loss_weight": conf_loss_weight,
+         "match_type": match_type, "mining_type": mining_type,
+         "normalize": normalize, "sample_size": sample_size or 0})
+    return loss
 
 
-def detection_output(*args, **kwargs):
-    raise NotImplementedError("detection_output: planned")
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3,
+                     nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, nms_eta=1.0, name=None):
+    """SSD output: decode loc at priors then class-wise NMS (reference
+    layers/detection.py detection_output = box_coder + multiclass_nms)."""
+    helper = LayerHelper("detection_output", input=loc, name=name)
+    decoded = helper.create_variable_for_type_inference(loc.dtype)
+    helper.append_op(
+        "box_coder",
+        {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+         "TargetBox": loc},
+        {"OutputBox": decoded},
+        {"code_type": "decode_center_size"})
+    from .nn import transpose
+
+    scores_t = transpose(scores, perm=[0, 2, 1])  # [B, C, M]
+    return multiclass_nms(
+        decoded, scores_t, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, background_label=background_label)
 
 
-def polygon_box_transform(*args, **kwargs):
-    raise NotImplementedError("polygon_box_transform: planned")
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("polygon_box_transform", {"Input": input},
+                     {"Output": out}, {})
+    return out
 
 
-def rpn_target_assign(*args, **kwargs):
-    raise NotImplementedError("rpn_target_assign: planned")
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """Per-anchor labels [-1/0/1] + encoded bbox targets, fixed shape
+    (the reference emits gathered index lists; see ops/detection_ops.py
+    rpn_target_assign for the XLA-native padded encoding)."""
+    helper = LayerHelper("rpn_target_assign", input=anchor_box)
+    labels = helper.create_variable_for_type_inference("int32", True)
+    targets = helper.create_variable_for_type_inference(
+        anchor_box.dtype, True)
+    inside_w = helper.create_variable_for_type_inference(
+        anchor_box.dtype, True)
+    helper.append_op(
+        "rpn_target_assign",
+        {"Anchor": anchor_box, "GtBoxes": gt_boxes},
+        {"LocationIndex": labels, "ScoreIndex": labels,
+         "TargetLabel": labels, "TargetBBox": targets,
+         "BBoxInsideWeight": inside_w},
+        {"rpn_batch_size_per_im": rpn_batch_size_per_im,
+         "rpn_fg_fraction": rpn_fg_fraction,
+         "rpn_positive_overlap": rpn_positive_overlap,
+         "rpn_negative_overlap": rpn_negative_overlap,
+         "use_random": use_random})
+    return labels, targets, inside_w
 
 
-def generate_proposals(*args, **kwargs):
-    raise NotImplementedError("generate_proposals: planned")
+def generate_proposals(scores, bbox_deltas, im_info, anchors,
+                       variances=None, pre_nms_top_n=6000,
+                       post_nms_top_n=1000, nms_thresh=0.5,
+                       min_size=0.1, eta=1.0, name=None):
+    helper = LayerHelper("generate_proposals", input=scores, name=name)
+    rois = helper.create_variable_for_type_inference(scores.dtype, True)
+    probs = helper.create_variable_for_type_inference(scores.dtype,
+                                                      True)
+    helper.append_op(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": bbox_deltas,
+         "ImInfo": im_info, "Anchors": anchors},
+        {"RpnRois": rois, "RpnRoiProbs": probs},
+        {"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+         "nms_thresh": nms_thresh, "min_size": min_size})
+    return rois, probs
 
 
-def generate_proposal_labels(*args, **kwargs):
-    raise NotImplementedError("generate_proposal_labels: planned")
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=[0.1, 0.1, 0.2, 0.2],
+                             class_nums=None, use_random=True):
+    """Match rois to gt, label fg/bg, subsample, encode bbox targets
+    (reference generate_proposal_labels_op.cc; see ops/detection_ops.py
+    for the fixed-shape encoding; is_crowd exclusion is not modeled)."""
+    import warnings
+
+    if is_crowd is not None:
+        warnings.warn("generate_proposal_labels: is_crowd exclusion is "
+                      "not modeled; crowd boxes are treated as regular "
+                      "gt", stacklevel=2)
+    helper = LayerHelper("generate_proposal_labels", input=rpn_rois)
+    labels = helper.create_variable_for_type_inference("int32", True)
+    targets = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, True)
+    inside_w = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, True)
+    outside_w = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, True)
+    rois_out = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, True)
+    helper.append_op(
+        "generate_proposal_labels",
+        {"RpnRois": rpn_rois, "GtClasses": gt_classes,
+         "GtBoxes": gt_boxes},
+        {"Rois": rois_out, "LabelsInt32": labels,
+         "BboxTargets": targets, "BboxInsideWeights": inside_w,
+         "BboxOutsideWeights": outside_w},
+        {"batch_size_per_im": batch_size_per_im,
+         "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+         "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+         "bbox_reg_weights": list(bbox_reg_weights),
+         "use_random": use_random})
+    return rois_out, labels, targets, inside_w, outside_w

@@ -10,3 +10,4 @@ from . import control_flow_ops  # noqa: F401
 from . import decode_ops  # noqa: F401
 from . import loss_extra_ops  # noqa: F401
 from . import dist_ops  # noqa: F401
+from . import detection_ops  # noqa: F401

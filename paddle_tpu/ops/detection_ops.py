@@ -1,0 +1,976 @@
+"""Detection/vision ops.
+
+Parity: reference paddle/fluid/operators/detection/ (prior_box_op.cc,
+box_coder_op.cc, iou_similarity_op.cc, multiclass_nms_op.cc,
+yolov3_loss_op.cc, yolo_box_op.cc, bipartite_match_op.cc,
+target_assign_op.cc, anchor_generator_op.cc, density_prior_box_op.cc,
+box_clip_op.cc, polygon_box_transform_op.cc, rpn_target_assign_op.cc,
+generate_proposals_op.cc) and detection_map_op.cc.
+
+TPU-first design: the reference's NMS/matching kernels emit
+variable-length LoD outputs; XLA needs static shapes, so every
+selection op here returns FIXED-size padded outputs (pad rows carry
+label/index -1) with the true count available from the pad sentinel.
+Suppression loops are `lax.fori_loop`s over a top-k-bounded candidate
+set — O(K*M) fixed-shape work that XLA compiles into tight vector code
+instead of the reference's data-dependent CPU loops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+BIG_NEG = -1e9
+
+
+# ---------------------------------------------------------------------
+# geometry helpers
+# ---------------------------------------------------------------------
+def _iou_matrix(a, b, normalized=True):
+    """Pairwise IoU: a [N,4], b [M,4] (xmin,ymin,xmax,ymax)."""
+    off = 0.0 if normalized else 1.0
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + off, 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1] + off, 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + off, 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1] + off, 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _nms_indices(boxes, scores, iou_threshold, score_threshold, max_out,
+                 normalized=True):
+    """Fixed-size NMS: returns (idx [max_out] int32 with -1 pad)."""
+    m = boxes.shape[0]
+    k = min(max_out, m)
+    valid = scores > score_threshold
+    masked = jnp.where(valid, scores, BIG_NEG)
+    iou = _iou_matrix(boxes, boxes, normalized)
+
+    def body(i, carry):
+        sel, alive, cur = carry
+        best = jnp.argmax(jnp.where(alive, cur, BIG_NEG))
+        ok = jnp.where(alive[best] & (cur[best] > BIG_NEG / 2),
+                       best, -1)
+        sel = sel.at[i].set(ok)
+        suppress = (iou[best] > iou_threshold) & (ok >= 0)
+        alive = alive & ~suppress & (jnp.arange(m) != best)
+        return sel, alive, cur
+
+    sel0 = jnp.full((max_out,), -1, jnp.int32)
+    sel, _, _ = jax.lax.fori_loop(
+        0, k, body, (sel0, valid, masked))
+    return sel
+
+
+# ---------------------------------------------------------------------
+@register_op("iou_similarity", differentiable=False)
+def iou_similarity(ctx):
+    """reference detection/iou_similarity_op.cc: X [N,4] vs Y [M,4]."""
+    return {"Out": _iou_matrix(ctx.input("X"), ctx.input("Y"),
+                               ctx.attr("box_normalized", True))}
+
+
+@register_op("box_clip", differentiable=False)
+def box_clip(ctx):
+    """reference detection/box_clip_op.cc: clip to im_info h/w."""
+    x = ctx.input("Input")
+    im = ctx.input("ImInfo")  # [B,3] (h, w, scale) or [3]
+
+    def clip_one(boxes, info):
+        h, w = info[0], info[1]
+        return jnp.stack([
+            jnp.clip(boxes[..., 0], 0, w - 1),
+            jnp.clip(boxes[..., 1], 0, h - 1),
+            jnp.clip(boxes[..., 2], 0, w - 1),
+            jnp.clip(boxes[..., 3], 0, h - 1)], axis=-1)
+
+    if im.ndim == 1:
+        return {"Output": clip_one(x, im)}
+    # batched: each image clips against its own (h, w)
+    return {"Output": jax.vmap(clip_one)(x, im)}
+
+
+@register_op("box_coder", differentiable=False)
+def box_coder(ctx):
+    """reference detection/box_coder_op.cc: center-size encode/decode."""
+    prior = ctx.input("PriorBox")  # [M,4]
+    pvar = ctx.input("PriorBoxVar")  # [M,4] | None
+    target = ctx.input("TargetBox")
+    code_type = ctx.attr("code_type", "encode_center_size")
+    normalized = ctx.attr("box_normalized", True)
+    off = 0.0 if normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+    if pvar is None:
+        var = jnp.asarray(ctx.attr("variance", [1.0, 1.0, 1.0, 1.0]),
+                          jnp.float32)
+        var = jnp.broadcast_to(var, prior.shape)
+    else:
+        var = pvar
+    if code_type.startswith("encode"):
+        # target [N,4] -> out [N, M, 4]
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = target[:, 0] + 0.5 * tw
+        tcy = target[:, 1] + 0.5 * th
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[None, :, 0]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / var[None, :, 1]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :])) / var[None, :, 2]
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :])) / var[None, :, 3]
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+    else:
+        # decode: target [N, M, 4] or [M, 4]
+        t = target if target.ndim == 3 else target[None, :, :]
+        ocx = pcx[None, :] + t[..., 0] * var[None, :, 0] * pw[None, :]
+        ocy = pcy[None, :] + t[..., 1] * var[None, :, 1] * ph[None, :]
+        ow = jnp.exp(t[..., 2] * var[None, :, 2]) * pw[None, :]
+        oh = jnp.exp(t[..., 3] * var[None, :, 3]) * ph[None, :]
+        out = jnp.stack([ocx - 0.5 * ow, ocy - 0.5 * oh,
+                         ocx + 0.5 * ow - off, ocy + 0.5 * oh - off],
+                        axis=-1)
+        if target.ndim == 2:
+            out = out[0]
+    return {"OutputBox": out}
+
+
+@register_op("prior_box", differentiable=False)
+def prior_box(ctx):
+    """reference detection/prior_box_op.cc: SSD priors per feature-map
+    cell; outputs Boxes/Variances [H, W, P, 4]."""
+    feat = ctx.input("Input")  # [B, C, H, W]
+    image = ctx.input("Image")  # [B, C, IH, IW]
+    min_sizes = [float(s) for s in ctx.attr("min_sizes")]
+    max_sizes = [float(s) for s in ctx.attr("max_sizes", []) or []]
+    ars = [float(a) for a in ctx.attr("aspect_ratios", [1.0])]
+    flip = ctx.attr("flip", False)
+    clip = ctx.attr("clip", False)
+    variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    step_w = ctx.attr("step_w", 0.0)
+    step_h = ctx.attr("step_h", 0.0)
+    offset = ctx.attr("offset", 0.5)
+    min_max_ar_order = ctx.attr("min_max_aspect_ratios_order", False)
+
+    h, w = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    sw = step_w or iw / w
+    sh = step_h or ih / h
+    # expand aspect ratios like the reference (1.0 first, optional flip)
+    out_ars = [1.0]
+    for a in ars:
+        if all(abs(a - b) > 1e-6 for b in out_ars):
+            out_ars.append(a)
+            if flip:
+                out_ars.append(1.0 / a)
+    boxes = []
+    for ms in min_sizes:
+        if min_max_ar_order:
+            boxes.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for a in out_ars:
+                if abs(a - 1.0) < 1e-6:
+                    continue
+                boxes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+        else:
+            for a in out_ars:
+                boxes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    wh = jnp.asarray(boxes, jnp.float32)  # [P, 2]
+    p = wh.shape[0]
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    cxg = cxg[..., None]
+    cyg = cyg[..., None]
+    bw = wh[None, None, :, 0] * 0.5
+    bh = wh[None, None, :, 1] * 0.5
+    out = jnp.stack([(cxg - bw) / iw, (cyg - bh) / ih,
+                     (cxg + bw) / iw, (cyg + bh) / ih], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, p, 4))
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("density_prior_box", differentiable=False)
+def density_prior_box(ctx):
+    """reference detection/density_prior_box_op.cc."""
+    feat = ctx.input("Input")
+    image = ctx.input("Image")
+    densities = [int(d) for d in ctx.attr("densities", [])]
+    fixed_sizes = [float(s) for s in ctx.attr("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in ctx.attr("fixed_ratios", [1.0])]
+    variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = ctx.attr("clip", False)
+    offset = ctx.attr("offset", 0.5)
+    step_w = ctx.attr("step_w", 0.0)
+    step_h = ctx.attr("step_h", 0.0)
+    h, w = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    sw = step_w or iw / w
+    sh = step_h or ih / h
+    # per cell: for each (size, density): density^2 shifted centers,
+    # each with each fixed_ratio
+    entries = []  # (dx, dy, bw, bh) offsets in pixels
+    for size, dens in zip(fixed_sizes, densities):
+        shift = size / dens
+        for r in fixed_ratios:
+            bw = size * np.sqrt(r)
+            bh = size / np.sqrt(r)
+            for di in range(dens):
+                for dj in range(dens):
+                    dx = -size / 2.0 + shift / 2.0 + dj * shift
+                    dy = -size / 2.0 + shift / 2.0 + di * shift
+                    entries.append((dx, dy, bw, bh))
+    ent = jnp.asarray(entries, jnp.float32)  # [P,4]
+    p = ent.shape[0]
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    ccx = cxg[..., None] + ent[None, None, :, 0]
+    ccy = cyg[..., None] + ent[None, None, :, 1]
+    bw = ent[None, None, :, 2] * 0.5
+    bh = ent[None, None, :, 3] * 0.5
+    out = jnp.stack([(ccx - bw) / iw, (ccy - bh) / ih,
+                     (ccx + bw) / iw, (ccy + bh) / ih], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, p, 4))
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("anchor_generator", differentiable=False)
+def anchor_generator(ctx):
+    """reference detection/anchor_generator_op.cc (RPN anchors,
+    absolute pixel coords)."""
+    feat = ctx.input("Input")
+    sizes = [float(s) for s in ctx.attr("anchor_sizes")]
+    ars = [float(a) for a in ctx.attr("aspect_ratios")]
+    variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    stride = [float(s) for s in ctx.attr("stride")]
+    offset = ctx.attr("offset", 0.5)
+    h, w = int(feat.shape[2]), int(feat.shape[3])
+    # anchor_width = size*sqrt(1/ar), anchor_height = size*sqrt(ar)
+    # with ar = h/w (reference anchor_generator_op.h)
+    whs = [(s / np.sqrt(a), s * np.sqrt(a)) for a in ars for s in sizes]
+    wh = jnp.asarray(whs, jnp.float32)
+    p = wh.shape[0]
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    bw = wh[None, None, :, 0] * 0.5
+    bh = wh[None, None, :, 1] * 0.5
+    anchors = jnp.stack([cxg[..., None] - bw, cyg[..., None] - bh,
+                         cxg[..., None] + bw, cyg[..., None] + bh],
+                        axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (h, w, p, 4))
+    return {"Anchors": anchors, "Variances": var}
+
+
+@register_op("bipartite_match", differentiable=False)
+def bipartite_match(ctx):
+    """reference detection/bipartite_match_op.cc: greedy argmax
+    matching on DistMat [B, N, M] (N gt rows, M priors). Outputs
+    ColToRowMatchIndices [B, M] (-1 unmatched) + matched distances.
+    match_type='per_prediction' additionally matches cols whose best
+    row similarity exceeds dist_threshold."""
+    dist = ctx.input("DistMat")
+    batched = dist.ndim == 3
+    if not batched:
+        dist = dist[None]
+    match_type = ctx.attr("match_type", "bipartite")
+    thresh = ctx.attr("dist_threshold", 0.5)
+    b, n, m = dist.shape
+
+    def one(d):
+        def body(i, carry):
+            match, matchd, dd = carry
+            flat = jnp.argmax(dd)
+            r, c = flat // m, flat % m
+            ok = dd[r, c] > 0
+            match = jnp.where(ok, match.at[c].set(r.astype(jnp.int32)),
+                              match)
+            matchd = jnp.where(ok, matchd.at[c].set(d[r, c]), matchd)
+            dd = jnp.where(ok, dd.at[r, :].set(BIG_NEG)
+                           .at[:, c].set(BIG_NEG), dd)
+            return match, matchd, dd
+
+        match0 = jnp.full((m,), -1, jnp.int32)
+        matchd0 = jnp.zeros((m,), dist.dtype)
+        match, matchd, _ = jax.lax.fori_loop(
+            0, min(n, m), body, (match0, matchd0, d))
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_sim = jnp.max(d, axis=0)
+            extra = (match < 0) & (best_sim > thresh)
+            match = jnp.where(extra, best_row, match)
+            matchd = jnp.where(extra, best_sim, matchd)
+        return match, matchd
+
+    match, matchd = jax.vmap(one)(dist)
+    if not batched:
+        match, matchd = match[0], matchd[0]
+    return {"ColToRowMatchIndices": match, "ColToRowMatchDist": matchd}
+
+
+@register_op("target_assign", differentiable=False)
+def target_assign(ctx):
+    """reference detection/target_assign_op.cc: out[i,j] =
+    X[match[i,j]] where matched else mismatch_value."""
+    x = ctx.input("X")  # [N, K] or [B, N, K]
+    match = ctx.input("MatchIndices")  # [B, M]
+    mismatch = ctx.attr("mismatch_value", 0)
+    if x.ndim == 2:
+        x = jnp.broadcast_to(x[None], (match.shape[0],) + x.shape)
+
+    def one(xb, mb):
+        safe = jnp.maximum(mb, 0)
+        out = xb[safe]
+        w = (mb >= 0)
+        out = jnp.where(w[:, None], out,
+                        jnp.asarray(mismatch, x.dtype))
+        return out, w.astype(x.dtype)
+
+    out, w = jax.vmap(one)(x, match)
+    return {"Out": out, "OutWeight": w[..., None]}
+
+
+@register_op("multiclass_nms", differentiable=False)
+def multiclass_nms(ctx):
+    """reference detection/multiclass_nms_op.cc. BBoxes [B, M, 4],
+    Scores [B, C, M] -> Out [B, keep_top_k, 6] rows
+    (label, score, x1, y1, x2, y2); pad rows have label -1.
+
+    (The reference emits a variable-row LoDTensor; fixed-size padding is
+    the XLA-native encoding of the same information.)"""
+    bboxes = ctx.input("BBoxes")
+    scores = ctx.input("Scores")
+    score_thresh = ctx.attr("score_threshold", 0.0)
+    nms_top_k = ctx.attr("nms_top_k", 100)
+    keep_top_k = ctx.attr("keep_top_k", 100)
+    nms_thresh = ctx.attr("nms_threshold", 0.3)
+    normalized = ctx.attr("normalized", True)
+    background = ctx.attr("background_label", 0)
+    b, m, _ = bboxes.shape
+    c = scores.shape[1]
+    per_class = min(nms_top_k, m)
+
+    def one_image(boxes, sc):
+        # per-class NMS -> [C, per_class] indices
+        def per_cls(cls_scores):
+            return _nms_indices(boxes, cls_scores, nms_thresh,
+                                score_thresh, per_class, normalized)
+
+        idx = jax.vmap(per_cls)(sc)  # [C, per_class]
+        cls_ids = jnp.broadcast_to(
+            jnp.arange(c, dtype=jnp.int32)[:, None], idx.shape)
+        valid = (idx >= 0) & (cls_ids != background)
+        flat_idx = idx.reshape(-1)
+        flat_cls = cls_ids.reshape(-1)
+        flat_valid = valid.reshape(-1)
+        flat_scores = jnp.where(
+            flat_valid,
+            sc[flat_cls, jnp.maximum(flat_idx, 0)], BIG_NEG)
+        k = min(keep_top_k, flat_scores.shape[0])
+        top_sc, top_i = jax.lax.top_k(flat_scores, k)
+        sel_box = boxes[jnp.maximum(flat_idx[top_i], 0)]
+        sel_cls = flat_cls[top_i].astype(bboxes.dtype)
+        ok = top_sc > BIG_NEG / 2
+        row = jnp.concatenate(
+            [jnp.where(ok, sel_cls, -1.0)[:, None],
+             jnp.where(ok, top_sc, 0.0)[:, None],
+             jnp.where(ok[:, None], sel_box, 0.0)], axis=1)
+        if k < keep_top_k:
+            row = jnp.concatenate(
+                [row, jnp.tile(jnp.asarray([[-1., 0, 0, 0, 0, 0]],
+                                           row.dtype),
+                               (keep_top_k - k, 1))], axis=0)
+        return row
+
+    return {"Out": jax.vmap(one_image)(bboxes, scores)}
+
+
+@register_op("yolo_box", differentiable=False)
+def yolo_box(ctx):
+    """reference detection/yolo_box_op.cc: decode YOLOv3 head."""
+    x = ctx.input("X")  # [B, A*(5+C), H, W]
+    img_size = ctx.input("ImgSize")  # [B, 2] (h, w)
+    anchors = [int(a) for a in ctx.attr("anchors")]
+    class_num = ctx.attr("class_num")
+    conf_thresh = ctx.attr("conf_thresh", 0.01)
+    downsample = ctx.attr("downsample_ratio", 32)
+    b, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    x = x.reshape(b, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + gx) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + gy) / h
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / (
+        downsample * w)
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / (
+        downsample * h)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    boxes = jnp.stack([(bx - bw / 2) * imw, (by - bh / 2) * imh,
+                       (bx + bw / 2) * imw, (by + bh / 2) * imh],
+                      axis=2)  # [B, A, 4, H, W]
+    mask = (conf > conf_thresh).astype(x.dtype)
+    boxes = boxes * mask[:, :, None]
+    probs = probs * mask[:, :, None]
+    boxes = boxes.transpose(0, 1, 3, 4, 2).reshape(b, na * h * w, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(
+        b, na * h * w, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+def _yolov3_loss_grad_maker(op, no_grad_set=frozenset()):
+    from ..core.program import Operator, grad_var_name
+
+    inputs = {k: list(v) for k, v in op.inputs.items()}
+    inputs["Loss@GRAD"] = [grad_var_name(op.output("Loss")[0])]
+    return [Operator(op.block, "yolov3_loss_grad", inputs,
+                     {"X@GRAD": [grad_var_name(op.input("X")[0])]},
+                     dict(op.attrs))]
+
+
+def _yolov3_loss_impl(x, gt_box, gt_label, anchors, anchor_mask,
+                      class_num, ignore_thresh, downsample):
+    """YOLOv3 loss (reference yolov3_loss_op.h): coord MSE/BCE +
+    objectness BCE with ignore region + class BCE."""
+    b, _, h, w = x.shape
+    na = len(anchor_mask)
+    all_an = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = all_an[jnp.asarray(anchor_mask, jnp.int32)]
+    xr = x.reshape(b, na, 5 + class_num, h, w)
+    px, py = xr[:, :, 0], xr[:, :, 1]
+    pw, ph = xr[:, :, 2], xr[:, :, 3]
+    pobj = xr[:, :, 4]
+    pcls = xr[:, :, 5:]
+
+    in_w = downsample * w
+    in_h = downsample * h
+    g = gt_box.shape[1]
+    # gt in [0,1] center-size (reference format)
+    gx = gt_box[..., 0] * w
+    gy = gt_box[..., 1] * h
+    gw = gt_box[..., 2] * in_w
+    gh = gt_box[..., 3] * in_h
+    gi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+    valid_gt = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)
+    # best anchor per gt by wh IoU against ALL anchors (reference picks
+    # over the full anchor set, then checks membership in anchor_mask)
+    inter = jnp.minimum(gw[..., None], all_an[None, None, :, 0]) * \
+        jnp.minimum(gh[..., None], all_an[None, None, :, 1])
+    union = gw[..., None] * gh[..., None] + \
+        all_an[None, None, :, 0] * all_an[None, None, :, 1] - inter
+    an_iou = inter / jnp.maximum(union, 1e-10)
+    best_an = jnp.argmax(an_iou, axis=-1)  # [B, G] in all-anchor ids
+    mask_arr = jnp.asarray(anchor_mask, jnp.int32)
+    in_mask = (best_an[..., None] == mask_arr[None, None, :])
+    local_an = jnp.argmax(in_mask, axis=-1)  # [B, G] position in mask
+    use_gt = valid_gt & in_mask.any(axis=-1)
+
+    sig = jax.nn.sigmoid
+
+    def bce(logit, label):
+        return jnp.maximum(logit, 0) - logit * label + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    # scatter per-gt targets onto the grid
+    obj_target = jnp.zeros((b, na, h, w))
+    loss_acc = jnp.zeros((b,))
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], (b, g))
+    scale = 2.0 - gt_box[..., 2] * gt_box[..., 3]
+    tx = gx - gi
+    ty = gy - gj
+    tw = jnp.log(jnp.maximum(
+        gw / jnp.maximum(an[local_an][..., 0], 1e-10), 1e-10))
+    th = jnp.log(jnp.maximum(
+        gh / jnp.maximum(an[local_an][..., 1], 1e-10), 1e-10))
+    sel = (bidx, local_an, gj, gi)
+    wgt = jnp.where(use_gt, scale, 0.0)
+    loss_xy = bce(px[sel], tx) * wgt + bce(py[sel], ty) * wgt
+    loss_wh = (jnp.square(pw[sel] - tw) + jnp.square(ph[sel] - th)) * \
+        wgt * 0.5
+    cls_onehot = jax.nn.one_hot(gt_label, class_num)
+    loss_cls = jnp.sum(bce(pcls.transpose(0, 1, 3, 4, 2)[sel],
+                           cls_onehot), -1) * jnp.where(use_gt, 1.0, 0.0)
+    obj_target = obj_target.at[sel].max(
+        jnp.where(use_gt, 1.0, 0.0))
+    # objectness: positives BCE(1); negatives BCE(0) unless best-gt IoU
+    # above ignore_thresh
+    pred_boxes = jnp.stack([
+        (sig(px) + jnp.arange(w)[None, None, None, :]) / w,
+        (sig(py) + jnp.arange(h)[None, None, :, None]) / h,
+        jnp.exp(pw) * an[None, :, 0, None, None] / in_w,
+        jnp.exp(ph) * an[None, :, 1, None, None] / in_h], axis=-1)
+    pb = pred_boxes.reshape(b, -1, 4)
+    pb_xyxy = jnp.concatenate([pb[..., :2] - pb[..., 2:] / 2,
+                               pb[..., :2] + pb[..., 2:] / 2], -1)
+    gt_xyxy = jnp.concatenate([gt_box[..., :2] - gt_box[..., 2:] / 2,
+                               gt_box[..., :2] + gt_box[..., 2:] / 2],
+                              -1)
+
+    def best_iou(pbi, gbi, vgi):
+        mat = _iou_matrix(pbi, gbi)
+        return jnp.max(jnp.where(vgi[None, :], mat, 0.0), axis=1)
+
+    biou = jax.vmap(best_iou)(pb_xyxy, gt_xyxy, valid_gt)
+    ignore = (biou > ignore_thresh).reshape(b, na, h, w)
+    noobj_w = jnp.where((obj_target < 0.5) & ~ignore, 1.0, 0.0)
+    loss_obj = bce(pobj, jnp.ones_like(pobj)) * obj_target + \
+        bce(pobj, jnp.zeros_like(pobj)) * noobj_w
+    total = (jnp.sum(loss_xy, 1) + jnp.sum(loss_wh, 1)
+             + jnp.sum(loss_cls, 1)
+             + jnp.sum(loss_obj, (1, 2, 3)))
+    return total + loss_acc
+
+
+@register_op("yolov3_loss", grad_maker=_yolov3_loss_grad_maker,
+             stop_gradient_slots=("GTBox", "GTLabel"))
+def yolov3_loss(ctx):
+    loss = _yolov3_loss_impl(
+        ctx.input("X"), ctx.input("GTBox"), ctx.input("GTLabel"),
+        [int(a) for a in ctx.attr("anchors")],
+        [int(a) for a in ctx.attr("anchor_mask")],
+        ctx.attr("class_num"), ctx.attr("ignore_thresh", 0.7),
+        ctx.attr("downsample_ratio", 32))
+    return {"Loss": loss}
+
+
+@register_op("yolov3_loss_grad", differentiable=False)
+def yolov3_loss_grad(ctx):
+    dl = ctx.input("Loss@GRAD")
+    args = (ctx.input("GTBox"), ctx.input("GTLabel"),
+            [int(a) for a in ctx.attr("anchors")],
+            [int(a) for a in ctx.attr("anchor_mask")],
+            ctx.attr("class_num"), ctx.attr("ignore_thresh", 0.7),
+            ctx.attr("downsample_ratio", 32))
+    grad = jax.grad(
+        lambda xx: jnp.sum(_yolov3_loss_impl(xx, *args) * dl))(
+            ctx.input("X"))
+    return {"X@GRAD": grad}
+
+
+@register_op("polygon_box_transform", differentiable=False)
+def polygon_box_transform(ctx):
+    """reference detection/polygon_box_transform_op.cc: input [B, 2K,
+    H, W] offsets -> absolute coords: out = 4*(col,row) - in for
+    activated cells (reference semantics: out(x)= id*4 - in)."""
+    x = ctx.input("Input")
+    b, c, h, w = x.shape
+    col = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype)[None, :],
+                           (h, w))
+    row = jnp.broadcast_to(jnp.arange(h, dtype=x.dtype)[:, None],
+                           (h, w))
+    idx = jnp.stack([col, row] * (c // 2), 0)  # [C, H, W]
+    return {"Output": idx[None] * 4.0 - x}
+
+
+@register_op("detection_map", differentiable=False)
+def detection_map(ctx):
+    """reference detection_map_op.cc: mAP over padded NMS detections
+    (label -1 rows = padding) vs padded gt (label -1 = padding). Host
+    computation via io_callback — metrics are not a device hot path."""
+    det = ctx.input("DetectRes")  # [B, D, 6]
+    label = ctx.input("Label")  # [B, G, 5] (label, x1, y1, x2, y2)
+    overlap = ctx.attr("overlap_threshold", 0.5)
+    ap_type = ctx.attr("ap_type", "integral")
+
+    def _map(det_np, lab_np):
+        det_np = np.asarray(det_np)
+        lab_np = np.asarray(lab_np)
+        classes = set(int(l) for b in lab_np
+                      for l in b[:, 0] if l >= 0)
+        aps = []
+        for cls in classes:
+            scores, tps = [], []
+            npos = 0
+            for bi in range(lab_np.shape[0]):
+                gt = lab_np[bi][lab_np[bi][:, 0] == cls][:, 1:]
+                npos += len(gt)
+                dt = det_np[bi][det_np[bi][:, 0] == cls]
+                dt = dt[np.argsort(-dt[:, 1])]
+                used = np.zeros(len(gt), bool)
+                for row in dt:
+                    scores.append(row[1])
+                    box = row[2:6]
+                    best, bi2 = 0.0, -1
+                    for gi, g in enumerate(gt):
+                        ix1 = max(box[0], g[0])
+                        iy1 = max(box[1], g[1])
+                        ix2 = min(box[2], g[2])
+                        iy2 = min(box[3], g[3])
+                        iw = max(ix2 - ix1, 0)
+                        ih = max(iy2 - iy1, 0)
+                        inter = iw * ih
+                        ua = ((box[2] - box[0]) * (box[3] - box[1])
+                              + (g[2] - g[0]) * (g[3] - g[1]) - inter)
+                        iou = inter / ua if ua > 0 else 0
+                        if iou > best:
+                            best, bi2 = iou, gi
+                    tp = best >= overlap and bi2 >= 0 and not used[bi2]
+                    if tp:
+                        used[bi2] = True
+                    tps.append(1.0 if tp else 0.0)
+            if npos == 0:
+                continue
+            order = np.argsort(-np.asarray(scores)) if scores else []
+            tps_s = np.asarray(tps)[order] if len(tps) else \
+                np.zeros(0)
+            ctp = np.cumsum(tps_s)
+            prec = ctp / (np.arange(len(ctp)) + 1) if len(ctp) else \
+                np.zeros(0)
+            rec = ctp / npos if len(ctp) else np.zeros(0)
+            if ap_type == "11point":
+                ap = float(np.mean([
+                    max([p for p, r in zip(prec, rec) if r >= t],
+                        default=0.0) for t in np.linspace(0, 1, 11)]))
+            else:
+                ap = 0.0
+                prev_r = 0.0
+                for p, r in zip(prec, rec):
+                    ap += p * (r - prev_r)
+                    prev_r = r
+            aps.append(ap)
+        return np.asarray(np.mean(aps) if aps else 0.0, np.float32)
+
+    from jax.experimental import io_callback
+
+    out = io_callback(_map, jax.ShapeDtypeStruct((), jnp.float32),
+                      det, label, ordered=True)
+    return {"MAP": out, "AccumPosCount": jnp.zeros((1,), jnp.int32),
+            "AccumTruePos": jnp.zeros((1, 2)),
+            "AccumFalsePos": jnp.zeros((1, 2))}
+
+
+@register_op("ssd_loss", stop_gradient_slots=("GTBox", "GTLabel",
+                                              "PriorBox", "PriorBoxVar"))
+def ssd_loss(ctx):
+    """Fused SSD multibox loss (reference layers/detection.py ssd_loss
+    composes ~10 ops: iou_similarity -> bipartite_match ->
+    target_assign -> mine_hard_examples -> smooth_l1 + softmax CE; here
+    it is ONE fused XLA kernel — matching, hard negative mining and
+    both losses in a single compiled region, grad via auto-vjp).
+
+    Inputs: Location [B, M, 4], Confidence [B, M, C],
+    GTBox [B, G, 4] (xyxy, padded rows all-zero), GTLabel [B, G, 1],
+    PriorBox [M, 4], PriorBoxVar [M, 4].
+    Output: Loss [B, 1]."""
+    loc = ctx.input("Location")
+    conf = ctx.input("Confidence")
+    gt_box = ctx.input("GTBox")
+    gt_label = ctx.input("GTLabel")
+    prior = ctx.input("PriorBox")
+    pvar = ctx.input("PriorBoxVar")
+    if pvar is None:
+        pvar = jnp.broadcast_to(
+            jnp.asarray([0.1, 0.1, 0.2, 0.2], loc.dtype), prior.shape)
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    neg_pos_ratio = ctx.attr("neg_pos_ratio", 3.0)
+    overlap_threshold = ctx.attr("overlap_threshold", 0.5)
+    neg_overlap = ctx.attr("neg_overlap", 0.5)
+    conf_loss_weight = ctx.attr("conf_loss_weight", 1.0)
+    loc_loss_weight = ctx.attr("loc_loss_weight", 1.0)
+    background_label = ctx.attr("background_label", 0)
+    match_type = ctx.attr("match_type", "per_prediction")
+    mining_type = ctx.attr("mining_type", "max_negative")
+    normalize = ctx.attr("normalize", True)
+    sample_size = ctx.attr("sample_size", 0)
+    b, m, _ = loc.shape
+
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + 0.5 * pw
+    pcy = prior[:, 1] + 0.5 * ph
+
+    def one(loc_i, conf_i, gtb, gtl):
+        valid_gt = (gtb[:, 2] - gtb[:, 0]) * (gtb[:, 3] - gtb[:, 1]) > 0
+        sim = _iou_matrix(gtb, prior)  # [G, M]
+        sim = jnp.where(valid_gt[:, None], sim, 0.0)
+        g = sim.shape[0]
+        # bipartite base match: each gt greedily claims its best prior
+        # (reference bipartite_match_op); per_prediction additionally
+        # matches priors whose best-gt IoU exceeds overlap_threshold
+        def bip_body(_, carry):
+            matched_b, sm = carry
+            flat = jnp.argmax(sm)
+            r, c = flat // m, flat % m
+            ok = sm[r, c] > 0
+            matched_b = jnp.where(ok, matched_b.at[c].set(True),
+                                  matched_b)
+            sm = jnp.where(ok, sm.at[r, :].set(BIG_NEG)
+                           .at[:, c].set(BIG_NEG), sm)
+            return matched_b, sm
+
+        bip_matched, _ = jax.lax.fori_loop(
+            0, min(g, m), bip_body,
+            (jnp.zeros((m,), bool), sim))
+        best_gt = jnp.argmax(sim, axis=0)  # per prior
+        best_sim = jnp.max(sim, axis=0)
+        if match_type == "per_prediction":
+            matched = bip_matched | (best_sim > overlap_threshold)
+        else:
+            matched = bip_matched
+        tgt_box = gtb[best_gt]
+        tgt_label = jnp.where(matched, gtl[best_gt].astype(jnp.int32),
+                              background_label)
+        # encode matched boxes against priors (center-size + variance)
+        tw = tgt_box[:, 2] - tgt_box[:, 0]
+        th = tgt_box[:, 3] - tgt_box[:, 1]
+        tcx = tgt_box[:, 0] + 0.5 * tw
+        tcy = tgt_box[:, 1] + 0.5 * th
+        enc = jnp.stack([
+            (tcx - pcx) / jnp.maximum(pw, 1e-10) / pvar[:, 0],
+            (tcy - pcy) / jnp.maximum(ph, 1e-10) / pvar[:, 1],
+            jnp.log(jnp.maximum(tw / jnp.maximum(pw, 1e-10), 1e-10))
+            / pvar[:, 2],
+            jnp.log(jnp.maximum(th / jnp.maximum(ph, 1e-10), 1e-10))
+            / pvar[:, 3]], axis=-1)
+        # smooth-l1 loc loss on positives
+        d = loc_i - enc
+        ad = jnp.abs(d)
+        sl1 = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(-1)
+        n_pos = jnp.maximum(matched.sum(), 1)
+        loc_loss = jnp.sum(jnp.where(matched, sl1, 0.0))
+        # softmax CE conf loss, hard-negative mined at neg_pos_ratio
+        logp = jax.nn.log_softmax(conf_i, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_label[:, None],
+                                  axis=-1)[:, 0]
+        # negatives: unmatched priors whose best overlap stays below
+        # neg_overlap (reference mine_hard_examples semantics)
+        neg_cand = (~matched) & (best_sim < neg_overlap)
+        neg_ce = jnp.where(neg_cand, ce, BIG_NEG)
+        n_neg = jnp.minimum(
+            (neg_pos_ratio * n_pos).astype(jnp.int32), m)
+        if mining_type == "hard_example" and sample_size:
+            n_neg = jnp.minimum(n_neg, sample_size)
+        sorted_neg = jnp.sort(neg_ce)[::-1]
+        thresh = sorted_neg[jnp.clip(n_neg - 1, 0, m - 1)]
+        neg_sel = neg_cand & (ce >= thresh) & (n_neg > 0)
+        conf_loss = jnp.sum(jnp.where(matched | neg_sel, ce, 0.0))
+        total = (conf_loss_weight * conf_loss
+                 + loc_loss_weight * loc_loss)
+        return total / n_pos if normalize else total
+
+    return {"Loss": jax.vmap(one)(loc, conf, gt_box, gt_label)[:, None]}
+
+
+@register_op("rpn_target_assign", differentiable=False, needs_rng=True)
+def rpn_target_assign(ctx):
+    """reference detection/rpn_target_assign_op.cc: label anchors as
+    fg (IoU > positive_overlap or best-per-gt), bg (IoU <
+    negative_overlap), sample to rpn_batch_size_per_im with fg
+    fraction. Fixed-shape outputs: per-anchor labels [-1 ignore, 0 bg,
+    1 fg] and encoded bbox targets (padded selection stays static)."""
+    anchor = ctx.input("Anchor")  # [M, 4]
+    gt_boxes = ctx.input("GtBoxes")  # [B, G, 4]
+    pos_overlap = ctx.attr("rpn_positive_overlap", 0.7)
+    neg_overlap = ctx.attr("rpn_negative_overlap", 0.3)
+    batch_per_im = ctx.attr("rpn_batch_size_per_im", 256)
+    fg_frac = ctx.attr("rpn_fg_fraction", 0.5)
+    use_random = ctx.attr("use_random", True)
+    key = ctx.rng()
+    m = anchor.shape[0]
+
+    def one(gtb, k):
+        valid = (gtb[:, 2] - gtb[:, 0]) * (gtb[:, 3] - gtb[:, 1]) > 0
+        iou = _iou_matrix(anchor, gtb)
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        best_per_anchor = jnp.max(iou, axis=1)
+        gt_per_anchor = jnp.argmax(iou, axis=1)
+        # anchors that are argmax for some gt are fg too
+        best_per_gt = jnp.max(iou, axis=0)
+        is_best = jnp.any(
+            (iou == best_per_gt[None, :]) & valid[None, :] &
+            (best_per_gt[None, :] > 0), axis=1)
+        fg = (best_per_anchor >= pos_overlap) | is_best
+        bg = (best_per_anchor < neg_overlap) & ~fg
+        # subsample: random scores (or deterministic IoU ranking when
+        # use_random=False, for reproducible tests), keep top n_fg/n_bg
+        n_fg = int(batch_per_im * fg_frac)
+        r1, r2 = jax.random.split(k)
+        if use_random:
+            fg_scores = jax.random.uniform(r1, (m,))
+            bg_scores = jax.random.uniform(r2, (m,))
+        else:
+            fg_scores = best_per_anchor
+            bg_scores = -best_per_anchor
+        fg_rank = jnp.where(fg, fg_scores, BIG_NEG)
+        fg_keep = fg & (fg_rank >=
+                        jnp.sort(fg_rank)[::-1][
+                            jnp.minimum(n_fg, m) - 1])
+        n_bg = batch_per_im - jnp.minimum(fg_keep.sum(), n_fg)
+        bg_rank = jnp.where(bg, bg_scores, BIG_NEG)
+        bg_thresh = jnp.sort(bg_rank)[::-1][
+            jnp.clip(n_bg - 1, 0, m - 1)]
+        bg_keep = bg & (bg_rank >= bg_thresh) & (n_bg > 0)
+        label = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+        tgt = gtb[gt_per_anchor]
+        # encode center-size targets
+        pw = anchor[:, 2] - anchor[:, 0]
+        ph = anchor[:, 3] - anchor[:, 1]
+        pcx = anchor[:, 0] + 0.5 * pw
+        pcy = anchor[:, 1] + 0.5 * ph
+        tw = tgt[:, 2] - tgt[:, 0]
+        th = tgt[:, 3] - tgt[:, 1]
+        enc = jnp.stack([
+            (tgt[:, 0] + 0.5 * tw - pcx) / jnp.maximum(pw, 1e-10),
+            (tgt[:, 1] + 0.5 * th - pcy) / jnp.maximum(ph, 1e-10),
+            jnp.log(jnp.maximum(tw / jnp.maximum(pw, 1e-10), 1e-10)),
+            jnp.log(jnp.maximum(th / jnp.maximum(ph, 1e-10), 1e-10))],
+            -1)
+        return label.astype(jnp.int32), enc
+
+    keys = jax.random.split(key, gt_boxes.shape[0])
+    labels, targets = jax.vmap(one)(gt_boxes, keys)
+    return {"LocationIndex": labels, "ScoreIndex": labels,
+            "TargetLabel": labels, "TargetBBox": targets,
+            "BBoxInsideWeight": (labels == 1).astype(anchor.dtype)
+            [..., None]}
+
+
+@register_op("generate_proposals", differentiable=False)
+def generate_proposals(ctx):
+    """reference detection/generate_proposals_op.cc: decode RPN deltas
+    at anchors, clip to image, NMS -> fixed post_nms_topN padded
+    proposals per image."""
+    scores = ctx.input("Scores")  # [B, A, H, W]
+    deltas = ctx.input("BboxDeltas")  # [B, A*4, H, W]
+    im_info = ctx.input("ImInfo")  # [B, 3]
+    anchors = ctx.input("Anchors")  # [H, W, A, 4]
+    pre_n = ctx.attr("pre_nms_topN", 6000)
+    post_n = ctx.attr("post_nms_topN", 1000)
+    nms_thresh = ctx.attr("nms_thresh", 0.7)
+    min_size = ctx.attr("min_size", 0.1)
+    b = scores.shape[0]
+    a = scores.shape[1]
+    h, w = scores.shape[2], scores.shape[3]
+    anc = anchors.reshape(-1, 4)  # [H*W*A, 4] (H, W, A order)
+
+    def one(sc, dl, im):
+        sc = sc.transpose(1, 2, 0).reshape(-1)  # H, W, A
+        dl = dl.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        pw = anc[:, 2] - anc[:, 0] + 1
+        ph = anc[:, 3] - anc[:, 1] + 1
+        pcx = anc[:, 0] + 0.5 * pw
+        pcy = anc[:, 1] + 0.5 * ph
+        cx = pcx + dl[:, 0] * pw
+        cy = pcy + dl[:, 1] * ph
+        bw = jnp.exp(jnp.minimum(dl[:, 2], 10.0)) * pw
+        bh = jnp.exp(jnp.minimum(dl[:, 3], 10.0)) * ph
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - 1, cy + bh / 2 - 1], -1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, im[1] - 1),
+            jnp.clip(boxes[:, 1], 0, im[0] - 1),
+            jnp.clip(boxes[:, 2], 0, im[1] - 1),
+            jnp.clip(boxes[:, 3], 0, im[0] - 1)], -1)
+        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size) &
+              (boxes[:, 3] - boxes[:, 1] >= min_size))
+        sc = jnp.where(ok, sc, BIG_NEG)
+        k = min(pre_n, sc.shape[0])
+        top_sc, top_i = jax.lax.top_k(sc, k)
+        idx = _nms_indices(boxes[top_i], top_sc, nms_thresh,
+                           BIG_NEG / 2, post_n, normalized=False)
+        sel = jnp.maximum(idx, 0)
+        rois = jnp.where((idx >= 0)[:, None], boxes[top_i][sel], 0.0)
+        roi_scores = jnp.where(idx >= 0, top_sc[sel], 0.0)
+        return rois, roi_scores
+
+    rois, rscores = jax.vmap(one)(scores, deltas, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": rscores[..., None]}
+
+
+@register_op("generate_proposal_labels", differentiable=False,
+             needs_rng=True)
+def generate_proposal_labels(ctx):
+    """reference detection/generate_proposal_labels_op.cc: match rois
+    to gt by IoU, label fg (iou >= fg_thresh, gt class) / bg
+    (bg_thresh_lo <= iou < bg_thresh_hi, label 0) / ignore (-1),
+    subsample to batch_size_per_im at fg_fraction, and emit encoded
+    bbox regression targets. Fixed shapes: labels/targets per roi,
+    unsampled rois labeled -1."""
+    rois = ctx.input("RpnRois")  # [B, N, 4]
+    gt_classes = ctx.input("GtClasses")  # [B, G]
+    gt_boxes = ctx.input("GtBoxes")  # [B, G, 4]
+    fg_thresh = ctx.attr("fg_thresh", 0.5)
+    bg_hi = ctx.attr("bg_thresh_hi", 0.5)
+    bg_lo = ctx.attr("bg_thresh_lo", 0.0)
+    batch_per_im = ctx.attr("batch_size_per_im", 256)
+    fg_frac = ctx.attr("fg_fraction", 0.25)
+    weights = jnp.asarray(
+        ctx.attr("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2]), jnp.float32)
+    use_random = ctx.attr("use_random", True)
+    key = ctx.rng()
+    n = rois.shape[1]
+
+    def one(r, gc, gb, k):
+        valid = (gb[:, 2] - gb[:, 0]) * (gb[:, 3] - gb[:, 1]) > 0
+        iou = _iou_matrix(r, gb)  # [N, G]
+        iou = jnp.where(valid[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1)
+        gt_i = jnp.argmax(iou, axis=1)
+        fg = best >= fg_thresh
+        bg = (best >= bg_lo) & (best < bg_hi)
+        n_fg = int(batch_per_im * fg_frac)
+        if use_random:
+            r1, r2 = jax.random.split(k)
+            fg_rank = jax.random.uniform(r1, (n,))
+            bg_rank = jax.random.uniform(r2, (n,))
+        else:
+            # deterministic: prefer higher IoU fg, lower IoU bg
+            fg_rank = best
+            bg_rank = -best
+        fg_score = jnp.where(fg, fg_rank, BIG_NEG)
+        fg_keep = fg & (fg_score >= jnp.sort(fg_score)[::-1][
+            jnp.clip(n_fg - 1, 0, n - 1)])
+        n_bg = batch_per_im - jnp.minimum(fg_keep.sum(), n_fg)
+        bg_score = jnp.where(bg, bg_rank, BIG_NEG)
+        bg_keep = bg & (bg_score >= jnp.sort(bg_score)[::-1][
+            jnp.clip(n_bg - 1, 0, n - 1)]) & (n_bg > 0)
+        label = jnp.where(fg_keep, gc[gt_i].astype(jnp.int32),
+                          jnp.where(bg_keep, 0, -1))
+        tgt = gb[gt_i]
+        pw = jnp.maximum(r[:, 2] - r[:, 0], 1e-10)
+        ph = jnp.maximum(r[:, 3] - r[:, 1], 1e-10)
+        tw = jnp.maximum(tgt[:, 2] - tgt[:, 0], 1e-10)
+        th = jnp.maximum(tgt[:, 3] - tgt[:, 1], 1e-10)
+        enc = jnp.stack([
+            ((tgt[:, 0] + tw / 2) - (r[:, 0] + pw / 2)) / pw
+            / weights[0],
+            ((tgt[:, 1] + th / 2) - (r[:, 1] + ph / 2)) / ph
+            / weights[1],
+            jnp.log(tw / pw) / weights[2],
+            jnp.log(th / ph) / weights[3]], -1)
+        inside = (label > 0).astype(r.dtype)[:, None] * \
+            jnp.ones((1, 4), r.dtype)
+        return label, jnp.where((label > 0)[:, None], enc, 0.0), inside
+
+    keys = jax.random.split(key, rois.shape[0])
+    labels, targets, inside = jax.vmap(one)(rois, gt_classes, gt_boxes,
+                                            keys)
+    return {"Rois": rois, "LabelsInt32": labels,
+            "BboxTargets": targets, "BboxInsideWeights": inside,
+            "BboxOutsideWeights": inside}

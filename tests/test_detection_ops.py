@@ -1,0 +1,373 @@
+"""Detection op family tests vs numpy oracles.
+
+Parity model: reference tests/unittests/test_iou_similarity_op.py,
+test_box_coder_op.py, test_prior_box_op.py, test_multiclass_nms_op.py,
+test_bipartite_match_op.py, test_yolov3_loss_op.py (OpTest numeric
+comparisons); shapes here are fixed/padded per the TPU design note in
+ops/detection_ops.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.layers import detection as det
+
+
+def _run(fetches, feed=None):
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed or {}, fetch_list=fetches)
+
+
+def _np_iou(a, b):
+    out = np.zeros((len(a), len(b)), np.float32)
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            ix1, iy1 = max(x[0], y[0]), max(x[1], y[1])
+            ix2, iy2 = min(x[2], y[2]), min(x[3], y[3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            ua = ((x[2] - x[0]) * (x[3] - x[1])
+                  + (y[2] - y[0]) * (y[3] - y[1]) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0
+    return out
+
+
+class TestGeometry:
+    def test_iou_similarity_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        a = np.sort(rng.uniform(0, 1, (5, 4)).astype(np.float32),
+                    axis=-1)[:, [0, 2, 1, 3]]
+        b = np.sort(rng.uniform(0, 1, (7, 4)).astype(np.float32),
+                    axis=-1)[:, [0, 2, 1, 3]]
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[4], dtype="float32")
+        out = det.iou_similarity(x, y)
+        got, = _run([out], {"x": a, "y": b})
+        np.testing.assert_allclose(got, _np_iou(a, b), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(1)
+        prior = np.sort(rng.uniform(0, 1, (6, 4)).astype(np.float32),
+                        axis=-1)[:, [0, 2, 1, 3]]
+        pvar = np.full((6, 4), 0.1, np.float32)
+        gt = np.sort(rng.uniform(0, 1, (3, 4)).astype(np.float32),
+                     axis=-1)[:, [0, 2, 1, 3]]
+        pb = fluid.layers.data(name="pb", shape=[4], dtype="float32")
+        pv = fluid.layers.data(name="pv", shape=[4], dtype="float32")
+        tb = fluid.layers.data(name="tb", shape=[4], dtype="float32")
+        enc = det.box_coder(pb, pv, tb, code_type="encode_center_size")
+        got_enc, = _run([enc], {"pb": prior, "pv": pvar, "tb": gt})
+        assert got_enc.shape == (3, 6, 4)
+        # decode the encodings back -> original gt boxes
+        tb2 = fluid.layers.data(name="tb2", shape=[6, 4],
+                                dtype="float32")
+        dec = det.box_coder(pb, pv, tb2, code_type="decode_center_size")
+        got_dec, = _run([dec], {"pb": prior, "pv": pvar, "tb": gt,
+                                "tb2": got_enc})
+        for i in range(3):
+            for j in range(6):
+                np.testing.assert_allclose(got_dec[i, j], gt[i],
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_box_clip(self):
+        boxes = np.array([[[-5.0, -5, 50, 50], [10, 10, 400, 300]]],
+                         np.float32)
+        im = np.array([[100.0, 200, 1.0]], np.float32)
+        b = fluid.layers.data(name="b", shape=[2, 4], dtype="float32")
+        i = fluid.layers.data(name="i", shape=[3], dtype="float32")
+        out = det.box_clip(b, i)
+        got, = _run([out], {"b": boxes, "i": im})
+        assert got.min() >= 0
+        assert got[0, 1, 2] == 199.0 and got[0, 1, 3] == 99.0
+
+
+class TestPriors:
+    def test_prior_box_shapes_and_centers(self):
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        feat = fluid.layers.data(name="feat", shape=[8, 4, 4],
+                                 dtype="float32")
+        box, var = det.prior_box(feat, img, min_sizes=[4.0],
+                                 max_sizes=[8.0],
+                                 aspect_ratios=[2.0], flip=True)
+        bnp, vnp = _run(
+            [box, var],
+            {"img": np.zeros((1, 3, 32, 32), np.float32),
+             "feat": np.zeros((1, 8, 4, 4), np.float32)})
+        # priors: ar {1, 2, 0.5} + max_size sqrt box = 4 per cell
+        assert bnp.shape == (4, 4, 4, 4)
+        assert vnp.shape == (4, 4, 4, 4)
+        # first cell center at offset 0.5 * step(8px) = (4, 4) px
+        cx = (bnp[0, 0, 0, 0] + bnp[0, 0, 0, 2]) / 2 * 32
+        assert cx == pytest.approx(4.0, abs=1e-4)
+        assert (bnp >= -1).all() and (bnp <= 2).all()
+
+    def test_density_prior_box_count(self):
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        feat = fluid.layers.data(name="feat", shape=[8, 4, 4],
+                                 dtype="float32")
+        box, var = det.density_prior_box(
+            feat, img, densities=[2, 1], fixed_sizes=[4.0, 8.0],
+            fixed_ratios=[1.0])
+        bnp, = _run([box], {"img": np.zeros((1, 3, 32, 32), np.float32),
+                            "feat": np.zeros((1, 8, 4, 4), np.float32)})
+        # 2^2*1 + 1^2*1 = 5 priors per cell
+        assert bnp.shape == (4, 4, 5, 4)
+
+    def test_anchor_generator(self):
+        feat = fluid.layers.data(name="feat", shape=[8, 4, 4],
+                                 dtype="float32")
+        anchors, var = det.anchor_generator(
+            feat, anchor_sizes=[32.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        anp, = _run([anchors],
+                    {"feat": np.zeros((1, 8, 4, 4), np.float32)})
+        assert anp.shape == (4, 4, 1, 4)
+        w = anp[0, 0, 0, 2] - anp[0, 0, 0, 0]
+        assert w == pytest.approx(32.0, rel=1e-5)
+
+
+class TestMatching:
+    def test_bipartite_match_greedy(self):
+        dist = np.array([[[0.9, 0.2, 0.1],
+                          [0.8, 0.7, 0.3]]], np.float32)  # [1, 2, 3]
+        d = fluid.layers.data(name="d", shape=[2, 3], dtype="float32")
+        mi, md = det.bipartite_match(d)
+        got_i, got_d = _run([mi, md], {"d": dist})
+        # greedy: (row0,col0)=0.9 then (row1,col1)=0.7
+        assert got_i[0].tolist() == [0, 1, -1]
+        np.testing.assert_allclose(got_d[0], [0.9, 0.7, 0.0], rtol=1e-6)
+
+    def test_bipartite_match_per_prediction(self):
+        dist = np.array([[[0.9, 0.6, 0.1],
+                          [0.2, 0.7, 0.3]]], np.float32)
+        d = fluid.layers.data(name="d", shape=[2, 3], dtype="float32")
+        mi, md = det.bipartite_match(d, match_type="per_prediction",
+                                     dist_threshold=0.5)
+        got_i, _ = _run([mi], {"d": dist}), None
+        # col1: bipartite gives row1 (0.7); col0 row0; col2 best row is
+        # row1 (0.3 < 0.5 threshold) -> unmatched
+        assert got_i[0][0].tolist() == [0, 1, -1]
+
+    def test_target_assign(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)  # 3 gt rows
+        match = np.array([[2, -1, 0, 1]], np.int32)
+        xv = fluid.layers.data(name="xv", shape=[3, 4],
+                               dtype="float32")
+        xv.shape = (3, 4)  # static gt table
+        mv = fluid.layers.data(name="mv", shape=[4], dtype="int32")
+        out, w = det.target_assign(xv, mv, mismatch_value=0)
+        got, gw = _run([out, w], {"xv": x, "mv": match})
+        np.testing.assert_allclose(got[0, 0], x[2])
+        np.testing.assert_allclose(got[0, 1], np.zeros(4))
+        assert gw[0, :, 0].tolist() == [1.0, 0.0, 1.0, 1.0]
+
+
+class TestNMS:
+    def test_multiclass_nms_suppresses(self):
+        # two overlapping boxes + one distinct, single class (class 1;
+        # class 0 is background)
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        b = fluid.layers.data(name="b", shape=[3, 4], dtype="float32")
+        s = fluid.layers.data(name="s", shape=[2, 3], dtype="float32")
+        out = det.multiclass_nms(b, s, score_threshold=0.1,
+                                 nms_top_k=3, keep_top_k=3,
+                                 nms_threshold=0.5, normalized=False)
+        got, = _run([out], {"b": boxes, "s": scores})
+        assert got.shape == (1, 3, 6)
+        kept = got[0][got[0, :, 0] >= 0]
+        assert len(kept) == 2  # the 0.8 box suppressed by the 0.9 one
+        assert kept[0, 1] == pytest.approx(0.9)
+        assert kept[1, 1] == pytest.approx(0.7)
+        np.testing.assert_allclose(kept[0, 2:], [0, 0, 10, 10])
+
+    def test_background_class_excluded(self):
+        boxes = np.array([[[0, 0, 10, 10]]], np.float32)
+        scores = np.zeros((1, 2, 1), np.float32)
+        scores[0, 0, 0] = 0.9  # background only
+        b = fluid.layers.data(name="b", shape=[1, 4], dtype="float32")
+        s = fluid.layers.data(name="s", shape=[2, 1], dtype="float32")
+        out = det.multiclass_nms(b, s, score_threshold=0.1, nms_top_k=1,
+                                 keep_top_k=1)
+        got, = _run([out], {"b": boxes, "s": scores})
+        assert (got[0, :, 0] == -1).all()
+
+
+class TestYolo:
+    def test_yolo_box_decodes(self):
+        np.random.seed(0)
+        xx = np.random.randn(1, 2 * 7, 2, 2).astype(np.float32)
+        x = fluid.layers.data(name="x", shape=[14, 2, 2],
+                              dtype="float32")
+        sz = fluid.layers.data(name="sz", shape=[2], dtype="int32")
+        boxes, scores = det.yolo_box(x, sz, anchors=[10, 13, 16, 30],
+                                     class_num=2, conf_thresh=0.0,
+                                     downsample_ratio=32)
+        bnp, snp = _run([boxes, scores],
+                        {"x": xx, "sz": np.array([[64, 64]], np.int32)})
+        assert bnp.shape == (1, 8, 4)
+        assert snp.shape == (1, 8, 2)
+        assert (snp >= 0).all() and (snp <= 1).all()
+
+    def test_yolov3_loss_positive_and_differentiable(self):
+        np.random.seed(1)
+        xx = np.random.randn(2, 3 * 7, 4, 4).astype(np.float32) * 0.5
+        gtb = np.zeros((2, 2, 4), np.float32)
+        gtb[:, 0] = [0.5, 0.5, 0.3, 0.4]  # cx cy w h in [0,1]
+        gtl = np.zeros((2, 2), np.int32)
+        x = fluid.layers.data(name="x", shape=[21, 4, 4],
+                              dtype="float32")
+        gb = fluid.layers.data(name="gb", shape=[2, 4],
+                               dtype="float32")
+        gl = fluid.layers.data(name="gl", shape=[2], dtype="int32")
+        loss = det.yolov3_loss(x, gb, gl,
+                               anchors=[10, 13, 16, 30, 33, 23],
+                               anchor_mask=[0, 1, 2], class_num=2,
+                               ignore_thresh=0.5,
+                               downsample_ratio=32)
+        mean = fluid.layers.mean(loss)
+        grads = fluid.gradients(mean, [x])
+        lnp, gnp = _run([mean, grads[0]],
+                        {"x": xx, "gb": gtb, "gl": gtl})
+        assert float(lnp) > 0
+        assert np.abs(gnp).sum() > 0
+        assert gnp.shape == xx.shape
+
+
+class TestSSDLoss:
+    def test_ssd_loss_trains(self):
+        rng = np.random.RandomState(0)
+        m, c = 8, 3
+        prior = np.stack([
+            np.linspace(0, 0.8, m), np.linspace(0, 0.8, m),
+            np.linspace(0.2, 1.0, m), np.linspace(0.2, 1.0, m)],
+            -1).astype(np.float32)
+        prior[0] = [0.1, 0.1, 0.4, 0.4]  # coincide with the gt boxes
+        prior[1] = [0.5, 0.5, 0.9, 0.9]  # so matching is guaranteed
+        loc = fluid.layers.data(name="loc", shape=[m, 4],
+                                dtype="float32")
+        conf = fluid.layers.data(name="conf", shape=[m, c],
+                                 dtype="float32")
+        gtb = fluid.layers.data(name="gtb", shape=[2, 4],
+                                dtype="float32")
+        gtl = fluid.layers.data(name="gtl", shape=[2, 1],
+                                dtype="int64")
+        pb = fluid.layers.data(name="pb", shape=[4], dtype="float32")
+        loc.stop_gradient = False
+        conf.stop_gradient = False
+        loss = det.ssd_loss(loc, conf, gtb, gtl, pb)
+        mean = fluid.layers.mean(loss)
+        g = fluid.gradients(mean, [loc, conf])
+        feed = {
+            "loc": rng.randn(2, m, 4).astype(np.float32) * 0.1,
+            "conf": rng.randn(2, m, c).astype(np.float32),
+            "gtb": np.tile(np.array([[0.1, 0.1, 0.4, 0.4],
+                                     [0.5, 0.5, 0.9, 0.9]],
+                                    np.float32), (2, 1, 1)),
+            "gtl": np.ones((2, 2, 1), np.int64),
+            "pb": prior}
+        lnp, g0, g1 = _run([mean, g[0], g[1]], feed)
+        assert float(lnp) > 0
+        assert np.abs(g0).sum() > 0 and np.abs(g1).sum() > 0
+
+
+class TestRPN:
+    def test_generate_proposals_fixed_shape(self):
+        np.random.seed(0)
+        h = w = 4
+        a = 3
+        sc = fluid.layers.data(name="sc", shape=[a, h, w],
+                               dtype="float32")
+        dl = fluid.layers.data(name="dl", shape=[a * 4, h, w],
+                               dtype="float32")
+        im = fluid.layers.data(name="im", shape=[3], dtype="float32")
+        feat = fluid.layers.data(name="feat", shape=[8, h, w],
+                                 dtype="float32")
+        anchors, _ = det.anchor_generator(
+            feat, anchor_sizes=[16.0], aspect_ratios=[0.5, 1.0, 2.0],
+            stride=[16.0, 16.0])
+        rois, probs = det.generate_proposals(
+            sc, dl, im, anchors, pre_nms_top_n=20, post_nms_top_n=5,
+            nms_thresh=0.7, min_size=1.0)
+        rnp, pnp = _run(
+            [rois, probs],
+            {"sc": np.random.rand(1, a, h, w).astype(np.float32),
+             "dl": np.random.randn(1, a * 4, h, w).astype(
+                 np.float32) * 0.1,
+             "im": np.array([[64.0, 64, 1]], np.float32),
+             "feat": np.zeros((1, 8, h, w), np.float32)})
+        assert rnp.shape == (1, 5, 4)
+        assert (rnp[..., 2] >= rnp[..., 0] - 1e-5).all()
+
+    def test_rpn_target_assign_labels(self):
+        anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                            [100, 100, 110, 110]], np.float32)
+        gt = np.array([[[0, 0, 10, 10], [0, 0, 0, 0]]], np.float32)
+        an = fluid.layers.data(name="an", shape=[3, 4],
+                               dtype="float32")
+        an.shape = (3, 4)
+        g = fluid.layers.data(name="g", shape=[2, 4], dtype="float32")
+        labels, targets, iw = det.rpn_target_assign(
+            None, None, an, None, g, rpn_batch_size_per_im=4)
+        lnp, = _run([labels], {"an": anchors, "g": gt})
+        assert lnp[0, 0] == 1  # perfect-IoU anchor is fg
+        assert lnp.shape == (1, 3)
+
+
+class TestProposalLabels:
+    def test_generate_proposal_labels_batched(self):
+        rois = np.array([[[0, 0, 10, 10], [20, 20, 30, 30],
+                          [0, 0, 9, 9]]], np.float32)
+        gtc = np.array([[3, 5]], np.int32)
+        gtb = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]],
+                       np.float32)
+        r = fluid.layers.data(name="r", shape=[3, 4], dtype="float32")
+        c = fluid.layers.data(name="c", shape=[2], dtype="int32")
+        b = fluid.layers.data(name="b", shape=[2, 4], dtype="float32")
+        out = det.generate_proposal_labels(
+            r, c, None, b, None, batch_size_per_im=3, fg_thresh=0.5,
+            use_random=False)
+        rois_o, labels, targets, iw, ow = out
+        ln, tn, iwn = _run([labels, targets, iw],
+                           {"r": rois, "c": gtc, "b": gtb})
+        assert ln.shape == (1, 3)
+        assert ln[0, 0] == 3 and ln[0, 1] == 5  # fg with gt classes
+        # fg rois that exactly coincide with gt encode to ~zero targets
+        np.testing.assert_allclose(tn[0, 0], np.zeros(4), atol=1e-5)
+        assert iwn[0, 0].tolist() == [1, 1, 1, 1]
+
+    def test_rpn_use_random_false_deterministic(self):
+        anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                            [5, 5, 15, 15]], np.float32)
+        gt = np.array([[[0, 0, 10, 10], [0, 0, 0, 0]]], np.float32)
+        an = fluid.layers.data(name="an", shape=[3, 4],
+                               dtype="float32")
+        an.shape = (3, 4)
+        g = fluid.layers.data(name="g", shape=[2, 4], dtype="float32")
+        labels, _, _ = det.rpn_target_assign(
+            None, None, an, None, g, rpn_batch_size_per_im=4,
+            use_random=False)
+        a1, = _run([labels], {"an": anchors, "g": gt})
+        a2, = _run([labels], {"an": anchors, "g": gt})
+        np.testing.assert_array_equal(a1, a2)
+
+
+class TestDetectionMap:
+    def test_perfect_detection_map_is_one(self):
+        det_res = np.array([[[1, 0.9, 0, 0, 10, 10],
+                             [-1, 0, 0, 0, 0, 0]]], np.float32)
+        label = np.array([[[1, 0, 0, 10, 10]]], np.float32)
+        d = fluid.layers.data(name="d", shape=[2, 6], dtype="float32")
+        l = fluid.layers.data(name="l", shape=[1, 5], dtype="float32")
+        helper = fluid.layers.detection.LayerHelper("detection_map",
+                                                    input=d)
+        out = helper.create_variable_for_type_inference("float32", True)
+        helper.append_op("detection_map", {"DetectRes": d, "Label": l},
+                         {"MAP": out}, {"overlap_threshold": 0.5})
+        got, = _run([out], {"d": det_res, "l": label})
+        assert float(got) == pytest.approx(1.0)
