@@ -47,6 +47,7 @@ from . import inference
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
     memory_optimize, release_memory
+from . import contrib
 
 # fluid-compat: many scripts do `import paddle.fluid as fluid`; we expose
 # the same names so `import paddle_tpu as fluid` works.

@@ -1,0 +1,8 @@
+"""contrib package (parity: reference python/paddle/fluid/contrib/ —
+slim model-compression framework, quantize passes, memory usage
+estimation, op frequency statistics, extended optimizers)."""
+from . import slim
+from .memory_usage_calc import memory_usage
+from .op_frequence import op_freq_statistic
+
+__all__ = ["slim", "memory_usage", "op_freq_statistic"]

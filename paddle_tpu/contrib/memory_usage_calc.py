@@ -1,0 +1,24 @@
+"""Estimate a program's memory usage (reference
+contrib/memory_usage_calc.py memory_usage)."""
+from __future__ import annotations
+
+import numpy as np
+
+_DTYPE_BYTES = {"float32": 4, "float64": 8, "float16": 2,
+                "bfloat16": 2, "int32": 4, "int64": 8, "int8": 1,
+                "uint8": 1, "bool": 1}
+
+
+def memory_usage(program, batch_size=1):
+    """Returns (min_MB, max_MB) like the reference (a +-30% band around
+    the summed var sizes with the batch dim filled in)."""
+    total = 0
+    for var in program.list_vars():
+        if var.shape is None:
+            continue
+        shape = [batch_size if (d is None or d < 0) else d
+                 for d in var.shape]
+        dt = var.dtype.value if var.dtype else "float32"
+        total += int(np.prod(shape)) * _DTYPE_BYTES.get(dt, 4)
+    mb = total / (1024.0 * 1024.0)
+    return mb * 0.7, mb * 1.3

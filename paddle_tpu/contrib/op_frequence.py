@@ -1,0 +1,24 @@
+"""Op frequency statistics (reference contrib/op_frequence.py)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+def op_freq_statistic(program):
+    """Returns (uni_op_freq, adj_2_op_freq): single-op counts and
+    adjacent-pair counts, like the reference."""
+    uni = {}
+    adj = {}
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = f"{prev}->{op.type}"
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+    uni_sorted = OrderedDict(
+        sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj_sorted = OrderedDict(
+        sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni_sorted, adj_sorted

@@ -1,0 +1,14 @@
+"""slim: model compression (parity: reference contrib/slim/ — the
+quantization/pruning/distillation framework).
+
+The reference organizes slim around a Compressor driving graph passes;
+here the three capabilities are direct APIs over the Program/ir layer:
+  quantization.QuantizationTransformPass / QuantizationFreezePass
+  prune.Pruner (magnitude pruning of scope params)
+  distillation soft-label loss helpers
+"""
+from . import quantization
+from .distillation import soft_label_loss, fsp_matrix
+from .prune import Pruner
+
+__all__ = ["quantization", "Pruner", "soft_label_loss", "fsp_matrix"]

@@ -1,0 +1,33 @@
+"""Distillation losses (parity: reference contrib/slim/distillation/
+distillation_strategy.py losses: soft-label cross entropy and FSP
+matrix loss)."""
+from __future__ import annotations
+
+from ... import layers
+
+
+def soft_label_loss(student_logits, teacher_logits,
+                    student_temperature=1.0, teacher_temperature=1.0):
+    """KL-style soft-label distillation loss (a Program-building layer
+    composition, like the reference's DistillationStrategy losses)."""
+    s = layers.softmax(layers.scale(student_logits,
+                                    scale=1.0 / student_temperature))
+    t = layers.softmax(layers.scale(teacher_logits,
+                                    scale=1.0 / teacher_temperature))
+    t.stop_gradient = True
+    ce = layers.reduce_sum(
+        layers.elementwise_mul(
+            t, layers.scale(layers.log(s), scale=-1.0)), dim=-1)
+    return layers.mean(ce)
+
+
+def fsp_matrix(feat_a, feat_b):
+    """Flow-of-solution-procedure matrix (reference fsp op):
+    [B, Ca, H*W] x [B, H*W, Cb] -> [B, Ca, Cb] / (H*W)."""
+    a_shape = feat_a.shape  # [B, Ca, H, W]
+    hw = int(a_shape[2]) * int(a_shape[3])
+    a = layers.reshape(feat_a, shape=[-1, int(a_shape[1]), hw])
+    b_shape = feat_b.shape
+    b = layers.reshape(feat_b, shape=[-1, int(b_shape[1]), hw])
+    prod = layers.matmul(a, layers.transpose(b, perm=[0, 2, 1]))
+    return layers.scale(prod, scale=1.0 / hw)

@@ -1,0 +1,52 @@
+"""Magnitude pruning (parity: reference contrib/slim/prune/ —
+SensitivePruneStrategy/StructurePruner; here a direct Pruner API over
+scope params)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Pruner:
+    def __init__(self, mode: str = "ratio"):
+        assert mode in ("ratio", "threshold")
+        self.mode = mode
+
+    def prune(self, scope, param_names: List[str], ratio: float = 0.5,
+              threshold: Optional[float] = None,
+              structured_axis: Optional[int] = None) -> Dict[str, float]:
+        """Zero out small-magnitude weights. structured_axis prunes
+        whole rows/channels along that axis. Returns achieved sparsity
+        per param."""
+        out = {}
+        for name in param_names:
+            w = scope._get(name)
+            if w is None:
+                continue
+            w = np.array(np.asarray(w))
+            if structured_axis is None:
+                mag = np.abs(w)
+                if self.mode == "ratio":
+                    k = int(w.size * ratio)
+                    thr = np.partition(mag.reshape(-1), k)[k] if \
+                        0 < k < w.size else (0 if k <= 0 else np.inf)
+                else:
+                    thr = threshold
+                w[mag < thr] = 0.0
+            else:
+                axes = tuple(i for i in range(w.ndim)
+                             if i != structured_axis)
+                norms = np.sqrt(np.sum(w * w, axis=axes))
+                if self.mode == "ratio":
+                    k = int(len(norms) * ratio)
+                    doomed = np.argsort(norms)[:k]
+                else:
+                    doomed = np.nonzero(norms < threshold)[0]
+                idx = [slice(None)] * w.ndim
+                for j in doomed:
+                    idx[structured_axis] = j
+                    w[tuple(idx)] = 0.0
+            scope._set(name, w)
+            out[name] = float((w == 0).mean())
+        return out

@@ -1,0 +1,178 @@
+"""Quantization-aware training passes.
+
+Parity: reference contrib/slim/quantization/quantization_pass.py
+(QuantizationTransformPass inserts fake_quantize/dequantize pairs on
+the weights and activations of quantizable ops;
+QuantizationFreezePass bakes the learned scales into int8 weights for
+deployment).
+
+Works on the Program/ir.Graph layer: quantizable op types are mul /
+conv2d / fc (depthwise conv shares the conv2d kernel here).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...core.program import Program
+
+QUANTIZABLE_OP_TYPES = ("mul", "conv2d", "fc")
+_W_SLOTS = {"mul": "Y", "conv2d": "Filter", "fc": "W"}
+_X_SLOTS = {"mul": "X", "conv2d": "Input", "fc": "Input"}
+
+
+class QuantizationTransformPass:
+    """Insert fake-quant ops before quantizable ops (QAT rewrite)."""
+
+    def __init__(self, scope=None, weight_bits: int = 8,
+                 activation_bits: int = 8,
+                 activation_quantize_type: str = "abs_max",
+                 weight_quantize_type: str = "abs_max",
+                 window_size: int = 10000, moving_rate: float = 0.9,
+                 quantizable_op_type: Optional[List[str]] = None,
+                 startup_program=None):
+        allowed = ("abs_max", "range_abs_max",
+                   "moving_average_abs_max")
+        if activation_quantize_type not in allowed or \
+                weight_quantize_type not in allowed:
+            raise ValueError(f"quantize type must be one of {allowed}")
+        self._scope = scope
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_type = activation_quantize_type
+        self._w_type = weight_quantize_type
+        self._window = window_size
+        self._rate = moving_rate
+        self._ops = tuple(quantizable_op_type or QUANTIZABLE_OP_TYPES)
+        self._startup = startup_program
+
+    def _init_aux(self, block, name, value):
+        """Initialize a persistable aux var: directly in the scope when
+        one is given, else via a fill_constant in the startup program
+        (reference _init_var writes through the scope)."""
+        if self._scope is not None:
+            self._scope.var(name)
+            if self._scope._get(name) is None:
+                self._scope._set(name, np.full((1,), value, np.float32))
+            return
+        from ...core.program import default_startup_program
+
+        startup = self._startup or default_startup_program()
+        sblock = startup.global_block
+        if not any(name in op.output_arg_names for op in sblock.ops):
+            sblock.create_var(name=name, shape=[1], dtype="float32",
+                              persistable=True)
+            sblock.append_op("fill_constant", {}, {"Out": [name]},
+                             {"shape": [1], "dtype": "float32",
+                              "value": float(value)})
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block
+        quantized = set()
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            i += 1
+            if op.type not in self._ops:
+                continue
+            for slot, bits, qtype in (
+                    (_W_SLOTS[op.type], self._wbits, self._w_type),
+                    (_X_SLOTS[op.type], self._abits, self._act_type)):
+                names = op.input(slot)
+                if not names:
+                    continue
+                name = names[0]
+                qname = name + ".quantized"
+                if name in quantized or name.endswith(".quantized"):
+                    op.inputs[slot] = [name if name.endswith(
+                        ".quantized") else qname]
+                    continue
+                var = block._find_var_recursive(name)
+                if var is None or var.shape is None:
+                    continue
+                block.create_var(name=qname, shape=var.shape,
+                                 dtype=var.dtype)
+                scale_name = name + ".quant_scale"
+                block.create_var(name=scale_name, shape=[1],
+                                 dtype="float32", persistable=True)
+                attrs = {"bit_length": bits, "op_role": "forward"}
+                if qtype == "abs_max":
+                    idx = block.ops.index(op)
+                    block.insert_op(
+                        idx, "fake_quantize_abs_max",
+                        {"X": [name]},
+                        {"Out": [qname], "OutScale": [scale_name]},
+                        attrs)
+                    i += 1
+                elif qtype == "range_abs_max":
+                    block.create_var(name=scale_name, shape=[1],
+                                     dtype="float32", persistable=True)
+                    self._init_aux(block, scale_name, 1e-7)
+                    idx = block.ops.index(op)
+                    block.insert_op(
+                        idx, "fake_quantize_range_abs_max",
+                        {"X": [name], "InScale": [scale_name]},
+                        {"Out": [qname], "OutScale": [scale_name]},
+                        dict(attrs, window_size=self._window))
+                    i += 1
+                else:  # moving_average_abs_max
+                    state = name + ".quant_state"
+                    accum = name + ".quant_accum"
+                    for aux, v0 in ((scale_name, 1e-7), (state, 1.0),
+                                    (accum, 1e-7)):
+                        block.create_var(name=aux, shape=[1],
+                                         dtype="float32",
+                                         persistable=True)
+                        self._init_aux(block, aux, v0)
+                    idx = block.ops.index(op)
+                    block.insert_op(
+                        idx, "fake_quantize_moving_average_abs_max",
+                        {"X": [name], "InScale": [scale_name],
+                         "InState": [state], "InAccum": [accum]},
+                        {"Out": [qname], "OutScale": [scale_name],
+                         "OutState": [state], "OutAccum": [accum]},
+                        dict(attrs, moving_rate=self._rate)),
+                    i += 1
+                op.inputs[slot] = [qname]
+                quantized.add(name)
+        return program
+
+
+class QuantizationFreezePass:
+    """Bake weight quantization for deployment (reference
+    QuantizationFreezePass): replace each weight with its int-grid
+    snapped value and drop the weight fake-quant ops (activation
+    fake-quants stay, with is_test scales)."""
+
+    def __init__(self, scope, weight_bits: int = 8):
+        self._scope = scope
+        self._wbits = weight_bits
+
+    def apply(self, program: Program) -> Program:
+        block = program.global_block
+        bnt = float((1 << (self._wbits - 1)) - 1)
+        for op in list(block.ops):
+            if not op.type.startswith("fake_quantize"):
+                continue
+            name = op.input("X")[0]
+            var = block._find_var_recursive(name)
+            if var is None or not var.persistable:
+                # activation quant: freeze to test mode
+                op.attrs["is_test"] = True
+                continue
+            w = self._scope._get(name)
+            if w is None:
+                continue
+            w = np.asarray(w)
+            scale = np.max(np.abs(w)) or 1e-8
+            wq = np.round(np.clip(w / scale, -1, 1) * bnt) / bnt * scale
+            self._scope._set(name, wq.astype(w.dtype))
+            # rewire consumers to the raw (now snapped) weight and drop
+            out = op.output("Out")[0]
+            for consumer in block.ops:
+                for slot, names in consumer.inputs.items():
+                    consumer.inputs[slot] = [
+                        name if n == out else n for n in names]
+            block.ops.remove(op)
+        return program

@@ -1,0 +1,189 @@
+"""Quantization ops.
+
+Parity: reference paddle/fluid/operators/fake_quantize_op.cc
+(fake_quantize_abs_max, fake_quantize_range_abs_max,
+fake_quantize_moving_average_abs_max, fake_channel_wise_quantize_abs_max),
+fake_dequantize_op.cc, and the MKLDNN int8 quantize_op.cc/dequantize_op.cc
+/requantize_op.cc.
+
+TPU-first notes: fake-quant is simulated quantization — round to the
+int grid but stay in float (XLA fuses the round into the surrounding
+ops); the straight-through estimator (identity grad inside the clip
+range) is registered as an explicit grad op, mirroring the reference's
+FakeQuantGradFunctor. Real int8 quantize/dequantize produce int8
+arrays (useful for weight storage; TPU MXU serving uses bf16 — see
+inference.AnalysisConfig.enable_tpu_bf16).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.program import Operator, grad_var_name
+from ..core.registry import register_op
+
+
+def _ste_grad_maker(x_slot="X", out_slot="Out"):
+    """Straight-through estimator: dX = dOut inside the quant range
+    (zero where the forward clipped). The forward's OutScale is threaded
+    into the grad op so the mask uses the ACTUAL scale (EMA/window
+    scales can be below max|x|)."""
+
+    def maker(op, no_grad_set=frozenset()):
+        x_name = op.input(x_slot)[0]
+        if x_name in no_grad_set:
+            return []
+        inputs = {x_slot: [x_name],
+                  "OutScale": list(op.output("OutScale")),
+                  "Out@GRAD": [grad_var_name(op.output(out_slot)[0])]}
+        return [Operator(op.block, "fake_quant_ste_grad", inputs,
+                         {"X@GRAD": [grad_var_name(x_name)]},
+                         dict(op.attrs))]
+
+    return maker
+
+
+@register_op("fake_quant_ste_grad", differentiable=False)
+def fake_quant_ste_grad(ctx):
+    dy = ctx.input("Out@GRAD")
+    x = ctx.input("X")
+    scale = ctx.input("OutScale")
+    if scale is None:
+        scale = jnp.max(jnp.abs(x))
+    else:
+        scale = scale.reshape((-1,) + (1,) * (x.ndim - 1)) \
+            if scale.size > 1 else scale.reshape(())
+    mask = (jnp.abs(x) <= scale).astype(dy.dtype)
+    return {"X@GRAD": dy * mask}
+
+
+def _quantize(x, scale, bit_length):
+    bnt = float((1 << (bit_length - 1)) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.round(jnp.clip(x / s, -1.0, 1.0) * bnt) / bnt * s
+
+
+@register_op("fake_quantize_abs_max", grad_maker=_ste_grad_maker())
+def fake_quantize_abs_max(ctx):
+    """reference fake_quantize_op.cc FakeQuantizeAbsMaxOp."""
+    x = ctx.input("X")
+    bits = ctx.attr("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": _quantize(x, scale, bits),
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_abs_max",
+             grad_maker=_ste_grad_maker())
+def fake_channel_wise_quantize_abs_max(ctx):
+    """Per-output-channel scales (dim 0), reference
+    FakeChannelWiseQuantizeAbsMaxOp."""
+    x = ctx.input("X")
+    bits = ctx.attr("bit_length", 8)
+    axes = tuple(range(1, x.ndim))
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    shape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    return {"Out": _quantize(x, scale.reshape(shape), bits),
+            "OutScale": scale}
+
+
+@register_op("fake_quantize_range_abs_max", grad_maker=_ste_grad_maker())
+def fake_quantize_range_abs_max(ctx):
+    """reference FakeQuantizeRangeAbsMaxOp: scale = max over a sliding
+    window of per-step abs-max (training); frozen scale at inference."""
+    x = ctx.input("X")
+    in_scale = ctx.input("InScale")
+    bits = ctx.attr("bit_length", 8)
+    window = ctx.attr("window_size", 10000)
+    is_test = ctx.attr("is_test", False)
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale = in_scale.reshape(())
+        return {"Out": _quantize(x, scale, bits),
+                "OutScale": in_scale}
+    scale = jnp.maximum(cur, in_scale.reshape(()) *
+                        (1.0 - 1.0 / float(window)))
+    return {"Out": _quantize(x, scale, bits),
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             grad_maker=_ste_grad_maker())
+def fake_quantize_moving_average_abs_max(ctx):
+    """reference FakeQuantizeMovingAverageAbsMaxOp: EMA of abs-max."""
+    x = ctx.input("X")
+    in_scale = ctx.input("InScale")
+    in_state = ctx.input("InState")
+    in_accum = ctx.input("InAccum")
+    rate = ctx.attr("moving_rate", 0.9)
+    bits = ctx.attr("bit_length", 8)
+    is_test = ctx.attr("is_test", False)
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale = in_scale.reshape(())
+        return {"Out": _quantize(x, scale, bits), "OutScale": in_scale,
+                "OutState": in_state, "OutAccum": in_accum}
+    state = (in_state.reshape(()) * rate + 1.0 if in_state is not None
+             else jnp.asarray(1.0))
+    accum = (in_accum.reshape(()) * rate + cur if in_accum is not None
+             else cur)
+    scale = accum / state
+    out = {"Out": _quantize(x, scale, bits),
+           "OutScale": scale.reshape(1)}
+    if "OutState" in ctx.op.outputs:
+        out["OutState"] = state.reshape(1)
+        out["OutAccum"] = accum.reshape(1)
+    return out
+
+
+@register_op("fake_dequantize_max_abs", differentiable=False)
+def fake_dequantize_max_abs(ctx):
+    """reference fake_dequantize_op.cc: y = x * scale / max_range."""
+    x = ctx.input("X")
+    scale = ctx.input("Scale").reshape(())
+    max_range = ctx.attr("max_range", 127.0)
+    return {"Out": x.astype(jnp.float32) * scale / max_range}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             differentiable=False)
+def fake_channel_wise_dequantize_max_abs(ctx):
+    x = ctx.input("X")
+    scales = ctx.inputs("Scales")
+    quant_bits = ctx.attr("quant_bits", [8])
+    out = x.astype(jnp.float32)
+    s0 = scales[0]
+    bnt = float((1 << (int(quant_bits[0]) - 1)) - 1)
+    shape = (out.shape[0],) + (1,) * (out.ndim - 1)
+    out = out * s0.reshape(shape) / bnt
+    if len(scales) > 1 and scales[1] is not None:
+        bnt1 = float((1 << (int(quant_bits[1]) - 1)) - 1)
+        out = out * scales[1].reshape(()) / bnt1
+    return {"Out": out}
+
+
+@register_op("quantize", differentiable=False)
+def quantize(ctx):
+    """Real int8 quantize (reference mkldnn quantize_op.cc)."""
+    x = ctx.input("Input")
+    scale = ctx.attr("Scale", 1.0)
+    return {"Output": jnp.clip(jnp.round(x * scale), -128, 127)
+            .astype(jnp.int8)}
+
+
+@register_op("dequantize", differentiable=False)
+def dequantize(ctx):
+    x = ctx.input("Input")
+    scale = ctx.attr("Scale", 1.0)
+    return {"Output": x.astype(jnp.float32) / scale}
+
+
+@register_op("requantize", differentiable=False)
+def requantize(ctx):
+    x = ctx.input("Input")
+    s_in = ctx.attr("Scale_in", 1.0)
+    s_out = ctx.attr("Scale_out", 1.0)
+    return {"Output": jnp.clip(
+        jnp.round(x.astype(jnp.float32) * (s_out / s_in)), -128, 127)
+        .astype(jnp.int8)}
