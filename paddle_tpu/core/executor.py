@@ -193,8 +193,13 @@ class Executor:
     """fluid.Executor parity (reference python/paddle/fluid/executor.py:451).
     """
 
-    def __init__(self, place: Optional[TPUPlace] = None):
+    def __init__(self, place: Optional[TPUPlace] = None,
+                 donate: bool = True):
+        # donate=False for executors whose scope is shared across
+        # threads (AsyncExecutor Hogwild workers): a donated buffer is
+        # deleted after the step, which would break concurrent readers
         self.place = place or TPUPlace()
+        self.donate = donate
         self._cache: Dict = {}
 
     def close(self):
@@ -274,7 +279,8 @@ class Executor:
             block, feed_names, fetch_names)
         step = _build_step_fn(block, feed_names, mutated, const, state_out,
                               fetch_names)
-        jitted = jax.jit(step, donate_argnums=(0,))
+        jitted = jax.jit(step,
+                         donate_argnums=(0,) if self.donate else ())
         return _CompiledBlock(jitted, feed_names, mutated, const, state_out,
                               fetch_names)
 

@@ -112,7 +112,53 @@ def split_byref(ctx):
     return {"Out": outs}
 
 
-@register_op("prefetch", differentiable=False)
+def _prefetch_grad_maker(op, no_grad_set=frozenset()):
+    """Sparse backward for the distributed lookup table (reference
+    distribute_transpiler.py:1301 _split_table_grad_and_add_send_vars:
+    grads are split by row ownership and sent to the owning pserver;
+    here the grad op pushes (ids, rows) straight to the endpoints)."""
+    from ..core.program import Operator, grad_var_name
+
+    inputs = {"Ids": list(op.input("Ids")),
+              "Out@GRAD": [grad_var_name(op.output("Out")[0])]}
+    return [Operator(op.block, "prefetch_grad", inputs, {},
+                     dict(op.attrs))]
+
+
+@register_op("prefetch_grad", differentiable=False)
+def prefetch_grad(ctx):
+    ids = ctx.input("Ids")
+    dout = ctx.input("Out@GRAD")
+    epmap = ctx.attr("epmap")
+    names = ctx.attr("varnames")
+    emb_dim = ctx.attr("emb_dim")
+    lr_name = ctx.attr("lr_name", "")
+    padding_idx = ctx.attr("padding_idx", -1)
+    n_shards = len(epmap)
+    flat_ids = jnp.reshape(ids, (-1,)).astype(jnp.int32)
+    flat_g = jnp.reshape(dout, (-1, int(emb_dim)))
+
+    def _do(idv, gv):
+        idv = np.asarray(idv)
+        gv = np.asarray(gv)
+        if padding_idx >= 0:  # pad positions contribute no gradient
+            keep = idv != padding_idx
+            idv, gv = idv[keep], gv[keep]
+        for shard, (ep, name) in enumerate(zip(epmap, names)):
+            mask = (idv % n_shards) == shard
+            if not mask.any():
+                continue
+            _endpoint(ep).push_sparse_grad(
+                name, idv[mask] // n_shards, gv[mask], lr_name)
+        return np.int32(0)
+
+    io_callback(_do, jax.ShapeDtypeStruct((), jnp.int32), flat_ids,
+                flat_g, ordered=True)
+    return {}
+
+
+@register_op("prefetch", grad_maker=_prefetch_grad_maker,
+             stop_gradient_slots=("Ids",))
 def prefetch(ctx):
     """Distributed-lookup-table row fetch (reference prefetch_op.cc +
     parameter_prefetch.cc): gather rows of a row-sharded table from the
@@ -122,6 +168,7 @@ def prefetch(ctx):
     epmap = ctx.attr("epmap")
     names = ctx.attr("varnames")
     emb_dim = ctx.attr("emb_dim")
+    padding_idx = ctx.attr("padding_idx", -1)
     n_shards = len(epmap)
     flat = jnp.reshape(ids, (-1,)).astype(jnp.int32)
     spec = jax.ShapeDtypeStruct((int(flat.shape[0]), int(emb_dim)),
@@ -139,6 +186,9 @@ def prefetch(ctx):
         return out
 
     rows = io_callback(_do, spec, flat, ordered=True)
+    if padding_idx >= 0:  # pad ids embed to zeros (lookup_table parity)
+        rows = jnp.where((flat == padding_idx)[:, None],
+                         jnp.zeros_like(rows), rows)
     out_shape = tuple(ids.shape) + (int(emb_dim),)
     if ids.ndim and ids.shape[-1] == 1:
         out_shape = tuple(ids.shape[:-1]) + (int(emb_dim),)

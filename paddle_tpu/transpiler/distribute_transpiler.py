@@ -119,6 +119,13 @@ class DistributeTranspiler:
         eps = self.pserver_endpoints
         dispatcher: PSDispatcher = self.config.split_method(eps)
 
+        # 0. distributed lookup tables: rewrite lookup_table ->
+        #    prefetch + sparse pserver updates (reference
+        #    _replace_lookup_table_op_with_prefetch :1217)
+        self._extra_lr_names: List[str] = []
+        self._dist_tables: Dict[str, Dict] = {}
+        self._replace_lookup_table_ops()
+
         # 1. param/grad pairs from optimize ops (reference
         #    _get_optimize_pass :2050 splits at the op-role boundary)
         block = self.origin_program.global_block
@@ -158,6 +165,107 @@ class DistributeTranspiler:
         self._build_trainer_startup()
 
     # ------------------------------------------------------------------
+    def _replace_lookup_table_ops(self):
+        """Row-shard each is_distributed embedding table across the
+        endpoints (mod-sharding: row r lives on endpoint r % n at local
+        row r // n) and rewrite its forward/backward/optimize ops to
+        prefetch / prefetch_grad / per-row pserver SGD."""
+        block = self.origin_program.global_block
+        eps = self.pserver_endpoints
+        n = len(eps)
+        tables = {}
+        for op in block.ops:
+            if op.type == "lookup_table" and op.attr("is_distributed",
+                                                     False):
+                tables[op.input("W")[0]] = None
+        if not tables:
+            return
+        for w_name in list(tables):
+            w_var = block.var(w_name)
+            rows, emb_dim = int(w_var.shape[0]), int(w_var.shape[1])
+            shard_names = [f"{w_name}.shard{j}" for j in range(n)]
+            # lr from the table's optimize op, which moves pserver-side
+            lr_name = ""
+            padding_idx = -1
+            for op in list(block.ops):
+                if (op.attr("op_role") == "optimize"
+                        and op.input("Param") == [w_name]):
+                    if op.type != "sgd":
+                        raise ValueError(
+                            f"distributed lookup table {w_name!r} is "
+                            f"optimized by {op.type!r}; the pserver "
+                            f"sparse update path supports SGD only "
+                            f"(the reference transpiler has the same "
+                            f"restriction) — use SGDOptimizer for the "
+                            f"table or is_distributed=False")
+                    if op.input("LearningRate"):
+                        lr_name = op.input("LearningRate")[0]
+                        self._extra_lr_names.append(lr_name)
+                    block.ops.remove(op)
+            for op in block.ops:
+                if op.type == "lookup_table" and \
+                        op.input("W") == [w_name]:
+                    padding_idx = op.attr("padding_idx", -1)
+            attrs = {"epmap": list(eps), "varnames": shard_names,
+                     "emb_dim": emb_dim, "lr_name": lr_name,
+                     "padding_idx": padding_idx, "op_role": "dist"}
+            for i, op in enumerate(list(block.ops)):
+                if op.type == "lookup_table" and \
+                        op.input("W") == [w_name]:
+                    idx = block.ops.index(op)
+                    block.ops.remove(op)
+                    block.insert_op(idx, "prefetch",
+                                    {"Ids": op.input("Ids")},
+                                    {"Out": op.output("Out")}, attrs)
+                elif op.type == "lookup_table_grad" and \
+                        w_name in op.input_arg_names:
+                    idx = block.ops.index(op)
+                    block.ops.remove(op)
+                    og = [nm for nm in op.input_arg_names
+                          if nm.endswith("@GRAD")]
+                    block.insert_op(idx, "prefetch_grad",
+                                    {"Ids": op.input("Ids"),
+                                     "Out@GRAD": og}, {}, attrs)
+            tables[w_name] = {"rows": rows, "emb_dim": emb_dim,
+                              "shards": shard_names,
+                              "lr_name": lr_name}
+        self._dist_tables = tables
+
+    def _append_table_init_sends(self, block):
+        """Startup: push mod-sharded table slices + lr values."""
+        eps = self.pserver_endpoints
+        n = len(eps)
+        vals, eps_l, names = [], [], []
+        for w_name, info in self._dist_tables.items():
+            for j, (ep, shard) in enumerate(zip(eps, info["shards"])):
+                idx = np.arange(j, info["rows"], n, dtype="int64")
+                idx_name = f"{shard}@init_idx"
+                block.create_var(name=idx_name, shape=[len(idx)],
+                                 dtype="int64")
+                block.append_op(
+                    "assign_value", {}, {"Out": [idx_name]},
+                    {"shape": [len(idx)], "dtype": "int64",
+                     "values": idx, "op_role": "dist"})
+                sl_name = f"{shard}@init"
+                block.create_var(name=sl_name,
+                                 shape=[len(idx), info["emb_dim"]],
+                                 dtype="float32")
+                block.append_op(
+                    "gather", {"X": [w_name], "Index": [idx_name]},
+                    {"Out": [sl_name]}, {"op_role": "dist"})
+                vals.append(sl_name)
+                eps_l.append(ep)
+                names.append(shard)
+            if info["lr_name"]:
+                for ep in eps:
+                    vals.append(info["lr_name"])
+                    eps_l.append(ep)
+                    names.append(info["lr_name"])
+        if vals:
+            block.append_op("send", {"X": vals}, {},
+                            {"epmap": eps_l, "varnames": names,
+                             "init": True, "op_role": "dist"})
+
     def _block_var(self, block, vb: VarBlock, proto):
         shape = list(proto.shape)
         shape[0] = vb.size
@@ -179,8 +287,9 @@ class DistributeTranspiler:
              kept).append(op)
         block.ops = kept
 
-        lr_names = sorted({op.input("LearningRate")[0]
-                           for op in dropped if op.input("LearningRate")})
+        lr_names = sorted(
+            {op.input("LearningRate")[0] for op in dropped
+             if op.input("LearningRate")} | set(self._extra_lr_names))
 
         send_vals, send_eps, send_names = [], [], []
         for p, g in self.params_grads:
@@ -315,6 +424,7 @@ class DistributeTranspiler:
             block.append_op("send", {"X": vals}, {},
                             {"epmap": eps_l, "varnames": names,
                              "init": True, "op_role": "dist"})
+        self._append_table_init_sends(block)
         self.trainer_startup_program = prog
 
     # ------------------------------------------------------------------

@@ -85,7 +85,8 @@ class PServerRuntime:
         """CheckpointNotify-era param placement: store an initial value
         (reference pserver startup initializes its own slices)."""
         with self._lock:
-            self.store[name] = np.asarray(value)
+            # copy: io_callback hands read-only views of device buffers
+            self.store[name] = np.array(np.asarray(value))
 
     def push_grad(self, name: str, value):
         """RequestSend handler (request_handler_impl.cc): buffer the
@@ -125,6 +126,28 @@ class PServerRuntime:
                     f"({self._barrier_count} arrived); with "
                     f"num_trainers > 1 run each trainer in its own "
                     f"thread/process")
+
+    def push_sparse_grad(self, name: str, rows, grads,
+                         lr_name: str = ""):
+        """Distributed-lookup-table update (reference pserver-side
+        lookup_sparse_table + per-row SGD): w[rows] -= lr * grads,
+        applied immediately (async semantics; the reference's sync
+        mode also applies table grads without the dense barrier)."""
+        with self._lock:
+            w = self.store.get(name)
+            if w is None:
+                raise KeyError(
+                    f"pserver {self.endpoint}: table shard {name!r} "
+                    f"not initialized")
+            lr = 1.0
+            if lr_name and lr_name in self.store:
+                lr = float(np.asarray(self.store[lr_name]).reshape(()))
+            rows = np.asarray(rows)
+            g = np.asarray(grads)
+            if not w.flags.writeable:
+                w = np.array(w)
+                self.store[name] = w
+            np.subtract.at(w, rows, lr * g)
 
     def pull(self, name: str) -> np.ndarray:
         """RequestGet handler: serve the current param block."""

@@ -1,0 +1,265 @@
+"""AsyncExecutor + MultiSlotDataFeed + distributed lookup table tests.
+
+Parity model: reference unittests/test_async_executor.py (file-driven
+multithread training), data_feed tests, and the distributed-lookup-
+table path of test_dist_transpiler.py / dist_ctr.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.async_executor import AsyncExecutor
+from paddle_tpu.data_feed import DataFeedDesc, MultiSlotDataFeed
+from paddle_tpu.transpiler import (DistributeTranspiler,
+                                   DistributeTranspilerConfig,
+                                   pserver_runtime)
+
+
+def _write_ctr_files(tmpdir, n_files=2, lines_per_file=64, seed=7):
+    """MultiSlot text files: dnn_data (sparse), lr_data (sparse),
+    click (dense label). Class-correlated ids so training converges."""
+    rng = np.random.RandomState(seed)
+    files = []
+    for fi in range(n_files):
+        path = os.path.join(str(tmpdir), f"ctr_{fi}.txt")
+        with open(path, "w") as f:
+            for _ in range(lines_per_file):
+                click = int(rng.randint(0, 2))
+                lo = 0 if click == 0 else 500
+                n1 = int(rng.randint(1, 6))
+                dnn = rng.randint(lo, lo + 500, n1)
+                n2 = int(rng.randint(1, 4))
+                lr = rng.randint(lo, lo + 500, n2)
+                line = (f"{n1} " + " ".join(map(str, dnn)) + " "
+                        f"{n2} " + " ".join(map(str, lr)) + " "
+                        f"1 {click}")
+                f.write(line + "\n")
+        files.append(path)
+    return files
+
+
+def _ctr_desc(batch_size=16):
+    desc = DataFeedDesc()
+    desc.set_batch_size(batch_size)
+    desc.add_slot("dnn_data", type="uint64")
+    desc.add_slot("lr_data", type="uint64")
+    desc.add_slot("click", type="uint64", is_dense=True)
+    return desc
+
+
+class TestMultiSlotDataFeed:
+    def test_parse_and_batch(self, tmp_path):
+        files = _write_ctr_files(tmp_path, n_files=1, lines_per_file=10)
+        feed = MultiSlotDataFeed(_ctr_desc(4))
+        batches = list(feed.read_batches(files[0]))
+        assert len(batches) == 3  # 4+4+2
+        b = batches[0]
+        assert b["dnn_data"].dtype == np.int64
+        assert b["dnn_data"].ndim == 2 and b["dnn_data"].shape[0] == 4
+        assert b["click"].shape == (4, 1)
+
+    def test_parse_error_clear(self, tmp_path):
+        p = os.path.join(str(tmp_path), "bad.txt")
+        with open(p, "w") as f:
+            f.write("3 1 2\n")  # declares 3 values, provides 2
+        feed = MultiSlotDataFeed(_ctr_desc(2))
+        with pytest.raises(ValueError, match="declares 3 values"):
+            list(feed.read_batches(p))
+
+    def test_desc_roundtrip(self):
+        desc = _ctr_desc(8)
+        import json
+
+        blob = json.loads(desc.desc())
+        assert blob["batch_size"] == 8
+        assert [s["name"] for s in blob["slots"]] == [
+            "dnn_data", "lr_data", "click"]
+
+
+class TestAsyncExecutor:
+    def _build_ctr(self):
+        from paddle_tpu.models import ctr
+
+        dnn = fluid.layers.data("dnn_data", shape=[-1], dtype="int64",
+                                append_batch_size=False)
+        dnn.shape = (-1, -1)
+        lr = fluid.layers.data("lr_data", shape=[-1], dtype="int64",
+                               append_batch_size=False)
+        lr.shape = (-1, -1)
+        click = fluid.layers.data("click", shape=[1], dtype="int64")
+        loss, acc, auc_var, _ = ctr.ctr_dnn_model(
+            dnn, lr, click, dnn_dict_dim=1001, lr_dict_dim=1001)
+        fluid.optimizer.AdamOptimizer(
+            learning_rate=0.05).minimize(loss)
+        return loss
+
+    def test_run_from_files_trains(self, tmp_path):
+        loss = self._build_ctr()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(fluid.default_startup_program())
+        files = _write_ctr_files(tmp_path, n_files=6,
+                                 lines_per_file=96)
+        async_exe = AsyncExecutor(fluid.TPUPlace(0))
+        hist = async_exe.run(fluid.default_main_program(),
+                             _ctr_desc(16), files, thread_num=2,
+                             fetch=[loss])
+        vals = hist[loss.name]
+        assert len(vals) == 36  # 6 files * 6 batches
+        assert np.mean(vals[-8:]) < np.mean(vals[:8]) - 0.02
+
+    def test_empty_filelist_raises(self):
+        with pytest.raises(ValueError):
+            AsyncExecutor().run(fluid.default_main_program(),
+                                _ctr_desc(), [], thread_num=2)
+
+
+class TestDistributedLookupTable:
+    EPS = ["127.0.0.1:8101", "127.0.0.1:8102"]
+
+    def _build(self, vocab=40, dim=4):
+        ids = fluid.layers.data("ids", shape=[5], dtype="int64")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[vocab, dim],
+                                     is_distributed=True)
+        pooled = fluid.layers.reduce_sum(emb, dim=1)
+        pred = fluid.layers.fc(input=pooled, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.01).minimize(loss)
+        return ids, y, loss
+
+    def _transpile(self, trainers=1):
+        cfg = DistributeTranspilerConfig()
+        cfg.slice_var_up = False
+        t = DistributeTranspiler(cfg)
+        t.transpile(0, pservers=",".join(self.EPS), trainers=trainers)
+        for ep in self.EPS:
+            pserver_runtime.configure_endpoint(
+                ep, t.get_pserver_program(ep), num_trainers=trainers,
+                sync_mode=True)
+        return t
+
+    def test_table_rewritten_and_sharded(self):
+        self._build()
+        pserver_runtime.reset_endpoints()
+        t = self._transpile()
+        types = [o.type for o in
+                 t.get_trainer_program().global_block.ops]
+        assert "prefetch" in types and "prefetch_grad" in types
+        assert "lookup_table" not in types
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(t.get_startup_program())
+        s0 = pserver_runtime.get_endpoint(self.EPS[0]).store
+        s1 = pserver_runtime.get_endpoint(self.EPS[1]).store
+        shard_keys0 = [k for k in s0 if ".shard" in k]
+        shard_keys1 = [k for k in s1 if ".shard" in k]
+        assert shard_keys0 and shard_keys1
+        # shards hold the mod-sharded rows of the initial table
+        w0 = np.asarray(fluid.global_scope()._get(
+            shard_keys0[0].split(".shard")[0]))
+        np.testing.assert_allclose(
+            np.asarray(s0[shard_keys0[0]]), w0[0::2], rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(s1[shard_keys1[0]]), w0[1::2], rtol=1e-6)
+
+    def test_prefetch_forward_parity(self):
+        ids, y, loss = self._build()
+        pserver_runtime.reset_endpoints()
+        t = self._transpile()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(t.get_startup_program())
+        w = np.array(np.asarray(fluid.global_scope()._get(
+            [n for n in fluid.global_scope().local_var_names()
+             if "emb" in n or "w" in n.lower()][0])))
+        # forward through prefetch must equal a local gather
+        table_name = [n for n, i in t._dist_tables.items()][0]
+        w = np.array(np.asarray(fluid.global_scope()._get(table_name)))
+        idv = np.array([[0, 1, 2, 3, 5], [7, 8, 9, 10, 11]], np.int64)
+        emb_out = next(o for o in
+                       t.get_trainer_program().global_block.ops
+                       if o.type == "prefetch").output("Out")[0]
+        got, = exe.run(t.get_trainer_program(),
+                       feed={"ids": idv,
+                             "y": np.zeros((2, 1), np.float32)},
+                       fetch_list=[emb_out])
+        np.testing.assert_allclose(got, w[idv], rtol=1e-5, atol=1e-6)
+
+    def test_adam_table_rejected(self):
+        ids = fluid.layers.data("ids", shape=[5], dtype="int64")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[40, 4],
+                                     is_distributed=True)
+        pred = fluid.layers.fc(
+            input=fluid.layers.reduce_sum(emb, dim=1), size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.AdamOptimizer(
+            learning_rate=0.01).minimize(loss)
+        cfg = DistributeTranspilerConfig()
+        with pytest.raises(ValueError, match="SGD only"):
+            DistributeTranspiler(cfg).transpile(
+                0, pservers=",".join(self.EPS), trainers=1)
+
+    def test_padding_idx_zeroes_and_protects_row(self):
+        pserver_runtime.reset_endpoints()
+        ids = fluid.layers.data("ids", shape=[4], dtype="int64")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[40, 4],
+                                     is_distributed=True,
+                                     padding_idx=0)
+        pred = fluid.layers.fc(
+            input=fluid.layers.reduce_sum(emb, dim=1), size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+        t = self._transpile()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(t.get_startup_program())
+        table = list(t._dist_tables)[0]
+        info = t._dist_tables[table]
+        rt0 = pserver_runtime.get_endpoint(self.EPS[0])
+        row0_before = np.array(rt0.store[info["shards"][0]][0])
+        emb_out = next(o for o in
+                       t.get_trainer_program().global_block.ops
+                       if o.type == "prefetch").output("Out")[0]
+        idv = np.array([[0, 0, 3, 5]], np.int64)
+        got, l = exe.run(
+            t.get_trainer_program(),
+            feed={"ids": idv, "y": np.ones((1, 1), np.float32)},
+            fetch_list=[emb_out, loss.name])
+        np.testing.assert_allclose(got[0, 0], np.zeros(4))  # pad = 0
+        np.testing.assert_allclose(got[0, 1], np.zeros(4))
+        assert np.abs(got[0, 2]).sum() > 0
+        # pad row received no gradient
+        row0_after = np.array(rt0.store[info["shards"][0]][0])
+        np.testing.assert_allclose(row0_after, row0_before)
+
+    def test_sparse_training_updates_only_touched_rows(self):
+        ids, y, loss = self._build()
+        pserver_runtime.reset_endpoints()
+        t = self._transpile()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(t.get_startup_program())
+        table_name = list(t._dist_tables)[0]
+        info = t._dist_tables[table_name]
+        rt0 = pserver_runtime.get_endpoint(self.EPS[0])
+        before0 = np.array(rt0.store[info["shards"][0]])
+        idv = np.array([[2, 2, 4, 6, 8]], np.int64)  # even rows: ep0
+        losses = []
+        for _ in range(10):
+            l, = exe.run(t.get_trainer_program(),
+                         feed={"ids": idv,
+                               "y": np.ones((1, 1), np.float32)},
+                         fetch_list=[loss.name])
+            losses.append(float(np.asarray(l)))
+        after0 = np.array(rt0.store[info["shards"][0]])
+        touched = np.array([1, 2, 3, 4])  # local rows = ids // 2
+        untouched = np.array([0, 5, 6, 7])
+        assert np.abs(after0[touched] - before0[touched]).sum() > 0
+        np.testing.assert_allclose(after0[untouched],
+                                   before0[untouched])
+        # odd-row shard on ep1 untouched entirely
+        rt1 = pserver_runtime.get_endpoint(self.EPS[1])
+        assert losses[-1] < losses[0]
