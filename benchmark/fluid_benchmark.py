@@ -1,0 +1,273 @@
+"""Benchmark harness (parity: reference benchmark/fluid/
+fluid_benchmark.py — same metric definition: examples/sec =
+num_samples / elapsed printed per pass, :296-300; same model set:
+mnist, resnet, vgg, se_resnext, stacked_dynamic_lstm,
+machine_translation, transformer, plus word2vec and ctr).
+
+Usage:
+    python -m benchmark.fluid_benchmark --model resnet --batch_size 32 \
+        --iterations 20 [--parallel] [--device TPU|CPU]
+
+--parallel compiles the program data-parallel over all visible chips
+via CompiledProgram.with_data_parallel (XLA GSPMD collectives replace
+the reference's ParallelExecutor AllReduce op handles).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu fluid_benchmark")
+    p.add_argument("--model", default="mnist",
+                   choices=sorted(MODELS))
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--learning_rate", type=float, default=None)
+    p.add_argument("--skip_batch_num", type=int, default=2,
+                   help="warmup batches excluded from timing")
+    p.add_argument("--iterations", type=int, default=10)
+    p.add_argument("--pass_num", type=int, default=1)
+    p.add_argument("--device", default=None, choices=["TPU", "CPU"])
+    p.add_argument("--parallel", action="store_true",
+                   help="data-parallel over all visible devices")
+    p.add_argument("--profile", action="store_true")
+    p.add_argument("--json", action="store_true",
+                   help="print one machine-readable JSON line")
+    return p.parse_args(argv)
+
+
+# ---------------------------------------------------------------------
+# model adapters: name -> fn(args) -> (main, startup, loss, feed_fn)
+# feed_fn(batch_size, rng) -> feed dict. sample_unit: what one
+# "example" is for examples/sec (images or tokens).
+# ---------------------------------------------------------------------
+def _mnist(args):
+    from paddle_tpu.models import mnist as M
+    import paddle_tpu as fluid
+
+    main, startup, loss, acc = M.build_program(use_conv=True)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.AdamOptimizer(
+            learning_rate=0.001 if args.learning_rate is None
+            else args.learning_rate).minimize(loss)
+
+    def feed(bs, rng):
+        return {"img": rng.randn(bs, 1, 28, 28).astype(np.float32),
+                "label": rng.randint(0, 10, (bs, 1)).astype(
+                    np.int64)}, bs
+
+    return main, startup, loss, feed, "examples"
+
+
+def _img_model(mod_name, image_shape, class_dim):
+    def build(args):
+        import importlib
+
+        M = importlib.import_module(f"paddle_tpu.models.{mod_name}")
+        kwargs = dict(class_dim=class_dim, image_shape=image_shape)
+        if args.learning_rate is not None:
+            kwargs["lr"] = args.learning_rate
+        if mod_name == "resnet":
+            kwargs["depth"] = 50
+        out = M.build_program(**kwargs)
+        main, startup, loss = out[0], out[1], out[2]
+
+        def feed(bs, rng):
+            return {"img": rng.randn(bs, *image_shape).astype(
+                np.float32),
+                "label": rng.randint(0, class_dim, (bs, 1)).astype(
+                    np.int64)}, bs
+
+        return main, startup, loss, feed, "examples"
+
+    return build
+
+
+def _stacked_dynamic_lstm(args):
+    from paddle_tpu.models import stacked_dynamic_lstm as M
+
+    dict_dim, seq = 10000, 80
+    main, startup, loss, acc = M.build_program(
+        dict_dim=dict_dim, emb_dim=256, hid_dim=256, stacked_num=3,
+        lr=0.002 if args.learning_rate is None else args.learning_rate)
+
+    def feed(bs, rng):
+        lens = rng.randint(seq // 2, seq + 1, bs).astype(np.int32)
+        f = {"words": rng.randint(0, dict_dim, (bs, seq)).astype(
+            np.int64),
+            "words@SEQ_LEN": lens,
+            "label": rng.randint(0, 2, (bs, 1)).astype(np.int64)}
+        # REAL tokens, not padded slots (the reference counts words
+        # via LoD lengths, fluid_benchmark.py:296)
+        return f, int(lens.sum())
+
+    return main, startup, loss, feed, "tokens"
+
+
+def _machine_translation(args):
+    from paddle_tpu.models import machine_translation as M
+
+    dd, seq = 10000, 30
+    out = M.build_program(src_dict_dim=dd, tgt_dict_dim=dd,
+                          lr=0.0002 if args.learning_rate is None else args.learning_rate)
+    main, startup, loss = out[0], out[1], out[2]
+
+    def feed(bs, rng):
+        lens = np.full(bs, seq, np.int32)
+        return {
+            "src_word_id": rng.randint(0, dd, (bs, seq)).astype(
+                np.int64),
+            "src_word_id@SEQ_LEN": lens,
+            "target_language_word": rng.randint(0, dd,
+                                                (bs, seq)).astype(
+                np.int64),
+            "target_language_word@SEQ_LEN": lens,
+            "target_language_next_word": rng.randint(
+                0, dd, (bs, seq)).astype(np.int64),
+            "target_language_next_word@SEQ_LEN": lens,
+        }, bs * seq
+
+    return main, startup, loss, feed, "tokens"
+
+
+def _transformer(args):
+    from paddle_tpu.models import transformer as M
+
+    seq, vocab = 64, 32000
+    main, startup, cost = M.build_program(
+        seq_len=seq, d_model=512, n_heads=8, n_layers=6, d_inner=2048,
+        vocab=vocab, dropout_rate=0.0, with_optimizer=True,
+        learning_rate=2.0 if args.learning_rate is None else args.learning_rate, warmup_steps=4000)
+
+    def feed(bs, rng):
+        return {
+            "src_ids": rng.randint(0, vocab, (bs, seq)).astype(
+                np.int64),
+            "tgt_ids": rng.randint(0, vocab, (bs, seq)).astype(
+                np.int64),
+            "label": rng.randint(0, vocab, (bs, seq)).astype(
+                np.int64),
+        }, bs * seq
+
+    return main, startup, cost, feed, "tokens"
+
+
+def _word2vec(args):
+    from paddle_tpu.models import word2vec as M
+
+    dict_size = 1500
+    main, startup, loss = M.build_program(
+        dict_size=dict_size, lr=0.001 if args.learning_rate is None else args.learning_rate)
+
+    def feed(bs, rng):
+        names = ("firstw", "secondw", "thirdw", "fourthw", "nextw")
+        return {n: rng.randint(0, dict_size, (bs, 1)).astype(np.int64)
+                for n in names}, bs
+
+    return main, startup, loss, feed, "examples"
+
+
+def _ctr(args):
+    from paddle_tpu.models import ctr as M
+
+    main, startup, loss, auc = M.build_program(
+        dnn_dict_dim=10001, lr_dict_dim=10001,
+        lr=0.0001 if args.learning_rate is None else args.learning_rate)
+
+    def feed(bs, rng):
+        t1, t2 = 8, 4
+        return {
+            "dnn_data": rng.randint(1, 10001, (bs, t1)).astype(
+                np.int64),
+            "dnn_data@SEQ_LEN": rng.randint(1, t1 + 1, bs).astype(
+                np.int32),
+            "lr_data": rng.randint(1, 10001, (bs, t2)).astype(
+                np.int64),
+            "lr_data@SEQ_LEN": rng.randint(1, t2 + 1, bs).astype(
+                np.int32),
+            "click": rng.randint(0, 2, (bs, 1)).astype(np.int64),
+        }, bs
+
+    return main, startup, loss, feed, "examples"
+
+
+MODELS = {
+    "mnist": _mnist,
+    "resnet": _img_model("resnet", (3, 224, 224), 1000),
+    "vgg": _img_model("vgg", (3, 32, 32), 10),
+    "se_resnext": _img_model("se_resnext", (3, 224, 224), 1000),
+    "stacked_dynamic_lstm": _stacked_dynamic_lstm,
+    "machine_translation": _machine_translation,
+    "transformer": _transformer,
+    "word2vec": _word2vec,
+    "ctr": _ctr,
+}
+
+
+def run_benchmark(args):
+    import jax
+
+    if args.device == "CPU":
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid
+
+    if args.iterations < 1:
+        raise ValueError("--iterations must be >= 1")
+    main, startup, loss, feed_fn, unit_kind = MODELS[args.model](args)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    prog = main
+    ndev = 1
+    if args.parallel:
+        prog = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        ndev = max(1, len(jax.devices()))
+    rng = np.random.RandomState(0)
+    loss_name = loss.name
+    unit = "tokens/sec" if unit_kind == "tokens" else "examples/sec"
+    results = []
+    if args.profile:
+        fluid.profiler.start_profiler("All")
+    for pass_id in range(args.pass_num):
+        # warmup (excluded from timing; first step pays XLA compile)
+        last = None
+        for _ in range(args.skip_batch_num):
+            f, _n = feed_fn(args.batch_size, rng)
+            out = exe.run(prog, feed=f, fetch_list=[loss_name])
+            last = float(np.asarray(out[0]).reshape(-1)[0])
+        num_samples = 0
+        start = time.perf_counter()
+        for _ in range(args.iterations):
+            f, n = feed_fn(args.batch_size, rng)
+            if ndev > 1:
+                # CompiledProgram drops the remainder rows that don't
+                # divide over the mesh; count only what actually ran
+                n = n * ((args.batch_size // ndev) * ndev) \
+                    // args.batch_size
+            out = exe.run(prog, feed=f, fetch_list=[loss_name])
+            last = float(np.asarray(out[0]).reshape(-1)[0])
+            num_samples += n
+        elapsed = time.perf_counter() - start
+        eps = num_samples / elapsed if elapsed > 0 else float("nan")
+        print(f"Pass: {pass_id}, Loss: {last:.5f}, Speed: {eps:.2f} "
+              f"{unit}")
+        results.append({"pass": pass_id, "loss": last, "speed": eps,
+                        "unit": unit})
+    if args.profile:
+        fluid.profiler.stop_profiler("total", "/tmp/benchmark_profile")
+    if args.json:
+        best = max(r["speed"] for r in results)
+        print(json.dumps({"model": args.model, "speed": best,
+                          "unit": unit,
+                          "loss": results[-1]["loss"],
+                          "parallel": bool(args.parallel),
+                          "batch_size": args.batch_size}))
+    return results
+
+
+if __name__ == "__main__":
+    run_benchmark(parse_args())

@@ -1,0 +1,61 @@
+"""Benchmark harness tests (parity model: reference benchmark/fluid/
+fluid_benchmark.py CLI semantics — per-pass examples/sec)."""
+import numpy as np
+
+from benchmark.fluid_benchmark import MODELS, parse_args, run_benchmark
+
+
+def _args(**kw):
+    argv = []
+    for k, v in kw.items():
+        if isinstance(v, bool):
+            if v:
+                argv.append(f"--{k}")
+        else:
+            argv += [f"--{k}", str(v)]
+    args = parse_args(argv)
+    if "batch_size" not in kw:
+        args.batch_size = 8
+    if "skip_batch_num" not in kw:
+        args.skip_batch_num = 1
+    if "iterations" not in kw:
+        args.iterations = 2
+    return args
+
+
+class TestBenchmarkHarness:
+    def test_model_registry_complete(self):
+        # the reference benchmark model set must all be present
+        for name in ("mnist", "resnet", "vgg", "se_resnext",
+                     "stacked_dynamic_lstm", "machine_translation",
+                     "transformer"):
+            assert name in MODELS
+
+    def test_mnist_speed_positive(self):
+        res = run_benchmark(_args(model="mnist"))
+        assert len(res) == 1
+        assert res[0]["speed"] > 0
+        assert res[0]["unit"] == "examples/sec"
+        assert np.isfinite(res[0]["loss"])
+
+    def test_lstm_counts_tokens(self):
+        res = run_benchmark(_args(model="stacked_dynamic_lstm",
+                                  batch_size=4))
+        assert res[0]["unit"] == "tokens/sec"
+        assert res[0]["speed"] > 0
+
+    def test_parallel_mode_runs(self):
+        res = run_benchmark(_args(model="mnist", parallel=True,
+                                  batch_size=16))
+        assert res[0]["speed"] > 0
+
+    def test_multi_pass(self):
+        res = run_benchmark(_args(model="word2vec", pass_num=2))
+        assert len(res) == 2
+
+
+    def test_zero_iterations_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            run_benchmark(_args(model="word2vec", iterations=0))
