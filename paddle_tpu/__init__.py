@@ -48,6 +48,15 @@ from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
     memory_optimize, release_memory
 from . import contrib
+from . import lod_tensor
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
+from . import average
+from . import debugger
+from . import net_drawer
+from . import evaluator
+from . import install_check
+from .async_executor import AsyncExecutor
+from .data_feed import DataFeedDesc
 
 # fluid-compat: many scripts do `import paddle.fluid as fluid`; we expose
 # the same names so `import paddle_tpu as fluid` works.

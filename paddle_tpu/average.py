@@ -1,0 +1,36 @@
+"""WeightedAverage (parity: reference python/paddle/fluid/average.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number_or_matrix(var):
+    return isinstance(var, (int, float, np.ndarray)) or np.isscalar(var)
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            value = np.asarray(value)
+        if self.numerator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator == 0:
+            raise ValueError(
+                "eval() called before any add(); there is no average "
+                "yet")
+        return self.numerator / self.denominator

@@ -1,0 +1,67 @@
+"""Program pretty-printer (parity: reference python/paddle/fluid/
+debugger.py draw_block_graphviz / print-style program dumps)."""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["pprint_program_codes", "pprint_block_codes",
+           "draw_block_graphviz"]
+
+
+def _fmt_var(var):
+    shape = "x".join(str(d) for d in (var.shape or ())) or "?"
+    dt = var.dtype.value if var.dtype else "?"
+    flags = []
+    if var.persistable:
+        flags.append("persist")
+    if var.is_data:
+        flags.append("data")
+    f = (" [" + ",".join(flags) + "]") if flags else ""
+    return f"{var.name}: {dt}[{shape}]{f}"
+
+
+def pprint_block_codes(block, show_backward=False) -> str:
+    lines = [f"// block {block.idx} (parent {block.parent_idx})"]
+    for var in block.vars.values():
+        lines.append(f"var {_fmt_var(var)}")
+    for op in block.ops:
+        if not show_backward and op.attr("op_role") == "backward":
+            continue
+        ins = ", ".join(f"{s}={v}" for s, v in op.inputs.items() if v)
+        outs = ", ".join(f"{s}={v}" for s, v in op.outputs.items()
+                         if v)
+        attrs = {k: v for k, v in op.attrs.items()
+                 if not k.startswith("__") and k != "op_role"}
+        lines.append(f"{outs} = {op.type}({ins})"
+                     + (f"  # {attrs}" if attrs else ""))
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program, show_backward=False) -> str:
+    return "\n\n".join(pprint_block_codes(b, show_backward)
+                       for b in program.blocks)
+
+
+def draw_block_graphviz(block, highlights=None,
+                        path: str = "./temp.dot") -> str:
+    """Emit a graphviz dot file of the block's op/var graph (reference
+    debugger.py draw_block_graphviz)."""
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    for i, op in enumerate(block.ops):
+        color = ', style=filled, fillcolor="lightblue"' \
+            if op.type in highlights else ""
+        lines.append(f'  op_{i} [label="{op.type}", shape=box{color}];')
+        for name in op.input_arg_names:
+            vid = f'var_{name.replace(".", "_").replace("@", "_")}'
+            lines.append(f'  {vid} [label="{name}", shape=ellipse];')
+            lines.append(f"  {vid} -> op_{i};")
+        for name in op.output_arg_names:
+            vid = f'var_{name.replace(".", "_").replace("@", "_")}'
+            lines.append(f'  {vid} [label="{name}", shape=ellipse];')
+            lines.append(f"  op_{i} -> {vid};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(dot)
+    return dot

@@ -1,0 +1,123 @@
+"""Evaluator classes (parity: reference python/paddle/fluid/
+evaluator.py — graph-building accumulators reset between passes;
+largely superseded by metrics.py, kept for surface parity)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import layers
+from .core.program import default_main_program, default_startup_program
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance"]
+
+
+class Evaluator:
+    """Base: owns accumulator state vars; reset() zeroes them
+    (reference evaluator.py Evaluator)."""
+
+    def __init__(self, name=None, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper_name = name or self.__class__.__name__
+
+    def reset(self, executor, reset_program=None):
+        from .core.scope import global_scope
+
+        for var in self.states:
+            val = global_scope()._get(var.name)
+            if val is not None:
+                global_scope()._set(var.name,
+                                    np.zeros_like(np.asarray(val)))
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+    def _create_state(self, suffix, dtype, shape):
+        from . import unique_name
+        from .core.program import default_startup_program
+
+        block = default_main_program().global_block
+        name = unique_name.generate(
+            f"{self.helper_name}_{suffix}")
+        var = block.create_var(name=name, shape=shape, dtype=dtype,
+                               persistable=True)
+        sblock = default_startup_program().global_block
+        sblock.create_var(name=name, shape=shape, dtype=dtype,
+                          persistable=True)
+        sblock.append_op("fill_constant", {}, {"Out": [name]},
+                         {"shape": list(shape), "dtype": dtype,
+                          "value": 0.0})
+        self.states.append(var)
+        return var
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulated chunk F1 (reference evaluator.py ChunkEvaluator)."""
+
+    def __init__(self, input, label, chunk_scheme,
+                 num_chunk_types, excluded_chunk_types=None):
+        super().__init__()
+        num_infer, num_label, num_correct = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)[3:]
+        self.num_infer_chunks = self._create_state(
+            "num_infer", "int64", [1])
+        self.num_label_chunks = self._create_state(
+            "num_label", "int64", [1])
+        self.num_correct_chunks = self._create_state(
+            "num_correct", "int64", [1])
+        block = default_main_program().global_block
+        for acc, cur in ((self.num_infer_chunks, num_infer),
+                         (self.num_label_chunks, num_label),
+                         (self.num_correct_chunks, num_correct)):
+            block.append_op("elementwise_add",
+                            {"X": [acc.name], "Y": [cur.name]},
+                            {"Out": [acc.name]}, {})
+        self.metrics = [self.num_infer_chunks, self.num_label_chunks,
+                        self.num_correct_chunks]
+
+    def eval(self, executor, eval_program=None):
+        from .core.scope import global_scope
+
+        ni = float(np.asarray(
+            global_scope()._get(self.num_infer_chunks.name)))
+        nl = float(np.asarray(
+            global_scope()._get(self.num_label_chunks.name)))
+        nc = float(np.asarray(
+            global_scope()._get(self.num_correct_chunks.name)))
+        precision = nc / ni if ni else 0.0
+        recall = nc / nl if nl else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(Evaluator):
+    """Accumulated average edit distance (reference evaluator.py)."""
+
+    def __init__(self, input, label, ignored_tokens=None):
+        super().__init__()
+        dist, seq_num = layers.edit_distance(
+            input, label, ignored_tokens=ignored_tokens)
+        self.total_distance = self._create_state("total_dist",
+                                                 "float32", [1])
+        self.seq_num = self._create_state("seq_num", "int64", [1])
+        block = default_main_program().global_block
+        summed = layers.reduce_sum(dist)
+        block.append_op("elementwise_add",
+                        {"X": [self.total_distance.name],
+                         "Y": [summed.name]},
+                        {"Out": [self.total_distance.name]}, {})
+        block.append_op("elementwise_add",
+                        {"X": [self.seq_num.name],
+                         "Y": [seq_num.name]},
+                        {"Out": [self.seq_num.name]}, {})
+
+    def eval(self, executor, eval_program=None):
+        from .core.scope import global_scope
+
+        total = float(np.asarray(
+            global_scope()._get(self.total_distance.name)))
+        n = float(np.asarray(global_scope()._get(self.seq_num.name)))
+        return total / n if n else 0.0

@@ -1,0 +1,48 @@
+"""Installation self-check (parity: reference python/paddle/fluid/
+install_check.py run_check: builds a tiny fc model, runs one train
+step single-device, then data-parallel when >1 device is visible)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    import jax
+
+    import paddle_tpu as fluid
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="inp", shape=[2], dtype="float32")
+        y = fluid.layers.data(name="lab", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(
+            learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        out = exe.run(prog,
+                      feed={"inp": np.ones((4, 2), np.float32),
+                            "lab": np.ones((4, 1), np.float32)},
+                      fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()
+        if len(jax.devices()) > 1:
+            compiled = fluid.CompiledProgram(prog).with_data_parallel(
+                loss_name=loss.name)
+            ndev = len(jax.devices())
+            out = exe.run(compiled,
+                          feed={"inp": np.ones((4 * ndev, 2),
+                                               np.float32),
+                                "lab": np.ones((4 * ndev, 1),
+                                               np.float32)},
+                          fetch_list=[loss.name])
+            assert np.isfinite(np.asarray(out[0])).all()
+    print("Your paddle_tpu works well on "
+          f"{len(jax.devices())} {jax.devices()[0].platform} "
+          "device(s).")
+    print("install check success!")

@@ -1,0 +1,97 @@
+"""Host-bridge layers: py_func, chunk_eval, Go.
+
+Parity: reference python/paddle/fluid/layers/nn.py py_func (+
+operators/py_func_op.cc), layers/nn.py chunk_eval, and the Go op
+(operators/csp/go_op.cc via fluid.layers.Go-era API).
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from ..core.program import default_main_program
+
+__all__ = ["py_func", "chunk_eval", "Go"]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Call a Python function as a graph op (reference layers/nn.py
+    py_func). `out` vars must be pre-created with known shapes/dtypes
+    (create via program.current_block().create_var), like the
+    reference requires."""
+    from ..ops.host_ops import register_py_func
+
+    if not isinstance(x, (list, tuple)):
+        x = [x]
+    if not isinstance(out, (list, tuple)):
+        out = [out]
+    fid = register_py_func(func)
+    bid = register_py_func(backward_func) if backward_func else -1
+    skip = [getattr(v, "name", v)
+            for v in (skip_vars_in_backward_input or [])]
+    helper = LayerHelper("py_func", input=x[0])
+    helper.append_op(
+        "py_func", {"X": list(x)}, {"Out": list(out)},
+        {"forward_callable_id": fid, "backward_callable_id": bid,
+         "backward_skip_vars": skip})
+    return out if len(out) > 1 else out[0]
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """reference layers/nn.py chunk_eval -> chunk_eval_op.cc. Returns
+    (precision, recall, f1, num_infer, num_label, num_correct)."""
+    helper = LayerHelper("chunk_eval", input=input)
+    precision = helper.create_variable_for_type_inference("float32",
+                                                          True)
+    recall = helper.create_variable_for_type_inference("float32", True)
+    f1 = helper.create_variable_for_type_inference("float32", True)
+    num_infer = helper.create_variable_for_type_inference("int64",
+                                                          True)
+    num_label = helper.create_variable_for_type_inference("int64",
+                                                          True)
+    num_correct = helper.create_variable_for_type_inference("int64",
+                                                            True)
+    ins = {"Inference": input, "Label": label}
+    if seq_length is None:
+        # auto-wire the padded-batch length companion (the framework's
+        # @SEQ_LEN convention, layers/sequence.py) so padded tails are
+        # not scored as chunks
+        cand = input.name + "@SEQ_LEN"
+        if input.block.has_var(cand):
+            ins["SeqLength"] = cand
+    else:
+        ins["SeqLength"] = seq_length
+    helper.append_op(
+        "chunk_eval", ins,
+        {"Precision": precision, "Recall": recall, "F1-Score": f1,
+         "NumInferChunks": num_infer, "NumLabelChunks": num_label,
+         "NumCorrectChunks": num_correct},
+        {"chunk_scheme": chunk_scheme,
+         "num_chunk_types": num_chunk_types,
+         "excluded_chunk_types": list(excluded_chunk_types or [])})
+    return precision, recall, f1, num_infer, num_label, num_correct
+
+
+class Go:
+    """Goroutine block (reference operators/csp/go_op.cc):
+
+        with fluid.layers.Go(inputs=[x]):
+            ... ops captured into the concurrent sub-block ...
+    """
+
+    def __init__(self, inputs=None, name=None):
+        self._inputs = list(inputs or [])
+        self._program = default_main_program()
+
+    def __enter__(self):
+        self._block = self._program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._program.rollback()
+        if exc_type is not None:
+            return False
+        parent = self._program.current_block()
+        parent.append_op(
+            "go", {"X": [v.name for v in self._inputs]}, {},
+            {"sub_block": self._block})
+        return True

@@ -1,0 +1,117 @@
+"""LoD tensor helpers (parity: reference python/paddle/fluid/
+lod_tensor.py: create_lod_tensor, create_random_int_lodtensor).
+
+TPU encoding note: the framework's native variable-length encoding is
+padded-dense [B, maxlen, ...] + an int32 per-sample length companion
+(layers/sequence.py @SEQ_LEN contract); these helpers build that pair
+from the reference's recursive_seq_lens representation, and convert
+back — the round-trip the reference's LoDTensor.set_lod/lod provides.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor",
+           "to_padded", "from_padded", "lengths_to_offsets",
+           "offsets_to_lengths"]
+
+
+def lengths_to_offsets(lengths: Sequence[int]) -> List[int]:
+    out = [0]
+    for l in lengths:
+        out.append(out[-1] + int(l))
+    return out
+
+
+def offsets_to_lengths(offsets: Sequence[int]) -> List[int]:
+    return [int(offsets[i + 1] - offsets[i])
+            for i in range(len(offsets) - 1)]
+
+
+class LoDTensor:
+    """Value + recursive sequence lengths (reference lod_tensor.h:110
+    semantics at the Python surface)."""
+
+    def __init__(self, data: np.ndarray,
+                 recursive_seq_lens: List[List[int]]):
+        self._data = np.asarray(data)
+        self._lens = [list(map(int, l)) for l in recursive_seq_lens]
+
+    def lod(self):
+        return [lengths_to_offsets(l) for l in self._lens]
+
+    def recursive_sequence_lengths(self):
+        return self._lens
+
+    def set_lod(self, lod):
+        self._lens = [offsets_to_lengths(l) for l in lod]
+
+    def __array__(self, dtype=None):
+        return self._data.astype(dtype) if dtype else self._data
+
+    def shape(self):
+        return list(self._data.shape)
+
+    def has_valid_recursive_sequence_lengths(self) -> bool:
+        total = self._data.shape[0]
+        lens = self._lens
+        for level in reversed(range(len(lens))):
+            if sum(lens[level]) != total:
+                return False
+            total = len(lens[level])
+        return True
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
+    """reference lod_tensor.py create_lod_tensor: data may be a numpy
+    array (rows = sum of bottom-level lens), a list of lists, or
+    another LoDTensor."""
+    if isinstance(data, LoDTensor):
+        return LoDTensor(np.asarray(data), recursive_seq_lens)
+    if isinstance(data, list):
+        flat = [np.asarray(x).reshape(-1, 1) for x in data]
+        arr = np.concatenate(flat, axis=0)
+        assert [len(x) for x in flat] == list(
+            recursive_seq_lens[-1]), \
+            "list data lengths must match recursive_seq_lens[-1]"
+        return LoDTensor(arr, recursive_seq_lens)
+    arr = np.asarray(data)
+    t = LoDTensor(arr, recursive_seq_lens)
+    assert t.has_valid_recursive_sequence_lengths(), \
+        "invalid recursive_seq_lens for data with %d rows" % \
+        arr.shape[0]
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high) -> LoDTensor:
+    rows = sum(recursive_seq_lens[-1])
+    shape = [rows] + list(base_shape)
+    return LoDTensor(
+        np.random.randint(low, high + 1, size=shape).astype(np.int64),
+        recursive_seq_lens)
+
+
+def to_padded(t: LoDTensor) -> Tuple[np.ndarray, np.ndarray]:
+    """LoDTensor -> (padded [B, maxlen, ...], lengths int32 [B]): the
+    framework's native encoding (feed the pair as `name` +
+    `name@SEQ_LEN`)."""
+    lens = t.recursive_sequence_lengths()[-1]
+    data = np.asarray(t)
+    maxlen = max(lens) if lens else 0
+    out = np.zeros((len(lens), maxlen) + data.shape[1:], data.dtype)
+    off = 0
+    for i, l in enumerate(lens):
+        out[i, :l] = data[off:off + l]
+        off += l
+    return out, np.asarray(lens, np.int32)
+
+
+def from_padded(padded: np.ndarray, lengths) -> LoDTensor:
+    rows = []
+    for i, l in enumerate(np.asarray(lengths)):
+        rows.append(padded[i, :int(l)])
+    return LoDTensor(np.concatenate(rows, axis=0),
+                     [[int(l) for l in np.asarray(lengths)]])

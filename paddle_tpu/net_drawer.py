@@ -1,0 +1,49 @@
+"""Graphviz network drawing (parity: reference python/paddle/fluid/
+net_drawer.py + graphviz.py — thin wrappers over debugger's dot
+emitter)."""
+from __future__ import annotations
+
+from .debugger import draw_block_graphviz
+
+__all__ = ["draw_graph", "Graph"]
+
+
+class Graph:
+    """Minimal graphviz builder (reference graphviz.py Graph)."""
+
+    def __init__(self, title="G", rankdir="TB"):
+        self.title = title
+        self.rankdir = rankdir
+        self.nodes = []
+        self.edges = []
+
+    def node(self, name, label=None, **attrs):
+        self.nodes.append((name, label or name, attrs))
+        return name
+
+    def edge(self, src, dst, **attrs):
+        self.edges.append((src, dst, attrs))
+
+    def __str__(self):
+        lines = [f"digraph {self.title} {{",
+                 f"  rankdir={self.rankdir};"]
+        for name, label, attrs in self.nodes:
+            extra = "".join(f", {k}={v}" for k, v in attrs.items())
+            lines.append(f'  {name} [label="{label}"{extra}];')
+        for s, d, attrs in self.edges:
+            extra = ", ".join(f"{k}={v}" for k, v in attrs.items())
+            lines.append(f"  {s} -> {d}"
+                         + (f" [{extra}]" if extra else "") + ";")
+        lines.append("}")
+        return "\n".join(lines)
+
+    def save(self, path):
+        with open(path, "w") as f:
+            f.write(str(self))
+        return path
+
+
+def draw_graph(startup_program, main_program, path="./network.dot"):
+    """reference net_drawer.py draw_graph: dot file of the main
+    program's global block."""
+    return draw_block_graphviz(main_program.global_block, path=path)

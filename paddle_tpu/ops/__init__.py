@@ -12,3 +12,4 @@ from . import loss_extra_ops  # noqa: F401
 from . import dist_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import host_ops  # noqa: F401

@@ -1,0 +1,252 @@
+"""Host-callback ops: py_func, chunk_eval, go.
+
+Parity: reference operators/py_func_op.cc (call back into Python from
+a graph op — the custom-op escape hatch), operators/chunk_eval_op.cc
+(chunk detection metrics for sequence labeling), operators/csp/go_op.cc
+(goroutine-style concurrent block execution).
+
+TPU-native: all three are host effects bridged through
+jax.experimental.io_callback / pure_callback from inside the compiled
+program — the XLA equivalent of the reference's CPU-only kernels.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+from ..core.program import Operator, grad_var_name
+from ..core.registry import register_op
+from ..core.types import to_jnp_dtype
+
+# registered python callables for py_func (reference py_func_op.cc
+# keeps a static registry the op indexes into)
+_PY_FUNC_REGISTRY: List[Callable] = []
+
+
+def register_py_func(fn: Callable) -> int:
+    _PY_FUNC_REGISTRY.append(fn)
+    return len(_PY_FUNC_REGISTRY) - 1
+
+
+def _py_func_grad_maker(op, no_grad_set=frozenset()):
+    if op.attr("backward_callable_id", -1) < 0:
+        return []
+    inputs = {"X": list(op.input("X")),
+              "Out": list(op.output("Out")),
+              "Out@GRAD": [grad_var_name(n)
+                           for n in op.output("Out")]}
+    outputs = {"X@GRAD": [grad_var_name(n) for n in op.input("X")
+                          if n not in no_grad_set]}
+    if not outputs["X@GRAD"]:
+        return []
+    return [Operator(op.block, "py_func_grad", inputs, outputs,
+                     dict(op.attrs))]
+
+
+@register_op("py_func", grad_maker=_py_func_grad_maker)
+def py_func(ctx):
+    fid = ctx.attr("forward_callable_id")
+    fn = _PY_FUNC_REGISTRY[fid]
+    xs = ctx.inputs("X")
+    out_names = ctx.op.output("Out")
+    block = ctx.op.block
+    specs = []
+    for n in out_names:
+        var = block.var(n)
+        shape = tuple(d if d is not None and d >= 0 else
+                      int(xs[0].shape[0]) for d in (var.shape or ()))
+        specs.append(jax.ShapeDtypeStruct(
+            shape, to_jnp_dtype(var.dtype or "float32")))
+
+    def _call(*arrays):
+        out = fn(*arrays)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        return tuple(np.asarray(o).astype(s.dtype).reshape(s.shape)
+                     for o, s in zip(out, specs))
+
+    vals = io_callback(_call, tuple(specs), *xs, ordered=True)
+    return {"Out": list(vals)}
+
+
+@register_op("py_func_grad", differentiable=False)
+def py_func_grad(ctx):
+    bid = ctx.attr("backward_callable_id")
+    fn = _PY_FUNC_REGISTRY[bid]
+    xs = ctx.inputs("X")
+    outs = ctx.inputs("Out")
+    douts = ctx.inputs("Out@GRAD")
+    in_names = ctx.op.input("X")
+    out_names = ctx.op.input("Out")
+    declared = ctx.op.output("X@GRAD")
+    # the maker may have filtered no-grad inputs out of X@GRAD; the
+    # callable still returns one grad per input — keep only declared
+    keep = [i for i, n in enumerate(in_names)
+            if grad_var_name(n) in declared]
+    skip = set(ctx.attr("backward_skip_vars", []) or [])
+    specs = tuple(jax.ShapeDtypeStruct(xs[i].shape, xs[i].dtype)
+                  for i in keep)
+
+    def _call(*arrays):
+        nx = len(xs)
+        no = len(outs)
+        a_x = arrays[:nx]
+        a_out = arrays[nx:nx + no]
+        a_dout = arrays[nx + no:]
+        # reference skip_vars_in_backward_input: the backward callable
+        # receives (x..., out..., dout...) minus the skipped vars
+        args = [a for a, n in zip(a_x, in_names) if n not in skip]
+        args += [a for a, n in zip(a_out, out_names) if n not in skip]
+        args += list(a_dout)
+        gx = fn(*args)
+        if not isinstance(gx, (list, tuple)):
+            gx = (gx,)
+        if len(gx) == len(xs):  # callable returned grads for ALL inputs
+            gx = [gx[i] for i in keep]
+        return tuple(np.asarray(g).astype(s.dtype).reshape(s.shape)
+                     for g, s in zip(gx, specs))
+
+    vals = io_callback(_call, specs, *xs, *outs, *douts, ordered=True)
+    return {"X@GRAD": list(vals)}
+
+
+# ---------------------------------------------------------------------
+def _extract_chunks(seq, scheme, num_types, excluded):
+    """Decode (start, end, type) chunks from a tag sequence (reference
+    chunk_eval_op.h: IOB=2 tags/type {B,I}, IOE=2 {I,E}, IOBES=4
+    {B,I,E,S}, plain=1). Out-of-range tags are 'O'."""
+    chunks = []
+    tags_per = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[scheme]
+    i = 0
+    n = len(seq)
+    while i < n:
+        tag = int(seq[i])
+        if tag < 0 or tag >= num_types * tags_per:
+            i += 1
+            continue
+        ctype = tag // tags_per
+        pos = tag % tags_per
+        start = i
+        counted = True
+        if scheme == "plain":
+            while i + 1 < n and int(seq[i + 1]) == tag:
+                i += 1
+        elif scheme == "IOB":  # B=0, I=1; I continues a B chunk
+            while i + 1 < n and int(seq[i + 1]) == ctype * 2 + 1:
+                i += 1
+        elif scheme == "IOE":  # I=0, E=1; chunk = I* then final E
+            if pos == 0:
+                while i + 1 < n and int(seq[i + 1]) == ctype * 2:
+                    i += 1
+                if i + 1 < n and int(seq[i + 1]) == ctype * 2 + 1:
+                    i += 1  # include the terminating E
+            # pos == 1: lone E is a complete chunk
+        else:  # IOBES: B=0, I=1, E=2, S=3; only B or S start chunks
+            if pos in (1, 2):
+                counted = False  # stray I/E without B: not a chunk
+            elif pos == 0:
+                while (i + 1 < n and int(seq[i + 1]) // 4 == ctype
+                       and int(seq[i + 1]) % 4 in (1, 2)):
+                    i += 1
+                    if int(seq[i]) % 4 == 2:
+                        break
+            # pos == 3 (S): single-token chunk
+        if counted and ctype not in excluded:
+            chunks.append((start, i, ctype))
+        i += 1
+    return set(chunks)
+
+
+@register_op("chunk_eval", differentiable=False)
+def chunk_eval(ctx):
+    """reference chunk_eval_op.cc. Inference/Label: int64 [B, T] padded
+    (lengths via the @SEQ_LEN companion when present, else full T)."""
+    inference = ctx.input("Inference")
+    label = ctx.input("Label")
+    seq_len = ctx.input("SeqLength")
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    num_types = ctx.attr("num_chunk_types")
+    excluded = set(ctx.attr("excluded_chunk_types", []) or [])
+
+    b = inference.shape[0]
+    # int32 counters: jax canonicalizes int64 away without x64 mode
+    specs = (jax.ShapeDtypeStruct((1,), jnp.float32),) * 3 + \
+        (jax.ShapeDtypeStruct((1,), jnp.int32),) * 3
+
+    def _eval(inf, lab, lens):
+        inf = np.asarray(inf).reshape(b, -1)
+        lab = np.asarray(lab).reshape(b, -1)
+        n_inf = n_lab = n_cor = 0
+        for i in range(b):
+            L = int(lens[i]) if lens is not None else inf.shape[1]
+            ci = _extract_chunks(inf[i][:L], scheme, num_types,
+                                 excluded)
+            cl = _extract_chunks(lab[i][:L], scheme, num_types,
+                                 excluded)
+            n_inf += len(ci)
+            n_lab += len(cl)
+            n_cor += len(ci & cl)
+        p = n_cor / n_inf if n_inf else 0.0
+        r = n_cor / n_lab if n_lab else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        i32 = np.int32
+        return (np.asarray([p], np.float32),
+                np.asarray([r], np.float32),
+                np.asarray([f1], np.float32),
+                np.asarray([n_inf], i32), np.asarray([n_lab], i32),
+                np.asarray([n_cor], i32))
+
+    if seq_len is not None:
+        vals = io_callback(_eval, specs, inference, label, seq_len,
+                           ordered=True)
+    else:
+        vals = io_callback(lambda a, c: _eval(a, c, None), specs,
+                           inference, label, ordered=True)
+    p, r, f1, ni, nl, nc = vals
+    return {"Precision": p, "Recall": r, "F1-Score": f1,
+            "NumInferChunks": ni, "NumLabelChunks": nl,
+            "NumCorrectChunks": nc}
+
+
+# ---------------------------------------------------------------------
+_GO_THREADS: List[threading.Thread] = []
+
+
+@register_op("go", differentiable=False)
+def go_op(ctx):
+    """reference csp/go_op.cc: execute the sub-block concurrently
+    (fire-and-forget goroutine). Inputs are snapshot into the thread;
+    the block runs eagerly host-side."""
+    sub_block = ctx.attr("sub_block")
+    names = ctx.op.input("X")
+    vals = ctx.inputs("X")
+
+    def _launch(*arrays):
+        env = {n: np.asarray(a) for n, a in zip(names, arrays)}
+
+        def run():
+            from ..core.registry import run_op
+
+            for op in sub_block.ops:
+                run_op(op, env)
+
+        _GO_THREADS[:] = [x for x in _GO_THREADS if x.is_alive()]
+        t = threading.Thread(target=run, daemon=True)
+        _GO_THREADS.append(t)
+        t.start()
+        return np.int32(0)
+
+    io_callback(_launch, jax.ShapeDtypeStruct((), jnp.int32), *vals,
+                ordered=True)
+    return {}
+
+
+def wait_all_go():
+    """Join all goroutines (test/shutdown helper)."""
+    while _GO_THREADS:
+        _GO_THREADS.pop().join()

@@ -1,0 +1,263 @@
+"""Misc parity module tests: lod_tensor, average, debugger,
+net_drawer, evaluator, install_check, py_func, chunk_eval, Go.
+
+Parity model: reference tests test_lod_tensor.py, test_py_func_op.py,
+test_chunk_eval_op.py, test_install_check.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import average, debugger, lod_tensor, net_drawer
+
+
+def _run(fetches, feed=None):
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed or {}, fetch_list=fetches)
+
+
+class TestLodTensor:
+    def test_create_and_validate(self):
+        t = lod_tensor.create_lod_tensor(
+            np.arange(10).reshape(10, 1).astype(np.float32),
+            [[3, 3, 4]])
+        assert t.has_valid_recursive_sequence_lengths()
+        assert t.lod() == [[0, 3, 6, 10]]
+        assert t.recursive_sequence_lengths() == [[3, 3, 4]]
+
+    def test_invalid_lens_rejected(self):
+        with pytest.raises(AssertionError):
+            lod_tensor.create_lod_tensor(
+                np.zeros((5, 1), np.float32), [[3, 3]])
+
+    def test_from_list(self):
+        t = lod_tensor.create_lod_tensor([[1, 2], [3, 4, 5]],
+                                         [[2, 3]])
+        assert np.asarray(t).shape == (5, 1)
+
+    def test_padded_roundtrip(self):
+        t = lod_tensor.create_lod_tensor(
+            np.arange(7).reshape(7, 1).astype(np.float32), [[3, 4]])
+        padded, lens = lod_tensor.to_padded(t)
+        assert padded.shape == (2, 4, 1)
+        assert lens.tolist() == [3, 4]
+        back = lod_tensor.from_padded(padded, lens)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(t))
+
+    def test_random_int(self):
+        t = lod_tensor.create_random_int_lodtensor(
+            [[2, 3]], [1], None, 0, 9)
+        a = np.asarray(t)
+        assert a.shape == (5, 1) and a.min() >= 0 and a.max() <= 9
+
+
+class TestAverage:
+    def test_weighted(self):
+        wa = average.WeightedAverage()
+        wa.add(1.0, 1)
+        wa.add(3.0, 3)
+        assert wa.eval() == pytest.approx(2.5)
+        wa.reset()
+        with pytest.raises(ValueError):
+            wa.eval()
+
+
+class TestDebugger:
+    def test_program_print_and_dot(self, tmp_path):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(input=x, size=2, act="relu")
+        prog = fluid.default_main_program()
+        text = debugger.pprint_program_codes(prog)
+        assert "mul" in text and "var x" in text
+        dot = debugger.draw_block_graphviz(
+            prog.global_block, path=str(tmp_path / "g.dot"))
+        assert "digraph" in dot and "mul" in dot
+        assert (tmp_path / "g.dot").exists()
+
+    def test_net_drawer(self, tmp_path):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        fluid.layers.fc(input=x, size=2)
+        out = net_drawer.draw_graph(fluid.default_startup_program(),
+                                    fluid.default_main_program(),
+                                    path=str(tmp_path / "n.dot"))
+        assert "digraph" in out
+        g = net_drawer.Graph("T")
+        g.node("a")
+        g.node("b")
+        g.edge("a", "b")
+        assert "a -> b" in str(g)
+
+
+class TestPyFunc:
+    def test_forward_and_backward(self):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              stop_gradient=False)
+        block = fluid.default_main_program().global_block
+        out = block.create_var(name="pyf_out", shape=(-1, 3),
+                               dtype="float32")
+
+        def fwd(a):
+            return np.asarray(a) * 2.0
+
+        def bwd(a, o, do):
+            return np.asarray(do) * 2.0
+
+        fluid.layers.py_func(fwd, x, out, backward_func=bwd)
+        loss = fluid.layers.reduce_sum(out)
+        g, = fluid.gradients(loss, [x])
+        xs = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        o, gx = _run([out, g], {"x": xs})
+        np.testing.assert_allclose(o, xs * 2.0, rtol=1e-6)
+        np.testing.assert_allclose(gx, np.full_like(xs, 2.0),
+                                   rtol=1e-6)
+
+    def test_no_backward_func_stops_grad(self):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              stop_gradient=False)
+        block = fluid.default_main_program().global_block
+        out = block.create_var(name="pyf2_out", shape=(-1, 3),
+                               dtype="float32")
+        fluid.layers.py_func(lambda a: np.asarray(a) + 1, x, out)
+        loss = fluid.layers.reduce_sum(out)
+        g = fluid.gradients(loss, [x])
+        assert g[0] is None
+
+
+class TestChunkEval:
+    def test_perfect_iob(self):
+        # IOB, 1 type: tags B=0, I=1, O=2
+        seq = np.array([[0, 1, 2, 0, 1, 1]], np.int64)
+        inf = fluid.layers.data(name="inf", shape=[6], dtype="int64")
+        lab = fluid.layers.data(name="lab", shape=[6], dtype="int64")
+        p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(
+            inf, lab, chunk_scheme="IOB", num_chunk_types=1)
+        pv, rv, fv, niv, nlv, ncv = _run(
+            [p, r, f1, ni, nl, nc], {"inf": seq, "lab": seq})
+        assert fv[0] == pytest.approx(1.0)
+        assert niv[0] == 2 and nlv[0] == 2 and ncv[0] == 2
+
+    def test_partial_match(self):
+        lab = np.array([[0, 1, 2, 0, 1, 1]], np.int64)
+        inf = np.array([[0, 1, 2, 2, 2, 2]], np.int64)  # 1 of 2 chunks
+        i = fluid.layers.data(name="inf", shape=[6], dtype="int64")
+        l = fluid.layers.data(name="lab", shape=[6], dtype="int64")
+        p, r, f1, *_ = fluid.layers.chunk_eval(
+            i, l, chunk_scheme="IOB", num_chunk_types=1)
+        pv, rv = _run([p, r], {"inf": inf, "lab": lab})
+        assert pv[0] == pytest.approx(1.0)
+        assert rv[0] == pytest.approx(0.5)
+
+
+class TestChunkExtraction:
+    def test_ioe_terminating_e_included(self):
+        from paddle_tpu.ops.host_ops import _extract_chunks
+
+        # I, E (one type): ONE chunk spanning both tokens
+        assert _extract_chunks([0, 1], "IOE", 1, set()) == {(0, 1, 0)}
+        # lone E is a complete chunk
+        assert _extract_chunks([1], "IOE", 1, set()) == {(0, 0, 0)}
+
+    def test_iobes_stray_tags_not_chunks(self):
+        from paddle_tpu.ops.host_ops import _extract_chunks
+
+        assert _extract_chunks([1], "IOBES", 1, set()) == set()  # I
+        assert _extract_chunks([2], "IOBES", 1, set()) == set()  # E
+        assert _extract_chunks([3], "IOBES", 1, set()) == \
+            {(0, 0, 0)}  # S
+        assert _extract_chunks([0, 1, 2], "IOBES", 1, set()) == \
+            {(0, 2, 0)}  # B I E
+
+
+class TestPyFuncMixedInputs:
+    def test_no_grad_input_filtered(self):
+        x = fluid.layers.data(name="x", shape=[3], dtype="float32",
+                              stop_gradient=False)
+        idx = fluid.layers.data(name="idx", shape=[3], dtype="int64")
+        block = fluid.default_main_program().global_block
+        out = block.create_var(name="mix_out", shape=(-1, 3),
+                               dtype="float32")
+
+        def fwd(a, i):
+            return np.asarray(a) * np.asarray(i)
+
+        def bwd(a, i, o, do):
+            return (np.asarray(do) * np.asarray(i),
+                    np.zeros_like(np.asarray(i)))
+
+        fluid.layers.py_func(fwd, [x, idx], out, backward_func=bwd)
+        loss = fluid.layers.reduce_sum(out)
+        g, = fluid.gradients(loss, [x])
+        xs = np.ones((2, 3), np.float32)
+        iv = np.arange(6).reshape(2, 3).astype(np.int64)
+        o, gx = _run([out, g], {"x": xs, "idx": iv})
+        np.testing.assert_allclose(o, xs * iv, rtol=1e-6)
+        np.testing.assert_allclose(gx, iv.astype(np.float32),
+                                   rtol=1e-6)
+
+    def test_skip_vars_in_backward_input(self):
+        x = fluid.layers.data(name="x", shape=[2], dtype="float32",
+                              stop_gradient=False)
+        block = fluid.default_main_program().global_block
+        out = block.create_var(name="sk_out", shape=(-1, 2),
+                               dtype="float32")
+
+        def fwd(a):
+            return np.asarray(a) * 3.0
+
+        def bwd(do):  # x skipped, out skipped -> only dout arrives
+            return np.asarray(do) * 3.0
+
+        fluid.layers.py_func(fwd, x, out, backward_func=bwd,
+                             skip_vars_in_backward_input=[x, out])
+        loss = fluid.layers.reduce_sum(out)
+        g, = fluid.gradients(loss, [x])
+        gx, = _run([g], {"x": np.ones((1, 2), np.float32)})
+        np.testing.assert_allclose(gx, np.full((1, 2), 3.0))
+
+
+class TestEvaluator:
+    def test_chunk_evaluator_accumulates_and_resets(self):
+        from paddle_tpu import evaluator
+
+        inf = fluid.layers.data(name="inf", shape=[6], dtype="int64")
+        lab = fluid.layers.data(name="lab", shape=[6], dtype="int64")
+        ev = evaluator.ChunkEvaluator(inf, lab, chunk_scheme="IOB",
+                                      num_chunk_types=1)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(fluid.default_startup_program())
+        seq = np.array([[0, 1, 2, 0, 1, 1]], np.int64)
+        for _ in range(3):
+            exe.run(feed={"inf": seq, "lab": seq},
+                    fetch_list=[m.name for m in ev.metrics])
+        p, r, f1 = ev.eval(exe)
+        assert f1 == pytest.approx(1.0)
+        ni = float(np.asarray(fluid.global_scope()._get(
+            ev.num_infer_chunks.name)))
+        assert ni == 6  # 2 chunks x 3 steps accumulated
+        ev.reset(exe)
+        assert float(np.asarray(fluid.global_scope()._get(
+            ev.num_infer_chunks.name))) == 0
+
+
+class TestGo:
+    def test_go_runs_sub_block_concurrently(self):
+        from paddle_tpu.ops.host_ops import wait_all_go
+
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        with fluid.layers.Go(inputs=[x]):
+            # side-effecting goroutine: doubles x into a host list
+            import paddle_tpu.layers as L
+
+            y = L.scale(x, scale=2.0)
+        out = fluid.layers.scale(x, scale=3.0)
+        xs = np.ones((2, 4), np.float32)
+        o, = _run([out], {"x": xs})
+        wait_all_go()
+        np.testing.assert_allclose(o, xs * 3.0)
+
+
+class TestInstallCheck:
+    def test_run_check(self, capsys):
+        fluid.install_check.run_check()
+        assert "install check success" in capsys.readouterr().out
