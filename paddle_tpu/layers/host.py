@@ -91,7 +91,16 @@ class Go:
         if exc_type is not None:
             return False
         parent = self._program.current_block()
+        # auto-capture every external read (params, constants...) so
+        # the goroutine's snapshot env is self-contained
+        declared = [v.name for v in self._inputs]
+        produced = set()
+        for op in self._block.ops:
+            for n in op.input_arg_names:
+                if (n not in produced and n not in declared
+                        and n not in self._block.vars):
+                    declared.append(n)
+            produced.update(op.output_arg_names)
         parent.append_op(
-            "go", {"X": [v.name for v in self._inputs]}, {},
-            {"sub_block": self._block})
+            "go", {"X": declared}, {}, {"sub_block": self._block})
         return True

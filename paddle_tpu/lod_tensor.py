@@ -69,7 +69,11 @@ def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
     array (rows = sum of bottom-level lens), a list of lists, or
     another LoDTensor."""
     if isinstance(data, LoDTensor):
-        return LoDTensor(np.asarray(data), recursive_seq_lens)
+        t = LoDTensor(np.asarray(data), recursive_seq_lens)
+        assert t.has_valid_recursive_sequence_lengths(), \
+            "invalid recursive_seq_lens for LoDTensor with %d rows" % \
+            np.asarray(data).shape[0]
+        return t
     if isinstance(data, list):
         flat = [np.asarray(x).reshape(-1, 1) for x in data]
         arr = np.concatenate(flat, axis=0)

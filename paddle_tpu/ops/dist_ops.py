@@ -202,3 +202,31 @@ def listen_and_serv(ctx):
         "the pserver program via transpiler.pserver_runtime."
         "configure_endpoint(...) (the reference equivalent is "
         "listen_and_serv_op.cc RunImpl blocking the process)")
+
+
+@register_op("allreduce", differentiable=False)
+def allreduce(ctx):
+    """Cross-process allreduce (reference distributed_ops/
+    allreduce_op.cc: in-graph ncclAllReduce for nccl2/collective
+    mode). Single process: identity. Multi-process (jax.distributed
+    initialized): the reduction crosses processes through the host
+    bridge — process_allgather rides Gloo on CPU / ICI-DCN on TPU —
+    then averages when reduce_type is mean."""
+    x = ctx.input("X")
+    reduce_type = ctx.attr("reduce_type", "sum")
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return {"Out": x}
+    spec = jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    def _do(v):
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(v)))
+        if reduce_type == "mean":
+            return gathered.mean(axis=0).astype(v.dtype)
+        return gathered.sum(axis=0).astype(v.dtype)
+
+    out = io_callback(_do, spec, x, ordered=True)
+    return {"Out": out}

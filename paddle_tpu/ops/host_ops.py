@@ -58,10 +58,21 @@ def py_func(ctx):
     specs = []
     for n in out_names:
         var = block.var(n)
-        shape = tuple(d if d is not None and d >= 0 else
-                      int(xs[0].shape[0]) for d in (var.shape or ()))
+        dims = list(var.shape or ())
+        shape = []
+        for pos, d in enumerate(dims):
+            if d is not None and d >= 0:
+                shape.append(d)
+            elif pos == 0:  # batch rides along from the first input
+                shape.append(int(xs[0].shape[0]))
+            else:
+                raise ValueError(
+                    f"py_func output {n!r} has unknown non-batch dim "
+                    f"at position {pos} (shape {dims}); XLA needs "
+                    f"static shapes — declare the out var with "
+                    f"concrete trailing dims")
         specs.append(jax.ShapeDtypeStruct(
-            shape, to_jnp_dtype(var.dtype or "float32")))
+            tuple(shape), to_jnp_dtype(var.dtype or "float32")))
 
     def _call(*arrays):
         out = fn(*arrays)
@@ -215,13 +226,15 @@ def chunk_eval(ctx):
 
 # ---------------------------------------------------------------------
 _GO_THREADS: List[threading.Thread] = []
+_GO_ERRORS: List[BaseException] = []
 
 
 @register_op("go", differentiable=False)
 def go_op(ctx):
     """reference csp/go_op.cc: execute the sub-block concurrently
     (fire-and-forget goroutine). Inputs are snapshot into the thread;
-    the block runs eagerly host-side."""
+    the block runs eagerly host-side. Failures are collected and
+    re-raised by wait_all_go()."""
     sub_block = ctx.attr("sub_block")
     names = ctx.op.input("X")
     vals = ctx.inputs("X")
@@ -232,8 +245,11 @@ def go_op(ctx):
         def run():
             from ..core.registry import run_op
 
-            for op in sub_block.ops:
-                run_op(op, env)
+            try:
+                for op in sub_block.ops:
+                    run_op(op, env)
+            except BaseException as e:
+                _GO_ERRORS.append(e)
 
         _GO_THREADS[:] = [x for x in _GO_THREADS if x.is_alive()]
         t = threading.Thread(target=run, daemon=True)
@@ -247,6 +263,8 @@ def go_op(ctx):
 
 
 def wait_all_go():
-    """Join all goroutines (test/shutdown helper)."""
+    """Join all goroutines; re-raises the first goroutine failure."""
     while _GO_THREADS:
         _GO_THREADS.pop().join()
+    if _GO_ERRORS:
+        raise _GO_ERRORS.pop(0)

@@ -108,10 +108,15 @@ class DistributeTranspiler:
         self.startup_program = (startup_program
                                 or default_startup_program())
         if self.config.mode == "collective" or self.config.mode == "nccl2":
-            # nothing to rewrite: record topology; data-parallel pjit
-            # compiles the collectives (reference _transpile_nccl2 :226
-            # appends gen_nccl_id; jax.distributed replaces that)
+            # nccl2-mode parity (reference _transpile_nccl2 :226): each
+            # process runs its own whole graph; an in-graph allreduce
+            # per gradient replaces the reference's ncclAllReduce
+            # (distributed_ops/allreduce_op.cc). jax.distributed owns
+            # the rendezvous gen_nccl_id performed.
             self.trainer_program = self.origin_program
+            self.trainer_startup_program = self.startup_program
+            if trainers > 1:
+                self._insert_collective_allreduce()
             return
 
         self.pserver_endpoints = [e.strip() for e in pservers.split(",")
@@ -165,6 +170,26 @@ class DistributeTranspiler:
         self._build_trainer_startup()
 
     # ------------------------------------------------------------------
+    def _insert_collective_allreduce(self):
+        """Insert allreduce(mean) on every gradient right before the
+        first optimize op (reference multi_devices_graph_pass.cc:542
+        InsertCollectiveOp, at process scope)."""
+        block = self.trainer_program.global_block
+        grad_names = []
+        first_opt = None
+        for i, op in enumerate(block.ops):
+            if op.attr("op_role") == "optimize" and op.input("Grad"):
+                if first_opt is None:
+                    first_opt = i
+                grad_names.append(op.input("Grad")[0])
+        if first_opt is None:
+            return
+        for g in sorted(set(grad_names)):
+            block.insert_op(first_opt, "allreduce",
+                            {"X": [g]}, {"Out": [g]},
+                            {"reduce_type": "mean",
+                             "op_role": "backward"})
+
     def _replace_lookup_table_ops(self):
         """Row-shard each is_distributed embedding table across the
         endpoints (mod-sharding: row r lives on endpoint r % n at local

@@ -1,0 +1,74 @@
+"""Distributed trainer payload (parity: reference tests/unittests/
+dist_mnist.py-style worker sharing TestDistRunnerBase): reads the
+PADDLE_* env contract, joins the jax.distributed coordination service
+(collective/nccl2 mode), trains a deterministic regression model on its
+shard of the global batch with in-graph allreduce(mean) gradient sync,
+and prints one JSON line of per-step losses."""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.parallel.env import init_distributed_env  # noqa: E402
+from paddle_tpu.transpiler import (DistributeTranspiler,  # noqa: E402
+                                   DistributeTranspilerConfig)
+
+STEPS = 6
+GLOBAL_BATCH = 32
+
+
+def global_batches(steps, seed=11):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(16, 1).astype(np.float32)
+    for _ in range(steps):
+        xs = rng.randn(GLOBAL_BATCH, 16).astype(np.float32)
+        ys = xs @ w + 0.05 * rng.randn(GLOBAL_BATCH, 1).astype(
+            np.float32)
+        yield xs, ys
+
+
+def build_model():
+    np.random.seed(90)
+    fluid.seed(90)
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=64, act="relu")
+    pred = fluid.layers.fc(input=h, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def main():
+    env = init_distributed_env()
+    loss = build_model()
+    cfg = DistributeTranspilerConfig()
+    cfg.mode = "collective"
+    t = DistributeTranspiler(cfg)
+    t.transpile(env.trainer_id, trainers=env.num_trainers)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    losses = []
+    shard = GLOBAL_BATCH // env.num_trainers
+    lo = env.trainer_id * shard
+    for xs, ys in global_batches(STEPS):
+        l, = exe.run(t.get_trainer_program(),
+                     feed={"x": xs[lo:lo + shard],
+                           "y": ys[lo:lo + shard]},
+                     fetch_list=[loss.name])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    print("DIST_RESULT " + json.dumps(
+        {"trainer_id": env.trainer_id, "losses": losses}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -111,13 +111,28 @@ class TestTranspileStructure:
                  t.get_trainer_program().global_block.ops]
         assert "split_byref" in types and "concat" in types
 
-    def test_collective_mode_keeps_program(self):
+    def test_collective_mode_inserts_allreduce(self):
+        _build_model()
+        cfg = DistributeTranspilerConfig()
+        cfg.mode = "collective"
+        t = DistributeTranspiler(cfg)
+        t.transpile(0, trainers=4)
+        types = [op.type for op in
+                 t.get_trainer_program().global_block.ops]
+        # one allreduce per gradient (4 params: 2 fc layers w+b),
+        # placed before the first optimize op
+        assert types.count("allreduce") == 4
+        first_opt = types.index("sgd")
+        assert all(i < first_opt for i, tt in enumerate(types)
+                   if tt == "allreduce")
+
+    def test_collective_single_trainer_untouched(self):
         _build_model()
         before = len(fluid.default_main_program().global_block.ops)
         cfg = DistributeTranspilerConfig()
         cfg.mode = "collective"
         t = DistributeTranspiler(cfg)
-        t.transpile(0, trainers=4)
+        t.transpile(0, trainers=1)
         assert len(t.get_trainer_program().global_block.ops) == before
 
 
