@@ -128,6 +128,25 @@ def _declare(lib):
     lib.ptp_lod_offsets_to_segment_ids.argtypes = [
         c.POINTER(c.c_int64), c.c_size_t, c.POINTER(c.c_size_t)]
     lib.ptp_lod_offsets_to_segment_ids.restype = c.c_void_p
+
+    lib.ptp_multislot_parse.argtypes = [c.c_char_p, c.c_size_t,
+                                        c.c_char_p]
+    lib.ptp_multislot_parse.restype = c.c_void_p
+    lib.ptp_multislot_num_slots.argtypes = [c.c_void_p]
+    lib.ptp_multislot_num_slots.restype = c.c_int
+    lib.ptp_multislot_slot_name.argtypes = [c.c_void_p, c.c_int]
+    lib.ptp_multislot_slot_name.restype = c.c_char_p
+    lib.ptp_multislot_slot_info.argtypes = [
+        c.c_void_p, c.c_int, c.POINTER(c.c_int), c.POINTER(c.c_int),
+        c.POINTER(c.c_int), c.POINTER(c.c_int)]
+    lib.ptp_multislot_slot_info.restype = c.c_int
+    lib.ptp_multislot_ints.argtypes = [c.c_void_p, c.c_int]
+    lib.ptp_multislot_ints.restype = c.POINTER(c.c_int64)
+    lib.ptp_multislot_floats.argtypes = [c.c_void_p, c.c_int]
+    lib.ptp_multislot_floats.restype = c.POINTER(c.c_float)
+    lib.ptp_multislot_lengths.argtypes = [c.c_void_p, c.c_int]
+    lib.ptp_multislot_lengths.restype = c.POINTER(c.c_int32)
+    lib.ptp_multislot_destroy.argtypes = [c.c_void_p]
     return lib
 
 

@@ -14,6 +14,7 @@
 #include "analysis.h"
 #include "json.h"
 #include "lod.h"
+#include "multislot.h"
 #include "program.h"
 #include "recordio.h"
 #include "scope.h"
@@ -293,5 +294,71 @@ int64_t* ptp_lod_offsets_to_segment_ids(const int64_t* offsets, size_t n,
   memcpy(buf, res.data(), res.size() * 8);
   return buf;
 }
+
+// ----------------------------------------------------------- multislot
+// slot_spec: '\n'-separated "name,flags" entries; flags chars:
+// f=float, d=dense (absent: sparse uint64)
+void* ptp_multislot_parse(const char* text, size_t len,
+                          const char* slot_spec) {
+  std::vector<ptp::SlotSpec> slots;
+  for (auto& entry : splitNames(slot_spec)) {
+    ptp::SlotSpec s;
+    auto comma = entry.find(',');
+    s.name = entry.substr(0, comma);
+    if (comma != std::string::npos) {
+      for (char c : entry.substr(comma + 1)) {
+        if (c == 'f') s.is_float = true;
+        if (c == 'd') s.is_dense = true;
+        if (c == 'u') s.is_used = false;
+      }
+    }
+    slots.push_back(std::move(s));
+  }
+  try {
+    auto* out = new std::vector<ptp::SlotBatch>(
+        ptp::ParseMultiSlotBatch(text, len, slots));
+    return out;
+  } catch (const std::exception& e) {
+    g_error = e.what();
+    return nullptr;
+  }
+}
+
+static std::vector<ptp::SlotBatch>* asBatches(void* h) {
+  return static_cast<std::vector<ptp::SlotBatch>*>(h);
+}
+
+int ptp_multislot_num_slots(void* h) {
+  return static_cast<int>(asBatches(h)->size());
+}
+
+const char* ptp_multislot_slot_name(void* h, int i) {
+  return (*asBatches(h))[i].name.c_str();
+}
+
+int ptp_multislot_slot_info(void* h, int i, int* batch, int* width,
+                            int* is_float, int* is_dense) {
+  auto& sb = (*asBatches(h))[i];
+  *batch = sb.batch;
+  *width = sb.width;
+  *is_float = sb.is_float ? 1 : 0;
+  *is_dense = sb.is_dense ? 1 : 0;
+  return 0;
+}
+
+const int64_t* ptp_multislot_ints(void* h, int i) {
+  return (*asBatches(h))[i].ints.data();
+}
+
+const float* ptp_multislot_floats(void* h, int i) {
+  return (*asBatches(h))[i].floats.data();
+}
+
+const int* ptp_multislot_lengths(void* h, int i) {
+  return reinterpret_cast<const int*>(
+      (*asBatches(h))[i].lengths.data());
+}
+
+void ptp_multislot_destroy(void* h) { delete asBatches(h); }
 
 }  // extern "C"

@@ -1,0 +1,168 @@
+#include "multislot.h"
+
+#include <cstdlib>
+#include <cstring>
+#include <stdexcept>
+
+namespace ptp {
+namespace {
+
+// in-place tokenizing cursor over one line
+struct Cursor {
+  const char* p;
+  const char* end;
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t')) ++p;
+  }
+
+  bool done() {
+    skip_ws();
+    return p >= end;
+  }
+
+  // parse next whitespace-delimited token as long/double
+  bool next_long(int64_t* out) {
+    skip_ws();
+    if (p >= end) return false;
+    char* q = nullptr;
+    *out = std::strtoll(p, &q, 10);
+    if (q == p) return false;
+    p = q;
+    return true;
+  }
+
+  bool next_float(float* out) {
+    skip_ws();
+    if (p >= end) return false;
+    char* q = nullptr;
+    *out = std::strtof(p, &q);
+    if (q == p) return false;
+    p = q;
+    return true;
+  }
+};
+
+int pow2_at_least(int v) {
+  int b = 4;
+  while (b < v) b *= 2;
+  return b;
+}
+
+}  // namespace
+
+std::vector<SlotBatch> ParseMultiSlotBatch(
+    const char* text, size_t len, const std::vector<SlotSpec>& slots) {
+  // first pass: tokenize all samples into ragged per-slot values
+  struct Sample {
+    std::vector<std::vector<int64_t>> ints;
+    std::vector<std::vector<float>> floats;
+  };
+  std::vector<Sample> samples;
+  const char* p = text;
+  const char* end = text + len;
+  int line_no = 0;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* line_end = nl ? nl : end;
+    ++line_no;
+    Cursor cur{p, line_end};
+    p = nl ? nl + 1 : end;
+    if (cur.done()) continue;  // blank line
+    Sample s;
+    s.ints.resize(slots.size());
+    s.floats.resize(slots.size());
+    for (size_t si = 0; si < slots.size(); ++si) {
+      int64_t n = 0;
+      if (!cur.next_long(&n) || n < 0) {
+        throw std::runtime_error(
+            "MultiSlot parse error: line " + std::to_string(line_no) +
+            " ended before slot '" + slots[si].name + "'");
+      }
+      if (slots[si].is_float) {
+        auto& v = s.floats[si];
+        v.reserve(static_cast<size_t>(n));
+        float f;
+        for (int64_t i = 0; i < n; ++i) {
+          if (!cur.next_float(&f)) {
+            throw std::runtime_error(
+                "MultiSlot parse error: slot '" + slots[si].name +
+                "' declares " + std::to_string(n) + " values, found " +
+                std::to_string(i));
+          }
+          v.push_back(f);
+        }
+      } else {
+        auto& v = s.ints[si];
+        v.reserve(static_cast<size_t>(n));
+        int64_t x;
+        for (int64_t i = 0; i < n; ++i) {
+          if (!cur.next_long(&x)) {
+            throw std::runtime_error(
+                "MultiSlot parse error: slot '" + slots[si].name +
+                "' declares " + std::to_string(n) + " values, found " +
+                std::to_string(i));
+          }
+          v.push_back(x);
+        }
+      }
+    }
+    samples.push_back(std::move(s));
+  }
+
+  // second pass: batch
+  std::vector<SlotBatch> out;
+  const int b = static_cast<int>(samples.size());
+  for (size_t si = 0; si < slots.size(); ++si) {
+    const SlotSpec& spec = slots[si];
+    if (!spec.is_used) continue;
+    SlotBatch sb;
+    sb.name = spec.name;
+    sb.batch = b;
+    sb.is_float = spec.is_float;
+    sb.is_dense = spec.is_dense;
+    if (spec.is_float || spec.is_dense) {
+      int width = 0;
+      for (auto& s : samples) {
+        int w = static_cast<int>(spec.is_float ? s.floats[si].size()
+                                               : s.ints[si].size());
+        if (w > width) width = w;
+      }
+      sb.width = width < 1 ? 1 : width;
+      if (spec.is_float) {
+        sb.floats.assign(static_cast<size_t>(b) * sb.width, 0.f);
+        for (int i = 0; i < b; ++i)
+          memcpy(&sb.floats[static_cast<size_t>(i) * sb.width],
+                 samples[i].floats[si].data(),
+                 samples[i].floats[si].size() * sizeof(float));
+      } else {
+        sb.ints.assign(static_cast<size_t>(b) * sb.width, 0);
+        for (int i = 0; i < b; ++i)
+          memcpy(&sb.ints[static_cast<size_t>(i) * sb.width],
+                 samples[i].ints[si].data(),
+                 samples[i].ints[si].size() * sizeof(int64_t));
+      }
+    } else {
+      int maxlen = 1;
+      sb.lengths.resize(static_cast<size_t>(b));
+      for (int i = 0; i < b; ++i) {
+        int l = static_cast<int>(samples[i].ints[si].size());
+        sb.lengths[static_cast<size_t>(i)] = l;
+        if (l > maxlen) maxlen = l;
+      }
+      // pow2 bucketing keeps the executor's shape-keyed jit cache
+      // small (mirrors python data_feed.py)
+      sb.width = pow2_at_least(maxlen);
+      sb.ints.assign(static_cast<size_t>(b) * sb.width, 0);
+      for (int i = 0; i < b; ++i)
+        memcpy(&sb.ints[static_cast<size_t>(i) * sb.width],
+               samples[i].ints[si].data(),
+               samples[i].ints[si].size() * sizeof(int64_t));
+    }
+    out.push_back(std::move(sb));
+  }
+  return out;
+}
+
+}  // namespace ptp

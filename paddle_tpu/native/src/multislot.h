@@ -1,0 +1,40 @@
+// MultiSlot text parser (native equivalent of the reference's C++
+// MultiSlotDataFeed, paddle/fluid/framework/data_feed.cc
+// ParseOneInstance): parses "count v1 .. vcount" slot groups per line
+// and batches sparse int slots into padded int64 arrays with length
+// companions. The hot loop the Python MultiSlotDataFeed pays per CTR
+// sample lives here in C++.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace ptp {
+
+struct SlotSpec {
+  std::string name;
+  bool is_float = false;
+  bool is_dense = false;
+  bool is_used = true;
+};
+
+struct SlotBatch {
+  std::string name;
+  // padded int64 [batch, maxlen] for sparse; dense stacks row-major
+  std::vector<int64_t> ints;
+  std::vector<float> floats;
+  std::vector<int32_t> lengths;  // per-sample lengths (sparse only)
+  int batch = 0;
+  int width = 0;  // maxlen (sparse, pow2-bucketed) or dense dim
+  bool is_float = false;
+  bool is_dense = false;
+};
+
+// Parse up to `max_lines` lines from text; returns per-used-slot
+// batches. Throws std::runtime_error with a clear message on
+// malformed input (slot count mismatch). Lines must be complete.
+std::vector<SlotBatch> ParseMultiSlotBatch(
+    const char* text, size_t len, const std::vector<SlotSpec>& slots);
+
+}  // namespace ptp
