@@ -132,8 +132,11 @@ class CompiledProgram:
                 arr = arr[: (arr.shape[0] // ndev) * ndev]
             feed_arrays[name] = arr
             feed_specs.append((name, arr.shape, str(arr.dtype)))
+        from .. import amp
+
         key = (id(self._program), self._program._version,
-               tuple(sorted(feed_specs)), tuple(fetch_names), ndev)
+               tuple(sorted(feed_specs)), tuple(fetch_names), ndev,
+               amp.state_token())
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._compile(block, tuple(sorted(feed_arrays)),

@@ -182,6 +182,72 @@ def _build_step_fn(block, feed_names, mutated, const, state_out,
     return step
 
 
+def _default_layout_specs(step, scope, mutated, const, feed_arrays,
+                          place):
+    """Pin the executor's jit boundary so state layouts stay stable.
+
+    Left to itself, jax compiles each block's entry layouts to match the
+    FIRST call's argument layouts, while XLA freely picks different
+    layouts for the results. Mutated state then comes back in a layout
+    the executable was not compiled for, and EVERY subsequent call
+    re-lays-out those buffers outside the program -- on a tunneled TPU
+    that is a host round-trip per buffer per step, which buried
+    ResNet-50 (266 state vars) under ~25x pure relayout traffic.
+
+    Fix: pin entry layouts to the layouts the scope arrays have NOW
+    (what the first call would have used anyway), and pin each cycled
+    state OUTPUT to its own input's layout, so state arrays flow
+    through repeated steps byte-identical in layout and donation
+    aliases cleanly. Everything else (fetches, fresh persistables, rng)
+    stays compiler-chosen via an unconstrained Format() -- never force
+    row-major: XLA tiles the two minor dims, so row-major [O,I,3,3]
+    conv weights would pad ~100x in HBM.
+
+    Returns (in_shardings, out_shardings), or None to fall back to
+    plain jit (state not yet materialized, non-addressable arrays...).
+    """
+    try:
+        from jax.experimental.layout import Format, Layout
+        from jax.sharding import SingleDeviceSharding
+    except Exception:
+        return None
+    try:
+        dev = place.device()
+    except Exception:
+        return None
+
+    def fmt_of(x):
+        f = getattr(x, "format", None)
+        if f is not None and f.layout is not None:
+            return f  # jax array: keep the layout it already has
+        nd = len(getattr(x, "shape", ()))
+        return Format(Layout(tuple(range(nd))), SingleDeviceSharding(dev))
+
+    mut_ex = {n: scope._get(n) for n in mutated}
+    const_ex = {n: scope._get(n) for n in const}
+    if any(v is None for v in mut_ex.values()) or \
+            any(v is None for v in const_ex.values()):
+        return None  # run() raises the friendly init error
+    feeds_ex = dict(feed_arrays or {})
+    rng_ex = scope._get(RNG_VAR)
+    if rng_ex is None:
+        rng_ex = jax.random.PRNGKey(0)
+    args = (mut_ex, const_ex, feeds_ex, rng_ex)
+    try:
+        out_shape = jax.eval_shape(step, *args)
+        in_fmts = jax.tree.map(fmt_of, args)
+        new_state_shape, fetches_shape, rng_shape = out_shape
+        out_fmts = (
+            {n: (fmt_of(mut_ex[n]) if n in mut_ex else Format())
+             for n in new_state_shape},
+            [Format() for _ in fetches_shape],
+            Format(),
+        )
+    except Exception:
+        return None
+    return in_fmts, out_fmts
+
+
 def _var_np_dtype(block, name, default=np.float32):
     v = block._find_var_recursive(name)
     if v is None or v.dtype is None:
@@ -226,39 +292,50 @@ class Executor:
                     f"fetch target {name!r} does not exist in the "
                     f"program")
 
+        try:
+            device = self.place.device()
+        except Exception:
+            device = None
         feed_arrays = {}
         feed_specs = []
         for name, val in feed.items():
             arr = _coerce_feed(val, _var_np_dtype(block, name))
-            feed_arrays[name] = arr
             feed_specs.append((name, arr.shape, str(arr.dtype)))
+            # Explicit transfer instead of passing numpy into the jitted
+            # call: the PJRT argument-upload path can be far slower than
+            # device_put for incompressible data (50x on a tunneled TPU).
+            if device is not None and not isinstance(arr, jax.Array):
+                arr = jax.device_put(arr, device)
+            feed_arrays[name] = arr
+
+        from .. import amp
 
         key = (id(program), program._version, tuple(sorted(feed_specs)),
-               tuple(fetch_names))
+               tuple(fetch_names), amp.state_token())
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = self._compile(program, block,
                                      tuple(sorted(feed_arrays)),
-                                     fetch_names, scope)
+                                     fetch_names, scope,
+                                     feed_arrays=feed_arrays)
             if use_program_cache:
                 self._cache[key] = compiled
 
-        mut = {}
-        for n in compiled.state_in:
+        def _state_val(n):
             v = scope._get(n)
             if v is None:
                 raise RuntimeError(
                     f"Variable {n!r} is used before initialization -- run "
                     f"the startup program first")
-            mut[n] = v
-        const_st = {}
-        for n in compiled.const_in:
-            v = scope._get(n)
-            if v is None:
-                raise RuntimeError(
-                    f"Variable {n!r} is used before initialization -- run "
-                    f"the startup program first")
-            const_st[n] = v
+            if device is not None and not isinstance(v, jax.Array):
+                # same slow-upload avoidance as feeds; cache the device
+                # copy so the transfer happens once, not per step
+                v = jax.device_put(np.asarray(v), device)
+                scope._set(n, v)
+            return v
+
+        mut = {n: _state_val(n) for n in compiled.state_in}
+        const_st = {n: _state_val(n) for n in compiled.const_in}
         rng = scope._get(RNG_VAR)
         if rng is None:
             prog_seed = getattr(program, "_seed", None)
@@ -274,13 +351,21 @@ class Executor:
         return list(fetches)
 
     # ------------------------------------------------------------------
-    def _compile(self, program, block, feed_names, fetch_names, scope):
+    def _compile(self, program, block, feed_names, fetch_names, scope,
+                 feed_arrays=None):
         mutated, const, state_out = _analyze_block(
             block, feed_names, fetch_names)
         step = _build_step_fn(block, feed_names, mutated, const, state_out,
                               fetch_names)
-        jitted = jax.jit(step,
-                         donate_argnums=(0,) if self.donate else ())
+        donate = (0,) if self.donate else ()
+        layouts = _default_layout_specs(
+            step, scope, mutated, const, feed_arrays, self.place)
+        if layouts is not None:
+            jitted = jax.jit(step, donate_argnums=donate,
+                             in_shardings=layouts[0],
+                             out_shardings=layouts[1])
+        else:
+            jitted = jax.jit(step, donate_argnums=donate)
         return _CompiledBlock(jitted, feed_names, mutated, const, state_out,
                               fetch_names)
 
@@ -311,6 +396,10 @@ def _coerce_feed(val, np_dtype):
         # (data, lod) legacy feed -- LoD handled by sequence ops via
         # explicit segment inputs; dense part fed here.
         val = val[0]
+    if isinstance(val, jax.Array):
+        # already device-resident (e.g. a reader that pre-transfers);
+        # keep it -- re-materializing via numpy would force a d2h+h2d
+        return val
     arr = np.asarray(val)
     if np_dtype is not None and arr.dtype != np_dtype \
             and np.issubdtype(arr.dtype, np.floating) \

@@ -171,6 +171,10 @@ def run_op(op: Operator, env: Dict, rng_cell=None, rng_salt=0) -> None:
             else:
                 vals.append(env[n])
         inputs[slot] = vals
+    from .. import amp
+
+    if amp.enabled():
+        inputs = amp.cast_op_inputs(op.type, inputs)
     ctx = OpContext(op, inputs, rng_cell=rng_cell, rng_salt=rng_salt)
     raw = info.kernel(ctx)
     outs = _normalize_outputs(op, raw)

@@ -360,7 +360,8 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
 # ---------------------------------------------------------------------------
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, numeric_stable_mode=True,
-                               return_softmax=False, axis=-1):
+                               return_softmax=False, axis=-1,
+                               label_smooth_eps=0.0):
     helper = LayerHelper("softmax_with_cross_entropy", input=logits)
     loss = helper.create_variable_for_type_inference(logits.dtype)
     sm = helper.create_variable_for_type_inference(logits.dtype)
@@ -368,7 +369,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
                      {"Logits": logits, "Label": label},
                      {"Loss": loss, "Softmax": sm},
                      {"soft_label": soft_label,
-                      "ignore_index": ignore_index})
+                      "ignore_index": ignore_index,
+                      "label_smooth_eps": label_smooth_eps})
     if return_softmax:
         return loss, sm
     return loss

@@ -118,14 +118,11 @@ def transformer(src_ids, tgt_ids, label, src_vocab=30000, tgt_vocab=30000,
                             dropout_rate, is_test)
     logits = layers.fc(dec, tgt_vocab, num_flatten_dims=2,
                        bias_attr=False)
-    if label_smooth_eps:
-        onehot = layers.one_hot(label, tgt_vocab)
-        soft = layers.label_smooth(onehot, epsilon=label_smooth_eps)
-        cost = layers.softmax_with_cross_entropy(logits, soft,
-                                                 soft_label=True)
-    else:
-        cost = layers.softmax_with_cross_entropy(
-            logits, layers.unsqueeze(label, [2]))
+    # fused smoothing: same math as one_hot+label_smooth+soft-label CE
+    # but never materializes the [B,T,V] one-hot (HBM-bound at 32k vocab)
+    cost = layers.softmax_with_cross_entropy(
+        logits, layers.unsqueeze(label, [2]),
+        label_smooth_eps=label_smooth_eps)
     avg_cost = layers.mean(cost)
     return avg_cost, logits
 

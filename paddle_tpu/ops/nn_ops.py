@@ -347,15 +347,19 @@ def log_softmax(ctx):
 
 
 def _swce_grad_maker(op, no_grad_set=frozenset()):
-    """Fused grad using saved Softmax (reference
-    softmax_with_cross_entropy_op.cu backward)."""
+    """Fused grad recomputed from saved Logits (reference
+    softmax_with_cross_entropy_op.cu backward keeps the softmax tensor;
+    recomputing it from logits trades cheap VPU FLOPs for the [N,V]
+    probability buffer -- with a 32k vocab that buffer dominates HBM, so
+    this is the TPU-right choice and lets XLA dead-code the unfetched
+    Softmax output entirely)."""
     from ..core.registry import is_registered, register_op as _reg
 
     if not is_registered("softmax_with_cross_entropy_grad"):
         _reg("softmax_with_cross_entropy_grad", differentiable=False)(
             _swce_grad_kernel)
     inputs = {
-        "Softmax": op.outputs["Softmax"],
+        "Logits": op.inputs["Logits"],
         "Label": op.inputs["Label"],
         "Loss@GRAD": [grad_var_name(n) for n in op.outputs["Loss"]],
     }
@@ -366,37 +370,51 @@ def _swce_grad_maker(op, no_grad_set=frozenset()):
 
 
 def _swce_grad_kernel(ctx):
-    softmax_out = ctx.input("Softmax")
+    logits = ctx.input("Logits")
     label = ctx.input("Label")
+    softmax_out = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     dloss = ctx.input("Loss@GRAD")
     if dloss is None:
         dloss = jnp.ones(softmax_out.shape[:-1] + (1,),
                          dtype=softmax_out.dtype)
+    eps = ctx.attr("label_smooth_eps", 0.0)
+    vocab = softmax_out.shape[-1]
     if ctx.attr("soft_label", False):
-        grad = softmax_out - label
+        target = label.astype(softmax_out.dtype)
     else:
         lab = label.astype(jnp.int32)
         if lab.ndim == softmax_out.ndim:
             lab = lab[..., 0]
-        onehot = jax.nn.one_hot(lab, softmax_out.shape[-1],
-                                dtype=softmax_out.dtype)
-        grad = softmax_out - onehot
-    return {"Logits@GRAD": grad * dloss}
+        target = jax.nn.one_hot(lab, vocab, dtype=softmax_out.dtype)
+    if eps:
+        target = target * (1.0 - eps) + eps / vocab
+    grad = (softmax_out - target) * dloss
+    return {"Logits@GRAD": grad.astype(logits.dtype)}
 
 
 @register_op("softmax_with_cross_entropy", grad_maker=_swce_grad_maker)
 def softmax_with_cross_entropy(ctx):
     logits = ctx.input("Logits")
     label = ctx.input("Label")
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     sm = jnp.exp(logp)
+    eps = ctx.attr("label_smooth_eps", 0.0)
     if ctx.attr("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+        if eps:
+            vocab = logits.shape[-1]
+            uniform = -jnp.mean(logp, axis=-1, keepdims=True)
+            loss = (1.0 - eps) * loss + eps * uniform
     else:
         lab = label.astype(jnp.int32)
         if lab.ndim == logits.ndim:
             lab = lab[..., 0]
         loss = -jnp.take_along_axis(logp, lab[..., None], axis=-1)
+        if eps:
+            # smoothed target (1-eps)*onehot + eps/V without materializing
+            # the [N,V] one-hot: sum_j(-logp_j)/V = lse - mean(logits)
+            uniform = -jnp.mean(logp, axis=-1, keepdims=True)
+            loss = (1.0 - eps) * loss + eps * uniform
     return {"Loss": loss, "Softmax": sm}
 
 

@@ -1,4 +1,4 @@
-"""Flash attention Pallas TPU kernel.
+"""Flash attention Pallas TPU kernels (forward AND backward).
 
 The hot path of the Transformer benchmark (BASELINE.md config 3). Online-
 softmax tiling keeps the full [Tq,Tk] logits matrix out of HBM: per
@@ -6,16 +6,21 @@ softmax tiling keeps the full [Tq,Tk] logits matrix out of HBM: per
 carrying running max/denominator -- the standard flash pattern expressed
 in Pallas (see /opt/skills/guides/pallas_guide.md).
 
-Differentiation: pallas_call has no autodiff rule, so flash_attention is
-a jax.custom_vjp whose backward is the jnp composition (fully fused by
-XLA); a Pallas backward kernel is a later optimization. Both paths use
-BOTTOM-RIGHT causal alignment (query i sees keys <= i + tk - tq), the
-same convention as the jnp fallback in ops/nn_ops.py, so kernel/fallback
-numerics agree for tq != tk.
+Backward: the forward additionally writes the per-row logsumexp; the
+backward recomputes attention probabilities blockwise from (q, k, lse)
+and accumulates dq in one kernel (grid over q-blocks) and dk/dv in a
+second (grid over k-blocks) -- the FlashAttention-2 recipe. Residuals
+are q, k, v, out, lse: O(T) extra memory instead of the O(T^2)
+probability matrix, and no jnp fallback on the grad path.
+
+Both directions use BOTTOM-RIGHT causal alignment (query i sees keys
+<= i + tk - tq), the same convention as the jnp fallback in
+ops/nn_ops.py, so kernel/fallback numerics agree for tq != tk.
 
 Block sizes adapt to the sequence length (min(t, 256) when divisible),
-so the kernel engages for seq-128 benchmark shapes, not just multiples
-of 256.
+so the kernels engage for seq-128 benchmark shapes, not just multiples
+of 256. `force_interpret(True)` runs every pallas_call in interpreter
+mode so CPU tests can exercise the real kernel code paths.
 """
 from __future__ import annotations
 
@@ -26,6 +31,17 @@ import jax.numpy as jnp
 import numpy as np
 
 _MAX_BLOCK = 256
+
+_INTERPRET = [False]
+
+
+def force_interpret(on: bool = True) -> None:
+    """Run kernels in pallas interpreter mode (CPU testing)."""
+    _INTERPRET[0] = bool(on)
+
+
+def _interp() -> bool:
+    return _INTERPRET[0]
 
 
 def _pick_block(t: int) -> int:
@@ -38,7 +54,7 @@ def _pick_block(t: int) -> int:
 def usable(q, k, v) -> bool:
     from . import on_tpu
 
-    if not on_tpu():
+    if not (on_tpu() or _interp()):
         return False
     b, h, tq, d = q.shape
     tk = k.shape[2]
@@ -49,26 +65,18 @@ def usable(q, k, v) -> bool:
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def flash_attention(q, k, v, scale=1.0, causal=False):
     """q,k,v: [B,H,T,D] -> [B,H,T,D]."""
-    return _flash_fwd_impl(q, k, v, scale, causal)
-
-
-def _reference_attention(q, k, v, scale, causal):
-    from . import reference_attention
-
-    return reference_attention(q, k, v, scale, causal)
+    out, _ = _flash_fwd_impl(q, k, v, scale, causal)
+    return out
 
 
 def _flash_fwd(q, k, v, scale, causal):
-    out = _flash_fwd_impl(q, k, v, scale, causal)
-    return out, (q, k, v)
+    out, lse = _flash_fwd_impl(q, k, v, scale, causal)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_attention(q_, k_, v_, scale,
-                                                causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(q, k, v, out, lse, g, scale, causal)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -89,7 +97,7 @@ def _flash_fwd_impl(q, k, v, scale, causal):
     grid = (bh, tq // block_q)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                tq=tq, tk=tk, block_k=block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -97,14 +105,23 @@ def _flash_fwd_impl(q, k, v, scale, causal):
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            # lse rides as (bh, 1, tq): sublane dim 1 == array dim, lane
+            # dim block_q is 128-divisible (TPU BlockSpec constraint)
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, tq), jnp.float32),
+        ],
+        interpret=_interp(),
     )(q3, k3, v3)
-    return out.reshape(b, h, tq, d)
+    return out.reshape(b, h, tq, d), lse.reshape(b, h, tq)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, tq, tk,
-                block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                tq, tk, block_k):
     from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
@@ -145,3 +162,151 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, tq, tk,
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m, l, acc))
     safe_l = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    # lse = m + log(l); -inf for fully-masked rows (p will be 0 in bwd)
+    lse_ref[0, 0] = jnp.where(l > 0.0, m + jnp.log(safe_l), -jnp.inf)
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2): dq over q-blocks, dk/dv over k-blocks
+# ---------------------------------------------------------------------------
+def _flash_bwd_impl(q, k, v, out, lse, g, scale, causal):
+    from jax.experimental import pallas as pl
+
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_q = _pick_block(tq)
+    block_k = _pick_block(tk)
+    bh = b * h
+    q3 = q.reshape(bh, tq, d)
+    k3 = k.reshape(bh, tk, d)
+    v3 = v.reshape(bh, tk, d)
+    g3 = g.reshape(bh, tq, d)
+    lse3 = lse.reshape(bh, 1, tq)
+    # delta_i = rowsum(dO_i * O_i); tiny elementwise+reduce, XLA fuses
+    delta = jnp.sum(g3.astype(jnp.float32)
+                    * out.reshape(bh, tq, d).astype(jnp.float32),
+                    axis=-1).reshape(bh, 1, tq)
+
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale,
+                                  causal=causal, tq=tq, tk=tk,
+                                  block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
+        interpret=_interp(),
+    )(q3, k3, v3, g3, lse3, delta)
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   causal=causal, tq=tq, tk=tk,
+                                   block_q=block_q)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, tq, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
+        ],
+        interpret=_interp(),
+    )(q3, k3, v3, g3, lse3, delta)
+
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, causal, tq, tk, block_k):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)          # [BQ, D]
+    do = do_ref[0].astype(jnp.float32)        # [BQ, D]
+    lse = lse_ref[0, 0]                       # [BQ]
+    delta = delta_ref[0, 0]                   # [BQ]
+    block_q = q.shape[0]
+    qi = pl.program_id(1)
+    offset = tk - tq
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)[:, None]
+    dq = jnp.zeros(q.shape, dtype=jnp.float32)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.dslice(kb * block_k, block_k)].astype(
+            jnp.float32)
+        v_blk = v_ref[0, pl.dslice(kb * block_k, block_k)].astype(
+            jnp.float32)
+        s = (q @ k_blk.T) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + offset >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse_safe), 0.0)
+        dp = do @ v_blk.T                     # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + ds @ k_blk
+
+    n_blocks = tk // block_k
+    dq = jax.lax.fori_loop(0, n_blocks, body, dq)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, tq, tk, block_q):
+    from jax.experimental import pallas as pl
+
+    k = k_ref[0].astype(jnp.float32)          # [BK, D]
+    v = v_ref[0].astype(jnp.float32)          # [BK, D]
+    block_k = k.shape[0]
+    ki = pl.program_id(1)
+    offset = tk - tq
+    dk = jnp.zeros(k.shape, dtype=jnp.float32)
+    dv = jnp.zeros(v.shape, dtype=jnp.float32)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.dslice(qb * block_q, block_q)].astype(
+            jnp.float32)
+        do_blk = do_ref[0, pl.dslice(qb * block_q, block_q)].astype(
+            jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.dslice(qb * block_q, block_q)]
+        delta_blk = delta_ref[0, 0, pl.dslice(qb * block_q, block_q)]
+        lse_safe = jnp.where(jnp.isfinite(lse_blk), lse_blk, 0.0)[:, None]
+        s = (q_blk @ k.T) * scale             # [BQ, BK]
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + offset >= k_pos, s, -jnp.inf)
+        p = jnp.where(jnp.isfinite(s), jnp.exp(s - lse_safe), 0.0)
+        dv = dv + p.T @ do_blk
+        dp = do_blk @ v.T                     # [BQ, BK]
+        ds = p * (dp - delta_blk[:, None]) * scale
+        dk = dk + ds.T @ q_blk
+        return dk, dv
+
+    n_blocks = tq // block_q
+    dk, dv = jax.lax.fori_loop(0, n_blocks, body, (dk, dv))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)

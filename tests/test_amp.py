@@ -1,0 +1,135 @@
+"""bf16 automatic-mixed-precision tests.
+
+AMP is the TPU-native answer to the fp32-everywhere reference: WHITE
+(MXU) ops compute in bf16 with fp32 master params, BLACK (softmax/norm/
+optimizer) ops stay fp32. No GradScaler -- bf16 keeps fp32's exponent.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import amp, layers
+
+
+def _mnist_like_program(hidden=32):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[16], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        h = layers.fc(x, hidden, act="relu")
+        logits = layers.fc(h, 4)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _separable_batch(n=64, seed=0):
+    r = np.random.RandomState(seed)
+    y = r.randint(0, 4, (n, 1)).astype(np.int64)
+    x = r.randn(n, 16).astype(np.float32) * 0.1
+    x[np.arange(n), y[:, 0]] += 2.0
+    return x, y
+
+
+def test_amp_training_converges():
+    main, startup, loss = _mnist_like_program()
+    exe = fluid.Executor(fluid.TPUPlace())
+    x, y = _separable_batch()
+    with amp.amp_guard(True):
+        exe.run(startup)
+        losses = [float(exe.run(main, feed={"x": x, "y": y},
+                                fetch_list=[loss])[0])
+                  for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+    assert np.isfinite(losses[-1])
+
+
+def test_amp_params_stay_fp32():
+    main, startup, loss = _mnist_like_program()
+    exe = fluid.Executor(fluid.TPUPlace())
+    x, y = _separable_batch()
+    with amp.amp_guard(True):
+        exe.run(startup)
+        exe.run(main, feed={"x": x, "y": y}, fetch_list=[loss])
+    sc = fluid.global_scope()
+    params = [n for n in sc._vars if n.startswith("fc_")
+              and "@" not in n]
+    assert params
+    for n in params:
+        assert np.asarray(sc._get(n)).dtype == np.float32, n
+
+
+def test_amp_white_op_computes_bf16():
+    import jax.numpy as jnp
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        out = layers.mul(x, layers.create_parameter([8, 8], "float32"))
+    exe = fluid.Executor(fluid.TPUPlace())
+    x_np = np.ones((4, 8), dtype=np.float32)
+    with amp.amp_guard(True):
+        exe.run(startup)
+        res = exe.run(main, feed={"x": x_np}, fetch_list=[out],
+                      return_numpy=False)
+    assert res[0].dtype == jnp.bfloat16
+
+
+def test_amp_off_is_pure_fp32():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        out = layers.mul(x, layers.create_parameter([8, 8], "float32"))
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    res = exe.run(main, feed={"x": np.ones((4, 8), dtype=np.float32)},
+                  fetch_list=[out], return_numpy=False)
+    assert res[0].dtype == np.float32
+
+
+def test_amp_matches_fp32_loss_first_step():
+    """First-step loss under AMP stays close to the fp32 loss."""
+    x, y = _separable_batch()
+    main, startup, loss = _mnist_like_program()
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    ref = float(exe.run(main, feed={"x": x, "y": y},
+                        fetch_list=[loss])[0])
+
+    fluid.core.program._main_program = fluid.Program()
+    fluid.core.program._startup_program = fluid.Program()
+    fluid._reset_global_scope()
+    fluid.unique_name.switch()
+    fluid.seed(90)
+    np.random.seed(90)
+    main2, startup2, loss2 = _mnist_like_program()
+    exe2 = fluid.Executor(fluid.TPUPlace())
+    with amp.amp_guard(True):
+        exe2.run(startup2)
+        got = float(exe2.run(main2, feed={"x": x, "y": y},
+                             fetch_list=[loss2])[0])
+    assert abs(ref - got) < 0.05, (ref, got)
+
+
+def test_label_smooth_eps_fused_matches_onehot_path():
+    """Fused label_smooth_eps == one_hot + label_smooth + soft CE."""
+    r = np.random.RandomState(0)
+    logits_np = r.randn(6, 10).astype(np.float32)
+    lab_np = r.randint(0, 10, (6, 1)).astype(np.int64)
+    eps = 0.1
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lg = layers.data("lg", shape=[10], dtype="float32")
+        lb = layers.data("lb", shape=[1], dtype="int64")
+        fused = layers.softmax_with_cross_entropy(
+            lg, lb, label_smooth_eps=eps)
+        onehot = layers.one_hot(lb, 10)
+        soft = layers.label_smooth(onehot, epsilon=eps)
+        ref = layers.softmax_with_cross_entropy(lg, soft,
+                                                soft_label=True)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    a, b = exe.run(main, feed={"lg": logits_np, "lb": lab_np},
+                   fetch_list=[fused, ref])
+    np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
