@@ -48,6 +48,8 @@ from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
     memory_optimize, release_memory
 from . import amp
+from . import flags
+from .flags import set_flags, get_flags
 from . import contrib
 from . import lod_tensor
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor

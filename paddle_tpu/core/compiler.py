@@ -177,6 +177,8 @@ class CompiledProgram:
             rng = scope._get(RNG_VAR)
             if rng is None:
                 rng = jax.random.PRNGKey(_global_seed[0])
+            if not _is_sharded(rng):
+                rng = jax.device_put(rng, repl)
             with mesh:
                 new_state, fetches, rng_out = jitted(
                     mut, const_st, sharded_feeds, rng)

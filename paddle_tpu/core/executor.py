@@ -182,6 +182,37 @@ def _build_step_fn(block, feed_names, mutated, const, state_out,
     return step
 
 
+@jax.jit
+def _finite_flags(vs):
+    import jax.numpy as jnp
+
+    return [jnp.all(jnp.isfinite(v)) for v in vs]
+
+
+def _check_nan_inf(new_state, fetches, fetch_names):
+    """FLAGS_check_nan_inf guard (reference framework/operator.cc:975
+    checks each op's outputs after Run). The whole block is ONE XLA
+    program here, so the per-op hook point does not exist; instead every
+    mutated state buffer and fetched value is reduced to a single
+    all-finite bit in one fused jit -- one scalar per variable crosses
+    the host boundary, and the first offending variable is named."""
+    import jax.numpy as jnp
+
+    named = [(n, v) for n, v in new_state.items()
+             if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)]
+    named += [(f"fetch:{fetch_names[i]}", v)
+              for i, v in enumerate(fetches)
+              if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)]
+    if not named:
+        return
+    flags = _finite_flags([v for _, v in named])
+    for (name, _), ok in zip(named, flags):
+        if not bool(ok):
+            raise RuntimeError(
+                f"Operator output contains NaN/Inf: variable {name!r} "
+                f"(FLAGS_check_nan_inf is enabled)")
+
+
 def _default_layout_specs(step, scope, mutated, const, feed_arrays,
                           place):
     """Pin the executor's jit boundary so state layouts stay stable.
@@ -210,6 +241,12 @@ def _default_layout_specs(step, scope, mutated, const, feed_arrays,
         from jax.experimental.layout import Format, Layout
         from jax.sharding import SingleDeviceSharding
     except Exception:
+        return None
+    if jax.device_count() > 1:
+        # Pinning SingleDeviceSharding formats breaks programs that
+        # shard_map over a multi-device mesh (context_parallel etc.);
+        # the relayout problem this solves only exists on the
+        # 1-real-chip tunneled host anyway.
         return None
     try:
         dev = place.device()
@@ -296,6 +333,12 @@ class Executor:
             device = self.place.device()
         except Exception:
             device = None
+        # Pre-committing inputs to one device conflicts with programs that
+        # shard_map over a multi-device mesh (context_parallel etc.) --
+        # committed single-device args can't be auto-resharded. The upload
+        # fast path only matters on the 1-real-chip bench host anyway.
+        if device is not None and jax.device_count() > 1:
+            device = None
         feed_arrays = {}
         feed_specs = []
         for name, val in feed.items():
@@ -343,6 +386,10 @@ class Executor:
                 prog_seed if prog_seed is not None else _global_seed[0])
         new_state, fetches, rng_out = compiled.fn(
             mut, const_st, feed_arrays, rng)
+        from ..flags import FLAGS
+
+        if FLAGS.check_nan_inf:
+            _check_nan_inf(new_state, fetches, fetch_names)
         scope._set(RNG_VAR, rng_out)
         for n, v in new_state.items():
             scope._set(n, v)

@@ -195,6 +195,7 @@ def run_op(op: Operator, env: Dict, rng_cell=None, rng_salt=0) -> None:
 # move with the fake size are dynamic (-1).
 # ---------------------------------------------------------------------------
 _PROBE_A, _PROBE_B = 7, 11
+_INFER_WARNED: set = set()
 
 
 def _probe_spec(var, probe):
@@ -234,8 +235,27 @@ def infer_shape_for_op(op: Operator, block: Block) -> None:
                 return _normalize_outputs(op, info.kernel(ctx))
 
             results.append(jax.eval_shape(f, ins))
-    except Exception:
-        return  # shape inference is best-effort at build time
+    except Exception as e:
+        # Reference InferShape raises at build time (framework/
+        # shape_inference.h). Here kernels double as shape functions via
+        # eval_shape, and some legitimately cannot trace with -1 probe
+        # dims -- so default is warn-and-defer, with FLAGS_strict_infer_
+        # shape=1 restoring raise-at-append_op semantics.
+        from ..flags import FLAGS
+
+        if FLAGS.strict_infer_shape:
+            raise RuntimeError(
+                f"shape inference failed for op {op.type!r}: {e}") from e
+        if op.type not in _INFER_WARNED:
+            _INFER_WARNED.add(op.type)
+            import warnings
+
+            warnings.warn(
+                f"shape inference for op {op.type!r} failed at build "
+                f"time ({type(e).__name__}: {e}); output shapes left "
+                f"unset -- errors may surface later at trace time. Set "
+                f"FLAGS_strict_infer_shape=1 to raise here instead.")
+        return
     ra, rb = results
     for slot, names in op.outputs.items():
         if slot not in ra:

@@ -76,6 +76,20 @@ class DataFeedDesc:
             "slots": [vars(s) for s in self.slots]}, indent=2)
 
 
+def _pad_ragged(vals, dtype):
+    """Pad variable-length rows to a power-of-two bucket so the
+    executor's shape-keyed jit cache reuses a handful of compiled
+    programs instead of one per distinct maxlen."""
+    maxlen = max(1, max(len(v) for v in vals))
+    b = 4
+    while b < maxlen:
+        b *= 2
+    arr = np.zeros((len(vals), b), dtype)
+    for i, v in enumerate(vals):
+        arr[i, :len(v)] = v
+    return arr
+
+
 class MultiSlotDataFeed:
     """Parse MultiSlot text files into padded batches (reference
     MultiSlotDataFeed::ParseOneInstance data_feed.cc)."""
@@ -113,25 +127,22 @@ class MultiSlotDataFeed:
             if not slot.is_used:
                 continue
             vals = [s[slot.name] for s in samples]
-            if slot.is_dense or slot.type.startswith("float"):
+            if slot.is_dense:
+                # dense slots have a fixed width: a ragged batch means
+                # corrupt input, and np.stack raising is the loud
+                # failure the reference's CheckFile gives
                 out[slot.name] = np.stack(vals).astype(
                     np.float32 if slot.type.startswith("float")
                     else np.int64)
             else:
-                maxlen = max(1, max(len(v) for v in vals))
-                # bucket the pad length to the next power of two so the
-                # executor's shape-keyed jit cache reuses a handful of
-                # compiled programs instead of one per distinct maxlen
-                b = 4
-                while b < maxlen:
-                    b *= 2
-                maxlen = b
-                arr = np.zeros((len(vals), maxlen), np.int64)
-                for i, v in enumerate(vals):
-                    arr[i, :len(v)] = v
-                out[slot.name] = arr
-                # padded-batch companion (layers/sequence.py contract:
-                # LoD offsets become per-sample lengths)
+                # variable-length sparse slot (int or float): ALWAYS
+                # pad + @SEQ_LEN companion (layers/sequence.py
+                # contract), keyed on the slot being sparse -- not on
+                # whether this particular batch happens to be ragged --
+                # so the output schema is batch-content-independent
+                dtype = (np.float32 if slot.type.startswith("float")
+                         else np.int64)
+                out[slot.name] = _pad_ragged(vals, dtype)
                 out[slot.name + "@SEQ_LEN"] = np.asarray(
                     [len(v) for v in vals], np.int32)
         return out

@@ -156,11 +156,16 @@ def to_variable(value, name=None, zero_copy=None):
                    stop_gradient=not isinstance(value, VarBase))
 
 
-def trace_op(op_type, inputs: Dict[str, List[VarBase]], num_outputs,
-             attrs, out_slots=None) -> List[VarBase]:
-    """Run one op eagerly + record it on the tape."""
+def trace_op_into(op_type, inputs: Dict[str, List[VarBase]],
+                  out_vars_by_slot: Dict[str, List[VarBase]],
+                  attrs) -> None:
+    """Run one op eagerly, filling CALLER-provided output VarBases.
+
+    This is the `fluid.layers.*`-in-dygraph-mode path (reference
+    framework.py:1633 Block.append_op traces through the dygraph tracer
+    instead of appending): LayerHelper pre-creates the output VarBases
+    it will return, so the trace must write into those objects."""
     t = tracer()
-    info = get_op_info(op_type)
     env = {}
     in_names = {}
     for slot, vars_ in inputs.items():
@@ -172,23 +177,26 @@ def trace_op(op_type, inputs: Dict[str, List[VarBase]], num_outputs,
             names.append(v.name)
         if names:
             in_names[slot] = names
-    if out_slots is None:
-        out_slots = {"Out": num_outputs}
-    out_names = {}
-    out_vars_by_slot = {}
-    for slot, n in out_slots.items():
-        vs = [VarBase(0.0, name=unique_name.generate(
-            f"{op_type}.{slot}")) for _ in range(n)]
-        out_names[slot] = [v.name for v in vs]
-        out_vars_by_slot[slot] = vs
+    out_names = {slot: [v.name for v in vs]
+                 for slot, vs in out_vars_by_slot.items()}
     op = Operator(None, op_type, in_names, out_names, attrs)
     rng_cell = [t.next_rng() if t else jax.random.PRNGKey(0)]
     run_op(op, env, rng_cell=rng_cell, rng_salt=0)
-    outs = []
     for slot, vs in out_vars_by_slot.items():
         for v in vs:
-            v.value = env[v.name]
-            outs.append(v)
+            v.value = jnp.asarray(env[v.name])
     if t is not None and t._record:
         t.record(op, inputs, out_vars_by_slot)
-    return outs
+
+
+def trace_op(op_type, inputs: Dict[str, List[VarBase]], num_outputs,
+             attrs, out_slots=None) -> List[VarBase]:
+    """Run one op eagerly + record it on the tape."""
+    if out_slots is None:
+        out_slots = {"Out": num_outputs}
+    out_vars_by_slot = {
+        slot: [VarBase(0.0, name=unique_name.generate(
+            f"{op_type}.{slot}")) for _ in range(n)]
+        for slot, n in out_slots.items()}
+    trace_op_into(op_type, inputs, out_vars_by_slot, attrs)
+    return [v for vs in out_vars_by_slot.values() for v in vs]

@@ -211,10 +211,26 @@ class AnalysisPredictor(PaddlePredictor):
     run_zero_copy = zero_copy_run
 
     def clone(self) -> "AnalysisPredictor":
-        """Share nothing mutable: the clone gets its own scope/cache but
-        re-loads from the same model artifact (reference clones share
-        the program, re-create the executor)."""
-        return AnalysisPredictor(copy.copy(self._config))
+        """Clone from the already-loaded program (reference
+        AnalysisPredictor::Clone shares the loaded program and
+        re-creates the executor) -- no disk re-read, so cloning still
+        works after the export dir is gone. The config is deep-copied so
+        append_pass/delete_pass on one predictor cannot leak into the
+        other; scope state (params) is shared copy-on-write via the
+        immutable jax arrays."""
+        twin = AnalysisPredictor.__new__(AnalysisPredictor)
+        twin._config = copy.deepcopy(self._config)
+        twin._scope = Scope()
+        for name in self._scope.local_var_names():
+            twin._scope._set(name, self._scope._get(name))
+        twin._exe = Executor(TPUPlace(0))
+        twin._zero_copy_inputs = {}
+        twin._zero_copy_outputs = {}
+        twin._program = self._program.clone() \
+            if hasattr(self._program, "clone") else self._program
+        twin._feed_names = list(self._feed_names)
+        twin._fetch_names = list(self._fetch_names)
+        return twin
 
 
 def create_paddle_predictor(config: NativeConfig) -> AnalysisPredictor:

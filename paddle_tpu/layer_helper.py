@@ -13,6 +13,12 @@ from .initializer import ConstantInitializer, XavierInitializer
 from .param_attr import ParamAttr
 
 
+def _in_dygraph_mode():
+    from .dygraph import base as _dy
+
+    return _dy.enabled()
+
+
 class LayerHelper:
     def __init__(self, layer_type, **kwargs):
         self.kwargs = kwargs
@@ -53,6 +59,9 @@ class LayerHelper:
             ConstantInitializer(0.0) if is_bias else XavierInitializer())
         dtype = as_datatype(dtype)
         shape = [int(s) for s in shape]
+        if _in_dygraph_mode():
+            return self._create_dygraph_parameter(name, init, shape,
+                                                  dtype, attr)
         param = self.main_program.global_block.create_parameter(
             name=name, shape=shape, dtype=dtype,
             trainable=attr.trainable, regularizer=attr.regularizer,
@@ -65,8 +74,40 @@ class LayerHelper:
         init(svar, sblock)
         return param
 
+    def _create_dygraph_parameter(self, name, init, shape, dtype, attr):
+        """Eager parameter: the init op runs immediately through the
+        same registered kernel it would get in the startup program
+        (reference framework.py create_parameter's dygraph branch)."""
+        from .core.program import Program
+        from .core.registry import run_op
+        from .dygraph import base as _dy
+
+        sp = Program()
+        sblock = sp.global_block
+        svar = sblock.create_var(name=name, shape=shape, dtype=dtype,
+                                 persistable=True)
+        init(svar, sblock)
+        env = {}
+        t = _dy.tracer()
+        import jax as _jax
+
+        rng_cell = [t.next_rng() if t else _jax.random.PRNGKey(0)]
+        for op in sblock.ops:
+            run_op(op, env, rng_cell=rng_cell, rng_salt=0)
+        param = _dy.VarBase(env[name], name=name, persistable=True)
+        param.trainable = attr.trainable
+        param.stop_gradient = not attr.trainable
+        param.optimize_attr = {"learning_rate": attr.learning_rate}
+        return param
+
     def create_variable_for_type_inference(self, dtype=None,
                                            stop_gradient=False):
+        if _in_dygraph_mode():
+            from .dygraph import base as _dy
+
+            return _dy.VarBase(
+                0.0, name=unique_name.generate(f"{self.name}.tmp"),
+                stop_gradient=stop_gradient)
         return self.block.create_var(
             name=unique_name.generate(f"{self.name}.tmp"),
             dtype=as_datatype(dtype) if dtype else None,
@@ -92,6 +133,30 @@ class LayerHelper:
         initializer(svar, sblock)
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        if _in_dygraph_mode():
+            from .dygraph import base as _dy
+
+            def norm(io):
+                out = {}
+                for slot, v in (io or {}).items():
+                    vs = v if isinstance(v, (list, tuple)) else [v]
+                    vs = [x for x in vs if x is not None]
+                    for x in vs:
+                        if isinstance(x, str):
+                            # graph-only layers pass variable NAMES
+                            # (e.g. '@SEQ_LEN' companions); there is no
+                            # scope to resolve them against eagerly
+                            raise TypeError(
+                                f"layer op {type!r} references "
+                                f"variable {x!r} by name and is not "
+                                f"supported in dygraph mode")
+                    out[slot] = [x if isinstance(x, _dy.VarBase)
+                                 else _dy.to_variable(x) for x in vs]
+                return out
+
+            _dy.trace_op_into(type, norm(inputs), norm(outputs),
+                              dict(attrs or {}))
+            return None
         return self.block.append_op(type, inputs, outputs, attrs)
 
     def append_bias_op(self, input_var, dim_start=1, dim_end=None):

@@ -171,9 +171,11 @@ def target_assign(input, matched_indices, negative_indices=None,
     out = helper.create_variable_for_type_inference(input.dtype, True)
     out_weight = helper.create_variable_for_type_inference("float32",
                                                            True)
+    ins = {"X": input, "MatchIndices": matched_indices}
+    if negative_indices is not None:
+        ins["NegIndices"] = negative_indices
     helper.append_op(
-        "target_assign",
-        {"X": input, "MatchIndices": matched_indices},
+        "target_assign", ins,
         {"Out": out, "OutWeight": out_weight},
         {"mismatch_value": mismatch_value or 0})
     return out, out_weight

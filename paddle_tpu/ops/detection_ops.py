@@ -171,11 +171,11 @@ def prior_box(ctx):
             if flip:
                 out_ars.append(1.0 / a)
     boxes = []
-    for ms in min_sizes:
+    for i, ms in enumerate(min_sizes):
         if min_max_ar_order:
             boxes.append((ms, ms))
             if max_sizes:
-                mx = max_sizes[min_sizes.index(ms)]
+                mx = max_sizes[i]
                 boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
             for a in out_ars:
                 if abs(a - 1.0) < 1e-6:
@@ -185,7 +185,7 @@ def prior_box(ctx):
             for a in out_ars:
                 boxes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
             if max_sizes:
-                mx = max_sizes[min_sizes.index(ms)]
+                mx = max_sizes[i]
                 boxes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
     wh = jnp.asarray(boxes, jnp.float32)  # [P, 2]
     p = wh.shape[0]
@@ -334,19 +334,35 @@ def target_assign(ctx):
     X[match[i,j]] where matched else mismatch_value."""
     x = ctx.input("X")  # [N, K] or [B, N, K]
     match = ctx.input("MatchIndices")  # [B, M]
+    neg = ctx.input("NegIndices")  # optional [B, Nn], pad rows -1
     mismatch = ctx.attr("mismatch_value", 0)
     if x.ndim == 2:
         x = jnp.broadcast_to(x[None], (match.shape[0],) + x.shape)
+    if neg is not None and neg.ndim == 1:
+        neg = jnp.broadcast_to(neg[None], (match.shape[0],) + neg.shape)
 
-    def one(xb, mb):
+    def one(xb, mb, nb):
         safe = jnp.maximum(mb, 0)
         out = xb[safe]
         w = (mb >= 0)
         out = jnp.where(w[:, None], out,
                         jnp.asarray(mismatch, x.dtype))
-        return out, w.astype(x.dtype)
+        w = w.astype(x.dtype)
+        if nb is not None:
+            # reference target_assign_op.cc NegIndices branch: mined
+            # negatives keep the mismatch value but get weight 1 so the
+            # background class trains on them
+            m_len = w.shape[0]
+            # pad entries (nb < 0) route to index m_len and are dropped
+            neg_mask = jnp.zeros((m_len,), bool).at[
+                jnp.where(nb >= 0, nb, m_len)].set(True, mode="drop")
+            w = jnp.where(neg_mask, jnp.asarray(1.0, x.dtype), w)
+        return out, w
 
-    out, w = jax.vmap(one)(x, match)
+    if neg is None:
+        out, w = jax.vmap(lambda xb, mb: one(xb, mb, None))(x, match)
+    else:
+        out, w = jax.vmap(one)(x, match, neg)
     return {"Out": out, "OutWeight": w[..., None]}
 
 
@@ -714,27 +730,34 @@ def ssd_loss(ctx):
         # (reference bipartite_match_op); per_prediction additionally
         # matches priors whose best-gt IoU exceeds overlap_threshold
         def bip_body(_, carry):
-            matched_b, sm = carry
+            matched_b, claim, sm = carry
             flat = jnp.argmax(sm)
             r, c = flat // m, flat % m
             ok = sm[r, c] > 0
             matched_b = jnp.where(ok, matched_b.at[c].set(True),
                                   matched_b)
+            claim = jnp.where(ok, claim.at[c].set(r), claim)
             sm = jnp.where(ok, sm.at[r, :].set(BIG_NEG)
                            .at[:, c].set(BIG_NEG), sm)
-            return matched_b, sm
+            return matched_b, claim, sm
 
-        bip_matched, _ = jax.lax.fori_loop(
+        bip_matched, bip_claim, _ = jax.lax.fori_loop(
             0, min(g, m), bip_body,
-            (jnp.zeros((m,), bool), sim))
+            (jnp.zeros((m,), bool), jnp.zeros((m,), jnp.int32), sim))
         best_gt = jnp.argmax(sim, axis=0)  # per prior
         best_sim = jnp.max(sim, axis=0)
         if match_type == "per_prediction":
             matched = bip_matched | (best_sim > overlap_threshold)
         else:
             matched = bip_matched
-        tgt_box = gtb[best_gt]
-        tgt_label = jnp.where(matched, gtl[best_gt].astype(jnp.int32),
+        # a prior claimed in the greedy bipartite pass takes the gt row
+        # that claimed it -- two gts contesting one prior can leave
+        # argmax-IoU pointing at the loser (reference bipartite_match ->
+        # target_assign gathers by the assigned row); per_prediction
+        # extras fall back to argmax
+        tgt_row = jnp.where(bip_matched, bip_claim, best_gt)
+        tgt_box = gtb[tgt_row]
+        tgt_label = jnp.where(matched, gtl[tgt_row].astype(jnp.int32),
                               background_label)
         # encode matched boxes against priors (center-size + variance)
         tw = tgt_box[:, 2] - tgt_box[:, 0]

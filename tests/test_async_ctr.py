@@ -263,3 +263,23 @@ class TestDistributedLookupTable:
         # odd-row shard on ep1 untouched entirely
         rt1 = pserver_runtime.get_endpoint(self.EPS[1])
         assert losses[-1] < losses[0]
+
+
+class TestRaggedFloatSlots:
+    def test_variable_length_float_slot_padded(self, tmp_path):
+        """ADVICE.md: sparse float slots with ragged lengths must pad
+        like the int path (reference MultiSlotDataFeed supports
+        variable-length float slots) instead of raising in np.stack."""
+        path = os.path.join(str(tmp_path), "f.txt")
+        with open(path, "w") as f:
+            f.write("2 0.5 1.5\n3 1.0 2.0 3.0\n")
+        desc = DataFeedDesc()
+        desc.set_batch_size(2)
+        desc.add_slot("fv", type="float")
+        feed = MultiSlotDataFeed(desc)
+        b = list(feed.read_batches(path))[0]
+        assert b["fv"].dtype == np.float32
+        assert b["fv"].shape == (2, 4)  # padded to pow2 bucket
+        np.testing.assert_array_equal(b["fv@SEQ_LEN"], [2, 3])
+        np.testing.assert_allclose(b["fv"][0, :2], [0.5, 1.5])
+        assert b["fv"][0, 2:].sum() == 0

@@ -371,3 +371,103 @@ class TestDetectionMap:
                          {"MAP": out}, {"overlap_threshold": 0.5})
         got, = _run([out], {"d": det_res, "l": label})
         assert float(got) == pytest.approx(1.0)
+
+
+class TestAdviceRegressions:
+    """Round-1 advisor findings (ADVICE.md): contested-prior target
+    assignment in ssd_loss, duplicate min_sizes in prior_box,
+    negative_indices in target_assign."""
+
+    def test_ssd_loss_contested_prior_uses_claiming_gt(self):
+        # gt1 claims P1 first (IoU .92); gt0 then claims P0 (.56) even
+        # though the argmax-IoU gt at P0 is gt1 (.64). Encoding loc as
+        # the bipartite assignment (P0->gt0, P1->gt1) must give a
+        # strictly lower loss than encoding the stale argmax
+        # (P0->gt1, P1->gt1).
+        m = 4
+        prior = np.array([[0.0, 0.0, 0.4, 0.4],
+                          [0.0, 0.0, 0.52, 0.52],
+                          [0.9, 0.9, 1.0, 1.0],
+                          [0.8, 0.0, 1.0, 0.2]], np.float32)
+        gts = np.array([[0.0, 0.0, 0.3, 0.3],
+                        [0.0, 0.0, 0.5, 0.5]], np.float32)
+        var = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+
+        def encode(tgt, pb):
+            pw, ph = pb[2] - pb[0], pb[3] - pb[1]
+            pcx, pcy = pb[0] + pw / 2, pb[1] + ph / 2
+            tw, th = tgt[2] - tgt[0], tgt[3] - tgt[1]
+            tcx, tcy = tgt[0] + tw / 2, tgt[1] + th / 2
+            return np.array([(tcx - pcx) / pw / var[0],
+                             (tcy - pcy) / ph / var[1],
+                             np.log(tw / pw) / var[2],
+                             np.log(th / ph) / var[3]], np.float32)
+
+        loc_claim = np.zeros((1, m, 4), np.float32)
+        loc_claim[0, 0] = encode(gts[0], prior[0])
+        loc_claim[0, 1] = encode(gts[1], prior[1])
+        loc_argmax = np.zeros((1, m, 4), np.float32)
+        loc_argmax[0, 0] = encode(gts[1], prior[0])
+        loc_argmax[0, 1] = encode(gts[1], prior[1])
+
+        def build_and_run(loc_np):
+            prog = fluid.Program()
+            with fluid.program_guard(prog, fluid.Program()):
+                loc = fluid.layers.data(name="loc", shape=[m, 4],
+                                        dtype="float32")
+                conf = fluid.layers.data(name="conf", shape=[m, 3],
+                                         dtype="float32")
+                gtb = fluid.layers.data(name="gtb", shape=[2, 4],
+                                        dtype="float32")
+                gtl = fluid.layers.data(name="gtl", shape=[2, 1],
+                                        dtype="int64")
+                pb = fluid.layers.data(name="pb", shape=[4],
+                                       dtype="float32")
+                loss = det.ssd_loss(loc, conf, gtb, gtl, pb,
+                                    match_type="bipartite")
+                mean = fluid.layers.mean(loss)
+            exe = fluid.Executor(fluid.TPUPlace(0))
+            out, = exe.run(prog, feed={
+                "loc": loc_np,
+                "conf": np.zeros((1, m, 3), np.float32),
+                "gtb": gts[None],
+                "gtl": np.array([[[1], [2]]], np.int64),
+                "pb": prior}, fetch_list=[mean])
+            return float(out)
+
+        assert build_and_run(loc_claim) < build_and_run(loc_argmax)
+
+    def test_prior_box_duplicate_min_sizes(self):
+        # duplicate min_sizes must pair max_sizes positionally, not by
+        # first-occurrence (ADVICE: min_sizes.index bug)
+        img = fluid.layers.data(name="imgd", shape=[3, 16, 16],
+                                dtype="float32")
+        feat = fluid.layers.data(name="featd", shape=[8, 4, 4],
+                                 dtype="float32")
+        box, _ = det.prior_box(feat, img, min_sizes=[4.0, 4.0],
+                               max_sizes=[8.0, 16.0],
+                               aspect_ratios=[1.0], clip=False)
+        got, = _run([box], {
+            "imgd": np.zeros((1, 3, 16, 16), np.float32),
+            "featd": np.zeros((1, 8, 4, 4), np.float32)})
+        # per cell: (min,max) pairs -> widths 4, sqrt(32), 4, sqrt(64)
+        w = (got[0, 0, :, 2] - got[0, 0, :, 0]) * 16.0
+        np.testing.assert_allclose(
+            sorted(w), sorted([4.0, np.sqrt(32), 4.0, 8.0]), rtol=1e-5)
+
+    def test_target_assign_negative_indices(self):
+        x = fluid.layers.data(name="xta", shape=[3, 2], dtype="float32")
+        mi = fluid.layers.data(name="mita", shape=[4], dtype="int32")
+        ni = fluid.layers.data(name="nita", shape=[2], dtype="int32")
+        out, w = det.target_assign(x, mi, negative_indices=ni,
+                                   mismatch_value=7)
+        got, wgt = _run([out, w], {
+            "xta": np.arange(6, dtype=np.float32).reshape(1, 3, 2),
+            "mita": np.array([[1, -1, -1, 0]], np.int32),
+            "nita": np.array([[2, -1]], np.int32)})
+        # matched rows gather X; negatives keep mismatch but weight 1
+        np.testing.assert_allclose(got[0, 0], [2, 3])
+        np.testing.assert_allclose(got[0, 3], [0, 1])
+        np.testing.assert_allclose(got[0, 1], [7, 7])
+        np.testing.assert_allclose(got[0, 2], [7, 7])
+        np.testing.assert_allclose(wgt[0, :, 0], [1, 0, 1, 1])

@@ -1,0 +1,78 @@
+"""fluid.layers.* on dygraph VarBase (reference framework.py:1633
+Block.append_op traces through the dygraph tracer when
+_in_dygraph_mode(); layer_helper.py creates eager variables/params)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dygraph.base import VarBase
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 8).astype(np.float32)
+    w = rng.randn(8, 3).astype(np.float32)
+    ys = np.argmax(xs @ w, 1).astype(np.int64)[:, None]
+    return xs, ys
+
+
+class TestFunctionalLayersInDygraph:
+    def test_reduce_mean_returns_varbase_and_backprops(self):
+        xs, _ = _data()
+        with fluid.dygraph.guard():
+            lin = fluid.dygraph.Linear(8, 3)
+            out = lin(fluid.dygraph.to_variable(xs))
+            loss = fluid.layers.reduce_mean(out)
+            assert isinstance(loss, VarBase)
+            loss.backward()
+            g = lin.weight.gradient()
+            assert g is not None and np.abs(g).sum() > 0
+
+    def test_softmax_with_cross_entropy(self):
+        xs, ys = _data()
+        with fluid.dygraph.guard():
+            lin = fluid.dygraph.Linear(8, 3)
+            out = lin(fluid.dygraph.to_variable(xs))
+            ce = fluid.layers.softmax_with_cross_entropy(
+                out, fluid.dygraph.to_variable(ys))
+            loss = fluid.layers.mean(ce)
+            assert int(np.prod(loss.shape or (1,))) == 1
+            loss.backward()
+            assert lin.weight.gradient() is not None
+
+    def test_activation_and_elementwise(self):
+        xs, _ = _data()
+        with fluid.dygraph.guard():
+            xv = fluid.dygraph.to_variable(xs)
+            r = fluid.layers.relu(xv)
+            np.testing.assert_allclose(r.numpy(),
+                                       np.maximum(xs, 0), rtol=1e-6)
+            s = fluid.layers.elementwise_add(r, xv)
+            np.testing.assert_allclose(s.numpy(),
+                                       np.maximum(xs, 0) + xs,
+                                       rtol=1e-6)
+
+
+class TestParamLayersInDygraph:
+    def test_fc_creates_eager_params_and_trains(self):
+        xs, ys = _data()
+        with fluid.dygraph.guard():
+            xv = fluid.dygraph.to_variable(xs)
+            yv = fluid.dygraph.to_variable(ys)
+            h = fluid.layers.fc(xv, size=3)
+            assert isinstance(h, VarBase) and h.shape == (32, 3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(h, yv))
+            loss.backward()
+
+    def test_graph_mode_unaffected(self):
+        # the dispatch must not leak into graph mode
+        xs, ys = _data()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            out = fluid.layers.fc(x, size=3)
+        assert not isinstance(out, VarBase)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got, = exe.run(prog, feed={"x": xs}, fetch_list=[out])
+        assert got.shape == (32, 3)

@@ -1,0 +1,125 @@
+"""FLAGS_* config system, nan/inf guard, deterministic mode, strict
+shape inference (reference python/paddle/fluid/__init__.py:129-180,
+framework/operator.cc:975, framework/shape_inference.h)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.flags import FLAGS, get_flags, set_flags
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    saved = dict(FLAGS._values)
+    yield
+    FLAGS._values.update(saved)
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", None)
+
+
+class TestFlagsAPI:
+    def test_defaults(self):
+        assert FLAGS.check_nan_inf is False
+        assert FLAGS.eager_delete_tensor_gb == -1.0
+
+    def test_set_get_roundtrip(self):
+        set_flags({"FLAGS_check_nan_inf": 1})
+        assert FLAGS.check_nan_inf is True
+        assert get_flags("FLAGS_check_nan_inf") == {
+            "FLAGS_check_nan_inf": True}
+
+    def test_unknown_flag_raises(self):
+        with pytest.raises(ValueError, match="unknown flag"):
+            set_flags({"FLAGS_no_such_flag": 1})
+
+    def test_noop_flag_accepted_with_warning(self):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            set_flags({"FLAGS_fraction_of_gpu_memory_to_use": 0.5})
+        assert FLAGS.fraction_of_gpu_memory_to_use == 0.5
+        assert any("no effect" in str(x.message) for x in w)
+
+    def test_deterministic_pins_matmul_precision(self):
+        import jax
+
+        set_flags({"FLAGS_cpu_deterministic": True})
+        assert jax.config.jax_default_matmul_precision == "highest"
+        set_flags({"FLAGS_cpu_deterministic": False})
+
+
+class TestNanInfGuard:
+    def _build_div_prog(self):
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[4], dtype="float32")
+            out = fluid.layers.elementwise_div(x, y)
+        return prog, startup, out
+
+    def test_clean_run_passes(self):
+        prog, startup, out = self._build_div_prog()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        set_flags({"FLAGS_check_nan_inf": True})
+        res = exe.run(prog,
+                      feed={"x": np.ones((2, 4), np.float32),
+                            "y": np.full((2, 4), 2.0, np.float32)},
+                      fetch_list=[out])
+        np.testing.assert_allclose(res[0], 0.5)
+
+    def test_nan_raises_with_var_name(self):
+        prog, startup, out = self._build_div_prog()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        set_flags({"FLAGS_check_nan_inf": True})
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            exe.run(prog,
+                    feed={"x": np.zeros((2, 4), np.float32),
+                          "y": np.zeros((2, 4), np.float32)},
+                    fetch_list=[out])
+
+    def test_disabled_does_not_raise(self):
+        prog, startup, out = self._build_div_prog()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run(prog,
+                      feed={"x": np.zeros((2, 4), np.float32),
+                            "y": np.zeros((2, 4), np.float32)},
+                      fetch_list=[out])
+        assert np.isnan(res[0]).all()
+
+
+class TestStrictInferShape:
+    def _append_broken_op(self):
+        from paddle_tpu.core.registry import register_op
+
+        if "always_broken" not in fluid.registered_ops():
+            @register_op("always_broken")
+            def _broken(ctx):
+                raise ValueError("kernel is intentionally broken")
+
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            out = prog.global_block.create_var(name="broken_out")
+            prog.global_block.append_op(
+                type="always_broken", inputs={"X": [x.name]},
+                outputs={"Out": [out.name]})
+
+    def test_default_warns_and_defers(self):
+        from paddle_tpu.core import registry
+
+        registry._INFER_WARNED.discard("always_broken")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            self._append_broken_op()
+        assert any("always_broken" in str(x.message) for x in w)
+
+    def test_strict_mode_raises_at_append(self):
+        set_flags({"FLAGS_strict_infer_shape": True})
+        with pytest.raises(RuntimeError, match="always_broken"):
+            self._append_broken_op()

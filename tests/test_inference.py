@@ -192,3 +192,21 @@ class TestIRPasses:
         assert any(n.is_op() and n.name == "relu" for n in g.op_nodes)
         relu_node = [n for n in g.op_nodes if n.name == "relu"][0]
         assert any(v.name == "x" for v in relu_node.inputs)
+
+
+def test_clone_survives_export_dir_removal(tmp_path):
+    """ADVICE.md: clone() must clone from the in-memory program (as the
+    reference does), not re-read the export dir; and must not share the
+    config's mutable pass list."""
+    import shutil
+
+    xs, ys, ref = _train_and_export(tmp_path)
+    pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+    shutil.rmtree(str(tmp_path))
+    clone = pred.clone()
+    o1 = pred.run([PaddleTensor(xs, name="img")])[0].data
+    o2 = clone.run([PaddleTensor(xs, name="img")])[0].data
+    np.testing.assert_allclose(o1, o2, rtol=1e-5)
+    cfg_a, cfg_b = pred._config, clone._config
+    cfg_b.append_pass("made_up_pass")
+    assert "made_up_pass" not in cfg_a.all_passes()
