@@ -7,5 +7,7 @@ from .metric_op import accuracy, auc  # noqa: F401
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .host import py_func, chunk_eval, Go  # noqa: F401
+from .extras import *  # noqa: F401,F403
 from . import nn, tensor, ops, io, control_flow, rnn, sequence  # noqa: F401
 from . import learning_rate_scheduler, metric_op, detection, host  # noqa: F401
+from . import extras  # noqa: F401

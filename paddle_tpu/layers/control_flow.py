@@ -193,45 +193,510 @@ def cond(pred, true_fn=None, false_fn=None):
     return out
 
 
+def _block_io_analysis(sub, parent, exclude_reads=()):
+    """carried = parent-visible vars the block writes; externals =
+    parent-visible reads that are not carried (the While analysis)."""
+    reads, writes = set(), set()
+    for op in sub.ops:
+        for n in op.input_arg_names:
+            if n not in writes and n not in exclude_reads \
+                    and parent._find_var_recursive(n) is not None:
+                reads.add(n)
+        writes.update(op.output_arg_names)
+    carried = sorted(n for n in writes if n not in sub.vars
+                     and parent._find_var_recursive(n) is not None)
+    externals = sorted(reads - set(carried))
+    return carried, externals
+
+
 class Switch:
-    """reference layers/control_flow.py:1126 -- sequential case guard."""
+    """reference layers/control_flow.py:1126 Switch: sequential case
+    guard -- the FIRST case whose scalar condition holds executes its
+    block (assign-style writes take effect), then the chain stops.
+
+    Lowering: each case becomes a `run_block_if` op (lax.cond with the
+    block's parent-visible writes carried) gated on
+    `cond_i AND NOT taken`, with `taken` accumulated across cases --
+    the sequential-guard semantics as a flat chain of compiled conds.
+    The canonical use (piecewise lr decay writing via layers.assign)
+    runs unchanged.
+    """
 
     def __init__(self, name=None):
-        self.cases = []
-        self.default_seen = False
-
-    def case(self, condition):
-        raise NotImplementedError(
-            "Switch: use layers.cond / piecewise arithmetic masks "
-            "(XLA-friendly) -- see learning_rate_scheduler.py")
-
-    def default(self):
-        raise NotImplementedError("Switch.default: see Switch.case")
+        self.helper = LayerHelper("switch", name=name)
+        self._program = default_main_program()
+        self._taken = None
+        self._inside = False
 
     def __enter__(self):
+        self._taken = tensor_layers.fill_constant([1], "bool", False)
+        self._inside = True
         return self
 
     def __exit__(self, *a):
+        self._inside = False
+        return False
+
+    def _guard(self, condition, is_default):
+        if not self._inside:
+            raise ValueError("Switch.case/default used outside "
+                             "'with Switch()' scope")
+        return _SwitchCaseGuard(self, condition, is_default)
+
+    def case(self, condition):
+        return self._guard(condition, False)
+
+    def default(self):
+        return self._guard(None, True)
+
+
+class _SwitchCaseGuard:
+    def __init__(self, switch, condition, is_default):
+        self.sw = switch
+        self.cond = condition
+        self.is_default = is_default
+
+    def __enter__(self):
+        sw = self.sw
+        if self.is_default:
+            self.eff = logical_not(sw._taken)
+        else:
+            self.eff = logical_and(self.cond,
+                                   logical_not(sw._taken))
+            # later cases see this one as taken
+            logical_or(sw._taken, self.cond, cond=sw._taken)
+        self.block = sw._program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        sw = self.sw
+        sub = sw._program.current_block()
+        sw._program.rollback()
+        parent = sw._program.current_block()
+        carried, externals = _block_io_analysis(sub, parent)
+        parent.append_op(
+            "run_block_if",
+            {"Condition": self.eff.name, "X": externals,
+             "Init": carried},
+            {"Out": carried},
+            {"sub_block": sub, "carried": carried,
+             "externals": externals})
         return False
 
 
 class StaticRNN:
-    """reference layers/control_flow.py:266 -- implemented over lax.scan
-    in layers/rnn.py (StaticRNN facade)."""
+    """reference layers/control_flow.py:266 StaticRNN (recurrent_op.cc):
+    user traces one time step inside `with rnn.step()`; sequence inputs
+    are TIME-MAJOR [T, ...]. Lowered to the `recurrent` op
+    (ops/lod_ops.py) = ONE traced step compiled under lax.scan, instead
+    of the reference's per-step sub-scope interpretation."""
+
+    BEFORE_RNN_BLOCK, IN_RNN_BLOCK, AFTER_RNN_BLOCK = 0, 1, 2
 
     def __init__(self, name=None):
-        raise NotImplementedError(
-            "StaticRNN: use layers.rnn.static_rnn / layers.lstm "
-            "(lax.scan-based)")
+        from .. import unique_name
+
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._program = default_main_program()
+        self._uname = unique_name
+        self.memories = {}   # pre_mem name -> [init_var, updated_var]
+        self._mem_order = []
+        self.seq_inputs = []  # (outer var, inner var)
+        self.step_outputs = []  # inner vars
+        self.outputs = []    # parent vars, set at completion
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.seq_len = None
+
+    def step(self):
+        return _StaticRNNGuard(self)
+
+    def _assert_in_block(self, method):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise ValueError(f"must call {method} inside rnn.step()")
+
+    def _parent_block(self):
+        return self._program.current_block().parent_block
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0,
+               ref_batch_dim_idx=1):
+        self._assert_in_block("memory")
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory() needs init, or shape + "
+                                 "batch_ref")
+            parent = self._parent_block()
+            name = self._uname.generate(self.helper.name +
+                                        "@memory_boot")
+            boot = parent.create_var(name=name, shape=shape,
+                                     dtype=batch_ref.dtype)
+            parent.append_op(
+                "fill_constant_batch_size_like",
+                {"Input": [batch_ref.name]}, {"Out": [name]},
+                {"value": float(init_value), "shape": list(shape),
+                 "dtype": boot.dtype.value
+                 if hasattr(boot.dtype, "value") else boot.dtype,
+                 "input_dim_idx": ref_batch_dim_idx,
+                 "output_dim_idx": init_batch_dim_idx})
+            return self.memory(init=boot)
+        block = self._program.current_block()
+        pre = block.create_var(
+            name=self._uname.generate(self.helper.name + "@mem"),
+            dtype=init.dtype, shape=init.shape)
+        self.memories[pre.name] = [init, None]
+        self._mem_order.append(pre.name)
+        return pre
+
+    def step_input(self, x):
+        self._assert_in_block("step_input")
+        if self.seq_len is None:
+            self.seq_len = x.shape[0]
+        elif self.seq_len != x.shape[0]:
+            raise ValueError("StaticRNN needs a fixed sequence length")
+        block = self._program.current_block()
+        ipt = block.create_var(
+            name=self._uname.generate(self.helper.name + "@step_in"),
+            dtype=x.dtype, shape=list(x.shape[1:]))
+        self.seq_inputs.append((x, ipt))
+        return ipt
+
+    def step_output(self, o):
+        self._assert_in_block("step_output")
+        self.step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def update_memory(self, mem, var):
+        if mem.name not in self.memories:
+            raise ValueError(f"{mem.name} is not a StaticRNN memory")
+        self.memories[mem.name][1] = var
+
+    def _complete(self, sub):
+        parent = self._program.current_block()
+        x_names = [inner.name for _, inner in self.seq_inputs]
+        pre_names = [n for n in self._mem_order
+                     if self.memories[n][1] is not None]
+        inits = [self.memories[n][0] for n in pre_names]
+        mem_names = [self.memories[n][1].name for n in pre_names]
+        out_names = [o.name for o in self.step_outputs]
+        carried, externals = _block_io_analysis(
+            sub, parent, exclude_reads=set(x_names) | set(pre_names))
+        if carried:
+            # the recurrent op only threads memories/outputs; a write
+            # to an outer var from inside the step would silently
+            # vanish -- fail loudly instead (route it through a memory)
+            raise ValueError(
+                f"StaticRNN step block writes outer variable(s) "
+                f"{carried}; only memories (update_memory) and step "
+                f"outputs are carried across steps")
+        ex_reads = [n for n in externals
+                    if n not in {v.name for v in inits}]
+        outs = []
+        for o in self.step_outputs:
+            ov = parent.create_var(
+                name=self._uname.generate(o.name + "@stacked"),
+                dtype=o.dtype,
+                shape=[self.seq_len] + list(o.shape or ()))
+            outs.append(ov)
+        finals = [parent.create_var(
+            name=self._uname.generate(n + "@final"),
+            dtype=self.memories[n][0].dtype,
+            shape=self.memories[n][0].shape) for n in pre_names]
+        parent.append_op(
+            "recurrent",
+            {"X": [v.name for v, _ in self.seq_inputs],
+             "Init": [v.name for v in inits],
+             "Ex": ex_reads},
+            {"Out": [v.name for v in outs],
+             "MemFinal": [v.name for v in finals]},
+            {"sub_block": sub, "x_names": x_names,
+             "pre_names": pre_names, "mem_names": mem_names,
+             "out_names": out_names, "externals": ex_reads,
+             "seq_len": self.seq_len})
+        self.outputs = outs
+
+    def __call__(self):
+        if self.status != StaticRNN.AFTER_RNN_BLOCK:
+            raise ValueError("outputs available after the step block")
+        if not self.outputs:
+            raise ValueError("StaticRNN has no output")
+        return self.outputs[0] if len(self.outputs) == 1 \
+            else self.outputs
+
+
+class _StaticRNNGuard:
+    def __init__(self, rnn):
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.rnn.status = StaticRNN.IN_RNN_BLOCK
+        self.rnn._program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        sub = self.rnn._program.current_block()
+        self.rnn._program.rollback()
+        self.rnn.status = StaticRNN.AFTER_RNN_BLOCK
+        self.rnn._complete(sub)
+        return False
 
 
 class DynamicRNN:
+    """reference layers/control_flow.py:1262 DynamicRNN: per-sequence
+    time steps over a LoD input. Padded-batch form: sequence inputs are
+    [B, T, ...] with the @SEQ_LEN companion; the `recurrent` op runs
+    the traced step under lax.scan with mask_memories=True, so finished
+    rows hold their memory and emit zeros -- the numerics the
+    reference gets from batch shrinking, at static shape."""
+
+    BEFORE_RNN, IN_RNN, AFTER_RNN = 0, 1, 2
+
     def __init__(self, name=None):
-        raise NotImplementedError(
-            "DynamicRNN: use layers.rnn.dynamic_rnn (scan + segment "
-            "masks over padded batches)")
+        from .. import unique_name
+
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self._program = default_main_program()
+        self._uname = unique_name
+        self.status = DynamicRNN.BEFORE_RNN
+        self.memories = {}
+        self._mem_order = []
+        self.seq_inputs = []
+        self.step_outputs = []
+        self.outputs = []
+        self._first_outer = None
+
+    def block(self):
+        return _DynamicRNNGuard(self)
+
+    def _assert_in_block(self, method):
+        if self.status != DynamicRNN.IN_RNN:
+            raise ValueError(f"must call {method} inside rnn.block()")
+
+    def step_input(self, x, level=0):
+        self._assert_in_block("step_input")
+        if self._first_outer is None:
+            self._first_outer = x
+        block = self._program.current_block()
+        ipt = block.create_var(
+            name=self._uname.generate(self.helper.name + "@step_in"),
+            dtype=x.dtype,
+            shape=[x.shape[0]] + list(x.shape[2:]))
+        self.seq_inputs.append((x, ipt))
+        return ipt
+
+    def static_input(self, x):
+        self._assert_in_block("static_input")
+        return x  # full var is visible every step (an external read)
+
+    def memory(self, init=None, shape=None, value=0.0,
+               need_reorder=False, dtype="float32"):
+        self._assert_in_block("memory")
+        if init is None:
+            if shape is None or self._first_outer is None:
+                raise ValueError("memory() needs init, or shape after "
+                                 "a step_input")
+            parent = self._program.current_block().parent_block
+            name = self._uname.generate(self.helper.name +
+                                        "@memory_boot")
+            boot = parent.create_var(name=name, shape=[-1] + list(shape),
+                                     dtype=dtype)
+            parent.append_op(
+                "fill_constant_batch_size_like",
+                {"Input": [self._first_outer.name]}, {"Out": [name]},
+                {"value": float(value),
+                 "shape": [-1] + list(shape),
+                 "dtype": boot.dtype.value
+                 if hasattr(boot.dtype, "value") else boot.dtype,
+                 "input_dim_idx": 0, "output_dim_idx": 0})
+            return self.memory(init=boot)
+        block = self._program.current_block()
+        pre = block.create_var(
+            name=self._uname.generate(self.helper.name + "@mem"),
+            dtype=init.dtype, shape=init.shape)
+        self.memories[pre.name] = [init, None]
+        self._mem_order.append(pre.name)
+        return pre
+
+    def update_memory(self, ex_mem, new_mem):
+        if ex_mem.name not in self.memories:
+            raise ValueError(f"{ex_mem.name} is not a DynamicRNN "
+                             f"memory")
+        self.memories[ex_mem.name][1] = new_mem
+
+    def output(self, *outputs):
+        self._assert_in_block("output")
+        self.step_outputs.extend(outputs)
+
+    def _complete(self, sub):
+        from .sequence import SEQ_LEN_SUFFIX, seq_len_of
+
+        parent = self._program.current_block()
+        x_names = [inner.name for _, inner in self.seq_inputs]
+        pre_names = [n for n in self._mem_order
+                     if self.memories[n][1] is not None]
+        inits = [self.memories[n][0] for n in pre_names]
+        mem_names = [self.memories[n][1].name for n in pre_names]
+        out_names = [o.name for o in self.step_outputs]
+        carried, externals = _block_io_analysis(
+            sub, parent, exclude_reads=set(x_names) | set(pre_names))
+        if carried:
+            raise ValueError(
+                f"DynamicRNN block writes outer variable(s) {carried};"
+                f" only memories (update_memory) and output() results "
+                f"are carried across steps")
+        ex_reads = [n for n in externals
+                    if n not in {v.name for v in inits}]
+        outer0 = self.seq_inputs[0][0]
+        seq_len_name = seq_len_of(outer0)
+        outs = []
+        for o in self.step_outputs:
+            ov = parent.create_var(
+                name=self._uname.generate(o.name + "@stacked"),
+                dtype=o.dtype,
+                shape=[outer0.shape[0], outer0.shape[1]]
+                + list((o.shape or ())[1:]))
+            outs.append(ov)
+        finals = [parent.create_var(
+            name=self._uname.generate(n + "@final"),
+            dtype=self.memories[n][0].dtype,
+            shape=self.memories[n][0].shape) for n in pre_names]
+        parent.append_op(
+            "recurrent",
+            {"X": [v.name for v, _ in self.seq_inputs],
+             "Init": [v.name for v in inits],
+             "Ex": ex_reads, "SeqLen": seq_len_name},
+            {"Out": [v.name for v in outs],
+             "MemFinal": [v.name for v in finals]},
+            {"sub_block": sub, "x_names": x_names,
+             "pre_names": pre_names, "mem_names": mem_names,
+             "out_names": out_names, "externals": ex_reads,
+             "batch_major": True, "mask_memories": True})
+        # outputs are LoD tensors with the input's lengths
+        helper = LayerHelper("dynamic_rnn_out")
+        for ov in outs:
+            lname = ov.name + SEQ_LEN_SUFFIX
+            helper.append_op("assign", {"X": seq_len_name},
+                             {"Out": lname}, {})
+            parent.create_var(name=lname, shape=(-1,), dtype="int32",
+                              stop_gradient=True)
+        self.outputs = outs
+
+    def __call__(self):
+        if self.status != DynamicRNN.AFTER_RNN:
+            raise ValueError("outputs available after rnn.block()")
+        if not self.outputs:
+            raise ValueError("DynamicRNN has no output")
+        return self.outputs[0] if len(self.outputs) == 1 \
+            else self.outputs
+
+
+class _DynamicRNNGuard:
+    def __init__(self, rnn):
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.rnn.status = DynamicRNN.IN_RNN
+        self.rnn._program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        sub = self.rnn._program.current_block()
+        self.rnn._program.rollback()
+        self.rnn.status = DynamicRNN.AFTER_RNN
+        self.rnn._complete(sub)
+        return False
 
 
 class IfElse:
+    """reference layers/control_flow.py:1126 IfElse
+    (split_lod_tensor/merge_lod_tensor): rows where cond holds take the
+    true branch. Static-shape lowering: both branches trace over the
+    FULL batch and a row-wise where() merges (ops/lod_ops.py ifelse op)
+    -- row-independent math gives identical values to the reference's
+    split-process-merge."""
+
+    OUT_IF_ELSE_BLOCKS = 2
+
     def __init__(self, cond, name=None):
-        raise NotImplementedError("IfElse: use layers.cond")
+        from .. import unique_name
+
+        self.helper = LayerHelper("ifelse", name=name)
+        self._program = default_main_program()
+        self._uname = unique_name
+        self.cond = cond
+        self._blocks = [None, None]       # traced sub-blocks
+        self._branch_outs = [[], []]      # inner out vars per branch
+        self._current = None
+
+    def true_block(self):
+        return _IfElseBranchGuard(self, 0)
+
+    def false_block(self):
+        return _IfElseBranchGuard(self, 1)
+
+    def input(self, x):
+        if self._current is None:
+            raise ValueError("IfElse.input used outside a branch block")
+        return x  # full-batch view; rows merge by cond at the end
+
+    def output(self, *outs):
+        if self._current is None:
+            raise ValueError("IfElse.output used outside a branch "
+                             "block")
+        self._branch_outs[self._current].extend(outs)
+
+    def __call__(self):
+        if self._blocks[0] is None or self._blocks[1] is None:
+            raise ValueError("both true_block and false_block must be "
+                             "traced")
+        t_outs, f_outs = self._branch_outs
+        if len(t_outs) != len(f_outs):
+            raise ValueError(
+                f"true_block emitted {len(t_outs)} outputs, "
+                f"false_block {len(f_outs)} -- they must match")
+        parent = self._program.current_block()
+        reads = set()
+        for blk in self._blocks:
+            _, ext = _block_io_analysis(blk, parent)
+            reads.update(ext)
+        outs = [parent.create_var(
+            name=self._uname.generate(self.helper.name + "@out"),
+            dtype=t.dtype, shape=t.shape) for t in t_outs]
+        parent.append_op(
+            "ifelse",
+            {"Cond": self.cond.name, "X": sorted(reads)},
+            {"Out": [o.name for o in outs]},
+            {"true_block": self._blocks[0],
+             "false_block": self._blocks[1],
+             "true_outs": [o.name for o in t_outs],
+             "false_outs": [o.name for o in f_outs],
+             "externals": sorted(reads)})
+        return outs  # the reference returns a list, even for one output
+
+
+class _IfElseBranchGuard:
+    def __init__(self, ie, idx):
+        self.ie = ie
+        self.idx = idx
+
+    def __enter__(self):
+        self.ie._current = self.idx
+        self.ie._program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        sub = self.ie._program.current_block()
+        self.ie._program.rollback()
+        self.ie._blocks[self.idx] = sub
+        self.ie._current = None
+        return False

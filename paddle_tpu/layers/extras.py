@@ -1,0 +1,359 @@
+"""Layer wrappers for the round-2 op-gap ops.
+
+Parity: reference python/paddle/fluid/layers/nn.py (pool3d,
+conv3d_transpose, bilinear_tensor_product, rank_loss, random_crop,
+add_position_encoding), layers/control_flow.py (lod_rank_table,
+max_sequence_len, lod_tensor_to_array, array_to_lod_tensor,
+shrink_memory, reorder_lod_tensor_by_rank, Print, is_empty),
+layers/nn.py dynamic_lstmp.
+"""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from .sequence import SEQ_LEN_SUFFIX, seq_len_of
+
+__all__ = ["pool3d", "conv3d_transpose", "bilinear_tensor_product",
+           "rank_loss", "random_crop", "add_position_encoding",
+           "dynamic_lstmp", "lod_rank_table", "max_sequence_len",
+           "lod_tensor_to_array", "array_to_lod_tensor",
+           "shrink_memory", "reorder_lod_tensor_by_rank", "Print",
+           "is_empty", "spp", "unpool", "conv_shift", "data_norm",
+           "modified_huber_loss", "squared_l2_distance",
+           "teacher_student_sigmoid_loss", "max_pool2d_with_index",
+           "max_pool3d_with_index"]
+
+
+def _triple(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+
+def _pair(v):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * 2
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool3d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool3d", {"X": input}, {"Out": out},
+        {"pooling_type": pool_type, "ksize": _triple(pool_size),
+         "strides": _triple(pool_stride),
+         "paddings": _triple(pool_padding),
+         "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+         "exclusive": exclusive})
+    return out
+
+
+def max_pool2d_with_index(input, pool_size, pool_stride=1,
+                          pool_padding=0, global_pooling=False,
+                          name=None):
+    helper = LayerHelper("max_pool2d_with_index", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op(
+        "max_pool2d_with_index", {"X": input},
+        {"Out": out, "Mask": mask},
+        {"ksize": _pair(pool_size), "strides": _pair(pool_stride),
+         "paddings": _pair(pool_padding),
+         "global_pooling": global_pooling})
+    return out, mask
+
+
+def max_pool3d_with_index(input, pool_size, pool_stride=1,
+                          pool_padding=0, global_pooling=False,
+                          name=None):
+    helper = LayerHelper("max_pool3d_with_index", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mask = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op(
+        "max_pool3d_with_index", {"X": input},
+        {"Out": out, "Mask": mask},
+        {"ksize": _triple(pool_size), "strides": _triple(pool_stride),
+         "paddings": _triple(pool_padding),
+         "global_pooling": global_pooling})
+    return out, mask
+
+
+def unpool(input, indices, pool_size, pool_stride=2, pool_padding=0,
+           name=None):
+    helper = LayerHelper("unpool", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "unpool", {"X": input, "Indices": indices}, {"Out": out},
+        {"ksize": _pair(pool_size), "strides": _pair(pool_stride),
+         "paddings": _pair(pool_padding), "unpooling_type": "max"})
+    return out
+
+
+def spp(input, pyramid_height, pool_type="max", name=None):
+    helper = LayerHelper("spp", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("spp", {"X": input}, {"Out": out},
+                     {"pyramid_height": pyramid_height,
+                      "pooling_type": pool_type})
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None):
+    helper = LayerHelper("conv3d_transpose", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    in_c = input.shape[1]
+    fs = _triple(filter_size)
+    w = helper.create_parameter(
+        helper.param_attr, [in_c, num_filters // groups] + fs,
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d_transpose", {"Input": input, "Filter": w},
+        {"Output": out},
+        {"strides": _triple(stride), "paddings": _triple(padding),
+         "dilations": _triple(dilation), "groups": groups})
+    out = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(out)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", input=x,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dx, dy = x.shape[1], y.shape[1]
+    w = helper.create_parameter(helper.param_attr, [size, dx, dy],
+                                x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": x, "Y": y, "Weight": w}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, [1, size],
+                                    x.dtype, is_bias=True)
+        if b is not None:
+            ins["Bias"] = b
+    helper.append_op("bilinear_tensor_product", ins, {"Out": out}, {})
+    return helper.append_activation(out)
+
+
+def rank_loss(label, left, right, name=None):
+    helper = LayerHelper("rank_loss", input=label, name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("rank_loss",
+                     {"Label": label, "Left": left, "Right": right},
+                     {"Out": out}, {})
+    return out
+
+
+def modified_huber_loss(input, label, name=None):
+    helper = LayerHelper("modified_huber_loss", input=input, name=name)
+    inter = helper.create_variable_for_type_inference(input.dtype, True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("modified_huber_loss",
+                     {"X": input, "Y": label},
+                     {"IntermediateVal": inter, "Out": out}, {})
+    return out
+
+
+def squared_l2_distance(x, y, name=None):
+    helper = LayerHelper("squared_l2_distance", input=x, name=name)
+    sub = helper.create_variable_for_type_inference(x.dtype, True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("squared_l2_distance", {"X": x, "Y": y},
+                     {"sub_result": sub, "Out": out}, {})
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label,
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    helper = LayerHelper("teacher_student_sigmoid_loss", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("teacher_student_sigmoid_loss",
+                     {"X": input, "Label": label}, {"Y": out},
+                     {"soft_max_up_bound": soft_max_up_bound,
+                      "soft_max_lower_bound": soft_max_lower_bound})
+    return out
+
+
+def conv_shift(x, y, name=None):
+    helper = LayerHelper("conv_shift", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("conv_shift", {"X": x, "Y": y}, {"Out": out}, {})
+    return out
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    helper = LayerHelper("add_position_encoding", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("add_position_encoding", {"X": input},
+                     {"Out": out}, {"alpha": alpha, "beta": beta})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    """reference layers/nn.py data_norm: normalization by running batch
+    statistics, no trainable scale/shift."""
+    from ..initializer import ConstantInitializer
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("data_norm", input=input,
+                         param_attr=param_attr, name=name)
+    c = input.shape[1]
+    attr = ParamAttr._to_attr(param_attr) or ParamAttr()
+    bsize = helper.create_parameter(
+        ParamAttr(name=attr.name and attr.name + ".batch_size",
+                  initializer=ConstantInitializer(1e4)),
+        [c], input.dtype)
+    bsum = helper.create_parameter(
+        ParamAttr(name=attr.name and attr.name + ".batch_sum",
+                  initializer=ConstantInitializer(0.0)),
+        [c], input.dtype)
+    bsq = helper.create_parameter(
+        ParamAttr(name=attr.name and attr.name + ".batch_square_sum",
+                  initializer=ConstantInitializer(1e4)),
+        [c], input.dtype)
+    for p in (bsize, bsum, bsq):
+        p.stop_gradient = True
+        p.trainable = False
+    y = helper.create_variable_for_type_inference(input.dtype)
+    means = helper.create_variable_for_type_inference(input.dtype, True)
+    scales = helper.create_variable_for_type_inference(input.dtype,
+                                                       True)
+    helper.append_op(
+        "data_norm",
+        {"X": input, "BatchSize": bsize, "BatchSum": bsum,
+         "BatchSquareSum": bsq},
+        {"Y": y, "Means": means, "Scales": scales,
+         "BatchSizeOut": bsize, "BatchSumOut": bsum,
+         "BatchSquareSumOut": bsq},
+        {"epsilon": epsilon})
+    return helper.append_activation(y)
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("random_crop", {"X": x}, {"Out": out},
+                     {"shape": list(shape),
+                      "startup_seed": seed if seed is not None else 0})
+    return out
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None,
+                  bias_attr=None, use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """reference layers/nn.py dynamic_lstmp (lstmp_op.cc): input
+    pre-projected [B,T,4H]; recurrence on the P-dim projection."""
+    helper = LayerHelper("dynamic_lstmp", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    hidden = size // 4
+    w = helper.create_parameter(helper.param_attr,
+                                [proj_size, 4 * hidden], dtype)
+    w_proj = helper.create_parameter(helper.param_attr,
+                                     [hidden, proj_size], dtype)
+    bias_size = 7 * hidden if use_peepholes else 4 * hidden
+    b = helper.create_parameter(helper.bias_attr, [1, bias_size],
+                                dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lstmp",
+        {"Input": input, "Weight": w, "ProjWeight": w_proj, "Bias": b,
+         "SeqLen": seq_len_of(input)},
+        {"Projection": proj, "Cell": cell},
+        {"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+         "gate_activation": gate_activation,
+         "cell_activation": cell_activation,
+         "candidate_activation": candidate_activation,
+         "proj_activation": proj_activation})
+    block = proj.block
+    for o in (proj, cell):
+        lname = o.name + SEQ_LEN_SUFFIX
+        helper.append_op("assign", {"X": input.name + SEQ_LEN_SUFFIX},
+                         {"Out": lname}, {})
+        block.create_var(name=lname, shape=(-1,), dtype="int32",
+                         stop_gradient=True)
+    return proj, cell
+
+
+# --- LoD machinery (reference layers/control_flow.py) --------------------
+def lod_rank_table(x, level=0):
+    helper = LayerHelper("lod_rank_table", input=x)
+    table = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("lod_rank_table",
+                     {"X": x, "SeqLen": seq_len_of(x)},
+                     {"Out": table}, {"level": level})
+    return table
+
+
+def max_sequence_len(rank_table):
+    helper = LayerHelper("max_sequence_len", input=rank_table)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("max_sequence_len", {"RankTable": rank_table},
+                     {"Out": out}, {})
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    helper = LayerHelper("lod_tensor_to_array", input=x)
+    arr = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("lod_tensor_to_array",
+                     {"X": x, "RankTable": table}, {"Out": arr}, {})
+    return arr
+
+
+def array_to_lod_tensor(x, table):
+    helper = LayerHelper("array_to_lod_tensor", input=x)
+    out = helper.create_variable_for_type_inference(None, True)
+    helper.append_op("array_to_lod_tensor",
+                     {"X": x, "RankTable": table}, {"Out": out}, {})
+    return out
+
+
+def shrink_memory(x, i, table):
+    helper = LayerHelper("shrink_memory", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    cnt = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("shrink_rnn_memory",
+                     {"X": x, "I": i, "RankTable": table},
+                     {"Out": out, "ActiveCount": cnt}, {})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    helper = LayerHelper("reorder_lod_tensor_by_rank", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reorder_lod_tensor_by_rank",
+                     {"X": x, "RankTable": rank_table},
+                     {"Out": out}, {})
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference layers/control_flow.py Print (print_op.cc)."""
+    helper = LayerHelper("print", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("print", {"X": input}, {"Out": out},
+                     {"first_n": first_n, "message": message or "",
+                      "summarize": summarize,
+                      "print_phase": print_phase})
+    return out
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper("is_empty", input=x)
+    out = cond or helper.create_variable_for_type_inference("bool",
+                                                            True)
+    helper.append_op("is_empty", {"X": x}, {"Out": out}, {})
+    return out

@@ -13,3 +13,5 @@ from . import dist_ops  # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import host_ops  # noqa: F401
+from . import extra_ops  # noqa: F401
+from . import lod_ops  # noqa: F401

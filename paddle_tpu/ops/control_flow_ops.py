@@ -73,6 +73,43 @@ def while_op(ctx):
     return {"Out": list(final)}
 
 
+@register_op("run_block_if", differentiable=False,
+             infer_shape=_no_infer, stop_gradient_slots=("Condition",))
+def run_block_if(ctx):
+    """Run a sub-block's ops iff Condition, carrying the vars the block
+    mutates (the multi-output sibling of conditional_block: lax.cond
+    with identity false branch). Used by GradientMergeOptimizer to gate
+    the optimize section on the k-th micro-step (reference
+    ir/multi_batch_merge_pass.cc repeats fwd/bwd k times in the SSA
+    graph then applies optimize once; here the SAME compiled program
+    runs every step and the apply is a cond -- XLA-friendly, no
+    program switching).
+
+    inputs: Condition, X = externals (read-only), Init = carried
+    initial values. outputs: Out = carried finals. attrs: sub_block,
+    carried, externals.
+    """
+    sub = ctx.attr("sub_block")
+    carried = list(ctx.attr("carried"))
+    externals = list(ctx.attr("externals"))
+    ext_env = dict(zip(externals, ctx.inputs("X")))
+    init = tuple(ctx.inputs("Init"))
+    pred = jnp.reshape(ctx.input("Condition"), ()).astype(bool)
+
+    def true_fn(carry):
+        env = dict(ext_env)
+        env.update(zip(carried, carry))
+        for op in sub.ops:
+            run_op(op, env, rng_cell=None, rng_salt=op._uid)
+        return tuple(env[n] for n in carried)
+
+    def false_fn(carry):
+        return carry
+
+    final = lax.cond(pred, true_fn, false_fn, init)
+    return {"Out": list(final)}
+
+
 @register_op("conditional_block", infer_shape=_no_infer,
              stop_gradient_slots=("Condition",))
 def conditional_block(ctx):

@@ -1,0 +1,536 @@
+"""Round-2 op-gap closure: pooling/conv3d extensions, structural
+losses, misc math, in-graph save/load + print/is_empty utilities.
+
+Parity targets (reference paddle/fluid/operators/): pool_op.cc (pool3d),
+pool_with_index_op.cc, conv_transpose_op.cc (conv3d_transpose),
+spp_op.h, unpool_op.h, bilinear_tensor_product_op.h, rank_loss_op.h,
+modified_huber_loss_op.h, squared_l2_distance_op.h,
+teacher_student_sigmoid_loss_op.h, conv_shift_op.cc,
+add_position_encoding_op.h, data_norm_op.cc, random_crop_op.h,
+is_empty_op.cc, print_op.cc, save_op.cc, load_op.cc,
+save_combine_op.cc, load_combine_op.cc,
+get_tensor_from_selected_rows_op.cc, merge_selected_rows_op.cc.
+
+All are fresh XLA-idiom implementations: windowed reductions via
+lax.reduce_window, argmax pooling via an im2col gather (static shapes,
+MXU/VPU friendly), circular convolution via jnp.roll-free modular
+gather, in-graph checkpoint IO via ordered io_callback (the reference
+runs save/load as graph ops inside the executor; the callback is the
+jit-compatible form of the same contract).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+__all__ = []
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return list(v) if len(v) == 3 else [v[0]] * 3
+    return [v] * 3
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return list(v) if len(v) == 2 else [v[0]] * 2
+    return [v] * 2
+
+
+# --------------------------------------------------------------------------
+# pooling family
+# --------------------------------------------------------------------------
+@register_op("pool3d")
+def pool3d(ctx):
+    """reference pool_op.cc (pool3d kernel): NCDHW max/avg pooling."""
+    x = ctx.input("X")
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = _triple(ctx.attr("ksize", [2, 2, 2]))
+    strides = _triple(ctx.attr("strides", [1, 1, 1]))
+    pads = _triple(ctx.attr("paddings", [0, 0, 0]))
+    if ctx.attr("global_pooling", False):
+        ksize = list(x.shape[2:5])
+        pads = [0, 0, 0]
+        strides = [1, 1, 1]
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides_,
+                                 padding)
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides_, padding)
+    if ctx.attr("exclusive", True) and any(pads):
+        cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                strides_, padding)
+        return s / cnt
+    return s / float(np.prod(ksize))
+
+
+def _pool_with_index(x, ksize, strides, pads, spatial):
+    """im2col argmax pooling returning (values, flat-input indices).
+
+    The reference mask is the position within the flattened spatial
+    input (pool_with_index_op.h). Static-shape gather keeps XLA happy;
+    out-of-window (padding) cells are masked to -inf so they never win.
+    """
+    n, c = x.shape[:2]
+    in_sp = x.shape[2:]
+    out_sp = [(in_sp[d] + 2 * pads[d] - ksize[d]) // strides[d] + 1
+              for d in range(spatial)]
+    # per-output-cell absolute input coordinates, one axis at a time
+    coords = []
+    valid = None
+    for d in range(spatial):
+        o = jnp.arange(out_sp[d]) * strides[d] - pads[d]
+        k = jnp.arange(ksize[d])
+        cd = o[:, None] + k[None, :]  # [out_d, k_d]
+        ok = (cd >= 0) & (cd < in_sp[d])
+        coords.append((jnp.clip(cd, 0, in_sp[d] - 1), ok))
+        valid = ok if valid is None else valid
+    if spatial == 2:
+        (ch, okh), (cw, okw) = coords
+        # windows [OH, OW, kh, kw]
+        hh = ch[:, None, :, None]
+        ww = cw[None, :, None, :]
+        ok = okh[:, None, :, None] & okw[None, :, None, :]
+        flat_idx = hh * in_sp[1] + ww
+        patches = x[:, :, hh, ww]  # [N, C, OH, OW, kh, kw]
+        patches = jnp.where(ok[None, None], patches, -jnp.inf)
+        pf = patches.reshape(n, c, out_sp[0], out_sp[1], -1)
+        arg = jnp.argmax(pf, axis=-1)
+        out = jnp.max(pf, axis=-1)
+        fi = flat_idx.reshape(out_sp[0], out_sp[1], -1)
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(fi[None, None], pf.shape[:-1] + fi.shape[-1:]),
+            arg[..., None], axis=-1)[..., 0]
+        return out, mask.astype(jnp.int32)
+    # spatial == 3
+    (cd_, okd), (ch, okh), (cw, okw) = coords
+    dd = cd_[:, None, None, :, None, None]
+    hh = ch[None, :, None, None, :, None]
+    ww = cw[None, None, :, None, None, :]
+    ok = (okd[:, None, None, :, None, None]
+          & okh[None, :, None, None, :, None]
+          & okw[None, None, :, None, None, :])
+    flat_idx = (dd * in_sp[1] + hh) * in_sp[2] + ww
+    patches = x[:, :, dd, hh, ww]
+    patches = jnp.where(ok[None, None], patches, -jnp.inf)
+    pf = patches.reshape(n, c, out_sp[0], out_sp[1], out_sp[2], -1)
+    arg = jnp.argmax(pf, axis=-1)
+    out = jnp.max(pf, axis=-1)
+    fi = flat_idx.reshape(out_sp[0], out_sp[1], out_sp[2], -1)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(fi[None, None], pf.shape[:-1] + fi.shape[-1:]),
+        arg[..., None], axis=-1)[..., 0]
+    return out, mask.astype(jnp.int32)
+
+
+@register_op("max_pool2d_with_index", stop_gradient_slots=())
+def max_pool2d_with_index(ctx):
+    """reference pool_with_index_op.cc: Out + Mask of flat h*w index."""
+    x = ctx.input("X")
+    ksize = _pair(ctx.attr("ksize", [2, 2]))
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    if ctx.attr("global_pooling", False):
+        ksize = list(x.shape[2:4])
+        pads = [0, 0]
+    out, mask = _pool_with_index(x, ksize, strides, pads, 2)
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("max_pool3d_with_index", stop_gradient_slots=())
+def max_pool3d_with_index(ctx):
+    x = ctx.input("X")
+    ksize = _triple(ctx.attr("ksize", [2, 2, 2]))
+    strides = _triple(ctx.attr("strides", [1, 1, 1]))
+    pads = _triple(ctx.attr("paddings", [0, 0, 0]))
+    if ctx.attr("global_pooling", False):
+        ksize = list(x.shape[2:5])
+        pads = [0, 0, 0]
+    out, mask = _pool_with_index(x, ksize, strides, pads, 3)
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("unpool")
+def unpool(ctx):
+    """reference unpool_op.h (unpooling_type='max'): scatter pooled
+    values back to the positions recorded in Indices."""
+    x = ctx.input("X")
+    idx = ctx.input("Indices")
+    ksize = _pair(ctx.attr("ksize", [2, 2]))
+    strides = _pair(ctx.attr("strides", [2, 2]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    n, c, h, w = x.shape
+    oh = (h - 1) * strides[0] - 2 * pads[0] + ksize[0]
+    ow = (w - 1) * strides[1] - 2 * pads[1] + ksize[1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].add(x.reshape(n, c, -1))
+    return out.reshape(n, c, oh, ow)
+
+
+@register_op("spp")
+def spp(ctx):
+    """reference spp_op.h: pyramid of 2^p-bin poolings, flattened and
+    concatenated along channels."""
+    x = ctx.input("X")
+    height = ctx.attr("pyramid_height", 1)
+    ptype = ctx.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for p in range(height):
+        bins = 2 ** p
+        kh = -(-h // bins)  # ceil
+        kw = -(-w // bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        window = (1, 1, kh, kw)
+        strides = (1, 1, kh, kw)
+        padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if ptype == "max":
+            o = lax.reduce_window(x, -jnp.inf, lax.max, window, strides,
+                                  padding)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides,
+                                  padding)
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                    window, strides, padding)
+            o = s / cnt
+        outs.append(o[:, :, :bins, :bins].reshape(n, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(ctx):
+    """reference conv_transpose_op.cc conv3d_transpose: NCDHW."""
+    x = ctx.input("Input")
+    w = ctx.input("Filter")  # [in_c, out_c/groups, kd, kh, kw]
+    strides = _triple(ctx.attr("strides", [1, 1, 1]))
+    pads = _triple(ctx.attr("paddings", [0, 0, 0]))
+    dilations = _triple(ctx.attr("dilations", [1, 1, 1]))
+    groups = ctx.attr("groups", 1)
+    from .nn_ops import _conv_transpose_nd
+
+    return {"Output": _conv_transpose_nd(x, w, strides, pads,
+                                         dilations, groups, spatial=3)}
+
+
+# --------------------------------------------------------------------------
+# structural losses / math
+# --------------------------------------------------------------------------
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx):
+    """reference bilinear_tensor_product_op.h: out[b,k] =
+    x[b] @ W[k] @ y[b] + bias[k]."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    w = ctx.input("Weight")  # [K, dx, dy]
+    bias = ctx.input("Bias")
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return out
+
+
+@register_op("rank_loss", stop_gradient_slots=("Label",))
+def rank_loss(ctx):
+    """reference rank_loss_op.h:40: log(1+exp(o)) - label*o,
+    o = left - right (RankNet)."""
+    label = ctx.input("Label")
+    o = ctx.input("Left") - ctx.input("Right")
+    return jnp.logaddexp(0.0, o) - label * o
+
+
+@register_op("modified_huber_loss", stop_gradient_slots=("Y",))
+def modified_huber_loss(ctx):
+    """reference modified_huber_loss_op.h: z = x*(2y-1);
+    loss = -4z if z<-1; (1-z)^2 if -1<=z<1; 0 otherwise."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    z = x * (2.0 * y - 1.0)
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return {"IntermediateVal": z, "Out": loss}
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(ctx):
+    """reference squared_l2_distance_op.h: row-wise ||x-y||^2 (y may be
+    a single row broadcast over the batch)."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    sub = x - y
+    return {"sub_result": sub,
+            "Out": jnp.sum(sub * sub, axis=tuple(range(1, sub.ndim)),
+                           keepdims=False).reshape(-1, 1)}
+
+
+@register_op("teacher_student_sigmoid_loss",
+             stop_gradient_slots=("Label",))
+def teacher_student_sigmoid_loss(ctx):
+    """reference teacher_student_sigmoid_loss_op.h:34-63; label encodes
+    (teacher score z', click z): <-1 no-teacher/no-click, [-1,0)
+    no-teacher/click, [0,1) teacher+no-click, >=1 teacher+click."""
+    x = ctx.input("X").reshape(-1)
+    label = ctx.input("Label").reshape(-1).astype(x.dtype)
+    sp = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    no_t_no_c = sp
+    no_t_c = sp - x
+    t_no_c = sp + sp - x * label
+    t_c = sp - x + sp - x * (label - 1.0)
+    y = jnp.where(label < -1.0, no_t_no_c,
+                  jnp.where(label < 0.0, no_t_c,
+                            jnp.where(label < 1.0, t_no_c, t_c)))
+    return y.reshape(-1, 1)
+
+
+@register_op("conv_shift")
+def conv_shift(ctx):
+    """reference conv_shift_op.cc:127-132 circular convolution:
+    out[b,i] = sum_j x[b, (i + j - w/2) mod n] * y[b,j]."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    n = x.shape[1]
+    w = y.shape[1]
+    half = w // 2
+    # static numpy index grid: x may be a concrete array under the
+    # fd-grad harness while the cotangent is traced
+    i = np.arange(n)[:, None]
+    j = np.arange(w)[None, :]
+    idx = (i + j - half) % n  # [n, w]
+    return jnp.einsum("bnw,bw->bn", jnp.asarray(x)[:, idx], y)
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(ctx):
+    """reference add_position_encoding_op.h:55-80: out = alpha*x +
+    beta*PE with sin on the first half of channels, cos on the second;
+    frequency j / 10000^(k/(half-1))."""
+    x = ctx.input("X")  # [B, T, D]
+    alpha = ctx.attr("alpha", 1.0)
+    beta = ctx.attr("beta", 1.0)
+    b, t, d = x.shape
+    half = d // 2
+    j = jnp.arange(t, dtype=jnp.float32)[:, None]
+    k = jnp.arange(half, dtype=jnp.float32)[None, :]
+    denom = jnp.power(10000.0, k / max(half - 1, 1))
+    val = j / denom  # [T, half]
+    pe = jnp.concatenate([jnp.sin(val), jnp.cos(val)], axis=-1)
+    return alpha * x + beta * pe[None].astype(x.dtype)
+
+
+@register_op("data_norm")
+def data_norm(ctx):
+    """reference data_norm_op.cc:190-200: means = batch_sum/batch_size,
+    scales = sqrt(batch_size/batch_square_sum), y = (x-means)*scales.
+    The three accumulators are updated in place with this batch's
+    sums (the reference routes the update through its grad op; the
+    in-place form is the single-program equivalent)."""
+    x = ctx.input("X")  # [N, C]
+    bsize = ctx.input("BatchSize")        # [C]
+    bsum = ctx.input("BatchSum")          # [C]
+    bsq = ctx.input("BatchSquareSum")     # [C]
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsq)
+    y = (x - means.reshape(1, -1)) * scales.reshape(1, -1)
+    n = x.shape[0]
+    out = {"Y": y, "Means": means, "Scales": scales}
+    if ctx.op.outputs.get("BatchSizeOut"):
+        out["BatchSizeOut"] = bsize + n
+        out["BatchSumOut"] = bsum + x.sum(0)
+        out["BatchSquareSumOut"] = bsq + (x * x).sum(0)
+    return out
+
+
+@register_op("random_crop", differentiable=False, needs_rng=True)
+def random_crop(ctx):
+    """reference random_crop_op.h: per-instance random crop of the
+    trailing dims to attr shape."""
+    x = ctx.input("X")
+    shape = list(ctx.attr("shape"))
+    k = len(shape)
+    batch_dims = x.shape[:x.ndim - k]
+    nb = int(np.prod(batch_dims)) if batch_dims else 1
+    xf = x.reshape((nb,) + x.shape[x.ndim - k:])
+    keys = jax.random.split(ctx.rng(), nb * k).reshape(nb, k, 2)
+
+    def one(inst, ks):
+        slices = []
+        starts = [jax.random.randint(ks[d], (), 0,
+                                     inst.shape[d] - shape[d] + 1)
+                  for d in range(k)]
+        return lax.dynamic_slice(inst, starts, shape)
+
+    out = jax.vmap(one)(xf, keys)
+    return out.reshape(batch_dims + tuple(shape))
+
+
+# --------------------------------------------------------------------------
+# utility / io ops
+# --------------------------------------------------------------------------
+@register_op("is_empty", differentiable=False)
+def is_empty(ctx):
+    """reference is_empty_op.cc: scalar bool numel == 0 (static under
+    XLA, so a compile-time constant)."""
+    return jnp.asarray(ctx.input("X").size == 0)
+
+
+@register_op("print", differentiable=False)
+def print_op(ctx):
+    """reference print_op.cc: pass-through + host-side print via
+    ordered io_callback (message/first_n/summarize attrs honored)."""
+    from jax.experimental import io_callback
+
+    x = ctx.input("X")
+    message = ctx.attr("message", "")
+    first_n = ctx.attr("first_n", -1)
+    summarize = ctx.attr("summarize", -1)
+    counter = [0]
+
+    def _emit(val):
+        counter[0] += 1
+        if first_n < 0 or counter[0] <= first_n:
+            flat = np.asarray(val).reshape(-1)
+            if summarize and summarize > 0:
+                flat = flat[:summarize]
+            print(f"{message} {np.asarray(val).shape} {flat}")
+        return np.zeros((), np.int32)
+
+    io_callback(_emit, jax.ShapeDtypeStruct((), jnp.int32), x,
+                ordered=True)
+    return {"Out": x}
+
+
+@register_op("save", differentiable=False)
+def save_op(ctx):
+    """reference save_op.cc: persist one variable to file_path from
+    inside the graph (ordered io_callback keeps step ordering)."""
+    from jax.experimental import io_callback
+
+    x = ctx.input("X")
+    path = ctx.attr("file_path")
+    overwrite = ctx.attr("overwrite", True)
+
+    def _save(val):
+        import os
+
+        if not overwrite and os.path.exists(path):
+            raise RuntimeError(f"{path} exists and overwrite=False")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.save(path, np.asarray(val), allow_pickle=False)
+        return np.zeros((), np.int32)
+
+    io_callback(_save, jax.ShapeDtypeStruct((), jnp.int32), x,
+                ordered=True)
+    return None
+
+
+@register_op("load", differentiable=False)
+def load_op(ctx):
+    """reference load_op.cc. XLA needs static result shapes, so the
+    layer records the target var's shape/dtype as attrs at build time
+    (io.py wires them); the value itself is read at execution."""
+    from jax.experimental import io_callback
+
+    path = ctx.attr("file_path")
+    shape = tuple(ctx.attr("shape"))
+    dtype = jnp.dtype(ctx.attr("dtype", "float32"))
+
+    def _load():
+        arr = np.load(path if path.endswith(".npy") else path + ".npy")
+        return np.ascontiguousarray(arr.astype(dtype)).reshape(shape)
+
+    return io_callback(_load, jax.ShapeDtypeStruct(shape, dtype),
+                       ordered=True)
+
+
+@register_op("save_combine", differentiable=False)
+def save_combine(ctx):
+    """reference save_combine_op.cc: many vars -> ONE file (npz keyed
+    by input var name)."""
+    from jax.experimental import io_callback
+
+    xs = ctx.inputs("X")
+    names = list(ctx.op.inputs["X"])
+    path = ctx.attr("file_path")
+
+    def _save(*vals):
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(path, **{n: np.asarray(v)
+                          for n, v in zip(names, vals)})
+        return np.zeros((), np.int32)
+
+    io_callback(_save, jax.ShapeDtypeStruct((), jnp.int32), *xs,
+                ordered=True)
+    return None
+
+
+@register_op("load_combine", differentiable=False)
+def load_combine(ctx):
+    """reference load_combine_op.cc: restore N vars from one file; the
+    layer supplies shapes/dtypes attrs for static results."""
+    from jax.experimental import io_callback
+
+    path = ctx.attr("file_path")
+    # npz keys: the names the vars were SAVED under (attr), falling
+    # back to this op's output var names when they match
+    names = list(ctx.attr("names") or ctx.op.outputs["Out"])
+    shapes = [tuple(s) for s in ctx.attr("shapes")]
+    dtypes = [jnp.dtype(d) for d in ctx.attr("dtypes")]
+
+    def _load():
+        p = path if path.endswith(".npz") else path + ".npz"
+        z = np.load(p)
+        return tuple(
+            np.ascontiguousarray(z[n].astype(dt)).reshape(sh)
+            for n, sh, dt in zip(names, shapes, dtypes))
+
+    specs = tuple(jax.ShapeDtypeStruct(sh, dt)
+                  for sh, dt in zip(shapes, dtypes))
+    vals = io_callback(_load, specs, ordered=True)
+    return {"Out": list(vals)}
+
+
+# --------------------------------------------------------------------------
+# SelectedRows bridges. Sparse rows are modeled as a (rows, values)
+# pair of dense tensors (rows int64 ids, values the per-row data) --
+# the static-shape encoding of reference selected_rows.h.
+# --------------------------------------------------------------------------
+@register_op("merge_selected_rows", differentiable=False)
+def merge_selected_rows(ctx):
+    """reference merge_selected_rows_op.cc: sum duplicate row ids.
+    Static-shape form: rows keep their slots; values of duplicate ids
+    are summed into the FIRST occurrence, later duplicates zeroed and
+    their row id set to -1 (padding)."""
+    rows = ctx.input("Rows")
+    vals = ctx.input("Values")
+    n = rows.shape[0]
+    eq = rows[None, :] == rows[:, None]          # [n, n]
+    first = jnp.argmax(eq, axis=1)               # first occurrence idx
+    is_first = first == jnp.arange(n)
+    # scatter-add every row's values into its first occurrence
+    merged = jnp.zeros_like(vals).at[first].add(vals)
+    merged = jnp.where(is_first[:, None], merged, 0)
+    out_rows = jnp.where(is_first, rows, -1)
+    return {"OutRows": out_rows, "OutValues": merged}
+
+
+@register_op("get_tensor_from_selected_rows", differentiable=False)
+def get_tensor_from_selected_rows(ctx):
+    """reference get_tensor_from_selected_rows_op.cc: densify a
+    (rows, values) pair into [height, width] (height attr; padding
+    rows id<0 are dropped)."""
+    rows = ctx.input("Rows")
+    vals = ctx.input("Values")
+    height = ctx.attr("height")
+    safe = jnp.where(rows >= 0, rows, height)  # dropped via mode=drop
+    dense = jnp.zeros((height,) + vals.shape[1:], vals.dtype)
+    return dense.at[safe].add(vals, mode="drop")

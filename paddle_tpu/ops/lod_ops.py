@@ -1,0 +1,296 @@
+"""LoD rank-table machinery + projection/step RNNs.
+
+Parity targets (reference paddle/fluid/operators/): lod_rank_table_op.cc,
+max_sequence_len_op.cc, lod_tensor_to_array_op.cc,
+array_to_lod_tensor_op.cc, shrink_rnn_memory_op.cc,
+reorder_lod_tensor_by_rank_op.cc, lstmp_op.cc, recurrent_op.cc.
+
+LoD design recap (layers/sequence.py): variable-length batches are
+padded [B, T, ...] with an int32 ``@SEQ_LEN`` companion of per-row
+lengths -- XLA needs static shapes, so the reference's LoD offsets
+become lengths and "shrinking" becomes masking. The rank table is the
+same (index, length) descending sort the reference builds; the tensor
+array carries FULL-batch per-timestep slices in rank order (no batch
+shrink -- finished rows are masked by consumers instead, which is the
+numerics-preserving static-shape form of the same computation).
+
+``recurrent`` runs a traced sub-block under lax.scan -- the
+StaticRNN backend (reference recurrent_op.cc re-executes the block per
+step through an inner executor; here the block is traced ONCE and the
+time loop is a compiled scan).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, run_op
+from .control_flow_ops import TensorArray, _no_infer
+
+
+@register_op("lod_rank_table", differentiable=False,
+             infer_shape=_no_infer)
+def lod_rank_table(ctx):
+    """reference lod_rank_table_op.cc: (index, length) rows sorted by
+    length descending (stable)."""
+    x = ctx.input("X")
+    seq_len = ctx.input("SeqLen")
+    if seq_len is None:
+        b, t = x.shape[0], x.shape[1]
+        seq_len = jnp.full((b,), t, jnp.int32)
+    order = jnp.argsort(-seq_len.astype(jnp.int32), stable=True)
+    return {"Out": jnp.stack(
+        [order.astype(jnp.int32),
+         seq_len[order].astype(jnp.int32)], axis=1)}
+
+
+@register_op("max_sequence_len", differentiable=False,
+             infer_shape=_no_infer)
+def max_sequence_len(ctx):
+    """reference max_sequence_len_op.cc: longest length in the rank
+    table (row 0 after the descending sort)."""
+    table = ctx.input("RankTable")
+    return {"Out": table[0, 1].astype(jnp.int64).reshape(1)}
+
+
+@register_op("lod_tensor_to_array", differentiable=False,
+             infer_shape=_no_infer)
+def lod_tensor_to_array(ctx):
+    """reference lod_tensor_to_array_op.cc: split [B, T, ...] into a
+    T-entry tensor array of per-timestep batches in rank order."""
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    order = table[:, 0]
+    xr = x[order]  # rank order
+    arr = TensorArray(jnp.swapaxes(xr, 0, 1)[t] for t in range(x.shape[1]))
+    return {"Out": [arr]}
+
+
+@register_op("array_to_lod_tensor", differentiable=False,
+             infer_shape=_no_infer)
+def array_to_lod_tensor(ctx):
+    """reference array_to_lod_tensor_op.cc: inverse of
+    lod_tensor_to_array -- stack the array and undo the rank permute."""
+    arr = ctx.input("X")
+    table = ctx.input("RankTable")
+    order = table[:, 0]
+    stacked = jnp.stack(list(arr), axis=1)  # [B, T, ...] rank order
+    inv = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=order.dtype))
+    return {"Out": stacked[inv]}
+
+
+@register_op("reorder_lod_tensor_by_rank", differentiable=False,
+             infer_shape=_no_infer)
+def reorder_lod_tensor_by_rank(ctx):
+    """reference reorder_lod_tensor_by_rank_op.cc."""
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    return {"Out": x[table[:, 0]]}
+
+
+@register_op("shrink_rnn_memory", infer_shape=_no_infer)
+def shrink_rnn_memory(ctx):
+    """reference shrink_rnn_memory_op.cc keeps only the rows whose
+    sequence is still active at step I. Static-shape form: full batch
+    out, with finished rows HELD at their input value by the consumer's
+    masking; the active count rides along so user code can still read
+    it (rows are rank-ordered, so active rows are a prefix)."""
+    x = ctx.input("X")
+    table = ctx.input("RankTable")
+    i = ctx.input("I")
+    step = jnp.reshape(i, ()).astype(jnp.int32)
+    active = jnp.sum((table[:, 1] > step).astype(jnp.int32))
+    return {"Out": x, "ActiveCount": active.reshape(1)}
+
+
+@register_op("ifelse", infer_shape=_no_infer,
+             stop_gradient_slots=("Cond",))
+def ifelse(ctx):
+    """reference layers/control_flow.py:1126 IfElse (split_lod_tensor /
+    merge_lod_tensor ops): rows where Cond is true flow through the
+    true block, the rest through the false block, outputs merged back
+    in row order. Static-shape form: BOTH branches run on the full
+    batch (row-independent math, same values the reference computes on
+    its split halves) and a row-wise where() does the merge -- no
+    dynamic shapes, branches fuse into one XLA program."""
+    tb = ctx.attr("true_block")
+    fb = ctx.attr("false_block")
+    t_outs = list(ctx.attr("true_outs"))
+    f_outs = list(ctx.attr("false_outs"))
+    externals = list(ctx.attr("externals"))
+    cond = ctx.input("Cond")
+    exs = dict(zip(externals, ctx.inputs("X")))
+
+    def run_branch(blk, names):
+        env = dict(exs)
+        for op in blk.ops:
+            run_op(op, env, rng_cell=None, rng_salt=op._uid)
+        return [env[n] for n in names]
+
+    tv = run_branch(tb, t_outs)
+    fv = run_branch(fb, f_outs)
+    merged = []
+    for a, b in zip(tv, fv):
+        c = cond.reshape((-1,) + (1,) * (a.ndim - 1)).astype(bool)
+        merged.append(jnp.where(c, a, b))
+    return {"Out": merged}
+
+
+# --------------------------------------------------------------------------
+# lstmp: LSTM with a recurrent projection layer
+# --------------------------------------------------------------------------
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+    "relu": jax.nn.relu, "identity": lambda v: v,
+}
+
+
+@register_op("lstmp", stop_gradient_slots=("SeqLen",))
+def lstmp(ctx):
+    """reference lstmp_op.cc: LSTM whose recurrence runs on a learned
+    projection r_t = act_proj(h_t @ W_proj) (Sak et al. 2014). Input
+    [B,T,4H] pre-projected, Weight [P,4H], ProjWeight [H,P], Bias
+    [1,4H(+3H peepholes)]; gate order i,f,c,o."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    w_proj = ctx.input("ProjWeight")
+    bias = ctx.input("Bias")
+    seq_len = ctx.input("SeqLen")
+    use_peepholes = ctx.attr("use_peepholes", True)
+    is_reverse = ctx.attr("is_reverse", False)
+    act_gate = _ACTS[ctx.attr("gate_activation", "sigmoid")]
+    act_cell = _ACTS[ctx.attr("cell_activation", "tanh")]
+    act_cand = _ACTS[ctx.attr("candidate_activation", "tanh")]
+    act_proj = _ACTS[ctx.attr("proj_activation", "tanh")]
+    b_sz, t, four_h = x.shape
+    h_dim = four_h // 4
+    p_dim = w_proj.shape[1]
+    if bias is not None:
+        x = x + bias[..., :4 * h_dim].reshape(1, 1, 4 * h_dim)
+        if use_peepholes:
+            peep = bias[..., 4 * h_dim:].reshape(3 * h_dim)
+            w_ic, w_fc, w_oc = (peep[:h_dim], peep[h_dim:2 * h_dim],
+                                peep[2 * h_dim:])
+        else:
+            w_ic = w_fc = w_oc = None
+    else:
+        w_ic = w_fc = w_oc = None
+    if seq_len is None:
+        seq_len = jnp.full((b_sz,), t, dtype=jnp.int32)
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    r_init = (act_proj(h0 @ w_proj) if h0 is not None
+              else jnp.zeros((b_sz, p_dim), x.dtype))
+    c_init = c0 if c0 is not None else jnp.zeros((b_sz, h_dim), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    steps = jnp.arange(t)
+    if is_reverse:
+        xs = xs[::-1]
+        steps = steps[::-1]
+
+    def cell(carry, inp):
+        r_prev, c_prev = carry
+        xt, step = inp
+        gates = xt + r_prev @ w
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if w_ic is not None:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i = act_gate(gi)
+        f = act_gate(gf)
+        c = f * c_prev + i * act_cand(gc)
+        if w_oc is not None:
+            go = go + c * w_oc
+        o = act_gate(go)
+        h = o * act_cell(c)
+        r = act_proj(h @ w_proj)
+        valid = (step < seq_len)[:, None].astype(x.dtype)
+        r = valid * r + (1 - valid) * r_prev
+        c = valid * c + (1 - valid) * c_prev
+        return (r, c), (r, c)
+
+    (_, _), (rs, cs) = lax.scan(cell, (r_init, c_init), (xs, steps))
+    if is_reverse:
+        rs, cs = rs[::-1], cs[::-1]
+    return {"Projection": jnp.swapaxes(rs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+# --------------------------------------------------------------------------
+# recurrent: sub-block stepped over time (StaticRNN backend)
+# --------------------------------------------------------------------------
+@register_op("recurrent", differentiable=False, infer_shape=_no_infer)
+def recurrent(ctx):
+    """reference recurrent_op.cc runs its sub-block once per step via an
+    inner executor, linking `memories` across steps. Here the block is
+    traced once and stepped by lax.scan (compiled time loop).
+
+    Layout follows the reference StaticRNN: sequence inputs are
+    TIME-MAJOR [T, ...] and sliced to [...] per step; stacked outputs
+    are [T, ...].
+
+    inputs: X = sequence inputs, Init = initial memory values, Ex =
+    read-only externals, SeqLen (optional [B] lengths; batch is then
+    dim 0 of each slice). attrs: sub_block; x_names (in-block names of
+    the per-step slices); pre_names/mem_names (memory in/out names in
+    the block); out_names (per-step outputs to stack); externals;
+    reverse; mask_memories (DynamicRNN semantics: finished rows hold
+    their memory and emit zeros). outputs: Out = stacked per out_name;
+    MemFinal = final memory values.
+    """
+    sub = ctx.attr("sub_block")
+    x_names = list(ctx.attr("x_names", []))
+    pre_names = list(ctx.attr("pre_names", []))
+    mem_names = list(ctx.attr("mem_names", []))
+    out_names = list(ctx.attr("out_names", []))
+    externals = list(ctx.attr("externals", []))
+    reverse = ctx.attr("reverse", False)
+    mask_memories = ctx.attr("mask_memories", False)
+    batch_major = ctx.attr("batch_major", False)
+    xs = ctx.inputs("X")
+    inits = ctx.inputs("Init")
+    seq_len = ctx.input("SeqLen")
+    exs = dict(zip(externals, ctx.inputs("Ex")))
+    if batch_major:  # DynamicRNN convention: [B, T, ...] outer layout
+        xs = [jnp.swapaxes(x, 0, 1) for x in xs]
+    t = xs[0].shape[0] if xs else ctx.attr("seq_len")
+
+    seq = list(xs)
+    steps = jnp.arange(t)
+    if reverse:
+        seq = [s[::-1] for s in seq]
+        steps = steps[::-1]
+
+    def step(carry, scanned):
+        slices, tstep = scanned
+        env = dict(exs)
+        env.update(zip(x_names, slices))
+        env.update(zip(pre_names, carry))
+        for op in sub.ops:
+            run_op(op, env, rng_cell=None, rng_salt=op._uid)
+        new_carry = tuple(env[n] for n in mem_names)
+        outs = tuple(env[n] for n in out_names)
+        if mask_memories and seq_len is not None:
+            def _mask(new, old):
+                valid = (tstep < seq_len).reshape(
+                    (-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(valid, new, old)
+
+            new_carry = tuple(_mask(n, o)
+                              for n, o in zip(new_carry, carry))
+            outs = tuple(_mask(o, jnp.zeros_like(o)) for o in outs)
+        return new_carry, outs
+
+    final_mem, stacked = lax.scan(step, tuple(inits),
+                                  (tuple(seq), steps), length=t)
+    outs = list(stacked)
+    if reverse:
+        outs = [o[::-1] for o in outs]
+    if batch_major:
+        outs = [jnp.swapaxes(o, 0, 1) for o in outs]
+    return {"Out": outs, "MemFinal": list(final_mem)}

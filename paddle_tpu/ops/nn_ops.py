@@ -68,14 +68,37 @@ def conv2d_transpose(ctx):
     pads = _pair(ctx.attr("paddings", [0, 0]))
     dilations = _pair(ctx.attr("dilations", [1, 1]))
     groups = ctx.attr("groups", 1)
-    out = jax.lax.conv_transpose(
-        x, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
-        strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        transpose_kernel=True)
+    out = _conv_transpose_nd(x, w, strides, pads, dilations, groups,
+                             spatial=2)
     return {"Output": out}
+
+
+def _conv_transpose_nd(x, w, strides, pads, dilations, groups, spatial):
+    """Transpose conv as an input-dilated forward conv (the textbook
+    identity), matching conv_transpose_op.cc's output formula
+    out = (in-1)*s - 2p + d*(k-1) + 1.
+
+    fluid filter layout is [C_in, C_out/g, *k]; the equivalent forward
+    kernel is the spatially-flipped, per-group channel-swapped
+    [C_out, C_in/g, *k]."""
+    ksp = w.shape[2:2 + spatial]
+    c_in = x.shape[1]
+    c_out_per_g = w.shape[1]
+    sp_axes = tuple(range(2, 2 + spatial))
+    w_f = jnp.flip(w, axis=sp_axes)
+    # [C_in, C_out/g, *k] -> [g, C_in/g, C_out/g, *k] -> swap ->
+    # [C_out, C_in/g, *k]
+    w_k = w_f.reshape((groups, c_in // groups, c_out_per_g) + ksp)
+    w_k = jnp.swapaxes(w_k, 1, 2).reshape(
+        (groups * c_out_per_g, c_in // groups) + ksp)
+    tpads = [(dilations[i] * (ksp[i] - 1) - pads[i],) * 2
+             for i in range(spatial)]
+    dn = (("NCHW", "OIHW", "NCHW") if spatial == 2
+          else ("NCDHW", "OIDHW", "NCDHW"))
+    return jax.lax.conv_general_dilated(
+        x, w_k, window_strides=(1,) * spatial, padding=tpads,
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
+        dimension_numbers=dn, feature_group_count=groups)
 
 
 @register_op("conv3d")

@@ -40,6 +40,35 @@ def momentum(ctx):
     return {"ParamOut": p_out, "VelocityOut": v_out}
 
 
+@register_op("dgc_momentum", differentiable=False,
+             inplace={"ParamOut": "Param", "UOut": "U", "VOut": "V"})
+def dgc_momentum(ctx):
+    """Deep Gradient Compression momentum (reference optimizer.py:589 +
+    details/all_reduce_op_handle.cc:65-227 sparse allreduce). The
+    per-worker math lives in parallel/dgc.py dgc_momentum_step; under a
+    GSPMD data-parallel program the incoming Grad is already the global
+    mean, so the compression here governs *update* sparsity; the
+    explicit compressed-wire collective form is
+    parallel.dgc.dgc_allreduce_step for shard_map programs."""
+    from ..parallel.dgc import dgc_momentum_step
+
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    u, v = ctx.input("U"), ctx.input("V")
+    step = ctx.input("CurrentStep").reshape(()).astype(jnp.int32)
+    lr = ctx.input("LearningRate").reshape(())
+    p_out, u_out, v_out = dgc_momentum_step(
+        p, g, u, v, lr,
+        mu=ctx.attr("mu"),
+        step=step,
+        sparsity=list(ctx.attr("sparsity", [0.999])),
+        rampup_begin_step=ctx.attr("rampup_begin_step", 0),
+        rampup_step=ctx.attr("rampup_step", 1),
+        use_nesterov=ctx.attr("use_nesterov", False))
+    # CurrentStep is advanced ONCE per step by the optimizer's
+    # _finish_update increment op, not per-param here
+    return {"ParamOut": p_out, "UOut": u_out, "VOut": v_out}
+
+
 @register_op("lars_momentum", differentiable=False,
              inplace={"ParamOut": "Param", "VelocityOut": "Velocity"})
 def lars_momentum(ctx):

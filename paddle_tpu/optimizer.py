@@ -25,7 +25,8 @@ __all__ = ["SGD", "Momentum", "Adagrad", "Adam", "Adamax",
            "DecayedAdagradOptimizer", "AdadeltaOptimizer",
            "RMSPropOptimizer", "FtrlOptimizer", "LarsMomentum",
            "LarsMomentumOptimizer", "DGCMomentumOptimizer",
-           "ModelAverage", "ExponentialMovingAverage", "Optimizer"]
+           "GradientMergeOptimizer", "ModelAverage",
+           "ExponentialMovingAverage", "Optimizer"]
 
 
 class Optimizer:
@@ -406,19 +407,166 @@ class FtrlOptimizer(Optimizer):
 
 
 class DGCMomentumOptimizer(MomentumOptimizer):
-    """Deep Gradient Compression momentum (reference optimizer.py:589).
+    """Deep Gradient Compression momentum (reference optimizer.py:589 +
+    details/all_reduce_op_handle.cc:65-227 encoded sparse allreduce).
 
-    On TPU, gradient allreduce is compiler-scheduled over ICI and bandwidth
-    is rarely the bottleneck intra-pod; we keep the API and the top-k
-    sparsification semantics (parallel/dgc.py applies the compressed
-    allreduce inside shard_map when enabled)."""
+    Per-param U (velocity) / V (accumulated residual) accumulators feed
+    the ``dgc_momentum`` op: momentum correction, residual
+    accumulation, quantile-threshold selection under the rampup
+    schedule, momentum factor masking (parallel/dgc.py). Before
+    rampup_begin_step the op IS the momentum op (asserted by test).
+    For explicit multi-worker shard_map programs,
+    parallel.dgc.dgc_allreduce_step provides the compressed-wire
+    collective form of the same step."""
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
                  rampup_step=1, sparsity=(0.999,), use_nesterov=False,
                  local_grad_clip_norm=None, num_trainers=None, **kwargs):
         super().__init__(learning_rate, momentum, use_nesterov, **kwargs)
-        self._sparsity = sparsity
+        self._sparsity = list(sparsity)
         self._rampup_begin_step = rampup_begin_step
+        self._rampup_step = rampup_step
+        self._local_grad_clip_norm = local_grad_clip_norm
+        self._step_var = None
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("dgc_u", p)
+            self._add_accumulator("dgc_v", p)
+        if self._step_var is None:
+            helper = LayerHelper("dgc_step")
+            self._step_var = helper.create_global_variable(
+                [1], "float32", persistable=True,
+                name=unique_name.generate("dgc_counter"))
+            helper.set_variable_initializer(
+                self._step_var, ConstantInitializer(0.0))
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        if self._local_grad_clip_norm is not None:
+            from . import layers
+
+            g = layers.clip_by_norm(g, self._local_grad_clip_norm)
+        u = self._get_accumulator("dgc_u", p)
+        v = self._get_accumulator("dgc_v", p)
+        return block.append_op(
+            "dgc_momentum",
+            {"Param": p, "Grad": g, "U": u, "V": v,
+             "CurrentStep": self._step_var,
+             "LearningRate": self._create_param_lr(param_and_grad)},
+            {"ParamOut": p, "UOut": u, "VOut": v},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov,
+             "sparsity": self._sparsity,
+             "rampup_begin_step": self._rampup_begin_step,
+             "rampup_step": self._rampup_step,
+             "op_role": "optimize"})
+
+    def _finish_update(self, block, parameters_and_grads):
+        # one shared step counter, advanced once per optimize pass
+        block.append_op("increment",
+                        {"X": self._step_var}, {"Out": self._step_var},
+                        {"step": 1.0, "op_role": "optimize"})
+
+
+class GradientMergeOptimizer(Optimizer):
+    """Gradient accumulation / batch merge: accumulate grads over
+    k_steps micro-batches, apply the inner optimizer once with the
+    merged (averaged) gradient.
+
+    Reference: ir/multi_batch_merge_pass.cc repeats the fwd/bwd
+    sub-graph k times per SSA-executor run and applies optimize ops
+    once; the pserver side merges k trainer grads
+    (distribute_transpiler.py:1649). TPU-native form: ONE compiled
+    program runs every micro-step; grads flow into persistable
+    accumulators, and the whole optimize section runs inside a
+    ``run_block_if`` op (lax.cond) gated on the k-th step -- no
+    program switching, no retrace, optimizer state (momentum, adam
+    moments, step counters) advances only on apply steps.
+    """
+
+    def __init__(self, inner_optimizer, k_steps, avg=True):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        super().__init__(0.0)
+        self._inner = inner_optimizer
+        self._k = int(k_steps)
+        self._avg = avg
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from . import layers
+
+        prog = default_main_program()
+        block = prog.global_block
+        params_grads = self._inner.backward(
+            loss, startup_program, parameter_list, no_grad_set)
+        params_grads = sorted(params_grads, key=lambda x: x[0].name)
+        helper = LayerHelper("gradient_merge")
+
+        accs = {}
+        for p, g in params_grads:
+            if g is None:
+                continue
+            acc = helper.create_global_variable(
+                list(p.shape), p.dtype, persistable=True,
+                name=unique_name.generate(p.name + "@GRAD@MERGE"))
+            helper.set_variable_initializer(acc,
+                                            ConstantInitializer(0.0))
+            block.append_op("elementwise_add", {"X": acc, "Y": g},
+                            {"Out": acc}, {"op_role": "optimize"})
+            accs[p.name] = acc
+        step_var = helper.create_global_variable(
+            [1], "float32", persistable=True,
+            name=unique_name.generate("gmerge_step"))
+        helper.set_variable_initializer(step_var,
+                                        ConstantInitializer(0.0))
+        block.append_op("increment", {"X": step_var}, {"Out": step_var},
+                        {"step": 1.0, "op_role": "optimize"})
+        k_var = layers.fill_constant([1], "float32", float(self._k))
+        pred = layers.equal(layers.elementwise_mod(step_var, k_var),
+                            layers.fill_constant([1], "float32", 0.0))
+
+        # the lr var must exist before the sub-block reads it
+        self._inner._create_global_learning_rate()
+
+        sub = prog.create_block()
+        merged = []
+        for p, g in params_grads:
+            if g is None:
+                merged.append((p, None))
+                continue
+            mg = accs[p.name]
+            if self._avg:
+                mg = layers.scale(mg, scale=1.0 / self._k)
+            merged.append((p, mg))
+        merged = append_gradient_clip_ops(
+            [(p, g) for p, g in merged if g is not None])
+        merged = append_regularization_ops(merged,
+                                           self._inner.regularization)
+        self._inner._create_accumulators(
+            sub, [p for p, g in merged if g is not None])
+        optimize_ops = []
+        for pg in merged:
+            if pg[1] is None or not pg[0].trainable:
+                continue
+            optimize_ops.append(self._inner._append_optimize_op(sub, pg))
+        self._inner._finish_update(sub, merged)
+        for acc in accs.values():
+            sub.append_op("scale", {"X": acc}, {"Out": acc},
+                          {"scale": 0.0, "op_role": "optimize"})
+        prog.rollback()
+        parent = prog.current_block()
+
+        from .layers.control_flow import _block_io_analysis
+
+        carried, externals = _block_io_analysis(sub, parent)
+        parent.append_op(
+            "run_block_if",
+            {"Condition": pred.name, "X": externals, "Init": carried},
+            {"Out": carried},
+            {"sub_block": sub, "carried": carried,
+             "externals": externals, "op_role": "optimize"})
+        return optimize_ops, params_grads
 
 
 # fluid exposes both Foo and FooOptimizer names

@@ -1,0 +1,207 @@
+"""StaticRNN / DynamicRNN / Switch / IfElse as real constructs
+(reference layers/control_flow.py:266 StaticRNN, :1262 DynamicRNN,
+:1126 Switch/IfElse; lowered to the recurrent/run_block_if/ifelse ops).
+
+The snippets mirror reference user code: PTB-style DynamicRNN
+(tests/unittests/test_dyn_rnn.py), piecewise-decay Switch
+(learning_rate_scheduler.py piecewise_decay), IfElse batch split
+(test_ifelse.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _exe():
+    return fluid.Executor(fluid.CPUPlace())
+
+
+class TestStaticRNN:
+    def test_accumulator_matches_numpy(self):
+        # rnn accumulates x_t + m_{t-1}; time-major input [T, B, D]
+        t, b, d = 5, 3, 4
+        x_np = np.random.RandomState(0).randn(t, b, d).astype(
+            np.float32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[t, b, d],
+                                  dtype="float32",
+                                  append_batch_size=False)
+            rnn = fluid.layers.StaticRNN()
+            with rnn.step():
+                xt = rnn.step_input(x)
+                mem = rnn.memory(shape=[b, d], batch_ref=x,
+                                 init_value=0.0, init_batch_dim_idx=0,
+                                 ref_batch_dim_idx=1)
+                acc = fluid.layers.elementwise_add(xt, mem)
+                rnn.update_memory(mem, acc)
+                rnn.step_output(acc)
+            out = rnn()
+        got, = _exe().run(prog, feed={"x": x_np}, fetch_list=[out])
+        np.testing.assert_allclose(got, np.cumsum(x_np, axis=0),
+                                   rtol=1e-5)
+
+    def test_fc_rnn_trains(self):
+        t, b, d, h = 4, 6, 5, 8
+        rng = np.random.RandomState(1)
+        x_np = rng.randn(t, b, d).astype(np.float32)
+        y_np = rng.randn(b, 1).astype(np.float32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[t, b, d],
+                                  dtype="float32",
+                                  append_batch_size=False)
+            y = fluid.layers.data(name="y", shape=[b, 1],
+                                  dtype="float32",
+                                  append_batch_size=False)
+            rnn = fluid.layers.StaticRNN()
+            with rnn.step():
+                xt = rnn.step_input(x)
+                mem = rnn.memory(shape=[b, h], batch_ref=x,
+                                 init_value=0.0)
+                nxt = fluid.layers.fc([xt, mem], size=h, act="tanh")
+                rnn.update_memory(mem, nxt)
+                rnn.step_output(nxt)
+            seq = rnn()  # [T, B, H]
+            last = fluid.layers.slice(seq, axes=[0], starts=[t - 1],
+                                      ends=[t])
+            last = fluid.layers.reshape(last, shape=[b, h])
+            pred = fluid.layers.fc(last, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+        exe = _exe()
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(
+            prog, feed={"x": x_np, "y": y_np},
+            fetch_list=[loss])[0]).reshape(-1)[0]) for _ in range(20)]
+        assert ls[-1] < ls[0] * 0.9
+
+
+class TestDynamicRNN:
+    def test_ptb_style_varlen_rnn(self):
+        # reference test_dyn_rnn.py shape: embedded sentence ->
+        # DynamicRNN fc step with memory -> last step state
+        b, t, d, h = 4, 6, 5, 8
+        rng = np.random.RandomState(2)
+        x_np = rng.randn(b, t, d).astype(np.float32)
+        lens = np.array([6, 3, 5, 1], np.int32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            sent = fluid.layers.data(name="sent", shape=[t, d],
+                                     dtype="float32")
+            drnn = fluid.layers.DynamicRNN()
+            with drnn.block():
+                word = drnn.step_input(sent)
+                prev = drnn.memory(shape=[h], value=0.0)
+                hidden = fluid.layers.fc([word, prev], size=h,
+                                         act="relu")
+                drnn.update_memory(prev, hidden)
+                drnn.output(hidden)
+            out = drnn()  # [B, T, H] + @SEQ_LEN
+            last = fluid.layers.sequence_last_step(out)
+        exe = _exe()
+        exe.run(startup)
+        o, l = exe.run(prog,
+                       feed={"sent": x_np, "sent@SEQ_LEN": lens},
+                       fetch_list=[out, last])
+        assert o.shape == (b, t, h)
+        # masked beyond length: zeros
+        assert np.abs(o[1, 3:]).sum() == 0
+        assert np.abs(o[3, 1:]).sum() == 0
+        # last step = state at len-1
+        np.testing.assert_allclose(l[1], o[1, 2], rtol=1e-6)
+        np.testing.assert_allclose(l[0], o[0, 5], rtol=1e-6)
+
+    def test_trains_binary_classifier(self):
+        b, t, d, h = 8, 5, 4, 8
+        rng = np.random.RandomState(3)
+        x_np = rng.randn(b, t, d).astype(np.float32)
+        y_np = (x_np.sum((1, 2)) > 0).astype(np.int64)[:, None]
+        lens = np.full((b,), t, np.int32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            sent = fluid.layers.data(name="sent", shape=[t, d],
+                                     dtype="float32")
+            label = fluid.layers.data(name="y", shape=[1],
+                                      dtype="int64")
+            drnn = fluid.layers.DynamicRNN()
+            with drnn.block():
+                word = drnn.step_input(sent)
+                prev = drnn.memory(shape=[h], value=0.0)
+                hidden = fluid.layers.fc([word, prev], size=h,
+                                         act="tanh")
+                drnn.update_memory(prev, hidden)
+                drnn.output(hidden)
+            last = fluid.layers.sequence_last_step(drnn())
+            logits = fluid.layers.fc(last, size=2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.SGD(0.5).minimize(loss)
+        exe = _exe()
+        exe.run(startup)
+        feed = {"sent": x_np, "sent@SEQ_LEN": lens, "y": y_np}
+        ls = [float(np.asarray(exe.run(prog, feed=feed,
+                                       fetch_list=[loss])[0])
+                    .reshape(-1)[0]) for _ in range(30)]
+        assert ls[-1] < ls[0] * 0.5
+
+
+class TestSwitch:
+    def _piecewise(self, step_value):
+        # the reference piecewise-decay snippet, run unchanged
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            step = fluid.layers.fill_constant([1], "float32",
+                                              float(step_value))
+            lr = fluid.layers.tensor.create_global_var(
+                [1], 0.0, "float32", persistable=True, name="sw_lr")
+            with fluid.layers.Switch() as switch:
+                with switch.case(fluid.layers.less_than_value(
+                        step, 100.0)):
+                    fluid.layers.tensor.assign(
+                        fluid.layers.fill_constant([1], "float32",
+                                                   1.0), lr)
+                with switch.case(fluid.layers.less_than_value(
+                        step, 200.0)):
+                    fluid.layers.tensor.assign(
+                        fluid.layers.fill_constant([1], "float32",
+                                                   0.5), lr)
+                with switch.default():
+                    fluid.layers.tensor.assign(
+                        fluid.layers.fill_constant([1], "float32",
+                                                   0.1), lr)
+        exe = _exe()
+        exe.run(startup)
+        out, = exe.run(prog, fetch_list=[lr])
+        return float(np.asarray(out).reshape(-1)[0])
+
+    def test_first_true_case_wins(self):
+        assert self._piecewise(50) == pytest.approx(1.0)
+        assert self._piecewise(150) == pytest.approx(0.5)
+        assert self._piecewise(500) == pytest.approx(0.1)
+
+
+class TestIfElse:
+    def test_rowwise_split_merge(self):
+        # reference test_ifelse.py pattern: rows < 0 negated, rows >= 0
+        # doubled, merged in order
+        x_np = np.array([[-2.0], [3.0], [-1.0], [4.0]], np.float32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[1],
+                                  dtype="float32")
+            zero = fluid.layers.fill_constant([1], "float32", 0.0)
+            cond = fluid.layers.less_than(x, zero)
+            ie = fluid.layers.IfElse(cond)
+            with ie.true_block():
+                d = ie.input(x)
+                ie.output(fluid.layers.scale(d, scale=-1.0))
+            with ie.false_block():
+                d = ie.input(x)
+                ie.output(fluid.layers.scale(d, scale=2.0))
+            out = ie()[0]
+        got, = _exe().run(prog, feed={"x": x_np}, fetch_list=[out])
+        np.testing.assert_allclose(
+            got, [[2.0], [6.0], [1.0], [8.0]], rtol=1e-6)

@@ -1,0 +1,621 @@
+"""OpTests for the round-2 op-gap ops (numpy oracles + fd grad checks).
+
+Parity model: reference tests/unittests/test_pool3d_op.py,
+test_pool_max_op.py, test_conv3d_transpose_op.py, test_unpool_op.py,
+test_spp_op.py, test_bilinear_tensor_product_op.py,
+test_rank_loss_op.py, test_modified_huber_loss_op.py,
+test_squared_l2_distance_op.py, test_conv_shift_op.py,
+test_add_position_encoding_op.py, test_data_norm_op.py,
+test_random_crop_op.py, test_is_empty_op.py, test_lstmp_op.py,
+test_lod_rank_table.py, test_lod_tensor_array_ops.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+def _np_pool3d(x, ksize, strides, pads, ptype):
+    n, c, d, h, w = x.shape
+    od = (d + 2 * pads[0] - ksize[0]) // strides[0] + 1
+    oh = (h + 2 * pads[1] - ksize[1]) // strides[1] + 1
+    ow = (w + 2 * pads[2] - ksize[2]) // strides[2] + 1
+    out = np.zeros((n, c, od, oh, ow), np.float32)
+    xp = np.pad(x, ((0, 0), (0, 0)) + tuple((p, p) for p in pads),
+                constant_values=-np.inf if ptype == "max" else 0.0)
+    for i in range(od):
+        for j in range(oh):
+            for k in range(ow):
+                win = xp[:, :,
+                         i * strides[0]:i * strides[0] + ksize[0],
+                         j * strides[1]:j * strides[1] + ksize[1],
+                         k * strides[2]:k * strides[2] + ksize[2]]
+                if ptype == "max":
+                    out[:, :, i, j, k] = win.max(axis=(2, 3, 4))
+                else:
+                    out[:, :, i, j, k] = win.mean(axis=(2, 3, 4))
+    return out
+
+
+class TestPool3dMax(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "pool3d"
+        x = np.random.random((2, 3, 6, 6, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2, 2],
+                      "strides": [2, 2, 2], "paddings": [0, 0, 0]}
+        self.outputs = {"Out": _np_pool3d(x, [2] * 3, [2] * 3, [0] * 3,
+                                          "max")}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPool3dAvgPadded(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "pool3d"
+        x = np.random.random((1, 2, 4, 4, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2, 2],
+                      "strides": [2, 2, 2], "paddings": [0, 0, 0],
+                      "exclusive": True}
+        self.outputs = {"Out": _np_pool3d(x, [2] * 3, [2] * 3, [0] * 3,
+                                          "avg")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        # fd grad on the smooth avg pool (max has kink points where
+        # central differences disagree with the subgradient)
+        self.check_grad(["X"], "Out")
+
+
+class TestMaxPool2dWithIndex(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "max_pool2d_with_index"
+        x = np.random.random((2, 3, 6, 6)).astype("float32")
+        n, c, h, w = x.shape
+        out = np.zeros((n, c, 3, 3), np.float32)
+        mask = np.zeros((n, c, 3, 3), np.int32)
+        for i in range(3):
+            for j in range(3):
+                win = x[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                wf = win.reshape(n, c, -1)
+                arg = wf.argmax(-1)
+                out[:, :, i, j] = wf.max(-1)
+                dh, dw = np.unravel_index(arg, (2, 2))
+                mask[:, :, i, j] = (2 * i + dh) * w + (2 * j + dw)
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0]}
+        self.outputs = {"Out": out, "Mask": mask}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMaxPool3dWithIndex(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "max_pool3d_with_index"
+        x = np.random.random((1, 2, 4, 4, 4)).astype("float32")
+        n, c, d, h, w = x.shape
+        out = np.zeros((n, c, 2, 2, 2), np.float32)
+        mask = np.zeros((n, c, 2, 2, 2), np.int32)
+        for i in range(2):
+            for j in range(2):
+                for k in range(2):
+                    win = x[:, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2,
+                            2 * k:2 * k + 2]
+                    wf = win.reshape(n, c, -1)
+                    arg = wf.argmax(-1)
+                    out[:, :, i, j, k] = wf.max(-1)
+                    dd, dh, dw = np.unravel_index(arg, (2, 2, 2))
+                    mask[:, :, i, j, k] = ((2 * i + dd) * h +
+                                           (2 * j + dh)) * w + \
+                        (2 * k + dw)
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                      "paddings": [0, 0, 0]}
+        self.outputs = {"Out": out, "Mask": mask}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestUnpoolRoundTrip:
+    def test_unpool_inverts_max_pool(self):
+        x = np.arange(32, dtype=np.float32).reshape(1, 2, 4, 4)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xin = fluid.layers.data(name="x", shape=[2, 4, 4],
+                                    dtype="float32")
+            pooled, mask = fluid.layers.max_pool2d_with_index(
+                xin, pool_size=2, pool_stride=2)
+            restored = fluid.layers.unpool(pooled, mask, pool_size=2,
+                                           pool_stride=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        p, m, r = exe.run(prog, feed={"x": x},
+                          fetch_list=[pooled, mask, restored])
+        assert p.shape == (1, 2, 2, 2)
+        # restored has pooled max values at their original positions
+        expect = np.zeros_like(x)
+        for ci in range(2):
+            for i in range(2):
+                for j in range(2):
+                    idx = m[0, ci, i, j]
+                    expect[0, ci, idx // 4, idx % 4] = p[0, ci, i, j]
+        np.testing.assert_allclose(r, expect)
+
+
+class TestSpp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "spp"
+        x = np.random.random((2, 3, 4, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pyramid_height": 2, "pooling_type": "max"}
+        # level 0: global max [N,C]; level 1: 2x2 max bins [N,C*4]
+        l0 = x.max(axis=(2, 3)).reshape(2, -1)
+        l1 = np.zeros((2, 3, 2, 2), np.float32)
+        for i in range(2):
+            for j in range(2):
+                l1[:, :, i, j] = x[:, :, 2 * i:2 * i + 2,
+                                   2 * j:2 * j + 2].max(axis=(2, 3))
+        self.outputs = {"Out": np.concatenate(
+            [l0, l1.reshape(2, -1)], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestConv3dTranspose:
+    def test_matches_scipy_style_oracle(self):
+        # stride-2 transpose conv of a delta kernel = upsample + copy
+        x = np.random.randn(1, 1, 3, 3, 3).astype(np.float32)
+        w = np.zeros((1, 1, 2, 2, 2), np.float32)
+        w[0, 0, 0, 0, 0] = 1.0
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xin = fluid.layers.data(name="x", shape=[1, 3, 3, 3],
+                                    dtype="float32")
+            out = fluid.layers.conv3d_transpose(
+                xin, num_filters=1, filter_size=2, stride=2,
+                param_attr=fluid.ParamAttr(
+                    name="w3t",
+                    initializer=fluid.initializer.NumpyArrayInitializer(
+                        w)),
+                bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got, = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        assert got.shape == (1, 1, 6, 6, 6)
+        np.testing.assert_allclose(got[0, 0, ::2, ::2, ::2],
+                                   x[0, 0], rtol=1e-5)
+        assert abs(got[0, 0, 1::2].sum()) < 1e-5
+
+
+class TestBilinearTensorProduct(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "bilinear_tensor_product"
+        x = np.random.random((4, 5)).astype("float32")
+        y = np.random.random((4, 6)).astype("float32")
+        w = np.random.random((3, 5, 6)).astype("float32")
+        b = np.random.random((1, 3)).astype("float32")
+        self.inputs = {"X": x, "Y": y, "Weight": w, "Bias": b}
+        self.outputs = {"Out": np.einsum("bi,kij,bj->bk", x, w, y) + b}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y", "Weight"], "Out")
+
+
+class TestRankLoss(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "rank_loss"
+        label = np.random.randint(0, 2, (8, 1)).astype("float32")
+        left = np.random.random((8, 1)).astype("float32")
+        right = np.random.random((8, 1)).astype("float32")
+        o = left - right
+        self.inputs = {"Label": label, "Left": left, "Right": right}
+        self.outputs = {"Out": np.log1p(np.exp(o)) - label * o}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["Left", "Right"], "Out")
+
+
+class TestModifiedHuberLoss(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "modified_huber_loss"
+        x = np.random.uniform(-2, 2, (10, 1)).astype("float32")
+        y = np.random.randint(0, 2, (10, 1)).astype("float32")
+        z = x * (2 * y - 1)
+        loss = np.where(z < -1, -4.0 * z,
+                        np.where(z < 1, (1 - z) ** 2, 0.0))
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"IntermediateVal": z,
+                        "Out": loss.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSquaredL2Distance(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "squared_l2_distance"
+        x = np.random.random((6, 4)).astype("float32")
+        y = np.random.random((6, 4)).astype("float32")
+        sub = x - y
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"sub_result": sub,
+                        "Out": (sub * sub).sum(1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestTeacherStudentSigmoidLoss(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "teacher_student_sigmoid_loss"
+        x = np.random.uniform(-3, 3, (12, 1)).astype("float32")
+        label = np.array([[-2.0], [-1.5], [-1.0], [-0.5], [0.0],
+                          [0.3], [0.7], [1.0], [1.2], [1.9], [-2.0],
+                          [0.5]], np.float32)
+        sp = np.maximum(x, 0) + np.log1p(np.exp(-np.abs(x)))
+        y = np.where(label < -1.0, sp,
+                     np.where(label < 0.0, sp - x,
+                              np.where(label < 1.0,
+                                       2 * sp - x * label,
+                                       2 * sp - x - x * (label - 1))))
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestConvShift(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "conv_shift"
+        x = np.random.random((3, 8)).astype("float32")
+        y = np.random.random((3, 3)).astype("float32")
+        n, w = 8, 3
+        out = np.zeros_like(x)
+        for b in range(3):
+            for i in range(n):
+                for j in range(w):
+                    out[b, i] += x[b, (i + j - w // 2) % n] * y[b, j]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestAddPositionEncoding(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "add_position_encoding"
+        x = np.random.random((2, 5, 8)).astype("float32")
+        alpha, beta = 0.7, 1.3
+        half = 4
+        pe = np.zeros((5, 8), np.float32)
+        for j in range(5):
+            for k in range(half):
+                val = j / np.power(10000.0, k / (half - 1))
+                pe[j, k] = np.sin(val)
+                pe[j, half + k] = np.cos(val)
+        self.inputs = {"X": x}
+        self.attrs = {"alpha": alpha, "beta": beta}
+        self.outputs = {"Out": alpha * x + beta * pe[None]}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestDataNorm(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "data_norm"
+        x = np.random.random((6, 3)).astype("float32")
+        bsize = np.full((3,), 10.0, np.float32)
+        bsum = np.random.random((3,)).astype("float32") * 10
+        bsq = np.full((3,), 40.0, np.float32)
+        means = bsum / bsize
+        scales = np.sqrt(bsize / bsq)
+        self.inputs = {"X": x, "BatchSize": bsize, "BatchSum": bsum,
+                       "BatchSquareSum": bsq}
+        self.outputs = {"Y": (x - means) * scales, "Means": means,
+                        "Scales": scales}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestRandomCropAndIsEmpty:
+    def test_random_crop_shape_and_content(self):
+        x = np.arange(2 * 8 * 8, dtype=np.float32).reshape(2, 8, 8)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xin = fluid.layers.data(name="x", shape=[8, 8],
+                                    dtype="float32")
+            out = fluid.layers.random_crop(xin, shape=[5, 5])
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        assert got.shape == (2, 5, 5)
+        # each crop is a contiguous sub-grid of the source instance
+        for b in range(2):
+            r0 = got[b, 0, 0]
+            i, j = divmod(int(r0) - 64 * b, 8)
+            np.testing.assert_array_equal(
+                got[b], x[b, i:i + 5, j:j + 5])
+
+    def test_is_empty(self):
+        x = np.zeros((0, 3), np.float32)
+        y = np.ones((2, 3), np.float32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xin = fluid.layers.data(name="x", shape=[3],
+                                    dtype="float32")
+            yin = fluid.layers.data(name="y", shape=[3],
+                                    dtype="float32")
+            ex = fluid.layers.is_empty(xin)
+            ey = fluid.layers.is_empty(yin)
+        exe = fluid.Executor(fluid.CPUPlace())
+        a, b = exe.run(prog, feed={"x": x, "y": y},
+                       fetch_list=[ex, ey])
+        assert bool(a) is True and bool(b) is False
+
+
+class TestLstmp:
+    def test_projection_shapes_and_masking(self):
+        b, t, h, p = 3, 6, 8, 4
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[t, 4 * h],
+                                  dtype="float32")
+            proj, cell = fluid.layers.dynamic_lstmp(
+                x, size=4 * h, proj_size=p, use_peepholes=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs = np.random.randn(b, t, 4 * h).astype(np.float32)
+        lens = np.array([6, 3, 1], np.int32)
+        pr, cl = exe.run(prog, feed={"x": xs, "x@SEQ_LEN": lens},
+                         fetch_list=[proj, cell])
+        assert pr.shape == (b, t, p) and cl.shape == (b, t, h)
+        # beyond each row's length the projection is held constant
+        np.testing.assert_allclose(pr[1, 3], pr[1, 2], rtol=1e-6)
+        np.testing.assert_allclose(pr[2, 5], pr[2, 0], rtol=1e-6)
+
+    def test_trains(self):
+        b, t, h, p = 4, 5, 8, 4
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[t, 4 * h],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            proj, _ = fluid.layers.dynamic_lstmp(
+                x, size=4 * h, proj_size=p, use_peepholes=False)
+            last = fluid.layers.sequence_last_step(proj)
+            pred = fluid.layers.fc(last, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xs = np.random.randn(b, t, 4 * h).astype(np.float32)
+        ys = np.random.randn(b, 1).astype(np.float32)
+        lens = np.full((b,), t, np.int32)
+        ls = [float(exe.run(prog,
+                            feed={"x": xs, "x@SEQ_LEN": lens, "y": ys},
+                            fetch_list=[loss])[0]) for _ in range(15)]
+        assert ls[-1] < ls[0]
+
+
+class TestLodMachinery:
+    def test_rank_table_array_roundtrip(self):
+        b, t, d = 4, 5, 2
+        x = np.random.randn(b, t, d).astype(np.float32)
+        lens = np.array([2, 5, 3, 1], np.int32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xin = fluid.layers.data(name="x", shape=[t, d],
+                                    dtype="float32")
+            table = fluid.layers.lod_rank_table(xin)
+            maxlen = fluid.layers.max_sequence_len(table)
+            arr = fluid.layers.lod_tensor_to_array(xin, table)
+            back = fluid.layers.array_to_lod_tensor(arr, table)
+            reord = fluid.layers.reorder_lod_tensor_by_rank(xin, table)
+        exe = fluid.Executor(fluid.CPUPlace())
+        tb, ml, bk, ro = exe.run(
+            prog, feed={"x": x, "x@SEQ_LEN": lens},
+            fetch_list=[table, maxlen, back, reord])
+        # rank table: indices sorted by length desc (stable)
+        np.testing.assert_array_equal(tb[:, 0], [1, 2, 0, 3])
+        np.testing.assert_array_equal(tb[:, 1], [5, 3, 2, 1])
+        assert int(ml) == 5
+        np.testing.assert_allclose(bk, x, rtol=1e-6)  # round trip
+        np.testing.assert_allclose(ro, x[[1, 2, 0, 3]], rtol=1e-6)
+
+
+class TestSaveLoadOps:
+    def test_save_load_roundtrip(self, tmp_path):
+        x = np.random.randn(3, 4).astype(np.float32)
+        path = str(tmp_path / "var_x")
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xin = fluid.layers.data(name="x", shape=[4],
+                                    dtype="float32")
+            helper = fluid.layers.nn.LayerHelper("save", input=xin)
+            helper.append_op("save", {"X": xin}, {},
+                             {"file_path": path})
+            out = prog.global_block.create_var(
+                name="loaded", shape=(3, 4), dtype="float32")
+            helper.append_op("load", {}, {"Out": out},
+                             {"file_path": path, "shape": [3, 4],
+                              "dtype": "float32"})
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        np.testing.assert_allclose(got, x, rtol=1e-6)
+
+    def test_save_combine_load_combine(self, tmp_path):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(4).astype(np.float32)
+        path = str(tmp_path / "combined")
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            ain = fluid.layers.data(name="a", shape=[3],
+                                    dtype="float32")
+            bin_ = fluid.layers.data(name="b", shape=[4],
+                                     dtype="float32")
+            helper = fluid.layers.nn.LayerHelper("save_combine",
+                                                 input=ain)
+            helper.append_op("save_combine",
+                             {"X": [ain, bin_]}, {},
+                             {"file_path": path})
+            la = prog.global_block.create_var(name="a", shape=(2, 3),
+                                              dtype="float32")
+            lb = prog.global_block.create_var(name="b", shape=(4,),
+                                              dtype="float32")
+            out_a = prog.global_block.create_var(
+                name="la", shape=(2, 3), dtype="float32")
+            out_b = prog.global_block.create_var(
+                name="lb", shape=(4,), dtype="float32")
+            helper.append_op("load_combine", {},
+                             {"Out": [out_a, out_b]},
+                             {"file_path": path,
+                              "names": ["a", "b"],
+                              "shapes": [[2, 3], [4]],
+                              "dtypes": ["float32", "float32"]})
+        exe = fluid.Executor(fluid.CPUPlace())
+        ga, gb = exe.run(prog, feed={"a": a, "b": b},
+                         fetch_list=[out_a, out_b])
+        np.testing.assert_allclose(ga, a, rtol=1e-6)
+        np.testing.assert_allclose(gb, b, rtol=1e-6)
+
+
+class TestSelectedRowsBridges:
+    def test_merge_and_densify(self):
+        rows = np.array([3, 1, 3, 0], np.int64)
+        vals = np.arange(8, dtype=np.float32).reshape(4, 2)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            rin = fluid.layers.data(name="r", shape=[4], dtype="int64",
+                                    append_batch_size=False)
+            vin = fluid.layers.data(name="v", shape=[4, 2],
+                                    dtype="float32",
+                                    append_batch_size=False)
+            helper = fluid.layers.nn.LayerHelper("msr", input=rin)
+            orow = prog.global_block.create_var(name="orow")
+            oval = prog.global_block.create_var(name="oval")
+            helper.append_op("merge_selected_rows",
+                             {"Rows": rin, "Values": vin},
+                             {"OutRows": orow, "OutValues": oval}, {})
+            dense = prog.global_block.create_var(name="dense")
+            helper.append_op("get_tensor_from_selected_rows",
+                             {"Rows": orow, "Values": oval},
+                             {"Out": dense}, {"height": 5})
+        exe = fluid.Executor(fluid.CPUPlace())
+        gr, gv, gd = exe.run(prog, feed={"r": rows, "v": vals},
+                             fetch_list=[orow, oval, dense])
+        np.testing.assert_array_equal(gr, [3, 1, -1, 0])
+        np.testing.assert_allclose(gv[0], vals[0] + vals[2])
+        np.testing.assert_allclose(gv[2], 0)
+        expect = np.zeros((5, 2), np.float32)
+        expect[3] = vals[0] + vals[2]
+        expect[1] = vals[1]
+        expect[0] = vals[3]
+        np.testing.assert_allclose(gd, expect)
+
+
+class TestPrintOp:
+    def test_print_passthrough(self, capfd):
+        x = np.ones((2, 2), np.float32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xin = fluid.layers.data(name="x", shape=[2],
+                                    dtype="float32")
+            out = fluid.layers.Print(xin, message="dbg:", summarize=2)
+            out2 = fluid.layers.scale(out, scale=2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(prog, feed={"x": x}, fetch_list=[out2])
+        np.testing.assert_allclose(got, 2 * x)
+
+
+class TestConvTransposeVsTorch:
+    """Kernel-orientation regression (review finding): fluid filter
+    layout is [C_in, C_out/g, *k]; outputs must match
+    torch.conv_transpose{2,3}d for C_in != C_out, groups, dilation."""
+
+    def _run2d(self, x, w, stride, pad, dilation, groups):
+        from paddle_tpu.ops.nn_ops import _conv_transpose_nd
+
+        return np.asarray(_conv_transpose_nd(
+            x, w, [stride] * 2, [pad] * 2, [dilation] * 2, groups, 2))
+
+    def test_channels_differ(self):
+        import torch
+        import torch.nn.functional as F
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 7, 7).astype(np.float32)
+        w = rng.randn(3, 5, 3, 3).astype(np.float32)
+        ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                 stride=2, padding=1).numpy()
+        np.testing.assert_allclose(self._run2d(x, w, 2, 1, 1, 1), ref,
+                                   atol=1e-4)
+
+    def test_grouped(self):
+        import torch
+        import torch.nn.functional as F
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 4, 5, 5).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        ref = F.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                                 groups=2).numpy()
+        np.testing.assert_allclose(self._run2d(x, w, 1, 0, 1, 2), ref,
+                                   atol=1e-4)
+
+    def test_layer_conv2d_transpose_c_in_ne_c_out(self):
+        # end-to-end through the layer (used to crash at trace time)
+        x = np.random.randn(1, 3, 4, 4).astype(np.float32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            xin = fluid.layers.data(name="x", shape=[3, 4, 4],
+                                    dtype="float32")
+            out = fluid.layers.conv2d_transpose(
+                xin, num_filters=4, filter_size=3, stride=2,
+                bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got, = exe.run(prog, feed={"x": x}, fetch_list=[out])
+        assert got.shape == (1, 4, 9, 9)
