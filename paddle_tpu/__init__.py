@@ -33,7 +33,8 @@ from . import metrics
 from . import profiler
 from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_params, load_persistables, save_inference_model,
-                 load_inference_model)
+                 load_inference_model, save_sharded_persistables,
+                 load_sharded_persistables)
 from .core.compiler import CompiledProgram, BuildStrategy, \
     ExecutionStrategy, ParallelExecutor
 from .data_feeder import DataFeeder

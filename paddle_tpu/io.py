@@ -24,7 +24,8 @@ from .core.scope import global_scope
 __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
            "load_inference_model", "get_program_parameter",
-           "get_program_persistable_vars"]
+           "get_program_persistable_vars", "save_sharded_persistables",
+           "load_sharded_persistables"]
 
 _MODEL_FILE = "__model__"
 
@@ -93,11 +94,87 @@ def save_params(executor, dirname, main_program=None, filename=None):
 
 def save_persistables(executor, dirname, main_program=None,
                       filename=None):
-    """reference io.py:443."""
+    """reference io.py:443; distributed programs (a transpiled trainer
+    with a distributed lookup table) route through
+    _save_distributed_persistables like the reference does."""
     main_program = main_program or default_main_program()
+    if getattr(main_program, "_distributed_lookup_table", None):
+        if filename is not None:
+            raise ValueError(
+                "filename is not supported when saving a program with "
+                "a distributed lookup table (each pserver persists its "
+                "own shard); the reference rejects this combination "
+                "too (io.py:443)")
+        return _save_distributed_persistables(executor, dirname,
+                                              main_program)
     return save_vars(executor, dirname, main_program,
                      vars=get_program_persistable_vars(main_program),
                      filename=filename)
+
+
+def _save_distributed_persistables(executor, dirname, main_program):
+    """reference io.py:263: save local persistables, then
+    checkpoint-notify every pserver so each persists ITS shard of the
+    distributed lookup table under dirname/__lookup_table__/."""
+    table = main_program._distributed_lookup_table
+    eps = getattr(main_program, "_pserver_endpoints", [])
+    local = [v for v in get_program_persistable_vars(main_program)
+             if v.name != table]
+    save_vars(executor, dirname, main_program, vars=local)
+    notify = Program()
+    blk = notify.global_block
+    blk.append_op("checkpoint_notify", {}, {},
+                  {"epmap": list(eps), "dir": dirname,
+                   "lookup_table": table})
+    executor.run(notify)
+
+
+def save_sharded_persistables(executor, dirname, main_program=None,
+                              scope=None):
+    """Orbax-style sharded checkpoint of every persistable
+    (parallel/checkpoint.py): each process writes only its addressable
+    shards; restore may target a DIFFERENT mesh (SURVEY §5)."""
+    from .parallel.checkpoint import save_sharded
+
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    arrays = {}
+    for var in get_program_persistable_vars(main_program):
+        v = scope._get(var.name)
+        if v is not None:
+            arrays[var.name] = v
+    save_sharded(dirname, arrays)
+
+
+def load_sharded_persistables(executor, dirname, main_program=None,
+                              scope=None, shardings=None,
+                              allow_missing=False):
+    """Restore a sharded checkpoint, resharding onto `shardings`
+    (name -> jax Sharding, or one Sharding for all; None loads host
+    arrays) -- mesh-change-on-restore is the point. A persistable
+    absent from the checkpoint raises (a silently fresh-initialized
+    param is a wrong model); allow_missing=True opts into partial
+    restores."""
+    from .parallel.checkpoint import load_manifest, load_sharded
+
+    main_program = main_program or default_main_program()
+    scope = scope or global_scope()
+    names = [v.name for v in
+             get_program_persistable_vars(main_program)]
+    manifest = load_manifest(dirname)
+    missing = [n for n in names if n not in manifest]
+    if missing and not allow_missing:
+        raise KeyError(
+            f"sharded checkpoint at {dirname!r} is missing persistable "
+            f"var(s) {missing}; pass allow_missing=True for a partial "
+            f"restore")
+    out = load_sharded(dirname, shardings=shardings,
+                       names=[n for n in names if n in manifest],
+                       manifest=manifest)
+    for name, arr in out.items():
+        scope.var(name)
+        scope._set(name, arr)
+    return sorted(out)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,

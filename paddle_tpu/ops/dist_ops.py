@@ -230,3 +230,27 @@ def allreduce(ctx):
 
     out = io_callback(_do, spec, x, ordered=True)
     return {"Out": out}
+
+
+@register_op("checkpoint_notify", differentiable=False)
+def checkpoint_notify(ctx):
+    """reference distributed_ops/checkpoint_notify_op.cc: tell every
+    pserver in epmap to run its checkpoint save block (persist its
+    shard of the distributed lookup table under `dir`). Host bridge:
+    ordered io_callback -> PServerRuntime.save_checkpoint, the same
+    transport every other pserver op here uses."""
+    epmap = list(ctx.attr("epmap", []))
+    dirname = ctx.attr("dir")
+    table = ctx.attr("lookup_table", "")
+
+    def _do():
+        import os
+
+        sub = os.path.join(dirname, "__lookup_table__") if table \
+            else dirname
+        for ep in epmap:
+            _endpoint(ep).save_checkpoint(sub, prefix=table)
+        return np.zeros((), np.int32)
+
+    io_callback(_do, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
+    return None

@@ -255,6 +255,15 @@ class DistributeTranspiler:
                               "shards": shard_names,
                               "lr_name": lr_name}
         self._dist_tables = tables
+        # recorded on the program so io._save_distributed_persistables
+        # can emit checkpoint_notify (reference sets
+        # _distributed_lookup_table on the pserver program,
+        # distribute_transpiler.py:871)
+        if tables:
+            self.origin_program._distributed_lookup_table = \
+                list(tables)[0]
+            self.origin_program._pserver_endpoints = \
+                list(self.pserver_endpoints)
 
     def _append_table_init_sends(self, block):
         """Startup: push mod-sharded table slices + lr values."""

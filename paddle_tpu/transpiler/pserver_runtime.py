@@ -22,7 +22,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["PServerRuntime", "get_endpoint", "reset_endpoints",
-           "configure_endpoint"]
+           "configure_endpoint", "serve", "RemoteRuntime"]
 
 _REGISTRY: Dict[str, "PServerRuntime"] = {}
 _LOCK = threading.Lock()
@@ -30,14 +30,36 @@ _LOCK = threading.Lock()
 
 def get_endpoint(endpoint: str) -> "PServerRuntime":
     with _LOCK:
-        if endpoint not in _REGISTRY:
-            _REGISTRY[endpoint] = PServerRuntime(endpoint)
-        return _REGISTRY[endpoint]
+        if endpoint in _REGISTRY:
+            return _REGISTRY[endpoint]
+    if _use_tcp_transport():
+        # trainer process in multi-process PS mode: proxy over TCP
+        # (reference: grpc channel to the listen_and_serv process).
+        # Endpoints HOSTED here are pre-registered as local runtimes
+        # by configure_endpoint/serve, so the registry hit above wins
+        # even when the whole cluster exports the transport env var.
+        with _LOCK:
+            return _REGISTRY.setdefault(endpoint,
+                                        RemoteRuntime(endpoint))
+    with _LOCK:
+        return _REGISTRY.setdefault(endpoint, PServerRuntime(endpoint))
+
+
+def _local_endpoint(endpoint: str) -> "PServerRuntime":
+    """The runtime HOSTING this endpoint in-process -- never a proxy,
+    regardless of PADDLE_PSERVER_TRANSPORT (a pserver proxying to its
+    own port would recurse)."""
+    with _LOCK:
+        rt = _REGISTRY.get(endpoint)
+        if not isinstance(rt, PServerRuntime):
+            rt = PServerRuntime(endpoint)
+            _REGISTRY[endpoint] = rt
+        return rt
 
 
 def configure_endpoint(endpoint: str, pserver_program, num_trainers: int,
                        sync_mode: bool) -> "PServerRuntime":
-    rt = get_endpoint(endpoint)
+    rt = _local_endpoint(endpoint)
     rt.configure(pserver_program, num_trainers, sync_mode)
     return rt
 
@@ -159,6 +181,28 @@ class PServerRuntime:
                     f"first)")
             return self.store[name]
 
+    def save_checkpoint(self, dirname: str, prefix: str = "") -> list:
+        """kRequestCheckpoint handler (reference
+        request_handler_impl.cc RequestCheckpointHandler runs the
+        pserver's checkpoint save block, distribute_transpiler.py:1457):
+        persist this endpoint's param blocks -- notably its shard of a
+        distributed lookup table -- under dirname, tagged by endpoint
+        so shards from different pservers do not collide."""
+        import os
+
+        with self._lock:
+            tag = self.endpoint.replace(":", "_").replace("/", "_")
+            os.makedirs(dirname, exist_ok=True)
+            written = []
+            for name, value in self.store.items():
+                if prefix and not name.startswith(prefix):
+                    continue
+                safe = name.replace("/", "_")
+                path = os.path.join(dirname, f"{safe}.{tag}.npy")
+                np.save(path, np.asarray(value), allow_pickle=False)
+                written.append(path)
+            return written
+
     # --- optimize-block execution --------------------------------------
     def _apply_for_grad(self, grad_name: str):
         grads = self._grad_bufs.pop(grad_name, [])
@@ -186,3 +230,142 @@ class PServerRuntime:
             for out in op.output_arg_names:
                 if out in env:
                     self.store[out] = np.asarray(env[out])
+
+
+# ---------------------------------------------------------------------------
+# Multi-process transport: a minimal TCP RPC so pservers can run as
+# REAL OS processes (reference: gRPC server in
+# operators/distributed/grpc/; listen_and_serv_op.cc binds the port).
+# Frame = 8-byte big-endian length + pickle of (method, args); reply =
+# same framing of ("ok", result) | ("err", repr). Each request runs on
+# its own thread because barrier() BLOCKS until all trainers arrive.
+# ---------------------------------------------------------------------------
+import os as _os
+import pickle as _pickle
+import socket as _socket
+import struct as _struct
+
+_REMOTE_METHODS = ("push_init", "push_grad", "push_sparse_grad",
+                   "barrier", "pull", "pull_rows", "save_checkpoint",
+                   "shutdown")
+
+
+def _recv_frame(conn):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = conn.recv(8 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = _struct.unpack(">Q", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return _pickle.loads(buf)
+
+
+def _send_frame(conn, obj):
+    payload = _pickle.dumps(obj, protocol=4)
+    conn.sendall(_struct.pack(">Q", len(payload)) + payload)
+
+
+def serve(endpoint: str, runtime: "PServerRuntime" = None,
+          blocking: bool = True):
+    """Run a pserver endpoint as a TCP server (the listen_and_serv
+    loop). Returns the server socket when blocking=False.
+
+    SECURITY: the frame payload is pickle (like the reference's
+    trusted-cluster protobuf-over-brpc, this assumes a private
+    network), and unpickling is code execution for anyone who can
+    connect. Binding is therefore restricted to loopback unless
+    PADDLE_PSERVER_ALLOW_NONLOCAL=1 explicitly opts a trusted-network
+    deployment in."""
+    rt = runtime or _local_endpoint(endpoint)
+    host, port = endpoint.rsplit(":", 1)
+    if host not in ("127.0.0.1", "localhost", "::1") and \
+            _os.environ.get("PADDLE_PSERVER_ALLOW_NONLOCAL") != "1":
+        raise ValueError(
+            f"refusing to serve the pickle-based pserver transport on "
+            f"non-loopback address {host!r}; set "
+            f"PADDLE_PSERVER_ALLOW_NONLOCAL=1 only on a trusted "
+            f"private network")
+    srv = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    srv.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+    srv.bind((host, int(port)))
+    srv.listen(64)
+    stop = threading.Event()
+
+    def handle(conn):
+        with conn:
+            req = _recv_frame(conn)
+            if req is None:
+                return
+            method, args = req
+            try:
+                if method == "shutdown":
+                    stop.set()
+                    _send_frame(conn, ("ok", None))
+                    return
+                if method not in _REMOTE_METHODS:
+                    raise ValueError(f"unknown method {method!r}")
+                out = getattr(rt, method)(*args)
+                _send_frame(conn, ("ok", out))
+            except Exception as e:  # serialize the failure to the peer
+                _send_frame(conn, ("err", repr(e)))
+
+    def loop():
+        while not stop.is_set():
+            try:
+                srv.settimeout(0.5)
+                conn, _ = srv.accept()
+            except _socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+        srv.close()
+
+    if blocking:
+        loop()
+        return None
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return srv
+
+
+class RemoteRuntime:
+    """Client proxy with the PServerRuntime method surface; every call
+    is one TCP round trip (the reference's brpc/grpc channel)."""
+
+    def __init__(self, endpoint: str, timeout: float = 120.0):
+        self.endpoint = endpoint
+        self.timeout = timeout
+
+    def _call(self, method, *args):
+        host, port = self.endpoint.rsplit(":", 1)
+        with _socket.create_connection((host, int(port)),
+                                       timeout=self.timeout) as conn:
+            _send_frame(conn, (method, args))
+            reply = _recv_frame(conn)
+        if reply is None:
+            raise ConnectionError(
+                f"pserver {self.endpoint} closed the connection")
+        status, payload = reply
+        if status != "ok":
+            raise RuntimeError(
+                f"pserver {self.endpoint} {method} failed: {payload}")
+        return payload
+
+
+for _m in _REMOTE_METHODS:
+    if _m != "shutdown":
+        setattr(RemoteRuntime, _m,
+                (lambda name: lambda self, *a: self._call(name, *a))(_m))
+
+
+def _use_tcp_transport() -> bool:
+    return _os.environ.get("PADDLE_PSERVER_TRANSPORT", "") == "tcp"

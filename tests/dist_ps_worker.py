@@ -1,0 +1,107 @@
+"""Multi-process parameter-server worker (parity: reference
+tests/unittests/test_dist_base.py:382 _run_cluster launches PSERVER and
+TRAINER roles as OS processes wired by the PADDLE_* env contract).
+
+Role PSERVER: transpile the pserver program for this endpoint, serve it
+over the TCP transport (pserver_runtime.serve), print READY, run until
+shutdown. Role TRAINER: set PADDLE_PSERVER_TRANSPORT=tcp so the
+send/recv ops proxy to the pserver processes, train, print losses.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.transpiler import (DistributeTranspiler,  # noqa: E402
+                                   DistributeTranspilerConfig)
+from paddle_tpu.transpiler import pserver_runtime  # noqa: E402
+
+STEPS = int(os.environ.get("DIST_STEPS", "12"))
+GLOBAL_BATCH = 32
+
+
+def batches(steps, seed=11):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(16, 1).astype(np.float32)
+    for _ in range(steps):
+        xs = rng.randn(GLOBAL_BATCH, 16).astype(np.float32)
+        ys = xs @ w + 0.05 * rng.randn(GLOBAL_BATCH, 1).astype(
+            np.float32)
+        yield xs, ys
+
+
+def build_model():
+    np.random.seed(90)
+    fluid.seed(90)
+    x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=32, act="relu")
+    pred = fluid.layers.fc(input=h, size=1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.05).minimize(loss)
+    return loss
+
+
+def transpile(trainer_id, n_trainers, pservers, sync_mode):
+    cfg = DistributeTranspilerConfig()
+    cfg.slice_var_up = False
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id, pservers=pservers, trainers=n_trainers,
+                sync_mode=sync_mode)
+    return t
+
+
+def run_pserver():
+    ep = os.environ["PADDLE_CURRENT_ENDPOINT"]
+    pservers = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    n_trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    sync_mode = os.environ.get("DIST_SYNC", "0") == "1"
+    build_model()
+    t = transpile(0, n_trainers, pservers, sync_mode)
+    pserver_runtime.configure_endpoint(
+        ep, t.get_pserver_program(ep), num_trainers=n_trainers,
+        sync_mode=sync_mode)
+    print("PSERVER_READY", flush=True)
+    pserver_runtime.serve(ep, blocking=True)
+
+
+def run_trainer():
+    os.environ["PADDLE_PSERVER_TRANSPORT"] = "tcp"
+    tid = int(os.environ["PADDLE_TRAINER_ID"])
+    n_trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    pservers = os.environ["PADDLE_PSERVER_ENDPOINTS"]
+    sync_mode = os.environ.get("DIST_SYNC", "0") == "1"
+    loss = build_model()
+    t = transpile(tid, n_trainers, pservers, sync_mode)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(t.get_startup_program())
+    losses = []
+    shard = GLOBAL_BATCH // n_trainers
+    lo = tid * shard
+    # one SHARED global batch stream, disjoint shards per trainer: in
+    # sync mode the merged update then equals the full-batch gradient,
+    # which the parity test checks against a single-process run
+    for xs, ys in batches(STEPS, seed=11):
+        l, = exe.run(t.get_trainer_program(),
+                     feed={"x": xs[lo:lo + shard],
+                           "y": ys[lo:lo + shard]},
+                     fetch_list=[loss.name])
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    print("DIST_RESULT " + json.dumps(
+        {"trainer_id": tid, "losses": losses}), flush=True)
+
+
+if __name__ == "__main__":
+    if os.environ.get("PADDLE_TRAINING_ROLE") == "PSERVER":
+        run_pserver()
+    else:
+        run_trainer()

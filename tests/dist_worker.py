@@ -37,6 +37,8 @@ def global_batches(steps, seed=11):
 
 
 def build_model():
+    if os.environ.get("DIST_MODEL", "regression") == "transformer":
+        return build_transformer_model()
     np.random.seed(90)
     fluid.seed(90)
     x = fluid.layers.data(name="x", shape=[16], dtype="float32")
@@ -46,6 +48,43 @@ def build_model():
     loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
     fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
     return loss
+
+
+SEQ_LEN, VOCAB = 8, 64
+
+
+def build_transformer_model():
+    """Tiny transformer payload (reference test_dist_transformer.py
+    uses the real model; this is models/transformer.py at toy size so
+    2-4 CPU trainers finish in seconds)."""
+    from paddle_tpu.models import transformer as T
+
+    np.random.seed(90)
+    fluid.seed(90)
+    main, startup, cost = T.build_program(
+        seq_len=SEQ_LEN, d_model=16, n_heads=2, n_layers=1, d_inner=32,
+        vocab=VOCAB, dropout_rate=0.0, with_optimizer=True,
+        learning_rate=0.5, warmup_steps=4)
+    # the transpiler + executor below operate on the DEFAULT programs
+    fluid.switch_main_program(main)
+    fluid.switch_startup_program(startup)
+    return cost
+
+
+def transformer_batches(steps, seed=13):
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        yield {
+            "src_ids": rng.randint(0, VOCAB,
+                                   (GLOBAL_BATCH, SEQ_LEN)).astype(
+                np.int64),
+            "tgt_ids": rng.randint(0, VOCAB,
+                                   (GLOBAL_BATCH, SEQ_LEN)).astype(
+                np.int64),
+            "label": rng.randint(0, VOCAB,
+                                 (GLOBAL_BATCH, SEQ_LEN)).astype(
+                np.int64),
+        }
 
 
 def main():
@@ -60,10 +99,14 @@ def main():
     losses = []
     shard = GLOBAL_BATCH // env.num_trainers
     lo = env.trainer_id * shard
-    for xs, ys in global_batches(STEPS):
-        l, = exe.run(t.get_trainer_program(),
-                     feed={"x": xs[lo:lo + shard],
-                           "y": ys[lo:lo + shard]},
+    if os.environ.get("DIST_MODEL", "regression") == "transformer":
+        feeds = ({k: v[lo:lo + shard] for k, v in b.items()}
+                 for b in transformer_batches(STEPS))
+    else:
+        feeds = ({"x": xs[lo:lo + shard], "y": ys[lo:lo + shard]}
+                 for xs, ys in global_batches(STEPS))
+    for feed in feeds:
+        l, = exe.run(t.get_trainer_program(), feed=feed,
                      fetch_list=[loss.name])
         losses.append(float(np.asarray(l).reshape(-1)[0]))
     print("DIST_RESULT " + json.dumps(
