@@ -46,7 +46,7 @@ WHITE_LIST = {
 
 # Numerically sensitive ops: run in fp32.
 BLACK_LIST = {
-    "softmax", "log_softmax", "softmax_with_cross_entropy",
+    "softmax", "log_softmax",
     "cross_entropy", "sigmoid_cross_entropy_with_logits",
     "layer_norm", "batch_norm", "group_norm", "instance_norm",
     "data_norm", "l2_normalize", "norm", "lrn",
@@ -65,7 +65,10 @@ BLACK_LIST = {
 KEEP_LIST = {"cast", "fill_constant", "assign", "one_hot", "range",
              "uniform_random", "gaussian_random", "eye",
              "fill_zeros_like", "fill_constant_batch_size_like",
-             "share_data", "print", "is_empty", "shape"}
+             "share_data", "print", "is_empty", "shape",
+             # manages its own precision: bf16 [N,V] logits stay put,
+             # reductions accumulate fp32 in-register (nn_ops.py swce)
+             "softmax_with_cross_entropy"}
 
 _enabled = [os.environ.get("FLAGS_use_bf16", "") in
             ("1", "true", "True")]

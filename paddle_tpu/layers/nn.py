@@ -1297,16 +1297,18 @@ def _triple(v):
 
 
 def attention(q, k, v, causal=False, scale=None, dropout_rate=0.0,
-              is_test=False, name=None):
-    """Fused scaled-dot-product attention over [B,H,T,D] heads -- the
-    framework's flash-attention entry point (Pallas kernel on TPU)."""
+              is_test=False, layout="bhtd", name=None):
+    """Fused scaled-dot-product attention -- the framework's
+    flash-attention entry point (Pallas kernel on TPU). layout='bthd'
+    takes [B,T,H,D] straight from the head-split reshape, skipping the
+    physical head transpose (see ops/nn_ops.py attention)."""
     helper = LayerHelper("attention", input=q, name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     helper.append_op("attention", {"Q": q, "K": k, "V": v},
                      {"Out": out},
                      {"causal": causal, "scale": scale,
                       "dropout_rate": dropout_rate,
-                      "is_test": is_test})
+                      "is_test": is_test, "layout": layout})
     return out
 
 

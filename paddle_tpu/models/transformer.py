@@ -34,14 +34,15 @@ def multi_head_attention(q_in, kv_in, d_model, n_heads, dropout_rate,
     v = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False)
 
     def split_heads(x):
-        x = layers.reshape(x, [0, 0, n_heads, head_dim])
-        return layers.transpose(x, [0, 2, 1, 3])
+        # [B,T,H,D] stays put: attention(layout='bthd') batches over
+        # heads in the dot_general instead of a physical transpose
+        return layers.reshape(x, [0, 0, n_heads, head_dim])
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
     ctx = layers.attention(q, k, v, causal=causal,
                            scale=head_dim ** -0.5,
-                           dropout_rate=0.0 if is_test else dropout_rate)
-    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+                           dropout_rate=0.0 if is_test else dropout_rate,
+                           layout="bthd")
     ctx = layers.reshape(ctx, [0, 0, d_model])
     return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False)
 

@@ -393,51 +393,81 @@ def _swce_grad_maker(op, no_grad_set=frozenset()):
 
 
 def _swce_grad_kernel(ctx):
+    """grad = (softmax - target) * dloss, emitted directly in the
+    logits' storage dtype: the fp32 probabilities exist only inside
+    the fused exp(l - lse) expression, never as an [N, V] HBM buffer;
+    the hard-label one-hot subtraction is a fused iota==label compare
+    select, not a materialized one-hot."""
     logits = ctx.input("Logits")
     label = ctx.input("Label")
-    softmax_out = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1, keepdims=True)
     dloss = ctx.input("Loss@GRAD")
     if dloss is None:
-        dloss = jnp.ones(softmax_out.shape[:-1] + (1,),
-                         dtype=softmax_out.dtype)
+        dloss = jnp.ones(logits.shape[:-1] + (1,), jnp.float32)
+    dloss = dloss.astype(jnp.float32)
     eps = ctx.attr("label_smooth_eps", 0.0)
-    vocab = softmax_out.shape[-1]
+    vocab = logits.shape[-1]
+    p_scaled = jnp.exp(lf - lse) * dloss  # fused, lands in grad
     if ctx.attr("soft_label", False):
-        target = label.astype(softmax_out.dtype)
-    else:
-        lab = label.astype(jnp.int32)
-        if lab.ndim == softmax_out.ndim:
-            lab = lab[..., 0]
-        target = jax.nn.one_hot(lab, vocab, dtype=softmax_out.dtype)
+        target = label.astype(jnp.float32)
+        if eps:
+            target = target * (1.0 - eps) + eps / vocab
+        grad = p_scaled - target * dloss
+        return {"Logits@GRAD": grad.astype(logits.dtype)}
+    lab = label.astype(jnp.int32)
+    if lab.ndim == logits.ndim:
+        lab = lab[..., 0]
     if eps:
-        target = target * (1.0 - eps) + eps / vocab
-    grad = (softmax_out - target) * dloss
+        grad = p_scaled - (eps / vocab) * dloss
+        hit = (1.0 - eps) * dloss
+    else:
+        grad = p_scaled
+        hit = dloss
+    # one-hot as a fused iota==label compare: elementwise over [N,V],
+    # no scatter temp, no materialized one-hot -- the whole expression
+    # collapses into the single bf16 output pass
+    iota = jnp.arange(vocab, dtype=jnp.int32)
+    onehot = (iota == lab[..., None])
+    grad = grad - jnp.where(onehot, hit, 0.0)
     return {"Logits@GRAD": grad.astype(logits.dtype)}
 
 
 @register_op("softmax_with_cross_entropy", grad_maker=_swce_grad_maker)
 def softmax_with_cross_entropy(ctx):
+    """Reduction-form xent: loss = lse(logits) - logits[label].
+
+    With a 32k vocab the [N, V] tensors dominate HBM traffic, so the
+    kernel never materializes an fp32 log-softmax: logits stay in
+    their storage dtype (bf16 under AMP -- this op is on the amp KEEP
+    list and manages its own precision), the logsumexp reduction
+    accumulates in fp32 on the fly, and the label logit is a gather.
+    The Softmax output is only computed when a consumer fetches it
+    (XLA dead-codes it otherwise)."""
     logits = ctx.input("Logits")
     label = ctx.input("Label")
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    sm = jnp.exp(logp)
+    lf = logits.astype(jnp.float32)  # fuses into the reductions below
+    lse = jax.scipy.special.logsumexp(lf, axis=-1, keepdims=True)
     eps = ctx.attr("label_smooth_eps", 0.0)
     if ctx.attr("soft_label", False):
-        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+        # sum(label * (lse - logits)) = lse - sum(label * logits)
+        loss = lse - jnp.sum(label.astype(jnp.float32) * lf, axis=-1,
+                             keepdims=True)
         if eps:
-            vocab = logits.shape[-1]
-            uniform = -jnp.mean(logp, axis=-1, keepdims=True)
+            uniform = lse - jnp.mean(lf, axis=-1, keepdims=True)
             loss = (1.0 - eps) * loss + eps * uniform
     else:
         lab = label.astype(jnp.int32)
         if lab.ndim == logits.ndim:
             lab = lab[..., 0]
-        loss = -jnp.take_along_axis(logp, lab[..., None], axis=-1)
+        picked = jnp.take_along_axis(lf, lab[..., None], axis=-1)
+        loss = lse - picked
         if eps:
-            # smoothed target (1-eps)*onehot + eps/V without materializing
-            # the [N,V] one-hot: sum_j(-logp_j)/V = lse - mean(logits)
-            uniform = -jnp.mean(logp, axis=-1, keepdims=True)
+            # smoothed target (1-eps)*onehot + eps/V without the [N,V]
+            # one-hot: mean_j(lse - logits_j) = lse - mean(logits)
+            uniform = lse - jnp.mean(lf, axis=-1, keepdims=True)
             loss = (1.0 - eps) * loss + eps * uniform
+    sm = jnp.exp(lf - lse)
     return {"Loss": loss, "Softmax": sm}
 
 
@@ -743,11 +773,20 @@ def mean_iou(ctx):
 # --------------------------------------------------------------------------
 @register_op("attention", needs_rng=True)
 def attention(ctx):
-    q = ctx.input("Q")  # B,H,T,D
+    """layout attr: 'bhtd' (default) or 'bthd'. The bthd form takes
+    q/k/v straight from the head-split reshape WITHOUT a physical
+    [B,T,H,D]->[B,H,T,D] transpose -- dot_general batches over h in
+    place, which removed ~30ms/step of transpose+copy HLOs from
+    transformer-base (profiled on v5e; the transposes and their jvp
+    duals were ~15% of device time). The pallas flash kernel keeps its
+    bhtd contract, so routes through transposes only when it is
+    actually selected (long sequences)."""
+    q = ctx.input("Q")
     k = ctx.input("K")
     v = ctx.input("V")
     scale = ctx.attr("scale", None)
     causal = ctx.attr("causal", False)
+    layout = ctx.attr("layout", "bhtd")
     dropout_rate = ctx.attr("dropout_rate", 0.0)
     if ctx.attr("is_test", False):
         dropout_rate = 0.0
@@ -757,25 +796,55 @@ def attention(ctx):
     from .pallas import attention as pallas_attn
     from ..parallel import ring_attention as ra
 
-    if ra.cp_applicable(q, k, v, dropout_rate):
-        return ra.cp_attention(q, k, v, scale, causal)
+    def to_bhtd(x):
+        return jnp.swapaxes(x, 1, 2) if layout == "bthd" else x
+
+    qh, kh, vh = to_bhtd(q), to_bhtd(k), to_bhtd(v)
+    if ra.cp_applicable(qh, kh, vh, dropout_rate):
+        return to_bhtd(ra.cp_attention(qh, kh, vh, scale, causal))
     if dropout_rate == 0.0:
-        if pallas_attn.usable(q, k, v):
-            return pallas_attn.flash_attention(q, k, v, scale=scale,
-                                               causal=causal)
+        if pallas_attn.usable(qh, kh, vh) and (
+                layout == "bhtd" or qh.shape[2] > 1024):
+            # bthd pays 4 transposes to reach the kernel; only worth it
+            # where flash wins (long T). Short T stays transpose-free.
+            return to_bhtd(pallas_attn.flash_attention(
+                qh, kh, vh, scale=scale, causal=causal))
+        if layout == "bthd":
+            return _attention_bthd(q, k, v, scale, causal)
         return pallas.reference_attention(q, k, v, scale, causal)
     # dropout between softmax and the V product forces the inline form
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    return to_bhtd(_sdpa(qh, kh, vh, scale, causal, "bhtd",
+                         dropout_rate=dropout_rate, rng=ctx.rng()))
+
+
+def _attention_bthd(q, k, v, scale, causal):
+    return _sdpa(q, k, v, scale, causal, "bthd")
+
+
+def _sdpa(q, k, v, scale, causal, layout, dropout_rate=0.0, rng=None):
+    """The one masked-softmax attention body behind both layouts and
+    the dropout path (pallas.reference_attention stays a deliberately
+    independent oracle for kernel tests). QK^T and PV accumulate in
+    fp32 via preferred_element_type -- bf16 inputs stay in HBM, the
+    MXU accumulator carries the precision, matching the flash kernel's
+    numerics."""
+    if layout == "bthd":
+        qk, pv = "bqhd,bkhd->bhqk", "bhqk,bkhd->bqhd"
+    else:
+        qk, pv = "bhqd,bhkd->bhqk", "bhqk,bhkd->bhqd"
+    s = jnp.einsum(qk, q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
-        tq, tk = logits.shape[-2], logits.shape[-1]
+        tq, tk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
-        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
-    weights = jax.nn.softmax(logits, axis=-1)
+        s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
     if dropout_rate:
-        keep = jax.random.bernoulli(ctx.rng(), 1.0 - dropout_rate,
-                                    weights.shape)
-        weights = weights * keep / (1.0 - dropout_rate)
-    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, p.shape)
+        p = p * keep / (1.0 - dropout_rate)
+    out = jnp.einsum(pv, p.astype(q.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
 
 
 # --------------------------------------------------------------------------

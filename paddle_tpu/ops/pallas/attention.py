@@ -52,8 +52,12 @@ def _pick_block(t: int) -> int:
 
 
 def usable(q, k, v) -> bool:
+    import os
+
     from . import on_tpu
 
+    if os.environ.get("PADDLE_TPU_DISABLE_FLASH_ATTN") == "1":
+        return False  # perf-debug escape hatch: XLA attention path
     if not (on_tpu() or _interp()):
         return False
     b, h, tq, d = q.shape
