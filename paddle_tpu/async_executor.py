@@ -48,6 +48,8 @@ class AsyncExecutor:
         if not filelist:
             raise ValueError("AsyncExecutor.run: empty filelist")
         thread_num = max(1, min(thread_num, len(filelist)))
+        if thread_num > 1:
+            self._warn_if_dense_heavy(program)
         fetch_names = []
         for f in (fetch or []):
             fetch_names.append(f if isinstance(f, str) else f.name)
@@ -89,6 +91,39 @@ class AsyncExecutor:
 
     # reference API surface (PSLib-backed in the reference; the pserver
     # capability here is transpiler.pserver_runtime)
+    @staticmethod
+    def _warn_if_dense_heavy(program):
+        """Whole-step write-back is last-writer-wins on DENSE params
+        (module docstring): fine for CTR's small dense towers, wrong
+        for dense-heavy models. Warn when most trainable parameter
+        volume is dense so the misuse is loud (round-1 review: the
+        caveat was documented but unguarded)."""
+        dense_elems = 0
+        sparse_elems = 0
+        sparse_inputs = set()
+        for op in program.global_block.ops:
+            if op.type in ("lookup_table", "lookup_table_v2",
+                           "prefetch", "prefetch_grad"):
+                for n in op.inputs.get("W", []):
+                    sparse_inputs.add(n)
+        for p in program.all_parameters():
+            n = int(np.prod([d for d in (p.shape or ()) if d > 0]))
+            if p.name in sparse_inputs:
+                sparse_elems += n
+            else:
+                dense_elems += n
+        if dense_elems > max(10 * sparse_elems, 100_000):
+            import warnings
+
+            warnings.warn(
+                f"AsyncExecutor with thread_num > 1 uses Hogwild-style "
+                f"whole-step write-back: concurrent DENSE updates can "
+                f"be lost (last-writer-wins). This program is "
+                f"dense-heavy ({dense_elems:,} dense vs "
+                f"{sparse_elems:,} sparse-table elements) -- use "
+                f"CompiledProgram.with_data_parallel for dense-heavy "
+                f"models.")
+
     def config_distributed_nodes(self, *a, **k):
         raise RuntimeError(
             "distributed AsyncExecutor: use transpiler."

@@ -357,3 +357,74 @@ def is_empty(x, cond=None):
                                                             True)
     helper.append_op("is_empty", {"X": x}, {"Out": out}, {})
     return out
+
+
+# --- op-gap batch 2 wrappers (reference layers/nn.py selu, l1 helpers,
+# space_to_depth, sequence_mask...; resize_* live in nn.py already) ---
+def selu(x, scale=None, alpha=None, name=None):
+    helper = LayerHelper("selu", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = scale
+    if alpha is not None:
+        attrs["alpha"] = alpha
+    helper.append_op("selu", {"X": x}, {"Out": out}, attrs)
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    helper = LayerHelper("space_to_depth", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("space_to_depth", {"X": x}, {"Out": out},
+                     {"blocksize": blocksize})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    if maxlen is None or int(maxlen) < 1:
+        # fail at the CALL SITE: maxlen=max(x) is data-dependent shape,
+        # which XLA cannot compile (reference sequence_mask_op.h:69
+        # allows it; the TPU design makes maxlen mandatory)
+        raise ValueError(
+            "sequence_mask requires a static maxlen > 0 on TPU "
+            "(maxlen=None would make the output shape data-dependent)")
+    helper = LayerHelper("sequence_mask", input=x, name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("sequence_mask", {"X": x}, {"Y": out},
+                     {"maxlen": int(maxlen), "out_dtype": dtype})
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", input=x, name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op("pad_constant_like", {"X": x, "Y": y},
+                     {"Out": out}, {"pad_value": float(pad_value)})
+    return out
+
+
+def l1_norm(x, name=None):
+    helper = LayerHelper("l1_norm", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("l1_norm", {"X": x}, {"Out": out}, {})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    helper = LayerHelper("hash", input=input, name=name)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("hash", {"X": input}, {"Out": out},
+                     {"mod_by": hash_size, "num_hash": num_hash})
+    return out
+
+
+def fsp_matrix(x, y):
+    helper = LayerHelper("fsp", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fsp", {"X": x, "Y": y}, {"Out": out}, {})
+    return out
+
+
+__all__.extend(["selu", "space_to_depth", "sequence_mask",
+                "pad_constant_like", "l1_norm", "hash", "fsp_matrix"])

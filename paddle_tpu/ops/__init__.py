@@ -14,4 +14,5 @@ from . import detection_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import host_ops  # noqa: F401
 from . import extra_ops  # noqa: F401
+from . import extra_ops2  # noqa: F401
 from . import lod_ops  # noqa: F401

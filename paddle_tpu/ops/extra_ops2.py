@@ -1,0 +1,624 @@
+"""Op-gap closure, batch 2: interpolation, activations, metrics,
+proximal optimizers, sequence/LoD utilities, distillation, distributed
+id plumbing.
+
+Parity targets (reference paddle/fluid/operators/): interpolate_op.cc
+(bilinear_interp/nearest_interp), selu_op.h, l1_norm_op.h, minus_op.cc,
+pad_constant_like_op.h, space_to_depth_op.cc,
+sequence_ops/sequence_mask_op.h, sequence_expand_as_op.h,
+sequence_erase_op.h, hash_op.h, metrics/precision_recall_op.h,
+positive_negative_pair_op.h, optimizers/proximal_gd_op.h,
+proximal_adagrad_op.h, average_accumulates_op.h, fsp_op.h,
+split_lod_tensor_op.cc, merge_lod_tensor_op.cc,
+tensor_array_to_tensor_op.cc, rnn_memory_helper_op.cc,
+conv_transpose_op.cc (depthwise_conv2d_transpose),
+sync_batch_norm_op.cu, detection/mine_hard_examples_op.cc,
+distributed_ops/split_ids_op.h, merge_ids_op.h,
+split_selected_rows_op.h, ref_by_trainer_id_op.h,
+lookup_sparse_table_op.cc, dgc_clip_by_norm_op.h.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+# --------------------------------------------------------------------------
+# image interpolation (reference interpolate_op.cc)
+# --------------------------------------------------------------------------
+def _interp_sizes(ctx, x):
+    oh = ctx.attr("out_h", -1)
+    ow = ctx.attr("out_w", -1)
+    out_size = ctx.input("OutSize")
+    if out_size is not None:
+        # XLA needs static shapes: OutSize must be a build-time
+        # constant var (the common fluid usage passes one)
+        raise ValueError(
+            "interp ops need static out_h/out_w attrs on TPU (XLA "
+            "static shapes); pass out_shape as ints, not a tensor")
+    scale = ctx.attr("scale", 0.0)
+    if (oh is None or oh <= 0) and scale:
+        oh = int(x.shape[2] * scale)
+        ow = int(x.shape[3] * scale)
+    if oh is None or oh <= 0 or ow is None or ow <= 0:
+        raise ValueError(
+            "interp op needs out_h/out_w attrs > 0 or a scale attr "
+            "(neither was set)")
+    return int(oh), int(ow)
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(ctx):
+    """reference interpolate_op.cc BilinearInterpolation: NCHW,
+    align_corners/align_mode attrs."""
+    x = ctx.input("X")
+    oh, ow = _interp_sizes(ctx, x)
+    n, c, h, w = x.shape
+    align = ctx.attr("align_corners", True)
+    mode = ctx.attr("align_mode", 1)
+
+    def src_idx(dst, out_dim, in_dim):
+        dst = dst.astype(jnp.float32)
+        if align:
+            ratio = (in_dim - 1) / max(out_dim - 1, 1)
+            return dst * ratio
+        ratio = in_dim / out_dim
+        if mode == 0:
+            return jnp.maximum(ratio * (dst + 0.5) - 0.5, 0.0)
+        return ratio * dst
+
+    sy = src_idx(jnp.arange(oh), oh, h)
+    sx = src_idx(jnp.arange(ow), ow, w)
+    y0 = jnp.clip(jnp.floor(sy).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(sx).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (sy - y0).astype(x.dtype)[None, None, :, None]
+    wx = (sx - x0).astype(x.dtype)[None, None, None, :]
+    g = lambda yy, xx: x[:, :, yy[:, None], xx[None, :]]
+    out = ((1 - wy) * (1 - wx) * g(y0, x0) + (1 - wy) * wx * g(y0, x1)
+           + wy * (1 - wx) * g(y1, x0) + wy * wx * g(y1, x1))
+    return out
+
+
+@register_op("nearest_interp")
+def nearest_interp(ctx):
+    x = ctx.input("X")
+    oh, ow = _interp_sizes(ctx, x)
+    n, c, h, w = x.shape
+    align = ctx.attr("align_corners", True)
+    if align:
+        sy = jnp.round(jnp.arange(oh) * (h - 1) / max(oh - 1, 1))
+        sx = jnp.round(jnp.arange(ow) * (w - 1) / max(ow - 1, 1))
+    else:
+        sy = jnp.floor(jnp.arange(oh) * h / oh)
+        sx = jnp.floor(jnp.arange(ow) * w / ow)
+    sy = jnp.clip(sy, 0, h - 1).astype(jnp.int32)
+    sx = jnp.clip(sx, 0, w - 1).astype(jnp.int32)
+    return x[:, :, sy[:, None], sx[None, :]]
+
+
+# --------------------------------------------------------------------------
+# activations / small math
+# --------------------------------------------------------------------------
+@register_op("selu")
+def selu(ctx):
+    """reference selu_op.h:35: scale * (x if x>0 else alpha*e^x -
+    alpha)."""
+    x = ctx.input("X")
+    alpha = ctx.attr("alpha", 1.6732632423543772)
+    scale = ctx.attr("scale", 1.0507009873554805)
+    return scale * jnp.where(x > 0, x, alpha * jnp.exp(x) - alpha)
+
+
+@register_op("l1_norm")
+def l1_norm(ctx):
+    """reference l1_norm_op.h: scalar sum |x|."""
+    return jnp.sum(jnp.abs(ctx.input("X"))).reshape(1)
+
+
+@register_op("minus")
+def minus(ctx):
+    return ctx.input("X") - ctx.input("Y")
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(ctx):
+    """reference pad_constant_like_op.h: pad Y up to X's shape with
+    pad_value."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    val = ctx.attr("pad_value", 0.0)
+    pads = [(0, int(xs - ys)) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=val)
+
+
+@register_op("space_to_depth")
+def space_to_depth(ctx):
+    """reference space_to_depth_op.cc: NCHW blocksize rearrange."""
+    x = ctx.input("X")
+    bs = ctx.attr("blocksize")
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * bs * bs, h // bs, w // bs)
+
+
+@register_op("hash", differentiable=False)
+def hash_op(ctx):
+    """reference hash_op.h (xxhash % mod_by, num_hash rounds): an
+    XLA-computable integer mix hash keeps ids on-device (the exact
+    xxhash bits differ; the contract -- deterministic bucketing of int
+    ids into [0, mod_by) x num_hash -- is preserved). Id space is
+    32-bit: the framework runs with jax x64 disabled, so int64 feeds
+    are already int32 on device; mod_by must fit int32."""
+    num_hash = ctx.attr("num_hash", 1)
+    mod_by = ctx.attr("mod_by")
+    if mod_by >= 2 ** 31:
+        raise ValueError(f"hash: mod_by={mod_by} must fit int32 "
+                         f"(x64 is disabled)")
+    x = ctx.input("X").astype(jnp.uint32)
+    outs = []
+    for i in range(num_hash):
+        h = x * jnp.uint32(2654435761) + jnp.uint32(
+            (0x9E3779B9 * (i + 1)) & 0xFFFFFFFF)
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int32))
+    return jnp.stack(outs, axis=-2)
+
+
+@register_op("fsp")
+def fsp(ctx):
+    """reference fsp_op.h: FSP (flow of solution procedure) matrix for
+    distillation: out[b,i,j] = mean_hw x[b,i,h,w] * y[b,j,h,w]."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    hw = x.shape[2] * x.shape[3]
+    return jnp.einsum("bihw,bjhw->bij", x, y) / hw
+
+
+# --------------------------------------------------------------------------
+# metrics (reference metrics/)
+# --------------------------------------------------------------------------
+@register_op("precision_recall", differentiable=False)
+def precision_recall(ctx):
+    """reference precision_recall_op.h: per-class macro/micro
+    precision/recall/F1 from MaxProbs+Indices (or detections) vs
+    Labels, plus accumulated states."""
+    idx = ctx.input("Indices").reshape(-1).astype(jnp.int32)
+    labels = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    weights = ctx.input("Weights")
+    cls = ctx.attr("class_number")
+    w = (weights.reshape(-1).astype(jnp.float32)
+         if weights is not None else jnp.ones_like(idx, jnp.float32))
+    tp = jnp.zeros(cls).at[labels].add(w * (idx == labels))
+    pred_cnt = jnp.zeros(cls).at[idx].add(w)
+    lab_cnt = jnp.zeros(cls).at[labels].add(w)
+    fp = pred_cnt - tp
+    fn = lab_cnt - tp
+    states = jnp.stack([tp, fp, fn,
+                        jnp.zeros_like(tp)], axis=1)  # TP FP FN TN
+    acc_in = ctx.input("StatesInfo")
+    if acc_in is not None:
+        states = states + acc_in.astype(jnp.float32)
+    atp, afp, afn = states[:, 0], states[:, 1], states[:, 2]
+
+    def prf(tp_, fp_, fn_):
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_ + 1e-12), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_ + 1e-12), 0.0)
+        f1 = jnp.where(prec + rec > 0,
+                       2 * prec * rec / (prec + rec + 1e-12), 0.0)
+        return prec, rec, f1
+
+    # batch metrics
+    bp, br, bf = prf(tp, fp, fn)
+    macro_b = jnp.stack([bp.mean(), br.mean(), bf.mean()])
+    mp, mr, mf = prf(tp.sum(), fp.sum(), fn.sum())
+    # accumulated metrics
+    ap, ar, af = prf(atp, afp, afn)
+    macro_a = jnp.stack([ap.mean(), ar.mean(), af.mean()])
+    map_, mar, maf = prf(atp.sum(), afp.sum(), afn.sum())
+    return {"BatchMetrics": jnp.concatenate(
+                [macro_b, jnp.stack([mp, mr, mf])]),
+            "AccumMetrics": jnp.concatenate(
+                [macro_a, jnp.stack([map_, mar, maf])]),
+            "AccumStatesInfo": states}
+
+
+@register_op("positive_negative_pair", differentiable=False)
+def positive_negative_pair(ctx):
+    """reference positive_negative_pair_op.h: within each query id,
+    count score-ordered pairs that agree/disagree with label order."""
+    score = ctx.input("Score").reshape(-1)
+    label = ctx.input("Label").reshape(-1)
+    qid = ctx.input("QueryID").reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    upper = jnp.triu(jnp.ones_like(same_q, dtype=bool), 1)
+    valid = same_q & upper & (label[:, None] != label[None, :])
+    s_diff = score[:, None] - score[None, :]
+    l_diff = (label[:, None] - label[None, :]).astype(s_diff.dtype)
+    pos = jnp.sum(valid & (s_diff * l_diff > 0)).astype(jnp.float32)
+    neg = jnp.sum(valid & (s_diff * l_diff < 0)).astype(jnp.float32)
+    neu = jnp.sum(valid & (s_diff == 0)).astype(jnp.float32)
+    acc_p = ctx.input("AccumulatePositivePair")
+    acc_n = ctx.input("AccumulateNegativePair")
+    acc_u = ctx.input("AccumulateNeutralPair")
+    if acc_p is not None:
+        pos = pos + acc_p.reshape(())
+        neg = neg + acc_n.reshape(())
+        neu = neu + acc_u.reshape(())
+    return {"PositivePair": pos.reshape(1),
+            "NegativePair": neg.reshape(1),
+            "NeutralPair": neu.reshape(1)}
+
+
+# --------------------------------------------------------------------------
+# proximal optimizers + accumulators (reference optimizers/)
+# --------------------------------------------------------------------------
+def _proximal(prox_param, lr, l1, l2):
+    if l1 > 0:
+        return (jnp.sign(prox_param)
+                * jnp.maximum(jnp.abs(prox_param) - lr * l1, 0.0)
+                / (1.0 + lr * l2))
+    return prox_param / (1.0 + lr * l2)
+
+
+@register_op("proximal_gd", differentiable=False,
+             inplace={"ParamOut": "Param"})
+def proximal_gd(ctx):
+    """reference proximal_gd_op.h:49-58."""
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    lr = ctx.input("LearningRate").reshape(())
+    return {"ParamOut": _proximal(p - lr * g, lr,
+                                  ctx.attr("l1", 0.0),
+                                  ctx.attr("l2", 0.0))}
+
+
+@register_op("proximal_adagrad", differentiable=False,
+             inplace={"ParamOut": "Param", "MomentOut": "Moment"})
+def proximal_adagrad(ctx):
+    """reference proximal_adagrad_op.h: adagrad step then the proximal
+    shrink."""
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    m_out = m + g * g
+    eff_lr = lr / jnp.sqrt(m_out)
+    return {"ParamOut": _proximal(p - eff_lr * g, eff_lr,
+                                  ctx.attr("l1", 0.0),
+                                  ctx.attr("l2", 0.0)),
+            "MomentOut": m_out}
+
+
+@register_op("average_accumulates", differentiable=False,
+             inplace={"out_sum_1": "in_sum_1", "out_sum_2": "in_sum_2",
+                      "out_sum_3": "in_sum_3",
+                      "out_num_accumulates": "in_num_accumulates",
+                      "out_old_num_accumulates":
+                          "in_old_num_accumulates",
+                      "out_num_updates": "in_num_updates"})
+def average_accumulates(ctx):
+    """reference average_accumulates_op.h: the ModelAverage windowed
+    triple-sum rotation."""
+    param = ctx.input("param")
+    s1 = ctx.input("in_sum_1")
+    s2 = ctx.input("in_sum_2")
+    s3 = ctx.input("in_sum_3")
+    na = ctx.input("in_num_accumulates").reshape(()).astype(jnp.int64)
+    ona = ctx.input("in_old_num_accumulates").reshape(()).astype(
+        jnp.int64)
+    nu = ctx.input("in_num_updates").reshape(()).astype(jnp.int64)
+    avg_win = ctx.attr("average_window", 0.0)
+    max_win = ctx.attr("max_average_window", 10000)
+    min_win = ctx.attr("min_average_window", 10000)
+    na = na + 1
+    nu = nu + 1
+    s1 = s1 + param
+    # reference average_accumulates_op.h:94-104: rotate when
+    # num_acc >= min_window AND num_acc >= min(max_window,
+    # num_updates * average_window); the old window (sums 1+2+3)
+    # moves wholesale into sum_3 and restarts
+    thresh = jnp.minimum(
+        jnp.asarray(max_win, jnp.float32),
+        nu.astype(jnp.float32) * avg_win)
+    rotate = (na >= min_win) & (na.astype(jnp.float32) >= thresh)
+    # sum_3 REPLACED by the window being discarded (in-place aliasing
+    # in the reference means sum_1 already includes this step's param)
+    s3r = jnp.where(rotate, s1 + s2, s3)
+    s1r = jnp.where(rotate, jnp.zeros_like(s1), s1)
+    s2f = jnp.where(rotate, jnp.zeros_like(s2), s2)
+    onar = jnp.where(rotate, na, ona)
+    naf = jnp.where(rotate, jnp.zeros_like(na), na)
+    return {"out_sum_1": s1r, "out_sum_2": s2f, "out_sum_3": s3r,
+            "out_num_accumulates": naf.reshape(1),
+            "out_old_num_accumulates": onar.reshape(1),
+            "out_num_updates": nu.reshape(1)}
+
+
+@register_op("dgc_clip_by_norm", differentiable=False)
+def dgc_clip_by_norm(ctx):
+    """reference dgc_clip_by_norm_op.h: clip_by_norm applied after
+    rampup_begin_step (before that, pass through)."""
+    x = ctx.input("X")
+    step = ctx.input("current_step")
+    begin = ctx.attr("rampup_begin_step", 0.0)
+    maxn = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    clipped = jnp.where(norm > maxn, x * (maxn / norm), x)
+    if step is None:
+        return clipped
+    return jnp.where(step.reshape(()) < begin, x, clipped)
+
+
+# --------------------------------------------------------------------------
+# sequence / LoD utilities (padded + @SEQ_LEN design)
+# --------------------------------------------------------------------------
+@register_op("sequence_mask", differentiable=False)
+def sequence_mask(ctx):
+    """reference sequence_mask_op.h: Y[..., j] = j < X[...]."""
+    x = ctx.input("X").astype(jnp.int32)
+    maxlen = ctx.attr("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen attr on TPU (XLA "
+            "static shapes); maxlen=-1 (max of X) is data-dependent")
+    out_dtype = ctx.attr("out_dtype", 5)
+    from ..core.types import DataType, to_jnp_dtype
+
+    dt = to_jnp_dtype(DataType(out_dtype)) if not isinstance(
+        out_dtype, str) else jnp.dtype(out_dtype)
+    j = jnp.arange(maxlen, dtype=jnp.int32)
+    return (j < x[..., None]).astype(dt)
+
+
+@register_op("sequence_expand_as", stop_gradient_slots=("Y",))
+def sequence_expand_as(ctx):
+    """reference sequence_expand_as_op.h: repeat each row of X to its
+    matching Y sequence length. Padded form: X [B, ...] broadcast over
+    Y's time axis [B, T, ...]; rows beyond @SEQ_LEN are zeros."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    seq_len = ctx.input("SeqLen")
+    t = y.shape[1]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    if seq_len is not None:
+        mask = (jnp.arange(t)[None, :] < seq_len[:, None]).astype(
+            out.dtype)
+        out = out * mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+    return out
+
+
+@register_op("sequence_erase", differentiable=False)
+def sequence_erase(ctx):
+    """reference sequence_erase_op.h: drop the listed tokens from each
+    sequence, compacting left. Padded form: stable left-shift of the
+    kept tokens, zero pad, @SEQ_LEN shrinks accordingly (returned as
+    OutLen)."""
+    x = ctx.input("X")  # [B, T] int
+    seq_len = ctx.input("SeqLen")
+    tokens = jnp.asarray(ctx.attr("tokens", []), x.dtype)
+    t = x.shape[1]
+    pos = jnp.arange(t)[None, :]
+    valid = (pos < seq_len[:, None]) if seq_len is not None else \
+        jnp.ones_like(x, bool)
+    keep = valid & ~jnp.isin(x, tokens)
+    # stable compaction: kept tokens get rank = cumsum-1, dropped go
+    # past the end and fall off via mode="drop"
+    rank = jnp.cumsum(keep, axis=1) - 1
+    dest = jnp.where(keep, rank, t)
+    out = jnp.zeros_like(x)
+    rows = jnp.arange(x.shape[0])[:, None]
+    out = out.at[rows, dest].set(jnp.where(keep, x, 0), mode="drop")
+    return {"Out": out, "OutLen": keep.sum(axis=1).astype(jnp.int32)}
+
+
+@register_op("split_lod_tensor", differentiable=False)
+def split_lod_tensor(ctx):
+    """reference split_lod_tensor_op.cc (the IfElse splitter): rows
+    routed by Mask. Static-shape form: both outputs keep the full
+    batch, rows not belonging are zeroed; the ifelse op composes the
+    true/false flows row-wise (ops/lod_ops.py)."""
+    x = ctx.input("X")
+    mask = ctx.input("Mask").reshape(-1).astype(bool)
+    m = mask.reshape((-1,) + (1,) * (x.ndim - 1))
+    return {"OutTrue": jnp.where(m, x, 0),
+            "OutFalse": jnp.where(m, 0, x)}
+
+
+@register_op("merge_lod_tensor", differentiable=False)
+def merge_lod_tensor(ctx):
+    """reference merge_lod_tensor_op.cc: inverse of split_lod_tensor
+    under the zero-fill convention."""
+    mask = ctx.input("Mask").reshape(-1).astype(bool)
+    t, f = ctx.input("InTrue"), ctx.input("InFalse")
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1))
+    return jnp.where(m, t, f)
+
+
+@register_op("tensor_array_to_tensor", differentiable=False,
+             infer_shape=lambda op, block: None)
+def tensor_array_to_tensor(ctx):
+    """reference tensor_array_to_tensor_op.cc: stack/concat the array
+    entries along attr axis."""
+    arr = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    use_stack = ctx.attr("use_stack", False)
+    vals = list(arr)
+    out = (jnp.stack(vals, axis=axis) if use_stack
+           else jnp.concatenate(vals, axis=axis))
+    idx = jnp.asarray([v.shape[axis] if not use_stack else 1
+                       for v in vals], jnp.int32)
+    return {"Out": out, "OutIndex": idx}
+
+
+@register_op("rnn_memory_helper")
+def rnn_memory_helper(ctx):
+    """reference rnn_memory_helper_op.cc: identity used by StaticRNN's
+    step_output plumbing (kept for program-level parity)."""
+    return ctx.input("X")
+
+
+# --------------------------------------------------------------------------
+# conv variants / norm
+# --------------------------------------------------------------------------
+@register_op("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(ctx):
+    """reference conv_transpose_op.cc depthwise variant: groups ==
+    channels transpose conv."""
+    from .nn_ops import _conv_transpose_nd, _pair
+
+    x = ctx.input("Input")
+    w = ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", x.shape[1])
+    return {"Output": _conv_transpose_nd(x, w, strides, pads,
+                                         dilations, groups, spatial=2)}
+
+
+@register_op("sync_batch_norm", grad_maker=None)
+def sync_batch_norm(ctx):
+    """reference sync_batch_norm_op.cu: batch norm with CROSS-REPLICA
+    statistics. Under the GSPMD executor the whole batch is one logical
+    tensor, so plain batch_norm stats are already global -- this alias
+    documents that and additionally psums over an explicit shard_map
+    axis when one is active (attr axis_name)."""
+    from .nn_ops import batch_norm
+
+    axis = ctx.attr("axis_name", None)
+    if axis is None:
+        return batch_norm(ctx)
+    x = ctx.input("X")
+    scale = ctx.input("Scale")
+    bias = ctx.input("Bias")
+    mean_in = ctx.input("Mean")
+    var_in = ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    red = tuple(i for i in range(x.ndim) if i != 1)
+    n_local = np.prod([x.shape[i] for i in red])
+    s = lax.psum(jnp.sum(x, axis=red), axis)
+    ss = lax.psum(jnp.sum(x * x, axis=red), axis)
+    n = lax.psum(jnp.asarray(float(n_local)), axis)
+    mean = s / n
+    var = ss / n - mean * mean
+    inv_std = jax.lax.rsqrt(var + eps)
+    bshape = (1, -1) + (1,) * (x.ndim - 2)
+    y = (x - mean.reshape(bshape)) * inv_std.reshape(bshape) \
+        * scale.reshape(bshape) + bias.reshape(bshape)
+    # same contract as nn_ops.batch_norm: SavedVariance holds inv-std
+    # (cuDNN convention) and the running stats get the momentum blend
+    mean_out = (mean_in * momentum + mean * (1 - momentum)
+                if mean_in is not None else mean)
+    var_out = (var_in * momentum + var * (1 - momentum)
+               if var_in is not None else var)
+    return {"Y": y, "SavedMean": mean, "SavedVariance": inv_std,
+            "MeanOut": mean_out, "VarianceOut": var_out}
+
+
+# --------------------------------------------------------------------------
+# distributed id plumbing (reference distributed_ops/)
+# --------------------------------------------------------------------------
+@register_op("split_ids", differentiable=False)
+def split_ids(ctx):
+    """reference split_ids_op.h: mod-shard ids across N outputs.
+    Static-shape form: each shard keeps the input length; slots not
+    belonging to the shard hold -1 padding."""
+    ids = ctx.input("Ids")
+    n = len(ctx.op.outputs["Out"])
+    outs = []
+    for i in range(n):
+        mine = (ids % n) == i
+        outs.append(jnp.where(mine, ids // n, -1))
+    return {"Out": outs}
+
+
+@register_op("merge_ids", differentiable=False)
+def merge_ids(ctx):
+    """reference merge_ids_op.h: route per-shard embedding rows back
+    to the original id order (inverse of split_ids + prefetch)."""
+    ids = ctx.input("Ids")  # original ids [N]
+    shard_vals = ctx.inputs("X")  # per-shard [N, D] rows (padded)
+    n = len(shard_vals)
+    out = jnp.zeros_like(shard_vals[0])
+    for i, sv in enumerate(shard_vals):
+        mine = ((ids % n) == i).reshape(-1, 1)
+        out = jnp.where(mine, sv, out)
+    return {"Out": out}
+
+
+@register_op("split_selected_rows", differentiable=False)
+def split_selected_rows(ctx):
+    """reference split_selected_rows_op.h: partition (rows, values) by
+    height_sections; pad slots -1."""
+    rows = ctx.input("Rows")
+    vals = ctx.input("Values")
+    sections = list(ctx.attr("height_sections"))
+    outs_r, outs_v = [], []
+    start = 0
+    for sec in sections:
+        mine = (rows >= start) & (rows < start + sec)
+        outs_r.append(jnp.where(mine, rows - start, -1))
+        outs_v.append(jnp.where(mine.reshape(-1, 1), vals, 0))
+        start += sec
+    return {"OutRows": outs_r, "OutValues": outs_v}
+
+
+@register_op("lookup_sparse_table", differentiable=False)
+def lookup_sparse_table(ctx):
+    """reference lookup_sparse_table_op.cc: embedding lookup that
+    auto-grows unknown ids (pserver-side). Single-program form: plain
+    gather with padding ids clamped (growth happens in the pserver
+    runtime's push_sparse_grad path)."""
+    w = ctx.input("W")
+    ids = ctx.input("Ids").astype(jnp.int32)
+    safe = jnp.clip(ids, 0, w.shape[0] - 1)
+    out = w[safe.reshape(-1)]
+    out = jnp.where((ids.reshape(-1) >= 0)[:, None], out, 0)
+    return out.reshape(tuple(ids.shape) + (w.shape[1],))
+
+
+@register_op("ref_by_trainer_id", differentiable=False)
+def ref_by_trainer_id(ctx):
+    """reference ref_by_trainer_id_op.h: select X[trainer_id]."""
+    xs = ctx.inputs("X")
+    tid = ctx.input("TrainerId")
+    i = jnp.reshape(tid, ()).astype(jnp.int32)
+    stacked = jnp.stack(xs)
+    return stacked[i]
+
+
+# --------------------------------------------------------------------------
+# detection extra
+# --------------------------------------------------------------------------
+@register_op("mine_hard_examples", differentiable=False)
+def mine_hard_examples(ctx):
+    """reference detection/mine_hard_examples_op.cc: pick the hardest
+    negatives per image at neg_pos_ratio. Padded form: NegIndices is
+    [B, M] with -1 padding; UpdatedMatchIndices keeps positives."""
+    cls_loss = ctx.input("ClsLoss")  # [B, M]
+    match = ctx.input("MatchIndices")  # [B, M]
+    neg_pos_ratio = ctx.attr("neg_pos_ratio", 3.0)
+    neg_overlap = ctx.attr("neg_dist_threshold", 0.5)
+    dist = ctx.input("MatchDist")
+    b, m = cls_loss.shape
+    pos = match >= 0
+    n_pos = pos.sum(axis=1)
+    n_neg = jnp.minimum((neg_pos_ratio * n_pos).astype(jnp.int32), m)
+    cand = (~pos)
+    if dist is not None:
+        cand = cand & (dist < neg_overlap)
+    score = jnp.where(cand, cls_loss, -jnp.inf)
+    order = jnp.argsort(-score, axis=1)
+    rank = jnp.arange(m)[None, :]
+    chosen = rank < n_neg[:, None]
+    has_cand = jnp.take_along_axis(score, order, axis=1) > -jnp.inf
+    neg_idx = jnp.where(chosen & has_cand, order, -1)
+    return {"NegIndices": neg_idx.astype(jnp.int32),
+            "UpdatedMatchIndices": match}

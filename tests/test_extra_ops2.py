@@ -1,0 +1,425 @@
+"""OpTests for op-gap batch 2 (numpy/torch oracles).
+
+Parity model: reference tests/unittests/test_bilinear_interp_op.py,
+test_nearest_interp_op.py, test_selu_op.py, test_l1_norm_op.py,
+test_pad_constant_like.py, test_space_to_depth_op.py,
+test_sequence_mask.py, test_sequence_erase_op.py, test_hash_op.py,
+test_precision_recall_op.py, test_positive_negative_pair_op.py,
+test_proximal_gd_op.py, test_proximal_adagrad_op.py, test_fsp_op.py,
+test_split_ids_op.py, test_merge_ids_op.py, test_mine_hard_examples_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+class TestBilinearInterp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "bilinear_interp"
+        x = np.random.random((2, 3, 4, 4)).astype("float32")
+        oh = ow = 8
+        # numpy oracle, align_corners=True
+        out = np.zeros((2, 3, oh, ow), np.float32)
+        for i in range(oh):
+            for j in range(ow):
+                sy = i * (4 - 1) / (oh - 1)
+                sx = j * (4 - 1) / (ow - 1)
+                y0, x0 = int(np.floor(sy)), int(np.floor(sx))
+                y1, x1 = min(y0 + 1, 3), min(x0 + 1, 3)
+                wy, wx = sy - y0, sx - x0
+                out[:, :, i, j] = (
+                    (1 - wy) * (1 - wx) * x[:, :, y0, x0]
+                    + (1 - wy) * wx * x[:, :, y0, x1]
+                    + wy * (1 - wx) * x[:, :, y1, x0]
+                    + wy * wx * x[:, :, y1, x1])
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 8, "out_w": 8, "align_corners": True}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestNearestInterp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "nearest_interp"
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        # align_corners=False, 2x upsample: each pixel repeats 2x2
+        out = x.repeat(2, axis=2).repeat(2, axis=3)
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": 8, "out_w": 8, "align_corners": False}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSelu(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "selu"
+        x = np.random.uniform(-2, 2, (4, 5)).astype("float32")
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        out = scale * np.where(x > 0, x, alpha * np.exp(x) - alpha)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestL1NormMinusPad(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "l1_norm"
+        x = np.random.uniform(-1, 1, (5, 6)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.abs(x).sum().reshape(1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestMinus(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "minus"
+        x = np.random.random((3, 4)).astype("float32")
+        y = np.random.random((3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestPadConstantLike(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "pad_constant_like"
+        x = np.zeros((4, 5), np.float32)
+        y = np.random.random((2, 3)).astype("float32")
+        out = np.full((4, 5), 7.0, np.float32)
+        out[:2, :3] = y
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"pad_value": 7.0}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSpaceToDepth(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "space_to_depth"
+        x = np.random.random((1, 2, 4, 4)).astype("float32")
+        b = 2
+        ref = x.reshape(1, 2, 2, b, 2, b).transpose(0, 3, 5, 1, 2, 4) \
+            .reshape(1, 2 * b * b, 2, 2)
+        self.inputs = {"X": x}
+        self.attrs = {"blocksize": 2}
+        self.outputs = {"Out": ref}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["X"], "Out")
+
+
+class TestFsp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fsp"
+        x = np.random.random((2, 3, 4, 4)).astype("float32")
+        y = np.random.random((2, 5, 4, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.einsum("bihw,bjhw->bij", x, y) / 16}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestHash:
+    def test_deterministic_and_bounded(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="int64")
+            helper = fluid.layers.nn.LayerHelper("hash", input=x)
+            out = prog.global_block.create_var(name="hashed")
+            helper.append_op("hash", {"X": x}, {"Out": out},
+                             {"num_hash": 2, "mod_by": 1000})
+        exe = fluid.Executor(fluid.CPUPlace())
+        ids = np.array([[1, 2, 3, 4], [1, 2, 3, 4]], np.int64)
+        a, = exe.run(prog, feed={"x": ids}, fetch_list=[out])
+        b, = exe.run(prog, feed={"x": ids}, fetch_list=[out])
+        np.testing.assert_array_equal(a, b)
+        assert a.min() >= 0 and a.max() < 1000
+        np.testing.assert_array_equal(a[0], a[1])  # same ids same hash
+        assert a.shape == (2, 2, 4)
+
+
+class TestProximalGD(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "proximal_gd"
+        p = np.random.random((4, 5)).astype("float32")
+        g = np.random.random((4, 5)).astype("float32")
+        lr = np.array([0.1], np.float32)
+        l1, l2 = 0.02, 0.01
+        prox = p - 0.1 * g
+        out = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0) \
+            / (1 + 0.1 * l2)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {"ParamOut": out.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestProximalAdagrad(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "proximal_adagrad"
+        p = np.random.random((4, 5)).astype("float32")
+        g = np.random.random((4, 5)).astype("float32")
+        m = np.random.random((4, 5)).astype("float32")
+        lr = np.array([0.1], np.float32)
+        l1, l2 = 0.02, 0.01
+        m_out = m + g * g
+        eff = 0.1 / np.sqrt(m_out)
+        prox = p - eff * g
+        out = np.sign(prox) * np.maximum(np.abs(prox) - eff * l1, 0) \
+            / (1 + eff * l2)
+        self.inputs = {"Param": p, "Grad": g, "Moment": m,
+                       "LearningRate": lr}
+        self.attrs = {"l1": l1, "l2": l2}
+        self.outputs = {"ParamOut": out.astype(np.float32),
+                        "MomentOut": m_out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestSequenceOpsPadded:
+    def _exe(self):
+        return fluid.Executor(fluid.CPUPlace())
+
+    def test_sequence_mask(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[3], dtype="int64",
+                                  append_batch_size=False)
+            helper = fluid.layers.nn.LayerHelper("sm", input=x)
+            out = prog.global_block.create_var(name="mask")
+            helper.append_op("sequence_mask", {"X": x}, {"Y": out},
+                             {"maxlen": 5, "out_dtype": "float32"})
+        got, = self._exe().run(prog,
+                               feed={"x": np.array([3, 0, 5],
+                                                   np.int64)},
+                               fetch_list=[out])
+        ref = np.array([[1, 1, 1, 0, 0], [0] * 5, [1] * 5], np.float32)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_sequence_erase(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[2, 6],
+                                  dtype="int64",
+                                  append_batch_size=False)
+            sl = fluid.layers.data(name="sl", shape=[2],
+                                   dtype="int32",
+                                   append_batch_size=False)
+            helper = fluid.layers.nn.LayerHelper("se", input=x)
+            out = prog.global_block.create_var(name="erased")
+            olen = prog.global_block.create_var(name="erased_len")
+            helper.append_op("sequence_erase",
+                             {"X": x, "SeqLen": sl},
+                             {"Out": out, "OutLen": olen},
+                             {"tokens": [0, 2]})
+        xs = np.array([[1, 0, 2, 3, 0, 9],
+                       [2, 2, 1, 4, 5, 6]], np.int64)
+        lens = np.array([6, 4], np.int32)
+        got, glen = self._exe().run(prog, feed={"x": xs, "sl": lens},
+                                    fetch_list=[out, olen])
+        np.testing.assert_array_equal(got[0], [1, 3, 9, 0, 0, 0])
+        np.testing.assert_array_equal(got[1], [1, 4, 0, 0, 0, 0])
+        np.testing.assert_array_equal(glen, [3, 2])
+
+    def test_sequence_expand_as(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[2, 3],
+                                  dtype="float32",
+                                  append_batch_size=False)
+            y = fluid.layers.data(name="y", shape=[2, 4, 1],
+                                  dtype="float32",
+                                  append_batch_size=False)
+            sl = fluid.layers.data(name="sl", shape=[2],
+                                   dtype="int32",
+                                   append_batch_size=False)
+            helper = fluid.layers.nn.LayerHelper("sea", input=x)
+            out = prog.global_block.create_var(name="expanded")
+            helper.append_op("sequence_expand_as",
+                             {"X": x, "Y": y, "SeqLen": sl},
+                             {"Out": out}, {})
+        xs = np.arange(6, dtype=np.float32).reshape(2, 3)
+        got, = self._exe().run(
+            prog, feed={"x": xs,
+                        "y": np.zeros((2, 4, 1), np.float32),
+                        "sl": np.array([4, 2], np.int32)},
+            fetch_list=[out])
+        assert got.shape == (2, 4, 3)
+        np.testing.assert_array_equal(got[0, 3], xs[0])
+        np.testing.assert_array_equal(got[1, 1], xs[1])
+        np.testing.assert_array_equal(got[1, 2], 0)
+
+
+class TestMetrics:
+    def test_precision_recall_perfect(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            idx = fluid.layers.data(name="idx", shape=[1],
+                                    dtype="int32")
+            lab = fluid.layers.data(name="lab", shape=[1],
+                                    dtype="int32")
+            helper = fluid.layers.nn.LayerHelper("pr", input=idx)
+            bm = prog.global_block.create_var(name="bm")
+            am = prog.global_block.create_var(name="am")
+            st = prog.global_block.create_var(name="st")
+            helper.append_op("precision_recall",
+                             {"Indices": idx, "Labels": lab},
+                             {"BatchMetrics": bm, "AccumMetrics": am,
+                              "AccumStatesInfo": st},
+                             {"class_number": 3})
+        exe = fluid.Executor(fluid.CPUPlace())
+        ids = np.array([[0], [1], [2], [1]], np.int32)
+        got_bm, got_st = exe.run(prog, feed={"idx": ids, "lab": ids},
+                                 fetch_list=[bm, st])
+        np.testing.assert_allclose(got_bm, np.ones(6), rtol=1e-6)
+        assert got_st[:, 0].sum() == 4  # all TP
+
+    def test_positive_negative_pair(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            s = fluid.layers.data(name="s", shape=[1],
+                                  dtype="float32")
+            l = fluid.layers.data(name="l", shape=[1],
+                                  dtype="float32")
+            q = fluid.layers.data(name="q", shape=[1], dtype="int64")
+            helper = fluid.layers.nn.LayerHelper("pnp", input=s)
+            pos = prog.global_block.create_var(name="pos")
+            neg = prog.global_block.create_var(name="neg")
+            neu = prog.global_block.create_var(name="neu")
+            helper.append_op("positive_negative_pair",
+                             {"Score": s, "Label": l, "QueryID": q},
+                             {"PositivePair": pos,
+                              "NegativePair": neg,
+                              "NeutralPair": neu}, {})
+        exe = fluid.Executor(fluid.CPUPlace())
+        # query 0: scores agree with labels (1 pos pair); query 1:
+        # scores disagree (1 neg pair)
+        feed = {"s": np.array([[0.9], [0.1], [0.2], [0.7]],
+                              np.float32),
+                "l": np.array([[1], [0], [1], [0]], np.float32),
+                "q": np.array([[0], [0], [1], [1]], np.int64)}
+        p, n, u = exe.run(prog, feed=feed, fetch_list=[pos, neg, neu])
+        assert float(p.reshape(-1)[0]) == 1.0
+        assert float(n.reshape(-1)[0]) == 1.0
+        assert float(u.reshape(-1)[0]) == 0.0
+
+
+class TestSplitMergeIds:
+    def test_split_then_merge_roundtrip(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            ids = fluid.layers.data(name="ids", shape=[6],
+                                    dtype="int64",
+                                    append_batch_size=False)
+            helper = fluid.layers.nn.LayerHelper("si", input=ids)
+            s0 = prog.global_block.create_var(name="s0")
+            s1 = prog.global_block.create_var(name="s1")
+            helper.append_op("split_ids", {"Ids": ids},
+                             {"Out": [s0, s1]}, {})
+        exe = fluid.Executor(fluid.CPUPlace())
+        ids_np = np.array([0, 1, 2, 3, 4, 5], np.int64)
+        a, b = exe.run(prog, feed={"ids": ids_np},
+                       fetch_list=[s0, s1])
+        np.testing.assert_array_equal(a, [0, -1, 1, -1, 2, -1])
+        np.testing.assert_array_equal(b, [-1, 0, -1, 1, -1, 2])
+
+
+class TestMineHardExamples:
+    def test_hardest_negatives_selected(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            cl = fluid.layers.data(name="cl", shape=[1, 6],
+                                   dtype="float32",
+                                   append_batch_size=False)
+            mi = fluid.layers.data(name="mi", shape=[1, 6],
+                                   dtype="int32",
+                                   append_batch_size=False)
+            helper = fluid.layers.nn.LayerHelper("mhe", input=cl)
+            neg = prog.global_block.create_var(name="neg")
+            upd = prog.global_block.create_var(name="upd")
+            helper.append_op("mine_hard_examples",
+                             {"ClsLoss": cl, "MatchIndices": mi},
+                             {"NegIndices": neg,
+                              "UpdatedMatchIndices": upd},
+                             {"neg_pos_ratio": 2.0})
+        exe = fluid.Executor(fluid.CPUPlace())
+        cls_loss = np.array([[0.1, 0.9, 0.3, 0.8, 0.2, 0.5]],
+                            np.float32)
+        match = np.array([[0, -1, -1, -1, -1, -1]], np.int32)
+        got, _ = exe.run(prog, feed={"cl": cls_loss, "mi": match},
+                         fetch_list=[neg, upd])
+        # 1 positive -> 2 negatives: hardest unmatched are idx 1 (0.9)
+        # and idx 3 (0.8)
+        picked = set(got[0][got[0] >= 0].tolist())
+        assert picked == {1, 3}
+
+
+class TestModelAverageOp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "average_accumulates"
+        p = np.random.random((3, 4)).astype("float32")
+        s1 = np.zeros((3, 4), np.float32)
+        s2 = np.zeros((3, 4), np.float32)
+        s3 = np.zeros((3, 4), np.float32)
+        na = np.array([0.0], np.float32)
+        ona = np.array([0.0], np.float32)
+        nu = np.array([0.0], np.float32)
+        self.inputs = {"param": p, "in_sum_1": s1, "in_sum_2": s2,
+                       "in_sum_3": s3, "in_num_accumulates": na,
+                       "in_old_num_accumulates": ona,
+                       "in_num_updates": nu}
+        self.attrs = {"average_window": 0.5,
+                      "max_average_window": 100,
+                      "min_average_window": 10}
+        self.outputs = {"out_sum_1": s1 + p, "out_sum_2": s2,
+                        "out_sum_3": s3,
+                        "out_num_accumulates": np.array([1]),
+                        "out_old_num_accumulates": np.array([0]),
+                        "out_num_updates": np.array([1])}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
