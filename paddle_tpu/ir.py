@@ -137,6 +137,10 @@ class Pass:
     def apply(self, graph: Graph, scope=None) -> Graph:
         self.apply_impl(graph, scope)
         graph.rebuild()
+        # any rewrite invalidates warm Executor caches keyed on
+        # (id(program), program._version, ...) -- removal/rewire-only
+        # passes would otherwise serve the stale pre-pass executable
+        graph.program._version += 1
         return graph
 
     def apply_impl(self, graph: Graph, scope) -> None:
@@ -366,3 +370,133 @@ class FCFusePass(Pass):
                 {"in_num_col_dims": mul.attr("x_num_col_dims", 1),
                  "activation_type": "relu" if act else ""})
             i = idx  # continue right after the new fc op
+
+
+@register_pass("attention_fuse_pass")
+class AttentionFusePass(Pass):
+    """Fuse the hand-written scaled-dot-product attention composition
+    (reference nets.py scaled_dot_product_attention builds it from
+    matmul/scale/softmax/dropout/matmul -- the reference has NO fused
+    attention op) into the framework's `attention` op, which routes to
+    the Pallas flash kernel / transpose-free XLA path (ops/nn_ops.py).
+
+    Pattern on 4D [B,H,T,D] operands:
+        matmul(Q, K, transpose_Y=True) [-> scale] -> softmax
+        [-> dropout] -> matmul(., V)
+    Every intermediate must have exactly one consumer and not be
+    protected (fetched).
+    """
+
+    def apply_impl(self, graph: Graph, scope):
+        protected = graph.attrs.get("protected", set())
+
+        def sole_consumer(op, out_name):
+            if out_name in protected:
+                return None
+            cons = graph.consumers(op, out_name)
+            return cons[0] if len(cons) == 1 else None
+
+        i = 0
+        while i < len(graph.block.ops):
+            qk = graph.block.ops[i]
+            i += 1
+            if qk.type != "matmul" or not qk.attr("transpose_Y", False) \
+                    or qk.attr("transpose_X", False):
+                continue
+            qv = graph.block._find_var_recursive(qk.input("X")[0])
+            if qv is None or qv.shape is None or len(qv.shape) != 4:
+                continue
+            scale = qk.attr("alpha", 1.0)
+            cur = qk
+            out, = cur.output("Out")
+            nxt = sole_consumer(cur, out)
+            scale_op = None
+            if nxt is not None and nxt.type == "scale":
+                if nxt.attr("bias", 0.0) != 0.0:
+                    continue
+                scale_op = nxt
+                scale *= nxt.attr("scale", 1.0)
+                cur, out = nxt, nxt.output("Out")[0]
+                nxt = sole_consumer(cur, out)
+            if nxt is None or nxt.type != "softmax" or \
+                    nxt.attr("axis", -1) not in (-1, 3):
+                continue  # fused attention softmaxes the LAST axis
+            sm = nxt
+            cur, out = sm, sm.output("Out")[0]
+            nxt = sole_consumer(cur, out)
+            dropout_rate = 0.0
+            drop = None
+            if nxt is not None and nxt.type == "dropout":
+                if nxt.attr("dropout_implementation",
+                            "downgrade_in_infer") != "upscale_in_train":
+                    continue  # infer-mode scaling changes semantics
+                drop = nxt
+                dropout_rate = (0.0 if drop.attr("is_test", False)
+                                else drop.attr("dropout_prob", 0.5))
+                cur, out = drop, drop.output("Out")[0]
+                nxt = sole_consumer(cur, out)
+            if nxt is None or nxt.type != "matmul" or \
+                    nxt.attr("transpose_X", False) or \
+                    nxt.attr("transpose_Y", False) or \
+                    nxt.attr("alpha", 1.0) != 1.0 or \
+                    nxt.input("X")[0] != out:
+                continue
+            pv = nxt
+            final_out = pv.output("Out")[0]
+            ops_to_remove = [op for op in (qk, scale_op, sm, drop, pv)
+                             if op is not None]
+            idx = graph.block.ops.index(qk)
+            for dead in ops_to_remove:
+                graph.remove_op(dead)
+            graph.block.insert_op(
+                idx, "attention",
+                {"Q": qk.input("X"), "K": qk.input("Y"),
+                 "V": pv.input("Y")},
+                {"Out": [final_out]},
+                {"scale": float(scale), "causal": False,
+                 "dropout_rate": float(dropout_rate),
+                 "layout": "bhtd"})
+            i = idx + 1
+
+
+@register_pass("identity_elimination_pass")
+class IdentityEliminationPass(Pass):
+    """Drop no-op ops: scale(scale=1, bias=0), cast to the same dtype,
+    chained assign (reference: the simplification family of
+    inference passes, e.g. identity_scale_op_clean_pass.cc)."""
+
+    def apply_impl(self, graph: Graph, scope):
+        protected = graph.attrs.get("protected", set())
+        for op in list(graph.block.ops):
+            out_name = None
+            if op.type == "scale" and op.attr("scale", 1.0) == 1.0 \
+                    and op.attr("bias", 0.0) == 0.0:
+                out_name, = op.output("Out")
+            elif op.type == "cast":
+                src = graph.block._find_var_recursive(op.input("X")[0])
+                if src is not None and src.dtype is not None and \
+                        op.attr("out_dtype") in (src.dtype,
+                                                 getattr(src.dtype,
+                                                         "value", None)):
+                    out_name, = op.output("Out")
+            elif op.type == "assign":
+                # collapse assigns only into pure temps (a persistable
+                # target is a state write-back the executor threads)
+                out_name, = op.output("Out")
+                var = graph.block._find_var_recursive(out_name)
+                if var is not None and var.persistable:
+                    out_name = None
+            if out_name is None or out_name in protected:
+                continue
+            x, = op.input("X")
+            # rewiring readers of out_name to x is only sound if
+            # NEITHER name is redefined later (an in-place write to x
+            # would leak the new value into pre-write readers; a
+            # rewrite of out_name would double-apply)
+            idx = graph.block.ops.index(op)
+            later_writes = {n for later in graph.block.ops[idx + 1:]
+                            for n in later.output_arg_names}
+            if x in later_writes or out_name in later_writes:
+                continue
+            graph.replace_input_everywhere(out_name, x, after=op)
+            graph.remove_op(op)

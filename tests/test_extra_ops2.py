@@ -423,3 +423,153 @@ class TestModelAverageOp(OpTest):
 
     def test_output(self):
         self.check_output(atol=1e-5)
+
+
+class TestNewIRPasses:
+    def _build_manual_attention(self, dropout=False):
+        """The reference nets.py scaled_dot_product_attention shape:
+        matmul(qk, transpose_Y) -> scale -> softmax -> matmul(v)."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            q = fluid.layers.data(name="q", shape=[4, 6, 8],
+                                  dtype="float32")
+            k = fluid.layers.data(name="k", shape=[4, 6, 8],
+                                  dtype="float32")
+            v = fluid.layers.data(name="v", shape=[4, 6, 8],
+                                  dtype="float32")
+            s = fluid.layers.matmul(q, k, transpose_y=True)
+            s = fluid.layers.scale(s, scale=8 ** -0.5)
+            w = fluid.layers.softmax(s)
+            if dropout:
+                w = fluid.layers.dropout(
+                    w, 0.1, dropout_implementation="upscale_in_train",
+                    is_test=True)
+            out = fluid.layers.matmul(w, v)
+        return prog, out
+
+    def test_attention_fuse_matches_unfused(self):
+        from paddle_tpu import ir
+
+        prog, out = self._build_manual_attention()
+        rng = np.random.RandomState(0)
+        feed = {n: rng.randn(2, 4, 6, 8).astype(np.float32)
+                for n in ("q", "k", "v")}
+        exe = fluid.Executor(fluid.CPUPlace())
+        ref, = exe.run(prog, feed=feed, fetch_list=[out])
+        ir.apply_passes(prog, ["attention_fuse_pass"],
+                        protected={out.name})
+        types = [op.type for op in prog.global_block.ops]
+        assert "attention" in types and "softmax" not in types
+        got, = exe.run(prog, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_attention_fuse_keeps_protected_intermediates(self):
+        from paddle_tpu import ir
+
+        prog, out = self._build_manual_attention()
+        # protect the softmax output -> fusion must NOT fire
+        sm_out = [op.output("Out")[0] for op in prog.global_block.ops
+                  if op.type == "softmax"][0]
+        ir.apply_passes(prog, ["attention_fuse_pass"],
+                        protected={out.name, sm_out})
+        types = [op.type for op in prog.global_block.ops]
+        assert "attention" not in types
+
+    def test_identity_elimination(self):
+        from paddle_tpu import ir
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[4],
+                                  dtype="float32")
+            a = fluid.layers.scale(x, scale=1.0, bias=0.0)  # no-op
+            b = fluid.layers.cast(a, "float32")             # no-op
+            out = fluid.layers.scale(b, scale=2.0)
+        n_before = len(prog.global_block.ops)
+        ir.apply_passes(prog, ["identity_elimination_pass"],
+                        protected={out.name})
+        types = [op.type for op in prog.global_block.ops]
+        assert len(prog.global_block.ops) < n_before
+        assert "cast" not in types
+        exe = fluid.Executor(fluid.CPUPlace())
+        xs = np.ones((2, 4), np.float32)
+        got, = exe.run(prog, feed={"x": xs}, fetch_list=[out])
+        np.testing.assert_allclose(got, 2 * xs)
+
+    def test_attention_fuse_dropout_arm(self):
+        from paddle_tpu import ir
+
+        # is_test dropout in the chain -> fuses with dropout_rate 0
+        prog, out = self._build_manual_attention(dropout=True)
+        rng = np.random.RandomState(0)
+        feed = {n: rng.randn(2, 4, 6, 8).astype(np.float32)
+                for n in ("q", "k", "v")}
+        exe = fluid.Executor(fluid.CPUPlace())
+        ref, = exe.run(prog, feed=feed, fetch_list=[out])
+        ir.apply_passes(prog, ["attention_fuse_pass"],
+                        protected={out.name})
+        attn = [op for op in prog.global_block.ops
+                if op.type == "attention"]
+        assert len(attn) == 1
+        assert attn[0].attr("dropout_rate") == 0.0  # is_test
+        got, = exe.run(prog, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_attention_fuse_rejects_non_last_axis_softmax(self):
+        from paddle_tpu import ir
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            q = fluid.layers.data(name="q", shape=[4, 6, 8],
+                                  dtype="float32")
+            k = fluid.layers.data(name="k", shape=[4, 6, 8],
+                                  dtype="float32")
+            v = fluid.layers.data(name="v", shape=[4, 6, 8],
+                                  dtype="float32")
+            s = fluid.layers.matmul(q, k, transpose_y=True)
+            w = fluid.layers.softmax(s, axis=1)
+            out = fluid.layers.matmul(w, v)
+        ir.apply_passes(prog, ["attention_fuse_pass"],
+                        protected={out.name})
+        assert "attention" not in [op.type
+                                   for op in prog.global_block.ops]
+
+    def test_identity_elim_respects_inplace_rewrites(self):
+        from paddle_tpu import ir
+
+        # snap = assign(x); x += 1; out = snap + x  -- the assign must
+        # SURVIVE (rewiring snap->x would read the post-increment x)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[1],
+                                  dtype="float32",
+                                  append_batch_size=False)
+            snap = fluid.layers.tensor.assign(x)
+            fluid.layers.increment(x, value=1.0)
+            out = fluid.layers.elementwise_add(snap, x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"x": np.array([1.0], np.float32)}
+        ref, = exe.run(prog, feed=feed, fetch_list=[out])
+        ir.apply_passes(prog, ["identity_elimination_pass"],
+                        protected={out.name})
+        got, = exe.run(prog, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(got, ref)  # 1 + 2 = 3, not 4
+
+    def test_pass_invalidates_executor_cache(self):
+        from paddle_tpu import ir
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[2],
+                                  dtype="float32")
+            a = fluid.layers.scale(x, scale=1.0, bias=0.0)
+            out = fluid.layers.scale(a, scale=3.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feed = {"x": np.ones((1, 2), np.float32)}
+        exe.run(prog, feed=feed, fetch_list=[out])  # warm the cache
+        v0 = prog._version
+        ir.apply_passes(prog, ["identity_elimination_pass"],
+                        protected={out.name})
+        assert prog._version != v0  # removal-only pass must bump too
+        got, = exe.run(prog, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(got, 3.0)
