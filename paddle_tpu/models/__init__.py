@@ -11,3 +11,5 @@ from . import stacked_dynamic_lstm  # noqa: F401
 from . import ctr  # noqa: F401
 from . import word2vec  # noqa: F401
 from . import machine_translation  # noqa: F401
+from . import recommender  # noqa: F401
+from . import label_semantic_roles  # noqa: F401

@@ -1,0 +1,86 @@
+"""Semantic role labeling (reference tests/book/
+test_label_semantic_roles.py): 8-way feature embeddings -> stacked
+bidirectional dynamic LSTM -> linear-chain CRF over the tag sequence."""
+from __future__ import annotations
+
+from .. import layers
+from ..layers.sequence import bind_seq_len
+from ..param_attr import ParamAttr
+
+WORD_DICT, PRED_DICT, MARK_DICT, LABEL_DICT = 1000, 200, 2, 59
+WORD_DIM, MARK_DIM, HIDDEN, DEPTH = 32, 5, 128, 4
+FEATURES = ("word_data", "ctx_n2_data", "ctx_n1_data", "ctx_0_data",
+            "ctx_p1_data", "ctx_p2_data")
+
+
+def db_lstm(seq_len=16, depth=DEPTH, hidden_dim=HIDDEN):
+    """reference db_lstm :53: shared word embeddings over 6 context
+    features + predicate + mark, then `depth` alternating-direction
+    LSTM layers with mix-hidden skip connections."""
+    word_inputs = [layers.data(n, shape=[seq_len], dtype="int64")
+                   for n in FEATURES]
+    predicate = layers.data("verb_data", shape=[seq_len],
+                            dtype="int64")
+    mark = layers.data("mark_data", shape=[seq_len], dtype="int64")
+
+    emb_layers = [layers.embedding(
+        w, size=[WORD_DICT, WORD_DIM],
+        param_attr=ParamAttr(name="emb", trainable=True))
+        for w in word_inputs]
+    emb_layers.append(layers.embedding(
+        predicate, size=[PRED_DICT, WORD_DIM],
+        param_attr=ParamAttr(name="vemb")))
+    emb_layers.append(layers.embedding(
+        mark, size=[MARK_DICT, MARK_DIM]))
+
+    hidden_0 = layers.sums([
+        layers.fc(emb, hidden_dim, num_flatten_dims=2)
+        for emb in emb_layers])
+    proj_0 = layers.fc(hidden_0, hidden_dim * 4, num_flatten_dims=2)
+    bind_seq_len(proj_0, word_inputs[0])
+    lstm_0, _ = layers.dynamic_lstm(
+        proj_0, size=hidden_dim * 4, candidate_activation="relu",
+        gate_activation="sigmoid", cell_activation="sigmoid")
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, depth):
+        mix_hidden = layers.sums([
+            layers.fc(input_tmp[0], hidden_dim, num_flatten_dims=2),
+            layers.fc(input_tmp[1], hidden_dim, num_flatten_dims=2)])
+        proj = layers.fc(mix_hidden, hidden_dim * 4,
+                         num_flatten_dims=2)
+        bind_seq_len(proj, word_inputs[0])
+        lstm, _ = layers.dynamic_lstm(
+            proj, size=hidden_dim * 4, candidate_activation="relu",
+            gate_activation="sigmoid", cell_activation="sigmoid",
+            is_reverse=(i % 2) == 1)
+        input_tmp = [mix_hidden, lstm]
+
+    return layers.sums([
+        layers.fc(input_tmp[0], LABEL_DICT, num_flatten_dims=2),
+        layers.fc(input_tmp[1], LABEL_DICT, num_flatten_dims=2)])
+
+
+def build_program(seq_len=16, lr=0.01, with_optimizer=True,
+                  depth=DEPTH):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    from ..layers.sequence import seq_len_of
+
+    with fluid.program_guard(main, startup):
+        feature_out = db_lstm(seq_len=seq_len, depth=depth)
+        target = layers.data("target", shape=[seq_len], dtype="int64")
+        # lengths matter: padded positions must not contribute to the
+        # CRF NLL nor receive decoded tags (reference LoD-aware CRF)
+        length = seq_len_of(target)
+        crf_cost = layers.linear_chain_crf(
+            input=feature_out, label=target, length=length,
+            param_attr=ParamAttr(name="crfw", learning_rate=1.0))
+        avg_cost = layers.mean(crf_cost)
+        crf_decode = layers.crf_decoding(
+            input=feature_out, length=length,
+            param_attr=ParamAttr(name="crfw"))
+        if with_optimizer:
+            fluid.optimizer.SGD(learning_rate=lr).minimize(avg_cost)
+    return main, startup, avg_cost, crf_decode

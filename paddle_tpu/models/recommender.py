@@ -1,0 +1,70 @@
+"""Personalized recommendation (reference tests/book/
+test_recommender_system.py): user-tower and movie-tower embeddings ->
+fc fusion -> cos_sim rating regression on MovieLens-shaped ids."""
+from __future__ import annotations
+
+from .. import layers
+from ..layers.sequence import bind_seq_len
+
+USR_DICT, GENDER_DICT, AGE_DICT, JOB_DICT = 6041, 2, 7, 21
+MOV_DICT, CATEGORY_DICT, TITLE_DICT = 3953, 19, 5175
+
+
+def user_tower():
+    uid = layers.data("user_id", shape=[1], dtype="int64")
+    usr_emb = layers.embedding(uid, size=[USR_DICT, 32])
+    usr_fc = layers.fc(usr_emb, 32)
+
+    gender = layers.data("gender_id", shape=[1], dtype="int64")
+    gender_fc = layers.fc(
+        layers.embedding(gender, size=[GENDER_DICT, 16]), 16)
+
+    age = layers.data("age_id", shape=[1], dtype="int64")
+    age_fc = layers.fc(layers.embedding(age, size=[AGE_DICT, 16]), 16)
+
+    job = layers.data("job_id", shape=[1], dtype="int64")
+    job_fc = layers.fc(layers.embedding(job, size=[JOB_DICT, 16]), 16)
+
+    concat = layers.concat([usr_fc, gender_fc, age_fc, job_fc], axis=1)
+    return layers.fc(concat, 200, act="tanh")
+
+
+def movie_tower(title_len=8):
+    mid = layers.data("movie_id", shape=[1], dtype="int64")
+    mov_fc = layers.fc(layers.embedding(mid, size=[MOV_DICT, 32]), 32)
+
+    # category and title are variable-length id lists (LoD in the
+    # reference): padded + @SEQ_LEN here, pooled to fixed width
+    cat = layers.data("category_id", shape=[CATEGORY_DICT],
+                      dtype="int64")
+    cat_emb = layers.embedding(cat, size=[CATEGORY_DICT, 32])
+    bind_seq_len(cat_emb, cat)
+    cat_pool = layers.sequence_pool(cat_emb, pool_type="sum")
+
+    title = layers.data("movie_title", shape=[title_len],
+                        dtype="int64")
+    title_emb = layers.embedding(title, size=[TITLE_DICT, 32])
+    bind_seq_len(title_emb, title)
+    title_conv = layers.sequence_conv(title_emb, num_filters=32,
+                                      filter_size=3, act="tanh")
+    title_pool = layers.sequence_pool(title_conv, pool_type="sum")
+
+    concat = layers.concat([mov_fc, cat_pool, title_pool], axis=1)
+    return layers.fc(concat, 200, act="tanh")
+
+
+def build_program(lr=0.2, with_optimizer=True, title_len=8):
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        usr = user_tower()
+        mov = movie_tower(title_len)
+        scale_infer = layers.scale(layers.cos_sim(usr, mov), scale=5.0)
+        label = layers.data("score", shape=[1], dtype="float32")
+        cost = layers.mean(layers.square_error_cost(scale_infer,
+                                                    label))
+        if with_optimizer:
+            fluid.optimizer.SGD(learning_rate=lr).minimize(cost)
+    return main, startup, cost, scale_infer

@@ -1,0 +1,140 @@
+"""Book-model parity: recommender system + label semantic roles
+(reference tests/book/test_recommender_system.py,
+test_label_semantic_roles.py) train end to end with decreasing loss.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models import label_semantic_roles as srl
+from paddle_tpu.models import recommender as rec
+
+
+def _run(prog, startup, cost, feeds, steps=12):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    losses = []
+    for _ in range(steps):
+        l, = exe.run(prog, feed=feeds, fetch_list=[cost], scope=scope)
+        losses.append(float(np.asarray(l).reshape(-1)[0]))
+    return losses
+
+
+class TestRecommenderSystem:
+    def test_trains(self):
+        rng = np.random.RandomState(0)
+        b, tl = 16, 8
+        prog, startup, cost, infer = rec.build_program(title_len=tl)
+        cat_len = rng.randint(1, 4, (b,)).astype(np.int32)
+        title_len = rng.randint(2, tl + 1, (b,)).astype(np.int32)
+        feeds = {
+            "user_id": rng.randint(0, rec.USR_DICT, (b, 1))
+            .astype(np.int64),
+            "gender_id": rng.randint(0, 2, (b, 1)).astype(np.int64),
+            "age_id": rng.randint(0, rec.AGE_DICT, (b, 1))
+            .astype(np.int64),
+            "job_id": rng.randint(0, rec.JOB_DICT, (b, 1))
+            .astype(np.int64),
+            "movie_id": rng.randint(0, rec.MOV_DICT, (b, 1))
+            .astype(np.int64),
+            "category_id": rng.randint(0, rec.CATEGORY_DICT,
+                                       (b, rec.CATEGORY_DICT))
+            .astype(np.int64),
+            "category_id@SEQ_LEN": cat_len,
+            "movie_title": rng.randint(0, rec.TITLE_DICT, (b, tl))
+            .astype(np.int64),
+            "movie_title@SEQ_LEN": title_len,
+            "score": rng.uniform(1, 5, (b, 1)).astype(np.float32),
+        }
+        losses = _run(prog, startup, cost, feeds, steps=15)
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_inference_range(self):
+        rng = np.random.RandomState(1)
+        prog, startup, cost, infer = rec.build_program(
+            with_optimizer=False, title_len=4)
+        test_prog = prog.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        b = 4
+        feeds = {
+            "user_id": np.zeros((b, 1), np.int64),
+            "gender_id": np.zeros((b, 1), np.int64),
+            "age_id": np.zeros((b, 1), np.int64),
+            "job_id": np.zeros((b, 1), np.int64),
+            "movie_id": np.zeros((b, 1), np.int64),
+            "category_id": np.zeros((b, rec.CATEGORY_DICT), np.int64),
+            "category_id@SEQ_LEN": np.ones((b,), np.int32),
+            "movie_title": np.zeros((b, 4), np.int64),
+            "movie_title@SEQ_LEN": np.full((b,), 4, np.int32),
+            "score": np.ones((b, 1), np.float32),
+        }
+        out, = exe.run(test_prog, feed=feeds, fetch_list=[infer],
+                       scope=scope)
+        assert np.all(np.abs(out) <= 5.0 + 1e-5)  # cos_sim * 5
+
+
+def _srl_feeds(rng, b, t, lens, target=None):
+    feeds = {}
+    for name in srl.FEATURES + ("verb_data", "mark_data"):
+        dict_size = {"verb_data": srl.PRED_DICT,
+                     "mark_data": srl.MARK_DICT}.get(
+            name, srl.WORD_DICT)
+        feeds[name] = rng.randint(0, dict_size, (b, t)).astype(
+            np.int64)
+        feeds[name + "@SEQ_LEN"] = lens
+    feeds["target"] = (target if target is not None else
+                       rng.randint(0, srl.LABEL_DICT,
+                                   (b, t)).astype(np.int64))
+    feeds["target@SEQ_LEN"] = lens
+    return feeds
+
+
+class TestLabelSemanticRoles:
+    def test_crf_trains(self):
+        rng = np.random.RandomState(0)
+        b, t = 8, 12
+        prog, startup, cost, decode = srl.build_program(
+            seq_len=t, depth=2, lr=0.02)
+        lens = rng.randint(t // 2, t + 1, (b,)).astype(np.int32)
+        feeds = _srl_feeds(rng, b, t, lens)
+        losses = _run(prog, startup, cost, feeds, steps=12)
+        assert losses[-1] < losses[0], losses
+
+    def test_padding_does_not_affect_cost(self):
+        # same valid prefix, different garbage in the padded tail ->
+        # identical CRF cost (the length wiring the review demanded)
+        rng = np.random.RandomState(3)
+        b, t = 4, 10
+        prog, startup, cost, _ = srl.build_program(
+            seq_len=t, depth=2, with_optimizer=False)
+        lens = np.full((b,), 6, np.int32)
+        feeds = _srl_feeds(rng, b, t, lens)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        c1, = exe.run(prog, feed=feeds, fetch_list=[cost], scope=scope)
+        tgt2 = feeds["target"].copy()
+        tgt2[:, 6:] = (tgt2[:, 6:] + 7) % srl.LABEL_DICT
+        feeds2 = dict(feeds, target=tgt2)
+        c2, = exe.run(prog, feed=feeds2, fetch_list=[cost],
+                      scope=scope)
+        np.testing.assert_allclose(np.asarray(c1), np.asarray(c2),
+                                   rtol=1e-6)
+
+    def test_decode_shape(self):
+        rng = np.random.RandomState(2)
+        b, t = 4, 10
+        prog, startup, cost, decode = srl.build_program(
+            seq_len=t, depth=2, with_optimizer=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        lens = np.full((b,), t, np.int32)
+        feeds = _srl_feeds(rng, b, t, lens,
+                           target=np.zeros((b, t), np.int64))
+        path, = exe.run(prog, feed=feeds, fetch_list=[decode],
+                        scope=scope)
+        assert path.shape == (b, t)
+        assert path.min() >= 0 and path.max() < srl.LABEL_DICT
