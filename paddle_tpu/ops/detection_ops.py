@@ -997,3 +997,272 @@ def generate_proposal_labels(ctx):
     return {"Rois": rois, "LabelsInt32": labels,
             "BboxTargets": targets, "BboxInsideWeights": inside,
             "BboxOutsideWeights": inside}
+
+
+# ---------------------------------------------------------------------
+# batch 3 additions (reference detection/box_decoder_and_assign_op.cc,
+# distribute_fpn_proposals_op.cc, roi_perspective_transform_op.cc,
+# generate_mask_labels_op.cc)
+# ---------------------------------------------------------------------
+@register_op("box_decoder_and_assign", differentiable=False)
+def box_decoder_and_assign(ctx):
+    """reference detection/box_decoder_and_assign_op.h: decode per-class
+    regression deltas against PriorBox (+1-offset corner convention),
+    clip dw/dh at box_clip, then assign each roi the decoded box of its
+    max-score non-background class (fallback: the prior itself)."""
+    prior = ctx.input("PriorBox")          # N,4
+    pvar = ctx.input("PriorBoxVar")        # [4] or per-prior [N,4]
+    tgt = ctx.input("TargetBox")           # N,C*4
+    score = ctx.input("BoxScore")          # N,C
+    clip = ctx.attr("box_clip", 2.302585)  # ln(10)
+    n = prior.shape[0]
+    c = score.shape[1]
+    t = tgt.reshape(n, c, 4)
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    if pvar.ndim == 2:  # per-prior variance rows (box_coder convention)
+        v0, v1 = pvar[:, 0][:, None], pvar[:, 1][:, None]
+        v2, v3 = pvar[:, 2][:, None], pvar[:, 3][:, None]
+    else:               # flat [4] (reference box_decoder_and_assign_op.h)
+        v0, v1, v2, v3 = pvar[0], pvar[1], pvar[2], pvar[3]
+    dw = jnp.minimum(v2 * t[..., 2], clip)
+    dh = jnp.minimum(v3 * t[..., 3], clip)
+    cx = v0 * t[..., 0] * pw[:, None] + pcx[:, None]
+    cy = v1 * t[..., 1] * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    dec = jnp.stack([cx - w / 2, cy - h / 2,
+                     cx + w / 2 - 1, cy + h / 2 - 1], axis=-1)  # N,C,4
+    if c > 1:
+        mj = 1 + jnp.argmax(score[:, 1:], axis=1)
+        assign = jnp.take_along_axis(
+            dec, mj[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    else:
+        assign = prior
+    return {"DecodeBox": dec.reshape(n, c * 4),
+            "OutputAssignBox": assign}
+
+
+@register_op("distribute_fpn_proposals", differentiable=False)
+def distribute_fpn_proposals(ctx):
+    """reference detection/distribute_fpn_proposals_op.h: route each
+    roi to FPN level floor(log2(sqrt(area)/refer_scale)+refer_level)
+    clamped to [min_level, max_level]. Fixed-shape TPU design: each
+    MultiFpnRois[i] is [N,4] with that level's rois packed to the top
+    (stable original order) and zero padding; MultiLevelCounts gives
+    the true per-level count; RestoreIndex[orig_i] = position of roi i
+    in the by-level concatenation (reference restore semantics)."""
+    rois = ctx.input("FpnRois")  # N,4
+    min_l = ctx.attr("min_level", 2)
+    max_l = ctx.attr("max_level", 5)
+    ref_l = ctx.attr("refer_level", 4)
+    ref_s = ctx.attr("refer_scale", 224)
+    n = rois.shape[0]
+    num_level = max_l - min_l + 1
+    # BBoxArea(..., normalized=false): +1 pixel offset on both sides
+    # (reference distribute_fpn_proposals_op.h:85)
+    area = jnp.maximum(rois[:, 2] - rois[:, 0] + 1, 0) * \
+        jnp.maximum(rois[:, 3] - rois[:, 1] + 1, 0)
+    scale = jnp.sqrt(area)
+    lvl = jnp.floor(jnp.log2(jnp.maximum(scale, 1e-6) / ref_s) + ref_l)
+    lvl = jnp.clip(lvl, min_l, max_l).astype(jnp.int32)
+    orig = jnp.arange(n)
+    # stable by-level order = sort key (level, original index)
+    order = jnp.argsort(lvl * (n + 1) + orig)
+    restore = jnp.argsort(order).astype(jnp.int32)  # orig -> shuffled pos
+    outs, counts = [], []
+    for i in range(num_level):
+        l = min_l + i
+        is_l = lvl == l
+        # rows of level l packed to the top, zero padding below
+        key = jnp.where(is_l, orig, n + orig)
+        perm = jnp.argsort(key)
+        packed = rois[perm] * is_l[perm][:, None].astype(rois.dtype)
+        outs.append(packed)
+        counts.append(jnp.sum(is_l).astype(jnp.int32))
+    return {"MultiFpnRois": outs,
+            "MultiLevelCounts": jnp.stack(counts),
+            "RestoreIndex": restore.reshape(n, 1)}
+
+
+@register_op("roi_perspective_transform", differentiable=False)
+def roi_perspective_transform(ctx):
+    """reference detection/roi_perspective_transform_op.cc: each roi is
+    a quad (8 coords, clockwise from top-left); estimate its aspect,
+    build the 3x3 projective map from output grid to input coords, and
+    bilinear-sample X inside the quad (0 outside). Single-image X
+    [1,C,H,W] (same convention as roi_pool/roi_align here,
+    misc_ops.py)."""
+    x = ctx.input("X")        # 1,C,H,W
+    rois = ctx.input("ROIs")  # N,8
+    th = ctx.attr("transformed_height", 8)
+    tw = ctx.attr("transformed_width", 8)
+    sscale = ctx.attr("spatial_scale", 1.0)
+    _, ch, hh, ww = x.shape
+    feat = x[0]
+
+    rx = rois[:, 0::2] * sscale  # N,4
+    ry = rois[:, 1::2] * sscale
+
+    def matrix(roi_x, roi_y):
+        x0, x1, x2, x3 = roi_x
+        y0, y1, y2, y3 = roi_y
+        len1 = jnp.hypot(x0 - x1, y0 - y1)
+        len2 = jnp.hypot(x1 - x2, y1 - y2)
+        len3 = jnp.hypot(x2 - x3, y2 - y3)
+        len4 = jnp.hypot(x3 - x0, y3 - y0)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        nh = th
+        nw_f = jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, 1e-6)) + 1
+        nw = jnp.minimum(nw_f, tw)
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1
+        den = jnp.where(jnp.abs(den) < 1e-12, 1e-12, den)
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / jnp.maximum(nw - 1, 1)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / jnp.maximum(nh - 1, 1)
+        m3 = (y1 - y0 + m6 * (nw - 1) * y1) / jnp.maximum(nw - 1, 1)
+        m4 = (y3 - y0 + m7 * (nh - 1) * y3) / jnp.maximum(nh - 1, 1)
+        m0 = (x1 - x0 + m6 * (nw - 1) * x1) / jnp.maximum(nw - 1, 1)
+        m1 = (x3 - x0 + m7 * (nh - 1) * x3) / jnp.maximum(nh - 1, 1)
+        return m0, m1, x0, m3, m4, y0, m6, m7
+
+    def in_quad(px, py, roi_x, roi_y):
+        # ray-casting even-odd rule, vectorized over the grid; the
+        # reference additionally counts points within 1e-4 of any edge
+        # as inside (in_quad's first loop) -- mirrored here with a
+        # point-to-segment distance test
+        xa, ya = roi_x, roi_y
+        xb = jnp.roll(roi_x, -1)
+        yb = jnp.roll(roi_y, -1)
+        crosses = ((ya[:, None, None] > py[None]) !=
+                   (yb[:, None, None] > py[None])) & \
+            (px[None] < (xb - xa)[:, None, None] *
+             (py[None] - ya[:, None, None]) /
+             (yb - ya + 1e-12)[:, None, None] + xa[:, None, None])
+        inside = jnp.sum(crosses.astype(jnp.int32), axis=0) % 2 == 1
+        ex = (xb - xa)[:, None, None]
+        ey = (yb - ya)[:, None, None]
+        dx = px[None] - xa[:, None, None]
+        dy = py[None] - ya[:, None, None]
+        t = jnp.clip((dx * ex + dy * ey) /
+                     jnp.maximum(ex * ex + ey * ey, 1e-12), 0.0, 1.0)
+        dist2 = (dx - t * ex) ** 2 + (dy - t * ey) ** 2
+        on_edge = jnp.any(dist2 < 1e-8, axis=0)
+        return inside | on_edge
+
+    gy, gx = jnp.mgrid[0:th, 0:tw]
+
+    def one(roi_x, roi_y):
+        m0, m1, m2, m3, m4, m5, m6, m7 = matrix(roi_x, roi_y)
+        wgt = m6 * gx + m7 * gy + 1.0
+        in_w = (m0 * gx + m1 * gy + m2) / wgt
+        in_h = (m3 * gx + m4 * gy + m5) / wgt
+        inside = in_quad(in_w, in_h, roi_x, roi_y) & \
+            (in_w >= -0.5) & (in_w <= ww - 0.5) & \
+            (in_h >= -0.5) & (in_h <= hh - 0.5)
+        sw = jnp.clip(in_w, 0, ww - 1)
+        sh = jnp.clip(in_h, 0, hh - 1)
+        x0i = jnp.floor(sw).astype(jnp.int32)
+        y0i = jnp.floor(sh).astype(jnp.int32)
+        x1i = jnp.minimum(x0i + 1, ww - 1)
+        y1i = jnp.minimum(y0i + 1, hh - 1)
+        ax = sw - x0i
+        ay = sh - y0i
+        v = (feat[:, y0i, x0i] * (1 - ay) * (1 - ax)
+             + feat[:, y0i, x1i] * (1 - ay) * ax
+             + feat[:, y1i, x0i] * ay * (1 - ax)
+             + feat[:, y1i, x1i] * ay * ax)
+        return jnp.where(inside[None], v, 0.0)
+
+    return {"Out": jax.vmap(one)(rx, ry)}
+
+
+def _rasterize_masks_np(rois, labels, gt_boxes, gt_classes, polys,
+                        poly_len, num_classes, resolution):
+    """Host-side mask-target rasterization (numpy): for each fg roi,
+    take the polygon of its best-IoU gt and rasterize it (even-odd
+    rule) onto a resolution x resolution grid over the roi extent,
+    written into the class-th mask slab."""
+    r = rois.shape[0]
+    m = resolution
+    masks = np.zeros((r, num_classes * m * m), np.int32)
+    has = np.zeros((r,), np.int32)
+    for i in range(r):
+        cls = int(labels[i])
+        if cls <= 0:
+            continue
+        # best gt by IoU
+        x1, y1, x2, y2 = rois[i]
+        ious = []
+        for g in range(gt_boxes.shape[0]):
+            gx1, gy1, gx2, gy2 = gt_boxes[g]
+            iw = max(min(x2, gx2) - max(x1, gx1), 0)
+            ih = max(min(y2, gy2) - max(y1, gy1), 0)
+            inter = iw * ih
+            ua = max((x2 - x1) * (y2 - y1)
+                     + (gx2 - gx1) * (gy2 - gy1) - inter, 1e-6)
+            ious.append(inter / ua)
+        if not ious:
+            continue
+        g = int(np.argmax(ious))
+        npts = int(poly_len[g])
+        if npts < 3:
+            continue
+        poly = polys[g, :npts]  # V,2
+        has[i] = 1
+        ys = y1 + (np.arange(m) + 0.5) * max(y2 - y1, 1e-6) / m
+        xs = x1 + (np.arange(m) + 0.5) * max(x2 - x1, 1e-6) / m
+        gx, gy = np.meshgrid(xs, ys)
+        inside = np.zeros((m, m), bool)
+        xa, ya = poly[:, 0], poly[:, 1]
+        xb, yb = np.roll(xa, -1), np.roll(ya, -1)
+        for e in range(npts):
+            cond = ((ya[e] > gy) != (yb[e] > gy)) & \
+                (gx < (xb[e] - xa[e]) * (gy - ya[e])
+                 / (yb[e] - ya[e] + 1e-12) + xa[e])
+            inside ^= cond
+        slab = masks[i].reshape(num_classes, m, m)
+        slab[cls] = inside.astype(np.int32)
+        masks[i] = slab.reshape(-1)
+    return masks, has
+
+
+@register_op("generate_mask_labels", differentiable=False)
+def generate_mask_labels(ctx):
+    """reference detection/generate_mask_labels_op.cc (Mask R-CNN mask
+    targets). TPU design: polygon rasterization is inherently
+    host-side (the reference does it on CPU too); runs as an ordered
+    io_callback with fixed shapes. Inputs use the padded batch design:
+    Rois [R,4], LabelsInt32 [R], GtSegms [G,V,2] one polygon per gt
+    padded to V points with PolyLen [G], GtBoxes/GtClasses [G,...]."""
+    from jax.experimental import io_callback
+
+    rois = ctx.input("Rois")
+    labels = ctx.input("LabelsInt32")
+    gt_boxes = ctx.input("GtBoxes")
+    gt_classes = ctx.input("GtClasses")
+    polys = ctx.input("GtSegms")
+    poly_len = ctx.input("PolyLen")
+    num_classes = ctx.attr("num_classes", 81)
+    resolution = ctx.attr("resolution", 14)
+    r = rois.shape[0]
+
+    def _host(ro, la, gb, gc, po, pl):
+        return _rasterize_masks_np(
+            np.asarray(ro), np.asarray(la), np.asarray(gb),
+            np.asarray(gc), np.asarray(po), np.asarray(pl),
+            num_classes, resolution)
+
+    masks, has = io_callback(
+        _host,
+        (jax.ShapeDtypeStruct((r, num_classes * resolution * resolution),
+                              np.int32),
+         jax.ShapeDtypeStruct((r,), np.int32)),
+        rois, labels, gt_boxes, gt_classes, polys, poly_len,
+        ordered=True)
+    return {"MaskRois": rois, "RoiHasMaskInt32": has,
+            "MaskInt32": masks}

@@ -1,0 +1,807 @@
+"""Op-gap closure, batch 3: the fused-op family, tensor-utility ops,
+and executor-parity ops.
+
+Parity targets (reference paddle/fluid/operators/): fill_op.cc,
+operators/distributed_ops/fake_init_op.cc, controlflow/get_places_op.cc,
+delete_var_op.cc, controlflow/feed_op.cc, controlflow/fetch_op.cc,
+alloc_continuous_space_op.cc, cross_entropy_op.cc (cross_entropy2),
+similarity_focus_op.cc, tree_conv_op.cc + math/tree2col.cc,
+fused/fused_elemwise_activation_op.cc, fused/fusion_squared_mat_sub_op.cc,
+fused/fusion_repeated_fc_relu_op.cc, fused/fusion_seqconv_eltadd_relu_op.cc,
+fused/fusion_seqpool_concat_op.cc, fused/fusion_seqexpand_concat_fc_op.cc,
+fused/fusion_transpose_flatten_concat_op.cc, fused/fusion_gru_op.cc,
+fused/fusion_lstm_op.cc, fused/fused_embedding_fc_lstm_op.cc,
+fused/fused_embedding_seq_pool_op.cc, attention_lstm_op.cc,
+conv_fusion_op.cc, fused/fusion_conv_inception_op.cu,
+reader/create_custom_reader_op.cc, reader/read_op.cc.
+
+TPU design note: the reference's fused CPU/cuDNN kernels exist because
+its per-op interpreter cannot fuse across op boundaries; under XLA the
+unfused composition compiles to the same fused HLO, so these kernels
+are *compositions* of the already-registered primitives -- they exist
+for program-level API parity (a reference program mentioning
+fusion_gru must load and run), not for speed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+# --------------------------------------------------------------------------
+# tensor utility / executor-parity ops
+# --------------------------------------------------------------------------
+@register_op("fill", differentiable=False)
+def fill(ctx):
+    """reference fill_op.cc: materialize attr `value` (row-major flat
+    float list) into a tensor of attr `shape`/`dtype`."""
+    from ..core.types import to_np_dtype
+
+    shape = [int(s) for s in ctx.attr("shape", [])]
+    dtype = to_np_dtype(ctx.attr("dtype", "float32"))
+    vals = np.asarray(ctx.attr("value", []), dtype=np.float64)
+    return {"Out": jnp.asarray(vals.reshape(shape).astype(dtype))}
+
+
+@register_op("fake_init", differentiable=False)
+def fake_init(ctx):
+    """reference distributed_ops/fake_init_op.cc: placeholder init for
+    vars whose real storage lives on a remote pserver (distributed
+    lookup tables) -- allocates shape but writes nothing. Here: zeros,
+    since XLA buffers cannot be left uninitialized."""
+    shape = [int(s) for s in ctx.attr("shape", [])]
+    return {"Out": jnp.zeros(shape, jnp.float32)}
+
+
+@register_op("delete_var", differentiable=False)
+def delete_var(ctx):
+    """reference delete_var_op.cc: drop vars from the scope. Under XLA
+    buffer liveness is compiler-managed (VERDICT row 2): inside a
+    compiled block this is a no-op marker; the executor additionally
+    drops the named vars from the scope after the step (see
+    core/executor.py handling of delete_var)."""
+    return {}
+
+
+@register_op("get_places", differentiable=False)
+def get_places(ctx):
+    """reference controlflow/get_places_op.cc: enumerate devices for
+    ParallelDo-era programs. Returns the device ids of the current
+    jax backend as an int32 vector (capped by attr device_count)."""
+    n = ctx.attr("device_count", 0)
+    try:
+        avail = len(jax.devices())
+    except Exception:
+        avail = 1
+    if not n:
+        n = avail
+    return {"Out": jnp.arange(min(int(n), avail), dtype=jnp.int32)}
+
+
+@register_op("feed", differentiable=False)
+def feed(ctx):
+    """reference controlflow/feed_op.cc: copy column `col` of the feed
+    holder into the target var. The executor short-circuits feed ops
+    (core/executor.py _SKIP_OP_TYPES) and materializes feeds directly;
+    this kernel exists so standalone run_op / program round-trips of
+    reference programs behave (identity on X)."""
+    return {"Out": ctx.input("X")}
+
+
+@register_op("fetch", differentiable=False)
+def fetch(ctx):
+    """reference controlflow/fetch_op.cc: copy var into fetch holder
+    column `col`. Executor short-circuits; identity for parity."""
+    return {"Out": ctx.input("X")}
+
+
+@register_op("alloc_continuous_space", differentiable=False)
+def alloc_continuous_space(ctx):
+    """reference alloc_continuous_space_op.cc: coalesce a list of
+    params/grads into one contiguous fused buffer (gradient coalescing
+    for fused allreduce). XLA performs buffer coalescing itself; this
+    op keeps the program-level contract: FusedOutput = flat concat,
+    Output[i] = view reshaped back to the input shapes."""
+    xs = ctx.inputs("Input")
+    const = ctx.attr("constant", None)
+    set_const = ctx.attr("set_constant", False)
+    flat = [jnp.ravel(x) for x in xs]
+    fused = jnp.concatenate(flat) if flat else jnp.zeros((0,), jnp.float32)
+    if set_const and const is not None:
+        fused = jnp.full_like(fused, const)
+    outs = []
+    off = 0
+    for x in xs:
+        n = int(np.prod(x.shape)) if x.ndim else 1
+        outs.append(jnp.reshape(fused[off:off + n], x.shape))
+        off += n
+    return {"Output": outs, "FusedOutput": fused}
+
+
+@register_op("cross_entropy2", stop_gradient_slots=("Label",))
+def cross_entropy2(ctx):
+    """reference cross_entropy_op.cc CrossEntropyOp2: hard-label CE
+    that also emits MatchX (the matched probability, reused by the
+    grad) and XShape (LoD carrier). ignore_index rows produce 0."""
+    x = ctx.input("X")
+    label = ctx.input("Label")
+    ignore = ctx.attr("ignore_index", -100)
+    lbl = label.reshape(label.shape[:-1]) if label.shape[-1:] == (1,) \
+        else label
+    lbl_i = lbl.astype(jnp.int32)
+    valid = (lbl_i != ignore)
+    safe = jnp.where(valid, lbl_i, 0)
+    match_x = jnp.take_along_axis(x, safe[..., None], axis=-1)
+    eps = jnp.finfo(x.dtype).tiny
+    y = -jnp.log(jnp.maximum(match_x, eps))
+    y = jnp.where(valid[..., None], y, 0.0)
+    return {"Y": y, "MatchX": match_x,
+            "XShape": jnp.zeros(x.shape + (0,), x.dtype)}
+
+
+@register_op("similarity_focus", differentiable=False)
+def similarity_focus(ctx):
+    """reference similarity_focus_op.cc: for each (batch, index in
+    `indexes`) take the HxW slice at channel axis position, greedily
+    pick min(H,W) maxima such that no two share a row or column, OR
+    the resulting masks over all indexes, broadcast across channels."""
+    x = ctx.input("X")  # N,A,B,C with axis selecting one of dims 1..3
+    axis = ctx.attr("axis", 1)
+    indexes = [int(i) for i in ctx.attr("indexes", [0])]
+    if axis != 1:
+        # move the focus axis to position 1 (reference supports 1..3)
+        x_m = jnp.moveaxis(x, axis, 1)
+    else:
+        x_m = x
+    n, a, b, c = x_m.shape
+    k = min(b, c)
+
+    def one_index(t):  # t: N,B,C
+        def body(i, carry):
+            mask, rowused, colused = carry
+            neg = jnp.finfo(t.dtype).min
+            avail = jnp.where(rowused[:, :, None] | colused[:, None, :],
+                              neg, t)
+            flat = avail.reshape(n, -1)
+            idx = jnp.argmax(flat, axis=1)
+            r, cc = idx // c, idx % c
+            mask = mask.at[jnp.arange(n), r, cc].set(1.0)
+            rowused = rowused.at[jnp.arange(n), r].set(True)
+            colused = colused.at[jnp.arange(n), cc].set(True)
+            return mask, rowused, colused
+
+        init = (jnp.zeros((n, b, c), x.dtype),
+                jnp.zeros((n, b), bool), jnp.zeros((n, c), bool))
+        mask, _, _ = lax.fori_loop(0, k, body, init)
+        return mask
+
+    total = jnp.zeros((n, b, c), x.dtype)
+    for i in indexes:
+        total = jnp.maximum(total, one_index(x_m[:, i]))
+    out = jnp.broadcast_to(total[:, None], (n, a, b, c))
+    if axis != 1:
+        out = jnp.moveaxis(out, 1, axis)
+    return {"Out": out}
+
+
+# --------------------------------------------------------------------------
+# tree_conv (reference tree_conv_op.cc + math/tree2col.cc)
+# --------------------------------------------------------------------------
+def _tree_patch_weights(edges, n_nodes, max_depth):
+    """Per-root eta weights, vectorized form of Tree2ColUtil.
+
+    edges: [E,2] int32 1-indexed (u -> v child edge; 0,0 padding).
+    Returns (eta_l, eta_r, eta_t): each [n_nodes, n_nodes] where row r
+    holds the weights of every node in root r's patch (0 = absent).
+    Formulas mirror math/tree2col.h TreeNode::eta_{t,l,r}: with
+    depth d (root=0), child position idx (1-based) among pclen
+    siblings: eta_t=(md-d)/md, eta_l=(1-eta_t)*((idx-1)/(pclen-1) or
+    .5 when pclen==1), eta_r=(1-eta_t)*(1-eta_l_frac_part)."""
+    e_u, e_v = edges[:, 0], edges[:, 1]
+    ok = (e_u > 0) & (e_v > 0)
+    nn = n_nodes + 1  # 1-indexed with 0 = null
+
+    # parent pointer + child position (idx) + sibling count (pclen)
+    parent = jnp.zeros((nn,), jnp.int32)
+    parent = parent.at[jnp.where(ok, e_v, 0)].set(
+        jnp.where(ok, e_u, 0).astype(jnp.int32))
+    # child position: order of appearance among edges of the same u
+    same_u = (e_u[:, None] == e_u[None, :]) & ok[:, None] & ok[None, :]
+    before = jnp.tril(jnp.ones_like(same_u), k=-1)
+    pos = jnp.sum(same_u & before.astype(bool), axis=1) + 1  # 1-based
+    childpos = jnp.zeros((nn,), jnp.int32).at[
+        jnp.where(ok, e_v, 0)].set(jnp.where(ok, pos, 0).astype(jnp.int32))
+    nchild = jnp.zeros((nn,), jnp.int32).at[
+        jnp.where(ok, e_u, 0)].add(jnp.where(ok, 1, 0).astype(jnp.int32))
+    pclen = nchild[parent]  # siblings of each node
+
+    # depth of v relative to root r: follow parent chain <= max_depth-1
+    # hops; anc[k] = k-th ancestor of v
+    roots = jnp.arange(nn, dtype=jnp.int32)
+    depth = jnp.full((nn, nn), -1, jnp.int32)  # [root, node]
+    anc = jnp.arange(nn, dtype=jnp.int32)
+    for d in range(max_depth):
+        hit = (anc[None, :] == roots[:, None]) & (anc[None, :] > 0)
+        depth = jnp.where(hit & (depth < 0), d, depth)
+        anc = parent[anc]
+    in_patch = depth >= 0
+
+    md = float(max_depth)
+    d_f = depth.astype(jnp.float32)
+    eta_t = jnp.where(in_patch, (md - d_f) / md, 0.0)
+    is_root = roots[:, None] == jnp.arange(nn)[None, :]
+    idx = jnp.where(is_root, 1, childpos[None, :]).astype(jnp.float32)
+    pc = jnp.where(is_root, 1, pclen[None, :]).astype(jnp.float32)
+    frac = jnp.where(pc == 1, 0.5, (idx - 1.0) / jnp.maximum(pc - 1.0, 1.0))
+    eta_l = jnp.where(in_patch, (1.0 - eta_t) * frac, 0.0)
+    eta_r = jnp.where(in_patch, (1.0 - eta_t) * (1.0 - frac), 0.0)
+    eta_t = jnp.where(in_patch, eta_t, 0.0)
+    return eta_l[1:, 1:], eta_r[1:, 1:], eta_t[1:, 1:]
+
+
+@register_op("tree_conv", stop_gradient_slots=("EdgeSet",))
+def tree_conv(ctx):
+    """reference tree_conv_op.cc: tree-based convolution (TBCNN,
+    arxiv 1409.5718). NodesVector [B,N,F], EdgeSet [B,E,2] (1-indexed
+    parent->child, zero padded), Filter [F,3,S,M] where the 3 taps are
+    (left, right, top) eta-weighted patch sums. Out [B,N,S,M]."""
+    edges = ctx.input("EdgeSet").astype(jnp.int32)
+    feats = ctx.input("NodesVector")
+    filt = ctx.input("Filter")
+    max_depth = ctx.attr("max_depth", 2)
+    b, n, f = feats.shape
+    fdim, three, s, m = filt.shape
+    w = jnp.transpose(filt, (1, 0, 2, 3)).reshape(3 * fdim, s * m)
+
+    def per_batch(e, x):
+        eta_l, eta_r, eta_t = _tree_patch_weights(e, n, max_depth)
+        # patch tap sums: [N roots, F] per tap; matches tree2col's
+        # interleaved (F,3) layout via the (3,F) weight reshape above
+        pl = eta_l @ x
+        pr = eta_r @ x
+        pt = eta_t @ x
+        patch = jnp.concatenate([pl, pr, pt], axis=-1)  # N, 3F
+        return (patch @ w).reshape(n, s, m)
+
+    return {"Out": jax.vmap(per_batch)(edges, feats)}
+
+
+# --------------------------------------------------------------------------
+# fused elementwise + activation (reference fused_elemwise_activation_op.cc)
+# --------------------------------------------------------------------------
+_UNARY = {
+    "relu": jax.nn.relu,
+    "scale": None,  # needs attr
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+}
+_BINARY = {
+    "elementwise_add": jnp.add,
+    "elementwise_mul": jnp.multiply,
+}
+
+
+@register_op("fused_elemwise_activation")
+def fused_elemwise_activation(ctx):
+    """reference fused/fused_elemwise_activation_op.cc: compose two
+    functors from functor_list -- Unary(Binary(X,Y)) when the second
+    entry is binary, else Binary(X, Unary(Y)). Supported unaries:
+    scale (attr `scale`), relu; binaries: elementwise_add/mul with
+    axis-style broadcast on Y."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    functors = list(ctx.attr("functor_list", []))
+    axis = ctx.attr("axis", -1)
+    if len(functors) != 2:
+        raise ValueError("fused_elemwise_activation: functor_list "
+                         "must hold exactly 2 functor names")
+
+    def bcast_y(yv, like):
+        if yv.ndim == like.ndim:
+            return yv
+        ax = axis if axis >= 0 else like.ndim - yv.ndim
+        shape = [1] * like.ndim
+        for i, d in enumerate(yv.shape):
+            shape[ax + i] = d
+        return jnp.reshape(yv, shape)
+
+    def apply_unary(name, v):
+        if name == "scale":
+            return v * ctx.attr("scale", 1.0)
+        fn = _UNARY.get(name)
+        if fn is None:
+            raise ValueError(f"fused_elemwise_activation: unsupported "
+                             f"unary functor {name!r}")
+        return fn(v)
+
+    f0, f1 = functors
+    if f1 in _BINARY:       # Unary(Binary(X, Y))
+        inter = _BINARY[f1](x, bcast_y(y, x))
+        out = apply_unary(f0, inter)
+    elif f0 in _BINARY:     # Binary(X, Unary(Y))
+        inter = apply_unary(f1, y)
+        out = _BINARY[f0](x, bcast_y(inter, x))
+    else:
+        raise ValueError(f"fused_elemwise_activation: functor_list "
+                         f"{functors} has no supported binary functor")
+    return {"Out": out, "IntermediateOut": inter}
+
+
+@register_op("fusion_squared_mat_sub")
+def fusion_squared_mat_sub(ctx):
+    """reference fused/fusion_squared_mat_sub_op.cc:
+    Out = ((X@Y)^2 - (X^2)@(Y^2)) * scalar."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    scalar = ctx.attr("scalar", 1.0)
+    sx = x * x
+    sy = y * y
+    sxy = jnp.matmul(x, y)
+    sxy2 = sxy * sxy
+    out = (sxy2 - jnp.matmul(sx, sy)) * scalar
+    return {"SquaredX": sx, "SquaredY": sy, "SquaredXY": sxy2, "Out": out}
+
+
+@register_op("fusion_repeated_fc_relu")
+def fusion_repeated_fc_relu(ctx):
+    """reference fused/fusion_repeated_fc_relu_op.cc: N stacked
+    fc+relu stages; W/Bias are parallel input lists."""
+    x = ctx.input("X")
+    ws = ctx.inputs("W")
+    bs = ctx.inputs("Bias")
+    if not ws:
+        raise ValueError("fusion_repeated_fc_relu: W list is empty")
+    relus = []
+    h = x
+    for i, w in enumerate(ws):
+        b = bs[i] if i < len(bs) else None
+        h = jnp.matmul(h, jnp.reshape(w, (h.shape[-1], -1)))
+        if b is not None:
+            h = h + jnp.reshape(b, (1, -1))
+        h = jax.nn.relu(h)
+        relus.append(h)
+    return {"ReluOut": relus[:-1], "Out": relus[-1]}
+
+
+def _sub_ctx(ctx, op_type, inputs, attrs):
+    """Build an OpContext for calling another registered kernel fn."""
+    from ..core.registry import OpContext
+    from ..core.program import Operator
+
+    op = Operator(ctx.op.block, type=op_type,
+                  inputs={}, outputs={}, attrs=attrs)
+    return OpContext(op, {k: [v] for k, v in inputs.items()})
+
+
+@register_op("fusion_seqconv_eltadd_relu", stop_gradient_slots=("SeqLen",))
+def fusion_seqconv_eltadd_relu(ctx):
+    """reference fused/fusion_seqconv_eltadd_relu_op.cc:
+    sequence_conv + bias add + relu in one op."""
+    from .sequence_ops import sequence_conv
+
+    b = ctx.input("Bias")
+    sub = _sub_ctx(ctx, "sequence_conv",
+                   {"X": ctx.input("X"), "Filter": ctx.input("Filter"),
+                    "SeqLen": ctx.input("SeqLen")},
+                   {"contextLength": ctx.attr("contextLength", 3),
+                    "contextStart": ctx.attr("contextStart", 0)})
+    out = sequence_conv(sub)
+    if isinstance(out, dict):
+        out = out.get("Out", next(iter(out.values())))
+    colmat = out
+    return {"Out": jax.nn.relu(colmat + jnp.reshape(b, (1, 1, -1))),
+            "ColMat": colmat}
+
+
+@register_op("fusion_seqpool_concat", stop_gradient_slots=("SeqLen",))
+def fusion_seqpool_concat(ctx):
+    """reference fused/fusion_seqpool_concat_op.cc: SUM/AVERAGE/SQRT
+    sequence_pool over each input then concat on axis 1."""
+    xs = ctx.inputs("X")
+    lens = ctx.inputs("SeqLen")
+    ptype = ctx.attr("pooltype", "SUM").upper()
+    pooled = []
+    for i, x in enumerate(xs):
+        sl = lens[i] if i < len(lens) and lens[i] is not None else \
+            jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+        m = (jnp.arange(x.shape[1])[None, :] < sl[:, None]).astype(x.dtype)
+        summed = jnp.sum(x * m[..., None], axis=1)
+        denom = jnp.maximum(sl.astype(x.dtype), 1)[:, None]
+        if ptype == "AVERAGE":
+            summed = summed / denom
+        elif ptype == "SQRT":
+            summed = summed / jnp.sqrt(denom)
+        pooled.append(summed)
+    return {"Out": jnp.concatenate(pooled, axis=1)}
+
+
+@register_op("fusion_seqexpand_concat_fc", stop_gradient_slots=("SeqLen",))
+def fusion_seqexpand_concat_fc(ctx):
+    """reference fused/fusion_seqexpand_concat_fc_op.cc: X[0] is the
+    [B,T,D0] ref sequence; X[1:] are [B,Di] per-sequence vectors
+    broadcast (seq_expand) along T; concat on the feature axis feeds
+    one fc (+bias, activation)."""
+    xs = ctx.inputs("X")
+    w = ctx.input("FCWeight")
+    b = ctx.input("FCBias")
+    act = ctx.attr("fc_activation", "identity")
+    ref = xs[0]
+    bsz, t = ref.shape[0], ref.shape[1]
+    cols = [ref]
+    for x in xs[1:]:
+        cols.append(jnp.broadcast_to(x[:, None, :],
+                                     (bsz, t, x.shape[-1])))
+    cat = jnp.concatenate(cols, axis=-1)
+    out = jnp.einsum("btd,dm->btm",
+                     cat, jnp.reshape(w, (cat.shape[-1], -1)))
+    if b is not None:
+        out = out + jnp.reshape(b, (1, 1, -1))
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    elif act not in ("identity", "", None):
+        raise ValueError(f"fusion_seqexpand_concat_fc: unsupported "
+                         f"activation {act!r}")
+    return {"Out": out, "FCOut": out}
+
+
+@register_op("fusion_transpose_flatten_concat", differentiable=False)
+def fusion_transpose_flatten_concat(ctx):
+    """reference fused/fusion_transpose_flatten_concat_op.cc: per
+    input transpose(trans_axis) then flatten(flatten_axis) then
+    concat(concat_axis)."""
+    xs = ctx.inputs("X")
+    trans = [int(a) for a in ctx.attr("trans_axis", [])]
+    flat_axis = ctx.attr("flatten_axis", 1)
+    cat_axis = ctx.attr("concat_axis", 1)
+    outs = []
+    for x in xs:
+        t = jnp.transpose(x, trans) if trans else x
+        lead = int(np.prod(t.shape[:flat_axis])) if flat_axis else 1
+        outs.append(jnp.reshape(t, (lead, -1)))
+    return {"Out": jnp.concatenate(outs, axis=cat_axis)}
+
+
+# --------------------------------------------------------------------------
+# fused recurrent ops: compositions over the registered gru/lstm kernels
+# --------------------------------------------------------------------------
+@register_op("fusion_gru", stop_gradient_slots=("SeqLen",))
+def fusion_gru(ctx):
+    """reference fused/fusion_gru_op.cc: XX = X@WeightX (+bias), then
+    the gru recurrence with WeightH. X [B,T,M], WeightX [M,3D],
+    WeightH [D,3D], Bias [1,3D]. Batched aux outputs (ReorderedH0,
+    BatchedInput, BatchedOut) are artifacts of the reference's
+    LoD-batching; here XX doubles for BatchedInput."""
+    from .rnn_ops import gru as gru_kernel
+
+    x = ctx.input("X")
+    wx = ctx.input("WeightX")
+    wh = ctx.input("WeightH")
+    bias = ctx.input("Bias")
+    xx = jnp.einsum("btm,md->btd", x, wx)
+    sub = _sub_ctx(ctx, "gru",
+                   {"Input": xx, "Weight": wh, "Bias": bias,
+                    "SeqLen": ctx.input("SeqLen"), "H0": ctx.input("H0")},
+                   {"is_reverse": ctx.attr("is_reverse", False),
+                    "origin_mode": ctx.attr("origin_mode", False),
+                    "gate_activation": ctx.attr("gate_activation",
+                                                "sigmoid"),
+                    "activation": ctx.attr("activation", "tanh")})
+    hidden = gru_kernel(sub)["Hidden"]
+    return {"Hidden": hidden, "XX": xx, "BatchedInput": xx,
+            "BatchedOut": hidden,
+            "ReorderedH0": ctx.input("H0") if ctx.input("H0") is not None
+            else jnp.zeros((x.shape[0], wh.shape[0]), x.dtype)}
+
+
+@register_op("fusion_lstm", stop_gradient_slots=("SeqLen",))
+def fusion_lstm(ctx):
+    """reference fused/fusion_lstm_op.cc: XX = X@WeightX, then the
+    lstm recurrence with WeightH. Bias [1,4D(+3D peepholes)]."""
+    from .rnn_ops import lstm as lstm_kernel
+
+    x = ctx.input("X")
+    wx = ctx.input("WeightX")
+    wh = ctx.input("WeightH")
+    xx = jnp.einsum("btm,md->btd", x, wx)
+    sub = _sub_ctx(ctx, "lstm",
+                   {"Input": xx, "Weight": wh, "Bias": ctx.input("Bias"),
+                    "SeqLen": ctx.input("SeqLen"),
+                    "H0": ctx.input("H0"), "C0": ctx.input("C0")},
+                   {"use_peepholes": ctx.attr("use_peepholes", False),
+                    "is_reverse": ctx.attr("is_reverse", False),
+                    "gate_activation": ctx.attr("gate_activation",
+                                                "sigmoid"),
+                    "cell_activation": ctx.attr("cell_activation", "tanh"),
+                    "candidate_activation":
+                        ctx.attr("candidate_activation", "tanh")})
+    outs = lstm_kernel(sub)
+    return {"Hidden": outs["Hidden"], "Cell": outs["Cell"], "XX": xx,
+            "BatchedInput": xx, "BatchedHidden": outs["Hidden"],
+            "BatchedCell": outs["Cell"]}
+
+
+@register_op("fused_embedding_fc_lstm", stop_gradient_slots=("Ids",
+                                                             "SeqLen"))
+def fused_embedding_fc_lstm(ctx):
+    """reference fused/fused_embedding_fc_lstm_op.cc: Embeddings holds
+    the table already multiplied through the fc weight (rows are
+    per-token pre-gate activations [V,4D]); lookup then lstm."""
+    from .rnn_ops import lstm as lstm_kernel
+
+    ids = ctx.input("Ids")
+    table = ctx.input("Embeddings")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    xx = jnp.take(table, ids.astype(jnp.int32), axis=0)  # B,T,4D
+    sub = _sub_ctx(ctx, "lstm",
+                   {"Input": xx, "Weight": ctx.input("WeightH"),
+                    "Bias": ctx.input("Bias"),
+                    "SeqLen": ctx.input("SeqLen"),
+                    "H0": ctx.input("H0"), "C0": ctx.input("C0")},
+                   {"use_peepholes": ctx.attr("use_peepholes", False),
+                    "is_reverse": ctx.attr("is_reverse", False),
+                    "gate_activation": ctx.attr("gate_activation",
+                                                "sigmoid"),
+                    "cell_activation": ctx.attr("cell_activation", "tanh"),
+                    "candidate_activation":
+                        ctx.attr("candidate_activation", "tanh")})
+    outs = lstm_kernel(sub)
+    return {"Hidden": outs["Hidden"], "Cell": outs["Cell"], "XX": xx}
+
+
+@register_op("fused_embedding_seq_pool", stop_gradient_slots=("Ids",
+                                                              "SeqLen"))
+def fused_embedding_seq_pool(ctx):
+    """reference fused/fused_embedding_seq_pool_op.cc: lookup_table +
+    sum sequence_pool in one op. Ids [B,T(,1)], W [V,D] -> Out [B,D]."""
+    ids = ctx.input("Ids")
+    w = ctx.input("W")
+    seq_len = ctx.input("SeqLen")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    emb = jnp.take(w, ids.astype(jnp.int32), axis=0)  # B,T,D
+    if seq_len is None:
+        seq_len = jnp.full((ids.shape[0],), ids.shape[1], jnp.int32)
+    m = (jnp.arange(ids.shape[1])[None, :]
+         < seq_len[:, None]).astype(emb.dtype)
+    return {"Out": jnp.sum(emb * m[..., None], axis=1)}
+
+
+@register_op("attention_lstm", stop_gradient_slots=("SeqLen",))
+def attention_lstm(ctx):
+    """reference attention_lstm_op.cc: per step t --
+    fcout = relu(concat(x, expand(c_{t-1})) @ AttentionWeight + b);
+    optionally scaled (AttentionScalar) + bias + relu; softmax over
+    the sequence; lstm_x = sum(softmax * x); one LSTM step on
+    [lstm_x, h_{t-1}] @ LSTMWeight. Gate order i,f,c,o; candidate
+    activation attr `candidate_activation`."""
+    x = ctx.input("X")            # B,T,M
+    c0 = ctx.input("C0")          # B,D
+    h0 = ctx.input("H0")
+    aw = ctx.input("AttentionWeight")          # (M+D),1
+    ab = ctx.input("AttentionBias")            # 1,1 or None
+    ascal = ctx.input("AttentionScalar")       # 1,1 or None
+    ascal_b = ctx.input("AttentionScalarBias")
+    lw = ctx.input("LSTMWeight")  # (D+M),4D
+    lb = ctx.input("LSTMBias")    # 1,4D
+    seq_len = ctx.input("SeqLen")
+    from .rnn_ops import _ACT
+
+    act_gate = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    act_cell = _ACT[ctx.attr("cell_activation", "tanh")]
+    act_cand = _ACT[ctx.attr("candidate_activation", "tanh")]
+    b_sz, t, m = x.shape
+    d = c0.shape[-1]
+    if h0 is None:
+        h0 = jnp.zeros_like(c0)
+    if seq_len is None:
+        seq_len = jnp.full((b_sz,), t, jnp.int32)
+    mask = jnp.arange(t)[None, :] < seq_len[:, None]  # B,T
+    aw_x, aw_c = aw[:m], aw[m:]
+
+    def step(carry, _):
+        h_prev, c_prev = carry
+        # attention scores over the whole (masked) sequence
+        sc = (jnp.einsum("btm,mo->bt", x, aw_x)
+              + (c_prev @ aw_c)[:, 0][:, None])
+        if ab is not None:
+            sc = sc + ab.reshape(())
+        sc = jax.nn.relu(sc)
+        if ascal is not None:
+            sc = sc * ascal.reshape(())
+        if ascal_b is not None:
+            sc = jax.nn.relu(sc + ascal_b.reshape(()))
+        sc = jnp.where(mask, sc, jnp.finfo(x.dtype).min)
+        p = jax.nn.softmax(sc, axis=1)
+        lstm_x = jnp.einsum("bt,btm->bm", p, x)
+        gates = (jnp.concatenate([lstm_x, h_prev], -1) @ lw
+                 + lb.reshape(1, -1))
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        i = act_gate(gi)
+        f = act_gate(gf)
+        c = f * c_prev + i * act_cand(gc)
+        o = act_gate(go)
+        h = o * act_cell(c)
+        return (h, c), (h, c)
+
+    (h_t, c_t), (hs, cs) = lax.scan(step, (h0, c0), None, length=t)
+    return {"Hidden": jnp.swapaxes(hs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+# --------------------------------------------------------------------------
+# fused convolutions
+# --------------------------------------------------------------------------
+@register_op("conv2d_fusion")
+def conv2d_fusion(ctx):
+    """reference conv_fusion_op.cc (cuDNN conv+bias+act(+residual)):
+    Output = act(conv(Input, Filter) + Bias (+ ResidualData))."""
+    from .nn_ops import conv2d as conv2d_kernel
+
+    sub = _sub_ctx(ctx, "conv2d",
+                   {"Input": ctx.input("Input"),
+                    "Filter": ctx.input("Filter")},
+                   {"strides": ctx.attr("strides", [1, 1]),
+                    "paddings": ctx.attr("paddings", [0, 0]),
+                    "dilations": ctx.attr("dilations", [1, 1]),
+                    "groups": ctx.attr("groups", 1)})
+    out = conv2d_kernel(sub)["Output"]
+    bias = ctx.input("Bias")
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1))
+    resid = ctx.input("ResidualData")
+    if resid is not None:
+        out = out + resid
+    act = ctx.attr("activation", "relu")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "sigmoid":
+        out = jax.nn.sigmoid(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    elif act not in ("identity", "", None):
+        raise ValueError(f"conv2d_fusion: unsupported activation {act!r}")
+    return {"Output": out}
+
+
+@register_op("conv2d_inception_fusion")
+def conv2d_inception_fusion(ctx):
+    """reference fused/fusion_conv_inception_op.cu: a 4-filter fused
+    inception cell. Dataflow (mirrors the cuDNN kernel's buffer plan):
+      b0 = 1x1 conv(avg_pool3x3(x), F0)
+      y1 = 1x1 conv(x, F1); first oc1 channels go to the output, the
+           remaining 2*F2_in feed
+      y2 = 3x3 grouped(2) conv(y1_tail, F2); first F2_out - F3_in
+           channels go to the output, the tail feeds
+      y3 = 3x3 conv(y2_tail, F3)
+      Output = relu(concat([b0, y1_head, y2_head, y3], channel))
+    with per-branch biases."""
+    x = ctx.input("Input")
+    filts = ctx.inputs("Filter")
+    biases = ctx.inputs("Bias")
+    if len(filts) != 4:
+        raise ValueError("conv2d_inception_fusion expects 4 filters")
+
+    def conv(v, w, groups=1, same=False):
+        k = w.shape[2]
+        pad = (k // 2, k // 2) if same or k > 1 else (0, 0)
+        return lax.conv_general_dilated(
+            v, w, window_strides=(1, 1), padding=[pad, pad],
+            feature_group_count=groups,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def addb(v, b):
+        return v + jnp.reshape(b, (1, -1, 1, 1)) if b is not None else v
+
+    pooled = lax.reduce_window(
+        x, 0.0, lax.add, (1, 1, 3, 3), (1, 1, 1, 1),
+        [(0, 0), (0, 0), (1, 1), (1, 1)]) / 9.0
+    b0 = addb(conv(pooled, filts[0]), biases[0] if biases else None)
+
+    f2_in = filts[2].shape[1]
+    f3_in = filts[3].shape[1]
+    y1 = addb(conv(x, filts[1]), biases[1] if len(biases) > 1 else None)
+    oc1 = filts[1].shape[0] - f2_in * 2
+    y1_head, y1_tail = y1[:, :oc1], y1[:, oc1:]
+    y2 = addb(conv(y1_tail, filts[2], groups=2, same=True),
+              biases[2] if len(biases) > 2 else None)
+    oc2 = filts[2].shape[0] - f3_in
+    y2_head, y2_tail = y2[:, :oc2], y2[:, oc2:]
+    y3 = addb(conv(y2_tail, filts[3], same=True),
+              biases[3] if len(biases) > 3 else None)
+    out = jnp.concatenate([b0, y1_head, y2_head, y3], axis=1)
+    return {"Output": jax.nn.relu(out)}
+
+
+# --------------------------------------------------------------------------
+# reader ops (reference operators/reader/read_op.cc,
+# create_custom_reader_op.cc) -- host bridge into the Python reader
+# registry; shapes must be static (declared on the reader var).
+# --------------------------------------------------------------------------
+_HOST_READERS = {}
+
+
+def register_host_reader(name, gen_factory):
+    """Bind a reader var name to a host generator factory. Each call
+    of the read op pulls the next batch (restarting on exhaustion)."""
+    _HOST_READERS[name] = {"factory": gen_factory, "it": None}
+
+
+@register_op("read", differentiable=False)
+def read_op(ctx):
+    """reference reader/read_op.cc: pop the next batch from the reader
+    bound to input Reader's var name. Runs as an ordered host callback
+    (the TPU analogue of the blocking queue pop); attrs `shapes` (flat
+    int list with -1 separators not supported -- per-output shapes come
+    from the output vars) and `dtypes` fix the static result specs."""
+    from jax.experimental import io_callback
+
+    rname = ctx.op.input("Reader")[0]
+    entry = _HOST_READERS.get(rname)
+    if entry is None:
+        raise KeyError(f"read: no host reader registered under "
+                       f"{rname!r}; call register_host_reader first")
+    block = ctx.op.block
+    from ..core.types import to_np_dtype
+
+    from jax import dtypes as _dtypes
+
+    specs = []
+    for n in ctx.op.output("Out"):
+        var = block.var(n)
+        dt = to_np_dtype(var.dtype if var.dtype is not None else "float32")
+        # 64-bit callback specs need x64; canonicalize like jnp does
+        dt = _dtypes.canonicalize_dtype(dt)
+        specs.append(jax.ShapeDtypeStruct(tuple(var.shape), dt))
+
+    def _next():
+        if entry["it"] is None:
+            entry["it"] = iter(entry["factory"]())
+        try:
+            batch = next(entry["it"])
+        except StopIteration:
+            entry["it"] = iter(entry["factory"]())
+            batch = next(entry["it"])
+        return tuple(np.asarray(b, dtype=s.dtype).reshape(s.shape)
+                     for b, s in zip(batch, specs))
+
+    vals = io_callback(_next, tuple(specs), ordered=True)
+    return {"Out": list(vals)}
+
+
+@register_op("create_custom_reader", differentiable=False)
+def create_custom_reader(ctx):
+    """reference reader/create_custom_reader_op.cc: decorate an
+    underlying reader with a preprocessing function. The reference
+    runs a sub-block per batch; here the decoration is a host
+    callable registered via register_host_reader -- this op re-binds
+    the output reader name to the decorated generator."""
+    src = ctx.op.input("UnderlyingReader")[0]
+    dst = ctx.op.output("Out")[0]
+    fn_id = ctx.attr("decorator_id", None)
+    entry = _HOST_READERS.get(src)
+    if entry is None:
+        raise KeyError(f"create_custom_reader: underlying reader "
+                       f"{src!r} not registered")
+    deco = None
+    if fn_id is not None:
+        from .host_ops import _PY_FUNC_REGISTRY
+
+        # the registry is a list indexed by the id handed out at
+        # registration time (host_ops.register_py_func)
+        if isinstance(fn_id, int) and 0 <= fn_id < len(_PY_FUNC_REGISTRY):
+            deco = _PY_FUNC_REGISTRY[fn_id]
+
+    def factory():
+        for batch in entry["factory"]():
+            yield deco(batch) if deco is not None else batch
+
+    register_host_reader(dst, factory)
+    return {}

@@ -1,0 +1,830 @@
+"""OpTests for op-gap batch 3 (fused-op family + utility ops).
+
+Parity model: reference tests/unittests/test_fill_op.py,
+test_fused_elemwise_activation_op.py, test_fusion_squared_mat_sub_op.py,
+test_fusion_repeated_fc_relu_op.py, test_fusion_seqconv_eltadd_relu_op.py,
+test_fusion_seqpool_concat_op.py, test_fusion_seqexpand_concat_fc_op.py,
+test_fusion_transpose_flatten_concat_op.py, test_fusion_gru_op.py,
+test_fusion_lstm_op.py, test_fused_embedding_seq_pool_op.py,
+test_attention_lstm_op.py, test_tree_conv_op.py,
+test_similarity_focus_op.py, test_box_decoder_and_assign_op.py,
+test_distribute_fpn_proposals_op.py, test_cross_entropy2_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+class TestFill(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fill"
+        vals = np.arange(6, dtype=np.float32)
+        self.inputs = {}
+        self.attrs = {"value": [float(v) for v in vals],
+                      "shape": [2, 3], "dtype": "float32"}
+        self.outputs = {"Out": vals.reshape(2, 3)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFakeInit(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fake_init"
+        self.inputs = {}
+        self.attrs = {"shape": [3, 4]}
+        self.outputs = {"Out": np.zeros((3, 4), np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAllocContinuousSpace(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "alloc_continuous_space"
+        a = np.random.rand(2, 3).astype("float32")
+        b = np.random.rand(4).astype("float32")
+        self.inputs = {"Input": [("a", a), ("b", b)]}
+        self.attrs = {}
+        self.outputs = {
+            "Output": [("a_out", a), ("b_out", b)],
+            "FusedOutput": np.concatenate([a.ravel(), b.ravel()])}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCrossEntropy2(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "cross_entropy2"
+        x = np.random.uniform(0.1, 1.0, (5, 7)).astype("float32")
+        x = x / x.sum(1, keepdims=True)
+        label = np.random.randint(0, 7, (5, 1)).astype("int64")
+        match = np.take_along_axis(x, label, axis=1)
+        y = -np.log(match)
+        self.inputs = {"X": x, "Label": label}
+        self.attrs = {}
+        self.outputs = {"Y": y, "MatchX": match}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["X"], "Y", no_grad_set={"Label"})
+
+
+class TestFusedElemwiseActivation(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fused_elemwise_activation"
+        x = np.random.randn(3, 4).astype("float32")
+        y = np.random.randn(3, 4).astype("float32")
+        inter = x + y
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"functor_list": ["relu", "elementwise_add"]}
+        self.outputs = {"Out": np.maximum(inter, 0),
+                        "IntermediateOut": inter}
+
+    def test_output(self):
+        self.check_output(atol=1e-6)
+
+    def test_grad(self):
+        self.check_grad(["X", "Y"], "Out")
+
+
+class TestFusedElemwiseActivationScale(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fused_elemwise_activation"
+        x = np.random.randn(3, 4).astype("float32")
+        y = np.random.randn(3, 4).astype("float32")
+        inter = y * 3.0
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"functor_list": ["elementwise_mul", "scale"],
+                      "scale": 3.0}
+        self.outputs = {"Out": x * inter, "IntermediateOut": inter}
+
+    def test_output(self):
+        self.check_output(atol=1e-6)
+
+
+class TestFusionSquaredMatSub(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fusion_squared_mat_sub"
+        x = np.random.randn(3, 4).astype("float32")
+        y = np.random.randn(4, 5).astype("float32")
+        sxy = (x @ y) ** 2
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"scalar": 0.5}
+        self.outputs = {"Out": (sxy - (x * x) @ (y * y)) * 0.5,
+                        "SquaredX": x * x, "SquaredY": y * y,
+                        "SquaredXY": sxy}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestFusionRepeatedFCRelu(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fusion_repeated_fc_relu"
+        x = np.random.randn(4, 5).astype("float32")
+        w1 = np.random.randn(5, 6).astype("float32")
+        b1 = np.random.randn(6).astype("float32")
+        w2 = np.random.randn(6, 3).astype("float32")
+        b2 = np.random.randn(3).astype("float32")
+        h1 = np.maximum(x @ w1 + b1, 0)
+        h2 = np.maximum(h1 @ w2 + b2, 0)
+        self.inputs = {"X": x, "W": [("w1", w1), ("w2", w2)],
+                       "Bias": [("b1", b1), ("b2", b2)]}
+        self.attrs = {}
+        self.outputs = {"Out": h2, "ReluOut": [("r1", h1)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFusionSeqpoolConcat(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fusion_seqpool_concat"
+        x0 = np.random.randn(2, 4, 3).astype("float32")
+        x1 = np.random.randn(2, 4, 5).astype("float32")
+        l0 = np.array([2, 4], np.int32)
+        l1 = np.array([3, 1], np.int32)
+
+        def pool(x, sl):
+            m = (np.arange(x.shape[1])[None, :] < sl[:, None])
+            return (x * m[..., None]).sum(1)
+
+        self.inputs = {"X": [("x0", x0), ("x1", x1)],
+                       "SeqLen": [("l0", l0), ("l1", l1)]}
+        self.attrs = {"pooltype": "SUM"}
+        self.outputs = {
+            "Out": np.concatenate([pool(x0, l0), pool(x1, l1)], 1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFusionSeqExpandConcatFC(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fusion_seqexpand_concat_fc"
+        ref = np.random.randn(2, 3, 4).astype("float32")
+        v = np.random.randn(2, 5).astype("float32")
+        w = np.random.randn(9, 6).astype("float32")
+        b = np.random.randn(6).astype("float32")
+        cat = np.concatenate(
+            [ref, np.broadcast_to(v[:, None], (2, 3, 5))], -1)
+        out = np.maximum(cat @ w + b, 0)
+        self.inputs = {"X": [("ref", ref), ("v", v)],
+                       "FCWeight": w, "FCBias": b}
+        self.attrs = {"fc_activation": "relu"}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFusionTransposeFlattenConcat(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fusion_transpose_flatten_concat"
+        x0 = np.random.randn(2, 3, 4).astype("float32")
+        x1 = np.random.randn(2, 3, 4).astype("float32")
+        t0 = x0.transpose(0, 2, 1).reshape(2, -1)
+        t1 = x1.transpose(0, 2, 1).reshape(2, -1)
+        self.inputs = {"X": [("x0", x0), ("x1", x1)]}
+        self.attrs = {"trans_axis": [0, 2, 1], "flatten_axis": 1,
+                      "concat_axis": 1}
+        self.outputs = {"Out": np.concatenate([t0, t1], 1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-6)
+
+
+class TestFusedEmbeddingSeqPool(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fused_embedding_seq_pool"
+        w = np.random.randn(10, 4).astype("float32")
+        ids = np.random.randint(0, 10, (2, 3, 1)).astype("int64")
+        sl = np.array([2, 3], np.int32)
+        emb = w[ids[..., 0]]
+        m = (np.arange(3)[None, :] < sl[:, None])
+        self.inputs = {"W": w, "Ids": ids, "SeqLen": sl}
+        self.attrs = {}
+        self.outputs = {"Out": (emb * m[..., None]).sum(1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["W"], "Out", no_grad_set={"Ids", "SeqLen"})
+
+
+def _np_lstm(xx, wh, bias, h0, c0):
+    """Oracle: i,f,c,o gate order, sigmoid gates, tanh cell/cand."""
+    b, t, fourh = xx.shape
+    d = fourh // 4
+    h = h0.copy()
+    c = c0.copy()
+    hs = np.zeros((b, t, d), np.float32)
+    cs = np.zeros((b, t, d), np.float32)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for step in range(t):
+        g = xx[:, step] + h @ wh + bias[:, :4 * d]
+        gi, gf, gc, go = np.split(g, 4, axis=1)
+        i, f, o = sig(gi), sig(gf), sig(go)
+        c = f * c + i * np.tanh(gc)
+        h = o * np.tanh(c)
+        hs[:, step] = h
+        cs[:, step] = c
+    return hs, cs
+
+
+class TestFusionLSTM(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fusion_lstm"
+        b, t, m, d = 2, 3, 4, 5
+        x = np.random.randn(b, t, m).astype("float32") * 0.1
+        wx = np.random.randn(m, 4 * d).astype("float32") * 0.1
+        wh = np.random.randn(d, 4 * d).astype("float32") * 0.1
+        bias = np.random.randn(1, 4 * d).astype("float32") * 0.1
+        xx = x @ wx
+        hs, cs = _np_lstm(xx, wh, bias,
+                          np.zeros((b, d), np.float32),
+                          np.zeros((b, d), np.float32))
+        self.inputs = {"X": x, "WeightX": wx, "WeightH": wh,
+                       "Bias": bias}
+        self.attrs = {"use_peepholes": False}
+        self.outputs = {"Hidden": hs, "Cell": cs}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFusionGRU(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fusion_gru"
+        b, t, m, d = 2, 3, 4, 5
+        x = np.random.randn(b, t, m).astype("float32") * 0.1
+        wx = np.random.randn(m, 3 * d).astype("float32") * 0.1
+        wh = np.random.randn(d, 3 * d).astype("float32") * 0.1
+        bias = np.random.randn(1, 3 * d).astype("float32") * 0.1
+        xx = x @ wx + bias
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        h = np.zeros((b, d), np.float32)
+        hs = np.zeros((b, t, d), np.float32)
+        w_rz, w_c = wh[:, :2 * d], wh[:, 2 * d:]
+        for step in range(t):
+            xu, xr, xc = np.split(xx[:, step], 3, axis=1)
+            rz = np.concatenate([xu, xr], 1) + h @ w_rz
+            u = sig(rz[:, :d])
+            r = sig(rz[:, d:])
+            cand = np.tanh(xc + (r * h) @ w_c)
+            h = (1 - u) * h + u * cand
+            hs[:, step] = h
+        self.inputs = {"X": x, "WeightX": wx, "WeightH": wh,
+                       "Bias": bias}
+        self.attrs = {}
+        self.outputs = {"Hidden": hs}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFusedEmbeddingFCLSTM(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fused_embedding_fc_lstm"
+        b, t, v, d = 2, 3, 7, 4
+        ids = np.random.randint(0, v, (b, t, 1)).astype("int64")
+        table = (np.random.randn(v, 4 * d) * 0.1).astype("float32")
+        wh = (np.random.randn(d, 4 * d) * 0.1).astype("float32")
+        bias = (np.random.randn(1, 4 * d) * 0.1).astype("float32")
+        xx = table[ids[..., 0]]
+        hs, cs = _np_lstm(xx, wh, bias,
+                          np.zeros((b, d), np.float32),
+                          np.zeros((b, d), np.float32))
+        self.inputs = {"Ids": ids, "Embeddings": table, "WeightH": wh,
+                       "Bias": bias}
+        self.attrs = {"use_peepholes": False}
+        self.outputs = {"Hidden": hs, "Cell": cs}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestAttentionLSTM(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "attention_lstm"
+        b, t, m, d = 2, 4, 3, 5
+        x = (np.random.randn(b, t, m) * 0.2).astype("float32")
+        c0 = (np.random.randn(b, d) * 0.2).astype("float32")
+        h0 = (np.random.randn(b, d) * 0.2).astype("float32")
+        aw = (np.random.randn(m + d, 1) * 0.2).astype("float32")
+        lw = (np.random.randn(d + m, 4 * d) * 0.2).astype("float32")
+        lb = (np.random.randn(1, 4 * d) * 0.2).astype("float32")
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        h, c = h0.copy(), c0.copy()
+        hs = np.zeros((b, t, d), np.float32)
+        cs = np.zeros((b, t, d), np.float32)
+        for step in range(t):
+            sc = x @ aw[:m, 0] + (c @ aw[m:, 0])[:, None]
+            sc = np.maximum(sc, 0)
+            e = np.exp(sc - sc.max(1, keepdims=True))
+            p = e / e.sum(1, keepdims=True)
+            lx = np.einsum("bt,btm->bm", p, x)
+            g = np.concatenate([lx, h], 1) @ lw + lb
+            gi, gf, gc, go = np.split(g, 4, axis=1)
+            c = sig(gf) * c + sig(gi) * np.tanh(gc)
+            h = sig(go) * np.tanh(c)
+            hs[:, step] = h
+            cs[:, step] = c
+        self.inputs = {"X": x, "C0": c0, "H0": h0,
+                       "AttentionWeight": aw,
+                       "LSTMWeight": lw, "LSTMBias": lb}
+        self.attrs = {}
+        self.outputs = {"Hidden": hs, "Cell": cs}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestConv2DFusion(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "conv2d_fusion"
+        import torch
+        import torch.nn.functional as F
+
+        x = np.random.randn(2, 3, 5, 5).astype("float32")
+        w = np.random.randn(4, 3, 3, 3).astype("float32")
+        b = np.random.randn(4).astype("float32")
+        out = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                       padding=1).numpy()
+        out = np.maximum(out + b.reshape(1, -1, 1, 1), 0)
+        self.inputs = {"Input": x, "Filter": w, "Bias": b}
+        self.attrs = {"paddings": [1, 1], "activation": "relu"}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestConv2DInceptionFusion(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "conv2d_inception_fusion"
+        import torch
+        import torch.nn.functional as F
+
+        cin, h, w = 4, 6, 6
+        x = np.random.randn(1, cin, h, w).astype("float32")
+        # f2 takes 2*3 channels in 2 groups; f3 takes 4 channels
+        f0 = np.random.randn(5, cin, 1, 1).astype("float32")
+        f1 = np.random.randn(8, cin, 1, 1).astype("float32")  # oc1=8-6=2
+        f2 = np.random.randn(6, 3, 3, 3).astype("float32")    # groups=2
+        f3 = np.random.randn(7, 2, 3, 3).astype("float32")
+        b0 = np.random.randn(5).astype("float32")
+        b1 = np.random.randn(8).astype("float32")
+        b2 = np.random.randn(6).astype("float32")
+        b3 = np.random.randn(7).astype("float32")
+
+        tt = torch.from_numpy
+        pooled = F.avg_pool2d(tt(x), 3, stride=1, padding=1,
+                              count_include_pad=True)
+        y0 = F.conv2d(pooled, tt(f0), tt(b0))
+        y1 = F.conv2d(tt(x), tt(f1), tt(b1))
+        y1h, y1t = y1[:, :2], y1[:, 2:]
+        y2 = F.conv2d(y1t, tt(f2), tt(b2), padding=1, groups=2)
+        y2h, y2t = y2[:, :4], y2[:, 4:]
+        y3 = F.conv2d(y2t, tt(f3), tt(b3), padding=1)
+        out = torch.relu(torch.cat([y0, y1h, y2h, y3], 1)).numpy()
+        self.inputs = {
+            "Input": x,
+            "Filter": [("f0", f0), ("f1", f1), ("f2", f2), ("f3", f3)],
+            "Bias": [("b0", b0), ("b1", b1), ("b2", b2), ("b3", b3)]}
+        self.attrs = {}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestSimilarityFocus(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "similarity_focus"
+        n, a, b, c = 2, 3, 3, 4
+        x = np.random.rand(n, a, b, c).astype("float32")
+        out = np.zeros_like(x)
+        for bi in range(n):
+            t = x[bi, 0]
+            mask = np.zeros((b, c))
+            used_r = np.zeros(b, bool)
+            used_c = np.zeros(c, bool)
+            for _ in range(min(b, c)):
+                avail = t.copy()
+                avail[used_r, :] = -np.inf
+                avail[:, used_c] = -np.inf
+                r, cc = np.unravel_index(np.argmax(avail), t.shape)
+                mask[r, cc] = 1
+                used_r[r] = True
+                used_c[cc] = True
+            out[bi] = mask[None]
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "indexes": [0]}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-6)
+
+
+class TestTreeConv(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "tree_conv"
+        # tree: 1 -> (2, 3), 2 -> (4); 4 nodes, features F=2
+        n, f, s, m = 4, 2, 3, 2
+        md = 2
+        edges = np.array([[[1, 2], [1, 3], [2, 4]]], np.int32)
+        feats = np.random.randn(1, n, f).astype("float32")
+        filt = np.random.randn(f, 3, s, m).astype("float32")
+
+        # independent numpy oracle: DFS patches per root, depth<md
+        children = {1: [2, 3], 2: [4], 3: [], 4: []}
+        parentpos = {2: (1, 2), 3: (2, 2), 4: (1, 1)}  # (idx, pclen)
+
+        def patch(root):
+            # (node, idx, pclen, depth); root has (1,1,0)
+            items = [(root, 1, 1, 0)]
+            frontier = [(root, 0)]
+            while frontier:
+                u, du = frontier.pop()
+                if du + 1 >= md:
+                    continue
+                for v in children[u]:
+                    idx, pc = parentpos[v]
+                    items.append((v, idx, pc, du + 1))
+                    frontier.append((v, du + 1))
+            return items
+
+        w2 = filt.transpose(1, 0, 2, 3).reshape(3 * f, s * m)
+        out = np.zeros((1, n, s, m), np.float32)
+        for root in range(1, n + 1):
+            pl = np.zeros(f)
+            pr = np.zeros(f)
+            pt = np.zeros(f)
+            for (node, idx, pc, depth) in patch(root):
+                eta_t = (md - depth) / md
+                frac = 0.5 if pc == 1 else (idx - 1.0) / (pc - 1.0)
+                eta_l = (1 - eta_t) * frac
+                eta_r = (1 - eta_t) * (1 - frac)
+                fv = feats[0, node - 1]
+                pl += eta_l * fv
+                pr += eta_r * fv
+                pt += eta_t * fv
+            vec = np.concatenate([pl, pr, pt])
+            out[0, root - 1] = (vec @ w2).reshape(s, m)
+        self.inputs = {"EdgeSet": edges, "NodesVector": feats,
+                       "Filter": filt}
+        self.attrs = {"max_depth": md}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["NodesVector", "Filter"], "Out",
+                        no_grad_set={"EdgeSet"})
+
+
+class TestBoxDecoderAndAssign(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "box_decoder_and_assign"
+        n, c = 4, 3
+        prior = np.abs(np.random.rand(n, 4).astype("float32")) * 10
+        prior[:, 2:] += prior[:, :2] + 1
+        pvar = np.array([0.1, 0.1, 0.2, 0.2], np.float32)
+        tgt = (np.random.randn(n, c * 4) * 0.3).astype("float32")
+        score = np.random.rand(n, c).astype("float32")
+        clip = np.log(10.0)
+
+        dec = np.zeros((n, c * 4), np.float32)
+        assign = np.zeros((n, 4), np.float32)
+        for i in range(n):
+            pw = prior[i, 2] - prior[i, 0] + 1
+            ph = prior[i, 3] - prior[i, 1] + 1
+            pcx = prior[i, 0] + pw / 2
+            pcy = prior[i, 1] + ph / 2
+            for j in range(c):
+                o = j * 4
+                dw = min(pvar[2] * tgt[i, o + 2], clip)
+                dh = min(pvar[3] * tgt[i, o + 3], clip)
+                cx = pvar[0] * tgt[i, o] * pw + pcx
+                cy = pvar[1] * tgt[i, o + 1] * ph + pcy
+                w = np.exp(dw) * pw
+                h = np.exp(dh) * ph
+                dec[i, o:o + 4] = [cx - w / 2, cy - h / 2,
+                                   cx + w / 2 - 1, cy + h / 2 - 1]
+            mj = 1 + int(np.argmax(score[i, 1:]))
+            assign[i] = dec[i, mj * 4:mj * 4 + 4]
+        self.inputs = {"PriorBox": prior, "PriorBoxVar": pvar,
+                       "TargetBox": tgt, "BoxScore": score}
+        self.attrs = {"box_clip": float(clip)}
+        self.outputs = {"DecodeBox": dec, "OutputAssignBox": assign}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestDistributeFpnProposals(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "distribute_fpn_proposals"
+        n = 6
+        rois = np.zeros((n, 4), np.float32)
+        sizes = [20, 300, 60, 500, 100, 40]  # sqrt(area) targets
+        for i, s in enumerate(sizes):
+            rois[i] = [10, 10, 10 + s, 10 + s]
+        min_l, max_l, ref_l, ref_s = 2, 5, 4, 224
+        # +1 pixel offset (reference BBoxArea normalized=false)
+        lvl = np.clip(np.floor(
+            np.log2((np.asarray(sizes, np.float64) + 1) / ref_s) + ref_l),
+            min_l, max_l).astype(int)
+        outs = []
+        for l in range(min_l, max_l + 1):
+            sel = rois[lvl == l]
+            pad = np.zeros((n, 4), np.float32)
+            pad[:sel.shape[0]] = sel
+            outs.append(pad)
+        counts = np.array([(lvl == l).sum()
+                           for l in range(min_l, max_l + 1)], np.int32)
+        order = np.argsort(lvl * (n + 1) + np.arange(n))
+        restore = np.argsort(order).astype(np.int32).reshape(n, 1)
+        self.inputs = {"FpnRois": rois}
+        self.attrs = {"min_level": min_l, "max_level": max_l,
+                      "refer_level": ref_l, "refer_scale": ref_s}
+        self.outputs = {
+            "MultiFpnRois": [(f"lvl{l}", outs[l - min_l])
+                             for l in range(min_l, max_l + 1)],
+            "MultiLevelCounts": counts,
+            "RestoreIndex": restore}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestRoiPerspectiveTransform(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "roi_perspective_transform"
+        # axis-aligned square quad: transform degenerates to bilinear
+        # resampling of the box -- oracle via the same matrix math in
+        # numpy on an explicit grid
+        c, h, w = 2, 8, 8
+        x = np.random.rand(1, c, h, w).astype("float32")
+        rois = np.array([[1, 1, 5, 1, 5, 5, 1, 5]], np.float32)
+        th = tw = 4
+        # matrix for an axis-aligned box (est_w == est_h == 4):
+        # nw = th; grid maps linearly
+        out = np.zeros((1, c, th, tw), np.float32)
+        for oy in range(th):
+            for ox in range(tw):
+                in_x = 1 + ox * (5 - 1) / (tw - 1)
+                in_y = 1 + oy * (5 - 1) / (th - 1)
+                x0, y0 = int(np.floor(in_x)), int(np.floor(in_y))
+                x1, y1 = min(x0 + 1, w - 1), min(y0 + 1, h - 1)
+                ax, ay = in_x - x0, in_y - y0
+                out[0, :, oy, ox] = (
+                    x[0, :, y0, x0] * (1 - ay) * (1 - ax)
+                    + x[0, :, y0, x1] * (1 - ay) * ax
+                    + x[0, :, y1, x0] * ay * (1 - ax)
+                    + x[0, :, y1, x1] * ay * ax)
+        self.inputs = {"X": x, "ROIs": rois}
+        self.attrs = {"transformed_height": th, "transformed_width": tw,
+                      "spatial_scale": 1.0}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestGenerateMaskLabels(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "generate_mask_labels"
+        res, ncls = 4, 3
+        rois = np.array([[0, 0, 8, 8], [0, 0, 2, 2]], np.float32)
+        labels = np.array([1, 0], np.int32)  # roi1 fg cls 1, roi2 bg
+        gt_boxes = np.array([[0, 0, 8, 8]], np.float32)
+        gt_classes = np.array([1], np.int32)
+        # polygon covering the left half of the gt box
+        polys = np.array([[[0, 0], [4, 0], [4, 8], [0, 8]]], np.float32)
+        poly_len = np.array([4], np.int32)
+        masks = np.zeros((2, ncls * res * res), np.int32)
+        slab = masks[0].reshape(ncls, res, res)
+        # grid centers at x = 1,3,5,7: first two columns inside
+        slab[1, :, :2] = 1
+        masks[0] = slab.reshape(-1)
+        self.inputs = {"Rois": rois, "LabelsInt32": labels,
+                       "GtBoxes": gt_boxes, "GtClasses": gt_classes,
+                       "GtSegms": polys, "PolyLen": poly_len}
+        self.attrs = {"num_classes": ncls, "resolution": res}
+        self.outputs = {"MaskRois": rois,
+                        "RoiHasMaskInt32": np.array([1, 0], np.int32),
+                        "MaskInt32": masks}
+
+    def test_output(self):
+        self.check_output(atol=0)
+
+
+class TestFusionSeqconvEltaddRelu(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fusion_seqconv_eltadd_relu"
+        b, t, d, m = 2, 4, 3, 5
+        clen, cstart = 3, -1
+        x = np.random.randn(b, t, d).astype("float32")
+        w = np.random.randn(clen * d, m).astype("float32")
+        bias = np.random.randn(m).astype("float32")
+        sl = np.array([3, 4], np.int32)
+        xm = x * (np.arange(t)[None, :, None] < sl[:, None, None])
+        cols = []
+        for i in range(clen):
+            off = cstart + i
+            sh = np.zeros_like(xm)
+            if off < 0:
+                sh[:, -off:] = xm[:, :t + off]
+            elif off > 0:
+                sh[:, :t - off] = xm[:, off:]
+            else:
+                sh = xm
+            cols.append(sh)
+        ctxmat = np.concatenate(cols, -1)
+        colmat = ctxmat @ w
+        colmat = colmat * (np.arange(t)[None, :, None]
+                           < sl[:, None, None])
+        out = np.maximum(colmat + bias, 0)
+        self.inputs = {"X": x, "Filter": w, "Bias": bias, "SeqLen": sl}
+        self.attrs = {"contextLength": clen, "contextStart": cstart}
+        self.outputs = {"Out": out, "ColMat": colmat}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestBoxDecoderAndAssignPerPriorVar(OpTest):
+    """PriorBoxVar as per-prior [N,4] rows (box_coder convention)."""
+
+    def setUp(self):
+        super().setUp()
+        self.op_type = "box_decoder_and_assign"
+        n, c = 3, 2
+        prior = np.abs(np.random.rand(n, 4).astype("float32")) * 10
+        prior[:, 2:] += prior[:, :2] + 1
+        pvar = np.random.uniform(0.05, 0.3, (n, 4)).astype("float32")
+        tgt = (np.random.randn(n, c * 4) * 0.3).astype("float32")
+        score = np.random.rand(n, c).astype("float32")
+        clip = np.log(10.0)
+        dec = np.zeros((n, c * 4), np.float32)
+        assign = np.zeros((n, 4), np.float32)
+        for i in range(n):
+            pw = prior[i, 2] - prior[i, 0] + 1
+            ph = prior[i, 3] - prior[i, 1] + 1
+            pcx = prior[i, 0] + pw / 2
+            pcy = prior[i, 1] + ph / 2
+            for j in range(c):
+                o = j * 4
+                dw = min(pvar[i, 2] * tgt[i, o + 2], clip)
+                dh = min(pvar[i, 3] * tgt[i, o + 3], clip)
+                cx = pvar[i, 0] * tgt[i, o] * pw + pcx
+                cy = pvar[i, 1] * tgt[i, o + 1] * ph + pcy
+                w = np.exp(dw) * pw
+                h = np.exp(dh) * ph
+                dec[i, o:o + 4] = [cx - w / 2, cy - h / 2,
+                                   cx + w / 2 - 1, cy + h / 2 - 1]
+            assign[i] = dec[i, 4:8]  # argmax over classes 1..C-1 == 1
+        self.inputs = {"PriorBox": prior, "PriorBoxVar": pvar,
+                       "TargetBox": tgt, "BoxScore": score}
+        self.attrs = {"box_clip": float(clip)}
+        self.outputs = {"DecodeBox": dec, "OutputAssignBox": assign}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestFusionRepeatedFCReluNoBias(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "fusion_repeated_fc_relu"
+        x = np.random.randn(4, 5).astype("float32")
+        w1 = np.random.randn(5, 6).astype("float32")
+        w2 = np.random.randn(6, 3).astype("float32")
+        h1 = np.maximum(x @ w1, 0)
+        h2 = np.maximum(h1 @ w2, 0)
+        self.inputs = {"X": x, "W": [("w1", w1), ("w2", w2)]}
+        self.attrs = {}
+        self.outputs = {"Out": h2, "ReluOut": [("r1", h1)]}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+def test_custom_reader_decorator():
+    from paddle_tpu.core.program import Operator
+    from paddle_tpu.core.registry import run_op
+    from paddle_tpu.ops.extra_ops3 import (_HOST_READERS,
+                                           register_host_reader)
+    from paddle_tpu.ops.host_ops import register_py_func
+
+    batches = [(np.full((2, 2), i, np.float32),) for i in range(2)]
+    register_host_reader("base_r", lambda: iter(batches))
+    fid = register_py_func(lambda b: (b[0] * 2.0,))
+
+    prog = fluid.Program()
+    block = prog.global_block
+    op = Operator(block, "create_custom_reader",
+                  {"UnderlyingReader": ["base_r"]},
+                  {"Out": ["deco_r"]}, {"decorator_id": fid})
+    run_op(op, {"base_r": np.zeros(1, np.float32)})
+    assert "deco_r" in _HOST_READERS
+    got = list(_HOST_READERS["deco_r"]["factory"]())
+    np.testing.assert_allclose(got[1][0], batches[1][0] * 2.0)
+
+
+def test_get_places_and_feed_fetch_and_delete_var():
+    import jax
+
+    from paddle_tpu.core.program import Operator
+    from paddle_tpu.core.registry import run_op
+
+    prog = fluid.Program()
+    block = prog.global_block
+    op = Operator(block, "get_places", {}, {"Out": ["places"]},
+                  {"device_count": 2})
+    env = {}
+    run_op(op, env)
+    assert len(np.asarray(env["places"])) >= 1
+
+    x = np.arange(4, dtype=np.float32)
+    for t in ("feed", "fetch"):
+        op = Operator(block, t, {"X": ["in"]}, {"Out": ["out"]},
+                      {"col": 0})
+        env = {"in": x}
+        run_op(op, env)
+        np.testing.assert_allclose(np.asarray(env["out"]), x)
+
+    op = Operator(block, "delete_var", {"X": ["in"]}, {}, {})
+    run_op(op, {"in": x})  # no outputs, must not raise
+
+
+def test_read_op_and_custom_reader():
+    from paddle_tpu.core.program import Operator
+    from paddle_tpu.core.registry import run_op
+    from paddle_tpu.ops.extra_ops3 import register_host_reader
+
+    prog = fluid.Program()
+    block = prog.global_block
+    block.create_var(name="img", shape=(2, 3), dtype="float32")
+    block.create_var(name="lbl", shape=(2, 1), dtype="int64")
+
+    batches = [
+        (np.full((2, 3), i, np.float32),
+         np.full((2, 1), i, np.int64)) for i in range(3)]
+    register_host_reader("r0", lambda: iter(batches))
+
+    op = Operator(block, "read", {"Reader": ["r0"]},
+                  {"Out": ["img", "lbl"]}, {})
+    env = {"r0": np.zeros(1, np.float32)}
+    run_op(op, env)
+    np.testing.assert_allclose(np.asarray(env["img"]),
+                               batches[0][0])
+    run_op(op, env)
+    np.testing.assert_allclose(np.asarray(env["lbl"]),
+                               batches[1][1])
+    # exhaustion restarts
+    run_op(op, env)
+    run_op(op, env)
+    np.testing.assert_allclose(np.asarray(env["img"]),
+                               batches[0][0])
+
+
+if __name__ == "__main__":
+    import pytest as _pytest
+
+    _pytest.main([__file__, "-q"])
