@@ -47,7 +47,9 @@ from . import ir
 from . import inference
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig, \
-    memory_optimize, release_memory
+    memory_optimize, release_memory, InferenceTranspiler
+from . import distributed
+from . import distribute_lookup_table
 from . import amp
 from . import flags
 from .flags import set_flags, get_flags

@@ -1,0 +1,55 @@
+"""Locate the (single) distributed lookup table in a program.
+
+Parity: reference python/paddle/fluid/distribute_lookup_table.py --
+find_distributed_lookup_table :55 (unique W of lookup_table ops with
+is_distributed=True), *_inputs :18 / *_outputs :36. Used by the
+DistributeTranspiler and the downpour PS to split a giant embedding
+row-wise across servers (SURVEY.md §2.4 "distributed lookup table").
+"""
+from __future__ import annotations
+
+LOOKUP_TABLE_TYPE = "lookup_table"
+
+
+def find_distributed_lookup_table(program):
+    """The unique table name marked is_distributed, or None. Raises if
+    two different distributed tables exist (unsupported, as in the
+    reference)."""
+    table_name = None
+    for op in program.global_block.ops:
+        if op.type != LOOKUP_TABLE_TYPE:
+            continue
+        w = op.input("W")[0]
+        if op.attr("is_distributed", False):
+            if table_name is None:
+                table_name = w
+            elif table_name != w:
+                raise RuntimeError("all distributed lookup_table ops "
+                                   "should share one table")
+        else:
+            if table_name is not None and w == table_name:
+                raise AssertionError(
+                    f"table {w!r} is used both distributed and local")
+    return table_name
+
+
+def find_distributed_lookup_table_inputs(program, table_name):
+    """Ids variables feeding lookups of `table_name`."""
+    block = program.global_block
+    inputs = []
+    for op in block.ops:
+        if op.type == LOOKUP_TABLE_TYPE and \
+                op.input("W")[0] == table_name:
+            inputs.extend(block.var(name) for name in op.input("Ids"))
+    return inputs
+
+
+def find_distributed_lookup_table_outputs(program, table_name):
+    """Out variables written by lookups of `table_name`."""
+    block = program.global_block
+    outputs = []
+    for op in block.ops:
+        if op.type == LOOKUP_TABLE_TYPE and \
+                op.input("W")[0] == table_name:
+            outputs.extend(block.var(name) for name in op.output("Out"))
+    return outputs

@@ -1,0 +1,10 @@
+"""Downpour parameter-server package (fleet precursor).
+
+Parity: reference python/paddle/fluid/distributed/ (downpour.py,
+node.py, ps_instance.py, helper.py; ps_pb2 protobufs are replaced by
+plain dict descs -- SURVEY.md §2.7 "distributed (downpour PS)")."""
+from .downpour import DownpourSGD  # noqa: F401
+from .helper import EnvRoleHelper, FileSystem  # noqa: F401
+from .node import (DownpourServer, DownpourWorker, Server,  # noqa: F401
+                   Worker)
+from .ps_instance import PaddlePSInstance  # noqa: F401

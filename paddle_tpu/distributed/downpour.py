@@ -1,0 +1,80 @@
+"""DownpourSGD: distributed optimizer for the async PS (CTR) path.
+
+Parity: reference python/paddle/fluid/distributed/downpour.py:24 --
+minimize (:47) appends backward, finds the distributed lookup table,
+registers one sparse table (the embedding) + one dense table (all
+other params) on server and worker descs, and returns
+[ps_param, worker_skipped_ops] where the worker must skip the
+lookup_table forward/backward ops (the PS serves them via prefetch).
+
+TPU-native: descs are plain dicts (node.py) aimed at the in-repo
+pserver runtime; the actual serving path is the distributed-lookup
+prefetch rewrite in transpiler/distribute_transpiler.py + ops/dist_ops
+(VERDICT row 17), so DownpourSGD is the driver-facing planner that the
+AsyncExecutor/downpour flow expects."""
+from __future__ import annotations
+
+from ..backward import append_backward
+from ..distribute_lookup_table import (
+    find_distributed_lookup_table,
+    find_distributed_lookup_table_inputs,
+    find_distributed_lookup_table_outputs)
+from .node import DownpourServer, DownpourWorker
+
+
+class DownpourSGD:
+    """Downpour SGD (Large Scale Distributed Deep Networks, Dean et
+    al. 2012): workers pull params, push grads asynchronously with a
+    communication `window`."""
+
+    def __init__(self, learning_rate=0.001, window=1):
+        self.learning_rate_ = learning_rate
+        self.window_ = window
+        self.type = "downpour"
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        """Append backward + build the PS plan.
+
+        Returns [ps_param, worker_skipped_ops]: ps_param holds
+        "server_param"/"trainer_param" descs; worker_skipped_ops are
+        op types the worker executor must skip because the parameter
+        server owns them (the sparse lookup)."""
+        params_grads = sorted(
+            append_backward(loss, parameter_list, no_grad_set),
+            key=lambda x: x[0].name)
+        program = loss.block.program
+        table_name = find_distributed_lookup_table(program)
+        prefetch_slots = []
+        prefetch_slots_emb = []
+        if table_name is not None:
+            prefetch_slots = find_distributed_lookup_table_inputs(
+                program, table_name)
+            prefetch_slots_emb = find_distributed_lookup_table_outputs(
+                program, table_name)
+
+        server = DownpourServer()
+        worker = DownpourWorker(self.window_)
+        sparse_table_index = 0
+        dense_table_index = 1
+        params = [p for p, _ in params_grads
+                  if p.name != table_name]
+        grads = [g for p, g in params_grads if p.name != table_name]
+        server.add_sparse_table(sparse_table_index,
+                                self.learning_rate_,
+                                prefetch_slots, prefetch_slots_emb)
+        server.add_dense_table(dense_table_index, self.learning_rate_,
+                               params, grads)
+        worker.add_sparse_table(sparse_table_index,
+                                self.learning_rate_,
+                                prefetch_slots, prefetch_slots_emb)
+        worker.add_dense_table(dense_table_index, self.learning_rate_,
+                               params, grads)
+
+        worker_skipped_ops = ["lookup_table", "lookup_table_grad"]
+        ps_param = {
+            "server_param": server.get_desc(),
+            "trainer_param": {**worker.get_desc(),
+                              "skip_op": list(worker_skipped_ops)},
+        }
+        return [ps_param, worker_skipped_ops]

@@ -1,0 +1,71 @@
+"""Role/communicator helpers for the downpour PS package.
+
+Parity: reference python/paddle/fluid/distributed/helper.py --
+FileSystem (:17, hadoop client desc for AsyncExecutor) and MPIHelper
+(:56, mpi4py wrapper). TPU-native: roles come from the PADDLE_* env
+contract (the same one test_dist_base-style launchers set) with
+jax.distributed as the optional barrier backend -- there is no MPI on
+TPU pods (SURVEY.md §2.4: coordination service replaces the gRPC/MPI
+bootstrap)."""
+from __future__ import annotations
+
+import os
+import socket
+
+
+class FileSystem:
+    """Hadoop/AFS client description for dataset IO (API parity; the
+    TPU build reads local/recordio files, so this is metadata only)."""
+
+    def __init__(self, fs_type="afs", uri="afs://xx", user=None,
+                 passwd=None, hadoop_bin=""):
+        if user is None or passwd is None or hadoop_bin is None:
+            raise ValueError("FileSystem needs user/passwd/hadoop_bin")
+        self.fs_client = {
+            "fs_type": fs_type, "uri": uri, "user": user,
+            "passwd": passwd, "hadoop_bin": hadoop_bin,
+        }
+
+    def get_desc(self):
+        return self.fs_client
+
+
+class EnvRoleHelper:
+    """get_rank/get_size/barrier over env vars (MPIHelper parity).
+
+    Rank layout follows the reference's mpi world: all processes in
+    one world, even ranks = workers, odd = servers when
+    server_worker_mode=1 (see ps_instance.py)."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_RANK", os.environ.get(
+            "PADDLE_TRAINER_ID", "0")))
+        self._size = int(os.environ.get("PADDLE_WORLD_SIZE", os.environ.get(
+            "PADDLE_TRAINERS_NUM", "1")))
+
+    def get_rank(self):
+        return self._rank
+
+    def get_size(self):
+        return self._size
+
+    def get_ip(self):
+        return socket.gethostbyname(socket.gethostname())
+
+    def get_hostname(self):
+        return socket.gethostname()
+
+    def barrier(self):
+        """Cross-process barrier: jax.distributed when running
+        multi-process, a no-op single-process. A barrier failure in
+        the multi-process case propagates -- silently skipping it
+        would let callers race past servers that are not up yet."""
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("downpour_barrier")
+
+    def finalize(self):
+        pass

@@ -1,0 +1,108 @@
+"""Downpour server/worker descriptors.
+
+Parity: reference python/paddle/fluid/distributed/node.py -- Server /
+Worker / DownpourServer (:35, add_sparse_table :53, add_dense_table
+:86) / DownpourWorker (:122). The reference fills PSLib protobufs
+(ps_pb2) configuring the Baidu brpc parameter server; the TPU-native
+backend is the in-repo PS runtime (transpiler/pserver_runtime.py over
+TCP + io_callback), so the descs here are plain dicts with the same
+logical fields (table ids, accessor params, slot var names)."""
+from __future__ import annotations
+
+
+class Server:
+    """A server description base (reference node.py:17)."""
+
+    def __init__(self):
+        pass
+
+
+class Worker:
+    """A worker description base (reference node.py:26)."""
+
+    def __init__(self):
+        pass
+
+
+class DownpourServer(Server):
+    """Generates the server-side table plan (reference node.py:35)."""
+
+    def __init__(self):
+        super().__init__()
+        self._desc = {
+            "service": {
+                # reference wires DownpourBrpcPsServer/Client; ours is
+                # the pserver_runtime TCP transport
+                "server_class": "PTpuPsServer",
+                "client_class": "PTpuPsClient",
+                "service_class": "PTpuPsService",
+            },
+            "downpour_table_params": [],
+        }
+
+    def add_sparse_table(self, table_id, learning_rate,
+                         slot_key_vars, slot_value_vars):
+        self._desc["downpour_table_params"].append({
+            "table_id": table_id,
+            "table_class": "DownpourSparseTable",
+            "type": "PS_SPARSE_TABLE",
+            "accessor": {
+                "accessor_class": "DownpourFeatureValueAccessor",
+                "learning_rate": learning_rate,
+            },
+            "slot_key_vars": [v.name for v in slot_key_vars],
+            "slot_value_vars": [v.name for v in slot_value_vars],
+        })
+
+    def add_dense_table(self, table_id, learning_rate, param_vars,
+                        grad_vars):
+        self._desc["downpour_table_params"].append({
+            "table_id": table_id,
+            "table_class": "DownpourDenseTable",
+            "type": "PS_DENSE_TABLE",
+            "accessor": {
+                "accessor_class": "DownpourDenseValueAccessor",
+                "learning_rate": learning_rate,
+            },
+            "dense_param_vars": [v.name for v in param_vars],
+            "dense_grad_vars": [g.name for g in grad_vars],
+        })
+
+    def get_desc(self):
+        return self._desc
+
+
+class DownpourWorker(Worker):
+    """Generates the worker-side pull/push plan (reference
+    node.py:122). `window` is the async communication window (how many
+    local steps between pushes)."""
+
+    def __init__(self, window=1):
+        super().__init__()
+        self.window = window
+        self._desc = {"window": window, "sparse_tables": [],
+                      "dense_tables": []}
+
+    def add_sparse_table(self, table_id, learning_rate,
+                         slot_key_vars, slot_value_vars):
+        self._desc["sparse_tables"].append({
+            "table_id": table_id,
+            "learning_rate": learning_rate,
+            "slot_key": [v.name for v in slot_key_vars],
+            "slot_value": [v.name for v in slot_value_vars],
+            "slot_gradient": [v.name + "@GRAD"
+                              for v in slot_value_vars],
+        })
+
+    def add_dense_table(self, table_id, learning_rate, param_vars,
+                        grad_vars):
+        self._desc["dense_tables"].append({
+            "table_id": table_id,
+            "learning_rate": learning_rate,
+            "dense_variable_name": [v.name for v in param_vars],
+            "dense_gradient_variable_name":
+                [g.name for g in grad_vars],
+        })
+
+    def get_desc(self):
+        return self._desc

@@ -500,3 +500,104 @@ class IdentityEliminationPass(Pass):
                 continue
             graph.replace_input_everywhere(out_name, x, after=op)
             graph.remove_op(op)
+
+
+@register_pass("conv_relu_fuse_pass")
+class ConvReluFusePass(Pass):
+    """conv2d [+ per-channel elementwise_add bias] + relu ->
+    conv2d_fusion(activation=relu) (reference
+    ir/conv_relu_mkldnn_fuse_pass.cc / conv_bias_mkldnn_fuse_pass.cc;
+    here the target is the registered conv2d_fusion op,
+    ops/extra_ops3 parity family). XLA fuses these anyway -- the pass
+    keeps the program-level rewrite capability the reference's
+    inference pipeline exposes."""
+
+    def apply_impl(self, graph: Graph, scope):
+        protected = graph.attrs.get("protected", set())
+        for relu in list(graph.block.ops):
+            if relu.type != "relu":
+                continue
+            prev = graph.producer(relu, "X")
+            add = None
+            if (prev is not None and prev.type == "elementwise_add"
+                    and prev.attr("axis", -1) == 1):
+                # only a per-channel (1-D, length C) Y is a conv bias;
+                # higher-rank Y uses fluid left-aligned broadcast the
+                # fused kernel's (1,C,1,1) reshape would misapply
+                y_var = graph.block._find_var_recursive(
+                    prev.input("Y")[0])
+                if y_var is not None and y_var.shape is not None \
+                        and len(y_var.shape) == 1:
+                    add = prev
+                    prev = graph.producer(add, "X")
+            if prev is None or prev.type != "conv2d":
+                continue
+            conv_out, = prev.output("Output")
+            nxt = add if add is not None else relu
+            if [c is nxt for c in graph.consumers(prev, conv_out)] \
+                    != [True]:
+                continue
+            mid = add.output("Out")[0] if add is not None else conv_out
+            if add is not None:
+                if [c is relu for c in graph.consumers(add, mid)] \
+                        != [True]:
+                    continue
+                if mid in protected:
+                    continue
+            if conv_out in protected:
+                continue
+            relu_out, = relu.output("Out")
+            inputs = {"Input": prev.input("Input"),
+                      "Filter": prev.input("Filter")}
+            if add is not None:
+                inputs["Bias"] = add.input("Y")
+            idx = graph.block.ops.index(prev)
+            graph.remove_op(prev)
+            if add is not None:
+                graph.remove_op(add)
+            graph.remove_op(relu)
+            graph.block.insert_op(
+                idx, "conv2d_fusion", inputs, {"Output": [relu_out]},
+                {**prev.attrs, "activation": "relu"})
+
+
+@register_pass("conv_eltwiseadd_fuse_pass")
+class ConvEltwiseAddFusePass(Pass):
+    """conv2d + same-shape elementwise_add (residual) ->
+    conv2d_fusion(ResidualData) (reference
+    ir/conv_elementwise_add_fuse_pass.cc)."""
+
+    def apply_impl(self, graph: Graph, scope):
+        protected = graph.attrs.get("protected", set())
+        for add in list(graph.block.ops):
+            if add.type != "elementwise_add":
+                continue
+            if add.attr("axis", -1) not in (-1, 0):
+                continue
+            conv = graph.producer(add, "X")
+            if conv is None or conv.type != "conv2d":
+                continue
+            # residual fusion is elementwise: Y must be full-rank
+            # NCHW (fluid left-aligned broadcast of a lower-rank Y
+            # is NOT what the fused kernel's plain add computes)
+            y_var = graph.block._find_var_recursive(add.input("Y")[0])
+            if y_var is None or y_var.shape is None \
+                    or len(y_var.shape) != 4:
+                continue
+            conv_out, = conv.output("Output")
+            if conv_out in protected:
+                continue
+            if [c is add for c in graph.consumers(conv, conv_out)] \
+                    != [True]:
+                continue
+            add_out, = add.output("Out")
+            idx = graph.block.ops.index(conv)
+            graph.remove_op(conv)
+            graph.remove_op(add)
+            graph.block.insert_op(
+                idx, "conv2d_fusion",
+                {"Input": conv.input("Input"),
+                 "Filter": conv.input("Filter"),
+                 "ResidualData": add.input("Y")},
+                {"Output": [add_out]},
+                {**conv.attrs, "activation": "identity"})

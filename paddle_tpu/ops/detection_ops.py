@@ -1181,7 +1181,7 @@ def roi_perspective_transform(ctx):
     return {"Out": jax.vmap(one)(rx, ry)}
 
 
-def _rasterize_masks_np(rois, labels, gt_boxes, gt_classes, polys,
+def _rasterize_masks_np(rois, labels, gt_boxes, polys,
                         poly_len, num_classes, resolution):
     """Host-side mask-target rasterization (numpy): for each fg roi,
     take the polygon of its best-IoU gt and rasterize it (even-odd
@@ -1244,17 +1244,19 @@ def generate_mask_labels(ctx):
     rois = ctx.input("Rois")
     labels = ctx.input("LabelsInt32")
     gt_boxes = ctx.input("GtBoxes")
-    gt_classes = ctx.input("GtClasses")
     polys = ctx.input("GtSegms")
     poly_len = ctx.input("PolyLen")
     num_classes = ctx.attr("num_classes", 81)
     resolution = ctx.attr("resolution", 14)
     r = rois.shape[0]
 
-    def _host(ro, la, gb, gc, po, pl):
+    # GtClasses is accepted for interface parity but the mask slab is
+    # keyed off the roi's own label (as the roi/label pairing already
+    # encodes the class); it is not shipped through the callback.
+    def _host(ro, la, gb, po, pl):
         return _rasterize_masks_np(
             np.asarray(ro), np.asarray(la), np.asarray(gb),
-            np.asarray(gc), np.asarray(po), np.asarray(pl),
+            np.asarray(po), np.asarray(pl),
             num_classes, resolution)
 
     masks, has = io_callback(
@@ -1262,7 +1264,7 @@ def generate_mask_labels(ctx):
         (jax.ShapeDtypeStruct((r, num_classes * resolution * resolution),
                               np.int32),
          jax.ShapeDtypeStruct((r,), np.int32)),
-        rois, labels, gt_boxes, gt_classes, polys, poly_len,
+        rois, labels, gt_boxes, polys, poly_len,
         ordered=True)
     return {"MaskRois": rois, "RoiHasMaskInt32": has,
             "MaskInt32": masks}

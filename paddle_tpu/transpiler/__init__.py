@@ -4,8 +4,10 @@ from .distribute_transpiler import (DistributeTranspiler,
 from .memory_optimization_transpiler import memory_optimize, \
     release_memory
 from .ps_dispatcher import HashName, PSDispatcher, RoundRobin
+from .inference_transpiler import InferenceTranspiler
 from . import pserver_runtime
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
            "VarBlock", "memory_optimize", "release_memory", "HashName",
-           "PSDispatcher", "RoundRobin", "pserver_runtime"]
+           "PSDispatcher", "RoundRobin", "pserver_runtime",
+           "InferenceTranspiler"]

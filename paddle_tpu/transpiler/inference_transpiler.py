@@ -1,0 +1,58 @@
+"""InferenceTranspiler: pre-IR-era program-level inference rewrites.
+
+Parity: reference python/paddle/fluid/transpiler/inference_transpiler.py
+(InferenceTranspiler.transpile :45 -- fuse batch_norm into conv
+weights :304, conv+bias :242, conv+relu :170, conv+eltwise_add :137,
+and an is_test sweep :82).
+
+TPU-first design: the reference hand-walks the program mutating OpDescs
+and numpy params; here every rewrite is already an IR pass (ir.py),
+so the transpiler is the thin user-facing facade the reference API
+promises -- it marks the program is_test, then runs the fuse pipeline
+against the scope holding the parameters. XLA would fuse the
+conv/bias/relu chain regardless; the value is (a) API parity and
+(b) the folded-BN parameter rewrite, which removes real FLOPs and
+state from the saved inference artifact.
+"""
+from __future__ import annotations
+
+from ..ir import apply_passes
+
+_PIPELINE = (
+    "dropout_eliminate_pass",     # _is_test_pass analogue for dropout
+    "conv_bn_fuse_pass",          # _fuse_batch_norm (+conv_bias)
+    "conv_eltwiseadd_fuse_pass",  # _fuse_conv_eltwise
+    "conv_relu_fuse_pass",        # _fuse_conv_relu (+conv_bias)
+    "identity_elimination_pass",  # _remove_unused_var-era cleanup
+)
+
+
+class InferenceTranspiler:
+    """Rewrite a trained program for inference, in place.
+
+    `place` is accepted for API parity (the reference reads params
+    through it); parameter values come from `scope`.
+    """
+
+    def transpile(self, program, place=None, scope=None,
+                  protected=None):
+        from .. import global_scope
+
+        if scope is None:
+            scope = global_scope()
+        # is_test sweep (reference _is_test_pass): batch_norm/dropout
+        # and friends switch to inference behavior
+        for block in program.blocks:
+            for op in block.ops:
+                if "is_test" in op.attrs or op.type in (
+                        "batch_norm", "dropout", "lrn"):
+                    op.attrs["is_test"] = True
+        if protected is None:
+            # keep every fetchable leaf alive: vars nothing consumes
+            consumed = {n for op in program.global_block.ops
+                        for n in op.input_arg_names}
+            protected = [n for op in program.global_block.ops
+                         for n in op.output_arg_names
+                         if n not in consumed]
+        return apply_passes(program, list(_PIPELINE), scope=scope,
+                            protected=protected)

@@ -1,0 +1,172 @@
+"""InferenceTranspiler + downpour package tests.
+
+Parity model: reference tests/unittests/test_inference_transpiler-era
+coverage (the reference exercises it inside book tests) plus
+test_downpoursgd-era desc checks.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build_conv_bn_relu():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data("img", shape=(3, 8, 8), dtype="float32")
+        conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                   padding=1, act=None)
+        bn = fluid.layers.batch_norm(conv, is_test=True)
+        relu = fluid.layers.relu(bn)
+    return prog, startup, img, relu
+
+
+def test_inference_transpiler_conv_bn_relu_fold():
+    prog, startup, img, out = _build_conv_bn_relu()
+    exe = fluid.Executor()
+    exe.run(startup)
+    x = np.random.RandomState(0).rand(2, 3, 8, 8).astype("float32")
+    before = np.asarray(
+        exe.run(prog, feed={"img": x}, fetch_list=[out.name])[0])
+
+    t = fluid.InferenceTranspiler()
+    t.transpile(prog, scope=fluid.global_scope(),
+                protected=[out.name])
+    types = [op.type for op in prog.global_block.ops]
+    assert "batch_norm" not in types, types
+    # conv+bias+relu collapsed into the fused op
+    assert "conv2d_fusion" in types, types
+    after = np.asarray(
+        exe.run(prog, feed={"img": x}, fetch_list=[out.name])[0])
+    np.testing.assert_allclose(after, before, atol=1e-4, rtol=1e-4)
+
+
+def test_conv_eltwiseadd_fuse_pass():
+    from paddle_tpu.ir import apply_passes
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data("img", shape=(3, 8, 8), dtype="float32")
+        res = fluid.layers.data("res", shape=(4, 8, 8), dtype="float32")
+        conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                   padding=1, act=None,
+                                   bias_attr=False)
+        out = fluid.layers.elementwise_add(conv, res)
+    exe = fluid.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    feed = {"img": rng.rand(2, 3, 8, 8).astype("float32"),
+            "res": rng.rand(2, 4, 8, 8).astype("float32")}
+    before = np.asarray(
+        exe.run(prog, feed=feed, fetch_list=[out.name])[0])
+    apply_passes(prog, ["conv_eltwiseadd_fuse_pass"],
+                 protected=[out.name])
+    types = [op.type for op in prog.global_block.ops]
+    assert "conv2d_fusion" in types and "elementwise_add" not in types
+    after = np.asarray(
+        exe.run(prog, feed=feed, fetch_list=[out.name])[0])
+    np.testing.assert_allclose(after, before, atol=1e-5, rtol=1e-5)
+
+
+def test_distribute_lookup_table_finders():
+    from paddle_tpu.distribute_lookup_table import (
+        find_distributed_lookup_table,
+        find_distributed_lookup_table_inputs,
+        find_distributed_lookup_table_outputs)
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", shape=(1,), dtype="int64")
+        emb = fluid.layers.embedding(ids, size=(100, 8),
+                                     is_distributed=True)
+    name = find_distributed_lookup_table(prog)
+    assert name is not None
+    ins = find_distributed_lookup_table_inputs(prog, name)
+    outs = find_distributed_lookup_table_outputs(prog, name)
+    assert [v.name for v in ins] == ["ids"]
+    assert len(outs) == 1
+
+
+def test_downpour_sgd_plan():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ids = fluid.layers.data("ids", shape=(1,), dtype="int64")
+        lbl = fluid.layers.data("lbl", shape=(1,), dtype="float32")
+        emb = fluid.layers.embedding(ids, size=(100, 8),
+                                     is_distributed=True)
+        emb.stop_gradient = False
+        fcout = fluid.layers.fc(emb, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fcout, lbl))
+        sgd = fluid.distributed.DownpourSGD(learning_rate=0.1, window=2)
+        ps_param, skipped = sgd.minimize(loss)
+    assert skipped == ["lookup_table", "lookup_table_grad"]
+    server = ps_param["server_param"]
+    tables = server["downpour_table_params"]
+    assert tables[0]["type"] == "PS_SPARSE_TABLE"
+    assert tables[0]["slot_key_vars"] == ["ids"]
+    assert tables[1]["type"] == "PS_DENSE_TABLE"
+    assert len(tables[1]["dense_param_vars"]) >= 2  # fc w + b
+    trainer = ps_param["trainer_param"]
+    assert trainer["window"] == 2
+    assert trainer["skip_op"] == skipped
+
+
+def test_ps_instance_roles():
+    from paddle_tpu.distributed import PaddlePSInstance
+
+    class FakeHelper:
+        def __init__(self, rank, size):
+            self._r, self._s = rank, size
+
+        def get_rank(self):
+            return self._r
+
+        def get_size(self):
+            return self._s
+
+        def get_ip(self):
+            return "127.0.0.1"
+
+        def barrier(self):
+            pass
+
+        def finalize(self):
+            pass
+
+    # mode 1: even ranks servers, odd workers
+    inst = PaddlePSInstance(server_worker_mode=1, proc_per_node=2,
+                            helper=FakeHelper(0, 4))
+    assert inst.is_server() and not inst.is_worker()
+    inst = PaddlePSInstance(server_worker_mode=1, proc_per_node=2,
+                            helper=FakeHelper(3, 4))
+    assert inst.is_worker()
+    assert inst.get_worker_index() == 1
+    assert inst.get_node_cnt() == 2
+    inst.barrier_all()  # no-op, must not raise
+    ips = inst.gather_ips()
+    assert len(ips) == 4
+
+    # mode 0: first half workers, second half servers (zero-based
+    # per-role indices)
+    inst = PaddlePSInstance(server_worker_mode=0, proc_per_node=2,
+                            helper=FakeHelper(0, 4))
+    assert inst.is_worker() and inst.is_first_worker()
+    inst = PaddlePSInstance(server_worker_mode=0, proc_per_node=2,
+                            helper=FakeHelper(1, 4))
+    assert inst.is_worker() and inst.get_worker_index() == 1
+    inst = PaddlePSInstance(server_worker_mode=0, proc_per_node=2,
+                            helper=FakeHelper(2, 4))
+    assert inst.is_server() and inst.get_server_index() == 0
+    inst = PaddlePSInstance(server_worker_mode=0, proc_per_node=2,
+                            helper=FakeHelper(3, 4))
+    assert inst.is_server() and inst.get_server_index() == 1
+
+
+if __name__ == "__main__":
+    import pytest
+
+    pytest.main([__file__, "-q"])
