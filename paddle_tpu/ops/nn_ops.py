@@ -400,14 +400,23 @@ def _swce_grad_kernel(ctx):
     select, not a materialized one-hot."""
     logits = ctx.input("Logits")
     label = ctx.input("Label")
-    lf = logits.astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(lf, axis=-1, keepdims=True)
     dloss = ctx.input("Loss@GRAD")
     if dloss is None:
         dloss = jnp.ones(logits.shape[:-1] + (1,), jnp.float32)
     dloss = dloss.astype(jnp.float32)
     eps = ctx.attr("label_smooth_eps", 0.0)
     vocab = logits.shape[-1]
+    if not ctx.attr("soft_label", False):
+        from .pallas import xent as pallas_xent
+
+        routed = pallas_xent.maybe_route(logits, label)
+        if routed is not None:
+            l2, lab1 = routed
+            dx = pallas_xent.xent_backward(
+                l2, lab1, dloss.reshape(-1), eps=eps)
+            return {"Logits@GRAD": dx.reshape(logits.shape)}
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1, keepdims=True)
     p_scaled = jnp.exp(lf - lse) * dloss  # fused, lands in grad
     if ctx.attr("soft_label", False):
         target = label.astype(jnp.float32)
@@ -446,9 +455,25 @@ def softmax_with_cross_entropy(ctx):
     (XLA dead-codes it otherwise)."""
     logits = ctx.input("Logits")
     label = ctx.input("Label")
+    eps = ctx.attr("label_smooth_eps", 0.0)
+    if not ctx.attr("soft_label", False):
+        from .pallas import xent as pallas_xent
+
+        routed = pallas_xent.maybe_route(logits, label)
+        if routed is not None:
+            l2, lab1 = routed
+            loss_flat, lse_flat = pallas_xent.xent_forward(
+                l2, lab1, eps=eps)
+            loss = loss_flat.reshape(logits.shape[:-1] + (1,))
+            # Softmax output stays a jnp expression off the pallas lse:
+            # XLA dead-codes it when (as in every model here) nothing
+            # consumes the Softmax slot
+            sm = jnp.exp(logits.astype(jnp.float32)
+                         - lse_flat.reshape(
+                             logits.shape[:-1] + (1,)))
+            return {"Loss": loss, "Softmax": sm}
     lf = logits.astype(jnp.float32)  # fuses into the reductions below
     lse = jax.scipy.special.logsumexp(lf, axis=-1, keepdims=True)
-    eps = ctx.attr("label_smooth_eps", 0.0)
     if ctx.attr("soft_label", False):
         # sum(label * (lse - logits)) = lse - sum(label * logits)
         loss = lse - jnp.sum(label.astype(jnp.float32) * lf, axis=-1,
@@ -803,10 +828,18 @@ def attention(ctx):
     if ra.cp_applicable(qh, kh, vh, dropout_rate):
         return to_bhtd(ra.cp_attention(qh, kh, vh, scale, causal))
     if dropout_rate == 0.0:
-        if pallas_attn.usable(qh, kh, vh) and (
-                layout == "bhtd" or qh.shape[2] > 1024):
-            # bthd pays 4 transposes to reach the kernel; only worth it
-            # where flash wins (long T). Short T stays transpose-free.
+        if pallas_attn.sdpa_usable(qh, kh, vh):
+            # short-T fused SDPA: scores never touch HBM and the
+            # backward reuses the saved probabilities instead of
+            # re-exping (the VPU exp rate is the floor at short T --
+            # see the kernel's module comment). Worth the bthd
+            # transposes at every size it accepts.
+            return to_bhtd(pallas_attn.sdpa_short(
+                qh, kh, vh, scale=scale, causal=causal))
+        if pallas_attn.usable(qh, kh, vh) and qh.shape[2] > 512:
+            # flash wins only at long T (its b*h-programs grid is
+            # launch-overhead-bound below that -- measured slower than
+            # the jnp composition at T<=512 on v5e, either layout)
             return to_bhtd(pallas_attn.flash_attention(
                 qh, kh, vh, scale=scale, causal=causal))
         if layout == "bthd":

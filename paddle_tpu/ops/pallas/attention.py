@@ -314,3 +314,172 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk, dv = jax.lax.fori_loop(0, n_blocks, body, (dk, dv))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# short-sequence fused SDPA: full [T,T] scores live in VMEM, several
+# (b,h) rows batched per program.
+#
+# Why not the flash kernel: at T<=~512 the flash grid degenerates to
+# b*h tiny programs (1024 on transformer-base) whose per-program
+# launch/DMA overhead dominates (~5 ms/call measured on v5e, slower
+# than the jnp composition). Here one program handles _SDPA_GROUP
+# heads with the entire score matrix on-chip -- no online-softmax
+# rescaling, no HBM [B,H,T,T] buffer (the jnp path's cost), and the
+# whole backward (dq, dk, dv) in ONE pass with softmax recomputed
+# from the saved lse.
+# ---------------------------------------------------------------------------
+# VMEM sizing at the routed window's top (T=512, the worst case
+# sdpa_usable admits): G*T*T f32 score temps = 8*512*512*4 = 8 MB fwd
+# (verified compiling + faster than the jnp path on v5e); the backward
+# additionally holds the saved-P block, hence the smaller group.
+_SDPA_GROUP_FWD = 8
+_SDPA_GROUP_BWD = 4
+
+
+def sdpa_usable(q, k, v) -> bool:
+    import os
+
+    from . import on_tpu
+
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS_SDPA") == "1":
+        return False
+    if not (on_tpu() or _interp()):
+        return False
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    # measured window on v5e (see module comment): at T<=256 the jnp
+    # composition wins in-model (XLA fuses softmax into neighbors and
+    # overlaps better); at T>512 the [grp,T,T] f32 scores overflow
+    # VMEM (1024^2*4*grp) -- that range belongs to the flash kernel
+    if tq != tk or not (256 < tq <= 512) or tq % 8 != 0:
+        return False
+    if d not in (64, 128) or q.dtype != k.dtype or k.dtype != v.dtype:
+        return False
+    bh = b * h
+    return bh % _SDPA_GROUP_FWD == 0 and bh % _SDPA_GROUP_BWD == 0
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def sdpa_short(q, k, v, scale=1.0, causal=False):
+    """q,k,v: [B,H,T,D] (same T) -> [B,H,T,D]."""
+    # primal (inference) path: p is only a backward residual; skip
+    # materializing the [B*H,T,T] tensor entirely
+    out, _ = _sdpa_short_fwd_impl(q, k, v, scale, causal,
+                                  save_p=False)
+    return out
+
+
+def _sdpa_short_fwd(q, k, v, scale, causal):
+    out, p = _sdpa_short_fwd_impl(q, k, v, scale, causal, save_p=True)
+    return out, (q, k, v, p)
+
+
+def _sdpa_short_bwd(scale, causal, res, g):
+    q, k, v, p = res
+    return _sdpa_short_bwd_impl(q, k, v, p, g, scale, causal)
+
+
+sdpa_short.defvjp(_sdpa_short_fwd, _sdpa_short_bwd)
+
+
+def _causal_mask(t):
+    r = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+    return r >= c
+
+
+def _sdpa_short_fwd_impl(q, k, v, scale, causal, save_p):
+    from jax.experimental import pallas as pl
+
+    b, h, t, d = q.shape
+    bh = b * h
+    grp = _SDPA_GROUP_FWD
+    q3 = q.reshape(bh, t, d)
+    k3 = k.reshape(bh, t, d)
+    v3 = v.reshape(bh, t, d)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, p_ref=None):
+        for g_i in range(grp):  # static unroll: 2-D matmuls on the MXU
+            qg = q_ref[g_i].astype(jnp.float32) * scale  # [T,D]
+            kg = k_ref[g_i].astype(jnp.float32)
+            vg = v_ref[g_i].astype(jnp.float32)
+            s = qg @ kg.T                                # [T,T]
+            if causal:
+                s = jnp.where(_causal_mask(t), s, -jnp.inf)
+            m = jnp.max(s, axis=1)
+            p = jnp.exp(s - m[:, None])
+            l = jnp.sum(p, axis=1)
+            pn = p / l[:, None]
+            o_ref[g_i] = (pn @ vg).astype(o_ref.dtype)
+            if p_ref is not None:
+                # normalized probabilities saved bf16 for the
+                # backward: the VPU's exp throughput (~25G/s on v5e)
+                # is the floor of this whole kernel, so the backward
+                # must NOT re-exp -- rereading 2*T*T bf16 from HBM is
+                # ~7x cheaper than the recompute
+                p_ref[g_i] = pn.astype(p_ref.dtype)
+
+    blk_td = pl.BlockSpec((grp, t, d), lambda i: (i, 0, 0))
+    out_specs = [blk_td]
+    out_shape = [jax.ShapeDtypeStruct((bh, t, d), q.dtype)]
+    if save_p:
+        out_specs.append(pl.BlockSpec((grp, t, t), lambda i: (i, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, t, t), jnp.bfloat16))
+    res = pl.pallas_call(
+        kernel,
+        grid=(bh // grp,),
+        in_specs=[blk_td] * 3,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_interp(),
+    )(q3, k3, v3)
+    if save_p:
+        out, p = res
+    else:
+        out, p = res[0], None
+    return out.reshape(b, h, t, d), p
+
+
+def _sdpa_short_bwd_impl(q, k, v, p, g, scale, causal):
+    from jax.experimental import pallas as pl
+
+    b, h, t, d = q.shape
+    bh = b * h
+    grp = _SDPA_GROUP_BWD
+    q3 = q.reshape(bh, t, d)
+    k3 = k.reshape(bh, t, d)
+    v3 = v.reshape(bh, t, d)
+    g3 = g.reshape(bh, t, d)
+
+    def kernel(q_ref, k_ref, v_ref, g_ref, p_ref,
+               dq_ref, dk_ref, dv_ref):
+        for g_i in range(grp):
+            qg = q_ref[g_i].astype(jnp.float32)
+            kg = k_ref[g_i].astype(jnp.float32)
+            vg = v_ref[g_i].astype(jnp.float32)
+            gg = g_ref[g_i].astype(jnp.float32)
+            pg = p_ref[g_i].astype(jnp.float32)          # [T,T] saved
+            dv_ref[g_i] = (pg.T @ gg).astype(dv_ref.dtype)
+            dp = gg @ vg.T                               # [T,T]
+            # softmax vjp: ds = p * (dp - rowsum(dp * p)); no exp here
+            row = jnp.sum(dp * pg, axis=1)
+            ds = pg * (dp - row[:, None])
+            dq_ref[g_i] = ((ds @ kg) * scale).astype(dq_ref.dtype)
+            dk_ref[g_i] = ((ds.T @ qg) * scale).astype(dk_ref.dtype)
+
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(bh // grp,),
+        in_specs=[pl.BlockSpec((grp, t, d), lambda i: (i, 0, 0))] * 4
+        + [pl.BlockSpec((grp, t, t), lambda i: (i, 0, 0))],
+        out_specs=[pl.BlockSpec((grp, t, d), lambda i: (i, 0, 0))] * 3,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ],
+        interpret=_interp(),
+    )(q3, k3, v3, g3, p)
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
+            dv.reshape(b, h, t, d))

@@ -103,3 +103,118 @@ def test_lse_saved_not_probs():
     out, res = fa._flash_fwd(q, k, v, 1.0, False)
     assert len(res) == 5
     assert res[4].shape == (1, 1, 32)  # lse
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_sdpa_short_forward_matches_oracle(causal):
+    q, k, v = _rand_qkv(2, 4, 32, 32, 64, seed=3)
+    q, k, v = q * 0.3, k * 0.3, v * 0.3
+    got = fa.sdpa_short(q, k, v, 0.125, causal)
+    ref = pallas.reference_attention(q, k, v, 0.125, causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_sdpa_short_grads_match_oracle():
+    q, k, v = _rand_qkv(2, 4, 32, 32, 64, seed=4)
+    q, k, v = q * 0.3, k * 0.3, v * 0.3
+
+    def f(q, k, v):
+        return (fa.sdpa_short(q, k, v, 0.125, True) * jnp.cos(q)).sum()
+
+    def fr(q, k, v):
+        return (pallas.reference_attention(q, k, v, 0.125, True)
+                * jnp.cos(q)).sum()
+
+    ga = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(ga, gr):
+        # bf16 saved-P quantization bounds the grad error
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-3, rtol=5e-2)
+
+
+def test_sdpa_short_routed_shape():
+    """A shape inside sdpa_usable's actual window (T=512)."""
+    q, k, v = _rand_qkv(1, 8, 512, 512, 64, seed=7)
+    q, k, v = q * 0.2, k * 0.2, v * 0.2
+    assert fa.sdpa_usable(q, k, v)
+    got = fa.sdpa_short(q, k, v, 0.125, True)
+    ref = pallas.reference_attention(q, k, v, 0.125, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sdpa_usable_window():
+    mk = lambda t: _rand_qkv(1, 8, t, t, 64, seed=1)
+    assert not fa.sdpa_usable(*mk(256))   # jnp path wins at short T
+    assert fa.sdpa_usable(*mk(384))
+    assert fa.sdpa_usable(*mk(512))
+    assert not fa.sdpa_usable(*mk(1024))  # flash kernel territory
+    q, k, v = _rand_qkv(1, 8, 384, 512, 64, seed=1)
+    assert not fa.sdpa_usable(q, k, v)    # cross-length rejected
+
+
+def test_pallas_xent_forward_backward_match_jnp():
+    from paddle_tpu.ops.pallas import xent as px
+
+    n, v = 64, 256
+    r = np.random.RandomState(5)
+    x = jnp.asarray(r.randn(n, v).astype(np.float32))
+    lab = jnp.asarray(r.randint(0, v, (n,)).astype(np.int32))
+    g = jnp.asarray(r.rand(n).astype(np.float32))
+    for eps in (0.0, 0.1):
+        loss, lse = px.xent_forward(x, lab, eps=eps)
+        lse_ref = jax.scipy.special.logsumexp(x, axis=-1)
+        picked = jnp.take_along_axis(x, lab[:, None], 1)[:, 0]
+        ref = lse_ref - picked
+        if eps:
+            ref = (1 - eps) * ref + eps * (lse_ref - jnp.mean(x, axis=1))
+        np.testing.assert_allclose(np.asarray(loss), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref),
+                                   atol=2e-6, rtol=2e-6)
+        dx = px.xent_backward(x, lab, g, eps=eps)
+        sm = jax.nn.softmax(x, axis=-1)
+        tgt = (1 - eps) * jax.nn.one_hot(lab, v) + (
+            eps / v if eps else 0.0)
+        dref = (sm - tgt) * g[:, None]
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(dref),
+                                   atol=2e-6, rtol=2e-6)
+
+
+def test_swce_op_routes_through_pallas_and_matches():
+    import os
+
+    import paddle_tpu as fluid
+    from paddle_tpu.core.program import Operator
+    from paddle_tpu.core.registry import run_op
+    from paddle_tpu.ops.pallas import xent as px
+
+    prog = fluid.Program()
+    block = prog.global_block
+    n, v = 64, 256
+    r = np.random.RandomState(6)
+    logits = r.randn(n, v).astype(np.float32)
+    label = r.randint(0, v, (n, 1)).astype(np.int64)
+    # the gate must actually accept this shape, else the comparison
+    # below degenerates to jnp-vs-jnp
+    assert px.usable(jnp.asarray(logits),
+                     jnp.asarray(label[:, 0].astype(np.int32)))
+    block.create_var(name="lg", shape=(n, v), dtype="float32")
+    block.create_var(name="lb", shape=(n, 1), dtype="int64")
+    op = Operator(block, "softmax_with_cross_entropy",
+                  {"Logits": ["lg"], "Label": ["lb"]},
+                  {"Loss": ["loss"], "Softmax": ["sm"]},
+                  {"label_smooth_eps": 0.1})
+    env = {"lg": jnp.asarray(logits), "lb": jnp.asarray(label)}
+    run_op(op, env)
+    pallas_loss = np.asarray(env["loss"])
+    os.environ["PADDLE_TPU_DISABLE_PALLAS_XENT"] = "1"
+    try:
+        env2 = {"lg": jnp.asarray(logits), "lb": jnp.asarray(label)}
+        run_op(op, env2)
+    finally:
+        os.environ.pop("PADDLE_TPU_DISABLE_PALLAS_XENT")
+    np.testing.assert_allclose(pallas_loss, np.asarray(env2["loss"]),
+                               atol=1e-5, rtol=1e-5)
