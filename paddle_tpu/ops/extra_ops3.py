@@ -790,18 +790,248 @@ def create_custom_reader(ctx):
     if entry is None:
         raise KeyError(f"create_custom_reader: underlying reader "
                        f"{src!r} not registered")
-    deco = None
-    if fn_id is not None:
-        from .host_ops import _PY_FUNC_REGISTRY
-
-        # the registry is a list indexed by the id handed out at
-        # registration time (host_ops.register_py_func)
-        if isinstance(fn_id, int) and 0 <= fn_id < len(_PY_FUNC_REGISTRY):
-            deco = _PY_FUNC_REGISTRY[fn_id]
+    deco = _resolve_py_func(fn_id, "create_custom_reader",
+                            required=False)
 
     def factory():
         for batch in entry["factory"]():
             yield deco(batch) if deco is not None else batch
+
+    register_host_reader(dst, factory)
+    return {}
+
+
+# --------------------------------------------------------------------------
+# reader-op family (reference operators/reader/): each create_* op
+# builds or decorates a host reader in the _HOST_READERS registry; the
+# `read` op above pops batches through an ordered io_callback. The
+# reference's C++ ReaderHolder chain (shuffle -> batch -> double-buffer
+# wrapping a file/py reader, reader/reader_op_registry.cc) maps 1:1
+# onto generator decoration here -- the TPU-side difference is that
+# batches enter the compiled step through the io_callback host bridge
+# instead of a blocking-queue LoDTensor holder.
+# --------------------------------------------------------------------------
+def _resolve_py_func(fn_id, who, required):
+    """Look up a host_ops py_func id; raise on an invalid id instead of
+    silently degrading to raw records."""
+    if fn_id is None:
+        if required:
+            raise ValueError(f"{who}: a parser_id attr is required")
+        return None
+    from .host_ops import _PY_FUNC_REGISTRY
+
+    if not (isinstance(fn_id, int)
+            and 0 <= fn_id < len(_PY_FUNC_REGISTRY)):
+        raise ValueError(f"{who}: parser/decorator id {fn_id!r} is not "
+                         f"a registered py_func id")
+    return _PY_FUNC_REGISTRY[fn_id]
+
+
+def _scan_recordio(path, parser):
+    """Yield (parsed) records from one recordio file, closing the
+    native scanner on exhaustion OR early generator abandonment."""
+    from .. import native
+
+    scanner = native.RecordIOScanner(path)
+    try:
+        for rec in scanner:
+            yield parser(rec) if parser is not None else (rec,)
+    finally:
+        scanner.close()
+
+
+def _require_reader(name, who):
+    entry = _HOST_READERS.get(name)
+    if entry is None:
+        raise KeyError(f"{who}: underlying reader {name!r} is not "
+                       f"registered (register_host_reader / a "
+                       f"create_* reader op must run first)")
+    return entry
+
+
+@register_op("create_py_reader", differentiable=False)
+def create_py_reader(ctx):
+    """reference reader/create_py_reader_op.cc: reader fed by a Python
+    generator through a blocking queue. Here the queue IS a PyReader
+    instance registered via reader.PyReader.bind_reader_var (or any
+    factory bound with register_host_reader under the Out name's
+    `source` attr)."""
+    src = ctx.attr("source", None)
+    dst = ctx.op.output("Out")[0]
+    if src is None:
+        raise ValueError("create_py_reader: needs a `source` attr "
+                         "naming a registered host reader")
+    entry = _require_reader(src, "create_py_reader")
+    register_host_reader(dst, entry["factory"])
+    return {}
+
+
+@register_op("create_recordio_file_reader", differentiable=False)
+def create_recordio_file_reader(ctx):
+    """reference reader/create_recordio_file_reader_op.cc: stream
+    records from a recordio file (native C++ scanner,
+    native/src/recordio.cc). Records are raw bytes; attr
+    `parser_id` may name a py_func (host_ops) that maps
+    bytes -> tuple of arrays (e.g. a MultiSlotDataFeed line parser)."""
+    filename = ctx.attr("filename", None)
+    dst = ctx.op.output("Out")[0]
+    parser = _resolve_py_func(ctx.attr("parser_id", None),
+                              "create_recordio_file_reader",
+                              required=False)
+
+    def factory():
+        yield from _scan_recordio(filename, parser)
+
+    register_host_reader(dst, factory)
+    return {}
+
+
+@register_op("create_shuffle_reader", differentiable=False)
+def create_shuffle_reader(ctx):
+    """reference reader/create_shuffle_reader-era decorator: buffered
+    shuffle with `buffer_size` (readers.shuffle semantics)."""
+    import random as _random
+
+    src = ctx.op.input("UnderlyingReader")[0]
+    dst = ctx.op.output("Out")[0]
+    buf_size = int(ctx.attr("buffer_size", 512))
+    seed = ctx.attr("seed", 0)
+    entry = _require_reader(src, "create_shuffle_reader")
+    # ONE engine shared across passes: re-seeding per factory() call
+    # would replay the identical order every epoch (the reference
+    # shuffle reader keeps its engine state across passes too)
+    rng = _random.Random(seed or None)
+
+    def factory():
+        buf = []
+        for item in entry["factory"]():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+
+    register_host_reader(dst, factory)
+    return {}
+
+
+@register_op("create_batch_reader", differentiable=False)
+def create_batch_reader(ctx):
+    """reference reader/create_batch_reader-era decorator: stack
+    `batch_size` samples (tuples of arrays) into batch arrays."""
+    src = ctx.op.input("UnderlyingReader")[0]
+    dst = ctx.op.output("Out")[0]
+    bsz = int(ctx.attr("batch_size", 1))
+    drop_last = bool(ctx.attr("drop_last", False))
+    entry = _require_reader(src, "create_batch_reader")
+
+    def factory():
+        def emit(batch):
+            return tuple(np.stack([b[i] for b in batch])
+                         for i in range(len(batch[0])))
+
+        batch = []
+        for item in entry["factory"]():
+            batch.append(item)
+            if len(batch) == bsz:
+                yield emit(batch)
+                batch = []
+        if batch and not drop_last:
+            yield emit(batch)  # reference keeps the partial tail batch
+
+    register_host_reader(dst, factory)
+    return {}
+
+
+@register_op("create_multi_pass_reader", differentiable=False)
+def create_multi_pass_reader(ctx):
+    """reference reader/create_multi_pass_reader-era decorator: repeat
+    the underlying reader `pass_num` times (multi-epoch training as
+    one logical pass)."""
+    src = ctx.op.input("UnderlyingReader")[0]
+    dst = ctx.op.output("Out")[0]
+    passes = int(ctx.attr("pass_num", 1))
+    entry = _require_reader(src, "create_multi_pass_reader")
+
+    def factory():
+        for _ in range(passes):
+            yield from entry["factory"]()
+
+    register_host_reader(dst, factory)
+    return {}
+
+
+@register_op("create_double_buffer_reader", differentiable=False)
+def create_double_buffer_reader(ctx):
+    """reference reader/create_double_buffer_reader_op.cc (async H2D
+    staging, reader/buffered_reader.cc): a daemon thread prefetches
+    into a bounded queue so host parsing overlaps device steps."""
+    import queue as _queue
+    import threading
+
+    src = ctx.op.input("UnderlyingReader")[0]
+    dst = ctx.op.output("Out")[0]
+    depth = int(ctx.attr("buffer_size", 2))
+    entry = _require_reader(src, "create_double_buffer_reader")
+
+    def factory():
+        q = _queue.Queue(maxsize=depth)
+        DONE = object()
+        stop = threading.Event()
+
+        def put(item):
+            # bounded put that gives up if the consumer abandoned the
+            # generator (otherwise the fill thread blocks forever)
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def fill():
+            try:
+                for item in entry["factory"]():
+                    if not put(item):
+                        return
+                put(DONE)
+            except BaseException as e:  # surfaced to the consumer --
+                # swallowing it would silently truncate the epoch
+                put(e)
+
+        threading.Thread(target=fill, daemon=True).start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    register_host_reader(dst, factory)
+    return {}
+
+
+@register_op("open_files", differentiable=False)
+def open_files(ctx):
+    """reference reader/open_files_op.cc: multi-file reader -- records
+    from each recordio file in `file_names` streamed in order (the
+    reference's thread pool becomes the double-buffer decorator when
+    overlap is wanted)."""
+    files = list(ctx.attr("file_names", []))
+    dst = ctx.op.output("Out")[0]
+    parser = _resolve_py_func(ctx.attr("parser_id", None), "open_files",
+                              required=False)
+
+    def factory():
+        for fn in files:
+            yield from _scan_recordio(fn, parser)
 
     register_host_reader(dst, factory)
     return {}

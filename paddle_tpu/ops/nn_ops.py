@@ -413,7 +413,8 @@ def _swce_grad_kernel(ctx):
         if routed is not None:
             l2, lab1 = routed
             dx = pallas_xent.xent_backward(
-                l2, lab1, dloss.reshape(-1), eps=eps)
+                l2, lab1, dloss.reshape(-1), eps=eps,
+                ignore_index=ctx.attr("ignore_index", -100))
             return {"Logits@GRAD": dx.reshape(logits.shape)}
     lf = logits.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(lf, axis=-1, keepdims=True)
@@ -427,6 +428,10 @@ def _swce_grad_kernel(ctx):
     lab = label.astype(jnp.int32)
     if lab.ndim == logits.ndim:
         lab = lab[..., 0]
+    ignore = ctx.attr("ignore_index", -100)
+    valid = (lab != ignore)[..., None]
+    dloss = jnp.where(valid, dloss, 0.0)
+    p_scaled = jnp.where(valid, p_scaled, 0.0)
     if eps:
         grad = p_scaled - (eps / vocab) * dloss
         hit = (1.0 - eps) * dloss
@@ -463,7 +468,8 @@ def softmax_with_cross_entropy(ctx):
         if routed is not None:
             l2, lab1 = routed
             loss_flat, lse_flat = pallas_xent.xent_forward(
-                l2, lab1, eps=eps)
+                l2, lab1, eps=eps,
+                ignore_index=ctx.attr("ignore_index", -100))
             loss = loss_flat.reshape(logits.shape[:-1] + (1,))
             # Softmax output stays a jnp expression off the pallas lse:
             # XLA dead-codes it when (as in every model here) nothing
@@ -485,13 +491,17 @@ def softmax_with_cross_entropy(ctx):
         lab = label.astype(jnp.int32)
         if lab.ndim == logits.ndim:
             lab = lab[..., 0]
-        picked = jnp.take_along_axis(lf, lab[..., None], axis=-1)
+        ignore = ctx.attr("ignore_index", -100)
+        valid = lab != ignore
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(lf, safe[..., None], axis=-1)
         loss = lse - picked
         if eps:
             # smoothed target (1-eps)*onehot + eps/V without the [N,V]
             # one-hot: mean_j(lse - logits_j) = lse - mean(logits)
             uniform = lse - jnp.mean(lf, axis=-1, keepdims=True)
             loss = (1.0 - eps) * loss + eps * uniform
+        loss = jnp.where(valid[..., None], loss, 0.0)
     sm = jnp.exp(lf - lse)
     return {"Loss": loss, "Softmax": sm}
 

@@ -43,7 +43,7 @@ def usable(logits2d, label1d) -> bool:
 # ---------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------
-def _fwd_kernel(x_ref, lab_ref, loss_ref, lse_ref, *, eps, v):
+def _fwd_kernel(x_ref, lab_ref, loss_ref, lse_ref, *, eps, v, ignore):
     x = x_ref[...].astype(jnp.float32)          # [bn, V]
     bn = x.shape[0]
     m = jnp.max(x, axis=1)
@@ -56,17 +56,20 @@ def _fwd_kernel(x_ref, lab_ref, loss_ref, lse_ref, *, eps, v):
     if eps:
         uniform = lse - jnp.mean(x, axis=1)
         loss = (1.0 - eps) * loss + eps * uniform
-    loss_ref[..., 0] = loss
+    # ignore_index rows contribute 0 loss (reference
+    # softmax_with_cross_entropy_op.h hard-label semantics)
+    loss_ref[..., 0] = jnp.where(lab == ignore, 0.0, loss)
     lse_ref[..., 0] = lse
 
 
-def xent_forward(logits2d, label1d, eps=0.0):
+def xent_forward(logits2d, label1d, eps=0.0, ignore_index=-100):
     """bf16/f32 [N,V] + int32 [N] -> (loss f32 [N], lse f32 [N])."""
     from jax.experimental import pallas as pl
 
     n, v = logits2d.shape
     bn = _ROW_BLOCK
-    kernel = functools.partial(_fwd_kernel, eps=float(eps), v=v)
+    kernel = functools.partial(_fwd_kernel, eps=float(eps), v=v,
+                               ignore=int(ignore_index))
     # per-row vectors ride as [N,1]: rank-1 blocks of bn<128 rows are
     # rejected by the TPU lowering (lane dim must be full or 128-mult)
     loss, lse = pl.pallas_call(
@@ -92,7 +95,7 @@ def xent_forward(logits2d, label1d, eps=0.0):
 # ---------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------
-def _bwd_kernel(x_ref, lab_ref, g_ref, dx_ref, *, eps, v):
+def _bwd_kernel(x_ref, lab_ref, g_ref, dx_ref, *, eps, v, ignore):
     x = x_ref[...].astype(jnp.float32)
     bn = x.shape[0]
     m = jnp.max(x, axis=1)
@@ -104,16 +107,19 @@ def _bwd_kernel(x_ref, lab_ref, g_ref, dx_ref, *, eps, v):
     onehot = (cols == lab[:, None]).astype(jnp.float32)
     tgt = (1.0 - eps) * onehot + (eps / v if eps else 0.0)
     g = g_ref[..., 0].astype(jnp.float32)
+    g = jnp.where(lab == ignore, 0.0, g)  # ignored rows: zero grad
     dx_ref[...] = ((sm - tgt) * g[:, None]).astype(dx_ref.dtype)
 
 
-def xent_backward(logits2d, label1d, dloss1d, eps=0.0):
+def xent_backward(logits2d, label1d, dloss1d, eps=0.0,
+                  ignore_index=-100):
     """dlogits in the logits' storage dtype; lse recomputed on-chip."""
     from jax.experimental import pallas as pl
 
     n, v = logits2d.shape
     bn = _ROW_BLOCK
-    kernel = functools.partial(_bwd_kernel, eps=float(eps), v=v)
+    kernel = functools.partial(_bwd_kernel, eps=float(eps), v=v,
+                               ignore=int(ignore_index))
     return pl.pallas_call(
         kernel,
         grid=(n // bn,),

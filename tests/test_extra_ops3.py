@@ -828,3 +828,211 @@ if __name__ == "__main__":
     import pytest as _pytest
 
     _pytest.main([__file__, "-q"])
+
+
+def test_reader_op_family_pipeline():
+    """recordio file -> parse -> shuffle -> batch -> multi_pass ->
+    double_buffer -> read op (reference reader op chain,
+    operators/reader/)."""
+    import tempfile, os as _os
+
+    from paddle_tpu import native
+    from paddle_tpu.core.program import Operator
+    from paddle_tpu.core.registry import run_op
+    from paddle_tpu.ops.extra_ops3 import _HOST_READERS
+    from paddle_tpu.ops.host_ops import register_py_func
+
+    prog = fluid.Program()
+    block = prog.global_block
+
+    with tempfile.TemporaryDirectory() as d:
+        path = _os.path.join(d, "data.recordio")
+        w = native.RecordIOWriter(path)
+        for i in range(8):
+            w.write(bytes([i]))
+        w.close()
+
+        pid = register_py_func(
+            lambda rec: (np.full((2,), rec[0], np.float32),))
+
+        def op(type_, ins, outs, attrs):
+            o = Operator(block, type_, ins, outs, attrs)
+            run_op(o, {n: np.zeros(1, np.float32)
+                       for ns in ins.values() for n in ns})
+
+        op("create_recordio_file_reader", {}, {"Out": ["file_r"]},
+           {"filename": path, "parser_id": pid})
+        op("create_shuffle_reader", {"UnderlyingReader": ["file_r"]},
+           {"Out": ["shuf_r"]}, {"buffer_size": 4, "seed": 7})
+        op("create_batch_reader", {"UnderlyingReader": ["shuf_r"]},
+           {"Out": ["batch_r"]}, {"batch_size": 2})
+        op("create_multi_pass_reader", {"UnderlyingReader": ["batch_r"]},
+           {"Out": ["mp_r"]}, {"pass_num": 2})
+        op("create_double_buffer_reader", {"UnderlyingReader": ["mp_r"]},
+           {"Out": ["db_r"]}, {"buffer_size": 2})
+
+        batches = list(_HOST_READERS["db_r"]["factory"]())
+        # 8 samples -> 4 batches/pass -> 2 passes
+        assert len(batches) == 8
+        assert batches[0][0].shape == (2, 2)
+        seen = sorted({int(v) for b in batches for v in b[0].ravel()})
+        assert seen == list(range(8))
+
+        # the read op pops through the io_callback bridge
+        block.create_var(name="vals", shape=(2, 2), dtype="float32")
+        rd = Operator(block, "read", {"Reader": ["db_r"]},
+                      {"Out": ["vals"]}, {})
+        env = {"db_r": np.zeros(1, np.float32)}
+        run_op(rd, env)
+        assert np.asarray(env["vals"]).shape == (2, 2)
+
+
+def test_create_py_reader_and_open_files():
+    import tempfile, os as _os
+
+    from paddle_tpu import native
+    from paddle_tpu.core.program import Operator
+    from paddle_tpu.core.registry import run_op
+    from paddle_tpu.ops.extra_ops3 import (_HOST_READERS,
+                                           register_host_reader)
+
+    prog = fluid.Program()
+    block = prog.global_block
+
+    batches = [(np.full((3,), i, np.float32),) for i in range(2)]
+    register_host_reader("gen_src", lambda: iter(batches))
+    op = Operator(block, "create_py_reader", {}, {"Out": ["py_r"]},
+                  {"source": "gen_src"})
+    run_op(op, {})
+    got = list(_HOST_READERS["py_r"]["factory"]())
+    assert len(got) == 2
+
+    with tempfile.TemporaryDirectory() as d:
+        paths = []
+        for f in range(2):
+            p = _os.path.join(d, f"f{f}.recordio")
+            w = native.RecordIOWriter(p)
+            for i in range(3):
+                w.write(bytes([f * 3 + i]))
+            w.close()
+            paths.append(p)
+        op = Operator(block, "open_files", {}, {"Out": ["files_r"]},
+                      {"file_names": paths})
+        run_op(op, {})
+        recs = [r[0] for r in _HOST_READERS["files_r"]["factory"]()]
+        assert [b[0] for b in recs] == list(range(6))
+
+
+def test_batch_reader_keeps_partial_tail_and_shuffle_reshuffles():
+    from paddle_tpu.core.program import Operator
+    from paddle_tpu.core.registry import run_op
+    from paddle_tpu.ops.extra_ops3 import (_HOST_READERS,
+                                           register_host_reader)
+
+    prog = fluid.Program()
+    block = prog.global_block
+    samples = [(np.full((1,), i, np.float32),) for i in range(9)]
+    register_host_reader("src9", lambda: iter(samples))
+    op = Operator(block, "create_batch_reader",
+                  {"UnderlyingReader": ["src9"]}, {"Out": ["b9"]},
+                  {"batch_size": 2})
+    run_op(op, {"src9": np.zeros(1, np.float32)})
+    got = list(_HOST_READERS["b9"]["factory"]())
+    assert len(got) == 5 and got[-1][0].shape == (1, 1)  # tail kept
+
+    op = Operator(block, "create_batch_reader",
+                  {"UnderlyingReader": ["src9"]}, {"Out": ["b9d"]},
+                  {"batch_size": 2, "drop_last": True})
+    run_op(op, {"src9": np.zeros(1, np.float32)})
+    assert len(list(_HOST_READERS["b9d"]["factory"]())) == 4
+
+    # shuffle order must differ across passes (persistent engine)
+    register_host_reader("src16", lambda: iter(
+        [(np.full((1,), i, np.float32),) for i in range(16)]))
+    op = Operator(block, "create_shuffle_reader",
+                  {"UnderlyingReader": ["src16"]}, {"Out": ["sh16"]},
+                  {"buffer_size": 16, "seed": 11})
+    run_op(op, {"src16": np.zeros(1, np.float32)})
+    pass1 = [int(x[0][0]) for x in _HOST_READERS["sh16"]["factory"]()]
+    pass2 = [int(x[0][0]) for x in _HOST_READERS["sh16"]["factory"]()]
+    assert sorted(pass1) == sorted(pass2) == list(range(16))
+    assert pass1 != pass2
+
+
+def test_double_buffer_propagates_reader_errors():
+    from paddle_tpu.core.program import Operator
+    from paddle_tpu.core.registry import run_op
+    from paddle_tpu.ops.extra_ops3 import (_HOST_READERS,
+                                           register_host_reader)
+
+    def bad():
+        yield (np.zeros((1,), np.float32),)
+        raise IOError("corrupt record")
+
+    register_host_reader("bad_src", bad)
+    prog = fluid.Program()
+    op = Operator(prog.global_block, "create_double_buffer_reader",
+                  {"UnderlyingReader": ["bad_src"]}, {"Out": ["db_bad"]},
+                  {"buffer_size": 2})
+    run_op(op, {"bad_src": np.zeros(1, np.float32)})
+    it = _HOST_READERS["db_bad"]["factory"]()
+    next(it)
+    with pytest.raises(IOError, match="corrupt record"):
+        next(it)
+
+
+def test_swce_ignore_index_paths_agree():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.program import Operator
+    from paddle_tpu.core.registry import run_op
+    from paddle_tpu.core.program import grad_var_name
+    from paddle_tpu.core.registry import make_grad_ops
+    from paddle_tpu.ops.pallas import attention as fa
+
+    fa.force_interpret(True)
+    try:
+        import os
+
+        n, v = 64, 256
+        r = np.random.RandomState(8)
+        logits = r.randn(n, v).astype(np.float32)
+        label = r.randint(0, v, (n, 1)).astype(np.int64)
+        label[::4] = -100  # ignored rows
+        prog = fluid.Program()
+        block = prog.global_block
+        block.create_var(name="lg", shape=(n, v), dtype="float32")
+        block.create_var(name="lb", shape=(n, 1), dtype="int64")
+        op = Operator(block, "softmax_with_cross_entropy",
+                      {"Logits": ["lg"], "Label": ["lb"]},
+                      {"Loss": ["loss"], "Softmax": ["sm"]},
+                      {"ignore_index": -100})
+
+        def run_path(disable):
+            if disable:
+                os.environ["PADDLE_TPU_DISABLE_PALLAS_XENT"] = "1"
+            try:
+                env = {"lg": jnp.asarray(logits),
+                       "lb": jnp.asarray(label)}
+                run_op(op, env)
+                genv = dict(env)
+                genv[grad_var_name("loss")] = jnp.ones((n, 1),
+                                                       jnp.float32)
+                genv[grad_var_name("sm")] = jnp.zeros((n, v),
+                                                      jnp.float32)
+                for gop in make_grad_ops(op, no_grad_set={"lb"}):
+                    run_op(gop, genv)
+                return (np.asarray(env["loss"]),
+                        np.asarray(genv[grad_var_name("lg")]))
+            finally:
+                os.environ.pop("PADDLE_TPU_DISABLE_PALLAS_XENT", None)
+
+        loss_p, grad_p = run_path(disable=False)
+        loss_j, grad_j = run_path(disable=True)
+        assert np.all(loss_p[::4] == 0.0)
+        assert np.all(grad_p[::4] == 0.0)
+        np.testing.assert_allclose(loss_p, loss_j, atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(grad_p, grad_j, atol=1e-5, rtol=1e-5)
+    finally:
+        fa.force_interpret(False)
