@@ -9,15 +9,19 @@ from paddle_tpu.models import label_semantic_roles as srl
 from paddle_tpu.models import recommender as rec
 
 
-def _run(prog, startup, cost, feeds, steps=12):
+def _run(prog, startup, cost, feeds, steps=12, scope=None,
+         return_exe=False):
+    """Shared book-model train loop (also used by test_book_models2)."""
     exe = fluid.Executor(fluid.CPUPlace())
-    scope = fluid.Scope()
-    exe.run(startup, scope=scope)
+    if scope is None:
+        scope = fluid.Scope()  # fresh per call (book1 tests rely on it)
+    kw = {"scope": scope}
+    exe.run(startup, **kw)
     losses = []
     for _ in range(steps):
-        l, = exe.run(prog, feed=feeds, fetch_list=[cost], scope=scope)
+        l, = exe.run(prog, feed=feeds, fetch_list=[cost], **kw)
         losses.append(float(np.asarray(l).reshape(-1)[0]))
-    return losses
+    return (exe, losses) if return_exe else losses
 
 
 class TestRecommenderSystem:

@@ -1,0 +1,117 @@
+"""Book-model parity, part 2: fit_a_line, image_classification (vgg),
+machine_translation / rnn_encoder_decoder.
+
+Parity model: reference tests/book/test_fit_a_line.py,
+test_image_classification.py, test_machine_translation.py,
+test_rnn_encoder_decoder.py -- each trains a real small model to a
+falling loss, exports with save_inference_model, reloads and infers
+(the reference's checkpoint-round-trip double duty, SURVEY.md §4.4).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+from test_book_models import _run
+
+
+def _train(prog, startup, cost, feeds, steps, scope=None):
+    return _run(prog, startup, cost, feeds, steps, scope=scope,
+                return_exe=True)
+
+
+class TestFitALine:
+    """reference book/test_fit_a_line.py: 13-feature linear
+    regression (UCI housing shape), SGD."""
+
+    def test_trains_and_roundtrips(self, tmp_path):
+        rng = np.random.RandomState(0)
+        true_w = rng.randn(13, 1).astype("float32")
+        x_np = rng.rand(64, 13).astype("float32")
+        y_np = x_np @ true_w + 0.1
+
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=(13,), dtype="float32")
+            y = fluid.layers.data("y", shape=(1,), dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            cost = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            test_prog = prog.clone(for_test=True)
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(cost)
+
+        fluid._reset_global_scope()
+        # save/load_inference_model read params from the global scope
+        exe, losses = _train(prog, startup, cost.name,
+                             {"x": x_np, "y": y_np}, 60,
+                             scope=fluid.global_scope())
+        assert losses[-1] < losses[0] * 0.1, losses[::20]
+
+        pred_before = np.asarray(exe.run(
+            test_prog, feed={"x": x_np, "y": y_np},
+            fetch_list=[pred.name])[0])
+        path = str(tmp_path / "fit_a_line")
+        fluid.save_inference_model(
+            path, ["x"], [test_prog.global_block.var(pred.name)], exe,
+            main_program=test_prog)
+        prog2, feed_names, fetch_names = fluid.load_inference_model(
+            path, exe)
+        pred_after = np.asarray(exe.run(
+            prog2, feed={feed_names[0]: x_np},
+            fetch_list=fetch_names)[0])
+        np.testing.assert_allclose(pred_after, pred_before,
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestImageClassificationVGG:
+    """reference book/test_image_classification.py (vgg flavor),
+    cifar-shaped 3x32x32 input (vgg's 5 pool halvings need >=32)."""
+
+    def test_trains(self):
+        from paddle_tpu.models import vgg
+
+        rng = np.random.RandomState(1)
+        prog, startup, cost = vgg.build_program(
+            class_dim=4, image_shape=(3, 32, 32), lr=0.01)
+        img = rng.rand(8, 3, 32, 32).astype("float32")
+        lbl = rng.randint(0, 4, (8, 1)).astype("int64")
+        scope = fluid.Scope()
+        _, losses = _train(prog, startup, cost,
+                           {"img": img, "label": lbl}, 15, scope)
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+class TestMachineTranslation:
+    """reference book/test_machine_translation.py +
+    test_rnn_encoder_decoder.py: gru seq2seq with attention-era
+    decode; trains with falling loss."""
+
+    def test_trains(self):
+        from paddle_tpu.models import machine_translation as mt
+
+        rng = np.random.RandomState(2)
+        prog, startup, cost = mt.build_program(
+            src_dict_dim=60, tgt_dict_dim=60)
+        b, t = 8, 10
+        feeds = {
+            "src_word_id": rng.randint(1, 60, (b, t)).astype("int64"),
+            "target_language_word":
+                rng.randint(1, 60, (b, t)).astype("int64"),
+            "target_language_next_word":
+                rng.randint(1, 60, (b, t)).astype("int64"),
+            "src_word_id@SEQ_LEN":
+                rng.randint(3, t + 1, (b,)).astype("int32"),
+            "target_language_word@SEQ_LEN":
+                rng.randint(3, t + 1, (b,)).astype("int32"),
+        }
+        missing = [n for n in feeds if n not in prog.global_block.vars]
+        assert not missing, f"model builder renamed feeds: {missing}"
+        scope = fluid.Scope()
+        _, losses = _train(prog, startup, cost, feeds, 12, scope)
+        assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
