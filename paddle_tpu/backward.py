@@ -65,10 +65,115 @@ def _ensure_grad_var(block, fwd_name: str, grad_name: str):
         persistable=False)
 
 
+def _recompute_plan(block, op_path, checkpoints, loss_name):
+    """Segment recompute (activation checkpointing): forward vars NOT
+    in the checkpoint set are re-produced inside the backward region
+    instead of being kept live from forward to backward.
+
+    Parity: the reference line carries this as
+    multi_batch-era RecomputeOptimizer /
+    _append_backward_ops_with_checkpoints_ (post-v1.3 fluid); on TPU
+    it is THE lever for HBM-bound configs (PERF.md: transformer
+    batch-256 OOMs on 16 GB without it). Returns
+    (segments, saved_names): segments in forward order, each a list of
+    ops; every var produced inside a segment and not `saved` gets a
+    per-segment @RECOMP clone emitted just before that segment's grad
+    ops, so XLA's liveness sees checkpoint-sized residuals only.
+    """
+    ckpt = {c.name if hasattr(c, "name") else c for c in checkpoints}
+    saved = set(ckpt)
+    saved.add(loss_name)
+    for var in block.vars.values():
+        # params/persistables and feeds are always resident
+        if var.persistable or var.is_data:
+            saved.add(var.name)
+    segments = []
+    cur = []
+    for op in op_path:
+        cur.append(op)
+        if any(o in ckpt for o in op.output_arg_names):
+            segments.append(cur)
+            cur = []
+    if cur:
+        segments.append(cur)
+    # a non-saved var consumed OUTSIDE its producing segment (a skip
+    # connection bypassing a checkpoint) stays live anyway -- treat it
+    # as saved so its consumers read the original rather than chaining
+    # recomputes across segments
+    producer_seg = {}
+    for i, seg in enumerate(segments):
+        for op in seg:
+            for o in op.output_arg_names:
+                producer_seg.setdefault(o, i)
+    for i, seg in enumerate(segments):
+        for op in seg:
+            for n in op.input_arg_names:
+                ps = producer_seg.get(n)
+                if ps is not None and ps != i:
+                    saved.add(n)
+    return segments, saved
+
+
+def _emit_recompute(block, segment, saved, seg_idx):
+    """Clone `segment`'s ops re-deriving its non-saved activations from
+    saved vars; returns {orig_name: recomputed_name}.
+
+    Every clone input coming from OUTSIDE the recompute region is
+    routed through an optimization_barrier op: without it the clones
+    are byte-identical HLO to the forward ops and XLA's CSE merges
+    them back, silently undoing the memory saving (the same reason
+    jax.remat wraps rematerialized computations in barriers)."""
+    remap = {}
+    barriered = {}
+
+    def _bar(name):
+        if name in barriered:
+            return barriered[name]
+        bname = unique_name.generate(f"{name}@BAR{seg_idx}")
+        bop = Operator(block, "optimization_barrier",
+                       {"X": [name]}, {"Out": [bname]},
+                       {OP_ROLE_KEY: "backward"})
+        block.ops.append(bop)
+        _ensure_grad_var(block, name, bname)
+        barriered[name] = bname
+        return bname
+
+    for op in segment:
+        out_renames = {}
+        for n in op.output_arg_names:
+            if n in saved:
+                continue
+            out_renames[n] = unique_name.generate(
+                f"{n}@RECOMP{seg_idx}")
+        if not out_renames:
+            continue
+        clone = Operator(
+            block, op.type,
+            {slot: [remap.get(n, _bar(n) if n != EMPTY_VAR else n)
+                    for n in names]
+             for slot, names in op.inputs.items()},
+            {slot: [out_renames.get(n, n) for n in names]
+             for slot, names in op.outputs.items()},
+            dict(op.attrs))
+        # same structural uid => sampling ops (dropout) re-toss the
+        # IDENTICAL noise in the recompute, keeping fwd/bwd consistent
+        clone._uid = op._uid
+        clone.attrs[OP_ROLE_KEY] = "backward"
+        block.ops.append(clone)
+        for orig, renamed in out_renames.items():
+            _ensure_grad_var(block, orig, renamed)
+            remap[orig] = renamed
+    return remap
+
+
 def append_backward(loss: Variable, parameter_list=None,
                     no_grad_set=None, callbacks=None,
                     checkpoints=None):
-    """Append grad ops for `loss`; returns [(param, grad_var)] pairs."""
+    """Append grad ops for `loss`; returns [(param, grad_var)] pairs.
+
+    `checkpoints`: optional list of forward vars (or names) to keep;
+    activations between consecutive checkpoints are recomputed in the
+    backward region (see _recompute_plan)."""
     block = loss.block
     program = block.program
     no_grad = _collect_no_grad(block, no_grad_set)
@@ -94,16 +199,54 @@ def append_backward(loss: Variable, parameter_list=None,
 
     produced: Set[str] = {loss_grad}
 
-    for op in reversed(op_path):
+    if checkpoints:
+        segments, saved = _recompute_plan(block, op_path, checkpoints,
+                                          loss.name)
+    else:
+        segments, saved = [op_path], None
+
+    for seg_idx in range(len(segments) - 1, -1, -1):
+        segment = segments[seg_idx]
+        remap = {}
+        if saved is not None:
+            remap = _emit_recompute(block, segment, saved, seg_idx)
+        _backward_over(segment, remap, block, no_grad, produced)
+
+    program._version += 1
+
+    # assemble (param, grad) list
+    if parameter_list is not None:
+        params = []
+        for p in parameter_list:
+            params.append(p if isinstance(p, Variable)
+                          else program.global_block.var(p))
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    param_grads = []
+    for p in params:
+        g = grad_var_name(p.name)
+        if g in produced:
+            param_grads.append((p, block.vars[g]))
+    return param_grads
+
+
+def _backward_over(ops, remap, block, no_grad, produced):
+    """Emit grad ops for `ops` reversed; `remap` redirects forward-
+    activation reads to recomputed clones (empty when not
+    checkpointing)."""
+    for op in reversed(ops):
         grad_ops = make_grad_ops(op, no_grad_set=no_grad)
         for gop in grad_ops:
             gop.attrs.setdefault(OP_ROLE_KEY, "backward")
-            # rewrite grad inputs that were never produced -> @EMPTY@
             for slot, names in gop.inputs.items():
-                if not slot.endswith(GRAD_SUFFIX):
-                    continue
-                gop.inputs[slot] = [
-                    n if n in produced else EMPTY_VAR for n in names]
+                if slot.endswith(GRAD_SUFFIX):
+                    # rewrite grad inputs never produced -> @EMPTY@
+                    gop.inputs[slot] = [
+                        n if n in produced else EMPTY_VAR
+                        for n in names]
+                elif remap:
+                    # forward-activation reads go to the recompute
+                    gop.inputs[slot] = [remap.get(n, n) for n in names]
             # handle duplicate grad production: accumulate with sum
             renames = []
             for slot, names in gop.outputs.items():
@@ -131,23 +274,6 @@ def append_backward(loss: Variable, parameter_list=None,
                     {OP_ROLE_KEY: "backward"})
                 block.ops.append(sum_op)
                 produced.add(orig)
-
-    program._version += 1
-
-    # assemble (param, grad) list
-    if parameter_list is not None:
-        params = []
-        for p in parameter_list:
-            params.append(p if isinstance(p, Variable)
-                          else program.global_block.var(p))
-    else:
-        params = [p for p in program.all_parameters() if p.trainable]
-    param_grads = []
-    for p in params:
-        g = grad_var_name(p.name)
-        if g in produced:
-            param_grads.append((p, block.vars[g]))
-    return param_grads
 
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None):

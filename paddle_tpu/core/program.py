@@ -194,7 +194,11 @@ class Operator:
             else:
                 attrs[k] = v
         return {"type": self.type, "inputs": self.inputs,
-                "outputs": self.outputs, "attrs": attrs}
+                "outputs": self.outputs, "attrs": attrs,
+                # structural uid: sampling ops derive their PRNG salt
+                # from it, and recompute clones copy it so re-tossed
+                # noise matches (backward.py _emit_recompute)
+                "uid": self._uid}
 
     @staticmethod
     def from_dict(block, d, program):
@@ -209,7 +213,10 @@ class Operator:
                 attrs[k] = arr
             else:
                 attrs[k] = v
-        return Operator(block, d["type"], d["inputs"], d["outputs"], attrs)
+        op = Operator(block, d["type"], d["inputs"], d["outputs"], attrs)
+        if "uid" in d:
+            op._uid = d["uid"]
+        return op
 
 
 class Block:
@@ -403,8 +410,10 @@ class Program:
                 for k, v in attrs.items():
                     if isinstance(v, Block):
                         attrs[k] = p.blocks[v.idx]
-                nb.ops.append(Operator(nb, op.type, op.inputs, op.outputs,
-                                       attrs))
+                nop = Operator(nb, op.type, op.inputs, op.outputs,
+                               attrs)
+                nop._uid = op._uid  # keep PRNG salts stable (see to_dict)
+                nb.ops.append(nop)
         p._parameters = {n: p.global_block.vars[n]
                          for n in self._parameters if n in p.global_block.vars}
         p.current_block_idx = 0

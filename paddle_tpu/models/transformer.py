@@ -104,19 +104,26 @@ def _embed(ids, vocab_size, d_model, max_len, dropout_rate, is_test,
 def transformer(src_ids, tgt_ids, label, src_vocab=30000, tgt_vocab=30000,
                 max_len=256, d_model=512, n_heads=8, n_layers=6,
                 d_inner=2048, dropout_rate=0.1, is_test=False,
-                label_smooth_eps=0.1):
+                label_smooth_eps=0.1, checkpoints=None):
     """Returns (avg_cost, logits). src_ids/tgt_ids: [B,T] int64;
-    label: [B,T] int64 (next-token targets)."""
+    label: [B,T] int64 (next-token targets). When `checkpoints` is a
+    list, each layer output is appended to it (for
+    RecomputeOptimizer-style activation checkpointing)."""
+    ck = checkpoints
     enc = _embed(src_ids, src_vocab, d_model, max_len, dropout_rate,
                  is_test, "src_word_emb")
     for _ in range(n_layers):
         enc = encoder_layer(enc, d_model, n_heads, d_inner,
                             dropout_rate, is_test)
+        if ck is not None:
+            ck.append(enc)
     dec = _embed(tgt_ids, tgt_vocab, d_model, max_len, dropout_rate,
                  is_test, "tgt_word_emb")
     for _ in range(n_layers):
         dec = decoder_layer(dec, enc, d_model, n_heads, d_inner,
                             dropout_rate, is_test)
+        if ck is not None:
+            ck.append(dec)
     logits = layers.fc(dec, tgt_vocab, num_flatten_dims=2,
                        bias_attr=False)
     # fused smoothing: same math as one_hot+label_smooth+soft-label CE
@@ -131,7 +138,8 @@ def transformer(src_ids, tgt_ids, label, src_vocab=30000, tgt_vocab=30000,
 def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
                   n_layers=6, d_inner=2048, vocab=30000,
                   learning_rate=2.0, warmup_steps=4000,
-                  with_optimizer=True, dropout_rate=0.1):
+                  with_optimizer=True, dropout_rate=0.1,
+                  recompute=False):
     import paddle_tpu as fluid
 
     main = fluid.Program()
@@ -140,15 +148,19 @@ def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
         src = layers.data("src_ids", shape=[seq_len], dtype="int64")
         tgt = layers.data("tgt_ids", shape=[seq_len], dtype="int64")
         label = layers.data("label", shape=[seq_len], dtype="int64")
+        ck = [] if recompute else None
         avg_cost, logits = transformer(
             src, tgt, label, src_vocab=vocab, tgt_vocab=vocab,
             max_len=max(seq_len, 256), d_model=d_model, n_heads=n_heads,
             n_layers=n_layers, d_inner=d_inner,
-            dropout_rate=dropout_rate)
+            dropout_rate=dropout_rate, checkpoints=ck)
         if with_optimizer:
             lr = layers.learning_rate_scheduler.noam_decay(
                 d_model, warmup_steps)
             opt = fluid.optimizer.Adam(
                 learning_rate=lr, beta1=0.9, beta2=0.997, epsilon=1e-9)
+            if recompute:
+                opt = fluid.optimizer.RecomputeOptimizer(opt)
+                opt._set_checkpoints(ck)
             opt.minimize(avg_cost)
     return main, startup, avg_cost

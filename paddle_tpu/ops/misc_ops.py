@@ -426,3 +426,12 @@ def psroi_pool(ctx):
                   pyr[:, None], pxr[None, :]]
 
     return jax.vmap(per_roi)(py, px)
+
+
+@register_op("optimization_barrier", differentiable=False)
+def optimization_barrier(ctx):
+    """Identity that XLA may not CSE/hoist across. Emitted by the
+    recompute planner (backward.py _emit_recompute) at segment
+    boundaries so rematerialized clones are not merged back into the
+    forward subgraph -- the same mechanism jax.remat relies on."""
+    return {"Out": jax.lax.optimization_barrier(ctx.input("X"))}

@@ -25,7 +25,7 @@ __all__ = ["SGD", "Momentum", "Adagrad", "Adam", "Adamax",
            "DecayedAdagradOptimizer", "AdadeltaOptimizer",
            "RMSPropOptimizer", "FtrlOptimizer", "LarsMomentum",
            "LarsMomentumOptimizer", "DGCMomentumOptimizer",
-           "GradientMergeOptimizer", "ModelAverage",
+           "GradientMergeOptimizer", "RecomputeOptimizer", "ModelAverage",
            "ExponentialMovingAverage", "Optimizer"]
 
 
@@ -466,6 +466,72 @@ class DGCMomentumOptimizer(MomentumOptimizer):
         block.append_op("increment",
                         {"X": self._step_var}, {"Out": self._step_var},
                         {"step": 1.0, "op_role": "optimize"})
+
+
+class RecomputeOptimizer(Optimizer):
+    """Activation checkpointing: keep only the listed checkpoint
+    activations across forward->backward; everything between them is
+    recomputed inside the backward region (backward.py
+    _recompute_plan). The HBM lever for memory-bound configs
+    (PERF.md: transformer batch-256 on 16 GB).
+
+    Parity: the reference line ships this as RecomputeOptimizer
+    (post-v1.3 fluid optimizer.py); usage is identical:
+
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.Adam(1e-3))
+        opt._set_checkpoints([layer1_out, layer2_out])
+        opt.minimize(loss)
+    """
+
+    def __init__(self, inner_optimizer):
+        self.__dict__["_inner"] = inner_optimizer  # before super() so
+        # __getattr__ can never recurse on a half-built instance
+        super().__init__(
+            learning_rate=inner_optimizer._learning_rate,
+            regularization=inner_optimizer.regularization)
+        self._checkpoints = None
+
+    def __getattr__(self, name):
+        # expose the wrapped optimizer's interface (reference
+        # RecomputeOptimizer delegates the same way) -- accumulators,
+        # _append_optimize_op, type, etc.
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = list(checkpoints)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        if not self._checkpoints:
+            raise ValueError(
+                "RecomputeOptimizer: call _set_checkpoints([...]) with "
+                "the activations to keep before minimize()")
+        return append_backward(loss, parameter_list, no_grad_set,
+                               callbacks or [error_clip_callback],
+                               checkpoints=self._checkpoints)
+
+    def apply_gradients(self, params_grads):
+        return self._inner.apply_gradients(params_grads)
+
+    # __getattr__ only fires for MISSING attributes; these exist on the
+    # Optimizer base (as raise/no-op stubs), so delegate explicitly --
+    # outer wrappers (GradientMergeOptimizer) drive the inner
+    # optimizer's update rule through them
+    def _append_optimize_op(self, block, param_and_grad):
+        return self._inner._append_optimize_op(block, param_and_grad)
+
+    def _create_accumulators(self, block, parameters):
+        return self._inner._create_accumulators(block, parameters)
+
+    def _finish_update(self, block, parameters_and_grads):
+        return self._inner._finish_update(block, parameters_and_grads)
+
+    def _create_global_learning_rate(self):
+        return self._inner._create_global_learning_rate()
 
 
 class GradientMergeOptimizer(Optimizer):

@@ -1,0 +1,214 @@
+"""Activation-checkpointing (recompute) parity tests.
+
+Parity model: the reference line's RecomputeOptimizer tests
+(test_recompute_optimizer-era): the checkpointed program must produce
+IDENTICAL losses and updates to the plain program -- recompute changes
+memory, never math. Includes a dropout layer so the recomputed noise
+path (same structural op uid -> same mask) is exercised.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build(with_dropout):
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=(16,), dtype="float32")
+        y = fluid.layers.data("y", shape=(1,), dtype="int64")
+        h1 = fluid.layers.fc(x, size=32, act="relu")
+        if with_dropout:
+            h1 = fluid.layers.dropout(
+                h1, 0.3, dropout_implementation="upscale_in_train")
+        c1 = fluid.layers.fc(h1, size=32, act="relu")  # checkpoint 1
+        h2 = fluid.layers.fc(c1, size=32, act="tanh")
+        c2 = fluid.layers.fc(h2, size=32, act="relu")  # checkpoint 2
+        logits = fluid.layers.fc(c2, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+    return prog, startup, loss, (c1, c2)
+
+
+def _train(use_recompute, with_dropout, steps=8):
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    fluid.seed(1234)
+    prog, startup, loss, ckpts = _build(with_dropout)
+    with fluid.program_guard(prog, startup):
+        if use_recompute:
+            opt = fluid.optimizer.RecomputeOptimizer(
+                fluid.optimizer.Adam(learning_rate=0.01))
+            opt._set_checkpoints(list(ckpts))
+        else:
+            opt = fluid.optimizer.Adam(learning_rate=0.01)
+        opt.minimize(loss)
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 16).astype("float32")
+    y = (rng.randint(0, 4, (32, 1))).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(steps):
+        out = exe.run(prog, feed={"x": x, "y": y},
+                      fetch_list=[loss.name])
+        losses.append(float(np.asarray(out[0])))
+    return prog, losses
+
+
+def test_recompute_matches_plain():
+    _, plain = _train(False, with_dropout=False)
+    prog, ck = _train(True, with_dropout=False)
+    np.testing.assert_allclose(ck, plain, atol=1e-6, rtol=1e-6)
+    assert plain[-1] < plain[0]
+    # the backward region actually contains recompute clones
+    types = [op.type for op in prog.global_block.ops]
+    names = [n for op in prog.global_block.ops
+             for n in op.output_arg_names]
+    assert any("@RECOMP" in n for n in names), "no recompute emitted"
+
+
+def test_recompute_matches_plain_with_dropout():
+    """Recomputed dropout must re-toss the IDENTICAL mask (same
+    structural op uid -> same per-step noise)."""
+    _, plain = _train(False, with_dropout=True)
+    _, ck = _train(True, with_dropout=True)
+    np.testing.assert_allclose(ck, plain, atol=1e-6, rtol=1e-6)
+
+
+def test_recompute_requires_checkpoints():
+    import pytest
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=(4,), dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=1))
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.1))
+        with pytest.raises(ValueError, match="checkpoints"):
+            opt.minimize(loss)
+
+
+if __name__ == "__main__":
+    import pytest
+
+    pytest.main([__file__, "-q"])
+
+
+def test_recompute_emits_barriers():
+    """Without optimization_barrier roots, XLA CSE would merge the
+    recompute clones back into the forward graph and the memory
+    saving would silently vanish."""
+    prog, _ = _train(True, with_dropout=False, steps=1)
+    types = [op.type for op in prog.global_block.ops]
+    assert "optimization_barrier" in types
+
+
+def test_recompute_parity_survives_program_clone():
+    """Program.clone must preserve op uids: a cloned recompute program
+    with dropout re-tosses the same masks (salts are uid-derived)."""
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    fluid.seed(77)
+    prog, startup, loss, ckpts = _build(True)
+    with fluid.program_guard(prog, startup):
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.Adam(learning_rate=0.01))
+        opt._set_checkpoints(list(ckpts))
+        opt.minimize(loss)
+    rng = np.random.RandomState(3)
+    x = rng.rand(16, 16).astype("float32")
+    y = rng.randint(0, 4, (16, 1)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    uids = {(i, op.type): op._uid
+            for i, op in enumerate(prog.global_block.ops)}
+    cloned = prog.clone()
+    cuids = {(i, op.type): op._uid
+             for i, op in enumerate(cloned.global_block.ops)}
+    assert uids == cuids
+
+    exe.run(startup)
+    l1 = [float(np.asarray(exe.run(prog, feed={"x": x, "y": y},
+                                   fetch_list=[loss.name])[0]))
+          for _ in range(3)]
+    fluid._reset_global_scope()
+    fluid.seed(77)
+    exe.run(startup)
+    l2 = [float(np.asarray(exe.run(cloned, feed={"x": x, "y": y},
+                                   fetch_list=[loss.name])[0]))
+          for _ in range(3)]
+    np.testing.assert_allclose(l1, l2, atol=1e-6, rtol=1e-6)
+
+
+def test_recompute_with_gradient_merge():
+    """Wrapper combo from the reference line: grad-merge over a
+    recompute-backed inner optimizer."""
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    fluid.seed(5)
+    prog, startup, loss, ckpts = _build(False)
+    with fluid.program_guard(prog, startup):
+        inner = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.05))
+        inner._set_checkpoints(list(ckpts))
+        opt = fluid.optimizer.GradientMergeOptimizer(inner, k_steps=2)
+        opt.minimize(loss)
+    rng = np.random.RandomState(1)
+    x = rng.rand(8, 16).astype("float32")
+    y = rng.randint(0, 4, (8, 1)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = [float(np.asarray(exe.run(prog, feed={"x": x, "y": y},
+                                       fetch_list=[loss.name])[0]))
+              for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_recompute_skip_connection_parity():
+    """A residual read crossing a checkpoint boundary: the bypassed
+    activation is treated as saved (spill) and the math is intact."""
+    from paddle_tpu import unique_name
+
+    def build_and_train(use_ck):
+        fluid._reset_global_scope()
+        unique_name.switch()
+        fluid.seed(9)
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=(16,), dtype="float32")
+            y = fluid.layers.data("y", shape=(1,), dtype="int64")
+            h0 = fluid.layers.fc(x, size=32, act="relu")
+            c1 = fluid.layers.fc(h0, size=32, act="relu")  # checkpoint
+            h2 = fluid.layers.fc(c1, size=32, act="tanh")
+            res = fluid.layers.elementwise_add(h2, h0)  # skip over c1
+            logits = fluid.layers.fc(res, size=4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            if use_ck:
+                opt = fluid.optimizer.RecomputeOptimizer(
+                    fluid.optimizer.SGD(learning_rate=0.05))
+                opt._set_checkpoints([c1])
+            else:
+                opt = fluid.optimizer.SGD(learning_rate=0.05)
+            opt.minimize(loss)
+        rng = np.random.RandomState(2)
+        xf = rng.rand(16, 16).astype("float32")
+        yf = rng.randint(0, 4, (16, 1)).astype("int64")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return [float(np.asarray(exe.run(
+            prog, feed={"x": xf, "y": yf},
+            fetch_list=[loss.name])[0])) for _ in range(6)]
+
+    np.testing.assert_allclose(build_and_train(True),
+                               build_and_train(False),
+                               atol=1e-6, rtol=1e-6)
