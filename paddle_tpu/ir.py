@@ -601,3 +601,136 @@ class ConvEltwiseAddFusePass(Pass):
                  "ResidualData": add.input("Y")},
                 {"Output": [add_out]},
                 {**conv.attrs, "activation": "identity"})
+
+
+class _FuseOptimizerBase(Pass):
+    """Fuse N per-param optimizer ops into ONE update over coalesced
+    buffers (reference details/fuse_optimizer_op_pass.cc +
+    fuse_sgd_op_pass.cc / fuse_adam_op_pass.cc). Plan per group of
+    fusable ops (same type, same attrs, same LearningRate):
+
+        alloc_continuous_space per slot (Param, Grad, moments...) ->
+        one optimizer op on the fused 1-D buffers ->
+        slice+reshape the updated fused param (and moments) back to
+        the ORIGINAL names, so the executor's state write-back is
+        untouched.
+
+    On TPU the speedup motive is gone (XLA fuses the elementwise
+    updates anyway); the pass keeps the reference's program-level
+    rewrite capability, and the fused form is what
+    fuse_all_reduce-style distributed rewrites key on."""
+
+    op_type = None
+    state_slots = ()     # per-param state to coalesce alongside Param
+    scalar_slots = ()    # per-param [1]-shaped inputs equal across the
+    # group (beta pows) -- the fused op reuses the first op's var
+
+    def _fusable(self, ops):
+        groups = {}
+        for op in ops:
+            if op.type != self.op_type:
+                continue
+            key = (tuple(sorted(op.attrs.items())),
+                   tuple(op.input("LearningRate")))
+            groups.setdefault(key, []).append(op)
+        return [g for g in groups.values() if len(g) > 1]
+
+    def apply_impl(self, graph: Graph, scope):
+        from . import unique_name
+
+        block = graph.block
+        for group in self._fusable(list(block.ops)):
+            first = group[0]
+            idx = min(block.ops.index(op) for op in group)
+            for op in group:
+                block.ops.remove(op)
+            new_ops = []
+
+            def coalesce(slot):
+                names = [op.input(slot)[0] for op in group]
+                fused = unique_name.generate(f"fused_{slot.lower()}")
+                view_names = [unique_name.generate(f"{n}@VIEW")
+                              for n in names]
+                block.create_var(name=fused)
+                for v in view_names:
+                    block.create_var(name=v)
+                new_ops.append(Operator(
+                    block, "alloc_continuous_space",
+                    {"Input": names},
+                    {"Output": view_names, "FusedOutput": [fused]}, {}))
+                return names, fused
+
+            slots = ("Param", "Grad") + tuple(self.state_slots)
+            fused_names = {}
+            orig_names = {}
+            for slot in slots:
+                orig_names[slot], fused_names[slot] = coalesce(slot)
+
+            fused_out = {}
+            op_inputs = {s: [fused_names[s]] for s in slots}
+            op_inputs["LearningRate"] = first.input("LearningRate")
+            for s in self.scalar_slots:
+                op_inputs[s] = first.input(s)
+            op_outputs = {}
+            for s in ("Param",) + tuple(self.state_slots):
+                fo = unique_name.generate(f"fused_{s.lower()}_out")
+                block.create_var(name=fo)
+                fused_out[s] = fo
+                op_outputs[s + "Out"] = [fo]
+            new_ops.append(Operator(block, self.op_type, op_inputs,
+                                    op_outputs, dict(first.attrs)))
+            # beta-pow style scalar state keeps advancing via explicit
+            # scale ops (reference fuse_adam_op_pass.cc FuseScaleOps);
+            # the fused op reads the first op's pows but updates none
+            for slot in self.scalar_slots:
+                factor = first.attrs.get(
+                    {"Beta1Pow": "beta1", "Beta2Pow": "beta2"}.get(
+                        slot, ""), None)
+                if factor is None:
+                    continue
+                for op in group:
+                    pow_name = op.input(slot)[0]
+                    new_ops.append(Operator(
+                        block, "scale", {"X": [pow_name]},
+                        {"Out": [pow_name]},
+                        {"scale": float(factor), "bias": 0.0}))
+
+            # scatter updated fused buffers back to the original vars
+            for s in ("Param",) + tuple(self.state_slots):
+                off = 0
+                for op, orig in zip(group, orig_names[s]):
+                    var = block._find_var_recursive(orig)
+                    shape = list(var.shape)
+                    n = int(np.prod(shape)) if shape else 1
+                    flat = unique_name.generate(f"{orig}@FLAT")
+                    block.create_var(name=flat)
+                    new_ops.append(Operator(
+                        block, "slice", {"Input": [fused_out[s]]},
+                        {"Out": [flat]},
+                        {"axes": [0], "starts": [off],
+                         "ends": [off + n]}))
+                    new_ops.append(Operator(
+                        block, "reshape", {"X": [flat]},
+                        {"Out": [orig]}, {"shape": shape}))
+                    off += n
+            for i, nop in enumerate(new_ops):
+                block.ops.insert(idx + i, nop)
+
+
+@register_pass("fuse_sgd_op_pass")
+class FuseSgdOpPass(_FuseOptimizerBase):
+    """reference details/fuse_sgd_op_pass.cc."""
+
+    op_type = "sgd"
+
+
+@register_pass("fuse_adam_op_pass")
+class FuseAdamOpPass(_FuseOptimizerBase):
+    """reference details/fuse_adam_op_pass.cc. Beta pows are shared
+    from the group's first op (they are numerically identical across
+    params: same init, same step count -- the reference reaches the
+    same state through FuseScaleOps)."""
+
+    op_type = "adam"
+    state_slots = ("Moment1", "Moment2")
+    scalar_slots = ("Beta1Pow", "Beta2Pow")

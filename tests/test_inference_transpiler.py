@@ -5,6 +5,7 @@ coverage (the reference exercises it inside book tests) plus
 test_downpoursgd-era desc checks.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 
@@ -170,3 +171,65 @@ if __name__ == "__main__":
     import pytest
 
     pytest.main([__file__, "-q"])
+
+
+def _opt_fuse_case(opt_name):
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    fluid.seed(21)
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=(8,), dtype="float32")
+        y = fluid.layers.data("y", shape=(1,), dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        h = fluid.layers.fc(h, size=16, act="tanh")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        if opt_name == "sgd":
+            fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+        else:
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    return prog, startup, loss
+
+
+def _run_steps(prog, startup, loss, steps=6):
+    rng = np.random.RandomState(4)
+    x = rng.rand(16, 8).astype("float32")
+    y = rng.rand(16, 1).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return [float(np.asarray(exe.run(prog, feed={"x": x, "y": y},
+                                     fetch_list=[loss.name])[0]))
+            for _ in range(steps)]
+
+
+@pytest.mark.parametrize("opt_name,pass_name,op_type", [
+    ("sgd", "fuse_sgd_op_pass", "sgd"),
+    ("adam", "fuse_adam_op_pass", "adam"),
+])
+def test_fuse_optimizer_pass_loss_parity(opt_name, pass_name, op_type):
+    """reference details/fuse_sgd_op_pass.cc / fuse_adam_op_pass.cc:
+    N per-param updates -> 1 update over coalesced buffers, same
+    training trajectory."""
+    from paddle_tpu.ir import apply_passes
+
+    prog, startup, loss = _opt_fuse_case(opt_name)
+    plain = _run_steps(prog, startup, loss)
+
+    prog2, startup2, loss2 = _opt_fuse_case(opt_name)
+    n_before = sum(1 for op in prog2.global_block.ops
+                   if op.type == op_type)
+    assert n_before > 1
+    apply_passes(prog2, [pass_name])
+    n_after = sum(1 for op in prog2.global_block.ops
+                  if op.type == op_type)
+    assert n_after == 1, f"expected one fused {op_type} op"
+    assert any(op.type == "alloc_continuous_space"
+               for op in prog2.global_block.ops)
+    fused = _run_steps(prog2, startup2, loss2)
+    np.testing.assert_allclose(fused, plain, atol=1e-5, rtol=1e-5)
+    assert fused[-1] < fused[0]
