@@ -94,6 +94,13 @@ class CompiledProgram:
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._share_vars_from = share_vars_from
         self._places = places
+        if self._build_strategy.fuse_all_optimizer_ops:
+            # reference build_strategy.cc appends fuse_adam/sgd passes
+            # when this knob is on; same pipeline here (ir.py)
+            from ..ir import apply_passes
+
+            apply_passes(self._program,
+                         ["fuse_adam_op_pass", "fuse_sgd_op_pass"])
         return self
 
     def with_inference_optimize(self, config):

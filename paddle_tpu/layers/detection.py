@@ -6,7 +6,8 @@ from __future__ import annotations
 
 from ..layer_helper import LayerHelper
 
-__all__ = ["prior_box", "box_coder", "iou_similarity", "yolo_box",
+__all__ = ["detection_map",
+           "prior_box", "box_coder", "iou_similarity", "yolo_box",
            "yolov3_loss", "multiclass_nms", "density_prior_box",
            "anchor_generator", "bipartite_match", "target_assign",
            "ssd_loss", "detection_output", "polygon_box_transform",
@@ -323,3 +324,26 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
          "bbox_reg_weights": list(bbox_reg_weights),
          "use_random": use_random})
     return rois_out, labels, targets, inside_w, outside_w
+
+
+def detection_map(detect_res, label, class_num=None, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral", has_difficult=False):
+    """reference layers/detection.py detection_map: mAP over padded
+    detections [B,D,6] vs padded gt [B,G,5]. class_num /
+    evaluate_difficult / state vars are accepted for API parity; the
+    op computes per-batch mAP on host (ops/detection_ops.py) and
+    accumulation lives in metrics.DetectionMAP."""
+    helper = LayerHelper("detection_map")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "detection_map",
+        {"DetectRes": [detect_res.name], "Label": [label.name]},
+        {"MAP": [out.name]},
+        {"overlap_threshold": overlap_threshold,
+         "ap_type": ap_version,
+         "background_label": background_label,
+         "evaluate_difficult": evaluate_difficult,
+         "has_difficult": bool(has_difficult)})
+    return out

@@ -603,13 +603,6 @@ def dot(x, y, name=None):
 # ---------------------------------------------------------------------------
 # shape manipulation wrappers
 # ---------------------------------------------------------------------------
-def _simple(op_type, x_slot="X", out_slot="Out"):
-    def layer(x, *args, **kwargs):
-        raise NotImplementedError
-
-    return layer
-
-
 def reshape(x, shape, actual_shape=None, act=None, inplace=False,
             name=None):
     helper = LayerHelper("reshape2", input=x, act=act, name=name)

@@ -174,7 +174,69 @@ class CompositeMetric(MetricBase):
 
 
 class DetectionMAP(MetricBase):
-    def __init__(self, *args, **kwargs):
-        super().__init__(kwargs.get("name"))
-        raise NotImplementedError(
-            "DetectionMAP: planned with the detection op family")
+    """Mean average precision accumulator (reference metrics.py:566).
+
+    The reference accumulates TP/FP state in-graph (AccumTruePos
+    vars); here the per-batch mAP comes from the detection_map op
+    (host-computed) and DATASET accumulation is host-side: feed each
+    fetched (detections, labels) batch through update(det, gt) and
+    eval() computes the pooled mAP with globally-ranked scores --
+    the same math as the reference's accumulated path. get_map_var()
+    returns (cur_map, cur_map): without in-graph state both slots
+    fetch the per-batch value; use eval() for the running dataset mAP.
+    """
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral", name=None):
+        super().__init__(name)
+        from . import layers
+
+        self._has_difficult = gt_difficult is not None
+        self._overlap = overlap_threshold
+        self._ap_version = ap_version
+        self._background = background_label
+        self._eval_difficult = evaluate_difficult
+        label = gt_label
+        if gt_box is not None and getattr(gt_label, "shape", None):
+            # reference concats [label, (difficult,) box] -> [N,5|6]
+            parts = [gt_label]
+            if gt_difficult is not None:
+                parts.append(gt_difficult)
+            parts.append(gt_box)
+            label = layers.concat(parts, axis=-1)
+        self._map_var = layers.detection.detection_map(
+            input, label, class_num=class_num,
+            background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            ap_version=ap_version,
+            has_difficult=self._has_difficult)
+        self._dets = []
+        self._labels = []
+
+    def get_map_var(self):
+        return self._map_var, self._map_var
+
+    def reset(self, executor=None):
+        self._dets = []
+        self._labels = []
+
+    def update(self, detections, labels):
+        """Accumulate one fetched batch: detections [B,D,6] (or list
+        of per-image [D,6]) and the concatenated labels [B,G,5|6]."""
+        self._dets.extend(list(np.asarray(detections)))
+        self._labels.extend(list(np.asarray(labels)))
+
+    def eval(self):
+        if not self._dets:
+            raise ValueError("DetectionMAP: no batches accumulated")
+        from .ops.detection_ops import compute_map_np
+
+        return compute_map_np(
+            self._dets, self._labels, overlap=self._overlap,
+            ap_type=self._ap_version,
+            background_label=self._background,
+            evaluate_difficult=self._eval_difficult,
+            has_difficult=self._has_difficult)

@@ -603,73 +603,107 @@ def polygon_box_transform(ctx):
     return {"Output": idx[None] * 4.0 - x}
 
 
+def compute_map_np(det_batches, lab_batches, overlap=0.5,
+                   ap_type="integral", background_label=0,
+                   evaluate_difficult=True, has_difficult=False):
+    """Pooled mAP over a list of per-image (det [D,6], gt [G,5|6])
+    numpy arrays (reference detection_map_op.cc semantics): scores are
+    ranked globally per class, gt rows with label==background_label
+    (or label<0 padding) are excluded, and with evaluate_difficult
+    False a detection matched to a difficult gt is IGNORED (neither TP
+    nor FP) while difficult gt do not count toward npos. Shared by the
+    detection_map op (one batch) and metrics.DetectionMAP (dataset
+    accumulation)."""
+    box_col = 2 if has_difficult else 1
+    classes = set()
+    for lab in lab_batches:
+        for row in np.asarray(lab):
+            l = int(row[0])
+            if l >= 0 and l != background_label:
+                classes.add(l)
+    aps = []
+    for cls in classes:
+        scores, marks = [], []  # mark: 1 tp, 0 fp (ignored = skipped)
+        npos = 0
+        for det_np, lab_np in zip(det_batches, lab_batches):
+            det_np = np.asarray(det_np)
+            lab_np = np.asarray(lab_np)
+            sel = lab_np[lab_np[:, 0] == cls]
+            gt = sel[:, box_col:box_col + 4]
+            difficult = (sel[:, 1].astype(bool) if has_difficult
+                         else np.zeros(len(sel), bool))
+            npos += int((~difficult).sum()) if not evaluate_difficult \
+                else len(gt)
+            dt = det_np[det_np[:, 0] == cls]
+            dt = dt[np.argsort(-dt[:, 1])]
+            used = np.zeros(len(gt), bool)
+            for row in dt:
+                box = row[2:6]
+                best, gi_best = 0.0, -1
+                for gi, g in enumerate(gt):
+                    iw = max(min(box[2], g[2]) - max(box[0], g[0]), 0)
+                    ih = max(min(box[3], g[3]) - max(box[1], g[1]), 0)
+                    inter = iw * ih
+                    ua = ((box[2] - box[0]) * (box[3] - box[1])
+                          + (g[2] - g[0]) * (g[3] - g[1]) - inter)
+                    iou = inter / ua if ua > 0 else 0
+                    if iou > best:
+                        best, gi_best = iou, gi
+                matched = best >= overlap and gi_best >= 0
+                if matched and not evaluate_difficult \
+                        and difficult[gi_best]:
+                    continue  # ignore: neither tp nor fp
+                tp = matched and not used[gi_best]
+                if tp:
+                    used[gi_best] = True
+                scores.append(row[1])
+                marks.append(1.0 if tp else 0.0)
+        if npos == 0:
+            continue
+        order = np.argsort(-np.asarray(scores)) if scores else []
+        tps_s = np.asarray(marks)[order] if marks else np.zeros(0)
+        ctp = np.cumsum(tps_s)
+        prec = ctp / (np.arange(len(ctp)) + 1) if len(ctp) else \
+            np.zeros(0)
+        rec = ctp / npos if len(ctp) else np.zeros(0)
+        if ap_type == "11point":
+            ap = float(np.mean([
+                max([p for p, r in zip(prec, rec) if r >= t],
+                    default=0.0) for t in np.linspace(0, 1, 11)]))
+        else:
+            ap = 0.0
+            prev_r = 0.0
+            for p, r in zip(prec, rec):
+                ap += p * (r - prev_r)
+                prev_r = r
+        aps.append(ap)
+    return float(np.mean(aps)) if aps else 0.0
+
+
 @register_op("detection_map", differentiable=False)
 def detection_map(ctx):
     """reference detection_map_op.cc: mAP over padded NMS detections
     (label -1 rows = padding) vs padded gt (label -1 = padding). Host
-    computation via io_callback — metrics are not a device hot path."""
+    computation via io_callback (compute_map_np) — metrics are not a
+    device hot path. Attrs: overlap_threshold, ap_type,
+    background_label, evaluate_difficult, has_difficult (gt layout
+    [label, difficult, x1..] vs [label, x1..])."""
     det = ctx.input("DetectRes")  # [B, D, 6]
-    label = ctx.input("Label")  # [B, G, 5] (label, x1, y1, x2, y2)
+    label = ctx.input("Label")  # [B, G, 5|6]
     overlap = ctx.attr("overlap_threshold", 0.5)
     ap_type = ctx.attr("ap_type", "integral")
+    background = ctx.attr("background_label", 0)
+    eval_diff = ctx.attr("evaluate_difficult", True)
+    has_diff = ctx.attr("has_difficult", False)
 
     def _map(det_np, lab_np):
         det_np = np.asarray(det_np)
         lab_np = np.asarray(lab_np)
-        classes = set(int(l) for b in lab_np
-                      for l in b[:, 0] if l >= 0)
-        aps = []
-        for cls in classes:
-            scores, tps = [], []
-            npos = 0
-            for bi in range(lab_np.shape[0]):
-                gt = lab_np[bi][lab_np[bi][:, 0] == cls][:, 1:]
-                npos += len(gt)
-                dt = det_np[bi][det_np[bi][:, 0] == cls]
-                dt = dt[np.argsort(-dt[:, 1])]
-                used = np.zeros(len(gt), bool)
-                for row in dt:
-                    scores.append(row[1])
-                    box = row[2:6]
-                    best, bi2 = 0.0, -1
-                    for gi, g in enumerate(gt):
-                        ix1 = max(box[0], g[0])
-                        iy1 = max(box[1], g[1])
-                        ix2 = min(box[2], g[2])
-                        iy2 = min(box[3], g[3])
-                        iw = max(ix2 - ix1, 0)
-                        ih = max(iy2 - iy1, 0)
-                        inter = iw * ih
-                        ua = ((box[2] - box[0]) * (box[3] - box[1])
-                              + (g[2] - g[0]) * (g[3] - g[1]) - inter)
-                        iou = inter / ua if ua > 0 else 0
-                        if iou > best:
-                            best, bi2 = iou, gi
-                    tp = best >= overlap and bi2 >= 0 and not used[bi2]
-                    if tp:
-                        used[bi2] = True
-                    tps.append(1.0 if tp else 0.0)
-            if npos == 0:
-                continue
-            order = np.argsort(-np.asarray(scores)) if scores else []
-            tps_s = np.asarray(tps)[order] if len(tps) else \
-                np.zeros(0)
-            ctp = np.cumsum(tps_s)
-            prec = ctp / (np.arange(len(ctp)) + 1) if len(ctp) else \
-                np.zeros(0)
-            rec = ctp / npos if len(ctp) else np.zeros(0)
-            if ap_type == "11point":
-                ap = float(np.mean([
-                    max([p for p, r in zip(prec, rec) if r >= t],
-                        default=0.0) for t in np.linspace(0, 1, 11)]))
-            else:
-                ap = 0.0
-                prev_r = 0.0
-                for p, r in zip(prec, rec):
-                    ap += p * (r - prev_r)
-                    prev_r = r
-            aps.append(ap)
-        return np.asarray(np.mean(aps) if aps else 0.0, np.float32)
+        return np.asarray(compute_map_np(
+            list(det_np), list(lab_np), overlap=overlap,
+            ap_type=ap_type, background_label=background,
+            evaluate_difficult=eval_diff, has_difficult=has_diff),
+            np.float32)
 
     from jax.experimental import io_callback
 

@@ -471,3 +471,61 @@ class TestAdviceRegressions:
         np.testing.assert_allclose(got[0, 1], [7, 7])
         np.testing.assert_allclose(got[0, 2], [7, 7])
         np.testing.assert_allclose(wgt[0, :, 0], [1, 0, 1, 1])
+
+
+def test_detection_map_layer_and_metric():
+    """layers.detection.detection_map + metrics.DetectionMAP
+    (reference metrics.py:566): perfect detections -> mAP 1.0;
+    accumulation pools TP/FP across batches."""
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        det = fluid.layers.data("det", shape=(3, 6), dtype="float32")
+        gt = fluid.layers.data("gt", shape=(2, 5), dtype="float32")
+        m = fluid.metrics.DetectionMAP(det, gt, None,
+                                       overlap_threshold=0.5)
+        cur_map, accum_map = m.get_map_var()
+    gt_np = np.array([[[1, 0, 0, 10, 10], [2, 20, 20, 30, 30]]],
+                     np.float32)
+    det_np = np.array([[[1, 0.9, 0, 0, 10, 10],
+                        [2, 0.8, 20, 20, 30, 30],
+                        [-1, 0, 0, 0, 0, 0]]], np.float32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    out = exe.run(prog, feed={"det": det_np, "gt": gt_np},
+                  fetch_list=[cur_map.name])
+    val = float(np.asarray(out[0]).reshape(-1)[0])
+    assert abs(val - 1.0) < 1e-5, val
+    # pooled accumulation: perfect batch + all-miss batch
+    m.update(det_np, gt_np)
+    miss = det_np.copy()
+    miss[:, :, 2:] += 100  # boxes nowhere near gt
+    m.update(miss, gt_np)
+    pooled = m.eval()
+    assert 0.0 < pooled < 1.0
+
+
+def test_detection_map_background_and_difficult():
+    from paddle_tpu.ops.detection_ops import compute_map_np
+
+    det = [np.array([[1, 0.9, 0, 0, 10, 10],
+                     [0, 0.8, 20, 20, 30, 30]], np.float32)]
+    # gt: one class-1 box + one background(0) row + one difficult
+    # class-1 box layout [label, difficult, x1, y1, x2, y2]
+    gt = [np.array([[1, 0, 0, 0, 10, 10],
+                    [0, 0, 20, 20, 30, 30],
+                    [1, 1, 50, 50, 60, 60]], np.float32)]
+    # background rows must not create a class; difficult box with
+    # evaluate_difficult=False must not count toward npos
+    v = compute_map_np(det, gt, overlap=0.5, background_label=0,
+                       evaluate_difficult=False, has_difficult=True)
+    assert abs(v - 1.0) < 1e-6, v
+    # evaluating difficult: the unmatched difficult gt lowers recall
+    v2 = compute_map_np(det, gt, overlap=0.5, background_label=0,
+                        evaluate_difficult=True, has_difficult=True)
+    assert v2 < 1.0

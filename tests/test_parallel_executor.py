@@ -79,3 +79,40 @@ def test_dryrun_multichip_entrypoint():
     sys.path.insert(0, "/root/repo")
     m = importlib.import_module("__graft_entry__")
     m.dryrun_multichip(8)
+
+
+def test_fuse_all_optimizer_ops_knob():
+    """BuildStrategy.fuse_all_optimizer_ops routes through the
+    fuse_adam/sgd IR passes (reference build_strategy.cc pipeline)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    fluid.seed(3)
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=(8,), dtype="float32")
+        y = fluid.layers.data("y", shape=(1,), dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(h, size=1), y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    bs = fluid.BuildStrategy()
+    bs.fuse_all_optimizer_ops = True
+    compiled = fluid.CompiledProgram(prog).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs)
+    assert sum(1 for op in prog.global_block.ops
+               if op.type == "sgd") == 1
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(16, 8).astype("float32"),
+            "y": rng.rand(16, 1).astype("float32")}
+    losses = [float(np.asarray(exe.run(compiled, feed=feed,
+                                       fetch_list=[loss.name])[0])
+                    .reshape(-1)[0])
+              for _ in range(6)]
+    assert losses[-1] < losses[0]
