@@ -181,6 +181,9 @@ def trace_op_into(op_type, inputs: Dict[str, List[VarBase]],
                  for slot, vs in out_vars_by_slot.items()}
     op = Operator(None, op_type, in_names, out_names, attrs)
     rng_cell = [t.next_rng() if t else jax.random.PRNGKey(0)]
+    # remember the exact key: run_backward replays it so vjp grad
+    # kernels (which recompute the forward) re-toss IDENTICAL noise
+    op._dygraph_rng_key = rng_cell[0]
     run_op(op, env, rng_cell=rng_cell, rng_salt=0)
     for slot, vs in out_vars_by_slot.items():
         for v in vs:

@@ -225,8 +225,57 @@ class PRelu(Layer):
 
 
 class NCE(Layer):
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError("dygraph NCE: use graph-mode layers.nce")
+    """Noise-contrastive estimation head (reference dygraph/nn.py NCE
+    signature): weight/bias are created lazily at first forward from
+    the input width (the reference's _build_once), so `dim` needs no
+    extra positional argument."""
+
+    def __init__(self, name_scope=None, num_total_classes=None,
+                 sample_weight=None, param_attr=None, bias_attr=None,
+                 num_neg_samples=None, sampler="uniform",
+                 custom_dist=None, seed=0, is_sparse=False,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        if num_total_classes is None:
+            raise ValueError("dygraph NCE needs num_total_classes")
+        if sampler != "uniform" or custom_dist is not None:
+            raise ValueError("dygraph NCE: only the uniform sampler is "
+                             "lowered (reference nce_op.h default)")
+        self._num_total_classes = int(num_total_classes)
+        self._num_neg = int(num_neg_samples
+                            if num_neg_samples is not None else 10)
+        self._seed = int(seed)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._sample_weight = sample_weight
+        self.weight = None
+        self.bias = None
+
+    def _build_once(self, input):
+        dim = int(input.shape[-1])
+        self.weight = self.create_parameter(
+            [self._num_total_classes, dim], attr=self._param_attr)
+        if self._bias_attr is not False:
+            self.bias = self.create_parameter(
+                [self._num_total_classes], attr=self._bias_attr,
+                is_bias=True)
+
+    def forward(self, input, label):
+        if self.weight is None:
+            self._build_once(input)
+        ins = {"Input": [input], "Label": [label],
+               "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        if self._sample_weight is not None:
+            ins["SampleWeight"] = [self._sample_weight]
+        outs = trace_op(
+            "nce", ins, 3,
+            {"num_total_classes": self._num_total_classes,
+             "num_neg_samples": self._num_neg, "seed": self._seed},
+            out_slots={"Cost": 1, "SampleLogits": 1,
+                       "SampleLabels": 1})
+        return outs[0]
 
 
 class Dropout(Layer):

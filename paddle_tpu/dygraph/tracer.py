@@ -63,7 +63,15 @@ class Tracer:
                                 resolved.append(EMPTY_VAR)
                         gop.inputs[slot] = resolved
                 try:
-                    run_op(gop, run_env)
+                    # same key+salt as the forward trace: sampling ops'
+                    # vjp recomputation must see the forward's noise
+                    run_op(gop, run_env,
+                           rng_cell=[getattr(op, "_dygraph_rng_key",
+                                             None)
+                                     if getattr(op, "_dygraph_rng_key",
+                                                None) is not None
+                                     else jax.random.PRNGKey(0)],
+                           rng_salt=0)
                 except KeyError:
                     continue
                 for slot, names in gop.outputs.items():

@@ -8,7 +8,8 @@ import numpy as np
 from . import layers
 from .core.program import default_main_program, default_startup_program
 
-__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance"]
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance",
+           "DetectionMAP"]
 
 
 class Evaluator:
@@ -121,3 +122,29 @@ class EditDistance(Evaluator):
             global_scope()._get(self.total_distance.name)))
         n = float(np.asarray(global_scope()._get(self.seq_num.name)))
         return total / n if n else 0.0
+
+
+class _EvaluatorDetectionMAP:
+    """reference evaluator.py DetectionMAP (the pre-metrics API):
+    wraps metrics.DetectionMAP, keeping the Evaluator-style
+    reset(executor, reset_program) signature legacy scripts call."""
+
+    def __init__(self, *args, **kwargs):
+        from .metrics import DetectionMAP as _M
+
+        self._m = _M(*args, **kwargs)
+
+    def get_map_var(self):
+        return self._m.get_map_var()
+
+    def update(self, *args, **kwargs):
+        return self._m.update(*args, **kwargs)
+
+    def eval(self, executor=None, eval_program=None):
+        return self._m.eval()
+
+    def reset(self, executor=None, reset_program=None):
+        return self._m.reset()
+
+
+DetectionMAP = _EvaluatorDetectionMAP

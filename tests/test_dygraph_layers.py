@@ -76,3 +76,45 @@ class TestParamLayersInDygraph:
         exe.run(startup)
         got, = exe.run(prog, feed={"x": xs}, fetch_list=[out])
         assert got.shape == (32, 3)
+
+
+def test_dygraph_nce_trains():
+    """dygraph NCE (reference dygraph/nn.py NCE signature): eager cost,
+    and backward gradients land ONLY on the rows the forward sampled
+    (the vjp recomputation replays the forward's PRNG key)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.dygraph import base as dybase
+
+    with fluid.dygraph.guard():
+        nce = fluid.dygraph.NCE(num_total_classes=50,
+                                num_neg_samples=5)
+        rng = np.random.RandomState(0)
+        x = fluid.dygraph.to_variable(rng.rand(4, 8).astype("float32"))
+        lbl = fluid.dygraph.to_variable(
+            rng.randint(0, 50, (4, 1)).astype("int64"))
+        cost = nce(x, lbl)
+        assert cost.numpy().shape == (4, 1)
+        # the tape's last entry holds the forward's SampleLabels
+        op, ins, outs = dybase.tracer()._tape[-1]
+        sampled = set(np.asarray(
+            outs["SampleLabels"][0].value).ravel().tolist())
+        cost.backward()
+        g = np.asarray(nce.weight.gradient())
+        grad_rows = set(np.where(np.abs(g).sum(1) > 0)[0].tolist())
+        assert grad_rows, "no gradient reached the nce weight"
+        assert grad_rows <= sampled, (
+            f"grads on unsampled rows: {sorted(grad_rows - sampled)}")
+
+
+def test_dygraph_nce_bias_attr_false():
+    import paddle_tpu as fluid
+
+    with fluid.dygraph.guard():
+        nce = fluid.dygraph.NCE(num_total_classes=20,
+                                num_neg_samples=3, bias_attr=False)
+        x = fluid.dygraph.to_variable(
+            np.random.RandomState(0).rand(2, 4).astype("float32"))
+        lbl = fluid.dygraph.to_variable(
+            np.array([[1], [2]], np.int64))
+        _ = nce(x, lbl)
+        assert nce.bias is None
