@@ -261,28 +261,40 @@ def batch_norm(ctx):
 
 @register_op("layer_norm")
 def layer_norm(ctx):
+    """Statistics always run in fp32 regardless of input dtype (the
+    pallas kernel already did; the jnp fallback now matches). NOTE the
+    op stays on the AMP BLACK list: keeping LN bf16-in/bf16-out to
+    elide the convert chain was tried and measured SLOWER on v5e
+    (200.6 vs 184 ms/step transformer-base) -- XLA folds the converts
+    into neighboring fusions for free, while bf16 IO degrades the
+    pallas LN tiles. See PERF.md dead ends."""
     x = ctx.input("X")
     eps = ctx.attr("epsilon", 1e-5)
     begin = ctx.attr("begin_norm_axis", 1)
     lead = int(np.prod(x.shape[:begin]))
     x2 = x.reshape(lead, -1)
     scale, bias = ctx.input("Scale"), ctx.input("Bias")
-    mean = jnp.mean(x2, axis=1, keepdims=True)
-    var = jnp.var(x2, axis=1, keepdims=True)
+    x2f = x2.astype(jnp.float32)
+    mean = jnp.mean(x2f, axis=1, keepdims=True)
+    var = jnp.var(x2f, axis=1, keepdims=True)
     from .pallas import layer_norm as pallas_ln
 
-    if (scale is not None and bias is not None
-            and pallas_ln.usable(lead, x2.shape[1])):
-        y = pallas_ln.layer_norm(x2, scale.reshape(-1),
-                                 bias.reshape(-1), eps)
+    if scale is not None and bias is not None:
+        s1, b1 = scale.reshape(-1), bias.reshape(-1)
+        # pallas kernel when usable, else its oracle (_ln_ref) -- ONE
+        # fp32 recipe shared with the kernel's custom_vjp backward
+        y = (pallas_ln.layer_norm(x2, s1, b1, eps)
+             if pallas_ln.usable(lead, x2.shape[1])
+             else pallas_ln._ln_ref(x2, s1, b1, eps))
         return {"Y": y.reshape(x.shape), "Mean": mean.reshape(lead),
                 "Variance": var.reshape(lead)}
-    y = (x2 - mean) * jax.lax.rsqrt(var + eps)
+    y = (x2f - mean) * jax.lax.rsqrt(var + eps)
     if scale is not None:
-        y = y * scale.reshape(1, -1)
+        y = y * scale.reshape(1, -1).astype(jnp.float32)
     if bias is not None:
-        y = y + bias.reshape(1, -1)
-    return {"Y": y.reshape(x.shape), "Mean": mean.reshape(lead),
+        y = y + bias.reshape(1, -1).astype(jnp.float32)
+    return {"Y": y.astype(x.dtype).reshape(x.shape),
+            "Mean": mean.reshape(lead),
             "Variance": var.reshape(lead)}
 
 
