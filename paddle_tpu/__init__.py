@@ -53,6 +53,8 @@ from . import distribute_lookup_table
 from . import amp
 from . import flags
 from .flags import set_flags, get_flags
+from . import enforce
+from .enforce import EnforceNotMet
 from . import contrib
 from . import lod_tensor
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor

@@ -1,0 +1,83 @@
+"""Enforce: check helpers raising EnforceNotMet with call context.
+
+Parity: reference paddle/fluid/platform/enforce.h (PADDLE_ENFORCE*,
+:245 -- CUDA-error decoding and C++ stack traces). The TPU build's
+device errors surface through jax/XLA exceptions already, so the
+Python layer keeps the reference's *check* surface: a structured
+error type plus the comparison helpers op builders and user code can
+call at program-construction time (where the reference fires most of
+its ENFORCEs, via InferShape)."""
+from __future__ import annotations
+
+import sys
+from types import SimpleNamespace
+
+
+class EnforceNotMet(RuntimeError):
+    """reference platform/enforce.h EnforceNotMet: carries the failing
+    expression/message and the python call site."""
+
+    def __init__(self, message, frame=None):
+        if frame is not None:
+            message = (f"{message}\n  at {frame.filename}:"
+                       f"{frame.lineno} in {frame.function}")
+        super().__init__(message)
+
+
+def _caller():
+    # sys._getframe: one frame fetch, no per-frame source-context
+    # reads like inspect.stack() would do for the WHOLE stack
+    try:
+        f = sys._getframe(2)  # [0]=_caller [1]=enforce_* [2]=call site
+    except ValueError:
+        return None
+    return SimpleNamespace(filename=f.f_code.co_filename,
+                           lineno=f.f_lineno,
+                           function=f.f_code.co_name)
+
+
+def enforce(cond, msg="enforce failed"):
+    if not cond:
+        raise EnforceNotMet(msg, _caller())
+
+
+def enforce_eq(a, b, msg=None):
+    if a != b:
+        raise EnforceNotMet(msg or f"enforce_eq failed: {a!r} != {b!r}",
+                            _caller())
+
+
+def enforce_ne(a, b, msg=None):
+    if a == b:
+        raise EnforceNotMet(msg or f"enforce_ne failed: both {a!r}",
+                            _caller())
+
+
+def enforce_gt(a, b, msg=None):
+    if not a > b:
+        raise EnforceNotMet(msg or f"enforce_gt failed: {a!r} <= {b!r}",
+                            _caller())
+
+
+def enforce_ge(a, b, msg=None):
+    if not a >= b:
+        raise EnforceNotMet(msg or f"enforce_ge failed: {a!r} < {b!r}",
+                            _caller())
+
+
+def enforce_lt(a, b, msg=None):
+    if not a < b:
+        raise EnforceNotMet(msg or f"enforce_lt failed: {a!r} >= {b!r}",
+                            _caller())
+
+
+def enforce_le(a, b, msg=None):
+    if not a <= b:
+        raise EnforceNotMet(msg or f"enforce_le failed: {a!r} > {b!r}",
+                            _caller())
+
+
+def enforce_not_none(v, msg=None):
+    if v is None:
+        raise EnforceNotMet(msg or "enforce_not_none failed", _caller())
+    return v

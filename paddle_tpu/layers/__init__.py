@@ -11,3 +11,5 @@ from .extras import *  # noqa: F401,F403
 from . import nn, tensor, ops, io, control_flow, rnn, sequence  # noqa: F401
 from . import learning_rate_scheduler, metric_op, detection, host  # noqa: F401
 from . import extras  # noqa: F401
+
+from . import collective  # noqa: F401

@@ -1,0 +1,29 @@
+"""Collective communication layers.
+
+Parity: reference python/paddle/fluid/layers/collective.py:19
+(`_allreduce` -- the private layer the nccl2-mode transpiler and
+dygraph multi-process path append per gradient). The op lowers to an
+in-graph cross-process reduction (ops/dist_ops.py allreduce);
+single-process it is identity, and inside a pjit'd data-parallel
+block the mesh psum (parallel/, CompiledProgram) is the idiomatic
+path -- this layer exists for reference program compatibility."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["_allreduce"]
+
+
+def _allreduce(x, out=None, reduce_type="sum", sync_mode=False):
+    helper = LayerHelper("allreduce", input=x)
+    if reduce_type not in ("sum", "mean", "max", "min", "prod"):
+        raise TypeError(f"reduce_type {reduce_type!r} is not supported")
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            dtype=getattr(x, "dtype", None))
+    # Variable objects (not names): LayerHelper routes them through
+    # BOTH graph append and the dygraph eager trace
+    helper.append_op("allreduce", {"X": [x]}, {"Out": [out]},
+                     {"reduce_type": reduce_type,
+                      "sync_mode": sync_mode})
+    return out

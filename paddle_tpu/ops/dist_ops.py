@@ -224,9 +224,13 @@ def allreduce(ctx):
 
         gathered = np.asarray(
             multihost_utils.process_allgather(jnp.asarray(v)))
-        if reduce_type == "mean":
-            return gathered.mean(axis=0).astype(v.dtype)
-        return gathered.sum(axis=0).astype(v.dtype)
+        reducers = {"mean": gathered.mean, "sum": gathered.sum,
+                    "max": gathered.max, "min": gathered.min,
+                    "prod": gathered.prod}
+        if reduce_type not in reducers:
+            raise ValueError(
+                f"allreduce: unsupported reduce_type {reduce_type!r}")
+        return reducers[reduce_type](axis=0).astype(v.dtype)
 
     out = io_callback(_do, spec, x, ordered=True)
     return {"Out": out}

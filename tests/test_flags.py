@@ -123,3 +123,48 @@ class TestStrictInferShape:
         set_flags({"FLAGS_strict_infer_shape": True})
         with pytest.raises(RuntimeError, match="always_broken"):
             self._append_broken_op()
+
+
+def test_enforce_helpers():
+    """reference platform/enforce.h check surface."""
+    import pytest
+
+    from paddle_tpu import enforce as E
+
+    E.enforce(True)
+    E.enforce_eq(3, 3)
+    E.enforce_ne(1, 2)
+    E.enforce_gt(2, 1)
+    E.enforce_ge(2, 2)
+    E.enforce_lt(1, 2)
+    E.enforce_le(2, 2)
+    assert E.enforce_not_none(5) == 5
+    with pytest.raises(E.EnforceNotMet, match="shape mismatch"):
+        E.enforce_eq((2, 3), (2, 4), "shape mismatch")
+    with pytest.raises(E.EnforceNotMet) as ei:
+        E.enforce(False, "boom")
+    # call-site context recorded
+    assert "test_flags.py" in str(ei.value)
+
+
+def test_collective_allreduce_layer():
+    """reference layers/collective.py:19 _allreduce: program-level
+    collective append; single-process it reduces to identity."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=(4,), dtype="float32")
+        y = fluid.layers.collective._allreduce(x, reduce_type="sum")
+    assert prog.global_block.ops[-1].type == "allreduce"
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                  fetch_list=[y.name])
+    np.testing.assert_allclose(np.asarray(out[0]), np.ones((2, 4)))
+    import pytest
+
+    with pytest.raises(TypeError):
+        fluid.layers.collective._allreduce(x, reduce_type="bogus")
