@@ -212,3 +212,62 @@ def test_recompute_skip_connection_parity():
     np.testing.assert_allclose(build_and_train(True),
                                build_and_train(False),
                                atol=1e-6, rtol=1e-6)
+
+
+def test_recompute_composes_with_data_parallel():
+    """Recompute + CompiledProgram.with_data_parallel on the virtual
+    8-device mesh: the barriers/clones must shard like any other op
+    and match the plain dp run."""
+    from paddle_tpu import unique_name
+
+    def run(use_ck):
+        fluid._reset_global_scope()
+        unique_name.switch()
+        fluid.seed(31)
+        prog, startup, loss, ckpts = _build(False)
+        with fluid.program_guard(prog, startup):
+            if use_ck:
+                opt = fluid.optimizer.RecomputeOptimizer(
+                    fluid.optimizer.SGD(learning_rate=0.05))
+                opt._set_checkpoints(list(ckpts))
+            else:
+                opt = fluid.optimizer.SGD(learning_rate=0.05)
+            opt.minimize(loss)
+        compiled = fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        rng = np.random.RandomState(7)
+        x = rng.rand(32, 16).astype("float32")
+        y = rng.randint(0, 4, (32, 1)).astype("int64")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return [float(np.asarray(exe.run(
+            compiled, feed={"x": x, "y": y},
+            fetch_list=[loss.name])[0]).reshape(-1)[0])
+            for _ in range(5)]
+
+    plain = run(False)
+    ck = run(True)
+    np.testing.assert_allclose(ck, plain, atol=1e-6, rtol=1e-6)
+    assert ck[-1] < ck[0]
+
+
+def test_recompute_dp_program_contains_clones():
+    """Guard against the vacuous-parity failure mode: the dp-wrapped
+    recompute program must actually carry barriers + @RECOMP clones."""
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    fluid.seed(31)
+    prog, startup, loss, ckpts = _build(False)
+    with fluid.program_guard(prog, startup):
+        opt = fluid.optimizer.RecomputeOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.05))
+        opt._set_checkpoints(list(ckpts))
+        opt.minimize(loss)
+    fluid.CompiledProgram(prog).with_data_parallel(loss_name=loss.name)
+    types = [op.type for op in prog.global_block.ops]
+    assert "optimization_barrier" in types
+    names = [n for op in prog.global_block.ops
+             for n in op.output_arg_names]
+    assert any("@RECOMP" in n for n in names)
