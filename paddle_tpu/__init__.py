@@ -55,6 +55,8 @@ from . import flags
 from .flags import set_flags, get_flags
 from . import enforce
 from .enforce import EnforceNotMet
+from . import train_checkpoint
+from .train_checkpoint import TrainCheckpoint
 from . import contrib
 from . import lod_tensor
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor

@@ -1,0 +1,179 @@
+"""Crash-resumable training checkpoints with retention.
+
+Beyond-reference capability (SURVEY.md §5 "failure detection": the
+reference's story is manual re-launch + load_persistables; it calls
+this a gap for the TPU build to exceed). TrainCheckpoint wraps the
+existing io.save/load machinery with:
+
+  - numbered step directories + an atomically-renamed LATEST marker,
+    so a crash mid-save can never corrupt the resume point
+  - max_to_keep retention
+  - resume() that restores persistables AND returns the step to
+    continue from (0 when no checkpoint exists)
+
+Usage::
+
+    ck = TrainCheckpoint(dirname, exe, main_program, max_to_keep=3)
+    start = ck.resume()
+    for step in range(start, max_steps):
+        exe.run(...)
+        if step % 100 == 0:
+            ck.save(step)
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+
+from . import io as _io
+
+_LATEST = "LATEST"
+
+
+class TrainCheckpoint:
+    def __init__(self, dirname, executor, main_program=None,
+                 max_to_keep=3, sharded=False):
+        self._dir = str(dirname)
+        self._exe = executor
+        self._prog = main_program
+        self._keep = int(max_to_keep)
+        self._sharded = bool(sharded)
+        if self._process_index() == 0:
+            os.makedirs(self._dir, exist_ok=True)
+            self._sweep_orphans()
+        self._barrier()
+
+    @staticmethod
+    def _process_index():
+        try:
+            import jax
+
+            return jax.process_index()
+        except Exception:
+            return 0
+
+    @staticmethod
+    def _process_count():
+        try:
+            import jax
+
+            return jax.process_count()
+        except Exception:
+            return 1
+
+    def _barrier(self):
+        if self._process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("train_checkpoint")
+
+    def _sweep_orphans(self):
+        # kill -9 mid-save leaves full-size staging dirs behind; they
+        # are garbage by construction (never published)
+        for name in os.listdir(self._dir):
+            if name.startswith(".ck_"):
+                shutil.rmtree(os.path.join(self._dir, name),
+                              ignore_errors=True)
+
+    # -- paths ---------------------------------------------------------
+    def _step_dir(self, step):
+        return os.path.join(self._dir, f"step_{int(step)}")
+
+    def _list_steps(self):
+        steps = []
+        for name in os.listdir(self._dir):
+            if name.startswith("step_"):
+                try:
+                    steps.append(int(name[5:]))
+                except ValueError:
+                    continue
+        return sorted(steps)
+
+    def latest_step(self):
+        """The newest COMPLETED save: the marker's step when its dir
+        survives, else the newest on-disk step dir (marker corruption
+        or a lost dir must not silently restart training at 0)."""
+        marker = os.path.join(self._dir, _LATEST)
+        step = None
+        if os.path.exists(marker):
+            try:
+                with open(marker) as f:
+                    step = int(json.load(f)["step"])
+            except (ValueError, KeyError, json.JSONDecodeError):
+                step = None  # truncated marker (e.g. power loss)
+        if step is not None and os.path.isdir(self._step_dir(step)):
+            return step
+        steps = self._list_steps()
+        return steps[-1] if steps else None
+
+    # -- save / resume -------------------------------------------------
+    def save(self, step):
+        """Write persistables for `step`; publish atomically; prune.
+
+        Multi-process sharded saves: every process writes its shards
+        into the SAME deterministic staging dir (save_sharded writes
+        disjoint files per process); rank 0 publishes after a
+        barrier. Re-saving an existing step renames the old dir aside
+        before the publish rename -- there is no window where the
+        marker points at a deleted directory."""
+        final = self._step_dir(step)
+        if self._sharded and self._process_count() > 1:
+            tmp = os.path.join(self._dir, f".ck_incoming_{int(step)}")
+            if self._process_index() == 0:
+                os.makedirs(tmp, exist_ok=True)
+            self._barrier()
+        else:
+            tmp = tempfile.mkdtemp(prefix=".ck_tmp_", dir=self._dir)
+        try:
+            if self._sharded:
+                _io.save_sharded_persistables(self._exe, tmp,
+                                              self._prog)
+            else:
+                _io.save_persistables(self._exe, tmp, self._prog)
+            self._barrier()  # all shards on disk before publish
+            if self._process_index() == 0:
+                old_aside = None
+                if os.path.isdir(final):
+                    old_aside = os.path.join(
+                        self._dir, f".ck_old_{int(step)}")
+                    shutil.rmtree(old_aside, ignore_errors=True)
+                    os.rename(final, old_aside)
+                os.rename(tmp, final)
+                if old_aside is not None:
+                    shutil.rmtree(old_aside, ignore_errors=True)
+        except BaseException:
+            if self._process_index() == 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        if self._process_index() == 0:
+            # marker rename is atomic: readers see old-or-new
+            marker_tmp = os.path.join(self._dir, _LATEST + ".tmp")
+            with open(marker_tmp, "w") as f:
+                json.dump({"step": int(step)}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(marker_tmp, os.path.join(self._dir, _LATEST))
+            self._prune(keep_also=step)
+        self._barrier()
+        return final
+
+    def _prune(self, keep_also):
+        steps = [s for s in self._list_steps() if s != keep_also]
+        for s in steps[:max(0, len(steps) - (self._keep - 1))]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def resume(self):
+        """Restore the newest completed checkpoint; returns the next
+        step to run (saved step + 1), or 0 with untouched state when
+        no checkpoint exists."""
+        step = self.latest_step()
+        if step is None:
+            return 0
+        path = self._step_dir(step)
+        if self._sharded:
+            _io.load_sharded_persistables(self._exe, path, self._prog)
+        else:
+            _io.load_persistables(self._exe, path, self._prog)
+        return step + 1

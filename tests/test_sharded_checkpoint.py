@@ -173,3 +173,109 @@ class TestCheckpointNotify:
         assert any(f.startswith("emb.block0")
                    for f in os.listdir(str(table_dir)))
         reset_endpoints()
+
+
+def test_train_checkpoint_crash_resume(tmp_path):
+    """TrainCheckpoint: save/prune/atomic-marker + crash-resume
+    continuing the exact trajectory (beyond-reference capability,
+    SURVEY §5 failure detection)."""
+    import os
+
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+
+    def build():
+        fluid._reset_global_scope()
+        unique_name.switch()
+        fluid.seed(11)
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=(6,), dtype="float32")
+            y = fluid.layers.data("y", shape=(1,), dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return prog, startup, loss
+
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 6).astype("float32"),
+            "y": rng.rand(8, 1).astype("float32")}
+    d = str(tmp_path / "ck")
+
+    # uninterrupted run: 8 steps, checkpoint every 2
+    prog, startup, loss = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    ck = fluid.TrainCheckpoint(d, exe, prog, max_to_keep=2)
+    assert ck.resume() == 0
+    ref = []
+    for step in range(8):
+        out = exe.run(prog, feed=feed, fetch_list=[loss.name])
+        ref.append(float(np.asarray(out[0])))
+        if step % 2 == 1:
+            ck.save(step)
+    # retention: only max_to_keep step dirs remain
+    kept = [n for n in os.listdir(d) if n.startswith("step_")]
+    assert len(kept) == 2, kept
+    assert ck.latest_step() == 7
+
+    # "crash" after step 5's checkpoint: fresh process resumes at 6
+    prog2, startup2, loss2 = build()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(startup2)
+    ck2 = fluid.TrainCheckpoint(d, exe2, prog2, max_to_keep=2)
+    # simulate the crash point by resuming from step 5's checkpoint
+    import shutil
+    shutil.rmtree(os.path.join(d, "step_7"))
+    import json
+    with open(os.path.join(d, "LATEST"), "w") as f:
+        json.dump({"step": 5}, f)
+    start = ck2.resume()
+    assert start == 6
+    got = []
+    for step in range(start, 8):
+        out = exe2.run(prog2, feed=feed, fetch_list=[loss2.name])
+        got.append(float(np.asarray(out[0])))
+    np.testing.assert_allclose(got, ref[6:], atol=1e-6, rtol=1e-6)
+
+
+def test_train_checkpoint_marker_fallback_and_orphans(tmp_path):
+    """Corrupt/stale LATEST falls back to the newest surviving step
+    dir; orphaned staging dirs are swept at init."""
+    import json
+    import os
+
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=(3,), dtype="float32")
+        fluid.layers.fc(x, size=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / "ck2")
+    ck = fluid.TrainCheckpoint(d, exe, prog, max_to_keep=3)
+    ck.save(1)
+    ck.save(3)
+    # stale marker pointing at a deleted dir -> fall back to step 3
+    with open(os.path.join(d, "LATEST"), "w") as f:
+        json.dump({"step": 9}, f)
+    assert ck.latest_step() == 3
+    # truncated marker (power loss) -> fallback, not a crash
+    with open(os.path.join(d, "LATEST"), "w") as f:
+        f.write("")
+    assert ck.latest_step() == 3
+    assert ck.resume() == 4
+    # re-save of the marker step must never leave a dead marker target
+    ck.save(3)
+    assert ck.latest_step() == 3
+    # orphan staging dirs are swept by a fresh instance
+    os.makedirs(os.path.join(d, ".ck_tmp_orphan"), exist_ok=True)
+    fluid.TrainCheckpoint(d, exe, prog)
+    assert not any(n.startswith(".ck_") for n in os.listdir(d))
