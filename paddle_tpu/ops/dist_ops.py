@@ -258,3 +258,30 @@ def checkpoint_notify(ctx):
 
     io_callback(_do, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
     return None
+
+
+@register_op("gen_nccl_id", differentiable=False)
+def gen_nccl_id(ctx):
+    """reference distributed_ops/gen_nccl_id_op.cc: rank 0 generates an
+    ncclUniqueId and broadcasts it over raw RPC so every trainer can
+    join the NCCL ring. On TPU the coordination service started by
+    jax.distributed.initialize (parallel/env.py) IS this bootstrap --
+    the op validates the env is up and writes a placeholder id so
+    reference startup programs run unchanged."""
+    trainers = ctx.attr("trainers", [])
+    if len(trainers) > 1 and jax.process_count() == 1:
+        raise RuntimeError(
+            "gen_nccl_id: multi-trainer program but jax.distributed is "
+            "not initialized -- call "
+            "paddle_tpu.parallel.init_distributed_env() first (the "
+            "coordination-service replacement for the NCCL-id exchange)")
+    return {"NCCLID": jnp.zeros((1,), jnp.int32)}
+
+
+@register_op("ncclInit", differentiable=False)
+def nccl_init(ctx):
+    """reference nccl/nccl_op.cc ncclInit: build communicators for a
+    device list. XLA collectives need no runtime communicator objects
+    (compiler-scheduled over ICI); parity marker writing a placeholder
+    Communicator."""
+    return {"Communicator": jnp.zeros((1,), jnp.int32)}

@@ -183,3 +183,83 @@ def lstm_unit(ctx):
     c = f * c_prev + i * jnp.tanh(gc)
     h = jax.nn.sigmoid(go) * jnp.tanh(c)
     return {"C": c, "H": h}
+
+
+@register_op("cudnn_lstm", needs_rng=True)
+def cudnn_lstm(ctx):
+    """reference cudnn_lstm_op.cc / cudnn_rnn_cache.h: multi-layer
+    LSTM over seq-major input with cuDNN's canonically PACKED flat
+    weight vector. TPU lowering: unpack W into per-layer (Wx, Wh,
+    bx, bh) and run the same scan the `lstm` op uses -- one XLA
+    program, no cuDNN. Packing layout (cudnnGetRNNLinLayerMatrixParams
+    order): per layer the 8 matrices [Wi Wf Wc Wo | Ri Rf Rc Ro], then
+    per layer the 8 bias vectors in the same order. Gate order
+    i, f, c(candidate), o. Input [T, B, I] (seq-major, the cuDNN
+    convention), InitH/InitC [L, B, H]; is_bidirec is not lowered.
+    """
+    x = ctx.input("Input")            # [T, B, I]
+    w = ctx.input("W").reshape(-1)
+    h0 = ctx.input("InitH")
+    c0 = ctx.input("InitC")
+    hidden = int(ctx.attr("hidden_size", 100))
+    in_size = int(ctx.attr("input_size", x.shape[-1]))
+    layers = int(ctx.attr("num_layers", 1))
+    dropout_p = float(ctx.attr("dropout_prob", 0.0))
+    is_test = ctx.attr("is_test", False)
+    if ctx.attr("is_bidirec", False):
+        raise ValueError("cudnn_lstm: is_bidirec is not lowered on "
+                         "TPU; stack a reversed direction explicitly")
+    t, b, _ = x.shape
+    h = hidden
+
+    # unpack the cuDNN canonical flat weights
+    mats = []
+    off = 0
+    for l in range(layers):
+        isz = in_size if l == 0 else h
+        wx = w[off:off + 4 * h * isz].reshape(4 * h, isz)
+        off += 4 * h * isz
+        wh = w[off:off + 4 * h * h].reshape(4 * h, h)
+        off += 4 * h * h
+        mats.append((wx, wh))
+    biases = []
+    for l in range(layers):
+        bx = w[off:off + 4 * h]
+        off += 4 * h
+        bh = w[off:off + 4 * h]
+        off += 4 * h
+        biases.append(bx + bh)
+
+    if h0 is None:
+        h0 = jnp.zeros((layers, b, h), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((layers, b, h), x.dtype)
+
+    seq = x
+    last_h, last_c = [], []
+    for l in range(layers):
+        wx, wh = mats[l]
+        bias = biases[l]
+        pre = jnp.einsum("tbi,gi->tbg", seq, wx) + bias
+
+        def cell(carry, xt):
+            hp, cp = carry
+            gates = xt + hp @ wh.T
+            gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(gi)
+            f = jax.nn.sigmoid(gf)
+            c = f * cp + i * jnp.tanh(gc)
+            o = jax.nn.sigmoid(go)
+            hh = o * jnp.tanh(c)
+            return (hh, c), hh
+
+        (hT, cT), hs = jax.lax.scan(cell, (h0[l], c0[l]), pre)
+        last_h.append(hT)
+        last_c.append(cT)
+        seq = hs
+        if dropout_p and not is_test and l < layers - 1:
+            keep = jax.random.bernoulli(ctx.rng(), 1.0 - dropout_p,
+                                        seq.shape)
+            seq = seq * keep / (1.0 - dropout_p)
+    return {"Out": seq, "last_h": jnp.stack(last_h),
+            "last_c": jnp.stack(last_c)}

@@ -153,3 +153,65 @@ def test_transformer_tiny_trains():
                                   "label": src}, fetch_list=[cost])
         losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
     assert losses[-1] < losses[0]
+
+
+from op_test import OpTest
+
+
+class TestCudnnLSTM(OpTest):
+    """cudnn_lstm with the canonical packed weight layout vs a numpy
+     2-layer LSTM oracle (reference cudnn_lstm_op.cc)."""
+
+    def setUp(self):
+        super().setUp()
+        self.op_type = "cudnn_lstm"
+        t, b, isz, h, layers = 3, 2, 4, 5, 2
+        r = np.random.RandomState(0)
+        x = (r.randn(t, b, isz) * 0.3).astype("float32")
+        h0 = (r.randn(layers, b, h) * 0.3).astype("float32")
+        c0 = (r.randn(layers, b, h) * 0.3).astype("float32")
+        mats, flat = [], []
+        for l in range(layers):
+            i_l = isz if l == 0 else h
+            wx = (r.randn(4 * h, i_l) * 0.3).astype("float32")
+            wh = (r.randn(4 * h, h) * 0.3).astype("float32")
+            mats.append((wx, wh))
+            flat += [wx.ravel(), wh.ravel()]
+        bias = []
+        for l in range(layers):
+            bx = (r.randn(4 * h) * 0.3).astype("float32")
+            bh = (r.randn(4 * h) * 0.3).astype("float32")
+            bias.append(bx + bh)
+            flat += [bx, bh]
+        w = np.concatenate(flat)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        seq = x
+        last_h = np.zeros((layers, b, h), np.float32)
+        last_c = np.zeros((layers, b, h), np.float32)
+        for l in range(layers):
+            wx, wh = mats[l]
+            hs = np.zeros((t, b, h), np.float32)
+            hp, cp = h0[l].copy(), c0[l].copy()
+            for step in range(t):
+                g = seq[step] @ wx.T + hp @ wh.T + bias[l]
+                gi, gf, gc, go = np.split(g, 4, axis=1)
+                cp = sig(gf) * cp + sig(gi) * np.tanh(gc)
+                hp = sig(go) * np.tanh(cp)
+                hs[step] = hp
+            last_h[l], last_c[l] = hp, cp
+            seq = hs
+        self.inputs = {"Input": x, "W": w, "InitH": h0, "InitC": c0}
+        self.attrs = {"hidden_size": h, "input_size": isz,
+                      "num_layers": layers, "is_test": True}
+        self.outputs = {"Out": seq, "last_h": last_h,
+                        "last_c": last_c}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input", "W"], "Out",
+                        no_grad_set={"InitH", "InitC"})
