@@ -13,9 +13,12 @@ program-level passes that still matter (conv+bn fold, fc fuse, dropout
 removal) run before compilation via paddle_tpu.ir.
 """
 from .config import AnalysisConfig, NativeConfig, PaddleDType
+from .export import (StableHLOServer, export_stablehlo,
+                     load_stablehlo)
 from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
 
 __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "AnalysisPredictor", "PaddlePredictor", "PaddleTensor",
-           "ZeroCopyTensor", "create_paddle_predictor"]
+           "ZeroCopyTensor", "create_paddle_predictor",
+           "StableHLOServer", "export_stablehlo", "load_stablehlo"]

@@ -1,0 +1,146 @@
+"""StableHLO serving export.
+
+SURVEY.md §5 checkpoint/resume: "keep save_inference_model-style
+export (StableHLO) as the serving artifact". Reference counterpart:
+python/paddle/fluid/io.py:865 save_inference_model writes a frozen
+ProgramDesc (`__model__`) that inference/io.cc + NaiveExecutor
+(framework/naive_executor.h) re-interpret per request; the TPU-native
+serving artifact is the COMPILED program itself: the whole inference
+block traced to one XLA computation with the parameters baked in as
+constants, serialized with jax.export (StableHLO + calling
+convention), loadable and runnable with no paddle_tpu op registry, no
+Program interpretation -- any jax-capable server can run it.
+
+    export_stablehlo(model_dir, example_feeds, out_path)
+    served = load_stablehlo(out_path)
+    fetches = served(feed_dict)          # list of np arrays
+
+The artifact directory holds `model.stablehlo` (serialized Exported)
+plus `meta.json` (feed order/shapes/dtypes + fetch names).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+import numpy as np
+
+
+def export_stablehlo(model_dir, example_feeds: Dict[str, np.ndarray],
+                     out_path, ir_optim: bool = True,
+                     platforms=None) -> str:
+    """Freeze the inference model at `model_dir` for the shapes of
+    `example_feeds` and serialize it as StableHLO.
+
+    Params are baked as constants (self-contained artifact). Returns
+    out_path. `platforms` optionally pins lowering platforms (e.g.
+    ["tpu", "cpu"]); default is the current backend."""
+    import jax
+    from jax import export as jexport
+
+    from .config import AnalysisConfig
+    from .predictor import AnalysisPredictor
+
+    cfg = AnalysisConfig(str(model_dir))
+    cfg.switch_ir_optim(bool(ir_optim))
+    pred = AnalysisPredictor(cfg)
+    feed_names = pred.get_input_names()
+    missing = [n for n in feed_names if n not in example_feeds]
+    if missing:
+        raise ValueError(f"example_feeds missing inputs: {missing}")
+
+    from ..core.executor import _analyze_block, _build_step_fn
+
+    block = pred._program.global_block
+    fetch_names = pred._fetch_names
+    mutated, const, state_out = _analyze_block(
+        block, tuple(sorted(feed_names)), list(fetch_names))
+    step = _build_step_fn(block, tuple(sorted(feed_names)), mutated,
+                          const, state_out, list(fetch_names))
+    scope = pred._scope
+    state_m = {n: np.asarray(scope._get(n)) for n in mutated}
+    state_c = {n: np.asarray(scope._get(n)) for n in const}
+    rng = jax.random.PRNGKey(0)
+
+    def serve(feeds):
+        # params closed over (lowered to constants); inference programs
+        # have no state writes worth keeping, fetches are the contract
+        _, fetches, _ = step(state_m, state_c, feeds, rng)
+        return fetches
+
+    from ..core.executor import _coerce_feed, _var_np_dtype
+
+    # coerce exactly like the live Executor path (executor.py:345):
+    # the trace and the advertised meta dtypes must both be the
+    # model's declared dtypes, not the caller's raw arrays (float64
+    # examples would otherwise record a dtype the computation was
+    # never traced with)
+    example = {n: np.asarray(_coerce_feed(example_feeds[n],
+                                          _var_np_dtype(block, n)))
+               for n in feed_names}
+    kwargs = {}
+    if platforms is not None:
+        kwargs["platforms"] = tuple(platforms)
+    exported = jexport.export(jax.jit(serve), **kwargs)(example)
+    blob = exported.serialize()
+
+    out_path = str(out_path)
+    os.makedirs(out_path, exist_ok=True)
+    with open(os.path.join(out_path, "model.stablehlo"), "wb") as f:
+        f.write(blob)
+    meta = {
+        "feed_names": list(feed_names),
+        "fetch_names": list(fetch_names),
+        "feeds": {n: {"shape": list(example[n].shape),
+                      "dtype": str(example[n].dtype)}
+                  for n in feed_names},
+    }
+    with open(os.path.join(out_path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return out_path
+
+
+class StableHLOServer:
+    """Loaded serving artifact: a plain callable over feed dicts
+    (the NaiveExecutor-serving role, framework/naive_executor.h,
+    without any program interpretation)."""
+
+    def __init__(self, dirname):
+        from jax import export as jexport
+
+        dirname = str(dirname)
+        with open(os.path.join(dirname, "model.stablehlo"), "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        with open(os.path.join(dirname, "meta.json")) as f:
+            self._meta = json.load(f)
+
+    @property
+    def feed_names(self) -> List[str]:
+        return list(self._meta["feed_names"])
+
+    @property
+    def fetch_names(self) -> List[str]:
+        return list(self._meta["fetch_names"])
+
+    def __call__(self, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        spec = self._meta["feeds"]
+        arrs = {}
+        for n in self.feed_names:
+            if n not in feeds:
+                raise ValueError(f"missing feed {n!r}")
+            a = np.asarray(feeds[n])
+            want = tuple(spec[n]["shape"])
+            if tuple(a.shape) != want:
+                raise ValueError(
+                    f"feed {n!r}: shape {a.shape} != exported {want} "
+                    f"(StableHLO artifacts are shape-specialized)")
+            arrs[n] = a.astype(spec[n]["dtype"], copy=False)
+        outs = self._exported.call(arrs)
+        return [np.asarray(o) for o in outs]
+
+
+def load_stablehlo(dirname) -> StableHLOServer:
+    """Counterpart of reference io.py:1020 load_inference_model for
+    the StableHLO artifact."""
+    return StableHLOServer(dirname)

@@ -210,3 +210,49 @@ def test_clone_survives_export_dir_removal(tmp_path):
     cfg_a, cfg_b = pred._config, clone._config
     cfg_b.append_pass("made_up_pass")
     assert "made_up_pass" not in cfg_a.all_passes()
+
+
+def test_stablehlo_export_round_trip(tmp_path):
+    """StableHLO serving export (SURVEY §5: the TPU-native
+    save_inference_model artifact): exported program must reproduce
+    the live predictor bit-for-bit at the exported shape, reject other
+    shapes, and carry feed/fetch metadata."""
+    import os
+
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        img = fluid.layers.data("img", shape=(1, 8, 8),
+                                dtype="float32")
+        conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                   padding=1, act="relu")
+        pool = fluid.layers.pool2d(conv, pool_size=8, pool_type="avg")
+        out = fluid.layers.fc(pool, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(2, 1, 8, 8).astype("float32")
+    ref = np.asarray(exe.run(prog, feed={"img": xv},
+                             fetch_list=[out.name])[0])
+
+    mdir = str(tmp_path / "model")
+    fluid.save_inference_model(mdir, ["img"],
+                               [prog.global_block.var(out.name)], exe,
+                               main_program=prog)
+    sdir = str(tmp_path / "served")
+    fluid.inference.export_stablehlo(mdir, {"img": xv}, sdir)
+    assert sorted(os.listdir(sdir)) == ["meta.json", "model.stablehlo"]
+
+    served = fluid.inference.load_stablehlo(sdir)
+    assert served.feed_names == ["img"]
+    got = served({"img": xv})[0]
+    np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="shape-specialized"):
+        served({"img": xv[:1]})
+    with pytest.raises(ValueError, match="missing feed"):
+        served({})
