@@ -13,12 +13,15 @@ program-level passes that still matter (conv+bn fold, fc fuse, dropout
 removal) run before compilation via paddle_tpu.ir.
 """
 from .config import AnalysisConfig, NativeConfig, PaddleDType
-from .export import (StableHLOServer, export_stablehlo,
-                     load_stablehlo)
+from .export import (StableHLOServer, StableHLOTrainer,
+                     export_stablehlo, export_train_stablehlo,
+                     load_stablehlo, load_train_stablehlo)
 from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
 
 __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "AnalysisPredictor", "PaddlePredictor", "PaddleTensor",
            "ZeroCopyTensor", "create_paddle_predictor",
-           "StableHLOServer", "export_stablehlo", "load_stablehlo"]
+           "StableHLOServer", "export_stablehlo", "load_stablehlo",
+           "StableHLOTrainer", "export_train_stablehlo",
+           "load_train_stablehlo"]

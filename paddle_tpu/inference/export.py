@@ -90,6 +90,7 @@ def export_stablehlo(model_dir, example_feeds: Dict[str, np.ndarray],
     with open(os.path.join(out_path, "model.stablehlo"), "wb") as f:
         f.write(blob)
     meta = {
+        "kind": "inference",
         "feed_names": list(feed_names),
         "fetch_names": list(fetch_names),
         "feeds": {n: {"shape": list(example[n].shape),
@@ -110,10 +111,12 @@ class StableHLOServer:
         from jax import export as jexport
 
         dirname = str(dirname)
+        self._dirname = dirname
         with open(os.path.join(dirname, "model.stablehlo"), "rb") as f:
             self._exported = jexport.deserialize(f.read())
         with open(os.path.join(dirname, "meta.json")) as f:
             self._meta = json.load(f)
+        self._check_kind()
 
     @property
     def feed_names(self) -> List[str]:
@@ -123,7 +126,17 @@ class StableHLOServer:
     def fetch_names(self) -> List[str]:
         return list(self._meta["fetch_names"])
 
-    def __call__(self, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
+    _KIND = "inference"
+
+    def _check_kind(self):
+        kind = self._meta.get("kind", "inference")
+        if kind != self._KIND:
+            raise ValueError(
+                f"artifact at {self._dirname!r} is a {kind!r} export; "
+                f"load it with "
+                f"{'load_train_stablehlo' if kind == 'train_step' else 'load_stablehlo'}")
+
+    def _coerce_feeds(self, feeds):
         spec = self._meta["feeds"]
         arrs = {}
         for n in self.feed_names:
@@ -136,7 +149,10 @@ class StableHLOServer:
                     f"feed {n!r}: shape {a.shape} != exported {want} "
                     f"(StableHLO artifacts are shape-specialized)")
             arrs[n] = a.astype(spec[n]["dtype"], copy=False)
-        outs = self._exported.call(arrs)
+        return arrs
+
+    def __call__(self, feeds: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        outs = self._exported.call(self._coerce_feeds(feeds))
         return [np.asarray(o) for o in outs]
 
 
@@ -144,3 +160,119 @@ def load_stablehlo(dirname) -> StableHLOServer:
     """Counterpart of reference io.py:1020 load_inference_model for
     the StableHLO artifact."""
     return StableHLOServer(dirname)
+
+
+def export_train_stablehlo(main_program, scope, example_feeds,
+                           fetch_names, out_path, platforms=None) -> str:
+    """Freeze a TRAINING step as a StableHLO artifact.
+
+    Counterpart of the reference's C++ train-from-saved-program demo
+    (inference/train/demo/, train/test_train_recognize_digits.cc:
+    train a `__model__` + startup artifact with no Python). Here the
+    artifact is the whole compiled train step with explicit state
+    threading:
+
+        served = load_stablehlo(out)
+        state = served.initial_state()           # from export time
+        state, fetches = served.train_step(state, feeds)
+
+    so any jax-capable runtime can drive the training loop. Optimizer
+    state/params ride as inputs+outputs (NOT constants -- they must
+    update); feeds are shape-specialized like the inference export."""
+    import jax
+    from jax import export as jexport
+
+    from ..core.executor import (_analyze_block, _build_step_fn,
+                                 _coerce_feed, _var_np_dtype)
+
+    block = main_program.global_block
+    feed_names = sorted(example_feeds)
+    mutated, const, state_out = _analyze_block(
+        block, tuple(feed_names), list(fetch_names))
+    step = _build_step_fn(block, tuple(feed_names), mutated, const,
+                          state_out, list(fetch_names))
+    state0 = {n: np.asarray(scope._get(n)) for n in mutated}
+    const0 = {n: np.asarray(scope._get(n)) for n in const}
+    from ..core.executor import RNG_VAR, _global_seed
+
+    # exactly Executor.run's key source: the scope's current step key
+    # (already advanced by e.g. the startup run) when present, else
+    # program seed, else global seed -- so the artifact continues the
+    # live session's trajectory bit-for-bit
+    rng0 = scope._get(RNG_VAR)
+    if rng0 is None:
+        seed = getattr(main_program, "_seed", None)
+        if seed is None:
+            seed = _global_seed[0]
+        rng0 = jax.random.PRNGKey(int(seed))
+    rng0 = np.asarray(rng0)
+
+    def train_step(state, rng, feeds):
+        new_state, fetches, rng_out = step(state, const0, feeds, rng)
+        # next step re-reads only `mutated` (executor.py semantics);
+        # returning the full state_out set would make the returned
+        # pytree an invalid input to the traced signature
+        return ({n: new_state[n] for n in mutated}, rng_out, fetches)
+
+    example = {n: np.asarray(_coerce_feed(example_feeds[n],
+                                          _var_np_dtype(block, n)))
+               for n in feed_names}
+    kwargs = {}
+    if platforms is not None:
+        kwargs["platforms"] = tuple(platforms)
+    exported = jexport.export(jax.jit(train_step), **kwargs)(
+        state0, rng0, example)
+
+    out_path = str(out_path)
+    os.makedirs(out_path, exist_ok=True)
+    with open(os.path.join(out_path, "model.stablehlo"), "wb") as f:
+        f.write(exported.serialize())
+    np.savez(os.path.join(out_path, "state0.npz"), **state0)
+    np.save(os.path.join(out_path, "rng0.npy"), rng0)
+    meta = {
+        "kind": "train_step",
+        "feed_names": feed_names,
+        "fetch_names": list(fetch_names),
+        "state_names": sorted(state0),
+        "feeds": {n: {"shape": list(example[n].shape),
+                      "dtype": str(example[n].dtype)}
+                  for n in feed_names},
+    }
+    with open(os.path.join(out_path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return out_path
+
+
+class StableHLOTrainer(StableHLOServer):
+    """Loaded train-step artifact: initial_state() + train_step().
+    The PRNG key rides in the state dict under "__rng__" so sampling
+    ops (dropout) advance exactly like the live Executor."""
+
+    _KIND = "train_step"
+    _RNG = "__rng__"
+
+    def initial_state(self):
+        path = os.path.join(self._dirname, "state0.npz")
+        with np.load(path) as z:
+            state = {k: z[k] for k in z.files}
+        state[self._RNG] = np.load(
+            os.path.join(self._dirname, "rng0.npy"))
+        return state
+
+    def train_step(self, state, feeds):
+        state = dict(state)
+        rng = state.pop(self._RNG)
+        new_state, rng_out, fetches = self._exported.call(
+            state, rng, self._coerce_feeds(feeds))
+        new_state = dict(new_state)
+        new_state[self._RNG] = np.asarray(rng_out)
+        return new_state, [np.asarray(f) for f in fetches]
+
+    def __call__(self, feeds):
+        raise TypeError("this is a train_step artifact: use "
+                        "train_step(state, feeds), starting from "
+                        "initial_state()")
+
+
+def load_train_stablehlo(dirname) -> StableHLOTrainer:
+    return StableHLOTrainer(dirname)

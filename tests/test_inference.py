@@ -256,3 +256,93 @@ def test_stablehlo_export_round_trip(tmp_path):
         served({"img": xv[:1]})
     with pytest.raises(ValueError, match="missing feed"):
         served({})
+
+
+def test_stablehlo_train_step_export(tmp_path):
+    """Train-step StableHLO artifact (reference C++ train demo
+    capability, inference/train/demo): driving the frozen step from
+    its saved initial state reproduces the live trajectory exactly."""
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    fluid.seed(3)
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=(6,), dtype="float32")
+        y = fluid.layers.data("y", shape=(1,), dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    feed = {"x": r.rand(8, 6).astype("float32"),
+            "y": r.rand(8, 1).astype("float32")}
+    out = str(tmp_path / "train_art")
+    fluid.inference.export_train_stablehlo(
+        prog, fluid.global_scope(), feed, [loss.name], out)
+    live = [float(np.asarray(exe.run(prog, feed=feed,
+                                     fetch_list=[loss.name])[0]))
+            for _ in range(5)]
+    tr = fluid.inference.load_train_stablehlo(out)
+    state = tr.initial_state()
+    art = []
+    for _ in range(5):
+        state, fetches = tr.train_step(state, feed)
+        art.append(float(fetches[0].reshape(-1)[0]))
+    np.testing.assert_allclose(art, live, atol=1e-6, rtol=1e-6)
+    assert art[-1] < art[0]
+
+
+def test_stablehlo_train_step_with_dropout_rng(tmp_path):
+    """The train artifact threads the PRNG key (state["__rng__"]):
+    dropout draws fresh masks per step and the trajectory matches the
+    live Executor seeded identically."""
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+
+    fluid._reset_global_scope()
+    unique_name.switch()
+    fluid.seed(9)
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=(6,), dtype="float32")
+        y = fluid.layers.data("y", shape=(1,), dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        h = fluid.layers.dropout(
+            h, 0.4, dropout_implementation="upscale_in_train")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(1)
+    feed = {"x": r.rand(8, 6).astype("float32"),
+            "y": r.rand(8, 1).astype("float32")}
+    out = str(tmp_path / "train_do")
+    fluid.inference.export_train_stablehlo(
+        prog, fluid.global_scope(), feed, [loss.name], out)
+    live = [float(np.asarray(exe.run(prog, feed=feed,
+                                     fetch_list=[loss.name])[0]))
+            for _ in range(5)]
+    tr = fluid.inference.load_train_stablehlo(out)
+    state = tr.initial_state()
+    art = []
+    for _ in range(5):
+        state, fetches = tr.train_step(state, feed)
+        art.append(float(fetches[0].reshape(-1)[0]))
+    np.testing.assert_allclose(art, live, atol=1e-6, rtol=1e-6)
+    # fresh noise per step: consecutive losses are not locked to one
+    # repeated mask trajectory (coarse check: steps differ)
+    assert len({round(v, 8) for v in art}) == len(art)
+    # kind validation both ways
+    with pytest.raises(ValueError, match="train_step"):
+        fluid.inference.load_stablehlo(out)
+    with pytest.raises(TypeError, match="train_step artifact"):
+        tr({"x": feed["x"]})
