@@ -8,8 +8,10 @@ common.py. Usage matches the reference:
         paddle_tpu.readers.shuffle(paddle_tpu.dataset.mnist.train(), 500),
         batch_size=128)
 """
-from . import (cifar, common, conll05, flowers, image, imdb, mnist,
+from . import (cifar, common, conll05, flowers, image, imdb, imikolov,
+               mnist, mq2007, sentiment, voc2012,
                movielens, uci_housing, wmt14, wmt16)
 
-__all__ = ["mnist", "cifar", "imdb", "uci_housing", "movielens", "wmt14",
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "mq2007",
+           "sentiment", "voc2012", "uci_housing", "movielens", "wmt14",
            "wmt16", "conll05", "flowers", "image", "common"]

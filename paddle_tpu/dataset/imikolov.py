@@ -1,0 +1,79 @@
+"""PTB (imikolov) language-model readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/imikolov.py -- build_dict()
+token -> id with '<unk>'/'<e>'/'<s>'; train/test(word_idx, n) yield
+n-gram tuples (DataType.NGRAM) or (src_seq, trg_seq) next-word pairs
+(DataType.SEQ). Synthetic corpus: a deterministic order-2 Markov chain
+over the vocab so LM perplexity actually improves during training.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+VOCAB_SIZE = 2074  # reference build_dict(min_word_freq=50) scale
+TRAIN_SENTENCES = 2048
+TEST_SENTENCES = 256
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq=50):
+    # min_word_freq shapes the vocab like the reference's frequency
+    # cutoff: the synthetic corpus has a fixed frequency profile, so
+    # scale the vocab inversely with the cutoff (50 -> reference size)
+    vocab = max(8, int(VOCAB_SIZE * 50 / max(int(min_word_freq), 1)))
+    d = {"w%d" % i: i for i in range(vocab - 3)}
+    d["<unk>"] = vocab - 3
+    d["<s>"] = vocab - 2
+    d["<e>"] = vocab - 1
+    return d
+
+
+def _sentences(n_sent, vocab, seed):
+    rng = np.random.RandomState(seed)
+    # deterministic sparse bigram table: each word strongly prefers a
+    # few successors (so an LM has signal to learn)
+    succ = rng.randint(0, vocab, size=(vocab, 4))
+    for _ in range(n_sent):
+        length = int(rng.randint(5, 25))
+        w = int(rng.randint(0, vocab))
+        sent = [w]
+        for _ in range(length - 1):
+            w = int(succ[w, rng.randint(0, 4)])
+            sent.append(w)
+        yield sent
+
+
+def reader_creator(word_idx, n, data_type, n_sent, seed):
+    vocab = len(word_idx) - 3
+    bos = word_idx["<s>"]
+    eos = word_idx["<e>"]
+
+    def reader():
+        for sent in _sentences(n_sent, vocab, seed):
+            if DataType.NGRAM == data_type:
+                l = [bos] + sent + [eos]
+                if len(l) >= n:
+                    l = np.asarray(l, dtype="int64")
+                    for i in range(n, len(l) + 1):
+                        yield tuple(l[i - n:i])
+            elif DataType.SEQ == data_type:
+                l = sent
+                src_seq = [bos] + l
+                trg_seq = l + [eos]
+                yield src_seq, trg_seq
+            else:
+                raise ValueError(f"Unknown data type {data_type}")
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator(word_idx, n, data_type, TRAIN_SENTENCES, 201)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator(word_idx, n, data_type, TEST_SENTENCES, 202)

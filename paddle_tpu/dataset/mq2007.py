@@ -1,0 +1,57 @@
+"""MQ2007 learning-to-rank readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/mq2007.py -- readers in three
+formats: pointwise (feature_vector, relevance), pairwise
+(feature_left, feature_right) with left more relevant, listwise
+(label_list, feature_list per query). 46 LETOR features; relevance in
+{0,1,2}. Synthetic queries: a hidden linear scorer generates
+consistent relevance so rankers converge.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+FEATURE_DIM = 46
+TRAIN_QUERIES = 128
+TEST_QUERIES = 32
+
+
+def _queries(n_query, seed):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(FEATURE_DIM)
+    for _ in range(n_query):
+        n_doc = int(rng.randint(5, 20))
+        feats = rng.rand(n_doc, FEATURE_DIM).astype("float32")
+        score = feats @ w
+        ranks = np.argsort(np.argsort(score))
+        rel = (ranks * 3 // max(n_doc, 1)).astype("int64")  # 0..2
+        yield feats, rel
+
+
+def __reader__(n_query, seed, format="pairwise"):
+    def pointwise():
+        for feats, rel in _queries(n_query, seed):
+            for f, r in zip(feats, rel):
+                yield f, int(r)
+
+    def pairwise():
+        for feats, rel in _queries(n_query, seed):
+            for i in range(len(rel)):
+                for j in range(len(rel)):
+                    if rel[i] > rel[j]:
+                        yield feats[i], feats[j]
+
+    def listwise():
+        for feats, rel in _queries(n_query, seed):
+            yield [int(r) for r in rel], [f for f in feats]
+
+    return {"pointwise": pointwise, "pairwise": pairwise,
+            "listwise": listwise}[format]
+
+
+def train(format="pairwise"):
+    return __reader__(TRAIN_QUERIES, 501, format=format)
+
+
+def test(format="pairwise"):
+    return __reader__(TEST_QUERIES, 502, format=format)

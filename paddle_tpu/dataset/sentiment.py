@@ -1,0 +1,34 @@
+"""NLTK movie-review sentiment readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/sentiment.py -- get_word_dict()
+sorted by frequency; train()/test() yield (word_id_list, label in
+{0,1}) over the reference's 1600/400 train/test split
+(NUM_TRAINING_INSTANCES of NUM_TOTAL_INSTANCES). Synthetic corpus reuses the imdb generator
+at the movie_reviews corpus scale.
+"""
+from __future__ import annotations
+
+from . import imdb as _imdb
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+_VOCAB = 3000
+
+
+def _word_idx():
+    return {"w%d" % i: i for i in range(_VOCAB)}
+
+
+def get_word_dict():
+    return sorted(_word_idx().items(), key=lambda kv: kv[1])
+
+
+def train():
+    return _imdb._make_reader(_word_idx(), NUM_TRAINING_INSTANCES,
+                              seed=301)
+
+
+def test():
+    return _imdb._make_reader(
+        _word_idx(), NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES,
+        seed=302)

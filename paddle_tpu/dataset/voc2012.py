@@ -1,0 +1,45 @@
+"""VOC2012 segmentation readers (synthetic, deterministic).
+
+Parity: reference python/paddle/dataset/voc2012.py -- train()/test()/
+val() yield (image CHW float, label HW int) segmentation pairs with
+21 classes. Synthetic scenes: axis-aligned class rectangles whose
+pixel statistics correlate with the class id, so segmentation models
+learn.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+CLASS_NUM = 21
+TRAIN_SIZE = 256
+TEST_SIZE = 64
+_H = _W = 96
+
+
+def _make_reader(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = np.zeros((_H, _W), np.int32)
+            img = rng.rand(3, _H, _W).astype("float32") * 0.2
+            for _ in range(int(rng.randint(1, 4))):
+                cls = int(rng.randint(1, CLASS_NUM))
+                y0, x0 = rng.randint(0, _H // 2), rng.randint(0, _W // 2)
+                h, w = rng.randint(8, _H // 2), rng.randint(8, _W // 2)
+                label[y0:y0 + h, x0:x0 + w] = cls
+                img[:, y0:y0 + h, x0:x0 + w] += cls / CLASS_NUM
+            yield img, label
+
+    return reader
+
+
+def train():
+    return _make_reader(TRAIN_SIZE, 401)
+
+
+def test():
+    return _make_reader(TEST_SIZE, 402)
+
+
+def val():
+    return _make_reader(TEST_SIZE, 403)

@@ -223,3 +223,43 @@ class TestEndToEndWithExecutor:
             out, = exe.run(feed=feeder.feed(batch), fetch_list=[loss])
             losses.append(float(out))
         assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1
+
+
+def test_imikolov_readers():
+    from paddle_tpu.dataset import imikolov
+
+    d = imikolov.build_dict()
+    grams = list(imikolov.train(d, 5)())[:50]
+    assert all(len(g) == 5 for g in grams)
+    vocab = len(d)
+    assert all(0 <= w < vocab for g in grams for w in g)
+    src, trg = next(iter(imikolov.train(
+        d, 5, imikolov.DataType.SEQ)()))
+    assert len(src) == len(trg)
+    assert src[0] == d["<s>"] and trg[-1] == d["<e>"]
+    # deterministic across constructions
+    g2 = list(imikolov.train(d, 5)())[:50]
+    assert grams == g2
+
+
+def test_sentiment_and_voc2012_readers():
+    from paddle_tpu.dataset import sentiment, voc2012
+
+    wd = sentiment.get_word_dict()
+    assert wd[0][1] == 0
+    s = list(sentiment.train()())[:20]
+    assert all(lab in (0, 1) for _, lab in s)
+    img, lab = next(iter(voc2012.train()()))
+    assert img.shape[0] == 3 and img.shape[1:] == lab.shape
+    assert 0 <= lab.max() < voc2012.CLASS_NUM
+
+
+def test_mq2007_formats():
+    from paddle_tpu.dataset import mq2007
+
+    f, r = next(iter(mq2007.train("pointwise")()))
+    assert f.shape == (mq2007.FEATURE_DIM,) and r in (0, 1, 2)
+    a, b = next(iter(mq2007.train("pairwise")()))
+    assert a.shape == b.shape == (mq2007.FEATURE_DIM,)
+    labels, feats = next(iter(mq2007.train("listwise")()))
+    assert len(labels) == len(feats)
