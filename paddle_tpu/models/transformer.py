@@ -29,9 +29,32 @@ def _position_encoding(max_len, d_model):
 def multi_head_attention(q_in, kv_in, d_model, n_heads, dropout_rate,
                          causal=False, is_test=False):
     head_dim = d_model // n_heads
-    q = layers.fc(q_in, d_model, num_flatten_dims=2, bias_attr=False)
-    k = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False)
-    v = layers.fc(kv_in, d_model, num_flatten_dims=2, bias_attr=False)
+    # fused projections: XLA does NOT merge separate dots over the
+    # same operand, so 3 (or 2) [*,512]x[512,512] matmuls become one
+    # wider MXU-friendlier matmul, split after. Explicit Xavier fans
+    # keep the init scale identical to THREE separate [d,d]
+    # projections (the fused shape would otherwise shrink it ~29%),
+    # and explicit param names keep the checkpoint layout stable and
+    # mismatches detectable.
+    from ..initializer import XavierInitializer
+    from .. import unique_name
+
+    def _proj_attr(tag):
+        return ParamAttr(
+            name=unique_name.generate(f"attn_{tag}_proj.w"),
+            initializer=XavierInitializer(fan_in=d_model,
+                                          fan_out=d_model))
+
+    if q_in is kv_in:
+        qkv = layers.fc(q_in, 3 * d_model, num_flatten_dims=2,
+                        bias_attr=False, param_attr=_proj_attr("qkv"))
+        q, k, v = layers.split(qkv, 3, dim=2)
+    else:
+        q = layers.fc(q_in, d_model, num_flatten_dims=2,
+                      bias_attr=False, param_attr=_proj_attr("q"))
+        kv = layers.fc(kv_in, 2 * d_model, num_flatten_dims=2,
+                       bias_attr=False, param_attr=_proj_attr("kv"))
+        k, v = layers.split(kv, 2, dim=2)
 
     def split_heads(x):
         # [B,T,H,D] stays put: attention(layout='bthd') batches over
