@@ -11,8 +11,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from .. import layers
-from ..initializer import NumpyArrayInitializer
+from .. import layers, unique_name
+from ..initializer import NumpyArrayInitializer, XavierInitializer
 from ..param_attr import ParamAttr
 
 
@@ -36,9 +36,6 @@ def multi_head_attention(q_in, kv_in, d_model, n_heads, dropout_rate,
     # projections (the fused shape would otherwise shrink it ~29%),
     # and explicit param names keep the checkpoint layout stable and
     # mismatches detectable.
-    from ..initializer import XavierInitializer
-    from .. import unique_name
-
     def _proj_attr(tag):
         return ParamAttr(
             name=unique_name.generate(f"attn_{tag}_proj.w"),

@@ -1,0 +1,41 @@
+"""Worker exercising every allreduce reduce_type across real
+processes (reference distributed_ops/allreduce_op.cc red_type enum).
+Rank r contributes value (r+1); prints one JSON line of results."""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.parallel.env import init_distributed_env  # noqa: E402
+
+
+def main():
+    init_distributed_env()
+    rank = jax.process_index()
+    results = {}
+    for red in ("sum", "mean", "max", "min", "prod"):
+        prog = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=(2,), dtype="float32")
+            out = fluid.layers.collective._allreduce(
+                x, reduce_type=red)
+        exe = fluid.Executor(fluid.CPUPlace())
+        val = np.full((1, 2), float(rank + 1), np.float32)
+        got = exe.run(prog, feed={"x": val},
+                      fetch_list=[out.name])[0]
+        results[red] = float(np.asarray(got).reshape(-1)[0])
+    print("RESULT " + json.dumps({"rank": rank, "results": results}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -247,3 +247,47 @@ class TestDistPserverProcesses:
         np.testing.assert_allclose(merged, local, rtol=2e-3,
                                    atol=1e-4)
         assert merged[-1] < merged[0]
+
+
+def test_allreduce_reduce_types_two_process():
+    """All five reduce types across 2 real processes (reference
+    distributed_ops/allreduce_op.cc)."""
+    import subprocess
+    import sys
+
+    port = _find_free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ENDPOINTS":
+                f"127.0.0.1:{port},127.0.0.1:{port + 1}",
+            "PADDLE_TRAINING_ROLE": "TRAINER",
+            "JAX_PLATFORMS": "cpu",
+        })
+        env.pop("XLA_FLAGS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__),
+                          "dist_allreduce_worker.py")],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env))
+    try:
+        outs = [p.communicate(timeout=180) for p in procs]
+    finally:
+        for p in procs:  # a hung rendezvous must not leak workers
+            if p.poll() is None:
+                p.kill()
+    for p, (o, e) in zip(procs, outs):
+        assert p.returncode == 0, e[-800:]
+    import json as _json
+
+    expected = {"sum": 3.0, "mean": 1.5, "max": 2.0, "min": 1.0,
+                "prod": 2.0}
+    for o, _ in outs:
+        line = [l for l in o.splitlines()
+                if l.startswith("RESULT ")][0]
+        res = _json.loads(line[len("RESULT "):])["results"]
+        assert res == expected, res
