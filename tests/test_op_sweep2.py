@@ -1,0 +1,214 @@
+"""Oracle sweep, part 2: loss / norm / vision families.
+
+Parity model: reference tests/unittests/test_hinge_loss_op.py,
+test_log_loss_op.py, test_smooth_l1_loss_op.py, test_kldiv_loss_op.py,
+test_margin_rank_loss_op.py, test_dice_loss-era, test_lrn_op.py,
+test_group_norm_op.py, test_instance_norm-era, test_l2_normalize-era,
+test_affine_channel_op.py, test_temporal_shift_op.py,
+test_strided_slice-era, test_unfold-era, test_spectral_norm_op.py.
+Forward oracles via the OpTest harness with fd grad checks where the
+op is smooth at the sampled points.
+"""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+R = np.random.RandomState(11)
+
+
+def _case(op_type, inputs, outputs, attrs=None, grad=(), atol=2e-5,
+          no_grad=(), out_name=None):
+    t = OpTest("setUp")
+    t.setUp()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    t.attrs = attrs or {}
+    t.check_output(atol=atol, rtol=atol)
+    if grad:
+        t.check_grad(list(grad), out_name or next(iter(outputs)),
+                     no_grad_set=set(no_grad))
+
+
+def test_hinge_loss():
+    logits = R.randn(8, 1).astype("float32")
+    labels = (R.rand(8, 1) > 0.5).astype("float32")
+    expect = np.maximum(0.0, 1.0 - (2 * labels - 1) * logits)
+    _case("hinge_loss", {"Logits": logits, "Labels": labels},
+          {"Loss": expect}, grad=("Logits",), no_grad=("Labels",))
+
+
+def test_log_loss():
+    p = R.uniform(0.1, 0.9, (8, 1)).astype("float32")
+    y = (R.rand(8, 1) > 0.5).astype("float32")
+    eps = 1e-4
+    expect = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+    _case("log_loss", {"Predicted": p, "Labels": y},
+          {"Loss": expect}, {"epsilon": eps}, grad=("Predicted",),
+          no_grad=("Labels",))
+
+
+def test_smooth_l1_loss():
+    x = R.randn(6, 4).astype("float32")
+    y = x + R.randn(6, 4).astype("float32") * 2  # mix |d|<1 and >1
+    sigma = 1.0
+    d = x - y
+    expect = np.where(np.abs(d) < 1.0 / sigma ** 2,
+                      0.5 * (sigma * d) ** 2,
+                      np.abs(d) - 0.5 / sigma ** 2).sum(
+                          1, keepdims=True)
+    _case("smooth_l1_loss", {"X": x, "Y": y}, {"Out": expect},
+          {"sigma": sigma}, grad=("X",), no_grad=("Y",))
+
+
+def test_kldiv_loss():
+    logp = np.log(R.dirichlet(np.ones(5), 6).astype("float32"))
+    t = R.dirichlet(np.ones(5), 6).astype("float32")
+    expect = (t * (np.log(t) - logp)).mean().reshape(1)
+    _case("kldiv_loss", {"X": logp, "Target": t},
+          {"Loss": expect.astype("float32")}, {"reduction": "mean"},
+          atol=1e-4, grad=("X",), no_grad=("Target",))
+
+
+def test_margin_rank_loss():
+    x1 = R.randn(8, 1).astype("float32")
+    x2 = R.randn(8, 1).astype("float32")
+    lab = np.where(R.rand(8, 1) > 0.5, 1.0, -1.0).astype("float32")
+    out = np.maximum(0.0, -lab * (x1 - x2) + 0.1)
+    _case("margin_rank_loss",
+          {"X1": x1, "X2": x2, "Label": lab},
+          {"Out": out, "Activated": (out > 0).astype("float32")},
+          {"margin": 0.1}, grad=("X1", "X2"), no_grad=("Label",))
+
+
+def test_dice_loss():
+    x = R.uniform(0.1, 0.9, (4, 9)).astype("float32")
+    lab = (R.rand(4, 9) > 0.5).astype("int64")
+    eps = 1e-5
+    inter = (x * lab).sum(-1) * 2
+    union = x.sum(-1) + lab.sum(-1)
+    expect = (1 - (inter + eps) / (union + eps)).mean().reshape(1)
+    _case("dice_loss", {"X": x, "Label": lab},
+          {"Out": expect.astype("float32")}, {"epsilon": eps},
+          grad=("X",), no_grad=("Label",))
+
+
+def test_bpr_loss():
+    x = R.uniform(0.05, 0.95, (4, 5)).astype("float32")
+    x = x / x.sum(1, keepdims=True)
+    lab = R.randint(0, 5, (4, 1)).astype("int64")
+    # reference bpr_loss_op.h: -mean_j!=y log(sigmoid(x_y - x_j))
+    expect = np.zeros((4, 1), np.float32)
+    for i in range(4):
+        y = int(lab[i, 0])
+        others = [j for j in range(5) if j != y]
+        diffs = x[i, y] - x[i, others]
+        expect[i, 0] = -np.mean(np.log(1 / (1 + np.exp(-diffs))))
+    _case("bpr_loss", {"X": x, "Label": lab}, {"Out": expect},
+          atol=1e-4, grad=("X",), no_grad=("Label",))
+
+
+def test_l2_normalize_and_lrn():
+    x = R.randn(3, 8).astype("float32")
+    expect = x / np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+    _case("l2_normalize", {"X": x}, {"Out": expect}, {"axis": 1},
+          grad=("X",))
+
+    # lrn (reference lrn_op.cc): out = x / (k + alpha*sum_window)^beta
+    xi = R.rand(2, 6, 3, 3).astype("float32")
+    n, alpha, beta, k = 5, 1e-4, 0.75, 1.0
+    sq = xi ** 2
+    acc = np.zeros_like(xi)
+    for c in range(6):
+        lo, hi = max(0, c - n // 2), min(6, c + n // 2 + 1)
+        acc[:, c] = sq[:, lo:hi].sum(1)
+    expect = xi / np.power(k + alpha * acc, beta)
+    _case("lrn", {"X": xi}, {"Out": expect},
+          {"n": n, "alpha": alpha, "beta": beta, "k": k},
+          grad=("X",))
+
+
+def test_group_and_instance_norm():
+    x = R.randn(2, 6, 4, 4).astype("float32")
+    g = 3
+    xr = x.reshape(2, g, -1)
+    mean = xr.mean(-1, keepdims=True)
+    var = xr.var(-1, keepdims=True)
+    yn = ((xr - mean) / np.sqrt(var + 1e-5)).reshape(x.shape)
+    scale = R.rand(6).astype("float32")
+    bias = R.rand(6).astype("float32")
+    expect = yn * scale[None, :, None, None] + bias[None, :, None, None]
+    _case("group_norm", {"X": x, "Scale": scale, "Bias": bias},
+          {"Y": expect}, {"groups": g, "epsilon": 1e-5},
+          atol=1e-4, grad=("X",), out_name="Y",
+          no_grad=("Scale", "Bias"))
+
+    xr = x.reshape(2, 6, -1)
+    mean = xr.mean(-1, keepdims=True)
+    var = xr.var(-1, keepdims=True)
+    yn = ((xr - mean) / np.sqrt(var + 1e-5)).reshape(x.shape)
+    expect = yn * scale[None, :, None, None] + bias[None, :, None, None]
+    _case("instance_norm", {"X": x, "Scale": scale, "Bias": bias},
+          {"Y": expect}, {"epsilon": 1e-5}, atol=1e-4,
+          grad=("X",), out_name="Y", no_grad=("Scale", "Bias"))
+
+
+def test_affine_channel_and_temporal_shift():
+    x = R.randn(2, 4, 3, 3).astype("float32")
+    scale = R.rand(4).astype("float32")
+    bias = R.rand(4).astype("float32")
+    expect = x * scale[None, :, None, None] + bias[None, :, None, None]
+    _case("affine_channel", {"X": x, "Scale": scale, "Bias": bias},
+          {"Out": expect}, {"data_layout": "NCHW"}, grad=("X",),
+          no_grad=("Scale", "Bias"))
+
+    # temporal_shift (reference temporal_shift_op.h): NT,C,H,W with
+    # seg_num T: first C/4 channels shift t-1, next C/4 shift t+1
+    nt, c, h, w = 4, 8, 2, 2
+    seg = 2
+    xt = R.randn(nt, c, h, w).astype("float32")
+    x5 = xt.reshape(nt // seg, seg, c, h, w)
+    out = np.zeros_like(x5)
+    c1, c2 = c // 4, c // 2
+    out[:, :-1, :c1] = x5[:, 1:, :c1]          # shift left (future)
+    out[:, 1:, c1:c2] = x5[:, :-1, c1:c2]      # shift right (past)
+    out[:, :, c2:] = x5[:, :, c2:]
+    expect = out.reshape(nt, c, h, w)
+    _case("temporal_shift", {"X": xt}, {"Out": expect},
+          {"seg_num": seg, "shift_ratio": 0.25}, grad=("X",))
+
+
+def test_strided_slice_and_unfold():
+    x = np.arange(48, dtype=np.float32).reshape(4, 12)
+    _case("strided_slice", {"Input": x}, {"Out": x[1:4:2, 2:10:3]},
+          {"axes": [0, 1], "starts": [1, 2], "ends": [4, 10],
+           "strides": [2, 3]}, grad=("Input",))
+
+    xi = R.randn(1, 2, 4, 4).astype("float32")
+    # unfold 2x2 patches stride 2: im2col oracle [1, C*k*k, L]
+    expect = np.transpose(
+        np.asarray([xi[0, :, i:i+2, j:j+2].reshape(-1)
+                    for i in (0, 2) for j in (0, 2)]), (1, 0))[None]
+    _case("unfold", {"X": xi}, {"Y": expect},
+          {"kernel_sizes": [2, 2], "strides": [2, 2],
+           "paddings": [0, 0], "dilations": [1, 1]},
+          grad=("X",), out_name="Y")
+
+
+def test_spectral_norm_contract():
+    # reference spectral_norm_op.h: weight / sigma with sigma from
+    # power iteration; check ||W/sigma||_2 ~= 1
+    from test_op_sweep import _run
+
+    w = R.randn(6, 4).astype("float32")
+    u = R.randn(6).astype("float32")
+    v = R.randn(4).astype("float32")
+    out = _run("spectral_norm", {"Weight": w, "U": u, "V": v},
+               {"dim": 0, "power_iters": 20, "eps": 1e-12})
+    sigma = np.linalg.svd(np.asarray(out), compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, atol=1e-3, rtol=1e-3)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
