@@ -260,12 +260,15 @@ def temporal_shift(ctx):
     x5 = x.reshape(n, seg, c, h, w)
     c1 = int(c * ratio)
     c2 = int(c * 2 * ratio)
-    back = jnp.pad(x5[:, 1:, :c1], ((0, 0), (0, 1), (0, 0), (0, 0),
-                                    (0, 0)))
-    fwd = jnp.pad(x5[:, :-1, c1:c2], ((0, 0), (1, 0), (0, 0), (0, 0),
-                                      (0, 0)))
+    # reference temporal_shift_op.h:60-66: channels < c1 read
+    # src_it = it-1 (the PAST frame), channels [c1,c2) read it+1
+    past = jnp.pad(x5[:, :-1, :c1], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                     (0, 0)))
+    future = jnp.pad(x5[:, 1:, c1:c2], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                        (0, 0)))
     keep = x5[:, :, c2:]
-    return jnp.concatenate([back, fwd, keep], axis=2).reshape(x.shape)
+    return jnp.concatenate([past, future, keep],
+                           axis=2).reshape(x.shape)
 
 
 @register_op("unfold")

@@ -34,9 +34,10 @@ def _sig(v):
 
 
 def _case(op_type, inputs, outputs, attrs=None, grad=(), atol=2e-5,
-          no_grad=()):
+          no_grad=(), out_name=None):
     """Run one op through the OpTest harness: Executor-compiled
-    forward vs oracle, then fd grad check for `grad` inputs."""
+    forward vs oracle, then fd grad check for `grad` inputs. Shared
+    with test_op_sweep2."""
     t = OpTest("setUp")
     t.setUp()
     t.op_type = op_type
@@ -45,7 +46,7 @@ def _case(op_type, inputs, outputs, attrs=None, grad=(), atol=2e-5,
     t.attrs = attrs or {}
     t.check_output(atol=atol, rtol=atol)
     if grad:
-        t.check_grad(list(grad), next(iter(outputs)),
+        t.check_grad(list(grad), out_name or next(iter(outputs)),
                      no_grad_set=set(no_grad))
 
 

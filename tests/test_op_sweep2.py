@@ -12,26 +12,18 @@ op is smooth at the sampled points.
 import numpy as np
 import pytest
 
-from op_test import OpTest
-
-R = np.random.RandomState(11)
-
-
-def _case(op_type, inputs, outputs, attrs=None, grad=(), atol=2e-5,
-          no_grad=(), out_name=None):
-    t = OpTest("setUp")
-    t.setUp()
-    t.op_type = op_type
-    t.inputs = inputs
-    t.outputs = outputs
-    t.attrs = attrs or {}
-    t.check_output(atol=atol, rtol=atol)
-    if grad:
-        t.check_grad(list(grad), out_name or next(iter(outputs)),
-                     no_grad_set=set(no_grad))
+from op_test import OpTest  # noqa: F401 (re-exported style)
+from test_op_sweep import _case
 
 
-def test_hinge_loss():
+@pytest.fixture()
+def R():
+    # per-test generator: shared module state would make data depend
+    # on test selection/ordering and flake the tolerance checks
+    return np.random.RandomState(11)
+
+
+def test_hinge_loss(R):
     logits = R.randn(8, 1).astype("float32")
     labels = (R.rand(8, 1) > 0.5).astype("float32")
     expect = np.maximum(0.0, 1.0 - (2 * labels - 1) * logits)
@@ -39,7 +31,7 @@ def test_hinge_loss():
           {"Loss": expect}, grad=("Logits",), no_grad=("Labels",))
 
 
-def test_log_loss():
+def test_log_loss(R):
     p = R.uniform(0.1, 0.9, (8, 1)).astype("float32")
     y = (R.rand(8, 1) > 0.5).astype("float32")
     eps = 1e-4
@@ -49,7 +41,7 @@ def test_log_loss():
           no_grad=("Labels",))
 
 
-def test_smooth_l1_loss():
+def test_smooth_l1_loss(R):
     x = R.randn(6, 4).astype("float32")
     y = x + R.randn(6, 4).astype("float32") * 2  # mix |d|<1 and >1
     sigma = 1.0
@@ -62,7 +54,7 @@ def test_smooth_l1_loss():
           {"sigma": sigma}, grad=("X",), no_grad=("Y",))
 
 
-def test_kldiv_loss():
+def test_kldiv_loss(R):
     logp = np.log(R.dirichlet(np.ones(5), 6).astype("float32"))
     t = R.dirichlet(np.ones(5), 6).astype("float32")
     expect = (t * (np.log(t) - logp)).mean().reshape(1)
@@ -71,7 +63,7 @@ def test_kldiv_loss():
           atol=1e-4, grad=("X",), no_grad=("Target",))
 
 
-def test_margin_rank_loss():
+def test_margin_rank_loss(R):
     x1 = R.randn(8, 1).astype("float32")
     x2 = R.randn(8, 1).astype("float32")
     lab = np.where(R.rand(8, 1) > 0.5, 1.0, -1.0).astype("float32")
@@ -82,7 +74,7 @@ def test_margin_rank_loss():
           {"margin": 0.1}, grad=("X1", "X2"), no_grad=("Label",))
 
 
-def test_dice_loss():
+def test_dice_loss(R):
     x = R.uniform(0.1, 0.9, (4, 9)).astype("float32")
     lab = (R.rand(4, 9) > 0.5).astype("int64")
     eps = 1e-5
@@ -94,7 +86,7 @@ def test_dice_loss():
           grad=("X",), no_grad=("Label",))
 
 
-def test_bpr_loss():
+def test_bpr_loss(R):
     x = R.uniform(0.05, 0.95, (4, 5)).astype("float32")
     x = x / x.sum(1, keepdims=True)
     lab = R.randint(0, 5, (4, 1)).astype("int64")
@@ -109,7 +101,7 @@ def test_bpr_loss():
           atol=1e-4, grad=("X",), no_grad=("Label",))
 
 
-def test_l2_normalize_and_lrn():
+def test_l2_normalize_and_lrn(R):
     x = R.randn(3, 8).astype("float32")
     expect = x / np.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
     _case("l2_normalize", {"X": x}, {"Out": expect}, {"axis": 1},
@@ -129,7 +121,7 @@ def test_l2_normalize_and_lrn():
           grad=("X",))
 
 
-def test_group_and_instance_norm():
+def test_group_and_instance_norm(R):
     x = R.randn(2, 6, 4, 4).astype("float32")
     g = 3
     xr = x.reshape(2, g, -1)
@@ -154,7 +146,7 @@ def test_group_and_instance_norm():
           grad=("X",), out_name="Y", no_grad=("Scale", "Bias"))
 
 
-def test_affine_channel_and_temporal_shift():
+def test_affine_channel_and_temporal_shift(R):
     x = R.randn(2, 4, 3, 3).astype("float32")
     scale = R.rand(4).astype("float32")
     bias = R.rand(4).astype("float32")
@@ -163,23 +155,23 @@ def test_affine_channel_and_temporal_shift():
           {"Out": expect}, {"data_layout": "NCHW"}, grad=("X",),
           no_grad=("Scale", "Bias"))
 
-    # temporal_shift (reference temporal_shift_op.h): NT,C,H,W with
-    # seg_num T: first C/4 channels shift t-1, next C/4 shift t+1
+    # temporal_shift (reference temporal_shift_op.h:60-66): channels
+    # < C/4 read the PAST frame (src_it = it-1), next C/4 the future
     nt, c, h, w = 4, 8, 2, 2
     seg = 2
     xt = R.randn(nt, c, h, w).astype("float32")
     x5 = xt.reshape(nt // seg, seg, c, h, w)
     out = np.zeros_like(x5)
     c1, c2 = c // 4, c // 2
-    out[:, :-1, :c1] = x5[:, 1:, :c1]          # shift left (future)
-    out[:, 1:, c1:c2] = x5[:, :-1, c1:c2]      # shift right (past)
+    out[:, 1:, :c1] = x5[:, :-1, :c1]          # past frame
+    out[:, :-1, c1:c2] = x5[:, 1:, c1:c2]      # future frame
     out[:, :, c2:] = x5[:, :, c2:]
     expect = out.reshape(nt, c, h, w)
     _case("temporal_shift", {"X": xt}, {"Out": expect},
           {"seg_num": seg, "shift_ratio": 0.25}, grad=("X",))
 
 
-def test_strided_slice_and_unfold():
+def test_strided_slice_and_unfold(R):
     x = np.arange(48, dtype=np.float32).reshape(4, 12)
     _case("strided_slice", {"Input": x}, {"Out": x[1:4:2, 2:10:3]},
           {"axes": [0, 1], "starts": [1, 2], "ends": [4, 10],
@@ -196,7 +188,7 @@ def test_strided_slice_and_unfold():
           grad=("X",), out_name="Y")
 
 
-def test_spectral_norm_contract():
+def test_spectral_norm_contract(R):
     # reference spectral_norm_op.h: weight / sigma with sigma from
     # power iteration; check ||W/sigma||_2 ~= 1
     from test_op_sweep import _run
