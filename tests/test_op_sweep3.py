@@ -1,0 +1,143 @@
+"""Oracle sweep, part 3: sequence / roi / sampling-grid families.
+
+Parity model: reference tests/unittests/test_sequence_pad_op.py,
+test_sequence_unpad_op.py, test_sequence_slice_op.py,
+test_sequence_enumerate_op.py, test_sequence_concat-era,
+test_sequence_reshape.py, test_roi_pool_op.py, test_roi_align_op.py,
+test_grid_sampler_op.py, test_affine_grid-era. Sequences use the
+repo's padded [B,T,...] + lengths design (SURVEY §5 LoD inversion).
+"""
+import numpy as np
+import pytest
+
+from test_op_sweep import _case, _run
+
+
+@pytest.fixture()
+def R():
+    return np.random.RandomState(13)
+
+
+def test_sequence_pad_unpad(R):
+    x = R.randn(2, 5, 3).astype("float32")
+    sl = np.array([3, 5], np.int32)
+    pad_val = np.array([0.5], np.float32)
+    m = (np.arange(5)[None, :] < sl[:, None])[..., None]
+    expect = np.where(m, x, 0.5)
+    got, lens = _run("sequence_pad",
+                     {"X": x, "SeqLen": sl, "PadValue": pad_val},
+                     {"padded_length": 5},
+                     out_slots=("Out", "Length"))
+    np.testing.assert_allclose(got, expect, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(lens).reshape(-1), sl)
+
+    unp = _run("sequence_unpad", {"X": got, "Length": sl})
+    np.testing.assert_allclose(unp, np.where(m, x, 0.0), atol=1e-6)
+
+
+def test_sequence_slice_and_reshape(R):
+    x = R.randn(2, 6, 2).astype("float32")
+    off = np.array([[1], [2]], np.int64)
+    ln = np.array([[3], [2]], np.int64)
+    got = _run("sequence_slice",
+               {"X": x, "Offset": off, "Length": ln})
+    # padded output: row b holds x[b, off:off+len] at the front
+    np.testing.assert_allclose(got[0, :3], x[0, 1:4], atol=1e-6)
+    np.testing.assert_allclose(got[1, :2], x[1, 2:4], atol=1e-6)
+    assert np.all(np.asarray(got)[0, 3:] == 0)
+
+    _case("sequence_reshape", {"X": x}, {"Out": x.reshape(2, 3, 4)},
+          {"new_dim": 4}, atol=1e-6, grad=("X",))
+
+
+def test_sequence_enumerate_and_concat(R):
+    ids = np.array([[1, 2, 3, 4]], np.int64)
+    got = _run("sequence_enumerate", {"X": ids},
+               {"win_size": 2, "pad_value": 0})
+    expect = np.array([[[1, 2], [2, 3], [3, 4], [4, 0]]])
+    np.testing.assert_array_equal(np.asarray(got), expect)
+
+    a = R.randn(2, 2, 3).astype("float32")
+    b = R.randn(2, 3, 3).astype("float32")
+    _case("sequence_concat", {"X": [("sa", a), ("sb", b)]},
+          {"Out": np.concatenate([a, b], axis=1)}, atol=1e-6,
+          grad=("sa", "sb"))
+
+
+def test_roi_pool_and_align(R):
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    rois = np.array([[0, 0, 4, 4]], np.float32)
+    got = _run("roi_pool", {"X": x, "ROIs": rois},
+               {"spatial_scale": 1.0, "pooled_height": 2,
+                "pooled_width": 2}, out_slots=("Out",))
+    # reference roi_pool_op.h: inclusive roi (w = x2-x1+1 = 5), bin
+    # boundaries floor/ceil -> bin0 covers rows/cols 0..2, bin1 2..4
+    region = x[0, 0, :5, :5]
+    expect = np.array([[region[:3, :3].max(), region[:3, 2:5].max()],
+                       [region[2:5, :3].max(), region[2:5, 2:5].max()]])
+    np.testing.assert_allclose(np.asarray(got)[0, 0], expect)
+
+    # roi_align: TWO channels so a swapped layout transpose cannot
+    # pass; bin centers (1,1),(1,3),(3,1),(3,3) -> exact pixels
+    x2 = np.stack([x[0, 0], x[0, 0] * 10 + 1])[None]  # 1,2,6,6
+    centers = np.array([[x[0, 0, 1, 1], x[0, 0, 1, 3]],
+                        [x[0, 0, 3, 1], x[0, 0, 3, 3]]])
+    expect2 = np.stack([centers, centers * 10 + 1])[None]
+    _case("roi_align", {"X": x2, "ROIs": rois}, {"Out": expect2},
+          {"spatial_scale": 1.0, "pooled_height": 2,
+           "pooled_width": 2}, atol=1e-5, grad=("X",),
+          no_grad=("ROIs",))
+
+
+def test_grid_sampler_and_affine_grid(R):
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # identity grid: normalized coords over the output plane
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4),
+                         np.linspace(-1, 1, 4), indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].astype("float32")
+    # TWO channels: the kernel returns NCHW (misc_ops.py transposes
+    # back from its NHWC gather); identity grid must reproduce both
+    # planes in place
+    x2 = np.stack([x[0, 0], x[0, 0] * 3 - 2])[None]  # 1,2,4,4
+    _case("grid_sampler", {"X": x2, "Grid": grid}, {"Output": x2},
+          atol=1e-5, grad=("X",), no_grad=("Grid",))
+
+    theta = np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)  # identity
+    ag = _run("affine_grid", {"Theta": theta},
+              {"output_shape": [1, 1, 4, 4]}, out_slots=("Output",))
+    np.testing.assert_allclose(np.asarray(ag), grid, atol=1e-5)
+
+    # composition: identity affine grid + sampler == input
+    got = _run("grid_sampler", {"X": x, "Grid": np.asarray(ag)},
+               out_slots=("Output",))
+    np.testing.assert_allclose(np.asarray(got), x, atol=1e-5)
+
+
+def test_row_conv(R):
+    # lookahead conv (reference row_conv_op.cc): out[t] = sum_{i=0..k}
+    # x[t+i] * w[i] -- through the OpTest harness with fd grads
+    x = R.randn(1, 5, 3).astype("float32")
+    w = R.randn(3, 3).astype("float32")  # (ctx+1)=3 taps
+    expect = np.zeros_like(x)
+    for t in range(5):
+        for i in range(3):
+            if t + i < 5:
+                expect[0, t] += x[0, t + i] * w[i]
+    _case("row_conv", {"X": x, "Filter": w}, {"Out": expect},
+          atol=1e-5, grad=("X", "Filter"))
+
+
+def test_im2sequence(R):
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    got = _run("im2sequence", {"X": x},
+               {"kernels": [2, 2], "strides": [2, 2],
+                "paddings": [0, 0, 0, 0]})
+    g = np.asarray(got)
+    # 4 patches of 2x2, row-major
+    expect = np.asarray([x[0, 0, i:i+2, j:j+2].reshape(-1)
+                         for i in (0, 2) for j in (0, 2)])
+    np.testing.assert_allclose(g.reshape(4, 4), expect, atol=1e-6)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-q"])
