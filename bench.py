@@ -260,11 +260,44 @@ BENCHES = [("transformer", bench_transformer),
            ("mnist", bench_mnist)]
 
 
+def _probe_backend(timeout_s=180):
+    """Fail fast (instead of hanging the driver) when the TPU tunnel
+    is wedged: jax backend init HANGS rather than raising in that
+    state (see CLAUDE.md tunnel rules). The probe runs in a child
+    process; on timeout the child is ABANDONED, not killed -- killing
+    a mid-handshake TPU process is exactly what wedges the tunnel.
+    Healthy runs pay one extra ~seconds backend init in the child;
+    the returned device_kind is reused so the parent only initializes
+    once more for the actual benches."""
+    import subprocess
+
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].device_kind)"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        out, err = child.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # leave the child running: it either completes harmlessly or
+        # was already hung on a dead tunnel
+        print("# bench: device backend unresponsive after "
+              f"{timeout_s}s (wedged TPU tunnel?) -- aborting instead "
+              "of hanging; see BENCH_SELF_r02.json for the last "
+              "healthy run", file=sys.stderr)
+        sys.exit(3)
+    if child.returncode != 0:
+        print(f"# bench: backend probe failed: {err[-400:]}",
+              file=sys.stderr)
+        sys.exit(3)
+    return out.strip().splitlines()[-1] if out.strip() else "unknown"
+
+
 def main():
+    device = _probe_backend()
     import jax
 
     only = sys.argv[1] if len(sys.argv) > 1 else None
-    device = jax.devices()[0].device_kind
     for name, fn in BENCHES:
         if only and name != only:
             continue
