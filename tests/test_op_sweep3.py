@@ -141,3 +141,62 @@ def test_im2sequence(R):
 
 if __name__ == "__main__":
     pytest.main([__file__, "-q"])
+
+
+def test_hierarchical_sigmoid(R):
+    """Oracle: full-tree path product of sigmoids (reference
+    hierarchical_sigmoid_op.h / matrix_bit_code.h node derivation)."""
+    b, d, v = 3, 4, 8
+    x = (R.randn(b, d) * 0.5).astype("float32")
+    w = (R.randn(v - 1, d) * 0.5).astype("float32")
+    bias = (R.randn(v - 1) * 0.5).astype("float32")
+    lab = R.randint(0, v, (b, 1)).astype("int64")
+
+    def sig(t):
+        return 1 / (1 + np.exp(-t))
+
+    expect = np.zeros((b, 1), np.float32)
+    for i in range(b):
+        node = int(lab[i, 0]) + v - 1
+        loss = 0.0
+        while node > 0:
+            parent = (node - 1) // 2
+            code = 1.0 if node == 2 * parent + 2 else 0.0  # right child
+            pre = float(x[i] @ w[parent] + bias[parent])
+            p = sig(pre)
+            prob = p if code else (1 - p)
+            loss += -np.log(max(prob, 1e-12))
+            node = parent
+        expect[i, 0] = loss
+    got = _run("hierarchical_sigmoid",
+               {"X": x, "W": w, "Label": lab, "Bias": bias},
+               {"num_classes": v}, out_slots=("Out", "PreOut"))[0]
+    np.testing.assert_allclose(np.asarray(got), expect,
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_sample_logits(R):
+    """True classes ride first with exact logit gather; sampled tail
+    stays within range; deterministic under a fixed seed."""
+    b, c, ns, nt = 4, 20, 6, 1
+    logits = R.randn(b, c).astype("float32")
+    labels = R.randint(0, c, (b, nt)).astype("int64")
+    outs = _run("sample_logits", {"Logits": logits, "Labels": labels},
+                {"num_samples": ns, "seed": 9},
+                out_slots=("SampledLogits", "SampledLabels",
+                           "Samples", "Probabilities"))
+    slog, slab, samples, probs = [np.asarray(o) for o in outs]
+    assert slog.shape == (b, nt + ns)
+    assert samples.shape == (b, nt + ns)
+    np.testing.assert_array_equal(samples[:, :nt], labels)
+    # the true class's sampled-axis position is recorded
+    assert np.all(slab[:, 0] == 0)
+    # gathered logits match (up to log-Q correction applied uniformly)
+    corr = slog[:, :nt] - logits[np.arange(b), labels[:, 0]][:, None]
+    np.testing.assert_allclose(corr - corr[0, 0], 0.0, atol=1e-5)
+    # deterministic with the same seed
+    outs2 = _run("sample_logits", {"Logits": logits, "Labels": labels},
+                 {"num_samples": ns, "seed": 9},
+                 out_slots=("SampledLogits", "SampledLabels",
+                            "Samples", "Probabilities"))
+    np.testing.assert_array_equal(samples, np.asarray(outs2[2]))
