@@ -200,3 +200,16 @@ def test_sample_logits(R):
                  out_slots=("SampledLogits", "SampledLabels",
                             "Samples", "Probabilities"))
     np.testing.assert_array_equal(samples, np.asarray(outs2[2]))
+
+
+def test_interpolate_nearest_and_bilinear(R):
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    got = _run("interpolate", {"X": x},
+               {"out_h": 8, "out_w": 8, "interp_method": "nearest",
+                "align_corners": False})
+    np.testing.assert_allclose(np.asarray(got),
+                               x.repeat(2, 2).repeat(2, 3), atol=1e-6)
+    got = _run("interpolate", {"X": x},
+               {"out_h": 4, "out_w": 4, "interp_method": "bilinear",
+                "align_corners": True})
+    np.testing.assert_allclose(np.asarray(got), x, atol=1e-5)
