@@ -1,14 +1,28 @@
 """slim: model compression (parity: reference contrib/slim/ — the
-quantization/pruning/distillation framework).
+quantization / pruning / distillation framework).
 
-The reference organizes slim around a Compressor driving graph passes;
-here the three capabilities are direct APIs over the Program/ir layer:
-  quantization.QuantizationTransformPass / QuantizationFreezePass
-  prune.Pruner (magnitude pruning of scope params)
-  distillation soft-label loss helpers
+Mirrors the reference's structure: a Compressor (core.py) drives
+Strategy objects over GraphWrapper views (graph.py) of the train/eval
+programs — UniformPruneStrategy / SensitivePruneStrategy (prune.py,
+real structured filter pruning with shape surgery),
+DistillationStrategy + FSP/L2/SoftLabel distillers (distillation.py),
+and QuantizationStrategy over the QAT passes (quantization.py).
 """
 from . import quantization
-from .distillation import soft_label_loss, fsp_matrix
-from .prune import Pruner
+from .core import Compressor, ConfigFactory, Context, Strategy
+from .distillation import (DistillationStrategy, FSPDistiller,
+                           L2Distiller, SoftLabelDistiller, fsp_matrix,
+                           merge, soft_label_loss)
+from .graph import GraphWrapper, OpWrapper, VarWrapper
+from .prune import (Pruner, SensitivePruneStrategy, StructurePruner,
+                    UniformPruneStrategy)
+from .quantization import QuantizationStrategy
 
-__all__ = ["quantization", "Pruner", "soft_label_loss", "fsp_matrix"]
+__all__ = [
+    "quantization", "Compressor", "ConfigFactory", "Context",
+    "Strategy", "GraphWrapper", "OpWrapper", "VarWrapper",
+    "Pruner", "StructurePruner", "UniformPruneStrategy",
+    "SensitivePruneStrategy", "DistillationStrategy", "FSPDistiller",
+    "L2Distiller", "SoftLabelDistiller", "QuantizationStrategy",
+    "soft_label_loss", "fsp_matrix", "merge",
+]

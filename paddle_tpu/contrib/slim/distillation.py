@@ -1,15 +1,43 @@
-"""Distillation losses (parity: reference contrib/slim/distillation/
-distillation_strategy.py losses: soft-label cross entropy and FSP
-matrix loss)."""
+"""Knowledge distillation: teacher-graph merge, distillers, strategy.
+
+Parity: reference contrib/slim/distillation/ — distiller.py (L2Distiller
+:25, FSPDistiller:87, SoftLabelDistiller:160: each appends its loss onto
+the merged graph's 'loss' out-node) and distillation_strategy.py
+(DistillationStrategy:26: at start_epoch merge the teacher program into
+the student train graph, chain the distiller losses, rebuild the
+optimize graph with the distiller optimizer; restore at end_epoch).
+
+The merge is pure Program surgery (reference GraphWrapper.merge):
+teacher ops/vars are appended into a clone of the student program with
+every teacher var renamed under ``teacher_`` except the shared data
+inputs; teacher persistable values are copied in the scope under the
+renamed keys and marked stop_gradient so `append_backward` never
+touches the teacher.
+"""
 from __future__ import annotations
 
-from ... import layers
+import logging
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ...core.program import program_guard
+from .core import Strategy
+from .graph import GraphWrapper
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["soft_label_loss", "fsp_matrix", "merge",
+           "L2Distiller", "FSPDistiller", "SoftLabelDistiller",
+           "DistillationStrategy"]
 
 
 def soft_label_loss(student_logits, teacher_logits,
                     student_temperature=1.0, teacher_temperature=1.0):
-    """KL-style soft-label distillation loss (a Program-building layer
-    composition, like the reference's DistillationStrategy losses)."""
+    """KL-style soft-label distillation loss (reference
+    distiller.py:160 SoftLabelDistiller semantics)."""
+    from ... import layers
+
     s = layers.softmax(layers.scale(student_logits,
                                     scale=1.0 / student_temperature))
     t = layers.softmax(layers.scale(teacher_logits,
@@ -24,6 +52,8 @@ def soft_label_loss(student_logits, teacher_logits,
 def fsp_matrix(feat_a, feat_b):
     """Flow-of-solution-procedure matrix (reference fsp op):
     [B, Ca, H*W] x [B, H*W, Cb] -> [B, Ca, Cb] / (H*W)."""
+    from ... import layers
+
     a_shape = feat_a.shape  # [B, Ca, H, W]
     hw = int(a_shape[2]) * int(a_shape[3])
     a = layers.reshape(feat_a, shape=[-1, int(a_shape[1]), hw])
@@ -31,3 +61,222 @@ def fsp_matrix(feat_a, feat_b):
     b = layers.reshape(feat_b, shape=[-1, int(b_shape[1]), hw])
     prod = layers.matmul(a, layers.transpose(b, perm=[0, 2, 1]))
     return layers.scale(prod, scale=1.0 / hw)
+
+
+def merge(teacher_graph: GraphWrapper, student_graph: GraphWrapper,
+          scope, name_prefix: str = "teacher_",
+          data_name_map: Optional[Dict[str, str]] = None) -> None:
+    """Append the teacher program into the student program in place.
+
+    data_name_map maps teacher data-var names to student var names
+    (default: any identically-named var that is a data input is
+    shared). Teacher persistables are copied in `scope` under the
+    prefixed names. Reference: the GraphWrapper merge used by
+    distillation_strategy.py:47.
+    """
+    t_block = teacher_graph.program.global_block
+    s_block = student_graph.program.global_block
+    mapping = dict(data_name_map or {})
+    for name, var in t_block.vars.items():
+        if name in mapping:
+            continue
+        if name in s_block.vars and (s_block.vars[name].is_data or
+                                     var.is_data):
+            mapping[name] = name  # shared feed var
+            continue
+        new_name = name_prefix + name
+        mapping[name] = new_name
+        if new_name in s_block.vars:
+            # a second merge under the same prefix would silently alias
+            # this teacher onto the previous one's vars
+            raise ValueError(
+                f"merge: var {new_name!r} already exists in the "
+                f"student program — use a distinct name_prefix per "
+                f"teacher")
+        nv = s_block.create_var(
+            name=new_name, shape=var.shape, dtype=var.dtype,
+            persistable=var.persistable)
+        nv.stop_gradient = True
+        if var.persistable:
+            val = scope._get(name)
+            if val is not None:
+                scope._set(new_name, np.array(np.asarray(val)))
+    for op in t_block.ops:
+        if any(hasattr(v, "ops") for v in op.attrs.values()):
+            raise NotImplementedError(
+                f"merge: teacher op {op.type!r} carries a sub-block; "
+                f"control-flow teachers are not supported")
+        ins = {slot: [mapping.get(n, name_prefix + n) for n in names]
+               for slot, names in op.inputs.items()}
+        outs = {slot: [mapping.get(n, name_prefix + n) for n in names]
+                for slot, names in op.outputs.items()}
+        attrs = dict(op.attrs)
+        attrs.setdefault("op_role", "forward")
+        # NOTE: append_op assigns a fresh _uid. Do NOT copy the teacher
+        # op's _uid — uids are per-block indices, so a copied uid would
+        # collide with the student op at the same index and make their
+        # sampling ops share PRNG salts (the CLAUDE.md preserve-_uid
+        # rule is for clones of the SAME program, not cross-program
+        # merges).
+        s_block.append_op(op.type, ins, outs, attrs)
+
+
+class L2Distiller:
+    """reference distiller.py:25 — mean-square distance between one
+    student and one teacher feature map, added onto the loss."""
+
+    def __init__(self, student_feature_map: str,
+                 teacher_feature_map: str,
+                 distillation_loss_weight: float = 1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.weight = float(distillation_loss_weight)
+
+    def distiller_loss(self, graph: GraphWrapper):
+        from ... import layers
+
+        s = graph.var(self.student_feature_map)._var
+        t = graph.var(self.teacher_feature_map)._var
+        t.stop_gradient = True
+        loss = layers.reduce_mean(
+            layers.square(layers.elementwise_sub(s, t)))
+        return layers.scale(loss, scale=self.weight)
+
+
+class FSPDistiller:
+    """reference distiller.py:87 — match student and teacher FSP
+    matrices over (section-entry, section-exit) feature-map pairs."""
+
+    def __init__(self, student_pairs: Sequence[Sequence[str]],
+                 teacher_pairs: Sequence[Sequence[str]],
+                 distillation_loss_weight: float = 1.0):
+        if len(student_pairs) != len(teacher_pairs):
+            raise ValueError("student_pairs and teacher_pairs must "
+                             "align")
+        if not student_pairs:
+            raise ValueError("FSPDistiller needs at least one "
+                             "(entry, exit) feature-map pair")
+        self.student_pairs = [tuple(p) for p in student_pairs]
+        self.teacher_pairs = [tuple(p) for p in teacher_pairs]
+        self.weight = float(distillation_loss_weight)
+
+    def distiller_loss(self, graph: GraphWrapper):
+        from ... import layers
+
+        losses = []
+        for (sa, sb), (ta, tb) in zip(self.student_pairs,
+                                      self.teacher_pairs):
+            s_fsp = fsp_matrix(graph.var(sa)._var, graph.var(sb)._var)
+            t_var_a, t_var_b = graph.var(ta)._var, graph.var(tb)._var
+            t_var_a.stop_gradient = True
+            t_var_b.stop_gradient = True
+            t_fsp = fsp_matrix(t_var_a, t_var_b)
+            losses.append(layers.reduce_mean(
+                layers.square(layers.elementwise_sub(s_fsp, t_fsp))))
+        total = losses[0]
+        for extra in losses[1:]:
+            total = layers.elementwise_add(total, extra)
+        return layers.scale(total, scale=self.weight /
+                            max(len(losses), 1))
+
+
+class SoftLabelDistiller:
+    """reference distiller.py:160 — soft-label cross entropy between
+    temperature-scaled teacher and student logits."""
+
+    def __init__(self, student_feature_map: str,
+                 teacher_feature_map: str,
+                 student_temperature: float = 1.0,
+                 teacher_temperature: float = 1.0,
+                 distillation_loss_weight: float = 1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = float(student_temperature)
+        self.teacher_temperature = float(teacher_temperature)
+        self.weight = float(distillation_loss_weight)
+
+    def distiller_loss(self, graph: GraphWrapper):
+        s = graph.var(self.student_feature_map)._var
+        t = graph.var(self.teacher_feature_map)._var
+        loss = soft_label_loss(s, t, self.student_temperature,
+                               self.teacher_temperature)
+        from ... import layers
+
+        return layers.scale(loss, scale=self.weight)
+
+
+class DistillationStrategy(Strategy):
+    """reference distillation_strategy.py:26.
+
+    At start_epoch: clone the student train graph, merge every teacher
+    graph into it, append each distiller's loss onto the 'loss'
+    out-node, rebuild the optimize graph with distiller_optimizer, and
+    swap it into the context. At end_epoch: restore the plain optimize
+    graph (fine-tuning continues without the teacher).
+    """
+
+    def __init__(self, distillers: Sequence = (), start_epoch=0,
+                 end_epoch=0,
+                 data_name_map: Optional[Dict[str, str]] = None):
+        super().__init__(start_epoch, end_epoch)
+        self.distillers = list(distillers)
+        self.data_name_map = dict(data_name_map or {})
+        self._backup = None
+        self._active = False
+
+    def on_compression_begin(self, context):
+        if not context.teacher_graphs:
+            raise ValueError("DistillationStrategy needs "
+                             "teacher_programs on the Compressor")
+        if context.distiller_optimizer is None:
+            raise ValueError("DistillationStrategy needs a "
+                             "distiller_optimizer")
+
+    @staticmethod
+    def teacher_prefix(i: int) -> str:
+        """First teacher keeps the reference's bare 'teacher_' prefix;
+        later teachers get a disambiguating index so two same-shaped
+        teachers never alias each other's vars."""
+        return "teacher_" if i == 0 else f"teacher{i}_"
+
+    def on_epoch_begin(self, context):
+        # >= (not ==) so a job resumed from a mid-window checkpoint
+        # re-merges the teacher instead of silently fine-tuning bare
+        if self._active or not (self.start_epoch <= context.epoch_id
+                                <= self.end_epoch):
+            return
+        self._active = True
+        self._backup = context.optimize_graph
+        program = context.train_graph.program.clone()
+        merged = GraphWrapper(program, scope=context.scope,
+                              in_nodes=dict(
+                                  context.train_graph.in_nodes),
+                              out_nodes=dict(
+                                  context.train_graph.out_nodes))
+        for i, tg in enumerate(context.teacher_graphs):
+            merge(tg, merged, context.scope,
+                  name_prefix=self.teacher_prefix(i),
+                  data_name_map=self.data_name_map)
+        from ... import layers
+        from .core import build_optimize_graph
+
+        with program_guard(program):
+            total = program.global_block.var(merged.out_nodes["loss"])
+            for d in self.distillers:
+                total = layers.elementwise_add(
+                    total, d.distiller_loss(merged))
+            merged.out_nodes["distillation_loss"] = total.name
+            merged.out_nodes["loss"] = total.name
+        context.optimize_graph = build_optimize_graph(
+            merged, context.distiller_optimizer, context.executor,
+            context.scope, loss_var=total)
+        _logger.info("distillation ON at epoch %d (%d distillers, "
+                     "%d teacher graphs)", context.epoch_id,
+                     len(self.distillers), len(context.teacher_graphs))
+
+    def on_epoch_end(self, context):
+        if context.epoch_id == self.end_epoch and self._active:
+            context.optimize_graph = self._backup
+            self._active = False
+            _logger.info("distillation OFF after epoch %d",
+                         context.epoch_id)

@@ -1,0 +1,278 @@
+"""Graph wrapper for model-compression passes.
+
+Parity: reference contrib/slim/graph/graph_wrapper.py (VarWrapper:44,
+OpWrapper:100, GraphWrapper:188) — a uniform read/mutate view over a
+Program that strategies (prune/quant/distill) traverse.
+
+TPU-first inversion: the reference wraps an IrGraph whose per-op shape
+surgery must be kept consistent by hand (update_param_shape +
+infer_shape per op). Here the Executor re-traces the whole block per
+program version, so compression passes only need to rewrite *parameter*
+shapes (program var + scope array) and bump ``program._version`` —
+every intermediate/grad shape re-infers at the next jit trace.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...core.program import Operator, Program, Variable
+
+
+class VarWrapper:
+    """reference graph_wrapper.py:44."""
+
+    def __init__(self, var: Variable, graph: "GraphWrapper"):
+        self._var = var
+        self._graph = graph
+
+    def __eq__(self, other):
+        return isinstance(other, VarWrapper) and \
+            self._var.name == other._var.name
+
+    def __hash__(self):
+        return hash(self._var.name)
+
+    def name(self):
+        return self._var.name
+
+    def shape(self):
+        return self._var.shape
+
+    def set_shape(self, shape):
+        """reference graph_wrapper.py:69; also mirrors the new shape
+        into the scope array holder when the graph owns a scope."""
+        self._var.shape = tuple(int(s) for s in shape)
+        self._graph.program._version += 1
+
+    def inputs(self) -> List["OpWrapper"]:
+        """Ops that produce this var."""
+        return [op for op in self._graph.ops()
+                if self.name() in op._op.output_arg_names]
+
+    def outputs(self) -> List["OpWrapper"]:
+        """Ops that consume this var."""
+        return [op for op in self._graph.ops()
+                if self.name() in op._op.input_arg_names]
+
+    def __repr__(self):
+        return f"VarWrapper({self.name()}, shape={self.shape()})"
+
+
+class OpWrapper:
+    """reference graph_wrapper.py:100."""
+
+    def __init__(self, op: Operator, graph: "GraphWrapper"):
+        self._op = op
+        self._graph = graph
+
+    def __eq__(self, other):
+        return isinstance(other, OpWrapper) and self._op is other._op
+
+    def __hash__(self):
+        return id(self._op)
+
+    @property
+    def type(self):
+        return self._op.type
+
+    def idx(self):
+        return self._graph.program.global_block.ops.index(self._op)
+
+    def is_bwd_op(self):
+        """reference graph_wrapper.py:140 (OpRole.Backward test)."""
+        return self._op.attr("op_role") == "backward" or \
+            self._op.type.endswith("_grad")
+
+    def is_opt_op(self):
+        return self._op.attr("op_role") == "optimize"
+
+    def all_inputs(self) -> List[VarWrapper]:
+        return [self._graph.var(n) for n in self._op.input_arg_names
+                if self._graph.has_var(n)]
+
+    def all_outputs(self) -> List[VarWrapper]:
+        return [self._graph.var(n) for n in self._op.output_arg_names
+                if self._graph.has_var(n)]
+
+    def inputs(self, slot) -> List[VarWrapper]:
+        return [self._graph.var(n) for n in self._op.input(slot)]
+
+    def outputs(self, slot) -> List[VarWrapper]:
+        return [self._graph.var(n) for n in self._op.output(slot)]
+
+    def set_attr(self, key, value):
+        self._op.attrs[key] = value
+        self._graph.program._version += 1
+
+    def attr(self, name, default=None):
+        return self._op.attr(name, default)
+
+    def __repr__(self):
+        return f"OpWrapper({self.type})"
+
+
+# per-op-type MAC-counting rules (2*MACs = flops), used by
+# GraphWrapper.flops (reference graph_wrapper.py:302 counts conv,
+# pool2d, mul, relu/sigmoid-era activations, batch_norm).
+def _conv_flops(op: OpWrapper) -> int:
+    w = op.inputs("Filter")[0].shape()
+    out = op.outputs("Output")[0].shape()
+    if w is None or out is None:
+        return 0
+    groups = int(op.attr("groups", 1) or 1)
+    kh, kw = int(w[2]), int(w[3])
+    cin = int(w[1])  # already per-group
+    out_numel = int(np.prod([abs(int(s)) for s in out]))
+    flops = 2 * out_numel * cin * kh * kw
+    if op.inputs("Bias"):
+        flops += out_numel
+    return flops
+
+
+def _mul_flops(op: OpWrapper) -> int:
+    x = op.inputs("X")[0].shape()
+    y = op.inputs("Y")[0].shape()
+    if x is None or y is None:
+        return 0
+    m = abs(int(np.prod(x[:-1])))
+    k = int(x[-1])
+    n = int(y[-1])
+    return 2 * m * k * n
+
+
+def _elementwise_flops(op: OpWrapper) -> int:
+    outs = op.all_outputs()
+    if not outs or outs[0].shape() is None:
+        return 0
+    return int(np.prod([abs(int(s)) for s in outs[0].shape()]))
+
+
+_FLOPS_RULES = {
+    "conv2d": _conv_flops,
+    "depthwise_conv2d": _conv_flops,
+    "mul": _mul_flops,
+    "matmul": _mul_flops,
+    "pool2d": _elementwise_flops,
+    "relu": _elementwise_flops,
+    "sigmoid": _elementwise_flops,
+    "tanh": _elementwise_flops,
+    "batch_norm": lambda op: 2 * _elementwise_flops(op),
+    "elementwise_add": _elementwise_flops,
+    "elementwise_mul": _elementwise_flops,
+}
+
+
+class GraphWrapper:
+    """reference graph_wrapper.py:188 — traversal + accounting view of
+    one Program block used by the compression strategies."""
+
+    def __init__(self, program: Program, scope=None,
+                 in_nodes: Optional[Dict[str, str]] = None,
+                 out_nodes: Optional[Dict[str, str]] = None):
+        self.program = program
+        self.scope = scope
+        # logical name -> var name (e.g. {"image": "x", "cost": "loss"})
+        self.in_nodes = dict(in_nodes or {})
+        self.out_nodes = dict(out_nodes or {})
+
+    # ---- structure ----
+    def ops(self) -> List[OpWrapper]:
+        return [OpWrapper(op, self)
+                for op in self.program.global_block.ops]
+
+    def vars(self) -> List[VarWrapper]:
+        return [VarWrapper(v, self)
+                for v in self.program.global_block.vars.values()]
+
+    def var(self, name) -> VarWrapper:
+        v = self.program.global_block._find_var_recursive(name)
+        if v is None:
+            raise KeyError(f"GraphWrapper: no var named {name!r}")
+        return VarWrapper(v, self)
+
+    def has_var(self, name) -> bool:
+        return self.program.global_block._find_var_recursive(name) \
+            is not None
+
+    def all_parameters(self) -> List[VarWrapper]:
+        return [VarWrapper(v, self) for v in
+                self.program.all_parameters()]
+
+    def is_parameter(self, var: VarWrapper) -> bool:
+        return var.name() in self.program._parameters
+
+    def is_persistable(self, var: VarWrapper) -> bool:
+        return bool(var._var.persistable)
+
+    def pre_ops(self, op: OpWrapper) -> List[OpWrapper]:
+        """Ops producing any input of `op` (reference :322)."""
+        ins = set(op._op.input_arg_names)
+        return [p for p in self.ops()
+                if ins & set(p._op.output_arg_names)]
+
+    def next_ops(self, op: OpWrapper) -> List[OpWrapper]:
+        """Ops consuming any output of `op` (reference :334)."""
+        outs = set(op._op.output_arg_names)
+        return [n for n in self.ops()
+                if outs & set(n._op.input_arg_names)]
+
+    def get_param_by_op(self, op: OpWrapper) -> List[VarWrapper]:
+        return [v for v in op.all_inputs() if self.is_parameter(v)]
+
+    # ---- accounting ----
+    def numel_params(self) -> int:
+        total = 0
+        for p in self.all_parameters():
+            shp = p.shape()
+            if shp:
+                total += int(np.prod([abs(int(s)) for s in shp]))
+        return total
+
+    def flops(self) -> int:
+        """Forward flops of the block (reference :302); bwd/opt ops are
+        excluded so train and eval graphs report comparable numbers."""
+        total = 0
+        for op in self.ops():
+            if op.is_bwd_op() or op.is_opt_op():
+                continue
+            rule = _FLOPS_RULES.get(op.type)
+            if rule is not None:
+                try:
+                    total += int(rule(op))
+                except (TypeError, IndexError):
+                    pass
+        return total
+
+    # ---- mutation helpers ----
+    def update_param_shape(self, name, shape,
+                           value: Optional[np.ndarray] = None):
+        """Resize one parameter: program var shape + scope array. The
+        next Executor.run re-traces with the new shapes (the TPU
+        replacement for the reference's per-op infer_shape walk)."""
+        self.var(name).set_shape(shape)
+        if self.scope is not None and value is not None:
+            self.scope._set(name, np.ascontiguousarray(value))
+
+    def infer_shapes(self):
+        """Re-run build-time shape inference over the block in program
+        order. After set_shape surgery on parameters, intermediate var
+        shapes (conv outputs etc.) are stale until the next jit trace;
+        flops()/shape reads need them refreshed eagerly."""
+        from ...core.registry import infer_shape_for_op
+
+        block = self.program.global_block
+        for op in block.ops:
+            infer_shape_for_op(op, block)
+
+    def clone(self, for_test=False) -> "GraphWrapper":
+        return GraphWrapper(self.program.clone(for_test=for_test),
+                            scope=self.scope,
+                            in_nodes=self.in_nodes,
+                            out_nodes=self.out_nodes)
+
+    def __repr__(self):
+        return (f"GraphWrapper(ops={len(self.ops())}, "
+                f"params={len(self.all_parameters())}, "
+                f"flops={self.flops()})")

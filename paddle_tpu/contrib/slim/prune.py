@@ -1,14 +1,44 @@
-"""Magnitude pruning (parity: reference contrib/slim/prune/ —
-SensitivePruneStrategy/StructurePruner; here a direct Pruner API over
-scope params)."""
+"""Pruning: magnitude masks, structured filter pruning, and the
+prune strategies driven by the slim Compressor.
+
+Parity: reference contrib/slim/prune/pruner.py (StructurePruner:34 —
+cal_pruned_idx/prune_tensor) and prune_strategy.py (PruneStrategy:36
+with the filter-propagation walk `_forward_pruning_ralated_params:246`,
+UniformPruneStrategy:531, SensitivePruneStrategy:635).
+
+TPU-first inversion: the reference performs per-op shape surgery on a
+live IrGraph and must call infer_shape op by op. Here pruning is a
+*plan* — `(var, axis, kept_idx)` triples computed once from the forward
+structure — applied to every graph that names the var (train / eval /
+optimize clones share scope arrays but hold separate Variable objects)
+plus the scope array. The next Executor.run re-traces the block, so
+every downstream activation/grad shape re-infers automatically.
+"""
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import fnmatch
+import logging
+import os
+import pickle
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from .core import Strategy
+from .graph import GraphWrapper, OpWrapper
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["Pruner", "StructurePruner", "PruneStrategy",
+           "UniformPruneStrategy", "SensitivePruneStrategy"]
+
 
 class Pruner:
+    """Unstructured magnitude pruning of scope params (kept from the
+    round-1 API; the reference's Pruner base is subclassed by
+    StructurePruner below)."""
+
     def __init__(self, mode: str = "ratio"):
         assert mode in ("ratio", "threshold")
         self.mode = mode
@@ -50,3 +80,515 @@ class Pruner:
             scope._set(name, w)
             out[name] = float((w == 0).mean())
         return out
+
+
+class StructurePruner:
+    """reference prune/pruner.py:34 — decide which filters die.
+
+    pruning_axis / criterions map fnmatch patterns on param names to
+    the axis to prune and the ranking criterion ('l1_norm', 'l2_norm',
+    'random').
+    """
+
+    def __init__(self, pruning_axis: Optional[Dict[str, int]] = None,
+                 criterions: Optional[Dict[str, str]] = None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def _lookup(self, table: Dict, name: str):
+        for pat, v in table.items():
+            if pat != "*" and fnmatch.fnmatch(name, pat):
+                return v
+        return table.get("*")
+
+    def cal_pruned_idx(self, name: str, param: np.ndarray, ratio: float,
+                       axis: Optional[int] = None) -> np.ndarray:
+        """Indices of the filters to REMOVE along `axis` (reference
+        pruner.py:55). Deterministic for 'random' via a name-seeded
+        PRNG so train/eval graphs agree."""
+        if axis is None:
+            axis = int(self._lookup(self.pruning_axis, name))
+        criterion = self._lookup(self.criterions, name)
+        n = param.shape[axis]
+        prune_num = int(round(n * ratio))
+        prune_num = min(max(prune_num, 0), n - 1)  # keep >=1 filter
+        reduce_axes = tuple(i for i in range(param.ndim) if i != axis)
+        if criterion == "l1_norm":
+            scores = np.sum(np.abs(param), axis=reduce_axes)
+        elif criterion == "l2_norm":
+            scores = np.sqrt(np.sum(param * param, axis=reduce_axes))
+        elif criterion == "random":
+            # zlib.crc32, not hash(): str hash is randomized per
+            # process and would pick different filters across runs
+            rng = np.random.RandomState(
+                zlib.crc32(name.encode()) & 0x7FFFFFFF)
+            scores = rng.uniform(size=n)
+        else:
+            raise ValueError(f"unknown criterion {criterion!r}")
+        return np.sort(np.argsort(scores)[:prune_num])
+
+    @staticmethod
+    def prune_tensor(tensor: np.ndarray, pruned_idx, pruned_axis: int,
+                     lazy: bool = False) -> np.ndarray:
+        """Drop (or, lazy, zero) the given indices along an axis
+        (reference pruner.py:81)."""
+        if lazy:
+            out = np.array(tensor)
+            sl = [slice(None)] * out.ndim
+            sl[pruned_axis] = np.asarray(pruned_idx, dtype=np.int64)
+            out[tuple(sl)] = 0.0
+            return out
+        return np.delete(tensor, np.asarray(pruned_idx, dtype=np.int64),
+                         axis=pruned_axis)
+
+
+# ops a pruned channel dimension flows *through* unchanged (NCHW
+# channel-preserving ops between two convs)
+_CHANNEL_TRANSPARENT = {
+    "relu", "relu6", "sigmoid", "tanh", "swish", "leaky_relu", "elu",
+    "pool2d", "dropout", "scale", "hard_sigmoid", "hard_swish",
+}
+
+
+class PruneStrategy(Strategy):
+    """reference prune_strategy.py:36 — shared plan-building machinery.
+
+    The central method is :meth:`_build_plan`, the analogue of the
+    reference's `_forward_pruning_ralated_params` walk: prune a conv's
+    output filters, then chase the channel dimension through
+    bias / batch_norm / activations / elementwise-add branches into the
+    next conv's input channels (or an fc's row groups).
+    """
+
+    def __init__(self, pruner: Optional[StructurePruner] = None,
+                 start_epoch=0, end_epoch=0, target_ratio=0.5,
+                 metric_name: Optional[str] = None,
+                 pruned_params: str = "*conv*weights*"):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner or StructurePruner()
+        self.target_ratio = float(target_ratio)
+        self.metric_name = metric_name
+        self.pruned_params = pruned_params
+
+    # ---- plan construction -------------------------------------------
+    def _matched_params(self, graph: GraphWrapper) -> List[str]:
+        out = []
+        for p in graph.all_parameters():
+            if fnmatch.fnmatch(p.name(), self.pruned_params) and \
+                    p.shape() is not None and len(p.shape()) == 4:
+                out.append(p.name())
+        return out
+
+    def _build_plan(self, graph: GraphWrapper, scope,
+                    ratios: Dict[str, float]) -> Dict[str, Dict[int, np.ndarray]]:
+        """var name -> {axis: indices-to-remove}. One var may be pruned
+        on several axes (its own filters on 0 AND the upstream conv's
+        channels on 1); two branches demanding different prunes of the
+        same axis raise — same contract as the reference walk."""
+        plan: Dict[str, Dict[int, np.ndarray]] = {}
+
+        def record(name: str, axis: int, idx: np.ndarray):
+            axes = plan.setdefault(name, {})
+            if axis in axes:
+                if not np.array_equal(axes[axis], idx):
+                    raise ValueError(
+                        f"conflicting prune of {name!r} on axis "
+                        f"{axis}")
+                return False
+            axes[axis] = idx
+            return True
+
+        for pname, ratio in ratios.items():
+            if 0 in plan.get(pname, {}):
+                # already pruned on axis 0 via brother/depthwise
+                # propagation from an earlier param — keep those
+                # indices (the branches must agree), like the
+                # reference walk's pruned_params skip
+                continue
+            w = scope._get(pname)
+            if w is None:
+                raise KeyError(f"param {pname!r} not initialized in "
+                               f"scope; run startup first")
+            idx = self.pruner.cal_pruned_idx(pname, np.asarray(w),
+                                             ratio, axis=0)
+            if idx.size == 0:
+                continue
+            if not record(pname, 0, idx):
+                continue
+            consumers = [op for op in graph.var(pname).outputs()
+                         if op.type in ("conv2d", "depthwise_conv2d")]
+            for op in consumers:
+                self._propagate(graph, op, idx, plan, record)
+        return plan
+
+    def _propagate(self, graph: GraphWrapper, conv_op: OpWrapper,
+                   idx: np.ndarray, plan, record):
+        """Push a conv output-channel prune downstream (reference
+        prune_strategy.py:246)."""
+        for bname in conv_op._op.input("Bias"):
+            record(bname, 0, idx)
+        frontier = [(conv_op, conv_op._op.output("Output")[0])]
+        seen = set()
+        while frontier:
+            src_op, var_name = frontier.pop()
+            for op in graph.ops():
+                if var_name not in op._op.input_arg_names:
+                    continue
+                key = (id(op._op), var_name)
+                if key in seen or op.is_bwd_op() or op.is_opt_op():
+                    continue
+                seen.add(key)
+                t = op.type
+                if t == "batch_norm":
+                    for slot in ("Scale", "Bias", "Mean", "Variance"):
+                        for n in op._op.input(slot):
+                            record(n, 0, idx)
+                    frontier.append((op, op._op.output("Y")[0]))
+                elif t in _CHANNEL_TRANSPARENT:
+                    out = op._op.output_arg_names
+                    if out:
+                        frontier.append((op, out[0]))
+                elif t in ("elementwise_add", "elementwise_sub",
+                           "elementwise_mul"):
+                    # a 1-D param brother is a broadcast bias: prune it
+                    # directly; otherwise the brother branch must lose
+                    # the same channels — find the conv feeding it
+                    # (reference _search_brother_ops:466)
+                    for other in op._op.input_arg_names:
+                        if other == var_name:
+                            continue
+                        oshape = graph.var(other).shape() if \
+                            graph.has_var(other) else None
+                        if oshape is not None and len(oshape) == 1:
+                            record(other, 0, idx)
+                        else:
+                            self._prune_brother(graph, other, idx,
+                                                plan, record)
+                    frontier.append((op, op._op.output("Out")[0]))
+                elif t == "conv2d":
+                    wname = op._op.input("Filter")[0]
+                    groups = int(op.attr("groups", 1) or 1)
+                    if groups == 1:
+                        record(wname, 1, idx)
+                    else:
+                        # grouped conv consumes channels per group;
+                        # bail out like the reference (unsupported)
+                        raise ValueError(
+                            f"cannot propagate prune into grouped "
+                            f"conv {wname!r}")
+                elif t == "depthwise_conv2d":
+                    wname = op._op.input("Filter")[0]
+                    record(wname, 0, idx)
+                    for bname in op._op.input("Bias"):
+                        record(bname, 0, idx)
+                    frontier.append((op, op._op.output("Output")[0]))
+                elif t == "mul":
+                    # fc after flatten: rows of W group per channel
+                    wname = op._op.input("Y")[0]
+                    wshape = graph.var(wname).shape()
+                    k = int(wshape[0])
+                    ch = self._channels_of(graph, var_name)
+                    if ch is None or k % ch != 0:
+                        raise ValueError(
+                            f"cannot map pruned channels into fc "
+                            f"weight {wname!r} (K={k}, C={ch})")
+                    g = k // ch
+                    rows = (np.asarray(idx)[:, None] * g +
+                            np.arange(g)[None, :]).reshape(-1)
+                    record(wname, 0, np.sort(rows))
+                else:
+                    raise ValueError(
+                        f"filter pruning cannot pass through op "
+                        f"{t!r} (var {var_name!r}); restrict "
+                        f"pruned_params")
+
+    def _prune_brother(self, graph, var_name, idx, plan, record):
+        """Prune the conv (possibly through bn/activation/elementwise
+        chains) that produces the brother input of an elementwise op.
+        An unhandled producer raises — a warning here would leave the
+        two branches of the add with different channel counts and fail
+        later, far from the cause (same contract as _propagate)."""
+        producers = [op for op in graph.var(var_name).inputs()
+                     if not op.is_bwd_op() and not op.is_opt_op()]
+        if not producers:
+            # a data/feed input: nothing upstream to prune
+            if graph.has_var(var_name) and \
+                    not graph.var(var_name)._var.is_data:
+                raise ValueError(
+                    f"filter pruning: brother branch var {var_name!r} "
+                    f"has no producer and is not a data input")
+            return
+        for op in producers:
+            t = op.type
+            if t in ("conv2d", "depthwise_conv2d"):
+                wname = op._op.input("Filter")[0]
+                if record(wname, 0, idx):
+                    for bname in op._op.input("Bias"):
+                        record(bname, 0, idx)
+            elif t == "batch_norm":
+                for slot in ("Scale", "Bias", "Mean", "Variance"):
+                    for n in op._op.input(slot):
+                        record(n, 0, idx)
+                self._prune_brother(graph, op._op.input("X")[0], idx,
+                                    plan, record)
+            elif t in ("elementwise_add", "elementwise_sub",
+                       "elementwise_mul"):
+                # stacked residual adds: both of ITS branches lose the
+                # same channels (record() dedups re-visits)
+                for n in op._op.input_arg_names:
+                    nshape = graph.var(n).shape() if \
+                        graph.has_var(n) else None
+                    if nshape is not None and len(nshape) == 1:
+                        record(n, 0, idx)
+                    else:
+                        self._prune_brother(graph, n, idx, plan,
+                                            record)
+            elif t in _CHANNEL_TRANSPARENT:
+                ins = op._op.input_arg_names
+                if ins:
+                    self._prune_brother(graph, ins[0], idx, plan,
+                                        record)
+            else:
+                raise ValueError(
+                    f"filter pruning cannot trace the brother branch "
+                    f"through op {t!r} (var {var_name!r})")
+
+    @staticmethod
+    def _channels_of(graph: GraphWrapper, var_name: str) -> Optional[int]:
+        shp = graph.var(var_name).shape() if graph.has_var(var_name) \
+            else None
+        if shp and len(shp) >= 2:
+            return int(shp[1])
+        return None
+
+    # ---- plan application --------------------------------------------
+    def _accumulator_plan(self, optimize_graph: GraphWrapper,
+                          plan: Dict[str, Dict[int, np.ndarray]]):
+        """Optimizer state (moments etc.) shaped like a pruned param
+        must shrink identically (reference _get_accumulator:227)."""
+        extra: Dict[str, Dict[int, np.ndarray]] = {}
+        for op in optimize_graph.ops():
+            if not op.is_opt_op():
+                continue
+            pnames = op._op.input("Param")
+            if not pnames or pnames[0] not in plan:
+                continue
+            pname = pnames[0]
+            pshape = optimize_graph.var(pname).shape()
+            for slot, names in op._op.inputs.items():
+                if slot in ("Param", "Grad", "LearningRate"):
+                    continue
+                for n in names:
+                    if n in plan or n in extra or not \
+                            optimize_graph.has_var(n):
+                        continue
+                    v = optimize_graph.var(n)
+                    if v._var.persistable and v.shape() == pshape:
+                        extra[n] = dict(plan[pname])
+        return extra
+
+    def _apply_plan(self, graphs: Sequence[GraphWrapper], scope,
+                    plan: Dict[str, Dict[int, np.ndarray]],
+                    lazy: bool = False):
+        """Apply {var: {axis: idx}} removals to the scope (once) and to
+        every graph's var shapes."""
+        for name, axes in plan.items():
+            val = scope._get(name)
+            if val is not None:
+                arr = np.asarray(val)
+                for axis, idx in axes.items():
+                    arr = StructurePruner.prune_tensor(
+                        arr, idx, axis, lazy=lazy)
+                scope._set(name, np.ascontiguousarray(arr))
+            if lazy:
+                continue
+            done = set()
+            for g in graphs:
+                if id(g.program) in done or not g.has_var(name):
+                    continue
+                done.add(id(g.program))
+                var = g.var(name)
+                shp = list(var.shape())
+                for axis, idx in axes.items():
+                    shp[axis] = int(shp[axis]) - int(len(idx))
+                var.set_shape(shp)
+        if not lazy:
+            # param shapes changed: refresh intermediate shapes so
+            # flops()/numel reads (and later plan builds) see the
+            # pruned network, not pre-prune metadata
+            seen = set()
+            for g in graphs:
+                if id(g.program) not in seen:
+                    seen.add(id(g.program))
+                    g.infer_shapes()
+
+    def _context_graphs(self, context) -> List[GraphWrapper]:
+        gs = []
+        for g in (context.optimize_graph, context.train_graph,
+                  context.eval_graph):
+            if g is not None and all(g.program is not o.program
+                                     for o in gs):
+                gs.append(g)
+        return gs
+
+    def _prune(self, context, ratios: Dict[str, float],
+               lazy: bool = False):
+        graph = context.train_graph or context.optimize_graph
+        plan = self._build_plan(graph, context.scope, ratios)
+        if context.optimize_graph is not None and not lazy:
+            plan.update(self._accumulator_plan(context.optimize_graph,
+                                               plan))
+        self._apply_plan(self._context_graphs(context), context.scope,
+                         plan, lazy=lazy)
+        return plan
+
+
+class UniformPruneStrategy(PruneStrategy):
+    """reference prune_strategy.py:531 — same ratio for every matched
+    conv param, applied once at start_epoch."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, metric_name=None,
+                 pruned_params="*conv*weights*"):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         metric_name, pruned_params)
+        self._pruned = False
+
+    def on_epoch_begin(self, context):
+        if self._pruned or context.epoch_id != self.start_epoch:
+            return
+        graph = context.train_graph or context.optimize_graph
+        params = self._matched_params(graph)
+        if not params:
+            raise ValueError(
+                f"pruned_params pattern {self.pruned_params!r} matched "
+                f"no 4-D conv parameter")
+        flops0, numel0 = graph.flops(), graph.numel_params()
+        ratios = {p: self.target_ratio for p in params}
+        self._prune(context, ratios)
+        context.put("prune_flops", (flops0, graph.flops()))
+        context.put("prune_numel", (numel0, graph.numel_params()))
+        _logger.info(
+            "uniform prune @epoch %d: flops %d -> %d, params %d -> %d",
+            context.epoch_id, flops0, graph.flops(), numel0,
+            graph.numel_params())
+        self._pruned = True
+
+
+class SensitivePruneStrategy(PruneStrategy):
+    """reference prune_strategy.py:635 — measure each layer's eval
+    sensitivity to pruning, then pick per-layer ratios hitting
+    target_ratio with minimum predicted metric loss.
+
+    metric_name must be a higher-is-better out_node of the eval graph
+    (accuracy); sensitivity of (param, ratio) = relative metric drop.
+    Ratio selection replaces the reference's quadratic fit + iterative
+    solve with a direct binary search on the tolerated per-layer drop.
+    """
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, metric_name="acc",
+                 pruned_params="*conv*weights*",
+                 sensitivities_file: Optional[str] = None,
+                 eval_batches: Optional[int] = 5,
+                 ratio_steps: Sequence[float] = (0.2, 0.4, 0.6, 0.8)):
+        super().__init__(pruner, start_epoch, end_epoch, target_ratio,
+                         metric_name, pruned_params)
+        self.sensitivities_file = sensitivities_file
+        self.eval_batches = eval_batches
+        self.ratio_steps = tuple(ratio_steps)
+        self._pruned = False
+
+    # ---- sensitivity measurement -------------------------------------
+    def compute_sensitivities(self, context) -> Dict[str, Dict[float, float]]:
+        """{param: {ratio: relative metric drop}} via lazy (zeroing)
+        pruning + eval + restore (reference :726)."""
+        if self.sensitivities_file and \
+                os.path.exists(self.sensitivities_file):
+            with open(self.sensitivities_file, "rb") as f:
+                return pickle.load(f)
+        graph = context.eval_graph
+        assert graph is not None, \
+            "SensitivePruneStrategy needs an eval graph"
+        baseline = context.run_eval_graph(self.eval_batches)[
+            self.metric_name]
+        sensitivities: Dict[str, Dict[float, float]] = {}
+        for pname in self._matched_params(graph):
+            backup = np.array(context.scope._get(pname))
+            sensitivities[pname] = {}
+            for ratio in self.ratio_steps:
+                idx = self.pruner.cal_pruned_idx(
+                    pname, backup, ratio, axis=0)
+                context.scope._set(pname, StructurePruner.prune_tensor(
+                    backup, idx, 0, lazy=True))
+                metric = context.run_eval_graph(self.eval_batches)[
+                    self.metric_name]
+                drop = (baseline - metric) / (abs(baseline) + 1e-12)
+                sensitivities[pname][ratio] = float(drop)
+                context.scope._set(pname, backup)
+        if self.sensitivities_file:
+            with open(self.sensitivities_file, "wb") as f:
+                pickle.dump(sensitivities, f)
+        return sensitivities
+
+    # ---- ratio selection ---------------------------------------------
+    def _ratios_for_tolerance(self, sensitivities, tol) -> Dict[str, float]:
+        out = {}
+        for pname, table in sensitivities.items():
+            best = 0.0
+            for ratio in sorted(table):
+                if table[ratio] <= tol:
+                    best = ratio
+            if best > 0:
+                out[pname] = best
+        return out
+
+    def get_best_ratios(self, context, sensitivities,
+                        target_ratio) -> Dict[str, float]:
+        """Binary-search the per-layer tolerated drop until the overall
+        pruned-parameter fraction reaches target_ratio (reference
+        :800)."""
+        graph = context.train_graph or context.eval_graph
+        numels = {}
+        for pname in sensitivities:
+            shp = graph.var(pname).shape()
+            numels[pname] = int(np.prod([abs(int(s)) for s in shp]))
+        total = sum(numels.values())
+
+        def pruned_fraction(ratios):
+            return sum(numels[p] * r for p, r in ratios.items()) / \
+                max(total, 1)
+
+        lo, hi = 0.0, max((max(t.values()) for t in
+                           sensitivities.values()), default=1.0)
+        best = self._ratios_for_tolerance(sensitivities, hi)
+        for _ in range(20):
+            mid = (lo + hi) / 2
+            ratios = self._ratios_for_tolerance(sensitivities, mid)
+            if pruned_fraction(ratios) >= target_ratio:
+                best, hi = ratios, mid
+            else:
+                lo = mid
+        return best
+
+    def on_epoch_begin(self, context):
+        if self._pruned or context.epoch_id != self.start_epoch:
+            return
+        sensitivities = self.compute_sensitivities(context)
+        ratios = self.get_best_ratios(context, sensitivities,
+                                      self.target_ratio)
+        if not ratios:
+            _logger.warning("sensitive prune found no layer prunable "
+                            "within tolerance; nothing pruned")
+            self._pruned = True
+            return
+        graph = context.train_graph or context.optimize_graph
+        flops0, numel0 = graph.flops(), graph.numel_params()
+        self._prune(context, ratios)
+        context.put("prune_ratios", ratios)
+        context.put("prune_flops", (flops0, graph.flops()))
+        context.put("prune_numel", (numel0, graph.numel_params()))
+        _logger.info(
+            "sensitive prune @epoch %d: ratios=%s flops %d -> %d",
+            context.epoch_id, ratios, flops0, graph.flops())
+        self._pruned = True

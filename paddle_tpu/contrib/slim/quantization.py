@@ -16,6 +16,7 @@ from typing import List, Optional
 import numpy as np
 
 from ...core.program import Program
+from .core import Strategy
 
 QUANTIZABLE_OP_TYPES = ("mul", "conv2d", "fc")
 _W_SLOTS = {"mul": "Y", "conv2d": "Filter", "fc": "W"}
@@ -176,3 +177,100 @@ class QuantizationFreezePass:
                         name if n == out else n for n in names]
             block.ops.remove(op)
         return program
+
+
+class QuantizationStrategy(Strategy):
+    """Compressor strategy driving QAT (reference
+    contrib/slim/quantization/quantization_strategy.py:30).
+
+    At start_epoch: rebuild the optimize graph from a
+    QuantizationTransformPass-rewritten clone of the forward train
+    graph (grads of the inserted fake-quant ops come from the registry
+    STE vjp — the TPU replacement for the reference's IrGraph
+    forward+backward rewrite) and transform the eval graph the same
+    way. At end_epoch: freeze the eval graph (weights snapped to the
+    int grid, scales baked) and optionally export float/int8 serving
+    models.
+    """
+
+    def __init__(self, start_epoch=0, end_epoch=0,
+                 float_model_save_path=None, int8_model_save_path=None,
+                 weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max",
+                 save_in_nodes=None, save_out_nodes=None):
+        super().__init__(start_epoch, end_epoch)
+        self.float_model_save_path = float_model_save_path
+        self.int8_model_save_path = int8_model_save_path
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_type = activation_quantize_type
+        self._w_type = weight_quantize_type
+        self.save_in_nodes = save_in_nodes
+        self.save_out_nodes = save_out_nodes
+        self._active = False
+
+    def _transform(self, program, scope):
+        return QuantizationTransformPass(
+            scope=scope, weight_bits=self._wbits,
+            activation_bits=self._abits,
+            activation_quantize_type=self._act_type,
+            weight_quantize_type=self._w_type).apply(program)
+
+    def on_epoch_begin(self, context):
+        # >= (not ==): a job resumed from a checkpoint inside the QAT
+        # window must re-apply the transform or it would train AND
+        # "freeze"/export an untransformed float model
+        if self._active or context.epoch_id < self.start_epoch:
+            return
+        self._active = True
+        from .core import build_optimize_graph
+        from .graph import GraphWrapper
+
+        scope = context.scope
+        program = self._transform(
+            context.train_graph.program.clone(), scope)
+        new_graph = GraphWrapper(
+            program, scope=scope,
+            in_nodes=dict(context.train_graph.in_nodes),
+            out_nodes=dict(context.train_graph.out_nodes))
+        loss = program.global_block.var(new_graph.out_nodes["loss"])
+        context.optimize_graph = build_optimize_graph(
+            new_graph, context.train_optimizer, context.executor,
+            scope, loss_var=loss)
+        if context.eval_graph is not None:
+            context.eval_graph = GraphWrapper(
+                self._transform(context.eval_graph.program.clone(),
+                                scope),
+                scope=scope,
+                in_nodes=dict(context.eval_graph.in_nodes),
+                out_nodes=dict(context.eval_graph.out_nodes))
+
+    def on_epoch_end(self, context):
+        if context.epoch_id != self.end_epoch or \
+                context.eval_graph is None or not self._active:
+            return
+        from ... import io as fluid_io
+        from .graph import GraphWrapper
+
+        scope = context.scope
+        frozen = QuantizationFreezePass(
+            scope, weight_bits=self._wbits).apply(
+                context.eval_graph.program.clone(for_test=True))
+        context.k_v["quantized_eval_program"] = frozen
+        in_names = self.save_in_nodes or \
+            list(context.eval_graph.in_nodes.values())
+        out_names = self.save_out_nodes or \
+            list(context.eval_graph.out_nodes.values())
+        out_vars = [frozen.global_block.var(n) for n in out_names]
+        for path in (self.float_model_save_path,
+                     self.int8_model_save_path):
+            # one artifact: weights already snapped to the int grid;
+            # a distinct int8-packed container is deploy-side work
+            if path:
+                from ... import scope_guard
+
+                with scope_guard(scope):
+                    fluid_io.save_inference_model(
+                        path, in_names, out_vars, context.executor,
+                        main_program=frozen)

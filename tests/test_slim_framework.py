@@ -1,0 +1,710 @@
+"""slim compression framework tests.
+
+Parity model: reference contrib/slim/tests/ — test_graph_wrapper.py,
+test_filter_pruning.py, test_distillation_strategy.py,
+test_quantization_strategy.py, test_factory.py (the per-technique
+Compressor round trips, shrunk to CI size).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import slim
+
+RNG = np.random.RandomState(7)
+
+
+def _conv_model():
+    """conv -> bn(relu) -> conv(relu) -> avgpool -> fc, mirroring the
+    shape of the reference slim test net (tests/mobilenet.py at toy
+    scale)."""
+    img = fluid.layers.data(name="img", shape=[3, 8, 8], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    c1 = fluid.layers.conv2d(
+        img, 8, 3, padding=1,
+        param_attr=fluid.ParamAttr(name="conv1_weights"),
+        bias_attr=fluid.ParamAttr(name="conv1_bias"))
+    b1 = fluid.layers.batch_norm(
+        c1, act="relu", param_attr=fluid.ParamAttr(name="bn1_scale"),
+        bias_attr=fluid.ParamAttr(name="bn1_bias"))
+    c2 = fluid.layers.conv2d(
+        b1, 16, 3, padding=1, act="relu",
+        param_attr=fluid.ParamAttr(name="conv2_weights"),
+        bias_attr=fluid.ParamAttr(name="conv2_bias"))
+    p = fluid.layers.pool2d(c2, 2, pool_type="avg", pool_stride=2)
+    logits = fluid.layers.fc(
+        p, 10, param_attr=fluid.ParamAttr(name="fc_w"),
+        bias_attr=fluid.ParamAttr(name="fc_b"))
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    acc = fluid.layers.accuracy(logits, label)
+    return img, label, logits, loss, acc
+
+
+def _conv_batches(n=3, bs=8):
+    def reader():
+        r = np.random.RandomState(11)
+        for _ in range(n):
+            yield {"img": r.randn(bs, 3, 8, 8).astype(np.float32),
+                   "label": r.randint(0, 10, (bs, 1)).astype(np.int64)}
+    return reader
+
+
+class TestGraphWrapper:
+    def test_traversal_and_accounting(self):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            _conv_model()
+        g = slim.GraphWrapper(main)
+        params = {p.name() for p in g.all_parameters()}
+        assert {"conv1_weights", "conv2_weights", "fc_w"} <= params
+        # conv1 -> (bias add) ... conv2 reachable via pre/next ops
+        conv_ops = [op for op in g.ops() if op.type == "conv2d"]
+        assert len(conv_ops) == 2
+        nxt = g.next_ops(conv_ops[0])
+        assert nxt, "conv1 has consumers"
+        assert g.pre_ops(conv_ops[1]), "conv2 has producers"
+        # flops: conv1 = 2*B*8*8*8 * 3*3*3 (+bias) dominated terms > 0
+        assert g.flops() > 0
+        # numel: exact sum of parameter sizes
+        expect = sum(
+            int(np.prod(p.shape())) for p in g.all_parameters())
+        assert g.numel_params() == expect
+        assert g.get_param_by_op(conv_ops[0])[0].name() == \
+            "conv1_weights"
+
+    def test_var_wrapper_producers_consumers(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            _conv_model()
+        g = slim.GraphWrapper(main)
+        w = g.var("conv1_weights")
+        assert [op.type for op in w.outputs()] == ["conv2d"]
+        assert w.inputs() == []  # parameters have no producer op
+
+
+class TestStructurePruner:
+    def test_cal_pruned_idx_l1(self):
+        p = slim.StructurePruner()
+        w = np.stack([np.full((3, 2, 2), v) for v in
+                      (5.0, 1.0, 3.0, 0.5)])  # axis0 l1 order: 3,1,2,0
+        idx = p.cal_pruned_idx("w", w, ratio=0.5, axis=0)
+        np.testing.assert_array_equal(idx, [1, 3])  # two smallest
+
+    def test_prune_tensor_modes(self):
+        w = np.arange(12, dtype=np.float32).reshape(4, 3)
+        hard = slim.StructurePruner.prune_tensor(w, [1, 2], 0)
+        assert hard.shape == (2, 3)
+        np.testing.assert_array_equal(hard[1], [9, 10, 11])
+        lazy = slim.StructurePruner.prune_tensor(w, [1], 0, lazy=True)
+        assert lazy.shape == (4, 3) and lazy[1].sum() == 0
+
+    def test_keeps_at_least_one_filter(self):
+        p = slim.StructurePruner()
+        idx = p.cal_pruned_idx("w", np.ones((4, 2, 1, 1)), ratio=1.0,
+                               axis=0)
+        assert len(idx) == 3
+
+
+class TestUniformPrune:
+    def test_end_to_end_shapes_and_retrain(self):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img, label, logits, loss, acc = _conv_model()
+        eval_prog = main.clone(for_test=True)
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        scope = fluid.global_scope()
+        exe.run(startup)
+        comp = slim.Compressor(
+            place, scope, main, train_reader=_conv_batches(),
+            train_feed_list={"img": "img", "label": "label"},
+            train_fetch_list={"loss": loss.name},
+            eval_program=eval_prog, eval_reader=_conv_batches(),
+            eval_feed_list={"img": "img", "label": "label"},
+            eval_fetch_list={"acc": acc.name},
+            train_optimizer=fluid.optimizer.MomentumOptimizer(0.05,
+                                                              0.9))
+        comp.epoch = 2
+        strategy = slim.UniformPruneStrategy(
+            target_ratio=0.5, start_epoch=1,
+            pruned_params="conv*weights")
+        comp.config([strategy])
+        final = comp.run()
+
+        g = slim.GraphWrapper(final)
+        shapes = {p.name(): p.shape() for p in g.all_parameters()}
+        assert shapes["conv1_weights"] == (4, 3, 3, 3)
+        assert shapes["conv1_bias"] == (4,)
+        assert shapes["bn1_scale"] == (4,)
+        # conv2 loses output filters AND conv1's channels
+        assert shapes["conv2_weights"] == (8, 4, 3, 3)
+        # fc rows follow the pooled channel count: 8 * 4 * 4
+        assert shapes["fc_w"] == (128, 10)
+        # scope arrays match the program metadata
+        for name, shp in shapes.items():
+            assert np.asarray(scope._get(name)).shape == tuple(shp)
+        # eval forward still runs on the pruned program (momentum
+        # accumulators were pruned in lockstep: the post-prune train
+        # epoch inside comp.run() already exercised the update path)
+        out = exe.run(final, feed=next(iter(_conv_batches(1)())),
+                      fetch_list=[acc.name])
+        assert np.isfinite(out[0]).all()
+
+    def test_flops_drop_recorded(self):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img, label, logits, loss, acc = _conv_model()
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        scope = fluid.global_scope()
+        exe.run(startup)
+        comp = slim.Compressor(
+            place, scope, main, train_reader=_conv_batches(1),
+            train_feed_list={"img": "img", "label": "label"},
+            train_fetch_list={"loss": loss.name},
+            train_optimizer=fluid.optimizer.SGDOptimizer(0.05))
+        comp.epoch = 1
+        strategy = slim.UniformPruneStrategy(
+            target_ratio=0.25, start_epoch=0,
+            pruned_params="conv*weights")
+        comp.config([strategy])
+        comp.run()
+        # strategy stashed before/after accounting in the context kv
+        # (checked indirectly: pruning happened once, flag set)
+        assert strategy._pruned
+
+
+class TestSensitivePrune:
+    def test_sensitivities_and_ratio_search(self, tmp_path):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img, label, logits, loss, acc = _conv_model()
+        eval_prog = main.clone(for_test=True)
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        scope = fluid.global_scope()
+        exe.run(startup)
+        sfile = str(tmp_path / "sens.pkl")
+        comp = slim.Compressor(
+            place, scope, main, train_reader=_conv_batches(1),
+            train_feed_list={"img": "img", "label": "label"},
+            train_fetch_list={"loss": loss.name},
+            eval_program=eval_prog, eval_reader=_conv_batches(2),
+            eval_feed_list={"img": "img", "label": "label"},
+            eval_fetch_list={"acc": acc.name},
+            train_optimizer=fluid.optimizer.SGDOptimizer(0.05))
+        comp.epoch = 1
+        strategy = slim.SensitivePruneStrategy(
+            target_ratio=0.4, start_epoch=0, metric_name="acc",
+            pruned_params="conv*weights", sensitivities_file=sfile,
+            eval_batches=2, ratio_steps=(0.25, 0.5))
+        comp.config([strategy])
+        comp.run()
+        assert os.path.exists(sfile)
+        import pickle
+
+        with open(sfile, "rb") as f:
+            sens = pickle.load(f)
+        assert set(sens) == {"conv1_weights", "conv2_weights"}
+        for table in sens.values():
+            assert set(table) == {0.25, 0.5}
+        # weights were restored between probes then REALLY pruned
+        w1 = np.asarray(scope._get("conv1_weights"))
+        assert w1.shape[0] < 8 or \
+            np.asarray(scope._get("conv2_weights")).shape[0] < 16
+
+
+class TestDistillation:
+    def _fc_net(self, prefix, width):
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1],
+                                  dtype="int64")
+        h = fluid.layers.fc(
+            img, width, act="relu",
+            param_attr=fluid.ParamAttr(name=prefix + "w1"),
+            bias_attr=fluid.ParamAttr(name=prefix + "b1"))
+        logits = fluid.layers.fc(
+            h, 5, param_attr=fluid.ParamAttr(name=prefix + "w2"),
+            bias_attr=fluid.ParamAttr(name=prefix + "b2"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        return img, label, logits, loss
+
+    def _reader(self, n=4):
+        def reader():
+            r = np.random.RandomState(3)
+            for _ in range(n):
+                x = r.randn(16, 4).astype(np.float32)
+                y = (x.sum(1, keepdims=True) > 0).astype(np.int64)
+                yield {"img": x, "label": y}
+        return reader
+
+    def test_soft_label_distillation_trains_and_freezes_teacher(self):
+        teacher = fluid.Program()
+        t_start = fluid.Program()
+        with fluid.program_guard(teacher, t_start):
+            _, _, t_logits, _ = self._fc_net("t_", 32)
+        teacher_eval = teacher.clone(for_test=True)
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img, label, s_logits, loss = self._fc_net("s_", 8)
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        scope = fluid.global_scope()
+        exe.run(startup)
+        exe.run(t_start)
+        dist = slim.DistillationStrategy(
+            distillers=[slim.SoftLabelDistiller(
+                s_logits.name, "teacher_" + t_logits.name,
+                teacher_temperature=2.0)],
+            start_epoch=0, end_epoch=1)
+        comp = slim.Compressor(
+            place, scope, main, train_reader=self._reader(),
+            train_feed_list={"img": "img", "label": "label"},
+            train_fetch_list={"loss": loss.name},
+            eval_program=main.clone(for_test=True),
+            eval_reader=self._reader(2),
+            eval_feed_list={"img": "img", "label": "label"},
+            eval_fetch_list={"loss": loss.name},
+            teacher_programs=[teacher_eval],
+            train_optimizer=fluid.optimizer.SGDOptimizer(0.1),
+            distiller_optimizer=fluid.optimizer.SGDOptimizer(0.1))
+        comp.epoch = 3
+        comp.config([dist])
+        t_w = np.array(scope._get("t_w1"))
+        comp.run()
+        # teacher untouched (both original and merged copy)
+        np.testing.assert_array_equal(t_w, scope._get("t_w1"))
+        np.testing.assert_array_equal(
+            t_w, scope._get("teacher_t_w1"))
+        # student learned: loss on fresh data well below chance
+        out = exe.run(main.clone(for_test=True),
+                      feed=next(iter(self._reader(1)())),
+                      fetch_list=[loss.name])
+        assert float(np.mean(out[0])) < 1.7  # below -ln(1/5)+slack
+
+    def test_l2_and_fsp_distillers_build(self):
+        teacher = fluid.Program()
+        t_start = fluid.Program()
+        with fluid.program_guard(teacher, t_start):
+            _conv_model()
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img, label, logits, loss, acc = _conv_model()
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        scope = fluid.global_scope()
+        exe.run(startup)
+        exe.run(t_start)
+        merged = slim.GraphWrapper(main.clone(), scope=scope,
+                                   out_nodes={"loss": loss.name})
+        slim.merge(slim.GraphWrapper(teacher.clone(for_test=True)),
+                   merged, scope)
+        # teacher activations exist under the prefix
+        conv_outs = [op._op.output("Output")[0]
+                     for op in merged.ops()
+                     if op.type == "conv2d" and not
+                     op._op.output("Output")[0].startswith("teacher_")]
+        t_conv_outs = [n for n in
+                       (op._op.output("Output")[0]
+                        for op in merged.ops() if op.type == "conv2d")
+                       if n.startswith("teacher_")]
+        assert len(conv_outs) == 2 and len(t_conv_outs) == 2
+        with fluid.program_guard(merged.program):
+            l2 = slim.L2Distiller(
+                conv_outs[0], t_conv_outs[0]).distiller_loss(merged)
+            fsp = slim.FSPDistiller(
+                [(conv_outs[0], conv_outs[1])],
+                [(t_conv_outs[0], t_conv_outs[1])]).distiller_loss(
+                    merged)
+        feed = next(iter(_conv_batches(1)()))
+        with fluid.scope_guard(scope):
+            vals = exe.run(merged.program, feed=feed,
+                           fetch_list=[l2.name, fsp.name],
+                           scope=scope)
+        assert all(np.isfinite(v).all() for v in vals)
+        # FSP of identical pairs with itself would be 0; student vs
+        # teacher differs
+        assert float(vals[1]) >= 0
+
+
+class TestQuantizationStrategy:
+    def test_qat_freeze_export_reload(self, tmp_path):
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[8],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            h = fluid.layers.fc(img, 16, act="relu")
+            logits = fluid.layers.fc(h, 5)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            acc = fluid.layers.accuracy(logits, label)
+        eval_prog = main.clone(for_test=True)
+        place = fluid.CPUPlace()
+        exe = fluid.Executor(place)
+        scope = fluid.global_scope()
+        exe.run(startup)
+
+        def reader():
+            r = np.random.RandomState(5)
+            for _ in range(3):
+                x = r.randn(16, 8).astype(np.float32)
+                y = (x.sum(1, keepdims=True) > 0).astype(np.int64)
+                yield {"img": x, "label": y}
+
+        export = str(tmp_path / "qmodel")
+        comp = slim.Compressor(
+            place, scope, main, train_reader=reader,
+            train_feed_list={"img": "img", "label": "label"},
+            train_fetch_list={"loss": loss.name},
+            eval_program=eval_prog, eval_reader=reader,
+            eval_feed_list={"img": "img", "label": "label"},
+            eval_fetch_list={"acc": acc.name},
+            train_optimizer=fluid.optimizer.AdamOptimizer(0.01))
+        comp.epoch = 2
+        comp.config({
+            "strategies": {
+                "quant": {"class": "QuantizationStrategy",
+                          "start_epoch": 0, "end_epoch": 1,
+                          "float_model_save_path": export,
+                          "weight_quantize_type": "abs_max",
+                          "activation_quantize_type":
+                              "moving_average_abs_max",
+                          "save_in_nodes": ["img"],
+                          "save_out_nodes": [logits.name]}},
+            "compressor": {"epoch": 2, "strategies": ["quant"]}})
+        comp.run()
+        # exported artifact reloads and serves
+        prog, feeds, fetches = fluid.io.load_inference_model(export,
+                                                             exe)
+        out = exe.run(prog, feed={
+            feeds[0]: np.random.RandomState(9).randn(4, 8).astype(
+                np.float32)}, fetch_list=fetches)
+        assert np.asarray(out[0]).shape == (4, 5)
+        # frozen weights sit on the int8 grid: few distinct values
+        w = None
+        for v in prog.global_block.vars.values():
+            if v.persistable and v.shape and len(v.shape) == 2 and \
+                    v.shape[1] == 16:
+                w = np.asarray(scope._get(v.name))
+                break
+        assert w is not None
+        scale = np.abs(w).max()
+        snapped = np.round(np.clip(w / scale, -1, 1) * 127) / 127 * \
+            scale
+        np.testing.assert_allclose(w, snapped, atol=1e-6)
+
+
+class TestConfigFactory:
+    def test_unknown_class_raises(self):
+        with pytest.raises(KeyError):
+            slim.ConfigFactory({"strategies": {
+                "x": {"class": "NoSuchStrategy"}}})
+
+    def test_builds_selected_strategies(self):
+        f = slim.ConfigFactory({
+            "strategies": {
+                "p": {"class": "UniformPruneStrategy",
+                      "target_ratio": 0.3},
+                "q": {"class": "QuantizationStrategy"}},
+            "compressor": {"epoch": 7, "strategies": ["p"]}})
+        assert f.epoch == 7
+        assert len(f.strategies) == 1
+        assert isinstance(f.strategies[0], slim.UniformPruneStrategy)
+        assert f.strategies[0].target_ratio == pytest.approx(0.3)
+
+
+class TestCompressorCheckpoint:
+    def test_resume_from_checkpoint(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+
+        def build_and_run(epochs):
+            main = fluid.Program()
+            startup = fluid.Program()
+            with fluid.program_guard(main, startup):
+                img, label, logits, loss, acc = _conv_model()
+            place = fluid.CPUPlace()
+            exe = fluid.Executor(place)
+            scope = fluid.global_scope()
+            exe.run(startup)
+            comp = slim.Compressor(
+                place, scope, main, train_reader=_conv_batches(2),
+                train_feed_list={"img": "img", "label": "label"},
+                train_fetch_list={"loss": loss.name},
+                train_optimizer=fluid.optimizer.SGDOptimizer(0.05),
+                checkpoint_path=ckpt)
+            comp.epoch = epochs
+            comp.config([])
+            comp.run()
+            return scope
+
+        build_and_run(1)  # writes epoch-0 checkpoint
+        assert os.path.isdir(os.path.join(ckpt, "0"))
+        # second job resumes at epoch 1 (trains exactly 1 more epoch)
+        scope = build_and_run(2)
+        assert os.path.isdir(os.path.join(ckpt, "1"))
+
+
+class TestReviewRegressions:
+    """Regression oracles for the round-2 review findings on slim."""
+
+    def test_merged_teacher_ops_get_fresh_uids(self):
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            x = fluid.layers.data(name="img", shape=[4],
+                                  dtype="float32")
+            fluid.layers.dropout(fluid.layers.fc(x, 4), 0.5)
+        teacher = fluid.Program()
+        with fluid.program_guard(teacher, fluid.Program()):
+            x = fluid.layers.data(name="img", shape=[4],
+                                  dtype="float32")
+            fluid.layers.dropout(fluid.layers.fc(x, 4), 0.5)
+        g = slim.GraphWrapper(main.clone())
+        slim.merge(slim.GraphWrapper(teacher), g, fluid.global_scope())
+        uids = [op._op._uid for op in g.ops()]
+        assert len(uids) == len(set(uids)), \
+            "student/teacher sampling ops share PRNG salts"
+
+    def test_two_teachers_same_arch_do_not_alias(self):
+        def small():
+            x = fluid.layers.data(name="img", shape=[4],
+                                  dtype="float32")
+            return fluid.layers.fc(
+                x, 3, param_attr=fluid.ParamAttr(name="w"),
+                bias_attr=False)
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            small()
+        scope = fluid.global_scope()
+        teachers = []
+        for v in (1.0, 2.0):
+            t = fluid.Program()
+            with fluid.program_guard(t, fluid.Program()):
+                small()
+            teachers.append(t)
+        scope.var("w")
+        scope._set("w", np.full((4, 3), 7.0, np.float32))
+        g = slim.GraphWrapper(main.clone(), scope=scope)
+        for i, t in enumerate(teachers):
+            slim.merge(slim.GraphWrapper(t), g, scope,
+                       name_prefix=slim.DistillationStrategy
+                       .teacher_prefix(i))
+        names = set(g.program.global_block.vars)
+        assert "teacher_w" in names and "teacher1_w" in names
+        # same prefix twice raises instead of aliasing
+        with pytest.raises(ValueError):
+            slim.merge(slim.GraphWrapper(teachers[0]), g, scope,
+                       name_prefix="teacher_")
+
+    def test_random_criterion_is_process_stable(self):
+        p = slim.StructurePruner(criterions={"*": "random"})
+        w = np.ones((8, 2, 1, 1), np.float32)
+        idx = p.cal_pruned_idx("convX_weights", w, 0.5, axis=0)
+        import subprocess, sys
+        code = (
+            "import sys; sys.path.insert(0, '/root/repo')\n"
+            "import numpy as np\n"
+            "from paddle_tpu.contrib.slim import StructurePruner\n"
+            "p = StructurePruner(criterions={'*': 'random'})\n"
+            "w = np.ones((8, 2, 1, 1), np.float32)\n"
+            "print(list(p.cal_pruned_idx('convX_weights', w, 0.5,"
+            " axis=0)))\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, env={**os.environ, "PYTHONHASHSEED": "123",
+                            "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        assert str(list(idx)) == out.stdout.strip()
+
+    def test_resume_syncs_pruned_shapes(self, tmp_path):
+        """After prune + checkpoint, a resumed job's program metadata
+        must match the loaded (pruned) arrays."""
+        ckpt = str(tmp_path / "ck")
+
+        def job(epochs):
+            main = fluid.Program()
+            startup = fluid.Program()
+            with fluid.program_guard(main, startup):
+                img, label, logits, loss, acc = _conv_model()
+            place = fluid.CPUPlace()
+            exe = fluid.Executor(place)
+            scope = fluid.global_scope()
+            exe.run(startup)
+            comp = slim.Compressor(
+                place, scope, main, train_reader=_conv_batches(1),
+                train_feed_list={"img": "img", "label": "label"},
+                train_fetch_list={"loss": loss.name},
+                train_optimizer=fluid.optimizer.SGDOptimizer(0.05),
+                checkpoint_path=ckpt)
+            comp.epoch = epochs
+            comp.config([slim.UniformPruneStrategy(
+                target_ratio=0.5, start_epoch=0,
+                pruned_params="conv*weights")])
+            final = comp.run()
+            return final
+
+        job(1)
+        # simulate a process restart: fresh scope AND fresh name
+        # counters, so rebuilt auto-named vars (bn running stats)
+        # regenerate the same names the checkpoint holds
+        fluid._reset_global_scope()
+        fluid.unique_name.switch()
+        final = job(2)  # resumes at epoch 1; prune epoch already past
+        g = slim.GraphWrapper(final)
+        assert g.var("conv1_weights").shape() == (4, 3, 3, 3), \
+            "resumed program kept stale pre-prune shapes"
+
+
+class TestReviewRegressions2:
+    """Second review pass: residual pruning, flops accounting, QAT
+    resume."""
+
+    def test_residual_two_matched_convs_prune_together(self):
+        """Two pattern-matched convs feeding one elementwise_add must
+        prune the SAME channels (propagated indices win; no
+        'conflicting prune' abort)."""
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            a = fluid.layers.conv2d(
+                img, 8, 3, padding=1,
+                param_attr=fluid.ParamAttr(name="conva_weights"),
+                bias_attr=False)
+            b = fluid.layers.conv2d(
+                img, 8, 3, padding=1,
+                param_attr=fluid.ParamAttr(name="convb_weights"),
+                bias_attr=False)
+            s = fluid.layers.elementwise_add(a, b, act="relu")
+            p = fluid.layers.pool2d(s, 8, pool_type="avg")
+            logits = fluid.layers.fc(p, 4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.global_scope()
+        exe.run(startup)
+        comp = slim.Compressor(
+            fluid.CPUPlace(), scope, main,
+            train_reader=_conv_batches(1),
+            train_feed_list={"img": "img", "label": "label"},
+            train_fetch_list={"loss": loss.name},
+            train_optimizer=fluid.optimizer.SGDOptimizer(0.05))
+        comp.epoch = 1
+        comp.config([slim.UniformPruneStrategy(
+            target_ratio=0.5, start_epoch=0,
+            pruned_params="conv*weights")])
+        final = comp.run()
+        g = slim.GraphWrapper(final)
+        assert g.var("conva_weights").shape()[0] == 4
+        assert g.var("convb_weights").shape()[0] == 4
+        # scope arrays agree
+        assert np.asarray(scope._get("conva_weights")).shape[0] == 4
+        assert np.asarray(scope._get("convb_weights")).shape[0] == 4
+
+    def test_flops_accounting_reflects_prune(self):
+        """post-prune flops must drop by roughly the channel ratio —
+        stale intermediate shapes previously overstated them."""
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            img, label, logits, loss, acc = _conv_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.global_scope()
+        exe.run(startup)
+        comp = slim.Compressor(
+            fluid.CPUPlace(), scope, main,
+            train_reader=_conv_batches(1),
+            train_feed_list={"img": "img", "label": "label"},
+            train_fetch_list={"loss": loss.name},
+            train_optimizer=fluid.optimizer.SGDOptimizer(0.05))
+        comp.epoch = 1
+        strategy = slim.UniformPruneStrategy(
+            target_ratio=0.5, start_epoch=0,
+            pruned_params="conv*weights")
+        comp.config([strategy])
+        context_kv = {}
+        comp.run()
+        g = slim.GraphWrapper(comp.train_graph.program)
+        # conv1: out 4 (was 8) in 3; conv2: out 8 in 4 (was 16 in 8):
+        # conv flops drop ~4x on conv2, 2x on conv1 — total well under
+        # 65% of original
+        # reconstruct original flops from a fresh build
+        main2 = fluid.Program()
+        with fluid.program_guard(main2, fluid.Program()):
+            _conv_model()
+        f_orig = slim.GraphWrapper(main2).flops()
+        f_pruned = g.flops()
+        assert f_pruned < 0.65 * f_orig, (f_orig, f_pruned)
+
+    def test_qat_applies_on_resume(self, tmp_path):
+        ckpt = str(tmp_path / "qck")
+        export = str(tmp_path / "qexp")
+
+        def job(epochs, end_epoch):
+            main = fluid.Program()
+            startup = fluid.Program()
+            with fluid.program_guard(main, startup):
+                img = fluid.layers.data(name="img", shape=[6],
+                                        dtype="float32")
+                label = fluid.layers.data(name="label", shape=[1],
+                                          dtype="int64")
+                logits = fluid.layers.fc(img, 4)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(logits,
+                                                            label))
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = fluid.global_scope()
+            exe.run(startup)
+
+            def reader():
+                r = np.random.RandomState(2)
+                for _ in range(2):
+                    yield {"img": r.randn(8, 6).astype(np.float32),
+                           "label": r.randint(0, 4, (8, 1)).astype(
+                               np.int64)}
+
+            comp = slim.Compressor(
+                fluid.CPUPlace(), scope, main, train_reader=reader,
+                train_feed_list={"img": "img", "label": "label"},
+                train_fetch_list={"loss": loss.name},
+                eval_program=main.clone(for_test=True),
+                eval_reader=reader,
+                eval_feed_list={"img": "img", "label": "label"},
+                eval_fetch_list={"loss": loss.name},
+                train_optimizer=fluid.optimizer.SGDOptimizer(0.05),
+                checkpoint_path=ckpt)
+            comp.epoch = epochs
+            strategy = slim.QuantizationStrategy(
+                start_epoch=0, end_epoch=end_epoch,
+                float_model_save_path=export,
+                save_in_nodes=["img"], save_out_nodes=[logits.name])
+            comp.config([strategy])
+            comp.run()
+            return comp, strategy
+
+        job(1, end_epoch=1)  # checkpoint epoch 0; no freeze yet
+        fluid._reset_global_scope()
+        fluid.unique_name.switch()
+        comp, strategy = job(2, end_epoch=1)  # resumes at epoch 1
+        assert os.path.isdir(export), \
+            "freeze/export must still happen on the resumed job"
+        prog, feeds, fetches = fluid.io.load_inference_model(export,
+            fluid.Executor(fluid.CPUPlace()))
+        assert any(op.type.startswith("fake_quantize")
+                   for op in prog.global_block.ops), \
+            "exported model lost the QAT rewrite on resume"
