@@ -15,7 +15,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..layer_helper import LayerHelper
-from .sequence import seq_len_of, SEQ_LEN_SUFFIX
+from .sequence import bind_seq_len, seq_len_of, SEQ_LEN_SUFFIX
 
 __all__ = ["lstm", "dynamic_lstm", "dynamic_gru", "gru_unit",
            "lstm_unit", "beam_search", "beam_search_decode",
@@ -67,7 +67,9 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
          dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
          default_initializer=None, seed=-1):
     """cuDNN-style stacked LSTM (reference cudnn_lstm_op.cu.cc) -- here a
-    stack of scan-based layers."""
+    stack of scan-based layers; is_bidirec runs a reversed twin per
+    layer and concats the two hidden sequences (the cuDNN
+    CUDNN_BIDIRECTIONAL semantics)."""
     helper = LayerHelper("cudnn_lstm", input=input, name=name)
     from . import nn
 
@@ -75,15 +77,34 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
     h_last = None
     c_last = None
     for layer in range(num_layers):
-        proj = nn.fc(x, 4 * hidden_size, num_flatten_dims=2,
-                     bias_attr=None)
-        h, c = dynamic_lstm(proj, 4 * hidden_size,
-                            use_peepholes=False)
-        if dropout_prob and not is_test:
-            h = nn.dropout(h, dropout_prob,
-                           dropout_implementation="upscale_in_train")
+        hs, cs = [], []
+        for is_rev in ((False, True) if is_bidirec else (False,)):
+            proj = nn.fc(x, 4 * hidden_size, num_flatten_dims=2,
+                         bias_attr=None)
+            bind_seq_len(proj, x)
+            h, c = dynamic_lstm(proj, 4 * hidden_size,
+                                use_peepholes=False,
+                                is_reverse=is_rev)
+            hs.append(h)
+            cs.append(c)
+        if is_bidirec:
+            h = nn.concat(hs, axis=-1)
+            c = nn.concat(cs, axis=-1)
+            # feature concat preserves the padded-batch layout; keep
+            # the @SEQ_LEN companion flowing into the next layer
+            bind_seq_len(h, hs[0])
+            bind_seq_len(c, cs[0])
+        else:
+            h, c = hs[0], cs[0]
         x = h
         h_last, c_last = h, c
+        # cuDNN applies dropout BETWEEN layers only (cudnn_rnn_cache.h
+        # dropout descriptor; same guard as the cudnn_lstm op) — never
+        # to the final output / last states
+        if dropout_prob and not is_test and layer < num_layers - 1:
+            x = nn.dropout(h, dropout_prob,
+                           dropout_implementation="upscale_in_train")
+            bind_seq_len(x, h)
     return x, h_last, c_last
 
 

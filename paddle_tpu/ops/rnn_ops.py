@@ -192,10 +192,14 @@ def cudnn_lstm(ctx):
     weight vector. TPU lowering: unpack W into per-layer (Wx, Wh,
     bx, bh) and run the same scan the `lstm` op uses -- one XLA
     program, no cuDNN. Packing layout (cudnnGetRNNLinLayerMatrixParams
-    order): per layer the 8 matrices [Wi Wf Wc Wo | Ri Rf Rc Ro], then
-    per layer the 8 bias vectors in the same order. Gate order
-    i, f, c(candidate), o. Input [T, B, I] (seq-major, the cuDNN
-    convention), InitH/InitC [L, B, H]; is_bidirec is not lowered.
+    order): per PSEUDO-layer the 8 matrices [Wi Wf Wc Wo | Ri Rf Rc Ro],
+    then per pseudo-layer the 8 bias vectors in the same order. A
+    pseudo-layer is (layer, direction) with direction minor — for
+    is_bidirec the order is l0-fwd, l0-bwd, l1-fwd, l1-bwd, ... and
+    layers past the first consume the 2H concat of both directions.
+    Gate order i, f, c(candidate), o. Input [T, B, I] (seq-major, the
+    cuDNN convention), InitH/InitC [L*dirs, B, H]; Out is [T, B,
+    H*dirs].
     """
     x = ctx.input("Input")            # [T, B, I]
     w = ctx.input("W").reshape(-1)
@@ -206,24 +210,23 @@ def cudnn_lstm(ctx):
     layers = int(ctx.attr("num_layers", 1))
     dropout_p = float(ctx.attr("dropout_prob", 0.0))
     is_test = ctx.attr("is_test", False)
-    if ctx.attr("is_bidirec", False):
-        raise ValueError("cudnn_lstm: is_bidirec is not lowered on "
-                         "TPU; stack a reversed direction explicitly")
+    dirs = 2 if ctx.attr("is_bidirec", False) else 1
     t, b, _ = x.shape
     h = hidden
 
-    # unpack the cuDNN canonical flat weights
+    # unpack the cuDNN canonical flat weights, pseudo-layer major
     mats = []
     off = 0
-    for l in range(layers):
-        isz = in_size if l == 0 else h
+    for pl in range(layers * dirs):
+        layer = pl // dirs
+        isz = in_size if layer == 0 else h * dirs
         wx = w[off:off + 4 * h * isz].reshape(4 * h, isz)
         off += 4 * h * isz
         wh = w[off:off + 4 * h * h].reshape(4 * h, h)
         off += 4 * h * h
         mats.append((wx, wh))
     biases = []
-    for l in range(layers):
+    for pl in range(layers * dirs):
         bx = w[off:off + 4 * h]
         off += 4 * h
         bh = w[off:off + 4 * h]
@@ -231,16 +234,15 @@ def cudnn_lstm(ctx):
         biases.append(bx + bh)
 
     if h0 is None:
-        h0 = jnp.zeros((layers, b, h), x.dtype)
+        h0 = jnp.zeros((layers * dirs, b, h), x.dtype)
     if c0 is None:
-        c0 = jnp.zeros((layers, b, h), x.dtype)
+        c0 = jnp.zeros((layers * dirs, b, h), x.dtype)
 
-    seq = x
-    last_h, last_c = [], []
-    for l in range(layers):
-        wx, wh = mats[l]
-        bias = biases[l]
-        pre = jnp.einsum("tbi,gi->tbg", seq, wx) + bias
+    def run_direction(seq, pl, reverse):
+        wx, wh = mats[pl]
+        pre = jnp.einsum("tbi,gi->tbg", seq, wx) + biases[pl]
+        if reverse:
+            pre = pre[::-1]
 
         def cell(carry, xt):
             hp, cp = carry
@@ -253,10 +255,20 @@ def cudnn_lstm(ctx):
             hh = o * jnp.tanh(c)
             return (hh, c), hh
 
-        (hT, cT), hs = jax.lax.scan(cell, (h0[l], c0[l]), pre)
-        last_h.append(hT)
-        last_c.append(cT)
-        seq = hs
+        (hT, cT), hs = jax.lax.scan(cell, (h0[pl], c0[pl]), pre)
+        return (hs[::-1] if reverse else hs), hT, cT
+
+    seq = x
+    last_h, last_c = [], []
+    for l in range(layers):
+        outs = []
+        for d in range(dirs):
+            pl = l * dirs + d
+            hs, hT, cT = run_direction(seq, pl, reverse=(d == 1))
+            outs.append(hs)
+            last_h.append(hT)
+            last_c.append(cT)
+        seq = outs[0] if dirs == 1 else jnp.concatenate(outs, -1)
         if dropout_p and not is_test and l < layers - 1:
             keep = jax.random.bernoulli(ctx.rng(), 1.0 - dropout_p,
                                         seq.shape)

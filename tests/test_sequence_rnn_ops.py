@@ -215,3 +215,106 @@ class TestCudnnLSTM(OpTest):
     def test_grad(self):
         self.check_grad(["Input", "W"], "Out",
                         no_grad_set={"InitH", "InitC"})
+
+
+class TestCudnnLSTMBidirec(OpTest):
+    """is_bidirec=True vs a numpy oracle: per layer a forward and a
+    time-reversed LSTM over the same input, hidden states concatenated
+    (cuDNN CUDNN_BIDIRECTIONAL pseudo-layer packing, direction minor —
+    reference cudnn_lstm_op.cc / cudnn_rnn_cache.h)."""
+
+    def setUp(self):
+        super().setUp()
+        self.op_type = "cudnn_lstm"
+        t, b, isz, h, layers, dirs = 3, 2, 4, 5, 2, 2
+        r = np.random.RandomState(1)
+        x = (r.randn(t, b, isz) * 0.3).astype("float32")
+        h0 = (r.randn(layers * dirs, b, h) * 0.3).astype("float32")
+        c0 = (r.randn(layers * dirs, b, h) * 0.3).astype("float32")
+        mats, flat = [], []
+        for pl in range(layers * dirs):
+            i_l = isz if pl // dirs == 0 else h * dirs
+            wx = (r.randn(4 * h, i_l) * 0.3).astype("float32")
+            wh = (r.randn(4 * h, h) * 0.3).astype("float32")
+            mats.append((wx, wh))
+            flat += [wx.ravel(), wh.ravel()]
+        bias = []
+        for pl in range(layers * dirs):
+            bx = (r.randn(4 * h) * 0.3).astype("float32")
+            bh = (r.randn(4 * h) * 0.3).astype("float32")
+            bias.append(bx + bh)
+            flat += [bx, bh]
+        w = np.concatenate(flat)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        def run_dir(seq, pl, reverse):
+            wx, wh = mats[pl]
+            order = range(t - 1, -1, -1) if reverse else range(t)
+            hs = np.zeros((t, b, h), np.float32)
+            hp, cp = h0[pl].copy(), c0[pl].copy()
+            for step in order:
+                g = seq[step] @ wx.T + hp @ wh.T + bias[pl]
+                gi, gf, gc, go = np.split(g, 4, axis=1)
+                cp = sig(gf) * cp + sig(gi) * np.tanh(gc)
+                hp = sig(go) * np.tanh(cp)
+                hs[step] = hp
+            return hs, hp, cp
+
+        seq = x
+        last_h = np.zeros((layers * dirs, b, h), np.float32)
+        last_c = np.zeros((layers * dirs, b, h), np.float32)
+        for l in range(layers):
+            outs = []
+            for d in range(dirs):
+                pl = l * dirs + d
+                hs, hT, cT = run_dir(seq, pl, reverse=(d == 1))
+                outs.append(hs)
+                last_h[pl], last_c[pl] = hT, cT
+            seq = np.concatenate(outs, axis=-1)
+        self.inputs = {"Input": x, "W": w, "InitH": h0, "InitC": c0}
+        self.attrs = {"hidden_size": h, "input_size": isz,
+                      "num_layers": layers, "is_bidirec": True,
+                      "is_test": True}
+        self.outputs = {"Out": seq, "last_h": last_h,
+                        "last_c": last_c}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["Input", "W"], "Out",
+                        no_grad_set={"InitH", "InitC"})
+
+
+def test_layers_lstm_bidirec_trains():
+    """layers.lstm(is_bidirec=True): output widens to 2H and a stacked
+    bidirectional model trains (loss falls) — the reference lstm layer
+    wraps the bidirectional cuDNN descriptor (layers/nn.py lstm)."""
+    import paddle_tpu as fluid
+
+    B, T, D, H = 4, 6, 8, 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[T, D], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        out, h_last, c_last = fluid.layers.lstm(
+            x, None, None, T, H, num_layers=2, is_bidirec=True)
+        assert out.shape[-1] == 2 * H
+        pooled = fluid.layers.reduce_mean(out, dim=[1, 2], keep_dim=False)
+        pred = fluid.layers.fc(fluid.layers.reshape(pooled, [-1, 1]), 1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - y))
+        fluid.optimizer.AdamOptimizer(0.02).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    xb = r.randn(B, T, D).astype(np.float32)
+    yb = xb.sum(axis=(1, 2), keepdims=False).reshape(B, 1).astype(
+        np.float32) * 0.1
+    lens = np.full((B,), T, np.int32)
+    losses = [float(np.mean(exe.run(
+        main, feed={"x": xb, "y": yb, "x@SEQ_LEN": lens},
+        fetch_list=[loss])[0]))
+        for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
