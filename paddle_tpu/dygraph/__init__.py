@@ -2,6 +2,8 @@ from .base import (to_variable, guard, enabled, enable_dygraph,
                    disable_dygraph, no_grad)  # noqa: F401
 from .layers import Layer  # noqa: F401
 from .nn import (Conv2D, Pool2D, FC, Linear, BatchNorm, Embedding,
-                 LayerNorm, GRUUnit, PRelu, NCE, Dropout)  # noqa: F401
+                 LayerNorm, GRUUnit, PRelu, NCE, Dropout,
+                 BilinearTensorProduct, Conv2DTranspose,
+                 SequenceConv)  # noqa: F401
 from .checkpoint import save_persistables, load_persistables  # noqa: F401
 from .tracer import Tracer  # noqa: F401

@@ -305,3 +305,88 @@ def _pair(v):
     if isinstance(v, (list, tuple)):
         return list(v)
     return [v, v]
+
+
+class BilinearTensorProduct(Layer):
+    """reference dygraph/nn.py BilinearTensorProduct:1025 —
+    out[b,k] = x[b] @ W[k] @ y[b] + bias[k]."""
+
+    def __init__(self, name_scope=None, input1_dim=None,
+                 input2_dim=None, output_dim=None, act=None,
+                 param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim])
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([1, output_dim], is_bias=True)
+
+    def forward(self, x, y):
+        ins = {"X": [x], "Y": [y], "Weight": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out, = trace_op("bilinear_tensor_product", ins, 1, {})
+        return _act(out, self._act)
+
+
+class Conv2DTranspose(Layer):
+    """reference dygraph/nn.py Conv2DTranspose:1117 — filter layout
+    [in_c, out_c/groups, kh, kw] (conv2d_transpose_op.cc)."""
+
+    def __init__(self, name_scope=None, num_channels=None,
+                 num_filters=None, filter_size=3, stride=1, padding=0,
+                 dilation=1, groups=1, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        fs = _pair(filter_size)
+        self._attrs = {"strides": _pair(stride),
+                       "paddings": _pair(padding),
+                       "dilations": _pair(dilation), "groups": groups}
+        self._act = act
+        self.weight = self.create_parameter(
+            [num_channels, num_filters // groups, fs[0], fs[1]])
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([num_filters], is_bias=True)
+
+    def forward(self, x):
+        out, = trace_op("conv2d_transpose",
+                        {"Input": [x], "Filter": [self.weight]}, 1,
+                        self._attrs, out_slots={"Output": 1})
+        if self.bias is not None:
+            out, = trace_op("elementwise_add",
+                            {"X": [out], "Y": [self.bias]}, 1,
+                            {"axis": 1})
+        return _act(out, self._act)
+
+
+class SequenceConv(Layer):
+    """reference dygraph/nn.py SequenceConv:1329 — context-window conv
+    over padded [B,T,D] batches (the @SEQ_LEN design replaces LoD; in
+    eager mode rows are taken full-length)."""
+
+    def __init__(self, name_scope=None, num_filters=None,
+                 filter_size=3, filter_stride=1, padding=None,
+                 input_dim=None, act=None, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        if filter_stride != 1:
+            raise ValueError("sequence_conv supports stride 1 only "
+                             "(reference sequence_conv_op.cc)")
+        self._attrs = {"contextLength": filter_size,
+                       "contextStart": -((filter_size - 1) // 2),
+                       "contextStride": 1}
+        self._act = act
+        self.weight = self.create_parameter(
+            [filter_size * input_dim, num_filters])
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([num_filters], is_bias=True)
+
+    def forward(self, x):
+        out, = trace_op("sequence_conv",
+                        {"X": [x], "Filter": [self.weight]}, 1,
+                        self._attrs)
+        if self.bias is not None:
+            out, = trace_op("elementwise_add",
+                            {"X": [out], "Y": [self.bias]}, 1,
+                            {"axis": 2})
+        return _act(out, self._act)

@@ -1,7 +1,9 @@
 from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
-from .io import data  # noqa: F401
+from .io import (data, py_reader, create_py_reader_by_data,  # noqa: F401
+                 double_buffer, batch, shuffle, open_files,
+                 random_data_generator, read_file, load, Preprocessor)
 from .control_flow import *  # noqa: F401,F403
 from .metric_op import accuracy, auc  # noqa: F401
 from .learning_rate_scheduler import *  # noqa: F401,F403

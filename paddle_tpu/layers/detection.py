@@ -4,6 +4,8 @@ Kernels in ops/detection_ops.py.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from ..layer_helper import LayerHelper
 
 __all__ = ["detection_map",
@@ -347,3 +349,165 @@ def detection_map(detect_res, label, class_num=None, background_label=0,
          "evaluate_difficult": evaluate_difficult,
          "has_difficult": bool(has_difficult)})
     return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip, name=None):
+    """reference layers/detection.py box_decoder_and_assign ->
+    detection/box_decoder_and_assign_op.cc."""
+    helper = LayerHelper("box_decoder_and_assign", input=prior_box,
+                         name=name)
+    decoded = helper.create_variable_for_type_inference(
+        prior_box.dtype)
+    assigned = helper.create_variable_for_type_inference(
+        prior_box.dtype)
+    helper.append_op(
+        "box_decoder_and_assign",
+        {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+         "TargetBox": target_box, "BoxScore": box_score},
+        {"DecodeBox": decoded, "OutputAssignBox": assigned},
+        {"box_clip": box_clip})
+    return decoded, assigned
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale, name=None):
+    """reference layers/detection.py distribute_fpn_proposals; the TPU
+    fixed-shape contract packs each level's rois to the top with a
+    per-level count vector (see ops/detection_ops.py)."""
+    helper = LayerHelper("distribute_fpn_proposals", input=fpn_rois,
+                         name=name)
+    num_level = max_level - min_level + 1
+    multi_rois = [helper.create_variable_for_type_inference(
+        fpn_rois.dtype) for _ in range(num_level)]
+    counts = helper.create_variable_for_type_inference("int32", True)
+    restore = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op(
+        "distribute_fpn_proposals", {"FpnRois": fpn_rois},
+        {"MultiFpnRois": multi_rois, "MultiLevelCounts": counts,
+         "RestoreIndex": restore},
+        {"min_level": min_level, "max_level": max_level,
+         "refer_level": refer_level, "refer_scale": refer_scale})
+    return multi_rois, restore
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """reference layers/detection.py roi_perspective_transform ->
+    detection/roi_perspective_transform_op.cc (quad rois, 8 coords)."""
+    helper = LayerHelper("roi_perspective_transform", input=input,
+                         name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "roi_perspective_transform", {"X": input, "ROIs": rois},
+        {"Out": out},
+        {"transformed_height": transformed_height,
+         "transformed_width": transformed_width,
+         "spatial_scale": spatial_scale})
+    return out
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms,
+                         rois, labels_int32, num_classes, resolution,
+                         gt_boxes=None, poly_len=None, name=None):
+    """reference layers/detection.py generate_mask_labels ->
+    detection/mask_util.cc + generate_mask_labels_op.cc (Mask R-CNN
+    mask targets; polygons rasterized host-side via py-callback).
+
+    Deviation from the reference signature: the fixed-shape kernel
+    needs gt_boxes [G,4] and poly_len [G] explicitly (the reference
+    recovers boxes from LoD-segmented polygons; the padded design
+    carries them as separate inputs) — both are REQUIRED here."""
+    if gt_boxes is None or poly_len is None:
+        raise ValueError(
+            "generate_mask_labels on TPU needs gt_boxes=[G,4] and "
+            "poly_len=[G] (the padded-polygon companions; see "
+            "ops/detection_ops.py generate_mask_labels)")
+    helper = LayerHelper("generate_mask_labels", input=rois, name=name)
+    mask_rois = helper.create_variable_for_type_inference(rois.dtype,
+                                                          True)
+    has_mask = helper.create_variable_for_type_inference("int32", True)
+    mask_int32 = helper.create_variable_for_type_inference("int32",
+                                                           True)
+    ins = {"Rois": rois, "LabelsInt32": labels_int32,
+           "GtBoxes": gt_boxes, "GtSegms": gt_segms,
+           "PolyLen": poly_len}
+    helper.append_op(
+        "generate_mask_labels", ins,
+        {"MaskRois": mask_rois, "RoiHasMaskInt32": has_mask,
+         "MaskInt32": mask_int32},
+        {"num_classes": num_classes, "resolution": resolution})
+    return mask_rois, has_mask, mask_int32
+
+
+def multi_box_head(inputs, image, base_size, num_classes,
+                   aspect_ratios, min_ratio=None, max_ratio=None,
+                   min_sizes=None, max_sizes=None, steps=None,
+                   step_w=None, step_h=None, offset=0.5, flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1,
+                   name=None, min_max_aspect_ratios_order=False):
+    """SSD detection head (reference layers/detection.py
+    multi_box_head): per feature map, a prior_box + two convs (loc,
+    conf) whose outputs are flattened and concatenated across maps.
+    Returns (mbox_locs, mbox_confs, boxes, variances)."""
+    from . import nn
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spread min_ratio..max_ratio
+        # over the deeper maps, first map fixed at base_size*0.1
+        min_sizes, max_sizes = [], []
+        step_r = int(np.floor((max_ratio - min_ratio) /
+                              max(n_layer - 2, 1)))
+        for ratio in range(min_ratio, max_ratio + 1, step_r):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step_r) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, x in enumerate(inputs):
+        minsz = min_sizes[i]
+        maxsz = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) else \
+            [aspect_ratios[i]]
+        st = steps[i] if steps else [step_w or 0.0, step_h or 0.0]
+        if not isinstance(st, (list, tuple)):
+            st = [st, st]
+        box, var = prior_box(
+            x, image, [minsz] if not isinstance(
+                minsz, (list, tuple)) else list(minsz),
+            [maxsz] if maxsz and not isinstance(
+                maxsz, (list, tuple)) else (list(maxsz or [])),
+            ar, flip=flip, clip=clip, steps=list(st), offset=offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        num_priors_per_cell = int(box.shape[2]) if box.shape and \
+            len(box.shape) == 4 else None
+        # boxes come out [H, W, P, 4] -> flatten to [H*W*P, 4]
+        boxes_all.append(nn.reshape(box, shape=[-1, 4]))
+        vars_all.append(nn.reshape(var, shape=[-1, 4]))
+        num_priors = num_priors_per_cell or 1
+        loc = nn.conv2d(x, num_priors * 4, kernel_size, stride=stride,
+                        padding=pad)
+        conf = nn.conv2d(x, num_priors * num_classes, kernel_size,
+                         stride=stride, padding=pad)
+        # NCHW -> NHWC -> [N, boxes, 4/classes]
+        locs.append(nn.reshape(
+            nn.transpose(loc, perm=[0, 2, 3, 1]), shape=[0, -1, 4]))
+        confs.append(nn.reshape(
+            nn.transpose(conf, perm=[0, 2, 3, 1]),
+            shape=[0, -1, num_classes]))
+    mbox_locs = nn.concat(locs, axis=1) if len(locs) > 1 else locs[0]
+    mbox_confs = nn.concat(confs, axis=1) if len(confs) > 1 else \
+        confs[0]
+    boxes = nn.concat(boxes_all, axis=0) if len(boxes_all) > 1 else \
+        boxes_all[0]
+    variances = nn.concat(vars_all, axis=0) if len(vars_all) > 1 else \
+        vars_all[0]
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+__all__.extend(["box_decoder_and_assign", "distribute_fpn_proposals",
+                "roi_perspective_transform", "generate_mask_labels",
+                "multi_box_head"])

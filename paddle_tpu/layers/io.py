@@ -1,8 +1,34 @@
-"""Input layers (reference python/paddle/fluid/layers/io.py:39 data)."""
+"""Input layers and the graph-mode reader surface.
+
+Parity: reference python/paddle/fluid/layers/io.py — data:39,
+py_reader:643, create_py_reader_by_data, double_buffer:1017, batch,
+shuffle, open_files, random_data_generator, read_file, load,
+Preprocessor.
+
+TPU design: reader VARIABLES are host-side generator registrations
+(ops/extra_ops3.py `_HOST_READERS`); the decorator ops
+(create_shuffle/batch/double_buffer_reader) chain factories at trace
+time, and the in-graph `read` op pops batches through an ordered
+io_callback — the XLA-compatible stand-in for the reference's blocking
+queue + buffered_reader H2D staging. A reader var carries a dummy
+scalar token in the scope purely so the executor's dataflow sees a
+producer/consumer edge.
+"""
 from __future__ import annotations
+
+import contextlib
+from typing import List, Optional, Sequence
+
+import numpy as np
 
 from ..core.program import default_main_program, default_startup_program
 from ..core.types import as_datatype
+from ..layer_helper import LayerHelper
+
+__all__ = ["data", "py_reader", "create_py_reader_by_data",
+           "double_buffer", "batch", "shuffle", "open_files",
+           "random_data_generator", "read_file", "load",
+           "Preprocessor"]
 
 
 def data(name, shape, dtype="float32", lod_level=0,
@@ -18,3 +44,293 @@ def data(name, shape, dtype="float32", lod_level=0,
         name=name, shape=shape, dtype=as_datatype(dtype),
         lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)
     return main
+
+
+def _reader_var(name):
+    """Create the reader variable + its scope token init (startup
+    fill_constant), so the executor has a value flowing along the
+    reader edge."""
+    block = default_main_program().global_block
+    var = block.create_var(name=name, shape=(1,), dtype="float32",
+                           persistable=True, stop_gradient=True)
+    sblock = default_startup_program().global_block
+    if not any(name in op.output_arg_names for op in sblock.ops):
+        sblock.create_var(name=name, shape=(1,), dtype="float32",
+                          persistable=True)
+        sblock.append_op("fill_constant", {}, {"Out": [name]},
+                         {"shape": [1], "dtype": "float32",
+                          "value": 0.0})
+    return var
+
+
+class ReaderVariable:
+    """The object `py_reader`/`open_files`-style layers return: wraps
+    the reader var plus the static (shape, dtype) specs the `read` op
+    needs. Mirrors the reference reader Variable's decorate/start/reset
+    surface (reference reader var methods attached in layers/io.py)."""
+
+    def __init__(self, var, shapes, dtypes, source_name=None):
+        self.var = var
+        self.name = var.name
+        self.shapes = [tuple(s) for s in shapes]
+        self.dtypes = list(dtypes)
+        self._source = source_name
+
+    # -- feeding ------------------------------------------------------
+    def decorate_paddle_reader(self, paddle_reader):
+        """paddle_reader yields per-batch lists of sample tuples
+        (reader-decorator convention); stack each slot."""
+
+        def factory():
+            for samples in paddle_reader():
+                yield tuple(
+                    np.stack([np.asarray(s[i]) for s in samples])
+                    for i in range(len(samples[0])))
+
+        self._register(factory)
+
+    def decorate_tensor_provider(self, provider):
+        """provider yields tuples of ready batch arrays."""
+
+        def factory():
+            yield from provider()
+
+        self._register(factory)
+
+    decorate_batch_generator = decorate_tensor_provider
+
+    def _register(self, factory):
+        from ..ops.extra_ops3 import register_host_reader
+
+        register_host_reader(self._source or self.name, factory)
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self):
+        """Reset the underlying iterator so the next read starts a
+        fresh pass (reference reader.start())."""
+        from ..ops.extra_ops3 import _HOST_READERS
+
+        for key in (self._source, self.name):
+            entry = _HOST_READERS.get(key) if key else None
+            if entry is not None:
+                entry["it"] = None
+
+    reset = start
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference layers/io.py py_reader:643 — in-graph reader fed from
+    Python. Returns a ReaderVariable; call decorate_paddle_reader then
+    read_file(reader) for the data vars.
+
+    Unlike the reference, `shapes` must be fully static (batch dim
+    included): the in-graph read rides an ordered io_callback whose
+    result specs XLA fixes at compile time — the price of tracing the
+    whole block into one program."""
+    for s in shapes:
+        if any(int(d) < 0 for d in s):
+            raise ValueError(
+                f"py_reader shapes must be fully static on TPU (got "
+                f"{s}); batch size is part of the compiled program")
+    helper = LayerHelper("py_reader", name=name)
+    rname = name or helper.name
+    source = rname + "@source"
+    var = _reader_var(rname)
+    helper.main_program.global_block.append_op(
+        "create_py_reader", {}, {"Out": [rname]}, {"source": source})
+    reader = ReaderVariable(var, shapes, dtypes, source_name=source)
+    if use_double_buffer:
+        reader = double_buffer(reader, name=rname + "@double_buffer")
+        # decorating/starting still targets the source registration
+        reader._source = source
+    return reader
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """reference layers/io.py create_py_reader_by_data — like
+    py_reader but specs come from existing data vars."""
+    shapes = [v.shape for v in feed_list]
+    dtypes = [v.dtype for v in feed_list]
+    return py_reader(capacity, shapes, dtypes, name=name,
+                     use_double_buffer=use_double_buffer)
+
+
+def _chain(op_type, reader, attrs, suffix, name=None):
+    rname = name or (reader.name + suffix)
+    var = _reader_var(rname)
+    default_main_program().global_block.append_op(
+        op_type, {"UnderlyingReader": [reader.name]},
+        {"Out": [rname]}, attrs)
+    out = ReaderVariable(var, reader.shapes, reader.dtypes,
+                         source_name=reader._source)
+    return out
+
+
+def double_buffer(reader, place=None, name=None):
+    """reference layers/io.py double_buffer:1017 ->
+    create_double_buffer_reader op (background prefetch thread)."""
+    return _chain("create_double_buffer_reader", reader,
+                  {"buffer_size": 2}, "@double_buffer", name)
+
+
+def batch(reader, batch_size):
+    """reference layers/io.py batch -> create_batch_reader op. The
+    factory stacks batch_size samples, so the static specs gain a
+    leading batch dim here (read_file compiles against them)."""
+    out = _chain("create_batch_reader", reader,
+                 {"batch_size": int(batch_size)}, "@batch")
+    out.shapes = [(int(batch_size),) + tuple(s) for s in out.shapes]
+    return out
+
+
+def shuffle(reader, buffer_size):
+    """reference layers/io.py shuffle -> create_shuffle_reader op."""
+    return _chain("create_shuffle_reader", reader,
+                  {"buffer_size": int(buffer_size)}, "@shuffle")
+
+
+def open_files(filenames, shapes, lod_levels=None, dtypes=None,
+               thread_num=None, buffer_size=None, pass_num=1,
+               is_test=None):
+    """reference layers/io.py open_files -> reader/open_files_op.cc:
+    stream records from multiple (recordio) files."""
+    helper = LayerHelper("open_files")
+    rname = helper.name
+    var = _reader_var(rname)
+    default_main_program().global_block.append_op(
+        "open_files", {}, {"Out": [rname]},
+        {"file_names": list(filenames)})
+    return ReaderVariable(var, shapes, dtypes or ["float32"] *
+                          len(shapes), source_name=rname)
+
+
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=True):
+    """reference layers/io.py random_data_generator — an in-graph
+    uniform-random reader (used by reader unit tests)."""
+    helper = LayerHelper("random_data_generator")
+    rname = helper.name
+    var = _reader_var(rname)
+    shapes = [tuple(abs(int(d)) for d in s) for s in shapes]
+
+    def factory():
+        rng = np.random.RandomState()
+        while True:
+            yield tuple(rng.uniform(low, high, s).astype(np.float32)
+                        for s in shapes)
+
+    from ..ops.extra_ops3 import register_host_reader
+
+    register_host_reader(rname, factory)
+    return ReaderVariable(var, shapes, ["float32"] * len(shapes),
+                          source_name=rname)
+
+
+def read_file(reader):
+    """reference layers/io.py read_file -> reader/read_op.cc: pop one
+    batch from the reader into fresh data vars."""
+    helper = LayerHelper("read_file")
+    block = default_main_program().global_block
+    outs = []
+    for shape, dtype in zip(reader.shapes, reader.dtypes):
+        v = helper.create_variable_for_type_inference(dtype)
+        v.shape = tuple(shape)
+        outs.append(v)
+    block.append_op("read", {"Reader": [reader.name]},
+                    {"Out": [v.name for v in outs]}, {})
+    if len(outs) == 1:
+        return outs[0]
+    return outs
+
+
+def load(out, file_path, load_as_fp16=None):
+    """reference layers/io.py load -> operators/load_op.cc: in-graph
+    load of one variable from a save_op artifact."""
+    helper = LayerHelper("load", input=out)
+    from ..core.types import to_np_dtype
+
+    attrs = {"file_path": file_path,
+             "shape": [int(d) for d in (out.shape or ())],
+             "dtype": np.dtype(to_np_dtype(out.dtype or
+                                           "float32")).name}
+    if load_as_fp16 is not None:
+        attrs["load_as_fp16"] = load_as_fp16
+    helper.append_op("load", {}, {"Out": out}, attrs)
+    return out
+
+
+class Preprocessor:
+    """reference layers/io.py Preprocessor — a per-batch transform
+    block between a reader and the model. The block's layers build a
+    sub-Program executed on the host for every batch (the reference
+    runs the sub-block inside create_custom_reader_op; here the
+    transform rides the host-reader factory chain, keeping the device
+    program clean of per-batch control flow)."""
+
+    def __init__(self, reader, name=None):
+        self._reader = reader
+        self._program = None
+        self._in_vars = None
+        self._out_vars = None
+        self.name = name or (reader.name + "@preprocessor")
+
+    @contextlib.contextmanager
+    def block(self):
+        from ..core.program import Program, program_guard
+
+        self._program = Program()
+        with program_guard(self._program, Program()):
+            yield self
+        if self._in_vars is None or self._out_vars is None:
+            raise ValueError("Preprocessor.block must call inputs() "
+                             "and outputs()")
+
+    def inputs(self):
+        blk = self._program.global_block
+        self._in_vars = [
+            blk.create_var(name=f"{self.name}@in{i}", shape=s,
+                           dtype=d, is_data=True)
+            for i, (s, d) in enumerate(zip(self._reader.shapes,
+                                           self._reader.dtypes))]
+        return self._in_vars
+
+    def outputs(self, *out_vars):
+        self._out_vars = list(out_vars)
+
+    def __call__(self):
+        """Return the transformed ReaderVariable."""
+        from ..core.executor import Executor
+        from ..core.scope import Scope
+        from ..ops.extra_ops3 import (_HOST_READERS,
+                                      register_host_reader)
+
+        # pull from the FINAL chained registration (reader.name), not
+        # the root source — otherwise shuffle/batch/double_buffer
+        # decorators on the input reader would be silently bypassed.
+        # The chain's create_* ops register it when the consuming
+        # program first traces; fall back to the root source only if
+        # the reader was never chained through an op.
+        src = self._reader.name
+        fallback = self._reader._source
+        program = self._program
+        in_names = [v.name for v in self._in_vars]
+        out_names = [v.name for v in self._out_vars]
+
+        def factory():
+            entry = _HOST_READERS.get(src) or _HOST_READERS[fallback]
+            exe = Executor()
+            scope = Scope()
+            for batch in entry["factory"]():
+                feed = dict(zip(in_names, batch))
+                outs = exe.run(program, feed=feed,
+                               fetch_list=out_names, scope=scope)
+                yield tuple(np.asarray(o) for o in outs)
+
+        rname = self.name
+        register_host_reader(rname, factory)
+        var = _reader_var(rname)
+        shapes = [tuple(v.shape or (-1,)) for v in self._out_vars]
+        dtypes = [v.dtype for v in self._out_vars]
+        return ReaderVariable(var, shapes, dtypes, source_name=rname)

@@ -151,3 +151,26 @@ def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
     inv = nn.scale(mask, scale=-1.0, bias=1.0)
     return nn.elementwise_add(nn.elementwise_mul(warm, mask),
                               nn.elementwise_mul(base, inv))
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """reference layers/learning_rate_scheduler.py append_LARS: per-
+    param local LR = global_lr * ||w|| / (||g|| + wd * ||w||). Returns
+    the decayed LR var list (the modern path is
+    LarsMomentumOptimizer, optimizer.py, which fuses this into the
+    update op)."""
+    from . import nn, ops
+
+    def _norm(v):
+        return ops.sqrt(nn.reduce_sum(ops.square(v)))
+
+    out = []
+    for param, grad in params_grads:
+        pn = _norm(param)
+        gn = _norm(grad)
+        denom = gn + weight_decay * pn
+        out.append(learning_rate * pn / denom)
+    return out
+
+
+__all__.append("append_LARS")

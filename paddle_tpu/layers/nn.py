@@ -1308,3 +1308,182 @@ def attention(q, k, v, causal=False, scale=None, dropout_rate=0.0,
 __all__.append("attention")
 __all__.extend(["linear_chain_crf", "linear_chain_crf_raw",
                 "crf_decoding", "crf_decoding_raw"])
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """reference layers/nn.py stanh -> activation_op.cc STanh."""
+    helper = LayerHelper("stanh", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("stanh", {"X": x}, {"Out": out},
+                     {"scale_a": scale_a, "scale_b": scale_b})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", name=None):
+    """reference layers/nn.py adaptive_pool3d (NCDHW)."""
+    helper = LayerHelper("adaptive_pool3d", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    size = pool_size if isinstance(pool_size, (list, tuple)) else \
+        [pool_size] * 3
+    helper.append_op("adaptive_pool3d", {"X": input}, {"Out": out},
+                     {"pooling_size": list(size),
+                      "pooling_type": pool_type})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0,
+                                    std=1.0, seed=0, dtype="float32"):
+    """reference layers/nn.py gaussian_random_batch_size_like."""
+    helper = LayerHelper("gaussian_random_batch_size_like",
+                         input=input)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("gaussian_random_batch_size_like",
+                     {"Input": input}, {"Out": out},
+                     {"shape": list(shape),
+                      "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx, "mean": mean,
+                      "std": std, "seed": seed})
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference layers/nn.py autoincreased_step_counter: a persistable
+    int64 counter bumped once per executor run (the global-step var the
+    LR schedules build on)."""
+    helper = LayerHelper("step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    block = helper.main_program.global_block
+    counter = block.create_var(name=name, shape=(1,), dtype="int64",
+                               persistable=True, stop_gradient=True)
+    sblock = helper.startup_program.global_block
+    svar = sblock.create_var(name=name, shape=(1,), dtype="int64",
+                             persistable=True)
+    if not any(name in op.output_arg_names for op in sblock.ops):
+        from ..initializer import ConstantInitializer
+
+        ConstantInitializer(float(begin - step))(svar, sblock)
+    cur = helper.main_program.current_block()
+    if not any(name in op.output_arg_names and op.type == "increment"
+               for op in cur.ops):
+        # int step: a python float would promote the int64 counter to
+        # float32 under JAX type rules on the first x + attr
+        cur.append_op("increment", {"X": counter}, {"Out": counter},
+                      {"step": int(step)})
+    return counter
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference layers/nn.py image_resize_short: scale so the SHORT
+    edge becomes out_short_len, keeping aspect ratio (static shapes:
+    computed at build time from the declared H/W)."""
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    ratio = float(out_short_len) / float(short)
+    out_shape = [int(round(h * ratio)), int(round(w * ratio))]
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference layers/nn.py lod_reset -> lod_reset_op.cc. Under the
+    padded+@SEQ_LEN design the data is unchanged; the new lengths come
+    from y's companion (or target_lod converted by the caller)."""
+    helper = LayerHelper("lod_reset", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ins = {"X": x}
+    if y is not None:
+        ins["Y"] = y
+    helper.append_op("lod_reset", ins, {"Out": out},
+                     {"target_lod": list(target_lod or [])})
+    from .sequence import SEQ_LEN_SUFFIX
+
+    block = out.block
+    src = (y.name if y is not None else x.name) + SEQ_LEN_SUFFIX
+    if block.has_var(src):
+        dst = out.name + SEQ_LEN_SUFFIX
+        helper.append_op("assign", {"X": src}, {"Out": dst}, {})
+        block.create_var(name=dst, shape=(-1,), dtype="int32",
+                         stop_gradient=True)
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """reference layers/nn.py mean_iou -> mean_iou_op.cc."""
+    helper = LayerHelper("mean_iou", input=input)
+    miou = helper.create_variable_for_type_inference("float32", True)
+    wrong = helper.create_variable_for_type_inference("float32", True)
+    correct = helper.create_variable_for_type_inference("float32",
+                                                        True)
+    helper.append_op("mean_iou",
+                     {"Predictions": input, "Labels": label},
+                     {"OutMeanIou": miou, "OutWrong": wrong,
+                      "OutCorrect": correct},
+                     {"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference layers/nn.py similarity_focus ->
+    similarity_focus_op.cc."""
+    helper = LayerHelper("similarity_focus", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("similarity_focus", {"X": input}, {"Out": out},
+                     {"axis": axis, "indexes": list(indexes)})
+    return out
+
+
+def merge_selected_rows(x, name=None):
+    """reference layers/nn.py merge_selected_rows: sum duplicate rows
+    of a SelectedRows pair (rows var + values var, the sparse-grad
+    representation — x is the values var, x@ROWS its companion)."""
+    helper = LayerHelper("merge_selected_rows", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    rows_out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("merge_selected_rows",
+                     {"Rows": x.name + "@ROWS", "Values": x},
+                     {"OutRows": rows_out, "Out": out}, {})
+    return out
+
+
+def get_tensor_from_selected_rows(x, height=None, name=None):
+    """reference layers/nn.py get_tensor_from_selected_rows: scatter a
+    SelectedRows (values var + @ROWS companion) into a dense tensor."""
+    helper = LayerHelper("get_tensor_from_selected_rows", input=x,
+                         name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("get_tensor_from_selected_rows",
+                     {"Rows": x.name + "@ROWS", "Values": x},
+                     {"Out": out},
+                     {"height": height or int(x.shape[0])})
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """reference layers/nn.py tree_conv -> tree_conv_op.cc (TBCNN)."""
+    helper = LayerHelper("tree_conv", input=nodes_vector,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dtype = nodes_vector.dtype
+    feature_size = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(
+        helper.param_attr, [feature_size, 3, output_size, num_filters],
+        dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("tree_conv",
+                     {"NodesVector": nodes_vector,
+                      "EdgeSet": edge_set, "Filter": w},
+                     {"Out": out}, {"max_depth": max_depth})
+    if helper.bias_attr is not False:
+        pre_act = helper.append_bias_op(out, dim_start=3)
+    else:
+        pre_act = out
+    return helper.append_activation(pre_act)
+
+
+__all__.extend([
+    "stanh", "adaptive_pool3d", "gaussian_random_batch_size_like",
+    "autoincreased_step_counter", "image_resize_short", "lod_reset",
+    "mean_iou", "similarity_focus", "merge_selected_rows",
+    "get_tensor_from_selected_rows", "tree_conv"])

@@ -109,3 +109,44 @@ def has_inf(x):
 
 def has_nan(x):
     return isfinite(x)
+
+
+def range(start, end, step, dtype="float32"):
+    """reference layers/tensor.py range -> range_op.cc. Static python
+    bounds ride attrs (XLA needs the length at trace time); Variable
+    bounds are passed as inputs and require concrete host values."""
+    helper = LayerHelper("range")
+    out = helper.create_variable_for_type_inference(dtype, True)
+    if all(isinstance(v, (int, float)) for v in (start, end, step)):
+        helper.append_op("range", {}, {"Out": out},
+                         {"start": float(start), "end": float(end),
+                          "step": float(step)})
+        import math
+
+        out.shape = (max(0, int(math.ceil((end - start) / step))),)
+    else:
+        helper.append_op("range",
+                         {"Start": start, "End": end, "Step": step},
+                         {"Out": out}, {})
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """reference layers/tensor.py tensor_array_to_tensor ->
+    tensor_array_to_tensor_op.cc: fuse a LoDTensorArray into one
+    tensor (concat or stack along axis)."""
+    helper = LayerHelper("tensor_array_to_tensor", input=input,
+                         name=name)
+    out = helper.create_variable_for_type_inference(
+        input[0].dtype if isinstance(input, (list, tuple)) else
+        input.dtype)
+    out_index = helper.create_variable_for_type_inference("int32",
+                                                          True)
+    helper.append_op("tensor_array_to_tensor", {"X": input},
+                     {"Out": out, "OutIndex": out_index},
+                     {"axis": axis, "use_stack": use_stack,
+                      "from_list": isinstance(input, (list, tuple))})
+    return out, out_index
+
+
+__all__.extend(["range", "tensor_array_to_tensor"])

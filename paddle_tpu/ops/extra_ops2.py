@@ -445,10 +445,15 @@ def merge_lod_tensor(ctx):
 def tensor_array_to_tensor(ctx):
     """reference tensor_array_to_tensor_op.cc: stack/concat the array
     entries along attr axis."""
-    arr = ctx.input("X")
+    vals = [v for v in ctx.inputs("X") if v is not None]
     axis = ctx.attr("axis", 0)
     use_stack = ctx.attr("use_stack", False)
-    vals = list(arr)
+    if not ctx.attr("from_list", False) and len(vals) == 1:
+        # single input that IS a stacked array-var: its leading dim
+        # enumerates the array entries. The layer sets from_list=True
+        # when X is a python list of vars, which is the only way to
+        # tell a one-element array apart from a stacked var.
+        vals = list(vals[0])
     out = (jnp.stack(vals, axis=axis) if use_stack
            else jnp.concatenate(vals, axis=axis))
     idx = jnp.asarray([v.shape[axis] if not use_stack else 1

@@ -305,3 +305,12 @@ def thresholded_relu(ctx):
     x = ctx.input("X")
     t = ctx.attr("threshold", 1.0)
     return jnp.where(x > t, x, jnp.zeros_like(x))
+
+
+@register_op("stanh")
+def stanh(ctx):
+    """reference operators/activation_op.cc STanh:
+    out = b * tanh(a * x) with a=scale_a, b=scale_b."""
+    a = ctx.attr("scale_a", 0.67)
+    b = ctx.attr("scale_b", 1.7159)
+    return b * jnp.tanh(a * ctx.input("X"))

@@ -931,3 +931,18 @@ def fc(ctx):
     elif act:
         raise ValueError(f"fc: unsupported activation {act!r}")
     return {"Out": out}
+
+
+@register_op("adaptive_pool3d")
+def adaptive_pool3d(ctx):
+    """reference operators/pool_op.cc adaptive path, 3-D: NCDHW input
+    pooled to pooling_size output cells (divisible case, like
+    adaptive_pool2d above)."""
+    x = ctx.input("X")
+    od, oh, ow = ctx.attr("pooling_size", [1, 1, 1])
+    ptype = ctx.attr("pooling_type", "avg")
+    n, c, d, h, w = x.shape
+    x7 = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+    if ptype == "avg":
+        return x7.mean(axis=(3, 5, 7))
+    return x7.max(axis=(3, 5, 7))

@@ -358,12 +358,16 @@ def one_hot(ctx):
 
 @register_op("range", differentiable=False)
 def range_op(ctx):
-    start = ctx.input("Start")
-    end = ctx.input("End")
-    step = ctx.input("Step")
-    # static-shape requirement: bounds must be attrs under jit when traced;
-    # support concrete host-side values.
-    return jnp.arange(float(start), float(end), float(step))
+    # static-shape requirement: bounds must be attrs under jit (the
+    # layers.range wrapper passes python scalars through); traced
+    # Start/End/Step inputs only work with concrete host-side values.
+    start = ctx.attr("start", None)
+    if start is not None:
+        return jnp.arange(float(start), float(ctx.attr("end")),
+                          float(ctx.attr("step")))
+    return jnp.arange(float(ctx.input("Start")),
+                      float(ctx.input("End")),
+                      float(ctx.input("Step")))
 
 
 @register_op("top_k", differentiable=False)
@@ -464,3 +468,19 @@ def assign_value(ctx):
     dtype = to_jnp_dtype(ctx.attr("dtype", "float32"))
     shape = ctx.attr("shape", list(vals.shape))
     return jnp.asarray(vals, dtype=dtype).reshape(shape)
+
+
+@register_op("gaussian_random_batch_size_like", differentiable=False,
+             needs_rng=True)
+def gaussian_random_batch_size_like(ctx):
+    """reference operators/gaussian_random_batch_size_like_op.cc:
+    normal samples with the batch dim copied from Input."""
+    ref = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    bidx = ctx.attr("input_dim_idx", 0)
+    oidx = ctx.attr("output_dim_idx", 0)
+    shape[oidx] = ref.shape[bidx]
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    return mean + std * jax.random.normal(_seeded_key(ctx), shape,
+                                          jnp.float32)

@@ -118,3 +118,72 @@ def test_dygraph_nce_bias_attr_false():
             np.array([[1], [2]], np.int64))
         _ = nce(x, lbl)
         assert nce.bias is None
+
+
+class TestNewDygraphLayers:
+    """BilinearTensorProduct / Conv2DTranspose / SequenceConv
+    (reference dygraph/nn.py:1025,1117,1329) with numpy oracles and
+    grad flow."""
+
+    def test_bilinear_tensor_product(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(5, 3).astype(np.float32)
+        y = rng.randn(5, 4).astype(np.float32)
+        with fluid.dygraph.guard():
+            layer = fluid.dygraph.BilinearTensorProduct(
+                input1_dim=3, input2_dim=4, output_dim=2)
+            out = layer(fluid.dygraph.to_variable(x),
+                        fluid.dygraph.to_variable(y))
+            w = layer.weight.numpy()
+            b = layer.bias.numpy().reshape(-1)
+            ref = np.einsum("bi,kij,bj->bk", x, w, y) + b
+            np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5,
+                                       atol=1e-5)
+            loss = fluid.layers.reduce_mean(out)
+            loss.backward()
+            assert np.abs(layer.weight.gradient()).sum() > 0
+
+    def test_conv2d_transpose_shape_and_grad(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 4, 4).astype(np.float32)
+        with fluid.dygraph.guard():
+            layer = fluid.dygraph.Conv2DTranspose(
+                num_channels=3, num_filters=5, filter_size=3,
+                stride=2, padding=1)
+            out = layer(fluid.dygraph.to_variable(x))
+            # H_out = (H-1)*s - 2p + k = 3*2 - 2 + 3 = 7
+            assert tuple(out.shape) == (2, 5, 7, 7), out.shape
+            loss = fluid.layers.reduce_mean(out)
+            loss.backward()
+            assert np.abs(layer.weight.gradient()).sum() > 0
+            # torch oracle for the values
+            import torch
+            import torch.nn.functional as F
+            ref = F.conv_transpose2d(
+                torch.tensor(x), torch.tensor(layer.weight.numpy()),
+                bias=torch.tensor(layer.bias.numpy()), stride=2,
+                padding=1).numpy()
+            np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_sequence_conv_matches_manual_window(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 6, 4).astype(np.float32)  # B,T,D
+        with fluid.dygraph.guard():
+            layer = fluid.dygraph.SequenceConv(
+                num_filters=7, filter_size=3, input_dim=4)
+            out = layer(fluid.dygraph.to_variable(x))
+            assert tuple(out.shape) == (2, 6, 7)
+            w = layer.weight.numpy()  # [3*4, 7]
+            b = layer.bias.numpy()
+            # manual context windows: offsets -1, 0, +1 (zero padded)
+            padded = np.pad(x, ((0, 0), (1, 1), (0, 0)))
+            ctx = np.concatenate(
+                [padded[:, 0:6], padded[:, 1:7], padded[:, 2:8]],
+                axis=-1)  # B,T,3D
+            ref = ctx @ w + b
+            np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4,
+                                       atol=1e-5)
+            loss = fluid.layers.reduce_mean(out)
+            loss.backward()
+            assert np.abs(layer.weight.gradient()).sum() > 0
