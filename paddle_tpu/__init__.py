@@ -16,6 +16,7 @@ from .core.program import (Program, Block, Variable, Operator,
                            program_guard, switch_main_program,
                            switch_startup_program)
 from .core.executor import (Executor, TPUPlace, CPUPlace, CUDAPlace,
+                            CUDAPinnedPlace,
                             seed)
 from .core.scope import Scope, global_scope, _reset_global_scope
 from .core import registry as _registry
@@ -61,6 +62,14 @@ from . import contrib
 from . import lod_tensor
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
 from . import average
+from .average import WeightedAverage  # noqa: F401
+from . import recordio_writer  # noqa: F401
+from .lod_tensor import LoDTensor  # noqa: F401
+# reference fluid exposes Tensor as an alias of LoDTensor
+# (python/paddle/fluid/__init__.py Tensor = LoDTensor)
+Tensor = LoDTensor
+LoDTensorArray = list
+from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401,E402
 from . import debugger
 from . import net_drawer
 from . import evaluator
@@ -100,6 +109,13 @@ def cuda_places(device_ids=None):
 
 def cpu_places(device_count=None):
     return [CPUPlace()]
+
+
+def cuda_pinned_places(device_count=None):
+    """reference framework.py:153 cuda_pinned_places: page-locked
+    staging buffers. XLA manages host staging itself; returns
+    CUDAPinnedPlace objects (CPU-backed) for isinstance parity."""
+    return [CUDAPinnedPlace() for _ in range(device_count or 1)]
 
 
 def device_count():

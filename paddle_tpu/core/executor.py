@@ -79,6 +79,14 @@ class CUDAPlace(TPUPlace):
         return f"CUDAPlace({self.device_id})"
 
 
+class CUDAPinnedPlace(CPUPlace):
+    """reference platform/place.h:52 CUDAPinnedPlace (page-locked host
+    staging). XLA owns host staging on TPU; behaves as a CPUPlace."""
+
+    def __repr__(self):
+        return "CUDAPinnedPlace()"
+
+
 class _CompiledBlock:
     """One specialization of a block: jitted fn + binding metadata."""
 

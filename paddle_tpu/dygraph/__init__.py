@@ -1,6 +1,6 @@
 from .base import (to_variable, guard, enabled, enable_dygraph,
                    disable_dygraph, no_grad)  # noqa: F401
-from .layers import Layer  # noqa: F401
+from .layers import Layer, PyLayer  # noqa: F401
 from .nn import (Conv2D, Pool2D, FC, Linear, BatchNorm, Embedding,
                  LayerNorm, GRUUnit, PRelu, NCE, Dropout,
                  BilinearTensorProduct, Conv2DTranspose,

@@ -127,3 +127,62 @@ def _run_initializer(init, shape, np_dtype):
         limit = np.sqrt(6.0 / (fan_in + fan_out))
         return rng.uniform(-limit, limit, shape).astype(np_dtype)
     raise TypeError(f"unsupported initializer for dygraph: {init}")
+
+
+class PyLayer(Layer):
+    """Custom autograd function for dygraph (reference
+    dygraph/layers.py PyLayer / imperative py_layer): subclass defines
+    numpy static methods ``forward(*inputs)`` and
+    ``backward(*output_grads)``; calling the instance runs forward
+    eagerly and records a py_func op on the tape so run_backward routes
+    output grads through the user's backward (ops/host_ops.py
+    py_func_grad with all x/out positions skipped — the reference
+    PyLayer backward also sees only douts)."""
+
+    def __init__(self):
+        super().__init__()
+
+    @staticmethod
+    def forward(*inputs):
+        raise NotImplementedError("PyLayer subclasses implement "
+                                  "forward as a @staticmethod")
+
+    @staticmethod
+    def backward(*output_grads):
+        raise NotImplementedError("PyLayer subclasses implement "
+                                  "backward as a @staticmethod")
+
+    @classmethod
+    def _callable_ids(cls):
+        # register the staticmethods themselves — register_py_func is
+        # idempotent per function object, so repeated instantiation
+        # does not grow the registry
+        from ..ops.host_ops import register_py_func
+
+        return (register_py_func(cls.forward),
+                register_py_func(cls.backward))
+
+    def __call__(self, *inputs):
+        import numpy as np
+
+        from ..core.program import Operator
+        from .base import VarBase, to_variable, tracer
+
+        ins = [to_variable(v) for v in inputs]
+        outs = type(self).forward(*[v.numpy() for v in ins])
+        if not isinstance(outs, (list, tuple)):
+            outs = (outs,)
+        out_vars = [VarBase(np.asarray(o)) for o in outs]
+        t = tracer()
+        if t is not None and t._record:
+            fid, bid = self._callable_ids()
+            in_names = [v.name for v in ins]
+            out_names = [v.name for v in out_vars]
+            op = Operator(None, "py_func",
+                          {"X": in_names}, {"Out": out_names},
+                          {"forward_callable_id": fid,
+                           "backward_callable_id": bid,
+                           # reference PyLayer.backward sees douts only
+                           "backward_skip_vars": in_names + out_names})
+            t.record(op, {"X": ins}, {"Out": out_vars})
+        return out_vars if len(out_vars) > 1 else out_vars[0]

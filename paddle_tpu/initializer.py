@@ -153,3 +153,15 @@ Bilinear = BilinearInitializer
 
 def force_init_on_cpu():
     return False
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """reference initializer.py:42 init_on_cpu: force initializer ops to
+    CPU. On TPU the startup program runs wherever the executor's place
+    is and XLA manages transfer, so this is an accepted no-op context
+    (kept for script parity, like force_init_on_cpu above)."""
+    yield

@@ -26,10 +26,18 @@ from ..core.types import to_jnp_dtype
 # registered python callables for py_func (reference py_func_op.cc
 # keeps a static registry the op indexes into)
 _PY_FUNC_REGISTRY: List[Callable] = []
+_PY_FUNC_IDS: Dict[int, int] = {}
 
 
 def register_py_func(fn: Callable) -> int:
+    """Idempotent per function object: re-registering the same callable
+    returns its existing id (keeps PyLayer classes from growing the
+    registry once per instance/call)."""
+    existing = _PY_FUNC_IDS.get(id(fn))
+    if existing is not None and _PY_FUNC_REGISTRY[existing] is fn:
+        return existing
     _PY_FUNC_REGISTRY.append(fn)
+    _PY_FUNC_IDS[id(fn)] = len(_PY_FUNC_REGISTRY) - 1
     return len(_PY_FUNC_REGISTRY) - 1
 
 
@@ -92,6 +100,11 @@ def py_func_grad(ctx):
     xs = ctx.inputs("X")
     outs = ctx.inputs("Out")
     douts = ctx.inputs("Out@GRAD")
+    # an output unused downstream arrives with no grad (EMPTY_VAR ->
+    # None); the user's backward sees zeros there, like the reference
+    # tolerates partially-used PyLayer outputs
+    douts = [jnp.zeros_like(o) if d is None else d
+             for d, o in zip(douts, outs)]
     in_names = ctx.op.input("X")
     out_names = ctx.op.input("Out")
     declared = ctx.op.output("X@GRAD")
