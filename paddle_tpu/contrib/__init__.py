@@ -1,8 +1,33 @@
 """contrib package (parity: reference python/paddle/fluid/contrib/ —
-slim model-compression framework, quantize passes, memory usage
-estimation, op frequency statistics, extended optimizers)."""
+slim model-compression framework, quantize transpiler, the dynamic
+decoding framework, high-level Trainer/Inferencer, int8 calibration,
+CTR reader, HDFS/lookup-table utils, memory usage estimation, op
+frequency statistics, extended optimizers, model summary).
+"""
 from . import slim
+from . import decoder
+from .decoder import (InitState, StateCell, TrainingDecoder,  # noqa: F401
+                      BeamSearchDecoder)
+from . import quantize
+from .quantize import QuantizeTranspiler  # noqa: F401
+from . import int8_inference
+from . import reader
+from . import utils
+from . import model_stat
+from .model_stat import summary  # noqa: F401
+from . import extend_optimizer
+from .extend_optimizer import extend_with_decoupled_weight_decay  # noqa: F401
+from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,  # noqa: F401
+                      EndEpochEvent, BeginStepEvent, EndStepEvent)
+from .inferencer import Inferencer  # noqa: F401
 from .memory_usage_calc import memory_usage
 from .op_frequence import op_freq_statistic
 
-__all__ = ["slim", "memory_usage", "op_freq_statistic"]
+__all__ = ["slim", "decoder", "InitState", "StateCell",
+           "TrainingDecoder", "BeamSearchDecoder", "quantize",
+           "QuantizeTranspiler", "int8_inference", "reader", "utils",
+           "model_stat", "summary", "extend_optimizer",
+           "extend_with_decoupled_weight_decay", "Trainer",
+           "CheckpointConfig", "BeginEpochEvent", "EndEpochEvent",
+           "BeginStepEvent", "EndStepEvent", "Inferencer",
+           "memory_usage", "op_freq_statistic"]

@@ -1,0 +1,361 @@
+"""Dynamic decoding framework: StateCell / TrainingDecoder /
+BeamSearchDecoder.
+
+Parity: reference contrib/decoder/beam_search_decoder.py — InitState:43,
+StateCell:159 (inputs/states dicts + @state_updater), TrainingDecoder
+:384 (teacher-forced training pass over the step function),
+BeamSearchDecoder:523 (inference-time beam expansion).
+
+TPU-first shape: the reference drives TrainingDecoder through
+DynamicRNN's LoD batch shrinking and BeamSearchDecoder through a
+while-op over LoD-reordered states (sequence_expand by parent). Here
+TrainingDecoder rides the padded-batch DynamicRNN (lax.scan under the
+`recurrent` op) and BeamSearchDecoder rides the While facade
+(lax.while_loop) at a STATIC [beam_size, ...] shape: beam reordering is
+a dense gather by the beam_search op's parent_idx, and finished beams
+are frozen by the op itself — no LoD at any point.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
+
+
+class InitState:
+    """reference beam_search_decoder.py:43 — a decoder state's initial
+    value: an existing var (`init`) or a filled boot tensor batched
+    like `boot_from`."""
+
+    def __init__(self, init=None, shape=None, value=0.0,
+                 init_boot=None, need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError("init_state must be set by either `init` "
+                             "or `init_boot`")
+        else:
+            from .. import layers
+
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=[-1] + list(shape),
+                dtype=dtype)
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class StateCell:
+    """reference beam_search_decoder.py:159 — the per-step state
+    transition: named inputs + named states + a registered updater.
+
+    `compute_state(inputs)` binds the step inputs and runs the updater
+    (which reads get_input/get_state and writes set_state);
+    `update_states()` commits the staged values to whichever decoder is
+    driving the cell.
+    """
+
+    def __init__(self, inputs: Dict, states: Dict[str, InitState],
+                 out_state: str, name=None):
+        self._inputs = dict(inputs)
+        self._init_states = dict(states)
+        self._out_state = out_state
+        self._cur_states: Dict = {}
+        self._staged: Dict = {}
+        self._updater: Optional[Callable] = None
+        self._decoder = None
+        for sname, s in states.items():
+            if not isinstance(s, InitState):
+                raise ValueError(f"state {sname!r} must be an "
+                                 f"InitState")
+            self._cur_states[sname] = s.value
+
+    # -- wiring --------------------------------------------------------
+    def state_updater(self, updater: Callable):
+        """Decorator registering the step function (reference :314)."""
+        self._updater = updater
+
+        def _decorator(cell):
+            return updater(cell)
+
+        return _decorator
+
+    def _enter_decoder(self, decoder, state_vars: Dict):
+        self._decoder = decoder
+        self._cur_states.update(state_vars)
+
+    def _leave_decoder(self):
+        self._decoder = None
+
+    # -- step-function surface ----------------------------------------
+    def get_input(self, input_name: str):
+        if input_name not in self._inputs:
+            raise KeyError(f"no input named {input_name!r}")
+        v = self._inputs[input_name]
+        if v is None:
+            raise ValueError(f"input {input_name!r} not bound yet "
+                             f"(compute_state must supply it)")
+        return v
+
+    def get_state(self, state_name: str):
+        if state_name not in self._cur_states:
+            raise KeyError(f"no state named {state_name!r}")
+        return self._cur_states[state_name]
+
+    def set_state(self, state_name: str, value):
+        self._staged[state_name] = value
+
+    def compute_state(self, inputs: Dict):
+        """reference :335 — bind this step's inputs, run the updater."""
+        if self._updater is None:
+            raise ValueError("register a @state_cell.state_updater "
+                             "first")
+        for k, v in inputs.items():
+            self._inputs[k] = v
+        self._updater(self)
+
+    def update_states(self):
+        """reference :360 — commit staged states via the driving
+        decoder (DynamicRNN update_memory, or assign in the beam
+        loop)."""
+        if self._decoder is None:
+            # standalone use: just roll the dict forward
+            self._cur_states.update(self._staged)
+        else:
+            self._decoder._commit_states(self, self._staged)
+        self._staged = {}
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+
+class TrainingDecoder:
+    """reference beam_search_decoder.py:384 — teacher-forced decoding:
+    the StateCell stepped by a DynamicRNN over the target sequence."""
+
+    BEFORE_DECODER, IN_DECODER, AFTER_DECODER = 0, 1, 2
+
+    def __init__(self, state_cell: StateCell, name=None):
+        from ..layers.control_flow import DynamicRNN
+
+        self._rnn = DynamicRNN(name=name)
+        self._state_cell = state_cell
+        self.status = TrainingDecoder.BEFORE_DECODER
+        self._outputs: List = []
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    @property
+    def dynamic_rnn(self):
+        return self._rnn
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            self.status = TrainingDecoder.IN_DECODER
+            with self._rnn.block():
+                state_vars = {}
+                self._mem_of = {}
+                for sname, st in \
+                        self._state_cell._init_states.items():
+                    mem = self._rnn.memory(init=st.value)
+                    state_vars[sname] = mem
+                    self._mem_of[sname] = mem
+                self._state_cell._enter_decoder(self, state_vars)
+                yield self
+            self._state_cell._leave_decoder()
+            self.status = TrainingDecoder.AFTER_DECODER
+
+        return _guard()
+
+    def step_input(self, x):
+        self._assert_in_block("step_input")
+        return self._rnn.step_input(x)
+
+    def static_input(self, x):
+        self._assert_in_block("static_input")
+        return self._rnn.static_input(x)
+
+    def output(self, *outputs):
+        self._assert_in_block("output")
+        self._rnn.output(*outputs)
+        self._outputs.extend(outputs)
+
+    def _commit_states(self, cell: StateCell, staged: Dict):
+        for sname, new in staged.items():
+            self._rnn.update_memory(self._mem_of[sname], new)
+            cell._cur_states[sname] = new
+
+    def __call__(self):
+        if self.status != TrainingDecoder.AFTER_DECODER:
+            raise ValueError("call the TrainingDecoder AFTER its "
+                             "block")
+        return self._rnn()
+
+    def _assert_in_block(self, method):
+        if self.status != TrainingDecoder.IN_DECODER:
+            raise ValueError(f"{method} must be called inside "
+                             f"TrainingDecoder.block()")
+
+
+class BeamSearchDecoder:
+    """reference beam_search_decoder.py:523 (the simplified
+    `decode()` usage): expand beam_size hypotheses per step with the
+    beam_search op, reorder states by parent_idx, stop at max_len, and
+    backtrack with beam_search_decode.
+
+    Works on ONE source sequence at static [beam_size, ...] shapes
+    (the reference's LoD beams at batch>1 trade against XLA static
+    shapes; batch decoding loops over sources).
+    """
+
+    def __init__(self, state_cell: StateCell, init_ids, init_scores,
+                 target_dict_dim, word_dim,
+                 input_var_dict: Optional[Dict] = None,
+                 topk_size=50, sparse_emb=True, max_len=100,
+                 beam_size=4, end_id=1, name=None,
+                 word_input_name: Optional[str] = None):
+        # which StateCell input receives the embedded previous token:
+        # explicit name, or unambiguous when the cell has exactly one
+        # input not supplied via input_var_dict
+        candidates = [k for k in state_cell._inputs
+                      if k not in (input_var_dict or {})]
+        if word_input_name is None:
+            if len(candidates) != 1:
+                raise ValueError(
+                    f"state_cell has inputs {candidates}; pass "
+                    f"word_input_name to say which one takes the "
+                    f"embedded previous token")
+            word_input_name = candidates[0]
+        elif word_input_name not in state_cell._inputs:
+            raise KeyError(f"state_cell has no input "
+                           f"{word_input_name!r}")
+        self._word_input_name = word_input_name
+        self._state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = target_dict_dim
+        self._word_dim = word_dim
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = min(int(topk_size), int(target_dict_dim))
+        self._sparse_emb = sparse_emb
+        self._max_len = max_len
+        self._beam_size = beam_size
+        self._end_id = end_id
+        self._embedding_param = name or "beam_decoder_trg_embedding"
+
+    def _commit_states(self, cell: StateCell, staged: Dict):
+        from .. import layers
+
+        for sname, new in staged.items():
+            layers.assign(new, output=cell._cur_states[sname])
+
+    def decode(self):
+        """Build the decode loop; returns (translation_ids,
+        translation_scores) — the reference's decode():700 contract."""
+        from .. import layers
+
+        beam = self._beam_size
+        cell = self._state_cell
+
+        # persistent loop state: current ids/scores + cell states as
+        # outer vars mutated in the While body
+        pre_ids = layers.assign(self._init_ids)          # [beam, 1]
+        pre_scores = layers.assign(self._init_scores)    # [beam, 1]
+        state_vars = {}
+        for sname, st in cell._init_states.items():
+            state_vars[sname] = layers.assign(st.value)
+        cell._enter_decoder(self, state_vars)
+
+        # dense [max_len+1, beam, 1] step buffers (tensor arrays are
+        # trace-time lists here — ops/control_flow_ops.py module doc —
+        # so loop-carried history rides scatter-written buffers at
+        # static shape instead)
+        steps = int(self._max_len) + 1
+        ids_buf = layers.fill_constant([steps, beam, 1], "int64",
+                                       float(self._end_id))
+        scores_buf = layers.fill_constant([steps, beam, 1], "float32",
+                                          0.0)
+        parents_buf = layers.fill_constant([steps, beam, 1], "int64",
+                                           0.0)
+        zero = layers.fill_constant([1], "int64", 0)
+        ids_buf = layers.scatter(
+            ids_buf, zero, layers.reshape(pre_ids, [1, beam, 1]))
+        scores_buf = layers.scatter(
+            scores_buf, zero,
+            layers.reshape(pre_scores, [1, beam, 1]))
+
+        counter = layers.fill_constant([1], "int64", 0)
+        maxlen = layers.fill_constant([1], "int64",
+                                      float(self._max_len))
+        cond = layers.less_than(counter, maxlen)
+        w = layers.While(cond)
+        with w.block():
+            # step input: embed the previous step's selected tokens
+            prev_ids = layers.reshape(pre_ids, shape=[beam])
+            word = layers.embedding(
+                prev_ids,
+                size=[self._target_dict_dim, self._word_dim],
+                is_sparse=self._sparse_emb,
+                param_attr=self._embedding_param)
+            inputs = {self._word_input_name: word}
+            inputs.update(self._input_var_dict)
+            cell.compute_state(inputs)
+            out_state = cell.out_state()
+            scores = layers.softmax(layers.fc(
+                out_state, self._target_dict_dim,
+                param_attr="beam_decoder_softmax_w",
+                bias_attr="beam_decoder_softmax_b"))
+            topk_scores, topk_ids = layers.topk(scores,
+                                                self._topk_size)
+            acc_scores = layers.elementwise_add(
+                layers.log(topk_scores), pre_scores)  # broadcast rows
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, topk_ids, acc_scores,
+                beam_size=beam, end_id=self._end_id,
+                return_parent_idx=True)
+            parent_flat = layers.reshape(parent, shape=[beam])
+            # reorder every state to follow its surviving parent beam
+            cell._staged = {
+                sname: layers.gather(cell.get_state(sname),
+                                     parent_flat)
+                for sname in state_vars}
+            cell.update_states()
+            # int step: a float literal would promote the int64
+            # counter to float32 and break the while-loop carry dtype
+            layers.increment(counter, 1)
+            layers.assign(layers.scatter(
+                ids_buf, counter,
+                layers.reshape(sel_ids, [1, beam, 1])),
+                output=ids_buf)
+            layers.assign(layers.scatter(
+                scores_buf, counter,
+                layers.reshape(sel_scores, [1, beam, 1])),
+                output=scores_buf)
+            layers.assign(layers.scatter(
+                parents_buf, counter,
+                layers.reshape(parent, [1, beam, 1])),
+                output=parents_buf)
+            layers.assign(sel_ids, output=pre_ids)
+            layers.assign(sel_scores, output=pre_scores)
+            layers.less_than(counter, maxlen, cond=cond)
+        cell._leave_decoder()
+
+        out_ids, out_scores = layers.beam_search_decode(
+            ids_buf, scores_buf, beam_size=beam, end_id=self._end_id,
+            parents=parents_buf)
+        return out_ids, out_scores

@@ -1,0 +1,82 @@
+"""Decoupled weight decay mixin for any optimizer.
+
+Parity: reference contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py:21 (DecoupledWeightDecay /
+extend_with_decoupled_weight_decay:104): the decay term
+``param -= coeff * param_old`` is applied OUTSIDE the gradient path
+(AdamW semantics) — the scaled snapshot is taken before the optimizer
+update and subtracted after it.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay:
+    """Mixin; composed with a concrete Optimizer subclass by
+    extend_with_decoupled_weight_decay."""
+
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None,
+                 **kwargs):
+        if not isinstance(coeff, float):
+            raise TypeError("coeff should be float")
+        self._coeff = coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        super().__init__(**kwargs)
+
+    def apply_gradients(self, params_grads):
+        from .. import layers
+
+        # snapshot coeff * param BEFORE the optimizer mutates it
+        scaled = []
+        if self._coeff != 0.0:
+            for param, grad in params_grads:
+                if grad is None:
+                    continue
+                if self._apply_decay_param_fun is not None and not \
+                        self._apply_decay_param_fun(param.name):
+                    continue
+                snap = layers.scale(param, scale=self._coeff)
+                scaled.append((param, snap))
+        optimize_ops = super().apply_gradients(params_grads)
+        # decoupled decay: param <- param_updated - coeff*param_old
+        block = None
+        for param, snap in scaled:
+            block = param.block
+            block.append_op(
+                "elementwise_sub", {"X": param, "Y": snap},
+                {"Out": param}, {"op_role": "optimize"})
+        return optimize_ops
+
+    def __str__(self):
+        return f"{type(self).__name__} (coeff={self._coeff})"
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """reference extend_optimizer_with_weight_decay.py:104: returns a
+    subclass of `base_optimizer` whose constructor takes an extra
+    ``coeff`` (and apply_decay_param_fun) and applies AdamW-style
+    decoupled decay::
+
+        AdamW = extend_with_decoupled_weight_decay(AdamOptimizer)
+        optimizer = AdamW(learning_rate=0.01, coeff=0.01)
+    """
+    from ..optimizer import Optimizer
+
+    if not issubclass(base_optimizer, Optimizer):
+        raise TypeError("input optimizer must be a subclass of "
+                        "Optimizer")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, coeff=0.0, apply_decay_param_fun=None,
+                     **kwargs):
+            super().__init__(coeff=coeff,
+                             apply_decay_param_fun=
+                             apply_decay_param_fun, **kwargs)
+
+    OptimizerWithDecoupledWeightDecay.__name__ = \
+        f"{base_optimizer.__name__}WithDecoupledWeightDecay"
+    return OptimizerWithDecoupledWeightDecay

@@ -1,0 +1,45 @@
+"""High-level Inferencer API (deprecated in the reference but part of
+its surface).
+
+Parity: reference contrib/inferencer.py:31 — `infer_func` rebuilds the
+inference graph, params load from `param_path` (a Trainer.save_params
+artifact), `infer(inputs)` runs one batch.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    def __init__(self, infer_func: Callable, param_path: str,
+                 place=None, parallel: bool = False):
+        import paddle_tpu as fluid
+
+        self._place = place or fluid.TPUPlace(0)
+        self.scope = fluid.Scope()
+        self.inference_program = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(self.inference_program, startup):
+            self.predict_var = infer_func()
+        self.inference_program = self.inference_program.clone(
+            for_test=True)
+        self.exe = fluid.Executor(self._place)
+        with fluid.scope_guard(self.scope):
+            self.exe.run(startup)
+            fluid.io.load_persistables(
+                self.exe, param_path,
+                main_program=self.inference_program)
+
+    def infer(self, inputs: dict, return_numpy: bool = True):
+        """reference inferencer.py:80; inputs is a feed dict."""
+        import paddle_tpu as fluid
+
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        with fluid.scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=[self.predict_var],
+                                return_numpy=return_numpy)

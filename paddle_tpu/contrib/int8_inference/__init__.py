@@ -1,0 +1,5 @@
+"""int8 inference calibration (parity: reference
+contrib/int8_inference/)."""
+from .utility import Calibrator  # noqa: F401
+
+__all__ = ["Calibrator"]

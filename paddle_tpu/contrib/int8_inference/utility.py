@@ -1,0 +1,84 @@
+"""Post-training int8 calibration.
+
+Parity: reference contrib/int8_inference/utility.py Calibrator (the
+MKLDNN int8 flow: run FP32 inference over sample data, collect
+per-tensor activation ranges, emit a quantized program). TPU design:
+ranges come from fetching the quantizable ops' activations over the
+calibration batches; the emitted program carries fake-quant ops with
+the calibrated scales baked (is_test), and weights snapped to the int
+grid via the slim freeze pass — XLA then folds the quantize/dequantize
+chains; a separate int8-packed artifact comes from
+contrib.quantize.QuantizeTranspiler.convert_to_int8.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+__all__ = ["Calibrator"]
+
+
+class Calibrator:
+    def __init__(self, program, pretrained_model=None, iterations=50,
+                 debug=False, algo="direct"):
+        self.program = program
+        self.iterations = iterations
+        self.algo = algo
+        self._ranges: Dict[str, float] = {}
+
+    def _quantizable_acts(self):
+        from ..slim.quantization import _X_SLOTS, QUANTIZABLE_OP_TYPES
+
+        block = self.program.global_block
+        acts = []
+        for op in block.ops:
+            if op.type in QUANTIZABLE_OP_TYPES:
+                names = op.input(_X_SLOTS[op.type])
+                if names:
+                    acts.append(names[0])
+        return acts
+
+    def sample_data(self, executor, feed_batches: Iterable[dict],
+                    scope=None):
+        """Run calibration batches, recording per-activation abs-max
+        (reference Calibrator.sample_data)."""
+        acts = [n for n in self._quantizable_acts()
+                if self.program.global_block.has_var(n)]
+        count = 0
+        for feed in feed_batches:
+            outs = executor.run(self.program, feed=feed,
+                                fetch_list=list(acts), scope=scope)
+            for name, val in zip(acts, outs):
+                mx = float(np.abs(np.asarray(val)).max())
+                self._ranges[name] = max(self._ranges.get(name, 0.0),
+                                         mx)
+            count += 1
+            if count >= self.iterations:
+                break
+        return dict(self._ranges)
+
+    def save_int8_model(self, scope=None):
+        """Emit the calibrated quantized program (reference
+        Calibrator.save_int8_model): insert fake-quant ops with the
+        sampled scales pinned, snap weights to the int grid."""
+        from ...core.scope import global_scope
+        from ..slim.quantization import (QuantizationFreezePass,
+                                         QuantizationTransformPass)
+
+        scope = scope or global_scope()
+        out = self.program.clone(for_test=True)
+        # range_abs_max, NOT abs_max: only its is_test path READS the
+        # InScale var, so the calibrated ranges actually take effect
+        # (abs_max recomputes the scale from the live tensor per batch
+        # and would silently ignore the calibration)
+        QuantizationTransformPass(
+            scope=scope,
+            activation_quantize_type="range_abs_max").apply(out)
+        # pin calibrated activation scales over the 1e-7 init
+        for name, mx in self._ranges.items():
+            key = name + ".quant_scale"
+            scope.var(key)
+            scope._set(key, np.asarray([mx or 1e-8], np.float32))
+        QuantizationFreezePass(scope).apply(out)
+        return out

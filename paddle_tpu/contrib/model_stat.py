@@ -1,0 +1,130 @@
+"""Model summary: per-op PARAMs/FLOPs table.
+
+Parity: reference contrib/model_stat.py:40 `summary(main_prog)` —
+walks the program, one row per supported op (conv2d, mul/fc, pool2d,
+activations, batch_norm), prints an aligned table plus totals and
+returns (total_params, total_flops). Table rendering is plain string
+formatting (the reference depends on prettytable; not a baked-in dep
+here).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["summary"]
+
+_ACT_TYPES = {"relu", "sigmoid", "tanh", "relu6", "leaky_relu",
+              "swish", "hard_sigmoid", "elu", "softmax"}
+
+
+def _shape(block, name) -> Optional[Tuple[int, ...]]:
+    var = block._find_var_recursive(name)
+    return tuple(var.shape) if var is not None and var.shape else None
+
+
+def _row(block, op):
+    """(input_shape, out_shape, params, flops) or None if unsupported
+    (reference _summary_model)."""
+    t = op.type
+    if t in ("conv2d", "depthwise_conv2d"):
+        inp = _shape(block, op.input("Input")[0])
+        w = _shape(block, op.input("Filter")[0])
+        out = _shape(block, op.output("Output")[0])
+        if not (inp and w and out):
+            return None
+        params = int(np.prod(w))
+        bias = op.input("Bias")
+        if bias:
+            params += int(np.prod(_shape(block, bias[0]) or ()))
+        # MACs: out_numel * Cin/groups * kh * kw (reference counts
+        # multiply-adds once, not 2x)
+        flops = int(np.prod([abs(d) for d in out[1:]])) * \
+            int(w[1]) * int(w[2]) * int(w[3])
+        return inp, out, params, flops
+    if t == "mul":
+        inp = _shape(block, op.input("X")[0])
+        w = _shape(block, op.input("Y")[0])
+        out = _shape(block, op.output("Out")[0])
+        if not (inp and w and out):
+            return None
+        return inp, out, int(np.prod(w)), int(np.prod(w))
+    if t == "pool2d":
+        inp = _shape(block, op.input("X")[0])
+        out = _shape(block, op.output("Out")[0])
+        if not (inp and out):
+            return None
+        k = op.attr("ksize", [1, 1])
+        flops = int(np.prod([abs(d) for d in out[1:]])) * \
+            int(k[0]) * int(k[1])
+        return inp, out, 0, flops
+    if t == "batch_norm":
+        inp = _shape(block, op.input("X")[0])
+        out = _shape(block, op.output("Y")[0])
+        if not (inp and out):
+            return None
+        c = _shape(block, op.input("Scale")[0])
+        params = 2 * int(np.prod(c or (0,)))  # scale+bias (trainable)
+        return inp, out, params, int(np.prod([abs(d) for d in
+                                              out[1:]]))
+    if t in _ACT_TYPES:
+        inp = _shape(block, op.input("X")[0])
+        out = _shape(block, op.output_arg_names[0]) if \
+            op.output_arg_names else None
+        if not (inp and out):
+            return None
+        return inp, out, 0, int(np.prod([abs(d) for d in out[1:]]))
+    if t == "elementwise_add":
+        # the conv2d/fc layers add bias via a separate op here; a 1-D
+        # persistable Y is that bias — count its params like the
+        # reference counts in-op Bias slots
+        y = op.input("Y")
+        yshape = _shape(block, y[0]) if y else None
+        yvar = block._find_var_recursive(y[0]) if y else None
+        if yshape and len(yshape) == 1 and yvar is not None and \
+                yvar.persistable:
+            inp = _shape(block, op.input("X")[0])
+            out = _shape(block, op.output("Out")[0])
+            if inp and out:
+                return inp, out, int(yshape[0]), \
+                    int(np.prod([abs(d) for d in out[1:]]))
+    return None
+
+
+def summary(main_prog, print_table: bool = True):
+    """reference contrib/model_stat.py:40. Returns
+    (total_params, total_flops)."""
+    rows: List = []
+    for block in main_prog.blocks:
+        for op in block.ops:
+            if op.attr("op_role") in ("backward", "optimize",
+                                      "lr_sched"):
+                continue
+            r = _row(block, op)
+            if r is None:
+                continue
+            inp, out, params, flops = r
+            rows.append((len(rows), op.type, str(tuple(inp[1:])),
+                         str(tuple(out[1:])), params, flops))
+    total_params = sum(r[4] for r in rows)
+    total_flops = sum(r[5] for r in rows)
+    if print_table:
+        headers = ("No.", "TYPE", "INPUT", "OUTPUT", "PARAMs",
+                   "FLOPs")
+        widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+                  if rows else len(str(h))
+                  for i, h in enumerate(headers)]
+        line = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(line)
+        print("| " + " | ".join(str(h).rjust(w) for h, w in
+                                zip(headers, widths)) + " |")
+        print(line)
+        for r in rows:
+            print("| " + " | ".join(str(v).rjust(w) for v, w in
+                                    zip(r, widths)) + " |")
+        print(line)
+        print(f"Total PARAMs: {total_params}"
+              f"({total_params / 1e9:.4f}G)")
+        print(f"Total FLOPs: {total_flops}({total_flops / 1e9:.2f}G)")
+    return total_params, total_flops

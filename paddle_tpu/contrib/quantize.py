@@ -1,0 +1,97 @@
+"""QuantizeTranspiler: program-level QAT rewrite API.
+
+Parity: reference contrib/quantize/quantize_transpiler.py:69
+(QuantizeTranspiler: training_transpile:100 inserts fake-quant pairs
+into the train program, freeze_program:149 bakes scales for inference,
+convert_to_int8:237 rewrites weights to int8 storage). Implemented over
+the slim QAT passes (contrib/slim/quantization.py) — one rewrite
+engine, two user surfaces, like the reference shares
+QuantizationTransformPass.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.scope import global_scope
+
+__all__ = ["QuantizeTranspiler"]
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000,
+                 moving_rate=0.9):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._act_type = activation_quantize_type
+        self._w_type = weight_quantize_type
+        self._window = window_size
+        self._rate = moving_rate
+
+    def training_transpile(self, program=None, startup_program=None):
+        """reference quantize_transpiler.py:100: rewrite the (forward)
+        train program in place with fake-quant ops; grads for the
+        inserted ops come from the registry STE vjp when backward is
+        appended afterwards."""
+        from ..core.program import (default_main_program,
+                                    default_startup_program)
+        from .slim.quantization import QuantizationTransformPass
+
+        program = program or default_main_program()
+        startup_program = startup_program or default_startup_program()
+        QuantizationTransformPass(
+            weight_bits=self._wbits, activation_bits=self._abits,
+            activation_quantize_type=self._act_type,
+            weight_quantize_type=self._w_type,
+            window_size=self._window, moving_rate=self._rate,
+            startup_program=startup_program).apply(program)
+        return program
+
+    def freeze_program(self, program, place=None, fuse_bn=False,
+                       scope=None):
+        """reference quantize_transpiler.py:149: snap weights to the
+        int grid, bake activation scales to test mode."""
+        from ..ir import apply_passes
+        from .slim.quantization import QuantizationFreezePass
+
+        scope = scope or global_scope()
+        if fuse_bn:
+            apply_passes(program, ["conv_bn_fuse_pass"], scope=scope)
+        QuantizationFreezePass(scope,
+                               weight_bits=self._wbits).apply(program)
+        return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """reference quantize_transpiler.py:237: store quantizable
+        weights as int8 arrays + float scale companions (the deploy
+        artifact; a consumer dequantizes with `w_int8 * scale/127`).
+        The program's weight vars flip to int8 dtype; scale lives under
+        `<name>@SCALE` in the scope."""
+        scope = scope or global_scope()
+        bnt = float((1 << (self._wbits - 1)) - 1)
+        block = program.global_block
+        for op in block.ops:
+            if op.type not in ("conv2d", "depthwise_conv2d", "mul",
+                               "fc"):
+                continue
+            slot = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+                    "mul": "Y", "fc": "W"}[op.type]
+            for name in op.input(slot):
+                w = scope._get(name)
+                var = block._find_var_recursive(name)
+                if w is None or var is None or not var.persistable:
+                    continue
+                w = np.asarray(w)
+                if w.dtype == np.int8:
+                    continue
+                scale = float(np.abs(w).max()) or 1e-8
+                q = np.round(np.clip(w / scale, -1, 1) * bnt)
+                scope._set(name, q.astype(np.int8))
+                scope._set(name + "@SCALE",
+                           np.asarray([scale / bnt], np.float32))
+                from ..core.types import as_datatype
+
+                var.dtype = as_datatype("int8")
+        program._version += 1
+        return program

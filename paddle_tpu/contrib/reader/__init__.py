@@ -1,0 +1,4 @@
+"""contrib readers (parity: reference contrib/reader/)."""
+from .ctr_reader import ctr_reader  # noqa: F401
+
+__all__ = ["ctr_reader"]

@@ -1,0 +1,62 @@
+"""CTR file reader (parity: reference contrib/reader/ctr_reader.py:44
+`ctr_reader` over operators/reader/ctr_reader.cc: multithreaded file
+reading of multi-slot CTR logs into a blocking queue).
+
+TPU design: parsing rides data_feed.MultiSlotDataFeed (the same line
+format the reference's C++ CTR reader consumes) registered as a host
+reader; the in-graph `read` op pops batches through the ordered
+io_callback bridge like every other reader in layers/io.py.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["ctr_reader"]
+
+
+def ctr_reader(feed_data, capacity: int, thread_num: int,
+               batch_size: int, file_list: Sequence[str],
+               slots: Sequence[str], name=None):
+    """Returns a ReaderVariable whose read_file() yields one batch of
+    the declared slots per step. feed_data lists the data vars the
+    slots map onto (their shapes/dtypes become the static specs)."""
+    from ...data_feed import DataFeedDesc, MultiSlotDataFeed
+    from ...layers import io as lio
+    from ...ops.extra_ops3 import register_host_reader
+
+    desc = DataFeedDesc()
+    desc.set_batch_size(batch_size)
+    for v, slot in zip(feed_data, slots):
+        is_dense = v.dtype is not None and "FP" in str(v.dtype)
+        desc.add_slot(slot, type="float" if is_dense else "uint64",
+                      is_dense=is_dense)
+    feed = MultiSlotDataFeed(desc)
+
+    def factory():
+        for path in file_list:
+            for batch in feed.read_batches(path):
+                yield tuple(batch[s] for s in slots
+                            if s in batch)
+
+    def _bucket(n):
+        # sparse slots come back padded to data_feed._pad_ragged's
+        # power-of-two buckets (min 4); the static read specs must
+        # match that width
+        b = 4
+        while b < n:
+            b *= 2
+        return b
+
+    rname = name or "ctr_reader"
+    register_host_reader(rname, factory)
+    var = lio._reader_var(rname)
+    shapes = []
+    for v, slot in zip(feed_data, slots):
+        dims = [int(d) if d and d > 0 else batch_size
+                for d in (v.shape or (batch_size,))]
+        is_dense = v.dtype is not None and "FP" in str(v.dtype)
+        if not is_dense and len(dims) >= 2:
+            dims[-1] = _bucket(dims[-1])
+        shapes.append(tuple(dims))
+    dtypes = [v.dtype for v in feed_data]
+    return lio.ReaderVariable(var, shapes, dtypes, source_name=rname)

@@ -1,0 +1,213 @@
+"""High-level Trainer API (deprecated in the reference but part of its
+surface).
+
+Parity: reference contrib/trainer.py — event classes
+(BeginEpochEvent:40, EndEpochEvent:52, BeginStepEvent:64,
+EndStepEvent:83), CheckpointConfig:100, Trainer:169 (train:379,
+test:407, save_params:420, save_inference_model:434, stop:373).
+
+The Trainer owns its own Program pair + Scope: `train_func` builds the
+forward and returns the loss (optionally [loss, *metrics]),
+`optimizer_func` supplies the optimizer; train() runs the epoch/step
+loop with event callbacks and optional periodic checkpoints
+(train_checkpoint.TrainCheckpoint handles crash-resume).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "CheckpointConfig", "Trainer"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        # reference: handler may flip this to request metric fetch
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """reference trainer.py:100."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or "checkpoint"
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+
+
+class Trainer:
+    """reference contrib/trainer.py:169."""
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 param_path: Optional[str] = None, place=None,
+                 parallel: bool = False,
+                 checkpoint_config: Optional[CheckpointConfig] = None):
+        import paddle_tpu as fluid
+
+        self._place = place or fluid.TPUPlace(0)
+        self._parallel = parallel
+        self._stop = False
+        self._checkpoint_cfg = checkpoint_config
+        self.scope = fluid.Scope()
+        self.startup_program = fluid.Program()
+        self.train_program = fluid.Program()
+        with fluid.program_guard(self.train_program,
+                                 self.startup_program):
+            outs = train_func()
+            outs = list(outs) if isinstance(outs, (list, tuple)) \
+                else [outs]
+            self.train_func_outputs = outs
+            loss = outs[0]
+            optimizer = optimizer_func()
+            optimizer.minimize(loss)
+        self.test_program = self.train_program.clone(for_test=True)
+        self.exe = fluid.Executor(self._place)
+        with fluid.scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if param_path:
+                fluid.io.load_persistables(
+                    self.exe, param_path,
+                    main_program=self.train_program)
+        self._compiled = None
+        if parallel:
+            self._compiled = fluid.CompiledProgram(
+                self.train_program).with_data_parallel(
+                    loss_name=loss.name)
+
+    # -- internals -----------------------------------------------------
+    def _feed(self, data, feed_order):
+        if isinstance(data, dict):
+            return data
+        if feed_order is None:
+            raise ValueError("feed_order is required when the reader "
+                             "yields tuples")
+        return dict(zip(feed_order, data))
+
+    # -- API -----------------------------------------------------------
+    def stop(self):
+        """reference trainer.py:373 — break out of train() after the
+        current step."""
+        self._stop = True
+
+    def train(self, num_epochs, event_handler: Callable,
+              reader: Callable = None,
+              feed_order: Optional[Sequence[str]] = None):
+        """reference trainer.py:379."""
+        import paddle_tpu as fluid
+
+        program = self._compiled or self.train_program
+        fetch = [v.name for v in self.train_func_outputs]
+        with fluid.scope_guard(self.scope):
+            for epoch_id in range(num_epochs):
+                if self._stop:
+                    break
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self._stop:
+                        break
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    outs = self.exe.run(
+                        program,
+                        feed=self._feed(data, feed_order),
+                        fetch_list=fetch if begin.fetch_metrics
+                        else [])
+                    event_handler(EndStepEvent(epoch_id, step_id,
+                                               outs))
+                    cfg = self._checkpoint_cfg
+                    # reference semantics: checkpoint on matching
+                    # step intervals, only in matching epochs
+                    if cfg and epoch_id % cfg.epoch_interval == 0 \
+                            and (step_id + 1) % cfg.step_interval == 0:
+                        self._save_checkpoint(epoch_id, step_id)
+                event_handler(EndEpochEvent(epoch_id))
+        self._stop = False
+
+    def test(self, reader, feed_order=None):
+        """reference trainer.py:407: mean of the train_func outputs
+        over the reader, on the test (is_test) program clone."""
+        import paddle_tpu as fluid
+
+        fetch = [v.name for v in self.train_func_outputs]
+        totals = np.zeros(len(fetch), np.float64)
+        count = 0
+        with fluid.scope_guard(self.scope):
+            for data in reader():
+                outs = self.exe.run(self.test_program,
+                                    feed=self._feed(data, feed_order),
+                                    fetch_list=fetch)
+                totals += [float(np.mean(o)) for o in outs]
+                count += 1
+        return list(totals / max(count, 1))
+
+    def save_params(self, param_path):
+        """reference trainer.py:420."""
+        import paddle_tpu as fluid
+
+        with fluid.scope_guard(self.scope):
+            fluid.io.save_persistables(
+                self.exe, param_path, main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        """reference trainer.py:434 — targets picked by index into the
+        train_func outputs."""
+        import paddle_tpu as fluid
+
+        targets = [self.train_func_outputs[i]
+                   for i in target_var_indexes]
+        with fluid.scope_guard(self.scope):
+            fluid.io.save_inference_model(
+                param_path, list(feeded_var_names), targets, self.exe,
+                main_program=self.test_program)
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        import os
+
+        import paddle_tpu as fluid
+
+        cfg = self._checkpoint_cfg
+        d = os.path.join(cfg.checkpoint_dir,
+                         f"epoch{epoch_id}_step{step_id}")
+        os.makedirs(d, exist_ok=True)
+        with fluid.scope_guard(self.scope):
+            fluid.io.save_persistables(
+                self.exe, d, main_program=self.train_program)
+        # retention: drop oldest beyond max_num_checkpoints
+        kids = sorted(
+            (p for p in os.listdir(cfg.checkpoint_dir)
+             if p.startswith("epoch")),
+            key=lambda p: os.path.getmtime(
+                os.path.join(cfg.checkpoint_dir, p)))
+        while len(kids) > cfg.max_num_checkpoints:
+            victim = kids.pop(0)
+            import shutil
+
+            shutil.rmtree(os.path.join(cfg.checkpoint_dir, victim),
+                          ignore_errors=True)

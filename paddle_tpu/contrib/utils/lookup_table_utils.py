@@ -1,0 +1,104 @@
+"""Distributed lookup-table program surgery + load helpers.
+
+Parity: reference contrib/utils/lookup_table_utils.py —
+convert_dist_to_sparse_program:82 (rewrite a transpiled trainer's
+prefetch path back to a local sparse lookup for single-machine
+increment training), load_persistables_for_increment:133 /
+load_persistables_for_inference:257 (load a pserver-sharded model dir,
+concatenating the table shards), get_inference_model:400.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference"]
+
+
+def _table_name(program):
+    t = getattr(program, "_distributed_lookup_table", None)
+    if not t:
+        raise ValueError(
+            "the program does NOT use a distributed lookup table "
+            "(transpile with one first — reference raises the same)")
+    return t
+
+
+def convert_dist_to_sparse_program(program):
+    """Rewrite the transpiled trainer program's remote-prefetch lookup
+    back into a plain local lookup_table (is_distributed off), so an
+    exported dist model runs single-process."""
+    table = _table_name(program)
+    out = program.clone()
+    for blk in out.blocks:
+        for op in blk.ops:
+            if op.type in ("lookup_table", "lookup_sparse_table") and \
+                    table in op.input_arg_names:
+                op.attrs["is_distributed"] = False
+                op.attrs["remote_prefetch"] = False
+            if op.type == "prefetch":
+                op.type = "lookup_table"
+                op.attrs = {"is_sparse": True,
+                            "is_distributed": False,
+                            "padding_idx": -1}
+    out._distributed_lookup_table = None
+    out._version += 1
+    return out
+
+
+def _load_table_shards(dirname, table_name, scope):
+    """Concatenate `<table>.block<N>` pserver shard files row-wise
+    (the reference loads per-pserver slices the same way)."""
+    shards = sorted(
+        (f for f in os.listdir(dirname)
+         if f == table_name or f.startswith(table_name + ".block")),
+        key=lambda f: int(f.rsplit("block", 1)[-1])
+        if "block" in f else -1)
+    if not shards:
+        return False
+    parts = [np.load(os.path.join(dirname, f))
+             if not os.path.isdir(os.path.join(dirname, f))
+             else None for f in shards]
+    parts = [p for p in parts if p is not None]
+    if not parts:
+        return False
+    scope._set(table_name, np.concatenate(parts, axis=0))
+    return True
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """reference :133 — load everything for continued training,
+    including the sharded big table."""
+    from ... import io as fluid_io
+    from ...core.scope import global_scope
+
+    table = lookup_table_var or _table_name(program)
+    fluid_io.load_persistables(executor, dirname,
+                               main_program=program)
+    scope = global_scope()
+    if lookup_table_var_path:
+        scope._set(table, np.load(lookup_table_var_path))
+    else:
+        _load_table_shards(dirname, table, scope)
+    return program
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """reference :257 — like increment-loading but tolerates a program
+    without the distributed marker (a converted inference model)."""
+    from ... import io as fluid_io
+    from ...core.scope import global_scope
+
+    fluid_io.load_persistables(executor, dirname,
+                               main_program=program)
+    if lookup_table_var_name:
+        _load_table_shards(dirname, lookup_table_var_name,
+                           global_scope())
+    return program

@@ -224,7 +224,8 @@ def lstmp(ctx):
 # --------------------------------------------------------------------------
 # recurrent: sub-block stepped over time (StaticRNN backend)
 # --------------------------------------------------------------------------
-@register_op("recurrent", differentiable=False, infer_shape=_no_infer)
+@register_op("recurrent", infer_shape=_no_infer,
+             stop_gradient_slots=("SeqLen",))
 def recurrent(ctx):
     """reference recurrent_op.cc runs its sub-block once per step via an
     inner executor, linking `memories` across steps. Here the block is

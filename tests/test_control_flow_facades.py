@@ -205,3 +205,44 @@ class TestIfElse:
         got, = _exe().run(prog, feed={"x": x_np}, fetch_list=[out])
         np.testing.assert_allclose(
             got, [[2.0], [6.0], [1.0], [8.0]], rtol=1e-6)
+
+
+def test_dynamic_rnn_inner_weights_receive_grads():
+    """Regression: the recurrent op must emit grads for its sub-block
+    externals (weights INSIDE the rnn step) — previously they were
+    silently frozen (differentiable=False)."""
+    import numpy as np
+
+    b, t, d, h = 4, 5, 6, 7
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        sent = fluid.layers.data(name="sent", shape=[t, d],
+                                 dtype="float32")
+        label = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(sent)
+            prev = drnn.memory(shape=[h], value=0.0)
+            hidden = fluid.layers.fc(
+                [word, prev], size=h, act="tanh",
+                param_attr=[fluid.ParamAttr(name="wx_reg"),
+                            fluid.ParamAttr(name="wh_reg")])
+            drnn.update_memory(prev, hidden)
+            drnn.output(hidden)
+        last = fluid.layers.sequence_last_step(drnn())
+        logits = fluid.layers.fc(last, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    assert any("wx_reg@GRAD" in op.output_arg_names
+               for op in prog.global_block.ops)
+    exe = _exe()
+    exe.run(startup)
+    scope = fluid.global_scope()
+    w0 = np.array(scope._get("wx_reg"))
+    rng = np.random.RandomState(0)
+    feed = {"sent": rng.randn(b, t, d).astype(np.float32),
+            "sent@SEQ_LEN": np.full((b,), t, np.int32),
+            "y": rng.randint(0, 2, (b, 1)).astype(np.int64)}
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    assert not np.allclose(w0, np.asarray(scope._get("wx_reg")))
