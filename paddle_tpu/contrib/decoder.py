@@ -113,7 +113,13 @@ class StateCell:
         return self._cur_states[state_name]
 
     def set_state(self, state_name: str, value):
+        """Reference semantics: the new value is visible to
+        get_state/out_state IMMEDIATELY (the book decoders read the
+        freshly computed state for their score fc before
+        update_states); update_states only COMMITS it to the driving
+        decoder's carry."""
         self._staged[state_name] = value
+        self._cur_states[state_name] = value
 
     def compute_state(self, inputs: Dict):
         """reference :335 — bind this step's inputs, run the updater."""
@@ -228,7 +234,8 @@ class BeamSearchDecoder:
                  input_var_dict: Optional[Dict] = None,
                  topk_size=50, sparse_emb=True, max_len=100,
                  beam_size=4, end_id=1, name=None,
-                 word_input_name: Optional[str] = None):
+                 word_input_name: Optional[str] = None,
+                 softmax_param_attr=None, softmax_bias_attr=None):
         # which StateCell input receives the embedded previous token:
         # explicit name, or unambiguous when the cell has exactly one
         # input not supplied via input_var_dict
@@ -257,12 +264,20 @@ class BeamSearchDecoder:
         self._beam_size = beam_size
         self._end_id = end_id
         self._embedding_param = name or "beam_decoder_trg_embedding"
+        # nameable so a decode program can SHARE the training model's
+        # output projection (scope params are keyed by name)
+        self._softmax_param_attr = softmax_param_attr or \
+            "beam_decoder_softmax_w"
+        self._softmax_bias_attr = softmax_bias_attr or \
+            "beam_decoder_softmax_b"
 
     def _commit_states(self, cell: StateCell, staged: Dict):
         from .. import layers
 
         for sname, new in staged.items():
-            layers.assign(new, output=cell._cur_states[sname])
+            layers.assign(new, output=self._carried[sname])
+            # next loop iteration reads the carried var again
+            cell._cur_states[sname] = self._carried[sname]
 
     def decode(self):
         """Build the decode loop; returns (translation_ids,
@@ -279,6 +294,7 @@ class BeamSearchDecoder:
         state_vars = {}
         for sname, st in cell._init_states.items():
             state_vars[sname] = layers.assign(st.value)
+        self._carried = state_vars
         cell._enter_decoder(self, state_vars)
 
         # dense [max_len+1, beam, 1] step buffers (tensor arrays are
@@ -315,11 +331,12 @@ class BeamSearchDecoder:
             inputs = {self._word_input_name: word}
             inputs.update(self._input_var_dict)
             cell.compute_state(inputs)
-            out_state = cell.out_state()
+            # out_state is the FRESH state (set_state semantics) —
+            # the same h_t the training path scores from
             scores = layers.softmax(layers.fc(
-                out_state, self._target_dict_dim,
-                param_attr="beam_decoder_softmax_w",
-                bias_attr="beam_decoder_softmax_b"))
+                cell.out_state(), self._target_dict_dim,
+                param_attr=self._softmax_param_attr,
+                bias_attr=self._softmax_bias_attr))
             topk_scores, topk_ids = layers.topk(scores,
                                                 self._topk_size)
             acc_scores = layers.elementwise_add(
@@ -329,10 +346,12 @@ class BeamSearchDecoder:
                 beam_size=beam, end_id=self._end_id,
                 return_parent_idx=True)
             parent_flat = layers.reshape(parent, shape=[beam])
-            # reorder every state to follow its surviving parent beam
+            # EVERY state follows its surviving hypothesis' parent
+            # beam — freshly staged ones and untouched ones alike (a
+            # read-only per-beam state still has beam identity)
             cell._staged = {
-                sname: layers.gather(cell.get_state(sname),
-                                     parent_flat)
+                sname: layers.gather(cell._staged.get(
+                    sname, cell.get_state(sname)), parent_flat)
                 for sname in state_vars}
             cell.update_states()
             # int step: a float literal would promote the int64

@@ -1,38 +1,77 @@
 """Seq2seq encoder-decoder NMT (reference benchmark/fluid/
 machine_translation.py / tests/book/test_machine_translation.py:
-GRU encoder -> attention-free decoder with teacher forcing)."""
+GRU encoder -> attention-free decoder with teacher forcing, plus the
+beam-search inference decoder the book test builds from
+contrib/decoder/beam_search_decoder.py).
+
+All decoder-path parameters are NAMED so the training program and the
+beam-decode program share weights through the scope (the reference
+shares them the same way, by param name).
+"""
 from __future__ import annotations
 
 from .. import layers
 from ..layers.sequence import bind_seq_len
 
+_P = {
+    "src_emb": "mt_src_emb_w",
+    "enc_proj_w": "mt_enc_proj_w", "enc_proj_b": "mt_enc_proj_b",
+    "enc_gru_w": "mt_enc_gru_w", "enc_gru_b": "mt_enc_gru_b",
+    "dec_boot_w": "mt_dec_boot_w", "dec_boot_b": "mt_dec_boot_b",
+    "tgt_emb": "mt_tgt_emb_w",
+    "dec_proj_w": "mt_dec_proj_w", "dec_proj_b": "mt_dec_proj_b",
+    "dec_gru_w": "mt_dec_gru_w", "dec_gru_b": "mt_dec_gru_b",
+    "softmax_w": "mt_softmax_w", "softmax_b": "mt_softmax_b",
+}
+
+
+def _encode(src_ids, src_dict_dim, embedding_dim, encoder_size):
+    src_emb = layers.embedding(src_ids,
+                               size=[src_dict_dim, embedding_dim],
+                               param_attr=_P["src_emb"])
+    bind_seq_len(src_emb, src_ids)
+    enc_proj = layers.fc(src_emb, encoder_size * 3, num_flatten_dims=2,
+                         param_attr=_P["enc_proj_w"],
+                         bias_attr=_P["enc_proj_b"])
+    bind_seq_len(enc_proj, src_emb)
+    enc = layers.dynamic_gru(enc_proj, encoder_size,
+                             param_attr=_P["enc_gru_w"],
+                             bias_attr=_P["enc_gru_b"])
+    enc_last = layers.sequence_pool(enc, "last")
+    return enc, enc_last
+
 
 def seq_to_seq_net(src_ids, tgt_ids, label, src_dict_dim, tgt_dict_dim,
                    embedding_dim=512, encoder_size=512,
                    decoder_size=512):
-    src_emb = layers.embedding(src_ids,
-                               size=[src_dict_dim, embedding_dim])
-    bind_seq_len(src_emb, src_ids)
-    enc_proj = layers.fc(src_emb, encoder_size * 3, num_flatten_dims=2)
-    bind_seq_len(enc_proj, src_emb)
-    enc = layers.dynamic_gru(enc_proj, encoder_size)
-    enc_last = layers.sequence_pool(enc, "last")
+    enc, enc_last = _encode(src_ids, src_dict_dim, embedding_dim,
+                            encoder_size)
+    dec_init = layers.fc(enc_last, decoder_size, act="tanh",
+                         param_attr=_P["dec_boot_w"],
+                         bias_attr=_P["dec_boot_b"])
 
     tgt_emb = layers.embedding(tgt_ids,
-                               size=[tgt_dict_dim, embedding_dim])
+                               size=[tgt_dict_dim, embedding_dim],
+                               param_attr=_P["tgt_emb"])
     bind_seq_len(tgt_emb, tgt_ids)
-    dec_proj = layers.fc(tgt_emb, decoder_size * 3, num_flatten_dims=2)
+    dec_proj = layers.fc(tgt_emb, decoder_size * 3, num_flatten_dims=2,
+                         param_attr=_P["dec_proj_w"],
+                         bias_attr=_P["dec_proj_b"])
     bind_seq_len(dec_proj, tgt_emb)
-    dec_init = layers.fc(enc_last, decoder_size, act="tanh")
-    dec = layers.dynamic_gru(dec_proj, decoder_size, h_0=dec_init)
-    logits = layers.fc(dec, tgt_dict_dim, num_flatten_dims=2)
+    dec = layers.dynamic_gru(dec_proj, decoder_size, h_0=dec_init,
+                             param_attr=_P["dec_gru_w"],
+                             bias_attr=_P["dec_gru_b"])
+    logits = layers.fc(dec, tgt_dict_dim, num_flatten_dims=2,
+                       param_attr=_P["softmax_w"],
+                       bias_attr=_P["softmax_b"])
     loss = layers.mean(layers.softmax_with_cross_entropy(
         logits, layers.unsqueeze(label, [2])))
     return loss, logits
 
 
 def build_program(src_dict_dim=10000, tgt_dict_dim=10000, lr=0.0002,
-                  with_optimizer=True):
+                  with_optimizer=True, embedding_dim=512,
+                  encoder_size=512, decoder_size=512):
     import paddle_tpu as fluid
 
     main = fluid.Program()
@@ -50,7 +89,73 @@ def build_program(src_dict_dim=10000, tgt_dict_dim=10000, lr=0.0002,
                             append_batch_size=False)
         label.shape = (-1, -1)
         loss, logits = seq_to_seq_net(src, tgt, label, src_dict_dim,
-                                      tgt_dict_dim)
+                                      tgt_dict_dim, embedding_dim,
+                                      encoder_size, decoder_size)
         if with_optimizer:
             fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
     return main, startup, loss
+
+
+def build_decode_program(src_dict_dim=10000, tgt_dict_dim=10000,
+                         embedding_dim=512, encoder_size=512,
+                         decoder_size=512, beam_size=4, max_len=32,
+                         start_id=0, end_id=1, src_len=None):
+    """Beam-search inference program sharing the training weights by
+    name (reference tests/book/test_machine_translation.py decode()
+    over contrib BeamSearchDecoder). Decodes ONE source sequence at
+    static [beam_size, ...] shapes; returns
+    (program, startup, feeds, (translation_ids, translation_scores)).
+    """
+    import paddle_tpu as fluid
+    from .. import contrib
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_word_id", shape=[-1], dtype="int64",
+                          lod_level=1, append_batch_size=False)
+        src.shape = (1, src_len if src_len else -1)
+        # static-batch program: declare the @SEQ_LEN companion at the
+        # same concrete batch so build-time shape probes agree
+        main.global_block.create_var(
+            name="src_word_id@SEQ_LEN", shape=(1,), dtype="int32",
+            is_data=True, stop_gradient=True)
+        enc, enc_last = _encode(src, src_dict_dim, embedding_dim,
+                                encoder_size)
+        dec_boot = layers.fc(enc_last, decoder_size, act="tanh",
+                             param_attr=_P["dec_boot_w"],
+                             bias_attr=_P["dec_boot_b"])  # [1, H]
+        h0 = layers.expand(dec_boot, [beam_size, 1])  # [beam, H]
+
+        cell = contrib.StateCell(
+            inputs={"word": None},
+            states={"h": contrib.InitState(init=h0)},
+            out_state="h")
+
+        @cell.state_updater
+        def updater(c):
+            word = c.get_input("word")          # [beam, E]
+            h_prev = c.get_state("h")           # [beam, H]
+            proj = layers.fc(word, decoder_size * 3,
+                             param_attr=_P["dec_proj_w"],
+                             bias_attr=_P["dec_proj_b"])
+            h, _, _ = layers.gru_unit(proj, h_prev, decoder_size * 3,
+                                      param_attr=_P["dec_gru_w"],
+                                      bias_attr=_P["dec_gru_b"])
+            c.set_state("h", h)
+
+        init_ids = layers.fill_constant([beam_size, 1], "int64",
+                                        float(start_id))
+        init_scores = layers.fill_constant([beam_size, 1], "float32",
+                                           0.0)
+        decoder = contrib.BeamSearchDecoder(
+            cell, init_ids, init_scores,
+            target_dict_dim=tgt_dict_dim, word_dim=embedding_dim,
+            topk_size=min(50, tgt_dict_dim), max_len=max_len,
+            beam_size=beam_size, end_id=end_id,
+            name=_P["tgt_emb"],
+            softmax_param_attr=_P["softmax_w"],
+            softmax_bias_attr=_P["softmax_b"])
+        out_ids, out_scores = decoder.decode()
+    return (main, startup, ["src_word_id", "src_word_id@SEQ_LEN"],
+            (out_ids, out_scores))

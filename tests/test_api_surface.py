@@ -425,3 +425,23 @@ def test_recordio_writer_roundtrip(tmp_path):
     paths = fluid.recordio_writer.convert_reader_to_recordio_files(
         os.path.join(str(tmp_path), "shard"), 3, reader)
     assert len(paths) == 3  # 3+3+1
+
+
+def test_reference_contrib_coverage():
+    """Every reference contrib submodule + its main public classes
+    resolve on paddle_tpu.contrib."""
+    from paddle_tpu import contrib
+
+    for mod in ["decoder", "memory_usage_calc", "op_frequence",
+                "quantize", "int8_inference", "reader", "slim",
+                "utils", "extend_optimizer"]:
+        assert hasattr(contrib, mod), mod
+    for name in ["BeamSearchDecoder", "TrainingDecoder", "StateCell",
+                 "InitState", "QuantizeTranspiler", "Trainer",
+                 "Inferencer", "summary",
+                 "extend_with_decoupled_weight_decay",
+                 "memory_usage", "op_freq_statistic"]:
+        assert hasattr(contrib, name), name
+    assert hasattr(contrib.utils, "HDFSClient")
+    assert hasattr(contrib.reader, "ctr_reader")
+    assert hasattr(contrib.int8_inference, "Calibrator")

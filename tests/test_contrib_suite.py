@@ -373,3 +373,58 @@ class TestDecoderFramework:
         ids = np.asarray(ids)
         assert ids.ndim >= 1 and ids.size > 0
         assert np.isfinite(np.asarray(scores)).all()
+
+
+class TestMachineTranslationDecode:
+    """Book-style MT flow (reference tests/book/
+    test_machine_translation.py): teacher-forced training, then
+    beam-search decode on the SAME weights (shared by param name)."""
+
+    def test_train_then_beam_decode(self):
+        from paddle_tpu.models import machine_translation as mt
+
+        V, E, H = 20, 8, 10
+        main, startup, loss = mt.build_program(
+            src_dict_dim=V, tgt_dict_dim=V, lr=0.01,
+            embedding_dim=E, encoder_size=H, decoder_size=H)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        B, T = 8, 5
+
+        def feed():
+            src = rng.randint(2, V, (B, T)).astype(np.int64)
+            lens = np.full((B,), T, np.int32)
+            return {"src_word_id": src,
+                    "src_word_id@SEQ_LEN": lens,
+                    "target_language_word": src,
+                    "target_language_word@SEQ_LEN": lens,
+                    "target_language_next_word": src,
+                    "target_language_next_word@SEQ_LEN": lens}
+
+        f = feed()
+        losses = [float(np.mean(exe.run(main, feed=f,
+                                        fetch_list=[loss])[0]))
+                  for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+        dec_main, dec_startup, feeds, (out_ids, out_scores) = \
+            mt.build_decode_program(
+                src_dict_dim=V, tgt_dict_dim=V, embedding_dim=E,
+                encoder_size=H, decoder_size=H, beam_size=3,
+                max_len=6, start_id=0, end_id=1, src_len=T)
+        # weight sharing: every decode param already lives in the
+        # scope from training — do NOT run dec_startup
+        scope = fluid.global_scope()
+        for p in dec_main.all_parameters():
+            assert scope._get(p.name) is not None, \
+                f"decode param {p.name} not shared from training"
+        src1 = rng.randint(2, V, (1, T)).astype(np.int64)
+        ids, scores = exe.run(
+            dec_main,
+            feed={"src_word_id": src1,
+                  "src_word_id@SEQ_LEN": np.full((1,), T, np.int32)},
+            fetch_list=[out_ids, out_scores])
+        ids = np.asarray(ids)
+        assert ids.size > 0 and (ids >= 0).all() and (ids < V).all()
+        assert np.isfinite(np.asarray(scores)).all()
