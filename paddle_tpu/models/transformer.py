@@ -184,3 +184,115 @@ def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
                 opt._set_checkpoints(ck)
             opt.minimize(avg_cost)
     return main, startup, avg_cost
+
+
+def build_greedy_decode_program(seq_len=16, max_out_len=16,
+                                d_model=64, n_heads=4, n_layers=2,
+                                d_inner=128, vocab=1000, start_id=0,
+                                end_id=1):
+    """Autoregressive greedy generation (reference
+    tests/unittests/dist_transformer.py:1498 fast_decode — its
+    while-op beam loop, at beam 1 — rebuilt as a lax.while_loop over
+    the full decoder at static shapes: each step re-runs the
+    causally-masked decoder on the [B, max_out_len] token buffer and
+    writes position t+1 by a one-hot mask; positions past t are
+    ignored by the causal mask, so no KV cache is needed for
+    correctness — incremental caching is a perf upgrade, not a
+    semantics change). Rows that emit end_id are frozen: every later
+    position holds end_id, like the reference's early-finish
+    handling.
+
+    Weight sharing with a training program relies on identical param
+    name sequences: build BOTH programs under the same
+    `fluid.unique_name.guard()` ordering (train first, then this).
+    Returns (program, startup, feeds, out_ids_var).
+    """
+    import paddle_tpu as fluid
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
+        enc = _embed(src, vocab, d_model, max(seq_len, max_out_len),
+                     0.0, True, "src_word_emb")
+        for _ in range(n_layers):
+            enc = encoder_layer(enc, d_model, n_heads, d_inner, 0.0,
+                                is_test=True)
+
+        # token buffer [B, maxT]: zeros, start token at position 0
+        positions = layers.cast(layers.range(0, max_out_len, 1),
+                                "int64")
+        tgt_buf = layers.fill_constant_batch_size_like(
+            src, [-1, max_out_len], "int64", 0.0)
+        if start_id:
+            start_col = layers.cast(
+                layers.equal(positions,
+                             layers.fill_constant([1], "int64", 0.0)),
+                "int64")
+            tgt_buf = layers.elementwise_add(
+                tgt_buf, layers.cast(
+                    layers.scale(start_col, scale=float(start_id)),
+                    "int64"))
+        tgt_buf = layers.assign(tgt_buf)
+        counter = layers.fill_constant([1], "int64", 0)
+        limit = layers.fill_constant([1], "int64",
+                                     float(max_out_len - 1))
+        finished = layers.assign(layers.fill_constant_batch_size_like(
+            src, [-1], "int64", 0.0))  # [B]: 1 once EOS emitted
+        cond = layers.less_than(counter, limit)
+        w = layers.While(cond)
+        with w.block():
+            dec = _embed(tgt_buf, vocab, d_model,
+                         max(seq_len, max_out_len), 0.0, True,
+                         "tgt_word_emb")
+            for _ in range(n_layers):
+                dec = decoder_layer(dec, enc, d_model, n_heads,
+                                    d_inner, 0.0, is_test=True)
+            # select step t's hidden row BEFORE the vocab projection:
+            # a [B,D]x[D,V] matmul instead of [B,maxT,D]x[D,V] —
+            # identical step_logits, maxT-fold cheaper hot path (the
+            # fc weight shape [D,V] is the same either way, so weight
+            # sharing with the training program is unaffected)
+            t_mask = layers.cast(layers.equal(positions, counter),
+                                 "float32")  # [maxT]
+            step_hidden = layers.reduce_sum(
+                layers.elementwise_mul(dec, layers.unsqueeze(
+                    t_mask, [1]), axis=1), dim=1)  # [B, D]
+            step_logits = layers.fc(step_hidden, vocab,
+                                    bias_attr=False)  # [B, V]
+            tok = layers.cast(layers.argmax(step_logits, axis=-1),
+                              "int64")  # [B]
+            # rows already finished keep emitting end_id (reference
+            # fast_decode freezes beams at EOS)
+            not_fin = layers.elementwise_sub(
+                layers.fill_constant_batch_size_like(
+                    src, [-1], "int64", 1.0), finished)
+            tok = layers.elementwise_add(
+                layers.elementwise_mul(tok, not_fin),
+                layers.cast(layers.scale(finished,
+                                         scale=float(end_id)),
+                            "int64"))
+            layers.assign(
+                layers.elementwise_max(
+                    finished,
+                    layers.cast(layers.equal(
+                        tok, layers.fill_constant(
+                            [1], "int64", float(end_id))), "int64")),
+                output=finished)
+            # write token at position t+1
+            next_mask = layers.cast(
+                layers.equal(positions,
+                             layers.increment(counter, 1,
+                                              in_place=False)),
+                "int64")  # [maxT]
+            keep = layers.elementwise_sub(
+                layers.fill_constant([max_out_len], "int64", 1.0),
+                next_mask)
+            new_buf = layers.elementwise_add(
+                layers.elementwise_mul(tgt_buf, keep),
+                layers.elementwise_mul(
+                    layers.unsqueeze(tok, [1]), next_mask))
+            layers.assign(new_buf, output=tgt_buf)
+            layers.increment(counter, 1)
+            layers.less_than(counter, limit, cond=cond)
+    return main, startup, ["src_ids"], tgt_buf
