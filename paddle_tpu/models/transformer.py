@@ -26,21 +26,27 @@ def _position_encoding(max_len, d_model):
     return enc
 
 
+def _attn_proj_attr(name, tag, d_model):
+    """Deterministic attention projection param (explicit Xavier fans:
+    the fused qkv shape would otherwise shrink the init scale ~29%).
+    Fully explicit names (no unique_name) make weight sharing between
+    train/decode/incremental-decode builds order-independent."""
+    return ParamAttr(
+        name=f"{name}_{tag}.w" if name else
+        unique_name.generate(f"attn_{tag}_proj.w"),
+        initializer=XavierInitializer(fan_in=d_model,
+                                      fan_out=d_model))
+
+
 def multi_head_attention(q_in, kv_in, d_model, n_heads, dropout_rate,
-                         causal=False, is_test=False):
+                         causal=False, is_test=False, name=None):
     head_dim = d_model // n_heads
+
     # fused projections: XLA does NOT merge separate dots over the
     # same operand, so 3 (or 2) [*,512]x[512,512] matmuls become one
-    # wider MXU-friendlier matmul, split after. Explicit Xavier fans
-    # keep the init scale identical to THREE separate [d,d]
-    # projections (the fused shape would otherwise shrink it ~29%),
-    # and explicit param names keep the checkpoint layout stable and
-    # mismatches detectable.
+    # wider MXU-friendlier matmul, split after.
     def _proj_attr(tag):
-        return ParamAttr(
-            name=unique_name.generate(f"attn_{tag}_proj.w"),
-            initializer=XavierInitializer(fan_in=d_model,
-                                          fan_out=d_model))
+        return _attn_proj_attr(name, tag, d_model)
 
     if q_in is kv_in:
         qkv = layers.fc(q_in, 3 * d_model, num_flatten_dims=2,
@@ -64,44 +70,66 @@ def multi_head_attention(q_in, kv_in, d_model, n_heads, dropout_rate,
                            dropout_rate=0.0 if is_test else dropout_rate,
                            layout="bthd")
     ctx = layers.reshape(ctx, [0, 0, d_model])
-    return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False)
+    return layers.fc(ctx, d_model, num_flatten_dims=2, bias_attr=False,
+                     param_attr=f"{name}_out.w" if name else None)
 
 
-def _ffn(x, d_model, d_inner, dropout_rate, is_test):
-    h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu")
+def _ffn(x, d_model, d_inner, dropout_rate, is_test, name=None):
+    h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu",
+                  param_attr=f"{name}_fc1.w" if name else None,
+                  bias_attr=f"{name}_fc1.b" if name else None)
     if dropout_rate and not is_test:
         h = layers.dropout(h, dropout_rate,
                            dropout_implementation="upscale_in_train")
-    return layers.fc(h, d_model, num_flatten_dims=2)
+    return layers.fc(h, d_model, num_flatten_dims=2,
+                     param_attr=f"{name}_fc2.w" if name else None,
+                     bias_attr=f"{name}_fc2.b" if name else None)
 
 
-def _add_norm(x, residual, dropout_rate, is_test):
+def _add_norm(x, residual, dropout_rate, is_test, name=None):
     if dropout_rate and not is_test:
         x = layers.dropout(x, dropout_rate,
                            dropout_implementation="upscale_in_train")
     return layers.layer_norm(layers.elementwise_add(x, residual),
-                             begin_norm_axis=2)
+                             begin_norm_axis=2,
+                             param_attr=f"{name}_ln.w" if name else
+                             None,
+                             bias_attr=f"{name}_ln.b" if name else
+                             None)
 
 
-def encoder_layer(x, d_model, n_heads, d_inner, dropout_rate, is_test):
+def encoder_layer(x, d_model, n_heads, d_inner, dropout_rate, is_test,
+                  name=None):
     attn = multi_head_attention(x, x, d_model, n_heads, dropout_rate,
-                                is_test=is_test)
-    x = _add_norm(attn, x, dropout_rate, is_test)
-    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
-    return _add_norm(ffn, x, dropout_rate, is_test)
+                                is_test=is_test,
+                                name=f"{name}_self" if name else None)
+    x = _add_norm(attn, x, dropout_rate, is_test,
+                  name=f"{name}_a" if name else None)
+    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test,
+               name=f"{name}" if name else None)
+    return _add_norm(ffn, x, dropout_rate, is_test,
+                     name=f"{name}_b" if name else None)
 
 
 def decoder_layer(x, enc_out, d_model, n_heads, d_inner, dropout_rate,
-                  is_test):
+                  is_test, name=None):
     self_attn = multi_head_attention(x, x, d_model, n_heads,
                                      dropout_rate, causal=True,
-                                     is_test=is_test)
-    x = _add_norm(self_attn, x, dropout_rate, is_test)
+                                     is_test=is_test,
+                                     name=f"{name}_self" if name
+                                     else None)
+    x = _add_norm(self_attn, x, dropout_rate, is_test,
+                  name=f"{name}_a" if name else None)
     cross = multi_head_attention(x, enc_out, d_model, n_heads,
-                                 dropout_rate, is_test=is_test)
-    x = _add_norm(cross, x, dropout_rate, is_test)
-    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test)
-    return _add_norm(ffn, x, dropout_rate, is_test)
+                                 dropout_rate, is_test=is_test,
+                                 name=f"{name}_cross" if name
+                                 else None)
+    x = _add_norm(cross, x, dropout_rate, is_test,
+                  name=f"{name}_b" if name else None)
+    ffn = _ffn(x, d_model, d_inner, dropout_rate, is_test,
+               name=f"{name}" if name else None)
+    return _add_norm(ffn, x, dropout_rate, is_test,
+                     name=f"{name}_c" if name else None)
 
 
 def _embed(ids, vocab_size, d_model, max_len, dropout_rate, is_test,
@@ -132,20 +160,20 @@ def transformer(src_ids, tgt_ids, label, src_vocab=30000, tgt_vocab=30000,
     ck = checkpoints
     enc = _embed(src_ids, src_vocab, d_model, max_len, dropout_rate,
                  is_test, "src_word_emb")
-    for _ in range(n_layers):
+    for li in range(n_layers):
         enc = encoder_layer(enc, d_model, n_heads, d_inner,
-                            dropout_rate, is_test)
+                            dropout_rate, is_test, name=f"enc{li}")
         if ck is not None:
             ck.append(enc)
     dec = _embed(tgt_ids, tgt_vocab, d_model, max_len, dropout_rate,
                  is_test, "tgt_word_emb")
-    for _ in range(n_layers):
+    for li in range(n_layers):
         dec = decoder_layer(dec, enc, d_model, n_heads, d_inner,
-                            dropout_rate, is_test)
+                            dropout_rate, is_test, name=f"dec{li}")
         if ck is not None:
             ck.append(dec)
     logits = layers.fc(dec, tgt_vocab, num_flatten_dims=2,
-                       bias_attr=False)
+                       bias_attr=False, param_attr="logits.w")
     # fused smoothing: same math as one_hot+label_smooth+soft-label CE
     # but never materializes the [B,T,V] one-hot (HBM-bound at 32k vocab)
     cost = layers.softmax_with_cross_entropy(
@@ -186,6 +214,61 @@ def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
     return main, startup, avg_cost
 
 
+def _init_token_buffer(src, positions, max_out_len, start_id):
+    """[B, maxT] int64 zeros with the start token at position 0 — the
+    loop-carried decode buffer both decode builders share."""
+    buf = layers.fill_constant_batch_size_like(
+        src, [-1, max_out_len], "int64", 0.0)
+    if start_id:
+        start_col = layers.cast(
+            layers.equal(positions,
+                         layers.fill_constant([1], "int64", 0.0)),
+            "int64")
+        buf = layers.elementwise_add(
+            buf, layers.cast(
+                layers.scale(start_col, scale=float(start_id)),
+                "int64"))
+    return layers.assign(buf)
+
+
+def _emit_token_step(src, step_logits, positions, tgt_buf, finished,
+                     counter, limit, cond, max_out_len, end_id):
+    """Shared decode-loop tail: greedy argmax, EOS freeze (finished
+    rows keep emitting end_id), one-hot write at position t+1, counter
+    bump, loop-condition refresh. Mutates tgt_buf/finished/counter/
+    cond in place — keep BOTH decode builders on this helper so their
+    token-for-token equivalence can't silently diverge."""
+    tok = layers.cast(layers.argmax(step_logits, axis=-1), "int64")
+    not_fin = layers.elementwise_sub(
+        layers.fill_constant_batch_size_like(
+            src, [-1], "int64", 1.0), finished)
+    tok = layers.elementwise_add(
+        layers.elementwise_mul(tok, not_fin),
+        layers.cast(layers.scale(finished, scale=float(end_id)),
+                    "int64"))
+    layers.assign(
+        layers.elementwise_max(
+            finished,
+            layers.cast(layers.equal(
+                tok, layers.fill_constant([1], "int64",
+                                          float(end_id))), "int64")),
+        output=finished)
+    next_mask = layers.cast(
+        layers.equal(positions,
+                     layers.increment(counter, 1, in_place=False)),
+        "int64")
+    keep = layers.elementwise_sub(
+        layers.fill_constant([max_out_len], "int64", 1.0), next_mask)
+    layers.assign(
+        layers.elementwise_add(
+            layers.elementwise_mul(tgt_buf, keep),
+            layers.elementwise_mul(layers.unsqueeze(tok, [1]),
+                                   next_mask)),
+        output=tgt_buf)
+    layers.increment(counter, 1)
+    layers.less_than(counter, limit, cond=cond)
+
+
 def build_greedy_decode_program(seq_len=16, max_out_len=16,
                                 d_model=64, n_heads=4, n_layers=2,
                                 d_inner=128, vocab=1000, start_id=0,
@@ -202,9 +285,9 @@ def build_greedy_decode_program(seq_len=16, max_out_len=16,
     position holds end_id, like the reference's early-finish
     handling.
 
-    Weight sharing with a training program relies on identical param
-    name sequences: build BOTH programs under the same
-    `fluid.unique_name.guard()` ordering (train first, then this).
+    Weight sharing with a training program is by EXPLICIT param name
+    (enc{i}_*/dec{i}_*/logits.w/…_word_emb) — build order and
+    unique_name state are irrelevant.
     Returns (program, startup, feeds, out_ids_var).
     """
     import paddle_tpu as fluid
@@ -215,25 +298,14 @@ def build_greedy_decode_program(seq_len=16, max_out_len=16,
         src = layers.data("src_ids", shape=[seq_len], dtype="int64")
         enc = _embed(src, vocab, d_model, max(seq_len, max_out_len),
                      0.0, True, "src_word_emb")
-        for _ in range(n_layers):
+        for li in range(n_layers):
             enc = encoder_layer(enc, d_model, n_heads, d_inner, 0.0,
-                                is_test=True)
+                                is_test=True, name=f"enc{li}")
 
-        # token buffer [B, maxT]: zeros, start token at position 0
         positions = layers.cast(layers.range(0, max_out_len, 1),
                                 "int64")
-        tgt_buf = layers.fill_constant_batch_size_like(
-            src, [-1, max_out_len], "int64", 0.0)
-        if start_id:
-            start_col = layers.cast(
-                layers.equal(positions,
-                             layers.fill_constant([1], "int64", 0.0)),
-                "int64")
-            tgt_buf = layers.elementwise_add(
-                tgt_buf, layers.cast(
-                    layers.scale(start_col, scale=float(start_id)),
-                    "int64"))
-        tgt_buf = layers.assign(tgt_buf)
+        tgt_buf = _init_token_buffer(src, positions, max_out_len,
+                                     start_id)
         counter = layers.fill_constant([1], "int64", 0)
         limit = layers.fill_constant([1], "int64",
                                      float(max_out_len - 1))
@@ -245,9 +317,10 @@ def build_greedy_decode_program(seq_len=16, max_out_len=16,
             dec = _embed(tgt_buf, vocab, d_model,
                          max(seq_len, max_out_len), 0.0, True,
                          "tgt_word_emb")
-            for _ in range(n_layers):
+            for li in range(n_layers):
                 dec = decoder_layer(dec, enc, d_model, n_heads,
-                                    d_inner, 0.0, is_test=True)
+                                    d_inner, 0.0, is_test=True,
+                                    name=f"dec{li}")
             # select step t's hidden row BEFORE the vocab projection:
             # a [B,D]x[D,V] matmul instead of [B,maxT,D]x[D,V] —
             # identical step_logits, maxT-fold cheaper hot path (the
@@ -259,40 +332,183 @@ def build_greedy_decode_program(seq_len=16, max_out_len=16,
                 layers.elementwise_mul(dec, layers.unsqueeze(
                     t_mask, [1]), axis=1), dim=1)  # [B, D]
             step_logits = layers.fc(step_hidden, vocab,
-                                    bias_attr=False)  # [B, V]
-            tok = layers.cast(layers.argmax(step_logits, axis=-1),
-                              "int64")  # [B]
-            # rows already finished keep emitting end_id (reference
-            # fast_decode freezes beams at EOS)
-            not_fin = layers.elementwise_sub(
-                layers.fill_constant_batch_size_like(
-                    src, [-1], "int64", 1.0), finished)
-            tok = layers.elementwise_add(
-                layers.elementwise_mul(tok, not_fin),
-                layers.cast(layers.scale(finished,
-                                         scale=float(end_id)),
-                            "int64"))
-            layers.assign(
-                layers.elementwise_max(
-                    finished,
-                    layers.cast(layers.equal(
-                        tok, layers.fill_constant(
-                            [1], "int64", float(end_id))), "int64")),
-                output=finished)
-            # write token at position t+1
-            next_mask = layers.cast(
-                layers.equal(positions,
-                             layers.increment(counter, 1,
-                                              in_place=False)),
-                "int64")  # [maxT]
-            keep = layers.elementwise_sub(
-                layers.fill_constant([max_out_len], "int64", 1.0),
-                next_mask)
-            new_buf = layers.elementwise_add(
-                layers.elementwise_mul(tgt_buf, keep),
+                                    bias_attr=False,
+                                    param_attr="logits.w")  # [B, V]
+            _emit_token_step(src, step_logits, positions, tgt_buf,
+                             finished, counter, limit, cond,
+                             max_out_len, end_id)
+    return main, startup, ["src_ids"], tgt_buf
+
+
+def build_incremental_decode_program(seq_len=16, max_out_len=16,
+                                     d_model=64, n_heads=4,
+                                     n_layers=2, d_inner=128,
+                                     vocab=1000, start_id=0,
+                                     end_id=1):
+    """KV-cached autoregressive greedy generation — the incremental
+    variant of build_greedy_decode_program (reference
+    tests/unittests/dist_transformer.py:1498 fast_decode caches
+    per-layer K/V the same way). Each step embeds ONE token, runs the
+    decoder stack on that single row against cached self-attention
+    K/V (written in place at position t) and precomputed
+    cross-attention K/V, so per-step cost is O(maxT) instead of
+    O(maxT^2) — token-for-token identical to the full-recompute
+    program (asserted in tests).
+
+    Weight sharing: the same explicit param names the training build
+    and build_greedy_decode_program use — order-independent.
+
+    Returns (program, startup, feeds, out_ids_var).
+    """
+    import paddle_tpu as fluid
+
+    head_dim = d_model // n_heads
+    scale = head_dim ** -0.5
+    maxT = max_out_len
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
+        enc = _embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
+                     True, "src_word_emb")
+        for li in range(n_layers):
+            enc = encoder_layer(enc, d_model, n_heads, d_inner, 0.0,
+                                is_test=True, name=f"enc{li}")
+
+        def _heads(x, t):  # [B,T,H*D] -> [B,H,T,D]
+            return layers.transpose(
+                layers.reshape(x, [0, t, n_heads, head_dim]),
+                perm=[0, 2, 1, 3])
+
+        # cross-attention K/V once per layer (explicitly named
+        # dec{li}_cross_kv.w, shared with the training build)
+        cross_kv = []
+        for li in range(n_layers):
+            kv = layers.fc(enc, 2 * d_model, num_flatten_dims=2,
+                           bias_attr=False,
+                           param_attr=_attn_proj_attr(
+                               f"dec{li}_cross", "kv", d_model))
+            k, v = layers.split(kv, 2, dim=2)
+            cross_kv.append((_heads(k, seq_len), _heads(v, seq_len)))
+
+        positions = layers.cast(layers.range(0, maxT, 1), "int64")
+        posf = layers.cast(positions, "float32")
+        pos_table = layers.assign(
+            _position_encoding(max(seq_len, maxT), d_model)[:maxT])
+
+        tgt_buf = _init_token_buffer(src, positions, maxT, start_id)
+        # per-layer self-attn caches [B,H,maxT,D]
+        caches = []
+        for li in range(n_layers):
+            kc = layers.assign(layers.fill_constant_batch_size_like(
+                src, [-1, n_heads, maxT, head_dim], "float32", 0.0))
+            vc = layers.assign(layers.fill_constant_batch_size_like(
+                src, [-1, n_heads, maxT, head_dim], "float32", 0.0))
+            caches.append((kc, vc))
+        counter = layers.fill_constant([1], "int64", 0)
+        limit = layers.fill_constant([1], "int64", float(maxT - 1))
+        finished = layers.assign(layers.fill_constant_batch_size_like(
+            src, [-1], "int64", 0.0))
+        cond = layers.less_than(counter, limit)
+        w = layers.While(cond)
+        with w.block():
+            # embed ONLY the current token
+            t_mask = layers.cast(layers.equal(positions, counter),
+                                 "float32")  # [maxT]
+            cur_tok = layers.reduce_sum(
+                layers.elementwise_mul(tgt_buf,
+                                       layers.cast(t_mask, "int64")),
+                dim=1, keep_dim=True)  # [B,1]
+            x = layers.embedding(cur_tok, size=[vocab, d_model],
+                                 param_attr=ParamAttr(
+                                     name="tgt_word_emb"))
+            # lookup_table squeezes the trailing 1 of [B,1] ids:
+            # restore the time axis for the [B,1,D] step row
+            x = layers.unsqueeze(x, [1])
+            x = layers.scale(x, scale=d_model ** 0.5)
+            pos_t = layers.reduce_sum(
                 layers.elementwise_mul(
-                    layers.unsqueeze(tok, [1]), next_mask))
-            layers.assign(new_buf, output=tgt_buf)
-            layers.increment(counter, 1)
-            layers.less_than(counter, limit, cond=cond)
+                    pos_table, layers.unsqueeze(t_mask, [1]), axis=0),
+                dim=0)  # [D]
+            x = layers.elementwise_add(x, pos_t)  # [B,1,D]
+
+            # attention validity: cached positions <= t
+            att_mask = layers.scale(
+                layers.cast(layers.greater_than(
+                    posf, layers.cast(counter, "float32")),
+                    "float32"), scale=-1e9)  # [maxT] 0 keep / -1e9 drop
+
+            for li in range(n_layers):
+                kc, vc = caches[li]
+                # --- cached causal self-attention (fused qkv) ---
+                qkv = layers.fc(
+                    x, 3 * d_model, num_flatten_dims=2,
+                    bias_attr=False,
+                    param_attr=_attn_proj_attr(
+                        f"dec{li}_self", "qkv", d_model))
+                q, k, v = layers.split(qkv, 3, dim=2)
+                qh = _heads(q, 1)              # [B,H,1,D]
+                kh = _heads(k, 1)
+                vh = _heads(v, 1)
+                # write k/v at cache position t (one-hot on axis 2)
+                m2 = layers.unsqueeze(t_mask, [1])  # [maxT,1]
+                keepc = layers.unsqueeze(
+                    layers.elementwise_sub(
+                        layers.fill_constant([maxT], "float32", 1.0),
+                        t_mask), [1])
+                new_kc = layers.elementwise_add(
+                    layers.elementwise_mul(kc, keepc, axis=2),
+                    layers.elementwise_mul(kh, m2, axis=2))
+                new_vc = layers.elementwise_add(
+                    layers.elementwise_mul(vc, keepc, axis=2),
+                    layers.elementwise_mul(vh, m2, axis=2))
+                layers.assign(new_kc, output=kc)
+                layers.assign(new_vc, output=vc)
+                scores = layers.scale(
+                    layers.matmul(qh, kc, transpose_y=True),
+                    scale=scale)  # [B,H,1,maxT]
+                scores = layers.elementwise_add(scores, att_mask)
+                probs = layers.softmax(scores, axis=-1)
+                ctx = layers.matmul(probs, vc)
+                ctx = layers.reshape(
+                    layers.transpose(ctx, perm=[0, 2, 1, 3]),
+                    [0, 1, d_model])  # [B,1,HD]
+                attn_out = layers.fc(ctx, d_model, num_flatten_dims=2,
+                                     bias_attr=False,
+                                     param_attr=f"dec{li}_self_out.w")
+                x = _add_norm(attn_out, x, 0.0, True,
+                              name=f"dec{li}_a")
+                # --- cross attention against precomputed enc K/V ---
+                q2 = layers.fc(
+                    x, d_model, num_flatten_dims=2, bias_attr=False,
+                    param_attr=_attn_proj_attr(
+                        f"dec{li}_cross", "q", d_model))
+                q2h = _heads(q2, 1)
+                ck, cv = cross_kv[li]
+                s2 = layers.scale(
+                    layers.matmul(q2h, ck, transpose_y=True),
+                    scale=scale)  # [B,H,1,S]
+                p2 = layers.softmax(s2, axis=-1)
+                ctx2 = layers.reshape(
+                    layers.transpose(layers.matmul(p2, cv),
+                                     perm=[0, 2, 1, 3]),
+                    [0, 1, d_model])
+                cross_out = layers.fc(
+                    ctx2, d_model, num_flatten_dims=2,
+                    bias_attr=False,
+                    param_attr=f"dec{li}_cross_out.w")
+                x = _add_norm(cross_out, x, 0.0, True,
+                              name=f"dec{li}_b")
+                # --- ffn ---
+                ffn = _ffn(x, d_model, d_inner, 0.0, True,
+                           name=f"dec{li}")
+                x = _add_norm(ffn, x, 0.0, True, name=f"dec{li}_c")
+
+            step_logits = layers.fc(
+                layers.reshape(x, [0, d_model]), vocab,
+                bias_attr=False, param_attr="logits.w")  # [B,V]
+            _emit_token_step(src, step_logits, positions, tgt_buf,
+                             finished, counter, limit, cond, maxT,
+                             end_id)
     return main, startup, ["src_ids"], tgt_buf

@@ -477,3 +477,53 @@ class TestTransformerGreedyDecode:
         np.testing.assert_array_equal(ids[0, 1:5], src[0])
         # EOS freeze: everything after the emitted end_id stays end_id
         np.testing.assert_array_equal(ids[0, 5:], [1, 1])
+
+
+class TestTransformerIncrementalDecode:
+    """KV-cached incremental decode must be token-for-token identical
+    to the full-recompute greedy decode on the same trained weights."""
+
+    def test_incremental_matches_full(self):
+        from paddle_tpu import unique_name
+        from paddle_tpu.models import transformer as T
+
+        V, D, L, S = 12, 16, 2, 4
+        with unique_name.guard():
+            main, startup, loss = T.build_program(
+                seq_len=S, d_model=D, n_heads=2, n_layers=L,
+                d_inner=32, vocab=V, with_optimizer=False,
+                dropout_rate=0.0)
+            with fluid.program_guard(main, startup):
+                fluid.optimizer.Adam(learning_rate=0.02).minimize(
+                    loss)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        for _ in range(30):
+            src = rng.randint(3, V, (4, S)).astype(np.int64)
+            tgt_in = np.concatenate(
+                [np.full((4, 1), 2, np.int64), src[:, :-1]], 1)
+            exe.run(main, feed={"src_ids": src, "tgt_ids": tgt_in,
+                                "label": src}, fetch_list=[loss])
+
+        kwargs = dict(seq_len=S, max_out_len=S + 3, d_model=D,
+                      n_heads=2, n_layers=L, d_inner=32, vocab=V,
+                      start_id=2, end_id=1)
+        with unique_name.guard():
+            full_m, _, _, full_buf = T.build_greedy_decode_program(
+                **kwargs)
+        with unique_name.guard():
+            inc_m, _, _, inc_buf = \
+                T.build_incremental_decode_program(**kwargs)
+        scope = fluid.global_scope()
+        missing = [p.name for p in inc_m.all_parameters()
+                   if scope._get(p.name) is None]
+        assert not missing, f"cache-decode params not shared: " \
+            f"{missing}"
+        src_t = rng.randint(3, V, (2, S)).astype(np.int64)
+        full_ids, = exe.run(full_m, feed={"src_ids": src_t},
+                            fetch_list=[full_buf])
+        inc_ids, = exe.run(inc_m, feed={"src_ids": src_t},
+                           fetch_list=[inc_buf])
+        np.testing.assert_array_equal(np.asarray(inc_ids),
+                                      np.asarray(full_ids))
