@@ -527,3 +527,56 @@ class TestTransformerIncrementalDecode:
                            fetch_list=[inc_buf])
         np.testing.assert_array_equal(np.asarray(inc_ids),
                                       np.asarray(full_ids))
+
+
+def test_generation_exports_to_stablehlo(tmp_path):
+    """The While-loop generation program round-trips through
+    save_inference_model -> StableHLO export -> python-free serving
+    (the reference's C++ inference-deploy capability, for GENERATION)."""
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.inference.export import (export_stablehlo,
+                                             load_stablehlo)
+
+    V, D, L, S = 12, 16, 1, 4
+    main, startup, loss = T.build_program(
+        seq_len=S, d_model=D, n_heads=2, n_layers=L, d_inner=32,
+        vocab=V, with_optimizer=False, dropout_rate=0.0)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    src = np.array([[4, 7, 9, 1]], np.int64)
+    tgt_in = np.array([[2, 4, 7, 9]], np.int64)
+    for _ in range(40):
+        exe.run(main, feed={"src_ids": src, "tgt_ids": tgt_in,
+                            "label": src}, fetch_list=[loss])
+    dm, _, feeds, buf = T.build_greedy_decode_program(
+        seq_len=S, max_out_len=S + 2, d_model=D, n_heads=2,
+        n_layers=L, d_inner=32, vocab=V, start_id=2, end_id=1)
+    direct, = exe.run(dm, feed={"src_ids": src}, fetch_list=[buf])
+
+    mdir = str(tmp_path / "gen_model")
+    fluid.io.save_inference_model(
+        mdir, ["src_ids"],
+        [dm.global_block.var(buf.name)], exe, main_program=dm)
+    art = str(tmp_path / "gen.stablehlo")
+    export_stablehlo(mdir, {"src_ids": src}, art)
+    server = load_stablehlo(art)
+    served = server({"src_ids": src})[0]
+    np.testing.assert_array_equal(np.asarray(served),
+                                  np.asarray(direct))
+
+    # the KV-cached incremental program must export too (its While
+    # loop carries in-place cache writes)
+    im, _, _, ibuf = T.build_incremental_decode_program(
+        seq_len=S, max_out_len=S + 2, d_model=D, n_heads=2,
+        n_layers=L, d_inner=32, vocab=V, start_id=2, end_id=1)
+    mdir2 = str(tmp_path / "gen_model_inc")
+    fluid.io.save_inference_model(
+        mdir2, ["src_ids"],
+        [im.global_block.var(ibuf.name)], exe, main_program=im)
+    art2 = str(tmp_path / "gen_inc.stablehlo")
+    export_stablehlo(mdir2, {"src_ids": src}, art2)
+    served2 = load_stablehlo(art2)({"src_ids": src})[0]
+    np.testing.assert_array_equal(np.asarray(served2),
+                                  np.asarray(direct))
