@@ -104,7 +104,8 @@ def compose(*readers, check_alignment=True):
                 if stopped == len(its):
                     return
                 if stopped:
-                    raise RuntimeError("readers have different lengths")
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
                 yield sum((make_tuple(i) for i in items), ())
         else:
             for items in itertools.zip_longest(*its):
@@ -273,3 +274,94 @@ def batch(reader, batch_size: int, drop_last=False):
             yield b
 
     return batch_reader
+
+
+class ComposeNotAligned(ValueError):
+    """reference python/paddle/reader/decorator.py:145 — raised by
+    compose(check_alignment=True) on ragged readers."""
+
+
+class PipeReader:
+    """reference python/paddle/reader/decorator.py:460 — stream lines
+    from a shell command's stdout (e.g. `hadoop fs -cat`, zcat)."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("pipe command must be a string")
+        self.command = command
+        self.bufsize = bufsize
+        if file_type not in ("plain", "gzip"):
+            raise TypeError("file_type must be plain or gzip")
+        self.file_type = file_type
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import codecs
+        import subprocess
+
+        proc = subprocess.Popen(self.command.split(),
+                                stdout=subprocess.PIPE)
+        out = proc.stdout
+        if self.file_type == "gzip":
+            import zlib
+
+            decomp = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        # incremental decode: a multibyte char split across bufsize
+        # reads must not become U+FFFD garbage
+        decoder = codecs.getincrementaldecoder("utf8")("replace")
+        remained = ""
+        while True:
+            buf = out.read(self.bufsize)
+            if not buf:
+                break
+            if self.file_type == "gzip":
+                raw = decomp.decompress(buf)
+                # concatenated gzip members (hadoop part files,
+                # `cat a.gz b.gz`): restart on each member boundary
+                # instead of silently dropping the rest
+                while decomp.unused_data:
+                    rest = decomp.unused_data
+                    decomp = zlib.decompressobj(32 + zlib.MAX_WBITS)
+                    raw += decomp.decompress(rest)
+            else:
+                raw = buf
+            data = decoder.decode(raw)
+            if cut_lines:
+                lines = (remained + data).split(line_break)
+                remained = lines.pop()
+                yield from lines
+            else:
+                yield data
+        remained += decoder.decode(b"", final=True)
+        if remained:
+            yield remained
+        if proc.wait() != 0:
+            raise IOError(
+                f"pipe command {self.command!r} exited with status "
+                f"{proc.returncode}")
+
+
+class Fake:
+    """reference python/paddle/reader/decorator.py:531 — cache the
+    first item of a reader and replay it data_num times (speed-test
+    harness reader)."""
+
+    def __init__(self):
+        self.data = None
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                try:
+                    self.data = next(reader())
+                except StopIteration:
+                    raise ValueError(
+                        "Fake needs a non-empty source reader")
+            # count locally: a partially-consumed or concurrent
+            # iterator must not shorten later passes
+            for _ in range(data_num):
+                yield self.data
+
+        return fake_reader
+
+
+__all__.extend(["ComposeNotAligned", "PipeReader", "Fake"])

@@ -189,7 +189,7 @@ class TestReaderDecorators:
         assert list(r()) == list(range(5))
 
     def test_compose_off_by_one_detected(self):
-        with pytest.raises(RuntimeError):
+        with pytest.raises(readers.ComposeNotAligned):
             list(readers.compose(self._range_reader(4),
                                  self._range_reader(3))())
 
@@ -263,3 +263,66 @@ def test_mq2007_formats():
     assert a.shape == b.shape == (mq2007.FEATURE_DIM,)
     labels, feats = next(iter(mq2007.train("listwise")()))
     assert len(labels) == len(feats)
+
+
+def test_reader_decorator_parity_extras():
+    """ComposeNotAligned / PipeReader / Fake (reference
+    python/paddle/reader/decorator.py:145,460,531)."""
+    import pytest
+
+    from paddle_tpu import readers
+
+    def r3():
+        yield from range(3)
+
+    def r4():
+        yield from range(4)
+
+    with pytest.raises(readers.ComposeNotAligned):
+        list(readers.compose(r3, r4)())
+    # Fake: caches first item, replays it data_num times
+    fake = readers.Fake()(r3, 5)
+    assert list(fake()) == [0] * 5
+    assert list(fake()) == [0] * 5  # resets after a full pass
+    # PipeReader: stream a real command's stdout
+    pr = readers.PipeReader("printf a\\nb\\nc\\n")
+    lines = list(pr.get_line())
+    assert lines == ["a", "b", "c"]
+    with pytest.raises(TypeError):
+        readers.PipeReader(["not", "a", "string"])
+
+
+def test_reader_decorator_review_regressions(tmp_path):
+    import gzip
+    import os
+
+    import pytest
+
+    from paddle_tpu import readers
+
+    # multi-member gzip: both members' lines come through
+    p1 = os.path.join(str(tmp_path), "a.gz")
+    with open(p1, "wb") as f:
+        f.write(gzip.compress(b"one\ntwo\n") +
+                gzip.compress(b"three\nfour\n"))
+    pr = readers.PipeReader(f"cat {p1}", file_type="gzip")
+    assert list(pr.get_line()) == ["one", "two", "three", "four"]
+    # multibyte char split across the buffer boundary survives
+    p2 = os.path.join(str(tmp_path), "utf.txt")
+    payload = ("x" * 8191 + "é\n").encode("utf8")  # é straddles 8192
+    open(p2, "wb").write(payload)
+    lines = list(readers.PipeReader(f"cat {p2}").get_line())
+    assert lines == ["x" * 8191 + "é"]
+    # failing command raises instead of looking like an empty dataset
+    with pytest.raises(IOError):
+        list(readers.PipeReader("cat /nonexistent-xyz").get_line())
+    # Fake: partial consumption must not shorten later passes
+    def r3():
+        yield from range(3)
+    fake = readers.Fake()(r3, 5)
+    it = fake()
+    next(it); next(it)
+    del it
+    assert len(list(fake())) == 5
+    with pytest.raises(ValueError):
+        list(readers.Fake()(lambda: iter(()), 5)())
