@@ -214,6 +214,18 @@ def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
     return main, startup, avg_cost
 
 
+def _step_logits(dec, positions, counter, vocab):
+    """Select step t's hidden row BEFORE the vocab projection: a
+    [rows,D]x[D,V] matmul instead of [rows,maxT,D]x[D,V] — identical
+    logits, maxT-fold cheaper (shared by all decode builders)."""
+    t_mask = layers.cast(layers.equal(positions, counter), "float32")
+    step_hidden = layers.reduce_sum(
+        layers.elementwise_mul(dec, layers.unsqueeze(t_mask, [1]),
+                               axis=1), dim=1)
+    return layers.fc(step_hidden, vocab, bias_attr=False,
+                     param_attr="logits.w")
+
+
 def _init_token_buffer(src, positions, max_out_len, start_id):
     """[B, maxT] int64 zeros with the start token at position 0 — the
     loop-carried decode buffer both decode builders share."""
@@ -321,19 +333,8 @@ def build_greedy_decode_program(seq_len=16, max_out_len=16,
                 dec = decoder_layer(dec, enc, d_model, n_heads,
                                     d_inner, 0.0, is_test=True,
                                     name=f"dec{li}")
-            # select step t's hidden row BEFORE the vocab projection:
-            # a [B,D]x[D,V] matmul instead of [B,maxT,D]x[D,V] —
-            # identical step_logits, maxT-fold cheaper hot path (the
-            # fc weight shape [D,V] is the same either way, so weight
-            # sharing with the training program is unaffected)
-            t_mask = layers.cast(layers.equal(positions, counter),
-                                 "float32")  # [maxT]
-            step_hidden = layers.reduce_sum(
-                layers.elementwise_mul(dec, layers.unsqueeze(
-                    t_mask, [1]), axis=1), dim=1)  # [B, D]
-            step_logits = layers.fc(step_hidden, vocab,
-                                    bias_attr=False,
-                                    param_attr="logits.w")  # [B, V]
+            step_logits = _step_logits(dec, positions, counter,
+                                       vocab)  # [B, V]
             _emit_token_step(src, step_logits, positions, tgt_buf,
                              finished, counter, limit, cond,
                              max_out_len, end_id)
@@ -512,3 +513,126 @@ def build_incremental_decode_program(seq_len=16, max_out_len=16,
                              finished, counter, limit, cond, maxT,
                              end_id)
     return main, startup, ["src_ids"], tgt_buf
+
+
+def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
+                              n_heads=4, n_layers=2, d_inner=128,
+                              vocab=1000, start_id=0, end_id=1,
+                              beam_size=4):
+    """Beam-search generation for ONE source sequence (reference
+    tests/unittests/dist_transformer.py:1523 beam_search inside
+    fast_decode). The beam rides the batch axis at static
+    [beam_size, maxT] shapes: every step runs the causally-masked
+    decoder over all beams, expands with the beam_search op
+    (accumulated log-probs, EOS freezing), reorders each beam's token
+    history by parent_idx, and backtracks with beam_search_decode.
+
+    Weight sharing: the explicit enc{i}_*/dec{i}_*/logits.w names.
+    Returns (program, startup, feeds, (sentence_ids [T, beam],
+    sentence_scores [beam])).
+    """
+    import paddle_tpu as fluid
+
+    maxT = max_out_len
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        # single-source beam decode at static shapes: batch pinned
+        # to 1 so build-time probes agree with the concrete
+        # [beam, ...] vars downstream
+        src = layers.data("src_ids", shape=[1, seq_len],
+                          dtype="int64", append_batch_size=False)
+        enc1 = _embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
+                      True, "src_word_emb")
+        for li in range(n_layers):
+            enc1 = encoder_layer(enc1, d_model, n_heads, d_inner, 0.0,
+                                 is_test=True, name=f"enc{li}")
+        # replicate the (single) source encoding across the beam axis
+        enc = layers.expand(enc1, [beam_size, 1, 1])
+
+        positions = layers.cast(layers.range(0, maxT, 1), "int64")
+        # per-beam token history [beam, maxT] with GO at position 0
+        tgt_buf = layers.assign(layers.fill_constant(
+            [beam_size, maxT], "int64", 0.0))
+        if start_id:
+            start_col = layers.cast(
+                layers.equal(positions,
+                             layers.fill_constant([1], "int64", 0.0)),
+                "int64")
+            tgt_buf = layers.assign(layers.elementwise_add(
+                tgt_buf, layers.cast(
+                    layers.scale(start_col, scale=float(start_id)),
+                    "int64")))
+        pre_ids = layers.assign(layers.fill_constant(
+            [beam_size, 1], "int64", float(start_id)))
+        pre_scores = layers.assign(layers.fill_constant(
+            [beam_size, 1], "float32", 0.0))
+        # step buffers for the backtrack [maxT, beam, 1]
+        ids_buf = layers.assign(layers.fill_constant(
+            [maxT, beam_size, 1], "int64", float(end_id)))
+        scores_buf = layers.assign(layers.fill_constant(
+            [maxT, beam_size, 1], "float32", 0.0))
+        parents_buf = layers.assign(layers.fill_constant(
+            [maxT, beam_size, 1], "int64", 0.0))
+        zero = layers.fill_constant([1], "int64", 0)
+        ids_buf = layers.assign(layers.scatter(
+            ids_buf, zero, layers.reshape(pre_ids,
+                                          [1, beam_size, 1])))
+
+        counter = layers.fill_constant([1], "int64", 0)
+        limit = layers.fill_constant([1], "int64", float(maxT - 1))
+        cond = layers.less_than(counter, limit)
+        w = layers.While(cond)
+        with w.block():
+            dec = _embed(tgt_buf, vocab, d_model, max(seq_len, maxT),
+                         0.0, True, "tgt_word_emb")
+            for li in range(n_layers):
+                dec = decoder_layer(dec, enc, d_model, n_heads,
+                                    d_inner, 0.0, is_test=True,
+                                    name=f"dec{li}")
+            step_logits = _step_logits(dec, positions, counter,
+                                       vocab)  # [beam, V]
+            probs = layers.softmax(step_logits)  # [beam, V]
+            topk_scores, topk_ids = layers.topk(
+                probs, min(2 * beam_size, vocab))
+            acc = layers.elementwise_add(layers.log(topk_scores),
+                                         pre_scores)
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, topk_ids, acc,
+                beam_size=beam_size, end_id=end_id,
+                return_parent_idx=True)
+            parent_flat = layers.reshape(parent, shape=[beam_size])
+            # each surviving hypothesis inherits its parent's history
+            layers.assign(layers.gather(tgt_buf, parent_flat),
+                          output=tgt_buf)
+            layers.increment(counter, 1)
+            next_mask = layers.cast(layers.equal(positions, counter),
+                                    "int64")
+            keep = layers.elementwise_sub(
+                layers.fill_constant([maxT], "int64", 1.0), next_mask)
+            layers.assign(layers.elementwise_add(
+                layers.elementwise_mul(tgt_buf, keep),
+                layers.elementwise_mul(
+                    layers.reshape(sel_ids, [beam_size, 1]),
+                    next_mask)), output=tgt_buf)
+            layers.assign(layers.scatter(
+                ids_buf, counter,
+                layers.reshape(sel_ids, [1, beam_size, 1])),
+                output=ids_buf)
+            layers.assign(layers.scatter(
+                scores_buf, counter,
+                layers.reshape(sel_scores, [1, beam_size, 1])),
+                output=scores_buf)
+            layers.assign(layers.scatter(
+                parents_buf, counter,
+                layers.reshape(parent, [1, beam_size, 1])),
+                output=parents_buf)
+            layers.assign(layers.reshape(sel_ids, [beam_size, 1]),
+                          output=pre_ids)
+            layers.assign(layers.reshape(sel_scores, [beam_size, 1]),
+                          output=pre_scores)
+            layers.less_than(counter, limit, cond=cond)
+        out_ids, out_scores = layers.beam_search_decode(
+            ids_buf, scores_buf, beam_size=beam_size, end_id=end_id,
+            parents=parents_buf)
+    return main, startup, ["src_ids"], (out_ids, out_scores)

@@ -41,13 +41,24 @@ def beam_search(ctx):
     scores = ctx.input("scores")
     beam = int(ctx.attr("beam_size"))
     end_id = int(ctx.attr("end_id", 0))
+    # reference beam_search_op.cc is_accumulated semantics: True (the
+    # layer default) means `scores` ALREADY hold the full accumulated
+    # log-prob per candidate; False means `scores` are raw per-step
+    # probabilities and the op accumulates pre + log(p) itself.
+    # (Previously this attr was ignored and pre_scores always added —
+    # double-counting history for every accumulated-score caller.)
+    is_accumulated = bool(ctx.attr("is_accumulated", True))
 
     rows = ids.shape[0]
     k = ids.shape[1]
     b = rows // beam
     finished = (pre_ids.reshape(rows) == end_id)
 
-    total = pre_scores.reshape(rows, 1) + scores  # [rows, K]
+    if is_accumulated:
+        total = scores  # [rows, K]
+    else:
+        total = pre_scores.reshape(rows, 1) + \
+            jnp.log(jnp.maximum(scores, 1e-30))
     neg = jnp.finfo(total.dtype).min
     # frozen beams: candidate 0 = end_id @ pre_score, others impossible
     frozen_scores = jnp.concatenate(

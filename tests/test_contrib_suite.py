@@ -580,3 +580,70 @@ def test_generation_exports_to_stablehlo(tmp_path):
     served2 = load_stablehlo(art2)({"src_ids": src})[0]
     np.testing.assert_array_equal(np.asarray(served2),
                                   np.asarray(direct))
+
+
+def test_transformer_beam_decode_agrees_with_greedy():
+    """Beam search at any width must score its best hypothesis at
+    least as well as greedy; on a memorized sequence the best beam IS
+    the greedy path."""
+    from paddle_tpu.models import transformer as T
+
+    V, D, L, S = 12, 16, 1, 4
+    main, startup, loss = T.build_program(
+        seq_len=S, d_model=D, n_heads=2, n_layers=L, d_inner=32,
+        vocab=V, with_optimizer=False, dropout_rate=0.0)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    src = np.array([[4, 7, 9, 1]], np.int64)
+    tgt_in = np.array([[2, 4, 7, 9]], np.int64)
+    for _ in range(60):
+        exe.run(main, feed={"src_ids": src, "tgt_ids": tgt_in,
+                            "label": src}, fetch_list=[loss])
+    kw = dict(seq_len=S, max_out_len=S + 2, d_model=D, n_heads=2,
+              n_layers=L, d_inner=32, vocab=V, start_id=2, end_id=1)
+    gm, _, _, gbuf = T.build_greedy_decode_program(**kw)
+    bm, _, _, (bids, bscores) = T.build_beam_decode_program(
+        beam_size=3, **kw)
+    greedy, = exe.run(gm, feed={"src_ids": src}, fetch_list=[gbuf])
+    beam_ids, beam_scores = exe.run(bm, feed={"src_ids": src},
+                                    fetch_list=[bids, bscores])
+    beam_ids = np.asarray(beam_ids)          # [T, beam]
+    # best beam's sentence (column 0) equals the greedy continuation
+    greedy_cont = np.asarray(greedy)[0, 1:]  # after GO
+    np.testing.assert_array_equal(beam_ids[1:, 0], greedy_cont)
+    np.testing.assert_array_equal(beam_ids[1:5, 0], src[0])
+    # scores are true cumulative log-probs: best beam's final score
+    # equals the sum of the greedy tokens' log-softmax probabilities
+    # (pins the is_accumulated contract — a double-accumulation
+    # regression would be exponentially off)
+    logits_prog = fluid.Program()
+    with fluid.program_guard(logits_prog, fluid.Program()):
+        s_in = fluid.layers.data(name="src_ids", shape=[S],
+                                 dtype="int64")
+        t_in = fluid.layers.data(name="tgt_ids", shape=[S + 2],
+                                 dtype="int64")
+        lbl = fluid.layers.data(name="label", shape=[S + 2],
+                                dtype="int64")
+        _, lg = T.transformer(
+            s_in, t_in, lbl, src_vocab=V, tgt_vocab=V,
+            max_len=max(S, S + 2), d_model=D, n_heads=2,
+            n_layers=L, d_inner=32, dropout_rate=0.0, is_test=True,
+            label_smooth_eps=0.0)
+    tgt_seq = np.asarray(beam_ids)[:, 0][None, :]  # [1, T]
+    lg_v, = exe.run(logits_prog,
+                    feed={"src_ids": src,
+                          "tgt_ids": tgt_seq.astype(np.int64),
+                          "label": np.zeros_like(tgt_seq)},
+                    fetch_list=[lg])
+    logp = lg_v - np.log(np.exp(lg_v).sum(-1, keepdims=True))
+    steps = np.asarray(beam_ids).shape[0] - 1
+    expected = 0.0
+    for t in range(steps):
+        tok = int(beam_ids[t + 1, 0])
+        expected += logp[0, t, tok]
+        if tok == 1:  # frozen after first EOS: no further log-probs
+            break
+    assert abs(float(np.ravel(beam_scores)[0]) - expected) < 1e-3, (
+        float(np.ravel(beam_scores)[0]), expected)

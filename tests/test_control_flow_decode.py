@@ -107,13 +107,15 @@ class TestBeamSearch:
                                  dtype="float32", append_batch_size=False)
             s_ids, s_scores, parent = layers.beam_search(
                 pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0,
-                return_parent_idx=True)
+                is_accumulated=False, return_parent_idx=True)
         feed = {
             "pre_ids": np.array([[1], [2]], dtype="int64"),
             "pre_scores": np.array([[-1.0], [-2.0]], dtype="float32"),
             "ids": np.array([[3, 4, 2], [4, 2, 1]], dtype="int64"),
-            "scores": np.log(np.array([[0.6, 0.3, 0.1],
-                                       [0.5, 0.3, 0.2]], "float32")),
+            # raw per-step probabilities: the op accumulates
+            # pre + log(p) itself under is_accumulated=False
+            "scores": np.array([[0.6, 0.3, 0.1],
+                                [0.5, 0.3, 0.2]], "float32"),
         }
         si, ss, pi = _run(main, startup, feed, [s_ids, s_scores, parent])
         # candidates: beam0: -1+log(.6/.3/.1); beam1: -2+log(.5/.3/.2)
@@ -137,12 +139,14 @@ class TestBeamSearch:
             scores = layers.data(name="scores", shape=[2, 2],
                                  dtype="float32", append_batch_size=False)
             s_ids, s_scores = layers.beam_search(
-                pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0)
+                pre_ids, pre_scores, ids, scores, beam_size=2, end_id=0,
+                is_accumulated=False)
         feed = {
             "pre_ids": np.array([[0], [2]], dtype="int64"),  # beam0 done
             "pre_scores": np.array([[-0.5], [-3.0]], dtype="float32"),
             "ids": np.array([[3, 4], [4, 2]], dtype="int64"),
-            "scores": np.array([[-0.1, -0.2], [-0.4, -0.9]], "float32"),
+            "scores": np.exp(np.array([[-0.1, -0.2],
+                                       [-0.4, -0.9]], "float32")),
         }
         si, ss = _run(main, startup, feed, [s_ids, s_scores])
         # finished beam keeps end_id at unchanged score -0.5 (best)
