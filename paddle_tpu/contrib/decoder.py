@@ -289,8 +289,17 @@ class BeamSearchDecoder:
 
         # persistent loop state: current ids/scores + cell states as
         # outer vars mutated in the While body
+        import numpy as _np
+
         pre_ids = layers.assign(self._init_ids)          # [beam, 1]
-        pre_scores = layers.assign(self._init_scores)    # [beam, 1]
+        # seed ONE live beam (reference single-seed LoD): equal init
+        # scores would collapse the search into beam_size copies of
+        # the greedy path (rows never diverge)
+        seed_mask = layers.assign(_np.where(
+            _np.arange(beam) == 0, 0.0,
+            -1e9).astype("float32").reshape(beam, 1))
+        pre_scores = layers.assign(layers.elementwise_add(
+            self._init_scores, seed_mask))   # [beam, 1]
         state_vars = {}
         for sname, st in cell._init_states.items():
             state_vars[sname] = layers.assign(st.value)

@@ -518,42 +518,48 @@ def build_incremental_decode_program(seq_len=16, max_out_len=16,
 def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
                               n_heads=4, n_layers=2, d_inner=128,
                               vocab=1000, start_id=0, end_id=1,
-                              beam_size=4):
-    """Beam-search generation for ONE source sequence (reference
+                              beam_size=4, batch_size=1):
+    """Batched beam-search generation (reference
     tests/unittests/dist_transformer.py:1523 beam_search inside
-    fast_decode). The beam rides the batch axis at static
-    [beam_size, maxT] shapes: every step runs the causally-masked
-    decoder over all beams, expands with the beam_search op
-    (accumulated log-probs, EOS freezing), reorders each beam's token
-    history by parent_idx, and backtracks with beam_search_decode.
+    fast_decode). Beams ride the batch axis at static
+    [batch*beam, maxT] shapes (batch-major blocks of beam rows, the
+    beam_search op's row layout): every step runs the causally-masked
+    decoder over all rows, expands per-source with the beam_search op
+    (accumulated log-probs, EOS freezing), reorders each hypothesis'
+    token history by absolute parent_idx, and backtracks with
+    beam_search_decode.
 
     Weight sharing: the explicit enc{i}_*/dec{i}_*/logits.w names.
-    Returns (program, startup, feeds, (sentence_ids [T, beam],
-    sentence_scores [beam])).
+    Returns (program, startup, feeds, (sentence_ids
+    [T, batch*beam], sentence_scores [batch*beam])).
     """
     import paddle_tpu as fluid
 
     maxT = max_out_len
+    rows = batch_size * beam_size
     main = fluid.Program()
     startup = fluid.Program()
     with fluid.program_guard(main, startup):
-        # single-source beam decode at static shapes: batch pinned
-        # to 1 so build-time probes agree with the concrete
-        # [beam, ...] vars downstream
-        src = layers.data("src_ids", shape=[1, seq_len],
+        # static-batch program so build-time probes agree with the
+        # concrete [rows, ...] vars downstream
+        src = layers.data("src_ids", shape=[batch_size, seq_len],
                           dtype="int64", append_batch_size=False)
         enc1 = _embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
                       True, "src_word_emb")
         for li in range(n_layers):
             enc1 = encoder_layer(enc1, d_model, n_heads, d_inner, 0.0,
                                  is_test=True, name=f"enc{li}")
-        # replicate the (single) source encoding across the beam axis
-        enc = layers.expand(enc1, [beam_size, 1, 1])
+        # repeat each source's encoding beam_size times consecutively
+        # ([B,S,D] -> [B,beam,S,D] -> [B*beam,S,D], batch-major rows)
+        enc = layers.reshape(
+            layers.expand(layers.unsqueeze(enc1, [1]),
+                          [1, beam_size, 1, 1]),
+            [rows, seq_len, d_model])
 
         positions = layers.cast(layers.range(0, maxT, 1), "int64")
-        # per-beam token history [beam, maxT] with GO at position 0
+        # per-hypothesis token history [rows, maxT], GO at position 0
         tgt_buf = layers.assign(layers.fill_constant(
-            [beam_size, maxT], "int64", 0.0))
+            [rows, maxT], "int64", 0.0))
         if start_id:
             start_col = layers.cast(
                 layers.equal(positions,
@@ -564,20 +570,24 @@ def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
                     layers.scale(start_col, scale=float(start_id)),
                     "int64")))
         pre_ids = layers.assign(layers.fill_constant(
-            [beam_size, 1], "int64", float(start_id)))
-        pre_scores = layers.assign(layers.fill_constant(
-            [beam_size, 1], "float32", 0.0))
-        # step buffers for the backtrack [maxT, beam, 1]
+            [rows, 1], "int64", float(start_id)))
+        # ONE live beam per source at step 0 (the reference's LoD
+        # single-seed): identical rows with equal scores would make
+        # per-block top-k pick beam_size copies of the same argmax and
+        # the beams would never diverge (degenerate greedy)
+        pre_scores = layers.assign(np.where(
+            np.arange(rows) % beam_size == 0, 0.0,
+            -1e9).astype("float32").reshape(rows, 1))
+        # step buffers for the backtrack [maxT, rows, 1]
         ids_buf = layers.assign(layers.fill_constant(
-            [maxT, beam_size, 1], "int64", float(end_id)))
+            [maxT, rows, 1], "int64", float(end_id)))
         scores_buf = layers.assign(layers.fill_constant(
-            [maxT, beam_size, 1], "float32", 0.0))
+            [maxT, rows, 1], "float32", 0.0))
         parents_buf = layers.assign(layers.fill_constant(
-            [maxT, beam_size, 1], "int64", 0.0))
+            [maxT, rows, 1], "int64", 0.0))
         zero = layers.fill_constant([1], "int64", 0)
         ids_buf = layers.assign(layers.scatter(
-            ids_buf, zero, layers.reshape(pre_ids,
-                                          [1, beam_size, 1])))
+            ids_buf, zero, layers.reshape(pre_ids, [1, rows, 1])))
 
         counter = layers.fill_constant([1], "int64", 0)
         limit = layers.fill_constant([1], "int64", float(maxT - 1))
@@ -591,8 +601,8 @@ def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
                                     d_inner, 0.0, is_test=True,
                                     name=f"dec{li}")
             step_logits = _step_logits(dec, positions, counter,
-                                       vocab)  # [beam, V]
-            probs = layers.softmax(step_logits)  # [beam, V]
+                                       vocab)  # [rows, V]
+            probs = layers.softmax(step_logits)  # [rows, V]
             topk_scores, topk_ids = layers.topk(
                 probs, min(2 * beam_size, vocab))
             acc = layers.elementwise_add(layers.log(topk_scores),
@@ -601,7 +611,7 @@ def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
                 pre_ids, pre_scores, topk_ids, acc,
                 beam_size=beam_size, end_id=end_id,
                 return_parent_idx=True)
-            parent_flat = layers.reshape(parent, shape=[beam_size])
+            parent_flat = layers.reshape(parent, shape=[rows])
             # each surviving hypothesis inherits its parent's history
             layers.assign(layers.gather(tgt_buf, parent_flat),
                           output=tgt_buf)
@@ -613,23 +623,23 @@ def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
             layers.assign(layers.elementwise_add(
                 layers.elementwise_mul(tgt_buf, keep),
                 layers.elementwise_mul(
-                    layers.reshape(sel_ids, [beam_size, 1]),
+                    layers.reshape(sel_ids, [rows, 1]),
                     next_mask)), output=tgt_buf)
             layers.assign(layers.scatter(
                 ids_buf, counter,
-                layers.reshape(sel_ids, [1, beam_size, 1])),
+                layers.reshape(sel_ids, [1, rows, 1])),
                 output=ids_buf)
             layers.assign(layers.scatter(
                 scores_buf, counter,
-                layers.reshape(sel_scores, [1, beam_size, 1])),
+                layers.reshape(sel_scores, [1, rows, 1])),
                 output=scores_buf)
             layers.assign(layers.scatter(
                 parents_buf, counter,
-                layers.reshape(parent, [1, beam_size, 1])),
+                layers.reshape(parent, [1, rows, 1])),
                 output=parents_buf)
-            layers.assign(layers.reshape(sel_ids, [beam_size, 1]),
+            layers.assign(layers.reshape(sel_ids, [rows, 1]),
                           output=pre_ids)
-            layers.assign(layers.reshape(sel_scores, [beam_size, 1]),
+            layers.assign(layers.reshape(sel_scores, [rows, 1]),
                           output=pre_scores)
             layers.less_than(counter, limit, cond=cond)
         out_ids, out_scores = layers.beam_search_decode(

@@ -614,6 +614,11 @@ def test_transformer_beam_decode_agrees_with_greedy():
     greedy_cont = np.asarray(greedy)[0, 1:]  # after GO
     np.testing.assert_array_equal(beam_ids[1:, 0], greedy_cont)
     np.testing.assert_array_equal(beam_ids[1:5, 0], src[0])
+    # the beams are a real search, not beam_size copies of greedy:
+    # at least one non-top hypothesis must differ from the best
+    # (regression for the degenerate equal-seed initialization)
+    assert any(not np.array_equal(beam_ids[:, j], beam_ids[:, 0])
+               for j in range(1, beam_ids.shape[1])), beam_ids.T
     # scores are true cumulative log-probs: best beam's final score
     # equals the sum of the greedy tokens' log-softmax probabilities
     # (pins the is_accumulated contract — a double-accumulation
@@ -647,3 +652,41 @@ def test_transformer_beam_decode_agrees_with_greedy():
             break
     assert abs(float(np.ravel(beam_scores)[0]) - expected) < 1e-3, (
         float(np.ravel(beam_scores)[0]), expected)
+
+
+def test_transformer_batched_beam_decode_per_source():
+    """Batched beam decode: each source's best hypothesis equals its
+    single-source decode (beams must not leak across batch blocks)."""
+    from paddle_tpu.models import transformer as T
+
+    V, D, L, S, BEAM = 12, 16, 1, 4, 3
+    main, startup, loss = T.build_program(
+        seq_len=S, d_model=D, n_heads=2, n_layers=L, d_inner=32,
+        vocab=V, with_optimizer=False, dropout_rate=0.0)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    rng = np.random.RandomState(2)
+    for _ in range(40):
+        src = rng.randint(3, V, (8, S)).astype(np.int64)
+        tgt_in = np.concatenate(
+            [np.full((8, 1), 2, np.int64), src[:, :-1]], 1)
+        exe.run(main, feed={"src_ids": src, "tgt_ids": tgt_in,
+                            "label": src}, fetch_list=[loss])
+    kw = dict(seq_len=S, max_out_len=S + 2, d_model=D, n_heads=2,
+              n_layers=L, d_inner=32, vocab=V, start_id=2, end_id=1,
+              beam_size=BEAM)
+    two = np.array([[4, 7, 9, 1], [5, 3, 8, 1]], np.int64)
+    bm2, _, _, (ids2, sc2) = T.build_beam_decode_program(
+        batch_size=2, **kw)
+    got2, s2 = exe.run(bm2, feed={"src_ids": two},
+                       fetch_list=[ids2, sc2])
+    got2 = np.asarray(got2)  # [T, 2*BEAM]
+    bm1, _, _, (ids1, sc1) = T.build_beam_decode_program(
+        batch_size=1, **kw)
+    for b in range(2):
+        one, _ = exe.run(bm1, feed={"src_ids": two[b:b + 1]},
+                         fetch_list=[ids1, sc1])
+        np.testing.assert_array_equal(got2[:, b * BEAM],
+                                      np.asarray(one)[:, 0])
