@@ -336,6 +336,32 @@ class Executor:
                 raise KeyError(
                     f"fetch target {name!r} does not exist in the "
                     f"program")
+        # validate feeds against declared shapes up front: a rank or
+        # fixed-dim mismatch would otherwise surface as a raw jax
+        # broadcast/reshape error deep inside the traced block
+        # (reference DataFeeder checks shapes the same way)
+        for name, value in feed.items():
+            var = block._find_var_recursive(name)
+            if var is None or var.shape is None:
+                continue
+            # extract the dense part the same way _coerce_feed will:
+            # (data, lod) legacy tuples and LoDTensor objects carry
+            # their array behind one level of indirection
+            dense = value
+            if isinstance(dense, tuple) and len(dense) == 2:
+                dense = dense[0]
+            try:
+                got = tuple(np.asarray(dense).shape)
+            except Exception:
+                continue  # exotic feed: let _coerce_feed handle it
+            want = tuple(var.shape)
+            ok = len(got) == len(want) and all(
+                w < 0 or g == w for g, w in zip(got, want))
+            if not ok:
+                raise ValueError(
+                    f"feed {name!r} has shape {got} but the "
+                    f"program declares {want} (-1 = any); check the "
+                    f"batch layout or the data() declaration")
 
         try:
             device = self.place.device()

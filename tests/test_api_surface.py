@@ -445,3 +445,28 @@ def test_reference_contrib_coverage():
     assert hasattr(contrib.utils, "HDFSClient")
     assert hasattr(contrib.reader, "ctr_reader")
     assert hasattr(contrib.int8_inference, "Calibrator")
+
+
+def test_feed_shape_mismatch_raises_clearly():
+    """A wrong-rank or wrong-dim feed must fail at Executor.run with a
+    named ValueError, not a raw jax broadcast error mid-trace."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    out = fluid.layers.fc(x, 2)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(ValueError, match="feed 'x' has shape"):
+        exe.run(feed={"x": np.zeros((3,), np.float32)},
+                fetch_list=[out])  # rank 1 vs declared rank 2
+    with pytest.raises(ValueError, match="feed 'x' has shape"):
+        exe.run(feed={"x": np.zeros((3, 5), np.float32)},
+                fetch_list=[out])  # wrong fixed dim
+    got = exe.run(feed={"x": np.zeros((3, 4), np.float32)},
+                  fetch_list=[out])  # -1 batch accepts any size
+    assert np.asarray(got[0]).shape == (3, 2)
+    # legacy (data, lod) tuple and LoDTensor feeds still pass through
+    got = exe.run(feed={"x": (np.zeros((2, 4), np.float32),
+                              [[0, 2]])}, fetch_list=[out])
+    assert np.asarray(got[0]).shape == (2, 2)
+    lt = fluid.LoDTensor(np.zeros((2, 4), np.float32), [[1, 1]])
+    got = exe.run(feed={"x": lt}, fetch_list=[out])
+    assert np.asarray(got[0]).shape == (2, 2)

@@ -495,7 +495,8 @@ class TestSaveLoadOps:
             ain = fluid.layers.data(name="a", shape=[3],
                                     dtype="float32")
             bin_ = fluid.layers.data(name="b", shape=[4],
-                                     dtype="float32")
+                                     dtype="float32",
+                                     append_batch_size=False)
             helper = fluid.layers.nn.LayerHelper("save_combine",
                                                  input=ain)
             helper.append_op("save_combine",
