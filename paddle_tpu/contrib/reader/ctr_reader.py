@@ -35,8 +35,14 @@ def ctr_reader(feed_data, capacity: int, thread_num: int,
     def factory():
         for path in file_list:
             for batch in feed.read_batches(path):
-                yield tuple(batch[s] for s in slots
-                            if s in batch)
+                missing = [s for s in slots if s not in batch]
+                if missing:
+                    raise ValueError(
+                        "ctr_reader: declared slot(s) %s absent from a "
+                        "parsed batch of %s (present: %s); every line "
+                        "must carry all declared slots" %
+                        (missing, path, sorted(batch)))
+                yield tuple(batch[s] for s in slots)
 
     def _bucket(n):
         # sparse slots come back padded to data_feed._pad_ragged's

@@ -16,6 +16,7 @@ touches the teacher.
 """
 from __future__ import annotations
 
+import copy
 import logging
 from typing import Dict, Optional, Sequence
 
@@ -110,7 +111,10 @@ def merge(teacher_graph: GraphWrapper, student_graph: GraphWrapper,
                for slot, names in op.inputs.items()}
         outs = {slot: [mapping.get(n, name_prefix + n) for n in names]
                 for slot, names in op.outputs.items()}
-        attrs = dict(op.attrs)
+        # deep-copy attr values: a shallow dict() would leave
+        # list-valued attrs (strides/shape/...) shared between the
+        # teacher program and the merged student program (ADVICE r2)
+        attrs = copy.deepcopy(op.attrs)
         attrs.setdefault("op_role", "forward")
         # NOTE: append_op assigns a fresh _uid. Do NOT copy the teacher
         # op's _uid — uids are per-block indices, so a copied uid would

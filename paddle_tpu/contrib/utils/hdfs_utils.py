@@ -53,7 +53,10 @@ class HDFSClient:
     def download(self, hdfs_path, local_path, overwrite=False,
                  unzip=False):
         if overwrite and os.path.exists(local_path):
-            shutil.rmtree(local_path, ignore_errors=True)
+            if os.path.isdir(local_path):
+                shutil.rmtree(local_path, ignore_errors=True)
+            else:
+                os.remove(local_path)
         return self._run(["-get", hdfs_path, local_path])
 
     def is_exist(self, hdfs_path):

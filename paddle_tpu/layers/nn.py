@@ -1246,10 +1246,53 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1,
     return out
 
 
-def deformable_conv(*args, **kwargs):
-    raise NotImplementedError(
-        "deformable_conv: deformable sampling is not yet lowered to TPU; "
-        "use grid_sampler composition")
+def deformable_conv(input, offset, mask=None, num_filters=None,
+                    filter_size=None, stride=1, padding=0, dilation=1,
+                    groups=1, deformable_groups=1, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=None,
+                    act=None, name=None):
+    """Deformable conv v1 (mask=None) / v2 (modulated, with mask).
+    Beyond-reference capability (no op in this reference tree; API
+    modeled on later fluid surfaces). `offset` is
+    [B, 2*deformable_groups*kh*kw, Ho, Wo] with (dy, dx) per tap;
+    `mask` is [B, deformable_groups*kh*kw, Ho, Wo]. `modulated`
+    defaults to inferring v1/v2 from mask presence; passing it
+    explicitly must agree with the mask (silently dropping a mask or
+    degrading v2 to v1 would be wrong numbers, not an error).
+    im2col_step is accepted for API parity and ignored (the TPU
+    lowering samples all taps in one gather — see ops/nn_ops.py
+    deformable_conv)."""
+    if modulated is None:
+        modulated = mask is not None
+    if modulated and mask is None:
+        raise ValueError("deformable_conv: modulated=True (v2) needs "
+                         "a mask input")
+    if not modulated and mask is not None:
+        raise ValueError("deformable_conv: a mask was given but "
+                         "modulated=False would silently ignore it; "
+                         "pass modulated=True or drop the mask")
+    helper = LayerHelper("deformable_conv", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    num_channels = input.shape[1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size, filter_size]
+    filter_shape = [num_filters, num_channels // groups, fs[0], fs[1]]
+    std = (2.0 / (fs[0] * fs[1] * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        helper.param_attr, filter_shape, input.dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ins = {"Input": input, "Offset": offset, "Filter": w}
+    if mask is not None:
+        ins["Mask"] = mask
+    helper.append_op(
+        "deformable_conv", ins, {"Output": out},
+        {"strides": _pair(stride), "paddings": _pair(padding),
+         "dilations": _pair(dilation), "groups": groups,
+         "deformable_groups": deformable_groups})
+    out = _conv_bias(helper, out)
+    return helper.append_activation(out)
 
 
 def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):

@@ -120,14 +120,14 @@ def range(start, end, step, dtype="float32"):
     if all(isinstance(v, (int, float)) for v in (start, end, step)):
         helper.append_op("range", {}, {"Out": out},
                          {"start": float(start), "end": float(end),
-                          "step": float(step)})
+                          "step": float(step), "dtype": dtype})
         import math
 
         out.shape = (max(0, int(math.ceil((end - start) / step))),)
     else:
         helper.append_op("range",
                          {"Start": start, "End": end, "Step": step},
-                         {"Out": out}, {})
+                         {"Out": out}, {"dtype": dtype})
     return out
 
 

@@ -69,6 +69,29 @@ def dgc_momentum(ctx):
     return {"ParamOut": p_out, "UOut": u_out, "VOut": v_out}
 
 
+@register_op("dgc", differentiable=False,
+             inplace={"U_out": "U", "V_out": "V"})
+def dgc(ctx):
+    """DGC gradient encode (reference operators/dgc_op.cc:23 DGCOp +
+    dgc_op.h:38 DGCOpKernel; wired by optimizer.py:813 _dgc_op).
+    Delegates to parallel/dgc.py dgc_encode; see its docstring for the
+    TPU-native dense-masked EncodeGrad format."""
+    from ..parallel.dgc import dgc_encode
+
+    u, v, g = ctx.input("U"), ctx.input("V"), ctx.input("Grad")
+    step = ctx.input("current_step").reshape(()).astype(jnp.int32)
+    u_out, v_out, encode, grad_out, k = dgc_encode(
+        u, v, g,
+        m=ctx.attr("m", 0.9),
+        step=step,
+        sparsity=list(ctx.attr("sparsity", [0.999])),
+        rampup_begin_step=int(ctx.attr("rampup_begin_step", 0.0)),
+        rampup_step=int(ctx.attr("rampup_step", 1.0)),
+        use_nesterov=ctx.attr("use_nesterov", True))
+    return {"U_out": u_out, "V_out": v_out, "EncodeGrad": encode,
+            "Grad_out": grad_out, "k": k}
+
+
 @register_op("lars_momentum", differentiable=False,
              inplace={"ParamOut": "Param", "VelocityOut": "Velocity"})
 def lars_momentum(ctx):

@@ -361,13 +361,19 @@ def range_op(ctx):
     # static-shape requirement: bounds must be attrs under jit (the
     # layers.range wrapper passes python scalars through); traced
     # Start/End/Step inputs only work with concrete host-side values.
+    dtype = jnp.dtype(ctx.attr("dtype", "float32"))
     start = ctx.attr("start", None)
     if start is not None:
-        return jnp.arange(float(start), float(ctx.attr("end")),
-                          float(ctx.attr("step")))
-    return jnp.arange(float(ctx.input("Start")),
-                      float(ctx.input("End")),
-                      float(ctx.input("Step")))
+        bounds = (start, ctx.attr("end"), ctx.attr("step"))
+    else:
+        bounds = (ctx.input("Start"), ctx.input("End"),
+                  ctx.input("Step"))
+    # compute host-side in float64, then cast to the declared var dtype
+    # (ADVICE r2: a float32 arange under an int-typed var breaks
+    # while-loop carry dtypes, and float32 intermediates corrupt int
+    # sequences past 2^24)
+    vals = np.arange(*(float(b) for b in bounds))
+    return jnp.asarray(vals.astype(dtype))
 
 
 @register_op("top_k", differentiable=False)

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["rampup_sparsity", "dgc_momentum_step",
+__all__ = ["rampup_sparsity", "dgc_momentum_step", "dgc_encode",
            "compressed_allreduce", "dgc_allreduce_step"]
 
 
@@ -47,6 +47,26 @@ def rampup_sparsity(step, sparsity, rampup_begin_step, rampup_step):
     seg = jnp.clip(jnp.floor(t * n / max(rampup_step, 1)), 0, n - 1)
     s = sparsity[seg.astype(jnp.int32)]
     return jnp.where(step < rampup_begin_step, 0.0, s)
+
+
+def _correct_and_select(u, v, g, *, m, s, use_nesterov=False):
+    """Momentum correction + quantile-threshold selection — the shared
+    core of `dgc_momentum_step` and the `dgc` encode op (reference
+    dgc_op.h:90-110 correction; k_select). Returns
+    (u_c, v_c, mask, send): corrected accumulators, the transmit mask
+    (strictly-below-threshold stays local; >= is sent; s=0 sends all),
+    and the masked send tensor."""
+    if use_nesterov:
+        u_c = m * (u + g)
+        v_c = v + u_c + g
+    else:
+        u_c = m * u + g
+        v_c = v + u_c
+    flat = jnp.abs(v_c.ravel())
+    thr = jnp.quantile(flat, jnp.clip(s, 0.0, 1.0))
+    mask = (jnp.abs(v_c) >= thr) | (s <= 0.0)
+    send = jnp.where(mask, v_c, 0.0)
+    return u_c, v_c, mask, send
 
 
 def dgc_momentum_step(p, g, u, v, lr, *, mu, step, sparsity,
@@ -69,14 +89,9 @@ def dgc_momentum_step(p, g, u, v, lr, *, mu, step, sparsity,
     else:
         p_dense = p - lr * u_dense
 
-    # DGC branch
-    u_c = mu * u + g
-    v_c = v + u_c
-    flat = jnp.abs(v_c.ravel())
-    thr = jnp.quantile(flat, jnp.clip(s, 0.0, 1.0))
-    # strictly-below-threshold stays local; >= is sent (s=0 sends all)
-    mask = (jnp.abs(v_c) >= thr) | (s <= 0.0)
-    send = jnp.where(mask, v_c, 0.0)
+    # DGC branch (correction is non-nesterov here regardless: the
+    # nesterov lookahead is already in the dense-branch update rule)
+    u_c, v_c, mask, send = _correct_and_select(u, v, g, m=mu, s=s)
     v_dgc = jnp.where(mask, 0.0, v_c)
     u_dgc = jnp.where(mask, 0.0, u_c)
     p_dgc = p - lr * send
@@ -86,6 +101,44 @@ def dgc_momentum_step(p, g, u, v, lr, *, mu, step, sparsity,
     u_out = jnp.where(dense, u_dense, u_dgc)
     v_out = jnp.where(dense, v, v_dgc)
     return p_out, u_out, v_out
+
+
+def dgc_encode(u, v, g, *, m, step, sparsity, rampup_begin_step,
+               rampup_step, use_nesterov=False):
+    """The `dgc` (encode) op's math (reference operators/dgc_op.h:38
+    DGCOpKernel + dgc_op.cc:63 DGCOpMaker).
+
+    Reference semantics: momentum-correct the accumulators
+    (u <- m*u + g; v <- v + u; nesterov: u <- m*(u+g); v <- v + u + g),
+    k_select the top |v| entries into EncodeGrad, zero them out of
+    u/v, and zero Grad_out (the encoded tensor replaces the dense
+    gradient on the wire). Pre-rampup (step < rampup_begin_step) the
+    op is a no-op and the dense gradient passes through.
+
+    TPU-native differences: EncodeGrad is a DENSE masked tensor (same
+    shape as Grad, zeros at unsent positions) rather than the
+    reference's 2k-element (index, value) buffer — XLA needs static
+    shapes while k varies with the rampup schedule, and the actual
+    2k-per-worker wire format lives in `compressed_allreduce` for
+    shard_map programs. Selection is by quantile threshold (see
+    `rampup_sparsity`), keeping k a traced scalar.
+
+    Returns (u_out, v_out, encode_grad, grad_out, k).
+    """
+    s = rampup_sparsity(step, sparsity, rampup_begin_step, rampup_step)
+    u_c, v_c, mask, encode = _correct_and_select(
+        u, v, g, m=m, s=s, use_nesterov=use_nesterov)
+    k = jnp.sum(mask.astype(jnp.float32))
+
+    dense = step < rampup_begin_step
+    u_out = jnp.where(dense, u, jnp.where(mask, 0.0, u_c))
+    v_out = jnp.where(dense, v, jnp.where(mask, 0.0, v_c))
+    encode = jnp.where(dense, jnp.zeros_like(encode), encode)
+    # post-rampup the dense grad is replaced by the encoded wire
+    # (reference zeroes Grad_out); pre-rampup it passes through
+    grad_out = jnp.where(dense, g, jnp.zeros_like(g))
+    k = jnp.where(dense, 0.0, k)
+    return u_out, v_out, encode, grad_out, k
 
 
 def compressed_allreduce(v, k, axis_name):

@@ -119,6 +119,14 @@ class TestTensorRangeAndArray:
         np.testing.assert_allclose(got, np.arange(1.0, 10.0, 2.0))
         assert out.shape == (5,)
 
+    def test_range_int_dtype_matches_declared_var(self):
+        # ADVICE r2: range(dtype="int64") used to yield a float array
+        # under an int-typed var — breaks while-loop carry dtypes
+        out = fluid.layers.range(0, 6, 2, dtype="int32")
+        got, = _run([out])
+        assert got.dtype == np.int32
+        np.testing.assert_array_equal(got, [0, 2, 4])
+
     def test_tensor_array_to_tensor(self):
         a = fluid.layers.fill_constant([2, 3], "float32", 1.0)
         b = fluid.layers.fill_constant([2, 3], "float32", 2.0)

@@ -269,3 +269,76 @@ class TestGradientMerge:
                 fluid.optimizer.SGD(1.0), k_steps=4).minimize(l),
             40, lambda i: (xs, ys))
         assert losses[-1] < losses[0] * 0.3
+
+
+class TestDGCEncodeOp:
+    """The in-graph `dgc` encode op (reference operators/dgc_op.h:38;
+    wired by reference optimizer.py:813 _dgc_op)."""
+
+    def _run_op(self, u, v, g, step, **attrs):
+        from tests.op_test import OpTest
+
+        class _T(OpTest):
+            op_type = "dgc"
+            inputs = {"U": u, "V": v, "Grad": g,
+                      "current_step": np.asarray([step], np.float32)}
+            outputs = {"U_out": u, "V_out": v, "EncodeGrad": g,
+                       "Grad_out": g, "k": np.zeros((), np.float32)}
+
+        t = _T("check_output")
+        t.attrs = attrs
+        t.setUp()
+        prog, feed, out_names = t._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        outs = exe.run(prog, feed=feed,
+                       fetch_list=["U_out", "V_out", "EncodeGrad",
+                                   "Grad_out", "k"])
+        return [np.asarray(o) for o in outs]
+
+    def test_pre_rampup_is_a_noop_passthrough(self):
+        rng = np.random.RandomState(3)
+        u = rng.randn(6).astype(np.float32)
+        v = rng.randn(6).astype(np.float32)
+        g = rng.randn(6).astype(np.float32)
+        u1, v1, enc, g1, k = self._run_op(
+            u, v, g, step=2, m=0.9, use_nesterov=False,
+            sparsity=[0.75], rampup_begin_step=10.0, rampup_step=1.0)
+        np.testing.assert_array_equal(u1, u)
+        np.testing.assert_array_equal(v1, v)
+        np.testing.assert_array_equal(enc, np.zeros_like(g))
+        np.testing.assert_array_equal(g1, g)
+        assert float(k) == 0.0
+
+    def test_post_rampup_encode_and_masking(self):
+        u = np.zeros(4, np.float32)
+        v = np.zeros(4, np.float32)
+        g = np.asarray([0.1, -0.2, 3.0, 0.05], np.float32)
+        u1, v1, enc, g1, k = self._run_op(
+            u, v, g, step=5, m=0.9, use_nesterov=False,
+            sparsity=[0.75], rampup_begin_step=0.0, rampup_step=1.0)
+        # u_c = g, v_c = g; only |v|=3.0 clears the 75% quantile
+        np.testing.assert_allclose(enc, [0, 0, 3.0, 0], rtol=1e-6)
+        # transmitted entry zeroed from both accumulators
+        np.testing.assert_allclose(u1, [0.1, -0.2, 0.0, 0.05],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(v1, [0.1, -0.2, 0.0, 0.05],
+                                   rtol=1e-6)
+        # dense grad replaced by the encoded wire (reference zeroes it)
+        np.testing.assert_array_equal(g1, np.zeros_like(g))
+        assert float(k) == 1.0
+
+    def test_nesterov_momentum_correction(self):
+        u = np.asarray([1.0, -1.0], np.float32)
+        v = np.asarray([0.5, 0.5], np.float32)
+        g = np.asarray([0.2, 0.4], np.float32)
+        m = 0.9
+        u1, v1, enc, g1, k = self._run_op(
+            u, v, g, step=5, m=m, use_nesterov=True,
+            sparsity=[0.0], rampup_begin_step=0.0, rampup_step=1.0)
+        u_c = m * (u + g)
+        v_c = v + u_c + g
+        # sparsity 0 -> everything is sent, accumulators fully drain
+        np.testing.assert_allclose(enc, v_c, rtol=1e-6)
+        np.testing.assert_allclose(u1, 0.0)
+        np.testing.assert_allclose(v1, 0.0)
+        assert float(k) == 2.0

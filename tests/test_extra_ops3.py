@@ -1036,3 +1036,131 @@ def test_swce_ignore_index_paths_agree():
         np.testing.assert_allclose(grad_p, grad_j, atol=1e-5, rtol=1e-5)
     finally:
         fa.force_interpret(False)
+
+
+def _np_deform_conv(x, offset, w, mask, stride, pad, dilation, groups,
+                    dg):
+    """Direct-loop numpy oracle for deformable_conv (bilinear sampling
+    with zero outside the image)."""
+    B, C, H, W = x.shape
+    F, _, kh, kw = w.shape
+    K = kh * kw
+    Ho = (H + 2 * pad - (dilation * (kh - 1) + 1)) // stride + 1
+    Wo = (W + 2 * pad - (dilation * (kw - 1) + 1)) // stride + 1
+    off = offset.reshape(B, dg, K, 2, Ho, Wo)
+    out = np.zeros((B, F, Ho, Wo), np.float64)
+
+    def sample(b, c, y, xx):
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        v = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yi, xi = y0 + dy, x0 + dx
+                if 0 <= yi < H and 0 <= xi < W:
+                    wgt = (1 - abs(y - yi)) * (1 - abs(xx - xi))
+                    v += wgt * x[b, c, yi, xi]
+        return v
+
+    cg = C // groups
+    fg = F // groups
+    for b in range(B):
+        for f in range(F):
+            g = f // fg
+            for ho in range(Ho):
+                for wo in range(Wo):
+                    acc = 0.0
+                    for i in range(kh):
+                        for j in range(kw):
+                            k = i * kw + j
+                            for cc in range(cg):
+                                c = g * cg + cc
+                                d = c // (C // dg)
+                                y = (ho * stride - pad + i * dilation +
+                                     off[b, d, k, 0, ho, wo])
+                                xx = (wo * stride - pad + j * dilation +
+                                      off[b, d, k, 1, ho, wo])
+                                v = sample(b, c, y, xx)
+                                if mask is not None:
+                                    v *= mask.reshape(
+                                        B, dg, K, Ho, Wo)[b, d, k, ho, wo]
+                                acc += v * w[f, cc, i, j]
+                    out[b, f, ho, wo] = acc
+    return out.astype(x.dtype)
+
+
+class TestDeformableConvV1(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "deformable_conv"
+        rng = np.random.RandomState(11)
+        x = rng.randn(2, 4, 5, 5).astype(np.float32)
+        w = rng.randn(3, 4, 3, 3).astype(np.float32)
+        # keep offsets off integer lattice points (fd-grad stability)
+        offset = (rng.rand(2, 2 * 2 * 9, 5, 5).astype(np.float32)
+                  * 0.8 + 0.1)
+        attrs = dict(stride=1, pad=1, dilation=1, groups=1, dg=2)
+        out = _np_deform_conv(x, offset, w, None, **attrs)
+        self.inputs = {"Input": x, "Offset": offset, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "deformable_groups": 2}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["Input", "Offset", "Filter"], "Output",
+                        max_relative_error=0.02)
+
+
+class TestDeformableConvV2Modulated(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "deformable_conv"
+        rng = np.random.RandomState(12)
+        x = rng.randn(1, 2, 4, 4).astype(np.float32)
+        w = rng.randn(4, 1, 3, 3).astype(np.float32)  # groups=2
+        offset = (rng.rand(1, 2 * 1 * 9, 2, 2).astype(np.float32)
+                  * 0.8 + 0.1)
+        mask = rng.rand(1, 1 * 9, 2, 2).astype(np.float32)
+        out = _np_deform_conv(x, offset, w, mask, stride=2, pad=1,
+                              dilation=1, groups=2, dg=1)
+        self.inputs = {"Input": x, "Offset": offset, "Filter": w,
+                       "Mask": mask}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 2,
+                      "deformable_groups": 1}
+        self.outputs = {"Output": out}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestDeformableConvZeroOffsetIsConv:
+    """Zero offsets + all-ones mask must reduce to plain conv2d."""
+
+    def test_matches_conv2d(self):
+        import paddle_tpu as fluid
+
+        rng = np.random.RandomState(13)
+        xv = rng.randn(2, 3, 6, 6).astype(np.float32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[3, 6, 6],
+                                  dtype="float32")
+            off = fluid.layers.fill_constant([2, 18, 6, 6], "float32",
+                                             0.0)
+            dc = fluid.layers.deformable_conv(
+                x, off, num_filters=5, filter_size=3, padding=1,
+                param_attr=fluid.ParamAttr(name="wshared"),
+                bias_attr=False)
+            c = fluid.layers.conv2d(
+                x, num_filters=5, filter_size=3, padding=1,
+                param_attr=fluid.ParamAttr(name="wshared"),
+                bias_attr=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        a, b = exe.run(prog, feed={"x": xv}, fetch_list=[dc, c])
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
