@@ -1,0 +1,767 @@
+"""Pipeline parallelism as a FRAMEWORK capability: partition a built
+`Program` into stages and train it with the GPipe schedule.
+
+Reference precedent for program surgery:
+multi_devices_graph_pass.h:40,110 (the reference replicates and
+rewrites the graph per device); the capability itself is beyond the
+reference (SURVEY.md §2.4: pipeline parallelism ABSENT in Fluid).
+
+TPU-native design
+-----------------
+A Fluid-style training program is (forward ops | backward ops |
+optimizer ops). This pass:
+
+* keeps the program's FORWARD and OPTIMIZER ops, drops its backward
+  ops (JAX AD through the pipeline replaces them — `lax.scan` and
+  `ppermute` both have transpose rules, so one `jax.grad` covers the
+  bubble schedule, the microbatch accumulation, and the stage
+  collectives);
+* splits the forward into replicated sections and LOOP sections. A
+  loop section is a run of isomorphic segments (e.g. the N
+  transformer layers), named by its boundary activations
+  ``bounds = [b0, b1, ..., bk]`` (b0 = input of segment 1, bi =
+  output of segment i). Segments are validated isomorphic (same op
+  types/attrs, same param shapes) and are executed by ONE traced
+  copy of segment 0's ops with per-segment params bound positionally;
+* per-segment params are stacked to a leading [n_segments] dim. With
+  ``pp == 1`` the loop lowers to `lax.scan` over layers — the HLO
+  stops growing linearly in depth (compile-size fix). With
+  ``pp > 1`` the stacked dim is sharded over the 'pp' mesh axis and
+  the loop runs the GPipe schedule: every device executes
+  n_segments/pp consecutive segments, activations advance one stage
+  per tick via `ppermute` around the ICI ring, microbatches ride the
+  same ring (gradient accumulation across microbatches is the scan's
+  AD, not hand-written);
+* broadcast inputs (vars produced before the loop and read inside it,
+  e.g. the encoder output consumed by every decoder layer's cross
+  attention) ride the ring NEXT TO their microbatch when they are
+  batch-major, and are passed replicated otherwise;
+* the program's own optimizer/lr-scheduler/clip ops then run on the
+  AD gradients (bound under the reference's `param@GRAD` names), so
+  optimizer semantics — noam decay, Adam bias correction, grad
+  clipping — are EXACTLY the Executor path's, and single-device loss
+  parity holds to float tolerance.
+
+Usage::
+
+    main, startup, loss = transformer.build_program(...)
+    mesh = make_mesh(MeshConfig(pp=2), jax.devices()[:2])
+    tr = PipelineTrainer(main, loss, loops=[enc_bounds, dec_bounds],
+                         mesh=mesh, n_micro=4)
+    exe.run(startup, scope=scope)
+    tr.initialize(scope)
+    for batch in data:
+        loss_val = tr.run(feed=batch)
+    tr.write_back(scope)   # params/optimizer state back to the scope
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.program import GRAD_SUFFIX, Program, grad_var_name
+from ..core.registry import EMPTY_VAR, is_registered, run_op
+
+__all__ = ["PipelineTrainer", "PipelinePartitionError", "propose_loops"]
+
+
+class PipelinePartitionError(ValueError):
+    """Raised when a Program cannot be partitioned as requested."""
+
+
+# ---------------------------------------------------------------------------
+# partition
+# ---------------------------------------------------------------------------
+@dataclass
+class _Loop:
+    bounds: List[str]                     # [b0 .. bk]
+    segments: List[List] = field(default_factory=list)   # ops per segment
+    # canonical (segment-0) param names, positional order
+    canon_params: List[str] = field(default_factory=list)
+    # per-segment param names aligned with canon_params
+    seg_params: List[List[str]] = field(default_factory=list)
+    bcast: List[str] = field(default_factory=list)       # broadcast reads
+
+
+@dataclass
+class _Section:
+    kind: str                 # "repl" | "loop"
+    ops: List = field(default_factory=list)
+    loop: Optional[_Loop] = None
+
+
+def _op_reads(op):
+    return [n for names in op.inputs.values() for n in names
+            if n != EMPTY_VAR]
+
+
+def _op_writes(op):
+    return [n for names in op.outputs.values() for n in names
+            if n != EMPTY_VAR]
+
+
+def _is_backward(op):
+    return op.attrs.get("op_role") == "backward"
+
+
+def _persistable(block, name):
+    v = block._find_var_recursive(name)
+    return v is not None and v.persistable
+
+
+def _touches_grad(op):
+    return any(GRAD_SUFFIX in n
+               for n in _op_reads(op) + _op_writes(op))
+
+
+def _attrs_isomorphic(a, b):
+    ka = {k: v for k, v in a.items() if k != "op_role"}
+    kb = {k: v for k, v in b.items() if k != "op_role"}
+    return ka == kb
+
+
+def _partition(program: Program, loss_name: str,
+               loops_bounds: Sequence[Sequence[str]]):
+    """Split the block into (sections, phaseB ops, var metadata)."""
+    block = program.global_block
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        if not is_registered(op.type):
+            raise PipelinePartitionError(
+                f"op {op.type!r} has no registered kernel")
+        if any(hasattr(v, "ops") for v in op.attrs.values()):
+            raise PipelinePartitionError(
+                f"op {op.type!r} carries a sub-block; control-flow "
+                f"programs cannot be pipeline-partitioned")
+
+    kept = [op for op in block.ops
+            if op.type not in ("feed", "fetch") and not _is_backward(op)]
+    # phase B = optimizer tail: first kept op that is optimize-role or
+    # touches a @GRAD var; everything after runs on the AD gradients
+    b_start = len(kept)
+    for i, op in enumerate(kept):
+        if op.attrs.get("op_role") == "optimize" or _touches_grad(op):
+            b_start = i
+            break
+    phase_a, phase_b = kept[:b_start], kept[b_start:]
+
+    if not any(loss_name in _op_writes(op) for op in phase_a):
+        raise PipelinePartitionError(
+            f"loss var {loss_name!r} is not produced by the forward "
+            f"section")
+
+    def persistable(name):
+        return _persistable(block, name)
+
+    def is_data(name):
+        v = block._find_var_recursive(name)
+        return v is not None and v.is_data
+
+    # producer index (last writer) of every var within phase A
+    producer = {}
+    for i, op in enumerate(phase_a):
+        for n in _op_writes(op):
+            producer[n] = i
+
+    # resolve loop op ranges
+    ranges = []
+    for bounds in loops_bounds:
+        bounds = [b.name if hasattr(b, "name") else b for b in bounds]
+        if len(bounds) < 3:
+            raise PipelinePartitionError(
+                f"loop bounds {bounds} must name at least two segments "
+                f"(>=3 boundary vars)")
+        for b in bounds[1:]:
+            if b not in producer:
+                raise PipelinePartitionError(
+                    f"loop boundary var {b!r} is not produced by the "
+                    f"forward section")
+        if bounds[0] not in producer and not is_data(bounds[0]):
+            raise PipelinePartitionError(
+                f"loop input var {bounds[0]!r} is neither produced by "
+                f"the forward section nor a data var")
+        # a data-var loop input means the loop starts at op 0
+        idxs = [producer.get(bounds[0], -1)] + \
+            [producer[b] for b in bounds[1:]]
+        if idxs != sorted(idxs):
+            raise PipelinePartitionError(
+                f"loop bounds {bounds} are not in program order")
+        ranges.append((idxs[0], idxs[-1], bounds, idxs))
+    ranges.sort()
+    for (s1, e1, b1, _), (s2, e2, b2, _) in zip(ranges, ranges[1:]):
+        if s2 < e1:
+            raise PipelinePartitionError(
+                f"loops {b1[-1]} and {b2[0]} overlap")
+
+    # build sections
+    sections: List[_Section] = []
+    cursor = 0
+    for start, end, bounds, idxs in ranges:
+        if cursor <= start:
+            repl = phase_a[cursor:start + 1]
+            if repl:
+                sections.append(_Section("repl", ops=repl))
+        loop = _Loop(bounds=bounds)
+        for a, b in zip(idxs, idxs[1:]):
+            loop.segments.append(phase_a[a + 1:b + 1])
+        sections.append(_Section("loop", loop=loop))
+        cursor = end + 1
+    tail = phase_a[cursor:]
+    if tail:
+        sections.append(_Section("repl", ops=tail))
+
+    # analyze + validate each loop
+    for sec in sections:
+        if sec.kind != "loop":
+            continue
+        loop = sec.loop
+        pre_loop = set()
+        for s in sections:
+            if s is sec:
+                break
+            if s.kind == "repl":
+                for op in s.ops:
+                    pre_loop.update(_op_writes(op))
+            else:
+                pre_loop.update(s.loop.bounds)
+        n_ops = [len(seg) for seg in loop.segments]
+        if len(set(n_ops)) != 1:
+            raise PipelinePartitionError(
+                f"loop {loop.bounds[0]}..{loop.bounds[-1]}: segments "
+                f"have differing op counts {n_ops}; not isomorphic")
+        types0 = [op.type for op in loop.segments[0]]
+        for si, seg in enumerate(loop.segments[1:], 1):
+            types = [op.type for op in seg]
+            if types != types0:
+                raise PipelinePartitionError(
+                    f"loop segment {si} op types {types} differ from "
+                    f"segment 0 {types0}; not isomorphic")
+            for o0, oi in zip(loop.segments[0], seg):
+                if not _attrs_isomorphic(o0.attrs, oi.attrs):
+                    raise PipelinePartitionError(
+                        f"loop segment {si} op {oi.type!r} attrs "
+                        f"differ from segment 0; not isomorphic")
+        bcast = []
+        read_sigs = []
+        for si, seg in enumerate(loop.segments):
+            local = set()
+            params_i = []
+            sig = []   # positional read signature, compared across segs
+            bound_in = loop.bounds[si]
+            for op in seg:
+                for n in _op_writes(op):
+                    if persistable(n):
+                        raise PipelinePartitionError(
+                            f"loop segment {si}: op {op.type!r} writes "
+                            f"persistable {n!r}; stateful ops (e.g. "
+                            f"batch-norm running stats) inside a "
+                            f"pipelined loop are not supported — their "
+                            f"updates cannot be threaded out of the "
+                            f"stage scan")
+                for n in _op_reads(op):
+                    if n == bound_in:
+                        sig.append("@BOUND")
+                        continue
+                    if n in local:
+                        sig.append("@LOCAL")
+                        continue
+                    if persistable(n):
+                        sig.append("@PARAM")
+                        if n not in params_i:
+                            params_i.append(n)
+                    elif n in pre_loop or is_data(n):
+                        # broadcasts are traced once (segment 0's ops
+                        # serve every segment) -> the NAME must match
+                        # across segments, so it goes into the
+                        # signature verbatim
+                        sig.append(n)
+                        if n not in bcast:
+                            bcast.append(n)
+                    else:
+                        raise PipelinePartitionError(
+                            f"loop segment {si}: op {op.type!r} reads "
+                            f"{n!r}, which is produced in another "
+                            f"segment (cross-segment skip connections "
+                            f"are not pipelineable)")
+                local.update(_op_writes(op))
+            if loop.bounds[si + 1] not in local:
+                raise PipelinePartitionError(
+                    f"loop segment {si} does not produce its boundary "
+                    f"var {loop.bounds[si + 1]!r}")
+            loop.seg_params.append(params_i)
+            read_sigs.append(sig)
+        for si, sig in enumerate(read_sigs[1:], 1):
+            if sig != read_sigs[0]:
+                diff = next(
+                    (a, b) for a, b in zip(read_sigs[0], sig)
+                    if a != b)
+                raise PipelinePartitionError(
+                    f"loop segment {si} reads {diff[1]!r} where "
+                    f"segment 0 reads {diff[0]!r}; per-segment "
+                    f"broadcast inputs must be identical (segment 0's "
+                    f"trace serves every segment)")
+        loop.canon_params = loop.seg_params[0]
+        lens = [len(p) for p in loop.seg_params]
+        if len(set(lens)) != 1:
+            raise PipelinePartitionError(
+                f"loop segments have differing param counts {lens}")
+        loop.bcast = bcast
+
+    return sections, phase_b
+
+
+# ---------------------------------------------------------------------------
+# auto-detection helper
+# ---------------------------------------------------------------------------
+def propose_loops(program: Program, loss_name: str,
+                  min_segments: int = 2) -> List[List[str]]:
+    """Detect maximal runs of isomorphic op segments in the forward
+    section and return their boundary-var lists (candidate `loops`
+    arguments). Convenience over manual bound naming; validation still
+    happens in `_partition`."""
+    sections, _ = _partition(program, loss_name, [])
+    ops = [op for sec in sections for op in sec.ops]
+    types = [op.type for op in ops]
+    n = len(types)
+    block = program.global_block
+
+    def persistable(name):
+        return _persistable(block, name)
+
+    # collect every valid periodic run, then greedily keep the ones
+    # covering the most ops (a transformer layer beats the 2-op
+    # bias-add mini-runs nested inside it)
+    candidates = []
+    for period in range(1, n // 2 + 1):
+        start = 0
+        while start + 2 * period <= n:
+            m = 1
+            while (start + (m + 1) * period <= n
+                   and types[start + m * period:
+                             start + (m + 1) * period]
+                   == types[start:start + period]):
+                m += 1
+            if m >= min_segments:
+                segs = [ops[start + i * period:
+                            start + (i + 1) * period]
+                        for i in range(m)]
+                bounds = _infer_bounds(segs, persistable)
+                if bounds is not None:
+                    candidates.append(
+                        (m * period, m, start, period, bounds))
+                start += m * period
+            else:
+                start += 1
+    candidates.sort(key=lambda c: (-c[0], -c[1], c[2]))
+    best: List[List[str]] = []
+    covered = [False] * n
+    for cover, m, start, period, bounds in candidates:
+        if any(covered[start:start + cover]):
+            continue
+        for i in range(start, start + cover):
+            covered[i] = True
+        best.append((start, bounds))
+    return [b for _, b in sorted(best)]
+
+
+def _infer_bounds(segs, persistable):
+    """A run of op segments is a loop iff exactly one non-persistable
+    var crosses each segment boundary; returns [b0..bk] or None."""
+    bounds = []
+    for i, seg in enumerate(segs):
+        produced_prev = set()
+        if i > 0:
+            for op in segs[i - 1]:
+                produced_prev.update(_op_writes(op))
+        local = set()
+        crossing = []
+        for op in seg:
+            for nm in _op_reads(op):
+                if (nm in produced_prev and nm not in local
+                        and not persistable(nm) and nm not in crossing):
+                    crossing.append(nm)
+            local.update(_op_writes(op))
+        if i == 0:
+            continue
+        if len(crossing) != 1:
+            return None
+        bounds.append(crossing[0])
+    if not bounds:
+        return None
+    # b0: the same positional input for segment 0. Find which op/slot
+    # consumed the crossing var in segment 1 and read segment 0's same
+    # position.
+    seg1 = segs[1]
+    target = bounds[0]
+    pos = None
+    for oi, op in enumerate(seg1):
+        for slot, names in op.inputs.items():
+            for k, nm in enumerate(names):
+                if nm == target:
+                    pos = (oi, slot, k)
+                    break
+            if pos:
+                break
+        if pos:
+            break
+    oi, slot, k = pos
+    b0 = segs[0][oi].inputs[slot][k]
+    # bk: last segment's counterpart of the crossing output
+    prod_pos = None
+    for oi, op in enumerate(segs[0]):
+        for slot, names in op.outputs.items():
+            for k, nm in enumerate(names):
+                if nm == bounds[0]:
+                    prod_pos = (oi, slot, k)
+    if prod_pos is None:
+        return None
+    oi, slot, k = prod_pos
+    bk = segs[-1][oi].outputs[slot][k]
+    return [b0] + bounds + [bk]
+
+
+# ---------------------------------------------------------------------------
+# trainer
+# ---------------------------------------------------------------------------
+class PipelineTrainer:
+    """Train a Program with its repeated-layer loops pipelined over a
+    'pp' mesh axis (or scanned over layers when pp == 1)."""
+
+    def __init__(self, program: Program, loss, *,
+                 loops: Sequence[Sequence[str]],
+                 mesh: Optional[Mesh] = None, n_micro: int = 1,
+                 axis: str = "pp"):
+        self.program = program
+        self.loss_name = loss.name if hasattr(loss, "name") else loss
+        self.mesh = mesh
+        self.axis = axis
+        self.n_micro = int(n_micro)
+        self.pp = 1 if mesh is None else int(mesh.shape[axis])
+        if mesh is not None:
+            other = [a for a in mesh.axis_names
+                     if a != axis and mesh.shape[a] != 1]
+            if other:
+                raise PipelinePartitionError(
+                    f"PipelineTrainer v1 supports a pure {axis!r} "
+                    f"mesh; axes {other} have size > 1")
+        self.sections, self.phase_b = _partition(
+            program, self.loss_name, loops)
+        for sec in self.sections:
+            if sec.kind == "loop" and len(sec.loop.segments) % self.pp:
+                raise PipelinePartitionError(
+                    f"loop {sec.loop.bounds[0]}..: "
+                    f"{len(sec.loop.segments)} segments not divisible "
+                    f"by pp={self.pp}")
+        self._collect_state_names()
+        self.state: Dict[str, jax.Array] = {}
+        self._rng = None
+        self._jitted = None
+        self._feed_spec = None
+
+    # ------------------------------------------------------------------
+    def _collect_state_names(self):
+        block = self.program.global_block
+
+        def persistable(name):
+            return _persistable(block, name)
+
+        a_ops = []
+        for sec in self.sections:
+            if sec.kind == "repl":
+                a_ops += sec.ops
+            else:
+                for seg in sec.loop.segments:
+                    a_ops += seg
+        read_a, written_a = [], []
+        produced = set()
+        for op in a_ops:
+            for n in _op_reads(op):
+                if persistable(n) and n not in produced \
+                        and n not in read_a:
+                    read_a.append(n)
+            for n in _op_writes(op):
+                produced.add(n)
+                if persistable(n) and n not in written_a:
+                    written_a.append(n)
+        read_b, written_b = [], []
+        for op in self.phase_b:
+            for n in _op_reads(op):
+                if persistable(n) and n not in read_b:
+                    read_b.append(n)
+            for n in _op_writes(op):
+                if persistable(n) and n not in written_b:
+                    written_b.append(n)
+        self.params_a = read_a            # forward persistables
+        self.state_names = list(dict.fromkeys(
+            read_a + written_a + read_b + written_b))
+        self.state_out = list(dict.fromkeys(written_a + written_b))
+        # feeds: data vars read anywhere in phase A
+        self.feed_names = sorted({
+            n for op in a_ops for n in _op_reads(op)
+            if (v := block._find_var_recursive(n)) is not None
+            and v.is_data})
+        # phase-A-produced non-persistables read by phase B (lr etc.)
+        a_local = {n for op in a_ops for n in _op_writes(op)
+                   if not persistable(n)}
+        self.aux_names = sorted({
+            n for op in self.phase_b for n in _op_reads(op)
+            if n in a_local and not n.endswith(GRAD_SUFFIX)})
+
+    # ------------------------------------------------------------------
+    def initialize(self, scope):
+        """Pull params/optimizer state from a scope (run the startup
+        program into it first)."""
+        for n in self.state_names:
+            v = scope._get(n)
+            if v is None:
+                raise RuntimeError(
+                    f"Variable {n!r} is used before initialization -- "
+                    f"run the startup program first")
+            self.state[n] = jnp.asarray(np.asarray(v))
+        seed = getattr(self.program, "_seed", None) or 0
+        self._rng = jax.random.PRNGKey(seed)
+        return self
+
+    def write_back(self, scope):
+        for n, v in self.state.items():
+            scope._set(n, v)
+
+    # ------------------------------------------------------------------
+    def _seg_apply(self, loop, params_list, h, bcast_env, key, seg_idx):
+        """Run segment-0's ops with positionally-bound params."""
+        env = dict(bcast_env)
+        env[loop.bounds[0]] = h
+        for name, val in zip(loop.canon_params, params_list):
+            env[name] = val
+        cell = [jax.random.fold_in(key, 0)]
+        for op in loop.segments[0]:
+            run_op(op, env, rng_cell=cell,
+                   rng_salt=_fold_salt(op._uid, seg_idx))
+        return env[loop.bounds[1]]
+
+    def _run_loop(self, loop, env, key):
+        h0 = env[loop.bounds[0]]
+        n_seg = len(loop.segments)
+        # stack per-segment params positionally; grads flow back
+        # through the stack to the per-name leaves
+        stacked = []
+        for pos in range(len(loop.canon_params)):
+            leaves = [env[loop.seg_params[s][pos]]
+                      for s in range(n_seg)]
+            st = jnp.stack(leaves)
+            if self.pp > 1:
+                st = lax.with_sharding_constraint(
+                    st, NamedSharding(self.mesh, P(self.axis)))
+            stacked.append(st)
+        if self.pp == 1:
+            def body(h, xs):
+                params, j = xs
+                return self._seg_apply(loop, params, h, env, key, j), None
+            h, _ = lax.scan(body, h0,
+                            (tuple(stacked), jnp.arange(n_seg)))
+            return h
+        return self._run_loop_gpipe(loop, stacked, h0, env, key)
+
+    def _run_loop_gpipe(self, loop, stacked, h0, env, key):
+        n_micro, pp, axis = self.n_micro, self.pp, self.axis
+        B = h0.shape[0]
+        if B % n_micro:
+            raise ValueError(
+                f"batch {B} not divisible by n_micro {n_micro}")
+        mb = B // n_micro
+        k = len(loop.segments) // pp
+
+        bb_names, const_names = [], []
+        for n in loop.bcast:
+            v = env[n]
+            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == B:
+                bb_names.append(n)
+            else:
+                const_names.append(n)
+        xs_h = h0.reshape((n_micro, mb) + h0.shape[1:])
+        xs_bb = [env[n].reshape((n_micro, mb) + env[n].shape[1:])
+                 for n in bb_names]
+        consts = [env[n] for n in const_names]
+
+        def local(stk, xs_h, xs_bb, consts, key):
+            n = lax.psum(1, axis)
+            idx = lax.axis_index(axis)
+            bc_env = dict(zip(const_names, consts))
+            total = n_micro + n - 1
+            perm = [(i, (i + 1) % n) for i in range(n)]
+
+            def stage(h, bb, key):
+                bc = dict(bc_env)
+                bc.update(zip(bb_names, bb))
+
+                def seg_body(hc, xs):
+                    params, j = xs
+                    out = self._seg_apply(loop, params, hc, bc, key,
+                                          idx * k + j)
+                    return out, None
+
+                h, _ = lax.scan(seg_body, h,
+                                (tuple(stk), jnp.arange(k)))
+                return h
+
+            def pick(t):
+                i = jnp.clip(t, 0, n_micro - 1)
+                return (lax.dynamic_index_in_dim(xs_h, i, keepdims=False),
+                        [lax.dynamic_index_in_dim(x, i, keepdims=False)
+                         for x in xs_bb])
+
+            h_init, bb_init = pick(jnp.asarray(0))
+            h_init = _vary(h_init, axis)
+            bb_init = [_vary(x, axis) for x in bb_init]
+            outs0 = _vary(jnp.zeros((n_micro, mb) + h_init.shape[1:],
+                                    h_init.dtype), axis)
+
+            def tick(carry, t):
+                h, bb, outs = carry
+                feed_h, feed_bb = pick(t)
+                h_in = jnp.where(idx == 0, feed_h, h)
+                bb_in = [jnp.where(idx == 0, f, c)
+                         for f, c in zip(feed_bb, bb)]
+                # fold the microbatch being processed (t - idx during
+                # the steady state) into the key so sampling ops draw
+                # DIFFERENT noise per microbatch, not one mask reused
+                # n_micro times
+                mb_key = jax.random.fold_in(
+                    key, jnp.clip(t - idx, 0, n_micro - 1))
+                out = stage(h_in, bb_in, mb_key)
+                slot = t - (n - 1)
+                write = jnp.logical_and(
+                    idx == n - 1,
+                    jnp.logical_and(slot >= 0, slot < n_micro))
+                upd = lax.dynamic_update_index_in_dim(
+                    outs, out[None], jnp.clip(slot, 0, n_micro - 1), 0)
+                outs = jnp.where(write, upd, outs)
+                ring = [lax.ppermute(x, axis, perm)
+                        for x in [out] + bb_in]
+                return (ring[0], ring[1:], outs), None
+
+            (_, _, outs), _ = lax.scan(
+                tick, (h_init, bb_init, outs0),
+                jnp.arange(total))
+            outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
+            return lax.psum(outs, axis)
+
+        fn = jax.shard_map(
+            local, mesh=self.mesh,
+            in_specs=([P(axis)] * len(stacked),
+                      P(), [P()] * len(xs_bb),
+                      [P()] * len(consts), P()),
+            out_specs=P())
+        ys = fn(stacked, xs_h, xs_bb, consts, key)
+        return ys.reshape((B,) + ys.shape[2:])
+
+    # ------------------------------------------------------------------
+    def _build_step(self):
+        diff_names = [
+            n for n in self.params_a
+            if jnp.issubdtype(jnp.asarray(self.state[n]).dtype,
+                              jnp.floating)]
+        nondiff = [n for n in self.state_names if n not in diff_names]
+        sections, phase_b = self.sections, self.phase_b
+        loss_name, aux_names = self.loss_name, self.aux_names
+        state_out = self.state_out
+
+        def loss_fn(diff_params, nondiff_state, feeds, key):
+            env = {}
+            env.update(nondiff_state)
+            env.update(diff_params)
+            env.update(feeds)
+            cell = [jax.random.fold_in(key, 1)]
+            for sec in sections:
+                if sec.kind == "repl":
+                    for op in sec.ops:
+                        run_op(op, env, rng_cell=cell,
+                               rng_salt=op._uid)
+                else:
+                    env[sec.loop.bounds[-1]] = self._run_loop(
+                        sec.loop, env, jax.random.fold_in(key, 2))
+            aux = {n: env[n] for n in aux_names if n in env}
+            for n in state_out:
+                if n in env:
+                    aux.setdefault(n, env[n])
+            # mean() returns a [1] tensor; grad needs a scalar
+            return jnp.reshape(env[loss_name], ()), aux
+
+        def step(state, feeds, rng):
+            key, rng_next = jax.random.split(rng)
+            diff = {n: state[n] for n in diff_names}
+            nond = {n: state[n] for n in nondiff}
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(diff, nond, feeds, key)
+            env = dict(state)
+            env.update(feeds)
+            env.update(aux)
+            for n, g in grads.items():
+                env[grad_var_name(n)] = g
+            cell = [jax.random.fold_in(key, 3)]
+            for op in phase_b:
+                run_op(op, env, rng_cell=cell, rng_salt=op._uid)
+            new_state = dict(state)
+            for n in self.state_names:
+                if n in env:
+                    new_state[n] = env[n]
+            return new_state, loss, rng_next
+
+        return step
+
+    # ------------------------------------------------------------------
+    def run(self, feed: Dict, fetch_list=None):
+        """One training step. Returns [loss] (plus any fetched state
+        vars named in fetch_list)."""
+        if not self.state:
+            raise RuntimeError(
+                "PipelineTrainer.run before initialize(scope)")
+        feeds = {}
+        block = self.program.global_block
+        for n in self.feed_names:
+            if n not in feed:
+                raise KeyError(f"missing feed {n!r}")
+            v = block._find_var_recursive(n)
+            from ..core.types import to_np_dtype
+            arr = np.asarray(feed[n])
+            want = to_np_dtype(v.dtype) if v is not None and v.dtype \
+                else arr.dtype
+            if arr.dtype != want and (
+                    np.issubdtype(arr.dtype, np.floating)
+                    == np.issubdtype(want, np.floating)):
+                arr = arr.astype(want)
+            feeds[n] = arr
+        spec = tuple(sorted((n, a.shape, str(a.dtype))
+                            for n, a in feeds.items()))
+        if self._jitted is None or self._feed_spec != spec:
+            step = self._build_step()
+            self._jitted = jax.jit(step, donate_argnums=(0,))
+            self._feed_spec = spec
+        self.state, loss, self._rng = self._jitted(
+            self.state, feeds, self._rng)
+        out = [np.asarray(loss)]
+        for f in (fetch_list or []):
+            name = f.name if hasattr(f, "name") else f
+            if name == self.loss_name:
+                continue
+            out.append(np.asarray(self.state[name]))
+        return out
+
+
+def _vary(x, axis_name):
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    return lax.pvary(x, axis_name)
+
+
+def _fold_salt(uid, seg_idx):
+    """Combine op uid with the (possibly traced) segment index so
+    sampling ops in different segments draw different noise."""
+    return uid + 100003 * seg_idx

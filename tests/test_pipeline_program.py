@@ -1,0 +1,326 @@
+"""Pipeline parallelism as a framework capability: Program partition +
+GPipe schedule + the program's own optimizer ops (parallel/
+pipeline_program.py).
+
+Parity standard (VERDICT r2 #3): a transformer (not a toy) trained
+pp=2 on the virtual mesh must produce the same losses as the
+single-device Executor to tight tolerance over >=5 steps.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
+from paddle_tpu.parallel.pipeline_program import (
+    PipelineTrainer, PipelinePartitionError, propose_loops)
+
+
+def _fresh():
+    fluid._reset_global_scope()
+    from paddle_tpu import unique_name
+    unique_name.switch()
+
+
+def _build_mlp(n_layers=4, seed=11):
+    prog, startup = fluid.Program(), fluid.Program()
+    prog._seed = seed
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = x
+        bounds = [h.name]
+        for i in range(n_layers):
+            h = fluid.layers.fc(
+                h, size=16, act="tanh",
+                param_attr=fluid.ParamAttr(name=f"l{i}_w"),
+                bias_attr=fluid.ParamAttr(name=f"l{i}_b"))
+            bounds.append(h.name)
+        logits = fluid.layers.fc(
+            h, size=3, param_attr=fluid.ParamAttr(name="head_w"),
+            bias_attr=fluid.ParamAttr(name="head_b"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    return prog, startup, loss, bounds
+
+
+def _mlp_data():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 16).astype(np.float32)
+    ys = np.argmax(xs[:, :3], 1).astype(np.int64)[:, None]
+    return xs, ys
+
+
+def _exec_losses(prog, startup, loss, feed, steps):
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    out = []
+    for _ in range(steps):
+        l, = exe.run(prog, feed=feed, fetch_list=[loss], scope=sc)
+        out.append(float(np.asarray(l).reshape(-1)[0]))
+    return out
+
+
+def _trainer_losses(prog, startup, loss, loops, feed, steps, mesh=None,
+                    n_micro=1):
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    tr = PipelineTrainer(prog, loss, loops=loops, mesh=mesh,
+                         n_micro=n_micro)
+    tr.initialize(sc)
+    out = []
+    for _ in range(steps):
+        l, = tr.run(feed=feed)
+        out.append(float(np.asarray(l).reshape(-1)[0]))
+    return out, tr, sc
+
+
+class TestScanOverLayers:
+    """pp=1: the loop lowers to lax.scan over stacked layer params."""
+
+    def test_mlp_parity_with_executor(self):
+        xs, ys = _mlp_data()
+        prog, startup, loss, bounds = _build_mlp()
+        base = _exec_losses(prog, startup, loss,
+                            {"x": xs, "y": ys}, 6)
+        _fresh()
+        prog2, startup2, loss2, bounds2 = _build_mlp()
+        got, _, _ = _trainer_losses(prog2, startup2, loss2, [bounds2],
+                                    {"x": xs, "y": ys}, 6)
+        np.testing.assert_allclose(base, got, rtol=2e-4, atol=2e-5)
+
+    def test_write_back_syncs_scope(self):
+        xs, ys = _mlp_data()
+        prog, startup, loss, bounds = _build_mlp()
+        _, tr, sc = _trainer_losses(prog, startup, loss, [bounds],
+                                    {"x": xs, "y": ys}, 3)
+        before = np.asarray(sc._get("l0_w")).copy()
+        tr.write_back(sc)
+        after = np.asarray(sc._get("l0_w"))
+        assert np.abs(after - before).max() > 0
+
+    def test_scan_shrinks_the_jaxpr(self):
+        """The point of the lowering: program size stops growing
+        linearly in depth."""
+        xs, ys = _mlp_data()
+
+        def jaxpr_len(n_layers):
+            _fresh()
+            prog, startup, loss, bounds = _build_mlp(n_layers)
+            exe = fluid.Executor(fluid.CPUPlace())
+            sc = fluid.Scope()
+            exe.run(startup, scope=sc)
+            tr = PipelineTrainer(prog, loss, loops=[bounds])
+            tr.initialize(sc)
+            feeds = {"x": xs, "y": ys}
+            step = tr._build_step()
+            jx = jax.make_jaxpr(step)(tr.state, feeds, tr._rng)
+            return len(str(jx))
+
+        l4, l8 = jaxpr_len(4), jaxpr_len(8)
+        # scan keeps ONE copy of the layer body; growth comes only
+        # from the per-layer optimizer ops, far below linear doubling
+        assert l8 < l4 * 1.5, (l4, l8)
+
+
+class TestGPipeProgram:
+    def test_mlp_pp2_parity(self):
+        xs, ys = _mlp_data()
+        prog, startup, loss, bounds = _build_mlp()
+        base = _exec_losses(prog, startup, loss, {"x": xs, "y": ys}, 6)
+        _fresh()
+        prog2, startup2, loss2, bounds2 = _build_mlp()
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        got, _, _ = _trainer_losses(prog2, startup2, loss2, [bounds2],
+                                    {"x": xs, "y": ys}, 6, mesh=mesh,
+                                    n_micro=4)
+        np.testing.assert_allclose(base, got, rtol=2e-4, atol=2e-5)
+
+    def test_mlp_pp4_two_segments_per_stage(self):
+        xs, ys = _mlp_data()
+        prog, startup, loss, bounds = _build_mlp(8)
+        base = _exec_losses(prog, startup, loss, {"x": xs, "y": ys}, 5)
+        _fresh()
+        prog2, startup2, loss2, bounds2 = _build_mlp(8)
+        mesh = make_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+        got, _, _ = _trainer_losses(prog2, startup2, loss2, [bounds2],
+                                    {"x": xs, "y": ys}, 5, mesh=mesh,
+                                    n_micro=8)
+        np.testing.assert_allclose(base, got, rtol=2e-4, atol=2e-5)
+
+
+class TestTransformerPipeline:
+    """The VERDICT bar: a real transformer through the Program path."""
+
+    V, T, D, L = 60, 8, 32, 4
+
+    def _data(self):
+        rng = np.random.RandomState(0)
+        return {
+            "src_ids": rng.randint(1, self.V, (16, self.T)).astype(
+                np.int64),
+            "tgt_ids": rng.randint(1, self.V, (16, self.T)).astype(
+                np.int64),
+            "label": rng.randint(1, self.V, (16, self.T)).astype(
+                np.int64),
+        }
+
+    def _build(self, dropout=0.0, seed=5):
+        from paddle_tpu.models import transformer as T
+
+        main, startup, loss = T.build_program(
+            seq_len=self.T, d_model=self.D, n_heads=2,
+            n_layers=self.L, d_inner=64, vocab=self.V,
+            dropout_rate=dropout, learning_rate=1.0, warmup_steps=40)
+        main._seed = seed
+        return main, startup, loss
+
+    def test_auto_detects_encoder_and_decoder_loops(self):
+        main, _, loss = self._build()
+        loops = propose_loops(main, loss.name)
+        assert len(loops) == 2
+        assert all(len(b) - 1 == self.L for b in loops)
+
+    def test_pp2_loss_parity_with_executor(self):
+        feed = self._data()
+        main, startup, loss = self._build()
+        base = _exec_losses(main, startup, loss, feed, 5)
+        _fresh()
+        main2, startup2, loss2 = self._build()
+        loops = propose_loops(main2, loss2.name)
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        got, _, _ = _trainer_losses(main2, startup2, loss2, loops,
+                                    feed, 5, mesh=mesh, n_micro=4)
+        np.testing.assert_allclose(base, got, rtol=5e-4, atol=5e-5)
+        assert got[-1] < got[0]  # and it actually trains
+
+    def test_pp4_loss_parity(self):
+        feed = self._data()
+        main, startup, loss = self._build()
+        base = _exec_losses(main, startup, loss, feed, 4)
+        _fresh()
+        main2, startup2, loss2 = self._build()
+        loops = propose_loops(main2, loss2.name)
+        mesh = make_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+        got, _, _ = _trainer_losses(main2, startup2, loss2, loops,
+                                    feed, 4, mesh=mesh, n_micro=4)
+        np.testing.assert_allclose(base, got, rtol=5e-4, atol=5e-5)
+
+    def test_dropout_trains_through_pipeline(self):
+        """No executor parity (rng streams differ), but microbatched
+        dropout must train and stay finite."""
+        feed = self._data()
+        _fresh()
+        main, startup, loss = self._build(dropout=0.1)
+        loops = propose_loops(main, loss.name)
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        got, _, _ = _trainer_losses(main, startup, loss, loops, feed,
+                                    6, mesh=mesh, n_micro=4)
+        assert all(np.isfinite(got))
+        assert got[-1] < got[0]
+
+
+class TestPartitionValidation:
+    def test_skip_connection_is_a_named_error(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h0 = fluid.layers.fc(x, size=8, act="tanh")
+            # two isomorphic segments [fc, relu, add]; segment 2's add
+            # reads t1, an INTERNAL var of segment 1 (not a boundary)
+            t1 = fluid.layers.fc(h0, size=8)
+            h1 = fluid.layers.elementwise_add(
+                fluid.layers.relu(t1), x)
+            t2 = fluid.layers.fc(h1, size=8)
+            h2 = fluid.layers.elementwise_add(
+                fluid.layers.relu(t2), t1)
+            logits = fluid.layers.fc(h2, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        with pytest.raises(PipelinePartitionError,
+                           match="skip connection|another segment"):
+            PipelineTrainer(prog, loss,
+                            loops=[[h0.name, h1.name, h2.name]])
+
+    def test_non_isomorphic_segments_rejected(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h0 = fluid.layers.fc(x, size=8, act="tanh")
+            h1 = fluid.layers.fc(h0, size=8, act="tanh")
+            h2 = fluid.layers.fc(h1, size=8, act="relu")  # extra op mix
+            h2 = fluid.layers.elementwise_add(h2, h0)
+            logits = fluid.layers.fc(h2, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        with pytest.raises(PipelinePartitionError,
+                           match="not isomorphic"):
+            PipelineTrainer(prog, loss,
+                            loops=[[h0.name, h1.name, h2.name]])
+
+    def test_uneven_segments_rejected(self):
+        xs, ys = _mlp_data()
+        prog, startup, loss, bounds = _build_mlp(3)
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        with pytest.raises(PipelinePartitionError,
+                           match="not divisible"):
+            PipelineTrainer(prog, loss, loops=[bounds], mesh=mesh)
+
+    def test_stateful_ops_in_segments_rejected(self):
+        """batch_norm's running-stat writes can't be threaded out of
+        the stage scan; must be a named error, not silent staleness."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[8],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h0 = fluid.layers.fc(x, size=8, act="tanh")
+            h1 = fluid.layers.batch_norm(fluid.layers.fc(h0, size=8))
+            h2 = fluid.layers.batch_norm(fluid.layers.fc(h1, size=8))
+            logits = fluid.layers.fc(h2, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        with pytest.raises(PipelinePartitionError,
+                           match="persistable|stateful"):
+            PipelineTrainer(prog, loss,
+                            loops=[[h0.name, h1.name, h2.name]])
+
+    def test_mismatched_broadcast_reads_rejected(self):
+        """Each segment reading its OWN pre-loop var would silently
+        execute with segment 0's var (segment 0's trace serves all);
+        must be a named error."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[8],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            m0 = fluid.layers.scale(x, scale=0.5)   # per-layer biases
+            m1 = fluid.layers.scale(x, scale=0.25)
+            h0 = fluid.layers.fc(x, size=8, act="tanh")
+            h1 = fluid.layers.elementwise_add(
+                fluid.layers.fc(h0, size=8), m0)
+            h2 = fluid.layers.elementwise_add(
+                fluid.layers.fc(h1, size=8), m1)
+            logits = fluid.layers.fc(h2, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        with pytest.raises(PipelinePartitionError,
+                           match="broadcast|identical"):
+            PipelineTrainer(prog, loss,
+                            loops=[[h0.name, h1.name, h2.name]])
+
+    def test_run_before_initialize_raises(self):
+        prog, startup, loss, bounds = _build_mlp()
+        tr = PipelineTrainer(prog, loss, loops=[bounds])
+        with pytest.raises(RuntimeError, match="initialize"):
+            tr.run(feed={})
