@@ -293,6 +293,29 @@ def _default_layout_specs(step, scope, mutated, const, feed_arrays,
     return in_fmts, out_fmts
 
 
+def _parallel_scope_token():
+    """Part of the executable cache key: the context-parallel and
+    expert-parallel activation scopes change how attention/switch_moe
+    ops LOWER at trace time (shard_map vs single-device), so entering
+    or leaving a scope must miss the cache the same way an AMP toggle
+    does — otherwise a stale dense executable is silently served."""
+    try:
+        from ..parallel.ring_attention import active_context_parallel
+        from ..parallel.moe import active_expert_parallel
+    except Exception:
+        return ()
+    tok = []
+    cp = active_context_parallel()
+    if cp is not None:
+        mesh, axis, impl = cp
+        tok.append(("cp", id(mesh), axis, impl))
+    ep = active_expert_parallel()
+    if ep is not None:
+        mesh, axis = ep
+        tok.append(("ep", id(mesh), axis))
+    return tuple(tok)
+
+
 def _var_np_dtype(block, name, default=np.float32):
     v = block._find_var_recursive(name)
     if v is None or v.dtype is None:
@@ -388,7 +411,8 @@ class Executor:
         from .. import amp
 
         key = (id(program), program._version, tuple(sorted(feed_specs)),
-               tuple(fetch_names), amp.state_token())
+               tuple(fetch_names), amp.state_token(),
+               _parallel_scope_token())
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
             compiled = self._compile(program, block,

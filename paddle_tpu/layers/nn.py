@@ -1295,6 +1295,52 @@ def deformable_conv(input, offset, mask=None, num_filters=None,
     return helper.append_activation(out)
 
 
+def switch_moe(input, num_experts, d_inner, top_k=1,
+               capacity_factor=2.0, param_attr=None, name=None):
+    """Switch/GShard mixture-of-experts FFN (beyond-reference; routing
+    math + expert-parallel dataflow in parallel/moe.py, lowered by the
+    `switch_moe` op). Returns (out, aux_loss): add
+    ``aux_loss * coeff`` (Switch uses coeff=0.01) onto the training
+    loss or routing collapses onto one expert.
+
+    input: [..., D]; experts are [D, d_inner] -> [d_inner, D] relu
+    MLPs. Under `with expert_parallel(mesh):` the op runs all_to_all
+    expert-parallel over the 'ep' mesh axis."""
+    helper = LayerHelper("switch_moe", input=input,
+                         param_attr=param_attr, name=name)
+    d = input.shape[-1]
+    prefix = name or helper.name
+    std = (2.0 / d) ** 0.5
+
+    def _attr(suffix):
+        from ..param_attr import ParamAttr
+        import copy as _copy
+
+        a = ParamAttr._to_attr(param_attr)
+        a = ParamAttr() if a is None else _copy.copy(a)
+        a.name = f"{prefix}_{suffix}" if a.name is None \
+            else f"{a.name}_{suffix}"
+        return a
+
+    wg = helper.create_parameter(
+        _attr("gate_w"), [d, num_experts], input.dtype,
+        default_initializer=NormalInitializer(0.0, 0.02))
+    w1 = helper.create_parameter(
+        _attr("expert_w1"), [num_experts, d, d_inner], input.dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    w2 = helper.create_parameter(
+        _attr("expert_w2"), [num_experts, d_inner, d], input.dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    aux = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "switch_moe",
+        {"X": input, "GateW": wg, "W1": w1, "W2": w2},
+        {"Out": out, "AuxLoss": aux},
+        {"top_k": int(top_k), "capacity_factor": float(capacity_factor)})
+    return out, aux
+
+
 def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
     helper = LayerHelper("im2sequence", input=input, name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
@@ -1349,6 +1395,7 @@ def attention(q, k, v, causal=False, scale=None, dropout_rate=0.0,
 
 
 __all__.append("attention")
+__all__.append("switch_moe")
 __all__.extend(["linear_chain_crf", "linear_chain_crf_raw",
                 "crf_decoding", "crf_decoding_raw"])
 

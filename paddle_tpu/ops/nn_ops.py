@@ -209,6 +209,39 @@ def deformable_conv(ctx):
     return {"Output": out}
 
 
+@register_op("switch_moe")
+def switch_moe(ctx):
+    """Switch/GShard mixture-of-experts FFN block (beyond-reference
+    capability; see parallel/moe.py for the routing math and the
+    expert-parallel dataflow). Inside a `with expert_parallel(mesh):`
+    scope and when token/expert counts divide the ep axis, lowers to
+    the shard_map all_to_all form; otherwise runs the identical dense
+    math on one device — ep=N and ep=1 are numerically interchangeable
+    in the no-drop capacity regime (per-shard FIFO capacity can drop
+    different tokens when over-subscribed)."""
+    from ..parallel import moe as moe_mod
+
+    x = ctx.input("X")            # [..., D]
+    wg = ctx.input("GateW")       # [D, E]
+    w1 = ctx.input("W1")          # [E, D, F]
+    w2 = ctx.input("W2")          # [E, F, D]
+    top_k = int(ctx.attr("top_k", 1))
+    cf = float(ctx.attr("capacity_factor", 2.0))
+    shape = x.shape
+    d = shape[-1]
+    xt = x.reshape(-1, d)
+    t, E = xt.shape[0], w1.shape[0]
+    if moe_mod.ep_applicable(t, E):
+        mesh, axis = moe_mod.active_expert_parallel()
+        out, aux = moe_mod.moe_apply(xt, wg, w1, w2, mesh, axis=axis,
+                                     capacity_factor=cf, top_k=top_k)
+    else:
+        cap = max(1, int(cf * top_k * t / E))
+        out, aux = moe_mod.moe_dense(xt, wg, w1, w2, cap, top_k)
+    return {"Out": out.reshape(shape),
+            "AuxLoss": aux.reshape(1).astype(jnp.float32)}
+
+
 @register_op("conv3d")
 def conv3d(ctx):
     x = ctx.input("Input")

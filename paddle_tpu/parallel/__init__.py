@@ -14,4 +14,6 @@ from .ring_attention import (ring_self_attention, context_parallel,
                              ring_attention_local,
                              ulysses_attention_local)  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401
-from .moe import moe_apply  # noqa: F401
+from .moe import moe_apply, expert_parallel  # noqa: F401
+from .pipeline_program import (PipelineTrainer,
+                               propose_loops)  # noqa: F401

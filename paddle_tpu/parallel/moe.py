@@ -1,10 +1,22 @@
 """Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
 
 Beyond-reference capability (SURVEY.md §2.4: expert parallelism ABSENT):
-Switch-Transformer-style top-1 routing with fixed expert capacity,
-experts sharded over 'ep', token dispatch/return as `lax.all_to_all`
-over ICI -- the standard TPU MoE dataflow (dispatch einsum -> a2a ->
-expert FFN -> a2a -> combine einsum), fully differentiable.
+Switch-Transformer-style routing (top-1, Fedus et al. '21) and GShard
+top-2 (Lepikhin et al. '20) with fixed expert capacity, the
+load-balancing auxiliary loss (Switch eq. 4), experts sharded over
+'ep', token dispatch/return as `lax.all_to_all` over ICI -- the
+standard TPU MoE dataflow (dispatch einsum -> a2a -> expert FFN -> a2a
+-> combine einsum), fully differentiable.
+
+Three entry points:
+* `route_tokens` -- router math shared by every path: top-k selection,
+  priority-ordered capacity assignment, dispatch/combine tensors, aux
+  loss. Pure and mesh-free.
+* `moe_apply` / `moe_local` -- the shard_map expert-parallel form.
+* the `switch_moe` graph op (ops/nn_ops.py) + `layers.switch_moe` --
+  the Program path; inside a `with expert_parallel(mesh):` scope the op
+  lowers to the shard_map form, otherwise it runs the identical dense
+  math on one device, so ep=N and ep=1 are numerically interchangeable.
 
 Layout contract inside shard_map:
   x_local:  [t, d]            tokens sharded over ep
@@ -12,7 +24,8 @@ Layout contract inside shard_map:
   w1_local: [e_local, d, f]   this shard's experts
   w2_local: [e_local, f, d]
 Over-capacity tokens are dropped (output zero), matching the canonical
-Switch formulation.
+Switch formulation. Combine scaling: raw router prob for top-1
+(Switch), probs normalized over the chosen k for k>1 (GShard).
 """
 from __future__ import annotations
 
@@ -24,63 +37,169 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+__all__ = ["route_tokens", "moe_local", "moe_apply", "expert_parallel",
+           "active_expert_parallel", "moe_dense"]
 
-def moe_local(x, wg, w1, w2, axis_name: str, capacity: int):
+
+def route_tokens(x, wg, capacity: int, top_k: int = 1):
+    """Router + capacity assignment.
+
+    x: [t, d]; wg: [d, E]. Returns (dispatch [t,E,C] 0/1,
+    combine [t,E,C] float weights, aux_loss scalar, gates [t,E]).
+
+    Capacity is assigned in choice-priority order (every token's first
+    choice before any second choice -- the GShard ordering), each
+    choice FIFO by token index. The aux loss is Switch eq. 4:
+    E * sum_e f_e * P_e with f_e the fraction of tokens whose PRIMARY
+    choice is e and P_e the mean router probability of e; it is 1.0 at
+    perfect balance and rises as routing collapses.
+    """
+    t, d = x.shape
+    E = wg.shape[-1]
+    C = capacity
+    logits = (x.astype(jnp.float32) @ wg.astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)              # [t, E]
+    gval, gidx = lax.top_k(gates, top_k)                 # [t, k]
+    if top_k > 1:
+        scale = gval / jnp.maximum(
+            gval.sum(-1, keepdims=True), 1e-9)
+    else:
+        scale = gval                                     # Switch: raw p
+
+    dispatch = jnp.zeros((t, E, C), jnp.float32)
+    combine = jnp.zeros((t, E, C), jnp.float32)
+    counts = jnp.zeros((E,), jnp.float32)
+    for j in range(top_k):
+        oh = jax.nn.one_hot(gidx[:, j], E, dtype=jnp.float32)
+        pos = (jnp.cumsum(oh, axis=0) - 1.0) * oh + counts[None, :] * oh
+        keep = (pos < C) & (oh > 0)
+        posC = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                              dtype=jnp.float32)
+        sel = posC * keep[..., None]
+        dispatch = dispatch + sel
+        combine = combine + sel * scale[:, j][:, None, None]
+        counts = counts + (oh * keep).sum(0)
+
+    prim = jax.nn.one_hot(gidx[:, 0], E, dtype=jnp.float32)
+    f = prim.mean(0)
+    p = gates.mean(0)
+    aux = E * jnp.sum(f * p)
+    return dispatch, combine, aux, gates
+
+
+def moe_dense(x, wg, w1, w2, capacity: int, top_k: int = 1):
+    """Single-device MoE forward with the SAME routing/capacity math
+    as the expert-parallel form (used by the `switch_moe` op outside an
+    expert_parallel scope). x: [t, d]. Returns (out [t, d], aux)."""
+    dispatch, combine, aux, _ = route_tokens(x, wg, capacity, top_k)
+    # router math stays fp32 (route_tokens); the expert FFN — the
+    # dominant FLOPs — runs in the input dtype so bf16/AMP models keep
+    # their MXU precision
+    dispatch = dispatch.astype(x.dtype)
+    xs = jnp.einsum("tec,td->ecd", dispatch, x)          # [E, C, d]
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", xs, w1.astype(x.dtype)))
+    y = jnp.einsum("ecf,efd->ecd", h, w2.astype(x.dtype))
+    out = jnp.einsum("ecd,tec->td", y, combine.astype(x.dtype))
+    return out, aux
+
+
+def moe_local(x, wg, w1, w2, axis_name: str, capacity: int,
+              top_k: int = 1):
+    """shard_map body. Returns (out_local [t, d], aux scalar
+    replicated). Aux statistics are psum-averaged over shards so the
+    value equals the global-batch formula."""
     n = lax.psum(1, axis_name)
     t, d = x.shape
     e_local = w1.shape[0]
     E = e_local * n
     C = capacity
 
-    logits = x @ wg                                     # [t, E]
-    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    gate_val = gates.max(axis=-1)                       # [t]
-    expert = gates.argmax(axis=-1)                      # [t]
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
-    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # position in expert
-    keep = (pos < C) & (onehot > 0)
-    # dispatch tensor [t, E, C]
-    posC = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)
-    dispatch = posC * keep[..., None]
-    xs = jnp.einsum("tec,td->ecd", dispatch,
-                    x.astype(jnp.float32))              # [E, C, d]
+    dispatch, combine, _, gates = route_tokens(x, wg, C, top_k)
+    # global aux: f and P averaged over ALL tokens (tokens are evenly
+    # sharded, so mean-of-means == global mean)
+    prim = jax.nn.one_hot(jnp.argmax(gates, -1), E, dtype=jnp.float32)
+    f = lax.psum(prim.mean(0), axis_name) / n
+    p = lax.psum(gates.mean(0), axis_name) / n
+    aux = E * jnp.sum(f * p)
+
+    # expert FFN in the input dtype (router stays fp32; see moe_dense)
+    xs = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
     # scatter expert groups to their owner shards; gather this shard's
     # experts' tokens from every shard: [E, C, d] -> [e_local, n*C, d]
     recv = lax.all_to_all(xs, axis_name, split_axis=0, concat_axis=1,
                           tiled=True)
     h = jax.nn.relu(jnp.einsum("ekd,edf->ekf", recv,
-                               w1.astype(jnp.float32)))
-    y = jnp.einsum("ekf,efd->ekd", h, w2.astype(jnp.float32))
+                               w1.astype(x.dtype)))
+    y = jnp.einsum("ekf,efd->ekd", h, w2.astype(x.dtype))
     # route results back: [e_local, n*C, d] -> [E, C, d]
     back = lax.all_to_all(y, axis_name, split_axis=1, concat_axis=0,
                           tiled=True)
-    combine = dispatch * gate_val[:, None, None]
-    out = jnp.einsum("ecd,tec->td", back, combine)
-    return out.astype(x.dtype)
+    out = jnp.einsum("ecd,tec->td", back, combine.astype(x.dtype))
+    return out, aux
 
 
 def moe_apply(x, wg, w1, w2, mesh: Mesh, axis: str = "ep",
-              capacity_factor: float = 2.0):
+              capacity_factor: float = 2.0, top_k: int = 1):
     """x: [tokens, d] global; wg: [d, E]; w1: [E, d, f]; w2: [E, f, d].
-    Tokens and experts are sharded over `axis`; returns [tokens, d]."""
+    Tokens and experts are sharded over `axis`; returns
+    (out [tokens, d], aux_loss scalar)."""
     n = mesh.shape[axis]
     t, E = x.shape[0], w1.shape[0]
     assert t % n == 0 and E % n == 0, \
         f"tokens({t}) and experts({E}) must divide ep({n})"
-    cap = max(1, int(capacity_factor * (t // n) / E))
-    body = functools.partial(moe_local, axis_name=axis, capacity=cap)
+    cap = max(1, int(capacity_factor * top_k * (t // n) / E))
+    body = functools.partial(moe_local, axis_name=axis, capacity=cap,
+                             top_k=top_k)
     fn = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
-        out_specs=P(axis))
+        out_specs=(P(axis), P()))
     put = lambda a, s: jax.device_put(a, NamedSharding(mesh, s))
     return fn(put(x, P(axis)), put(wg, P()), put(w1, P(axis)),
               put(w2, P(axis)))
 
 
+# --- expert-parallel activation scope --------------------------------------
+# The `switch_moe` op (ops/nn_ops.py) consults this the same way the
+# attention op consults context_parallel: inside the scope, eligible MoE
+# ops lower to the shard_map expert-parallel dataflow over the given
+# mesh axis; outside it they run moe_dense on one device.
+_ACTIVE_EP = None
+
+
+class expert_parallel:
+    """`with expert_parallel(mesh, axis='ep'):` -- route framework
+    switch_moe ops through the all_to_all expert-parallel dataflow."""
+
+    def __init__(self, mesh: Mesh, axis: str = "ep"):
+        self.cfg = (mesh, axis)
+
+    def __enter__(self):
+        global _ACTIVE_EP
+        self._prev = _ACTIVE_EP
+        _ACTIVE_EP = self.cfg
+        return self
+
+    def __exit__(self, *a):
+        global _ACTIVE_EP
+        _ACTIVE_EP = self._prev
+
+
+def active_expert_parallel():
+    return _ACTIVE_EP
+
+
+def ep_applicable(n_tokens: int, n_experts: int) -> bool:
+    if _ACTIVE_EP is None:
+        return False
+    mesh, axis = _ACTIVE_EP
+    n = mesh.shape[axis]
+    return n > 1 and n_tokens % n == 0 and n_experts % n == 0
+
+
 def dryrun(n_devices: int) -> None:
     """Driver smoke: EP MoE vs dense per-token expert application (big
-    capacity so nothing drops)."""
+    capacity so nothing drops), top-1 and top-2."""
     import numpy as np
 
     from .mesh import make_mesh, MeshConfig
@@ -98,8 +217,8 @@ def dryrun(n_devices: int) -> None:
     w1 = jnp.asarray(r.randn(E, d, f).astype(np.float32) * 0.3)
     w2 = jnp.asarray(r.randn(E, f, d).astype(np.float32) * 0.3)
 
-    got = moe_apply(x, wg, w1, w2, mesh, capacity_factor=float(E * 2))
-
+    got, aux = moe_apply(x, wg, w1, w2, mesh,
+                         capacity_factor=float(E * 2))
     gates = jax.nn.softmax(x @ wg, axis=-1)
     idx = jnp.argmax(gates, axis=-1)
     want = jnp.stack([
@@ -107,4 +226,15 @@ def dryrun(n_devices: int) -> None:
         for i in range(t)])
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=1e-5, rtol=1e-4)
-    print(f"dryrun ep: {ep}-shard expert-parallel MoE matches dense ok")
+    assert np.isfinite(float(aux)) and float(aux) >= 1.0 - 1e-5
+
+    # top-2 EP must match the dense path exactly
+    got2, aux2 = moe_apply(x, wg, w1, w2, mesh,
+                           capacity_factor=float(E * 2), top_k=2)
+    want2, auxd = moe_dense(x, wg, w1, w2,
+                            capacity=t * 2, top_k=2)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2),
+                               atol=1e-5, rtol=1e-4)
+    np.testing.assert_allclose(float(aux2), float(auxd), rtol=1e-5)
+    print(f"dryrun ep: {ep}-shard expert-parallel MoE matches dense "
+          f"(top-1 and top-2) ok")

@@ -1,0 +1,427 @@
+"""Productized MoE: top-k routing + Switch aux loss + the
+layers.switch_moe Program path + ep=N/ep=1 interchangeability
+(parallel/moe.py, ops/nn_ops.py switch_moe). VERDICT r2 #4."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
+from paddle_tpu.parallel.moe import (
+    route_tokens, moe_dense, moe_apply, expert_parallel)
+
+
+def _fresh():
+    fluid._reset_global_scope()
+    from paddle_tpu import unique_name
+    unique_name.switch()
+
+
+class TestRouting:
+    def test_top1_aux_loss_formula(self):
+        r = np.random.RandomState(0)
+        x = jnp.asarray(r.randn(32, 8).astype(np.float32))
+        wg = jnp.asarray(r.randn(8, 4).astype(np.float32))
+        _, _, aux, gates = route_tokens(x, wg, capacity=32, top_k=1)
+        g = np.asarray(gates)
+        f = np.bincount(g.argmax(1), minlength=4) / 32.0
+        want = 4 * float((f * g.mean(0)).sum())
+        np.testing.assert_allclose(float(aux), want, rtol=1e-5)
+
+    def test_aux_is_one_at_perfect_balance(self):
+        # uniform router -> f_e = P_e = 1/E -> aux = E * E*(1/E^2) = 1
+        x = jnp.ones((16, 8), jnp.float32)
+        wg = jnp.zeros((8, 4), jnp.float32)
+        _, _, aux, _ = route_tokens(x, wg, capacity=16, top_k=1)
+        np.testing.assert_allclose(float(aux), 1.0, rtol=1e-6)
+
+    def test_top2_combine_weights_normalized(self):
+        r = np.random.RandomState(1)
+        x = jnp.asarray(r.randn(8, 6).astype(np.float32))
+        wg = jnp.asarray(r.randn(6, 4).astype(np.float32))
+        dispatch, combine, _, gates = route_tokens(x, wg, capacity=8,
+                                                   top_k=2)
+        # per token: dispatched to exactly 2 experts, weights sum to 1
+        per_tok = np.asarray(dispatch.sum((1, 2)))
+        np.testing.assert_allclose(per_tok, 2.0)
+        wsum = np.asarray(combine.sum((1, 2)))
+        np.testing.assert_allclose(wsum, 1.0, rtol=1e-5)
+
+    def test_capacity_drops_in_fifo_priority_order(self):
+        # all 4 tokens pick expert 0 (identical rows); capacity 2 ->
+        # first two kept, later two dropped
+        x = jnp.ones((4, 4), jnp.float32)
+        wg = jnp.asarray(
+            np.eye(4, 3, dtype=np.float32) * 5.0)
+        dispatch, _, _, _ = route_tokens(x, wg, capacity=2, top_k=1)
+        kept = np.asarray(dispatch.sum((1, 2)))
+        np.testing.assert_array_equal(kept, [1, 1, 0, 0])
+
+    def test_second_choice_yields_to_first_choices(self):
+        # GShard priority: every token's first choice is placed before
+        # any token's second choice
+        r = np.random.RandomState(2)
+        x = jnp.asarray(r.randn(12, 6).astype(np.float32))
+        wg = jnp.asarray(r.randn(6, 3).astype(np.float32))
+        d1, _, _, gates = route_tokens(x, wg, capacity=4, top_k=2)
+        g = np.asarray(gates)
+        first = g.argmax(1)
+        # every token whose FIRST choice expert has <= capacity primary
+        # takers in front of it must be dispatched to that expert
+        for i in range(12):
+            e = first[i]
+            ahead = int((first[:i] == e).sum())
+            if ahead < 4:
+                assert float(d1[i, e].sum()) == 1.0, (i, e)
+
+
+class TestDenseVsExpertParallel:
+    def test_ep2_matches_dense_top1_and_top2(self):
+        mesh = make_mesh(MeshConfig(ep=2), devices=jax.devices()[:2])
+        r = np.random.RandomState(3)
+        t, d, f, E = 16, 8, 16, 4
+        x = jnp.asarray(r.randn(t, d).astype(np.float32))
+        wg = jnp.asarray(r.randn(d, E).astype(np.float32))
+        w1 = jnp.asarray(r.randn(E, d, f).astype(np.float32) * 0.3)
+        w2 = jnp.asarray(r.randn(E, f, d).astype(np.float32) * 0.3)
+        for k in (1, 2):
+            got, aux_ep = moe_apply(x, wg, w1, w2, mesh,
+                                    capacity_factor=float(2 * E),
+                                    top_k=k)
+            want, aux_d = moe_dense(x, wg, w1, w2, capacity=2 * t,
+                                    top_k=k)
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(want),
+                                       atol=1e-5, rtol=1e-4)
+            np.testing.assert_allclose(float(aux_ep), float(aux_d),
+                                       rtol=1e-5)
+
+    def test_ep4_matches_ep1_numerics(self):
+        mesh = make_mesh(MeshConfig(ep=4), devices=jax.devices()[:4])
+        r = np.random.RandomState(4)
+        t, d, f, E = 32, 8, 16, 4
+        x = jnp.asarray(r.randn(t, d).astype(np.float32))
+        wg = jnp.asarray(r.randn(d, E).astype(np.float32))
+        w1 = jnp.asarray(r.randn(E, d, f).astype(np.float32) * 0.3)
+        w2 = jnp.asarray(r.randn(E, f, d).astype(np.float32) * 0.3)
+        got, _ = moe_apply(x, wg, w1, w2, mesh,
+                           capacity_factor=float(2 * E), top_k=2)
+        want, _ = moe_dense(x, wg, w1, w2, capacity=2 * t, top_k=2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-4)
+
+
+def _build_moe_classifier(E=4, top_k=1, aux_coeff=0.01, seed=7):
+    prog, startup = fluid.Program(), fluid.Program()
+    prog._seed = seed
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="tanh",
+                            param_attr=fluid.ParamAttr(name="in_w"),
+                            bias_attr=fluid.ParamAttr(name="in_b"))
+        moe_out, aux = fluid.layers.switch_moe(
+            h, num_experts=E, d_inner=32, top_k=top_k,
+            capacity_factor=4.0, name="moe0")
+        h = fluid.layers.elementwise_add(h, moe_out)
+        logits = fluid.layers.fc(h, size=4,
+                                 param_attr=fluid.ParamAttr(name="out_w"),
+                                 bias_attr=fluid.ParamAttr(name="out_b"))
+        ce = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        loss = fluid.layers.elementwise_add(
+            ce, fluid.layers.scale(aux, scale=aux_coeff))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    return prog, startup, ce, aux
+
+
+class TestSwitchMoeProgram:
+    def _data(self):
+        r = np.random.RandomState(0)
+        xs = r.randn(64, 16).astype(np.float32)
+        ys = np.argmax(xs[:, :4], 1).astype(np.int64)[:, None]
+        return xs, ys
+
+    def test_trains_through_executor(self):
+        xs, ys = self._data()
+        prog, startup, ce, aux = _build_moe_classifier()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        losses = []
+        for i in range(40):
+            l, a = exe.run(prog, feed={"x": xs, "y": ys},
+                           fetch_list=[ce, aux], scope=sc)
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        # expert weights actually trained (grads flow through a2a-free
+        # dense path)
+        w1 = np.asarray(sc._get("moe0_expert_w1"))
+        assert np.isfinite(w1).all()
+
+    def test_aux_loss_balances_experts(self):
+        """With the aux loss, primary-assignment fractions stay near
+        uniform; without it, routing is measurably less balanced."""
+        xs, ys = self._data()
+
+        def final_balance(aux_coeff, seed):
+            _fresh()
+            prog, startup, ce, aux = _build_moe_classifier(
+                aux_coeff=aux_coeff, seed=seed)
+            exe = fluid.Executor(fluid.CPUPlace())
+            sc = fluid.Scope()
+            exe.run(startup, scope=sc)
+            for i in range(60):
+                exe.run(prog, feed={"x": xs, "y": ys},
+                        fetch_list=[ce], scope=sc)
+            # measure primary assignment fractions with the trained
+            # gate
+            from paddle_tpu.parallel.moe import route_tokens
+            h = np.tanh(xs @ np.asarray(sc._get("in_w"))
+                        + np.asarray(sc._get("in_b")))
+            gates = jax.nn.softmax(
+                jnp.asarray(h) @ jnp.asarray(
+                    np.asarray(sc._get("moe0_gate_w"))), axis=-1)
+            f = np.bincount(np.asarray(gates).argmax(1), minlength=4) \
+                / len(h)
+            return float(((f - 0.25) ** 2).sum())
+
+        imb_with = np.median([final_balance(0.05, s)
+                              for s in (7, 8, 9)])
+        imb_without = np.median([final_balance(0.0, s)
+                                 for s in (7, 8, 9)])
+        assert imb_with < imb_without + 1e-9, \
+            (imb_with, imb_without)
+        assert imb_with < 0.05, imb_with
+
+    def test_top2_program_path(self):
+        xs, ys = self._data()
+        _fresh()
+        prog, startup, ce, aux = _build_moe_classifier(top_k=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        losses = []
+        for i in range(30):
+            l, = exe.run(prog, feed={"x": xs, "y": ys},
+                         fetch_list=[ce], scope=sc)
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_expert_parallel_scope_routes_the_op(self):
+        """Same program, ep=2 scope vs no scope: same loss values in
+        the no-drop capacity regime."""
+        xs, ys = self._data()
+        _fresh()
+        prog, startup, ce, aux = _build_moe_classifier()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        base, = exe.run(prog, feed={"x": xs, "y": ys},
+                        fetch_list=[ce], scope=sc)
+
+        _fresh()
+        prog2, startup2, ce2, aux2 = _build_moe_classifier()
+        sc2 = fluid.Scope()
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2, scope=sc2)
+        mesh = make_mesh(MeshConfig(ep=2), devices=jax.devices()[:2])
+        with expert_parallel(mesh):
+            got, = exe2.run(prog2, feed={"x": xs, "y": ys},
+                            fetch_list=[ce2], scope=sc2)
+        np.testing.assert_allclose(np.asarray(base), np.asarray(got),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestMoeTransformerVariant:
+    """A transformer layer stack whose FFN is switch_moe, trained
+    through the Program path (the VERDICT 'MoE transformer' bar)."""
+
+    def test_moe_transformer_block_trains(self):
+        V, T, D = 40, 8, 32
+        r = np.random.RandomState(0)
+        src = r.randint(1, V, (8, T)).astype(np.int64)
+        lab = np.roll(src, -1, axis=1)
+
+        prog, startup = fluid.Program(), fluid.Program()
+        prog._seed = 5
+        with fluid.program_guard(prog, startup):
+            ids = fluid.layers.data(name="src", shape=[T],
+                                    dtype="int64")
+            y = fluid.layers.data(name="y", shape=[T], dtype="int64")
+            emb = fluid.layers.embedding(
+                ids, size=[V, D],
+                param_attr=fluid.ParamAttr(name="emb"))
+            aux_total = None
+            h = emb
+            for li in range(2):
+                qkv = fluid.layers.reshape(h, [-1, T, 2, D // 2])
+                attn = fluid.layers.attention(
+                    qkv, qkv, qkv, causal=True, layout="bthd",
+                    name=f"l{li}_attn")
+                attn = fluid.layers.reshape(attn, [-1, T, D])
+                h = fluid.layers.layer_norm(
+                    fluid.layers.elementwise_add(h, attn),
+                    param_attr=fluid.ParamAttr(name=f"l{li}_ln1_w"),
+                    bias_attr=fluid.ParamAttr(name=f"l{li}_ln1_b"))
+                moe_out, aux = fluid.layers.switch_moe(
+                    h, num_experts=4, d_inner=64, top_k=2,
+                    capacity_factor=4.0, name=f"l{li}_moe")
+                h = fluid.layers.layer_norm(
+                    fluid.layers.elementwise_add(h, moe_out),
+                    param_attr=fluid.ParamAttr(name=f"l{li}_ln2_w"),
+                    bias_attr=fluid.ParamAttr(name=f"l{li}_ln2_b"))
+                aux_total = aux if aux_total is None else \
+                    fluid.layers.elementwise_add(aux_total, aux)
+            logits = fluid.layers.fc(
+                h, size=V, num_flatten_dims=2, bias_attr=False,
+                param_attr=fluid.ParamAttr(name="head_w"))
+            ce = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    logits, fluid.layers.unsqueeze(y, [2])))
+            loss = fluid.layers.elementwise_add(
+                ce, fluid.layers.scale(aux_total, scale=0.01))
+            fluid.optimizer.Adam(0.005).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        losses = []
+        for i in range(60):
+            l, = exe.run(prog, feed={"src": src, "y": lab},
+                         fetch_list=[ce], scope=sc)
+            losses.append(float(np.asarray(l).reshape(-1)[0]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def _np_switch_moe(x, wg, w1, w2, capacity, top_k):
+    """Independent numpy oracle for the switch_moe op (top-k routing,
+    FIFO capacity, Switch/GShard combine scaling)."""
+    t, d = x.shape
+    E = wg.shape[1]
+    logits = x.astype(np.float64) @ wg.astype(np.float64)
+    z = np.exp(logits - logits.max(1, keepdims=True))
+    gates = z / z.sum(1, keepdims=True)
+    order = np.argsort(-gates, axis=1)[:, :top_k]
+    gval = np.take_along_axis(gates, order, axis=1)
+    if top_k > 1:
+        scale = gval / np.maximum(gval.sum(1, keepdims=True), 1e-9)
+    else:
+        scale = gval
+    counts = np.zeros(E, int)
+    out = np.zeros((t, d), np.float64)
+    assigned = []
+    for j in range(top_k):
+        for i in range(t):
+            e = order[i, j]
+            if counts[e] < capacity:
+                assigned.append((i, e, scale[i, j]))
+                counts[e] += 1
+    for i, e, s in assigned:
+        h = np.maximum(x[i].astype(np.float64) @ w1[e].astype(
+            np.float64), 0.0)
+        out[i] += s * (h @ w2[e].astype(np.float64))
+    f = np.bincount(order[:, 0], minlength=E) / t
+    aux = E * float((f * gates.mean(0)).sum())
+    return out.astype(np.float32), np.float32(aux)
+
+
+from tests.op_test import OpTest  # noqa: E402
+
+
+class TestSwitchMoeOp(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "switch_moe"
+        # seed chosen so no dispatched relu pre-activation sits
+        # within the fd window of zero and routing margins are wide
+        # (fd through a relu kink corrupts the quotient)
+        r = np.random.RandomState(9)
+        t, d, f, E = 12, 6, 10, 3
+        # scale logits up so fd perturbations (5e-3) never flip the
+        # routing argmax (discontinuity would break the fd quotient)
+        x = (r.randn(t, d) * 1.0).astype(np.float32)
+        wg = (r.randn(d, E) * 2.0).astype(np.float32)
+        w1 = (r.randn(E, d, f) * 0.4).astype(np.float32)
+        w2 = (r.randn(E, f, d) * 0.4).astype(np.float32)
+        cf = 4.0
+        cap = max(1, int(cf * 1 * t / E))
+        out, aux = _np_switch_moe(x, wg, w1, w2, cap, top_k=1)
+        self.inputs = {"X": x, "GateW": wg, "W1": w1, "W2": w2}
+        self.attrs = {"top_k": 1, "capacity_factor": cf}
+        self.outputs = {"Out": out, "AuxLoss": aux.reshape(1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["X", "GateW", "W1", "W2"], "Out",
+                        max_relative_error=0.02, delta=1e-3)
+
+
+class TestSwitchMoeOpTop2(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "switch_moe"
+        r = np.random.RandomState(22)
+        t, d, f, E = 8, 6, 10, 4
+        x = r.randn(t, d).astype(np.float32)
+        wg = (r.randn(d, E) * 2.0).astype(np.float32)
+        w1 = (r.randn(E, d, f) * 0.4).astype(np.float32)
+        w2 = (r.randn(E, f, d) * 0.4).astype(np.float32)
+        cf = 8.0
+        cap = max(1, int(cf * 2 * t / E))
+        out, aux = _np_switch_moe(x, wg, w1, w2, cap, top_k=2)
+        self.inputs = {"X": x, "GateW": wg, "W1": w1, "W2": w2}
+        self.attrs = {"top_k": 2, "capacity_factor": cf}
+        self.outputs = {"Out": out, "AuxLoss": aux.reshape(1)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+
+class TestScopeCacheKey:
+    def test_entering_scope_recompiles_cached_program(self):
+        """Regression: the executable cache key must include the
+        CP/EP scope state — running once OUTSIDE the scope then again
+        INSIDE it (same shapes) must not serve the stale dense
+        lowering."""
+        _fresh()
+        r = np.random.RandomState(0)
+        xs = r.randn(16, 16).astype(np.float32)
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[16],
+                                  dtype="float32")
+            out, aux = fluid.layers.switch_moe(
+                x, num_experts=2, d_inner=8, capacity_factor=8.0,
+                name="ck_moe")
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        # compile + run the dense lowering first
+        dense, = exe.run(prog, feed={"x": xs}, fetch_list=[out],
+                         scope=sc)
+        mesh = make_mesh(MeshConfig(ep=2), devices=jax.devices()[:2])
+        calls = {"n": 0}
+        import paddle_tpu.parallel.moe as moe_mod
+        orig = moe_mod.moe_apply
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        moe_mod.moe_apply = spy
+        try:
+            with expert_parallel(mesh):
+                ep_out, = exe.run(prog, feed={"x": xs},
+                                  fetch_list=[out], scope=sc)
+        finally:
+            moe_mod.moe_apply = orig
+        assert calls["n"] == 1, "stale dense executable served"
+        np.testing.assert_allclose(np.asarray(dense),
+                                   np.asarray(ep_out),
+                                   rtol=1e-4, atol=1e-5)

@@ -83,7 +83,7 @@ class TestMoE:
 
     def test_ep4_matches_dense_when_no_drops(self):
         mesh, x, wg, w1, w2 = self._setup(ep=4)
-        got = moe_apply(x, wg, w1, w2, mesh, capacity_factor=64.0)
+        got, _ = moe_apply(x, wg, w1, w2, mesh, capacity_factor=64.0)
         want = self._dense(x, wg, w1, w2)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5, rtol=1e-4)
@@ -93,7 +93,7 @@ class TestMoE:
         every produced row matches its dense counterpart."""
         mesh, x, wg, w1, w2 = self._setup(ep=2)
         got = np.asarray(moe_apply(x, wg, w1, w2, mesh,
-                                   capacity_factor=0.25))
+                                   capacity_factor=0.25)[0])
         want = np.asarray(self._dense(x, wg, w1, w2))
         for i in range(got.shape[0]):
             if np.allclose(got[i], 0.0, atol=1e-7):
@@ -107,7 +107,7 @@ class TestMoE:
 
         def loss(w1, w2):
             return (moe_apply(x, wg, w1, w2, mesh,
-                              capacity_factor=64.0) ** 2).sum()
+                              capacity_factor=64.0)[0] ** 2).sum()
 
         g1, g2 = jax.grad(loss, argnums=(0, 1))(w1, w2)
         assert np.isfinite(np.asarray(g1)).all()
