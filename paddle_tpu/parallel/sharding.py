@@ -54,16 +54,208 @@ def default_transformer_rules() -> ShardingRules:
 def spec_for_param(name: str, shape, rules: Optional[ShardingRules],
                    tp_threshold: int = 1024) -> P:
     """Heuristic TP assignment when no explicit rule matches: shard the
-    largest dim of big 2-D weights over 'tp'."""
+    largest dim of big 2-D weights over 'tp'. Prefer
+    `derive_sharding_rules(program)` — the structural pass — whenever a
+    Program is available; this size heuristic is the fallback for bare
+    state dicts."""
     if rules is not None:
         spec = rules.spec_for(name, len(shape))
-        if spec != P():
+        if spec != P() or isinstance(rules, DerivedRules):
+            # a DerivedRules table is exhaustive: replicated means the
+            # structural pass DECIDED replicated (e.g. a residual-
+            # escaped weight) — the size heuristic must not override it
             return spec
     if len(shape) == 2 and max(shape) >= tp_threshold:
         if shape[1] >= shape[0]:
             return P(None, "tp")
         return P("tp", None)
     return P()
+
+
+# ---------------------------------------------------------------------------
+# Structural TP rules derived from the program graph
+# ---------------------------------------------------------------------------
+# Ops a column-sharded activation may flow through on its way to the
+# paired row-sharded projection without forcing a gather: shape/layout
+# ops, elementwise activations, and the fused attention op (a
+# head-partitioned attention needs no cross-head communication).
+_TP_PASS_OPS = {
+    "split", "reshape2", "reshape", "transpose2", "transpose",
+    "relu", "gelu", "tanh", "sigmoid", "scale", "dropout",
+    "attention", "cast",
+}
+
+
+class DerivedRules(ShardingRules):
+    """Exact param-name -> PartitionSpec table from the structural
+    pass; quacks like ShardingRules for shard_state/spec_for_param.
+    The table is EXHAUSTIVE: names not in it (directly or via their
+    parent param, see below) are deliberately replicated — no size
+    heuristic applies on top."""
+
+    def __init__(self, table: Dict[str, P]):
+        self.table = dict(table)
+        self.default = P()
+        self._keys = sorted(self.table, key=len, reverse=True)
+
+    def spec_for(self, name: str, ndim: int) -> P:
+        spec = self.table.get(name)
+        if spec is None:
+            # optimizer accumulators are named <param>_<acc>_<n>
+            # (moment1_0, velocity_0, ...) and are param-shaped: they
+            # inherit the param's spec so Adam state keeps the TP
+            # memory savings. Rank mismatches (e.g. the (1,) beta-pow
+            # accumulators) fall through to replicated below.
+            for key in self._keys:
+                if name.startswith(key + "_"):
+                    spec = self.table[key]
+                    break
+        if spec is None:
+            return P()
+        return spec if len(spec) <= ndim else P()
+
+    def __repr__(self):
+        return f"DerivedRules({self.table})"
+
+
+def derive_sharding_rules(program) -> DerivedRules:
+    """Derive Megatron-style tensor-parallel PartitionSpecs from the
+    PROGRAM GRAPH instead of weight sizes (the reference's analogue
+    decides placement per-op in multi_devices_graph_pass.cc:40).
+
+    Pattern: for each projection `mul(X, W_a)`, chase its output
+    forward through `_TP_PASS_OPS` (+ rank-1 param bias adds). If
+    every path lands on another projection `mul(., W_b)` — the FFN
+    up/down pair, or qkv -> attention -> out-proj — then W_a is
+    column-sharded P(None, 'tp'), its bias P('tp'), W_b row-sharded
+    P('tp', None), its bias replicated (the row matmul's partial sums
+    are psum'd once by GSPMD). If any path escapes (residual add,
+    layer_norm, loss...), W_a stays replicated — a column shard there
+    would force a gather per matmul.
+
+    Embeddings (`lookup_table` W) are vocab-row-sharded; a logits head
+    (projection onto an embedding-sized vocab feeding
+    softmax_with_cross_entropy) is vocab-column-sharded — Megatron's
+    parallel vocab loss.
+    """
+    block = program.global_block
+
+    def persistable(name):
+        v = block._find_var_recursive(name)
+        return v is not None and v.persistable
+
+    def var_shape(name):
+        v = block._find_var_recursive(name)
+        return tuple(v.shape) if v is not None and v.shape else ()
+
+    fwd_ops = [op for op in block.ops
+               if op.attrs.get("op_role") not in ("backward", "optimize")
+               and op.type not in ("feed", "fetch")]
+    consumers: Dict[str, list] = {}
+    for i, op in enumerate(fwd_ops):
+        for names in op.inputs.values():
+            for n in names:
+                consumers.setdefault(n, []).append(i)
+
+    def is_proj(op):
+        if op.type not in ("mul", "matmul"):
+            return False
+        y = op.inputs.get("Y", [None])[0]
+        return y is not None and persistable(y)
+
+    def bias_of(op):
+        """The rank-1 param added right onto this projection's out."""
+        out = op.outputs["Out"][0]
+        for ci in consumers.get(out, []):
+            c = fwd_ops[ci]
+            if c.type == "elementwise_add":
+                y = c.inputs.get("Y", [None])[0]
+                if y and persistable(y) and len(var_shape(y)) == 1:
+                    return y
+        return None
+
+    table: Dict[str, P] = {}
+    vocab_sizes = set()
+    for op in fwd_ops:
+        if op.type == "lookup_table":
+            w = op.inputs["W"][0]
+            table[w] = P("tp", None)
+            vocab_sizes.add(var_shape(w)[0] if var_shape(w) else None)
+
+    def downstream_projs(op):
+        """(reached projection op idxs, escaped?) chasing op's Out."""
+        reached, escaped = set(), False
+        seen = set()
+        stack = [op.outputs["Out"][0]]
+        while stack:
+            var = stack.pop()
+            if var in seen:
+                continue
+            seen.add(var)
+            for ci in consumers.get(var, []):
+                c = fwd_ops[ci]
+                if is_proj(c) and var in c.inputs.get("X", []):
+                    reached.add(ci)
+                elif c.type == "elementwise_add":
+                    y = c.inputs.get("Y", [None])[0]
+                    if y and persistable(y) and len(var_shape(y)) == 1:
+                        stack.extend(c.outputs["Out"])   # bias add
+                    else:
+                        escaped = True                   # residual
+                elif c.type in _TP_PASS_OPS:
+                    for names in c.outputs.values():
+                        stack.extend(names)
+                else:
+                    escaped = True
+        return reached, escaped
+
+    for i, op in enumerate(fwd_ops):
+        if not is_proj(op):
+            continue
+        w = op.inputs["Y"][0]
+        if w in table:
+            continue          # already assigned (e.g. row by a pair)
+        shp = var_shape(w)
+        if len(shp) != 2:
+            continue
+        # vocab head: projection onto an embedding vocab feeding the
+        # softmax loss
+        out = op.outputs["Out"][0]
+        outs_cs = [fwd_ops[ci].type for ci in consumers.get(out, [])]
+        if shp[1] in vocab_sizes and \
+                "softmax_with_cross_entropy" in outs_cs:
+            table[w] = P(None, "tp")
+            continue
+        reached, escaped = downstream_projs(op)
+        if escaped or not reached:
+            continue
+        down_ws = [fwd_ops[ci].inputs["Y"][0] for ci in reached]
+        if any(table.get(dw) == P(None, "tp") for dw in down_ws):
+            continue          # would chain column->column; stay safe
+        table[w] = P(None, "tp")
+        b = bias_of(op)
+        if b:
+            table[b] = P("tp")
+        for dw in down_ws:
+            table[dw] = P("tp", None)
+            # row-proj bias stays replicated (added after the psum)
+    return DerivedRules(table)
+
+
+def safe_spec(mesh: Mesh, spec: P, shape) -> P:
+    """Drop a spec whose sharded dims don't divide the mesh axis
+    (e.g. the (1,)-shaped beta-pow accumulator inheriting its bias
+    param's P('tp')): replicate instead of erroring at device_put."""
+    for dim, ax in zip(shape, tuple(spec)):
+        if ax is None:
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape.get(a, 1)
+        if size and dim % size != 0:
+            return P()
+    return spec
 
 
 def shard_state(state: Dict, mesh: Mesh,
@@ -76,7 +268,8 @@ def shard_state(state: Dict, mesh: Mesh,
             out[name] = val
             continue
         shape = getattr(val, "shape", ())
-        spec = spec_for_param(name, shape, rules)
+        spec = safe_spec(mesh, spec_for_param(name, shape, rules),
+                         shape)
         out[name] = jax.device_put(val, NamedSharding(mesh, spec))
     return out
 

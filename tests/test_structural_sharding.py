@@ -1,0 +1,240 @@
+"""Structural TP rules derived from the program graph
+(parallel/sharding.py derive_sharding_rules). VERDICT r2 #5: replace
+the max(shape)>=1024 size heuristic with column-then-row Megatron
+pairing read off the op graph, and assert the collective count — one
+psum per down-projection, not one per matmul.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as fluid
+from paddle_tpu.models import transformer as T
+from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
+from paddle_tpu.parallel.sharding import derive_sharding_rules
+
+
+def _fresh():
+    fluid._reset_global_scope()
+    from paddle_tpu import unique_name
+    unique_name.switch()
+
+
+def _transformer(n_layers=2, with_optimizer=True):
+    _fresh()
+    main, startup, cost = T.build_program(
+        seq_len=8, d_model=32, n_heads=2, n_layers=n_layers,
+        d_inner=64, vocab=64, dropout_rate=0.0,
+        with_optimizer=with_optimizer, learning_rate=0.5,
+        warmup_steps=20)
+    return main, startup, cost
+
+
+class TestDerivedRules:
+    def test_megatron_pairing_on_transformer(self):
+        main, _, _ = _transformer()
+        t = derive_sharding_rules(main).table
+        # qkv / q / kv projections: column; out-projections: row
+        assert t["enc0_self_qkv.w"] == P(None, "tp")
+        assert t["enc0_self_out.w"] == P("tp", None)
+        assert t["dec0_cross_q.w"] == P(None, "tp")
+        assert t["dec0_cross_kv.w"] == P(None, "tp")
+        assert t["dec0_cross_out.w"] == P("tp", None)
+        # FFN pair: up column (+ sharded bias), down row (repl bias)
+        assert t["enc0_fc1.w"] == P(None, "tp")
+        assert t["enc0_fc1.b"] == P("tp")
+        assert t["enc0_fc2.w"] == P("tp", None)
+        assert "enc0_fc2.b" not in t
+        # embeddings vocab-row; logits head vocab-column
+        assert t["src_word_emb"] == P("tp", None)
+        assert t["logits.w"] == P(None, "tp")
+        # layer norms replicated (absent from the table)
+        assert "enc0_a_ln.w" not in t
+
+    def test_residual_escape_blocks_column_sharding(self):
+        """An fc whose output feeds a residual add (not another
+        projection) must stay replicated — a column shard there would
+        gather per matmul."""
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[16],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(
+                x, size=16, act="relu",
+                param_attr=fluid.ParamAttr(name="solo_w"),
+                bias_attr=False)
+            h = fluid.layers.elementwise_add(h, x)   # residual escape
+            logits = fluid.layers.fc(
+                h, size=4, param_attr=fluid.ParamAttr(name="head_w"),
+                bias_attr=False)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        t = derive_sharding_rules(prog).table
+        assert "solo_w" not in t
+        assert "head_w" not in t
+
+    def test_plain_ffn_pair_detected(self):
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[16],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(
+                x, size=64, act="relu",
+                param_attr=fluid.ParamAttr(name="up_w"),
+                bias_attr=fluid.ParamAttr(name="up_b"))
+            h = fluid.layers.fc(
+                h, size=16, param_attr=fluid.ParamAttr(name="down_w"),
+                bias_attr=fluid.ParamAttr(name="down_b"))
+            logits = fluid.layers.fc(h, size=4, bias_attr=False)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        t = derive_sharding_rules(prog).table
+        assert t["up_w"] == P(None, "tp")
+        assert t["up_b"] == P("tp")
+        assert t["down_w"] == P("tp", None)
+        assert "down_b" not in t
+
+
+def _sharded_train_setup(mesh, rules):
+    import __graft_entry__ as g
+
+    main, startup, cost = _transformer()
+    state = g._build_state(startup)
+    feed_names = ("label", "src_ids", "tgt_ids")
+    step, mutated, const = g._make_step(main, feed_names, [cost.name])
+
+    def place(name, val):
+        from paddle_tpu.parallel.sharding import safe_spec
+
+        if mesh is None:
+            return val
+        shape = getattr(val, "shape", ())
+        spec = safe_spec(mesh, rules.spec_for(name, len(shape)), shape)
+        return jax.device_put(val, NamedSharding(mesh, spec))
+
+    mut = {n: place(n, state[n]) for n in mutated}
+    const_st = {n: place(n, state[n]) for n in const}
+    r = np.random.RandomState(0)
+    feeds = {k: r.randint(0, 64, (8, 8)).astype(np.int32)
+             for k in ("src_ids", "tgt_ids", "label")}
+    if mesh is not None:
+        feeds = {k: jax.device_put(v, NamedSharding(mesh, P("dp")))
+                 for k, v in feeds.items()}
+    rng = jax.random.PRNGKey(0)
+    return step, mut, const_st, feeds, rng
+
+
+class TestShardedExecution:
+    def test_tp2_losses_match_unsharded(self):
+        mesh = make_mesh(MeshConfig(dp=2, tp=2),
+                         devices=jax.devices()[:4])
+        main, startup, cost = _transformer()
+        rules = derive_sharding_rules(main)
+        step, mut, const_st, feeds, rng = _sharded_train_setup(
+            mesh, rules)
+        with mesh:
+            jitted = jax.jit(step)
+            losses_tp = []
+            st = mut
+            for _ in range(3):
+                st, fetches, rng = jitted(st, const_st, feeds, rng)
+                losses_tp.append(
+                    float(np.asarray(fetches[0]).reshape(-1)[0]))
+
+        # unsharded single-device baseline
+        step2, mut2, const2, feeds2, rng2 = _sharded_train_setup(
+            None, rules)
+        feeds2 = {k: np.asarray(v) for k, v in feeds2.items()}
+        jitted2 = jax.jit(step2)
+        losses_1 = []
+        st = mut2
+        for _ in range(3):
+            st, fetches, rng2 = jitted2(st, const2, feeds2, rng2)
+            losses_1.append(
+                float(np.asarray(fetches[0]).reshape(-1)[0]))
+        np.testing.assert_allclose(losses_tp, losses_1, rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_collective_count_one_psum_per_down_proj(self):
+        """The point of column-then-row pairing: the FORWARD pass
+        all-reduces once per row-projection (+ the embedding gathers
+        and the vocab-parallel loss), nowhere near once per matmul."""
+        mesh = make_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+        main, startup, cost = _transformer(with_optimizer=False)
+        rules = derive_sharding_rules(main)
+        n_muls = sum(1 for op in main.global_block.ops
+                     if op.type == "mul")
+        row_projs = [k for k, v in rules.table.items()
+                     if v == P("tp", None) and not k.endswith("emb")]
+
+        import __graft_entry__ as g
+        state = g._build_state(startup)
+        feed_names = ("label", "src_ids", "tgt_ids")
+        step, mutated, const = g._make_step(main, feed_names,
+                                            [cost.name])
+
+        def place(name, val):
+            from paddle_tpu.parallel.sharding import safe_spec
+
+            shape = getattr(val, "shape", ())
+            spec = safe_spec(mesh, rules.spec_for(name, len(shape)),
+                             shape)
+            return jax.device_put(val, NamedSharding(mesh, spec))
+
+        mut = {n: place(n, state[n]) for n in mutated}
+        const_st = {n: place(n, state[n]) for n in const}
+        r = np.random.RandomState(0)
+        feeds = {k: jax.device_put(
+            r.randint(0, 64, (8, 8)).astype(np.int32),
+            NamedSharding(mesh, P()))
+            for k in ("src_ids", "tgt_ids", "label")}
+        rng = jax.random.PRNGKey(0)
+        with mesh:
+            compiled = jax.jit(step).lower(
+                mut, const_st, feeds, rng).compile()
+        hlo = compiled.as_text()
+        n_ar = hlo.count("all-reduce(") + hlo.count("all-reduce-start(")
+        # forward-only: expect ~1 all-reduce per row projection plus a
+        # small constant for embeddings + vocab-parallel loss; far
+        # below one per matmul
+        assert n_ar >= len(row_projs) // 2, (n_ar, len(row_projs))
+        assert n_ar <= len(row_projs) + 8, (n_ar, len(row_projs))
+        assert n_ar < n_muls, (n_ar, n_muls)
+
+
+class TestDerivedRulesInheritance:
+    def test_optimizer_accumulators_inherit_param_spec(self):
+        main, _, _ = _transformer()
+        rules = derive_sharding_rules(main)
+        # moment accumulators are param-shaped -> param's spec
+        assert rules.spec_for("enc0_fc1.w_moment1_0", 2) == \
+            P(None, "tp")
+        assert rules.spec_for("enc0_self_out.w_moment2_0", 2) == \
+            P("tp", None)
+        # rank-1 beta-pow accumulators can't take a rank-2 spec
+        assert rules.spec_for("enc0_fc1.w_beta1_pow_acc_0", 1) == P()
+        # a bias accumulator of shape (1,) inherits P('tp') by name but
+        # safe_spec replicates it (1 % tp != 0)
+        from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
+        from paddle_tpu.parallel.sharding import safe_spec
+        import jax as _jax
+        m = make_mesh(MeshConfig(tp=2), devices=_jax.devices()[:2])
+        assert safe_spec(m, rules.spec_for("enc0_fc1.b_beta1_pow_acc_0",
+                                           1), (1,)) == P()
+
+    def test_table_is_exhaustive_no_size_heuristic(self):
+        from paddle_tpu.parallel.sharding import spec_for_param
+        main, _, _ = _transformer()
+        rules = derive_sharding_rules(main)
+        # a big 2-D weight the structural pass left replicated must
+        # STAY replicated through spec_for_param (no size heuristic)
+        assert spec_for_param("some_escaped_w", (2048, 2048),
+                              rules) == P()
