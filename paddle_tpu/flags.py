@@ -58,6 +58,10 @@ _DEFS = {
     "strict_infer_shape": (_as_bool, False, True),
     "use_bf16": (_as_bool, False, True),
     "benchmark": (_as_bool, False, True),
+    # cross-check the native (C++) block analyzer/GC-planner against the
+    # Python oracle on every compile; raise on divergence instead of
+    # silently preferring either side
+    "native_verify": (_as_bool, False, True),
     # memory / allocator family (XLA buffer assignment owns this)
     "eager_delete_scope": (_as_bool, True, False),
     "eager_delete_tensor_gb": (float, -1.0, False),

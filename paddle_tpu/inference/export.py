@@ -243,6 +243,116 @@ def export_train_stablehlo(main_program, scope, example_feeds,
     return out_path
 
 
+def export_train_hlo(main_program, scope, example_feeds, fetch_names,
+                     out_path) -> str:
+    """Freeze a TRAINING step as an HLO artifact runnable from C++
+    with NO Python in the process — the reference's C++ train demo
+    (reference paddle/fluid/train/demo/demo_trainer.cc) done the
+    XLA-native way. The artifact holds:
+
+      * train_step.hlo.pb — the serialized HloModuleProto of the WHOLE
+        train step (forward + backward + optimizer ops, exactly what
+        the Executor compiles), flat-parameter calling convention;
+      * manifest.json — flat input order (name/dtype/shape/kind/file),
+        flat output order, and which output threads back into which
+        input between steps;
+      * data/*.bin — raw little-endian initial state, rng key, and
+        example feeds.
+
+    Drive it with `paddle_tpu.native.run_train_demo(out_path, steps)`
+    (compiles native/train_demo/train_demo.cc against the bundled XLA
+    runtime) or any XLA-capable host."""
+    import jax
+
+    from ..core.executor import (RNG_VAR, _analyze_block,
+                                 _build_step_fn, _coerce_feed,
+                                 _global_seed, _var_np_dtype)
+
+    block = main_program.global_block
+    feed_names = sorted(example_feeds)
+    mutated, const, state_out = _analyze_block(
+        block, tuple(feed_names), list(fetch_names))
+    step = _build_step_fn(block, tuple(feed_names), mutated, const,
+                          state_out, list(fetch_names))
+    state0 = {n: np.asarray(scope._get(n)) for n in mutated}
+    const0 = {n: np.asarray(scope._get(n)) for n in const}
+    rng0 = scope._get(RNG_VAR)
+    if rng0 is None:
+        seed = getattr(main_program, "_seed", None)
+        if seed is None:
+            seed = _global_seed[0]
+        rng0 = jax.random.PRNGKey(int(seed))
+    rng0 = np.asarray(rng0)
+
+    def train_step(state, rng, feeds):
+        new_state, fetches, rng_out = step(state, const0, feeds, rng)
+        return ({n: new_state[n] for n in mutated}, rng_out, fetches)
+
+    example = {n: np.asarray(_coerce_feed(example_feeds[n],
+                                          _var_np_dtype(block, n)))
+               for n in feed_names}
+    args = (state0, rng0, example)
+    lowered = jax.jit(train_step).lower(*args)
+    hlo_bytes = lowered.compiler_ir(
+        "hlo").as_serialized_hlo_module_proto()
+
+    out_path = str(out_path)
+    os.makedirs(os.path.join(out_path, "data"), exist_ok=True)
+    with open(os.path.join(out_path, "train_step.hlo.pb"), "wb") as f:
+        f.write(hlo_bytes)
+
+    # flat input order == jax's pytree flatten order of the traced args
+    from jax.tree_util import tree_flatten_with_path
+
+    def _entry_name(path):
+        idx = path[0].idx
+        if idx == 1:
+            return "__rng__", "rng"
+        key = path[1].key
+        return key, ("state" if idx == 0 else "feed")
+
+    flat_in, _ = tree_flatten_with_path(args)
+    inputs = []
+    in_index = {}
+    for i, (path, leaf) in enumerate(flat_in):
+        name, kind = _entry_name(path)
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        # the traced computation sees jax-canonicalized dtypes (int64
+        # demotes to int32 under the default x64-disabled config); the
+        # artifact must carry what parameter i actually wants
+        arr = arr.astype(jax.dtypes.canonicalize_dtype(arr.dtype))
+        fname = f"data/{i:03d}.bin"
+        arr.tofile(os.path.join(out_path, fname))
+        inputs.append({"name": name, "kind": kind,
+                       "dtype": str(arr.dtype),
+                       "shape": list(arr.shape), "file": fname})
+        in_index[(kind, name)] = i
+
+    out_shape = jax.eval_shape(train_step, *args)
+    flat_out, _ = tree_flatten_with_path(out_shape)
+    outputs = []
+    for path, leaf in flat_out:
+        idx = path[0].idx
+        if idx == 0:
+            name = path[1].key
+            dst = in_index.get(("state", name), -1)
+            outputs.append({"name": name, "kind": "state",
+                            "feeds_input": dst})
+        elif idx == 1:
+            outputs.append({"name": "__rng__", "kind": "rng",
+                            "feeds_input": in_index[("rng", "__rng__")]})
+        else:
+            fi = path[1].idx
+            outputs.append({"name": fetch_names[fi], "kind": "fetch",
+                            "feeds_input": -1})
+    manifest = {"hlo": "train_step.hlo.pb", "inputs": inputs,
+                "outputs": outputs,
+                "fetch_names": list(fetch_names)}
+    with open(os.path.join(out_path, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return out_path
+
+
 class StableHLOTrainer(StableHLOServer):
     """Loaded train-step artifact: initial_state() + train_step().
     The PRNG key rides in the state dict under "__rng__" so sampling

@@ -437,3 +437,87 @@ def offsets_to_segment_ids(offsets):
     for seg in range(1, len(offsets)):
         out.extend([seg - 1] * (offsets[seg] - offsets[seg - 1]))
     return out
+
+
+# ---------------------------------------------------------------------------
+# C++ train demo (native/train_demo/train_demo.cc): run an exported
+# train-step HLO artifact with no Python in the process — the
+# reference's C++ train demo (train/demo/demo_trainer.cc) done the
+# XLA-native way. Links against the XLA runtime bundled with the
+# installed tensorflow wheel (libtensorflow_cc exports LocalClient).
+# ---------------------------------------------------------------------------
+_DEMO_BIN = os.path.join(_DIR, "_train_demo")
+_demo_lock = threading.Lock()
+_demo_error: Optional[str] = None
+
+
+def _find_tf_root() -> Optional[str]:
+    import sys
+
+    for p in sys.path:
+        cand = os.path.join(p, "tensorflow")
+        if os.path.isfile(os.path.join(cand, "libtensorflow_cc.so.2")) \
+                and os.path.isdir(os.path.join(cand, "include", "xla")):
+            return cand
+    return None
+
+
+def build_train_demo() -> str:
+    """Compile (once) and return the path of the train_demo binary.
+    Raises RuntimeError when the toolchain or the XLA runtime is
+    unavailable."""
+    global _demo_error
+    with _demo_lock:
+        src = os.path.join(_DIR, "train_demo", "train_demo.cc")
+        deps = [src, os.path.join(_SRC, "json.cc"),
+                os.path.join(_SRC, "json.h")]
+        if os.path.exists(_DEMO_BIN) and all(
+                os.path.getmtime(_DEMO_BIN) >= os.path.getmtime(d)
+                for d in deps):
+            return _DEMO_BIN
+        if _demo_error is not None:
+            raise RuntimeError(_demo_error)
+        tf = _find_tf_root()
+        if tf is None:
+            _demo_error = ("train_demo: no bundled XLA runtime "
+                           "(tensorflow wheel with libtensorflow_cc) "
+                           "found on sys.path")
+            raise RuntimeError(_demo_error)
+        inc = os.path.join(tf, "include")
+        cmd = ["g++", "-std=c++17", "-O1", src,
+               os.path.join(_SRC, "json.cc"),
+               "-I" + inc,
+               "-I" + os.path.join(inc, "external", "highwayhash"),
+               "-I" + os.path.join(inc, "external", "farmhash_archive",
+                                   "src"),
+               os.path.join(tf, "libtensorflow_cc.so.2"),
+               os.path.join(tf, "libtensorflow_framework.so.2"),
+               "-Wl,-rpath," + tf,
+               "-o", _DEMO_BIN]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            _demo_error = ("train_demo build failed: "
+                           + proc.stderr[-2000:])
+            raise RuntimeError(_demo_error)
+        return _DEMO_BIN
+
+
+def run_train_demo(artifact_dir: str, steps: int,
+                   timeout: int = 600) -> List[dict]:
+    """Run the C++ driver over an `export_train_hlo` artifact for
+    `steps` steps; returns the per-step fetch dicts it printed. Final
+    state lands next to the artifact's data files as *.bin.final."""
+    binary = build_train_demo()
+    proc = subprocess.run(
+        [binary, str(artifact_dir), str(int(steps))],
+        capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"train_demo failed (exit {proc.returncode}): "
+            f"{proc.stderr[-2000:]}")
+    out = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            out.append(json.loads(line))
+    return out

@@ -1,0 +1,248 @@
+// Native training driver: load a train-step artifact exported by
+// paddle_tpu.inference.export.export_train_hlo and run the training
+// loop with NO Python in the process — the TPU-native counterpart of
+// the reference's C++ train demo (reference
+// paddle/fluid/train/demo/demo_trainer.cc, which loads a saved
+// __model__ program and drives Executor.Run from C++).
+//
+// Here the artifact is one XLA computation (the WHOLE train step:
+// forward + backward + optimizer, exactly what the Python Executor
+// compiles) plus a manifest describing the flat parameter order and
+// which outputs thread back into which inputs. The driver:
+//   1. deserializes the HloModuleProto and compiles it with the
+//      classic XLA LocalClient (Host platform),
+//   2. loads the initial state / rng / feeds from raw binaries,
+//   3. runs N steps, threading state outputs into the next step's
+//      inputs, printing one JSON line of fetch values per step,
+//   4. writes the final state back next to the artifact.
+//
+// Build/run via paddle_tpu.native.run_train_demo (links against the
+// bundled libtensorflow_cc, which exports the XLA runtime).
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "xla/client/client_library.h"
+#include "xla/client/local_client.h"
+#include "xla/hlo/builder/xla_computation.h"
+#include "xla/literal.h"
+#include "xla/service/hlo.pb.h"
+#include "xla/service/platform_util.h"
+#include "xla/shape_util.h"
+
+#include "../src/json.h"
+
+namespace {
+
+std::string readFile(const std::string& path, bool* ok) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) {
+    *ok = false;
+    return "";
+  }
+  std::stringstream ss;
+  ss << in.rdbuf();
+  *ok = true;
+  return ss.str();
+}
+
+xla::PrimitiveType dtypeToPrim(const std::string& dt) {
+  if (dt == "float32") return xla::F32;
+  if (dt == "float64") return xla::F64;
+  if (dt == "bfloat16") return xla::BF16;
+  if (dt == "float16") return xla::F16;
+  if (dt == "int64") return xla::S64;
+  if (dt == "int32") return xla::S32;
+  if (dt == "int16") return xla::S16;
+  if (dt == "int8") return xla::S8;
+  if (dt == "uint64") return xla::U64;
+  if (dt == "uint32") return xla::U32;
+  if (dt == "uint8") return xla::U8;
+  if (dt == "bool") return xla::PRED;
+  fprintf(stderr, "train_demo: unsupported dtype %s\n", dt.c_str());
+  exit(2);
+}
+
+double firstElementAsDouble(const xla::Literal& lit) {
+  const xla::Shape& s = lit.shape();
+  switch (s.element_type()) {
+    case xla::F32:
+      return static_cast<const float*>(lit.untyped_data())[0];
+    case xla::F64:
+      return static_cast<const double*>(lit.untyped_data())[0];
+    case xla::BF16: {
+      // bf16 = top 16 bits of an f32
+      uint32_t bits = static_cast<uint32_t>(
+          static_cast<const uint16_t*>(lit.untyped_data())[0]) << 16;
+      float f;
+      std::memcpy(&f, &bits, sizeof(f));
+      return f;
+    }
+    case xla::S32:
+      return static_cast<const int32_t*>(lit.untyped_data())[0];
+    case xla::S64:
+      return static_cast<double>(
+          static_cast<const int64_t*>(lit.untyped_data())[0]);
+    case xla::U32:
+      return static_cast<const uint32_t*>(lit.untyped_data())[0];
+    default:
+      fprintf(stderr, "train_demo: unsupported fetch dtype %d\n",
+              static_cast<int>(s.element_type()));
+      exit(2);
+  }
+}
+
+// JSON has no literal NaN; emit the spellings Python's json accepts
+void printJsonNumber(double v) {
+  if (std::isnan(v)) {
+    printf("NaN");
+  } else if (std::isinf(v)) {
+    printf(v > 0 ? "Infinity" : "-Infinity");
+  } else {
+    printf("%.9g", v);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: train_demo <artifact_dir> <steps>\n");
+    return 2;
+  }
+  const std::string dir = argv[1];
+  const int steps = atoi(argv[2]);
+
+  bool ok = false;
+  std::string mtext = readFile(dir + "/manifest.json", &ok);
+  if (!ok) {
+    fprintf(stderr, "train_demo: no manifest in %s\n", dir.c_str());
+    return 2;
+  }
+  std::string err;
+  ptp::JsonPtr manifest = ptp::Json::parse(mtext, &err);
+  if (!manifest) {
+    fprintf(stderr, "train_demo: manifest parse error: %s\n",
+            err.c_str());
+    return 2;
+  }
+
+  std::string hlo_bytes =
+      readFile(dir + "/" + manifest->get("hlo")->asString(), &ok);
+  if (!ok) {
+    fprintf(stderr, "train_demo: missing hlo file\n");
+    return 2;
+  }
+  xla::HloModuleProto proto;
+  if (!proto.ParseFromString(hlo_bytes)) {
+    fprintf(stderr, "train_demo: HloModuleProto parse failed\n");
+    return 2;
+  }
+  xla::XlaComputation comp(proto);
+
+  auto* platform = xla::PlatformUtil::GetPlatform("Host").value();
+  xla::LocalClientOptions copts(platform);
+  xla::LocalClient* client =
+      xla::ClientLibrary::GetOrCreateLocalClient(copts).value();
+
+  // load inputs
+  const auto& inputs = manifest->get("inputs")->items();
+  std::vector<xla::Literal> in_lits;
+  in_lits.reserve(inputs.size());
+  for (const auto& spec : inputs) {
+    std::vector<int64_t> dims;
+    for (const auto& d : spec->get("shape")->items())
+      dims.push_back(d->asInt());
+    xla::Shape shape = xla::ShapeUtil::MakeShapeWithDescendingLayout(
+        dtypeToPrim(spec->get("dtype")->asString()), dims);
+    std::string bytes =
+        readFile(dir + "/" + spec->get("file")->asString(), &ok);
+    if (!ok) {
+      fprintf(stderr, "train_demo: missing input file %s\n",
+              spec->get("file")->asString().c_str());
+      return 2;
+    }
+    xla::Literal lit(shape);
+    if (bytes.size() != lit.size_bytes()) {
+      fprintf(stderr, "train_demo: %s: %zu bytes, want %zu\n",
+              spec->get("name")->asString().c_str(), bytes.size(),
+              static_cast<size_t>(lit.size_bytes()));
+      return 2;
+    }
+    std::memcpy(lit.untyped_data(), bytes.data(), bytes.size());
+    in_lits.push_back(std::move(lit));
+  }
+
+  auto pshape = comp.GetProgramShape().value();
+  if (pshape.parameters_size() != static_cast<int>(in_lits.size())) {
+    fprintf(stderr, "train_demo: program wants %d args, manifest has "
+            "%zu\n", pshape.parameters_size(), in_lits.size());
+    return 2;
+  }
+  std::vector<const xla::Shape*> arg_shapes;
+  for (int i = 0; i < pshape.parameters_size(); ++i)
+    arg_shapes.push_back(&pshape.parameters(i));
+  xla::ExecutableBuildOptions build_opts;
+  auto execs = client->Compile(comp, arg_shapes, build_opts).value();
+  auto& exe = execs[0];
+
+  const auto& outputs = manifest->get("outputs")->items();
+  xla::ExecutableRunOptions run_opts;
+  run_opts.set_allocator(client->backend().memory_allocator());
+  run_opts.set_intra_op_thread_pool(
+      client->backend().eigen_intra_op_thread_pool_device());
+
+  for (int step = 0; step < steps; ++step) {
+    std::vector<xla::ScopedShapedBuffer> bufs;
+    bufs.reserve(in_lits.size());
+    for (const auto& lit : in_lits)
+      bufs.push_back(client->LiteralToShapedBuffer(lit, 0).value());
+    std::vector<const xla::ShapedBuffer*> args;
+    for (const auto& b : bufs) args.push_back(&b);
+    auto result =
+        exe->Run(absl::Span<const xla::ShapedBuffer* const>(args),
+                 run_opts)
+            .value();
+    xla::Literal out_lit =
+        client->ShapedBufferToLiteral(result).value();
+    std::vector<xla::Literal> parts = out_lit.DecomposeTuple();
+    if (parts.size() != outputs.size()) {
+      fprintf(stderr, "train_demo: program returned %zu outputs, "
+              "manifest has %zu\n", parts.size(), outputs.size());
+      return 2;
+    }
+    // fetches first (printing), then thread state back
+    printf("{\"step\": %d", step);
+    for (size_t i = 0; i < outputs.size(); ++i) {
+      if (outputs[i]->get("kind")->asString() == "fetch") {
+        printf(", \"%s\": ",
+               outputs[i]->get("name")->asString().c_str());
+        printJsonNumber(firstElementAsDouble(parts[i]));
+      }
+    }
+    printf("}\n");
+    for (size_t i = 0; i < outputs.size(); ++i) {
+      int64_t dst = outputs[i]->get("feeds_input")->asInt();
+      if (dst >= 0) in_lits[dst] = std::move(parts[i]);
+    }
+  }
+
+  // final state back to disk (the artifact's checkpoint story)
+  for (size_t i = 0; i < inputs.size(); ++i) {
+    if (inputs[i]->get("kind")->asString() == "feed") continue;
+    std::string out_path =
+        dir + "/" + inputs[i]->get("file")->asString() + ".final";
+    std::ofstream out(out_path, std::ios::binary);
+    out.write(static_cast<const char*>(in_lits[i].untyped_data()),
+              in_lits[i].size_bytes());
+  }
+  fflush(stdout);
+  return 0;
+}

