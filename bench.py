@@ -48,6 +48,98 @@ def _peak_flops(device_kind: str) -> float:
     return 197e12  # assume v5e-class if unrecognized
 
 
+def _analytic_train_flops(prog, batch, seq=None):
+    """FLOPs per TRAINING step from the program graph: walk the
+    forward ops and count the matmul-class work (conv2d, mul/matmul,
+    lstm recurrent matmuls) from declared shapes, then apply the
+    standard train = 3x forward (backward re-does each matmul twice).
+    Elementwise/norm work is ignored — on TPU it is fused into the
+    matmuls and contributes negligibly to the FLOP count (not
+    necessarily to the runtime; that gap IS what MFU exposes).
+
+    Dynamic dims resolve positionally: a leading -1 is the batch;
+    later -1s are the (padded) sequence length `seq`."""
+    block = prog.global_block
+
+    def shape_of(name):
+        v = block._find_var_recursive(name)
+        if v is None or not v.shape:
+            return None
+        out = []
+        for i, d in enumerate(v.shape):
+            if d != -1:
+                out.append(d)
+            elif i == 0:
+                out.append(batch)
+            else:
+                if seq is None:
+                    return None
+                out.append(seq)
+        return tuple(out)
+
+    total = 0.0
+    for op in block.ops:
+        if op.attrs.get("op_role") in ("backward", "optimize"):
+            continue
+        if op.type in ("conv2d", "depthwise_conv2d"):
+            w = shape_of(op.inputs["Filter"][0])
+            out = shape_of(op.outputs["Output"][0])
+            if w and out:
+                # [F, Cin/g, kh, kw] x [B, F, Ho, Wo]
+                total += 2.0 * out[0] * out[2] * out[3] * out[1] \
+                    * w[1] * w[2] * w[3]
+        elif op.type in ("mul", "matmul", "matmul_v2"):
+            x = shape_of(op.inputs["X"][0])
+            y = shape_of(op.inputs["Y"][0])
+            if x and y and len(y) >= 2:
+                numel_x = 1
+                for d in x:
+                    numel_x *= d
+                if op.type == "mul":
+                    # mul flattens x's trailing dims into the
+                    # contraction (x_num_col_dims semantics):
+                    # FLOPs = 2 * |x| * cols
+                    y_ncd = op.attrs.get("y_num_col_dims", 1)
+                    cols = 1
+                    for d in y[y_ncd:]:
+                        cols *= d
+                else:
+                    # matmul: output columns depend on transpose_Y
+                    # (QK^T-style calls contract y's LAST dim)
+                    ty = op.attrs.get("transpose_Y",
+                                      op.attrs.get("transpose_y",
+                                                   False))
+                    cols = y[-2] if ty else y[-1]
+                total += 2.0 * numel_x * cols
+        elif op.type in ("dynamic_lstm", "lstm", "cudnn_lstm"):
+            x = shape_of(op.inputs.get("Input", [None])[0]
+                         or op.inputs.get("X", [None])[0])
+            w = shape_of(op.inputs.get("Weight", [None])[0])
+            if x and w:
+                # recurrent matmul per timestep: [B, h] x [h, 4h]
+                t_steps = x[1] if len(x) >= 3 else 1
+                b = x[0]
+                total += 2.0 * b * t_steps * w[0] * w[1]
+        elif op.type == "switch_moe":
+            x = shape_of(op.inputs["X"][0])
+            w1 = shape_of(op.inputs["W1"][0])
+            if x and w1:
+                toks = 1
+                for d in x[:-1]:
+                    toks *= d
+                k = op.attrs.get("top_k", 1)
+                # each routed token does up+down expert matmuls
+                total += 2.0 * 2 * toks * k * w1[1] * w1[2]
+    return 3.0 * total
+
+
+def _mfu(value_per_sec, flops_per_unit):
+    import jax
+
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    return round(value_per_sec * flops_per_unit / peak, 4)
+
+
 def _time_loop(exe, prog, feed, fetch, steps, warmup):
     import jax
 
@@ -139,11 +231,13 @@ def bench_resnet50():
         elapsed, loss0, loss1 = _time_loop(exe, main_prog, feed, cost,
                                            steps, warmup)
     imgs_per_sec = steps * batch / elapsed
+    flops_img = _analytic_train_flops(main_prog, batch) / batch
     return {
         "metric": "resnet50_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),
         "unit": "imgs/sec",
         "vs_baseline": round(imgs_per_sec / TARGETS["resnet50"], 3),
+        "mfu": _mfu(imgs_per_sec, flops_img),
         "loss0": round(loss0, 4), "loss1": round(loss1, 4),
         "loss_decreased": bool(loss1 < loss0),
         "batch": batch, "amp": "bf16",
@@ -173,11 +267,18 @@ def bench_stacked_lstm():
     elapsed, loss0, loss1 = _time_loop(exe, main_prog, feed, cost,
                                        steps, warmup)
     words_per_sec = steps * int(lens.sum()) / elapsed
+    # per processed (padded) word: the chip computes padded timesteps
+    # regardless, so MFU is vs padded work while words/sec counts real
+    # words — both reported, the gap is the padding tax
+    flops_word = _analytic_train_flops(main_prog, batch, seq=seq) \
+        / (batch * seq)
+    padded_words_per_sec = steps * batch * seq / elapsed
     return {
         "metric": "stacked_dynamic_lstm_train_words_per_sec_per_chip",
         "value": round(words_per_sec, 1),
         "unit": "words/sec",
         "vs_baseline": round(words_per_sec / TARGETS["stacked_lstm"], 3),
+        "mfu": _mfu(padded_words_per_sec, flops_word),
         "loss0": round(loss0, 4), "loss1": round(loss1, 4),
         "loss_decreased": bool(loss1 < loss0),
         "batch": batch, "amp": "fp32",
