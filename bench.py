@@ -140,6 +140,15 @@ def _mfu(value_per_sec, flops_per_unit):
     return round(value_per_sec * flops_per_unit / peak, 4)
 
 
+def _transformer_flops_tok(d_model, d_inner, seq, n_layers, vocab):
+    """Analytic matmul+attention FLOPs per token (fwd); train = 3x."""
+    d, di, t = d_model, d_inner, seq
+    enc = n_layers * (8 * d * d + 4 * d * di + 4 * t * d)
+    dec = n_layers * (16 * d * d + 4 * d * di + 8 * t * d)
+    logits = 2 * d * vocab
+    return 3.0 * (enc + dec + logits)
+
+
 def _time_loop(exe, prog, feed, fetch, steps, warmup):
     import jax
 
@@ -191,13 +200,8 @@ def bench_transformer():
         elapsed, loss0, loss1 = _time_loop(exe, main_prog, feed, cost,
                                            steps, warmup)
     tokens_per_sec = steps * batch * seq / elapsed
-
-    # analytic matmul+attention FLOPs per token (fwd); train = 3x fwd
-    d, di, t = d_model, d_inner, seq
-    enc = n_layers * (8 * d * d + 4 * d * di + 4 * t * d)
-    dec = n_layers * (16 * d * d + 4 * d * di + 8 * t * d)
-    logits = 2 * d * vocab
-    flops_tok = 3.0 * (enc + dec + logits)
+    flops_tok = _transformer_flops_tok(d_model, d_inner, seq,
+                                       n_layers, vocab)
     peak = _peak_flops(jax.devices()[0].device_kind)
     mfu = tokens_per_sec * flops_tok / peak
     return {
@@ -354,11 +358,75 @@ def bench_mnist():
     }
 
 
+def bench_transformer_scan(batch=256, seq=256):
+    """Transformer-base trained through scan-over-layers
+    (PipelineTrainer pp=1): the HLO stops growing linearly in depth,
+    which is the framework-native fix for the remote compile helper
+    500ing on the fully-unrolled batch>=256 program (PERF.md). OPT-IN
+    (run `python bench.py transformer_scan`): kept out of the default
+    driver window until A/B'd on the real chip."""
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import amp
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.parallel.pipeline_program import (PipelineTrainer,
+                                                      propose_loops)
+
+    vocab = 32000
+    d_model, n_heads, n_layers, d_inner = 512, 8, 6, 2048
+    steps, warmup = 15, 5
+    main_prog, startup, cost = T.build_program(
+        seq_len=seq, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_inner=d_inner, vocab=vocab,
+        dropout_rate=0.0, with_optimizer=True, learning_rate=2.0,
+        warmup_steps=8000)
+    loops = propose_loops(main_prog, cost.name)
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    r = np.random.RandomState(0)
+    feed = {
+        "src_ids": r.randint(0, vocab, (batch, seq)).astype(np.int64),
+        "tgt_ids": r.randint(0, vocab, (batch, seq)).astype(np.int64),
+        "label": r.randint(0, vocab, (batch, seq)).astype(np.int64),
+    }
+    with amp.amp_guard(True):
+        exe.run(startup, scope=scope)
+        tr = PipelineTrainer(main_prog, cost, loops=loops)
+        tr.initialize(scope)
+        for _ in range(warmup):
+            out = tr.run(feed=feed)
+        loss0 = float(np.asarray(out[0]).reshape(-1)[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = tr.run(feed=feed, return_numpy=False)
+        loss1 = float(np.asarray(out[0]).reshape(-1)[0])
+        elapsed = time.perf_counter() - t0
+    tokens_per_sec = steps * batch * seq / elapsed
+    flops_tok = _transformer_flops_tok(d_model, d_inner, seq,
+                                       n_layers, vocab)
+    return {
+        "metric": "transformer_scan_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tokens_per_sec / TARGETS["transformer"], 3),
+        "mfu": _mfu(tokens_per_sec, flops_tok),
+        "loss0": round(loss0, 4), "loss1": round(loss1, 4),
+        "loss_decreased": bool(loss1 < loss0),
+        "batch": batch, "seq_len": seq, "amp": "bf16",
+        "lowering": "scan-over-layers",
+    }
+
+
 BENCHES = [("transformer", bench_transformer),
            ("resnet50", bench_resnet50),
            ("stacked_lstm", bench_stacked_lstm),
            ("ctr", bench_ctr),
            ("mnist", bench_mnist)]
+
+# opt-in configs (argv-selectable only; never in the driver's default
+# window)
+EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan}
 
 
 def _probe_backend(timeout_s=180):
@@ -399,7 +467,10 @@ def main():
     import jax
 
     only = sys.argv[1] if len(sys.argv) > 1 else None
-    for name, fn in BENCHES:
+    benches = list(BENCHES)
+    if only in EXTRA_BENCHES:
+        benches = [(only, EXTRA_BENCHES[only])]
+    for name, fn in benches:
         if only and name != only:
             continue
         try:

@@ -105,6 +105,10 @@ class CompiledProgram:
         self._places = places
         self._user_mesh = mesh
         self._sharding_rules = sharding_rules
+        # placement-config epoch: id()-keyed cache entries would be
+        # unsound (a GC'd mesh/rules object's address can be reused);
+        # every reconfigure bumps this instead
+        self._config_epoch = getattr(self, "_config_epoch", 0) + 1
         if mesh is not None and "dp" not in mesh.axis_names:
             raise ValueError(
                 "with_data_parallel(mesh=...) needs a 'dp' axis; got "
@@ -157,12 +161,6 @@ class CompiledProgram:
             return self._auto_rules[1]
         return rules
 
-    def _rules_token(self):
-        rules = getattr(self, "_sharding_rules", "auto")
-        # "auto" re-derives per program version (already in the key);
-        # explicit rules objects key by identity
-        return "auto" if isinstance(rules, str) else id(rules)
-
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
         if not self._is_data_parallel:
             return executor.run(self._program, feed=feed,
@@ -190,8 +188,7 @@ class CompiledProgram:
 
         key = (id(self._program), self._program._version,
                tuple(sorted(feed_specs)), tuple(fetch_names), ndev,
-               id(getattr(self, "_user_mesh", None)),
-               self._rules_token(),
+               getattr(self, "_config_epoch", 0),
                amp.state_token(), _parallel_scope_token())
         compiled = self._cache.get(key)
         if compiled is None:

@@ -563,7 +563,12 @@ class PipelineTrainer:
         if self.pp == 1:
             def body(h, xs):
                 params, j = xs
-                return self._seg_apply(loop, params, h, env, key, j), None
+                out = self._seg_apply(loop, params, h, env, key, j)
+                # under AMP the boundary can come back fp32 (layer_norm
+                # is a KEEP op) while the carry entered bf16; cast back
+                # -- identical to the cast the next layer's first
+                # white-listed op performs in the unrolled program
+                return out.astype(h.dtype), None
             h, _ = lax.scan(body, h0,
                             (tuple(stacked), jnp.arange(n_seg)))
             return h
@@ -605,7 +610,8 @@ class PipelineTrainer:
                     params, j = xs
                     out = self._seg_apply(loop, params, hc, bc, key,
                                           idx * k + j)
-                    return out, None
+                    # AMP boundary cast; see the pp==1 branch
+                    return out.astype(hc.dtype), None
 
                 h, _ = lax.scan(seg_body, h,
                                 (tuple(stk), jnp.arange(k)))
@@ -717,9 +723,13 @@ class PipelineTrainer:
         return step
 
     # ------------------------------------------------------------------
-    def run(self, feed: Dict, fetch_list=None):
+    def run(self, feed: Dict, fetch_list=None, return_numpy=True):
         """One training step. Returns [loss] (plus any fetched state
-        vars named in fetch_list)."""
+        vars named in fetch_list). return_numpy=False keeps the LOSS
+        as a device array so steps pipeline without a host round-trip
+        (PERF.md "Measurement pitfalls": convert only the last one);
+        state fetches are converted regardless because their buffers
+        are donated to the next step."""
         if not self.state:
             raise RuntimeError(
                 "PipelineTrainer.run before initialize(scope)")
@@ -746,11 +756,16 @@ class PipelineTrainer:
             self._feed_spec = spec
         self.state, loss, self._rng = self._jitted(
             self.state, feeds, self._rng)
-        out = [np.asarray(loss)]
+        out = [np.asarray(loss) if return_numpy else loss]
         for f in (fetch_list or []):
             name = f.name if hasattr(f, "name") else f
             if name == self.loss_name:
                 continue
+            # state entries are ALWAYS converted: their device buffers
+            # are donated to the next step's jit call, so returning
+            # the live reference would hand the caller an array that
+            # dies on the next run() (the loss is a fresh jit output
+            # and safe to keep on device)
             out.append(np.asarray(self.state[name]))
         return out
 
