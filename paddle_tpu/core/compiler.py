@@ -186,7 +186,7 @@ class CompiledProgram:
         from .. import amp
         from .executor import _parallel_scope_token
 
-        key = (id(self._program), self._program._version,
+        key = (self._program._uid, self._program._version,
                tuple(sorted(feed_specs)), tuple(fetch_names), ndev,
                getattr(self, "_config_epoch", 0),
                amp.state_token(), _parallel_scope_token())
@@ -213,13 +213,18 @@ class CompiledProgram:
 
             shape = getattr(val, "shape", ())
             spec = safe_spec(mesh, rules.spec_for(name, len(shape)),
-                             shape)
+                             shape, name=name)
             return NamedSharding(mesh, spec)
         # No explicit loss scaling needed: the program computes the GLOBAL
         # batch mean, so XLA's SPMD partitioner inserts the psum with the
         # right coefficient -- fluid's CoeffNumDevice scale_loss_grad op
         # (details/scale_loss_grad_op_handle.cc) is subsumed.
         jitted = jax.jit(step, donate_argnums=(0,))
+        # rules and mesh are fixed for this executable: memoize each
+        # name's target sharding so the steady state pays one dict hit
+        # + an is_equivalent_to check per array, not a spec_for
+        # key-scan + regex + NamedSharding build per step
+        _targets: Dict[str, NamedSharding] = {}
 
         def run(scope, feed_arrays, return_numpy):
             mut = {n: scope._get(n) for n in mutated}
@@ -233,12 +238,28 @@ class CompiledProgram:
             sharded_feeds = {
                 n: jax.device_put(v, batched)
                 for n, v in feed_arrays.items()}
-            mut = {n: jax.device_put(v, param_sharding(n, v))
-                   if not _is_sharded(v) else v
-                   for n, v in mut.items()}
-            const_st = {n: jax.device_put(v, param_sharding(n, v))
-                        if not _is_sharded(v) else v
-                        for n, v in const_st.items()}
+
+            def place(n, v):
+                # A previously-placed array is kept only if its sharding
+                # agrees with the CURRENT rules: after a reconfiguring
+                # with_data_parallel() call the new structural rules must
+                # apply to state placed under the old config too (the
+                # config epoch busts the executable cache, but the scope
+                # arrays live on).
+                target = _targets.get(n)
+                if target is None:
+                    target = _targets[n] = param_sharding(n, v)
+                if _is_sharded(v):
+                    try:
+                        if v.sharding.is_equivalent_to(target, v.ndim):
+                            return v
+                    except Exception:
+                        return v
+                    return jax.device_put(v, target)
+                return jax.device_put(v, target)
+
+            mut = {n: place(n, v) for n, v in mut.items()}
+            const_st = {n: place(n, v) for n, v in const_st.items()}
             rng = scope._get(RNG_VAR)
             if rng is None:
                 rng = jax.random.PRNGKey(_global_seed[0])

@@ -390,6 +390,18 @@ def _default_layout_specs(step, scope, mutated, const, feed_arrays,
     return in_fmts, out_fmts
 
 
+def _mesh_token(mesh):
+    """Stable identity for a jax Mesh in executable cache keys.
+    id(mesh) is unsound: a GC'd mesh whose address is reused by a new,
+    DIFFERENT mesh would serve a stale executable. Axis names + shape +
+    flat device ids pin the things that change how ops lower."""
+    try:
+        dev_ids = tuple(int(d.id) for d in mesh.devices.flat)
+    except Exception:
+        dev_ids = ()
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape), dev_ids)
+
+
 def _parallel_scope_token():
     """Part of the executable cache key: the context-parallel and
     expert-parallel activation scopes change how attention/switch_moe
@@ -405,11 +417,11 @@ def _parallel_scope_token():
     cp = active_context_parallel()
     if cp is not None:
         mesh, axis, impl = cp
-        tok.append(("cp", id(mesh), axis, impl))
+        tok.append(("cp", _mesh_token(mesh), axis, impl))
     ep = active_expert_parallel()
     if ep is not None:
         mesh, axis = ep
-        tok.append(("ep", id(mesh), axis))
+        tok.append(("ep", _mesh_token(mesh), axis))
     return tuple(tok)
 
 
@@ -507,7 +519,7 @@ class Executor:
 
         from .. import amp
 
-        key = (id(program), program._version, tuple(sorted(feed_specs)),
+        key = (program._uid, program._version, tuple(sorted(feed_specs)),
                tuple(fetch_names), amp.state_token(),
                _parallel_scope_token())
         compiled = self._cache.get(key) if use_program_cache else None

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import contextlib
 import copy
+import itertools
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -335,6 +336,9 @@ def _normalize_io(io) -> Dict[str, List[str]]:
     return out
 
 
+_program_uid_counter = itertools.count()
+
+
 class Program:
     """A whole trainable/executable program (reference framework.py:2660)."""
 
@@ -343,6 +347,10 @@ class Program:
         self.current_block_idx = 0
         self._parameters: Dict[str, Variable] = {}
         self._version = 0
+        # process-unique identity for executable cache keys: id() is
+        # unsound (a GC'd Program's address can be reused by a new
+        # Program whose _version also starts at 0)
+        self._uid = next(_program_uid_counter)
         self._seed = None
         self.op_role_vars: List[str] = []
 

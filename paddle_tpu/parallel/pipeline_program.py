@@ -584,9 +584,38 @@ class PipelineTrainer:
         k = len(loop.segments) // pp
 
         bb_names, const_names = [], []
+        blk = self.program.global_block
         for n in loop.bcast:
             v = env[n]
-            if getattr(v, "ndim", 0) >= 1 and v.shape[0] == B:
+            runtime_batch = getattr(v, "ndim", 0) >= 1 and \
+                v.shape[0] == B
+            # classify per-microbatch vs broadcast-constant by var
+            # METADATA, not runtime shape alone: a non-batch var whose
+            # leading dim coincidentally equals B (e.g. a [T,T]
+            # attention mask when seq == batch) must NOT be split.
+            # Declared -1 leading dim (or a data var) = batch-major;
+            # a fully concrete declaration whose leading dim happens
+            # to equal B is AMBIGUOUS and errors with guidance rather
+            # than silently splitting (wrong numerics) or silently
+            # broadcasting (also wrong, the other way).
+            var = blk._find_var_recursive(n)
+            decl = tuple(var.shape) if var is not None and var.shape \
+                else None
+            if decl is not None and len(decl) == getattr(v, "ndim", 0):
+                per_batch = runtime_batch and (
+                    decl[0] == -1 or var.is_data)
+                if runtime_batch and not per_batch:
+                    raise ValueError(
+                        f"pipeline: broadcast input {n!r} (declared "
+                        f"shape {decl}) has leading dim == batch {B} "
+                        f"but is not declared batch-major; cannot "
+                        f"tell per-microbatch data from a broadcast "
+                        f"constant. Declare its batch dim as -1 (per-"
+                        f"microbatch) or reshape so the leading dim "
+                        f"differs from the batch (constant).")
+            else:
+                per_batch = runtime_batch
+            if per_batch:
                 bb_names.append(n)
             else:
                 const_names.append(n)

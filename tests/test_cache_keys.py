@@ -1,0 +1,165 @@
+"""Executable-cache identity regressions (VERDICT r3 weak #3 /
+ADVICE #1): id()-keyed caches are unsound — a GC'd Program/Mesh whose
+address is reused by a new object (whose _version also starts at 0)
+must NOT be served a stale executable. Keys now use a process-unique
+Program._uid and a structural mesh token."""
+import gc
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as fluid
+
+
+def _fresh():
+    fluid._reset_global_scope()
+    from paddle_tpu import unique_name
+    unique_name.switch()
+
+
+def _build(scale):
+    """A one-op program: out = x * scale (scale baked as attr)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        out = fluid.layers.scale(x, scale=scale)
+    return prog, startup, out
+
+
+class TestProgramUid:
+    def test_uids_are_unique_and_survive_clone(self):
+        p1 = fluid.Program()
+        p2 = fluid.Program()
+        assert p1._uid != p2._uid
+        c = p1.clone()
+        assert c._uid != p1._uid  # a clone is a DIFFERENT program
+
+    def test_gc_lookalike_program_gets_fresh_compile(self):
+        """Two same-shaped programs built/GC'd in sequence through ONE
+        executor must produce their own numerics even if the second
+        reuses the first's heap address (the id() bug this guards)."""
+        _fresh()
+        exe = fluid.Executor(fluid.TPUPlace())
+        feed = {"x": np.ones((2, 4), np.float32)}
+
+        prog1, startup1, out1 = _build(2.0)
+        exe.run(startup1)
+        r1 = exe.run(prog1, feed=feed, fetch_list=[out1])[0]
+        addr1 = id(prog1)
+        del prog1, startup1, out1
+        gc.collect()
+
+        # allocate lookalikes until one lands on the old address (or
+        # give up — the uid key is correct either way; landing on the
+        # address makes the regression real)
+        progs = []
+        hit = None
+        for scale in range(3, 40):
+            p, s, o = _build(float(scale))
+            if id(p) == addr1:
+                hit = (p, s, o, float(scale))
+                break
+            progs.append((p, s, o))
+        if hit is None:
+            # couldn't provoke address reuse; still assert basic
+            # correctness of a second program through the same cache
+            p, s, o = progs[0]
+            exe.run(s)
+            r2 = exe.run(p, feed=feed, fetch_list=[o])[0]
+            np.testing.assert_allclose(r2, np.ones((2, 4)) * 3.0)
+            return
+        p, s, o, scale = hit
+        assert p._version == 0  # same version as the dead program had
+        exe.run(s)
+        r2 = exe.run(p, feed=feed, fetch_list=[o])[0]
+        np.testing.assert_allclose(r2, np.ones((2, 4)) * scale)
+        np.testing.assert_allclose(r1, np.ones((2, 4)) * 2.0)
+
+
+class TestMeshToken:
+    def test_token_is_structural_not_identity(self):
+        from paddle_tpu.core.executor import _mesh_token
+        devs = np.array(jax.devices()[:4]).reshape(2, 2)
+        m1 = Mesh(devs, ("dp", "tp"))
+        tok1 = _mesh_token(m1)
+        del m1
+        gc.collect()
+        m2 = Mesh(devs, ("dp", "tp"))
+        assert _mesh_token(m2) == tok1  # same structure, same token
+        m3 = Mesh(devs.reshape(4, 1), ("dp", "tp"))
+        assert _mesh_token(m3) != tok1  # different shape, new token
+        m4 = Mesh(devs, ("dp", "sp"))
+        assert _mesh_token(m4) != tok1  # different axes, new token
+
+    def test_scope_token_uses_mesh_token(self):
+        """Entering context_parallel with a structurally different
+        mesh must change the scope token (stale-executable guard)."""
+        from paddle_tpu.core.executor import _parallel_scope_token
+        from paddle_tpu.parallel.ring_attention import context_parallel
+        devs = jax.devices()
+        m_a = Mesh(np.array(devs[:2]), ("sp",))
+        m_b = Mesh(np.array(devs[2:4]), ("sp",))
+        with context_parallel(m_a, "sp"):
+            tok_a = _parallel_scope_token()
+        with context_parallel(m_b, "sp"):
+            tok_b = _parallel_scope_token()
+        assert tok_a != tok_b
+        with context_parallel(m_a, "sp"):
+            assert _parallel_scope_token() == tok_a
+        assert _parallel_scope_token() == ()
+
+
+class TestReconfigurePlacement:
+    def test_state_replaced_on_config_epoch_change(self):
+        """ADVICE #3: after a reconfiguring with_data_parallel(), state
+        placed under the OLD config must be re-placed by the new rules
+        (the executable cache is busted by the epoch; the scope arrays
+        must follow)."""
+        _fresh()
+        from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[16],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(
+                x, size=64, act="relu",
+                param_attr=fluid.ParamAttr(name="up_w"),
+                bias_attr=False)
+            h = fluid.layers.fc(
+                h, size=16, param_attr=fluid.ParamAttr(name="down_w"),
+                bias_attr=False)
+            logits = fluid.layers.fc(h, size=4, bias_attr=False)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(0).randn(8, 16).astype(
+            np.float32),
+            "y": np.zeros((8, 1), np.int64)}
+
+        mesh_tp = make_mesh(MeshConfig(dp=2, tp=2),
+                            devices=jax.devices()[:4])
+        cp = fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name, mesh=mesh_tp)
+        exe.run(cp, feed=feed, fetch_list=[loss])
+        scope = fluid.global_scope()
+        up_w = scope._get("up_w")
+        spec = up_w.sharding.spec
+        assert any(s == "tp" for s in spec), spec  # TP-sharded now
+
+        # reconfigure to plain dp (tp=1): params must come back to
+        # replicated, not stay sharded under the dead config
+        mesh_dp = make_mesh(MeshConfig(dp=2),
+                            devices=jax.devices()[:2])
+        cp.with_data_parallel(loss_name=loss.name, mesh=mesh_dp)
+        exe.run(cp, feed=feed, fetch_list=[loss])
+        up_w2 = scope._get("up_w")
+        spec2 = getattr(up_w2.sharding, "spec", P())
+        assert not any(s == "tp" for s in tuple(spec2)), spec2
