@@ -10,6 +10,7 @@ on top of JAX's functional purity (SURVEY.md hard part (e)).
 """
 from __future__ import annotations
 
+import itertools
 from typing import Dict, Optional
 
 import numpy as np
@@ -52,12 +53,18 @@ class ScopeVar:
         return TensorValue(self._scope, self.name)
 
 
+_scope_uid_counter = itertools.count()
+
+
 class Scope:
     def __init__(self, parent: Optional["Scope"] = None):
         self._vars: Dict[str, object] = {}
         self._lods: Dict[str, list] = {}
         self.parent = parent
         self._kids = []
+        # process-unique identity for caches keyed on "which scope":
+        # id() is unsound after GC + address reuse
+        self._uid = next(_scope_uid_counter)
 
     # --- fluid-style interface --------------------------------------------
     def var(self, name) -> ScopeVar:

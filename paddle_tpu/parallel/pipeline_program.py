@@ -437,19 +437,26 @@ class PipelineTrainer:
     def __init__(self, program: Program, loss, *,
                  loops: Sequence[Sequence[str]],
                  mesh: Optional[Mesh] = None, n_micro: int = 1,
-                 axis: str = "pp"):
+                 axis: str = "pp", tp_rules=None):
         self.program = program
         self.loss_name = loss.name if hasattr(loss, "name") else loss
         self.mesh = mesh
         self.axis = axis
         self.n_micro = int(n_micro)
         self.pp = 1 if mesh is None else int(mesh.shape[axis])
+        self.tp = 1
         if mesh is not None:
+            # pp composes with tp: the GPipe ring is MANUAL over the
+            # 'pp' axis (shard_map axis_names) while 'tp' stays an
+            # AUTO axis — GSPMD partitions the per-segment matmuls by
+            # the structural rules exactly as the dp x tp Executor
+            # path does. Other axes must be size 1.
+            self.tp = int(mesh.shape.get("tp", 1))
             other = [a for a in mesh.axis_names
-                     if a != axis and mesh.shape[a] != 1]
+                     if a not in (axis, "tp") and mesh.shape[a] != 1]
             if other:
                 raise PipelinePartitionError(
-                    f"PipelineTrainer v1 supports a pure {axis!r} "
+                    f"PipelineTrainer supports a {axis!r} (x 'tp') "
                     f"mesh; axes {other} have size > 1")
         self.sections, self.phase_b = _partition(
             program, self.loss_name, loops)
@@ -460,10 +467,40 @@ class PipelineTrainer:
                     f"{len(sec.loop.segments)} segments not divisible "
                     f"by pp={self.pp}")
         self._collect_state_names()
+        # explicit tp_rules (a ShardingRules object) wins; otherwise
+        # derive the structural table from the program graph
+        self._tp_rules = tp_rules if self.tp > 1 else None
+        if self.tp > 1 and tp_rules is None:
+            from .sharding import derive_sharding_rules
+
+            self._tp_rules = derive_sharding_rules(program)
         self.state: Dict[str, jax.Array] = {}
         self._rng = None
         self._jitted = None
         self._feed_spec = None
+
+    # ------------------------------------------------------------------
+    def _tp_spec(self, name, shape):
+        """PartitionSpec ('tp' dims only) for one state var, downgraded
+        to replicated when the dim doesn't divide."""
+        from .sharding import safe_spec
+
+        if self._tp_rules is None:
+            return P()
+        return safe_spec(self.mesh,
+                         self._tp_rules.spec_for(name, len(shape)),
+                         shape, name=name)
+
+    def _stack_spec(self, loop, pos, shape):
+        """Sharding spec for a stacked [n_seg, ...] param: 'pp' on the
+        stack dim + the canon param's tp spec on its own dims. Falls
+        back to pp-only if segments disagree (can't happen for loops
+        that passed isomorphism validation, but stay safe)."""
+        specs = {tuple(self._tp_spec(loop.seg_params[s][pos], shape))
+                 for s in range(len(loop.seg_params))}
+        tp_part = specs.pop() if len(specs) == 1 else ()
+        lead = self.axis if self.pp > 1 else None
+        return P(lead, *tp_part)
 
     # ------------------------------------------------------------------
     def _collect_state_names(self):
@@ -524,7 +561,14 @@ class PipelineTrainer:
                 raise RuntimeError(
                     f"Variable {n!r} is used before initialization -- "
                     f"run the startup program first")
-            self.state[n] = jnp.asarray(np.asarray(v))
+            arr = jnp.asarray(np.asarray(v))
+            if self.tp > 1:
+                # replicated-section params (embeddings, logits head,
+                # optimizer accumulators) take their structural tp spec
+                # up front; loop params are re-constrained at stack time
+                arr = jax.device_put(arr, NamedSharding(
+                    self.mesh, self._tp_spec(n, arr.shape)))
+            self.state[n] = arr
         seed = getattr(self.program, "_seed", None) or 0
         self._rng = jax.random.PRNGKey(seed)
         return self
@@ -556,9 +600,11 @@ class PipelineTrainer:
             leaves = [env[loop.seg_params[s][pos]]
                       for s in range(n_seg)]
             st = jnp.stack(leaves)
-            if self.pp > 1:
+            if self.pp > 1 or self.tp > 1:
                 st = lax.with_sharding_constraint(
-                    st, NamedSharding(self.mesh, P(self.axis)))
+                    st, NamedSharding(
+                        self.mesh,
+                        self._stack_spec(loop, pos, leaves[0].shape)))
             stacked.append(st)
         if self.pp == 1:
             def body(h, xs):
@@ -688,8 +734,13 @@ class PipelineTrainer:
             outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
             return lax.psum(outs, axis)
 
+        # manual ONLY over the pp ring axis: 'tp' (if present) stays an
+        # auto axis, so GSPMD partitions the segment matmuls inside the
+        # ring body by the stacked params' tp shardings — the same
+        # composition mechanism as the dp x tp Executor path
         fn = jax.shard_map(
             local, mesh=self.mesh,
+            axis_names=frozenset({axis}),
             in_specs=([P(axis)] * len(stacked),
                       P(), [P()] * len(xs_bb),
                       [P()] * len(consts), P()),
