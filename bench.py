@@ -418,6 +418,58 @@ def bench_transformer_scan(batch=256, seq=256):
     }
 
 
+def bench_moe_transformer(batch=64, seq=256):
+    """Switch-MoE decoder LM (models/moe_transformer.py): dense FLOPs
+    of a 4-layer model, 8x expert capacity on the alternating layers.
+    Reports tokens/s + the per-layer drop fractions. OPT-IN
+    (`python bench.py moe_transformer`)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import amp
+    from paddle_tpu.models import moe_transformer as M
+
+    vocab = 32000
+    d_model, n_heads, n_layers, d_inner = 512, 8, 4, 2048
+    steps, warmup = 15, 5
+    main_prog, startup, cost = M.build_program(
+        seq_len=seq, vocab=vocab, d_model=d_model, n_heads=n_heads,
+        n_layers=n_layers, d_inner=d_inner, n_experts=8, top_k=1,
+        capacity_factor=2.0, dropout_rate=0.0, learning_rate=2.0,
+        warmup_steps=8000)
+    exe = fluid.Executor(fluid.TPUPlace())
+    r = np.random.RandomState(0)
+    feed = {
+        "src_ids": r.randint(0, vocab, (batch, seq)).astype(np.int64),
+        "label": r.randint(0, vocab, (batch, seq)).astype(np.int64),
+    }
+    drops = main_prog._moe_drop_vars
+    with amp.amp_guard(True):
+        exe.run(startup)
+        elapsed, loss0, loss1 = _time_loop(exe, main_prog, feed, cost,
+                                           steps, warmup)
+        drop_vals = [
+            float(np.asarray(v).reshape(-1)[0])
+            for v in exe.run(main_prog, feed=feed, fetch_list=drops)]
+    tokens_per_sec = steps * batch * seq / elapsed
+    # dense-equivalent FLOPs: attention stack + top-1 expert FFN per
+    # token (same matmul work per token as a dense FFN) + logits
+    d, di = d_model, d_inner
+    flops_tok = 3.0 * (n_layers * (8 * d * d + 4 * d * di
+                                   + 4 * seq * d)
+                       + 2 * d * vocab)
+    return {
+        "metric": "moe_transformer_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tokens_per_sec / TARGETS["transformer"], 3),
+        "mfu": _mfu(tokens_per_sec, flops_tok),
+        "loss0": round(loss0, 4), "loss1": round(loss1, 4),
+        "loss_decreased": bool(loss1 < loss0),
+        "drop_fracs": [round(v, 4) for v in drop_vals],
+        "batch": batch, "seq_len": seq, "amp": "bf16",
+        "n_experts": 8,
+    }
+
+
 BENCHES = [("transformer", bench_transformer),
            ("resnet50", bench_resnet50),
            ("stacked_lstm", bench_stacked_lstm),
@@ -426,7 +478,8 @@ BENCHES = [("transformer", bench_transformer),
 
 # opt-in configs (argv-selectable only; never in the driver's default
 # window)
-EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan}
+EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
+                 "moe_transformer": bench_moe_transformer}
 
 
 def _probe_backend(timeout_s=180):

@@ -1296,12 +1296,17 @@ def deformable_conv(input, offset, mask=None, num_filters=None,
 
 
 def switch_moe(input, num_experts, d_inner, top_k=1,
-               capacity_factor=2.0, param_attr=None, name=None):
+               capacity_factor=2.0, param_attr=None, name=None,
+               return_drop_frac=False):
     """Switch/GShard mixture-of-experts FFN (beyond-reference; routing
     math + expert-parallel dataflow in parallel/moe.py, lowered by the
     `switch_moe` op). Returns (out, aux_loss): add
     ``aux_loss * coeff`` (Switch uses coeff=0.01) onto the training
     loss or routing collapses onto one expert.
+    With ``return_drop_frac=True`` returns (out, aux_loss, drop_frac)
+    where drop_frac [1] is the fraction of tokens that received NO
+    expert slot this step — fetch it to monitor silent over-capacity
+    drops (it costs nothing when unfetched; XLA dead-codes it).
 
     input: [..., D]; experts are [D, d_inner] -> [d_inner, D] relu
     MLPs. Under `with expert_parallel(mesh):` the op runs all_to_all
@@ -1333,11 +1338,15 @@ def switch_moe(input, num_experts, d_inner, top_k=1,
         default_initializer=NormalInitializer(0.0, std))
     out = helper.create_variable_for_type_inference(input.dtype)
     aux = helper.create_variable_for_type_inference("float32")
+    drop = helper.create_variable_for_type_inference("float32")
+    drop.stop_gradient = True
     helper.append_op(
         "switch_moe",
         {"X": input, "GateW": wg, "W1": w1, "W2": w2},
-        {"Out": out, "AuxLoss": aux},
+        {"Out": out, "AuxLoss": aux, "DropFrac": drop},
         {"top_k": int(top_k), "capacity_factor": float(capacity_factor)})
+    if return_drop_frac:
+        return out, aux, drop
     return out, aux
 
 

@@ -7,6 +7,7 @@ from . import resnet  # noqa: F401
 from . import vgg  # noqa: F401
 from . import se_resnext  # noqa: F401
 from . import transformer  # noqa: F401
+from . import moe_transformer  # noqa: F401
 from . import stacked_dynamic_lstm  # noqa: F401
 from . import ctr  # noqa: F401
 from . import word2vec  # noqa: F401

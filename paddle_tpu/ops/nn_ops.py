@@ -233,13 +233,20 @@ def switch_moe(ctx):
     t, E = xt.shape[0], w1.shape[0]
     if moe_mod.ep_applicable(t, E):
         mesh, axis = moe_mod.active_expert_parallel()
-        out, aux = moe_mod.moe_apply(xt, wg, w1, w2, mesh, axis=axis,
-                                     capacity_factor=cf, top_k=top_k)
+        out, aux, drop = moe_mod.moe_apply(
+            xt, wg, w1, w2, mesh, axis=axis,
+            capacity_factor=cf, top_k=top_k)
     else:
         cap = max(1, int(cf * top_k * t / E))
-        out, aux = moe_mod.moe_dense(xt, wg, w1, w2, cap, top_k)
+        out, aux, drop = moe_mod.moe_dense(xt, wg, w1, w2, cap, top_k)
+    # DropFrac: fraction of tokens with zero dispatch slots — the
+    # first thing to monitor in real MoE training. Extra outputs are
+    # free when unfetched (XLA dead-codes them); stop_gradient keeps
+    # the monitoring path out of AD.
     return {"Out": out.reshape(shape),
-            "AuxLoss": aux.reshape(1).astype(jnp.float32)}
+            "AuxLoss": aux.reshape(1).astype(jnp.float32),
+            "DropFrac": jax.lax.stop_gradient(drop).reshape(1).astype(
+                jnp.float32)}
 
 
 @register_op("conv3d")

@@ -87,6 +87,9 @@ class _Loop:
     # per-segment param names aligned with canon_params
     seg_params: List[List[str]] = field(default_factory=list)
     bcast: List[str] = field(default_factory=list)       # broadcast reads
+    # per-segment outputs read after the loop (MoE aux pattern):
+    # outer list = positional family, inner = one name per segment
+    reduce_outs: List[List[str]] = field(default_factory=list)
 
 
 @dataclass
@@ -314,6 +317,48 @@ def _partition(program: Program, loss_name: str,
                 f"loop segments have differing param counts {lens}")
         loop.bcast = bcast
 
+        # reduce outputs: vars written inside a segment and read AFTER
+        # the loop (the MoE per-layer aux-loss pattern). They are
+        # emitted per segment by the scan/GPipe schedule; microbatched
+        # schedules average them over microbatches (documented: the
+        # Switch aux is nonlinear in the batch, so pp>1 values are the
+        # mean of per-microbatch routing statistics).
+        seen_self = False
+        later_reads = set()
+        for s in sections:
+            if s is sec:
+                seen_self = True
+                continue
+            if not seen_self:
+                continue
+            ops_ = s.ops if s.kind == "repl" else \
+                [op for seg in s.loop.segments for op in seg]
+            for op in ops_:
+                later_reads.update(_op_reads(op))
+        for op in phase_b:
+            later_reads.update(_op_reads(op))
+
+        def _out_positions(seg):
+            pos = []
+            for oi, op in enumerate(seg):
+                for slot, names in op.outputs.items():
+                    for k, nm in enumerate(names):
+                        if nm in later_reads and nm != loop.bounds[-1]:
+                            pos.append((oi, slot, k))
+            return pos
+
+        pos0 = _out_positions(loop.segments[0])
+        for si, seg in enumerate(loop.segments[1:], 1):
+            if _out_positions(seg) != pos0:
+                raise PipelinePartitionError(
+                    f"loop segment {si}: per-segment outputs read "
+                    f"after the loop do not line up positionally with "
+                    f"segment 0's (every segment must export the same "
+                    f"reduce outputs)")
+        loop.reduce_outs = [
+            [seg[oi].outputs[slot][k] for seg in loop.segments]
+            for (oi, slot, k) in pos0]
+
     return sections, phase_b
 
 
@@ -339,14 +384,19 @@ def propose_loops(program: Program, loss_name: str,
     # covering the most ops (a transformer layer beats the 2-op
     # bias-add mini-runs nested inside it)
     candidates = []
+    def _iso(a_off, b_off, period):
+        return (types[b_off:b_off + period] ==
+                types[a_off:a_off + period] and
+                all(_attrs_isomorphic(ops[a_off + i].attrs,
+                                      ops[b_off + i].attrs)
+                    for i in range(period)))
+
     for period in range(1, n // 2 + 1):
         start = 0
         while start + 2 * period <= n:
             m = 1
             while (start + (m + 1) * period <= n
-                   and types[start + m * period:
-                             start + (m + 1) * period]
-                   == types[start:start + period]):
+                   and _iso(start, start + m * period, period)):
                 m += 1
             if m >= min_segments:
                 segs = [ops[start + i * period:
@@ -579,7 +629,8 @@ class PipelineTrainer:
 
     # ------------------------------------------------------------------
     def _seg_apply(self, loop, params_list, h, bcast_env, key, seg_idx):
-        """Run segment-0's ops with positionally-bound params."""
+        """Run segment-0's ops with positionally-bound params.
+        Returns (boundary output, tuple of reduce-out values)."""
         env = dict(bcast_env)
         env[loop.bounds[0]] = h
         for name, val in zip(loop.canon_params, params_list):
@@ -588,7 +639,8 @@ class PipelineTrainer:
         for op in loop.segments[0]:
             run_op(op, env, rng_cell=cell,
                    rng_salt=_fold_salt(op._uid, seg_idx))
-        return env[loop.bounds[1]]
+        reds = tuple(env[fam[0]] for fam in loop.reduce_outs)
+        return env[loop.bounds[1]], reds
 
     def _run_loop(self, loop, env, key):
         h0 = env[loop.bounds[0]]
@@ -609,14 +661,18 @@ class PipelineTrainer:
         if self.pp == 1:
             def body(h, xs):
                 params, j = xs
-                out = self._seg_apply(loop, params, h, env, key, j)
+                out, reds = self._seg_apply(loop, params, h, env, key,
+                                            j)
                 # under AMP the boundary can come back fp32 (layer_norm
                 # is a KEEP op) while the carry entered bf16; cast back
                 # -- identical to the cast the next layer's first
                 # white-listed op performs in the unrolled program
-                return out.astype(h.dtype), None
-            h, _ = lax.scan(body, h0,
-                            (tuple(stacked), jnp.arange(n_seg)))
+                return out.astype(h.dtype), reds
+            h, ys = lax.scan(body, h0,
+                             (tuple(stacked), jnp.arange(n_seg)))
+            for fam, arr in zip(loop.reduce_outs, ys):
+                for si, nm in enumerate(fam):
+                    env[nm] = arr[si]
             return h
         return self._run_loop_gpipe(loop, stacked, h0, env, key)
 
@@ -683,14 +739,14 @@ class PipelineTrainer:
 
                 def seg_body(hc, xs):
                     params, j = xs
-                    out = self._seg_apply(loop, params, hc, bc, key,
-                                          idx * k + j)
+                    out, reds = self._seg_apply(loop, params, hc, bc,
+                                                key, idx * k + j)
                     # AMP boundary cast; see the pp==1 branch
-                    return out.astype(hc.dtype), None
+                    return out.astype(hc.dtype), reds
 
-                h, _ = lax.scan(seg_body, h,
-                                (tuple(stk), jnp.arange(k)))
-                return h
+                h, reds = lax.scan(seg_body, h,
+                                   (tuple(stk), jnp.arange(k)))
+                return h, reds  # reds: tuple of [k, ...] per family
 
             def pick(t):
                 i = jnp.clip(t, 0, n_micro - 1)
@@ -703,9 +759,14 @@ class PipelineTrainer:
             bb_init = [_vary(x, axis) for x in bb_init]
             outs0 = _vary(jnp.zeros((n_micro, mb) + h_init.shape[1:],
                                     h_init.dtype), axis)
+            # reduce-out accumulators: one [k, ...] buffer per family,
+            # summed over this stage's processed microbatches
+            shapes = jax.eval_shape(stage, h_init, bb_init, key)[1]
+            racc0 = tuple(_vary(jnp.zeros(s.shape, s.dtype), axis)
+                          for s in shapes)
 
             def tick(carry, t):
-                h, bb, outs = carry
+                h, bb, outs, raccs = carry
                 feed_h, feed_bb = pick(t)
                 h_in = jnp.where(idx == 0, feed_h, h)
                 bb_in = [jnp.where(idx == 0, f, c)
@@ -716,7 +777,13 @@ class PipelineTrainer:
                 # n_micro times
                 mb_key = jax.random.fold_in(
                     key, jnp.clip(t - idx, 0, n_micro - 1))
-                out = stage(h_in, bb_in, mb_key)
+                out, reds = stage(h_in, bb_in, mb_key)
+                # this stage holds a REAL microbatch only during its
+                # steady-state window
+                mb_valid = jnp.logical_and(t - idx >= 0,
+                                           t - idx < n_micro)
+                raccs = tuple(a + jnp.where(mb_valid, r, 0)
+                              for a, r in zip(raccs, reds))
                 slot = t - (n - 1)
                 write = jnp.logical_and(
                     idx == n - 1,
@@ -726,13 +793,23 @@ class PipelineTrainer:
                 outs = jnp.where(write, upd, outs)
                 ring = [lax.ppermute(x, axis, perm)
                         for x in [out] + bb_in]
-                return (ring[0], ring[1:], outs), None
+                return (ring[0], ring[1:], outs, raccs), None
 
-            (_, _, outs), _ = lax.scan(
-                tick, (h_init, bb_init, outs0),
+            (_, _, outs, raccs), _ = lax.scan(
+                tick, (h_init, bb_init, outs0, racc0),
                 jnp.arange(total))
             outs = jnp.where(idx == n - 1, outs, jnp.zeros_like(outs))
-            return lax.psum(outs, axis)
+            # assemble each family's per-segment values: this stage
+            # owns segments [idx*k, (idx+1)*k); microbatch-mean, then
+            # psum gathers the other stages' slots
+            fulls = []
+            for acc in raccs:
+                full = _vary(jnp.zeros((k * n,) + acc.shape[1:],
+                                       acc.dtype), axis)
+                full = lax.dynamic_update_slice_in_dim(
+                    full, acc / n_micro, idx * k, 0)
+                fulls.append(lax.psum(full, axis))
+            return lax.psum(outs, axis), tuple(fulls)
 
         # manual ONLY over the pp ring axis: 'tp' (if present) stays an
         # auto axis, so GSPMD partitions the segment matmuls inside the
@@ -744,8 +821,11 @@ class PipelineTrainer:
             in_specs=([P(axis)] * len(stacked),
                       P(), [P()] * len(xs_bb),
                       [P()] * len(consts), P()),
-            out_specs=P())
-        ys = fn(stacked, xs_h, xs_bb, consts, key)
+            out_specs=(P(), tuple(P() for _ in loop.reduce_outs)))
+        ys, fulls = fn(stacked, xs_h, xs_bb, consts, key)
+        for fam, arr in zip(loop.reduce_outs, fulls):
+            for si, nm in enumerate(fam):
+                env[nm] = arr[si]
         return ys.reshape((B,) + ys.shape[2:])
 
     # ------------------------------------------------------------------
