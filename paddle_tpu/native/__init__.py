@@ -521,3 +521,78 @@ def run_train_demo(artifact_dir: str, steps: int,
         if line.startswith("{"):
             out.append(json.loads(line))
     return out
+
+
+# ---------------------------------------------------------------------------
+# C++ XLA-computation builder (native/xla_train/xla_train.cc): the
+# train-step XLA program is BUILT in C++ by per-op registry kernels
+# over the native ProgramDesc (reference op_registry.h:197-270
+# REGISTER_OPERATOR analogue), then compiled and driven with no Python
+# in the process. Python's trace path is the numerical oracle.
+# ---------------------------------------------------------------------------
+_XLA_TRAIN_BIN = os.path.join(_DIR, "_xla_train")
+_xla_train_lock = threading.Lock()
+_xla_train_error: Optional[str] = None
+
+
+def build_xla_train() -> str:
+    """Compile (once) and return the path of the xla_train binary."""
+    global _xla_train_error
+    with _xla_train_lock:
+        src = os.path.join(_DIR, "xla_train", "xla_train.cc")
+        deps = [src,
+                os.path.join(_SRC, "json.cc"),
+                os.path.join(_SRC, "json.h"),
+                os.path.join(_SRC, "program.cc"),
+                os.path.join(_SRC, "program.h")]
+        if os.path.exists(_XLA_TRAIN_BIN) and all(
+                os.path.getmtime(_XLA_TRAIN_BIN) >= os.path.getmtime(d)
+                for d in deps):
+            return _XLA_TRAIN_BIN
+        if _xla_train_error is not None:
+            raise RuntimeError(_xla_train_error)
+        tf = _find_tf_root()
+        if tf is None:
+            _xla_train_error = (
+                "xla_train: no bundled XLA runtime (tensorflow wheel "
+                "with libtensorflow_cc) found on sys.path")
+            raise RuntimeError(_xla_train_error)
+        inc = os.path.join(tf, "include")
+        cmd = ["g++", "-std=c++17", "-O1", src,
+               os.path.join(_SRC, "json.cc"),
+               os.path.join(_SRC, "program.cc"),
+               "-I" + inc,
+               "-I" + os.path.join(inc, "external", "highwayhash"),
+               "-I" + os.path.join(inc, "external", "farmhash_archive",
+                                   "src"),
+               os.path.join(tf, "libtensorflow_cc.so.2"),
+               os.path.join(tf, "libtensorflow_framework.so.2"),
+               "-Wl,-rpath," + tf,
+               "-o", _XLA_TRAIN_BIN]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            _xla_train_error = ("xla_train build failed: "
+                                + proc.stderr[-2000:])
+            raise RuntimeError(_xla_train_error)
+        return _XLA_TRAIN_BIN
+
+
+def run_xla_train(artifact_dir: str, steps: int,
+                  timeout: int = 600) -> List[dict]:
+    """Run the native-builder driver over an `export_train_program`
+    artifact for `steps` steps; returns the per-step fetch dicts.
+    Final state lands next to the data files as *.bin.final."""
+    binary = build_xla_train()
+    proc = subprocess.run(
+        [binary, str(artifact_dir), str(int(steps))],
+        capture_output=True, text=True, timeout=timeout)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"xla_train failed (exit {proc.returncode}): "
+            f"{proc.stderr[-2000:]}")
+    out = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            out.append(json.loads(line))
+    return out

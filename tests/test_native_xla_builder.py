@@ -1,0 +1,129 @@
+"""Native XLA-computation builder (native/xla_train/xla_train.cc):
+the MNIST-fc train step's XLA program is BUILT in C++ by per-op
+registry kernels over the native ProgramDesc — closing SURVEY §2's [N]
+obligation for kernel registration/dispatch (reference
+framework/op_registry.h:197-270) — and trained with no Python in the
+process. The Python Executor is the numerical oracle: per-step losses
+must match to 1e-5 (VERDICT r3 next #3's done-bar)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import native
+
+
+def _fresh():
+    fluid._reset_global_scope()
+    from paddle_tpu import unique_name
+    unique_name.switch()
+
+
+def _build_mnist_fc():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="img", shape=[784],
+                              dtype="float32")
+        y = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=128, act="relu",
+                            param_attr=fluid.ParamAttr(name="fc1_w"),
+                            bias_attr=fluid.ParamAttr(name="fc1_b"))
+        logits = fluid.layers.fc(
+            h, size=10, param_attr=fluid.ParamAttr(name="fc2_w"),
+            bias_attr=fluid.ParamAttr(name="fc2_b"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.5).minimize(loss)
+    return prog, startup, loss
+
+
+def _data(B=64, seed=0):
+    r = np.random.RandomState(seed)
+    img = r.randn(B, 784).astype(np.float32) * 0.1
+    # separable synthetic task so the loss genuinely falls
+    w_true = r.randn(784, 10).astype(np.float32)
+    label = np.argmax(img @ w_true, 1).astype(np.int64)[:, None]
+    return {"img": img, "label": label}
+
+
+def _native_ready():
+    try:
+        native.build_xla_train()
+        return True
+    except RuntimeError:
+        return False
+
+
+@pytest.mark.skipif(not _native_ready(),
+                    reason="no toolchain/XLA runtime for xla_train")
+class TestNativeXlaBuilder:
+    def test_mnist_fc_losses_match_python_to_1e5(self, tmp_path):
+        _fresh()
+        feed = _data()
+        prog, startup, loss = _build_mnist_fc()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+
+        # export FIRST (the artifact must hold step-0 state), then run
+        # the Python oracle from the same scope
+        from paddle_tpu.inference.export import export_train_program
+        art = export_train_program(prog, sc, feed, [loss.name],
+                                   str(tmp_path / "mnist_native"))
+
+        steps = 6
+        py_losses = []
+        for _ in range(steps):
+            l, = exe.run(prog, feed=feed, fetch_list=[loss], scope=sc)
+            py_losses.append(float(np.asarray(l).reshape(-1)[0]))
+
+        rows = native.run_xla_train(art, steps)
+        native_losses = [row[loss.name] for row in rows]
+        assert len(native_losses) == steps
+        np.testing.assert_allclose(native_losses, py_losses,
+                                   rtol=1e-5, atol=1e-6)
+        assert py_losses[-1] < py_losses[0]  # and it actually trains
+
+    def test_final_state_written_and_close_to_python(self, tmp_path):
+        _fresh()
+        feed = _data(seed=1)
+        prog, startup, loss = _build_mnist_fc()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        from paddle_tpu.inference.export import export_train_program
+        art = export_train_program(prog, sc, feed, [loss.name],
+                                   str(tmp_path / "m2"))
+        steps = 4
+        for _ in range(steps):
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=sc)
+        native.run_xla_train(art, steps)
+        # fc1_w final state must match the Python-trained weights
+        import json
+        with open(os.path.join(art, "manifest.json")) as f:
+            manifest = json.load(f)
+        spec = next(s for s in manifest["inputs"]
+                    if s["name"] == "fc1_w")
+        final = np.fromfile(os.path.join(art, spec["file"] + ".final"),
+                            dtype=spec["dtype"]).reshape(spec["shape"])
+        np.testing.assert_allclose(final, np.asarray(sc._get("fc1_w")),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_unregistered_op_is_a_named_error(self, tmp_path):
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[8],
+                                  dtype="float32")
+            out = fluid.layers.tanh(x)  # no native kernel registered
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        from paddle_tpu.inference.export import export_train_program
+        art = export_train_program(
+            prog, sc, {"x": np.zeros((2, 8), np.float32)},
+            [out.name], str(tmp_path / "m3"))
+        with pytest.raises(RuntimeError,
+                           match="no native XLA kernel registered"):
+            native.run_xla_train(art, 1)
