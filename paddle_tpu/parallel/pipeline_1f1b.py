@@ -1,0 +1,686 @@
+"""1F1B (one-forward-one-backward) pipeline schedule.
+
+GPipe (pipeline_program.py) differentiates the whole forward ring with
+outer AD, so every microbatch's stage residuals stay live until the
+backward phase begins: peak activation memory grows with ``n_micro``.
+The 1F1B schedule (PipeDream-flush — the schedule Megatron-LM uses)
+interleaves each microbatch's backward as soon as the last stage
+finishes its forward, so a stage holds at most ``pp - stage_idx``
+in-flight microbatches regardless of ``n_micro``.
+
+Reference precedent: Fluid has no pipeline engine (SURVEY.md §2.4); the
+closest reference artifact is the batch-merge pass
+(/root/reference/paddle/fluid/framework/ir/multi_batch_merge_pass.cc:1)
+which replicates a block per sub-batch and accumulates grads — the
+memory/schedule tradeoff this module manages explicitly.
+
+TPU-native design
+-----------------
+Outer AD cannot express 1F1B (JAX runs the whole forward before any
+backward), so this engine drives AD *manually*, stage by stage:
+
+* the pre-loop ("head") ops run ONCE over the full batch under
+  ``jax.vjp``, outside the ring;
+* the loop body and the post-loop ("tail", which produces the loss)
+  run inside ONE ``shard_map``-over-'pp' ``lax.scan`` whose tick ``t``
+  makes stage ``i`` run
+    - forward  of microbatch ``m = (t - i) / 2``               (when integral)
+    - backward of microbatch ``m = (t - (2*pp - 1 - i)) / 2``  (when integral)
+  — the two parities are disjoint, so each tick is one F or one B,
+  selected with ``lax.cond`` (no collectives inside the branches);
+* a forward tick stashes only the stage INPUT (circular buffer of
+  ``min(pp, n_micro)`` slots); the backward tick re-runs the stage
+  under ``jax.vjp`` (stage-granular rematerialisation) with the SAME
+  rng derivation as the forward tick, so recomputed dropout masks
+  match bit-for-bit;
+* activations ride a forward ``ppermute`` ring, cotangents ride a
+  reverse ring; the last stage runs the tail per microbatch inside its
+  backward tick and seeds the cotangent chain with ``1/n_micro``;
+* stacked per-segment params are sharded over 'pp' (same layout as
+  GPipe); their grads come back sharded the same way, and 'tp' axes
+  stay AUTO inside the ring (GSPMD partitions the segment matmuls),
+  exactly like the GPipe path.
+
+Scheduling formulas (0-based stage ``i``, microbatch ``m``)::
+
+    F(i, m) = i + 2*m
+    B(i, m) = 2*pp - 1 + 2*m - i        # last stage: B = F + 1
+    ticks   = 2 * (n_micro + pp - 1)
+
+In-flight microbatches at stage ``i``: at most ``pp - i`` (vs
+``n_micro`` for GPipe) — the stashed-activation win that
+tests/test_pipeline_1f1b.py proves via ``compiled.memory_analysis()``.
+
+Semantics caveat (microbatched reduce outputs): the tail runs per
+microbatch, so a loop reduce output enters the loss as
+``mean_m f(red_m)`` where GPipe computes ``f(mean_m red_m)``. The two
+agree exactly when the tail is LINEAR in the reduce outputs (true for
+the Switch aux-loss pattern: the aux enters the cost as a scaled sum);
+a tail that is nonlinear in a reduce output (e.g. a z-loss squaring a
+router statistic) trains to a slightly different objective under
+'1f1b' than under 'gpipe' — same direction of difference as GPipe
+itself vs the unmicrobatched Executor. Nonlinearity is undecidable
+from the op list, so this is documented rather than guarded.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.program import grad_var_name
+from ..core.registry import EMPTY_VAR, run_op
+from .pipeline_program import (PipelinePartitionError,
+                               _classify_batch_major, _op_reads,
+                               _op_writes, _persistable, _vary)
+
+__all__ = ["build_1f1b_step"]
+
+
+def build_1f1b_step(tr):
+    """Build ``step(state, feeds, rng) -> (new_state, loss, rng_next)``
+    running ``tr``'s program under the 1F1B schedule. ``tr`` is a
+    PipelineTrainer constructed with ``schedule='1f1b'``."""
+    if tr.pp <= 1:
+        raise PipelinePartitionError(
+            "schedule='1f1b' needs a 'pp' mesh axis > 1 (with pp == 1 "
+            "the loop is a plain lax.scan and GPipe/1F1B are the same "
+            "program; use schedule='gpipe')")
+    loop_secs = [s for s in tr.sections if s.kind == "loop"]
+    if len(loop_secs) != 1:
+        raise PipelinePartitionError(
+            f"schedule='1f1b' supports exactly one pipelined loop "
+            f"(got {len(loop_secs)}; multi-stack programs such as "
+            f"encoder+decoder need schedule='gpipe')")
+    loop = loop_secs[0].loop
+    li = tr.sections.index(loop_secs[0])
+    head_ops = [op for s in tr.sections[:li] for op in s.ops]
+    tail_ops = [op for s in tr.sections[li + 1:] for op in s.ops]
+
+    block = tr.program.global_block
+    loop_param_names = {n for seg in loop.seg_params for n in seg}
+    red_names = {nm for fam in loop.reduce_outs for nm in fam}
+    h_final_name = loop.bounds[-1]
+
+    def persistable(n):
+        return _persistable(block, n)
+
+    def is_data(n):
+        v = block._find_var_recursive(n)
+        return v is not None and v.is_data
+
+    # ---- head/tail variable roles -----------------------------------
+    head_writes_set = set()
+    for op in head_ops:
+        for n in _op_reads(op):
+            if n in loop_param_names:
+                raise PipelinePartitionError(
+                    f"1f1b: head op {op.type!r} reads loop param "
+                    f"{n!r}; params shared between the loop and the "
+                    f"head are not supported")
+        head_writes_set.update(_op_writes(op))
+
+    tail_params = []
+    tail_writes = set()
+    tail_ext = []          # non-persistable externals the tail reads
+    for op in tail_ops:
+        for n in _op_reads(op):
+            if n == EMPTY_VAR:
+                continue
+            if n in loop_param_names:
+                raise PipelinePartitionError(
+                    f"1f1b: tail op {op.type!r} reads loop param "
+                    f"{n!r}; params shared between the loop and the "
+                    f"tail are not supported")
+            if persistable(n):
+                if n not in tail_params:
+                    tail_params.append(n)
+            elif n not in tail_writes and n not in tail_ext:
+                tail_ext.append(n)
+        tail_writes.update(_op_writes(op))
+
+    tail_ext_nonred = []
+    for n in tail_ext:
+        if n == h_final_name or n in red_names:
+            continue
+        if not (is_data(n) or n in head_writes_set):
+            raise PipelinePartitionError(
+                f"1f1b: tail reads {n!r}, which is neither a data "
+                f"var, a head output, the loop output, nor a loop "
+                f"reduce output")
+        tail_ext_nonred.append(n)
+    for n in loop.bcast:
+        if not (is_data(n) or n in head_writes_set):
+            raise PipelinePartitionError(
+                f"1f1b: loop broadcast input {n!r} is neither a data "
+                f"var nor a head output")
+
+    # ---- phase-B aux closure (lr schedules etc.) --------------------
+    # tail ops computable WITHOUT pipelined activations (reduce
+    # observables count as available: the ring reassembles them),
+    # needed to produce aux/state_out values that phase B reads
+    aux_avail = set(tr.state_names) | set(tr.feed_names) \
+        | head_writes_set | red_names
+    aux_ops = []
+    for op in tail_ops:
+        reads = [n for n in _op_reads(op) if n != EMPTY_VAR]
+        if all(n in aux_avail for n in reads):
+            aux_ops.append(op)
+            aux_avail.update(_op_writes(op))
+    for n in list(tr.aux_names) + [x for x in tr.state_out
+                                   if x in tail_writes]:
+        if n in tail_writes and n not in aux_avail:
+            raise PipelinePartitionError(
+                f"1f1b: optimizer-phase input {n!r} is computed from "
+                f"pipelined activations in the tail; run it through "
+                f"schedule='gpipe' instead")
+
+    diff_names = [
+        n for n in tr.params_a
+        if jnp.issubdtype(jnp.asarray(tr.state[n]).dtype,
+                          jnp.floating)]
+    for n in sorted(loop_param_names):
+        if n not in diff_names:
+            raise PipelinePartitionError(
+                f"1f1b: loop param {n!r} is not a floating-point "
+                f"trainable; the manual-vjp schedule differentiates "
+                f"every stacked loop param")
+    outer_diff = [n for n in diff_names if n not in loop_param_names]
+    nondiff = [n for n in tr.state_names if n not in diff_names]
+    tail_nondiff_names = [n for n in tail_params if n not in diff_names]
+
+    n_seg = len(loop.segments)
+    pp, axis, n_micro = tr.pp, tr.axis, tr.n_micro
+    k = n_seg // pp
+    S = min(pp, n_micro)
+    loss_name = tr.loss_name
+    outside_writes = set(head_writes_set)
+    for op in aux_ops:
+        outside_writes.update(_op_writes(op))
+
+    # ------------------------------------------------------------------
+    def head_apply(diff_params, env_base, key):
+        """Run head ops over the full batch; returns the env."""
+        env = dict(env_base)
+        env.update(diff_params)
+        cell = [jax.random.fold_in(key, 1)]
+        for op in head_ops:
+            run_op(op, env, rng_cell=cell, rng_salt=op._uid)
+        return env
+
+    def tail_apply(tail_diff, h_final, red_vals, dconsts, ndconsts,
+                   mb_feeds, key, m):
+        """Run tail ops on ONE microbatch; returns the scalar loss."""
+        env = {}
+        env.update(ndconsts)
+        env.update(dconsts)
+        env.update(tail_diff)
+        env.update(mb_feeds)
+        env[h_final_name] = h_final
+        for fam, buf in zip(loop.reduce_outs, red_vals):
+            for si, nm in enumerate(fam):
+                env[nm] = buf[si]
+        cell = [jax.random.fold_in(jax.random.fold_in(key, 4), m)]
+        for op in tail_ops:
+            run_op(op, env, rng_cell=cell, rng_salt=op._uid)
+        return jnp.reshape(env[loss_name], ())
+
+    # ------------------------------------------------------------------
+    def step(state, feeds, rng):
+        key, rng_next = jax.random.split(rng)
+        diff = {n: state[n] for n in diff_names}
+        nond = {n: state[n] for n in nondiff}
+        outer = {n: diff[n] for n in outer_diff}
+
+        env_base = {}
+        env_base.update(nond)
+        env_base.update(feeds)
+
+        # ---- head: full batch, vjp over the non-loop params ---------
+        out_names = [n for n in ([loop.bounds[0]] + loop.bcast +
+                                 tail_ext_nonred)
+                     if n in head_writes_set]
+        out_names = list(dict.fromkeys(out_names))
+
+        def head_outs(p):
+            env = head_apply(p, env_base, key)
+            return tuple(env[n] for n in out_names), env
+
+        if head_ops:
+            head_vals, head_vjp, head_env = jax.vjp(
+                head_outs, outer, has_aux=True)
+            env = dict(head_env)
+        else:
+            head_vals, head_vjp = (), None
+            env = dict(env_base)
+            env.update(outer)
+        hv = dict(zip(out_names, head_vals))
+
+        def lookup(n):
+            return hv[n] if n in hv else env[n]
+
+        h0 = lookup(loop.bounds[0])
+        B = h0.shape[0]
+        if B % n_micro:
+            raise ValueError(
+                f"batch {B} not divisible by n_micro {n_micro}")
+        mb = B // n_micro
+
+        # ---- classify ring-side inputs ------------------------------
+        bb_names, const_names = [], []
+        for n in loop.bcast:
+            (bb_names if _classify_batch_major(block, n, lookup(n), B)
+             else const_names).append(n)
+        t_mb, t_const = [], []
+        for n in tail_ext_nonred:
+            (t_mb if _classify_batch_major(block, n, lookup(n), B)
+             else t_const).append(n)
+        for n in t_mb:
+            if n in head_writes_set:
+                raise PipelinePartitionError(
+                    f"1f1b: tail reads head-produced batch-major var "
+                    f"{n!r}; per-microbatch tail grads are only "
+                    f"supported for data vars — use schedule='gpipe'")
+        dconst_names = sorted({
+            n for n in const_names + t_const
+            if n in head_writes_set and jnp.issubdtype(
+                jnp.asarray(lookup(n)).dtype, jnp.floating)})
+        ndconst_loop = {n: lookup(n) for n in const_names
+                        if n not in dconst_names}
+        ndconst_tail = {n: lookup(n) for n in t_const
+                        if n not in dconst_names}
+        for n in tail_nondiff_names:
+            ndconst_tail[n] = state[n]
+        dconsts = {n: lookup(n) for n in dconst_names}
+
+        xs_h = h0.reshape((n_micro, mb) + h0.shape[1:])
+        xs_bb = {n: lookup(n).reshape(
+            (n_micro, mb) + lookup(n).shape[1:]) for n in bb_names}
+        xs_tail = {n: lookup(n).reshape(
+            (n_micro, mb) + lookup(n).shape[1:]) for n in t_mb}
+
+        # ---- stack per-segment params (same layout as GPipe) --------
+        stacked = []
+        for pos in range(len(loop.canon_params)):
+            leaves = [diff[loop.seg_params[s][pos]]
+                      for s in range(n_seg)]
+            st = jnp.stack(leaves)
+            st = lax.with_sharding_constraint(
+                st, NamedSharding(
+                    tr.mesh,
+                    tr._stack_spec(loop, pos, leaves[0].shape)))
+            stacked.append(st)
+        tail_diff = {n: diff[n] for n in tail_params
+                     if n in diff_names}
+        loop_key = jax.random.fold_in(key, 2)
+        T = 2 * (n_micro + pp - 1)
+
+        # reduce-out family shapes (one segment's contribution)
+        seg0_params = [diff[n] for n in loop.canon_params]
+        probe_bc = {n: (xs_bb[n][0] if n in bb_names else lookup(n))
+                    for n in loop.bcast}
+        red_sds = jax.eval_shape(
+            lambda p, h, bc, kk: tr._seg_apply(loop, p, h, bc, kk, 0)[1],
+            seg0_params, xs_h[0], probe_bc, loop_key)
+        for sd in red_sds:
+            if not jnp.issubdtype(sd.dtype, jnp.floating):
+                raise PipelinePartitionError(
+                    f"1f1b: a loop reduce output has non-float dtype "
+                    f"{sd.dtype}; the manual-vjp schedule carries "
+                    f"reduce cotangents and needs float reduce "
+                    f"outputs — use schedule='gpipe'")
+        red_protos = tuple(
+            jnp.zeros((n_seg,) + sd.shape, sd.dtype) for sd in red_sds)
+
+        def stage_fwd(stk_params, h, bb, dcs_, loop_key_, m, idx):
+            """This stage's k segments on one microbatch. Returns
+            (h_out, per-family [k, ...] reduce outputs). rng
+            derivation matches the GPipe path's `stage`
+            (pipeline_program.py:736) bit-for-bit, so the backward
+            tick's recompute — and GPipe↔1F1B parity — reproduce the
+            same noise."""
+            bc = dict(ndconst_loop)
+            bc.update(dcs_)
+            bc.update(bb)
+            mb_key = jax.random.fold_in(loop_key_, m)
+
+            def seg_body(hc, xs):
+                params, j = xs
+                out, reds = tr._seg_apply(loop, params, hc, bc,
+                                          mb_key, idx * k + j)
+                return out.astype(hc.dtype), reds
+
+            return lax.scan(seg_body, h,
+                            (tuple(stk_params), jnp.arange(k)))
+
+        # ---- the 1F1B ring ------------------------------------------
+        def ring(stk, tail_d, dcs, key_):
+            # tail_d/dcs arrive replicated (in_spec P()); differentiate
+            # them as VARYING values — the transpose of the implicit
+            # replicated->varying cast is a psum, and a collective
+            # inside the divergent per-stage lax.cond would deadlock.
+            # The masked psum after the scan does the cross-stage
+            # reduction instead.
+            tail_d = jax.tree.map(lambda x: _vary(x, axis), tail_d)
+            dcs = jax.tree.map(lambda x: _vary(x, axis), dcs)
+            idx = lax.axis_index(axis)
+            fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+            bwd_perm = [(i, i - 1) for i in range(1, pp)]
+
+            def pick(buf, m):
+                return lax.dynamic_index_in_dim(
+                    buf, jnp.clip(m, 0, n_micro - 1), keepdims=False)
+
+            def zv(shape, dtype):
+                return _vary(jnp.zeros(shape, dtype), axis)
+
+            h_sd = jax.eval_shape(lambda: xs_h[0])
+            carry0 = dict(
+                ring_h=zv(h_sd.shape, h_sd.dtype),
+                ring_bb={n: zv(xs_bb[n][0].shape, xs_bb[n].dtype)
+                         for n in bb_names},
+                ring_red=tuple(zv(r.shape, r.dtype)
+                               for r in red_protos),
+                ring_gh=zv(h_sd.shape, h_sd.dtype),
+                ring_gbb={n: zv(xs_bb[n][0].shape, xs_bb[n].dtype)
+                          for n in bb_names},
+                ring_gred=tuple(zv(r.shape, r.dtype)
+                                for r in red_protos),
+                stash_h=zv((S,) + h_sd.shape, h_sd.dtype),
+                stash_bb={n: zv((S,) + xs_bb[n][0].shape,
+                                xs_bb[n].dtype) for n in bb_names},
+                stash_red=tuple(zv((S,) + r.shape, r.dtype)
+                                for r in red_protos),
+                acc_gstk=[jnp.zeros_like(s) for s in stk],
+                acc_gtail=jax.tree.map(jnp.zeros_like, tail_d),
+                acc_gdc=jax.tree.map(jnp.zeros_like, dcs),
+                buf_gh0=zv((n_micro,) + h_sd.shape, h_sd.dtype),
+                buf_gbb={n: zv((n_micro,) + xs_bb[n][0].shape,
+                               xs_bb[n].dtype) for n in bb_names},
+                acc_loss=_vary(jnp.zeros((), jnp.float32), axis),
+                acc_red=tuple(zv(r.shape, r.dtype)
+                              for r in red_protos),
+            )
+
+            def zero_sends(c):
+                return dict(
+                    h=jnp.zeros_like(c["ring_h"]),
+                    bb={n: jnp.zeros_like(c["ring_bb"][n])
+                        for n in bb_names},
+                    red=tuple(jnp.zeros_like(r)
+                              for r in c["ring_red"]),
+                    gh=jnp.zeros_like(c["ring_gh"]),
+                    gbb={n: jnp.zeros_like(c["ring_gbb"][n])
+                         for n in bb_names},
+                    gred=tuple(jnp.zeros_like(r)
+                               for r in c["ring_gred"]))
+
+            def f_branch(c, t):
+                m = (t - idx) // 2
+                is0 = idx == 0
+                h_in = jnp.where(is0, pick(xs_h, m), c["ring_h"])
+                bb_in = {n: jnp.where(is0, pick(xs_bb[n], m),
+                                      c["ring_bb"][n])
+                         for n in bb_names}
+                red_in = tuple(
+                    jnp.where(is0, jnp.zeros_like(r), r)
+                    for r in c["ring_red"])
+                h_out, reds_k = stage_fwd(stk, h_in, bb_in, dcs,
+                                          key_, m, idx)
+                red_out = tuple(
+                    lax.dynamic_update_slice_in_dim(
+                        buf, kk.astype(buf.dtype), idx * k, 0)
+                    for buf, kk in zip(red_in, reds_k))
+                slot = m % S
+                c = dict(c)
+                c["stash_h"] = lax.dynamic_update_index_in_dim(
+                    c["stash_h"], h_in.astype(c["stash_h"].dtype),
+                    slot, 0)
+                c["stash_bb"] = {
+                    n: lax.dynamic_update_index_in_dim(
+                        c["stash_bb"][n], bb_in[n], slot, 0)
+                    for n in bb_names}
+                c["stash_red"] = tuple(
+                    lax.dynamic_update_index_in_dim(sr, ro, slot, 0)
+                    for sr, ro in zip(c["stash_red"], red_out))
+                last = idx == pp - 1
+                c["acc_red"] = tuple(
+                    a + jnp.where(last, ro, 0)
+                    for a, ro in zip(c["acc_red"], red_out))
+                send = zero_sends(c)
+                send["h"] = h_out.astype(send["h"].dtype)
+                send["bb"] = bb_in
+                send["red"] = red_out
+                return c, send
+
+            def b_branch(c, t):
+                m = (t - (2 * pp - 1 - idx)) // 2
+                slot = m % S
+                h_in = lax.dynamic_index_in_dim(
+                    c["stash_h"], slot, keepdims=False)
+                bb_in = {n: lax.dynamic_index_in_dim(
+                    c["stash_bb"][n], slot, keepdims=False)
+                    for n in bb_names}
+                red_buf = tuple(
+                    lax.dynamic_index_in_dim(sr, slot, keepdims=False)
+                    for sr in c["stash_red"])
+
+                def fwd_for_vjp(stk_, h_, bb_, dcs_):
+                    return stage_fwd(stk_, h_, bb_, dcs_, key_, m, idx)
+
+                (h_out, reds_k), vjp_fn = jax.vjp(
+                    fwd_for_vjp, stk, h_in, bb_in, dcs)
+
+                last = idx == pp - 1
+
+                # only the LAST stage needs the tail's loss + vjp; a
+                # traced `last` would make every stage compute (and
+                # then mask) the full logits+CE forward/backward, so
+                # gate it with a nested lax.cond — safe because
+                # tail_apply contains no collectives
+                def run_tail(_):
+                    mb_feeds = {n: pick(xs_tail[n], m) for n in t_mb}
+                    loss_m, tvjp = jax.vjp(
+                        lambda tp, hf, rv, dc: tail_apply(
+                            tp, hf, rv, dc, ndconst_tail, mb_feeds,
+                            key_, m),
+                        tail_d, h_out, red_buf, dcs)
+                    g_tp, g_hf, g_rv, g_tdc = tvjp(
+                        _vary(jnp.asarray(1.0 / n_micro,
+                                          loss_m.dtype), axis))
+                    return (loss_m.astype(jnp.float32), g_tp, g_hf,
+                            g_rv, g_tdc)
+
+                def skip_tail(_):
+                    return (
+                        _vary(jnp.zeros((), jnp.float32), axis),
+                        jax.tree.map(jnp.zeros_like, tail_d),
+                        jnp.zeros_like(h_out),
+                        tuple(jnp.zeros_like(r) for r in red_buf),
+                        jax.tree.map(jnp.zeros_like, dcs))
+
+                loss_m, g_tp, g_hf, g_rv, g_tdc = lax.cond(
+                    last, run_tail, skip_tail, None)
+                g_hout = jnp.where(last, g_hf,
+                                   c["ring_gh"].astype(g_hf.dtype))
+                g_redbuf = tuple(
+                    jnp.where(last, gr, rg.astype(gr.dtype))
+                    for gr, rg in zip(g_rv, c["ring_gred"]))
+                g_red_mine = tuple(
+                    lax.dynamic_slice_in_dim(gr, idx * k, k, 0)
+                    .astype(rk.dtype)
+                    for gr, rk in zip(g_redbuf, reds_k))
+                g_stk, g_hin, g_bb, g_dc = vjp_fn(
+                    (g_hout.astype(h_out.dtype), g_red_mine))
+
+                def only_last(x):
+                    return jnp.where(last, x, 0)
+
+                c = dict(c)
+                c["acc_gstk"] = [a + g for a, g in
+                                 zip(c["acc_gstk"], g_stk)]
+                c["acc_gtail"] = jax.tree.map(
+                    lambda a, g: a + only_last(g),
+                    c["acc_gtail"], g_tp)
+                c["acc_gdc"] = jax.tree.map(
+                    lambda a, g1, g2: a + g1 + only_last(g2),
+                    c["acc_gdc"], g_dc, g_tdc)
+                c["acc_loss"] = c["acc_loss"] + jnp.where(
+                    last, loss_m.astype(jnp.float32), 0.0)
+                first = idx == 0
+                g_bb_tot = {
+                    n: c["ring_gbb"][n].astype(g_bb[n].dtype)
+                    + g_bb[n] for n in bb_names}
+                mi = jnp.clip(m, 0, n_micro - 1)
+                c["buf_gh0"] = jnp.where(
+                    first,
+                    lax.dynamic_update_index_in_dim(
+                        c["buf_gh0"],
+                        g_hin.astype(c["buf_gh0"].dtype), mi, 0),
+                    c["buf_gh0"])
+                c["buf_gbb"] = {
+                    n: jnp.where(
+                        first,
+                        lax.dynamic_update_index_in_dim(
+                            c["buf_gbb"][n],
+                            g_bb_tot[n].astype(c["buf_gbb"][n].dtype),
+                            mi, 0),
+                        c["buf_gbb"][n])
+                    for n in bb_names}
+                send = zero_sends(c)
+                send["gh"] = g_hin.astype(send["gh"].dtype)
+                send["gbb"] = {n: g_bb_tot[n].astype(
+                    send["gbb"][n].dtype) for n in bb_names}
+                send["gred"] = tuple(
+                    g.astype(r.dtype) for g, r in
+                    zip(g_redbuf, send["gred"]))
+                return c, send
+
+            def idle_branch(c, t):
+                return dict(c), zero_sends(c)
+
+            def tick(c, t):
+                df = t - idx
+                is_f = jnp.logical_and(
+                    df % 2 == 0,
+                    jnp.logical_and(df >= 0, df // 2 < n_micro))
+                db = t - (2 * pp - 1 - idx)
+                is_b = jnp.logical_and(
+                    db % 2 == 0,
+                    jnp.logical_and(db >= 0, db // 2 < n_micro))
+                c, send = lax.cond(
+                    is_f, f_branch,
+                    lambda cc, tt: lax.cond(
+                        is_b, b_branch, idle_branch, cc, tt),
+                    c, t)
+                c["ring_h"] = lax.ppermute(send["h"], axis, fwd_perm)
+                c["ring_bb"] = {
+                    n: lax.ppermute(send["bb"][n], axis, fwd_perm)
+                    for n in bb_names}
+                c["ring_red"] = tuple(
+                    lax.ppermute(r, axis, fwd_perm)
+                    for r in send["red"])
+                c["ring_gh"] = lax.ppermute(send["gh"], axis,
+                                            bwd_perm)
+                c["ring_gbb"] = {
+                    n: lax.ppermute(send["gbb"][n], axis, bwd_perm)
+                    for n in bb_names}
+                c["ring_gred"] = tuple(
+                    lax.ppermute(r, axis, bwd_perm)
+                    for r in send["gred"])
+                return c, None
+
+            c, _ = lax.scan(tick, carry0, jnp.arange(T))
+            idx_last = idx == pp - 1
+            idx_first = idx == 0
+
+            def msum(x, mask):
+                return lax.psum(jnp.where(mask, x, 0), axis)
+
+            loss = msum(c["acc_loss"], idx_last) / n_micro
+            g_tail = jax.tree.map(lambda x: msum(x, idx_last),
+                                  c["acc_gtail"])
+            g_dc = jax.tree.map(lambda x: lax.psum(x, axis),
+                                c["acc_gdc"])
+            g_h0 = msum(c["buf_gh0"], idx_first)
+            g_bb = {n: msum(c["buf_gbb"][n], idx_first)
+                    for n in bb_names}
+            red_obs = tuple(msum(r, idx_last) / n_micro
+                            for r in c["acc_red"])
+            return (loss, c["acc_gstk"], g_tail, g_dc, g_h0, g_bb,
+                    red_obs)
+
+        fn = jax.shard_map(
+            ring, mesh=tr.mesh, axis_names=frozenset({axis}),
+            in_specs=([P(axis)] * len(stacked),
+                      jax.tree.map(lambda _: P(), tail_diff),
+                      jax.tree.map(lambda _: P(), dconsts), P()),
+            out_specs=(P(), [P(axis)] * len(stacked),
+                       jax.tree.map(lambda _: P(), tail_diff),
+                       jax.tree.map(lambda _: P(), dconsts),
+                       P(), {n: P() for n in bb_names},
+                       tuple(P() for _ in loop.reduce_outs)))
+        (loss, g_stk, g_tail, g_dc, g_h0, g_bb, red_obs) = fn(
+            stacked, tail_diff, dconsts, loop_key)
+
+        # ---- assemble gradients -------------------------------------
+        grads: Dict[str, jax.Array] = {}
+        for pos in range(len(loop.canon_params)):
+            for s in range(n_seg):
+                nm = loop.seg_params[s][pos]
+                grads[nm] = grads.get(nm, 0) + g_stk[pos][s]
+        for n, g in g_tail.items():
+            grads[n] = grads.get(n, 0) + g
+        if head_vjp is not None:
+            cots = []
+            for n in out_names:
+                v = hv[n]
+                if n == loop.bounds[0]:
+                    g = g_h0.reshape(v.shape).astype(v.dtype)
+                elif n in g_bb:
+                    g = g_bb[n].reshape(v.shape).astype(v.dtype)
+                elif n in g_dc:
+                    g = g_dc[n].astype(v.dtype)
+                else:
+                    g = jnp.zeros_like(v)
+                cots.append(g)
+            head_grads, = head_vjp(tuple(cots))
+            for n, g in head_grads.items():
+                grads[n] = grads.get(n, 0) + g
+
+        # ---- aux values + phase B -----------------------------------
+        for fam, arr in zip(loop.reduce_outs, red_obs):
+            for si, nm in enumerate(fam):
+                env[nm] = arr[si]
+        cell = [jax.random.fold_in(key, 5)]
+        for op in aux_ops:
+            run_op(op, env, rng_cell=cell, rng_salt=op._uid)
+        env[loss_name] = jnp.reshape(loss, (1,))
+
+        env_b = dict(state)
+        env_b.update(feeds)
+        for n in tr.aux_names:
+            if n in env:
+                env_b[n] = env[n]
+        for n in tr.state_out:
+            if n in outside_writes and n in env:
+                env_b[n] = env[n]
+        for fam in loop.reduce_outs:
+            for nm in fam:
+                env_b[nm] = env[nm]
+        env_b[loss_name] = env[loss_name]
+        for n, g in grads.items():
+            env_b[grad_var_name(n)] = g
+        cellb = [jax.random.fold_in(key, 3)]
+        for op in tr.phase_b:
+            run_op(op, env_b, rng_cell=cellb, rng_salt=op._uid)
+        new_state = dict(state)
+        for n in tr.state_names:
+            if n in env_b:
+                new_state[n] = env_b[n]
+        return new_state, jnp.reshape(loss, ()), rng_next
+
+    return step

@@ -315,6 +315,22 @@ def _partition(program: Program, loss_name: str,
         if len(set(lens)) != 1:
             raise PipelinePartitionError(
                 f"loop segments have differing param counts {lens}")
+        # stacked execution binds every segment's params positionally
+        # to ONE trace: declared shapes must match or the stack is
+        # malformed (e.g. an input-projection first layer whose weight
+        # is [d_in, d] vs the stack's [d, d])
+        for pos in range(lens[0]):
+            shapes = []
+            for si in range(len(loop.seg_params)):
+                v = block._find_var_recursive(loop.seg_params[si][pos])
+                shapes.append(tuple(v.shape) if v is not None else None)
+            if len(set(shapes)) != 1:
+                names = [p[pos] for p in loop.seg_params]
+                raise PipelinePartitionError(
+                    f"loop params {names} have differing declared "
+                    f"shapes {shapes}; segments are not isomorphic "
+                    f"(keep shape-changing layers outside the loop "
+                    f"bounds)")
         loop.bcast = bcast
 
         # reduce outputs: vars written inside a segment and read AFTER
@@ -384,11 +400,24 @@ def propose_loops(program: Program, loss_name: str,
     # covering the most ops (a transformer layer beats the 2-op
     # bias-add mini-runs nested inside it)
     candidates = []
+
+    def _param_shapes(op):
+        return [tuple(v.shape)
+                for names in op.inputs.values() for n in names
+                if n != EMPTY_VAR and persistable(n)
+                and (v := block._find_var_recursive(n)) is not None]
+
     def _iso(a_off, b_off, period):
         return (types[b_off:b_off + period] ==
                 types[a_off:a_off + period] and
                 all(_attrs_isomorphic(ops[a_off + i].attrs,
                                       ops[b_off + i].attrs)
+                    # positional param shapes must match too: an
+                    # input-projection layer (e.g. fc 16->32 before a
+                    # 32->32 stack) has identical op types/attrs but
+                    # cannot join the stacked loop
+                    and _param_shapes(ops[a_off + i]) ==
+                    _param_shapes(ops[b_off + i])
                     for i in range(period)))
 
     for period in range(1, n // 2 + 1):
@@ -487,7 +516,12 @@ class PipelineTrainer:
     def __init__(self, program: Program, loss, *,
                  loops: Sequence[Sequence[str]],
                  mesh: Optional[Mesh] = None, n_micro: int = 1,
-                 axis: str = "pp", tp_rules=None):
+                 axis: str = "pp", tp_rules=None,
+                 schedule: str = "gpipe"):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got {schedule!r}")
+        self.schedule = schedule
         self.program = program
         self.loss_name = loss.name if hasattr(loss, "name") else loss
         self.mesh = mesh
@@ -688,36 +722,7 @@ class PipelineTrainer:
         bb_names, const_names = [], []
         blk = self.program.global_block
         for n in loop.bcast:
-            v = env[n]
-            runtime_batch = getattr(v, "ndim", 0) >= 1 and \
-                v.shape[0] == B
-            # classify per-microbatch vs broadcast-constant by var
-            # METADATA, not runtime shape alone: a non-batch var whose
-            # leading dim coincidentally equals B (e.g. a [T,T]
-            # attention mask when seq == batch) must NOT be split.
-            # Declared -1 leading dim (or a data var) = batch-major;
-            # a fully concrete declaration whose leading dim happens
-            # to equal B is AMBIGUOUS and errors with guidance rather
-            # than silently splitting (wrong numerics) or silently
-            # broadcasting (also wrong, the other way).
-            var = blk._find_var_recursive(n)
-            decl = tuple(var.shape) if var is not None and var.shape \
-                else None
-            if decl is not None and len(decl) == getattr(v, "ndim", 0):
-                per_batch = runtime_batch and (
-                    decl[0] == -1 or var.is_data)
-                if runtime_batch and not per_batch:
-                    raise ValueError(
-                        f"pipeline: broadcast input {n!r} (declared "
-                        f"shape {decl}) has leading dim == batch {B} "
-                        f"but is not declared batch-major; cannot "
-                        f"tell per-microbatch data from a broadcast "
-                        f"constant. Declare its batch dim as -1 (per-"
-                        f"microbatch) or reshape so the leading dim "
-                        f"differs from the batch (constant).")
-            else:
-                per_batch = runtime_batch
-            if per_batch:
+            if _classify_batch_major(blk, n, env[n], B):
                 bb_names.append(n)
             else:
                 const_names.append(n)
@@ -830,6 +835,10 @@ class PipelineTrainer:
 
     # ------------------------------------------------------------------
     def _build_step(self):
+        if self.schedule == "1f1b":
+            from .pipeline_1f1b import build_1f1b_step
+
+            return build_1f1b_step(self)
         diff_names = [
             n for n in self.params_a
             if jnp.issubdtype(jnp.asarray(self.state[n]).dtype,
@@ -934,6 +943,34 @@ def _vary(x, axis_name):
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axis_name, to="varying")
     return lax.pvary(x, axis_name)
+
+
+def _classify_batch_major(block, name, val, B):
+    """True when `name` is per-example data (split per microbatch),
+    False when it is a broadcast constant — decided by var METADATA
+    first, not runtime shape alone: a non-batch var whose leading dim
+    coincidentally equals B (e.g. a [T,T] attention mask when
+    seq == batch) must NOT be split. Declared -1 leading dim (or a
+    data var) = batch-major; a fully concrete declaration whose
+    leading dim happens to equal B is AMBIGUOUS and errors with
+    guidance rather than silently splitting (wrong numerics) or
+    silently broadcasting (also wrong, the other way). Shared by the
+    GPipe and 1F1B schedules."""
+    runtime_batch = getattr(val, "ndim", 0) >= 1 and val.shape[0] == B
+    var = block._find_var_recursive(name)
+    decl = tuple(var.shape) if var is not None and var.shape else None
+    if decl is not None and len(decl) == getattr(val, "ndim", 0):
+        per_batch = runtime_batch and (decl[0] == -1 or var.is_data)
+        if runtime_batch and not per_batch:
+            raise ValueError(
+                f"pipeline: input {name!r} (declared shape {decl}) "
+                f"has leading dim == batch {B} but is not declared "
+                f"batch-major; cannot tell per-microbatch data from a "
+                f"broadcast constant. Declare its batch dim as -1 "
+                f"(per-microbatch) or reshape so the leading dim "
+                f"differs from the batch (constant).")
+        return per_batch
+    return runtime_batch
 
 
 def _fold_salt(uid, seg_idx):

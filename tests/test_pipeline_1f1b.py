@@ -1,0 +1,287 @@
+"""1F1B pipeline schedule (parallel/pipeline_1f1b.py): loss parity
+with the Executor and the GPipe schedule, the stashed-activation
+memory win (VERDICT r4 next #3 — proved via compiled.memory_analysis()
+on the CPU backend, no chip needed), and the named unsupported cases.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel.mesh import make_mesh, MeshConfig
+from paddle_tpu.parallel.pipeline_program import (
+    PipelineTrainer, PipelinePartitionError, propose_loops)
+
+
+def _fresh():
+    fluid._reset_global_scope()
+    from paddle_tpu import unique_name
+    unique_name.switch()
+
+
+def _build_mlp(n_layers=4, seed=11):
+    prog, startup = fluid.Program(), fluid.Program()
+    prog._seed = seed
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = x
+        bounds = [h.name]
+        for i in range(n_layers):
+            h = fluid.layers.fc(
+                h, size=16, act="tanh",
+                param_attr=fluid.ParamAttr(name=f"l{i}_w"),
+                bias_attr=fluid.ParamAttr(name=f"l{i}_b"))
+            bounds.append(h.name)
+        logits = fluid.layers.fc(
+            h, size=3, param_attr=fluid.ParamAttr(name="head_w"),
+            bias_attr=fluid.ParamAttr(name="head_b"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    return prog, startup, loss, bounds
+
+
+def _mlp_data():
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 16).astype(np.float32)
+    ys = np.argmax(xs[:, :3], 1).astype(np.int64)[:, None]
+    return xs, ys
+
+
+def _exec_losses(prog, startup, loss, feed, steps):
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    out = []
+    for _ in range(steps):
+        l, = exe.run(prog, feed=feed, fetch_list=[loss], scope=sc)
+        out.append(float(np.asarray(l).reshape(-1)[0]))
+    return out
+
+
+def _trainer_losses(prog, startup, loss, loops, feed, steps, mesh,
+                    n_micro, schedule):
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    tr = PipelineTrainer(prog, loss, loops=loops, mesh=mesh,
+                         n_micro=n_micro, schedule=schedule)
+    tr.initialize(sc)
+    out = []
+    for _ in range(steps):
+        l, = tr.run(feed=feed)
+        out.append(float(np.asarray(l).reshape(-1)[0]))
+    return out, tr, sc
+
+
+def _build_moe(seed=5, **kw):
+    from paddle_tpu.models import moe_transformer as M
+
+    _fresh()
+    args = dict(seq_len=8, vocab=64, d_model=32, n_heads=2,
+                n_layers=4, d_inner=64, n_experts=4,
+                dropout_rate=0.0, learning_rate=1.0, warmup_steps=40)
+    args.update(kw)
+    main, startup, cost = M.build_program(**args)
+    main._seed = seed
+    return main, startup, cost
+
+
+def _moe_data(B=16, T=8, V=64, seed=0):
+    r = np.random.RandomState(seed)
+    return {k: r.randint(1, V, (B, T)).astype(np.int64)
+            for k in ("src_ids", "label")}
+
+
+class TestMlpParity:
+    def test_pp2_parity_with_executor(self):
+        xs, ys = _mlp_data()
+        prog, startup, loss, bounds = _build_mlp()
+        base = _exec_losses(prog, startup, loss, {"x": xs, "y": ys}, 6)
+        _fresh()
+        prog2, startup2, loss2, bounds2 = _build_mlp()
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        got, _, _ = _trainer_losses(prog2, startup2, loss2, [bounds2],
+                                    {"x": xs, "y": ys}, 6, mesh, 4,
+                                    "1f1b")
+        np.testing.assert_allclose(base, got, rtol=2e-4, atol=2e-5)
+
+    def test_pp4_nmicro8_parity(self):
+        xs, ys = _mlp_data()
+        prog, startup, loss, bounds = _build_mlp(8)
+        base = _exec_losses(prog, startup, loss, {"x": xs, "y": ys}, 5)
+        _fresh()
+        prog2, startup2, loss2, bounds2 = _build_mlp(8)
+        mesh = make_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+        got, _, _ = _trainer_losses(prog2, startup2, loss2, [bounds2],
+                                    {"x": xs, "y": ys}, 5, mesh, 8,
+                                    "1f1b")
+        np.testing.assert_allclose(base, got, rtol=2e-4, atol=2e-5)
+
+    def test_nmicro_smaller_than_pp(self):
+        """Degenerate bubble-heavy case: schedule must stay correct."""
+        xs, ys = _mlp_data()
+        prog, startup, loss, bounds = _build_mlp(8)
+        base = _exec_losses(prog, startup, loss, {"x": xs, "y": ys}, 3)
+        _fresh()
+        prog2, startup2, loss2, bounds2 = _build_mlp(8)
+        mesh = make_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+        got, _, _ = _trainer_losses(prog2, startup2, loss2, [bounds2],
+                                    {"x": xs, "y": ys}, 3, mesh, 2,
+                                    "1f1b")
+        np.testing.assert_allclose(base, got, rtol=2e-4, atol=2e-5)
+
+
+class TestMoEFlagship:
+    """Head (embedding) vjp, reduce-out cotangent ring (Switch aux in
+    the cost), per-microbatch tail — on the round-4 flagship."""
+
+    def test_1f1b_matches_gpipe_exactly(self):
+        feed = _moe_data()
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        main, startup, cost = _build_moe()
+        loops = propose_loops(main, cost.name)
+        gp, _, _ = _trainer_losses(main, startup, cost, loops, feed,
+                                   5, mesh, 4, "gpipe")
+        main2, startup2, cost2 = _build_moe()
+        loops2 = propose_loops(main2, cost2.name)
+        f1, _, _ = _trainer_losses(main2, startup2, cost2, loops2,
+                                   feed, 5, mesh, 4, "1f1b")
+        # the Switch aux is LINEAR in the per-layer auxes, so the
+        # per-microbatch tail reproduces GPipe's microbatch-mean
+        # semantics to float tolerance
+        np.testing.assert_allclose(gp, f1, rtol=5e-5, atol=5e-6)
+
+    def test_near_parity_with_executor_and_trains(self):
+        feed = _moe_data()
+        main, startup, cost = _build_moe()
+        base = _exec_losses(main, startup, cost, feed, 5)
+        main2, startup2, cost2 = _build_moe()
+        loops = propose_loops(main2, cost2.name)
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        got, _, _ = _trainer_losses(main2, startup2, cost2, loops,
+                                    feed, 5, mesh, 4, "1f1b")
+        assert all(np.isfinite(got))
+        assert got[-1] < got[0]
+        assert max(abs(a - b) for a, b in zip(base, got)) < 0.15
+
+    def test_dropout_matches_gpipe(self):
+        """The backward tick recomputes the stage with the same rng
+        derivation as the forward tick, so dropout masks reproduce and
+        GPipe/1F1B agree even with sampling ops in the loop + head."""
+        feed = _moe_data()
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        main, startup, cost = _build_moe(dropout_rate=0.1)
+        loops = propose_loops(main, cost.name)
+        gp, _, _ = _trainer_losses(main, startup, cost, loops, feed,
+                                   5, mesh, 4, "gpipe")
+        main2, startup2, cost2 = _build_moe(dropout_rate=0.1)
+        loops2 = propose_loops(main2, cost2.name)
+        f1, _, _ = _trainer_losses(main2, startup2, cost2, loops2,
+                                   feed, 5, mesh, 4, "1f1b")
+        np.testing.assert_allclose(gp, f1, rtol=5e-5, atol=5e-6)
+        assert f1[-1] < f1[0]
+
+
+class TestMemoryWin:
+    """The point of 1F1B: in-flight activations bounded by pp, not
+    n_micro. Proved with the XLA compiler's own buffer stats
+    (compiled.memory_analysis()), chip-free on the CPU backend."""
+
+    def _compile_temp_bytes(self, schedule, n_micro, mesh):
+        main, startup, cost = _build_moe(
+            seq_len=32, vocab=128, d_model=64, n_heads=4, n_layers=8,
+            d_inner=256)
+        loops = propose_loops(main, cost.name)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        tr = PipelineTrainer(main, cost, loops=loops, mesh=mesh,
+                             n_micro=n_micro, schedule=schedule)
+        tr.initialize(sc)
+        r = np.random.RandomState(0)
+        feeds = {k: r.randint(1, 128, (32, 32)).astype(np.int64)
+                 for k in ("src_ids", "label")}
+        comp = jax.jit(tr._build_step(), donate_argnums=(0,)).lower(
+            tr.state, feeds, tr._rng).compile()
+        return comp.memory_analysis().temp_size_in_bytes
+
+    def test_pp4_nmicro8_temp_memory_win(self):
+        mesh = make_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+        tg = self._compile_temp_bytes("gpipe", 8, mesh)
+        tf = self._compile_temp_bytes("1f1b", 8, mesh)
+        # measured on this config: ~37.5 MB vs ~14.3 MB (2.6x); keep
+        # headroom against compiler-version noise
+        assert tf < tg / 1.5, (tg, tf)
+
+
+class TestNamedErrors:
+    def test_two_loop_program_rejected(self):
+        """Encoder+decoder transformers have two stacks; 1F1B handles
+        one loop and must say so."""
+        from paddle_tpu.models import transformer as T
+
+        _fresh()
+        main, startup, loss = T.build_program(
+            seq_len=8, d_model=32, n_heads=2, n_layers=4, d_inner=64,
+            vocab=60, dropout_rate=0.0, learning_rate=1.0,
+            warmup_steps=40)
+        main._seed = 5
+        loops = propose_loops(main, loss.name)
+        assert len(loops) == 2
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        tr = PipelineTrainer(main, loss, loops=loops, mesh=mesh,
+                             n_micro=4, schedule="1f1b")
+        tr.initialize(sc)
+        r = np.random.RandomState(0)
+        feed = {k: r.randint(1, 60, (8, 8)).astype(np.int64)
+                for k in ("src_ids", "tgt_ids", "label")}
+        with pytest.raises(PipelinePartitionError,
+                           match="exactly one|gpipe"):
+            tr.run(feed=feed)
+
+    def test_pp1_rejected(self):
+        xs, ys = _mlp_data()
+        _fresh()
+        prog, startup, loss, bounds = _build_mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        tr = PipelineTrainer(prog, loss, loops=[bounds],
+                             schedule="1f1b")
+        tr.initialize(sc)
+        with pytest.raises(PipelinePartitionError, match="pp"):
+            tr.run(feed={"x": xs, "y": ys})
+
+    def test_bad_schedule_name(self):
+        prog, startup, loss, bounds = _build_mlp()
+        with pytest.raises(ValueError, match="gpipe"):
+            PipelineTrainer(prog, loss, loops=[bounds],
+                            schedule="interleaved")
+
+
+class TestCompiledProgramAPI:
+    def test_pp_schedule_flag(self):
+        xs, ys = _mlp_data()
+        prog, startup, loss, bounds = _build_mlp()
+        base = _exec_losses(prog, startup, loss, {"x": xs, "y": ys}, 4)
+        _fresh()
+        prog2, startup2, loss2, _ = _build_mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup2, scope=sc)
+        mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+        cp = fluid.CompiledProgram(prog2).with_data_parallel(
+            loss_name=loss2.name, mesh=mesh, n_micro=4,
+            pp_schedule="1f1b")
+        got = []
+        for _ in range(4):
+            l, = exe.run(cp, feed={"x": xs, "y": ys},
+                         fetch_list=[loss2], scope=sc)
+            got.append(float(np.asarray(l).reshape(-1)[0]))
+        np.testing.assert_allclose(base, got, rtol=5e-4, atol=5e-5)
