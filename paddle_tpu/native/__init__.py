@@ -532,33 +532,57 @@ def run_train_demo(artifact_dir: str, steps: int,
 # ---------------------------------------------------------------------------
 _XLA_TRAIN_BIN = os.path.join(_DIR, "_xla_train")
 _xla_train_lock = threading.Lock()
-_xla_train_error: Optional[str] = None
+# (source-hash, tf-root) -> error message: a failure is retried when
+# either the sources change or a different toolchain appears, instead
+# of latching the first error for the process lifetime (ADVICE r4)
+_xla_train_error: dict = {}
+
+
+def _xla_train_deps():
+    return [os.path.join(_DIR, "xla_train", "xla_train.cc"),
+            os.path.join(_SRC, "json.cc"),
+            os.path.join(_SRC, "json.h"),
+            os.path.join(_SRC, "program.cc"),
+            os.path.join(_SRC, "program.h")]
+
+
+def _src_hash(paths) -> str:
+    """Content hash of the native sources. Freshness must NOT use
+    mtimes: git checkouts do not preserve them, so a stale (or
+    foreign) binary could shadow newer sources (ADVICE r4)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for p in paths:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
 
 
 def build_xla_train() -> str:
-    """Compile (once) and return the path of the xla_train binary."""
-    global _xla_train_error
+    """Compile (once per source state) and return the binary path."""
     with _xla_train_lock:
-        src = os.path.join(_DIR, "xla_train", "xla_train.cc")
-        deps = [src,
-                os.path.join(_SRC, "json.cc"),
-                os.path.join(_SRC, "json.h"),
-                os.path.join(_SRC, "program.cc"),
-                os.path.join(_SRC, "program.h")]
-        if os.path.exists(_XLA_TRAIN_BIN) and all(
-                os.path.getmtime(_XLA_TRAIN_BIN) >= os.path.getmtime(d)
-                for d in deps):
-            return _XLA_TRAIN_BIN
-        if _xla_train_error is not None:
-            raise RuntimeError(_xla_train_error)
+        deps = _xla_train_deps()
         tf = _find_tf_root()
+        # stamp = sources hash + toolchain root: a binary linked
+        # against a removed/replaced tensorflow wheel must rebuild,
+        # not be served stale
+        want = _src_hash(deps) + ":" + str(tf)
+        stamp = _XLA_TRAIN_BIN + ".srchash"
+        if os.path.exists(_XLA_TRAIN_BIN) and os.path.exists(stamp):
+            with open(stamp) as f:
+                if f.read().strip() == want:
+                    return _XLA_TRAIN_BIN
+        key = (want, tf)
+        if key in _xla_train_error:
+            raise RuntimeError(_xla_train_error[key])
         if tf is None:
-            _xla_train_error = (
+            _xla_train_error[key] = (
                 "xla_train: no bundled XLA runtime (tensorflow wheel "
                 "with libtensorflow_cc) found on sys.path")
-            raise RuntimeError(_xla_train_error)
+            raise RuntimeError(_xla_train_error[key])
         inc = os.path.join(tf, "include")
-        cmd = ["g++", "-std=c++17", "-O1", src,
+        cmd = ["g++", "-std=c++17", "-O1", deps[0],
                os.path.join(_SRC, "json.cc"),
                os.path.join(_SRC, "program.cc"),
                "-I" + inc,
@@ -571,9 +595,11 @@ def build_xla_train() -> str:
                "-o", _XLA_TRAIN_BIN]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
-            _xla_train_error = ("xla_train build failed: "
-                                + proc.stderr[-2000:])
-            raise RuntimeError(_xla_train_error)
+            _xla_train_error[key] = ("xla_train build failed: "
+                                     + proc.stderr[-2000:])
+            raise RuntimeError(_xla_train_error[key])
+        with open(stamp, "w") as f:
+            f.write(want)
         return _XLA_TRAIN_BIN
 
 

@@ -116,7 +116,10 @@ class TestNativeXlaBuilder:
         with fluid.program_guard(prog, startup):
             x = fluid.layers.data(name="x", shape=[8],
                                   dtype="float32")
-            out = fluid.layers.tanh(x)  # no native kernel registered
+            # atan has no native kernel registered (tanh does — the r4
+            # version of this test used tanh and only passed against a
+            # stale committed binary, ADVICE r4 #1)
+            out = fluid.layers.atan(x)
         exe = fluid.Executor(fluid.CPUPlace())
         sc = fluid.Scope()
         exe.run(startup, scope=sc)
