@@ -99,4 +99,5 @@ def build_program(batch_size=None, seq_len=64, vocab=32000, d_model=512,
                 learning_rate=lr, beta1=0.9, beta2=0.997, epsilon=1e-9)
             opt.minimize(avg_cost)
     main._moe_drop_vars = drops
+    main._moe_aux_var = aux_mean.name
     return main, startup, avg_cost

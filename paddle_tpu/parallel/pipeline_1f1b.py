@@ -80,10 +80,13 @@ from .pipeline_program import (PipelinePartitionError,
 __all__ = ["build_1f1b_step"]
 
 
-def build_1f1b_step(tr):
-    """Build ``step(state, feeds, rng) -> (new_state, loss, rng_next)``
-    running ``tr``'s program under the 1F1B schedule. ``tr`` is a
-    PipelineTrainer constructed with ``schedule='1f1b'``."""
+def build_1f1b_step(tr, extra_fetches=()):
+    """Build ``step(state, feeds, rng) -> (new_state, loss, fetches,
+    rng_next)`` running ``tr``'s program under the 1F1B schedule.
+    ``tr`` is a PipelineTrainer constructed with ``schedule='1f1b'``;
+    ``extra_fetches`` names non-state vars to materialize (head
+    outputs, gradients, reduce observables — NOT per-microbatch tail
+    activations, which only GPipe holds at full batch)."""
     if tr.pp <= 1:
         raise PipelinePartitionError(
             "schedule='1f1b' needs a 'pp' mesh axis > 1 (with pp == 1 "
@@ -681,6 +684,22 @@ def build_1f1b_step(tr):
         for n in tr.state_names:
             if n in env_b:
                 new_state[n] = env_b[n]
-        return new_state, jnp.reshape(loss, ()), rng_next
+        fetches = {}
+        for n in extra_fetches:
+            if n in env_b:
+                fetches[n] = env_b[n]
+            elif n in env:
+                fetches[n] = env[n]
+            else:
+                from .pipeline_program import PipelineFetchError
+
+                raise PipelineFetchError(
+                    f"fetch target {n!r} is not materialized by the "
+                    f"1f1b schedule: it is neither the loss, a "
+                    f"persistable, a head-section var, a gradient, "
+                    f"nor a loop reduce output. Tail activations are "
+                    f"computed per microbatch inside the ring — "
+                    f"fetch them through schedule='gpipe'.")
+        return new_state, jnp.reshape(loss, ()), fetches, rng_next
 
     return step

@@ -75,6 +75,13 @@ class PipelinePartitionError(ValueError):
     """Raised when a Program cannot be partitioned as requested."""
 
 
+class PipelineFetchError(KeyError):
+    """A fetch target the pipeline schedule does not materialize.
+    Distinct from a plain KeyError (e.g. a missing feed) so callers
+    like CompiledProgram can rebuild with widened fetch hints on THIS
+    error only."""
+
+
 # ---------------------------------------------------------------------------
 # partition
 # ---------------------------------------------------------------------------
@@ -130,7 +137,8 @@ def _attrs_isomorphic(a, b):
 
 
 def _partition(program: Program, loss_name: str,
-               loops_bounds: Sequence[Sequence[str]]):
+               loops_bounds: Sequence[Sequence[str]],
+               fetch_hints: Sequence[str] = ()):
     """Split the block into (sections, phaseB ops, var metadata)."""
     block = program.global_block
     for op in block.ops:
@@ -353,6 +361,27 @@ def _partition(program: Program, loss_name: str,
                 later_reads.update(_op_reads(op))
         for op in phase_b:
             later_reads.update(_op_reads(op))
+        # fetch hints promote otherwise-dead per-segment outputs (the
+        # MoE drop-fraction observability pattern) into reduce-out
+        # families so the schedules materialize them; under pp > 1
+        # they come back as per-microbatch means like every reduce
+        # out. Batch-major vars are excluded: a mean over microbatches
+        # of per-example activations is not the Executor's value, so
+        # those stay a named fetch error instead of a silent surprise.
+        # static-batch programs declare a CONCRETE batch on their data
+        # vars; a loop internal with that same leading dim is
+        # per-example too
+        static_batches = {
+            v.shape[0] for v in block.vars.values()
+            if v.is_data and v.shape and v.shape[0] != -1}
+
+        def _hintable(name):
+            v = block._find_var_recursive(name)
+            return (v is not None and v.shape and not v.is_data
+                    and not v.persistable and v.shape[0] != -1
+                    and v.shape[0] not in static_batches)
+
+        later_reads.update(n for n in fetch_hints if _hintable(n))
 
         def _out_positions(seg):
             pos = []
@@ -363,17 +392,21 @@ def _partition(program: Program, loss_name: str,
                             pos.append((oi, slot, k))
             return pos
 
-        pos0 = _out_positions(loop.segments[0])
-        for si, seg in enumerate(loop.segments[1:], 1):
-            if _out_positions(seg) != pos0:
-                raise PipelinePartitionError(
-                    f"loop segment {si}: per-segment outputs read "
-                    f"after the loop do not line up positionally with "
-                    f"segment 0's (every segment must export the same "
-                    f"reduce outputs)")
+        # positions are the UNION over segments: reading (or hinting)
+        # only segment 2's observable still exports the whole family
+        pos_union = sorted({p for seg in loop.segments
+                            for p in _out_positions(seg)})
+        for si, seg in enumerate(loop.segments):
+            for (oi, slot, k) in pos_union:
+                names = seg[oi].outputs.get(slot, [])
+                if k >= len(names):
+                    raise PipelinePartitionError(
+                        f"loop segment {si}: op {seg[oi].type!r} has "
+                        f"no output at slot {slot}[{k}] that other "
+                        f"segments export as a reduce output")
         loop.reduce_outs = [
             [seg[oi].outputs[slot][k] for seg in loop.segments]
-            for (oi, slot, k) in pos0]
+            for (oi, slot, k) in pos_union]
 
     return sections, phase_b
 
@@ -517,11 +550,13 @@ class PipelineTrainer:
                  loops: Sequence[Sequence[str]],
                  mesh: Optional[Mesh] = None, n_micro: int = 1,
                  axis: str = "pp", tp_rules=None,
-                 schedule: str = "gpipe"):
+                 schedule: str = "gpipe",
+                 fetch_hints: Sequence[str] = ()):
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 f"schedule must be 'gpipe' or '1f1b', got {schedule!r}")
         self.schedule = schedule
+        self.fetch_hints = tuple(fetch_hints)
         self.program = program
         self.loss_name = loss.name if hasattr(loss, "name") else loss
         self.mesh = mesh
@@ -543,7 +578,8 @@ class PipelineTrainer:
                     f"PipelineTrainer supports a {axis!r} (x 'tp') "
                     f"mesh; axes {other} have size > 1")
         self.sections, self.phase_b = _partition(
-            program, self.loss_name, loops)
+            program, self.loss_name, loops,
+            fetch_hints=self.fetch_hints)
         for sec in self.sections:
             if sec.kind == "loop" and len(sec.loop.segments) % self.pp:
                 raise PipelinePartitionError(
@@ -834,11 +870,11 @@ class PipelineTrainer:
         return ys.reshape((B,) + ys.shape[2:])
 
     # ------------------------------------------------------------------
-    def _build_step(self):
+    def _build_step(self, extra_fetches=()):
         if self.schedule == "1f1b":
             from .pipeline_1f1b import build_1f1b_step
 
-            return build_1f1b_step(self)
+            return build_1f1b_step(self, extra_fetches)
         diff_names = [
             n for n in self.params_a
             if jnp.issubdtype(jnp.asarray(self.state[n]).dtype,
@@ -866,6 +902,12 @@ class PipelineTrainer:
             for n in state_out:
                 if n in env:
                     aux.setdefault(n, env[n])
+            # requested fetches that materialize inside the forward
+            # (head/tail activations, reduce observables) ride out
+            # through aux — XLA dead-codes them when unfetched
+            for n in extra_fetches:
+                if n in env:
+                    aux.setdefault(n, env[n])
             # mean() returns a [1] tensor; grad needs a scalar
             return jnp.reshape(env[loss_name], ()), aux
 
@@ -887,7 +929,18 @@ class PipelineTrainer:
             for n in self.state_names:
                 if n in env:
                     new_state[n] = env[n]
-            return new_state, loss, rng_next
+            fetches = {}
+            for n in extra_fetches:
+                if n not in env:
+                    raise PipelineFetchError(
+                        f"fetch target {n!r} is not materialized by "
+                        f"the pipeline schedule: it is neither the "
+                        f"loss, a persistable, a head/tail-section "
+                        f"var, a gradient, nor a loop reduce output. "
+                        f"Loop-internal activations are only held "
+                        f"per microbatch inside the stage scan.")
+                fetches[n] = env[n]
+            return new_state, loss, fetches, rng_next
 
         return step
 
@@ -917,25 +970,41 @@ class PipelineTrainer:
                     == np.issubdtype(want, np.floating)):
                 arr = arr.astype(want)
             feeds[n] = arr
-        spec = tuple(sorted((n, a.shape, str(a.dtype))
-                            for n, a in feeds.items()))
-        if self._jitted is None or self._feed_spec != spec:
-            step = self._build_step()
-            self._jitted = jax.jit(step, donate_argnums=(0,))
-            self._feed_spec = spec
-        self.state, loss, self._rng = self._jitted(
+        names = [f.name if hasattr(f, "name") else f
+                 for f in (fetch_list or [])]
+        extra = tuple(dict.fromkeys(
+            n for n in names
+            if n != self.loss_name and n not in self.state))
+        spec = (tuple(sorted((n, a.shape, str(a.dtype))
+                             for n, a in feeds.items())), extra)
+        # cache per spec: the periodic-observability pattern (fetch
+        # observables every Nth step) alternates fetch sets and must
+        # not recompile the whole step on every transition
+        if self._jitted is None:
+            self._jitted = {}
+        jitted = self._jitted.get(spec)
+        if jitted is None:
+            step = self._build_step(extra_fetches=extra)
+            jitted = self._jitted[spec] = jax.jit(
+                step, donate_argnums=(0,))
+        self.state, loss, fetched, self._rng = jitted(
             self.state, feeds, self._rng)
         out = [np.asarray(loss) if return_numpy else loss]
-        for f in (fetch_list or []):
-            name = f.name if hasattr(f, "name") else f
+        for name in names:
             if name == self.loss_name:
                 continue
             # state entries are ALWAYS converted: their device buffers
             # are donated to the next step's jit call, so returning
             # the live reference would hand the caller an array that
-            # dies on the next run() (the loss is a fresh jit output
-            # and safe to keep on device)
-            out.append(np.asarray(self.state[name]))
+            # dies on the next run(). Loss and extra fetches are fresh
+            # jit outputs, safe to keep on device under
+            # return_numpy=False (PERF.md: steps pipeline without a
+            # host round-trip).
+            if name in self.state:
+                out.append(np.asarray(self.state[name]))
+            else:
+                out.append(np.asarray(fetched[name]) if return_numpy
+                           else fetched[name])
         return out
 
 
