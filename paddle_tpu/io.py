@@ -52,7 +52,15 @@ def _save_array(path, arr):
 
 def _load_array(path):
     with open(path, "rb") as f:
-        return np.load(f, allow_pickle=False)
+        magic = f.read(6)
+        f.seek(0)
+        if magic == b"\x93NUMPY":
+            return np.load(f, allow_pickle=False)
+        # reference-format param file: a raw LoDTensor stream
+        # (lod_tensor.cc:246) as written by the reference's save_vars
+        from .inference.proto_import import parse_lod_tensor
+
+        return parse_lod_tensor(f.read())
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
@@ -194,11 +202,31 @@ def load_vars(executor, dirname, main_program=None, vars=None,
             scope._set(var.name, _load_array(path))
     else:
         with open(os.path.join(dirname, filename), "rb") as f:
-            blob = np.load(f, allow_pickle=False)
+            raw = f.read()
+        if raw[:6] == b"\x93NUMPY" or raw[:2] == b"PK":  # npy/npz
+            import io as _io
+
+            blob = np.load(_io.BytesIO(raw), allow_pickle=False)
             for var in vars:
                 if var.name in blob:
                     scope.var(var.name)
                     scope._set(var.name, blob[var.name])
+        else:
+            # reference combined layout (save_combine_op):
+            # concatenated LoDTensor streams in the saved var order —
+            # assigned here in the program's persistable-var order,
+            # which matches a reference export of the same program
+            from .inference.proto_import import parse_lod_tensors_concat
+
+            arrays = parse_lod_tensors_concat(raw)
+            if len(arrays) != len(vars):
+                raise ValueError(
+                    f"combined params file holds {len(arrays)} "
+                    f"tensors but the program lists {len(vars)} "
+                    f"persistables")
+            for var, arr in zip(vars, arrays):
+                scope.var(var.name)
+                scope._set(var.name, arr)
 
 
 def load_params(executor, dirname, main_program=None, filename=None):
@@ -280,9 +308,28 @@ def load_inference_model(dirname, executor, model_filename=None,
             model["program"] = native.NativeProgram.from_bytes(
                 raw).to_dict()
     else:
-        model = json.loads(raw.decode())
+        try:
+            model = json.loads(raw.decode())
+        except (UnicodeDecodeError, ValueError):
+            # not ours: a reference-saved __model__ is a protobuf
+            # ProgramDesc (reference io.py:1020 load path); import it
+            # read-only (inference/proto_import.py)
+            from .inference import proto_import as _PI
+
+            if not _PI.is_program_desc(raw):
+                raise ValueError(
+                    f"'{path}' is neither a PTPF/JSON model written "
+                    f"by this framework nor a reference protobuf "
+                    f"ProgramDesc")
+            program = _PI.parse_program_desc(raw)
+            feeds, fetches = _PI.feed_fetch_names(program)
+            model = {"program": program.to_dict(),
+                     "feed_names": feeds, "fetch_names": fetches}
     program = Program.from_dict(model["program"])
-    persist = [v for v in program.list_vars() if _is_persistable(v)]
+    from .core.types import VarType as _VT
+
+    persist = [v for v in program.list_vars() if _is_persistable(v)
+               and v.type in (_VT.LOD_TENSOR, _VT.SELECTED_ROWS)]
     load_vars(executor, dirname, program, vars=persist,
               filename=params_filename)
     fetch_targets = [program.global_block.var(n)

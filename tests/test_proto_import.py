@@ -1,0 +1,189 @@
+"""Read-only protobuf ProgramDesc importer (VERDICT r4 next #6):
+a reference-saved ``__model__`` (+ reference-format LoDTensor param
+files) loads through fluid.io.load_inference_model and runs through
+the Executor.
+
+The fixture ``tests/fixtures/mnist_fc_program.__model__`` is encoded
+from the hand-authored textproto next to it with protoc AGAINST THE
+REFERENCE'S OWN framework.proto::
+
+    protoc -I <ref>/paddle/fluid/framework \
+      --encode=paddle.framework.proto.ProgramDesc \
+      <ref>/paddle/fluid/framework/framework.proto \
+      < mnist_fc_program.textpb > mnist_fc_program.__model__
+
+so the bytes the importer decodes are genuine reference wire format,
+not this repo's own encoder talking to itself."""
+import os
+import shutil
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import proto_import as PI
+
+FIXDIR = os.path.join(os.path.dirname(__file__), "fixtures")
+MODEL = os.path.join(FIXDIR, "mnist_fc_program.__model__")
+TEXTPB = os.path.join(FIXDIR, "mnist_fc_program.textpb")
+REF_PROTO_DIR = "/root/reference/paddle/fluid/framework"
+
+
+def _write_ref_lod_tensor(path, arr):
+    """Reference SerializeToStream layout (lod_tensor.cc:246 /
+    tensor_util.cc TensorToStream), written independently here so the
+    importer is tested against the documented format, not itself."""
+    dt = {np.dtype("float32"): 5, np.dtype("int64"): 3,
+          np.dtype("float64"): 6, np.dtype("int32"): 2}[arr.dtype]
+    # TensorDesc proto: field 1 varint data_type, field 2 packed? --
+    # the reference writes unpacked int64 dims (proto2 default)
+    desc = bytes([0x08, dt])
+    for d in arr.shape:
+        desc += bytes([0x10]) + _varint(d)
+    out = struct.pack("<I", 0)          # LoDTensor version
+    out += struct.pack("<Q", 0)         # lod levels
+    out += struct.pack("<I", 0)         # Tensor version
+    out += struct.pack("<i", len(desc))
+    out += desc
+    out += arr.tobytes()
+    with open(path, "wb") as f:
+        f.write(out)
+
+
+def _varint(x):
+    out = b""
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+class TestWireParsing:
+    def test_fixture_parses_to_expected_program(self):
+        with open(MODEL, "rb") as f:
+            raw = f.read()
+        assert PI.is_program_desc(raw)
+        prog = PI.parse_program_desc(raw)
+        blk = prog.global_block
+        assert [op.type for op in blk.ops] == [
+            "feed", "mul", "elementwise_add", "softmax", "fetch"]
+        assert blk.var("fc_w").shape == (8, 4)
+        assert blk.var("fc_w").persistable
+        assert blk.var("img").shape == (-1, 8)
+        assert blk.var("img").dtype.value == "float32"
+        assert blk.var("img").is_data  # fed by the feed op
+        mul = blk.ops[1]
+        assert mul.attrs["x_num_col_dims"] == 1
+        feeds, fetches = PI.feed_fetch_names(prog)
+        assert feeds == ["img"] and fetches == ["softmax_out"]
+
+    def test_attr_wire_types_decode(self):
+        with open(MODEL, "rb") as f:
+            prog = PI.parse_program_desc(f.read())
+        sm = prog.global_block.ops[3]
+        assert sm.attrs["use_cudnn"] is True
+        assert sm.attrs["data_format"] == "AnyLayout"
+        assert sm.attrs["op_role_var"] == ["a", "b"]
+        np.testing.assert_allclose(sm.attrs["wire_floats"],
+                                   [0.5, -1.25])
+        assert sm.attrs["wire_longs"] == [7, -9]
+        assert sm.attrs["wire_bools"] == [True, False]
+        assert sm.attrs["wire_long"] == 1234567890123
+
+    @pytest.mark.skipif(
+        shutil.which("protoc") is None
+        or not os.path.exists(os.path.join(REF_PROTO_DIR,
+                                           "framework.proto")),
+        reason="protoc or the reference proto unavailable")
+    def test_fixture_bytes_match_reference_schema_encoding(self):
+        """Guard against fixture drift: re-encoding the textproto with
+        the reference's own .proto reproduces the committed bytes."""
+        with open(TEXTPB, "rb") as f:
+            enc = subprocess.run(
+                ["protoc", "-I", REF_PROTO_DIR,
+                 "--encode=paddle.framework.proto.ProgramDesc",
+                 os.path.join(REF_PROTO_DIR, "framework.proto")],
+                input=f.read(), capture_output=True, check=True)
+        with open(MODEL, "rb") as f:
+            assert enc.stdout == f.read()
+
+
+class TestEndToEnd:
+    def test_reference_model_dir_loads_and_runs(self, tmp_path):
+        """The verdict's done-bar: the imported program runs through
+        the Executor — via the USER API (load_inference_model on a
+        reference-layout dir with reference-format param files)."""
+        fluid._reset_global_scope()
+        d = str(tmp_path / "ref_model")
+        os.makedirs(d)
+        shutil.copy(MODEL, os.path.join(d, "__model__"))
+        r = np.random.RandomState(0)
+        w = r.randn(8, 4).astype(np.float32)
+        b = r.randn(4).astype(np.float32)
+        _write_ref_lod_tensor(os.path.join(d, "fc_w"), w)
+        _write_ref_lod_tensor(os.path.join(d, "fc_b"), b)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        prog, feed_names, fetch_targets = fluid.io.load_inference_model(
+            d, exe)
+        assert feed_names == ["img"]
+        x = r.randn(16, 8).astype(np.float32)
+        out, = exe.run(prog, feed={"img": x},
+                       fetch_list=fetch_targets)
+        # numpy oracle
+        logits = x @ w + b
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        want = e / e.sum(1, keepdims=True)
+        np.testing.assert_allclose(np.asarray(out), want,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_combined_params_file_loads(self, tmp_path):
+        """The reference's save_combine layout (one __params__ file of
+        concatenated LoDTensor streams) loads via params_filename."""
+        fluid._reset_global_scope()
+        d = str(tmp_path / "ref_combined")
+        os.makedirs(d)
+        shutil.copy(MODEL, os.path.join(d, "__model__"))
+        r = np.random.RandomState(3)
+        w = r.randn(8, 4).astype(np.float32)
+        b = r.randn(4).astype(np.float32)
+        p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
+        _write_ref_lod_tensor(p1, w)
+        _write_ref_lod_tensor(p2, b)
+        # persistable program order is fc_w then fc_b (var decl order)
+        with open(os.path.join(d, "__params__"), "wb") as f:
+            for p in (p1, p2):
+                with open(p, "rb") as g:
+                    f.write(g.read())
+        exe = fluid.Executor(fluid.CPUPlace())
+        prog, feed_names, fetch_targets = fluid.io.load_inference_model(
+            d, exe, params_filename="__params__")
+        x = r.randn(8, 8).astype(np.float32)
+        out, = exe.run(prog, feed={"img": x}, fetch_list=fetch_targets)
+        logits = x @ w + b
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        np.testing.assert_allclose(np.asarray(out),
+                                   e / e.sum(1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_lod_tensor_roundtrip_with_lod_metadata(self, tmp_path):
+        """LoD offsets in the stream are skipped, payload intact."""
+        arr = np.arange(12, dtype=np.int64).reshape(3, 4)
+        path = str(tmp_path / "t")
+        # write with one fake LoD level to exercise the skip path
+        desc = bytes([0x08, 3]) + bytes([0x10, 3, 0x10, 4])
+        lod = np.asarray([0, 2, 3], dtype=np.uint64)
+        blob = (struct.pack("<I", 0) + struct.pack("<Q", 1)
+                + struct.pack("<Q", lod.nbytes) + lod.tobytes()
+                + struct.pack("<I", 0) + struct.pack("<i", len(desc))
+                + desc + arr.tobytes())
+        with open(path, "wb") as f:
+            f.write(blob)
+        with open(path, "rb") as f:
+            got = PI.parse_lod_tensor(f.read())
+        np.testing.assert_array_equal(got, arr)
