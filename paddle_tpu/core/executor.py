@@ -518,6 +518,38 @@ class Executor:
             feed_arrays[name] = arr
 
         from .. import amp
+        from ..flags import FLAGS
+
+        if FLAGS.native_build:
+            # the train-step XLA program is BUILT IN C++ (xla_train
+            # kernel registry) and consumed in-process via StableHLO;
+            # the traced path below stays the cross-check oracle
+            if amp.enabled():
+                raise RuntimeError(
+                    "FLAGS_native_build does not compose with AMP "
+                    "yet; the native kernel slice builds the block "
+                    "at its declared dtypes")
+            nkey = ("native", program._uid, program._version,
+                    tuple(sorted(feed_specs)), tuple(fetch_names),
+                    scope._uid)
+            step = self._cache.get(nkey) if use_program_cache \
+                else None
+            if step is None:
+                from ..native.hlo_exec import NativeBuiltStep
+
+                step = NativeBuiltStep(program, scope, feed_arrays,
+                                       fetch_names)
+                if use_program_cache:
+                    self._cache[nkey] = step
+            fetched = step.run(scope, feed_arrays)
+            out = [fetched[n] for n in fetch_names]
+            if FLAGS.check_nan_inf:
+                _check_nan_inf(
+                    {n: scope._get(n) for n in step.state_out_names},
+                    out, fetch_names)
+            if return_numpy:
+                out = [np.asarray(v) for v in out]
+            return out
 
         key = (program._uid, program._version, tuple(sorted(feed_specs)),
                tuple(fetch_names), amp.state_token(),
@@ -553,8 +585,6 @@ class Executor:
                 prog_seed if prog_seed is not None else _global_seed[0])
         new_state, fetches, rng_out = compiled.fn(
             mut, const_st, feed_arrays, rng)
-        from ..flags import FLAGS
-
         if FLAGS.check_nan_inf:
             _check_nan_inf(new_state, fetches, fetch_names)
         scope._set(RNG_VAR, rng_out)

@@ -420,7 +420,15 @@ def export_train_program(main_program, scope, example_feeds,
 
     def add_input(name, kind, arr):
         i = len(inputs)
-        arr = np.ascontiguousarray(np.asarray(arr))
+        import jax as _jax
+
+        arr = np.asarray(arr)
+        # canonicalize like the jax runtime (int64->int32 etc. under
+        # the default x64-disabled config): the manifest dtypes define
+        # the computation's PARAMETER types, and the in-process
+        # consumer (FLAGS_native_build) feeds jax-canonical buffers
+        arr = np.ascontiguousarray(
+            arr.astype(_jax.dtypes.canonicalize_dtype(arr.dtype)))
         fname = f"data/{i:03d}.bin"
         arr.tofile(os.path.join(out_path, fname))
         inputs.append({"name": name, "kind": kind,

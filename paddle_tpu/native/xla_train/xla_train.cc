@@ -618,6 +618,315 @@ void adamKernel(BuildCtx& ctx) {
           xla::Reshape(xla::Mul(b2p, c_b2), {1}));
 }
 
+// ---------------------------------------------------------------------------
+// conv / pool / batch_norm — the ResNet-slice kernels (semantics
+// mirror ops/nn_ops.py conv2d/_pool2d_impl/batch_norm exactly; grads
+// mirror the jax transpose rules the Python path differentiates into)
+// ---------------------------------------------------------------------------
+std::vector<int64_t> attrInts(BuildCtx& ctx, const std::string& name,
+                              std::vector<int64_t> def) {
+  const ptp::Attr* a = ctx.op->findAttr(name);
+  if (!a || a->tag != ptp::Attr::Tag::Ints) return def;
+  std::vector<int64_t> out(a->ints.begin(), a->ints.end());
+  if (out.size() == 1) out.push_back(out[0]);
+  return out;
+}
+
+xla::ConvolutionDimensionNumbers nchwOihwDnums() {
+  xla::ConvolutionDimensionNumbers d;
+  d.set_input_batch_dimension(0);
+  d.set_input_feature_dimension(1);
+  d.add_input_spatial_dimensions(2);
+  d.add_input_spatial_dimensions(3);
+  d.set_kernel_output_feature_dimension(0);
+  d.set_kernel_input_feature_dimension(1);
+  d.add_kernel_spatial_dimensions(2);
+  d.add_kernel_spatial_dimensions(3);
+  d.set_output_batch_dimension(0);
+  d.set_output_feature_dimension(1);
+  d.add_output_spatial_dimensions(2);
+  d.add_output_spatial_dimensions(3);
+  return d;
+}
+
+void conv2dKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("Input"), w = ctx.in("Filter");
+  auto strides = attrInts(ctx, "strides", {1, 1});
+  auto pads = attrInts(ctx, "paddings", {0, 0});
+  auto dil = attrInts(ctx, "dilations", {1, 1});
+  int64_t groups = ctx.attrI("groups", 1);
+  ctx.out("Output", xla::ConvGeneralDilated(
+      x, w, strides,
+      {{pads[0], pads[0]}, {pads[1], pads[1]}},
+      /*lhs_dilation=*/{1, 1}, /*rhs_dilation=*/dil,
+      nchwOihwDnums(), groups));
+}
+
+void conv2dGradKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("Input"), w = ctx.in("Filter");
+  xla::XlaOp dout = ctx.in("Output@GRAD");
+  auto strides = attrInts(ctx, "strides", {1, 1});
+  auto pads = attrInts(ctx, "paddings", {0, 0});
+  auto dil = attrInts(ctx, "dilations", {1, 1});
+  if (ctx.attrI("groups", 1) != 1)
+    fail("conv2d_grad: grouped convolutions are not in the native "
+         "slice yet");
+  auto xd = ctx.shapeOf(x), wd = ctx.shapeOf(w);
+  // per-dim remainder r = (H + 2p - dk) mod s
+  int64_t dk[2], r[2];
+  for (int i = 0; i < 2; ++i) {
+    dk[i] = dil[i] * (wd[2 + i] - 1) + 1;
+    r[i] = (xd[2 + i] + 2 * pads[i] - dk[i]) % strides[i];
+  }
+  // dInput: conv(dout lhs-dilated by s, w swapped+spatially reversed)
+  xla::XlaOp wt = xla::Rev(xla::Transpose(w, {1, 0, 2, 3}), {2, 3});
+  ctx.out("Input@GRAD", xla::ConvGeneralDilated(
+      dout, wt, {1, 1},
+      {{dk[0] - 1 - pads[0], dk[0] - 1 - pads[0] + r[0]},
+       {dk[1] - 1 - pads[1], dk[1] - 1 - pads[1] + r[1]}},
+      /*lhs_dilation=*/strides, /*rhs_dilation=*/dil,
+      nchwOihwDnums(), 1));
+  // dFilter: conv with batch<->feature swapped on both operands
+  xla::ConvolutionDimensionNumbers fd;
+  fd.set_input_batch_dimension(1);       // C_in acts as batch
+  fd.set_input_feature_dimension(0);     // N acts as features
+  fd.add_input_spatial_dimensions(2);
+  fd.add_input_spatial_dimensions(3);
+  fd.set_kernel_input_feature_dimension(0);   // N
+  fd.set_kernel_output_feature_dimension(1);  // C_out
+  fd.add_kernel_spatial_dimensions(2);
+  fd.add_kernel_spatial_dimensions(3);
+  fd.set_output_batch_dimension(0);      // -> C_in
+  fd.set_output_feature_dimension(1);    // -> C_out
+  fd.add_output_spatial_dimensions(2);
+  fd.add_output_spatial_dimensions(3);
+  xla::XlaOp dw_io = xla::ConvGeneralDilated(
+      x, dout, /*window_strides=*/dil,
+      {{pads[0], pads[0] - r[0]}, {pads[1], pads[1] - r[1]}},
+      /*lhs_dilation=*/{1, 1}, /*rhs_dilation=*/strides, fd, 1);
+  ctx.out("Filter@GRAD", xla::Transpose(dw_io, {1, 0, 2, 3}));
+}
+
+struct PoolCfg {
+  std::vector<int64_t> win, str;
+  std::vector<std::pair<int64_t, int64_t>> pad;
+  int64_t kh, kw, ph, pw, sh, sw;
+  bool max_pool, exclusive, padded;
+};
+
+PoolCfg poolCfg(BuildCtx& ctx, const std::vector<int64_t>& xd) {
+  PoolCfg c;
+  auto ksize = attrInts(ctx, "ksize", {2, 2});
+  auto strides = attrInts(ctx, "strides", {1, 1});
+  auto pads = attrInts(ctx, "paddings", {0, 0});
+  if (ctx.attrB("global_pooling", false)) {
+    ksize = {xd[2], xd[3]};
+    pads = {0, 0};
+    strides = {1, 1};
+  }
+  if (ctx.attrB("ceil_mode", false))
+    fail("pool2d: ceil_mode is not in the native slice yet");
+  std::string pt;
+  const ptp::Attr* a = ctx.op->findAttr("pooling_type");
+  if (a && a->tag == ptp::Attr::Tag::String) pt = a->s;
+  c.max_pool = pt != "avg";
+  c.exclusive = ctx.attrB("exclusive", true);
+  c.kh = ksize[0]; c.kw = ksize[1];
+  c.sh = strides[0]; c.sw = strides[1];
+  c.ph = pads[0]; c.pw = pads[1];
+  c.win = {1, 1, c.kh, c.kw};
+  c.str = {1, 1, c.sh, c.sw};
+  c.pad = {{0, 0}, {0, 0}, {c.ph, c.ph}, {c.pw, c.pw}};
+  c.padded = c.ph != 0 || c.pw != 0;
+  return c;
+}
+
+xla::XlaOp windowCounts(BuildCtx& ctx, const PoolCfg& c,
+                        const std::vector<int64_t>& xd,
+                        xla::PrimitiveType ty) {
+  xla::XlaOp ones = xla::Broadcast(
+      xla::ConvertElementType(xla::ConstantR0<float>(ctx.b, 1.0f), ty),
+      xd);
+  return xla::ReduceWindowWithGeneralPadding(
+      ones, xla::Zero(ctx.b, ty),
+      xla::CreateScalarAddComputation(ty, ctx.b),
+      c.win, c.str, /*base_dilations=*/{}, /*window_dilations=*/{},
+      c.pad);
+}
+
+void pool2dKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  auto xd = ctx.shapeOf(x);
+  auto ty = ctx.typeOf(x);
+  PoolCfg c = poolCfg(ctx, xd);
+  if (c.max_pool) {
+    ctx.out("Out", xla::ReduceWindowWithGeneralPadding(
+        x, xla::MinValue(ctx.b, ty),
+        xla::CreateScalarMaxComputation(ty, ctx.b),
+        c.win, c.str, {}, {}, c.pad));
+    return;
+  }
+  xla::XlaOp s = xla::ReduceWindowWithGeneralPadding(
+      x, xla::Zero(ctx.b, ty),
+      xla::CreateScalarAddComputation(ty, ctx.b),
+      c.win, c.str, {}, {}, c.pad);
+  if (c.exclusive && c.padded) {
+    ctx.out("Out", xla::Div(s, windowCounts(ctx, c, xd, ty)));
+  } else {
+    ctx.out("Out", xla::Div(
+        s, xla::ConvertElementType(
+            xla::ConstantR0<float>(
+                ctx.b, static_cast<float>(c.kh * c.kw)), ty)));
+  }
+}
+
+void pool2dGradKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  xla::XlaOp dout = ctx.in("Out@GRAD");
+  auto xd = ctx.shapeOf(x);
+  auto ty = ctx.typeOf(x);
+  PoolCfg c = poolCfg(ctx, xd);
+  if (c.max_pool) {
+    // transpose of the max reduce-window: route each dout element to
+    // the window's (first) argmax — jax lowers its transpose to the
+    // same select-and-scatter
+    ctx.out("X@GRAD", xla::SelectAndScatterWithGeneralPadding(
+        x, xla::CreateScalarGeComputation(ty, ctx.b),
+        c.win, c.str, c.pad, dout, xla::Zero(ctx.b, ty),
+        xla::CreateScalarAddComputation(ty, ctx.b)));
+    return;
+  }
+  // avg: scale dout per window, then scatter back = conv against a
+  // ones kernel with lhs_dilation = pool strides (depthwise)
+  xla::XlaOp scaled;
+  if (c.exclusive && c.padded) {
+    scaled = xla::Div(dout, windowCounts(ctx, c, xd, ty));
+  } else {
+    scaled = xla::Div(dout, xla::ConvertElementType(
+        xla::ConstantR0<float>(
+            ctx.b, static_cast<float>(c.kh * c.kw)), ty));
+  }
+  int64_t C = xd[1];
+  int64_t rh = (xd[2] + 2 * c.ph - c.kh) % c.sh;
+  int64_t rw = (xd[3] + 2 * c.pw - c.kw) % c.sw;
+  xla::XlaOp ones_k = xla::Broadcast(
+      xla::ConvertElementType(xla::ConstantR0<float>(ctx.b, 1.0f), ty),
+      {C, 1, c.kh, c.kw});
+  ctx.out("X@GRAD", xla::ConvGeneralDilated(
+      scaled, ones_k, {1, 1},
+      {{c.kh - 1 - c.ph, c.kh - 1 - c.ph + rh},
+       {c.kw - 1 - c.pw, c.kw - 1 - c.pw + rw}},
+      /*lhs_dilation=*/{c.sh, c.sw}, /*rhs_dilation=*/{1, 1},
+      nchwOihwDnums(), /*feature_group_count=*/C));
+}
+
+xla::XlaOp bcastC(BuildCtx& ctx, xla::XlaOp v,
+                  const std::vector<int64_t>& dims) {
+  return xla::BroadcastInDim(v, dims, {1});
+}
+
+void requireNchw(BuildCtx& ctx, const std::vector<int64_t>& xd) {
+  const ptp::Attr* a = ctx.op->findAttr("data_layout");
+  if (a && a->tag == ptp::Attr::Tag::String && a->s != "NCHW")
+    fail(ctx.op->type + ": data_layout '" + a->s +
+         "' is not in the native slice (NCHW only)");
+  if (xd.size() != 4)
+    fail(ctx.op->type + ": the native slice covers NCHW rank-4 "
+         "inputs");
+}
+
+void batchNormKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  xla::XlaOp scale = ctx.in("Scale"), bias = ctx.in("Bias");
+  xla::XlaOp mean_in = ctx.in("Mean"), var_in = ctx.in("Variance");
+  auto xd = ctx.shapeOf(x);
+  auto ty = ctx.typeOf(x);
+  requireNchw(ctx, xd);
+  double eps = ctx.attrF("epsilon", 1e-5);
+  double mom = ctx.attrF("momentum", 0.9);
+  bool is_test = ctx.attrB("is_test", false) ||
+                 ctx.attrB("use_global_stats", false);
+  double m = static_cast<double>(xd[0] * xd[2] * xd[3]);
+  auto add_c = xla::CreateScalarAddComputation(ty, ctx.b);
+  auto reduce_mean = [&](xla::XlaOp v) {
+    return xla::Div(
+        xla::Reduce(v, xla::Zero(ctx.b, ty), add_c, {0, 2, 3}),
+        xla::ScalarLike(scale, m));
+  };
+  if (is_test) {
+    xla::XlaOp inv = xla::Rsqrt(
+        xla::Add(var_in, xla::ScalarLike(var_in, eps)));
+    xla::XlaOp y = xla::Add(
+        xla::Mul(xla::Mul(xla::Sub(x, bcastC(ctx, mean_in, xd)),
+                          bcastC(ctx, inv, xd)),
+                 bcastC(ctx, scale, xd)),
+        bcastC(ctx, bias, xd));
+    ctx.out("Y", y);
+    ctx.out("MeanOut", mean_in);
+    ctx.out("VarianceOut", var_in);
+    ctx.out("SavedMean", mean_in);
+    ctx.out("SavedVariance", inv);
+    return;
+  }
+  xla::XlaOp mean = reduce_mean(x);
+  xla::XlaOp var = xla::Sub(reduce_mean(xla::Mul(x, x)),
+                            xla::Mul(mean, mean));
+  xla::XlaOp inv = xla::Rsqrt(
+      xla::Add(var, xla::ScalarLike(var, eps)));
+  xla::XlaOp y = xla::Add(
+      xla::Mul(xla::Mul(xla::Sub(x, bcastC(ctx, mean, xd)),
+                        bcastC(ctx, inv, xd)),
+               bcastC(ctx, scale, xd)),
+      bcastC(ctx, bias, xd));
+  xla::XlaOp momv = xla::ScalarLike(mean, mom);
+  xla::XlaOp one_m = xla::ScalarLike(mean, 1.0 - mom);
+  ctx.out("Y", y);
+  ctx.out("MeanOut",
+          xla::Add(xla::Mul(mean_in, momv), xla::Mul(mean, one_m)));
+  ctx.out("VarianceOut",
+          xla::Add(xla::Mul(var_in, momv), xla::Mul(var, one_m)));
+  ctx.out("SavedMean", mean);
+  ctx.out("SavedVariance", inv);
+}
+
+void batchNormGradKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  xla::XlaOp scale = ctx.in("Scale");
+  xla::XlaOp mean = ctx.in("SavedMean");
+  xla::XlaOp inv = ctx.in("SavedVariance");  // inv-std, like cuDNN
+  xla::XlaOp dy = ctx.in("Y@GRAD");
+  auto xd = ctx.shapeOf(x);
+  auto ty = ctx.typeOf(x);
+  requireNchw(ctx, xd);
+  double m = static_cast<double>(xd[0] * xd[2] * xd[3]);
+  auto add_c = xla::CreateScalarAddComputation(ty, ctx.b);
+  auto rsum = [&](xla::XlaOp v) {
+    return xla::Reduce(v, xla::Zero(ctx.b, ty), add_c, {0, 2, 3});
+  };
+  xla::XlaOp xhat = xla::Mul(xla::Sub(x, bcastC(ctx, mean, xd)),
+                             bcastC(ctx, inv, xd));
+  xla::XlaOp dbias = rsum(dy);
+  xla::XlaOp dscale = rsum(xla::Mul(dy, xhat));
+  bool stats_frozen = ctx.attrB("is_test", false) ||
+                      ctx.attrB("use_global_stats", false);
+  xla::XlaOp dx;
+  if (stats_frozen) {
+    dx = xla::Mul(dy, xla::Mul(bcastC(ctx, scale, xd),
+                               bcastC(ctx, inv, xd)));
+  } else {
+    xla::XlaOp coef = xla::Div(
+        xla::Mul(scale, inv), xla::ScalarLike(scale, m));
+    xla::XlaOp term = xla::Sub(
+        xla::Sub(xla::Mul(dy, xla::ScalarLike(dy, m)),
+                 bcastC(ctx, dbias, xd)),
+        xla::Mul(xhat, bcastC(ctx, dscale, xd)));
+    dx = xla::Mul(bcastC(ctx, coef, xd), term);
+  }
+  ctx.out("X@GRAD", dx);
+  ctx.out("Scale@GRAD", dscale);
+  ctx.out("Bias@GRAD", dbias);
+}
+
 void scaleKernel(BuildCtx& ctx) {
   xla::XlaOp x = ctx.in("X");
   double scale = ctx.attrF("scale", 1.0);
@@ -656,6 +965,13 @@ REGISTER_XLA_KERNEL("reshape2", reshape2Kernel);
 REGISTER_XLA_KERNEL("reshape2_grad", reshape2GradKernel);
 REGISTER_XLA_KERNEL("momentum", momentumKernel);
 REGISTER_XLA_KERNEL("adam", adamKernel);
+REGISTER_XLA_KERNEL("conv2d", conv2dKernel);
+REGISTER_XLA_KERNEL("conv2d_grad", conv2dGradKernel);
+REGISTER_XLA_KERNEL("depthwise_conv2d", conv2dKernel);
+REGISTER_XLA_KERNEL("pool2d", pool2dKernel);
+REGISTER_XLA_KERNEL("pool2d_grad", pool2dGradKernel);
+REGISTER_XLA_KERNEL("batch_norm", batchNormKernel);
+REGISTER_XLA_KERNEL("batch_norm_grad", batchNormGradKernel);
 
 // ---------------------------------------------------------------------------
 // block -> XlaComputation (the Executor's _build_step_fn, natively)
@@ -733,11 +1049,14 @@ void printJsonNumber(double v) {
 
 int main(int argc, char** argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: xla_train <artifact_dir> <steps>\n");
+    fprintf(stderr,
+            "usage: xla_train <artifact_dir> <steps>\n"
+            "       xla_train <artifact_dir> --hlo <out_path>\n");
     return 2;
   }
   const std::string dir = argv[1];
-  const int steps = atoi(argv[2]);
+  const bool hlo_mode = std::string(argv[2]) == "--hlo";
+  const int steps = hlo_mode ? 0 : atoi(argv[2]);
 
   bool ok = false;
   std::string err;
@@ -758,6 +1077,19 @@ int main(int argc, char** argv) {
   // THE point of this binary: the XLA computation is built here, in
   // C++, by per-op registry kernels over the native ProgramDesc
   xla::XlaComputation comp = buildTrainStep(*prog, *manifest);
+
+  if (hlo_mode) {
+    // dump the natively-built computation as a serialized
+    // HloModuleProto; the Python Executor (FLAGS_native_build)
+    // converts it to StableHLO and compiles/executes it in-process
+    if (argc < 4) fail("--hlo needs an output path");
+    std::string blob = comp.proto().SerializeAsString();
+    std::ofstream out(argv[3], std::ios::binary);
+    if (!out) fail(std::string("cannot write ") + argv[3]);
+    out.write(blob.data(),
+              static_cast<std::streamsize>(blob.size()));
+    return 0;
+  }
 
   auto* platform = xla::PlatformUtil::GetPlatform("Host").value();
   xla::LocalClientOptions copts(platform);

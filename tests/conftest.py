@@ -49,6 +49,8 @@ SLOW_MODULES = {
     "test_dgc_gradmerge",
     "test_structural_sharding",
     "test_ring_attention",
+    "test_moe_program",          # ep-vs-dense parity sweeps
+    "test_pallas_attention",     # interpret-mode kernel sweeps
 }
 
 
