@@ -476,10 +476,32 @@ BENCHES = [("transformer", bench_transformer),
            ("ctr", bench_ctr),
            ("mnist", bench_mnist)]
 
+def bench_transformer_fused():
+    """Transformer-base with the whole-layer fused attention block
+    (PADDLE_TPU_FUSE_ATTN_BLOCK=1 -> ops/pallas/attention_block.py):
+    the PERF.md MFU lever, prepped in r5 while the tunnel was down.
+    A/B recipe when the chip returns:
+        python bench.py transformer        # unfused baseline
+        python bench.py transformer_fused  # fused block
+    Same params/init/math (tests/test_attention_block.py), so the
+    tokens/s and mfu fields are directly comparable."""
+    import os
+
+    os.environ["PADDLE_TPU_FUSE_ATTN_BLOCK"] = "1"
+    try:
+        res = bench_transformer()
+    finally:
+        os.environ.pop("PADDLE_TPU_FUSE_ATTN_BLOCK", None)
+    res["metric"] = "transformer_fused_train_tokens_per_sec_per_chip"
+    res["lowering"] = "fused-attention-block"
+    return res
+
+
 # opt-in configs (argv-selectable only; never in the driver's default
 # window)
 EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
-                 "moe_transformer": bench_moe_transformer}
+                 "moe_transformer": bench_moe_transformer,
+                 "transformer_fused": bench_transformer_fused}
 
 
 def _probe_backend(timeout_s=180):

@@ -1387,6 +1387,42 @@ def _triple(v):
     return [v, v, v]
 
 
+def attention_block(x, n_heads, causal=False, scale=None,
+                    param_attr_qkv=None, param_attr_out=None,
+                    name=None):
+    """Whole-layer fused self-attention sub-layer (no dropout, no
+    projection biases, residual outside): ONE op replacing the
+    qkv-fc/split/reshape/attention/reshape/out-fc sequence so the
+    pallas kernel (ops/pallas/attention_block.py) can keep every
+    intermediate in VMEM. Route multi_head_attention through it with
+    PADDLE_TPU_FUSE_ATTN_BLOCK=1 (A/B knob; PERF.md)."""
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("attention_block", input=x,
+                         param_attr=param_attr_qkv, name=name)
+    d = int(x.shape[-1])
+    if d % n_heads:
+        raise ValueError(
+            f"attention_block: d_model {d} not divisible by "
+            f"n_heads {n_heads}")
+    w_qkv = helper.create_parameter(
+        ParamAttr._to_attr(param_attr_qkv), [d, 3 * d], x.dtype)
+    w_o = helper.create_parameter(
+        ParamAttr._to_attr(param_attr_out), [d, d], x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "attention_block", {"X": x, "WQKV": w_qkv, "WO": w_o},
+        {"Out": out},
+        {"n_heads": int(n_heads),
+         "scale": float(scale if scale is not None
+                        else (d // n_heads) ** -0.5),
+         "causal": bool(causal)})
+    return out
+
+
+__all__.append("attention_block")
+
+
 def attention(q, k, v, causal=False, scale=None, dropout_rate=0.0,
               is_test=False, layout="bhtd", name=None):
     """Fused scaled-dot-product attention -- the framework's

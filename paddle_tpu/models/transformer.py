@@ -48,6 +48,22 @@ def multi_head_attention(q_in, kv_in, d_model, n_heads, dropout_rate,
     def _proj_attr(tag):
         return _attn_proj_attr(name, tag, d_model)
 
+    import os
+
+    if (q_in is kv_in and not is_test and dropout_rate == 0.0
+            and os.environ.get("PADDLE_TPU_FUSE_ATTN_BLOCK") == "1"):
+        # not is_test: decode programs keep the unfused path (their
+        # While-loop bodies and cache-friendly shapes are validated
+        # against the op composition, not the pallas kernel)
+        # whole-layer fused sub-layer (PERF.md MFU lever): same params
+        # (names + Xavier fans), same math, ONE op — A/B against the
+        # unfused path by flipping the env var
+        return layers.attention_block(
+            q_in, n_heads, causal=causal,
+            param_attr_qkv=_proj_attr("qkv"),
+            param_attr_out=f"{name}_out.w" if name else None,
+            name=name)
+
     if q_in is kv_in:
         qkv = layers.fc(q_in, 3 * d_model, num_flatten_dims=2,
                         bias_attr=False, param_attr=_proj_attr("qkv"))

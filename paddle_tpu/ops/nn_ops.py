@@ -966,6 +966,33 @@ def mean_iou(ctx):
 # exists only as a layer composition (reference nets.py
 # scaled_dot_product_attention) -- so this op is a TPU-first upgrade.
 # --------------------------------------------------------------------------
+@register_op("attention_block")
+def attention_block_op(ctx):
+    """Whole-layer fused self-attention sub-layer: ONE op for
+    x @ Wqkv -> split-heads SDPA -> merge -> @ Wo (the PERF.md
+    whole-layer-fusion lever; kernel in ops/pallas/attention_block.py).
+    Replaces the 7-op sequence multi_head_attention otherwise emits;
+    grads come from the generic vjp, which flows through the kernel's
+    custom_vjp (saved-P backward, zero exps)."""
+    x = ctx.input("X")
+    wqkv = ctx.input("WQKV")
+    wo = ctx.input("WO")
+    n_heads = int(ctx.attr("n_heads"))
+    scale = ctx.attr("scale", None)
+    if scale is None:
+        scale = (x.shape[-1] // n_heads) ** -0.5
+    causal = ctx.attr("causal", False)
+    from .pallas import attention_block as AB
+
+    if AB.usable(x, wqkv, n_heads):
+        out = AB.attention_block(x, wqkv, wo, n_heads, float(scale),
+                                 bool(causal))
+    else:
+        out = AB.attention_block_reference(x, wqkv, wo, n_heads,
+                                           float(scale), bool(causal))
+    return {"Out": out}
+
+
 @register_op("attention", needs_rng=True)
 def attention(ctx):
     """layout attr: 'bhtd' (default) or 'bthd'. The bthd form takes

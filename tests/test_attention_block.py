@@ -1,0 +1,180 @@
+"""Whole-layer fused attention block (ops/pallas/attention_block.py +
+the `attention_block` op/layer): the PERF.md MFU lever, prepped so the
+on-chip A/B is a 10-minute job (VERDICT r4 next #2). Kernel parity is
+tested in pallas interpret mode; the op/layer path is tested through
+the Executor against the unfused 7-op composition."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.pallas import attention as fa
+from paddle_tpu.ops.pallas import attention_block as AB
+
+
+@pytest.fixture
+def interp():
+    fa.force_interpret(True)
+    yield
+    fa.force_interpret(False)
+
+
+def _mk(b=4, t=16, d=32, dtype=jnp.float32, seed=0):
+    r = np.random.RandomState(seed)
+    x = jnp.asarray(r.randn(b, t, d).astype(np.float32), dtype)
+    wqkv = jnp.asarray(
+        (r.randn(d, 3 * d) / np.sqrt(d)).astype(np.float32), dtype)
+    wo = jnp.asarray(
+        (r.randn(d, d) / np.sqrt(d)).astype(np.float32), dtype)
+    return x, wqkv, wo
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_reference(self, interp, causal):
+        x, wqkv, wo = _mk()
+        scale = (32 // 4) ** -0.5
+        got = AB.attention_block(x, wqkv, wo, 4, scale, causal)
+        want = AB.attention_block_reference(x, wqkv, wo, 4, scale,
+                                            causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, interp, causal):
+        x, wqkv, wo = _mk(seed=3)
+        scale = (32 // 4) ** -0.5
+
+        def loss_k(x, wqkv, wo):
+            return jnp.sum(
+                AB.attention_block(x, wqkv, wo, 4, scale, causal) ** 2)
+
+        def loss_r(x, wqkv, wo):
+            return jnp.sum(
+                AB.attention_block_reference(
+                    x, wqkv, wo, 4, scale, causal) ** 2)
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, wqkv, wo)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, wqkv, wo)
+        # the kernel saves P in bf16 (the deliberate precision trade
+        # of the saved-P backward): errors scale with the grad
+        # magnitude, so the atol is scale-aware
+        for a, e in zip(gk, gr):
+            a, e = np.asarray(a), np.asarray(e)
+            np.testing.assert_allclose(
+                a, e, rtol=5e-2, atol=5e-3 * max(np.abs(e).max(), 1))
+
+    def test_bf16_io(self, interp):
+        x, wqkv, wo = _mk(dtype=jnp.bfloat16, seed=1)
+        got = AB.attention_block(x, wqkv, wo, 4, 0.125, True)
+        want = AB.attention_block_reference(x, wqkv, wo, 4, 0.125,
+                                            True)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=2e-2, atol=2e-2)
+
+    def test_usable_gate(self):
+        x, wqkv, wo = _mk()
+        os.environ["PADDLE_TPU_DISABLE_PALLAS_ATTN_BLOCK"] = "1"
+        try:
+            assert not AB.usable(x, wqkv, 4)
+        finally:
+            del os.environ["PADDLE_TPU_DISABLE_PALLAS_ATTN_BLOCK"]
+        # too-long sequences stay on the jnp path (VMEM ceiling)
+        xl = jnp.zeros((2, 1024, 32))
+        assert not AB.usable(xl, jnp.zeros((32, 96)), 4)
+
+
+def _fresh():
+    fluid._reset_global_scope()
+    from paddle_tpu import unique_name
+    unique_name.switch()
+
+
+def _build():
+    from paddle_tpu.models import transformer as T
+
+    main, startup, cost = T.build_program(
+        seq_len=8, d_model=32, n_heads=2, n_layers=2, d_inner=64,
+        vocab=64, dropout_rate=0.0, learning_rate=1.0,
+        warmup_steps=40)
+    main._seed = 5
+    return main, startup, cost
+
+
+def _losses(fused, steps=5):
+    _fresh()
+    if fused:
+        os.environ["PADDLE_TPU_FUSE_ATTN_BLOCK"] = "1"
+    try:
+        main, startup, cost = _build()
+    finally:
+        os.environ.pop("PADDLE_TPU_FUSE_ATTN_BLOCK", None)
+    r = np.random.RandomState(0)
+    feed = {k: r.randint(1, 64, (8, 8)).astype(np.int64)
+            for k in ("src_ids", "tgt_ids", "label")}
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    out = []
+    for _ in range(steps):
+        l, = exe.run(main, feed=feed, fetch_list=[cost], scope=sc)
+        out.append(float(np.asarray(l).reshape(-1)[0]))
+    return out, main
+
+
+class TestModelIntegration:
+    def test_fused_route_emits_one_op_per_self_attention(self):
+        _, fused_main = None, None
+        os.environ["PADDLE_TPU_FUSE_ATTN_BLOCK"] = "1"
+        try:
+            _fresh()
+            fused_main, _, _ = _build()
+        finally:
+            os.environ.pop("PADDLE_TPU_FUSE_ATTN_BLOCK", None)
+        types = [op.type for op in fused_main.global_block.ops]
+        # 2 enc self + 2 dec self = 4 fused ops; cross-attention stays
+        # on the unfused path (separate q / kv sources)
+        assert types.count("attention_block") == 4
+        assert types.count("attention") == 2  # cross only
+
+    def test_fused_matches_unfused_through_training(self):
+        base, _ = _losses(False)
+        got, _ = _losses(True)
+        np.testing.assert_allclose(got, base, rtol=5e-4, atol=5e-5)
+
+    def test_dropout_and_decode_builds_stay_unfused(self):
+        """dropout>0 and is_test builds keep the unfused path (the
+        kernel has no dropout; decode While-loop bodies are validated
+        against the op composition); the flag must not leak."""
+        from paddle_tpu.models import transformer as T
+
+        _fresh()
+        os.environ["PADDLE_TPU_FUSE_ATTN_BLOCK"] = "1"
+        try:
+            main, _, _ = T.build_program(
+                seq_len=8, d_model=32, n_heads=2, n_layers=1,
+                d_inner=64, vocab=64, dropout_rate=0.1,
+                learning_rate=1.0, warmup_steps=40)
+            types = [op.type for op in main.global_block.ops]
+            assert types.count("attention_block") == 0
+            # is_test=True (decode-style build) declines too
+            _fresh()
+            prog, startup = None, None
+            import paddle_tpu as fl
+            prog, startup = fl.Program(), fl.Program()
+            with fl.program_guard(prog, startup):
+                x = fl.layers.data("x", shape=[8, 32],
+                                   dtype="float32")
+                T.multi_head_attention(x, x, 32, 2, 0.0,
+                                       causal=True, is_test=True,
+                                       name="t")
+            types = [op.type for op in prog.global_block.ops]
+            assert types.count("attention_block") == 0
+        finally:
+            os.environ.pop("PADDLE_TPU_FUSE_ATTN_BLOCK", None)
