@@ -1,0 +1,64 @@
+"""One-shot round-5 bench capture (run the MOMENT the tunnel is back):
+
+    python capture_bench_r05.py
+
+Runs the 5 BASELINE configs plus the three opt-in configs
+(transformer_scan, transformer_fused, moe_transformer) SEQUENTIALLY in
+separate processes (one TPU claim at a time, per the tunnel rules) and
+writes every JSON line to BENCH_SELF_r05.json. Each sub-run inherits
+bench.py's fail-fast probe, so a dead tunnel costs 180 s, not a hang.
+
+The transformer vs transformer_fused pair is the whole-layer-fusion
+A/B PERF.md describes — record BOTH numbers.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "BENCH_SELF_r05.json")
+
+
+def run(args):
+    print(f"# capture: python bench.py {' '.join(args)}",
+          file=sys.stderr, flush=True)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "bench.py"), *args],
+        capture_output=True, text=True, timeout=3600)
+    sys.stderr.write(proc.stderr)
+    lines = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            lines.append(json.loads(line))
+    return proc.returncode, lines
+
+
+def main():
+    results = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+               "runs": []}
+    rc, lines = run([])  # the 5 BASELINE configs
+    results["default_rc"] = rc
+    results["runs"] += lines
+    if rc == 3:
+        print("# capture: backend dead (rc=3); writing probe record",
+              file=sys.stderr)
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+        return 3
+    for extra in ("transformer_scan", "transformer_fused",
+                  "moe_transformer"):
+        rc_e, lines_e = run([extra])
+        results["runs"] += lines_e
+        results[f"{extra}_rc"] = rc_e
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
+    print(f"# capture: wrote {OUT} with {len(results['runs'])} "
+          f"result lines", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
