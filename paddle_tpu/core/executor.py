@@ -32,7 +32,10 @@ from .registry import get_op_info, is_registered, run_op, EMPTY_VAR
 from .scope import Scope, global_scope
 from .types import to_np_dtype
 
-_SKIP_OP_TYPES = ("feed", "fetch")
+# feed/fetch are plumbing; `go` (reference operators/csp/go_op.cc) is
+# a host-side detached-thread launcher that cannot live inside the
+# traced XLA program — Executor.run fires it separately
+_SKIP_OP_TYPES = ("feed", "fetch", "go")
 
 RNG_VAR = "@RNG@"
 
@@ -447,6 +450,92 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._go_threads = []
+
+    # ------------------------------------------------------------------
+    def _launch_go_ops(self, block, scope, feed_arrays):
+        """Fire each `go` op's sub-block on a detached thread against
+        a SNAPSHOT env (reference go_op.cc RunImpl: child scope,
+        inputs copied in, scope dropped when the thread ends). Thread
+        handles are kept on the executor so tests can join; the
+        reference detaches outright."""
+        import threading
+
+        self._go_threads = [
+            t for t in getattr(self, "_go_threads", [])
+            if t.is_alive()]
+        producer = {}
+        for op in block.ops:
+            if op.type in _SKIP_OP_TYPES:
+                continue
+            for n in op.output_arg_names:
+                producer.setdefault(n, op)
+        for op in block.ops:
+            if op.type != "go":
+                continue
+            sub = op.attrs["sub_block"]
+            env = {}
+            # a go input may be a main-block INTERMEDIATE: under the
+            # traced executor those never materialize in the scope, so
+            # the thread recomputes the (deterministic) producing
+            # chain from scope/feed roots — observably the value the
+            # reference's eager executor would have found in the scope
+            prefix, stack, seen = [], list(op.inputs.get("X", [])), set()
+            while stack:
+                n = stack.pop()
+                if n in seen:
+                    continue
+                seen.add(n)
+                v = feed_arrays.get(n)
+                if v is None:
+                    v = scope._get(n)
+                if v is not None:
+                    # COPY, on device: the step jit donates state
+                    # buffers (donate_argnums), so a bare reference
+                    # would be a deleted buffer by the time the
+                    # thread reads it. jnp.array(copy=True) stays a
+                    # device-device copy — no host round-trip.
+                    env[n] = jnp.array(v, copy=True)
+                    continue
+                p = producer.get(n)
+                if p is None:
+                    raise RuntimeError(
+                        f"go: input var {n!r} is neither fed, in the "
+                        f"scope, nor produced by the block")
+                if p.type in ("py_func", "print"):
+                    raise RuntimeError(
+                        f"go: captured var {n!r} is produced by the "
+                        f"host-effecting op {p.type!r}; recomputing "
+                        f"it in the go thread would double its side "
+                        f"effects. Route the value through a "
+                        f"persistable var instead.")
+                prefix.append(p)
+                stack.extend(x for x in p.input_arg_names
+                             if x != EMPTY_VAR)
+            order = {id(o): i for i, o in enumerate(block.ops)}
+            prefix = sorted({id(p): p for p in prefix}.values(),
+                            key=lambda o: order[id(o)])
+            salt = getattr(op, "_uid", 0)
+
+            def worker(sub=sub, env=env, prefix=tuple(prefix),
+                       salt=salt):
+                try:
+                    cell = [jax.random.PRNGKey(_global_seed[0] + salt)]
+                    for o in prefix:
+                        run_op(o, env, rng_cell=cell, rng_salt=o._uid)
+                    for o in sub.ops:
+                        run_op(o, env, rng_cell=cell, rng_salt=o._uid)
+                    # env discarded: the reference destroys the child
+                    # scope when the thread finishes
+                except Exception as e:  # fire-and-forget, but LOUD
+                    import warnings
+
+                    warnings.warn(
+                        f"go thread failed: {type(e).__name__}: {e}")
+
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            self._go_threads.append(t)
 
     # ------------------------------------------------------------------
     def run(self, program: Optional[Program] = None, feed=None,
@@ -516,6 +605,9 @@ class Executor:
             if device is not None and not isinstance(arr, jax.Array):
                 arr = jax.device_put(arr, device)
             feed_arrays[name] = arr
+
+        if any(op.type == "go" for op in block.ops):
+            self._launch_go_ops(block, scope, feed_arrays)
 
         from .. import amp
         from ..flags import FLAGS

@@ -21,7 +21,7 @@ __all__ = ["less_than", "less_equal", "greater_than", "greater_equal",
            "logical_xor", "logical_not", "While", "Switch", "cond",
            "increment", "array_write", "array_read", "array_length",
            "create_array", "StaticRNN", "DynamicRNN", "IfElse",
-           "less_than_value"]
+           "less_than_value", "Go"]
 
 
 def _cmp_layer(op_type):
@@ -121,6 +121,9 @@ class _WhileBlockGuard:
 
     def __exit__(self, exc_type, exc_val, exc_tb):
         if exc_type is not None:
+            # same orphaned-sub-block hazard as Go.__exit__: restore
+            # the parent block before propagating
+            self.w._program.rollback()
             return False
         prog = self.w._program
         sub = prog.current_block()
@@ -156,6 +159,66 @@ class _WhileBlockGuard:
             {"Out": carried},
             {"sub_block": sub, "carried": carried,
              "externals": externals})
+        return False
+
+
+class Go:
+    """CSP go block (reference operators/csp/go_op.cc:28 GoOp): launch
+    the block's ops on a DETACHED thread against a snapshot of the
+    enclosing scope, fire-and-forget. The reference at this version
+    keeps the op with no channel surface left in the Python API, so a
+    Go block can only matter through host-side-effecting ops
+    (py_func / print / save) — implemented faithfully at that scope:
+    the Executor runs `go` ops on the HOST at run() time (a thread
+    launcher cannot live inside the traced XLA program; the op is
+    skip-listed like feed/fetch) and the thread's env is discarded on
+    exit, mirroring the reference's destroyed child scope.
+
+    Documented deviations from the eager reference (the whole block is
+    ONE traced program here, so there is no per-op scope to read):
+
+    * the snapshot is taken at run() START — state mutated later in
+      the same step (optimizer updates) is seen pre-update;
+    * a captured main-block INTERMEDIATE is recomputed inside the
+      thread from scope/feed roots; recomputed sampling ops draw
+      fresh noise, and host-effecting producers are refused with a
+      named error (route such values through persistables instead).
+
+    Usage::
+
+        with fluid.layers.Go():
+            layers.py_func(log_fn, x, out=sink)
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("go", name=name)
+        self._program = default_main_program()
+
+    def __enter__(self):
+        self._block = self._program.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            # leave the program pointed at the PARENT block, not the
+            # half-built sub-block, or every later layer silently
+            # lands inside the orphaned Go body
+            self._program.rollback()
+            return False
+        prog = self._program
+        sub = prog.current_block()
+        prog.rollback()
+        parent = prog.current_block()
+        local = set()
+        externals = []
+        for op in sub.ops:
+            for n in op.input_arg_names:
+                if (n not in local and n not in externals
+                        and parent._find_var_recursive(n) is not None):
+                    externals.append(n)
+            local.update(op.output_arg_names)
+        parent.append_op("go", {"X": sorted(externals)}, {},
+                         {"sub_block": sub})
         return False
 
 

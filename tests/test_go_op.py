@@ -1,0 +1,134 @@
+"""CSP `go` op (reference operators/csp/go_op.cc GoOp — the last
+missing-list item from VERDICT r4): a Go block's ops run on a detached
+thread against a snapshot of the scope, fire-and-forget, while the
+main program runs normally. The reference at this version has no
+channel surface left, so host-side-effecting ops (py_func) are the
+observable contract."""
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _wait_threads(exe, timeout=10.0):
+    for t in getattr(exe, "_go_threads", []):
+        t.join(timeout)
+        assert not t.is_alive(), "go thread did not finish"
+
+
+class TestGoOp:
+    def test_go_block_runs_on_thread_with_scope_snapshot(self):
+        seen = []
+
+        def record(arr):
+            seen.append(np.asarray(arr).copy())
+            return np.asarray(arr)
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.scale(x, scale=2.0)
+            with fluid.layers.Go():
+                doubled = fluid.layers.scale(y, scale=3.0)
+                sink = prog.current_block().create_var(
+                    name="go_sink", shape=[-1, 4], dtype="float32")
+                fluid.layers.py_func(record, doubled, out=sink)
+            loss = fluid.layers.mean(y)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        xs = np.arange(8, dtype=np.float32).reshape(2, 4)
+        out, = exe.run(prog, feed={"x": xs}, fetch_list=[loss],
+                       scope=sc)
+        # main program unaffected by the go block
+        np.testing.assert_allclose(float(np.asarray(out).reshape(-1)[0]),
+                                   2.0 * xs.mean(), rtol=1e-6)
+        _wait_threads(exe)
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], 6.0 * xs, rtol=1e-6)
+
+    def test_go_env_is_discarded(self):
+        """Writes inside the Go block must NOT leak into the scope
+        (the reference destroys the thread's child scope)."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            with fluid.layers.Go():
+                fluid.layers.scale(x, scale=5.0)
+            loss = fluid.layers.mean(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss], scope=sc)
+        _wait_threads(exe)
+        go_op = next(op for op in prog.global_block.ops
+                     if op.type == "go")
+        sub = go_op.attrs["sub_block"]
+        for op in sub.ops:
+            for n in op.output_arg_names:
+                assert sc._get(n) is None, n
+
+    def test_go_survives_state_donation_across_steps(self):
+        """The snapshot must COPY donated state buffers: a Go block
+        capturing an activation computed from trainable params runs
+        every step while the jitted step donates those params'
+        buffers (regression: bare references died silently)."""
+        logged = []
+
+        def log(arr):
+            logged.append(np.asarray(arr).copy())
+            return np.asarray(arr)
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            logits = fluid.layers.fc(x, 3)
+            with fluid.layers.Go():
+                sink = prog.current_block().create_var(
+                    name="sink3", shape=[-1, 3], dtype="float32")
+                fluid.layers.py_func(log, logits, out=sink)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.Adam(0.05).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        r = np.random.RandomState(0)
+        feed = {"x": r.randn(16, 8).astype(np.float32),
+                "y": r.randint(0, 3, (16, 1)).astype(np.int64)}
+        for _ in range(8):
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=sc)
+        _wait_threads(exe)
+        assert len(logged) == 8
+        # and they track training (params changed between snapshots)
+        assert np.abs(logged[-1] - logged[0]).max() > 0
+
+    def test_go_fires_every_run(self):
+        calls = []
+
+        def bump(arr):
+            calls.append(1)
+            return np.asarray(arr)
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[2], dtype="float32")
+            with fluid.layers.Go():
+                sink = prog.current_block().create_var(
+                    name="sink2", shape=[-1, 2], dtype="float32")
+                fluid.layers.py_func(bump, x, out=sink)
+            loss = fluid.layers.mean(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        for _ in range(3):
+            exe.run(prog, feed={"x": np.ones((2, 2), np.float32)},
+                    fetch_list=[loss], scope=sc)
+        deadline = time.time() + 10
+        while len(calls) < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        _wait_threads(exe)
+        assert len(calls) == 3
