@@ -40,6 +40,7 @@
 #include "xla/client/local_client.h"
 #include "xla/hlo/builder/lib/arithmetic.h"
 #include "xla/hlo/builder/lib/constants.h"
+#include "xla/hlo/builder/lib/slicing.h"
 #include "xla/hlo/builder/xla_builder.h"
 #include "xla/hlo/builder/xla_computation.h"
 #include "xla/literal.h"
@@ -386,15 +387,13 @@ xla::XlaOp oneHot(BuildCtx& ctx, xla::XlaOp lab,
 }
 
 void swceKernel(BuildCtx& ctx) {
-  // hard-label reduction form: loss = lse(logits) - logits[label]
-  // (ops/nn_ops.py softmax_with_cross_entropy; soft_label and label
-  // smoothing are out of this native slice's scope)
+  // hard-label reduction form with label smoothing
+  // (ops/nn_ops.py softmax_with_cross_entropy):
+  //   loss = (1-eps)*(lse - logits[label]) + eps*(lse - mean(logits))
   if (ctx.attrB("soft_label", false))
     fail("softmax_with_cross_entropy: soft_label not supported "
          "in the native builder yet");
-  if (ctx.attrF("label_smooth_eps", 0.0) != 0.0)
-    fail("softmax_with_cross_entropy: label smoothing not supported "
-         "in the native builder yet");
+  double eps = ctx.attrF("label_smooth_eps", 0.0);
   xla::XlaOp logits = ctx.in("Logits");
   xla::XlaOp lf = xla::ConvertElementType(logits, xla::F32);
   auto dims = ctx.shapeOf(logits);
@@ -404,11 +403,24 @@ void swceKernel(BuildCtx& ctx) {
   // picked[label] as a masked sum — adds exact zeros, so it equals
   // the gather the Python kernel uses
   int64_t last = static_cast<int64_t>(dims.size()) - 1;
+  auto addc = xla::CreateScalarAddComputation(xla::F32, ctx.b);
   xla::XlaOp picked = xla::Reduce(
       xla::Select(oh, lf, xla::ZerosLike(lf)),
-      xla::ConstantR0<float>(ctx.b, 0.0f),
-      xla::CreateScalarAddComputation(xla::F32, ctx.b), {last});
+      xla::ConstantR0<float>(ctx.b, 0.0f), addc, {last});
   xla::XlaOp loss = xla::Sub(lse, picked);
+  if (eps != 0.0) {
+    xla::XlaOp mean = xla::Div(
+        xla::Reduce(lf, xla::ConstantR0<float>(ctx.b, 0.0f), addc,
+                    {last}),
+        xla::ConstantR0<float>(ctx.b,
+                               static_cast<float>(dims[last])));
+    xla::XlaOp uniform = xla::Sub(lse, mean);
+    loss = xla::Add(
+        xla::Mul(loss, xla::ConstantR0<float>(
+            ctx.b, static_cast<float>(1.0 - eps))),
+        xla::Mul(uniform, xla::ConstantR0<float>(
+            ctx.b, static_cast<float>(eps))));
+  }
   loss = xla::Select(li.valid, loss, xla::ZerosLike(loss));
   std::vector<int64_t> loss_dims(dims.begin(), dims.end() - 1);
   loss_dims.push_back(1);
@@ -419,9 +431,9 @@ void swceKernel(BuildCtx& ctx) {
 }
 
 void swceGradKernel(BuildCtx& ctx) {
-  if (ctx.attrB("soft_label", false) ||
-      ctx.attrF("label_smooth_eps", 0.0) != 0.0)
-    fail("softmax_with_cross_entropy_grad: unsupported variant");
+  if (ctx.attrB("soft_label", false))
+    fail("softmax_with_cross_entropy_grad: soft_label unsupported");
+  double eps = ctx.attrF("label_smooth_eps", 0.0);
   xla::XlaOp logits = ctx.in("Logits");
   xla::XlaOp lf = xla::ConvertElementType(logits, xla::F32);
   auto dims = ctx.shapeOf(logits);
@@ -436,13 +448,21 @@ void swceGradKernel(BuildCtx& ctx) {
   std::vector<int64_t> lead_map;
   for (int64_t i = 0; i < last; ++i) lead_map.push_back(i);
   xla::XlaOp lse = logsumexpLast(ctx, lf);
+  xla::XlaOp dloss_b = xla::BroadcastInDim(dloss, dims, lead_map);
   xla::XlaOp p_scaled =
-      xla::Mul(xla::Exp(xla::Sub(lf, lse, lead_map)),
-               xla::BroadcastInDim(dloss, dims, lead_map));
+      xla::Mul(xla::Exp(xla::Sub(lf, lse, lead_map)), dloss_b);
   xla::XlaOp oh = oneHot(ctx, li.lab, dims);
-  xla::XlaOp hit = xla::BroadcastInDim(dloss, dims, lead_map);
+  // smoothed target: grad = p*dl - (eps/V)*dl - onehot*(1-eps)*dl
+  // (ops/nn_ops.py _swce grad, fused-smoothing form)
+  xla::XlaOp hit = xla::Mul(
+      dloss_b, xla::ConstantR0<float>(
+          ctx.b, static_cast<float>(1.0 - eps)));
   xla::XlaOp grad =
       xla::Sub(p_scaled, xla::Select(oh, hit, xla::ZerosLike(hit)));
+  if (eps != 0.0)
+    grad = xla::Sub(grad, xla::Mul(
+        dloss_b, xla::ConstantR0<float>(
+            ctx.b, static_cast<float>(eps / dims[last]))));
   ctx.out("Logits@GRAD",
           xla::ConvertElementType(grad, ctx.typeOf(logits)));
 }
@@ -927,6 +947,461 @@ void batchNormGradKernel(BuildCtx& ctx) {
   ctx.out("Bias@GRAD", dbias);
 }
 
+// ---------------------------------------------------------------------------
+// transformer-slice kernels (semantics mirror ops/nn_ops.py _sdpa /
+// layer_norm, ops/tensor_ops.py lookup_table/split, and the lr-chain
+// ops; grads mirror the jax vjp the Python path derives)
+// ---------------------------------------------------------------------------
+int64_t inCount(BuildCtx& ctx, const std::string& slot) {
+  const auto* names = ctx.inNames(slot);
+  return names ? static_cast<int64_t>(names->size()) : 0;
+}
+
+void lookupTableKernel(BuildCtx& ctx) {
+  xla::XlaOp w = ctx.in("W"), ids = ctx.in("Ids");
+  auto idd = ctx.shapeOf(ids);
+  auto wd = ctx.shapeOf(w);
+  // ONE trailing-1 id axis is squeezed when rank >= 2 ([B,1] ids ->
+  // [B,D]; mirrors ops/nn_ops.py lookup_table exactly — [B,1,1]
+  // gives [B,1,D], not [B,D])
+  std::vector<int64_t> out_lead(idd.begin(), idd.end());
+  if (out_lead.size() >= 2 && out_lead.back() == 1)
+    out_lead.pop_back();
+  int64_t n = numel(idd);
+  xla::XlaOp flat = xla::Reshape(
+      xla::ConvertElementType(ids, xla::S32), {n});
+  int64_t pad = ctx.attrI("padding_idx", -1);
+  xla::XlaOp gather_ids = flat;
+  if (pad >= 0)  // clamp so the gather is in-bounds, then zero rows
+    gather_ids = xla::Max(flat, xla::ConstantR0<int32_t>(ctx.b, 0));
+  xla::XlaOp rows = xla::TorchIndexSelect(w, gather_ids, 0);  // [n,D]
+  if (pad >= 0) {
+    xla::XlaOp keep = xla::Ne(
+        flat, xla::ConstantR0<int32_t>(ctx.b,
+                                       static_cast<int32_t>(pad)));
+    xla::XlaOp keep_b = xla::BroadcastInDim(
+        keep, {n, wd[1]}, {0});
+    rows = xla::Select(keep_b, rows, xla::ZerosLike(rows));
+  }
+  std::vector<int64_t> out_dims(out_lead);
+  out_dims.push_back(wd[1]);
+  ctx.out("Out", xla::Reshape(rows, out_dims));
+}
+
+void lookupTableGradKernel(BuildCtx& ctx) {
+  // dW = zeros_like(W).at[ids].add(dOut) — a real scatter-add, the
+  // same dataflow the Python kernel lowers to (an [n,V] one-hot
+  // matmul would be exactly the [N,V]-buffer blowup PERF.md warns
+  // about at 32k vocab)
+  xla::XlaOp w = ctx.in("W"), ids = ctx.in("Ids");
+  xla::XlaOp dout = ctx.in("Out@GRAD");
+  auto wd = ctx.shapeOf(w);
+  auto idd = ctx.shapeOf(ids);
+  int64_t n = numel(idd);
+  int64_t V = wd[0], D = wd[1];
+  auto w_ty = ctx.typeOf(w);
+  xla::XlaOp flat = xla::Reshape(
+      xla::ConvertElementType(ids, xla::S32), {n});
+  xla::XlaOp d2 = xla::ConvertElementType(
+      xla::Reshape(dout, {n, D}), w_ty);
+  int64_t pad = ctx.attrI("padding_idx", -1);
+  if (pad >= 0) {
+    xla::XlaOp keep = xla::BroadcastInDim(
+        xla::Ne(flat, xla::ConstantR0<int32_t>(
+            ctx.b, static_cast<int32_t>(pad))), {n, D}, {0});
+    d2 = xla::Select(keep, d2, xla::ZerosLike(d2));
+  }
+  xla::XlaOp zeros = xla::Broadcast(xla::Zero(ctx.b, w_ty), {V, D});
+  xla::ScatterDimensionNumbers sd;
+  sd.add_update_window_dims(1);
+  sd.add_inserted_window_dims(0);
+  sd.add_scatter_dims_to_operand_dims(0);
+  sd.set_index_vector_dim(1);
+  xla::XlaOp dw = xla::Scatter(
+      zeros, xla::Reshape(flat, {n, 1}), d2,
+      xla::CreateScalarAddComputation(w_ty, ctx.b), sd);
+  ctx.out("W@GRAD", dw);
+}
+
+void splitKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  auto xd = ctx.shapeOf(x);
+  int64_t axis = ctx.attrI("axis", 0);
+  if (axis < 0) axis += static_cast<int64_t>(xd.size());
+  const auto* outs = ctx.outNames("Out");
+  if (!outs) fail("split: no outputs");
+  const ptp::Attr* sec = ctx.op->findAttr("sections");
+  std::vector<int64_t> sizes;
+  if (sec && sec->tag == ptp::Attr::Tag::Ints && !sec->ints.empty())
+    sizes.assign(sec->ints.begin(), sec->ints.end());
+  else
+    sizes.assign(outs->size(), xd[axis] /
+                 static_cast<int64_t>(outs->size()));
+  int64_t off = 0;
+  for (size_t i = 0; i < outs->size(); ++i) {
+    ctx.out("Out", xla::SliceInDim(x, off, off + sizes[i], 1, axis),
+            static_cast<int>(i));
+    off += sizes[i];
+  }
+}
+
+void splitGradKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  auto xd = ctx.shapeOf(x);
+  int64_t axis = ctx.attrI("axis", 0);
+  if (axis < 0) axis += static_cast<int64_t>(xd.size());
+  const auto* names = ctx.inNames("Out@GRAD");
+  if (!names) fail("split_grad: missing Out@GRAD");
+  int64_t n = static_cast<int64_t>(names->size());
+  std::vector<xla::XlaOp> parts;
+  for (int64_t i = 0; i < n; ++i) {
+    // an output never reached by backprop arrives as @EMPTY@
+    // (backward.py substitutes it); synthesize zeros like the
+    // Python vjp kernels do
+    if ((*names)[i] == "@EMPTY@") {
+      std::vector<int64_t> pd(xd);
+      pd[axis] = xd[axis] / n;
+      parts.push_back(xla::Broadcast(
+          xla::Zero(ctx.b, ctx.typeOf(x)), pd));
+    } else {
+      parts.push_back(ctx.in("Out@GRAD", static_cast<int>(i)));
+    }
+  }
+  ctx.out("X@GRAD", xla::ConcatInDim(ctx.b, parts, axis));
+}
+
+void sumKernel(BuildCtx& ctx) {
+  int64_t n = inCount(ctx, "X");
+  xla::XlaOp acc = ctx.in("X", 0);
+  for (int64_t i = 1; i < n; ++i)
+    acc = xla::Add(acc, ctx.in("X", static_cast<int>(i)));
+  ctx.out("Out", acc);
+}
+
+void unsqueeze2Kernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  auto xd = ctx.shapeOf(x);
+  const ptp::Attr* a = ctx.op->findAttr("axes");
+  std::vector<int64_t> axes;
+  if (a && a->tag == ptp::Attr::Tag::Ints)
+    axes.assign(a->ints.begin(), a->ints.end());
+  std::vector<int64_t> dims(xd.begin(), xd.end());
+  for (int64_t ax : axes) {
+    if (ax < 0) ax += static_cast<int64_t>(dims.size()) + 1;
+    dims.insert(dims.begin() + ax, 1);
+  }
+  ctx.out("Out", xla::Reshape(x, dims));
+}
+
+void incrementKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  ctx.out("Out", xla::Add(x, xla::ScalarLike(
+      x, ctx.attrF("step", 1.0))));
+}
+
+void fillConstantKernel(BuildCtx& ctx) {
+  const ptp::Attr* sh = ctx.op->findAttr("shape");
+  std::vector<int64_t> dims;
+  if (sh && sh->tag == ptp::Attr::Tag::Ints)
+    dims.assign(sh->ints.begin(), sh->ints.end());
+  std::string dt = "float32";
+  const ptp::Attr* da = ctx.op->findAttr("dtype");
+  if (da && da->tag == ptp::Attr::Tag::String) dt = da->s;
+  xla::XlaOp v = xla::ConvertElementType(
+      xla::ConstantR0<double>(ctx.b, ctx.attrF("value", 0.0)),
+      dtypeToPrim(dt));
+  ctx.out("Out", xla::Broadcast(v, dims));
+}
+
+void rsqrtKernel(BuildCtx& ctx) {
+  ctx.out("Out", xla::Rsqrt(ctx.in("X")));
+}
+
+void rsqrtGradKernel(BuildCtx& ctx) {
+  // d rsqrt(x) = -0.5 * x^{-3/2}
+  xla::XlaOp x = ctx.in("X");
+  xla::XlaOp r = xla::Rsqrt(x);
+  ctx.out("X@GRAD", xla::Mul(
+      ctx.in("Out@GRAD"),
+      xla::Mul(xla::ScalarLike(x, -0.5),
+               xla::Div(r, x))));
+}
+
+void scaleGradKernel(BuildCtx& ctx) {
+  xla::XlaOp dout = ctx.in("Out@GRAD");
+  ctx.out("X@GRAD", xla::Mul(
+      dout, xla::ScalarLike(dout, ctx.attrF("scale", 1.0))));
+}
+
+void maxKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
+  ctx.out("Out", xla::Max(x, broadcastY(ctx, x, y,
+                                        ctx.attrI("axis", -1),
+                                        nullptr)));
+}
+
+void minKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
+  ctx.out("Out", xla::Min(x, broadcastY(ctx, x, y,
+                                        ctx.attrI("axis", -1),
+                                        nullptr)));
+}
+
+void assignValueKernel(BuildCtx& ctx) {
+  const ptp::Attr* a = ctx.op->findAttr("values");
+  if (!a || a->tag != ptp::Attr::Tag::NdArray)
+    fail("assign_value: missing ndarray 'values' attr");
+  xla::Shape shape = xla::ShapeUtil::MakeShape(
+      dtypeToPrim(a->nd_dtype), a->nd_dims);
+  xla::Literal lit(shape);
+  if (a->nd_data.size() != lit.size_bytes())
+    fail("assign_value: payload size mismatch");
+  std::memcpy(lit.untyped_data(), a->nd_data.data(),
+              a->nd_data.size());
+  ctx.out("Out", xla::ConstantLiteral(ctx.b, lit));
+}
+
+// ---- layer_norm (ops/nn_ops.py layer_norm: fp32 stats over the
+// trailing dims from begin_norm_axis; Mean/Variance output [lead]) --
+struct LnParts {
+  xla::XlaOp x2;    // [lead, m] f32
+  xla::XlaOp mean;  // [lead, 1]
+  xla::XlaOp var;   // [lead, 1] (jnp.var: centered, no eps)
+  int64_t lead, m;
+};
+
+LnParts lnStats(BuildCtx& ctx, xla::XlaOp x, int64_t begin) {
+  auto xd = ctx.shapeOf(x);
+  int64_t lead = 1, m = 1;
+  for (size_t i = 0; i < xd.size(); ++i) {
+    if (static_cast<int64_t>(i) < begin) lead *= xd[i];
+    else m *= xd[i];
+  }
+  xla::XlaOp x2 = xla::Reshape(
+      xla::ConvertElementType(x, xla::F32), {lead, m});
+  auto addc = xla::CreateScalarAddComputation(xla::F32, ctx.b);
+  xla::XlaOp mf = xla::ConstantR0<float>(
+      ctx.b, static_cast<float>(m));
+  xla::XlaOp mean = xla::Div(
+      xla::Reduce(x2, xla::ConstantR0<float>(ctx.b, 0.0f), addc, {1}),
+      mf);
+  xla::XlaOp mean_b = xla::BroadcastInDim(mean, {lead, m}, {0});
+  xla::XlaOp cen = xla::Sub(x2, mean_b);
+  xla::XlaOp var = xla::Div(
+      xla::Reduce(xla::Mul(cen, cen),
+                  xla::ConstantR0<float>(ctx.b, 0.0f), addc, {1}),
+      mf);
+  return {x2, xla::Reshape(mean, {lead, 1}),
+          xla::Reshape(var, {lead, 1}), lead, m};
+}
+
+void layerNormKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  auto xd = ctx.shapeOf(x);
+  double eps = ctx.attrF("epsilon", 1e-5);
+  int64_t begin = ctx.attrI("begin_norm_axis", 1);
+  LnParts p = lnStats(ctx, x, begin);
+  xla::XlaOp inv = xla::Rsqrt(xla::Add(
+      p.var, xla::ConstantR0<float>(ctx.b,
+                                    static_cast<float>(eps))));
+  xla::XlaOp y = xla::Mul(
+      xla::Sub(p.x2, xla::BroadcastInDim(
+          xla::Reshape(p.mean, {p.lead}), {p.lead, p.m}, {0})),
+      xla::BroadcastInDim(xla::Reshape(inv, {p.lead}),
+                          {p.lead, p.m}, {0}));
+  if (ctx.hasIn("Scale")) {
+    xla::XlaOp s = xla::Reshape(
+        xla::ConvertElementType(ctx.in("Scale"), xla::F32), {p.m});
+    y = xla::Mul(y, xla::BroadcastInDim(s, {p.lead, p.m}, {1}));
+  }
+  if (ctx.hasIn("Bias")) {
+    xla::XlaOp bb = xla::Reshape(
+        xla::ConvertElementType(ctx.in("Bias"), xla::F32), {p.m});
+    y = xla::Add(y, xla::BroadcastInDim(bb, {p.lead, p.m}, {1}));
+  }
+  ctx.out("Y", xla::ConvertElementType(
+      xla::Reshape(y, xd), ctx.typeOf(x)));
+  ctx.out("Mean", xla::Reshape(p.mean, {p.lead}));
+  ctx.out("Variance", xla::Reshape(p.var, {p.lead}));
+}
+
+void layerNormGradKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  xla::XlaOp dy = ctx.in("Y@GRAD");
+  auto xd = ctx.shapeOf(x);
+  double eps = ctx.attrF("epsilon", 1e-5);
+  int64_t begin = ctx.attrI("begin_norm_axis", 1);
+  LnParts p = lnStats(ctx, x, begin);
+  int64_t lead = p.lead, m = p.m;
+  auto bcL = [&](xla::XlaOp v) {  // [lead] -> [lead,m]
+    return xla::BroadcastInDim(v, {lead, m}, {0});
+  };
+  auto bcM = [&](xla::XlaOp v) {  // [m] -> [lead,m]
+    return xla::BroadcastInDim(v, {lead, m}, {1});
+  };
+  auto addc = xla::CreateScalarAddComputation(xla::F32, ctx.b);
+  xla::XlaOp inv = xla::Rsqrt(xla::Add(
+      xla::Reshape(p.var, {lead}),
+      xla::ConstantR0<float>(ctx.b, static_cast<float>(eps))));
+  xla::XlaOp xhat = xla::Mul(
+      xla::Sub(p.x2, bcL(xla::Reshape(p.mean, {lead}))), bcL(inv));
+  xla::XlaOp dy2 = xla::Reshape(
+      xla::ConvertElementType(dy, xla::F32), {lead, m});
+  xla::XlaOp zero = xla::ConstantR0<float>(ctx.b, 0.0f);
+  // dScale/dBias: reduce over the LEAD rows
+  if (ctx.hasIn("Scale")) {
+    xla::XlaOp ds = xla::Reduce(xla::Mul(dy2, xhat), zero, addc, {0});
+    ctx.out("Scale@GRAD", xla::ConvertElementType(
+        ds, ctx.typeOf(ctx.in("Scale"))));
+  }
+  xla::XlaOp db = xla::Reduce(dy2, zero, addc, {0});
+  if (ctx.hasIn("Bias"))
+    ctx.out("Bias@GRAD", xla::ConvertElementType(
+        db, ctx.typeOf(ctx.in("Bias"))));
+  // dX: standard LN backward with dyh = dy * scale
+  xla::XlaOp dyh = dy2;
+  if (ctx.hasIn("Scale")) {
+    xla::XlaOp s = xla::Reshape(
+        xla::ConvertElementType(ctx.in("Scale"), xla::F32), {m});
+    dyh = xla::Mul(dy2, bcM(s));
+  }
+  xla::XlaOp sum_dyh = xla::Reduce(dyh, zero, addc, {1});    // [lead]
+  xla::XlaOp sum_dyh_xhat = xla::Reduce(
+      xla::Mul(dyh, xhat), zero, addc, {1});
+  xla::XlaOp mf = xla::ConstantR0<float>(
+      ctx.b, static_cast<float>(m));
+  xla::XlaOp dx = xla::Mul(
+      bcL(xla::Div(inv, mf)),
+      xla::Sub(xla::Sub(xla::Mul(dyh, bcL(xla::Broadcast(mf, {lead}))),
+                        bcL(sum_dyh)),
+               xla::Mul(xhat, bcL(sum_dyh_xhat))));
+  ctx.out("X@GRAD", xla::ConvertElementType(
+      xla::Reshape(dx, xd), ctx.typeOf(x)));
+}
+
+// ---- attention (ops/nn_ops.py _sdpa, bthd/bhtd layouts, fp32
+// accumulate, finfo.min causal mask; grad mirrors the jax vjp) ------
+xla::DotDimensionNumbers batchDot(int64_t lc, int64_t rc) {
+  xla::DotDimensionNumbers d;
+  d.add_lhs_batch_dimensions(0);
+  d.add_lhs_batch_dimensions(1);
+  d.add_rhs_batch_dimensions(0);
+  d.add_rhs_batch_dimensions(1);
+  d.add_lhs_contracting_dimensions(lc);
+  d.add_rhs_contracting_dimensions(rc);
+  return d;
+}
+
+struct AttnCtx {
+  xla::XlaOp q, k, v;   // [B,H,T,D], ORIGINAL dtype (dots accumulate
+                        // f32 via preferred_element_type, like the
+                        // _sdpa einsums)
+  bool bthd;
+  std::vector<int64_t> qd;
+};
+
+AttnCtx attnInputs(BuildCtx& ctx) {
+  std::string layout = "bhtd";
+  const ptp::Attr* a = ctx.op->findAttr("layout");
+  if (a && a->tag == ptp::Attr::Tag::String) layout = a->s;
+  if (ctx.attrF("dropout_rate", 0.0) != 0.0 &&
+      !ctx.attrB("is_test", false))
+    fail("attention: dropout is not in the native slice");
+  auto cvt = [&](xla::XlaOp x) {
+    if (layout == "bthd") x = xla::Transpose(x, {0, 2, 1, 3});
+    return x;
+  };
+  AttnCtx r;
+  r.bthd = layout == "bthd";
+  r.q = cvt(ctx.in("Q"));
+  r.k = cvt(ctx.in("K"));
+  r.v = cvt(ctx.in("V"));
+  r.qd = ctx.shapeOf(r.q);
+  return r;
+}
+
+xla::XlaOp attnProbs(BuildCtx& ctx, const AttnCtx& a, double scale,
+                     bool causal) {
+  xla::XlaOp s = xla::Mul(
+      xla::DotGeneral(a.q, a.k, batchDot(3, 3), nullptr, xla::F32),
+      xla::ConstantR0<float>(ctx.b, static_cast<float>(scale)));
+  auto sd = ctx.shapeOf(s);  // [B,H,Tq,Tk]
+  if (causal) {
+    int64_t tq = sd[2], tk = sd[3];
+    xla::XlaOp r = xla::Iota(
+        ctx.b, xla::ShapeUtil::MakeShape(xla::S32, {tq, tk}), 0);
+    xla::XlaOp c = xla::Iota(
+        ctx.b, xla::ShapeUtil::MakeShape(xla::S32, {tq, tk}), 1);
+    // tril with offset tk - tq (the _sdpa mask), finfo.min fill
+    xla::XlaOp keep = xla::Ge(
+        xla::Add(r, xla::ConstantR0<int32_t>(
+            ctx.b, static_cast<int32_t>(tk - tq))), c);
+    xla::XlaOp keep_b = xla::BroadcastInDim(keep, sd, {2, 3});
+    s = xla::Select(keep_b, s,
+                    xla::Broadcast(xla::MinFiniteValue(ctx.b,
+                                                       xla::F32),
+                                   sd));
+  }
+  // stable softmax over the last dim
+  xla::XlaOp lse = logsumexpLast(ctx, s);   // [B,H,Tq]
+  return xla::Exp(xla::Sub(s, lse, {0, 1, 2}));
+}
+
+void attentionKernel(BuildCtx& ctx) {
+  AttnCtx a = attnInputs(ctx);
+  auto in_ty = ctx.typeOf(ctx.in("Q"));
+  double scale = ctx.attrF("scale", 1.0 / std::sqrt(
+      static_cast<double>(a.qd[3])));
+  xla::XlaOp p = attnProbs(ctx, a, scale, ctx.attrB("causal", false));
+  // _sdpa casts p to the input dtype before the PV einsum (bf16
+  // probabilities in HBM, f32 MXU accumulate)
+  xla::XlaOp out = xla::DotGeneral(
+      xla::ConvertElementType(p, in_ty), a.v, batchDot(3, 2),
+      nullptr, xla::F32);
+  if (a.bthd) out = xla::Transpose(out, {0, 2, 1, 3});
+  ctx.out("Out", xla::ConvertElementType(out, in_ty));
+}
+
+void attentionGradKernel(BuildCtx& ctx) {
+  AttnCtx a = attnInputs(ctx);
+  auto in_ty = ctx.typeOf(ctx.in("Q"));
+  double scale = ctx.attrF("scale", 1.0 / std::sqrt(
+      static_cast<double>(a.qd[3])));
+  xla::XlaOp p = attnProbs(ctx, a, scale, ctx.attrB("causal", false));
+  xla::XlaOp p_in = xla::ConvertElementType(p, in_ty);
+  xla::XlaOp g = ctx.in("Out@GRAD");
+  if (a.bthd) g = xla::Transpose(g, {0, 2, 1, 3});  // -> [B,H,T,D]
+  // dV = P^T @ g (contract Tq)
+  xla::XlaOp dv = xla::DotGeneral(p_in, g, batchDot(2, 2),
+                                  nullptr, xla::F32);
+  // dP = g @ V^T (contract D)
+  xla::XlaOp dp = xla::DotGeneral(g, a.v, batchDot(3, 3),
+                                  nullptr, xla::F32);
+  // softmax vjp in f32: ds = p * (dp - rowsum(dp * p))
+  auto addc = xla::CreateScalarAddComputation(xla::F32, ctx.b);
+  xla::XlaOp row = xla::Reduce(
+      xla::Mul(dp, p), xla::ConstantR0<float>(ctx.b, 0.0f),
+      addc, {3});
+  xla::XlaOp ds = xla::Mul(
+      p, xla::Sub(dp, row, {0, 1, 2}));
+  xla::XlaOp sc = xla::ConstantR0<float>(
+      ctx.b, static_cast<float>(scale));
+  xla::XlaOp kf = xla::ConvertElementType(a.k, xla::F32);
+  xla::XlaOp qf = xla::ConvertElementType(a.q, xla::F32);
+  // dQ = scale * ds @ K (contract Tk); dK = scale * ds^T @ Q
+  xla::XlaOp dq = xla::Mul(
+      xla::DotGeneral(ds, kf, batchDot(3, 2)), sc);
+  xla::XlaOp dk = xla::Mul(
+      xla::DotGeneral(ds, qf, batchDot(2, 2)), sc);
+  auto back = [&](xla::XlaOp x) {
+    if (a.bthd) x = xla::Transpose(x, {0, 2, 1, 3});
+    return xla::ConvertElementType(x, in_ty);
+  };
+  ctx.out("Q@GRAD", back(dq));
+  ctx.out("K@GRAD", back(dk));
+  ctx.out("V@GRAD", back(dv));
+}
+
 void scaleKernel(BuildCtx& ctx) {
   xla::XlaOp x = ctx.in("X");
   double scale = ctx.attrF("scale", 1.0);
@@ -972,6 +1447,24 @@ REGISTER_XLA_KERNEL("pool2d", pool2dKernel);
 REGISTER_XLA_KERNEL("pool2d_grad", pool2dGradKernel);
 REGISTER_XLA_KERNEL("batch_norm", batchNormKernel);
 REGISTER_XLA_KERNEL("batch_norm_grad", batchNormGradKernel);
+REGISTER_XLA_KERNEL("lookup_table", lookupTableKernel);
+REGISTER_XLA_KERNEL("lookup_table_grad", lookupTableGradKernel);
+REGISTER_XLA_KERNEL("split", splitKernel);
+REGISTER_XLA_KERNEL("split_grad", splitGradKernel);
+REGISTER_XLA_KERNEL("sum", sumKernel);
+REGISTER_XLA_KERNEL("unsqueeze2", unsqueeze2Kernel);
+REGISTER_XLA_KERNEL("increment", incrementKernel);
+REGISTER_XLA_KERNEL("fill_constant", fillConstantKernel);
+REGISTER_XLA_KERNEL("rsqrt", rsqrtKernel);
+REGISTER_XLA_KERNEL("rsqrt_grad", rsqrtGradKernel);
+REGISTER_XLA_KERNEL("scale_grad", scaleGradKernel);
+REGISTER_XLA_KERNEL("elementwise_max", maxKernel);
+REGISTER_XLA_KERNEL("elementwise_min", minKernel);
+REGISTER_XLA_KERNEL("assign_value", assignValueKernel);
+REGISTER_XLA_KERNEL("layer_norm", layerNormKernel);
+REGISTER_XLA_KERNEL("layer_norm_grad", layerNormGradKernel);
+REGISTER_XLA_KERNEL("attention", attentionKernel);
+REGISTER_XLA_KERNEL("attention_grad", attentionGradKernel);
 
 // ---------------------------------------------------------------------------
 // block -> XlaComputation (the Executor's _build_step_fn, natively)

@@ -105,6 +105,51 @@ class TestConvSliceBinaryDriver:
             rtol=1e-5, atol=1e-6)
 
 
+def _build_transformer():
+    from paddle_tpu.models import transformer as T
+
+    main, startup, cost = T.build_program(
+        seq_len=8, d_model=32, n_heads=2, n_layers=1, d_inner=64,
+        vocab=64, dropout_rate=0.0, learning_rate=1.0,
+        warmup_steps=40)
+    main._seed = 5
+    return main, startup, cost
+
+
+def _transformer_data(seed=0):
+    r = np.random.RandomState(seed)
+    return {k: r.randint(1, 64, (8, 8)).astype(np.int64)
+            for k in ("src_ids", "tgt_ids", "label")}
+
+
+@pytest.mark.skipif(not _native_ready(),
+                    reason="no toolchain/XLA runtime for xla_train")
+class TestTransformerSliceBinaryDriver:
+    """THIRD model family through the C++ builder: the full
+    encoder-decoder transformer (fused-QKV attention self+cross,
+    layer_norm, label-smoothed CE, the noam lr chain, Adam)."""
+
+    def test_transformer_losses_match_python_to_1e5(self, tmp_path):
+        _fresh()
+        feed = _transformer_data()
+        main, startup, cost = _build_transformer()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        from paddle_tpu.inference.export import export_train_program
+        art = export_train_program(main, sc, feed, [cost.name],
+                                   str(tmp_path / "tf_native"))
+        steps = 5
+        py = []
+        for _ in range(steps):
+            l, = exe.run(main, feed=feed, fetch_list=[cost], scope=sc)
+            py.append(float(np.asarray(l).reshape(-1)[0]))
+        rows = native.run_xla_train(art, steps)
+        nat = [row[cost.name] for row in rows]
+        np.testing.assert_allclose(nat, py, rtol=2e-5, atol=2e-6)
+        assert py[-1] < py[0]
+
+
 @pytest.mark.skipif(not _native_ready(),
                     reason="no toolchain/XLA runtime for xla_train")
 class TestNativeBuildExecutor:
@@ -156,6 +201,12 @@ class TestNativeBuildExecutor:
         base = self._losses(build, feed, 6, False)
         got = self._losses(build, feed, 6, True)
         np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-6)
+
+    def test_transformer_parity(self):
+        feed = _transformer_data()
+        base = self._losses(_build_transformer, feed, 5, False)
+        got = self._losses(_build_transformer, feed, 5, True)
+        np.testing.assert_allclose(got, base, rtol=2e-5, atol=2e-6)
 
     def test_unsupported_op_is_a_named_error(self):
         def build():
