@@ -41,7 +41,7 @@ import jax.numpy as jnp
 WHITE_LIST = {
     "mul", "matmul", "fc", "conv2d", "depthwise_conv2d",
     "conv2d_transpose", "conv3d", "conv3d_transpose", "attention",
-    "attention_block",
+    "attention_block", "ffn_block",
     "lookup_table", "sequence_conv", "bilinear_tensor_product",
 }
 

@@ -1423,6 +1423,38 @@ def attention_block(x, n_heads, causal=False, scale=None,
 __all__.append("attention_block")
 
 
+def ffn_block(x, d_inner, param_attr_fc1=None, bias_attr_fc1=None,
+              param_attr_fc2=None, bias_attr_fc2=None, name=None):
+    """Whole-layer fused position-wise MLP (relu between two fcs, no
+    dropout): ONE op replacing the mul/add/relu/mul/add sequence so
+    the pallas kernel (ops/pallas/ffn_block.py) keeps the [T, d_inner]
+    hidden in VMEM. Routed from models/transformer._ffn by
+    PADDLE_TPU_FUSE_ATTN_BLOCK=1."""
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("ffn_block", input=x,
+                         param_attr=param_attr_fc1, name=name)
+    d = int(x.shape[-1])
+    w1 = helper.create_parameter(
+        ParamAttr._to_attr(param_attr_fc1), [d, d_inner], x.dtype)
+    b1 = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr_fc1), [d_inner], x.dtype,
+        is_bias=True)
+    w2 = helper.create_parameter(
+        ParamAttr._to_attr(param_attr_fc2), [d_inner, d], x.dtype)
+    b2 = helper.create_parameter(
+        ParamAttr._to_attr(bias_attr_fc2), [d], x.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "ffn_block",
+        {"X": x, "W1": w1, "B1": b1, "W2": w2, "B2": b2},
+        {"Out": out}, {})
+    return out
+
+
+__all__.append("ffn_block")
+
+
 def attention(q, k, v, causal=False, scale=None, dropout_rate=0.0,
               is_test=False, layout="bhtd", name=None):
     """Fused scaled-dot-product attention -- the framework's

@@ -91,6 +91,19 @@ def multi_head_attention(q_in, kv_in, d_model, n_heads, dropout_rate,
 
 
 def _ffn(x, d_model, d_inner, dropout_rate, is_test, name=None):
+    import os
+
+    if (not is_test and dropout_rate == 0.0
+            and os.environ.get("PADDLE_TPU_FUSE_ATTN_BLOCK") == "1"):
+        # the MLP half of the whole-layer fusion (same knob as the
+        # attention block; same param names/init as the unfused path)
+        return layers.ffn_block(
+            x, d_inner,
+            param_attr_fc1=f"{name}_fc1.w" if name else None,
+            bias_attr_fc1=f"{name}_fc1.b" if name else None,
+            param_attr_fc2=f"{name}_fc2.w" if name else None,
+            bias_attr_fc2=f"{name}_fc2.b" if name else None,
+            name=name)
     h = layers.fc(x, d_inner, num_flatten_dims=2, act="relu",
                   param_attr=f"{name}_fc1.w" if name else None,
                   bias_attr=f"{name}_fc1.b" if name else None)

@@ -966,6 +966,23 @@ def mean_iou(ctx):
 # exists only as a layer composition (reference nets.py
 # scaled_dot_product_attention) -- so this op is a TPU-first upgrade.
 # --------------------------------------------------------------------------
+@register_op("ffn_block")
+def ffn_block_op(ctx):
+    """Whole-layer fused position-wise MLP: ONE op for
+    relu(x @ W1 + b1) @ W2 + b2 (the MLP half of PERF.md's
+    whole-layer-fusion lever; kernel in ops/pallas/ffn_block.py).
+    Grads flow through the kernel's custom_vjp (hidden recomputed,
+    never stored to HBM)."""
+    x = ctx.input("X")
+    w1, b1 = ctx.input("W1"), ctx.input("B1")
+    w2, b2 = ctx.input("W2"), ctx.input("B2")
+    from .pallas import ffn_block as FB
+
+    if FB.usable(x, w1):
+        return {"Out": FB.ffn_block(x, w1, b1, w2, b2)}
+    return {"Out": FB.ffn_block_reference(x, w1, b1, w2, b2)}
+
+
 @register_op("attention_block")
 def attention_block_op(ctx):
     """Whole-layer fused self-attention sub-layer: ONE op for

@@ -78,8 +78,11 @@ class TestKernelParity:
             np.asarray(got, np.float32), np.asarray(want, np.float32),
             rtol=2e-2, atol=2e-2)
 
-    def test_usable_gate(self):
+    def test_usable_gate(self, interp):
+        # interp fixture so the platform gate passes and the knobs
+        # below are ACTUALLY exercised (not vacuous on CPU)
         x, wqkv, wo = _mk()
+        assert AB.usable(x, wqkv, 4)
         os.environ["PADDLE_TPU_DISABLE_PALLAS_ATTN_BLOCK"] = "1"
         try:
             assert not AB.usable(x, wqkv, 4)
@@ -88,6 +91,66 @@ class TestKernelParity:
         # too-long sequences stay on the jnp path (VMEM ceiling)
         xl = jnp.zeros((2, 1024, 32))
         assert not AB.usable(xl, jnp.zeros((32, 96)), 4)
+
+
+class TestFfnKernelParity:
+    """The MLP half of the whole-layer fusion
+    (ops/pallas/ffn_block.py)."""
+
+    def _mk(self, b=4, t=16, d=32, f=64, seed=0):
+        r = np.random.RandomState(seed)
+        return (jnp.asarray(r.randn(b, t, d).astype(np.float32)),
+                jnp.asarray((r.randn(d, f) / np.sqrt(d)).astype(
+                    np.float32)),
+                jnp.asarray(r.randn(f).astype(np.float32) * 0.1),
+                jnp.asarray((r.randn(f, d) / np.sqrt(f)).astype(
+                    np.float32)),
+                jnp.asarray(r.randn(d).astype(np.float32) * 0.1))
+
+    def test_forward_matches_reference(self, interp):
+        from paddle_tpu.ops.pallas import ffn_block as FB
+
+        args = self._mk()
+        got = FB.ffn_block(*args)
+        want = FB.ffn_block_reference(*args)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_reference(self, interp):
+        from paddle_tpu.ops.pallas import ffn_block as FB
+
+        args = self._mk(seed=3)
+
+        def loss_k(*a):
+            return jnp.sum(FB.ffn_block(*a) ** 2)
+
+        def loss_r(*a):
+            return jnp.sum(FB.ffn_block_reference(*a) ** 2)
+
+        gk = jax.grad(loss_k, argnums=tuple(range(5)))(*args)
+        gr = jax.grad(loss_r, argnums=tuple(range(5)))(*args)
+        for a, e in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_usable_gate(self, interp):
+        # interp fixture so the platform gate passes and the env knob
+        # + VMEM estimate are ACTUALLY exercised (not vacuous on CPU)
+        from paddle_tpu.ops.pallas import ffn_block as FB
+
+        x = jnp.zeros((4, 16, 32))
+        assert FB.usable(x, jnp.zeros((32, 64)))
+        os.environ["PADDLE_TPU_DISABLE_PALLAS_FFN_BLOCK"] = "1"
+        try:
+            assert not FB.usable(x, jnp.zeros((32, 64)))
+        finally:
+            del os.environ["PADDLE_TPU_DISABLE_PALLAS_FFN_BLOCK"]
+        # oversized weights refuse the kernel (VMEM estimate)
+        assert not FB.usable(jnp.zeros((2, 512, 2048)),
+                             jnp.zeros((2048, 8192)))
+        # backward accumulators bind before the forward does
+        assert not FB.usable(jnp.zeros((2, 64, 1024)),
+                             jnp.zeros((1024, 1280)))
 
 
 def _fresh():
@@ -142,6 +205,8 @@ class TestModelIntegration:
         # on the unfused path (separate q / kv sources)
         assert types.count("attention_block") == 4
         assert types.count("attention") == 2  # cross only
+        # and every layer's MLP fused too (2 enc + 2 dec)
+        assert types.count("ffn_block") == 4
 
     def test_fused_matches_unfused_through_training(self):
         base, _ = _losses(False)
