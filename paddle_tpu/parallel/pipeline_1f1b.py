@@ -299,11 +299,15 @@ def build_1f1b_step(tr, extra_fetches=()):
             ndconst_tail[n] = state[n]
         dconsts = {n: lookup(n) for n in dconst_names}
 
-        xs_h = h0.reshape((n_micro, mb) + h0.shape[1:])
-        xs_bb = {n: lookup(n).reshape(
-            (n_micro, mb) + lookup(n).shape[1:]) for n in bb_names}
-        xs_tail = {n: lookup(n).reshape(
-            (n_micro, mb) + lookup(n).shape[1:]) for n in t_mb}
+        # 'dp' is an AUTO axis (like 'tp'): batch rows sharded over it,
+        # GSPMD partitions the ring-body compute and inserts the grad
+        # reductions (tr._dp_shard is a no-op at dp == 1)
+        xs_h = tr._dp_shard(
+            h0.reshape((n_micro, mb) + h0.shape[1:]), 1)
+        xs_bb = {n: tr._dp_shard(lookup(n).reshape(
+            (n_micro, mb) + lookup(n).shape[1:]), 1) for n in bb_names}
+        xs_tail = {n: tr._dp_shard(lookup(n).reshape(
+            (n_micro, mb) + lookup(n).shape[1:]), 1) for n in t_mb}
 
         # ---- stack per-segment params (same layout as GPipe) --------
         stacked = []

@@ -564,19 +564,24 @@ class PipelineTrainer:
         self.n_micro = int(n_micro)
         self.pp = 1 if mesh is None else int(mesh.shape[axis])
         self.tp = 1
+        self.dp = 1
         if mesh is not None:
-            # pp composes with tp: the GPipe ring is MANUAL over the
-            # 'pp' axis (shard_map axis_names) while 'tp' stays an
-            # AUTO axis — GSPMD partitions the per-segment matmuls by
-            # the structural rules exactly as the dp x tp Executor
-            # path does. Other axes must be size 1.
+            # pp composes with tp AND dp: the pipeline ring is MANUAL
+            # over the 'pp' axis (shard_map axis_names) while 'tp' and
+            # 'dp' stay AUTO axes — GSPMD partitions the per-segment
+            # matmuls by the structural rules (tp) and the microbatch
+            # rows by the batch constraint (dp), inserting the grad
+            # psum exactly as the dp x tp Executor path does. Other
+            # axes must be size 1.
             self.tp = int(mesh.shape.get("tp", 1))
+            self.dp = int(mesh.shape.get("dp", 1))
             other = [a for a in mesh.axis_names
-                     if a not in (axis, "tp") and mesh.shape[a] != 1]
+                     if a not in (axis, "tp", "dp")
+                     and mesh.shape[a] != 1]
             if other:
                 raise PipelinePartitionError(
-                    f"PipelineTrainer supports a {axis!r} (x 'tp') "
-                    f"mesh; axes {other} have size > 1")
+                    f"PipelineTrainer supports a {axis!r} (x 'tp' x "
+                    f"'dp') mesh; axes {other} have size > 1")
         self.sections, self.phase_b = _partition(
             program, self.loss_name, loops,
             fetch_hints=self.fetch_hints)
@@ -729,6 +734,12 @@ class PipelineTrainer:
                         self._stack_spec(loop, pos, leaves[0].shape)))
             stacked.append(st)
         if self.pp == 1:
+            # scan-over-layers: the full-batch dim IS the final
+            # layout, so the dp constraint lands here (the GPipe path
+            # constrains the [n_micro, mb, ...] mb dim instead — a
+            # dim-0 constraint there would force a reshard)
+            h0 = self._dp_shard(h0)
+
             def body(h, xs):
                 params, j = xs
                 out, reds = self._seg_apply(loop, params, h, env, key,
@@ -746,6 +757,18 @@ class PipelineTrainer:
             return h
         return self._run_loop_gpipe(loop, stacked, h0, env, key)
 
+    def _dp_shard(self, arr, batch_dim=0):
+        """Constrain a batch-major array's batch dim over the AUTO
+        'dp' axis (no-op at dp == 1): GSPMD then partitions the ring
+        body's per-microbatch compute across dp and inserts the grad
+        psum where AD needs it."""
+        if self.dp <= 1:
+            return arr
+        spec = [None] * arr.ndim
+        spec[batch_dim] = "dp"
+        return lax.with_sharding_constraint(
+            arr, NamedSharding(self.mesh, P(*spec)))
+
     def _run_loop_gpipe(self, loop, stacked, h0, env, key):
         n_micro, pp, axis = self.n_micro, self.pp, self.axis
         B = h0.shape[0]
@@ -762,9 +785,11 @@ class PipelineTrainer:
                 bb_names.append(n)
             else:
                 const_names.append(n)
-        xs_h = h0.reshape((n_micro, mb) + h0.shape[1:])
-        xs_bb = [env[n].reshape((n_micro, mb) + env[n].shape[1:])
-                 for n in bb_names]
+        xs_h = self._dp_shard(
+            h0.reshape((n_micro, mb) + h0.shape[1:]), 1)
+        xs_bb = [self._dp_shard(
+            env[n].reshape((n_micro, mb) + env[n].shape[1:]), 1)
+            for n in bb_names]
         consts = [env[n] for n in const_names]
 
         def local(stk, xs_h, xs_bb, consts, key):
