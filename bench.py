@@ -487,13 +487,39 @@ def bench_transformer_fused():
     tokens/s and mfu fields are directly comparable."""
     import os
 
+    prev = os.environ.get("PADDLE_TPU_FUSE_ATTN_BLOCK")
     os.environ["PADDLE_TPU_FUSE_ATTN_BLOCK"] = "1"
     try:
         res = bench_transformer()
     finally:
-        os.environ.pop("PADDLE_TPU_FUSE_ATTN_BLOCK", None)
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_FUSE_ATTN_BLOCK", None)
+        else:
+            os.environ["PADDLE_TPU_FUSE_ATTN_BLOCK"] = prev
     res["metric"] = "transformer_fused_train_tokens_per_sec_per_chip"
     res["lowering"] = "fused-attention-block"
+    return res
+
+
+def bench_transformer_scan_fused():
+    """scan-over-layers lowering AND the whole-layer fused kernels
+    together — the likely best batch-256 config (the scan dodges the
+    compile-service 500, the fused blocks cut the HBM/exp cost);
+    parity pinned by tests/test_attention_block.py."""
+    import os
+
+    prev = os.environ.get("PADDLE_TPU_FUSE_ATTN_BLOCK")
+    os.environ["PADDLE_TPU_FUSE_ATTN_BLOCK"] = "1"
+    try:
+        res = bench_transformer_scan()
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_FUSE_ATTN_BLOCK", None)
+        else:
+            os.environ["PADDLE_TPU_FUSE_ATTN_BLOCK"] = prev
+    res["metric"] = \
+        "transformer_scan_fused_train_tokens_per_sec_per_chip"
+    res["lowering"] = "scan-over-layers+fused-blocks"
     return res
 
 
@@ -501,7 +527,8 @@ def bench_transformer_fused():
 # window)
 EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
                  "moe_transformer": bench_moe_transformer,
-                 "transformer_fused": bench_transformer_fused}
+                 "transformer_fused": bench_transformer_fused,
+                 "transformer_scan_fused": bench_transformer_scan_fused}
 
 
 def _probe_backend(timeout_s=180):

@@ -49,7 +49,7 @@ def main():
             json.dump(results, f, indent=1)
         return 3
     for extra in ("transformer_scan", "transformer_fused",
-                  "moe_transformer"):
+                  "transformer_scan_fused", "moe_transformer"):
         rc_e, lines_e = run([extra])
         results["runs"] += lines_e
         results[f"{extra}_rc"] = rc_e

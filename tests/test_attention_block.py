@@ -213,6 +213,36 @@ class TestModelIntegration:
         got, _ = _losses(True)
         np.testing.assert_allclose(got, base, rtol=5e-4, atol=5e-5)
 
+    def test_fused_composes_with_scan_over_layers(self):
+        """The batch-256 lowering (PipelineTrainer pp=1 scan) works on
+        fused-block layers: the segments stay isomorphic with one
+        attention_block + one ffn_block op each, and losses match the
+        unfused Executor — the combined config transformer_scan_fused
+        benches this on-chip."""
+        from paddle_tpu.parallel.pipeline_program import (
+            PipelineTrainer, propose_loops)
+
+        base, _ = _losses(False)
+        _fresh()
+        os.environ["PADDLE_TPU_FUSE_ATTN_BLOCK"] = "1"
+        try:
+            main, startup, cost = _build()
+        finally:
+            os.environ.pop("PADDLE_TPU_FUSE_ATTN_BLOCK", None)
+        loops = propose_loops(main, cost.name)
+        assert len(loops) == 2  # enc + dec stacks detected when fused
+        r = np.random.RandomState(0)
+        feed = {k: r.randint(1, 64, (8, 8)).astype(np.int64)
+                for k in ("src_ids", "tgt_ids", "label")}
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        tr = PipelineTrainer(main, cost, loops=loops)
+        tr.initialize(sc)
+        got = [float(np.asarray(tr.run(feed=feed)[0]).reshape(-1)[0])
+               for _ in range(5)]
+        np.testing.assert_allclose(got, base, rtol=5e-4, atol=5e-5)
+
     def test_dropout_and_decode_builds_stay_unfused(self):
         """dropout>0 and is_test builds keep the unfused path (the
         kernel has no dropout; decode While-loop bodies are validated
