@@ -51,6 +51,13 @@ In-flight microbatches at stage ``i``: at most ``pp - i`` (vs
 ``n_micro`` for GPipe) — the stashed-activation win that
 tests/test_pipeline_1f1b.py proves via ``compiled.memory_analysis()``.
 
+Composition: 'dp' works (auto axis; nothing sharded forces a
+collective inside the divergent per-stage ``lax.cond``); 'tp' does
+NOT — tp-sharded params make GSPMD insert tp collectives inside the
+branches, and devices at different pp coordinates then disagree on
+the collective sequence and deadlock (observed on the 8-dev mesh).
+tp meshes get a named error pointing at GPipe.
+
 Semantics caveat (microbatched reduce outputs): the tail runs per
 microbatch, so a loop reduce output enters the loss as
 ``mean_m f(red_m)`` where GPipe computes ``f(mean_m red_m)``. The two
@@ -92,6 +99,16 @@ def build_1f1b_step(tr, extra_fetches=()):
             "schedule='1f1b' needs a 'pp' mesh axis > 1 (with pp == 1 "
             "the loop is a plain lax.scan and GPipe/1F1B are the same "
             "program; use schedule='gpipe')")
+    if tr.tp > 1:
+        raise PipelinePartitionError(
+            "schedule='1f1b' does not compose with tp: the schedule "
+            "selects F/B work per stage with lax.cond, and tp-sharded "
+            "params force GSPMD to insert tp collectives INSIDE the "
+            "divergent branches — devices at different pp coordinates "
+            "then disagree on the collective sequence and deadlock "
+            "(observed on the 8-dev CPU mesh). Use schedule='gpipe' "
+            "for pp x tp meshes ('dp' composes fine: nothing sharded "
+            "forces a branch-internal collective).")
     loop_secs = [s for s in tr.sections if s.kind == "loop"]
     if len(loop_secs) != 1:
         raise PipelinePartitionError(

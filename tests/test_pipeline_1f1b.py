@@ -245,6 +245,24 @@ class TestNamedErrors:
                            match="exactly one|gpipe"):
             tr.run(feed=feed)
 
+    def test_tp_composition_rejected(self):
+        """tp-sharded params would force GSPMD collectives inside the
+        schedule's divergent lax.cond branches — a deadlock on real
+        meshes, so it must be a named error pointing at gpipe."""
+        xs, ys = _mlp_data()
+        _fresh()
+        prog, startup, loss, bounds = _build_mlp()
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        mesh = make_mesh(MeshConfig(pp=2, tp=2),
+                         devices=jax.devices()[:4])
+        tr = PipelineTrainer(prog, loss, loops=[bounds], mesh=mesh,
+                             n_micro=4, schedule="1f1b")
+        tr.initialize(sc)
+        with pytest.raises(PipelinePartitionError, match="gpipe"):
+            tr.run(feed={"x": xs, "y": ys})
+
     def test_pp1_rejected(self):
         xs, ys = _mlp_data()
         _fresh()
