@@ -94,6 +94,7 @@ struct BuildCtx {
   const ptp::OpDesc* op;
   xla::XlaBuilder* b;
   std::map<std::string, xla::XlaOp>* env;
+  const ptp::ProgramDesc* prog = nullptr;  // for sub-block ops (while)
 
   const std::vector<std::string>* inNames(const std::string& slot) const {
     for (const auto& kv : op->inputs)
@@ -127,7 +128,9 @@ struct BuildCtx {
   }
   std::vector<int64_t> shapeOf(xla::XlaOp v) const {
     auto s = b->GetShape(v);
-    if (!s.ok()) fail(op->type + ": GetShape failed");
+    if (!s.ok())
+      fail(op->type + ": GetShape failed: " +
+           std::string(s.status().message()));
     return std::vector<int64_t>(s.value().dimensions().begin(),
                                 s.value().dimensions().end());
   }
@@ -162,6 +165,22 @@ using XlaKernel = std::function<void(BuildCtx&)>;
 std::map<std::string, XlaKernel>& registry() {
   static std::map<std::string, XlaKernel> r;
   return r;
+}
+
+// run every op of `block` against env/builder through the registry —
+// the shared engine for block 0 and for control-flow sub-blocks
+void runBlockOps(const ptp::ProgramDesc& prog,
+                 const ptp::BlockDesc& block, xla::XlaBuilder* b,
+                 std::map<std::string, xla::XlaOp>* env) {
+  for (const auto& op : block.ops) {
+    if (op.type == "feed" || op.type == "fetch") continue;
+    auto it = registry().find(op.type);
+    if (it == registry().end())
+      fail("no native XLA kernel registered for op '" + op.type +
+           "' (see REGISTER_XLA_KERNEL in xla_train.cc)");
+    BuildCtx ctx{&op, b, env, &prog};
+    it->second(ctx);
+  }
 }
 
 struct Registrar {
@@ -208,6 +227,50 @@ xla::XlaOp logsumexpLast(BuildCtx& ctx, xla::XlaOp x) {
       e, xla::ConstantR0<float>(b, 0.0f),
       xla::CreateScalarAddComputation(xla::F32, b), {last});
   return xla::Add(xla::Log(s), m);
+}
+
+// full numpy-style two-sided broadcast with fluid's axis alignment
+// (mirrors the jnp elementwise kernels: X dims of 1 broadcast up too,
+// e.g. [B,1] + [T] -> [B,T] in the decode one-hot writes)
+xla::XlaOp binaryBroadcast(
+    BuildCtx& ctx, xla::XlaOp x, xla::XlaOp y, int64_t axis,
+    std::function<xla::XlaOp(xla::XlaOp, xla::XlaOp)> f) {
+  auto xd = ctx.shapeOf(x);
+  auto yd = ctx.shapeOf(y);
+  if (xd == yd) return f(x, y);
+  int64_t xr = static_cast<int64_t>(xd.size());
+  int64_t yr = static_cast<int64_t>(yd.size());
+  int64_t out_r = std::max(xr, yr);
+  // axis == -1: plain numpy right-alignment of BOTH sides (the jnp
+  // kernels' semantics); explicit axis: fluid's y-into-x alignment,
+  // which requires x to be the higher-rank side
+  int64_t x_off, y_off;
+  if (axis < 0) {
+    x_off = out_r - xr;
+    y_off = out_r - yr;
+  } else {
+    if (yr > xr)
+      fail(ctx.op->type + ": explicit axis with rank(Y) > rank(X)");
+    x_off = 0;
+    y_off = axis;
+  }
+  std::vector<int64_t> out(out_r, 1);
+  auto fold = [&](const std::vector<int64_t>& d, int64_t off) {
+    for (size_t i = 0; i < d.size(); ++i) {
+      int64_t o = off + static_cast<int64_t>(i);
+      if (out[o] == 1)
+        out[o] = d[i];
+      else if (d[i] != 1 && d[i] != out[o])
+        fail(ctx.op->type + ": incompatible broadcast shapes");
+    }
+  };
+  fold(xd, x_off);
+  fold(yd, y_off);
+  std::vector<int64_t> xmap, ymap;
+  for (int64_t i = 0; i < xr; ++i) xmap.push_back(x_off + i);
+  for (int64_t i = 0; i < yr; ++i) ymap.push_back(y_off + i);
+  return f(xla::BroadcastInDim(x, out, xmap),
+           xla::BroadcastInDim(y, out, ymap));
 }
 
 // fluid elementwise broadcast: y aligned to x starting at `axis`
@@ -265,8 +328,9 @@ void mulGradKernel(BuildCtx& ctx) {
 
 void addKernel(BuildCtx& ctx) {
   xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
-  xla::XlaOp yb = broadcastY(ctx, x, y, ctx.attrI("axis", -1), nullptr);
-  ctx.out("Out", xla::Add(x, yb));
+  ctx.out("Out", binaryBroadcast(
+      ctx, x, y, ctx.attrI("axis", -1),
+      [](xla::XlaOp a, xla::XlaOp b2) { return xla::Add(a, b2); }));
 }
 
 void addGradKernel(BuildCtx& ctx) {
@@ -504,9 +568,9 @@ void softmaxKernel(BuildCtx& ctx) {
 
 void mulEwKernel(BuildCtx& ctx) {
   xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
-  ctx.out("Out", xla::Mul(x, broadcastY(ctx, x, y,
-                                        ctx.attrI("axis", -1),
-                                        nullptr)));
+  ctx.out("Out", binaryBroadcast(
+      ctx, x, y, ctx.attrI("axis", -1),
+      [](xla::XlaOp a, xla::XlaOp b2) { return xla::Mul(a, b2); }));
 }
 
 void mulEwGradKernel(BuildCtx& ctx) {
@@ -535,9 +599,9 @@ void mulEwGradKernel(BuildCtx& ctx) {
 
 void subKernel(BuildCtx& ctx) {
   xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
-  ctx.out("Out", xla::Sub(x, broadcastY(ctx, x, y,
-                                        ctx.attrI("axis", -1),
-                                        nullptr)));
+  ctx.out("Out", binaryBroadcast(
+      ctx, x, y, ctx.attrI("axis", -1),
+      [](xla::XlaOp a, xla::XlaOp b2) { return xla::Sub(a, b2); }));
 }
 
 void subGradKernel(BuildCtx& ctx) {
@@ -1094,9 +1158,13 @@ void unsqueeze2Kernel(BuildCtx& ctx) {
 }
 
 void incrementKernel(BuildCtx& ctx) {
+  // counters are int (CLAUDE.md: float steps on int carries break
+  // while dtypes); ConvertElementType handles the f64 attr -> S64
   xla::XlaOp x = ctx.in("X");
-  ctx.out("Out", xla::Add(x, xla::ScalarLike(
-      x, ctx.attrF("step", 1.0))));
+  xla::XlaOp step = xla::ConvertElementType(
+      xla::ConstantR0<double>(ctx.b, ctx.attrF("step", 1.0)),
+      ctx.typeOf(x));
+  ctx.out("Out", xla::Add(x, step));
 }
 
 void fillConstantKernel(BuildCtx& ctx) {
@@ -1135,16 +1203,16 @@ void scaleGradKernel(BuildCtx& ctx) {
 
 void maxKernel(BuildCtx& ctx) {
   xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
-  ctx.out("Out", xla::Max(x, broadcastY(ctx, x, y,
-                                        ctx.attrI("axis", -1),
-                                        nullptr)));
+  ctx.out("Out", binaryBroadcast(
+      ctx, x, y, ctx.attrI("axis", -1),
+      [](xla::XlaOp a, xla::XlaOp b2) { return xla::Max(a, b2); }));
 }
 
 void minKernel(BuildCtx& ctx) {
   xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
-  ctx.out("Out", xla::Min(x, broadcastY(ctx, x, y,
-                                        ctx.attrI("axis", -1),
-                                        nullptr)));
+  ctx.out("Out", binaryBroadcast(
+      ctx, x, y, ctx.attrI("axis", -1),
+      [](xla::XlaOp a, xla::XlaOp b2) { return xla::Min(a, b2); }));
 }
 
 void assignValueKernel(BuildCtx& ctx) {
@@ -1159,6 +1227,203 @@ void assignValueKernel(BuildCtx& ctx) {
   std::memcpy(lit.untyped_data(), a->nd_data.data(),
               a->nd_data.size());
   ctx.out("Out", xla::ConstantLiteral(ctx.b, lit));
+}
+
+// ---- decode-slice kernels (ops/tensor_ops.py / control_flow_ops.py
+// semantics) --------------------------------------------------------
+void assignKernel(BuildCtx& ctx) {
+  ctx.out("Out", ctx.in("X"));
+}
+
+void castKernel(BuildCtx& ctx) {
+  const ptp::Attr* a = ctx.op->findAttr("out_dtype");
+  if (!a || a->tag != ptp::Attr::Tag::String)
+    fail("cast: out_dtype attr missing or not a dtype string (int "
+         "DataType enums are not supported by the native slice)");
+  ctx.out("Out", xla::ConvertElementType(ctx.in("X"),
+                                         dtypeToPrim(a->s)));
+}
+
+void equalKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
+  ctx.out("Out", binaryBroadcast(
+      ctx, x, y, -1,
+      [](xla::XlaOp a, xla::XlaOp b2) { return xla::Eq(a, b2); }));
+}
+
+void lessThanKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
+  ctx.out("Out", binaryBroadcast(
+      ctx, x, y, -1,
+      [](xla::XlaOp a, xla::XlaOp b2) { return xla::Lt(a, b2); }));
+}
+
+void rangeKernel(BuildCtx& ctx) {
+  double start = ctx.attrF("start", 0.0);
+  double end = ctx.attrF("end", 0.0);
+  double step = ctx.attrF("step", 1.0);
+  std::string dt = "float32";
+  const ptp::Attr* a = ctx.op->findAttr("dtype");
+  if (a && a->tag == ptp::Attr::Tag::String) dt = a->s;
+  int64_t n = static_cast<int64_t>(std::ceil((end - start) / step));
+  if (n < 0) n = 0;
+  xla::PrimitiveType ty = dtypeToPrim(dt);
+  // F64 intermediates: F32 iota corrupts int sequences past 2^24
+  // (same fix the Python kernel carries, ops/tensor_ops.py range)
+  xla::XlaOp iota = xla::Iota(
+      ctx.b, xla::ShapeUtil::MakeShape(xla::F64, {n}), 0);
+  xla::XlaOp vals = xla::Add(
+      xla::Mul(iota, xla::ConstantR0<double>(ctx.b, step)),
+      xla::ConstantR0<double>(ctx.b, start));
+  ctx.out("Out", xla::ConvertElementType(vals, ty));
+}
+
+void fillConstantBatchSizeLikeKernel(BuildCtx& ctx) {
+  xla::XlaOp ref = ctx.in("Input");
+  auto rd = ctx.shapeOf(ref);
+  const ptp::Attr* sh = ctx.op->findAttr("shape");
+  std::vector<int64_t> dims;
+  if (sh && sh->tag == ptp::Attr::Tag::Ints)
+    dims.assign(sh->ints.begin(), sh->ints.end());
+  int64_t in_idx = ctx.attrI("input_dim_idx", 0);
+  int64_t out_idx = ctx.attrI("output_dim_idx", 0);
+  if (out_idx < static_cast<int64_t>(dims.size()))
+    dims[out_idx] = rd[in_idx];
+  std::string dt = "float32";
+  const ptp::Attr* da = ctx.op->findAttr("dtype");
+  if (da && da->tag == ptp::Attr::Tag::String) dt = da->s;
+  xla::XlaOp v = xla::ConvertElementType(
+      xla::ConstantR0<double>(ctx.b, ctx.attrF("value", 0.0)),
+      dtypeToPrim(dt));
+  ctx.out("Out", xla::Broadcast(v, dims));
+}
+
+void argMaxKernel(BuildCtx& ctx) {
+  // first-index argmax over `axis` (matches jnp.argmax tie-breaking):
+  // max-reduce, then min-reduce the iota where the max is attained
+  xla::XlaOp x = ctx.in("X");
+  auto xd = ctx.shapeOf(x);
+  auto ty = ctx.typeOf(x);
+  int64_t axis = ctx.attrI("axis", -1);
+  if (axis < 0) axis += static_cast<int64_t>(xd.size());
+  xla::XlaOp m = xla::Reduce(
+      x, xla::MinValue(ctx.b, ty),
+      xla::CreateScalarMaxComputation(ty, ctx.b), {axis});
+  std::vector<int64_t> bmap;
+  for (int64_t i = 0; i < static_cast<int64_t>(xd.size()); ++i)
+    if (i != axis) bmap.push_back(i);
+  std::vector<int64_t> mdims;
+  for (int64_t i = 0; i < static_cast<int64_t>(xd.size()); ++i)
+    if (i != axis) mdims.push_back(xd[i]);
+  xla::XlaOp m_b = xla::BroadcastInDim(m, xd, bmap);
+  xla::XlaOp iota = xla::Iota(
+      ctx.b, xla::ShapeUtil::MakeShape(xla::S64, xd), axis);
+  xla::XlaOp cand = xla::Select(
+      xla::Eq(x, m_b), iota,
+      xla::Broadcast(xla::MaxValue(ctx.b, xla::S64), xd));
+  // the jnp kernel returns int32 (ops/tensor_ops.py arg_max)
+  ctx.out("Out", xla::ConvertElementType(
+      xla::Reduce(cand, xla::MaxValue(ctx.b, xla::S64),
+                  xla::CreateScalarMinComputation(xla::S64, ctx.b),
+                  {axis}),
+      xla::S32));
+}
+
+void reduceSumKernel(BuildCtx& ctx) {
+  // mirrors ops/math_ops.py _reduce(jnp.sum): default dim [0],
+  // reduce_all -> a true SCALAR (not [1]); keep_dim keeps 1-dims
+  xla::XlaOp x = ctx.in("X");
+  auto xd = ctx.shapeOf(x);
+  auto ty = ctx.typeOf(x);
+  std::vector<int64_t> dims;
+  if (ctx.attrB("reduce_all", false)) {
+    for (size_t i = 0; i < xd.size(); ++i)
+      dims.push_back(static_cast<int64_t>(i));
+  } else {
+    const ptp::Attr* a = ctx.op->findAttr("dim");
+    std::vector<int64_t> raw{0};
+    if (a && a->tag == ptp::Attr::Tag::Ints && !a->ints.empty())
+      raw.assign(a->ints.begin(), a->ints.end());
+    for (int64_t d : raw)
+      dims.push_back(d < 0 ? d + static_cast<int64_t>(xd.size()) : d);
+  }
+  xla::XlaOp s = xla::Reduce(
+      x, xla::Zero(ctx.b, ty),
+      xla::CreateScalarAddComputation(ty, ctx.b), dims);
+  if (ctx.attrB("keep_dim", false)) {
+    std::vector<int64_t> kd(xd.begin(), xd.end());
+    for (int64_t d : dims) kd[d] = 1;
+    s = xla::Reshape(s, kd);
+  }
+  ctx.out("Out", s);
+}
+
+void whileKernel(BuildCtx& ctx) {
+  // xla::While over the sub-block (ops/control_flow_ops.py while_op):
+  // carry = carried vars + externals (XLA computations cannot close
+  // over free values, so read-only externals ride the tuple)
+  if (!ctx.prog) fail("while: no program context");
+  const ptp::Attr* sb = ctx.op->findAttr("sub_block");
+  if (!sb || sb->tag != ptp::Attr::Tag::Block)
+    fail("while: missing sub_block attr");
+  const ptp::BlockDesc& sub = ctx.prog->blocks.at(sb->block_idx);
+  std::vector<std::string> carried, externals;
+  const ptp::Attr* ca = ctx.op->findAttr("carried");
+  if (ca && ca->tag == ptp::Attr::Tag::Strings) carried = ca->strings;
+  const ptp::Attr* ea = ctx.op->findAttr("externals");
+  if (ea && ea->tag == ptp::Attr::Tag::Strings)
+    externals = ea->strings;
+  const std::string cond_name = (*ctx.inNames("Condition"))[0];
+
+  std::vector<std::string> names(carried);
+  names.insert(names.end(), externals.begin(), externals.end());
+  std::vector<xla::XlaOp> init;
+  std::vector<xla::Shape> shapes;
+  for (size_t i = 0; i < carried.size(); ++i)
+    init.push_back(ctx.in("Init", static_cast<int>(i)));
+  for (size_t i = 0; i < externals.size(); ++i)
+    init.push_back(ctx.in("X", static_cast<int>(i)));
+  for (auto& v : init) shapes.push_back(ctx.b->GetShape(v).value());
+  xla::Shape tup = xla::ShapeUtil::MakeTupleShape(shapes);
+
+  xla::XlaComputation cond_c;
+  {
+    xla::XlaBuilder cb("while_cond");
+    xla::XlaOp p = xla::Parameter(&cb, 0, tup, "carry");
+    int idx = -1;
+    for (size_t i = 0; i < names.size(); ++i)
+      if (names[i] == cond_name) idx = static_cast<int>(i);
+    if (idx < 0)
+      fail("while: condition var " + cond_name +
+           " is neither carried nor external");
+    xla::XlaOp c = xla::GetTupleElement(p, idx);
+    xla::ConvertElementType(xla::Reshape(c, {}), xla::PRED);
+    auto built = cb.Build();
+    if (!built.ok()) fail("while cond build failed");
+    cond_c = std::move(built).value();
+  }
+  xla::XlaComputation body_c;
+  {
+    xla::XlaBuilder bb("while_body");
+    xla::XlaOp p = xla::Parameter(&bb, 0, tup, "carry");
+    std::map<std::string, xla::XlaOp> env2;
+    for (size_t i = 0; i < names.size(); ++i)
+      env2[names[i]] = xla::GetTupleElement(p, static_cast<int>(i));
+    runBlockOps(*ctx.prog, sub, &bb, &env2);
+    std::vector<xla::XlaOp> outs;
+    for (const auto& n : names) outs.push_back(env2[n]);
+    xla::Tuple(&bb, outs);
+    auto built = bb.Build();
+    if (!built.ok())
+      fail(std::string("while body build failed: ") +
+           std::string(built.status().message()));
+    body_c = std::move(built).value();
+  }
+  xla::XlaOp fin = xla::While(cond_c, body_c,
+                              xla::Tuple(ctx.b, init));
+  for (size_t i = 0; i < carried.size(); ++i)
+    ctx.out("Out", xla::GetTupleElement(fin, static_cast<int>(i)),
+            static_cast<int>(i));
 }
 
 // ---- layer_norm (ops/nn_ops.py layer_norm: fp32 stats over the
@@ -1407,8 +1672,24 @@ void scaleKernel(BuildCtx& ctx) {
   double scale = ctx.attrF("scale", 1.0);
   double bias = ctx.attrF("bias", 0.0);
   bool bias_after = ctx.attrB("bias_after_scale", true);
-  xla::XlaOp s = xla::ScalarLike(x, scale);
-  xla::XlaOp c = xla::ScalarLike(x, bias);
+  // scale also runs on INT vars (decode counters/buffers). Integral
+  // scale/bias values keep int math; fractional values promote the
+  // whole op to f32 — mirroring jnp's weak-type promotion of
+  // int_array * python_float (a strict int cast would truncate 0.5
+  // to 0 and silently zero the output)
+  auto ty = ctx.typeOf(x);
+  bool integral = ty == xla::S64 || ty == xla::S32 ||
+                  ty == xla::S16 || ty == xla::S8 ||
+                  ty == xla::U8 || ty == xla::PRED;
+  if (integral &&
+      (scale != std::floor(scale) || bias != std::floor(bias))) {
+    x = xla::ConvertElementType(x, xla::F32);
+    ty = xla::F32;
+  }
+  xla::XlaOp s = xla::ConvertElementType(
+      xla::ConstantR0<double>(ctx.b, scale), ty);
+  xla::XlaOp c = xla::ConvertElementType(
+      xla::ConstantR0<double>(ctx.b, bias), ty);
   xla::XlaOp out = bias_after ? xla::Add(xla::Mul(x, s), c)
                               : xla::Mul(xla::Add(x, c), s);
   ctx.out("Out", out);
@@ -1465,6 +1746,16 @@ REGISTER_XLA_KERNEL("layer_norm", layerNormKernel);
 REGISTER_XLA_KERNEL("layer_norm_grad", layerNormGradKernel);
 REGISTER_XLA_KERNEL("attention", attentionKernel);
 REGISTER_XLA_KERNEL("attention_grad", attentionGradKernel);
+REGISTER_XLA_KERNEL("assign", assignKernel);
+REGISTER_XLA_KERNEL("cast", castKernel);
+REGISTER_XLA_KERNEL("equal", equalKernel);
+REGISTER_XLA_KERNEL("less_than", lessThanKernel);
+REGISTER_XLA_KERNEL("range", rangeKernel);
+REGISTER_XLA_KERNEL("fill_constant_batch_size_like",
+                    fillConstantBatchSizeLikeKernel);
+REGISTER_XLA_KERNEL("arg_max", argMaxKernel);
+REGISTER_XLA_KERNEL("reduce_sum", reduceSumKernel);
+REGISTER_XLA_KERNEL("while", whileKernel);
 
 // ---------------------------------------------------------------------------
 // block -> XlaComputation (the Executor's _build_step_fn, natively)
@@ -1486,16 +1777,7 @@ xla::XlaComputation buildTrainStep(const ptp::ProgramDesc& prog,
     env[name] = xla::Parameter(&b, static_cast<int64_t>(i), shape, name);
   }
 
-  const ptp::BlockDesc& block = prog.blocks.at(0);
-  for (const auto& op : block.ops) {
-    if (op.type == "feed" || op.type == "fetch") continue;
-    auto it = registry().find(op.type);
-    if (it == registry().end())
-      fail("no native XLA kernel registered for op '" + op.type +
-           "' (see REGISTER_XLA_KERNEL in xla_train.cc)");
-    BuildCtx ctx{&op, &b, &env};
-    it->second(ctx);
-  }
+  runBlockOps(prog, prog.blocks.at(0), &b, &env);
 
   std::vector<xla::XlaOp> outs;
   for (const auto& spec : manifest.get("outputs")->items()) {

@@ -152,6 +152,50 @@ class TestTransformerSliceBinaryDriver:
 
 @pytest.mark.skipif(not _native_ready(),
                     reason="no toolchain/XLA runtime for xla_train")
+class TestNativeControlFlow:
+    """Sub-block control flow in the C++ builder (closes the 'block 0
+    only, no control flow' limitation): the transformer's
+    autoregressive greedy decode — a lax.while_loop program with a
+    23-op loop body — builds as an xla::While and reproduces the
+    traced path token for token."""
+
+    def test_greedy_decode_matches_traced_tokens(self):
+        from paddle_tpu.models import transformer as T
+
+        _fresh()
+        main, startup, cost = T.build_program(
+            seq_len=8, d_model=32, n_heads=2, n_layers=1, d_inner=64,
+            vocab=32, dropout_rate=0.0, learning_rate=2.0,
+            warmup_steps=40)
+        main._seed = 5
+        r = np.random.RandomState(0)
+        src = r.randint(3, 32, (8, 8)).astype(np.int64)
+        tgt = np.concatenate(
+            [np.ones((8, 1), np.int64), src[:, :-1]], 1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        for _ in range(40):
+            exe.run(main, feed={"src_ids": src, "tgt_ids": tgt,
+                                "label": src},
+                    fetch_list=[cost], scope=sc)
+        dec, _, _, out_ids = T.build_greedy_decode_program(
+            seq_len=8, max_out_len=9, d_model=32, n_heads=2,
+            n_layers=1, d_inner=64, vocab=32, start_id=1, end_id=2)
+        ref, = exe.run(dec, feed={"src_ids": src},
+                       fetch_list=[out_ids], scope=sc)
+        fluid.set_flags({"FLAGS_native_build": True})
+        try:
+            nat, = exe.run(dec, feed={"src_ids": src},
+                           fetch_list=[out_ids], scope=sc)
+        finally:
+            fluid.set_flags({"FLAGS_native_build": False})
+        np.testing.assert_array_equal(np.asarray(nat),
+                                      np.asarray(ref))
+
+
+@pytest.mark.skipif(not _native_ready(),
+                    reason="no toolchain/XLA runtime for xla_train")
 class TestNativeBuildExecutor:
     """FLAGS_native_build: the Executor consumes the C++-built
     computation in-process (StableHLO), trace path as oracle."""
