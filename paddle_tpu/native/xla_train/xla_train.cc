@@ -1426,6 +1426,79 @@ void whileKernel(BuildCtx& ctx) {
             static_cast<int>(i));
 }
 
+void modKernel(BuildCtx& ctx) {
+  // jnp.mod = FLOOR mod (result takes the divisor's sign);
+  // xla::Rem truncates, so adjust when signs differ
+  xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
+  ctx.out("Out", binaryBroadcast(
+      ctx, x, y, ctx.attrI("axis", -1),
+      [&](xla::XlaOp a, xla::XlaOp b2) {
+        xla::XlaOp m = xla::Rem(a, b2);
+        xla::XlaOp zero = xla::ZerosLike(m);
+        xla::XlaOp fix = xla::And(
+            xla::Ne(m, zero),
+            xla::Ne(xla::Lt(m, zero), xla::Lt(b2, zero)));
+        return xla::Select(fix, xla::Add(m, b2), m);
+      }));
+}
+
+void runBlockIfKernel(BuildCtx& ctx) {
+  // xla::Conditional over the sub-block (ops/control_flow_ops.py
+  // run_block_if: lax.cond with identity false branch) — the gate
+  // GradientMergeOptimizer uses to apply the optimizer every k-th
+  // micro-step
+  if (!ctx.prog) fail("run_block_if: no program context");
+  const ptp::Attr* sb = ctx.op->findAttr("sub_block");
+  if (!sb || sb->tag != ptp::Attr::Tag::Block)
+    fail("run_block_if: missing sub_block attr");
+  const ptp::BlockDesc& sub = ctx.prog->blocks.at(sb->block_idx);
+  std::vector<std::string> carried, externals;
+  const ptp::Attr* ca = ctx.op->findAttr("carried");
+  if (ca && ca->tag == ptp::Attr::Tag::Strings) carried = ca->strings;
+  const ptp::Attr* ea = ctx.op->findAttr("externals");
+  if (ea && ea->tag == ptp::Attr::Tag::Strings)
+    externals = ea->strings;
+
+  std::vector<std::string> names(carried);
+  names.insert(names.end(), externals.begin(), externals.end());
+  std::vector<xla::XlaOp> init;
+  std::vector<xla::Shape> shapes;
+  for (size_t i = 0; i < carried.size(); ++i)
+    init.push_back(ctx.in("Init", static_cast<int>(i)));
+  for (size_t i = 0; i < externals.size(); ++i)
+    init.push_back(ctx.in("X", static_cast<int>(i)));
+  for (auto& v : init) shapes.push_back(ctx.b->GetShape(v).value());
+  xla::Shape tup = xla::ShapeUtil::MakeTupleShape(shapes);
+
+  auto build_branch = [&](bool run) {
+    xla::XlaBuilder bb(run ? "if_true" : "if_false");
+    xla::XlaOp p = xla::Parameter(&bb, 0, tup, "carry");
+    std::map<std::string, xla::XlaOp> env2;
+    for (size_t i = 0; i < names.size(); ++i)
+      env2[names[i]] = xla::GetTupleElement(p, static_cast<int>(i));
+    if (run) runBlockOps(*ctx.prog, sub, &bb, &env2);
+    std::vector<xla::XlaOp> outs;
+    for (size_t i = 0; i < carried.size(); ++i)
+      outs.push_back(env2[carried[i]]);
+    xla::Tuple(&bb, outs);
+    auto built = bb.Build();
+    if (!built.ok())
+      fail(std::string("run_block_if branch build failed: ") +
+           std::string(built.status().message()));
+    return std::move(built).value();
+  };
+  xla::XlaComputation t_c = build_branch(true);
+  xla::XlaComputation f_c = build_branch(false);
+  xla::XlaOp pred = xla::ConvertElementType(
+      xla::Reshape(ctx.in("Condition"), {}), xla::PRED);
+  xla::XlaOp fin = xla::Conditional(
+      pred, xla::Tuple(ctx.b, init), t_c,
+      xla::Tuple(ctx.b, init), f_c);
+  for (size_t i = 0; i < carried.size(); ++i)
+    ctx.out("Out", xla::GetTupleElement(fin, static_cast<int>(i)),
+            static_cast<int>(i));
+}
+
 // ---- layer_norm (ops/nn_ops.py layer_norm: fp32 stats over the
 // trailing dims from begin_norm_axis; Mean/Variance output [lead]) --
 struct LnParts {
@@ -1756,6 +1829,8 @@ REGISTER_XLA_KERNEL("fill_constant_batch_size_like",
 REGISTER_XLA_KERNEL("arg_max", argMaxKernel);
 REGISTER_XLA_KERNEL("reduce_sum", reduceSumKernel);
 REGISTER_XLA_KERNEL("while", whileKernel);
+REGISTER_XLA_KERNEL("run_block_if", runBlockIfKernel);
+REGISTER_XLA_KERNEL("elementwise_mod", modKernel);
 
 // ---------------------------------------------------------------------------
 // block -> XlaComputation (the Executor's _build_step_fn, natively)

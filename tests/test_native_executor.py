@@ -246,6 +246,35 @@ class TestNativeBuildExecutor:
         got = self._losses(build, feed, 6, True)
         np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-6)
 
+    def test_gradient_merge_parity(self):
+        """run_block_if (the optimizer gate GradientMergeOptimizer
+        emits) builds as an xla::Conditional: the k=3 loss staircase
+        matches the traced path bit for bit."""
+        def build():
+            prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(prog, startup):
+                x = fluid.layers.data("x", shape=[16],
+                                      dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="int64")
+                h = fluid.layers.fc(x, 32, act="relu")
+                logits = fluid.layers.fc(h, 4)
+                loss = fluid.layers.mean(
+                    fluid.layers.softmax_with_cross_entropy(logits,
+                                                            y))
+                fluid.optimizer.GradientMergeOptimizer(
+                    fluid.optimizer.SGD(0.1), k_steps=3).minimize(
+                    loss)
+            return prog, startup, loss
+
+        r = np.random.RandomState(0)
+        feed = {"x": r.randn(16, 16).astype(np.float32),
+                "y": r.randint(0, 4, (16, 1)).astype(np.int64)}
+        base = self._losses(build, feed, 9, False)
+        got = self._losses(build, feed, 9, True)
+        np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-6)
+        assert got[0] == got[1] == got[2]  # merge window
+        assert got[3] < got[0]             # k-th step applied
+
     def test_transformer_parity(self):
         feed = _transformer_data()
         base = self._losses(_build_transformer, feed, 5, False)
