@@ -51,6 +51,8 @@ SLOW_MODULES = {
     "test_ring_attention",
     "test_moe_program",          # ep-vs-dense parity sweeps
     "test_pallas_attention",     # interpret-mode kernel sweeps
+    "test_native_executor",      # C++ builds + decode/GM parity
+    "test_pipeline_3d",          # 8-dev 3D mesh compiles
 }
 
 
