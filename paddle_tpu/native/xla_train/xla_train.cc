@@ -1442,6 +1442,47 @@ void modKernel(BuildCtx& ctx) {
       }));
 }
 
+void transpose2Kernel(BuildCtx& ctx) {
+  const ptp::Attr* a = ctx.op->findAttr("axis");
+  if (!a || a->tag != ptp::Attr::Tag::Ints)
+    fail("transpose2: missing axis attr");
+  std::vector<int64_t> perm(a->ints.begin(), a->ints.end());
+  ctx.out("Out", xla::Transpose(ctx.in("X"), perm));
+}
+
+void greaterThanKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
+  ctx.out("Out", binaryBroadcast(
+      ctx, x, y, -1,
+      [](xla::XlaOp a, xla::XlaOp b2) { return xla::Gt(a, b2); }));
+}
+
+void matmulKernel(BuildCtx& ctx) {
+  // batched matmul with transpose flags + alpha (ops/math_ops.py
+  // matmul / reference matmul_op.cc); equal-rank operands, leading
+  // dims are batch
+  xla::XlaOp x = ctx.in("X"), y = ctx.in("Y");
+  auto xd = ctx.shapeOf(x), yd = ctx.shapeOf(y);
+  if (xd.size() != yd.size() || xd.size() < 2)
+    fail("matmul: the native slice covers equal-rank >=2 operands");
+  bool tx = ctx.attrB("transpose_X", false);
+  bool ty = ctx.attrB("transpose_Y", false);
+  int64_t r = static_cast<int64_t>(xd.size());
+  xla::DotDimensionNumbers d;
+  for (int64_t i = 0; i < r - 2; ++i) {
+    d.add_lhs_batch_dimensions(i);
+    d.add_rhs_batch_dimensions(i);
+  }
+  d.add_lhs_contracting_dimensions(tx ? r - 2 : r - 1);
+  d.add_rhs_contracting_dimensions(ty ? r - 1 : r - 2);
+  xla::XlaOp out = xla::DotGeneral(x, y, d);
+  double alpha = ctx.attrF("alpha", 1.0);
+  if (alpha != 1.0)
+    out = xla::Mul(out, xla::ConvertElementType(
+        xla::ConstantR0<double>(ctx.b, alpha), ctx.typeOf(out)));
+  ctx.out("Out", out);
+}
+
 void runBlockIfKernel(BuildCtx& ctx) {
   // xla::Conditional over the sub-block (ops/control_flow_ops.py
   // run_block_if: lax.cond with identity false branch) — the gate
@@ -1831,6 +1872,9 @@ REGISTER_XLA_KERNEL("reduce_sum", reduceSumKernel);
 REGISTER_XLA_KERNEL("while", whileKernel);
 REGISTER_XLA_KERNEL("run_block_if", runBlockIfKernel);
 REGISTER_XLA_KERNEL("elementwise_mod", modKernel);
+REGISTER_XLA_KERNEL("transpose2", transpose2Kernel);
+REGISTER_XLA_KERNEL("greater_than", greaterThanKernel);
+REGISTER_XLA_KERNEL("matmul", matmulKernel);
 
 // ---------------------------------------------------------------------------
 // block -> XlaComputation (the Executor's _build_step_fn, natively)

@@ -192,6 +192,23 @@ class TestNativeControlFlow:
             fluid.set_flags({"FLAGS_native_build": False})
         np.testing.assert_array_equal(np.asarray(nat),
                                       np.asarray(ref))
+        # the KV-CACHED incremental decode (batched matmul/transpose2
+        # cache reads, greater_than freeze masks) builds natively too
+        inc, _, _, inc_out = T.build_incremental_decode_program(
+            seq_len=8, max_out_len=9, d_model=32, n_heads=2,
+            n_layers=1, d_inner=64, vocab=32, start_id=1, end_id=2)
+        iref, = exe.run(inc, feed={"src_ids": src},
+                        fetch_list=[inc_out], scope=sc)
+        fluid.set_flags({"FLAGS_native_build": True})
+        try:
+            inat, = exe.run(inc, feed={"src_ids": src},
+                            fetch_list=[inc_out], scope=sc)
+        finally:
+            fluid.set_flags({"FLAGS_native_build": False})
+        np.testing.assert_array_equal(np.asarray(inat),
+                                      np.asarray(iref))
+        np.testing.assert_array_equal(np.asarray(inat),
+                                      np.asarray(ref))
 
 
 @pytest.mark.skipif(not _native_ready(),
