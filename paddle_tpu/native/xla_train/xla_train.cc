@@ -41,6 +41,7 @@
 #include "xla/hlo/builder/lib/arithmetic.h"
 #include "xla/hlo/builder/lib/constants.h"
 #include "xla/hlo/builder/lib/slicing.h"
+#include "xla/hlo/builder/lib/sorting.h"
 #include "xla/hlo/builder/xla_builder.h"
 #include "xla/hlo/builder/xla_computation.h"
 #include "xla/literal.h"
@@ -64,7 +65,7 @@ std::string readFile(const std::string& path, bool* ok) {
   return ss.str();
 }
 
-xla::PrimitiveType dtypeToPrim(const std::string& dt) {
+xla::PrimitiveType rawPrim(const std::string& dt) {
   if (dt == "float32") return xla::F32;
   if (dt == "float64") return xla::F64;
   if (dt == "bfloat16") return xla::BF16;
@@ -77,6 +78,17 @@ xla::PrimitiveType dtypeToPrim(const std::string& dt) {
   if (dt == "bool") return xla::PRED;
   fprintf(stderr, "xla_train: unsupported dtype %s\n", dt.c_str());
   exit(2);
+}
+
+// the computation uses JAX-CANONICAL dtypes (x64 disabled:
+// int64->int32, float64->float32) — the Python kernels never see
+// mixed int widths because the runtime canonicalizes every array, so
+// the builder must too or S32 indices (top_k/arg_max, matching the
+// jnp kernels' int32 outputs) collide with S64 declared constants
+xla::PrimitiveType dtypeToPrim(const std::string& dt) {
+  if (dt == "int64") return xla::S32;
+  if (dt == "float64") return xla::F32;
+  return rawPrim(dt);
 }
 
 [[noreturn]] void fail(const std::string& msg) {
@@ -1219,14 +1231,17 @@ void assignValueKernel(BuildCtx& ctx) {
   const ptp::Attr* a = ctx.op->findAttr("values");
   if (!a || a->tag != ptp::Attr::Tag::NdArray)
     fail("assign_value: missing ndarray 'values' attr");
+  // literal at the PAYLOAD dtype, then convert to canonical
   xla::Shape shape = xla::ShapeUtil::MakeShape(
-      dtypeToPrim(a->nd_dtype), a->nd_dims);
+      rawPrim(a->nd_dtype), a->nd_dims);
   xla::Literal lit(shape);
   if (a->nd_data.size() != lit.size_bytes())
     fail("assign_value: payload size mismatch");
   std::memcpy(lit.untyped_data(), a->nd_data.data(),
               a->nd_data.size());
-  ctx.out("Out", xla::ConstantLiteral(ctx.b, lit));
+  ctx.out("Out", xla::ConvertElementType(
+      xla::ConstantLiteral(ctx.b, lit),
+      dtypeToPrim(a->nd_dtype)));
 }
 
 // ---- decode-slice kernels (ops/tensor_ops.py / control_flow_ops.py
@@ -1481,6 +1496,203 @@ void matmulKernel(BuildCtx& ctx) {
     out = xla::Mul(out, xla::ConvertElementType(
         xla::ConstantR0<double>(ctx.b, alpha), ctx.typeOf(out)));
   ctx.out("Out", out);
+}
+
+// ---- beam-search decode slice (ops/decode_ops.py /
+// ops/tensor_ops.py semantics) --------------------------------------
+void logKernel(BuildCtx& ctx) {
+  ctx.out("Out", xla::Log(ctx.in("X")));
+}
+
+void expandKernel(BuildCtx& ctx) {
+  // jnp.tile: per-dim repeat via reshape -> broadcast -> reshape
+  xla::XlaOp x = ctx.in("X");
+  auto xd = ctx.shapeOf(x);
+  const ptp::Attr* a = ctx.op->findAttr("expand_times");
+  if (!a || a->tag != ptp::Attr::Tag::Ints)
+    fail("expand: missing expand_times attr");
+  std::vector<int64_t> times(a->ints.begin(), a->ints.end());
+  if (times.size() != xd.size())
+    fail("expand: expand_times rank mismatch");
+  std::vector<int64_t> mid, midmap, fin;
+  for (size_t i = 0; i < xd.size(); ++i) {
+    mid.push_back(times[i]);
+    mid.push_back(xd[i]);
+    midmap.push_back(2 * static_cast<int64_t>(i) + 1);
+    fin.push_back(times[i] * xd[i]);
+  }
+  ctx.out("Out", xla::Reshape(
+      xla::BroadcastInDim(x, mid, midmap), fin));
+}
+
+void gatherKernel(BuildCtx& ctx) {
+  // jnp.take(x, index, axis=0): out = index.shape + x.shape[1:]
+  xla::XlaOp x = ctx.in("X");
+  xla::XlaOp idx = xla::ConvertElementType(ctx.in("Index"), xla::S32);
+  auto xd = ctx.shapeOf(x);
+  auto id_d = ctx.shapeOf(idx);
+  int64_t m = numel(id_d);
+  xla::XlaOp rows = xla::TorchIndexSelect(
+      x, xla::Reshape(idx, {m}), 0);
+  std::vector<int64_t> out(id_d);
+  out.insert(out.end(), xd.begin() + 1, xd.end());
+  ctx.out("Out", xla::Reshape(rows, out));
+}
+
+void scatterKernel(BuildCtx& ctx) {
+  xla::XlaOp x = ctx.in("X");
+  xla::XlaOp ids = xla::ConvertElementType(ctx.in("Ids"), xla::S32);
+  xla::XlaOp upd = ctx.in("Updates");
+  auto xd = ctx.shapeOf(x);
+  int64_t m = numel(ctx.shapeOf(ids));
+  bool overwrite = ctx.attrB("overwrite", true);
+  auto ty = ctx.typeOf(x);
+  xla::XlaComputation comb;
+  {
+    xla::XlaBuilder cb("scatter_comb");
+    xla::Shape sc = xla::ShapeUtil::MakeShape(ty, {});
+    xla::XlaOp a = xla::Parameter(&cb, 0, sc, "old");
+    xla::XlaOp b2 = xla::Parameter(&cb, 1, sc, "new");
+    if (overwrite)
+      (void)b2;  // root = new
+    else
+      xla::Add(a, b2);
+    comb = std::move(cb.Build()).value();
+  }
+  xla::ScatterDimensionNumbers sd;
+  for (size_t i = 1; i < xd.size(); ++i)
+    sd.add_update_window_dims(static_cast<int64_t>(i));
+  sd.add_inserted_window_dims(0);
+  sd.add_scatter_dims_to_operand_dims(0);
+  sd.set_index_vector_dim(1);
+  ctx.out("Out", xla::Scatter(
+      x, xla::Reshape(ids, {m, 1}), upd, comb, sd));
+}
+
+void topKKernel(BuildCtx& ctx) {
+  int64_t k = ctx.attrI("k", 1);
+  xla::XlaOp t = xla::TopK(ctx.in("X"), k);
+  ctx.out("Out", xla::GetTupleElement(t, 0));
+  ctx.out("Indices", xla::ConvertElementType(
+      xla::GetTupleElement(t, 1), xla::S32));
+}
+
+void beamSearchKernel(BuildCtx& ctx) {
+  // one dense beam step (ops/decode_ops.py beam_search): frozen beams
+  // keep end_id @ pre_score; per batch, top `beam` of beam*K
+  // candidates; parent_idx = absolute source row
+  xla::XlaOp pre_ids = ctx.in("pre_ids");
+  xla::XlaOp pre_scores = ctx.in("pre_scores");
+  xla::XlaOp ids = ctx.in("ids");
+  xla::XlaOp scores = ctx.in("scores");
+  int64_t beam = ctx.attrI("beam_size", 1);
+  int64_t end_id = ctx.attrI("end_id", 0);
+  auto idd = ctx.shapeOf(ids);
+  int64_t rows = idd[0], k = idd[1];
+  int64_t b = rows / beam;
+  auto ids_ty = ctx.typeOf(ids);
+  auto sc_ty = ctx.typeOf(scores);
+
+  xla::XlaOp fin = xla::Eq(
+      xla::Reshape(pre_ids, {rows}),
+      xla::ConvertElementType(
+          xla::ConstantR0<int64_t>(ctx.b, end_id),
+          ctx.typeOf(pre_ids)));
+  xla::XlaOp fin_b = xla::BroadcastInDim(fin, {rows, k}, {0});
+  xla::XlaOp total;
+  if (ctx.attrB("is_accumulated", true)) {
+    total = scores;
+  } else {
+    total = xla::Add(
+        xla::BroadcastInDim(xla::Reshape(pre_scores, {rows}),
+                            {rows, k}, {0}),
+        xla::Log(xla::Max(scores, xla::ScalarLike(scores, 1e-30))));
+  }
+  xla::XlaOp neg = xla::MinFiniteValue(ctx.b, sc_ty);
+  xla::XlaOp frozen_scores = xla::ConcatInDim(
+      ctx.b,
+      {xla::Reshape(pre_scores, {rows, 1}),
+       xla::Broadcast(neg, {rows, k - 1})},
+      1);
+  xla::XlaOp frozen_ids = xla::Broadcast(
+      xla::ConvertElementType(
+          xla::ConstantR0<int64_t>(ctx.b, end_id), ids_ty),
+      {rows, k});
+  total = xla::Select(fin_b, frozen_scores, total);
+  xla::XlaOp cand = xla::Select(fin_b, frozen_ids, ids);
+
+  xla::XlaOp total_b = xla::Reshape(total, {b, beam * k});
+  xla::XlaOp ids_b = xla::Reshape(cand, {b, beam * k});
+  xla::XlaOp top = xla::TopK(total_b, beam);
+  xla::XlaOp top_scores = xla::GetTupleElement(top, 0);
+  xla::XlaOp top_pos = xla::GetTupleElement(top, 1);   // S32 [b,beam]
+  xla::XlaOp sel_ids = xla::TorchGather(ids_b, top_pos, 1);
+  xla::XlaOp src_beam = xla::Div(
+      top_pos, xla::ConstantR0<int32_t>(
+          ctx.b, static_cast<int32_t>(k)));
+  xla::XlaOp boff = xla::Mul(
+      xla::Iota(ctx.b, xla::ShapeUtil::MakeShape(xla::S32, {b, beam}),
+                0),
+      xla::ConstantR0<int32_t>(ctx.b, static_cast<int32_t>(beam)));
+  xla::XlaOp parent = xla::Add(src_beam, boff);
+  ctx.out("selected_ids", xla::Reshape(sel_ids, {rows, 1}));
+  ctx.out("selected_scores", xla::Reshape(top_scores, {rows, 1}));
+  ctx.out("parent_idx", xla::Reshape(parent, {rows}));
+}
+
+void beamSearchDecodeKernel(BuildCtx& ctx) {
+  // backtrack stacked selections (ops/decode_ops.py
+  // beam_search_decode): T is static, so the reverse scan unrolls in
+  // the builder — 2 gathers per step
+  xla::XlaOp ids = ctx.in("Ids");
+  auto idd = ctx.shapeOf(ids);
+  int64_t t = idd[0];
+  int64_t rows = numel(idd) / t;
+  xla::XlaOp ids2 = xla::Reshape(ids, {t, rows});
+  xla::XlaOp par2;
+  if (ctx.hasIn("Parents")) {
+    par2 = xla::ConvertElementType(
+        xla::Reshape(ctx.in("Parents"), {t, rows}), xla::S32);
+  } else {
+    // no lineage: each beam is its own ancestor (the Python
+    // kernel's parents=None identity path)
+    par2 = xla::Iota(
+        ctx.b, xla::ShapeUtil::MakeShape(xla::S32, {t, rows}), 1);
+  }
+  xla::XlaOp carry = xla::Iota(
+      ctx.b, xla::ShapeUtil::MakeShape(xla::S32, {rows}), 0);
+  std::vector<xla::XlaOp> toks(t);
+  for (int64_t s = t - 1; s >= 0; --s) {
+    xla::XlaOp step_ids = xla::Reshape(
+        xla::SliceInDim(ids2, s, s + 1, 1, 0), {rows});
+    xla::XlaOp step_par = xla::Reshape(
+        xla::SliceInDim(par2, s, s + 1, 1, 0), {rows});
+    toks[s] = xla::Reshape(
+        xla::TorchIndexSelect(step_ids, carry, 0), {1, rows});
+    carry = xla::TorchIndexSelect(step_par, carry, 0);
+  }
+  xla::XlaOp sentence = xla::ConcatInDim(ctx.b, toks, 0);
+  // python: .astype(int64) -> canonical int32 under the jax runtime
+  ctx.out("SentenceIds",
+          xla::ConvertElementType(sentence, xla::S32));
+  xla::XlaOp fin_sc;
+  if (ctx.hasIn("Scores")) {
+    xla::XlaOp sc = ctx.in("Scores");
+    auto sd = ctx.shapeOf(sc);
+    if (!sd.empty() && sd[0] == t &&
+        numel(sd) == t * rows)
+      fin_sc = xla::Reshape(
+          xla::SliceInDim(xla::Reshape(sc, {t, rows}), t - 1, t, 1,
+                          0),
+          {rows});
+    else
+      fin_sc = xla::Reshape(sc, {rows});
+  } else {
+    // Python kernel returns zeros when Scores is absent
+    fin_sc = xla::Broadcast(xla::ConstantR0<float>(ctx.b, 0.0f),
+                            {rows});
+  }
+  ctx.out("SentenceScores", fin_sc);
 }
 
 void runBlockIfKernel(BuildCtx& ctx) {
@@ -1875,6 +2087,13 @@ REGISTER_XLA_KERNEL("elementwise_mod", modKernel);
 REGISTER_XLA_KERNEL("transpose2", transpose2Kernel);
 REGISTER_XLA_KERNEL("greater_than", greaterThanKernel);
 REGISTER_XLA_KERNEL("matmul", matmulKernel);
+REGISTER_XLA_KERNEL("log", logKernel);
+REGISTER_XLA_KERNEL("expand", expandKernel);
+REGISTER_XLA_KERNEL("gather", gatherKernel);
+REGISTER_XLA_KERNEL("scatter", scatterKernel);
+REGISTER_XLA_KERNEL("top_k", topKKernel);
+REGISTER_XLA_KERNEL("beam_search", beamSearchKernel);
+REGISTER_XLA_KERNEL("beam_search_decode", beamSearchDecodeKernel);
 
 // ---------------------------------------------------------------------------
 // block -> XlaComputation (the Executor's _build_step_fn, natively)

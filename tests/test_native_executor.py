@@ -209,6 +209,29 @@ class TestNativeControlFlow:
                                       np.asarray(iref))
         np.testing.assert_array_equal(np.asarray(inat),
                                       np.asarray(ref))
+        # and BEAM SEARCH: the third generation flavor (dense beam
+        # step + unrolled backtrack) builds natively too
+        bm, _, _, bouts = T.build_beam_decode_program(
+            seq_len=8, max_out_len=9, d_model=32, n_heads=2,
+            n_layers=1, d_inner=64, vocab=32, start_id=1, end_id=2,
+            beam_size=2)
+        bfetch = list(bouts) if isinstance(bouts, (list, tuple)) \
+            else [bouts]
+        brefs = exe.run(bm, feed={"src_ids": src[:1]},
+                        fetch_list=bfetch, scope=sc)
+        fluid.set_flags({"FLAGS_native_build": True})
+        try:
+            bnats = exe.run(bm, feed={"src_ids": src[:1]},
+                            fetch_list=bfetch, scope=sc)
+        finally:
+            fluid.set_flags({"FLAGS_native_build": False})
+        for a, b in zip(brefs, bnats):
+            a, b = np.asarray(a), np.asarray(b)
+            if np.issubdtype(a.dtype, np.floating):
+                np.testing.assert_allclose(b, a, rtol=1e-5,
+                                           atol=1e-6)
+            else:
+                np.testing.assert_array_equal(b, a)
 
 
 @pytest.mark.skipif(not _native_ready(),
