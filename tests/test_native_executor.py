@@ -321,6 +321,66 @@ class TestNativeBuildExecutor:
         got = self._losses(_build_transformer, feed, 5, True)
         np.testing.assert_allclose(got, base, rtol=2e-5, atol=2e-6)
 
+    def test_edge_semantics_match_traced(self):
+        """Pin the decode-slice kernels' edge semantics against the
+        traced oracle: floor-mod with negatives, expand tiling,
+        gather, top_k values+indices, reduce_sum keep_dim and
+        full-reduce shapes."""
+        def both(build_fn, feeds):
+            _fresh()
+            prog, startup, fetches = build_fn()
+            exe = fluid.Executor(fluid.CPUPlace())
+            sc = fluid.Scope()
+            exe.run(startup, scope=sc)
+            ref = exe.run(prog, feed=feeds, fetch_list=fetches,
+                          scope=sc)
+            fluid.set_flags({"FLAGS_native_build": True})
+            try:
+                nat = exe.run(prog, feed=feeds, fetch_list=fetches,
+                              scope=sc)
+            finally:
+                fluid.set_flags({"FLAGS_native_build": False})
+            for i, (a, b) in enumerate(zip(ref, nat)):
+                a, b = np.asarray(a), np.asarray(b)
+                assert a.shape == b.shape, (i, a.shape, b.shape)
+                if np.issubdtype(a.dtype, np.floating):
+                    np.testing.assert_allclose(
+                        b, a, rtol=1e-5, atol=1e-6, err_msg=str(i))
+                else:
+                    np.testing.assert_array_equal(
+                        b, a, err_msg=str(i))
+
+        def b_mod():
+            prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(prog, startup):
+                x = fluid.layers.data("x", shape=[6],
+                                      dtype="float32")
+                y = fluid.layers.data("y", shape=[6],
+                                      dtype="float32")
+                out = fluid.layers.elementwise_mod(x, y)
+            return prog, startup, [out]
+
+        both(b_mod,
+             {"x": np.array([[-7., 7, -7, 5, -5, 0]], np.float32),
+              "y": np.array([[3., 3, -3, -3, 5, 3]], np.float32)})
+
+        def b_misc():
+            prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(prog, startup):
+                x = fluid.layers.data("x", shape=[3],
+                                      dtype="float32")
+                e = fluid.layers.expand(x, [2, 3])
+                g = fluid.layers.gather(
+                    x, fluid.layers.fill_constant([2], "int64", 1))
+                tkv, tki = fluid.layers.topk(x, k=2)
+                rs = fluid.layers.reduce_sum(x, dim=[1],
+                                             keep_dim=True)
+                rall = fluid.layers.reduce_sum(x, dim=[0, 1])
+            return prog, startup, [e, g, tkv, tki, rs, rall]
+
+        both(b_misc,
+             {"x": np.array([[3., 1, 2], [6, 5, 4]], np.float32)})
+
     def test_unsupported_op_is_a_named_error(self):
         def build():
             prog, startup = fluid.Program(), fluid.Program()
