@@ -150,26 +150,39 @@ def _transformer_flops_tok(d_model, d_inner, seq, n_layers, vocab):
 
 
 def _time_loop(exe, prog, feed, fetch, steps, warmup):
+    """Timed window = ONE Executor.run_steps call: the whole K-step
+    loop is a single device-resident lax.scan, so the window holds
+    zero Python dispatches and exactly one host readback (vs one
+    pipelined dispatch per step before -- PERF.md "Host dispatch &
+    the multi-step scan"). Programs that cannot scan fall back to the
+    per-step path inside run_steps (named reason on
+    exe.last_run_steps_fallback) and this loop still measures them.
+
+    The warmup window runs the SAME K as the timed window (the scan
+    executable is specialized on K), so the timed call is a pure
+    cache hit; `warmup` only gates whether the untimed window runs.
+    """
     import jax
 
     # the same batch is fed every step (reference fluid_benchmark feeds
     # synthetic batches too); transfer it once so the timed window
     # measures training, not repeated uploads of identical bytes
     feed = {k: jax.device_put(v) for k, v in feed.items()}
-    for _ in range(warmup):
-        out = exe.run(prog, feed=feed, fetch_list=[fetch])
-    loss0 = float(np.asarray(out[0]).reshape(-1)[0])
+    loss0 = None
+    if warmup > 0:
+        # pays the XLA compile of the K-step scan
+        out = exe.run_steps(prog, feed=feed, fetch_list=[fetch],
+                            steps=steps, return_numpy=False)
+        loss0 = float(np.asarray(out[0][-1]).reshape(-1)[0])
     t0 = time.perf_counter()
-    for _ in range(steps):
-        # return_numpy=False: the loss is still computed and fetched
-        # every step, but steps pipeline on-device instead of stalling
-        # for a host round trip per step (the reference's GPU harness
-        # gets the same effect from CUDA stream async)
-        out = exe.run(prog, feed=feed, fetch_list=[fetch],
-                      return_numpy=False)
-    # converting the LAST fetch drains the whole pipeline
-    loss1 = float(np.asarray(out[0]).reshape(-1)[0])
+    out = exe.run_steps(prog, feed=feed, fetch_list=[fetch],
+                        steps=steps, return_numpy=False)
+    # fetching ONE element of the stacked losses drains the scan --
+    # the single host round-trip of the whole window
+    loss1 = float(np.asarray(out[0][-1]).reshape(-1)[0])
     elapsed = time.perf_counter() - t0
+    if loss0 is None:
+        loss0 = float(np.asarray(out[0][0]).reshape(-1)[0])
     return elapsed, loss0, loss1
 
 

@@ -22,11 +22,21 @@ OUT = os.path.join(HERE, "BENCH_SELF_r05.json")
 
 
 def run(args):
+    """One bench.py sub-run. A hung config (the exact hang-prone-
+    tunnel scenario this one-shot script exists for) must not abort
+    the capture: TimeoutExpired is recorded as rc='timeout' and the
+    next config still runs (ADVICE r5)."""
     print(f"# capture: python bench.py {' '.join(args)}",
           file=sys.stderr, flush=True)
-    proc = subprocess.run(
-        [sys.executable, os.path.join(HERE, "bench.py"), *args],
-        capture_output=True, text=True, timeout=3600)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench.py"), *args],
+            capture_output=True, text=True, timeout=3600)
+    except subprocess.TimeoutExpired as e:
+        print(f"# capture: TIMEOUT after {e.timeout}s for config "
+              f"{args or ['default']}; recording marker and moving on",
+              file=sys.stderr, flush=True)
+        return "timeout", []
     sys.stderr.write(proc.stderr)
     lines = []
     for line in proc.stdout.splitlines():
@@ -39,22 +49,30 @@ def run(args):
 def main():
     results = {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
                "runs": []}
-    rc, lines = run([])  # the 5 BASELINE configs
-    results["default_rc"] = rc
-    results["runs"] += lines
-    if rc == 3:
-        print("# capture: backend dead (rc=3); writing probe record",
-              file=sys.stderr)
+
+    def flush():
+        # partial results are the whole point: write after EVERY
+        # config so a later hang/kill loses nothing
         with open(OUT, "w") as f:
             json.dump(results, f, indent=1)
-        return 3
-    for extra in ("transformer_scan", "transformer_fused",
-                  "transformer_scan_fused", "moe_transformer"):
-        rc_e, lines_e = run([extra])
-        results["runs"] += lines_e
-        results[f"{extra}_rc"] = rc_e
-    with open(OUT, "w") as f:
-        json.dump(results, f, indent=1)
+
+    try:
+        rc, lines = run([])  # the 5 BASELINE configs
+        results["default_rc"] = rc
+        results["runs"] += lines
+        flush()
+        if rc == 3:
+            print("# capture: backend dead (rc=3); wrote probe record",
+                  file=sys.stderr)
+            return 3
+        for extra in ("transformer_scan", "transformer_fused",
+                      "transformer_scan_fused", "moe_transformer"):
+            rc_e, lines_e = run([extra])
+            results["runs"] += lines_e
+            results[f"{extra}_rc"] = rc_e
+            flush()
+    finally:
+        flush()
     print(f"# capture: wrote {OUT} with {len(results['runs'])} "
           f"result lines", file=sys.stderr)
     return 0

@@ -103,6 +103,22 @@ class _CompiledBlock:
         self.fetch_names = fetch_names
 
 
+class _CompiledScan(_CompiledBlock):
+    """A K-step lax.scan specialization (Executor.run_steps): fn runs
+    the whole K-step loop on device and returns stacked fetches."""
+
+    def __init__(self, fn, feed_names, state_in, const_in, state_out,
+                 fetch_names, write_only_specs, steps, stacked):
+        super().__init__(fn, feed_names, state_in, const_in, state_out,
+                         fetch_names)
+        # state_out names never read by the block: they join the scan
+        # carry (structure must be step-invariant) seeded with zeros
+        # of these shapes; every iteration overwrites them
+        self.write_only_specs = write_only_specs
+        self.steps = steps
+        self.stacked = stacked        # per-step xs vs one closed-over feed
+
+
 _NATIVE_WARNED = [False]
 
 
@@ -345,6 +361,24 @@ def _default_layout_specs(step, scope, mutated, const, feed_arrays,
     Returns (in_shardings, out_shardings), or None to fall back to
     plain jit (state not yet materialized, non-addressable arrays...).
     """
+    mut_ex = {n: scope._get(n) for n in mutated}
+    const_ex = {n: scope._get(n) for n in const}
+    if any(v is None for v in mut_ex.values()) or \
+            any(v is None for v in const_ex.values()):
+        return None  # run() raises the friendly init error
+    rng_ex = scope._get(RNG_VAR)
+    if rng_ex is None:
+        rng_ex = jax.random.PRNGKey(0)
+    return _pin_state_layout_formats(step, mut_ex, const_ex,
+                                     feed_arrays, rng_ex, place)
+
+
+def _pin_state_layout_formats(fn, state_ex, const_ex, feeds_ex, rng_ex,
+                              place):
+    """Core of _default_layout_specs, generic over the step shape:
+    `fn(state, const, feeds, rng) -> (new_state, fetches, rng)`; used
+    for both the single-step block and the K-step scan (whose state is
+    the scan carry and whose fetches are stacked [K, ...])."""
     try:
         from jax.experimental.layout import Format, Layout
         from jax.sharding import SingleDeviceSharding
@@ -368,22 +402,13 @@ def _default_layout_specs(step, scope, mutated, const, feed_arrays,
         nd = len(getattr(x, "shape", ()))
         return Format(Layout(tuple(range(nd))), SingleDeviceSharding(dev))
 
-    mut_ex = {n: scope._get(n) for n in mutated}
-    const_ex = {n: scope._get(n) for n in const}
-    if any(v is None for v in mut_ex.values()) or \
-            any(v is None for v in const_ex.values()):
-        return None  # run() raises the friendly init error
-    feeds_ex = dict(feed_arrays or {})
-    rng_ex = scope._get(RNG_VAR)
-    if rng_ex is None:
-        rng_ex = jax.random.PRNGKey(0)
-    args = (mut_ex, const_ex, feeds_ex, rng_ex)
+    args = (state_ex, const_ex, dict(feeds_ex or {}), rng_ex)
     try:
-        out_shape = jax.eval_shape(step, *args)
+        out_shape = jax.eval_shape(fn, *args)
         in_fmts = jax.tree.map(fmt_of, args)
         new_state_shape, fetches_shape, rng_shape = out_shape
         out_fmts = (
-            {n: (fmt_of(mut_ex[n]) if n in mut_ex else Format())
+            {n: (fmt_of(state_ex[n]) if n in state_ex else Format())
              for n in new_state_shape},
             [Format() for _ in fetches_shape],
             Format(),
@@ -435,6 +460,82 @@ def _var_np_dtype(block, name, default=np.float32):
     return to_np_dtype(v.dtype)
 
 
+def _check_feed_shape(block, name, value):
+    """Validate a feed against the declared var shape up front: a rank
+    or fixed-dim mismatch would otherwise surface as a raw jax
+    broadcast/reshape error deep inside the traced block (reference
+    DataFeeder checks shapes the same way)."""
+    var = block._find_var_recursive(name)
+    if var is None or var.shape is None:
+        return
+    # extract the dense part the same way _coerce_feed will: (data,
+    # lod) legacy tuples carry their array behind one indirection
+    dense = value
+    if isinstance(dense, tuple) and len(dense) == 2:
+        dense = dense[0]
+    got = getattr(dense, "shape", None)
+    if got is None or callable(got):
+        # LoDTensor's .shape is a METHOD; lists have none -- fall back
+        # to materializing (the jax-array fast path above avoids a
+        # device readback for the common case)
+        try:
+            got = np.asarray(dense).shape
+        except Exception:
+            return  # exotic feed: let _coerce_feed handle it
+    got = tuple(got)
+    want = tuple(var.shape)
+    ok = len(got) == len(want) and all(
+        w < 0 or g == w for g, w in zip(got, want))
+    if not ok:
+        raise ValueError(
+            f"feed {name!r} has shape {got} but the "
+            f"program declares {want} (-1 = any); check the "
+            f"batch layout or the data() declaration")
+
+
+def _scan_fallback_reason(program):
+    """Why a program cannot lower into the K-step scan executor
+    (Executor.run_steps): returns None when scannable, else the named
+    reason the per-step fallback runs instead. Host-bridging ops
+    (io_callback readers, py_func, go threads, print/save/load, PS
+    send/recv) have once-per-step host semantics that a device-resident
+    lax.scan cannot honor; sub-blocks (while/conditional) are walked
+    too so a host op inside a loop body is caught."""
+    from .compiler import CompiledProgram
+
+    if isinstance(program, CompiledProgram):
+        return ("CompiledProgram (data-parallel / inference-compiled) "
+                "programs run through their own per-step path")
+    from ..flags import FLAGS
+
+    if FLAGS.native_build:
+        return ("FLAGS_native_build executes C++-built programs one "
+                "step at a time")
+    from .program import Block
+
+    seen = set()
+
+    def walk(blk):
+        for op in blk.ops:
+            if op.type in ("feed", "fetch"):
+                continue
+            if is_registered(op.type) and \
+                    get_op_info(op.type).host_effect:
+                return (f"op {op.type!r} bridges to the host "
+                        f"(io_callback / host threads) and cannot be "
+                        f"lowered into a device-resident lax.scan "
+                        f"over steps")
+            for v in op.attrs.values():
+                if isinstance(v, Block) and id(v) not in seen:
+                    seen.add(id(v))
+                    r = walk(v)
+                    if r is not None:
+                        return r
+        return None
+
+    return walk(program.global_block)
+
+
 class Executor:
     """fluid.Executor parity (reference python/paddle/fluid/executor.py:451).
     """
@@ -447,6 +548,9 @@ class Executor:
         self.place = place or TPUPlace()
         self.donate = donate
         self._cache: Dict = {}
+        # run_steps: named reason the last call used the per-step
+        # fallback (None = the K-step scan path ran)
+        self.last_run_steps_fallback: Optional[str] = None
 
     def close(self):
         self._cache.clear()
@@ -464,15 +568,28 @@ class Executor:
         self._go_threads = [
             t for t in getattr(self, "_go_threads", [])
             if t.is_alive()]
-        producer = {}
-        for op in block.ops:
-            if op.type in _SKIP_OP_TYPES:
-                continue
-            for n in op.output_arg_names:
-                producer.setdefault(n, op)
-        for op in block.ops:
+        for go_idx, op in enumerate(block.ops):
             if op.type != "go":
                 continue
+            # Producers visible to THIS go op: only ops BEFORE it in
+            # block order. A whole-block first-writer map could
+            # recompute a value the reference's eager executor never
+            # observes at the go point (a var first written later, or
+            # rewritten between writes); those cases are named errors.
+            producer, multi_writer, late = {}, set(), {}
+            for p in block.ops[:go_idx]:
+                if p.type in _SKIP_OP_TYPES:
+                    continue
+                for n in p.output_arg_names:
+                    if n in producer:
+                        multi_writer.add(n)
+                    else:
+                        producer[n] = p
+            for p in block.ops[go_idx + 1:]:
+                if p.type in _SKIP_OP_TYPES:
+                    continue
+                for n in p.output_arg_names:
+                    late.setdefault(n, p)
             sub = op.attrs["sub_block"]
             env = {}
             # a go input may be a main-block INTERMEDIATE: under the
@@ -499,9 +616,22 @@ class Executor:
                     continue
                 p = producer.get(n)
                 if p is None:
+                    lp = late.get(n)
+                    if lp is not None:
+                        raise RuntimeError(
+                            f"go: captured var {n!r} is first written "
+                            f"by op {lp.type!r} AFTER the go op; the "
+                            f"reference's eager executor would not "
+                            f"observe it at the go point")
                     raise RuntimeError(
                         f"go: input var {n!r} is neither fed, in the "
                         f"scope, nor produced by the block")
+                if n in multi_writer:
+                    raise RuntimeError(
+                        f"go: captured var {n!r} has multiple writers "
+                        f"before the go op; recomputing it in the go "
+                        f"thread is ambiguous. Route the value "
+                        f"through a persistable var instead.")
                 if p.type in ("py_func", "print"):
                     raise RuntimeError(
                         f"go: captured var {n!r} is produced by the "
@@ -557,32 +687,8 @@ class Executor:
                 raise KeyError(
                     f"fetch target {name!r} does not exist in the "
                     f"program")
-        # validate feeds against declared shapes up front: a rank or
-        # fixed-dim mismatch would otherwise surface as a raw jax
-        # broadcast/reshape error deep inside the traced block
-        # (reference DataFeeder checks shapes the same way)
         for name, value in feed.items():
-            var = block._find_var_recursive(name)
-            if var is None or var.shape is None:
-                continue
-            # extract the dense part the same way _coerce_feed will:
-            # (data, lod) legacy tuples and LoDTensor objects carry
-            # their array behind one level of indirection
-            dense = value
-            if isinstance(dense, tuple) and len(dense) == 2:
-                dense = dense[0]
-            try:
-                got = tuple(np.asarray(dense).shape)
-            except Exception:
-                continue  # exotic feed: let _coerce_feed handle it
-            want = tuple(var.shape)
-            ok = len(got) == len(want) and all(
-                w < 0 or g == w for g, w in zip(got, want))
-            if not ok:
-                raise ValueError(
-                    f"feed {name!r} has shape {got} but the "
-                    f"program declares {want} (-1 = any); check the "
-                    f"batch layout or the data() declaration")
+            _check_feed_shape(block, name, value)
 
         try:
             device = self.place.device()
@@ -655,21 +761,8 @@ class Executor:
             if use_program_cache:
                 self._cache[key] = compiled
 
-        def _state_val(n):
-            v = scope._get(n)
-            if v is None:
-                raise RuntimeError(
-                    f"Variable {n!r} is used before initialization -- run "
-                    f"the startup program first")
-            if device is not None and not isinstance(v, jax.Array):
-                # same slow-upload avoidance as feeds; cache the device
-                # copy so the transfer happens once, not per step
-                v = jax.device_put(np.asarray(v), device)
-                scope._set(n, v)
-            return v
-
-        mut = {n: _state_val(n) for n in compiled.state_in}
-        const_st = {n: _state_val(n) for n in compiled.const_in}
+        mut = self._scope_state(scope, compiled.state_in, device)
+        const_st = self._scope_state(scope, compiled.const_in, device)
         rng = scope._get(RNG_VAR)
         if rng is None:
             prog_seed = getattr(program, "_seed", None)
@@ -685,6 +778,303 @@ class Executor:
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    # ------------------------------------------------------------------
+    def _scope_state(self, scope, names, device):
+        """Gather scope values for `names`, device-committing host
+        arrays once (the slow-upload avoidance of run(): device_put
+        beats the PJRT argument-upload path for incompressible data)."""
+        out = {}
+        for n in names:
+            v = scope._get(n)
+            if v is None:
+                raise RuntimeError(
+                    f"Variable {n!r} is used before initialization -- "
+                    f"run the startup program first")
+            if device is not None and not isinstance(v, jax.Array):
+                v = jax.device_put(np.asarray(v), device)
+                scope._set(n, v)
+            out[n] = v
+        return out
+
+    # ------------------------------------------------------------------
+    def run_steps(self, program: Optional[Program] = None, feed=None,
+                  fetch_list=None, steps: Optional[int] = None,
+                  scope: Optional[Scope] = None,
+                  return_numpy: bool = True,
+                  use_program_cache: bool = True):
+        """Run K training steps as ONE device-resident lax.scan.
+
+        The reference keeps its hot loop in C++ exactly to keep the
+        host out of the step path (reference framework/executor.cc
+        RunPreparedContext loop; layers/io.py double_buffer H2D
+        staging). The TPU-native equivalent is scanning the whole
+        compiled step over K on device: K Python dispatches + K
+        potential tunnel round-trips collapse into 1 dispatch + 1
+        stacked readback (~75 ms per avoided readback on the tunneled
+        chip -- PERF.md "Host dispatch & the multi-step scan").
+
+        feed is either ONE dict (the same batch every step, the bench
+        harness case -- it enters the scan as a closed-over constant)
+        or a list of K dicts (K batches are stacked and staged on
+        device up front, entering as per-step scan xs). Returns one
+        stacked [K, ...] array per fetch.
+
+        Step semantics match K sequential run() calls exactly: the
+        step PRNG key advances once per scan iteration, so sampling
+        ops (dropout...) draw the identical per-step noise, and the
+        final persistable state written back to the scope is the
+        K-th step's (loss trajectories agree to float tolerance --
+        tests/test_run_steps.py pins 1e-6).
+
+        Programs that cannot scan fall back to K sequential run()
+        calls with the named reason recorded on
+        `self.last_run_steps_fallback` (None when the scan path ran):
+        host-bridging ops (io_callback readers, py_func, go, print/
+        save/load, PS send/recv), CompiledProgram, FLAGS_native_build.
+        The scan executable is cached under its own key (program
+        _uid/_version, per-step feed specs, fetch set, K, AMP and
+        parallel-scope tokens), so Pass.apply version bumps invalidate
+        it the same way they invalidate run()'s cache.
+        """
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        feeds_seq = None
+        if isinstance(feed, (list, tuple)):
+            feeds_seq = [dict(f) for f in feed]
+            if not feeds_seq:
+                raise ValueError("run_steps: empty feed list")
+            if steps is None:
+                steps = len(feeds_seq)
+            if int(steps) != len(feeds_seq):
+                raise ValueError(
+                    f"run_steps: steps={steps} but {len(feeds_seq)} "
+                    f"feed dicts were given")
+            names0 = set(feeds_seq[0])
+            if any(set(f) != names0 for f in feeds_seq):
+                raise ValueError(
+                    "run_steps: all per-step feed dicts must bind "
+                    "the same variable names")
+        else:
+            feed = dict(feed or {})
+            if steps is None:
+                raise ValueError(
+                    "run_steps: steps=K is required when feeding one "
+                    "dict (pass a list of K dicts for per-step "
+                    "batches)")
+        steps = int(steps)
+        if steps < 1:
+            raise ValueError(
+                f"run_steps: steps must be >= 1, got {steps}")
+
+        reason = _scan_fallback_reason(program)
+        self.last_run_steps_fallback = reason
+        if reason is not None:
+            self._warn_scan_fallback(program, reason)
+            return self._run_steps_fallback(
+                program, feed, feeds_seq, fetch_list, steps, scope,
+                return_numpy, use_program_cache)
+
+        fetch_names = _to_fetch_names(fetch_list)
+        block = program.global_block
+        first_feed = feeds_seq[0] if feeds_seq is not None else feed
+        for name in fetch_names:
+            if not block.has_var(name) and name not in first_feed:
+                raise KeyError(
+                    f"fetch target {name!r} does not exist in the "
+                    f"program")
+        try:
+            device = self.place.device()
+        except Exception:
+            device = None
+        if device is not None and jax.device_count() > 1:
+            # same multi-device caveat as run(): committed single-
+            # device args can't be auto-resharded by shard_map programs
+            device = None
+
+        feed_arrays = {}
+        feed_specs = []  # PER-STEP specs (what each scan body sees)
+        if feeds_seq is not None:
+            for name in sorted(feeds_seq[0]):
+                dt = _var_np_dtype(block, name)
+                cols = [_coerce_feed(f[name], dt) for f in feeds_seq]
+                _check_feed_shape(block, name, cols[0])
+                if all(isinstance(c, jax.Array) for c in cols):
+                    arr = jnp.stack(cols)  # already device-resident
+                else:
+                    arr = np.stack([np.asarray(c) for c in cols])
+                    if device is not None:
+                        # ONE staging transfer for all K batches
+                        arr = jax.device_put(arr, device)
+                feed_arrays[name] = arr
+                feed_specs.append(
+                    (name, tuple(arr.shape[1:]), str(arr.dtype)))
+        else:
+            for name, val in feed.items():
+                _check_feed_shape(block, name, val)
+                arr = _coerce_feed(val, _var_np_dtype(block, name))
+                feed_specs.append(
+                    (name, tuple(arr.shape), str(arr.dtype)))
+                if device is not None and not isinstance(arr, jax.Array):
+                    arr = jax.device_put(arr, device)
+                feed_arrays[name] = arr
+
+        from .. import amp
+        from ..flags import FLAGS
+
+        key = ("scan", program._uid, program._version,
+               tuple(sorted(feed_specs)), tuple(fetch_names), steps,
+               feeds_seq is not None, amp.state_token(),
+               _parallel_scope_token())
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = self._compile_steps(
+                program, block, tuple(sorted(feed_arrays)),
+                fetch_names, scope, steps,
+                stacked=feeds_seq is not None, feed_arrays=feed_arrays,
+                device=device)
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        carry = self._scope_state(scope, compiled.state_in, device)
+        const_st = self._scope_state(scope, compiled.const_in, device)
+        for n, spec in compiled.write_only_specs.items():
+            # zeros placeholder: step 1 overwrites it; the carry just
+            # needs a step-invariant structure
+            carry[n] = jnp.zeros(spec.shape, spec.dtype)
+        rng = scope._get(RNG_VAR)
+        if rng is None:
+            prog_seed = getattr(program, "_seed", None)
+            rng = jax.random.PRNGKey(
+                prog_seed if prog_seed is not None else _global_seed[0])
+        fin_state, ys, rng_out = compiled.fn(
+            carry, const_st, feed_arrays, rng)
+        if FLAGS.check_nan_inf:
+            _check_nan_inf(fin_state, ys, fetch_names)
+        scope._set(RNG_VAR, rng_out)
+        for n, v in fin_state.items():
+            scope._set(n, v)
+        if return_numpy:
+            return [np.asarray(v) for v in ys]
+        return list(ys)
+
+    def _warn_scan_fallback(self, program, reason):
+        """Named-reason visibility: fallbacks are correct but slower;
+        warn once per (program, reason) so a bench silently losing the
+        scan win is noticed."""
+        warned = getattr(self, "_scan_fallback_warned", None)
+        if warned is None:
+            warned = self._scan_fallback_warned = set()
+        tok = (program._uid if isinstance(program, Program)
+               else id(program), reason)
+        if tok in warned:
+            return
+        warned.add(tok)
+        import warnings
+
+        warnings.warn(
+            f"run_steps: falling back to the per-step path: {reason}")
+
+    def _run_steps_fallback(self, program, feed, feeds_seq, fetch_list,
+                            steps, scope, return_numpy,
+                            use_program_cache):
+        """Per-step path with the run_steps return contract (stacked
+        [K, ...] fetches). return_numpy=False per inner step keeps the
+        steps pipelining on-device; only the final stack converts."""
+        per_step = []
+        for k in range(steps):
+            f = feeds_seq[k] if feeds_seq is not None else feed
+            per_step.append(self.run(
+                program, feed=f, fetch_list=fetch_list, scope=scope,
+                return_numpy=False, use_program_cache=use_program_cache))
+        n_fetch = len(per_step[0]) if per_step else 0
+        out = []
+        for i in range(n_fetch):
+            vals = [r[i] for r in per_step]
+            if return_numpy:
+                out.append(np.stack([np.asarray(v) for v in vals]))
+            else:
+                out.append(jnp.stack(vals))
+        return out
+
+    # ------------------------------------------------------------------
+    def _compile_steps(self, program, block, feed_names, fetch_names,
+                       scope, steps, stacked, feed_arrays, device):
+        """Lower the SAME _build_step_fn body run() compiles -- the
+        step-key advance included -- into one jitted lax.scan over K
+        steps with donated carry state."""
+        nprog = None
+        if _native_usable(block):
+            try:
+                nprog = _native_prog(block)
+            except Exception:
+                nprog = None
+        mutated, const, state_out = _analyze_block(
+            block, feed_names, fetch_names, nprog=nprog)
+        free_after = _last_use_plan(block, feed_names, fetch_names,
+                                    nprog=nprog)
+        step = _build_step_fn(block, feed_names, mutated, const,
+                              state_out, fetch_names,
+                              free_after=free_after)
+        mutated_set = set(mutated)
+        write_only = [n for n in state_out if n not in mutated_set]
+
+        def multi(carry_state, const_state, feeds, rng):
+            def body(carry, xs):
+                state, key = carry
+                mut = {n: state[n] for n in mutated}
+                f = xs if stacked else feeds
+                new_state, fetches, key = step(mut, const_state, f,
+                                               key)
+                nxt = dict(state)
+                nxt.update(new_state)
+                return (nxt, key), fetches
+
+            (fin, key_out), ys = jax.lax.scan(
+                body, (carry_state, rng),
+                xs=feeds if stacked else None,
+                length=None if stacked else steps)
+            return fin, ys, key_out
+
+        # shapes of the write-only carry slots come from one abstract
+        # eval of the single step (dtypes canonicalized the way jit
+        # will see them)
+        mut_ex = self._scope_state(scope, mutated, device)
+        const_ex = self._scope_state(scope, const, device)
+        rng_ex = scope._get(RNG_VAR)
+        if rng_ex is None:
+            rng_ex = jax.random.PRNGKey(0)
+        write_only_specs = {}
+        if write_only:
+            if stacked:
+                feeds_ex = {
+                    n: jax.ShapeDtypeStruct(tuple(a.shape[1:]), a.dtype)
+                    for n, a in feed_arrays.items()}
+            else:
+                feeds_ex = {
+                    n: jax.ShapeDtypeStruct(
+                        tuple(a.shape),
+                        jax.dtypes.canonicalize_dtype(a.dtype))
+                    for n, a in feed_arrays.items()}
+            new_state_shapes = jax.eval_shape(
+                step, mut_ex, const_ex, feeds_ex, rng_ex)[0]
+            write_only_specs = {n: new_state_shapes[n]
+                                for n in write_only}
+        carry_ex = dict(mut_ex)
+        for n, spec in write_only_specs.items():
+            carry_ex[n] = jnp.zeros(spec.shape, spec.dtype)
+        donate = (0,) if self.donate else ()
+        layouts = _pin_state_layout_formats(
+            multi, carry_ex, const_ex, feed_arrays, rng_ex, self.place)
+        if layouts is not None:
+            jitted = jax.jit(multi, donate_argnums=donate,
+                             in_shardings=layouts[0],
+                             out_shardings=layouts[1])
+        else:
+            jitted = jax.jit(multi, donate_argnums=donate)
+        return _CompiledScan(jitted, feed_names, mutated, const,
+                             state_out, fetch_names, write_only_specs,
+                             steps, stacked)
 
     # ------------------------------------------------------------------
     def _compile(self, program, block, feed_names, fetch_names, scope,

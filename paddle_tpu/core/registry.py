@@ -35,7 +35,8 @@ class OpInfo:
                  infer_shape: Optional[Callable] = None,
                  grad_maker=None, differentiable: bool = True,
                  inplace: Optional[Dict[str, str]] = None,
-                 stop_gradient_slots=(), needs_rng: bool = False):
+                 stop_gradient_slots=(), needs_rng: bool = False,
+                 host_effect: bool = False):
         self.type = type
         self.kernel = kernel
         self.infer_shape = infer_shape
@@ -47,6 +48,12 @@ class OpInfo:
         # input slots that never receive gradient (e.g. integer indices)
         self.stop_gradient_slots = tuple(stop_gradient_slots)
         self.needs_rng = needs_rng
+        # True for kernels that bridge to the host (io_callback /
+        # pure_callback / trace-time host state): they run per-step but
+        # cannot be lowered into a lax.scan over steps — the multi-step
+        # executor (Executor.run_steps) falls back to the per-step path
+        # when a block contains one (with the op named in the reason)
+        self.host_effect = host_effect
 
 
 _REGISTRY: Dict[str, OpInfo] = {}
@@ -121,14 +128,15 @@ class OpContext:
 
 def register_op(type: str, *, infer_shape=None, grad_maker=None,
                 differentiable=True, inplace=None, stop_gradient_slots=(),
-                needs_rng=False):
+                needs_rng=False, host_effect=False):
     """Decorator: register `fn(ctx) -> {out_slot: value|[values]}`."""
 
     def deco(fn):
         _REGISTRY[type] = OpInfo(
             type, fn, infer_shape=infer_shape, grad_maker=grad_maker,
             differentiable=differentiable, inplace=inplace,
-            stop_gradient_slots=stop_gradient_slots, needs_rng=needs_rng)
+            stop_gradient_slots=stop_gradient_slots, needs_rng=needs_rng,
+            host_effect=host_effect)
         return fn
 
     return deco

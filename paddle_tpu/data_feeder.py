@@ -19,6 +19,11 @@ from .layers.sequence import SEQ_LEN_SUFFIX
 class DataFeeder:
     def __init__(self, feed_list: Sequence, place=None, program=None):
         self.program = program or default_main_program()
+        # a place makes feed() return DEVICE arrays: jax.device_put is
+        # async, so converting a batch while the previous step runs
+        # overlaps its H2D transfer with compute (the reference's
+        # buffered_reader H2D staging, reader.py double buffer)
+        self.place = place
         self.feed_vars = []
         for v in feed_list:
             if isinstance(v, str):
@@ -46,6 +51,16 @@ class DataFeeder:
                     if all(d > 0 for d in trail):
                         arr = arr.reshape([arr.shape[0]] + trail)
                 result[var.name] = arr
+        if self.place is not None:
+            import jax
+
+            try:
+                device = self.place.device()
+            except Exception:
+                device = None
+            if device is not None:
+                result = {k: jax.device_put(v, device)
+                          for k, v in result.items()}
         return result
 
 

@@ -213,9 +213,11 @@ def load_vars(executor, dirname, main_program=None, vars=None,
                     scope._set(var.name, blob[var.name])
         else:
             # reference combined layout (save_combine_op):
-            # concatenated LoDTensor streams in the saved var order —
-            # assigned here in the program's persistable-var order,
-            # which matches a reference export of the same program
+            # concatenated LoDTensor streams SORTED BY VAR NAME — the
+            # reference's save path iterates `sorted(save_var_map
+            # .keys())` (reference io.py:203) and its combined load
+            # sorts the same way (io.py:602), so stream order is the
+            # sorted-name order regardless of declaration order
             from .inference.proto_import import parse_lod_tensors_concat
 
             arrays = parse_lod_tensors_concat(raw)
@@ -224,7 +226,8 @@ def load_vars(executor, dirname, main_program=None, vars=None,
                     f"combined params file holds {len(arrays)} "
                     f"tensors but the program lists {len(vars)} "
                     f"persistables")
-            for var, arr in zip(vars, arrays):
+            for var, arr in zip(sorted(vars, key=lambda v: v.name),
+                                arrays):
                 scope.var(var.name)
                 scope._set(var.name, arr)
 

@@ -1108,11 +1108,34 @@ void splitKernel(BuildCtx& ctx) {
   if (!outs) fail("split: no outputs");
   const ptp::Attr* sec = ctx.op->findAttr("sections");
   std::vector<int64_t> sizes;
-  if (sec && sec->tag == ptp::Attr::Tag::Ints && !sec->ints.empty())
+  if (sec && sec->tag == ptp::Attr::Tag::Ints && !sec->ints.empty()) {
     sizes.assign(sec->ints.begin(), sec->ints.end());
-  else
+    // the fluid API allows ONE -1 section (inferred from the axis
+    // extent minus the explicit sections); more than one is
+    // ill-formed and a raw copy would hand SliceInDim a negative
+    // bound -- resolve or fail with a named message
+    int64_t infer = -1, explicit_sum = 0;
+    for (size_t i = 0; i < sizes.size(); ++i) {
+      if (sizes[i] == -1) {
+        if (infer >= 0)
+          fail("split: more than one -1 entry in 'sections' is "
+               "unsupported in the native slice");
+        infer = static_cast<int64_t>(i);
+      } else {
+        explicit_sum += sizes[i];
+      }
+    }
+    if (infer >= 0) {
+      int64_t rest = xd[axis] - explicit_sum;
+      if (rest < 0)
+        fail("split: explicit 'sections' exceed the axis extent; "
+             "cannot infer the -1 section");
+      sizes[infer] = rest;
+    }
+  } else {
     sizes.assign(outs->size(), xd[axis] /
                  static_cast<int64_t>(outs->size()));
+  }
   int64_t off = 0;
   for (size_t i = 0; i < outs->size(); ++i) {
     ctx.out("Out", xla::SliceInDim(x, off, off + sizes[i], 1, axis),

@@ -680,7 +680,8 @@ def compute_map_np(det_batches, lab_batches, overlap=0.5,
     return float(np.mean(aps)) if aps else 0.0
 
 
-@register_op("detection_map", differentiable=False)
+@register_op("detection_map", differentiable=False,
+             host_effect=True)
 def detection_map(ctx):
     """reference detection_map_op.cc: mAP over padded NMS detections
     (label -1 rows = padding) vs padded gt (label -1 = padding). Host
@@ -1265,7 +1266,8 @@ def _rasterize_masks_np(rois, labels, gt_boxes, polys,
     return masks, has
 
 
-@register_op("generate_mask_labels", differentiable=False)
+@register_op("generate_mask_labels", differentiable=False,
+             host_effect=True)
 def generate_mask_labels(ctx):
     """reference detection/generate_mask_labels_op.cc (Mask R-CNN mask
     targets). TPU design: polygon rasterization is inherently

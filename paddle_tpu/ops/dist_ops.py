@@ -28,7 +28,7 @@ def _endpoint(ep: str):
     return get_endpoint(ep)
 
 
-@register_op("send", differentiable=False)
+@register_op("send", differentiable=False, host_effect=True)
 def send(ctx):
     """Push grads (or init values) to endpoints; attrs: epmap aligned
     with X, varnames = remote names, init (startup push vs grad push)."""
@@ -51,7 +51,7 @@ def send(ctx):
     return {}
 
 
-@register_op("send_barrier", differentiable=False)
+@register_op("send_barrier", differentiable=False, host_effect=True)
 def send_barrier(ctx):
     endpoints = ctx.attr("endpoints")
 
@@ -64,7 +64,7 @@ def send_barrier(ctx):
     return {}
 
 
-@register_op("recv", differentiable=False)
+@register_op("recv", differentiable=False, host_effect=True)
 def recv(ctx):
     """Pull param blocks; attrs: epmap aligned with Out slot vars,
     varnames = remote names."""
@@ -89,7 +89,7 @@ def recv(ctx):
     return {"Out": list(vals)}
 
 
-@register_op("fetch_barrier", differentiable=False)
+@register_op("fetch_barrier", differentiable=False, host_effect=True)
 def fetch_barrier(ctx):
     def _do():
         return np.int32(0)
@@ -125,7 +125,7 @@ def _prefetch_grad_maker(op, no_grad_set=frozenset()):
                      dict(op.attrs))]
 
 
-@register_op("prefetch_grad", differentiable=False)
+@register_op("prefetch_grad", differentiable=False, host_effect=True)
 def prefetch_grad(ctx):
     ids = ctx.input("Ids")
     dout = ctx.input("Out@GRAD")
@@ -158,7 +158,7 @@ def prefetch_grad(ctx):
 
 
 @register_op("prefetch", grad_maker=_prefetch_grad_maker,
-             stop_gradient_slots=("Ids",))
+             stop_gradient_slots=("Ids",), host_effect=True)
 def prefetch(ctx):
     """Distributed-lookup-table row fetch (reference prefetch_op.cc +
     parameter_prefetch.cc): gather rows of a row-sharded table from the
@@ -195,7 +195,7 @@ def prefetch(ctx):
     return {"Out": jnp.reshape(rows, out_shape)}
 
 
-@register_op("listen_and_serv", differentiable=False)
+@register_op("listen_and_serv", differentiable=False, host_effect=True)
 def listen_and_serv(ctx):
     raise RuntimeError(
         "listen_and_serv is a host server loop, not a compiled op; run "
@@ -204,7 +204,7 @@ def listen_and_serv(ctx):
         "listen_and_serv_op.cc RunImpl blocking the process)")
 
 
-@register_op("allreduce", differentiable=False)
+@register_op("allreduce", differentiable=False, host_effect=True)
 def allreduce(ctx):
     """Cross-process allreduce (reference distributed_ops/
     allreduce_op.cc: in-graph ncclAllReduce for nccl2/collective
@@ -236,7 +236,7 @@ def allreduce(ctx):
     return {"Out": out}
 
 
-@register_op("checkpoint_notify", differentiable=False)
+@register_op("checkpoint_notify", differentiable=False, host_effect=True)
 def checkpoint_notify(ctx):
     """reference distributed_ops/checkpoint_notify_op.cc: tell every
     pserver in epmap to run its checkpoint save block (persist its

@@ -381,7 +381,7 @@ def is_empty(ctx):
     return jnp.asarray(ctx.input("X").size == 0)
 
 
-@register_op("print", differentiable=False)
+@register_op("print", differentiable=False, host_effect=True)
 def print_op(ctx):
     """reference print_op.cc: pass-through + host-side print via
     ordered io_callback (message/first_n/summarize attrs honored)."""
@@ -407,7 +407,7 @@ def print_op(ctx):
     return {"Out": x}
 
 
-@register_op("save", differentiable=False)
+@register_op("save", differentiable=False, host_effect=True)
 def save_op(ctx):
     """reference save_op.cc: persist one variable to file_path from
     inside the graph (ordered io_callback keeps step ordering)."""
@@ -431,7 +431,7 @@ def save_op(ctx):
     return None
 
 
-@register_op("load", differentiable=False)
+@register_op("load", differentiable=False, host_effect=True)
 def load_op(ctx):
     """reference load_op.cc. XLA needs static result shapes, so the
     layer records the target var's shape/dtype as attrs at build time
@@ -450,7 +450,7 @@ def load_op(ctx):
                        ordered=True)
 
 
-@register_op("save_combine", differentiable=False)
+@register_op("save_combine", differentiable=False, host_effect=True)
 def save_combine(ctx):
     """reference save_combine_op.cc: many vars -> ONE file (npz keyed
     by input var name)."""
@@ -473,7 +473,7 @@ def save_combine(ctx):
     return None
 
 
-@register_op("load_combine", differentiable=False)
+@register_op("load_combine", differentiable=False, host_effect=True)
 def load_combine(ctx):
     """reference load_combine_op.cc: restore N vars from one file; the
     layer supplies shapes/dtypes attrs for static results."""

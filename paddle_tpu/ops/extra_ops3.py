@@ -734,7 +734,7 @@ def register_host_reader(name, gen_factory):
     _HOST_READERS[name] = {"factory": gen_factory, "it": None}
 
 
-@register_op("read", differentiable=False)
+@register_op("read", differentiable=False, host_effect=True)
 def read_op(ctx):
     """reference reader/read_op.cc: pop the next batch from the reader
     bound to input Reader's var name. Runs as an ordered host callback
@@ -776,7 +776,8 @@ def read_op(ctx):
     return {"Out": list(vals)}
 
 
-@register_op("create_custom_reader", differentiable=False)
+@register_op("create_custom_reader", differentiable=False,
+             host_effect=True)
 def create_custom_reader(ctx):
     """reference reader/create_custom_reader_op.cc: decorate an
     underlying reader with a preprocessing function. The reference
@@ -849,7 +850,8 @@ def _require_reader(name, who):
     return entry
 
 
-@register_op("create_py_reader", differentiable=False)
+@register_op("create_py_reader", differentiable=False,
+             host_effect=True)
 def create_py_reader(ctx):
     """reference reader/create_py_reader_op.cc: reader fed by a Python
     generator through a blocking queue. Here the queue IS a PyReader
@@ -866,7 +868,8 @@ def create_py_reader(ctx):
     return {"Out": jnp.zeros((1,), jnp.float32)}
 
 
-@register_op("create_recordio_file_reader", differentiable=False)
+@register_op("create_recordio_file_reader", differentiable=False,
+             host_effect=True)
 def create_recordio_file_reader(ctx):
     """reference reader/create_recordio_file_reader_op.cc: stream
     records from a recordio file (native C++ scanner,
@@ -886,7 +889,8 @@ def create_recordio_file_reader(ctx):
     return {"Out": jnp.zeros((1,), jnp.float32)}
 
 
-@register_op("create_shuffle_reader", differentiable=False)
+@register_op("create_shuffle_reader", differentiable=False,
+             host_effect=True)
 def create_shuffle_reader(ctx):
     """reference reader/create_shuffle_reader-era decorator: buffered
     shuffle with `buffer_size` (readers.shuffle semantics)."""
@@ -917,7 +921,8 @@ def create_shuffle_reader(ctx):
     return {"Out": jnp.zeros((1,), jnp.float32)}
 
 
-@register_op("create_batch_reader", differentiable=False)
+@register_op("create_batch_reader", differentiable=False,
+             host_effect=True)
 def create_batch_reader(ctx):
     """reference reader/create_batch_reader-era decorator: stack
     `batch_size` samples (tuples of arrays) into batch arrays."""
@@ -945,7 +950,8 @@ def create_batch_reader(ctx):
     return {"Out": jnp.zeros((1,), jnp.float32)}
 
 
-@register_op("create_multi_pass_reader", differentiable=False)
+@register_op("create_multi_pass_reader", differentiable=False,
+             host_effect=True)
 def create_multi_pass_reader(ctx):
     """reference reader/create_multi_pass_reader-era decorator: repeat
     the underlying reader `pass_num` times (multi-epoch training as
@@ -963,7 +969,8 @@ def create_multi_pass_reader(ctx):
     return {"Out": jnp.zeros((1,), jnp.float32)}
 
 
-@register_op("create_double_buffer_reader", differentiable=False)
+@register_op("create_double_buffer_reader", differentiable=False,
+             host_effect=True)
 def create_double_buffer_reader(ctx):
     """reference reader/create_double_buffer_reader_op.cc (async H2D
     staging, reader/buffered_reader.cc): a daemon thread prefetches
@@ -1018,7 +1025,7 @@ def create_double_buffer_reader(ctx):
     return {"Out": jnp.zeros((1,), jnp.float32)}
 
 
-@register_op("open_files", differentiable=False)
+@register_op("open_files", differentiable=False, host_effect=True)
 def open_files(ctx):
     """reference reader/open_files_op.cc: multi-file reader -- records
     from each recordio file in `file_names` streamed in order (the

@@ -56,7 +56,8 @@ def _py_func_grad_maker(op, no_grad_set=frozenset()):
                      dict(op.attrs))]
 
 
-@register_op("py_func", grad_maker=_py_func_grad_maker)
+@register_op("py_func", grad_maker=_py_func_grad_maker,
+             host_effect=True)
 def py_func(ctx):
     fid = ctx.attr("forward_callable_id")
     fn = _PY_FUNC_REGISTRY[fid]
@@ -93,7 +94,7 @@ def py_func(ctx):
     return {"Out": list(vals)}
 
 
-@register_op("py_func_grad", differentiable=False)
+@register_op("py_func_grad", differentiable=False, host_effect=True)
 def py_func_grad(ctx):
     bid = ctx.attr("backward_callable_id")
     fn = _PY_FUNC_REGISTRY[bid]
@@ -186,7 +187,7 @@ def _extract_chunks(seq, scheme, num_types, excluded):
     return set(chunks)
 
 
-@register_op("chunk_eval", differentiable=False)
+@register_op("chunk_eval", differentiable=False, host_effect=True)
 def chunk_eval(ctx):
     """reference chunk_eval_op.cc. Inference/Label: int64 [B, T] padded
     (lengths via the @SEQ_LEN companion when present, else full T)."""
@@ -242,7 +243,7 @@ _GO_THREADS: List[threading.Thread] = []
 _GO_ERRORS: List[BaseException] = []
 
 
-@register_op("go", differentiable=False)
+@register_op("go", differentiable=False, host_effect=True)
 def go_op(ctx):
     """reference csp/go_op.cc: execute the sub-block concurrently
     (fire-and-forget goroutine). Inputs are snapshot into the thread;

@@ -215,9 +215,25 @@ def split(ctx):
     x = ctx.input("X")
     axis = ctx.attr("axis", 0)
     num = ctx.attr("num", 0)
-    sections = ctx.attr("sections", [])
+    sections = list(ctx.attr("sections", []))
     if num:
         return {"Out": list(jnp.split(x, num, axis=axis))}
+    neg = [i for i, s in enumerate(sections) if s == -1]
+    if len(neg) > 1:
+        raise ValueError(
+            f"split: more than one -1 entry in sections {sections}")
+    if neg:
+        # fluid allows ONE -1 section, inferred from the axis extent
+        rest = int(x.shape[axis]) - sum(s for s in sections if s != -1)
+        if rest < 0:
+            # jnp.split would silently clamp the out-of-range index
+            # into a zero-width slice; the native kernel names this
+            # case too (xla_train.cc splitKernel)
+            raise ValueError(
+                f"split: explicit sections {sections} exceed the axis "
+                f"extent {int(x.shape[axis])}; cannot infer the -1 "
+                f"section")
+        sections[neg[0]] = rest
     idx = np.cumsum(sections)[:-1]
     return {"Out": list(jnp.split(x, idx, axis=axis))}
 

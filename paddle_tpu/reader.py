@@ -7,6 +7,15 @@ bounded queue while jax.device_put overlaps with the running step (XLA
 async dispatch) -- same pipelining, no custom C++ reader op needed for
 the Python path (the C++ recordio reader feeds this queue for file-driven
 training).
+
+use_double_buffer=True makes the fill thread `jax.device_put` each
+batch BEFORE queueing it: the H2D transfer of batch k+1 overlaps the
+device computing step k (device_put is async), so the consumer pops
+already-device-resident arrays and the host feed cost disappears from
+steady state -- the TPU-native reading of the reference's
+buffered_reader.cc double buffer. `prefetch_to_device` exposes the
+same overlap for any iterator of feed dicts (the Executor.run_steps
+staging path uses the same trick at window granularity).
 """
 from __future__ import annotations
 
@@ -17,11 +26,83 @@ from typing import Callable, List, Optional
 from .data_feeder import DataFeeder
 
 
+def _device_put_batch(item, device=None):
+    """Stage one batch's arrays on device (async; returns immediately
+    with the transfers in flight). Accepts the two batch shapes that
+    flow through readers: a feed dict (DataFeeder.feed output) or a
+    tuple/list of arrays (batch generators)."""
+    import jax
+
+    if isinstance(item, dict):
+        return {k: (v if isinstance(v, jax.Array)
+                    else jax.device_put(v, device))
+                for k, v in item.items()}
+    if isinstance(item, (list, tuple)):
+        return type(item)(
+            v if isinstance(v, jax.Array) else jax.device_put(v, device)
+            for v in item)
+    return item
+
+
+def prefetch_to_device(iterator, device=None, capacity: int = 2):
+    """Wrap an iterator of batches with a background device-staging
+    thread: batch k+1's `jax.device_put` overlaps step k. The bounded
+    queue (default 2 = classic double buffering) caps device memory
+    pinned by in-flight batches. Abandoning the generator early
+    (break / close) releases the fill thread and its staged buffers.
+
+    Reference counterpart: python/paddle/fluid/layers/io.py:1017
+    double_buffer -> operators/reader/buffered_reader.cc (the
+    background H2D staging thread), surfaced as a plain-iterator
+    utility here.
+    """
+    q: queue.Queue = queue.Queue(maxsize=max(1, int(capacity)))
+    _SENTINEL = object()
+    err: List[BaseException] = []
+    stop = threading.Event()
+
+    def _put(item):
+        """Bounded put that gives up when the consumer is gone --
+        a plain q.put would block forever on an abandoned generator,
+        pinning device-resident batches for the process lifetime."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _fill():
+        try:
+            for item in iterator:
+                if not _put(_device_put_batch(item, device)):
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            err.append(e)
+        finally:
+            _put(_SENTINEL)
+
+    t = threading.Thread(target=_fill, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
+
+
 class PyReader:
     def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
                  iterable=True, return_list=False):
         self._feed_list = feed_list
         self._capacity = capacity
+        self._use_double_buffer = use_double_buffer
         self._iterable = iterable
         self._batch_reader = None
         self._places = None
@@ -42,13 +123,30 @@ class PyReader:
 
     decorate_paddle_reader = decorate_sample_list_generator
 
+    def _device(self):
+        places = self._places
+        if isinstance(places, (list, tuple)) and places:
+            places = places[0]
+        dev = getattr(places, "device", None)
+        if callable(dev):
+            try:
+                return dev()
+            except Exception:
+                return None
+        return None
+
     def start(self):
         self._exhausted = False
         self._queue = queue.Queue(maxsize=self._capacity)
+        device = self._device() if self._use_double_buffer else None
 
         def _fill():
             try:
                 for item in self._batch_reader():
+                    if self._use_double_buffer:
+                        # async H2D: batch k+1 transfers while the
+                        # consumer's step k computes
+                        item = _device_put_batch(item, device)
                     self._queue.put(item)
             finally:
                 self._queue.put(None)

@@ -5,6 +5,8 @@ family, range, append_LARS, SSD multi_box_head...).
 Parity model: reference tests/unittests/test_layers.py (build-and-run
 surface checks) + the per-op numeric oracles of op_test.py.
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -397,6 +399,9 @@ def test_reference_module_all_coverage():
     assert not missing, missing
 
 
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/python/paddle/fluid/__init__.py"),
+    reason="reference checkout not present in this environment")
 def test_reference_root_all_coverage():
     """The reference fluid/__init__ composes its __all__ from module
     lists (checked above) plus a literal tail — check the tail too."""

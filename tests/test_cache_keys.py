@@ -79,6 +79,48 @@ class TestProgramUid:
         np.testing.assert_allclose(r1, np.ones((2, 4)) * 2.0)
 
 
+class TestRunStepsCacheKey:
+    def test_version_bump_invalidates_scan_executable(self):
+        """The K-step scan executable is cached under the program
+        _uid/_version (plus feed specs / fetch set / K): mutating the
+        program after a run_steps call -- same fetch name, same feed
+        specs -- must recompile, not serve the stale scan (the same
+        contract Pass.apply relies on for run())."""
+        _fresh()
+        exe = fluid.Executor(fluid.TPUPlace())
+        feed = {"x": np.ones((2, 4), np.float32)}
+        prog, startup, out = _build(2.0)
+        exe.run(startup)
+        r1 = exe.run_steps(prog, feed=feed, fetch_list=[out], steps=3)
+        assert exe.last_run_steps_fallback is None
+        np.testing.assert_allclose(np.asarray(r1[0]),
+                                   np.full((3, 2, 4), 2.0))
+        # in-place program mutation: rewrite the fetched var x10
+        # (append_op bumps _version; feed specs and fetch set are
+        # unchanged, so ONLY the version distinguishes the keys)
+        v0 = prog._version
+        prog.global_block.append_op(
+            "scale", {"X": [out.name]}, {"Out": [out.name]},
+            {"scale": 10.0})
+        assert prog._version > v0
+        r2 = exe.run_steps(prog, feed=feed, fetch_list=[out], steps=3)
+        np.testing.assert_allclose(np.asarray(r2[0]),
+                                   np.full((3, 2, 4), 20.0))
+
+    def test_distinct_k_compiles_are_isolated(self):
+        """steps=K is part of the key: a K=2 window then a K=4 window
+        through one executor must each return their own stack."""
+        _fresh()
+        exe = fluid.Executor(fluid.TPUPlace())
+        feed = {"x": np.ones((2, 4), np.float32)}
+        prog, startup, out = _build(3.0)
+        exe.run(startup)
+        r2 = exe.run_steps(prog, feed=feed, fetch_list=[out], steps=2)
+        r4 = exe.run_steps(prog, feed=feed, fetch_list=[out], steps=4)
+        assert np.asarray(r2[0]).shape == (2, 2, 4)
+        assert np.asarray(r4[0]).shape == (4, 2, 4)
+
+
 class TestMeshToken:
     def test_token_is_structural_not_identity(self):
         from paddle_tpu.core.executor import _mesh_token

@@ -132,3 +132,58 @@ class TestGoOp:
             time.sleep(0.05)
         _wait_threads(exe)
         assert len(calls) == 3
+
+
+class TestGoProducerOrdering:
+    """ADVICE r5: the recompute-chain producer map must see only ops
+    BEFORE the go op in block order; later-positioned or multi-writer
+    producers are named errors (the reference's eager executor would
+    never observe those values at the go point)."""
+
+    def test_producer_after_go_op_is_named_error(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.scale(x, scale=2.0)
+            with fluid.layers.Go():
+                fluid.layers.scale(y, scale=3.0)
+            loss = fluid.layers.mean(x)
+        ops = prog.global_block.ops
+        y_i = next(i for i, o in enumerate(ops)
+                   if y.name in o.output_arg_names)
+        go_i = next(i for i, o in enumerate(ops) if o.type == "go")
+        assert y_i < go_i
+        # move y's producer AFTER the go op: the go thread would
+        # recompute a value the eager executor never saw at this point
+        ops.append(ops.pop(y_i))
+        prog._version += 1
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        import pytest
+        with pytest.raises(RuntimeError,
+                           match="AFTER the go op"):
+            exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss], scope=sc)
+
+    def test_multi_writer_before_go_is_named_error(self):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.scale(x, scale=2.0)
+            # second in-place writer of y before the go op: the
+            # recompute chain can't know which value the go captured
+            prog.global_block.append_op(
+                "scale", {"X": [y.name]}, {"Out": [y.name]},
+                {"scale": 5.0})
+            with fluid.layers.Go():
+                fluid.layers.scale(y, scale=3.0)
+            loss = fluid.layers.mean(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        import pytest
+        with pytest.raises(RuntimeError,
+                           match="multiple writers"):
+            exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[loss], scope=sc)

@@ -130,3 +130,28 @@ class TestNativeXlaBuilder:
         with pytest.raises(RuntimeError,
                            match="no native XLA kernel registered"):
             native.run_xla_train(art, 1)
+
+    def test_split_with_inferred_section(self, tmp_path):
+        """A -1 entry in split's `sections` (one inferred section,
+        allowed by the fluid API) must resolve from the axis extent in
+        the native kernel instead of handing SliceInDim a negative
+        bound (ADVICE r5); parity vs the Python executor."""
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data(name="x", shape=[6],
+                                  dtype="float32")
+            a, b = fluid.layers.split(x, [2, -1], dim=1)
+            loss = fluid.layers.mean(a) + fluid.layers.mean(b)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        feed = {"x": np.arange(12, dtype=np.float32).reshape(2, 6)}
+        from paddle_tpu.inference.export import export_train_program
+        art = export_train_program(prog, sc, feed, [loss.name],
+                                   str(tmp_path / "m_split"))
+        py, = exe.run(prog, feed=feed, fetch_list=[loss], scope=sc)
+        rows = native.run_xla_train(art, 1)
+        np.testing.assert_allclose(
+            rows[0][loss.name],
+            float(np.asarray(py).reshape(-1)[0]), rtol=1e-6)

@@ -152,12 +152,15 @@ class TestEndToEnd:
         r = np.random.RandomState(3)
         w = r.randn(8, 4).astype(np.float32)
         b = r.randn(4).astype(np.float32)
-        p1, p2 = str(tmp_path / "a"), str(tmp_path / "b")
-        _write_ref_lod_tensor(p1, w)
-        _write_ref_lod_tensor(p2, b)
-        # persistable program order is fc_w then fc_b (var decl order)
+        p_w, p_b = str(tmp_path / "w"), str(tmp_path / "b")
+        _write_ref_lod_tensor(p_w, w)
+        _write_ref_lod_tensor(p_b, b)
+        # the reference's save_combine writes streams sorted by var
+        # name (reference io.py:203 `for name in sorted(save_var_map
+        # .keys())`): fc_b BEFORE fc_w, even though the program
+        # declares fc_w first
         with open(os.path.join(d, "__params__"), "wb") as f:
-            for p in (p1, p2):
+            for p in (p_b, p_w):
                 with open(p, "rb") as g:
                     f.write(g.read())
         exe = fluid.Executor(fluid.CPUPlace())

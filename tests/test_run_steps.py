@@ -1,0 +1,456 @@
+"""Executor.run_steps: K training steps as ONE device-resident
+lax.scan (the TPU-native reading of the reference's C++
+while-over-steps hot loop, reference framework/executor.cc
+RunPreparedContext, + layers/io.py double_buffer H2D staging).
+
+Acceptance bars (ISSUE r6): run_steps(K) loss trajectories match K
+sequential Executor.run calls to <=1e-6 on the mnist-fc and
+transformer-base families -- including a dropout program (the
+step-keyed noise must advance identically inside the scan) and an AMP
+program -- and non-scannable programs fall back to the per-step path
+with a NAMED reason instead of mis-executing.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _fresh():
+    fluid._reset_global_scope()
+    from paddle_tpu import unique_name
+    unique_name.switch()
+    fluid.seed(11)
+
+
+def _losses_sequential(prog, startup, loss, feeds, scope=None):
+    """K sequential run() calls -- the oracle trajectory."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = scope or fluid.Scope()
+    exe.run(startup, scope=sc)
+    out = []
+    for f in feeds:
+        l, = exe.run(prog, feed=f, fetch_list=[loss], scope=sc)
+        out.append(float(np.asarray(l).reshape(-1)[0]))
+    return out, sc
+
+
+def _losses_scanned(prog, startup, loss, feeds, same_feed=None,
+                    steps=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc = fluid.Scope()
+    exe.run(startup, scope=sc)
+    if same_feed is not None:
+        out = exe.run_steps(prog, feed=same_feed, fetch_list=[loss],
+                            steps=steps, scope=sc)
+    else:
+        out = exe.run_steps(prog, feed=feeds, fetch_list=[loss],
+                            scope=sc)
+    assert exe.last_run_steps_fallback is None, \
+        exe.last_run_steps_fallback
+    return list(np.asarray(out[0]).reshape(-1).astype(np.float64)), sc
+
+
+def _mnist_fc():
+    from paddle_tpu.models import mnist as M
+
+    main, startup, loss, _acc = M.build_program(use_conv=False)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _mnist_feeds(k, batch=16):
+    r = np.random.RandomState(0)
+    feeds = []
+    for _ in range(k):
+        lab = r.randint(0, 10, (batch, 1)).astype(np.int64)
+        img = r.randn(batch, 784).astype(np.float32) * 0.1
+        img[np.arange(batch), lab[:, 0]] += 2.0
+        feeds.append({"img": img, "label": lab})
+    return feeds
+
+
+def _tiny_transformer(dropout_rate=0.0):
+    from paddle_tpu.models import transformer as T
+
+    main, startup, cost = T.build_program(
+        seq_len=8, d_model=16, n_heads=2, n_layers=1, d_inner=32,
+        vocab=64, dropout_rate=dropout_rate, with_optimizer=True,
+        learning_rate=0.5, warmup_steps=100)
+    return main, startup, cost
+
+
+def _transformer_feed(batch=4, seq=8, vocab=64, seed=0):
+    r = np.random.RandomState(seed)
+    return {
+        "src_ids": r.randint(0, vocab, (batch, seq)).astype(np.int64),
+        "tgt_ids": r.randint(0, vocab, (batch, seq)).astype(np.int64),
+        "label": r.randint(0, vocab, (batch, seq)).astype(np.int64),
+    }
+
+
+class TestRunStepsParity:
+    def test_mnist_fc_same_feed(self):
+        """Constant-feed mode: one dict, steps=K."""
+        _fresh()
+        prog, startup, loss = _mnist_fc()
+        feed = _mnist_feeds(1)[0]
+        K = 5
+        seq, _ = _losses_sequential(prog, startup, loss, [feed] * K)
+        scan, _ = _losses_scanned(prog, startup, loss, None,
+                                  same_feed=feed, steps=K)
+        np.testing.assert_allclose(scan, seq, rtol=0, atol=1e-6)
+        assert seq[-1] < seq[0]  # the trajectory actually trains
+
+    def test_mnist_fc_per_step_feeds_and_final_state(self):
+        """Staged mode: K distinct batches enter as scan xs; the
+        post-window persistable state matches the sequential path."""
+        _fresh()
+        prog, startup, loss = _mnist_fc()
+        feeds = _mnist_feeds(4)
+        seq, sc_seq = _losses_sequential(prog, startup, loss, feeds)
+        scan, sc_scan = _losses_scanned(prog, startup, loss, feeds)
+        np.testing.assert_allclose(scan, seq, rtol=0, atol=1e-6)
+        for name in ("fc_0.w_0",):
+            a, b = sc_seq._get(name), sc_scan._get(name)
+            if a is None or b is None:
+                continue
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=0, atol=1e-6)
+
+    def test_dropout_step_key_parity(self):
+        """Sampling ops inside the scan must draw the EXACT per-step
+        noise of sequential runs: the step key advances once per scan
+        iteration via the same split the per-step executor does."""
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            h = fluid.layers.fc(x, 32, act="relu")
+            h = fluid.layers.dropout(h, dropout_prob=0.4)
+            logits = fluid.layers.fc(h, 4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.Adam(0.02).minimize(loss)
+        r = np.random.RandomState(1)
+        feed = {"x": r.randn(16, 8).astype(np.float32),
+                "y": r.randint(0, 4, (16, 1)).astype(np.int64)}
+        K = 6
+        seq, _ = _losses_sequential(prog, startup, loss, [feed] * K)
+        scan, _ = _losses_scanned(prog, startup, loss, None,
+                                  same_feed=feed, steps=K)
+        # dropout noise diverging would show up WAY above 1e-6
+        np.testing.assert_allclose(scan, seq, rtol=0, atol=1e-6)
+
+    def test_transformer_with_dropout(self):
+        _fresh()
+        prog, startup, cost = _tiny_transformer(dropout_rate=0.1)
+        feed = _transformer_feed()
+        K = 3
+        seq, _ = _losses_sequential(prog, startup, cost, [feed] * K)
+        scan, _ = _losses_scanned(prog, startup, cost, None,
+                                  same_feed=feed, steps=K)
+        np.testing.assert_allclose(scan, seq, rtol=0, atol=1e-6)
+
+    def test_transformer_amp(self):
+        """bf16 AMP casts happen at trace time (run_op), so the scan
+        body sees the identical cast placement as the per-step path."""
+        from paddle_tpu import amp
+
+        _fresh()
+        prog, startup, cost = _tiny_transformer()
+        feed = _transformer_feed(seed=2)
+        K = 3
+        with amp.amp_guard(True):
+            seq, _ = _losses_sequential(prog, startup, cost,
+                                        [feed] * K)
+            scan, _ = _losses_scanned(prog, startup, cost, None,
+                                      same_feed=feed, steps=K)
+        np.testing.assert_allclose(scan, seq, rtol=0, atol=1e-6)
+
+
+class TestRunStepsFallback:
+    def test_py_reader_program_falls_back_with_named_reason(self):
+        """io_callback reader ops pop one batch per step from host
+        state -- unlowerable into lax.scan; the named reason fires and
+        the per-step path still trains correctly."""
+        _fresh()
+        B = 8
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            reader = fluid.layers.py_reader(
+                capacity=4, shapes=[(B, 8), (B, 1)],
+                dtypes=["float32", "int64"])
+            x, y = fluid.layers.read_file(reader)
+            logits = fluid.layers.fc(x, 4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        r = np.random.RandomState(0)
+        batches = [(r.randn(B, 8).astype(np.float32),
+                    r.randint(0, 4, (B, 1)).astype(np.int64))
+                   for _ in range(8)]
+        reader.decorate_tensor_provider(lambda: iter(batches))
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        K = 3
+        out = exe.run_steps(prog, fetch_list=[loss], steps=K, scope=sc)
+        reason = exe.last_run_steps_fallback
+        assert reason is not None
+        assert "host" in reason and "lax.scan" in reason
+        assert np.asarray(out[0]).shape[0] == K
+        assert np.all(np.isfinite(np.asarray(out[0])))
+
+    def test_go_program_falls_back(self):
+        _fresh()
+        seen = []
+
+        def record(arr):
+            seen.append(np.asarray(arr).copy())
+            return np.asarray(arr)
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.scale(x, scale=2.0)
+            with fluid.layers.Go():
+                sink = prog.current_block().create_var(
+                    name="rs_go_sink", shape=[-1, 4], dtype="float32")
+                fluid.layers.py_func(record, y, out=sink)
+            loss = fluid.layers.mean(y)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        K = 3
+        out = exe.run_steps(prog, feed=feed, fetch_list=[loss],
+                            steps=K, scope=sc)
+        assert exe.last_run_steps_fallback is not None
+        assert "'go'" in exe.last_run_steps_fallback or \
+            "go" in exe.last_run_steps_fallback
+        np.testing.assert_allclose(np.asarray(out[0]).reshape(-1),
+                                   [2.0] * K, rtol=1e-6)
+        for t in getattr(exe, "_go_threads", []):
+            t.join(10)
+        assert len(seen) == K  # the go block fired once per step
+
+    def test_host_op_inside_sub_block_is_caught(self):
+        """The scannability walk must recurse into control-flow
+        sub-blocks: a host op inside a While body forces fallback."""
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            i = fluid.layers.fill_constant(shape=[1], dtype="int32",
+                                           value=0)
+            n = fluid.layers.fill_constant(shape=[1], dtype="int32",
+                                           value=2)
+            cond = fluid.layers.less_than(i, n)
+            w = fluid.layers.While(cond)
+            with w.block():
+                fluid.layers.Print(x, message="inside-while")
+                fluid.layers.increment(i, value=1, in_place=True)
+                fluid.layers.less_than(i, n, cond=cond)
+            loss = fluid.layers.mean(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        from paddle_tpu.core.executor import _scan_fallback_reason
+        reason = _scan_fallback_reason(prog)
+        assert reason is not None and "print" in reason
+
+    def test_compiled_program_falls_back(self):
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="int64")
+            logits = fluid.layers.fc(x, 4)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        cp = fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        r = np.random.RandomState(0)
+        feed = {"x": r.randn(16, 8).astype(np.float32),
+                "y": r.randint(0, 4, (16, 1)).astype(np.int64)}
+        out = exe.run_steps(cp, feed=feed, fetch_list=[loss.name],
+                            steps=2)
+        assert exe.last_run_steps_fallback is not None
+        assert "CompiledProgram" in exe.last_run_steps_fallback
+        assert np.asarray(out[0]).shape[0] == 2
+
+
+class TestRunStepsContract:
+    def test_steps_required_for_single_dict(self):
+        _fresh()
+        prog, startup, loss = _mnist_fc()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.raises(ValueError, match="steps"):
+            exe.run_steps(prog, feed=_mnist_feeds(1)[0],
+                          fetch_list=[loss])
+
+    def test_mismatched_feed_keys_rejected(self):
+        _fresh()
+        prog, startup, loss = _mnist_fc()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        f1, f2 = _mnist_feeds(2)
+        del f2["label"]
+        with pytest.raises(ValueError, match="same variable names"):
+            exe.run_steps(prog, feed=[f1, f2], fetch_list=[loss])
+
+    def test_stacked_fetch_shape(self):
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            out = fluid.layers.scale(x, scale=3.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": np.ones((2, 4), np.float32)}
+        res = exe.run_steps(prog, feed=feed, fetch_list=[out], steps=4)
+        assert exe.last_run_steps_fallback is None
+        assert np.asarray(res[0]).shape == (4, 2, 4)
+        np.testing.assert_allclose(np.asarray(res[0]),
+                                   np.full((4, 2, 4), 3.0))
+
+    def test_return_numpy_false_returns_device_arrays(self):
+        import jax
+
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            out = fluid.layers.scale(x, scale=2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run_steps(prog, feed={"x": np.ones((2, 4),
+                                                     np.float32)},
+                            fetch_list=[out], steps=3,
+                            return_numpy=False)
+        assert isinstance(res[0], jax.Array)
+
+
+class TestDoubleBufferedFeed:
+    def test_pyreader_double_buffer_stages_on_device(self):
+        """use_double_buffer=True: the fill thread device_puts each
+        batch, so the consumer pops device-resident arrays (H2D of
+        batch k+1 overlaps step k)."""
+        import jax
+
+        _fresh()
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            x = fluid.layers.data("px", shape=[4], dtype="float32")
+        from paddle_tpu.reader import PyReader
+
+        batches = [[(np.full(4, i, np.float32),)] for i in range(5)]
+        rd = PyReader(feed_list=[x], capacity=4,
+                      use_double_buffer=True)
+        rd.decorate_sample_list_generator(lambda: iter(batches))
+        got = list(rd)
+        assert len(got) == 5
+        for i, item in enumerate(got):
+            assert isinstance(item["px"], jax.Array)
+            np.testing.assert_allclose(np.asarray(item["px"]),
+                                       np.full((1, 4), i))
+
+    def test_pyreader_host_mode_unchanged(self):
+        _fresh()
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            x = fluid.layers.data("hx", shape=[4], dtype="float32")
+        from paddle_tpu.reader import PyReader
+
+        batches = [[(np.full(4, i, np.float32),)] for i in range(3)]
+        rd = PyReader(feed_list=[x], capacity=4,
+                      use_double_buffer=False)
+        rd.decorate_sample_list_generator(lambda: iter(batches))
+        got = list(rd)
+        assert len(got) == 3
+        assert isinstance(got[0]["hx"], np.ndarray)
+
+    def test_prefetch_to_device_preserves_order_and_values(self):
+        import jax
+
+        from paddle_tpu.reader import prefetch_to_device
+
+        feeds = ({"a": np.full((2, 2), i, np.float32)}
+                 for i in range(6))
+        out = list(prefetch_to_device(feeds, capacity=2))
+        assert len(out) == 6
+        for i, f in enumerate(out):
+            assert isinstance(f["a"], jax.Array)
+            np.testing.assert_allclose(np.asarray(f["a"]),
+                                       np.full((2, 2), i))
+
+    def test_prefetch_to_device_propagates_errors(self):
+        from paddle_tpu.reader import prefetch_to_device
+
+        def bad():
+            yield {"a": np.zeros(2, np.float32)}
+            raise RuntimeError("reader exploded")
+
+        it = prefetch_to_device(bad(), capacity=1)
+        next(it)
+        with pytest.raises(RuntimeError, match="reader exploded"):
+            list(it)
+
+    def test_data_feeder_place_returns_device_arrays(self):
+        import jax
+
+        _fresh()
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            x = fluid.layers.data("fx", shape=[3], dtype="float32")
+        feeder = fluid.DataFeeder([x], place=fluid.CPUPlace(),
+                                  program=prog)
+        feed = feeder.feed([(np.ones(3, np.float32),),
+                            (np.zeros(3, np.float32),)])
+        assert isinstance(feed["fx"], jax.Array)
+        assert feed["fx"].shape == (2, 3)
+
+
+class TestRunStepsDispatchWin:
+    def test_scan_not_slower_than_sequential_on_cpu(self):
+        """The CPU-measurable claim: amortizing K Python dispatches
+        into one scan call must not LOSE to the sequential loop on a
+        small config (it typically wins big; the bound here is loose
+        so CI noise can't flake it)."""
+        import time
+
+        _fresh()
+        prog, startup, loss = _mnist_fc()
+        feed = _mnist_feeds(1, batch=8)[0]
+        K = 30
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc1 = fluid.Scope()
+        exe.run(startup, scope=sc1)
+        # warm both executables outside the timed windows
+        exe.run(prog, feed=feed, fetch_list=[loss], scope=sc1)
+        t0 = time.perf_counter()
+        for _ in range(K):
+            exe.run(prog, feed=feed, fetch_list=[loss], scope=sc1,
+                    return_numpy=False)
+        t_seq = time.perf_counter() - t0
+
+        sc2 = fluid.Scope()
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup, scope=sc2)
+        exe2.run_steps(prog, feed=feed, fetch_list=[loss], steps=K,
+                       scope=sc2)
+        t0 = time.perf_counter()
+        exe2.run_steps(prog, feed=feed, fetch_list=[loss], steps=K,
+                       scope=sc2, return_numpy=False)
+        t_scan = time.perf_counter() - t0
+        assert exe2.last_run_steps_fallback is None
+        # generous 2x guard: the real measured ratio is recorded in
+        # PERF.md ("Host dispatch & the multi-step scan")
+        assert t_scan < 2.0 * t_seq, (t_scan, t_seq)
