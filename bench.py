@@ -536,12 +536,92 @@ def bench_transformer_scan_fused():
     return res
 
 
+def bench_serving(n_requests=400):
+    """Inference serving throughput at batch-of-1 arrivals: the naive
+    per-request `AnalysisPredictor.run` loop vs the DynamicBatcher
+    server (inference/serving.py), cold and AOT-warmed. The win is
+    the run_steps dispatch-amortization arithmetic applied to serving
+    (PERF.md "Serving path") and is CPU-measurable the same way; on
+    the tunneled chip the per-request readback (~75 ms) makes the
+    batching factor nearly linear in achieved batch occupancy.
+    Fail-fast (exit 3) on a dead backend is inherited from main()'s
+    _probe_backend, same as every other config."""
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu.inference import (AnalysisConfig, InferenceServer,
+                                      PaddleTensor,
+                                      create_paddle_predictor)
+
+    in_dim, hidden, classes = 256, 512, 32
+    max_batch = 16
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[in_dim],
+                              dtype="float32")
+        h = fluid.layers.fc(input=x, size=hidden, act="relu")
+        out = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    mdir = tempfile.mkdtemp(prefix="serving_bench_")
+    fluid.save_inference_model(mdir, ["x"], [out], exe,
+                               main_program=prog)
+    pred = create_paddle_predictor(AnalysisConfig(mdir))
+    r = np.random.RandomState(0)
+    reqs = [r.randn(1, in_dim).astype(np.float32)
+            for _ in range(n_requests)]
+
+    def timed_naive():
+        pred.run([PaddleTensor(reqs[0], name="x")])  # warm the shape
+        t0 = time.perf_counter()
+        for a in reqs:
+            pred.run([PaddleTensor(a, name="x")])
+        return n_requests / (time.perf_counter() - t0)
+
+    def timed_server(warm):
+        # share_cache=False isolates each measurement's compile work
+        worker = pred.clone(share_cache=False)
+        with InferenceServer(worker, max_batch_size=max_batch,
+                             max_wait_ms=2.0) as srv:
+            if warm:
+                srv.aot_warmup()
+            t0 = time.perf_counter()
+            replies = [srv.submit({"x": a}) for a in reqs]
+            for rep in replies:
+                rep.result(timeout=600.0)
+            rps = n_requests / (time.perf_counter() - t0)
+            st = srv.stats()
+        return rps, st
+
+    naive_rps = timed_naive()
+    cold_rps, _ = timed_server(warm=False)
+    warm_rps, st = timed_server(warm=True)
+    return {
+        "metric": "serving_requests_per_sec_batch1_arrivals",
+        "value": round(warm_rps, 1),
+        "unit": "requests/sec",
+        "naive_rps": round(naive_rps, 1),
+        "batched_rps": round(cold_rps, 1),
+        "batched_warmed_rps": round(warm_rps, 1),
+        "speedup_batched": round(cold_rps / naive_rps, 2),
+        "speedup_warmed": round(warm_rps / naive_rps, 2),
+        "batch_occupancy": st["batch_occupancy"],
+        "p50_ms": st["latency_ms"]["p50"],
+        "p99_ms": st["latency_ms"]["p99"],
+        "compile_count": st["compile_count"],
+        "max_batch_size": max_batch,
+        "n_requests": n_requests,
+        "model": f"fc {in_dim}->{hidden}->{classes}",
+    }
+
+
 # opt-in configs (argv-selectable only; never in the driver's default
 # window)
 EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
                  "moe_transformer": bench_moe_transformer,
                  "transformer_fused": bench_transformer_fused,
-                 "transformer_scan_fused": bench_transformer_scan_fused}
+                 "transformer_scan_fused": bench_transformer_scan_fused,
+                 "serving": bench_serving}
 
 
 def _probe_backend(timeout_s=180):
@@ -595,9 +675,14 @@ def main():
                   file=sys.stderr)
             continue
         print(json.dumps(res), flush=True)
-        print(f"# {name}: device={device} loss {res['loss0']:.4f}->"
-              f"{res['loss1']:.4f} decreased={res['loss_decreased']}",
-              file=sys.stderr)
+        if "loss0" in res:
+            print(f"# {name}: device={device} loss {res['loss0']:.4f}"
+                  f"->{res['loss1']:.4f} "
+                  f"decreased={res['loss_decreased']}",
+                  file=sys.stderr)
+        else:
+            print(f"# {name}: device={device} "
+                  f"{res['value']} {res['unit']}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -541,13 +541,23 @@ class Executor:
     """
 
     def __init__(self, place: Optional[TPUPlace] = None,
-                 donate: bool = True):
+                 donate: bool = True, cache: Optional[Dict] = None):
         # donate=False for executors whose scope is shared across
         # threads (AsyncExecutor Hogwild workers): a donated buffer is
         # deleted after the step, which would break concurrent readers
         self.place = place or TPUPlace()
         self.donate = donate
-        self._cache: Dict = {}
+        # `cache` lets serving workers SHARE one executable cache
+        # (AnalysisPredictor.clone): the keys carry the process-unique
+        # program _uid + _version, so sharing the dict across executors
+        # running the same program object is sound — a warmed bucket
+        # compiled by one worker is a cache hit for every other.
+        self._cache: Dict = {} if cache is None else cache
+        # observability: how many XLA specializations THIS executor
+        # built vs served from cache (serving perf is unverifiable
+        # without these — the bucket-bound tests read them)
+        self.compile_count = 0
+        self.cache_hit_count = 0
         # run_steps: named reason the last call used the per-step
         # fallback (None = the K-step scan path ran)
         self.last_run_steps_fallback: Optional[str] = None
@@ -737,8 +747,11 @@ class Executor:
 
                 step = NativeBuiltStep(program, scope, feed_arrays,
                                        fetch_names)
+                self.compile_count += 1
                 if use_program_cache:
                     self._cache[nkey] = step
+            else:
+                self.cache_hit_count += 1
             fetched = step.run(scope, feed_arrays)
             out = [fetched[n] for n in fetch_names]
             if FLAGS.check_nan_inf:
@@ -758,8 +771,11 @@ class Executor:
                                      tuple(sorted(feed_arrays)),
                                      fetch_names, scope,
                                      feed_arrays=feed_arrays)
+            self.compile_count += 1
             if use_program_cache:
                 self._cache[key] = compiled
+        else:
+            self.cache_hit_count += 1
 
         mut = self._scope_state(scope, compiled.state_in, device)
         const_st = self._scope_state(scope, compiled.const_in, device)
@@ -933,8 +949,11 @@ class Executor:
                 fetch_names, scope, steps,
                 stacked=feeds_seq is not None, feed_arrays=feed_arrays,
                 device=device)
+            self.compile_count += 1
             if use_program_cache:
                 self._cache[key] = compiled
+        else:
+            self.cache_hit_count += 1
 
         carry = self._scope_state(scope, compiled.state_in, device)
         const_st = self._scope_state(scope, compiled.const_in, device)

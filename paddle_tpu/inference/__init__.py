@@ -18,10 +18,14 @@ from .export import (StableHLOServer, StableHLOTrainer,
                      load_stablehlo, load_train_stablehlo)
 from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
+from .serving import (GenerationServer, InferenceServer,
+                      apply_eos_sentinel, default_batch_buckets)
 
 __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "AnalysisPredictor", "PaddlePredictor", "PaddleTensor",
            "ZeroCopyTensor", "create_paddle_predictor",
            "StableHLOServer", "export_stablehlo", "load_stablehlo",
            "StableHLOTrainer", "export_train_stablehlo",
-           "load_train_stablehlo"]
+           "load_train_stablehlo", "InferenceServer",
+           "GenerationServer", "apply_eos_sentinel",
+           "default_batch_buckets"]

@@ -57,6 +57,7 @@ class AnalysisConfig(NativeConfig):
         self._use_feed_fetch_ops = False
         self._specify_input_name = True
         self._profile = False
+        self._serving: Optional[dict] = None
 
     # --- model location ------------------------------------------------
     def set_model(self, x: str, y: Optional[str] = None):
@@ -102,6 +103,29 @@ class AnalysisConfig(NativeConfig):
 
     def all_passes(self) -> List[str]:
         return list(self._passes)
+
+    # --- dynamic batching (inference/serving.py InferenceServer) -------
+    def enable_dynamic_batching(self, max_batch_size: int = 8,
+                                max_wait_ms: float = 2.0,
+                                batch_buckets=None, seq_buckets=()):
+        """Record serving defaults on the config: an InferenceServer
+        built over a predictor carrying these knobs picks them up
+        without per-callsite plumbing; explicit InferenceServer
+        constructor arguments take precedence over the config's
+        values (the reference's analogous knob
+        surface is EnableTensorRtEngine's max_batch_size/workspace
+        args, inference/api/paddle_analysis_config.h -- engine tuning
+        lives on the config, not the call)."""
+        self._serving = {
+            "max_batch_size": int(max_batch_size),
+            "max_wait_ms": float(max_wait_ms),
+            "batch_buckets": (list(batch_buckets)
+                              if batch_buckets is not None else None),
+            "seq_buckets": list(seq_buckets),
+        }
+
+    def serving_options(self) -> Optional[dict]:
+        return dict(self._serving) if self._serving else None
 
     # --- TPU precision (stands in for enable_tensorrt_engine) ----------
     def enable_tpu_bf16(self):

@@ -171,6 +171,8 @@ class AnalysisPredictor(PaddlePredictor):
 
     # --- execution ------------------------------------------------------
     def _run_feed(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        import jax
+
         if isinstance(self._config, AnalysisConfig) and (
                 self._config.precision_mode()
                 == AnalysisConfig.Precision.Bfloat16):
@@ -182,7 +184,13 @@ class AnalysisPredictor(PaddlePredictor):
         outs = self._exe.run(self._program, feed=feed,
                              fetch_list=self._fetch_names,
                              scope=self._scope, return_numpy=False)
-        return [np.asarray(o, dtype=np.float32)
+        # ONE batched device->host pull: jax.device_get starts the
+        # copy of every fetch before blocking on any, where a per-
+        # fetch np.asarray loop pays one full round-trip each (~75 ms
+        # per fetch through the TPU tunnel -- PERF.md "Measurement
+        # pitfalls" / "Serving path")
+        outs = jax.device_get(outs)
+        return [np.asarray(o).astype(np.float32)
                 if str(np.asarray(o).dtype) == "bfloat16" else
                 np.asarray(o) for o in outs]
 
@@ -210,24 +218,39 @@ class AnalysisPredictor(PaddlePredictor):
 
     run_zero_copy = zero_copy_run
 
-    def clone(self) -> "AnalysisPredictor":
+    def clone(self, share_cache: bool = True) -> "AnalysisPredictor":
         """Clone from the already-loaded program (reference
         AnalysisPredictor::Clone shares the loaded program and
         re-creates the executor) -- no disk re-read, so cloning still
         works after the export dir is gone. The config is deep-copied so
         append_pass/delete_pass on one predictor cannot leak into the
         other; scope state (params) is shared copy-on-write via the
-        immutable jax arrays."""
+        immutable jax arrays.
+
+        share_cache=True (the serving default) additionally shares the
+        PROGRAM OBJECT and the executor's compiled-executable cache:
+        the analysis pipeline already ran at load, the clone runs the
+        identical program, and the cache keys carry _uid/_version, so
+        a bucket warmed by one worker is a zero-compile cache hit for
+        every clone (N serving threads used to recompile N times). A
+        post-clone Pass.apply on the shared program bumps _version and
+        invalidates the cache for ALL sharers -- consistent, never
+        stale. share_cache=False restores the fully isolated clone
+        (program deep-cloned under a fresh _uid, private cache)."""
         twin = AnalysisPredictor.__new__(AnalysisPredictor)
         twin._config = copy.deepcopy(self._config)
         twin._scope = Scope()
         for name in self._scope.local_var_names():
             twin._scope._set(name, self._scope._get(name))
-        twin._exe = Executor(TPUPlace(0))
         twin._zero_copy_inputs = {}
         twin._zero_copy_outputs = {}
-        twin._program = self._program.clone() \
-            if hasattr(self._program, "clone") else self._program
+        if share_cache:
+            twin._exe = Executor(TPUPlace(0), cache=self._exe._cache)
+            twin._program = self._program
+        else:
+            twin._exe = Executor(TPUPlace(0))
+            twin._program = self._program.clone() \
+                if hasattr(self._program, "clone") else self._program
         twin._feed_names = list(self._feed_names)
         twin._fetch_names = list(self._fetch_names)
         return twin

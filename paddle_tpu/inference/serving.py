@@ -1,0 +1,566 @@
+"""InferenceServer: batched, bucketed serving over a loaded predictor.
+
+Reference counterpart: inference/api/analysis_predictor.cc:192 Run is a
+one-request API — the reference leaves batching to the caller (its C++
+deploy apps loop requests through one predictor). Serving heavy traffic
+on TPU inverts the economics: every `AnalysisPredictor.run` costs one
+Python dispatch plus one host readback, and every DISTINCT feed shape
+costs a fresh XLA compile (the executable cache is keyed on feed
+specs). This module applies the PERF.md "Host dispatch & the multi-step
+scan" arithmetic to inference — amortize dispatch/readback over a
+micro-batch — plus the Clipper/ORT-style dynamic-batching discipline
+(PAPERS.md):
+
+* **DynamicBatcher** — a thread-safe request queue; a single batcher
+  thread forms micro-batches up to ``max_batch_size`` rows or
+  ``max_wait_ms`` after the oldest queued request, runs ONE compiled
+  executable, and demultiplexes output rows back to each caller.
+* **Shape bucketing** — the batch dim is padded UP to a fixed ladder
+  (1, 2, 4, ... max_batch_size) by replicating the last real row, and
+  declared ``-1`` sequence dims are padded up to ``seq_buckets`` (with
+  ``name@SEQ_LEN`` companions left at the REAL lengths), so the number
+  of executables is bounded by #batch-buckets x #seq-buckets instead
+  of growing with traffic shape diversity.
+* **aot_warmup()** — pre-compiles every bucket before traffic by
+  pushing one synthetic batch per bucket through the normal path; this
+  SEEDS the Executor cache (keyed on feed specs), it is not a second
+  compiler path.
+* **GenerationServer** — routes multi-token requests through the
+  KV-cached While-loop decode program
+  (models/transformer.py:373 build_incremental_decode_program), so a
+  T-token generation is ONE dispatch + ONE readback instead of T.
+
+Observability: `stats()` returns queue depth, batch occupancy, compile
+and cache-hit counts (Executor.compile_count / cache_hit_count) and
+p50/p99 request latency — serving perf work is unverifiable without
+them.
+"""
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from concurrent import futures
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.executor import Executor, TPUPlace
+from ..core.scope import global_scope
+from ..core.types import to_np_dtype
+
+SEQ_SUFFIX = "@SEQ_LEN"
+
+
+def default_batch_buckets(max_batch_size: int) -> List[int]:
+    """Power-of-two ladder 1,2,4,... capped at (and always including)
+    max_batch_size (the shape-specialization analogue of the
+    reference's TRT max-batch knob, inference/api/
+    paddle_analysis_config.h EnableTensorRtEngine max_batch_size —
+    there one engine serves [1, max]; XLA specializes per shape, so
+    the ladder bounds the specialization count instead)."""
+    if max_batch_size < 1:
+        raise ValueError(f"max_batch_size must be >= 1, got "
+                         f"{max_batch_size}")
+    ladder = []
+    b = 1
+    while b < max_batch_size:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch_size)
+    return ladder
+
+
+def _bucket_for(size: int, ladder: Sequence[int], what: str) -> int:
+    for b in ladder:
+        if size <= b:
+            return b
+    raise ValueError(
+        f"{what} {size} exceeds the largest bucket {max(ladder)}; "
+        f"raise the bucket ladder or split the request")
+
+
+def _pad_rows(arr: np.ndarray, rows: int) -> np.ndarray:
+    """Pad the batch axis up to `rows` by replicating the last real
+    row: replication (vs zeros) keeps padded rows numerically benign
+    for any op (no fresh NaN/inf paths), and padded rows are sliced
+    away before demux anyway."""
+    have = arr.shape[0]
+    if have == rows:
+        return arr
+    reps = np.repeat(arr[-1:], rows - have, axis=0)
+    return np.concatenate([arr, reps], axis=0)
+
+
+def _pad_axis(arr: np.ndarray, axis: int, size: int) -> np.ndarray:
+    """Zero-pad `axis` up to `size` (sequence bucketing; real lengths
+    ride the @SEQ_LEN companion untouched)."""
+    have = arr.shape[axis]
+    if have == size:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, size - have)
+    return np.pad(arr, widths)
+
+
+# per-request future the batcher thread fulfils after demux; the
+# stdlib Future already provides done()/result(timeout)/set_result/
+# set_exception with the right rethrow semantics
+_Reply = futures.Future
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "reply", "t_arrival")
+
+    def __init__(self, feed, rows, reply):
+        self.feed = feed
+        self.rows = rows
+        self.reply = reply
+        self.t_arrival = time.monotonic()
+
+
+class _PredictorRunner:
+    """Adapts an AnalysisPredictor to the server's runner protocol."""
+
+    def __init__(self, predictor):
+        self._predictor = predictor
+        self.feed_names = list(predictor.get_input_names())
+        self.fetch_names = list(predictor.get_output_names())
+        self.program = predictor.program()
+        self.executor = predictor._exe
+
+    def run_batch(self, feed):
+        return self._predictor._run_feed(feed)
+
+
+class ProgramRunner:
+    """Runs a raw Program (the generation path) through an Executor
+    against a trained scope (the serving reading of reference
+    python/paddle/fluid/executor.py:451 run); one batched
+    device->host pull per batch (see AnalysisPredictor._run_feed for
+    the per-fetch pitfall)."""
+
+    def __init__(self, program, feed_names, fetch_names, executor=None,
+                 scope=None):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.executor = executor or Executor(TPUPlace(0))
+        self.scope = scope or global_scope()
+
+    def run_batch(self, feed):
+        import jax
+
+        outs = self.executor.run(self.program, feed=feed,
+                                 fetch_list=self.fetch_names,
+                                 scope=self.scope, return_numpy=False)
+        return [np.asarray(o) for o in jax.device_get(outs)]
+
+
+class InferenceServer:
+    """Dynamic-batching, shape-bucketing server over a predictor.
+
+    Reference counterpart: AnalysisPredictor::Run
+    (inference/api/analysis_predictor.cc:192) is the one-request API
+    this batches over; the reference has no traffic layer (its C++
+    deploy apps loop requests), so the batcher follows the
+    Clipper/ORT dynamic-batching discipline instead (PAPERS.md).
+
+    Requests are feed dicts whose arrays carry a leading batch axis
+    (batch-of-1 arrivals are the common case); fetched outputs must be
+    batch-major the same way (true for every program this framework
+    builds: fixed-size padded outputs with batch at axis 0).
+
+    ``submit`` enqueues and returns a future-like reply; ``infer``
+    blocks for one request. A single batcher thread groups compatible
+    requests (same post-bucketing shape signature), pads the batch dim
+    up the bucket ladder, runs ONE executable, and slices each
+    caller's rows back out.
+    """
+
+    def __init__(self, predictor_or_runner,
+                 max_batch_size: Optional[int] = None,
+                 max_wait_ms: Optional[float] = None,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 seq_buckets: Optional[Sequence[int]] = None,
+                 start: bool = True):
+        # precedence: explicit constructor args > the predictor
+        # config's enable_dynamic_batching knobs > built-in defaults
+        # (a call site tightening max_batch_size must win over the
+        # config it did not write)
+        knobs = None
+        if hasattr(predictor_or_runner, "run_batch"):
+            self._runner = predictor_or_runner
+        else:
+            self._runner = _PredictorRunner(predictor_or_runner)
+            cfg = getattr(predictor_or_runner, "_config", None)
+            knobs = getattr(cfg, "serving_options", lambda: None)()
+        if knobs:
+            if max_batch_size is None:
+                max_batch_size = knobs.get("max_batch_size")
+            if max_wait_ms is None:
+                max_wait_ms = knobs.get("max_wait_ms")
+            if batch_buckets is None:
+                batch_buckets = knobs.get("batch_buckets")
+            if seq_buckets is None and knobs.get("seq_buckets"):
+                seq_buckets = knobs["seq_buckets"]
+        self.max_batch_size = int(
+            max_batch_size if max_batch_size is not None else 8)
+        self.max_wait_ms = float(
+            max_wait_ms if max_wait_ms is not None else 2.0)
+        seq_buckets = seq_buckets if seq_buckets is not None else ()
+        self.batch_buckets = sorted(
+            set(batch_buckets or default_batch_buckets(
+                self.max_batch_size)))
+        if self.batch_buckets[-1] < self.max_batch_size:
+            raise ValueError(
+                f"batch_buckets {self.batch_buckets} do not cover "
+                f"max_batch_size={self.max_batch_size}")
+        self.seq_buckets = sorted(set(int(s) for s in seq_buckets))
+        self._feed_names = list(self._runner.feed_names)
+        self._fetch_names = list(self._runner.fetch_names)
+        self._block = self._runner.program.global_block
+
+        self._cv = threading.Condition()
+        # group key -> FIFO of pending requests (insertion order is
+        # arrival order; dict preserves group creation order)
+        self._groups: Dict[tuple, collections.deque] = {}
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+        # observability counters (under _cv)
+        self._n_requests = 0
+        self._n_batches = 0
+        self._n_rows = 0
+        self._n_padded_rows = 0
+        self._latencies = collections.deque(maxlen=4096)
+        self._warmed_compiles = 0
+
+        if start:
+            self.start()
+
+    # --- lifecycle ----------------------------------------------------
+    def start(self):
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def close(self, timeout: float = 5.0):
+        """Stop the batcher; pending requests are failed, not dropped
+        silently."""
+        with self._cv:
+            self._running = False
+            pending = [r for grp in self._groups.values() for r in grp]
+            self._groups.clear()
+            self._cv.notify_all()
+        for r in pending:
+            r.reply.set_exception(
+                RuntimeError("InferenceServer closed"))
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --- request path -------------------------------------------------
+    def submit(self, feed: Dict[str, np.ndarray]) -> _Reply:
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"missing inputs: {missing}")
+        rows = int(feed[self._feed_names[0]].shape[0])
+        if rows < 1:
+            raise ValueError("empty request: feeds need >= 1 row")
+        for n in self._feed_names:
+            if feed[n].shape[0] != rows:
+                raise ValueError(
+                    f"feed {n!r} has {feed[n].shape[0]} rows but "
+                    f"{self._feed_names[0]!r} has {rows}; all inputs "
+                    f"share the batch axis")
+        if rows > self.max_batch_size:
+            raise ValueError(
+                f"request of {rows} rows exceeds max_batch_size="
+                f"{self.max_batch_size}; split it client-side")
+        feed, key = self._bucket_seq(feed)
+        reply = _Reply()
+        req = _Request(feed, rows, reply)
+        with self._cv:
+            if not self._running:
+                raise RuntimeError("InferenceServer is closed")
+            self._groups.setdefault(key, collections.deque()).append(
+                req)
+            self._n_requests += 1
+            self._cv.notify_all()
+        return reply
+
+    def infer(self, feed: Dict[str, np.ndarray],
+              timeout: Optional[float] = 60.0) -> List[np.ndarray]:
+        return self.submit(feed).result(timeout)
+
+    # --- bucketing ----------------------------------------------------
+    def _declared_shape(self, name):
+        v = self._block._find_var_recursive(name)
+        return tuple(v.shape) if v is not None and v.shape else None
+
+    def _bucket_seq(self, feed):
+        """Pad declared -1 non-batch dims up to the seq-bucket ladder;
+        returns (padded feed, group key). @SEQ_LEN companions keep the
+        REAL lengths — padded tail positions are masked by sequence
+        ops exactly like ordinary pad (the framework's no-LoD
+        contract)."""
+        out = {}
+        key = []
+        for name in sorted(feed):
+            arr = feed[name]
+            want = self._declared_shape(name)
+            if want is not None and not name.endswith(SEQ_SUFFIX) \
+                    and len(want) == arr.ndim:
+                for ax in range(1, arr.ndim):
+                    if want[ax] == -1 and self.seq_buckets:
+                        arr = _pad_axis(
+                            arr, ax,
+                            _bucket_for(arr.shape[ax],
+                                        self.seq_buckets,
+                                        f"sequence dim of {name!r}"))
+            out[name] = arr
+            key.append((name, arr.shape[1:], str(arr.dtype)))
+        return out, tuple(key)
+
+    # --- batcher thread -----------------------------------------------
+    def _oldest_group(self):
+        best = None
+        for key, grp in self._groups.items():
+            if grp and (best is None
+                        or grp[0].t_arrival
+                        < self._groups[best][0].t_arrival):
+                best = key
+        return best
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while self._running and self._oldest_group() is None:
+                    self._cv.wait()
+                if not self._running:
+                    return
+                key = self._oldest_group()
+                grp = self._groups[key]
+                deadline = grp[0].t_arrival + self.max_wait_ms / 1e3
+                while self._running:
+                    rows = sum(r.rows for r in grp)
+                    now = time.monotonic()
+                    if rows >= self.max_batch_size or now >= deadline:
+                        break
+                    self._cv.wait(timeout=deadline - now)
+                    grp = self._groups.get(key)
+                    if grp is None or not grp:
+                        break  # close() drained us
+                if not self._running:
+                    return
+                grp = self._groups.get(key)
+                if grp is None or not grp:
+                    continue
+                batch, taken = [], 0
+                while grp and taken + grp[0].rows <= self.max_batch_size:
+                    r = grp.popleft()
+                    batch.append(r)
+                    taken += r.rows
+                if not grp:
+                    del self._groups[key]
+            if batch:
+                self._dispatch(batch, taken)
+
+    def _dispatch(self, batch: List[_Request], rows: int):
+        bucket = _bucket_for(rows, self.batch_buckets, "batch rows")
+        try:
+            feed = {
+                name: _pad_rows(
+                    np.concatenate([r.feed[name] for r in batch],
+                                   axis=0)
+                    if len(batch) > 1 else batch[0].feed[name],
+                    bucket)
+                for name in batch[0].feed}
+            outs = self._runner.run_batch(feed)
+        except BaseException as e:
+            for r in batch:
+                r.reply.set_exception(e)
+            return
+        done_t = time.monotonic()
+        # counters BEFORE fulfilling the futures: a caller unblocked
+        # by set_result may read stats() immediately and must see the
+        # batch that just completed
+        with self._cv:
+            self._n_batches += 1
+            self._n_rows += rows
+            self._n_padded_rows += bucket
+            for r in batch:
+                self._latencies.append(
+                    (done_t - r.t_arrival) * 1e3)
+        off = 0
+        for r in batch:
+            r.reply.set_result([np.asarray(o)[off:off + r.rows]
+                                for o in outs])
+            off += r.rows
+
+    # --- AOT warmup ---------------------------------------------------
+    def _warmup_feed_specs(self):
+        """Synthetic feed shapes for every bucket combination, derived
+        from the program's declared var shapes: batch -1 -> each batch
+        bucket, other -1 dims -> each seq bucket (all seq-bucketed
+        inputs move together per combination — mixed-per-input seq
+        buckets would square the executable count for no caller)."""
+        shapes = {}
+        needs_seq = False
+        for name in self._feed_names:
+            want = self._declared_shape(name)
+            if want is None:
+                raise ValueError(
+                    f"aot_warmup: feed {name!r} has no declared shape "
+                    f"in the program; warm manually via infer()")
+            if any(d == -1 for d in want[1:]):
+                needs_seq = True
+            shapes[name] = want
+        if needs_seq and not self.seq_buckets:
+            raise ValueError(
+                "aot_warmup: the program declares -1 sequence dims; "
+                "pass seq_buckets=(...) so warmup knows the ladder")
+        seq_ladder = self.seq_buckets if needs_seq else [None]
+        for seq in seq_ladder:
+            for b in self.batch_buckets:
+                feed = {}
+                for name, want in shapes.items():
+                    shp = [b] + [seq if d == -1 else d
+                                 for d in want[1:]]
+                    v = self._block._find_var_recursive(name)
+                    dt = to_np_dtype(v.dtype) if v is not None and \
+                        v.dtype is not None else np.float32
+                    if name.endswith(SEQ_SUFFIX):
+                        base = name[:-len(SEQ_SUFFIX)]
+                        bw = shapes.get(base)
+                        full = seq if (bw is not None
+                                       and any(d == -1
+                                               for d in bw[1:])) \
+                            else (bw[1] if bw and len(bw) > 1
+                                  else 1)
+                        feed[name] = np.full((b,), full, dtype=dt)
+                    else:
+                        feed[name] = np.zeros(shp, dtype=dt)
+                yield feed
+
+    def aot_warmup(self) -> int:
+        """Pre-compile every bucket before traffic: one synthetic
+        batch per (seq bucket x batch bucket) combination runs
+        directly through the runner at EXACTLY the padded shape the
+        batcher will dispatch, so this seeds the Executor's executable
+        cache under exactly the keys real traffic will hit (cache
+        seeding, not a second compiler path). Probes bypass the
+        request queue: queued probes of one ladder would coalesce
+        into a single micro-batch and only warm the largest bucket.
+        Returns the number of fresh compiles it caused."""
+        exe = self._runner.executor
+        before = exe.compile_count
+        for feed in self._warmup_feed_specs():
+            self._runner.run_batch(feed)
+        self._warmed_compiles = exe.compile_count - before
+        return self._warmed_compiles
+
+    # --- observability ------------------------------------------------
+    def stats(self) -> dict:
+        exe = self._runner.executor
+        with self._cv:
+            lat = sorted(self._latencies)
+            depth = sum(len(g) for g in self._groups.values())
+
+            def pct(p):
+                if not lat:
+                    return None
+                # nearest-rank: ceil(p*N)-1 (int(p*N) overshoots --
+                # p50 of 2 samples must be the 1st, not the 2nd)
+                idx = max(0, math.ceil(p * len(lat)) - 1)
+                return round(lat[min(len(lat) - 1, idx)], 3)
+
+            occ = (self._n_rows / self._n_padded_rows
+                   if self._n_padded_rows else None)
+            return {
+                "requests": self._n_requests,
+                "batches": self._n_batches,
+                "rows": self._n_rows,
+                "padded_rows": self._n_padded_rows,
+                "batch_occupancy": round(occ, 4) if occ else None,
+                "queue_depth": depth,
+                "compile_count": exe.compile_count,
+                "cache_hit_count": exe.cache_hit_count,
+                "warmed_compiles": self._warmed_compiles,
+                "latency_ms": {"p50": pct(0.50), "p99": pct(0.99)},
+            }
+
+
+class GenerationServer(InferenceServer):
+    """Dynamic-batching server for autoregressive generation
+    (reference tests/unittests/dist_transformer.py:1498 fast_decode
+    is the decode loop being served).
+
+    Wraps the KV-cached incremental decode program
+    (models/transformer.py:373): the whole T-token greedy loop is ONE
+    While-loop executable, so a served generation costs one dispatch +
+    one readback regardless of output length, and concurrent requests
+    share it through the same bucket ladder as plain inference.
+
+    ``generate(src_ids)`` accepts one source row ([T] or [1, T]) or a
+    [B, T] block, and returns the decode buffer rows for the REAL
+    rows only. With ``end_id`` set, positions strictly after the first
+    emitted end_id are rewritten to the fixed-size -1 sentinel (the
+    detection-op padded-output convention), so callers can split
+    variable-length results out of the static [maxT] buffer.
+    """
+
+    def __init__(self, program, out_var, feed_name: str = "src_ids",
+                 executor: Optional[Executor] = None, scope=None,
+                 end_id: Optional[int] = None, **kwargs):
+        out_name = getattr(out_var, "name", out_var)
+        runner = ProgramRunner(program, [feed_name], [out_name],
+                                executor=executor, scope=scope)
+        self._end_id = end_id
+        super().__init__(runner, **kwargs)
+
+    def generate(self, src_ids, timeout: Optional[float] = 120.0):
+        arr = np.asarray(src_ids)
+        one_row = arr.ndim == 1
+        if one_row:
+            arr = arr[None]
+        toks = self.infer({self._feed_names[0]: arr},
+                          timeout=timeout)[0]
+        toks = apply_eos_sentinel(toks, self._end_id)
+        return toks[0] if one_row else toks
+
+
+def apply_eos_sentinel(tokens: np.ndarray,
+                       end_id: Optional[int]) -> np.ndarray:
+    """Rewrite positions strictly AFTER each row's first `end_id` to
+    -1 (the first end_id itself is kept as the terminator). The decode
+    programs freeze finished rows at end_id (reference
+    tests/unittests/dist_transformer.py:1498 fast_decode early-finish
+    handling); the -1 tail is this repo's fixed-size padded-output
+    sentinel convention (detection/NMS ops). Position 0 (the GO
+    token) never counts as a terminator."""
+    if end_id is None:
+        return tokens
+    toks = np.array(tokens, copy=True)
+    hit = toks[:, 1:] == end_id
+    first = np.where(hit.any(axis=1), hit.argmax(axis=1) + 1,
+                     toks.shape[1])
+    pos = np.arange(toks.shape[1])[None, :]
+    toks[pos > first[:, None]] = -1
+    return toks
+
+
+__all__ = ["InferenceServer", "GenerationServer", "ProgramRunner",
+           "apply_eos_sentinel", "default_batch_buckets"]

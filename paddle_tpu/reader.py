@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Callable, List, Optional
 
 from .data_feeder import DataFeeder
@@ -108,6 +109,7 @@ class PyReader:
         self._places = None
         self._queue: Optional[queue.Queue] = None
         self._thread = None
+        self._stop: Optional[threading.Event] = None
         self._feeder = None
         self._exhausted = True
 
@@ -137,26 +139,69 @@ class PyReader:
 
     def start(self):
         self._exhausted = False
-        self._queue = queue.Queue(maxsize=self._capacity)
+        q = self._queue = queue.Queue(maxsize=self._capacity)
+        stop = self._stop = threading.Event()
         device = self._device() if self._use_double_buffer else None
+
+        def _put(item):
+            # bounded put: a reset() consumer stops draining, so a
+            # plain q.put would block forever on the full queue and
+            # the fill thread could never observe the stop event
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def _fill():
             try:
                 for item in self._batch_reader():
+                    if stop.is_set():
+                        return
                     if self._use_double_buffer:
                         # async H2D: batch k+1 transfers while the
                         # consumer's step k computes
                         item = _device_put_batch(item, device)
-                    self._queue.put(item)
+                    if not _put(item):
+                        return
             finally:
-                self._queue.put(None)
+                _put(None)
 
         self._thread = threading.Thread(target=_fill, daemon=True)
         self._thread.start()
 
-    def reset(self):
-        if self._thread is not None:
-            self._thread = None
+    def reset(self, join_timeout: float = 5.0):
+        """Stop the fill thread and drop the queue. The previous
+        implementation abandoned the thread without signalling it:
+        still blocked on the bounded queue, it kept filling after
+        reset and could interleave STALE batches into the next epoch's
+        queue. Now: signal stop, drain (so a put-blocked thread wakes),
+        and join with a bounded timeout."""
+        thread, q = self._thread, self._queue
+        if self._stop is not None:
+            self._stop.set()
+        if thread is not None and thread.is_alive():
+            deadline = _time.monotonic() + join_timeout
+            while thread.is_alive() and _time.monotonic() < deadline:
+                if q is not None:
+                    try:  # unblock a put-blocked fill thread
+                        while True:
+                            q.get_nowait()
+                    except queue.Empty:
+                        pass
+                thread.join(timeout=0.05)
+        if q is not None:
+            # wake any consumer still blocked in __next__'s get():
+            # with the fill thread stopped and the queue drained, no
+            # sentinel would ever arrive and that get() blocks forever
+            try:
+                q.put_nowait(None)
+            except queue.Full:
+                pass
+        self._thread = None
+        self._stop = None
         self._queue = None
         self._exhausted = True
 

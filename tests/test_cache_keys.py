@@ -121,6 +121,43 @@ class TestRunStepsCacheKey:
         assert np.asarray(r4[0]).shape == (4, 2, 4)
 
 
+class TestServingCompileBound:
+    def test_mixed_traffic_compiles_at_most_bucket_count(self):
+        """100 mixed-shape batch-of-1..4 requests through a 4-bucket
+        InferenceServer produce AT MOST #buckets executables (the
+        bucket ladder bounds the executable cache; unbucketed serving
+        would compile one per distinct batch size). Uses the Executor
+        compile counter."""
+        from paddle_tpu.inference.serving import (InferenceServer,
+                                                  ProgramRunner)
+
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[6], dtype="float32")
+            h = fluid.layers.fc(x, size=8, act="relu")
+            out = fluid.layers.fc(h, size=3)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        assert exe.compile_count == 1  # the startup program
+        runner = ProgramRunner(prog, ["x"], [out.name], executor=exe,
+                               scope=fluid.global_scope())
+        r = np.random.RandomState(0)
+        sizes = r.randint(1, 9, size=100)
+        with InferenceServer(runner, max_batch_size=8,
+                             max_wait_ms=1.0) as srv:
+            assert srv.batch_buckets == [1, 2, 4, 8]
+            replies = [srv.submit(
+                {"x": r.randn(int(n), 6).astype(np.float32)})
+                for n in sizes]
+            outs = [rep.result(timeout=60.0) for rep in replies]
+        for n, o in zip(sizes, outs):
+            assert o[0].shape == (n, 3)
+        # <= 4 serving executables on top of the startup compile
+        assert exe.compile_count - 1 <= len(srv.batch_buckets), \
+            f"compile_count={exe.compile_count}"
+
+
 class TestMeshToken:
     def test_token_is_structural_not_identity(self):
         from paddle_tpu.core.executor import _mesh_token

@@ -362,6 +362,50 @@ class TestDoubleBufferedFeed:
             np.testing.assert_allclose(np.asarray(item["px"]),
                                        np.full((1, 4), i))
 
+    def test_pyreader_reset_stops_fill_thread_no_stale_batches(self):
+        """reset() mid-epoch must signal + join the fill thread: the
+        old behavior abandoned it still blocked on the bounded queue,
+        and it kept interleaving epoch-A batches into epoch B."""
+        import time
+
+        _fresh()
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            x = fluid.layers.data("rx", shape=[2], dtype="float32")
+        from paddle_tpu.reader import PyReader
+
+        def slow_epoch(tag, n=50):
+            def gen():
+                for _ in range(n):
+                    time.sleep(0.002)
+                    yield [(np.full(2, tag, np.float32),)]
+            return gen
+
+        rd = PyReader(feed_list=[x], capacity=2,
+                      use_double_buffer=False)
+        rd.decorate_sample_list_generator(slow_epoch(1.0))
+        rd.start()
+        first = next(rd)
+        np.testing.assert_allclose(np.asarray(first["rx"]),
+                                   np.ones((1, 2)))
+        old_thread = rd._thread
+        rd.reset()
+        assert rd._queue is None and rd._thread is None
+        # the old fill thread must be stopped, not abandoned
+        old_thread.join(timeout=5.0)
+        assert not old_thread.is_alive()
+
+        # epoch B: every batch must come from the NEW generator
+        rd.decorate_sample_list_generator(slow_epoch(2.0, n=6))
+        rd.start()
+        got = []
+        for item in iter(rd.next, None):
+            got.append(float(np.asarray(item["rx"])[0, 0]))
+            if len(got) == 6:
+                break
+        assert got == [2.0] * 6, f"stale epoch-A batches: {got}"
+        rd.reset()
+
     def test_pyreader_host_mode_unchanged(self):
         _fresh()
         prog = fluid.Program()

@@ -1,0 +1,373 @@
+"""Batched, bucketed inference serving (inference/serving.py).
+
+Covers: DynamicBatcher demux correctness, bucket-ladder executable
+bounds, aot_warmup cache seeding, clone() cache sharing (zero compiles
+on a warmed worker), device-resident generation parity at padded
+buckets (EOS/-1 sentinel included), observability counters, and the
+batched-vs-naive throughput regression guard.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import (AnalysisConfig, GenerationServer,
+                                  InferenceServer, PaddleTensor,
+                                  apply_eos_sentinel,
+                                  create_paddle_predictor,
+                                  default_batch_buckets)
+from paddle_tpu.inference.serving import ProgramRunner
+
+
+def _export_tiny_fc(tmpdir, in_dim=8, hidden=16, classes=4):
+    """Untrained (but deterministically initialized) fc model exported
+    for predictor tests -- serving correctness does not need training."""
+    x = fluid.layers.data(name="x", shape=[in_dim], dtype="float32")
+    h = fluid.layers.fc(input=x, size=hidden, act="relu")
+    out = fluid.layers.fc(input=h, size=classes, act="softmax")
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    fluid.save_inference_model(str(tmpdir), ["x"], [out], exe)
+    return out
+
+
+class TestDynamicBatcher:
+    def test_demux_matches_naive_per_request(self, tmp_path):
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        r = np.random.RandomState(0)
+        reqs = [r.randn(rows, 8).astype(np.float32)
+                for rows in (1, 3, 2, 1, 4, 2, 1)]
+        naive = [pred.run([PaddleTensor(a, name="x")])[0].data
+                 for a in reqs]
+        with InferenceServer(pred, max_batch_size=8,
+                             max_wait_ms=30.0) as srv:
+            replies = [srv.submit({"x": a}) for a in reqs]
+            got = [rep.result(timeout=60.0)[0] for rep in replies]
+        for g, n, a in zip(got, naive, reqs):
+            assert g.shape == n.shape == (a.shape[0], 4)
+            np.testing.assert_allclose(g, n, rtol=1e-5, atol=1e-6)
+
+    def test_batches_actually_form(self, tmp_path):
+        """Requests queued together must ride ONE padded executable
+        call, not one dispatch each."""
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        srv = InferenceServer(pred, max_batch_size=8, max_wait_ms=60.0,
+                              start=False)
+        x = np.ones((1, 8), np.float32)
+        srv.start()
+        replies = [srv.submit({"x": x}) for _ in range(5)]
+        for rep in replies:
+            rep.result(timeout=60.0)
+        st = srv.stats()
+        srv.close()
+        assert st["requests"] == 5
+        assert st["batches"] == 1          # one micro-batch
+        assert st["rows"] == 5
+        assert st["padded_rows"] == 8      # bucketed 5 -> 8
+        assert st["batch_occupancy"] == pytest.approx(5 / 8)
+
+    def test_max_wait_flushes_partial_batch(self, tmp_path):
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        with InferenceServer(pred, max_batch_size=8,
+                             max_wait_ms=5.0) as srv:
+            t0 = time.monotonic()
+            out = srv.infer({"x": np.ones((1, 8), np.float32)},
+                            timeout=60.0)
+            waited = time.monotonic() - t0
+        assert out[0].shape == (1, 4)
+        assert waited < 30.0  # flushed by deadline, not stuck at 8 rows
+
+    def test_oversize_request_rejected(self, tmp_path):
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        with InferenceServer(pred, max_batch_size=4) as srv:
+            with pytest.raises(ValueError, match="max_batch_size"):
+                srv.submit({"x": np.ones((5, 8), np.float32)})
+
+    def test_closed_server_fails_pending_and_rejects_new(self, tmp_path):
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        srv = InferenceServer(pred, max_batch_size=8)
+        srv.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            srv.submit({"x": np.ones((1, 8), np.float32)})
+
+    def test_config_knobs_flow_into_server(self, tmp_path):
+        _export_tiny_fc(tmp_path)
+        cfg = AnalysisConfig(str(tmp_path))
+        cfg.enable_dynamic_batching(max_batch_size=16, max_wait_ms=7.0,
+                                    batch_buckets=(2, 16))
+        pred = create_paddle_predictor(cfg)
+        with InferenceServer(pred) as srv:
+            assert srv.max_batch_size == 16
+            assert srv.max_wait_ms == 7.0
+            assert srv.batch_buckets == [2, 16]
+        # explicit constructor args take precedence over the config
+        with InferenceServer(pred, max_batch_size=4,
+                             batch_buckets=(1, 4)) as srv:
+            assert srv.max_batch_size == 4
+            assert srv.batch_buckets == [1, 4]
+            assert srv.max_wait_ms == 7.0  # config still fills gaps
+
+
+class TestBucketsAndWarmup:
+    def test_default_ladder(self):
+        assert default_batch_buckets(8) == [1, 2, 4, 8]
+        assert default_batch_buckets(6) == [1, 2, 4, 6]
+        assert default_batch_buckets(1) == [1]
+
+    def test_aot_warmup_seeds_every_bucket(self, tmp_path):
+        """After warmup, mixed-shape traffic produces ZERO fresh
+        compiles: warmup seeded the Executor cache under exactly the
+        keys real traffic hits."""
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        with InferenceServer(pred, max_batch_size=8,
+                             max_wait_ms=1.0) as srv:
+            warmed = srv.aot_warmup()
+            assert warmed == len(srv.batch_buckets) == 4
+            exe = pred._exe
+            before = exe.compile_count
+            r = np.random.RandomState(1)
+            for rows in (1, 2, 3, 5, 8, 4, 7, 1):
+                srv.infer({"x": r.randn(rows, 8).astype(np.float32)},
+                          timeout=60.0)
+            assert exe.compile_count == before  # all cache hits
+            assert exe.cache_hit_count > 0
+
+    def test_seq_bucketing_bounds_shapes(self):
+        """Declared -1 sequence dims pad up the seq ladder; outputs
+        come back at the padded length (fixed-size padded convention)
+        and real positions match the unpadded run."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[-1, 4], dtype="float32")
+            out = fluid.layers.scale(x, scale=3.0)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        runner = ProgramRunner(prog, ["x"], [out.name], executor=exe,
+                               scope=fluid.global_scope())
+        r = np.random.RandomState(2)
+        with InferenceServer(runner, max_batch_size=4, max_wait_ms=2.0,
+                             seq_buckets=(4, 8)) as srv:
+            a3 = r.randn(1, 3, 4).astype(np.float32)   # T=3 -> 4
+            a5 = r.randn(2, 5, 4).astype(np.float32)   # T=5 -> 8
+            o3 = srv.infer({"x": a3}, timeout=60.0)[0]
+            o5 = srv.infer({"x": a5}, timeout=60.0)[0]
+        assert o3.shape == (1, 4, 4)
+        assert o5.shape == (2, 8, 4)
+        np.testing.assert_allclose(o3[:, :3], a3 * 3.0, rtol=1e-6)
+        np.testing.assert_allclose(o5[:, :5], a5 * 3.0, rtol=1e-6)
+        # both buckets compiled at the batch buckets actually used
+        assert exe.compile_count <= 2 * len(srv.batch_buckets)
+
+
+class TestSharedExecutableCache:
+    def test_clone_serves_warmed_buckets_with_zero_compiles(
+            self, tmp_path):
+        """AnalysisPredictor.clone() shares the parent's compiled
+        cache: a warmed bucket costs a cloned worker NOTHING (the old
+        behavior recompiled per worker)."""
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        with InferenceServer(pred, max_batch_size=8,
+                             max_wait_ms=1.0) as srv:
+            srv.aot_warmup()
+        workers = [pred.clone() for _ in range(3)]
+        r = np.random.RandomState(3)
+        for w in workers:
+            for rows in (1, 3, 8):
+                out = w.run([PaddleTensor(
+                    r.randn(rows, 8).astype(np.float32), name="x")])
+                assert out[0].data.shape == (rows, 4)
+        for w in workers:
+            # rows pad client-side? no -- direct predictor.run is the
+            # unbatched path, so only EXACT warmed shapes hit: 1 and 8
+            # hit the warmed cache, 3 compiles fresh in the SHARED
+            # cache (so only the first worker pays it)
+            assert w._exe.cache_hit_count >= 2
+        fresh = [w._exe.compile_count for w in workers]
+        assert sum(fresh) <= 1, fresh  # at most the batch-3 shape once
+        assert workers[0]._program is pred._program
+
+    def test_clone_through_server_zero_compiles(self, tmp_path):
+        """A server over a cloned worker re-uses every warmed bucket:
+        0 fresh executables for bucketed traffic."""
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        with InferenceServer(pred, max_batch_size=8,
+                             max_wait_ms=1.0) as srv:
+            srv.aot_warmup()
+        worker = pred.clone()
+        assert worker._exe.compile_count == 0
+        r = np.random.RandomState(4)
+        with InferenceServer(worker, max_batch_size=8,
+                             max_wait_ms=1.0) as wsrv:
+            for rows in (1, 2, 3, 5, 8):
+                wsrv.infer({"x": r.randn(rows, 8).astype(np.float32)},
+                           timeout=60.0)
+        assert worker._exe.compile_count == 0
+        assert worker._exe.cache_hit_count >= 5
+
+    def test_unshared_clone_keeps_old_isolation(self, tmp_path):
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        x = np.ones((2, 8), np.float32)
+        pred.run([PaddleTensor(x, name="x")])
+        iso = pred.clone(share_cache=False)
+        assert iso._program is not pred._program
+        assert iso._exe._cache is not pred._exe._cache
+        iso.run([PaddleTensor(x, name="x")])
+        assert iso._exe.compile_count == 1  # recompiled privately
+
+
+class TestGenerationServing:
+    def _train_tiny_transformer(self):
+        from paddle_tpu import unique_name
+        from paddle_tpu.models import transformer as T
+
+        V, D, L, S = 12, 16, 1, 4
+        with unique_name.guard():
+            main, startup, loss = T.build_program(
+                seq_len=S, d_model=D, n_heads=2, n_layers=L,
+                d_inner=32, vocab=V, with_optimizer=False,
+                dropout_rate=0.0)
+            with fluid.program_guard(main, startup):
+                fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        for _ in range(30):
+            src = rng.randint(3, V, (4, S)).astype(np.int64)
+            tgt_in = np.concatenate(
+                [np.full((4, 1), 2, np.int64), src[:, :-1]], 1)
+            exe.run(main, feed={"src_ids": src, "tgt_ids": tgt_in,
+                                "label": src}, fetch_list=[loss])
+        kwargs = dict(seq_len=S, max_out_len=S + 3, d_model=D,
+                      n_heads=2, n_layers=L, d_inner=32, vocab=V,
+                      start_id=2, end_id=1)
+        with unique_name.guard():
+            inc_m, _, _, inc_buf = \
+                T.build_incremental_decode_program(**kwargs)
+        return exe, inc_m, inc_buf, V, S
+
+    def test_padded_bucket_decode_parity_with_eos_sentinel(self):
+        """Tokens served from a BUCKETED (padded 3->4) batch must be
+        exactly the unpadded incremental-decode tokens for the real
+        rows; with end_id set, positions past the first EOS come back
+        as the -1 sentinel."""
+        exe, inc_m, inc_buf, V, S = self._train_tiny_transformer()
+        rng = np.random.RandomState(7)
+        srcs = rng.randint(3, V, (3, S)).astype(np.int64)
+        # unpadded oracle: one batch-3 run of the same program
+        ref, = exe.run(inc_m, feed={"src_ids": srcs},
+                       fetch_list=[inc_buf])
+        ref = np.asarray(ref)
+
+        srv = GenerationServer(
+            inc_m, inc_buf, executor=exe, scope=fluid.global_scope(),
+            end_id=1, max_batch_size=4, max_wait_ms=250.0)
+        got = [None] * 3
+        try:
+            # concurrent generate() calls so the batcher coalesces
+            # them into ONE padded batch-4 decode
+            def call(i):
+                got[i] = srv.generate(srcs[i], timeout=120.0)
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120.0)
+            st = srv.stats()
+        finally:
+            srv.close()
+        # the three requests rode ONE padded batch-4 executable
+        assert st["batches"] == 1
+        assert st["padded_rows"] == 4
+        want = apply_eos_sentinel(ref, end_id=1)
+        for i in range(3):
+            assert got[i] is not None
+            np.testing.assert_array_equal(got[i], want[i])
+        # sentinel semantics: the EOS terminator is kept, tail is -1
+        for r0 in got:
+            if (r0 == 1).any():
+                t = int(np.argmax(r0[1:] == 1)) + 1
+                assert (r0[t + 1:] == -1).all()
+                assert r0[t] == 1
+
+    def test_generate_single_row_roundtrip(self):
+        exe, inc_m, inc_buf, V, S = self._train_tiny_transformer()
+        rng = np.random.RandomState(9)
+        src = rng.randint(3, V, (S,)).astype(np.int64)
+        ref, = exe.run(inc_m, feed={"src_ids": src[None]},
+                       fetch_list=[inc_buf])
+        srv = GenerationServer(
+            inc_m, inc_buf, executor=exe, scope=fluid.global_scope(),
+            end_id=1, max_batch_size=4, max_wait_ms=1.0)
+        try:
+            toks = srv.generate(src, timeout=120.0)
+        finally:
+            srv.close()
+        assert toks.ndim == 1  # 1-D in, 1-D out
+        np.testing.assert_array_equal(
+            toks, apply_eos_sentinel(np.asarray(ref), end_id=1)[0])
+
+
+class TestObservability:
+    def test_stats_shape(self, tmp_path):
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        with InferenceServer(pred, max_batch_size=4,
+                             max_wait_ms=2.0) as srv:
+            for rows in (1, 2, 4):
+                srv.infer({"x": np.ones((rows, 8), np.float32)},
+                          timeout=60.0)
+            st = srv.stats()
+        assert st["requests"] == 3
+        assert st["rows"] == 7
+        assert st["queue_depth"] == 0
+        assert 0 < st["batch_occupancy"] <= 1.0
+        assert st["compile_count"] >= 1
+        assert st["latency_ms"]["p50"] is not None
+        assert st["latency_ms"]["p99"] >= st["latency_ms"]["p50"]
+
+
+class TestThroughputGuard:
+    def test_batched_server_not_slower_than_naive_loop(self, tmp_path):
+        """Regression guard (CPU analogue of the PERF.md serving
+        table): serving N batch-of-1 requests through the warmed
+        batched server must sustain >= the naive per-request
+        predictor.run loop. The real win measured in bench.py serving
+        is ~3-5x; asserting >= 1x keeps the guard robust to loaded CI
+        hosts."""
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        r = np.random.RandomState(5)
+        reqs = [r.randn(1, 8).astype(np.float32) for _ in range(100)]
+
+        # naive loop (warm its executable first)
+        pred.run([PaddleTensor(reqs[0], name="x")])
+        t0 = time.perf_counter()
+        for a in reqs:
+            pred.run([PaddleTensor(a, name="x")])
+        naive_s = time.perf_counter() - t0
+
+        worker = pred.clone()
+        with InferenceServer(worker, max_batch_size=16,
+                             max_wait_ms=2.0) as srv:
+            srv.aot_warmup()
+            t0 = time.perf_counter()
+            replies = [srv.submit({"x": a}) for a in reqs]
+            for rep in replies:
+                rep.result(timeout=60.0)
+            batched_s = time.perf_counter() - t0
+        assert batched_s <= naive_s * 1.05, (
+            f"batched serving regressed: {batched_s:.3f}s vs naive "
+            f"{naive_s:.3f}s for 100 requests")
