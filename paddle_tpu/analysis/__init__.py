@@ -1,0 +1,98 @@
+"""Program verifier: static analysis over the Program IR.
+
+The reference validates programs in C++ BEFORE execution
+(reference paddle/fluid/framework/op_desc.cc CheckAttrs + each op's
+InferShape, operator.cc:975 RunImpl enforcement); the whole-block-jit
+Executor here compiles the entire block in one shot and had no
+equivalent gate — malformed programs surfaced as multi-hour trace
+debugging or a wedged TPU tunnel. This package is that gate, in the
+shape of TVM's Relay well-formedness passes / TensorFlow's GraphDef
+validators (PAPERS.md): a millisecond-scale diagnostics engine over
+the program-as-data IR.
+
+Pieces:
+
+* analysis.dataflow — def-use chains per block + recursive sub-block
+  walking (the `_scan_fallback_reason` walk, generalized).
+* analysis.checkers — the Checker registry: stable `PTA0xx` codes,
+  severity error/warn/info, op/var anchors, fix hints. Every checker
+  encodes a REAL incident from CLAUDE.md's session learnings
+  (collective-in-divergent-cond deadlocks, int->float while-carry
+  promotion, _uid loss, global-counter param names, ...).
+* Executor gate — ``FLAGS_static_check={off,warn,strict}`` runs the
+  suite before every compile (strict raises EnforceNotMet with the
+  diagnostic list).
+* CLI — ``python -m paddle_tpu.analysis`` builds and lints every
+  program in models/ and benchmark/ (``--strict`` for CI).
+
+Usage::
+
+    from paddle_tpu import analysis
+    diags = analysis.run_checks(program)         # all checkers
+    errs = [d for d in diags if d.severity == analysis.ERROR]
+    analysis.check_shared_params(train_prog, decode_prog)
+    analysis.check_clone_uids(prog, prog.clone())
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .checkers import (Checker, Diagnostic, ERROR, INFO, WARNING,
+                       check_clone_uids, check_registry,
+                       check_shared_params, format_diagnostics,
+                       register_checker, registered_checkers,
+                       run_checks)
+from .dataflow import (BlockDataflow, OpSite, analyze_block,
+                       iter_blocks, iter_ops, iter_sub_blocks)
+
+__all__ = [
+    "Diagnostic", "Checker", "ERROR", "WARNING", "INFO",
+    "run_checks", "register_checker", "registered_checkers",
+    "check_registry", "check_shared_params", "check_clone_uids",
+    "format_diagnostics", "maybe_check_program",
+    "BlockDataflow", "OpSite", "analyze_block", "iter_blocks",
+    "iter_ops", "iter_sub_blocks",
+]
+
+# one gate evaluation per (program uid, version): the Executor calls
+# maybe_check_program on every compile, and one program compiles many
+# specializations (feed-shape buckets, AMP tokens) — the diagnostics
+# only change when the PROGRAM does (Pass.apply bumps _version)
+_checked_cache: dict = {}
+
+
+def maybe_check_program(program) -> List[Diagnostic]:
+    """The Executor's pre-compile gate (core/executor.py
+    _build_step_fn): honors FLAGS_static_check. off -> no-op;
+    warn -> warnings.warn with the error/warning diagnostics;
+    strict -> raise EnforceNotMet when any ERROR diagnostic fires."""
+    from ..flags import FLAGS
+
+    mode = FLAGS.static_check
+    if mode == "off":
+        return []
+    key = (getattr(program, "_uid", id(program)),
+           getattr(program, "_version", 0), mode)
+    cached = _checked_cache.get(key)
+    if cached is None:
+        cached = run_checks(program)
+        if len(_checked_cache) > 512:
+            _checked_cache.clear()
+        _checked_cache[key] = cached
+    errors = [d for d in cached if d.severity == ERROR]
+    warns = [d for d in cached if d.severity == WARNING]
+    if errors and mode == "strict":
+        from ..enforce import EnforceNotMet
+
+        raise EnforceNotMet(
+            f"FLAGS_static_check=strict: program verifier found "
+            f"{len(errors)} error(s):\n"
+            + format_diagnostics(errors))
+    if errors or warns:
+        import warnings
+
+        warnings.warn(
+            f"static_check: {len(errors)} error(s), {len(warns)} "
+            f"warning(s) in program:\n"
+            + format_diagnostics(errors + warns))
+    return cached

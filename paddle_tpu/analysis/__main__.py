@@ -1,0 +1,115 @@
+"""CLI: build and lint every models/ + benchmark/ program.
+
+Usage:
+    python -m paddle_tpu.analysis [--strict] [--json] [--verbose]
+                                  [--only mnist transformer ...]
+                                  [--no-benchmark] [--registry]
+
+Exit status: 0 clean (no error-severity diagnostics), 2 when any
+program has errors (or, with --strict-warn, warnings). This is the
+CI gate ISSUE 3 asks for: regressions in program builders fail here
+in seconds instead of on-chip.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("python -m paddle_tpu.analysis")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 2 if any error diagnostic fires")
+    p.add_argument("--strict-warn", action="store_true",
+                   help="exit 2 on warnings too")
+    p.add_argument("--json", action="store_true",
+                   help="one machine-readable JSON object")
+    p.add_argument("--verbose", action="store_true",
+                   help="print info-severity diagnostics as well")
+    p.add_argument("--only", nargs="*", default=None,
+                   help="models/ names to lint (default: everything; "
+                        "note --only also skips the benchmark/ sweep)")
+    p.add_argument("--no-benchmark", action="store_true",
+                   help="skip the benchmark/ harness programs")
+    p.add_argument("--registry", action="store_true",
+                   help="also sweep the FULL op registry for host_"
+                        "effect completeness (PTA070)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # lint never needs a TPU
+
+    from . import (ERROR, INFO, WARNING, check_registry,
+                   check_shared_params, run_checks)
+    from .targets import MODEL_BUILDERS, iter_lint_targets
+
+    if args.only:
+        unknown = sorted(set(args.only) - set(MODEL_BUILDERS))
+        if unknown:
+            # a typo'd --only must NOT look like a green strict run
+            print(f"error: unknown --only name(s) {unknown}; known: "
+                  f"{sorted(MODEL_BUILDERS)}", file=sys.stderr)
+            return 2
+
+    report = []
+    n_err = n_warn = 0
+    for target in iter_lint_targets(
+            include_benchmark=not args.no_benchmark, only=args.only):
+        for label, prog in target.programs.items():
+            diags = run_checks(prog)
+            for a, b in target.pairs:
+                if label == a:
+                    diags = diags + check_shared_params(
+                        target.programs[a], target.programs[b])
+            errs = [d for d in diags if d.severity == ERROR]
+            warns = [d for d in diags if d.severity == WARNING]
+            infos = [d for d in diags if d.severity == INFO]
+            n_err += len(errs)
+            n_warn += len(warns)
+            report.append({
+                "target": f"{target.name}:{label}",
+                "errors": [d.format() for d in errs],
+                "warnings": [d.format() for d in warns],
+                "infos": len(infos) if not args.verbose
+                else [d.format() for d in infos],
+            })
+            if not args.json:
+                status = "OK" if not (errs or warns) else \
+                    f"{len(errs)} error(s), {len(warns)} warning(s)"
+                print(f"{target.name}:{label}: {status} "
+                      f"({len(infos)} info)")
+                for d in errs + warns:
+                    print("  " + d.format().replace("\n", "\n  "))
+                if args.verbose:
+                    for d in infos:
+                        print("  " + d.format().replace("\n", "\n  "))
+
+    if args.registry:
+        regs = check_registry()
+        n_err += len(regs)
+        report.append({"target": "registry",
+                       "errors": [d.format() for d in regs],
+                       "warnings": [], "infos": 0})
+        if not args.json:
+            print(f"registry: "
+                  f"{'OK' if not regs else f'{len(regs)} error(s)'}")
+            for d in regs:
+                print("  " + d.format().replace("\n", "\n  "))
+
+    if args.json:
+        print(json.dumps({"targets": report, "errors": n_err,
+                          "warnings": n_warn}, indent=1))
+    else:
+        print(f"TOTAL: {n_err} error(s), {n_warn} warning(s) across "
+              f"{len(report)} program(s)")
+    if args.strict and n_err:
+        return 2
+    if args.strict_warn and (n_err or n_warn):
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,780 @@
+"""Checker suite: static verification passes over the Program IR.
+
+Reference counterparts: paddle/fluid/framework/op_desc.cc (attr/shape
+checks at OpDesc build), operator.cc RunImpl enforcement, and the
+transpiler-era program validators. The whole-block-jit Executor has no
+per-op hook, so invalid programs here historically failed DEEP inside
+a jax trace — or deadlocked a real TPU (CLAUDE.md session learnings).
+Every checker below is grounded in one of those incidents and carries
+a stable diagnostic code so tests/docs can reference the class:
+
+  PTA001  uninitialized read            (go/_launch_go_ops bug class)
+  PTA002  multiple writers              (ambiguous recompute/go capture)
+  PTA003  dead op                       (build waste; XLA would DCE)
+  PTA004  go-capture hazard             (late writer / host producer)
+  PTA010  collective in divergent branch (r5 pp deadlock trap)
+  PTA011  maybe-collective in branch    (scope-dependent lowering)
+  PTA020  while-carry dtype promotion   (increment int->float trap)
+  PTA030  duplicate uid on sampling ops (fwd/bwd noise divergence)
+  PTA031  clone dropped/mutated uid     (Program.clone contract)
+  PTA040  recompute clone not barrier-rooted (XLA CSE undoes remat)
+  PTA050  auto-generated param names    (cross-build sharing fragility)
+  PTA051  cross-program shared-name conflict
+  PTA060  @SEQ_LEN companion mismatch   (static-batch probe trap)
+  PTA070  host_effect flag missing      (run_steps scan correctness)
+  PTA080  unregistered op type
+
+Severities: "error" = the program is wrong (strict mode raises),
+"warning" = almost certainly a bug but a legal feed/scope could save
+it, "info" = hygiene finding. `run_checks(program)` runs everything.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ..core.program import Block, Operator, Program
+from ..core.registry import (EMPTY_VAR, get_op_info, is_registered,
+                             kernel_bridges_host)
+from .dataflow import (BlockDataflow, OpSite, analyze_block,
+                       block_entry_names, iter_blocks, iter_ops,
+                       iter_sub_blocks)
+
+__all__ = ["Diagnostic", "Checker", "register_checker", "run_checks",
+           "check_registry", "check_shared_params", "check_clone_uids",
+           "registered_checkers", "format_diagnostics",
+           "ERROR", "WARNING", "INFO"]
+
+ERROR, WARNING, INFO = "error", "warning", "info"
+
+# ops the Executor skips at trace time (core/executor.py _SKIP_OP_TYPES
+# plus the feed/fetch placeholders that are never registered)
+_PLUMBING = ("feed", "fetch")
+
+# cross-process / cross-device collective ops (ops/dist_ops.py): their
+# host-bridge (ordered io_callback) or psum sequencing must be
+# IDENTICAL on every participant — a divergent lax.cond/while means
+# participants disagree on whether the collective runs at all
+DIST_OP_TYPES = frozenset({
+    "send", "recv", "send_barrier", "fetch_barrier", "prefetch",
+    "prefetch_grad", "checkpoint_notify", "allreduce",
+    "listen_and_serv", "gen_nccl_id",
+})
+
+# ops whose kernels lower through shard_map / with_sharding_constraint
+# when a parallel scope (context/expert parallel) is active — inside a
+# divergent branch GSPMD may then plant a collective in the branch
+# body (the r6 1F1B x tp generalized trap)
+SCOPE_COLLECTIVE_OP_TYPES = frozenset({
+    "attention", "attention_block", "switch_moe",
+})
+
+# container op type -> whether its sub-blocks trace as DIVERGENT
+# control flow (lax.cond / lax.while_loop): different devices can take
+# different paths, so a collective inside deadlocks
+DIVERGENT_CONTAINERS = frozenset({
+    "conditional_block", "run_block_if", "ifelse", "while",
+})
+
+_AUTO_PARAM_RE = re.compile(r"_\d+\.[wb]_\d+$")
+
+RECOMP_MARK = "@RECOMP"
+BARRIER_MARK = "@BAR"
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One structured finding (reference: the EnforceNotMet message the
+    C++ validators would have raised, made machine-readable)."""
+    code: str
+    severity: str
+    message: str
+    block_idx: int = 0
+    op_idx: Optional[int] = None
+    op_type: Optional[str] = None
+    var: Optional[str] = None
+    hint: Optional[str] = None
+
+    def format(self) -> str:
+        where = f"block {self.block_idx}"
+        if self.op_idx is not None:
+            where += f" op {self.op_idx}"
+        if self.op_type:
+            where += f" ({self.op_type})"
+        out = f"{self.code} [{self.severity}] {where}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+def _diag_at(code, severity, site: OpSite, message, var=None,
+             hint=None) -> Diagnostic:
+    return Diagnostic(code, severity, message, block_idx=site.block_idx,
+                      op_idx=site.op_idx, op_type=site.op.type, var=var,
+                      hint=hint)
+
+
+@dataclass
+class Checker:
+    code: str
+    name: str
+    fn: Callable[[Program], Iterable[Diagnostic]]
+    doc: str = ""
+
+
+_CHECKERS: Dict[str, Checker] = {}
+
+
+def register_checker(code: str, name: str, doc: str = ""):
+    """Decorator registering `fn(program) -> iterable of Diagnostic`
+    under a stable PTA code (mirrors core/registry.register_op)."""
+
+    def deco(fn):
+        _CHECKERS[code] = Checker(code, name, fn, doc or fn.__doc__ or "")
+        return fn
+
+    return deco
+
+
+def registered_checkers() -> List[Checker]:
+    return [_CHECKERS[c] for c in sorted(_CHECKERS)]
+
+
+def run_checks(program: Program,
+               only: Optional[Iterable[str]] = None) -> List[Diagnostic]:
+    """Run every registered checker (or the `only` subset of codes)
+    over `program`; returns diagnostics sorted error-first, stable
+    within severity."""
+    codes = set(only) if only is not None else None
+    out: List[Diagnostic] = []
+    for checker in registered_checkers():
+        if codes is not None and checker.code not in codes:
+            continue
+        out.extend(checker.fn(program))
+    rank = {ERROR: 0, WARNING: 1, INFO: 2}
+    out.sort(key=lambda d: (rank.get(d.severity, 3), d.code,
+                            d.block_idx, d.op_idx or 0))
+    return out
+
+
+def format_diagnostics(diags: Iterable[Diagnostic]) -> str:
+    return "\n".join(d.format() for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# Dataflow checks: PTA001 uninitialized read, PTA002 multi-writer,
+# PTA003 dead op, PTA004 go-capture hazards.
+# ---------------------------------------------------------------------------
+def _seed_names(blk: Block, container: Optional[Operator]) -> set:
+    """Names defined before any op of `blk` runs: persistables (from
+    the scope after the startup program), declared data vars (feeds),
+    and — for sub-blocks — the containing op's declared environment
+    (control-flow kernels build a FRESH env; see block_entry_names)."""
+    seeded = set()
+    b: Optional[Block] = blk
+    while b is not None:
+        for v in b.vars.values():
+            if v.persistable or v.is_data:
+                seeded.add(v.name)
+        b = b.parent_block
+    if container is not None:
+        seeded |= block_entry_names(container)
+    return seeded
+
+
+@register_checker("PTA001", "uninitialized-read")
+def check_uninitialized_reads(program: Program):
+    """A var read before any write that is neither persistable (scope
+    state), a declared data var (feed), nor part of a sub-block's
+    declared environment. At run time this is the Executor's
+    'used before initialization' error or a trace-time KeyError —
+    warning severity because an undeclared name CAN still be fed."""
+    for blk, container in iter_blocks(program):
+        seeded = _seed_names(blk, container)
+        written = set()
+        for i, op in enumerate(blk.ops):
+            if op.type in _PLUMBING:
+                continue
+            for n in op.input_arg_names:
+                if n == EMPTY_VAR or n in written or n in seeded:
+                    continue
+                site = OpSite(blk.idx, i, op, container)
+                yield _diag_at(
+                    "PTA001", WARNING, site,
+                    f"var {n!r} is read before any write in the block "
+                    f"and is neither persistable nor a declared data "
+                    f"var", var=n,
+                    hint="feed it, declare it with layers.data(...), "
+                         "or produce it before this op")
+                seeded.add(n)  # one diagnostic per name per block
+            written.update(op.output_arg_names)
+
+
+@register_checker("PTA002", "multi-writer")
+def check_multi_writers(program: Program):
+    """A non-persistable var written by more than one op in a block.
+    Legal (last-writer-wins under the trace), but it makes the value
+    observed by threads (go), recompute clones, and human readers
+    order-dependent — the exact ambiguity _launch_go_ops refuses at
+    run time. Info severity; the go-specific EP is PTA004."""
+    for blk, container in iter_blocks(program):
+        df = analyze_block(blk)
+        for name, idxs in df.multi_writers().items():
+            var = blk._find_var_recursive(name)
+            if var is not None and var.persistable:
+                continue  # in-place state updates are the normal idiom
+            op = blk.ops[idxs[1]]
+            site = OpSite(blk.idx, idxs[1], op, container)
+            yield _diag_at(
+                "PTA002", INFO, site,
+                f"var {name!r} has {len(idxs)} writers in this block "
+                f"(ops {idxs})", var=name,
+                hint="rename intermediate results or route the value "
+                     "through a persistable var if threads/clones "
+                     "must observe a specific write")
+
+
+@register_checker("PTA003", "dead-op")
+def check_dead_ops(program: Program):
+    """An op none of whose outputs is ever read anywhere in the
+    program, written to a persistable (scope state), or side-effecting.
+    XLA dead-codes it, but it still costs build/trace time and usually
+    marks builder bugs. Info severity: fetch targets are unknown
+    statically, so the last producer of a to-be-fetched var looks
+    dead here."""
+    read_anywhere = set()
+    for blk, _ in iter_blocks(program):
+        for op in blk.ops:
+            read_anywhere.update(op.input_arg_names)
+            for v in op.attrs.values():
+                if isinstance(v, (list, tuple)) and v and all(
+                        isinstance(x, str) for x in v):
+                    read_anywhere.update(v)
+    for site in iter_ops(program):
+        op = site.op
+        if op.type in _PLUMBING or not op.output_arg_names:
+            continue
+        if is_registered(op.type) and get_op_info(op.type).host_effect:
+            continue
+        if any(isinstance(v, Block) for v in op.attrs.values()):
+            continue
+        live = False
+        for n in op.output_arg_names:
+            var = site.op.block._find_var_recursive(n) \
+                if site.op.block is not None else None
+            if n in read_anywhere or (var is not None and
+                                      var.persistable):
+                live = True
+                break
+        if not live:
+            yield _diag_at(
+                "PTA003", INFO, site,
+                f"no output of this op ({op.output_arg_names}) is read "
+                f"anywhere, persistable, or side-effecting",
+                hint="drop the op, or fetch/persist its result")
+
+
+@register_checker("PTA004", "go-capture-hazard")
+def check_go_captures(program: Program):
+    """Static form of the _launch_go_ops run-time refusals: a `go` op
+    capture that is (a) first written AFTER the go op, (b) written by
+    multiple ops before it (ambiguous recompute), or (c) produced by a
+    host-effecting op (recomputing doubles its side effects). All
+    three raise at run time today — this surfaces them at build."""
+    for blk, container in iter_blocks(program):
+        df = analyze_block(blk)
+        for go_idx, op in enumerate(blk.ops):
+            if op.type != "go":
+                continue
+            site = OpSite(blk.idx, go_idx, op, container)
+            for n in op.inputs.get("X", []):
+                var = blk._find_var_recursive(n)
+                if var is not None and (var.persistable or var.is_data):
+                    continue
+                writes = df.writers.get(n, [])
+                before = [i for i in writes if i < go_idx]
+                if not before:
+                    if writes:
+                        yield _diag_at(
+                            "PTA004", ERROR, site,
+                            f"go captures {n!r}, first written by op "
+                            f"{writes[0]} AFTER the go op — the "
+                            f"reference's eager executor would not "
+                            f"observe it at the go point", var=n)
+                    else:
+                        yield _diag_at(
+                            "PTA004", ERROR, site,
+                            f"go captures {n!r} which is neither fed, "
+                            f"persistable, nor produced by the block",
+                            var=n)
+                    continue
+                if len(before) > 1:
+                    yield _diag_at(
+                        "PTA004", ERROR, site,
+                        f"go captures {n!r} which has multiple writers "
+                        f"before the go op (ops {before}); recomputing "
+                        f"it in the go thread is ambiguous", var=n,
+                        hint="route the value through a persistable "
+                             "var")
+                    continue
+                producer = blk.ops[before[0]]
+                if is_registered(producer.type) and \
+                        get_op_info(producer.type).host_effect:
+                    yield _diag_at(
+                        "PTA004", ERROR, site,
+                        f"go captures {n!r} produced by host-effecting "
+                        f"op {producer.type!r}; recomputing it in the "
+                        f"go thread would double its side effects",
+                        var=n,
+                        hint="route the value through a persistable "
+                             "var")
+
+
+# ---------------------------------------------------------------------------
+# PTA010/PTA011: collectives inside divergent control flow.
+# ---------------------------------------------------------------------------
+def _is_collective(op: Operator) -> bool:
+    if op.type in DIST_OP_TYPES:
+        return True
+    # an explicit shard_map axis on any op (sync_batch_norm and
+    # friends) makes its kernel emit lax.psum over that axis
+    return bool(op.attrs.get("axis_name"))
+
+
+def _walk_block_ops(blk: Block, seen=None):
+    """All ops in blk and (recursively) its sub-block attrs."""
+    if seen is None:
+        seen = set()
+    for i, op in enumerate(blk.ops):
+        yield i, op
+        for _, sub in iter_sub_blocks(op):
+            if id(sub) in seen:
+                continue
+            seen.add(id(sub))
+            yield from _walk_block_ops(sub, seen)
+
+
+@register_checker("PTA010", "collective-in-divergent-branch")
+def check_collective_in_branch(program: Program):
+    """NO collective may live inside divergent control flow: devices
+    (or processes, for the io_callback pserver ops) that take
+    different branches disagree on whether — or in which order — the
+    collective executes, and the program deadlocks. This is the r5
+    shard_map + lax.cond trap (CLAUDE.md) as a build-time error; the
+    reference had no equivalent because its executor ran branches on
+    the host."""
+    for blk, container in iter_blocks(program):
+        for i, op in enumerate(blk.ops):
+            if op.type not in DIVERGENT_CONTAINERS:
+                continue
+            for attr_name, sub in iter_sub_blocks(op):
+                for j, inner in _walk_block_ops(sub):
+                    if _is_collective(inner):
+                        site = OpSite(blk.idx, i, op, container)
+                        yield _diag_at(
+                            "PTA010", ERROR, site,
+                            f"collective op {inner.type!r} (sub-block "
+                            f"{attr_name} op {j}) lives inside "
+                            f"divergent control flow ({op.type}); "
+                            f"participants taking different paths "
+                            f"will deadlock",
+                            var=(inner.output_arg_names or [None])[0],
+                            hint="hoist the collective out of the "
+                                 "branch and mask its input instead "
+                                 "(psum of a zeroed contribution is "
+                                 "the identity)")
+
+
+@register_checker("PTA011", "scope-collective-in-branch")
+def check_scope_collective_in_branch(program: Program):
+    """Ops that lower to shard_map collectives only when a parallel
+    scope (context/expert parallel) is active, found inside divergent
+    control flow. Warning: single-device lowering is fine, but the
+    same program under scope_context_parallel/expert_parallel plants
+    a collective in the branch — the r6 generalized GSPMD trap."""
+    for blk, container in iter_blocks(program):
+        for i, op in enumerate(blk.ops):
+            if op.type not in DIVERGENT_CONTAINERS:
+                continue
+            found: Dict[str, int] = {}
+            for attr_name, sub in iter_sub_blocks(op):
+                for _, inner in _walk_block_ops(sub):
+                    if inner.type in SCOPE_COLLECTIVE_OP_TYPES:
+                        found[inner.type] = found.get(inner.type, 0) + 1
+            for inner_type, count in sorted(found.items()):
+                site = OpSite(blk.idx, i, op, container)
+                yield _diag_at(
+                    "PTA011", WARNING, site,
+                    f"{count} {inner_type!r} op(s) inside this "
+                    f"{op.type}'s sub-blocks lower to shard_map "
+                    f"collectives under context/expert-parallel "
+                    f"scopes; there they become branch-internal "
+                    f"collectives and deadlock",
+                    hint=f"keep parallel-scope models' {inner_type} "
+                         "ops out of divergent branches, or run this "
+                         "program only outside those scopes")
+
+
+# ---------------------------------------------------------------------------
+# PTA020: while-carry dtype stability.
+# ---------------------------------------------------------------------------
+def _is_int_dtype_str(s: Optional[str]) -> bool:
+    return bool(s) and s.startswith(("int", "uint", "bool"))
+
+
+def _writer_dtype_map(program: Program) -> Dict[str, str]:
+    """name -> dtype attr of its FIRST writer op carrying an explicit
+    dtype (fill_constant / cast / ...), in program walk order. One
+    pass, shared by every increment check in the run — writer attrs
+    beat the Variable's dtype field, because build-time shape
+    inference OVERWRITES an in-place op's shared var dtype with the
+    (possibly already promoted) inferred result."""
+    out: Dict[str, str] = {}
+    for site in iter_ops(program):
+        dt = site.op.attrs.get("dtype") or \
+            site.op.attrs.get("out_dtype")
+        if not isinstance(dt, str):
+            continue
+        for n in site.op.output_arg_names:
+            out.setdefault(n, dt)
+    return out
+
+
+@register_checker("PTA020", "while-carry-dtype")
+def check_while_carry_dtypes(program: Program):
+    """`increment(x, 1.0)` on an integer var promotes the value to
+    float under JAX weak typing; if the var is a lax.while_loop carry
+    the loop raises a carry-structure TypeError deep inside the trace
+    (CLAUDE.md: 'pass int steps'). Error inside while bodies, warning
+    elsewhere (the counter silently changes dtype)."""
+    in_while = set()
+    for blk, _ in iter_blocks(program):
+        for op in blk.ops:
+            if op.type == "while":
+                for _, sub in iter_sub_blocks(op):
+                    for _, inner in _walk_block_ops(sub):
+                        in_while.add(id(inner))
+    writer_dtypes = None  # built lazily: most programs have 0 hits
+    for site in iter_ops(program):
+        op = site.op
+        if op.type != "increment":
+            continue
+        step = op.attrs.get("step", 1.0)
+        if not isinstance(step, float):
+            continue
+        names = op.inputs.get("X", [])
+        if not names:
+            continue
+        if writer_dtypes is None:
+            writer_dtypes = _writer_dtype_map(program)
+        var = site.op.block._find_var_recursive(names[0])
+        dtype = writer_dtypes.get(names[0]) or (
+            var.dtype.value if var is not None and var.dtype is not None
+            else None)
+        if not _is_int_dtype_str(dtype):
+            continue
+        severity = ERROR if id(op) in in_while else WARNING
+        yield _diag_at(
+            "PTA020", severity, site,
+            f"increment of integer var {names[0]!r} "
+            f"(dtype {dtype}) with float step {step!r} "
+            f"promotes the value to float"
+            + (" and breaks the lax.while_loop carry dtype"
+               if severity == ERROR else ""),
+            var=names[0],
+            hint="pass an int step: layers.increment(x, 1)")
+
+
+# ---------------------------------------------------------------------------
+# PTA030/PTA031: structural uid preservation for sampling ops.
+# ---------------------------------------------------------------------------
+def _needs_rng(op_type: str) -> bool:
+    return is_registered(op_type) and get_op_info(op_type).needs_rng
+
+
+def _is_recompute_clone(op: Operator) -> bool:
+    return any(RECOMP_MARK in n for n in op.output_arg_names) or (
+        op.attrs.get("op_role") == "backward")
+
+
+@register_checker("PTA030", "sampling-uid-collision")
+def check_sampling_uids(program: Program):
+    """Sampling ops derive their PRNG salt from `op._uid`
+    (fold_in(step_key, uid), core/registry.py OpContext.rng). Two
+    DIFFERENT sampling ops sharing a uid draw byte-identical noise —
+    silently correlated dropout masks. The one legal duplicate is a
+    recompute clone (backward.py _emit_recompute), which shares its
+    forward op's uid ON PURPOSE so the re-tossed noise matches."""
+    groups: Dict[int, List[OpSite]] = {}
+    for site in iter_ops(program):
+        if _needs_rng(site.op.type):
+            groups.setdefault(site.op._uid, []).append(site)
+    for uid, sites in groups.items():
+        if len(sites) < 2:
+            continue
+        types = {s.op.type for s in sites}
+        originals = [s for s in sites
+                     if not _is_recompute_clone(s.op)]
+        if len(types) == 1 and len(originals) <= 1:
+            continue  # forward op + its recompute clones: intended
+        site = sites[1]
+        yield _diag_at(
+            "PTA030", ERROR, site,
+            f"{len(sites)} sampling ops share uid {uid} "
+            f"(types {sorted(types)}, anchors "
+            f"{[s.anchor() for s in sites]}); their PRNG salts "
+            f"collide and they draw identical noise",
+            hint="ops cloned outside Program.clone/recompute must "
+                 "re-derive or preserve _uid correctly (see "
+                 "Operator.__init__)")
+
+
+def check_clone_uids(src: Program, cloned: Program) -> List[Diagnostic]:
+    """PTA031: verify a Program.clone (or any structural copy)
+    preserved `_uid` on sampling ops — a clone that re-derives uids
+    breaks fwd/bwd noise parity for programs sharing a scope with the
+    source (CLAUDE.md architecture invariant). Ops are matched by
+    (type, output names) signature since for_test clones prune ops."""
+    out: List[Diagnostic] = []
+    src_uids: Dict[tuple, int] = {}
+    for site in iter_ops(src):
+        if _needs_rng(site.op.type):
+            sig = (site.op.type, tuple(site.op.output_arg_names))
+            src_uids.setdefault(sig, site.op._uid)
+    for site in iter_ops(cloned):
+        if not _needs_rng(site.op.type):
+            continue
+        sig = (site.op.type, tuple(site.op.output_arg_names))
+        want = src_uids.get(sig)
+        if want is not None and site.op._uid != want:
+            out.append(_diag_at(
+                "PTA031", ERROR, site,
+                f"cloned sampling op {site.op.type!r} has uid "
+                f"{site.op._uid} but the source op (same outputs "
+                f"{list(site.op.output_arg_names)}) has uid {want}: "
+                f"the clone draws DIFFERENT noise",
+                hint="clones must copy op._uid (Program.clone does; "
+                     "custom passes must too)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PTA040: recompute clones rooted in optimization_barrier.
+# ---------------------------------------------------------------------------
+@register_checker("PTA040", "recompute-barrier-rooting")
+def check_recompute_barriers(program: Program):
+    """Recompute clones (@RECOMP outputs) must read ONLY barriered
+    (@BAR) or recomputed (@RECOMP) inputs: a clone reading the
+    original forward activation is byte-identical HLO and XLA CSE
+    merges it back, silently undoing the memory saving (backward.py
+    _emit_recompute). Also verifies every @BAR name is actually
+    produced by an optimization_barrier op."""
+    barrier_outs = set()
+    for site in iter_ops(program):
+        if site.op.type == "optimization_barrier":
+            barrier_outs.update(site.op.output_arg_names)
+    for site in iter_ops(program):
+        op = site.op
+        if not any(RECOMP_MARK in n for n in op.output_arg_names):
+            continue
+        for n in op.input_arg_names:
+            if n == EMPTY_VAR or RECOMP_MARK in n:
+                continue
+            if BARRIER_MARK in n:
+                if n not in barrier_outs:
+                    yield _diag_at(
+                        "PTA040", ERROR, site,
+                        f"recompute clone reads {n!r} which no "
+                        f"optimization_barrier op produces", var=n)
+                continue
+            yield _diag_at(
+                "PTA040", ERROR, site,
+                f"recompute clone reads forward var {n!r} directly; "
+                f"without an optimization_barrier root XLA CSE merges "
+                f"the clone back into the forward op and the "
+                f"rematerialization silently vanishes", var=n,
+                hint="route out-of-region reads through "
+                     "optimization_barrier (backward.py _emit_"
+                     "recompute._bar)")
+
+
+# ---------------------------------------------------------------------------
+# PTA050/PTA051: parameter naming across builds.
+# ---------------------------------------------------------------------------
+@register_checker("PTA050", "auto-param-names")
+def check_auto_param_names(program: Program):
+    """Auto-generated parameter names (fc_N.w_M ...) come from ONE
+    global helper counter: two programs built in different op orders
+    assign the SAME name to DIFFERENT parameters, so sharing weights
+    by name across separate train/decode builds breaks (CLAUDE.md
+    late-r2 learning). Info severity per program — it only bites when
+    a second build shares the scope; PTA051 (check_shared_params)
+    upgrades it when two programs are actually paired."""
+    auto = sorted(n for n in program._parameters
+                  if _AUTO_PARAM_RE.search(n))
+    if auto:
+        sample = ", ".join(auto[:4]) + ("..." if len(auto) > 4 else "")
+        yield Diagnostic(
+            "PTA050", INFO,
+            f"{len(auto)} parameter(s) carry auto-generated names "
+            f"({sample}); cross-program weight sharing by these names "
+            f"depends on identical build order",
+            hint="name parameters explicitly (ParamAttr(name=...)) "
+                 "for any model with a separate decode/inference "
+                 "build — see models/transformer.py enc{i}_*/dec{i}_*")
+
+
+def check_shared_params(a: Program, b: Program) -> List[Diagnostic]:
+    """PTA051: lint a (train, inference) program pair that shares
+    weights by name through one scope. Shared names with DIFFERENT
+    shapes are errors (the share is already broken); shared
+    auto-generated names are warnings (one added layer reorders the
+    global counter and silently shuffles every weight)."""
+    out: List[Diagnostic] = []
+    shared = sorted(set(a._parameters) & set(b._parameters))
+    for name in shared:
+        sa = a._parameters[name].shape
+        sb = b._parameters[name].shape
+        if sa is not None and sb is not None and tuple(sa) != tuple(sb):
+            out.append(Diagnostic(
+                "PTA051", ERROR,
+                f"programs share parameter {name!r} with mismatched "
+                f"shapes {tuple(sa)} vs {tuple(sb)}: scope sharing by "
+                f"this name is broken", var=name))
+        elif _AUTO_PARAM_RE.search(name):
+            out.append(Diagnostic(
+                "PTA051", WARNING,
+                f"programs share AUTO-generated parameter name "
+                f"{name!r}; any build-order divergence re-assigns it "
+                f"to a different weight", var=name,
+                hint="use explicit ParamAttr names in both builds"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PTA060: @SEQ_LEN companion declaration/batch consistency.
+# ---------------------------------------------------------------------------
+SEQ_LEN_SUFFIX = "@SEQ_LEN"
+
+
+@register_checker("PTA060", "seq-len-companion")
+def check_seq_len_companions(program: Program):
+    """Padded sequences ride with an int32 [batch] `name@SEQ_LEN`
+    companion (layers/sequence.py). Build-time shape probes replace -1
+    dims with a probe value, so a program whose data var has a
+    CONCRETE batch must declare the companion at the SAME concrete
+    batch — a (-1,) companion probes at a different batch and the
+    kernel trace fails with an opaque broadcast error (CLAUDE.md
+    late-r2 learning). Companions read by ops but declared nowhere
+    get a warning (the feed path would KeyError)."""
+    written = set()
+    declared = set()
+    for blk, _ in iter_blocks(program):
+        declared.update(blk.vars)
+        for op in blk.ops:
+            written.update(op.output_arg_names)
+    # companions READ by some op but declared in no block: the program
+    # expects a feed it never announces (DataFeeder/_check_feed_shape
+    # cannot validate it; the trace KeyErrors)
+    flagged = set()
+    for site in iter_ops(program):
+        for n in site.op.input_arg_names:
+            if not n.endswith(SEQ_LEN_SUFFIX) or n in declared \
+                    or n in written or n in flagged:
+                continue
+            flagged.add(n)
+            yield _diag_at(
+                "PTA060", WARNING, site,
+                f"op reads sequence-length companion {n!r} which no "
+                f"block declares; the feed path cannot validate it "
+                f"and the trace will KeyError", var=n,
+                hint="declare it (layers.sequence.seq_len_of / "
+                     "bind_seq_len) or create the data var explicitly")
+    for blk, container in iter_blocks(program):
+        for name, var in blk.vars.items():
+            if not name.endswith(SEQ_LEN_SUFFIX):
+                continue
+            if name in written and not var.is_data:
+                # produced in-graph (bind_seq_len assign): shape
+                # inference rewrites its shape from the producer, so
+                # the declared placeholder shape is not a feed contract
+                continue
+            base = blk._find_var_recursive(name[:-len(SEQ_LEN_SUFFIX)])
+            if base is None or base.shape is None:
+                continue
+            batch = base.shape[0] if len(base.shape) else None
+            if batch is None or batch == -1:
+                continue
+            cshape = var.shape
+            if cshape is None or tuple(cshape) != (batch,):
+                yield Diagnostic(
+                    "PTA060", ERROR,
+                    f"companion {name!r} is declared with shape "
+                    f"{tuple(cshape) if cshape else None} but its base "
+                    f"var has CONCRETE batch {batch}; build-time shape "
+                    f"probes will disagree", block_idx=blk.idx,
+                    var=name,
+                    hint=f"declare the companion at shape ({batch},) "
+                         f"(models/machine_translation.py "
+                         f"build_decode_program does)")
+
+
+# ---------------------------------------------------------------------------
+# PTA070: host_effect flag completeness (registry-level).
+# ---------------------------------------------------------------------------
+def check_registry(op_types: Optional[Iterable[str]] = None
+                   ) -> List[Diagnostic]:
+    """PTA070: every registered kernel whose code references
+    io_callback/pure_callback must be flagged host_effect=True —
+    otherwise Executor.run_steps lowers it into a device-resident
+    lax.scan and its once-per-step host semantics silently break
+    (CLAUDE.md r6 'REMEMBER the flag', mechanized). register_op now
+    asserts this at registration; this sweep is the belt-and-braces
+    for kernels registered before the assert or monkeypatched in."""
+    from ..core.registry import registered_ops
+
+    out: List[Diagnostic] = []
+    types = list(op_types) if op_types is not None else registered_ops()
+    for t in types:
+        if not is_registered(t):
+            continue
+        info = get_op_info(t)
+        if info.host_effect:
+            continue
+        if kernel_bridges_host(info.kernel):
+            out.append(Diagnostic(
+                "PTA070", ERROR,
+                f"op {t!r} kernel references io_callback/pure_callback "
+                f"but is registered with host_effect=False; "
+                f"Executor.run_steps would scan it on device and break "
+                f"its per-step host semantics", op_type=t,
+                hint="register with host_effect=True"))
+    return out
+
+
+@register_checker("PTA070", "host-effect-flag")
+def check_program_host_effects(program: Program):
+    """Registry sweep restricted to the op types this program uses."""
+    used = {site.op.type for site in iter_ops(program)}
+    for d in check_registry(sorted(used)):
+        yield d
+
+
+# ---------------------------------------------------------------------------
+# PTA080: unregistered op types.
+# ---------------------------------------------------------------------------
+@register_checker("PTA080", "unregistered-op")
+def check_registered(program: Program):
+    """Every non-plumbing op must have a registered kernel, or the
+    Executor raises at compile ('op has no registered kernel') —
+    catch it before the jax trace starts."""
+    for site in iter_ops(program):
+        if site.op.type in _PLUMBING:
+            continue
+        if not is_registered(site.op.type):
+            yield _diag_at(
+                "PTA080", ERROR, site,
+                f"op type {site.op.type!r} has no registered kernel "
+                f"(core/registry.py)",
+                hint="register the op or remove it from the program")

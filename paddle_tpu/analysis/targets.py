@@ -1,0 +1,181 @@
+"""Lint targets: every program the repo ships, built for analysis.
+
+One place (shared by ``python -m paddle_tpu.analysis`` and the tier-1
+gate test tests/test_analysis_gate.py) that knows how to BUILD each
+models/ and benchmark/ program so the checker suite can lint it. Model
+builds use small dims — the IR structure (op types, sub-blocks,
+companions, param naming) is what the checkers read, and it is
+invariant to width — so the whole zoo builds in well under a minute on
+CPU. Benchmark programs go through benchmark/fluid_benchmark.py's own
+adapters (its default arg shapes) so the exact programs the harness
+times are the programs that get linted.
+
+Each target yields ``LintTarget(name, programs, pairs)`` where
+`programs` maps a label -> Program (main + startup builds) and `pairs`
+lists (label_a, label_b) program pairs that share weights by name
+through one scope (train/decode builds) for check_shared_params.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Tuple
+
+__all__ = ["LintTarget", "iter_lint_targets", "MODEL_BUILDERS"]
+
+
+@dataclass
+class LintTarget:
+    name: str
+    programs: Dict[str, object]              # label -> Program
+    pairs: List[Tuple[str, str]] = field(default_factory=list)
+
+
+def _mnist():
+    from ..models import mnist
+
+    main, startup, *_ = mnist.build_program(use_conv=True)
+    return {"main": main, "startup": startup}, []
+
+
+def _resnet():
+    from ..models import resnet
+
+    main, startup, _ = resnet.build_program(
+        depth=50, class_dim=10, image_shape=(3, 32, 32))
+    return {"main": main, "startup": startup}, []
+
+
+def _vgg():
+    from ..models import vgg
+
+    main, startup, _ = vgg.build_program(class_dim=10,
+                                         image_shape=(3, 32, 32))
+    return {"main": main, "startup": startup}, []
+
+
+def _se_resnext():
+    from ..models import se_resnext
+
+    main, startup, _ = se_resnext.build_program(
+        class_dim=10, image_shape=(3, 64, 64))
+    return {"main": main, "startup": startup}, []
+
+
+def _stacked_dynamic_lstm():
+    from ..models import stacked_dynamic_lstm
+
+    main, startup, *_ = stacked_dynamic_lstm.build_program(
+        dict_dim=1000, emb_dim=64, hid_dim=64, stacked_num=2)
+    return {"main": main, "startup": startup}, []
+
+
+def _machine_translation():
+    from ..models import machine_translation as mt
+
+    kw = dict(src_dict_dim=1000, tgt_dict_dim=1000, embedding_dim=32,
+              encoder_size=32, decoder_size=32)
+    main, startup, _ = mt.build_program(**kw)
+    dec = mt.build_decode_program(src_len=8, max_len=8, **kw)
+    return ({"main": main, "startup": startup, "decode": dec[0],
+             "decode_startup": dec[1]},
+            [("main", "decode")])
+
+
+def _transformer():
+    from ..models import transformer as tr
+
+    kw = dict(seq_len=16, d_model=64, n_heads=4, n_layers=2,
+              d_inner=128, vocab=1000)
+    main, startup, _ = tr.build_program(dropout_rate=0.1, **kw)
+    dkw = dict(seq_len=8, max_out_len=8, d_model=64, n_heads=4,
+               n_layers=2, d_inner=128, vocab=1000)
+    greedy = tr.build_greedy_decode_program(**dkw)
+    incr = tr.build_incremental_decode_program(**dkw)
+    beam = tr.build_beam_decode_program(**dkw)
+    return ({"main": main, "startup": startup, "greedy": greedy[0],
+             "incremental": incr[0], "beam": beam[0]},
+            [("main", "greedy"), ("main", "incremental"),
+             ("main", "beam")])
+
+
+def _moe_transformer():
+    from ..models import moe_transformer
+
+    main, startup, _ = moe_transformer.build_program(
+        seq_len=16, vocab=1000, d_model=64, n_heads=4, n_layers=2,
+        d_inner=128, n_experts=4)
+    return {"main": main, "startup": startup}, []
+
+
+def _ctr():
+    from ..models import ctr
+
+    main, startup, *_ = ctr.build_program(dnn_dict_dim=1001,
+                                          lr_dict_dim=1001)
+    return {"main": main, "startup": startup}, []
+
+
+def _word2vec():
+    from ..models import word2vec
+
+    main, startup, *_ = word2vec.build_program(dict_size=500,
+                                               embed_size=16,
+                                               hidden_size=32)
+    return {"main": main, "startup": startup}, []
+
+
+def _recommender():
+    from ..models import recommender
+
+    main, startup, *_ = recommender.build_program()
+    return {"main": main, "startup": startup}, []
+
+
+def _label_semantic_roles():
+    from ..models import label_semantic_roles
+
+    main, startup, *_ = label_semantic_roles.build_program(seq_len=8)
+    return {"main": main, "startup": startup}, []
+
+
+MODEL_BUILDERS: Dict[str, Callable] = {
+    "mnist": _mnist,
+    "resnet": _resnet,
+    "vgg": _vgg,
+    "se_resnext": _se_resnext,
+    "stacked_dynamic_lstm": _stacked_dynamic_lstm,
+    "machine_translation": _machine_translation,
+    "transformer": _transformer,
+    "moe_transformer": _moe_transformer,
+    "ctr": _ctr,
+    "word2vec": _word2vec,
+    "recommender": _recommender,
+    "label_semantic_roles": _label_semantic_roles,
+}
+
+
+def _benchmark_targets() -> Iterator[LintTarget]:
+    """The benchmark harness's own program builds (its default arg
+    shapes). Importable only with the repo root on sys.path; callers
+    treat ImportError as 'no benchmark package here'."""
+    from benchmark.fluid_benchmark import MODELS, parse_args
+
+    for name, adapter in sorted(MODELS.items()):
+        args = parse_args(["--model", name, "--batch_size", "4"])
+        main, startup, _loss, _feed, _unit = adapter(args)
+        yield LintTarget(f"benchmark/{name}",
+                         {"main": main, "startup": startup})
+
+
+def iter_lint_targets(include_benchmark: bool = True,
+                      only: List[str] = None) -> Iterator[LintTarget]:
+    for name, build in MODEL_BUILDERS.items():
+        if only and name not in only:
+            continue
+        programs, pairs = build()
+        yield LintTarget(f"models/{name}", programs, pairs)
+    if include_benchmark and not only:
+        try:
+            yield from _benchmark_targets()
+        except ImportError:
+            pass

@@ -277,6 +277,14 @@ def _analyze_block_py(block, feed_names, fetch_names):
 
 def _build_step_fn(block, feed_names, mutated, const, state_out,
                    fetch_names, free_after=None):
+    # pre-compile gate (reference op_desc.cc/operator.cc validate
+    # before Run): FLAGS_static_check={off,warn,strict} runs the
+    # analysis checker suite over the program ONCE per version —
+    # strict raises EnforceNotMet with the PTA diagnostics instead of
+    # letting a malformed program fail deep inside the jax trace
+    from ..analysis import maybe_check_program
+
+    maybe_check_program(block.program)
     keep = set(state_out) | set(fetch_names)
 
     def step(mut_state, const_state, feeds, rng):

@@ -58,6 +58,50 @@ class OpInfo:
 
 _REGISTRY: Dict[str, OpInfo] = {}
 
+
+def kernel_bridges_host(fn: Callable) -> bool:
+    """True when `fn`'s code references jax's io_callback/pure_callback
+    host bridges — directly, in nested functions, or through helper
+    functions defined in the SAME module (a kernel that factors its
+    callback into a shared module helper must still trip the
+    host_effect assert). Works off code objects (co_names covers both
+    module-level imports and function-local `from jax.experimental
+    import io_callback`), so it costs microseconds at registration —
+    no source parsing. Cross-module helpers are not followed; a
+    kernel delegating its host bridge to another module must carry
+    host_effect=True explicitly."""
+    import types
+
+    targets = ("io_callback", "pure_callback")
+    seen = set()
+
+    def scan_fn(f):
+        code = getattr(f, "__code__", None)
+        if code is None or id(code) in seen:
+            return False  # seen: also breaks mutual-recursion cycles
+        if scan_code(code):
+            return True
+        # follow same-module helper functions referenced by name
+        module = getattr(f, "__module__", None)
+        globs = getattr(f, "__globals__", {})
+        for name in code.co_names:
+            g = globs.get(name)
+            if isinstance(g, types.FunctionType) and \
+                    g.__module__ == module and scan_fn(g):
+                return True
+        return False
+
+    def scan_code(code):
+        if id(code) in seen:
+            return False
+        seen.add(id(code))
+        if any(n in code.co_names for n in targets):
+            return True
+        return any(isinstance(c, types.CodeType) and scan_code(c)
+                   for c in code.co_consts)
+
+    return scan_fn(fn)
+
 # placeholder input name meaning "no value" (e.g. an output grad that is
 # never reached by backprop); run_op resolves it to None and the vjp grad
 # kernel substitutes zeros (reference uses fill_zeros_like ops instead).
@@ -132,6 +176,17 @@ def register_op(type: str, *, infer_shape=None, grad_maker=None,
     """Decorator: register `fn(ctx) -> {out_slot: value|[values]}`."""
 
     def deco(fn):
+        if not host_effect and kernel_bridges_host(fn):
+            # the r6 'REMEMBER the flag' learning, mechanized: a
+            # host-bridging kernel registered without the flag would be
+            # silently lowered into Executor.run_steps' device-resident
+            # lax.scan, breaking its once-per-step host semantics
+            raise RuntimeError(
+                f"op {type!r}: kernel references io_callback/"
+                f"pure_callback but is registered with "
+                f"host_effect=False — register with host_effect=True "
+                f"so Executor.run_steps falls back to the per-step "
+                f"path (analysis checker PTA070)")
         _REGISTRY[type] = OpInfo(
             type, fn, infer_shape=infer_shape, grad_maker=grad_maker,
             differentiable=differentiable, inplace=inplace,

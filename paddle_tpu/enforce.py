@@ -24,6 +24,11 @@ class EnforceNotMet(RuntimeError):
         super().__init__(message)
 
 
+# spelling used by the analysis gate docs (FLAGS_static_check=strict
+# "raises EnforceError"); same type, both names resolve
+EnforceError = EnforceNotMet
+
+
 def _caller():
     # sys._getframe: one frame fetch, no per-frame source-context
     # reads like inspect.stack() would do for the WHOLE stack

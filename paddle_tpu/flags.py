@@ -35,6 +35,19 @@ import warnings
 __all__ = ["FLAGS", "set_flags", "get_flags"]
 
 
+def _as_static_check(s):
+    """FLAGS_static_check mode: off | warn | strict (bool spellings
+    map 0->off, 1->warn for launch-script convenience)."""
+    v = str(s).strip().lower()
+    if v in ("off", "warn", "strict"):
+        return v
+    if v in ("0", "false", "no", ""):
+        return "off"
+    if v in ("1", "true", "yes", "on"):
+        return "warn"
+    raise ValueError(f"{s!r} is not one of off/warn/strict")
+
+
 def _as_bool(s):
     if isinstance(s, bool):
         return s
@@ -56,6 +69,11 @@ _DEFS = {
     "cpu_deterministic": (_as_bool, False, True),
     "cudnn_deterministic": (_as_bool, False, True),
     "strict_infer_shape": (_as_bool, False, True),
+    # program verifier (paddle_tpu/analysis): run the static checker
+    # suite before every Executor compile. off = skip, warn =
+    # warnings.warn the diagnostics, strict = raise EnforceNotMet on
+    # any error-severity diagnostic (PTA0xx codes)
+    "static_check": (_as_static_check, "off", True),
     "use_bf16": (_as_bool, False, True),
     "benchmark": (_as_bool, False, True),
     # cross-check the native (C++) block analyzer/GC-planner against the

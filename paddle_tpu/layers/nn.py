@@ -1006,6 +1006,15 @@ def log_softmax(input, axis=-1, name=None):
 
 def increment(x, value=1.0, in_place=True):
     helper = LayerHelper("increment", input=x)
+    # integer counters: a python-float step (the fluid-parity 1.0
+    # default) would promote the value to float under JAX weak typing
+    # and break lax.while_loop carry dtypes (analysis checker PTA020)
+    # -- coerce integral steps to int so counters stay counters
+    dt = getattr(x, "dtype", None)
+    dt = getattr(dt, "value", dt)
+    if isinstance(value, float) and isinstance(dt, str) \
+            and dt.startswith(("int", "uint")) and value.is_integer():
+        value = int(value)
     if in_place:
         out = x
     else:

@@ -1,0 +1,638 @@
+"""Program-verifier tests: one positive + one negative case per
+checker (paddle_tpu/analysis). Reference counterpart of the validation
+the C++ side does in op_desc.cc/operator.cc — here the failure classes
+come from CLAUDE.md session learnings, so each test doubles as a
+regression pin for a real incident."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import analysis, layers
+from paddle_tpu.analysis import (ERROR, INFO, WARNING, check_clone_uids,
+                                 check_registry, check_shared_params,
+                                 run_checks)
+
+
+def _codes(diags, severity=None):
+    return {d.code for d in diags
+            if severity is None or d.severity == severity}
+
+
+def _diags(program, code):
+    return [d for d in run_checks(program) if d.code == code]
+
+
+def _guarded():
+    main, startup = fluid.Program(), fluid.Program()
+    return main, startup, fluid.program_guard(main, startup)
+
+
+# ---------------------------------------------------------------------------
+# PTA001 uninitialized read
+# ---------------------------------------------------------------------------
+class TestUninitializedRead:
+    def test_positive(self):
+        main, startup, g = _guarded()
+        with g:
+            blk = main.global_block
+            blk.append_op("scale", {"X": ["ghost"]}, {"Out": ["y"]},
+                          {"scale": 2.0})
+        ds = _diags(main, "PTA001")
+        assert ds and ds[0].severity == WARNING
+        assert ds[0].var == "ghost"
+
+    def test_negative_data_and_order(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            h = layers.scale(x, 2.0)
+            layers.scale(h, 0.5)
+        assert not _diags(main, "PTA001")
+
+
+# ---------------------------------------------------------------------------
+# PTA002 multi-writer
+# ---------------------------------------------------------------------------
+class TestMultiWriter:
+    def test_positive(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            blk = main.global_block
+            blk.append_op("scale", {"X": x}, {"Out": ["t"]},
+                          {"scale": 2.0})
+            blk.append_op("scale", {"X": x}, {"Out": ["t"]},
+                          {"scale": 3.0})
+        ds = _diags(main, "PTA002")
+        assert ds and ds[0].severity == INFO and ds[0].var == "t"
+
+    def test_negative_persistable_update(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            acc = main.global_block.create_var(
+                name="acc", shape=(4,), dtype="float32",
+                persistable=True)
+            blk = main.global_block
+            blk.append_op("elementwise_add", {"X": acc, "Y": x},
+                          {"Out": acc}, {})
+            blk.append_op("elementwise_add", {"X": acc, "Y": x},
+                          {"Out": acc}, {})
+        assert not _diags(main, "PTA002")
+
+
+# ---------------------------------------------------------------------------
+# PTA003 dead op
+# ---------------------------------------------------------------------------
+class TestDeadOp:
+    def test_positive(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            layers.scale(x, 2.0)  # result never consumed
+        ds = _diags(main, "PTA003")
+        assert ds and ds[0].severity == INFO
+
+    def test_negative_consumed(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            h = layers.scale(x, 2.0)
+            out = main.global_block.create_var(
+                name="out", shape=(4,), dtype="float32",
+                persistable=True)
+            main.global_block.append_op("assign", {"X": h},
+                                        {"Out": out}, {})
+        assert not _diags(main, "PTA003")
+
+
+# ---------------------------------------------------------------------------
+# PTA004 go-capture hazards (the _launch_go_ops bug class, static)
+# ---------------------------------------------------------------------------
+class TestGoCapture:
+    def test_positive_late_writer(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            sub = main.create_block()
+            sub.append_op("scale", {"X": ["late"]}, {"Out": ["s"]},
+                          {"scale": 1.0})
+            main.rollback()
+            blk = main.global_block
+            blk.append_op("go", {"X": ["late"]}, {},
+                          {"sub_block": sub})
+            blk.append_op("scale", {"X": x}, {"Out": ["late"]},
+                          {"scale": 1.0})
+        ds = _diags(main, "PTA004")
+        assert ds and ds[0].severity == ERROR
+        assert "AFTER the go op" in ds[0].message
+
+    def test_negative_clean_capture(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            h = layers.scale(x, 2.0)
+            with layers.Go():
+                layers.scale(h, 1.0)
+        assert not _diags(main, "PTA004")
+
+
+# ---------------------------------------------------------------------------
+# PTA010 collective inside divergent control flow (the r5 deadlock)
+# ---------------------------------------------------------------------------
+def _collective_in_cond_program():
+    """Crafted pp-style program: a per-stage predicate gating a branch
+    that contains an allreduce — the shape of program that is KNOWN to
+    deadlock on a real mesh (CLAUDE.md round-5 learnings: no
+    collective may live in a divergent lax.cond branch)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        from paddle_tpu.layers.collective import _allreduce
+
+        x = layers.data("x", shape=[4], dtype="float32")
+        stage = layers.fill_constant([1], "float32", 0.0)
+        pred = layers.less_than_value(stage, 1.0)
+        layers.cond(pred,
+                    lambda: _allreduce(layers.scale(x, 2.0)),
+                    lambda: layers.scale(x, 1.0))
+    return main
+
+
+class TestCollectiveInBranch:
+    def test_positive_cond_allreduce(self):
+        main = _collective_in_cond_program()
+        ds = _diags(main, "PTA010")
+        assert ds and ds[0].severity == ERROR
+        assert "allreduce" in ds[0].message
+        assert ds[0].op_type == "conditional_block"
+
+    def test_positive_axis_name_in_while(self):
+        main, startup, g = _guarded()
+        with g:
+            sub = main.create_block()
+            sub.append_op("sync_batch_norm", {"X": ["h"]},
+                          {"Y": ["h2"]}, {"axis_name": "dp"})
+            main.rollback()
+            main.global_block.append_op(
+                "while", {"Condition": ["c"], "X": [], "Init": []},
+                {"Out": []},
+                {"sub_block": sub, "carried": [], "externals": []})
+        assert _codes(_diags(main, "PTA010")) == {"PTA010"}
+
+    def test_negative_top_level_allreduce(self):
+        main, startup, g = _guarded()
+        with g:
+            from paddle_tpu.layers.collective import _allreduce
+
+            x = layers.data("x", shape=[4], dtype="float32")
+            _allreduce(layers.scale(x, 2.0))
+        assert not _diags(main, "PTA010")
+
+
+# ---------------------------------------------------------------------------
+# PTA011 scope-dependent collectives in branches (r6 generalized trap)
+# ---------------------------------------------------------------------------
+class TestScopeCollectiveInBranch:
+    def test_positive_attention_in_while(self):
+        main, startup, g = _guarded()
+        with g:
+            sub = main.create_block()
+            sub.append_op("attention", {"Q": ["q"]}, {"Out": ["o"]}, {})
+            main.rollback()
+            main.global_block.append_op(
+                "while", {"Condition": ["c"], "X": [], "Init": []},
+                {"Out": []},
+                {"sub_block": sub, "carried": [], "externals": []})
+        ds = _diags(main, "PTA011")
+        assert ds and ds[0].severity == WARNING
+
+    def test_negative_attention_top_level(self):
+        main, startup, g = _guarded()
+        with g:
+            main.global_block.append_op("attention", {"Q": ["q"]},
+                                        {"Out": ["o"]}, {})
+        assert not _diags(main, "PTA011")
+
+
+# ---------------------------------------------------------------------------
+# PTA020 while-carry dtype promotion (increment int->float trap)
+# ---------------------------------------------------------------------------
+def _while_counter_program(step):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = layers.fill_constant([1], "int64", 0)
+        limit = layers.fill_constant([1], "int64", 10)
+        cond = layers.less_than(i, limit)
+        w = layers.While(cond)
+        with w.block():
+            blk = main.current_block()
+            blk.append_op("increment", {"X": i.name}, {"Out": i.name},
+                          {"step": step})
+            layers.less_than(i, limit, cond=cond)
+    return main
+
+
+class TestWhileCarryDtype:
+    def test_positive_float_step_in_while(self):
+        ds = _diags(_while_counter_program(1.0), "PTA020")
+        assert ds and ds[0].severity == ERROR
+        assert "while" in ds[0].message.lower() or \
+            "carry" in ds[0].message
+
+    def test_negative_int_step(self):
+        assert not _diags(_while_counter_program(1), "PTA020")
+
+    def test_layer_coerces_integral_float_step(self):
+        # the satellite fix: layers.increment(int_var, 1.0) must not
+        # emit a float step for integer counters
+        main, startup, g = _guarded()
+        with g:
+            i = layers.fill_constant([1], "int64", 0)
+            layers.increment(i, 1.0)
+        ops = [op for op in main.global_block.ops
+               if op.type == "increment"]
+        assert ops and isinstance(ops[0].attrs["step"], int)
+        assert not _diags(main, "PTA020")
+
+    def test_warning_outside_while(self):
+        main, startup, g = _guarded()
+        with g:
+            i = layers.fill_constant([1], "int64", 0)
+            main.global_block.append_op(
+                "increment", {"X": i.name}, {"Out": i.name},
+                {"step": 1.0})
+        ds = _diags(main, "PTA020")
+        assert ds and ds[0].severity == WARNING
+
+
+# ---------------------------------------------------------------------------
+# PTA030 / PTA031 sampling-op uid preservation
+# ---------------------------------------------------------------------------
+def _dropout_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        h = layers.dropout(x, dropout_prob=0.5)
+        layers.mean(layers.dropout(h, dropout_prob=0.5))
+    return main
+
+
+class TestSamplingUids:
+    def test_positive_uid_collision(self):
+        main = _dropout_program()
+        drops = [op for op in main.global_block.ops
+                 if op.type == "dropout"]
+        assert len(drops) == 2
+        drops[1]._uid = drops[0]._uid
+        ds = _diags(main, "PTA030")
+        assert ds and ds[0].severity == ERROR
+
+    def test_negative_distinct_uids(self):
+        assert not _diags(_dropout_program(), "PTA030")
+
+    def test_negative_recompute_clone_share_is_legal(self):
+        # a backward-role clone sharing its forward op's uid is the
+        # INTENDED recompute contract, not a collision
+        main = _dropout_program()
+        blk = main.global_block
+        fwd = [op for op in blk.ops if op.type == "dropout"][0]
+        clone = blk.append_op(
+            "dropout", dict(fwd.inputs),
+            {"Out": [n + "@RECOMP0_0" for n in fwd.outputs["Out"]]},
+            dict(fwd.attrs, op_role="backward"))
+        clone._uid = fwd._uid
+        assert not _diags(main, "PTA030")
+
+    def test_clone_preserves_uids(self):
+        main = _dropout_program()
+        assert check_clone_uids(main, main.clone()) == []
+        assert check_clone_uids(main, main.clone(for_test=True)) == []
+
+    def test_clone_uid_mutation_detected(self):
+        main = _dropout_program()
+        cloned = main.clone()
+        for op in cloned.global_block.ops:
+            if op.type == "dropout":
+                op._uid += 991
+        ds = check_clone_uids(main, cloned)
+        assert ds and all(d.code == "PTA031" and d.severity == ERROR
+                          for d in ds)
+
+
+# ---------------------------------------------------------------------------
+# PTA040 recompute clones rooted in optimization_barrier
+# ---------------------------------------------------------------------------
+class TestRecomputeBarriers:
+    def test_positive_unbarriered_clone(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            h = layers.scale(x, 2.0)
+            main.global_block.append_op(
+                "scale", {"X": [h.name]},
+                {"Out": [h.name + "@RECOMP0_0"]}, {"scale": 2.0})
+        ds = _diags(main, "PTA040")
+        assert ds and ds[0].severity == ERROR
+        assert "CSE" in ds[0].message
+
+    def test_negative_real_recompute(self):
+        # backward.py's own checkpointing must satisfy its checker
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[8], dtype="float32")
+            h1 = layers.fc(x, 8, act="relu")
+            h2 = layers.fc(h1, 8, act="relu")
+            loss = layers.mean(layers.fc(h2, 1))
+            from paddle_tpu.backward import append_backward
+
+            append_backward(loss, checkpoints=[h1])
+        has_recomp = any("@RECOMP" in n for op in main.global_block.ops
+                         for n in op.output_arg_names)
+        assert has_recomp  # the plan actually emitted clones
+        assert not _diags(main, "PTA040")
+
+
+# ---------------------------------------------------------------------------
+# PTA050 / PTA051 parameter naming across builds
+# ---------------------------------------------------------------------------
+class TestParamNaming:
+    def test_positive_auto_names(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            layers.fc(x, 4)
+        ds = _diags(main, "PTA050")
+        assert ds and ds[0].severity == INFO
+
+    def test_negative_explicit_names(self):
+        from paddle_tpu.param_attr import ParamAttr
+
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            layers.fc(x, 4, param_attr=ParamAttr(name="proj_w"),
+                      bias_attr=ParamAttr(name="proj_b"))
+        assert not _diags(main, "PTA050")
+
+    def _prog_with_param(self, name, shape):
+        p = fluid.Program()
+        p.global_block.create_parameter(name=name, shape=shape,
+                                        dtype="float32")
+        return p
+
+    def test_pair_shape_mismatch_is_error(self):
+        a = self._prog_with_param("fc_0.w_0", [4, 4])
+        b = self._prog_with_param("fc_0.w_0", [8, 4])
+        ds = check_shared_params(a, b)
+        assert ds and ds[0].code == "PTA051" \
+            and ds[0].severity == ERROR
+
+    def test_pair_auto_name_share_is_warning(self):
+        a = self._prog_with_param("fc_0.w_0", [4, 4])
+        b = self._prog_with_param("fc_0.w_0", [4, 4])
+        ds = check_shared_params(a, b)
+        assert ds and ds[0].severity == WARNING
+
+    def test_pair_explicit_share_is_clean(self):
+        a = self._prog_with_param("enc0_q.w", [4, 4])
+        b = self._prog_with_param("enc0_q.w", [4, 4])
+        assert check_shared_params(a, b) == []
+
+
+# ---------------------------------------------------------------------------
+# PTA060 @SEQ_LEN companion batch consistency
+# ---------------------------------------------------------------------------
+class TestSeqLenCompanion:
+    def _prog(self, companion_shape):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[2, 8], dtype="int64",
+                            append_batch_size=False)
+            main.global_block.create_var(
+                name="x@SEQ_LEN", shape=companion_shape, dtype="int32",
+                is_data=True, stop_gradient=True)
+            layers.scale(layers.cast(x, "float32"), 1.0)
+        return main
+
+    def test_positive_dynamic_companion_static_batch(self):
+        ds = _diags(self._prog((-1,)), "PTA060")
+        assert ds and ds[0].severity == ERROR
+
+    def test_negative_matching_batch(self):
+        assert not _diags(self._prog((2,)), "PTA060")
+
+    def test_positive_read_but_undeclared_companion(self):
+        main, startup, g = _guarded()
+        with g:
+            main.global_block.append_op(
+                "cast", {"X": ["w@SEQ_LEN"]}, {"Out": ["lens_f"]},
+                {"out_dtype": "float32"})
+        ds = _diags(main, "PTA060")
+        assert ds and ds[0].severity == WARNING
+        assert "declares" in ds[0].message
+
+
+# ---------------------------------------------------------------------------
+# PTA070 host_effect completeness + registration-time assert
+# ---------------------------------------------------------------------------
+class TestHostEffectFlag:
+    def test_kernel_bridges_host_detection(self):
+        from paddle_tpu.core.registry import kernel_bridges_host
+
+        def bridging(ctx):
+            def inner(v):
+                return io_callback(None, None, v)  # noqa: F821
+
+            return inner
+
+        def plain(ctx):
+            return ctx.input("X")
+
+        assert kernel_bridges_host(bridging)
+        assert not kernel_bridges_host(plain)
+
+    def test_kernel_bridges_host_follows_module_helpers(self):
+        # a kernel factoring its callback into a same-module helper
+        # must still trip the assert (review finding: co_names of the
+        # kernel alone only sees the helper's name)
+        import types
+
+        mod = types.ModuleType("_pta070_helper_mod")
+        src = ("def _helper(v):\n"
+               "    return io_callback(None, None, v)\n"
+               "def kernel(ctx):\n"
+               "    return _helper(ctx)\n"
+               "def clean_kernel(ctx):\n"
+               "    return str(ctx)\n")
+        exec(compile(src, "<pta070>", "exec"), mod.__dict__)
+        from paddle_tpu.core.registry import kernel_bridges_host
+
+        assert kernel_bridges_host(mod.kernel)
+        assert not kernel_bridges_host(mod.clean_kernel)
+
+    def test_register_op_asserts_flag(self):
+        from paddle_tpu.core.registry import (_REGISTRY, is_registered,
+                                              register_op)
+
+        with pytest.raises(RuntimeError, match="host_effect"):
+            @register_op("_pta070_bad_op")
+            def bad(ctx):
+                return io_callback(None, None)  # noqa: F821
+
+        assert not is_registered("_pta070_bad_op")
+
+        @register_op("_pta070_good_op", host_effect=True)
+        def good(ctx):
+            return io_callback(None, None)  # noqa: F821
+
+        try:
+            assert is_registered("_pta070_good_op")
+        finally:
+            del _REGISTRY["_pta070_good_op"]
+
+    def test_positive_registry_sweep(self):
+        from paddle_tpu.core.registry import OpInfo, _REGISTRY
+
+        def sneaky(ctx):
+            return io_callback(None, None)  # noqa: F821
+
+        _REGISTRY["_pta070_sneaky"] = OpInfo("_pta070_sneaky", sneaky)
+        try:
+            ds = check_registry(["_pta070_sneaky"])
+            assert ds and ds[0].code == "PTA070" \
+                and ds[0].severity == ERROR
+            # program-level checker finds it through the used-op sweep
+            main = fluid.Program()
+            main.global_block.append_op("_pta070_sneaky", {}, {}, {})
+            assert "PTA070" in _codes(run_checks(main))
+        finally:
+            del _REGISTRY["_pta070_sneaky"]
+
+    def test_negative_shipped_registry_clean(self):
+        assert check_registry() == []
+
+
+# ---------------------------------------------------------------------------
+# PTA080 unregistered op
+# ---------------------------------------------------------------------------
+class TestUnregisteredOp:
+    def test_positive(self):
+        main = fluid.Program()
+        main.global_block.append_op("definitely_not_an_op", {}, {}, {})
+        ds = _diags(main, "PTA080")
+        assert ds and ds[0].severity == ERROR
+
+    def test_negative_feed_fetch_plumbing(self):
+        main = fluid.Program()
+        main.global_block.append_op("feed", {}, {"Out": ["x"]}, {})
+        main.global_block.append_op("fetch", {"X": ["x"]}, {}, {})
+        assert not _diags(main, "PTA080")
+
+
+# ---------------------------------------------------------------------------
+# Executor gate: FLAGS_static_check={off,warn,strict}
+# ---------------------------------------------------------------------------
+class TestExecutorGate:
+    def _int_promotion_program(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[1], dtype="int64",
+                            append_batch_size=False)
+            y = main.global_block.create_var(name="y", shape=(1,),
+                                             dtype="int64")
+            main.global_block.append_op("increment", {"X": x},
+                                        {"Out": y}, {"step": 1.0})
+        return main
+
+    def test_strict_raises_enforce(self):
+        from paddle_tpu.enforce import EnforceNotMet
+
+        main = _collective_in_cond_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        fluid.set_flags({"FLAGS_static_check": "strict"})
+        try:
+            with pytest.raises(EnforceNotMet, match="PTA010"):
+                exe.run(main,
+                        feed={"x": np.zeros((1, 4), np.float32)},
+                        fetch_list=[])
+        finally:
+            fluid.set_flags({"FLAGS_static_check": "off"})
+
+    def test_warn_mode_warns_and_runs(self):
+        main = self._int_promotion_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        fluid.set_flags({"FLAGS_static_check": "warn"})
+        try:
+            with pytest.warns(UserWarning, match="PTA020"):
+                out = exe.run(main,
+                              feed={"x": np.zeros((1,), np.int64)},
+                              fetch_list=["y"])
+        finally:
+            fluid.set_flags({"FLAGS_static_check": "off"})
+        assert out[0].shape == (1,)
+
+    def test_off_mode_is_silent(self):
+        import warnings as W
+
+        main = self._int_promotion_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with W.catch_warnings(record=True) as caught:
+            W.simplefilter("always")
+            out = exe.run(main, feed={"x": np.zeros((1,), np.int64)},
+                          fetch_list=["y"])
+        assert not [w for w in caught
+                    if "static_check" in str(w.message)]
+        assert out[0].shape == (1,)
+
+    def test_strict_passes_clean_program(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[4], dtype="float32")
+            y = layers.scale(x, 2.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        fluid.set_flags({"FLAGS_static_check": "strict"})
+        try:
+            out = exe.run(main,
+                          feed={"x": np.ones((2, 4), np.float32)},
+                          fetch_list=[y])
+        finally:
+            fluid.set_flags({"FLAGS_static_check": "off"})
+        np.testing.assert_allclose(out[0], 2.0 * np.ones((2, 4)))
+
+    def test_flag_rejects_bogus_mode(self):
+        with pytest.raises(ValueError):
+            fluid.set_flags({"FLAGS_static_check": "bogus"})
+
+
+# ---------------------------------------------------------------------------
+# suite plumbing
+# ---------------------------------------------------------------------------
+class TestSuitePlumbing:
+    def test_eight_plus_checkers_with_stable_codes(self):
+        codes = [c.code for c in analysis.registered_checkers()]
+        assert len(codes) >= 8
+        assert codes == sorted(codes)
+        assert all(c.startswith("PTA0") for c in codes)
+
+    def test_diagnostics_sorted_error_first(self):
+        main = _collective_in_cond_program()
+        main.global_block.append_op("definitely_not_an_op", {}, {}, {})
+        ds = run_checks(main)
+        sevs = [d.severity for d in ds]
+        order = {ERROR: 0, WARNING: 1, INFO: 2}
+        assert sevs == sorted(sevs, key=order.get)
+
+    def test_only_filter(self):
+        main = _collective_in_cond_program()
+        ds = run_checks(main, only=["PTA010"])
+        assert ds and _codes(ds) == {"PTA010"}
+
+    def test_dataflow_facts(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            h = layers.scale(x, 2.0)
+            layers.scale(h, 0.5)
+        df = analysis.analyze_block(main.global_block)
+        assert df.first_write[h.name] == 0
+        assert df.readers[h.name] == [1]
