@@ -1,0 +1,382 @@
+"""Warm-start layer: persistent, content-addressed compile cache.
+
+Reference counterpart: the reference amortizes per-step setup with
+Executor::Prepare / RunPreparedContext (reference
+paddle/fluid/framework/executor.cc:337,377) and ships inference as a
+pre-optimized ``__model__`` artifact — a fresh serving process never
+re-runs the analysis passes. paddle_tpu's analogue of "setup" is the
+XLA compile itself, and until now every process start re-traced and
+re-compiled every executable. PERF.md's serving table shows that cost
+landing inside the traffic window collapses the batching win from
+9.7x to 1.04x; ``aot_warmup()`` only MOVES those compiles ahead of
+traffic, it does not eliminate them.
+
+This module eliminates them across processes:
+
+* Keys are content-addressed: ``Program.fingerprint()`` (canonical
+  structural hash, NOT the process-local ``_uid``) + feed specs +
+  fetch names + AMP token + parallel-scope token + backend + device
+  count + jax/jaxlib version strings. Any component changing (a
+  Pass.apply version bump, a jaxlib upgrade, an AMP toggle) is a
+  clean miss, never a stale executable.
+* Values are serialized AOT executables via
+  ``jax.experimental.serialize_executable`` (API feature-detected the
+  way native/hlo_exec.py detects the StableHLO bridges), plus the aux
+  metadata (state_in/const_in/state_out names, feed/fetch lists,
+  write-only carry specs) needed to rehydrate a compiled step with
+  ZERO tracing. When executable serialization is unavailable the
+  entry persists lowered StableHLO instead — tracing is still
+  skipped; only the backend compile is redone at load.
+* Corrupt or stale entries are discarded with a named reason
+  (``CompileCache.discards``) and the caller recompiles — a broken
+  cache can slow a process down, never break it.
+
+Gated by ``FLAGS_compile_cache={off,ro,rw}`` +
+``FLAGS_compile_cache_dir``; wired through every Executor compile
+path (run / run_steps / the InferenceServer aot_warmup bucket ladder)
+in core/executor.py.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["CompileCache", "active_cache", "canonical_digest",
+           "version_token"]
+
+# bump when the entry layout changes: old-format entries become clean
+# named-reason discards instead of unpickling hazards
+_MAGIC = "ptp-exe-cache-v1"
+
+# tests force the StableHLO persistence path without uninstalling the
+# serialize_executable API
+_FORCE_STABLEHLO = [False]
+
+
+def _canon(o):
+    """json.dumps default= hook: canonicalize numpy/enum/odd values so
+    digests are process-stable."""
+    if isinstance(o, np.generic):
+        return o.item()
+    if isinstance(o, np.ndarray):
+        return {"__ndarray__": o.reshape(-1).tolist(),
+                "dtype": str(o.dtype), "shape": list(o.shape)}
+    if isinstance(o, (set, frozenset)):
+        return sorted(map(repr, o))
+    value = getattr(o, "value", None)
+    if value is not None and isinstance(value, (str, int)):
+        return value
+    return repr(o)
+
+
+def canonical_digest(parts: Dict[str, Any]) -> str:
+    """Stable sha256 of a JSON-canonicalized structure — the key/
+    fingerprint hasher (reference analogue: the serialized
+    ProgramDesc bytes that identify a `__model__` artifact, reference
+    python/paddle/fluid/io.py:865 save_inference_model writes
+    program.desc.serialize_to_string())."""
+    blob = json.dumps(parts, sort_keys=True, default=_canon).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+# computed once per process: hashing ~170 .py files (~2.5 MB) costs
+# milliseconds and only runs when the cache is actually consulted
+_SOURCE_TOKEN: list = []
+
+
+def _source_token() -> str:
+    """Content hash of the paddle_tpu package's own .py sources. The
+    program fingerprint hashes op DESCS, not op KERNELS — an epsilon
+    fix inside ops/ changes the compiled math without changing any
+    desc, and must be a clean cache miss, not a silently-stale
+    executable with the old numerics. Content-based (not mtime) so
+    identical code deployed into fresh containers still warm-starts."""
+    if _SOURCE_TOKEN:
+        return _SOURCE_TOKEN[0]
+    h = hashlib.sha256()
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    try:
+        paths = []
+        for dirpath, dirnames, files in os.walk(pkg_root):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__"]
+            paths.extend(os.path.join(dirpath, f) for f in files
+                         if f.endswith(".py"))
+        for p in sorted(paths):
+            h.update(p[len(pkg_root):].encode())
+            with open(p, "rb") as f:
+                h.update(f.read())
+        token = h.hexdigest()
+    except Exception:
+        token = "unhashable-source"
+    _SOURCE_TOKEN.append(token)
+    return token
+
+
+def version_token() -> Dict[str, str]:
+    """Toolchain + framework version strings for the cache key
+    (reference analogue: the version field baked into the serialized
+    ProgramDesc, reference framework/framework.proto:188 `version`,
+    checked at load): a serialized executable is an internal jaxlib
+    artifact AND embeds this framework's kernel lowerings, so a bump
+    of either must be a clean miss (tests spoof this to prove
+    invalidation)."""
+    import jax
+
+    try:
+        import jaxlib
+
+        jl = getattr(getattr(jaxlib, "version", None), "__version__",
+                     None) or getattr(jaxlib, "__version__", "unknown")
+    except Exception:
+        jl = "unknown"
+    return {"jax": jax.__version__, "jaxlib": str(jl),
+            "paddle_tpu_src": _source_token()}
+
+
+def _serialize_fns():
+    """Feature-detect the executable (de)serialization API — jaxlib
+    renames bite (CLAUDE.md r6: three spellings drifted in this
+    container alone), so never assume, always probe."""
+    if _FORCE_STABLEHLO[0]:
+        return None, None
+    try:
+        from jax.experimental import serialize_executable as se
+    except Exception:
+        return None, None
+    return (getattr(se, "serialize", None),
+            getattr(se, "deserialize_and_load", None))
+
+
+class _StableHLOCallable:
+    """Fallback rehydration: StableHLO text -> backend compile ->
+    flatten/execute/unflatten wrapper matching the traced step fn's
+    calling convention. Donation annotations survive in the module's
+    input_output_alias, so donated state buffers behave exactly like
+    the jit path (the executor re-gathers state from the scope each
+    step)."""
+
+    def __init__(self, loaded, in_tree, out_tree, in_dtypes):
+        self._loaded = loaded
+        self._in_tree = in_tree
+        self._out_tree = out_tree
+        self._in_dtypes = in_dtypes
+
+    def __call__(self, *args):
+        import jax
+        import jax.numpy as jnp
+
+        flat = jax.tree.flatten(args)[0]
+        bufs = []
+        for x, want in zip(flat, self._in_dtypes):
+            if not isinstance(x, jax.Array) or str(x.dtype) != want:
+                x = jnp.asarray(np.asarray(x).astype(want))
+            bufs.append(x)
+        outs = self._loaded.execute(bufs)
+        return jax.tree.unflatten(self._out_tree, list(outs))
+
+
+def _compile_stablehlo(text: str):
+    """backend.compile with the hlo_exec.py API feature detection."""
+    import jax
+    from jax._src.lib import xla_client
+
+    backend = jax.devices()[0].client
+    opts = xla_client.CompileOptions()
+    if hasattr(backend, "compile_and_load"):
+        return backend.compile_and_load(text, backend.devices()[:1],
+                                        opts)
+    return backend.compile(text, opts)
+
+
+class CompileCache:
+    """One on-disk cache root (reference analogue: the pre-optimized
+    `__model__` + params directory a serving process loads instead of
+    re-running analysis, reference
+    inference/api/analysis_predictor.cc:78 Init — here the persisted
+    artifact is the compiled executable itself). Entries are pickle
+    files named by the full key digest, sharded by a 2-char prefix;
+    writes are atomic (tempfile + os.replace) so concurrent processes
+    can share a root."""
+
+    def __init__(self, root: str, mode: str):
+        assert mode in ("ro", "rw"), mode
+        self.root = root
+        self.mode = mode
+        self.hit_count = 0        # entries successfully rehydrated
+        self.miss_count = 0       # no entry on disk
+        self.store_count = 0      # entries written this process
+        self.discards = []        # (digest, named reason)
+
+    @property
+    def writable(self) -> bool:
+        return self.mode == "rw"
+
+    @property
+    def last_discard_reason(self) -> Optional[str]:
+        return self.discards[-1][1] if self.discards else None
+
+    def _path(self, digest: str) -> str:
+        return os.path.join(self.root, digest[:2], digest + ".ptexe")
+
+    def _discard(self, digest: str, reason: str):
+        """Named-reason discard (never a crash): drop the entry from
+        disk when writable so the next process recompiles cleanly."""
+        self.discards.append((digest, reason))
+        warnings.warn(
+            f"compile_cache: discarding entry {digest[:12]}...: "
+            f"{reason} (recompiling)")
+        if self.writable:
+            try:
+                os.unlink(self._path(digest))
+            except OSError:
+                pass
+
+    # --- load ---------------------------------------------------------
+    def load_executable(self, digest: str):
+        """Rehydrate one entry -> (callable fn, meta dict) or None.
+        fn has the traced step's calling convention. Corrupt /
+        undeserializable entries are discarded with a named reason."""
+        path = self._path(digest)
+        try:
+            with open(path, "rb") as f:
+                entry = pickle.load(f)
+        except FileNotFoundError:
+            self.miss_count += 1
+            return None
+        except Exception as e:
+            self._discard(digest, f"unreadable/corrupt entry "
+                          f"({type(e).__name__}: {e})")
+            return None
+        if not isinstance(entry, dict) or entry.get("magic") != _MAGIC:
+            self._discard(digest, "entry format mismatch (truncated "
+                          "or written by an incompatible version)")
+            return None
+        try:
+            fmt = entry["format"]
+            if fmt == "aot":
+                _, deserialize = _serialize_fns()
+                if deserialize is None:
+                    raise RuntimeError(
+                        "serialize_executable API unavailable in this "
+                        "jax")
+                fn = deserialize(entry["payload"], entry["in_tree"],
+                                 entry["out_tree"])
+            elif fmt == "stablehlo":
+                loaded = _compile_stablehlo(entry["payload"])
+                fn = _StableHLOCallable(loaded, entry["in_tree"],
+                                        entry["out_tree"],
+                                        entry["in_dtypes"])
+            else:
+                raise RuntimeError(f"unknown entry format {fmt!r}")
+        except Exception as e:
+            self._discard(digest, f"executable failed to rehydrate "
+                          f"({type(e).__name__}: {e})")
+            return None
+        self.hit_count += 1
+        return fn, entry["meta"]
+
+    # --- store --------------------------------------------------------
+    def store_executable(self, digest: str, compiled, lowered,
+                         out_shape, meta: Dict[str, Any]) -> bool:
+        """Persist one AOT-compiled executable. `compiled` is the
+        jax.stages.Compiled, `lowered` its Lowered (the StableHLO
+        fallback source), `out_shape` the eval_shape output pytree
+        (out_tree source when serialize() is unavailable). Failures
+        are recorded, never raised — an unserializable program (e.g.
+        one bridging the host via io_callback) simply stays
+        process-local."""
+        if not self.writable:
+            return False
+        import jax
+
+        entry = {"magic": _MAGIC, "meta": meta,
+                 "versions": version_token()}
+        serialize, _ = _serialize_fns()
+        try:
+            if serialize is None:
+                raise RuntimeError(
+                    "serialize_executable API unavailable")
+            payload, in_tree, out_tree = serialize(compiled)
+            entry.update(format="aot", payload=payload,
+                         in_tree=in_tree, out_tree=out_tree)
+        except Exception as aot_err:
+            try:
+                in_avals = meta["in_avals"]
+                flat, in_tree = jax.tree.flatten(in_avals)
+                entry.update(
+                    format="stablehlo",
+                    payload=lowered.as_text(),
+                    in_tree=in_tree,
+                    out_tree=jax.tree.structure(out_shape),
+                    in_dtypes=[str(a.dtype) for a in flat])
+            except Exception as e:
+                self.discards.append(
+                    (digest, f"entry not serializable (aot: "
+                     f"{aot_err}; stablehlo: {type(e).__name__}: "
+                     f"{e})"))
+                return False
+        # in_avals are only needed at store time (tree/dtype
+        # extraction above); keep entries lean
+        entry["meta"] = {k: v for k, v in meta.items()
+                         if k != "in_avals"}
+        path = self._path(digest)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                       suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump(entry, f)
+                os.replace(tmp, path)  # atomic: readers never see a
+                # half-written entry
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except Exception as e:
+            self.discards.append(
+                (digest, f"entry not writable ({type(e).__name__}: "
+                 f"{e})"))
+            return False
+        self.store_count += 1
+        return True
+
+    def stats(self) -> dict:
+        return {"hits": self.hit_count, "misses": self.miss_count,
+                "stores": self.store_count,
+                "discards": len(self.discards)}
+
+
+# one CompileCache per (root, mode) per process so counters aggregate
+# across executors (serving clones share it the way they share the
+# in-memory cache)
+_CACHES: Dict[Tuple[str, str], CompileCache] = {}
+
+
+def active_cache() -> Optional[CompileCache]:
+    """The process's CompileCache per FLAGS, or None when off
+    (reference analogue: the gflags bridge gating optional engines,
+    reference python/paddle/fluid/__init__.py:129 env-flag
+    allowlist)."""
+    from ..flags import FLAGS
+
+    mode = FLAGS.compile_cache
+    if mode == "off":
+        return None
+    root = os.path.abspath(FLAGS.compile_cache_dir)
+    key = (root, mode)
+    cache = _CACHES.get(key)
+    if cache is None:
+        cache = _CACHES[key] = CompileCache(root, mode)
+    return cache

@@ -41,6 +41,11 @@ RNG_VAR = "@RNG@"
 
 _global_seed = [0]
 
+# (program uid, version, native_build) -> (reason-or-None,), the
+# Executor.prepare_unsupported_reason memo (wrapped in a tuple so a
+# cached None is distinguishable from a miss)
+_PREPARE_REASON_CACHE: Dict = {}
+
 
 def seed(s: int):
     """Set the global PRNG seed (analogue of fluid Program.random_seed)."""
@@ -117,6 +122,99 @@ class _CompiledScan(_CompiledBlock):
         self.write_only_specs = write_only_specs
         self.steps = steps
         self.stacked = stacked        # per-step xs vs one closed-over feed
+
+
+class ExecutableCache:
+    """Bounded in-memory executable cache (LRU).
+
+    Reference counterpart: the ExecutorPrepareContext cache the
+    Python Executor keeps per (program, scope) around
+    Executor::Prepare (reference python/paddle/fluid/executor.py:451
+    `Executor._get_program_cache`; reference
+    framework/executor.cc:289 Prepare builds what is cached) — here
+    the cached object is the compiled XLA executable, and the cache
+    is bounded.
+
+    The unbounded dict it replaces leaked one executable per program
+    mutation: `Pass.apply` bumps `program._version`, so the old entry
+    can never be hit again but was never dropped — a long-lived
+    serving process accumulated stranded XLA executables forever.
+    Capacity comes from `FLAGS_executor_cache_capacity` (<= 0 =
+    unbounded); evictions are counted for observability. Shared
+    across serving clones exactly like the dict was
+    (AnalysisPredictor.clone passes the object through)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            from ..flags import FLAGS
+
+            capacity = FLAGS.executor_cache_capacity
+        self.capacity = int(capacity)
+        self.evict_count = 0
+        self._d: "collections.OrderedDict" = collections.OrderedDict()
+        # serving clones share one instance across batcher/caller
+        # threads; the plain dict this replaces was GIL-atomic per op,
+        # but get() here is a read + move_to_end pair racing
+        # __setitem__'s eviction — lock the pairs
+        import threading
+
+        self._lock = threading.Lock()
+
+    def get(self, key, default=None):
+        with self._lock:
+            try:
+                value = self._d[key]
+            except KeyError:
+                return default
+            self._d.move_to_end(key)
+            return value
+
+    def __getitem__(self, key):
+        with self._lock:
+            value = self._d[key]
+            self._d.move_to_end(key)
+            return value
+
+    def __setitem__(self, key, value):
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            if self.capacity > 0:
+                while len(self._d) > self.capacity:
+                    self._d.popitem(last=False)
+                    self.evict_count += 1
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._d
+
+    def __len__(self):
+        with self._lock:
+            return len(self._d)
+
+    def clear(self):
+        with self._lock:
+            self._d.clear()
+
+
+def _as_aval(x):
+    """Example value -> the aval jit would see at call time (dtype
+    canonicalized the way the dispatch path does, so AOT-lowered
+    entry signatures match real calls)."""
+    arr = x if isinstance(x, jax.Array) else np.asarray(x)
+    return jax.ShapeDtypeStruct(
+        tuple(arr.shape), jax.dtypes.canonicalize_dtype(arr.dtype))
+
+
+def _dtype_from_str(s):
+    """np.dtype(str) that also resolves ml_dtypes names (bfloat16 is
+    not registered under np.dtype's string lookup)."""
+    try:
+        return np.dtype(s)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, s))
 
 
 _NATIVE_WARNED = [False]
@@ -501,6 +599,35 @@ def _check_feed_shape(block, name, value):
             f"batch layout or the data() declaration")
 
 
+def _first_host_effect_op(block) -> Optional[str]:
+    """Name of the first host-bridging op (registry host_effect flag)
+    in `block` or any sub-block, else None. Shared by the scan
+    fallback (host ops cannot live in a device-resident lax.scan) and
+    the disk compile cache gate (io_callback closures are
+    process-local pointers — a serialized executable carrying one
+    would crash or corrupt a fresh process)."""
+    from .program import Block
+
+    seen = set()
+
+    def walk(blk):
+        for op in blk.ops:
+            if op.type in ("feed", "fetch"):
+                continue
+            if is_registered(op.type) and \
+                    get_op_info(op.type).host_effect:
+                return op.type
+            for v in op.attrs.values():
+                if isinstance(v, Block) and id(v) not in seen:
+                    seen.add(id(v))
+                    r = walk(v)
+                    if r is not None:
+                        return r
+        return None
+
+    return walk(block)
+
+
 def _scan_fallback_reason(program):
     """Why a program cannot lower into the K-step scan executor
     (Executor.run_steps): returns None when scannable, else the named
@@ -519,29 +646,13 @@ def _scan_fallback_reason(program):
     if FLAGS.native_build:
         return ("FLAGS_native_build executes C++-built programs one "
                 "step at a time")
-    from .program import Block
-
-    seen = set()
-
-    def walk(blk):
-        for op in blk.ops:
-            if op.type in ("feed", "fetch"):
-                continue
-            if is_registered(op.type) and \
-                    get_op_info(op.type).host_effect:
-                return (f"op {op.type!r} bridges to the host "
-                        f"(io_callback / host threads) and cannot be "
-                        f"lowered into a device-resident lax.scan "
-                        f"over steps")
-            for v in op.attrs.values():
-                if isinstance(v, Block) and id(v) not in seen:
-                    seen.add(id(v))
-                    r = walk(v)
-                    if r is not None:
-                        return r
-        return None
-
-    return walk(program.global_block)
+    host_op = _first_host_effect_op(program.global_block)
+    if host_op is not None:
+        return (f"op {host_op!r} bridges to the host "
+                f"(io_callback / host threads) and cannot be "
+                f"lowered into a device-resident lax.scan "
+                f"over steps")
+    return None
 
 
 class Executor:
@@ -560,15 +671,22 @@ class Executor:
         # program _uid + _version, so sharing the dict across executors
         # running the same program object is sound — a warmed bucket
         # compiled by one worker is a cache hit for every other.
-        self._cache: Dict = {} if cache is None else cache
+        self._cache = ExecutableCache() if cache is None else cache
         # observability: how many XLA specializations THIS executor
         # built vs served from cache (serving perf is unverifiable
         # without these — the bucket-bound tests read them)
         self.compile_count = 0
         self.cache_hit_count = 0
+        # executables rehydrated from the on-disk warm-start cache
+        # (core/compile_cache.py) WITHOUT tracing or compiling
+        self.disk_load_count = 0
         # run_steps: named reason the last call used the per-step
         # fallback (None = the K-step scan path ran)
         self.last_run_steps_fallback: Optional[str] = None
+
+    @property
+    def cache_evict_count(self) -> int:
+        return getattr(self._cache, "evict_count", 0)
 
     def close(self):
         self._cache.clear()
@@ -770,16 +888,12 @@ class Executor:
                 out = [np.asarray(v) for v in out]
             return out
 
-        key = (program._uid, program._version, tuple(sorted(feed_specs)),
-               tuple(fetch_names), amp.state_token(),
-               _parallel_scope_token())
+        key = self._block_cache_key(program, feed_specs, fetch_names)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
-            compiled = self._compile(program, block,
-                                     tuple(sorted(feed_arrays)),
-                                     fetch_names, scope,
-                                     feed_arrays=feed_arrays)
-            self.compile_count += 1
+            compiled = self._resolve_block(
+                program, block, tuple(sorted(feed_specs)), fetch_names,
+                scope, feed_arrays)
             if use_program_cache:
                 self._cache[key] = compiled
         else:
@@ -946,18 +1060,14 @@ class Executor:
         from .. import amp
         from ..flags import FLAGS
 
-        key = ("scan", program._uid, program._version,
-               tuple(sorted(feed_specs)), tuple(fetch_names), steps,
-               feeds_seq is not None, amp.state_token(),
-               _parallel_scope_token())
+        key = self._scan_cache_key(program, feed_specs, fetch_names,
+                                   steps, feeds_seq is not None)
         compiled = self._cache.get(key) if use_program_cache else None
         if compiled is None:
-            compiled = self._compile_steps(
-                program, block, tuple(sorted(feed_arrays)),
-                fetch_names, scope, steps,
-                stacked=feeds_seq is not None, feed_arrays=feed_arrays,
-                device=device)
-            self.compile_count += 1
+            compiled = self._resolve_scan(
+                program, block, tuple(sorted(feed_specs)), fetch_names,
+                scope, steps, feeds_seq is not None, feed_arrays,
+                device)
             if use_program_cache:
                 self._cache[key] = compiled
         else:
@@ -1025,8 +1135,245 @@ class Executor:
         return out
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def prepare_unsupported_reason(program) -> Optional[str]:
+        """None when prepare(program) is supported, else the named
+        PROGRAM-level reason it is not. Callers with a per-call
+        fallback (predictor/serving) check this up front so that
+        per-REQUEST errors (bad feed shape) from a prepared handle
+        propagate like Executor.run's would, instead of being
+        mistaken for 'program not preparable'. Memoized per
+        (program, version, native-build flag): hot serving paths ask
+        on every request and must not re-walk the op list."""
+        from ..flags import FLAGS
+
+        from .compiler import CompiledProgram
+
+        if isinstance(program, CompiledProgram):
+            return "CompiledProgram runs through its own path"
+        key = (program._uid, program._version, FLAGS.native_build)
+        cached = _PREPARE_REASON_CACHE.get(key)
+        if cached is not None:
+            return cached[0]
+        if FLAGS.native_build:
+            reason = ("FLAGS_native_build steps carry their own "
+                      "context")
+        elif any(op.type == "go"
+                 for op in program.global_block.ops):
+            reason = "`go` ops launch host threads per run"
+        else:
+            reason = None
+        if len(_PREPARE_REASON_CACHE) > 512:
+            _PREPARE_REASON_CACHE.clear()
+        _PREPARE_REASON_CACHE[key] = (reason,)
+        return reason
+
+    def prepare(self, program: Optional[Program] = None, feed=None,
+                fetch_list=None, scope: Optional[Scope] = None,
+                steps: Optional[int] = None) -> "PreparedProgram":
+        """Resolve the executable + binding plans ONCE; the returned
+        PreparedProgram.run(feed) is the serving/bench hot-loop entry
+        that skips per-call cache hashing and trace-env rebuild
+        (reference Executor::Prepare / RunPreparedContext,
+        framework/executor.cc:337,377 — there it skips per-step op
+        creation; here it skips the Python dispatch prologue, the
+        measured 0.8-2.5 ms/step term of PERF.md "Host dispatch").
+
+        `feed` is an EXAMPLE feed dict (arrays at the exact serving
+        shapes) or a list of (name, shape, dtype) specs. With
+        steps=K the prepared executable is the K-step scan
+        (run_steps semantics: one shared feed dict per call, stacked
+        [K, ...] fetches; unscannable programs fall back per-step
+        with the named reason on `prepared.fallback_reason`)."""
+        program = program or default_main_program()
+        reason = self.prepare_unsupported_reason(program)
+        if reason is not None:
+            raise TypeError(f"prepare() does not support this "
+                            f"program: {reason}; use Executor.run")
+        scope = scope or global_scope()
+        return PreparedProgram(self, program, scope, feed, fetch_list,
+                               steps=steps)
+
+    # --- in-memory cache keys (ONE builder per kind: run/run_steps/
+    # PreparedProgram._bind must agree byte-for-byte or they stop
+    # sharing executables) -------------------------------------------
+    @staticmethod
+    def _block_cache_key(program, feed_specs, fetch_names):
+        from .. import amp
+
+        return (program._uid, program._version,
+                tuple(sorted(feed_specs)), tuple(fetch_names),
+                amp.state_token(), _parallel_scope_token())
+
+    @staticmethod
+    def _scan_cache_key(program, feed_specs, fetch_names, steps,
+                        stacked):
+        from .. import amp
+
+        return ("scan", program._uid, program._version,
+                tuple(sorted(feed_specs)), tuple(fetch_names),
+                int(steps), bool(stacked), amp.state_token(),
+                _parallel_scope_token())
+
+    # --- warm-start layer (core/compile_cache.py) ---------------------
+    def _disk_slot(self, program, feed_specs, fetch_names, kind,
+                   extra=()):
+        """(CompileCache, key digest) for one compile site, or
+        (None, None) when the disk cache is off / inapplicable. The
+        digest is process-STABLE: Program.fingerprint() (not _uid) +
+        feed specs + fetch set + AMP/parallel-scope tokens + backend +
+        device count + jax/jaxlib versions — any toolchain or program
+        change is a clean miss."""
+        from ..flags import FLAGS
+
+        if FLAGS.native_build:
+            # native-built steps have their own C++ artifact path
+            return None, None
+        from .compile_cache import (active_cache, canonical_digest,
+                                    version_token)
+
+        dcache = active_cache()
+        if dcache is None:
+            return None, None
+        if _first_host_effect_op(program.global_block) is not None:
+            # io_callback closures are process-local function
+            # pointers: a persisted executable carrying one would
+            # crash (or worse) in the fresh process that loads it —
+            # host-bridging programs stay process-local, both on
+            # store AND on load
+            return None, None
+        from .. import amp
+
+        parts = {"kind": kind,
+                 "program": program.fingerprint(),
+                 "feeds": sorted(tuple(s) for s in feed_specs),
+                 "fetch": tuple(fetch_names),
+                 "amp": amp.state_token(),
+                 "pscope": _parallel_scope_token(),
+                 "donate": self.donate,
+                 "backend": jax.default_backend(),
+                 "ndev": jax.device_count(),
+                 "extra": tuple(extra)}
+        parts.update(version_token())
+        return dcache, canonical_digest(parts)
+
+    def _resolve_block(self, program, block, feed_specs, fetch_names,
+                       scope, feed_arrays):
+        """In-memory-miss path for run(): rehydrate a serialized
+        executable from the warm-start cache (ZERO tracing), else
+        trace + compile (persisting the result when writable)."""
+        dcache, digest = self._disk_slot(program, feed_specs,
+                                         fetch_names, "block")
+        if dcache is not None:
+            got = dcache.load_executable(digest)
+            if got is not None:
+                fn, meta = got
+                # the pre-compile static-check gate still guards
+                # disk-warmed paths (cached per program version)
+                from ..analysis import maybe_check_program
+
+                maybe_check_program(program)
+                self.disk_load_count += 1
+                return _CompiledBlock(
+                    fn, tuple(meta["feed_names"]), meta["state_in"],
+                    meta["const_in"], meta["state_out"],
+                    meta["fetch_names"])
+        compiled = self._compile(program, block,
+                                 tuple(sorted(feed_arrays)),
+                                 fetch_names, scope,
+                                 feed_arrays=feed_arrays,
+                                 aot=dcache is not None)
+        self.compile_count += 1
+        if dcache is not None and dcache.writable:
+            self._disk_store(dcache, digest, compiled, kind="block")
+        return compiled
+
+    def _resolve_scan(self, program, block, feed_specs, fetch_names,
+                      scope, steps, stacked, feed_arrays, device):
+        """run_steps analogue of _resolve_block — the K-specialized
+        scan executable is the most expensive single compile in the
+        repo, so it benefits most from the disk warm start."""
+        dcache, digest = self._disk_slot(program, feed_specs,
+                                         fetch_names, "scan",
+                                         extra=(steps, stacked))
+        if dcache is not None:
+            got = dcache.load_executable(digest)
+            if got is not None:
+                fn, meta = got
+                from ..analysis import maybe_check_program
+
+                maybe_check_program(program)
+                self.disk_load_count += 1
+                wos = {n: jax.ShapeDtypeStruct(tuple(shape),
+                                               _dtype_from_str(dt))
+                       for n, shape, dt in meta["write_only_specs"]}
+                return _CompiledScan(
+                    fn, tuple(meta["feed_names"]), meta["state_in"],
+                    meta["const_in"], meta["state_out"],
+                    meta["fetch_names"], wos, meta["steps"],
+                    meta["stacked"])
+        compiled = self._compile_steps(
+            program, block, tuple(sorted(feed_arrays)), fetch_names,
+            scope, steps, stacked=stacked, feed_arrays=feed_arrays,
+            device=device, aot=dcache is not None)
+        self.compile_count += 1
+        if dcache is not None and dcache.writable:
+            self._disk_store(
+                dcache, digest, compiled, kind="scan",
+                extra_meta={
+                    "write_only_specs": [
+                        (n, tuple(s.shape), str(s.dtype))
+                        for n, s in
+                        compiled.write_only_specs.items()],
+                    "steps": steps, "stacked": stacked})
+        return compiled
+
+    def _disk_store(self, dcache, digest, compiled, kind,
+                    extra_meta=None):
+        """Persist a freshly AOT-compiled executable + the binding
+        metadata a future process needs to rehydrate it untraced."""
+        aot = getattr(compiled, "_aot", None)
+        if aot is None:
+            return  # AOT lowering was unavailable (e.g. uninit state)
+        lowered, in_avals, out_shape = aot
+        meta = {"kind": kind,
+                "feed_names": list(compiled.feed_names),
+                "state_in": list(compiled.state_in),
+                "const_in": list(compiled.const_in),
+                "state_out": list(compiled.state_out),
+                "fetch_names": list(compiled.fetch_names),
+                "in_avals": in_avals}
+        if extra_meta:
+            meta.update(extra_meta)
+        dcache.store_executable(digest, compiled.fn, lowered,
+                                out_shape, meta)
+
+    def _try_aot(self, jitted, fn, example_args):
+        """Lower + compile ahead-of-time so the executable can be
+        serialized (jax.jit's lazy path never exposes the Compiled).
+        Returns (compiled_fn, (lowered, in_avals, out_shape)) or None
+        to fall back to plain jit — never raises."""
+        try:
+            in_avals = jax.tree.map(_as_aval, example_args)
+            lowered = jitted.lower(*in_avals)
+            compiled = lowered.compile()
+            out_shape = getattr(lowered, "out_info", None)
+            if out_shape is None:
+                out_shape = jax.eval_shape(fn, *in_avals)
+            return compiled, (lowered, in_avals, out_shape)
+        except Exception as e:
+            import warnings
+
+            warnings.warn(
+                f"compile_cache: AOT lowering failed "
+                f"({type(e).__name__}: {e}); this executable stays "
+                f"process-local")
+            return None
+
+    # ------------------------------------------------------------------
     def _compile_steps(self, program, block, feed_names, fetch_names,
-                       scope, steps, stacked, feed_arrays, device):
+                       scope, steps, stacked, feed_arrays, device,
+                       aot=False):
         """Lower the SAME _build_step_fn body run() compiles -- the
         step-key advance included -- into one jitted lax.scan over K
         steps with donated carry state."""
@@ -1099,13 +1446,24 @@ class Executor:
                              out_shardings=layouts[1])
         else:
             jitted = jax.jit(multi, donate_argnums=donate)
-        return _CompiledScan(jitted, feed_names, mutated, const,
+        fn = jitted
+        aot_art = None
+        if aot:
+            got = self._try_aot(
+                jitted, multi,
+                (carry_ex, const_ex, dict(feed_arrays), rng_ex))
+            if got is not None:
+                fn, aot_art = got
+        scan = _CompiledScan(fn, feed_names, mutated, const,
                              state_out, fetch_names, write_only_specs,
                              steps, stacked)
+        if aot_art is not None:
+            scan._aot = aot_art
+        return scan
 
     # ------------------------------------------------------------------
     def _compile(self, program, block, feed_names, fetch_names, scope,
-                 feed_arrays=None):
+                 feed_arrays=None, aot=False):
         # build the native program once; both analyses share it
         nprog = None
         if _native_usable(block):
@@ -1128,13 +1486,302 @@ class Executor:
                              out_shardings=layouts[1])
         else:
             jitted = jax.jit(step, donate_argnums=donate)
-        return _CompiledBlock(jitted, feed_names, mutated, const, state_out,
-                              fetch_names)
+        fn = jitted
+        aot_art = None
+        if aot:
+            mut_ex = {n: scope._get(n) for n in mutated}
+            const_ex = {n: scope._get(n) for n in const}
+            if not (any(v is None for v in mut_ex.values())
+                    or any(v is None for v in const_ex.values())):
+                # uninitialized state: skip AOT, run() raises the
+                # friendly init error on the plain path
+                rng_ex = scope._get(RNG_VAR)
+                if rng_ex is None:
+                    rng_ex = jax.random.PRNGKey(0)
+                got = self._try_aot(
+                    jitted, step,
+                    (mut_ex, const_ex, dict(feed_arrays or {}),
+                     rng_ex))
+                if got is not None:
+                    fn, aot_art = got
+        blk = _CompiledBlock(fn, feed_names, mutated, const, state_out,
+                             fetch_names)
+        if aot_art is not None:
+            blk._aot = aot_art
+        return blk
 
     # fluid parity helper: infer feed order from a program's data vars
     def _feed_data_names(self, program):
         return [v.name for v in program.global_block.vars.values()
                 if v.is_data]
+
+
+class PreparedProgram:
+    """Prepared-dispatch fast path (reference ExecutorPrepareContext:
+    Executor::Prepare builds the op list once, RunPreparedContext
+    replays it, framework/executor.cc:337,377).
+
+    Binds ONCE: the resolved executable (through the same in-memory /
+    on-disk caches as Executor.run, so a warmed bucket is shared), the
+    feed order + coercion dtypes, the scope-gather name lists, and the
+    device commitment. `run(feed)` then goes straight from feed dict
+    to executable call — no fetch parsing, no key hashing, no feed
+    validation, no block analysis.
+
+    Staleness guards stay cheap but present: every run() compares the
+    program `_version` (Pass.apply bumps it) and the AMP /
+    parallel-scope tokens against the bound snapshot and re-binds on
+    change — a prepared handle can never serve a stale executable.
+    Feed arrays must match the prepared (shape, dtype) specs exactly;
+    new shapes need a new prepare() (or Executor.run, which
+    re-specializes per call)."""
+
+    def __init__(self, exe: Executor, program: Program, scope: Scope,
+                 feed, fetch_list, steps: Optional[int] = None):
+        self.exe = exe
+        self.program = program
+        self.scope = scope
+        self.fetch_names = _to_fetch_names(fetch_list)
+        self._steps = int(steps) if steps is not None else None
+        if self._steps is not None and self._steps < 1:
+            raise ValueError(
+                f"prepare: steps must be >= 1, got {steps}")
+        if isinstance(feed, (list, tuple)):
+            # [(name, shape, dtype)] specs -> synthetic example arrays
+            feed = {name: np.zeros(tuple(shape), _dtype_from_str(dt))
+                    for name, shape, dt in feed}
+        self._feed_example = dict(feed or {})
+        self._bind_specs = None
+        self._bind()
+
+    @property
+    def fallback_reason(self) -> Optional[str]:
+        """Named reason the prepared scan runs per-step (None = the
+        K-step scan executable is bound)."""
+        return self._fallback_reason
+
+    def _snapshot_tokens(self):
+        from .. import amp
+
+        self._pversion = self.program._version
+        self._amp_tok = amp.state_token()
+        self._ptok = _parallel_scope_token()
+
+    def _bind(self):
+        exe, program, scope = self.exe, self.program, self.scope
+        block = program.global_block
+        if self._feed_example is None:
+            # a re-bind (version/AMP change): the original example
+            # arrays were dropped after the first bind (a prepared
+            # training batch can be large device memory); zeros at
+            # the recorded specs are shape/dtype-equivalent
+            self._feed_example = {
+                name: np.zeros(shape, _dtype_from_str(dt))
+                for name, shape, dt in self._bind_specs}
+        for name in self.fetch_names:
+            if not block.has_var(name) \
+                    and name not in self._feed_example:
+                raise KeyError(
+                    f"fetch target {name!r} does not exist in the "
+                    f"program")
+        self._fallback_reason = None
+        if self._steps is not None:
+            reason = _scan_fallback_reason(program)
+            if reason is not None:
+                self._fallback_reason = reason
+                exe._warn_scan_fallback(program, reason)
+                self._snapshot_tokens()
+                return
+        try:
+            device = exe.place.device()
+        except Exception:
+            device = None
+        if device is not None and jax.device_count() > 1:
+            device = None  # same multi-device caveat as run()
+        self._device = device
+
+        feed_arrays = {}
+        feed_specs = []
+        np_dtypes = {}
+        for name, val in self._feed_example.items():
+            dt = _var_np_dtype(block, name)
+            np_dtypes[name] = dt
+            arr = _coerce_feed(val, dt)
+            _check_feed_shape(block, name, arr)
+            if device is not None and not isinstance(arr, jax.Array):
+                arr = jax.device_put(arr, device)
+            feed_arrays[name] = arr
+            feed_specs.append((name, tuple(arr.shape),
+                               str(arr.dtype)))
+        # the same in-memory keys run()/run_steps() use (one shared
+        # builder per kind), so prepared handles, plain runs, and
+        # serving clones share executables
+        if self._steps is None:
+            key = exe._block_cache_key(program, feed_specs,
+                                       self.fetch_names)
+            compiled = exe._cache.get(key)
+            if compiled is None:
+                compiled = exe._resolve_block(
+                    program, block, tuple(sorted(feed_specs)),
+                    self.fetch_names, scope, feed_arrays)
+                exe._cache[key] = compiled
+            else:
+                exe.cache_hit_count += 1
+        else:
+            key = exe._scan_cache_key(program, feed_specs,
+                                      self.fetch_names, self._steps,
+                                      False)
+            compiled = exe._cache.get(key)
+            if compiled is None:
+                compiled = exe._resolve_scan(
+                    program, block, tuple(sorted(feed_specs)),
+                    self.fetch_names, scope, self._steps, False,
+                    feed_arrays, device)
+                exe._cache[key] = compiled
+            else:
+                exe.cache_hit_count += 1
+        self._compiled = compiled
+        self._np_dtypes = {n: np_dtypes.get(n, _var_np_dtype(block, n))
+                           for n in compiled.feed_names}
+        # spec check table: shapes strict, dtypes compared AFTER
+        # canonicalization so a numpy-int64 example and a jax-int32
+        # array at run time agree (jit canonicalizes both the same)
+        self._check_specs = {
+            name: (shape,
+                   str(jax.dtypes.canonicalize_dtype(
+                       _dtype_from_str(dt))))
+            for name, shape, dt in feed_specs}
+        self._bind_specs = feed_specs
+        self._feed_example = None  # large batches must not be pinned
+        # for the handle's lifetime; re-binds rebuild from specs
+        self._snapshot_tokens()
+
+    def run(self, feed=None, return_numpy: bool = True):
+        """The hot loop. Semantics match Executor.run (or run_steps
+        when prepared with steps=K) exactly, minus per-call shape
+        re-validation."""
+        exe = self.exe
+        from .. import amp
+        from ..flags import FLAGS
+
+        if (self.program._version != self._pversion
+                or amp.state_token() != self._amp_tok
+                or _parallel_scope_token() != self._ptok):
+            self._bind()  # Pass.apply / AMP toggle / scope change:
+            # re-resolve instead of serving a stale executable
+        else:
+            # observability parity with Executor.run: a prepared call
+            # served from the bound executable is a cache hit (the
+            # serving stats/tests count hits per request)
+            exe.cache_hit_count += 1
+        if self._fallback_reason is not None:
+            exe.last_run_steps_fallback = self._fallback_reason
+            return exe._run_steps_fallback(
+                self.program, dict(feed or {}), None,
+                list(self.fetch_names), self._steps, self.scope,
+                return_numpy, True)
+        if self._steps is not None:
+            exe.last_run_steps_fallback = None
+        c = self._compiled
+        scope, device = self.scope, self._device
+        feed = feed or {}
+        if set(feed) != set(c.feed_names):
+            unknown = sorted(set(feed) - set(c.feed_names))
+            missing = sorted(set(c.feed_names) - set(feed))
+            raise ValueError(
+                f"prepared program binds feeds "
+                f"{sorted(c.feed_names)}; got unknown={unknown} "
+                f"missing={missing}")
+        feed_arrays = {}
+        for name in c.feed_names:
+            arr = _coerce_feed(feed[name], self._np_dtypes[name])
+            want_shape, want_dt = self._check_specs[name]
+            got_dt = str(jax.dtypes.canonicalize_dtype(arr.dtype))
+            if tuple(arr.shape) != want_shape or got_dt != want_dt:
+                raise ValueError(
+                    f"prepared program was bound for feed {name!r} "
+                    f"spec {want_shape}/{want_dt} but got "
+                    f"{tuple(arr.shape)}/{got_dt}; prepare() again "
+                    f"for new shapes (or use Executor.run)")
+            if device is not None and not isinstance(arr, jax.Array):
+                arr = jax.device_put(arr, device)
+            feed_arrays[name] = arr
+
+        mut = exe._scope_state(scope, c.state_in, device)
+        const_st = exe._scope_state(scope, c.const_in, device)
+        rng = scope._get(RNG_VAR)
+        if rng is None:
+            prog_seed = getattr(self.program, "_seed", None)
+            rng = jax.random.PRNGKey(
+                prog_seed if prog_seed is not None
+                else _global_seed[0])
+        if isinstance(c, _CompiledScan):
+            for n, spec in c.write_only_specs.items():
+                mut[n] = jnp.zeros(spec.shape, spec.dtype)
+        new_state, out, rng_out = c.fn(mut, const_st, feed_arrays,
+                                       rng)
+        if FLAGS.check_nan_inf:
+            _check_nan_inf(new_state, out, c.fetch_names)
+        scope._set(RNG_VAR, rng_out)
+        for n, v in new_state.items():
+            scope._set(n, v)
+        if return_numpy:
+            return [np.asarray(v) for v in out]
+        return list(out)
+
+
+class PreparedCache:
+    """Feed-spec-keyed LRU of PreparedProgram handles — the shared
+    serving-hot-loop helper behind AnalysisPredictor._run_feed and
+    serving.ProgramRunner.run_batch (reference analogue: the
+    predictor holding one prepared ctx per input signature around
+    Executor::RunPreparedContext, executor.cc:337).
+
+    Capped so unbucketed many-shape traffic cannot pin one executable
+    per transient shape forever (the leak class
+    FLAGS_executor_cache_capacity closes, one layer up)."""
+
+    def __init__(self, executor: Executor, program, fetch_names,
+                 scope, capacity: int = 32):
+        self._exe = executor
+        self._program = program
+        self._fetch_names = list(fetch_names)
+        self._scope = scope
+        self._cap = int(capacity)
+        self._d: "collections.OrderedDict" = collections.OrderedDict()
+
+    def lookup(self, feed) -> Optional["PreparedProgram"]:
+        """The PreparedProgram for this feed's spec, binding it on
+        first sight, or None when the program takes the per-call
+        Executor.run path (go ops / CompiledProgram / native build —
+        checked up front so a per-REQUEST feed error raises exactly
+        like Executor.run's validation would). Normalizes non-array
+        feed values in place."""
+        if Executor.prepare_unsupported_reason(self._program) \
+                is not None:
+            return None
+        key = []
+        for n in sorted(feed):
+            v = feed[n]
+            if not hasattr(v, "shape") or callable(
+                    getattr(v, "shape", None)):
+                v = feed[n] = np.asarray(v)
+            key.append((n, tuple(v.shape), str(v.dtype)))
+        key = tuple(key)
+        prepared = self._d.get(key)
+        if prepared is not None:
+            self._d.move_to_end(key)  # LRU recency
+            return prepared
+        prepared = self._exe.prepare(
+            self._program, feed, fetch_list=self._fetch_names,
+            scope=self._scope)
+        self._d[key] = prepared
+        while len(self._d) > self._cap:
+            self._d.popitem(last=False)
+        return prepared
+
+    def __len__(self):
+        return len(self._d)
 
 
 def _to_fetch_names(fetch_list) -> List[str]:

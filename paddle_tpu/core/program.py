@@ -450,6 +450,32 @@ class Program:
                          if n in blk.vars}
         return p
 
+    def fingerprint(self) -> str:
+        """Process-STABLE content hash of the program structure (op
+        descs/attrs + var shapes/dtypes/persistability via to_dict) —
+        the disk compile-cache key component (core/compile_cache.py).
+        Reference counterpart: the serialized ProgramDesc proto bytes
+        (reference framework/program_desc.h:38 Proto(); python
+        framework.py:2932 Program.desc serialization) that identify
+        the reference's `__model__` artifact on disk.
+
+        Deliberately NOT the process-local `_uid` (a fresh process
+        re-building the identical program gets a new _uid but must hit
+        the on-disk executable). Op `_uid`s ARE included: they are
+        position-derived (identical builds agree) and they salt
+        sampling-op noise, so two programs differing only in op uids
+        compile to different executables. Cached per `_version`
+        (Pass.apply bumps it, invalidating the cached digest the same
+        way it invalidates in-memory executables)."""
+        cached = getattr(self, "_fingerprint_cache", None)
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
+        from .compile_cache import canonical_digest
+
+        digest = canonical_digest(self.to_dict())
+        self._fingerprint_cache = (self._version, digest)
+        return digest
+
     # --- serialization -----------------------------------------------------
     def _to_analysis_dict(self):
         """Minimal structural dict for the native dataflow analyzer:

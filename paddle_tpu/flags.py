@@ -48,6 +48,19 @@ def _as_static_check(s):
     raise ValueError(f"{s!r} is not one of off/warn/strict")
 
 
+def _as_cache_mode(s):
+    """FLAGS_compile_cache mode: off | ro | rw (bool spellings map
+    0->off, 1->rw for launch-script convenience)."""
+    v = str(s).strip().lower()
+    if v in ("off", "ro", "rw"):
+        return v
+    if v in ("0", "false", "no", ""):
+        return "off"
+    if v in ("1", "true", "yes", "on"):
+        return "rw"
+    raise ValueError(f"{s!r} is not one of off/ro/rw")
+
+
 def _as_bool(s):
     if isinstance(s, bool):
         return s
@@ -74,6 +87,17 @@ _DEFS = {
     # warnings.warn the diagnostics, strict = raise EnforceNotMet on
     # any error-severity diagnostic (PTA0xx codes)
     "static_check": (_as_static_check, "off", True),
+    # warm-start layer (core/compile_cache.py): persist serialized
+    # executables on disk so a fresh process serves every shape with
+    # zero in-process compiles. off = current behavior, ro = load
+    # existing entries but never write, rw = load + populate.
+    "compile_cache": (_as_cache_mode, "off", True),
+    "compile_cache_dir": (str, ".paddle_tpu_cache", True),
+    # bound on the Executor's in-memory executable cache (LRU;
+    # Pass.apply version bumps permanently strand the old entry, so
+    # long-lived serving processes leak one executable per program
+    # mutation without a cap). <= 0 = unbounded.
+    "executor_cache_capacity": (int, 64, True),
     "use_bf16": (_as_bool, False, True),
     "benchmark": (_as_bool, False, True),
     # cross-check the native (C++) block analyzer/GC-planner against the

@@ -46,7 +46,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..core.executor import Executor, TPUPlace
+from ..core.executor import Executor, PreparedCache, TPUPlace
 from ..core.scope import global_scope
 from ..core.types import to_np_dtype
 
@@ -148,13 +148,24 @@ class ProgramRunner:
         self.fetch_names = list(fetch_names)
         self.executor = executor or Executor(TPUPlace(0))
         self.scope = scope or global_scope()
+        # batcher hot loop: one PreparedProgram per bucket shape
+        # (core/executor.py PreparedCache; PERF.md "Host dispatch")
+        self._prepared = PreparedCache(self.executor, program,
+                                       self.fetch_names, self.scope)
 
     def run_batch(self, feed):
         import jax
 
-        outs = self.executor.run(self.program, feed=feed,
-                                 fetch_list=self.fetch_names,
-                                 scope=self.scope, return_numpy=False)
+        # None = program not preparable (go ops / CompiledProgram /
+        # native build): per-call Executor.run path
+        prepared = self._prepared.lookup(feed)
+        if prepared is not None:
+            outs = prepared.run(feed, return_numpy=False)
+        else:
+            outs = self.executor.run(self.program, feed=feed,
+                                     fetch_list=self.fetch_names,
+                                     scope=self.scope,
+                                     return_numpy=False)
         return [np.asarray(o) for o in jax.device_get(outs)]
 
 
@@ -467,8 +478,21 @@ class InferenceServer:
         Returns the number of fresh compiles it caused."""
         exe = self._runner.executor
         before = exe.compile_count
+        evict_before = exe.cache_evict_count
         for feed in self._warmup_feed_specs():
             self._runner.run_batch(feed)
+        if exe.cache_evict_count > evict_before:
+            import warnings
+
+            warnings.warn(
+                f"aot_warmup: the bucket ladder overflowed the "
+                f"executor's bounded executable cache "
+                f"({exe.cache_evict_count - evict_before} "
+                f"eviction(s)) — early buckets will recompile "
+                f"INSIDE the traffic window, the exact cost warmup "
+                f"exists to avoid. Raise "
+                f"FLAGS_executor_cache_capacity above the ladder "
+                f"size.")
         self._warmed_compiles = exe.compile_count - before
         return self._warmed_compiles
 
@@ -498,6 +522,11 @@ class InferenceServer:
                 "queue_depth": depth,
                 "compile_count": exe.compile_count,
                 "cache_hit_count": exe.cache_hit_count,
+                # warm-start observability: executables rehydrated
+                # from the on-disk compile cache (zero in-process
+                # compiles) and in-memory LRU evictions
+                "disk_load_count": exe.disk_load_count,
+                "cache_evict_count": exe.cache_evict_count,
                 "warmed_compiles": self._warmed_compiles,
                 "latency_ms": {"p50": pct(0.50), "p99": pct(0.99)},
             }

@@ -83,3 +83,24 @@ def _fresh_state():
     np.random.seed(90)
     fluid.seed(90)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_compile_cache(tmp_path):
+    """Tier-1 must never read or write a shared on-disk compile cache:
+    route FLAGS_compile_cache_dir to this test's tmp_path (and restore
+    the mode), so a developer's populated .paddle_tpu_cache — or a
+    leaked FLAGS_compile_cache=rw env var — cannot leak executables
+    into or out of the suite."""
+    from paddle_tpu.core import compile_cache as cc
+    from paddle_tpu.flags import FLAGS
+
+    saved_mode = FLAGS._values["compile_cache"]
+    saved_dir = FLAGS._values["compile_cache_dir"]
+    FLAGS._values["compile_cache"] = "off"
+    FLAGS._values["compile_cache_dir"] = str(tmp_path / "ptp_cache")
+    cc._CACHES.clear()
+    yield
+    FLAGS._values["compile_cache"] = saved_mode
+    FLAGS._values["compile_cache_dir"] = saved_dir
+    cc._CACHES.clear()

@@ -1,0 +1,449 @@
+"""Warm-start layer (core/compile_cache.py + Executor prepared
+dispatch): content-addressed disk cache round trips (zero in-process
+compiles in a warmed process — proven cross-process by subprocess),
+invalidation on program mutation and toolchain version change,
+corrupt-entry tolerance (named reason, never a crash), the StableHLO
+persistence fallback, the in-memory LRU executable-cache bound, and
+PreparedProgram parity/staleness guards."""
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import compile_cache as cc
+from paddle_tpu.core.executor import ExecutableCache
+from paddle_tpu.flags import FLAGS, set_flags
+
+FEED = {"x": np.ones((2, 4), np.float32)}
+
+
+def _fresh():
+    fluid._reset_global_scope()
+    from paddle_tpu import unique_name
+
+    unique_name.switch()
+    fluid.seed(90)
+
+
+def _build():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        out = fluid.layers.fc(h, size=3)
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def _enable(tmp_path, mode="rw"):
+    set_flags({"FLAGS_compile_cache": mode,
+               "FLAGS_compile_cache_dir": str(tmp_path / "cc")})
+
+
+def _train_pass(steps=None):
+    """One identical build+train pass: fresh scope/names/seed, run
+    startup, one train step (or a K-step scan). Returns (result,
+    executor, program)."""
+    _fresh()
+    prog, startup, loss = _build()
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    if steps is None:
+        out = exe.run(prog, feed=FEED, fetch_list=[loss])
+    else:
+        out = exe.run_steps(prog, feed=FEED, fetch_list=[loss],
+                            steps=steps)
+    return np.asarray(out[0]), exe, prog
+
+
+class TestFingerprint:
+    def test_identical_builds_agree_uid_does_not_matter(self):
+        _fresh()
+        p1, _, _ = _build()
+        _fresh()
+        p2, _, _ = _build()
+        assert p1._uid != p2._uid
+        assert p1.fingerprint() == p2.fingerprint()
+
+    def test_mutation_changes_fingerprint(self):
+        _fresh()
+        prog, _, loss = _build()
+        fp = prog.fingerprint()
+        prog.global_block.append_op(
+            "scale", {"X": [loss.name]}, {"Out": [loss.name]},
+            {"scale": 2.0})
+        assert prog.fingerprint() != fp
+
+    def test_clone_preserves_fingerprint(self):
+        # clone() keeps structure + op uids -> same executable content
+        _fresh()
+        prog, _, _ = _build()
+        assert prog.clone().fingerprint() == prog.fingerprint()
+
+
+class TestDiskRoundTrip:
+    def test_block_round_trip_zero_compiles(self, tmp_path):
+        _enable(tmp_path)
+        r1, exe1, _ = _train_pass()
+        assert exe1.compile_count > 0 and exe1.disk_load_count == 0
+        r2, exe2, _ = _train_pass()
+        assert exe2.compile_count == 0, \
+            f"warmed pass compiled {exe2.compile_count}x"
+        assert exe2.disk_load_count > 0
+        np.testing.assert_array_equal(r1, r2)  # bit-exact rehydration
+
+    def test_scan_round_trip_zero_compiles(self, tmp_path):
+        _enable(tmp_path)
+        r1, exe1, _ = _train_pass(steps=3)
+        assert exe1.last_run_steps_fallback is None
+        r2, exe2, _ = _train_pass(steps=3)
+        assert exe2.compile_count == 0
+        assert exe2.disk_load_count > 0
+        np.testing.assert_array_equal(r1, r2)
+
+    def test_ro_mode_never_writes(self, tmp_path):
+        _enable(tmp_path, mode="ro")
+        _, exe, _ = _train_pass()
+        assert exe.compile_count > 0
+        root = tmp_path / "cc"
+        files = [f for _, _, fs in os.walk(root) for f in fs] \
+            if root.exists() else []
+        assert files == [], f"ro cache wrote {files}"
+
+    def test_version_bump_is_a_miss_not_a_stale_hit(self, tmp_path):
+        _enable(tmp_path)
+        _train_pass()
+        _fresh()
+        prog, startup, loss = _build()
+        prog.global_block.append_op(
+            "scale", {"X": [loss.name]}, {"Out": [loss.name]},
+            {"scale": 10.0})
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        out = exe.run(prog, feed=FEED, fetch_list=[loss])
+        # the mutated program must compile fresh (startup itself may
+        # disk-hit; the train step may not)
+        assert exe.compile_count >= 1
+        base, exe_b, _ = _train_pass()
+        np.testing.assert_allclose(np.asarray(out[0]), base * 10.0,
+                                   rtol=1e-5)
+
+    def test_spoofed_toolchain_version_is_a_miss(self, tmp_path,
+                                                 monkeypatch):
+        _enable(tmp_path)
+        _train_pass()
+        real = cc.version_token()
+        monkeypatch.setattr(
+            cc, "version_token",
+            lambda: dict(real, jaxlib="99.99.99-spoofed"))
+        _, exe, _ = _train_pass()
+        assert exe.disk_load_count == 0  # no cross-version hit
+        assert exe.compile_count > 0
+
+    def test_framework_source_change_is_a_miss(self, tmp_path,
+                                               monkeypatch):
+        """The program fingerprint hashes op DESCS, not KERNELS — an
+        ops/ numerics fix must invalidate persisted executables via
+        the source token, never serve the old math."""
+        _enable(tmp_path)
+        _train_pass()
+        monkeypatch.setattr(cc, "_SOURCE_TOKEN",
+                            ["simulated-kernel-edit"])
+        _, exe, _ = _train_pass()
+        assert exe.disk_load_count == 0
+        assert exe.compile_count > 0
+
+    def test_corrupt_entry_recompiles_with_named_reason(self,
+                                                        tmp_path):
+        _enable(tmp_path)
+        r1, _, _ = _train_pass()
+        n_truncated = 0
+        for dirpath, _, files in os.walk(tmp_path / "cc"):
+            for f in files:
+                p = os.path.join(dirpath, f)
+                with open(p, "r+b") as fh:
+                    fh.truncate(8)
+                n_truncated += 1
+        assert n_truncated > 0
+        cc._CACHES.clear()  # fresh counters for the assertion
+        with pytest.warns(UserWarning, match="discarding entry"):
+            r2, exe, _ = _train_pass()
+        assert exe.compile_count > 0  # recompiled, did not crash
+        cache = cc.active_cache()
+        assert cache.discards, "no named discard reason recorded"
+        assert any("corrupt" in reason or "format" in reason
+                   for _, reason in cache.discards)
+        np.testing.assert_array_equal(r1, r2)
+
+    def test_host_effect_programs_never_enter_the_disk_cache(
+            self, tmp_path):
+        """io_callback closures are process-local pointers: a
+        persisted executable carrying one would crash a fresh
+        process. Host-bridging programs must stay process-local —
+        nothing stored, nothing loaded."""
+        _enable(tmp_path)
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.scale(x, scale=2.0)
+            sink = prog.current_block().create_var(
+                name="he_sink", shape=[-1, 4], dtype="float32")
+            fluid.layers.py_func(lambda a: np.asarray(a), y,
+                                 out=sink)
+            loss = fluid.layers.mean(y)
+        exe = fluid.Executor(fluid.TPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        exe.run(prog, feed=FEED, fetch_list=[loss], scope=sc)
+        # startup (pure) may persist; the py_func program must not —
+        # a fresh identical build must recompile it, never disk-load
+        _fresh()
+        prog2, startup2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog2, startup2):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.scale(x, scale=2.0)
+            sink = prog2.current_block().create_var(
+                name="he_sink", shape=[-1, 4], dtype="float32")
+            fluid.layers.py_func(lambda a: np.asarray(a), y,
+                                 out=sink)
+            loss2 = fluid.layers.mean(y)
+        exe2 = fluid.Executor(fluid.TPUPlace())
+        sc2 = fluid.Scope()
+        exe2.run(startup2, scope=sc2)
+        disk_before = exe2.disk_load_count
+        out = exe2.run(prog2, feed=FEED, fetch_list=[loss2],
+                       scope=sc2)
+        assert exe2.disk_load_count == disk_before  # no host-op load
+        assert exe2.compile_count >= 1
+        np.testing.assert_allclose(np.asarray(out[0]).reshape(-1),
+                                   [2.0], rtol=1e-6)
+
+    def test_stablehlo_fallback_round_trip(self, tmp_path):
+        """serialize_executable unavailable -> entries persist lowered
+        StableHLO; loads skip tracing and redo only the backend
+        compile."""
+        cc._FORCE_STABLEHLO[0] = True
+        try:
+            _enable(tmp_path)
+            r1, exe1, _ = _train_pass()
+            assert exe1.compile_count > 0
+            entries = []
+            for dirpath, _, files in os.walk(tmp_path / "cc"):
+                for f in files:
+                    with open(os.path.join(dirpath, f), "rb") as fh:
+                        entries.append(pickle.load(fh))
+            assert entries and all(
+                e["format"] == "stablehlo" for e in entries)
+            r2, exe2, _ = _train_pass()
+            assert exe2.compile_count == 0
+            assert exe2.disk_load_count > 0
+            np.testing.assert_allclose(r1, r2, rtol=1e-6)
+        finally:
+            cc._FORCE_STABLEHLO[0] = False
+
+
+class TestExecutableCacheLRU:
+    def test_capacity_bound_and_eviction_counter(self):
+        _fresh()
+        prog, startup, loss = _build()
+        exe = fluid.Executor(fluid.TPUPlace(),
+                             cache=ExecutableCache(capacity=2))
+        exe.run(startup)
+        for b in (1, 2, 3):  # three feed-shape specializations
+            exe.run(prog, feed={"x": np.ones((b, 4), np.float32)},
+                    fetch_list=[loss])
+        assert len(exe._cache) <= 2
+        assert exe.cache_evict_count >= 1
+
+    def test_version_bump_stranded_entries_get_evicted(self):
+        """Pass.apply-style mutations strand the old executable under
+        an unreachable key; the LRU cap reclaims it instead of
+        leaking one executable per mutation forever."""
+        _fresh()
+        prog, startup, loss = _build()
+        exe = fluid.Executor(fluid.TPUPlace(),
+                             cache=ExecutableCache(capacity=2))
+        exe.run(startup)
+        for i in range(4):
+            exe.run(prog, feed=FEED, fetch_list=[loss])
+            prog.global_block.append_op(
+                "scale", {"X": [loss.name]}, {"Out": [loss.name]},
+                {"scale": 1.0})  # bump _version, strand the entry
+        assert len(exe._cache) <= 2
+        assert exe.cache_evict_count >= 2
+
+    def test_default_capacity_comes_from_flag(self):
+        assert ExecutableCache().capacity == \
+            FLAGS.executor_cache_capacity
+
+    def test_lru_recency_order(self):
+        c = ExecutableCache(capacity=2)
+        c["a"], c["b"] = 1, 2
+        assert c.get("a") == 1  # refresh a
+        c["c"] = 3              # evicts b, not a
+        assert "a" in c and "b" not in c and "c" in c
+        assert c.evict_count == 1
+
+
+class TestPreparedProgram:
+    def test_parity_with_run(self):
+        r1, _, _ = _train_pass()
+        _fresh()
+        prog, startup, loss = _build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        prep = exe.prepare(prog, FEED, fetch_list=[loss])
+        out = prep.run(FEED)
+        np.testing.assert_array_equal(np.asarray(out[0]), r1)
+
+    def test_prepare_from_specs(self):
+        _fresh()
+        prog, startup, loss = _build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        prep = exe.prepare(prog, [("x", (2, 4), "float32")],
+                           fetch_list=[loss])
+        out = prep.run(FEED)
+        assert np.isfinite(np.asarray(out[0])).all()
+
+    def test_rebind_on_program_mutation(self):
+        """A Pass.apply-style version bump between prepared calls must
+        re-resolve, never serve the stale executable."""
+        _fresh()
+        prog, startup, loss = _build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        prep = exe.prepare(prog, FEED, fetch_list=[loss])
+        prep.run(FEED)
+        compiles = exe.compile_count
+        prog.global_block.append_op(
+            "scale", {"X": [loss.name]}, {"Out": [loss.name]},
+            {"scale": 10.0})
+        out2 = prep.run(FEED)
+        assert exe.compile_count > compiles  # re-resolved
+        # the x10 rewrite is visible through the prepared handle
+        np.testing.assert_allclose(np.asarray(out2[0]) / 10.0,
+                                   _replay_second_step(), rtol=1e-5)
+
+    def test_feed_spec_mismatch_is_a_named_error(self):
+        _fresh()
+        prog, startup, loss = _build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        prep = exe.prepare(prog, FEED, fetch_list=[loss])
+        with pytest.raises(ValueError, match="bound for feed"):
+            prep.run({"x": np.ones((5, 4), np.float32)})
+        with pytest.raises(ValueError, match="missing"):
+            prep.run({})
+        # same count, wrong NAME: named error, not a raw KeyError
+        with pytest.raises(ValueError, match="unknown=\\['y'\\]"):
+            prep.run({"y": np.ones((2, 4), np.float32)})
+
+    def test_prepared_scan_parity_with_run_steps(self):
+        r1, _, _ = _train_pass(steps=3)
+        _fresh()
+        prog, startup, loss = _build()
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        prep = exe.prepare(prog, FEED, fetch_list=[loss], steps=3)
+        assert prep.fallback_reason is None
+        out = prep.run(FEED)
+        np.testing.assert_array_equal(np.asarray(out[0]), r1)
+
+    def test_prepared_scan_fallback_named_reason(self):
+        """Host-bridging ops cannot scan; the prepared handle keeps
+        the run_steps contract (stacked fetches + named reason)."""
+        _fresh()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.scale(x, scale=2.0)
+            sink = prog.current_block().create_var(
+                name="pp_sink", shape=[-1, 4], dtype="float32")
+            fluid.layers.py_func(lambda a: np.asarray(a), y, out=sink)
+            loss = fluid.layers.mean(y)
+        exe = fluid.Executor(fluid.TPUPlace())
+        sc = fluid.Scope()
+        exe.run(startup, scope=sc)
+        prep = exe.prepare(prog, FEED, fetch_list=[loss], steps=3,
+                           scope=sc)
+        assert prep.fallback_reason is not None
+        assert "host" in prep.fallback_reason
+        out = prep.run(FEED)
+        np.testing.assert_allclose(np.asarray(out[0]).reshape(-1),
+                                   [2.0] * 3, rtol=1e-6)
+
+
+def _replay_second_step():
+    """Two sequential train steps on a fresh identical build; returns
+    the second step's loss (what a mutated x-10 fetch is compared
+    against in test_rebind_on_program_mutation)."""
+    _fresh()
+    prog, startup, loss = _build()
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    exe.run(prog, feed=FEED, fetch_list=[loss])
+    out = exe.run(prog, feed=FEED, fetch_list=[loss])
+    return np.asarray(out[0])
+
+
+_SUBPROCESS_SCRIPT = r"""
+import json
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.inference.serving import InferenceServer, ProgramRunner
+
+fluid.seed(7)
+prog, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(prog, startup):
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    h = fluid.layers.fc(x, size=8, act="relu")
+    out = fluid.layers.fc(h, size=3)
+exe = fluid.Executor(fluid.TPUPlace())
+exe.run(startup)
+runner = ProgramRunner(prog, ["x"], [out.name], executor=exe,
+                       scope=fluid.global_scope())
+with InferenceServer(runner, max_batch_size=4, max_wait_ms=1.0) as srv:
+    srv.aot_warmup()
+    res = srv.infer({"x": np.ones((1, 6), np.float32)})
+    st = srv.stats()
+print(json.dumps({"compile_count": st["compile_count"],
+                  "disk_load_count": st["disk_load_count"],
+                  "out": np.asarray(res[0]).tolist()}))
+"""
+
+
+class TestSubprocessRoundTrip:
+    def test_disk_warmed_fresh_process_serves_with_zero_compiles(
+            self, tmp_path):
+        """The acceptance proof: process A populates the cache;
+        process B — a genuinely fresh python process — AOT-warms the
+        whole bucket ladder and serves with compile_count == 0."""
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   FLAGS_compile_cache="rw",
+                   FLAGS_compile_cache_dir=str(tmp_path / "cc"))
+
+        def run_once(tag):
+            proc = subprocess.run(
+                [sys.executable, "-c", _SUBPROCESS_SCRIPT],
+                capture_output=True, text=True, env=env, timeout=300)
+            assert proc.returncode == 0, \
+                f"{tag} failed:\n{proc.stderr[-2000:]}"
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        a = run_once("process A (cold)")
+        assert a["compile_count"] > 0
+        b = run_once("process B (disk-warmed)")
+        assert b["compile_count"] == 0, \
+            f"warmed process compiled: {b}"
+        assert b["disk_load_count"] > 0
+        # identical serving results across the process boundary
+        np.testing.assert_allclose(a["out"], b["out"], rtol=1e-6)
