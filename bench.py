@@ -770,6 +770,186 @@ def bench_coldstart(n_requests=400):
     }
 
 
+def bench_generation(n_requests=96):
+    """Generation serving on a mixed-length (Zipf-ish) workload:
+    static whole-loop GenerationServer vs ContinuousGenerationServer
+    (slot pool + fused admission/decode-burst cycles). The static
+    server pays head-of-line blocking — every batch runs to its
+    LONGEST member's length — while the slot pool retires EOS'd lanes
+    immediately and refills from the queue, so its advantage scales
+    with the workload's length variance (PERF.md "Continuous
+    batching").
+
+    CPU-PINNED by design (same reasoning as bench_coldstart): the
+    scheduler-vs-executable arithmetic is honestly CPU-measurable,
+    and per-cycle dispatches through the tunneled chip would measure
+    the ~75 ms tunnel readback, not the serving design. Best-of-3 per
+    leg: this 2-core host swings single-pass walls ~3x. Fail-fast
+    (exit 3) on a dead backend is inherited from main()'s
+    _probe_backend."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.inference import (ContinuousGenerationServer,
+                                      GenerationServer,
+                                      apply_eos_sentinel,
+                                      count_generated_tokens)
+    from paddle_tpu.models import transformer as T
+
+    V, D, L, S, maxT = 16, 128, 2, 12, 64
+    n_slots = 8
+    end_id = 1
+    rng = np.random.RandomState(7)
+
+    def zipf_prompts(n, r):
+        # terminator-copy prompts: EOS planted early for most rows
+        # (short generations), none for a ~1-in-8 tail (full-buffer
+        # runs) — the Zipf-ish mix where almost every static batch is
+        # poisoned by one long member while most of its rows idle
+        src = r.randint(3, V, (n, S)).astype(np.int64)
+        for i in range(n):
+            p = int(r.choice([1, 2, 3, S], p=[.45, .25, .175, .125]))
+            if p < S:
+                src[i, p:] = end_id
+        return src
+
+    # train the terminator-copy task so decode lengths are
+    # model-driven (EOS mid-stream), then build both serving paths
+    # over the same weights
+    scope = Scope()
+    with unique_name.guard():
+        main_p, startup, loss = T.build_program(
+            seq_len=S, d_model=D, n_heads=2, n_layers=L, d_inner=128,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+        with fluid.program_guard(main_p, startup):
+            fluid.optimizer.Adam(learning_rate=0.002).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    for _ in range(600):
+        src = zipf_prompts(8, rng)
+        tgt_in = np.concatenate(
+            [np.full((8, 1), 2, np.int64), src[:, :-1]], 1)
+        exe.run(main_p, feed={"src_ids": src, "tgt_ids": tgt_in,
+                              "label": src}, fetch_list=[loss],
+                scope=scope)
+    kwargs = dict(seq_len=S, max_out_len=maxT, d_model=D, n_heads=2,
+                  n_layers=L, d_inner=128, vocab=V, start_id=2,
+                  end_id=end_id)
+    with unique_name.guard():
+        inc_m, _, _, inc_buf = T.build_incremental_decode_program(
+            **kwargs)
+    with unique_name.guard():
+        bundle = T.build_decode_step_program(n_slots=n_slots,
+                                             **kwargs)
+
+    srcs = zipf_prompts(n_requests, np.random.RandomState(31))
+    ref, = exe.run(inc_m, feed={"src_ids": srcs},
+                   fetch_list=[inc_buf], scope=scope)
+    want = apply_eos_sentinel(np.asarray(ref), end_id)
+    lens = count_generated_tokens(want, end_id)
+    total_tokens = int(lens.sum())
+    short = lens <= int(np.median(lens))
+
+    def run_leg(make_server, submit):
+        srv = make_server()
+        try:
+            done_at = [None] * n_requests
+            t0 = time.perf_counter()
+            replies = [submit(srv, s) for s in srcs]
+            for i, rep in enumerate(replies):
+                rep.add_done_callback(
+                    lambda _f, i=i: done_at.__setitem__(
+                        i, time.perf_counter()))
+            outs = [rep.result(600.0) for rep in replies]
+            wall = time.perf_counter() - t0
+            # done-callbacks fire on the server thread AFTER result()
+            # unblocks; wait for the stragglers before reading
+            deadline = time.perf_counter() + 5.0
+            while any(d is None for d in done_at) \
+                    and time.perf_counter() < deadline:
+                time.sleep(0.001)
+            st = srv.stats()
+        finally:
+            srv.close()
+        comp_ms = np.array([((d if d is not None else t0 + wall)
+                             - t0) * 1e3 for d in done_at])
+        return {"wall_s": wall, "tok_s": total_tokens / wall,
+                "short_p50_ms": float(np.median(comp_ms[short])),
+                "stats": st, "outs": outs}
+
+    def static_leg():
+        return run_leg(
+            lambda: GenerationServer(
+                inc_m, inc_buf, executor=exe, scope=scope,
+                end_id=end_id, max_batch_size=n_slots,
+                max_wait_ms=2.0),
+            lambda srv, s: srv.submit({"src_ids": s[None]}))
+
+    def continuous_leg():
+        return run_leg(
+            lambda: ContinuousGenerationServer(
+                bundle, executor=exe, scope=scope, steps_per_tick=8),
+            lambda srv, s: srv.submit(s))
+
+    static_leg()       # warm the static bucket executables
+    compiles_before = exe.compile_count
+    legs = [continuous_leg()]  # warms the serve executables
+    # INTERLEAVED best-of-3: this host's CPU-share throttle windows
+    # last seconds, so alternating legs samples both servers under
+    # the same conditions — a sequential best-of-3 can land one whole
+    # server inside a slow window and report a 2x-off ratio. The two
+    # warm legs above are excluded from the mins so BOTH sides are a
+    # best-of-3 over the same interleaved windows (no sample-count
+    # asymmetry flattering either ratio).
+    statics = []
+    for _ in range(3):
+        statics.append(static_leg())
+        legs.append(continuous_leg())
+    sbest = min(statics, key=lambda r: r["wall_s"])
+    cbest = min(legs[1:], key=lambda r: r["wall_s"])
+    # warmup happens in the first server __init__; later legs and all
+    # steady-state traffic must compile NOTHING
+    steady_compiles = exe.compile_count - compiles_before \
+        - legs[0]["stats"]["warmed_compiles"]
+    # token-exact parity of the measured leg (sentinel rows vs the
+    # whole-loop oracle) — a fast continuous leg that decoded wrong
+    # tokens would be meaningless
+    parity = all(
+        np.array_equal(np.asarray(o), want[i])
+        for leg in legs for i, o in enumerate(leg["outs"]))
+    cst = cbest["stats"]
+    return {
+        "metric": "generation_tokens_per_sec_mixed_len",
+        "value": round(cbest["tok_s"], 1),
+        "unit": "tokens/sec",
+        "static_tok_s": round(sbest["tok_s"], 1),
+        "continuous_tok_s": round(cbest["tok_s"], 1),
+        "speedup_continuous": round(cbest["tok_s"] / sbest["tok_s"],
+                                    2),
+        "short_req_p50_ms": {
+            "static": round(sbest["short_p50_ms"], 1),
+            "continuous": round(cbest["short_p50_ms"], 1)},
+        "token_parity_vs_whole_loop": parity,
+        "steady_state_compiles": int(steady_compiles),
+        "slot_occupancy": cst["slot_occupancy"],
+        "ttft_p50_ms": cst["ttft_ms"]["p50"],
+        "retired_per_s": cst["retired_per_s"],
+        "serve_executables": len(bundle.serves),
+        "n_requests": n_requests,
+        "total_tokens": total_tokens,
+        "len_histogram": {int(k): int(v) for k, v in
+                          zip(*np.unique(lens, return_counts=True))},
+        "workload": "zipf-ish terminator-copy",
+        "model": (f"transformer d{D} L{L} S{S} maxT{maxT} "
+                  f"slots{n_slots}"),
+        "best_of": 3,
+    }
+
+
 # opt-in configs (argv-selectable only; never in the driver's default
 # window)
 EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
@@ -777,7 +957,8 @@ EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
                  "transformer_fused": bench_transformer_fused,
                  "transformer_scan_fused": bench_transformer_scan_fused,
                  "serving": bench_serving,
-                 "coldstart": bench_coldstart}
+                 "coldstart": bench_coldstart,
+                 "generation": bench_generation}
 
 
 def _probe_backend(timeout_s=180):

@@ -23,6 +23,8 @@ a stable diagnostic code so tests/docs can reference the class:
   PTA060  @SEQ_LEN companion mismatch   (static-batch probe trap)
   PTA070  host_effect flag missing      (run_steps scan correctness)
   PTA080  unregistered op type
+  PTA090  write-only persistable not carry-declarable (r6 scan-carry
+          trap: run_steps/prepare(steps=K) seed it with zeros)
 
 Severities: "error" = the program is wrong (strict mode raises),
 "warning" = almost certainly a bug but a legal feed/scope could save
@@ -759,6 +761,64 @@ def check_program_host_effects(program: Program):
     used = {site.op.type for site in iter_ops(program)}
     for d in check_registry(sorted(used)):
         yield d
+
+
+# ---------------------------------------------------------------------------
+# PTA090: write-only persistables must be carry-declarable.
+# ---------------------------------------------------------------------------
+@register_checker("PTA090", "write-only-carry")
+def check_write_only_carry(program: Program):
+    """A persistable var a step program WRITES but never READS (KV
+    slots / counters / stats written for the next consumer) does not
+    flow through the executor's state-in path: Executor.run_steps and
+    PreparedProgram(steps=K) must seed it into the lax.scan carry with
+    zeros or the carry structure changes between iterations — the r6
+    write-only-carry trap. That zeros slot is declared from the var's
+    metadata, so the var must be CARRY-DECLARABLE: a known dtype and a
+    concrete shape (no -1 / missing dims). A write-only persistable
+    that is not breaks the K-step scan (and its disk-cached
+    rehydration) with an opaque tree-structure error deep in jax;
+    error severity because the program is one run_steps call away
+    from it.
+
+    Reads anywhere count — including inside While/cond sub-blocks,
+    whose parent-visible reads surface as the container op's input
+    slots — so ordinary read-modify-write state (params, optimizer
+    moments, counters) never trips this."""
+    read = set()
+    for site in iter_ops(program):
+        read.update(site.op.input_arg_names)
+    blk = program.global_block
+    df = analyze_block(blk)
+    flagged = set()
+    for name in df.writers:
+        if name in read or name in flagged or name == EMPTY_VAR:
+            continue
+        var = blk._find_var_recursive(name)
+        if var is None or not var.persistable:
+            continue
+        problems = []
+        if var.dtype is None:
+            problems.append("no dtype")
+        if var.shape is None:
+            problems.append("no declared shape")
+        elif any(d is None or d < 0 for d in var.shape):
+            problems.append(f"non-concrete shape {tuple(var.shape)}")
+        if not problems:
+            continue
+        flagged.add(name)
+        first = df.first_write[name]
+        op = blk.ops[first]
+        yield Diagnostic(
+            "PTA090", ERROR,
+            f"persistable {name!r} is write-only in this program but "
+            f"not carry-declarable ({'; '.join(problems)}): "
+            f"Executor.run_steps / prepare(steps=K) must seed its "
+            f"scan-carry slot with zeros of the declared shape/dtype",
+            block_idx=blk.idx, op_idx=first, op_type=op.type, var=name,
+            hint="declare it with a concrete shape and dtype "
+                 "(models/transformer._declare_slot_state does), or "
+                 "read-modify-write it so it rides state_in")
 
 
 # ---------------------------------------------------------------------------

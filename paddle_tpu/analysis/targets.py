@@ -92,10 +92,19 @@ def _transformer():
     greedy = tr.build_greedy_decode_program(**dkw)
     incr = tr.build_incremental_decode_program(**dkw)
     beam = tr.build_beam_decode_program(**dkw)
+    bundle = tr.build_decode_step_program(n_slots=4, **dkw)
+    big = max(bundle.prefills)
     return ({"main": main, "startup": startup, "greedy": greedy[0],
-             "incremental": incr[0], "beam": beam[0]},
+             "incremental": incr[0], "beam": beam[0],
+             "cb_prefill": bundle.prefill,
+             f"cb_prefill{big}": bundle.prefills[big],
+             "cb_step": bundle.step,
+             "cb_serve0": bundle.serves[0],
+             f"cb_serve{big}": bundle.serves[big]},
             [("main", "greedy"), ("main", "incremental"),
-             ("main", "beam")])
+             ("main", "beam"), ("main", "cb_prefill"),
+             ("main", f"cb_prefill{big}"), ("main", "cb_step"),
+             ("main", "cb_serve0"), ("main", f"cb_serve{big}")])
 
 
 def _moe_transformer():

@@ -18,8 +18,9 @@ from .export import (StableHLOServer, StableHLOTrainer,
                      load_stablehlo, load_train_stablehlo)
 from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
-from .serving import (GenerationServer, InferenceServer,
-                      apply_eos_sentinel, default_batch_buckets)
+from .serving import (ContinuousGenerationServer, GenerationServer,
+                      InferenceServer, apply_eos_sentinel,
+                      count_generated_tokens, default_batch_buckets)
 
 __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "AnalysisPredictor", "PaddlePredictor", "PaddleTensor",
@@ -27,5 +28,6 @@ __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "StableHLOServer", "export_stablehlo", "load_stablehlo",
            "StableHLOTrainer", "export_train_stablehlo",
            "load_train_stablehlo", "InferenceServer",
-           "GenerationServer", "apply_eos_sentinel",
+           "GenerationServer", "ContinuousGenerationServer",
+           "apply_eos_sentinel", "count_generated_tokens",
            "default_batch_buckets"]

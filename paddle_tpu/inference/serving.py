@@ -29,11 +29,19 @@ micro-batch — plus the Clipper/ORT-style dynamic-batching discipline
   KV-cached While-loop decode program
   (models/transformer.py:373 build_incremental_decode_program), so a
   T-token generation is ONE dispatch + ONE readback instead of T.
+* **ContinuousGenerationServer** — iteration-level scheduling over a
+  fixed slot pool (Orca OSDI'22 / vLLM SOSP'23, PAPERS.md): a
+  single-step decode program advances every occupied slot one token
+  per dispatch, queued prompts are admitted into free slots by a
+  prefill dispatch, and EOS'd lanes retire IMMEDIATELY — no
+  head-of-line blocking on the longest request in a batch, which is
+  the whole-loop server's structural cost under mixed output lengths.
 
 Observability: `stats()` returns queue depth, batch occupancy, compile
-and cache-hit counts (Executor.compile_count / cache_hit_count) and
-p50/p99 request latency — serving perf work is unverifiable without
-them.
+and cache-hit counts (Executor.compile_count / cache_hit_count),
+p50/p99 request latency, time-to-first-token and per-generated-token
+latency; the generation servers add slot occupancy and retired
+requests/s — serving perf work is unverifiable without them.
 """
 from __future__ import annotations
 
@@ -108,6 +116,21 @@ def _pad_axis(arr: np.ndarray, axis: int, size: int) -> np.ndarray:
 # stdlib Future already provides done()/result(timeout)/set_result/
 # set_exception with the right rethrow semantics
 _Reply = futures.Future
+
+
+def _pct(sorted_vals, p):
+    """Nearest-rank percentile over an ascending list (ceil(p*N)-1:
+    int(p*N) overshoots — p50 of 2 samples must be the 1st, not the
+    2nd). None on empty."""
+    if not sorted_vals:
+        return None
+    idx = max(0, math.ceil(p * len(sorted_vals)) - 1)
+    return round(sorted_vals[min(len(sorted_vals) - 1, idx)], 3)
+
+
+def _pct_dict(vals):
+    lat = sorted(vals)
+    return {"p50": _pct(lat, 0.50), "p99": _pct(lat, 0.99)}
 
 
 class _Request:
@@ -245,7 +268,18 @@ class InferenceServer:
         self._n_batches = 0
         self._n_rows = 0
         self._n_padded_rows = 0
+        self._n_done = 0
+        self._n_tokens = 0
         self._latencies = collections.deque(maxlen=4096)
+        # time-to-first-token: for one-shot inference (and the
+        # whole-loop generation server) the first token and the last
+        # arrive in the same readback, so TTFT == request latency —
+        # recorded separately anyway so the continuous server's
+        # stats() shape is identical and legs are comparable
+        self._ttft = collections.deque(maxlen=4096)
+        self._per_token = collections.deque(maxlen=4096)
+        self._t_first_arrival = None
+        self._t_last_done = None
         self._warmed_compiles = 0
 
         if start:
@@ -309,6 +343,8 @@ class InferenceServer:
             self._groups.setdefault(key, collections.deque()).append(
                 req)
             self._n_requests += 1
+            if self._t_first_arrival is None:
+                self._t_first_arrival = req.t_arrival
             self._cv.notify_all()
         return reply
 
@@ -412,14 +448,31 @@ class InferenceServer:
             self._n_batches += 1
             self._n_rows += rows
             self._n_padded_rows += bucket
+            off = 0
             for r in batch:
-                self._latencies.append(
-                    (done_t - r.t_arrival) * 1e3)
+                lat = (done_t - r.t_arrival) * 1e3
+                self._latencies.append(lat)
+                self._ttft.append(lat)
+                ntok = self._tokens_in_rows(
+                    np.asarray(outs[0])[off:off + r.rows])
+                if ntok:
+                    self._n_tokens += ntok
+                    self._per_token.append(lat / ntok)
+                self._n_done += 1
+                off += r.rows
+            self._t_last_done = done_t
         off = 0
         for r in batch:
             r.reply.set_result([np.asarray(o)[off:off + r.rows]
                                 for o in outs])
             off += r.rows
+
+    def _tokens_in_rows(self, rows) -> Optional[int]:
+        """Generated-token count for the primary output rows of one
+        request, or None when the served program is not generative
+        (plain inference: per-token latency is meaningless).
+        GenerationServer overrides with the EOS-aware count."""
+        return None
 
     # --- AOT warmup ---------------------------------------------------
     def _warmup_feed_specs(self):
@@ -500,21 +553,16 @@ class InferenceServer:
     def stats(self) -> dict:
         exe = self._runner.executor
         with self._cv:
-            lat = sorted(self._latencies)
             depth = sum(len(g) for g in self._groups.values())
-
-            def pct(p):
-                if not lat:
-                    return None
-                # nearest-rank: ceil(p*N)-1 (int(p*N) overshoots --
-                # p50 of 2 samples must be the 1st, not the 2nd)
-                idx = max(0, math.ceil(p * len(lat)) - 1)
-                return round(lat[min(len(lat) - 1, idx)], 3)
-
             occ = (self._n_rows / self._n_padded_rows
                    if self._n_padded_rows else None)
+            done_span = (
+                self._t_last_done - self._t_first_arrival
+                if self._t_last_done is not None
+                and self._t_first_arrival is not None else None)
             return {
                 "requests": self._n_requests,
+                "completed": self._n_done,
                 "batches": self._n_batches,
                 "rows": self._n_rows,
                 "padded_rows": self._n_padded_rows,
@@ -528,7 +576,13 @@ class InferenceServer:
                 "disk_load_count": exe.disk_load_count,
                 "cache_evict_count": exe.cache_evict_count,
                 "warmed_compiles": self._warmed_compiles,
-                "latency_ms": {"p50": pct(0.50), "p99": pct(0.99)},
+                "latency_ms": _pct_dict(self._latencies),
+                "ttft_ms": _pct_dict(self._ttft),
+                "per_token_ms": _pct_dict(self._per_token),
+                "tokens": self._n_tokens,
+                "retired_per_s": (
+                    round(self._n_done / done_span, 1)
+                    if done_span else None),
             }
 
 
@@ -549,6 +603,14 @@ class GenerationServer(InferenceServer):
     emitted end_id are rewritten to the fixed-size -1 sentinel (the
     detection-op padded-output convention), so callers can split
     variable-length results out of the static [maxT] buffer.
+
+    PASS end_id whenever the program has one: the decode loop's
+    all-rows-finished early exit stops writing once every CO-BATCHED
+    row has finished, so without sentinel normalization the raw tail
+    past a row's EOS (frozen end_id up to the batch-wide exit step,
+    zero init after) depends on which requests the batcher happened
+    to coalesce — end_id=None returns that raw, co-tenant-dependent
+    tail verbatim.
     """
 
     def __init__(self, program, out_var, feed_name: str = "src_ids",
@@ -569,6 +631,351 @@ class GenerationServer(InferenceServer):
                           timeout=timeout)[0]
         toks = apply_eos_sentinel(toks, self._end_id)
         return toks[0] if one_row else toks
+
+    def _tokens_in_rows(self, rows) -> Optional[int]:
+        """Generated tokens per request: positions up to and including
+        the first end_id (the GO token at position 0 excluded), full
+        buffer length when no EOS fired."""
+        return int(count_generated_tokens(rows, self._end_id).sum())
+
+    def stats(self) -> dict:
+        st = super().stats()
+        # the whole-loop server's "slots" are its padded batch rows
+        st["slots"] = self.max_batch_size
+        st["slot_occupancy"] = st["batch_occupancy"]
+        return st
+
+
+class _GenRequest:
+    __slots__ = ("src", "reply", "t_arrival", "t_first")
+
+    def __init__(self, src, reply):
+        self.src = src
+        self.reply = reply
+        self.t_arrival = time.monotonic()
+        self.t_first = None  # set when its first token lands
+
+
+class ContinuousGenerationServer:
+    """Continuous-batching generation over a fixed slot pool
+    (iteration-level scheduling: Orca, Yu et al. OSDI'22; slot-based
+    KV management: vLLM, Kwon et al. SOSP'23 — PAPERS.md. Reference
+    decode loop: tests/unittests/dist_transformer.py:1498
+    fast_decode).
+
+    Wraps a models/transformer.build_decode_step_program bundle: the
+    KV cache slots, token buffers, per-slot step counters, and
+    active-lane masks live as persistable scope state ON DEVICE; the
+    host loops over fused scheduler cycles, each ONE prepared
+    dispatch of a ``bundle.serves[A]`` program:
+
+      admit   — FIFO: up to A oldest queued prompts fill free slots
+                (batched encoder + cross-K/V one-hot matmul scatter,
+                lane reset; padded rows land on the dustbin lane), A
+                drawn from the power-of-two admission-bucket ladder;
+      step    — the same dispatch then advances every live lane up to
+                ``steps_per_tick`` tokens in a device-side While with
+                an all-lanes-idle early exit, so the ~0.5-1 ms host
+                dispatch + readback amortizes over A admissions and a
+                whole burst of tokens;
+      retire  — lanes whose active flag dropped (EOS emitted, or
+                buffer exhausted) are read back, sentinel-normalized
+                (apply_eos_sentinel) and their futures fulfilled;
+                the slot frees for the next arrival IMMEDIATELY.
+
+    Short requests therefore never wait on long ones (the whole-loop
+    GenerationServer's head-of-line cost), and arrivals never wait for
+    a draining batch. Executable count is fixed: ONE serve
+    specialization per admission bucket of the (slot_count, seq
+    bucket) config, resolved through Executor.prepare (the serving
+    fast path) and disk-cacheable via Program.fingerprint();
+    steady-state traffic compiles NOTHING (asserted in tests).
+
+    Greedy parity: a lane's token row equals the whole-loop decode of
+    the same prompt after apply_eos_sentinel, independent of admission
+    order or slot assignment — the step program's math IS the
+    whole-loop body (models/transformer._cached_decoder_step) and
+    every op is row-wise, so co-resident lanes cannot interact.
+    """
+
+    def __init__(self, bundle, executor=None, scope=None,
+                 steps_per_tick: Optional[int] = None,
+                 drain_steps: Optional[int] = None,
+                 exit_on_retire: bool = False,
+                 start: bool = True):
+        self.bundle = bundle
+        self.executor = executor or Executor(TPUPlace(0))
+        self.scope = scope or global_scope()
+        # burst caps. steps_per_tick bounds the queue-pressure burst:
+        # a retired lane's slot refills only at the next cycle, so the
+        # cap trades slot-refill latency (up to K-1 idle steps for one
+        # slot) against per-dispatch overhead amortization — K ~ 8 is
+        # right when host dispatch costs a few device iterations (this
+        # CPU host); on hardware where an iteration dwarfs dispatch,
+        # pass exit_on_retire=True to hand control back the moment a
+        # lane dies (the serve programs' min_active feed) instead.
+        # drain_steps bounds the empty-queue drain burst (the While
+        # exits by itself when the pool goes idle); a request arriving
+        # mid-drain waits at most one drain dispatch.
+        self.steps_per_tick = int(steps_per_tick) \
+            if steps_per_tick is not None else 8
+        self.drain_steps = int(drain_steps) if drain_steps is not None \
+            else bundle.max_out_len
+        self.exit_on_retire = bool(exit_on_retire)
+        self.n_slots = bundle.n_slots
+        self._end_id = bundle.end_id
+        bundle.init_slot_state(self.scope)
+
+        # bind the prepared handles up front (= AOT warmup: all
+        # compiles happen HERE, none in the traffic window): one fused
+        # serve program per admission bucket (0 = tick-only)
+        before = self.executor.compile_count
+        S = bundle.seq_len
+        st = bundle.state
+        self._fetches = [st["tok_buf"], st["step"], st["active"],
+                         st["finished"]]
+        self._serves = {}
+        for A, prog in sorted(bundle.serves.items()):
+            feed = [("n_steps", (1,), "int64"),
+                    ("min_active", (1,), "int64")]
+            if A > 0:
+                feed = [("src_ids", (A, S), "int64"),
+                        ("slots", (A,), "int64")] + feed
+            self._serves[A] = self.executor.prepare(
+                prog, feed=feed, fetch_list=self._fetches,
+                scope=self.scope)
+        self._admit_buckets = sorted(a for a in self._serves if a > 0)
+        self._warmed_compiles = self.executor.compile_count - before
+
+        self._cv = threading.Condition()
+        self._queue: "collections.deque[_GenRequest]" = \
+            collections.deque()
+        self._lanes: List[Optional[_GenRequest]] = \
+            [None] * self.n_slots
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+        # observability (under _cv)
+        self._n_requests = 0
+        self._n_done = 0
+        self._n_tokens = 0
+        self._n_ticks = 0
+        self._occ_sum = 0.0
+        self._latencies = collections.deque(maxlen=4096)
+        self._ttft = collections.deque(maxlen=4096)
+        self._per_token = collections.deque(maxlen=4096)
+        self._t_first_arrival = None
+        self._t_last_done = None
+
+        if start:
+            self.start()
+
+    # --- lifecycle ----------------------------------------------------
+    def start(self):
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def close(self, timeout: float = 5.0):
+        with self._cv:
+            self._running = False
+            pending = list(self._queue)
+            self._queue.clear()
+            pending += [r for r in self._lanes if r is not None]
+            self._lanes = [None] * self.n_slots
+            self._cv.notify_all()
+        for r in pending:
+            r.reply.set_exception(
+                RuntimeError("ContinuousGenerationServer closed"))
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --- request path -------------------------------------------------
+    def submit(self, src_ids) -> _Reply:
+        arr = np.asarray(src_ids)
+        if arr.ndim == 1:
+            arr = arr[None]
+        if arr.shape != (1, self.bundle.seq_len):
+            raise ValueError(
+                f"continuous generation takes one prompt row of "
+                f"exactly seq_len={self.bundle.seq_len} tokens; got "
+                f"shape {tuple(np.asarray(src_ids).shape)}")
+        req = _GenRequest(arr.astype(np.int64), _Reply())
+        with self._cv:
+            if not self._running:
+                raise RuntimeError(
+                    "ContinuousGenerationServer is closed")
+            self._queue.append(req)
+            self._n_requests += 1
+            if self._t_first_arrival is None:
+                self._t_first_arrival = req.t_arrival
+            self._cv.notify_all()
+        return req.reply
+
+    def generate(self, src_ids, timeout: Optional[float] = 120.0):
+        """One prompt row in, one sentinel-normalized [max_out_len]
+        token row out (same contract as GenerationServer.generate for
+        a single row)."""
+        return self.submit(src_ids).result(timeout)
+
+    # --- scheduler ----------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cv:
+                while self._running and not self._queue \
+                        and all(l is None for l in self._lanes):
+                    self._cv.wait()
+                if not self._running:
+                    return
+                # FIFO admission into free slots (arrival order is the
+                # fairness contract; slots assigned lowest-index-first;
+                # at most the largest admission bucket per cycle — a
+                # custom admit_buckets ladder may cover less than
+                # n_slots, and the overflow simply waits one cycle)
+                admits = []
+                for slot in range(self.n_slots):
+                    if not self._queue \
+                            or len(admits) >= self._admit_buckets[-1]:
+                        break
+                    if self._lanes[slot] is None:
+                        req = self._queue.popleft()
+                        self._lanes[slot] = req
+                        admits.append((slot, req))
+                occupied = sum(l is not None for l in self._lanes)
+                drain = not self._queue
+            if admits or occupied:
+                # empty queue: let the burst run — the device loop
+                # exits by itself once the pool drains
+                self._cycle(admits,
+                            self.drain_steps if drain
+                            else self.steps_per_tick,
+                            occupied - 1 if (self.exit_on_retire
+                                             and not drain) else 0)
+
+    def _cycle(self, admits, n_steps, min_active):
+        """ONE fused dispatch per scheduler cycle: admit up to A
+        queued prompts (padded rows replicate the last prompt and
+        scatter to the dustbin lane) and run decode ticks over every
+        live lane until n_steps ran or the live-lane count drops to
+        min_active — admission cost scales with buckets, not
+        requests, and the dispatch overhead amortizes over the whole
+        burst."""
+        feed = {"n_steps": np.array([n_steps], np.int64),
+                "min_active": np.array([max(0, min_active)],
+                                       np.int64)}
+        if admits:
+            A = _bucket_for(len(admits), self._admit_buckets,
+                            "admission batch")
+            feed["src_ids"] = np.concatenate(
+                [req.src for _, req in admits]
+                + [admits[-1][1].src] * (A - len(admits)), axis=0)
+            feed["slots"] = np.array(
+                [slot for slot, _ in admits]
+                + [self.bundle.dustbin] * (A - len(admits)), np.int64)
+        else:
+            A = 0
+        try:
+            outs = self._serves[A].run(feed, return_numpy=True)
+        except BaseException as e:
+            with self._cv:
+                lanes = [r for r in self._lanes if r is not None]
+                self._lanes = [None] * self.n_slots
+            for r in lanes:
+                r.reply.set_exception(e)
+            return
+        tok_buf, step, active, _fin = outs
+        done_t = time.monotonic()
+        retired = []
+        with self._cv:
+            occupied = 0
+            for slot in range(self.n_slots):
+                req = self._lanes[slot]
+                if req is None:
+                    continue
+                occupied += 1
+                if req.t_first is None:
+                    req.t_first = done_t  # first token just landed
+                if active[slot] == 0:
+                    # EOS emitted (or buffer full): retire NOW, free
+                    # the slot for the next arrival
+                    toks = apply_eos_sentinel(
+                        tok_buf[slot:slot + 1], self._end_id)[0]
+                    ntok = int(count_generated_tokens(
+                        toks[None], self._end_id)[0])
+                    lat = (done_t - req.t_arrival) * 1e3
+                    self._latencies.append(lat)
+                    self._ttft.append(
+                        (req.t_first - req.t_arrival) * 1e3)
+                    if ntok:
+                        self._per_token.append(lat / ntok)
+                        self._n_tokens += ntok
+                    self._n_done += 1
+                    self._t_last_done = done_t
+                    self._lanes[slot] = None
+                    retired.append((req, toks))
+            self._n_ticks += 1
+            self._occ_sum += occupied / self.n_slots
+        for req, toks in retired:
+            req.reply.set_result(toks)
+
+    # --- observability ------------------------------------------------
+    def stats(self) -> dict:
+        exe = self.executor
+        with self._cv:
+            done_span = (
+                self._t_last_done - self._t_first_arrival
+                if self._t_last_done is not None
+                and self._t_first_arrival is not None else None)
+            occ = (self._occ_sum / self._n_ticks
+                   if self._n_ticks else None)
+            return {
+                "requests": self._n_requests,
+                "completed": self._n_done,
+                "queue_depth": len(self._queue),
+                "slots": self.n_slots,
+                "slot_occupancy": round(occ, 4) if occ else None,
+                "ticks": self._n_ticks,
+                "steps_per_tick": self.steps_per_tick,
+                "compile_count": exe.compile_count,
+                "cache_hit_count": exe.cache_hit_count,
+                "disk_load_count": exe.disk_load_count,
+                "cache_evict_count": exe.cache_evict_count,
+                "warmed_compiles": self._warmed_compiles,
+                "latency_ms": _pct_dict(self._latencies),
+                "ttft_ms": _pct_dict(self._ttft),
+                "per_token_ms": _pct_dict(self._per_token),
+                "tokens": self._n_tokens,
+                "retired_per_s": (
+                    round(self._n_done / done_span, 1)
+                    if done_span else None),
+            }
+
+
+def count_generated_tokens(tokens: np.ndarray,
+                           end_id: Optional[int]) -> np.ndarray:
+    """Per-row generated-token count of a [B, maxT] decode buffer:
+    positions 1..first-end_id inclusive (the GO token never counts),
+    maxT-1 when the row never emitted end_id (the length the
+    reference's fast_decode early-finish handling implies, reference
+    tests/unittests/dist_transformer.py:1498; the serving layer's
+    tokens/s and per-token-latency unit)."""
+    toks = np.asarray(tokens)
+    if end_id is None:
+        return np.full((toks.shape[0],), toks.shape[1] - 1,
+                       dtype=np.int64)
+    hit = toks[:, 1:] == end_id
+    return np.where(hit.any(axis=1), hit.argmax(axis=1) + 1,
+                    toks.shape[1] - 1).astype(np.int64)
 
 
 def apply_eos_sentinel(tokens: np.ndarray,
@@ -591,5 +998,7 @@ def apply_eos_sentinel(tokens: np.ndarray,
     return toks
 
 
-__all__ = ["InferenceServer", "GenerationServer", "ProgramRunner",
-           "apply_eos_sentinel", "default_batch_buckets"]
+__all__ = ["InferenceServer", "GenerationServer",
+           "ContinuousGenerationServer", "ProgramRunner",
+           "apply_eos_sentinel", "count_generated_tokens",
+           "default_batch_buckets"]

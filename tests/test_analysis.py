@@ -515,6 +515,96 @@ class TestHostEffectFlag:
 # ---------------------------------------------------------------------------
 # PTA080 unregistered op
 # ---------------------------------------------------------------------------
+class TestWriteOnlyCarry:
+    """PTA090: write-only persistables must be carry-declarable (the
+    r6 run_steps scan-carry trap: they join the lax.scan carry seeded
+    with zeros of the DECLARED shape/dtype)."""
+
+    def _write_only(self, data_shape, append_batch):
+        """Write-only persistable sink fed by a scale of `x`; shape
+        inference propagates x's shape onto the sink (batch -1 when
+        append_batch, concrete otherwise)."""
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=list(data_shape),
+                            dtype="float32",
+                            append_batch_size=append_batch)
+            sink = main.global_block.create_var(
+                name="@stats_sink", shape=None, dtype="float32",
+                persistable=True, stop_gradient=True)
+            layers.assign(layers.scale(x, 2.0), output=sink)
+        return main
+
+    def test_positive_batch_dim_shape(self):
+        ds = _diags(self._write_only((4,), True), "PTA090")
+        assert ds and ds[0].severity == ERROR
+        assert ds[0].var == "@stats_sink"
+        assert "carry-declarable" in ds[0].message
+
+    def test_positive_missing_dtype(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            sink = main.global_block.create_var(
+                name="@stats_sink", shape=(8, 4), persistable=True,
+                stop_gradient=True)
+            layers.assign(layers.scale(x, 2.0), output=sink)
+        ds = _diags(main, "PTA090")
+        assert ds and ds[0].severity == ERROR
+
+    def test_negative_concrete_shape(self):
+        # concrete (static-batch) declaration: the zeros carry slot
+        # is well-defined
+        assert not _diags(self._write_only((8, 4), False), "PTA090")
+
+    def test_negative_read_modify_write(self):
+        # read-AND-written persistables ride state_in; declaration
+        # shape is irrelevant (ordinary params/counters)
+        main, startup, g = _guarded()
+        with g:
+            acc = main.global_block.create_var(
+                name="@acc", shape=(-1, 4), dtype="float32",
+                persistable=True, stop_gradient=True)
+            x = layers.data("x", shape=[4], dtype="float32")
+            layers.assign(layers.elementwise_add(acc, x), output=acc)
+        assert not _diags(main, "PTA090")
+
+    def test_negative_read_inside_sub_block(self):
+        # a read from inside a While body surfaces as the while op's
+        # input slots — not write-only
+        main, startup, g = _guarded()
+        with g:
+            state = main.global_block.create_var(
+                name="@loop_state", shape=(-1, 4), dtype="float32",
+                persistable=True, stop_gradient=True)
+            x = layers.data("x", shape=[4], dtype="float32")
+            layers.assign(x, output=state)
+            i = layers.fill_constant([1], "float32", 0.0)
+            limit = layers.fill_constant([1], "float32", 2.0)
+            cond = layers.less_than(i, limit)
+            w = layers.While(cond)
+            with w.block():
+                layers.assign(layers.scale(state, 2.0), output=state)
+                layers.increment(i, 1.0)
+                layers.less_than(i, limit, cond=cond)
+        assert not _diags(main, "PTA090")
+
+    def test_slot_pool_step_program_is_clean(self):
+        # the continuous-batching bundle is the canonical all-state
+        # step program: every slot var is read+written and declared
+        # concrete — PTA090-clean by construction
+        from paddle_tpu.models import transformer as T
+
+        from paddle_tpu import unique_name
+
+        with unique_name.guard():
+            bundle = T.build_decode_step_program(
+                seq_len=4, max_out_len=6, d_model=16, n_heads=2,
+                n_layers=1, d_inner=32, vocab=16, n_slots=2)
+        assert not _diags(bundle.step, "PTA090")
+        assert not _diags(bundle.prefill, "PTA090")
+
+
 class TestUnregisteredOp:
     def test_positive(self):
         main = fluid.Program()

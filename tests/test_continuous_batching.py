@@ -1,0 +1,367 @@
+"""Continuous batching for generation serving
+(inference/serving.py ContinuousGenerationServer +
+models/transformer.py build_decode_step_program).
+
+Covers the two invariants the slot-pool design must hold:
+
+* token-exact greedy parity with the whole-loop incremental decode —
+  same prompts give identical sentinel-normalized token rows, for
+  mixed output lengths (EOS mid-stream via the terminator-copy task),
+  through slot reuse, independent of admission order, and on the
+  K-step-scan tick path;
+* zero steady-state compiles — executable count is fixed at the
+  fused serve set (one program per admission bucket) no matter how
+  many mixed-length requests churn through the pool;
+
+plus the continuous >= static throughput regression guard and the
+serving-observability surface (slot occupancy, TTFT, per-token
+latency, retired/s).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import (ContinuousGenerationServer,
+                                  GenerationServer, apply_eos_sentinel,
+                                  count_generated_tokens)
+
+V, D, L, S, MAXT = 16, 64, 1, 12, 64
+END_ID = 1
+
+
+def _mixed_len_prompts(rng, n):
+    """Terminator-copy prompts: random tokens with end_id planted at a
+    random position — the trained copy model then emits EOS there, so
+    served generations have MIXED lengths (the workload continuous
+    batching exists for)."""
+    src = rng.randint(3, V, (n, S)).astype(np.int64)
+    for r in range(n):
+        p = rng.randint(1, S + 1)
+        if p < S:
+            src[r, p:] = END_ID
+    return src
+
+
+def _zipf_prompts(rng, n):
+    """Zipf-ish workload: most prompts plant EOS in the first few
+    positions (short generations), a fat tail has NO terminator and
+    decodes to the full buffer — the mixed-length mix where
+    head-of-line blocking hurts the whole-loop server most."""
+    src = rng.randint(3, V, (n, S)).astype(np.int64)
+    for r in range(n):
+        p = int(rng.choice([1, 2, 3, S], p=[0.4, 0.25, 0.15, 0.2]))
+        if p < S:
+            src[r, p:] = END_ID
+    return src
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """Train the tiny terminator-copy transformer once; build the
+    whole-loop incremental decode (the parity oracle / static leg)
+    and the slot-pool bundle against the same scope-shared weights."""
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.models import transformer as T
+
+    # module-private scope: the autouse _fresh_state fixture resets
+    # the GLOBAL scope per test, which would wipe the trained weights
+    scope = Scope()
+    with unique_name.guard():
+        main, startup, loss = T.build_program(
+            seq_len=S, d_model=D, n_heads=2, n_layers=L, d_inner=128,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+        with fluid.program_guard(main, startup):
+            fluid.optimizer.Adam(learning_rate=0.005).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(7)
+    for _ in range(400):
+        src = _zipf_prompts(rng, 8)
+        tgt_in = np.concatenate(
+            [np.full((8, 1), 2, np.int64), src[:, :-1]], 1)
+        exe.run(main, feed={"src_ids": src, "tgt_ids": tgt_in,
+                            "label": src}, fetch_list=[loss],
+                scope=scope)
+    kwargs = dict(seq_len=S, max_out_len=MAXT, d_model=D, n_heads=2,
+                  n_layers=L, d_inner=128, vocab=V, start_id=2,
+                  end_id=END_ID)
+    with unique_name.guard():
+        inc_m, _, _, inc_buf = T.build_incremental_decode_program(
+            **kwargs)
+    with unique_name.guard():
+        bundle = T.build_decode_step_program(n_slots=8, **kwargs)
+    return {"exe": exe, "scope": scope, "inc_m": inc_m,
+            "inc_buf": inc_buf, "bundle": bundle, "rng": rng}
+
+
+def _oracle(tr, srcs):
+    """Whole-loop incremental decode of the same prompts, sentinel-
+    normalized (batch-composition-independent form)."""
+    ref, = tr["exe"].run(tr["inc_m"], feed={"src_ids": srcs},
+                         fetch_list=[tr["inc_buf"]],
+                         scope=tr["scope"])
+    return apply_eos_sentinel(np.asarray(ref), end_id=END_ID)
+
+
+class TestParity:
+    def test_token_exact_vs_whole_loop_with_slot_reuse(self, trained):
+        """24 mixed-length requests through 8 slots (3x reuse): every
+        row must equal the whole-loop decode row, -1 sentinel tails
+        included."""
+        srcs = _mixed_len_prompts(np.random.RandomState(11), 24)
+        want = _oracle(trained, srcs)
+        assert len(set((w != -1).sum() for w in want)) > 1, \
+            "workload must have mixed output lengths"
+        with ContinuousGenerationServer(
+                trained["bundle"], executor=trained["exe"],
+                scope=trained["scope"]) as srv:
+            replies = [srv.submit(s) for s in srcs]
+            got = np.stack([r.result(timeout=120.0) for r in replies])
+            st = srv.stats()
+        np.testing.assert_array_equal(got, want)
+        assert st["completed"] == 24
+        assert st["requests"] == 24
+
+    def test_independent_of_admission_order(self, trained):
+        """Reversed submission order: each prompt still decodes to
+        exactly its own row (lanes cannot interact — row-wise ops
+        only)."""
+        srcs = _mixed_len_prompts(np.random.RandomState(13), 10)
+        want = _oracle(trained, srcs)
+        with ContinuousGenerationServer(
+                trained["bundle"], executor=trained["exe"],
+                scope=trained["scope"]) as srv:
+            order = list(range(10))[::-1]
+            replies = {i: srv.submit(srcs[i]) for i in order}
+            got = np.stack([replies[i].result(timeout=120.0)
+                            for i in range(10)])
+        np.testing.assert_array_equal(got, want)
+
+    def test_burst_length_does_not_move_tokens(self, trained):
+        """steps_per_tick=1 vs the default burst: the fused serve
+        While runs a different number of device ticks per dispatch —
+        tokens must not move."""
+        srcs = _mixed_len_prompts(np.random.RandomState(17), 8)
+        want = _oracle(trained, srcs)
+        with ContinuousGenerationServer(
+                trained["bundle"], executor=trained["exe"],
+                scope=trained["scope"], steps_per_tick=1,
+                drain_steps=1) as srv:
+            replies = [srv.submit(s) for s in srcs]
+            got = np.stack([r.result(timeout=120.0) for r in replies])
+        np.testing.assert_array_equal(got, want)
+        with ContinuousGenerationServer(
+                trained["bundle"], executor=trained["exe"],
+                scope=trained["scope"], steps_per_tick=6) as srv:
+            replies = [srv.submit(s) for s in srcs]
+            got2 = np.stack([r.result(timeout=120.0)
+                             for r in replies])
+            st = srv.stats()
+        np.testing.assert_array_equal(got2, want)
+        # the burst amortization actually happened: fewer dispatches
+        # than tokens emitted
+        assert st["ticks"] < st["tokens"]
+        # exit-on-retire scheduling (the min_active feed) moves
+        # dispatch boundaries, never tokens
+        with ContinuousGenerationServer(
+                trained["bundle"], executor=trained["exe"],
+                scope=trained["scope"], exit_on_retire=True) as srv:
+            replies = [srv.submit(s) for s in srcs]
+            got3 = np.stack([r.result(timeout=120.0)
+                             for r in replies])
+        np.testing.assert_array_equal(got3, want)
+
+    def test_standalone_step_program_scan_parity(self, trained):
+        """The bundle's standalone single-step program composes with
+        Executor.prepare(steps=K) (the run_steps inner lax.scan): K
+        scanned ticks equal K sequential ticks, token-for-token."""
+        bundle, exe = trained["bundle"], trained["exe"]
+        scope = trained["scope"]
+        srcs = _mixed_len_prompts(np.random.RandomState(37), 2)
+        sn = bundle.state
+        fetches = [sn["tok_buf"], sn["step"], sn["finished"]]
+
+        def admit_and_run(tick):
+            bundle.init_slot_state(scope)
+            pre = exe.prepare(
+                bundle.prefills[2],
+                feed=[("src_ids", (2, S), "int64"),
+                      ("slots", (2,), "int64")],
+                fetch_list=[], scope=scope)
+            pre.run({"src_ids": srcs,
+                     "slots": np.array([0, 1], np.int64)})
+            return tick()
+
+        seq = exe.prepare(bundle.step, feed={}, fetch_list=fetches,
+                          scope=scope)
+        toks_seq = admit_and_run(
+            lambda: [seq.run({}) for _ in range(6)][-1][0])
+        scanned = exe.prepare(bundle.step, feed={},
+                              fetch_list=fetches, scope=scope,
+                              steps=3)
+        assert scanned.fallback_reason is None  # the scan path bound
+        toks_scan = admit_and_run(
+            lambda: [scanned.run({}) for _ in range(2)][-1][0][-1])
+        np.testing.assert_array_equal(np.asarray(toks_scan)[:2],
+                                      np.asarray(toks_seq)[:2])
+
+
+class TestExecutableBound:
+    def test_zero_steady_state_compiles_under_churn(self, trained):
+        """100 mixed-length requests churning through 8 slots compile
+        NOTHING after the fused serve set (one executable per
+        admission bucket) binds: the slot-pool design admits any
+        request mix through fixed shapes."""
+        exe = trained["exe"]
+        srv = ContinuousGenerationServer(
+            trained["bundle"], executor=exe, scope=trained["scope"])
+        try:
+            # one executable per serve bucket {0,1,2,4,8}
+            assert srv._warmed_compiles <= len(
+                trained["bundle"].serves)
+            warmed = exe.compile_count
+            srcs = _mixed_len_prompts(np.random.RandomState(19), 100)
+            replies = [srv.submit(s) for s in srcs]
+            got = [r.result(timeout=300.0) for r in replies]
+            st = srv.stats()
+        finally:
+            srv.close()
+        assert len(got) == 100
+        assert exe.compile_count == warmed, (
+            f"steady-state traffic compiled "
+            f"{exe.compile_count - warmed} fresh executable(s)")
+        assert st["completed"] == 100
+        # every retirement freed a slot for the next arrival: the pool
+        # stayed busy (>= half occupied on average under a full queue)
+        assert st["slot_occupancy"] and st["slot_occupancy"] >= 0.5
+
+
+class TestCustomAdmitLadder:
+    def test_ladder_smaller_than_slots_caps_admissions(self, trained):
+        """A bundle whose admission-bucket ladder covers less than
+        n_slots must not kill the scheduler when more slots than the
+        largest bucket are free — overflow admissions wait one
+        cycle (regression: _bucket_for raised out of the scheduler
+        thread and every future hung)."""
+        from paddle_tpu import unique_name
+        from paddle_tpu.models import transformer as T
+
+        with unique_name.guard():
+            bundle = T.build_decode_step_program(
+                seq_len=S, max_out_len=MAXT, d_model=D, n_heads=2,
+                n_layers=L, d_inner=128, vocab=V, start_id=2,
+                end_id=END_ID, n_slots=4, admit_buckets=[1, 2],
+                state_prefix="@cb2/")
+        srcs = _mixed_len_prompts(np.random.RandomState(41), 5)
+        want = _oracle(trained, srcs)
+        with ContinuousGenerationServer(
+                bundle, executor=trained["exe"],
+                scope=trained["scope"]) as srv:
+            replies = [srv.submit(s) for s in srcs]
+            got = np.stack([r.result(timeout=120.0) for r in replies])
+        np.testing.assert_array_equal(got, want)
+
+
+class TestObservability:
+    def test_stats_surface(self, trained):
+        srcs = _mixed_len_prompts(np.random.RandomState(23), 8)
+        with ContinuousGenerationServer(
+                trained["bundle"], executor=trained["exe"],
+                scope=trained["scope"]) as srv:
+            replies = [srv.submit(s) for s in srcs]
+            got = np.stack([r.result(timeout=120.0) for r in replies])
+            st = srv.stats()
+        assert st["slots"] == 8
+        assert 0 < st["slot_occupancy"] <= 1.0
+        assert st["ttft_ms"]["p50"] is not None
+        assert st["ttft_ms"]["p99"] >= st["ttft_ms"]["p50"]
+        # TTFT strictly precedes completion for multi-token requests
+        assert st["ttft_ms"]["p50"] <= st["latency_ms"]["p50"]
+        assert st["per_token_ms"]["p50"] is not None
+        assert st["retired_per_s"] and st["retired_per_s"] > 0
+        assert st["tokens"] == int(
+            count_generated_tokens(got, END_ID).sum())
+
+    def test_whole_loop_server_reports_slots_and_ttft(self, trained):
+        """The satellite observability on the STATIC server: TTFT,
+        per-token latency, slot occupancy (its padded batch rows)."""
+        srv = GenerationServer(
+            trained["inc_m"], trained["inc_buf"],
+            executor=trained["exe"], scope=trained["scope"],
+            end_id=END_ID, max_batch_size=4, max_wait_ms=5.0)
+        try:
+            srcs = _mixed_len_prompts(np.random.RandomState(29), 6)
+            replies = [srv.submit({"src_ids": s[None]}) for s in srcs]
+            for r in replies:
+                r.result(timeout=120.0)
+            st = srv.stats()
+        finally:
+            srv.close()
+        assert st["slots"] == 4  # its padded batch rows
+        assert st["slot_occupancy"] == st["batch_occupancy"]
+        assert st["ttft_ms"]["p50"] is not None
+        assert st["per_token_ms"]["p50"] is not None
+        assert st["tokens"] > 0
+        assert st["retired_per_s"] and st["retired_per_s"] > 0
+
+
+class TestThroughputGuard:
+    def test_continuous_not_slower_than_static(self, trained):
+        """Regression guard (CPU analogue of the PERF.md continuous-
+        batching table): on a mixed-length workload the slot-pool
+        server must sustain at least the whole-loop GenerationServer's
+        tokens/s. The measured win is ~1.5-3x (BENCH_SELF_r10.json);
+        asserting >= ~1x (5% slack) keeps the guard robust on loaded
+        CI hosts."""
+        exe, scope = trained["exe"], trained["scope"]
+        srcs = _zipf_prompts(np.random.RandomState(31), 64)
+        want = _oracle(trained, srcs)
+        total_tokens = int(count_generated_tokens(want, END_ID).sum())
+
+        def static_leg():
+            srv = GenerationServer(
+                trained["inc_m"], trained["inc_buf"], executor=exe,
+                scope=scope, end_id=END_ID, max_batch_size=8,
+                max_wait_ms=2.0)
+            try:
+                t0 = time.perf_counter()
+                replies = [srv.submit({"src_ids": s[None]})
+                           for s in srcs]
+                for r in replies:
+                    r.result(timeout=300.0)
+                return time.perf_counter() - t0
+            finally:
+                srv.close()
+
+        def continuous_leg():
+            srv = ContinuousGenerationServer(
+                trained["bundle"], executor=exe, scope=scope,
+                steps_per_tick=8)
+            try:
+                t0 = time.perf_counter()
+                replies = [srv.submit(s) for s in srcs]
+                for r in replies:
+                    r.result(timeout=300.0)
+                return time.perf_counter() - t0
+            finally:
+                srv.close()
+
+        # warm both paths, then 3 INTERLEAVED (static, continuous)
+        # pairs and the best PAIRED ratio: this host's CPU-throttle
+        # windows last seconds, so comparing each leg's global best
+        # can pit one server's lucky window against the other's
+        # throttled one and report a 2x-off ratio (PERF.md
+        # "Continuous batching" measurement note). Adjacent legs
+        # share a window; three pairs make it vanishingly unlikely
+        # every pair straddles a throttle transition.
+        pairs = [(static_leg(), continuous_leg()) for _ in range(3)]
+        best = max(s / c for s, c in pairs)
+        assert best >= 0.95, (
+            f"continuous batching regressed: best paired speedup "
+            f"{best:.2f}x over the static server on the mixed-length "
+            f"workload (pairs: "
+            f"{[(round(s, 3), round(c, 3)) for s, c in pairs]}; "
+            f"{total_tokens} tokens)")

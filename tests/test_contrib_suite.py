@@ -468,15 +468,56 @@ class TestTransformerGreedyDecode:
         missing = [p.name for p in dmain.all_parameters()
                    if scope._get(p.name) is None]
         assert not missing, f"decode params not shared: {missing}"
-        ids, = exe.run(dmain, feed={"src_ids": src},
-                       fetch_list=[out_buf])
+        ids, steps = exe.run(dmain, feed={"src_ids": src},
+                             fetch_list=[out_buf, T.DECODE_STEPS_VAR])
         ids = np.asarray(ids)
         assert ids.shape == (1, S + 3)
-        # greedy generation reproduces the memorized sequence
+        # greedy generation reproduces the memorized sequence (whose
+        # last copied token IS end_id=1 — the EOS terminator)
         assert ids[0, 0] == 2  # GO
         np.testing.assert_array_equal(ids[0, 1:5], src[0])
-        # EOS freeze: everything after the emitted end_id stays end_id
-        np.testing.assert_array_equal(ids[0, 5:], [1, 1])
+        # all-rows-finished early exit: the loop stopped right after
+        # the EOS step instead of spinning to max_out_len emitting
+        # frozen end_id rows, so the tail positions keep their zero
+        # init (apply_eos_sentinel normalizes them to -1 for callers)
+        assert int(np.ravel(steps)[0]) == 4 < S + 3 - 1
+        np.testing.assert_array_equal(ids[0, 5:], [0, 0])
+
+
+class TestDecodeEarlyExit:
+    """Step-count probe for the all-rows-finished early exit: with
+    logits.w zeroed, argmax is token 0 everywhere; at end_id=0 every
+    row emits EOS on the FIRST step, so the While must run exactly 1
+    iteration instead of max_out_len-1 (both decode builders)."""
+
+    def test_loop_stops_when_all_rows_finish(self):
+        from paddle_tpu import unique_name
+        from paddle_tpu.models import transformer as T
+
+        V, D, L, S, maxT = 12, 16, 1, 4, 10
+        kwargs = dict(seq_len=S, max_out_len=maxT, d_model=D,
+                      n_heads=2, n_layers=L, d_inner=32, vocab=V,
+                      start_id=2, end_id=0)
+        with unique_name.guard():
+            gm, gs, _, gbuf = T.build_greedy_decode_program(**kwargs)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(gs)
+        sc = fluid.global_scope()
+        sc._set("logits.w",
+                np.zeros_like(np.asarray(sc._get("logits.w"))))
+        src = np.array([[4, 7, 9, 3], [5, 6, 3, 8]], np.int64)
+        ids, steps = exe.run(gm, feed={"src_ids": src},
+                             fetch_list=[gbuf, T.DECODE_STEPS_VAR])
+        assert int(np.ravel(steps)[0]) == 1, np.asarray(steps)
+        assert (np.asarray(ids)[:, 1] == 0).all()  # EOS at step 1
+        with unique_name.guard():
+            im, _, _, ibuf = T.build_incremental_decode_program(
+                **kwargs)
+        ids2, steps2 = exe.run(im, feed={"src_ids": src},
+                               fetch_list=[ibuf, T.DECODE_STEPS_VAR])
+        assert int(np.ravel(steps2)[0]) == 1, np.asarray(steps2)
+        np.testing.assert_array_equal(np.asarray(ids2),
+                                      np.asarray(ids))
 
 
 class TestTransformerIncrementalDecode:
@@ -611,8 +652,14 @@ def test_transformer_beam_decode_agrees_with_greedy():
                                     fetch_list=[bids, bscores])
     beam_ids = np.asarray(beam_ids)          # [T, beam]
     # best beam's sentence (column 0) equals the greedy continuation
+    # up to and including the EOS terminator; past it the
+    # early-exiting greedy buffer keeps its zero init while the beam
+    # backtrack fills end_id — both mean "after the sequence"
     greedy_cont = np.asarray(greedy)[0, 1:]  # after GO
-    np.testing.assert_array_equal(beam_ids[1:, 0], greedy_cont)
+    eos_at = int(np.argmax(greedy_cont == 1)) + 1 \
+        if (greedy_cont == 1).any() else len(greedy_cont)
+    np.testing.assert_array_equal(beam_ids[1:1 + eos_at, 0],
+                                  greedy_cont[:eos_at])
     np.testing.assert_array_equal(beam_ids[1:5, 0], src[0])
     # the beams are a real search, not beam_size copies of greedy:
     # at least one non-top hypothesis must differ from the best
