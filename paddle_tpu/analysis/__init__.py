@@ -38,10 +38,10 @@ from __future__ import annotations
 from typing import List
 
 from .checkers import (Checker, Diagnostic, ERROR, INFO, WARNING,
-                       check_clone_uids, check_registry,
-                       check_shared_params, format_diagnostics,
-                       register_checker, registered_checkers,
-                       run_checks)
+                       check_clone_uids, check_cross_model_collision,
+                       check_registry, check_shared_params,
+                       format_diagnostics, register_checker,
+                       registered_checkers, run_checks)
 from .dataflow import (BlockDataflow, OpSite, analyze_block,
                        iter_blocks, iter_ops, iter_sub_blocks)
 
@@ -49,6 +49,7 @@ __all__ = [
     "Diagnostic", "Checker", "ERROR", "WARNING", "INFO",
     "run_checks", "register_checker", "registered_checkers",
     "check_registry", "check_shared_params", "check_clone_uids",
+    "check_cross_model_collision",
     "format_diagnostics", "maybe_check_program",
     "BlockDataflow", "OpSite", "analyze_block", "iter_blocks",
     "iter_ops", "iter_sub_blocks",

@@ -41,9 +41,12 @@ def main(argv=None) -> int:
 
     jax.config.update("jax_platforms", "cpu")  # lint never needs a TPU
 
-    from . import (ERROR, INFO, WARNING, check_registry,
-                   check_shared_params, run_checks)
+    from . import (ERROR, INFO, WARNING, check_cross_model_collision,
+                   check_registry, check_shared_params, run_checks)
     from .targets import MODEL_BUILDERS, iter_lint_targets
+
+    pair_checkers = {"shared_params": check_shared_params,
+                     "cross_model": check_cross_model_collision}
 
     if args.only:
         unknown = sorted(set(args.only) - set(MODEL_BUILDERS))
@@ -59,9 +62,10 @@ def main(argv=None) -> int:
             include_benchmark=not args.no_benchmark, only=args.only):
         for label, prog in target.programs.items():
             diags = run_checks(prog)
+            pair_check = pair_checkers[target.pair_check]
             for a, b in target.pairs:
                 if label == a:
-                    diags = diags + check_shared_params(
+                    diags = diags + pair_check(
                         target.programs[a], target.programs[b])
             errs = [d for d in diags if d.severity == ERROR]
             warns = [d for d in diags if d.severity == WARNING]

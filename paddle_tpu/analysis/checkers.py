@@ -25,6 +25,8 @@ a stable diagnostic code so tests/docs can reference the class:
   PTA080  unregistered op type
   PTA090  write-only persistable not carry-declarable (r6 scan-carry
           trap: run_steps/prepare(steps=K) seed it with zeros)
+  PTA100  cross-model param-name collision (co-resident serving
+          runtime models aliasing/clobbering one scope's weights)
 
 Severities: "error" = the program is wrong (strict mode raises),
 "warning" = almost certainly a bug but a legal feed/scope could save
@@ -45,6 +47,7 @@ from .dataflow import (BlockDataflow, OpSite, analyze_block,
 
 __all__ = ["Diagnostic", "Checker", "register_checker", "run_checks",
            "check_registry", "check_shared_params", "check_clone_uids",
+           "check_cross_model_collision",
            "registered_checkers", "format_diagnostics",
            "ERROR", "WARNING", "INFO"]
 
@@ -625,6 +628,74 @@ def check_auto_param_names(program: Program):
             hint="name parameters explicitly (ParamAttr(name=...)) "
                  "for any model with a separate decode/inference "
                  "build — see models/transformer.py enc{i}_*/dec{i}_*")
+
+
+def check_cross_model_collision(a: Program,
+                                b: Program) -> List[Diagnostic]:
+    """PTA100: lint two UNRELATED programs that will be co-resident
+    in one process/scope (the multi-tenant serving runtime's model
+    zoo, inference/runtime). Unlike PTA051 — where sharing is the
+    INTENT and only broken sharing is flagged — here ANY persistable
+    name overlap is an ERROR: same name + different shape means one
+    model's init/swap clobbers the other (a shape error at best),
+    same name + same shape means silent weight aliasing — model B
+    quietly serves model A's parameters and every answer is wrong
+    with no error anywhere. The aliasing case is the WORSE defect
+    (no error ever surfaces), so it must not rank below the loud
+    one: both are errors and both fail the --strict gate. Diagnosed
+    from the runtime scheduling work (ModelRegistry.load refuses
+    colliding co-loads with this check); the fix is per-model name
+    prefixes (the runtime zoo's ``{prefix}_fc1.w`` scheme) or
+    per-model Scopes.
+
+    Covers ALL persistable vars, not just parameters: batch_norm's
+    moving mean/variance are persistables created via
+    create_global_variable (never registered in ``_parameters``), and
+    two models saved from fresh processes both carry e.g.
+    ``batch_norm_0...`` names — a parameters-only intersection stays
+    silent on exactly the running-statistics aliasing this check
+    exists to catch."""
+    out: List[Diagnostic] = []
+
+    def persistables(p: Program):
+        vars_by_name = {}
+        for v in p.list_vars():
+            if getattr(v, "persistable", False):
+                vars_by_name.setdefault(v.name, v)
+        return vars_by_name
+
+    pa, pb = persistables(a), persistables(b)
+    for name in sorted(set(pa) & set(pb)):
+        sa = pa[name].shape
+        sb = pb[name].shape
+        if sa is not None and sb is not None \
+                and tuple(sa) != tuple(sb):
+            out.append(Diagnostic(
+                "PTA100", ERROR,
+                f"co-resident models both declare persistable {name!r} "
+                f"with DIFFERENT shapes {tuple(sa)} vs {tuple(sb)}: "
+                f"loading both into one scope clobbers one of them",
+                var=name,
+                hint="give each model its own Scope, or prefix its "
+                     "parameter names (ParamAttr(name='<model>_...'))"))
+        elif sa is None or sb is None:
+            out.append(Diagnostic(
+                "PTA100", ERROR,
+                f"co-resident models both declare persistable {name!r} "
+                f"(shape unknown on at least one side): one scope "
+                f"would alias or clobber their weights", var=name,
+                hint="give each model its own Scope, or prefix its "
+                     "parameter names (ParamAttr(name='<model>_...'))"))
+        else:
+            out.append(Diagnostic(
+                "PTA100", ERROR,
+                f"co-resident models both declare persistable {name!r} "
+                f"at the same shape: one scope would silently ALIAS "
+                f"their weights (model B serves model A's "
+                f"parameters, no error anywhere)", var=name,
+                hint="give each model its own Scope, or prefix its "
+                     "parameter names (ParamAttr(name='<model>_...'))"))
+    return out
 
 
 def check_shared_params(a: Program, b: Program) -> List[Diagnostic]:

@@ -12,8 +12,12 @@ times are the programs that get linted.
 
 Each target yields ``LintTarget(name, programs, pairs)`` where
 `programs` maps a label -> Program (main + startup builds) and `pairs`
-lists (label_a, label_b) program pairs that share weights by name
-through one scope (train/decode builds) for check_shared_params.
+lists (label_a, label_b) program pairs for the pairwise sweep named by
+``pair_check``: "shared_params" (the default — builds that SHARE
+weights by name through one scope, check_shared_params/PTA051) or
+"cross_model" (co-resident but UNRELATED serving-runtime models,
+check_cross_model_collision/PTA100, where any name overlap is the
+defect).
 """
 from __future__ import annotations
 
@@ -28,6 +32,7 @@ class LintTarget:
     name: str
     programs: Dict[str, object]              # label -> Program
     pairs: List[Tuple[str, str]] = field(default_factory=list)
+    pair_check: str = "shared_params"        # or "cross_model"
 
 
 def _mnist():
@@ -147,6 +152,27 @@ def _label_semantic_roles():
     return {"main": main, "startup": startup}, []
 
 
+def _serving_runtime():
+    """The multi-tenant runtime's model zoo (inference/runtime/zoo.py
+    — the exact programs bench.py's `multitenant` config serves).
+    Every distinct model pair is also lint-PAIRED so PTA051/PTA100's
+    shared-name sweeps cover the co-residency contract (distinct
+    per-model prefixes must keep them silent)."""
+    from ..inference.runtime import zoo
+
+    programs = {}
+    names = []
+    for prefix, in_dim, hidden, classes in zoo.DEFAULT_ZOO:
+        main, startup, _feeds, _fetches = zoo.build_fc_program(
+            prefix, in_dim, hidden, classes)
+        programs[prefix] = main
+        programs[f"{prefix}_startup"] = startup
+        names.append(prefix)
+    pairs = [(a, b) for i, a in enumerate(names)
+             for b in names[i + 1:]]
+    return programs, pairs, "cross_model"
+
+
 MODEL_BUILDERS: Dict[str, Callable] = {
     "mnist": _mnist,
     "resnet": _resnet,
@@ -160,6 +186,7 @@ MODEL_BUILDERS: Dict[str, Callable] = {
     "word2vec": _word2vec,
     "recommender": _recommender,
     "label_semantic_roles": _label_semantic_roles,
+    "serving_runtime": _serving_runtime,
 }
 
 
@@ -181,8 +208,11 @@ def iter_lint_targets(include_benchmark: bool = True,
     for name, build in MODEL_BUILDERS.items():
         if only and name not in only:
             continue
-        programs, pairs = build()
-        yield LintTarget(f"models/{name}", programs, pairs)
+        built = build()
+        programs, pairs = built[0], built[1]
+        pair_check = built[2] if len(built) > 2 else "shared_params"
+        yield LintTarget(f"models/{name}", programs, pairs,
+                         pair_check=pair_check)
     if include_benchmark and not only:
         try:
             yield from _benchmark_targets()

@@ -34,7 +34,11 @@ This module eliminates them across processes:
 Gated by ``FLAGS_compile_cache={off,ro,rw}`` +
 ``FLAGS_compile_cache_dir``; wired through every Executor compile
 path (run / run_steps / the InferenceServer aot_warmup bucket ladder)
-in core/executor.py.
+in core/executor.py. ``FLAGS_compile_cache_max_entries`` /
+``_max_bytes`` bound the on-disk size with LRU-by-mtime pruning on
+write (loads refresh mtime), counted in ``prune_count`` — multi-model
+churn (inference/runtime hot swap) otherwise grows the root without
+bound.
 """
 from __future__ import annotations
 
@@ -43,6 +47,7 @@ import json
 import os
 import pickle
 import tempfile
+import time
 import warnings
 from typing import Any, Dict, Optional, Tuple
 
@@ -214,6 +219,7 @@ class CompileCache:
         self.hit_count = 0        # entries successfully rehydrated
         self.miss_count = 0       # no entry on disk
         self.store_count = 0      # entries written this process
+        self.prune_count = 0      # entries GC'd by the size bounds
         self.discards = []        # (digest, named reason)
 
     @property
@@ -282,6 +288,20 @@ class CompileCache:
                           f"({type(e).__name__}: {e})")
             return None
         self.hit_count += 1
+        # LRU signal for the size-bounded GC: a load refreshes the
+        # entry's mtime so _prune drops cold entries, not the ones
+        # serving processes still warm-start from. Deliberately NOT
+        # gated on self.writable — the common fleet split is ro
+        # serving processes + one rw writer doing the pruning, and an
+        # ro reader that never touched mtime would look cold to the
+        # writer's GC and get its hot entries evicted. mtime is cache
+        # METADATA, not content; ro still never writes entries. A
+        # permission failure (true read-only mount) is fine to
+        # swallow: GC then degrades to FIFO for those readers.
+        try:
+            os.utime(path)
+        except OSError:
+            pass
         return fn, entry["meta"]
 
     # --- store --------------------------------------------------------
@@ -350,11 +370,82 @@ class CompileCache:
                  f"{e})"))
             return False
         self.store_count += 1
+        self._prune()
         return True
+
+    # --- size-bounded GC ---------------------------------------------
+    def _entries(self, sweep_tmps: bool = False):
+        """[(path, mtime, size)] of every entry on disk (cheap: a few
+        hundred stat calls at most for any sane bound).
+        ``sweep_tmps`` unlinks stale ``.tmp`` debris during the SAME
+        walk so the per-store GC pays one directory pass, not two."""
+        now = time.time()
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for f in files:
+                p = os.path.join(dirpath, f)
+                if f.endswith(".tmp"):
+                    if not sweep_tmps:
+                        continue
+                    try:
+                        if now - os.stat(p).st_mtime > \
+                                self._TMP_STALE_S:
+                            os.unlink(p)
+                    except OSError:
+                        pass
+                    continue
+                if not f.endswith(".ptexe"):
+                    continue
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                out.append((p, st.st_mtime, st.st_size))
+        return out
+
+    def disk_usage(self) -> dict:
+        entries = self._entries()
+        return {"entries": len(entries),
+                "bytes": int(sum(s for _, _, s in entries))}
+
+    # a writer killed between mkstemp and os.replace leaves a
+    # digest-sized .tmp that _entries() never counts; live writers
+    # finish in well under a minute, so anything older is debris
+    _TMP_STALE_S = 300.0
+
+    def _prune(self):
+        """LRU-by-mtime GC down to FLAGS_compile_cache_max_entries /
+        _max_bytes (<= 0 = unbounded). Runs after each store; loads
+        refresh mtime, so what goes is what no process warm-started
+        from recently. Unlink races with concurrent writers are
+        benign (missing file = already pruned)."""
+        from ..flags import FLAGS
+
+        max_entries = int(FLAGS.compile_cache_max_entries)
+        max_bytes = int(FLAGS.compile_cache_max_bytes)
+        if max_entries <= 0 and max_bytes <= 0:
+            return  # GC off: stores stay O(1), no directory walks
+        entries = self._entries(sweep_tmps=True)
+        total = sum(s for _, _, s in entries)
+        over_n = (len(entries) - max_entries) if max_entries > 0 else 0
+        if over_n <= 0 and (max_bytes <= 0 or total <= max_bytes):
+            return
+        entries.sort(key=lambda e: e[1])  # oldest mtime first
+        for path, _mtime, size in entries:
+            if over_n <= 0 and (max_bytes <= 0 or total <= max_bytes):
+                break
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            self.prune_count += 1
+            over_n -= 1
+            total -= size
 
     def stats(self) -> dict:
         return {"hits": self.hit_count, "misses": self.miss_count,
                 "stores": self.store_count,
+                "prunes": self.prune_count,
                 "discards": len(self.discards)}
 
 

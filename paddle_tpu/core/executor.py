@@ -151,6 +151,7 @@ class ExecutableCache:
             capacity = FLAGS.executor_cache_capacity
         self.capacity = int(capacity)
         self.evict_count = 0
+        self.insert_count = 0
         self._d: "collections.OrderedDict" = collections.OrderedDict()
         # serving clones share one instance across batcher/caller
         # threads; the plain dict this replaces was GIL-atomic per op,
@@ -177,6 +178,8 @@ class ExecutableCache:
 
     def __setitem__(self, key, value):
         with self._lock:
+            if key not in self._d:
+                self.insert_count += 1
             self._d[key] = value
             self._d.move_to_end(key)
             if self.capacity > 0:
@@ -195,6 +198,17 @@ class ExecutableCache:
     def clear(self):
         with self._lock:
             self._d.clear()
+
+    def stats(self) -> dict:
+        """Cache-pressure snapshot for the runtime's capacity-planning
+        surface (inference/runtime): residency, bound, and lifetime
+        insert/evict counts — a rising evictions/inserts ratio means
+        the bound is below the live working set and steady-state
+        traffic is recompiling."""
+        with self._lock:
+            return {"size": len(self._d), "capacity": self.capacity,
+                    "inserts": self.insert_count,
+                    "evictions": self.evict_count}
 
 
 def _as_aval(x):

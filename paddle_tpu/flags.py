@@ -93,6 +93,12 @@ _DEFS = {
     # existing entries but never write, rw = load + populate.
     "compile_cache": (_as_cache_mode, "off", True),
     "compile_cache_dir": (str, ".paddle_tpu_cache", True),
+    # disk compile-cache GC (multi-model churn grows the cache dir
+    # unboundedly otherwise): prune LRU-by-mtime on write down to
+    # these bounds. <= 0 = unbounded. Loads touch mtime so entries
+    # a serving process still warm-starts from stay resident.
+    "compile_cache_max_entries": (int, 0, True),
+    "compile_cache_max_bytes": (int, 0, True),
     # bound on the Executor's in-memory executable cache (LRU;
     # Pass.apply version bumps permanently strand the old entry, so
     # long-lived serving processes leak one executable per program

@@ -19,8 +19,11 @@ from .export import (StableHLOServer, StableHLOTrainer,
 from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
 from .serving import (ContinuousGenerationServer, GenerationServer,
-                      InferenceServer, apply_eos_sentinel,
-                      count_generated_tokens, default_batch_buckets)
+                      InferenceServer, ServerClosed, ServerQuiesced,
+                      apply_eos_sentinel, count_generated_tokens,
+                      default_batch_buckets)
+from .runtime import (AdmissionError, ModelRegistry, Router,
+                      ServingRuntime)
 
 __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "AnalysisPredictor", "PaddlePredictor", "PaddleTensor",
@@ -29,5 +32,7 @@ __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "StableHLOTrainer", "export_train_stablehlo",
            "load_train_stablehlo", "InferenceServer",
            "GenerationServer", "ContinuousGenerationServer",
-           "apply_eos_sentinel", "count_generated_tokens",
-           "default_batch_buckets"]
+           "ServerClosed", "ServerQuiesced", "apply_eos_sentinel",
+           "count_generated_tokens", "default_batch_buckets",
+           "ServingRuntime", "ModelRegistry", "Router",
+           "AdmissionError"]

@@ -173,6 +173,13 @@ class AnalysisPredictor(PaddlePredictor):
     def program(self):
         return self._program
 
+    def fingerprint(self) -> str:
+        """Content identity of the loaded (analyzed) program —
+        `Program.fingerprint()`, the same process-stable key the disk
+        compile cache and the serving runtime's ModelRegistry use
+        (never the process-local `_uid`)."""
+        return self._program.fingerprint()
+
     # --- execution ------------------------------------------------------
     def _run_feed(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
         import jax
@@ -232,7 +239,8 @@ class AnalysisPredictor(PaddlePredictor):
 
     run_zero_copy = zero_copy_run
 
-    def clone(self, share_cache: bool = True) -> "AnalysisPredictor":
+    def clone(self, share_cache: bool = True,
+              cache=None) -> "AnalysisPredictor":
         """Clone from the already-loaded program (reference
         AnalysisPredictor::Clone shares the loaded program and
         re-creates the executor) -- no disk re-read, so cloning still
@@ -250,7 +258,13 @@ class AnalysisPredictor(PaddlePredictor):
         post-clone Pass.apply on the shared program bumps _version and
         invalidates the cache for ALL sharers -- consistent, never
         stale. share_cache=False restores the fully isolated clone
-        (program deep-cloned under a fresh _uid, private cache)."""
+        (program deep-cloned under a fresh _uid, private cache).
+
+        `cache` (implies share_cache semantics for the program object)
+        attaches the clone to an EXTERNAL ExecutableCache instead of
+        this predictor's own -- the multi-tenant runtime's
+        clone-by-fingerprint path, where every model worker shares the
+        registry's one bounded cache."""
         twin = AnalysisPredictor.__new__(AnalysisPredictor)
         twin._config = copy.deepcopy(self._config)
         twin._scope = Scope()
@@ -258,8 +272,10 @@ class AnalysisPredictor(PaddlePredictor):
             twin._scope._set(name, self._scope._get(name))
         twin._zero_copy_inputs = {}
         twin._zero_copy_outputs = {}
-        if share_cache:
-            twin._exe = Executor(TPUPlace(0), cache=self._exe._cache)
+        if share_cache or cache is not None:
+            twin._exe = Executor(TPUPlace(0),
+                                 cache=cache if cache is not None
+                                 else self._exe._cache)
             twin._program = self._program
         else:
             twin._exe = Executor(TPUPlace(0))

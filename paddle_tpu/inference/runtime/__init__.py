@@ -1,0 +1,146 @@
+"""Multi-tenant serving runtime: many models, one process, SLOs.
+
+SURVEY §2.5's inference engine is one predictor per model; every
+serving layer built since (DynamicBatcher r7, warm start r9,
+continuous batching r10) kept that single-model shape. This package
+is the front door that owns the CROSS-model story — the first layer
+arbitrating global resources (executables, slots, queue time) across
+everything below it:
+
+* ``registry.ModelRegistry`` — model bundles keyed by
+  ``Program.fingerprint()``; hot swap = warm the new fingerprint
+  (disk compile cache -> shared executable cache) -> flip the alias
+  -> drain the old server -> close (zero accepted-request loss); all
+  model executors share ONE bounded ``ExecutableCache`` so retired
+  executables age out through the LRU.
+* ``router.Router`` — per-tenant token-bucket admission + bounded
+  queues with NAMED rejection (``AdmissionError``), and SLO-aware
+  weighted deficit round-robin over the per-model servers' capacity
+  (a noisy tenant keeps its backlog in its own queue).
+* ``stats.RuntimeStats`` — the unified ``stats_json()`` surface:
+  per-tenant and per-model TTFT/latency/occupancy plus cache
+  pressure (executable LRU size/evictions, compile counts, disk
+  cache hits/prunes).
+* ``zoo`` — the model set the multitenant bench/tests serve (also
+  linted by ``python -m paddle_tpu.analysis``).
+
+``ServingRuntime`` below is the one-object facade wiring the three
+together; the pieces remain individually usable.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import ModelHandle, ModelRegistry, server_fingerprint
+from .router import AdmissionError, Router, TenantConfig
+from .stats import RuntimeStats
+
+__all__ = ["ServingRuntime", "ModelRegistry", "ModelHandle",
+           "Router", "TenantConfig", "AdmissionError", "RuntimeStats",
+           "server_fingerprint"]
+
+
+class ServingRuntime:
+    """The process front door: registry + router + stats in one
+    object. Reference counterpart: the closest thing is a fleet of
+    inference/api/analysis_predictor.cc predictors with no in-process
+    arbiter — see registry.py's module docstring for the full
+    mapping.
+
+    Usage::
+
+        rt = ServingRuntime()
+        server, scope = zoo.make_fc_server("base", 128, 256, 16,
+                                           executor=rt.executor())
+        rt.load_model("base", server)          # warms, then serves
+        rt.add_tenant("acme", weight=2.0, rate=500, max_queue=128,
+                      target_p99_ms=50)
+        out = rt.infer("acme", "base", {"base_x": batch})
+        print(rt.stats_json())
+    """
+
+    def __init__(self, cache_capacity: Optional[int] = None,
+                 quantum: float = 1.0,
+                 default_target_p99_ms: float = 1000.0,
+                 drain_timeout: float = 60.0):
+        from ...core.executor import ExecutableCache
+
+        cache = ExecutableCache(cache_capacity)
+        self.registry = ModelRegistry(cache=cache,
+                                      drain_timeout=drain_timeout)
+        self.router = Router(
+            self.registry, quantum=quantum,
+            default_target_p99_ms=default_target_p99_ms)
+        self._stats = RuntimeStats(self.registry, self.router)
+
+    # --- wiring helpers ----------------------------------------------
+    @property
+    def cache(self):
+        return self.registry.cache
+
+    def executor(self, donate: bool = True):
+        """Executors for model servers/runners — all share the
+        runtime's bounded executable cache."""
+        return self.registry.executor(donate=donate)
+
+    # --- models -------------------------------------------------------
+    def load_model(self, alias: str, server, warm: bool = True,
+                   max_inflight: Optional[int] = None) -> ModelHandle:
+        return self.registry.load(alias, server, warm=warm,
+                                  max_inflight=max_inflight)
+
+    def load_predictor(self, alias: str, predictor,
+                       **kwargs) -> ModelHandle:
+        return self.registry.load_predictor(alias, predictor, **kwargs)
+
+    def retire_model(self, alias: str):
+        self.registry.retire(alias)
+
+    # --- tenants / traffic -------------------------------------------
+    def add_tenant(self, name: str, **cfg) -> TenantConfig:
+        return self.router.add_tenant(name, **cfg)
+
+    def submit(self, tenant: str, model: str, payload):
+        return self.router.submit(tenant, model, payload)
+
+    def infer(self, tenant: str, model: str, payload,
+              timeout: Optional[float] = 60.0):
+        return self.router.infer(tenant, model, payload,
+                                 timeout=timeout)
+
+    # --- observability ------------------------------------------------
+    def stats(self, reset: bool = False) -> dict:
+        return self._stats.collect(reset=reset)
+
+    def stats_json(self, reset: bool = False, indent=None) -> str:
+        return self._stats.to_json(reset=reset, indent=indent)
+
+    # --- lifecycle ----------------------------------------------------
+    def drain(self, timeout: Optional[float] = 60.0) -> bool:
+        """Quiesce nothing; just wait for queued + in-flight traffic
+        to finish (router queues first, then each model server).
+        ``timeout`` bounds the WHOLE call: each successive drain gets
+        the time remaining on one deadline, not a fresh budget."""
+        import time as _time
+
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+
+        def left():
+            return None if deadline is None \
+                else max(0.0, deadline - _time.monotonic())
+
+        ok = self.router.drain(left())
+        for handle in self.registry.aliases().values():
+            ok = handle.server.drain(left()) and ok
+        return ok
+
+    def close(self):
+        self.router.close()
+        self.registry.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,328 @@
+"""ModelRegistry: fingerprint-keyed model bundles + hot swap.
+
+Reference counterpart: the reference's serving story is one
+AnalysisPredictor per model per process (reference
+inference/api/analysis_predictor.cc:832 CreatePaddlePredictor); its
+deploy apps run a process per model and swap by process replacement.
+A TPU-native front door serves a model ZOO from one process (the
+analysis_predictor-zoo analogue SURVEY §2.5 stops short of): models
+are identified by ``Program.fingerprint()`` (content hash — the same
+key the disk compile cache uses, core/compile_cache.py), aliases give
+traffic a stable name, and hot swap is
+
+    load new fingerprint -> warm (aot_warmup seeds the shared
+    executable cache / rehydrates from the disk compile cache) ->
+    flip the alias -> quiesce + drain the old server -> close it
+
+so accepted requests are NEVER lost (the old server finishes its
+queue before closing; arrivals that race the flip get the
+``ServerQuiesced`` named error and the Router re-resolves the alias).
+Old executables are not freed eagerly: their cache entries simply age
+out of the shared bounded ``ExecutableCache`` LRU (core/executor.py)
+once nothing hits them.
+
+Scope isolation is load-time-checked: two models loaded into the SAME
+scope whose programs declare overlapping persistable names silently
+alias weights (model B serves model A's parameters) — the PTA100
+failure class (analysis/checkers.py check_cross_model_collision);
+``load`` refuses such a pair with a named error.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Dict, Optional
+
+from ...core.executor import ExecutableCache, Executor, TPUPlace
+
+__all__ = ["ModelHandle", "ModelRegistry", "server_fingerprint"]
+
+
+def server_fingerprint(server) -> str:
+    """Content identity of the program(s) a server dispatches:
+    ``Program.fingerprint()`` for the single-program servers
+    (InferenceServer/GenerationServer via their runner), a canonical
+    digest over the per-admission-bucket serve programs for
+    ContinuousGenerationServer. Process-stable by construction (never
+    ``_uid`` — CLAUDE.md r9). No direct reference counterpart: the
+    closest shape is the program-desc identity
+    inference/api/analysis_predictor.cc predictors are created
+    from."""
+    runner = getattr(server, "_runner", None)
+    if runner is not None and hasattr(runner, "program"):
+        return runner.program.fingerprint()
+    bundle = getattr(server, "bundle", None)
+    if bundle is not None:
+        from ...core.compile_cache import canonical_digest
+
+        return canonical_digest(
+            {str(a): prog.fingerprint()
+             for a, prog in sorted(bundle.serves.items())})
+    raise TypeError(
+        f"cannot fingerprint {type(server).__name__}: expected an "
+        f"InferenceServer-style server (with ._runner.program) or a "
+        f"ContinuousGenerationServer (with .bundle)")
+
+
+def _server_scope(server):
+    runner = getattr(server, "_runner", None)
+    if runner is not None:
+        scope = getattr(runner, "scope", None)
+        if scope is not None:
+            return scope
+        pred = getattr(runner, "_predictor", None)
+        if pred is not None:
+            return getattr(pred, "_scope", None)
+    return getattr(server, "scope", None)
+
+
+def _server_programs(server):
+    runner = getattr(server, "_runner", None)
+    if runner is not None and hasattr(runner, "program"):
+        return [runner.program]
+    bundle = getattr(server, "bundle", None)
+    if bundle is not None:
+        return [prog for _a, prog in sorted(bundle.serves.items())]
+    return []
+
+
+class ModelHandle:
+    """One loaded model: alias + fingerprint + the serving object.
+
+    ``max_inflight`` is the Router's per-model forwarding bound (how
+    many admitted requests may sit in the server's own queue at once;
+    beyond it the Router holds requests in per-tenant queues where
+    weighted-deficit scheduling owns the ordering). Default: twice
+    the server's native capacity (batch rows / slots) so the batcher
+    can always form a full next batch while one is in flight. No
+    direct reference counterpart: one of these is roughly one
+    inference/api/analysis_predictor.cc predictor instance, with the
+    alias/fingerprint/in-flight bookkeeping the multi-model registry
+    adds."""
+
+    __slots__ = ("alias", "server", "fingerprint", "kind",
+                 "max_inflight", "loaded_at", "load_config")
+
+    def __init__(self, alias: str, server, fingerprint: str,
+                 max_inflight: Optional[int] = None):
+        self.alias = alias
+        self.server = server
+        self.fingerprint = fingerprint
+        self.kind = type(server).__name__
+        # the (max_inflight, server_kwargs) a load_predictor call
+        # built this handle from — the dedupe no-op compares against
+        # it so a same-fingerprint re-load with CHANGED serving
+        # config swaps instead of silently keeping the old knobs.
+        # None for servers loaded directly via load().
+        self.load_config = None
+        if max_inflight is None:
+            native = getattr(server, "max_batch_size", None) \
+                or getattr(server, "n_slots", None) or 8
+            max_inflight = 2 * int(native)
+        self.max_inflight = int(max_inflight)
+        self.loaded_at = time.monotonic()
+
+    @property
+    def executor(self) -> Executor:
+        runner = getattr(self.server, "_runner", None)
+        if runner is not None:
+            return runner.executor
+        return self.server.executor
+
+    def submit(self, payload):
+        """Forward one request payload verbatim to the server's
+        submit (a feed dict for InferenceServer/GenerationServer, a
+        prompt row for ContinuousGenerationServer)."""
+        return self.server.submit(payload)
+
+    def stats(self, reset: bool = False) -> dict:
+        return self.server.stats(reset=reset)
+
+
+class ModelRegistry:
+    """Alias -> ModelHandle map with warm-then-flip hot swap.
+
+    All model executors should share ONE bounded ``ExecutableCache``
+    (``registry.executor()`` hands them out) so the process has a
+    single global executable budget: N models' bucket ladders compete
+    in one LRU instead of N unbounded private dicts, and retired
+    models' executables age out instead of leaking."""
+
+    def __init__(self, cache: Optional[ExecutableCache] = None,
+                 drain_timeout: float = 60.0):
+        self._cache = cache if cache is not None else ExecutableCache()
+        self._lock = threading.Lock()
+        # serializes whole load() calls (guard -> warm -> flip):
+        # the PTA100 scope-collision guard is check-then-act against
+        # the alias table, and warmup widens that window to seconds —
+        # two concurrent loads of colliding models must not both pass
+        # the check. Always taken OUTSIDE self._lock. Loads are rare
+        # control-plane ops; serializing them costs nothing. RLock:
+        # load_predictor holds it across its fingerprint dedupe (also
+        # check-then-act) and re-enters through load().
+        self._load_lock = threading.RLock()
+        self._aliases: Dict[str, ModelHandle] = {}
+        self.drain_timeout = float(drain_timeout)
+        self.swap_count = 0
+        self.retire_count = 0
+
+    @property
+    def cache(self) -> ExecutableCache:
+        return self._cache
+
+    def executor(self, donate: bool = True) -> Executor:
+        """A fresh Executor wired to the registry's shared executable
+        cache — build model servers/runners against these."""
+        return Executor(TPUPlace(0), donate=donate, cache=self._cache)
+
+    # --- load / swap --------------------------------------------------
+    def load(self, alias: str, server, warm: bool = True,
+             max_inflight: Optional[int] = None) -> ModelHandle:
+        """Load (or hot-swap) `alias`. The new server is warmed FIRST
+        (compiles land before it takes traffic), then the alias flips
+        atomically; an existing server under the alias is quiesced,
+        drained (its accepted requests all complete), and closed."""
+        fingerprint = server_fingerprint(server)
+        with self._load_lock:
+            self._guard_scope_collision(alias, server)
+            if warm:
+                warmup = getattr(server, "aot_warmup", None)
+                if warmup is not None:
+                    warmup()
+            handle = ModelHandle(alias, server, fingerprint,
+                                 max_inflight)
+            with self._lock:
+                old = self._aliases.get(alias)
+                self._aliases[alias] = handle
+                if old is not None:
+                    self.swap_count += 1
+        if old is not None:
+            self._retire_handle(old)
+        return handle
+
+    def load_predictor(self, alias: str, predictor, warm: bool = True,
+                       max_inflight: Optional[int] = None,
+                       force: bool = False,
+                       **server_kwargs) -> ModelHandle:
+        """Clone-by-fingerprint: wrap an AnalysisPredictor in an
+        InferenceServer and load it. The clone shares the loaded
+        program and attaches to the registry's shared executable
+        cache, so a bucket warmed by any model worker is a cache hit
+        here. A re-load whose fingerprint AND serving config
+        (`max_inflight`/`server_kwargs`) match the currently served
+        ones is a no-op (same program content, same knobs — the
+        idempotent deploy-loop case; weight-only updates should pass
+        force=True); a same-fingerprint re-load with CHANGED config
+        is a config update and swaps in a reconfigured server rather
+        than silently keeping the old knobs."""
+        fingerprint = predictor.fingerprint()
+        load_config = (max_inflight, dict(server_kwargs))
+        with self._load_lock:
+            with self._lock:
+                current = self._aliases.get(alias)
+            if current is not None and not force \
+                    and current.fingerprint == fingerprint \
+                    and current.load_config == load_config:
+                return current
+            from ..serving import InferenceServer
+
+            twin = predictor.clone(share_cache=True, cache=self._cache)
+            server = InferenceServer(twin, **server_kwargs)
+            handle = self.load(alias, server, warm=warm,
+                               max_inflight=max_inflight)
+            handle.load_config = load_config
+            return handle
+
+    # --- lookup -------------------------------------------------------
+    def get(self, alias: str) -> ModelHandle:
+        with self._lock:
+            handle = self._aliases.get(alias)
+            if handle is None:
+                raise KeyError(
+                    f"no model loaded under alias {alias!r}; loaded: "
+                    f"{sorted(self._aliases)}")
+            return handle
+
+    def aliases(self) -> Dict[str, ModelHandle]:
+        with self._lock:
+            return dict(self._aliases)
+
+    # --- retire -------------------------------------------------------
+    def _retire_handle(self, handle: ModelHandle):
+        handle.server.quiesce()
+        drained = handle.server.drain(self.drain_timeout)
+        if not drained:
+            warnings.warn(
+                f"registry: retiring model {handle.alias!r} "
+                f"({handle.fingerprint[:12]}...) before its queue "
+                f"fully drained ({self.drain_timeout}s timeout); "
+                f"remaining requests fail with the closed-server "
+                f"error")
+        handle.server.close()
+        with self._lock:
+            self.retire_count += 1
+
+    def retire(self, alias: str):
+        """Drain and close one alias (no replacement)."""
+        with self._lock:
+            handle = self._aliases.pop(alias, None)
+        if handle is None:
+            raise KeyError(f"no model loaded under alias {alias!r}")
+        self._retire_handle(handle)
+
+    def close(self):
+        with self._lock:
+            handles = list(self._aliases.values())
+            self._aliases.clear()
+        for handle in handles:
+            self._retire_handle(handle)
+
+    # --- isolation guard ----------------------------------------------
+    def _guard_scope_collision(self, alias: str, server):
+        """Refuse to co-load two models whose programs share
+        persistable names in ONE scope (silent weight aliasing /
+        clobbering — PTA100). Swapping the SAME alias in the same
+        scope is exempt: that is the supported weight-carryover
+        path.
+
+        This is a LOAD-time backstop: if the colliding model's
+        startup program already ran into the shared scope at build
+        time, the clobber has already happened — the refusal here
+        only keeps the corrupted pair from serving. Builders must
+        check BEFORE scope init (zoo.make_fc_server refuses an
+        already-populated scope pre-startup)."""
+        from ...analysis import check_cross_model_collision
+
+        scope = _server_scope(server)
+        if scope is None:
+            return
+        new_progs = _server_programs(server)
+        with self._lock:
+            others = [(a, h) for a, h in self._aliases.items()
+                      if a != alias]
+        for other_alias, other in others:
+            if _server_scope(other.server) is not scope:
+                continue
+            diags = []
+            for pa in new_progs:
+                for pb in _server_programs(other.server):
+                    diags.extend(check_cross_model_collision(pa, pb))
+            if diags:
+                listing = "\n  ".join(d.format() for d in diags[:6])
+                raise RuntimeError(
+                    f"refusing to load model {alias!r}: it shares a "
+                    f"scope AND persistable names with loaded model "
+                    f"{other_alias!r} — co-resident models would "
+                    f"silently alias/clobber weights (PTA100). Give "
+                    f"each model its own Scope.\n  {listing}")
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "loaded": len(self._aliases),
+                "swaps": self.swap_count,
+                "retired": self.retire_count,
+                "models": {a: h.fingerprint[:16]
+                           for a, h in self._aliases.items()},
+            }

@@ -1,0 +1,517 @@
+"""Router: per-tenant admission control + SLO-aware fair scheduling.
+
+The serving layers below (inference/serving.py) own SHAPE economics —
+batch formation, bucket ladders, slot pools. Nothing before this
+module owned TRAFFIC economics: who gets on the box (admission), and
+in what order contended capacity is spent (scheduling). The reference
+framework has no analogue (its deploy apps are one tenant, one
+model); the design here follows the front-door discipline of
+Orca/vLLM-class servers' outer loops (PAPERS.md) and classic fair
+queueing:
+
+* **Admission** is per tenant and synchronous at ``submit``: a token
+  bucket (``rate`` requests/s refilled continuously, ``burst`` cap)
+  and a bounded queue (``max_queue``) reject with a NAMED
+  ``AdmissionError`` (`reason` in {rate-limited, queue-full,
+  unknown-tenant, unknown-model, router-closed}) instead of letting a
+  flood grow unbounded latency for everyone.
+* **Scheduling** is weighted deficit round-robin (DRR, Shreedhar &
+  Varghese '95) over the per-tenant queues: each pass every backlogged
+  tenant earns ``quantum x weight`` credit and dispatches whole
+  requests while credit lasts, so a tenant flooding 100x the traffic
+  still only gets its weight share of contended model capacity — the
+  noisy neighbor's backlog waits in ITS queue, not in front of the
+  small tenant. Pass order is SLO-aware: tenants are visited
+  most-urgent-first, urgency = head-of-queue wait / target p99, so a
+  tenant near its SLO spends its credit before one with slack.
+* **Backpressure** comes from per-model in-flight caps
+  (``ModelHandle.max_inflight``): the router forwards at most that
+  many admitted requests into a server's own FIFO at once (enough to
+  keep its batcher full), and holds the rest where DRR ordering still
+  applies. Without the cap, forwarding eagerly would re-serialize
+  everything through the server's arrival-order queue and fairness
+  would be cosmetic.
+
+Completion is observed via the server futures; per-tenant latency /
+queue-time / TTFT percentiles and SLO-violation counts accumulate
+under the router lock (same reset/window discipline as the servers'
+``stats(reset=...)``). Hot swap is transparent: a forward that hits a
+quiescing server (``ServerQuiesced``) re-resolves the alias and
+retries — accepted requests never fail because of a swap.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent import futures
+from typing import Dict, Optional
+
+from ..serving import ServerClosed, ServerQuiesced, _pct_dict
+
+__all__ = ["AdmissionError", "Router", "TenantConfig"]
+
+
+class AdmissionError(RuntimeError):
+    """Named request rejection at the front door. `reason` is
+    machine-readable: rate-limited | queue-full | unknown-tenant |
+    unknown-model | router-closed. No direct reference counterpart
+    (the reference serves one tenant per process; see the Router
+    docstring)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        msg = f"admission rejected ({reason})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class TenantConfig:
+    """Per-tenant policy: fair-share ``weight``, token-bucket
+    ``rate``/``burst`` (None = unlimited), queue bound ``max_queue``,
+    and SLO ``target_p99_ms`` (drives scheduling urgency and the
+    violation counter; None = best-effort). No direct reference
+    counterpart — multi-tenancy is this runtime's addition (see the
+    Router docstring)."""
+
+    __slots__ = ("name", "weight", "rate", "burst", "max_queue",
+                 "target_p99_ms")
+
+    def __init__(self, name: str, weight: float = 1.0,
+                 rate: Optional[float] = None,
+                 burst: Optional[float] = None,
+                 max_queue: int = 64,
+                 target_p99_ms: Optional[float] = None):
+        if weight <= 0:
+            raise ValueError(f"tenant weight must be > 0, got {weight}")
+        if max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1, got {max_queue}")
+        if rate is not None and rate <= 0:
+            raise ValueError(
+                f"tenant rate must be > 0 (or None for unlimited), "
+                f"got {rate}")
+        if burst is not None and burst < 1.0:
+            # admission spends whole tokens: a bucket that can never
+            # hold one would reject every request as rate-limited
+            raise ValueError(
+                f"tenant burst must be >= 1, got {burst}")
+        if burst is not None and rate is None:
+            # the whole token-bucket path is gated on rate: a
+            # burst-only config would validate, then silently not
+            # limit anything
+            raise ValueError(
+                "tenant burst requires a rate (burst alone does not "
+                "limit anything)")
+        self.name = name
+        self.weight = float(weight)
+        self.rate = float(rate) if rate is not None else None
+        if burst is None:
+            burst = max(1.0, rate) if rate is not None else None
+        self.burst = float(burst) if burst is not None else None
+        self.max_queue = int(max_queue)
+        self.target_p99_ms = (float(target_p99_ms)
+                              if target_p99_ms is not None else None)
+
+
+class _Routed:
+    __slots__ = ("model", "payload", "reply", "t_submit", "t_dispatch")
+
+    def __init__(self, model, payload):
+        self.model = model
+        self.payload = payload
+        self.reply = futures.Future()
+        self.t_submit = time.monotonic()
+        self.t_dispatch = None
+
+
+class _TenantState:
+    __slots__ = ("cfg", "queue", "tokens", "t_refill", "deficit",
+                 "admitted", "rejected_rate", "rejected_queue",
+                 "completed", "failed", "slo_violations",
+                 "latencies", "queue_ms", "ttft")
+
+    def __init__(self, cfg: TenantConfig):
+        self.cfg = cfg
+        self.queue: "collections.deque[_Routed]" = collections.deque()
+        self.tokens = cfg.burst if cfg.burst is not None else 0.0
+        self.t_refill = time.monotonic()
+        self.deficit = 0.0
+        self.admitted = 0
+        self.rejected_rate = 0
+        self.rejected_queue = 0
+        self.completed = 0
+        self.failed = 0
+        self.slo_violations = 0
+        self.latencies = collections.deque(maxlen=4096)
+        self.queue_ms = collections.deque(maxlen=4096)
+        # tenant-level TTFT == reply latency (the router sees complete
+        # replies; same recording convention as the one-shot servers —
+        # token-level TTFT lives in the per-model server stats)
+        self.ttft = collections.deque(maxlen=4096)
+
+
+class Router:
+    """Per-tenant admission + SLO-aware weighted-DRR scheduling over
+    a ModelRegistry's servers (design rationale in the module
+    docstring above). No direct reference counterpart: the reference
+    serves one tenant/one model per process (its deploy apps sit on
+    inference/api/analysis_predictor.cc:832 CreatePaddlePredictor
+    directly); this is the front door that multi-tenancy adds on
+    top."""
+
+    def __init__(self, registry, quantum: float = 1.0,
+                 default_target_p99_ms: float = 1000.0,
+                 start: bool = True):
+        self._registry = registry
+        if quantum <= 0:
+            # the DRR pass normalizes by quantum x weight: 0 would
+            # ZeroDivisionError (killing the daemon dispatch loop,
+            # every request hangs), negative silently starves
+            raise ValueError(f"quantum must be > 0, got {quantum}")
+        self.quantum = float(quantum)
+        self.default_target_p99_ms = float(default_target_p99_ms)
+        self._cv = threading.Condition()
+        self._tenants: Dict[str, _TenantState] = {}
+        self._inflight: Dict[str, int] = {}
+        self._running = False   # scheduler thread live
+        self._closed = False    # close() called (admission stops)
+        self._thread: Optional[threading.Thread] = None
+        self._t_start = time.monotonic()
+        self._t_window = self._t_start
+        if start:
+            self.start()
+
+    # --- lifecycle ----------------------------------------------------
+    def start(self):
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def close(self, timeout: float = 5.0):
+        with self._cv:
+            self._running = False
+            self._closed = True
+            pending = [r for t in self._tenants.values()
+                       for r in t.queue]
+            for t in self._tenants.values():
+                t.queue.clear()
+            self._cv.notify_all()
+        for r in pending:
+            r.reply.set_exception(
+                AdmissionError("router-closed",
+                               "router closed while queued"))
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def drain(self, timeout: Optional[float] = 60.0) -> bool:
+        """Block until every tenant queue is empty and every
+        forwarded request has completed. (Model servers may still be
+        finishing their own internal batches only in the instant
+        before their futures fire — inflight counts those, so False
+        here really means work remains.)"""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cv:
+            def dirty():
+                return (any(t.queue for t in self._tenants.values())
+                        or any(self._inflight.values()))
+
+            while self._running and dirty():
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+            return not dirty()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --- tenants ------------------------------------------------------
+    def add_tenant(self, name: str, **cfg) -> TenantConfig:
+        tc = TenantConfig(name, **cfg)
+        with self._cv:
+            if name in self._tenants:
+                raise ValueError(f"tenant {name!r} already exists")
+            self._tenants[name] = _TenantState(tc)
+        return tc
+
+    # --- request path -------------------------------------------------
+    def submit(self, tenant: str, model: str, payload):
+        """Admit one request for `tenant` against model alias `model`;
+        returns a future. Rejections raise AdmissionError
+        synchronously — callers see WHY at the call site instead of a
+        timeout later."""
+        with self._cv:
+            if self._closed:
+                raise AdmissionError("router-closed", "")
+            state = self._tenants.get(tenant)
+            if state is None:
+                raise AdmissionError(
+                    "unknown-tenant",
+                    f"{tenant!r}; known: {sorted(self._tenants)}")
+            try:
+                self._registry.get(model)
+            except KeyError as e:
+                raise AdmissionError("unknown-model", str(e)) from None
+            cfg = state.cfg
+            # queue bound BEFORE the token debit: a client retrying
+            # on queue-full must not drain its rate budget while
+            # nothing is being admitted
+            if len(state.queue) >= cfg.max_queue:
+                state.rejected_queue += 1
+                raise AdmissionError(
+                    "queue-full",
+                    f"tenant {tenant!r} queue at max_queue="
+                    f"{cfg.max_queue}")
+            if cfg.rate is not None:
+                now = time.monotonic()
+                state.tokens = min(
+                    cfg.burst,
+                    state.tokens + (now - state.t_refill) * cfg.rate)
+                state.t_refill = now
+                if state.tokens < 1.0:
+                    state.rejected_rate += 1
+                    raise AdmissionError(
+                        "rate-limited",
+                        f"tenant {tenant!r} exceeds {cfg.rate:g} "
+                        f"req/s (burst {cfg.burst:g})")
+                state.tokens -= 1.0
+            req = _Routed(model, payload)
+            state.queue.append(req)
+            state.admitted += 1
+            self._cv.notify_all()
+        return req.reply
+
+    def infer(self, tenant: str, model: str, payload,
+              timeout: Optional[float] = 60.0):
+        return self.submit(tenant, model, payload).result(timeout)
+
+    # --- scheduler ----------------------------------------------------
+    def _urgency(self, state: _TenantState, now: float) -> float:
+        target = state.cfg.target_p99_ms \
+            if state.cfg.target_p99_ms is not None \
+            else self.default_target_p99_ms
+        return (now - state.queue[0].t_submit) * 1e3 / max(target, 1.0)
+
+    def _head_capacity(self, state: _TenantState) -> bool:
+        """True when the head request's model can take a forward now
+        (or is gone — then dispatch proceeds and fails it by name)."""
+        try:
+            handle = self._registry.get(state.queue[0].model)
+        except KeyError:
+            return True
+        alias = state.queue[0].model
+        return self._inflight.get(alias, 0) < handle.max_inflight
+
+    def _loop(self):
+        while True:
+            to_send = []
+            with self._cv:
+                while self._running and not any(
+                        t.queue and self._head_capacity(t)
+                        for t in self._tenants.values()):
+                    self._cv.wait()
+                if not self._running:
+                    return
+                now = time.monotonic()
+                active = [t for t in self._tenants.values() if t.queue]
+                # SLO-aware pass order: most urgent head first
+                active.sort(key=lambda t: -self._urgency(t, now))
+                # DRR: earn quantum x weight per pass, spend 1 per
+                # request. Only tenants whose head can dispatch NOW
+                # earn (a tenant blocked on a saturated model banks no
+                # credit for its blocked time — it must not burst past
+                # everyone when the model frees up), and earnings are
+                # normalized so the largest-weight tenant that can
+                # spend earns exactly one credit when quantum x weight
+                # < 1: weight RATIOS (not absolute values) set the
+                # service shares, so normalized weights (0.7/0.2/0.1)
+                # neither starve below the one-credit threshold nor
+                # pace on the idle wait below. Keying the scale on ALL
+                # backlogged tenants (including a blocked high-weight
+                # one) would pace a low-weight tenant's IDLE model at
+                # one request per ~(weight ratio) 1 ms sleeps — a
+                # non-work-conserving scheduler.
+                spendable = {id(t) for t in active
+                             if self._head_capacity(t)}
+                earn_max = max(self.quantum * t.cfg.weight
+                               for t in active
+                               if not spendable or id(t) in spendable)
+                scale = 1.0 / earn_max if earn_max < 1.0 else 1.0
+                for state in active:
+                    if id(state) not in spendable:
+                        continue
+                    # Credit is capped (bounded burst after a partial
+                    # pass) but never below one request.
+                    earn = self.quantum * state.cfg.weight * scale
+                    state.deficit = min(state.deficit + earn,
+                                        max(1.0, 8.0 * earn))
+                    while state.queue and state.deficit >= 1.0:
+                        if not self._head_capacity(state):
+                            break  # head-of-line within ONE tenant
+                        req = state.queue.popleft()
+                        state.deficit -= 1.0
+                        req.t_dispatch = time.monotonic()
+                        self._inflight[req.model] = \
+                            self._inflight.get(req.model, 0) + 1
+                        to_send.append((state, req))
+                    if not state.queue:
+                        state.deficit = 0.0  # classic DRR reset
+                if not to_send:
+                    # the only heads with capacity belong to tenants
+                    # still accruing toward a whole credit: yield
+                    # briefly instead of hot-spinning the GIL away
+                    # from the batcher threads
+                    self._cv.wait(timeout=0.001)
+            for state, req in to_send:
+                self._forward(state, req)
+
+    def _forward(self, state: _TenantState, req: _Routed):
+        """Hand one request to its model server (outside the router
+        lock — server submit takes the server's own lock). A quiesced
+        or freshly-closed server means a hot swap is mid-flight:
+        re-resolve the alias and retry — on a HELPER thread, so the
+        dispatch loop never sleeps and other tenants'/models'
+        forwards are not head-of-line blocked behind one swap."""
+        if self._try_forward(state, req):
+            return
+        threading.Thread(target=self._retry_forward,
+                         args=(state, req), daemon=True).start()
+
+    def _try_forward(self, state: _TenantState, req: _Routed) -> bool:
+        """One forward attempt. True = request handled (forwarded or
+        terminally failed); False = the server was quiescing/closed
+        (typed, never matched on message text) and the caller should
+        retry after re-resolving the alias."""
+        try:
+            handle = self._registry.get(req.model)
+        except KeyError as e:
+            self._finish_error(state, req, e)
+            return True
+        try:
+            inner = handle.submit(req.payload)
+        except (ServerQuiesced, ServerClosed):
+            return False
+        except BaseException as e:
+            self._finish_error(state, req, e)
+            return True
+        inner.add_done_callback(
+            lambda f, s=state, r=req: self._on_done(s, r, f))
+        return True
+
+    def _retry_forward(self, state: _TenantState, req: _Routed):
+        for _attempt in range(50):
+            time.sleep(0.002)
+            if self._try_forward(state, req):
+                return
+        self._finish_error(state, req, RuntimeError(
+            f"model {req.model!r} unavailable (still quiescing "
+            f"after retries)"))
+
+    def _on_done(self, state: _TenantState, req: _Routed, inner):
+        now = time.monotonic()
+        exc = inner.exception()
+        with self._cv:
+            # stats BEFORE fulfilment (a caller unblocked by the
+            # result must see its own completion in stats — the
+            # serving layer's convention)
+            if exc is None:
+                state.completed += 1
+                lat = (now - req.t_submit) * 1e3
+                state.latencies.append(lat)
+                state.ttft.append(lat)
+                if req.t_dispatch is not None:
+                    state.queue_ms.append(
+                        (req.t_dispatch - req.t_submit) * 1e3)
+                target = state.cfg.target_p99_ms
+                if target is not None and lat > target:
+                    state.slo_violations += 1
+            else:
+                state.failed += 1
+        # fulfilment BEFORE the inflight decrement: drain() claims
+        # "every forwarded request has completed", which must imply
+        # the reply futures are already fulfilled when it returns.
+        # try/finally because a caller that timed out may have
+        # cancel()led the reply (it is never marked running, so
+        # cancel succeeds) — set_result then raises InvalidStateError
+        # and the decrement MUST still run or the model's capacity
+        # leaks permanently.
+        try:
+            if exc is None:
+                req.reply.set_result(inner.result())
+            else:
+                req.reply.set_exception(exc)
+        except futures.InvalidStateError:
+            pass
+        finally:
+            with self._cv:
+                self._inflight[req.model] -= 1
+                self._cv.notify_all()
+
+    def _finish_error(self, state: _TenantState, req: _Routed, exc):
+        with self._cv:
+            state.failed += 1
+        # same cancelled-reply + drain contract as _on_done
+        try:
+            req.reply.set_exception(exc)
+        except futures.InvalidStateError:
+            pass
+        finally:
+            with self._cv:
+                self._inflight[req.model] -= 1
+                self._cv.notify_all()
+
+    # --- observability ------------------------------------------------
+    def inflight(self, alias: str) -> int:
+        with self._cv:
+            return self._inflight.get(alias, 0)
+
+    def stats(self, reset: bool = False) -> dict:
+        """Per-tenant snapshot (atomic under the router lock; same
+        reset/window semantics as the servers' stats)."""
+        with self._cv:
+            now = time.monotonic()
+            out = {
+                "uptime_s": round(now - self._t_start, 3),
+                "window_s": round(now - self._t_window, 3),
+                "tenants": {},
+            }
+            for name, st in self._tenants.items():
+                cfg = st.cfg
+                out["tenants"][name] = {
+                    "weight": cfg.weight,
+                    "rate": cfg.rate,
+                    "target_p99_ms": cfg.target_p99_ms,
+                    "queue_depth": len(st.queue),
+                    "admitted": st.admitted,
+                    "rejected": {"rate-limited": st.rejected_rate,
+                                 "queue-full": st.rejected_queue},
+                    "completed": st.completed,
+                    "failed": st.failed,
+                    "slo_violations": st.slo_violations,
+                    "queue_ms": _pct_dict(st.queue_ms),
+                    "latency_ms": _pct_dict(st.latencies),
+                    "ttft_ms": _pct_dict(st.ttft),
+                }
+                if reset:
+                    st.admitted = st.rejected_rate = 0
+                    st.rejected_queue = st.completed = 0
+                    st.failed = st.slo_violations = 0
+                    st.latencies.clear()
+                    st.queue_ms.clear()
+                    st.ttft.clear()
+            if reset:
+                self._t_window = now
+            return out

@@ -1,0 +1,100 @@
+"""RuntimeStats: one JSON snapshot for the whole serving process.
+
+Serving perf work is unverifiable without observability (the serving
+layer's rule since r7); a MULTI-model process additionally needs the
+cross-cutting view no single server owns: which tenant is burning the
+box, which model's executables are getting evicted, whether the disk
+compile cache is absorbing swap churn. ``collect()`` joins
+
+* the Router's per-tenant surface (admission/rejection counts,
+  queue-time + latency + TTFT percentiles, SLO violations),
+* every loaded model's server stats (the r10 TTFT / occupancy /
+  per-token metrics, per-executor compile/hit/disk-load counts),
+* cache pressure: the shared in-memory ``ExecutableCache`` (size vs
+  capacity, inserts, evictions), summed per-model executor counters,
+  and the on-disk compile cache (hits/stores/prunes + entry/byte
+  usage) when FLAGS enable it,
+* registry state (loaded aliases -> fingerprints, swap/retire
+  counts),
+
+into one dict; ``to_json()`` is the ``/stats``-endpoint-shaped
+serialization. ``reset=True`` propagates the servers'/router's
+atomic window-reset semantics so a poller gets per-window rates.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+__all__ = ["RuntimeStats"]
+
+
+class RuntimeStats:
+    """One JSON snapshot over the whole runtime: per-tenant latency/
+    TTFT/SLO counters (router), per-model server stats, and cache
+    pressure (executable LRU + disk compile cache). No direct
+    reference counterpart: the reference stops at per-predictor
+    profiling (inference/api/analysis_predictor.cc:832); the
+    cross-model, cross-tenant aggregation exists because one process
+    here owns a model zoo."""
+
+    def __init__(self, registry, router):
+        self._registry = registry
+        self._router = router
+        self._t_start = time.monotonic()
+        # disk_usage() walks + stats the whole cache dir — memoized
+        # so a 1 Hz /stats poller doesn't pay an ever-growing
+        # directory walk per poll (counters stay per-call fresh)
+        self._disk_usage_memo = (0.0, None)
+        self._disk_usage_ttl = 5.0
+
+    def collect(self, reset: bool = False) -> dict:
+        registry, router = self._registry, self._router
+        models = {}
+        seen_exes = {}
+        for alias, handle in sorted(registry.aliases().items()):
+            server_stats = handle.stats(reset=reset)
+            models[alias] = {
+                "fingerprint": handle.fingerprint[:16],
+                "kind": handle.kind,
+                "max_inflight": handle.max_inflight,
+                "inflight": router.inflight(alias),
+                **server_stats,
+            }
+            exe = handle.executor
+            seen_exes[id(exe)] = exe
+        compiles = sum(e.compile_count for e in seen_exes.values())
+        hits = sum(e.cache_hit_count for e in seen_exes.values())
+        disk_loads = sum(e.disk_load_count for e in seen_exes.values())
+
+        from ...core.compile_cache import active_cache
+
+        dcache = active_cache()
+        disk = None
+        if dcache is not None:
+            disk = dict(dcache.stats())
+            t_now = time.monotonic()
+            t_snap, usage = self._disk_usage_memo
+            if usage is None or t_now - t_snap > self._disk_usage_ttl:
+                usage = dcache.disk_usage()
+                self._disk_usage_memo = (t_now, usage)
+            disk.update(usage)
+
+        rstats = router.stats(reset=reset)
+        return {
+            "uptime_s": round(time.monotonic() - self._t_start, 3),
+            "tenants": rstats["tenants"],
+            "models": models,
+            "registry": registry.stats(),
+            "cache": {
+                "executable": registry.cache.stats(),
+                "compile_count": compiles,
+                "cache_hit_count": hits,
+                "disk_load_count": disk_loads,
+                "disk": disk,
+            },
+        }
+
+    def to_json(self, reset: bool = False, indent=None) -> str:
+        return json.dumps(self.collect(reset=reset), indent=indent,
+                          sort_keys=True)

@@ -1,0 +1,96 @@
+"""Runtime model zoo: the programs the multi-tenant bench/tests serve.
+
+One builder (shared by ``bench.py multitenant``, tests/test_runtime.py
+and the analysis lint zoo in analysis/targets.py) so the exact
+programs the runtime serves are the programs that get linted —
+the targets.py discipline applied to the serving runtime.
+
+Parameters are EXPLICITLY named with a per-model prefix (the PTA050
+rule): co-resident models must never collide on auto-generated
+``fc_N.w_M`` names, and distinct prefixes are what makes the PTA100
+cross-model collision check pass trivially for this zoo.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+__all__ = ["build_fc_program", "make_fc_server", "DEFAULT_ZOO"]
+
+# (prefix, in_dim, hidden, classes): three distinct fingerprints, the
+# bench's N=3 model zoo. Widths differ so a swapped/mis-routed
+# executable is a SHAPE error, never a silent wrong answer.
+DEFAULT_ZOO: List[Tuple[str, int, int, int]] = [
+    ("tiny", 64, 128, 8),
+    ("base", 128, 256, 16),
+    ("large", 256, 512, 32),
+]
+
+
+def build_fc_program(prefix: str, in_dim: int, hidden: int,
+                     classes: int):
+    """fc(in)->relu->fc->softmax classifier (the bench_serving model
+    shape, parameterized): returns (main, startup, feed_names,
+    fetch_names). No direct reference counterpart — a bench/test
+    fixture; params are explicitly ``{prefix}_``-named so co-resident
+    zoo models never collide (PTA100, the reference's per-process
+    predictor isolation made this moot)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name=f"{prefix}_x", shape=[in_dim],
+                              dtype="float32")
+        h = fluid.layers.fc(
+            input=x, size=hidden, act="relu",
+            param_attr=fluid.ParamAttr(name=f"{prefix}_fc1.w"),
+            bias_attr=fluid.ParamAttr(name=f"{prefix}_fc1.b"))
+        out = fluid.layers.fc(
+            input=h, size=classes, act="softmax",
+            param_attr=fluid.ParamAttr(name=f"{prefix}_fc2.w"),
+            bias_attr=fluid.ParamAttr(name=f"{prefix}_fc2.b"))
+    return main, startup, [f"{prefix}_x"], [out.name]
+
+
+def make_fc_server(prefix: str, in_dim: int, hidden: int, classes: int,
+                   executor, scope=None,
+                   max_batch_size: int = 16,
+                   max_wait_ms: float = 2.0,
+                   allow_existing: bool = False,
+                   **server_kwargs):
+    """Build + init one zoo model in its OWN scope and wrap it in an
+    InferenceServer over the given (registry-shared) executor.
+    Returns (server, scope). No direct reference counterpart: the
+    closest shape is one inference/api/analysis_predictor.cc:78 Init
+    per model — here N of these share one executor/executable cache.
+
+    Passing an EXISTING scope that already holds any of the new
+    program's persistable names is refused BEFORE the startup program
+    runs (the ModelRegistry's PTA100 load guard fires only at load —
+    too late, since running startup into the shared scope is itself
+    the clobber). ``allow_existing=True`` opts into an intentional
+    re-init of the same names (same-model weight reset)."""
+    from ...core.scope import Scope
+    from ..serving import InferenceServer, ProgramRunner
+
+    scope_provided = scope is not None
+    scope = scope if scope is not None else Scope()
+    main, startup, feeds, fetches = build_fc_program(
+        prefix, in_dim, hidden, classes)
+    if scope_provided and not allow_existing:
+        clobber = sorted(v.name for v in main.list_vars()
+                         if getattr(v, "persistable", False)
+                         and scope._get(v.name) is not None)
+        if clobber:
+            raise RuntimeError(
+                f"refusing to build model {prefix!r} into a scope "
+                f"already holding persistable var(s) "
+                f"{clobber[:4]}: running its startup program would "
+                f"clobber another model's weights (PTA100). Build "
+                f"each model in its own scope, or pass "
+                f"allow_existing=True for an intentional re-init.")
+    executor.run(startup, scope=scope)
+    runner = ProgramRunner(main, feeds, fetches, executor=executor,
+                           scope=scope)
+    server = InferenceServer(runner, max_batch_size=max_batch_size,
+                             max_wait_ms=max_wait_ms, **server_kwargs)
+    return server, scope

@@ -118,6 +118,43 @@ def _pad_axis(arr: np.ndarray, axis: int, size: int) -> np.ndarray:
 _Reply = futures.Future
 
 
+class ServerQuiesced(RuntimeError):
+    """submit() hit a server that stopped ACCEPTING but is still
+    draining its queue (ModelRegistry hot swap: quiesce -> drain ->
+    close). Distinct from ServerClosed so routing layers can
+    re-resolve the model alias and retry instead of failing the
+    request. No direct reference counterpart: the reference swaps
+    models by restarting predictor processes, so it never needs an
+    accepting/draining distinction."""
+
+
+class ServerClosed(RuntimeError):
+    """submit() hit a server whose close() already ran. Typed (not a
+    bare RuntimeError) so the Router's swap-transparency retry can
+    catch it by TYPE — matching on message substrings would silently
+    retry unrelated errors. No direct reference counterpart (see
+    ServerQuiesced)."""
+
+
+def _call_scheduling_hook(server, hook, arg, hook_name, fallback):
+    """Run a pluggable queue-selection hook; on ANY exception warn
+    ONCE per server (the `_hook_warned` latch) and return (False,
+    None) so the caller falls back to its default policy. A sane
+    call that returns an invalid pick is the CALLER's check — a hook
+    may legitimately decline — and falls back silently."""
+    try:
+        return True, hook(arg)
+    except Exception as e:
+        if not server._hook_warned:
+            server._hook_warned = True
+            import warnings
+
+            warnings.warn(
+                f"{hook_name} hook failed ({type(e).__name__}: {e}); "
+                f"falling back to {fallback} for this server")
+        return False, None
+
+
 def _pct(sorted_vals, p):
     """Nearest-rank percentile over an ascending list (ceil(p*N)-1:
     int(p*N) overshoots — p50 of 2 samples must be the 1st, not the
@@ -218,6 +255,7 @@ class InferenceServer:
                  max_wait_ms: Optional[float] = None,
                  batch_buckets: Optional[Sequence[int]] = None,
                  seq_buckets: Optional[Sequence[int]] = None,
+                 select_group=None,
                  start: bool = True):
         # precedence: explicit constructor args > the predictor
         # config's enable_dynamic_batching knobs > built-in defaults
@@ -261,7 +299,18 @@ class InferenceServer:
         # arrival order; dict preserves group creation order)
         self._groups: Dict[tuple, collections.deque] = {}
         self._running = False
+        self._closed = False     # close() called: reject everything
+        self._accepting = True   # quiesce() flips; drain/close path
+        self._inflight = 0       # batches handed to the runner
         self._thread: Optional[threading.Thread] = None
+        # pluggable queue selection: callable(groups) -> group key,
+        # where `groups` maps key -> tuple of queued requests (each
+        # with .rows and .t_arrival). Called under the server lock —
+        # it must be fast and must NOT call back into the server.
+        # None / a bad return / an exception fall back to the default
+        # oldest-request-first policy.
+        self._select_group_hook = select_group
+        self._hook_warned = False
 
         # observability counters (under _cv)
         self._n_requests = 0
@@ -281,6 +330,8 @@ class InferenceServer:
         self._t_first_arrival = None
         self._t_last_done = None
         self._warmed_compiles = 0
+        self._t_start = time.monotonic()   # monotonic uptime anchor
+        self._t_window = self._t_start     # stats(reset=True) window
 
         if start:
             self.start()
@@ -291,20 +342,52 @@ class InferenceServer:
             if self._running:
                 return
             self._running = True
+            # an explicit restart after close() re-opens the server
+            # (pre-lifecycle behavior: submit gated on _running only)
+            self._closed = False
+            self._accepting = True
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def quiesce(self):
+        """Stop ACCEPTING new requests (submit raises ServerQuiesced)
+        while the batcher keeps draining queued + in-flight work — the
+        hot-swap half of close(). Idempotent."""
+        with self._cv:
+            self._accepting = False
+
+    def drain(self, timeout: Optional[float] = 60.0) -> bool:
+        """Block until every queued request has been dispatched AND
+        every in-flight batch has completed (their futures fulfilled).
+        True on fully drained, False on timeout. Usually preceded by
+        quiesce() so the queue cannot refill behind the wait."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cv:
+            while self._running and (
+                    any(self._groups.values()) or self._inflight):
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+            return not (any(self._groups.values()) or self._inflight)
 
     def close(self, timeout: float = 5.0):
         """Stop the batcher; pending requests are failed, not dropped
         silently."""
         with self._cv:
             self._running = False
+            self._closed = True
+            self._accepting = False
             pending = [r for grp in self._groups.values() for r in grp]
             self._groups.clear()
             self._cv.notify_all()
         for r in pending:
             r.reply.set_exception(
-                RuntimeError("InferenceServer closed"))
+                ServerClosed("InferenceServer closed"))
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
@@ -338,8 +421,15 @@ class InferenceServer:
         reply = _Reply()
         req = _Request(feed, rows, reply)
         with self._cv:
-            if not self._running:
-                raise RuntimeError("InferenceServer is closed")
+            # not-yet-started servers QUEUE (start() drains them);
+            # only closed/quiesced ones reject
+            if self._closed:
+                raise ServerClosed("InferenceServer is closed")
+            if not self._accepting:
+                raise ServerQuiesced(
+                    "InferenceServer is quiesced (draining for "
+                    "retire/hot swap); re-resolve the model and "
+                    "retry")
             self._groups.setdefault(key, collections.deque()).append(
                 req)
             self._n_requests += 1
@@ -391,6 +481,19 @@ class InferenceServer:
                 best = key
         return best
 
+    def _pick_group(self):
+        """Next group to dispatch: the pluggable hook when set (and
+        sane), else oldest-request-first. Called under _cv."""
+        hook = self._select_group_hook
+        if hook is not None and any(self._groups.values()):
+            ok, key = _call_scheduling_hook(
+                self, hook,
+                {k: tuple(g) for k, g in self._groups.items() if g},
+                "select_group", "oldest-first")
+            if ok and key in self._groups and self._groups[key]:
+                return key
+        return self._oldest_group()
+
     def _loop(self):
         while True:
             with self._cv:
@@ -398,7 +501,7 @@ class InferenceServer:
                     self._cv.wait()
                 if not self._running:
                     return
-                key = self._oldest_group()
+                key = self._pick_group()
                 grp = self._groups[key]
                 deadline = grp[0].t_arrival + self.max_wait_ms / 1e3
                 while self._running:
@@ -422,8 +525,15 @@ class InferenceServer:
                     taken += r.rows
                 if not grp:
                     del self._groups[key]
+                if batch:
+                    self._inflight += 1  # drain() waits on this
             if batch:
-                self._dispatch(batch, taken)
+                try:
+                    self._dispatch(batch, taken)
+                finally:
+                    with self._cv:
+                        self._inflight -= 1
+                        self._cv.notify_all()
 
     def _dispatch(self, batch: List[_Request], rows: int):
         bucket = _bucket_for(rows, self.batch_buckets, "batch rows")
@@ -550,9 +660,19 @@ class InferenceServer:
         return self._warmed_compiles
 
     # --- observability ------------------------------------------------
-    def stats(self) -> dict:
+    def stats(self, reset: bool = False) -> dict:
+        """Atomic snapshot of the serving counters. With reset=True
+        the WINDOW counters (requests/batches/latency deques/...) are
+        zeroed under the same lock the batcher thread updates them
+        with, so an aggregator polling stats(reset=True) computes
+        per-window rates without racing in-flight updates. `uptime_s`
+        is monotonic since server start (never reset); `window_s` is
+        the span the returned counters cover. Executor counters
+        (compile/cache) are cumulative by design — delta them across
+        snapshots."""
         exe = self._runner.executor
         with self._cv:
+            now = time.monotonic()
             depth = sum(len(g) for g in self._groups.values())
             occ = (self._n_rows / self._n_padded_rows
                    if self._n_padded_rows else None)
@@ -560,7 +680,7 @@ class InferenceServer:
                 self._t_last_done - self._t_first_arrival
                 if self._t_last_done is not None
                 and self._t_first_arrival is not None else None)
-            return {
+            snap = {
                 "requests": self._n_requests,
                 "completed": self._n_done,
                 "batches": self._n_batches,
@@ -568,6 +688,8 @@ class InferenceServer:
                 "padded_rows": self._n_padded_rows,
                 "batch_occupancy": round(occ, 4) if occ else None,
                 "queue_depth": depth,
+                "uptime_s": round(now - self._t_start, 3),
+                "window_s": round(now - self._t_window, 3),
                 "compile_count": exe.compile_count,
                 "cache_hit_count": exe.cache_hit_count,
                 # warm-start observability: executables rehydrated
@@ -584,6 +706,17 @@ class InferenceServer:
                     round(self._n_done / done_span, 1)
                     if done_span else None),
             }
+            if reset:
+                self._n_requests = self._n_batches = 0
+                self._n_rows = self._n_padded_rows = 0
+                self._n_done = self._n_tokens = 0
+                self._latencies.clear()
+                self._ttft.clear()
+                self._per_token.clear()
+                self._t_first_arrival = None
+                self._t_last_done = None
+                self._t_window = now
+            return snap
 
 
 class GenerationServer(InferenceServer):
@@ -638,8 +771,8 @@ class GenerationServer(InferenceServer):
         buffer length when no EOS fired."""
         return int(count_generated_tokens(rows, self._end_id).sum())
 
-    def stats(self) -> dict:
-        st = super().stats()
+    def stats(self, reset: bool = False) -> dict:
+        st = super().stats(reset=reset)
         # the whole-loop server's "slots" are its padded batch rows
         st["slots"] = self.max_batch_size
         st["slot_occupancy"] = st["batch_occupancy"]
@@ -702,6 +835,7 @@ class ContinuousGenerationServer:
                  steps_per_tick: Optional[int] = None,
                  drain_steps: Optional[int] = None,
                  exit_on_retire: bool = False,
+                 admit_select=None,
                  start: bool = True):
         self.bundle = bundle
         self.executor = executor or Executor(TPUPlace(0))
@@ -753,7 +887,17 @@ class ContinuousGenerationServer:
         self._lanes: List[Optional[_GenRequest]] = \
             [None] * self.n_slots
         self._running = False
+        self._closed = False    # close() called: reject everything
+        self._accepting = True  # quiesce() flips
+        self._busy = False      # a fused cycle is mid-dispatch
         self._thread: Optional[threading.Thread] = None
+        # pluggable admission selection: callable(queue) -> index of
+        # the request to admit next, where `queue` is a tuple of
+        # pending _GenRequest (each with .t_arrival/.src). Called
+        # under the server lock; bad values / exceptions fall back to
+        # FIFO (index 0).
+        self._admit_select = admit_select
+        self._hook_warned = False
 
         # observability (under _cv)
         self._n_requests = 0
@@ -766,6 +910,8 @@ class ContinuousGenerationServer:
         self._per_token = collections.deque(maxlen=4096)
         self._t_first_arrival = None
         self._t_last_done = None
+        self._t_start = time.monotonic()
+        self._t_window = self._t_start
 
         if start:
             self.start()
@@ -776,12 +922,47 @@ class ContinuousGenerationServer:
             if self._running:
                 return
             self._running = True
+            # an explicit restart after close() re-opens the server
+            # (pre-lifecycle behavior: submit gated on _running only)
+            self._closed = False
+            self._accepting = True
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    def quiesce(self):
+        """Stop ACCEPTING (submit raises ServerQuiesced); the
+        scheduler keeps running queued prompts and live lanes to
+        completion — the hot-swap half of close(). Idempotent."""
+        with self._cv:
+            self._accepting = False
+
+    def drain(self, timeout: Optional[float] = 60.0) -> bool:
+        """Block until the queue is empty, every lane has retired, and
+        no fused cycle is mid-dispatch. True on drained, False on
+        timeout. Pair with quiesce() so arrivals cannot refill the
+        pool behind the wait."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cv:
+            def dirty():
+                return (self._queue or self._busy
+                        or any(l is not None for l in self._lanes))
+
+            while self._running and dirty():
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+            return not dirty()
 
     def close(self, timeout: float = 5.0):
         with self._cv:
             self._running = False
+            self._closed = True
+            self._accepting = False
             pending = list(self._queue)
             self._queue.clear()
             pending += [r for r in self._lanes if r is not None]
@@ -789,7 +970,7 @@ class ContinuousGenerationServer:
             self._cv.notify_all()
         for r in pending:
             r.reply.set_exception(
-                RuntimeError("ContinuousGenerationServer closed"))
+                ServerClosed("ContinuousGenerationServer closed"))
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
@@ -812,9 +993,14 @@ class ContinuousGenerationServer:
                 f"shape {tuple(np.asarray(src_ids).shape)}")
         req = _GenRequest(arr.astype(np.int64), _Reply())
         with self._cv:
-            if not self._running:
-                raise RuntimeError(
+            if self._closed:
+                raise ServerClosed(
                     "ContinuousGenerationServer is closed")
+            if not self._accepting:
+                raise ServerQuiesced(
+                    "ContinuousGenerationServer is quiesced "
+                    "(draining for retire/hot swap); re-resolve the "
+                    "model and retry")
             self._queue.append(req)
             self._n_requests += 1
             if self._t_first_arrival is None:
@@ -829,6 +1015,27 @@ class ContinuousGenerationServer:
         return self.submit(src_ids).result(timeout)
 
     # --- scheduler ----------------------------------------------------
+    def _pop_next(self):
+        """Next queued request to admit: FIFO, or the pluggable
+        admit_select hook's pick (index into the queue snapshot).
+        Called under _cv with a non-empty queue."""
+        hook = self._admit_select
+        idx = 0
+        if hook is not None and len(self._queue) > 1:
+            # int() failure counts as a hook failure (warned), an
+            # out-of-range index as a silent decline
+            ok, raw = _call_scheduling_hook(
+                self, lambda q: int(hook(q)), tuple(self._queue),
+                "admit_select", "FIFO admission")
+            if ok and 0 <= raw < len(self._queue):
+                idx = raw
+        if idx == 0:
+            return self._queue.popleft()
+        self._queue.rotate(-idx)
+        req = self._queue.popleft()
+        self._queue.rotate(idx)
+        return req
+
     def _loop(self):
         while True:
             with self._cv:
@@ -838,29 +1045,38 @@ class ContinuousGenerationServer:
                 if not self._running:
                     return
                 # FIFO admission into free slots (arrival order is the
-                # fairness contract; slots assigned lowest-index-first;
-                # at most the largest admission bucket per cycle — a
-                # custom admit_buckets ladder may cover less than
-                # n_slots, and the overflow simply waits one cycle)
+                # fairness contract, admit_select the pluggable
+                # override; slots assigned lowest-index-first; at most
+                # the largest admission bucket per cycle — a custom
+                # admit_buckets ladder may cover less than n_slots,
+                # and the overflow simply waits one cycle)
                 admits = []
                 for slot in range(self.n_slots):
                     if not self._queue \
                             or len(admits) >= self._admit_buckets[-1]:
                         break
                     if self._lanes[slot] is None:
-                        req = self._queue.popleft()
+                        req = self._pop_next()
                         self._lanes[slot] = req
                         admits.append((slot, req))
                 occupied = sum(l is not None for l in self._lanes)
                 drain = not self._queue
+                if admits or occupied:
+                    self._busy = True  # drain() waits on this
             if admits or occupied:
                 # empty queue: let the burst run — the device loop
                 # exits by itself once the pool drains
-                self._cycle(admits,
-                            self.drain_steps if drain
-                            else self.steps_per_tick,
-                            occupied - 1 if (self.exit_on_retire
-                                             and not drain) else 0)
+                try:
+                    self._cycle(admits,
+                                self.drain_steps if drain
+                                else self.steps_per_tick,
+                                occupied - 1 if (self.exit_on_retire
+                                                 and not drain)
+                                else 0)
+                finally:
+                    with self._cv:
+                        self._busy = False
+                        self._cv.notify_all()
 
     def _cycle(self, admits, n_steps, min_active):
         """ONE fused dispatch per scheduler cycle: admit up to A
@@ -929,16 +1145,20 @@ class ContinuousGenerationServer:
             req.reply.set_result(toks)
 
     # --- observability ------------------------------------------------
-    def stats(self) -> dict:
+    def stats(self, reset: bool = False) -> dict:
+        """Atomic snapshot; reset/uptime semantics identical to
+        InferenceServer.stats (window counters zeroed under the
+        scheduler lock, uptime_s monotonic since start)."""
         exe = self.executor
         with self._cv:
+            now = time.monotonic()
             done_span = (
                 self._t_last_done - self._t_first_arrival
                 if self._t_last_done is not None
                 and self._t_first_arrival is not None else None)
             occ = (self._occ_sum / self._n_ticks
                    if self._n_ticks else None)
-            return {
+            snap = {
                 "requests": self._n_requests,
                 "completed": self._n_done,
                 "queue_depth": len(self._queue),
@@ -946,6 +1166,8 @@ class ContinuousGenerationServer:
                 "slot_occupancy": round(occ, 4) if occ else None,
                 "ticks": self._n_ticks,
                 "steps_per_tick": self.steps_per_tick,
+                "uptime_s": round(now - self._t_start, 3),
+                "window_s": round(now - self._t_window, 3),
                 "compile_count": exe.compile_count,
                 "cache_hit_count": exe.cache_hit_count,
                 "disk_load_count": exe.disk_load_count,
@@ -959,6 +1181,17 @@ class ContinuousGenerationServer:
                     round(self._n_done / done_span, 1)
                     if done_span else None),
             }
+            if reset:
+                self._n_requests = self._n_done = 0
+                self._n_tokens = self._n_ticks = 0
+                self._occ_sum = 0.0
+                self._latencies.clear()
+                self._ttft.clear()
+                self._per_token.clear()
+                self._t_first_arrival = None
+                self._t_last_done = None
+                self._t_window = now
+            return snap
 
 
 def count_generated_tokens(tokens: np.ndarray,
@@ -1000,5 +1233,5 @@ def apply_eos_sentinel(tokens: np.ndarray,
 
 __all__ = ["InferenceServer", "GenerationServer",
            "ContinuousGenerationServer", "ProgramRunner",
-           "apply_eos_sentinel", "count_generated_tokens",
-           "default_batch_buckets"]
+           "ServerQuiesced", "ServerClosed", "apply_eos_sentinel",
+           "count_generated_tokens", "default_batch_buckets"]

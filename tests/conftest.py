@@ -95,12 +95,15 @@ def _hermetic_compile_cache(tmp_path):
     from paddle_tpu.core import compile_cache as cc
     from paddle_tpu.flags import FLAGS
 
-    saved_mode = FLAGS._values["compile_cache"]
-    saved_dir = FLAGS._values["compile_cache_dir"]
+    saved = {k: FLAGS._values[k]
+             for k in ("compile_cache", "compile_cache_dir",
+                       "compile_cache_max_entries",
+                       "compile_cache_max_bytes")}
     FLAGS._values["compile_cache"] = "off"
     FLAGS._values["compile_cache_dir"] = str(tmp_path / "ptp_cache")
+    FLAGS._values["compile_cache_max_entries"] = 0
+    FLAGS._values["compile_cache_max_bytes"] = 0
     cc._CACHES.clear()
     yield
-    FLAGS._values["compile_cache"] = saved_mode
-    FLAGS._values["compile_cache_dir"] = saved_dir
+    FLAGS._values.update(saved)
     cc._CACHES.clear()

@@ -400,6 +400,85 @@ class TestParamNaming:
 
 
 # ---------------------------------------------------------------------------
+# PTA100 cross-model param collision (co-resident serving runtime)
+# ---------------------------------------------------------------------------
+class TestCrossModelCollision:
+    def _prog_with_param(self, name, shape):
+        p = fluid.Program()
+        p.global_block.create_parameter(name=name, shape=shape,
+                                        dtype="float32")
+        return p
+
+    def test_shape_mismatch_is_error(self):
+        from paddle_tpu.analysis import check_cross_model_collision
+
+        a = self._prog_with_param("proj.w", [4, 4])
+        b = self._prog_with_param("proj.w", [8, 4])
+        ds = check_cross_model_collision(a, b)
+        assert ds and ds[0].code == "PTA100" \
+            and ds[0].severity == ERROR
+
+    def test_same_shape_alias_is_error_unlike_pta051(self):
+        """The intent inversion vs PTA051: for UNRELATED co-resident
+        models, an explicit shared name at the same shape is silent
+        weight aliasing — the WORSE defect (wrong answers, no error
+        anywhere), so it is ERROR severity like the loud shape
+        mismatch; check_shared_params stays silent on the same
+        pair."""
+        from paddle_tpu.analysis import check_cross_model_collision
+
+        a = self._prog_with_param("proj.w", [4, 4])
+        b = self._prog_with_param("proj.w", [4, 4])
+        ds = check_cross_model_collision(a, b)
+        assert ds and ds[0].code == "PTA100" \
+            and ds[0].severity == ERROR
+        assert check_shared_params(a, b) == []  # the PTA051 contrast
+
+    def test_prefixed_models_are_clean(self):
+        from paddle_tpu.analysis import check_cross_model_collision
+
+        a = self._prog_with_param("m1_proj.w", [4, 4])
+        b = self._prog_with_param("m2_proj.w", [4, 4])
+        assert check_cross_model_collision(a, b) == []
+
+    def test_non_parameter_persistable_collision_is_error(self):
+        """batch_norm-style running statistics are persistables
+        created OUTSIDE ``_parameters`` (create_global_variable), and
+        two models saved from fresh processes both carry the same
+        auto names — a parameters-only intersection would stay
+        silent on exactly that aliasing."""
+        from paddle_tpu.analysis import check_cross_model_collision
+
+        def prog():
+            p = fluid.Program()
+            p.global_block.create_var(
+                name="batch_norm_0.w_1", shape=[16],
+                dtype="float32", persistable=True)
+            return p
+
+        a, b = prog(), prog()
+        assert not (set(a._parameters) & set(b._parameters))
+        ds = check_cross_model_collision(a, b)
+        assert ds and ds[0].code == "PTA100" \
+            and ds[0].severity == ERROR
+
+    def test_runtime_zoo_is_collision_free(self):
+        """The shipped runtime zoo (distinct per-model prefixes) must
+        be pairwise clean — the property the analysis target pins."""
+        from paddle_tpu.analysis import check_cross_model_collision
+        from paddle_tpu.inference.runtime import zoo
+
+        progs = []
+        for prefix, i, h, c in zoo.DEFAULT_ZOO:
+            main, _startup, _f, _o = zoo.build_fc_program(
+                prefix, i, h, c)
+            progs.append(main)
+        for i, a in enumerate(progs):
+            for b in progs[i + 1:]:
+                assert check_cross_model_collision(a, b) == []
+
+
+# ---------------------------------------------------------------------------
 # PTA060 @SEQ_LEN companion batch consistency
 # ---------------------------------------------------------------------------
 class TestSeqLenCompanion:

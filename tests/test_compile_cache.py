@@ -249,6 +249,121 @@ class TestDiskRoundTrip:
             cc._FORCE_STABLEHLO[0] = False
 
 
+class TestDiskGC:
+    """FLAGS_compile_cache_max_entries/_max_bytes: LRU-by-mtime
+    pruning on write — the bound multi-model swap churn needs (the
+    runtime loads/retires fingerprints; without GC the cache dir
+    grows forever)."""
+
+    def _serve_shapes(self, prog, startup, loss, shapes):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        for b in shapes:
+            exe.run(prog, feed={"x": np.ones((b, 4), np.float32)},
+                    fetch_list=[loss])
+        return exe
+
+    def test_prune_on_write_bounds_entries_and_counts(self, tmp_path):
+        import time as _time
+
+        _enable(tmp_path)
+        _fresh()
+        prog, startup, loss = _build()
+        # startup + one block entry per feed shape land on disk
+        self._serve_shapes(prog, startup, loss, (1, 2, 4))
+        cache = cc.active_cache()
+        n0 = cache.disk_usage()["entries"]
+        assert n0 >= 4  # startup + 3 shapes
+        # age every existing entry so mtime ordering is unambiguous
+        # (sub-second writes can tie)
+        now = _time.time()
+        for i, (path, _m, _s) in enumerate(sorted(cache._entries())):
+            os.utime(path, (now - 1000 + i, now - 1000 + i))
+        set_flags({"FLAGS_compile_cache_max_entries": n0 - 1})
+        self._serve_shapes(prog, startup, loss, (8,))  # + 1 store
+        assert cache.disk_usage()["entries"] == n0 - 1
+        assert cache.prune_count >= 1
+        assert cache.stats()["prunes"] == cache.prune_count
+
+    def test_byte_bound_prunes_oldest_first(self, tmp_path):
+        import time as _time
+
+        _enable(tmp_path)
+        _fresh()
+        prog, startup, loss = _build()
+        self._serve_shapes(prog, startup, loss, (1, 2))
+        cache = cc.active_cache()
+        usage = cache.disk_usage()
+        now = _time.time()
+        for i, (path, _m, _s) in enumerate(sorted(cache._entries())):
+            os.utime(path, (now - 1000 + i, now - 1000 + i))
+        # bound at the CURRENT total: the next write overflows it and
+        # must shed the oldest entries until back under
+        set_flags({"FLAGS_compile_cache_max_bytes":
+                   int(usage["bytes"])})
+        self._serve_shapes(prog, startup, loss, (4,))
+        assert cache.disk_usage()["bytes"] <= usage["bytes"]
+        assert cache.prune_count >= 1
+
+    def test_load_refreshes_mtime_so_hot_entries_survive(
+            self, tmp_path):
+        """An entry a process warm-started from recently must NOT be
+        the one GC sheds: load refreshes mtime (LRU, not FIFO)."""
+        import time as _time
+
+        _enable(tmp_path)
+        _fresh()
+        prog, startup, loss = _build()
+        self._serve_shapes(prog, startup, loss, (2,))
+        cache = cc.active_cache()
+        before = {p for p, _m, _s in cache._entries()}
+        self._serve_shapes(prog, startup, loss, (4,))
+        (path_b,) = [p for p, _m, _s in cache._entries()
+                     if p not in before]        # the shape-4 entry
+        now = _time.time()
+        for p, _m, _s in cache._entries():
+            # everything old; the shape-4 entry the YOUNGEST cold one
+            os.utime(p, (now - 1000, now - 1000))
+        os.utime(path_b, (now - 500, now - 500))
+        # disk-load shape 2 in a FRESH executor (private in-memory
+        # cache -> forced to the disk path): refreshes the mtimes of
+        # everything it rehydrates (startup + shape-2), leaving
+        # path_b the LRU entry
+        exe2 = self._serve_shapes(prog, startup, loss, (2,))
+        assert exe2.compile_count == 0 and exe2.disk_load_count > 0
+        n = cache.disk_usage()["entries"]
+        set_flags({"FLAGS_compile_cache_max_entries": n})
+        self._serve_shapes(prog, startup, loss, (8,))  # overflow by 1
+        assert not os.path.exists(path_b), \
+            "the cold entry should have been pruned first (LRU)"
+        assert cache.disk_usage()["entries"] == n
+
+    def test_prune_sweeps_stale_tmp_debris(self, tmp_path):
+        """A writer killed between mkstemp and os.replace leaves a
+        .tmp the entry walk never counts; _prune must sweep stale
+        ones (crash debris) but leave recent ones (live writers)."""
+        import time as _time
+
+        _enable(tmp_path)
+        _fresh()
+        prog, startup, loss = _build()
+        self._serve_shapes(prog, startup, loss, (1,))
+        cache = cc.active_cache()
+        sub = os.path.dirname(cache._entries()[0][0])
+        stale = os.path.join(sub, "dead-writer-a.tmp")
+        fresh = os.path.join(sub, "live-writer-b.tmp")
+        for p in (stale, fresh):
+            with open(p, "wb") as f:
+                f.write(b"x" * 128)
+        now = _time.time()
+        os.utime(stale, (now - 3600, now - 3600))
+        set_flags({"FLAGS_compile_cache_max_entries": 64})
+        self._serve_shapes(prog, startup, loss, (2,))  # triggers prune
+        assert not os.path.exists(stale), "crash debris must be swept"
+        assert os.path.exists(fresh), \
+            "a recent .tmp may be a live concurrent writer"
+
+
 class TestExecutableCacheLRU:
     def test_capacity_bound_and_eviction_counter(self):
         _fresh()

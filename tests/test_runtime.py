@@ -1,0 +1,584 @@
+"""Multi-tenant serving runtime (paddle_tpu/inference/runtime).
+
+Covers the runtime's four contracts:
+
+* **Registry / hot swap** — fingerprint-keyed load, clone-by-
+  fingerprint dedupe, and the zero-loss swap: a mid-traffic alias
+  flip loses NO accepted request and steady-state traffic after the
+  new model's warm compiles NOTHING.
+* **Isolation** — PTA100 scope-collision refusal at load, and the
+  noisy-neighbor guarantee: a tenant flooding the shared model must
+  not starve a small tenant (weighted deficit round-robin bounds the
+  small tenant's p99 well under the flood's).
+* **Admission** — token-bucket and queue-bound rejections are NAMED
+  (AdmissionError.reason), synchronous at submit.
+* **Observability** — stats_json() is one parseable snapshot with
+  per-tenant latency/TTFT/queue-time, per-model server stats, and
+  cache pressure; the shared executable cache stays within the
+  N x (buckets + 1) bound.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import AnalysisConfig, create_paddle_predictor
+from paddle_tpu.inference.runtime import (AdmissionError, ModelRegistry,
+                                          Router, ServingRuntime, zoo)
+from paddle_tpu.inference.serving import ServerQuiesced
+
+
+def _runtime_with_zoo(max_batch_size=8, **rt_kwargs):
+    """A ServingRuntime serving the three-model runtime zoo, warmed."""
+    rt = ServingRuntime(**rt_kwargs)
+    scopes = {}
+    for prefix, in_dim, hidden, classes in zoo.DEFAULT_ZOO:
+        server, scope = zoo.make_fc_server(
+            prefix, in_dim, hidden, classes, executor=rt.executor(),
+            max_batch_size=max_batch_size, max_wait_ms=1.0)
+        rt.load_model(prefix, server)
+        scopes[prefix] = scope
+    return rt, scopes
+
+
+def _req(prefix, rng, rows=1):
+    dims = {p: d for p, d, _h, _c in zoo.DEFAULT_ZOO}
+    return {f"{prefix}_x": rng.randn(rows, dims[prefix]).astype(
+        np.float32)}
+
+
+class TestRegistry:
+    def test_load_get_and_fingerprints(self):
+        rt, _ = _runtime_with_zoo()
+        try:
+            handles = rt.registry.aliases()
+            assert sorted(handles) == ["base", "large", "tiny"]
+            # three distinct programs -> three distinct fingerprints
+            fps = {h.fingerprint for h in handles.values()}
+            assert len(fps) == 3
+            assert rt.registry.get("tiny") is handles["tiny"]
+            with pytest.raises(KeyError, match="no model loaded"):
+                rt.registry.get("nope")
+        finally:
+            rt.close()
+
+    def test_scope_collision_refused_pta100(self):
+        """Two models colliding on persistable names in ONE scope are
+        refused TWICE: the zoo builder refuses BEFORE the colliding
+        startup runs (running it is itself the clobber), and the
+        registry's load backstop refuses a colliding server built
+        elsewhere."""
+        rt = ServingRuntime()
+        try:
+            s1, scope = zoo.make_fc_server(
+                "tiny", 64, 128, 8, executor=rt.executor())
+            rt.load_model("a", s1)
+            # build-time precheck: same prefix + same scope refused
+            # pre-startup, so model 'a''s weights stay untouched
+            with pytest.raises(RuntimeError, match="PTA100"):
+                zoo.make_fc_server("tiny", 64, 32, 8,
+                                   executor=rt.executor(), scope=scope)
+            rng = np.random.RandomState(0)
+            out = rt.registry.get("a").submit(
+                _req("tiny", rng)).result(60.0)
+            assert out[0].shape == (1, 8)  # scope uncorrupted
+            # load-time backstop: a colliding server built WITHOUT
+            # the precheck (no startup run) is refused at load
+            from paddle_tpu.inference.serving import (InferenceServer,
+                                                      ProgramRunner)
+            main, _startup, feeds, fetches = zoo.build_fc_program(
+                "tiny", 64, 32, 8)
+            runner = ProgramRunner(main, feeds, fetches,
+                                   executor=rt.executor(), scope=scope)
+            s2 = InferenceServer(runner)
+            with pytest.raises(RuntimeError, match="PTA100"):
+                rt.load_model("b", s2)
+            s2.close()
+            # distinct scope: same names are fine (isolated)
+            s3, _ = zoo.make_fc_server(
+                "tiny", 64, 32, 8, executor=rt.executor())
+            rt.load_model("b", s3)
+        finally:
+            rt.close()
+
+    def test_load_predictor_dedupes_by_fingerprint(self, tmp_path):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=16, act="relu")
+        out = fluid.layers.fc(input=h, size=4, act="softmax")
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(fluid.default_startup_program())
+        fluid.save_inference_model(str(tmp_path), ["x"], [out], exe)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+
+        registry = ModelRegistry()
+        try:
+            h1 = registry.load_predictor("m", pred, max_batch_size=4)
+            assert h1.fingerprint == pred.fingerprint()
+            # same fingerprint -> no-op (no swap, same handle)
+            h2 = registry.load_predictor("m", pred, max_batch_size=4)
+            assert h2 is h1
+            assert registry.swap_count == 0
+            # force=True -> a real swap even at the same fingerprint
+            h3 = registry.load_predictor("m", pred, max_batch_size=4,
+                                         force=True)
+            assert h3 is not h1
+            assert registry.swap_count == 1
+            out = h3.submit(
+                {"x": np.ones((1, 8), np.float32)}).result(60.0)
+            assert out[0].shape == (1, 4)
+            # same fingerprint but CHANGED serving config -> a config
+            # update, not a silent no-op keeping the old knobs
+            h4 = registry.load_predictor("m", pred, max_batch_size=8,
+                                         max_inflight=16)
+            assert h4 is not h3
+            assert registry.swap_count == 2
+            assert h4.server.max_batch_size == 8
+            assert h4.max_inflight == 16
+            # ...and re-asserting that same config dedupes again
+            h5 = registry.load_predictor("m", pred, max_batch_size=8,
+                                         max_inflight=16)
+            assert h5 is h4
+            assert registry.swap_count == 2
+        finally:
+            registry.close()
+
+
+class TestHotSwap:
+    def test_mid_traffic_swap_zero_loss_zero_steady_compiles(self):
+        """The acceptance contract: flip the alias under live traffic;
+        every accepted request completes (zero loss), and once the new
+        server's warmup is done, traffic compiles NOTHING."""
+        rt = ServingRuntime()
+        try:
+            server, _ = zoo.make_fc_server(
+                "tiny", 64, 128, 8, executor=rt.executor(),
+                max_batch_size=8, max_wait_ms=1.0)
+            h1 = rt.load_model("m", server)
+            rt.add_tenant("t", max_queue=100000)
+            rng = np.random.RandomState(0)
+            replies, stop = [], [False]
+
+            def traffic():
+                while not stop[0]:
+                    replies.append(rt.submit(
+                        "t", "m",
+                        {"tiny_x": rng.randn(1, 64).astype(
+                            np.float32)}))
+                    time.sleep(0.0005)
+
+            th = threading.Thread(target=traffic)
+            th.start()
+            time.sleep(0.2)
+            # different hidden width -> a genuinely NEW fingerprint
+            server2, _ = zoo.make_fc_server(
+                "tiny", 64, 64, 8, executor=rt.executor(),
+                max_batch_size=8, max_wait_ms=1.0)
+            h2 = rt.load_model("m", server2)   # warm -> flip -> drain
+            assert h2.fingerprint != h1.fingerprint
+            # post-swap steady state: zero compiles from here on
+            compiles_after_warm = h2.executor.compile_count
+            time.sleep(0.2)
+            stop[0] = True
+            th.join()
+            outs = [rep.result(60.0) for rep in replies]
+            assert len(outs) == len(replies)   # ZERO accepted lost
+            assert all(o[0].shape == (1, 8) for o in outs)
+            assert h2.executor.compile_count == compiles_after_warm, \
+                "steady-state traffic compiled after the swap warmup"
+            st = rt.stats()
+            assert st["registry"]["swaps"] == 1
+            assert st["registry"]["retired"] == 1
+            assert st["tenants"]["t"]["failed"] == 0
+        finally:
+            rt.close()
+
+
+class TestNoisyNeighborIsolation:
+    def test_flood_does_not_starve_small_tenant(self):
+        """One tenant floods the shared model with 30x the small
+        tenant's traffic. Weighted deficit round-robin must interleave
+        them ~1:1, so the small tenant's p99 stays FAR below the
+        flood's (whose backlog waits in its own queue). FIFO pass-
+        through would put the small tenant's p99 at the flood's."""
+        rt = ServingRuntime()
+        try:
+            server, _ = zoo.make_fc_server(
+                "tiny", 64, 128, 8, executor=rt.executor(),
+                max_batch_size=8, max_wait_ms=1.0)
+            # modest inflight cap so fairness is decided in the
+            # router's queues, not the server's FIFO
+            rt.load_model("m", server, max_inflight=8)
+            rt.add_tenant("noisy", max_queue=100000)
+            rt.add_tenant("small", max_queue=1000,
+                          target_p99_ms=10000.0)
+            rng = np.random.RandomState(1)
+            feed = {"tiny_x": rng.randn(1, 64).astype(np.float32)}
+            noisy = [rt.submit("noisy", "m", dict(feed))
+                     for _ in range(240)]
+            small = [rt.submit("small", "m", dict(feed))
+                     for _ in range(8)]
+            for rep in small + noisy:
+                rep.result(120.0)
+            st = rt.stats()
+            t_small = st["tenants"]["small"]
+            t_noisy = st["tenants"]["noisy"]
+            assert t_small["completed"] == 8
+            assert t_noisy["completed"] == 240
+            assert t_small["latency_ms"]["p99"] <= \
+                0.5 * t_noisy["latency_ms"]["p99"], (
+                    f"small tenant p99 "
+                    f"{t_small['latency_ms']['p99']}ms not isolated "
+                    f"from flood p99 {t_noisy['latency_ms']['p99']}ms")
+        finally:
+            rt.close()
+
+    def test_weights_skew_service_share(self):
+        """weight=3 vs weight=1 on equal backlogs: the heavy tenant's
+        requests finish sooner on average (it earns 3x the deficit
+        credit per pass). Both backlogs are enqueued BEFORE the
+        dispatch loop starts (Router(start=False)) so the share is a
+        property of DRR ordering, not of submission timing vs this
+        host's CPU-throttle stalls."""
+        registry = ModelRegistry()
+        router = Router(registry, start=False)
+        try:
+            server, _ = zoo.make_fc_server(
+                "tiny", 64, 128, 8, executor=registry.executor(),
+                max_batch_size=8, max_wait_ms=1.0)
+            registry.load(server=server, alias="m", max_inflight=8)
+            router.add_tenant("heavy", weight=3.0, max_queue=100000)
+            router.add_tenant("light", weight=1.0, max_queue=100000)
+            rng = np.random.RandomState(2)
+            feed = {"tiny_x": rng.randn(1, 64).astype(np.float32)}
+            h = [router.submit("heavy", "m", dict(feed))
+                 for _ in range(120)]
+            li = [router.submit("light", "m", dict(feed))
+                  for _ in range(120)]
+            router.start()
+            for rep in h + li:
+                rep.result(120.0)
+            st = router.stats()
+            assert st["tenants"]["heavy"]["latency_ms"]["p50"] < \
+                st["tenants"]["light"]["latency_ms"]["p50"]
+        finally:
+            router.close()
+            registry.close()
+
+    def test_fractional_weights_make_progress(self):
+        """Normalized weights (summing to 1, e.g. 0.7/0.1) must serve
+        every tenant: DRR earnings are scaled so the largest-weight
+        backlogged tenant earns a whole credit per pass. Before that
+        normalization, weight=0.1 capped its deficit at 0.8 credits
+        and the tenant's queue starved forever."""
+        rt = ServingRuntime()
+        try:
+            server, _ = zoo.make_fc_server(
+                "tiny", 64, 128, 8, executor=rt.executor(),
+                max_batch_size=8, max_wait_ms=1.0)
+            rt.load_model("m", server, max_inflight=8)
+            rt.add_tenant("big", weight=0.7, max_queue=1000)
+            rt.add_tenant("small", weight=0.1, max_queue=1000)
+            rng = np.random.RandomState(3)
+            feed = {"tiny_x": rng.randn(1, 64).astype(np.float32)}
+            reps = [rt.submit(t, "m", dict(feed))
+                    for _ in range(40) for t in ("big", "small")]
+            for rep in reps:
+                rep.result(60.0)   # raises TimeoutError on starvation
+            st = rt.stats()
+            assert st["tenants"]["small"]["completed"] == 40
+            assert st["tenants"]["small"]["failed"] == 0
+        finally:
+            rt.close()
+
+    def test_blocked_heavy_tenant_does_not_pace_idle_model(self):
+        """Work conservation: a high-weight tenant head-of-line
+        blocked on a saturated model must not set the DRR earning
+        scale for everyone else. Before the fix, normalizing earnings
+        over ALL backlogged tenants meant weight 0.99 (blocked on
+        'slow', max_inflight=1, ~250 ms per request) paced weight
+        0.001's requests to an IDLE model at one per ~990 passes of
+        1 ms sleeps — ~1 request/second against idle hardware. Now
+        blocked tenants neither earn nor key the scale, so the small
+        tenant drains at full speed while the flood is still stuck."""
+        rt = ServingRuntime()
+        try:
+            # 'slow': a lone request sits the full max_wait_ms in the
+            # batcher, so with max_inflight=1 the flood tenant's head
+            # is capacity-blocked ~250 ms per request.
+            slow, _ = zoo.make_fc_server(
+                "tiny", 64, 128, 8, executor=rt.executor(),
+                max_batch_size=8, max_wait_ms=250.0)
+            fast, _ = zoo.make_fc_server(
+                "base", 128, 256, 16, executor=rt.executor(),
+                max_batch_size=8, max_wait_ms=1.0)
+            rt.load_model("slow", slow, max_inflight=1)
+            rt.load_model("fast", fast)
+            rt.add_tenant("flood", weight=0.99, max_queue=1000)
+            rt.add_tenant("small", weight=0.001, max_queue=1000)
+            rng = np.random.RandomState(7)
+            slow_feed = {"tiny_x": rng.randn(1, 64).astype(np.float32)}
+            fast_feed = {"base_x": rng.randn(1, 128).astype(np.float32)}
+            flood_reps = [rt.submit("flood", "slow", dict(slow_feed))
+                          for _ in range(30)]
+            t0 = time.monotonic()
+            small_reps = [rt.submit("small", "fast", dict(fast_feed))
+                          for _ in range(10)]
+            for rep in small_reps:
+                rep.result(30.0)
+            small_wall = time.monotonic() - t0
+            st = rt.stats()
+            # the flood's 30 x ~250 ms backlog must still be draining
+            # when the small tenant finishes — i.e. small was NOT
+            # paced on the flood's blocked time (broken pacing took
+            # ~1 s/request here, outlasting the whole flood drain)
+            assert st["tenants"]["flood"]["completed"] < 30
+            assert small_wall < 6.0, (
+                f"small tenant took {small_wall:.1f}s against an idle "
+                f"model while the flood tenant was head-blocked")
+            for rep in flood_reps:
+                rep.result(60.0)
+        finally:
+            rt.close()
+
+
+class TestAdmission:
+    def test_named_rejections(self):
+        registry = ModelRegistry()
+        # start=False: requests stay queued, so bounds are
+        # deterministic (nothing drains mid-assert)
+        router = Router(registry, start=False)
+        try:
+            server, _ = zoo.make_fc_server(
+                "tiny", 64, 128, 8, executor=registry.executor())
+            registry.load(server=server, alias="m", warm=False)
+            # rate tiny so no whole token refills during the test
+            # even across a multi-second throttle stall on this host
+            router.add_tenant("t", rate=0.001, burst=2.0,
+                              max_queue=10)
+            with pytest.raises(AdmissionError) as ei:
+                router.submit("ghost", "m", {})
+            assert ei.value.reason == "unknown-tenant"
+            with pytest.raises(AdmissionError) as ei:
+                router.submit("t", "ghost-model", {})
+            assert ei.value.reason == "unknown-model"
+            feed = {"tiny_x": np.zeros((1, 64), np.float32)}
+            router.submit("t", "m", feed)
+            router.submit("t", "m", feed)
+            # burst=2 spent, negligible refill -> rate-limited
+            with pytest.raises(AdmissionError) as ei:
+                router.submit("t", "m", feed)
+            assert ei.value.reason == "rate-limited"
+            router.add_tenant("q", max_queue=2)
+            router.submit("q", "m", feed)
+            router.submit("q", "m", feed)
+            with pytest.raises(AdmissionError) as ei:
+                router.submit("q", "m", feed)
+            assert ei.value.reason == "queue-full"
+            st = router.stats()
+            assert st["tenants"]["t"]["rejected"]["rate-limited"] == 1
+            assert st["tenants"]["q"]["rejected"]["queue-full"] == 1
+        finally:
+            router.close()
+            registry.close()
+
+    def test_config_validation(self):
+        """Misconfigurations fail loudly at construction, not as a
+        dead dispatch thread or a silently-inert limit."""
+        registry = ModelRegistry()
+        try:
+            # quantum=0 would ZeroDivisionError in the DRR pass
+            # (killing the daemon loop: every request hangs)
+            with pytest.raises(ValueError, match="quantum"):
+                Router(registry, quantum=0.0, start=False)
+            router = Router(registry, start=False)
+            try:
+                with pytest.raises(ValueError, match="weight"):
+                    router.add_tenant("t", weight=0)
+                with pytest.raises(ValueError, match="rate"):
+                    router.add_tenant("t", rate=0)
+                with pytest.raises(ValueError, match="burst"):
+                    router.add_tenant("t", rate=5.0, burst=0.5)
+                # burst without rate: the token bucket is gated on
+                # rate, so this would validate yet limit nothing
+                with pytest.raises(ValueError, match="burst"):
+                    router.add_tenant("t", burst=5.0)
+            finally:
+                router.close()
+        finally:
+            registry.close()
+
+    def test_queue_full_rejection_does_not_burn_rate_tokens(self):
+        """A client retrying on queue-full must not drain its token
+        bucket: the queue bound is checked BEFORE the rate debit, so
+        admitted throughput recovers the moment the queue clears."""
+        registry = ModelRegistry()
+        router = Router(registry, start=False)
+        try:
+            server, _ = zoo.make_fc_server(
+                "tiny", 64, 128, 8, executor=registry.executor())
+            registry.load(server=server, alias="m", warm=False)
+            router.add_tenant("t", rate=5.0, burst=2.0, max_queue=1)
+            feed = {"tiny_x": np.zeros((1, 64), np.float32)}
+            router.submit("t", "m", feed)       # 1 token left
+            for _ in range(3):
+                with pytest.raises(AdmissionError) as ei:
+                    router.submit("t", "m", feed)
+                assert ei.value.reason == "queue-full"
+            # the 3 rejections spent NO tokens (rate ~5/s refills are
+            # negligible over this test's microseconds)
+            assert router._tenants["t"].tokens >= 1.0
+        finally:
+            router.close()
+            registry.close()
+
+    def test_closed_router_rejects_and_fails_queued(self):
+        registry = ModelRegistry()
+        router = Router(registry, start=False)
+        server, _ = zoo.make_fc_server(
+            "tiny", 64, 128, 8, executor=registry.executor())
+        registry.load(server=server, alias="m", warm=False)
+        router.add_tenant("t")
+        feed = {"tiny_x": np.zeros((1, 64), np.float32)}
+        rep = router.submit("t", "m", feed)
+        router.close()
+        with pytest.raises(AdmissionError, match="router-closed"):
+            rep.result(5.0)
+        with pytest.raises(AdmissionError) as ei:
+            router.submit("t", "m", feed)
+        assert ei.value.reason == "router-closed"
+        registry.close()
+
+
+class TestStatsSurface:
+    def test_stats_json_and_executable_bound(self):
+        """One process, three models, Zipf-ish traffic: stats_json()
+        parses and carries the acceptance surface (per-tenant TTFT/
+        p99, per-model occupancy, cache pressure), and the shared
+        executable cache respects the N x (buckets + 1) bound."""
+        import json
+
+        rt, _ = _runtime_with_zoo(max_batch_size=8)
+        try:
+            # post-warm baseline (model warmup AND the startup-program
+            # compiles are all behind us here)
+            compiles_after_warm = sum(
+                h.executor.compile_count
+                for h in rt.registry.aliases().values())
+            rt.add_tenant("alpha", weight=2.0, target_p99_ms=5000.0,
+                          max_queue=10000)
+            rt.add_tenant("beta", max_queue=10000)
+            rng = np.random.RandomState(3)
+            models = [p for p, *_ in zoo.DEFAULT_ZOO]
+            # Zipf-ish popularity over the 3 models
+            probs = np.array([1 / (r + 1) for r in range(3)])
+            probs /= probs.sum()
+            replies = []
+            for k in range(120):
+                prefix = models[rng.choice(3, p=probs)]
+                tenant = "alpha" if k % 3 else "beta"
+                replies.append(
+                    rt.submit(tenant, prefix, _req(prefix, rng)))
+            for rep in replies:
+                rep.result(120.0)
+
+            st = json.loads(rt.stats_json())
+            for tenant in ("alpha", "beta"):
+                ts = st["tenants"][tenant]
+                assert ts["completed"] > 0
+                assert ts["latency_ms"]["p99"] is not None
+                assert ts["ttft_ms"]["p99"] is not None
+                assert ts["queue_ms"]["p50"] is not None
+            assert st["tenants"]["alpha"]["slo_violations"] == 0
+            for prefix in models:
+                ms = st["models"][prefix]
+                assert ms["kind"] == "InferenceServer"
+                assert len(ms["fingerprint"]) == 16
+                assert ms["completed"] > 0
+                assert ms["batch_occupancy"] is not None
+                assert ms["uptime_s"] > 0
+            cache = st["cache"]["executable"]
+            n_models = len(models)
+            ladder = len(rt.registry.get("tiny").server.batch_buckets)
+            assert cache["size"] <= n_models * (ladder + 1)
+            assert cache["evictions"] == 0
+            # zero steady-state compiles: nothing compiled after warm
+            assert st["cache"]["compile_count"] == \
+                compiles_after_warm
+        finally:
+            rt.close()
+
+    def test_runtime_stats_reset_window(self):
+        rt, _ = _runtime_with_zoo()
+        try:
+            rt.add_tenant("t", max_queue=1000)
+            rng = np.random.RandomState(4)
+            for _ in range(5):
+                rt.infer("t", "tiny", _req("tiny", rng), timeout=60.0)
+            st = rt.stats(reset=True)
+            assert st["tenants"]["t"]["completed"] == 5
+            st2 = rt.stats()
+            assert st2["tenants"]["t"]["completed"] == 0
+            assert st2["models"]["tiny"]["requests"] == 0
+            # uptime is monotonic across resets
+            assert st2["models"]["tiny"]["uptime_s"] >= \
+                st["models"]["tiny"]["uptime_s"]
+        finally:
+            rt.close()
+
+
+class TestServerLifecycleForSwap:
+    def test_quiesce_drain_semantics(self):
+        """The swap building blocks directly: a quiesced server
+        rejects with ServerQuiesced (retryable), drains its queue,
+        and closes cleanly."""
+        registry = ModelRegistry()
+        server, _ = zoo.make_fc_server(
+            "tiny", 64, 128, 8, executor=registry.executor(),
+            max_wait_ms=20.0)
+        feed = {"tiny_x": np.zeros((1, 64), np.float32)}
+        reps = [server.submit(dict(feed)) for _ in range(5)]
+        server.quiesce()
+        with pytest.raises(ServerQuiesced):
+            server.submit(dict(feed))
+        assert server.drain(30.0) is True
+        for rep in reps:
+            assert rep.result(1.0)[0].shape == (1, 8)
+        server.close()
+
+
+class TestRouterCapacityAccounting:
+    def test_cancelled_reply_does_not_leak_inflight(self):
+        """A caller that times out and cancel()s its reply future
+        (never marked running, so cancel succeeds) must not leak the
+        model's inflight slot: set_result on the cancelled reply
+        raises InvalidStateError inside the done-callback, and the
+        decrement must still run or max_inflight wedges the alias
+        forever."""
+        rng = np.random.RandomState(7)
+        rt, _ = _runtime_with_zoo()
+        try:
+            rt.add_tenant("t", rate=1e9, burst=1000, max_queue=1000)
+            # tiny cap so even a couple of leaked slots wedge it
+            rt.registry.get("tiny").max_inflight = 2
+            cancelled = 0
+            for _ in range(300):
+                rep = rt.submit("t", "tiny", _req("tiny", rng))
+                if rep.cancel():
+                    cancelled += 1
+                if cancelled >= 3:
+                    break
+            assert cancelled >= 1, \
+                "no submit was cancellable before fulfilment"
+            assert rt.drain(timeout=60)
+            deadline = time.monotonic() + 10
+            while (rt.router.inflight("tiny")
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert rt.router.inflight("tiny") == 0
+            # capacity intact: a fresh request still completes
+            out = rt.infer("t", "tiny", _req("tiny", rng), timeout=30)
+            assert np.asarray(out[0]).shape == (1, 8)
+        finally:
+            rt.close()

@@ -339,6 +339,107 @@ class TestObservability:
         assert st["latency_ms"]["p99"] >= st["latency_ms"]["p50"]
 
 
+class TestStatsResetAndLifecycle:
+    def test_stats_reset_window_and_uptime(self, tmp_path):
+        """stats(reset=True) atomically zeroes the WINDOW counters
+        (the runtime aggregator's rate basis) while uptime_s stays
+        monotonic from server start — the r11 aggregation contract."""
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        with InferenceServer(pred, max_batch_size=4,
+                             max_wait_ms=2.0) as srv:
+            for rows in (1, 2, 4):
+                srv.infer({"x": np.ones((rows, 8), np.float32)},
+                          timeout=60.0)
+            st = srv.stats(reset=True)
+            assert st["requests"] == 3
+            assert st["uptime_s"] >= 0
+            assert st["window_s"] >= 0
+            st2 = srv.stats()
+            assert st2["requests"] == 0
+            assert st2["rows"] == 0
+            assert st2["latency_ms"]["p50"] is None
+            assert st2["uptime_s"] >= st["uptime_s"]
+            assert st2["window_s"] <= st["window_s"] + 1.0
+            # executor counters are cumulative (delta across windows)
+            assert st2["compile_count"] == st["compile_count"]
+            srv.infer({"x": np.ones((1, 8), np.float32)},
+                      timeout=60.0)
+            assert srv.stats()["requests"] == 1
+
+    def test_quiesce_drain_close(self, tmp_path):
+        """quiesce() stops ACCEPTING with the retryable named error
+        while queued work completes; drain() blocks until the queue
+        and in-flight batches are empty (the hot-swap retire path)."""
+        from paddle_tpu.inference import ServerQuiesced
+
+        _export_tiny_fc(tmp_path)
+        pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
+        srv = InferenceServer(pred, max_batch_size=8,
+                              max_wait_ms=50.0)
+        reps = [srv.submit({"x": np.ones((1, 8), np.float32)})
+                for _ in range(3)]
+        srv.quiesce()
+        with pytest.raises(ServerQuiesced):
+            srv.submit({"x": np.ones((1, 8), np.float32)})
+        assert srv.drain(30.0) is True
+        for rep in reps:
+            assert rep.result(1.0)[0].shape == (1, 4)
+        st = srv.stats()
+        assert st["completed"] == 3 and st["queue_depth"] == 0
+        srv.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            srv.submit({"x": np.ones((1, 8), np.float32)})
+        # explicit restart after close re-opens the server (the
+        # pre-lifecycle contract, where submit gated on the batcher
+        # thread alone): a fresh start() must clear closed/quiesced
+        srv.start()
+        try:
+            out = srv.infer({"x": np.ones((1, 8), np.float32)},
+                            timeout=60.0)
+            assert out[0].shape == (1, 4)
+        finally:
+            srv.close()
+
+    def test_select_group_hook_orders_dispatch(self):
+        """The pluggable queue-selection hook overrides the default
+        oldest-first group policy: with two shape groups queued, a
+        hook preferring the LATER-arrived group gets it dispatched
+        (and completed) first."""
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            x = fluid.layers.data("x", shape=[-1, 4], dtype="float32")
+            out = fluid.layers.scale(x, scale=2.0)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        runner = ProgramRunner(prog, ["x"], [out.name], executor=exe,
+                               scope=fluid.global_scope())
+
+        def prefer_longest(groups):
+            # group keys carry the post-bucket shape signature; pick
+            # the one with the largest seq dim
+            return max(groups, key=lambda k: k[0][1])
+
+        srv = InferenceServer(runner, max_batch_size=4,
+                              max_wait_ms=200.0, seq_buckets=(4, 8),
+                              select_group=prefer_longest,
+                              start=False)
+        r = np.random.RandomState(7)
+        rep_short = srv.submit({"x": r.randn(1, 3, 4).astype(
+            np.float32)})   # T=3 -> bucket 4, arrives FIRST
+        rep_long = srv.submit({"x": r.randn(1, 7, 4).astype(
+            np.float32)})    # T=7 -> bucket 8
+        done_order = []
+        rep_short.add_done_callback(lambda f: done_order.append("s"))
+        rep_long.add_done_callback(lambda f: done_order.append("l"))
+        srv.start()
+        rep_short.result(60.0)
+        rep_long.result(60.0)
+        srv.close()
+        assert done_order[0] == "l", (
+            f"hook did not reorder dispatch: {done_order}")
+
+
 class TestThroughputGuard:
     def test_batched_server_not_slower_than_naive_loop(self, tmp_path):
         """Regression guard (CPU analogue of the PERF.md serving
