@@ -40,6 +40,53 @@ _PEAKS = (("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
           ("v4", 275e12), ("h100", 989e12))
 
 
+def _telemetry_snapshot(stats_json_dict=None) -> dict:
+    """The `telemetry` key every BENCH_SELF_*.json carries from r12
+    on: the central metrics exposition (observability/metrics.py) +
+    the runtime's stats_json() dict, so future perf rounds read the
+    counter context (compiles, cache tiers, occupancy) next to the
+    headline number instead of re-deriving it.
+
+    The flag is flipped to `metrics` just for the expose() call: the
+    counters behind the exposition (executor compiles/hits, cache
+    residency, server histograms) are live pull providers that count
+    at EVERY level, so benches that ran at `off` still snapshot real
+    values — only the exposition rendering itself is gated."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.flags import FLAGS, set_flags
+
+    prev = FLAGS.observability
+    set_flags({"FLAGS_observability": "metrics"})
+    try:
+        exposition = obs.metrics.expose()
+    finally:
+        set_flags({"FLAGS_observability": prev})
+    return {
+        "metrics_expose": exposition,
+        "stats_json": stats_json_dict,
+        "flight": {
+            "recorded_total": obs.RECORDER.recorded_total,
+            "incidents_total": obs.RECORDER.incidents_total,
+        },
+    }
+
+
+def _write_bench_self(filename: str, result: dict,
+                      stats_json_dict=None) -> dict:
+    """Write a BENCH_SELF_*.json next to this file, injecting the
+    r12 `telemetry` key (see _telemetry_snapshot) so the record
+    carries its counter context. Returns the result dict (with the
+    key attached) for the caller to return/print."""
+    import os
+
+    result["telemetry"] = _telemetry_snapshot(stats_json_dict)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            filename)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    return result
+
+
 def _peak_flops(device_kind: str) -> float:
     kind = device_kind.lower().replace(" ", "")
     for sub, peak in _PEAKS:
@@ -624,6 +671,7 @@ def bench_serving(n_requests=400):
         "max_batch_size": max_batch,
         "n_requests": n_requests,
         "model": f"fc {in_dim}->{hidden}->{classes}",
+        "telemetry": _telemetry_snapshot(st),
     }
 
 
@@ -767,6 +815,7 @@ def bench_coldstart(n_requests=400):
         "max_batch_size": 16,
         "n_requests": n_requests,
         "model": f"fc {in_dim}->{hidden}->{classes}",
+        "telemetry": _telemetry_snapshot(),
     }
 
 
@@ -947,18 +996,36 @@ def bench_generation(n_requests=96):
         "model": (f"transformer d{D} L{L} S{S} maxT{maxT} "
                   f"slots{n_slots}"),
         "best_of": 3,
+        "telemetry": _telemetry_snapshot(cst),
     }
 
 
 def bench_multitenant(n_requests=900):
+    """Restore-safe wrapper: the body flips FLAGS_observability
+    across legs with hard asserts in between, and main() keeps going
+    after a failed config — a tripped assert must not leave the flag
+    at metrics/trace for every later bench in the process."""
+    from paddle_tpu.flags import FLAGS, set_flags
+
+    prev = FLAGS.observability
+    try:
+        return _bench_multitenant_body(n_requests=n_requests)
+    finally:
+        set_flags({"FLAGS_observability": prev})
+
+
+def _bench_multitenant_body(n_requests=900):
     """Multi-tenant serving runtime (inference/runtime): ONE process
     serves the 3-model runtime zoo under mixed Zipf traffic from 3
     tenants through the ModelRegistry + SLO-aware Router, then hot-
     swaps the most popular model mid-traffic. Asserted invariants
     (the r11 acceptance criteria, not just reported): bounded
     executable count (<= N x (buckets + 1) in the SHARED LRU), ZERO
-    steady-state compiles after warm, and zero accepted-request loss
-    across the swap. Writes BENCH_SELF_r11.json next to this file.
+    steady-state compiles after warm, zero accepted-request loss
+    across the swap, and (r12) a complete slow-request span tree from
+    the observability layer. Writes BENCH_SELF_r12.json next to this
+    file, including the off/metrics/trace interleaved A/B and the
+    `telemetry` snapshot.
 
     CPU-PINNED by design (same reasoning as bench_coldstart): the
     scheduling/arbitration arithmetic is honestly CPU-measurable and
@@ -1010,16 +1077,123 @@ def bench_multitenant(n_requests=900):
             (str(tenant_mix[k]), prefix,
              {f"{prefix}_x": rng.randn(1, in_dim).astype(np.float32)}))
 
-    def leg():
+    def leg(repeat=1):
         t0 = time.perf_counter()
-        replies = [rt.submit(t, m, f) for t, m, f in schedule]
+        replies = [rt.submit(t, m, f)
+                   for _ in range(repeat)
+                   for t, m, f in schedule]
         for rep in replies:
             rep.result(600.0)
         wall = time.perf_counter() - t0
-        return n_requests / wall, rt.stats(reset=True)
+        return repeat * n_requests / wall, rt.stats(reset=True)
 
+    # observability-overhead A/B (the r12 acceptance gate): the SAME
+    # traffic leg alternating FLAGS_observability off/metrics/trace,
+    # interleaved best-of-3 per the PERF.md discipline (sequential
+    # legs land in different throttle windows on this 2-core host and
+    # report 2x-off ratios). The metrics level is pull-based
+    # (weakref providers read at expose() time), so the expected
+    # delta is noise-level; the interleave is what makes 3% resolvable.
+    from paddle_tpu import observability as obs
+    from paddle_tpu.flags import FLAGS, set_flags
+
+    leg()  # discard: very first traffic leg is cold (thread pools,
+    #        allocator)
+    # headline: best-of-3 at the r11 leg length, observability off —
+    # the value stays comparable across rounds
+    set_flags({"FLAGS_observability": "off"})
     legs = [leg() for _ in range(3)]
     best_rps, best_st = max(legs, key=lambda x: x[0])
+
+    def ab_pair(mode_a, mode_b, reps, repeat=4):
+        """Median of PAIRED adjacent-leg rps ratios mode_a/mode_b.
+        Three defenses against this host's CPU-share throttle, which
+        swings single short legs 2.5x (so best-of-N compares
+        throttle-window luck, not modes): legs run the schedule
+        ``repeat``x so each leg spans multiple throttle windows
+        instead of landing inside one; the two modes run back-to-back
+        (shared throttle state) with the order alternating per rep
+        (the second leg of a pair trends measurably warmer); and the
+        median over reps rejects window-boundary outliers."""
+        ratios, legs = [], {mode_a: [], mode_b: []}
+        for rep in range(reps):
+            order = ((mode_a, mode_b) if rep % 2 == 0
+                     else (mode_b, mode_a))
+            res = {}
+            for mode in order:
+                set_flags({"FLAGS_observability": mode})
+                res[mode] = leg(repeat=repeat)
+            for m in (mode_a, mode_b):
+                legs[m].append(res[m])
+            ratios.append(res[mode_a][0] / res[mode_b][0])
+        srt = sorted(ratios)
+        mid = len(srt) // 2
+        med = (srt[mid] if len(srt) % 2
+               else 0.5 * (srt[mid - 1] + srt[mid]))
+        return med, ratios, legs
+
+    obs_ratio, metrics_ratios, mo_legs = ab_pair("metrics", "off", 6)
+    trace_ratio, trace_ratios, to_legs = ab_pair("trace", "off", 4)
+    ab_legs = {"off": mo_legs["off"] + to_legs["off"],
+               "metrics": mo_legs["metrics"],
+               "trace": to_legs["trace"]}
+
+    # The A/B above records the acceptance protocol, but this host's
+    # CPU-share throttle swings IDENTICAL adjacent legs up to 1.7x
+    # (see the recorded pair ratios) — no end-to-end estimator tried
+    # here (paired median, ABBA quads, best-of-20 interleaved, 15 s
+    # legs) resolves 3% run-to-run. The budget is therefore checked
+    # against a DIRECT measurement: time the exact per-request work
+    # the metrics level adds (the flag gate, the request id, and the
+    # coarse flight-recorder entry — everything else runs at off too)
+    # and compare it to the measured per-request wall. This is
+    # deterministic to a few percent where the macro ratio is not.
+    from paddle_tpu.observability import flight as obs_flight
+    from paddle_tpu.observability import tracing as obs_tracing
+    from paddle_tpu.observability.metrics import metrics_on
+
+    set_flags({"FLAGS_observability": "metrics"})
+    scratch = obs_flight.FlightRecorder(max_recent=8)  # not the
+    #   global ring: the telemetry snapshot must not count bench spins
+    K = 50_000
+    t0 = time.perf_counter()
+    for _ in range(K):
+        metrics_on()
+        rid = obs_tracing.TRACER.next_request_id()
+        scratch.record(
+            {"request_id": rid, "status": "ok",
+             "slo_violated": False, "tenant": "bench",
+             "model": "tiny", "latency_ms": 12.3, "queue_ms": 1.2},
+            incident=False)
+    direct_us = (time.perf_counter() - t0) / K * 1e6
+    mean_off_rps = (sum(r for r, _ in ab_legs["off"])
+                    / len(ab_legs["off"]))
+    wall_us = 1e6 / mean_off_rps  # conservative: per-request WALL,
+    #   not the 2-core CPU budget (which is ~2x larger)
+    overhead_frac = direct_us / wall_us
+    # back to the headline level: the hot-swap phase below (swap_s,
+    # post-swap compile window, zero-loss leg) must run at the SAME
+    # observability level as the headline legs and the r11 record it
+    # is compared against — not at the microbench's metrics level
+    set_flags({"FLAGS_observability": "off"})
+
+    # forensic demo (acceptance): the SLOWEST traced request's span
+    # tree must be complete — router.queue -> server.queue ->
+    # server.dispatch -> execute -> readback under the request root,
+    # with cache-tier annotations — and the whole sink dumps to one
+    # chrome trace (written under /tmp; the timeline summary is
+    # recorded in the result JSON)
+    with obs.TRACER._lock:
+        traced = list(obs.TRACER.completed)
+    slow = max(traced, key=lambda t: (t.t_end or t.t_start) - t.t_start)
+    slow_tl = slow.timeline()
+    slow_names = {s["name"] for s in slow_tl["spans"]}
+    need = {"request", "router.queue", "server.queue",
+            "server.dispatch", "execute", "readback"}
+    assert need <= slow_names, (
+        f"slow-request trace incomplete: missing "
+        f"{sorted(need - slow_names)} in {sorted(slow_names)}")
+    obs.dump_trace("/tmp/paddle_tpu_multitenant_trace_r12")
     steady_compiles = total_compiles() - compiles_after_warm
     assert steady_compiles == 0, (
         f"steady-state traffic compiled {steady_compiles} fresh "
@@ -1118,17 +1292,32 @@ def bench_multitenant(n_requests=900):
             "swaps": swap_st["registry"]["swaps"],
         },
         "cache": best_st["cache"]["executable"],
+        "observability_overhead": {
+            "ab_method": ("median of paired adjacent-leg ratios, "
+                          "order alternated per pair; evidence only "
+                          "— host throttle noise floor >> 3% (see "
+                          "PERF.md 'Observability overhead')"),
+            "metrics_over_off": round(obs_ratio, 4),
+            "trace_over_off": round(trace_ratio, 4),
+            "metrics_pair_ratios": [round(r, 4)
+                                    for r in metrics_ratios],
+            "trace_pair_ratios": [round(r, 4) for r in trace_ratios],
+            "rps_legs": {m: [round(r, 1) for r, _ in ab_legs[m]]
+                         for m in ("off", "metrics", "trace")},
+            "budget": "metrics within 3% of off",
+            "direct_overhead_us_per_request": round(direct_us, 3),
+            "per_request_wall_us_at_off": round(wall_us, 1),
+            "direct_overhead_fraction": round(overhead_frac, 5),
+            "within_budget": bool(overhead_frac < 0.03),
+        },
+        "slow_request_trace": slow_tl,
+        "trace_dump": "/tmp/paddle_tpu_multitenant_trace_r12.json",
         "n_requests": n_requests,
         "max_batch_size": max_batch,
         "best_of": 3,
     }
-    import os
-
-    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "BENCH_SELF_r11.json")
-    with open(out_path, "w") as f:
-        json.dump(result, f, indent=1)
-    return result
+    return _write_bench_self("BENCH_SELF_r12.json", result,
+                             stats_json_dict=best_st)
 
 
 # opt-in configs (argv-selectable only; never in the driver's default

@@ -32,6 +32,7 @@ from . import unique_name
 from . import nets
 from . import metrics
 from . import profiler
+from . import observability
 from .io import (save_vars, save_params, save_persistables, load_vars,
                  load_params, load_persistables, save_inference_model,
                  load_inference_model, save_sharded_persistables,

@@ -43,6 +43,7 @@ bound.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import os
 import pickle
@@ -212,6 +213,8 @@ class CompileCache:
     writes are atomic (tempfile + os.replace) so concurrent processes
     can share a root."""
 
+    _obs_seq = itertools.count(1)
+
     def __init__(self, root: str, mode: str):
         assert mode in ("ro", "rw"), mode
         self.root = root
@@ -221,6 +224,21 @@ class CompileCache:
         self.store_count = 0      # entries written this process
         self.prune_count = 0      # entries GC'd by the size bounds
         self.discards = []        # (digest, named reason)
+        # observability: counters pulled at metrics.expose() time
+        # (weakref provider; instances are process-global via _CACHES,
+        # one per (root, mode) — the store label keeps co-resident
+        # roots from emitting duplicate series, which a scraper
+        # rejects wholesale)
+        from ..observability import metrics as _obs_metrics
+
+        self._obs_id = f"disk-cache-{next(CompileCache._obs_seq)}"
+        _obs_metrics.register_provider(self)
+
+    def _metrics_samples(self):
+        lab = {"mode": self.mode, "store": self._obs_id}
+        s = self.stats()
+        return [(f"paddle_tpu_disk_cache_{k}_total", lab, v)
+                for k, v in s.items()]
 
     @property
     def writable(self) -> bool:

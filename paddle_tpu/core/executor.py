@@ -21,6 +21,8 @@ caching (executor.py:451 _run cache).
 from __future__ import annotations
 
 import collections
+import itertools
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -144,6 +146,8 @@ class ExecutableCache:
     across serving clones exactly like the dict was
     (AnalysisPredictor.clone passes the object through)."""
 
+    _obs_seq = itertools.count(1)
+
     def __init__(self, capacity: Optional[int] = None):
         if capacity is None:
             from ..flags import FLAGS
@@ -153,6 +157,12 @@ class ExecutableCache:
         self.evict_count = 0
         self.insert_count = 0
         self._d: "collections.OrderedDict" = collections.OrderedDict()
+        # observability: residency/churn pulled at expose() time
+        # (weakref provider — paddle_tpu/observability/metrics.py)
+        self._obs_id = f"exe-cache-{next(ExecutableCache._obs_seq)}"
+        from ..observability import metrics as _obs_metrics
+
+        _obs_metrics.register_provider(self)
         # serving clones share one instance across batcher/caller
         # threads; the plain dict this replaces was GIL-atomic per op,
         # but get() here is a read + move_to_end pair racing
@@ -209,6 +219,20 @@ class ExecutableCache:
             return {"size": len(self._d), "capacity": self.capacity,
                     "inserts": self.insert_count,
                     "evictions": self.evict_count}
+
+    def _metrics_samples(self):
+        """Pull-provider for observability.metrics.expose()."""
+        lab = {"cache": self._obs_id}
+        s = self.stats()
+        return [
+            ("paddle_tpu_executable_cache_size", lab, s["size"]),
+            ("paddle_tpu_executable_cache_capacity", lab,
+             s["capacity"]),
+            ("paddle_tpu_executable_cache_inserts_total", lab,
+             s["inserts"]),
+            ("paddle_tpu_executable_cache_evictions_total", lab,
+             s["evictions"]),
+        ]
 
 
 def _as_aval(x):
@@ -669,9 +693,45 @@ def _scan_fallback_reason(program):
     return None
 
 
+def _record_compile_event(kind, program, tier, t0, fn=None):
+    """Observability: one global 'compile' span per executable
+    RESOLUTION that was not a memory hit — annotated with the
+    program's content fingerprint, the cache tier that satisfied it
+    (``disk`` = warm-start rehydration, ``cold`` = trace + XLA
+    compile; a memory hit never lands here, which is what lets the
+    serving tests assert zero steady-state compile spans), and
+    ``compiled.memory_analysis()`` sizes when the executable exposes
+    them (AOT-compiled paths; plain-jit callables skip the sizes).
+    Gated on FLAGS_observability=trace; at lower levels this is one
+    boolean check per compile (compiles are rare by design)."""
+    from ..observability import tracing as obs_tracing
+
+    if not obs_tracing.trace_on():
+        return
+    attrs = {"kind": kind, "tier": tier,
+             "fingerprint": program.fingerprint()[:16]}
+    ma = getattr(fn, "memory_analysis", None)
+    if ma is not None:
+        try:
+            m = ma()
+            for field in ("temp_size_in_bytes",
+                          "argument_size_in_bytes",
+                          "output_size_in_bytes",
+                          "generated_code_size_in_bytes"):
+                v = getattr(m, field, None)
+                if v is not None:
+                    attrs[field] = int(v)
+        except Exception:
+            pass  # backend without memory analysis: annotate less
+    obs_tracing.record_global_event("compile", t0, time.monotonic(),
+                                    **attrs)
+
+
 class Executor:
     """fluid.Executor parity (reference python/paddle/fluid/executor.py:451).
     """
+
+    _obs_seq = itertools.count(1)
 
     def __init__(self, place: Optional[TPUPlace] = None,
                  donate: bool = True, cache: Optional[Dict] = None):
@@ -697,6 +757,28 @@ class Executor:
         # run_steps: named reason the last call used the per-step
         # fallback (None = the K-step scan path ran)
         self.last_run_steps_fallback: Optional[str] = None
+        # observability: the counters above are pulled at expose()
+        # time (weakref provider; see _metrics_samples)
+        self._obs_id = f"executor-{next(Executor._obs_seq)}"
+        from ..observability import metrics as _obs_metrics
+
+        _obs_metrics.register_provider(self)
+
+    def _metrics_samples(self):
+        """Pull-provider for observability.metrics.expose(): the
+        compile/hit/disk-load/evict counters serving stats already
+        read, re-registered into the central registry."""
+        lab = {"executor": self._obs_id}
+        return [
+            ("paddle_tpu_executor_compiles_total", lab,
+             self.compile_count),
+            ("paddle_tpu_executor_cache_hits_total", lab,
+             self.cache_hit_count),
+            ("paddle_tpu_executor_disk_loads_total", lab,
+             self.disk_load_count),
+            ("paddle_tpu_executor_cache_evictions_total", lab,
+             self.cache_evict_count),
+        ]
 
     @property
     def cache_evict_count(self) -> int:
@@ -1276,6 +1358,7 @@ class Executor:
         """In-memory-miss path for run(): rehydrate a serialized
         executable from the warm-start cache (ZERO tracing), else
         trace + compile (persisting the result when writable)."""
+        t0 = time.monotonic()
         dcache, digest = self._disk_slot(program, feed_specs,
                                          fetch_names, "block")
         if dcache is not None:
@@ -1288,6 +1371,7 @@ class Executor:
 
                 maybe_check_program(program)
                 self.disk_load_count += 1
+                _record_compile_event("block", program, "disk", t0, fn)
                 return _CompiledBlock(
                     fn, tuple(meta["feed_names"]), meta["state_in"],
                     meta["const_in"], meta["state_out"],
@@ -1298,6 +1382,8 @@ class Executor:
                                  feed_arrays=feed_arrays,
                                  aot=dcache is not None)
         self.compile_count += 1
+        _record_compile_event("block", program, "cold", t0,
+                              compiled.fn)
         if dcache is not None and dcache.writable:
             self._disk_store(dcache, digest, compiled, kind="block")
         return compiled
@@ -1307,6 +1393,7 @@ class Executor:
         """run_steps analogue of _resolve_block — the K-specialized
         scan executable is the most expensive single compile in the
         repo, so it benefits most from the disk warm start."""
+        t0 = time.monotonic()
         dcache, digest = self._disk_slot(program, feed_specs,
                                          fetch_names, "scan",
                                          extra=(steps, stacked))
@@ -1318,6 +1405,7 @@ class Executor:
 
                 maybe_check_program(program)
                 self.disk_load_count += 1
+                _record_compile_event("scan", program, "disk", t0, fn)
                 wos = {n: jax.ShapeDtypeStruct(tuple(shape),
                                                _dtype_from_str(dt))
                        for n, shape, dt in meta["write_only_specs"]}
@@ -1331,6 +1419,8 @@ class Executor:
             scope, steps, stacked=stacked, feed_arrays=feed_arrays,
             device=device, aot=dcache is not None)
         self.compile_count += 1
+        _record_compile_event("scan", program, "cold", t0,
+                              compiled.fn)
         if dcache is not None and dcache.writable:
             self._disk_store(
                 dcache, digest, compiled, kind="scan",

@@ -61,6 +61,19 @@ def _as_cache_mode(s):
     raise ValueError(f"{s!r} is not one of off/ro/rw")
 
 
+def _as_obs_mode(s):
+    """FLAGS_observability level: off | metrics | trace (bool
+    spellings map 0->off, 1->metrics for launch-script convenience)."""
+    v = str(s).strip().lower()
+    if v in ("off", "metrics", "trace"):
+        return v
+    if v in ("0", "false", "no", ""):
+        return "off"
+    if v in ("1", "true", "yes", "on"):
+        return "metrics"
+    raise ValueError(f"{s!r} is not one of off/metrics/trace")
+
+
 def _as_bool(s):
     if isinstance(s, bool):
         return s
@@ -87,6 +100,12 @@ _DEFS = {
     # warnings.warn the diagnostics, strict = raise EnforceNotMet on
     # any error-severity diagnostic (PTA0xx codes)
     "static_check": (_as_static_check, "off", True),
+    # unified observability layer (paddle_tpu/observability): off =
+    # dormant (no span capture, empty exposition), metrics = central
+    # metrics registry exposition + coarse flight-recorder timelines,
+    # trace = + per-request span capture and chrome-trace dumps.
+    # Always compiled in; read per call so set_flags flips it live.
+    "observability": (_as_obs_mode, "off", True),
     # warm-start layer (core/compile_cache.py): persist serialized
     # executables on disk so a fresh process serves every shape with
     # zero in-process compiles. off = current behavior, ro = load

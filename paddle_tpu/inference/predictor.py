@@ -17,6 +17,7 @@ import numpy as np
 
 from ..core.executor import Executor, PreparedCache, TPUPlace
 from ..core.scope import Scope
+from ..observability import tracing as obs_tracing
 from .config import AnalysisConfig, NativeConfig, PaddleDType
 
 
@@ -197,20 +198,27 @@ class AnalysisPredictor(PaddlePredictor):
         # per-call cache hashing / fetch parsing / trace-env rebuild
         # happen once per shape, not once per request; None = the
         # program takes the per-call Executor.run path
-        prepared = self._prepared.lookup(feed)
-        if prepared is not None:
-            outs = prepared.run(feed, return_numpy=False)
-        else:
-            outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_names,
-                                 scope=self._scope,
-                                 return_numpy=False)
+        # execute/readback spans attach to every co-batched request
+        # via the ambient batch context (observability/tracing) —
+        # the predictor-backed server path shares execute_span with
+        # serving.ProgramRunner.run_batch, so the cache-tier
+        # attribution convention has exactly one copy
+        with obs_tracing.execute_span(self._exe):
+            prepared = self._prepared.lookup(feed)
+            if prepared is not None:
+                outs = prepared.run(feed, return_numpy=False)
+            else:
+                outs = self._exe.run(self._program, feed=feed,
+                                     fetch_list=self._fetch_names,
+                                     scope=self._scope,
+                                     return_numpy=False)
         # ONE batched device->host pull: jax.device_get starts the
         # copy of every fetch before blocking on any, where a per-
         # fetch np.asarray loop pays one full round-trip each (~75 ms
         # per fetch through the TPU tunnel -- PERF.md "Measurement
         # pitfalls" / "Serving path")
-        outs = jax.device_get(outs)
+        with obs_tracing.span("readback"):
+            outs = jax.device_get(outs)
         return [np.asarray(o).astype(np.float32)
                 if str(np.asarray(o).dtype) == "bfloat16" else
                 np.asarray(o) for o in outs]

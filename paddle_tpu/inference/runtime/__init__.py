@@ -115,6 +115,30 @@ class ServingRuntime:
     def stats_json(self, reset: bool = False, indent=None) -> str:
         return self._stats.to_json(reset=reset, indent=indent)
 
+    def metrics_expose(self) -> str:
+        """Prometheus text exposition of the central metrics registry
+        (paddle_tpu/observability/metrics.py) — the machine-scrape
+        twin of stats_json()."""
+        from ...observability import metrics
+
+        return metrics.expose()
+
+    def incident_report(self, max_incidents: Optional[int] = None) \
+            -> dict:
+        """Flight-recorder forensic dump: retained timelines of every
+        SLO-violating or errored request (full span trees at
+        FLAGS_observability=trace) — observability/flight.py."""
+        from ...observability import incident_report
+
+        return incident_report(max_incidents=max_incidents)
+
+    def dump_trace(self, path: str) -> dict:
+        """One chrome-trace JSON of host spans + request span trees +
+        compile events (observability/tracing.py dump_trace)."""
+        from ...observability import dump_trace
+
+        return dump_trace(path)
+
     # --- lifecycle ----------------------------------------------------
     def drain(self, timeout: Optional[float] = 60.0) -> bool:
         """Quiesce nothing; just wait for queued + in-flight traffic

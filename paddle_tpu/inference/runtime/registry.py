@@ -29,6 +29,7 @@ failure class (analysis/checkers.py check_cross_model_collision);
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 import warnings
@@ -149,6 +150,8 @@ class ModelRegistry:
     in one LRU instead of N unbounded private dicts, and retired
     models' executables age out instead of leaking."""
 
+    _obs_seq = itertools.count(1)
+
     def __init__(self, cache: Optional[ExecutableCache] = None,
                  drain_timeout: float = 60.0):
         self._cache = cache if cache is not None else ExecutableCache()
@@ -166,6 +169,26 @@ class ModelRegistry:
         self.drain_timeout = float(drain_timeout)
         self.swap_count = 0
         self.retire_count = 0
+        from ...observability import metrics as _obs_metrics
+
+        # unique instance label: two co-resident registries must not
+        # emit duplicate (name, labels) series — a scraper rejects
+        # the whole exposition (same _obs_id discipline as Executor)
+        self._obs_id = f"registry-{next(ModelRegistry._obs_seq)}"
+        _obs_metrics.register_provider(self)
+
+    def _metrics_samples(self):
+        """Pull-provider for observability.metrics.expose()."""
+        lab = {"registry": self._obs_id}
+        with self._lock:
+            return [
+                ("paddle_tpu_registry_models_loaded", lab,
+                 len(self._aliases)),
+                ("paddle_tpu_registry_swaps_total", lab,
+                 self.swap_count),
+                ("paddle_tpu_registry_retired_total", lab,
+                 self.retire_count),
+            ]
 
     @property
     def cache(self) -> ExecutableCache:

@@ -42,11 +42,16 @@ retries — accepted requests never fail because of a swap.
 from __future__ import annotations
 
 import collections
+import itertools
 import threading
 import time
 from concurrent import futures
 from typing import Dict, Optional
 
+from ...observability import flight as obs_flight
+from ...observability import metrics as obs_metrics
+from ...observability import tracing as obs_tracing
+from ...observability.metrics import Histogram
 from ..serving import ServerClosed, ServerQuiesced, _pct_dict
 
 __all__ = ["AdmissionError", "Router", "TenantConfig"]
@@ -116,7 +121,8 @@ class TenantConfig:
 
 
 class _Routed:
-    __slots__ = ("model", "payload", "reply", "t_submit", "t_dispatch")
+    __slots__ = ("model", "payload", "reply", "t_submit", "t_dispatch",
+                 "rid", "trace")
 
     def __init__(self, model, payload):
         self.model = model
@@ -124,6 +130,11 @@ class _Routed:
         self.reply = futures.Future()
         self.t_submit = time.monotonic()
         self.t_dispatch = None
+        # observability: request id (metrics level and up — names the
+        # request in flight-recorder incident reports) and the span
+        # Trace (trace level only; the router owns its lifecycle)
+        self.rid = None
+        self.trace = None
 
 
 class _TenantState:
@@ -144,12 +155,14 @@ class _TenantState:
         self.completed = 0
         self.failed = 0
         self.slo_violations = 0
-        self.latencies = collections.deque(maxlen=4096)
-        self.queue_ms = collections.deque(maxlen=4096)
+        # fixed-bucket histograms (observability/metrics): O(1)
+        # memory per tenant regardless of request count
+        self.latencies = Histogram("paddle_tpu_tenant_latency_ms")
+        self.queue_ms = Histogram("paddle_tpu_tenant_queue_ms")
         # tenant-level TTFT == reply latency (the router sees complete
         # replies; same recording convention as the one-shot servers —
         # token-level TTFT lives in the per-model server stats)
-        self.ttft = collections.deque(maxlen=4096)
+        self.ttft = Histogram("paddle_tpu_tenant_ttft_ms")
 
 
 class Router:
@@ -160,6 +173,8 @@ class Router:
     inference/api/analysis_predictor.cc:832 CreatePaddlePredictor
     directly); this is the front door that multi-tenancy adds on
     top."""
+
+    _obs_seq = itertools.count(1)
 
     def __init__(self, registry, quantum: float = 1.0,
                  default_target_p99_ms: float = 1000.0,
@@ -180,6 +195,13 @@ class Router:
         self._thread: Optional[threading.Thread] = None
         self._t_start = time.monotonic()
         self._t_window = self._t_start
+        # observability: per-tenant counters are pulled from here at
+        # expose() time (weakref provider — no hot-path cost). Unique
+        # instance label: two routers sharing a tenant name must not
+        # emit duplicate (name, labels) series (a scraper rejects the
+        # whole exposition)
+        self._obs_id = f"router-{next(Router._obs_seq)}"
+        obs_metrics.register_provider(self)
         if start:
             self.start()
 
@@ -289,6 +311,12 @@ class Router:
                         f"req/s (burst {cfg.burst:g})")
                 state.tokens -= 1.0
             req = _Routed(model, payload)
+            req.trace = obs_tracing.start_request(
+                owner="router", tenant=tenant, model=model)
+            if req.trace is not None:
+                req.rid = req.trace.request_id
+            elif obs_metrics.metrics_on():
+                req.rid = obs_tracing.TRACER.next_request_id()
             state.queue.append(req)
             state.admitted += 1
             self._cv.notify_all()
@@ -401,7 +429,10 @@ class Router:
             self._finish_error(state, req, e)
             return True
         try:
-            inner = handle.submit(req.payload)
+            # park the request trace in the ambient context so the
+            # server's submit adopts it instead of opening its own
+            with obs_tracing.request_context(req.trace):
+                inner = handle.submit(req.payload)
         except (ServerQuiesced, ServerClosed):
             return False
         except BaseException as e:
@@ -423,23 +454,26 @@ class Router:
     def _on_done(self, state: _TenantState, req: _Routed, inner):
         now = time.monotonic()
         exc = inner.exception()
+        lat = (now - req.t_submit) * 1e3
+        violated = False
         with self._cv:
             # stats BEFORE fulfilment (a caller unblocked by the
             # result must see its own completion in stats — the
             # serving layer's convention)
             if exc is None:
                 state.completed += 1
-                lat = (now - req.t_submit) * 1e3
-                state.latencies.append(lat)
-                state.ttft.append(lat)
+                state.latencies.observe(lat)
+                state.ttft.observe(lat)
                 if req.t_dispatch is not None:
-                    state.queue_ms.append(
+                    state.queue_ms.observe(
                         (req.t_dispatch - req.t_submit) * 1e3)
                 target = state.cfg.target_p99_ms
                 if target is not None and lat > target:
                     state.slo_violations += 1
+                    violated = True
             else:
                 state.failed += 1
+        self._observe_completion(state, req, now, lat, exc, violated)
         # fulfilment BEFORE the inflight decrement: drain() claims
         # "every forwarded request has completed", which must imply
         # the reply futures are already fulfilled when it returns.
@@ -460,9 +494,40 @@ class Router:
                 self._inflight[req.model] -= 1
                 self._cv.notify_all()
 
+    def _observe_completion(self, state, req, now, lat, exc, violated):
+        """Seal the request's observability record: at trace level the
+        span tree is finished (router.queue span included) and flows
+        to the flight recorder via Trace.finish; at metrics level a
+        coarse timeline is recorded directly. Incidents = error or
+        SLO violation."""
+        status = "ok" if exc is None else "error"
+        if req.trace is not None:
+            if req.t_dispatch is not None:
+                req.trace.add_span("router.queue", req.t_submit,
+                                   req.t_dispatch)
+            req.trace.finish(
+                status=status, slo_violated=violated,
+                tenant=state.cfg.name,
+                **({"error": repr(exc)} if exc is not None else {}))
+        elif req.rid is not None:
+            obs_flight.RECORDER.record(
+                {"request_id": req.rid, "status": status,
+                 "slo_violated": violated,
+                 "tenant": state.cfg.name, "model": req.model,
+                 "latency_ms": round(lat, 3),
+                 "queue_ms": (round(
+                     (req.t_dispatch - req.t_submit) * 1e3, 3)
+                     if req.t_dispatch is not None else None),
+                 **({"error": repr(exc)} if exc is not None else {})},
+                incident=(exc is not None or violated))
+
     def _finish_error(self, state: _TenantState, req: _Routed, exc):
+        now = time.monotonic()
         with self._cv:
             state.failed += 1
+        self._observe_completion(state, req, now,
+                                 (now - req.t_submit) * 1e3, exc,
+                                 False)
         # same cancelled-reply + drain contract as _on_done
         try:
             req.reply.set_exception(exc)
@@ -477,6 +542,37 @@ class Router:
     def inflight(self, alias: str) -> int:
         with self._cv:
             return self._inflight.get(alias, 0)
+
+    def _metrics_samples(self):
+        """Pull-provider for observability.metrics.expose(): the
+        per-tenant admission/SLO counters, labeled by tenant."""
+        out = []
+        with self._cv:
+            for name, st in self._tenants.items():
+                lab = {"router": self._obs_id, "tenant": name}
+                out += [
+                    ("paddle_tpu_tenant_admitted_total", lab,
+                     st.admitted),
+                    ("paddle_tpu_tenant_rejected_total",
+                     {**lab, "reason": "rate-limited"},
+                     st.rejected_rate),
+                    ("paddle_tpu_tenant_rejected_total",
+                     {**lab, "reason": "queue-full"},
+                     st.rejected_queue),
+                    ("paddle_tpu_tenant_completed_total", lab,
+                     st.completed),
+                    ("paddle_tpu_tenant_failed_total", lab,
+                     st.failed),
+                    ("paddle_tpu_tenant_slo_violations_total", lab,
+                     st.slo_violations),
+                    ("paddle_tpu_tenant_queue_depth", lab,
+                     len(st.queue)),
+                    ("paddle_tpu_tenant_latency_ms", lab,
+                     st.latencies),
+                    ("paddle_tpu_tenant_queue_ms", lab, st.queue_ms),
+                    ("paddle_tpu_tenant_ttft_ms", lab, st.ttft),
+                ]
+        return out
 
     def stats(self, reset: bool = False) -> dict:
         """Per-tenant snapshot (atomic under the router lock; same

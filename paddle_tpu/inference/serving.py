@@ -46,6 +46,7 @@ requests/s — serving perf work is unverifiable without them.
 from __future__ import annotations
 
 import collections
+import itertools
 import math
 import threading
 import time
@@ -57,6 +58,10 @@ import numpy as np
 from ..core.executor import Executor, PreparedCache, TPUPlace
 from ..core.scope import global_scope
 from ..core.types import to_np_dtype
+from ..observability import metrics as obs_metrics
+from ..observability import tracing as obs_tracing
+from ..observability.metrics import Histogram
+from ..observability.tracing import cache_tier as _cache_tier
 
 SEQ_SUFFIX = "@SEQ_LEN"
 
@@ -158,7 +163,8 @@ def _call_scheduling_hook(server, hook, arg, hook_name, fallback):
 def _pct(sorted_vals, p):
     """Nearest-rank percentile over an ascending list (ceil(p*N)-1:
     int(p*N) overshoots — p50 of 2 samples must be the 1st, not the
-    2nd). None on empty."""
+    2nd). None on empty. Kept as the EXACT oracle the observability
+    histograms are pinned against (tests/test_observability.py)."""
     if not sorted_vals:
         return None
     idx = max(0, math.ceil(p * len(sorted_vals)) - 1)
@@ -166,18 +172,38 @@ def _pct(sorted_vals, p):
 
 
 def _pct_dict(vals):
+    """p50/p99 dict from a fixed-bucket Histogram (the O(1)-memory
+    serving path — a million-request run holds bucket counts, not raw
+    samples) or, for compatibility, any iterable of raw samples."""
+    if isinstance(vals, Histogram):
+        return vals.percentile_dict()
     lat = sorted(vals)
     return {"p50": _pct(lat, 0.50), "p99": _pct(lat, 0.99)}
 
 
-class _Request:
-    __slots__ = ("feed", "rows", "reply", "t_arrival")
+_obs_server_seq = itertools.count(1)
 
-    def __init__(self, feed, rows, reply):
+
+def _obs_server_id(server) -> str:
+    """Stable per-instance metrics label, e.g. InferenceServer-3
+    (itertools.count: thread-safe like Executor._obs_seq — servers
+    are constructed concurrently by registry loads)."""
+    return f"{type(server).__name__}-{next(_obs_server_seq)}"
+
+
+class _Request:
+    __slots__ = ("feed", "rows", "reply", "t_arrival", "trace")
+
+    def __init__(self, feed, rows, reply, trace=None):
         self.feed = feed
         self.rows = rows
         self.reply = reply
         self.t_arrival = time.monotonic()
+        # observability: the request's Trace (observability/tracing),
+        # None unless FLAGS_observability=trace. Router-owned traces
+        # are finished by the router's completion path; server-owned
+        # ones (standalone servers) are finished at demux.
+        self.trace = trace
 
 
 class _PredictorRunner:
@@ -216,17 +242,24 @@ class ProgramRunner:
     def run_batch(self, feed):
         import jax
 
-        # None = program not preparable (go ops / CompiledProgram /
-        # native build): per-call Executor.run path
-        prepared = self._prepared.lookup(feed)
-        if prepared is not None:
-            outs = prepared.run(feed, return_numpy=False)
-        else:
-            outs = self.executor.run(self.program, feed=feed,
-                                     fetch_list=self.fetch_names,
-                                     scope=self.scope,
-                                     return_numpy=False)
-        return [np.asarray(o) for o in jax.device_get(outs)]
+        # execute/readback spans attach to every co-batched request
+        # via the ambient batch context the server set (near-free when
+        # tracing is off: one thread-local lookup per span); the
+        # execute_span helper stamps the cache-tier attr from counter
+        # deltas, covering a prepared-lookup-miss compile
+        with obs_tracing.execute_span(self.executor):
+            # None = program not preparable (go ops / CompiledProgram
+            # / native build): per-call Executor.run path
+            prepared = self._prepared.lookup(feed)
+            if prepared is not None:
+                outs = prepared.run(feed, return_numpy=False)
+            else:
+                outs = self.executor.run(self.program, feed=feed,
+                                         fetch_list=self.fetch_names,
+                                         scope=self.scope,
+                                         return_numpy=False)
+        with obs_tracing.span("readback"):
+            return [np.asarray(o) for o in jax.device_get(outs)]
 
 
 class InferenceServer:
@@ -319,19 +352,26 @@ class InferenceServer:
         self._n_padded_rows = 0
         self._n_done = 0
         self._n_tokens = 0
-        self._latencies = collections.deque(maxlen=4096)
+        # fixed-bucket histograms (observability/metrics): O(1) memory
+        # for a million-request run; p50/p99 read from bucket counts
+        # (within one bucket width of exact — pinned in tests)
+        self._latencies = Histogram("paddle_tpu_request_latency_ms")
         # time-to-first-token: for one-shot inference (and the
         # whole-loop generation server) the first token and the last
         # arrive in the same readback, so TTFT == request latency —
         # recorded separately anyway so the continuous server's
         # stats() shape is identical and legs are comparable
-        self._ttft = collections.deque(maxlen=4096)
-        self._per_token = collections.deque(maxlen=4096)
+        self._ttft = Histogram("paddle_tpu_request_ttft_ms")
+        self._per_token = Histogram("paddle_tpu_per_token_ms")
         self._t_first_arrival = None
         self._t_last_done = None
         self._warmed_compiles = 0
         self._t_start = time.monotonic()   # monotonic uptime anchor
         self._t_window = self._t_start     # stats(reset=True) window
+        # observability: pull-provider registration (weakref — the
+        # registry reads these counters only at expose() time)
+        self._obs_id = _obs_server_id(self)
+        obs_metrics.register_provider(self)
 
         if start:
             self.start()
@@ -419,7 +459,14 @@ class InferenceServer:
                 f"{self.max_batch_size}; split it client-side")
         feed, key = self._bucket_seq(feed)
         reply = _Reply()
-        req = _Request(feed, rows, reply)
+        # request tracing: adopt the router's trace when one is parked
+        # in the ambient request context, else (standalone server at
+        # FLAGS_observability=trace) open a server-owned one
+        trace = obs_tracing.current_request_trace()
+        if trace is None:
+            trace = obs_tracing.start_request(owner="server",
+                                              server=self._obs_id)
+        req = _Request(feed, rows, reply, trace=trace)
         with self._cv:
             # not-yet-started servers QUEUE (start() drains them);
             # only closed/quiesced ones reject
@@ -537,6 +584,10 @@ class InferenceServer:
 
     def _dispatch(self, batch: List[_Request], rows: int):
         bucket = _bucket_for(rows, self.batch_buckets, "batch rows")
+        traces = [r.trace for r in batch if r.trace is not None]
+        exe = self._runner.executor
+        c0, d0 = exe.compile_count, exe.disk_load_count
+        t_d0 = time.monotonic()
         try:
             feed = {
                 name: _pad_rows(
@@ -545,12 +596,32 @@ class InferenceServer:
                     if len(batch) > 1 else batch[0].feed[name],
                     bucket)
                 for name in batch[0].feed}
-            outs = self._runner.run_batch(feed)
+            with obs_tracing.ambient(traces):
+                outs = self._runner.run_batch(feed)
         except BaseException as e:
             for r in batch:
+                # spans BEFORE set_exception: fulfilling the future
+                # fires the router's done-callback synchronously in
+                # this thread, which finishes router-owned traces —
+                # a span added after that is dropped by the sealed-
+                # trace guard, and errored requests are exactly the
+                # incidents whose timelines must stay complete
+                if r.trace is not None:
+                    r.trace.add_span("server.queue", r.t_arrival, t_d0)
                 r.reply.set_exception(e)
+                if r.trace is not None and r.trace.owner == "server":
+                    r.trace.finish(status="error", error=repr(e))
             return
         done_t = time.monotonic()
+        for r in batch:
+            if r.trace is not None:
+                # queue: arrival -> batch formation; dispatch: the
+                # whole padded-batch runner call (its execute/readback
+                # children were recorded inside run_batch)
+                r.trace.add_span("server.queue", r.t_arrival, t_d0)
+                r.trace.add_span("server.dispatch", t_d0, done_t,
+                                 rows=rows, bucket=bucket,
+                                 cache=_cache_tier(exe, c0, d0))
         # counters BEFORE fulfilling the futures: a caller unblocked
         # by set_result may read stats() immediately and must see the
         # batch that just completed
@@ -561,13 +632,13 @@ class InferenceServer:
             off = 0
             for r in batch:
                 lat = (done_t - r.t_arrival) * 1e3
-                self._latencies.append(lat)
-                self._ttft.append(lat)
+                self._latencies.observe(lat)
+                self._ttft.observe(lat)
                 ntok = self._tokens_in_rows(
                     np.asarray(outs[0])[off:off + r.rows])
                 if ntok:
                     self._n_tokens += ntok
-                    self._per_token.append(lat / ntok)
+                    self._per_token.observe(lat / ntok)
                 self._n_done += 1
                 off += r.rows
             self._t_last_done = done_t
@@ -576,6 +647,8 @@ class InferenceServer:
             r.reply.set_result([np.asarray(o)[off:off + r.rows]
                                 for o in outs])
             off += r.rows
+            if r.trace is not None and r.trace.owner == "server":
+                r.trace.finish()
 
     def _tokens_in_rows(self, rows) -> Optional[int]:
         """Generated-token count for the primary output rows of one
@@ -662,14 +735,19 @@ class InferenceServer:
     # --- observability ------------------------------------------------
     def stats(self, reset: bool = False) -> dict:
         """Atomic snapshot of the serving counters. With reset=True
-        the WINDOW counters (requests/batches/latency deques/...) are
-        zeroed under the same lock the batcher thread updates them
-        with, so an aggregator polling stats(reset=True) computes
-        per-window rates without racing in-flight updates. `uptime_s`
-        is monotonic since server start (never reset); `window_s` is
-        the span the returned counters cover. Executor counters
-        (compile/cache) are cumulative by design — delta them across
-        snapshots."""
+        the WINDOW counters (requests/batches/latency histograms/...)
+        are zeroed under the same lock the batcher thread updates
+        them with, so an aggregator polling stats(reset=True)
+        computes per-window rates without racing in-flight updates.
+        `uptime_s` is monotonic since server start (never reset);
+        `window_s` is the span the returned counters cover. Executor
+        counters (compile/cache) are cumulative by design — delta
+        them across snapshots. NOTE (r12 semantics change): p50/p99
+        come from fixed-bucket histograms that accumulate SINCE THE
+        LAST RESET, not from a recent-N-samples ring — a monitor that
+        wants the current regime (not lifetime) must poll with
+        reset=True windows; in exchange percentile memory is O(1) for
+        a million-request run."""
         exe = self._runner.executor
         with self._cv:
             now = time.monotonic()
@@ -717,6 +795,31 @@ class InferenceServer:
                 self._t_last_done = None
                 self._t_window = now
             return snap
+
+    def _metrics_samples(self):
+        """Pull-provider for observability.metrics.expose(): the same
+        counters stats() reports, as Prometheus samples."""
+        lab = {"server": self._obs_id}
+        with self._cv:
+            occ = (self._n_rows / self._n_padded_rows
+                   if self._n_padded_rows else 0.0)
+            return [
+                ("paddle_tpu_server_requests_total", lab,
+                 self._n_requests),
+                ("paddle_tpu_server_completed_total", lab,
+                 self._n_done),
+                ("paddle_tpu_server_batches_total", lab,
+                 self._n_batches),
+                ("paddle_tpu_server_queue_depth", lab,
+                 sum(len(g) for g in self._groups.values())),
+                ("paddle_tpu_server_batch_occupancy", lab, occ),
+                ("paddle_tpu_server_tokens_total", lab,
+                 self._n_tokens),
+                ("paddle_tpu_request_latency_ms", lab,
+                 self._latencies),
+                ("paddle_tpu_request_ttft_ms", lab, self._ttft),
+                ("paddle_tpu_per_token_ms", lab, self._per_token),
+            ]
 
 
 class GenerationServer(InferenceServer):
@@ -780,13 +883,16 @@ class GenerationServer(InferenceServer):
 
 
 class _GenRequest:
-    __slots__ = ("src", "reply", "t_arrival", "t_first")
+    __slots__ = ("src", "reply", "t_arrival", "t_first", "t_admit",
+                 "trace")
 
-    def __init__(self, src, reply):
+    def __init__(self, src, reply, trace=None):
         self.src = src
         self.reply = reply
         self.t_arrival = time.monotonic()
         self.t_first = None  # set when its first token lands
+        self.t_admit = None  # set when a slot admits it
+        self.trace = trace   # observability (see _Request.trace)
 
 
 class ContinuousGenerationServer:
@@ -905,13 +1011,17 @@ class ContinuousGenerationServer:
         self._n_tokens = 0
         self._n_ticks = 0
         self._occ_sum = 0.0
-        self._latencies = collections.deque(maxlen=4096)
-        self._ttft = collections.deque(maxlen=4096)
-        self._per_token = collections.deque(maxlen=4096)
+        # fixed-bucket histograms — same O(1)-memory contract as
+        # InferenceServer (observability/metrics)
+        self._latencies = Histogram("paddle_tpu_request_latency_ms")
+        self._ttft = Histogram("paddle_tpu_request_ttft_ms")
+        self._per_token = Histogram("paddle_tpu_per_token_ms")
         self._t_first_arrival = None
         self._t_last_done = None
         self._t_start = time.monotonic()
         self._t_window = self._t_start
+        self._obs_id = _obs_server_id(self)
+        obs_metrics.register_provider(self)
 
         if start:
             self.start()
@@ -991,7 +1101,11 @@ class ContinuousGenerationServer:
                 f"continuous generation takes one prompt row of "
                 f"exactly seq_len={self.bundle.seq_len} tokens; got "
                 f"shape {tuple(np.asarray(src_ids).shape)}")
-        req = _GenRequest(arr.astype(np.int64), _Reply())
+        trace = obs_tracing.current_request_trace()
+        if trace is None:
+            trace = obs_tracing.start_request(owner="server",
+                                              server=self._obs_id)
+        req = _GenRequest(arr.astype(np.int64), _Reply(), trace=trace)
         with self._cv:
             if self._closed:
                 raise ServerClosed(
@@ -1051,6 +1165,7 @@ class ContinuousGenerationServer:
                 # admit_buckets ladder may cover less than n_slots,
                 # and the overflow simply waits one cycle)
                 admits = []
+                t_admit = time.monotonic()
                 for slot in range(self.n_slots):
                     if not self._queue \
                             or len(admits) >= self._admit_buckets[-1]:
@@ -1058,6 +1173,11 @@ class ContinuousGenerationServer:
                     if self._lanes[slot] is None:
                         req = self._pop_next()
                         self._lanes[slot] = req
+                        req.t_admit = t_admit
+                        if req.trace is not None:
+                            req.trace.add_span("slotpool.queue",
+                                               req.t_arrival, t_admit,
+                                               slot=slot)
                         admits.append((slot, req))
                 occupied = sum(l is not None for l in self._lanes)
                 drain = not self._queue
@@ -1101,13 +1221,25 @@ class ContinuousGenerationServer:
         else:
             A = 0
         try:
-            outs = self._serves[A].run(feed, return_numpy=True)
+            c0 = self.executor.compile_count
+            d0 = self.executor.disk_load_count
+            with obs_tracing.ambient(
+                    [r.trace for r in self._lanes
+                     if r is not None and r.trace is not None]):
+                with obs_tracing.span("slotpool.dispatch",
+                                      admits=A, n_steps=n_steps) as sp:
+                    outs = self._serves[A].run(feed,
+                                               return_numpy=True)
+                    sp.attrs["cache"] = _cache_tier(
+                        self.executor, c0, d0)
         except BaseException as e:
             with self._cv:
                 lanes = [r for r in self._lanes if r is not None]
                 self._lanes = [None] * self.n_slots
             for r in lanes:
                 r.reply.set_exception(e)
+                if r.trace is not None and r.trace.owner == "server":
+                    r.trace.finish(status="error", error=repr(e))
             return
         tok_buf, step, active, _fin = outs
         done_t = time.monotonic()
@@ -1129,20 +1261,28 @@ class ContinuousGenerationServer:
                     ntok = int(count_generated_tokens(
                         toks[None], self._end_id)[0])
                     lat = (done_t - req.t_arrival) * 1e3
-                    self._latencies.append(lat)
-                    self._ttft.append(
+                    self._latencies.observe(lat)
+                    self._ttft.observe(
                         (req.t_first - req.t_arrival) * 1e3)
                     if ntok:
-                        self._per_token.append(lat / ntok)
+                        self._per_token.observe(lat / ntok)
                         self._n_tokens += ntok
                     self._n_done += 1
                     self._t_last_done = done_t
                     self._lanes[slot] = None
+                    if req.trace is not None:
+                        req.trace.add_span(
+                            "slotpool.decode",
+                            req.t_admit if req.t_admit is not None
+                            else req.t_arrival,
+                            done_t, slot=slot, tokens=ntok)
                     retired.append((req, toks))
             self._n_ticks += 1
             self._occ_sum += occupied / self.n_slots
         for req, toks in retired:
             req.reply.set_result(toks)
+            if req.trace is not None and req.trace.owner == "server":
+                req.trace.finish()
 
     # --- observability ------------------------------------------------
     def stats(self, reset: bool = False) -> dict:
@@ -1192,6 +1332,29 @@ class ContinuousGenerationServer:
                 self._t_last_done = None
                 self._t_window = now
             return snap
+
+    def _metrics_samples(self):
+        """Pull-provider for observability.metrics.expose()."""
+        lab = {"server": self._obs_id}
+        with self._cv:
+            occ = (self._occ_sum / self._n_ticks
+                   if self._n_ticks else 0.0)
+            return [
+                ("paddle_tpu_server_requests_total", lab,
+                 self._n_requests),
+                ("paddle_tpu_server_completed_total", lab,
+                 self._n_done),
+                ("paddle_tpu_server_queue_depth", lab,
+                 len(self._queue)),
+                ("paddle_tpu_server_slot_occupancy", lab, occ),
+                ("paddle_tpu_server_ticks_total", lab, self._n_ticks),
+                ("paddle_tpu_server_tokens_total", lab,
+                 self._n_tokens),
+                ("paddle_tpu_request_latency_ms", lab,
+                 self._latencies),
+                ("paddle_tpu_request_ttft_ms", lab, self._ttft),
+                ("paddle_tpu_per_token_ms", lab, self._per_token),
+            ]
 
 
 def count_generated_tokens(tokens: np.ndarray,

@@ -1,0 +1,108 @@
+"""Flight recorder: bounded ring of completed request timelines.
+
+Aggregate counters answer "is the fleet healthy"; they cannot answer
+"what did the request that blew its SLO at 14:03 actually do". The
+flight recorder keeps a bounded ring of recently completed request
+timelines and RETAINS (in a separate, smaller ring) the full timeline
+of every incident — an errored request, or one that finished over its
+tenant's SLO target — so the forensic record survives the churn of
+healthy traffic. ``incident_report()`` is the dump surface
+(``ServingRuntime.incident_report()`` forwards to it).
+
+Detail scales with the observability level (flags.py):
+
+* ``metrics`` — coarse timelines (submit/dispatch/done timestamps,
+  tenant/model/latency/status) recorded by the Router's completion
+  path directly; O(1) per request.
+* ``trace`` — full span trees: ``Trace.finish`` (tracing.py) routes
+  every sealed trace here, so an incident's entry carries the whole
+  router -> queue -> dispatch -> execute -> readback tree with compile
+  and cache-tier annotations.
+* ``off`` — ``record`` is a no-op.
+
+No direct reference counterpart: the reference's profiler
+(platform/profiler.cc) aggregates by event NAME; per-request retention
+is this runtime's addition (the shape follows crash/flight recorders
+in production serving stacks).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+from .metrics import REGISTRY, metrics_on
+
+__all__ = ["FlightRecorder", "RECORDER", "incident_report"]
+
+
+class FlightRecorder:
+    """Bounded rings of completed request timelines + retained
+    incidents (module docstring has the level semantics). No direct
+    reference counterpart — the reference profiler (platform/
+    profiler.cc) aggregates by event name; per-request retention
+    follows production crash/flight recorders."""
+
+    def __init__(self, max_recent: int = 256, max_incidents: int = 64):
+        self._lock = threading.Lock()
+        self.recent = collections.deque(maxlen=max_recent)
+        self.incidents = collections.deque(maxlen=max_incidents)
+        self.recorded_total = 0
+        self.incidents_total = 0
+
+    def record(self, timeline: dict, incident: bool = False):
+        """One completed request timeline (tracing.Trace.timeline()
+        shape, or the Router's coarse dict at metrics level). Gated
+        here (not at every caller) on FLAGS_observability."""
+        if not metrics_on():
+            return
+        with self._lock:
+            self.recorded_total += 1
+            self.recent.append(timeline)
+            if incident:
+                self.incidents_total += 1
+                self.incidents.append(timeline)
+
+    def incident_report(self, max_incidents: Optional[int] = None) \
+            -> dict:
+        """JSON-able forensic dump: every retained incident timeline
+        (newest last) + ring bookkeeping."""
+        with self._lock:
+            incidents = list(self.incidents)
+            if max_incidents is not None:
+                incidents = incidents[-int(max_incidents):]
+            return {
+                "generated_at": time.time(),
+                "recorded_total": self.recorded_total,
+                "incidents_total": self.incidents_total,
+                "incidents_retained": len(incidents),
+                "recent_retained": len(self.recent),
+                "incidents": incidents,
+            }
+
+    def _metrics_samples(self):
+        return [
+            ("paddle_tpu_flight_recorded_total", {},
+             self.recorded_total),
+            ("paddle_tpu_flight_incidents_total", {},
+             self.incidents_total),
+        ]
+
+    def reset(self):
+        with self._lock:
+            self.recent.clear()
+            self.incidents.clear()
+            self.recorded_total = 0
+            self.incidents_total = 0
+
+
+RECORDER = FlightRecorder()
+# Only the process-global ring is a metrics provider: private rings
+# (tests, bench microbench spins) must not emit duplicate
+# paddle_tpu_flight_* series into the exposition.
+REGISTRY.register_provider(RECORDER)
+
+
+def incident_report(max_incidents: Optional[int] = None) -> dict:
+    return RECORDER.incident_report(max_incidents=max_incidents)
