@@ -1000,6 +1000,272 @@ def bench_generation(n_requests=96):
     }
 
 
+def bench_paged(n_requests=192):
+    """Paged KV cache + prefix reuse vs the r10 dense slot pool
+    (models/decode_engine.py paged layout +
+    PagedContinuousGenerationServer), at MATCHED KV byte budgets —
+    the capacity story: the dense layout reserves the full
+    [maxT, ...] self-KV and a private cross-KV per lane, so its KV
+    budget carries 8 lanes; the same bytes as a shared block pool +
+    refcounted prompt entries carry 16 lanes at this workload's
+    mixed lengths, and a shared system prompt prefills ONCE (hit
+    admissions skip the encoder entirely).
+
+    Workload: 80% of requests use one of a few common prompts
+    (Zipf-weighted "system prompts" with model-driven mixed output
+    lengths via the terminator-copy task), 20% are unique — the
+    million-user traffic shape ROADMAP names.
+
+    Three INTERLEAVED legs (throttled-host discipline): the
+    whole-loop GenerationServer (the r10 baseline), the dense-slot
+    continuous server, and the paged server. Asserted (r13
+    acceptance, not just reported): token-exact parity vs the dense
+    whole-loop decode in the SAME measured legs, KV bytes per
+    admitted request >= 2x lower paged vs dense-slot, zero
+    steady-state compiles, and paged >= 1.5x the WHOLE-LOOP dense
+    decode's tok/s. The paged-vs-dense-SLOT ratio is recorded
+    unasserted: on this 2-core host, per-tick cost is LINEAR in
+    static lanes, so doubling lanes at matched KV bytes roughly
+    doubles tick cost and the capacity lever cannot show up as CPU
+    tok/s — on the real chip the decode matmuls underutilize the MXU
+    and extra lanes are nearly free, which is where requests-per-
+    HBM-byte converts to throughput (PERF.md "Paged KV + prefix
+    reuse" has the arithmetic).
+
+    CPU-PINNED by design (same reasoning as bench_generation).
+    Fail-fast (exit 3) on a dead backend is inherited from main()'s
+    _probe_backend. Writes BENCH_SELF_r13.json."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.inference import (ContinuousGenerationServer,
+                                      GenerationServer,
+                                      PagedContinuousGenerationServer,
+                                      apply_eos_sentinel,
+                                      count_generated_tokens)
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.models.decode_engine import CacheConfig
+
+    V, D, L, S, maxT = 16, 128, 2, 12, 64
+    end_id = 1
+    dense_slots, paged_slots = 8, 12
+    rng = np.random.RandomState(7)
+
+    def term_prompt(r, p):
+        src = r.randint(3, V, (S,)).astype(np.int64)
+        if p < S:
+            src[p:] = end_id
+        return src
+
+    # train the terminator-copy task (d128/L2 needs the lr/steps
+    # ladder from CLAUDE.md) so output lengths are model-driven; the
+    # workload below must only use terminator placements the model
+    # SAW here, or untrained placements decode to full buffers and
+    # silently flip the length mix
+    scope = Scope()
+    with unique_name.guard():
+        main_p, startup, loss = T.build_program(
+            seq_len=S, d_model=D, n_heads=2, n_layers=L, d_inner=128,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+        with fluid.program_guard(main_p, startup):
+            fluid.optimizer.Adam(learning_rate=0.002).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    for _ in range(600):
+        src = np.stack([term_prompt(
+            rng, int(rng.choice([2, 3, 5, S], p=[.4, .25, .15, .2])))
+            for _ in range(8)])
+        tgt_in = np.concatenate(
+            [np.full((8, 1), 2, np.int64), src[:, :-1]], 1)
+        exe.run(main_p, feed={"src_ids": src, "tgt_ids": tgt_in,
+                              "label": src}, fetch_list=[loss],
+                scope=scope)
+
+    kwargs = dict(seq_len=S, max_out_len=maxT, d_model=D, n_heads=2,
+                  n_layers=L, d_inner=128, vocab=V, start_id=2,
+                  end_id=end_id)
+    with unique_name.guard():
+        inc_m, _, _, inc_buf = T.build_incremental_decode_program(
+            **kwargs)
+    with unique_name.guard():
+        dense = T.build_decode_step_program(n_slots=dense_slots,
+                                            **kwargs)
+    # 12 lanes / 24 blocks: ~2.1x fewer KV bytes per admitted
+    # request than dense-8, with the static-row count low enough that
+    # the CPU's lane-linear tick cost doesn't eat the whole capacity
+    # win (16 lanes measured 1.1x the whole-loop leg; the full
+    # CPU-vs-TPU arithmetic is in PERF.md), and enough blocks that
+    # the 20%-long Zipf tail paginates without preemption thrash
+    cache = CacheConfig(layout="paged", block_size=16, n_blocks=24,
+                        n_prompt_entries=8)
+    with unique_name.guard():
+        paged = T.build_decode_step_program(
+            n_slots=paged_slots, state_prefix="@pgb/", cache=cache,
+            **kwargs)
+    # the capacity premise: 2x the lanes in FEWER KV bytes
+    assert paged.kv_state_bytes() <= dense.kv_state_bytes(), (
+        paged.kv_state_bytes(), dense.kv_state_bytes())
+
+    # shared-prefix workload: 80% of traffic uses one of 4 common
+    # "system prompts" (Zipf-weighted, mixed model-driven lengths),
+    # 20% unique prompts
+    wl_rng = np.random.RandomState(31)
+    common = [term_prompt(wl_rng, p) for p in (1, 2, 3, S)]
+    zipf = np.array([1.0 / (r + 1) ** 1.1 for r in range(4)])
+    zipf = 0.8 * zipf / zipf.sum()
+    srcs = []
+    for _ in range(n_requests):
+        u = wl_rng.rand()
+        acc = 0.0
+        row = None
+        for k in range(4):
+            acc += zipf[k]
+            if u < acc:
+                row = common[k]
+                break
+        if row is None:
+            row = term_prompt(wl_rng, int(wl_rng.choice(
+                [1, 2, 3, S], p=[.4, .25, .15, .2])))
+        srcs.append(row)
+    srcs = np.stack(srcs)
+    ref, = exe.run(inc_m, feed={"src_ids": srcs},
+                   fetch_list=[inc_buf], scope=scope)
+    want = apply_eos_sentinel(np.asarray(ref), end_id)
+    lens = count_generated_tokens(want, end_id)
+    total_tokens = int(lens.sum())
+
+    def run_leg(make_server):
+        srv = make_server()
+        try:
+            t0 = time.perf_counter()
+            replies = [srv.submit(s) for s in srcs]
+            outs = [rep.result(600.0) for rep in replies]
+            wall = time.perf_counter() - t0
+            st = srv.stats()
+        finally:
+            srv.close()
+        # parity IN the measured leg: a fast leg that decoded wrong
+        # tokens would be meaningless
+        assert all(np.array_equal(np.asarray(o), want[i])
+                   for i, o in enumerate(outs)), \
+            "token parity vs the whole-loop decode failed"
+        return {"wall_s": wall, "tok_s": total_tokens / wall,
+                "stats": st}
+
+    def whole_loop_leg():
+        srv = GenerationServer(
+            inc_m, inc_buf, executor=exe, scope=scope, end_id=end_id,
+            max_batch_size=dense_slots, max_wait_ms=2.0)
+        try:
+            t0 = time.perf_counter()
+            replies = [srv.submit({"src_ids": s[None]}) for s in srcs]
+            outs = [apply_eos_sentinel(
+                np.asarray(rep.result(600.0)[0]), end_id)[0]
+                for rep in replies]
+            wall = time.perf_counter() - t0
+            st = srv.stats()
+        finally:
+            srv.close()
+        assert all(np.array_equal(o, want[i])
+                   for i, o in enumerate(outs)), \
+            "whole-loop leg parity failed"
+        return {"wall_s": wall, "tok_s": total_tokens / wall,
+                "stats": st}
+
+    def dense_leg():
+        return run_leg(lambda: ContinuousGenerationServer(
+            dense, executor=exe, scope=scope, steps_per_tick=8))
+
+    def paged_leg():
+        return run_leg(lambda: PagedContinuousGenerationServer(
+            paged, executor=exe, scope=scope, steps_per_tick=8))
+
+    whole_loop_leg()  # warm all three serve sets (all compiles here)
+    dense_leg()
+    paged_leg()
+    compiles_before = exe.compile_count
+    # INTERLEAVED best-of-3 (r10 discipline): adjacent legs share
+    # this host's CPU-share throttle windows
+    triples = [(whole_loop_leg(), dense_leg(), paged_leg())
+               for _ in range(3)]
+    steady_compiles = exe.compile_count - compiles_before
+    assert steady_compiles == 0, (
+        f"steady-state legs compiled {steady_compiles}")
+    wbest = min((w for w, _, _ in triples), key=lambda r: r["wall_s"])
+    dbest = min((d for _, d, _ in triples), key=lambda r: r["wall_s"])
+    pbest = min((p for _, _, p in triples), key=lambda r: r["wall_s"])
+    # the ASSERTED ratio is the best PAIRED one (the r10 guard-test
+    # method): adjacent legs of a triple share this host's throttle
+    # window, while ratios of global bests can pit one leg's lucky
+    # window against another's throttled one
+    speedup_vs_whole = max(p["tok_s"] / w["tok_s"]
+                           for w, _, p in triples)
+    ratio_vs_dense_slot = max(p["tok_s"] / d["tok_s"]
+                              for _, d, p in triples)
+    triple_toks = [(round(w["tok_s"]), round(d["tok_s"]),
+                    round(p["tok_s"])) for w, d, p in triples]
+    assert speedup_vs_whole >= 1.5, (
+        f"paged tok/s only {speedup_vs_whole:.2f}x the whole-loop "
+        f"decode on the shared-prefix workload (paired triples: "
+        f"{triple_toks})")
+
+    dense_kv_req = dense.kv_state_bytes() / dense_slots
+    paged_kv_req = paged.kv_state_bytes() / paged_slots
+    kv_ratio = dense_kv_req / paged_kv_req
+    assert kv_ratio >= 2.0, (
+        f"KV bytes per admitted request only {kv_ratio:.2f}x lower")
+    pst = pbest["stats"]
+    bp = pst["block_pool"]
+    hit_rate = bp["prefix_hits"] / max(
+        1, bp["prefix_hits"] + bp["prefix_misses"] + bp["cow_copies"])
+    result = {
+        "metric": "paged_kv_tokens_per_sec_shared_prefix",
+        "value": round(pbest["tok_s"], 1),
+        "unit": "tokens/sec",
+        "whole_loop_tok_s": round(wbest["tok_s"], 1),
+        "dense_slot_tok_s": round(dbest["tok_s"], 1),
+        "paged_tok_s": round(pbest["tok_s"], 1),
+        "speedup_vs_whole_loop": round(speedup_vs_whole, 2),
+        "ratio_vs_dense_slot": round(ratio_vs_dense_slot, 2),
+        "ratio_vs_dense_slot_note": (
+            "unasserted: CPU tick cost is linear in static lanes, so "
+            "2x lanes at matched KV bytes ~2x the tick — the "
+            "capacity lever converts to tok/s only where lanes are "
+            "near-free (real-chip MXU; PERF.md)"),
+        "triple_tok_s": [[round(w["tok_s"], 1), round(d["tok_s"], 1),
+                          round(p["tok_s"], 1)]
+                         for w, d, p in triples],
+        "token_parity_vs_whole_loop": True,  # asserted per leg
+        "steady_state_compiles": int(steady_compiles),
+        "kv_bytes_per_request": {
+            "dense": int(dense_kv_req), "paged": int(paged_kv_req),
+            "ratio": round(kv_ratio, 2)},
+        "requests_per_kv_byte": {
+            "dense": dense_slots / dense.kv_state_bytes(),
+            "paged": paged_slots / paged.kv_state_bytes()},
+        "prefix_hit_rate": round(hit_rate, 3),
+        "block_pool": bp,
+        "slots": {"dense": dense_slots, "paged": paged_slots},
+        "cache": {"block_size": cache.block_size,
+                  "n_blocks": cache.n_blocks,
+                  "n_prompt_entries": cache.n_prompt_entries},
+        "workload": "80% shared system prompts (Zipf over 4), "
+                    "20% unique; terminator-copy mixed lengths",
+        "len_histogram": {int(k): int(v) for k, v in
+                          zip(*np.unique(lens, return_counts=True))},
+        "n_requests": n_requests,
+        "total_tokens": total_tokens,
+        "model": f"transformer d{D} L{L} S{S} maxT{maxT}",
+        "best_of": 3,
+    }
+    return _write_bench_self("BENCH_SELF_r13.json", result,
+                             stats_json_dict=pst)
+
+
 def bench_multitenant(n_requests=900):
     """Restore-safe wrapper: the body flips FLAGS_observability
     across legs with hard asserts in between, and main() keeps going
@@ -1329,6 +1595,7 @@ EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
                  "serving": bench_serving,
                  "coldstart": bench_coldstart,
                  "generation": bench_generation,
+                 "paged": bench_paged,
                  "multitenant": bench_multitenant}
 
 

@@ -27,6 +27,9 @@ a stable diagnostic code so tests/docs can reference the class:
           trap: run_steps/prepare(steps=K) seed it with zeros)
   PTA100  cross-model param-name collision (co-resident serving
           runtime models aliasing/clobbering one scope's weights)
+  PTA110  shared-pool write not provably lane-exclusive (paged KV
+          block pools: aliased scatter = silent cross-request KV
+          corruption)
 
 Severities: "error" = the program is wrong (strict mode raises),
 "warning" = almost certainly a bug but a legal feed/scope could save
@@ -888,8 +891,90 @@ def check_write_only_carry(program: Program):
             f"scan-carry slot with zeros of the declared shape/dtype",
             block_idx=blk.idx, op_idx=first, op_type=op.type, var=name,
             hint="declare it with a concrete shape and dtype "
-                 "(models/transformer._declare_slot_state does), or "
+                 "(models/decode_engine._declare_slot_state does), or "
                  "read-modify-write it so it rides state_in")
+
+
+# ---------------------------------------------------------------------------
+# PTA110: shared-pool writes must be provably lane-exclusive.
+# ---------------------------------------------------------------------------
+POOL_MARK = "@POOL"
+
+# the builder-declared reasons row indices of a shared-pool write
+# cannot alias (layers/extras.py masked_pool_write documents both)
+_POOL_EXCLUSIVE_VIA = ("block_table", "host_indices")
+
+
+@register_checker("PTA110", "shared-pool-write-exclusive")
+def check_shared_pool_writes(program: Program):
+    """Writes into a SHARED decode KV block pool (persistable vars
+    carrying the @POOL name mark — models/decode_engine.py paged
+    layout) must be provably lane-exclusive: unlike the per-lane
+    dense buffers, a pool cell is not owned by a row index, so an
+    aliased or unmasked scatter silently corrupts ANOTHER request's
+    KV — generations stay plausible and no error ever surfaces,
+    which makes this the nastiest paged-serving failure class.
+
+    Provably exclusive means: the ONE blessed writer op
+    (``masked_pool_write``: disjoint one-hot masks, clamped keep
+    mask), reading the pool it writes (read-modify-write, so the
+    pool rides the executor's state_in path instead of tripping the
+    PTA090 write-only-carry trap), carrying the builder's
+    ``exclusive_via`` declaration ('block_table' = per-lane blocks
+    from the host free-list, 'host_indices' = host-deduplicated
+    admission targets), and — for block-table writes — an active-lane
+    ``Gate`` so idle/dustbin/paused lanes write nothing."""
+    for site in iter_ops(program):
+        op = site.op
+        hit = [n for n in op.output_arg_names if POOL_MARK in n]
+        if not hit:
+            continue
+        if any(isinstance(v, Block) for v in op.attrs.values()):
+            # container ops (while/cond) surface their sub-blocks'
+            # writes as their own output slots; the actual writer
+            # inside the sub-block is what this sweep judges
+            continue
+        var = op.block._find_var_recursive(hit[0]) \
+            if op.block is not None else None
+        if var is not None and not var.persistable:
+            continue
+        name = hit[0]
+        if op.type != "masked_pool_write":
+            yield _diag_at(
+                "PTA110", ERROR, site,
+                f"op {op.type!r} writes shared block pool {name!r} "
+                f"directly; only masked_pool_write's disjoint one-hot "
+                f"scatter is provably lane-exclusive — anything else "
+                f"is the silent cross-request KV corruption class",
+                var=name,
+                hint="route the write through layers.masked_pool_"
+                     "write(pool, new, index, gate, exclusive_via=...)")
+            continue
+        if name not in op.input_arg_names:
+            yield _diag_at(
+                "PTA110", ERROR, site,
+                f"masked_pool_write writes {name!r} without reading "
+                f"it: the keep-mask read-modify-write is what "
+                f"preserves other lanes' cells (and keeps the pool "
+                f"on the state_in path — see PTA090)", var=name)
+            continue
+        via = op.attrs.get("exclusive_via")
+        if via not in _POOL_EXCLUSIVE_VIA:
+            yield _diag_at(
+                "PTA110", ERROR, site,
+                f"masked_pool_write into {name!r} carries "
+                f"exclusive_via={via!r}; the builder must declare why "
+                f"row indices cannot alias "
+                f"({'/'.join(_POOL_EXCLUSIVE_VIA)})", var=name)
+            continue
+        if via == "block_table" and not op.inputs.get("Gate"):
+            yield _diag_at(
+                "PTA110", ERROR, site,
+                f"block-table write into {name!r} has no Gate input: "
+                f"idle/dustbin/paused lanes (active=0) would scatter "
+                f"through stale table rows into blocks other lanes "
+                f"own", var=name,
+                hint="pass gate=cast(active, 'float32')")
 
 
 # ---------------------------------------------------------------------------

@@ -88,6 +88,7 @@ def _machine_translation():
 
 def _transformer():
     from ..models import transformer as tr
+    from ..models.decode_engine import CacheConfig
 
     kw = dict(seq_len=16, d_model=64, n_heads=4, n_layers=2,
               d_inner=128, vocab=1000)
@@ -99,17 +100,34 @@ def _transformer():
     beam = tr.build_beam_decode_program(**dkw)
     bundle = tr.build_decode_step_program(n_slots=4, **dkw)
     big = max(bundle.prefills)
+    # paged decode-engine layout (block pool + prefix entries): the
+    # PTA110 shared-pool sweep and the rest of the suite cover every
+    # program flavor the paged server dispatches
+    paged = tr.build_decode_step_program(
+        n_slots=4, state_prefix="@cbp/",
+        cache=CacheConfig(layout="paged", block_size=4, n_blocks=8,
+                          n_prompt_entries=3), **dkw)
+    pbig = max(paged.prefills)
     return ({"main": main, "startup": startup, "greedy": greedy[0],
              "incremental": incr[0], "beam": beam[0],
              "cb_prefill": bundle.prefill,
              f"cb_prefill{big}": bundle.prefills[big],
              "cb_step": bundle.step,
              "cb_serve0": bundle.serves[0],
-             f"cb_serve{big}": bundle.serves[big]},
+             f"cb_serve{big}": bundle.serves[big],
+             "pg_prefill": paged.prefill,
+             f"pg_hit_prefill{pbig}": paged.hit_prefills[pbig],
+             "pg_step": paged.step,
+             "pg_serve0": paged.serves[0],
+             f"pg_serve_miss{pbig}": paged.serves[("miss", pbig)],
+             f"pg_serve_hit{pbig}": paged.serves[("hit", pbig)]},
             [("main", "greedy"), ("main", "incremental"),
              ("main", "beam"), ("main", "cb_prefill"),
              ("main", f"cb_prefill{big}"), ("main", "cb_step"),
-             ("main", "cb_serve0"), ("main", f"cb_serve{big}")])
+             ("main", "cb_serve0"), ("main", f"cb_serve{big}"),
+             ("main", "pg_prefill"), ("main", "pg_step"),
+             ("main", f"pg_serve_miss{pbig}"),
+             ("main", f"pg_serve_hit{pbig}")])
 
 
 def _moe_transformer():

@@ -18,10 +18,11 @@ from .export import (StableHLOServer, StableHLOTrainer,
                      load_stablehlo, load_train_stablehlo)
 from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
-from .serving import (ContinuousGenerationServer, GenerationServer,
-                      InferenceServer, ServerClosed, ServerQuiesced,
-                      apply_eos_sentinel, count_generated_tokens,
-                      default_batch_buckets)
+from .serving import (BlockPoolExhausted, ContinuousGenerationServer,
+                      GenerationServer, InferenceServer,
+                      PagedContinuousGenerationServer, ServerClosed,
+                      ServerQuiesced, apply_eos_sentinel,
+                      count_generated_tokens, default_batch_buckets)
 from .runtime import (AdmissionError, ModelRegistry, Router,
                       ServingRuntime)
 
@@ -32,6 +33,7 @@ __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "StableHLOTrainer", "export_train_stablehlo",
            "load_train_stablehlo", "InferenceServer",
            "GenerationServer", "ContinuousGenerationServer",
+           "PagedContinuousGenerationServer", "BlockPoolExhausted",
            "ServerClosed", "ServerQuiesced", "apply_eos_sentinel",
            "count_generated_tokens", "default_batch_buckets",
            "ServingRuntime", "ModelRegistry", "Router",

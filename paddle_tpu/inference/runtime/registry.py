@@ -57,9 +57,22 @@ def server_fingerprint(server) -> str:
     if bundle is not None:
         from ...core.compile_cache import canonical_digest
 
+        # the KV-cache layout token (dense vs paged, block_size,
+        # n_blocks, prompt entries) is part of the identity: two
+        # servers differing ONLY in block-pool layout serve different
+        # executables and different capacity envelopes, so they must
+        # not dedupe or hot-swap as "same fingerprint". (The serve
+        # fingerprints already differ — pool var shapes are hashed —
+        # but the explicit token keeps that guarantee even for
+        # layouts that happen to produce structurally identical
+        # programs.)
+        cache_token = getattr(bundle, "cache_token", None)
         return canonical_digest(
-            {str(a): prog.fingerprint()
-             for a, prog in sorted(bundle.serves.items())})
+            {"cache": list(cache_token()) if cache_token else None,
+             "serves": {str(a): prog.fingerprint()
+                        for a, prog in sorted(
+                            bundle.serves.items(),
+                            key=lambda kv: str(kv[0]))}})
     raise TypeError(
         f"cannot fingerprint {type(server).__name__}: expected an "
         f"InferenceServer-style server (with ._runner.program) or a "
@@ -84,7 +97,8 @@ def _server_programs(server):
         return [runner.program]
     bundle = getattr(server, "bundle", None)
     if bundle is not None:
-        return [prog for _a, prog in sorted(bundle.serves.items())]
+        return [prog for _a, prog in sorted(bundle.serves.items(),
+                                            key=lambda kv: str(kv[0]))]
     return []
 
 

@@ -27,7 +27,7 @@ micro-batch — plus the Clipper/ORT-style dynamic-batching discipline
   compiler path.
 * **GenerationServer** — routes multi-token requests through the
   KV-cached While-loop decode program
-  (models/transformer.py:373 build_incremental_decode_program), so a
+  (models/decode_engine.py build_incremental_decode_program), so a
   T-token generation is ONE dispatch + ONE readback instead of T.
 * **ContinuousGenerationServer** — iteration-level scheduling over a
   fixed slot pool (Orca OSDI'22 / vLLM SOSP'23, PAPERS.md): a
@@ -36,6 +36,12 @@ micro-batch — plus the Clipper/ORT-style dynamic-batching discipline
   prefill dispatch, and EOS'd lanes retire IMMEDIATELY — no
   head-of-line blocking on the longest request in a batch, which is
   the whole-loop server's structural cost under mixed output lengths.
+* **PagedContinuousGenerationServer** — the same scheduler over the
+  PAGED KV layout (models/decode_engine.py): host-allocated block
+  tables over a shared self-KV pool, prefix-cache admission
+  (hit/partial/miss tiers; a repeated system prompt prefills once),
+  block-pool backpressure with the named retryable
+  ``BlockPoolExhausted``, and block-pool gauges.
 
 Observability: `stats()` returns queue depth, batch occupancy, compile
 and cache-hit counts (Executor.compile_count / cache_hit_count),
@@ -58,6 +64,8 @@ import numpy as np
 from ..core.executor import Executor, PreparedCache, TPUPlace
 from ..core.scope import global_scope
 from ..core.types import to_np_dtype
+from ..models.decode_engine import (BlockPoolExhausted, HostBlockPool,
+                                    PromptPrefixCache)
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 from ..observability.metrics import Histogram
@@ -828,7 +836,8 @@ class GenerationServer(InferenceServer):
     is the decode loop being served).
 
     Wraps the KV-cached incremental decode program
-    (models/transformer.py:373): the whole T-token greedy loop is ONE
+    (models/decode_engine.py, re-exported by models/transformer.py):
+    the whole T-token greedy loop is ONE
     While-loop executable, so a served generation costs one dispatch +
     one readback regardless of output length, and concurrent requests
     share it through the same bucket ladder as plain inference.
@@ -933,7 +942,7 @@ class ContinuousGenerationServer:
     Greedy parity: a lane's token row equals the whole-loop decode of
     the same prompt after apply_eos_sentinel, independent of admission
     order or slot assignment — the step program's math IS the
-    whole-loop body (models/transformer._cached_decoder_step) and
+    whole-loop body (models/decode_engine.cached_decoder_step) and
     every op is row-wise, so co-resident lanes cannot interact.
     """
 
@@ -943,6 +952,19 @@ class ContinuousGenerationServer:
                  exit_on_retire: bool = False,
                  admit_select=None,
                  start: bool = True):
+        bundle_cache = getattr(bundle, "cache", None)
+        if (type(self) is ContinuousGenerationServer
+                and bundle_cache is not None
+                and bundle_cache.layout != "dense"):
+            # the mirror of the paged subclass's dense-bundle check:
+            # this scheduler never publishes block tables / active
+            # masks, so serving a paged bundle here would fail every
+            # admission with an opaque KeyError at best
+            raise ValueError(
+                f"ContinuousGenerationServer serves DENSE bundles; "
+                f"this bundle's KV layout is "
+                f"{bundle_cache.layout!r} — use "
+                f"PagedContinuousGenerationServer")
         self.bundle = bundle
         self.executor = executor or Executor(TPUPlace(0))
         self.scope = scope or global_scope()
@@ -968,24 +990,25 @@ class ContinuousGenerationServer:
 
         # bind the prepared handles up front (= AOT warmup: all
         # compiles happen HERE, none in the traffic window): one fused
-        # serve program per admission bucket (0 = tick-only)
+        # serve program per admission flavor x bucket (0 = tick-only)
         before = self.executor.compile_count
-        S = bundle.seq_len
         st = bundle.state
         self._fetches = [st["tok_buf"], st["step"], st["active"],
                          st["finished"]]
         self._serves = {}
-        for A, prog in sorted(bundle.serves.items()):
-            feed = [("n_steps", (1,), "int64"),
-                    ("min_active", (1,), "int64")]
-            if A > 0:
-                feed = [("src_ids", (A, S), "int64"),
-                        ("slots", (A,), "int64")] + feed
-            self._serves[A] = self.executor.prepare(
-                prog, feed=feed, fetch_list=self._fetches,
-                scope=self.scope)
-        self._admit_buckets = sorted(a for a in self._serves if a > 0)
+        for key, prog in sorted(bundle.serves.items(),
+                                key=lambda kv: str(kv[0])):
+            self._serves[key] = self.executor.prepare(
+                prog, feed=bundle.serve_feed_spec(key),
+                fetch_list=self._fetches, scope=self.scope)
+        self._admit_buckets = sorted(
+            {k for k in self._serves if isinstance(k, int) and k > 0}
+            | {k[1] for k in self._serves if isinstance(k, tuple)})
         self._warmed_compiles = self.executor.compile_count - before
+        # lanes the scheduler parked because the shared KV pool could
+        # not cover their next burst (paged layout only; always empty
+        # on the dense server) — the retire sweep must skip them
+        self._paused: set = set()
 
         self._cv = threading.Condition()
         self._queue: "collections.deque[_GenRequest]" = \
@@ -1150,49 +1173,99 @@ class ContinuousGenerationServer:
         self._queue.rotate(idx)
         return req
 
+    def _plan_admissions_locked(self, failures):
+        """FIFO admission into free slots (arrival order is the
+        fairness contract, admit_select the pluggable override; slots
+        assigned lowest-index-first; at most the largest admission
+        bucket per cycle — a custom admit_buckets ladder may cover
+        less than n_slots, and the overflow simply waits one cycle).
+        Called under _cv; `failures` collects (req, exc) pairs the
+        caller fails OUTSIDE the lock (paged exhaustion path)."""
+        admits = []
+        t_admit = time.monotonic()
+        for slot in range(self.n_slots):
+            if not self._queue \
+                    or len(admits) >= self._admit_buckets[-1]:
+                break
+            if self._lanes[slot] is None:
+                req = self._pop_next()
+                self._lanes[slot] = req
+                req.t_admit = t_admit
+                if req.trace is not None:
+                    req.trace.add_span("slotpool.queue",
+                                       req.t_arrival, t_admit,
+                                       slot=slot)
+                admits.append((slot, req))
+        return admits
+
+    def _plan_burst_locked(self, admits, drain, failures):
+        """Burst policy for the coming cycle: (n_steps, min_active,
+        run). Paged scheduling overrides this to cap the burst at the
+        allocated block coverage. Called under _cv."""
+        occupied = sum(l is not None for l in self._lanes)
+        if not occupied:
+            return 0, 0, False
+        n = self.drain_steps if drain else self.steps_per_tick
+        m = occupied - 1 if (self.exit_on_retire and not drain) else 0
+        return n, max(0, m), True
+
+    def _admission_feed(self, admits):
+        """(serve key, admission feeds) for this cycle's admits;
+        padded rows replicate the last prompt and scatter to the
+        dustbin lane."""
+        A = _bucket_for(len(admits), self._admit_buckets,
+                        "admission batch")
+        feed = {
+            "src_ids": np.concatenate(
+                [req.src for _, req in admits]
+                + [admits[-1][1].src] * (A - len(admits)), axis=0),
+            "slots": np.array(
+                [slot for slot, _ in admits]
+                + [self.bundle.dustbin] * (A - len(admits)),
+                np.int64)}
+        return A, feed
+
+    def _pre_dispatch(self):
+        """Hook: publish host-owned state (paged block tables) just
+        before the fused dispatch."""
+
+    def _post_dispatch(self, outs):
+        """Hook: absorb fetched state (paged per-lane step counters)
+        right after a successful dispatch."""
+
+    def _release_lane(self, slot, req):
+        """Hook: a lane stopped serving `req` (retired, errored, or
+        failed) — paged scheduling frees its blocks/prompt entry."""
+
+    def _fail_requests(self, failures):
+        for req, exc in failures:
+            req.reply.set_exception(exc)
+            if req.trace is not None and req.trace.owner == "server":
+                req.trace.finish(status="error", error=repr(exc))
+
     def _loop(self):
         while True:
+            failures = []
             with self._cv:
                 while self._running and not self._queue \
                         and all(l is None for l in self._lanes):
                     self._cv.wait()
                 if not self._running:
                     return
-                # FIFO admission into free slots (arrival order is the
-                # fairness contract, admit_select the pluggable
-                # override; slots assigned lowest-index-first; at most
-                # the largest admission bucket per cycle — a custom
-                # admit_buckets ladder may cover less than n_slots,
-                # and the overflow simply waits one cycle)
-                admits = []
-                t_admit = time.monotonic()
-                for slot in range(self.n_slots):
-                    if not self._queue \
-                            or len(admits) >= self._admit_buckets[-1]:
-                        break
-                    if self._lanes[slot] is None:
-                        req = self._pop_next()
-                        self._lanes[slot] = req
-                        req.t_admit = t_admit
-                        if req.trace is not None:
-                            req.trace.add_span("slotpool.queue",
-                                               req.t_arrival, t_admit,
-                                               slot=slot)
-                        admits.append((slot, req))
-                occupied = sum(l is not None for l in self._lanes)
+                admits = self._plan_admissions_locked(failures)
                 drain = not self._queue
-                if admits or occupied:
-                    self._busy = True  # drain() waits on this
-            if admits or occupied:
                 # empty queue: let the burst run — the device loop
                 # exits by itself once the pool drains
+                n_steps, min_active, run = self._plan_burst_locked(
+                    admits, drain, failures)
+                if run:
+                    self._busy = True  # drain() waits on this
+            # failing futures fires their done-callbacks synchronously
+            # — never under the scheduler lock
+            self._fail_requests(failures)
+            if run:
                 try:
-                    self._cycle(admits,
-                                self.drain_steps if drain
-                                else self.steps_per_tick,
-                                occupied - 1 if (self.exit_on_retire
-                                                 and not drain)
-                                else 0)
+                    self._cycle(admits, n_steps, min_active)
                 finally:
                     with self._cv:
                         self._busy = False
@@ -1200,26 +1273,18 @@ class ContinuousGenerationServer:
 
     def _cycle(self, admits, n_steps, min_active):
         """ONE fused dispatch per scheduler cycle: admit up to A
-        queued prompts (padded rows replicate the last prompt and
-        scatter to the dustbin lane) and run decode ticks over every
-        live lane until n_steps ran or the live-lane count drops to
-        min_active — admission cost scales with buckets, not
-        requests, and the dispatch overhead amortizes over the whole
-        burst."""
+        queued prompts and run decode ticks over every live lane
+        until n_steps ran or the live-lane count drops to min_active
+        — admission cost scales with buckets, not requests, and the
+        dispatch overhead amortizes over the whole burst."""
         feed = {"n_steps": np.array([n_steps], np.int64),
                 "min_active": np.array([max(0, min_active)],
                                        np.int64)}
+        key = 0
         if admits:
-            A = _bucket_for(len(admits), self._admit_buckets,
-                            "admission batch")
-            feed["src_ids"] = np.concatenate(
-                [req.src for _, req in admits]
-                + [admits[-1][1].src] * (A - len(admits)), axis=0)
-            feed["slots"] = np.array(
-                [slot for slot, _ in admits]
-                + [self.bundle.dustbin] * (A - len(admits)), np.int64)
-        else:
-            A = 0
+            key, extra = self._admission_feed(admits)
+            feed.update(extra)
+        self._pre_dispatch()
         try:
             c0 = self.executor.compile_count
             d0 = self.executor.disk_load_count
@@ -1227,20 +1292,26 @@ class ContinuousGenerationServer:
                     [r.trace for r in self._lanes
                      if r is not None and r.trace is not None]):
                 with obs_tracing.span("slotpool.dispatch",
-                                      admits=A, n_steps=n_steps) as sp:
-                    outs = self._serves[A].run(feed,
-                                               return_numpy=True)
+                                      admits=len(admits),
+                                      n_steps=n_steps) as sp:
+                    outs = self._serves[key].run(feed,
+                                                 return_numpy=True)
                     sp.attrs["cache"] = _cache_tier(
                         self.executor, c0, d0)
         except BaseException as e:
             with self._cv:
-                lanes = [r for r in self._lanes if r is not None]
+                lanes = [(slot, r)
+                         for slot, r in enumerate(self._lanes)
+                         if r is not None]
+                for slot, r in lanes:
+                    self._release_lane(slot, r)
                 self._lanes = [None] * self.n_slots
-            for r in lanes:
+            for _slot, r in lanes:
                 r.reply.set_exception(e)
                 if r.trace is not None and r.trace.owner == "server":
                     r.trace.finish(status="error", error=repr(e))
             return
+        self._post_dispatch(outs)
         tok_buf, step, active, _fin = outs
         done_t = time.monotonic()
         retired = []
@@ -1253,7 +1324,7 @@ class ContinuousGenerationServer:
                 occupied += 1
                 if req.t_first is None:
                     req.t_first = done_t  # first token just landed
-                if active[slot] == 0:
+                if active[slot] == 0 and slot not in self._paused:
                     # EOS emitted (or buffer full): retire NOW, free
                     # the slot for the next arrival
                     toks = apply_eos_sentinel(
@@ -1269,6 +1340,7 @@ class ContinuousGenerationServer:
                         self._n_tokens += ntok
                     self._n_done += 1
                     self._t_last_done = done_t
+                    self._release_lane(slot, req)
                     self._lanes[slot] = None
                     if req.trace is not None:
                         req.trace.add_span(
@@ -1357,6 +1429,362 @@ class ContinuousGenerationServer:
             ]
 
 
+class PagedContinuousGenerationServer(ContinuousGenerationServer):
+    """Continuous batching over the PAGED KV layout (vLLM-style block
+    tables + prefix reuse; models/decode_engine.py module docstring
+    has the layout).
+
+    Everything the base scheduler does (fused admit+burst dispatches,
+    immediate retirement, zero steady-state compiles) carries over;
+    this subclass adds the HOST side of paging:
+
+    * **Block allocation** — per-lane self-KV blocks come from a
+      ``HostBlockPool`` free-list; a lane starts with one block and
+      grows lazily as its generation crosses block boundaries
+      (``_plan_burst_locked`` caps each burst at the coverage it
+      could allocate). Short requests therefore consume 1 block where
+      the dense layout reserved the full maxT — the capacity lever.
+    * **Prefix-cache admission** — prompts are classified hit/partial/
+      miss against the refcounted ``PromptPrefixCache``; hits admit
+      through the encoder-free ``("hit", A)`` serve programs (the
+      shared-system-prompt fast path), misses/partials prefill ONCE
+      into a pool entry later hits reuse. One admission flavor per
+      fused cycle; duplicate cold prompts in one batch defer one
+      cycle and come back as hits.
+    * **Backpressure, pausing, preemption, exhaustion** — transient
+      pool pressure queues (admission) or pauses lanes for a cycle
+      (mid-generation: the lane's active flag is host-masked so it
+      cannot write the shared pool); when EVERY live lane blocks at a
+      boundary (lockstep long generations), the youngest is
+      recompute-PREEMPTED — blocks freed, request re-queued at the
+      front; greedy decode is deterministic so the re-decoded tokens
+      are byte-identical. Only a LONE request that outgrows the whole
+      pool fails, with the NAMED retryable ``BlockPoolExhausted`` —
+      never a hang, and never a lost request that could have run.
+
+    FIFO admission only: ``admit_select`` hooks are rejected (tier
+    grouping owns the admission order).
+    """
+
+    def __init__(self, bundle, **kwargs):
+        cache = getattr(bundle, "cache", None)
+        if cache is None or cache.layout != "paged":
+            raise ValueError(
+                "PagedContinuousGenerationServer needs a bundle built "
+                "with CacheConfig(layout='paged') — for dense bundles "
+                "use ContinuousGenerationServer")
+        if kwargs.get("admit_select") is not None:
+            raise ValueError(
+                "paged serving owns admission order (prefix-tier "
+                "grouping); admit_select hooks are not supported")
+        self.cache = cache
+        self._bs = cache.block_size
+        self._blocks = HostBlockPool(cache.n_blocks)
+        self._prefix = PromptPrefixCache(cache.n_prompt_entries,
+                                         cache.block_size)
+        rows = bundle.n_slots + 1
+        self._tab = np.zeros((rows, cache.pages(bundle.max_out_len)),
+                             np.int32)
+        self._pref = np.full((rows,), cache.n_prompt_entries,
+                             np.int32)
+        self._lane_blocks = [[] for _ in range(bundle.n_slots)]
+        self._lane_entry: List[Optional[int]] = [None] * bundle.n_slots
+        self._lane_step = np.zeros((rows,), np.int64)
+        self._admit_tier = None
+        self._pause_events = 0  # lanes parked for >= 1 cycle by pool
+        #                         pressure (observability)
+        self._preemptions = 0   # recompute-preempted lanes (vLLM-
+        #                         style requeue; tokens stay exact)
+        super().__init__(bundle, **kwargs)
+
+    # how deep past the queue head the tier-grouped admission scan may
+    # look for batch-compatible requests (bounds the O(scan) planning
+    # cost per cycle; the head itself is ALWAYS first, so no request
+    # can be starved by later same-tier traffic)
+    _ADMIT_SCAN_DEPTH = 64
+
+    def _plan_admissions_locked(self, failures):
+        admits = []
+        self._admit_tier = None
+        if not self._queue:
+            return admits
+        t_admit = time.monotonic()
+        free_slots = [s for s in range(self.n_slots)
+                      if self._lanes[s] is None]
+        max_A = self._admit_buckets[-1]
+        seen_cold = set()
+        blocked_reason = None
+        taken = []
+        # ONE admission flavor per fused cycle (hit admissions are
+        # encoder-free programs), decided by the QUEUE HEAD so its
+        # request always ships first; the rest of the batch is filled
+        # with same-tier requests scanned from deeper in the queue —
+        # strictly consecutive admission would shrink batches to the
+        # head's same-tier run length (~1/miss-rate) and make the
+        # mixed hit/miss workload admission-bound (measured 0.35x of
+        # the dense server before this scan)
+        for pos, req in enumerate(self._queue):
+            if pos >= self._ADMIT_SCAN_DEPTH or not free_slots \
+                    or len(admits) >= max_A:
+                break
+            prompt = tuple(int(x) for x in req.src.reshape(-1))
+            tier, _entry = self._prefix.lookup(prompt)
+            flavor = "hit" if tier == "hit" else "miss"
+            if self._admit_tier is None:
+                self._admit_tier = flavor
+            if flavor != self._admit_tier:
+                continue  # next cycle's flavor
+            if flavor == "miss" and prompt in seen_cold:
+                # a duplicate cold prompt in one batch would alias
+                # the pool entry write; it comes back a HIT next cycle
+                continue
+            # admission watermark (the vLLM can_allocate discipline):
+            # after this admission, one spare block must remain per
+            # ALREADY-live lane, or growth pressure turns into
+            # preempt/re-admit thrash — preempted lockstep longs used
+            # to steal their own freed blocks back at the next
+            # admission and re-decode forever
+            live_now = self.n_slots - len(free_slots)
+            if self._blocks.free_count - 1 < live_now:
+                blocked_reason = ("free KV blocks below the live-lane "
+                                  "watermark")
+                break
+            blk = self._blocks.alloc()
+            if blk is None:
+                blocked_reason = "no free KV block"
+                break
+            if flavor == "hit":
+                entry = self._prefix.acquire_hit(prompt)
+            else:
+                entry = self._prefix.acquire_fresh(
+                    prompt, partial=(tier == "partial"))
+                if entry is None:
+                    self._blocks.free([blk])
+                    blocked_reason = "every prompt entry is pinned"
+                    break
+                seen_cold.add(prompt)
+            slot = free_slots.pop(0)
+            taken.append(req)
+            self._lane_blocks[slot] = [blk]
+            self._lane_entry[slot] = entry
+            self._lane_step[slot] = 0
+            self._tab[slot, :] = 0
+            self._tab[slot, 0] = blk
+            self._pref[slot] = entry
+            self._lanes[slot] = req
+            req.t_admit = t_admit
+            if req.trace is not None:
+                # the prefix tier is what explains slow (miss: full
+                # encoder prefill) vs fast (hit: lane reset only)
+                # admissions in the flight recorder
+                req.trace.add_span("slotpool.queue", req.t_arrival,
+                                   t_admit, slot=slot, prefix=tier)
+            admits.append((slot, req))
+        if taken:
+            taken_ids = {id(r) for r in taken}
+            self._queue = collections.deque(
+                r for r in self._queue if id(r) not in taken_ids)
+        if blocked_reason and not admits \
+                and all(l is None for l in self._lanes):
+            # nothing in flight can ever free a block/entry: fail the
+            # head with the NAMED retryable error instead of hanging
+            req = self._queue.popleft()
+            failures.append((req, BlockPoolExhausted(
+                f"cannot admit prompt: {blocked_reason} with the pool "
+                f"otherwise idle (n_blocks={self._blocks.n_blocks}, "
+                f"n_prompt_entries={self._prefix.n_entries}); "
+                f"retryable against a larger pool")))
+        return admits
+
+    def _admission_feed(self, admits):
+        tier = self._admit_tier
+        A = _bucket_for(len(admits), self._admit_buckets,
+                        "admission batch")
+        feed = {"slots": np.array(
+            [slot for slot, _ in admits]
+            + [self.bundle.dustbin] * (A - len(admits)), np.int64)}
+        if tier == "miss":
+            feed["src_ids"] = np.concatenate(
+                [req.src for _, req in admits]
+                + [admits[-1][1].src] * (A - len(admits)), axis=0)
+            # padded rows scatter into the dustbin ENTRY (index E):
+            # duplicates there sum to garbage harmlessly, real
+            # entries stay host-distinct (PTA110 "host_indices")
+            feed["prompt_slots"] = np.array(
+                [self._lane_entry[slot] for slot, _ in admits]
+                + [self.cache.n_prompt_entries] * (A - len(admits)),
+                np.int64)
+        return (tier, A), feed
+
+    # --- burst planning: coverage, pausing, hard exhaustion ----------
+    def _grow_blocks_locked(self, slot, upto_pos):
+        need = upto_pos // self._bs + 1
+        blocks = self._lane_blocks[slot]
+        while len(blocks) < need:
+            b = self._blocks.alloc()
+            if b is None:
+                return
+            self._tab[slot, len(blocks)] = b
+            blocks.append(b)
+
+    def _free_lane_locked(self, slot):
+        if self._lane_blocks[slot]:
+            self._blocks.free(self._lane_blocks[slot])
+            self._lane_blocks[slot] = []
+        if self._lane_entry[slot] is not None:
+            self._prefix.release(self._lane_entry[slot])
+            self._lane_entry[slot] = None
+        self._paused.discard(slot)
+
+    def _plan_burst_locked(self, admits, drain, failures):
+        n_steps, min_active, run = super()._plan_burst_locked(
+            admits, drain, failures)
+        if not run:
+            return n_steps, min_active, run
+        maxT = self.bundle.max_out_len
+        while True:
+            live = [s for s in range(self.n_slots)
+                    if self._lanes[s] is not None]
+            if not live:
+                self._paused = set()
+                break
+            k = n_steps
+            blocked = []
+            for s in live:
+                st = int(self._lane_step[s])
+                # a K-tick burst writes KV at positions st..st+K-1
+                self._grow_blocks_locked(
+                    s, min(st + n_steps - 1, maxT - 1))
+                coverable = len(self._lane_blocks[s]) * self._bs - st
+                if coverable <= 0:
+                    blocked.append(s)
+                else:
+                    k = min(k, coverable)
+            if blocked and len(blocked) == len(live):
+                # hard exhaustion: every live lane sits at a block
+                # boundary with an empty free list (lockstep long
+                # generations do this the moment admission packs
+                # them). PREEMPT the youngest by recompute (the vLLM
+                # discipline): free its blocks so the older lanes
+                # advance, and re-queue the request at the FRONT —
+                # greedy decode is deterministic, so the re-decoded
+                # tokens are byte-identical and only work is lost,
+                # never a request. Each preemption hands >= 1 block
+                # to a strictly older lane, so total outstanding work
+                # decreases and the loop terminates.
+                victim = max(blocked,
+                             key=lambda s: self._lanes[s].t_admit or 0)
+                req = self._lanes[victim]
+                if len(live) == 1:
+                    # a LONE lane owns every in-use block and still
+                    # cannot advance: re-running it can never do
+                    # better — the named retryable error, not a
+                    # preempt-forever loop
+                    self._free_lane_locked(victim)
+                    self._lanes[victim] = None
+                    failures.append((req, BlockPoolExhausted(
+                        f"KV block pool exhausted mid-generation "
+                        f"(n_blocks={self._blocks.n_blocks}, the "
+                        f"request alone outgrows the pool); request "
+                        f"evicted — retryable against a larger "
+                        f"pool")))
+                    continue
+                self._free_lane_locked(victim)
+                self._lanes[victim] = None
+                self._preemptions += 1
+                req.t_admit = None
+                req.t_first = None
+                self._queue.appendleft(req)
+                continue
+            self._pause_events += len(set(blocked) - self._paused)
+            self._paused = set(blocked)
+            n_steps = k
+            break
+        if self.exit_on_retire and not drain:
+            live_unpaused = sum(
+                1 for s in range(self.n_slots)
+                if self._lanes[s] is not None
+                and s not in self._paused)
+            min_active = max(0, live_unpaused - 1)
+        return n_steps, min_active, True
+
+    def _pre_dispatch(self):
+        """Publish the host-owned indirection + the pause/victim mask
+        just before the fused dispatch (prepared handles re-read scope
+        state per call, so this is the whole host->device channel)."""
+        names = self.bundle.state
+        self.scope._set(names["block_tab"], self._tab.copy())
+        self.scope._set(names["prompt_ref"], self._pref.copy())
+        act = np.zeros((self.n_slots + 1,), np.int64)
+        for s in range(self.n_slots):
+            if self._lanes[s] is not None and s not in self._paused:
+                act[s] = 1
+        # paused lanes MUST read 0 (an act-gated pool write is the
+        # exclusivity contract); retired/victim/idle lanes likewise;
+        # freshly admitted lanes are raised by the admission body
+        # inside the same dispatch either way
+        self.scope._set(names["active"], act)
+
+    def _post_dispatch(self, outs):
+        self._lane_step = np.asarray(outs[1]).astype(np.int64).copy()
+
+    def _release_lane(self, slot, req):
+        self._free_lane_locked(slot)
+
+    # --- observability ------------------------------------------------
+    def pool_stats(self) -> dict:
+        """Block-pool + prefix-cache counters (also exposed as the
+        paddle_tpu_blockpool_* pull-provider gauges)."""
+        with self._cv:
+            return self._pool_stats_locked()
+
+    def _pool_stats_locked(self) -> dict:
+        return {
+            "layout": "paged",
+            "block_size": self._bs,
+            "n_blocks": self._blocks.n_blocks,
+            "blocks_in_use": self._blocks.in_use,
+            "blocks_free": self._blocks.free_count,
+            "prompt_entries": self._prefix.n_entries,
+            "prompt_entries_in_use": self._prefix.in_use,
+            "prefix_hits": self._prefix.hits,
+            "prefix_misses": self._prefix.misses,
+            # partial-tier admissions re-prefill (bidirectional
+            # encoder: only a FULL prompt match may share) — each is
+            # a copy-on-write materialization of a shared prefix
+            "cow_copies": self._prefix.partials,
+            "evictions": self._prefix.evictions,
+            "paused_lanes": len(self._paused),
+            "pause_events": self._pause_events,
+            "preemptions": self._preemptions,
+        }
+
+    def stats(self, reset: bool = False) -> dict:
+        st = super().stats(reset=reset)
+        st["block_pool"] = self.pool_stats()
+        return st
+
+    def _metrics_samples(self):
+        samples = super()._metrics_samples()
+        lab = {"server": self._obs_id}  # unique per instance: two
+        # co-resident paged servers must not collide series
+        b, p = self._blocks, self._prefix
+        samples += [
+            ("paddle_tpu_blockpool_blocks_in_use", lab, b.in_use),
+            ("paddle_tpu_blockpool_blocks_free", lab, b.free_count),
+            ("paddle_tpu_blockpool_prompt_entries_in_use", lab,
+             p.in_use),
+            ("paddle_tpu_blockpool_prefix_hits_total", lab, p.hits),
+            ("paddle_tpu_blockpool_prefix_misses_total", lab,
+             p.misses),
+            ("paddle_tpu_blockpool_cow_copies_total", lab,
+             p.partials),
+            ("paddle_tpu_blockpool_evictions_total", lab,
+             p.evictions),
+        ]
+        return samples
+
+
 def count_generated_tokens(tokens: np.ndarray,
                            end_id: Optional[int]) -> np.ndarray:
     """Per-row generated-token count of a [B, maxT] decode buffer:
@@ -1395,6 +1823,8 @@ def apply_eos_sentinel(tokens: np.ndarray,
 
 
 __all__ = ["InferenceServer", "GenerationServer",
-           "ContinuousGenerationServer", "ProgramRunner",
-           "ServerQuiesced", "ServerClosed", "apply_eos_sentinel",
-           "count_generated_tokens", "default_batch_buckets"]
+           "ContinuousGenerationServer",
+           "PagedContinuousGenerationServer", "BlockPoolExhausted",
+           "ProgramRunner", "ServerQuiesced", "ServerClosed",
+           "apply_eos_sentinel", "count_generated_tokens",
+           "default_batch_buckets"]

@@ -428,3 +428,44 @@ def fsp_matrix(x, y):
 
 __all__.extend(["selu", "space_to_depth", "sequence_mask",
                 "pad_constant_like", "l1_norm", "hash", "fsp_matrix"])
+
+
+def masked_pool_write(pool, new, index, gate=None, leading_dims=1,
+                      exclusive_via=None, name=None):
+    """Write rows into a SHARED decode KV pool by disjoint one-hot
+    scatter, IN PLACE (the op's Out is the pool var itself, so the
+    pool rides the executor's read-modify-write state path). The one
+    blessed write surface for `@POOL`-marked persistables
+    (models/decode_engine.py paged layout; ops/paged_ops.py kernel):
+    checker PTA110 rejects any other writer, because an aliased
+    scatter into a shared pool silently corrupts ANOTHER request's KV
+    — the nastiest failure class of paged serving.
+
+    ``exclusive_via`` is mandatory and names the lane-exclusivity
+    proof: "block_table" (per-lane blocks from the host free-list —
+    requires ``gate`` so idle/dustbin/paused lanes write nothing) or
+    "host_indices" (host-deduplicated admission targets).
+    """
+    if exclusive_via not in ("block_table", "host_indices"):
+        raise ValueError(
+            f"masked_pool_write needs exclusive_via='block_table' or "
+            f"'host_indices' (got {exclusive_via!r}): shared-pool "
+            f"writes must declare why row indices cannot alias "
+            f"(checker PTA110)")
+    if exclusive_via == "block_table" and gate is None:
+        raise ValueError(
+            "masked_pool_write(exclusive_via='block_table') needs a "
+            "gate: ungated lane writes through a block table let "
+            "idle/dustbin lanes scribble over other requests' KV "
+            "(checker PTA110)")
+    helper = LayerHelper("masked_pool_write", input=pool, name=name)
+    inputs = {"Pool": pool, "New": new, "Index": index}
+    if gate is not None:
+        inputs["Gate"] = gate
+    helper.append_op("masked_pool_write", inputs, {"Out": pool},
+                     {"leading_dims": int(leading_dims),
+                      "exclusive_via": exclusive_via})
+    return pool
+
+
+__all__.append("masked_pool_write")

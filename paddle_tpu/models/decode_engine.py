@@ -1,0 +1,1304 @@
+"""Decode engine: ONE home for every decode capability.
+
+Reference counterpart: tests/unittests/dist_transformer.py:1498
+fast_decode is the decode loop all of this re-designs TPU-first; the
+slot-pool/paged serving discipline follows Orca (OSDI'22), vLLM
+(SOSP'23 — PagedAttention block tables) and SGLang (RadixAttention
+prefix sharing), PAPERS.md.
+
+models/transformer.py used to carry three decode builders (whole-loop,
+incremental, DecodeStepBundle) with ~600 lines of overlapping loop/
+cache/emission logic, so every new decode capability (paged KV,
+speculative, sampling, sharding) had to be implemented three times.
+This module factors the decode machinery into composable pieces the
+builders share — transformer.py's builder entry points keep their
+public signatures and delegate here:
+
+* **Cache layout** — ``CacheConfig`` selects ``dense`` (per-lane
+  ``[rows, H, maxT, Dh]`` KV buffers, the r10 design) or ``paged``
+  (a SHARED block pool ``[n_blocks, block_size, H, Dh]`` per layer +
+  per-lane int32 block-table rows; cross-attention K/V lives in a
+  refcounted prompt-entry pool so identical prompts prefill ONCE).
+  Reads go through one-hot/gather composition of existing ops; writes
+  go through the ``masked_pool_write`` registry op whose disjoint
+  one-hot masks are the lane-exclusivity contract checker PTA110
+  enforces (shared-pool aliasing is the silent cross-request KV
+  corruption class).
+* **Step body** — ``cached_decoder_step`` runs the KV-cached decoder
+  stack over per-layer cache-access objects (``_DenseLaneCache`` /
+  ``_PagedLaneCache``), so the whole-loop, single-step and paged
+  programs trace IDENTICAL math — token-for-token parity across
+  layouts is structural, not coincidental.
+* **Loop/burst/exit policy** — the serve-program While (n_steps +
+  min_active early exits) and the scalar-counter whole-loop tail.
+* **Emission** — the greedy emit/EOS-freeze/one-hot-write tail, in
+  scalar-loop and per-lane-vectorized forms.
+
+Host-side allocation policy (``HostBlockPool``, ``PromptPrefixCache``)
+also lives here: the device only ever sees fed/persistable tables, so
+blocks/refcounts/prefix hashing stay plain testable Python in the
+serving scheduler (inference/serving.py).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+# fixed-name [1] int64 var holding the number of While iterations a
+# decode program actually ran (early-exit observability; fetchable)
+DECODE_STEPS_VAR = "@decode_steps"
+
+# name mark on SHARED block-pool persistables: checker PTA110 requires
+# every write to a var carrying this mark to be a provably
+# lane-exclusive masked_pool_write (analysis/checkers.py)
+POOL_MARK = "@POOL"
+
+
+@dataclass(frozen=True)
+class CacheConfig:
+    """KV cache layout of a slot-pool decode bundle.
+
+    ``dense``: per-lane KV buffers — every admitted request reserves
+    the full ``[maxT, ...]`` self-KV and ``[seq_len, ...]`` cross-KV
+    regardless of its actual generation/prompt reuse (the r10 layout).
+
+    ``paged``: self-attention KV lives in ONE shared pool of
+    ``n_blocks`` blocks of ``block_size`` positions per layer
+    (``[n_blocks, block_size, n_heads, head_dim]``), addressed through
+    per-lane int32 block-table rows the HOST allocates
+    (``HostBlockPool``); cross-attention K/V lives in a pool of
+    ``n_prompt_entries`` whole-prompt entries (+1 dustbin), shared
+    refcounted across lanes with identical prompts
+    (``PromptPrefixCache``) so a repeated system prompt prefills once
+    and later admissions skip the encoder entirely.
+    """
+
+    layout: str = "dense"          # "dense" | "paged"
+    block_size: int = 8            # positions per self-KV block
+    n_blocks: int = 0              # shared self-KV pool blocks
+    n_prompt_entries: int = 0      # shared cross-KV prompt entries
+
+    def validate(self, max_out_len: int):
+        if self.layout not in ("dense", "paged"):
+            raise ValueError(f"unknown KV layout {self.layout!r}")
+        if self.layout == "paged":
+            if self.block_size < 1 or self.n_blocks < 1 \
+                    or self.n_prompt_entries < 1:
+                raise ValueError(
+                    f"paged layout needs block_size/n_blocks/"
+                    f"n_prompt_entries >= 1, got {self}")
+            if max_out_len % self.block_size != 0:
+                raise ValueError(
+                    f"block_size={self.block_size} must divide "
+                    f"max_out_len={max_out_len} (token-exact parity "
+                    f"needs the paged cache view to cover exactly the "
+                    f"dense [maxT] positions)")
+
+    def pages(self, max_out_len: int) -> int:
+        return max_out_len // self.block_size
+
+    def token(self) -> tuple:
+        """Content identity of the layout — part of
+        ``server_fingerprint`` and therefore of hot-swap/dedupe
+        decisions: two servers differing only in KV layout must not
+        dedupe as 'same fingerprint' (inference/runtime/registry.py)."""
+        if self.layout == "dense":
+            return ("dense",)
+        return ("paged", self.block_size, self.n_blocks,
+                self.n_prompt_entries)
+
+
+# ---------------------------------------------------------------------------
+# Emission helpers (shared by every decode front).
+# ---------------------------------------------------------------------------
+def step_logits(dec, positions, counter, vocab):
+    """Select step t's hidden row BEFORE the vocab projection: a
+    [rows,D]x[D,V] matmul instead of [rows,maxT,D]x[D,V] — identical
+    logits, maxT-fold cheaper (shared by all decode builders)."""
+    t_mask = layers.cast(layers.equal(positions, counter), "float32")
+    step_hidden = layers.reduce_sum(
+        layers.elementwise_mul(dec, layers.unsqueeze(t_mask, [1]),
+                               axis=1), dim=1)
+    return layers.fc(step_hidden, vocab, bias_attr=False,
+                     param_attr="logits.w")
+
+
+def init_token_buffer(src, positions, max_out_len, start_id):
+    """[B, maxT] int64 zeros with the start token at position 0 — the
+    loop-carried decode buffer the whole-loop builders share."""
+    buf = layers.fill_constant_batch_size_like(
+        src, [-1, max_out_len], "int64", 0.0)
+    if start_id:
+        start_col = layers.cast(
+            layers.equal(positions,
+                         layers.fill_constant([1], "int64", 0.0)),
+            "int64")
+        buf = layers.elementwise_add(
+            buf, layers.cast(
+                layers.scale(start_col, scale=float(start_id)),
+                "int64"))
+    return layers.assign(buf)
+
+
+def emit_token_step(src, step_logits_v, positions, tgt_buf, finished,
+                    counter, limit, cond, max_out_len, end_id):
+    """Shared whole-loop decode tail: greedy argmax, EOS freeze
+    (finished rows keep emitting end_id), one-hot write at position
+    t+1, counter bump, loop-condition refresh. Mutates tgt_buf/
+    finished/counter/cond in place — keep BOTH whole-loop builders on
+    this helper so their token-for-token equivalence can't silently
+    diverge.
+
+    The refreshed condition carries an all-rows-finished early-exit
+    term: once every row has emitted end_id the loop stops instead of
+    spinning to max_out_len emitting frozen end_id rows. Positions
+    past the exit step keep their zero init — callers that need the
+    variable-length result go through apply_eos_sentinel
+    (inference/serving.py), which normalizes everything after the
+    first end_id to the -1 sentinel either way. Expressed with
+    reduce_sum/elementwise_min/greater_than only, all inside the
+    native xla_train kernel slice (FLAGS_native_build builds these
+    programs too)."""
+    tok = layers.cast(layers.argmax(step_logits_v, axis=-1), "int64")
+    not_fin = layers.elementwise_sub(
+        layers.fill_constant_batch_size_like(
+            src, [-1], "int64", 1.0), finished)
+    tok = layers.elementwise_add(
+        layers.elementwise_mul(tok, not_fin),
+        layers.cast(layers.scale(finished, scale=float(end_id)),
+                    "int64"))
+    layers.assign(
+        layers.elementwise_max(
+            finished,
+            layers.cast(layers.equal(
+                tok, layers.fill_constant([1], "int64",
+                                          float(end_id))), "int64")),
+        output=finished)
+    next_mask = layers.cast(
+        layers.equal(positions,
+                     layers.increment(counter, 1, in_place=False)),
+        "int64")
+    keep = layers.elementwise_sub(
+        layers.fill_constant([max_out_len], "int64", 1.0), next_mask)
+    layers.assign(
+        layers.elementwise_add(
+            layers.elementwise_mul(tgt_buf, keep),
+            layers.elementwise_mul(layers.unsqueeze(tok, [1]),
+                                   next_mask)),
+        output=tgt_buf)
+    layers.increment(counter, 1)
+    # continue while BOTH hold: steps remain (limit - counter > 0) AND
+    # at least one row is unfinished (sum(1 - finished) > 0); min(a, b)
+    # > 0 encodes the conjunction without logical ops
+    unfinished = layers.reduce_sum(
+        layers.elementwise_sub(
+            layers.fill_constant_batch_size_like(
+                src, [-1], "int64", 1.0), finished),
+        keep_dim=True)
+    layers.greater_than(
+        layers.elementwise_min(
+            layers.elementwise_sub(limit, counter), unfinished),
+        layers.fill_constant([1], "int64", 0.0), cond=cond)
+
+
+def heads_of(x, t, n_heads, head_dim):
+    """[R,t,H*D] -> [R,H,t,D] (the cached-attention head layout every
+    KV-cached decode builder shares)."""
+    return layers.transpose(
+        layers.reshape(x, [0, t, n_heads, head_dim]),
+        perm=[0, 2, 1, 3])
+
+
+# ---------------------------------------------------------------------------
+# Cache-access objects: the ONE place layout differences live.
+# ---------------------------------------------------------------------------
+class _DenseLaneCache:
+    """Per-layer dense self-KV access: in-place one-hot masked write
+    into per-lane ``[R, H, maxT, Dh]`` vars, attention reads the vars
+    directly (the r10 layout; write masks broadcast for either a
+    shared scalar counter [maxT,1] or per-lane counters
+    [R,1,maxT,1])."""
+
+    def __init__(self, kc, vc, write_mask, keep_mask):
+        self.kc, self.vc = kc, vc
+        self.write_mask, self.keep_mask = write_mask, keep_mask
+
+    def update(self, kh, vh):
+        new_kc = layers.elementwise_add(
+            layers.elementwise_mul(self.kc, self.keep_mask),
+            layers.elementwise_mul(kh, self.write_mask))
+        new_vc = layers.elementwise_add(
+            layers.elementwise_mul(self.vc, self.keep_mask),
+            layers.elementwise_mul(vh, self.write_mask))
+        layers.assign(new_kc, output=self.kc)
+        layers.assign(new_vc, output=self.vc)
+        return self.kc, self.vc
+
+
+class _PagedLaneCache:
+    """Per-layer paged self-KV access: writes go through the
+    ``masked_pool_write`` registry op (disjoint one-hot scatter into
+    the SHARED ``[NB, BS, H, Dh]`` pool at each lane's block-table
+    address, gated by the active mask so idle/dustbin lanes never
+    touch the pool — the PTA110 exclusivity contract), reads gather
+    every lane's maxT cache positions back into the dense
+    ``[R, H, maxT, Dh]`` view the shared attention math expects.
+    Positions a lane has not written yet hold stale pool bytes; the
+    caller's validity bias (-1e9 past position t) masks them exactly
+    like the dense layout masks its zeros, so the softmax sees
+    identical values — token-exact parity with dense."""
+
+    def __init__(self, pool_k, pool_v, write_idx, gate, flat_pos,
+                 rows, n_heads, head_dim, maxT, n_cells):
+        self.pool_k, self.pool_v = pool_k, pool_v
+        self.write_idx, self.gate = write_idx, gate
+        self.flat_pos = flat_pos          # [rows*maxT] int32 cell addrs
+        self.rows, self.maxT = rows, maxT
+        self.n_heads, self.head_dim = n_heads, head_dim
+        self.n_cells = n_cells            # NB * BS
+
+    def _view(self, pool):
+        flat = layers.reshape(pool, [self.n_cells,
+                                     self.n_heads * self.head_dim])
+        rows_kv = layers.gather(flat, self.flat_pos)
+        return layers.transpose(
+            layers.reshape(rows_kv, [self.rows, self.maxT,
+                                     self.n_heads, self.head_dim]),
+            perm=[0, 2, 1, 3])
+
+    def update(self, kh, vh):
+        for pool, new in ((self.pool_k, kh), (self.pool_v, vh)):
+            layers.masked_pool_write(
+                pool,
+                layers.reshape(new, [0, self.n_heads, self.head_dim]),
+                self.write_idx, gate=self.gate, leading_dims=2,
+                exclusive_via="block_table")
+        return self._view(self.pool_k), self._view(self.pool_v)
+
+
+def cached_decoder_step(x, caches, cross_kv, att_bias, d_model,
+                        n_heads, d_inner):
+    """One KV-cached decoder-stack step over a [R,1,D] row batch
+    (reference tests/unittests/dist_transformer.py:1498 fast_decode's
+    cached decoder, factored so the whole-loop incremental program and
+    the slot-pool single-step programs — dense AND paged — trace the
+    IDENTICAL math; their token-for-token parity is structural, not
+    coincidental).
+
+    ``caches``: per-layer cache-access objects (_DenseLaneCache /
+    _PagedLaneCache) owning the self-attention KV write+view.
+    ``cross_kv``: per-layer (ck, cv) [R,H,S,Dh] encoder projections
+    (vars for dense, pool gathers for paged). ``att_bias`` is the
+    0/-1e9 validity bias added to the [R,H,1,maxT] attention scores.
+    Param names are the explicit dec{li}_* scheme shared with the
+    training build. Returns the [R,1,D] hidden row after all layers.
+    """
+    from . import transformer as T
+
+    head_dim = d_model // n_heads
+    scale = head_dim ** -0.5
+    for li, cache in enumerate(caches):
+        # --- cached causal self-attention (fused qkv) ---
+        qkv = layers.fc(
+            x, 3 * d_model, num_flatten_dims=2, bias_attr=False,
+            param_attr=T._attn_proj_attr(f"dec{li}_self", "qkv",
+                                         d_model))
+        q, k, v = layers.split(qkv, 3, dim=2)
+        qh = heads_of(q, 1, n_heads, head_dim)
+        kh = heads_of(k, 1, n_heads, head_dim)
+        vh = heads_of(v, 1, n_heads, head_dim)
+        kc, vc = cache.update(kh, vh)
+        scores = layers.scale(
+            layers.matmul(qh, kc, transpose_y=True),
+            scale=scale)  # [R,H,1,maxT]
+        scores = layers.elementwise_add(scores, att_bias)
+        probs = layers.softmax(scores, axis=-1)
+        ctx = layers.matmul(probs, vc)
+        ctx = layers.reshape(
+            layers.transpose(ctx, perm=[0, 2, 1, 3]),
+            [0, 1, d_model])  # [R,1,HD]
+        attn_out = layers.fc(ctx, d_model, num_flatten_dims=2,
+                             bias_attr=False,
+                             param_attr=f"dec{li}_self_out.w")
+        x = T._add_norm(attn_out, x, 0.0, True, name=f"dec{li}_a")
+        # --- cross attention against precomputed enc K/V ---
+        q2 = layers.fc(
+            x, d_model, num_flatten_dims=2, bias_attr=False,
+            param_attr=T._attn_proj_attr(f"dec{li}_cross", "q",
+                                         d_model))
+        q2h = heads_of(q2, 1, n_heads, head_dim)
+        ck, cv = cross_kv[li]
+        s2 = layers.scale(
+            layers.matmul(q2h, ck, transpose_y=True),
+            scale=scale)  # [R,H,1,S]
+        p2 = layers.softmax(s2, axis=-1)
+        ctx2 = layers.reshape(
+            layers.transpose(layers.matmul(p2, cv),
+                             perm=[0, 2, 1, 3]),
+            [0, 1, d_model])
+        cross_out = layers.fc(
+            ctx2, d_model, num_flatten_dims=2,
+            bias_attr=False,
+            param_attr=f"dec{li}_cross_out.w")
+        x = T._add_norm(cross_out, x, 0.0, True, name=f"dec{li}_b")
+        # --- ffn ---
+        ffn = T._ffn(x, d_model, d_inner, 0.0, True, name=f"dec{li}")
+        x = T._add_norm(ffn, x, 0.0, True, name=f"dec{li}_c")
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Whole-loop fronts (scalar step counter; per-request programs).
+# ---------------------------------------------------------------------------
+def build_greedy_decode_program(seq_len=16, max_out_len=16,
+                                d_model=64, n_heads=4, n_layers=2,
+                                d_inner=128, vocab=1000, start_id=0,
+                                end_id=1):
+    """Autoregressive greedy generation (reference
+    tests/unittests/dist_transformer.py:1498 fast_decode — its
+    while-op beam loop, at beam 1 — rebuilt as a lax.while_loop over
+    the full decoder at static shapes: each step re-runs the
+    causally-masked decoder on the [B, max_out_len] token buffer and
+    writes position t+1 by a one-hot mask; positions past t are
+    ignored by the causal mask, so no KV cache is needed for
+    correctness — incremental caching is a perf upgrade, not a
+    semantics change). Rows that emit end_id are frozen: every later
+    position holds end_id, like the reference's early-finish
+    handling.
+
+    Weight sharing with a training program is by EXPLICIT param name
+    (enc{i}_*/dec{i}_*/logits.w/…_word_emb) — build order and
+    unique_name state are irrelevant.
+    Returns (program, startup, feeds, out_ids_var).
+    """
+    import paddle_tpu as fluid
+
+    from . import transformer as T
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
+        enc = T._embed(src, vocab, d_model, max(seq_len, max_out_len),
+                       0.0, True, "src_word_emb")
+        for li in range(n_layers):
+            enc = T.encoder_layer(enc, d_model, n_heads, d_inner, 0.0,
+                                  is_test=True, name=f"enc{li}")
+
+        positions = layers.cast(layers.range(0, max_out_len, 1),
+                                "int64")
+        tgt_buf = init_token_buffer(src, positions, max_out_len,
+                                    start_id)
+        # fixed-name counter so tests/benches can fetch the number of
+        # loop iterations actually taken (the early-exit probe)
+        counter = layers.fill_constant(
+            [1], "int64", 0,
+            out=main.global_block.create_var(
+                name=DECODE_STEPS_VAR, shape=(1,), dtype="int64",
+                stop_gradient=True))
+        limit = layers.fill_constant([1], "int64",
+                                     float(max_out_len - 1))
+        finished = layers.assign(layers.fill_constant_batch_size_like(
+            src, [-1], "int64", 0.0))  # [B]: 1 once EOS emitted
+        cond = layers.less_than(counter, limit)
+        w = layers.While(cond)
+        with w.block():
+            dec = T._embed(tgt_buf, vocab, d_model,
+                           max(seq_len, max_out_len), 0.0, True,
+                           "tgt_word_emb")
+            for li in range(n_layers):
+                dec = T.decoder_layer(dec, enc, d_model, n_heads,
+                                      d_inner, 0.0, is_test=True,
+                                      name=f"dec{li}")
+            logits_v = step_logits(dec, positions, counter,
+                                   vocab)  # [B, V]
+            emit_token_step(src, logits_v, positions, tgt_buf,
+                            finished, counter, limit, cond,
+                            max_out_len, end_id)
+    return main, startup, ["src_ids"], tgt_buf
+
+
+def build_incremental_decode_program(seq_len=16, max_out_len=16,
+                                     d_model=64, n_heads=4,
+                                     n_layers=2, d_inner=128,
+                                     vocab=1000, start_id=0,
+                                     end_id=1):
+    """KV-cached autoregressive greedy generation — the incremental
+    variant of build_greedy_decode_program (reference
+    tests/unittests/dist_transformer.py:1498 fast_decode caches
+    per-layer K/V the same way). Each step embeds ONE token, runs the
+    decoder stack on that single row against cached self-attention
+    K/V (written in place at position t) and precomputed
+    cross-attention K/V, so per-step cost is O(maxT) instead of
+    O(maxT^2) — token-for-token identical to the full-recompute
+    program (asserted in tests).
+
+    Weight sharing: the same explicit param names the training build
+    and build_greedy_decode_program use — order-independent.
+
+    Returns (program, startup, feeds, out_ids_var).
+    """
+    import paddle_tpu as fluid
+
+    from . import transformer as T
+
+    head_dim = d_model // n_heads
+    maxT = max_out_len
+
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
+        enc = T._embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
+                       True, "src_word_emb")
+        for li in range(n_layers):
+            enc = T.encoder_layer(enc, d_model, n_heads, d_inner, 0.0,
+                                  is_test=True, name=f"enc{li}")
+
+        # cross-attention K/V once per layer (explicitly named
+        # dec{li}_cross_kv.w, shared with the training build)
+        cross_kv = []
+        for li in range(n_layers):
+            kv = layers.fc(enc, 2 * d_model, num_flatten_dims=2,
+                           bias_attr=False,
+                           param_attr=T._attn_proj_attr(
+                               f"dec{li}_cross", "kv", d_model))
+            k, v = layers.split(kv, 2, dim=2)
+            cross_kv.append((heads_of(k, seq_len, n_heads, head_dim),
+                             heads_of(v, seq_len, n_heads, head_dim)))
+
+        positions = layers.cast(layers.range(0, maxT, 1), "int64")
+        posf = layers.cast(positions, "float32")
+        pos_table = layers.assign(
+            T._position_encoding(max(seq_len, maxT), d_model)[:maxT])
+
+        tgt_buf = init_token_buffer(src, positions, maxT, start_id)
+        # per-layer self-attn caches [B,H,maxT,D]
+        caches = []
+        for li in range(n_layers):
+            kc = layers.assign(layers.fill_constant_batch_size_like(
+                src, [-1, n_heads, maxT, head_dim], "float32", 0.0))
+            vc = layers.assign(layers.fill_constant_batch_size_like(
+                src, [-1, n_heads, maxT, head_dim], "float32", 0.0))
+            caches.append((kc, vc))
+        counter = layers.fill_constant(
+            [1], "int64", 0,
+            out=main.global_block.create_var(
+                name=DECODE_STEPS_VAR, shape=(1,), dtype="int64",
+                stop_gradient=True))
+        limit = layers.fill_constant([1], "int64", float(maxT - 1))
+        finished = layers.assign(layers.fill_constant_batch_size_like(
+            src, [-1], "int64", 0.0))
+        cond = layers.less_than(counter, limit)
+        w = layers.While(cond)
+        with w.block():
+            # embed ONLY the current token
+            t_mask = layers.cast(layers.equal(positions, counter),
+                                 "float32")  # [maxT]
+            cur_tok = layers.reduce_sum(
+                layers.elementwise_mul(tgt_buf,
+                                       layers.cast(t_mask, "int64")),
+                dim=1, keep_dim=True)  # [B,1]
+            x = layers.embedding(cur_tok, size=[vocab, d_model],
+                                 param_attr=ParamAttr(
+                                     name="tgt_word_emb"))
+            # lookup_table squeezes the trailing 1 of [B,1] ids:
+            # restore the time axis for the [B,1,D] step row
+            x = layers.unsqueeze(x, [1])
+            x = layers.scale(x, scale=d_model ** 0.5)
+            pos_t = layers.reduce_sum(
+                layers.elementwise_mul(
+                    pos_table, layers.unsqueeze(t_mask, [1]), axis=0),
+                dim=0)  # [D]
+            x = layers.elementwise_add(x, pos_t)  # [B,1,D]
+
+            # attention validity: cached positions <= t
+            att_mask = layers.scale(
+                layers.cast(layers.greater_than(
+                    posf, layers.cast(counter, "float32")),
+                    "float32"), scale=-1e9)  # [maxT] 0 keep / -1e9 drop
+
+            # one-hot write column at cache position t (axis 2 of the
+            # [B,H,maxT,Dh] caches) and its complement
+            m2 = layers.unsqueeze(t_mask, [1])  # [maxT,1]
+            keepc = layers.unsqueeze(
+                layers.elementwise_sub(
+                    layers.fill_constant([maxT], "float32", 1.0),
+                    t_mask), [1])
+            cache_objs = [_DenseLaneCache(kc, vc, m2, keepc)
+                          for kc, vc in caches]
+            x = cached_decoder_step(x, cache_objs, cross_kv, att_mask,
+                                    d_model, n_heads, d_inner)
+
+            logits_v = layers.fc(
+                layers.reshape(x, [0, d_model]), vocab,
+                bias_attr=False, param_attr="logits.w")  # [B,V]
+            emit_token_step(src, logits_v, positions, tgt_buf,
+                            finished, counter, limit, cond, maxT,
+                            end_id)
+    return main, startup, ["src_ids"], tgt_buf
+
+
+# ---------------------------------------------------------------------------
+# Slot-pool front: bucketed admission + single-step/burst programs.
+# ---------------------------------------------------------------------------
+class DecodeStepBundle:
+    """Program set for slot-pool continuous batching (reference
+    tests/unittests/dist_transformer.py:1498 fast_decode is the decode
+    loop; the slot-pool scheduling follows the iteration-level /
+    paged-slot serving discipline of Orca (OSDI'22) and vLLM
+    (SOSP'23), PAPERS.md).
+
+    All per-slot decode state is PERSISTABLE scope state shared by the
+    programs (KV cache, token buffers, per-slot step counters,
+    finished/active lane masks — written by one-hot scatter, the
+    repo's loop-carried-history convention). The pool holds
+    ``n_slots`` schedulable lanes plus ONE extra dustbin row (index
+    ``n_slots``) that absorbs the padded rows of a bucketed admission
+    batch — it decodes garbage harmlessly (every op is row-wise, and
+    under the paged layout its pool writes are gated off) and is
+    never scheduled.
+
+    KV layout is selected by ``cache`` (CacheConfig): ``dense``
+    per-lane buffers, or ``paged`` shared block pools + per-lane
+    block-table/prompt-entry indirection (module docstring). Under
+    the paged layout the block table and prompt-entry references are
+    HOST-owned read-only state: the serving scheduler allocates
+    blocks/entries (HostBlockPool/PromptPrefixCache) and writes the
+    tables into the scope between dispatches — the device programs
+    never mutate them.
+
+    * ``prefills[A]`` — one admission program per bucket size A
+      (power-of-two ladder up to n_slots): feeds ``src_ids`` [A,
+      seq_len] + ``slots`` [A] (dustbin index for padded rows); runs
+      the encoder over the WHOLE admission batch, installs each row's
+      cross-attention K/V (dense: one-hot matmul scatter into the
+      lane rows; paged: masked_pool_write into the fed
+      ``prompt_slots`` entries), resets the slots' decode state, and
+      raises their active flags. ``prefill`` aliases the smallest
+      bucket. Paged bundles also carry ``hit_prefills[A]`` —
+      encoder-free admissions for prompts whose entry is already
+      cached (the prefix-reuse fast path: lane reset only).
+    * ``step`` — no feeds; advances EVERY lane one token in one
+      dispatch via the shared ``cached_decoder_step`` body.
+    * ``serves[key]`` — the fused scheduler-cycle programs: the
+      admission body (absent at key 0) followed by a While that runs
+      the step body until ``n_steps`` ticks ran or the live-lane
+      count drops to ``min_active`` (both fed as [1] int64). Keys are
+      admission buckets (ints) for dense bundles and ``("hit"|"miss",
+      A)`` tuples (plus 0) for paged ones; ``serve_feed_spec(key)``
+      names each program's feed signature.
+
+    ``state`` maps logical names ('tok_buf', 'step', 'finished',
+    'active', and for paged 'block_tab'/'prompt_ref') to the scope
+    var names; ``init_slot_state(scope)`` seeds the pool. The
+    returned ``startup`` holds param initializers only — serving runs
+    against an already-trained scope and must NOT run it.
+
+    Weight sharing: the explicit enc{i}_*/dec{i}_*/logits.w/…_word_emb
+    names — order-independent with the train and whole-loop builds.
+    """
+
+    def __init__(self, prefills, step, serves, startup, state,
+                 n_slots, seq_len, max_out_len, start_id, end_id,
+                 cache=None, hit_prefills=None):
+        self.prefills = dict(prefills)   # bucket size A -> Program
+        self.prefill = self.prefills[min(self.prefills)]
+        self.hit_prefills = dict(hit_prefills or {})
+        self.step = step
+        self.serves = dict(serves)       # key -> Program (see docstring)
+        self.startup = startup
+        self.state = dict(state)
+        self.n_slots = n_slots
+        self.dustbin = n_slots           # the padded-admission row
+        self.seq_len = seq_len
+        self.max_out_len = max_out_len
+        self.start_id = start_id
+        self.end_id = end_id
+        self.cache = cache or CacheConfig()
+        self._state_specs = {}
+
+    def cache_token(self) -> tuple:
+        """KV-layout identity for server_fingerprint/compile-cache
+        keys (CacheConfig.token)."""
+        return self.cache.token()
+
+    def serve_feed_spec(self, key) -> List[tuple]:
+        """Feed signature (name, shape, dtype) of ``serves[key]`` —
+        the serving layer binds prepared handles from this."""
+        feed = [("n_steps", (1,), "int64"),
+                ("min_active", (1,), "int64")]
+        if key == 0:
+            return feed
+        tier, A = key if isinstance(key, tuple) else ("miss", key)
+        pre = []
+        if tier == "miss":
+            pre.append(("src_ids", (A, self.seq_len), "int64"))
+        pre.append(("slots", (A,), "int64"))
+        if tier == "miss" and self.cache.layout == "paged":
+            pre.append(("prompt_slots", (A,), "int64"))
+        return pre + feed
+
+    def kv_state_bytes(self) -> int:
+        """Total persistable KV bytes of the bundle (self + cross KV
+        incl. table/indirection state; token/flag buffers excluded —
+        identical across layouts). The capacity denominator for the
+        requests-per-KV-byte bench metric."""
+        total = 0
+        for name, (shape, dt) in self._state_specs.items():
+            short = name.split("/")[-1]
+            if short.startswith(("self_", "cross_", "block_tab",
+                                 "prompt_ref")):
+                total += int(np.prod(shape)) * np.dtype(dt).itemsize
+        return total
+
+    def init_slot_state(self, scope):
+        """Seed the pool state in `scope` (idle slots: finished=1,
+        active=0 — they step harmlessly until admitted; paged
+        prompt_ref points every lane at the dustbin entry)."""
+        for name, (shape, dt) in self._state_specs.items():
+            if name == self.state["finished"]:
+                scope._set(name, np.ones(shape, dt))
+            elif name == self.state.get("prompt_ref"):
+                scope._set(name, np.full(shape,
+                                         self.cache.n_prompt_entries,
+                                         dt))
+            else:
+                scope._set(name, np.zeros(shape, dt))
+
+
+def _slot_state_specs(prefix, rows, maxT, seq_len, n_heads,
+                      head_dim, n_layers, cache):
+    specs = {
+        f"{prefix}tok_buf": ((rows, maxT), "int64"),
+        f"{prefix}step": ((rows,), "int64"),
+        f"{prefix}finished": ((rows,), "int64"),
+        f"{prefix}active": ((rows,), "int64"),
+    }
+    if cache.layout == "dense":
+        for li in range(n_layers):
+            specs[f"{prefix}self_k{li}"] = (
+                (rows, n_heads, maxT, head_dim), "float32")
+            specs[f"{prefix}self_v{li}"] = (
+                (rows, n_heads, maxT, head_dim), "float32")
+            specs[f"{prefix}cross_k{li}"] = (
+                (rows, n_heads, seq_len, head_dim), "float32")
+            specs[f"{prefix}cross_v{li}"] = (
+                (rows, n_heads, seq_len, head_dim), "float32")
+        return specs
+    NP = cache.pages(maxT)
+    E = cache.n_prompt_entries
+    specs[f"{prefix}block_tab"] = ((rows, NP), "int32")
+    specs[f"{prefix}prompt_ref"] = ((rows,), "int32")
+    for li in range(n_layers):
+        specs[f"{prefix}self_k{li}{POOL_MARK}"] = (
+            (cache.n_blocks, cache.block_size, n_heads, head_dim),
+            "float32")
+        specs[f"{prefix}self_v{li}{POOL_MARK}"] = (
+            (cache.n_blocks, cache.block_size, n_heads, head_dim),
+            "float32")
+        # +1: the dustbin entry padded admission rows scatter into
+        specs[f"{prefix}cross_k{li}{POOL_MARK}"] = (
+            (E + 1, n_heads, seq_len, head_dim), "float32")
+        specs[f"{prefix}cross_v{li}{POOL_MARK}"] = (
+            (E + 1, n_heads, seq_len, head_dim), "float32")
+    return specs
+
+
+def _declare_slot_state(block, specs):
+    """Declare the persistable slot-pool vars in a program's global
+    block (all programs bind the SAME scope values by name). Concrete
+    shapes + dtypes keep them carry-declarable (checker PTA090)."""
+    return {name: block.create_var(name=name, shape=shape, dtype=dt,
+                                   persistable=True,
+                                   stop_gradient=True)
+            for name, (shape, dt) in specs.items()}
+
+
+def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
+                              n_heads=4, n_layers=2, d_inner=128,
+                              vocab=1000, start_id=0, end_id=1,
+                              n_slots=8, admit_buckets=None,
+                              state_prefix="@cb/", cache=None):
+    """Build the slot-pool continuous-batching bundle (bucketed
+    admission prefills + single-step decode over ``n_slots``
+    device-resident lanes) — see DecodeStepBundle. The step program's
+    per-layer math IS build_incremental_decode_program's While body
+    (``cached_decoder_step``), with the scalar loop counter replaced
+    by a per-lane counter vector, so a lane decodes token-for-token
+    exactly what the whole-loop program would — the continuous
+    server's parity invariant, across BOTH KV layouts.
+
+    ``admit_buckets`` bounds the admission specializations (default:
+    power-of-two ladder 1,2,4,... capped at n_slots); padded rows of
+    a bucket land on the dustbin lane. ``cache`` (CacheConfig)
+    selects the KV layout; None = dense.
+
+    Returns a DecodeStepBundle.
+    """
+    import paddle_tpu as fluid
+
+    from . import transformer as T
+
+    cache = cache or CacheConfig()
+    cache.validate(max_out_len)
+    paged = cache.layout == "paged"
+    head_dim = d_model // n_heads
+    maxT = max_out_len
+    rows = n_slots + 1  # + the dustbin lane for padded admissions
+    if admit_buckets is None:
+        admit_buckets, b = [], 1
+        while b < n_slots:
+            admit_buckets.append(b)
+            b *= 2
+        admit_buckets.append(n_slots)
+    admit_buckets = sorted(set(int(a) for a in admit_buckets))
+    if admit_buckets[0] < 1 or admit_buckets[-1] > n_slots:
+        raise ValueError(
+            f"admit_buckets {admit_buckets} must lie in "
+            f"[1, n_slots={n_slots}]")
+    specs = _slot_state_specs(state_prefix, rows, maxT, seq_len,
+                              n_heads, head_dim, n_layers, cache)
+    if paged:
+        NP, BS, NB = cache.pages(maxT), cache.block_size, cache.n_blocks
+        E = cache.n_prompt_entries
+
+    # --- lane-reset tail shared by every admission flavor: one-hot
+    # masks over the fed slot ids, then token-buffer/counter/flag
+    # resets for exactly the admitted lanes --------------------------
+    def _lane_onehots(slots, A):
+        lane_range = layers.cast(layers.range(0, rows, 1), "int64")
+        # [A, rows] one-hot per admitted prompt; padded rows all
+        # point at the dustbin, whose scatter-sum is garbage by
+        # design — min() clamps its multiplicity in the masks
+        oh = layers.cast(
+            layers.equal(lane_range,
+                         layers.reshape(slots, [A, 1])),
+            "float32")
+        any_f = layers.elementwise_min(
+            layers.reduce_sum(oh, dim=0),
+            layers.fill_constant([rows], "float32", 1.0))
+        any_i = layers.cast(any_f, "int64")
+        keep_f = layers.elementwise_sub(
+            layers.fill_constant([rows], "float32", 1.0), any_f)
+        keep_i = layers.elementwise_sub(
+            layers.fill_constant([rows], "int64", 1.0), any_i)
+        return oh, any_f, any_i, keep_f, keep_i
+
+    def _reset_lane_state(sv, any_i, keep_i):
+        # token buffer rows: start_id at position 0, zeros
+        # elsewhere (identical init row for every admission)
+        positions = layers.cast(layers.range(0, maxT, 1), "int64")
+        start_col = layers.cast(
+            layers.equal(positions,
+                         layers.fill_constant([1], "int64", 0.0)),
+            "int64")
+        row_init = layers.cast(
+            layers.scale(start_col, scale=float(start_id)),
+            "int64")
+        any_col = layers.reshape(any_i, [rows, 1])
+        keep_col = layers.reshape(keep_i, [rows, 1])
+        tok_buf = sv[f"{state_prefix}tok_buf"]
+        layers.assign(layers.elementwise_add(
+            layers.elementwise_mul(tok_buf, keep_col),
+            layers.elementwise_mul(any_col, row_init)),
+            output=tok_buf)
+        stepv = sv[f"{state_prefix}step"]
+        layers.assign(layers.elementwise_mul(stepv, keep_i),
+                      output=stepv)
+        fin = sv[f"{state_prefix}finished"]
+        layers.assign(layers.elementwise_mul(fin, keep_i),
+                      output=fin)
+        act = sv[f"{state_prefix}active"]
+        # the dustbin lane never activates: it must not hold the
+        # serve While open nor count against min_active
+        valid = layers.assign(
+            (np.arange(rows) < n_slots).astype("int64"))
+        layers.assign(layers.elementwise_add(
+            layers.elementwise_mul(act, keep_i),
+            layers.elementwise_mul(any_i, valid)), output=act)
+
+    def _encode_prompts(A):
+        src = layers.data("src_ids", shape=[A, seq_len],
+                          dtype="int64", append_batch_size=False)
+        enc = T._embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
+                       True, "src_word_emb")
+        for li in range(n_layers):
+            enc = T.encoder_layer(enc, d_model, n_heads, d_inner,
+                                  0.0, is_test=True,
+                                  name=f"enc{li}")
+        return enc
+
+    def _cross_proj(enc, li):
+        kvp = layers.fc(enc, 2 * d_model, num_flatten_dims=2,
+                        bias_attr=False,
+                        param_attr=T._attn_proj_attr(
+                            f"dec{li}_cross", "kv", d_model))
+        k, v = layers.split(kvp, 2, dim=2)
+        return (heads_of(k, seq_len, n_heads, head_dim),
+                heads_of(v, seq_len, n_heads, head_dim))
+
+    # --- admission bodies: admit up to A prompts in ONE dispatch ----
+    def _admit_body_dense(sv, A):
+        enc = _encode_prompts(A)
+        slots = layers.data("slots", shape=[A], dtype="int64",
+                            append_batch_size=False)
+        oh, any_f, any_i, keep_f, keep_i = _lane_onehots(slots, A)
+        keep4 = layers.reshape(keep_f, [rows, 1, 1, 1])
+        ohT = layers.transpose(oh, perm=[1, 0])  # [rows, A]
+        flat = n_heads * seq_len * head_dim
+        for li in range(n_layers):
+            kh, vh = _cross_proj(enc, li)
+            for var, new in (
+                    (sv[f"{state_prefix}cross_k{li}"], kh),
+                    (sv[f"{state_prefix}cross_v{li}"], vh)):
+                # one-hot matmul scatter: row a of `new` lands on
+                # lane slots[a]; untouched lanes read 0 and keep
+                # their old value through keep4
+                scat = layers.reshape(
+                    layers.matmul(ohT,
+                                  layers.reshape(new, [A, flat])),
+                    [rows, n_heads, seq_len, head_dim])
+                layers.assign(layers.elementwise_add(
+                    layers.elementwise_mul(var, keep4), scat),
+                    output=var)
+            for var in (sv[f"{state_prefix}self_k{li}"],
+                        sv[f"{state_prefix}self_v{li}"]):
+                layers.assign(layers.elementwise_mul(var, keep4),
+                              output=var)
+        _reset_lane_state(sv, any_i, keep_i)
+
+    def _admit_body_paged_miss(sv, A):
+        """Cold-prompt admission: encode, publish cross-KV into the
+        fed prompt-pool entries (host-distinct indices — padded rows
+        target the dustbin entry), reset the lanes. The lanes' block
+        tables / prompt refs are HOST-written scope state."""
+        enc = _encode_prompts(A)
+        slots = layers.data("slots", shape=[A], dtype="int64",
+                            append_batch_size=False)
+        pslots = layers.data("prompt_slots", shape=[A], dtype="int64",
+                             append_batch_size=False)
+        for li in range(n_layers):
+            kh, vh = _cross_proj(enc, li)
+            for var, new in (
+                    (sv[f"{state_prefix}cross_k{li}{POOL_MARK}"], kh),
+                    (sv[f"{state_prefix}cross_v{li}{POOL_MARK}"],
+                     vh)):
+                layers.masked_pool_write(
+                    var, new, pslots, leading_dims=1,
+                    exclusive_via="host_indices")
+        _, _, any_i, _, keep_i = _lane_onehots(slots, A)
+        _reset_lane_state(sv, any_i, keep_i)
+        # fresh lanes need no self-pool zeroing: every cache position
+        # <= t is rewritten by the lane before it is ever attended to,
+        # and positions > t are masked by the validity bias exactly
+        # like the dense layout's zeros
+
+    def _admit_body_paged_hit(sv, A):
+        """Prefix-HIT admission: the prompt's cross-KV entry is
+        already in the pool (refcount bumped host-side), so admission
+        is a lane reset only — no encoder, no pool write. This is the
+        prefix-reuse fast path a shared system prompt rides."""
+        slots = layers.data("slots", shape=[A], dtype="int64",
+                            append_batch_size=False)
+        _, _, any_i, _, keep_i = _lane_onehots(slots, A)
+        _reset_lane_state(sv, any_i, keep_i)
+
+    admit_bodies = {"miss": _admit_body_dense if not paged
+                    else _admit_body_paged_miss}
+    if paged:
+        admit_bodies["hit"] = _admit_body_paged_hit
+
+    prefills = {}
+    hit_prefills = {}
+    startup = None
+    for A in admit_buckets:
+        prog = fluid.Program()
+        st = fluid.Program()
+        with fluid.program_guard(prog, st):
+            admit_bodies["miss"](
+                _declare_slot_state(prog.global_block, specs), A)
+        prefills[A] = prog
+        startup = startup or st
+        if paged:
+            hprog = fluid.Program()
+            with fluid.program_guard(hprog, fluid.Program()):
+                admit_bodies["hit"](
+                    _declare_slot_state(hprog.global_block, specs), A)
+            hit_prefills[A] = hprog
+
+    # --- the one-token step body over all lanes (shared by the
+    # standalone step program and the fused serve programs' While) ---
+    def _step_body(sv):
+        tok_buf = sv[f"{state_prefix}tok_buf"]
+        stepv = sv[f"{state_prefix}step"]
+        fin = sv[f"{state_prefix}finished"]
+        act = sv[f"{state_prefix}active"]
+        positions = layers.cast(layers.range(0, maxT, 1), "int64")
+        posf = layers.cast(positions, "float32")
+        pos_table = layers.assign(
+            T._position_encoding(max(seq_len, maxT), d_model)[:maxT])
+        step2 = layers.reshape(stepv, [rows, 1])           # [R,1]
+        t_mask = layers.cast(layers.equal(positions, step2),
+                             "float32")                    # [R,maxT]
+        cur_tok = layers.reduce_sum(
+            layers.elementwise_mul(tok_buf,
+                                   layers.cast(t_mask, "int64")),
+            dim=1, keep_dim=True)                          # [R,1]
+        x = layers.embedding(cur_tok, size=[vocab, d_model],
+                             param_attr=ParamAttr(
+                                 name="tgt_word_emb"))     # [R,D]
+        x = layers.unsqueeze(x, [1])                       # [R,1,D]
+        x = layers.scale(x, scale=d_model ** 0.5)
+        pos_t = layers.matmul(t_mask, pos_table)           # [R,D]
+        x = layers.elementwise_add(x, layers.unsqueeze(pos_t, [1]))
+        # per-lane attention validity (paged gathers exactly the
+        # dense maxT positions — block_size divides maxT — so the
+        # same bias masks unwritten cells in both layouts)
+        att_bias = layers.reshape(
+            layers.scale(layers.cast(layers.greater_than(
+                posf, layers.cast(step2, "float32")), "float32"),
+                scale=-1e9),
+            [rows, 1, 1, maxT])
+        if not paged:
+            write_mask = layers.reshape(t_mask, [rows, 1, maxT, 1])
+            keep_mask = layers.reshape(
+                layers.elementwise_sub(
+                    layers.fill_constant([rows, maxT], "float32",
+                                         1.0),
+                    t_mask),
+                [rows, 1, maxT, 1])
+            caches = [_DenseLaneCache(sv[f"{state_prefix}self_k{li}"],
+                                      sv[f"{state_prefix}self_v{li}"],
+                                      write_mask, keep_mask)
+                      for li in range(n_layers)]
+            cross_kv = [(sv[f"{state_prefix}cross_k{li}"],
+                         sv[f"{state_prefix}cross_v{li}"])
+                        for li in range(n_layers)]
+        else:
+            # cell addresses through the HOST-owned block table:
+            # flat cache cell of position p = tab[lane, p//BS]*BS
+            # + p%BS, materialized for all maxT positions (gather
+            # view) and for the current write position (scatter)
+            tabf = layers.cast(sv[f"{state_prefix}block_tab"],
+                               "float32")                  # [R,NP]
+            base = layers.expand(
+                layers.unsqueeze(layers.scale(tabf, scale=float(BS)),
+                                 [2]),
+                [1, 1, BS])                                # [R,NP,BS]
+            offs = layers.assign(np.arange(BS, dtype="float32"))
+            flat_posf = layers.elementwise_add(base, offs, axis=2)
+            flat_pos = layers.cast(
+                layers.reshape(flat_posf, [rows * maxT]), "int32")
+            # current position's page/offset one-hots from t_mask
+            t_pages = layers.reshape(t_mask, [rows, NP, BS])
+            page_oh = layers.reduce_sum(t_pages, dim=2)    # [R,NP]
+            off_oh = layers.reduce_sum(t_pages, dim=1)     # [R,BS]
+            cur_block = layers.reduce_sum(
+                layers.elementwise_mul(tabf, page_oh), dim=1)
+            cur_off = layers.reduce_sum(
+                layers.elementwise_mul(off_oh, offs), dim=1)
+            write_idx = layers.cast(
+                layers.elementwise_add(
+                    layers.scale(cur_block, scale=float(BS)),
+                    cur_off), "int32")                     # [R]
+            # idle/dustbin/paused lanes (act=0) must NOT write the
+            # SHARED pool — the gate is the lane-exclusivity half
+            # PTA110 checks alongside the block-table indices
+            gate = layers.cast(act, "float32")
+            caches = [_PagedLaneCache(
+                sv[f"{state_prefix}self_k{li}{POOL_MARK}"],
+                sv[f"{state_prefix}self_v{li}{POOL_MARK}"],
+                write_idx, gate, flat_pos, rows, n_heads, head_dim,
+                maxT, NB * BS) for li in range(n_layers)]
+            pref = sv[f"{state_prefix}prompt_ref"]
+            cross_kv = []
+            for li in range(n_layers):
+                pair = []
+                for tag in ("k", "v"):
+                    pool = sv[f"{state_prefix}cross_{tag}{li}"
+                              f"{POOL_MARK}"]
+                    flat = layers.reshape(
+                        pool, [E + 1, n_heads * seq_len * head_dim])
+                    got = layers.gather(flat, pref)        # [R, HSD]
+                    pair.append(layers.reshape(
+                        got, [rows, n_heads, seq_len, head_dim]))
+                cross_kv.append(tuple(pair))
+        x = cached_decoder_step(x, caches, cross_kv, att_bias,
+                                d_model, n_heads, d_inner)
+        logits_v = layers.fc(
+            layers.reshape(x, [0, d_model]), vocab,
+            bias_attr=False, param_attr="logits.w")        # [R,V]
+        # --- per-lane emit (the emit_token_step tail, vectorized over
+        # lane counters; same freeze/write semantics) ---
+        tok = layers.cast(layers.argmax(logits_v, axis=-1),
+                          "int64")                         # [R]
+        ones_n = layers.fill_constant([rows], "int64", 1.0)
+        not_fin = layers.elementwise_sub(ones_n, fin)
+        tok = layers.elementwise_add(
+            layers.elementwise_mul(tok, not_fin),
+            layers.cast(layers.scale(fin, scale=float(end_id)),
+                        "int64"))
+        # the EOS latch only counts lanes that actually ADVANCED this
+        # tick (act gate): a host-paused paged lane (no KV block for
+        # its next write) decodes a garbage token — its tok_buf write
+        # is re-done correctly on resume, but an un-gated fin latch
+        # would freeze the lane on garbage-EOS permanently
+        new_fin = layers.elementwise_max(
+            fin, layers.elementwise_mul(
+                act, layers.cast(layers.equal(
+                    tok, layers.fill_constant(
+                        [1], "int64", float(end_id))), "int64")))
+        next2 = layers.reshape(
+            layers.elementwise_add(stepv, ones_n), [rows, 1])
+        next_mask = layers.cast(layers.equal(positions, next2),
+                                "int64")                   # [R,maxT]
+        keep_tok = layers.elementwise_sub(
+            layers.fill_constant([rows, maxT], "int64", 1.0),
+            next_mask)
+        new_step = layers.elementwise_add(stepv, act)  # gate by lane
+        layers.assign(layers.elementwise_add(
+            layers.elementwise_mul(tok_buf, keep_tok),
+            layers.elementwise_mul(next_mask,
+                                   layers.reshape(tok, [rows, 1]))),
+            output=tok_buf)
+        layers.assign(new_step, output=stepv)
+        # lanes auto-deactivate on EOS or buffer exhaustion — the
+        # host retires a lane the moment its active flag drops
+        room = layers.cast(layers.less_than(
+            new_step, layers.fill_constant([1], "int64",
+                                           float(maxT - 1))),
+            "int64")                                       # [N]
+        new_act = layers.elementwise_mul(
+            layers.elementwise_mul(
+                act, layers.elementwise_sub(ones_n, new_fin)),
+            room)
+        layers.assign(new_act, output=act)
+        layers.assign(new_fin, output=fin)
+
+    # --- standalone single-step program (one tick = one dispatch;
+    # also the Executor.prepare(steps=K) scan target) ----------------
+    step_prog = fluid.Program()
+    with fluid.program_guard(step_prog, fluid.Program()):
+        _step_body(_declare_slot_state(step_prog.global_block, specs))
+
+    # --- fused serve programs: [admission +] a decode-burst While —
+    # a WHOLE scheduler cycle (admit + burst) is ONE dispatch, so the
+    # host overhead amortizes over A admissions and a burst of tokens
+    # per lane. The loop exits when EITHER n_steps ticks ran OR the
+    # live-lane count drops to min_active (both fed): with a
+    # non-empty host queue the server sets min_active = live - 1, so
+    # control returns the MOMENT a lane retires and its slot refills
+    # — iteration-level scheduling with zero zombie ticks — while an
+    # empty queue sets min_active = 0 and the burst drains the pool.
+    # One specialization per admission flavor x bucket (0: no
+    # admission). ---------------------------------------------------
+    def _build_serve(tier, A):
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            sv = _declare_slot_state(prog.global_block, specs)
+            if A > 0:
+                admit_bodies[tier](sv, A)
+            n_steps = layers.data("n_steps", shape=[1], dtype="int64",
+                                  append_batch_size=False)
+            min_active = layers.data("min_active", shape=[1],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            act = sv[f"{state_prefix}active"]
+            k = layers.fill_constant([1], "int64", 0)
+
+            def _serve_cond(cond=None):
+                # ticks remain AND live lanes exceed the exit
+                # threshold: min(a, b) > 0
+                return layers.greater_than(
+                    layers.elementwise_min(
+                        layers.elementwise_sub(n_steps, k),
+                        layers.elementwise_sub(
+                            layers.reduce_sum(act, keep_dim=True),
+                            min_active)),
+                    layers.fill_constant([1], "int64", 0.0),
+                    cond=cond)
+
+            cond = _serve_cond()
+            w = layers.While(cond)
+            with w.block():
+                _step_body(sv)
+                layers.increment(k, 1)
+                _serve_cond(cond=cond)
+        return prog
+
+    serves = {0: _build_serve("miss", 0)}
+    for A in admit_buckets:
+        if paged:
+            serves[("miss", A)] = _build_serve("miss", A)
+            serves[("hit", A)] = _build_serve("hit", A)
+        else:
+            serves[A] = _build_serve("miss", A)
+
+    state = {"tok_buf": f"{state_prefix}tok_buf",
+             "step": f"{state_prefix}step",
+             "finished": f"{state_prefix}finished",
+             "active": f"{state_prefix}active"}
+    if paged:
+        state["block_tab"] = f"{state_prefix}block_tab"
+        state["prompt_ref"] = f"{state_prefix}prompt_ref"
+    bundle = DecodeStepBundle(prefills, step_prog, serves, startup,
+                              state, n_slots, seq_len, maxT, start_id,
+                              end_id, cache=cache,
+                              hit_prefills=hit_prefills)
+    bundle._state_specs = {
+        n: (shape, dt) for n, (shape, dt) in specs.items()}
+    return bundle
+
+
+# ---------------------------------------------------------------------------
+# Host-side allocation policy (plain Python; the device only sees the
+# tables the scheduler writes into the scope).
+# ---------------------------------------------------------------------------
+class BlockPoolExhausted(RuntimeError):
+    """The shared KV block pool (or the prompt-entry pool) cannot
+    satisfy an allocation AND nothing in flight can ever free one —
+    a NAMED, RETRYABLE error (``retryable=True``): the caller may
+    resubmit once other requests retire, or against a server with a
+    larger pool. Raised instead of hanging the scheduler (the r13
+    acceptance contract); transient pressure is handled by queueing/
+    pausing, never by this error."""
+
+    retryable = True
+
+
+class HostBlockPool:
+    """Free-list over the ``n_blocks`` shared self-KV blocks. Lanes
+    own disjoint block sets by construction (alloc hands a block to
+    exactly one lane until freed) — the host half of the PTA110
+    lane-exclusivity story; the device half is the act-gated
+    masked_pool_write masks."""
+
+    def __init__(self, n_blocks: int):
+        self.n_blocks = int(n_blocks)
+        self._free = list(range(self.n_blocks))
+
+    def alloc(self) -> Optional[int]:
+        return self._free.pop() if self._free else None
+
+    def free(self, blocks):
+        for b in blocks:
+            if not 0 <= b < self.n_blocks or b in self._free:
+                raise ValueError(f"bad free of block {b}")
+            self._free.append(b)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.n_blocks - len(self._free)
+
+
+class PromptPrefixCache:
+    """Refcounted exact-prompt cache over the cross-KV entry pool,
+    with block-hash-chain partial detection (the SGLang/RadixAttention
+    shape at whole-prompt granularity: this framework's encoder is
+    BIDIRECTIONAL, so a cross-KV column depends on the WHOLE prompt
+    and only a full-content match may reuse an entry; a leading-chunk
+    match is reported as the ``partial`` tier — re-prefilled like a
+    miss, and counted as a copy-on-write materialization — which a
+    causal-encoder model could upgrade to true radix reuse).
+
+    Entries are pinned while any lane references them (``refs > 0``);
+    unpinned entries stay cached LRU and are evicted only when a miss
+    needs a slot. Counters feed the block-pool observability gauges
+    (prefix_hits/misses/partials=cow_copies, evictions)."""
+
+    def __init__(self, n_entries: int, chunk_tokens: int):
+        self.n_entries = int(n_entries)
+        self.chunk = max(1, int(chunk_tokens))
+        self._free = list(range(self.n_entries))
+        self._by_prompt: Dict[tuple, int] = {}   # prompt -> entry
+        self._entry_prompt: Dict[int, tuple] = {}
+        self._refs: Dict[int, int] = {}
+        self._lru: "Dict[tuple, None]" = {}      # insertion-ordered
+        self._heads: Dict[tuple, int] = {}       # first chunk -> count
+        self.hits = 0
+        self.misses = 0
+        self.partials = 0       # exposed as cow_copies
+        self.evictions = 0
+
+    def _head(self, prompt: tuple) -> tuple:
+        return prompt[:self.chunk]
+
+    def lookup(self, prompt: tuple) -> Tuple[str, Optional[int]]:
+        """('hit', entry) on a full-content match, ('partial', None)
+        when only a leading chunk matches a cached prompt, else
+        ('miss', None). Pure lookup — no counters, no refcounts (the
+        scheduler may probe the queue head every cycle)."""
+        entry = self._by_prompt.get(prompt)
+        if entry is not None:
+            return "hit", entry
+        if self._heads.get(self._head(prompt)):
+            return "partial", None
+        return "miss", None
+
+    def acquire_hit(self, prompt: tuple) -> int:
+        entry = self._by_prompt[prompt]
+        self._refs[entry] = self._refs.get(entry, 0) + 1
+        self._lru.pop(prompt, None)
+        self._lru[prompt] = None
+        self.hits += 1
+        return entry
+
+    def acquire_fresh(self, prompt: tuple,
+                      partial: bool = False) -> Optional[int]:
+        """Entry for a cold prompt: a free slot, else the LRU
+        UNPINNED entry (evicted). None when every entry is pinned —
+        the caller backpressures (or, with nothing in flight, raises
+        BlockPoolExhausted)."""
+        if self._free:
+            entry = self._free.pop()
+        else:
+            victim = next((p for p in self._lru
+                           if self._refs.get(self._by_prompt[p],
+                                             0) == 0), None)
+            if victim is None:
+                return None
+            entry = self._by_prompt.pop(victim)
+            self._lru.pop(victim, None)
+            self._entry_prompt.pop(entry, None)
+            head = self._head(victim)
+            self._heads[head] -= 1
+            if not self._heads[head]:
+                del self._heads[head]
+            self.evictions += 1
+        self._by_prompt[prompt] = entry
+        self._entry_prompt[entry] = prompt
+        self._refs[entry] = 1
+        self._lru[prompt] = None
+        self._heads[self._head(prompt)] = \
+            self._heads.get(self._head(prompt), 0) + 1
+        if partial:
+            self.partials += 1
+        else:
+            self.misses += 1
+        return entry
+
+    def release(self, entry: int):
+        self._refs[entry] = max(0, self._refs.get(entry, 0) - 1)
+
+    @property
+    def in_use(self) -> int:
+        return sum(1 for r in self._refs.values() if r > 0)
+
+
+__all__ = ["CacheConfig", "DecodeStepBundle", "DECODE_STEPS_VAR",
+           "POOL_MARK", "BlockPoolExhausted", "HostBlockPool",
+           "PromptPrefixCache", "build_greedy_decode_program",
+           "build_incremental_decode_program",
+           "build_decode_step_program", "cached_decoder_step",
+           "step_logits", "init_token_buffer", "emit_token_step",
+           "heads_of"]

@@ -6,18 +6,30 @@ Built entirely from the framework's own layers; attention goes through
 the flash-attention path (ops/pallas/attention.py) when enabled, else
 the jnp composition -- either way one XLA program per step with all
 matmuls on the MXU in bf16-friendly shapes.
+
+Decode fronts: the whole-loop, incremental and slot-pool builders
+below keep their public signatures but DELEGATE to
+models/decode_engine.py — the single home for decode capabilities
+(cache layouts incl. the paged KV block pool, step body, loop/burst/
+exit policy, emission). New decode features land there once, not
+three times.
 """
 from __future__ import annotations
 
 import numpy as np
 
 from .. import layers, unique_name
-from ..initializer import NumpyArrayInitializer, XavierInitializer
+from ..initializer import XavierInitializer
 from ..param_attr import ParamAttr
-
-# fixed-name [1] int64 var holding the number of While iterations a
-# decode program actually ran (early-exit observability; fetchable)
-DECODE_STEPS_VAR = "@decode_steps"
+from . import decode_engine
+# re-exports: the decode surface moved to decode_engine; every
+# existing call site (tests, benches, analysis targets) keeps
+# importing it from here
+from .decode_engine import (DECODE_STEPS_VAR, CacheConfig,  # noqa: F401
+                            DecodeStepBundle,
+                            build_decode_step_program,
+                            build_greedy_decode_program,
+                            build_incremental_decode_program)
 
 
 def _position_encoding(max_len, d_model):
@@ -247,776 +259,6 @@ def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
     return main, startup, avg_cost
 
 
-def _step_logits(dec, positions, counter, vocab):
-    """Select step t's hidden row BEFORE the vocab projection: a
-    [rows,D]x[D,V] matmul instead of [rows,maxT,D]x[D,V] — identical
-    logits, maxT-fold cheaper (shared by all decode builders)."""
-    t_mask = layers.cast(layers.equal(positions, counter), "float32")
-    step_hidden = layers.reduce_sum(
-        layers.elementwise_mul(dec, layers.unsqueeze(t_mask, [1]),
-                               axis=1), dim=1)
-    return layers.fc(step_hidden, vocab, bias_attr=False,
-                     param_attr="logits.w")
-
-
-def _init_token_buffer(src, positions, max_out_len, start_id):
-    """[B, maxT] int64 zeros with the start token at position 0 — the
-    loop-carried decode buffer both decode builders share."""
-    buf = layers.fill_constant_batch_size_like(
-        src, [-1, max_out_len], "int64", 0.0)
-    if start_id:
-        start_col = layers.cast(
-            layers.equal(positions,
-                         layers.fill_constant([1], "int64", 0.0)),
-            "int64")
-        buf = layers.elementwise_add(
-            buf, layers.cast(
-                layers.scale(start_col, scale=float(start_id)),
-                "int64"))
-    return layers.assign(buf)
-
-
-def _emit_token_step(src, step_logits, positions, tgt_buf, finished,
-                     counter, limit, cond, max_out_len, end_id):
-    """Shared decode-loop tail: greedy argmax, EOS freeze (finished
-    rows keep emitting end_id), one-hot write at position t+1, counter
-    bump, loop-condition refresh. Mutates tgt_buf/finished/counter/
-    cond in place — keep BOTH decode builders on this helper so their
-    token-for-token equivalence can't silently diverge.
-
-    The refreshed condition carries an all-rows-finished early-exit
-    term: once every row has emitted end_id the loop stops instead of
-    spinning to max_out_len emitting frozen end_id rows. Positions
-    past the exit step keep their zero init — callers that need the
-    variable-length result go through apply_eos_sentinel
-    (inference/serving.py), which normalizes everything after the
-    first end_id to the -1 sentinel either way. Expressed with
-    reduce_sum/elementwise_min/greater_than only, all inside the
-    native xla_train kernel slice (FLAGS_native_build builds these
-    programs too)."""
-    tok = layers.cast(layers.argmax(step_logits, axis=-1), "int64")
-    not_fin = layers.elementwise_sub(
-        layers.fill_constant_batch_size_like(
-            src, [-1], "int64", 1.0), finished)
-    tok = layers.elementwise_add(
-        layers.elementwise_mul(tok, not_fin),
-        layers.cast(layers.scale(finished, scale=float(end_id)),
-                    "int64"))
-    layers.assign(
-        layers.elementwise_max(
-            finished,
-            layers.cast(layers.equal(
-                tok, layers.fill_constant([1], "int64",
-                                          float(end_id))), "int64")),
-        output=finished)
-    next_mask = layers.cast(
-        layers.equal(positions,
-                     layers.increment(counter, 1, in_place=False)),
-        "int64")
-    keep = layers.elementwise_sub(
-        layers.fill_constant([max_out_len], "int64", 1.0), next_mask)
-    layers.assign(
-        layers.elementwise_add(
-            layers.elementwise_mul(tgt_buf, keep),
-            layers.elementwise_mul(layers.unsqueeze(tok, [1]),
-                                   next_mask)),
-        output=tgt_buf)
-    layers.increment(counter, 1)
-    # continue while BOTH hold: steps remain (limit - counter > 0) AND
-    # at least one row is unfinished (sum(1 - finished) > 0); min(a, b)
-    # > 0 encodes the conjunction without logical ops
-    unfinished = layers.reduce_sum(
-        layers.elementwise_sub(
-            layers.fill_constant_batch_size_like(
-                src, [-1], "int64", 1.0), finished),
-        keep_dim=True)
-    layers.greater_than(
-        layers.elementwise_min(
-            layers.elementwise_sub(limit, counter), unfinished),
-        layers.fill_constant([1], "int64", 0.0), cond=cond)
-
-
-def build_greedy_decode_program(seq_len=16, max_out_len=16,
-                                d_model=64, n_heads=4, n_layers=2,
-                                d_inner=128, vocab=1000, start_id=0,
-                                end_id=1):
-    """Autoregressive greedy generation (reference
-    tests/unittests/dist_transformer.py:1498 fast_decode — its
-    while-op beam loop, at beam 1 — rebuilt as a lax.while_loop over
-    the full decoder at static shapes: each step re-runs the
-    causally-masked decoder on the [B, max_out_len] token buffer and
-    writes position t+1 by a one-hot mask; positions past t are
-    ignored by the causal mask, so no KV cache is needed for
-    correctness — incremental caching is a perf upgrade, not a
-    semantics change). Rows that emit end_id are frozen: every later
-    position holds end_id, like the reference's early-finish
-    handling.
-
-    Weight sharing with a training program is by EXPLICIT param name
-    (enc{i}_*/dec{i}_*/logits.w/…_word_emb) — build order and
-    unique_name state are irrelevant.
-    Returns (program, startup, feeds, out_ids_var).
-    """
-    import paddle_tpu as fluid
-
-    main = fluid.Program()
-    startup = fluid.Program()
-    with fluid.program_guard(main, startup):
-        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
-        enc = _embed(src, vocab, d_model, max(seq_len, max_out_len),
-                     0.0, True, "src_word_emb")
-        for li in range(n_layers):
-            enc = encoder_layer(enc, d_model, n_heads, d_inner, 0.0,
-                                is_test=True, name=f"enc{li}")
-
-        positions = layers.cast(layers.range(0, max_out_len, 1),
-                                "int64")
-        tgt_buf = _init_token_buffer(src, positions, max_out_len,
-                                     start_id)
-        # fixed-name counter so tests/benches can fetch the number of
-        # loop iterations actually taken (the early-exit probe)
-        counter = layers.fill_constant(
-            [1], "int64", 0,
-            out=main.global_block.create_var(
-                name=DECODE_STEPS_VAR, shape=(1,), dtype="int64",
-                stop_gradient=True))
-        limit = layers.fill_constant([1], "int64",
-                                     float(max_out_len - 1))
-        finished = layers.assign(layers.fill_constant_batch_size_like(
-            src, [-1], "int64", 0.0))  # [B]: 1 once EOS emitted
-        cond = layers.less_than(counter, limit)
-        w = layers.While(cond)
-        with w.block():
-            dec = _embed(tgt_buf, vocab, d_model,
-                         max(seq_len, max_out_len), 0.0, True,
-                         "tgt_word_emb")
-            for li in range(n_layers):
-                dec = decoder_layer(dec, enc, d_model, n_heads,
-                                    d_inner, 0.0, is_test=True,
-                                    name=f"dec{li}")
-            step_logits = _step_logits(dec, positions, counter,
-                                       vocab)  # [B, V]
-            _emit_token_step(src, step_logits, positions, tgt_buf,
-                             finished, counter, limit, cond,
-                             max_out_len, end_id)
-    return main, startup, ["src_ids"], tgt_buf
-
-
-def _heads_of(x, t, n_heads, head_dim):
-    """[R,t,H*D] -> [R,H,t,D] (the cached-attention head layout both
-    KV-cached decode builders share)."""
-    return layers.transpose(
-        layers.reshape(x, [0, t, n_heads, head_dim]),
-        perm=[0, 2, 1, 3])
-
-
-def _cached_decoder_step(x, caches, cross_kv, write_mask, keep_mask,
-                         att_bias, d_model, n_heads, d_inner):
-    """One KV-cached decoder-stack step over a [R,1,D] row batch
-    (reference tests/unittests/dist_transformer.py:1498 fast_decode's
-    cached decoder, factored so the whole-loop incremental program and
-    the slot-pool single-step program trace the IDENTICAL math — their
-    token-for-token parity is structural, not coincidental).
-
-    caches: per-layer (kc, vc) [R,H,maxT,Dh] vars, written in place at
-    each row's position via `write_mask`/`keep_mask` (one-hot /
-    complement over the maxT axis, any shape that broadcasts against
-    the cache: [maxT,1] for a shared scalar counter, [R,1,maxT,1] for
-    per-row slot counters). att_bias is the 0/-1e9 validity bias added
-    to the [R,H,1,maxT] attention scores ([maxT] or [R,1,1,maxT]).
-    cross_kv: per-layer (ck, cv) [R,H,S,Dh] encoder projections.
-    Param names are the explicit dec{li}_* scheme shared with the
-    training build. Returns the [R,1,D] hidden row after all layers.
-    """
-    head_dim = d_model // n_heads
-    scale = head_dim ** -0.5
-    for li in range(len(caches)):
-        kc, vc = caches[li]
-        # --- cached causal self-attention (fused qkv) ---
-        qkv = layers.fc(
-            x, 3 * d_model, num_flatten_dims=2, bias_attr=False,
-            param_attr=_attn_proj_attr(f"dec{li}_self", "qkv",
-                                       d_model))
-        q, k, v = layers.split(qkv, 3, dim=2)
-        qh = _heads_of(q, 1, n_heads, head_dim)
-        kh = _heads_of(k, 1, n_heads, head_dim)
-        vh = _heads_of(v, 1, n_heads, head_dim)
-        new_kc = layers.elementwise_add(
-            layers.elementwise_mul(kc, keep_mask),
-            layers.elementwise_mul(kh, write_mask))
-        new_vc = layers.elementwise_add(
-            layers.elementwise_mul(vc, keep_mask),
-            layers.elementwise_mul(vh, write_mask))
-        layers.assign(new_kc, output=kc)
-        layers.assign(new_vc, output=vc)
-        scores = layers.scale(
-            layers.matmul(qh, kc, transpose_y=True),
-            scale=scale)  # [R,H,1,maxT]
-        scores = layers.elementwise_add(scores, att_bias)
-        probs = layers.softmax(scores, axis=-1)
-        ctx = layers.matmul(probs, vc)
-        ctx = layers.reshape(
-            layers.transpose(ctx, perm=[0, 2, 1, 3]),
-            [0, 1, d_model])  # [R,1,HD]
-        attn_out = layers.fc(ctx, d_model, num_flatten_dims=2,
-                             bias_attr=False,
-                             param_attr=f"dec{li}_self_out.w")
-        x = _add_norm(attn_out, x, 0.0, True, name=f"dec{li}_a")
-        # --- cross attention against precomputed enc K/V ---
-        q2 = layers.fc(
-            x, d_model, num_flatten_dims=2, bias_attr=False,
-            param_attr=_attn_proj_attr(f"dec{li}_cross", "q",
-                                       d_model))
-        q2h = _heads_of(q2, 1, n_heads, head_dim)
-        ck, cv = cross_kv[li]
-        s2 = layers.scale(
-            layers.matmul(q2h, ck, transpose_y=True),
-            scale=scale)  # [R,H,1,S]
-        p2 = layers.softmax(s2, axis=-1)
-        ctx2 = layers.reshape(
-            layers.transpose(layers.matmul(p2, cv),
-                             perm=[0, 2, 1, 3]),
-            [0, 1, d_model])
-        cross_out = layers.fc(
-            ctx2, d_model, num_flatten_dims=2,
-            bias_attr=False,
-            param_attr=f"dec{li}_cross_out.w")
-        x = _add_norm(cross_out, x, 0.0, True, name=f"dec{li}_b")
-        # --- ffn ---
-        ffn = _ffn(x, d_model, d_inner, 0.0, True, name=f"dec{li}")
-        x = _add_norm(ffn, x, 0.0, True, name=f"dec{li}_c")
-    return x
-
-
-def build_incremental_decode_program(seq_len=16, max_out_len=16,
-                                     d_model=64, n_heads=4,
-                                     n_layers=2, d_inner=128,
-                                     vocab=1000, start_id=0,
-                                     end_id=1):
-    """KV-cached autoregressive greedy generation — the incremental
-    variant of build_greedy_decode_program (reference
-    tests/unittests/dist_transformer.py:1498 fast_decode caches
-    per-layer K/V the same way). Each step embeds ONE token, runs the
-    decoder stack on that single row against cached self-attention
-    K/V (written in place at position t) and precomputed
-    cross-attention K/V, so per-step cost is O(maxT) instead of
-    O(maxT^2) — token-for-token identical to the full-recompute
-    program (asserted in tests).
-
-    Weight sharing: the same explicit param names the training build
-    and build_greedy_decode_program use — order-independent.
-
-    Returns (program, startup, feeds, out_ids_var).
-    """
-    import paddle_tpu as fluid
-
-    head_dim = d_model // n_heads
-    maxT = max_out_len
-
-    main = fluid.Program()
-    startup = fluid.Program()
-    with fluid.program_guard(main, startup):
-        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
-        enc = _embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
-                     True, "src_word_emb")
-        for li in range(n_layers):
-            enc = encoder_layer(enc, d_model, n_heads, d_inner, 0.0,
-                                is_test=True, name=f"enc{li}")
-
-        def _heads(x, t):  # [B,T,H*D] -> [B,H,T,D]
-            return layers.transpose(
-                layers.reshape(x, [0, t, n_heads, head_dim]),
-                perm=[0, 2, 1, 3])
-
-        # cross-attention K/V once per layer (explicitly named
-        # dec{li}_cross_kv.w, shared with the training build)
-        cross_kv = []
-        for li in range(n_layers):
-            kv = layers.fc(enc, 2 * d_model, num_flatten_dims=2,
-                           bias_attr=False,
-                           param_attr=_attn_proj_attr(
-                               f"dec{li}_cross", "kv", d_model))
-            k, v = layers.split(kv, 2, dim=2)
-            cross_kv.append((_heads(k, seq_len), _heads(v, seq_len)))
-
-        positions = layers.cast(layers.range(0, maxT, 1), "int64")
-        posf = layers.cast(positions, "float32")
-        pos_table = layers.assign(
-            _position_encoding(max(seq_len, maxT), d_model)[:maxT])
-
-        tgt_buf = _init_token_buffer(src, positions, maxT, start_id)
-        # per-layer self-attn caches [B,H,maxT,D]
-        caches = []
-        for li in range(n_layers):
-            kc = layers.assign(layers.fill_constant_batch_size_like(
-                src, [-1, n_heads, maxT, head_dim], "float32", 0.0))
-            vc = layers.assign(layers.fill_constant_batch_size_like(
-                src, [-1, n_heads, maxT, head_dim], "float32", 0.0))
-            caches.append((kc, vc))
-        counter = layers.fill_constant(
-            [1], "int64", 0,
-            out=main.global_block.create_var(
-                name=DECODE_STEPS_VAR, shape=(1,), dtype="int64",
-                stop_gradient=True))
-        limit = layers.fill_constant([1], "int64", float(maxT - 1))
-        finished = layers.assign(layers.fill_constant_batch_size_like(
-            src, [-1], "int64", 0.0))
-        cond = layers.less_than(counter, limit)
-        w = layers.While(cond)
-        with w.block():
-            # embed ONLY the current token
-            t_mask = layers.cast(layers.equal(positions, counter),
-                                 "float32")  # [maxT]
-            cur_tok = layers.reduce_sum(
-                layers.elementwise_mul(tgt_buf,
-                                       layers.cast(t_mask, "int64")),
-                dim=1, keep_dim=True)  # [B,1]
-            x = layers.embedding(cur_tok, size=[vocab, d_model],
-                                 param_attr=ParamAttr(
-                                     name="tgt_word_emb"))
-            # lookup_table squeezes the trailing 1 of [B,1] ids:
-            # restore the time axis for the [B,1,D] step row
-            x = layers.unsqueeze(x, [1])
-            x = layers.scale(x, scale=d_model ** 0.5)
-            pos_t = layers.reduce_sum(
-                layers.elementwise_mul(
-                    pos_table, layers.unsqueeze(t_mask, [1]), axis=0),
-                dim=0)  # [D]
-            x = layers.elementwise_add(x, pos_t)  # [B,1,D]
-
-            # attention validity: cached positions <= t
-            att_mask = layers.scale(
-                layers.cast(layers.greater_than(
-                    posf, layers.cast(counter, "float32")),
-                    "float32"), scale=-1e9)  # [maxT] 0 keep / -1e9 drop
-
-            # one-hot write column at cache position t (axis 2 of the
-            # [B,H,maxT,Dh] caches) and its complement
-            m2 = layers.unsqueeze(t_mask, [1])  # [maxT,1]
-            keepc = layers.unsqueeze(
-                layers.elementwise_sub(
-                    layers.fill_constant([maxT], "float32", 1.0),
-                    t_mask), [1])
-            x = _cached_decoder_step(x, caches, cross_kv, m2, keepc,
-                                     att_mask, d_model, n_heads,
-                                     d_inner)
-
-            step_logits = layers.fc(
-                layers.reshape(x, [0, d_model]), vocab,
-                bias_attr=False, param_attr="logits.w")  # [B,V]
-            _emit_token_step(src, step_logits, positions, tgt_buf,
-                             finished, counter, limit, cond, maxT,
-                             end_id)
-    return main, startup, ["src_ids"], tgt_buf
-
-
-class DecodeStepBundle:
-    """Program set for slot-pool continuous batching (reference
-    tests/unittests/dist_transformer.py:1498 fast_decode is the decode
-    loop; the slot-pool scheduling follows the iteration-level /
-    paged-slot serving discipline of Orca (OSDI'22) and vLLM
-    (SOSP'23), PAPERS.md).
-
-    All per-slot decode state is PERSISTABLE scope state shared by the
-    programs (KV cache slots, token buffers, per-slot step counters,
-    finished/active lane masks — dense pre-allocated buffers written
-    by one-hot scatter, the repo's loop-carried-history convention).
-    The pool holds ``n_slots`` schedulable lanes plus ONE extra
-    dustbin row (index ``n_slots``) that absorbs the padded rows of a
-    bucketed admission batch — it decodes garbage harmlessly (every
-    op is row-wise) and is never scheduled.
-
-    * ``prefills[A]`` — one admission program per bucket size A
-      (power-of-two ladder up to n_slots): feeds ``src_ids`` [A,
-      seq_len] + ``slots`` [A] (dustbin index for padded rows); runs
-      the encoder over the WHOLE admission batch, scatters each row's
-      cross-attention K/V into its slot (a one-hot matmul scatter),
-      resets the slots' self-attention KV rows / token buffers /
-      counters, and raises their active flags. One dispatch admits up
-      to A requests — admission cost does not scale per-request.
-      ``prefill`` aliases the A=1 bucket.
-    * ``step`` — no feeds; advances EVERY lane one token in one
-      dispatch (embed each lane's current token, cached decoder stack
-      via the shared ``_cached_decoder_step`` body, greedy emit with
-      EOS freeze, per-lane counter bump, lane auto-deactivation on
-      EOS or buffer exhaustion). Safe to scan K steps on device
-      (``Executor.prepare(steps=K)``): every state var is read AND
-      written, so the scan carry is fully populated.
-    * ``serves[A]`` — the fused scheduler-cycle programs the
-      continuous server actually dispatches: the bucket-A admission
-      body (absent at A=0) followed by a While that runs the step
-      body until ``n_steps`` ticks ran or the live-lane count drops
-      to ``min_active`` (both fed as [1] int64). A whole
-      admit+decode-burst cycle is ONE dispatch, and with
-      min_active = live - 1 the loop hands control back the moment a
-      lane retires — iteration-level scheduling with no zombie
-      device ticks.
-
-    ``state`` maps logical names ('tok_buf', 'step', 'finished',
-    'active') to the scope var names; ``init_slot_state(scope)`` seeds
-    the pool (zeros; finished=1 so idle lanes emit frozen end_id rows
-    and never corrupt anything). The returned ``startup`` holds param
-    initializers only — serving runs against an already-trained scope
-    and must NOT run it (it would clobber the weights); slot state
-    comes from ``init_slot_state``.
-
-    Weight sharing: the explicit enc{i}_*/dec{i}_*/logits.w/…_word_emb
-    names — order-independent with the train and whole-loop builds.
-    """
-
-    def __init__(self, prefills, step, serves, startup, state,
-                 n_slots, seq_len, max_out_len, start_id, end_id):
-        self.prefills = dict(prefills)   # bucket size A -> Program
-        self.prefill = self.prefills[min(self.prefills)]
-        self.step = step
-        self.serves = dict(serves)       # admit bucket (0=none) -> Program
-        self.startup = startup
-        self.state = dict(state)
-        self.n_slots = n_slots
-        self.dustbin = n_slots           # the padded-admission row
-        self.seq_len = seq_len
-        self.max_out_len = max_out_len
-        self.start_id = start_id
-        self.end_id = end_id
-        self._state_specs = {}
-
-    def init_slot_state(self, scope):
-        """Seed the pool state in `scope` (idle slots: finished=1,
-        active=0 — they step harmlessly until admitted)."""
-        for name, (shape, dt) in self._state_specs.items():
-            if name == self.state["finished"]:
-                scope._set(name, np.ones(shape, dt))
-            else:
-                scope._set(name, np.zeros(shape, dt))
-
-
-def _slot_state_specs(prefix, n_slots, maxT, seq_len, n_heads,
-                      head_dim, n_layers):
-    specs = {
-        f"{prefix}tok_buf": ((n_slots, maxT), "int64"),
-        f"{prefix}step": ((n_slots,), "int64"),
-        f"{prefix}finished": ((n_slots,), "int64"),
-        f"{prefix}active": ((n_slots,), "int64"),
-    }
-    for li in range(n_layers):
-        specs[f"{prefix}self_k{li}"] = (
-            (n_slots, n_heads, maxT, head_dim), "float32")
-        specs[f"{prefix}self_v{li}"] = (
-            (n_slots, n_heads, maxT, head_dim), "float32")
-        specs[f"{prefix}cross_k{li}"] = (
-            (n_slots, n_heads, seq_len, head_dim), "float32")
-        specs[f"{prefix}cross_v{li}"] = (
-            (n_slots, n_heads, seq_len, head_dim), "float32")
-    return specs
-
-
-def _declare_slot_state(block, specs):
-    """Declare the persistable slot-pool vars in a program's global
-    block (both programs bind the SAME scope values by name). Concrete
-    shapes + dtypes keep them carry-declarable (checker PTA090)."""
-    return {name: block.create_var(name=name, shape=shape, dtype=dt,
-                                   persistable=True,
-                                   stop_gradient=True)
-            for name, (shape, dt) in specs.items()}
-
-
-def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
-                              n_heads=4, n_layers=2, d_inner=128,
-                              vocab=1000, start_id=0, end_id=1,
-                              n_slots=8, admit_buckets=None,
-                              state_prefix="@cb/"):
-    """Build the slot-pool continuous-batching bundle (bucketed
-    admission prefills + single-step decode over ``n_slots``
-    device-resident lanes) — see DecodeStepBundle. The step program's
-    per-layer math IS build_incremental_decode_program's While body
-    (`_cached_decoder_step`), with the scalar loop counter replaced by
-    a per-lane counter vector (one-hot masks become 2-D), so a lane
-    decodes token-for-token exactly what the whole-loop program would
-    — the continuous server's parity invariant.
-
-    ``admit_buckets`` bounds the admission specializations (default:
-    power-of-two ladder 1,2,4,... capped at n_slots); padded rows of
-    a bucket land on the dustbin lane.
-
-    Returns a DecodeStepBundle.
-    """
-    import paddle_tpu as fluid
-
-    head_dim = d_model // n_heads
-    maxT = max_out_len
-    rows = n_slots + 1  # + the dustbin lane for padded admissions
-    if admit_buckets is None:
-        admit_buckets, b = [], 1
-        while b < n_slots:
-            admit_buckets.append(b)
-            b *= 2
-        admit_buckets.append(n_slots)
-    admit_buckets = sorted(set(int(a) for a in admit_buckets))
-    if admit_buckets[0] < 1 or admit_buckets[-1] > n_slots:
-        raise ValueError(
-            f"admit_buckets {admit_buckets} must lie in "
-            f"[1, n_slots={n_slots}]")
-    specs = _slot_state_specs(state_prefix, rows, maxT, seq_len,
-                              n_heads, head_dim, n_layers)
-
-    # --- admission body: admit up to A prompts in ONE dispatch
-    # (batched encoder + one-hot matmul scatter); traced into both the
-    # standalone prefill programs and the fused serve programs -------
-    def _admit_body(sv, A):
-        src = layers.data("src_ids", shape=[A, seq_len],
-                          dtype="int64", append_batch_size=False)
-        slots = layers.data("slots", shape=[A], dtype="int64",
-                            append_batch_size=False)
-        enc = _embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
-                     True, "src_word_emb")
-        for li in range(n_layers):
-            enc = encoder_layer(enc, d_model, n_heads, d_inner,
-                                0.0, is_test=True,
-                                name=f"enc{li}")
-        lane_range = layers.cast(layers.range(0, rows, 1),
-                                 "int64")
-        # [A, rows] one-hot per admitted prompt; padded rows all
-        # point at the dustbin, whose scatter-sum is garbage by
-        # design — min() clamps its multiplicity in the masks
-        oh = layers.cast(
-            layers.equal(lane_range,
-                         layers.reshape(slots, [A, 1])),
-            "float32")
-        any_f = layers.elementwise_min(
-            layers.reduce_sum(oh, dim=0),
-            layers.fill_constant([rows], "float32", 1.0))
-        any_i = layers.cast(any_f, "int64")
-        keep_f = layers.elementwise_sub(
-            layers.fill_constant([rows], "float32", 1.0), any_f)
-        keep_i = layers.elementwise_sub(
-            layers.fill_constant([rows], "int64", 1.0), any_i)
-        keep4 = layers.reshape(keep_f, [rows, 1, 1, 1])
-        ohT = layers.transpose(oh, perm=[1, 0])  # [rows, A]
-        flat = n_heads * seq_len * head_dim
-        for li in range(n_layers):
-            kvp = layers.fc(enc, 2 * d_model, num_flatten_dims=2,
-                            bias_attr=False,
-                            param_attr=_attn_proj_attr(
-                                f"dec{li}_cross", "kv", d_model))
-            k, v = layers.split(kvp, 2, dim=2)
-            kh = _heads_of(k, seq_len, n_heads, head_dim)
-            vh = _heads_of(v, seq_len, n_heads, head_dim)
-            for var, new in (
-                    (sv[f"{state_prefix}cross_k{li}"], kh),
-                    (sv[f"{state_prefix}cross_v{li}"], vh)):
-                # one-hot matmul scatter: row a of `new` lands on
-                # lane slots[a]; untouched lanes read 0 and keep
-                # their old value through keep4
-                scat = layers.reshape(
-                    layers.matmul(ohT,
-                                  layers.reshape(new, [A, flat])),
-                    [rows, n_heads, seq_len, head_dim])
-                layers.assign(layers.elementwise_add(
-                    layers.elementwise_mul(var, keep4), scat),
-                    output=var)
-            for var in (sv[f"{state_prefix}self_k{li}"],
-                        sv[f"{state_prefix}self_v{li}"]):
-                layers.assign(layers.elementwise_mul(var, keep4),
-                              output=var)
-        # token buffer rows: start_id at position 0, zeros
-        # elsewhere (identical init row for every admission)
-        positions = layers.cast(layers.range(0, maxT, 1), "int64")
-        start_col = layers.cast(
-            layers.equal(positions,
-                         layers.fill_constant([1], "int64", 0.0)),
-            "int64")
-        row_init = layers.cast(
-            layers.scale(start_col, scale=float(start_id)),
-            "int64")
-        any_col = layers.reshape(any_i, [rows, 1])
-        keep_col = layers.reshape(keep_i, [rows, 1])
-        tok_buf = sv[f"{state_prefix}tok_buf"]
-        layers.assign(layers.elementwise_add(
-            layers.elementwise_mul(tok_buf, keep_col),
-            layers.elementwise_mul(any_col, row_init)),
-            output=tok_buf)
-        stepv = sv[f"{state_prefix}step"]
-        layers.assign(layers.elementwise_mul(stepv, keep_i),
-                      output=stepv)
-        fin = sv[f"{state_prefix}finished"]
-        layers.assign(layers.elementwise_mul(fin, keep_i),
-                      output=fin)
-        act = sv[f"{state_prefix}active"]
-        # the dustbin lane never activates: it must not hold the
-        # serve While open nor count against min_active
-        valid = layers.assign(
-            (np.arange(rows) < n_slots).astype("int64"))
-        layers.assign(layers.elementwise_add(
-            layers.elementwise_mul(act, keep_i),
-            layers.elementwise_mul(any_i, valid)), output=act)
-
-    prefills = {}
-    startup = None
-    for A in admit_buckets:
-        prog = fluid.Program()
-        st = fluid.Program()
-        with fluid.program_guard(prog, st):
-            _admit_body(_declare_slot_state(prog.global_block, specs),
-                        A)
-        prefills[A] = prog
-        startup = startup or st
-
-    # --- the one-token step body over all lanes (shared by the
-    # standalone step program and the fused serve programs' While) ---
-    def _step_body(sv):
-        tok_buf = sv[f"{state_prefix}tok_buf"]
-        stepv = sv[f"{state_prefix}step"]
-        fin = sv[f"{state_prefix}finished"]
-        act = sv[f"{state_prefix}active"]
-        positions = layers.cast(layers.range(0, maxT, 1), "int64")
-        posf = layers.cast(positions, "float32")
-        pos_table = layers.assign(
-            _position_encoding(max(seq_len, maxT), d_model)[:maxT])
-        step2 = layers.reshape(stepv, [rows, 1])           # [R,1]
-        t_mask = layers.cast(layers.equal(positions, step2),
-                             "float32")                    # [R,maxT]
-        cur_tok = layers.reduce_sum(
-            layers.elementwise_mul(tok_buf,
-                                   layers.cast(t_mask, "int64")),
-            dim=1, keep_dim=True)                          # [R,1]
-        x = layers.embedding(cur_tok, size=[vocab, d_model],
-                             param_attr=ParamAttr(
-                                 name="tgt_word_emb"))     # [R,D]
-        x = layers.unsqueeze(x, [1])                       # [R,1,D]
-        x = layers.scale(x, scale=d_model ** 0.5)
-        pos_t = layers.matmul(t_mask, pos_table)           # [R,D]
-        x = layers.elementwise_add(x, layers.unsqueeze(pos_t, [1]))
-        # per-lane attention validity + cache write masks
-        att_bias = layers.reshape(
-            layers.scale(layers.cast(layers.greater_than(
-                posf, layers.cast(step2, "float32")), "float32"),
-                scale=-1e9),
-            [rows, 1, 1, maxT])
-        write_mask = layers.reshape(t_mask, [rows, 1, maxT, 1])
-        keep_mask = layers.reshape(
-            layers.elementwise_sub(
-                layers.fill_constant([rows, maxT], "float32", 1.0),
-                t_mask),
-            [rows, 1, maxT, 1])
-        caches = [(sv[f"{state_prefix}self_k{li}"],
-                   sv[f"{state_prefix}self_v{li}"])
-                  for li in range(n_layers)]
-        cross_kv = [(sv[f"{state_prefix}cross_k{li}"],
-                     sv[f"{state_prefix}cross_v{li}"])
-                    for li in range(n_layers)]
-        x = _cached_decoder_step(x, caches, cross_kv, write_mask,
-                                 keep_mask, att_bias, d_model,
-                                 n_heads, d_inner)
-        step_logits = layers.fc(
-            layers.reshape(x, [0, d_model]), vocab,
-            bias_attr=False, param_attr="logits.w")        # [R,V]
-        # --- per-lane emit (the _emit_token_step tail, vectorized over
-        # lane counters; same freeze/write semantics) ---
-        tok = layers.cast(layers.argmax(step_logits, axis=-1),
-                          "int64")                         # [R]
-        ones_n = layers.fill_constant([rows], "int64", 1.0)
-        not_fin = layers.elementwise_sub(ones_n, fin)
-        tok = layers.elementwise_add(
-            layers.elementwise_mul(tok, not_fin),
-            layers.cast(layers.scale(fin, scale=float(end_id)),
-                        "int64"))
-        new_fin = layers.elementwise_max(
-            fin, layers.cast(layers.equal(
-                tok, layers.fill_constant([1], "int64",
-                                          float(end_id))), "int64"))
-        next2 = layers.reshape(
-            layers.elementwise_add(stepv, ones_n), [rows, 1])
-        next_mask = layers.cast(layers.equal(positions, next2),
-                                "int64")                   # [R,maxT]
-        keep_tok = layers.elementwise_sub(
-            layers.fill_constant([rows, maxT], "int64", 1.0),
-            next_mask)
-        new_step = layers.elementwise_add(stepv, act)  # gate by lane
-        layers.assign(layers.elementwise_add(
-            layers.elementwise_mul(tok_buf, keep_tok),
-            layers.elementwise_mul(next_mask,
-                                   layers.reshape(tok, [rows, 1]))),
-            output=tok_buf)
-        layers.assign(new_step, output=stepv)
-        # lanes auto-deactivate on EOS or buffer exhaustion — the
-        # host retires a lane the moment its active flag drops
-        room = layers.cast(layers.less_than(
-            new_step, layers.fill_constant([1], "int64",
-                                           float(maxT - 1))),
-            "int64")                                       # [N]
-        new_act = layers.elementwise_mul(
-            layers.elementwise_mul(
-                act, layers.elementwise_sub(ones_n, new_fin)),
-            room)
-        layers.assign(new_act, output=act)
-        layers.assign(new_fin, output=fin)
-
-    # --- standalone single-step program (one tick = one dispatch;
-    # also the Executor.prepare(steps=K) scan target) ----------------
-    step_prog = fluid.Program()
-    with fluid.program_guard(step_prog, fluid.Program()):
-        _step_body(_declare_slot_state(step_prog.global_block, specs))
-
-    # --- fused serve programs: [bucketed admission +] a decode-burst
-    # While — a WHOLE scheduler cycle (admit + burst) is ONE dispatch,
-    # so the host overhead amortizes over A admissions and a burst of
-    # tokens per lane. The loop exits when EITHER n_steps ticks ran
-    # OR the live-lane count drops to min_active (both fed): with a
-    # non-empty host queue the server sets min_active = live - 1, so
-    # control returns the MOMENT a lane retires and its slot refills
-    # — iteration-level scheduling with zero zombie ticks — while an
-    # empty queue sets min_active = 0 and the burst drains the pool.
-    # One specialization per admission bucket (A=0: no admission). ---
-    def _build_serve(A):
-        prog = fluid.Program()
-        with fluid.program_guard(prog, fluid.Program()):
-            sv = _declare_slot_state(prog.global_block, specs)
-            if A > 0:
-                _admit_body(sv, A)
-            n_steps = layers.data("n_steps", shape=[1], dtype="int64",
-                                  append_batch_size=False)
-            min_active = layers.data("min_active", shape=[1],
-                                     dtype="int64",
-                                     append_batch_size=False)
-            act = sv[f"{state_prefix}active"]
-            k = layers.fill_constant([1], "int64", 0)
-
-            def _serve_cond(cond=None):
-                # ticks remain AND live lanes exceed the exit
-                # threshold: min(a, b) > 0
-                return layers.greater_than(
-                    layers.elementwise_min(
-                        layers.elementwise_sub(n_steps, k),
-                        layers.elementwise_sub(
-                            layers.reduce_sum(act, keep_dim=True),
-                            min_active)),
-                    layers.fill_constant([1], "int64", 0.0),
-                    cond=cond)
-
-            cond = _serve_cond()
-            w = layers.While(cond)
-            with w.block():
-                _step_body(sv)
-                layers.increment(k, 1)
-                _serve_cond(cond=cond)
-        return prog
-
-    serves = {0: _build_serve(0)}
-    for A in admit_buckets:
-        serves[A] = _build_serve(A)
-
-    state = {"tok_buf": f"{state_prefix}tok_buf",
-             "step": f"{state_prefix}step",
-             "finished": f"{state_prefix}finished",
-             "active": f"{state_prefix}active"}
-    bundle = DecodeStepBundle(prefills, step_prog, serves, startup,
-                              state, n_slots, seq_len, maxT, start_id,
-                              end_id)
-    bundle._state_specs = {
-        n: (shape, dt) for n, (shape, dt) in specs.items()}
-    return bundle
-
-
 def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
                               n_heads=4, n_layers=2, d_inner=128,
                               vocab=1000, start_id=0, end_id=1,
@@ -1102,8 +344,8 @@ def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
                 dec = decoder_layer(dec, enc, d_model, n_heads,
                                     d_inner, 0.0, is_test=True,
                                     name=f"dec{li}")
-            step_logits = _step_logits(dec, positions, counter,
-                                       vocab)  # [rows, V]
+            step_logits = decode_engine.step_logits(
+                dec, positions, counter, vocab)  # [rows, V]
             probs = layers.softmax(step_logits)  # [rows, V]
             topk_scores, topk_ids = layers.topk(
                 probs, min(2 * beam_size, vocab))

@@ -1,0 +1,70 @@
+"""Paged-KV pool ops (models/decode_engine.py paged layout).
+
+Reference counterpart: none — the reference framework's decode caches
+are per-request dense tensors (reference
+tests/unittests/dist_transformer.py:1498 fast_decode caches). The
+shared block pool follows vLLM's PagedAttention block tables
+(SOSP'23, PAPERS.md), re-designed for XLA static shapes: the pool is
+one persistable tensor, lanes address it through host-allocated
+int32 tables, reads are plain `gather` composition, and ALL writes
+funnel through the single op below so the lane-exclusivity contract
+is one auditable surface (analysis checker PTA110).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("masked_pool_write", differentiable=False,
+             stop_gradient_slots=("Pool", "New", "Index", "Gate"))
+def masked_pool_write(ctx):
+    """Disjoint one-hot masked scatter into a SHARED KV pool.
+
+    inputs: Pool [N0(, N1), ...tail] (the pool var — also the op's
+    output, an in-place read-modify-write so the var rides the
+    executor's state_in path); New [R, ...tail]; Index [R] int
+    (flattened leading index of each row's target cell); Gate [R]
+    optional 0/1 (rows with gate 0 — idle/dustbin/paused lanes —
+    write nothing). attrs: leading_dims (how many leading Pool axes
+    the Index addresses, flattened), exclusive_via (the builder's
+    declaration of WHY row indices cannot alias: "block_table" =
+    per-lane blocks from a host free-list, "host_indices" =
+    host-deduplicated admission targets — checker PTA110 requires
+    it).
+
+    Out-of-range and gated-off rows write nothing (they scatter into
+    a trash row that is sliced away), and cells hit by a gated row
+    take EXACTLY the new value. The lowering is an indexed row
+    scatter — O(R x cell) instead of the O(n_cells x R x cell)
+    one-hot matmul, which MEASURED as ~3x the cost of the attention
+    itself per decode tick at small head dims; the semantics are the
+    disjoint-one-hot-mask semantics PTA110 assumes (under the
+    exclusivity contract the two lowerings are identical — aliased
+    gated rows are the corruption class the host allocator + PTA110
+    exclude, not something either lowering can repair).
+    """
+    pool = ctx.input("Pool")
+    new = ctx.input("New")
+    idx = ctx.input("Index")
+    gate = ctx.input("Gate")
+    lead = int(ctx.attr("leading_dims", 1))
+    n = 1
+    for d in pool.shape[:lead]:
+        n *= int(d)
+    pool_flat = pool.reshape(n, -1)
+    rows = new.shape[0]
+    new_flat = new.reshape(rows, -1).astype(pool_flat.dtype)
+    idx = idx.reshape(rows).astype(jnp.int32)
+    keep = (idx >= 0) & (idx < n)
+    if gate is not None:
+        keep = keep & (gate.reshape(rows) > 0)
+    safe = jnp.where(keep, idx, n)  # n = the trash row below
+    padded = jnp.concatenate(
+        [pool_flat, jnp.zeros((1,) + pool_flat.shape[1:],
+                              pool_flat.dtype)], axis=0)
+    out = padded.at[safe].set(new_flat,
+                              unique_indices=False,
+                              indices_are_sorted=False)[:n]
+    return out.reshape(pool.shape)

@@ -3,6 +3,8 @@ checker (paddle_tpu/analysis). Reference counterpart of the validation
 the C++ side does in op_desc.cc/operator.cc — here the failure classes
 come from CLAUDE.md session learnings, so each test doubles as a
 regression pin for a real incident."""
+import re
+
 import numpy as np
 import pytest
 
@@ -781,7 +783,8 @@ class TestSuitePlumbing:
         codes = [c.code for c in analysis.registered_checkers()]
         assert len(codes) >= 8
         assert codes == sorted(codes)
-        assert all(c.startswith("PTA0") for c in codes)
+        # PTA0xx ran out at PTA100/PTA110: the stable prefix is PTA
+        assert all(re.fullmatch(r"PTA\d{3}", c) for c in codes)
 
     def test_diagnostics_sorted_error_first(self):
         main = _collective_in_cond_program()
@@ -805,3 +808,100 @@ class TestSuitePlumbing:
         df = analysis.analyze_block(main.global_block)
         assert df.first_write[h.name] == 0
         assert df.readers[h.name] == [1]
+
+
+# ---------------------------------------------------------------------------
+# PTA110 shared-pool write exclusivity (paged KV block pools)
+# ---------------------------------------------------------------------------
+class TestSharedPoolWrites:
+    """PTA110: writes into @POOL-marked shared block pools must go
+    through masked_pool_write with the lane-exclusivity contract —
+    anything else is the silent cross-request KV corruption class
+    (models/decode_engine.py paged layout)."""
+
+    def _pool_prog(self):
+        main, startup, g = _guarded()
+        with g:
+            pool = main.global_block.create_var(
+                name="@p/self_k0@POOL", shape=(4, 2, 2, 8),
+                dtype="float32", persistable=True,
+                stop_gradient=True)
+            new = layers.data("new", shape=[3, 2, 8],
+                              dtype="float32",
+                              append_batch_size=False)
+            idx = layers.data("idx", shape=[3], dtype="int32",
+                              append_batch_size=False)
+            gate = layers.data("gate", shape=[3], dtype="float32",
+                               append_batch_size=False)
+        # program_guard CMs are single-use: hand back a fresh one
+        return main, pool, new, idx, gate, fluid.program_guard(main)
+
+    def test_raw_assign_write_is_error(self):
+        main, pool, new, idx, gate, g = self._pool_prog()
+        with g:
+            zeros = layers.fill_constant([4, 2, 2, 8], "float32", 0.0)
+            layers.assign(zeros, output=pool)
+        ds = _diags(main, "PTA110")
+        assert ds and ds[0].severity == ERROR
+        assert "@POOL" in ds[0].var
+
+    def test_missing_exclusive_via_is_error(self):
+        main, pool, new, idx, gate, g = self._pool_prog()
+        with g:
+            # bypass the layer wrapper (which refuses at build time)
+            # to pin the checker's own sweep
+            main.global_block.append_op(
+                "masked_pool_write",
+                {"Pool": [pool.name], "New": [new.name],
+                 "Index": [idx.name], "Gate": [gate.name]},
+                {"Out": [pool.name]}, {"leading_dims": 2})
+        ds = _diags(main, "PTA110")
+        assert ds and ds[0].severity == ERROR
+        assert "exclusive_via" in ds[0].message
+
+    def test_ungated_block_table_write_is_error(self):
+        main, pool, new, idx, gate, g = self._pool_prog()
+        with g:
+            main.global_block.append_op(
+                "masked_pool_write",
+                {"Pool": [pool.name], "New": [new.name],
+                 "Index": [idx.name]},
+                {"Out": [pool.name]},
+                {"leading_dims": 2, "exclusive_via": "block_table"})
+        ds = _diags(main, "PTA110")
+        assert ds and ds[0].severity == ERROR
+        assert "Gate" in ds[0].message
+
+    def test_blessed_write_is_clean(self):
+        main, pool, new, idx, gate, g = self._pool_prog()
+        with g:
+            layers.masked_pool_write(pool, new, idx, gate=gate,
+                                     leading_dims=2,
+                                     exclusive_via="block_table")
+        assert not _diags(main, "PTA110")
+
+    def test_layer_wrapper_refuses_bad_contracts(self):
+        main, pool, new, idx, gate, g = self._pool_prog()
+        with g:
+            with pytest.raises(ValueError, match="exclusive_via"):
+                layers.masked_pool_write(pool, new, idx, gate=gate)
+            with pytest.raises(ValueError, match="gate"):
+                layers.masked_pool_write(
+                    pool, new, idx, exclusive_via="block_table")
+
+    def test_paged_bundle_programs_are_clean(self):
+        """The shipped paged decode programs pass the sweep (also
+        pinned by the strict lint zoo, analysis/targets.py)."""
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.models.decode_engine import CacheConfig
+
+        bundle = T.build_decode_step_program(
+            seq_len=8, max_out_len=8, d_model=32, n_heads=2,
+            n_layers=1, d_inner=64, vocab=50, n_slots=2,
+            state_prefix="@pta110/",
+            cache=CacheConfig(layout="paged", block_size=4,
+                              n_blocks=4, n_prompt_entries=2))
+        for key in (0, ("miss", 2), ("hit", 2)):
+            assert not _diags(bundle.serves[key], "PTA110"), key
+        assert not _diags(bundle.step, "PTA110")
+        assert not _diags(bundle.prefill, "PTA110")

@@ -1,0 +1,632 @@
+"""Paged KV cache + prefix reuse (models/decode_engine.py paged
+layout + inference/serving.py PagedContinuousGenerationServer).
+
+The invariants the paged design must hold:
+
+* token-exact greedy parity with the dense whole-loop decode — through
+  slot reuse, admission-order permutations, burst lengths, and across
+  the hit/miss admission flavors (a prefix-HIT generation must be
+  byte-identical to the cold one);
+* the capacity claim is REAL: persistable KV bytes per admitted
+  request are >= 2x lower paged vs dense at mixed lengths, and the XLA
+  compiler's own ``memory_analysis()`` argument accounting agrees;
+* zero steady-state compiles under a 100-request churn;
+* block exhaustion fails with the NAMED retryable ``BlockPoolExhausted``
+  — never a hang — and the server keeps serving afterwards;
+* ``server_fingerprint`` separates KV layouts (paged vs dense, and
+  differing block-pool geometry) so the runtime never dedupes/swaps
+  them as "the same model";
+* the block-pool observability surface (gauges + prefix-tier admission
+  spans) exists and counts.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import (BlockPoolExhausted,
+                                  ContinuousGenerationServer,
+                                  PagedContinuousGenerationServer,
+                                  apply_eos_sentinel,
+                                  count_generated_tokens)
+from paddle_tpu.models.decode_engine import (CacheConfig,
+                                             HostBlockPool,
+                                             PromptPrefixCache)
+
+V, D, H, L, S, MAXT = 16, 32, 2, 1, 10, 32
+# serving-bundle paged geometry (NP = 4 pages/lane): NB = n_slots *
+# NP makes exhaustion IMPOSSIBLE, so parity/churn tests never see
+# victims — the capacity arithmetic is pinned on the TIGHT bundle
+# below, exhaustion on its own 1-block bundle
+BS, NB, E = 8, 16, 3
+END_ID = 1
+N_SLOTS = 4
+
+
+def _mixed_len_prompts(rng, n):
+    """Terminator-copy prompts: random tokens with end_id planted at a
+    random position — the trained copy model emits EOS there, so
+    generations have MIXED lengths (short ones fit one block, the
+    no-terminator tail runs to the buffer)."""
+    src = rng.randint(3, V, (n, S)).astype(np.int64)
+    for r in range(n):
+        p = rng.randint(1, S + 1)
+        if p < S:
+            src[r, p:] = END_ID
+    return src
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """Train the tiny terminator-copy transformer once; build the
+    whole-loop oracle + dense AND paged bundles over the same
+    scope-shared weights."""
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.models import transformer as T
+
+    # param-init ops (uniform/gaussian_random) ride the GLOBAL seed,
+    # which other suite tests mutate — pin it or the trained model
+    # (and the oracle generation lengths the preconditions below rely
+    # on) depends on which tests ran first
+    fluid.seed(0)
+    scope = Scope()
+    with unique_name.guard():
+        main, startup, loss = T.build_program(
+            seq_len=S, d_model=D, n_heads=H, n_layers=L, d_inner=64,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+        with fluid.program_guard(main, startup):
+            fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(7)
+    for _ in range(200):
+        src = _mixed_len_prompts(rng, 8)
+        tgt_in = np.concatenate(
+            [np.full((8, 1), 2, np.int64), src[:, :-1]], 1)
+        exe.run(main, feed={"src_ids": src, "tgt_ids": tgt_in,
+                            "label": src}, fetch_list=[loss],
+                scope=scope)
+    kwargs = dict(seq_len=S, max_out_len=MAXT, d_model=D, n_heads=H,
+                  n_layers=L, d_inner=64, vocab=V, start_id=2,
+                  end_id=END_ID)
+    with unique_name.guard():
+        inc_m, _, _, inc_buf = T.build_incremental_decode_program(
+            **kwargs)
+    with unique_name.guard():
+        dense = T.build_decode_step_program(n_slots=N_SLOTS, **kwargs)
+    with unique_name.guard():
+        paged = T.build_decode_step_program(
+            n_slots=N_SLOTS, state_prefix="@pg/",
+            cache=CacheConfig(layout="paged", block_size=BS,
+                              n_blocks=NB, n_prompt_entries=E),
+            **kwargs)
+    # the capacity-claim bundle: 2x the lanes of the dense pool in
+    # FEWER KV bytes (blocks oversubscribed vs worst case — the
+    # scheduler's pausing/backpressure absorbs the tail)
+    with unique_name.guard():
+        paged_tight = T.build_decode_step_program(
+            n_slots=2 * N_SLOTS, state_prefix="@pgt/",
+            cache=CacheConfig(layout="paged", block_size=BS,
+                              n_blocks=10, n_prompt_entries=E),
+            **kwargs)
+    return {"exe": exe, "scope": scope, "inc_m": inc_m,
+            "inc_buf": inc_buf, "dense": dense, "paged": paged,
+            "paged_tight": paged_tight, "kwargs": kwargs}
+
+
+def _oracle(tr, srcs):
+    ref, = tr["exe"].run(tr["inc_m"], feed={"src_ids": srcs},
+                         fetch_list=[tr["inc_buf"]],
+                         scope=tr["scope"])
+    return apply_eos_sentinel(np.asarray(ref), end_id=END_ID)
+
+
+def _paged_server(tr, **kw):
+    return PagedContinuousGenerationServer(
+        tr["paged"], executor=tr["exe"], scope=tr["scope"], **kw)
+
+
+def _pick_long_prompts(tr, rng, n, min_tokens):
+    """`n` no-terminator prompts whose ORACLE generations exceed
+    `min_tokens` — selected by decode, not assumed, so the block-
+    pressure scenarios stay valid under small model-init shifts."""
+    cands = rng.randint(3, V, (24, S)).astype(np.int64)
+    lens = count_generated_tokens(_oracle(tr, cands), END_ID)
+    order = np.argsort(-lens)
+    picked = cands[order[:n]]
+    assert lens[order[n - 1]] > min_tokens, (
+        f"model generates too short for the pressure scenario "
+        f"(best lengths {sorted(lens)[-n:]})")
+    return picked
+
+
+class TestParity:
+    def test_token_exact_vs_whole_loop_with_slot_reuse(self, trained):
+        """12 mixed-length requests through 4 slots (3x reuse, block
+        churn): every row must equal the whole-loop decode row, -1
+        sentinel tails included."""
+        srcs = _mixed_len_prompts(np.random.RandomState(11), 12)
+        want = _oracle(trained, srcs)
+        assert len(set((w != -1).sum() for w in want)) > 1, \
+            "workload must have mixed output lengths"
+        with _paged_server(trained) as srv:
+            replies = [srv.submit(s) for s in srcs]
+            got = np.stack([r.result(timeout=120.0) for r in replies])
+            st = srv.stats()
+        np.testing.assert_array_equal(got, want)
+        assert st["completed"] == 12
+        # retirement returned every block/entry to the pools
+        bp = st["block_pool"]
+        assert bp["blocks_in_use"] == 0
+        assert bp["prompt_entries_in_use"] == 0
+
+    def test_independent_of_admission_order(self, trained):
+        srcs = _mixed_len_prompts(np.random.RandomState(13), 8)
+        want = _oracle(trained, srcs)
+        with _paged_server(trained) as srv:
+            order = list(range(8))[::-1]
+            replies = {i: srv.submit(srcs[i]) for i in order}
+            got = np.stack([replies[i].result(timeout=120.0)
+                            for i in range(8)])
+        np.testing.assert_array_equal(got, want)
+
+    def test_burst_length_does_not_move_tokens(self, trained):
+        """steps_per_tick=1 vs the default burst vs exit-on-retire:
+        dispatch boundaries move, tokens must not."""
+        srcs = _mixed_len_prompts(np.random.RandomState(17), 6)
+        want = _oracle(trained, srcs)
+        for kw in (dict(steps_per_tick=1, drain_steps=1),
+                   dict(steps_per_tick=6),
+                   dict(exit_on_retire=True)):
+            with _paged_server(trained, **kw) as srv:
+                replies = [srv.submit(s) for s in srcs]
+                got = np.stack([r.result(timeout=120.0)
+                                for r in replies])
+            np.testing.assert_array_equal(got, want, err_msg=str(kw))
+
+    def _sync_drive(self, srv, srcs):
+        """Drive the paged scheduler SINGLE-THREADED (plan -> fail ->
+        cycle), so pause/preempt dynamics are deterministic instead
+        of depending on submission/scheduler thread interleaving."""
+        from paddle_tpu.inference import serving as SV
+
+        replies = []
+        for s in srcs:
+            req = SV._GenRequest(np.asarray(s)[None].astype(np.int64),
+                                 SV._Reply())
+            srv._queue.append(req)
+            replies.append(req.reply)
+        guard = 0
+        while srv._queue or any(l is not None for l in srv._lanes):
+            guard += 1
+            assert guard < 500, "scheduler failed to converge"
+            failures = []
+            with srv._cv:
+                admits = srv._plan_admissions_locked(failures)
+                drain = not srv._queue
+                n, m, run = srv._plan_burst_locked(admits, drain,
+                                                   failures)
+            srv._fail_requests(failures)
+            if run:
+                srv._cycle(admits, n, m)
+        return replies
+
+    def test_parity_under_block_pressure_with_pausing(self, trained):
+        """A pool too small for the concurrent mix forces the
+        scheduler to PAUSE lanes at block boundaries (host-masked
+        active flag; no shared-pool writes while parked) and resume
+        them as retirements free blocks — tokens must stay exact
+        through park/resume cycles (regression: an un-gated EOS latch
+        froze paused lanes on garbage tokens; 7/192 wrong tokens)."""
+        from paddle_tpu import unique_name
+        from paddle_tpu.models import transformer as T
+
+        with unique_name.guard():
+            tight = T.build_decode_step_program(
+                n_slots=6, state_prefix="@press/",
+                cache=CacheConfig(layout="paged", block_size=BS,
+                                  n_blocks=8, n_prompt_entries=4),
+                **trained["kwargs"])
+        rng = np.random.RandomState(43)
+        longs = _pick_long_prompts(trained, rng, 2, 3 * BS)
+        shorts = rng.randint(3, V, (10, S)).astype(np.int64)
+        shorts[:, 3:] = END_ID  # every short fits one block
+        srcs = np.concatenate([longs, shorts])
+        want = _oracle(trained, srcs)
+        assert all((w != -1).sum() > 3 * BS for w in want[:2]), \
+            "precondition: the long rows must span all 4 pages"
+        srv = PagedContinuousGenerationServer(
+            tight, executor=trained["exe"], scope=trained["scope"],
+            start=False)
+        try:
+            replies = self._sync_drive(srv, srcs)
+            got = np.stack([r.result(0) for r in replies])
+            ps = srv.pool_stats()
+        finally:
+            srv.close()
+        np.testing.assert_array_equal(got, want)
+        assert ps["pause_events"] > 0, \
+            "the pressure geometry must actually have paused a lane"
+        assert ps["paused_lanes"] == 0  # everyone resumed + retired
+        assert ps["blocks_in_use"] == 0
+
+    def test_parity_under_lockstep_preemption(self, trained):
+        """Lockstep full-length generations cross block boundaries
+        simultaneously; when every live lane blocks on an empty free
+        list the scheduler recompute-PREEMPTS the youngest (requeue,
+        not failure), and the admission watermark keeps preempted
+        work from stealing its own blocks back. Greedy decode is
+        deterministic, so preempted requests re-decode
+        byte-identically — parity and completion must survive."""
+        from paddle_tpu import unique_name
+        from paddle_tpu.models import transformer as T
+
+        with unique_name.guard():
+            tight = T.build_decode_step_program(
+                n_slots=4, state_prefix="@lock/",
+                cache=CacheConfig(layout="paged", block_size=BS,
+                                  n_blocks=4, n_prompt_entries=4),
+                **trained["kwargs"])
+        rng = np.random.RandomState(47)
+        longs = _pick_long_prompts(trained, rng, 4, BS)
+        want = _oracle(trained, longs)
+        assert all((w != -1).sum() > BS for w in want), \
+            "precondition: every row must cross a block boundary"
+        srv = PagedContinuousGenerationServer(
+            tight, executor=trained["exe"], scope=trained["scope"],
+            start=False)
+        try:
+            replies = self._sync_drive(srv, longs)
+            got = np.stack([r.result(0) for r in replies])
+            ps = srv.pool_stats()
+            st = srv.stats()
+        finally:
+            srv.close()
+        np.testing.assert_array_equal(got, want)
+        assert st["completed"] == 4
+        assert ps["preemptions"] > 0, \
+            "lockstep full-buffer rows on a tiny pool must preempt"
+
+    def test_prefix_hit_generation_byte_identical_to_cold(self,
+                                                          trained):
+        """The same prompt served cold (miss: encoder prefill) and
+        again as a prefix HIT (encoder-free admission reusing the
+        pooled cross-KV entry) must produce byte-identical rows —
+        and the hit must actually have taken the hit path."""
+        src = _mixed_len_prompts(np.random.RandomState(19), 1)[0]
+        want = _oracle(trained, src[None])[0]
+        with _paged_server(trained) as srv:
+            cold = srv.submit(src).result(timeout=120.0)
+            h0 = srv.pool_stats()["prefix_hits"]
+            hot = srv.submit(src).result(timeout=120.0)
+            ps = srv.pool_stats()
+        np.testing.assert_array_equal(cold, want)
+        np.testing.assert_array_equal(hot, want)
+        assert ps["prefix_hits"] == h0 + 1
+        assert ps["prefix_misses"] >= 1
+
+    def test_partial_prefix_is_cow_not_reuse(self, trained):
+        """A prompt sharing only a leading block with a cached one is
+        the 'partial' tier: re-prefilled (bidirectional encoder — only
+        full-content matches may share) and counted as a COW copy;
+        tokens still exact."""
+        rng = np.random.RandomState(23)
+        a = rng.randint(3, V, (S,)).astype(np.int64)
+        b = a.copy()
+        b[BS:] = (b[BS:] % (V - 4)) + 3  # same first block, new tail
+        want = _oracle(trained, np.stack([a, b]))
+        with _paged_server(trained) as srv:
+            got_a = srv.submit(a).result(timeout=120.0)
+            got_b = srv.submit(b).result(timeout=120.0)
+            ps = srv.pool_stats()
+        np.testing.assert_array_equal(np.stack([got_a, got_b]), want)
+        assert ps["cow_copies"] >= 1
+
+
+class TestMemory:
+    def _kv_per_request(self, bundle):
+        return bundle.kv_state_bytes() / bundle.n_slots
+
+    def test_paged_kv_bytes_per_request_at_least_2x_lower(self,
+                                                          trained):
+        """The capacity lever: the paged pool serves 2x the lanes of
+        the dense bundle in FEWER total KV bytes, so KV bytes per
+        admitted request drop >= 2x (same claim the bench makes at
+        the r10 serving geometry)."""
+        assert trained["paged_tight"].kv_state_bytes() \
+            <= trained["dense"].kv_state_bytes()
+        dense = self._kv_per_request(trained["dense"])
+        paged = self._kv_per_request(trained["paged_tight"])
+        assert paged * 2 <= dense, (paged, dense)
+
+    def test_memory_analysis_agrees(self, trained):
+        """The XLA compiler's own argument accounting must show the
+        KV saving (r5 learning: memory_analysis is valid on the CPU
+        backend for schedule/state-level comparisons) — the
+        spec-derived byte claim above is not just arithmetic."""
+        import jax
+
+        from paddle_tpu.core.executor import RNG_VAR
+
+        exe, scope = trained["exe"], trained["scope"]
+
+        def arg_bytes(bundle):
+            srv = ContinuousGenerationServer if \
+                bundle.cache.layout == "dense" \
+                else PagedContinuousGenerationServer
+            s = srv(bundle, executor=exe, scope=scope, start=False)
+            try:
+                c = s._serves[0]._compiled
+                mut = exe._scope_state(scope, c.state_in, None)
+                const = exe._scope_state(scope, c.const_in, None)
+                rng = scope._get(RNG_VAR)
+                if rng is None:
+                    rng = jax.random.PRNGKey(0)
+                feed = {"n_steps": np.array([1], np.int64),
+                        "min_active": np.array([0], np.int64)}
+                m = c.fn.lower(mut, const, feed,
+                               rng).compile().memory_analysis()
+                return int(m.argument_size_in_bytes)
+            finally:
+                s.close()
+
+        dense_b = arg_bytes(trained["dense"])
+        paged_b = arg_bytes(trained["paged_tight"])
+        predicted = trained["dense"].kv_state_bytes() \
+            - trained["paged_tight"].kv_state_bytes()
+        assert predicted > 0
+        measured = dense_b - paged_b
+        # params are identical across layouts, so the argument delta
+        # tracks the KV-state delta (slack: the tight bundle carries
+        # 2x the token/flag rows, and int64 state canonicalizes to
+        # int32 on device)
+        assert measured >= 0.7 * predicted, (measured, predicted)
+
+
+class TestChurnAndCompiles:
+    def test_100_request_churn_zero_steady_state_compiles(self,
+                                                          trained):
+        exe = trained["exe"]
+        srv = _paged_server(trained)
+        try:
+            warmed = exe.compile_count
+            srcs = _mixed_len_prompts(np.random.RandomState(29), 100)
+            replies = [srv.submit(s) for s in srcs]
+            got = [r.result(timeout=300.0) for r in replies]
+            st = srv.stats()
+        finally:
+            srv.close()
+        assert len(got) == 100
+        assert exe.compile_count == warmed, (
+            f"steady-state traffic compiled "
+            f"{exe.compile_count - warmed} fresh executable(s)")
+        assert st["completed"] == 100
+        bp = st["block_pool"]
+        assert bp["blocks_in_use"] == 0
+        assert bp["prefix_hits"] + bp["prefix_misses"] \
+            + bp["cow_copies"] == 100
+
+
+class TestExhaustion:
+    def test_block_exhaustion_named_retryable_error_not_hang(
+            self, trained):
+        """A 1-block pool cannot hold a full-buffer generation: the
+        request must FAIL with the named retryable BlockPoolExhausted
+        (not hang), and the server must keep serving block-sized
+        requests afterwards."""
+        from paddle_tpu import unique_name
+        from paddle_tpu.models import transformer as T
+
+        with unique_name.guard():
+            tiny = T.build_decode_step_program(
+                n_slots=2, state_prefix="@tiny/",
+                cache=CacheConfig(layout="paged", block_size=BS,
+                                  n_blocks=1, n_prompt_entries=2),
+                **trained["kwargs"])
+        rng = np.random.RandomState(31)
+        long_src = _pick_long_prompts(trained, rng, 1, BS)[0]
+        want_long = _oracle(trained, long_src[None])[0]
+        assert (want_long != -1).sum() > BS, \
+            "precondition: the no-terminator prompt must decode past " \
+            "one block"
+        short_src = long_src.copy()
+        short_src[2:] = END_ID  # copies the terminator early
+        want_short = _oracle(trained, short_src[None])[0]
+        assert (want_short != -1).sum() <= BS, \
+            "precondition: the short prompt must fit one block"
+        srv = PagedContinuousGenerationServer(
+            tiny, executor=trained["exe"], scope=trained["scope"])
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(BlockPoolExhausted) as ei:
+                srv.submit(long_src).result(timeout=60.0)
+            assert time.monotonic() - t0 < 60.0  # failed, not hung
+            assert ei.value.retryable is True
+            got = srv.submit(short_src).result(timeout=60.0)
+        finally:
+            srv.close()
+        np.testing.assert_array_equal(got, want_short)
+
+
+class TestFingerprints:
+    def test_kv_layout_separates_server_fingerprints(self, trained):
+        """Two servers differing only in KV layout (or block-pool
+        geometry) must not dedupe/hot-swap as the same fingerprint
+        (inference/runtime/registry.py)."""
+        from paddle_tpu import unique_name
+        from paddle_tpu.inference.runtime.registry import \
+            server_fingerprint
+        from paddle_tpu.models import transformer as T
+
+        exe, scope = trained["exe"], trained["scope"]
+        fp_dense = server_fingerprint(ContinuousGenerationServer(
+            trained["dense"], executor=exe, scope=scope, start=False))
+        fp_paged = server_fingerprint(PagedContinuousGenerationServer(
+            trained["paged"], executor=exe, scope=scope, start=False))
+        assert fp_dense != fp_paged
+        # geometry matters too: same layout, different block_size
+        with unique_name.guard():
+            other = T.build_decode_step_program(
+                n_slots=N_SLOTS, state_prefix="@pg2/",
+                cache=CacheConfig(layout="paged", block_size=BS // 2,
+                                  n_blocks=NB, n_prompt_entries=E),
+                **trained["kwargs"])
+        fp_other = server_fingerprint(PagedContinuousGenerationServer(
+            other, executor=exe, scope=scope, start=False))
+        assert fp_other != fp_paged
+
+    def test_compile_cache_keys_differ_per_layout(self, trained):
+        """Program.fingerprint (the disk compile-cache key component)
+        must already separate the serve executables — pool var shapes
+        and ops are hashed."""
+        d = trained["dense"].serves[0].fingerprint()
+        p = trained["paged"].serves[0].fingerprint()
+        assert d != p
+
+
+class TestObservability:
+    def test_blockpool_gauges_and_admission_tier_spans(self, trained):
+        """Block-pool gauges ride the uniquely-labeled pull provider;
+        at FLAGS_observability=trace the admission span carries the
+        prefix tier so the flight recorder explains slow (miss:
+        encoder prefill) vs fast (hit) admissions."""
+        from paddle_tpu import observability as obs
+        from paddle_tpu.flags import FLAGS, set_flags
+
+        src = _mixed_len_prompts(np.random.RandomState(37), 1)[0]
+        prev = FLAGS.observability
+        set_flags({"FLAGS_observability": "trace"})
+        try:
+            with _paged_server(trained) as srv:
+                srv.submit(src).result(timeout=120.0)
+                srv.submit(src).result(timeout=120.0)  # prefix hit
+                label = srv._obs_id
+                expo = obs.metrics.expose()
+            with obs.TRACER._lock:
+                traces = list(obs.TRACER.completed)
+        finally:
+            set_flags({"FLAGS_observability": prev})
+        assert f'paddle_tpu_blockpool_blocks_in_use{{server="' \
+               f'{label}"}}' in expo
+        assert "paddle_tpu_blockpool_prefix_hits_total" in expo
+        tiers = [sp["attrs"]["prefix"] for t in traces
+                 for sp in t.timeline()["spans"]
+                 if sp["name"] == "slotpool.queue"
+                 and "prefix" in sp.get("attrs", {})]
+        assert "miss" in tiers and "hit" in tiers, tiers
+
+
+class TestHostAllocators:
+    """The host half of the paging design is plain Python — pin it
+    directly (the device tests above exercise it end to end)."""
+
+    def test_block_pool_freelist(self):
+        pool = HostBlockPool(3)
+        got = [pool.alloc() for _ in range(3)]
+        assert sorted(got) == [0, 1, 2] and pool.alloc() is None
+        assert pool.in_use == 3
+        pool.free(got[:2])
+        assert pool.free_count == 2
+        with pytest.raises(ValueError):
+            pool.free([got[0]])  # double free
+
+    def test_prefix_cache_tiers_refcounts_eviction(self):
+        pc = PromptPrefixCache(2, chunk_tokens=2)
+        p1, p2, p3 = (1, 2, 3, 4), (1, 2, 9, 9), (5, 6, 7, 8)
+        assert pc.lookup(p1) == ("miss", None)
+        e1 = pc.acquire_fresh(p1)
+        assert pc.lookup(p1) == ("hit", e1)
+        assert pc.lookup(p2)[0] == "partial"  # shares chunk (1, 2)
+        e2 = pc.acquire_fresh(p2, partial=True)
+        assert pc.partials == 1 and pc.misses == 1
+        # both pinned: a third cold prompt cannot get an entry
+        assert pc.acquire_fresh(p3) is None
+        pc.release(e1)
+        e3 = pc.acquire_fresh(p3)  # evicts the unpinned p1 entry
+        assert e3 == e1 and pc.evictions == 1
+        # p1's entry is gone, but the still-cached p2 shares its
+        # leading chunk -> the correct post-eviction tier is partial
+        assert pc.lookup(p1) == ("partial", None)
+        assert pc.acquire_hit(p2) == e2 and pc.hits == 1
+        pc.release(e2)
+        pc.release(e2)  # acquired twice (fresh + hit): two releases
+        pc.release(e3)
+        # both unpinned; the hit moved p2 to MRU, so LRU-first is p3
+        pc.acquire_fresh((7, 7, 7, 7))  # evicts p3
+        # nothing cached shares p3's head (5, 6) -> true miss; the
+        # recently-used p2 survived the eviction
+        assert pc.lookup(p3) == ("miss", None)
+        assert pc.lookup(p2) == ("hit", e2)
+
+
+class TestMaskedPoolWriteOp:
+    def test_numpy_oracle(self):
+        """Kernel semantics vs a numpy oracle: gated rows land, keep
+        mask preserves untouched cells, out-of-range indices drop,
+        gate-0 rows write nothing."""
+        from op_test import OpTest
+
+        rng = np.random.RandomState(0)
+        pool = rng.randn(3, 4, 2, 5).astype(np.float32)  # lead 2 -> 12
+        new = rng.randn(4, 2, 5).astype(np.float32)
+        idx = np.array([0, 7, 99, 3], np.int32)   # 99 out of range
+        gate = np.array([1.0, 1.0, 1.0, 0.0], np.float32)
+        want = pool.reshape(12, 10).copy()
+        for r in range(4):
+            if gate[r] and 0 <= idx[r] < 12:
+                want[idx[r]] = new[r].reshape(10)
+        want = want.reshape(3, 4, 2, 5)
+
+        class T(OpTest):
+            def runTest(self):
+                pass
+
+        t = T()
+        t.setUp()
+        t.op_type = "masked_pool_write"
+        t.inputs = {"Pool": pool, "New": new, "Index": idx,
+                    "Gate": gate}
+        t.attrs = {"leading_dims": 2,
+                   "exclusive_via": "block_table"}
+        t.outputs = {"Out": want}
+        t.check_output()
+
+
+class TestPagedAttentionKernel:
+    """Interpret-mode validation of the Pallas paged-attention stub
+    (ops/pallas/paged_attention.py) against its jnp oracle — the
+    kernel is NOT routed into the decode programs yet (CLAUDE.md: A/B
+    on the real chip first; the tunnel has been down since r2), but
+    its code path must stay correct for when the chip returns."""
+
+    def test_interpret_mode_matches_reference(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas import attention as base
+        from paddle_tpu.ops.pallas import paged_attention as pa
+
+        rng = np.random.RandomState(5)
+        R, Hh, Dh, NBk, BSk, NP = 5, 2, 64, 7, 8, 3
+        q = rng.randn(R, Hh, Dh).astype(np.float32)
+        pk = rng.randn(NBk, BSk, Hh, Dh).astype(np.float32)
+        pv = rng.randn(NBk, BSk, Hh, Dh).astype(np.float32)
+        # distinct blocks per lane (the allocator invariant)
+        tab = np.stack([rng.permutation(NBk)[:NP]
+                        for _ in range(R)]).astype(np.int32)
+        step = rng.randint(0, NP * BSk, (R,)).astype(np.int32)
+        assert pa.usable(jnp.asarray(q), jnp.asarray(pk), tab) \
+            is False  # CPU without interpret mode: gated off
+        base.force_interpret(True)
+        try:
+            assert pa.usable(jnp.asarray(q), jnp.asarray(pk), tab)
+            got = np.asarray(pa.paged_decode_attention(
+                jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+                jnp.asarray(tab), jnp.asarray(step), scale=0.125))
+        finally:
+            base.force_interpret(False)
+        want = np.asarray(pa.paged_decode_attention_reference(
+            jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+            jnp.asarray(tab), jnp.asarray(step), scale=0.125))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
