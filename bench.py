@@ -1266,6 +1266,261 @@ def bench_paged(n_requests=192):
                              stats_json_dict=pst)
 
 
+def bench_speculative(n_requests=96, spec_k=3):
+    """Speculative draft-and-verify decoding vs the plain decode
+    burst and the whole-loop server (models/decode_engine.py
+    DraftConfig; BENCH_SELF_r14.json).
+
+    Workload: the terminator-copy task where BOTH the d128/L2 target
+    and the d32/L1 draft learn near-deterministic copying, so the
+    draft's k proposals mostly match the target's greedy stream —
+    the high-acceptance regime speculative decoding amortizes: per
+    device tick, k tiny draft steps + ONE batched (k+1)-query target
+    step emit up to k+1 tokens where the plain burst's tick emits 1.
+    Greedy acceptance is TOKEN-EXACT vs the whole-loop decode, so
+    every measured leg asserts byte parity (a fast leg with wrong
+    tokens would be meaningless).
+
+    Three INTERLEAVED legs per triple (r10/r13 throttled-host
+    discipline), best PAIRED ratios asserted: speculative > 1x the
+    plain burst's tok/s, zero steady-state compiles. Draft-vs-target
+    step accounting (the real cost model: CPU time is ~linear in
+    FLOPs, so the win is k*draft_cost + verify_cost vs
+    tokens-per-tick — PERF.md "Speculative decoding" has the
+    arithmetic for this host and the real chip). CPU-PINNED like
+    bench_generation; fail-fast exit 3 inherited from main()."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.inference import (ContinuousGenerationServer,
+                                      GenerationServer,
+                                      apply_eos_sentinel,
+                                      count_generated_tokens)
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.models.decode_engine import DraftConfig
+
+    V, D, L, S, maxT = 16, 128, 2, 12, 64
+    DD, DL = 64, 1   # draft dims: ~8x fewer decode FLOPs/step — a
+    #                  d32 draft measured acceptance 0.69/accepted
+    #                  len 2.81, UNDER the 2.54 tick-cost threshold;
+    #                  d64 hits 0.89/3.42 and clears it
+    n_slots = 8
+    end_id = 1
+    rng = np.random.RandomState(7)
+
+    # FIXED prompt pool (the ISSUE's "repeated-suffix mix"): 8
+    # memorizable sequences with varied planted EOS. Random-content
+    # terminator-copy leaves both models' CONTENT tokens noisy
+    # (measured: loss plateaus ~1.7 and draft/target agreement sits
+    # at chance), which starves acceptance; a small pool is
+    # memorized by BOTH capacities, so the draft accepts — the
+    # production analogue is templated / repeated-system-prompt
+    # traffic, the same shape bench_paged's prefix cache exploits.
+    # EVERY row terminates within the trained S-token horizon: a
+    # no-EOS row would decode ~maxT-S positions PAST anything either
+    # model saw in training, where their extrapolations disagree
+    # chaotically — measured mean accepted length collapsed to ~1.75
+    # (< the 2.54 spec-vs-plain tick-cost ratio on this host) with
+    # 25% no-EOS traffic, vs ~3+ when generations stay on-horizon.
+    pool_rng = np.random.RandomState(5)
+    pool = []
+    for p in (4, 5, 6, 7, 8, 9, 10, 11):
+        row = pool_rng.randint(3, V, (S,)).astype(np.int64)
+        row[p:] = end_id
+        pool.append(row)
+    pool = np.stack(pool)
+
+    def term_prompts(n, r):
+        return pool[r.randint(0, len(pool), n)]
+
+    # train target AND draft on the same stream into ONE scope
+    # (disjoint names via the draft_ prefix; ONE unique_name guard so
+    # their auto-named optimizer moments cannot collide). Target per
+    # the CLAUDE.md size ladder (d128/L2 lr.002x600); the draft gets
+    # an lr DECAY (.01 x300 then .003 x300, two programs sharing the
+    # scope with separate moments — both startups run BEFORE any
+    # training): acceptance is the whole game, and the flat-lr draft
+    # plateaued ~0.1 loss above the target, costing ~0.2 of mean
+    # accepted length.
+    scope = Scope()
+    with unique_name.guard():
+        t_main, t_st, t_loss = T.build_program(
+            seq_len=S, d_model=D, n_heads=2, n_layers=L, d_inner=128,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+        with fluid.program_guard(t_main, t_st):
+            fluid.optimizer.Adam(learning_rate=0.002).minimize(
+                t_loss)
+        d_main, d_st, d_loss = T.build_program(
+            seq_len=S, d_model=DD, n_heads=2, n_layers=DL,
+            d_inner=128, vocab=V, with_optimizer=False,
+            dropout_rate=0.0, name_prefix="draft_")
+        with fluid.program_guard(d_main, d_st):
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(d_loss)
+        d_main2, d_st2, d_loss2 = T.build_program(
+            seq_len=S, d_model=DD, n_heads=2, n_layers=DL,
+            d_inner=128, vocab=V, with_optimizer=False,
+            dropout_rate=0.0, name_prefix="draft_")
+        with fluid.program_guard(d_main2, d_st2):
+            fluid.optimizer.Adam(learning_rate=0.003).minimize(
+                d_loss2)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(t_st, scope=scope)
+    exe.run(d_st, scope=scope)
+    exe.run(d_st2, scope=scope)  # fine-tune moments (re-inits draft
+    #                              params — runs BEFORE training)
+    for i in range(600):
+        src = term_prompts(8, rng)
+        tgt_in = np.concatenate(
+            [np.full((8, 1), 2, np.int64), src[:, :-1]], 1)
+        feed = {"src_ids": src, "tgt_ids": tgt_in, "label": src}
+        exe.run(t_main, feed=feed, fetch_list=[t_loss], scope=scope)
+        if i < 300:
+            exe.run(d_main, feed=feed, fetch_list=[d_loss],
+                    scope=scope)
+        else:
+            exe.run(d_main2, feed=feed, fetch_list=[d_loss2],
+                    scope=scope)
+
+    kwargs = dict(seq_len=S, max_out_len=maxT, d_model=D, n_heads=2,
+                  n_layers=L, d_inner=128, vocab=V, start_id=2,
+                  end_id=end_id)
+    with unique_name.guard():
+        inc_m, _, _, inc_buf = T.build_incremental_decode_program(
+            **kwargs)
+    with unique_name.guard():
+        plain = T.build_decode_step_program(n_slots=n_slots, **kwargs)
+    with unique_name.guard():
+        spec = T.build_decode_step_program(
+            n_slots=n_slots, state_prefix="@spec/",
+            draft=DraftConfig(d_model=DD, n_heads=2, n_layers=DL,
+                              d_inner=128, k=spec_k), **kwargs)
+
+    srcs = term_prompts(n_requests, np.random.RandomState(31))
+    ref, = exe.run(inc_m, feed={"src_ids": srcs},
+                   fetch_list=[inc_buf], scope=scope)
+    want = apply_eos_sentinel(np.asarray(ref), end_id)
+    lens = count_generated_tokens(want, end_id)
+    total_tokens = int(lens.sum())
+
+    def run_leg(make_server):
+        srv = make_server()
+        try:
+            t0 = time.perf_counter()
+            replies = [srv.submit(s) for s in srcs]
+            outs = [rep.result(600.0) for rep in replies]
+            wall = time.perf_counter() - t0
+            st = srv.stats()
+        finally:
+            srv.close()
+        assert all(np.array_equal(np.asarray(o), want[i])
+                   for i, o in enumerate(outs)), \
+            "token parity vs the whole-loop decode failed"
+        return {"wall_s": wall, "tok_s": total_tokens / wall,
+                "stats": st}
+
+    def whole_loop_leg():
+        srv = GenerationServer(
+            inc_m, inc_buf, executor=exe, scope=scope, end_id=end_id,
+            max_batch_size=n_slots, max_wait_ms=2.0)
+        try:
+            t0 = time.perf_counter()
+            replies = [srv.submit({"src_ids": s[None]}) for s in srcs]
+            outs = [apply_eos_sentinel(
+                np.asarray(rep.result(600.0)[0]), end_id)[0]
+                for rep in replies]
+            wall = time.perf_counter() - t0
+            st = srv.stats()
+        finally:
+            srv.close()
+        assert all(np.array_equal(o, want[i])
+                   for i, o in enumerate(outs)), \
+            "whole-loop leg parity failed"
+        return {"wall_s": wall, "tok_s": total_tokens / wall,
+                "stats": st}
+
+    def plain_leg():
+        return run_leg(lambda: ContinuousGenerationServer(
+            plain, executor=exe, scope=scope, steps_per_tick=8))
+
+    def spec_leg():
+        return run_leg(lambda: ContinuousGenerationServer(
+            spec, executor=exe, scope=scope, steps_per_tick=8))
+
+    whole_loop_leg()  # warm all three serve sets (all compiles here)
+    plain_leg()
+    spec_leg()
+    compiles_before = exe.compile_count
+    triples = [(whole_loop_leg(), plain_leg(), spec_leg())
+               for _ in range(3)]
+    steady_compiles = exe.compile_count - compiles_before
+    assert steady_compiles == 0, (
+        f"steady-state legs compiled {steady_compiles}")
+    wbest = min((w for w, _, _ in triples), key=lambda r: r["wall_s"])
+    pbest = min((p for _, p, _ in triples), key=lambda r: r["wall_s"])
+    sbest = min((s for _, _, s in triples), key=lambda r: r["wall_s"])
+    # asserted ratios are the best PAIRED ones (adjacent legs share
+    # this host's CPU-throttle windows — the r10 method)
+    speedup_vs_plain = max(s["tok_s"] / p["tok_s"]
+                           for _, p, s in triples)
+    speedup_vs_whole = max(s["tok_s"] / w["tok_s"]
+                           for w, _, s in triples)
+    triple_toks = [(round(w["tok_s"]), round(p["tok_s"]),
+                    round(s["tok_s"])) for w, p, s in triples]
+    sp = sbest["stats"]["speculative"]
+    assert speedup_vs_plain > 1.0, (
+        f"speculative tok/s only {speedup_vs_plain:.2f}x the plain "
+        f"decode burst on the high-acceptance workload (paired "
+        f"triples: {triple_toks}; acceptance_rate="
+        f"{sp['acceptance_rate']}, mean_accepted_len="
+        f"{sp['mean_accepted_len']} — PERF.md 'Speculative "
+        f"decoding' has the a > c_spec/c_1 threshold arithmetic)")
+    result = {
+        "metric": "speculative_tokens_per_sec_terminator_copy",
+        "value": round(sbest["tok_s"], 1),
+        "unit": "tokens/sec",
+        "whole_loop_tok_s": round(wbest["tok_s"], 1),
+        "plain_burst_tok_s": round(pbest["tok_s"], 1),
+        "speculative_tok_s": round(sbest["tok_s"], 1),
+        "speedup_vs_plain_burst": round(speedup_vs_plain, 2),
+        "speedup_vs_whole_loop": round(speedup_vs_whole, 2),
+        "triple_tok_s": [[round(w["tok_s"], 1), round(p["tok_s"], 1),
+                          round(s["tok_s"], 1)]
+                         for w, p, s in triples],
+        "token_parity_vs_whole_loop": True,  # asserted per leg
+        "steady_state_compiles": int(steady_compiles),
+        "spec": {
+            "k": spec_k,
+            "draft_model": f"d{DD} L{DL}",
+            "target_model": f"d{D} L{L}",
+            "acceptance_rate": sp["acceptance_rate"],
+            "mean_accepted_len": sp["mean_accepted_len"],
+            "proposed": sp["proposed"],
+            "accepted": sp["accepted"],
+            "emitted": sp["emitted"],
+            "draft_steps": sp["draft_steps"],
+            "target_steps": sp["target_steps"],
+            "tokens_per_target_step": (
+                round(sp["emitted"] / sp["target_steps"], 2)
+                if sp["target_steps"] else None),
+        },
+        "n_requests": n_requests,
+        "total_tokens": total_tokens,
+        "len_histogram": {int(k): int(v) for k, v in
+                          zip(*np.unique(lens, return_counts=True))},
+        "workload": "terminator-copy over an 8-prompt pool "
+                    "(repeated-suffix mix; high draft acceptance)",
+        "model": (f"transformer d{D} L{L} S{S} maxT{maxT} "
+                  f"slots{n_slots}, draft d{DD} L{DL} k{spec_k}"),
+        "best_of": 3,
+    }
+    return _write_bench_self("BENCH_SELF_r14.json", result,
+                             stats_json_dict=sbest["stats"])
+
+
 def bench_multitenant(n_requests=900):
     """Restore-safe wrapper: the body flips FLAGS_observability
     across legs with hard asserts in between, and main() keeps going
@@ -1596,6 +1851,7 @@ EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
                  "coldstart": bench_coldstart,
                  "generation": bench_generation,
                  "paged": bench_paged,
+                 "speculative": bench_speculative,
                  "multitenant": bench_multitenant}
 
 

@@ -30,6 +30,10 @@ a stable diagnostic code so tests/docs can reference the class:
   PTA110  shared-pool write not provably lane-exclusive (paged KV
           block pools: aliased scatter = silent cross-request KV
           corruption)
+  PTA120  speculative advance bound unprovable (spec_accept shape/
+          attr disagreement: the counter-advance <= k+1 clamp and
+          the accepted-prefix scatter's room clip are only sound
+          when the declared k/max_len match the wired tensors)
 
 Severities: "error" = the program is wrong (strict mode raises),
 "warning" = almost certainly a bug but a legal feed/scope could save
@@ -975,6 +979,98 @@ def check_shared_pool_writes(program: Program):
                 f"through stale table rows into blocks other lanes "
                 f"own", var=name,
                 hint="pass gate=cast(active, 'float32')")
+
+
+# ---------------------------------------------------------------------------
+# PTA120: speculative counter-advance bound.
+# ---------------------------------------------------------------------------
+@register_checker("PTA120", "spec-advance-bounded")
+def check_spec_advance(program: Program):
+    """The speculative decode step advances per-lane counters by
+    ``spec_accept``'s Advance output, whose <= k+1 clamp (and the
+    EOS/room clips) the kernel computes FROM the op's ``k`` and
+    ``max_len`` attrs (ops/spec_ops.py). That bound is only provable
+    when the attrs agree with the wired tensors: Proposals [R, k],
+    DraftProbs [R, k, V], TargetProbs [R, k+1, V] — a builder that
+    lies about k mis-slices the acceptance scan and the advance can
+    exceed the verified positions. Likewise the accepted-prefix
+    ``span_scatter`` consuming the Tokens output must write a
+    [R, max_len] buffer, or the room clip bounds writes against the
+    WRONG buffer width (per-lane counter corruption / out-of-buffer
+    token writes — the silent class the accepted-prefix scatter can
+    hide). Grown from the r14 draft-and-verify work."""
+    # one walk up front: the Tokens-consumer sweep below would
+    # otherwise re-walk the whole program per spec_accept site, and
+    # the spec serve programs are the zoo's biggest builds
+    spec_sites, scatter_sites = [], []
+    for site in iter_ops(program):
+        if site.op.type == "spec_accept":
+            spec_sites.append(site)
+        elif site.op.type == "span_scatter":
+            scatter_sites.append(site)
+    for site in spec_sites:
+        op = site.op
+        blk = op.block
+        k = op.attrs.get("k")
+        max_len = op.attrs.get("max_len")
+        if not isinstance(k, int) or k < 0:
+            yield _diag_at(
+                "PTA120", ERROR, site,
+                f"spec_accept carries k={k!r}; the advance bound "
+                f"needs a static k >= 0")
+            continue
+        if not isinstance(max_len, int) or max_len < 1:
+            yield _diag_at(
+                "PTA120", ERROR, site,
+                f"spec_accept carries max_len={max_len!r}; the room "
+                f"clip needs the real decode-buffer width")
+            continue
+
+        def _shape(slot):
+            names = op.inputs.get(slot) or []
+            if not names or blk is None:
+                return None
+            v = blk._find_var_recursive(names[0])
+            return tuple(v.shape) if v is not None and v.shape \
+                else None
+
+        for slot, axis, want in (("Proposals", 1, k),
+                                 ("DraftProbs", 1, k),
+                                 ("TargetProbs", 1, k + 1)):
+            shape = _shape(slot)
+            if shape is None or len(shape) <= axis:
+                continue
+            if shape[axis] != want:
+                yield _diag_at(
+                    "PTA120", ERROR, site,
+                    f"spec_accept attr k={k} disagrees with its "
+                    f"{slot} input (shape {shape}, axis {axis} "
+                    f"expected {want}): the counter-advance <= k+1 "
+                    f"bound is unprovable",
+                    var=(op.inputs.get(slot) or [None])[0])
+        # the accepted-prefix scatter: every span_scatter fed by this
+        # op's Tokens must write a buffer of width max_len
+        tok_names = set(op.outputs.get("Tokens") or [])
+        if not tok_names:
+            continue
+        for other in scatter_sites:
+            o = other.op
+            if not tok_names & set(o.inputs.get("Vals") or []):
+                continue
+            buf_names = o.inputs.get("X") or []
+            v = o.block._find_var_recursive(buf_names[0]) \
+                if buf_names and o.block is not None else None
+            shape = tuple(v.shape) if v is not None and v.shape \
+                else None
+            if shape is not None and len(shape) == 2 \
+                    and shape[1] != max_len:
+                yield _diag_at(
+                    "PTA120", ERROR, other,
+                    f"accepted-prefix span_scatter writes buffer "
+                    f"{buf_names[0]!r} of width {shape[1]} but the "
+                    f"producing spec_accept clips room against "
+                    f"max_len={max_len}: the advance bound guards "
+                    f"the wrong buffer", var=buf_names[0])
 
 
 # ---------------------------------------------------------------------------

@@ -88,7 +88,8 @@ def _machine_translation():
 
 def _transformer():
     from ..models import transformer as tr
-    from ..models.decode_engine import CacheConfig
+    from ..models.decode_engine import (CacheConfig, DraftConfig,
+                                        SamplingConfig)
 
     kw = dict(seq_len=16, d_model=64, n_heads=4, n_layers=2,
               d_inner=128, vocab=1000)
@@ -108,6 +109,29 @@ def _transformer():
         cache=CacheConfig(layout="paged", block_size=4, n_blocks=8,
                           n_prompt_entries=3), **dkw)
     pbig = max(paged.prefills)
+    # speculative draft-and-verify (r14): the draft prefill/propose,
+    # target verify and fused serve programs join the strict zoo —
+    # dense AND paged (PTA110 covers the multi-position verify
+    # scatter, PTA120 the advance bound), plus a sampled-lane step
+    draft = DraftConfig(d_model=32, n_heads=2, n_layers=1,
+                        d_inner=64, k=2)
+    # ONE admission bucket per spec-flavor bundle: program structure
+    # is bucket-invariant, and the spec serve programs are the
+    # biggest builds in the zoo — the gate must stay fast (tier-1)
+    spec = tr.build_decode_step_program(
+        n_slots=4, state_prefix="@cbs/", draft=draft,
+        admit_buckets=[2], **dkw)
+    sbig = max(spec.prefills)
+    pspec = tr.build_decode_step_program(
+        n_slots=4, state_prefix="@cbps/", draft=draft,
+        admit_buckets=[2],
+        cache=CacheConfig(layout="paged", block_size=4, n_blocks=8,
+                          n_prompt_entries=3), **dkw)
+    psbig = max(pspec.prefills)
+    sampled = tr.build_decode_step_program(
+        n_slots=4, state_prefix="@cbt/", admit_buckets=[2],
+        sampling=SamplingConfig(temperature=0.8, top_k=8,
+                                top_p=0.95), **dkw)
     return ({"main": main, "startup": startup, "greedy": greedy[0],
              "incremental": incr[0], "beam": beam[0],
              "cb_prefill": bundle.prefill,
@@ -120,14 +144,26 @@ def _transformer():
              "pg_step": paged.step,
              "pg_serve0": paged.serves[0],
              f"pg_serve_miss{pbig}": paged.serves[("miss", pbig)],
-             f"pg_serve_hit{pbig}": paged.serves[("hit", pbig)]},
+             f"pg_serve_hit{pbig}": paged.serves[("hit", pbig)],
+             "sp_prefill": spec.prefill,
+             "sp_step": spec.step,
+             "sp_serve0": spec.serves[0],
+             f"sp_serve{sbig}": spec.serves[sbig],
+             "sps_step": pspec.step,
+             f"sps_serve_miss{psbig}": pspec.serves[("miss", psbig)],
+             f"sps_serve_hit{psbig}": pspec.serves[("hit", psbig)],
+             "smp_step": sampled.step,
+             "smp_serve0": sampled.serves[0]},
             [("main", "greedy"), ("main", "incremental"),
              ("main", "beam"), ("main", "cb_prefill"),
              ("main", f"cb_prefill{big}"), ("main", "cb_step"),
              ("main", "cb_serve0"), ("main", f"cb_serve{big}"),
              ("main", "pg_prefill"), ("main", "pg_step"),
              ("main", f"pg_serve_miss{pbig}"),
-             ("main", f"pg_serve_hit{pbig}")])
+             ("main", f"pg_serve_hit{pbig}"),
+             ("main", "sp_step"), ("main", f"sp_serve{sbig}"),
+             ("main", f"sps_serve_miss{psbig}"),
+             ("main", "smp_step")])
 
 
 def _moe_transformer():

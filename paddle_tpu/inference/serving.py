@@ -893,15 +893,19 @@ class GenerationServer(InferenceServer):
 
 class _GenRequest:
     __slots__ = ("src", "reply", "t_arrival", "t_first", "t_admit",
-                 "trace")
+                 "trace", "seed")
 
-    def __init__(self, src, reply, trace=None):
+    def __init__(self, src, reply, trace=None, seed=0):
         self.src = src
         self.reply = reply
         self.t_arrival = time.monotonic()
         self.t_first = None  # set when its first token lands
         self.t_admit = None  # set when a slot admits it
         self.trace = trace   # observability (see _Request.trace)
+        # per-request noise seed (sampled/speculative bundles): folded
+        # with each POSITION into the emission keys, so a request
+        # samples the same tokens whatever lane/order/burst served it
+        self.seed = seed
 
 
 class ContinuousGenerationServer:
@@ -988,13 +992,42 @@ class ContinuousGenerationServer:
         self._end_id = bundle.end_id
         bundle.init_slot_state(self.scope)
 
+        # sampled/speculative bundle knobs (absent on pre-r14 plain
+        # bundles): per-request seeds in the admission feeds, tokens
+        # per device tick (> 1 under draft-and-verify — the paged
+        # scheduler sizes block coverage by it), and the device-side
+        # spec counters the stats surface deltas per dispatch
+        self._needs_seeds = bool(getattr(bundle, "needs_seeds",
+                                         False))
+        self._spec_k = int(getattr(bundle, "spec_k", 0))
+        self._toks_per_tick = int(getattr(bundle, "tokens_per_tick",
+                                          1))
+        self._spec_names = [
+            bundle.state[c] for c in
+            ("spec_proposed", "spec_accepted", "spec_emitted",
+             "spec_draft_steps", "spec_target_steps")] \
+            if self._spec_k > 0 else []
+        self._spec_tot = dict.fromkeys(
+            ("proposed", "accepted", "emitted", "draft_steps",
+             "target_steps"), 0)
+        # stats(reset=True) window baseline: the DEVICE counters are
+        # cumulative since init_slot_state, so the window view is
+        # tot - base — keeping every number in the "speculative" dict
+        # on the same window the histograms cover
+        self._spec_base = dict(self._spec_tot)
+        # acceptance-rate histogram: fraction of offered draft tokens
+        # accepted per dispatch (fixed 0.1-wide buckets)
+        self._acc_hist = Histogram(
+            "paddle_tpu_spec_acceptance_rate",
+            buckets=tuple(round(0.1 * i, 1) for i in range(1, 11)))
+
         # bind the prepared handles up front (= AOT warmup: all
         # compiles happen HERE, none in the traffic window): one fused
         # serve program per admission flavor x bucket (0 = tick-only)
         before = self.executor.compile_count
         st = bundle.state
         self._fetches = [st["tok_buf"], st["step"], st["active"],
-                         st["finished"]]
+                         st["finished"]] + self._spec_names
         self._serves = {}
         for key, prog in sorted(bundle.serves.items(),
                                 key=lambda kv: str(kv[0])):
@@ -1115,7 +1148,13 @@ class ContinuousGenerationServer:
         self.close()
 
     # --- request path -------------------------------------------------
-    def submit(self, src_ids) -> _Reply:
+    def submit(self, src_ids, seed=None) -> _Reply:
+        """Enqueue one prompt row. ``seed`` keys the request's
+        emission noise on sampled/speculative bundles (ignored by
+        plain greedy ones); None derives it from the prompt CONTENT
+        (crc32), so identical prompts sample identical streams and
+        the served tokens are invariant to admission order — the
+        bit-repro contract tests pin."""
         arr = np.asarray(src_ids)
         if arr.ndim == 1:
             arr = arr[None]
@@ -1124,11 +1163,17 @@ class ContinuousGenerationServer:
                 f"continuous generation takes one prompt row of "
                 f"exactly seq_len={self.bundle.seq_len} tokens; got "
                 f"shape {tuple(np.asarray(src_ids).shape)}")
+        arr = arr.astype(np.int64)
+        if seed is None:
+            import zlib
+
+            seed = zlib.crc32(arr.tobytes())
         trace = obs_tracing.current_request_trace()
         if trace is None:
             trace = obs_tracing.start_request(owner="server",
                                               server=self._obs_id)
-        req = _GenRequest(arr.astype(np.int64), _Reply(), trace=trace)
+        req = _GenRequest(arr, _Reply(), trace=trace,
+                          seed=int(seed))
         with self._cv:
             if self._closed:
                 raise ServerClosed(
@@ -1145,11 +1190,12 @@ class ContinuousGenerationServer:
             self._cv.notify_all()
         return req.reply
 
-    def generate(self, src_ids, timeout: Optional[float] = 120.0):
+    def generate(self, src_ids, timeout: Optional[float] = 120.0,
+                 seed=None):
         """One prompt row in, one sentinel-normalized [max_out_len]
         token row out (same contract as GenerationServer.generate for
         a single row)."""
-        return self.submit(src_ids).result(timeout)
+        return self.submit(src_ids, seed=seed).result(timeout)
 
     # --- scheduler ----------------------------------------------------
     def _pop_next(self):
@@ -1223,6 +1269,12 @@ class ContinuousGenerationServer:
                 [slot for slot, _ in admits]
                 + [self.bundle.dustbin] * (A - len(admits)),
                 np.int64)}
+        if self._needs_seeds:
+            # padded rows' seeds scatter to the dustbin lane: garbage
+            # there is harmless (it never activates)
+            feed["seeds"] = np.array(
+                [req.seed for _, req in admits]
+                + [0] * (A - len(admits)), np.int64)
         return A, feed
 
     def _pre_dispatch(self):
@@ -1298,6 +1350,23 @@ class ContinuousGenerationServer:
                                                  return_numpy=True)
                     sp.attrs["cache"] = _cache_tier(
                         self.executor, c0, d0)
+                    if self._spec_names:
+                        # delta the device-side spec counters for
+                        # this dispatch: the acceptance-rate sample
+                        # and the burst annotation the flight
+                        # recorder uses to explain slow bursts
+                        # (low mean accepted length = the draft
+                        # stopped agreeing with the target)
+                        d = self._absorb_spec_counters(outs)
+                        if d["proposed"] > 0:
+                            self._acc_hist.observe(
+                                d["accepted"] / d["proposed"])
+                            # per lane-tick (see stats()): a LOW
+                            # value explains a slow burst — the
+                            # draft stopped agreeing with the target
+                            sp.attrs["mean_accepted_len"] = round(
+                                d["emitted"] * self._spec_k
+                                / d["proposed"], 3)
         except BaseException as e:
             with self._cv:
                 lanes = [(slot, r)
@@ -1312,7 +1381,7 @@ class ContinuousGenerationServer:
                     r.trace.finish(status="error", error=repr(e))
             return
         self._post_dispatch(outs)
-        tok_buf, step, active, _fin = outs
+        tok_buf, step, active, _fin = outs[:4]  # [4:] = spec counters
         done_t = time.monotonic()
         retired = []
         with self._cv:
@@ -1356,6 +1425,51 @@ class ContinuousGenerationServer:
             if req.trace is not None and req.trace.owner == "server":
                 req.trace.finish()
 
+    def _absorb_spec_counters(self, outs) -> dict:
+        """Read the fetched device-side speculative counters
+        (cumulative since init_slot_state) and return this dispatch's
+        DELTAS; updates the running totals under the scheduler
+        lock."""
+        vals = {key: int(np.asarray(outs[4 + i]).reshape(-1)[0])
+                for i, key in enumerate(
+                    ("proposed", "accepted", "emitted",
+                     "draft_steps", "target_steps"))}
+        with self._cv:
+            deltas = {k: vals[k] - self._spec_tot[k] for k in vals}
+            self._spec_tot = vals
+        return deltas
+
+    def _speculative_stats_locked(self) -> Optional[dict]:
+        if self._spec_k <= 0:
+            return None
+        # window-scoped like every other stats() counter: reset=True
+        # re-bases, so acceptance_rate and the acceptance-rate
+        # histogram always describe the SAME window (a lifetime-
+        # average rate next to a window histogram masked exactly the
+        # acceptance collapses the surface exists to show)
+        t = {key: self._spec_tot[key] - self._spec_base[key]
+             for key in self._spec_tot}
+        return {
+            "k": self._spec_k,
+            "proposed": t["proposed"],
+            "accepted": t["accepted"],
+            "emitted": t["emitted"],
+            "draft_steps": t["draft_steps"],
+            "target_steps": t["target_steps"],
+            "acceptance_rate": (
+                round(t["accepted"] / t["proposed"], 4)
+                if t["proposed"] else None),
+            # per LANE-tick (proposed/k = live lane-ticks): tokens a
+            # lane advances per verify, in [1, k+1] — NOT per program
+            # tick, which sums all live lanes and scales with
+            # occupancy (the bench reports that separately as
+            # tokens_per_target_step)
+            "mean_accepted_len": (
+                round(t["emitted"] * self._spec_k / t["proposed"], 3)
+                if t["proposed"] else None),
+            "acceptance_rate_hist": self._acc_hist.percentile_dict(),
+        }
+
     # --- observability ------------------------------------------------
     def stats(self, reset: bool = False) -> dict:
         """Atomic snapshot; reset/uptime semantics identical to
@@ -1393,6 +1507,9 @@ class ContinuousGenerationServer:
                     round(self._n_done / done_span, 1)
                     if done_span else None),
             }
+            spec = self._speculative_stats_locked()
+            if spec is not None:
+                snap["speculative"] = spec
             if reset:
                 self._n_requests = self._n_done = 0
                 self._n_tokens = self._n_ticks = 0
@@ -1400,6 +1517,8 @@ class ContinuousGenerationServer:
                 self._latencies.clear()
                 self._ttft.clear()
                 self._per_token.clear()
+                self._acc_hist.clear()
+                self._spec_base = dict(self._spec_tot)
                 self._t_first_arrival = None
                 self._t_last_done = None
                 self._t_window = now
@@ -1411,7 +1530,7 @@ class ContinuousGenerationServer:
         with self._cv:
             occ = (self._occ_sum / self._n_ticks
                    if self._n_ticks else 0.0)
-            return [
+            samples = [
                 ("paddle_tpu_server_requests_total", lab,
                  self._n_requests),
                 ("paddle_tpu_server_completed_total", lab,
@@ -1427,6 +1546,23 @@ class ContinuousGenerationServer:
                 ("paddle_tpu_request_ttft_ms", lab, self._ttft),
                 ("paddle_tpu_per_token_ms", lab, self._per_token),
             ]
+            if self._spec_k > 0:
+                t = self._spec_tot
+                samples += [
+                    ("paddle_tpu_spec_proposed_total", lab,
+                     t["proposed"]),
+                    ("paddle_tpu_spec_accepted_total", lab,
+                     t["accepted"]),
+                    ("paddle_tpu_spec_emitted_total", lab,
+                     t["emitted"]),
+                    ("paddle_tpu_spec_draft_steps_total", lab,
+                     t["draft_steps"]),
+                    ("paddle_tpu_spec_target_steps_total", lab,
+                     t["target_steps"]),
+                    ("paddle_tpu_spec_acceptance_rate", lab,
+                     self._acc_hist),
+                ]
+            return samples
 
 
 class PagedContinuousGenerationServer(ContinuousGenerationServer):
@@ -1603,10 +1739,14 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         feed = {"slots": np.array(
             [slot for slot, _ in admits]
             + [self.bundle.dustbin] * (A - len(admits)), np.int64)}
-        if tier == "miss":
+        if tier == "miss" or self._spec_k > 0:
+            # spec bundles feed src_ids on HITs too: the hit program
+            # skips only the TARGET encoder — the (tiny) draft
+            # encoder re-runs per lane (decode_engine._draft_admit)
             feed["src_ids"] = np.concatenate(
                 [req.src for _, req in admits]
                 + [admits[-1][1].src] * (A - len(admits)), axis=0)
+        if tier == "miss":
             # padded rows scatter into the dustbin ENTRY (index E):
             # duplicates there sum to garbage harmlessly, real
             # entries stay host-distinct (PTA110 "host_indices")
@@ -1614,6 +1754,10 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 [self._lane_entry[slot] for slot, _ in admits]
                 + [self.cache.n_prompt_entries] * (A - len(admits)),
                 np.int64)
+        if self._needs_seeds:
+            feed["seeds"] = np.array(
+                [req.seed for _, req in admits]
+                + [0] * (A - len(admits)), np.int64)
         return (tier, A), feed
 
     # --- burst planning: coverage, pausing, hard exhaustion ----------
@@ -1642,6 +1786,7 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         if not run:
             return n_steps, min_active, run
         maxT = self.bundle.max_out_len
+        tpt = self._toks_per_tick
         while True:
             live = [s for s in range(self.n_slots)
                     if self._lanes[s] is not None]
@@ -1652,10 +1797,26 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
             blocked = []
             for s in live:
                 st = int(self._lane_step[s])
-                # a K-tick burst writes KV at positions st..st+K-1
+                # a K-tick burst writes KV at positions st..st+K*tpt-1
+                # (under draft-and-verify every tick VERIFIES tpt =
+                # k+1 positions even when fewer are accepted, so
+                # coverage must be sized by the worst case or a
+                # rejected-run verify would scatter through
+                # unallocated table rows into other lanes' blocks)
                 self._grow_blocks_locked(
-                    s, min(st + n_steps - 1, maxT - 1))
-                coverable = len(self._lane_blocks[s]) * self._bs - st
+                    s, min(st + n_steps * tpt - 1, maxT - 1))
+                covered = len(self._lane_blocks[s]) * self._bs
+                if covered >= maxT:
+                    # whole buffer covered: writes can never leave
+                    # the lane's blocks (the verify gate masks
+                    # positions past maxT-1), so coverage does not
+                    # bound this lane's ticks at all — without this,
+                    # a lane with < tpt positions LEFT counted as
+                    # blocked and a lone nearly-done request died
+                    # BlockPoolExhausted owning every block it needs
+                    coverable = n_steps
+                else:
+                    coverable = (covered - st) // tpt
                 if coverable <= 0:
                     blocked.append(s)
                 else:

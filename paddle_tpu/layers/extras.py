@@ -469,3 +469,85 @@ def masked_pool_write(pool, new, index, gate=None, leading_dims=1,
 
 
 __all__.append("masked_pool_write")
+
+
+def filtered_softmax(logits, temperature=1.0, top_k=0, top_p=1.0,
+                     name=None):
+    """Temperature/top-k/top-p filtered, renormalized probabilities
+    over the last axis of `logits` (ops/spec_ops.py). temperature=0 is
+    the greedy degenerate case: a one-hot at argmax — which is what
+    lets greedy speculative acceptance ride the same rejection-rule
+    kernel (layers.spec_accept) token-exactly."""
+    helper = LayerHelper("filtered_softmax", input=logits, name=name)
+    out = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op("filtered_softmax", {"X": logits}, {"Out": out},
+                     {"temperature": float(temperature),
+                      "top_k": int(top_k), "top_p": float(top_p)})
+    return out
+
+
+def sample_categorical(probs, seed, pos, noise_tag=0, base_seed=0,
+                       name=None):
+    """One token per lane from [R, V] probabilities
+    (ops/spec_ops.py). Noise is a pure function of (base_seed,
+    noise_tag, seed[r], pos[r]) — NOT the executor step key — so the
+    same (request seed, position) draws the same token in every serve
+    specialization: admission order, burst boundaries, and paged
+    recompute-preemption replay cannot move sampled tokens (the
+    serving layer's byte-exact contract; ops/spec_ops.py module
+    docstring has the full rationale)."""
+    helper = LayerHelper("sample_categorical", input=probs, name=name)
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("sample_categorical",
+                     {"Probs": probs, "Seed": seed, "Pos": pos},
+                     {"Out": out},
+                     {"noise_tag": int(noise_tag),
+                      "base_seed": int(base_seed)})
+    return out
+
+
+def span_scatter(buf, vals, start, count, name=None):
+    """Per-row span write: buf[r, start[r]:start[r]+count[r]] =
+    vals[r, :count[r]], IN PLACE (Out is the buf var, so the buffer
+    rides the executor's read-modify-write state path) — the
+    accepted-prefix token write of the speculative decode step
+    (ops/spec_ops.py)."""
+    helper = LayerHelper("span_scatter", input=buf, name=name)
+    helper.append_op("span_scatter",
+                     {"X": buf, "Vals": vals, "Start": start,
+                      "Count": count},
+                     {"Out": buf}, {})
+    return buf
+
+
+def spec_accept(proposals, draft_probs, target_probs, seed, pos, k,
+                end_id, max_len, greedy=True, base_seed=0, noise_tag=0,
+                name=None):
+    """Draft-and-verify acceptance for one batched speculative step
+    (ops/spec_ops.py spec_accept: Leviathan-style rejection sampling;
+    greedy=True makes it token-exact greedy). Returns (advance,
+    tokens, accepted, fin): per-lane emitted count (clipped at the
+    first end_id and at buffer room), the [R, k+1] emitted tokens,
+    the accepted-proposal count, and the EOS latch. Checker PTA120
+    verifies the declared shapes agree with k (the counter-advance
+    <= k+1 bound is only provable when they do)."""
+    helper = LayerHelper("spec_accept", input=proposals, name=name)
+    advance = helper.create_variable_for_type_inference("int64", True)
+    tokens = helper.create_variable_for_type_inference("int64", True)
+    accepted = helper.create_variable_for_type_inference("int64", True)
+    fin = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("spec_accept",
+                     {"Proposals": proposals, "DraftProbs": draft_probs,
+                      "TargetProbs": target_probs, "Seed": seed,
+                      "Pos": pos},
+                     {"Advance": advance, "Tokens": tokens,
+                      "Accepted": accepted, "Fin": fin},
+                     {"k": int(k), "end_id": int(end_id),
+                      "max_len": int(max_len), "greedy": bool(greedy),
+                      "base_seed": int(base_seed),
+                      "noise_tag": int(noise_tag)})
+    return advance, tokens, accepted, fin
+
+
+__all__.extend(["filtered_softmax", "sample_categorical",
+                "span_scatter", "spec_accept"])

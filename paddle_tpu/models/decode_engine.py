@@ -281,9 +281,144 @@ class _PagedLaneCache:
         return self._view(self.pool_k), self._view(self.pool_v)
 
 
+class _DenseSpanCache:
+    """Per-layer dense self-KV access for a MULTI-position write (the
+    speculative verify step: q=k+1 query rows per lane land at cache
+    positions t..t+k in one update). ``pos_oh`` is the [R,q,maxT]
+    one-hot of each query's cache position (all-zero rows for
+    positions past the buffer write nothing); the scatter is the
+    one-hot matmul the admission bodies already use, and the read
+    view is the raw var exactly like _DenseLaneCache."""
+
+    def __init__(self, kc, vc, pos_oh, keep_mask):
+        self.kc, self.vc = kc, vc
+        # [R,1,maxT,q] scatter operand (matmul against [R,H,q,Dh])
+        self.scat = layers.unsqueeze(
+            layers.transpose(pos_oh, perm=[0, 2, 1]), [1])
+        self.keep_mask = keep_mask  # [R,1,maxT,1]
+
+    def update(self, kh, vh):
+        for var, new in ((self.kc, kh), (self.vc, vh)):
+            scat = layers.matmul(self.scat, new)  # [R,H,maxT,Dh]
+            layers.assign(layers.elementwise_add(
+                layers.elementwise_mul(var, self.keep_mask), scat),
+                output=var)
+        return self.kc, self.vc
+
+
+class _PagedSpanCache:
+    """Per-layer paged self-KV access for the multi-position verify
+    write: the q positions of every lane flatten to R*q
+    masked_pool_write rows (distinct cells — positions within a lane
+    are distinct, lanes own disjoint blocks via the host table: the
+    PTA110 exclusivity story is unchanged), with the gate extended by
+    per-position validity so positions past the buffer end never
+    touch the pool. Reads reuse the full dense-view gather."""
+
+    def __init__(self, pool_k, pool_v, write_idx_rq, gate_rq,
+                 flat_pos, rows, q, n_heads, head_dim, maxT, n_cells):
+        self.pool_k, self.pool_v = pool_k, pool_v
+        self.write_idx, self.gate = write_idx_rq, gate_rq  # [R*q]
+        self.flat_pos = flat_pos
+        self.rows, self.q, self.maxT = rows, q, maxT
+        self.n_heads, self.head_dim = n_heads, head_dim
+        self.n_cells = n_cells
+
+    def _view(self, pool):
+        flat = layers.reshape(pool, [self.n_cells,
+                                     self.n_heads * self.head_dim])
+        rows_kv = layers.gather(flat, self.flat_pos)
+        return layers.transpose(
+            layers.reshape(rows_kv, [self.rows, self.maxT,
+                                     self.n_heads, self.head_dim]),
+            perm=[0, 2, 1, 3])
+
+    def update(self, kh, vh):
+        for pool, new in ((self.pool_k, kh), (self.pool_v, vh)):
+            # [R,H,q,Dh] -> [R*q, H, Dh] write rows
+            rows_new = layers.reshape(
+                layers.transpose(new, perm=[0, 2, 1, 3]),
+                [self.rows * self.q, self.n_heads, self.head_dim])
+            layers.masked_pool_write(
+                pool, rows_new, self.write_idx, gate=self.gate,
+                leading_dims=2, exclusive_via="block_table")
+        return self._view(self.pool_k), self._view(self.pool_v)
+
+
+@dataclass(frozen=True)
+class SamplingConfig:
+    """Emission-lane sampling policy (temperature/top-k/top-p) for a
+    decode bundle. temperature == 0 degenerates to greedy argmax;
+    ``base_seed`` is the bundle's noise root — per-request seeds fold
+    into it, so two servers over the same weights with different
+    base seeds sample independently. Noise derivation (and why the
+    executor step key deliberately stays out of it):
+    ops/spec_ops.py module docstring."""
+
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    base_seed: int = 0
+
+    def validate(self):
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{self.temperature}")
+        if self.top_p <= 0 or self.top_p > 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got "
+                             f"{self.top_p}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+    def token(self) -> tuple:
+        return ("sample", float(self.temperature), int(self.top_k),
+                float(self.top_p), int(self.base_seed))
+
+
+@dataclass(frozen=True)
+class DraftConfig:
+    """Draft model of a speculative (draft-and-verify) decode bundle
+    (Leviathan et al.; the vLLM spec-decode worker family, PAPERS.md).
+    The draft is a SMALLER enc-dec transformer co-resident with the
+    target in ONE scope, so every parameter it creates is prefixed
+    (``prefix``, default ``draft_``) — explicit names per the PTA050
+    cross-build rule, and the builder pair-lints draft-vs-target
+    persistable names with the PTA100 collision check at bundle
+    build. ``k`` proposals per lane per step; k=0 degenerates to the
+    plain one-token step (the r10 path)."""
+
+    d_model: int = 32
+    n_heads: int = 2
+    n_layers: int = 1
+    d_inner: int = 64
+    k: int = 3
+    prefix: str = "draft_"
+
+    def validate(self, max_out_len: int):
+        if self.k < 0:
+            raise ValueError(f"draft k must be >= 0, got {self.k}")
+        if self.k + 1 > max_out_len:
+            raise ValueError(
+                f"draft k={self.k} proposes past the decode buffer "
+                f"(max_out_len={max_out_len})")
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"draft d_model={self.d_model} not divisible by "
+                f"n_heads={self.n_heads}")
+
+    def token(self) -> tuple:
+        return ("spec", int(self.k), int(self.d_model),
+                int(self.n_heads), int(self.n_layers),
+                int(self.d_inner), self.prefix)
+
+
 def cached_decoder_step(x, caches, cross_kv, att_bias, d_model,
-                        n_heads, d_inner):
-    """One KV-cached decoder-stack step over a [R,1,D] row batch
+                        n_heads, d_inner, prefix="", q=1):
+    """One KV-cached decoder-stack step over a [R,q,D] row batch
     (reference tests/unittests/dist_transformer.py:1498 fast_decode's
     cached decoder, factored so the whole-loop incremental program and
     the slot-pool single-step programs — dense AND paged — trace the
@@ -291,12 +426,17 @@ def cached_decoder_step(x, caches, cross_kv, att_bias, d_model,
     coincidental).
 
     ``caches``: per-layer cache-access objects (_DenseLaneCache /
-    _PagedLaneCache) owning the self-attention KV write+view.
+    _PagedLaneCache for q=1; the span caches for the speculative
+    q=k+1 verify step) owning the self-attention KV write+view.
     ``cross_kv``: per-layer (ck, cv) [R,H,S,Dh] encoder projections
     (vars for dense, pool gathers for paged). ``att_bias`` is the
-    0/-1e9 validity bias added to the [R,H,1,maxT] attention scores.
-    Param names are the explicit dec{li}_* scheme shared with the
-    training build. Returns the [R,1,D] hidden row after all layers.
+    0/-1e9 validity bias added to the [R,H,q,maxT] attention scores —
+    for q>1 it must be per-query-position causal ([R,1,q,maxT]:
+    query j masks cache positions > t+j). Param names are the
+    explicit {prefix}dec{li}_* scheme shared with the training build
+    (``prefix`` is how a speculative DRAFT model co-resides with the
+    target in one scope without aliasing — the PTA100 contract).
+    Returns the [R,q,D] hidden rows after all layers.
     """
     from . import transformer as T
 
@@ -306,49 +446,52 @@ def cached_decoder_step(x, caches, cross_kv, att_bias, d_model,
         # --- cached causal self-attention (fused qkv) ---
         qkv = layers.fc(
             x, 3 * d_model, num_flatten_dims=2, bias_attr=False,
-            param_attr=T._attn_proj_attr(f"dec{li}_self", "qkv",
-                                         d_model))
-        q, k, v = layers.split(qkv, 3, dim=2)
-        qh = heads_of(q, 1, n_heads, head_dim)
-        kh = heads_of(k, 1, n_heads, head_dim)
-        vh = heads_of(v, 1, n_heads, head_dim)
+            param_attr=T._attn_proj_attr(f"{prefix}dec{li}_self",
+                                         "qkv", d_model))
+        qv, k, v = layers.split(qkv, 3, dim=2)
+        qh = heads_of(qv, q, n_heads, head_dim)
+        kh = heads_of(k, q, n_heads, head_dim)
+        vh = heads_of(v, q, n_heads, head_dim)
         kc, vc = cache.update(kh, vh)
         scores = layers.scale(
             layers.matmul(qh, kc, transpose_y=True),
-            scale=scale)  # [R,H,1,maxT]
+            scale=scale)  # [R,H,q,maxT]
         scores = layers.elementwise_add(scores, att_bias)
         probs = layers.softmax(scores, axis=-1)
         ctx = layers.matmul(probs, vc)
         ctx = layers.reshape(
             layers.transpose(ctx, perm=[0, 2, 1, 3]),
-            [0, 1, d_model])  # [R,1,HD]
+            [0, q, d_model])  # [R,q,HD]
         attn_out = layers.fc(ctx, d_model, num_flatten_dims=2,
                              bias_attr=False,
-                             param_attr=f"dec{li}_self_out.w")
-        x = T._add_norm(attn_out, x, 0.0, True, name=f"dec{li}_a")
+                             param_attr=f"{prefix}dec{li}_self_out.w")
+        x = T._add_norm(attn_out, x, 0.0, True,
+                        name=f"{prefix}dec{li}_a")
         # --- cross attention against precomputed enc K/V ---
         q2 = layers.fc(
             x, d_model, num_flatten_dims=2, bias_attr=False,
-            param_attr=T._attn_proj_attr(f"dec{li}_cross", "q",
-                                         d_model))
-        q2h = heads_of(q2, 1, n_heads, head_dim)
+            param_attr=T._attn_proj_attr(f"{prefix}dec{li}_cross",
+                                         "q", d_model))
+        q2h = heads_of(q2, q, n_heads, head_dim)
         ck, cv = cross_kv[li]
         s2 = layers.scale(
             layers.matmul(q2h, ck, transpose_y=True),
-            scale=scale)  # [R,H,1,S]
+            scale=scale)  # [R,H,q,S]
         p2 = layers.softmax(s2, axis=-1)
         ctx2 = layers.reshape(
             layers.transpose(layers.matmul(p2, cv),
                              perm=[0, 2, 1, 3]),
-            [0, 1, d_model])
+            [0, q, d_model])
         cross_out = layers.fc(
             ctx2, d_model, num_flatten_dims=2,
             bias_attr=False,
-            param_attr=f"dec{li}_cross_out.w")
-        x = T._add_norm(cross_out, x, 0.0, True, name=f"dec{li}_b")
+            param_attr=f"{prefix}dec{li}_cross_out.w")
+        x = T._add_norm(cross_out, x, 0.0, True,
+                        name=f"{prefix}dec{li}_b")
         # --- ffn ---
-        ffn = T._ffn(x, d_model, d_inner, 0.0, True, name=f"dec{li}")
-        x = T._add_norm(ffn, x, 0.0, True, name=f"dec{li}_c")
+        ffn = T._ffn(x, d_model, d_inner, 0.0, True,
+                     name=f"{prefix}dec{li}")
+        x = T._add_norm(ffn, x, 0.0, True, name=f"{prefix}dec{li}_c")
     return x
 
 
@@ -606,7 +749,8 @@ class DecodeStepBundle:
 
     def __init__(self, prefills, step, serves, startup, state,
                  n_slots, seq_len, max_out_len, start_id, end_id,
-                 cache=None, hit_prefills=None):
+                 cache=None, hit_prefills=None, sampling=None,
+                 draft=None):
         self.prefills = dict(prefills)   # bucket size A -> Program
         self.prefill = self.prefills[min(self.prefills)]
         self.hit_prefills = dict(hit_prefills or {})
@@ -621,12 +765,42 @@ class DecodeStepBundle:
         self.start_id = start_id
         self.end_id = end_id
         self.cache = cache or CacheConfig()
+        self.sampling = sampling         # SamplingConfig | None
+        self.draft = draft               # DraftConfig | None
         self._state_specs = {}
 
+    @property
+    def spec_k(self) -> int:
+        """Draft proposals per lane per step (0 = plain decode)."""
+        return self.draft.k if self.draft is not None else 0
+
+    @property
+    def tokens_per_tick(self) -> int:
+        """Max tokens ONE device tick can emit per lane — the paged
+        scheduler sizes block coverage by this (k accepted proposals
+        + the correction/bonus token)."""
+        return self.spec_k + 1
+
+    @property
+    def needs_seeds(self) -> bool:
+        """True when admissions must feed per-request noise seeds
+        (sampled emission lanes, or any speculative bundle — the
+        acceptance draws are keyed on them)."""
+        return self.sampling is not None or self.draft is not None
+
     def cache_token(self) -> tuple:
-        """KV-layout identity for server_fingerprint/compile-cache
-        keys (CacheConfig.token)."""
-        return self.cache.token()
+        """Content identity for server_fingerprint/compile-cache
+        keys: KV layout (CacheConfig.token) PLUS the speculative and
+        sampling configs — a spec bundle and a plain bundle over the
+        same weights (or two spec bundles differing only in k or
+        temperature) serve different token streams and must never
+        dedupe or hot-swap as 'same model'."""
+        tok = self.cache.token()
+        if self.draft is not None:
+            tok = tok + self.draft.token()
+        if self.sampling is not None:
+            tok = tok + self.sampling.token()
+        return tok
 
     def serve_feed_spec(self, key) -> List[tuple]:
         """Feed signature (name, shape, dtype) of ``serves[key]`` —
@@ -637,11 +811,16 @@ class DecodeStepBundle:
             return feed
         tier, A = key if isinstance(key, tuple) else ("miss", key)
         pre = []
-        if tier == "miss":
+        if tier == "miss" or self.spec_k > 0:
+            # spec bundles feed src_ids on HIT admissions too: the
+            # (tiny) draft encoder always runs so its per-lane
+            # cross-KV exists — only the TARGET encoder is skipped
             pre.append(("src_ids", (A, self.seq_len), "int64"))
         pre.append(("slots", (A,), "int64"))
         if tier == "miss" and self.cache.layout == "paged":
             pre.append(("prompt_slots", (A,), "int64"))
+        if self.needs_seeds:
+            pre.append(("seeds", (A,), "int64"))
         return pre + feed
 
     def kv_state_bytes(self) -> int:
@@ -653,7 +832,8 @@ class DecodeStepBundle:
         for name, (shape, dt) in self._state_specs.items():
             short = name.split("/")[-1]
             if short.startswith(("self_", "cross_", "block_tab",
-                                 "prompt_ref")):
+                                 "prompt_ref", "draft_self_",
+                                 "draft_cross_")):
                 total += int(np.prod(shape)) * np.dtype(dt).itemsize
         return total
 
@@ -673,13 +853,41 @@ class DecodeStepBundle:
 
 
 def _slot_state_specs(prefix, rows, maxT, seq_len, n_heads,
-                      head_dim, n_layers, cache):
+                      head_dim, n_layers, cache, sampling=None,
+                      draft=None):
     specs = {
         f"{prefix}tok_buf": ((rows, maxT), "int64"),
         f"{prefix}step": ((rows,), "int64"),
         f"{prefix}finished": ((rows,), "int64"),
         f"{prefix}active": ((rows,), "int64"),
     }
+    if sampling is not None or draft is not None:
+        # per-lane noise seed, written at admission from the fed
+        # per-request seeds — the (request, position) key channel
+        specs[f"{prefix}seed"] = ((rows,), "int64")
+    if draft is not None and draft.k > 0:
+        dh = draft.d_model // draft.n_heads
+        # the draft's self-KV stays DENSE per-lane in BOTH target
+        # layouts (the draft is small — that is the point; paging it
+        # would buy bytes nobody is short of), its cross-KV is
+        # per-lane too (the draft encoder re-runs even on prefix-HIT
+        # admissions, so no pooled entries to refcount)
+        for li in range(draft.n_layers):
+            specs[f"{prefix}draft_self_k{li}"] = (
+                (rows, draft.n_heads, maxT, dh), "float32")
+            specs[f"{prefix}draft_self_v{li}"] = (
+                (rows, draft.n_heads, maxT, dh), "float32")
+            specs[f"{prefix}draft_cross_k{li}"] = (
+                (rows, draft.n_heads, seq_len, dh), "float32")
+            specs[f"{prefix}draft_cross_v{li}"] = (
+                (rows, draft.n_heads, seq_len, dh), "float32")
+        # device-side speculative accounting ([1] int64 RMW counters;
+        # the serving layer deltas them per dispatch): proposals
+        # offered / accepted / tokens emitted / draft vs target model
+        # steps — the observability satellite's raw series
+        for c in ("spec_proposed", "spec_accepted", "spec_emitted",
+                  "spec_draft_steps", "spec_target_steps"):
+            specs[f"{prefix}{c}"] = ((1,), "int64")
     if cache.layout == "dense":
         for li in range(n_layers):
             specs[f"{prefix}self_k{li}"] = (
@@ -720,11 +928,98 @@ def _declare_slot_state(block, specs):
             for name, (shape, dt) in specs.items()}
 
 
+def _param_probe(prefix, seq_len, max_out_len, d_model, n_heads,
+                 n_layers, d_inner, vocab):
+    """Tiny program whose only job is to CREATE every parameter the
+    (prefix-named) enc-dec decode stack owns, through the REAL
+    param-creating code paths (T.encoder_layer / cached_decoder_step /
+    the embeddings and the logits fc) so the name set cannot drift
+    from the actual builders — the draft-vs-target PTA100 pair lint
+    (_pair_lint_draft_target) reads its persistables."""
+    import paddle_tpu as fluid
+
+    from . import transformer as T
+
+    head_dim = d_model // n_heads
+    maxT = max_out_len
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[1, seq_len],
+                          dtype="int64", append_batch_size=False)
+        enc = T._embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
+                       True, f"{prefix}src_word_emb")
+        for li in range(n_layers):
+            enc = T.encoder_layer(enc, d_model, n_heads, d_inner,
+                                  0.0, is_test=True,
+                                  name=f"{prefix}enc{li}")
+        cross = []
+        for li in range(n_layers):
+            kvp = layers.fc(enc, 2 * d_model, num_flatten_dims=2,
+                            bias_attr=False,
+                            param_attr=T._attn_proj_attr(
+                                f"{prefix}dec{li}_cross", "kv",
+                                d_model))
+            k, v = layers.split(kvp, 2, dim=2)
+            cross.append((heads_of(k, seq_len, n_heads, head_dim),
+                          heads_of(v, seq_len, n_heads, head_dim)))
+        ids = layers.assign(np.zeros((1, 1), "int64"))
+        x = layers.unsqueeze(
+            layers.embedding(ids, size=[vocab, d_model],
+                             param_attr=ParamAttr(
+                                 name=f"{prefix}tgt_word_emb")), [1])
+        wm = layers.assign(np.zeros((1, 1, maxT, 1), "float32"))
+        km = layers.assign(np.ones((1, 1, maxT, 1), "float32"))
+        caches = [
+            _DenseLaneCache(
+                layers.assign(np.zeros((1, n_heads, maxT, head_dim),
+                                       "float32")),
+                layers.assign(np.zeros((1, n_heads, maxT, head_dim),
+                                       "float32")), wm, km)
+            for _ in range(n_layers)]
+        bias = layers.assign(np.zeros((maxT,), "float32"))
+        x = cached_decoder_step(x, caches, cross, bias, d_model,
+                                n_heads, d_inner, prefix=prefix)
+        layers.fc(layers.reshape(x, [0, d_model]), vocab,
+                  bias_attr=False, param_attr=f"{prefix}logits.w")
+    return main
+
+
+def _pair_lint_draft_target(draft, *, seq_len, max_out_len, d_model,
+                            n_heads, n_layers, d_inner, vocab):
+    """ModelRegistry-style PTA100 pair lint at bundle build: the
+    speculative draft co-resides with the target in ONE scope, so ANY
+    persistable name overlap between them is the aliasing/clobbering
+    defect check_cross_model_collision exists for (same shape =
+    silent weight aliasing — the draft would serve target weights and
+    acceptance statistics would be garbage with no error anywhere).
+    Raises with the formatted diagnostics on collision; a distinct
+    ``draft.prefix`` keeps it silent."""
+    from ..analysis.checkers import (ERROR,
+                                     check_cross_model_collision,
+                                     format_diagnostics)
+
+    target = _param_probe("", seq_len, max_out_len, d_model, n_heads,
+                          n_layers, d_inner, vocab)
+    probe = _param_probe(draft.prefix, seq_len, max_out_len,
+                         draft.d_model, draft.n_heads,
+                         draft.n_layers, draft.d_inner, vocab)
+    diags = [d for d in check_cross_model_collision(target, probe)
+             if d.severity == ERROR]
+    if diags:
+        raise ValueError(
+            f"speculative draft (prefix {draft.prefix!r}) collides "
+            f"with the target model's persistables — co-residence in "
+            f"one scope would alias/clobber weights (PTA100):\n"
+            + format_diagnostics(diags))
+
+
 def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                               n_heads=4, n_layers=2, d_inner=128,
                               vocab=1000, start_id=0, end_id=1,
                               n_slots=8, admit_buckets=None,
-                              state_prefix="@cb/", cache=None):
+                              state_prefix="@cb/", cache=None,
+                              sampling=None, draft=None):
     """Build the slot-pool continuous-batching bundle (bucketed
     admission prefills + single-step decode over ``n_slots``
     device-resident lanes) — see DecodeStepBundle. The step program's
@@ -739,6 +1034,20 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
     a bucket land on the dustbin lane. ``cache`` (CacheConfig)
     selects the KV layout; None = dense.
 
+    ``sampling`` (SamplingConfig) replaces the greedy argmax emission
+    with temperature/top-k/top-p sampled lanes keyed on per-request
+    seeds (admissions then feed ``seeds``); ``draft`` (DraftConfig)
+    turns the step into SPECULATIVE draft-and-verify: k unrolled
+    cached draft-model steps propose tokens per lane, ONE batched
+    k+1-query target step verifies them, and per-lane counters
+    advance by the accepted prefix (+ the correction/bonus token).
+    Greedy spec (sampling None or temperature 0) is token-exact vs
+    the whole-loop decode; sampled spec uses the rejection rule so
+    the emitted stream matches the target model's (filtered)
+    distribution. draft.k == 0 degenerates to the plain one-token
+    step. The draft's params are prefix-named and pair-linted
+    against the target's with the PTA100 collision check at build.
+
     Returns a DecodeStepBundle.
     """
     import paddle_tpu as fluid
@@ -747,7 +1056,19 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
 
     cache = cache or CacheConfig()
     cache.validate(max_out_len)
+    if sampling is not None:
+        sampling.validate()
+    if draft is not None:
+        draft.validate(max_out_len)
+        _pair_lint_draft_target(
+            draft, seq_len=seq_len, max_out_len=max_out_len,
+            d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+            d_inner=d_inner, vocab=vocab)
+    spec = draft is not None and draft.k > 0
+    greedy = sampling is None or sampling.greedy
+    samp = sampling or SamplingConfig(temperature=0.0)
     paged = cache.layout == "paged"
+    needs_seeds = sampling is not None or draft is not None
     head_dim = d_model // n_heads
     maxT = max_out_len
     rows = n_slots + 1  # + the dustbin lane for padded admissions
@@ -763,7 +1084,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             f"admit_buckets {admit_buckets} must lie in "
             f"[1, n_slots={n_slots}]")
     specs = _slot_state_specs(state_prefix, rows, maxT, seq_len,
-                              n_heads, head_dim, n_layers, cache)
+                              n_heads, head_dim, n_layers, cache,
+                              sampling=sampling, draft=draft)
     if paged:
         NP, BS, NB = cache.pages(maxT), cache.block_size, cache.n_blocks
         E = cache.n_prompt_entries
@@ -790,7 +1112,7 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             layers.fill_constant([rows], "int64", 1.0), any_i)
         return oh, any_f, any_i, keep_f, keep_i
 
-    def _reset_lane_state(sv, any_i, keep_i):
+    def _reset_lane_state(sv, any_i, keep_i, oh=None, seeds=None):
         # token buffer rows: start_id at position 0, zeros
         # elsewhere (identical init row for every admission)
         positions = layers.cast(layers.range(0, maxT, 1), "int64")
@@ -814,6 +1136,19 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         fin = sv[f"{state_prefix}finished"]
         layers.assign(layers.elementwise_mul(fin, keep_i),
                       output=fin)
+        if seeds is not None:
+            # per-request noise seeds scatter to their lanes in PURE
+            # int arithmetic (a float32 one-hot matmul would truncate
+            # 32-bit seeds past 2^24); dustbin duplicates sum to
+            # garbage harmlessly
+            oh_i = layers.cast(oh, "int64")  # [A, rows]
+            scat = layers.reduce_sum(
+                layers.elementwise_mul(
+                    oh_i, layers.reshape(seeds, [-1, 1])), dim=0)
+            seedv = sv[f"{state_prefix}seed"]
+            layers.assign(layers.elementwise_add(
+                layers.elementwise_mul(seedv, keep_i), scat),
+                output=seedv)
         act = sv[f"{state_prefix}active"]
         # the dustbin lane never activates: it must not hold the
         # serve While open nor count against min_active
@@ -822,6 +1157,56 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         layers.assign(layers.elementwise_add(
             layers.elementwise_mul(act, keep_i),
             layers.elementwise_mul(any_i, valid)), output=act)
+
+    def _seeds_data(A):
+        if not needs_seeds:
+            return None
+        return layers.data("seeds", shape=[A], dtype="int64",
+                           append_batch_size=False)
+
+    def _draft_admit(sv, src, A, oh, keep_f):
+        """Speculative admission tail: run the (tiny) DRAFT encoder
+        over the admission prompts and install per-lane draft
+        cross-KV + zeroed draft self-KV. Runs on EVERY admission
+        flavor — including paged prefix-HITs, which skip only the
+        TARGET encoder (the draft's cross-KV is per-lane, not
+        pooled; re-encoding with the draft costs ~nothing, and
+        pooling it would couple the prompt-entry refcounts to the
+        draft's lifetime for no capacity win)."""
+        dd = draft.d_model
+        dh = dd // draft.n_heads
+        denc = T._embed(src, vocab, dd, max(seq_len, maxT), 0.0,
+                        True, f"{draft.prefix}src_word_emb")
+        for li in range(draft.n_layers):
+            denc = T.encoder_layer(denc, dd, draft.n_heads,
+                                   draft.d_inner, 0.0, is_test=True,
+                                   name=f"{draft.prefix}enc{li}")
+        keep4 = layers.reshape(keep_f, [rows, 1, 1, 1])
+        ohT = layers.transpose(oh, perm=[1, 0])  # [rows, A]
+        flat = draft.n_heads * seq_len * dh
+        for li in range(draft.n_layers):
+            kvp = layers.fc(denc, 2 * dd, num_flatten_dims=2,
+                            bias_attr=False,
+                            param_attr=T._attn_proj_attr(
+                                f"{draft.prefix}dec{li}_cross", "kv",
+                                dd))
+            k, v = layers.split(kvp, 2, dim=2)
+            kh = heads_of(k, seq_len, draft.n_heads, dh)
+            vh = heads_of(v, seq_len, draft.n_heads, dh)
+            for var, new in (
+                    (sv[f"{state_prefix}draft_cross_k{li}"], kh),
+                    (sv[f"{state_prefix}draft_cross_v{li}"], vh)):
+                scat = layers.reshape(
+                    layers.matmul(ohT,
+                                  layers.reshape(new, [A, flat])),
+                    [rows, draft.n_heads, seq_len, dh])
+                layers.assign(layers.elementwise_add(
+                    layers.elementwise_mul(var, keep4), scat),
+                    output=var)
+            for var in (sv[f"{state_prefix}draft_self_k{li}"],
+                        sv[f"{state_prefix}draft_self_v{li}"]):
+                layers.assign(layers.elementwise_mul(var, keep4),
+                              output=var)
 
     def _encode_prompts(A):
         src = layers.data("src_ids", shape=[A, seq_len],
@@ -832,7 +1217,7 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             enc = T.encoder_layer(enc, d_model, n_heads, d_inner,
                                   0.0, is_test=True,
                                   name=f"enc{li}")
-        return enc
+        return src, enc
 
     def _cross_proj(enc, li):
         kvp = layers.fc(enc, 2 * d_model, num_flatten_dims=2,
@@ -845,9 +1230,10 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
 
     # --- admission bodies: admit up to A prompts in ONE dispatch ----
     def _admit_body_dense(sv, A):
-        enc = _encode_prompts(A)
+        src, enc = _encode_prompts(A)
         slots = layers.data("slots", shape=[A], dtype="int64",
                             append_batch_size=False)
+        seeds = _seeds_data(A)
         oh, any_f, any_i, keep_f, keep_i = _lane_onehots(slots, A)
         keep4 = layers.reshape(keep_f, [rows, 1, 1, 1])
         ohT = layers.transpose(oh, perm=[1, 0])  # [rows, A]
@@ -871,18 +1257,21 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                         sv[f"{state_prefix}self_v{li}"]):
                 layers.assign(layers.elementwise_mul(var, keep4),
                               output=var)
-        _reset_lane_state(sv, any_i, keep_i)
+        if spec:
+            _draft_admit(sv, src, A, oh, keep_f)
+        _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds)
 
     def _admit_body_paged_miss(sv, A):
         """Cold-prompt admission: encode, publish cross-KV into the
         fed prompt-pool entries (host-distinct indices — padded rows
         target the dustbin entry), reset the lanes. The lanes' block
         tables / prompt refs are HOST-written scope state."""
-        enc = _encode_prompts(A)
+        src, enc = _encode_prompts(A)
         slots = layers.data("slots", shape=[A], dtype="int64",
                             append_batch_size=False)
         pslots = layers.data("prompt_slots", shape=[A], dtype="int64",
                              append_batch_size=False)
+        seeds = _seeds_data(A)
         for li in range(n_layers):
             kh, vh = _cross_proj(enc, li)
             for var, new in (
@@ -892,8 +1281,10 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                 layers.masked_pool_write(
                     var, new, pslots, leading_dims=1,
                     exclusive_via="host_indices")
-        _, _, any_i, _, keep_i = _lane_onehots(slots, A)
-        _reset_lane_state(sv, any_i, keep_i)
+        oh, _, any_i, keep_f, keep_i = _lane_onehots(slots, A)
+        if spec:
+            _draft_admit(sv, src, A, oh, keep_f)
+        _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds)
         # fresh lanes need no self-pool zeroing: every cache position
         # <= t is rewritten by the lane before it is ever attended to,
         # and positions > t are masked by the validity bias exactly
@@ -902,12 +1293,21 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
     def _admit_body_paged_hit(sv, A):
         """Prefix-HIT admission: the prompt's cross-KV entry is
         already in the pool (refcount bumped host-side), so admission
-        is a lane reset only — no encoder, no pool write. This is the
-        prefix-reuse fast path a shared system prompt rides."""
+        is a lane reset only — no TARGET encoder, no pool write. This
+        is the prefix-reuse fast path a shared system prompt rides.
+        Speculative bundles still feed src_ids here and run the
+        (tiny) DRAFT encoder: its cross-KV is per-lane state (see
+        _draft_admit)."""
+        if spec:
+            src = layers.data("src_ids", shape=[A, seq_len],
+                              dtype="int64", append_batch_size=False)
         slots = layers.data("slots", shape=[A], dtype="int64",
                             append_batch_size=False)
-        _, _, any_i, _, keep_i = _lane_onehots(slots, A)
-        _reset_lane_state(sv, any_i, keep_i)
+        seeds = _seeds_data(A)
+        oh, _, any_i, keep_f, keep_i = _lane_onehots(slots, A)
+        if spec:
+            _draft_admit(sv, src, A, oh, keep_f)
+        _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds)
 
     admit_bodies = {"miss": _admit_body_dense if not paged
                     else _admit_body_paged_miss}
@@ -1035,10 +1435,23 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             layers.reshape(x, [0, d_model]), vocab,
             bias_attr=False, param_attr="logits.w")        # [R,V]
         # --- per-lane emit (the emit_token_step tail, vectorized over
-        # lane counters; same freeze/write semantics) ---
-        tok = layers.cast(layers.argmax(logits_v, axis=-1),
-                          "int64")                         # [R]
+        # lane counters; same freeze/write semantics). Sampled lanes
+        # draw from the filtered distribution keyed on (per-request
+        # seed, position) — invariant to admission order / burst
+        # boundaries / which serve specialization runs the tick
+        # (ops/spec_ops.py noise discipline) ---
         ones_n = layers.fill_constant([rows], "int64", 1.0)
+        if sampling is not None and not sampling.greedy:
+            probs_v = layers.filtered_softmax(
+                logits_v, temperature=samp.temperature,
+                top_k=samp.top_k, top_p=samp.top_p)
+            tok = layers.sample_categorical(
+                probs_v, sv[f"{state_prefix}seed"],
+                layers.elementwise_add(stepv, ones_n),
+                noise_tag=0, base_seed=samp.base_seed)     # [R]
+        else:
+            tok = layers.cast(layers.argmax(logits_v, axis=-1),
+                              "int64")                     # [R]
         not_fin = layers.elementwise_sub(ones_n, fin)
         tok = layers.elementwise_add(
             layers.elementwise_mul(tok, not_fin),
@@ -1081,11 +1494,263 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         layers.assign(new_act, output=act)
         layers.assign(new_fin, output=fin)
 
+    # --- the speculative (draft-and-verify) step body: k unrolled
+    # cached DRAFT steps propose tokens per lane, ONE batched
+    # (k+1)-query TARGET step verifies them, and spec_accept advances
+    # each lane by its accepted prefix + the correction/bonus token.
+    # Greedy is token-exact vs the whole-loop decode (the acceptance
+    # rule degenerates exactly — ops/spec_ops.py); KV cells past the
+    # accepted prefix hold rejected-token garbage, which is masked by
+    # the per-query validity bias and rewritten when the lane reaches
+    # those positions (the same staleness discipline the paged
+    # layout already relies on). ------------------------------------
+    def _spec_step_body(sv):
+        k = draft.k
+        Q = k + 1
+        dd, dH = draft.d_model, draft.n_heads
+        tok_buf = sv[f"{state_prefix}tok_buf"]
+        stepv = sv[f"{state_prefix}step"]
+        fin = sv[f"{state_prefix}finished"]
+        act = sv[f"{state_prefix}active"]
+        seedv = sv[f"{state_prefix}seed"]
+        positions = layers.cast(layers.range(0, maxT, 1), "int64")
+        posf = layers.cast(positions, "float32")
+        pos_table = layers.assign(
+            T._position_encoding(max(seq_len, maxT), d_model)[:maxT])
+        dpos_table = layers.assign(
+            T._position_encoding(max(seq_len, maxT), dd)[:maxT])
+        ones_n = layers.fill_constant([rows], "int64", 1.0)
+        step2 = layers.reshape(stepv, [rows, 1])           # [R,1]
+        t_mask0 = layers.cast(layers.equal(positions, step2),
+                              "float32")                   # [R,maxT]
+        cur_tok = layers.reduce_sum(
+            layers.elementwise_mul(tok_buf,
+                                   layers.cast(t_mask0, "int64")),
+            dim=1, keep_dim=True)                          # [R,1]
+
+        # ---- draft propose: k+1 unrolled cached draft-model steps
+        # over positions step..step+k. Steps 0..k-1 yield the k
+        # proposals; step k exists ONLY to write the draft's KV at
+        # position step+k — after a full-acceptance tick the counter
+        # advances to step+k+1, and without that write the draft
+        # cache keeps a PERMANENT hole at step+k (never reprocessed:
+        # later ticks start past it), silently poisoning every
+        # subsequent proposal for the lane's lifetime (measured:
+        # acceptance collapsed to ~0 after the first burst) ----
+        proposals, dprob_rows = [], []
+        prev = cur_tok
+        for j in range(k + 1):
+            stepj = stepv if j == 0 else layers.elementwise_add(
+                stepv, layers.fill_constant([1], "int64", float(j)))
+            stepj2 = layers.reshape(stepj, [rows, 1])
+            t_mask_j = layers.cast(layers.equal(positions, stepj2),
+                                   "float32")              # [R,maxT]
+            x = layers.embedding(prev, size=[vocab, dd],
+                                 param_attr=ParamAttr(
+                                     name=f"{draft.prefix}"
+                                          f"tgt_word_emb"))
+            x = layers.unsqueeze(x, [1])                   # [R,1,dd]
+            x = layers.scale(x, scale=dd ** 0.5)
+            pos_e = layers.matmul(t_mask_j, dpos_table)    # [R,dd]
+            x = layers.elementwise_add(x,
+                                       layers.unsqueeze(pos_e, [1]))
+            dbias = layers.reshape(
+                layers.scale(layers.cast(layers.greater_than(
+                    posf, layers.cast(stepj2, "float32")), "float32"),
+                    scale=-1e9),
+                [rows, 1, 1, maxT])
+            wm = layers.reshape(t_mask_j, [rows, 1, maxT, 1])
+            km = layers.reshape(
+                layers.elementwise_sub(
+                    layers.fill_constant([rows, maxT], "float32",
+                                         1.0), t_mask_j),
+                [rows, 1, maxT, 1])
+            dcaches = [
+                _DenseLaneCache(sv[f"{state_prefix}draft_self_k{li}"],
+                                sv[f"{state_prefix}draft_self_v{li}"],
+                                wm, km)
+                for li in range(draft.n_layers)]
+            dcross = [(sv[f"{state_prefix}draft_cross_k{li}"],
+                       sv[f"{state_prefix}draft_cross_v{li}"])
+                      for li in range(draft.n_layers)]
+            x = cached_decoder_step(x, dcaches, dcross, dbias, dd,
+                                    dH, draft.d_inner,
+                                    prefix=draft.prefix)
+            if j == k:
+                # the cache-fill-only step: position step+k's KV is
+                # written (the full-acceptance hole), no proposal
+                break
+            dlogits = layers.fc(
+                layers.reshape(x, [0, dd]), vocab, bias_attr=False,
+                param_attr=f"{draft.prefix}logits.w")      # [R,V]
+            dprobs = layers.filtered_softmax(
+                dlogits, temperature=samp.temperature,
+                top_k=samp.top_k, top_p=samp.top_p)
+            if greedy:
+                tok_j = layers.cast(
+                    layers.argmax(dprobs, axis=-1), "int64")
+            else:
+                tok_j = layers.sample_categorical(
+                    dprobs, seedv,
+                    layers.elementwise_add(
+                        stepj, layers.fill_constant([1], "int64",
+                                                    1.0)),
+                    noise_tag=1, base_seed=samp.base_seed)
+            proposals.append(tok_j)
+            dprob_rows.append(layers.unsqueeze(dprobs, [1]))
+            prev = layers.reshape(tok_j, [rows, 1])
+
+        # ---- target verify: ONE batched Q-query cached step over
+        # [current token, k proposals] ----
+        toks_q = layers.concat(
+            [cur_tok] + [layers.reshape(t, [rows, 1])
+                         for t in proposals], axis=1)      # [R,Q]
+        x = layers.embedding(toks_q, size=[vocab, d_model],
+                             param_attr=ParamAttr(
+                                 name="tgt_word_emb"))     # [R,Q,D]
+        x = layers.scale(x, scale=d_model ** 0.5)
+        posq = layers.elementwise_add(
+            step2, layers.assign(np.arange(Q).astype("int64")))
+        posq3 = layers.reshape(posq, [rows, Q, 1])
+        t_mask_q = layers.cast(layers.equal(positions, posq3),
+                               "float32")                  # [R,Q,maxT]
+        x = layers.elementwise_add(
+            x, layers.matmul(t_mask_q, pos_table))         # [R,Q,D]
+        # per-query causal validity: query j attends positions
+        # <= step+j (positions past the buffer get all-zero one-hots
+        # and never write — see the span caches)
+        bias = layers.reshape(
+            layers.scale(layers.cast(layers.greater_than(
+                posf, layers.cast(posq3, "float32")), "float32"),
+                scale=-1e9),
+            [rows, 1, Q, maxT])
+        if not paged:
+            keep = layers.reshape(
+                layers.elementwise_sub(
+                    layers.fill_constant([rows, maxT], "float32",
+                                         1.0),
+                    layers.reduce_sum(t_mask_q, dim=1)),
+                [rows, 1, maxT, 1])
+            caches = [_DenseSpanCache(
+                sv[f"{state_prefix}self_k{li}"],
+                sv[f"{state_prefix}self_v{li}"], t_mask_q, keep)
+                for li in range(n_layers)]
+            cross_kv = [(sv[f"{state_prefix}cross_k{li}"],
+                         sv[f"{state_prefix}cross_v{li}"])
+                        for li in range(n_layers)]
+        else:
+            tabf = layers.cast(sv[f"{state_prefix}block_tab"],
+                               "float32")                  # [R,NP]
+            base = layers.expand(
+                layers.unsqueeze(layers.scale(tabf, scale=float(BS)),
+                                 [2]),
+                [1, 1, BS])                                # [R,NP,BS]
+            offs = layers.assign(np.arange(BS, dtype="float32"))
+            flat_pos = layers.cast(
+                layers.reshape(
+                    layers.elementwise_add(base, offs, axis=2),
+                    [rows * maxT]), "int32")
+            t_pages_q = layers.reshape(t_mask_q, [rows, Q, NP, BS])
+            page_oh = layers.reduce_sum(t_pages_q, dim=3)  # [R,Q,NP]
+            off_oh = layers.reduce_sum(t_pages_q, dim=2)   # [R,Q,BS]
+            cur_block = layers.reduce_sum(
+                layers.elementwise_mul(layers.unsqueeze(tabf, [1]),
+                                       page_oh), dim=2)    # [R,Q]
+            cur_off = layers.reduce_sum(
+                layers.elementwise_mul(off_oh, offs), dim=2)
+            write_idx = layers.cast(
+                layers.reshape(
+                    layers.elementwise_add(
+                        layers.scale(cur_block, scale=float(BS)),
+                        cur_off), [rows * Q]), "int32")
+            # gate = active AND position-in-buffer: an out-of-range
+            # query's one-hot is all-zero, which would otherwise
+            # alias cell 0 of block 0 — another lane's KV
+            validq = layers.reduce_sum(t_mask_q, dim=2)    # [R,Q]
+            gate = layers.reshape(
+                layers.elementwise_mul(
+                    layers.reshape(layers.cast(act, "float32"),
+                                   [rows, 1]), validq), [rows * Q])
+            caches = [_PagedSpanCache(
+                sv[f"{state_prefix}self_k{li}{POOL_MARK}"],
+                sv[f"{state_prefix}self_v{li}{POOL_MARK}"],
+                write_idx, gate, flat_pos, rows, Q, n_heads,
+                head_dim, maxT, NB * BS) for li in range(n_layers)]
+            pref = sv[f"{state_prefix}prompt_ref"]
+            cross_kv = []
+            for li in range(n_layers):
+                pair = []
+                for tag in ("k", "v"):
+                    pool = sv[f"{state_prefix}cross_{tag}{li}"
+                              f"{POOL_MARK}"]
+                    flat = layers.reshape(
+                        pool, [E + 1, n_heads * seq_len * head_dim])
+                    got = layers.gather(flat, pref)
+                    pair.append(layers.reshape(
+                        got, [rows, n_heads, seq_len, head_dim]))
+                cross_kv.append(tuple(pair))
+        x = cached_decoder_step(x, caches, cross_kv, bias, d_model,
+                                n_heads, d_inner, q=Q)     # [R,Q,D]
+        logits_q = layers.fc(x, vocab, num_flatten_dims=2,
+                             bias_attr=False,
+                             param_attr="logits.w")        # [R,Q,V]
+        tprobs = layers.filtered_softmax(
+            logits_q, temperature=samp.temperature,
+            top_k=samp.top_k, top_p=samp.top_p)
+        dprobs_s = layers.concat(dprob_rows, axis=1)       # [R,k,V]
+        props = layers.concat(
+            [layers.reshape(t, [rows, 1]) for t in proposals],
+            axis=1)                                        # [R,k]
+        adv, toks, accepted, fin_new = layers.spec_accept(
+            props, dprobs_s, tprobs, seedv, stepv, k=k,
+            end_id=end_id, max_len=maxT, greedy=greedy,
+            base_seed=samp.base_seed, noise_tag=8)
+        adv_g = layers.elementwise_mul(adv, act)           # [R]
+        layers.span_scatter(tok_buf, toks,
+                            layers.elementwise_add(stepv, ones_n),
+                            adv_g)
+        new_fin = layers.elementwise_max(
+            fin, layers.elementwise_mul(fin_new, act))
+        new_step = layers.elementwise_add(stepv, adv_g)
+        room = layers.cast(layers.less_than(
+            new_step, layers.fill_constant([1], "int64",
+                                           float(maxT - 1))),
+            "int64")
+        new_act = layers.elementwise_mul(
+            layers.elementwise_mul(
+                act, layers.elementwise_sub(ones_n, new_fin)), room)
+        # ---- device-side speculative accounting (the serving layer
+        # deltas these per dispatch). Computed BEFORE the state
+        # assigns: the in-place act update below would otherwise feed
+        # the POST-tick mask into this tick's live/accepted sums ----
+        live = layers.reduce_sum(act, keep_dim=True)       # [1]
+        k_const = layers.fill_constant([1], "int64", float(k))
+        one_c = layers.fill_constant([1], "int64", 1.0)
+        for name, delta in (
+                ("spec_proposed",
+                 layers.elementwise_mul(live, k_const)),
+                ("spec_accepted",
+                 layers.reduce_sum(
+                     layers.elementwise_mul(accepted, act),
+                     keep_dim=True)),
+                ("spec_emitted",
+                 layers.reduce_sum(adv_g, keep_dim=True)),
+                ("spec_draft_steps", k_const),
+                ("spec_target_steps", one_c)):
+            var = sv[f"{state_prefix}{name}"]
+            layers.assign(layers.elementwise_add(var, delta),
+                          output=var)
+        layers.assign(new_step, output=stepv)
+        layers.assign(new_act, output=act)
+        layers.assign(new_fin, output=fin)
+
+    body = _spec_step_body if spec else _step_body
+
     # --- standalone single-step program (one tick = one dispatch;
     # also the Executor.prepare(steps=K) scan target) ----------------
     step_prog = fluid.Program()
     with fluid.program_guard(step_prog, fluid.Program()):
-        _step_body(_declare_slot_state(step_prog.global_block, specs))
+        body(_declare_slot_state(step_prog.global_block, specs))
 
     # --- fused serve programs: [admission +] a decode-burst While —
     # a WHOLE scheduler cycle (admit + burst) is ONE dispatch, so the
@@ -1127,7 +1792,7 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             cond = _serve_cond()
             w = layers.While(cond)
             with w.block():
-                _step_body(sv)
+                body(sv)
                 layers.increment(k, 1)
                 _serve_cond(cond=cond)
         return prog
@@ -1147,13 +1812,160 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
     if paged:
         state["block_tab"] = f"{state_prefix}block_tab"
         state["prompt_ref"] = f"{state_prefix}prompt_ref"
+    if needs_seeds:
+        state["seed"] = f"{state_prefix}seed"
+    if spec:
+        for c in ("spec_proposed", "spec_accepted", "spec_emitted",
+                  "spec_draft_steps", "spec_target_steps"):
+            state[c] = f"{state_prefix}{c}"
     bundle = DecodeStepBundle(prefills, step_prog, serves, startup,
                               state, n_slots, seq_len, maxT, start_id,
                               end_id, cache=cache,
-                              hit_prefills=hit_prefills)
+                              hit_prefills=hit_prefills,
+                              sampling=sampling, draft=draft)
     bundle._state_specs = {
         n: (shape, dt) for n, (shape, dt) in specs.items()}
     return bundle
+
+
+# ---------------------------------------------------------------------------
+# Beam front (the last decode loop folded in from transformer.py —
+# every decode capability now lives in this module).
+# ---------------------------------------------------------------------------
+def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
+                              n_heads=4, n_layers=2, d_inner=128,
+                              vocab=1000, start_id=0, end_id=1,
+                              beam_size=4, batch_size=1):
+    """Batched beam-search generation (reference
+    tests/unittests/dist_transformer.py:1523 beam_search inside
+    fast_decode). Beams ride the batch axis at static
+    [batch*beam, maxT] shapes (batch-major blocks of beam rows, the
+    beam_search op's row layout): every step runs the causally-masked
+    decoder over all rows, expands per-source with the beam_search op
+    (accumulated log-probs, EOS freezing), reorders each hypothesis'
+    token history by absolute parent_idx, and backtracks with
+    beam_search_decode.
+
+    Weight sharing: the explicit enc{i}_*/dec{i}_*/logits.w names.
+    Returns (program, startup, feeds, (sentence_ids
+    [T, batch*beam], sentence_scores [batch*beam])).
+    """
+    import paddle_tpu as fluid
+
+    from . import transformer as T
+
+    maxT = max_out_len
+    rows = batch_size * beam_size
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        # static-batch program so build-time probes agree with the
+        # concrete [rows, ...] vars downstream
+        src = layers.data("src_ids", shape=[batch_size, seq_len],
+                          dtype="int64", append_batch_size=False)
+        enc1 = T._embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
+                        True, "src_word_emb")
+        for li in range(n_layers):
+            enc1 = T.encoder_layer(enc1, d_model, n_heads, d_inner,
+                                   0.0, is_test=True, name=f"enc{li}")
+        # repeat each source's encoding beam_size times consecutively
+        # ([B,S,D] -> [B,beam,S,D] -> [B*beam,S,D], batch-major rows)
+        enc = layers.reshape(
+            layers.expand(layers.unsqueeze(enc1, [1]),
+                          [1, beam_size, 1, 1]),
+            [rows, seq_len, d_model])
+
+        positions = layers.cast(layers.range(0, maxT, 1), "int64")
+        # per-hypothesis token history [rows, maxT], GO at position 0
+        tgt_buf = layers.assign(layers.fill_constant(
+            [rows, maxT], "int64", 0.0))
+        if start_id:
+            start_col = layers.cast(
+                layers.equal(positions,
+                             layers.fill_constant([1], "int64", 0.0)),
+                "int64")
+            tgt_buf = layers.assign(layers.elementwise_add(
+                tgt_buf, layers.cast(
+                    layers.scale(start_col, scale=float(start_id)),
+                    "int64")))
+        pre_ids = layers.assign(layers.fill_constant(
+            [rows, 1], "int64", float(start_id)))
+        # ONE live beam per source at step 0 (the reference's LoD
+        # single-seed): identical rows with equal scores would make
+        # per-block top-k pick beam_size copies of the same argmax and
+        # the beams would never diverge (degenerate greedy)
+        pre_scores = layers.assign(np.where(
+            np.arange(rows) % beam_size == 0, 0.0,
+            -1e9).astype("float32").reshape(rows, 1))
+        # step buffers for the backtrack [maxT, rows, 1]
+        ids_buf = layers.assign(layers.fill_constant(
+            [maxT, rows, 1], "int64", float(end_id)))
+        scores_buf = layers.assign(layers.fill_constant(
+            [maxT, rows, 1], "float32", 0.0))
+        parents_buf = layers.assign(layers.fill_constant(
+            [maxT, rows, 1], "int64", 0.0))
+        zero = layers.fill_constant([1], "int64", 0)
+        ids_buf = layers.assign(layers.scatter(
+            ids_buf, zero, layers.reshape(pre_ids, [1, rows, 1])))
+
+        counter = layers.fill_constant([1], "int64", 0)
+        limit = layers.fill_constant([1], "int64", float(maxT - 1))
+        cond = layers.less_than(counter, limit)
+        w = layers.While(cond)
+        with w.block():
+            dec = T._embed(tgt_buf, vocab, d_model,
+                           max(seq_len, maxT), 0.0, True,
+                           "tgt_word_emb")
+            for li in range(n_layers):
+                dec = T.decoder_layer(dec, enc, d_model, n_heads,
+                                      d_inner, 0.0, is_test=True,
+                                      name=f"dec{li}")
+            logits_v = step_logits(dec, positions, counter,
+                                   vocab)  # [rows, V]
+            probs = layers.softmax(logits_v)  # [rows, V]
+            topk_scores, topk_ids = layers.topk(
+                probs, min(2 * beam_size, vocab))
+            acc = layers.elementwise_add(layers.log(topk_scores),
+                                         pre_scores)
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, topk_ids, acc,
+                beam_size=beam_size, end_id=end_id,
+                return_parent_idx=True)
+            parent_flat = layers.reshape(parent, shape=[rows])
+            # each surviving hypothesis inherits its parent's history
+            layers.assign(layers.gather(tgt_buf, parent_flat),
+                          output=tgt_buf)
+            layers.increment(counter, 1)
+            next_mask = layers.cast(layers.equal(positions, counter),
+                                    "int64")
+            keep = layers.elementwise_sub(
+                layers.fill_constant([maxT], "int64", 1.0), next_mask)
+            layers.assign(layers.elementwise_add(
+                layers.elementwise_mul(tgt_buf, keep),
+                layers.elementwise_mul(
+                    layers.reshape(sel_ids, [rows, 1]),
+                    next_mask)), output=tgt_buf)
+            layers.assign(layers.scatter(
+                ids_buf, counter,
+                layers.reshape(sel_ids, [1, rows, 1])),
+                output=ids_buf)
+            layers.assign(layers.scatter(
+                scores_buf, counter,
+                layers.reshape(sel_scores, [1, rows, 1])),
+                output=scores_buf)
+            layers.assign(layers.scatter(
+                parents_buf, counter,
+                layers.reshape(parent, [1, rows, 1])),
+                output=parents_buf)
+            layers.assign(layers.reshape(sel_ids, [rows, 1]),
+                          output=pre_ids)
+            layers.assign(layers.reshape(sel_scores, [rows, 1]),
+                          output=pre_scores)
+            layers.less_than(counter, limit, cond=cond)
+        out_ids, out_scores = layers.beam_search_decode(
+            ids_buf, scores_buf, beam_size=beam_size, end_id=end_id,
+            parents=parents_buf)
+    return main, startup, ["src_ids"], (out_ids, out_scores)
 
 
 # ---------------------------------------------------------------------------
@@ -1295,10 +2107,12 @@ class PromptPrefixCache:
         return sum(1 for r in self._refs.values() if r > 0)
 
 
-__all__ = ["CacheConfig", "DecodeStepBundle", "DECODE_STEPS_VAR",
+__all__ = ["CacheConfig", "SamplingConfig", "DraftConfig",
+           "DecodeStepBundle", "DECODE_STEPS_VAR",
            "POOL_MARK", "BlockPoolExhausted", "HostBlockPool",
            "PromptPrefixCache", "build_greedy_decode_program",
            "build_incremental_decode_program",
-           "build_decode_step_program", "cached_decoder_step",
+           "build_decode_step_program", "build_beam_decode_program",
+           "cached_decoder_step",
            "step_logits", "init_token_buffer", "emit_token_step",
            "heads_of"]

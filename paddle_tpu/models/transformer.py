@@ -26,7 +26,9 @@ from . import decode_engine
 # existing call site (tests, benches, analysis targets) keeps
 # importing it from here
 from .decode_engine import (DECODE_STEPS_VAR, CacheConfig,  # noqa: F401
-                            DecodeStepBundle,
+                            DecodeStepBundle, DraftConfig,
+                            SamplingConfig,
+                            build_beam_decode_program,
                             build_decode_step_program,
                             build_greedy_decode_program,
                             build_incremental_decode_program)
@@ -197,28 +199,35 @@ def _embed(ids, vocab_size, d_model, max_len, dropout_rate, is_test,
 def transformer(src_ids, tgt_ids, label, src_vocab=30000, tgt_vocab=30000,
                 max_len=256, d_model=512, n_heads=8, n_layers=6,
                 d_inner=2048, dropout_rate=0.1, is_test=False,
-                label_smooth_eps=0.1, checkpoints=None):
+                label_smooth_eps=0.1, checkpoints=None,
+                name_prefix=""):
     """Returns (avg_cost, logits). src_ids/tgt_ids: [B,T] int64;
     label: [B,T] int64 (next-token targets). When `checkpoints` is a
     list, each layer output is appended to it (for
-    RecomputeOptimizer-style activation checkpointing)."""
+    RecomputeOptimizer-style activation checkpointing).
+    ``name_prefix`` prefixes EVERY parameter name (enc/dec layers,
+    embeddings, logits) — how a speculative DRAFT model trains
+    weights that co-reside with the target's in one scope without
+    aliasing (decode_engine.DraftConfig.prefix; the PTA100
+    contract)."""
     ck = checkpoints
+    p = name_prefix
     enc = _embed(src_ids, src_vocab, d_model, max_len, dropout_rate,
-                 is_test, "src_word_emb")
+                 is_test, f"{p}src_word_emb")
     for li in range(n_layers):
         enc = encoder_layer(enc, d_model, n_heads, d_inner,
-                            dropout_rate, is_test, name=f"enc{li}")
+                            dropout_rate, is_test, name=f"{p}enc{li}")
         if ck is not None:
             ck.append(enc)
     dec = _embed(tgt_ids, tgt_vocab, d_model, max_len, dropout_rate,
-                 is_test, "tgt_word_emb")
+                 is_test, f"{p}tgt_word_emb")
     for li in range(n_layers):
         dec = decoder_layer(dec, enc, d_model, n_heads, d_inner,
-                            dropout_rate, is_test, name=f"dec{li}")
+                            dropout_rate, is_test, name=f"{p}dec{li}")
         if ck is not None:
             ck.append(dec)
     logits = layers.fc(dec, tgt_vocab, num_flatten_dims=2,
-                       bias_attr=False, param_attr="logits.w")
+                       bias_attr=False, param_attr=f"{p}logits.w")
     # fused smoothing: same math as one_hot+label_smooth+soft-label CE
     # but never materializes the [B,T,V] one-hot (HBM-bound at 32k vocab)
     cost = layers.softmax_with_cross_entropy(
@@ -232,7 +241,7 @@ def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
                   n_layers=6, d_inner=2048, vocab=30000,
                   learning_rate=2.0, warmup_steps=4000,
                   with_optimizer=True, dropout_rate=0.1,
-                  recompute=False):
+                  recompute=False, name_prefix=""):
     import paddle_tpu as fluid
 
     main = fluid.Program()
@@ -246,7 +255,8 @@ def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
             src, tgt, label, src_vocab=vocab, tgt_vocab=vocab,
             max_len=max(seq_len, 256), d_model=d_model, n_heads=n_heads,
             n_layers=n_layers, d_inner=d_inner,
-            dropout_rate=dropout_rate, checkpoints=ck)
+            dropout_rate=dropout_rate, checkpoints=ck,
+            name_prefix=name_prefix)
         if with_optimizer:
             lr = layers.learning_rate_scheduler.noam_decay(
                 d_model, warmup_steps)
@@ -259,134 +269,7 @@ def build_program(batch_size=None, seq_len=64, d_model=512, n_heads=8,
     return main, startup, avg_cost
 
 
-def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
-                              n_heads=4, n_layers=2, d_inner=128,
-                              vocab=1000, start_id=0, end_id=1,
-                              beam_size=4, batch_size=1):
-    """Batched beam-search generation (reference
-    tests/unittests/dist_transformer.py:1523 beam_search inside
-    fast_decode). Beams ride the batch axis at static
-    [batch*beam, maxT] shapes (batch-major blocks of beam rows, the
-    beam_search op's row layout): every step runs the causally-masked
-    decoder over all rows, expands per-source with the beam_search op
-    (accumulated log-probs, EOS freezing), reorders each hypothesis'
-    token history by absolute parent_idx, and backtracks with
-    beam_search_decode.
-
-    Weight sharing: the explicit enc{i}_*/dec{i}_*/logits.w names.
-    Returns (program, startup, feeds, (sentence_ids
-    [T, batch*beam], sentence_scores [batch*beam])).
-    """
-    import paddle_tpu as fluid
-
-    maxT = max_out_len
-    rows = batch_size * beam_size
-    main = fluid.Program()
-    startup = fluid.Program()
-    with fluid.program_guard(main, startup):
-        # static-batch program so build-time probes agree with the
-        # concrete [rows, ...] vars downstream
-        src = layers.data("src_ids", shape=[batch_size, seq_len],
-                          dtype="int64", append_batch_size=False)
-        enc1 = _embed(src, vocab, d_model, max(seq_len, maxT), 0.0,
-                      True, "src_word_emb")
-        for li in range(n_layers):
-            enc1 = encoder_layer(enc1, d_model, n_heads, d_inner, 0.0,
-                                 is_test=True, name=f"enc{li}")
-        # repeat each source's encoding beam_size times consecutively
-        # ([B,S,D] -> [B,beam,S,D] -> [B*beam,S,D], batch-major rows)
-        enc = layers.reshape(
-            layers.expand(layers.unsqueeze(enc1, [1]),
-                          [1, beam_size, 1, 1]),
-            [rows, seq_len, d_model])
-
-        positions = layers.cast(layers.range(0, maxT, 1), "int64")
-        # per-hypothesis token history [rows, maxT], GO at position 0
-        tgt_buf = layers.assign(layers.fill_constant(
-            [rows, maxT], "int64", 0.0))
-        if start_id:
-            start_col = layers.cast(
-                layers.equal(positions,
-                             layers.fill_constant([1], "int64", 0.0)),
-                "int64")
-            tgt_buf = layers.assign(layers.elementwise_add(
-                tgt_buf, layers.cast(
-                    layers.scale(start_col, scale=float(start_id)),
-                    "int64")))
-        pre_ids = layers.assign(layers.fill_constant(
-            [rows, 1], "int64", float(start_id)))
-        # ONE live beam per source at step 0 (the reference's LoD
-        # single-seed): identical rows with equal scores would make
-        # per-block top-k pick beam_size copies of the same argmax and
-        # the beams would never diverge (degenerate greedy)
-        pre_scores = layers.assign(np.where(
-            np.arange(rows) % beam_size == 0, 0.0,
-            -1e9).astype("float32").reshape(rows, 1))
-        # step buffers for the backtrack [maxT, rows, 1]
-        ids_buf = layers.assign(layers.fill_constant(
-            [maxT, rows, 1], "int64", float(end_id)))
-        scores_buf = layers.assign(layers.fill_constant(
-            [maxT, rows, 1], "float32", 0.0))
-        parents_buf = layers.assign(layers.fill_constant(
-            [maxT, rows, 1], "int64", 0.0))
-        zero = layers.fill_constant([1], "int64", 0)
-        ids_buf = layers.assign(layers.scatter(
-            ids_buf, zero, layers.reshape(pre_ids, [1, rows, 1])))
-
-        counter = layers.fill_constant([1], "int64", 0)
-        limit = layers.fill_constant([1], "int64", float(maxT - 1))
-        cond = layers.less_than(counter, limit)
-        w = layers.While(cond)
-        with w.block():
-            dec = _embed(tgt_buf, vocab, d_model, max(seq_len, maxT),
-                         0.0, True, "tgt_word_emb")
-            for li in range(n_layers):
-                dec = decoder_layer(dec, enc, d_model, n_heads,
-                                    d_inner, 0.0, is_test=True,
-                                    name=f"dec{li}")
-            step_logits = decode_engine.step_logits(
-                dec, positions, counter, vocab)  # [rows, V]
-            probs = layers.softmax(step_logits)  # [rows, V]
-            topk_scores, topk_ids = layers.topk(
-                probs, min(2 * beam_size, vocab))
-            acc = layers.elementwise_add(layers.log(topk_scores),
-                                         pre_scores)
-            sel_ids, sel_scores, parent = layers.beam_search(
-                pre_ids, pre_scores, topk_ids, acc,
-                beam_size=beam_size, end_id=end_id,
-                return_parent_idx=True)
-            parent_flat = layers.reshape(parent, shape=[rows])
-            # each surviving hypothesis inherits its parent's history
-            layers.assign(layers.gather(tgt_buf, parent_flat),
-                          output=tgt_buf)
-            layers.increment(counter, 1)
-            next_mask = layers.cast(layers.equal(positions, counter),
-                                    "int64")
-            keep = layers.elementwise_sub(
-                layers.fill_constant([maxT], "int64", 1.0), next_mask)
-            layers.assign(layers.elementwise_add(
-                layers.elementwise_mul(tgt_buf, keep),
-                layers.elementwise_mul(
-                    layers.reshape(sel_ids, [rows, 1]),
-                    next_mask)), output=tgt_buf)
-            layers.assign(layers.scatter(
-                ids_buf, counter,
-                layers.reshape(sel_ids, [1, rows, 1])),
-                output=ids_buf)
-            layers.assign(layers.scatter(
-                scores_buf, counter,
-                layers.reshape(sel_scores, [1, rows, 1])),
-                output=scores_buf)
-            layers.assign(layers.scatter(
-                parents_buf, counter,
-                layers.reshape(parent, [1, rows, 1])),
-                output=parents_buf)
-            layers.assign(layers.reshape(sel_ids, [rows, 1]),
-                          output=pre_ids)
-            layers.assign(layers.reshape(sel_scores, [rows, 1]),
-                          output=pre_scores)
-            layers.less_than(counter, limit, cond=cond)
-        out_ids, out_scores = layers.beam_search_decode(
-            ids_buf, scores_buf, beam_size=beam_size, end_id=end_id,
-            parents=parents_buf)
-    return main, startup, ["src_ids"], (out_ids, out_scores)
+# build_beam_decode_program moved to decode_engine (the last decode
+# loop folded in — ROADMAP "one decode engine, three fronts"); the
+# re-export above keeps every call site and the public signature
+# unchanged.

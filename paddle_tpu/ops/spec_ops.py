@@ -1,0 +1,245 @@
+"""Sampling-lane + speculative-decode kernels (models/decode_engine.py).
+
+Reference counterpart: none — the reference framework's decode surface
+is greedy/beam only (reference tests/unittests/dist_transformer.py:1498
+fast_decode). The temperature/top-k/top-p lanes follow the standard
+serving samplers; the draft-and-verify acceptance follows Leviathan et
+al.'s speculative sampling (the vLLM spec-decode worker's rejection
+rule), re-designed for XLA static shapes: the whole accept/advance
+decision is ONE pure kernel over [R, k(+1), V] stacks so the hairy
+per-lane math lives in one numpy-oracle-testable surface instead of a
+fifty-op layer composition.
+
+Noise discipline (deliberate deviation from the `(step key, op._uid)`
+chain the training-time sampling ops use, CLAUDE.md invariant): serving
+emission noise must be invariant to WHICH serve specialization
+processes a position — admission order, burst boundaries, and paged
+recompute-preemption all change the dispatch sequence, and byte-exact
+re-decode of a preempted lane requires the noise at (request, position)
+to be a pure function of those two. So every draw here derives from
+``fold_in`` chains over (base_seed attr, noise_tag attr, per-lane Seed,
+per-lane Pos) — never the advancing executor step key and never the
+op's uid (the same logical draw appears in MANY programs of one serve
+bundle, each with different uids). The ops still register
+``needs_rng=True`` so the PTA030 uid sweep covers them; tag separation
+(draft/accept/residual/bonus draws use distinct tags) is the builder's
+half of the non-collision contract.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+# draw-purpose tags folded into the key chain on TOP of the builder's
+# noise_tag: the same (seed, pos) must give INDEPENDENT draws for the
+# draft proposal, the acceptance uniform, and the residual/bonus sample
+_TAG_ACCEPT = 101
+_TAG_RESID = 102
+
+
+def _base_key(base_seed: int, tag: int):
+    return jax.random.fold_in(
+        jax.random.PRNGKey(int(base_seed) & 0x7FFFFFFF), int(tag))
+
+
+def _lane_keys(base, seed, pos):
+    """[R] (or [R, J]) PRNG keys: fold_in(fold_in(base, seed), pos),
+    vmapped over lanes (and positions). Pure in (seed, pos) — the
+    admission-order / burst-length / preemption-replay invariance the
+    serving layer's byte-exact contracts rest on."""
+    seed = seed.astype(jnp.uint32)
+    pos = pos.astype(jnp.uint32)
+
+    def kf(s, p):
+        return jax.random.fold_in(jax.random.fold_in(base, s), p)
+
+    if pos.ndim == 2:
+        return jax.vmap(jax.vmap(kf, in_axes=(None, 0)))(seed, pos)
+    return jax.vmap(kf)(seed, pos)
+
+
+def _filter_probs(logits, temperature, top_k, top_p):
+    """Temperature/top-k/top-p filtered, renormalized probabilities
+    over the last axis. temperature == 0 is the greedy degenerate
+    case: a one-hot at argmax, which makes greedy acceptance an exact
+    special case of the rejection rule (spec_accept docstring)."""
+    v = logits.shape[-1]
+    if temperature == 0.0:
+        return jax.nn.one_hot(jnp.argmax(logits, axis=-1), v,
+                              dtype=jnp.float32)
+    z = (logits / float(temperature)).astype(jnp.float32)
+    if top_k and 0 < int(top_k) < v:
+        kth = jax.lax.top_k(z, int(top_k))[0][..., -1:]
+        z = jnp.where(z >= kth, z, -jnp.inf)
+    p = jax.nn.softmax(z, axis=-1)
+    if top_p and float(top_p) < 1.0:
+        ps = jnp.sort(p, axis=-1)[..., ::-1]
+        cs = jnp.cumsum(ps, axis=-1)
+        # nucleus: smallest set whose mass reaches top_p (the top-1
+        # token always survives: its exclusive cumsum is 0 < top_p)
+        keep_sorted = (cs - ps) < float(top_p)
+        cutoff = jnp.min(jnp.where(keep_sorted, ps, jnp.inf),
+                         axis=-1, keepdims=True)
+        p = jnp.where(p >= cutoff, p, 0.0)
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return p
+
+
+@register_op("filtered_softmax", differentiable=False,
+             stop_gradient_slots=("X",))
+def filtered_softmax(ctx):
+    """[..., V] logits -> temperature/top-k/top-p filtered normalized
+    probabilities (temperature 0 -> one-hot argmax). attrs:
+    temperature, top_k, top_p."""
+    return _filter_probs(ctx.input("X"),
+                         float(ctx.attr("temperature", 1.0)),
+                         int(ctx.attr("top_k", 0) or 0),
+                         float(ctx.attr("top_p", 1.0)))
+
+
+@register_op("sample_categorical", differentiable=False, needs_rng=True,
+             stop_gradient_slots=("Probs", "Seed", "Pos"))
+def sample_categorical(ctx):
+    """One token per lane from [R, V] probabilities, keyed purely by
+    (base_seed, noise_tag, Seed[r], Pos[r]) — see the module docstring
+    for why the executor step key deliberately stays out."""
+    probs = ctx.input("Probs")
+    seed = ctx.input("Seed").reshape(-1)
+    pos = ctx.input("Pos").reshape(-1)
+    base = _base_key(ctx.attr("base_seed", 0), ctx.attr("noise_tag", 0))
+    keys = _lane_keys(base, seed, pos)
+    logp = jnp.log(probs.astype(jnp.float32) + 1e-20)
+    tok = jax.vmap(jax.random.categorical)(keys, logp)
+    return {"Out": tok.astype(jnp.int64)}
+
+
+@register_op("span_scatter", differentiable=False,
+             stop_gradient_slots=("X", "Vals", "Start", "Count"))
+def span_scatter(ctx):
+    """Write Vals[r, :Count[r]] into Buf[r, Start[r]:Start[r]+Count[r]]
+    per row (in place: Out is the Buf var — the accepted-prefix token
+    write of the speculative step). Rows are disjoint by construction
+    (per-lane buffers), so no pool-exclusivity contract applies."""
+    buf = ctx.input("X")
+    vals = ctx.input("Vals")
+    start = ctx.input("Start").reshape(-1)
+    count = ctx.input("Count").reshape(-1)
+    t = buf.shape[1]
+    w = vals.shape[1]
+    pos = jnp.arange(t)[None, :]
+    rel = pos - start[:, None]
+    sel = (rel >= 0) & (rel < count[:, None]) & (rel < w)
+    relc = jnp.clip(rel, 0, w - 1)
+    vals_at = jnp.take_along_axis(vals, relc, axis=1)
+    return jnp.where(sel, vals_at.astype(buf.dtype), buf)
+
+
+@register_op("spec_accept", differentiable=False, needs_rng=True,
+             stop_gradient_slots=("Proposals", "DraftProbs",
+                                  "TargetProbs", "Seed", "Pos"))
+def spec_accept(ctx):
+    """Draft-and-verify acceptance (Leviathan et al. speculative
+    sampling) for one batched lane step.
+
+    inputs (R lanes, k proposals, vocab V):
+      Proposals   [R, k]      draft tokens for positions Pos+1..Pos+k
+      DraftProbs  [R, k, V]   filtered draft dists those tokens were
+                              drawn from (one-hot under greedy)
+      TargetProbs [R, k+1, V] filtered target dists for positions
+                              Pos+1..Pos+k+1 (the verify step's k+1
+                              query outputs)
+      Seed, Pos   [R]         noise seed / current step counter
+    attrs: k, end_id, max_len, greedy, base_seed, noise_tag.
+
+    Per lane: accept proposal j while u_j * q_j(x_j) < p_j(x_j)
+    (u_j ~ U[0,1) keyed on (seed, pos+1+j) — strict `<` makes the
+    greedy one-hot case exactly deterministic: a match always accepts,
+    a mismatch never does, regardless of u). At the first rejection
+    sample the correction from norm(max(p - q, 0)); with all k
+    accepted, sample the bonus token from p_k. Under attr greedy the
+    correction/bonus is argmax instead of a draw, so greedy
+    speculative decoding is TOKEN-EXACT vs the whole-loop greedy
+    decode (the r10 parity contract).
+
+    The emitted run is then clipped at the first end_id (the lane
+    finishes THERE, matching the whole-loop EOS freeze) and at the
+    buffer room max_len-1 - Pos. outputs:
+      Advance  [R] emitted token count this step (0..k+1, and <= room)
+      Tokens   [R, k+1] emitted tokens (first Advance entries valid)
+      Accepted [R] how many emitted tokens were accepted draft
+                   proposals (the acceptance-rate numerator)
+      Fin      [R] 1 iff the advance ends with end_id
+    """
+    props = ctx.input("Proposals")
+    dprobs = ctx.input("DraftProbs").astype(jnp.float32)
+    tprobs = ctx.input("TargetProbs").astype(jnp.float32)
+    seed = ctx.input("Seed").reshape(-1)
+    pos = ctx.input("Pos").reshape(-1)
+    k = int(ctx.attr("k"))
+    end_id = int(ctx.attr("end_id"))
+    max_len = int(ctx.attr("max_len"))
+    greedy = bool(ctx.attr("greedy", True))
+    base_seed = ctx.attr("base_seed", 0)
+    tag = ctx.attr("noise_tag", 0)
+    r = tprobs.shape[0]
+    v = tprobs.shape[-1]
+
+    posj = pos[:, None] + 1 + jnp.arange(k + 1)[None, :]  # [R, k+1]
+    if k > 0:
+        acc_keys = _lane_keys(
+            _base_key(base_seed, tag + _TAG_ACCEPT), seed,
+            posj[:, :k])
+        u = jax.vmap(jax.vmap(jax.random.uniform))(acc_keys)  # [R,k]
+        px = jnp.take_along_axis(tprobs[:, :k], props[..., None],
+                                 axis=-1)[..., 0]
+        qx = jnp.take_along_axis(dprobs, props[..., None],
+                                 axis=-1)[..., 0]
+        acc = u * qx < px
+        a = jnp.cumprod(acc.astype(jnp.int64), axis=1).sum(axis=1)
+        ai = jnp.clip(a, 0, k - 1)
+        p_a = jnp.take_along_axis(
+            tprobs, ai[:, None, None], axis=1)[:, 0]  # [R, V]
+        q_a = jnp.take_along_axis(
+            dprobs, ai[:, None, None], axis=1)[:, 0]
+        resid = jnp.clip(p_a - q_a, 0.0, None)
+        rs = resid.sum(axis=-1, keepdims=True)
+        resid = jnp.where(rs > 0, resid / jnp.where(rs > 0, rs, 1.0),
+                          p_a)
+    else:
+        a = jnp.zeros((r,), jnp.int64)
+        resid = tprobs[:, 0]
+    bonus = tprobs[:, k]
+    corr_dist = jnp.where((a < k)[:, None], resid, bonus) if k > 0 \
+        else bonus
+    if greedy:
+        corr_tok = jnp.argmax(corr_dist, axis=-1).astype(jnp.int64)
+    else:
+        corr_pos = pos + 1 + a  # the correction lands at this position
+        corr_keys = _lane_keys(
+            _base_key(base_seed, tag + _TAG_RESID), seed, corr_pos)
+        corr_tok = jax.vmap(jax.random.categorical)(
+            corr_keys, jnp.log(corr_dist + 1e-20)).astype(jnp.int64)
+
+    cols = jnp.arange(k + 1)[None, :]
+    if k > 0:
+        toks = jnp.concatenate(
+            [props.astype(jnp.int64), jnp.zeros((r, 1), jnp.int64)],
+            axis=1)
+    else:
+        toks = jnp.zeros((r, 1), jnp.int64)
+    toks = jnp.where(cols == a[:, None], corr_tok[:, None], toks)
+    adv = a + 1
+    # EOS clip: the lane finishes AT its first emitted end_id
+    is_eos = (toks == end_id) & (cols < adv[:, None])
+    eos_any = is_eos.any(axis=1)
+    first_eos = jnp.argmax(is_eos, axis=1)
+    adv = jnp.where(eos_any, first_eos + 1, adv)
+    # room clip: never write past buffer position max_len-1
+    room = jnp.clip(max_len - 1 - pos, 0, k + 1)
+    adv = jnp.minimum(adv, room)
+    fin = (eos_any & (first_eos + 1 <= adv)).astype(jnp.int64)
+    accepted = jnp.minimum(a, adv)
+    return {"Advance": adv.astype(jnp.int64), "Tokens": toks,
+            "Accepted": accepted.astype(jnp.int64), "Fin": fin}

@@ -905,3 +905,70 @@ class TestSharedPoolWrites:
             assert not _diags(bundle.serves[key], "PTA110"), key
         assert not _diags(bundle.step, "PTA110")
         assert not _diags(bundle.prefill, "PTA110")
+
+
+class TestPTA120SpecAdvanceBounded:
+    """spec_accept shape/attr agreement: the counter-advance <= k+1
+    clamp and the accepted-prefix room clip are only provable when
+    the declared k/max_len match the wired tensors (r14)."""
+
+    def _spec_prog(self, k_attr=2, props_w=2, tprobs_w=3, buf_w=16,
+                   max_len=16):
+        main, startup, g = _guarded()
+        with g:
+            props = layers.data("props", shape=[4, props_w],
+                                dtype="int64",
+                                append_batch_size=False)
+            dprobs = layers.data("dprobs", shape=[4, props_w, 8],
+                                 dtype="float32",
+                                 append_batch_size=False)
+            tprobs = layers.data("tprobs", shape=[4, tprobs_w, 8],
+                                 dtype="float32",
+                                 append_batch_size=False)
+            seed = layers.data("seed", shape=[4], dtype="int64",
+                               append_batch_size=False)
+            pos = layers.data("pos", shape=[4], dtype="int64",
+                              append_batch_size=False)
+            adv, toks, acc, fin = layers.spec_accept(
+                props, dprobs, tprobs, seed, pos, k=k_attr,
+                end_id=1, max_len=max_len, greedy=True)
+            buf = main.global_block.create_var(
+                name="@pta120/tok_buf", shape=(4, buf_w),
+                dtype="int64", persistable=True,
+                stop_gradient=True)
+            layers.span_scatter(buf, toks, pos, adv)
+        return main
+
+    def test_negative_consistent_wiring_is_clean(self):
+        assert not _diags(self._spec_prog(), "PTA120")
+
+    def test_positive_k_attr_disagrees_with_proposals(self):
+        ds = _diags(self._spec_prog(k_attr=3, props_w=2,
+                                    tprobs_w=4), "PTA120")
+        assert ds and all(d.severity == ERROR for d in ds)
+        assert any("k=3" in d.message for d in ds)
+
+    def test_positive_target_probs_width_mismatch(self):
+        ds = _diags(self._spec_prog(tprobs_w=2), "PTA120")
+        assert ds and ds[0].severity == ERROR
+
+    def test_positive_scatter_buffer_width_vs_max_len(self):
+        ds = _diags(self._spec_prog(buf_w=8, max_len=16), "PTA120")
+        assert ds and ds[0].severity == ERROR
+        assert "max_len=16" in ds[0].message
+
+    def test_shipped_spec_bundle_is_clean(self):
+        """The real draft-and-verify programs pass the sweep (also
+        pinned by the strict lint zoo)."""
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.models.decode_engine import DraftConfig
+
+        bundle = T.build_decode_step_program(
+            seq_len=8, max_out_len=8, d_model=32, n_heads=2,
+            n_layers=1, d_inner=64, vocab=50, n_slots=2,
+            state_prefix="@pta120b/",
+            draft=DraftConfig(d_model=16, n_heads=2, n_layers=1,
+                              d_inner=32, k=2))
+        for key in (0, 2):
+            assert not _diags(bundle.serves[key], "PTA120"), key
+        assert not _diags(bundle.step, "PTA120")

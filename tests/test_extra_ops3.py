@@ -1164,3 +1164,158 @@ class TestDeformableConvZeroOffsetIsConv:
         a, b = exe.run(prog, feed={"x": xv}, fetch_list=[dc, c])
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# r14 sampling/speculative kernels (ops/spec_ops.py). All are
+# differentiable=False, so these are forward numpy-oracle checks; the
+# stochastic draws are pinned through their DETERMINISTIC regimes
+# (greedy one-hot distributions make spec_accept and
+# sample_categorical exact — the kernel docstrings' design point).
+# ---------------------------------------------------------------------------
+def _np_filtered_softmax(logits, temperature, top_k, top_p):
+    v = logits.shape[-1]
+    if temperature == 0.0:
+        out = np.zeros_like(logits, dtype=np.float32)
+        np.put_along_axis(out, logits.argmax(-1)[..., None], 1.0, -1)
+        return out
+    z = (logits / temperature).astype(np.float32)
+    if top_k and 0 < top_k < v:
+        kth = np.sort(z, axis=-1)[..., -top_k][..., None]
+        z = np.where(z >= kth, z, -np.inf)
+    e = np.exp(z - np.nanmax(np.where(np.isfinite(z), z, np.nan),
+                             axis=-1, keepdims=True))
+    e = np.where(np.isfinite(z), e, 0.0)
+    p = e / e.sum(-1, keepdims=True)
+    if top_p and top_p < 1.0:
+        ps = np.sort(p, axis=-1)[..., ::-1]
+        cs = np.cumsum(ps, axis=-1)
+        keep = (cs - ps) < top_p
+        cutoff = np.min(np.where(keep, ps, np.inf), axis=-1,
+                        keepdims=True)
+        p = np.where(p >= cutoff, p, 0.0)
+        p = p / p.sum(-1, keepdims=True)
+    return p
+
+
+class TestFilteredSoftmaxGreedy(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "filtered_softmax"
+        x = np.random.RandomState(3).randn(4, 9).astype(np.float32)
+        self.inputs = {"X": x}
+        self.attrs = {"temperature": 0.0}
+        self.outputs = {"Out": _np_filtered_softmax(x, 0.0, 0, 1.0)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestFilteredSoftmaxTopKTopP(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "filtered_softmax"
+        x = np.random.RandomState(5).randn(6, 11).astype(np.float32)
+        self.attrs = {"temperature": 1.7, "top_k": 5, "top_p": 0.8}
+        self.inputs = {"X": x}
+        self.outputs = {"Out": _np_filtered_softmax(x, 1.7, 5, 0.8)}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestSampleCategoricalDegenerate(OpTest):
+    """One-hot distributions: the categorical draw is (for every
+    practical key) the hot index — the exact property greedy
+    speculative decoding's token-exactness rests on."""
+
+    def setUp(self):
+        super().setUp()
+        self.op_type = "sample_categorical"
+        hot = np.array([2, 0, 5, 5], np.int64)
+        probs = np.zeros((4, 6), np.float32)
+        probs[np.arange(4), hot] = 1.0
+        self.inputs = {"Probs": probs,
+                       "Seed": np.array([7, 8, 9, 9], np.int64),
+                       "Pos": np.array([1, 2, 3, 4], np.int64)}
+        self.attrs = {"noise_tag": 3, "base_seed": 11}
+        self.outputs = {"Out": hot}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSpanScatter(OpTest):
+    def setUp(self):
+        super().setUp()
+        self.op_type = "span_scatter"
+        buf = np.arange(24, dtype=np.int64).reshape(3, 8)
+        vals = np.array([[90, 91, 92], [80, 81, 82],
+                         [70, 71, 72]], np.int64)
+        start = np.array([2, 6, 0], np.int64)
+        count = np.array([3, 0, 4], np.int64)  # row2: count > width
+        want = buf.copy()
+        want[0, 2:5] = [90, 91, 92]
+        want[2, 0:3] = [70, 71, 72]  # clipped at vals width 3
+        self.inputs = {"X": buf, "Vals": vals, "Start": start,
+                       "Count": count}
+        self.outputs = {"Out": want}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSpecAcceptGreedy(OpTest):
+    """Greedy (one-hot) acceptance oracle covering the edge cases:
+    full acceptance + bonus, first-position rejection, EOS clip
+    INSIDE the accepted prefix, EOS at the bonus slot, and the
+    buffer-room clip."""
+
+    def setUp(self):
+        super().setUp()
+        self.op_type = "spec_accept"
+        K, V, END, MAXL = 3, 7, 1, 16
+
+        def oh(rows):
+            out = np.zeros((len(rows), len(rows[0]), V), np.float32)
+            for r, toks in enumerate(rows):
+                for j, t in enumerate(toks):
+                    out[r, j, t] = 1.0
+            return out
+
+        props = np.array([
+            [4, 5, 6],   # r0: all accepted, bonus 3 -> adv 4
+            [4, 5, 6],   # r1: target wants 2 at j=0 -> adv 1, tok 2
+            [4, 1, 6],   # r2: accepts 4 then EOS at j=1 -> adv 2, fin
+            [4, 5, 6],   # r3: all accepted, BONUS is EOS -> adv 4, fin
+            [4, 5, 6],   # r4: room clip (pos=13 -> room 2) -> adv 2
+        ], np.int64)
+        tprobs = oh([[4, 5, 6, 3],
+                     [2, 5, 6, 3],
+                     [4, 1, 6, 3],
+                     [4, 5, 6, 1],
+                     [4, 5, 6, 3]])
+        dprobs = oh([p for p in props])
+        pos = np.array([0, 0, 0, 0, 13], np.int64)
+        self.inputs = {"Proposals": props, "DraftProbs": dprobs,
+                       "TargetProbs": tprobs,
+                       "Seed": np.arange(5, dtype=np.int64),
+                       "Pos": pos}
+        self.attrs = {"k": K, "end_id": END, "max_len": MAXL,
+                      "greedy": True, "base_seed": 0, "noise_tag": 0}
+        self.outputs = {
+            "Advance": np.array([4, 1, 2, 4, 2], np.int64),
+            "Tokens": np.array([
+                [4, 5, 6, 3],
+                [2, 5, 6, 0],   # correction replaces slot 0
+                [4, 1, 6, 3],   # EOS proposal is ACCEPTED (a=3, the
+                #                 bonus fills slot 3); the clip only
+                #                 shortens Advance/latches Fin
+                [4, 5, 6, 1],
+                [4, 5, 6, 3]], np.int64),
+            "Accepted": np.array([3, 0, 2, 3, 2], np.int64),
+            "Fin": np.array([0, 0, 1, 1, 0], np.int64),
+        }
+
+    def test_output(self):
+        self.check_output()

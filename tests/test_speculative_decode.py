@@ -1,0 +1,389 @@
+"""Speculative draft-and-verify decoding + sampling lanes
+(models/decode_engine.py DraftConfig/SamplingConfig,
+inference/serving.py spec stats; ops/spec_ops.py kernels).
+
+The invariants the r14 design must hold:
+
+* GREEDY speculative decoding is TOKEN-EXACT vs the whole-loop
+  incremental decode — the acceptance rule degenerates exactly, so
+  the r10/r13 parity harness carries over: slot reuse, admission-order
+  permutations, burst lengths, and the PAGED layout (the multi-position
+  verify scatter must respect lane exclusivity);
+* SAMPLED lanes are keyed purely on (per-request seed, position):
+  bit-identical reproduction across admission-order permutations and
+  repeated submission, while distinct seeds actually vary the stream
+  and the modal sample sits on the model's greedy mode;
+* the device-side acceptance counters have honest UNITS (emitted ==
+  generated tokens, draft_steps == k * target_steps, accepted <=
+  proposed);
+* k=0 degenerates to the plain one-token r10 path;
+* 100-request churn compiles NOTHING after warmup;
+* fingerprints separate spec/sampled/plain bundles (never dedupe or
+  hot-swap as the same model), and a draft prefix colliding with the
+  target's params is REFUSED at build (PTA100 pair lint).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import (ContinuousGenerationServer,
+                                  PagedContinuousGenerationServer,
+                                  apply_eos_sentinel,
+                                  count_generated_tokens)
+from paddle_tpu.models.decode_engine import (CacheConfig, DraftConfig,
+                                             SamplingConfig)
+
+V, D, H, L, S, MAXT = 16, 32, 2, 1, 10, 32
+DD = 16          # draft width (d16/L1 — the CLAUDE.md tiny-task tier)
+K = 2            # proposals per lane per step
+END_ID = 1
+N_SLOTS = 4
+# paged geometry sized so coverage in (k+1)-position ticks never
+# exhausts and prompt entries outlive the whole workload (eviction/
+# exhaustion semantics are test_paged_decode's subject — here a
+# prefix entry evicted mid-test would silently turn the HIT-tier
+# assertion into a miss)
+BS, NB, E = 8, 20, 12
+
+
+# FIXED prompt pool (the "repeated-suffix mix" the ISSUE names): 8
+# memorizable sequences with planted end_id at varied positions.
+# Training on random-content terminator-copy leaves BOTH tiny models
+# at ~1.7 loss (measured) — they terminate correctly but their
+# content tokens are noise, so draft/target agreement (= acceptance)
+# sits at chance. A small fixed pool is memorizable by any capacity:
+# both models converge to the SAME near-deterministic streams and the
+# draft actually accepts — the regime speculative decoding exists for
+# (production analogue: repeated system prompts / templated traffic).
+_POOL_RNG = np.random.RandomState(5)
+PROMPT_POOL = []
+for _p in (1, 2, 3, 4, 6, 8, 10, 10):
+    _src = _POOL_RNG.randint(3, V, (S,)).astype(np.int64)
+    if _p < S:
+        _src[_p:] = END_ID
+    PROMPT_POOL.append(_src)
+PROMPT_POOL = np.stack(PROMPT_POOL)
+
+
+def _mixed_len_prompts(rng, n):
+    """n draws from the fixed pool — MODEL-DRIVEN mixed output
+    lengths (varied planted EOS) with high draft/target agreement."""
+    return PROMPT_POOL[rng.randint(0, len(PROMPT_POOL), n)]
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """Train target (d32/L1) AND draft (d16/L1) terminator-copy
+    models into ONE scope (disjoint param names via the draft_
+    prefix), build the whole-loop oracle + the bundle flavors."""
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.models import transformer as T
+
+    fluid.seed(0)
+    scope = Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    # ONE guard over both train builds: each creates auto-named
+    # optimizer state, and resetting the counter between them would
+    # hand the draft's moments the target's names in the shared scope
+    with unique_name.guard():
+        t_main, t_st, t_loss = T.build_program(
+            seq_len=S, d_model=D, n_heads=H, n_layers=L, d_inner=64,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+        with fluid.program_guard(t_main, t_st):
+            fluid.optimizer.Adam(learning_rate=0.02).minimize(t_loss)
+        d_main, d_st, d_loss = T.build_program(
+            seq_len=S, d_model=DD, n_heads=H, n_layers=L, d_inner=32,
+            vocab=V, with_optimizer=False, dropout_rate=0.0,
+            name_prefix="draft_")
+        with fluid.program_guard(d_main, d_st):
+            fluid.optimizer.Adam(learning_rate=0.02).minimize(d_loss)
+    exe.run(t_st, scope=scope)
+    exe.run(d_st, scope=scope)
+    rng = np.random.RandomState(7)
+    for _ in range(150):
+        src = _mixed_len_prompts(rng, 8)
+        tgt_in = np.concatenate(
+            [np.full((8, 1), 2, np.int64), src[:, :-1]], 1)
+        feed = {"src_ids": src, "tgt_ids": tgt_in, "label": src}
+        exe.run(t_main, feed=feed, fetch_list=[t_loss], scope=scope)
+        exe.run(d_main, feed=feed, fetch_list=[d_loss], scope=scope)
+
+    kwargs = dict(seq_len=S, max_out_len=MAXT, d_model=D, n_heads=H,
+                  n_layers=L, d_inner=64, vocab=V, start_id=2,
+                  end_id=END_ID)
+    draft = DraftConfig(d_model=DD, n_heads=H, n_layers=L,
+                        d_inner=32, k=K)
+    with unique_name.guard():
+        inc_m, _, _, inc_buf = T.build_incremental_decode_program(
+            **kwargs)
+    # admission ladder [1, N_SLOTS] (not the full power-of-two
+    # ladder): halves the serve-program compile bill of the five
+    # bundle flavors — this module must fit the tier-1 fast lane
+    buckets = [1, N_SLOTS]
+    with unique_name.guard():
+        spec = T.build_decode_step_program(
+            n_slots=N_SLOTS, state_prefix="@sp/", draft=draft,
+            admit_buckets=buckets, **kwargs)
+    with unique_name.guard():
+        pspec = T.build_decode_step_program(
+            n_slots=N_SLOTS, state_prefix="@pp/", draft=draft,
+            admit_buckets=buckets,
+            cache=CacheConfig(layout="paged", block_size=BS,
+                              n_blocks=NB, n_prompt_entries=E),
+            **kwargs)
+    with unique_name.guard():
+        # temperature 1.0 on the MEMORIZED pool task: confident
+        # per-position dists make "modal sample == argmax" sound
+        # over 40 draws, while the residual tail still varies long
+        # generations across seeds (a 1.5 run on the noisier
+        # random-content task measured near-uniform firsts and made
+        # the mode assertion a coin flip)
+        sampled = T.build_decode_step_program(
+            n_slots=N_SLOTS, state_prefix="@sm/",
+            admit_buckets=buckets,
+            sampling=SamplingConfig(temperature=1.0, top_k=8),
+            **kwargs)
+    with unique_name.guard():
+        spec_k0 = T.build_decode_step_program(
+            n_slots=N_SLOTS, state_prefix="@s0/",
+            admit_buckets=buckets,
+            draft=DraftConfig(d_model=DD, n_heads=H, n_layers=L,
+                              d_inner=32, k=0), **kwargs)
+    return {"exe": exe, "scope": scope, "inc_m": inc_m,
+            "inc_buf": inc_buf, "spec": spec,
+            "pspec": pspec, "sampled": sampled, "spec_k0": spec_k0,
+            "draft": draft, "kwargs": kwargs}
+
+
+def _oracle(tr, srcs):
+    ref, = tr["exe"].run(tr["inc_m"], feed={"src_ids": srcs},
+                         fetch_list=[tr["inc_buf"]],
+                         scope=tr["scope"])
+    return apply_eos_sentinel(np.asarray(ref), end_id=END_ID)
+
+
+def _serve(tr, bundle, srcs, order=None, seeds=None, cls=None,
+           **srv_kw):
+    cls = cls or (PagedContinuousGenerationServer
+                  if bundle.cache.layout == "paged"
+                  else ContinuousGenerationServer)
+    n = len(srcs)
+    order = list(order) if order is not None else list(range(n))
+    with cls(bundle, executor=tr["exe"], scope=tr["scope"],
+             **srv_kw) as srv:
+        replies = {}
+        for i in order:
+            kw = {"seed": seeds[i]} if seeds is not None else {}
+            replies[i] = srv.submit(srcs[i], **kw)
+        got = np.stack([replies[i].result(timeout=300.0)
+                        for i in range(n)])
+        st = srv.stats()
+    return got, st
+
+
+class TestGreedySpecParity:
+    def test_token_exact_with_slot_reuse(self, trained):
+        """12 mixed-length requests through 4 slots (3x reuse): every
+        speculative row equals the whole-loop greedy row, sentinel
+        tails included — AND the trained draft actually accepts (the
+        speedup premise, not just correctness)."""
+        srcs = _mixed_len_prompts(np.random.RandomState(11), 12)
+        want = _oracle(trained, srcs)
+        assert len(set((w != -1).sum() for w in want)) > 1
+        got, st = _serve(trained, trained["spec"], srcs)
+        np.testing.assert_array_equal(got, want)
+        sp = st["speculative"]
+        assert sp["k"] == K
+        # both tiny models learned the same copy task: the draft must
+        # agree with the target well above chance
+        assert sp["acceptance_rate"] is not None \
+            and sp["acceptance_rate"] > 0.3, sp
+
+    def test_independent_of_admission_order(self, trained):
+        srcs = _mixed_len_prompts(np.random.RandomState(13), 8)
+        want = _oracle(trained, srcs)
+        got, _ = _serve(trained, trained["spec"], srcs,
+                        order=range(7, -1, -1))
+        np.testing.assert_array_equal(got, want)
+
+    def test_burst_length_does_not_move_tokens(self, trained):
+        srcs = _mixed_len_prompts(np.random.RandomState(17), 6)
+        want = _oracle(trained, srcs)
+        got1, _ = _serve(trained, trained["spec"], srcs,
+                         steps_per_tick=1, drain_steps=1)
+        np.testing.assert_array_equal(got1, want)
+        got6, st = _serve(trained, trained["spec"], srcs,
+                          steps_per_tick=6)
+        np.testing.assert_array_equal(got6, want)
+        # the multi-token lever is real: fewer target model steps
+        # than tokens emitted
+        sp = st["speculative"]
+        assert sp["target_steps"] < sp["emitted"]
+
+    def test_token_exact_paged(self, trained):
+        """The PAGED spec server: multi-position verify writes go
+        through lane-exclusive block-table scatter; prefix hit/miss
+        admission tiers both carry the draft state."""
+        rng = np.random.RandomState(19)
+        srcs = _mixed_len_prompts(rng, 10)
+        srcs[5] = srcs[0]  # a prefix HIT mid-stream
+        want = _oracle(trained, srcs)
+        got, st = _serve(trained, trained["pspec"], srcs)
+        np.testing.assert_array_equal(got, want)
+        assert st["block_pool"]["prefix_hits"] >= 1
+
+    def test_k0_degenerates_to_plain_path(self, trained):
+        """DraftConfig(k=0) = the r10 one-token step: token parity
+        with the whole-loop oracle (= the plain bundle's own pinned
+        contract, tests/test_continuous_batching.py) and no
+        speculative machinery in the stats."""
+        srcs = _mixed_len_prompts(np.random.RandomState(23), 6)
+        want = _oracle(trained, srcs)
+        got_k0, st = _serve(trained, trained["spec_k0"], srcs)
+        np.testing.assert_array_equal(got_k0, want)
+        assert "speculative" not in st  # no draft machinery ran
+
+
+class TestSpecCounters:
+    def test_counter_units(self, trained):
+        """emitted == generated tokens (the buffer-content count),
+        draft_steps == k * target_steps (k draft model steps per
+        verify), accepted <= proposed, and the emitted stream is
+        accepted proposals + one correction/bonus per lane-tick."""
+        srcs = _mixed_len_prompts(np.random.RandomState(29), 8)
+        got, st = _serve(trained, trained["spec"], srcs)
+        sp = st["speculative"]
+        assert sp["draft_steps"] == K * sp["target_steps"]
+        assert 0 <= sp["accepted"] <= sp["proposed"]
+        assert sp["emitted"] == int(
+            count_generated_tokens(got, END_ID).sum())
+        assert sp["accepted"] <= sp["emitted"]
+        # per LANE-tick units: a lane advances 1..k+1 tokens per
+        # verify (regression: an emitted/program-ticks version scaled
+        # with occupancy and reported 21.7 at 8 live lanes)
+        assert 1.0 <= sp["mean_accepted_len"] <= K + 1
+        assert st["tokens"] == sp["emitted"]
+
+    def test_metrics_and_span_surface(self, trained):
+        """The uniquely-labeled pull-provider samples exist with the
+        device-counter values."""
+        srcs = _mixed_len_prompts(np.random.RandomState(31), 4)
+        with ContinuousGenerationServer(
+                trained["spec"], executor=trained["exe"],
+                scope=trained["scope"]) as srv:
+            for s in srcs:
+                srv.submit(s).result(timeout=300.0)
+            samples = {name: val for name, lab, val
+                       in srv._metrics_samples()}
+            sp = srv.stats()["speculative"]
+        for key in ("proposed", "accepted", "emitted", "draft_steps",
+                    "target_steps"):
+            assert samples[f"paddle_tpu_spec_{key}_total"] == sp[key]
+        assert "paddle_tpu_spec_acceptance_rate" in samples
+
+
+class TestSampledLanes:
+    def test_bit_identical_across_admission_orders(self, trained):
+        """Fixed per-request seeds: the sampled stream of every
+        request is byte-identical whatever order admitted it (noise
+        is keyed on (seed, position), never on lane/tick/dispatch)."""
+        srcs = _mixed_len_prompts(np.random.RandomState(37), 8)
+        seeds = list(range(100, 108))
+        a, _ = _serve(trained, trained["sampled"], srcs, seeds=seeds)
+        b, _ = _serve(trained, trained["sampled"], srcs, seeds=seeds,
+                      order=range(7, -1, -1))
+        np.testing.assert_array_equal(a, b)
+        # content-derived default seeds: resubmission reproduces too
+        c1, _ = _serve(trained, trained["sampled"], srcs)
+        c2, _ = _serve(trained, trained["sampled"], srcs,
+                       order=range(7, -1, -1))
+        np.testing.assert_array_equal(c1, c2)
+
+    def test_seeds_vary_the_stream(self, trained):
+        """Distinct seeds on ONE prompt: the noise channel is alive
+        (>= 2 distinct generations across 16 seeds), and the same
+        seed twice is identical. Uses the no-EOS pool prompt: its
+        full-buffer generation gives the tail probabilities ~31
+        positions to fire on."""
+        src = PROMPT_POOL[-1]
+        outs = []
+        with ContinuousGenerationServer(
+                trained["sampled"], executor=trained["exe"],
+                scope=trained["scope"]) as srv:
+            for seed in range(16):
+                outs.append(tuple(
+                    srv.submit(src, seed=seed).result(300.0)))
+            again = tuple(srv.submit(src, seed=3).result(300.0))
+        assert len(set(outs)) >= 2
+        assert again == outs[3]
+
+    def test_distribution_centers_on_greedy_mode(self, trained):
+        """Sampled-lane sanity on the trained terminator-copy task:
+        across many seeds the MODAL first generated token is the
+        greedy (argmax) token — the filtered sampler draws from the
+        model's distribution, not some shifted one."""
+        src = _mixed_len_prompts(np.random.RandomState(43), 1)
+        greedy_first = _oracle(trained, src)[0, 1]
+        firsts = []
+        with ContinuousGenerationServer(
+                trained["sampled"], executor=trained["exe"],
+                scope=trained["scope"]) as srv:
+            for seed in range(30):
+                toks = srv.submit(src[0], seed=seed).result(300.0)
+                firsts.append(int(toks[1]))
+        vals, counts = np.unique(firsts, return_counts=True)
+        assert vals[np.argmax(counts)] == greedy_first, (
+            list(zip(vals.tolist(), counts.tolist())), greedy_first)
+
+
+class TestExecutableBound:
+    def test_zero_steady_state_compiles_under_churn(self, trained):
+        """100 mixed-length requests through the speculative server
+        compile NOTHING after its fused serve set binds."""
+        exe = trained["exe"]
+        srv = ContinuousGenerationServer(
+            trained["spec"], executor=exe, scope=trained["scope"])
+        try:
+            assert srv._warmed_compiles <= len(
+                trained["spec"].serves)
+            warmed = exe.compile_count
+            srcs = _mixed_len_prompts(np.random.RandomState(47), 100)
+            replies = [srv.submit(s) for s in srcs]
+            got = [r.result(timeout=600.0) for r in replies]
+            st = srv.stats()
+        finally:
+            srv.close()
+        assert len(got) == 100
+        assert exe.compile_count == warmed, (
+            f"steady-state spec traffic compiled "
+            f"{exe.compile_count - warmed} executable(s)")
+        assert st["completed"] == 100
+
+
+class TestFingerprints:
+    def test_spec_and_sampled_bundles_never_dedupe(self, trained):
+        """server_fingerprint separates plain / spec / spec-k0 /
+        sampled bundles over the SAME weights — the runtime must
+        never hot-swap or dedupe them as one model."""
+        from types import SimpleNamespace
+
+        from paddle_tpu.inference.runtime.registry import \
+            server_fingerprint
+
+        fps = {name: server_fingerprint(
+                   SimpleNamespace(bundle=trained[name]))
+               for name in ("spec", "pspec", "sampled", "spec_k0")}
+        assert len(set(fps.values())) == len(fps), fps
+
+    def test_colliding_draft_prefix_refused_at_build(self, trained):
+        """The ModelRegistry-style PTA100 pair lint at bundle build:
+        a draft whose params would alias the target's raises."""
+        from paddle_tpu.models import transformer as T
+
+        with pytest.raises(ValueError, match="PTA100"):
+            T.build_decode_step_program(
+                n_slots=2, state_prefix="@bad/",
+                draft=DraftConfig(d_model=D, n_heads=H, n_layers=L,
+                                  d_inner=64, k=1, prefix=""),
+                **trained["kwargs"])
