@@ -40,51 +40,13 @@ _PEAKS = (("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
           ("v4", 275e12), ("h100", 989e12))
 
 
-def _telemetry_snapshot(stats_json_dict=None) -> dict:
-    """The `telemetry` key every BENCH_SELF_*.json carries from r12
-    on: the central metrics exposition (observability/metrics.py) +
-    the runtime's stats_json() dict, so future perf rounds read the
-    counter context (compiles, cache tiers, occupancy) next to the
-    headline number instead of re-deriving it.
+# Shared measurement scaffolding (benchmark/harness.py): interleaved
+# best-of-N legs, fail-fast backend probing, telemetry snapshots, and
+# the BENCH_SELF schema guard — one implementation for all configs.
+from benchmark import harness as _harness
 
-    The flag is flipped to `metrics` just for the expose() call: the
-    counters behind the exposition (executor compiles/hits, cache
-    residency, server histograms) are live pull providers that count
-    at EVERY level, so benches that ran at `off` still snapshot real
-    values — only the exposition rendering itself is gated."""
-    from paddle_tpu import observability as obs
-    from paddle_tpu.flags import FLAGS, set_flags
-
-    prev = FLAGS.observability
-    set_flags({"FLAGS_observability": "metrics"})
-    try:
-        exposition = obs.metrics.expose()
-    finally:
-        set_flags({"FLAGS_observability": prev})
-    return {
-        "metrics_expose": exposition,
-        "stats_json": stats_json_dict,
-        "flight": {
-            "recorded_total": obs.RECORDER.recorded_total,
-            "incidents_total": obs.RECORDER.incidents_total,
-        },
-    }
-
-
-def _write_bench_self(filename: str, result: dict,
-                      stats_json_dict=None) -> dict:
-    """Write a BENCH_SELF_*.json next to this file, injecting the
-    r12 `telemetry` key (see _telemetry_snapshot) so the record
-    carries its counter context. Returns the result dict (with the
-    key attached) for the caller to return/print."""
-    import os
-
-    result["telemetry"] = _telemetry_snapshot(stats_json_dict)
-    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            filename)
-    with open(out_path, "w") as f:
-        json.dump(result, f, indent=1)
-    return result
+_telemetry_snapshot = _harness.telemetry_snapshot
+_write_bench_self = _harness.write_bench_self
 
 
 def _peak_flops(device_kind: str) -> float:
@@ -707,14 +669,17 @@ def _coldstart_child(model_dir, cache_dir, n_requests):
         t_first = time.perf_counter() - t_start
         reqs = [r.randn(1, in_dim).astype(np.float32)
                 for _ in range(n_requests)]
-        rps = 0.0
-        for _ in range(3):  # best-of-3, same as the naive leg
-            # (shared-CPU hosts are noisy)
+
+        def _served_pass():
             t0 = time.perf_counter()
             replies = [srv.submit({"x": a}) for a in reqs]
             for rep in replies:
                 rep.result(timeout=600.0)
-            rps = max(rps, n_requests / (time.perf_counter() - t0))
+            return n_requests / (time.perf_counter() - t0)
+
+        # best-of-3, same as the naive leg (shared-CPU hosts are
+        # noisy; harness discipline)
+        rps = _harness.best_of(_served_pass, 3)
         st = srv.stats()
     cc = active_cache()
     print(json.dumps({
@@ -773,13 +738,15 @@ def bench_coldstart(n_requests=400):
     reqs = [r.randn(1, in_dim).astype(np.float32)
             for _ in range(n_requests)]
     pred.run([PaddleTensor(reqs[0], name="x")])  # warm the shape
-    naive_rps = 0.0
-    for _ in range(3):  # best-of-3: shared-CPU hosts are noisy
+
+    def _naive_pass():
         t0 = time.perf_counter()
         for a in reqs:
             pred.run([PaddleTensor(a, name="x")])
-        naive_rps = max(naive_rps,
-                        n_requests / (time.perf_counter() - t0))
+        return n_requests / (time.perf_counter() - t0)
+
+    # best-of-3 (harness discipline): shared-CPU hosts are noisy
+    naive_rps = _harness.best_of(_naive_pass, 3)
 
     cache_dir = tempfile.mkdtemp(prefix="coldstart_cache_")
 
@@ -946,30 +913,31 @@ def bench_generation(n_requests=96):
 
     static_leg()       # warm the static bucket executables
     compiles_before = exe.compile_count
-    legs = [continuous_leg()]  # warms the serve executables
-    # INTERLEAVED best-of-3: this host's CPU-share throttle windows
-    # last seconds, so alternating legs samples both servers under
-    # the same conditions — a sequential best-of-3 can land one whole
-    # server inside a slow window and report a 2x-off ratio. The two
-    # warm legs above are excluded from the mins so BOTH sides are a
-    # best-of-3 over the same interleaved windows (no sample-count
-    # asymmetry flattering either ratio).
-    statics = []
-    for _ in range(3):
-        statics.append(static_leg())
-        legs.append(continuous_leg())
-    sbest = min(statics, key=lambda r: r["wall_s"])
-    cbest = min(legs[1:], key=lambda r: r["wall_s"])
+    warm_leg = continuous_leg()  # warms the serve executables
+    # INTERLEAVED best-of-3 (harness.interleave_rounds): this host's
+    # CPU-share throttle windows last seconds, so alternating legs
+    # samples both servers under the same conditions — a sequential
+    # best-of-3 can land one whole server inside a slow window and
+    # report a 2x-off ratio. The two warm legs above are excluded
+    # from the mins so BOTH sides are a best-of-3 over the same
+    # interleaved windows (no sample-count asymmetry flattering
+    # either ratio).
+    rounds = _harness.interleave_rounds(
+        [("static", static_leg), ("continuous", continuous_leg)],
+        rounds=3)
+    sbest = _harness.best_leg(rounds, "static")
+    cbest = _harness.best_leg(rounds, "continuous")
     # warmup happens in the first server __init__; later legs and all
     # steady-state traffic must compile NOTHING
     steady_compiles = exe.compile_count - compiles_before \
-        - legs[0]["stats"]["warmed_compiles"]
+        - warm_leg["stats"]["warmed_compiles"]
     # token-exact parity of the measured leg (sentinel rows vs the
     # whole-loop oracle) — a fast continuous leg that decoded wrong
     # tokens would be meaningless
     parity = all(
         np.array_equal(np.asarray(o), want[i])
-        for leg in legs for i, o in enumerate(leg["outs"]))
+        for leg in [warm_leg] + [r["continuous"] for r in rounds]
+        for i, o in enumerate(leg["outs"]))
     cst = cbest["stats"]
     return {
         "metric": "generation_tokens_per_sec_mixed_len",
@@ -1188,24 +1156,27 @@ def bench_paged(n_requests=192):
     dense_leg()
     paged_leg()
     compiles_before = exe.compile_count
-    # INTERLEAVED best-of-3 (r10 discipline): adjacent legs share
-    # this host's CPU-share throttle windows
-    triples = [(whole_loop_leg(), dense_leg(), paged_leg())
-               for _ in range(3)]
+    # INTERLEAVED best-of-3 (r10 discipline, harness.interleave_
+    # rounds): adjacent legs share this host's CPU-share throttle
+    # windows
+    rounds = _harness.interleave_rounds(
+        [("whole", whole_loop_leg), ("dense", dense_leg),
+         ("paged", paged_leg)], rounds=3)
     steady_compiles = exe.compile_count - compiles_before
     assert steady_compiles == 0, (
         f"steady-state legs compiled {steady_compiles}")
-    wbest = min((w for w, _, _ in triples), key=lambda r: r["wall_s"])
-    dbest = min((d for _, d, _ in triples), key=lambda r: r["wall_s"])
-    pbest = min((p for _, _, p in triples), key=lambda r: r["wall_s"])
+    wbest = _harness.best_leg(rounds, "whole")
+    dbest = _harness.best_leg(rounds, "dense")
+    pbest = _harness.best_leg(rounds, "paged")
     # the ASSERTED ratio is the best PAIRED one (the r10 guard-test
-    # method): adjacent legs of a triple share this host's throttle
-    # window, while ratios of global bests can pit one leg's lucky
-    # window against another's throttled one
-    speedup_vs_whole = max(p["tok_s"] / w["tok_s"]
-                           for w, _, p in triples)
-    ratio_vs_dense_slot = max(p["tok_s"] / d["tok_s"]
-                              for _, d, p in triples)
+    # method, harness.paired_ratio_max): adjacent legs of a round
+    # share this host's throttle window, while ratios of global bests
+    # can pit one leg's lucky window against another's throttled one
+    speedup_vs_whole = _harness.paired_ratio_max(rounds, "paged",
+                                                 "whole")
+    ratio_vs_dense_slot = _harness.paired_ratio_max(rounds, "paged",
+                                                    "dense")
+    triples = [(r["whole"], r["dense"], r["paged"]) for r in rounds]
     triple_toks = [(round(w["tok_s"]), round(d["tok_s"]),
                     round(p["tok_s"])) for w, d, p in triples]
     assert speedup_vs_whole >= 1.5, (
@@ -1454,22 +1425,25 @@ def bench_speculative(n_requests=96, spec_k=3):
     plain_leg()
     spec_leg()
     compiles_before = exe.compile_count
-    triples = [(whole_loop_leg(), plain_leg(), spec_leg())
-               for _ in range(3)]
+    rounds = _harness.interleave_rounds(
+        [("whole", whole_loop_leg), ("plain", plain_leg),
+         ("spec", spec_leg)], rounds=3)
     steady_compiles = exe.compile_count - compiles_before
     assert steady_compiles == 0, (
         f"steady-state legs compiled {steady_compiles}")
-    wbest = min((w for w, _, _ in triples), key=lambda r: r["wall_s"])
-    pbest = min((p for _, p, _ in triples), key=lambda r: r["wall_s"])
-    sbest = min((s for _, _, s in triples), key=lambda r: r["wall_s"])
+    wbest = _harness.best_leg(rounds, "whole")
+    pbest = _harness.best_leg(rounds, "plain")
+    sbest = _harness.best_leg(rounds, "spec")
     # asserted ratios are the best PAIRED ones (adjacent legs share
-    # this host's CPU-throttle windows — the r10 method)
-    speedup_vs_plain = max(s["tok_s"] / p["tok_s"]
-                           for _, p, s in triples)
-    speedup_vs_whole = max(s["tok_s"] / w["tok_s"]
-                           for w, _, s in triples)
-    triple_toks = [(round(w["tok_s"]), round(p["tok_s"]),
-                    round(s["tok_s"])) for w, p, s in triples]
+    # this host's CPU-throttle windows — the r10 method,
+    # harness.paired_ratio_max)
+    speedup_vs_plain = _harness.paired_ratio_max(rounds, "spec",
+                                                 "plain")
+    speedup_vs_whole = _harness.paired_ratio_max(rounds, "spec",
+                                                 "whole")
+    triple_toks = [(round(r["whole"]["tok_s"]),
+                    round(r["plain"]["tok_s"]),
+                    round(r["spec"]["tok_s"])) for r in rounds]
     sp = sbest["stats"]["speculative"]
     assert speedup_vs_plain > 1.0, (
         f"speculative tok/s only {speedup_vs_plain:.2f}x the plain "
@@ -1627,31 +1601,14 @@ def _bench_multitenant_body(n_requests=900):
     best_rps, best_st = max(legs, key=lambda x: x[0])
 
     def ab_pair(mode_a, mode_b, reps, repeat=4):
-        """Median of PAIRED adjacent-leg rps ratios mode_a/mode_b.
-        Three defenses against this host's CPU-share throttle, which
-        swings single short legs 2.5x (so best-of-N compares
-        throttle-window luck, not modes): legs run the schedule
-        ``repeat``x so each leg spans multiple throttle windows
-        instead of landing inside one; the two modes run back-to-back
-        (shared throttle state) with the order alternating per rep
-        (the second leg of a pair trends measurably warmer); and the
-        median over reps rejects window-boundary outliers."""
-        ratios, legs = [], {mode_a: [], mode_b: []}
-        for rep in range(reps):
-            order = ((mode_a, mode_b) if rep % 2 == 0
-                     else (mode_b, mode_a))
-            res = {}
-            for mode in order:
-                set_flags({"FLAGS_observability": mode})
-                res[mode] = leg(repeat=repeat)
-            for m in (mode_a, mode_b):
-                legs[m].append(res[m])
-            ratios.append(res[mode_a][0] / res[mode_b][0])
-        srt = sorted(ratios)
-        mid = len(srt) // 2
-        med = (srt[mid] if len(srt) % 2
-               else 0.5 * (srt[mid - 1] + srt[mid]))
-        return med, ratios, legs
+        """Paired-median A/B over FLAGS_observability modes
+        (harness.paired_median_ab has the throttle-defense
+        rationale); legs run the schedule ``repeat``x so each spans
+        multiple throttle windows instead of landing inside one."""
+        return _harness.paired_median_ab(
+            lambda: leg(repeat=repeat),
+            lambda mode: set_flags({"FLAGS_observability": mode}),
+            mode_a, mode_b, reps)
 
     obs_ratio, metrics_ratios, mo_legs = ab_pair("metrics", "off", 6)
     trace_ratio, trace_ratios, to_legs = ab_pair("trace", "off", 4)
@@ -1855,37 +1812,7 @@ EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
                  "multitenant": bench_multitenant}
 
 
-def _probe_backend(timeout_s=180):
-    """Fail fast (instead of hanging the driver) when the TPU tunnel
-    is wedged: jax backend init HANGS rather than raising in that
-    state (see CLAUDE.md tunnel rules). The probe runs in a child
-    process; on timeout the child is ABANDONED, not killed -- killing
-    a mid-handshake TPU process is exactly what wedges the tunnel.
-    Healthy runs pay one extra ~seconds backend init in the child;
-    the returned device_kind is reused so the parent only initializes
-    once more for the actual benches."""
-    import subprocess
-
-    child = subprocess.Popen(
-        [sys.executable, "-c",
-         "import jax; print(jax.devices()[0].device_kind)"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        start_new_session=True)
-    try:
-        out, err = child.communicate(timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        # leave the child running: it either completes harmlessly or
-        # was already hung on a dead tunnel
-        print("# bench: device backend unresponsive after "
-              f"{timeout_s}s (wedged TPU tunnel?) -- aborting instead "
-              "of hanging; see BENCH_SELF_r02.json for the last "
-              "healthy run", file=sys.stderr)
-        sys.exit(3)
-    if child.returncode != 0:
-        print(f"# bench: backend probe failed: {err[-400:]}",
-              file=sys.stderr)
-        sys.exit(3)
-    return out.strip().splitlines()[-1] if out.strip() else "unknown"
+_probe_backend = _harness.probe_backend
 
 
 def main():

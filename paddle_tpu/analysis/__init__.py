@@ -13,17 +13,29 @@ the program-as-data IR.
 Pieces:
 
 * analysis.dataflow — def-use chains per block + recursive sub-block
-  walking (the `_scan_fallback_reason` walk, generalized).
+  walking (the `_scan_fallback_reason` walk, generalized), with an
+  explicit per-op-type registry of sub-block entry-name attrs.
+* analysis.absint — the divergence & sharding prover: whole-program
+  fixpoint abstract interpretation (divergence contexts, the
+  replicated/varying/unknown lattice, declared-vs-producer
+  shape/dtype facts) feeding PTA130/131/140, plus the
+  divergence-source seed table sharded lowerings register with.
 * analysis.checkers — the Checker registry: stable `PTA0xx` codes,
   severity error/warn/info, op/var anchors, fix hints. Every checker
   encodes a REAL incident from CLAUDE.md's session learnings
   (collective-in-divergent-cond deadlocks, int->float while-carry
-  promotion, _uid loss, global-counter param names, ...).
+  promotion, _uid loss, global-counter param names, ...). Bundle-
+  level contracts ride `check_bundle` (PTA150); per-site
+  suppressions ride the ``_pta_suppress`` op attr (counted,
+  surfaced).
 * Executor gate — ``FLAGS_static_check={off,warn,strict}`` runs the
   suite before every compile (strict raises EnforceNotMet with the
   diagnostic list).
 * CLI — ``python -m paddle_tpu.analysis`` builds and lints every
-  program in models/ and benchmark/ (``--strict`` for CI).
+  program in models/ and benchmark/ (``--strict`` for CI;
+  ``--baseline`` diffs the zoo's diagnostic set against the
+  committed analysis_baseline.json and fails on any NEW
+  error-or-warning — analysis.baseline has the machinery).
 
 Usage::
 
@@ -37,22 +49,25 @@ from __future__ import annotations
 
 from typing import List
 
+from . import absint
 from .checkers import (Checker, Diagnostic, ERROR, INFO, WARNING,
-                       check_clone_uids, check_cross_model_collision,
+                       SUPPRESS_ATTR, check_bundle, check_clone_uids,
+                       check_cross_model_collision,
                        check_registry, check_shared_params,
                        format_diagnostics, register_checker,
                        registered_checkers, run_checks)
 from .dataflow import (BlockDataflow, OpSite, analyze_block,
-                       iter_blocks, iter_ops, iter_sub_blocks)
+                       iter_blocks, iter_ops, iter_sub_blocks,
+                       register_block_entry_attrs)
 
 __all__ = [
     "Diagnostic", "Checker", "ERROR", "WARNING", "INFO",
     "run_checks", "register_checker", "registered_checkers",
     "check_registry", "check_shared_params", "check_clone_uids",
-    "check_cross_model_collision",
-    "format_diagnostics", "maybe_check_program",
+    "check_cross_model_collision", "check_bundle", "SUPPRESS_ATTR",
+    "format_diagnostics", "maybe_check_program", "absint",
     "BlockDataflow", "OpSite", "analyze_block", "iter_blocks",
-    "iter_ops", "iter_sub_blocks",
+    "iter_ops", "iter_sub_blocks", "register_block_entry_attrs",
 ]
 
 # one gate evaluation per (program uid, version): the Executor calls

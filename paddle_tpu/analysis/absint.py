@@ -1,0 +1,501 @@
+"""Abstract interpretation over the Program IR: the divergence &
+sharding prover.
+
+Reference counterpart: the reference validates every program in C++
+before execution (reference paddle/fluid/framework/op_desc.cc
+CheckAttrs/InferShape, operator.cc:975 RunImpl enforcement) but runs
+control flow on the HOST, so "is this collective inside a divergent
+branch" is not a question its validators can even ask. Here a whole
+Block jits into ONE XLA computation and control flow traces into
+lax.cond/lax.while_loop — a collective under a predicate that differs
+across mesh coordinates deadlocks the chip (the r5 shard_map trap,
+re-hit as 1F1B x tp; CLAUDE.md session learnings). The pattern
+matcher (checkers.py PTA010/011) catches the lexical shape of that
+bug; this module upgrades it to a PROOF: whole-program fixpoint
+propagation over three abstract domains, so "this site executes
+uniformly" and "this value is replicated across the mesh" become
+checkable facts that PR 12's sharded serving lowerings can lean on.
+
+Domains
+-------
+1. **Divergence contexts** — for every OpSite, the stack of guard
+   predicates (while / conditional_block / run_block_if / ifelse
+   conditions) the site executes under, each classified by the
+   replication fact of its condition value.
+2. **Replication lattice** — ``replicated ⊑ varying ⊑ unknown`` per
+   value. Seeds: persistables, data vars and constants are
+   `replicated` (the single-logical-device build); ops annotated with
+   a registered *divergence source* (``divergence_source`` attr —
+   lane active masks, pp stage ids, explicit `_vary` casts) or an
+   auto-axis sharding annotation (``sharding_axes`` attr) mint
+   `varying` values; joins propagate through assign/arith chains and
+   through sub-blocks to a fixpoint.
+3. **Symbolic shape/dtype** — build-time shape inference clobbers
+   declared shapes in place (core/registry.py stashes the original as
+   ``_declared_shape``/``_declared_dtype``); `declared_clobbers`
+   surfaces declared-vs-producer disagreements (the r10 class) and
+   int->float promotions (PTA020 generalized beyond `increment`).
+
+Annotation surface (the seed table)
+-----------------------------------
+Builders that MINT a predicate that can differ across mesh
+coordinates must mark the minting op::
+
+    from paddle_tpu.analysis import absint
+    cond = layers.greater_than(live, min_active)
+    absint.mark_divergence_source(cond, "lane_active_mask")
+
+New divergence sources (PR 12's sharded lowerings: dp lane shards,
+tp/vocab shards) must register a tag first via
+``register_divergence_source`` — `mark_divergence_source` refuses
+unknown tags so the seed table stays the single source of truth.
+
+Checkers PTA130/131 (checkers.py) read the facts computed here; the
+engine itself is pure Python over Program metadata (no jax, no
+tracing) and analyzes a whole model program in milliseconds.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core.program import Block, Operator, Program
+from ..core.registry import EMPTY_VAR
+from .dataflow import OpSite, iter_blocks, iter_sub_blocks
+
+__all__ = [
+    "REPLICATED", "VARYING", "UNKNOWN", "join",
+    "DIVERGENCE_ATTR", "SHARDING_ATTR",
+    "register_divergence_source", "divergence_sources",
+    "mark_divergence_source", "mark_sharded",
+    "ValueFact", "GuardFact", "ProgramFacts", "analyze",
+    "declared_clobbers",
+]
+
+# --- the replication lattice ------------------------------------------------
+REPLICATED, VARYING, UNKNOWN = "replicated", "varying", "unknown"
+_ORDER = {REPLICATED: 0, VARYING: 1, UNKNOWN: 2}
+
+
+def join(a: str, b: str) -> str:
+    """Least upper bound: replicated ⊑ varying ⊑ unknown.
+
+    Reference counterpart: none — standard dataflow lattice join.
+    """
+    return a if _ORDER[a] >= _ORDER[b] else b
+
+
+# --- annotation attrs & the divergence-source seed table --------------------
+DIVERGENCE_ATTR = "divergence_source"
+SHARDING_ATTR = "sharding_axes"
+
+# tag -> human explanation of WHY values minted under it differ across
+# mesh coordinates. This is the seed table the ISSUE/ROADMAP name: a
+# new sharded lowering that mints a new predicate family registers its
+# tag here (CLAUDE.md conventions) so the prover knows about it.
+_DIVERGENCE_SOURCES: Dict[str, str] = {
+    "lane_active_mask": (
+        "per-lane active/finished masks: once decode lanes shard "
+        "across a data-parallel mesh axis, each device sees only its "
+        "own lanes' masks — burst-exit predicates derived from them "
+        "differ per device"),
+    "pp_stage_id": (
+        "pipeline-stage coordinate: per-stage predicates (the 1F1B "
+        "F/B selector) differ across pp mesh coordinates BY "
+        "construction — the r5 deadlock family"),
+    "mesh_coord": (
+        "a mesh axis index (lax.axis_index analogue): differs across "
+        "that axis by definition"),
+    "vary": (
+        "explicit replicated->varying cast done OUTSIDE divergent "
+        "control flow (the r5 `_vary` fix): the value is per-device "
+        "from here on, and its grad transpose psum lands at this op, "
+        "not inside a branch"),
+}
+
+
+def register_divergence_source(tag: str, description: str) -> None:
+    """Add a divergence-source tag to the seed table (idempotent for
+    an identical description; refuses silent redefinition).
+
+    Reference counterpart: none — the reference ran control flow on
+    the host (reference operators/controlflow/while_op.cc), so a
+    cross-device predicate-divergence registry had nothing to gate.
+    """
+    old = _DIVERGENCE_SOURCES.get(tag)
+    if old is not None and old != description:
+        raise ValueError(
+            f"divergence source {tag!r} already registered with a "
+            f"different description; pick a new tag")
+    _DIVERGENCE_SOURCES[tag] = description
+
+
+def divergence_sources() -> Dict[str, str]:
+    """The registered seed table, copied. Reference counterpart:
+    none (see register_divergence_source)."""
+    return dict(_DIVERGENCE_SOURCES)
+
+
+def _producer_op(var) -> Optional[Operator]:
+    """Most recent op writing `var` (searched from the var's program,
+    current block first — the helper is called right after the layer
+    call appends the producer)."""
+    name = getattr(var, "name", var)
+    blk = getattr(var, "block", None)
+    program = blk.program if blk is not None else None
+    if program is None:
+        return None
+    blocks = [program.current_block()] + list(program.blocks)
+    seen = set()
+    for b in blocks:
+        if id(b) in seen:
+            continue
+        seen.add(id(b))
+        for op in reversed(b.ops):
+            if name in op.output_arg_names:
+                return op
+    return None
+
+
+def mark_divergence_source(var, tag: str) -> None:
+    """Build-time annotation: mark the producer op of `var` as minting
+    a mesh-varying value (tag must be in the registered seed table).
+    The abstract interpreter seeds the replication lattice from these
+    marks; collectives/grads guarded by values derived from them get
+    PROVEN-divergent diagnostics (PTA130/131) instead of pattern
+    guesses.
+
+    Reference counterpart: none (see register_divergence_source);
+    compile-time capability of the whole-block-jit executor.
+    """
+    if tag not in _DIVERGENCE_SOURCES:
+        raise ValueError(
+            f"unknown divergence source {tag!r}; register it first "
+            f"(absint.register_divergence_source) — known: "
+            f"{sorted(_DIVERGENCE_SOURCES)}")
+    op = _producer_op(var)
+    if op is None:
+        raise ValueError(
+            f"mark_divergence_source: no producer op found for "
+            f"{getattr(var, 'name', var)!r}")
+    op.attrs[DIVERGENCE_ATTR] = tag
+    blk = getattr(var, "block", None)
+    if blk is not None and blk.program is not None:
+        blk.program._version += 1  # invalidate cached fingerprints/facts
+
+
+def mark_sharded(var, axes) -> None:
+    """Mark the producer of `var` as carrying an auto-axis sharding
+    annotation (the with_sharding_constraint analogue PR 12's
+    lowerings emit): GSPMD may insert collectives wherever the value
+    is consumed, so the prover treats it as varying and PTA131 rejects
+    reads of it inside divergent contexts.
+
+    Reference counterpart: the reference annotated placement per op
+    (reference framework/op_desc.cc device attrs); GSPMD auto-axis
+    annotations whose collectives MOVE have no analogue there.
+    """
+    op = _producer_op(var)
+    if op is None:
+        raise ValueError(
+            f"mark_sharded: no producer op found for "
+            f"{getattr(var, 'name', var)!r}")
+    op.attrs[SHARDING_ATTR] = tuple(axes) if isinstance(
+        axes, (list, tuple)) else (axes,)
+    blk = getattr(var, "block", None)
+    if blk is not None and blk.program is not None:
+        blk.program._version += 1
+
+
+# --- facts ------------------------------------------------------------------
+@dataclass(frozen=True)
+class ValueFact:
+    """Abstract value of one var name."""
+    repl: str = REPLICATED          # REPLICATED | VARYING | UNKNOWN
+    source: Optional[str] = None    # divergence tag when VARYING
+    minted_at: Optional[str] = None  # anchor of the minting op
+    sharded: Optional[tuple] = None  # sharding axes annotation, if any
+
+    def joined(self, other: "ValueFact") -> "ValueFact":
+        repl = join(self.repl, other.repl)
+        # keep the explanation of whichever side made us varying
+        lead = self if _ORDER[self.repl] >= _ORDER[other.repl] else other
+        return ValueFact(repl, lead.source, lead.minted_at,
+                         self.sharded or other.sharded)
+
+
+@dataclass(frozen=True)
+class GuardFact:
+    """One divergent-control-flow predicate a site executes under."""
+    container_type: str             # while / conditional_block / ...
+    container_anchor: str           # OpSite.anchor() of the container
+    cond_var: Optional[str]         # predicate var name
+    fact: str                       # replication class of the predicate
+    source: Optional[str] = None    # divergence tag when proven varying
+    minted_at: Optional[str] = None
+
+    def describe(self) -> str:
+        what = f"{self.container_type} guard {self.cond_var!r}"
+        if self.fact == VARYING:
+            src = _DIVERGENCE_SOURCES.get(self.source or "", "")
+            out = (f"{what}: PROVEN divergent across mesh coordinates "
+                   f"(source {self.source!r}")
+            if self.minted_at:
+                out += f", minted at {self.minted_at}"
+            out += ")"
+            if src:
+                out += f" — {src}"
+            return out
+        if self.fact == UNKNOWN:
+            return (f"{what}: divergence UNPROVABLE (predicate derives "
+                    f"from values outside the replication facts)")
+        return (f"{what}: value-uniform under current replication "
+                f"facts (facts assume unsharded feeds)")
+
+
+@dataclass
+class ProgramFacts:
+    """Result of one fixpoint run over a Program."""
+    program: Program
+    values: Dict[str, ValueFact] = field(default_factory=dict)
+    # id(op) -> guard stack (outermost first); only guarded ops appear
+    _guards: Dict[int, Tuple[GuardFact, ...]] = field(
+        default_factory=dict)
+    # every site, recorded in walk order (guarded or not)
+    sites: List[OpSite] = field(default_factory=list)
+    iterations: int = 0
+    converged: bool = True
+
+    def value(self, name: str) -> ValueFact:
+        return self.values.get(name, ValueFact(REPLICATED))
+
+    def guards(self, op: Operator) -> Tuple[GuardFact, ...]:
+        return self._guards.get(id(op), ())
+
+    def guarded_sites(self) -> Iterable[Tuple[OpSite,
+                                              Tuple[GuardFact, ...]]]:
+        for site in self.sites:
+            g = self._guards.get(id(site.op))
+            if g:
+                yield site, g
+
+    def divergent(self, guards: Tuple[GuardFact, ...]) -> bool:
+        return any(g.fact == VARYING for g in guards)
+
+    def unproven(self, guards: Tuple[GuardFact, ...]) -> bool:
+        return any(g.fact in (VARYING, UNKNOWN) for g in guards)
+
+
+# container op type -> input slot holding the branch predicate
+# (mirrors checkers.DIVERGENT_CONTAINERS; the kernels are in
+# ops/control_flow_ops.py and ops/lod_ops.py)
+_COND_SLOTS = {
+    "while": "Condition",
+    "run_block_if": "Condition",
+    "conditional_block": "Condition",
+    "ifelse": "Cond",
+}
+
+_MAX_ITERS = 16
+
+
+class _Interp:
+    """One fixpoint run. Values live in ONE name->fact map: var names
+    are program-unique in practice (sub-block kernels resolve parent
+    names by identity), and the join makes any accidental collision
+    err toward varying/unknown — conservative, never silently
+    uniform."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.values: Dict[str, ValueFact] = {}
+        self.guards: Dict[int, Tuple[GuardFact, ...]] = {}
+        self.sites: List[OpSite] = []
+        self.changed = False
+
+    def run(self) -> ProgramFacts:
+        iters = 0
+        converged = False
+        for iters in range(1, _MAX_ITERS + 1):
+            self.changed = False
+            self.guards.clear()
+            self.sites = []
+            for blk, container in self._top_blocks():
+                self._walk(blk, container, ())
+            if not self.changed:
+                converged = True
+                break
+        facts = ProgramFacts(self.program, dict(self.values),
+                             dict(self.guards), list(self.sites),
+                             iterations=iters, converged=converged)
+        return facts
+
+    def _top_blocks(self):
+        """Blocks NOT owned by a container op (the global block plus
+        strays); container-owned blocks are walked from their op so
+        guard stacks nest correctly."""
+        owned = set()
+        for blk, _ in iter_blocks(self.program):
+            for op in blk.ops:
+                for _, sub in iter_sub_blocks(op):
+                    owned.add(id(sub))
+        for blk, container in iter_blocks(self.program):
+            if id(blk) not in owned:
+                yield blk, container
+
+    def _value_of(self, name: str, blk: Block) -> ValueFact:
+        got = self.values.get(name)
+        if got is not None:
+            return got
+        # unwritten names — persistables, data vars, and undeclared
+        # feeds/companions alike — seed REPLICATED: the single-
+        # logical-device runtime materializes one value for everyone,
+        # and divergence must be proven positively through a marked
+        # source (PTA001 flags genuinely missing names)
+        return ValueFact(REPLICATED)
+
+    def _set(self, name: str, fact: ValueFact):
+        old = self.values.get(name)
+        new = fact if old is None else old.joined(fact)
+        if old != new:
+            self.values[name] = new
+            self.changed = True
+
+    def _transfer(self, op: Operator, blk: Block,
+                  site: OpSite) -> ValueFact:
+        tag = op.attrs.get(DIVERGENCE_ATTR)
+        if isinstance(tag, str) and tag:
+            return ValueFact(VARYING, tag, site.anchor())
+        axes = op.attrs.get(SHARDING_ATTR)
+        if axes:
+            return ValueFact(VARYING, f"sharding:{tuple(axes)}",
+                             site.anchor(), sharded=tuple(axes))
+        fact = ValueFact(REPLICATED)
+        for n in op.input_arg_names:
+            if n == EMPTY_VAR:
+                continue
+            fact = fact.joined(self._value_of(n, blk))
+        return fact
+
+    def _walk(self, blk: Block, container: Optional[Operator],
+              guard_stack: Tuple[GuardFact, ...]):
+        for i, op in enumerate(blk.ops):
+            site = OpSite(blk.idx, i, op, container)
+            self.sites.append(site)
+            if guard_stack:
+                self.guards[id(op)] = guard_stack
+            out_fact = self._transfer(op, blk, site)
+            for n in op.output_arg_names:
+                if n != EMPTY_VAR:
+                    self._set(n, out_fact)
+            subs = list(iter_sub_blocks(op))
+            if not subs:
+                continue
+            inner = guard_stack
+            cond_slot = _COND_SLOTS.get(op.type)
+            if cond_slot is not None:
+                cond_names = op.inputs.get(cond_slot) or []
+                cond = cond_names[0] if cond_names else None
+                cf = self._value_of(cond, blk) if cond else \
+                    ValueFact(UNKNOWN)
+                inner = guard_stack + (GuardFact(
+                    op.type, site.anchor(), cond, cf.repl,
+                    cf.source, cf.minted_at),)
+            for _, sub in subs:
+                self._walk(sub, op, inner)
+
+
+def analyze(program: Program) -> ProgramFacts:
+    """Run (or fetch the cached) fixpoint analysis for `program`.
+    The cache rides ON the program object (`_absint_cache`, keyed by
+    `_version` — the `fingerprint()` caching pattern), so PTA130 and
+    PTA131 share one run, Pass.apply's version bump invalidates it,
+    and a dead Program frees its facts with itself: a global
+    facts-by-uid map would pin every analyzed program's whole IR
+    (blocks/vars/ops via the recorded OpSites) for the life of a
+    serving process under model churn.
+
+    Reference counterpart: reference framework/op_desc.cc CheckAttrs
+    validates ONE op; a whole-program fixpoint over divergence/
+    replication facts is the jit-era gate with no reference analogue.
+    """
+    version = getattr(program, "_version", 0)
+    cached = getattr(program, "_absint_cache", None)
+    if cached is not None and cached[0] == version:
+        return cached[1]
+    facts = _Interp(program).run()
+    try:
+        program._absint_cache = (version, facts)
+    except AttributeError:
+        pass  # exotic program-likes without attribute space
+    return facts
+
+
+# --- symbolic shape/dtype: declared-vs-producer disagreements ---------------
+@dataclass(frozen=True)
+class DeclClobber:
+    """One var whose builder declaration was overwritten in place by
+    build-time shape inference (core/registry.py stashes the
+    original)."""
+    block_idx: int
+    name: str
+    declared_shape: Optional[tuple]
+    final_shape: Optional[tuple]
+    declared_dtype: Optional[str]
+    final_dtype: Optional[str]
+    persistable: bool
+    is_data: bool
+
+
+def declared_clobbers(program: Program) -> List[DeclClobber]:
+    """Every var carrying a stashed declaration that differs from its
+    final (producer-inferred) shape/dtype, in block order.
+
+    Reference counterpart: reference InferShape (framework/
+    shape_inference.h) RAISES on declared-vs-inferred disagreement;
+    the in-place Python IR overwrites instead, so the stash+sweep
+    recovers the check the reference got for free.
+    """
+    out: List[DeclClobber] = []
+    for blk, _ in iter_blocks(program):
+        for name, var in blk.vars.items():
+            ds = getattr(var, "_declared_shape", None)
+            dd = getattr(var, "_declared_dtype", None)
+            if ds is None and dd is None:
+                continue
+            final_shape = tuple(var.shape) if var.shape is not None \
+                else None
+            if ds is not None and final_shape == tuple(ds):
+                ds = None  # converged back: not a clobber
+            dtype_s = var.dtype.value if var.dtype is not None else None
+            decl_dtype_s = dd.value if dd is not None else None
+            if decl_dtype_s is not None and decl_dtype_s == dtype_s:
+                decl_dtype_s = None
+            if ds is None and decl_dtype_s is None:
+                continue
+            out.append(DeclClobber(
+                blk.idx, name,
+                tuple(ds) if ds is not None else None, final_shape,
+                decl_dtype_s, dtype_s,
+                bool(var.persistable), bool(var.is_data)))
+    return out
+
+
+def while_carried_names(program: Program) -> set:
+    """Names carried through while/run_block_if loops anywhere in the
+    program — the set whose dtype stability the lax.while_loop carry
+    contract depends on (PTA020/PTA140).
+
+    Reference counterpart: reference operators/controlflow/
+    while_op_helper.cc skip-eager-deletion var lists — the carried
+    set whose dtype/shape stability the loop depends on.
+    """
+    carried = set()
+    for blk, _ in iter_blocks(program):
+        for op in blk.ops:
+            if op.type in ("while", "run_block_if"):
+                names = op.attrs.get("carried")
+                if isinstance(names, (list, tuple)):
+                    carried.update(n for n in names
+                                   if isinstance(n, str))
+    return carried

@@ -1,0 +1,195 @@
+"""CI lint baseline: snapshot the zoo's diagnostic set, fail on drift.
+
+Reference counterpart: the reference gates programs one at a time at
+build (op_desc.cc CheckAttrs); a repo-wide DIAGNOSTIC SET gate has no
+reference analogue — it is the compile-time equivalent of a golden
+test. The strict CLI already fails on errors; once warnings matter
+(the divergence prover emits proof-carrying warnings whose regression
+is a real signal), "no new error-OR-warning anywhere in the 73-program
+zoo" needs a committed snapshot to diff against. That snapshot is
+``analysis_baseline.json`` at the repo root:
+
+* ``python -m paddle_tpu.analysis --write-baseline`` regenerates it
+  (review the diff like any golden change);
+* ``python -m paddle_tpu.analysis --baseline`` (CI, and the tier-1
+  gate test tests/test_analysis_gate.py in-process) exits 2 when any
+  NEW error-or-warning appears vs the snapshot — resolved findings
+  only print a refresh reminder, so fixes never fail the gate.
+
+Baseline keys are ``target|code|severity|op_type|var`` with counts —
+stable under op-index drift (message positions move; the finding
+class does not). Suppressed diagnostics (`_pta_suppress`) are
+recorded under their own section: suppressing is reviewable debt the
+baseline makes visible, not a disappearance.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .checkers import (Diagnostic, ERROR, INFO, WARNING, check_bundle,
+                       check_cross_model_collision, check_shared_params,
+                       run_checks)
+
+__all__ = ["TargetReport", "collect_reports", "baseline_payload",
+           "diff_against_baseline", "write_baseline", "load_baseline",
+           "default_baseline_path", "BASELINE_FILENAME"]
+
+BASELINE_FILENAME = "analysis_baseline.json"
+
+_PAIR_CHECKERS = {"shared_params": check_shared_params,
+                  "cross_model": check_cross_model_collision}
+
+
+@dataclass
+class TargetReport:
+    """Diagnostics for ONE linted program (or bundle) of the zoo."""
+    target: str
+    diagnostics: List[Diagnostic] = field(default_factory=list)
+    suppressed: List[Tuple[Diagnostic, str]] = field(
+        default_factory=list)
+
+    def by_severity(self, severity: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == severity]
+
+
+def collect_reports(include_benchmark: bool = True,
+                    only: Optional[List[str]] = None,
+                    targets=None) -> List[TargetReport]:
+    """Build (or accept pre-built) lint targets and run the FULL
+    sweep over each: per-program checkers (with suppressions
+    collected), the target's pairwise check, and the whole-bundle
+    contract check for every bundle the target ships. One code path
+    shared by the CLI and the tier-1 gate test — the gate must test
+    the sweep CI actually runs.
+
+    Reference counterpart: none — the reference gated one program at
+    a time at build (op_desc.cc); a repo-wide diagnostic sweep is the
+    CI-era extension (module docstring)."""
+    from .targets import iter_lint_targets
+
+    if targets is None:
+        targets = iter_lint_targets(
+            include_benchmark=include_benchmark, only=only)
+    reports: List[TargetReport] = []
+    for target in targets:
+        pair_check = _PAIR_CHECKERS[target.pair_check]
+        for label, prog in target.programs.items():
+            rep = TargetReport(f"{target.name}:{label}")
+            rep.diagnostics = run_checks(
+                prog, collect_suppressed=rep.suppressed)
+            for a, b in target.pairs:
+                if label == a:
+                    rep.diagnostics = rep.diagnostics + pair_check(
+                        target.programs[a], target.programs[b])
+            reports.append(rep)
+        for blabel, bundle in sorted(
+                getattr(target, "bundles", {}).items()):
+            rep = TargetReport(f"{target.name}:bundle/{blabel}")
+            rep.diagnostics = check_bundle(bundle)
+            reports.append(rep)
+    return reports
+
+
+def _key(target: str, d: Diagnostic) -> str:
+    return "|".join([target, d.code, d.severity, d.op_type or "",
+                     d.var or ""])
+
+
+def baseline_payload(reports: List[TargetReport]) -> dict:
+    """The committed snapshot: gated (error/warning) finding counts
+    per stable key, suppression counts, and info totals (recorded for
+    context, never gated — info findings are hygiene, and their
+    counts churn with every model tweak).
+
+    Reference counterpart: none (see diff_against_baseline)."""
+    entries: Dict[str, int] = {}
+    suppressed: Dict[str, int] = {}
+    n_err = n_warn = n_info = 0
+    for rep in reports:
+        for d in rep.diagnostics:
+            if d.severity == ERROR:
+                n_err += 1
+            elif d.severity == WARNING:
+                n_warn += 1
+            elif d.severity == INFO:
+                n_info += 1
+            if d.severity in (ERROR, WARNING):
+                k = _key(rep.target, d)
+                entries[k] = entries.get(k, 0) + 1
+        for d, _reason in rep.suppressed:
+            k = _key(rep.target, d)
+            suppressed[k] = suppressed.get(k, 0) + 1
+    return {
+        "version": 1,
+        "entries": {k: entries[k] for k in sorted(entries)},
+        "suppressed": {k: suppressed[k] for k in sorted(suppressed)},
+        "totals": {"errors": n_err, "warnings": n_warn,
+                   "infos": n_info, "targets": len(reports)},
+    }
+
+
+def diff_against_baseline(reports: List[TargetReport],
+                          baseline: dict):
+    """(new, resolved): `new` lists error/warning finding keys whose
+    count EXCEEDS the baseline's (the CI failure set); `resolved`
+    lists baseline keys now absent or reduced (print-and-refresh,
+    never a failure).
+
+    The SUPPRESSED section is diffed too: a new ``_pta_suppress``
+    would otherwise bypass both --strict (run_checks drops the
+    diagnostic) and the entries diff — a silent disappearance, the
+    exact thing this module promises not to allow. A new suppression
+    therefore FAILS the gate until the baseline is refreshed, which
+    forces the suppression into the committed analysis_baseline.json
+    diff where a reviewer sees it; once recorded, it never fails
+    again (the escape hatch stays usable, just visible).
+
+    Reference counterpart: none — a compile-time golden-diagnostic
+    drift gate has no reference analogue."""
+    payload = baseline_payload(reports)
+    new = []
+    resolved = []
+    for section, tag in (("entries", ""),
+                         ("suppressed", " [suppressed]")):
+        current = payload[section]
+        base = dict(baseline.get(section, {}))
+        for k, n in current.items():
+            extra = n - base.get(k, 0)
+            if extra > 0:
+                new.append(f"{k} (x{extra} new{tag})")
+        for k, n in base.items():
+            have = current.get(k, 0)
+            if have < n:
+                resolved.append(f"{k} (-{n - have}{tag})")
+    return sorted(new), sorted(resolved)
+
+
+def default_baseline_path() -> str:
+    """The committed snapshot lives at the repo root, next to the
+    BENCH_SELF records."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.abspath(os.path.join(here, "..", "..",
+                                        BASELINE_FILENAME))
+
+
+def write_baseline(reports: List[TargetReport],
+                   path: Optional[str] = None) -> str:
+    """Snapshot the sweep to `path` (default: the committed repo-root
+    file). Reference counterpart: none (see diff_against_baseline)."""
+    path = path or default_baseline_path()
+    with open(path, "w") as f:
+        json.dump(baseline_payload(reports), f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def load_baseline(path: Optional[str] = None) -> dict:
+    """Load a baseline snapshot (default: the committed repo-root
+    file). Reference counterpart: none (see diff_against_baseline)."""
+    path = path or default_baseline_path()
+    with open(path) as f:
+        return json.load(f)

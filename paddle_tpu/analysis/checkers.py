@@ -34,10 +34,28 @@ a stable diagnostic code so tests/docs can reference the class:
           attr disagreement: the counter-advance <= k+1 clamp and
           the accepted-prefix scatter's room clip are only sound
           when the declared k/max_len match the wired tensors)
+  PTA130  collective under divergent control flow, PROVEN (absint
+          guard contexts: subsumes PTA010/011, which remain as its
+          fast-path corroboration — every diagnostic carries the
+          per-guard divergence classification and source chain)
+  PTA131  replicated value differentiated / sharded value consumed
+          inside a divergent context (the r5 trap family: the grad
+          transpose of an implicit replicated->varying cast is a
+          psum, and an auto-axis sharding annotation reaching a
+          divergent site invites a GSPMD-inserted collective)
+  PTA140  declared shape/dtype clobbered by producer inference (the
+          r10 'shape inference CLOBBERS a declared persistable'
+          class; generalizes PTA020's int->float promotion beyond
+          the `increment` special case)
+  PTA150  decode-bundle contract (check_bundle: all serve/admission/
+          step specializations of one DecodeStepBundle must agree on
+          cache geometry, seed derivation, and counter presence)
 
 Severities: "error" = the program is wrong (strict mode raises),
 "warning" = almost certainly a bug but a legal feed/scope could save
-it, "info" = hygiene finding. `run_checks(program)` runs everything.
+it, "info" = hygiene finding. `run_checks(program)` runs everything;
+per-site suppressions ride the ``_pta_suppress=("PTA0xx", "reason")``
+op attr (counted, surfaced in the CLI's --json and the CI baseline).
 """
 from __future__ import annotations
 
@@ -54,11 +72,18 @@ from .dataflow import (BlockDataflow, OpSite, analyze_block,
 
 __all__ = ["Diagnostic", "Checker", "register_checker", "run_checks",
            "check_registry", "check_shared_params", "check_clone_uids",
-           "check_cross_model_collision",
+           "check_cross_model_collision", "check_bundle",
            "registered_checkers", "format_diagnostics",
-           "ERROR", "WARNING", "INFO"]
+           "ERROR", "WARNING", "INFO", "SUPPRESS_ATTR"]
 
 ERROR, WARNING, INFO = "error", "warning", "info"
+
+# per-site diagnostic suppression: an op carrying
+# _pta_suppress=("PTA0xx", "reason") — or a list/tuple of such pairs —
+# silences diagnostics of that code ANCHORED AT that op. Suppressions
+# are counted and surfaced (CLI --json `suppressed`, CI baseline), so
+# they are reviewable debt, not disappearances.
+SUPPRESS_ATTR = "_pta_suppress"
 
 # ops the Executor skips at trace time (core/executor.py _SKIP_OP_TYPES
 # plus the feed/fetch placeholders that are never registered)
@@ -153,17 +178,80 @@ def registered_checkers() -> List[Checker]:
     return [_CHECKERS[c] for c in sorted(_CHECKERS)]
 
 
+def _normalize_suppressions(raw):
+    """Accept ("PTA0xx", "reason") or a list/tuple of such pairs;
+    return [(code, reason)] or None for a malformed attr."""
+    if isinstance(raw, (list, tuple)) and len(raw) == 2 and \
+            all(isinstance(x, str) for x in raw):
+        raw = [raw]
+    if not isinstance(raw, (list, tuple)):
+        return None
+    out = []
+    for entry in raw:
+        if not (isinstance(entry, (list, tuple)) and len(entry) == 2
+                and all(isinstance(x, str) for x in entry)
+                and re.fullmatch(r"PTA\d{3}", entry[0])):
+            return None
+        out.append((entry[0], entry[1]))
+    return out
+
+
+def _collect_suppressions(program: Program):
+    """(block_idx, op_idx, code) -> reason, plus malformed-attr
+    diagnostics (a suppression that silently failed to parse would be
+    a suppression that silently does nothing)."""
+    sup: Dict[tuple, str] = {}
+    malformed: List[Diagnostic] = []
+    for site in iter_ops(program):
+        raw = site.op.attrs.get(SUPPRESS_ATTR)
+        if raw is None:
+            continue
+        entries = _normalize_suppressions(raw)
+        if entries is None:
+            malformed.append(_diag_at(
+                "PTA199", WARNING, site,
+                f"malformed {SUPPRESS_ATTR} attr {raw!r}; expected "
+                f"(\"PTA0xx\", \"reason\") or a list of such pairs "
+                f"— the suppression is IGNORED",
+                hint="fix the attr; nothing is suppressed until it "
+                     "parses"))
+            continue
+        for code, reason in entries:
+            sup[(site.block_idx, site.op_idx, code)] = reason
+    return sup, malformed
+
+
 def run_checks(program: Program,
-               only: Optional[Iterable[str]] = None) -> List[Diagnostic]:
+               only: Optional[Iterable[str]] = None,
+               collect_suppressed: Optional[list] = None
+               ) -> List[Diagnostic]:
     """Run every registered checker (or the `only` subset of codes)
     over `program`; returns diagnostics sorted error-first, stable
-    within severity."""
+    within severity. Diagnostics anchored at an op carrying a matching
+    ``_pta_suppress`` attr are dropped from the return value and — when
+    `collect_suppressed` is a list — appended to it as
+    (diagnostic, reason) pairs so callers (CLI --json, the CI
+    baseline) can count and surface them."""
     codes = set(only) if only is not None else None
     out: List[Diagnostic] = []
     for checker in registered_checkers():
         if codes is not None and checker.code not in codes:
             continue
         out.extend(checker.fn(program))
+    sup, malformed = _collect_suppressions(program)
+    if malformed and (codes is None or "PTA199" in codes):
+        out.extend(malformed)
+    if sup:
+        kept = []
+        for d in out:
+            reason = None
+            if d.op_idx is not None:
+                reason = sup.get((d.block_idx, d.op_idx, d.code))
+            if reason is None:
+                kept.append(d)
+            elif collect_suppressed is not None:
+                collect_suppressed.append((d, reason))
+        out = kept
     rank = {ERROR: 0, WARNING: 1, INFO: 2}
     out.sort(key=lambda d: (rank.get(d.severity, 3), d.code,
                             d.block_idx, d.op_idx or 0))
@@ -1090,3 +1178,388 @@ def check_registered(program: Program):
                 f"op type {site.op.type!r} has no registered kernel "
                 f"(core/registry.py)",
                 hint="register the op or remove it from the program")
+
+
+# ---------------------------------------------------------------------------
+# PTA130/PTA131: the divergence & sharding prover (analysis/absint.py
+# abstract interpretation — whole-program fixpoint over divergence
+# contexts and the replication lattice).
+# ---------------------------------------------------------------------------
+def _guard_proof(facts, guards) -> str:
+    lines = [g.describe() for g in guards]
+    return "; ".join(lines)
+
+
+@register_checker("PTA130", "divergence-proof-collective")
+def check_collective_divergence_proof(program: Program):
+    """The PROOF form of PTA010/011: for every collective site, the
+    abstract interpreter computes the full guard context (every
+    while/cond predicate the site executes under, transitively) and
+    classifies each predicate on the replication lattice. A collective
+    under ANY traced guard is an ERROR — same stance as PTA010, so
+    PTA130's findings are a superset by construction — but the
+    diagnostic now carries the proof: a guard PROVEN divergent names
+    its divergence source and mint site (the r5 deadlock explained,
+    not pattern-matched); an unprovable guard says what is missing;
+    a value-uniform guard says which replication assumptions the
+    safety would rest on. Scope-dependent collectives (attention/
+    switch_moe under cp/ep scopes) mirror PTA011 at WARNING, upgraded
+    to ERROR when a guard is proven divergent — under a per-lane/
+    per-stage predicate the scoped lowering WILL deadlock."""
+    from . import absint
+
+    facts = absint.analyze(program)
+    scope_hits: Dict[tuple, list] = {}
+    for site, guards in facts.guarded_sites():
+        op = site.op
+        if _is_collective(op):
+            proven = facts.divergent(guards)
+            yield _diag_at(
+                "PTA130", ERROR, site,
+                f"collective op {op.type!r} executes under "
+                f"{len(guards)} traced guard(s) "
+                f"[{_guard_proof(facts, guards)}] — "
+                + ("participants PROVABLY disagree on whether/in "
+                   "which order it runs: deadlock" if proven else
+                   "collective order under traced control flow "
+                   "cannot be verified: hoist it"),
+                var=(op.output_arg_names or [None])[0],
+                hint="hoist the collective out of the branch and mask "
+                     "its input instead (psum of a zeroed "
+                     "contribution is the identity)")
+        elif op.type in SCOPE_COLLECTIVE_OP_TYPES:
+            key = (guards[-1].container_anchor, op.type)
+            scope_hits.setdefault(key, []).append((site, guards))
+    for (anchor, op_type), entries in sorted(scope_hits.items()):
+        site, guards = entries[0]
+        proven = facts.divergent(guards)
+        sev = ERROR if proven else WARNING
+        yield _diag_at(
+            "PTA130", sev, site,
+            f"{len(entries)} {op_type!r} op(s) under traced guard(s) "
+            f"of {anchor} [{_guard_proof(facts, guards)}] lower to "
+            f"shard_map collectives under context/expert-parallel "
+            f"scopes"
+            + (" — and the guard is PROVEN divergent, so the scoped "
+               "lowering deadlocks" if proven else
+               "; there they become branch-internal collectives "
+               "and deadlock"),
+            hint=f"keep parallel-scope models' {op_type} ops out of "
+                 f"divergent branches, or run this program only "
+                 f"outside those scopes")
+
+
+@register_checker("PTA131", "replicated-in-divergent-context")
+def check_replicated_in_divergent_context(program: Program):
+    """The r5 trap family, proven from the replication lattice:
+
+    (a) a grad op inside a divergent context producing a gradient for
+        a REPLICATED forward input — the transpose of the implicit
+        replicated->varying broadcast is a psum, and it lands INSIDE
+        the branch: participants on other paths never post it, so the
+        program deadlocks. The fix is the r5 `_vary` discipline: cast
+        the input varying BEFORE the divergent region
+        (absint.mark_divergence_source(v, "vary")) and mask-psum
+        after.
+    (b) a value carrying an auto-axis sharding annotation
+        (absint.mark_sharded / a `sharding_axes` attr) consumed inside
+        a divergent context — GSPMD is free to materialize the
+        resharding collective at the consumption site, i.e. inside
+        the branch (the r6 generalized trap: 1F1B x tp's
+        vocab-sharded logits psum).
+
+    ERROR when a guard is PROVEN divergent; WARNING when divergence is
+    unprovable; silent when every guard is value-uniform (every mesh
+    program instance takes the same path, so implied collectives
+    match up — this is exactly what the uniformity proof buys)."""
+    from . import absint
+
+    facts = absint.analyze(program)
+    for site, guards in facts.guarded_sites():
+        if not facts.unproven(guards):
+            continue  # all guards proven value-uniform
+        sev = ERROR if facts.divergent(guards) else WARNING
+        op = site.op
+        is_grad = op.type.endswith("_grad") or \
+            op.attrs.get("op_role") == "backward"
+        if is_grad:
+            flagged = set()
+            for g in op.output_arg_names:
+                if not g.endswith(GRAD_MARK) or g in flagged:
+                    continue
+                x = g[:-len(GRAD_MARK)]
+                if facts.value(x).repl != "replicated":
+                    continue  # varying input: the r5 fix was applied
+                flagged.add(g)
+                yield _diag_at(
+                    "PTA131", sev, site,
+                    f"grad op {op.type!r} differentiates "
+                    f"REPLICATED input {x!r} inside divergent "
+                    f"control flow [{_guard_proof(facts, guards)}]: "
+                    f"the transpose of the implicit replicated->"
+                    f"varying cast is a psum INSIDE the branch — "
+                    f"participants on other paths never post it",
+                    var=x,
+                    hint="make the input varying BEFORE the branch "
+                         "(absint.mark_divergence_source(v, 'vary')) "
+                         "and mask-psum after — the r5 1F1B fix")
+        for n in op.input_arg_names:
+            if n == EMPTY_VAR:
+                continue
+            vf = facts.value(n)
+            if vf.sharded is None:
+                continue
+            yield _diag_at(
+                "PTA131", sev, site,
+                f"op {op.type!r} consumes {n!r}, which carries the "
+                f"auto-axis sharding annotation {vf.sharded} "
+                f"(minted at {vf.minted_at}), inside divergent "
+                f"control flow [{_guard_proof(facts, guards)}]: "
+                f"GSPMD may materialize the resharding collective "
+                f"at this site — inside the branch",
+                var=n,
+                hint="apply the sharding constraint OUTSIDE the "
+                     "divergent region (CLAUDE.md r5: ONE "
+                     "with_sharding_constraint on the pre-branch "
+                     "value)")
+
+
+GRAD_MARK = "@GRAD"
+
+
+# ---------------------------------------------------------------------------
+# PTA140: declared shape/dtype clobbered by producer inference.
+# ---------------------------------------------------------------------------
+@register_checker("PTA140", "declared-shape-clobber")
+def check_declared_clobbers(program: Program):
+    """Build-time shape inference overwrites a var's DECLARED shape/
+    dtype with the producer's inferred one, in place (the r10
+    incident: assign of a [-1,4] value onto a concretely-declared
+    persistable rewrites it to [-1,4] — and every contract hanging
+    off the declaration, scan-carry seeding, feed validation, PTA090
+    concreteness, silently moves with it). core/registry.py stashes
+    the pre-clobber declaration; this checker surfaces the
+    disagreements:
+
+    * a persistable/data var declared with a CONCRETE shape whose
+      producer re-inferred it differently — ERROR (the declaration
+      was a contract; the producer broke it);
+    * an integer-declared CONTRACT var (persistable, data, or a
+      while/run_block_if carry) whose producer promoted it to float —
+      the PTA020 int->float promotion generalized beyond `increment`:
+      ERROR when the var is a while carry (the lax.while_loop carry
+      dtype breaks), WARNING elsewhere. Arithmetic temps are exempt:
+      an int scaled by a float step legitimately becomes float — only
+      dtypes some contract hangs off are findings."""
+    from . import absint
+
+    clobbers = absint.declared_clobbers(program)
+    if not clobbers:
+        return
+    carried = absint.while_carried_names(program)
+    for c in clobbers:
+        if c.declared_shape is not None and \
+                (c.persistable or c.is_data) and \
+                all(d is not None and d >= 0
+                    for d in c.declared_shape):
+            yield Diagnostic(
+                "PTA140", ERROR,
+                f"{'persistable' if c.persistable else 'data'} var "
+                f"{c.name!r} was DECLARED with concrete shape "
+                f"{c.declared_shape} but build-time shape inference "
+                f"clobbered it to {c.final_shape} from its producer "
+                f"— the declared feed/carry contract silently moved",
+                block_idx=c.block_idx, var=c.name,
+                hint="make the producer emit the declared shape (a "
+                     "static-batch producer pins it — the PTA090 "
+                     "test discipline), or declare the var with the "
+                     "producer's real shape")
+        if c.declared_dtype is not None and \
+                _is_int_dtype_str(c.declared_dtype) and \
+                c.final_dtype is not None and \
+                c.final_dtype.startswith("float") and \
+                (c.persistable or c.is_data or c.name in carried):
+            sev = ERROR if c.name in carried else WARNING
+            yield Diagnostic(
+                "PTA140", sev,
+                f"var {c.name!r} was DECLARED {c.declared_dtype} but "
+                f"its producer promoted it to {c.final_dtype}"
+                + (" and it is a while-loop carry: the "
+                   "lax.while_loop carry dtype breaks (PTA020 "
+                   "generalized)" if sev == ERROR else
+                   " (PTA020's int->float promotion, generalized "
+                   "beyond `increment`)"),
+                block_idx=c.block_idx, var=c.name,
+                hint="keep integer state integer: int steps, int "
+                     "fill_constants, explicit casts at the float "
+                     "boundary")
+
+
+# ---------------------------------------------------------------------------
+# PTA150: whole-bundle contracts (DecodeStepBundle as ONE lint unit).
+# ---------------------------------------------------------------------------
+def _bundle_programs(bundle):
+    """(label, program) for every program a DecodeStepBundle ships.
+    Duck-typed: analysis stays IR-level and never imports
+    models/decode_engine."""
+    out = []
+    for a, p in sorted(getattr(bundle, "prefills", {}).items()):
+        out.append((f"prefill{a}", p))
+    for a, p in sorted(getattr(bundle, "hit_prefills", {}).items()):
+        out.append((f"hit_prefill{a}", p))
+    step = getattr(bundle, "step", None)
+    if step is not None:
+        out.append(("step", step))
+    for key, p in sorted(getattr(bundle, "serves", {}).items(),
+                         key=lambda kv: str(kv[0])):
+        out.append((f"serve{key}", p))
+    return out
+
+
+def _persistable_decls(program):
+    """name -> (shape, dtype) as the BUILDER declared it: the stashed
+    pre-clobber declaration (core/registry.py) beats the final
+    inferred metadata — e.g. with x64 disabled, inference
+    canonicalizes a declared int64 persistable to int32 on every
+    program identically, which is not a bundle disagreement."""
+    decls = {}
+    for blk, _ in iter_blocks(program):
+        for name, var in blk.vars.items():
+            if not var.persistable or name in decls:
+                continue
+            shape = getattr(var, "_declared_shape", None)
+            if shape is None:
+                shape = tuple(var.shape) if var.shape is not None \
+                    else None
+            dtype = getattr(var, "_declared_dtype", None) or var.dtype
+            decls[name] = (shape,
+                           dtype.value if dtype is not None else None)
+    return decls
+
+
+def check_bundle(bundle) -> List[Diagnostic]:
+    """PTA150: lint a whole DecodeStepBundle as ONE unit. The bundle's
+    programs are SPECIALIZATIONS over shared scope state — one
+    admission flavor per bucket, a standalone step, the fused serves —
+    and the serving layer dispatches them interchangeably against the
+    same scope, so they must agree on:
+
+    * **cache geometry** — every slot-state var (`_state_specs`) and
+      every shared persistable must be declared with IDENTICAL
+      shape/dtype in every program that touches it: a serve
+      specialization disagreeing with the step program corrupts the
+      scope the other programs read (today only pairwise
+      `pair_check`s existed; this is the n-way sweep);
+    * **counter presence** — the bundle's `state` vars (token buffer,
+      step/finished/active masks, spec counters) must be declared in
+      the step program and every serve: a specialization missing one
+      silently decodes against stale state;
+    * **seed derivation** — every sampling/acceptance op that carries
+      a `base_seed` attr must carry the SAME value across all
+      specializations: the r14 replay contract keys noise purely on
+      (base_seed, request seed, position), so a serve specialization
+      with a drifted base_seed emits different tokens for the same
+      request depending on which program the scheduler happened to
+      dispatch.
+
+    Reference counterpart: op_desc.cc validates ONE program; the
+    bundle gate is the capability the whole-block-jit serving path
+    needs instead."""
+    out: List[Diagnostic] = []
+    progs = _bundle_programs(bundle)
+    if not progs:
+        return out
+    specs = dict(getattr(bundle, "_state_specs", {}) or {})
+    state = dict(getattr(bundle, "state", {}) or {})
+
+    decls_by_prog = {label: _persistable_decls(p)
+                     for label, p in progs}
+
+    # cache geometry: spec agreement + n-way cross-program agreement
+    for name, (shape, dt) in sorted(specs.items()):
+        want = (tuple(shape), str(np_dtype_name(dt)))
+        for label, decls in decls_by_prog.items():
+            got = decls.get(name)
+            if got is None:
+                continue
+            got_n = (got[0], np_dtype_name(got[1])
+                     if got[1] is not None else None)
+            if got_n != want:
+                out.append(Diagnostic(
+                    "PTA150", ERROR,
+                    f"bundle program {label!r} declares slot-state "
+                    f"var {name!r} as {got_n} but the bundle's state "
+                    f"spec says {want}: the specializations share "
+                    f"ONE scope — a geometry disagreement corrupts "
+                    f"it", var=name,
+                    hint="every specialization must declare slot "
+                         "state from the same _slot_state_specs "
+                         "table"))
+    seen: Dict[str, tuple] = {}
+    for label, decls in sorted(decls_by_prog.items()):
+        for name, got in sorted(decls.items()):
+            if name in specs:
+                continue  # already checked against the spec table
+            prev = seen.get(name)
+            if prev is None:
+                seen[name] = (label, got)
+            elif prev[1] != got and None not in (prev[1][0], got[0]):
+                out.append(Diagnostic(
+                    "PTA150", ERROR,
+                    f"bundle programs {prev[0]!r} and {label!r} "
+                    f"declare shared persistable {name!r} with "
+                    f"different shape/dtype ({prev[1]} vs {got}): "
+                    f"one scope serves both", var=name))
+
+    # counter presence
+    must_have = [(label, p) for label, p in progs
+                 if label == "step" or label.startswith("serve")]
+    for logical, name in sorted(state.items()):
+        for label, _p in must_have:
+            if name not in decls_by_prog[label]:
+                out.append(Diagnostic(
+                    "PTA150", ERROR,
+                    f"bundle program {label!r} does not declare the "
+                    f"bundle state var {name!r} (logical "
+                    f"{logical!r}): it would decode against stale "
+                    f"or missing scope state", var=name))
+
+    # seed derivation
+    base_seeds: Dict[str, Dict[object, str]] = {}
+    for label, p in progs:
+        for site in iter_ops(p):
+            bs = site.op.attrs.get("base_seed")
+            if bs is None:
+                continue
+            base_seeds.setdefault(site.op.type, {}).setdefault(
+                bs, label)
+    for op_type, values in sorted(base_seeds.items()):
+        if len(values) > 1:
+            detail = ", ".join(
+                f"{v!r} (first in {label!r})"
+                for v, label in sorted(values.items(),
+                                       key=lambda kv: str(kv[0])))
+            out.append(Diagnostic(
+                "PTA150", ERROR,
+                f"bundle specializations disagree on {op_type!r} "
+                f"base_seed: {detail} — the same logical draw must "
+                f"be byte-identical in every specialization (the "
+                f"r14 replay contract), so one bundle has ONE "
+                f"base_seed",
+                hint="derive every specialization's sampling ops "
+                     "from the bundle's single SamplingConfig/"
+                     "DraftConfig base_seed"))
+    return out
+
+
+def np_dtype_name(dt) -> str:
+    """Canonical dtype string for bundle-spec comparison ('int64',
+    'float32', ...): accepts numpy dtypes/strings/DataType values.
+    Reference counterpart: framework/data_type.h ToDataType's
+    proto-enum canonicalization, reduced to numpy names."""
+    import numpy as np
+
+    try:
+        return np.dtype(dt).name
+    except TypeError:
+        return str(getattr(dt, "value", dt))

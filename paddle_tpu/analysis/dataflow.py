@@ -23,7 +23,8 @@ from ..core.program import Block, Operator, Program
 from ..core.registry import EMPTY_VAR
 
 __all__ = ["BlockDataflow", "analyze_block", "iter_sub_blocks",
-           "iter_blocks", "iter_ops", "OpSite", "block_entry_names"]
+           "iter_blocks", "iter_ops", "OpSite", "block_entry_names",
+           "register_block_entry_attrs", "BLOCK_ENTRY_ATTRS"]
 
 
 @dataclass(frozen=True)
@@ -128,16 +129,68 @@ def iter_ops(program: Program) -> Iterator[OpSite]:
             yield OpSite(blk.idx, i, op, container)
 
 
+# op type -> the attr names whose string lists genuinely SEED the
+# sub-block environment (read straight from the kernels:
+# ops/control_flow_ops.py builds while/run_block_if envs from
+# externals+carried and conditional_block's from its X inputs only;
+# ops/lod_ops.py builds ifelse branch envs from externals and
+# recurrent step envs from externals + per-step x_names + pre_names).
+# Output-name lists (true_out/false_out, out_names, mem_names) are
+# PRODUCED inside the block — treating them as entries (the old
+# any-all-str-list heuristic) over-seeded PTA001 and masked true
+# uninitialized reads.
+BLOCK_ENTRY_ATTRS: Dict[str, Tuple[str, ...]] = {
+    "while": ("carried", "externals"),
+    "run_block_if": ("carried", "externals"),
+    "conditional_block": (),
+    "ifelse": ("externals",),
+    "recurrent": ("externals", "x_names", "pre_names"),
+    "go": (),
+}
+
+_ENTRY_FALLBACK_WARNED: set = set()
+
+
+def register_block_entry_attrs(op_type: str,
+                               attr_names: Tuple[str, ...]) -> None:
+    """Register which of a NEW container op's list attrs seed its
+    sub-block environment (mirrors core/registry.register_op: a
+    sub-block-carrying op added without an entry registration falls
+    back to the permissive heuristic with a warn-once, so the gap is
+    visible instead of silent)."""
+    BLOCK_ENTRY_ATTRS[op_type] = tuple(attr_names)
+
+
 def block_entry_names(op: Operator) -> set:
     """Names a control-flow op's sub-block environment starts with.
 
-    The sub-block kernels (ops/control_flow_ops.py while / run_block_if
-    / conditional_block, ops/lod_ops.py recurrent / ifelse) build a
-    FRESH env from the op's declared inputs plus name lists carried in
-    attrs (carried / externals / x_names / pre_names ...): parent-block
-    vars are NOT visible unless declared. This is the seed set an
-    uninitialized-read analysis of the sub-block must start from."""
+    The sub-block kernels build a FRESH env: parent-block vars are NOT
+    visible unless declared through the op's inputs or the registered
+    entry-name attrs (BLOCK_ENTRY_ATTRS). This is the seed set an
+    uninitialized-read analysis of the sub-block must start from.
+
+    Unregistered container op types fall back to the old permissive
+    heuristic — every all-str list attr counts — with a warn-once:
+    over-seeding can MASK true uninitialized reads (PTA001), so the
+    fallback is a visible stopgap, not the contract."""
     names = set(op.input_arg_names)
+    registered = BLOCK_ENTRY_ATTRS.get(op.type)
+    if registered is not None:
+        for attr in registered:
+            v = op.attrs.get(attr)
+            if isinstance(v, (list, tuple)):
+                names.update(x for x in v if isinstance(x, str))
+        return names
+    if op.type not in _ENTRY_FALLBACK_WARNED:
+        _ENTRY_FALLBACK_WARNED.add(op.type)
+        import warnings
+
+        warnings.warn(
+            f"block_entry_names: container op type {op.type!r} has no "
+            f"registered entry-name attrs; falling back to the "
+            f"permissive any-all-str-list heuristic, which can mask "
+            f"uninitialized-read findings (PTA001). Register it via "
+            f"analysis.dataflow.register_block_entry_attrs.")
     for v in op.attrs.values():
         if isinstance(v, (list, tuple)) and v and all(
                 isinstance(x, str) for x in v):

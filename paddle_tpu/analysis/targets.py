@@ -17,7 +17,10 @@ lists (label_a, label_b) program pairs for the pairwise sweep named by
 weights by name through one scope, check_shared_params/PTA051) or
 "cross_model" (co-resident but UNRELATED serving-runtime models,
 check_cross_model_collision/PTA100, where any name overlap is the
-defect).
+defect). Targets that build DecodeStepBundles also carry them in
+``bundles`` (label -> bundle) so the whole-bundle contract sweep
+(checkers.check_bundle / PTA150) lints each bundle AS A UNIT — the
+per-program sweep cannot see cross-specialization disagreements.
 """
 from __future__ import annotations
 
@@ -33,6 +36,7 @@ class LintTarget:
     programs: Dict[str, object]              # label -> Program
     pairs: List[Tuple[str, str]] = field(default_factory=list)
     pair_check: str = "shared_params"        # or "cross_model"
+    bundles: Dict[str, object] = field(default_factory=dict)
 
 
 def _mnist():
@@ -163,7 +167,12 @@ def _transformer():
              ("main", f"pg_serve_hit{pbig}"),
              ("main", "sp_step"), ("main", f"sp_serve{sbig}"),
              ("main", f"sps_serve_miss{psbig}"),
-             ("main", "smp_step")])
+             ("main", "smp_step")],
+            "shared_params",
+            # whole-bundle contract sweep (PTA150): every bundle the
+            # repo ships, checked as a unit
+            {"cb": bundle, "pg": paged, "sp": spec, "sps": pspec,
+             "smp": sampled})
 
 
 def _moe_transformer():
@@ -265,8 +274,9 @@ def iter_lint_targets(include_benchmark: bool = True,
         built = build()
         programs, pairs = built[0], built[1]
         pair_check = built[2] if len(built) > 2 else "shared_params"
+        bundles = built[3] if len(built) > 3 else {}
         yield LintTarget(f"models/{name}", programs, pairs,
-                         pair_check=pair_check)
+                         pair_check=pair_check, bundles=bundles)
     if include_benchmark and not only:
         try:
             yield from _benchmark_targets()

@@ -267,10 +267,69 @@ def _probe_spec(var, probe):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def _snapshot_output_decls(op: Operator, block: Block):
+    """Pre-inference (var, shape, dtype) of the op's existing output
+    vars — the evidence base for the PTA140 declared-shape-clobber
+    checker (analysis/checkers.py). Output names with NO var yet are
+    recorded as (name, None): the var the inference pass creates for
+    them is inference-shaped from birth, never a declaration."""
+    snap = []
+    missing = []
+    for n in op.output_arg_names:
+        v = block._find_var_recursive(n)
+        if v is not None:
+            snap.append((v, v.shape, v.dtype))
+        else:
+            missing.append(n)
+    return snap, missing
+
+
+def _record_decl_clobbers(snap) -> None:
+    """Build-time shape inference OVERWRITES a var's declared
+    shape/dtype with the producer's inferred one, in place (the r10
+    incident: assign of a [-1,4] value onto a concretely-declared
+    persistable rewrites it to [-1,4], silently breaking the var's
+    feed/carry contract). The declaration is unrecoverable after the
+    fact, so this hook stashes it on FIRST clobber: a shape/dtype that
+    was present before any inference pass changed it is the builder's
+    declaration (`_declared_shape`/`_declared_dtype`); shapes a prior
+    inference pass itself wrote (`_shape_inferred`) are producer
+    facts, not declarations — multi-writer temps never false-positive.
+    The PTA140 checker reads the stash."""
+    for v, shape0, dtype0 in snap:
+        if v.shape != shape0:
+            if shape0 is not None and \
+                    not getattr(v, "_shape_inferred", False) and \
+                    not hasattr(v, "_declared_shape"):
+                v._declared_shape = tuple(shape0)
+            v._shape_inferred = True
+        if v.dtype != dtype0:
+            if dtype0 is not None and \
+                    not getattr(v, "_dtype_inferred", False) and \
+                    not hasattr(v, "_declared_dtype"):
+                v._declared_dtype = dtype0
+            v._dtype_inferred = True
+
+
 def infer_shape_for_op(op: Operator, block: Block) -> None:
     info = _REGISTRY.get(op.type)
     if info is None:
         return  # unregistered (e.g. feed/fetch placeholders) -- skip
+    snap, missing = _snapshot_output_decls(op, block)
+    try:
+        _infer_shape_for_op(op, block, info)
+    finally:
+        _record_decl_clobbers(snap)
+        for n in missing:
+            v = block._find_var_recursive(n)
+            if v is not None:
+                # created by this inference pass: its metadata is a
+                # producer fact from birth, never a declaration
+                v._shape_inferred = True
+                v._dtype_inferred = True
+
+
+def _infer_shape_for_op(op: Operator, block: Block, info) -> None:
     if info.infer_shape is not None:
         info.infer_shape(op, block)
         return

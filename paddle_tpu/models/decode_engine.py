@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import layers
+from ..analysis import absint
 from ..param_attr import ParamAttr
 
 # fixed-name [1] int64 var holding the number of While iterations a
@@ -1780,7 +1781,7 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             def _serve_cond(cond=None):
                 # ticks remain AND live lanes exceed the exit
                 # threshold: min(a, b) > 0
-                return layers.greater_than(
+                out = layers.greater_than(
                     layers.elementwise_min(
                         layers.elementwise_sub(n_steps, k),
                         layers.elementwise_sub(
@@ -1788,6 +1789,16 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                             min_active)),
                     layers.fill_constant([1], "int64", 0.0),
                     cond=cond)
+                # divergence-source annotation (analysis/absint.py
+                # seed table): this predicate derives from the
+                # per-lane active mask — the moment PR 12 shards
+                # lanes across a dp mesh axis it differs per device,
+                # and the burst While becomes divergent control
+                # flow. The prover (PTA130/131) uses the mark to
+                # REJECT collectives/sharded values inside the burst
+                # with a proof instead of a pattern guess.
+                absint.mark_divergence_source(out, "lane_active_mask")
+                return out
 
             cond = _serve_cond()
             w = layers.While(cond)

@@ -100,15 +100,29 @@ def build_1f1b_step(tr, extra_fetches=()):
             "the loop is a plain lax.scan and GPipe/1F1B are the same "
             "program; use schedule='gpipe')")
     if tr.tp > 1:
+        # The IR-level form of this trap is now PROVABLE instead of
+        # hand-rejected: the per-stage F/B predicates are exactly the
+        # "pp_stage_id" divergence source in the absint seed table
+        # (analysis/absint.py), and a collective/sharding annotation
+        # under such a predicate is PTA130/131 at ERROR. This named
+        # rejection stays as the jax-level belt-and-braces for THIS
+        # engine, whose schedule never goes through the Program IR.
+        from ..analysis import absint as _absint
+
+        assert "pp_stage_id" in _absint.divergence_sources(), \
+            "absint seed table lost the pp_stage_id divergence " \
+            "source the 1F1B rejection is grounded in"
         raise PipelinePartitionError(
             "schedule='1f1b' does not compose with tp: the schedule "
             "selects F/B work per stage with lax.cond, and tp-sharded "
             "params force GSPMD to insert tp collectives INSIDE the "
             "divergent branches — devices at different pp coordinates "
             "then disagree on the collective sequence and deadlock "
-            "(observed on the 8-dev CPU mesh). Use schedule='gpipe' "
-            "for pp x tp meshes ('dp' composes fine: nothing sharded "
-            "forces a branch-internal collective).")
+            "(observed on the 8-dev CPU mesh; the Program-IR form of "
+            "this trap is checker PTA130/131's proof domain). Use "
+            "schedule='gpipe' for pp x tp meshes ('dp' composes "
+            "fine: nothing sharded forces a branch-internal "
+            "collective).")
     loop_secs = [s for s in tr.sections if s.kind == "loop"]
     if len(loop_secs) != 1:
         raise PipelinePartitionError(

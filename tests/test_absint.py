@@ -1,0 +1,730 @@
+"""Divergence & sharding prover tests (paddle_tpu/analysis/absint).
+
+The crafted positive fixtures re-build the two REAL incidents the
+prover exists for (CLAUDE.md round-5 learnings):
+
+* the 1F1B x tp trap — a vocab-sharded logits psum landing inside a
+  per-STAGE lax.cond branch, so devices at different pp coordinates
+  disagree on the collective order and deadlock (PTA130 at ERROR,
+  with the divergence source named in the proof);
+* the replicated-input-grad trap — differentiating a REPLICATED
+  input inside a divergent branch, whose transpose psum lands inside
+  the branch (PTA131 at ERROR; applying the r5 `_vary` fix silences
+  it).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.analysis import (ERROR, INFO, WARNING, absint,
+                                 check_bundle, run_checks)
+
+
+def _diags(program, code):
+    return [d for d in run_checks(program) if d.code == code]
+
+
+def _guarded():
+    main, startup = fluid.Program(), fluid.Program()
+    return main, startup, fluid.program_guard(main, startup)
+
+
+# ---------------------------------------------------------------------------
+# engine basics: lattice, seed table, marking
+# ---------------------------------------------------------------------------
+class TestEngine:
+    def test_join_order(self):
+        assert absint.join(absint.REPLICATED, absint.VARYING) \
+            == absint.VARYING
+        assert absint.join(absint.VARYING, absint.UNKNOWN) \
+            == absint.UNKNOWN
+        assert absint.join(absint.REPLICATED, absint.REPLICATED) \
+            == absint.REPLICATED
+
+    def test_mark_requires_registered_tag(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.fill_constant([1], "float32", 0.0)
+            with pytest.raises(ValueError, match="unknown divergence"):
+                absint.mark_divergence_source(x, "not_a_tag")
+
+    def test_register_refuses_silent_redefinition(self):
+        absint.register_divergence_source("_t_tag", "a test tag")
+        absint.register_divergence_source("_t_tag", "a test tag")
+        with pytest.raises(ValueError, match="different description"):
+            absint.register_divergence_source("_t_tag", "changed")
+
+    def test_marked_value_propagates_varying(self):
+        main, startup, g = _guarded()
+        with g:
+            stage = layers.fill_constant([1], "float32", 0.0)
+            absint.mark_divergence_source(stage, "pp_stage_id")
+            derived = layers.scale(stage, 2.0)
+            plain = layers.fill_constant([1], "float32", 1.0)
+        facts = absint.analyze(main)
+        assert facts.value(stage.name).repl == absint.VARYING
+        assert facts.value(stage.name).source == "pp_stage_id"
+        assert facts.value(derived.name).repl == absint.VARYING
+        assert facts.value(plain.name).repl == absint.REPLICATED
+
+    def test_while_guard_classified_and_fixpoint_converges(self):
+        # the serve-cond pattern: cond minted from a varying mask,
+        # refreshed INSIDE the body — needs the fixpoint to classify
+        main, startup, g = _guarded()
+        with g:
+            mask = layers.fill_constant([4], "int64", 1)
+            absint.mark_divergence_source(mask, "lane_active_mask")
+            live = layers.reduce_sum(mask, keep_dim=True)
+            limit = layers.fill_constant([1], "int64", 0.0)
+            cond = layers.greater_than(live, limit)
+            w = layers.While(cond)
+            with w.block():
+                layers.greater_than(
+                    layers.reduce_sum(mask, keep_dim=True), limit,
+                    cond=cond)
+        facts = absint.analyze(main)
+        assert facts.converged
+        guarded = list(facts.guarded_sites())
+        assert guarded, "while body sites must carry the guard"
+        for _site, guards in guarded:
+            assert guards[0].container_type == "while"
+            assert guards[0].fact == absint.VARYING
+            assert guards[0].source == "lane_active_mask"
+
+    def test_shipped_serve_while_is_proven_divergent(self):
+        # decode_engine annotates _serve_cond with lane_active_mask:
+        # the whole burst body must sit under a PROVEN-divergent guard
+        from paddle_tpu.models import transformer as T
+
+        bundle = T.build_decode_step_program(
+            seq_len=4, max_out_len=6, d_model=16, n_heads=2,
+            n_layers=1, d_inner=32, vocab=16, n_slots=2,
+            state_prefix="@absint_sv/")
+        facts = absint.analyze(bundle.serves[0])
+        guarded = list(facts.guarded_sites())
+        assert guarded
+        assert all(facts.divergent(g) for _s, g in guarded)
+
+
+# ---------------------------------------------------------------------------
+# PTA130: the r5 1F1B x tp vocab-psum-in-branch fixture
+# ---------------------------------------------------------------------------
+def _vocab_psum_in_stage_branch():
+    """Crafted 1F1B x tp shape: a per-STAGE predicate (marked
+    pp_stage_id) gating a branch whose body computes vocab logits and
+    psums them over the tp axis — the exact r5 deadlock, as a
+    Program."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        stage = layers.fill_constant([1], "float32", 0.0)
+        absint.mark_divergence_source(stage, "pp_stage_id")
+        pred = layers.less_than_value(stage, 1.0)
+        sub = main.create_block()
+        # vocab-sharded logits partial matmul + the tp psum: modeled
+        # by an op carrying the shard_map axis_name attr (what the
+        # sharded lowering emits)
+        sub.append_op("scale", {"X": [x.name]}, {"Out": ["logits_p"]},
+                      {"scale": 1.0})
+        sub.append_op("sync_batch_norm", {"X": ["logits_p"]},
+                      {"Y": ["logits"]}, {"axis_name": "tp"})
+        main.rollback()
+        fsub = main.create_block()
+        fsub.append_op("scale", {"X": [x.name]}, {"Out": ["logits_f"]},
+                       {"scale": 1.0})
+        main.rollback()
+        main.global_block.append_op(
+            "conditional_block",
+            {"Condition": [pred.name], "X": [x.name]},
+            {"Out": ["b_out"]},
+            {"true_block": sub, "false_block": fsub,
+             "true_out": "logits", "false_out": "logits_f"})
+    return main
+
+
+class TestPTA130:
+    def test_vocab_psum_in_stage_branch_is_proven_error(self):
+        main = _vocab_psum_in_stage_branch()
+        ds = _diags(main, "PTA130")
+        assert ds and ds[0].severity == ERROR
+        assert "PROVEN" in ds[0].message
+        assert "pp_stage_id" in ds[0].message
+
+    def test_unmarked_cond_still_errors_like_pta010(self):
+        # agreement with the pattern matcher: a collective under ANY
+        # traced guard is an error even when the predicate is
+        # value-uniform (the replication facts assume unsharded feeds)
+        main, startup, g = _guarded()
+        with g:
+            from paddle_tpu.layers.collective import _allreduce
+
+            x = layers.data("x", shape=[4], dtype="float32")
+            pred = layers.less_than_value(
+                layers.fill_constant([1], "float32", 0.0), 1.0)
+            layers.cond(pred,
+                        lambda: _allreduce(layers.scale(x, 2.0)),
+                        lambda: layers.scale(x, 1.0))
+        p130 = _diags(main, "PTA130")
+        p010 = _diags(main, "PTA010")
+        assert p130 and p130[0].severity == ERROR
+        assert "value-uniform" in p130[0].message
+        assert len(p130) >= len(p010) > 0
+
+    def test_scope_collective_upgraded_under_divergent_guard(self):
+        # PTA011 warns on attention-in-while; under a PROVEN-divergent
+        # guard the scoped lowering WILL deadlock -> PTA130 ERROR
+        main, startup, g = _guarded()
+        with g:
+            mask = layers.fill_constant([1], "int64", 1)
+            absint.mark_divergence_source(mask, "lane_active_mask")
+            limit = layers.fill_constant([1], "int64", 0.0)
+            cond = layers.greater_than(mask, limit)
+            w = layers.While(cond)
+            with w.block():
+                blk = main.current_block()
+                blk.append_op("attention", {"Q": ["q"]},
+                              {"Out": ["o"]}, {})
+                layers.greater_than(mask, limit, cond=cond)
+        ds = _diags(main, "PTA130")
+        assert ds and ds[0].severity == ERROR
+        assert "PROVEN divergent" in ds[0].message
+        # the pattern matcher stays at warning — the upgrade is the
+        # prover's value-add
+        p011 = _diags(main, "PTA011")
+        assert p011 and p011[0].severity == WARNING
+
+    def test_top_level_collective_is_clean(self):
+        main, startup, g = _guarded()
+        with g:
+            from paddle_tpu.layers.collective import _allreduce
+
+            x = layers.data("x", shape=[4], dtype="float32")
+            _allreduce(layers.scale(x, 2.0))
+        assert not _diags(main, "PTA130")
+
+
+# ---------------------------------------------------------------------------
+# PTA131: replicated-input grad / sharded value in divergent context
+# ---------------------------------------------------------------------------
+def _grad_in_stage_branch(vary_fix=False):
+    """Crafted replicated-input-grad-in-cond: a backward-role op
+    inside a stage-gated branch producing w@GRAD for a replicated
+    parameter. With vary_fix=True the input is cast varying BEFORE
+    the branch (the r5 `_vary` fix) and the prover must go silent."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        w = main.global_block.create_parameter(
+            name="stage_w", shape=[4, 4], dtype="float32")
+        stage = layers.fill_constant([1], "float32", 0.0)
+        absint.mark_divergence_source(stage, "pp_stage_id")
+        pred = layers.less_than_value(stage, 1.0)
+        src = w
+        if vary_fix:
+            src = layers.scale(w, 1.0)
+            absint.mark_divergence_source(src, "vary")
+        sub = main.create_block()
+        sub.append_op("scale_grad", {"X": [src.name],
+                                     "Out@GRAD": ["g_in"]},
+                      {"X@GRAD": [src.name + "@GRAD"]},
+                      {"op_role": "backward"})
+        main.rollback()
+        fsub = main.create_block()
+        fsub.append_op("scale", {"X": [src.name]}, {"Out": ["noop"]},
+                       {"scale": 1.0})
+        main.rollback()
+        main.global_block.append_op(
+            "conditional_block",
+            {"Condition": [pred.name], "X": [src.name]},
+            {"Out": ["out"]},
+            {"true_block": sub, "false_block": fsub,
+             "true_out": src.name + "@GRAD", "false_out": "noop"})
+    return main
+
+
+class TestPTA131:
+    def test_replicated_grad_in_divergent_branch_is_error(self):
+        ds = _diags(_grad_in_stage_branch(), "PTA131")
+        assert ds and ds[0].severity == ERROR
+        assert "psum INSIDE the branch" in ds[0].message
+        assert ds[0].var == "stage_w"
+
+    def test_vary_fix_silences_it(self):
+        # the r5 discipline: cast varying BEFORE the branch
+        assert not _diags(_grad_in_stage_branch(vary_fix=True),
+                          "PTA131")
+
+    def test_uniform_guard_is_silent(self):
+        # differentiating under a value-uniform predicate is fine:
+        # every mesh program instance takes the same path
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            w = main.global_block.create_parameter(
+                name="u_w", shape=[4, 4], dtype="float32")
+            pred = layers.less_than_value(
+                layers.fill_constant([1], "float32", 0.0), 1.0)
+            sub = main.create_block()
+            sub.append_op("scale_grad", {"X": [w.name],
+                                         "Out@GRAD": ["g_in"]},
+                          {"X@GRAD": ["u_w@GRAD"]},
+                          {"op_role": "backward"})
+            main.rollback()
+            fsub = main.create_block()
+            fsub.append_op("scale", {"X": [w.name]},
+                           {"Out": ["noop"]}, {"scale": 1.0})
+            main.rollback()
+            main.global_block.append_op(
+                "conditional_block",
+                {"Condition": [pred.name], "X": [w.name]},
+                {"Out": ["out"]},
+                {"true_block": sub, "false_block": fsub,
+                 "true_out": "u_w@GRAD", "false_out": "noop"})
+        assert not _diags(main, "PTA131")
+
+    def test_sharded_value_in_divergent_branch_is_error(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[8], dtype="float32")
+            h = layers.scale(x, 1.0)
+            absint.mark_sharded(h, ("model",))
+            mask = layers.fill_constant([1], "int64", 1)
+            absint.mark_divergence_source(mask, "lane_active_mask")
+            limit = layers.fill_constant([1], "int64", 0.0)
+            cond = layers.greater_than(mask, limit)
+            w = layers.While(cond)
+            with w.block():
+                layers.scale(h, 2.0)
+                layers.greater_than(mask, limit, cond=cond)
+        ds = _diags(main, "PTA131")
+        assert ds and ds[0].severity == ERROR
+        assert "sharding annotation" in ds[0].message
+        assert ds[0].var == h.name
+
+    def test_sharded_value_outside_branches_is_clean(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[8], dtype="float32")
+            h = layers.scale(x, 1.0)
+            absint.mark_sharded(h, ("model",))
+            layers.scale(h, 2.0)
+        assert not _diags(main, "PTA131")
+
+
+# ---------------------------------------------------------------------------
+# PTA140: declared shape/dtype clobbered by producer inference (r10)
+# ---------------------------------------------------------------------------
+class TestPTA140:
+    def test_r10_concrete_persistable_clobbered_is_error(self):
+        # THE incident: assign of a [-1,4] value onto a concretely-
+        # declared persistable rewrites the declaration
+        main, startup, g = _guarded()
+        with g:
+            sink = main.global_block.create_var(
+                name="@decl_sink", shape=(8, 4), dtype="float32",
+                persistable=True, stop_gradient=True)
+            x = layers.data("x", shape=[4], dtype="float32")
+            layers.assign(layers.scale(x, 2.0), output=sink)
+            layers.scale(sink, 1.0)  # read it: not PTA090's class
+        assert tuple(sink.shape) != (8, 4)  # inference DID clobber
+        ds = _diags(main, "PTA140")
+        assert ds and ds[0].severity == ERROR
+        assert ds[0].var == "@decl_sink"
+        assert "(8, 4)" in ds[0].message
+
+    def test_static_batch_producer_is_clean(self):
+        main, startup, g = _guarded()
+        with g:
+            sink = main.global_block.create_var(
+                name="@decl_ok", shape=(8, 4), dtype="float32",
+                persistable=True, stop_gradient=True)
+            x = layers.data("x", shape=[8, 4], dtype="float32",
+                            append_batch_size=False)
+            layers.assign(layers.scale(x, 2.0), output=sink)
+            layers.scale(sink, 1.0)
+        assert not _diags(main, "PTA140")
+
+    def test_int_persistable_promoted_to_float_warns(self):
+        # the PTA020 class generalized beyond `increment`: any
+        # producer that promotes a declared-int contract var
+        main, startup, g = _guarded()
+        with g:
+            ctr = main.global_block.create_var(
+                name="@int_ctr", shape=(1,), dtype="int64",
+                persistable=True, stop_gradient=True)
+            f = layers.fill_constant([1], "float32", 1.5)
+            main.global_block.append_op(
+                "elementwise_add", {"X": [ctr.name], "Y": [f.name]},
+                {"Out": [ctr.name]}, {})
+            layers.scale(ctr, 1.0)
+        ds = _diags(main, "PTA140")
+        assert ds and any("promoted" in d.message for d in ds)
+        assert all(d.severity in (WARNING, ERROR) for d in ds)
+
+    def test_float_temp_promotion_is_exempt(self):
+        # int temp scaled by a float step is ordinary arithmetic —
+        # only contract vars (persistable/data/carried) are findings
+        main, startup, g = _guarded()
+        with g:
+            i = layers.fill_constant([1], "int64", 3)
+            layers.mean(layers.scale(i, 0.5))
+        assert not [d for d in _diags(main, "PTA140")
+                    if "promoted" in d.message]
+
+    def test_zoo_style_programs_are_clean(self):
+        from paddle_tpu.models import mnist
+
+        main, startup, *_ = mnist.build_program(use_conv=False)
+        assert not _diags(main, "PTA140")
+        assert not _diags(startup, "PTA140")
+
+
+# ---------------------------------------------------------------------------
+# PTA150: whole-bundle contracts
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def small_bundle():
+    from paddle_tpu.models import transformer as T
+
+    return T.build_decode_step_program(
+        seq_len=4, max_out_len=6, d_model=16, n_heads=2, n_layers=1,
+        d_inner=32, vocab=16, n_slots=2, state_prefix="@b150/")
+
+
+class TestPTA150Bundle:
+    def test_shipped_bundle_is_clean(self, small_bundle):
+        assert check_bundle(small_bundle) == []
+
+    def test_geometry_disagreement_is_error(self, small_bundle):
+        serve = small_bundle.serves[0]
+        name = small_bundle.state["tok_buf"]
+        var = serve.global_block.vars[name]
+        old = var.shape
+        try:
+            var.shape = (old[0], old[1] + 1)
+            var._declared_shape = var.shape
+            ds = check_bundle(small_bundle)
+            assert ds and ds[0].code == "PTA150" \
+                and ds[0].severity == ERROR
+            assert "geometry" in ds[0].message
+        finally:
+            var.shape = old
+            del var._declared_shape
+
+    def test_missing_counter_is_error(self, small_bundle):
+        serve = small_bundle.serves[0]
+        name = small_bundle.state["step"]
+        var = serve.global_block.vars.pop(name)
+        try:
+            ds = check_bundle(small_bundle)
+            assert ds and any(
+                d.severity == ERROR and d.var == name and
+                "stale" in d.message for d in ds)
+        finally:
+            serve.global_block.vars[name] = var
+
+    def test_seed_derivation_drift_is_error(self):
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.models.decode_engine import SamplingConfig
+
+        bundle = T.build_decode_step_program(
+            seq_len=4, max_out_len=6, d_model=16, n_heads=2,
+            n_layers=1, d_inner=32, vocab=16, n_slots=2,
+            state_prefix="@b150s/", admit_buckets=[2],
+            sampling=SamplingConfig(temperature=0.8, base_seed=7))
+        assert check_bundle(bundle) == []
+        # drift ONE specialization's base_seed: the same logical draw
+        # would no longer replay byte-identically across programs
+        from paddle_tpu.analysis import iter_ops
+
+        drifted = None
+        for site in iter_ops(bundle.serves[2]):
+            if "base_seed" in site.op.attrs:
+                drifted = site.op
+                break
+        assert drifted is not None
+        old = drifted.attrs["base_seed"]
+        try:
+            drifted.attrs["base_seed"] = old + 1
+            ds = check_bundle(bundle)
+            assert ds and all(d.code == "PTA150" for d in ds)
+            assert any("base_seed" in d.message and
+                       d.severity == ERROR for d in ds)
+        finally:
+            drifted.attrs["base_seed"] = old
+
+
+# ---------------------------------------------------------------------------
+# suppression contract (_pta_suppress)
+# ---------------------------------------------------------------------------
+class TestSuppression:
+    def _collective_prog(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            from paddle_tpu.layers.collective import _allreduce
+
+            x = layers.data("x", shape=[4], dtype="float32")
+            pred = layers.less_than_value(
+                layers.fill_constant([1], "float32", 0.0), 1.0)
+            layers.cond(pred,
+                        lambda: _allreduce(layers.scale(x, 2.0)),
+                        lambda: layers.scale(x, 1.0))
+        return main
+
+    def test_suppression_drops_and_collects(self):
+        main = self._collective_prog()
+        cond_op = next(op for op in main.global_block.ops
+                       if op.type == "conditional_block")
+        cond_op.attrs["_pta_suppress"] = (
+            "PTA010", "single-host test program, never meshed")
+        collected = []
+        ds = run_checks(main, collect_suppressed=collected)
+        assert "PTA010" not in {d.code for d in ds}
+        assert collected and collected[0][0].code == "PTA010"
+        assert "never meshed" in collected[0][1]
+        # PTA130 anchors at the INNER collective op, so it still
+        # fires: one suppression never blankets the whole class
+        assert "PTA130" in {d.code for d in ds}
+
+    def test_executor_strict_gate_honors_suppression(self):
+        main = self._collective_prog()
+        for op in main.global_block.ops:
+            if op.type == "conditional_block":
+                op.attrs["_pta_suppress"] = [
+                    ("PTA010", "crafted: documents the trap")]
+        inner = [op for blk in main.blocks for op in blk.ops
+                 if op.type == "allreduce"]
+        assert inner
+        inner[0].attrs["_pta_suppress"] = (
+            "PTA130", "crafted: documents the trap")
+        assert not [d for d in run_checks(main)
+                    if d.severity == ERROR]
+
+    def test_malformed_suppression_warns_and_ignores(self):
+        main = self._collective_prog()
+        cond_op = next(op for op in main.global_block.ops
+                       if op.type == "conditional_block")
+        cond_op.attrs["_pta_suppress"] = "PTA010"  # not a pair
+        ds = run_checks(main)
+        assert "PTA199" in {d.code for d in ds}
+        assert "PTA010" in {d.code for d in ds}  # NOT suppressed
+
+    def test_suppression_only_matches_its_anchor(self):
+        main = self._collective_prog()
+        # suppress at an unrelated op: the finding must survive
+        main.global_block.ops[0].attrs["_pta_suppress"] = (
+            "PTA010", "wrong anchor")
+        assert "PTA010" in {d.code for d in run_checks(main)}
+
+
+# ---------------------------------------------------------------------------
+# dataflow entry-name registry (the PTA001 over-seeding fix)
+# ---------------------------------------------------------------------------
+class TestBlockEntryRegistry:
+    def test_output_name_lists_no_longer_seed(self):
+        # a while op whose sub-block reads a name that ONLY appears in
+        # a non-entry list attr: the old any-all-str-list heuristic
+        # seeded it and masked the uninit read
+        main, startup, g = _guarded()
+        with g:
+            sub = main.create_block()
+            sub.append_op("scale", {"X": ["ghost"]}, {"Out": ["s"]},
+                          {"scale": 1.0})
+            main.rollback()
+            main.global_block.append_op(
+                "while", {"Condition": ["c"], "X": [], "Init": []},
+                {"Out": []},
+                {"sub_block": sub, "carried": [], "externals": [],
+                 "bogus_names": ["ghost"]})
+        ds = _diags(main, "PTA001")
+        assert any(d.var == "ghost" for d in ds)
+
+    def test_registered_entry_attrs_still_seed(self):
+        main, startup, g = _guarded()
+        with g:
+            x = layers.data("x", shape=[4], dtype="float32")
+            sub = main.create_block()
+            sub.append_op("scale", {"X": ["carried_v"]},
+                          {"Out": ["carried_v"]}, {"scale": 1.0})
+            main.rollback()
+            main.global_block.append_op(
+                "while", {"Condition": ["c"], "X": [x.name],
+                          "Init": [x.name]},
+                {"Out": ["carried_v"]},
+                {"sub_block": sub, "carried": ["carried_v"],
+                 "externals": []})
+        assert not [d for d in _diags(main, "PTA001")
+                    if d.var == "carried_v"]
+
+    def test_unknown_container_falls_back_with_warning(self):
+        from paddle_tpu.analysis.dataflow import (
+            _ENTRY_FALLBACK_WARNED, block_entry_names)
+        from paddle_tpu.core.program import Operator
+
+        op = Operator(None, "_t_custom_container", {"X": ["a"]}, {},
+                      {"some_names": ["seeded"]})
+        _ENTRY_FALLBACK_WARNED.discard("_t_custom_container")
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            names = block_entry_names(op)
+        assert "seeded" in names  # permissive fallback
+        assert any("register_block_entry_attrs" in str(w.message)
+                   for w in caught)
+        # warn-once: second call is silent
+        with warnings.catch_warnings(record=True) as caught2:
+            warnings.simplefilter("always")
+            block_entry_names(op)
+        assert not caught2
+
+    def test_registration_makes_it_exact(self):
+        from paddle_tpu.analysis.dataflow import (
+            BLOCK_ENTRY_ATTRS, block_entry_names,
+            register_block_entry_attrs)
+        from paddle_tpu.core.program import Operator
+
+        register_block_entry_attrs("_t_reg_container", ("ins",))
+        try:
+            op = Operator(None, "_t_reg_container", {}, {},
+                          {"ins": ["a"], "outs": ["b"]})
+            names = block_entry_names(op)
+            assert "a" in names and "b" not in names
+        finally:
+            del BLOCK_ENTRY_ATTRS["_t_reg_container"]
+
+
+# ---------------------------------------------------------------------------
+# baseline payload/diff machinery (no zoo build: crafted reports)
+# ---------------------------------------------------------------------------
+class TestBaseline:
+    def _report(self, target, diags, suppressed=()):
+        from paddle_tpu.analysis.baseline import TargetReport
+
+        rep = TargetReport(target)
+        rep.diagnostics = list(diags)
+        rep.suppressed = list(suppressed)
+        return rep
+
+    def _diag(self, code, severity, var=None, op_type=None):
+        from paddle_tpu.analysis import Diagnostic
+
+        return Diagnostic(code, severity, "msg", var=var,
+                          op_type=op_type)
+
+    def test_payload_records_gated_and_suppressed(self):
+        from paddle_tpu.analysis.baseline import baseline_payload
+
+        reps = [self._report(
+            "models/x:main",
+            [self._diag("PTA130", ERROR, var="v"),
+             self._diag("PTA011", WARNING),
+             self._diag("PTA003", INFO)],
+            suppressed=[(self._diag("PTA010", ERROR), "why")])]
+        pay = baseline_payload(reps)
+        assert pay["entries"] == {
+            "models/x:main|PTA130|error||v": 1,
+            "models/x:main|PTA011|warning||": 1}
+        assert pay["suppressed"] == {
+            "models/x:main|PTA010|error||": 1}
+        assert pay["totals"]["infos"] == 1
+
+    def test_diff_flags_new_and_reports_resolved(self):
+        from paddle_tpu.analysis.baseline import (baseline_payload,
+                                                  diff_against_baseline)
+
+        base = baseline_payload([self._report(
+            "t:main", [self._diag("PTA011", WARNING)])])
+        now = [self._report(
+            "t:main", [self._diag("PTA011", WARNING),
+                       self._diag("PTA140", WARNING, var="s")])]
+        new, resolved = diff_against_baseline(now, base)
+        assert new == ["t:main|PTA140|warning||s (x1 new)"]
+        assert resolved == []
+        fixed = [self._report("t:main", [])]
+        new2, resolved2 = diff_against_baseline(fixed, base)
+        assert new2 == []
+        assert resolved2 == ["t:main|PTA011|warning|| (-1)"]
+
+    def test_new_suppression_fails_until_baselined(self):
+        # a fresh _pta_suppress drops the diagnostic from --strict,
+        # so the drift gate must catch it through the suppressed
+        # section — and stop failing once the baseline records it
+        from paddle_tpu.analysis.baseline import (baseline_payload,
+                                                  diff_against_baseline)
+
+        base = baseline_payload([self._report("t:main", [])])
+        now = [self._report(
+            "t:main", [],
+            suppressed=[(self._diag("PTA010", ERROR), "wip")])]
+        new, _res = diff_against_baseline(now, base)
+        assert new == ["t:main|PTA010|error|| (x1 new [suppressed])"]
+        refreshed = baseline_payload(now)
+        assert diff_against_baseline(now, refreshed) == ([], [])
+
+    def test_write_load_roundtrip(self, tmp_path):
+        from paddle_tpu.analysis.baseline import (
+            diff_against_baseline, load_baseline, write_baseline)
+
+        reps = [self._report("t:main",
+                             [self._diag("PTA011", WARNING)])]
+        path = str(tmp_path / "base.json")
+        write_baseline(reps, path)
+        base = load_baseline(path)
+        assert diff_against_baseline(reps, base) == ([], [])
+
+    def test_cli_baseline_rejects_partial_sweeps(self):
+        # the drift gate is only meaningful over the FULL zoo: a
+        # shrunk sweep hides new findings as vacuous 'resolved'
+        from paddle_tpu.analysis.__main__ import main
+
+        assert main(["--baseline", "x.json", "--only", "mnist"]) == 2
+        assert main(["--baseline", "x.json", "--no-benchmark"]) == 2
+        assert main(["--write-baseline", "x.json",
+                     "--no-benchmark"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# registry declaration recording (the PTA140 evidence base)
+# ---------------------------------------------------------------------------
+class TestDeclarationRecording:
+    def test_first_clobber_stashes_declaration(self):
+        main, startup, g = _guarded()
+        with g:
+            v = main.global_block.create_var(
+                name="decl_v", shape=(8, 4), dtype="float32",
+                persistable=True)
+            x = layers.data("x", shape=[4], dtype="float32")
+            layers.assign(layers.scale(x, 2.0), output=v)
+        assert v._declared_shape == (8, 4)
+        assert tuple(v.shape) == (-1, 4)
+
+    def test_inferred_shapes_are_not_declarations(self):
+        # a shapeless temp written twice with different inferred
+        # shapes must NOT record a declaration (PTA002-legal temps)
+        main, startup, g = _guarded()
+        with g:
+            x4 = layers.data("x4", shape=[4], dtype="float32")
+            x8 = layers.data("x8", shape=[8], dtype="float32")
+            blk = main.global_block
+            blk.append_op("scale", {"X": [x4.name]}, {"Out": ["t"]},
+                          {"scale": 1.0})
+            blk.append_op("scale", {"X": [x8.name]}, {"Out": ["t"]},
+                          {"scale": 1.0})
+        t = main.global_block.vars["t"]
+        assert not hasattr(t, "_declared_shape")
+
+    def test_matching_inference_keeps_declaration_armed(self):
+        # declared (8,4), first producer agrees, second clobbers:
+        # the stash must still capture the DECLARED (8,4)
+        main, startup, g = _guarded()
+        with g:
+            v = main.global_block.create_var(
+                name="armed_v", shape=(8, 4), dtype="float32",
+                persistable=True)
+            ok = layers.data("ok", shape=[8, 4], dtype="float32",
+                             append_batch_size=False)
+            bad = layers.data("bad", shape=[4], dtype="float32")
+            layers.assign(layers.scale(ok, 1.0), output=v)
+            assert not hasattr(v, "_declared_shape")
+            layers.assign(layers.scale(bad, 1.0), output=v)
+        assert v._declared_shape == (8, 4)

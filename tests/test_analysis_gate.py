@@ -1,22 +1,118 @@
-"""Tier-1 CI gate: `python -m paddle_tpu.analysis --strict` over every
-models/ + benchmark/ program must report ZERO error-severity
-diagnostics — builder regressions (a collective slipping into a decode
-branch, a dropped @SEQ_LEN companion, an unflagged host op...) fail
-here in seconds instead of on-chip (ISSUE 3 acceptance criterion)."""
+"""Tier-1 CI gate: the full lint-zoo sweep (per-program checkers incl.
+the absint divergence prover, pairwise checks, whole-bundle contracts)
+must report ZERO error-severity diagnostics, the prover's findings
+must cover the PTA010/011 pattern matchers with zero new false
+errors, and the diagnostic set must match the committed
+``analysis_baseline.json`` (the drift gate: any NEW error-or-warning
+anywhere in the zoo fails here in seconds instead of on-chip). The
+zoo builds ONCE per module; the pure analysis phase is timed and
+pinned < 60 s so the fixpoint engine never slips the fast lane."""
+import time
+
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import analysis
+from paddle_tpu.analysis import ERROR, WARNING
+from paddle_tpu.analysis.baseline import (collect_reports,
+                                          diff_against_baseline,
+                                          load_baseline)
+
+
+@pytest.fixture(scope="module")
+def zoo():
+    """Build every lint target once (the expensive phase — program
+    builds, not analysis), then run the sweep ONCE, timing only the
+    analysis phase."""
+    from paddle_tpu.analysis.targets import iter_lint_targets
+
+    targets = list(iter_lint_targets())
+    t0 = time.perf_counter()
+    reports = collect_reports(targets=targets)
+    analysis_s = time.perf_counter() - t0
+    return {"targets": targets, "reports": reports,
+            "analysis_s": analysis_s}
 
 
 class TestLintGate:
-    def test_cli_strict_all_programs_clean(self):
-        # the CLI entrypoint itself (what CI/devs run), in-process:
-        # builds and lints models/ + benchmark/ and exits 0 iff no
-        # error diagnostics anywhere
+    def test_zoo_is_error_free(self, zoo):
+        errs = [(rep.target, d.format())
+                for rep in zoo["reports"]
+                for d in rep.by_severity(ERROR)]
+        assert not errs, f"strict zoo regressed: {errs[:5]}"
+        # the zoo is the advertised size: a silently-shrunk target
+        # list would make every assertion here vacuous
+        assert len(zoo["reports"]) >= 73
+
+    def test_absint_covers_pattern_matchers(self, zoo):
+        """Agreement sweep (ISSUE 11 acceptance): over the FULL zoo,
+        PTA130 reproduces every PTA010 error and PTA011 warning —
+        per program, at >= the matcher's severity — and introduces
+        zero new errors anywhere (no false positives from the
+        fixpoint engine)."""
+        for rep in zoo["reports"]:
+            codes = {}
+            for d in rep.diagnostics:
+                codes.setdefault(d.code, []).append(d)
+            p010 = codes.get("PTA010", [])
+            p011 = codes.get("PTA011", [])
+            p130 = codes.get("PTA130", [])
+            p130_err = [d for d in p130 if d.severity == ERROR]
+            p130_any = p130_err + [d for d in p130
+                                   if d.severity == WARNING]
+            assert len(p130_err) >= len(p010), (
+                f"{rep.target}: PTA130 errors ({len(p130_err)}) do "
+                f"not cover PTA010 ({len(p010)})")
+            assert len(p130_any) >= len(p011) + len(p010), (
+                f"{rep.target}: PTA130 findings do not cover "
+                f"PTA011's")
+            # zero new FALSE errors: the zoo is error-free, so the
+            # prover must not error anywhere the matcher does not
+            assert len(p130_err) == len(p010) == 0, (
+                f"{rep.target}: prover found errors in the clean "
+                f"zoo: {[d.format() for d in p130_err]}")
+
+    def test_baseline_diff_is_clean(self, zoo):
+        """The committed analysis_baseline.json matches this sweep:
+        no NEW error-or-warning (the CI drift gate, in-process).
+        Resolved entries are allowed — they only ask for a refresh."""
+        base = load_baseline()
+        new, _resolved = diff_against_baseline(zoo["reports"], base)
+        assert not new, (
+            f"NEW findings vs analysis_baseline.json: {new} — fix "
+            f"them, or (if intentional) refresh with `python -m "
+            f"paddle_tpu.analysis --write-baseline` and review the "
+            f"diff")
+
+    def test_analysis_phase_under_60s(self, zoo):
+        """The fixpoint engine + checkers + bundle contracts over the
+        whole zoo must stay interactive: < 60 s wall (measured on the
+        pre-built programs — program BUILDS are the separately-paid
+        cost every lint consumer shares). Today this runs in a few
+        seconds; the pin is the never-slip-the-fast-lane backstop."""
+        assert zoo["analysis_s"] < 60.0, (
+            f"zoo analysis took {zoo['analysis_s']:.1f}s")
+
+    def test_cli_strict_smoke(self):
+        # the CLI entrypoint itself (what CI/devs run), on one model:
+        # argparse wiring, strict exit code, registry sweep
         from paddle_tpu.analysis.__main__ import main
 
-        assert main(["--strict", "--registry"]) == 0
+        assert main(["--strict", "--registry", "--only",
+                     "mnist"]) == 0
+
+    def test_cli_baseline_roundtrip(self, zoo, tmp_path):
+        # --write-baseline / --baseline logic against THIS sweep,
+        # through the library (the CLI's own sweep would rebuild the
+        # zoo); the CLI flag plumbing is covered by test_absint
+        from paddle_tpu.analysis.baseline import write_baseline
+
+        path = str(tmp_path / "base.json")
+        write_baseline(zoo["reports"], path)
+        new, resolved = diff_against_baseline(
+            zoo["reports"], load_baseline(path))
+        assert new == [] and resolved == []
 
     def test_registry_host_effect_complete(self):
         assert analysis.check_registry() == []
