@@ -18,8 +18,19 @@ Pieces:
 * analysis.absint — the divergence & sharding prover: whole-program
   fixpoint abstract interpretation (divergence contexts, the
   replicated/varying/unknown lattice, declared-vs-producer
-  shape/dtype facts) feeding PTA130/131/140, plus the
-  divergence-source seed table sharded lowerings register with.
+  shape/dtype facts, and the SHARDING DOMAIN — per-op ShardSpec
+  propagation over the rules registered in core/registry.py, seeded
+  from mark_sharded/MeshConfig annotations) feeding
+  PTA130/131/140/160/161/170, plus the divergence-source seed table
+  sharded lowerings register with.
+* analysis.sharding_rules — the per-op-family propagation rules
+  (matmul/mul contraction psums, reshape major-dim carry, reduce
+  psums, gather allgathers, elementwise conflicts, ...); unknown ops
+  degrade to an explicit ⊤ spec with a warn-once.
+* analysis.memplan — the static per-device memory planner behind
+  ``analyze(p).device_memory_plan()`` / CLI ``--memory-plan`` /
+  checker PTA170: persistable/feed/temp bytes under the propagated
+  specs, validated against ``compiled.memory_analysis()``.
 * analysis.checkers — the Checker registry: stable `PTA0xx` codes,
   severity error/warn/info, op/var anchors, fix hints. Every checker
   encodes a REAL incident from CLAUDE.md's session learnings

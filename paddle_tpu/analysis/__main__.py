@@ -2,17 +2,26 @@
 
 Usage:
     python -m paddle_tpu.analysis [--strict] [--json] [--verbose]
-                                  [--only mnist transformer ...]
+                                  [--only mnist transf ...]
                                   [--no-benchmark] [--registry]
+                                  [--memory-plan]
                                   [--baseline [PATH]]
                                   [--write-baseline [PATH]]
+
+``--only`` filters by target-name SUBSTRING (``--only transf`` lints
+models/transformer), so iterating on one checker against one program
+stops costing a full zoo build; ``--json`` carries per-checker wall
+seconds (``checker_seconds``) so a slow checker is attributable;
+``--memory-plan`` prints each program's static per-device memory
+plan (analysis/memplan.py — the PTA170 surface).
 
 Exit status: 0 clean, 2 when any program has error diagnostics (or,
 with --strict-warn, warnings; or, with --baseline, any error-or-
 warning NEW vs the committed analysis_baseline.json — the CI drift
-gate). This is the gate ISSUE 3 asked for and ISSUE 11 hardened:
-builder regressions fail here in seconds instead of on-chip, and
-once warnings gate CI the baseline pins the full diagnostic set.
+gate, which also value-diffs the ``sharding_facts`` snapshot). This
+is the gate ISSUE 3 asked for and ISSUE 11 hardened: builder
+regressions fail here in seconds instead of on-chip, and once
+warnings gate CI the baseline pins the full diagnostic set.
 """
 from __future__ import annotations
 
@@ -32,13 +41,19 @@ def main(argv=None) -> int:
     p.add_argument("--verbose", action="store_true",
                    help="print info-severity diagnostics as well")
     p.add_argument("--only", nargs="*", default=None,
-                   help="models/ names to lint (default: everything; "
-                        "note --only also skips the benchmark/ sweep)")
+                   help="target-name SUBSTRINGS to lint (e.g. "
+                        "'transf' lints models/transformer; default: "
+                        "everything; note --only also skips the "
+                        "benchmark/ sweep)")
     p.add_argument("--no-benchmark", action="store_true",
                    help="skip the benchmark/ harness programs")
     p.add_argument("--registry", action="store_true",
                    help="also sweep the FULL op registry for host_"
                         "effect completeness (PTA070)")
+    p.add_argument("--memory-plan", action="store_true",
+                   help="print each program's static per-device "
+                        "memory plan (PTA170's planner; --json adds "
+                        "a memory_plan section per target)")
     p.add_argument("--baseline", nargs="?", const="", default=None,
                    metavar="PATH",
                    help="diff the sweep against the committed "
@@ -61,11 +76,13 @@ def main(argv=None) -> int:
     from .targets import MODEL_BUILDERS
 
     if args.only:
-        unknown = sorted(set(args.only) - set(MODEL_BUILDERS))
-        if unknown:
+        from .targets import match_targets
+
+        matched = match_targets(args.only)
+        if not matched:
             # a typo'd --only must NOT look like a green strict run
-            print(f"error: unknown --only name(s) {unknown}; known: "
-                  f"{sorted(MODEL_BUILDERS)}", file=sys.stderr)
+            print(f"error: --only {args.only} matches no target; "
+                  f"known: {sorted(MODEL_BUILDERS)}", file=sys.stderr)
             return 2
     if args.baseline is not None or args.write_baseline is not None:
         # the drift gate (and the snapshot it diffs against) is only
@@ -78,8 +95,11 @@ def main(argv=None) -> int:
                   f"--only/--no-benchmark", file=sys.stderr)
             return 2
 
+    checker_seconds = {}
     reports = collect_reports(
-        include_benchmark=not args.no_benchmark, only=args.only)
+        include_benchmark=not args.no_benchmark, only=args.only,
+        collect_timings=checker_seconds,
+        with_plans=args.memory_plan)
 
     report = []
     n_err = n_warn = n_sup = 0
@@ -102,6 +122,18 @@ def main(argv=None) -> int:
                 {"code": d.code, "severity": d.severity,
                  "reason": reason, "diagnostic": d.format()}
                 for d, reason in rep.suppressed]
+        if args.memory_plan and rep.plan is not None:
+            entry["memory_plan"] = {
+                "state_bytes": rep.plan.state_bytes,
+                "state_device_bytes": rep.plan.state_device_bytes,
+                "feed_bytes": rep.plan.feed_bytes,
+                "temp_bytes": rep.plan.temp_bytes,
+                "temp_device_bytes": rep.plan.temp_device_bytes,
+                "argument_bytes": rep.plan.argument_bytes,
+                "total_device_bytes": rep.plan.total_device_bytes,
+                "mesh": rep.plan.mesh.describe()
+                if rep.plan.mesh else None,
+            }
         report.append(entry)
         if not args.json:
             status = "OK" if not (errs or warns) else \
@@ -109,6 +141,8 @@ def main(argv=None) -> int:
             sup = f", {len(rep.suppressed)} suppressed" \
                 if rep.suppressed else ""
             print(f"{rep.target}: {status} ({len(infos)} info{sup})")
+            if args.memory_plan and rep.plan is not None:
+                print("  " + rep.plan.summary().replace("\n", "\n  "))
             for d in errs + warns:
                 print("  " + d.format().replace("\n", "\n  "))
             for d, reason in rep.suppressed:
@@ -148,7 +182,10 @@ def main(argv=None) -> int:
 
     if args.json:
         out = {"targets": report, "errors": n_err,
-               "warnings": n_warn, "suppressed": n_sup}
+               "warnings": n_warn, "suppressed": n_sup,
+               "checker_seconds": {
+                   k: round(v, 4)
+                   for k, v in sorted(checker_seconds.items())}}
         if baseline_result is not None:
             out["baseline"] = baseline_result
         print(json.dumps(out, indent=1))

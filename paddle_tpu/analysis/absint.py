@@ -65,11 +65,15 @@ from .dataflow import OpSite, iter_blocks, iter_sub_blocks
 
 __all__ = [
     "REPLICATED", "VARYING", "UNKNOWN", "join",
-    "DIVERGENCE_ATTR", "SHARDING_ATTR",
+    "DIVERGENCE_ATTR", "SHARDING_ATTR", "SHARDING_DIMS_ATTR",
     "register_divergence_source", "divergence_sources",
     "mark_divergence_source", "mark_sharded",
     "ValueFact", "GuardFact", "ProgramFacts", "analyze",
     "declared_clobbers",
+    "ShardSpec", "TOP_SPEC", "REPLICATED_SPEC", "spec_join",
+    "MeshConfig", "set_mesh", "mesh_of",
+    "CollectiveEvent", "EventSite",
+    "set_device_memory_budget", "device_memory_budget",
 ]
 
 # --- the replication lattice ------------------------------------------------
@@ -85,9 +89,161 @@ def join(a: str, b: str) -> str:
     return a if _ORDER[a] >= _ORDER[b] else b
 
 
+# --- the sharding domain ----------------------------------------------------
+# A ShardSpec is the abstract placement of ONE value on the mesh: a
+# sparse {tensor dim -> mesh axis} mapping (GSPMD/NamedSharding's
+# PartitionSpec, made order-free), with two distinguished points:
+# REPLICATED_SPEC (empty mapping — every device holds the full value)
+# and TOP_SPEC (placements=None — layout UNKNOWN, the explicit ⊤ an
+# op without a registered sharding rule degrades to). The sparse
+# form is rank-agnostic, so replicated values never need shape
+# bookkeeping and the fixpoint join stays O(1).
+@dataclass(frozen=True)
+class ShardSpec:
+    """Abstract mesh placement of one value (see module docstring).
+
+    Reference counterpart: none — the reference shards at runtime via
+    transpilers (transpiler/distribute_transpiler.py); a compile-time
+    placement lattice is the GSPMD-era capability this module adds.
+    """
+    placements: Optional[Tuple[Tuple[int, str], ...]] = ()
+
+    @property
+    def is_top(self) -> bool:
+        return self.placements is None
+
+    @property
+    def is_replicated(self) -> bool:
+        return self.placements == ()
+
+    def axis_of(self, dim: int) -> Optional[str]:
+        if self.placements is None:
+            return None
+        for d, a in self.placements:
+            if d == dim:
+                return a
+        return None
+
+    def axes(self):
+        return () if self.placements is None else tuple(
+            a for _, a in self.placements)
+
+    def describe(self) -> str:
+        if self.placements is None:
+            return "⊤"
+        if not self.placements:
+            return "replicated"
+        return ",".join(f"dim{d}:{a}" for d, a in self.placements)
+
+    @staticmethod
+    def of(placements) -> "ShardSpec":
+        """Normalize a {dim: axis} dict / iterable of (dim, axis)
+        pairs into a canonical (sorted, deduped) ShardSpec."""
+        if placements is None:
+            return TOP_SPEC
+        if isinstance(placements, dict):
+            placements = placements.items()
+        return ShardSpec(tuple(sorted(
+            (int(d), str(a)) for d, a in placements)))
+
+
+TOP_SPEC = ShardSpec(None)
+REPLICATED_SPEC = ShardSpec(())
+
+
+def spec_join(a: ShardSpec, b: ShardSpec) -> ShardSpec:
+    """Lattice join: equal specs meet at themselves, anything else
+    goes to ⊤ — a value written with two different placements has no
+    single static layout, and pretending otherwise would let the
+    memory planner and the order prover reason from a lie."""
+    return a if a == b else TOP_SPEC
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Named device mesh a program is built against (SNIPPETS.md
+    [1]/[3]'s ``Mesh(devices, ("batch", "model"))`` pattern, as
+    static metadata): ordered (axis name, size) pairs. Attached to a
+    Program via ``set_mesh`` so the planner can turn propagated
+    ShardSpecs into per-DEVICE bytes and the provers can name the
+    axes a collective spans.
+
+    Reference counterpart: none — reference device placement was
+    per-op attrs (framework/op_desc.cc), not a named mesh.
+    """
+    axes: Tuple[Tuple[str, int], ...]
+
+    @staticmethod
+    def make(**axes) -> "MeshConfig":
+        return MeshConfig(tuple((str(k), int(v))
+                                for k, v in axes.items()))
+
+    def size(self, name: str, default: int = 1) -> int:
+        for n, s in self.axes:
+            if n == name:
+                return s
+        return default
+
+    def n_devices(self) -> int:
+        n = 1
+        for _, s in self.axes:
+            n *= s
+        return n
+
+    def describe(self) -> str:
+        return "x".join(f"{n}={s}" for n, s in self.axes)
+
+
+def set_mesh(program, mesh: Optional[MeshConfig]) -> None:
+    """Attach (or clear) the MeshConfig a program's sharding
+    annotations refer to; bumps the version so cached facts refresh."""
+    program._mesh_config = mesh
+    program._version = getattr(program, "_version", 0) + 1
+
+
+def mesh_of(program) -> Optional[MeshConfig]:
+    return getattr(program, "_mesh_config", None)
+
+
+def set_device_memory_budget(program, n_bytes: Optional[int]) -> None:
+    """Per-program per-DEVICE memory budget in bytes: when set, the
+    PTA170 checker turns an over-budget ``device_memory_plan()`` into
+    an error diagnostic (the static OOM gate)."""
+    program._device_memory_budget = n_bytes
+    program._version = getattr(program, "_version", 0) + 1
+
+
+def device_memory_budget(program) -> Optional[int]:
+    return getattr(program, "_device_memory_budget", None)
+
+
+@dataclass(frozen=True)
+class CollectiveEvent:
+    """One collective a lowering IMPLIES under the propagated specs
+    (not a literal collective op — those are checkers._is_collective):
+    kind "psum" (contraction/reduce over a sharded dim), "allgather"
+    (gather/consume of a dim-sharded value that must materialize
+    fully), "reshard" (GSPMD layout change forced at this site), or
+    "conflict" (two consumers/producers demand incompatible specs)."""
+    kind: str
+    axes: Tuple[str, ...]
+    var: Optional[str]
+    why: str
+
+
+@dataclass(frozen=True)
+class EventSite:
+    """A CollectiveEvent anchored at its op site with the guard stack
+    it executes under — the record PTA160/PTA161 read."""
+    site: "OpSite"
+    guards: tuple
+    event: CollectiveEvent
+
+
 # --- annotation attrs & the divergence-source seed table --------------------
 DIVERGENCE_ATTR = "divergence_source"
 SHARDING_ATTR = "sharding_axes"
+SHARDING_DIMS_ATTR = "sharding_dims"
 
 # tag -> human explanation of WHY values minted under it differ across
 # mesh coordinates. This is the seed table the ISSUE/ROADMAP name: a
@@ -184,24 +340,80 @@ def mark_divergence_source(var, tag: str) -> None:
         blk.program._version += 1  # invalidate cached fingerprints/facts
 
 
+def _parse_sharding(var, axes):
+    """(axis_names, dim_placements|None) from the two accepted forms:
+
+    * ``{dim: axis}`` dict (or (dim, axis) pairs) — the full per-dim
+      placement the sharding DOMAIN propagates (negative dims resolve
+      against the var's rank when known);
+    * a bare axis name or sequence of names — the legacy
+      which-axes-touch-this-value form (dims unknown: the replication
+      lattice still marks the value varying, the spec domain pins ⊤).
+    """
+    if isinstance(axes, dict) or (
+            isinstance(axes, (list, tuple)) and axes and all(
+                isinstance(e, (list, tuple)) and len(e) == 2
+                for e in axes)):
+        items = axes.items() if isinstance(axes, dict) else axes
+        rank = None
+        shape = getattr(var, "shape", None)
+        if shape is not None:
+            rank = len(shape)
+        placements = []
+        for d, a in items:
+            d = int(d)
+            if d < 0:
+                if rank is None:
+                    raise ValueError(
+                        f"mark_sharded: negative dim {d} needs a var "
+                        f"with a known shape")
+                d += rank
+            if rank is not None and not (0 <= d < rank):
+                raise ValueError(
+                    f"mark_sharded: dim {d} out of range for shape "
+                    f"{tuple(shape)}")
+            placements.append((d, str(a)))
+        spec = ShardSpec.of(placements)
+        return tuple(a for _, a in spec.placements), spec.placements
+    names = tuple(axes) if isinstance(axes, (list, tuple)) else (axes,)
+    return tuple(str(a) for a in names), None
+
+
 def mark_sharded(var, axes) -> None:
-    """Mark the producer of `var` as carrying an auto-axis sharding
-    annotation (the with_sharding_constraint analogue PR 12's
-    lowerings emit): GSPMD may insert collectives wherever the value
-    is consumed, so the prover treats it as varying and PTA131 rejects
-    reads of it inside divergent contexts.
+    """Mark `var` as carrying an auto-axis sharding annotation (the
+    with_sharding_constraint analogue PR 12+'s lowerings emit): GSPMD
+    may insert collectives wherever the value is consumed, so the
+    prover treats it as varying and PTA131 rejects reads of it inside
+    divergent contexts. The dict form ``{dim: axis}`` additionally
+    pins the value's ShardSpec for the sharding domain (PTA160/161
+    propagation, the PTA170 per-device planner).
+
+    The annotation rides BOTH the producer op (when one exists) and
+    the Variable itself: data/feed vars and parameters have no
+    producer in an inference/step program, yet sharded INPUTS are
+    precisely the sharded-serving entry point — the var-level seed is
+    what lets a builder annotate them at all.
 
     Reference counterpart: the reference annotated placement per op
     (reference framework/op_desc.cc device attrs); GSPMD auto-axis
     annotations whose collectives MOVE have no analogue there.
     """
+    names, placements = _parse_sharding(var, axes)
     op = _producer_op(var)
-    if op is None:
+    if op is None and getattr(var, "block", None) is None:
         raise ValueError(
-            f"mark_sharded: no producer op found for "
-            f"{getattr(var, 'name', var)!r}")
-    op.attrs[SHARDING_ATTR] = tuple(axes) if isinstance(
-        axes, (list, tuple)) else (axes,)
+            f"mark_sharded: {getattr(var, 'name', var)!r} has neither "
+            f"a producer op nor a Variable to seed — pass the "
+            f"Variable object (layers.data / block.create_var result)")
+    if op is not None:
+        op.attrs[SHARDING_ATTR] = names
+        if placements is not None:
+            op.attrs[SHARDING_DIMS_ATTR] = placements
+    if getattr(var, "block", None) is not None:
+        # var-level seed: producer-less vars (feeds, parameters) AND
+        # read-before-write state see the annotation from iteration 1
+        var._sharding_axes = names
+        var._sharding_dims = placements
     blk = getattr(var, "block", None)
     if blk is not None and blk.program is not None:
         blk.program._version += 1
@@ -265,9 +477,60 @@ class ProgramFacts:
     sites: List[OpSite] = field(default_factory=list)
     iterations: int = 0
     converged: bool = True
+    # --- the sharding domain ---
+    specs: Dict[str, ShardSpec] = field(default_factory=dict)
+    pinned: Dict[str, ShardSpec] = field(default_factory=dict)
+    # sharding-implied collectives/reshards, in walk order
+    collective_events: List[EventSite] = field(default_factory=list)
+    mesh: Optional[MeshConfig] = None
 
     def value(self, name: str) -> ValueFact:
         return self.values.get(name, ValueFact(REPLICATED))
+
+    def spec(self, name: str) -> ShardSpec:
+        got = self.pinned.get(name)
+        if got is not None:
+            return got
+        return self.specs.get(name, REPLICATED_SPEC)
+
+    def nontrivial_specs(self) -> Dict[str, str]:
+        """{var: spec description} for every var whose propagated (or
+        pinned) spec is not plain-replicated — the snapshot the CI
+        baseline's ``sharding_facts`` section drift-gates."""
+        out = {}
+        for name in set(self.specs) | set(self.pinned):
+            s = self.spec(name)
+            if not s.is_replicated:
+                out[name] = s.describe()
+        return out
+
+    def stable_sharding_facts(self) -> Dict[str, str]:
+        """``nontrivial_specs`` restricted to STABLY-named vars —
+        pinned annotations plus persistable/data vars: auto-generated
+        temp names (tmp_N) shift with process-global build order, so
+        only the stable surface feeds the CI baseline's
+        ``sharding_facts`` drift gate (analysis/baseline.py)."""
+        stable = {}
+        named = set(self.pinned)
+        for blk, _ in iter_blocks(self.program):
+            for name, var in blk.vars.items():
+                if var.persistable or var.is_data:
+                    named.add(name)
+        for name, desc in self.nontrivial_specs().items():
+            if name in named:
+                stable[name] = desc
+        if self.mesh is not None and stable:
+            stable["@mesh"] = self.mesh.describe()
+        return stable
+
+    def device_memory_plan(self, batch: int = 1):
+        """Static per-device memory plan for the program under the
+        propagated specs (analysis/memplan.py): bytes per persistable
+        / feed / temp, totals and per-device totals. `batch`
+        substitutes dynamic (-1) dims."""
+        from . import memplan
+
+        return memplan.build_plan(self, batch=batch)
 
     def guards(self, op: Operator) -> Tuple[GuardFact, ...]:
         return self._guards.get(id(op), ())
@@ -304,7 +567,11 @@ class _Interp:
     are program-unique in practice (sub-block kernels resolve parent
     names by identity), and the join makes any accidental collision
     err toward varying/unknown — conservative, never silently
-    uniform."""
+    uniform. The sharding domain runs in the SAME walk: per-op
+    propagation rules (core/registry.py register_sharding_rule) carry
+    ShardSpecs forward, annotation pins hold them fixed, and the
+    collectives a lowering implies are recorded per site with the
+    guard stack they would execute under."""
 
     def __init__(self, program: Program):
         self.program = program
@@ -312,14 +579,51 @@ class _Interp:
         self.guards: Dict[int, Tuple[GuardFact, ...]] = {}
         self.sites: List[OpSite] = []
         self.changed = False
+        self.mesh = mesh_of(program)
+        self.specs: Dict[str, ShardSpec] = {}
+        self.events: List[EventSite] = []
+        self._top_warned: set = set()
+        # spec pins: var-level annotations (mark_sharded on feeds /
+        # parameters / state) plus op-level dim annotations — the
+        # with_sharding_constraint analogue: the annotated name HOLDS
+        # its spec; a producer that disagrees is an implicit reshard
+        # fact, not a join to ⊤
+        self.pins: Dict[str, ShardSpec] = {}
+        for blk, _ in iter_blocks(program):
+            for name, var in blk.vars.items():
+                dims = getattr(var, "_sharding_dims", None)
+                axes = getattr(var, "_sharding_axes", None)
+                if dims is not None:
+                    self.pins[name] = ShardSpec.of(dims)
+                elif axes is not None:
+                    self.pins.setdefault(name, TOP_SPEC)
+                if axes is not None:
+                    # var-level annotations (producer-less feeds/
+                    # params/state) mint VARYING from iteration 1 —
+                    # sharded values invite GSPMD collectives at
+                    # their consumers (PTA131's premise)
+                    self.values[name] = ValueFact(
+                        VARYING, f"sharding:{tuple(axes)}", None,
+                        sharded=tuple(axes))
+            for op in blk.ops:
+                dims = op.attrs.get(SHARDING_DIMS_ATTR)
+                if dims is not None:
+                    for n in op.output_arg_names:
+                        if n != EMPTY_VAR:
+                            self.pins.setdefault(n, ShardSpec.of(dims))
 
     def run(self) -> ProgramFacts:
+        # rule families register at first use (import side effect),
+        # mirroring how kernels register at ops/ import
+        from . import sharding_rules  # noqa: F401
+
         iters = 0
         converged = False
         for iters in range(1, _MAX_ITERS + 1):
             self.changed = False
             self.guards.clear()
             self.sites = []
+            self.events = []
             for blk, container in self._top_blocks():
                 self._walk(blk, container, ())
             if not self.changed:
@@ -327,7 +631,11 @@ class _Interp:
                 break
         facts = ProgramFacts(self.program, dict(self.values),
                              dict(self.guards), list(self.sites),
-                             iterations=iters, converged=converged)
+                             iterations=iters, converged=converged,
+                             specs=dict(self.specs),
+                             pinned=dict(self.pins),
+                             collective_events=list(self.events),
+                             mesh=self.mesh)
         return facts
 
     def _top_blocks(self):
@@ -370,12 +678,118 @@ class _Interp:
         if axes:
             return ValueFact(VARYING, f"sharding:{tuple(axes)}",
                              site.anchor(), sharded=tuple(axes))
+        if any(True for _ in iter_sub_blocks(op)):
+            # container op: the body's writes land in the shared name
+            # map during the sub-block walk, so joining every DATA
+            # input here would smear e.g. a sharded loop input onto
+            # the carried guard var and misclassify a genuinely
+            # uniform loop as divergent. Only the guard's own
+            # divergence flows onto the carried outputs (a value
+            # whose definition depends on a divergent predicate is
+            # divergent even if each branch writes uniformly).
+            fact = ValueFact(REPLICATED)
+            cond_slot = _COND_SLOTS.get(op.type)
+            if cond_slot is not None:
+                for n in op.inputs.get(cond_slot) or []:
+                    if n != EMPTY_VAR:
+                        fact = fact.joined(self._value_of(n, blk))
+            return fact
         fact = ValueFact(REPLICATED)
         for n in op.input_arg_names:
             if n == EMPTY_VAR:
                 continue
             fact = fact.joined(self._value_of(n, blk))
         return fact
+
+    # --- the sharding-spec transfer ------------------------------------
+    def _spec_of(self, name: str, blk: Block) -> ShardSpec:
+        got = self.pins.get(name)
+        if got is not None and not got.is_top:
+            return got
+        got = self.specs.get(name)
+        if got is not None:
+            return got
+        if name in self.pins:           # legacy axes-only annotation
+            return TOP_SPEC
+        return REPLICATED_SPEC
+
+    def _set_spec(self, name: str, spec: ShardSpec, site: OpSite,
+                  guards) -> None:
+        pin = self.pins.get(name)
+        if pin is not None and not pin.is_top:
+            # the annotation HOLDS (with_sharding_constraint): a
+            # producer computing a different layout implies GSPMD
+            # reshards at the write — record the fact, keep the pin
+            if spec != pin and not spec.is_top:
+                self.events.append(EventSite(site, guards, CollectiveEvent(
+                    "reshard", spec.axes() + pin.axes(), name,
+                    f"producer computes {spec.describe()} but "
+                    f"{name!r} is pinned {pin.describe()}")))
+            return
+        old = self.specs.get(name)
+        new = spec if old is None else spec_join(old, spec)
+        if old != new:
+            self.specs[name] = new
+            self.changed = True
+
+    def _transfer_specs(self, op: Operator, blk: Block, site: OpSite,
+                        guards) -> None:
+        from ..core.registry import get_sharding_rule
+
+        if any(True for _ in iter_sub_blocks(op)):
+            # container op: carried outputs are written BY the body
+            # (walked into the same spec map), so there is nothing to
+            # transfer here — and degrading them to ⊤ would clobber
+            # the body-propagated layouts and emit a misleading
+            # "register a rule for 'while'" warning
+            return
+        dims = op.attrs.get(SHARDING_DIMS_ATTR)
+        if dims is not None:
+            spec = ShardSpec.of(dims)
+            for n in op.output_arg_names:
+                if n != EMPTY_VAR:
+                    self._set_spec(n, spec, site, guards)
+            return
+
+        def spec_of(name):
+            return self._spec_of(name, blk)
+
+        def shape_of(name):
+            var = blk._find_var_recursive(name) \
+                if blk is not None else None
+            if var is None or var.shape is None:
+                return None
+            return tuple(var.shape)
+
+        rule = get_sharding_rule(op.type)
+        if rule is not None:
+            out_specs, events = rule(op, spec_of, shape_of, self.mesh)
+            for n, s in out_specs.items():
+                self._set_spec(n, s, site, guards)
+            for ev in events:
+                self.events.append(EventSite(site, guards, ev))
+            return
+        # no rule: replicated-in -> replicated-out is sound (an
+        # unannotated op cannot mint sharding); any sharded input
+        # degrades every output to the explicit ⊤ spec, warn-once
+        touched = [n for n in op.input_arg_names
+                   if n != EMPTY_VAR
+                   and not self._spec_of(n, blk).is_replicated]
+        out = TOP_SPEC if touched else REPLICATED_SPEC
+        if touched and op.type not in self._top_warned:
+            self._top_warned.add(op.type)
+            import warnings
+
+            warnings.warn(
+                f"sharding domain: op type {op.type!r} has no "
+                f"registered sharding rule but consumes sharded "
+                f"value(s) {touched[:3]}; its outputs degrade to the "
+                f"⊤ spec. Register a rule via core.registry."
+                f"register_sharding_rule (analysis/sharding_rules.py "
+                f"has the families) or explicitly declare replication.")
+        for n in op.output_arg_names:
+            if n != EMPTY_VAR:
+                self._set_spec(n, out, site, guards)
 
     def _walk(self, blk: Block, container: Optional[Operator],
               guard_stack: Tuple[GuardFact, ...]):
@@ -388,6 +802,8 @@ class _Interp:
             for n in op.output_arg_names:
                 if n != EMPTY_VAR:
                     self._set(n, out_fact)
+            if op.type not in ("feed", "fetch"):
+                self._transfer_specs(op, blk, site, guard_stack)
             subs = list(iter_sub_blocks(op))
             if not subs:
                 continue

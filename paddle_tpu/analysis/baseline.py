@@ -50,6 +50,14 @@ class TargetReport:
     diagnostics: List[Diagnostic] = field(default_factory=list)
     suppressed: List[Tuple[Diagnostic, str]] = field(
         default_factory=list)
+    # stable propagated-sharding snapshot (absint
+    # stable_sharding_facts): var -> spec description; feeds the
+    # baseline's drift-gated `sharding_facts` section
+    sharding: Dict[str, str] = field(default_factory=dict)
+    # static per-device memory plan (analysis/memplan.MemoryPlan);
+    # filled only when collect_reports(with_plans=True) — the CLI's
+    # --memory-plan surface
+    plan: object = None
 
     def by_severity(self, severity: str) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.severity == severity]
@@ -57,7 +65,9 @@ class TargetReport:
 
 def collect_reports(include_benchmark: bool = True,
                     only: Optional[List[str]] = None,
-                    targets=None) -> List[TargetReport]:
+                    targets=None,
+                    collect_timings: Optional[Dict[str, float]] = None,
+                    with_plans: bool = False) -> List[TargetReport]:
     """Build (or accept pre-built) lint targets and run the FULL
     sweep over each: per-program checkers (with suppressions
     collected), the target's pairwise check, and the whole-bundle
@@ -68,6 +78,7 @@ def collect_reports(include_benchmark: bool = True,
     Reference counterpart: none — the reference gated one program at
     a time at build (op_desc.cc); a repo-wide diagnostic sweep is the
     CI-era extension (module docstring)."""
+    from . import absint
     from .targets import iter_lint_targets
 
     if targets is None:
@@ -79,7 +90,15 @@ def collect_reports(include_benchmark: bool = True,
         for label, prog in target.programs.items():
             rep = TargetReport(f"{target.name}:{label}")
             rep.diagnostics = run_checks(
-                prog, collect_suppressed=rep.suppressed)
+                prog, collect_suppressed=rep.suppressed,
+                collect_timings=collect_timings)
+            facts = absint.analyze(prog)
+            rep.sharding = facts.stable_sharding_facts()
+            if with_plans:
+                try:
+                    rep.plan = facts.device_memory_plan()
+                except Exception:
+                    rep.plan = None  # planner must never kill lint
             for a, b in target.pairs:
                 if label == a:
                     rep.diagnostics = rep.diagnostics + pair_check(
@@ -100,13 +119,18 @@ def _key(target: str, d: Diagnostic) -> str:
 
 def baseline_payload(reports: List[TargetReport]) -> dict:
     """The committed snapshot: gated (error/warning) finding counts
-    per stable key, suppression counts, and info totals (recorded for
+    per stable key, suppression counts, info totals (recorded for
     context, never gated — info findings are hygiene, and their
-    counts churn with every model tweak).
+    counts churn with every model tweak), and the zoo's propagated
+    sharding facts (``target|var`` -> spec description, stable names
+    only — absint.stable_sharding_facts): a propagation-rule change
+    that silently re-lays-out an annotated program shows up as a
+    sharding_facts diff, drift-gated exactly like a new warning.
 
     Reference counterpart: none (see diff_against_baseline)."""
     entries: Dict[str, int] = {}
     suppressed: Dict[str, int] = {}
+    sharding: Dict[str, str] = {}
     n_err = n_warn = n_info = 0
     for rep in reports:
         for d in rep.diagnostics:
@@ -122,10 +146,13 @@ def baseline_payload(reports: List[TargetReport]) -> dict:
         for d, _reason in rep.suppressed:
             k = _key(rep.target, d)
             suppressed[k] = suppressed.get(k, 0) + 1
+        for var, desc in rep.sharding.items():
+            sharding[f"{rep.target}|{var}"] = desc
     return {
-        "version": 1,
+        "version": 2,
         "entries": {k: entries[k] for k in sorted(entries)},
         "suppressed": {k: suppressed[k] for k in sorted(suppressed)},
+        "sharding_facts": {k: sharding[k] for k in sorted(sharding)},
         "totals": {"errors": n_err, "warnings": n_warn,
                    "infos": n_info, "targets": len(reports)},
     }
@@ -164,6 +191,20 @@ def diff_against_baseline(reports: List[TargetReport],
             have = current.get(k, 0)
             if have < n:
                 resolved.append(f"{k} (-{n - have}{tag})")
+    # sharding_facts: value-compared, not counted — a CHANGED spec is
+    # drift (a propagation-rule or annotation change re-laid-out the
+    # zoo) and fails like a new warning until the baseline refresh
+    # puts the new layout in front of a reviewer
+    current = payload["sharding_facts"]
+    base = dict(baseline.get("sharding_facts", {}))
+    for k, v in current.items():
+        if k not in base:
+            new.append(f"{k}={v} (new sharding fact)")
+        elif base[k] != v:
+            new.append(f"{k}={v} (was {base[k]}: sharding drift)")
+    for k, v in base.items():
+        if k not in current:
+            resolved.append(f"{k} (sharding fact gone)")
     return sorted(new), sorted(resolved)
 
 

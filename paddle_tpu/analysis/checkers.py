@@ -50,6 +50,20 @@ a stable diagnostic code so tests/docs can reference the class:
   PTA150  decode-bundle contract (check_bundle: all serve/admission/
           step specializations of one DecodeStepBundle must agree on
           cache geometry, seed derivation, and counter presence)
+  PTA160  sharding contradiction / implicit reshard (the sharding
+          domain: consumers demanding incompatible ShardSpecs, or a
+          GSPMD-forced reshard landing inside a serve-While body —
+          the r5 'dp on the pre-reshape dim' trap, proven from the
+          propagated specs instead of pattern-matched)
+  PTA161  collective-order agreement (symbolic enumeration of the
+          collective sequence each mesh coordinate observes through
+          divergent guards, over BOTH literal collective ops and the
+          sharding-implied psum/allgather/reshard events; ERROR when
+          two coordinates can disagree — the 1F1B x tp vocab-psum
+          rejection becomes a corollary of this proof)
+  PTA170  per-device memory budget (the static planner
+          analysis/memplan.py: persistable/feed/temp bytes under the
+          propagated specs vs an opt-in per-program budget)
 
 Severities: "error" = the program is wrong (strict mode raises),
 "warning" = almost certainly a bug but a legal feed/scope could save
@@ -223,7 +237,8 @@ def _collect_suppressions(program: Program):
 
 def run_checks(program: Program,
                only: Optional[Iterable[str]] = None,
-               collect_suppressed: Optional[list] = None
+               collect_suppressed: Optional[list] = None,
+               collect_timings: Optional[Dict[str, float]] = None
                ) -> List[Diagnostic]:
     """Run every registered checker (or the `only` subset of codes)
     over `program`; returns diagnostics sorted error-first, stable
@@ -231,13 +246,23 @@ def run_checks(program: Program,
     ``_pta_suppress`` attr are dropped from the return value and — when
     `collect_suppressed` is a list — appended to it as
     (diagnostic, reason) pairs so callers (CLI --json, the CI
-    baseline) can count and surface them."""
+    baseline) can count and surface them. `collect_timings`
+    accumulates per-checker wall seconds (code -> s) across calls —
+    the CLI's --json surfaces the totals so a slow checker is
+    attributable instead of a mystery in the gate's wall-time pin."""
+    import time as _time
+
     codes = set(only) if only is not None else None
     out: List[Diagnostic] = []
     for checker in registered_checkers():
         if codes is not None and checker.code not in codes:
             continue
+        t0 = _time.perf_counter() if collect_timings is not None \
+            else 0.0
         out.extend(checker.fn(program))
+        if collect_timings is not None:
+            collect_timings[checker.code] = collect_timings.get(
+                checker.code, 0.0) + (_time.perf_counter() - t0)
     sup, malformed = _collect_suppressions(program)
     if malformed and (codes is None or "PTA199" in codes):
         out.extend(malformed)
@@ -455,6 +480,25 @@ def _walk_block_ops(blk: Block, seen=None):
             yield from _walk_block_ops(sub, seen)
 
 
+def _prover_coverage(program: Program):
+    """Op ids the PTA130 prover covers (every site it walked under a
+    traced guard), or None when the prover is unavailable for this
+    program (fixpoint failed to converge / raised) — the legacy
+    PTA010/011 pattern matchers only emit at sites the prover does
+    NOT cover, so each incident surfaces exactly once, with the
+    proof-carrying diagnostic when one exists (the twin-diagnostic
+    dedupe; the gate test pins the superset relation)."""
+    from . import absint
+
+    try:
+        facts = absint.analyze(program)
+    except Exception:
+        return None
+    if not facts.converged:
+        return None
+    return {id(site.op) for site, _g in facts.guarded_sites()}
+
+
 @register_checker("PTA010", "collective-in-divergent-branch")
 def check_collective_in_branch(program: Program):
     """NO collective may live inside divergent control flow: devices
@@ -463,7 +507,13 @@ def check_collective_in_branch(program: Program):
     collective executes, and the program deadlocks. This is the r5
     shard_map + lax.cond trap (CLAUDE.md) as a build-time error; the
     reference had no equivalent because its executor ran branches on
-    the host."""
+    the host.
+
+    Sites the absint prover covers are left to PTA130, which carries
+    the same ERROR stance plus the divergence proof — this pattern
+    matcher is the FALLBACK for programs the fixpoint engine cannot
+    analyze, so the two never double-report one incident."""
+    covered = _prover_coverage(program)
     for blk, container in iter_blocks(program):
         for i, op in enumerate(blk.ops):
             if op.type not in DIVERGENT_CONTAINERS:
@@ -471,6 +521,9 @@ def check_collective_in_branch(program: Program):
             for attr_name, sub in iter_sub_blocks(op):
                 for j, inner in _walk_block_ops(sub):
                     if _is_collective(inner):
+                        if covered is not None and \
+                                id(inner) in covered:
+                            continue  # PTA130 proves this site
                         site = OpSite(blk.idx, i, op, container)
                         yield _diag_at(
                             "PTA010", ERROR, site,
@@ -492,7 +545,11 @@ def check_scope_collective_in_branch(program: Program):
     scope (context/expert parallel) is active, found inside divergent
     control flow. Warning: single-device lowering is fine, but the
     same program under scope_context_parallel/expert_parallel plants
-    a collective in the branch — the r6 generalized GSPMD trap."""
+    a collective in the branch — the r6 generalized GSPMD trap.
+    Like PTA010, sites the absint prover covers are left to PTA130
+    (which also upgrades them to ERROR under a proven-divergent
+    guard); this matcher is the non-convergence fallback."""
+    covered = _prover_coverage(program)
     for blk, container in iter_blocks(program):
         for i, op in enumerate(blk.ops):
             if op.type not in DIVERGENT_CONTAINERS:
@@ -501,6 +558,9 @@ def check_scope_collective_in_branch(program: Program):
             for attr_name, sub in iter_sub_blocks(op):
                 for _, inner in _walk_block_ops(sub):
                     if inner.type in SCOPE_COLLECTIVE_OP_TYPES:
+                        if covered is not None and \
+                                id(inner) in covered:
+                            continue
                         found[inner.type] = found.get(inner.type, 0) + 1
             for inner_type, count in sorted(found.items()):
                 site = OpSite(blk.idx, i, op, container)
@@ -1325,6 +1385,182 @@ def check_replicated_in_divergent_context(program: Program):
 
 
 GRAD_MARK = "@GRAD"
+
+
+# ---------------------------------------------------------------------------
+# PTA160/PTA161/PTA170: the sharding & resource provers (the sharding
+# domain of analysis/absint.py — propagated ShardSpecs, implied
+# collectives, and the static per-device memory planner).
+# ---------------------------------------------------------------------------
+_LOOP_CONTAINERS = ("while", "run_block_if")
+
+
+def _in_loop(guards) -> bool:
+    return any(g.container_type in _LOOP_CONTAINERS for g in guards)
+
+
+def _event_where(es) -> str:
+    out = f"{es.event.kind} over mesh axes {sorted(set(es.event.axes))}"
+    if es.event.var:
+        out += f" (var {es.event.var!r})"
+    return out
+
+
+@register_checker("PTA160", "sharding-contradiction")
+def check_sharding_contradiction(program: Program):
+    """Sharding-contradiction / implicit-reshard prover. Two failure
+    classes, both read off the propagated spec facts:
+
+    * **conflict** — consumers demand incompatible ShardSpecs for one
+      value (an elementwise/concat joining a dim0-dp operand with a
+      dim0-tp operand): GSPMD silently reshards one side. WARNING in
+      straight-line code (a one-off reshard is a perf bug), ERROR
+      under a serve-While / divergent guard (a reshard per tick, or a
+      branch-internal collective — the deadlock class).
+    * **reshard** — a single value whose layout GSPMD must change at
+      this site (a reshape splitting a sharded dim off its major
+      position, a producer disagreeing with a pinned annotation —
+      the r5 'dp on the pre-reshape dim' trap). Silent in
+      straight-line code (the facts record it; the planner prices
+      it), ERROR inside a While body or divergent context.
+    """
+    from . import absint
+
+    facts = absint.analyze(program)
+    for es in facts.collective_events:
+        if es.event.kind not in ("conflict", "reshard"):
+            continue
+        hot = _in_loop(es.guards) or facts.divergent(es.guards)
+        if es.event.kind == "reshard" and not hot:
+            continue  # a recorded fact, not a finding
+        sev = ERROR if hot else WARNING
+        where = ("inside a serve-While/divergent context "
+                 f"[{_guard_proof(facts, es.guards)}]" if es.guards
+                 else "in straight-line code")
+        yield _diag_at(
+            "PTA160", sev, es.site,
+            f"sharding {es.event.kind}: {es.event.why} — {where}"
+            + ("; GSPMD materializes the reshard collective INSIDE "
+               "the loop/branch body, every iteration" if hot
+               else ""),
+            var=es.event.var,
+            hint="apply ONE with_sharding_constraint on the value the "
+                 "consumers actually share, OUTSIDE the divergent "
+                 "region (CLAUDE.md r5: the post-reshape mb dim, not "
+                 "the pre-reshape full-batch dim)")
+
+
+@register_checker("PTA161", "collective-order-proof")
+def check_collective_order(program: Program):
+    """Collective-order agreement, proven symbolically: enumerate the
+    sequence of collectives — literal collective ops AND the psum/
+    allgather/reshard events the sharding domain proves the lowering
+    implies — that each mesh coordinate observes, composing with the
+    divergence lattice: a collective under a PROVEN-divergent guard
+    is observed by the coordinates taking that path and NOT by the
+    others, so the two coordinate classes disagree on the collective
+    sequence and the program deadlocks (XLA collectives must be
+    issued in identical order on every participant). ERROR with the
+    divergence source named; WARNING when a guard's divergence is
+    unprovable (order agreement cannot be verified).
+
+    The 1F1B x tp rejection (pipeline_1f1b.py's named ValueError) is
+    a COROLLARY here: a vocab/row-sharded matmul inside the per-stage
+    F/B cond implies a psum over 'tp' under a 'pp_stage_id'-divergent
+    guard — exactly the shape this prover rejects, for any future
+    lowering, without naming schedules. Literal collective sites
+    under guards are already PTA130 errors; this checker reports the
+    sharding-IMPLIED events PTA130 cannot see, and carries the full
+    observed-sequence enumeration in the diagnostic so the
+    disagreement is readable, not asserted."""
+    from . import absint
+
+    facts = absint.analyze(program)
+    implied = [es for es in facts.collective_events
+               if es.event.kind in ("psum", "allgather")]
+    if not implied:
+        return
+    # the symbolic sequence: every collective-like event in walk
+    # order, tagged with whether ALL coordinates observe it
+    literal = {id(site.op): site for site in facts.sites
+               if _is_collective(site.op)}
+    seq = []
+    for site in facts.sites:
+        if id(site.op) in literal:
+            g = facts.guards(site.op)
+            seq.append((f"{site.op.type}@{site.anchor()}",
+                        facts.divergent(g) or facts.unproven(g)))
+    for es in implied:
+        seq.append((f"implied-{es.event.kind}"
+                    f"[{','.join(sorted(set(es.event.axes)))}]"
+                    f"@{es.site.anchor()}",
+                    facts.divergent(es.guards)
+                    or facts.unproven(es.guards)))
+    for es in implied:
+        if not es.guards or not facts.unproven(es.guards):
+            continue  # unguarded / value-uniform: every coord agrees
+        divergent = facts.divergent(es.guards)
+        sev = ERROR if divergent else WARNING
+        srcs = sorted({g.source for g in es.guards
+                       if g.fact == absint.VARYING and g.source})
+        all_seq = ", ".join(s for s, _ in seq)
+        other_seq = ", ".join(s for s, guarded in seq
+                              if not guarded) or "(empty)"
+        yield _diag_at(
+            "PTA161", sev, es.site,
+            f"collective-order disagreement: the sharded lowering "
+            f"implies a {_event_where(es)} under "
+            f"{len(es.guards)} traced guard(s) "
+            f"[{_guard_proof(facts, es.guards)}]. "
+            + (f"Coordinates where the guard holds observe the "
+               f"sequence [{all_seq}]; coordinates differing in "
+               f"{srcs} observe [{other_seq}] — participants "
+               f"disagree on whether this collective runs: deadlock"
+               if divergent else
+               "divergence of the guard is unprovable, so order "
+               "agreement across mesh coordinates cannot be "
+               "verified"),
+            var=es.event.var,
+            hint="hoist the sharded computation (and its implied "
+                 "collective) out of the divergent region and mask "
+                 "its input instead — or keep tp-sharded params out "
+                 "of per-stage/per-lane branches (the 1F1B x tp "
+                 "rejection, derived)")
+
+
+@register_checker("PTA170", "device-memory-budget")
+def check_device_memory_budget(program: Program):
+    """Static per-device memory budget: when a program opts in via
+    ``absint.set_device_memory_budget(program, bytes)``, the PTA170
+    planner (analysis/memplan.py — persistable + feed + temp bytes
+    under the propagated ShardSpecs, validated against the XLA
+    compiler's own ``compiled.memory_analysis()`` accounting in
+    tests/test_memory_plan.py) prices the program per device and an
+    over-budget plan becomes an ERROR here instead of a device OOM
+    after minutes of compile."""
+    from . import absint
+
+    budget = absint.device_memory_budget(program)
+    if budget is None:
+        return
+    facts = absint.analyze(program)
+    plan = facts.device_memory_plan()
+    total = plan.total_device_bytes
+    if total <= budget:
+        return
+    top = sorted(plan.state + plan.feeds,
+                 key=lambda v: -v.device_bytes)[:3]
+    biggest = ", ".join(f"{v.name}={v.device_bytes}B" for v in top)
+    yield Diagnostic(
+        "PTA170", ERROR,
+        f"per-device memory plan {total} bytes exceeds the declared "
+        f"budget {budget} bytes (state {plan.state_device_bytes} + "
+        f"feeds {plan.feed_device_bytes} + temps "
+        f"{plan.temp_device_bytes}; largest: {biggest})"
+        + (f" on mesh {plan.mesh.describe()}" if plan.mesh else ""),
+        hint="shard the largest state over a mesh axis "
+             "(absint.mark_sharded with a {dim: axis} placement), "
+             "shrink the geometry, or raise the budget")
 
 
 # ---------------------------------------------------------------------------

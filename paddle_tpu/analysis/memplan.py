@@ -1,0 +1,345 @@
+"""Static per-device memory planner (PTA170's engine).
+
+Prices a Program's device memory BEFORE any trace/compile, from IR
+metadata alone, under the sharding domain's propagated ShardSpecs
+(analysis/absint.py):
+
+* **state** — every persistable the executor's state_in path feeds
+  (params, optimizer moments, KV pools, slot counters: the walk
+  mirrors core/executor.py `_analyze_block_py`, so the byte total
+  matches the compiled executable's argument accounting EXACTLY —
+  tests/test_memory_plan.py pins that against the XLA compiler's own
+  ``compiled.memory_analysis().argument_size_in_bytes`` on the CPU
+  backend, the r5-proven comparison surface);
+* **feeds** — declared data vars at a chosen batch;
+* **temps** — a peak-liveness estimate over the block schedule with
+  in-place/fusion modeling for the elementwise family (XLA fuses
+  elementwise chains and aliases same-size elementwise outputs, so a
+  naive sum of all intermediates over-prices 2-5x; with the aliasing
+  model the estimate lands within ~25% of
+  ``memory_analysis().temp_size_in_bytes`` on the straight-line zoo
+  programs the validation test pins). While/cond bodies contribute
+  their own peak at the container's position.
+
+Per-DEVICE bytes divide each var's sharded dims by the MeshConfig
+axis size (ceil, XLA's shard sizing): a KV pool sharded
+``{head_dim: "tp"}`` prices at ~1/tp per device — the ROADMAP's
+sharded-serving capacity claim, now a checkable number instead of
+arithmetic in a doc.
+
+Dtype accounting canonicalizes like the runtime (x64 disabled:
+int64/uint64/float64 narrow to their 32-bit forms) so planned bytes
+are DEVICE bytes, not numpy bytes.
+
+Pure Python over Program metadata: no jax, no tracing — a whole
+model prices in milliseconds (module invariant shared with the rest
+of analysis/).
+
+Reference counterpart: reference contrib memory_usage_calc.py
+estimated TOTAL bytes from var shapes alone (no sharding, no
+liveness, no executor contract); this planner is that idea rebuilt
+against the jit executor's actual argument/temp surfaces.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..core.program import Block, Program
+from ..core.registry import EMPTY_VAR
+from .absint import MeshConfig, REPLICATED_SPEC, ShardSpec
+
+__all__ = ["VarPlan", "MemoryPlan", "build_plan", "canonical_dtype",
+           "var_nbytes", "RNG_KEY_BYTES", "INPLACE_OP_TYPES"]
+
+# the executor threads one PRNGKey (uint32[2]) through every step
+RNG_KEY_BYTES = 8
+
+# ops whose XLA lowering is elementwise enough that the output buffer
+# aliases a dying same-size input (fusion / in-place elementwise):
+# the temp estimator's aliasing model. Layout movers (reshape/
+# transpose on contiguous buffers) behave the same way.
+INPLACE_OP_TYPES = frozenset({
+    "scale", "cast", "assign", "relu", "sigmoid", "tanh", "exp",
+    "log", "sqrt", "square", "clip", "dropout", "softmax",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "reshape", "unsqueeze", "squeeze", "transpose", "brelu", "elu",
+    "leaky_relu", "sum",
+})
+
+_CANON = {"int64": "int32", "uint64": "uint32", "float64": "float32"}
+
+
+def canonical_dtype(dtype) -> np.dtype:
+    """Device dtype under the runtime's x64-disabled canonicalization
+    (jax default; CLAUDE.md r5: 'feed dtypes must be
+    jax-canonicalized or parameter sizes mismatch')."""
+    s = np.dtype(dtype).name
+    return np.dtype(_CANON.get(s, s))
+
+
+def _concrete_shape(shape, batch: int) -> Tuple[int, ...]:
+    return tuple(batch if (d is None or d < 0) else int(d)
+                 for d in (shape or ()))
+
+
+def var_nbytes(var, batch: int) -> int:
+    """Total device bytes of one var's full (unsharded) value."""
+    if var is None or var.dtype is None:
+        return 0
+    shape = _concrete_shape(var.shape, batch)
+    n = 1
+    for d in shape:
+        n *= d
+    return n * canonical_dtype(var.dtype.value).itemsize
+
+
+def _device_nbytes(var, batch: int, spec: ShardSpec,
+                   mesh: Optional[MeshConfig]) -> int:
+    """Per-device bytes under `spec`: each sharded dim divides by its
+    mesh axis size (ceil — XLA pads the ragged shard)."""
+    if var is None or var.dtype is None:
+        return 0
+    shape = list(_concrete_shape(var.shape, batch))
+    if spec.placements:
+        for d, a in spec.placements:
+            if d < len(shape):
+                size = mesh.size(a) if mesh is not None else 1
+                shape[d] = math.ceil(shape[d] / max(size, 1))
+    n = 1
+    for d in shape:
+        n *= d
+    return n * canonical_dtype(var.dtype.value).itemsize
+
+
+@dataclass(frozen=True)
+class VarPlan:
+    """One priced var."""
+    name: str
+    klass: str                  # "state" | "feed"
+    shape: Tuple[int, ...]
+    dtype: str
+    bytes: int                  # full logical value
+    device_bytes: int           # per-device under the spec
+    spec: str                   # ShardSpec.describe()
+
+
+@dataclass
+class MemoryPlan:
+    """The static plan: what `analyze(p).device_memory_plan()` and
+    the CLI's ``--memory-plan`` surface."""
+    program: Program
+    batch: int
+    mesh: Optional[MeshConfig]
+    state: List[VarPlan] = field(default_factory=list)
+    feeds: List[VarPlan] = field(default_factory=list)
+    temp_bytes: int = 0
+    temp_device_bytes: int = 0
+    rng_bytes: int = RNG_KEY_BYTES
+    # state_in names the planner could not price (no declared var)
+    unsized: List[str] = field(default_factory=list)
+
+    @property
+    def state_bytes(self) -> int:
+        return sum(v.bytes for v in self.state)
+
+    @property
+    def state_device_bytes(self) -> int:
+        return sum(v.device_bytes for v in self.state)
+
+    @property
+    def feed_bytes(self) -> int:
+        return sum(v.bytes for v in self.feeds)
+
+    @property
+    def feed_device_bytes(self) -> int:
+        return sum(v.device_bytes for v in self.feeds)
+
+    @property
+    def argument_bytes(self) -> int:
+        """What the compiled step's XLA argument accounting shows:
+        state + feeds + the threaded PRNG key (exact-match surface,
+        tests/test_memory_plan.py)."""
+        return self.state_bytes + self.feed_bytes + self.rng_bytes
+
+    @property
+    def total_bytes(self) -> int:
+        return self.state_bytes + self.feed_bytes + self.temp_bytes \
+            + self.rng_bytes
+
+    @property
+    def total_device_bytes(self) -> int:
+        return self.state_device_bytes + self.feed_device_bytes \
+            + self.temp_device_bytes + self.rng_bytes
+
+    def entry(self, name: str) -> Optional[VarPlan]:
+        for v in self.state + self.feeds:
+            if v.name == name:
+                return v
+        return None
+
+    def state_device_bytes_matching(self, *substrings) -> int:
+        """Per-device bytes of state vars whose name contains any of
+        `substrings` — e.g. ('self_k', 'self_v') prices the KV pool."""
+        return sum(v.device_bytes for v in self.state
+                   if any(s in v.name for s in substrings))
+
+    def state_bytes_matching(self, *substrings) -> int:
+        return sum(v.bytes for v in self.state
+                   if any(s in v.name for s in substrings))
+
+    def summary(self) -> str:
+        head = (f"batch={self.batch}"
+                + (f" mesh={self.mesh.describe()}" if self.mesh
+                   else ""))
+        lines = [
+            f"memory plan [{head}]:",
+            f"  state  {self.state_bytes:>12} B total "
+            f"({self.state_device_bytes} B/device, "
+            f"{len(self.state)} vars)",
+            f"  feeds  {self.feed_bytes:>12} B total "
+            f"({self.feed_device_bytes} B/device, "
+            f"{len(self.feeds)} vars)",
+            f"  temps  {self.temp_bytes:>12} B peak "
+            f"({self.temp_device_bytes} B/device)",
+            f"  args   {self.argument_bytes:>12} B "
+            f"(state+feeds+rng: the XLA argument surface)",
+        ]
+        if self.unsized:
+            lines.append(f"  unsized: {self.unsized[:5]}")
+        return "\n".join(lines)
+
+
+def _state_and_feed_names(block: Block):
+    """Mirror core/executor.py _analyze_block_py: names read before
+    any write, minus declared data vars (feeds) — the executor's
+    state_in surface. Declared data vars are the feed surface."""
+    feeds = [v.name for v in block.vars.values() if v.is_data]
+    feedset = set(feeds)
+    produced = set(feedset)
+    state_in: List[str] = []
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        for n in op.input_arg_names:
+            if n == EMPTY_VAR or n in produced:
+                continue
+            produced.add(n)
+            state_in.append(n)
+        for n in op.output_arg_names:
+            produced.add(n)
+    return state_in, feeds
+
+
+def _block_temp_peak(blk: Block, feeds: Set[str], keep: Set[str],
+                     batch: int, spec_of, mesh, device: bool) -> int:
+    """Peak live temp bytes over one block's schedule, with the
+    elementwise aliasing model (module docstring); container ops add
+    their sub-blocks' own peaks at their position."""
+    buf_of: Dict[str, int] = {}
+    bufs: Dict[int, list] = {}       # id -> [size, birth, death]
+    reads: Dict[str, int] = {}
+    for i, op in enumerate(blk.ops):
+        for n in op.input_arg_names:
+            if n != EMPTY_VAR:
+                reads[n] = i
+    next_id = [0]
+    sub_peaks: Dict[int, int] = {}
+    for i, op in enumerate(blk.ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        for v in op.attrs.values():
+            if isinstance(v, Block):
+                sub_peaks[i] = sub_peaks.get(i, 0) + _block_temp_peak(
+                    v, feeds, keep, batch, spec_of, mesh, device)
+        for n in op.output_arg_names:
+            if n == EMPTY_VAR:
+                continue
+            if n in buf_of:
+                b = bufs[buf_of[n]]
+                b[2] = max(b[2], reads.get(n, i))
+                continue
+            var = blk._find_var_recursive(n)
+            if var is None or var.persistable or var.is_data \
+                    or n in feeds or n in keep:
+                continue
+            size = _device_nbytes(var, batch, spec_of(n), mesh) \
+                if device else var_nbytes(var, batch)
+            alias = None
+            if op.type in INPLACE_OP_TYPES:
+                for m in op.input_arg_names:
+                    b = buf_of.get(m)
+                    if b is not None and bufs[b][0] == size \
+                            and reads.get(m, -1) == i:
+                        alias = b
+                        break
+            if alias is not None:
+                buf_of[n] = alias
+                bufs[alias][2] = max(bufs[alias][2], reads.get(n, i))
+            else:
+                bid = next_id[0]
+                next_id[0] += 1
+                buf_of[n] = bid
+                bufs[bid] = [size, i, reads.get(n, i)]
+    peak = 0
+    for i in range(len(blk.ops)):
+        live = sum(s for s, b, d in bufs.values() if b <= i <= d)
+        live += sub_peaks.get(i, 0)
+        peak = max(peak, live)
+    return peak
+
+
+def build_plan(facts, batch: int = 1,
+               fetch_names: Tuple[str, ...] = ()) -> MemoryPlan:
+    """Build the plan from ProgramFacts (the cached absint fixpoint:
+    the specs are already propagated). `batch` substitutes dynamic
+    (-1) dims; `fetch_names` are excluded from the temp estimate
+    (XLA prices fetched values as outputs, not temps)."""
+    program = facts.program
+    mesh = facts.mesh
+    block = program.global_block
+    state_in, feed_names = _state_and_feed_names(block)
+    plan = MemoryPlan(program, batch, mesh)
+    for name in state_in:
+        var = block._find_var_recursive(name)
+        if var is None or var.dtype is None:
+            plan.unsized.append(name)
+            continue
+        spec = facts.spec(name)
+        plan.state.append(VarPlan(
+            name, "state", _concrete_shape(var.shape, batch),
+            canonical_dtype(var.dtype.value).name,
+            var_nbytes(var, batch),
+            _device_nbytes(var, batch, spec, mesh),
+            spec.describe()))
+    for name in feed_names:
+        var = block._find_var_recursive(name)
+        if var is None or var.dtype is None:
+            plan.unsized.append(name)
+            continue
+        spec = facts.spec(name)
+        plan.feeds.append(VarPlan(
+            name, "feed", _concrete_shape(var.shape, batch),
+            canonical_dtype(var.dtype.value).name,
+            var_nbytes(var, batch),
+            _device_nbytes(var, batch, spec, mesh),
+            spec.describe()))
+    # temps: persistable outputs (state_out) and fetches are not temp
+    keep = {n for op in block.ops for n in op.output_arg_names
+            if n != EMPTY_VAR and (
+                (block._find_var_recursive(n) or _NoVar).persistable)}
+    keep |= set(fetch_names)
+    feedset = set(feed_names)
+    plan.temp_bytes = _block_temp_peak(
+        block, feedset, keep, batch, facts.spec, mesh, device=False)
+    plan.temp_device_bytes = _block_temp_peak(
+        block, feedset, keep, batch, facts.spec, mesh, device=True)
+    return plan
+
+
+class _NoVar:
+    persistable = False

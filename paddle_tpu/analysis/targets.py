@@ -215,6 +215,20 @@ def _label_semantic_roles():
     return {"main": main, "startup": startup}, []
 
 
+def _sharded_decoder():
+    """The tp-sharded cached_decoder_step fixture
+    (models/sharded_decoder.py): the Megatron-annotated step program
+    the sharding prover (PTA160/161) and the per-device memory
+    planner (PTA170) must keep strict-green, so PR 13's sharded
+    serving lowerings inherit a working prover instead of
+    bootstrapping one. The baseline's ``sharding_facts`` section
+    snapshots this target's propagated specs."""
+    from ..models import sharded_decoder
+
+    fx = sharded_decoder.build_tp_sharded_decoder_step()
+    return {"step": fx.program, "startup": fx.startup}, []
+
+
 def _serving_runtime():
     """The multi-tenant runtime's model zoo (inference/runtime/zoo.py
     — the exact programs bench.py's `multitenant` config serves).
@@ -250,7 +264,19 @@ MODEL_BUILDERS: Dict[str, Callable] = {
     "recommender": _recommender,
     "label_semantic_roles": _label_semantic_roles,
     "serving_runtime": _serving_runtime,
+    "sharded_decoder": _sharded_decoder,
 }
+
+
+def match_targets(only: Optional[List[str]]) -> List[str]:
+    """Model names selected by the --only SUBSTRING filters (a lint
+    iteration loop types `--only transformer`, not the full target
+    name): every model whose ``models/<name>`` contains any filter.
+    Empty/None selects everything."""
+    if not only:
+        return list(MODEL_BUILDERS)
+    return [name for name in MODEL_BUILDERS
+            if any(s in f"models/{name}" for s in only)]
 
 
 def _benchmark_targets() -> Iterator[LintTarget]:
@@ -268,8 +294,9 @@ def _benchmark_targets() -> Iterator[LintTarget]:
 
 def iter_lint_targets(include_benchmark: bool = True,
                       only: List[str] = None) -> Iterator[LintTarget]:
+    selected = set(match_targets(only))
     for name, build in MODEL_BUILDERS.items():
-        if only and name not in only:
+        if only and name not in selected:
             continue
         built = build()
         programs, pairs = built[0], built[1]

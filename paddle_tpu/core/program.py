@@ -427,6 +427,13 @@ class Program:
         p.current_block_idx = 0
         p._version = self._version
         p._seed = self._seed
+        # analysis-layer program attrs ride the clone like the var-
+        # level sharding annotations (copy.copy above) already do:
+        # an eval/serving clone keeps its mesh (per-device memory
+        # plans, PTA160/161 axis naming) and its OOM-gate budget
+        for attr in ("_mesh_config", "_device_memory_budget"):
+            if hasattr(self, attr):
+                setattr(p, attr, getattr(self, attr))
         return p
 
     def _prune(self, targets: Sequence[str]) -> "Program":

@@ -58,6 +58,53 @@ class OpInfo:
 
 _REGISTRY: Dict[str, OpInfo] = {}
 
+# op type -> sharding propagation rule for the analysis layer's
+# sharding domain (analysis/absint.py). A rule is a PURE function
+#     rule(op, spec_of, shape_of, mesh) -> (out_specs, events)
+# over Program metadata: `spec_of(name)`/`shape_of(name)` resolve an
+# input var's abstract ShardSpec / static shape, `out_specs` maps
+# output var names to their propagated ShardSpec, and `events` lists
+# the CollectiveEvents (psum/allgather/reshard/conflict) the op's
+# GSPMD lowering implies under those specs. Rules live alongside the
+# kernels they describe (analysis/sharding_rules.py registers the
+# core families) so a new op that touches sharded state registers its
+# propagation fact the same way it registers its kernel — an op
+# WITHOUT a rule degrades its outputs to the explicit ⊤ spec
+# (warn-once) the moment a sharded value reaches it, so imprecision
+# is visible, never silently wrong.
+_SHARDING_RULES: Dict[str, Callable] = {}
+
+
+def register_sharding_rule(op_types, fn: Optional[Callable] = None):
+    """Register a sharding-propagation rule for one op type or a
+    family of op types (mirrors register_op; usable as a decorator).
+
+    Reference counterpart: none — the reference shards at runtime via
+    transpilers (reference transpiler/distribute_transpiler.py), so a
+    compile-time per-op sharding algebra had nothing to attach to.
+    """
+    if isinstance(op_types, str):
+        op_types = (op_types,)
+
+    def deco(f):
+        for t in op_types:
+            _SHARDING_RULES[t] = f
+        return f
+
+    return deco(fn) if fn is not None else deco
+
+
+def get_sharding_rule(op_type: str) -> Optional[Callable]:
+    return _SHARDING_RULES.get(op_type)
+
+
+def has_sharding_rule(op_type: str) -> bool:
+    return op_type in _SHARDING_RULES
+
+
+def sharding_rule_types() -> List[str]:
+    return sorted(_SHARDING_RULES)
+
 
 def kernel_bridges_host(fn: Callable) -> bool:
     """True when `fn`'s code references jax's io_callback/pure_callback
@@ -172,10 +219,14 @@ class OpContext:
 
 def register_op(type: str, *, infer_shape=None, grad_maker=None,
                 differentiable=True, inplace=None, stop_gradient_slots=(),
-                needs_rng=False, host_effect=False):
-    """Decorator: register `fn(ctx) -> {out_slot: value|[values]}`."""
+                needs_rng=False, host_effect=False, sharding_rule=None):
+    """Decorator: register `fn(ctx) -> {out_slot: value|[values]}`.
+    `sharding_rule` optionally registers the op's sharding-propagation
+    rule in the same breath (see register_sharding_rule)."""
 
     def deco(fn):
+        if sharding_rule is not None:
+            register_sharding_rule(type, sharding_rule)
         if not host_effect and kernel_bridges_host(fn):
             # the r6 'REMEMBER the flag' learning, mechanized: a
             # host-bridging kernel registered without the flag would be

@@ -1,0 +1,132 @@
+"""tp-sharded decoder-step fixture: the sharded-serving entry proof.
+
+ONE tensor-parallel ``cached_decoder_step`` program — the exact step
+body the slot-pool serving stack dispatches (models/decode_engine.py)
+— annotated with the Megatron-LM layout (Shoeybi et al.: column-
+parallel qkv/fc1, row-parallel out/fc2, vocab-parallel logits head,
+self/cross KV sharded along heads) on a named dp x tp mesh. The
+annotations are EXACTLY the surface PR 13's sharded serving lowerings
+will emit (absint.mark_sharded placements + absint.set_mesh); nothing
+in the engine changes — this module only marks the already-built step
+program, so the sharded lowerings inherit a prover and a memory
+planner that are already green on the real program shape:
+
+* the sharding domain propagates the head-sharded layout through the
+  cached attention (scores/context ride ``{1: tp}``, the row-parallel
+  out-projections imply the psum over ``tp`` exactly where Megatron
+  places it), and the strict lint zoo pins the whole fixture
+  error-free (analysis/targets.py ``sharded_decoder`` target);
+* the PTA170 planner prices the per-device KV state at ~1/tp of the
+  unsharded bundle — the ROADMAP's "per-device KV bytes shrinking
+  ~1/tp via memory_analysis()" claim as a machine-checked number
+  (tests/test_memory_plan.py);
+* the baseline's ``sharding_facts`` section snapshots the propagated
+  specs, so any drift in the propagation rules shows up as a CI diff
+  instead of a silently different layout.
+
+Reference counterpart: none — the reference sharded at runtime via
+transpilers (reference transpiler/distribute_transpiler.py); a
+statically-annotated, statically-proven tensor-parallel decode step
+is the GSPMD-era capability this repo builds toward.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from .. import unique_name
+from ..analysis import absint
+
+__all__ = ["ShardedDecoderFixture", "build_tp_sharded_decoder_step",
+           "TP_AXIS", "DP_AXIS"]
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+
+
+@dataclass
+class ShardedDecoderFixture:
+    """The annotated step program plus everything tests need to
+    assert the sharding story: the un-annotated bundle it came from,
+    the mesh, and the annotated name -> placement map."""
+    program: object                 # the tp-annotated step program
+    startup: object
+    bundle: object                  # the DecodeStepBundle (dense)
+    mesh: absint.MeshConfig
+    placements: Dict[str, dict] = field(default_factory=dict)
+    kv_names: List[str] = field(default_factory=list)
+
+    def kv_state_bytes(self) -> int:
+        """Unsharded KV bytes of the bundle's self+cross cache state
+        (the denominator of the ~1/tp per-device claim)."""
+        return self.bundle.kv_state_bytes()
+
+
+def _annotate(block, placements, name, dims):
+    var = block.vars.get(name)
+    if var is None:
+        var = block._find_var_recursive(name)
+    if var is None:
+        raise KeyError(f"sharded_decoder fixture: no var {name!r} in "
+                       f"the step program")
+    absint.mark_sharded(var, dims)
+    placements[name] = dict(dims)
+    return var
+
+
+def build_tp_sharded_decoder_step(tp: int = 2, dp: int = 4,
+                                  seq_len: int = 8,
+                                  max_out_len: int = 8,
+                                  d_model: int = 32, n_heads: int = 4,
+                                  n_layers: int = 2,
+                                  d_inner: int = 64, vocab: int = 64,
+                                  n_slots: int = 4,
+                                  state_prefix: str = "@tpfx/"
+                                  ) -> ShardedDecoderFixture:
+    """Build the dense decode-step bundle and annotate its step
+    program with the Megatron tensor-parallel layout (annotations
+    only — the builder is the stock
+    transformer.build_decode_step_program)."""
+    from . import transformer as T
+
+    if n_heads % tp:
+        raise ValueError(f"n_heads={n_heads} must divide over tp={tp}")
+    with unique_name.guard():
+        bundle = T.build_decode_step_program(
+            seq_len=seq_len, max_out_len=max_out_len, d_model=d_model,
+            n_heads=n_heads, n_layers=n_layers, d_inner=d_inner,
+            vocab=vocab, n_slots=n_slots, state_prefix=state_prefix)
+    step = bundle.step
+    mesh = absint.MeshConfig.make(**{DP_AXIS: dp, TP_AXIS: tp})
+    absint.set_mesh(step, mesh)
+    blk = step.global_block
+    placements: Dict[str, dict] = {}
+    kv_names: List[str] = []
+    # --- KV cache state: sharded along heads (dim 1 of the dense
+    # [rows, H, T, Dh] per-lane buffers) — the paged analogue is the
+    # ROADMAP's [n_blocks, block_size, H/tp, Dh] pool ---
+    for name in bundle._state_specs:
+        short = name.split("/")[-1]
+        if short.startswith(("self_k", "self_v", "cross_k",
+                             "cross_v")):
+            _annotate(blk, placements, name, {1: TP_AXIS})
+            kv_names.append(name)
+    # --- decoder params: Megatron column/row-parallel pairs ---
+    for li in range(n_layers):
+        _annotate(blk, placements, f"dec{li}_self_qkv.w",
+                  {1: TP_AXIS})      # column-parallel fused qkv
+        _annotate(blk, placements, f"dec{li}_self_out.w",
+                  {0: TP_AXIS})      # row-parallel out projection
+        _annotate(blk, placements, f"dec{li}_cross_q.w",
+                  {1: TP_AXIS})
+        _annotate(blk, placements, f"dec{li}_cross_out.w",
+                  {0: TP_AXIS})
+        _annotate(blk, placements, f"dec{li}_fc1.w", {1: TP_AXIS})
+        _annotate(blk, placements, f"dec{li}_fc2.w", {0: TP_AXIS})
+    # --- vocab-parallel logits head (the Megatron output layer whose
+    # branch-internal psum IS the 1F1B x tp rejection when it lands
+    # under a divergent guard — here it sits in straight-line code,
+    # which is exactly what the PTA161 proof requires) ---
+    _annotate(blk, placements, "logits.w", {1: TP_AXIS})
+    return ShardedDecoderFixture(step, bundle.startup, bundle, mesh,
+                                 placements, kv_names)

@@ -154,9 +154,11 @@ class TestPTA130:
         assert "pp_stage_id" in ds[0].message
 
     def test_unmarked_cond_still_errors_like_pta010(self):
-        # agreement with the pattern matcher: a collective under ANY
-        # traced guard is an error even when the predicate is
-        # value-uniform (the replication facts assume unsharded feeds)
+        # agreement with the pattern matcher's stance: a collective
+        # under ANY traced guard is an error even when the predicate
+        # is value-uniform. Since the twin dedupe, the prover OWNS
+        # the covered site — PTA010 defers (fires only when the
+        # fixpoint engine is unavailable)
         main, startup, g = _guarded()
         with g:
             from paddle_tpu.layers.collective import _allreduce
@@ -171,7 +173,7 @@ class TestPTA130:
         p010 = _diags(main, "PTA010")
         assert p130 and p130[0].severity == ERROR
         assert "value-uniform" in p130[0].message
-        assert len(p130) >= len(p010) > 0
+        assert p010 == []  # the dedupe: one incident, one diagnostic
 
     def test_scope_collective_upgraded_under_divergent_guard(self):
         # PTA011 warns on attention-in-while; under a PROVEN-divergent
@@ -191,10 +193,8 @@ class TestPTA130:
         ds = _diags(main, "PTA130")
         assert ds and ds[0].severity == ERROR
         assert "PROVEN divergent" in ds[0].message
-        # the pattern matcher stays at warning — the upgrade is the
-        # prover's value-add
-        p011 = _diags(main, "PTA011")
-        assert p011 and p011[0].severity == WARNING
+        # the twin dedupe: the covered site is the prover's alone
+        assert _diags(main, "PTA011") == []
 
     def test_top_level_collective_is_clean(self):
         main, startup, g = _guarded()
@@ -474,25 +474,19 @@ class TestSuppression:
 
     def test_suppression_drops_and_collects(self):
         main = self._collective_prog()
-        cond_op = next(op for op in main.global_block.ops
-                       if op.type == "conditional_block")
-        cond_op.attrs["_pta_suppress"] = (
-            "PTA010", "single-host test program, never meshed")
+        inner = [op for blk in main.blocks for op in blk.ops
+                 if op.type == "allreduce"]
+        assert inner
+        inner[0].attrs["_pta_suppress"] = (
+            "PTA130", "single-host test program, never meshed")
         collected = []
         ds = run_checks(main, collect_suppressed=collected)
-        assert "PTA010" not in {d.code for d in ds}
-        assert collected and collected[0][0].code == "PTA010"
+        assert "PTA130" not in {d.code for d in ds}
+        assert collected and collected[0][0].code == "PTA130"
         assert "never meshed" in collected[0][1]
-        # PTA130 anchors at the INNER collective op, so it still
-        # fires: one suppression never blankets the whole class
-        assert "PTA130" in {d.code for d in ds}
 
     def test_executor_strict_gate_honors_suppression(self):
         main = self._collective_prog()
-        for op in main.global_block.ops:
-            if op.type == "conditional_block":
-                op.attrs["_pta_suppress"] = [
-                    ("PTA010", "crafted: documents the trap")]
         inner = [op for blk in main.blocks for op in blk.ops
                  if op.type == "allreduce"]
         assert inner
@@ -503,19 +497,19 @@ class TestSuppression:
 
     def test_malformed_suppression_warns_and_ignores(self):
         main = self._collective_prog()
-        cond_op = next(op for op in main.global_block.ops
-                       if op.type == "conditional_block")
-        cond_op.attrs["_pta_suppress"] = "PTA010"  # not a pair
+        inner = [op for blk in main.blocks for op in blk.ops
+                 if op.type == "allreduce"]
+        inner[0].attrs["_pta_suppress"] = "PTA130"  # not a pair
         ds = run_checks(main)
         assert "PTA199" in {d.code for d in ds}
-        assert "PTA010" in {d.code for d in ds}  # NOT suppressed
+        assert "PTA130" in {d.code for d in ds}  # NOT suppressed
 
     def test_suppression_only_matches_its_anchor(self):
         main = self._collective_prog()
         # suppress at an unrelated op: the finding must survive
         main.global_block.ops[0].attrs["_pta_suppress"] = (
-            "PTA010", "wrong anchor")
-        assert "PTA010" in {d.code for d in run_checks(main)}
+            "PTA130", "wrong anchor")
+        assert "PTA130" in {d.code for d in run_checks(main)}
 
 
 # ---------------------------------------------------------------------------

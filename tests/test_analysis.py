@@ -161,12 +161,20 @@ def _collective_in_cond_program():
 
 
 class TestCollectiveInBranch:
-    def test_positive_cond_allreduce(self):
+    """Since the PTA010<->PTA130 twin dedupe, the legacy pattern
+    matcher DEFERS to the prover at every site the fixpoint engine
+    covers (which is every reachable site of a convergent program):
+    the incident surfaces exactly once, as the proof-carrying PTA130
+    error. PTA010 remains the fallback for programs the prover cannot
+    analyze (non-convergence) — the gate test pins the superset
+    relation over the whole zoo."""
+
+    def test_positive_cond_allreduce_dedupes_to_prover(self):
         main = _collective_in_cond_program()
-        ds = _diags(main, "PTA010")
+        assert not _diags(main, "PTA010")  # deferred to the prover
+        ds = _diags(main, "PTA130")
         assert ds and ds[0].severity == ERROR
-        assert "allreduce" in ds[0].message
-        assert ds[0].op_type == "conditional_block"
+        assert ds[0].op_type == "allreduce"
 
     def test_positive_axis_name_in_while(self):
         main, startup, g = _guarded()
@@ -179,7 +187,25 @@ class TestCollectiveInBranch:
                 "while", {"Condition": ["c"], "X": [], "Init": []},
                 {"Out": []},
                 {"sub_block": sub, "carried": [], "externals": []})
-        assert _codes(_diags(main, "PTA010")) == {"PTA010"}
+        assert not _diags(main, "PTA010")
+        ds = _diags(main, "PTA130")
+        assert ds and ds[0].severity == ERROR
+
+    def test_legacy_matcher_fires_when_prover_unavailable(self,
+                                                          monkeypatch):
+        # the non-convergence fallback: when absint cannot analyze
+        # the program, the pattern matcher still catches the deadlock
+        from paddle_tpu.analysis import absint as ai
+
+        def boom(program):
+            raise RuntimeError("crafted prover outage")
+
+        monkeypatch.setattr(ai, "analyze", boom)
+        main = _collective_in_cond_program()
+        ds = [d for d in analysis.run_checks(main, only=["PTA010"])
+              if d.code == "PTA010"]
+        assert ds and ds[0].severity == ERROR
+        assert "allreduce" in ds[0].message
 
     def test_negative_top_level_allreduce(self):
         main, startup, g = _guarded()
@@ -195,7 +221,9 @@ class TestCollectiveInBranch:
 # PTA011 scope-dependent collectives in branches (r6 generalized trap)
 # ---------------------------------------------------------------------------
 class TestScopeCollectiveInBranch:
-    def test_positive_attention_in_while(self):
+    def test_positive_attention_in_while_dedupes_to_prover(self):
+        # the twin dedupe: the prover covers the site, so the legacy
+        # matcher stays silent and PTA130 carries the (one) warning
         main, startup, g = _guarded()
         with g:
             sub = main.create_block()
@@ -205,8 +233,10 @@ class TestScopeCollectiveInBranch:
                 "while", {"Condition": ["c"], "X": [], "Init": []},
                 {"Out": []},
                 {"sub_block": sub, "carried": [], "externals": []})
-        ds = _diags(main, "PTA011")
+        assert not _diags(main, "PTA011")
+        ds = _diags(main, "PTA130")
         assert ds and ds[0].severity == WARNING
+        assert "attention" in ds[0].message
 
     def test_negative_attention_top_level(self):
         main, startup, g = _guarded()
@@ -722,7 +752,7 @@ class TestExecutorGate:
         exe = fluid.Executor(fluid.CPUPlace())
         fluid.set_flags({"FLAGS_static_check": "strict"})
         try:
-            with pytest.raises(EnforceNotMet, match="PTA010"):
+            with pytest.raises(EnforceNotMet, match="PTA130"):
                 exe.run(main,
                         feed={"x": np.zeros((1, 4), np.float32)},
                         fetch_list=[])
@@ -796,8 +826,15 @@ class TestSuitePlumbing:
 
     def test_only_filter(self):
         main = _collective_in_cond_program()
-        ds = run_checks(main, only=["PTA010"])
-        assert ds and _codes(ds) == {"PTA010"}
+        ds = run_checks(main, only=["PTA130"])
+        assert ds and _codes(ds) == {"PTA130"}
+
+    def test_checker_timings_collected(self):
+        main = _collective_in_cond_program()
+        timings = {}
+        run_checks(main, collect_timings=timings)
+        assert "PTA130" in timings and "PTA001" in timings
+        assert all(v >= 0.0 for v in timings.values())
 
     def test_dataflow_facts(self):
         main, startup, g = _guarded()

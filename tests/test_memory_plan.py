@@ -1,0 +1,195 @@
+"""PTA170 memory-planner validation: the static plan vs the XLA
+compiler's own accounting, on the CPU backend (the r5-proven
+schedule-level comparison surface — CLAUDE.md "memory_analysis works
+on the CPU backend").
+
+Three surfaces:
+
+* **argument bytes EXACT** — `MemoryPlan.argument_bytes`
+  (state + feeds + the threaded PRNG key) must equal
+  ``compiled.memory_analysis().argument_size_in_bytes`` bit-for-bit
+  on ≥ 5 zoo programs: the planner walks the same state_in contract
+  as core/executor.py `_analyze_block_py`, so any drift between the
+  two is a planner bug, not an estimate missing.
+* **temp bytes within 25%** — the peak-liveness estimate with the
+  elementwise aliasing model vs ``temp_size_in_bytes`` on the same
+  programs (measured ratios at the time of writing: mnist-mlp 0.98,
+  the three zoo-fc programs ~1.04, word2vec 1.22).
+* **the ~1/tp KV shrink** — on the tp-sharded decoder fixture the
+  per-device KV-pool bytes must be exactly total/tp (heads divide
+  evenly), the ROADMAP's sharded-serving capacity claim as a number.
+
+Plus the PTA170 checker itself: an opt-in budget turns an over-budget
+plan into an ERROR diagnostic; in-budget and budget-less programs
+stay silent.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, unique_name
+from paddle_tpu.analysis import ERROR, absint, memplan, run_checks
+from paddle_tpu.core import executor as E
+
+BATCH = 4
+
+
+def _auto_feeds(program, batch=BATCH):
+    """(shape, dtype) per declared data var, -1 dims -> `batch`."""
+    feeds = {}
+    for v in program.global_block.vars.values():
+        if v.is_data:
+            shape = tuple(batch if (d is None or d < 0) else d
+                          for d in v.shape)
+            feeds[v.name] = (shape, v.dtype.value)
+    return feeds
+
+
+def _xla_memory(program, fetch_names, batch=BATCH):
+    """Compile the program the way Executor.run does (same
+    state_in/feed/rng signature the planner prices) and return the
+    compiled executable's memory_analysis."""
+    import jax
+
+    block = program.global_block
+    feed_shapes = _auto_feeds(program, batch)
+    feed_names = list(feed_shapes)
+    mutated, const, state_out = E._analyze_block_py(
+        block, feed_names, fetch_names)
+    step = E._build_step_fn(block, feed_names, mutated, const,
+                            state_out, fetch_names)
+
+    def arr_of(name):
+        v = block._find_var_recursive(name)
+        shape = tuple(batch if (d is None or d < 0) else d
+                      for d in (v.shape or ()))
+        return np.zeros(shape, v.dtype.value if v.dtype else "float32")
+
+    mut = {n: arr_of(n) for n in mutated}
+    cst = {n: arr_of(n) for n in const}
+    feeds = {n: np.zeros(s, dt) for n, (s, dt) in feed_shapes.items()}
+    rng = jax.random.PRNGKey(0)
+    return jax.jit(step).lower(mut, cst, feeds, rng) \
+        .compile().memory_analysis()
+
+
+def _plan_of(program, fetch_names, batch=BATCH):
+    facts = absint.analyze(program)
+    return memplan.build_plan(facts, batch=batch,
+                              fetch_names=tuple(fetch_names))
+
+
+def _zoo_programs():
+    """label -> (program, fetch_names): the ≥5-program validation
+    set. Builders run under unique_name.guard so param names do not
+    collide across pytest collection order."""
+    out = {}
+    with unique_name.guard():
+        from paddle_tpu.models import mnist
+
+        main, _startup, loss, _acc = mnist.build_program(
+            use_conv=False)
+        out["mnist-mlp"] = (main, [loss.name])
+    from paddle_tpu.inference.runtime import zoo
+
+    for prefix, in_dim, hidden, classes in zoo.DEFAULT_ZOO:
+        m, _s, _f, fetches = zoo.build_fc_program(
+            prefix, in_dim, hidden, classes)
+        name = fetches[0] if isinstance(fetches[0], str) \
+            else fetches[0].name
+        out[f"zoo-{prefix}"] = (m, [name])
+    with unique_name.guard():
+        from paddle_tpu.models import word2vec
+
+        wm, _ws, *rest = word2vec.build_program(
+            dict_size=500, embed_size=16, hidden_size=32)
+        out["word2vec"] = (wm, [rest[0].name])
+    return out
+
+
+@pytest.fixture(scope="module")
+def zoo_results():
+    """Plan + XLA accounting per validation program (one compile
+    each, shared by the exact/ratio tests)."""
+    results = {}
+    for label, (prog, fetch) in _zoo_programs().items():
+        results[label] = (_plan_of(prog, fetch),
+                          _xla_memory(prog, fetch))
+    return results
+
+
+class TestPlannerVsXLA:
+    def test_covers_at_least_five_programs(self, zoo_results):
+        assert len(zoo_results) >= 5
+
+    def test_argument_bytes_exact(self, zoo_results):
+        for label, (plan, m) in zoo_results.items():
+            assert plan.argument_bytes == m.argument_size_in_bytes, (
+                label, plan.summary())
+
+    def test_temp_bytes_within_25pct(self, zoo_results):
+        for label, (plan, m) in zoo_results.items():
+            xla = m.temp_size_in_bytes
+            assert xla > 0, label
+            ratio = plan.temp_bytes / xla
+            assert 0.75 <= ratio <= 1.25, (label, plan.temp_bytes,
+                                           xla, ratio)
+
+
+class TestShardedKVShrink:
+    def test_kv_pool_prices_at_one_over_tp(self):
+        from paddle_tpu.models import sharded_decoder
+
+        tp = 2
+        fx = sharded_decoder.build_tp_sharded_decoder_step(tp=tp)
+        facts = absint.analyze(fx.program)
+        plan = facts.device_memory_plan(batch=1)
+        assert fx.kv_names
+        full = dev = 0
+        for name in fx.kv_names:
+            entry = plan.entry(name)
+            assert entry is not None and entry.klass == "state", name
+            full += entry.bytes
+            dev += entry.device_bytes
+        # heads divide evenly over tp, so the shrink is EXACTLY 1/tp
+        assert dev * tp == full
+        # and the planner's full-size accounting agrees with the
+        # bundle's own KV bookkeeping (dense layout: the self_/cross_
+        # state IS the kv_names set)
+        assert full == fx.kv_state_bytes()
+
+    def test_unsharded_state_unchanged_per_device(self):
+        from paddle_tpu.models import sharded_decoder
+
+        fx = sharded_decoder.build_tp_sharded_decoder_step()
+        plan = absint.analyze(fx.program).device_memory_plan(batch=1)
+        tok = plan.entry(fx.bundle.state["tok_buf"])
+        assert tok is not None
+        assert tok.device_bytes == tok.bytes
+
+
+class TestPTA170Budget:
+    def _program(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[8, 64], dtype="float32",
+                            append_batch_size=False)
+            layers.fc(x, size=64)
+        return main
+
+    def test_over_budget_is_error(self):
+        main = self._program()
+        absint.set_device_memory_budget(main, 100)
+        ds = [d for d in run_checks(main) if d.code == "PTA170"]
+        assert ds and ds[0].severity == ERROR
+        assert "exceeds the declared budget" in ds[0].message
+
+    def test_within_budget_is_silent(self):
+        main = self._program()
+        absint.set_device_memory_budget(main, 10 * 1024 * 1024)
+        assert not [d for d in run_checks(main)
+                    if d.code == "PTA170"]
+
+    def test_no_budget_is_silent(self):
+        main = self._program()
+        assert not [d for d in run_checks(main) if d.code == "PTA170"]
