@@ -1821,6 +1821,14 @@ def main():
         # the backend
         _coldstart_child(sys.argv[2], sys.argv[3], int(sys.argv[4]))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "trend":
+        # perf-trend sentinel over the committed BENCH_SELF history
+        # (benchmark/trend.py): pure file processing — no backend
+        # probe, no TPU claim. Exit 2 on a regressed/stale store;
+        # --write-trend refreshes intentionally.
+        from benchmark import trend
+
+        sys.exit(trend.main(sys.argv[2:]))
     device = _probe_backend()
     import jax
 

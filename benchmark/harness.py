@@ -116,6 +116,21 @@ def write_bench_self(filename: str, result: dict,
                 f"this is an intentional record evolution")
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
+    # perf-trend sentinel hookup (benchmark/trend.py): a freshly
+    # measured record that regresses the committed trajectory must
+    # never land silently — the warning prints at write time, the
+    # committed bench_trend.json still gates in CI (`bench.py trend`)
+    # until refreshed intentionally with --write-trend. Best-effort:
+    # trend problems must not fail a bench run that just measured.
+    try:
+        from .trend import (_cross_round_warnings, build_records,
+                            extract_record)
+
+        _ = extract_record(out_path)  # record must stay extractable
+        for w in _cross_round_warnings(build_records()):
+            print(f"# trend WARNING: {w}")
+    except Exception as e:
+        print(f"# trend: sentinel skipped ({type(e).__name__}: {e})")
     return result
 
 

@@ -1,0 +1,369 @@
+"""Perf-trend sentinel over the committed BENCH_SELF_r*.json history.
+
+The repo's measured record is a trajectory: every round commits a
+BENCH_SELF_r*.json whose headline (``metric``/``unit``/``value``),
+ratio fields (``speedup_*``/``ratio_*``) and parity flags
+(``*parity*``, ``steady_state_compiles``) are the claims later rounds
+build on. Nothing guarded them: a refactor could silently thin a
+record, and a regressed headline in a fresh record looked exactly
+like an intentional one. This module is the drift gate, in the style
+of ``analysis_baseline.json`` (analysis/baseline.py):
+
+* ``build_records()`` extracts a normalized trajectory record per
+  BENCH_SELF file — tolerant of every historical schema (r02's
+  ``results`` list, r10's nested ``generation`` dict, the r11+ flat
+  headline) — including a per-file **noise band** derived from the
+  recorded interleaved legs (``rps_legs`` / ``triple_tok_s``): on
+  this 2-core CPU-share-throttled host identical legs swing ~3x
+  (PERF.md), so the band is wide by design and the sentinel catches
+  silent COLLAPSES, not percent-level drift.
+* ``diff_against_store()`` compares the files on disk against the
+  committed ``bench_trend.json``: a headline that dropped below the
+  committed value by more than the noise band, a parity flag that
+  went false, or steady-state compiles that became nonzero is a
+  **REGRESSION** (loud, named); any other mismatch — new record,
+  changed value, drifted schema — is **STALE** (the store must be
+  refreshed intentionally). Either fails the gate.
+* ``write_store()`` refreshes intentionally (``bench.py trend
+  --write-trend``), printing a cross-round warning when a new record
+  regresses the previous committed record of the same metric — the
+  measurement stands (it IS the record), but it can never land
+  silently.
+
+``bench.py trend`` is the CLI; tests/test_benchmark_harness.py runs
+the same gate in-process over the committed set (tier-adjacent: the
+fast lane asserts the committed store is current).
+
+Reference counterpart: none — reference benchmark/fluid/
+fluid_benchmark.py prints per-pass speeds; a committed, gated
+perf trajectory has no reference analogue.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from . import harness
+
+__all__ = ["STORE_SCHEMA_VERSION", "TREND_STORE", "build_records",
+           "extract_record", "load_store", "write_store",
+           "diff_against_store", "default_store_path", "main"]
+
+STORE_SCHEMA_VERSION = 1
+TREND_STORE = "bench_trend.json"
+
+_FILE_RE = re.compile(r"BENCH_SELF_r(\d+)\.json$")
+
+# when a file records no leg spread (single-pass rounds), assume the
+# host's documented worst-case: regressions must clear a 2x drop to
+# fire (PERF.md: single-pass walls swing ~3x; the sentinel exists for
+# collapses, not percent drift)
+_DEFAULT_NOISE_BAND = 0.5
+_MIN_NOISE_BAND, _MAX_NOISE_BAND = 0.2, 0.6
+
+
+def default_store_path() -> str:
+    """Committed store location (repo root, beside the BENCH files).
+    Reference counterpart: none — the reference commits no benchmark
+    trajectory."""
+    # late-bound through the module: tests monkeypatch
+    # harness.BENCH_DIR, and a value import frozen at whatever dir was
+    # active when trend was FIRST imported would point there forever
+    return os.path.join(harness.BENCH_DIR, TREND_STORE)
+
+
+def _headline_dicts(data: dict) -> List[dict]:
+    """Every {metric, value[, unit]} headline a record carries, in a
+    stable order: the top-level headline (r11+), nested config dicts
+    (r10 'generation'), and 'results'/'runs' list entries (r02-r09)."""
+    out = []
+
+    def take(d):
+        if isinstance(d, dict) and "metric" in d and "value" in d \
+                and isinstance(d.get("value"), (int, float)):
+            out.append({"metric": str(d["metric"]),
+                        "unit": str(d.get("unit", "")),
+                        "value": float(d["value"])})
+
+    take(data)
+    for key in sorted(data):
+        v = data[key]
+        if isinstance(v, dict):
+            take(v)
+        elif isinstance(v, list) and key in ("results", "runs"):
+            for entry in v:
+                take(entry)
+    # one headline per metric name: first (most-authoritative) wins
+    seen, uniq = set(), []
+    for h in out:
+        if h["metric"] not in seen:
+            seen.add(h["metric"])
+            uniq.append(h)
+    return uniq
+
+
+def _walk_flags(data, path="", depth=0, out=None) -> Dict[str, object]:
+    """Parity flags + steady-state-compile counts, recursively (dotted
+    paths), bounded depth — the booleans later rounds must not lose."""
+    if out is None:
+        out = {}
+    if depth > 3 or not isinstance(data, dict):
+        return out
+    for k in sorted(data):
+        v = data[k]
+        p = f"{path}{k}"
+        if isinstance(v, bool) and ("parity" in k or k == "loss_decreased"):
+            out[p] = v
+        elif k == "steady_state_compiles" and isinstance(v, (int, float)):
+            out[p] = int(v)
+        elif isinstance(v, dict):
+            _walk_flags(v, p + ".", depth + 1, out)
+    return out
+
+
+def _noise_band(data: dict, headline_value: Optional[float]) -> float:
+    """1 - min/max over the recorded interleaved legs of the headline
+    mode, clamped: the spread the committed legs actually showed is
+    the spread a regression must exceed to be a claim and not
+    weather."""
+    spreads = []
+
+    def spread(vals):
+        vals = [v for v in vals if isinstance(v, (int, float)) and v > 0]
+        if len(vals) >= 2:
+            spreads.append(1.0 - min(vals) / max(vals))
+
+    for key, v in data.items():
+        if not isinstance(v, list) or not v:
+            continue
+        if all(isinstance(x, (int, float)) for x in v) \
+                and ("legs" in key or key.endswith("_s")):
+            spread(v)
+        elif all(isinstance(x, list) for x in v):
+            # interleaved triples: [round][leg]; the headline column
+            # is the one containing the headline value, else the
+            # widest column
+            cols = list(zip(*[r for r in v if r]))
+            pick = None
+            if headline_value is not None:
+                for c in cols:
+                    if any(abs(float(x) - headline_value) < 1e-6
+                           for x in c):
+                        pick = c
+                        break
+            for c in cols if pick is None else [pick]:
+                spread(c)
+    band = max(spreads) if spreads else _DEFAULT_NOISE_BAND
+    return round(min(_MAX_NOISE_BAND, max(_MIN_NOISE_BAND, band)), 4)
+
+
+def extract_record(path: str) -> dict:
+    """One normalized trajectory record for a BENCH_SELF file.
+    Reference counterpart: benchmark/fluid/fluid_benchmark.py prints
+    per-pass speeds only; normalized committed records are this
+    repo's addition."""
+    fname = os.path.basename(path)
+    m = _FILE_RE.search(fname)
+    with open(path) as f:
+        data = json.load(f)
+    headlines = _headline_dicts(data)
+    ratios = {k: float(v) for k, v in data.items()
+              if isinstance(v, (int, float)) and not isinstance(v, bool)
+              and (k.startswith("speedup") or k.startswith("ratio_"))}
+    head_val = headlines[0]["value"] if headlines else None
+    return {
+        "file": fname,
+        "round": int(m.group(1)) if m else None,
+        "schema_keys": sorted(str(k) for k in data),
+        "headlines": headlines,
+        "ratios": ratios,
+        "parity": _walk_flags(data),
+        "noise_band": _noise_band(data, head_val),
+    }
+
+
+def build_records(bench_dir: Optional[str] = None) -> List[dict]:
+    """Trajectory records for every BENCH_SELF_r*.json on disk,
+    sorted by round. Reference counterpart: none (see
+    extract_record)."""
+    bench_dir = bench_dir or harness.BENCH_DIR
+    files = sorted(
+        (f for f in os.listdir(bench_dir) if _FILE_RE.search(f)),
+        key=lambda f: int(_FILE_RE.search(f).group(1)))
+    return [extract_record(os.path.join(bench_dir, f)) for f in files]
+
+
+def load_store(path: Optional[str] = None) -> Optional[dict]:
+    """The committed store, schema-guarded (the write_bench_self
+    discipline). Reference counterpart: none."""
+    path = path or default_store_path()
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        store = json.load(f)
+    if store.get("schema_version") != STORE_SCHEMA_VERSION:
+        raise ValueError(
+            f"{TREND_STORE} schema_version "
+            f"{store.get('schema_version')!r} != "
+            f"{STORE_SCHEMA_VERSION} supported by this checkout — "
+            f"refresh with `python bench.py trend --write-trend` and "
+            f"review the diff (the write_bench_self schema-guard "
+            f"discipline)")
+    return store
+
+
+def _cross_round_warnings(records: List[dict]) -> List[str]:
+    """New-record-vs-previous-committed-record regressions of the
+    SAME metric name (printed at write time: the measurement stands,
+    but it can never land silently)."""
+    warnings = []
+    last: Dict[str, Tuple[int, float]] = {}
+    for rec in records:
+        for h in rec["headlines"]:
+            prev = last.get(h["metric"])
+            band = rec.get("noise_band", _DEFAULT_NOISE_BAND)
+            if prev is not None and h["value"] < prev[1] * (1 - band):
+                warnings.append(
+                    f"cross-round regression: {h['metric']} "
+                    f"{prev[1]:g} (r{prev[0]}) -> {h['value']:g} "
+                    f"(r{rec['round']}), beyond the {band:.0%} noise "
+                    f"band")
+            last[h["metric"]] = (rec["round"], h["value"])
+    return warnings
+
+
+def diff_against_store(records: List[dict],
+                       store: Optional[dict]) -> Tuple[List[str],
+                                                       List[str]]:
+    """(regressions, stale) between the files on disk and the
+    committed store. Regressions are the loud class — a value
+    collapse or a lost parity claim; stale means the store must be
+    refreshed intentionally (--write-trend). Both fail the gate.
+    Reference counterpart: none — drift gating mirrors
+    analysis/baseline.py diff_baseline."""
+    regressions: List[str] = []
+    stale: List[str] = []
+    if store is None:
+        return regressions, [f"no committed {TREND_STORE}; create it "
+                             f"with `python bench.py trend "
+                             f"--write-trend`"]
+    by_file = {r["file"]: r for r in records}
+    committed = {r["file"]: r for r in store.get("records", [])}
+    for fname, old in committed.items():
+        new = by_file.get(fname)
+        if new is None:
+            stale.append(f"{fname}: committed in {TREND_STORE} but "
+                         f"missing on disk")
+            continue
+        band = old.get("noise_band", _DEFAULT_NOISE_BAND)
+        new_heads = {h["metric"]: h for h in new["headlines"]}
+        for h in old.get("headlines", []):
+            got = new_heads.get(h["metric"])
+            if got is None:
+                stale.append(f"{fname}: headline {h['metric']!r} "
+                             f"disappeared from the record")
+                continue
+            if abs(got["value"] - h["value"]) <= 1e-9 * max(
+                    1.0, abs(h["value"])):
+                continue
+            if got["value"] < h["value"] * (1 - band):
+                regressions.append(
+                    f"{fname}: headline {h['metric']} REGRESSED "
+                    f"{h['value']:g} -> {got['value']:g} (beyond the "
+                    f"{band:.0%} recorded noise band)")
+            else:
+                stale.append(
+                    f"{fname}: headline {h['metric']} changed "
+                    f"{h['value']:g} -> {got['value']:g}; refresh "
+                    f"the store if intentional")
+        for key, v in (old.get("ratios") or {}).items():
+            got_v = (new.get("ratios") or {}).get(key)
+            if got_v is None:
+                stale.append(f"{fname}: ratio {key!r} disappeared")
+            elif got_v < v * (1 - band):
+                regressions.append(
+                    f"{fname}: ratio {key} REGRESSED {v:g} -> "
+                    f"{got_v:g}")
+            elif abs(got_v - v) > 1e-9 * max(1.0, abs(v)):
+                stale.append(f"{fname}: ratio {key} changed "
+                             f"{v:g} -> {got_v:g}")
+        for key, v in (old.get("parity") or {}).items():
+            got_v = (new.get("parity") or {}).get(key)
+            if isinstance(v, bool):
+                if v and got_v is not True:
+                    regressions.append(
+                        f"{fname}: parity flag {key} was true, now "
+                        f"{got_v!r} — a correctness claim was lost")
+            elif isinstance(v, int) and v == 0:
+                if got_v is None or int(got_v) != 0:
+                    regressions.append(
+                        f"{fname}: {key} was 0, now {got_v!r} — "
+                        f"steady-state compiles appeared")
+        if new["schema_keys"] != old.get("schema_keys"):
+            missing = sorted(set(old.get("schema_keys", []))
+                             - set(new["schema_keys"]))
+            added = sorted(set(new["schema_keys"])
+                           - set(old.get("schema_keys", [])))
+            stale.append(f"{fname}: schema drifted (missing "
+                         f"{missing}, new {added})")
+    for fname in sorted(set(by_file) - set(committed)):
+        stale.append(f"{fname}: new record not in {TREND_STORE}; "
+                     f"append with `python bench.py trend "
+                     f"--write-trend`")
+    return regressions, stale
+
+
+def write_store(path: Optional[str] = None,
+                bench_dir: Optional[str] = None) -> dict:
+    """Intentional refresh: rebuild the trajectory from disk, print
+    cross-round regression warnings (never silent), write the store,
+    return it. Reference counterpart: none — the
+    intentional-refresh workflow mirrors analysis/baseline.py
+    --write-baseline."""
+    records = build_records(bench_dir)
+    for w in _cross_round_warnings(records):
+        print(f"# trend WARNING: {w}")
+    store = {"schema_version": STORE_SCHEMA_VERSION,
+             "records": records}
+    path = path or default_store_path()
+    with open(path, "w") as f:
+        json.dump(store, f, indent=1)
+        f.write("\n")
+    return store
+
+
+def check(path: Optional[str] = None,
+          bench_dir: Optional[str] = None,
+          quiet: bool = False) -> int:
+    """The gate: 0 green, 2 on any regression or staleness.
+    Reference counterpart: none (the analysis_baseline.json gate
+    pattern applied to perf)."""
+    records = build_records(bench_dir)
+    try:
+        store = load_store(path)
+    except ValueError as e:
+        print(f"# trend STALE: {e}")
+        return 2
+    regressions, stale = diff_against_store(records, store)
+    for r in regressions:
+        print(f"# trend REGRESSION: {r}")
+    for s in stale:
+        print(f"# trend STALE: {s}")
+    if not regressions and not stale and not quiet:
+        n_heads = sum(len(r["headlines"]) for r in records)
+        print(f"# trend OK: {len(records)} record(s), {n_heads} "
+              f"headline(s), store current")
+    return 2 if (regressions or stale) else 0
+
+
+def main(argv: List[str]) -> int:
+    """CLI body for ``python bench.py trend [--write-trend]``.
+    Reference counterpart: none."""
+    if "--write-trend" in argv or "--write" in argv:
+        store = write_store()
+        print(f"# trend: wrote {TREND_STORE} with "
+              f"{len(store['records'])} record(s)")
+        return 0
+    return check()

@@ -64,6 +64,12 @@ a stable diagnostic code so tests/docs can reference the class:
   PTA170  per-device memory budget (the static planner
           analysis/memplan.py: persistable/feed/temp bytes under the
           propagated specs vs an opt-in per-program budget)
+  PTA180  device-telemetry counter contract (@TEL-marked counters —
+          observability/devtel.py — must be int64, concretely
+          declared, persistable, and read-modify-write wherever
+          written: the PTA020/PTA090 lessons applied to the decode
+          flight-data subsystem; a drifted counter poisons every
+          stats window with no downstream error)
 
 Severities: "error" = the program is wrong (strict mode raises),
 "warning" = almost certainly a bug but a legal feed/scope could save
@@ -1219,6 +1225,147 @@ def check_spec_advance(program: Program):
                     f"producing spec_accept clips room against "
                     f"max_len={max_len}: the advance bound guards "
                     f"the wrong buffer", var=buf_names[0])
+
+
+# ---------------------------------------------------------------------------
+# PTA180: device-telemetry counter contract.
+# ---------------------------------------------------------------------------
+# the devtel registry owns the mark (single source of truth: a local
+# copy drifting from the registry would make PTA180 silently match
+# zero vars and unenforce the whole contract)
+from ..observability.devtel import TEL_MARK  # noqa: E402
+
+
+def _rmw_chain_reads(block, site_idx: int, name: str,
+                     depth: int = 8) -> bool:
+    """Does the value written to ``name`` at ``block.ops[site_idx]``
+    derive from a read of ``name``? Direct read on the writing op
+    counts (container ops carry the var through their inputs), else a
+    bounded backward walk over same-block producers — the RMW idiom
+    ``assign(elementwise_add(var, delta), output=var)`` reads the var
+    one producer behind the write."""
+    ops = block.ops
+    op = ops[site_idx]
+    if name in op.input_arg_names:
+        return True
+    producers = {}
+    for i, o in enumerate(ops[:site_idx]):
+        for out in o.output_arg_names:
+            producers[out] = i   # last producer before the write wins
+    frontier = [n for n in op.input_arg_names if n != name]
+    seen = set(frontier)
+    for _ in range(depth):
+        nxt = []
+        for n in frontier:
+            pi = producers.get(n)
+            if pi is None:
+                continue
+            po = ops[pi]
+            if name in po.input_arg_names:
+                return True
+            for m in po.input_arg_names:
+                if m not in seen:
+                    seen.add(m)
+                    nxt.append(m)
+        if not nxt:
+            return False
+        frontier = nxt
+    return False
+
+
+@register_checker("PTA180", "telemetry-counter-contract")
+def check_telemetry_counters(program: Program):
+    """Device-telemetry counters (persistables carrying the ``@TEL``
+    name mark — observability/devtel.py) are the flight recorder's
+    only view into a fused admission+burst dispatch, and they ride
+    the executor's state paths, so each one must honor the contract
+    the measured traps behind PTA020 and PTA090 taught:
+
+    * **declared int64** — an accidentally-float counter silently
+      breaks the lax.while_loop / scan carry dtypes under JAX weak
+      typing (the PTA020 `increment` promotion class, applied to the
+      new subsystem);
+    * **concrete declared shape + persistable** — the counter must be
+      carry-declarable so `Executor.run_steps` / `prepare(steps=K)`
+      can seed its scan slot (the PTA090 class);
+    * **read-modify-write at EVERY writing site** — a write whose
+      value does not derive from a read of the counter (checked per
+      site via the producer chain, not a program-global read set: a
+      legitimate RMW bump elsewhere must not whitewash a clobbering
+      ``assign(fill_constant, output=var)``) overwrites the
+      cumulative total, so the serving layer's per-dispatch deltas go
+      negative and every window silently lies. Reads inside While
+      bodies surface through the container op's carried inputs, so
+      the serve programs' in-loop increments count.
+
+    ERROR severity: a drifted counter poisons the telemetry surface
+    with no error anywhere downstream — the defect class this whole
+    checker family exists for."""
+    written: Dict[str, OpSite] = {}
+    clobbered: Dict[str, OpSite] = {}
+    for blk, container in iter_blocks(program):
+        for i, op in enumerate(blk.ops):
+            for n in op.output_arg_names:
+                if TEL_MARK not in n:
+                    continue
+                site = OpSite(blk.idx, i, op, container)
+                written.setdefault(n, site)
+                if n not in clobbered \
+                        and not _rmw_chain_reads(blk, i, n):
+                    clobbered[n] = site
+    seen = set()
+    for blk, _container in iter_blocks(program):
+        for name, var in blk.vars.items():
+            if TEL_MARK not in name or name in seen:
+                continue
+            seen.add(name)
+            dtype = getattr(var, "_declared_dtype", None) or var.dtype
+            dtype_name = np_dtype_name(dtype) if dtype is not None \
+                else None
+            shape = getattr(var, "_declared_shape", None)
+            if shape is None:
+                shape = tuple(var.shape) if var.shape is not None \
+                    else None
+            site = written.get(name)
+            problems = []
+            if not var.persistable:
+                problems.append(
+                    "not persistable (it would not ride "
+                    "state_in/state_out across dispatches)")
+            if dtype_name != "int64":
+                problems.append(
+                    f"declared dtype {dtype_name or 'unknown'} "
+                    f"(must be int64: float counters break while/"
+                    f"scan carry dtypes under weak typing)")
+            if shape is None or any(d is None or d < 0
+                                    for d in shape):
+                problems.append(
+                    f"non-concrete declared shape "
+                    f"{tuple(shape) if shape else None} (must be "
+                    f"carry-declarable for the K-step scan)")
+            clobber = clobbered.get(name)
+            if clobber is not None:
+                site = clobber   # anchor the diagnostic at the bad
+                #                  write, not just the first one
+                problems.append(
+                    "written without reading it (the update must be "
+                    "read-modify-write — var = var + delta — at "
+                    "every site, or per-dispatch deltas go negative)")
+            if not problems:
+                continue
+            msg = (f"telemetry counter {name!r} violates the devtel "
+                   f"contract: {'; '.join(problems)}")
+            hint = ("declare it through observability/devtel."
+                    "counter_specs ([1] int64 persistable) and "
+                    "update it with layers.assign(elementwise_add("
+                    "var, delta), output=var)")
+            if site is not None:
+                yield _diag_at("PTA180", ERROR, site, msg, var=name,
+                               hint=hint)
+            else:
+                yield Diagnostic("PTA180", ERROR, msg,
+                                 block_idx=blk.idx, var=name,
+                                 hint=hint)
 
 
 # ---------------------------------------------------------------------------

@@ -727,6 +727,52 @@ def _record_compile_event(kind, program, tier, t0, fn=None):
                                     **attrs)
 
 
+def _cost_probe_avals(compiled, scope, feed_arrays, write_only=None):
+    """Aval tuple matching the compiled fn's call signature — the
+    lazy cost-analysis probe (observability/costmodel.py): shape
+    structs only, never arrays, so stashing a probe pins no buffers
+    (the PreparedProgram example-feed discipline). None when scope
+    state is uninitialized (run() raises its friendly error before
+    analysis could matter) or any value defies aval-ing."""
+    try:
+        mut = {n: scope._get(n) for n in compiled.state_in}
+        const = {n: scope._get(n) for n in compiled.const_in}
+        if any(v is None for v in mut.values()) \
+                or any(v is None for v in const.values()):
+            return None
+        rng = scope._get(RNG_VAR)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        carry = {n: _as_aval(v) for n, v in mut.items()}
+        for n, spec in (write_only or {}).items():
+            carry[n] = jax.ShapeDtypeStruct(tuple(spec.shape),
+                                            spec.dtype)
+        return (carry,
+                {n: _as_aval(v) for n, v in const.items()},
+                {n: _as_aval(v)
+                 for n, v in (feed_arrays or {}).items()},
+                _as_aval(rng))
+    except Exception:
+        return None
+
+
+def _note_cost_model(program, fn, kind, feed_specs, compiled=None,
+                     scope=None, feed_arrays=None, write_only=None):
+    """Compile-time hook feeding the executable cost model
+    (observability/costmodel.py): direct analysis for AOT Compiled
+    fns, an aval probe for live-jit ones. Rides the compile budget —
+    never a request path."""
+    from ..observability import costmodel as obs_costmodel
+
+    avals = None
+    if compiled is not None and scope is not None \
+            and not hasattr(fn, "cost_analysis"):
+        avals = _cost_probe_avals(compiled, scope, feed_arrays,
+                                  write_only=write_only)
+    obs_costmodel.note_executable(program, fn, kind,
+                                  feed_specs=feed_specs, avals=avals)
+
+
 class Executor:
     """fluid.Executor parity (reference python/paddle/fluid/executor.py:451).
     """
@@ -1372,6 +1418,7 @@ class Executor:
                 maybe_check_program(program)
                 self.disk_load_count += 1
                 _record_compile_event("block", program, "disk", t0, fn)
+                _note_cost_model(program, fn, "block", feed_specs)
                 return _CompiledBlock(
                     fn, tuple(meta["feed_names"]), meta["state_in"],
                     meta["const_in"], meta["state_out"],
@@ -1384,6 +1431,9 @@ class Executor:
         self.compile_count += 1
         _record_compile_event("block", program, "cold", t0,
                               compiled.fn)
+        _note_cost_model(program, compiled.fn, "block", feed_specs,
+                         compiled=compiled, scope=scope,
+                         feed_arrays=feed_arrays)
         if dcache is not None and dcache.writable:
             self._disk_store(dcache, digest, compiled, kind="block")
         return compiled
@@ -1406,6 +1456,7 @@ class Executor:
                 maybe_check_program(program)
                 self.disk_load_count += 1
                 _record_compile_event("scan", program, "disk", t0, fn)
+                _note_cost_model(program, fn, "scan", feed_specs)
                 wos = {n: jax.ShapeDtypeStruct(tuple(shape),
                                                _dtype_from_str(dt))
                        for n, shape, dt in meta["write_only_specs"]}
@@ -1421,6 +1472,10 @@ class Executor:
         self.compile_count += 1
         _record_compile_event("scan", program, "cold", t0,
                               compiled.fn)
+        _note_cost_model(program, compiled.fn, "scan", feed_specs,
+                         compiled=compiled, scope=scope,
+                         feed_arrays=feed_arrays,
+                         write_only=compiled.write_only_specs)
         if dcache is not None and dcache.writable:
             self._disk_store(
                 dcache, digest, compiled, kind="scan",

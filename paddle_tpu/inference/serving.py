@@ -66,6 +66,8 @@ from ..core.scope import global_scope
 from ..core.types import to_np_dtype
 from ..models.decode_engine import (BlockPoolExhausted, HostBlockPool,
                                     PromptPrefixCache)
+from ..observability import costmodel as obs_costmodel
+from ..observability import devtel as obs_devtel
 from ..observability import metrics as obs_metrics
 from ..observability import tracing as obs_tracing
 from ..observability.metrics import Histogram
@@ -255,7 +257,9 @@ class ProgramRunner:
         # tracing is off: one thread-local lookup per span); the
         # execute_span helper stamps the cache-tier attr from counter
         # deltas, covering a prepared-lookup-miss compile
-        with obs_tracing.execute_span(self.executor):
+        with obs_tracing.execute_span(self.executor,
+                                      program=self.program,
+                                      feed=feed):
             # None = program not preparable (go ops / CompiledProgram
             # / native build): per-call Executor.run path
             prepared = self._prepared.lookup(feed)
@@ -1020,6 +1024,15 @@ class ContinuousGenerationServer:
         self._acc_hist = Histogram(
             "paddle_tpu_spec_acceptance_rate",
             buckets=tuple(round(0.1 * i, 1) for i in range(1, 11)))
+        # device-side flight data (observability/devtel.py): the
+        # bundle's telemetry counters join the dispatch fetch list and
+        # are deltaed per burst — ticks, occupancy integral, exit
+        # reason, admission tiers. Inactive (empty) for hand-built
+        # bundles without devtel state.
+        self._devtel = obs_devtel.DeviceTelemetry(bundle)
+        # per-serve-key cost-model snapshots (lazy: the first
+        # metrics-on dispatch of a key resolves them, cached forever)
+        self._cost_snaps: Dict[object, dict] = {}
 
         # bind the prepared handles up front (= AOT warmup: all
         # compiles happen HERE, none in the traffic window): one fused
@@ -1027,7 +1040,8 @@ class ContinuousGenerationServer:
         before = self.executor.compile_count
         st = bundle.state
         self._fetches = [st["tok_buf"], st["step"], st["active"],
-                         st["finished"]] + self._spec_names
+                         st["finished"]] + self._spec_names \
+            + self._devtel.fetch_names
         self._serves = {}
         for key, prog in sorted(bundle.serves.items(),
                                 key=lambda kv: str(kv[0])):
@@ -1346,10 +1360,18 @@ class ContinuousGenerationServer:
                 with obs_tracing.span("slotpool.dispatch",
                                       admits=len(admits),
                                       n_steps=n_steps) as sp:
+                    t_run0 = time.monotonic()
                     outs = self._serves[key].run(feed,
                                                  return_numpy=True)
+                    wall_s = time.monotonic() - t_run0
                     sp.attrs["cache"] = _cache_tier(
                         self.executor, c0, d0)
+                    if self._devtel.active:
+                        # device-side burst interior: delta the
+                        # telemetry counters and annotate the span
+                        # the flight recorder retains (exit reason,
+                        # ticks, occupancy, expected-vs-actual)
+                        self._absorb_devtel(key, outs, wall_s, sp)
                     if self._spec_names:
                         # delta the device-side spec counters for
                         # this dispatch: the acceptance-rate sample
@@ -1439,6 +1461,87 @@ class ContinuousGenerationServer:
             self._spec_tot = vals
         return deltas
 
+    def _cost_snapshot(self, key) -> Optional[dict]:
+        """Executable cost-model snapshot for serves[key]
+        (observability/costmodel.py), resolved lazily on the first
+        metrics-on dispatch of the key (one extra trace, no XLA
+        compile) and cached on the server forever after — never a
+        steady-state cost."""
+        snap = self._cost_snaps.get(key)
+        if snap is None and obs_metrics.metrics_on():
+            snap = obs_costmodel.lookup(self.bundle.serves[key])
+            if snap is not None:
+                self._cost_snaps[key] = snap
+        return snap
+
+    def _absorb_devtel(self, key, outs, wall_s, sp):
+        """Delta the fetched device-telemetry counters for this
+        dispatch and annotate the burst span with the interior the
+        flight recorder retains: ticks actually run, the exit reason,
+        the occupancy integral, and — once the cost model has a
+        calibrated rate — expected-vs-actual tick time (model cost vs
+        this host's throttle weather)."""
+        off = 4 + len(self._spec_names)
+        with self._cv:
+            deltas = self._devtel.absorb(
+                outs[off:off + len(self._devtel.fetch_names)])
+        ticks = deltas.get("tel_ticks", 0)
+        if not ticks:
+            return
+        sp.attrs["ticks"] = ticks
+        sp.attrs["occupancy_integral"] = deltas.get("tel_occupancy",
+                                                    0)
+        reason = obs_devtel.DeviceTelemetry.exit_reason(deltas)
+        if reason is not None:
+            sp.attrs["exit_reason"] = reason
+        if not obs_metrics.metrics_on():
+            return
+        # per-tick cost comes from the KEY-0 serve snapshot — the
+        # pure-burst program (no admission body), so its one-While-
+        # body cost IS one tick. A per-key snapshot would fold the
+        # admission prologue (A full encoder prefills on a miss key)
+        # into every tick of the burst, overstating expected_ms and
+        # inflating the calibrated rate by ticks x prologue.
+        snap = self._cost_snapshot(0) or {}
+        flops = snap.get("flops")
+        actual_tick_ms = wall_s * 1e3 / ticks
+        # expectation from the rate calibrated BEFORE this dispatch:
+        # this burst's own sample must not vouch for itself
+        expected = obs_costmodel.expected_ms(flops)
+        sp.attrs["actual_tick_ms"] = round(actual_tick_ms, 3)
+        if expected is not None:
+            sp.attrs["expected_tick_ms"] = round(expected, 3)
+            if expected > 0:
+                sp.attrs["tick_time_ratio"] = round(
+                    actual_tick_ms / expected, 3)
+        if flops:
+            # the While body is costed once, so tick-flops x ticks is
+            # the burst's work — but an admission dispatch's wall
+            # ALSO covers the encoder prologue the key-0 flops
+            # excludes, and feeding that wall uncorrected would
+            # depress the calibrated rate (blurring the very
+            # model-cost-vs-host-weather split this exists for).
+            # Add the prologue's own flops from the key's snapshot
+            # (key flops = admission body + one tick body); when the
+            # prologue cost is unknown, skip the sample rather than
+            # poison the median. Low-concurrency traffic admits on
+            # EVERY dispatch, so admission dispatches must calibrate
+            # or the rate never warms.
+            work = flops * ticks
+            if sp.attrs.get("admits", 0) and key != 0:
+                kflops = (self._cost_snapshot(key) or {}).get("flops")
+                work = None if kflops is None \
+                    else work + max(0.0, kflops - flops)
+            if work:
+                obs_costmodel.observe(work, wall_s)
+
+    def _host_tel_locked(self, reset: bool) -> dict:
+        """Host-side supplement to stats()['device_telemetry']
+        (window-scoped; re-based on reset). The paged scheduler
+        overrides with its allocation counters; the dense server has
+        none. Called under _cv."""
+        return {}
+
     def _speculative_stats_locked(self) -> Optional[dict]:
         if self._spec_k <= 0:
             return None
@@ -1510,6 +1613,13 @@ class ContinuousGenerationServer:
             spec = self._speculative_stats_locked()
             if spec is not None:
                 snap["speculative"] = spec
+            if self._devtel.active:
+                # the device-side burst interior, window-scoped like
+                # every other stats() counter (reset=True re-bases —
+                # the r14 spec-counter window semantics)
+                dt = self._devtel.stats_dict(self._devtel.window())
+                dt.update(self._host_tel_locked(reset))
+                snap["device_telemetry"] = dt
             if reset:
                 self._n_requests = self._n_done = 0
                 self._n_tokens = self._n_ticks = 0
@@ -1519,6 +1629,7 @@ class ContinuousGenerationServer:
                 self._per_token.clear()
                 self._acc_hist.clear()
                 self._spec_base = dict(self._spec_tot)
+                self._devtel.rebase()
                 self._t_first_arrival = None
                 self._t_last_done = None
                 self._t_window = now
@@ -1562,6 +1673,7 @@ class ContinuousGenerationServer:
                     ("paddle_tpu_spec_acceptance_rate", lab,
                      self._acc_hist),
                 ]
+            samples += self._devtel.metric_samples(lab)
             return samples
 
 
@@ -1631,6 +1743,14 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         #                         pressure (observability)
         self._preemptions = 0   # recompute-preempted lanes (vLLM-
         #                         style requeue; tokens stay exact)
+        # devtel host supplement (observability/devtel.HOST_COUNTERS):
+        # window-scoped high-water marks + pause/preempt bases for
+        # stats()['device_telemetry'] (the device cannot see host
+        # allocation decisions, but they explain the same slow bursts)
+        self._blocks_hwm = 0
+        self._entries_hwm = 0
+        self._pause_base = 0
+        self._preempt_base = 0
         super().__init__(bundle, **kwargs)
 
     # how deep past the queue head the tier-grouped admission scan may
@@ -1867,6 +1987,10 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 if self._lanes[s] is not None
                 and s not in self._paused)
             min_active = max(0, live_unpaused - 1)
+        # devtel: pool high-water marks AFTER this cycle's admissions
+        # and block growth (under _cv like every planning mutation)
+        self._blocks_hwm = max(self._blocks_hwm, self._blocks.in_use)
+        self._entries_hwm = max(self._entries_hwm, self._prefix.in_use)
         return n_steps, min_active, True
 
     def _pre_dispatch(self):
@@ -1920,6 +2044,25 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
             "preemptions": self._preemptions,
         }
 
+    def _host_tel_locked(self, reset: bool) -> dict:
+        """Paged host supplement: window-scoped pool high-water marks
+        and pause/preempt counts (pool_stats() keeps the LIFETIME
+        views of the latter). Called under _cv from stats()."""
+        out = {
+            "blocks_hwm": self._blocks_hwm,
+            "prompt_entries_hwm": self._entries_hwm,
+            "pause_events": self._pause_events - self._pause_base,
+            "preemptions": self._preemptions - self._preempt_base,
+        }
+        if reset:
+            # hwm re-bases to CURRENT residency (not zero): the next
+            # window's mark must not under-report lanes already live
+            self._blocks_hwm = self._blocks.in_use
+            self._entries_hwm = self._prefix.in_use
+            self._pause_base = self._pause_events
+            self._preempt_base = self._preemptions
+        return out
+
     def stats(self, reset: bool = False) -> dict:
         st = super().stats(reset=reset)
         st["block_pool"] = self.pool_stats()
@@ -1929,6 +2072,14 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         samples = super()._metrics_samples()
         lab = {"server": self._obs_id}  # unique per instance: two
         # co-resident paged servers must not collide series
+        host_tel = {
+            "blocks_hwm": self._blocks_hwm,
+            "prompt_entries_hwm": self._entries_hwm,
+            "pause_events": self._pause_events,
+            "preemptions": self._preemptions,
+        }
+        samples += [(c.metric, lab, host_tel[c.stat])
+                    for c in obs_devtel.HOST_COUNTERS]
         b, p = self._blocks, self._prefix
         samples += [
             ("paddle_tpu_blockpool_blocks_in_use", lab, b.in_use),

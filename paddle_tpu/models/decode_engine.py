@@ -48,11 +48,9 @@ import numpy as np
 
 from .. import layers
 from ..analysis import absint
+from ..observability import devtel
+from ..observability.devtel import DECODE_STEPS_VAR  # noqa: F401
 from ..param_attr import ParamAttr
-
-# fixed-name [1] int64 var holding the number of While iterations a
-# decode program actually ran (early-exit observability; fetchable)
-DECODE_STEPS_VAR = "@decode_steps"
 
 # name mark on SHARED block-pool persistables: checker PTA110 requires
 # every write to a var carrying this mark to be a provably
@@ -540,11 +538,7 @@ def build_greedy_decode_program(seq_len=16, max_out_len=16,
                                     start_id)
         # fixed-name counter so tests/benches can fetch the number of
         # loop iterations actually taken (the early-exit probe)
-        counter = layers.fill_constant(
-            [1], "int64", 0,
-            out=main.global_block.create_var(
-                name=DECODE_STEPS_VAR, shape=(1,), dtype="int64",
-                stop_gradient=True))
+        counter = devtel.declare_decode_steps(main.global_block)
         limit = layers.fill_constant([1], "int64",
                                      float(max_out_len - 1))
         finished = layers.assign(layers.fill_constant_batch_size_like(
@@ -630,11 +624,7 @@ def build_incremental_decode_program(seq_len=16, max_out_len=16,
             vc = layers.assign(layers.fill_constant_batch_size_like(
                 src, [-1, n_heads, maxT, head_dim], "float32", 0.0))
             caches.append((kc, vc))
-        counter = layers.fill_constant(
-            [1], "int64", 0,
-            out=main.global_block.create_var(
-                name=DECODE_STEPS_VAR, shape=(1,), dtype="int64",
-                stop_gradient=True))
+        counter = devtel.declare_decode_steps(main.global_block)
         limit = layers.fill_constant([1], "int64", float(maxT - 1))
         finished = layers.assign(layers.fill_constant_batch_size_like(
             src, [-1], "int64", 0.0))
@@ -889,6 +879,12 @@ def _slot_state_specs(prefix, rows, maxT, seq_len, n_heads,
         for c in ("spec_proposed", "spec_accepted", "spec_emitted",
                   "spec_draft_steps", "spec_target_steps"):
             specs[f"{prefix}{c}"] = ((1,), "int64")
+    # device-side flight data (observability/devtel.py): [1] int64
+    # RMW counters every program of the bundle declares — ticks,
+    # occupancy integral, burst exit reasons, admission-tier counts.
+    # The @TEL name mark puts them under checker PTA180's contract.
+    specs.update(devtel.counter_specs(prefix,
+                                      cache.layout == "paged"))
     if cache.layout == "dense":
         for li in range(n_layers):
             specs[f"{prefix}self_k{li}"] = (
@@ -1091,6 +1087,16 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         NP, BS, NB = cache.pages(maxT), cache.block_size, cache.n_blocks
         E = cache.n_prompt_entries
 
+    # --- device-telemetry increment: var = var + delta on a bundle
+    # counter (observability/devtel.py registry; silently skipped for
+    # counters this layout does not carry, e.g. tel_admit_hit on
+    # dense bundles) ------------------------------------------------
+    def _tel_add(sv, logical, delta):
+        var = sv.get(f"{state_prefix}{logical}{devtel.TEL_MARK}")
+        if var is None:
+            return
+        layers.assign(layers.elementwise_add(var, delta), output=var)
+
     # --- lane-reset tail shared by every admission flavor: one-hot
     # masks over the fed slot ids, then token-buffer/counter/flag
     # resets for exactly the admitted lanes --------------------------
@@ -1113,7 +1119,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             layers.fill_constant([rows], "int64", 1.0), any_i)
         return oh, any_f, any_i, keep_f, keep_i
 
-    def _reset_lane_state(sv, any_i, keep_i, oh=None, seeds=None):
+    def _reset_lane_state(sv, any_i, keep_i, oh=None, seeds=None,
+                          tier="miss"):
         # token buffer rows: start_id at position 0, zeros
         # elsewhere (identical init row for every admission)
         positions = layers.cast(layers.range(0, maxT, 1), "int64")
@@ -1158,6 +1165,12 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         layers.assign(layers.elementwise_add(
             layers.elementwise_mul(act, keep_i),
             layers.elementwise_mul(any_i, valid)), output=act)
+        # devtel: count the REAL lanes this admission touched (padded
+        # rows collapse onto the dustbin lane, masked out by `valid`)
+        _tel_add(sv, f"tel_admit_{tier}",
+                 layers.reduce_sum(
+                     layers.elementwise_mul(any_i, valid),
+                     keep_dim=True))
 
     def _seeds_data(A):
         if not needs_seeds:
@@ -1308,7 +1321,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         oh, _, any_i, keep_f, keep_i = _lane_onehots(slots, A)
         if spec:
             _draft_admit(sv, src, A, oh, keep_f)
-        _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds)
+        _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds,
+                          tier="hit")
 
     admit_bodies = {"miss": _admit_body_dense if not paged
                     else _admit_body_paged_miss}
@@ -1340,6 +1354,12 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         stepv = sv[f"{state_prefix}step"]
         fin = sv[f"{state_prefix}finished"]
         act = sv[f"{state_prefix}active"]
+        # devtel: one tick ran; occupancy integral reads act BEFORE
+        # this tick's retirements mutate it (live lanes AT tick start)
+        _tel_add(sv, "tel_ticks",
+                 layers.fill_constant([1], "int64", 1.0))
+        _tel_add(sv, "tel_occupancy",
+                 layers.reduce_sum(act, keep_dim=True))
         positions = layers.cast(layers.range(0, maxT, 1), "int64")
         posf = layers.cast(positions, "float32")
         pos_table = layers.assign(
@@ -1514,6 +1534,12 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         fin = sv[f"{state_prefix}finished"]
         act = sv[f"{state_prefix}active"]
         seedv = sv[f"{state_prefix}seed"]
+        # devtel: same tick/occupancy discipline as _step_body (act
+        # read before the post-verify state assigns)
+        _tel_add(sv, "tel_ticks",
+                 layers.fill_constant([1], "int64", 1.0))
+        _tel_add(sv, "tel_occupancy",
+                 layers.reduce_sum(act, keep_dim=True))
         positions = layers.cast(layers.range(0, maxT, 1), "int64")
         posf = layers.cast(positions, "float32")
         pos_table = layers.assign(
@@ -1806,6 +1832,26 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                 body(sv)
                 layers.increment(k, 1)
                 _serve_cond(cond=cond)
+            # devtel: classify THIS burst's exit exactly once, after
+            # the While (k and act read their final loop values).
+            # Precedence: ran all n_steps ticks > every lane idle >
+            # live dropped to min_active — int arithmetic only, no
+            # logical ops (the emit_token_step conjunction idiom)
+            ran_out = layers.cast(layers.equal(k, n_steps), "int64")
+            live = layers.reduce_sum(act, keep_dim=True)
+            idle = layers.cast(
+                layers.equal(live,
+                             layers.fill_constant([1], "int64", 0.0)),
+                "int64")
+            one = layers.fill_constant([1], "int64", 1.0)
+            not_ran = layers.elementwise_sub(one, ran_out)
+            _tel_add(sv, "tel_exit_n_steps", ran_out)
+            _tel_add(sv, "tel_exit_all_idle",
+                     layers.elementwise_mul(not_ran, idle))
+            _tel_add(sv, "tel_exit_min_active",
+                     layers.elementwise_mul(
+                         not_ran,
+                         layers.elementwise_sub(one, idle)))
         return prog
 
     serves = {0: _build_serve("miss", 0)}
@@ -1829,6 +1875,9 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         for c in ("spec_proposed", "spec_accepted", "spec_emitted",
                   "spec_draft_steps", "spec_target_steps"):
             state[c] = f"{state_prefix}{c}"
+    # devtel counters join the state map (and therefore the PTA150
+    # counter-presence sweep) under their logical names
+    state.update(devtel.state_entries(state_prefix, paged))
     bundle = DecodeStepBundle(prefills, step_prog, serves, startup,
                               state, n_slots, seq_len, maxT, start_id,
                               end_id, cache=cache,

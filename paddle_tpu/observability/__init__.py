@@ -25,6 +25,16 @@ Three sub-modules, one gate:
 * ``flight`` — bounded ring of completed request timelines; SLO
   violations and errors are retained with their full span tree;
   ``incident_report()`` dumps them.
+* ``devtel`` — device-resident decode telemetry: the declarative
+  registry of [1] int64 RMW counters the decode engine compiles into
+  every serve/step/burst program (burst exit reason, ticks, occupancy
+  integral, admission tiers), deltaed per dispatch into the stats and
+  metric surfaces — the INTERIOR of the one ``execute`` span a fused
+  admission+burst dispatch used to be.
+* ``costmodel`` — static per-executable ``cost_analysis()`` /
+  ``memory_analysis()`` snapshots keyed on ``Program.fingerprint()``
+  plus a median achieved-rate calibration, so retained slow bursts
+  carry expected-vs-actual tick time (model cost vs host throttle).
 
 Gate: ``FLAGS_observability = off | metrics | trace`` (flags.py),
 read per call so ``set_flags`` flips the level mid-process. The layer
@@ -39,11 +49,11 @@ from .flight import RECORDER, incident_report
 from .metrics import metrics_on, trace_on
 from .tracing import TRACER, dump_trace, start_request
 
-__all__ = ["metrics", "tracing", "flight", "dump_trace",
-           "incident_report", "start_request", "metrics_on",
-           "trace_on", "reset", "TRACER", "RECORDER"]
+__all__ = ["metrics", "tracing", "flight", "devtel", "costmodel",
+           "dump_trace", "incident_report", "start_request",
+           "metrics_on", "trace_on", "reset", "TRACER", "RECORDER"]
 
-from . import flight, tracing  # noqa: E402  (re-export modules)
+from . import costmodel, devtel, flight, tracing  # noqa: E402
 
 
 def reset():

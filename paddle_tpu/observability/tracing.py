@@ -298,13 +298,24 @@ class execute_span(span):
     serving.ProgramRunner.run_batch and
     predictor.AnalysisPredictor._run_feed. Open it BEFORE the
     prepared-cache lookup: a lookup miss is itself the compile the
-    tier must attribute."""
+    tier must attribute.
 
-    __slots__ = ("_exe", "_c0", "_d0")
+    With ``program=`` the span also carries the executable cost
+    model's expected flops/bytes (observability/costmodel.py) — the
+    static side a retained slow request is compared against. The
+    lookup is a dict read after the program's first resolution; only
+    that first trace-level lookup may resolve a lazy probe (one extra
+    trace, never a compile). ``feed=`` (the dispatch's feed dict)
+    selects the spec-exact snapshot, so a program compiled at several
+    bucket shapes annotates each request with ITS bucket's cost."""
 
-    def __init__(self, exe, **attrs):
+    __slots__ = ("_exe", "_c0", "_d0", "_program", "_feed")
+
+    def __init__(self, exe, program=None, feed=None, **attrs):
         super().__init__("execute", **attrs)
         self._exe = exe
+        self._program = program
+        self._feed = feed
 
     def __enter__(self):
         self._c0 = self._exe.compile_count
@@ -313,6 +324,14 @@ class execute_span(span):
 
     def __exit__(self, *exc):
         self.attrs["cache"] = cache_tier(self._exe, self._c0, self._d0)
+        if self._traces and self._program is not None:
+            from . import costmodel
+
+            snap = costmodel.lookup(self._program,
+                                    feed_arrays=self._feed) or {}
+            for field in ("flops", "bytes_accessed"):
+                if snap.get(field) is not None:
+                    self.attrs[field] = snap[field]
         return super().__exit__(*exc)
 
 

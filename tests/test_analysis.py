@@ -1009,3 +1009,133 @@ class TestPTA120SpecAdvanceBounded:
         for key in (0, 2):
             assert not _diags(bundle.serves[key], "PTA120"), key
         assert not _diags(bundle.step, "PTA120")
+
+
+# ---------------------------------------------------------------------------
+# PTA180 device-telemetry counter contract (observability/devtel.py)
+# ---------------------------------------------------------------------------
+class TestTelemetryCounterContract:
+    """PTA180: every @TEL-marked counter must be an int64, concretely
+    declared, persistable, read-modify-write var — the PTA020 (weak-
+    typing carry promotion) and PTA090 (write-only scan carry)
+    lessons applied to the devtel subsystem, where a drifted counter
+    silently poisons every stats window instead of erroring."""
+
+    def _tel_var(self, main, name="@t/tel_ticks@TEL", dtype="int64",
+                 shape=(1,), persistable=True):
+        return main.global_block.create_var(
+            name=name, shape=shape, dtype=dtype,
+            persistable=persistable, stop_gradient=True)
+
+    def test_rmw_int64_counter_is_clean(self):
+        main, startup, g = _guarded()
+        with g:
+            var = self._tel_var(main)
+            layers.assign(
+                layers.elementwise_add(
+                    var, layers.fill_constant([1], "int64", 1.0)),
+                output=var)
+        assert not _diags(main, "PTA180")
+
+    def test_write_only_counter_is_error(self):
+        main, startup, g = _guarded()
+        with g:
+            var = self._tel_var(main)
+            # overwrites the cumulative total: per-dispatch deltas of
+            # the serving layer go negative
+            layers.assign(layers.fill_constant([1], "int64", 7.0),
+                          output=var)
+        ds = _diags(main, "PTA180")
+        assert ds and ds[0].severity == ERROR
+        assert "without reading" in ds[0].message
+
+    def test_rmw_elsewhere_does_not_whitewash_clobber(self):
+        """The RMW check is PER WRITING SITE via the producer chain:
+        a legitimate bump elsewhere in the program must not mask a
+        clobbering overwrite of the same counter (the program-global
+        read-set version of this check passed exactly that)."""
+        main, startup, g = _guarded()
+        with g:
+            var = self._tel_var(main)
+            layers.assign(
+                layers.elementwise_add(
+                    var, layers.fill_constant([1], "int64", 1.0)),
+                output=var)                       # good RMW bump
+            layers.assign(layers.fill_constant([1], "int64", 0.0),
+                          output=var)             # clobber: resets it
+        ds = _diags(main, "PTA180")
+        assert ds and ds[0].severity == ERROR
+        assert "without reading" in ds[0].message
+
+    def test_float_counter_is_error(self):
+        main, startup, g = _guarded()
+        with g:
+            var = self._tel_var(main, dtype="float32")
+            layers.assign(
+                layers.elementwise_add(
+                    var, layers.fill_constant([1], "float32", 1.0)),
+                output=var)
+        ds = _diags(main, "PTA180")
+        assert ds and ds[0].severity == ERROR
+        assert "int64" in ds[0].message
+
+    def test_nonconcrete_shape_is_error(self):
+        main, startup, g = _guarded()
+        with g:
+            self._tel_var(main, shape=(-1,))
+        ds = _diags(main, "PTA180")
+        assert ds and ds[0].severity == ERROR
+        assert "carry-declarable" in ds[0].message
+
+    def test_non_persistable_counter_is_error(self):
+        main, startup, g = _guarded()
+        with g:
+            var = self._tel_var(main, persistable=False)
+            layers.assign(
+                layers.elementwise_add(
+                    var, layers.fill_constant([1], "int64", 1.0)),
+                output=var)
+        ds = _diags(main, "PTA180")
+        assert ds and ds[0].severity == ERROR
+        assert "persistable" in ds[0].message
+
+    def test_declared_but_untouched_counter_is_clean(self):
+        """Admission-only programs declare counters the step bodies
+        own (shared slot-state table): declared-but-unwritten must
+        not trip the RMW rule."""
+        main, startup, g = _guarded()
+        with g:
+            self._tel_var(main)
+        assert not _diags(main, "PTA180")
+
+    def test_in_while_increment_counts_as_rmw(self):
+        """The serve programs bump counters INSIDE the burst While;
+        reads/writes surface through the container — the shipped
+        bundle programs are the fixture."""
+        from paddle_tpu.models import transformer as T
+
+        bundle = T.build_decode_step_program(
+            seq_len=8, max_out_len=8, d_model=32, n_heads=2,
+            n_layers=1, d_inner=64, vocab=50, n_slots=2,
+            state_prefix="@pta180/")
+        for key, prog in bundle.serves.items():
+            assert not _diags(prog, "PTA180"), key
+        assert not _diags(bundle.step, "PTA180")
+        assert not _diags(bundle.prefill, "PTA180")
+
+    def test_bundle_state_carries_devtel_logicals(self):
+        """The devtel registry's logical names ride bundle.state (the
+        serving layer's fetch-name contract) and the spec table
+        declares them in every program (the PTA150 sweep's input)."""
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.observability import devtel
+
+        bundle = T.build_decode_step_program(
+            seq_len=8, max_out_len=8, d_model=32, n_heads=2,
+            n_layers=1, d_inner=64, vocab=50, n_slots=2,
+            state_prefix="@pta180b/")
+        for spec in devtel.bundle_counters(paged=False):
+            assert spec.logical in bundle.state
+            name = bundle.state[spec.logical]
+            assert devtel.TEL_MARK in name
+            assert bundle._state_specs[name] == ((1,), "int64")

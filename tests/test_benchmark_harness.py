@@ -181,3 +181,128 @@ class TestMeasurementHarness:
             with open(p) as f:
                 rec = json.load(f)
             assert "telemetry" in rec, p
+
+
+class TestTrendSentinel:
+    """benchmark/trend.py: the perf-trend drift gate over the
+    committed BENCH_SELF history (the analysis_baseline.json
+    discipline applied to the measured record). The fast lane runs
+    the REAL gate in-process: the committed bench_trend.json must be
+    current, and a synthetically regressed headline must fail."""
+
+    def test_committed_store_is_current(self):
+        # the tier-1-adjacent assertion: `python bench.py trend` on
+        # this checkout is green — the store matches the files
+        from benchmark import trend
+
+        records = trend.build_records()
+        store = trend.load_store()
+        assert store is not None, \
+            "bench_trend.json missing; run bench.py trend --write-trend"
+        regressions, stale = trend.diff_against_store(records, store)
+        assert not regressions, regressions
+        assert not stale, stale
+
+    def _tmp_history(self, tmp_path):
+        import json
+        import os
+        import shutil
+
+        from benchmark import trend
+        from benchmark.harness import BENCH_DIR
+
+        for f in os.listdir(BENCH_DIR):
+            if f.startswith("BENCH_SELF_r") and f.endswith(".json"):
+                shutil.copy(os.path.join(BENCH_DIR, f), tmp_path)
+        store_path = str(tmp_path / "bench_trend.json")
+        trend.write_store(path=store_path, bench_dir=str(tmp_path))
+        return trend, json, store_path
+
+    def test_synthetic_headline_regression_fails_loudly(self, tmp_path):
+        trend, json, store_path = self._tmp_history(tmp_path)
+        p = tmp_path / "BENCH_SELF_r13.json"
+        rec = json.loads(p.read_text())
+        rec["value"] = rec["value"] * 0.1  # collapse the headline
+        p.write_text(json.dumps(rec))
+        regs, stale = trend.diff_against_store(
+            trend.build_records(str(tmp_path)),
+            trend.load_store(store_path))
+        assert any("REGRESSED" in r for r in regs), (regs, stale)
+        assert trend.check(path=store_path,
+                           bench_dir=str(tmp_path)) == 2
+
+    def test_lost_parity_flag_is_a_regression(self, tmp_path):
+        trend, json, store_path = self._tmp_history(tmp_path)
+        p = tmp_path / "BENCH_SELF_r14.json"
+        rec = json.loads(p.read_text())
+        rec["token_parity_vs_whole_loop"] = False
+        p.write_text(json.dumps(rec))
+        regs, _ = trend.diff_against_store(
+            trend.build_records(str(tmp_path)),
+            trend.load_store(store_path))
+        assert any("parity" in r for r in regs), regs
+
+    def test_steady_state_compiles_appearing_is_a_regression(
+            self, tmp_path):
+        trend, json, store_path = self._tmp_history(tmp_path)
+        p = tmp_path / "BENCH_SELF_r13.json"
+        rec = json.loads(p.read_text())
+        rec["steady_state_compiles"] = 3
+        p.write_text(json.dumps(rec))
+        regs, _ = trend.diff_against_store(
+            trend.build_records(str(tmp_path)),
+            trend.load_store(store_path))
+        assert any("steady-state" in r for r in regs), regs
+
+    def test_new_record_is_stale_until_appended(self, tmp_path):
+        trend, json, store_path = self._tmp_history(tmp_path)
+        src = json.loads((tmp_path / "BENCH_SELF_r14.json").read_text())
+        (tmp_path / "BENCH_SELF_r99.json").write_text(json.dumps(src))
+        regs, stale = trend.diff_against_store(
+            trend.build_records(str(tmp_path)),
+            trend.load_store(store_path))
+        assert not regs
+        assert any("BENCH_SELF_r99" in s and "--write-trend" in s
+                   for s in stale), stale
+        # the refresh appends it and goes green
+        trend.write_store(path=store_path, bench_dir=str(tmp_path))
+        assert trend.check(path=store_path,
+                           bench_dir=str(tmp_path)) == 0
+
+    def test_schema_drift_is_stale(self, tmp_path):
+        trend, json, store_path = self._tmp_history(tmp_path)
+        p = tmp_path / "BENCH_SELF_r12.json"
+        rec = json.loads(p.read_text())
+        rec.pop("observability_overhead")
+        p.write_text(json.dumps(rec))
+        _, stale = trend.diff_against_store(
+            trend.build_records(str(tmp_path)),
+            trend.load_store(store_path))
+        assert any("schema drifted" in s for s in stale), stale
+
+    def test_store_schema_version_guard(self, tmp_path):
+        import pytest as _pytest
+
+        trend, json, store_path = self._tmp_history(tmp_path)
+        store = json.loads(open(store_path).read())
+        store["schema_version"] = 99
+        open(store_path, "w").write(json.dumps(store))
+        with _pytest.raises(ValueError, match="schema_version"):
+            trend.load_store(store_path)
+        assert trend.check(path=store_path,
+                           bench_dir=str(tmp_path)) == 2
+
+    def test_headline_extraction_covers_every_era(self):
+        # r02 results-list, r10 nested dict, r11+ flat — each era's
+        # committed records must yield at least one headline (r05/r06
+        # are TPU-outage rounds with no headline, excluded)
+        from benchmark import trend
+
+        by_round = {r["round"]: r for r in trend.build_records()}
+        for rnd in (2, 7, 9, 10, 11, 12, 13, 14):
+            assert by_round[rnd]["headlines"], rnd
+        # parity flags surfaced from both nesting styles
+        assert any("parity" in k
+                   for k in by_round[13]["parity"])
+        assert any(k.endswith("steady_state_compiles")
+                   for k in by_round[13]["parity"])
