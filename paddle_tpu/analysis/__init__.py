@@ -27,6 +27,15 @@ Pieces:
   (matmul/mul contraction psums, reshape major-dim carry, reduce
   psums, gather allgathers, elementwise conflicts, ...); unknown ops
   degrade to an explicit ⊤ spec with a warn-once.
+* analysis.ownership_rules — the pool-index PROVENANCE rules behind
+  the ownership domain (absint ProvFact: host-owned source tags with
+  typestates, constants, one-hot indicators, value bounds, through
+  the affine/selection idioms the paged lowerings use), feeding
+  PTA190 (provenance + in-bounds), PTA191 (lane-exclusive write
+  PROVEN under the named host-allocator assumption — subsumes
+  PTA110's declaration) and PTA192 (read-only-while-shared, the COW
+  contract); ops without a rule propagate nothing, so an unproven
+  index fails loudly at the pool access.
 * analysis.memplan — the static per-device memory planner behind
   ``analyze(p).device_memory_plan()`` / CLI ``--memory-plan`` /
   checker PTA170: persistable/feed/temp bytes under the propagated

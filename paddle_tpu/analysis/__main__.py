@@ -122,6 +122,14 @@ def main(argv=None) -> int:
                 {"code": d.code, "severity": d.severity,
                  "reason": reason, "diagnostic": d.format()}
                 for d, reason in rep.suppressed]
+        if rep.ownership:
+            # the obligations/assumptions ledger: which named host-
+            # allocator invariants this target's pool proofs rest on
+            # (PTA190/191/192 — the ownership prover surface)
+            entry["ownership"] = {
+                "facts": dict(rep.ownership),
+                "ledger": dict(rep.ownership_ledger),
+            }
         if args.memory_plan and rep.plan is not None:
             entry["memory_plan"] = {
                 "state_bytes": rep.plan.state_bytes,
@@ -181,8 +189,21 @@ def main(argv=None) -> int:
                       f"--write-baseline")
 
     if args.json:
+        # zoo-wide assumptions/obligations roll-up: every named host
+        # invariant the ownership proofs lean on, with site counts —
+        # reviewable next to the per-checker wall seconds
+        assumptions, obligations = {}, {}
+        for rep in reports:
+            led = rep.ownership_ledger or {}
+            for name, n in (led.get("assumptions") or {}).items():
+                assumptions[name] = assumptions.get(name, 0) + n
+            for name, n in (led.get("obligations") or {}).items():
+                obligations[name] = obligations.get(name, 0) + n
         out = {"targets": report, "errors": n_err,
                "warnings": n_warn, "suppressed": n_sup,
+               "ownership_ledger": {
+                   "assumptions": dict(sorted(assumptions.items())),
+                   "obligations": dict(sorted(obligations.items()))},
                "checker_seconds": {
                    k: round(v, 4)
                    for k, v in sorted(checker_seconds.items())}}

@@ -35,6 +35,21 @@ Domains
    ``_declared_shape``/``_declared_dtype``); `declared_clobbers`
    surfaces declared-vs-producer disagreements (the r10 class) and
    int->float promotions (PTA020 generalized beyond `increment`).
+4. **Ownership / index provenance** — symbolic provenance for every
+   index reaching a ``@POOL`` read/write (the shared paged-KV pools,
+   models/decode_engine.py): a ProvFact tracks which HOST-OWNED index
+   sources (block-table feeds, host-deduplicated admission targets,
+   refcounted prompt-entry refs — the registered ownership-source
+   seed table, each tag carrying a TYPESTATE ``exclusive``/``shared``
+   and the named host-allocator assumption that backs it), trace-time
+   constants, 0/1 indicators and value BOUNDS a value derives from,
+   through the gather/reshape/one-hot-matmul/affine compositions the
+   paged lowerings actually use (rules in analysis/ownership_rules.py
+   via core.registry.register_index_rule). Checkers PTA190/191/192
+   read the recorded PoolAccess facts: provenance+bounds, PROVEN
+   lane-exclusive writes (subsuming PTA110's syntactic declaration —
+   the ``exclusive_via`` attr survives as the assumption's name), and
+   the read-only-while-shared COW contract.
 
 Annotation surface (the seed table)
 -----------------------------------
@@ -74,6 +89,12 @@ __all__ = [
     "MeshConfig", "set_mesh", "mesh_of",
     "CollectiveEvent", "EventSite",
     "set_device_memory_budget", "device_memory_budget",
+    # --- the ownership domain ---
+    "POOL_MARK", "OWNERSHIP_ATTR", "OWNERSHIP_BOUND_ATTR",
+    "TS_EXCLUSIVE", "TS_SHARED", "TS_GATE",
+    "OwnershipSource", "register_pool_index_source",
+    "pool_index_sources", "mark_pool_index_source",
+    "ProvFact", "prov_join", "PoolAccess",
 ]
 
 # --- the replication lattice ------------------------------------------------
@@ -292,6 +313,240 @@ def divergence_sources() -> Dict[str, str]:
     return dict(_DIVERGENCE_SOURCES)
 
 
+# --- the ownership domain: pool-index provenance & typestates ---------------
+# name mark on SHARED block-pool persistables (models/decode_engine.py
+# defines the same literal; analysis stays IR-level and never imports
+# models, so the mark is re-declared here as the domain's anchor)
+POOL_MARK = "@POOL"
+
+# op attr carrying a mint-site ownership tag (mark_pool_index_source);
+# the bound attr carries the host-invariant exclusive upper bound on
+# the minted index values (e.g. a block-table entry < n_blocks)
+OWNERSHIP_ATTR = "pool_index_source"
+OWNERSHIP_BOUND_ATTR = "pool_index_bound"
+
+# typestates of the per-block lifetime lattice
+#   free -> exclusive(lane) -> shared(refcount>1) -> freed
+# as seen FROM the device program: an index source's typestate says
+# what the host allocator guarantees about the blocks/entries it
+# addresses at the moment the program runs. TS_GATE is the odd one
+# out: not an index source but the active-lane mask a block-table
+# write must be gated by (PTA190's gate obligation).
+TS_EXCLUSIVE, TS_SHARED, TS_GATE = "exclusive", "shared", "gate"
+
+
+@dataclass(frozen=True)
+class OwnershipSource:
+    """One registered pool-index source family: the tag builders mark
+    mint sites with, the host typestate it certifies, and the NAMED
+    host-allocator assumption the exclusivity proof rests on (the
+    property-tested invariant — tests/test_block_pool_model.py).
+
+    Reference counterpart: none — the reference's allocator checks
+    are runtime Scope/memory asserts (reference framework/scope.cc);
+    a compile-time ownership contract has no analogue there.
+    """
+    tag: str
+    description: str
+    typestate: str                  # TS_EXCLUSIVE | TS_SHARED | TS_GATE
+    assumption: Optional[str] = None  # named host invariant
+    indicator: bool = False         # values provably 0/1 (masks)
+
+
+# The seed table. The two EXCLUSIVE tags deliberately spell exactly
+# like PTA110's ``exclusive_via`` declarations: the prover checks the
+# declared via AGREES with the proven provenance, so the old
+# declaration survives as the assumption's name (the PTA130/PTA010
+# subsumption pattern applied to ownership).
+_OWNERSHIP_SOURCES: Dict[str, OwnershipSource] = {}
+
+
+def register_pool_index_source(tag: str, description: str,
+                               typestate: str,
+                               assumption: Optional[str] = None,
+                               indicator: bool = False) -> None:
+    """Add an ownership-source tag to the seed table (idempotent for
+    an identical entry; refuses silent redefinition — the
+    register_divergence_source contract).
+
+    Reference counterpart: none (see OwnershipSource) — the
+    reference's allocator checks are runtime-only."""
+    if typestate not in (TS_EXCLUSIVE, TS_SHARED, TS_GATE):
+        raise ValueError(
+            f"register_pool_index_source: typestate must be one of "
+            f"{TS_EXCLUSIVE!r}/{TS_SHARED!r}/{TS_GATE!r}, got "
+            f"{typestate!r}")
+    entry = OwnershipSource(tag, description, typestate, assumption,
+                            indicator)
+    old = _OWNERSHIP_SOURCES.get(tag)
+    if old is not None and old != entry:
+        raise ValueError(
+            f"ownership source {tag!r} already registered "
+            f"differently; pick a new tag")
+    _OWNERSHIP_SOURCES[tag] = entry
+
+
+def pool_index_sources() -> Dict[str, OwnershipSource]:
+    """The registered ownership seed table, copied. Reference
+    counterpart: none (see register_pool_index_source)."""
+    return dict(_OWNERSHIP_SOURCES)
+
+
+# the canonical sources every paged lowering uses (models/
+# decode_engine.py marks its mint sites with these; the assumption
+# names point at the host state machines whose invariants
+# tests/test_block_pool_model.py property-tests)
+register_pool_index_source(
+    "block_table",
+    "per-lane block rows the HOST allocator wrote into the fed/"
+    "persistable block table: HostBlockPool.alloc hands each block "
+    "to exactly one lane until freed, so distinct lanes' rows are "
+    "disjoint and any index selected from a lane's row stays inside "
+    "that lane's blocks",
+    TS_EXCLUSIVE, assumption="HostBlockPool.alloc-disjoint")
+register_pool_index_source(
+    "host_indices",
+    "host-deduplicated admission targets (prompt-entry slots fed per "
+    "admission): the scheduler feeds pairwise-distinct FRESH entries "
+    "(PromptPrefixCache.acquire_fresh, refcount==1 at write time) "
+    "with padded rows aimed at the dustbin entry",
+    TS_EXCLUSIVE, assumption="PromptPrefixCache.fresh-exclusive")
+register_pool_index_source(
+    "prompt_entry_ref",
+    "per-lane prompt-entry refs: entries are REFCOUNTED across lanes "
+    "with identical prompts (refcount may exceed 1), so these "
+    "indices certify reads only — a write through them is the "
+    "write-while-shared COW violation PTA192 rejects",
+    TS_SHARED)
+register_pool_index_source(
+    "lane_active",
+    "per-lane active mask (0/1 by the slot-state contract): the gate "
+    "a block-table pool write must carry so idle/dustbin/paused "
+    "lanes write nothing",
+    TS_GATE, indicator=True)
+
+
+@dataclass(frozen=True)
+class ProvFact:
+    """Symbolic provenance of one value, as an index candidate.
+
+    ``tags``: ownership-source tags the value derives from (sorted).
+    ``const``: every contribution is a trace-time constant.
+    ``indicator``: values provably in {0, 1} (comparison mints, the
+    active mask, products of indicators).
+    ``onehot``: an indicator with AT MOST ONE nonzero in each
+    leading-index row's trailing block — ``oh_tail`` records HOW MANY
+    trailing axes that block spans (1 at the `equal`-against-a-
+    distinct-`range` mint; a last-axis-splitting reshape widens it).
+    The extent is load-bearing: a reshape that folds leading axes
+    into the block, a concat along it, or a reduce outside it breaks
+    the property, and the rules must drop the flag there rather than
+    certify a lying bound downstream.
+    ``selection``: product of a bounded value with a one-hot — a
+    reduce over the one-hot's trailing block picks at most one
+    entry, so tags/bound survive the sum (``oh_tail`` carries the
+    selector's block extent through to the reduce).
+    ``distinct``: constant with pairwise-distinct entries (range /
+    arange mints) — the operand that makes an `equal` one-hot.
+    ``bound``: exclusive upper bound on the (integer) values when
+    provable; None = unbounded/unknown.
+    ``nonneg``: values provably >= 0. Mints of negative constants
+    produce NO fact at all; this flag exists because subtraction can
+    turn a non-negative fact negative, and the sub/mul/scale bound
+    arithmetic is only sound over non-negative operands — a rule
+    must consult it before reusing a bound (ownership_rules.py).
+    ``chain``: mint-site + transform anchors (capped) — the
+    provenance chain PTA190 prints on a failed proof.
+
+    Reference counterpart: none — the reference's allocator safety
+    was runtime Scope/memory asserts (reference framework/scope.cc);
+    a static provenance fact has nothing to mirror there.
+    """
+    tags: Tuple[str, ...] = ()
+    const: bool = False
+    indicator: bool = False
+    onehot: bool = False
+    selection: bool = False
+    distinct: bool = False
+    bound: Optional[int] = None
+    nonneg: bool = True
+    oh_tail: int = 0
+    chain: Tuple[str, ...] = ()
+
+    def with_step(self, anchor: str, **changes) -> "ProvFact":
+        chain = self.chain if len(self.chain) >= 8 \
+            else self.chain + (anchor,)
+        return ProvFact(**{**self.__dict__, **changes,
+                           "chain": chain})
+
+    def typestates(self) -> Tuple[str, ...]:
+        return tuple(sorted({
+            _OWNERSHIP_SOURCES[t].typestate for t in self.tags
+            if t in _OWNERSHIP_SOURCES}))
+
+    def describe(self) -> str:
+        bits = []
+        if self.tags:
+            bits.append("tags=" + ",".join(self.tags))
+        if self.const:
+            bits.append("const")
+        if self.onehot:
+            bits.append("one-hot")
+        elif self.indicator:
+            bits.append("indicator")
+        if self.bound is not None:
+            bits.append(f"bound<{self.bound}")
+        return "{" + " ".join(bits or ["unknown"]) + "}"
+
+
+def prov_join(a: ProvFact, b: ProvFact) -> ProvFact:
+    """Join of two writers of one name: union the tags, keep a
+    property only when BOTH sides have it, weaken the bound to the
+    larger one (None wins — unbounded).
+
+    Reference counterpart: none — standard dataflow lattice join
+    (see ProvFact)."""
+    bound = None
+    if a.bound is not None and b.bound is not None:
+        bound = max(a.bound, b.bound)
+    lead = a if a.chain else b
+    both_oh = a.onehot and b.onehot
+    both_sel = a.selection and b.selection
+    # the larger trailing block is the STRONGER claim; the join
+    # keeps the weaker (smaller) one
+    tail = min(a.oh_tail, b.oh_tail) if (both_oh or both_sel) else 0
+    return ProvFact(tuple(sorted(set(a.tags) | set(b.tags))),
+                    a.const and b.const,
+                    a.indicator and b.indicator,
+                    both_oh, both_sel,
+                    a.distinct and b.distinct,
+                    bound, a.nonneg and b.nonneg, tail, lead.chain)
+
+
+@dataclass(frozen=True)
+class PoolAccess:
+    """One read/write of a ``@POOL`` persistable, with the resolved
+    index/gate provenance — the record PTA190/191/192 judge.
+    ``axis_size`` is the extent of the indexed leading axis (the
+    flattened cell count for a write, the gathered view's first dim
+    for a read) when statically known — the in-bounds half of
+    PTA190's proof compares the index fact's bound against it.
+
+    Reference counterpart: none — the closest thing in the
+    reference is the runtime bounds assert inside each kernel
+    (reference operators/gather_op.h); a build-time access record
+    has no analogue."""
+    site: "OpSite"
+    guards: tuple
+    kind: str                       # "read" | "write"
+    pool: str                       # the @POOL var name
+    index_var: Optional[str]
+    index_fact: Optional[ProvFact]
+    gate_var: Optional[str] = None
+    gate_fact: Optional[ProvFact] = None
+    axis_size: Optional[int] = None
+
+
 def _producer_op(var) -> Optional[Operator]:
     """Most recent op writing `var` (searched from the var's program,
     current block first — the helper is called right after the layer
@@ -419,6 +674,52 @@ def mark_sharded(var, axes) -> None:
         blk.program._version += 1
 
 
+def mark_pool_index_source(var, tag: str,
+                           bound: Optional[int] = None) -> None:
+    """Build-time annotation: mark `var` as a HOST-OWNED pool-index
+    source of family `tag` (must be in the registered ownership seed
+    table). The ownership domain seeds its provenance facts from
+    these marks; an index reaching a ``@POOL`` access whose
+    provenance does not chain to a marked source (or a trace-time
+    constant) is a PTA190 error with the chain printed.
+
+    `bound` is the host invariant's exclusive upper bound on the
+    minted values (a block-table entry < n_blocks, a prompt ref <=
+    the dustbin entry): it feeds the in-bounds half of PTA190 through
+    the affine composition rules.
+
+    Like ``mark_sharded``, the annotation rides the producer op when
+    one exists AND the Variable itself — fed tables and persistable
+    scope state have no producer in a step program, yet host-written
+    tables are precisely the ownership entry point.
+
+    Reference counterpart: none (see OwnershipSource) — the
+    reference's allocator checks are runtime-only.
+    """
+    if tag not in _OWNERSHIP_SOURCES:
+        raise ValueError(
+            f"unknown ownership source {tag!r}; register it first "
+            f"(absint.register_pool_index_source) — known: "
+            f"{sorted(_OWNERSHIP_SOURCES)}")
+    op = _producer_op(var)
+    if op is None and getattr(var, "block", None) is None:
+        raise ValueError(
+            f"mark_pool_index_source: {getattr(var, 'name', var)!r} "
+            f"has neither a producer op nor a Variable to seed — "
+            f"pass the Variable object")
+    if op is not None:
+        op.attrs[OWNERSHIP_ATTR] = tag
+        if bound is not None:
+            op.attrs[OWNERSHIP_BOUND_ATTR] = int(bound)
+    if getattr(var, "block", None) is not None:
+        var._ownership_tag = tag
+        var._ownership_bound = int(bound) if bound is not None \
+            else None
+    blk = getattr(var, "block", None)
+    if blk is not None and blk.program is not None:
+        blk.program._version += 1
+
+
 # --- facts ------------------------------------------------------------------
 @dataclass(frozen=True)
 class ValueFact:
@@ -483,6 +784,9 @@ class ProgramFacts:
     # sharding-implied collectives/reshards, in walk order
     collective_events: List[EventSite] = field(default_factory=list)
     mesh: Optional[MeshConfig] = None
+    # --- the ownership domain ---
+    prov: Dict[str, ProvFact] = field(default_factory=dict)
+    pool_accesses: List[PoolAccess] = field(default_factory=list)
 
     def value(self, name: str) -> ValueFact:
         return self.values.get(name, ValueFact(REPLICATED))
@@ -548,6 +852,93 @@ class ProgramFacts:
     def unproven(self, guards: Tuple[GuardFact, ...]) -> bool:
         return any(g.fact in (VARYING, UNKNOWN) for g in guards)
 
+    # --- the ownership surface -----------------------------------------
+    def prov_of(self, name: str) -> Optional[ProvFact]:
+        return self.prov.get(name)
+
+    def ownership_ledger(self) -> dict:
+        """The assumptions/obligations ledger of this program's pool
+        accesses: which NAMED host-allocator invariants the proofs
+        rest on (with site counts), how many accesses the domain
+        proved, and which remain unproven — the CLI's --json
+        ownership surface and the CI baseline's raw material."""
+        assumptions: Dict[str, int] = {}
+        obligations: Dict[str, int] = {}
+        proven_w = proven_r = unproven = 0
+        for acc in self.pool_accesses:
+            fact = acc.index_fact
+            tags = fact.tags if fact is not None else ()
+            ok = fact is not None and (
+                fact.const or (tags and all(
+                    t in _OWNERSHIP_SOURCES for t in tags)))
+            if not ok:
+                unproven += 1
+                continue
+            if acc.kind == "write":
+                proven_w += 1
+            else:
+                proven_r += 1
+            for t in tags:
+                src = _OWNERSHIP_SOURCES[t]
+                if src.assumption:
+                    assumptions[src.assumption] = \
+                        assumptions.get(src.assumption, 0) + 1
+            if acc.kind == "write" and acc.gate_fact is not None \
+                    and any(_OWNERSHIP_SOURCES.get(t) is not None
+                            and _OWNERSHIP_SOURCES[t].typestate
+                            == TS_GATE
+                            for t in acc.gate_fact.tags):
+                obligations["gate=lane_active"] = \
+                    obligations.get("gate=lane_active", 0) + 1
+        return {"assumptions": assumptions,
+                "obligations": obligations,
+                "proven_writes": proven_w, "proven_reads": proven_r,
+                "unproven": unproven}
+
+    def stable_ownership_facts(self) -> Dict[str, str]:
+        """Per-pool access summary over STABLE names (the pools are
+        persistables), for the CI baseline's drift-gated
+        ``ownership_facts`` section: a provenance-rule or annotation
+        change that silently re-derives a pool access shows up as a
+        value diff, exactly like ``sharding_facts``."""
+        per_pool: Dict[str, Dict[str, set]] = {}
+        for acc in self.pool_accesses:
+            slot = per_pool.setdefault(acc.pool,
+                                       {"read": set(), "write": set()})
+            fact = acc.index_fact
+            if fact is None:
+                desc = "unknown"
+            elif fact.tags:
+                parts = []
+                for t in fact.tags:
+                    src = _OWNERSHIP_SOURCES.get(t)
+                    if src is not None and src.assumption:
+                        parts.append(f"{t}⊢{src.assumption}")
+                    else:
+                        parts.append(t)
+                desc = ",".join(parts)
+            elif fact.const:
+                desc = "const"
+            else:
+                desc = "unknown"
+            if acc.kind == "write" and acc.gate_fact is not None \
+                    and acc.gate_fact.tags:
+                desc += f" gate={','.join(acc.gate_fact.tags)}"
+            slot[acc.kind].add(desc)
+        out = {}
+        for pool, kinds in per_pool.items():
+            bits = []
+            for kind in ("write", "read"):
+                if kinds[kind]:
+                    bits.append(
+                        f"{kind}s[{';'.join(sorted(kinds[kind]))}]")
+            out[pool] = " ".join(bits)
+        ledger = self.ownership_ledger()
+        if out and ledger["assumptions"]:
+            out["@assumptions"] = ",".join(
+                sorted(ledger["assumptions"]))
+        return out
+
 
 # container op type -> input slot holding the branch predicate
 # (mirrors checkers.DIVERGENT_CONTAINERS; the kernels are in
@@ -583,6 +974,17 @@ class _Interp:
         self.specs: Dict[str, ShardSpec] = {}
         self.events: List[EventSite] = []
         self._top_warned: set = set()
+        # --- the ownership domain ---
+        self.prov: Dict[str, ProvFact] = {}
+        self.pool_accesses: List[PoolAccess] = []
+        # pool VIEWS: names that alias a @POOL var through pure
+        # view ops (reshape/transpose/...) — a gather off one is a
+        # pool READ whose index PTA190 must judge
+        self.pool_views: Dict[str, str] = {}
+        # var-level ownership pins (mark_pool_index_source on fed/
+        # persistable tables): the annotation HOLDS — in-program
+        # writers (the active mask's RMW update) never weaken it
+        self.prov_pins: Dict[str, ProvFact] = {}
         # spec pins: var-level annotations (mark_sharded on feeds /
         # parameters / state) plus op-level dim annotations — the
         # with_sharding_constraint analogue: the annotated name HOLDS
@@ -591,6 +993,13 @@ class _Interp:
         self.pins: Dict[str, ShardSpec] = {}
         for blk, _ in iter_blocks(program):
             for name, var in blk.vars.items():
+                tag = getattr(var, "_ownership_tag", None)
+                if tag is not None and tag in _OWNERSHIP_SOURCES:
+                    src = _OWNERSHIP_SOURCES[tag]
+                    self.prov_pins[name] = ProvFact(
+                        tags=(tag,), indicator=src.indicator,
+                        bound=getattr(var, "_ownership_bound", None),
+                        chain=(f"{tag} mark on {name!r}",))
                 dims = getattr(var, "_sharding_dims", None)
                 axes = getattr(var, "_sharding_axes", None)
                 if dims is not None:
@@ -615,6 +1024,7 @@ class _Interp:
     def run(self) -> ProgramFacts:
         # rule families register at first use (import side effect),
         # mirroring how kernels register at ops/ import
+        from . import ownership_rules  # noqa: F401
         from . import sharding_rules  # noqa: F401
 
         iters = 0
@@ -624,18 +1034,24 @@ class _Interp:
             self.guards.clear()
             self.sites = []
             self.events = []
+            self.pool_accesses = []
+            self.pool_views = {}
             for blk, container in self._top_blocks():
                 self._walk(blk, container, ())
             if not self.changed:
                 converged = True
                 break
+        prov = dict(self.prov)
+        prov.update(self.prov_pins)   # pins win (the annotation HOLDS)
         facts = ProgramFacts(self.program, dict(self.values),
                              dict(self.guards), list(self.sites),
                              iterations=iters, converged=converged,
                              specs=dict(self.specs),
                              pinned=dict(self.pins),
                              collective_events=list(self.events),
-                             mesh=self.mesh)
+                             mesh=self.mesh,
+                             prov=prov,
+                             pool_accesses=list(self.pool_accesses))
         return facts
 
     def _top_blocks(self):
@@ -791,6 +1207,155 @@ class _Interp:
             if n != EMPTY_VAR:
                 self._set_spec(n, out, site, guards)
 
+    # --- the ownership (index-provenance) transfer ----------------------
+    # ops whose output still EXPOSES the pool's cells to a downstream
+    # gather (value-preserving views and per-element copies): a miss
+    # here would let a pool read escape PTA190 silently, so the set
+    # over-approximates — slice/split narrow but still alias pool
+    # rows, cast copies values 1:1
+    _VIEW_OPS = frozenset({
+        "reshape", "reshape2", "transpose", "transpose2",
+        "unsqueeze", "unsqueeze2", "squeeze", "squeeze2",
+        "slice", "split", "cast",
+    })
+
+    def _prov_of(self, name: str) -> Optional[ProvFact]:
+        got = self.prov_pins.get(name)
+        if got is not None:
+            return got
+        return self.prov.get(name)
+
+    def _set_prov(self, name: str, fact: Optional[ProvFact]) -> None:
+        if fact is None or name in self.prov_pins:
+            return
+        old = self.prov.get(name)
+        new = fact if old is None else prov_join(old, fact)
+        if old is not None and new.bound is not None and \
+                (old.bound is None or new.bound > old.bound):
+            # WIDENING: the bound lattice has infinite ascending
+            # chains (a const-seeded RMW counter — assign(add(cnt,
+            # 1), output=cnt) in a While — grows its bound by 1
+            # every fixpoint iteration, to non-convergence at
+            # _MAX_ITERS and a silently-disabled prover). A join
+            # that GROWS an existing bound jumps straight to
+            # unbounded; single-writer straight-line chains never
+            # re-join and keep their exact bounds.
+            new = ProvFact(new.tags, new.const, new.indicator,
+                           new.onehot, new.selection, new.distinct,
+                           None, new.nonneg, new.oh_tail, new.chain)
+        if old != new:
+            self.prov[name] = new
+            self.changed = True
+
+    def _is_pool(self, name: str, blk: Block) -> bool:
+        if POOL_MARK not in name:
+            return False
+        var = blk._find_var_recursive(name)
+        return var is None or bool(var.persistable)
+
+    def _transfer_prov(self, op: Operator, blk: Block, site: OpSite,
+                       guards) -> None:
+        from ..core.registry import get_index_rule
+
+        # mint site: a mark_pool_index_source'd producer
+        tag = op.attrs.get(OWNERSHIP_ATTR)
+        if isinstance(tag, str) and tag in _OWNERSHIP_SOURCES:
+            src = _OWNERSHIP_SOURCES[tag]
+            fact = ProvFact(
+                tags=(tag,), indicator=src.indicator,
+                bound=op.attrs.get(OWNERSHIP_BOUND_ATTR),
+                chain=(f"{tag} mint at {site.anchor()}",))
+            for n in op.output_arg_names:
+                if n != EMPTY_VAR:
+                    self._set_prov(n, fact)
+            return
+        rule = get_index_rule(op.type)
+        if rule is not None:
+            def shape_of(name):
+                var = blk._find_var_recursive(name) \
+                    if blk is not None else None
+                if var is None or var.shape is None:
+                    return None
+                return tuple(var.shape)
+
+            out = rule(op, self._prov_of, shape_of)
+            for n, f in out.items():
+                self._set_prov(n, f)
+        # an op without a rule propagates NO provenance: its outputs
+        # reach a @POOL access as unknown and PTA190 rejects there
+
+    def _record_pool_access(self, op: Operator, blk: Block,
+                            site: OpSite, guards) -> None:
+        def _first(slot):
+            names = op.inputs.get(slot) or []
+            return names[0] if names and names[0] != EMPTY_VAR \
+                else None
+
+        if op.type == "masked_pool_write":
+            pools = [n for n in op.output_arg_names
+                     if self._is_pool(n, blk)]
+            idx = _first("Index")
+            gate = _first("Gate")
+            for pool in pools:
+                cells = None
+                var = blk._find_var_recursive(pool)
+                lead = op.attrs.get("leading_dims", 1)
+                if var is not None and var.shape is not None and \
+                        isinstance(lead, int) and \
+                        0 < lead <= len(var.shape) and all(
+                            d is not None and d >= 0
+                            for d in var.shape[:lead]):
+                    cells = 1
+                    for d in var.shape[:lead]:
+                        cells *= int(d)
+                self.pool_accesses.append(PoolAccess(
+                    site, guards, "write", pool, idx,
+                    self._prov_of(idx) if idx else None, gate,
+                    self._prov_of(gate) if gate else None,
+                    axis_size=cells))
+            return
+        # any OTHER writer of a pool var (container ops surface their
+        # sub-blocks' writes and are judged at the inner site)
+        for n in op.output_arg_names:
+            if self._is_pool(n, blk):
+                self.pool_accesses.append(PoolAccess(
+                    site, guards, "write", n, None, None))
+        # view tracking + gather reads
+        if op.type in self._VIEW_OPS:
+            roots = [self.pool_views.get(n) or
+                     (n if self._is_pool(n, blk) else None)
+                     for n in op.input_arg_names if n != EMPTY_VAR]
+            root = next((r for r in roots if r is not None), None)
+            if root is not None:
+                for n in op.output_arg_names:
+                    if n != EMPTY_VAR:
+                        self.pool_views[n] = root
+            return
+        if op.type in ("gather", "gather_nd"):
+            x = _first("X")
+            root = self.pool_views.get(x) if x else None
+            if root is None and x and self._is_pool(x, blk):
+                root = x
+            if root is not None:
+                idx = _first("Index")
+                axis = None
+                # gather_nd's last-axis index COMPONENTS address
+                # multiple leading axes of X — a single scalar bound
+                # cannot be compared against shape[0] (falsely
+                # flags correct programs AND falsely passes a
+                # too-big trailing component), so its axis stays
+                # unknown and only provenance is judged
+                xvar = blk._find_var_recursive(x) \
+                    if x is not None else None
+                if op.type == "gather" and xvar is not None and \
+                        xvar.shape and xvar.shape[0] is not None \
+                        and xvar.shape[0] >= 0:
+                    axis = int(xvar.shape[0])
+                self.pool_accesses.append(PoolAccess(
+                    site, guards, "read", root, idx,
+                    self._prov_of(idx) if idx else None,
+                    axis_size=axis))
+
     def _walk(self, blk: Block, container: Optional[Operator],
               guard_stack: Tuple[GuardFact, ...]):
         for i, op in enumerate(blk.ops):
@@ -802,9 +1367,13 @@ class _Interp:
             for n in op.output_arg_names:
                 if n != EMPTY_VAR:
                     self._set(n, out_fact)
+            subs = list(iter_sub_blocks(op))
             if op.type not in ("feed", "fetch"):
                 self._transfer_specs(op, blk, site, guard_stack)
-            subs = list(iter_sub_blocks(op))
+                if not subs:
+                    self._transfer_prov(op, blk, site, guard_stack)
+                    self._record_pool_access(op, blk, site,
+                                             guard_stack)
             if not subs:
                 continue
             inner = guard_stack

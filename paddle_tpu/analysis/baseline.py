@@ -54,6 +54,15 @@ class TargetReport:
     # stable_sharding_facts): var -> spec description; feeds the
     # baseline's drift-gated `sharding_facts` section
     sharding: Dict[str, str] = field(default_factory=dict)
+    # stable pool-ownership snapshot (absint stable_ownership_facts):
+    # pool var -> proven access summary (+ the '@assumptions' roll-up
+    # of named host-allocator invariants the proofs rest on); feeds
+    # the baseline's drift-gated `ownership_facts` section
+    ownership: Dict[str, str] = field(default_factory=dict)
+    # the per-target assumptions/obligations ledger (absint
+    # ownership_ledger): the CLI --json surface, never baselined raw
+    # (site counts churn with op-count tweaks; the FACTS above gate)
+    ownership_ledger: dict = field(default_factory=dict)
     # static per-device memory plan (analysis/memplan.MemoryPlan);
     # filled only when collect_reports(with_plans=True) — the CLI's
     # --memory-plan surface
@@ -94,6 +103,8 @@ def collect_reports(include_benchmark: bool = True,
                 collect_timings=collect_timings)
             facts = absint.analyze(prog)
             rep.sharding = facts.stable_sharding_facts()
+            rep.ownership = facts.stable_ownership_facts()
+            rep.ownership_ledger = facts.ownership_ledger()
             if with_plans:
                 try:
                     rep.plan = facts.device_memory_plan()
@@ -121,16 +132,21 @@ def baseline_payload(reports: List[TargetReport]) -> dict:
     """The committed snapshot: gated (error/warning) finding counts
     per stable key, suppression counts, info totals (recorded for
     context, never gated — info findings are hygiene, and their
-    counts churn with every model tweak), and the zoo's propagated
+    counts churn with every model tweak), the zoo's propagated
     sharding facts (``target|var`` -> spec description, stable names
-    only — absint.stable_sharding_facts): a propagation-rule change
-    that silently re-lays-out an annotated program shows up as a
-    sharding_facts diff, drift-gated exactly like a new warning.
+    only — absint.stable_sharding_facts), and the zoo's pool
+    OWNERSHIP facts (``target|pool`` -> proven access summary with
+    the named allocator assumptions, plus a per-target
+    ``@assumptions`` roll-up — absint.stable_ownership_facts): a
+    propagation/provenance-rule change that silently re-lays-out or
+    re-derives an annotated program shows up as a facts diff,
+    drift-gated exactly like a new warning.
 
     Reference counterpart: none (see diff_against_baseline)."""
     entries: Dict[str, int] = {}
     suppressed: Dict[str, int] = {}
     sharding: Dict[str, str] = {}
+    ownership: Dict[str, str] = {}
     n_err = n_warn = n_info = 0
     for rep in reports:
         for d in rep.diagnostics:
@@ -148,11 +164,15 @@ def baseline_payload(reports: List[TargetReport]) -> dict:
             suppressed[k] = suppressed.get(k, 0) + 1
         for var, desc in rep.sharding.items():
             sharding[f"{rep.target}|{var}"] = desc
+        for var, desc in rep.ownership.items():
+            ownership[f"{rep.target}|{var}"] = desc
     return {
-        "version": 2,
+        "version": 3,
         "entries": {k: entries[k] for k in sorted(entries)},
         "suppressed": {k: suppressed[k] for k in sorted(suppressed)},
         "sharding_facts": {k: sharding[k] for k in sorted(sharding)},
+        "ownership_facts": {k: ownership[k]
+                            for k in sorted(ownership)},
         "totals": {"errors": n_err, "warnings": n_warn,
                    "infos": n_info, "targets": len(reports)},
     }
@@ -191,20 +211,23 @@ def diff_against_baseline(reports: List[TargetReport],
             have = current.get(k, 0)
             if have < n:
                 resolved.append(f"{k} (-{n - have}{tag})")
-    # sharding_facts: value-compared, not counted — a CHANGED spec is
-    # drift (a propagation-rule or annotation change re-laid-out the
-    # zoo) and fails like a new warning until the baseline refresh
-    # puts the new layout in front of a reviewer
-    current = payload["sharding_facts"]
-    base = dict(baseline.get("sharding_facts", {}))
-    for k, v in current.items():
-        if k not in base:
-            new.append(f"{k}={v} (new sharding fact)")
-        elif base[k] != v:
-            new.append(f"{k}={v} (was {base[k]}: sharding drift)")
-    for k, v in base.items():
-        if k not in current:
-            resolved.append(f"{k} (sharding fact gone)")
+    # sharding_facts / ownership_facts: value-compared, not counted —
+    # a CHANGED spec or access-proof summary is drift (a propagation
+    # rule, annotation, or provenance-rule change re-derived the
+    # zoo's layouts/proofs) and fails like a new warning until the
+    # baseline refresh puts the new facts in front of a reviewer
+    for section, what in (("sharding_facts", "sharding"),
+                          ("ownership_facts", "ownership")):
+        current = payload[section]
+        base = dict(baseline.get(section, {}))
+        for k, v in current.items():
+            if k not in base:
+                new.append(f"{k}={v} (new {what} fact)")
+            elif base[k] != v:
+                new.append(f"{k}={v} (was {base[k]}: {what} drift)")
+        for k, v in base.items():
+            if k not in current:
+                resolved.append(f"{k} ({what} fact gone)")
     return sorted(new), sorted(resolved)
 
 

@@ -70,6 +70,26 @@ a stable diagnostic code so tests/docs can reference the class:
           written: the PTA020/PTA090 lessons applied to the decode
           flight-data subsystem; a drifted counter poisons every
           stats window with no downstream error)
+  PTA190  pool-access provenance + in-bounds (the ownership domain,
+          absint ProvFact: every index reaching a @POOL read/write
+          must chain to a registered host-owned source or a
+          trace-time constant, block-table writes must be gated by
+          the lane-active mask, and the index bound must fit the
+          indexed axis — unknown provenance is ERROR with the chain
+          printed)
+  PTA191  lane-exclusive write PROVEN (given the host allocator's
+          disjoint-allocation invariant as a NAMED assumption, the
+          provenance proof shows distinct lanes' writes hit disjoint
+          rows — subsumes PTA110's syntactic declaration the way
+          PTA130 subsumed PTA010: twin-dedupe at prover-covered
+          sites, the exclusive_via declaration survives as the
+          assumption's name and must AGREE with the proven chain)
+  PTA192  read-only-while-shared (writes are only legal in the
+          exclusive typestate of the free→exclusive→shared→freed
+          block lifetime lattice: an index whose provenance chains
+          to a REFCOUNTED source — prompt_entry_ref — certifies
+          reads only; a write through it is the COW violation the
+          radix/beam prefix-sharing work must never ship)
 
 Severities: "error" = the program is wrong (strict mode raises),
 "warning" = almost certainly a bug but a legal feed/scope could save
@@ -1056,11 +1076,36 @@ def check_write_only_carry(program: Program):
 # ---------------------------------------------------------------------------
 # PTA110: shared-pool writes must be provably lane-exclusive.
 # ---------------------------------------------------------------------------
-POOL_MARK = "@POOL"
+# the pool name mark is OWNED by the ownership domain (absint) —
+# importing it keeps this sweep and the prover matching the same
+# vars (the PTA180/TEL_MARK drifted-literal lesson);
+# models/decode_engine.py re-declares the literal only because
+# analysis never imports models
+from .absint import POOL_MARK  # noqa: E402
 
 # the builder-declared reasons row indices of a shared-pool write
 # cannot alias (layers/extras.py masked_pool_write documents both)
 _POOL_EXCLUSIVE_VIA = ("block_table", "host_indices")
+
+
+def _ownership_coverage(program: Program):
+    """Op ids of the @POOL write sites the ownership prover covers
+    (every pool access absint's converged fixpoint recorded), or None
+    when the prover is unavailable for this program — the PTA110
+    declaration checker only emits at sites the prover does NOT
+    cover, so each incident surfaces exactly once, with the
+    proof-carrying PTA191/190/192 diagnostic when one exists (the
+    PTA010/PTA130 twin-dedupe pattern applied to ownership)."""
+    from . import absint
+
+    try:
+        facts = absint.analyze(program)
+    except Exception:
+        return None
+    if not facts.converged:
+        return None
+    return {id(acc.site.op) for acc in facts.pool_accesses
+            if acc.kind == "write"}
 
 
 @register_checker("PTA110", "shared-pool-write-exclusive")
@@ -1081,7 +1126,14 @@ def check_shared_pool_writes(program: Program):
     ``exclusive_via`` declaration ('block_table' = per-lane blocks
     from the host free-list, 'host_indices' = host-deduplicated
     admission targets), and — for block-table writes — an active-lane
-    ``Gate`` so idle/dustbin/paused lanes write nothing."""
+    ``Gate`` so idle/dustbin/paused lanes write nothing.
+
+    Sites the ownership prover covers are left to PTA190/191/192,
+    which carry the same ERROR stance plus the provenance PROOF —
+    this declaration checker is the fallback for programs the
+    fixpoint engine cannot analyze, so the two never double-report
+    one incident (the PTA010/PTA130 dedupe pattern)."""
+    covered = _ownership_coverage(program)
     for site in iter_ops(program):
         op = site.op
         hit = [n for n in op.output_arg_names if POOL_MARK in n]
@@ -1096,6 +1148,8 @@ def check_shared_pool_writes(program: Program):
             if op.block is not None else None
         if var is not None and not var.persistable:
             continue
+        if covered is not None and id(op) in covered:
+            continue  # the ownership prover judges this site
         name = hit[0]
         if op.type != "masked_pool_write":
             yield _diag_at(
@@ -1708,6 +1762,273 @@ def check_device_memory_budget(program: Program):
         hint="shard the largest state over a mesh axis "
              "(absint.mark_sharded with a {dim: axis} placement), "
              "shrink the geometry, or raise the budget")
+
+
+# ---------------------------------------------------------------------------
+# PTA190/PTA191/PTA192: the pool ownership & lifetime prover (the
+# ownership domain of analysis/absint.py — symbolic index provenance,
+# per-block typestates, and the host allocator's named assumptions).
+# ---------------------------------------------------------------------------
+def _chain_of(fact) -> str:
+    if fact is None or not fact.chain:
+        return "(no provenance chain: the value never passed a "\
+            "registered index rule or marked source)"
+    return " ← ".join(reversed(fact.chain))
+
+
+def _exclusive_tags(fact):
+    from . import absint
+
+    srcs = absint.pool_index_sources()
+    return [t for t in (fact.tags if fact else ())
+            if t in srcs
+            and srcs[t].typestate == absint.TS_EXCLUSIVE]
+
+
+def _shared_tags(fact):
+    from . import absint
+
+    srcs = absint.pool_index_sources()
+    return [t for t in (fact.tags if fact else ())
+            if t in srcs and srcs[t].typestate == absint.TS_SHARED]
+
+
+def _gate_ok(fact) -> bool:
+    from . import absint
+
+    srcs = absint.pool_index_sources()
+    return fact is not None and any(
+        t in srcs and srcs[t].typestate == absint.TS_GATE
+        for t in fact.tags)
+
+
+@register_checker("PTA190", "pool-access-provenance")
+def check_pool_access_provenance(program: Program):
+    """Provenance + in-bounds prover for every ``@POOL`` access the
+    ownership domain recorded (reads AND writes):
+
+    * **provenance** — the index must chain to a registered
+      host-owned source (``mark_pool_index_source``: block-table
+      feeds, host-deduplicated admission targets, refcounted prompt
+      refs) or be a trace-time constant (the dustbin row). An index
+      of UNKNOWN provenance is an ERROR with the chain printed: a
+      device-computed index nobody vouches for is exactly how a lane
+      scribbles over another request's KV with no error anywhere.
+    * **gate** — a write declared ``exclusive_via='block_table'``
+      must be gated by the lane-active mask (a gate whose provenance
+      chains to a ``lane_active``-marked source): stale table rows of
+      idle/dustbin/paused lanes address blocks other lanes now own.
+    * **in-bounds** — when the indexed axis extent is static, the
+      index fact's bound must fit it (ERROR when the bound provably
+      exceeds the axis; WARNING when no bound is derivable for a
+      READ — the write kernel clamps out-of-range rows into its
+      trash row, reads have no such net)."""
+    from . import absint
+
+    facts = absint.analyze(program)
+    if not facts.converged:
+        return  # PTA110's declaration fallback owns this program
+    for acc in facts.pool_accesses:
+        if acc.kind == "write" and acc.index_var is None:
+            continue  # direct (non-masked_pool_write) writer: PTA191
+        fact = acc.index_fact
+        if fact is None or (not fact.tags and not fact.const):
+            yield _diag_at(
+                "PTA190", ERROR, acc.site,
+                f"{acc.kind} of shared pool {acc.pool!r} through "
+                f"index {acc.index_var!r} of UNKNOWN provenance "
+                f"[{_chain_of(fact)}]: no host-owned source vouches "
+                f"for these cells", var=acc.pool,
+                hint="chain the index to a marked host table "
+                     "(absint.mark_pool_index_source) through "
+                     "registered index rules "
+                     "(analysis/ownership_rules.py), or feed "
+                     "host-deduplicated indices")
+            continue
+        if acc.kind == "write" and acc.gate_var is not None and \
+                acc.site.op.attrs.get("exclusive_via") \
+                == "block_table" and not _gate_ok(acc.gate_fact):
+            # a write with NO Gate input at all is PTA191's finding
+            # (one incident, one diagnostic); this judges only the
+            # provenance of a gate that exists
+            yield _diag_at(
+                "PTA190", ERROR, acc.site,
+                f"block-table write into {acc.pool!r} is not gated "
+                f"by the lane-active mask (gate {acc.gate_var!r}: "
+                f"{_chain_of(acc.gate_fact)}): idle/dustbin/paused "
+                f"lanes would scatter through stale table rows into "
+                f"blocks other lanes own", var=acc.pool,
+                hint="gate with the active mask "
+                     "(absint.mark_pool_index_source(active, "
+                     "'lane_active'); gate=cast(active,'float32'))")
+        if acc.axis_size is not None:
+            if fact.bound is not None and fact.bound > acc.axis_size:
+                yield _diag_at(
+                    "PTA190", ERROR, acc.site,
+                    f"{acc.kind} of pool {acc.pool!r}: index bound "
+                    f"{fact.bound} exceeds the indexed axis extent "
+                    f"{acc.axis_size} [{_chain_of(fact)}]",
+                    var=acc.pool,
+                    hint="fix the mint-site bound "
+                         "(mark_pool_index_source(..., bound=N)) or "
+                         "the addressing arithmetic")
+            elif fact.bound is None and acc.kind == "read" \
+                    and not fact.const:
+                yield _diag_at(
+                    "PTA190", WARNING, acc.site,
+                    f"read of pool {acc.pool!r}: in-bounds is "
+                    f"unprovable (no bound derivable for index "
+                    f"{acc.index_var!r} [{_chain_of(fact)}]); a "
+                    f"gather past the pool end returns clamped "
+                    f"garbage silently", var=acc.pool,
+                    hint="declare the host invariant's bound at the "
+                         "mint site: mark_pool_index_source(var, "
+                         "tag, bound=N)")
+
+
+@register_checker("PTA191", "pool-write-exclusive-proven")
+def check_pool_write_exclusive_proven(program: Program):
+    """The PROOF form of PTA110: for every shared-pool write the
+    ownership domain recorded, prove distinct lanes' writes hit
+    disjoint rows — GIVEN the host allocator's disjoint-allocation
+    invariant as a NAMED assumption (the ownership seed table entry
+    backing the index's provenance tag; property-tested in
+    tests/test_block_pool_model.py). The structural PTA110 contract
+    (one blessed writer op, read-modify-write, a declared
+    ``exclusive_via``, a Gate on block-table writes) is re-enforced
+    here so the twin-dedupe loses nothing, and the declaration is
+    UPGRADED: ``exclusive_via`` must AGREE with the provenance the
+    prover actually derived — a builder declaring 'block_table'
+    while wiring host-admission indices (or vice versa) claims an
+    invariant nobody is maintaining. Indices mixing two exclusive
+    source families are rejected: each family's disjointness is
+    per-family; their union proves nothing."""
+    from . import absint
+
+    facts = absint.analyze(program)
+    if not facts.converged:
+        return  # PTA110's declaration fallback owns this program
+    srcs = absint.pool_index_sources()
+    for acc in facts.pool_accesses:
+        if acc.kind != "write":
+            continue
+        op = acc.site.op
+        name = acc.pool
+        if op.type != "masked_pool_write":
+            yield _diag_at(
+                "PTA191", ERROR, acc.site,
+                f"op {op.type!r} writes shared block pool {name!r} "
+                f"directly; only masked_pool_write's disjoint "
+                f"one-hot scatter is provably lane-exclusive — "
+                f"anything else is the silent cross-request KV "
+                f"corruption class", var=name,
+                hint="route the write through layers.masked_pool_"
+                     "write(pool, new, index, gate, "
+                     "exclusive_via=...)")
+            continue
+        if name not in op.input_arg_names:
+            yield _diag_at(
+                "PTA191", ERROR, acc.site,
+                f"masked_pool_write writes {name!r} without reading "
+                f"it: the keep-mask read-modify-write is what "
+                f"preserves other lanes' cells (and keeps the pool "
+                f"on the state_in path — see PTA090)", var=name)
+            continue
+        via = op.attrs.get("exclusive_via")
+        if via not in _POOL_EXCLUSIVE_VIA:
+            yield _diag_at(
+                "PTA191", ERROR, acc.site,
+                f"masked_pool_write into {name!r} carries "
+                f"exclusive_via={via!r}; the builder must name the "
+                f"exclusivity assumption "
+                f"({'/'.join(_POOL_EXCLUSIVE_VIA)})", var=name)
+            continue
+        if via == "block_table" and not op.inputs.get("Gate"):
+            yield _diag_at(
+                "PTA191", ERROR, acc.site,
+                f"block-table write into {name!r} has no Gate input: "
+                f"idle/dustbin/paused lanes (active=0) would scatter "
+                f"through stale table rows into blocks other lanes "
+                f"own", var=name,
+                hint="pass gate=cast(active, 'float32')")
+            continue
+        fact = acc.index_fact
+        if fact is None or (not fact.tags and not fact.const):
+            continue  # unknown provenance: PTA190's finding
+        excl = sorted(set(_exclusive_tags(fact)))
+        if len(excl) > 1:
+            yield _diag_at(
+                "PTA191", ERROR, acc.site,
+                f"write into {name!r} mixes exclusive index "
+                f"families {excl} [{_chain_of(fact)}]: each "
+                f"family's disjointness assumption "
+                f"({', '.join(srcs[t].assumption or t for t in excl)}) "
+                f"is per-family — their union proves nothing",
+                var=name,
+                hint="derive the write index from ONE host-owned "
+                     "source family")
+            continue
+        if excl and excl[0] != via:
+            src = srcs[excl[0]]
+            yield _diag_at(
+                "PTA191", ERROR, acc.site,
+                f"write into {name!r} declares exclusive_via="
+                f"{via!r} but its index provenance chains to "
+                f"{excl[0]!r} (assumption "
+                f"{src.assumption or 'none'}) "
+                f"[{_chain_of(fact)}]: the declaration names an "
+                f"invariant nobody is maintaining for these "
+                f"indices", var=name,
+                hint="fix the declaration or the index wiring; the "
+                     "declared via must name the assumption the "
+                     "proof actually rests on")
+
+
+@register_checker("PTA192", "pool-write-while-shared")
+def check_pool_write_while_shared(program: Program):
+    """Read-only-while-shared: the per-block lifetime lattice is
+    ``free → exclusive(lane) → shared(refcount>1) → freed``, and
+    WRITES are only legal in the exclusive typestate — exactly the
+    copy-on-write contract the radix-tree/beam prefix-sharing work
+    needs (ROADMAP), landed BEFORE the feature so COW lowerings
+    build on a proven base. An index whose provenance chains to a
+    REFCOUNTED source (``prompt_entry_ref``: entries shared across
+    lanes with identical prompts) certifies reads only; a write
+    through it would mutate KV that OTHER live lanes are attending
+    to — generations stay plausible and no error ever surfaces.
+    The host half of the bargain (refcount monotonicity, no
+    free-while-shared, fresh entries exclusive at refcount==1) is
+    the property-tested allocator state machine
+    (models/decode_engine.HostBlockPool / PromptPrefixCache,
+    tests/test_block_pool_model.py)."""
+    from . import absint
+
+    facts = absint.analyze(program)
+    if not facts.converged:
+        return  # PTA110's declaration fallback owns this program
+    srcs = absint.pool_index_sources()
+    for acc in facts.pool_accesses:
+        if acc.kind != "write":
+            continue
+        shared = sorted(set(_shared_tags(acc.index_fact)))
+        if not shared:
+            continue
+        descs = "; ".join(
+            f"{t}: {srcs[t].description}" for t in shared)
+        yield _diag_at(
+            "PTA192", ERROR, acc.site,
+            f"write into shared pool {acc.pool!r} through index "
+            f"{acc.index_var!r} whose provenance chains to "
+            f"REFCOUNTED (shared-typestate) source(s) {shared} "
+            f"[{_chain_of(acc.index_fact)}]: writes are only legal "
+            f"in the exclusive typestate (refcount==1) — this is "
+            f"the write-while-shared COW violation ({descs})",
+            var=acc.pool,
+            hint="copy-on-write first: acquire a FRESH entry "
+                 "(PromptPrefixCache.acquire_fresh, refcount==1), "
+                 "write through its host-fed index "
+                 "(exclusive_via='host_indices'), and repoint the "
+                 "lane's ref after the copy")
 
 
 # ---------------------------------------------------------------------------

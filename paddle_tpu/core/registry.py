@@ -106,6 +106,52 @@ def sharding_rule_types() -> List[str]:
     return sorted(_SHARDING_RULES)
 
 
+# op type -> pool-index PROVENANCE rule for the analysis layer's
+# ownership domain (analysis/absint.py). A rule is a PURE function
+#     rule(op, prov_of, shape_of) -> {out_name: ProvFact}
+# over Program metadata: it states how the op carries symbolic
+# provenance of pool indices (host-owned table tags, trace-time
+# constants, 0/1 indicators, value bounds) from inputs to outputs.
+# Families live in analysis/ownership_rules.py, beside the sharding
+# families; an op WITHOUT a rule propagates NO provenance, so an
+# index that flows through it reaches a @POOL access with UNKNOWN
+# provenance and PTA190 rejects the access — imprecision is a loud
+# error at the one place it matters, never a silent pass.
+_INDEX_RULES: Dict[str, Callable] = {}
+
+
+def register_index_rule(op_types, fn: Optional[Callable] = None):
+    """Register a pool-index provenance rule for one op type or a
+    family (mirrors register_sharding_rule; usable as a decorator).
+
+    Reference counterpart: none — the reference checks allocator
+    state at RUNTIME (reference framework/scope.cc Var lookups); a
+    compile-time index-provenance algebra is the shared-pool-era
+    capability the whole-block-jit serving path needs instead.
+    """
+    if isinstance(op_types, str):
+        op_types = (op_types,)
+
+    def deco(f):
+        for t in op_types:
+            _INDEX_RULES[t] = f
+        return f
+
+    return deco(fn) if fn is not None else deco
+
+
+def get_index_rule(op_type: str) -> Optional[Callable]:
+    return _INDEX_RULES.get(op_type)
+
+
+def has_index_rule(op_type: str) -> bool:
+    return op_type in _INDEX_RULES
+
+
+def index_rule_types() -> List[str]:
+    return sorted(_INDEX_RULES)
+
+
 def kernel_bridges_host(fn: Callable) -> bool:
     """True when `fn`'s code references jax's io_callback/pure_callback
     host bridges — directly, in nested functions, or through helper

@@ -1097,6 +1097,26 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             return
         layers.assign(layers.elementwise_add(var, delta), output=var)
 
+    # --- ownership mint-site annotations (analysis/absint.py seed
+    # table): every paged program declares the SAME host-owned index
+    # sources, so the ownership prover (PTA190/191/192) can chain
+    # each @POOL access back to the allocator invariant that makes it
+    # lane-exclusive. block_tab rows are disjoint per lane
+    # (HostBlockPool.alloc-disjoint, entries < NB), prompt_ref is the
+    # REFCOUNTED read path (entries <= the dustbin at E), and the
+    # active mask is the gate block-table writes must carry. ---------
+    def _mark_ownership(sv):
+        if not paged:
+            return sv
+        absint.mark_pool_index_source(
+            sv[f"{state_prefix}block_tab"], "block_table", bound=NB)
+        absint.mark_pool_index_source(
+            sv[f"{state_prefix}prompt_ref"], "prompt_entry_ref",
+            bound=E + 1)
+        absint.mark_pool_index_source(
+            sv[f"{state_prefix}active"], "lane_active")
+        return sv
+
     # --- lane-reset tail shared by every admission flavor: one-hot
     # masks over the fed slot ids, then token-buffer/counter/flag
     # resets for exactly the admitted lanes --------------------------
@@ -1285,6 +1305,11 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                             append_batch_size=False)
         pslots = layers.data("prompt_slots", shape=[A], dtype="int64",
                              append_batch_size=False)
+        # the scheduler feeds pairwise-distinct FRESH entries
+        # (refcount==1 at write time; padded rows aim at the dustbin
+        # E) — the host invariant PTA191 names in its proof
+        absint.mark_pool_index_source(pslots, "host_indices",
+                                      bound=E + 1)
         seeds = _seeds_data(A)
         for li in range(n_layers):
             kh, vh = _cross_proj(enc, li)
@@ -1337,14 +1362,16 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         st = fluid.Program()
         with fluid.program_guard(prog, st):
             admit_bodies["miss"](
-                _declare_slot_state(prog.global_block, specs), A)
+                _mark_ownership(
+                    _declare_slot_state(prog.global_block, specs)), A)
         prefills[A] = prog
         startup = startup or st
         if paged:
             hprog = fluid.Program()
             with fluid.program_guard(hprog, fluid.Program()):
                 admit_bodies["hit"](
-                    _declare_slot_state(hprog.global_block, specs), A)
+                    _mark_ownership(_declare_slot_state(
+                        hprog.global_block, specs)), A)
             hit_prefills[A] = hprog
 
     # --- the one-token step body over all lanes (shared by the
@@ -1777,7 +1804,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
     # also the Executor.prepare(steps=K) scan target) ----------------
     step_prog = fluid.Program()
     with fluid.program_guard(step_prog, fluid.Program()):
-        body(_declare_slot_state(step_prog.global_block, specs))
+        body(_mark_ownership(
+            _declare_slot_state(step_prog.global_block, specs)))
 
     # --- fused serve programs: [admission +] a decode-burst While —
     # a WHOLE scheduler cycle (admit + burst) is ONE dispatch, so the
@@ -1793,7 +1821,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
     def _build_serve(tier, A):
         prog = fluid.Program()
         with fluid.program_guard(prog, fluid.Program()):
-            sv = _declare_slot_state(prog.global_block, specs)
+            sv = _mark_ownership(
+                _declare_slot_state(prog.global_block, specs))
             if A > 0:
                 admit_bodies[tier](sv, A)
             n_steps = layers.data("n_steps", shape=[1], dtype="int64",
@@ -2044,25 +2073,71 @@ class BlockPoolExhausted(RuntimeError):
     retryable = True
 
 
+class BlockLifetimeError(ValueError):
+    """A host-allocator call violated the per-block lifetime lattice
+    ``free → exclusive(lane) → shared(refcount>1) → freed``: freeing
+    an unallocated or already-freed block, or releasing a zero-ref
+    prompt entry. NAMED (and a ValueError subclass for callers that
+    caught the old bare error) so the scheduler fails loudly at the
+    bad transition instead of silently corrupting the free list —
+    the next alloc would hand one block to TWO lanes and break the
+    very disjointness invariant the ownership prover (PTA191)
+    assumes. The full automaton is property-tested in
+    tests/test_block_pool_model.py."""
+
+
 class HostBlockPool:
-    """Free-list over the ``n_blocks`` shared self-KV blocks. Lanes
-    own disjoint block sets by construction (alloc hands a block to
-    exactly one lane until freed) — the host half of the PTA110
-    lane-exclusivity story; the device half is the act-gated
-    masked_pool_write masks."""
+    """Free-list over the ``n_blocks`` shared self-KV blocks, run as
+    an explicit TYPESTATE machine: every block is ``free`` or
+    ``exclusive`` (owned by exactly one lane between alloc and free).
+    This is the host half of the lane-exclusivity story the
+    ownership prover leans on — its alloc-disjoint invariant is the
+    NAMED assumption (``HostBlockPool.alloc-disjoint``,
+    analysis/absint.py ownership seed table) under which PTA191
+    proves distinct lanes' pool writes hit disjoint rows; the device
+    half is the act-gated masked_pool_write masks. Invalid
+    transitions raise ``BlockLifetimeError`` instead of corrupting
+    the free list (a double-freed block would be handed to two
+    lanes)."""
 
     def __init__(self, n_blocks: int):
         self.n_blocks = int(n_blocks)
         self._free = list(range(self.n_blocks))
+        self._state = ["free"] * self.n_blocks
 
     def alloc(self) -> Optional[int]:
-        return self._free.pop() if self._free else None
+        if not self._free:
+            return None
+        b = self._free.pop()
+        self._state[b] = "exclusive"
+        return b
 
     def free(self, blocks):
+        blocks = list(blocks)
+        seen = set()
         for b in blocks:
-            if not 0 <= b < self.n_blocks or b in self._free:
-                raise ValueError(f"bad free of block {b}")
+            if not 0 <= b < self.n_blocks:
+                raise BlockLifetimeError(
+                    f"free of block {b} outside the pool "
+                    f"[0, {self.n_blocks})")
+            if self._state[b] != "exclusive" or b in seen:
+                raise BlockLifetimeError(
+                    f"free of block {b} in typestate "
+                    f"{'freed-in-this-call' if b in seen else self._state[b]!r} "
+                    f"(legal only from 'exclusive'): double-free/"
+                    f"unallocated free would hand one block to two "
+                    f"lanes")
+            seen.add(b)
+        for b in blocks:
+            self._state[b] = "free"
             self._free.append(b)
+
+    def typestate(self, block: int) -> str:
+        return self._state[block]
+
+    def live_blocks(self) -> set:
+        return {b for b, s in enumerate(self._state)
+                if s == "exclusive"}
 
     @property
     def free_count(self) -> int:
@@ -2160,7 +2235,40 @@ class PromptPrefixCache:
         return entry
 
     def release(self, entry: int):
-        self._refs[entry] = max(0, self._refs.get(entry, 0) - 1)
+        refs = self._refs.get(entry, 0)
+        if refs <= 0:
+            raise BlockLifetimeError(
+                f"release of prompt entry {entry} at refcount "
+                f"{refs}: refcounts are monotone within a lifetime "
+                f"(acquire+/release-) and never go negative — a "
+                f"double release would unpin an entry another lane "
+                f"still attends to")
+        self._refs[entry] = refs - 1
+
+    # --- the refcount typestate surface (the COW contract PTA192
+    # checks the device half of): free -> exclusive (refcount==1) ->
+    # shared (refcount>1) -> back; writes to an entry's KV are only
+    # legal while it is EXCLUSIVE — acquire_fresh's refcount==1
+    # window is when admission prefill writes happen, and the
+    # ``PromptPrefixCache.fresh-exclusive`` assumption PTA191 names
+    # is exactly that window's guarantee. ----------------------------
+    def refcount(self, entry: int) -> int:
+        return self._refs.get(entry, 0)
+
+    def is_shared(self, entry: int) -> bool:
+        return self.refcount(entry) > 1
+
+    def writable(self, entry: int) -> bool:
+        """True while a write to the entry's pooled KV is legal:
+        refcount <= 1 (nobody else attends to it). A COW lowering
+        must check this (or copy to a fresh entry) before mutating."""
+        return self.refcount(entry) <= 1
+
+    def typestate(self, entry: int) -> str:
+        refs = self.refcount(entry)
+        if refs == 0:
+            return "free"
+        return "exclusive" if refs == 1 else "shared"
 
     @property
     def in_use(self) -> int:
@@ -2169,7 +2277,8 @@ class PromptPrefixCache:
 
 __all__ = ["CacheConfig", "SamplingConfig", "DraftConfig",
            "DecodeStepBundle", "DECODE_STEPS_VAR",
-           "POOL_MARK", "BlockPoolExhausted", "HostBlockPool",
+           "POOL_MARK", "BlockPoolExhausted", "BlockLifetimeError",
+           "HostBlockPool",
            "PromptPrefixCache", "build_greedy_decode_program",
            "build_incremental_decode_program",
            "build_decode_step_program", "build_beam_decode_program",

@@ -44,6 +44,17 @@ def masked_pool_write(ctx):
     exclusivity contract the two lowerings are identical — aliased
     gated rows are the corruption class the host allocator + PTA110
     exclude, not something either lowering can repair).
+
+    Since the ownership prover landed, ``exclusive_via`` is more
+    than a declaration: the abstract interpreter (analysis/absint.py
+    ownership domain) chains the Index input's provenance back to a
+    marked host-owned source and PTA191 PROVES lane-exclusivity
+    under that source's named allocator assumption — a via that
+    disagrees with the proven chain, an index of unknown provenance
+    (PTA190), or an index reaching a REFCOUNTED shared entry
+    (PTA192 write-while-shared, the COW contract) are build-time
+    errors. The trash-row clamp covers out-of-range WRITES; reads
+    have no such net, which is why PTA190 also proves gather bounds.
     """
     pool = ctx.input("Pool")
     new = ctx.input("New")

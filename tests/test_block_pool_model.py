@@ -1,0 +1,181 @@
+"""Property tests for the host allocator automaton
+(models/decode_engine.HostBlockPool / PromptPrefixCache).
+
+These classes are the HOST half of the pool-ownership bargain: the
+ownership prover (PTA190/191/192, analysis/absint.py) proves device
+programs lane-exclusive GIVEN the named invariants below, so the
+invariants themselves must be machine-checked, not folklore:
+
+* ``HostBlockPool.alloc-disjoint`` — a block is owned by exactly one
+  lane between alloc and free: randomized alloc/free traces never
+  yield overlapping live blocks, and bad lifetime transitions
+  (double free, free of unallocated, out-of-range) raise the NAMED
+  ``BlockLifetimeError`` instead of corrupting the free list;
+* ``PromptPrefixCache.fresh-exclusive`` — a fresh entry starts at
+  refcount 1 (the exclusive write window admission prefill uses);
+  refcounts stay >= 0 (release below zero raises), shared entries
+  (refcount > 1) are never ``writable``, and LRU eviction only ever
+  touches UNPINNED entries (refcount == 0).
+
+Plain ``random`` with fixed seeds — deterministic, no external
+property-testing dependency."""
+import random
+
+import pytest
+
+from paddle_tpu.models.decode_engine import (BlockLifetimeError,
+                                             HostBlockPool,
+                                             PromptPrefixCache)
+
+
+class TestHostBlockPoolModel:
+    def test_random_traces_keep_live_blocks_disjoint(self):
+        for seed in range(8):
+            rng = random.Random(1000 + seed)
+            pool = HostBlockPool(rng.randint(1, 24))
+            owned = {}          # lane -> set of blocks
+            for _ in range(400):
+                lane = rng.randrange(6)
+                mine = owned.setdefault(lane, set())
+                if rng.random() < 0.55:
+                    b = pool.alloc()
+                    if b is None:
+                        assert pool.free_count == 0
+                        continue
+                    # alloc-disjoint: the block is live for NOBODY
+                    for other, blocks in owned.items():
+                        assert b not in blocks, (seed, lane, other)
+                    mine.add(b)
+                elif mine:
+                    take = rng.sample(sorted(mine),
+                                      rng.randint(1, len(mine)))
+                    pool.free(take)
+                    mine.difference_update(take)
+                # global invariants after every step
+                live = set().union(*owned.values()) if owned else set()
+                assert pool.live_blocks() == live
+                assert pool.in_use == len(live)
+                assert pool.free_count + pool.in_use == pool.n_blocks
+
+    def test_double_free_raises_named_error(self):
+        pool = HostBlockPool(4)
+        b = pool.alloc()
+        pool.free([b])
+        with pytest.raises(BlockLifetimeError, match="typestate"):
+            pool.free([b])
+
+    def test_free_of_unallocated_raises_named_error(self):
+        # the satellite regression: this used to corrupt the free
+        # list (the next alloc would hand one block to two lanes)
+        pool = HostBlockPool(4)
+        with pytest.raises(BlockLifetimeError):
+            pool.free([2])
+        with pytest.raises(BlockLifetimeError, match="outside"):
+            pool.free([99])
+        # a refused free leaves the pool consistent
+        assert pool.free_count == 4 and pool.in_use == 0
+
+    def test_failed_free_is_atomic(self):
+        pool = HostBlockPool(4)
+        a, b = pool.alloc(), pool.alloc()
+        with pytest.raises(BlockLifetimeError):
+            pool.free([a, a])   # second entry is a double free
+        # NOTHING was freed: validation precedes mutation
+        assert pool.typestate(a) == "exclusive"
+        assert pool.typestate(b) == "exclusive"
+        assert pool.free_count == 2
+        pool.free([a, b])
+        assert pool.free_count == 4
+
+    def test_typestate_surface(self):
+        pool = HostBlockPool(2)
+        b = pool.alloc()
+        assert pool.typestate(b) == "exclusive"
+        pool.free([b])
+        assert pool.typestate(b) == "free"
+
+
+class TestPromptPrefixCacheModel:
+    def _prompt(self, rng):
+        return tuple(rng.randrange(50) for _ in range(4))
+
+    def test_random_traces_keep_refcounts_and_eviction_legal(self):
+        for seed in range(8):
+            rng = random.Random(2000 + seed)
+            pc = PromptPrefixCache(rng.randint(1, 6), chunk_tokens=2)
+            refs = {}           # entry -> model refcount
+            prompts = [self._prompt(rng) for _ in range(8)]
+            for _ in range(300):
+                p = rng.choice(prompts)
+                r = rng.random()
+                tier, entry = pc.lookup(p)
+                if r < 0.5:
+                    if tier == "hit":
+                        e = pc.acquire_hit(p)
+                        refs[e] = refs.get(e, 0) + 1
+                    else:
+                        before = dict(refs)
+                        e = pc.acquire_fresh(p, partial=(
+                            tier == "partial"))
+                        if e is None:
+                            # every entry pinned: nothing evictable
+                            assert all(v > 0 for v in before.values())
+                            assert len(before) >= pc.n_entries
+                            continue
+                        # fresh-exclusive: the entry was NOT live
+                        # (eviction only touches unpinned entries)
+                        assert before.get(e, 0) == 0, (seed, e)
+                        refs[e] = 1
+                        assert pc.refcount(e) == 1
+                        assert pc.writable(e)
+                        assert pc.typestate(e) == "exclusive"
+                else:
+                    live = [e for e, v in refs.items() if v > 0]
+                    if live:
+                        e = rng.choice(live)
+                        pc.release(e)
+                        refs[e] -= 1
+                # invariants after every step
+                for e, v in refs.items():
+                    assert pc.refcount(e) == v and v >= 0
+                    assert pc.is_shared(e) == (v > 1)
+                    assert pc.writable(e) == (v <= 1)
+                assert pc.in_use == sum(1 for v in refs.values()
+                                        if v > 0)
+                assert pc.in_use <= pc.n_entries
+
+    def test_release_below_zero_raises_named_error(self):
+        pc = PromptPrefixCache(2, chunk_tokens=2)
+        e = pc.acquire_fresh((1, 2, 3))
+        pc.release(e)
+        with pytest.raises(BlockLifetimeError, match="refcount"):
+            pc.release(e)
+
+    def test_shared_entry_is_not_writable(self):
+        # the host half of PTA192's read-only-while-shared: two lanes
+        # share one prompt entry -> refcount 2 -> not writable; after
+        # one release it returns to the exclusive (COW-legal) state
+        pc = PromptPrefixCache(2, chunk_tokens=2)
+        p = (5, 5, 5)
+        e = pc.acquire_fresh(p)
+        assert pc.typestate(e) == "exclusive" and pc.writable(e)
+        assert pc.acquire_hit(p) == e
+        assert pc.typestate(e) == "shared"
+        assert pc.is_shared(e) and not pc.writable(e)
+        pc.release(e)
+        assert pc.typestate(e) == "exclusive" and pc.writable(e)
+
+    def test_eviction_only_touches_unpinned(self):
+        pc = PromptPrefixCache(2, chunk_tokens=2)
+        p1, p2, p3 = (1, 1), (2, 2), (3, 3)
+        e1 = pc.acquire_fresh(p1)
+        e2 = pc.acquire_fresh(p2)
+        # both pinned: a miss has nothing to evict
+        assert pc.acquire_fresh(p3) is None
+        pc.release(e1)
+        # p1 now unpinned: it is the only legal victim
+        e3 = pc.acquire_fresh(p3)
+        assert e3 == e1 and pc.evictions == 1
+        assert pc.lookup(p1) == ("miss", None)
+        assert pc.lookup(p2)[0] == "hit"
+        assert pc.refcount(e2) == 1

@@ -467,7 +467,14 @@ class TestRunStepsDispatchWin:
         """The CPU-measurable claim: amortizing K Python dispatches
         into one scan call must not LOSE to the sequential loop on a
         small config (it typically wins big; the bound here is loose
-        so CI noise can't flake it)."""
+        so CI noise can't flake it).
+
+        Measured as 3 INTERLEAVED (sequential, scan) leg pairs, best
+        paired ratio: a single pass on this throttled 2-core host can
+        land the two legs in different multi-second CPU-share windows
+        and flake under full-lane contention (the PR 13 leftover;
+        PERF.md measurement discipline — adjacent legs share a
+        window)."""
         import time
 
         _fresh()
@@ -477,24 +484,35 @@ class TestRunStepsDispatchWin:
         exe = fluid.Executor(fluid.CPUPlace())
         sc1 = fluid.Scope()
         exe.run(startup, scope=sc1)
-        # warm both executables outside the timed windows
-        exe.run(prog, feed=feed, fetch_list=[loss], scope=sc1)
-        t0 = time.perf_counter()
-        for _ in range(K):
-            exe.run(prog, feed=feed, fetch_list=[loss], scope=sc1,
-                    return_numpy=False)
-        t_seq = time.perf_counter() - t0
-
         sc2 = fluid.Scope()
         exe2 = fluid.Executor(fluid.CPUPlace())
         exe2.run(startup, scope=sc2)
+
+        def seq_leg():
+            t0 = time.perf_counter()
+            for _ in range(K):
+                exe.run(prog, feed=feed, fetch_list=[loss],
+                        scope=sc1, return_numpy=False)
+            return time.perf_counter() - t0
+
+        def scan_leg():
+            t0 = time.perf_counter()
+            exe2.run_steps(prog, feed=feed, fetch_list=[loss],
+                           steps=K, scope=sc2, return_numpy=False)
+            return time.perf_counter() - t0
+
+        # warm both executables outside the timed windows (the scan
+        # executable is specialized on K — warm with the SAME K)
+        exe.run(prog, feed=feed, fetch_list=[loss], scope=sc1)
         exe2.run_steps(prog, feed=feed, fetch_list=[loss], steps=K,
                        scope=sc2)
-        t0 = time.perf_counter()
-        exe2.run_steps(prog, feed=feed, fetch_list=[loss], steps=K,
-                       scope=sc2, return_numpy=False)
-        t_scan = time.perf_counter() - t0
+        pairs = [(seq_leg(), scan_leg()) for _ in range(3)]
         assert exe2.last_run_steps_fallback is None
-        # generous 2x guard: the real measured ratio is recorded in
-        # PERF.md ("Host dispatch & the multi-step scan")
-        assert t_scan < 2.0 * t_seq, (t_scan, t_seq)
+        # generous 2x guard on the BEST pair: the real measured ratio
+        # is recorded in PERF.md ("Host dispatch & the multi-step
+        # scan")
+        best = min(sc / sq for sq, sc in pairs)
+        assert best < 2.0, (
+            f"run_steps scan regressed: best paired scan/seq ratio "
+            f"{best:.2f} (pairs: "
+            f"{[(round(sq, 3), round(sc, 3)) for sq, sc in pairs]})")

@@ -447,28 +447,47 @@ class TestThroughputGuard:
         batched server must sustain >= the naive per-request
         predictor.run loop. The real win measured in bench.py serving
         is ~3-5x; asserting >= 1x keeps the guard robust to loaded CI
-        hosts."""
+        hosts.
+
+        Measured as 3 INTERLEAVED (naive, batched) leg pairs, best
+        paired ratio: this host is 2-core and CPU-share throttled in
+        multi-second windows (PERF.md), so a single sequential
+        naive-then-batched pass can land the two legs in different
+        throttle windows and flake under full-lane contention —
+        adjacent legs share a window, and three pairs make it
+        vanishingly unlikely every pair straddles a transition (the
+        PR 13 contention-flake fix; same discipline as the
+        continuous-batching guard)."""
         _export_tiny_fc(tmp_path)
         pred = create_paddle_predictor(AnalysisConfig(str(tmp_path)))
         r = np.random.RandomState(5)
         reqs = [r.randn(1, 8).astype(np.float32) for _ in range(100)]
 
-        # naive loop (warm its executable first)
-        pred.run([PaddleTensor(reqs[0], name="x")])
-        t0 = time.perf_counter()
-        for a in reqs:
-            pred.run([PaddleTensor(a, name="x")])
-        naive_s = time.perf_counter() - t0
+        def naive_leg():
+            t0 = time.perf_counter()
+            for a in reqs:
+                pred.run([PaddleTensor(a, name="x")])
+            return time.perf_counter() - t0
 
         worker = pred.clone()
         with InferenceServer(worker, max_batch_size=16,
                              max_wait_ms=2.0) as srv:
             srv.aot_warmup()
-            t0 = time.perf_counter()
-            replies = [srv.submit({"x": a}) for a in reqs]
-            for rep in replies:
-                rep.result(timeout=60.0)
-            batched_s = time.perf_counter() - t0
-        assert batched_s <= naive_s * 1.05, (
-            f"batched serving regressed: {batched_s:.3f}s vs naive "
-            f"{naive_s:.3f}s for 100 requests")
+
+            def batched_leg():
+                t0 = time.perf_counter()
+                replies = [srv.submit({"x": a}) for a in reqs]
+                for rep in replies:
+                    rep.result(timeout=60.0)
+                return time.perf_counter() - t0
+
+            # warm both paths outside the timed windows
+            pred.run([PaddleTensor(reqs[0], name="x")])
+            batched_leg()
+            pairs = [(naive_leg(), batched_leg())
+                     for _ in range(3)]
+        best = min(b / n for n, b in pairs)
+        assert best <= 1.05, (
+            f"batched serving regressed: best paired batched/naive "
+            f"ratio {best:.2f} for 100 requests (pairs: "
+            f"{[(round(n, 3), round(b, 3)) for n, b in pairs]})")
