@@ -20,6 +20,8 @@ self-derived target recorded in TARGETS below.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -1237,6 +1239,308 @@ def bench_paged(n_requests=192):
                              stats_json_dict=pst)
 
 
+def bench_sharded(n_requests=120):
+    """Sharded serving: tensor-parallel decode + data-parallel lanes
+    on the virtual 8-device mesh (models/decode_engine.ShardingConfig
+    + core/sharding_plan.py + runtime/placement.py).
+
+    XLA fixes the host-platform device count at backend init, and the
+    driver's probe already initialized jax in THIS process — so the
+    measurement runs in a CHILD process with
+    ``--xla_force_host_platform_device_count=8`` set (the
+    _coldstart_child discipline), which also CPU-pins it by
+    construction. The child writes BENCH_SELF_r17.json and prints the
+    record; this parent relays it.
+
+    Three INTERLEAVED legs (throttled-host discipline), all on the
+    paged serve path with identical geometry and token-exact parity
+    vs the whole-loop decode asserted per leg:
+
+      single — the r13 paged server, one device;
+      tp2    — the same bundle tensor-parallel over devices [0,1]
+               (head-sharded KV pool, row/column-parallel
+               projections, vocab-sharded logits);
+      tp2+dp — TWO tp=2 models on disjoint slices [0,1] / [2,3],
+               traffic split between them (the runtime placement
+               carve, minus the fc lanes the tests cover).
+
+    The ASSERTED wins are per-device KV bytes (pool shard bytes
+    exactly 1/tp, >= 1.8x smaller) and the dp-lane AGGREGATE over one
+    tp model; the tp2-vs-single tok/s ratio is recorded UNASSERTED
+    with the CPU caveat: on this 2-core host every psum is a
+    same-core memcpy + sync that costs a visible slice of the tick,
+    while on the real chip the decode matmuls underutilize the MXU
+    and the collectives ride the ICI (PERF.md "Sharded serving" has
+    the arithmetic)."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count"
+                            "=8").strip())
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "_sharded_child",
+         str(n_requests)],
+        env=env, capture_output=True, text=True, timeout=3600)
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"sharded child failed (rc {proc.returncode}); stderr "
+            f"tail above")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _bench_sharded_impl(n_requests):
+    """The child-process body of bench_sharded (8 virtual devices)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.device_count() >= 8, jax.device_count()
+
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.inference import (PagedContinuousGenerationServer,
+                                      apply_eos_sentinel,
+                                      count_generated_tokens)
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.models.decode_engine import (CacheConfig,
+                                                 ShardingConfig)
+
+    V, D, H, L, S, maxT = 16, 64, 4, 1, 12, 64
+    end_id = 1
+    n_slots = 8
+    rng = np.random.RandomState(7)
+
+    def term_prompt(r, p):
+        src = r.randint(3, V, (S,)).astype(np.int64)
+        if p < S:
+            src[p:] = end_id
+        return src
+
+    scope = Scope()
+    with unique_name.guard():
+        main_p, startup, loss = T.build_program(
+            seq_len=S, d_model=D, n_heads=H, n_layers=L, d_inner=128,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+        with fluid.program_guard(main_p, startup):
+            fluid.optimizer.Adam(learning_rate=0.005).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    for _ in range(400):
+        src = np.stack([term_prompt(
+            rng, int(rng.choice([2, 3, 5, S], p=[.4, .25, .15, .2])))
+            for _ in range(8)])
+        tgt_in = np.concatenate(
+            [np.full((8, 1), 2, np.int64), src[:, :-1]], 1)
+        exe.run(main_p, feed={"src_ids": src, "tgt_ids": tgt_in,
+                              "label": src}, fetch_list=[loss],
+                scope=scope)
+
+    kwargs = dict(seq_len=S, max_out_len=maxT, d_model=D, n_heads=H,
+                  n_layers=L, d_inner=128, vocab=V, start_id=2,
+                  end_id=end_id)
+    cache = CacheConfig(layout="paged", block_size=16, n_blocks=24,
+                        n_prompt_entries=8)
+    with unique_name.guard():
+        inc_m, _, _, inc_buf = T.build_incremental_decode_program(
+            **kwargs)
+    with unique_name.guard():
+        b_single = T.build_decode_step_program(
+            n_slots=n_slots, state_prefix="@sg/", cache=cache,
+            **kwargs)
+    with unique_name.guard():
+        b_tp = T.build_decode_step_program(
+            n_slots=n_slots, state_prefix="@tp/", cache=cache,
+            sharding=ShardingConfig(tp=2), **kwargs)
+    with unique_name.guard():
+        b_tp2 = T.build_decode_step_program(
+            n_slots=n_slots, state_prefix="@tq/", cache=cache,
+            sharding=ShardingConfig(tp=2), **kwargs)
+
+    # shared-prefix workload (the r13 shape: 80% Zipf over 4 system
+    # prompts, 20% unique, model-driven mixed lengths)
+    wl_rng = np.random.RandomState(31)
+    common = [term_prompt(wl_rng, p) for p in (2, 3, 5, S)]
+    srcs = []
+    for _ in range(n_requests):
+        u = wl_rng.rand()
+        if u < 0.8:
+            zipf = np.array([1.0 / (r + 1) ** 1.1 for r in range(4)])
+            zipf = zipf / zipf.sum()
+            srcs.append(common[int(wl_rng.choice(4, p=zipf))])
+        else:
+            srcs.append(term_prompt(wl_rng, int(wl_rng.choice(
+                [2, 3, 5, S], p=[.4, .25, .15, .2]))))
+    srcs = np.stack(srcs)
+    ref, = exe.run(inc_m, feed={"src_ids": srcs},
+                   fetch_list=[inc_buf], scope=scope)
+    want = apply_eos_sentinel(np.asarray(ref), end_id)
+    total_tokens = int(count_generated_tokens(want, end_id).sum())
+
+    def fork_scope():
+        fork = Scope()
+        for name in list(scope._vars):
+            val = scope._get(name)
+            fork._set(name, np.asarray(val)
+                      if hasattr(val, "shape") else val)
+        return fork
+
+    def run_one(bundle, devices, prompts, expect):
+        srv = PagedContinuousGenerationServer(
+            bundle, executor=exe, scope=fork_scope(),
+            steps_per_tick=8, mesh_devices=devices)
+        try:
+            t0 = time.perf_counter()
+            replies = [srv.submit(s) for s in prompts]
+            outs = [rep.result(600.0) for rep in replies]
+            wall = time.perf_counter() - t0
+            st = srv.stats()
+        finally:
+            srv.close()
+        assert all(np.array_equal(np.asarray(o), expect[i])
+                   for i, o in enumerate(outs)), \
+            "token parity vs the whole-loop decode failed"
+        return wall, st
+
+    def single_leg():
+        wall, st = run_one(b_single, None, srcs, want)
+        return {"wall_s": wall, "tok_s": total_tokens / wall,
+                "stats": st}
+
+    def tp2_leg():
+        wall, st = run_one(b_tp, jax.devices()[:2], srcs, want)
+        return {"wall_s": wall, "tok_s": total_tokens / wall,
+                "stats": st}
+
+    def tp2dp_leg():
+        # two tp=2 models on disjoint slices, traffic split: the
+        # dp-lane aggregate (run concurrently via the servers' own
+        # scheduler threads)
+        import threading
+
+        half = len(srcs) // 2
+        walls, stats, errs = [None, None], [None, None], []
+
+        def lane(i, bundle, devices, prompts, expect):
+            try:
+                walls[i], stats[i] = run_one(bundle, devices,
+                                             prompts, expect)
+            except BaseException as e:  # surfaced below
+                errs.append(e)
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=lane, args=(
+                0, b_tp, jax.devices()[:2], srcs[:half],
+                want[:half])),
+            threading.Thread(target=lane, args=(
+                1, b_tp2, jax.devices()[2:4], srcs[half:],
+                want[half:]))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        # the leg's headline is the TWO-lane aggregate, so the pool
+        # accounting must cover both lanes (lane 0's stats alone
+        # described half the traffic); telemetry keeps lane 0's full
+        # stats dict as the per-lane sample
+        pools = [st["block_pool"] for st in stats]
+        return {"wall_s": wall, "tok_s": total_tokens / wall,
+                "stats": stats[0],
+                "pool_sum": {k: sum(p[k] for p in pools)
+                             for k in ("prefix_hits", "prefix_misses",
+                                       "cow_copies")}}
+
+    # per-device KV bytes: the placed pool's addressable shard is
+    # EXACTLY total/tp (heads divide evenly)
+    probe = PagedContinuousGenerationServer(
+        b_tp, executor=exe, scope=fork_scope(),
+        mesh_devices=jax.devices()[:2], start=False)
+    pool = probe.scope._get("@tp/self_k0@POOL")
+    per_dev = int(pool.addressable_shards[0].data.nbytes)
+    full = int(np.prod(pool.shape)) * pool.dtype.itemsize
+    probe.close()
+    kv_ratio = full / per_dev
+    assert kv_ratio >= 1.8, (full, per_dev)
+
+    single_leg()
+    tp2_leg()
+    tp2dp_leg()  # warm (all compiles land here)
+    compiles_before = exe.compile_count
+    rounds = _harness.interleave_rounds(
+        [("single", single_leg), ("tp2", tp2_leg),
+         ("tp2dp", tp2dp_leg)], rounds=3)
+    steady_compiles = exe.compile_count - compiles_before
+    assert steady_compiles == 0, steady_compiles
+    sbest = _harness.best_leg(rounds, "single")
+    tbest = _harness.best_leg(rounds, "tp2")
+    dbest = _harness.best_leg(rounds, "tp2dp")
+    dp_over_tp2 = _harness.paired_ratio_max(rounds, "tp2dp", "tp2")
+    tp2_over_single = _harness.paired_ratio_max(rounds, "tp2",
+                                                "single")
+    # BOTH throughput ratios are recorded UNASSERTED beyond sanity
+    # floors: all 8 virtual devices share 2 throttled cores, so the
+    # dp lanes compete for the same cycles (paired dp/tp2 measured
+    # 0.76x-1.52x across runs — unresolvable, the PERF.md r12
+    # discipline) and tp trades latency for per-device bytes. The
+    # HARD assertions of this bench are the layout/compile
+    # invariants: per-device KV exactly 1/tp, parity per leg, zero
+    # steady-state compiles. On disjoint REAL chips dp lanes scale
+    # by construction (PERF.md "Sharded serving").
+    assert dp_over_tp2 >= 0.5, (
+        f"dp aggregate collapsed to {dp_over_tp2:.2f}x one tp model")
+    bp = dbest["pool_sum"]  # both dp lanes' pools (the aggregate leg)
+    result = {
+        "metric": "sharded_dp_aggregate_tokens_per_sec",
+        "value": round(dbest["tok_s"], 1),
+        "unit": "tokens/sec",
+        "single_tok_s": round(sbest["tok_s"], 1),
+        "tp2_tok_s": round(tbest["tok_s"], 1),
+        "tp2dp_tok_s": round(dbest["tok_s"], 1),
+        "dp_aggregate_over_tp2": round(dp_over_tp2, 2),
+        "dp_aggregate_note": (
+            "unasserted beyond a 0.5 sanity floor: the dp lanes "
+            "share this host's 2 cores, paired ratios swing "
+            "0.76-1.52x across runs (unresolvable); on disjoint "
+            "real chips lanes scale by construction"),
+        "tp2_over_single": round(tp2_over_single, 2),
+        "tp2_over_single_note": (
+            "unasserted: on this 2-core host every per-tick psum is "
+            "a same-core copy+sync, so tp trades latency for the "
+            "per-device KV bytes; the real-chip tok/s arithmetic is "
+            "argued in PERF.md 'Sharded serving'"),
+        "per_device_kv": {"full_pool_bytes": full,
+                          "per_device_bytes": per_dev,
+                          "ratio": round(kv_ratio, 2)},
+        "token_parity_vs_whole_loop": True,  # asserted per leg
+        "steady_state_compiles": int(steady_compiles),
+        "triple_tok_s": [[round(r["single"]["tok_s"], 1),
+                          round(r["tp2"]["tok_s"], 1),
+                          round(r["tp2dp"]["tok_s"], 1)]
+                         for r in rounds],
+        "mesh": {"devices": 8, "tp": 2, "tp_models": 2,
+                 "slices": [[0, 1], [2, 3]]},
+        "cache": {"block_size": cache.block_size,
+                  "n_blocks": cache.n_blocks,
+                  "n_prompt_entries": cache.n_prompt_entries},
+        "workload": "80% shared system prompts (Zipf over 4), "
+                    "20% unique; terminator-copy mixed lengths",
+        "n_requests": n_requests,
+        "total_tokens": total_tokens,
+        "model": f"transformer d{D} L{L} S{S} maxT{maxT}",
+        "best_of": 3,
+        "prefix_hit_rate": round(
+            bp["prefix_hits"] / max(1, bp["prefix_hits"]
+                                    + bp["prefix_misses"]
+                                    + bp["cow_copies"]), 3),
+    }
+    return _write_bench_self("BENCH_SELF_r17.json", result,
+                             stats_json_dict=dbest["stats"])
+
+
 def bench_speculative(n_requests=96, spec_k=3):
     """Speculative draft-and-verify decoding vs the plain decode
     burst and the whole-loop server (models/decode_engine.py
@@ -1809,6 +2113,7 @@ EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
                  "generation": bench_generation,
                  "paged": bench_paged,
                  "speculative": bench_speculative,
+                 "sharded": bench_sharded,
                  "multitenant": bench_multitenant}
 
 
@@ -1820,6 +2125,13 @@ def main():
         # internal: spawned by bench_coldstart; parent already probed
         # the backend
         _coldstart_child(sys.argv[2], sys.argv[3], int(sys.argv[4]))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "_sharded_child":
+        # internal: spawned by bench_sharded with the 8-virtual-device
+        # XLA_FLAGS (device count is fixed at backend init, so the
+        # parent cannot host the mesh itself)
+        print(json.dumps(_bench_sharded_impl(int(sys.argv[2]))),
+              flush=True)
         return
     if len(sys.argv) > 1 and sys.argv[1] == "trend":
         # perf-trend sentinel over the committed BENCH_SELF history

@@ -263,6 +263,15 @@ class EventSite:
 
 # --- annotation attrs & the divergence-source seed table --------------------
 DIVERGENCE_ATTR = "divergence_source"
+# optional companion attr: the mesh axes a marked predicate actually
+# varies ACROSS (mark_divergence_source(axes=...)). With a MeshConfig
+# attached, a mark whose axes are all absent from the mesh is inert —
+# the predicate provably cannot differ on a mesh that lacks its axis
+# (the tp-sharded serve While: lanes replicated over 'tp', the
+# burst-exit predicate varies only across a lane-sharding axis).
+# Without a mesh (or without axes) the mark stays unconditionally
+# varying — the historical conservative stance.
+DIVERGENCE_AXES_ATTR = "divergence_axes"
 SHARDING_ATTR = "sharding_axes"
 SHARDING_DIMS_ATTR = "sharding_dims"
 
@@ -568,13 +577,24 @@ def _producer_op(var) -> Optional[Operator]:
     return None
 
 
-def mark_divergence_source(var, tag: str) -> None:
+def mark_divergence_source(var, tag: str, axes=None) -> None:
     """Build-time annotation: mark the producer op of `var` as minting
     a mesh-varying value (tag must be in the registered seed table).
     The abstract interpreter seeds the replication lattice from these
     marks; collectives/grads guarded by values derived from them get
     PROVEN-divergent diagnostics (PTA130/131) instead of pattern
     guesses.
+
+    ``axes`` (optional) names the mesh axes the predicate varies
+    ACROSS. When given AND the program carries a MeshConfig
+    (``set_mesh``) that has none of those axes at size > 1, the mark
+    is inert — the predicate provably cannot differ on a mesh lacking
+    its axis, so the guard classifies from its actual inputs instead
+    (the tp-sharded serve While's burst-exit predicate: lanes are
+    replicated over 'tp'; the predicate varies only across a
+    lane-sharding axis, which the tp mesh does not have). Without
+    axes, or without a mesh, the mark stays unconditionally varying —
+    the conservative historical stance.
 
     Reference counterpart: none (see register_divergence_source);
     compile-time capability of the whole-block-jit executor.
@@ -590,6 +610,10 @@ def mark_divergence_source(var, tag: str) -> None:
             f"mark_divergence_source: no producer op found for "
             f"{getattr(var, 'name', var)!r}")
     op.attrs[DIVERGENCE_ATTR] = tag
+    if axes is not None:
+        op.attrs[DIVERGENCE_AXES_ATTR] = tuple(
+            str(a) for a in (axes if isinstance(axes, (list, tuple))
+                             else (axes,)))
     blk = getattr(var, "block", None)
     if blk is not None and blk.program is not None:
         blk.program._version += 1  # invalidate cached fingerprints/facts
@@ -655,6 +679,14 @@ def mark_sharded(var, axes) -> None:
     """
     names, placements = _parse_sharding(var, axes)
     op = _producer_op(var)
+    if op is not None and any(True for _ in iter_sub_blocks(op)):
+        # a CONTAINER op (while/cond) lists every carried name as an
+        # output — pinning the op would smear this var's placement
+        # onto every co-carried output (annotating a while-carried KV
+        # buffer must not shard the loop counter). The body's real
+        # writer ops are walked anyway; the var-level seed below is
+        # what holds the annotation.
+        op = None
     if op is None and getattr(var, "block", None) is None:
         raise ValueError(
             f"mark_sharded: {getattr(var, 'name', var)!r} has neither "
@@ -728,13 +760,30 @@ class ValueFact:
     source: Optional[str] = None    # divergence tag when VARYING
     minted_at: Optional[str] = None  # anchor of the minting op
     sharded: Optional[tuple] = None  # sharding axes annotation, if any
+    # True when ANY varying ancestry came from a MANUAL divergence
+    # source (the registered seed table: pp_stage_id, mesh_coord,
+    # lane_active_mask, vary) as opposed to GSPMD auto-axis sharding
+    # annotations. STICKY across joins: a predicate mixing sharded
+    # values with a stage id is manually divergent no matter which
+    # operand's source string survives the join — the GSPMD-uniform
+    # guard reclassification must never fire for it.
+    manual: bool = False
 
     def joined(self, other: "ValueFact") -> "ValueFact":
         repl = join(self.repl, other.repl)
-        # keep the explanation of whichever side made us varying
+        # keep the explanation of whichever side made us varying;
+        # between two varying sides, prefer the MANUAL one — its tag
+        # names the real divergence source in diagnostics
         lead = self if _ORDER[self.repl] >= _ORDER[other.repl] else other
+        if self.repl == VARYING and other.repl == VARYING \
+                and lead.source and str(lead.source).startswith(
+                    "sharding:"):
+            alt = other if lead is self else self
+            if alt.manual:
+                lead = alt
         return ValueFact(repl, lead.source, lead.minted_at,
-                         self.sharded or other.sharded)
+                         self.sharded or other.sharded,
+                         self.manual or other.manual)
 
 
 @dataclass(frozen=True)
@@ -762,6 +811,13 @@ class GuardFact:
         if self.fact == UNKNOWN:
             return (f"{what}: divergence UNPROVABLE (predicate derives "
                     f"from values outside the replication facts)")
+        if self.source and str(self.source).startswith("sharding:"):
+            return (f"{what}: value-uniform — its only varying "
+                    f"ancestry is GSPMD auto-axis sharding "
+                    f"({self.source}); the partitioner computes "
+                    f"predicates consistently on every device, so "
+                    f"control flow stays uniform (no manual "
+                    f"divergence source in its chain)")
         return (f"{what}: value-uniform under current replication "
                 f"facts (facts assume unsharded feeds)")
 
@@ -1085,11 +1141,22 @@ class _Interp:
             self.values[name] = new
             self.changed = True
 
+    def _mark_active(self, op: Operator) -> bool:
+        """Whether a divergence-source mark on `op` fires under this
+        program's mesh: axes-qualified marks are inert when the
+        attached MeshConfig has none of the named axes at size > 1
+        (the predicate cannot vary across a mesh that lacks its
+        axis); unqualified marks, or no mesh, stay active."""
+        axes = op.attrs.get(DIVERGENCE_AXES_ATTR)
+        if not axes or self.mesh is None:
+            return True
+        return any(self.mesh.size(str(a)) > 1 for a in axes)
+
     def _transfer(self, op: Operator, blk: Block,
                   site: OpSite) -> ValueFact:
         tag = op.attrs.get(DIVERGENCE_ATTR)
-        if isinstance(tag, str) and tag:
-            return ValueFact(VARYING, tag, site.anchor())
+        if isinstance(tag, str) and tag and self._mark_active(op):
+            return ValueFact(VARYING, tag, site.anchor(), manual=True)
         axes = op.attrs.get(SHARDING_ATTR)
         if axes:
             return ValueFact(VARYING, f"sharding:{tuple(axes)}",
@@ -1383,8 +1450,27 @@ class _Interp:
                 cond = cond_names[0] if cond_names else None
                 cf = self._value_of(cond, blk) if cond else \
                     ValueFact(UNKNOWN)
+                repl = cf.repl
+                if repl == VARYING and not cf.manual \
+                        and isinstance(cf.source, str) \
+                        and cf.source.startswith("sharding:"):
+                    # GSPMD-uniform guard: the predicate's only
+                    # varying ancestry is auto-axis sharding
+                    # annotations — under GSPMD SPMD semantics the
+                    # partitioner computes predicates CONSISTENTLY
+                    # on every device (it inserts whatever
+                    # collectives the replicated cond needs, outside
+                    # any manual divergence), so control flow stays
+                    # uniform. Manual sources (pp_stage_id,
+                    # mesh_coord, lane_active_mask under a lane-
+                    # sharding mesh) never take this path: the
+                    # STICKY ValueFact.manual bit survives joins, so
+                    # a predicate MIXING sharded values with a
+                    # manual source stays proven-divergent even when
+                    # the surviving source string is "sharding:*".
+                    repl = REPLICATED
                 inner = guard_stack + (GuardFact(
-                    op.type, site.anchor(), cond, cf.repl,
+                    op.type, site.anchor(), cond, repl,
                     cf.source, cf.minted_at),)
             for _, sub in subs:
                 self._walk(sub, op, inner)

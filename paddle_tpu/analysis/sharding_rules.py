@@ -663,6 +663,63 @@ def rule_pool_scatter(op, spec_of, shape_of, mesh):
     return _all_outs(op, sp, events)
 
 
+@_family("sample", ("sample_categorical",))
+def rule_sample_categorical(op, spec_of, shape_of, mesh):
+    """Categorical draw over the last (vocab) axis: the sampled token
+    ids are REPLICATED (one logical draw per lane), and a
+    vocab-sharded probability row implies the lowering gathers (or
+    psum-reduces the cumulative mass of) the full distribution — the
+    Megatron vocab-parallel sampling collective. Seed/Pos carry no
+    layout."""
+    probs = _in(op, "Probs")
+    sp = spec_of(probs) if probs else REPLICATED_SPEC
+    if sp.is_top:
+        return _all_outs(op, TOP_SPEC)
+    events = []
+    shape = shape_of(probs) if probs else None
+    if shape is not None:
+        a = sp.axis_of(len(shape) - 1)
+        if a is not None:
+            events.append(CollectiveEvent(
+                "allgather", (a,),
+                _outs(op)[0] if _outs(op) else None,
+                f"categorical draw over the {a}-sharded vocab dim: "
+                f"the lowering materializes the full distribution "
+                f"(or psums its cumulative mass) across {a!r}"))
+    return _all_outs(op, REPLICATED_SPEC, events)
+
+
+@_family("spec_accept", ("spec_accept",))
+def rule_spec_accept(op, spec_of, shape_of, mesh):
+    """Draft-and-verify acceptance (ops/spec_ops.py): per-lane
+    scalars/short rows out — REPLICATED — computed from per-token
+    probability lookups; a vocab-sharded draft/target distribution
+    implies a cross-shard gather of the looked-up p/q columns (and of
+    the residual distribution for the correction draw)."""
+    events = []
+    axes = set()
+    for slot in ("DraftProbs", "TargetProbs"):
+        name = _in(op, slot)
+        if name is None:
+            continue
+        s = spec_of(name)
+        if s.is_top:
+            return _all_outs(op, TOP_SPEC)
+        shape = shape_of(name)
+        if shape is not None:
+            a = s.axis_of(len(shape) - 1)
+            if a is not None:
+                axes.add(a)
+    if axes:
+        events.append(CollectiveEvent(
+            "allgather", tuple(sorted(axes)),
+            _outs(op)[0] if _outs(op) else None,
+            f"speculative acceptance over vocab dims sharded on "
+            f"{sorted(axes)}: the p/q token lookups and the residual "
+            f"correction distribution materialize across those axes"))
+    return _all_outs(op, REPLICATED_SPEC, events)
+
+
 # ---------------------------------------------------------------------------
 # shape-like producers: mint fresh replicated values even when their
 # reference input is sharded (they only read its metadata)

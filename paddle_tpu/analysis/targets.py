@@ -216,17 +216,50 @@ def _label_semantic_roles():
 
 
 def _sharded_decoder():
-    """The tp-sharded cached_decoder_step fixture
-    (models/sharded_decoder.py): the Megatron-annotated step program
-    the sharding prover (PTA160/161) and the per-device memory
-    planner (PTA170) must keep strict-green, so PR 13's sharded
-    serving lowerings inherit a working prover instead of
-    bootstrapping one. The baseline's ``sharding_facts`` section
-    snapshots this target's propagated specs."""
+    """The tp-sharded decode engine — the REAL sharded serving
+    lowerings (models/decode_engine.ShardingConfig), linted as zoo
+    targets: the dense fixture bundle's step + serve programs AND a
+    paged+speculative tp bundle, so PTA130/131/160/161 prove every
+    shipped sharded serve While branch-free of misplaced collectives
+    and PTA190/191 keep the sharded pools' ownership proofs. The
+    baseline's ``sharding_facts`` section snapshots the propagated
+    specs of all of them."""
+    from .. import unique_name
     from ..models import sharded_decoder
+    from ..models import transformer as tr
+    from ..models.decode_engine import (CacheConfig, DraftConfig,
+                                        ShardingConfig)
 
     fx = sharded_decoder.build_tp_sharded_decoder_step()
-    return {"step": fx.program, "startup": fx.startup}, []
+    b = fx.bundle
+    big = max(b.prefills)
+    with unique_name.guard():
+        # paged + speculative tp bundle: the sharded pools under the
+        # ownership prover + the (k+1)-query verify under the
+        # sharding prover, in one build (ONE admission bucket — the
+        # gate must stay fast, the targets.py spec-bundle discipline)
+        ps = tr.build_decode_step_program(
+            seq_len=8, max_out_len=8, d_model=32, n_heads=4,
+            n_layers=1, d_inner=64, vocab=64, n_slots=4,
+            state_prefix="@tpps/", admit_buckets=[2],
+            draft=DraftConfig(d_model=16, n_heads=2, n_layers=1,
+                              d_inner=32, k=2),
+            cache=CacheConfig(layout="paged", block_size=4,
+                              n_blocks=8, n_prompt_entries=3),
+            sharding=ShardingConfig(tp=2))
+    pbig = max(ps.prefills)
+    return ({"step": fx.program, "startup": fx.startup,
+             "serve0": b.serves[0], f"serve{big}": b.serves[big],
+             "prefill": b.prefill,
+             "ps_step": ps.step,
+             "ps_serve0": ps.serves[0],
+             f"ps_serve_miss{pbig}": ps.serves[("miss", pbig)],
+             f"ps_serve_hit{pbig}": ps.serves[("hit", pbig)],
+             f"ps_prefill{pbig}": ps.prefills[pbig]},
+            [("step", "serve0"), ("step", f"serve{big}"),
+             ("ps_step", f"ps_serve_miss{pbig}")],
+            "shared_params",
+            {"tp": b, "tpps": ps})
 
 
 def _serving_runtime():

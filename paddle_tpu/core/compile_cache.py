@@ -284,6 +284,28 @@ class CompileCache:
             self._discard(digest, "entry format mismatch (truncated "
                           "or written by an incompatible version)")
             return None
+        mesh = (entry.get("meta") or {}).get("mesh")
+        if mesh:
+            # a sharded executable embeds its device assignment:
+            # validate BEFORE deserializing so a process without the
+            # mesh (fewer virtual devices, missing device ids) gets a
+            # NAMED discard instead of a deserialization crash deep
+            # inside jaxlib
+            import jax
+
+            have = {int(d.id) for d in jax.devices()}
+            want = [int(i) for i in mesh.get("device_ids", [])]
+            missing = [i for i in want if i not in have]
+            if int(mesh.get("ndev", 0)) > len(have) or missing:
+                self._discard(
+                    digest,
+                    f"mesh mismatch: entry compiled for a "
+                    f"{mesh.get('ndev')}-device mesh "
+                    f"(axes {mesh.get('axes')}, device ids {want}); "
+                    f"this process has {len(have)} device(s) "
+                    f"{sorted(have)[:8]} — recompiling for the local "
+                    f"mesh")
+                return None
         try:
             fmt = entry["format"]
             if fmt == "aot":
@@ -347,6 +369,15 @@ class CompileCache:
             entry.update(format="aot", payload=payload,
                          in_tree=in_tree, out_tree=out_tree)
         except Exception as aot_err:
+            if meta.get("mesh"):
+                # the StableHLO fallback recompiles single-device at
+                # load (`_compile_stablehlo`): a sharded module would
+                # silently lose its mesh — stay process-local instead
+                self.discards.append(
+                    (digest, f"sharded executable not serializable "
+                     f"(aot: {aot_err}); the StableHLO fallback is "
+                     f"single-device — entry stays process-local"))
+                return False
             try:
                 in_avals = meta["in_avals"]
                 flat, in_tree = jax.tree.flatten(in_avals)

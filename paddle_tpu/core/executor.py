@@ -1342,20 +1342,24 @@ class Executor:
     @staticmethod
     def _block_cache_key(program, feed_specs, fetch_names):
         from .. import amp
+        from .sharding_plan import program_sharding_token
 
         return (program._uid, program._version,
                 tuple(sorted(feed_specs)), tuple(fetch_names),
-                amp.state_token(), _parallel_scope_token())
+                amp.state_token(), _parallel_scope_token(),
+                program_sharding_token(program))
 
     @staticmethod
     def _scan_cache_key(program, feed_specs, fetch_names, steps,
                         stacked):
         from .. import amp
+        from .sharding_plan import program_sharding_token
 
         return ("scan", program._uid, program._version,
                 tuple(sorted(feed_specs)), tuple(fetch_names),
                 int(steps), bool(stacked), amp.state_token(),
-                _parallel_scope_token())
+                _parallel_scope_token(),
+                program_sharding_token(program))
 
     # --- warm-start layer (core/compile_cache.py) ---------------------
     def _disk_slot(self, program, feed_specs, fetch_names, kind,
@@ -1385,6 +1389,7 @@ class Executor:
             # store AND on load
             return None, None
         from .. import amp
+        from .sharding_plan import program_sharding_token
 
         parts = {"kind": kind,
                  "program": program.fingerprint(),
@@ -1392,6 +1397,11 @@ class Executor:
                  "fetch": tuple(fetch_names),
                  "amp": amp.state_token(),
                  "pscope": _parallel_scope_token(),
+                 # mesh shape + placements + bound device ids: a
+                 # sharded and a dense build of one program — or one
+                 # plan bound to two different device slices — must
+                 # never share a persisted executable
+                 "sharding": program_sharding_token(program),
                  "donate": self.donate,
                  "backend": jax.default_backend(),
                  "ndev": jax.device_count(),
@@ -1435,7 +1445,8 @@ class Executor:
                          compiled=compiled, scope=scope,
                          feed_arrays=feed_arrays)
         if dcache is not None and dcache.writable:
-            self._disk_store(dcache, digest, compiled, kind="block")
+            self._disk_store(dcache, digest, compiled, kind="block",
+                             program=program)
         return compiled
 
     def _resolve_scan(self, program, block, feed_specs, fetch_names,
@@ -1479,6 +1490,7 @@ class Executor:
         if dcache is not None and dcache.writable:
             self._disk_store(
                 dcache, digest, compiled, kind="scan",
+                program=program,
                 extra_meta={
                     "write_only_specs": [
                         (n, tuple(s.shape), str(s.dtype))
@@ -1488,7 +1500,7 @@ class Executor:
         return compiled
 
     def _disk_store(self, dcache, digest, compiled, kind,
-                    extra_meta=None):
+                    extra_meta=None, program=None):
         """Persist a freshly AOT-compiled executable + the binding
         metadata a future process needs to rehydrate it untraced."""
         aot = getattr(compiled, "_aot", None)
@@ -1502,10 +1514,57 @@ class Executor:
                 "state_out": list(compiled.state_out),
                 "fetch_names": list(compiled.fetch_names),
                 "in_avals": in_avals}
+        if program is not None:
+            from .sharding_plan import plan_of
+
+            plan = plan_of(program)
+            if plan is not None and plan.is_bound:
+                # rehydration context check (compile_cache): a sharded
+                # executable embeds its device assignment — loading
+                # it on a process whose mesh devices do not exist must
+                # be a NAMED discard, not a deserialization crash
+                meta["mesh"] = {"ndev": plan.n_devices,
+                                "axes": list(plan.axes),
+                                "device_ids": list(plan._device_ids)}
         if extra_meta:
             meta.update(extra_meta)
         dcache.store_executable(digest, compiled.fn, lowered,
                                 out_shape, meta)
+
+    @staticmethod
+    def _plan_jit_shardings(program, block, carry_names, const,
+                            state_out, fetch_names, scan=False):
+        """(in_shardings, out_shardings) for a sharded program's jit
+        boundary, or None for unsharded/unbound programs. Entry AND
+        result shardings of every persistable are pinned to the
+        plan's placement, so donated state round-trips with a
+        byte-stable layout and prepared handles never re-specialize
+        mid-traffic (the zero-steady-state-compiles contract); feeds
+        and the rng are replicated on the mesh (numpy feeds are
+        device_put per call by the dispatch path — host-written
+        block tables stay plain numpy on the host side)."""
+        from .sharding_plan import plan_of
+
+        plan = plan_of(program)
+        if plan is None or not plan.is_bound:
+            return None
+
+        def sh(name):
+            v = block._find_var_recursive(name)
+            shape = tuple(v.shape) if v is not None \
+                and v.shape is not None else None
+            return plan.sharding_for(name, shape)
+
+        repl = plan.replicated()
+        in_sh = ({n: sh(n) for n in carry_names},
+                 {n: sh(n) for n in const},
+                 repl,   # feeds dict (pytree prefix)
+                 repl)   # rng
+        # scan fetches are stacked [K, ...]: placement dims would be
+        # off by one — replicate them (fetches are host readbacks)
+        fetch_sh = [repl if scan else sh(n) for n in fetch_names]
+        out_sh = ({n: sh(n) for n in state_out}, fetch_sh, repl)
+        return in_sh, out_sh
 
     def _try_aot(self, jitted, fn, example_args):
         """Lower + compile ahead-of-time so the executable can be
@@ -1597,14 +1656,24 @@ class Executor:
         for n, spec in write_only_specs.items():
             carry_ex[n] = jnp.zeros(spec.shape, spec.dtype)
         donate = (0,) if self.donate else ()
-        layouts = _pin_state_layout_formats(
-            multi, carry_ex, const_ex, feed_arrays, rng_ex, self.place)
-        if layouts is not None:
+        carry_names = list(mutated) + list(write_only_specs)
+        plan_sh = self._plan_jit_shardings(program, block, carry_names,
+                                           const, carry_names,
+                                           fetch_names, scan=True)
+        if plan_sh is not None:
             jitted = jax.jit(multi, donate_argnums=donate,
-                             in_shardings=layouts[0],
-                             out_shardings=layouts[1])
+                             in_shardings=plan_sh[0],
+                             out_shardings=plan_sh[1])
         else:
-            jitted = jax.jit(multi, donate_argnums=donate)
+            layouts = _pin_state_layout_formats(
+                multi, carry_ex, const_ex, feed_arrays, rng_ex,
+                self.place)
+            if layouts is not None:
+                jitted = jax.jit(multi, donate_argnums=donate,
+                                 in_shardings=layouts[0],
+                                 out_shardings=layouts[1])
+            else:
+                jitted = jax.jit(multi, donate_argnums=donate)
         fn = jitted
         aot_art = None
         if aot:
@@ -1637,14 +1706,22 @@ class Executor:
         step = _build_step_fn(block, feed_names, mutated, const, state_out,
                               fetch_names, free_after=free_after)
         donate = (0,) if self.donate else ()
-        layouts = _default_layout_specs(
-            step, scope, mutated, const, feed_arrays, self.place)
-        if layouts is not None:
+        plan_sh = self._plan_jit_shardings(program, block, mutated,
+                                           const, state_out,
+                                           fetch_names)
+        if plan_sh is not None:
             jitted = jax.jit(step, donate_argnums=donate,
-                             in_shardings=layouts[0],
-                             out_shardings=layouts[1])
+                             in_shardings=plan_sh[0],
+                             out_shardings=plan_sh[1])
         else:
-            jitted = jax.jit(step, donate_argnums=donate)
+            layouts = _default_layout_specs(
+                step, scope, mutated, const, feed_arrays, self.place)
+            if layouts is not None:
+                jitted = jax.jit(step, donate_argnums=donate,
+                                 in_shardings=layouts[0],
+                                 out_shardings=layouts[1])
+            else:
+                jitted = jax.jit(step, donate_argnums=donate)
         fn = jitted
         aot_art = None
         if aot:

@@ -1,0 +1,220 @@
+"""ShardingPlan: the mesh-execution half of a sharded program.
+
+Reference counterpart: reference
+transpiler/distribute_transpiler.py:69 VarBlock / :1131
+_init_splited_vars sliced parameters across pservers by REWRITING the
+program; here the program is untouched — a plan is a {persistable/feed
+name -> {tensor dim -> mesh axis}} placement table attached to a
+Program (``attach``), and the Executor turns it into
+``jax.jit(in_shardings=..., out_shardings=...)`` over a named
+``jax.sharding.Mesh`` so XLA GSPMD inserts the collectives
+(SNIPPETS.md [1]/[3]'s ``Mesh + NamedSharding`` pattern).
+
+Design contract (why this is a separate object, not executor logic):
+
+* The SAME placement dict feeds THREE consumers that must never
+  drift: the static prover (``absint.mark_sharded`` annotations are
+  emitted from it at build time — PTA130/131/160/161 prove the serve
+  While branch-free of misplaced collectives), the runtime
+  (``sharding_for`` → NamedSharding for jit boundaries and
+  ``place_state`` device_puts), and the cache keys
+  (``token()`` joins the executor's in-memory keys, the disk compile
+  cache digest, and ``server_fingerprint`` — a sharded and a dense
+  build of one program must never dedupe, and a warm-start entry
+  compiled for one mesh must never rehydrate on another).
+* Devices bind LATE (``bind``): the plan is built with abstract axis
+  sizes (models/decode_engine.ShardingConfig) and the serving layer
+  binds it to a concrete device slice — that is how the runtime
+  places two tp=2 models on devices [0,1] and [2,3] of the 8-device
+  mesh (inference/runtime/placement.py).
+
+State round-trip stability: the executor pins BOTH entry and result
+shardings for every mutated persistable to the plan's placement, so
+donated state flows through repeated steps with a byte-stable layout
+and a prepared handle never re-specializes mid-traffic (the
+zero-steady-state-compiles contract, extended to sharded programs).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = ["ShardingPlan", "attach_plan", "plan_of",
+           "program_sharding_token"]
+
+
+class ShardingPlan:
+    """Placement table + named mesh for one sharded program family.
+
+    ``axes``: ordered (axis name, size) pairs — the mesh shape.
+    ``placements``: {var name -> {tensor dim -> axis name}} for every
+    persistable/feed that is NOT replicated (unlisted = replicated).
+
+    Reference counterpart: reference
+    framework/details/multi_devices_graph_pass.cc:40 decided
+    per-place replication/collectives by rewriting the SSA graph; the
+    plan is that decision as declarative metadata GSPMD executes.
+    """
+
+    def __init__(self, axes: Sequence[Tuple[str, int]],
+                 placements: Dict[str, Dict[int, str]],
+                 label: str = ""):
+        self.axes = tuple((str(n), int(s)) for n, s in axes)
+        self.placements = {
+            str(name): {int(d): str(a) for d, a in dims.items()}
+            for name, dims in placements.items()}
+        self.label = label
+        self._mesh = None           # bound jax.sharding.Mesh
+        self._device_ids: Tuple[int, ...] = ()
+
+    # --- identity -----------------------------------------------------
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for _, s in self.axes:
+            n *= s
+        return n
+
+    def axis_size(self, name: str) -> int:
+        for n, s in self.axes:
+            if n == name:
+                return s
+        return 1
+
+    def token(self) -> tuple:
+        """Stable content identity for cache keys and fingerprints:
+        mesh shape + every placement + (when bound) the flat device
+        ids, the executor's ``_mesh_token`` discipline — two plans
+        differing in any of these must never share an executable."""
+        return ("sharded", self.axes,
+                tuple(sorted((n, tuple(sorted(d.items())))
+                             for n, d in self.placements.items())),
+                self._device_ids)
+
+    # --- device binding -----------------------------------------------
+    @property
+    def is_bound(self) -> bool:
+        return self._mesh is not None
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            raise RuntimeError(
+                f"ShardingPlan({self.label or self.axes}) is not "
+                f"bound to devices yet — call plan.bind(devices) "
+                f"(the serving placement step) before executing")
+        return self._mesh
+
+    def bind(self, devices=None) -> "ShardingPlan":
+        """Bind the plan to a concrete device slice. ``devices=None``
+        means "the first ``n_devices`` of ``jax.devices()`` WHEN
+        UNBOUND, else keep the current binding" — a later server over
+        an already-placed bundle that does not name a slice must not
+        silently migrate the model back to the default slice (and
+        version-bump every program under a live server). Rebinding to
+        an explicitly DIFFERENT slice is allowed (the token changes,
+        so cached executables miss cleanly)."""
+        import numpy as np
+
+        import jax
+        from jax.sharding import Mesh
+
+        if devices is None:
+            if self._mesh is not None:
+                return self  # keep the existing binding
+            devices = jax.devices()[:self.n_devices]
+        devices = list(devices)
+        if len(devices) != self.n_devices:
+            raise ValueError(
+                f"ShardingPlan needs {self.n_devices} devices for "
+                f"mesh {self.axes}, got {len(devices)}")
+        ids = tuple(int(d.id) for d in devices)
+        if self._mesh is not None and ids == self._device_ids:
+            return self
+        shape = tuple(s for _, s in self.axes)
+        names = tuple(n for n, _ in self.axes)
+        self._mesh = Mesh(np.array(devices).reshape(shape), names)
+        self._device_ids = ids
+        return self
+
+    # --- shardings ----------------------------------------------------
+    def _pspec(self, name: str, shape=None):
+        from jax.sharding import PartitionSpec as P
+
+        dims = self.placements.get(name)
+        if not dims:
+            return P()
+        rank = len(shape) if shape is not None else \
+            (max(dims) + 1)
+        entries = [None] * rank
+        for d, a in dims.items():
+            if d >= rank:
+                return P()  # rank changed under us: replicate, safe
+            if shape is not None and shape[d] is not None \
+                    and shape[d] >= 0 and shape[d] % self.axis_size(a):
+                # non-divisible dim (the sharding.safe_spec rule):
+                # replicate rather than error at device_put
+                return P()
+            entries[d] = a
+        return P(*entries)
+
+    def sharding_for(self, name: str, shape=None):
+        """NamedSharding for one var (replicated when unlisted or the
+        placement does not divide the shape)."""
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self._pspec(name, shape))
+
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    def place_state(self, scope, names=None, shapes=None) -> int:
+        """device_put every initialized scope value in ``names``
+        (default: every placement key present in the scope) onto the
+        mesh per its placement — the one-time serving placement step.
+        Returns the number of arrays placed. Host-written state that
+        is re-set as numpy later still lands correctly: the jit
+        boundary's in_shardings re-places it per call."""
+        import numpy as np
+
+        import jax
+
+        placed = 0
+        if names is None:
+            names = list(self.placements)
+        for name in names:
+            val = scope._get(name)
+            if val is None:
+                continue
+            shape = tuple(np.shape(val))
+            sh = self.sharding_for(name, shape)
+            scope._set(name, jax.device_put(val, sh))
+            placed += 1
+        return placed
+
+    def __repr__(self):
+        return (f"ShardingPlan({self.label or ''} axes={self.axes}, "
+                f"{len(self.placements)} placements, "
+                f"bound={self.is_bound})")
+
+
+def attach_plan(program, plan: Optional[ShardingPlan]) -> None:
+    """Attach (or clear) the execution plan on a Program; bumps the
+    version so prepared handles / cached facts re-resolve."""
+    program._sharding_plan = plan
+    program._version = getattr(program, "_version", 0) + 1
+
+
+def plan_of(program) -> Optional[ShardingPlan]:
+    return getattr(program, "_sharding_plan", None)
+
+
+def program_sharding_token(program) -> tuple:
+    """The plan token for executor cache keys / disk digests; () for
+    unsharded programs (the historical key shape, so existing cache
+    entries stay valid)."""
+    plan = plan_of(program)
+    if plan is None:
+        return ()
+    return plan.token()

@@ -31,13 +31,16 @@ from __future__ import annotations
 
 from typing import Optional
 
+from .placement import (MeshPlacement, ReplicaSet, place_scope_on_device,
+                        plan_mesh)
 from .registry import ModelHandle, ModelRegistry, server_fingerprint
 from .router import AdmissionError, Router, TenantConfig
 from .stats import RuntimeStats
 
 __all__ = ["ServingRuntime", "ModelRegistry", "ModelHandle",
            "Router", "TenantConfig", "AdmissionError", "RuntimeStats",
-           "server_fingerprint"]
+           "server_fingerprint", "MeshPlacement", "ReplicaSet",
+           "plan_mesh", "place_scope_on_device"]
 
 
 class ServingRuntime:

@@ -1,0 +1,189 @@
+"""Mesh placement: carve the device mesh into model slices.
+
+Reference counterpart: the reference's multi-device serving story is
+process-per-model-per-device (reference
+inference/api/analysis_predictor.cc predictors + the
+multi_devices_graph_pass.cc replica graphs for training); here ONE
+process owns the whole mesh and the runtime places models on
+SLICES of it:
+
+* **tp slices** — a tensor-parallel decode model's
+  ``ShardingPlan`` binds to a contiguous device slice (2 tp=2 models
+  on devices [0,1] and [2,3] of the 8-device CPU mesh); the serving
+  layer's ``mesh_devices=`` kwarg routes here.
+* **dp lanes** — data-parallel replicas of a single-device model
+  (the fc/bucket path): each replica's scope is COMMITTED to its own
+  device (``place_scope_on_device``), jit then executes each
+  replica's dispatches on that device, and a ``ReplicaSet`` fans
+  requests across the lanes round-robin behind ONE server interface
+  so the existing registry/router machinery (aliases, hot swap,
+  token buckets, DRR) needs no changes.
+
+``plan_mesh`` is the default 8-device carve the ISSUE names: 2 tp-2
+decode models + 4 dp fc lanes.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+__all__ = ["MeshPlacement", "plan_mesh", "place_scope_on_device",
+           "ReplicaSet"]
+
+
+@dataclass
+class MeshPlacement:
+    """One carve of the device list: ``tp_slices[i]`` is the device
+    slice the i-th tensor-parallel model binds its ShardingPlan to;
+    ``dp_devices[j]`` is the device the j-th data-parallel replica
+    lane commits its scope to.
+
+    Reference counterpart: reference
+    framework/details/multi_devices_graph_pass.cc:40 — the per-place
+    device list its SSA graph builders replicate over, as data."""
+    tp_slices: List[list] = field(default_factory=list)
+    dp_devices: List[object] = field(default_factory=list)
+
+    def describe(self) -> str:
+        tps = [[int(d.id) for d in s] for s in self.tp_slices]
+        dps = [int(d.id) for d in self.dp_devices]
+        return f"tp_slices={tps} dp_lanes={dps}"
+
+
+def plan_mesh(n_tp_models: int = 2, tp: int = 2,
+              n_dp_lanes: int = 4, devices=None) -> MeshPlacement:
+    """Carve ``devices`` (default ``jax.devices()``) into
+    ``n_tp_models`` contiguous tp-wide slices followed by
+    ``n_dp_lanes`` single-device replica lanes — the 8-device
+    default: tp slices [0,1],[2,3] + dp lanes 4,5,6,7. Raises when
+    the mesh is too small (a silent wrap would co-locate models that
+    the capacity math assumes are disjoint).
+
+    Reference counterpart: reference platform/nccl_helper.h:90
+    NCCLContextMap's dev_ids carve — device-ring membership decided
+    once, up front."""
+    import jax
+
+    devices = list(devices) if devices is not None else jax.devices()
+    need = n_tp_models * tp + n_dp_lanes
+    if len(devices) < need:
+        raise ValueError(
+            f"plan_mesh needs {need} devices "
+            f"({n_tp_models} x tp{tp} + {n_dp_lanes} dp lanes), "
+            f"got {len(devices)}")
+    slices = [devices[i * tp:(i + 1) * tp]
+              for i in range(n_tp_models)]
+    dp = devices[n_tp_models * tp:n_tp_models * tp + n_dp_lanes]
+    return MeshPlacement(slices, dp)
+
+
+def place_scope_on_device(scope, device, names=None) -> int:
+    """Commit every (or the named) initialized scope array to ONE
+    device — the dp replica-lane placement: jit dispatches with
+    committed args execute on that device, so N lanes on N devices
+    serve concurrently without stepping on each other's core. Returns
+    the number of arrays placed.
+
+    Reference counterpart: reference framework/executor.cc:118 ran
+    one Executor per Place; committing a scope to a device is that
+    placement decision applied to the data instead of the loop."""
+    import jax
+
+    placed = 0
+    for name in (names if names is not None else list(scope._vars)):
+        val = scope._get(name)
+        if val is None:
+            continue
+        scope._set(name, jax.device_put(val, device))
+        placed += 1
+    return placed
+
+
+class ReplicaSet:
+    """N single-device replica servers behind ONE server interface —
+    the dp-lane aggregate the registry/router load as a single model.
+
+    submit() round-robins across the lanes (per-request state lives
+    in the returned future, so interleaving is safe); lifecycle
+    (quiesce/drain/close/start) and warmup fan out; ``stats()``
+    aggregates the counters the router/runtime read. The fingerprint
+    digests every member's program fingerprint + the lane device ids,
+    so a 4-lane and a 2-lane deployment of the same weights never
+    dedupe as 'same model' (they have different capacity envelopes).
+
+    Reference counterpart: reference
+    inference/api/analysis_predictor.cc:832 CreatePaddlePredictor —
+    one predictor per process per replica behind an external
+    balancer; this is that balancer folded into the in-process
+    runtime."""
+
+    def __init__(self, servers: List[object], devices=None):
+        if not servers:
+            raise ValueError("ReplicaSet needs at least one server")
+        self.servers = list(servers)
+        self.devices = list(devices) if devices is not None else []
+        self._rr = itertools.cycle(range(len(self.servers)))
+        self._lock = threading.Lock()
+
+    # --- the server surface the registry/router use -------------------
+    def submit(self, payload):
+        with self._lock:
+            idx = next(self._rr)
+        return self.servers[idx].submit(payload)
+
+    def aot_warmup(self):
+        for s in self.servers:
+            warm = getattr(s, "aot_warmup", None)
+            if warm is not None:
+                warm()
+
+    def start(self):
+        for s in self.servers:
+            s.start()
+
+    def quiesce(self):
+        for s in self.servers:
+            s.quiesce()
+
+    def drain(self, timeout: Optional[float] = 60.0) -> bool:
+        import time
+
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        ok = True
+        for s in self.servers:
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            ok = s.drain(left) and ok
+        return ok
+
+    def close(self, timeout: float = 5.0):
+        for s in self.servers:
+            s.close(timeout)
+
+    @property
+    def max_batch_size(self):
+        per = getattr(self.servers[0], "max_batch_size", None) \
+            or getattr(self.servers[0], "n_slots", None) or 8
+        return int(per) * len(self.servers)
+
+    def replica_fingerprint(self) -> str:
+        from ...core.compile_cache import canonical_digest
+        from .registry import server_fingerprint
+
+        return canonical_digest({
+            "kind": "replica_set",
+            "lanes": [server_fingerprint(s) for s in self.servers],
+            "devices": [int(d.id) for d in self.devices],
+        })
+
+    def stats(self, reset: bool = False) -> dict:
+        per = [s.stats(reset=reset) for s in self.servers]
+        agg = {"lanes": len(per), "per_lane": per}
+        for key in ("completed", "requests", "tokens"):
+            vals = [p.get(key) for p in per if p.get(key) is not None]
+            if vals:
+                agg[key] = sum(vals)
+        return agg

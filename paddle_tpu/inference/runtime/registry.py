@@ -53,6 +53,13 @@ def server_fingerprint(server) -> str:
     runner = getattr(server, "_runner", None)
     if runner is not None and hasattr(runner, "program"):
         return runner.program.fingerprint()
+    replica = getattr(server, "replica_fingerprint", None)
+    if replica is not None:
+        # a dp ReplicaSet (runtime/placement.py): digest over the
+        # member fingerprints + lane devices — a 4-lane and a 2-lane
+        # deployment of one model must not dedupe (different
+        # capacity envelopes)
+        return replica()
     bundle = getattr(server, "bundle", None)
     if bundle is not None:
         from ...core.compile_cache import canonical_digest

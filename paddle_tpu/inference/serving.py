@@ -959,7 +959,8 @@ class ContinuousGenerationServer:
                  drain_steps: Optional[int] = None,
                  exit_on_retire: bool = False,
                  admit_select=None,
-                 start: bool = True):
+                 start: bool = True,
+                 mesh_devices=None):
         bundle_cache = getattr(bundle, "cache", None)
         if (type(self) is ContinuousGenerationServer
                 and bundle_cache is not None
@@ -994,7 +995,24 @@ class ContinuousGenerationServer:
         self.exit_on_retire = bool(exit_on_retire)
         self.n_slots = bundle.n_slots
         self._end_id = bundle.end_id
+        if mesh_devices is not None \
+                and getattr(bundle, "sharding_plan", None) is None:
+            raise ValueError(
+                "mesh_devices given but the bundle carries no "
+                "sharding plan — build it with ShardingConfig(tp>1)")
         bundle.init_slot_state(self.scope)
+        # tensor-parallel bundles: bind the sharding plan to its
+        # device slice (``mesh_devices``; default the first tp
+        # devices) and place every persistable BEFORE the prepared
+        # handles bind below — params land replicated-on-mesh once,
+        # KV pools land head-sharded (per-device bytes ~1/tp), and
+        # the serve executables compile directly at the placed
+        # layout (models/decode_engine.place_sharded_bundle)
+        if getattr(bundle, "sharding_plan", None) is not None:
+            from ..models.decode_engine import place_sharded_bundle
+
+            place_sharded_bundle(bundle, self.scope,
+                                 devices=mesh_devices)
 
         # sampled/speculative bundle knobs (absent on pre-r14 plain
         # bundles): per-request seeds in the admission feeds, tokens

@@ -57,6 +57,84 @@ from ..param_attr import ParamAttr
 # lane-exclusive masked_pool_write (analysis/checkers.py)
 POOL_MARK = "@POOL"
 
+# the mesh-axis name that WOULD shard decode lanes across devices.
+# No shipped lowering shards lanes (tensor parallelism shards heads;
+# data parallelism is replica servers on disjoint device slices), so
+# a tp-only mesh proves the serve While's burst-exit predicate
+# uniform — but the burst-exit mark names this axis so that any
+# future lane-sharding mesh flips the prover back to
+# proven-divergent automatically (absint.mark_divergence_source
+# axes= semantics).
+LANE_AXIS = "lanes"
+
+
+@dataclass(frozen=True)
+class ShardingConfig:
+    """Tensor-parallel execution layout of a decode bundle — the
+    Megatron-LM composition (Shoeybi et al.; SNIPPETS.md [1]/[3]'s
+    ``Mesh + NamedSharding`` pattern) re-designed for the decode
+    engine's serving regime:
+
+    * self/cross KV state sharded along HEADS — dense per-lane
+      buffers ``[R, H/tp, T, Dh]``, the paged pools
+      ``[n_blocks, block_size, H/tp, Dh]`` — so per-device KV bytes
+      drop ~1/tp. Block tables / prompt refs stay host-owned and
+      REPLICATED: ``HostBlockPool`` and the PTA190/191 ownership
+      proofs are untouched.
+    * column/row-parallel ffn (fc1 out-dim, fc2 in-dim), row-parallel
+      attention out-projections, column-parallel cross-attention
+      query, vocab-sharded logits head. The implied psums/allgathers
+      sit inside the decode-burst While — legal under GSPMD exactly
+      because the burst-exit predicate is PROVEN value-uniform on a
+      tp-only mesh (PTA130/131/160/161; the r5 contract).
+    * the fused self-attention qkv projection and the fused cross-KV
+      projection stay REPLICATED deliberately: their ``split`` on the
+      fused output axis crosses tp shard boundaries (the contiguous
+      fused layout is not head-interleaved), so column-sharding them
+      would force a reshard collective EVERY tick — PTA160 rejects
+      that shape inside the While, and the serving win lives in the
+      KV bytes anyway (decode is bandwidth-bound; PERF.md "Sharded
+      serving").
+
+    ``dp`` replica lanes are NOT part of this config: data
+    parallelism is separate server instances on disjoint device
+    slices (inference/runtime/placement.py), each carrying its own
+    bound copy of this plan.
+
+    Reference counterpart: reference
+    transpiler/distribute_transpiler.py:69 VarBlock sliced params by
+    REWRITING programs at runtime; a declarative layout config the
+    compiler partitions from is the GSPMD-era shape.
+    """
+
+    tp: int = 1
+    axis: str = "tp"
+
+    @property
+    def enabled(self) -> bool:
+        return self.tp > 1
+
+    def validate(self, n_heads: int, vocab: int, d_model: int,
+                 d_inner: int):
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if not self.enabled:
+            return
+        for what, dim in (("n_heads", n_heads), ("vocab", vocab),
+                          ("d_model", d_model), ("d_inner", d_inner)):
+            if dim % self.tp:
+                raise ValueError(
+                    f"ShardingConfig(tp={self.tp}) needs {what} "
+                    f"divisible by tp, got {what}={dim}")
+        if self.axis == LANE_AXIS:
+            raise ValueError(
+                f"mesh axis {LANE_AXIS!r} is reserved for (future) "
+                f"lane sharding — the serve While's divergence mark "
+                f"names it; pick another tp axis name")
+
+    def token(self) -> tuple:
+        return ("tp", int(self.tp), self.axis)
+
 
 @dataclass(frozen=True)
 class CacheConfig:
@@ -565,7 +643,7 @@ def build_incremental_decode_program(seq_len=16, max_out_len=16,
                                      d_model=64, n_heads=4,
                                      n_layers=2, d_inner=128,
                                      vocab=1000, start_id=0,
-                                     end_id=1):
+                                     end_id=1, sharding=None):
     """KV-cached autoregressive greedy generation — the incremental
     variant of build_greedy_decode_program (reference
     tests/unittests/dist_transformer.py:1498 fast_decode caches
@@ -675,6 +753,16 @@ def build_incremental_decode_program(seq_len=16, max_out_len=16,
             emit_token_step(src, logits_v, positions, tgt_buf,
                             finished, counter, limit, cond, maxT,
                             end_id)
+    if sharding is not None and sharding.enabled:
+        sharding.validate(n_heads, vocab, d_model, d_inner)
+        # params-only tp layout (the per-request KV caches here are
+        # loop-local temporaries — the paged POOL is where per-device
+        # KV bytes matter); the emit While's guard derives purely
+        # from GSPMD-sharded values, which the prover classifies
+        # value-uniform (absint GSPMD-uniform guards)
+        annotate_sharded_program(
+            main, tp_param_placements(n_layers, sharding),
+            ((sharding.axis, sharding.tp),))
     return main, startup, ["src_ids"], tgt_buf
 
 
@@ -758,7 +846,20 @@ class DecodeStepBundle:
         self.cache = cache or CacheConfig()
         self.sampling = sampling         # SamplingConfig | None
         self.draft = draft               # DraftConfig | None
+        self.sharding = None             # ShardingConfig | None
+        self.sharding_plan = None        # core.sharding_plan plan
         self._state_specs = {}
+
+    def programs(self):
+        """Every program of the bundle, in a stable order (prefills,
+        hit prefills, step, serves) — the sweep surface for sharding
+        annotation/placement and zoo registration."""
+        out = [p for _a, p in sorted(self.prefills.items())]
+        out += [p for _a, p in sorted(self.hit_prefills.items())]
+        out.append(self.step)
+        out += [p for _k, p in sorted(self.serves.items(),
+                                      key=lambda kv: str(kv[0]))]
+        return out
 
     @property
     def spec_k(self) -> int:
@@ -791,6 +892,13 @@ class DecodeStepBundle:
             tok = tok + self.draft.token()
         if self.sampling is not None:
             tok = tok + self.sampling.token()
+        if self.sharding is not None and self.sharding.enabled:
+            # mesh shape + axis: a tp-sharded and a dense build over
+            # the same weights serve different executables on
+            # different device footprints — they must never dedupe
+            # or hot-swap as "same model" (the plan token additionally
+            # separates DEVICE slices at the compile-cache layer)
+            tok = tok + self.sharding.token()
         return tok
 
     def serve_feed_spec(self, key) -> List[tuple]:
@@ -925,6 +1033,154 @@ def _declare_slot_state(block, specs):
             for name, (shape, dt) in specs.items()}
 
 
+def tp_param_placements(n_layers: int, sharding: "ShardingConfig",
+                        prefix: str = "") -> Dict[str, dict]:
+    """{param name -> {dim: axis}} of the Megatron column/row-parallel
+    decoder layout for the explicit ``{prefix}dec{li}_*`` name scheme
+    (ShardingConfig docstring: fused qkv / fused cross-kv stay
+    replicated — their fused-axis split crosses tp shard boundaries;
+    biases stay replicated — GSPMD slices them locally for free)."""
+    ax = sharding.axis
+    out: Dict[str, dict] = {f"{prefix}logits.w": {1: ax}}
+    for li in range(n_layers):
+        out[f"{prefix}dec{li}_self_out.w"] = {0: ax}
+        out[f"{prefix}dec{li}_cross_q.w"] = {1: ax}
+        out[f"{prefix}dec{li}_cross_out.w"] = {0: ax}
+        out[f"{prefix}dec{li}_fc1.w"] = {1: ax}
+        out[f"{prefix}dec{li}_fc2.w"] = {0: ax}
+    return out
+
+
+def _tp_state_placements(state_prefix, n_layers, cache, sharding
+                         ) -> Dict[str, dict]:
+    """{slot-state name -> {dim: axis}}: KV sharded along heads (dim
+    1 of the dense ``[R, H, T, Dh]`` lane buffers; dim 2 of the paged
+    ``[NB, BS, H, Dh]`` self pool, dim 1 of the ``[E+1, H, S, Dh]``
+    cross pool). Tables/masks/counters/draft state stay replicated —
+    block tables in particular remain host-owned replicated int32, so
+    the ownership story (PTA190/191) is untouched."""
+    ax = sharding.axis
+    out: Dict[str, dict] = {}
+    for li in range(n_layers):
+        if cache.layout == "dense":
+            out[f"{state_prefix}self_k{li}"] = {1: ax}
+            out[f"{state_prefix}self_v{li}"] = {1: ax}
+            out[f"{state_prefix}cross_k{li}"] = {1: ax}
+            out[f"{state_prefix}cross_v{li}"] = {1: ax}
+        else:
+            out[f"{state_prefix}self_k{li}{POOL_MARK}"] = {2: ax}
+            out[f"{state_prefix}self_v{li}{POOL_MARK}"] = {2: ax}
+            out[f"{state_prefix}cross_k{li}{POOL_MARK}"] = {1: ax}
+            out[f"{state_prefix}cross_v{li}{POOL_MARK}"] = {1: ax}
+    return out
+
+
+def annotate_sharded_program(program, placements: Dict[str, dict],
+                             mesh_axes, plan=None):
+    """Wire ONE program into both halves of the sharded story from
+    one placement table: the PROVER half (``absint.set_mesh`` + a
+    ``mark_sharded`` pin per var present in the program, so
+    PTA130/131/160/161 judge the real lowering) and the EXECUTION
+    half (a shared ``core.sharding_plan.ShardingPlan`` attached for
+    the Executor's jit in/out_shardings and cache-key tokens).
+    Returns the plan (created when not passed) so a program family —
+    every specialization of one bundle — shares one bind site."""
+    from ..core import sharding_plan as sp
+
+    absint.set_mesh(program,
+                    absint.MeshConfig.make(**dict(mesh_axes)))
+    blk = program.global_block
+    for name, dims in placements.items():
+        var = blk.vars.get(name) or blk._find_var_recursive(name)
+        if var is None:
+            continue  # this specialization never touches the var
+        absint.mark_sharded(var, dims)
+    if plan is None:
+        plan = sp.ShardingPlan(tuple(mesh_axes), placements)
+    sp.attach_plan(program, plan)
+    return plan
+
+
+def _apply_tp_sharding(bundle: "DecodeStepBundle",
+                       sharding: "ShardingConfig", n_layers: int):
+    """Annotate every program of a bundle with the tp layout and
+    attach ONE shared execution plan (ShardingConfig docstring)."""
+    placements = dict(tp_param_placements(n_layers, sharding))
+    placements.update(_tp_state_placements(
+        _state_prefix_of(bundle), n_layers, bundle.cache, sharding))
+    mesh_axes = ((sharding.axis, sharding.tp),)
+    plan = None
+    for prog in bundle.programs():
+        plan = annotate_sharded_program(prog, placements, mesh_axes,
+                                        plan=plan)
+    bundle.sharding = sharding
+    bundle.sharding_plan = plan
+    return plan
+
+
+def _state_prefix_of(bundle) -> str:
+    """Recover the state prefix from any state entry ('@cb/' style:
+    everything up to and including the last '/')."""
+    name = bundle.state["tok_buf"]
+    return name[:len(name) - len("tok_buf")]
+
+
+def place_sharded_bundle(bundle: "DecodeStepBundle", scope,
+                         devices=None) -> int:
+    """The one-time serving placement step for a sharded bundle: bind
+    the plan to a device slice (default: the first tp devices) and
+    device_put EVERY persistable the bundle's programs read — sharded
+    per the placement table, replicated otherwise — so steady-state
+    dispatches never re-transfer params and per-device KV actually
+    shrinks. Returns the number of arrays placed. Call AFTER params
+    are trained/loaded and ``init_slot_state`` ran."""
+    from ..core import sharding_plan as sp
+
+    plan = getattr(bundle, "sharding_plan", None)
+    if plan is None:
+        raise ValueError("bundle has no sharding plan — build it "
+                         "with ShardingConfig(tp>1)")
+    ids_before = plan._device_ids
+    plan.bind(devices)
+    rebound = plan._device_ids != ids_before
+    names = set(bundle._state_specs)
+    for prog in bundle.programs():
+        blk = prog.global_block
+        for name, var in blk.vars.items():
+            if var.persistable:
+                names.add(name)
+        # version-bump ONLY on a real (re)bind: prepared handles
+        # bound against the old device slice must re-resolve, but a
+        # second server over the SAME placement (fresh scope, same
+        # slice) must hit the warmed executables — an unconditional
+        # bump recompiled every serve program per server
+        # construction (caught by bench.py sharded's zero-steady-
+        # state-compiles assertion)
+        if rebound or sp.plan_of(prog) is not plan:
+            sp.attach_plan(prog, plan)
+    return plan.place_state(scope, sorted(names))
+
+
+def place_sharded_program(program, scope, devices=None) -> int:
+    """``place_sharded_bundle`` for a single whole-loop program
+    (build_incremental_decode_program(sharding=...)): bind the plan
+    and device_put the program's persistables (params; the loop's KV
+    caches are trace-local temporaries)."""
+    from ..core import sharding_plan as sp
+
+    plan = sp.plan_of(program)
+    if plan is None:
+        raise ValueError("program has no sharding plan — build it "
+                         "with sharding=ShardingConfig(tp>1)")
+    ids_before = plan._device_ids
+    plan.bind(devices)
+    names = sorted(v.name for v in program.list_vars()
+                   if getattr(v, "persistable", False))
+    if plan._device_ids != ids_before:
+        sp.attach_plan(program, plan)  # re-bound: re-resolve handles
+    return plan.place_state(scope, names)
+
+
 def _param_probe(prefix, seq_len, max_out_len, d_model, n_heads,
                  n_layers, d_inner, vocab):
     """Tiny program whose only job is to CREATE every parameter the
@@ -1016,7 +1272,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                               vocab=1000, start_id=0, end_id=1,
                               n_slots=8, admit_buckets=None,
                               state_prefix="@cb/", cache=None,
-                              sampling=None, draft=None):
+                              sampling=None, draft=None,
+                              sharding=None):
     """Build the slot-pool continuous-batching bundle (bucketed
     admission prefills + single-step decode over ``n_slots``
     device-resident lanes) — see DecodeStepBundle. The step program's
@@ -1053,6 +1310,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
 
     cache = cache or CacheConfig()
     cache.validate(max_out_len)
+    if sharding is not None:
+        sharding.validate(n_heads, vocab, d_model, d_inner)
     if sampling is not None:
         sampling.validate()
     if draft is not None:
@@ -1846,13 +2105,22 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                     cond=cond)
                 # divergence-source annotation (analysis/absint.py
                 # seed table): this predicate derives from the
-                # per-lane active mask — the moment PR 12 shards
-                # lanes across a dp mesh axis it differs per device,
+                # per-lane active mask — the moment a lowering shards
+                # LANES across a mesh axis it differs per device,
                 # and the burst While becomes divergent control
                 # flow. The prover (PTA130/131) uses the mark to
                 # REJECT collectives/sharded values inside the burst
-                # with a proof instead of a pattern guess.
-                absint.mark_divergence_source(out, "lane_active_mask")
+                # with a proof instead of a pattern guess. axes=
+                # names the lane-sharding axis: on a tp-only mesh
+                # (heads sharded, lanes replicated) the mark is
+                # provably inert and the guard classifies from its
+                # actual inputs — which is what lets the tp-sharded
+                # serve programs carry their vocab-psum INSIDE the
+                # burst legally (GSPMD-uniform control flow), while
+                # any future lanes-sharding mesh flips this back to
+                # proven-divergent automatically.
+                absint.mark_divergence_source(out, "lane_active_mask",
+                                              axes=(LANE_AXIS,))
                 return out
 
             cond = _serve_cond()
@@ -1914,6 +2182,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                               sampling=sampling, draft=draft)
     bundle._state_specs = {
         n: (shape, dt) for n, (shape, dt) in specs.items()}
+    if sharding is not None and sharding.enabled:
+        _apply_tp_sharding(bundle, sharding, n_layers)
     return bundle
 
 
@@ -2276,8 +2546,11 @@ class PromptPrefixCache:
 
 
 __all__ = ["CacheConfig", "SamplingConfig", "DraftConfig",
-           "DecodeStepBundle", "DECODE_STEPS_VAR",
-           "POOL_MARK", "BlockPoolExhausted", "BlockLifetimeError",
+           "ShardingConfig", "DecodeStepBundle", "DECODE_STEPS_VAR",
+           "POOL_MARK", "LANE_AXIS",
+           "tp_param_placements", "annotate_sharded_program",
+           "place_sharded_bundle", "place_sharded_program",
+           "BlockPoolExhausted", "BlockLifetimeError",
            "HostBlockPool",
            "PromptPrefixCache", "build_greedy_decode_program",
            "build_incremental_decode_program",

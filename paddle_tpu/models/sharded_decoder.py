@@ -1,28 +1,31 @@
-"""tp-sharded decoder-step fixture: the sharded-serving entry proof.
+"""tp-sharded decoder-step fixture — a thin wrapper over the REAL
+sharded lowering.
 
-ONE tensor-parallel ``cached_decoder_step`` program — the exact step
-body the slot-pool serving stack dispatches (models/decode_engine.py)
-— annotated with the Megatron-LM layout (Shoeybi et al.: column-
-parallel qkv/fc1, row-parallel out/fc2, vocab-parallel logits head,
-self/cross KV sharded along heads) on a named dp x tp mesh. The
-annotations are EXACTLY the surface PR 13's sharded serving lowerings
-will emit (absint.mark_sharded placements + absint.set_mesh); nothing
-in the engine changes — this module only marks the already-built step
-program, so the sharded lowerings inherit a prover and a memory
-planner that are already green on the real program shape:
+Until PR 15 this module hand-annotated a stock dense bundle with a
+prospective Megatron layout so the sharding prover and the per-device
+memory planner could be built ahead of the feature. The sharded
+serving lowering has now landed in the engine itself
+(``models/decode_engine.ShardingConfig`` →
+``build_decode_step_program(sharding=...)``), so this fixture simply
+builds a tp-sharded bundle through the SHIPPED code path and exposes
+its step program — the zoo target (analysis/targets.py
+``sharded_decoder``) and the memory-plan tests lint/price the code
+that actually serves, not a hand-built twin.
 
-* the sharding domain propagates the head-sharded layout through the
-  cached attention (scores/context ride ``{1: tp}``, the row-parallel
-  out-projections imply the psum over ``tp`` exactly where Megatron
-  places it), and the strict lint zoo pins the whole fixture
-  error-free (analysis/targets.py ``sharded_decoder`` target);
-* the PTA170 planner prices the per-device KV state at ~1/tp of the
-  unsharded bundle — the ROADMAP's "per-device KV bytes shrinking
-  ~1/tp via memory_analysis()" claim as a machine-checked number
-  (tests/test_memory_plan.py);
-* the baseline's ``sharding_facts`` section snapshots the propagated
-  specs, so any drift in the propagation rules shows up as a CI diff
-  instead of a silently different layout.
+What the shipped layout pins (ShardingConfig docstring has the full
+rationale):
+
+* self/cross KV state sharded along heads (dim 1 of the dense
+  ``[rows, H, maxT, Dh]`` lane buffers; the paged pools shard
+  ``[n_blocks, block_size, H/tp, Dh]``) — per-device KV bytes exactly
+  1/tp (tests/test_memory_plan.py);
+* row-parallel attention out-projections + column/row-parallel ffn
+  (their psums are the PTA161-proof obligations), column-parallel
+  cross-attention query, vocab-sharded logits head;
+* the fused self-attention qkv and the fused cross-KV projections
+  REPLICATED (their fused-axis split crosses tp shard boundaries —
+  sharding them would force a per-tick reshard, which PTA160 rejects
+  inside the serve While).
 
 Reference counterpart: none — the reference sharded at runtime via
 transpilers (reference transpiler/distribute_transpiler.py); a
@@ -46,12 +49,12 @@ TP_AXIS = "tp"
 
 @dataclass
 class ShardedDecoderFixture:
-    """The annotated step program plus everything tests need to
-    assert the sharding story: the un-annotated bundle it came from,
-    the mesh, and the annotated name -> placement map."""
+    """The sharded step program plus everything tests need to assert
+    the sharding story: the bundle it came from, the mesh, and the
+    annotated name -> placement map."""
     program: object                 # the tp-annotated step program
     startup: object
-    bundle: object                  # the DecodeStepBundle (dense)
+    bundle: object                  # the tp-sharded DecodeStepBundle
     mesh: absint.MeshConfig
     placements: Dict[str, dict] = field(default_factory=dict)
     kv_names: List[str] = field(default_factory=list)
@@ -62,19 +65,7 @@ class ShardedDecoderFixture:
         return self.bundle.kv_state_bytes()
 
 
-def _annotate(block, placements, name, dims):
-    var = block.vars.get(name)
-    if var is None:
-        var = block._find_var_recursive(name)
-    if var is None:
-        raise KeyError(f"sharded_decoder fixture: no var {name!r} in "
-                       f"the step program")
-    absint.mark_sharded(var, dims)
-    placements[name] = dict(dims)
-    return var
-
-
-def build_tp_sharded_decoder_step(tp: int = 2, dp: int = 4,
+def build_tp_sharded_decoder_step(tp: int = 2,
                                   seq_len: int = 8,
                                   max_out_len: int = 8,
                                   d_model: int = 32, n_heads: int = 4,
@@ -83,50 +74,24 @@ def build_tp_sharded_decoder_step(tp: int = 2, dp: int = 4,
                                   n_slots: int = 4,
                                   state_prefix: str = "@tpfx/"
                                   ) -> ShardedDecoderFixture:
-    """Build the dense decode-step bundle and annotate its step
-    program with the Megatron tensor-parallel layout (annotations
-    only — the builder is the stock
-    transformer.build_decode_step_program)."""
+    """Build a dense decode-step bundle through the REAL sharded
+    lowering (``ShardingConfig(tp=tp)``) and expose its step program
+    as the prover/planner fixture."""
     from . import transformer as T
+    from .decode_engine import ShardingConfig
 
-    if n_heads % tp:
-        raise ValueError(f"n_heads={n_heads} must divide over tp={tp}")
     with unique_name.guard():
         bundle = T.build_decode_step_program(
             seq_len=seq_len, max_out_len=max_out_len, d_model=d_model,
             n_heads=n_heads, n_layers=n_layers, d_inner=d_inner,
-            vocab=vocab, n_slots=n_slots, state_prefix=state_prefix)
+            vocab=vocab, n_slots=n_slots, state_prefix=state_prefix,
+            sharding=ShardingConfig(tp=tp, axis=TP_AXIS))
     step = bundle.step
-    mesh = absint.MeshConfig.make(**{DP_AXIS: dp, TP_AXIS: tp})
-    absint.set_mesh(step, mesh)
-    blk = step.global_block
-    placements: Dict[str, dict] = {}
-    kv_names: List[str] = []
-    # --- KV cache state: sharded along heads (dim 1 of the dense
-    # [rows, H, T, Dh] per-lane buffers) — the paged analogue is the
-    # ROADMAP's [n_blocks, block_size, H/tp, Dh] pool ---
-    for name in bundle._state_specs:
-        short = name.split("/")[-1]
-        if short.startswith(("self_k", "self_v", "cross_k",
-                             "cross_v")):
-            _annotate(blk, placements, name, {1: TP_AXIS})
-            kv_names.append(name)
-    # --- decoder params: Megatron column/row-parallel pairs ---
-    for li in range(n_layers):
-        _annotate(blk, placements, f"dec{li}_self_qkv.w",
-                  {1: TP_AXIS})      # column-parallel fused qkv
-        _annotate(blk, placements, f"dec{li}_self_out.w",
-                  {0: TP_AXIS})      # row-parallel out projection
-        _annotate(blk, placements, f"dec{li}_cross_q.w",
-                  {1: TP_AXIS})
-        _annotate(blk, placements, f"dec{li}_cross_out.w",
-                  {0: TP_AXIS})
-        _annotate(blk, placements, f"dec{li}_fc1.w", {1: TP_AXIS})
-        _annotate(blk, placements, f"dec{li}_fc2.w", {0: TP_AXIS})
-    # --- vocab-parallel logits head (the Megatron output layer whose
-    # branch-internal psum IS the 1F1B x tp rejection when it lands
-    # under a divergent guard — here it sits in straight-line code,
-    # which is exactly what the PTA161 proof requires) ---
-    _annotate(blk, placements, "logits.w", {1: TP_AXIS})
-    return ShardedDecoderFixture(step, bundle.startup, bundle, mesh,
-                                 placements, kv_names)
+    placements = dict(bundle.sharding_plan.placements)
+    kv_names = [
+        name for name in bundle._state_specs
+        if name.split("/")[-1].startswith(("self_k", "self_v",
+                                           "cross_k", "cross_v"))]
+    return ShardedDecoderFixture(step, bundle.startup, bundle,
+                                 absint.mesh_of(step), placements,
+                                 kv_names)

@@ -45,6 +45,9 @@ SLOW_MODULES = {
     "test_control_flow_decode",  # beam-search decode loops
     "test_train_demo",
     "test_sharded_checkpoint",
+    "test_sharded_serving",      # trained-model tp/dp serving suite
+    #                              (tests/test_sharding_plan.py keeps
+    #                              the fast-lane sharded smoke)
     "test_recompute",
     "test_dgc_gradmerge",
     "test_structural_sharding",

@@ -566,6 +566,204 @@ class TestPTA161:
                 layers.less_than(i, limit, cond=cond)
         assert not _diags(main, "PTA161")
 
+    def test_mixed_manual_and_sharded_guard_stays_divergent(self):
+        """The GSPMD-uniform reclassification must NOT fire for a
+        predicate that mixes sharded values with a MANUAL divergence
+        source: the sticky ValueFact.manual bit survives joins even
+        when the sharded operand comes FIRST and its 'sharding:*'
+        source string wins the join — a psum under such a guard is
+        still a proven deadlock."""
+        main, startup, g = _guarded()
+        with g:
+            x = _data("x", (8, 16), {1: "tp"})
+            stage = layers.fill_constant([1], "float32", 0.0)
+            absint.mark_divergence_source(stage, "pp_stage_id")
+            # sharded ancestry FIRST, manual second: the joined
+            # fact's source string is the sharding one
+            sx = layers.reduce_sum(x, dim=1)          # varying: tp
+            mixed = layers.elementwise_add(
+                layers.reduce_sum(sx, dim=0, keep_dim=True), stage)
+            one = layers.fill_constant([1], "float32", 1.0)
+            cond = layers.less_than(mixed, one)
+            w = layers.While(cond)
+            with w.block():
+                layers.reduce_sum(x, dim=1)  # implied psum in body
+                layers.less_than(mixed, one, cond=cond)
+        ds = _diags(main, "PTA161")
+        assert ds and ds[0].severity == ERROR
+        assert "pp_stage_id" in ds[0].message
+
+
+# ---------------------------------------------------------------------------
+# paged/spec op families vs GSPMD's ACTUAL choice (the r17 satellite:
+# an unregistered op blinds PTA160/161 and inflates the PTA170 plan on
+# exactly the sharded serve programs — these pin each family's rule
+# against what XLA does on the 8-dev mesh)
+# ---------------------------------------------------------------------------
+class TestPagedSpecOpRules:
+    def _facts(self, main):
+        return absint.analyze(main)
+
+    def test_masked_pool_write_keeps_pool_layout(self):
+        NB, BS, H, Dh, R = 8, 4, 4, 4, 5
+        main, startup, g = _guarded()
+        with g:
+            pool = main.global_block.create_var(
+                name="@rulepool", shape=(NB, BS, H, Dh),
+                dtype="float32", persistable=True,
+                stop_gradient=True)
+            absint.mark_sharded(pool, {2: "tp"})
+            new = _data("new", (R, H, Dh))
+            idx = _data("idx", (R,), dtype="int64")
+            gate = _data("gate", (R,))
+            layers.masked_pool_write(pool, new, idx, gate=gate,
+                                     leading_dims=2,
+                                     exclusive_via="block_table")
+        facts = self._facts(main)
+        assert facts.spec("@rulepool") == ShardSpec.of({2: "tp"})
+        # replicated New into a sharded pool is a local slice — the
+        # rule must NOT claim a reshard (free under GSPMD)
+        assert not [es for es in facts.collective_events
+                    if es.event.kind == "reshard"]
+
+        import jax.numpy as jnp
+
+        def fn(pool, new, idx, gate):
+            n = NB * BS
+            pf = pool.reshape(n, -1)
+            nf = new.reshape(R, -1).astype(pf.dtype)
+            ii = idx.reshape(R).astype(jnp.int32)
+            keep = (ii >= 0) & (ii < n) & (gate.reshape(R) > 0)
+            safe = jnp.where(keep, ii, n)
+            padded = jnp.concatenate(
+                [pf, jnp.zeros((1,) + pf.shape[1:], pf.dtype)], 0)
+            return padded.at[safe].set(nf)[:n].reshape(pool.shape)
+
+        got = _jax_out_pspec(
+            fn,
+            [np.zeros((NB, BS, H, Dh), np.float32),
+             np.ones((R, H, Dh), np.float32),
+             np.arange(R, dtype=np.int32), np.ones(R, np.float32)],
+            [(None, None, "tp", None), (), (), ()], 4)
+        assert got == _spec_to_pspec(facts.spec("@rulepool"), 4)
+
+    def test_span_scatter_keeps_buffer_layout(self):
+        R, T, W = 8, 16, 4
+        main, startup, g = _guarded()
+        with g:
+            buf = main.global_block.create_var(
+                name="@rulebuf", shape=(R, T), dtype="int64",
+                persistable=True, stop_gradient=True)
+            absint.mark_sharded(buf, {0: "dp"})
+            vals = _data("vals", (R, W), dtype="int64")
+            start = _data("start", (R,), dtype="int64")
+            count = _data("count", (R,), dtype="int64")
+            layers.span_scatter(buf, vals, start, count)
+        facts = self._facts(main)
+        assert facts.spec("@rulebuf") == ShardSpec.of({0: "dp"})
+
+        import jax.numpy as jnp
+
+        def fn(buf, vals, start, count):
+            pos = jnp.arange(T)[None, :]
+            rel = pos - start[:, None]
+            sel = (rel >= 0) & (rel < count[:, None]) & (rel < W)
+            relc = jnp.clip(rel, 0, W - 1)
+            va = jnp.take_along_axis(vals, relc, axis=1)
+            return jnp.where(sel, va.astype(buf.dtype), buf)
+
+        got = _jax_out_pspec(
+            fn,
+            [np.zeros((R, T), np.int64), np.ones((R, W), np.int64),
+             np.zeros(R, np.int64), np.full(R, 2, np.int64)],
+            [("dp", None), (), (), ()], 2)
+        assert got == _spec_to_pspec(facts.spec("@rulebuf"), 2)
+
+    def test_filtered_softmax_keeps_vocab_shard_and_implies_psum(self):
+        R, V = 8, 64
+        main, startup, g = _guarded()
+        with g:
+            z = _data("z", (R, V), {1: "tp"})
+            p = layers.filtered_softmax(z, temperature=0.8, top_k=8,
+                                        top_p=0.95)
+        facts = self._facts(main)
+        assert facts.spec(p.name) == ShardSpec.of({1: "tp"})
+        psums = [es for es in facts.collective_events
+                 if es.event.kind == "psum"]
+        assert psums and all("tp" in es.event.axes for es in psums)
+
+        import jax
+        import jax.numpy as jnp
+
+        def fn(z):
+            zz = (z / 0.8).astype(jnp.float32)
+            kth = jax.lax.top_k(zz, 8)[0][..., -1:]
+            zz = jnp.where(zz >= kth, zz, -jnp.inf)
+            pr = jax.nn.softmax(zz, axis=-1)
+            ps = jnp.sort(pr, axis=-1)[..., ::-1]
+            cs = jnp.cumsum(ps, axis=-1)
+            keep = (cs - ps) < 0.95
+            cut = jnp.min(jnp.where(keep, ps, jnp.inf), axis=-1,
+                          keepdims=True)
+            pr = jnp.where(pr >= cut, pr, 0.0)
+            return pr / jnp.sum(pr, axis=-1, keepdims=True)
+
+        got = _jax_out_pspec(fn, [np.random.rand(R, V).astype(
+            np.float32)], [(None, "tp")], 2)
+        assert got == _spec_to_pspec(facts.spec(p.name), 2)
+
+    def test_sample_categorical_replicates_and_implies_gather(self):
+        R, V = 8, 64
+        main, startup, g = _guarded()
+        with g:
+            probs = _data("probs", (R, V), {1: "tp"})
+            seed = _data("seed", (R,), dtype="int64")
+            pos = _data("pos", (R,), dtype="int64")
+            tok = layers.sample_categorical(probs, seed, pos)
+        facts = self._facts(main)
+        assert facts.spec(tok.name).is_replicated
+        ag = [es for es in facts.collective_events
+              if es.event.kind == "allgather"]
+        assert ag and "tp" in ag[0].event.axes
+
+    def test_spec_accept_replicates_and_implies_gather(self):
+        R, V, k = 8, 64, 2
+        main, startup, g = _guarded()
+        with g:
+            props = _data("props", (R, k), dtype="int64")
+            dprobs = _data("dprobs", (R, k, V))
+            tprobs = _data("tprobs", (R, k + 1, V), {2: "tp"})
+            seed = _data("seed", (R,), dtype="int64")
+            pos = _data("pos", (R,), dtype="int64")
+            adv, toks, acc, fin = layers.spec_accept(
+                props, dprobs, tprobs, seed, pos, k=k, end_id=1,
+                max_len=16, greedy=True)
+        facts = self._facts(main)
+        for v in (adv, toks, acc, fin):
+            assert facts.spec(v.name).is_replicated, v.name
+        ag = [es for es in facts.collective_events
+              if es.event.kind == "allgather"]
+        assert ag and "tp" in ag[0].event.axes
+
+        import jax.numpy as jnp
+
+        def fn(props, dprobs, tprobs):
+            px = jnp.take_along_axis(tprobs[:, :k], props[..., None],
+                                     axis=-1)[..., 0]
+            qx = jnp.take_along_axis(dprobs, props[..., None],
+                                     axis=-1)[..., 0]
+            a = jnp.cumprod((qx < px).astype(jnp.int64),
+                            axis=1).sum(axis=1)
+            return a
+
+        got = _jax_out_pspec(
+            fn,
+            [np.zeros((R, k), np.int64),
+             np.random.rand(R, k, V).astype(np.float32),
+             np.random.rand(R, k + 1, V).astype(np.float32)],
+            [(), (), (None, None, "tp")], 1)
+        assert got == _spec_to_pspec(facts.spec(adv.name), 1)
+
 
 # ---------------------------------------------------------------------------
 # the tp-sharded decoder fixture (analysis/targets.py zoo target)
@@ -600,7 +798,10 @@ class TestShardedDecoderFixture:
     def test_sharding_facts_are_stable_surface_only(self, tp_fixture):
         facts = absint.analyze(tp_fixture.program)
         stable = facts.stable_sharding_facts()
-        assert stable["@mesh"] == "dp=4xtp=2"
+        # the REAL lowering's mesh: tp only (dp replica lanes are
+        # separate server instances on disjoint device slices, not a
+        # mesh axis of one program)
+        assert stable["@mesh"] == "tp=2"
         assert stable["logits.w"] == "dim1:tp"
         # tmp_N propagation intermediates stay OUT of the baseline
         assert not any(k.startswith("tmp") or ".tmp" in k
