@@ -408,10 +408,14 @@ def pool_index_sources() -> Dict[str, OwnershipSource]:
 register_pool_index_source(
     "block_table",
     "per-lane block rows the HOST allocator wrote into the fed/"
-    "persistable block table: HostBlockPool.alloc hands each block "
-    "to exactly one lane until freed, so distinct lanes' rows are "
-    "disjoint and any index selected from a lane's row stays inside "
-    "that lane's blocks",
+    "persistable block table: every block in a lane's WRITE-REACHABLE "
+    "suffix (table positions >= the lane's resume step page) is "
+    "exclusive to it (HostBlockPool refcount==1 between alloc and "
+    "free/decref), while radix-shared blocks (refcount>1) appear "
+    "only in the read-only prefix BELOW the resume step — so the "
+    "step body's act-gated current-position write always lands in "
+    "an exclusive block, and distinct lanes' writable rows are "
+    "disjoint",
     TS_EXCLUSIVE, assumption="HostBlockPool.alloc-disjoint")
 register_pool_index_source(
     "host_indices",
@@ -433,6 +437,24 @@ register_pool_index_source(
     "a block-table pool write must carry so idle/dustbin/paused "
     "lanes write nothing",
     TS_GATE, indicator=True)
+register_pool_index_source(
+    "cow_src",
+    "COW copy sources: blocks of a radix-SHARED chain "
+    "(HostBlockPool refcount>=1, typically >1) the host feeds to "
+    "the bundle's cow program — read-legal (the gather side of the "
+    "copy), write-ILLEGAL: an index with this tag reaching a pool "
+    "write is exactly the write-while-shared violation PTA192 "
+    "rejects",
+    TS_SHARED)
+register_pool_index_source(
+    "cow_dst",
+    "COW copy destinations: blocks freshly popped from "
+    "HostBlockPool.alloc (refcount==1, exclusive) for this copy "
+    "dispatch, pairwise-distinct and disjoint from every live "
+    "chain; padded rows aim at -1 (the trash row) under gate 0 — "
+    "the exclusive write window a lane diverges into when it "
+    "branches off a shared prefix",
+    TS_EXCLUSIVE, assumption="HostBlockPool.cow-fresh-exclusive")
 
 
 @dataclass(frozen=True)

@@ -1084,8 +1084,10 @@ def check_write_only_carry(program: Program):
 from .absint import POOL_MARK  # noqa: E402
 
 # the builder-declared reasons row indices of a shared-pool write
-# cannot alias (layers/extras.py masked_pool_write documents both)
-_POOL_EXCLUSIVE_VIA = ("block_table", "host_indices")
+# cannot alias (layers/extras.py masked_pool_write documents all
+# three; "cow_dst" is the COW copy's fresh-exclusive destination
+# window — the radix/beam branching path)
+_POOL_EXCLUSIVE_VIA = ("block_table", "host_indices", "cow_dst")
 
 
 def _ownership_coverage(program: Program):

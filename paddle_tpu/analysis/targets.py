@@ -149,6 +149,9 @@ def _transformer():
              "pg_serve0": paged.serves[0],
              f"pg_serve_miss{pbig}": paged.serves[("miss", pbig)],
              f"pg_serve_hit{pbig}": paged.serves[("hit", pbig)],
+             f"pg_serve_radix{pbig}": paged.serves[("radix", pbig)],
+             "pg_cow": paged.cow,
+             "pg_probe": paged.probe,
              "sp_prefill": spec.prefill,
              "sp_step": spec.step,
              "sp_serve0": spec.serves[0],
@@ -165,6 +168,8 @@ def _transformer():
              ("main", "pg_prefill"), ("main", "pg_step"),
              ("main", f"pg_serve_miss{pbig}"),
              ("main", f"pg_serve_hit{pbig}"),
+             ("main", f"pg_serve_radix{pbig}"),
+             ("main", "pg_cow"), ("main", "pg_probe"),
              ("main", "sp_step"), ("main", f"sp_serve{sbig}"),
              ("main", f"sps_serve_miss{psbig}"),
              ("main", "smp_step")],

@@ -20,6 +20,7 @@ from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
 from .serving import (BlockPoolExhausted, ContinuousGenerationServer,
                       GenerationServer, InferenceServer,
+                      PagedBeamDecoder,
                       PagedContinuousGenerationServer, ServerClosed,
                       ServerQuiesced, apply_eos_sentinel,
                       count_generated_tokens, default_batch_buckets)
@@ -33,7 +34,8 @@ __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "StableHLOTrainer", "export_train_stablehlo",
            "load_train_stablehlo", "InferenceServer",
            "GenerationServer", "ContinuousGenerationServer",
-           "PagedContinuousGenerationServer", "BlockPoolExhausted",
+           "PagedContinuousGenerationServer", "PagedBeamDecoder",
+           "BlockPoolExhausted",
            "ServerClosed", "ServerQuiesced", "apply_eos_sentinel",
            "count_generated_tokens", "default_batch_buckets",
            "ServingRuntime", "ModelRegistry", "Router",

@@ -64,8 +64,9 @@ import numpy as np
 from ..core.executor import Executor, PreparedCache, TPUPlace
 from ..core.scope import global_scope
 from ..core.types import to_np_dtype
-from ..models.decode_engine import (BlockPoolExhausted, HostBlockPool,
-                                    PromptPrefixCache)
+from ..models.decode_engine import (BlockLifetimeError,
+                                    BlockPoolExhausted, HostBlockPool,
+                                    PromptPrefixCache, RadixBlockTree)
 from ..observability import costmodel as obs_costmodel
 from ..observability import devtel as obs_devtel
 from ..observability import metrics as obs_metrics
@@ -897,9 +898,10 @@ class GenerationServer(InferenceServer):
 
 class _GenRequest:
     __slots__ = ("src", "reply", "t_arrival", "t_first", "t_admit",
-                 "trace", "seed")
+                 "trace", "seed", "session", "harvest", "radix")
 
-    def __init__(self, src, reply, trace=None, seed=0):
+    def __init__(self, src, reply, trace=None, seed=0, session=None,
+                 harvest=True):
         self.src = src
         self.reply = reply
         self.t_arrival = time.monotonic()
@@ -910,6 +912,14 @@ class _GenRequest:
         # with each POSITION into the emission keys, so a request
         # samples the same tokens whatever lane/order/burst served it
         self.seed = seed
+        # chat-session id (paged radix reuse); fan-out branches of a
+        # best-of-n submit carry harvest=False — probe generations
+        # never extend the session's retained history
+        self.session = session
+        self.harvest = harvest
+        # admission-time radix plan (hist tokens, resume step, history
+        # length), written by the paged scheduler under its lock
+        self.radix = None
 
 
 class ContinuousGenerationServer:
@@ -1069,6 +1079,11 @@ class ContinuousGenerationServer:
         self._admit_buckets = sorted(
             {k for k in self._serves if isinstance(k, int) and k > 0}
             | {k[1] for k in self._serves if isinstance(k, tuple)})
+        # radix capability: paged non-speculative bundles build
+        # ("radix", A) serve programs (teacher-forced resume over a
+        # shared block prefix) — the gate for session_id / n_best
+        self._radix_ok = any(isinstance(k, tuple) and k[0] == "radix"
+                             for k in self._serves)
         self._warmed_compiles = self.executor.compile_count - before
         # lanes the scheduler parked because the shared KV pool could
         # not cover their next burst (paged layout only; always empty
@@ -1180,13 +1195,38 @@ class ContinuousGenerationServer:
         self.close()
 
     # --- request path -------------------------------------------------
-    def submit(self, src_ids, seed=None) -> _Reply:
+    def submit(self, src_ids, seed=None, session_id=None,
+               extend_tokens=None, n_best=1):
         """Enqueue one prompt row. ``seed`` keys the request's
         emission noise on sampled/speculative bundles (ignored by
         plain greedy ones); None derives it from the prompt CONTENT
         (crc32), so identical prompts sample identical streams and
         the served tokens are invariant to admission order — the
-        bit-repro contract tests pin."""
+        bit-repro contract tests pin.
+
+        Paged bundles additionally unlock (raising elsewhere):
+
+        * ``session_id`` — a multi-turn CHAT session: the first turn
+          decodes normally; when it retires, the full-block prefix of
+          its decoded tokens is adopted into the server's radix tree
+          and the history retained. A RESUBMIT with the same
+          session_id (same prompt — the bidirectional encoder pins
+          cross-KV to the whole prompt) admits through the
+          encoder-free radix tier: the longest shared block prefix is
+          mapped read-only, only the divergent tail is teacher-force
+          re-prefilled, and decode resumes where the history ends —
+          never a re-prefill, never a recompute of shared KV.
+        * ``extend_tokens`` — appended to the session's retained
+          history before the turn runs (the "user turn" injected into
+          the decoder stream); requires a session with at least one
+          retired turn. Sessions are sequential: submit the next turn
+          after the previous one resolved.
+        * ``n_best`` — fan-out: n requests sharing the prompt entry
+          (and, for a session, the radix block chain) with seeds
+          ``seed..seed+n-1``; returns a LIST of replies. Branches
+          never extend the session history. Distinct generations need
+          a sampled bundle — greedy branches are identical.
+        """
         arr = np.asarray(src_ids)
         if arr.ndim == 1:
             arr = arr[None]
@@ -1196,16 +1236,34 @@ class ContinuousGenerationServer:
                 f"exactly seq_len={self.bundle.seq_len} tokens; got "
                 f"shape {tuple(np.asarray(src_ids).shape)}")
         arr = arr.astype(np.int64)
+        n_best = int(n_best)
+        if n_best < 1:
+            raise ValueError(f"n_best must be >= 1, got {n_best}")
+        if (session_id is not None or n_best > 1) \
+                and not self._radix_ok:
+            raise ValueError(
+                "session_id/n_best need the radix serve tier — a "
+                "PAGED, non-speculative bundle served by "
+                "PagedContinuousGenerationServer")
+        if extend_tokens is not None and session_id is None:
+            raise ValueError(
+                "extend_tokens extends an existing chat session; "
+                "pass session_id")
         if seed is None:
             import zlib
 
             seed = zlib.crc32(arr.tobytes())
-        trace = obs_tracing.current_request_trace()
-        if trace is None:
-            trace = obs_tracing.start_request(owner="server",
-                                              server=self._obs_id)
-        req = _GenRequest(arr, _Reply(), trace=trace,
-                          seed=int(seed))
+        reqs = []
+        for i in range(n_best):
+            trace = obs_tracing.current_request_trace() \
+                if i == 0 else None
+            if trace is None:
+                trace = obs_tracing.start_request(owner="server",
+                                                  server=self._obs_id)
+            reqs.append(_GenRequest(arr, _Reply(), trace=trace,
+                                    seed=int(seed) + i,
+                                    session=session_id,
+                                    harvest=(n_best == 1)))
         with self._cv:
             if self._closed:
                 raise ServerClosed(
@@ -1215,12 +1273,21 @@ class ContinuousGenerationServer:
                     "ContinuousGenerationServer is quiesced "
                     "(draining for retire/hot swap); re-resolve the "
                     "model and retry")
-            self._queue.append(req)
-            self._n_requests += 1
+            if session_id is not None:
+                self._session_submit_locked(session_id, arr,
+                                            extend_tokens)
+            for req in reqs:
+                self._queue.append(req)
+            self._n_requests += len(reqs)
             if self._t_first_arrival is None:
-                self._t_first_arrival = req.t_arrival
+                self._t_first_arrival = reqs[0].t_arrival
             self._cv.notify_all()
-        return req.reply
+        return reqs[0].reply if n_best == 1 \
+            else [r.reply for r in reqs]
+
+    def _session_submit_locked(self, session_id, arr, extend_tokens):
+        raise ValueError(  # unreachable behind the _radix_ok gate
+            "chat sessions need PagedContinuousGenerationServer")
 
     def generate(self, src_ids, timeout: Optional[float] = 120.0,
                  seed=None):
@@ -1732,13 +1799,18 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
     grouping owns the admission order).
     """
 
-    def __init__(self, bundle, **kwargs):
+    def __init__(self, bundle, radix_reuse=True, **kwargs):
         cache = getattr(bundle, "cache", None)
         if cache is None or cache.layout != "paged":
             raise ValueError(
                 "PagedContinuousGenerationServer needs a bundle built "
                 "with CacheConfig(layout='paged') — for dense bundles "
                 "use ContinuousGenerationServer")
+        # radix_reuse=False keeps the session API but replays every
+        # turn's FULL history into fresh blocks (resume step 0, no
+        # shared chains) — the re-prefill baseline bench.py multiturn
+        # measures the radix win against
+        self._radix_reuse = bool(radix_reuse)
         if kwargs.get("admit_select") is not None:
             raise ValueError(
                 "paged serving owns admission order (prefix-tier "
@@ -1757,6 +1829,30 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         self._lane_entry: List[Optional[int]] = [None] * bundle.n_slots
         self._lane_step = np.zeros((rows,), np.int64)
         self._admit_tier = None
+        # radix block-prefix reuse (multi-turn chat sessions): the
+        # tree shares decoded-token self-KV chains across turns and
+        # fan-out branches; per-lane the READ-ONLY shared prefix
+        # (_lane_shared, one pool ref per block) is kept apart from
+        # the lane-exclusive writable tail (_lane_blocks) — the
+        # host half of the PTA192 read-only-while-shared contract
+        self._radix = RadixBlockTree(self._blocks, self._bs)
+        self._lane_shared = [[] for _ in range(bundle.n_slots)]
+        self._lane_sess: List[Optional[object]] = \
+            [None] * bundle.n_slots
+        self._sessions: Dict[object, dict] = {}
+        # session harvest source: the last dispatch's token buffer
+        # (valid only between a successful _post_dispatch and the
+        # next _pre_dispatch — a failed dispatch must never graft a
+        # stale buffer into the tree)
+        self._last_tok = None
+        self._harvest_ok = False
+        self._radix_admits = 0
+        # prefix hit-DEPTH histogram (in blocks): how deep radix
+        # admissions actually share — the reuse-efficiency signal
+        # the flat hit counter cannot show
+        self._hit_depth = Histogram(
+            "paddle_tpu_blockpool_prefix_hit_depth",
+            buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
         self._pause_events = 0  # lanes parked for >= 1 cycle by pool
         #                         pressure (observability)
         self._preemptions = 0   # recompute-preempted lanes (vLLM-
@@ -1776,6 +1872,72 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
     # cost per cycle; the head itself is ALWAYS first, so no request
     # can be starved by later same-tier traffic)
     _ADMIT_SCAN_DEPTH = 64
+
+    # --- chat sessions (radix block-prefix reuse) --------------------
+    def _session_submit_locked(self, session_id, arr, extend_tokens):
+        prompt = tuple(int(x) for x in arr.reshape(-1))
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            if extend_tokens is not None:
+                raise ValueError(
+                    f"session {session_id!r} has no retired turn to "
+                    f"extend; submit its first turn plain")
+            self._sessions[session_id] = {
+                "prompt": prompt, "hist": None, "entry": None,
+                "turns": 0}
+            return
+        if sess["prompt"] != prompt:
+            raise ValueError(
+                f"session {session_id!r} was opened with a different "
+                f"prompt: sessions are keyed by PROMPT content (the "
+                f"bidirectional encoder pins every KV chain to the "
+                f"whole prompt); open a new session for a new prompt")
+        if extend_tokens is not None:
+            if sess["hist"] is None:
+                raise ValueError(
+                    f"session {session_id!r}'s first turn has not "
+                    f"retired yet; extend after its reply resolves")
+            ext = [int(t) for t in np.asarray(extend_tokens)
+                   .reshape(-1)]
+            maxT = self.bundle.max_out_len
+            if len(sess["hist"]) + len(ext) > maxT - 1:
+                raise ValueError(
+                    f"session {session_id!r} history "
+                    f"({len(sess['hist'])} + {len(ext)} tokens) "
+                    f"exceeds the decode buffer (max_out_len-1 = "
+                    f"{maxT - 1}); close_session and restart")
+            sess["hist"] = sess["hist"] + ext
+
+    def close_session(self, session_id):
+        """Drop a chat session: releases its cross-KV entry pin and
+        forgets the retained history. The session's radix tree nodes
+        persist as shared CACHE until evicted under pool pressure.
+        Idempotent; in-flight turns of the session finish normally
+        (their harvest is skipped)."""
+        with self._cv:
+            sess = self._sessions.pop(session_id, None)
+            if sess is not None and sess["entry"] is not None:
+                self._prefix.release(sess["entry"])
+
+    def session_history(self, session_id):
+        """The session's retained decoded-token history (list of
+        ints, GO token first, terminator excluded), or None before
+        its first turn retired / for an unknown session."""
+        with self._cv:
+            sess = self._sessions.get(session_id)
+            if sess is None or sess["hist"] is None:
+                return None
+            return list(sess["hist"])
+
+    def _alloc_block_locked(self):
+        """Pool alloc with the radix tree as reclaimable capacity:
+        a miss first evicts the deepest tree-only (refcount-1) leaf
+        — cached prefixes are exactly the blocks it is safe to drop
+        under pressure."""
+        b = self._blocks.alloc()
+        if b is None and self._radix.evict(1):
+            b = self._blocks.alloc()
+        return b
 
     def _plan_admissions_locked(self, failures):
         admits = []
@@ -1803,7 +1965,16 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 break
             prompt = tuple(int(x) for x in req.src.reshape(-1))
             tier, _entry = self._prefix.lookup(prompt)
-            flavor = "hit" if tier == "hit" else "miss"
+            sess = self._sessions.get(req.session) \
+                if req.session is not None else None
+            if (sess is not None and sess["hist"] is not None
+                    and sess["entry"] is not None):
+                # a retired-turn session: admit through the
+                # encoder-free radix tier — shared block prefix
+                # mapped read-only, divergent tail teacher-forced
+                flavor = "radix"
+            else:
+                flavor = "hit" if tier == "hit" else "miss"
             if self._admit_tier is None:
                 self._admit_tier = flavor
             if flavor != self._admit_tier:
@@ -1817,13 +1988,67 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
             # ALREADY-live lane, or growth pressure turns into
             # preempt/re-admit thrash — preempted lockstep longs used
             # to steal their own freed blocks back at the next
-            # admission and re-decode forever
+            # admission and re-decode forever. Radix-cached
+            # (tree-only) blocks are reclaimable capacity: evict
+            # before declaring pressure.
             live_now = self.n_slots - len(free_slots)
+            if self._blocks.free_count - 1 < live_now:
+                self._radix.evict(
+                    live_now + 1 - self._blocks.free_count)
             if self._blocks.free_count - 1 < live_now:
                 blocked_reason = ("free KV blocks below the live-lane "
                                   "watermark")
                 break
-            blk = self._blocks.alloc()
+            if flavor == "radix":
+                hist = list(sess["hist"])
+                P = len(hist)
+                # cap the shared prefix at (P-1)//BS full blocks:
+                # resume = h*BS must leave >= 1 tick of history to
+                # replay, and the FIRST device write then lands in
+                # the fresh exclusive tail block — never in a shared
+                # block (PTA192 green by construction)
+                shared = self._radix.acquire(
+                    prompt, hist,
+                    max_blocks=(P - 1) // self._bs) \
+                    if self._radix_reuse else []
+                blk = self._alloc_block_locked()
+                if blk is None:
+                    self._radix.release(shared)
+                    blocked_reason = "no free KV block"
+                    break
+                # the session's entry pin keeps the prompt resident,
+                # so this is always a hit (encoder-free admission)
+                entry = self._prefix.acquire_hit(prompt)
+                h = len(shared)
+                slot = free_slots.pop(0)
+                taken.append(req)
+                self._lane_shared[slot] = shared
+                self._lane_blocks[slot] = [blk]
+                self._lane_entry[slot] = entry
+                self._lane_sess[slot] = req.session
+                self._lane_step[slot] = h * self._bs
+                self._tab[slot, :] = 0
+                for j, b in enumerate(shared):
+                    self._tab[slot, j] = b
+                self._tab[slot, h] = blk
+                self._pref[slot] = entry
+                self._lanes[slot] = req
+                req.t_admit = t_admit
+                req.radix = (hist, h * self._bs, P)
+                self._radix_admits += 1
+                self._hit_depth.observe(float(h))
+                if req.trace is not None:
+                    # blocks_reused is the radix win (KV pages NOT
+                    # recomputed); blocks_cowed is 0 by construction
+                    # on this path — serving admissions never write
+                    # a shared block (COW lives in PagedBeamDecoder)
+                    req.trace.add_span(
+                        "slotpool.queue", req.t_arrival, t_admit,
+                        slot=slot, prefix="radix", blocks_reused=h,
+                        blocks_cowed=0)
+                admits.append((slot, req))
+                continue
+            blk = self._alloc_block_locked()
             if blk is None:
                 blocked_reason = "no free KV block"
                 break
@@ -1839,8 +2064,10 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 seen_cold.add(prompt)
             slot = free_slots.pop(0)
             taken.append(req)
+            self._lane_shared[slot] = []
             self._lane_blocks[slot] = [blk]
             self._lane_entry[slot] = entry
+            self._lane_sess[slot] = req.session
             self._lane_step[slot] = 0
             self._tab[slot, :] = 0
             self._tab[slot, 0] = blk
@@ -1877,6 +2104,28 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         feed = {"slots": np.array(
             [slot for slot, _ in admits]
             + [self.bundle.dustbin] * (A - len(admits)), np.int64)}
+        if tier == "radix":
+            # teacher-forced resume: the lane replays its retained
+            # history from resume = h*BS (the first position past the
+            # shared prefix) and flips to real decode at step P-1 —
+            # padded rows (dustbin) feed zero rows harmlessly
+            maxT = self.bundle.max_out_len
+            hist = np.zeros((A, maxT), np.int64)
+            resume = np.zeros((A,), np.int64)
+            until = np.zeros((A,), np.int64)
+            for i, (_slot, req) in enumerate(admits):
+                htoks, r, n = req.radix
+                hist[i, :n] = htoks
+                resume[i] = r
+                until[i] = n
+            feed["hist_toks"] = hist
+            feed["resume_steps"] = resume
+            feed["prefill_until"] = until
+            if self._needs_seeds:
+                feed["seeds"] = np.array(
+                    [req.seed for _, req in admits]
+                    + [0] * (A - len(admits)), np.int64)
+            return (tier, A), feed
         if tier == "miss" or self._spec_k > 0:
             # spec bundles feed src_ids on HITs too: the hit program
             # skips only the TARGET encoder — the (tiny) draft
@@ -1901,21 +2150,36 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
     # --- burst planning: coverage, pausing, hard exhaustion ----------
     def _grow_blocks_locked(self, slot, upto_pos):
         need = upto_pos // self._bs + 1
+        # the lane's table = read-only shared radix prefix (never
+        # grown, never written) + the exclusive writable tail
+        base = len(self._lane_shared[slot])
         blocks = self._lane_blocks[slot]
-        while len(blocks) < need:
-            b = self._blocks.alloc()
+        while base + len(blocks) < need:
+            b = self._alloc_block_locked()
             if b is None:
                 return
-            self._tab[slot, len(blocks)] = b
+            self._tab[slot, base + len(blocks)] = b
             blocks.append(b)
 
     def _free_lane_locked(self, slot):
+        if self._lane_shared[slot]:
+            # the lane's refs on the shared radix prefix (the tree
+            # keeps its own ref per node — blocks stay cached)
+            self._radix.release(self._lane_shared[slot])
+            self._lane_shared[slot] = []
         if self._lane_blocks[slot]:
-            self._blocks.free(self._lane_blocks[slot])
+            # radix-aware free: decref from refcount 1 IS the strict
+            # free; a block the tree adopted at session harvest
+            # (refcount 2) survives tree-owned. Reverse order so a
+            # freed block never outlives a deeper one that depends
+            # on it.
+            for b in reversed(self._lane_blocks[slot]):
+                self._blocks.decref(b)
             self._lane_blocks[slot] = []
         if self._lane_entry[slot] is not None:
             self._prefix.release(self._lane_entry[slot])
             self._lane_entry[slot] = None
+        self._lane_sess[slot] = None
         self._paused.discard(slot)
 
     def _plan_burst_locked(self, admits, drain, failures):
@@ -1943,7 +2207,8 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 # unallocated table rows into other lanes' blocks)
                 self._grow_blocks_locked(
                     s, min(st + n_steps * tpt - 1, maxT - 1))
-                covered = len(self._lane_blocks[s]) * self._bs
+                covered = (len(self._lane_shared[s])
+                           + len(self._lane_blocks[s])) * self._bs
                 if covered >= maxT:
                     # whole buffer covered: writes can never leave
                     # the lane's blocks (the verify gate masks
@@ -2027,12 +2292,55 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         # freshly admitted lanes are raised by the admission body
         # inside the same dispatch either way
         self.scope._set(names["active"], act)
+        self._harvest_ok = False  # until this dispatch's outs land
 
     def _post_dispatch(self, outs):
         self._lane_step = np.asarray(outs[1]).astype(np.int64).copy()
+        # session harvest source: the retire sweep adopts the full
+        # blocks behind each finished session turn into the radix
+        # tree and retains its history for the next turn
+        self._last_tok = np.asarray(outs[0])
+        self._harvest_ok = True
 
     def _release_lane(self, slot, req):
+        sid = self._lane_sess[slot]
+        if sid is not None and req.harvest and self._harvest_ok:
+            self._harvest_session_locked(slot, sid)
         self._free_lane_locked(slot)
+
+    def _harvest_session_locked(self, slot, sid):
+        """Adopt a retiring session turn into the radix tree: the
+        FULL blocks behind its decoded tokens become tree nodes (one
+        tree ref each — 'existing node wins' makes replayed chunks
+        idempotent), and the history (terminator excluded, so the
+        next turn can extend past it) is retained for the session's
+        next radix admission."""
+        sess = self._sessions.get(sid)
+        if sess is None:
+            return  # closed mid-flight: nothing to extend
+        row = np.asarray(self._last_tok[slot]).reshape(-1)
+        if self._end_id is None:
+            e = row.shape[0] - 1
+        else:
+            hit = row[1:] == self._end_id
+            e = int(hit.argmax()) + 1 if hit.any() \
+                else row.shape[0] - 1
+        hist = [int(t) for t in row[:e]]
+        # KV positions 0..e-1 are valid => e // BS FULL blocks; the
+        # lane's chain (shared prefix + exclusive tail) covers them
+        f = e // self._bs
+        if f and self._radix_reuse:
+            chain = (list(self._lane_shared[slot])
+                     + list(self._lane_blocks[slot]))
+            self._radix.insert(sess["prompt"], hist, chain[:f])
+        sess["hist"] = hist
+        sess["turns"] += 1
+        if sess["entry"] is None:
+            # pin the cross-KV entry for the session's lifetime by
+            # TRANSFERRING the lane's ref (the lane free below must
+            # not release it) — later turns admit as guaranteed hits
+            sess["entry"] = self._lane_entry[slot]
+            self._lane_entry[slot] = None
 
     # --- observability ------------------------------------------------
     def pool_stats(self) -> dict:
@@ -2060,6 +2368,15 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
             "paused_lanes": len(self._paused),
             "pause_events": self._pause_events,
             "preemptions": self._preemptions,
+            # radix block-prefix reuse (decoded-token self-KV chains)
+            "shared_blocks": len(self._blocks.shared_blocks()),
+            "radix_nodes": self._radix.n_nodes,
+            "radix_hit_blocks": self._radix.hit_blocks,
+            "radix_inserts": self._radix.inserts,
+            "radix_adoptions": self._radix.adoptions,
+            "radix_evicted_blocks": self._radix.evicted_blocks,
+            "radix_admissions": self._radix_admits,
+            "sessions_open": len(self._sessions),
         }
 
     def _host_tel_locked(self, reset: bool) -> dict:
@@ -2111,8 +2428,282 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
              p.partials),
             ("paddle_tpu_blockpool_evictions_total", lab,
              p.evictions),
+            # radix reuse gauges: shared (refcount>1) residency, tree
+            # size, and the hit-depth histogram — together they say
+            # how much KV the pool holds ONCE for many readers
+            ("paddle_tpu_blockpool_shared_blocks", lab,
+             len(b.shared_blocks())),
+            ("paddle_tpu_blockpool_radix_nodes", lab,
+             self._radix.n_nodes),
+            ("paddle_tpu_blockpool_radix_hit_blocks_total", lab,
+             self._radix.hit_blocks),
+            ("paddle_tpu_blockpool_radix_evicted_blocks_total", lab,
+             self._radix.evicted_blocks),
+            ("paddle_tpu_blockpool_radix_admissions_total", lab,
+             self._radix_admits),
+            ("paddle_tpu_blockpool_sessions_open", lab,
+             len(self._sessions)),
+            ("paddle_tpu_blockpool_prefix_hit_depth", lab,
+             self._hit_depth),
         ]
         return samples
+
+
+class PagedBeamDecoder:
+    """Beam search where beam branching IS copy-on-write block
+    branching (reference counterpart: the whole-loop
+    models/decode_engine.build_beam_decode_program, itself mirroring
+    reference tests/unittests/dist_transformer.py:1523 beam_search —
+    which holds ``beam_size`` FULL dense histories and re-decodes
+    them every step; here each shared hypothesis prefix is stored
+    ONCE in the paged pool).
+
+    Drives the bundle's PROBE program — one device tick that runs
+    the cached decoder over every lane and publishes the full
+    next-token distribution (``probe_probs``), with teacher forcing
+    pinned to ``prefill_until = max_out_len`` so the device never
+    emits a token or latches a lane: the HOST owns tokens, scores,
+    block tables, and the refcount typestate. Per expansion step:
+
+    * a child hypothesis shares its parent's FULL blocks read-only
+      (``incref`` — exclusive→shared is the branch point);
+    * the parent's PARTIAL tail block is copied through the bundle's
+      COW program into a fresh exclusive block per diverging child —
+      the ONLY write path into branched state (checker PTA192's
+      copy-before-write contract, held here by host construction);
+    * a parent with a single heir hands its tail over exclusively —
+      zero copies on a non-branching step (beam_size=1 degenerates
+      to greedy with no COW at all).
+
+    Expansion math mirrors ops/decode_ops.beam_search exactly
+    (2*beam candidates, accumulated log-probs, EOS freezing,
+    per-batch top-k with lower-index tie preference), so decoded
+    tokens are token-exact against the whole-loop reference on a
+    trained model.
+
+    Owns the bundle's scope state between calls — do not serve the
+    same bundle/scope from a ContinuousGenerationServer concurrently.
+    """
+
+    def __init__(self, bundle, beam_size, executor=None, scope=None):
+        cache = getattr(bundle, "cache", None)
+        if cache is None or cache.layout != "paged" \
+                or getattr(bundle, "probe", None) is None:
+            raise ValueError(
+                "PagedBeamDecoder needs a paged, non-speculative "
+                "bundle (its probe + cow programs); build with "
+                "CacheConfig(layout='paged') and no DraftConfig")
+        if not 1 <= int(beam_size) <= bundle.n_slots:
+            raise ValueError(
+                f"beam_size {beam_size} must fit the bundle's "
+                f"{bundle.n_slots} lanes")
+        self.bundle = bundle
+        self.beam = int(beam_size)
+        self.executor = executor or Executor(TPUPlace(0))
+        self.scope = scope or global_scope()
+        self.cache = cache
+        self._bs = cache.block_size
+        self._pool = HostBlockPool(cache.n_blocks)
+        bundle.init_slot_state(self.scope)
+        st = bundle.state
+        self._st = st
+        self._rows = bundle.n_slots + 1
+        self._probe = self.executor.prepare(
+            bundle.probe, feed=[],
+            fetch_list=[st["probe_probs"], st["step"]],
+            scope=self.scope)
+        self._cow = self.executor.prepare(
+            bundle.cow, feed=bundle.cow_feed_spec(),
+            fetch_list=[st["step"]], scope=self.scope)
+        # prompt admission reuses the fused serve programs at
+        # n_steps=0 (prefill + lane reset, zero decode ticks): beam 0
+        # prefills the cross-KV entry (miss), beams 1.. reset as hits
+        buckets = sorted({k[1] for k in bundle.serves
+                          if isinstance(k, tuple)})
+        mk = ("miss", _bucket_for(1, buckets, "beam admission"))
+        self._miss = self.executor.prepare(
+            bundle.serves[mk], feed=bundle.serve_feed_spec(mk),
+            fetch_list=[st["step"]], scope=self.scope)
+        self._miss_A = mk[1]
+        self._hit = None
+        if self.beam > 1:
+            hk = ("hit", _bucket_for(self.beam - 1, buckets,
+                                     "beam fan-out"))
+            self._hit = self.executor.prepare(
+                bundle.serves[hk], feed=bundle.serve_feed_spec(hk),
+                fetch_list=[st["step"]], scope=self.scope)
+            self._hit_A = hk[1]
+        # observability (pool_stats-shaped; blocks_cowed is the
+        # satellite the admission spans of the radix server pin at 0)
+        self.cow_blocks = 0
+        self.shared_block_peak = 0
+
+    def _alloc(self):
+        b = self._pool.alloc()
+        if b is None:
+            raise BlockPoolExhausted(
+                f"beam branching exhausted the KV block pool "
+                f"(n_blocks={self._pool.n_blocks}, beam="
+                f"{self.beam}); retryable against a larger pool")
+        return b
+
+    def _admit(self, arr, tab, pref):
+        st, scope = self._st, self.scope
+        scope._set(st["block_tab"], tab.copy())
+        scope._set(st["prompt_ref"], pref.copy())
+        zero = np.array([0], np.int64)
+        A = self._miss_A
+        feed = {"src_ids": np.repeat(arr, A, axis=0),
+                "slots": np.full((A,), self.bundle.dustbin, np.int64),
+                "prompt_slots": np.full(
+                    (A,), self.cache.n_prompt_entries, np.int64),
+                "n_steps": zero, "min_active": zero}
+        feed["slots"][0] = 0
+        feed["prompt_slots"][0] = 0
+        if getattr(self.bundle, "needs_seeds", False):
+            feed["seeds"] = np.zeros((A,), np.int64)
+        self._miss.run(feed, return_numpy=True)
+        if self._hit is not None:
+            A = self._hit_A
+            slots = np.full((A,), self.bundle.dustbin, np.int64)
+            slots[:self.beam - 1] = np.arange(1, self.beam)
+            feed = {"slots": slots, "n_steps": zero,
+                    "min_active": zero}
+            if getattr(self.bundle, "needs_seeds", False):
+                feed["seeds"] = np.zeros((A,), np.int64)
+            self._hit.run(feed, return_numpy=True)
+
+    def decode(self, src_ids, return_all=False):
+        """One prompt row in; the best hypothesis out as
+        ``(tokens [max_out_len] sentinel-normalized, score)`` —
+        or every hypothesis best-first with ``return_all=True``."""
+        W, maxT, bs = self.beam, self.bundle.max_out_len, self._bs
+        end_id = self.bundle.end_id
+        arr = np.asarray(src_ids)
+        if arr.ndim == 1:
+            arr = arr[None]
+        if arr.shape != (1, self.bundle.seq_len):
+            raise ValueError(
+                f"beam decode takes one prompt row of exactly "
+                f"seq_len={self.bundle.seq_len} tokens; got "
+                f"{tuple(np.asarray(src_ids).shape)}")
+        arr = arr.astype(np.int64)
+        st, scope, rows = self._st, self.scope, self._rows
+        tab = np.zeros((rows, self.cache.pages(maxT)), np.int32)
+        pref = np.full((rows,), self.cache.n_prompt_entries,
+                       np.int32)
+        pref[:W] = 0
+        tables = []
+        for b in range(W):
+            blk = self._alloc()
+            tables.append([blk])
+            tab[b, 0] = blk
+        self._admit(arr, tab, pref)
+        # probe mode: the device computes KV + distributions but
+        # never emits — set AFTER admission (the lane reset clears
+        # prefill_until)
+        until = np.zeros((rows,), np.int64)
+        until[:W] = maxT
+        scope._set(st["prefill_until"], until)
+        buf = np.zeros((rows, maxT), np.int64)
+        buf[:W, 0] = self.bundle.start_id
+        scores = np.full((W,), -1e9, np.float32)
+        scores[0] = 0.0  # single live seed (the reference's LoD seed)
+        act = np.zeros((rows,), np.int64)
+        act[:W] = 1
+        neg = np.finfo(np.float32).min
+        for s in range(maxT - 1):
+            scope._set(st["tok_buf"], buf.copy())
+            scope._set(st["active"], act.copy())
+            scope._set(st["block_tab"], tab.copy())
+            outs = self._probe.run({}, return_numpy=True)
+            probs = np.asarray(outs[0])[:W]
+            k2 = min(2 * W, probs.shape[1])
+            finished = buf[:W, s] == end_id
+            cand_ids = np.empty((W, k2), np.int64)
+            cand_tot = np.empty((W, k2), np.float32)
+            for b in range(W):
+                if finished[b]:
+                    # frozen beam: only candidate is end_id at an
+                    # unchanged score (decode_ops.beam_search rule)
+                    cand_ids[b] = end_id
+                    cand_tot[b] = neg
+                    cand_tot[b, 0] = scores[b]
+                else:
+                    order = np.argsort(-probs[b],
+                                       kind="stable")[:k2]
+                    cand_ids[b] = order
+                    with np.errstate(divide="ignore"):
+                        cand_tot[b] = (np.log(probs[b, order])
+                                       + scores[b])
+            flat = cand_tot.reshape(-1)
+            top = np.argsort(-flat, kind="stable")[:W]
+            parents = top // k2
+            toks = cand_ids.reshape(-1)[top]
+            scores = flat[top].astype(np.float32)
+            # --- reassignment: sharing, inheritance, COW ----------
+            boundary = (s + 1) % bs == 0
+            c = s // bs  # block holding position s (just written)
+            heirs = collections.Counter(int(p) for p in parents)
+            new_tables, cow_src, cow_dst = [], [], []
+            for b in range(W):
+                pt = tables[int(parents[b])]
+                if boundary:
+                    # block c is FULL: shareable read-only; the next
+                    # write opens a fresh block either way
+                    share, tail = pt[:c + 1], None
+                elif heirs[int(parents[b])] == 1:
+                    # sole heir inherits the partial tail exclusively
+                    share, tail = pt, []
+                else:
+                    # diverging children each COW the partial block
+                    share = pt[:c]
+                    tail = [self._alloc()]
+                    cow_src.append(pt[c])
+                    cow_dst.append(tail[0])
+                for blk in share:
+                    self._pool.incref(blk)
+                if tail is None:
+                    tail = [self._alloc()]
+                new_tables.append(share + tail)
+            if cow_src:
+                # device-side block copy BEFORE the old refs drop
+                # (the sources must stay pinned while read)
+                csrc = np.zeros((rows,), np.int64)
+                cdst = np.full((rows,), -1, np.int64)
+                cgate = np.zeros((rows,), np.float32)
+                csrc[:len(cow_src)] = cow_src
+                cdst[:len(cow_dst)] = cow_dst
+                cgate[:len(cow_src)] = 1.0
+                self._cow.run({"cow_src": csrc, "cow_dst": cdst,
+                               "cow_gate": cgate},
+                              return_numpy=True)
+                self.cow_blocks += len(cow_src)
+            for pt in tables:
+                for blk in reversed(pt):
+                    self._pool.decref(blk)
+            tables = new_tables
+            tab[:W, :] = 0
+            for b in range(W):
+                for j, blk in enumerate(tables[b]):
+                    tab[b, j] = blk
+            self.shared_block_peak = max(
+                self.shared_block_peak,
+                len(self._pool.shared_blocks()))
+            newbuf = buf.copy()
+            for b in range(W):
+                newbuf[b] = buf[int(parents[b])]
+                newbuf[b, s + 1] = toks[b]
+            buf = newbuf
+            if np.all(toks == end_id):
+                break  # every hypothesis frozen: later steps no-op
+        order = np.argsort(-scores, kind="stable")
+        hyps = [(apply_eos_sentinel(buf[b:b + 1], end_id)[0],
+                 float(scores[b])) for b in order]
+        for pt in tables:
+            for blk in reversed(pt):
+                self._pool.decref(blk)
+        return hyps if return_all else hyps[0]
 
 
 def count_generated_tokens(tokens: np.ndarray,
@@ -2154,7 +2745,8 @@ def apply_eos_sentinel(tokens: np.ndarray,
 
 __all__ = ["InferenceServer", "GenerationServer",
            "ContinuousGenerationServer",
-           "PagedContinuousGenerationServer", "BlockPoolExhausted",
+           "PagedContinuousGenerationServer", "PagedBeamDecoder",
+           "BlockPoolExhausted",
            "ProgramRunner", "ServerQuiesced", "ServerClosed",
            "apply_eos_sentinel", "count_generated_tokens",
            "default_batch_buckets"]

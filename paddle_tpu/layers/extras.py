@@ -443,15 +443,18 @@ def masked_pool_write(pool, new, index, gate=None, leading_dims=1,
 
     ``exclusive_via`` is mandatory and names the lane-exclusivity
     proof: "block_table" (per-lane blocks from the host free-list —
-    requires ``gate`` so idle/dustbin/paused lanes write nothing) or
-    "host_indices" (host-deduplicated admission targets).
+    requires ``gate`` so idle/dustbin/paused lanes write nothing),
+    "host_indices" (host-deduplicated admission targets), or
+    "cow_dst" (freshly allocated exclusive blocks a COW copy
+    diverges into — the radix/beam branching path).
     """
-    if exclusive_via not in ("block_table", "host_indices"):
+    if exclusive_via not in ("block_table", "host_indices",
+                             "cow_dst"):
         raise ValueError(
-            f"masked_pool_write needs exclusive_via='block_table' or "
-            f"'host_indices' (got {exclusive_via!r}): shared-pool "
-            f"writes must declare why row indices cannot alias "
-            f"(checker PTA110)")
+            f"masked_pool_write needs exclusive_via='block_table', "
+            f"'host_indices' or 'cow_dst' (got {exclusive_via!r}): "
+            f"shared-pool writes must declare why row indices "
+            f"cannot alias (checker PTA110)")
     if exclusive_via == "block_table" and gate is None:
         raise ValueError(
             "masked_pool_write(exclusive_via='block_table') needs a "

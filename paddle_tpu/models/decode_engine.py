@@ -578,7 +578,7 @@ def cached_decoder_step(x, caches, cross_kv, att_bias, d_model,
 def build_greedy_decode_program(seq_len=16, max_out_len=16,
                                 d_model=64, n_heads=4, n_layers=2,
                                 d_inner=128, vocab=1000, start_id=0,
-                                end_id=1):
+                                end_id=1, sharding=None):
     """Autoregressive greedy generation (reference
     tests/unittests/dist_transformer.py:1498 fast_decode — its
     while-op beam loop, at beam 1 — rebuilt as a lax.while_loop over
@@ -636,6 +636,17 @@ def build_greedy_decode_program(seq_len=16, max_out_len=16,
             emit_token_step(src, logits_v, positions, tgt_buf,
                             finished, counter, limit, cond,
                             max_out_len, end_id)
+    if sharding is not None and sharding.enabled:
+        sharding.validate(n_heads, vocab, d_model, d_inner)
+        # params-only tp layout, mirroring the incremental front: the
+        # full-recompute loop holds no persistable KV at all, so the
+        # fused attention ops pick up head sharding purely from the
+        # GSPMD-propagated param placements — which is exactly what
+        # makes this front the sharded parity oracle for the paged
+        # bundle (same placements, no cache layout to disagree on)
+        annotate_sharded_program(
+            main, tp_param_placements(n_layers, sharding),
+            ((sharding.axis, sharding.tp),))
     return main, startup, ["src_ids"], tgt_buf
 
 
@@ -829,7 +840,7 @@ class DecodeStepBundle:
     def __init__(self, prefills, step, serves, startup, state,
                  n_slots, seq_len, max_out_len, start_id, end_id,
                  cache=None, hit_prefills=None, sampling=None,
-                 draft=None):
+                 draft=None, cow=None, probe=None):
         self.prefills = dict(prefills)   # bucket size A -> Program
         self.prefill = self.prefills[min(self.prefills)]
         self.hit_prefills = dict(hit_prefills or {})
@@ -846,6 +857,8 @@ class DecodeStepBundle:
         self.cache = cache or CacheConfig()
         self.sampling = sampling         # SamplingConfig | None
         self.draft = draft               # DraftConfig | None
+        self.cow = cow                   # COW block-copy Program
+        self.probe = probe               # probe-step Program
         self.sharding = None             # ShardingConfig | None
         self.sharding_plan = None        # core.sharding_plan plan
         self._state_specs = {}
@@ -857,6 +870,10 @@ class DecodeStepBundle:
         out = [p for _a, p in sorted(self.prefills.items())]
         out += [p for _a, p in sorted(self.hit_prefills.items())]
         out.append(self.step)
+        if self.cow is not None:
+            out.append(self.cow)
+        if self.probe is not None:
+            out.append(self.probe)
         out += [p for _k, p in sorted(self.serves.items(),
                                       key=lambda kv: str(kv[0]))]
         return out
@@ -909,6 +926,14 @@ class DecodeStepBundle:
         if key == 0:
             return feed
         tier, A = key if isinstance(key, tuple) else ("miss", key)
+        if tier == "radix":
+            pre = [("hist_toks", (A, self.max_out_len), "int64"),
+                   ("resume_steps", (A,), "int64"),
+                   ("prefill_until", (A,), "int64"),
+                   ("slots", (A,), "int64")]
+            if self.needs_seeds:
+                pre.append(("seeds", (A,), "int64"))
+            return pre + feed
         pre = []
         if tier == "miss" or self.spec_k > 0:
             # spec bundles feed src_ids on HIT admissions too: the
@@ -921,6 +946,15 @@ class DecodeStepBundle:
         if self.needs_seeds:
             pre.append(("seeds", (A,), "int64"))
         return pre + feed
+
+    def cow_feed_spec(self) -> List[tuple]:
+        """Feed signature of the COW block-copy program (``cow``):
+        per-row (src shared block, dst fresh exclusive block, gate).
+        Padded rows feed gate 0 and dst -1 (the trash row)."""
+        rows = self.n_slots + 1
+        return [("cow_src", (rows,), "int64"),
+                ("cow_dst", (rows,), "int64"),
+                ("cow_gate", (rows,), "float32")]
 
     def kv_state_bytes(self) -> int:
         """Total persistable KV bytes of the bundle (self + cross KV
@@ -953,7 +987,7 @@ class DecodeStepBundle:
 
 def _slot_state_specs(prefix, rows, maxT, seq_len, n_heads,
                       head_dim, n_layers, cache, sampling=None,
-                      draft=None):
+                      draft=None, vocab=None):
     specs = {
         f"{prefix}tok_buf": ((rows, maxT), "int64"),
         f"{prefix}step": ((rows,), "int64"),
@@ -1008,6 +1042,20 @@ def _slot_state_specs(prefix, rows, maxT, seq_len, n_heads,
     E = cache.n_prompt_entries
     specs[f"{prefix}block_tab"] = ((rows, NP), "int32")
     specs[f"{prefix}prompt_ref"] = ((rows,), "int32")
+    # teacher-forcing horizon per lane: while step+1 < prefill_until
+    # the lane re-plays its (admission-written) token-buffer history —
+    # KV is written, logits are computed, but the emitted token never
+    # lands and EOS never latches. 0 (the idle/cold default) makes
+    # every tick a real decode tick, so non-radix admissions are
+    # untouched by construction. This is what lets a radix admission
+    # chunk-prefill ONLY the divergent tail of a resumed chat turn.
+    specs[f"{prefix}prefill_until"] = ((rows,), "int64")
+    if vocab is not None and (draft is None or draft.k == 0):
+        # the beam/probe front's full next-token distribution, one
+        # softmax row per lane, refreshed by the probe step program —
+        # host-side beam branching reads it instead of re-running the
+        # decoder outside the bundle
+        specs[f"{prefix}probe_probs"] = ((rows, vocab), "float32")
     for li in range(n_layers):
         specs[f"{prefix}self_k{li}{POOL_MARK}"] = (
             (cache.n_blocks, cache.block_size, n_heads, head_dim),
@@ -1341,7 +1389,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             f"[1, n_slots={n_slots}]")
     specs = _slot_state_specs(state_prefix, rows, maxT, seq_len,
                               n_heads, head_dim, n_layers, cache,
-                              sampling=sampling, draft=draft)
+                              sampling=sampling, draft=draft,
+                              vocab=vocab if paged else None)
     if paged:
         NP, BS, NB = cache.pages(maxT), cache.block_size, cache.n_blocks
         E = cache.n_prompt_entries
@@ -1423,6 +1472,12 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         fin = sv[f"{state_prefix}finished"]
         layers.assign(layers.elementwise_mul(fin, keep_i),
                       output=fin)
+        pfu = sv.get(f"{state_prefix}prefill_until")
+        if pfu is not None:
+            # admitted lanes start un-forced (a radix admission
+            # re-scatters its horizon AFTER this shared reset)
+            layers.assign(layers.elementwise_mul(pfu, keep_i),
+                          output=pfu)
         if seeds is not None:
             # per-request noise seeds scatter to their lanes in PURE
             # int arithmetic (a float32 one-hot matmul would truncate
@@ -1608,10 +1663,70 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds,
                           tier="hit")
 
+    def _admit_body_paged_radix(sv, A):
+        """Radix-resume admission (multi-turn sessions / shared-chain
+        fan-out): the prompt's cross-KV entry is pooled (prefix HIT —
+        the session pin guarantees it) and the longest shared BLOCK
+        prefix of the lane's token history is host-mapped read-only
+        into its block table, so the device neither encodes nor
+        replays those positions. Admission scatters the full token
+        HISTORY into tok_buf, sets step = resume_steps (the first
+        position NOT covered by shared blocks — every device write
+        lands in a freshly allocated exclusive block, which is how
+        PTA192's read-only-while-shared holds by construction) and
+        prefill_until = the history length, so the divergent tail
+        chunk-prefills via teacher forcing before real decoding
+        starts."""
+        hist = layers.data("hist_toks", shape=[A, maxT],
+                           dtype="int64", append_batch_size=False)
+        resume = layers.data("resume_steps", shape=[A], dtype="int64",
+                             append_batch_size=False)
+        until = layers.data("prefill_until", shape=[A], dtype="int64",
+                            append_batch_size=False)
+        slots = layers.data("slots", shape=[A], dtype="int64",
+                            append_batch_size=False)
+        seeds = _seeds_data(A)
+        oh, _, any_i, keep_f, keep_i = _lane_onehots(slots, A)
+        _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds,
+                          tier="radix")
+        # overwrite the shared reset's cold-start row/counters with
+        # the session history. Token ids < vocab << 2^24, so the
+        # float32 one-hot matmul scatter is exact; the counters use
+        # the pure-int scatter idiom (they share the seed path's
+        # magnitude concern)
+        ohT = layers.transpose(oh, perm=[1, 0])            # [rows, A]
+        hist_scat = layers.cast(
+            layers.matmul(ohT, layers.cast(hist, "float32")),
+            "int64")                                       # [R,maxT]
+        any_col = layers.reshape(any_i, [rows, 1])
+        keep_col = layers.reshape(keep_i, [rows, 1])
+        tok_buf = sv[f"{state_prefix}tok_buf"]
+        layers.assign(layers.elementwise_add(
+            layers.elementwise_mul(tok_buf, keep_col),
+            layers.elementwise_mul(hist_scat, any_col)),
+            output=tok_buf)
+        oh_i = layers.cast(oh, "int64")
+        for feed_v, state_name in ((resume, "step"),
+                                   (until, "prefill_until")):
+            var = sv[f"{state_prefix}{state_name}"]
+            scat = layers.reduce_sum(
+                layers.elementwise_mul(
+                    oh_i, layers.reshape(feed_v, [-1, 1])), dim=0)
+            layers.assign(layers.elementwise_add(
+                layers.elementwise_mul(var, keep_i), scat),
+                output=var)
+
     admit_bodies = {"miss": _admit_body_dense if not paged
                     else _admit_body_paged_miss}
     if paged:
         admit_bodies["hit"] = _admit_body_paged_hit
+        if not spec:
+            # the radix tier rides the plain paged step: speculative
+            # decode advances counters by variable accepted lengths,
+            # which the block-aligned resume arithmetic does not
+            # model (and the draft's dense per-lane KV has no shared
+            # prefix to reuse anyway)
+            admit_bodies["radix"] = _admit_body_paged_radix
 
     prefills = {}
     hit_prefills = {}
@@ -1635,7 +1750,7 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
 
     # --- the one-token step body over all lanes (shared by the
     # standalone step program and the fused serve programs' While) ---
-    def _step_body(sv):
+    def _step_body(sv, probe=False):
         tok_buf = sv[f"{state_prefix}tok_buf"]
         stepv = sv[f"{state_prefix}step"]
         fin = sv[f"{state_prefix}finished"]
@@ -1741,6 +1856,12 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         logits_v = layers.fc(
             layers.reshape(x, [0, d_model]), vocab,
             bias_attr=False, param_attr="logits.w")        # [R,V]
+        if probe:
+            # beam/probe front: publish every lane's full next-token
+            # distribution for the HOST to branch on (the paged beam
+            # decoder's expansion oracle — host selection, device KV)
+            layers.assign(layers.softmax(logits_v),
+                          output=sv[f"{state_prefix}probe_probs"])
         # --- per-lane emit (the emit_token_step tail, vectorized over
         # lane counters; same freeze/write semantics). Sampled lanes
         # draw from the filtered distribution keyed on (per-request
@@ -1764,6 +1885,21 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             layers.elementwise_mul(tok, not_fin),
             layers.cast(layers.scale(fin, scale=float(end_id)),
                         "int64"))
+        # teacher forcing (radix tail prefill / beam probe): while
+        # step+1 < prefill_until the lane is REPLAYING its history —
+        # the decoder ran and its KV write landed (that is the whole
+        # point), but the emitted token must not clobber the history
+        # token already sitting at step+1, and a coincidental end_id
+        # must not latch fin. prefill_until defaults to 0 everywhere,
+        # so non-radix lanes take emit_flag == act identically to the
+        # pre-forcing lowering.
+        emit_flag = ones_n
+        if paged:
+            forcing = layers.elementwise_mul(
+                act, layers.cast(layers.less_than(
+                    layers.elementwise_add(stepv, ones_n),
+                    sv[f"{state_prefix}prefill_until"]), "int64"))
+            emit_flag = layers.elementwise_sub(ones_n, forcing)
         # the EOS latch only counts lanes that actually ADVANCED this
         # tick (act gate): a host-paused paged lane (no KV block for
         # its next write) decodes a garbage token — its tok_buf write
@@ -1771,13 +1907,16 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         # would freeze the lane on garbage-EOS permanently
         new_fin = layers.elementwise_max(
             fin, layers.elementwise_mul(
-                act, layers.cast(layers.equal(
+                layers.elementwise_mul(act, emit_flag),
+                layers.cast(layers.equal(
                     tok, layers.fill_constant(
                         [1], "int64", float(end_id))), "int64")))
         next2 = layers.reshape(
             layers.elementwise_add(stepv, ones_n), [rows, 1])
         next_mask = layers.cast(layers.equal(positions, next2),
                                 "int64")                   # [R,maxT]
+        next_mask = layers.elementwise_mul(
+            next_mask, layers.reshape(emit_flag, [rows, 1]))
         keep_tok = layers.elementwise_sub(
             layers.fill_constant([rows, maxT], "int64", 1.0),
             next_mask)
@@ -2156,8 +2295,62 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         if paged:
             serves[("miss", A)] = _build_serve("miss", A)
             serves[("hit", A)] = _build_serve("hit", A)
+            if "radix" in admit_bodies:
+                serves[("radix", A)] = _build_serve("radix", A)
         else:
             serves[A] = _build_serve("miss", A)
+
+    # --- COW block copy (paged only): gather the SHARED source rows
+    # and masked-write them into freshly allocated EXCLUSIVE blocks —
+    # the one lowering through which a lane may diverge from a shared
+    # chain (beam branching, partial-page session resume). Operating
+    # on the whole [NB, BS, H, Dh] pool along dim 0 only keeps it
+    # layout-oblivious under tp (the sharded heads axis is never
+    # reshaped or reduced). Padded rows feed gate 0 AND dst -1 (the
+    # trash row), so one fixed-shape program serves any copy count. --
+    cow_prog = None
+    if paged:
+        cow_prog = fluid.Program()
+        with fluid.program_guard(cow_prog, fluid.Program()):
+            sv = _mark_ownership(
+                _declare_slot_state(cow_prog.global_block, specs))
+            csrc = layers.data("cow_src", shape=[rows], dtype="int64",
+                               append_batch_size=False)
+            cdst = layers.data("cow_dst", shape=[rows], dtype="int64",
+                               append_batch_size=False)
+            cgate = layers.data("cow_gate", shape=[rows],
+                                dtype="float32",
+                                append_batch_size=False)
+            # mint-site ownership marks (analysis/absint.py seed
+            # table): sources are refcount>=1 SHARED chain blocks
+            # (read-legal, write-ILLEGAL — PTA192 proves no write
+            # chains from them), destinations are host-fresh
+            # exclusive allocations (the COW window)
+            absint.mark_pool_index_source(csrc, "cow_src", bound=NB)
+            absint.mark_pool_index_source(cdst, "cow_dst", bound=NB)
+            for li in range(n_layers):
+                for tag in ("k", "v"):
+                    pool = sv[f"{state_prefix}self_{tag}{li}"
+                              f"{POOL_MARK}"]
+                    src_rows = layers.gather(pool, csrc)
+                    layers.masked_pool_write(
+                        pool, src_rows, cdst, cgate, leading_dims=1,
+                        exclusive_via="cow_dst")
+            _tel_add(sv, "tel_cow_blocks",
+                     layers.reduce_sum(layers.cast(cgate, "int64"),
+                                       keep_dim=True))
+
+    # --- probe step (paged, non-spec): one decode tick that ALSO
+    # publishes every lane's full softmax row to probe_probs — the
+    # paged beam decoder's expansion oracle (host selects tokens,
+    # device owns KV; under permanent teacher forcing the tick never
+    # writes tok_buf or latches fin) ---------------------------------
+    probe_prog = None
+    if paged and not spec:
+        probe_prog = fluid.Program()
+        with fluid.program_guard(probe_prog, fluid.Program()):
+            _step_body(_mark_ownership(_declare_slot_state(
+                probe_prog.global_block, specs)), probe=True)
 
     state = {"tok_buf": f"{state_prefix}tok_buf",
              "step": f"{state_prefix}step",
@@ -2166,6 +2359,9 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
     if paged:
         state["block_tab"] = f"{state_prefix}block_tab"
         state["prompt_ref"] = f"{state_prefix}prompt_ref"
+        state["prefill_until"] = f"{state_prefix}prefill_until"
+        if probe_prog is not None:
+            state["probe_probs"] = f"{state_prefix}probe_probs"
     if needs_seeds:
         state["seed"] = f"{state_prefix}seed"
     if spec:
@@ -2179,7 +2375,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                               state, n_slots, seq_len, maxT, start_id,
                               end_id, cache=cache,
                               hit_prefills=hit_prefills,
-                              sampling=sampling, draft=draft)
+                              sampling=sampling, draft=draft,
+                              cow=cow_prog, probe=probe_prog)
     bundle._state_specs = {
         n: (shape, dt) for n, (shape, dt) in specs.items()}
     if sharding is not None and sharding.enabled:
@@ -2358,14 +2555,18 @@ class BlockLifetimeError(ValueError):
 
 class HostBlockPool:
     """Free-list over the ``n_blocks`` shared self-KV blocks, run as
-    an explicit TYPESTATE machine: every block is ``free`` or
-    ``exclusive`` (owned by exactly one lane between alloc and free).
-    This is the host half of the lane-exclusivity story the
-    ownership prover leans on — its alloc-disjoint invariant is the
-    NAMED assumption (``HostBlockPool.alloc-disjoint``,
-    analysis/absint.py ownership seed table) under which PTA191
-    proves distinct lanes' pool writes hit disjoint rows; the device
-    half is the act-gated masked_pool_write masks. Invalid
+    an explicit TYPESTATE machine riding per-block refcounts:
+    ``free -> exclusive (refcount==1, owned by one lane) -> shared
+    (refcount>1, read-only radix prefix) -> free``. This is the host
+    half of the lane-exclusivity story the ownership prover leans on
+    — its alloc-disjoint invariant is the NAMED assumption
+    (``HostBlockPool.alloc-disjoint``, analysis/absint.py ownership
+    seed table) under which PTA191 proves distinct lanes' pool
+    writes hit disjoint rows: every block a lane can WRITE (the
+    write-reachable suffix of its table) is exclusive to it; shared
+    blocks may appear in many tables but only in the read-only
+    prefix below ``resume_step`` (PTA192's read-only-while-shared is
+    the device half, the host half is ``writable()`` here). Invalid
     transitions raise ``BlockLifetimeError`` instead of corrupting
     the free list (a double-freed block would be handed to two
     lanes)."""
@@ -2374,15 +2575,22 @@ class HostBlockPool:
         self.n_blocks = int(n_blocks)
         self._free = list(range(self.n_blocks))
         self._state = ["free"] * self.n_blocks
+        self._refs = [0] * self.n_blocks
 
     def alloc(self) -> Optional[int]:
         if not self._free:
             return None
         b = self._free.pop()
         self._state[b] = "exclusive"
+        self._refs[b] = 1
         return b
 
     def free(self, blocks):
+        """Strict single-owner free: legal ONLY from the exclusive
+        (refcount==1) typestate — the legacy lane-release path.
+        Radix-aware callers holding one ref among several use
+        ``decref`` instead; routing a possibly-shared block through
+        here raises rather than yanking KV other lanes attend to."""
         blocks = list(blocks)
         seen = set()
         for b in blocks:
@@ -2395,19 +2603,75 @@ class HostBlockPool:
                     f"free of block {b} in typestate "
                     f"{'freed-in-this-call' if b in seen else self._state[b]!r} "
                     f"(legal only from 'exclusive'): double-free/"
-                    f"unallocated free would hand one block to two "
-                    f"lanes")
+                    f"unallocated/shared free would hand one block "
+                    f"to two lanes")
             seen.add(b)
         for b in blocks:
             self._state[b] = "free"
+            self._refs[b] = 0
             self._free.append(b)
+
+    # --- refcount surface (the radix tree + COW path) ----------------
+    def incref(self, block: int) -> int:
+        """A new reader adopts the block (radix-tree node, extra lane
+        mapping it read-only, COW source pin). refcount 1 -> 2 is the
+        exclusive -> shared transition."""
+        if not 0 <= block < self.n_blocks:
+            raise BlockLifetimeError(
+                f"incref of block {block} outside the pool "
+                f"[0, {self.n_blocks})")
+        if self._refs[block] <= 0:
+            raise BlockLifetimeError(
+                f"incref of block {block} in typestate "
+                f"{self._state[block]!r} (refcount 0): a freed block "
+                f"may be re-handed to another lane at any alloc")
+        self._refs[block] += 1
+        self._state[block] = "shared"
+        return self._refs[block]
+
+    def decref(self, block: int) -> int:
+        """Drop one reference; at refcount 0 the block returns to the
+        free list (the shared -> exclusive -> free unwinding; a
+        decref from refcount 1 IS the radix-aware free)."""
+        if not 0 <= block < self.n_blocks:
+            raise BlockLifetimeError(
+                f"decref of block {block} outside the pool "
+                f"[0, {self.n_blocks})")
+        if self._refs[block] <= 0:
+            raise BlockLifetimeError(
+                f"decref of block {block} at refcount "
+                f"{self._refs[block]}: refcounts never go negative — "
+                f"a double decref would free KV another reader still "
+                f"attends to")
+        self._refs[block] -= 1
+        if self._refs[block] == 0:
+            self._state[block] = "free"
+            self._free.append(block)
+        elif self._refs[block] == 1:
+            self._state[block] = "exclusive"
+        return self._refs[block]
+
+    def refcount(self, block: int) -> int:
+        return self._refs[block]
+
+    def writable(self, block: int) -> bool:
+        """True while a device write into the block is legal:
+        refcount == 1 (single owner). A lane's first write into a
+        SHARED block must COW — copy into a fresh exclusive block,
+        then decref the shared source — never write through the
+        shared path (checker PTA192's host half)."""
+        return self._refs[block] == 1
 
     def typestate(self, block: int) -> str:
         return self._state[block]
 
     def live_blocks(self) -> set:
         return {b for b, s in enumerate(self._state)
-                if s == "exclusive"}
+                if s != "free"}
+
+    def shared_blocks(self) -> set:
+        return {b for b, s in enumerate(self._state)
+                if s == "shared"}
 
     @property
     def free_count(self) -> int:
@@ -2545,13 +2809,189 @@ class PromptPrefixCache:
         return sum(1 for r in self._refs.values() if r > 0)
 
 
+class _RadixNode:
+    __slots__ = ("chunk", "block", "children", "parent")
+
+    def __init__(self, chunk, block, parent):
+        self.chunk = chunk        # the BS-token tuple this edge spells
+        self.block = block        # pool block holding its self-KV
+        self.children = {}        # chunk tuple -> _RadixNode
+        self.parent = parent
+
+
+class RadixBlockTree:
+    """Host-side radix tree over decoded-token -> self-KV block
+    chains (the SGLang/RadixAttention longest-shared-prefix shape,
+    PAPERS.md, on vLLM-style block tables — reference counterpart:
+    none; the reference framework's fast_decode
+    (tests/unittests/dist_transformer.py:1498) holds per-request
+    dense caches with nothing shareable).
+
+    Granularity is one FULL block (``block_size`` tokens): a node is
+    a block whose KV is fully determined by the root prompt plus the
+    token chunks spelling the path to it. Roots are keyed by the
+    PROMPT CONTENT tuple — this framework's encoder is bidirectional,
+    so every self-KV row also attends cross-attention values derived
+    from the whole prompt, and chains are shareable only between
+    requests with the SAME prompt (the cross-KV entry the
+    PromptPrefixCache already dedupes).
+
+    Refcount protocol (HostBlockPool): the tree holds ONE ref per
+    adopted node (``incref`` at insert); every lane mapping a chain
+    read-only holds one ref per block (``acquire``/``release``). A
+    node whose block is at refcount 1 is tree-only and evictable —
+    ``evict`` drops such LEAF nodes (never an interior node: its
+    children's KV transitively depends on it), which is exactly the
+    "eviction only unpins refcount-0 subtrees" invariant
+    tests/test_block_pool_model.py property-checks."""
+
+    def __init__(self, pool: "HostBlockPool", block_size: int):
+        self.pool = pool
+        self.block_size = max(1, int(block_size))
+        self._roots: Dict[tuple, _RadixNode] = {}
+        self.inserts = 0
+        self.adoptions = 0
+        self.hit_blocks = 0
+        self.evicted_blocks = 0
+
+    def _chunks(self, tokens):
+        toks = tuple(int(t) for t in tokens)
+        bs = self.block_size
+        return [toks[i:i + bs] for i in
+                range(0, len(toks) - len(toks) % bs, bs)]
+
+    def _walk(self, prompt, tokens):
+        """Longest-prefix walk: (matched nodes, first divergent chunk
+        index)."""
+        node = self._roots.get(tuple(int(t) for t in prompt))
+        path = []
+        if node is None:
+            return path
+        for chunk in self._chunks(tokens):
+            nxt = node.children.get(chunk)
+            if nxt is None:
+                break
+            path.append(nxt)
+            node = nxt
+        return path
+
+    def match(self, prompt, tokens) -> int:
+        """Longest shared block-prefix depth (in BLOCKS) for this
+        (prompt, decoded-token) pair. Pure probe — no refcounts."""
+        return len(self._walk(prompt, tokens))
+
+    def acquire(self, prompt, tokens, max_blocks=None):
+        """Map the longest shared prefix read-only into a lane: one
+        ``incref`` per matched block (the lane's refs — released
+        with ``release``). Returns the block-id list, shallowest
+        first."""
+        path = self._walk(prompt, tokens)
+        if max_blocks is not None:
+            path = path[:max_blocks]
+        blocks = [n.block for n in path]
+        for b in blocks:
+            self.pool.incref(b)
+        self.hit_blocks += len(blocks)
+        return blocks
+
+    def release(self, blocks):
+        """Drop a lane's refs on a shared chain (reverse order so a
+        block freed at refcount 0 never outlives a deeper block that
+        depends on it)."""
+        for b in reversed(list(blocks)):
+            self.pool.decref(b)
+
+    def insert(self, prompt, tokens, blocks) -> int:
+        """Adopt a finished lane's FULL-block chain: walk the chunks;
+        where a node already exists the existing block wins (the
+        lane's duplicate stays lane-owned — the caller releases it
+        normally); where it doesn't, the tree adopts the lane's block
+        with its OWN incref (the lane still releases its ref).
+        Returns the number of newly adopted blocks."""
+        key = tuple(int(t) for t in prompt)
+        chunks = self._chunks(tokens)
+        if not chunks:
+            return 0
+        blocks = list(blocks)
+        if len(blocks) < len(chunks):
+            raise BlockLifetimeError(
+                f"radix insert of {len(chunks)} full chunks backed "
+                f"by only {len(blocks)} blocks: a node without its "
+                f"KV block would serve garbage to every later hit")
+        root = self._roots.get(key)
+        if root is None:
+            root = self._roots[key] = _RadixNode(None, None, None)
+        node, adopted = root, 0
+        for chunk, block in zip(chunks, blocks):
+            nxt = node.children.get(chunk)
+            if nxt is None:
+                self.pool.incref(block)
+                nxt = _RadixNode(chunk, block, node)
+                node.children[chunk] = nxt
+                adopted += 1
+            node = nxt
+        self.inserts += 1
+        self.adoptions += adopted
+        return adopted
+
+    def evict(self, need: int) -> int:
+        """Free >= ``need`` blocks by unpinning tree-only (refcount
+        1) LEAF nodes, deepest first. Returns how many were freed;
+        pinned subtrees (any lane ref anywhere below) are never
+        touched."""
+        freed = 0
+        while freed < need:
+            victim = None
+            for root in self._roots.values():
+                stack = [(c, 1) for c in root.children.values()]
+                best = None
+                while stack:
+                    n, d = stack.pop()
+                    if n.children:
+                        stack.extend((c, d + 1)
+                                     for c in n.children.values())
+                    elif self.pool.refcount(n.block) == 1:
+                        if best is None or d > best[1]:
+                            best = (n, d)
+                if best is not None and (
+                        victim is None or best[1] > victim[1]):
+                    victim = best
+            if victim is None:
+                break
+            node = victim[0]
+            del node.parent.children[node.chunk]
+            self.pool.decref(node.block)
+            freed += 1
+            self.evicted_blocks += 1
+        for key in [k for k, r in self._roots.items()
+                    if not r.children]:
+            del self._roots[key]
+        return freed
+
+    def tree_blocks(self) -> set:
+        """Every block currently adopted by a node (the tree's own
+        refs) — the property tests' overlap oracle."""
+        out = set()
+        for root in self._roots.values():
+            stack = list(root.children.values())
+            while stack:
+                n = stack.pop()
+                out.add(n.block)
+                stack.extend(n.children.values())
+        return out
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self.tree_blocks())
+
+
 __all__ = ["CacheConfig", "SamplingConfig", "DraftConfig",
            "ShardingConfig", "DecodeStepBundle", "DECODE_STEPS_VAR",
            "POOL_MARK", "LANE_AXIS",
            "tp_param_placements", "annotate_sharded_program",
            "place_sharded_bundle", "place_sharded_program",
            "BlockPoolExhausted", "BlockLifetimeError",
-           "HostBlockPool",
+           "HostBlockPool", "RadixBlockTree",
            "PromptPrefixCache", "build_greedy_decode_program",
            "build_incremental_decode_program",
            "build_decode_step_program", "build_beam_decode_program",

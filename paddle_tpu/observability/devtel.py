@@ -123,6 +123,18 @@ BUNDLE_COUNTERS: Tuple[CounterSpec, ...] = (
         "admitted_hit",
         "real lanes admitted through the encoder-free prefix-HIT "
         "body", paged_only=True),
+    CounterSpec(
+        "tel_admit_radix", "paddle_tpu_devtel_admit_radix_total",
+        "admitted_radix",
+        "real lanes admitted through the radix-resume body (shared "
+        "block prefix mapped read-only, divergent tail teacher-"
+        "force prefilled)", paged_only=True),
+    CounterSpec(
+        "tel_cow_blocks", "paddle_tpu_devtel_cow_blocks_total",
+        "cow_blocks",
+        "KV blocks copied by the COW program (lane diverging off a "
+        "shared radix/beam chain into a fresh exclusive block)",
+        paged_only=True),
 )
 
 # host-side supplement the PAGED scheduler reports through the same

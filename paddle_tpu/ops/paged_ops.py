@@ -31,8 +31,9 @@ def masked_pool_write(ctx):
     the Index addresses, flattened), exclusive_via (the builder's
     declaration of WHY row indices cannot alias: "block_table" =
     per-lane blocks from a host free-list, "host_indices" =
-    host-deduplicated admission targets — checker PTA110 requires
-    it).
+    host-deduplicated admission targets, "cow_dst" = freshly
+    allocated exclusive blocks a COW copy diverges a lane into —
+    checker PTA110 requires it).
 
     Out-of-range and gated-off rows write nothing (they scatter into
     a trash row that is sliced away), and cells hit by a gated row

@@ -25,7 +25,8 @@ import pytest
 
 from paddle_tpu.models.decode_engine import (BlockLifetimeError,
                                              HostBlockPool,
-                                             PromptPrefixCache)
+                                             PromptPrefixCache,
+                                             RadixBlockTree)
 
 
 class TestHostBlockPoolModel:
@@ -179,3 +180,222 @@ class TestPromptPrefixCacheModel:
         assert pc.lookup(p1) == ("miss", None)
         assert pc.lookup(p2)[0] == "hit"
         assert pc.refcount(e2) == 1
+
+
+class TestRadixBlockTreeModel:
+    """Randomized trace testing of the refcounted radix tree over
+    HostBlockPool (the ISSUE 16 protocol): lanes acquire shared
+    chains read-only + alloc exclusive tails, finished chains are
+    inserted (the tree adopts with its OWN ref; existing node wins),
+    eviction unpins tree-only leaves. The model tracks every holder
+    of every block and cross-checks the pool's refcounts/typestates
+    after every operation."""
+
+    BS = 2
+
+    def _histories(self, rng):
+        """Per-prompt deterministic decode streams that SHARE a
+        prefix and then branch (greedy decode determinism is what
+        makes radix chains shareable at all): prompt -> two variants
+         'a'/'b' diverging after a random number of chunks."""
+        out = {}
+        for p in range(3):
+            prompt = (100 + p, 200 + p)
+            common = [rng.randrange(3, 50)
+                      for _ in range(self.BS * rng.randint(1, 4))]
+            out[prompt] = {
+                v: common + [rng.randrange(3, 50) + 50 * i
+                             for i in range(self.BS * 5)]
+                for i, v in enumerate(("a", "b"))}
+        return out
+
+    def _check(self, pool, tree, lanes):
+        """Global cross-check: pool refcounts == model holder counts,
+        writability == single ownership, lane TAILS disjoint."""
+        holders = {b: 1 for b in tree.tree_blocks()}
+        tails = []
+        for ln in lanes.values():
+            for b in ln["shared"] + ln["tail"]:
+                holders[b] = holders.get(b, 0) + 1
+            tails.append(set(ln["tail"]))
+        for b in range(pool.n_blocks):
+            want = holders.get(b, 0)
+            assert pool.refcount(b) == want, (b, want,
+                                              pool.refcount(b))
+            assert (pool.typestate(b) != "free") == (want > 0)
+            if want > 0:
+                # refcount 1 <=> writable <=> exactly one holder
+                assert pool.writable(b) == (want == 1)
+        # live blocks never overlap across chains in the WRITABLE
+        # position: exclusive tails are pairwise disjoint
+        for i in range(len(tails)):
+            for j in range(i + 1, len(tails)):
+                assert not (tails[i] & tails[j]), (tails[i],
+                                                   tails[j])
+        assert pool.free_count + pool.in_use == pool.n_blocks
+
+    def test_random_traces_hold_radix_invariants(self):
+        for seed in range(6):
+            rng = random.Random(3000 + seed)
+            pool = HostBlockPool(rng.randint(10, 28))
+            tree = RadixBlockTree(pool, self.BS)
+            hist = self._histories(rng)
+            lanes, next_lane = {}, 0
+            for _ in range(250):
+                r = rng.random()
+                if r < 0.45:  # admit: acquire shared + alloc tail
+                    prompt = rng.choice(list(hist))
+                    var = rng.choice(("a", "b"))
+                    n = rng.randrange(0, 10)
+                    toks = hist[prompt][var][:n]
+                    shared = tree.acquire(prompt, toks)
+                    want_tail = rng.randint(1, 2)
+                    tail = []
+                    while len(tail) < want_tail:
+                        b = pool.alloc()
+                        if b is None:
+                            break
+                        tail.append(b)
+                    if len(tail) < want_tail:
+                        # exhausted: back out ATOMICALLY (the
+                        # server's blocked-admission path)
+                        for b in reversed(tail):
+                            pool.decref(b)
+                        tree.release(shared)
+                    else:
+                        lanes[next_lane] = {
+                            "prompt": prompt, "var": var,
+                            "shared": shared, "tail": tail}
+                        next_lane += 1
+                elif r < 0.75 and lanes:  # finish: insert + free
+                    lid = rng.choice(list(lanes))
+                    ln = lanes.pop(lid)
+                    chain = ln["shared"] + ln["tail"]
+                    # the lane decoded along its deterministic
+                    # stream: every block in the chain is FULL
+                    toks = hist[ln["prompt"]][ln["var"]][
+                        :len(chain) * self.BS]
+                    before_tree = tree.tree_blocks()
+                    adopted = tree.insert(ln["prompt"], toks, chain)
+                    # existing node wins: newly adopted blocks are
+                    # exactly the chain blocks not already in a node
+                    gained = tree.tree_blocks() - before_tree
+                    assert len(gained) == adopted
+                    assert gained <= set(chain)
+                    tree.release(ln["shared"])
+                    for b in reversed(ln["tail"]):
+                        pool.decref(b)
+                elif r < 0.9:  # evict
+                    lane_held = {b for ln in lanes.values()
+                                 for b in ln["shared"] + ln["tail"]}
+                    before = pool.free_count
+                    freed = tree.evict(rng.randint(1, 3))
+                    assert pool.free_count == before + freed
+                    # eviction never touches a pinned block
+                    for b in lane_held:
+                        assert pool.typestate(b) != "free", b
+                else:  # release a lane WITHOUT inserting (failure/
+                    # preemption path: nothing joins the tree)
+                    if lanes:
+                        lid = rng.choice(list(lanes))
+                        ln = lanes.pop(lid)
+                        tree.release(ln["shared"])
+                        for b in reversed(ln["tail"]):
+                            pool.decref(b)
+                self._check(pool, tree, lanes)
+            # drain: release every lane, then evict the whole tree —
+            # the pool must come back to fully free (no leaks)
+            for ln in lanes.values():
+                tree.release(ln["shared"])
+                for b in reversed(ln["tail"]):
+                    pool.decref(b)
+            tree.evict(pool.n_blocks)
+            assert pool.free_count == pool.n_blocks
+            assert tree.tree_blocks() == set()
+
+    def test_refcounts_never_negative(self):
+        pool = HostBlockPool(2)
+        b = pool.alloc()
+        pool.decref(b)
+        with pytest.raises(BlockLifetimeError, match="negative"):
+            pool.decref(b)
+        with pytest.raises(BlockLifetimeError, match="refcount 0"):
+            pool.incref(b)
+
+    def test_shared_block_is_not_writable_cow_restores(self):
+        # host half of PTA192: a first write into a shared block must
+        # COW — the shared source is never writable; the fresh copy
+        # is; decref'ing the source back to one owner restores its
+        # writability
+        pool = HostBlockPool(4)
+        src = pool.alloc()
+        pool.incref(src)                 # tree/another lane adopts
+        assert not pool.writable(src)
+        dst = pool.alloc()               # the COW destination
+        assert pool.writable(dst)
+        pool.decref(src)                 # the writing lane lets go
+        assert pool.writable(src)        # sole owner again
+
+    def test_strict_free_rejects_shared_blocks(self):
+        # the legacy lane-release path must NOT yank a radix-adopted
+        # block: free() is exclusive-only, decref is the radix-aware
+        # release
+        pool = HostBlockPool(2)
+        b = pool.alloc()
+        pool.incref(b)
+        with pytest.raises(BlockLifetimeError, match="shared"):
+            pool.free([b])
+        assert pool.refcount(b) == 2     # the refused free mutated
+        pool.decref(b)                   # nothing
+        pool.free([b])
+
+    def test_insert_underflow_is_atomic(self):
+        pool = HostBlockPool(4)
+        tree = RadixBlockTree(pool, 2)
+        blocks = [pool.alloc(), pool.alloc()]
+        with pytest.raises(BlockLifetimeError, match="radix insert"):
+            tree.insert((1, 2), [5, 6, 7, 8, 9, 10], blocks)
+        # NOTHING was adopted: validation precedes mutation
+        assert tree.tree_blocks() == set()
+        assert all(pool.refcount(b) == 1 for b in blocks)
+
+    def test_existing_node_wins_duplicate_stays_lane_owned(self):
+        # two lanes decode the SAME continuation (greedy twins): the
+        # first insert adopts, the second adopts nothing and the
+        # duplicate blocks remain the lane's to free normally
+        pool = HostBlockPool(8)
+        tree = RadixBlockTree(pool, 2)
+        toks = [7, 8, 9, 10]
+        a = [pool.alloc(), pool.alloc()]
+        assert tree.insert((1,), toks, a) == 2
+        b = [pool.alloc(), pool.alloc()]
+        assert tree.insert((1,), toks, b) == 0
+        assert tree.tree_blocks() == set(a)
+        for blk in reversed(b):
+            pool.decref(blk)             # duplicates: plain free
+        for blk in reversed(a):
+            pool.decref(blk)             # lane refs; tree's survive
+        assert pool.in_use == 2          # the adopted chain lives on
+        # a later acquire maps the surviving chain
+        got = tree.acquire((1,), toks)
+        assert got == a
+        tree.release(got)
+
+    def test_evict_deepest_leaf_first_never_interior(self):
+        pool = HostBlockPool(8)
+        tree = RadixBlockTree(pool, 2)
+        toks = [1, 2, 3, 4, 5, 6]
+        chain = [pool.alloc() for _ in range(3)]
+        tree.insert((9,), toks, chain)
+        for b in reversed(chain):
+            pool.decref(b)               # lane gone; tree-only now
+        # a lane pins the 2-block prefix: only the depth-3 leaf is
+        # evictable, interior nodes under the pin never are
+        held = tree.acquire((9,), toks[:4])
+        assert held == chain[:2]
+        assert tree.evict(99) == 1
+        assert pool.typestate(chain[2]) == "free"
+        assert tree.tree_blocks() == set(chain[:2])
+        tree.release(held)
+        assert tree.evict(99) == 2       # unpinned: deepest first
+        assert pool.free_count == pool.n_blocks

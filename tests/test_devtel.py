@@ -51,8 +51,8 @@ DENSE_STATS_KEYS = {"ticks", "occupancy_integral", "exit_n_steps",
                     "exit_all_idle", "exit_min_active",
                     "admitted_miss", "mean_live_lanes"}
 PAGED_STATS_KEYS = DENSE_STATS_KEYS | {
-    "admitted_hit", "blocks_hwm", "prompt_entries_hwm",
-    "pause_events", "preemptions"}
+    "admitted_hit", "admitted_radix", "cow_blocks", "blocks_hwm",
+    "prompt_entries_hwm", "pause_events", "preemptions"}
 DENSE_METRICS = {
     "paddle_tpu_devtel_ticks_total",
     "paddle_tpu_devtel_occupancy_integral_total",
@@ -63,6 +63,8 @@ DENSE_METRICS = {
 }
 PAGED_METRICS = DENSE_METRICS | {
     "paddle_tpu_devtel_admit_hit_total",
+    "paddle_tpu_devtel_admit_radix_total",
+    "paddle_tpu_devtel_cow_blocks_total",
     "paddle_tpu_devtel_blocks_hwm",
     "paddle_tpu_devtel_prompt_entries_hwm",
     "paddle_tpu_devtel_pause_events_total",
