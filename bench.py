@@ -1458,6 +1458,216 @@ def bench_multiturn(n_conversations=12, n_turns=3):
                              stats_json_dict=rbest["stats"])
 
 
+def bench_prefill(n_longs=3, shorts_per_long=6):
+    """Chunked prefill vs monolithic admission (ISSUE 17): the
+    TTFT-vs-ITL coupling. Today a miss-tier admission runs the FULL
+    encoder prefill inside the serve program, so one 2k-token
+    arrival stalls every live lane's decode tick; chunked prefill
+    (Sarathi-style, C prompt tokens per tick through the
+    ``("chunked", p)`` phase programs) bounds the stall at one
+    chunk.
+
+    ONE bundle (seq_len=2048, chunk_tokens=256 -> 8 chunks x 4
+    phases), TWO legs over the same executor/scope:
+
+    * ``chunked`` — the default two-tier schedule: chunk ticks
+      interleave with decode bursts;
+    * ``mono``    — ``chunked_prefill=False``: the same programs
+      minus the chunk tier; cold admissions prefill monolithically.
+
+    Each leg measures two windows (stats(reset=True) between them):
+    a LONG-ONLY window (two cold 2k prompts back-to-back -> server
+    ttft_ms is long-only by construction) and the INTERLEAVED window
+    — hit-tier shorts stream while a cold 2k prompt arrives; each
+    short's inter-token latency is client-side wall / tokens, so
+    the monolithic stall lands in the short ITL p99 directly.
+
+    Discipline (PERF.md, throttled 2-core host): both legs warmed
+    once (all compiles), then interleave_rounds best-of-3 — paired
+    per-round ITL ratios only; BYTE-EXACT token parity chunked vs
+    mono on every request of every round (phase-major chunking is
+    exact, not approximate); zero steady-state compiles across the
+    measured rounds; executable count bounded by the bundle's serve
+    programs (#bucket tiers + #chunk phases) + slot-state init.
+
+    ``radix_reuse=False`` on BOTH legs: identical repeat shorts
+    would otherwise resume from the radix tree (near-free decode)
+    and thin the very decode traffic the stall is measured against.
+
+    CPU-PINNED by design (the stall is host-observable wall time;
+    same reasoning as bench_generation). Writes BENCH_SELF_r18.json.
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.inference import PagedContinuousGenerationServer
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.models.decode_engine import CacheConfig
+
+    V, D, H, L, S, maxT = 16, 32, 2, 1, 2048, 16
+    BS, NB, E, n_slots, C = 8, 24, 6, 4, 256
+    NC = (S + C - 1) // C
+    NPH = 2 * L + 2
+
+    # untrained, seed-pinned: greedy decode is deterministic either
+    # way, and parity/latency need no trained weights at S=2048
+    fluid.seed(0)
+    scope = Scope()
+    with unique_name.guard():
+        _, startup, _ = T.build_program(
+            seq_len=S, d_model=D, n_heads=H, n_layers=L, d_inner=64,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    with unique_name.guard():
+        bundle = T.build_decode_step_program(
+            n_slots=n_slots, admit_buckets=[1], state_prefix="@pf/",
+            seq_len=S, max_out_len=maxT, d_model=D, n_heads=H,
+            n_layers=L, d_inner=64, vocab=V, start_id=2, end_id=1,
+            cache=CacheConfig(layout="paged", block_size=BS,
+                              n_blocks=NB, n_prompt_entries=E,
+                              chunk_tokens=C))
+    compiles0 = exe.compile_count
+
+    # fixed prompt sets, identical across legs and rounds: 2 shorts
+    # (hit tier after the warm pass) + 5 distinct cold 2k longs
+    # (2 for the long-only TTFT window, n_longs for the interleaved
+    # one). E=6 entries: the shorts stay MRU through the interleaved
+    # stream, so entry eviction only ever recycles a long's entry.
+    rng = np.random.RandomState(11)
+    shorts = [rng.randint(3, V, (1, S)).astype(np.int64)
+              for _ in range(2)]
+    longs = [rng.randint(3, V, (1, S)).astype(np.int64)
+             for _ in range(2 + n_longs)]
+
+    def _p99(vals):
+        srt = sorted(vals)
+        return srt[max(0, int(np.ceil(0.99 * len(srt))) - 1)]
+
+    def leg(chunked):
+        srv = PagedContinuousGenerationServer(
+            bundle, executor=exe, scope=scope, steps_per_tick=4,
+            chunked_prefill=chunked, radix_reuse=False)
+        toks = []
+        try:
+            for p in shorts:  # warm the hit tier (cold exactly once)
+                toks.append(np.asarray(srv.submit(p).result(600.0)))
+            srv.stats(reset=True)
+            # LONG-ONLY window: server ttft_ms sees only cold 2k
+            # prompts here
+            long_walls = []
+            for p in longs[:2]:
+                t0 = time.perf_counter()
+                toks.append(np.asarray(srv.submit(p).result(600.0)))
+                long_walls.append((time.perf_counter() - t0) * 1e3)
+            st_long = srv.stats(reset=True)
+            # INTERLEAVED window: shorts stream while a cold 2k
+            # prompt chunks in (or stalls the loop, mono leg)
+            itl = []
+            for k in range(n_longs):
+                rep = srv.submit(longs[2 + k])
+                for j in range(shorts_per_long):
+                    t0 = time.perf_counter()
+                    out = np.asarray(
+                        srv.submit(shorts[j % 2]).result(600.0))
+                    ntok = max(int((out != -1).sum()), 1)
+                    itl.append(
+                        (time.perf_counter() - t0) * 1e3 / ntok)
+                    toks.append(out)
+                toks.append(np.asarray(rep.result(600.0)))
+            st = srv.stats()
+            pst = srv.pool_stats()
+        finally:
+            srv.close()
+        return {"wall_s": sum(long_walls) / 1e3, "toks": toks,
+                "itl_p99_ms": _p99(itl), "itl_ms": itl,
+                "long_ttft_ms": st_long["ttft_ms"],
+                "long_wall_p50_ms": sorted(long_walls)[
+                    len(long_walls) // 2],
+                "stats": st, "pool": pst}
+
+    def chunked_leg():
+        return leg(True)
+
+    def mono_leg():
+        return leg(False)
+
+    mono_leg()     # warm both serve-tier sets (all compiles here)
+    chunked_leg()
+    warm_compiles = exe.compile_count - compiles0
+    # #bucket tiers + #chunk phases (+ slot-state init/reset bits):
+    # the whole point of the two-tier schedule is that chunking adds
+    # NPH programs, not NC x NPH
+    exe_bound = len(bundle.serves) + 4
+    assert warm_compiles <= exe_bound, (
+        f"warm legs compiled {warm_compiles} executables — bound is "
+        f"{len(bundle.serves)} serve programs + 4 init")
+    compiles_before = exe.compile_count
+    rounds = _harness.interleave_rounds(
+        [("mono", mono_leg), ("chunked", chunked_leg)], rounds=3)
+    steady_compiles = exe.compile_count - compiles_before
+    assert steady_compiles == 0, (
+        f"steady-state legs compiled {steady_compiles}")
+    # BYTE-EXACT parity on every request of every round: phase-major
+    # chunking must not change one served token
+    for r in rounds:
+        assert len(r["chunked"]["toks"]) == len(r["mono"]["toks"])
+        for i, (a, b) in enumerate(zip(r["chunked"]["toks"],
+                                       r["mono"]["toks"])):
+            assert np.array_equal(a, b), (
+                f"request {i}: chunked decode diverged from "
+                f"monolithic admission")
+    # paired per-round ITL ratios (the r10 discipline)
+    ratios = [r["chunked"]["itl_p99_ms"] / r["mono"]["itl_p99_ms"]
+              for r in rounds]
+    med_ratio = sorted(ratios)[len(ratios) // 2]
+    assert min(ratios) < 1.0 and med_ratio < 1.0, (
+        f"short-request ITL p99 paired ratios {ratios}: chunked "
+        f"prefill did not beat the monolithic stall")
+    cbest = _harness.best_leg(rounds, "chunked",
+                              key=lambda r: r["itl_p99_ms"])
+    mbest = _harness.best_leg(rounds, "mono",
+                              key=lambda r: r["itl_p99_ms"])
+    result = {
+        "metric": "prefill_short_itl_p99_chunked",
+        "value": round(cbest["itl_p99_ms"], 2),
+        "unit": "ms/token",
+        "mono_itl_p99_ms": round(mbest["itl_p99_ms"], 2),
+        "itl_p99_paired_ratios": [round(r, 3) for r in ratios],
+        "itl_p99_ratio_median": round(med_ratio, 3),
+        "long_ttft_ms": {
+            "chunked": cbest["long_ttft_ms"],
+            "mono": mbest["long_ttft_ms"],
+        },
+        "long_wall_p50_ms": {
+            "chunked": round(cbest["long_wall_p50_ms"], 1),
+            "mono": round(mbest["long_wall_p50_ms"], 1),
+        },
+        "token_parity_chunked_vs_mono": True,  # asserted per round
+        "steady_state_compiles": int(steady_compiles),
+        "warm_compiles": int(warm_compiles),
+        "executable_bound": int(exe_bound),
+        "chunk": {
+            "chunk_tokens": C, "n_chunks": NC, "phases": NPH,
+            "chunk_jobs": cbest["pool"]["chunk_jobs"],
+            "chunk_ticks": cbest["pool"]["chunk_ticks"],
+        },
+        "workload": f"{shorts_per_long} hit-tier shorts streamed per "
+                    f"cold {S}-token arrival x {n_longs} arrivals; "
+                    f"2-long TTFT window per leg",
+        "cache": {"block_size": BS, "n_blocks": NB,
+                  "n_prompt_entries": E},
+        "model": f"transformer d{D} L{L} S{S} maxT{maxT}",
+        "best_of": 3,
+    }
+    return _write_bench_self("BENCH_SELF_r18.json", result,
+                             stats_json_dict=cbest["stats"])
+
+
 def bench_sharded(n_requests=120):
     """Sharded serving: tensor-parallel decode + data-parallel lanes
     on the virtual 8-device mesh (models/decode_engine.ShardingConfig
@@ -2334,7 +2544,8 @@ EXTRA_BENCHES = {"transformer_scan": bench_transformer_scan,
                  "speculative": bench_speculative,
                  "sharded": bench_sharded,
                  "multitenant": bench_multitenant,
-                 "multiturn": bench_multiturn}
+                 "multiturn": bench_multiturn,
+                 "prefill": bench_prefill}
 
 
 _probe_backend = _harness.probe_backend

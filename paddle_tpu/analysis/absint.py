@@ -455,6 +455,16 @@ register_pool_index_source(
     "the exclusive write window a lane diverges into when it "
     "branches off a shared prefix",
     TS_EXCLUSIVE, assumption="HostBlockPool.cow-fresh-exclusive")
+register_pool_index_source(
+    "chunk_cursor",
+    "chunked-prefill position cursor (the `chunk_pos` feed): the "
+    "host walks it 0, C, 2C, ... < seq_len across ONE prompt whose "
+    "entry stays fresh-exclusive (refcount==1, unpublished) for the "
+    "whole multi-phase prefill — it selects POSITIONS inside that "
+    "exclusive entry's staging/cross rows, never a pool row, so "
+    "every write it parameterizes stays inside the host_indices "
+    "exclusivity window",
+    TS_EXCLUSIVE, assumption="PromptPrefixCache.fresh-exclusive")
 
 
 @dataclass(frozen=True)

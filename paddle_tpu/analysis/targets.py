@@ -136,6 +136,16 @@ def _transformer():
         n_slots=4, state_prefix="@cbt/", admit_buckets=[2],
         sampling=SamplingConfig(temperature=0.8, top_k=8,
                                 top_p=0.95), **dkw)
+    # chunked prefill (ISSUE 17): the ("chunked", p) phase programs
+    # join the strict zoo — the embed scatter (0), a kv staging
+    # phase (1), an attention phase (2) and the cross-KV install
+    # (2L+1) cover every distinct phase-body shape; the bundle
+    # contract sweep (PTA150) checks the full set
+    chunked = tr.build_decode_step_program(
+        n_slots=4, state_prefix="@cbc/", admit_buckets=[2],
+        cache=CacheConfig(layout="paged", block_size=4, n_blocks=8,
+                          n_prompt_entries=3, chunk_tokens=4), **dkw)
+    ckph = len(chunked.chunk_phase_keys) - 1
     return ({"main": main, "startup": startup, "greedy": greedy[0],
              "incremental": incr[0], "beam": beam[0],
              "cb_prefill": bundle.prefill,
@@ -160,7 +170,12 @@ def _transformer():
              f"sps_serve_miss{psbig}": pspec.serves[("miss", psbig)],
              f"sps_serve_hit{psbig}": pspec.serves[("hit", psbig)],
              "smp_step": sampled.step,
-             "smp_serve0": sampled.serves[0]},
+             "smp_serve0": sampled.serves[0],
+             "ck_chunk_embed": chunked.serves[("chunked", 0)],
+             "ck_chunk_kv": chunked.serves[("chunked", 1)],
+             "ck_chunk_attn": chunked.serves[("chunked", 2)],
+             f"ck_chunk_cross{ckph}":
+                 chunked.serves[("chunked", ckph)]},
             [("main", "greedy"), ("main", "incremental"),
              ("main", "beam"), ("main", "cb_prefill"),
              ("main", f"cb_prefill{big}"), ("main", "cb_step"),
@@ -172,12 +187,14 @@ def _transformer():
              ("main", "pg_cow"), ("main", "pg_probe"),
              ("main", "sp_step"), ("main", f"sp_serve{sbig}"),
              ("main", f"sps_serve_miss{psbig}"),
-             ("main", "smp_step")],
+             ("main", "smp_step"),
+             ("main", "ck_chunk_kv"),
+             ("main", f"ck_chunk_cross{ckph}")],
             "shared_params",
             # whole-bundle contract sweep (PTA150): every bundle the
             # repo ships, checked as a unit
             {"cb": bundle, "pg": paged, "sp": spec, "sps": pspec,
-             "smp": sampled})
+             "smp": sampled, "ck": chunked})
 
 
 def _moe_transformer():

@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 __all__ = ["MeshPlacement", "plan_mesh", "place_scope_on_device",
-           "ReplicaSet"]
+           "place_disaggregated_bundle", "ReplicaSet"]
 
 
 @dataclass
@@ -98,6 +98,95 @@ def place_scope_on_device(scope, device, names=None) -> int:
             continue
         scope._set(name, jax.device_put(val, device))
         placed += 1
+    return placed
+
+
+def place_disaggregated_bundle(bundle, decode_scope, prefill_scope,
+                               decode_devices=None,
+                               prefill_devices=None,
+                               sync_from_decode=True) -> int:
+    """The one-time placement step for a DISAGGREGATED bundle
+    (``apply_phase_sharding``): bind the decode plan and the prefill
+    plan to their (normally disjoint) device slices and device_put
+    each phase's state into ITS scope under ITS plan.
+
+    * ``decode_scope`` hosts every persistable the non-chunk programs
+      read (params, slot state, pools) under ``bundle.sharding_plan``.
+    * ``prefill_scope`` hosts every persistable the ``("chunked", p)``
+      phase programs read under ``bundle.prefill_plan`` — the chunk
+      programs embed the serve While (dispatched with ``n_steps=0``
+      by the worker), so this is the full state set too; its decode-
+      side arrays are dead weight that XLA never touches.
+
+    Defaults carve ``jax.devices()`` head-first: decode on the first
+    ``tp_d`` devices, prefill on the NEXT ``tp_p`` — disjoint, so the
+    two plans' tokens differ by device ids as well as placements and
+    no executable/disk-cache entry can dedup across phases.
+
+    ``sync_from_decode`` copies any prefill-side array that is
+    missing from ``prefill_scope`` out of ``decode_scope`` first
+    (params are trained/loaded once, in the decode scope).
+
+    Version-bump discipline matches
+    ``decode_engine.place_sharded_bundle``: programs re-attach (and
+    prepared handles re-resolve) only on a REAL rebind.
+
+    Reference counterpart: reference
+    framework/details/multi_devices_graph_pass.cc:40 — per-place
+    replication, here split by PHASE instead of by replica."""
+    import numpy as np
+
+    import jax
+
+    from ...core import sharding_plan as sp
+
+    dec_plan = getattr(bundle, "sharding_plan", None)
+    pre_plan = getattr(bundle, "prefill_plan", None)
+    if dec_plan is None or pre_plan is None:
+        raise ValueError(
+            "bundle has no phase plans — run "
+            "decode_engine.apply_phase_sharding(bundle, ...) first")
+    if decode_devices is None and prefill_devices is None \
+            and dec_plan._mesh is None and pre_plan._mesh is None:
+        devs = jax.devices()
+        need = dec_plan.n_devices + pre_plan.n_devices
+        if len(devs) < need:
+            raise ValueError(
+                f"disaggregation needs {need} devices "
+                f"(tp{dec_plan.n_devices} decode + "
+                f"tp{pre_plan.n_devices} prefill), got {len(devs)}")
+        decode_devices = devs[:dec_plan.n_devices]
+        prefill_devices = devs[dec_plan.n_devices:need]
+    dec_before = dec_plan._device_ids
+    pre_before = pre_plan._device_ids
+    dec_plan.bind(decode_devices)
+    pre_plan.bind(prefill_devices)
+    dec_rebound = dec_plan._device_ids != dec_before
+    pre_rebound = pre_plan._device_ids != pre_before
+
+    chunk_ids = {id(p) for k, p in bundle.serves.items()
+                 if isinstance(k, tuple) and k[0] == "chunked"}
+    dec_names = set(bundle._state_specs)
+    pre_names = set(bundle._state_specs)
+    for prog in bundle.programs():
+        is_chunk = id(prog) in chunk_ids
+        plan, rebound = (pre_plan, pre_rebound) if is_chunk \
+            else (dec_plan, dec_rebound)
+        names = pre_names if is_chunk else dec_names
+        for name, var in prog.global_block.vars.items():
+            if var.persistable:
+                names.add(name)
+        if rebound or sp.plan_of(prog) is not plan:
+            sp.attach_plan(prog, plan)
+
+    if sync_from_decode:
+        for name in sorted(pre_names):
+            if prefill_scope._get(name) is None:
+                val = decode_scope._get(name)
+                if val is not None:
+                    prefill_scope._set(name, np.asarray(val))
+    placed = dec_plan.place_state(decode_scope, sorted(dec_names))
+    placed += pre_plan.place_state(prefill_scope, sorted(pre_names))
     return placed
 
 

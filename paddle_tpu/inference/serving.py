@@ -54,6 +54,7 @@ from __future__ import annotations
 import collections
 import itertools
 import math
+import re
 import threading
 import time
 from concurrent import futures
@@ -62,8 +63,9 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..core.executor import Executor, PreparedCache, TPUPlace
-from ..core.scope import global_scope
+from ..core.scope import Scope, global_scope
 from ..core.types import to_np_dtype
+from ..models.decode_engine import POOL_MARK as dec_POOL_MARK
 from ..models.decode_engine import (BlockLifetimeError,
                                     BlockPoolExhausted, HostBlockPool,
                                     PromptPrefixCache, RadixBlockTree)
@@ -1019,10 +1021,29 @@ class ContinuousGenerationServer:
         # the serve executables compile directly at the placed
         # layout (models/decode_engine.place_sharded_bundle)
         if getattr(bundle, "sharding_plan", None) is not None:
-            from ..models.decode_engine import place_sharded_bundle
+            if getattr(bundle, "prefill_plan", None) is not None:
+                # disaggregated bundle (apply_phase_sharding): TWO
+                # plans over two scopes — bound by
+                # runtime.placement.place_disaggregated_bundle BEFORE
+                # server construction; re-placing here would fold the
+                # chunk programs back under the decode plan
+                if mesh_devices is not None:
+                    raise ValueError(
+                        "mesh_devices does not apply to a "
+                        "disaggregated bundle — bind both slices "
+                        "via place_disaggregated_bundle")
+                if bundle.sharding_plan._mesh is None:
+                    raise ValueError(
+                        "disaggregated bundle is unplaced — run "
+                        "runtime.placement.place_disaggregated_"
+                        "bundle(bundle, decode_scope, prefill_scope) "
+                        "before constructing the server")
+            else:
+                from ..models.decode_engine import \
+                    place_sharded_bundle
 
-            place_sharded_bundle(bundle, self.scope,
-                                 devices=mesh_devices)
+                place_sharded_bundle(bundle, self.scope,
+                                     devices=mesh_devices)
 
         # sampled/speculative bundle knobs (absent on pre-r14 plain
         # bundles): per-request seeds in the admission feeds, tokens
@@ -1073,12 +1094,15 @@ class ContinuousGenerationServer:
         self._serves = {}
         for key, prog in sorted(bundle.serves.items(),
                                 key=lambda kv: str(kv[0])):
+            if self._skip_serve_key(key):
+                continue
             self._serves[key] = self.executor.prepare(
                 prog, feed=bundle.serve_feed_spec(key),
                 fetch_list=self._fetches, scope=self.scope)
         self._admit_buckets = sorted(
             {k for k in self._serves if isinstance(k, int) and k > 0}
-            | {k[1] for k in self._serves if isinstance(k, tuple)})
+            | {k[1] for k in self._serves if isinstance(k, tuple)
+               and k[0] != "chunked"})
         # radix capability: paged non-speculative bundles build
         # ("radix", A) serve programs (teacher-forced resume over a
         # shared block prefix) — the gate for session_id / n_best
@@ -1159,7 +1183,9 @@ class ContinuousGenerationServer:
         with self._cv:
             def dirty():
                 return (self._queue or self._busy
-                        or any(l is not None for l in self._lanes))
+                        or any(l is not None for l in self._lanes)
+                        or self._has_background_work_locked()
+                        or self._has_pending_external_locked())
 
             while self._running and dirty():
                 if deadline is None:
@@ -1180,6 +1206,10 @@ class ContinuousGenerationServer:
             self._queue.clear()
             pending += [r for r in self._lanes if r is not None]
             self._lanes = [None] * self.n_slots
+            bg = self._background_abort_locked()
+            if bg is not None:
+                pending.append(bg)
+            self._flush_requests_locked(pending)
             self._cv.notify_all()
         for r in pending:
             r.reply.set_exception(
@@ -1384,6 +1414,50 @@ class ContinuousGenerationServer:
         """Hook: absorb fetched state (paged per-lane step counters)
         right after a successful dispatch."""
 
+    # --- background work (chunked prefill) ---------------------------
+    # A cycle with no admissions may still carry background device
+    # work fused with the decode burst (paged chunked prefill: one
+    # prompt-chunk phase program per dispatch). The hooks keep the
+    # base loop generic: the wait predicate stays awake while a job
+    # is in flight, the cycle swaps the serve key, and a failed
+    # dispatch aborts the job alongside the lanes.
+    def _has_background_work_locked(self) -> bool:
+        """Hook: True while a background job needs dispatches even
+        with an empty queue and no live lanes. Called under _cv."""
+        return False
+
+    def _has_pending_external_locked(self) -> bool:
+        """Hook: True while requests are in flight OUTSIDE this
+        scheduler (a disaggregated prefill worker) — drain() must
+        wait on them, but the cycle loop must NOT wake for them
+        (their completion callback notifies _cv itself; waking early
+        would busy-spin for the whole external job). Called under
+        _cv."""
+        return False
+
+    def _skip_serve_key(self, key) -> bool:
+        """Hook: True to leave a serve program unprepared (the paged
+        server skips ('chunked', p) keys when an external prefill
+        worker owns their dispatches on its own scope)."""
+        return False
+
+    def _background_feed(self):
+        """Hook: (serve key, extra feeds) for this cycle's background
+        work, or None. Only consulted when the cycle admits nothing
+        (admissions and background work are distinct serve keys)."""
+        return None
+
+    def _background_abort_locked(self):
+        """Hook: a dispatch raised (or the server is closing) — drop
+        the in-flight background job and return its request (failed
+        by the caller) or None. Called under _cv."""
+        return None
+
+    def _flush_requests_locked(self, pending):
+        """Hook: the listed requests are being failed wholesale
+        (close()) — drop any per-request bookkeeping (paged handoff
+        entry refs). Called under _cv."""
+
     def _release_lane(self, slot, req):
         """Hook: a lane stopped serving `req` (retired, errored, or
         failed) — paged scheduling frees its blocks/prompt entry."""
@@ -1399,7 +1473,8 @@ class ContinuousGenerationServer:
             failures = []
             with self._cv:
                 while self._running and not self._queue \
-                        and all(l is None for l in self._lanes):
+                        and all(l is None for l in self._lanes) \
+                        and not self._has_background_work_locked():
                     self._cv.wait()
                 if not self._running:
                     return
@@ -1435,6 +1510,11 @@ class ContinuousGenerationServer:
         if admits:
             key, extra = self._admission_feed(admits)
             feed.update(extra)
+        else:
+            bg = self._background_feed()
+            if bg is not None:
+                key, extra = bg
+                feed.update(extra)
         self._pre_dispatch()
         try:
             c0 = self.executor.compile_count
@@ -1482,6 +1562,9 @@ class ContinuousGenerationServer:
                 for slot, r in lanes:
                     self._release_lane(slot, r)
                 self._lanes = [None] * self.n_slots
+                bg_req = self._background_abort_locked()
+            if bg_req is not None:
+                lanes = lanes + [(None, bg_req)]
             for _slot, r in lanes:
                 r.reply.set_exception(e)
                 if r.trace is not None and r.trace.owner == "server":
@@ -1799,7 +1882,8 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
     grouping owns the admission order).
     """
 
-    def __init__(self, bundle, radix_reuse=True, **kwargs):
+    def __init__(self, bundle, radix_reuse=True, chunked_prefill=None,
+                 prefill_worker=None, **kwargs):
         cache = getattr(bundle, "cache", None)
         if cache is None or cache.layout != "paged":
             raise ValueError(
@@ -1865,7 +1949,68 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         self._entries_hwm = 0
         self._pause_base = 0
         self._preempt_base = 0
+        # chunked-prefill job state (set BEFORE super().__init__ —
+        # the scheduler thread may consult the hooks the moment the
+        # loop starts): ONE prompt prefills at a time, one phase
+        # program per fused dispatch, decode ticks riding in the same
+        # While either way
+        self._chunk_keys = sorted(
+            (k for k in bundle.serves
+             if isinstance(k, tuple) and k[0] == "chunked"),
+            key=lambda kv: kv[1])
+        self._prefill_job = None     # {req, prompt, entry, phase, ci}
+        self._chunk_turn = False     # alternation vs admission cycles
+        self._bg_ticked = False      # this dispatch carried a chunk
+        self._handoff: Dict[int, int] = {}  # id(req) -> entry ref
+        self._chunk_jobs = 0
+        self._chunk_ticks_host = 0
+        self._n_chunks = cache.n_chunks(bundle.seq_len) \
+            if cache.chunked else 0
+        # cross-request radix reuse on PLAIN submits: retired greedy
+        # generations memoized prompt -> history so an identical
+        # sessionless prompt re-admits through the encoder-free radix
+        # tier (teacher-forced replay of its own deterministic output)
+        self._plain_hist: "collections.OrderedDict[tuple, list]" = \
+            collections.OrderedDict()
+        self._plain_hist_cap = 32
+        self._plain_radix_admits = 0
+        # disaggregated prefill (DistServe): cold prompts route to an
+        # external DisaggregatedPrefillWorker (own scope, own device
+        # slice, own thread); finished cross-KV rows come back
+        # through _disagg_inbox, drained on THIS scheduler thread
+        self._prefill_worker = prefill_worker
+        self._disagg_inbox: "collections.deque" = collections.deque()
+        self._disagg_prompts: set = set()
+        self._disagg_out = 0
+        self._disagg_handoffs = 0
+        self._prefill_blocked = False
         super().__init__(bundle, **kwargs)
+        if prefill_worker is not None:
+            if chunked_prefill is False:
+                raise ValueError(
+                    "prefill_worker implies chunked scheduling; "
+                    "chunked_prefill=False contradicts it")
+            if prefill_worker.bundle is not bundle:
+                raise ValueError(
+                    "prefill_worker must serve the SAME bundle (the "
+                    "handoff copies cross-KV rows between scopes by "
+                    "the bundle's state names)")
+            chunked_prefill = True
+        if chunked_prefill is None:
+            chunked_prefill = bool(self._chunk_keys) \
+                and self._spec_k == 0
+        if chunked_prefill and not self._chunk_keys:
+            raise ValueError(
+                "chunked_prefill=True needs a bundle built with "
+                "CacheConfig(chunk_tokens=C) — this bundle carries no "
+                "('chunked', phase) serve programs")
+        if chunked_prefill and self._spec_k > 0:
+            raise ValueError(
+                "chunked prefill does not compose with speculative "
+                "bundles yet (the draft encoder runs whole-prompt at "
+                "admission); build without spec_k or pass "
+                "chunked_prefill=False")
+        self._chunked = bool(chunked_prefill)
 
     # how deep past the queue head the tier-grouped admission scan may
     # look for batch-compatible requests (bounds the O(scan) planning
@@ -1939,9 +2084,181 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
             b = self._blocks.alloc()
         return b
 
+    def _has_background_work_locked(self):
+        return self._prefill_job is not None \
+            or bool(self._disagg_inbox)
+
+    def _has_pending_external_locked(self):
+        return self._disagg_out > 0
+
+    def _skip_serve_key(self, key):
+        return (self._prefill_worker is not None
+                and isinstance(key, tuple) and key[0] == "chunked")
+
+    def _prefill_inflight_locked(self, prompt) -> bool:
+        """True while `prompt`'s cross-KV entry is registered but
+        still FILLING (local chunk job or disaggregated worker):
+        lookup says hit, but admitting against it would read garbage
+        — defer until the handoff re-queues the owning request."""
+        if self._prefill_worker is not None:
+            return prompt in self._disagg_prompts
+        return self._prefill_job is not None \
+            and prompt == self._prefill_job["prompt"]
+
+    def _maybe_start_prefill_locked(self, failures):
+        """Pop the first plain cold prompt in the scan window into
+        the (single) chunked-prefill job: its cross-KV entry is
+        acquired fresh-exclusive NOW, then filled one C-token phase
+        program per fused dispatch while decode ticks keep running —
+        the request itself re-queues as an encoder-free HIT once the
+        final phase lands. With a disaggregated worker the job runs
+        on the WORKER's scope/slice instead (_route_prefills_locked);
+        this scheduler only ever sees the finished handoff."""
+        if self._prefill_worker is not None:
+            self._route_prefills_locked(failures)
+            return
+        if self._prefill_job is not None or not self._queue:
+            return
+        for pos, req in enumerate(self._queue):
+            if pos >= self._ADMIT_SCAN_DEPTH:
+                return
+            if req.session is not None:
+                continue  # session turns keep the monolithic path
+            prompt = tuple(int(x) for x in req.src.reshape(-1))
+            tier, _entry = self._prefix.lookup(prompt)
+            if tier == "hit":
+                continue
+            entry = self._prefix.acquire_fresh(
+                prompt, partial=(tier == "partial"))
+            if entry is None:
+                # every entry pinned: backpressure this cycle (the
+                # flag feeds the idle-pool exhaustion check — with
+                # nothing in flight to unpin one, waiting is a hang)
+                self._prefill_blocked = True
+                return
+            del self._queue[pos]
+            self._prefill_job = {"req": req, "prompt": prompt,
+                                 "entry": entry, "phase": 0, "ci": 0}
+            self._chunk_jobs += 1
+            return
+
+    # --- disaggregated prefill: routing + handoff --------------------
+    def _route_prefills_locked(self, failures):
+        """Ship every plain cold prompt in the scan window to the
+        prefill worker: the cross-KV entry is acquired
+        fresh-exclusive HERE (this server owns the prompt-entry
+        cache), filled on the worker's scope/slice, and handed back
+        through _disagg_inbox. Unlike the local single-job mode the
+        worker pipelines jobs — admission order among handoffs is
+        preserved by the inbox drain."""
+        pos = 0
+        scanned = 0
+        while pos < len(self._queue) \
+                and scanned < self._ADMIT_SCAN_DEPTH:
+            req = self._queue[pos]
+            scanned += 1
+            if req.session is not None:
+                pos += 1
+                continue
+            prompt = tuple(int(x) for x in req.src.reshape(-1))
+            if prompt in self._disagg_prompts:
+                pos += 1
+                continue
+            tier, _entry = self._prefix.lookup(prompt)
+            if tier == "hit":
+                pos += 1
+                continue
+            entry = self._prefix.acquire_fresh(
+                prompt, partial=(tier == "partial"))
+            if entry is None:
+                self._prefill_blocked = True
+                return
+            try:
+                self._prefill_worker.submit_job(
+                    req, prompt, entry, self._disagg_done,
+                    self._disagg_fail)
+            except BaseException as e:
+                self._prefix.release(entry)
+                self._prefix.invalidate(entry)
+                del self._queue[pos]
+                failures.append((req, e))
+                return
+            del self._queue[pos]
+            self._disagg_prompts.add(prompt)
+            self._disagg_out += 1
+            self._chunk_jobs += 1
+            # pos unchanged: the deque shifted left over the del
+
+    def _disagg_done(self, req, prompt, entry, rows):
+        """Worker thread: a prefill job finished — queue the handoff
+        for the scheduler thread (never touch decode scope state from
+        here; the scheduler owns it between dispatches)."""
+        fail = None
+        with self._cv:
+            self._disagg_prompts.discard(prompt)
+            self._disagg_out -= 1
+            if self._closed:
+                self._prefix.release(entry)
+                fail = ServerClosed(
+                    "server closed while its prompt prefilled")
+            else:
+                self._disagg_inbox.append((req, entry, rows))
+            self._cv.notify_all()
+        if fail is not None:
+            req.reply.set_exception(fail)
+            if req.trace is not None and req.trace.owner == "server":
+                req.trace.finish(status="error", error=repr(fail))
+
+    def _disagg_fail(self, req, prompt, entry, exc):
+        """Worker thread: a prefill job died — the entry is
+        part-written; unmap it so the prompt can never hit stale
+        cross-KV, and fail the request."""
+        with self._cv:
+            self._disagg_prompts.discard(prompt)
+            self._disagg_out -= 1
+            self._prefix.release(entry)
+            self._prefix.invalidate(entry)
+            self._cv.notify_all()
+        req.reply.set_exception(exc)
+        if req.trace is not None and req.trace.owner == "server":
+            req.trace.finish(status="error", error=repr(exc))
+
+    def _drain_disagg_inbox_locked(self):
+        """Scheduler thread: land finished prefills. The worker
+        filled the entry's cross-KV under ITS plan on ITS scope; copy
+        the rows into THIS scope's pools (numpy round-trip — the next
+        dispatch's in_shardings re-places them under the decode plan)
+        and re-queue each request at the front with its entry ref
+        held (the handoff) until the hit admission pins its own."""
+        if not self._disagg_inbox:
+            return
+        drained = []
+        while self._disagg_inbox:
+            drained.append(self._disagg_inbox.popleft())
+        for _req, entry, rows in drained:
+            for name, row in rows.items():
+                val = np.array(np.asarray(self.scope._get(name)))
+                val[entry] = row
+                self.scope._set(name, val)
+            self._disagg_handoffs += 1
+        for req, entry, _rows in reversed(drained):
+            self._handoff[id(req)] = entry
+            self._queue.appendleft(req)
+
     def _plan_admissions_locked(self, failures):
         admits = []
         self._admit_tier = None
+        self._prefill_blocked = False
+        if self._prefill_worker is not None:
+            self._drain_disagg_inbox_locked()
+        if self._chunked:
+            self._maybe_start_prefill_locked(failures)
+        if self._prefill_job is not None and self._chunk_turn:
+            # the chunk's cycle: admit nothing so _background_feed
+            # picks the phase program (live lanes' decode burst rides
+            # in the same dispatch either way)
+            self._chunk_turn = False
+            return admits
         if not self._queue:
             return admits
         t_admit = time.monotonic()
@@ -1964,6 +2281,11 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                     or len(admits) >= max_A:
                 break
             prompt = tuple(int(x) for x in req.src.reshape(-1))
+            if self._prefill_inflight_locked(prompt):
+                # the in-flight prefill REGISTERED this prompt
+                # (acquire_fresh), so lookup says hit — but the entry
+                # is still filling; defer until the handoff
+                continue
             tier, _entry = self._prefix.lookup(prompt)
             sess = self._sessions.get(req.session) \
                 if req.session is not None else None
@@ -1975,6 +2297,22 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 flavor = "radix"
             else:
                 flavor = "hit" if tier == "hit" else "miss"
+                if (flavor == "miss" and self._chunked
+                        and req.session is None):
+                    # cold plain prompts go through the chunk-job
+                    # lane, never the stall-everyone monolithic
+                    # prefill; shorts behind them admit this cycle
+                    continue
+                if (flavor == "hit" and req.session is None
+                        and self._radix_ok and self._radix_reuse
+                        and self._spec_k == 0
+                        and not self._needs_seeds
+                        and prompt in self._plain_hist):
+                    # cross-request reuse without a session: an
+                    # identical plain prompt replays its memoized
+                    # deterministic generation teacher-forced over
+                    # whatever chain the radix tree still holds
+                    flavor = "radix"
             if self._admit_tier is None:
                 self._admit_tier = flavor
             if flavor != self._admit_tier:
@@ -2000,7 +2338,15 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                                   "watermark")
                 break
             if flavor == "radix":
-                hist = list(sess["hist"])
+                if sess is not None:
+                    hist = list(sess["hist"])
+                else:
+                    # plain reuse: memoized retired generation (LRU
+                    # touch); tier == "hit" was checked at the flavor
+                    # upgrade, so acquire_hit below cannot miss
+                    hist = list(self._plain_hist[prompt])
+                    self._plain_hist.move_to_end(prompt)
+                    self._plain_radix_admits += 1
                 P = len(hist)
                 # cap the shared prefix at (P-1)//BS full blocks:
                 # resume = h*BS must leave >= 1 tick of history to
@@ -2085,11 +2431,29 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
             taken_ids = {id(r) for r in taken}
             self._queue = collections.deque(
                 r for r in self._queue if id(r) not in taken_ids)
+            for r in taken:
+                e = self._handoff.pop(id(r), None)
+                if e is not None:
+                    # the chunk job held the filled entry resident
+                    # until this admission took its own ref
+                    self._prefix.release(e)
+        if admits and self._prefill_job is not None:
+            self._chunk_turn = True  # next cycle belongs to the chunk
+        if blocked_reason is None and self._prefill_blocked:
+            # the chunk/worker path could not even START a prefill
+            # (every entry pinned); same exhaustion discipline below
+            blocked_reason = "every prompt entry is pinned"
         if blocked_reason and not admits \
+                and self._prefill_job is None \
+                and self._disagg_out == 0 \
+                and not self._disagg_inbox \
                 and all(l is None for l in self._lanes):
             # nothing in flight can ever free a block/entry: fail the
             # head with the NAMED retryable error instead of hanging
             req = self._queue.popleft()
+            e = self._handoff.pop(id(req), None)
+            if e is not None:
+                self._prefix.release(e)
             failures.append((req, BlockPoolExhausted(
                 f"cannot admit prompt: {blocked_reason} with the pool "
                 f"otherwise idle (n_blocks={self._blocks.n_blocks}, "
@@ -2147,6 +2511,77 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 + [0] * (A - len(admits)), np.int64)
         return (tier, A), feed
 
+    # --- chunked prefill: the background job -------------------------
+    def _background_feed(self):
+        job = self._prefill_job
+        if job is None:
+            return None
+        C = self.cache.chunk_tokens
+        key = self._chunk_keys[job["phase"]]
+        feed = {"chunk_entry": np.array([job["entry"]], np.int64),
+                "chunk_pos": np.array([job["ci"] * C], np.int64)}
+        if key[1] == 0:
+            # the embed phase is the only one that sees tokens; the
+            # ragged last chunk zero-pads (its one-hot rows select
+            # nothing past seq_len, so the pad never lands)
+            toks = np.zeros((1, C), np.int64)
+            seg = np.asarray(job["req"].src).reshape(-1)[
+                job["ci"] * C: job["ci"] * C + C]
+            toks[0, :len(seg)] = seg
+            feed["chunk_toks"] = toks
+        self._bg_ticked = True
+        return key, feed
+
+    def _advance_prefill(self):
+        """One chunk phase dispatched successfully: walk the cursor
+        phase-major (every chunk of phase p before phase p+1 — the
+        bidirectional encoder's layer l+1 reads ALL of layer l). On
+        the final phase the entry holds the complete cross-KV: the
+        request re-queues at the FRONT and re-admits encoder-free as
+        a prefix HIT, with the job's entry ref held (the handoff)
+        until that admission pins its own."""
+        with self._cv:
+            self._chunk_ticks_host += 1
+            job = self._prefill_job
+            job["ci"] += 1
+            if job["ci"] < self._n_chunks:
+                return
+            job["ci"] = 0
+            job["phase"] += 1
+            if job["phase"] < len(self._chunk_keys):
+                return
+            req = job["req"]
+            self._handoff[id(req)] = job["entry"]
+            self._prefill_job = None
+            self._chunk_turn = False
+            self._queue.appendleft(req)
+            self._cv.notify_all()
+
+    def _background_abort_locked(self):
+        job = self._prefill_job
+        if job is None:
+            return None
+        self._prefill_job = None
+        self._chunk_turn = False
+        # the entry is PART-written: unmap it so the prompt can never
+        # again be looked up as a hit against stale cross-KV
+        self._prefix.release(job["entry"])
+        self._prefix.invalidate(job["entry"])
+        return job["req"]
+
+    def _flush_requests_locked(self, pending):
+        while self._disagg_inbox:
+            # finished handoffs the scheduler never landed: the
+            # entry content is complete but the server is closing —
+            # drop the job's ref and fail the request with the rest
+            req, entry, _rows = self._disagg_inbox.popleft()
+            self._prefix.release(entry)
+            pending.append(req)
+        for r in pending:
+            e = self._handoff.pop(id(r), None)
+            if e is not None:
+                self._prefix.release(e)
+
     # --- burst planning: coverage, pausing, hard exhaustion ----------
     def _grow_blocks_locked(self, slot, upto_pos):
         need = upto_pos // self._bs + 1
@@ -2186,6 +2621,11 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         n_steps, min_active, run = super()._plan_burst_locked(
             admits, drain, failures)
         if not run:
+            if self._prefill_job is not None:
+                # chunk-only dispatch: the phase body runs in the
+                # pre-While prologue; the decode While exits at once
+                # (no live lanes)
+                return 0, 0, True
             return n_steps, min_active, run
         maxT = self.bundle.max_out_len
         tpt = self._toks_per_tick
@@ -2228,16 +2668,36 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 # hard exhaustion: every live lane sits at a block
                 # boundary with an empty free list (lockstep long
                 # generations do this the moment admission packs
-                # them). PREEMPT the youngest by recompute (the vLLM
-                # discipline): free its blocks so the older lanes
-                # advance, and re-queue the request at the FRONT —
-                # greedy decode is deterministic, so the re-decoded
-                # tokens are byte-identical and only work is lost,
-                # never a request. Each preemption hands >= 1 block
-                # to a strictly older lane, so total outstanding work
-                # decreases and the loop terminates.
+                # them). Radix-aware preemption, two rungs:
+                #
+                # 1. CACHE before WORK — bulk-evict refcount-1 radix
+                #    leaves and re-plan. Per-alloc growth already
+                #    evicts one leaf per miss, so this usually finds
+                #    nothing on the first pass; it fires on LATER
+                #    passes, when a preempted lane's released shared
+                #    refs just turned tree nodes back to refcount 1
+                #    (cheaper to drop that cache than preempt again).
+                if self._radix.evict(len(blocked)):
+                    continue
+                # 2. Preempt the lane that loses the LEAST work:
+                #    deepest shared radix prefix first (its
+                #    re-admission replays from resume = h*BS, so only
+                #    the exclusive tail is recomputed), youngest
+                #    t_admit as the tiebreak (the r13 discipline —
+                #    and the exact old behavior for plain lanes,
+                #    where every shared depth is 0). PREEMPT by
+                #    recompute: free its blocks so the older lanes
+                #    advance, re-queue the request at the FRONT —
+                #    greedy decode is deterministic, so the
+                #    re-decoded tokens are byte-identical and only
+                #    work is lost, never a request. Each preemption
+                #    hands >= 1 block to a surviving lane, so total
+                #    outstanding work decreases and the loop
+                #    terminates.
                 victim = max(blocked,
-                             key=lambda s: self._lanes[s].t_admit or 0)
+                             key=lambda s: (len(self._lane_shared[s]),
+                                            self._lanes[s].t_admit
+                                            or 0))
                 req = self._lanes[victim]
                 if len(live) == 1:
                     # a LONE lane owns every in-use block and still
@@ -2301,11 +2761,18 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
         # tree and retains its history for the next turn
         self._last_tok = np.asarray(outs[0])
         self._harvest_ok = True
+        if self._bg_ticked:
+            self._bg_ticked = False
+            self._advance_prefill()
 
     def _release_lane(self, slot, req):
         sid = self._lane_sess[slot]
         if sid is not None and req.harvest and self._harvest_ok:
             self._harvest_session_locked(slot, sid)
+        elif (sid is None and req.harvest and self._harvest_ok
+                and self._radix_ok and self._radix_reuse
+                and self._spec_k == 0 and not self._needs_seeds):
+            self._harvest_plain_locked(slot, req)
         self._free_lane_locked(slot)
 
     def _harvest_session_locked(self, slot, sid):
@@ -2342,6 +2809,34 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
             sess["entry"] = self._lane_entry[slot]
             self._lane_entry[slot] = None
 
+    def _harvest_plain_locked(self, slot, req):
+        """Sessionless analogue of the session harvest: a retired
+        plain GREEDY generation's full blocks join the radix tree
+        keyed by its prompt, and the history is memoized (bounded
+        LRU) so an identical later submit re-admits through the
+        encoder-free radix tier — teacher-forced replay of its own
+        deterministic output, byte-identical by construction. The
+        entry ref is NOT transferred (no session pins it); the entry
+        stays cached LRU in the prefix cache like any retired miss."""
+        row = np.asarray(self._last_tok[slot]).reshape(-1)
+        if self._end_id is None:
+            e = row.shape[0] - 1
+        else:
+            hit = row[1:] == self._end_id
+            e = int(hit.argmax()) + 1 if hit.any() \
+                else row.shape[0] - 1
+        hist = [int(t) for t in row[:e]]
+        prompt = tuple(int(x) for x in req.src.reshape(-1))
+        f = e // self._bs
+        if f:
+            chain = (list(self._lane_shared[slot])
+                     + list(self._lane_blocks[slot]))
+            self._radix.insert(prompt, hist, chain[:f])
+        self._plain_hist.pop(prompt, None)
+        self._plain_hist[prompt] = hist
+        while len(self._plain_hist) > self._plain_hist_cap:
+            self._plain_hist.popitem(last=False)
+
     # --- observability ------------------------------------------------
     def pool_stats(self) -> dict:
         """Block-pool + prefix-cache counters (also exposed as the
@@ -2376,7 +2871,16 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
             "radix_adoptions": self._radix.adoptions,
             "radix_evicted_blocks": self._radix.evicted_blocks,
             "radix_admissions": self._radix_admits,
+            "plain_radix_admissions": self._plain_radix_admits,
             "sessions_open": len(self._sessions),
+            # chunked prefill (host view; device tel_chunks agrees)
+            "chunked_prefill": self._chunked,
+            "chunk_jobs": self._chunk_jobs,
+            "chunk_ticks": self._chunk_ticks_host,
+            # disaggregated prefill (DistServe-style phase split)
+            "disaggregated": self._prefill_worker is not None,
+            "disagg_outstanding": self._disagg_out,
+            "disagg_handoffs": self._disagg_handoffs,
         }
 
     def _host_tel_locked(self, reset: bool) -> dict:
@@ -2447,6 +2951,216 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
              self._hit_depth),
         ]
         return samples
+
+
+class DisaggregatedPrefillWorker:
+    """The PREFILL half of disaggregated serving (DistServe, Zhong
+    et al. OSDI'24 — PAPERS.md): a dedicated dispatcher for the
+    bundle's ``("chunked", p)`` phase programs on its OWN scope —
+    and, via ``models.decode_engine.apply_phase_sharding`` +
+    ``runtime.placement.place_disaggregated_bundle``, its own device
+    slice under its own ShardingPlan (MXU-bound: tp over the encoder
+    projections) while the decode server's plan shards KV bytes.
+
+    The decode server owns the host allocators (HostBlockPool /
+    PromptPrefixCache): it acquires the cross-KV entry and routes
+    cold prompts here (``prefill_worker=``); this worker runs every
+    chunk phase back-to-back with ``n_steps=0`` (each phase program
+    embeds the decode While, which exits immediately — the slot
+    state in this scope is dead weight XLA never reads), then reads
+    the finished entry's cross-KV rows off its scope and hands them
+    to the completion callback. The decode scheduler lands the rows
+    in ITS scope and re-admits the request encoder-free.
+
+    Construction order: build the bundle chunked; for the sharded
+    mode run ``apply_phase_sharding``, train/load params +
+    ``init_slot_state`` into the decode scope, then
+    ``place_disaggregated_bundle(bundle, decode_scope,
+    prefill_scope)`` (binds both plans, syncs params across), THEN
+    this worker, THEN the server with ``prefill_worker=``. The
+    unsharded two-scope mode skips the plans and passes
+    ``params_from=decode_scope`` here instead.
+
+    Reference counterpart: reference
+    inference/api/analysis_predictor.cc:832 — a second predictor
+    process specialized to one phase of the request; here it is a
+    thread over a second scope with phase-specialized programs."""
+
+    def __init__(self, bundle, executor=None, scope=None,
+                 params_from=None, start: bool = True):
+        from ..models.decode_engine import _state_prefix_of
+
+        cache = getattr(bundle, "cache", None)
+        if cache is None or cache.layout != "paged" \
+                or not cache.chunked:
+            raise ValueError(
+                "DisaggregatedPrefillWorker needs a paged bundle "
+                "built with CacheConfig(chunk_tokens=C) — the phase "
+                "split IS the chunk-program set")
+        self.bundle = bundle
+        self.executor = executor or Executor(TPUPlace(0))
+        self.scope = scope or Scope()
+        if params_from is not None:
+            for name in list(params_from._vars):
+                if self.scope._get(name) is None:
+                    val = params_from._get(name)
+                    if val is not None:
+                        self.scope._set(name,
+                                        np.array(np.asarray(val)))
+        bundle.init_slot_state(self.scope)
+        self._chunk_keys = sorted(
+            (k for k in bundle.serves
+             if isinstance(k, tuple) and k[0] == "chunked"),
+            key=lambda kv: kv[1])
+        self._n_chunks = cache.n_chunks(bundle.seq_len)
+        prefix = _state_prefix_of(bundle)
+        pat = re.compile(
+            re.escape(prefix) + r"cross_[kv]\d+"
+            + re.escape(dec_POOL_MARK))
+        self._cross_names = sorted(
+            n for n in bundle._state_specs if pat.fullmatch(n))
+        before = self.executor.compile_count
+        fetches = [bundle.state["step"]]
+        self._serves = {
+            k: self.executor.prepare(
+                bundle.serves[k], feed=bundle.serve_feed_spec(k),
+                fetch_list=fetches, scope=self.scope)
+            for k in self._chunk_keys}
+        self._warmed_compiles = self.executor.compile_count - before
+        self._cv = threading.Condition()
+        self._jobs: "collections.deque" = collections.deque()
+        self._running = False
+        self._closed = False
+        self._busy = False
+        self._jobs_done = 0
+        self._jobs_failed = 0
+        self._ticks = 0
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # --- lifecycle ---------------------------------------------------
+    def start(self):
+        with self._cv:
+            if self._running:
+                return
+            if self._closed:
+                raise ServerClosed(
+                    "DisaggregatedPrefillWorker closed")
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle-tpu-prefill-worker",
+                daemon=True)
+            self._thread.start()
+
+    def drain(self, timeout: Optional[float] = 60.0) -> bool:
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        with self._cv:
+            while self._running and (self._jobs or self._busy):
+                if deadline is None:
+                    self._cv.wait()
+                    continue
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cv.wait(left)
+            return not (self._jobs or self._busy)
+
+    def close(self, timeout: float = 5.0):
+        with self._cv:
+            self._running = False
+            self._closed = True
+            dropped = list(self._jobs)
+            self._jobs.clear()
+            self._cv.notify_all()
+        for req, prompt, entry, _done, fail in dropped:
+            fail(req, prompt, entry, ServerClosed(
+                "DisaggregatedPrefillWorker closed"))
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --- the job surface the decode server routes to -----------------
+    def submit_job(self, req, prompt, entry, on_done, on_fail):
+        """Queue one prefill job. ``on_done(req, prompt, entry,
+        rows)`` / ``on_fail(req, prompt, entry, exc)`` fire on the
+        WORKER thread (never under this worker's lock) — ``rows``
+        maps each cross-pool state name to the entry's finished
+        [H, S, Dh] row, copied off this scope."""
+        with self._cv:
+            if not self._running or self._closed:
+                raise ServerClosed(
+                    "DisaggregatedPrefillWorker closed")
+            self._jobs.append((req, prompt, entry, on_done, on_fail))
+            self._cv.notify_all()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while self._running and not self._jobs:
+                    self._cv.wait()
+                if not self._running:
+                    return
+                job = self._jobs.popleft()
+                self._busy = True
+            req, prompt, entry, on_done, on_fail = job
+            try:
+                rows = self._run_job(req, entry)
+            except BaseException as e:
+                with self._cv:
+                    self._busy = False
+                    self._jobs_failed += 1
+                    self._cv.notify_all()
+                on_fail(req, prompt, entry, e)
+            else:
+                with self._cv:
+                    self._busy = False
+                    self._jobs_done += 1
+                    self._cv.notify_all()
+                on_done(req, prompt, entry, rows)
+
+    def _run_job(self, req, entry):
+        """Phase-major chunk walk (every chunk of phase p before
+        phase p+1 — the bidirectional encoder's layer l+1 reads ALL
+        of layer l), one dispatch per (phase, chunk); identical
+        cursor order to the decode server's local chunk-job mode, so
+        the entry content is bit-identical to it."""
+        C = self.bundle.cache.chunk_tokens
+        src = np.asarray(req.src).reshape(-1)
+        for key in self._chunk_keys:
+            for ci in range(self._n_chunks):
+                feed = {"n_steps": np.array([0], np.int64),
+                        "min_active": np.array([0], np.int64),
+                        "chunk_entry": np.array([entry], np.int64),
+                        "chunk_pos": np.array([ci * C], np.int64)}
+                if key[1] == 0:
+                    toks = np.zeros((1, C), np.int64)
+                    seg = src[ci * C: ci * C + C]
+                    toks[0, :len(seg)] = seg
+                    feed["chunk_toks"] = toks
+                self._serves[key].run(feed, return_numpy=False)
+                with self._cv:
+                    self._ticks += 1
+        return {name:
+                np.array(np.asarray(self.scope._get(name))[entry])
+                for name in self._cross_names}
+
+    def stats(self, reset: bool = False) -> dict:
+        with self._cv:
+            return {
+                "jobs_done": self._jobs_done,
+                "jobs_failed": self._jobs_failed,
+                "jobs_queued": len(self._jobs),
+                "chunk_ticks": self._ticks,
+                "warmed_compiles": self._warmed_compiles,
+            }
 
 
 class PagedBeamDecoder:

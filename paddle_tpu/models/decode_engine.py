@@ -159,6 +159,9 @@ class CacheConfig:
     block_size: int = 8            # positions per self-KV block
     n_blocks: int = 0              # shared self-KV pool blocks
     n_prompt_entries: int = 0      # shared cross-KV prompt entries
+    chunk_tokens: int = 0          # >0: build ("chunked", p) prefill
+    #                                phase programs processing this
+    #                                many prompt tokens per tick
 
     def validate(self, max_out_len: int):
         if self.layout not in ("dense", "paged"):
@@ -175,6 +178,34 @@ class CacheConfig:
                     f"max_out_len={max_out_len} (token-exact parity "
                     f"needs the paged cache view to cover exactly the "
                     f"dense [maxT] positions)")
+        if self.chunk_tokens < 0:
+            raise ValueError(
+                f"chunk_tokens must be >= 0, got {self.chunk_tokens}")
+        if self.chunk_tokens and self.layout != "paged":
+            raise ValueError(
+                "chunked prefill needs the paged layout (chunks land "
+                "in the shared prompt-entry pool)")
+        if self.chunk_tokens == 1:
+            raise ValueError(
+                "chunk_tokens == 1 is rejected: a single-query "
+                "attention chunk lowers to a different XLA "
+                "contraction whose accumulation order drifts ~1e-7 "
+                "from the monolithic encoder, breaking the bit-exact "
+                "chunked==monolithic parity contract (any C >= 2 is "
+                "exact — the ragged last chunk keeps width C by "
+                "zero-padding, so no dispatch ever sees a "
+                "single-query shape)")
+
+    @property
+    def chunked(self) -> bool:
+        return self.layout == "paged" and self.chunk_tokens > 0
+
+    def n_chunks(self, seq_len: int) -> int:
+        """Ticks needed to stream one seq_len prompt through at
+        chunk_tokens per tick (ceil division; the last chunk may be
+        ragged — phase bodies mask past-the-end positions)."""
+        c = self.chunk_tokens
+        return (seq_len + c - 1) // c if c else 0
 
     def pages(self, max_out_len: int) -> int:
         return max_out_len // self.block_size
@@ -186,8 +217,14 @@ class CacheConfig:
         dedupe as 'same fingerprint' (inference/runtime/registry.py)."""
         if self.layout == "dense":
             return ("dense",)
-        return ("paged", self.block_size, self.n_blocks,
-                self.n_prompt_entries)
+        tok = ("paged", self.block_size, self.n_blocks,
+               self.n_prompt_entries)
+        # append-only so historical paged tokens stay byte-identical:
+        # a chunked and an unchunked build of one geometry carry
+        # different program sets and must never dedupe
+        if self.chunk_tokens:
+            tok = tok + ("chunk", self.chunk_tokens)
+        return tok
 
 
 # ---------------------------------------------------------------------------
@@ -825,7 +862,11 @@ class DecodeStepBundle:
       count drops to ``min_active`` (both fed as [1] int64). Keys are
       admission buckets (ints) for dense bundles and ``("hit"|"miss",
       A)`` tuples (plus 0) for paged ones; ``serve_feed_spec(key)``
-      names each program's feed signature.
+      names each program's feed signature. Chunked-prefill bundles
+      (``cache.chunk_tokens > 0``) additionally carry ``("chunked",
+      p)`` programs — phase p of the incremental encoder over ONE
+      prompt chunk, fused with the same decode While so live lanes
+      keep ticking while the chunk computes (the two-tier schedule).
 
     ``state`` maps logical names ('tok_buf', 'step', 'finished',
     'active', and for paged 'block_tab'/'prompt_ref') to the scope
@@ -884,6 +925,20 @@ class DecodeStepBundle:
         return self.draft.k if self.draft is not None else 0
 
     @property
+    def chunk_phase_keys(self):
+        """The ("chunked", p) serve keys in phase order (empty on
+        non-chunked bundles). The host drives ONE prompt through
+        them phase-major: run phase p at EVERY chunk cursor before
+        advancing to phase p+1 — attention phases read the full
+        staged K/V of their layer, so a later phase may not start
+        until the earlier one covered the whole prompt (the
+        scheduler's chunk-job state machine walks exactly this
+        order; total ticks = n_chunks * len(chunk_phase_keys))."""
+        return sorted((k for k in self.serves
+                       if isinstance(k, tuple) and k[0] == "chunked"),
+                      key=lambda kv: kv[1])
+
+    @property
     def tokens_per_tick(self) -> int:
         """Max tokens ONE device tick can emit per lane — the paged
         scheduler sizes block coverage by this (k accepted proposals
@@ -933,6 +988,16 @@ class DecodeStepBundle:
                    ("slots", (A,), "int64")]
             if self.needs_seeds:
                 pre.append(("seeds", (A,), "int64"))
+            return pre + feed
+        if tier == "chunked":
+            # A is the PHASE index p here (0 = embed, 1+2l = layer
+            # l's kv projection, 2+2l = layer l's attn+ffn, 2L+1 =
+            # final cross-projection install)
+            pre = [("chunk_entry", (1,), "int64"),
+                   ("chunk_pos", (1,), "int64")]
+            if A == 0:
+                pre.append(("chunk_toks",
+                            (1, self.cache.chunk_tokens), "int64"))
             return pre + feed
         pre = []
         if tier == "miss" or self.spec_k > 0:
@@ -1026,7 +1091,8 @@ def _slot_state_specs(prefix, rows, maxT, seq_len, n_heads,
     # occupancy integral, burst exit reasons, admission-tier counts.
     # The @TEL name mark puts them under checker PTA180's contract.
     specs.update(devtel.counter_specs(prefix,
-                                      cache.layout == "paged"))
+                                      cache.layout == "paged",
+                                      chunked=cache.chunked))
     if cache.layout == "dense":
         for li in range(n_layers):
             specs[f"{prefix}self_k{li}"] = (
@@ -1050,6 +1116,25 @@ def _slot_state_specs(prefix, rows, maxT, seq_len, n_heads,
     # untouched by construction. This is what lets a radix admission
     # chunk-prefill ONLY the divergent tail of a resumed chat turn.
     specs[f"{prefix}prefill_until"] = ((rows,), "int64")
+    if cache.chunked:
+        # chunked-prefill staging: per-PROMPT-ENTRY activation rows
+        # the phase programs hand forward between ticks. The encoder
+        # is bidirectional (layer l+1 needs ALL of layer l), so a
+        # resumable prefill must stage whole-prompt activations —
+        # indexed by prompt-entry id like the cross pools (+1
+        # dustbin), NOT by lane: the entry is host-exclusive for the
+        # whole prefill, and the staging row is dead once the final
+        # phase installs the cross-KV. a/b ping-pong across layers;
+        # kv holds the concat(K,V) self-attn projection of the layer
+        # being chunked (attention needs K/V at ALL positions before
+        # any query chunk can run — that is the phase split).
+        d_model = n_heads * head_dim
+        specs[f"{prefix}chunk_stage_a{POOL_MARK}"] = (
+            (E + 1, seq_len, d_model), "float32")
+        specs[f"{prefix}chunk_stage_b{POOL_MARK}"] = (
+            (E + 1, seq_len, d_model), "float32")
+        specs[f"{prefix}chunk_stage_kv{POOL_MARK}"] = (
+            (E + 1, seq_len, 2 * d_model), "float32")
     if vocab is not None and (draft is None or draft.k == 0):
         # the beam/probe front's full next-token distribution, one
         # softmax row per lane, refreshed by the probe step program —
@@ -1171,6 +1256,99 @@ def _state_prefix_of(bundle) -> str:
     everything up to and including the last '/')."""
     name = bundle.state["tok_buf"]
     return name[:len(name) - len("tok_buf")]
+
+
+def enc_param_placements(n_layers: int, sharding: "ShardingConfig",
+                         prefix: str = "") -> Dict[str, dict]:
+    """{param name -> {dim: axis}} for the ENCODER-side (prefill
+    phase) stack: column/row-parallel ffn and row-parallel attention
+    out-projections per encoder layer — prefill is MXU-bound, so the
+    tp win is in the projection matmuls, where decode's plan
+    (tp_param_placements) spends its placements on the KV bytes
+    instead. The fused ``enc{l}_self_qkv.w`` and the cross-KV install
+    ``dec{li}_cross_kv.w`` stay replicated for the same
+    fused-axis-crosses-shards reason as the decoder's (ShardingConfig
+    docstring)."""
+    ax = sharding.axis
+    out: Dict[str, dict] = {}
+    for li in range(n_layers):
+        out[f"{prefix}enc{li}_self_out.w"] = {0: ax}
+        out[f"{prefix}enc{li}_fc1.w"] = {1: ax}
+        out[f"{prefix}enc{li}_fc2.w"] = {0: ax}
+    return out
+
+
+def _prefill_state_placements(state_prefix, n_layers, cache, sharding
+                              ) -> Dict[str, dict]:
+    """Prefill-phase slot-state placements: the cross pools it WRITES
+    sharded along heads (dim 1 of ``[E+1, H, S, Dh]`` — the same
+    tensor layout the decode plan reads, so the handoff is a
+    device_put, not a re-layout) plus the chunk staging pools along
+    d_model (the heads-concat axis)."""
+    ax = sharding.axis
+    out: Dict[str, dict] = {}
+    for li in range(n_layers):
+        out[f"{state_prefix}cross_k{li}{POOL_MARK}"] = {1: ax}
+        out[f"{state_prefix}cross_v{li}{POOL_MARK}"] = {1: ax}
+    if cache.chunked:
+        out[f"{state_prefix}chunk_stage_a{POOL_MARK}"] = {2: ax}
+        out[f"{state_prefix}chunk_stage_b{POOL_MARK}"] = {2: ax}
+        out[f"{state_prefix}chunk_stage_kv{POOL_MARK}"] = {2: ax}
+    return out
+
+
+def apply_phase_sharding(bundle: "DecodeStepBundle",
+                         prefill_sharding: "ShardingConfig",
+                         decode_sharding: "ShardingConfig",
+                         n_layers: int):
+    """Disaggregated prefill/decode sharding (DistServe, Zhong et al.
+    OSDI'24 — PAPERS.md): the bundle's ``("chunked", p)`` phase
+    programs get the PREFILL plan (MXU-bound: tp over the encoder
+    projections, ``enc_param_placements``) while every other program
+    gets the DECODE plan (bandwidth-bound: tp over KV bytes,
+    ``tp_param_placements``) — two ``ShardingPlan``s whose tokens
+    differ by placements AND, once bound to disjoint slices, by
+    device ids, so no executable, disk-cache entry, or
+    server_fingerprint can ever dedup across phases.
+
+    Returns ``(prefill_plan, decode_plan)``. The decode plan is also
+    attached as ``bundle.sharding_plan`` (what the serving layer's
+    placement step binds); the prefill plan rides as
+    ``bundle.prefill_plan`` and binds at
+    ``runtime.placement.place_disaggregated_bundle``."""
+    if not bundle.cache.chunked:
+        raise ValueError(
+            "apply_phase_sharding needs a chunked-prefill bundle "
+            "(CacheConfig(chunk_tokens=C)) — without ('chunked', p) "
+            "programs there is no prefill phase to carve out")
+    prefix = _state_prefix_of(bundle)
+    dec_placements = dict(tp_param_placements(n_layers,
+                                              decode_sharding))
+    dec_placements.update(_tp_state_placements(
+        prefix, n_layers, bundle.cache, decode_sharding))
+    pre_placements = dict(enc_param_placements(n_layers,
+                                               prefill_sharding))
+    pre_placements.update(_prefill_state_placements(
+        prefix, n_layers, bundle.cache, prefill_sharding))
+    dec_axes = ((decode_sharding.axis, decode_sharding.tp),)
+    pre_axes = ((prefill_sharding.axis, prefill_sharding.tp),)
+    dec_plan = None
+    pre_plan = None
+    chunk_progs = {id(p) for k, p in bundle.serves.items()
+                   if isinstance(k, tuple) and k[0] == "chunked"}
+    for prog in bundle.programs():
+        if id(prog) in chunk_progs:
+            pre_plan = annotate_sharded_program(
+                prog, pre_placements, pre_axes, plan=pre_plan)
+        else:
+            dec_plan = annotate_sharded_program(
+                prog, dec_placements, dec_axes, plan=dec_plan)
+    pre_plan.label = "prefill"
+    dec_plan.label = "decode"
+    bundle.sharding = decode_sharding
+    bundle.sharding_plan = dec_plan
+    bundle.prefill_plan = pre_plan
+    return pre_plan, dec_plan
 
 
 def place_sharded_bundle(bundle: "DecodeStepBundle", scope,
@@ -2217,12 +2395,17 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
     # One specialization per admission flavor x bucket (0: no
     # admission). ---------------------------------------------------
     def _build_serve(tier, A):
+        def pre(sv):
+            if A > 0:
+                admit_bodies[tier](sv, A)
+        return _serve_program(pre)
+
+    def _serve_program(pre_body):
         prog = fluid.Program()
         with fluid.program_guard(prog, fluid.Program()):
             sv = _mark_ownership(
                 _declare_slot_state(prog.global_block, specs))
-            if A > 0:
-                admit_bodies[tier](sv, A)
+            pre_body(sv)
             n_steps = layers.data("n_steps", shape=[1], dtype="int64",
                                   append_batch_size=False)
             min_active = layers.data("min_active", shape=[1],
@@ -2290,6 +2473,176 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                          layers.elementwise_sub(one, idle)))
         return prog
 
+    # --- chunked-prefill phase bodies (cache.chunk_tokens > 0): the
+    # miss admission's encoder, re-cut into resumable C-token ticks.
+    # The encoder is BIDIRECTIONAL — layer l+1 needs layer l at ALL
+    # prompt positions — so "C tokens per tick" must be phase-major:
+    # phase p runs over every chunk cursor before phase p+1 starts.
+    # Phases: 0 = embed+positional into stage_a; 1+2l = layer l's
+    # fused qkv projection of a chunk into stage_kv (per-position —
+    # chunkable); 2+2l = layer l's attention (C queries over the FULL
+    # staged K/V) + add_norm + ffn + add_norm into the other stage;
+    # 2L+1 = the per-layer cross-KV projection of a chunk, installed
+    # into the prompt entry's cross pools. Every op is per-position
+    # outside `layers.attention`, and the attention phase reads
+    # complete staged K/V, so the chunked pipeline is BIT-EXACT vs
+    # the monolithic _admit_body_paged_miss encoder (asserted in
+    # tests) — which is what lets a chunk-prefilled entry finish as
+    # an ordinary prefix HIT. Ragged last chunks need no extra
+    # masking: an out-of-range cursor row of the chunk-selection
+    # one-hot is all-zero, so its scatter contributes nothing and
+    # `keep` preserves the row.
+    def _chunk_phase_body(sv, p):
+        C, S, L = cache.chunk_tokens, seq_len, n_layers
+        entry = layers.data("chunk_entry", shape=[1], dtype="int64",
+                            append_batch_size=False)
+        pos0 = layers.data("chunk_pos", shape=[1], dtype="int64",
+                           append_batch_size=False)
+        # mint-site ownership marks: the entry is a host-FRESH
+        # prompt-pool index (refcount==1 for the whole prefill — the
+        # same allocator invariant the monolithic miss admission's
+        # prompt_slots ride), and the chunk cursor is a host-bounded
+        # position index (< seq_len) — marking it keeps the
+        # PTA190 provenance chain closed over the staging writes
+        # instead of silently downgrading the prover
+        absint.mark_pool_index_source(entry, "host_indices",
+                                      bound=E + 1)
+        absint.mark_pool_index_source(pos0, "chunk_cursor", bound=S)
+        # devtel: one chunk ticked; how many decode lanes were live
+        # while it did (the prefill-vs-decode occupancy split)
+        _tel_add(sv, "tel_chunks",
+                 layers.fill_constant([1], "int64", 1.0))
+        _tel_add(sv, "tel_prefill_occupancy",
+                 layers.reduce_sum(sv[f"{state_prefix}active"],
+                                   keep_dim=True))
+        # [C, S] chunk-position one-hot: row c selects prompt
+        # position pos0+c (all-zero past seq_len — ragged tail)
+        sr = layers.cast(layers.range(0, S, 1), "int64")
+        cr = layers.cast(layers.range(0, C, 1), "int64")
+        csel = layers.cast(
+            layers.equal(sr, layers.elementwise_add(
+                layers.reshape(cr, [C, 1]), pos0)), "float32")
+        cselT = layers.transpose(csel, perm=[1, 0])       # [S, C]
+        keep = layers.reshape(
+            layers.elementwise_sub(
+                layers.fill_constant([S], "float32", 1.0),
+                layers.reduce_sum(csel, dim=0)), [S, 1])
+        stage = [sv[f"{state_prefix}chunk_stage_a{POOL_MARK}"],
+                 sv[f"{state_prefix}chunk_stage_b{POOL_MARK}"]]
+        stage_kv = sv[f"{state_prefix}chunk_stage_kv{POOL_MARK}"]
+
+        def _stage_row(pool, width):                      # [S, width]
+            return layers.reshape(layers.gather(pool, entry),
+                                  [S, width])
+
+        def _chunk_of(row, width):                     # [1, C, width]
+            return layers.reshape(layers.matmul(csel, row),
+                                  [1, C, width])
+
+        def _stage_merge(pool, row, chunk2d):
+            # RMW the entry row: this tick's C positions replaced,
+            # every other position kept — the one-hot matmul scatter
+            # is exact (single nonzero per column)
+            merged = layers.elementwise_add(
+                layers.elementwise_mul(row, keep),
+                layers.matmul(cselT, chunk2d))
+            layers.masked_pool_write(
+                pool, layers.unsqueeze(merged, [0]), entry,
+                leading_dims=1, exclusive_via="host_indices")
+
+        if p == 0:
+            # embed the chunk's tokens + positional encoding (the
+            # _embed math at chunk offsets; garbage pad tokens of a
+            # ragged tail embed then scatter to nothing)
+            toks = layers.data("chunk_toks", shape=[1, C],
+                               dtype="int64",
+                               append_batch_size=False)
+            emb = layers.embedding(toks, size=[vocab, d_model],
+                                   param_attr=ParamAttr(
+                                       name="src_word_emb"))
+            # C == 1 hits lookup_table's trailing-1 id-axis squeeze
+            # ([1,1] ids give [1,D]) — restore the [1,C,D] rank
+            emb = layers.reshape(emb, [1, C, d_model])
+            emb = layers.scale(emb, scale=d_model ** 0.5)
+            pos_tab = layers.assign(
+                T._position_encoding(max(S, maxT), d_model)[:S])
+            x = layers.elementwise_add(
+                emb, layers.matmul(csel, pos_tab), axis=1)
+            _stage_merge(stage[0], _stage_row(stage[0], d_model),
+                         layers.reshape(x, [C, d_model]))
+            return
+        if p <= 2 * L:
+            l = (p - 1) // 2
+            xrow = _stage_row(stage[l % 2], d_model)
+            x = _chunk_of(xrow, d_model)
+            # same fused-qkv param as encoder_layer's self-attention
+            qkv = layers.fc(x, 3 * d_model, num_flatten_dims=2,
+                            bias_attr=False,
+                            param_attr=T._attn_proj_attr(
+                                f"enc{l}_self", "qkv", d_model))
+            q, k, v = layers.split(qkv, 3, dim=2)
+            if (p - 1) % 2 == 0:
+                # kv phase: stage this chunk's K/V columns (fc is
+                # per-position — chunkable; q recomputes next phase)
+                _stage_merge(
+                    stage_kv, _stage_row(stage_kv, 2 * d_model),
+                    layers.reshape(layers.concat([k, v], axis=2),
+                                   [C, 2 * d_model]))
+                return
+            # attention phase: C queries over the layer's FULL
+            # staged K/V, then the per-position encoder tail
+            kvrow = _stage_row(stage_kv, 2 * d_model)     # [S, 2D]
+            kf, vf = layers.split(kvrow, 2, dim=1)
+            q4 = layers.reshape(q, [0, 0, n_heads, head_dim])
+            k4 = layers.reshape(kf, [1, S, n_heads, head_dim])
+            v4 = layers.reshape(vf, [1, S, n_heads, head_dim])
+            ctx = layers.attention(q4, k4, v4, causal=False,
+                                   scale=head_dim ** -0.5,
+                                   dropout_rate=0.0, layout="bthd")
+            ctx = layers.reshape(ctx, [0, 0, d_model])
+            attn = layers.fc(ctx, d_model, num_flatten_dims=2,
+                             bias_attr=False,
+                             param_attr=f"enc{l}_self_out.w")
+            x1 = T._add_norm(attn, x, 0.0, True, name=f"enc{l}_a")
+            ffn = T._ffn(x1, d_model, d_inner, 0.0, True,
+                         name=f"enc{l}")
+            x2 = T._add_norm(ffn, x1, 0.0, True, name=f"enc{l}_b")
+            out_pool = stage[(l + 1) % 2]
+            _stage_merge(out_pool, _stage_row(out_pool, d_model),
+                         layers.reshape(x2, [C, d_model]))
+            return
+        # final phase: project the chunk's cross-attention K/V for
+        # every decoder layer and install it into the prompt entry's
+        # cross pools — the entry layout is heads_of's [H, S, Dh], so
+        # the positional merge happens in a [S, H*Dh] view
+        xrow = _stage_row(stage[L % 2], d_model)
+        x = _chunk_of(xrow, d_model)
+        for li in range(n_layers):
+            kvp = layers.fc(x, 2 * d_model, num_flatten_dims=2,
+                            bias_attr=False,
+                            param_attr=T._attn_proj_attr(
+                                f"dec{li}_cross", "kv", d_model))
+            k, v = layers.split(kvp, 2, dim=2)
+            for tag, val in (("k", k), ("v", v)):
+                pool = sv[f"{state_prefix}cross_{tag}{li}"
+                          f"{POOL_MARK}"]
+                row = layers.reshape(
+                    layers.transpose(layers.gather(pool, entry),
+                                     perm=[0, 2, 1, 3]),
+                    [S, d_model])
+                merged = layers.elementwise_add(
+                    layers.elementwise_mul(row, keep),
+                    layers.matmul(cselT,
+                                  layers.reshape(val, [C, d_model])))
+                layers.masked_pool_write(
+                    pool,
+                    layers.transpose(
+                        layers.reshape(merged,
+                                       [1, S, n_heads, head_dim]),
+                        perm=[0, 2, 1, 3]),
+                    entry, leading_dims=1,
+                    exclusive_via="host_indices")
+
     serves = {0: _build_serve("miss", 0)}
     for A in admit_buckets:
         if paged:
@@ -2299,6 +2652,15 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                 serves[("radix", A)] = _build_serve("radix", A)
         else:
             serves[A] = _build_serve("miss", A)
+    if paged and cache.chunked:
+        # one serve program per phase, each fused with the SAME
+        # decode While as key 0 — a chunk dispatch IS a decode burst
+        # with a chunk bolted on the front, so live lanes keep
+        # ticking while the chunk computes (the two-tier schedule);
+        # executable count grows by exactly 2*n_layers+2 programs
+        for p in range(2 * n_layers + 2):
+            serves[("chunked", p)] = _serve_program(
+                lambda sv, _p=p: _chunk_phase_body(sv, _p))
 
     # --- COW block copy (paged only): gather the SHARED source rows
     # and masked-write them into freshly allocated EXCLUSIVE blocks —
@@ -2778,6 +3140,30 @@ class PromptPrefixCache:
                 f"double release would unpin an entry another lane "
                 f"still attends to")
         self._refs[entry] = refs - 1
+
+    def invalidate(self, entry: int):
+        """Forget an UNPINNED entry's prompt mapping and return the
+        slot to the free list — for an ABANDONED part-written prefill
+        (a chunked-prefill job whose dispatch failed mid-fill): the
+        prompt must never again be looked up as a hit against stale
+        cross-KV. Raises while any lane still references the entry
+        (typestate: only a free entry may be forgotten)."""
+        if self._refs.get(entry, 0) > 0:
+            raise BlockLifetimeError(
+                f"invalidate of prompt entry {entry} at refcount "
+                f"{self._refs[entry]}: a referenced entry is still "
+                f"attended to — release every ref first")
+        prompt = self._entry_prompt.pop(entry, None)
+        if prompt is None:
+            return
+        del self._by_prompt[prompt]
+        self._lru.pop(prompt, None)
+        head = self._head(prompt)
+        self._heads[head] -= 1
+        if not self._heads[head]:
+            del self._heads[head]
+        self._refs.pop(entry, None)
+        self._free.append(entry)
 
     # --- the refcount typestate surface (the COW contract PTA192
     # checks the device half of): free -> exclusive (refcount==1) ->

@@ -85,6 +85,8 @@ class CounterSpec:
     stat: str               # key inside stats()["device_telemetry"]
     doc: str
     paged_only: bool = False
+    chunked_only: bool = False  # only bundles built with chunked
+    #                             prefill programs carry it
 
 
 # the counters every DecodeStepBundle program set carries (device
@@ -135,6 +137,21 @@ BUNDLE_COUNTERS: Tuple[CounterSpec, ...] = (
         "KV blocks copied by the COW program (lane diverging off a "
         "shared radix/beam chain into a fresh exclusive block)",
         paged_only=True),
+    CounterSpec(
+        "tel_chunks", "paddle_tpu_devtel_prefill_chunks_total",
+        "prefill_chunks",
+        "prompt chunks ticked through the chunked-prefill phase "
+        "programs (one bump per chunk body run)",
+        paged_only=True, chunked_only=True),
+    CounterSpec(
+        "tel_prefill_occupancy",
+        "paddle_tpu_devtel_prefill_occupancy_integral_total",
+        "prefill_occupancy_integral",
+        "sum over chunk dispatches of the live decode-lane count at "
+        "dispatch — with tel_occupancy this is the prefill-vs-decode "
+        "occupancy split (how many decode lanes kept ticking while a "
+        "prompt chunked in)",
+        paged_only=True, chunked_only=True),
 )
 
 # host-side supplement the PAGED scheduler reports through the same
@@ -164,15 +181,21 @@ HOST_COUNTERS: Tuple[CounterSpec, ...] = (
 )
 
 
-def bundle_counters(paged: bool) -> Tuple[CounterSpec, ...]:
+def bundle_counters(paged: bool,
+                    chunked: bool = True) -> Tuple[CounterSpec, ...]:
     """The device counters a bundle of the given layout carries.
-    Reference counterpart: none — the reference profiler has no
-    per-layout event selection (platform/profiler.h:166)."""
+    ``chunked`` defaults True on the ABSORB side (DeviceTelemetry
+    filters by actual state presence) and is passed False by builders
+    of non-chunked bundles so their spec tables stay exactly as
+    before. Reference counterpart: none — the reference profiler has
+    no per-layout event selection (platform/profiler.h:166)."""
     return tuple(c for c in BUNDLE_COUNTERS
-                 if paged or not c.paged_only)
+                 if (paged or not c.paged_only)
+                 and (chunked or not c.chunked_only))
 
 
-def counter_specs(prefix: str, paged: bool) -> Dict[str, tuple]:
+def counter_specs(prefix: str, paged: bool,
+                  chunked: bool = False) -> Dict[str, tuple]:
     """Slot-state spec entries (name -> ((1,), 'int64')) for the
     devtel counters of one bundle — merged into
     decode_engine._slot_state_specs so declaration, scope seeding and
@@ -181,15 +204,16 @@ def counter_specs(prefix: str, paged: bool) -> Dict[str, tuple]:
     side-channel registry. Reference counterpart: none — reference
     counters are host-side aggregates (platform/profiler.cc)."""
     return {f"{prefix}{c.logical}{TEL_MARK}": ((1,), "int64")
-            for c in bundle_counters(paged)}
+            for c in bundle_counters(paged, chunked)}
 
 
-def state_entries(prefix: str, paged: bool) -> Dict[str, str]:
+def state_entries(prefix: str, paged: bool,
+                  chunked: bool = False) -> Dict[str, str]:
     """logical -> var name map entries for ``DecodeStepBundle.state``
     (the serving layer resolves fetch names through this).
     Reference counterpart: none (see counter_specs)."""
     return {c.logical: f"{prefix}{c.logical}{TEL_MARK}"
-            for c in bundle_counters(paged)}
+            for c in bundle_counters(paged, chunked)}
 
 
 def declare_decode_steps(block):

@@ -56,6 +56,11 @@ SLOW_MODULES = {
     "test_pallas_attention",     # interpret-mode kernel sweeps
     "test_native_executor",      # C++ builds + decode/GM parity
     "test_pipeline_3d",          # 8-dev 3D mesh compiles
+    "test_disagg_serving",       # two-plan phase-sharded serving
+    "test_chunked_prefill",      # chunk/disagg serve waves
+    #                              (tests/test_chunked_contracts.py
+    #                              keeps the fast-lane chunk
+    #                              coverage)
 }
 
 

@@ -70,6 +70,14 @@ PAGED_METRICS = DENSE_METRICS | {
     "paddle_tpu_devtel_pause_events_total",
     "paddle_tpu_devtel_preemptions_total",
 }
+# chunked-prefill bundles (CacheConfig(chunk_tokens=C)) carry two more
+# counters; plain paged bundles keep EXACTLY the set above
+CHUNKED_STATS_KEYS = PAGED_STATS_KEYS | {
+    "prefill_chunks", "prefill_occupancy_integral"}
+CHUNKED_METRICS = PAGED_METRICS | {
+    "paddle_tpu_devtel_prefill_chunks_total",
+    "paddle_tpu_devtel_prefill_occupancy_integral_total",
+}
 
 
 @pytest.fixture(scope="module")
@@ -254,9 +262,13 @@ class TestCounterUnits:
 class TestPagedTelemetry:
     def test_hit_admissions_count_separately(self, ctx, obs):
         bundle = _paged_bundle(ctx, "@dtlp/", n_blocks=6)
+        # radix_reuse=False: this test pins the HIT tier's counter —
+        # under the default, an identical repeat prompt admits through
+        # the radix tier instead (tel_admit_radix; ISSUE 17
+        # cross-request reuse) and never reaches the hit program
         srv = PagedContinuousGenerationServer(
             bundle, executor=ctx["exe"], scope=ctx["scope"],
-            start=False)
+            start=False, radix_reuse=False)
         p = _prompts(1)[0]
         srv.submit(p)
         _drive(srv)
@@ -335,12 +347,16 @@ class TestGoldenKeysets:
         # must agree or the contract forked
         dense_logical = {c.stat for c in devtel.bundle_counters(False)}
         assert dense_logical | {"mean_live_lanes"} == DENSE_STATS_KEYS
-        paged = {c.stat for c in devtel.bundle_counters(True)} \
+        paged = {c.stat
+                 for c in devtel.bundle_counters(True, chunked=False)} \
             | {c.stat for c in devtel.HOST_COUNTERS}
         assert paged | {"mean_live_lanes"} == PAGED_STATS_KEYS
+        chunked = {c.stat for c in devtel.bundle_counters(True)} \
+            | {c.stat for c in devtel.HOST_COUNTERS}
+        assert chunked | {"mean_live_lanes"} == CHUNKED_STATS_KEYS
         assert {c.metric for c in devtel.BUNDLE_COUNTERS} \
             | {c.metric for c in devtel.HOST_COUNTERS} \
-            == PAGED_METRICS
+            == CHUNKED_METRICS
 
 
 class TestChurnWithTelemetry:

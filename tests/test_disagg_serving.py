@@ -1,0 +1,219 @@
+"""Disaggregated prefill/decode serving, sharded half (ISSUE 17
+tentpole): ONE chunked bundle, TWO ShardingPlans over two scopes on
+disjoint slices of the 8-device CPU mesh.
+
+The contracts this module pins (slow lane — two full tp=2 serving
+stacks compile):
+
+* ``apply_phase_sharding`` attaches the ``("chunked", p)`` phase
+  programs to a PREFILL plan (tp over the encoder projections — the
+  MXU-bound phase) and everything else to a DECODE plan (tp over KV
+  bytes), with DIFFERENT plan tokens: no executable/disk-cache entry
+  can dedup across phases;
+* ``place_disaggregated_bundle`` binds the plans to DISJOINT device
+  slices, syncs params decode-scope -> prefill-scope, and places each
+  phase's state under its plan;
+* the KV handoff is token-exact: entry rows the worker wrote on the
+  prefill slice read back BIT-IDENTICAL from the decode scope, and
+  the served tokens match the unsharded monolithic baseline exactly;
+* zero steady-state compiles with BOTH servers live: a second traffic
+  wave (fresh cold prompt included — chunk dispatches on the prefill
+  slice, decode bursts on the decode slice) compiles nothing;
+* the server constructor enforces the placement discipline: a
+  disaggregated bundle must be placed BEFORE construction, and
+  ``mesh_devices=`` (the single-plan path) is rejected for it.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import unique_name
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.inference.runtime.placement import \
+    place_disaggregated_bundle
+from paddle_tpu.inference.serving import (DisaggregatedPrefillWorker,
+                                          PagedContinuousGenerationServer)
+from paddle_tpu.models import transformer as T
+from paddle_tpu.models.decode_engine import (POOL_MARK, CacheConfig,
+                                             ShardingConfig,
+                                             apply_phase_sharding)
+
+V, D, H, L, S, MAXT = 16, 32, 2, 2, 10, 32
+BS, NB, E, C = 8, 24, 3, 4
+NC = (S + C - 1) // C
+NPH = 2 * L + 2
+PREFIX = "@dsg/"
+TP = 2
+
+
+def _build(phase_shard):
+    """Seed-pinned build: params are initialized identically for the
+    baseline and the disaggregated stack, so token parity is exact."""
+    fluid.seed(0)
+    scope = Scope()
+    with unique_name.guard():
+        _, t_st, _ = T.build_program(
+            seq_len=S, d_model=D, n_heads=H, n_layers=L, d_inner=64,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+    with unique_name.guard():
+        bundle = T.build_decode_step_program(
+            n_slots=4, admit_buckets=[1, 4], state_prefix=PREFIX,
+            seq_len=S, max_out_len=MAXT, d_model=D, n_heads=H,
+            n_layers=L, d_inner=64, vocab=V, start_id=2, end_id=1,
+            cache=CacheConfig(layout="paged", block_size=BS,
+                              n_blocks=NB, n_prompt_entries=E,
+                              chunk_tokens=C))
+    if phase_shard:
+        apply_phase_sharding(bundle, ShardingConfig(tp=TP),
+                             ShardingConfig(tp=TP), L)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(t_st, scope=scope)
+    return bundle, exe, scope
+
+
+def _prompts():
+    rng = np.random.RandomState(7)
+    return [rng.randint(3, V, (1, S)).astype(np.int64)
+            for _ in range(4)]
+
+
+ORDER = [0, 1, 0, 2, 1, 3, 2, 0]
+
+
+@pytest.fixture(scope="module")
+def mono_ref():
+    """Unsharded monolithic baseline tokens over the standard wave."""
+    bundle, exe, scope = _build(phase_shard=False)
+    prompts = _prompts()
+    with PagedContinuousGenerationServer(
+            bundle, executor=exe, scope=scope, steps_per_tick=4,
+            chunked_prefill=False) as srv:
+        return [np.asarray(srv.submit(prompts[i]).result(240.0))
+                for i in ORDER]
+
+
+@pytest.fixture(scope="module")
+def disagg():
+    """The full sharded stack: phase plans bound to disjoint slices,
+    worker on the prefill scope, server on the decode scope."""
+    bundle, exe, scope = _build(phase_shard=True)
+    bundle.init_slot_state(scope)
+    pre_scope = Scope()
+    placed = place_disaggregated_bundle(bundle, scope, pre_scope)
+    worker = DisaggregatedPrefillWorker(bundle, executor=exe,
+                                        scope=pre_scope)
+    srv = PagedContinuousGenerationServer(
+        bundle, executor=exe, scope=scope, steps_per_tick=4,
+        prefill_worker=worker)
+    yield {"bundle": bundle, "exe": exe, "scope": scope,
+           "pre_scope": pre_scope, "worker": worker, "srv": srv,
+           "placed": placed, "prompts": _prompts()}
+    srv.close()
+    worker.close()
+
+
+class TestPhasePlans:
+    def test_distinct_plans_on_disjoint_slices(self, disagg):
+        b = disagg["bundle"]
+        assert b.sharding_plan is not None
+        assert b.prefill_plan is not None
+        # different placements + different device ids: the executor
+        # key, disk-cache digest and server fingerprint all differ by
+        # construction — no cross-phase dedup anywhere
+        assert b.prefill_plan.token() != b.sharding_plan.token()
+        dec_ids = set(b.sharding_plan._device_ids)
+        pre_ids = set(b.prefill_plan._device_ids)
+        assert len(dec_ids) == TP and len(pre_ids) == TP
+        assert not (dec_ids & pre_ids)
+        assert disagg["placed"] > 0
+
+    def test_chunk_programs_ride_the_prefill_plan(self, disagg):
+        from paddle_tpu.core import sharding_plan as sp
+
+        b = disagg["bundle"]
+        for key, prog in b.serves.items():
+            want = b.prefill_plan \
+                if isinstance(key, tuple) and key[0] == "chunked" \
+                else b.sharding_plan
+            assert sp.plan_of(prog) is want, key
+
+    def test_apply_phase_sharding_needs_chunked_bundle(self):
+        with unique_name.guard():
+            plain = T.build_decode_step_program(
+                n_slots=2, admit_buckets=[1], state_prefix="@dsgp/",
+                seq_len=S, max_out_len=MAXT, d_model=D, n_heads=H,
+                n_layers=1, d_inner=64, vocab=V, start_id=2, end_id=1,
+                cache=CacheConfig(layout="paged", block_size=BS,
+                                  n_blocks=8, n_prompt_entries=2))
+        with pytest.raises(ValueError, match="chunked-prefill"):
+            apply_phase_sharding(plain, ShardingConfig(tp=TP),
+                                 ShardingConfig(tp=TP), 1)
+
+
+class TestConstructionDiscipline:
+    def test_unplaced_disagg_bundle_rejected(self):
+        bundle, exe, scope = _build(phase_shard=True)
+        with pytest.raises(ValueError, match="unplaced"):
+            PagedContinuousGenerationServer(bundle, executor=exe,
+                                            scope=scope)
+
+    def test_mesh_devices_rejected_for_disagg_bundle(self):
+        import jax
+
+        bundle, exe, scope = _build(phase_shard=True)
+        bundle.init_slot_state(scope)
+        place_disaggregated_bundle(bundle, scope, Scope())
+        with pytest.raises(ValueError, match="place_disaggregated"):
+            PagedContinuousGenerationServer(
+                bundle, executor=exe, scope=scope,
+                mesh_devices=jax.devices()[:TP])
+
+
+class TestServing:
+    def test_wave_token_exact_vs_monolithic(self, disagg, mono_ref):
+        srv, prompts = disagg["srv"], disagg["prompts"]
+        toks = [np.asarray(srv.submit(prompts[i]).result(240.0))
+                for i in ORDER]
+        for got, want in zip(toks, mono_ref):
+            assert np.array_equal(got, want)
+        stats = srv.pool_stats()
+        assert stats["disaggregated"] is True
+        # 4 distinct prompts with E=3 entries: >= 4 jobs (a repeat of
+        # an LRU-evicted prompt re-chunks — timing-dependent), every
+        # job handed off, tick arithmetic exact per job
+        assert stats["chunk_jobs"] >= 4
+        assert stats["disagg_handoffs"] == stats["chunk_jobs"]
+        ws = disagg["worker"].stats()
+        assert ws["jobs_done"] == stats["chunk_jobs"]
+        assert ws["chunk_ticks"] == ws["jobs_done"] * NC * NPH
+
+    def test_handoff_rows_bit_exact_across_scopes(self, disagg):
+        """Runs after the wave: every cross-KV entry row the worker
+        wrote on the prefill slice must read back bit-identical from
+        the decode scope (the handoff is a copy, not a recompute)."""
+        import re
+
+        b = disagg["bundle"]
+        pat = re.compile(re.escape(PREFIX) + r"cross_[kv]\d+"
+                         + re.escape(POOL_MARK))
+        names = sorted(n for n in b._state_specs if pat.fullmatch(n))
+        assert len(names) == 2 * L
+        for n in names:
+            dec = np.asarray(disagg["scope"]._get(n))[:E]
+            pre = np.asarray(disagg["pre_scope"]._get(n))[:E]
+            np.testing.assert_array_equal(dec, pre, err_msg=n)
+
+    def test_second_wave_zero_compiles_both_servers_live(
+            self, disagg, mono_ref):
+        """Steady state with BOTH phases serving: re-running the wave
+        (hits + radix re-admissions on the decode slice; the repeat
+        submissions of already-evicted prompts may chunk again on the
+        prefill slice) must compile NOTHING anywhere."""
+        srv, exe = disagg["srv"], disagg["exe"]
+        prompts = disagg["prompts"]
+        warmed = exe.compile_count
+        toks = [np.asarray(srv.submit(prompts[i]).result(240.0))
+                for i in ORDER]
+        assert exe.compile_count == warmed
+        for got, want in zip(toks, mono_ref):
+            assert np.array_equal(got, want)

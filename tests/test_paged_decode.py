@@ -124,6 +124,13 @@ def _oracle(tr, srcs):
 
 
 def _paged_server(tr, **kw):
+    # radix_reuse=False: this module pins the paged-pool contracts
+    # proper — full drain after retirement, hit-tier admissions for
+    # repeat prompts. Under the default, retired generations' block
+    # chains are ADOPTED into the radix tree (cross-request reuse,
+    # ISSUE 17) so blocks_in_use stays >0 by design; that behavior
+    # has its own coverage (test_radix_reuse, test_chunked_prefill).
+    kw.setdefault("radix_reuse", False)
     return PagedContinuousGenerationServer(
         tr["paged"], executor=tr["exe"], scope=tr["scope"], **kw)
 
@@ -239,7 +246,7 @@ class TestParity:
             "precondition: the long rows must span all 4 pages"
         srv = PagedContinuousGenerationServer(
             tight, executor=trained["exe"], scope=trained["scope"],
-            start=False)
+            start=False, radix_reuse=False)  # see _paged_server
         try:
             replies = self._sync_drive(srv, srcs)
             got = np.stack([r.result(0) for r in replies])

@@ -174,6 +174,25 @@ class TestParity:
         got = apply_eos_sentinel(np.asarray(got), END_ID)
         np.testing.assert_array_equal(got, want)
 
+    def test_greedy_full_recompute_sharded_vs_single(self, trained):
+        """The greedy FULL-RECOMPUTE whole-loop front takes
+        ``sharding=`` too (params-only tp layout — it holds no
+        persistable KV, so the fused attention ops take head
+        sharding purely from GSPMD param propagation): token parity
+        against the single-device incremental oracle."""
+        srcs = _mixed_len_prompts(np.random.RandomState(29), 8)
+        want = _oracle(trained, srcs)
+        with unique_name.guard():
+            g_m, _, _, g_buf = T.build_greedy_decode_program(
+                sharding=ShardingConfig(tp=TP), **trained["kwargs"])
+        fork = _fork_scope(trained["scope"])
+        placed = place_sharded_program(g_m, fork)
+        assert placed > 0
+        got, = trained["exe"].run(g_m, feed={"src_ids": srcs},
+                                  fetch_list=[g_buf], scope=fork)
+        got = apply_eos_sentinel(np.asarray(got), END_ID)
+        np.testing.assert_array_equal(got, want)
+
     def test_dense_burst_sharded_vs_single(self, trained):
         srcs = _mixed_len_prompts(np.random.RandomState(13), 12)
         want = _oracle(trained, srcs)
