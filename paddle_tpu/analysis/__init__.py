@@ -36,6 +36,23 @@ Pieces:
   PTA110's declaration) and PTA192 (read-only-while-shared, the COW
   contract); ops without a rule propagate nothing, so an unproven
   index fails loudly at the pool access.
+* analysis.liveness — the protocol LIVENESS domain: admission-
+  capacity feasibility (PTA200 — a declarative resource model over
+  the host allocators; session-pinned prompt entries against
+  never-closing sessions is the canonical infeasible witness),
+  release-on-every-exit-path obligation ledgers (PTA201 — every
+  acquire contract registered via absint.register_acquire_release
+  must name a release site for each declared exit path), and While
+  progress variants (PTA202 — a bounded increment-driven counter in
+  the condition's backward slice; serve loops additionally carry the
+  named monotone-lane_active_mask assumption).
+* analysis.protomodel — the exhaustive bounded model checker over
+  the HOST allocator typestate machines (HostBlockPool,
+  PromptPrefixCache, RadixBlockTree, session pin/unpin): BFS over
+  small-bound state spaces with refcount-conservation invariants,
+  drain-to-free leak checks, deadlock detection and minimal
+  counterexample traces — the oracle PTA200's feasibility predicate
+  is validated against (tests/test_protomodel.py grid).
 * analysis.memplan — the static per-device memory planner behind
   ``analyze(p).device_memory_plan()`` / CLI ``--memory-plan`` /
   checker PTA170: persistable/feed/temp bytes under the propagated
@@ -69,7 +86,7 @@ from __future__ import annotations
 
 from typing import List
 
-from . import absint
+from . import absint, liveness, protomodel
 from .checkers import (Checker, Diagnostic, ERROR, INFO, WARNING,
                        SUPPRESS_ATTR, check_bundle, check_clone_uids,
                        check_cross_model_collision,
@@ -86,6 +103,7 @@ __all__ = [
     "check_registry", "check_shared_params", "check_clone_uids",
     "check_cross_model_collision", "check_bundle", "SUPPRESS_ATTR",
     "format_diagnostics", "maybe_check_program", "absint",
+    "liveness", "protomodel",
     "BlockDataflow", "OpSite", "analyze_block", "iter_blocks",
     "iter_ops", "iter_sub_blocks", "register_block_entry_attrs",
 ]

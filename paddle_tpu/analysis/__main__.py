@@ -7,6 +7,11 @@ Usage:
                                   [--memory-plan]
                                   [--baseline [PATH]]
                                   [--write-baseline [PATH]]
+                                  [--explain PTA0xx ...]
+
+``--explain PTA0xx`` prints the named checker's contract docstring
+(what it proves, what a finding means, how to discharge or suppress
+it) straight from the registered checker — no zoo build.
 
 ``--only`` filters by target-name SUBSTRING (``--only transf`` lints
 models/transformer), so iterating on one checker against one program
@@ -28,6 +33,37 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+
+
+def _explain(codes) -> int:
+    """Print each named checker's contract docstring — the catalog's
+    tribal knowledge, surfaced at the CLI so a red finding comes with
+    its own discharge instructions. Unknown codes exit 2 (a typo'd
+    code must not look like a documented one)."""
+    import inspect
+
+    from .checkers import registered_checkers
+
+    by_code = {c.code: c for c in registered_checkers()}
+    rc = 0
+    for raw in codes:
+        code = raw.upper()
+        chk = by_code.get(code)
+        if chk is None:
+            print(f"error: unknown checker code {raw!r}; known: "
+                  f"{' '.join(sorted(by_code))}", file=sys.stderr)
+            rc = 2
+            continue
+        doc = inspect.cleandoc(chk.doc) if chk.doc \
+            else "(no contract docstring registered)"
+        print(f"{chk.code} — {chk.name}\n")
+        print(doc)
+        print(f"\nsuppress: attach _pta_suppress=(\"{chk.code}\", "
+              f"\"<reason>\") at the flagged op (bundle-level codes "
+              f"like PTA150/PTA200: set bundle._pta_suppress); every "
+              f"suppression is counted and drift-gated by "
+              f"analysis_baseline.json, never silent.\n")
+    return rc
 
 
 def main(argv=None) -> int:
@@ -64,11 +100,19 @@ def main(argv=None) -> int:
                    default=None, metavar="PATH",
                    help="(re)write the baseline snapshot from this "
                         "sweep and exit 0")
+    p.add_argument("--explain", nargs="+", default=None,
+                   metavar="PTA0xx",
+                   help="print the named checker(s)' contract "
+                        "docstring and suppression convention, then "
+                        "exit (skips the zoo build)")
     args = p.parse_args(argv)
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")  # lint never needs a TPU
+
+    if args.explain is not None:
+        return _explain(args.explain)
 
     from . import ERROR, INFO, WARNING, check_registry
     from .baseline import (collect_reports, diff_against_baseline,
@@ -129,6 +173,15 @@ def main(argv=None) -> int:
             entry["ownership"] = {
                 "facts": dict(rep.ownership),
                 "ledger": dict(rep.ownership_ledger),
+            }
+        if rep.liveness:
+            # the release-obligation / progress ledger: which acquire
+            # contracts this target discharges on every exit path and
+            # which While loops carry a proven variant (PTA200/201/202
+            # — the liveness prover surface)
+            entry["liveness"] = {
+                "facts": dict(rep.liveness),
+                "ledger": dict(rep.liveness_ledger),
             }
         if args.memory_plan and rep.plan is not None:
             entry["memory_plan"] = {
@@ -199,11 +252,24 @@ def main(argv=None) -> int:
                 assumptions[name] = assumptions.get(name, 0) + n
             for name, n in (led.get("obligations") or {}).items():
                 obligations[name] = obligations.get(name, 0) + n
+        # zoo-wide liveness roll-up: total discharged release
+        # obligations and every UNDISCHARGED one by target — the
+        # "zero unproven" acceptance surface the gate test asserts
+        liv_proven = 0
+        liv_unproven = []
+        for rep in reports:
+            led = rep.liveness_ledger or {}
+            liv_proven += int(led.get("proven", 0))
+            liv_unproven += [f"{rep.target}: {u}"
+                             for u in led.get("unproven", [])]
         out = {"targets": report, "errors": n_err,
                "warnings": n_warn, "suppressed": n_sup,
                "ownership_ledger": {
                    "assumptions": dict(sorted(assumptions.items())),
                    "obligations": dict(sorted(obligations.items()))},
+               "liveness_ledger": {
+                   "proven": liv_proven,
+                   "unproven": sorted(liv_unproven)},
                "checker_seconds": {
                    k: round(v, 4)
                    for k, v in sorted(checker_seconds.items())}}

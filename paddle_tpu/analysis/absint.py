@@ -95,6 +95,9 @@ __all__ = [
     "OwnershipSource", "register_pool_index_source",
     "pool_index_sources", "mark_pool_index_source",
     "ProvFact", "prov_join", "PoolAccess",
+    # --- the liveness domain ---
+    "AcquireContract", "register_acquire_release",
+    "register_release_site", "acquire_contracts", "release_sites",
 ]
 
 # --- the replication lattice ------------------------------------------------
@@ -465,6 +468,155 @@ register_pool_index_source(
     "every write it parameterizes stays inside the host_indices "
     "exclusivity window",
     TS_EXCLUSIVE, assumption="PromptPrefixCache.fresh-exclusive")
+
+
+# --- the liveness domain: acquire/release obligation contracts ---------------
+# Where an OwnershipSource certifies what a minted index MEANS, an
+# AcquireContract declares the OBLIGATION minting through that tag
+# creates: the host call that takes the hold, the host call that
+# discharges it, and the exhaustive set of protocol exit paths the
+# discharge must be proven on. The contract store lives in
+# core/registry.py beside the sharding/index rule stores; this module
+# owns validation (tags must exist in the ownership seed table and
+# must not be gates — a 0/1 mask is not a resource) so a typo'd tag
+# fails at import, not as a silently-empty ledger.
+@dataclass(frozen=True)
+class AcquireContract:
+    """One acquire/release obligation family for a resource tag.
+
+    ``acquire``/``release`` name the host calls ("Class.method") that
+    mint and discharge the hold; ``exits`` is the exhaustive tuple of
+    protocol exit paths on which PTA201 requires a registered release
+    site; ``resource`` names the allocator machine the hold draws
+    from (the protomodel/PTA200 capacity pool it counts against).
+
+    Reference counterpart: none — the reference discharges at runtime
+    via scoped GC (reference framework/executor.cc Scope teardown); a
+    static per-exit-path obligation has no analogue there.
+    """
+    tag: str
+    acquire: str
+    release: str
+    exits: Tuple[str, ...]
+    resource: str
+
+
+def register_acquire_release(tag: str, acquire: str, release: str,
+                             exits: Iterable[str],
+                             resource: str) -> AcquireContract:
+    """Register the liveness contract for ownership tag ``tag``
+    (idempotent-identical, raise-on-redefinition — the standing
+    registry contract). The tag must already be a registered
+    NON-GATE ownership source: contracts attach obligations to real
+    resource holds, and registering first forces the mint-site mark
+    to exist before anyone claims its release story.
+
+    Reference counterpart: none (see AcquireContract)."""
+    from ..core import registry as _registry
+
+    src = _OWNERSHIP_SOURCES.get(tag)
+    if src is None:
+        raise ValueError(
+            f"register_acquire_release: {tag!r} is not a registered "
+            f"ownership source (register_pool_index_source first)")
+    if src.typestate == TS_GATE:
+        raise ValueError(
+            f"register_acquire_release: {tag!r} is a gate (0/1 "
+            f"mask), not a resource hold — gates carry no obligation")
+    exits = tuple(exits)
+    if not exits:
+        raise ValueError(
+            f"register_acquire_release: {tag!r} declares no exit "
+            f"paths — an obligation with no discharge path is a "
+            f"declared leak, suppress it at the checker instead")
+    contract = AcquireContract(tag, acquire, release, exits, resource)
+    _registry.register_acquire_contract(tag, contract)
+    return contract
+
+
+def register_release_site(tag: str, exit_path: str,
+                          site: str) -> None:
+    """Record that ``site`` discharges ``tag``'s obligation on
+    ``exit_path``. The contract must exist and must declare the exit
+    — a release on an undeclared path means the contract's exit set
+    is stale, which is exactly the drift PTA201 exists to catch, so
+    it raises here rather than widening silently.
+
+    Reference counterpart: none (see AcquireContract)."""
+    from ..core import registry as _registry
+
+    contract = _registry.get_acquire_contract(tag)
+    if contract is None:
+        raise ValueError(
+            f"register_release_site: no acquire contract for "
+            f"{tag!r} (register_acquire_release first)")
+    if exit_path not in contract.exits:
+        raise ValueError(
+            f"register_release_site: {tag!r} does not declare exit "
+            f"path {exit_path!r} (declared: {contract.exits}); "
+            f"extend the contract, don't widen it from a call site")
+    _registry.register_release_site(tag, exit_path, site)
+
+
+def acquire_contracts() -> Dict[str, AcquireContract]:
+    """The registered contract table, copied. Reference counterpart:
+    none (see AcquireContract)."""
+    from ..core import registry as _registry
+
+    return _registry.acquire_contracts()
+
+
+def release_sites() -> Dict[Tuple[str, str], List[str]]:
+    """The registered release-site table, copied. Reference
+    counterpart: none (see AcquireContract)."""
+    from ..core import registry as _registry
+
+    return _registry.release_sites()
+
+
+# The canonical contracts for the serving-era tags above. Exit-path
+# vocabulary (shared with inference/serving.py's site registrations):
+#   retire        normal lane retirement (_free_lane_locked)
+#   preempt       recompute-preemption of a live lane
+#   abort         abandoned chunked-prefill job teardown
+#   invalidate    admission backout / entry invalidation
+#   session_close close_session releasing a pinned entry
+#   server_close  close() draining lanes, jobs, and handoff refs
+#   handoff       disagg prefill->decode ownership transfer
+# "cancel" (the front-door tentpole) is DELIBERATELY not declared
+# yet: when cancellation lands it must extend these contracts, and
+# PTA201 will flag every tag until its release sites register — that
+# is the designed failure mode, not an oversight.
+register_acquire_release(
+    "block_table", acquire="HostBlockPool.alloc",
+    release="HostBlockPool.decref",
+    exits=("retire", "preempt", "server_close"),
+    resource="HostBlockPool")
+register_acquire_release(
+    "host_indices", acquire="PromptPrefixCache.acquire_fresh",
+    release="PromptPrefixCache.release",
+    exits=("retire", "abort", "invalidate", "server_close"),
+    resource="PromptPrefixCache")
+register_acquire_release(
+    "prompt_entry_ref", acquire="PromptPrefixCache.acquire_hit",
+    release="PromptPrefixCache.release",
+    exits=("retire", "session_close", "server_close"),
+    resource="PromptPrefixCache")
+register_acquire_release(
+    "cow_src", acquire="RadixBlockTree.acquire",
+    release="RadixBlockTree.release",
+    exits=("retire", "preempt", "evict", "server_close"),
+    resource="HostBlockPool")
+register_acquire_release(
+    "cow_dst", acquire="HostBlockPool.alloc",
+    release="HostBlockPool.decref",
+    exits=("retire", "preempt", "server_close"),
+    resource="HostBlockPool")
+register_acquire_release(
+    "chunk_cursor", acquire="PromptPrefixCache.acquire_fresh",
+    release="PromptPrefixCache.release",
+    exits=("handoff", "abort", "server_close"),
+    resource="PromptPrefixCache")
 
 
 @dataclass(frozen=True)

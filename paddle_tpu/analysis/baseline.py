@@ -63,6 +63,16 @@ class TargetReport:
     # ownership_ledger): the CLI --json surface, never baselined raw
     # (site counts churn with op-count tweaks; the FACTS above gate)
     ownership_ledger: dict = field(default_factory=dict)
+    # stable liveness snapshot (liveness.stable_liveness_facts for
+    # programs, liveness.bundle_liveness_facts for bundles): While
+    # variant verdicts, the release-obligation roll-up, and
+    # admission-capacity feasibility; feeds the baseline's
+    # drift-gated `liveness_facts` section
+    liveness: Dict[str, str] = field(default_factory=dict)
+    # the per-target release-obligation ledger
+    # (liveness.obligation_ledger): the CLI --json surface, never
+    # baselined raw (site lists churn; the FACTS above gate)
+    liveness_ledger: dict = field(default_factory=dict)
     # static per-device memory plan (analysis/memplan.MemoryPlan);
     # filled only when collect_reports(with_plans=True) — the CLI's
     # --memory-plan surface
@@ -87,7 +97,7 @@ def collect_reports(include_benchmark: bool = True,
     Reference counterpart: none — the reference gated one program at
     a time at build (op_desc.cc); a repo-wide diagnostic sweep is the
     CI-era extension (module docstring)."""
-    from . import absint
+    from . import absint, liveness
     from .targets import iter_lint_targets
 
     if targets is None:
@@ -105,6 +115,8 @@ def collect_reports(include_benchmark: bool = True,
             rep.sharding = facts.stable_sharding_facts()
             rep.ownership = facts.stable_ownership_facts()
             rep.ownership_ledger = facts.ownership_ledger()
+            rep.liveness = liveness.stable_liveness_facts(facts)
+            rep.liveness_ledger = liveness.obligation_ledger(facts)
             if with_plans:
                 try:
                     rep.plan = facts.device_memory_plan()
@@ -118,7 +130,9 @@ def collect_reports(include_benchmark: bool = True,
         for blabel, bundle in sorted(
                 getattr(target, "bundles", {}).items()):
             rep = TargetReport(f"{target.name}:bundle/{blabel}")
-            rep.diagnostics = check_bundle(bundle)
+            rep.diagnostics = check_bundle(
+                bundle, collect_suppressed=rep.suppressed)
+            rep.liveness = liveness.bundle_liveness_facts(bundle)
             reports.append(rep)
     return reports
 
@@ -140,13 +154,19 @@ def baseline_payload(reports: List[TargetReport]) -> dict:
     ``@assumptions`` roll-up — absint.stable_ownership_facts): a
     propagation/provenance-rule change that silently re-lays-out or
     re-derives an annotated program shows up as a facts diff,
-    drift-gated exactly like a new warning.
+    drift-gated exactly like a new warning. The LIVENESS facts
+    (``target|key`` -> While variant verdicts, release-obligation
+    roll-ups, and per-bundle admission-capacity feasibility —
+    liveness.stable_liveness_facts / bundle_liveness_facts) gate the
+    same way: a progress proof that stops proving, an obligation that
+    stops discharging, or a capacity margin that flips is drift.
 
     Reference counterpart: none (see diff_against_baseline)."""
     entries: Dict[str, int] = {}
     suppressed: Dict[str, int] = {}
     sharding: Dict[str, str] = {}
     ownership: Dict[str, str] = {}
+    liveness: Dict[str, str] = {}
     n_err = n_warn = n_info = 0
     for rep in reports:
         for d in rep.diagnostics:
@@ -166,13 +186,16 @@ def baseline_payload(reports: List[TargetReport]) -> dict:
             sharding[f"{rep.target}|{var}"] = desc
         for var, desc in rep.ownership.items():
             ownership[f"{rep.target}|{var}"] = desc
+        for var, desc in rep.liveness.items():
+            liveness[f"{rep.target}|{var}"] = desc
     return {
-        "version": 3,
+        "version": 4,
         "entries": {k: entries[k] for k in sorted(entries)},
         "suppressed": {k: suppressed[k] for k in sorted(suppressed)},
         "sharding_facts": {k: sharding[k] for k in sorted(sharding)},
         "ownership_facts": {k: ownership[k]
                             for k in sorted(ownership)},
+        "liveness_facts": {k: liveness[k] for k in sorted(liveness)},
         "totals": {"errors": n_err, "warnings": n_warn,
                    "infos": n_info, "targets": len(reports)},
     }
@@ -217,7 +240,8 @@ def diff_against_baseline(reports: List[TargetReport],
     # zoo's layouts/proofs) and fails like a new warning until the
     # baseline refresh puts the new facts in front of a reviewer
     for section, what in (("sharding_facts", "sharding"),
-                          ("ownership_facts", "ownership")):
+                          ("ownership_facts", "ownership"),
+                          ("liveness_facts", "liveness")):
         current = payload[section]
         base = dict(baseline.get(section, {}))
         for k, v in current.items():

@@ -90,6 +90,25 @@ a stable diagnostic code so tests/docs can reference the class:
           to a REFCOUNTED source — prompt_entry_ref — certifies
           reads only; a write through it is the COW violation the
           radix/beam prefix-sharing work must never ship)
+  PTA200  admission-capacity feasibility (the liveness domain,
+          analysis/liveness.py: worst-case steady-state resource
+          demand per serving configuration vs the static pools —
+          lane block chains vs HostBlockPool, pinned session
+          prompts vs PromptPrefixCache entries; an infeasible
+          config gets a concrete deadlock witness, validated
+          against the exhaustive protomodel explorer)
+  PTA201  release-on-every-exit-path (every acquire obligation an
+          ownership tag creates — absint.register_acquire_release —
+          must have a registered release SITE on every declared
+          protocol exit path: retirement, preemption, abort,
+          invalidate, session/server close, handoff; an
+          undischarged path is a leak nobody is maintaining)
+  PTA202  serve-While progress (every While must carry a SOUND
+          variant: an increment-driven counter in its condition's
+          backward slice bounded by a loop-invariant feed/const;
+          serve/burst Whiles additionally rest on the NAMED
+          monotone-lane_active_mask assumption for their burst-exit
+          disjunct)
 
 Severities: "error" = the program is wrong (strict mode raises),
 "warning" = almost certainly a bug but a legal feed/scope could save
@@ -2102,6 +2121,161 @@ def check_declared_clobbers(program: Program):
 
 
 # ---------------------------------------------------------------------------
+# PTA201/PTA202: the liveness domain's program-level provers
+# (analysis/liveness.py; PTA200's capacity model is bundle-level and
+# lives in check_bundle below).
+# ---------------------------------------------------------------------------
+@register_checker("PTA200", "admission-capacity-feasibility")
+def check_admission_capacity(program: Program):
+    """Admission-capacity feasibility: the serving configuration's
+    worst-case steady-state resource demand must fit its static
+    pools, or admission can wedge forever with no error anywhere.
+    Two pools are modeled (analysis/liveness.py): ``HostBlockPool``
+    (demand = n_slots lanes x pages(max_out_len) blocks, assuming no
+    radix sharing) and ``PromptPrefixCache`` (demand = the declared
+    workload's distinct SESSION prompts, which pin one entry each for
+    the session lifetime, plus one churn entry when cold traffic
+    shares the cache). The deadlock witness is validated against the
+    exhaustive bounded explorer in analysis/protomodel.py
+    (session_protocol), so "INFEASIBLE" comes with a replayable
+    minimal trace, and the serving layer raises the same verdict as
+    ``AdmissionInfeasible`` at submit time.
+
+    This checker is BUNDLE-level: the capacity model reads the
+    bundle's static shape (n_slots/max_out_len/cache) and declared
+    ``workload``, not any one program's IR, so the check runs in
+    ``check_bundle`` and this program-level registration exists for
+    the catalog/--explain surface.
+
+    Example::
+
+        bundle.workload = {"distinct_session_prompts": 5}
+        # cache.n_prompt_entries == 3, sessions never close:
+        # every admitted session pins an entry forever; after 3
+        # admissions all entries are pinned and unevictable, the
+        # 4th distinct prompt waits forever -> PTA200 error
+
+    Suppress with a bundle-level attr
+    ``bundle._pta_suppress = (("PTA200", "reason"),)`` — counted in
+    the CI baseline's suppressed section, never silent."""
+    return ()
+
+
+@register_checker("PTA201", "release-on-every-exit-path")
+def check_release_obligations(program: Program):
+    """Every acquire obligation this program exercises must be
+    discharged on EVERY declared protocol exit path. An ownership tag
+    reaching a ``@POOL`` access names a resource hold (HostBlockPool
+    block, PromptPrefixCache entry, radix incref); its
+    ``AcquireContract`` (absint.register_acquire_release) declares
+    the exit paths — retirement, preemption, abort, invalidate,
+    session close, server close, handoff — and the serving layer
+    registers the release SITE proving each one
+    (absint.register_release_site at the method that implements it).
+    A tag with no contract, or a declared exit with no site, is an
+    ERROR: an undischarged hold on a rare exit path is exactly how a
+    pool drains one leaked block per preemption until admission
+    wedges with no error anywhere.
+
+    Example::
+
+        # a builder minting a NEW resource-holding index source
+        absint.register_pool_index_source("my_tab", "...",
+                                          absint.TS_EXCLUSIVE)
+        absint.mark_pool_index_source(tab, "my_tab", bound=N)
+        # ...without ALSO registering its liveness contract:
+        #   absint.register_acquire_release("my_tab",
+        #       acquire="MyPool.alloc", release="MyPool.decref",
+        #       exits=("retire", "preempt"), resource="MyPool")
+        # and a release site per exit (from the code implementing
+        # it):
+        #   absint.register_release_site("my_tab", "retire",
+        #       "MyServer._free_lane_locked")
+        # -> PTA201 error at the first @POOL access the tag reaches
+
+    Suppress with ``_pta_suppress=("PTA201", "why this hold is
+    deliberately leaked")`` on the mint-site/access op — counted in
+    the CI baseline, never silent."""
+    from . import absint, liveness
+
+    facts = absint.analyze(program)
+    if not facts.converged:
+        return
+    ledger = liveness.obligation_ledger(facts)
+    if not ledger["unproven"]:
+        return
+    # anchor each tag's findings at its first pool access so the
+    # counted _pta_suppress convention (op-anchored) applies
+    anchor_of: Dict[str, OpSite] = {}
+    for acc in facts.pool_accesses:
+        fact = acc.index_fact
+        for t in (fact.tags if fact is not None else ()):
+            anchor_of.setdefault(t, acc.site)
+    for item in ledger["unproven"]:
+        tag = item.split(":", 1)[0]
+        site = anchor_of.get(tag)
+        msg = (f"unproven release obligation — {item}: a hold with "
+               f"an unproven discharge path leaks pool capacity on "
+               f"that path until admission wedges")
+        hint = ("register the contract/site: absint."
+                "register_acquire_release(tag, acquire, release, "
+                "exits, resource) beside the mint site, absint."
+                "register_release_site(tag, exit, 'Class.method') "
+                "from the code that releases")
+        if site is not None:
+            yield _diag_at("PTA201", ERROR, site, msg, hint=hint)
+        else:
+            yield Diagnostic("PTA201", ERROR, msg, hint=hint)
+
+
+@register_checker("PTA202", "while-variant-progress")
+def check_while_progress(program: Program):
+    """Every While loop must carry a SOUND termination variant
+    instead of being trusted by construction: the condition's
+    backward slice through the body must contain a positive-step
+    ``increment`` counter AND a loop-invariant bound terminal (a data
+    feed, a ``fill_constant``, or a parent-block value the body
+    cannot write). Serve/burst Whiles (condition producer marked
+    ``lane_active_mask``) are held to ERROR — their burst-exit
+    disjunct additionally rides the NAMED monotone-mask assumption
+    (active lanes only retire within a burst), so the counter term
+    alone must bound the loop; other unproven Whiles are WARNING (a
+    legal data-dependent loop could still terminate, but nothing
+    here proves it).
+
+    Example::
+
+        cond = layers.less_than(counter, limit, cond=cond)  # in body
+        # ...with NO layers.increment(counter, 1) in the body:
+        # the slice has a bound but no counter -> PTA202 (and a
+        # serve While whose body never recomputes its condition at
+        # all can only spin -> PTA202 error)
+
+    Suppress with ``_pta_suppress=("PTA202", "reason")`` on the
+    while op — counted, never silent."""
+    from . import liveness
+
+    for v in liveness.while_variants(program):
+        if v.proven:
+            continue
+        sev = ERROR if v.kind == "serve" else WARNING
+        msg = (f"While has no provable termination variant "
+               f"({v.detail}); "
+               + ("this is a serve/burst loop — an unbounded burst "
+                  "holds the dispatch hostage and never returns "
+                  "lane results" if v.kind == "serve" else
+                  "nothing proves this loop makes progress"))
+        hint = ("drive the condition from an increment-stepped "
+                "counter compared against a fed/const limit, "
+                "recomputed in the body (the decode_engine "
+                "_serve_cond pattern)")
+        if v.site is not None:
+            yield _diag_at("PTA202", sev, v.site, msg, hint=hint)
+        else:
+            yield Diagnostic("PTA202", sev, msg, hint=hint)
+
+
+# ---------------------------------------------------------------------------
 # PTA150: whole-bundle contracts (DecodeStepBundle as ONE lint unit).
 # ---------------------------------------------------------------------------
 def _bundle_programs(bundle):
@@ -2143,8 +2317,11 @@ def _persistable_decls(program):
     return decls
 
 
-def check_bundle(bundle) -> List[Diagnostic]:
-    """PTA150: lint a whole DecodeStepBundle as ONE unit. The bundle's
+def check_bundle(bundle,
+                 collect_suppressed: Optional[list] = None
+                 ) -> List[Diagnostic]:
+    """PTA150 + PTA200: lint a whole DecodeStepBundle as ONE unit.
+    The bundle's
     programs are SPECIALIZATIONS over shared scope state — one
     admission flavor per bucket, a standalone step, the fused serves —
     and the serving layer dispatches them interchangeably against the
@@ -2166,7 +2343,23 @@ def check_bundle(bundle) -> List[Diagnostic]:
       (base_seed, request seed, position), so a serve specialization
       with a drifted base_seed emits different tokens for the same
       request depending on which program the scheduler happened to
-      dispatch.
+      dispatch;
+    * **admission-capacity feasibility** (PTA200, the liveness
+      domain): the bundle's static shape must admit a live steady
+      state — lane block chains must fit ``HostBlockPool`` and the
+      declared session workload's pinned prompts must fit
+      ``PromptPrefixCache`` (analysis/liveness.py; the protomodel
+      explorer is the oracle). Bundle-level diagnostics have no op
+      anchor, so a deliberate witness target suppresses via a
+      ``_pta_suppress`` attr ON THE BUNDLE object — counted through
+      `collect_suppressed` exactly like op-anchored ones.
+
+    Example (PTA200)::
+
+        bundle.workload = {"distinct_session_prompts": 5}
+        # with cache.n_prompt_entries == 3 and sessions that never
+        # close: 5 pinned entries can never fit 3 slots -> PTA200
+        # error with the session-pinning deadlock witness
 
     Reference counterpart: op_desc.cc validates ONE program; the
     bundle gate is the capability the whole-block-jit serving path
@@ -2255,6 +2448,42 @@ def check_bundle(bundle) -> List[Diagnostic]:
                 hint="derive every specialization's sampling ops "
                      "from the bundle's single SamplingConfig/"
                      "DraftConfig base_seed"))
+
+    # PTA200: admission-capacity feasibility (bundle-level — the
+    # capacity model is a property of the bundle's static shape +
+    # declared workload, not of any one program)
+    from . import liveness as _liveness
+
+    suppress: Dict[str, str] = {}
+    raw = getattr(bundle, SUPPRESS_ATTR, None)
+    if raw is not None:
+        entries = _normalize_suppressions(raw)
+        if entries is None:
+            out.append(Diagnostic(
+                "PTA199", WARNING,
+                f"malformed bundle-level {SUPPRESS_ATTR} attr "
+                f"{raw!r}; expected (\"PTA0xx\", \"reason\") or a "
+                f"list of such pairs — the suppression is IGNORED"))
+        else:
+            suppress = dict(entries)
+    for chk in _liveness.bundle_capacity_checks(bundle):
+        if chk.feasible:
+            continue
+        d = Diagnostic(
+            "PTA200", ERROR,
+            f"admission-capacity INFEASIBLE for {chk.resource}: "
+            f"{chk.witness}", var=chk.resource,
+            hint="grow the pool (n_blocks/n_prompt_entries), shrink "
+                 "the workload's distinct session prompts, or let "
+                 "sessions close (close_session releases the pin); "
+                 "serving preflights raise AdmissionInfeasible on "
+                 "this config before any request wedges")
+        reason = suppress.get("PTA200")
+        if reason is not None:
+            if collect_suppressed is not None:
+                collect_suppressed.append((d, reason))
+            continue
+        out.append(d)
     return out
 
 

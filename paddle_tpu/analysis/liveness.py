@@ -1,0 +1,447 @@
+"""The liveness domain: capacity feasibility, release obligations,
+and While-variant proofs.
+
+Three provers, one module (the checkers PTA200/201/202 in
+checkers.py are thin wrappers over these):
+
+* **Admission-capacity feasibility** (PTA200): a declarative resource
+  model of the host serving protocol. Each acquire site the ownership
+  domain already names (absint's tag table) draws from one of two
+  pools — ``HostBlockPool`` blocks or ``PromptPrefixCache`` entries —
+  and the worst-case steady-state demand per serving configuration is
+  arithmetic over the bundle's static shape: ``n_slots`` lanes times
+  ``pages(max_out_len)`` blocks each, one entry per concurrently-live
+  distinct prompt, PLUS one pinned entry per open chat session per
+  DISTINCT session prompt (sessions retain their entry ref for their
+  whole lifetime — ``_harvest_session_locked`` transfers, never
+  releases). Feasible means admission can always eventually make
+  progress; infeasible comes with a concrete witness. The predicate
+  is validated against the exhaustive explorer in
+  analysis/protomodel.py (tests/test_protomodel.py runs the grid), so
+  the static claim inherits proof-up-to-bound strength without
+  enumerating states at lint time.
+
+* **Release-on-every-exit-path** (PTA201): every acquire obligation
+  (an ``AcquireContract`` registered beside the ownership tag it
+  attaches to) must have a registered release SITE on every declared
+  protocol exit path. The sites register from the code that
+  implements them (inference/serving.py module scope), so the ledger
+  names real methods; a tag a program exercises with no contract, or
+  a declared exit with no site, is an unproven obligation.
+
+* **While-variant progress** (PTA202): a While loop terminates when
+  it has a sound variant — a monotone step counter (an ``increment``
+  op with positive step in the condition's backward slice) bounded by
+  a loop-invariant limit (a data feed or trace-time constant). The
+  serve/burst Whiles' second disjunct (the ``lane_active_mask``
+  divergence mark on the condition's producer) rides a NAMED
+  monotone-mask assumption: active lanes only ever retire within a
+  burst, so the mask term is monotone non-increasing and the counter
+  term alone bounds the loop.
+
+Reference counterpart: none — the reference's liveness story is
+runtime watchdogs and PADDLE_ENFORCE timeouts (reference
+framework/operator.cc enforcement tier); proving admission progress
+and release coverage statically is the shared-pool serving-era
+capability this layer adds on top of the PTA190 ownership proofs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from . import absint
+from .dataflow import analyze_block, iter_blocks, iter_ops
+
+__all__ = [
+    "CapacityCheck", "session_feasibility", "bundle_capacity_checks",
+    "bundle_liveness_facts", "obligation_ledger",
+    "unproven_obligations", "WhileVariant", "while_variants",
+    "stable_liveness_facts",
+]
+
+
+# ---------------------------------------------------------------------------
+# PTA200: the capacity model.
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class CapacityCheck:
+    """One resource-pool feasibility verdict: worst-case steady-state
+    ``demand`` against static ``supply``, with a concrete ``witness``
+    sentence when infeasible. Reference counterpart: none (module
+    docstring)."""
+    resource: str
+    demand: int
+    supply: int
+    feasible: bool
+    witness: Optional[str] = None
+
+    def describe(self) -> str:
+        verdict = "feasible" if self.feasible else "INFEASIBLE"
+        return f"{self.resource}: demand {self.demand} <= supply " \
+               f"{self.supply} [{verdict}]" if self.feasible else \
+               f"{self.resource}: demand {self.demand} > supply " \
+               f"{self.supply} [{verdict}]"
+
+
+def session_feasibility(n_prompt_entries: int, distinct_prompts: int,
+                        sessions_close: bool = False,
+                        cold_traffic: bool = False) -> CapacityCheck:
+    """THE session-pinning capacity predicate (the one source of
+    truth — the serving preflight and PTA200 both call this; the
+    protomodel ``session_protocol`` explorer is its oracle). A chat
+    session PINS one ``PromptPrefixCache`` entry per distinct prompt
+    for its whole lifetime; with sessions that never close, steady-
+    state demand is the distinct-session-prompt count (plus one
+    churnable entry when non-session traffic shares the cache), and
+    admission wedges forever the moment demand exceeds the entry
+    pool — no eviction can help because every entry is pinned.
+    Reference counterpart: none (module docstring)."""
+    demand = int(distinct_prompts) + (1 if cold_traffic else 0)
+    supply = int(n_prompt_entries)
+    feasible = bool(sessions_close) or demand <= supply
+    witness = None
+    if not feasible:
+        witness = (
+            f"session-pinning admission deadlock: {distinct_prompts} "
+            f"distinct session prompts"
+            + (" + 1 churn entry for non-session traffic"
+               if cold_traffic else "")
+            + f" each pin a PromptPrefixCache entry for the session "
+              f"lifetime, but n_prompt_entries={n_prompt_entries}; "
+              f"once {n_prompt_entries} sessions are admitted every "
+              f"entry is pinned (refcount>0, unevictable) and every "
+              f"later admission waits forever (protomodel "
+              f"session_protocol finds the minimal wedge trace)")
+    return CapacityCheck("PromptPrefixCache", demand, supply,
+                         feasible, witness)
+
+
+def bundle_capacity_checks(bundle) -> List[CapacityCheck]:
+    """Worst-case steady-state capacity checks for one decode bundle
+    (duck-typed on n_slots/max_out_len/cache; paged layouts only —
+    dense bundles hold no pool resources). Block demand assumes NO
+    radix sharing (sharing only lowers it); entry demand assumes
+    every live lane holds a distinct prompt plus whatever the
+    bundle's declared ``workload`` dict pins through sessions.
+    Reference counterpart: none (module docstring)."""
+    cache = getattr(bundle, "cache", None)
+    if cache is None or getattr(cache, "layout", "dense") != "paged":
+        return []
+    checks: List[CapacityCheck] = []
+    n_slots = int(getattr(bundle, "n_slots", 0))
+    max_out = int(getattr(bundle, "max_out_len", 0))
+    pages = cache.pages(max_out)
+    demand = n_slots * pages
+    feasible = demand <= cache.n_blocks
+    checks.append(CapacityCheck(
+        "HostBlockPool", demand, cache.n_blocks, feasible,
+        None if feasible else (
+            f"{n_slots} lanes x {pages} pages "
+            f"(max_out_len={max_out} / block_size="
+            f"{cache.block_size}) = {demand} blocks exceed "
+            f"n_blocks={cache.n_blocks}: a full admission round "
+            f"cannot allocate its write-reachable chains and decode "
+            f"stalls behind preemption forever")))
+    workload = getattr(bundle, "workload", None)
+    if isinstance(workload, dict) \
+            and "distinct_session_prompts" in workload:
+        checks.append(session_feasibility(
+            cache.n_prompt_entries,
+            int(workload["distinct_session_prompts"]),
+            sessions_close=bool(workload.get("sessions_close",
+                                             False)),
+            cold_traffic=bool(workload.get("cold_traffic", False))))
+    else:
+        # no declared session workload: lanes churn entries, so the
+        # steady-state entry demand is one fresh entry per admission
+        # wave (entries release on retirement) — feasible whenever
+        # the cache has any entry at all.
+        demand = 1 if n_slots else 0
+        checks.append(CapacityCheck(
+            "PromptPrefixCache", demand, cache.n_prompt_entries,
+            demand <= cache.n_prompt_entries,
+            None if demand <= cache.n_prompt_entries else (
+                f"paged serving with n_prompt_entries="
+                f"{cache.n_prompt_entries} cannot admit even one "
+                f"prompt")))
+    return checks
+
+
+def bundle_liveness_facts(bundle) -> Dict[str, str]:
+    """Stable per-bundle capacity facts for the baseline's
+    ``liveness_facts`` section (keys are resource pools — stable by
+    construction). Chunked bundles also record the two-tier schedule
+    bound: a decode tick never waits longer than ONE C-token chunk
+    phase, so prefill progress cannot starve decode progress.
+    Reference counterpart: none (module docstring)."""
+    facts: Dict[str, str] = {}
+    for chk in bundle_capacity_checks(bundle):
+        facts[f"@capacity:{chk.resource}"] = chk.describe()
+    cache = getattr(bundle, "cache", None)
+    if cache is not None and getattr(cache, "chunk_tokens", 0):
+        facts["@decode-wait"] = (
+            f"two-tier schedule: decode tick waits <= one "
+            f"chunk_tokens={cache.chunk_tokens} prefill phase")
+    return facts
+
+
+# ---------------------------------------------------------------------------
+# PTA201: the obligation ledger.
+# ---------------------------------------------------------------------------
+_PROTOCOL_SITES_LOADED = False
+
+
+def _ensure_protocol_sites() -> None:
+    """Import the serving layer so its module-scope
+    ``register_release_site`` calls populate the registry. Lazy and
+    memoized: the analysis package stays importable (and IR-level)
+    without the inference stack; only the ledger needs the real site
+    table, and an import failure surfaces as loudly-missing sites,
+    never a silent pass."""
+    global _PROTOCOL_SITES_LOADED
+    if _PROTOCOL_SITES_LOADED:
+        return
+    try:
+        from ..inference import serving  # noqa: F401
+    except Exception:  # pragma: no cover - loud downstream anyway
+        return  # don't latch: retry on the next ledger build
+    _PROTOCOL_SITES_LOADED = True
+
+
+def obligation_ledger(facts) -> dict:
+    """The per-program acquire/release obligation ledger (mirrors
+    ``ProgramFacts.ownership_ledger``): which contracts the program's
+    pool accesses actually exercise (via their index-provenance
+    tags), which exit paths each is proven on (registered release
+    sites, counted), and which obligations remain unproven — a tag
+    with no contract, or a declared exit with no site. The CLI's
+    --json liveness surface and the CI gate's artifact both read
+    this. Reference counterpart: none (module docstring)."""
+    _ensure_protocol_sites()
+    sources = absint.pool_index_sources()
+    contracts = absint.acquire_contracts()
+    sites = absint.release_sites()
+    used: Dict[str, int] = {}
+    for acc in facts.pool_accesses:
+        fact = acc.index_fact
+        if fact is None:
+            continue
+        for t in fact.tags:
+            src = sources.get(t)
+            if src is None or src.typestate == absint.TS_GATE:
+                continue
+            used[t] = used.get(t, 0) + 1
+    obligations: Dict[str, dict] = {}
+    unproven: List[str] = []
+    for tag in sorted(used):
+        contract = contracts.get(tag)
+        if contract is None:
+            unproven.append(
+                f"{tag}: no acquire/release contract registered "
+                f"(absint.register_acquire_release)")
+            continue
+        exits: Dict[str, List[str]] = {}
+        for exit_path in contract.exits:
+            got = sites.get((tag, exit_path), [])
+            exits[exit_path] = list(got)
+            if not got:
+                unproven.append(
+                    f"{tag}: declared exit path {exit_path!r} has "
+                    f"no registered release site")
+        obligations[tag] = {
+            "resource": contract.resource,
+            "acquire": contract.acquire,
+            "release": contract.release,
+            "sites": used[tag],
+            "exits": exits,
+        }
+    return {"obligations": obligations, "unproven": unproven,
+            "proven": sum(1 for tag in obligations
+                          if not any(u.startswith(f"{tag}:")
+                                     for u in unproven))}
+
+
+def unproven_obligations(facts) -> List[str]:
+    """Just the unproven list (the PTA201 error surface). Reference
+    counterpart: none (module docstring)."""
+    return obligation_ledger(facts)["unproven"]
+
+
+# ---------------------------------------------------------------------------
+# PTA202: While variants.
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class WhileVariant:
+    """One While loop's progress verdict. ``counter`` is the name the
+    in-body ``increment`` op steps; ``bound_terms`` are the FED
+    loop-invariant terminals of the condition's backward slice
+    (feed names only — consts/outer temps bound the variant but
+    carry build-order-dependent names); ``kind`` is "serve" when the
+    condition producer carries the ``lane_active_mask`` divergence
+    mark (the burst-exit disjunct), else "plain"; ``assumption``
+    names the monotone-mask fact serve variants additionally rest
+    on. Reference counterpart: none (module docstring)."""
+    site_key: str
+    anchor: str
+    proven: bool
+    counter: Optional[str]
+    bound_terms: Tuple[str, ...]
+    kind: str
+    assumption: Optional[str] = None
+    detail: Optional[str] = None
+    site: object = None             # the OpSite, for diag anchoring
+
+    def describe(self) -> str:
+        # counter names are auto-generated temps (process-global
+        # build order) — the STABLE description records presence +
+        # the fed bound names only
+        if self.proven:
+            desc = (f"variant[counter bound="
+                    f"{','.join(self.bound_terms) or 'const/outer'}]")
+            if self.assumption:
+                desc += f" +{self.assumption}"
+            return desc
+        return f"UNPROVEN[{self.detail}]"
+
+
+_MONOTONE_MASK_ASSUMPTION = "monotone-lane_active_mask"
+
+
+def _var_of(name: str, name_to_var: dict):
+    return name_to_var.get(name)
+
+
+def while_variants(program) -> List[WhileVariant]:
+    """Prove (or fail to prove) a termination variant for every While
+    in ``program``. The slice walks backward from the body's writer
+    of the Condition var through in-body writers; terminals classify
+    as feed (data var), const (``fill_constant`` producer in the
+    body), state (persistable), or outer (parent-block value — loop-
+    invariant by construction since the body cannot write it). A
+    variant is proven when the slice contains a positive-step
+    ``increment`` AND at least one feed/const/outer bound terminal.
+    Reference counterpart: none (module docstring)."""
+    name_to_var: dict = {}
+    for blk, _ in iter_blocks(program):
+        for name, var in blk.vars.items():
+            name_to_var.setdefault(name, var)
+    out: List[WhileVariant] = []
+    n = 0
+    for site in iter_ops(program):
+        op = site.op
+        if op.type != "while":
+            continue
+        key = f"@while#{n}"
+        n += 1
+        cond_name = op.inputs.get("Condition", [None])[0]
+        body = op.attr("sub_block")
+        if cond_name is None or body is None:
+            out.append(WhileVariant(
+                key, site.anchor(), False, None, (), "plain",
+                detail="no Condition input or sub_block",
+                site=site))
+            continue
+        df = analyze_block(body)
+        writers = df.writers
+        if cond_name not in writers:
+            out.append(WhileVariant(
+                key, site.anchor(), False, None, (), "plain",
+                detail=f"body never recomputes condition "
+                       f"{cond_name!r} — the loop can only spin",
+                site=site))
+            continue
+        cond_writer = body.ops[writers[cond_name][-1]]
+        kind = "plain"
+        assumption = None
+        if cond_writer.attrs.get(absint.DIVERGENCE_ATTR) \
+                == "lane_active_mask":
+            kind = "serve"
+            assumption = _MONOTONE_MASK_ASSUMPTION
+        # backward slice through in-body writers
+        counter = None
+        bound_terms: List[str] = []
+        has_bound = False
+        seen_names: set = set()
+        work = [nm for nm in cond_writer.input_arg_names]
+        visited_ops = {id(cond_writer)}
+        while work:
+            nm = work.pop()
+            if nm in seen_names:
+                continue
+            seen_names.add(nm)
+            idxs = writers.get(nm)
+            if not idxs:
+                var = _var_of(nm, name_to_var)
+                if var is not None and var.is_data:
+                    # only FED names land in bound_terms: feed names
+                    # are author-chosen and stable across builds,
+                    # unlike auto-generated temps in parent blocks
+                    bound_terms.append(nm)
+                    has_bound = True
+                elif var is not None and var.persistable:
+                    # state: the step may rewrite it between runs, so
+                    # it is not a loop-invariant bound (and param
+                    # names would drown the description in noise)
+                    pass
+                else:
+                    # parent-block value: loop-invariant (the body
+                    # cannot write it), so it bounds the variant —
+                    # but its name is usually a temp; record presence
+                    # only
+                    has_bound = True
+                continue
+            producer = body.ops[idxs[-1]]
+            if producer.type == "fill_constant":
+                has_bound = True
+                continue
+            if producer.type == "increment" \
+                    and float(producer.attr("step", 1.0)) > 0:
+                counter = nm
+                continue
+            if id(producer) not in visited_ops:
+                visited_ops.add(id(producer))
+                work.extend(producer.input_arg_names)
+        proven = counter is not None and has_bound
+        detail = None
+        if not proven:
+            missing = []
+            if counter is None:
+                missing.append("no increment-driven counter in the "
+                               "condition slice")
+            if not has_bound:
+                missing.append("no loop-invariant bound terminal "
+                               "(feed/const/outer)")
+            detail = "; ".join(missing)
+        out.append(WhileVariant(
+            key, site.anchor(), proven, counter,
+            tuple(sorted(bound_terms)), kind, assumption, detail,
+            site=site))
+    return out
+
+
+def stable_liveness_facts(facts) -> Dict[str, str]:
+    """Per-program liveness summary over STABLE names for the CI
+    baseline's drift-gated ``liveness_facts`` section: one entry per
+    While (ordinal keys — While count and order are build-determined,
+    not process-global), plus an ``@obligations`` roll-up naming the
+    exercised contracts (mirrors ``stable_ownership_facts``'s
+    ``@assumptions`` convention). Reference counterpart: none
+    (module docstring)."""
+    out: Dict[str, str] = {}
+    for v in while_variants(facts.program):
+        desc = v.describe()
+        if v.kind == "serve":
+            desc = f"serve {desc}"
+        out[v.site_key] = desc
+    ledger = obligation_ledger(facts)
+    if ledger["obligations"]:
+        bits = []
+        for tag, entry in sorted(ledger["obligations"].items()):
+            n_exits = sum(1 for s in entry["exits"].values() if s)
+            bits.append(f"{tag}->{entry['release']}"
+                        f"[{n_exits}/{len(entry['exits'])} exits]")
+        out["@obligations"] = ",".join(bits)
+    if ledger["unproven"]:
+        out["@unproven"] = ";".join(sorted(ledger["unproven"]))
+    return out

@@ -1,0 +1,675 @@
+"""Exhaustive bounded model checking of the host allocator protocol.
+
+The serving layer's correctness story so far rests on three legs:
+typestate machines that RAISE on bad transitions (``HostBlockPool``/
+``PromptPrefixCache``/``RadixBlockTree`` — ``BlockLifetimeError``),
+randomized property traces over them (tests/test_block_pool_model.py),
+and the static ownership prover (PTA190-192). None of those is a
+LIVENESS argument: a protocol can pass every random trace and still
+have an interleaving that wedges admissions forever (the session-pin
+deadlock CLAUDE.md documents in prose) or leaks a refcount on one rare
+exit path. This module closes that gap with the smallest tool that
+actually proves something: an exhaustive breadth-first explorer over
+the REAL allocator classes at small bounds. Every reachable
+interleaving of a modeled protocol is visited; invariants are checked
+in every state; a drain obligation ("after everyone retires, the pool
+is all-free") is checked from every state; and because the search is
+BFS, the first violation found carries a MINIMAL action trace — a
+counterexample a human can replay by hand.
+
+This is the oracle the PTA200 admission-capacity model (analysis/
+liveness.py) is validated against: the declarative feasibility
+predicate and the explorer must agree on every small configuration
+(tests/test_protomodel.py runs the cross-validation grid), which is
+what licenses the static checker to claim "provably infeasible"
+without enumerating states at lint time.
+
+Design notes:
+
+* States drive the REAL classes from models/decode_engine.py (lazy
+  imports inside the builders keep this module importable without the
+  models package — the analysis-never-imports-models discipline holds
+  at module level). A seeded bug in an allocator therefore fails HERE,
+  not just in a hand-written abstraction of it.
+* ``fingerprint`` canonicalizes allocator internals INCLUDING
+  free-list order (alloc pops from the tail, so order is semantics).
+* Violation kinds: ``invariant`` (a state predicate failed),
+  ``lifetime`` (the allocator itself raised ``BlockLifetimeError`` —
+  the typestate machine caught a protocol bug), ``deadlock`` (work
+  outstanding, no action enabled), ``leak`` (the drain obligation
+  failed: retiring everything did not return the pool to all-free).
+
+Reference counterpart: none — the reference framework's allocator
+checks are runtime asserts (reference paddle/fluid/framework/scope.cc,
+memory/ allocators); an exhaustive protocol-state explorer is the
+de-risking capability the shared-pool serving era needs instead.
+"""
+from __future__ import annotations
+
+import copy
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Action", "Violation", "Result", "Protocol", "explore",
+    "pool_fingerprint", "cache_fingerprint", "tree_fingerprint",
+    "block_pool_protocol", "prefix_cache_protocol", "radix_protocol",
+    "session_protocol", "session_feasible",
+]
+
+
+def _lifetime_error():
+    from ..models.decode_engine import BlockLifetimeError
+
+    return BlockLifetimeError
+
+
+@dataclass(frozen=True)
+class Action:
+    """One protocol move: enabled iff ``guard(state)``; ``effect``
+    mutates the state in place (the explorer deep-copies first).
+    Reference counterpart: none (module docstring)."""
+    name: str
+    guard: Callable[[dict], bool]
+    effect: Callable[[dict], None]
+
+
+@dataclass(frozen=True)
+class Violation:
+    """A counterexample: the MINIMAL (BFS) action trace reaching it.
+    Reference counterpart: none (module docstring)."""
+    kind: str                  # invariant | lifetime | deadlock | leak
+    trace: Tuple[str, ...]
+    detail: str
+
+    def format(self) -> str:
+        steps = " -> ".join(self.trace) if self.trace else "(initial)"
+        return f"[{self.kind}] after {steps}: {self.detail}"
+
+
+@dataclass(frozen=True)
+class Result:
+    """Outcome of one bounded exploration. ``ok`` means every reached
+    state satisfied every obligation AND the search was exhaustive
+    within ``max_states`` (``truncated`` reports a hit bound — a
+    truncated green run is a weaker claim and tests must assert
+    ``not truncated``). Reference counterpart: none."""
+    ok: bool
+    n_states: int
+    n_transitions: int
+    truncated: bool
+    counterexample: Optional[Violation]
+
+
+@dataclass
+class Protocol:
+    """A bounded protocol machine: initial-state factory, action
+    alphabet, state invariants (name, fn -> None-or-detail), a
+    canonicalizing fingerprint, an ``accepting`` predicate (states
+    where having NO enabled action is fine — omitted means every
+    stuck state is acceptable, i.e. pure safety checking), and an
+    optional ``drain`` obligation run on a COPY of every state (return
+    a detail string when the everything-retires unwinding leaks).
+    Deliberately mutable so tests can swap one action's effect to
+    seed a bug (the dropped-decref mutation test).
+    Reference counterpart: none (module docstring)."""
+    name: str
+    make_init: Callable[[], dict]
+    actions: List[Action]
+    invariants: List[Tuple[str, Callable[[dict], Optional[str]]]] = \
+        field(default_factory=list)
+    fingerprint: Callable[[dict], object] = lambda s: repr(s)
+    accepting: Optional[Callable[[dict], bool]] = None
+    drain: Optional[Callable[[dict], Optional[str]]] = None
+
+
+def explore(proto: Protocol, max_states: int = 20000) -> Result:
+    """Exhaustive BFS over ``proto``'s reachable states (up to
+    ``max_states`` distinct fingerprints). Checks every invariant and
+    the drain obligation in every newly discovered state, runs every
+    enabled action from every state (catching ``BlockLifetimeError``
+    as a lifetime violation), and flags deadlock on non-accepting
+    stuck states. BFS guarantees the returned counterexample trace is
+    minimal in action count. Reference counterpart: none."""
+    LifetimeError = _lifetime_error()
+    n_transitions = 0
+    truncated = False
+
+    def check(state, trace) -> Optional[Violation]:
+        for name, inv in proto.invariants:
+            detail = inv(state)
+            if detail:
+                return Violation("invariant", trace,
+                                 f"{name}: {detail}")
+        if proto.drain is not None:
+            try:
+                detail = proto.drain(copy.deepcopy(state))
+            except LifetimeError as e:
+                return Violation("lifetime", trace,
+                                 f"drain raised: {e}")
+            if detail:
+                return Violation("leak", trace, detail)
+        return None
+
+    def result(n_states, violation):
+        return Result(violation is None and not truncated, n_states,
+                      n_transitions, truncated, violation)
+
+    init = proto.make_init()
+    seen = {proto.fingerprint(init)}
+    queue = deque([(init, ())])
+    n_states = 1
+    v = check(init, ())
+    if v is not None:
+        return result(n_states, v)
+    while queue:
+        state, trace = queue.popleft()
+        enabled = [a for a in proto.actions if a.guard(state)]
+        if not enabled:
+            if proto.accepting is not None \
+                    and not proto.accepting(state):
+                return result(n_states, Violation(
+                    "deadlock", trace,
+                    f"{proto.name}: work outstanding but no action "
+                    f"enabled"))
+            continue
+        for a in enabled:
+            nxt = copy.deepcopy(state)
+            try:
+                a.effect(nxt)
+            except LifetimeError as e:
+                return result(n_states, Violation(
+                    "lifetime", trace + (a.name,), str(e)))
+            n_transitions += 1
+            key = proto.fingerprint(nxt)
+            if key in seen:
+                continue
+            if n_states >= max_states:
+                truncated = True
+                continue
+            seen.add(key)
+            n_states += 1
+            v = check(nxt, trace + (a.name,))
+            if v is not None:
+                return result(n_states, v)
+            queue.append((nxt, trace + (a.name,)))
+    return result(n_states, None)
+
+
+# ---------------------------------------------------------------------------
+# Canonical fingerprints (free-list ORDER is semantics: alloc pops the
+# tail, so two states differing only in list order can diverge later).
+# ---------------------------------------------------------------------------
+def pool_fingerprint(pool) -> tuple:
+    """Canonical tuple of a ``HostBlockPool``'s full internal state.
+    Reference counterpart: none (module docstring)."""
+    return (tuple(pool._free), tuple(pool._state), tuple(pool._refs))
+
+
+def cache_fingerprint(cache) -> tuple:
+    """Canonical tuple of a ``PromptPrefixCache``'s full internal
+    state (LRU insertion order included — eviction order is
+    semantics). Reference counterpart: none."""
+    return (tuple(cache._free),
+            tuple(sorted(cache._by_prompt.items())),
+            tuple(sorted((e, r) for e, r in cache._refs.items())),
+            tuple(cache._lru),
+            tuple(sorted(cache._heads.items())))
+
+
+def tree_fingerprint(tree) -> tuple:
+    """Canonical tuple of a ``RadixBlockTree``'s node structure.
+    Reference counterpart: none."""
+    def node_fp(n):
+        return (n.chunk, n.block,
+                tuple(sorted((k, node_fp(c))
+                             for k, c in n.children.items())))
+
+    return tuple(sorted((k, node_fp(r))
+                        for k, r in tree._roots.items()))
+
+
+def _conservation(pool, holds: Dict[int, int]) -> Optional[str]:
+    """Refcount conservation vs an explicit hold count per block, plus
+    free-list/typestate consistency."""
+    for b in range(pool.n_blocks):
+        want = holds.get(b, 0)
+        if pool._refs[b] != want:
+            return (f"block {b}: refcount {pool._refs[b]} != "
+                    f"{want} tracked holds")
+        st = pool._state[b]
+        if (st == "free") != (pool._refs[b] == 0):
+            return f"block {b}: typestate {st!r} at refcount " \
+                   f"{pool._refs[b]}"
+    if sorted(pool._free) != sorted(
+            b for b in range(pool.n_blocks) if pool._refs[b] == 0):
+        return f"free list {pool._free} disagrees with refcounts"
+    if len(set(pool._free)) != len(pool._free):
+        return f"free list {pool._free} has duplicates"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Protocol builders over the real allocator classes.
+# ---------------------------------------------------------------------------
+def block_pool_protocol(n_blocks: int = 2, n_lanes: int = 2,
+                        pages: int = 1) -> Protocol:
+    """Lanes alloc exclusive chains (up to ``pages`` blocks), adopt
+    each other's live blocks read-only (incref — the radix-share
+    shape), drop shares, and retire (decref everything — the
+    ``_free_lane_locked`` unwinding). Invariant: pool refcounts ==
+    tracked holds; drain: after every lane retires the pool is
+    all-free. Reference counterpart: none (module docstring)."""
+    from ..models.decode_engine import HostBlockPool
+
+    def make_init():
+        return {"pool": HostBlockPool(n_blocks),
+                "lanes": [{"blocks": [], "shared": []}
+                          for _ in range(n_lanes)]}
+
+    def retire(lane, pool):
+        for b in reversed(lane["shared"]):
+            pool.decref(b)
+        for b in reversed(lane["blocks"]):
+            pool.decref(b)
+        lane["blocks"], lane["shared"] = [], []
+
+    actions: List[Action] = []
+    for li in range(n_lanes):
+        def alloc(s, li=li):
+            lane = s["lanes"][li]
+            lane["blocks"].append(s["pool"].alloc())
+
+        actions.append(Action(
+            f"alloc[{li}]",
+            lambda s, li=li: (len(s["lanes"][li]["blocks"]) < pages
+                              and s["pool"].free_count > 0),
+            alloc))
+        for b in range(n_blocks):
+            def adopt(s, li=li, b=b):
+                s["pool"].incref(b)
+                s["lanes"][li]["shared"].append(b)
+
+            actions.append(Action(
+                f"adopt[{li},{b}]",
+                lambda s, li=li, b=b: (
+                    s["pool"].refcount(b) >= 1
+                    and b not in s["lanes"][li]["shared"]
+                    and b not in s["lanes"][li]["blocks"]),
+                adopt))
+
+        def drop(s, li=li):
+            lane = s["lanes"][li]
+            s["pool"].decref(lane["shared"].pop())
+
+        actions.append(Action(
+            f"drop[{li}]",
+            lambda s, li=li: bool(s["lanes"][li]["shared"]),
+            drop))
+
+        def do_retire(s, li=li):
+            retire(s["lanes"][li], s["pool"])
+
+        actions.append(Action(
+            f"retire[{li}]",
+            lambda s, li=li: bool(s["lanes"][li]["blocks"]
+                                  or s["lanes"][li]["shared"]),
+            do_retire))
+
+    def holds_of(s):
+        holds: Dict[int, int] = {}
+        for lane in s["lanes"]:
+            for b in lane["blocks"]:
+                holds[b] = holds.get(b, 0) + 1
+            for b in lane["shared"]:
+                holds[b] = holds.get(b, 0) + 1
+        return holds
+
+    def conserve(s):
+        return _conservation(s["pool"], holds_of(s))
+
+    def drain(s):
+        for lane in s["lanes"]:
+            retire(lane, s["pool"])
+        if s["pool"].free_count != n_blocks:
+            return (f"after full retirement {s['pool'].free_count}/"
+                    f"{n_blocks} blocks free: "
+                    f"{n_blocks - s['pool'].free_count} leaked")
+        return None
+
+    return Protocol(
+        name=f"block_pool(n={n_blocks},lanes={n_lanes},pages={pages})",
+        make_init=make_init, actions=actions,
+        invariants=[("refcount-conservation", conserve)],
+        fingerprint=lambda s: (
+            pool_fingerprint(s["pool"]),
+            tuple((tuple(l["blocks"]), tuple(l["shared"]))
+                  for l in s["lanes"])),
+        drain=drain)
+
+
+def _cache_can_acquire(cache, prompt) -> bool:
+    """Acquire succeeds iff hit, a free slot, or an unpinned mapped
+    entry to evict (mirrors ``acquire_fresh``'s None contract)."""
+    kind, _ = cache.lookup(prompt)
+    if kind == "hit":
+        return True
+    if cache._free:
+        return True
+    return any(cache._refs.get(cache._by_prompt[p], 0) == 0
+               for p in cache._lru)
+
+
+def _cache_acquire(cache, prompt) -> int:
+    kind, _ = cache.lookup(prompt)
+    if kind == "hit":
+        return cache.acquire_hit(prompt)
+    entry = cache.acquire_fresh(prompt, partial=(kind == "partial"))
+    assert entry is not None, "guard must ensure acquirability"
+    return entry
+
+
+def prefix_cache_protocol(n_entries: int = 1, n_prompts: int = 2,
+                          n_clients: int = 2,
+                          with_abort: bool = True) -> Protocol:
+    """Clients acquire prompt entries (hit/fresh/evict — the admission
+    path), release them (retirement), and — when ``with_abort`` —
+    invalidate unpinned entries (the abandoned-prefill abort path).
+    Invariant: per-entry refcount == client holds and slot
+    conservation; drain: release everything, invalidate every mapped
+    entry, free list must be full. Reference counterpart: none."""
+    from ..models.decode_engine import PromptPrefixCache
+
+    prompts = [(i,) for i in range(n_prompts)]
+
+    def make_init():
+        return {"cache": PromptPrefixCache(n_entries, 1),
+                "clients": [None] * n_clients}
+
+    actions: List[Action] = []
+    for ci in range(n_clients):
+        for p in prompts:
+            def acquire(s, ci=ci, p=p):
+                s["clients"][ci] = _cache_acquire(s["cache"], p)
+
+            actions.append(Action(
+                f"acquire[{ci},{p[0]}]",
+                lambda s, ci=ci, p=p: (
+                    s["clients"][ci] is None
+                    and _cache_can_acquire(s["cache"], p)),
+                acquire))
+
+        def release(s, ci=ci):
+            s["cache"].release(s["clients"][ci])
+            s["clients"][ci] = None
+
+        actions.append(Action(
+            f"release[{ci}]",
+            lambda s, ci=ci: s["clients"][ci] is not None,
+            release))
+    if with_abort:
+        for p in prompts:
+            def invalidate(s, p=p):
+                s["cache"].invalidate(s["cache"]._by_prompt[p])
+
+            actions.append(Action(
+                f"invalidate[{p[0]}]",
+                lambda s, p=p: (
+                    p in s["cache"]._by_prompt
+                    and s["cache"].refcount(
+                        s["cache"]._by_prompt[p]) == 0),
+                invalidate))
+
+    def conserve(s):
+        cache = s["cache"]
+        holds: Dict[int, int] = {}
+        for e in s["clients"]:
+            if e is not None:
+                holds[e] = holds.get(e, 0) + 1
+        for e in range(n_entries):
+            if cache.refcount(e) != holds.get(e, 0):
+                return (f"entry {e}: refcount {cache.refcount(e)} "
+                        f"!= {holds.get(e, 0)} client holds")
+        if len(cache._free) + len(cache._entry_prompt) != n_entries:
+            return (f"slot conservation: {len(cache._free)} free + "
+                    f"{len(cache._entry_prompt)} mapped != "
+                    f"{n_entries}")
+        return None
+
+    def drain(s):
+        cache = s["cache"]
+        for ci, e in enumerate(s["clients"]):
+            if e is not None:
+                cache.release(e)
+                s["clients"][ci] = None
+        for e in list(cache._entry_prompt):
+            cache.invalidate(e)
+        if len(cache._free) != n_entries:
+            return (f"after release+invalidate of everything "
+                    f"{len(cache._free)}/{n_entries} slots free")
+        return None
+
+    return Protocol(
+        name=f"prefix_cache(entries={n_entries},prompts={n_prompts},"
+             f"clients={n_clients})",
+        make_init=make_init, actions=actions,
+        invariants=[("entry-refcount-conservation", conserve)],
+        fingerprint=lambda s: (cache_fingerprint(s["cache"]),
+                               tuple(s["clients"])),
+        drain=drain)
+
+
+def radix_protocol(n_blocks: int = 3, n_lanes: int = 2,
+                   seqs: Tuple[tuple, ...] = ((7,), (7, 8))
+                   ) -> Protocol:
+    """Lanes fill exclusive chains for token sequences, insert them
+    into the radix tree (tree takes its OWN incref per adopted node),
+    admit via the shared-prefix hit path (``acquire`` increfs), retire
+    (release shared + decref own — the radix-aware
+    ``_free_lane_locked``), and the tree evicts refcount-1 leaves
+    under pressure. Invariant: refcounts == lane holds + tree
+    adoptions; drain: retire all lanes, evict the whole tree, pool
+    all-free. Block size 1, one shared prompt ``(1,)`` (the tree keys
+    chains by prompt content). Reference counterpart: none."""
+    from ..models.decode_engine import HostBlockPool, RadixBlockTree
+
+    prompt = (1,)
+
+    def make_init():
+        pool = HostBlockPool(n_blocks)
+        return {"pool": pool, "tree": RadixBlockTree(pool, 1),
+                "lanes": [{"blocks": [], "shared": [], "tokens": None,
+                           "inserted": False}
+                          for _ in range(n_lanes)]}
+
+    def lane_idle(lane):
+        return lane["tokens"] is None and not lane["blocks"] \
+            and not lane["shared"]
+
+    def retire(lane, tree, pool):
+        tree.release(lane["shared"])
+        for b in reversed(lane["blocks"]):
+            pool.decref(b)
+        lane.update(blocks=[], shared=[], tokens=None,
+                    inserted=False)
+
+    actions: List[Action] = []
+    for li in range(n_lanes):
+        for s_i, seq in enumerate(seqs):
+            def fill(s, li=li, seq=seq):
+                lane = s["lanes"][li]
+                lane["blocks"] = [s["pool"].alloc() for _ in seq]
+                lane["tokens"] = seq
+
+            actions.append(Action(
+                f"fill[{li},{s_i}]",
+                lambda s, li=li, seq=seq: (
+                    lane_idle(s["lanes"][li])
+                    and s["pool"].free_count >= len(seq)),
+                fill))
+
+            def hit(s, li=li, seq=seq):
+                lane = s["lanes"][li]
+                lane["shared"] = s["tree"].acquire(prompt, seq)
+                lane["tokens"] = seq
+
+            actions.append(Action(
+                f"hit[{li},{s_i}]",
+                lambda s, li=li, seq=seq: (
+                    lane_idle(s["lanes"][li])
+                    and s["tree"].match(prompt, seq) > 0),
+                hit))
+
+        def insert(s, li=li):
+            lane = s["lanes"][li]
+            s["tree"].insert(prompt, lane["tokens"], lane["blocks"])
+            lane["inserted"] = True
+
+        actions.append(Action(
+            f"insert[{li}]",
+            lambda s, li=li: (s["lanes"][li]["tokens"] is not None
+                              and bool(s["lanes"][li]["blocks"])
+                              and not s["lanes"][li]["inserted"]),
+            insert))
+
+        def do_retire(s, li=li):
+            retire(s["lanes"][li], s["tree"], s["pool"])
+
+        actions.append(Action(
+            f"retire[{li}]",
+            lambda s, li=li: s["lanes"][li]["tokens"] is not None,
+            do_retire))
+
+    def evict(s):
+        s["tree"].evict(1)
+
+    actions.append(Action(
+        "evict",
+        lambda s: bool(s["tree"]._roots),
+        evict))
+
+    def conserve(s):
+        holds: Dict[int, int] = {}
+        for lane in s["lanes"]:
+            for b in lane["blocks"]:
+                holds[b] = holds.get(b, 0) + 1
+            for b in lane["shared"]:
+                holds[b] = holds.get(b, 0) + 1
+        for b in s["tree"].tree_blocks():
+            holds[b] = holds.get(b, 0) + 1
+        return _conservation(s["pool"], holds)
+
+    def drain(s):
+        for lane in s["lanes"]:
+            if lane["tokens"] is not None:
+                retire(lane, s["tree"], s["pool"])
+        while s["tree"].evict(n_blocks):
+            pass
+        if s["pool"].free_count != n_blocks:
+            return (f"after retire+evict of everything "
+                    f"{s['pool'].free_count}/{n_blocks} blocks free")
+        return None
+
+    return Protocol(
+        name=f"radix(n={n_blocks},lanes={n_lanes})",
+        make_init=make_init, actions=actions,
+        invariants=[("refcount-conservation", conserve)],
+        fingerprint=lambda s: (
+            pool_fingerprint(s["pool"]), tree_fingerprint(s["tree"]),
+            tuple((tuple(l["blocks"]), tuple(l["shared"]),
+                   l["tokens"], l["inserted"])
+                  for l in s["lanes"])),
+        drain=drain)
+
+
+def session_feasible(n_entries: int, n_prompts: int,
+                     allow_close: bool) -> bool:
+    """The declarative PTA200 session-capacity predicate this
+    module's explorer validates: sessions PIN one prompt entry per
+    DISTINCT prompt for their whole lifetime, so admission stays
+    live iff sessions can close or the distinct-prompt count fits the
+    entry pool. Reference counterpart: none."""
+    return allow_close or n_prompts <= n_entries
+
+
+def session_protocol(n_entries: int, n_prompts: int,
+                     allow_close: bool = False) -> Protocol:
+    """The session-pinning machine (the CLAUDE.md radix-rules
+    deadlock, now mechanized): one session per distinct prompt, each
+    needing exactly one turn. ``admit`` acquires the prompt entry
+    (``_plan_admissions_locked``), ``harvest`` transfers the entry
+    pin from the lane to the session (``_harvest_session_locked`` —
+    the ref is RETAINED), ``close`` (only when ``allow_close``)
+    releases it (``close_session``). A state where some session still
+    wants its turn but nothing is enabled is the admission deadlock;
+    with ``n_prompts > n_entries`` and no close the explorer finds it
+    with a minimal trace, and ``session_feasible`` must agree on
+    every configuration. Reference counterpart: none."""
+    from ..models.decode_engine import PromptPrefixCache
+
+    def make_init():
+        return {"cache": PromptPrefixCache(n_entries, 1),
+                "sessions": [{"st": "want", "entry": None}
+                             for _ in range(n_prompts)]}
+
+    actions: List[Action] = []
+    for si in range(n_prompts):
+        p = (si,)
+
+        def admit(s, si=si, p=p):
+            sess = s["sessions"][si]
+            sess["entry"] = _cache_acquire(s["cache"], p)
+            sess["st"] = "active"
+
+        actions.append(Action(
+            f"admit[{si}]",
+            lambda s, si=si, p=p: (
+                s["sessions"][si]["st"] == "want"
+                and _cache_can_acquire(s["cache"], p)),
+            admit))
+
+        def harvest(s, si=si):
+            s["sessions"][si]["st"] = "pinned"
+
+        actions.append(Action(
+            f"harvest[{si}]",
+            lambda s, si=si: s["sessions"][si]["st"] == "active",
+            harvest))
+        if allow_close:
+            def close(s, si=si):
+                sess = s["sessions"][si]
+                s["cache"].release(sess["entry"])
+                sess.update(st="closed", entry=None)
+
+            actions.append(Action(
+                f"close[{si}]",
+                lambda s, si=si: s["sessions"][si]["st"] == "pinned",
+                close))
+
+    def conserve(s):
+        holds: Dict[int, int] = {}
+        for sess in s["sessions"]:
+            if sess["entry"] is not None:
+                holds[sess["entry"]] = holds.get(sess["entry"], 0) + 1
+        for e in range(n_entries):
+            if s["cache"].refcount(e) != holds.get(e, 0):
+                return (f"entry {e}: refcount "
+                        f"{s['cache'].refcount(e)} != "
+                        f"{holds.get(e, 0)} session pins")
+        return None
+
+    def accepting(s):
+        return all(sess["st"] not in ("want", "active")
+                   for sess in s["sessions"])
+
+    return Protocol(
+        name=f"session(entries={n_entries},prompts={n_prompts},"
+             f"close={allow_close})",
+        make_init=make_init, actions=actions,
+        invariants=[("pin-refcount-conservation", conserve)],
+        fingerprint=lambda s: (
+            cache_fingerprint(s["cache"]),
+            tuple((sess["st"], sess["entry"])
+                  for sess in s["sessions"])),
+        accepting=accepting)

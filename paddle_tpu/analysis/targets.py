@@ -24,6 +24,7 @@ per-program sweep cannot see cross-specialization disagreements.
 """
 from __future__ import annotations
 
+import copy
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Tuple
 
@@ -146,6 +147,19 @@ def _transformer():
         cache=CacheConfig(layout="paged", block_size=4, n_blocks=8,
                           n_prompt_entries=3, chunk_tokens=4), **dkw)
     ckph = len(chunked.chunk_phase_keys) - 1
+    # deliberately-misconfigured capacity wedge (PTA200): 5 distinct
+    # never-closing session prompts against 3 pinnable prompt entries
+    # is the session-pinning admission deadlock the protomodel proves
+    # (protomodel.session_protocol) — the zoo keeps it as a COUNTED
+    # suppressed witness so the checker's positive case is regression-
+    # gated without turning the strict gate red
+    wedge = copy.copy(paged)
+    wedge.workload = {"distinct_session_prompts": 5,
+                      "sessions_close": False}
+    wedge._pta_suppress = (
+        ("PTA200", "deliberate witness: session-pinning deadlock "
+                   "(5 pinned prompts > 3 entries) kept as the "
+                   "PTA200 regression wedge"),)
     return ({"main": main, "startup": startup, "greedy": greedy[0],
              "incremental": incr[0], "beam": beam[0],
              "cb_prefill": bundle.prefill,
@@ -194,7 +208,7 @@ def _transformer():
             # whole-bundle contract sweep (PTA150): every bundle the
             # repo ships, checked as a unit
             {"cb": bundle, "pg": paged, "sp": spec, "sps": pspec,
-             "smp": sampled, "ck": chunked})
+             "smp": sampled, "ck": chunked, "pg_wedge": wedge})
 
 
 def _moe_transformer():

@@ -20,7 +20,7 @@ op_info.h, grad_op_desc_maker.h). Differences driven by XLA:
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -150,6 +150,75 @@ def has_index_rule(op_type: str) -> bool:
 
 def index_rule_types() -> List[str]:
     return sorted(_INDEX_RULES)
+
+
+# ownership tag -> acquire/release CONTRACT for the analysis layer's
+# liveness domain (analysis/liveness.py). Where the index rules above
+# prove WHERE a pool index came from, a contract declares the
+# obligation that acquiring through that tag creates — which host
+# call mints the hold, which call discharges it, and the exhaustive
+# set of protocol exit paths on which the discharge must be proven to
+# run (normal retirement, preemption, abort, invalidate, session
+# close, server close, future cancel). PTA201 walks these: a tag a
+# program actually exercises with NO contract, or a declared exit
+# path with NO registered release site, is an unproven obligation —
+# an error, never a silent pass. Contracts register via
+# absint.register_acquire_release (which validates the tag against
+# the ownership-source table); release SITES register from the code
+# that implements them (inference/serving.py) so the ledger names
+# real methods, not prose.
+_ACQUIRE_CONTRACTS: Dict[str, object] = {}
+
+# (tag, exit_path) -> list of "module.method" site strings proving
+# the release runs on that path.
+_RELEASE_SITES: Dict[Tuple[str, str], List[str]] = {}
+
+
+def register_acquire_contract(tag: str, contract: object) -> None:
+    """Register the acquire/release contract for an ownership tag.
+    Idempotent on identical re-registration; raises on a DIFFERING
+    redefinition (two subsystems disagreeing about an obligation is
+    a bug, not a merge).
+
+    Reference counterpart: none — the reference frees at runtime via
+    GC passes (reference framework/executor_gc.md); a static
+    obligation registry is the proof-tier analogue.
+    """
+    prev = _ACQUIRE_CONTRACTS.get(tag)
+    if prev is not None:
+        if prev == contract:
+            return
+        raise ValueError(
+            f"acquire contract for {tag!r} already registered with "
+            f"different terms: {prev} vs {contract}")
+    _ACQUIRE_CONTRACTS[tag] = contract
+
+
+def get_acquire_contract(tag: str):
+    return _ACQUIRE_CONTRACTS.get(tag)
+
+
+def acquire_contracts() -> Dict[str, object]:
+    return dict(_ACQUIRE_CONTRACTS)
+
+
+def register_release_site(tag: str, exit_path: str,
+                          site: str) -> None:
+    """Record that `site` (a "Class.method" string in the serving
+    layer) discharges `tag`'s obligation on `exit_path`. Append-only
+    and idempotent per site. Validation that the tag has a contract
+    and declares the exit lives in absint.register_release_site (the
+    public wrapper) — this is the bare store.
+
+    Reference counterpart: none (see register_acquire_contract).
+    """
+    sites = _RELEASE_SITES.setdefault((tag, exit_path), [])
+    if site not in sites:
+        sites.append(site)
+
+
+def release_sites() -> Dict[Tuple[str, str], List[str]]:
+    return {k: list(v) for k, v in _RELEASE_SITES.items()}
 
 
 def kernel_bridges_host(fn: Callable) -> bool:

@@ -18,7 +18,8 @@ from .export import (StableHLOServer, StableHLOTrainer,
                      load_stablehlo, load_train_stablehlo)
 from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
-from .serving import (BlockPoolExhausted, ContinuousGenerationServer,
+from .serving import (AdmissionInfeasible, BlockPoolExhausted,
+                      ContinuousGenerationServer,
                       GenerationServer, InferenceServer,
                       PagedBeamDecoder,
                       PagedContinuousGenerationServer, ServerClosed,
@@ -35,7 +36,7 @@ __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "load_train_stablehlo", "InferenceServer",
            "GenerationServer", "ContinuousGenerationServer",
            "PagedContinuousGenerationServer", "PagedBeamDecoder",
-           "BlockPoolExhausted",
+           "BlockPoolExhausted", "AdmissionInfeasible",
            "ServerClosed", "ServerQuiesced", "apply_eos_sentinel",
            "count_generated_tokens", "default_batch_buckets",
            "ServingRuntime", "ModelRegistry", "Router",

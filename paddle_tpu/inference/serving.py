@@ -65,8 +65,10 @@ import numpy as np
 from ..core.executor import Executor, PreparedCache, TPUPlace
 from ..core.scope import Scope, global_scope
 from ..core.types import to_np_dtype
+from ..analysis import absint as _absint
 from ..models.decode_engine import POOL_MARK as dec_POOL_MARK
-from ..models.decode_engine import (BlockLifetimeError,
+from ..models.decode_engine import (AdmissionInfeasible,
+                                    BlockLifetimeError,
                                     BlockPoolExhausted, HostBlockPool,
                                     PromptPrefixCache, RadixBlockTree)
 from ..observability import costmodel as obs_costmodel
@@ -1900,6 +1902,28 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 "paged serving owns admission order (prefix-tier "
                 "grouping); admit_select hooks are not supported")
         self.cache = cache
+        # PTA200 preflight: a bundle DECLARING its session workload
+        # (bundle.workload = {"distinct_session_prompts": K, ...})
+        # gets the capacity model's verdict at construction — a
+        # provably-infeasible config raises the named, non-retryable
+        # AdmissionInfeasible here instead of wedging admissions at
+        # runtime (the same predicate the zoo gate's PTA200 checker
+        # and the per-submit session preflight evaluate; the
+        # protomodel explorer is its oracle)
+        workload = getattr(bundle, "workload", None)
+        if isinstance(workload, dict) \
+                and "distinct_session_prompts" in workload:
+            from ..analysis.liveness import session_feasibility
+
+            chk = session_feasibility(
+                cache.n_prompt_entries,
+                int(workload["distinct_session_prompts"]),
+                sessions_close=bool(workload.get("sessions_close",
+                                                 False)),
+                cold_traffic=bool(workload.get("cold_traffic",
+                                               False)))
+            if not chk.feasible:
+                raise AdmissionInfeasible(chk.witness)
         self._bs = cache.block_size
         self._blocks = HostBlockPool(cache.n_blocks)
         self._prefix = PromptPrefixCache(cache.n_prompt_entries,
@@ -2027,6 +2051,29 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
                 raise ValueError(
                     f"session {session_id!r} has no retired turn to "
                     f"extend; submit its first turn plain")
+            # PTA200 dynamic preflight: every open session pins one
+            # PromptPrefixCache entry per DISTINCT prompt for its
+            # lifetime; admitting a session that pushes the distinct
+            # count past the entry pool can NEVER be satisfied until
+            # some session closes (pinned entries are unevictable),
+            # so raise the named verdict now instead of deadlocking
+            # admissions later (== is feasible; close_session frees
+            # capacity)
+            open_prompts = {s["prompt"]
+                            for s in self._sessions.values()}
+            open_prompts.add(prompt)
+            from ..analysis.liveness import session_feasibility
+
+            chk = session_feasibility(self.cache.n_prompt_entries,
+                                      len(open_prompts))
+            if not chk.feasible:
+                raise AdmissionInfeasible(
+                    f"opening session {session_id!r} would pin "
+                    f"{len(open_prompts)} distinct prompts against "
+                    f"n_prompt_entries="
+                    f"{self.cache.n_prompt_entries}; close a "
+                    f"session (close_session) or grow the entry "
+                    f"pool. {chk.witness}")
             self._sessions[session_id] = {
                 "prompt": prompt, "hist": None, "entry": None,
                 "turns": 0}
@@ -3457,10 +3504,70 @@ def apply_eos_sentinel(tokens: np.ndarray,
     return toks
 
 
+# --- PTA201 release-site registrations (the liveness domain) ---------------
+# Every acquire contract absint declares gets its release SITES
+# registered HERE, from the module that implements them, so the
+# obligation ledger names real methods. The exit-path vocabulary is
+# the contract's (absint.py); adding a protocol exit (the front-door
+# "cancel") means extending the contract AND registering its site —
+# PTA201 flags every tag until both halves land.
+_P = "PagedContinuousGenerationServer"
+for _tag in ("block_table", "cow_dst"):
+    # lane-exclusive block chains: reversed decref in retirement,
+    # the same unwinding on preemption/close
+    _absint.register_release_site(_tag, "retire",
+                                  f"{_P}._free_lane_locked")
+    _absint.register_release_site(_tag, "preempt",
+                                  f"{_P}._plan_burst_locked")
+    _absint.register_release_site(_tag, "server_close",
+                                  f"{_P}._flush_requests_locked")
+# radix-shared chains: tree-aware release on every lane exit, plus
+# the watermark/pressure eviction rungs dropping the tree's own refs
+_absint.register_release_site("cow_src", "retire",
+                              f"{_P}._free_lane_locked")
+_absint.register_release_site("cow_src", "preempt",
+                              f"{_P}._plan_burst_locked")
+_absint.register_release_site("cow_src", "evict",
+                              f"{_P}._alloc_block_locked")
+_absint.register_release_site("cow_src", "server_close",
+                              f"{_P}._flush_requests_locked")
+# fresh prompt entries: released on retirement, on admission backout
+# (invalidate), on abandoned-prefill abort, and at close
+_absint.register_release_site("host_indices", "retire",
+                              f"{_P}._free_lane_locked")
+_absint.register_release_site("host_indices", "abort",
+                              f"{_P}._background_abort_locked")
+_absint.register_release_site("host_indices", "invalidate",
+                              f"{_P}._plan_admissions_locked")
+_absint.register_release_site("host_indices", "server_close",
+                              f"{_P}._flush_requests_locked")
+# refcounted hit refs: lane ref drops at retirement; the session PIN
+# (ref transferred by _harvest_session_locked) drops at close_session
+_absint.register_release_site("prompt_entry_ref", "retire",
+                              f"{_P}._free_lane_locked")
+_absint.register_release_site("prompt_entry_ref", "session_close",
+                              f"{_P}.close_session")
+_absint.register_release_site("prompt_entry_ref", "server_close",
+                              f"{_P}._flush_requests_locked")
+# chunked-prefill cursor entries: ownership hands off to the decode
+# lane (or the disagg inbox) on completion, releases on abort/close
+_absint.register_release_site("chunk_cursor", "handoff",
+                              f"{_P}._advance_prefill")
+_absint.register_release_site("chunk_cursor", "handoff",
+                              f"{_P}._disagg_done")
+_absint.register_release_site("chunk_cursor", "abort",
+                              f"{_P}._background_abort_locked")
+_absint.register_release_site("chunk_cursor", "abort",
+                              f"{_P}._disagg_fail")
+_absint.register_release_site("chunk_cursor", "server_close",
+                              f"{_P}._flush_requests_locked")
+del _P, _tag
+
+
 __all__ = ["InferenceServer", "GenerationServer",
            "ContinuousGenerationServer",
            "PagedContinuousGenerationServer", "PagedBeamDecoder",
-           "BlockPoolExhausted",
+           "BlockPoolExhausted", "AdmissionInfeasible",
            "ProgramRunner", "ServerQuiesced", "ServerClosed",
            "apply_eos_sentinel", "count_generated_tokens",
            "default_batch_buckets"]

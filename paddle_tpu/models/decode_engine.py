@@ -226,6 +226,47 @@ class CacheConfig:
             tok = tok + ("chunk", self.chunk_tokens)
         return tok
 
+    @staticmethod
+    def suggest_chunk_tokens(bundle, tick_budget_ms: float,
+                             prefill_ms: float = 150.0) -> int:
+        """Largest power-of-two chunk size whose per-tick prefill
+        slice fits ``tick_budget_ms`` — the PERF.md "Chunk-size
+        arithmetic" made callable (the PR 17 leftover ROADMAP named:
+        tuning C per shape was manual).
+
+        One chunk tick runs ONE phase over C prompt tokens; a
+        monolithic prefill runs all ``2L+2`` phases over all
+        ``seq_len`` tokens in ``prefill_ms`` (default: the measured
+        ~150 ms for the 2k-token encoder on the throttled CPU host —
+        pass a fresh measurement for other shapes/backends). So
+        ``tick(C) ~= prefill_ms * C / (seq_len * n_phases)``, and the
+        two-tier schedule bounds every decode tick's wait by one such
+        slice. L is read off the bundle's state specs (one cross_k
+        entry per layer); the floor is C=2 because ``validate``
+        rejects C=1 (accumulation-order drift breaks byte-exact
+        parity). Worked example (PERF.md): seq_len=2048, L=1 (4
+        phases), 5.0 ms budget -> C=256 (tick 4.69 ms; C=512 would
+        be 9.38 ms).
+
+        Reference counterpart: none — the reference has no chunked
+        prefill; DistServe-style chunk sizing is serving-era
+        arithmetic."""
+        if tick_budget_ms <= 0:
+            raise ValueError(
+                f"tick_budget_ms must be > 0, got {tick_budget_ms}")
+        seq_len = int(bundle.seq_len)
+        n_layers = sum(1 for name in bundle._state_specs
+                       if "cross_k" in name)
+        n_phases = 2 * max(n_layers, 1) + 2
+
+        def tick(c):
+            return prefill_ms * c / (seq_len * n_phases)
+
+        c = 2
+        while c * 2 <= seq_len and tick(c * 2) <= tick_budget_ms:
+            c *= 2
+        return c
+
 
 # ---------------------------------------------------------------------------
 # Emission helpers (shared by every decode front).
@@ -2902,6 +2943,25 @@ class BlockPoolExhausted(RuntimeError):
     retryable = True
 
 
+class AdmissionInfeasible(RuntimeError):
+    """The serving CONFIGURATION (not transient load) can never admit
+    this request: the liveness capacity model
+    (analysis/liveness.py ``session_feasibility``, validated against
+    the exhaustive protomodel explorer) proves steady-state demand
+    exceeds a static pool — e.g. more distinct session prompts than
+    ``n_prompt_entries``, each pinning an entry for its session
+    lifetime. NAMED and NOT retryable (``retryable=False``): unlike
+    ``BlockPoolExhausted``, waiting cannot help — pinned entries are
+    unevictable until a session closes, so the preflight raises up
+    front instead of letting admissions wedge silently at runtime.
+
+    Reference counterpart: none — the reference admits until OOM
+    (runtime PADDLE_ENFORCE); a provably-infeasible-config error is
+    the capacity-model tier this layer adds."""
+
+    retryable = False
+
+
 class BlockLifetimeError(ValueError):
     """A host-allocator call violated the per-block lifetime lattice
     ``free → exclusive(lane) → shared(refcount>1) → freed``: freeing
@@ -3377,6 +3437,7 @@ __all__ = ["CacheConfig", "SamplingConfig", "DraftConfig",
            "tp_param_placements", "annotate_sharded_program",
            "place_sharded_bundle", "place_sharded_program",
            "BlockPoolExhausted", "BlockLifetimeError",
+           "AdmissionInfeasible",
            "HostBlockPool", "RadixBlockTree",
            "PromptPrefixCache", "build_greedy_decode_program",
            "build_incremental_decode_program",

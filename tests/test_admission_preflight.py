@@ -1,0 +1,184 @@
+"""Admission-capacity preflights (the PTA200 model at runtime) and
+the chunk-size arithmetic helper.
+
+The static model (analysis/liveness.session_feasibility, validated
+against the protomodel explorer) gets two serving enforcement points:
+
+* construction — a bundle DECLARING its session workload
+  (``bundle.workload = {"distinct_session_prompts": K, ...}``) is
+  checked at server construction, so a provably-infeasible deployment
+  raises the named, non-retryable ``AdmissionInfeasible`` before a
+  single request instead of wedging admissions at steady state;
+* per-submit — opening a session whose prompt would push the
+  distinct-open-prompt count past the prompt-entry pool raises the
+  same error synchronously from ``submit`` (pinned entries are
+  unevictable, so the request could NEVER be satisfied until a close;
+  == entries is feasible, and ``close_session`` restores capacity).
+
+``CacheConfig.suggest_chunk_tokens`` closes the PR 17 ROADMAP
+leftover (chunk size was hand-tuned per shape): the PERF.md worked
+example is pinned here as arithmetic."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import unique_name
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.inference import (AdmissionInfeasible,
+                                  PagedContinuousGenerationServer)
+from paddle_tpu.models.decode_engine import CacheConfig
+
+V, D, H, L, S, MAXT = 16, 32, 2, 1, 8, 8
+BS, NB, E = 4, 12, 2
+N_SLOTS = 2
+
+
+@pytest.fixture(scope="module")
+def paged():
+    """Untrained tiny paged bundle + warm scope: the preflights fire
+    on capacity arithmetic, not on token quality."""
+    from paddle_tpu.models import transformer as T
+
+    fluid.seed(0)
+    scope = Scope()
+    with unique_name.guard():
+        _, startup, _ = T.build_program(
+            seq_len=S, d_model=D, n_heads=H, n_layers=L, d_inner=32,
+            vocab=V, with_optimizer=False, dropout_rate=0.0)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)  # weights exist; training irrelevant
+    with unique_name.guard():
+        bundle = T.build_decode_step_program(
+            seq_len=S, max_out_len=MAXT, d_model=D, n_heads=H,
+            n_layers=L, d_inner=32, vocab=V, n_slots=N_SLOTS,
+            state_prefix="@adm/",
+            cache=CacheConfig(layout="paged", block_size=BS,
+                              n_blocks=NB, n_prompt_entries=E))
+    return {"bundle": bundle, "exe": exe, "scope": scope}
+
+
+def _server(p, **kw):
+    return PagedContinuousGenerationServer(
+        p["bundle"], executor=p["exe"], scope=p["scope"], **kw)
+
+
+def _prompt(i):
+    row = np.full((S,), 1, np.int64)
+    row[0] = 3 + i
+    return row
+
+
+class TestConstructionPreflight:
+    def test_infeasible_declared_workload_raises_named_error(
+            self, paged):
+        bundle = paged["bundle"]
+        bundle.workload = {"distinct_session_prompts": E + 1,
+                           "sessions_close": False}
+        try:
+            with pytest.raises(AdmissionInfeasible,
+                               match="session-pinning"):
+                _server(paged)
+        finally:
+            del bundle.workload
+        # the verdict is a capacity fact, not a transient: callers
+        # must not retry their way around it
+        assert AdmissionInfeasible("x").retryable is False
+
+    def test_feasible_declared_workload_constructs(self, paged):
+        bundle = paged["bundle"]
+        bundle.workload = {"distinct_session_prompts": E}
+        try:
+            with _server(paged):
+                pass
+        finally:
+            del bundle.workload
+
+    def test_closing_sessions_make_any_count_feasible(self, paged):
+        bundle = paged["bundle"]
+        bundle.workload = {"distinct_session_prompts": E + 3,
+                           "sessions_close": True}
+        try:
+            with _server(paged):
+                pass
+        finally:
+            del bundle.workload
+
+
+class TestSubmitPreflight:
+    def test_session_overflow_raises_and_close_restores(self, paged):
+        with _server(paged) as srv:
+            for i in range(E):
+                srv.submit(_prompt(i),
+                           session_id=f"s{i}").result(120.0)
+            # E distinct prompts pinned == E entries: at capacity but
+            # feasible; one MORE distinct prompt can never admit
+            with pytest.raises(AdmissionInfeasible,
+                               match="close_session"):
+                srv.submit(_prompt(E), session_id="extra")
+            # the refused session was NOT registered
+            assert srv.session_history("extra") is None
+            # a close releases the pin and the same submit succeeds
+            srv.close_session("s0")
+            srv.submit(_prompt(E), session_id="extra").result(120.0)
+
+    @pytest.mark.slow
+    def test_duplicate_prompt_shares_entry_and_admits(self, paged):
+        # distinct-prompt counting: a new session re-using an OPEN
+        # session's prompt shares its refcounted entry and must pass
+        # the preflight even at full pinning
+        with _server(paged) as srv:
+            for i in range(E):
+                srv.submit(_prompt(i),
+                           session_id=f"t{i}").result(120.0)
+            srv.submit(_prompt(0), session_id="twin").result(120.0)
+
+    @pytest.mark.slow
+    def test_non_session_traffic_unaffected(self, paged):
+        # plain requests churn entries (release on retire): no pin,
+        # no preflight, even many distinct prompts
+        with _server(paged) as srv:
+            for i in range(E + 2):
+                srv.submit(_prompt(i)).result(120.0)
+
+
+class TestSuggestChunkTokens:
+    def _duck(self, seq_len, n_layers):
+        class B:
+            pass
+
+        b = B()
+        b.seq_len = seq_len
+        b._state_specs = {f"@x/cross_k{i}": ((1,), "float32")
+                          for i in range(n_layers)}
+        return b
+
+    def test_perf_md_worked_example(self):
+        # seq_len=2048, L=1 -> 4 phases; 150 ms monolithic prefill;
+        # 5 ms budget -> C=256 (tick 4.69 ms; 512 would be 9.38 ms)
+        b = self._duck(2048, 1)
+        assert CacheConfig.suggest_chunk_tokens(b, 5.0) == 256
+
+    def test_budget_scales_and_caps_at_seq_len(self):
+        b = self._duck(2048, 1)
+        assert CacheConfig.suggest_chunk_tokens(b, 10.0) == 512
+        # a huge budget never suggests more than one full prefill
+        assert CacheConfig.suggest_chunk_tokens(b, 1e9) == 2048
+
+    def test_floor_is_two(self):
+        # validate() rejects C=1 (accumulation-order drift breaks
+        # byte-exact parity): even an impossible budget floors at 2
+        b = self._duck(2048, 1)
+        assert CacheConfig.suggest_chunk_tokens(b, 1e-6) == 2
+
+    def test_more_layers_mean_more_phases_and_bigger_chunks(self):
+        # 2L+2 phases each touch C tokens once: deeper models do less
+        # work per phase-tick, so the same budget fits a bigger chunk
+        shallow = CacheConfig.suggest_chunk_tokens(
+            self._duck(2048, 1), 2.5)
+        deep = CacheConfig.suggest_chunk_tokens(
+            self._duck(2048, 3), 2.5)
+        assert deep > shallow
+
+    def test_bad_budget_raises(self):
+        with pytest.raises(ValueError, match="tick_budget_ms"):
+            CacheConfig.suggest_chunk_tokens(self._duck(2048, 1), 0.0)

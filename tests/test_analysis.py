@@ -1163,3 +1163,328 @@ class TestTelemetryCounterContract:
             name = bundle.state[spec.logical]
             assert devtel.TEL_MARK in name
             assert bundle._state_specs[name] == ((1,), "int64")
+
+
+# ---------------------------------------------------------------------------
+# PTA200/PTA201/PTA202 — the liveness domain (analysis/liveness.py)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def small_paged_bundle():
+    """One tiny shipped paged bundle for the liveness sweeps: PTA200
+    reads only its static shape (cache/n_slots/max_out_len/workload),
+    PTA201 its programs' pool accesses."""
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.models.decode_engine import CacheConfig
+
+    return T.build_decode_step_program(
+        seq_len=8, max_out_len=8, d_model=32, n_heads=2,
+        n_layers=1, d_inner=64, vocab=50, n_slots=2,
+        state_prefix="@pta200/",
+        cache=CacheConfig(layout="paged", block_size=4,
+                          n_blocks=4, n_prompt_entries=2))
+
+
+class TestAdmissionCapacity:
+    """PTA200 (bundle-level, via check_bundle): the capacity model's
+    verdict on the session-pinning deadlock — the protomodel-validated
+    witness — plus the counted bundle-level suppression convention."""
+
+    def _bundle(self, base, **over):
+        import copy
+
+        b = copy.copy(base)
+        for k, v in over.items():
+            setattr(b, k, v)
+        return b
+
+    def test_infeasible_session_workload_is_error(
+            self, small_paged_bundle):
+        b = self._bundle(small_paged_bundle,
+                         workload={"distinct_session_prompts": 3})
+        ds = [d for d in analysis.check_bundle(b)
+              if d.code == "PTA200"]
+        assert ds and ds[0].severity == ERROR
+        assert "session-pinning" in ds[0].message
+        assert "protomodel" in ds[0].message  # oracle-backed witness
+        assert ds[0].var == "PromptPrefixCache"
+
+    def test_feasible_workloads_are_clean(self, small_paged_bundle):
+        for wl in ({"distinct_session_prompts": 2},
+                   {"distinct_session_prompts": 9,
+                    "sessions_close": True},
+                   None):
+            b = self._bundle(small_paged_bundle, workload=wl) \
+                if wl is not None else small_paged_bundle
+            assert not [d for d in analysis.check_bundle(b)
+                        if d.code == "PTA200"], wl
+
+    def test_cold_traffic_tightens_the_entry_bound(
+            self, small_paged_bundle):
+        # == entries is feasible alone but not with churn traffic
+        b = self._bundle(small_paged_bundle,
+                         workload={"distinct_session_prompts": 2,
+                                   "cold_traffic": True})
+        ds = [d for d in analysis.check_bundle(b)
+              if d.code == "PTA200"]
+        assert ds and "churn entry" in ds[0].message
+
+    def test_block_pool_demand_is_checked_too(
+            self, small_paged_bundle):
+        b = self._bundle(small_paged_bundle, n_slots=4)  # 4x2 > 4
+        ds = [d for d in analysis.check_bundle(b)
+              if d.code == "PTA200"]
+        assert ds and ds[0].var == "HostBlockPool"
+        assert "preemption" in ds[0].message
+
+    def test_bundle_suppression_is_counted_not_silent(
+            self, small_paged_bundle):
+        b = self._bundle(
+            small_paged_bundle,
+            workload={"distinct_session_prompts": 3},
+            _pta_suppress=("PTA200", "deliberate capacity wedge"))
+        sup = []
+        ds = [d for d in analysis.check_bundle(
+            b, collect_suppressed=sup) if d.code == "PTA200"]
+        assert not ds
+        assert len(sup) == 1
+        d, reason = sup[0]
+        assert d.code == "PTA200" and reason == \
+            "deliberate capacity wedge"
+
+    def test_malformed_bundle_suppress_warns_and_ignores(
+            self, small_paged_bundle):
+        b = self._bundle(
+            small_paged_bundle,
+            workload={"distinct_session_prompts": 3},
+            _pta_suppress="PTA200")  # not a (code, reason) pair
+        ds = analysis.check_bundle(b)
+        assert any(d.code == "PTA199" and d.severity == WARNING
+                   for d in ds)
+        assert any(d.code == "PTA200" and d.severity == ERROR
+                   for d in ds)  # nothing suppressed
+
+
+class TestReleaseObligations:
+    """PTA201: every ownership tag a program's pool accesses exercise
+    must carry an acquire/release contract with a registered release
+    site on EVERY declared exit path."""
+
+    def _pool_prog(self, tag):
+        from paddle_tpu.analysis import absint
+
+        main, startup, g = _guarded()
+        with g:
+            pool = main.global_block.create_var(
+                name="@p201/self_k0@POOL", shape=(4, 2, 2, 8),
+                dtype="float32", persistable=True,
+                stop_gradient=True)
+            new = layers.data("new", shape=[3, 2, 8],
+                              dtype="float32",
+                              append_batch_size=False)
+            idx = layers.data("idx", shape=[3], dtype="int32",
+                              append_batch_size=False)
+            gate = layers.data("gate", shape=[3], dtype="float32",
+                               append_batch_size=False)
+            absint.mark_pool_index_source(idx, tag, bound=8)
+            absint.mark_pool_index_source(gate, "lane_active")
+            # raw op: the layer wrapper only blesses the shipped
+            # exclusive_via names, and the ledger keys off the INDEX
+            # provenance tag, not the declaration
+            main.global_block.append_op(
+                "masked_pool_write",
+                {"Pool": [pool.name], "New": [new.name],
+                 "Index": [idx.name], "Gate": [gate.name]},
+                {"Out": [pool.name]},
+                {"leading_dims": 2, "exclusive_via": tag})
+        return main
+
+    @staticmethod
+    def _register_source(tag):
+        from paddle_tpu.analysis import absint
+
+        # registries are process-global and idempotent-identical:
+        # re-registering the same definition is legal, so repeated
+        # in-process runs of this module stay green
+        absint.register_pool_index_source(
+            tag, "test-only resource hold", absint.TS_EXCLUSIVE,
+            assumption="HostBlockPool.alloc-disjoint")
+
+    def test_tag_without_contract_is_error(self):
+        self._register_source("pta201_nocontract_tab")
+        main = self._pool_prog("pta201_nocontract_tab")
+        ds = _diags(main, "PTA201")
+        assert ds and ds[0].severity == ERROR
+        assert "no acquire/release contract" in ds[0].message
+        assert "pta201_nocontract_tab" in ds[0].message
+        assert ds[0].op_idx is not None  # anchored at the access
+
+    def test_declared_exit_without_site_is_error(self):
+        from paddle_tpu.analysis import absint
+
+        self._register_source("pta201_noexit_tab")
+        absint.register_acquire_release(
+            "pta201_noexit_tab", acquire="TestPool.alloc",
+            release="TestPool.free", exits=("retire", "abort"),
+            resource="TestPool")
+        absint.register_release_site(
+            "pta201_noexit_tab", "retire", "TestServer.retire")
+        main = self._pool_prog("pta201_noexit_tab")
+        ds = _diags(main, "PTA201")
+        assert ds and ds[0].severity == ERROR
+        assert "'abort'" in ds[0].message
+        assert "no registered release site" in ds[0].message
+
+    def test_fully_discharged_contract_is_clean(self):
+        from paddle_tpu.analysis import absint
+
+        self._register_source("pta201_clean_tab")
+        absint.register_acquire_release(
+            "pta201_clean_tab", acquire="TestPool.alloc",
+            release="TestPool.free", exits=("retire",),
+            resource="TestPool")
+        absint.register_release_site(
+            "pta201_clean_tab", "retire", "TestServer.retire")
+        assert not _diags(self._pool_prog("pta201_clean_tab"),
+                          "PTA201")
+
+    def test_shipped_paged_bundle_is_clean(self, small_paged_bundle):
+        # the serving layer's module-scope release-site registrations
+        # discharge every contract the real programs exercise (also
+        # pinned zoo-wide by test_analysis_gate)
+        b = small_paged_bundle
+        for label in ("step", "prefill"):
+            assert not _diags(getattr(b, label), "PTA201"), label
+        for key, prog in b.serves.items():
+            assert not _diags(prog, "PTA201"), key
+
+    def test_contract_api_rejects_bad_registrations(self):
+        from paddle_tpu.analysis import absint
+
+        with pytest.raises(ValueError, match="not a registered"):
+            absint.register_acquire_release(
+                "pta201_never_registered", "a", "r", ("x",), "P")
+        with pytest.raises(ValueError, match="gate"):
+            absint.register_acquire_release(
+                "lane_active", "a", "r", ("x",), "P")
+        with pytest.raises(ValueError, match="no exit paths"):
+            self._register_source("pta201_noexits_tab")
+            absint.register_acquire_release(
+                "pta201_noexits_tab", "a", "r", (), "P")
+        with pytest.raises(ValueError, match="no acquire contract"):
+            absint.register_release_site(
+                "pta201_never_registered", "x", "S.m")
+        self._register_source("pta201_drift_tab")
+        absint.register_acquire_release(
+            "pta201_drift_tab", "a", "r", ("retire",), "P")
+        with pytest.raises(ValueError,
+                           match="does not declare exit path"):
+            absint.register_release_site(
+                "pta201_drift_tab", "preempt", "S.m")
+
+
+class TestWhileProgress:
+    """PTA202: While loops must carry a provable termination variant
+    (increment counter + loop-invariant bound in the condition's
+    backward slice); serve Whiles (lane_active_mask-marked condition)
+    are held to ERROR, others to WARNING."""
+
+    def _no_counter_while(self, serve=False):
+        from paddle_tpu.analysis import absint
+
+        main, startup, g = _guarded()
+        with g:
+            i = layers.fill_constant([1], "int64", 0)
+            limit = layers.fill_constant([1], "int64", 10)
+            cond = layers.less_than(i, limit)
+            w = layers.While(cond)
+            with w.block():
+                # recomputes the condition but never steps a counter
+                layers.less_than(i, limit, cond=cond)
+                if serve:
+                    # mark INSIDE the body so the producer search
+                    # finds the in-body writer (the _serve_cond
+                    # pattern), not the pre-loop one
+                    absint.mark_divergence_source(
+                        cond, "lane_active_mask")
+        return main
+
+    def test_plain_unproven_while_warns(self):
+        ds = _diags(self._no_counter_while(), "PTA202")
+        assert ds and ds[0].severity == WARNING
+        assert "no increment-driven counter" in ds[0].message
+
+    def test_serve_unproven_while_is_error(self):
+        ds = _diags(self._no_counter_while(serve=True), "PTA202")
+        assert ds and ds[0].severity == ERROR
+        assert "serve/burst" in ds[0].message
+
+    def test_spinning_while_is_flagged(self):
+        # the While LAYER refuses a body that never rewrites the
+        # condition at build time; append the raw op to pin the
+        # checker's own sweep on the same defect
+        main, startup, g = _guarded()
+        with g:
+            i = layers.fill_constant([1], "int64", 0)
+            limit = layers.fill_constant([1], "int64", 10)
+            cond = layers.less_than(i, limit)
+            sub = main.create_block()
+            sub.append_op("increment", {"X": [i.name]},
+                          {"Out": [i.name]}, {"step": 1})
+            main.rollback()
+            main.global_block.append_op(
+                "while", {"Condition": [cond.name], "X": [],
+                          "Init": []}, {"Out": []},
+                {"sub_block": sub, "carried": [], "externals": []})
+        ds = _diags(main, "PTA202")
+        assert ds and "only spin" in ds[0].message
+
+    def test_counter_bounded_while_is_proven(self):
+        assert not _diags(_while_counter_program(1), "PTA202")
+
+    def test_shipped_serve_whiles_are_proven(self,
+                                             small_paged_bundle):
+        from paddle_tpu.analysis import liveness
+
+        for key, prog in small_paged_bundle.serves.items():
+            assert not _diags(prog, "PTA202"), key
+            vs = [v for v in liveness.while_variants(prog)
+                  if v.kind == "serve"]
+            assert vs, key  # the serve While is detected as such
+            for v in vs:
+                assert v.proven
+                assert v.assumption == "monotone-lane_active_mask"
+                assert "min_active" in v.bound_terms \
+                    and "n_steps" in v.bound_terms
+
+
+class TestExplainCLI:
+    """--explain PTA0xx: checker contract docs at the CLI, no zoo
+    build (tribal knowledge must be one command away from a red
+    finding)."""
+
+    def test_explain_prints_contract_doc(self, capsys):
+        from paddle_tpu.analysis.__main__ import main as cli_main
+
+        rc = cli_main(["--explain", "PTA201"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "PTA201 — release-on-every-exit-path" in out
+        assert "register_acquire_release" in out
+        assert "_pta_suppress" in out  # the suppression footer
+
+    def test_explain_is_case_insensitive_and_multi(self, capsys):
+        from paddle_tpu.analysis.__main__ import main as cli_main
+
+        rc = cli_main(["--explain", "pta200", "PTA202"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "PTA200 — admission-capacity-feasibility" in out
+        assert "PTA202 — while-variant-progress" in out
+
+    def test_explain_unknown_code_exits_2(self, capsys):
+        from paddle_tpu.analysis.__main__ import main as cli_main
+
+        rc = cli_main(["--explain", "PTA999"])
+        err = capsys.readouterr().err
+        assert rc == 2
+        assert "unknown checker code" in err

@@ -620,7 +620,7 @@ class TestLedgerAndBaseline:
         rep = TargetReport("own:step")
         rep.ownership = dict(stable)
         payload = baseline_payload([rep])
-        assert payload["version"] == 3
+        assert payload["version"] == 4  # liveness_facts joined in PR 18
         key = f"own:step|{pools[0]}"
         assert key in payload["ownership_facts"]
         base = {"ownership_facts":
