@@ -119,14 +119,23 @@ def _transformer():
     # dense AND paged (PTA110 covers the multi-position verify
     # scatter, PTA120 the advance bound), plus a sampled-lane step
     draft = DraftConfig(d_model=32, n_heads=2, n_layers=1,
-                        d_inner=64, k=2)
+                        d_inner=64, k=2, k_options=(0, 2))
     # ONE admission bucket per spec-flavor bundle: program structure
     # is bucket-invariant, and the spec serve programs are the
-    # biggest builds in the zoo — the gate must stay fast (tier-1)
+    # biggest builds in the zoo — the gate must stay fast (tier-1).
+    # The k-ladder (r19) adds the ("k", 0, base) adaptive variants —
+    # the draft-keepalive + plain-body composition — to the sweep.
     spec = tr.build_decode_step_program(
         n_slots=4, state_prefix="@cbs/", draft=draft,
         admit_buckets=[2], **dkw)
     sbig = max(spec.prefills)
+    # model-free drafting (r19): the n-gram/prompt-copy propose body
+    # (shift-matrix suffix matcher, one-hot dprobs) + its adaptive
+    # k=0 rung join the strict zoo
+    ngram = tr.build_decode_step_program(
+        n_slots=4, state_prefix="@cbn/", admit_buckets=[2],
+        draft=DraftConfig(k=2, kind="ngram", ngram=2,
+                          k_options=(0, 2)), **dkw)
     pspec = tr.build_decode_step_program(
         n_slots=4, state_prefix="@cbps/", draft=draft,
         admit_buckets=[2],
@@ -180,6 +189,10 @@ def _transformer():
              "sp_step": spec.step,
              "sp_serve0": spec.serves[0],
              f"sp_serve{sbig}": spec.serves[sbig],
+             f"sp_serve_k0_{sbig}": spec.serves[("k", 0, sbig)],
+             "ng_step": ngram.step,
+             f"ng_serve{sbig}": ngram.serves[sbig],
+             f"ng_serve_k0_{sbig}": ngram.serves[("k", 0, sbig)],
              "sps_step": pspec.step,
              f"sps_serve_miss{psbig}": pspec.serves[("miss", psbig)],
              f"sps_serve_hit{psbig}": pspec.serves[("hit", psbig)],
@@ -200,6 +213,8 @@ def _transformer():
              ("main", f"pg_serve_radix{pbig}"),
              ("main", "pg_cow"), ("main", "pg_probe"),
              ("main", "sp_step"), ("main", f"sp_serve{sbig}"),
+             ("main", f"sp_serve_k0_{sbig}"),
+             ("main", "ng_step"), ("main", f"ng_serve_k0_{sbig}"),
              ("main", f"sps_serve_miss{psbig}"),
              ("main", "smp_step"),
              ("main", "ck_chunk_kv"),
@@ -208,7 +223,8 @@ def _transformer():
             # whole-bundle contract sweep (PTA150): every bundle the
             # repo ships, checked as a unit
             {"cb": bundle, "pg": paged, "sp": spec, "sps": pspec,
-             "smp": sampled, "ck": chunked, "pg_wedge": wedge})
+             "ng": ngram, "smp": sampled, "ck": chunked,
+             "pg_wedge": wedge})
 
 
 def _moe_transformer():
@@ -282,7 +298,7 @@ def _sharded_decoder():
                               d_inner=32, k=2),
             cache=CacheConfig(layout="paged", block_size=4,
                               n_blocks=8, n_prompt_entries=3),
-            sharding=ShardingConfig(tp=2))
+            sharding=ShardingConfig(tp=2, qkv_interleaved=True))
     pbig = max(ps.prefills)
     return ({"step": fx.program, "startup": fx.startup,
              "serve0": b.serves[0], f"serve{big}": b.serves[big],

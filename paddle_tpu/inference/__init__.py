@@ -18,6 +18,7 @@ from .export import (StableHLOServer, StableHLOTrainer,
                      load_stablehlo, load_train_stablehlo)
 from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
+from .spec_controller import SpecController, choose_draft_placement
 from .serving import (AdmissionInfeasible, BlockPoolExhausted,
                       ContinuousGenerationServer,
                       GenerationServer, InferenceServer,
@@ -40,4 +41,5 @@ __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "ServerClosed", "ServerQuiesced", "apply_eos_sentinel",
            "count_generated_tokens", "default_batch_buckets",
            "ServingRuntime", "ModelRegistry", "Router",
-           "AdmissionError"]
+           "AdmissionError", "SpecController",
+           "choose_draft_placement"]

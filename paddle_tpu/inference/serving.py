@@ -974,7 +974,8 @@ class ContinuousGenerationServer:
                  exit_on_retire: bool = False,
                  admit_select=None,
                  start: bool = True,
-                 mesh_devices=None):
+                 mesh_devices=None,
+                 spec_controller=None):
         bundle_cache = getattr(bundle, "cache", None)
         if (type(self) is ContinuousGenerationServer
                 and bundle_cache is not None
@@ -1065,6 +1066,49 @@ class ContinuousGenerationServer:
         self._spec_tot = dict.fromkeys(
             ("proposed", "accepted", "emitted", "draft_steps",
              "target_steps"), 0)
+        # adaptive speculation (r19): per-lane acceptance counters
+        # join the fetch list, and a host-side controller re-buckets
+        # the pool across the bundle's pre-built k-ladder serve
+        # variants — pure program selection, zero steady-state
+        # compiles (inference/spec_controller.py)
+        self._lane_names = [
+            bundle.state[c] for c in
+            ("spec_lane_accepted", "spec_lane_ticks")
+            if c in getattr(bundle, "state", {})] \
+            if self._spec_k > 0 else []
+        self._lane_tot = [None] * len(self._lane_names)
+        self._spec_k_options = tuple(
+            getattr(bundle, "spec_k_options", ()) or ())
+        if spec_controller is None and self._spec_k_options:
+            from .spec_controller import SpecController
+
+            draft = getattr(bundle, "draft", None)
+            spec_controller = SpecController(
+                self._spec_k_options, default_k=self._spec_k,
+                draft_cost_ratio=(
+                    0.0 if draft is not None
+                    and getattr(draft, "kind", "model") == "ngram"
+                    else 0.25))
+        self._spec_ctl = spec_controller or None
+        if self._spec_ctl is not None and not self._spec_k_options:
+            raise ValueError(
+                "spec_controller given but the bundle has no k "
+                "ladder — build it with DraftConfig(k_options=...)")
+        # per-k-bucket windows (controller observability): each fused
+        # dispatch runs the WHOLE pool at one rung, so its spec-
+        # counter deltas attribute cleanly to that rung
+        self._per_k_tot: Dict[int, dict] = {
+            k: dict.fromkeys(
+                ("dispatches", "proposed", "accepted", "emitted"), 0)
+            for k in (self._spec_k_options or ())}
+        self._per_k_base = {k: dict(v)
+                            for k, v in self._per_k_tot.items()}
+        self._acc_hist_k = {
+            k: Histogram(
+                f"paddle_tpu_spec_acceptance_rate_k{k}",
+                buckets=tuple(round(0.1 * i, 1)
+                              for i in range(1, 11)))
+            for k in self._spec_k_options if k > 0}
         # stats(reset=True) window baseline: the DEVICE counters are
         # cumulative since init_slot_state, so the window view is
         # tot - base — keeping every number in the "speculative" dict
@@ -1092,7 +1136,7 @@ class ContinuousGenerationServer:
         st = bundle.state
         self._fetches = [st["tok_buf"], st["step"], st["active"],
                          st["finished"]] + self._spec_names \
-            + self._devtel.fetch_names
+            + self._lane_names + self._devtel.fetch_names
         self._serves = {}
         for key, prog in sorted(bundle.serves.items(),
                                 key=lambda kv: str(kv[0])):
@@ -1104,7 +1148,7 @@ class ContinuousGenerationServer:
         self._admit_buckets = sorted(
             {k for k in self._serves if isinstance(k, int) and k > 0}
             | {k[1] for k in self._serves if isinstance(k, tuple)
-               and k[0] != "chunked"})
+               and k[0] not in ("chunked", "k")})
         # radix capability: paged non-speculative bundles build
         # ("radix", A) serve programs (teacher-forced resume over a
         # shared block prefix) — the gate for session_id / n_best
@@ -1509,6 +1553,7 @@ class ContinuousGenerationServer:
                 "min_active": np.array([max(0, min_active)],
                                        np.int64)}
         key = 0
+        background = False
         if admits:
             key, extra = self._admission_feed(admits)
             feed.update(extra)
@@ -1517,6 +1562,20 @@ class ContinuousGenerationServer:
             if bg is not None:
                 key, extra = bg
                 feed.update(extra)
+                background = True
+        k_used = self._spec_k
+        if self._spec_ctl is not None and not background:
+            # adaptive-k: the controller picks the rung the whole
+            # pool runs this dispatch; non-default rungs route
+            # through the pre-built ("k", kv, base) serve variant.
+            # Background (chunked-prefill) dispatches keep the
+            # default body — their phase programs have no k ladder.
+            for slot, _req in admits:
+                self._spec_ctl.reset_lane(slot)
+            kv = int(self._spec_ctl.choose())
+            if kv != self._spec_k and ("k", kv, key) in self._serves:
+                key = ("k", kv, key)
+                k_used = kv
         self._pre_dispatch()
         try:
             c0 = self.executor.compile_count
@@ -1547,6 +1606,7 @@ class ContinuousGenerationServer:
                         # (low mean accepted length = the draft
                         # stopped agreeing with the target)
                         d = self._absorb_spec_counters(outs)
+                        self._absorb_lane_counters(outs, d, k_used)
                         if d["proposed"] > 0:
                             self._acc_hist.observe(
                                 d["accepted"] / d["proposed"])
@@ -1554,8 +1614,10 @@ class ContinuousGenerationServer:
                             # value explains a slow burst — the
                             # draft stopped agreeing with the target
                             sp.attrs["mean_accepted_len"] = round(
-                                d["emitted"] * self._spec_k
+                                d["emitted"] * k_used
                                 / d["proposed"], 3)
+                        if self._spec_ctl is not None:
+                            sp.attrs["spec_k"] = k_used
         except BaseException as e:
             with self._cv:
                 lanes = [(slot, r)
@@ -1631,6 +1693,37 @@ class ContinuousGenerationServer:
             self._spec_tot = vals
         return deltas
 
+    def _absorb_lane_counters(self, outs, spec_deltas, k_used):
+        """Delta the per-lane acceptance counters, feed the adaptive
+        controller, and attribute this dispatch's spec deltas to the
+        rung it ran (the per-k stats windows)."""
+        if not self._lane_names:
+            return
+        off = 4 + len(self._spec_names)
+        lane_deltas = []
+        with self._cv:
+            for i in range(len(self._lane_names)):
+                cur = np.asarray(outs[off + i]).reshape(-1).astype(
+                    np.int64)
+                prev = self._lane_tot[i]
+                lane_deltas.append(
+                    cur if prev is None else cur - prev)
+                self._lane_tot[i] = cur
+            per_k = self._per_k_tot.get(int(k_used))
+            if per_k is not None:
+                per_k["dispatches"] += 1
+                for src, dst in (("proposed", "proposed"),
+                                 ("accepted", "accepted"),
+                                 ("emitted", "emitted")):
+                    per_k[dst] += spec_deltas[src]
+            hist = self._acc_hist_k.get(int(k_used))
+            if hist is not None and spec_deltas["proposed"] > 0:
+                hist.observe(spec_deltas["accepted"]
+                             / spec_deltas["proposed"])
+        if self._spec_ctl is not None and len(lane_deltas) == 2:
+            self._spec_ctl.observe(lane_deltas[0], lane_deltas[1],
+                                   k=int(k_used))
+
     def _cost_snapshot(self, key) -> Optional[dict]:
         """Executable cost-model snapshot for serves[key]
         (observability/costmodel.py), resolved lazily on the first
@@ -1651,7 +1744,7 @@ class ContinuousGenerationServer:
         the occupancy integral, and — once the cost model has a
         calibrated rate — expected-vs-actual tick time (model cost vs
         this host's throttle weather)."""
-        off = 4 + len(self._spec_names)
+        off = 4 + len(self._spec_names) + len(self._lane_names)
         with self._cv:
             deltas = self._devtel.absorb(
                 outs[off:off + len(self._devtel.fetch_names)])
@@ -1722,7 +1815,7 @@ class ContinuousGenerationServer:
         # acceptance collapses the surface exists to show)
         t = {key: self._spec_tot[key] - self._spec_base[key]
              for key in self._spec_tot}
-        return {
+        out = {
             "k": self._spec_k,
             "proposed": t["proposed"],
             "accepted": t["accepted"],
@@ -1742,6 +1835,29 @@ class ContinuousGenerationServer:
                 if t["proposed"] else None),
             "acceptance_rate_hist": self._acc_hist.percentile_dict(),
         }
+        if self._spec_k_options:
+            # adaptive-k controller observability: the same window
+            # (reset=True re-bases — the r14 semantics) split per
+            # rung, so a degradation to k=0 is visible as residency,
+            # not just as a blended acceptance number
+            per_k = {}
+            for kv in self._spec_k_options:
+                w = {c: self._per_k_tot[kv][c]
+                     - self._per_k_base[kv][c]
+                     for c in self._per_k_tot[kv]}
+                w["acceptance_rate"] = (
+                    round(w["accepted"] / w["proposed"], 4)
+                    if w["proposed"] else None)
+                hist = self._acc_hist_k.get(kv)
+                if hist is not None:
+                    w["acceptance_rate_hist"] = \
+                        hist.percentile_dict()
+                per_k[kv] = w
+            out["per_k"] = per_k
+            out["k_options"] = list(self._spec_k_options)
+            if self._spec_ctl is not None:
+                out["controller"] = self._spec_ctl.stats()
+        return out
 
     # --- observability ------------------------------------------------
     def stats(self, reset: bool = False) -> dict:
@@ -1799,6 +1915,10 @@ class ContinuousGenerationServer:
                 self._per_token.clear()
                 self._acc_hist.clear()
                 self._spec_base = dict(self._spec_tot)
+                self._per_k_base = {k: dict(v) for k, v in
+                                    self._per_k_tot.items()}
+                for hist in self._acc_hist_k.values():
+                    hist.clear()
                 self._devtel.rebase()
                 self._t_first_arrival = None
                 self._t_last_done = None
@@ -1843,6 +1963,16 @@ class ContinuousGenerationServer:
                     ("paddle_tpu_spec_acceptance_rate", lab,
                      self._acc_hist),
                 ]
+                for kv in self._spec_k_options:
+                    klab = dict(lab, k=str(kv))
+                    samples.append(
+                        ("paddle_tpu_spec_k_dispatches_total", klab,
+                         self._per_k_tot[kv]["dispatches"]))
+                    hist = self._acc_hist_k.get(kv)
+                    if hist is not None:
+                        samples.append(
+                            ("paddle_tpu_spec_acceptance_rate_k",
+                             klab, hist))
             samples += self._devtel.metric_samples(lab)
             return samples
 

@@ -1,0 +1,208 @@
+"""Adaptive speculation controller — host-side per-lane acceptance
+tracking and k selection over a PRE-BUILT serve-program ladder.
+
+Reference counterpart: the inference engine's fast-decode dispatch
+(paddle/fluid/inference/api/analysis_predictor.cc:78 drives a fixed
+graph per config) — the reference has no speculative path at all, so
+the adaptive policy here is TPU-native design: because every serve
+executable must exist BEFORE traffic (zero steady-state compiles, the
+serving layer's core invariant), "adaptive" cannot mean recompiling at
+a new k.  It means choosing, per fused dispatch, which rung of the
+k-ladder the bundle already built (``DraftConfig.k_options`` →
+``("k", kv, base)`` serve keys) the whole slot pool runs next.
+
+The signal is the device-side per-lane counter pair the spec step body
+maintains (``spec_lane_accepted`` / ``spec_lane_ticks``, cumulative
+int64 rows fetched with every dispatch): the server deltas them and
+feeds ``observe()``; ``choose()`` returns the rung maximizing expected
+tokens per unit target-model cost
+
+    score(k) = E[tokens/verify] / (1 + c * k),
+    E[tokens/verify] = (1 - a^(k+1)) / (1 - a)   (a < 1; k+1 at a = 1)
+
+where ``a`` is the pooled EWMA acceptance probability per proposed
+token and ``c`` the measured draft/target per-step cost ratio (0 for
+the model-free n-gram lane — its proposals are index arithmetic).
+The rule reproduces PERF.md's speculation-threshold arithmetic
+(win requires a > c_spec/c_1) and degrades gracefully: as a falls the
+argmax walks down the ladder and parks at k=0 (plain one-token bursts,
+~1.0x the non-speculative server) instead of burning k draft steps
+per rejected window.  A parked controller re-probes a positive rung
+every ``probe_every`` dispatches so recovering traffic is noticed.
+
+Hysteresis: a switch away from the current rung needs a relative score
+win above ``margin`` — acceptance estimates are noisy at small window
+sizes, and flapping between adjacent rungs costs nothing in compiles
+(all rungs are pre-built) but pollutes the per-k telemetry windows.
+"""
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["SpecController", "choose_draft_placement"]
+
+
+def expected_tokens_per_verify(a: float, k: int) -> float:
+    """E[tokens emitted per verify step] at acceptance prob ``a`` and
+    draft length ``k``: the accepted geometric prefix plus the
+    correction/bonus token, sum_{i=0..k} a^i = (1-a^(k+1))/(1-a).
+    Reference counterpart: PERF.md "Speculative decoding" arithmetic
+    (Leviathan et al. expectation; ops/spec_ops.py:1 implements the
+    rejection rule that realizes it token-exactly)."""
+    a = min(max(float(a), 0.0), 1.0)
+    if a >= 1.0:
+        return float(k + 1)
+    return (1.0 - a ** (k + 1)) / (1.0 - a)
+
+
+class SpecController:
+    """Per-lane acceptance EWMA + pooled k selection over a fixed
+    ladder.  Host policy ONLY: decisions pick among pre-built serve
+    executables, so no device predicate depends on them (nothing new
+    for the divergence prover to see) and a decision can never
+    trigger a compile.
+
+    Parameters
+    ----------
+    k_options : the bundle's ladder (``DraftConfig.k_options``),
+        must include the bundle's default k.
+    default_k : the rung the bundle's unwrapped serve keys run.
+    draft_cost_ratio : per-step draft/target cost ratio ``c`` in the
+        score denominator ``1 + c*k``.  0 for n-gram lanes; ~0.25 is
+        the measured d64-draft/d128-target ratio on this host.
+    ewma : weight of the newest window in the acceptance estimate.
+    margin : relative score improvement required to leave the
+        current rung (hysteresis).
+    probe_every : while parked at k=0, force one positive-k dispatch
+        every N choices so the controller can observe recovery.
+    """
+
+    def __init__(self, k_options: Sequence[int], default_k: int,
+                 draft_cost_ratio: float = 0.25,
+                 ewma: float = 0.25,
+                 margin: float = 0.05,
+                 probe_every: int = 16):
+        opts = sorted({int(k) for k in k_options})
+        if int(default_k) not in opts:
+            opts.append(int(default_k))
+            opts.sort()
+        if not opts:
+            raise ValueError("k_options must be non-empty")
+        self.k_options: Tuple[int, ...] = tuple(opts)
+        self.default_k = int(default_k)
+        self.draft_cost_ratio = float(draft_cost_ratio)
+        self.ewma = float(ewma)
+        self.margin = float(margin)
+        self.probe_every = int(probe_every)
+        self._a: Optional[float] = None       # pooled EWMA acceptance
+        self._lane_a: Dict[int, float] = {}   # per-lane EWMA
+        self._k = self.default_k
+        self._parked = 0                      # choices spent at k=0
+        self.n_switches = 0
+        self.n_probes = 0
+
+    # --- signal -----------------------------------------------------
+    def observe(self, accepted_delta, ticks_delta, k: int):
+        """Absorb one dispatch's per-lane counter deltas (arrays over
+        the slot pool incl. dustbin row) measured while the pool ran
+        at rung ``k``.  k=0 dispatches carry no signal (the plain
+        body proposes nothing) and leave the estimate untouched."""
+        if k <= 0:
+            return
+        acc = np.asarray(accepted_delta, dtype=np.float64).reshape(-1)
+        tks = np.asarray(ticks_delta, dtype=np.float64).reshape(-1)
+        tot_t = float(tks.sum())
+        if tot_t <= 0:
+            return
+        for lane in np.nonzero(tks > 0)[0]:
+            a_l = min(acc[lane] / (tks[lane] * k), 1.0)
+            prev = self._lane_a.get(int(lane))
+            self._lane_a[int(lane)] = a_l if prev is None else \
+                (1 - self.ewma) * prev + self.ewma * a_l
+        a_now = min(float(acc.sum()) / (tot_t * k), 1.0)
+        self._a = a_now if self._a is None else \
+            (1 - self.ewma) * self._a + self.ewma * a_now
+
+    def reset_lane(self, lane: int):
+        """A slot was re-admitted: its history describes the RETIRED
+        request, not the new one — drop it (the pooled estimate decays
+        on its own)."""
+        self._lane_a.pop(int(lane), None)
+
+    # --- policy -----------------------------------------------------
+    def score(self, k: int, a: Optional[float] = None) -> float:
+        a = self._a if a is None else a
+        if a is None:
+            # no signal yet: prefer the default rung
+            return 1.0 if k == self.default_k else 0.0
+        return expected_tokens_per_verify(a, k) \
+            / (1.0 + self.draft_cost_ratio * k)
+
+    def choose(self) -> int:
+        """The rung the NEXT dispatch should run."""
+        if self._k == 0 and self.probe_every > 0:
+            self._parked += 1
+            if self._parked >= self.probe_every:
+                self._parked = 0
+                self.n_probes += 1
+                pos = [k for k in self.k_options if k > 0]
+                if pos:
+                    return min(pos)  # probe cheaply; estimate updates
+        best = max(self.k_options, key=lambda k: (self.score(k), k))
+        if best != self._k \
+                and self.score(best) \
+                > self.score(self._k) * (1.0 + self.margin):
+            self._k = best
+            self.n_switches += 1
+            if best != 0:
+                self._parked = 0
+        return self._k
+
+    # --- observability ----------------------------------------------
+    @property
+    def k_now(self) -> int:
+        return self._k
+
+    @property
+    def acceptance(self) -> Optional[float]:
+        return self._a
+
+    def lane_rates(self) -> Dict[int, float]:
+        return dict(self._lane_a)
+
+    def stats(self) -> dict:
+        return {
+            "k_now": self._k,
+            "k_options": list(self.k_options),
+            "acceptance_ewma": (round(self._a, 4)
+                                if self._a is not None else None),
+            "switches": self.n_switches,
+            "probes": self.n_probes,
+            "lane_acceptance": {
+                lane: round(v, 4)
+                for lane, v in sorted(self._lane_a.items())},
+        }
+
+
+def choose_draft_placement(draft, sharding):
+    """Draft placement policy under tensor parallelism: the TARGET
+    shards, the draft stays REPLICATED (``DraftConfig.sharded=False``)
+    unless explicitly overridden — r17 measured a tp-sharded draft as
+    all-overhead (the draft is already small; slicing its heads buys
+    per-device FLOPs nobody is short of while adding an all-reduce per
+    draft layer per proposal step, k of them per tick).  Returns the
+    (possibly replaced) draft config; the decision is visible in cache
+    keys because ``DraftConfig.token()`` carries ``sharded`` and the
+    target's ``ShardingPlan.token()`` rides every executor/disk key
+    (core/sharding_plan.py).  Reference counterpart: the transpiler's
+    placement split (transpiler/distribute_transpiler.py:69)."""
+    if draft is None or sharding is None or not sharding.enabled:
+        return draft
+    if draft.kind != "model":
+        return draft  # nothing to place
+    if draft.sharded and draft.n_heads % sharding.tp != 0:
+        raise ValueError(
+            f"sharded draft needs n_heads % tp == 0 "
+            f"(got {draft.n_heads} % {sharding.tp})")
+    return draft

@@ -41,6 +41,7 @@ serving scheduler (inference/serving.py).
 """
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -87,14 +88,21 @@ class ShardingConfig:
       sit inside the decode-burst While — legal under GSPMD exactly
       because the burst-exit predicate is PROVEN value-uniform on a
       tp-only mesh (PTA130/131/160/161; the r5 contract).
-    * the fused self-attention qkv projection and the fused cross-KV
-      projection stay REPLICATED deliberately: their ``split`` on the
-      fused output axis crosses tp shard boundaries (the contiguous
-      fused layout is not head-interleaved), so column-sharding them
-      would force a reshard collective EVERY tick — PTA160 rejects
-      that shape inside the While, and the serving win lives in the
-      KV bytes anyway (decode is bandwidth-bound; PERF.md "Sharded
-      serving").
+    * the CONTIGUOUS fused self-attention qkv projection and the fused
+      cross-KV projection stay REPLICATED deliberately: their
+      ``split`` on the fused output axis crosses tp shard boundaries,
+      so column-sharding them would force a reshard collective EVERY
+      tick — PTA160 rejects that shape inside the While.
+      ``qkv_interleaved=True`` switches the decode-step builders to
+      the HEAD-INTERLEAVED fused layout (``dec{li}_self_qkvh.w``,
+      columns ordered ``[H, 3, Dh]``-major): the q/k/v decomposition
+      becomes reshape ``[.., H, 3, Dh]`` → split on the local 3-axis →
+      squeeze → transpose, every step of which carries a head-sharded
+      placement locally (analysis/sharding_rules.py reshape
+      major-carry + split/squeeze/transpose rules), so the qkv weight
+      column-shards with ZERO per-tick reshard — the Megatron
+      column-parallel attention block, completed. Convert trained
+      contiguous weights with ``interleave_qkv_params``.
 
     ``dp`` replica lanes are NOT part of this config: data
     parallelism is separate server instances on disjoint device
@@ -109,6 +117,11 @@ class ShardingConfig:
 
     tp: int = 1
     axis: str = "tp"
+    # head-interleaved fused-qkv weight layout (dec{li}_self_qkvh.w)
+    # — lets the fused qkv projection column-shard under tp; False
+    # keeps the contiguous (replicated-qkv) layout byte-compatible
+    # with pre-r19 checkpoints
+    qkv_interleaved: bool = False
 
     @property
     def enabled(self) -> bool:
@@ -133,7 +146,8 @@ class ShardingConfig:
                 f"names it; pick another tp axis name")
 
     def token(self) -> tuple:
-        return ("tp", int(self.tp), self.axis)
+        return ("tp", int(self.tp), self.axis,
+                int(self.qkv_interleaved))
 
 
 @dataclass(frozen=True)
@@ -544,7 +558,35 @@ class DraftConfig:
     cross-build rule, and the builder pair-lints draft-vs-target
     persistable names with the PTA100 collision check at bundle
     build. ``k`` proposals per lane per step; k=0 degenerates to the
-    plain one-token step (the r10 path)."""
+    plain one-token step (the r10 path).
+
+    r19 adaptive-speculation knobs:
+
+    * ``kind="ngram"`` replaces the draft MODEL with a model-free
+      prompt-copy proposer: each tick proposes the continuation of
+      the longest (up to ``ngram``-token) prompt/history suffix match
+      ("prompt lookup decoding"; PAPERS.md). Proposals enter the SAME
+      spec_accept verify path as deterministic one-hot
+      "distributions" — exact under greedy AND sampled emission,
+      because a one-hot draft distribution makes the Leviathan accept
+      test exact (accept w.p. p(x); residual is p with x zeroed). No
+      draft params, no draft KV, no draft model steps — the whole
+      proposer is index arithmetic over per-lane prompt/history
+      state.
+    * ``k_options`` is the pre-built adaptive-k ladder: for every
+      ``kv`` in it besides the default ``k``, the bundle builds a
+      parallel serve-program set keyed ``("k", kv, base_key)`` over
+      the SAME slot state, so the host controller
+      (inference/spec_controller.py) re-buckets lanes across draft
+      lengths by pure program selection — zero steady-state compiles
+      by construction. ``k`` must itself be a rung of a non-empty
+      ladder.
+    * ``sharded`` opts the draft model INTO the bundle's tp plan
+      (draft params + draft KV head-sharded). Default False: r17
+      measured a sharded draft as all-overhead (a draft small enough
+      to be cheap is small enough that its psums dominate), so the
+      shipped placement shards only the TARGET.
+    """
 
     d_model: int = 32
     n_heads: int = 2
@@ -552,6 +594,10 @@ class DraftConfig:
     d_inner: int = 64
     k: int = 3
     prefix: str = "draft_"
+    kind: str = "model"       # "model" | "ngram"
+    ngram: int = 2            # suffix-match length for kind="ngram"
+    k_options: tuple = ()     # adaptive ladder; () = fixed-k bundle
+    sharded: bool = False     # shard draft params/KV under tp
 
     def validate(self, max_out_len: int):
         if self.k < 0:
@@ -560,19 +606,59 @@ class DraftConfig:
             raise ValueError(
                 f"draft k={self.k} proposes past the decode buffer "
                 f"(max_out_len={max_out_len})")
-        if self.d_model % self.n_heads:
+        if self.kind not in ("model", "ngram"):
+            raise ValueError(
+                f"draft kind must be 'model' or 'ngram', got "
+                f"{self.kind!r}")
+        if self.kind == "ngram":
+            if self.ngram < 1:
+                raise ValueError(
+                    f"ngram suffix length must be >= 1, got "
+                    f"{self.ngram}")
+            if self.sharded:
+                raise ValueError(
+                    "DraftConfig(kind='ngram') has no draft params "
+                    "to shard — sharded=True is meaningless")
+        elif self.d_model % self.n_heads:
             raise ValueError(
                 f"draft d_model={self.d_model} not divisible by "
                 f"n_heads={self.n_heads}")
+        if self.k_options:
+            opts = tuple(int(v) for v in self.k_options)
+            if list(opts) != sorted(set(opts)):
+                raise ValueError(
+                    f"k_options must be sorted unique ints, got "
+                    f"{self.k_options!r}")
+            for kv in opts:
+                if kv < 0 or kv + 1 > max_out_len:
+                    raise ValueError(
+                        f"k_options entry {kv} out of range for "
+                        f"max_out_len={max_out_len}")
+            if self.k not in opts:
+                raise ValueError(
+                    f"default k={self.k} must be a rung of "
+                    f"k_options={self.k_options!r} (the serve keys "
+                    f"the controller starts from)")
+            if self.k == 0:
+                raise ValueError(
+                    "adaptive bundles need a speculative DEFAULT "
+                    "(k > 0): the k=0 rung is the degradation "
+                    "target, not the build anchor — every draft.k>0 "
+                    "gate (state specs, admissions) keys off the "
+                    "default")
 
     def token(self) -> tuple:
         return ("spec", int(self.k), int(self.d_model),
                 int(self.n_heads), int(self.n_layers),
-                int(self.d_inner), self.prefix)
+                int(self.d_inner), self.prefix, self.kind,
+                int(self.ngram),
+                tuple(int(v) for v in self.k_options),
+                int(self.sharded))
 
 
 def cached_decoder_step(x, caches, cross_kv, att_bias, d_model,
-                        n_heads, d_inner, prefix="", q=1):
+                        n_heads, d_inner, prefix="", q=1,
+                        qkv_interleaved=False):
     """One KV-cached decoder-stack step over a [R,q,D] row batch
     (reference tests/unittests/dist_transformer.py:1498 fast_decode's
     cached decoder, factored so the whole-loop incremental program and
@@ -591,6 +677,14 @@ def cached_decoder_step(x, caches, cross_kv, att_bias, d_model,
     explicit {prefix}dec{li}_* scheme shared with the training build
     (``prefix`` is how a speculative DRAFT model co-resides with the
     target in one scope without aliasing — the PTA100 contract).
+
+    ``qkv_interleaved=True`` uses the head-interleaved fused weight
+    ``{prefix}dec{li}_self_qkvh.w`` (columns ``[H, 3, Dh]``-major;
+    see ShardingConfig and ``interleave_qkv_params``): the q/k/v
+    decomposition becomes reshape → local split → squeeze →
+    transpose, so the fused projection column-shards under tp with
+    zero per-tick reshard. Identical math to the contiguous layout —
+    only the weight column ORDER differs.
     Returns the [R,q,D] hidden rows after all layers.
     """
     from . import transformer as T
@@ -599,14 +693,31 @@ def cached_decoder_step(x, caches, cross_kv, att_bias, d_model,
     scale = head_dim ** -0.5
     for li, cache in enumerate(caches):
         # --- cached causal self-attention (fused qkv) ---
-        qkv = layers.fc(
-            x, 3 * d_model, num_flatten_dims=2, bias_attr=False,
-            param_attr=T._attn_proj_attr(f"{prefix}dec{li}_self",
-                                         "qkv", d_model))
-        qv, k, v = layers.split(qkv, 3, dim=2)
-        qh = heads_of(qv, q, n_heads, head_dim)
-        kh = heads_of(k, q, n_heads, head_dim)
-        vh = heads_of(v, q, n_heads, head_dim)
+        if qkv_interleaved:
+            qkv = layers.fc(
+                x, 3 * d_model, num_flatten_dims=2, bias_attr=False,
+                param_attr=T._attn_proj_attr(f"{prefix}dec{li}_self",
+                                             "qkvh", d_model))
+            # [R,q,3D] -> [R,q,H,3,Dh]: H rides the MAJOR position
+            # of the split group, so a column shard on dim 2 of the
+            # fc output carries to the H axis (sharding_rules
+            # rule_reshape major-carry); the 3-way split is then on
+            # the UNSHARDED interleave axis — entirely local
+            z = layers.reshape(qkv, [0, q, n_heads, 3, head_dim])
+            zq, zk, zv = layers.split(z, 3, dim=3)
+            qh, kh, vh = (
+                layers.transpose(layers.squeeze(t, axes=[3]),
+                                 perm=[0, 2, 1, 3])
+                for t in (zq, zk, zv))  # [R,H,q,Dh]
+        else:
+            qkv = layers.fc(
+                x, 3 * d_model, num_flatten_dims=2, bias_attr=False,
+                param_attr=T._attn_proj_attr(f"{prefix}dec{li}_self",
+                                             "qkv", d_model))
+            qv, k, v = layers.split(qkv, 3, dim=2)
+            qh = heads_of(qv, q, n_heads, head_dim)
+            kh = heads_of(k, q, n_heads, head_dim)
+            vh = heads_of(v, q, n_heads, head_dim)
         kc, vc = cache.update(kh, vh)
         scores = layers.scale(
             layers.matmul(qh, kc, transpose_y=True),
@@ -966,6 +1077,16 @@ class DecodeStepBundle:
         return self.draft.k if self.draft is not None else 0
 
     @property
+    def spec_k_options(self) -> tuple:
+        """The pre-built adaptive-k ladder (empty on fixed-k and
+        plain bundles). Non-empty means serves carries a ("k", kv,
+        base_key) variant set per non-default rung and the host
+        controller may re-bucket across them compile-free."""
+        if self.draft is None:
+            return ()
+        return tuple(int(v) for v in self.draft.k_options)
+
+    @property
     def chunk_phase_keys(self):
         """The ("chunked", p) serve keys in phase order (empty on
         non-chunked bundles). The host drives ONE prompt through
@@ -983,8 +1104,10 @@ class DecodeStepBundle:
     def tokens_per_tick(self) -> int:
         """Max tokens ONE device tick can emit per lane — the paged
         scheduler sizes block coverage by this (k accepted proposals
-        + the correction/bonus token)."""
-        return self.spec_k + 1
+        + the correction/bonus token). Adaptive bundles size by the
+        ladder's TOP rung: the controller may select it any
+        dispatch."""
+        return max((self.spec_k,) + self.spec_k_options) + 1
 
     @property
     def needs_seeds(self) -> bool:
@@ -1019,6 +1142,12 @@ class DecodeStepBundle:
         the serving layer binds prepared handles from this."""
         feed = [("n_steps", (1,), "int64"),
                 ("min_active", (1,), "int64")]
+        if isinstance(key, tuple) and key and key[0] == "k":
+            # adaptive-k variant: same admission body, same slot
+            # state, same feeds — only the burst's draft length
+            # differs (the whole point: re-bucketing is pure program
+            # selection)
+            return self.serve_feed_spec(key[2])
         if key == 0:
             return feed
         tier, A = key if isinstance(key, tuple) else ("miss", key)
@@ -1105,21 +1234,28 @@ def _slot_state_specs(prefix, rows, maxT, seq_len, n_heads,
         # per-request seeds — the (request, position) key channel
         specs[f"{prefix}seed"] = ((rows,), "int64")
     if draft is not None and draft.k > 0:
-        dh = draft.d_model // draft.n_heads
-        # the draft's self-KV stays DENSE per-lane in BOTH target
-        # layouts (the draft is small — that is the point; paging it
-        # would buy bytes nobody is short of), its cross-KV is
-        # per-lane too (the draft encoder re-runs even on prefix-HIT
-        # admissions, so no pooled entries to refcount)
-        for li in range(draft.n_layers):
-            specs[f"{prefix}draft_self_k{li}"] = (
-                (rows, draft.n_heads, maxT, dh), "float32")
-            specs[f"{prefix}draft_self_v{li}"] = (
-                (rows, draft.n_heads, maxT, dh), "float32")
-            specs[f"{prefix}draft_cross_k{li}"] = (
-                (rows, draft.n_heads, seq_len, dh), "float32")
-            specs[f"{prefix}draft_cross_v{li}"] = (
-                (rows, draft.n_heads, seq_len, dh), "float32")
+        if draft.kind == "model":
+            dh = draft.d_model // draft.n_heads
+            # the draft's self-KV stays DENSE per-lane in BOTH target
+            # layouts (the draft is small — that is the point; paging
+            # it would buy bytes nobody is short of), its cross-KV is
+            # per-lane too (the draft encoder re-runs even on
+            # prefix-HIT admissions, so no pooled entries to refcount)
+            for li in range(draft.n_layers):
+                specs[f"{prefix}draft_self_k{li}"] = (
+                    (rows, draft.n_heads, maxT, dh), "float32")
+                specs[f"{prefix}draft_self_v{li}"] = (
+                    (rows, draft.n_heads, maxT, dh), "float32")
+                specs[f"{prefix}draft_cross_k{li}"] = (
+                    (rows, draft.n_heads, seq_len, dh), "float32")
+                specs[f"{prefix}draft_cross_v{li}"] = (
+                    (rows, draft.n_heads, seq_len, dh), "float32")
+        else:
+            # ngram proposer: no model, no KV — just the per-lane
+            # prompt copy the suffix matcher scans (tok_buf already
+            # holds the generated history)
+            specs[f"{prefix}prompt_toks"] = ((rows, seq_len),
+                                             "int64")
         # device-side speculative accounting ([1] int64 RMW counters;
         # the serving layer deltas them per dispatch): proposals
         # offered / accepted / tokens emitted / draft vs target model
@@ -1127,6 +1263,17 @@ def _slot_state_specs(prefix, rows, maxT, seq_len, n_heads,
         for c in ("spec_proposed", "spec_accepted", "spec_emitted",
                   "spec_draft_steps", "spec_target_steps"):
             specs[f"{prefix}{c}"] = ((1,), "int64")
+        # PER-LANE acceptance accounting (the adaptive-k controller's
+        # signal): accepted proposals and spec ticks per lane,
+        # cumulative since init — the controller deltas them per
+        # dispatch to estimate each lane's acceptance rate
+        specs[f"{prefix}spec_lane_accepted"] = ((rows,), "int64")
+        specs[f"{prefix}spec_lane_ticks"] = ((rows,), "int64")
+        if draft.k_options:
+            # per-rung tick counters for the pre-built k ladder
+            # (@TEL: PTA180 contract, devtel fetch/stats for free)
+            specs.update(devtel.spec_k_counter_specs(
+                prefix, draft.k_options))
     # device-side flight data (observability/devtel.py): [1] int64
     # RMW counters every program of the bundle declares — ticks,
     # occupancy integral, burst exit reasons, admission-tier counts.
@@ -1211,17 +1358,53 @@ def tp_param_placements(n_layers: int, sharding: "ShardingConfig",
                         prefix: str = "") -> Dict[str, dict]:
     """{param name -> {dim: axis}} of the Megatron column/row-parallel
     decoder layout for the explicit ``{prefix}dec{li}_*`` name scheme
-    (ShardingConfig docstring: fused qkv / fused cross-kv stay
-    replicated — their fused-axis split crosses tp shard boundaries;
-    biases stay replicated — GSPMD slices them locally for free)."""
+    (ShardingConfig docstring: the CONTIGUOUS fused qkv / fused
+    cross-kv stay replicated — their fused-axis split crosses tp
+    shard boundaries; biases stay replicated — GSPMD slices them
+    locally for free). With ``sharding.qkv_interleaved`` the
+    head-interleaved fused weight ``dec{li}_self_qkvh.w``
+    column-shards: its ``[H, 3, Dh]``-major column order puts heads
+    on the MAJOR axis of the decomposition reshape, so the shard
+    carries through reshape/split/squeeze/transpose with zero
+    reshard (the r17 leftover, closed)."""
     ax = sharding.axis
     out: Dict[str, dict] = {f"{prefix}logits.w": {1: ax}}
     for li in range(n_layers):
+        if sharding.qkv_interleaved:
+            out[f"{prefix}dec{li}_self_qkvh.w"] = {1: ax}
         out[f"{prefix}dec{li}_self_out.w"] = {0: ax}
         out[f"{prefix}dec{li}_cross_q.w"] = {1: ax}
         out[f"{prefix}dec{li}_cross_out.w"] = {0: ax}
         out[f"{prefix}dec{li}_fc1.w"] = {1: ax}
         out[f"{prefix}dec{li}_fc2.w"] = {0: ax}
+    return out
+
+
+def interleave_qkv_params(scope, n_layers: int, n_heads: int,
+                          d_model: int, prefix: str = ""):
+    """Convert trained CONTIGUOUS fused-qkv weights
+    (``{prefix}dec{li}_self_qkv.w``, columns ``[3, H, Dh]``-major) to
+    the HEAD-INTERLEAVED layout (``{prefix}dec{li}_self_qkvh.w``,
+    columns ``[H, 3, Dh]``-major) a ``qkv_interleaved`` decode build
+    reads — a pure column permutation, so the decode math is
+    bit-identical to the contiguous layout (asserted by the bundle
+    parity tests). Writes the converted weights into ``scope`` and
+    returns the new param names. Reference counterpart:
+    transpiler/distribute_transpiler.py:69 VarBlock param slicing —
+    there a runtime program rewrite, here an offline weight re-layout
+    feeding a declaratively sharded build."""
+    head_dim = d_model // n_heads
+    out = []
+    for li in range(n_layers):
+        src = f"{prefix}dec{li}_self_qkv.w"
+        dst = f"{prefix}dec{li}_self_qkvh.w"
+        w = np.asarray(scope._get(src))
+        d_in = w.shape[0]
+        scope._set(dst, np.ascontiguousarray(
+            w.reshape(d_in, 3, n_heads, head_dim)
+             .transpose(0, 2, 1, 3)
+             .reshape(d_in, 3 * d_model)))
+        out.append(dst)
     return out
 
 
@@ -1278,10 +1461,37 @@ def annotate_sharded_program(program, placements: Dict[str, dict],
 def _apply_tp_sharding(bundle: "DecodeStepBundle",
                        sharding: "ShardingConfig", n_layers: int):
     """Annotate every program of a bundle with the tp layout and
-    attach ONE shared execution plan (ShardingConfig docstring)."""
+    attach ONE shared execution plan (ShardingConfig docstring).
+    The DRAFT model of a speculative bundle joins the plan only when
+    ``draft.sharded`` opted it in (DraftConfig: r17 measured a
+    sharded draft as all-overhead, so target-only is the default
+    placement the controller hands out)."""
     placements = dict(tp_param_placements(n_layers, sharding))
+    prefix = _state_prefix_of(bundle)
     placements.update(_tp_state_placements(
-        _state_prefix_of(bundle), n_layers, bundle.cache, sharding))
+        prefix, n_layers, bundle.cache, sharding))
+    draft = bundle.draft
+    if draft is not None and draft.sharded and draft.k > 0:
+        if draft.n_heads % sharding.tp or \
+                draft.d_model % sharding.tp or \
+                draft.d_inner % sharding.tp:
+            raise ValueError(
+                f"DraftConfig(sharded=True) needs draft "
+                f"n_heads/d_model/d_inner divisible by tp="
+                f"{sharding.tp}, got {draft.n_heads}/"
+                f"{draft.d_model}/{draft.d_inner}")
+        # the draft's fused qkv is never interleaved (it is not worth
+        # a second weight layout for a model this small), so its
+        # placements come from the contiguous view of the config
+        dcfg = dataclasses.replace(sharding, qkv_interleaved=False)
+        placements.update(tp_param_placements(
+            draft.n_layers, dcfg, prefix=draft.prefix))
+        # draft KV is dense per-lane [R, dH, T, dh] in both target
+        # layouts — heads on dim 1
+        for li in range(draft.n_layers):
+            for nm in (f"draft_self_k{li}", f"draft_self_v{li}",
+                       f"draft_cross_k{li}", f"draft_cross_v{li}"):
+                placements[f"{prefix}{nm}"] = {1: sharding.axis}
     mesh_axes = ((sharding.axis, sharding.tp),)
     plan = None
     for prog in bundle.programs():
@@ -1583,11 +1793,14 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         sampling.validate()
     if draft is not None:
         draft.validate(max_out_len)
-        _pair_lint_draft_target(
-            draft, seq_len=seq_len, max_out_len=max_out_len,
-            d_model=d_model, n_heads=n_heads, n_layers=n_layers,
-            d_inner=d_inner, vocab=vocab)
+        if draft.kind == "model":
+            _pair_lint_draft_target(
+                draft, seq_len=seq_len, max_out_len=max_out_len,
+                d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+                d_inner=d_inner, vocab=vocab)
     spec = draft is not None and draft.k > 0
+    ngram = spec and draft.kind == "ngram"
+    qkv_il = sharding is not None and sharding.qkv_interleaved
     greedy = sampling is None or sampling.greedy
     samp = sampling or SamplingConfig(temperature=0.0)
     paged = cache.layout == "paged"
@@ -1775,6 +1988,32 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                 layers.assign(layers.elementwise_mul(var, keep4),
                               output=var)
 
+    def _ngram_admit(sv, src, A, oh, keep_f):
+        """Model-free draft admission: scatter the admission prompts
+        into the admitted lanes' ``prompt_toks`` copies — the text
+        the suffix matcher scans at every spec tick. Token ids <
+        vocab << 2^24, so the float32 one-hot matmul scatter is exact
+        (the radix hist_toks idiom)."""
+        ohT = layers.transpose(oh, perm=[1, 0])            # [rows, A]
+        scat = layers.cast(
+            layers.matmul(ohT, layers.cast(src, "float32")),
+            "int64")                                       # [R,S]
+        keep_i = layers.cast(keep_f, "int64")
+        keep_col = layers.reshape(keep_i, [rows, 1])
+        var = sv[f"{state_prefix}prompt_toks"]
+        layers.assign(layers.elementwise_add(
+            layers.elementwise_mul(var, keep_col), scat),
+            output=var)
+
+    def _spec_admit(sv, src, A, oh, keep_f):
+        """Speculative admission tail dispatch: draft-MODEL bundles
+        install per-lane draft cross-KV (_draft_admit); ngram bundles
+        install the per-lane prompt copy (_ngram_admit)."""
+        if ngram:
+            _ngram_admit(sv, src, A, oh, keep_f)
+        else:
+            _draft_admit(sv, src, A, oh, keep_f)
+
     def _encode_prompts(A):
         src = layers.data("src_ids", shape=[A, seq_len],
                           dtype="int64", append_batch_size=False)
@@ -1825,7 +2064,7 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                 layers.assign(layers.elementwise_mul(var, keep4),
                               output=var)
         if spec:
-            _draft_admit(sv, src, A, oh, keep_f)
+            _spec_admit(sv, src, A, oh, keep_f)
         _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds)
 
     def _admit_body_paged_miss(sv, A):
@@ -1855,7 +2094,7 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                     exclusive_via="host_indices")
         oh, _, any_i, keep_f, keep_i = _lane_onehots(slots, A)
         if spec:
-            _draft_admit(sv, src, A, oh, keep_f)
+            _spec_admit(sv, src, A, oh, keep_f)
         _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds)
         # fresh lanes need no self-pool zeroing: every cache position
         # <= t is rewritten by the lane before it is ever attended to,
@@ -1878,7 +2117,7 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         seeds = _seeds_data(A)
         oh, _, any_i, keep_f, keep_i = _lane_onehots(slots, A)
         if spec:
-            _draft_admit(sv, src, A, oh, keep_f)
+            _spec_admit(sv, src, A, oh, keep_f)
         _reset_lane_state(sv, any_i, keep_i, oh=oh, seeds=seeds,
                           tier="hit")
 
@@ -2071,7 +2310,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                         got, [rows, n_heads, seq_len, head_dim]))
                 cross_kv.append(tuple(pair))
         x = cached_decoder_step(x, caches, cross_kv, att_bias,
-                                d_model, n_heads, d_inner)
+                                d_model, n_heads, d_inner,
+                                qkv_interleaved=qkv_il)
         logits_v = layers.fc(
             layers.reshape(x, [0, d_model]), vocab,
             bias_attr=False, param_attr="logits.w")        # [R,V]
@@ -2169,10 +2409,11 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
     # the per-query validity bias and rewritten when the lane reaches
     # those positions (the same staleness discipline the paged
     # layout already relies on). ------------------------------------
-    def _spec_step_body(sv):
-        k = draft.k
+    def _spec_step_body(sv, k_run=None):
+        # k_run: the draft length THIS serve variant runs (adaptive-k
+        # ladder rungs share the body builder; None = the default k)
+        k = draft.k if k_run is None else int(k_run)
         Q = k + 1
-        dd, dH = draft.d_model, draft.n_heads
         tok_buf = sv[f"{state_prefix}tok_buf"]
         stepv = sv[f"{state_prefix}step"]
         fin = sv[f"{state_prefix}finished"]
@@ -2184,12 +2425,14 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                  layers.fill_constant([1], "int64", 1.0))
         _tel_add(sv, "tel_occupancy",
                  layers.reduce_sum(act, keep_dim=True))
+        # adaptive ladder: which rung ticked (absent on fixed-k
+        # bundles — _tel_add skips missing counters)
+        _tel_add(sv, devtel.spec_k_logical(k),
+                 layers.fill_constant([1], "int64", 1.0))
         positions = layers.cast(layers.range(0, maxT, 1), "int64")
         posf = layers.cast(positions, "float32")
         pos_table = layers.assign(
             T._position_encoding(max(seq_len, maxT), d_model)[:maxT])
-        dpos_table = layers.assign(
-            T._position_encoding(max(seq_len, maxT), dd)[:maxT])
         ones_n = layers.fill_constant([rows], "int64", 1.0)
         step2 = layers.reshape(stepv, [rows, 1])           # [R,1]
         t_mask0 = layers.cast(layers.equal(positions, step2),
@@ -2199,77 +2442,183 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                                    layers.cast(t_mask0, "int64")),
             dim=1, keep_dim=True)                          # [R,1]
 
-        # ---- draft propose: k+1 unrolled cached draft-model steps
-        # over positions step..step+k. Steps 0..k-1 yield the k
-        # proposals; step k exists ONLY to write the draft's KV at
-        # position step+k — after a full-acceptance tick the counter
-        # advances to step+k+1, and without that write the draft
-        # cache keeps a PERMANENT hole at step+k (never reprocessed:
-        # later ticks start past it), silently poisoning every
-        # subsequent proposal for the lane's lifetime (measured:
-        # acceptance collapsed to ~0 after the first burst) ----
-        proposals, dprob_rows = [], []
-        prev = cur_tok
-        for j in range(k + 1):
-            stepj = stepv if j == 0 else layers.elementwise_add(
-                stepv, layers.fill_constant([1], "int64", float(j)))
-            stepj2 = layers.reshape(stepj, [rows, 1])
-            t_mask_j = layers.cast(layers.equal(positions, stepj2),
-                                   "float32")              # [R,maxT]
-            x = layers.embedding(prev, size=[vocab, dd],
-                                 param_attr=ParamAttr(
-                                     name=f"{draft.prefix}"
-                                          f"tgt_word_emb"))
-            x = layers.unsqueeze(x, [1])                   # [R,1,dd]
-            x = layers.scale(x, scale=dd ** 0.5)
-            pos_e = layers.matmul(t_mask_j, dpos_table)    # [R,dd]
-            x = layers.elementwise_add(x,
-                                       layers.unsqueeze(pos_e, [1]))
-            dbias = layers.reshape(
-                layers.scale(layers.cast(layers.greater_than(
-                    posf, layers.cast(stepj2, "float32")), "float32"),
-                    scale=-1e9),
-                [rows, 1, 1, maxT])
-            wm = layers.reshape(t_mask_j, [rows, 1, maxT, 1])
-            km = layers.reshape(
-                layers.elementwise_sub(
-                    layers.fill_constant([rows, maxT], "float32",
-                                         1.0), t_mask_j),
-                [rows, 1, maxT, 1])
-            dcaches = [
-                _DenseLaneCache(sv[f"{state_prefix}draft_self_k{li}"],
-                                sv[f"{state_prefix}draft_self_v{li}"],
-                                wm, km)
-                for li in range(draft.n_layers)]
-            dcross = [(sv[f"{state_prefix}draft_cross_k{li}"],
-                       sv[f"{state_prefix}draft_cross_v{li}"])
-                      for li in range(draft.n_layers)]
-            x = cached_decoder_step(x, dcaches, dcross, dbias, dd,
-                                    dH, draft.d_inner,
-                                    prefix=draft.prefix)
-            if j == k:
-                # the cache-fill-only step: position step+k's KV is
-                # written (the full-acceptance hole), no proposal
-                break
-            dlogits = layers.fc(
-                layers.reshape(x, [0, dd]), vocab, bias_attr=False,
-                param_attr=f"{draft.prefix}logits.w")      # [R,V]
-            dprobs = layers.filtered_softmax(
-                dlogits, temperature=samp.temperature,
-                top_k=samp.top_k, top_p=samp.top_p)
-            if greedy:
-                tok_j = layers.cast(
-                    layers.argmax(dprobs, axis=-1), "int64")
-            else:
-                tok_j = layers.sample_categorical(
-                    dprobs, seedv,
+        if ngram:
+            # ---- model-free propose (prompt-lookup decoding): find
+            # the RIGHTMOST non-trivial occurrence of the lane's
+            # last-n-token suffix in prompt+history and propose its
+            # continuation. The proposals are deterministic, and
+            # their one-hot "distributions" make the Leviathan
+            # accept test exact under greedy AND sampled emission
+            # (accept w.p. p(x); residual = p with x zeroed), so the
+            # whole proposer is FREE of model steps — index
+            # arithmetic only.
+            n = draft.ngram
+            S_ = seq_len
+            CTX = S_ + maxT
+            ctx_i = layers.concat(
+                [sv[f"{state_prefix}prompt_toks"], tok_buf],
+                axis=1)                                    # [R,CTX]
+            ctx_f = layers.cast(ctx_i, "float32")
+            ctx_posf = layers.assign(
+                np.arange(CTX, dtype="float32"))           # [CTX]
+            step2f = layers.cast(step2, "float32")         # [R,1]
+            # candidate match-END validity: j >= n-1 (a full suffix
+            # sits to its left) AND j < S + step (strictly left of
+            # the live suffix end — excludes the trivial self-match
+            # and, because validity is prefix-closed, every
+            # uncommitted tok_buf position the window could touch)
+            j_ok = layers.cast(layers.greater_than(
+                ctx_posf, layers.fill_constant(
+                    [1], "float32", float(n - 2))),
+                "float32")                                 # [CTX]
+            end_ok = layers.cast(layers.less_than(
+                ctx_posf, layers.scale(step2f, bias=float(S_))),
+                "float32")                                 # [R,CTX]
+            score = layers.elementwise_mul(end_ok, j_ok, axis=1)
+            for i in range(n):
+                # suffix token i back from the live end: ctx[S+step-i]
+                # — reading the CONCATENATED prompt+history means the
+                # suffix crosses the prompt boundary correctly during
+                # the first n generated tokens. A spurious match
+                # against pad/zero tokens merely proposes tokens the
+                # verify step then rejects (acceptance cost, never a
+                # correctness cost).
+                m_i = layers.cast(layers.equal(
+                    ctx_posf, layers.scale(
+                        step2f, bias=float(S_ - i))), "float32")
+                s_i = layers.reduce_sum(
+                    layers.elementwise_mul(ctx_f, m_i), dim=1,
+                    keep_dim=True)                         # [R,1]
+                # ctx shifted right by i (matmul with the off-
+                # diagonal identity): shifted[r, j] = ctx[r, j-i]
+                shift = layers.assign(
+                    np.eye(CTX, dtype="float32", k=i))
+                shifted = layers.matmul(ctx_f, shift)      # [R,CTX]
+                score = layers.elementwise_mul(
+                    score, layers.cast(layers.equal(shifted, s_i),
+                                       "float32"))
+            # rightmost match end: argmax of score*(j+1); 0 = none
+            best = layers.reduce_max(
+                layers.elementwise_mul(
+                    score, layers.scale(ctx_posf, bias=1.0),
+                    axis=1),
+                dim=1, keep_dim=True)                      # [R,1]
+            has = layers.cast(layers.greater_than(
+                best, layers.fill_constant([1], "float32", 0.0)),
+                "float32")                                 # [R,1]
+            idx = layers.scale(best, bias=-1.0)            # [R,1]
+            cur_f = layers.cast(cur_tok, "float32")        # [R,1]
+            proposals, dprob_rows = [], []
+            for m in range(k):
+                pm = layers.scale(idx, bias=float(1 + m))  # [R,1]
+                # committed-continuation gate: the proposed position
+                # must itself be prompt/history (pm <= S+step)
+                ok_m = layers.elementwise_mul(
+                    has, layers.cast(layers.less_than(
+                        pm, layers.scale(step2f,
+                                         bias=float(S_ + 1))),
+                        "float32"))                        # [R,1]
+                om = layers.cast(layers.equal(ctx_posf, pm),
+                                 "float32")                # [R,CTX]
+                got = layers.reduce_sum(
+                    layers.elementwise_mul(ctx_f, om), dim=1,
+                    keep_dim=True)                         # [R,1]
+                # fallback: repeat the current token (any proposal
+                # is CORRECT — the verify step rejects bad ones; the
+                # fallback only matters for acceptance rate)
+                tok_m = layers.cast(layers.reshape(
                     layers.elementwise_add(
-                        stepj, layers.fill_constant([1], "int64",
-                                                    1.0)),
-                    noise_tag=1, base_seed=samp.base_seed)
-            proposals.append(tok_j)
-            dprob_rows.append(layers.unsqueeze(dprobs, [1]))
-            prev = layers.reshape(tok_j, [rows, 1])
+                        layers.elementwise_mul(got, ok_m),
+                        layers.elementwise_mul(
+                            cur_f, layers.scale(ok_m, scale=-1.0,
+                                                bias=1.0))),
+                    [rows]), "int64")                      # [R]
+                proposals.append(tok_m)
+                dprob_rows.append(layers.unsqueeze(
+                    layers.one_hot(tok_m, vocab), [1]))    # [R,1,V]
+        else:
+            dd, dH = draft.d_model, draft.n_heads
+            dpos_table = layers.assign(
+                T._position_encoding(max(seq_len, maxT), dd)[:maxT])
+            # ---- draft propose: k+1 unrolled cached draft-model
+            # steps over positions step..step+k. Steps 0..k-1 yield
+            # the k proposals; step k exists ONLY to write the
+            # draft's KV at position step+k — after a full-acceptance
+            # tick the counter advances to step+k+1, and without that
+            # write the draft cache keeps a PERMANENT hole at step+k
+            # (never reprocessed: later ticks start past it),
+            # silently poisoning every subsequent proposal for the
+            # lane's lifetime (measured: acceptance collapsed to ~0
+            # after the first burst). The same discipline is why the
+            # adaptive k=0 rung keeps a one-step draft keepalive
+            # (_draft_keepalive) in front of the plain body. ----
+            proposals, dprob_rows = [], []
+            prev = cur_tok
+            for j in range(k + 1):
+                stepj = stepv if j == 0 else layers.elementwise_add(
+                    stepv, layers.fill_constant([1], "int64",
+                                                float(j)))
+                stepj2 = layers.reshape(stepj, [rows, 1])
+                t_mask_j = layers.cast(
+                    layers.equal(positions, stepj2),
+                    "float32")                             # [R,maxT]
+                x = layers.embedding(prev, size=[vocab, dd],
+                                     param_attr=ParamAttr(
+                                         name=f"{draft.prefix}"
+                                              f"tgt_word_emb"))
+                x = layers.unsqueeze(x, [1])               # [R,1,dd]
+                x = layers.scale(x, scale=dd ** 0.5)
+                pos_e = layers.matmul(t_mask_j, dpos_table)
+                x = layers.elementwise_add(
+                    x, layers.unsqueeze(pos_e, [1]))
+                dbias = layers.reshape(
+                    layers.scale(layers.cast(layers.greater_than(
+                        posf, layers.cast(stepj2, "float32")),
+                        "float32"), scale=-1e9),
+                    [rows, 1, 1, maxT])
+                wm = layers.reshape(t_mask_j, [rows, 1, maxT, 1])
+                km = layers.reshape(
+                    layers.elementwise_sub(
+                        layers.fill_constant([rows, maxT], "float32",
+                                             1.0), t_mask_j),
+                    [rows, 1, maxT, 1])
+                dcaches = [
+                    _DenseLaneCache(
+                        sv[f"{state_prefix}draft_self_k{li}"],
+                        sv[f"{state_prefix}draft_self_v{li}"],
+                        wm, km)
+                    for li in range(draft.n_layers)]
+                dcross = [(sv[f"{state_prefix}draft_cross_k{li}"],
+                           sv[f"{state_prefix}draft_cross_v{li}"])
+                          for li in range(draft.n_layers)]
+                x = cached_decoder_step(x, dcaches, dcross, dbias,
+                                        dd, dH, draft.d_inner,
+                                        prefix=draft.prefix)
+                if j == k:
+                    # the cache-fill-only step: position step+k's KV
+                    # is written (the full-acceptance hole), no
+                    # proposal
+                    break
+                dlogits = layers.fc(
+                    layers.reshape(x, [0, dd]), vocab,
+                    bias_attr=False,
+                    param_attr=f"{draft.prefix}logits.w")  # [R,V]
+                dprobs = layers.filtered_softmax(
+                    dlogits, temperature=samp.temperature,
+                    top_k=samp.top_k, top_p=samp.top_p)
+                if greedy:
+                    tok_j = layers.cast(
+                        layers.argmax(dprobs, axis=-1), "int64")
+                else:
+                    tok_j = layers.sample_categorical(
+                        dprobs, seedv,
+                        layers.elementwise_add(
+                            stepj, layers.fill_constant(
+                                [1], "int64", 1.0)),
+                        noise_tag=1, base_seed=samp.base_seed)
+                proposals.append(tok_j)
+                dprob_rows.append(layers.unsqueeze(dprobs, [1]))
+                prev = layers.reshape(tok_j, [rows, 1])
 
         # ---- target verify: ONE batched Q-query cached step over
         # [current token, k proposals] ----
@@ -2361,7 +2710,8 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
                         got, [rows, n_heads, seq_len, head_dim]))
                 cross_kv.append(tuple(pair))
         x = cached_decoder_step(x, caches, cross_kv, bias, d_model,
-                                n_heads, d_inner, q=Q)     # [R,Q,D]
+                                n_heads, d_inner, q=Q,
+                                qkv_interleaved=qkv_il)    # [R,Q,D]
         logits_q = layers.fc(x, vocab, num_flatten_dims=2,
                              bias_attr=False,
                              param_attr="logits.w")        # [R,Q,V]
@@ -2397,23 +2747,95 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         live = layers.reduce_sum(act, keep_dim=True)       # [1]
         k_const = layers.fill_constant([1], "int64", float(k))
         one_c = layers.fill_constant([1], "int64", 1.0)
-        for name, delta in (
-                ("spec_proposed",
-                 layers.elementwise_mul(live, k_const)),
-                ("spec_accepted",
-                 layers.reduce_sum(
-                     layers.elementwise_mul(accepted, act),
-                     keep_dim=True)),
-                ("spec_emitted",
-                 layers.reduce_sum(adv_g, keep_dim=True)),
-                ("spec_draft_steps", k_const),
-                ("spec_target_steps", one_c)):
+        acc_live = layers.elementwise_mul(accepted, act)   # [R]
+        bumps = [
+            ("spec_proposed",
+             layers.elementwise_mul(live, k_const)),
+            ("spec_accepted",
+             layers.reduce_sum(acc_live, keep_dim=True)),
+            ("spec_emitted",
+             layers.reduce_sum(adv_g, keep_dim=True)),
+            ("spec_target_steps", one_c)]
+        if not ngram:
+            # the n-gram lane runs ZERO draft-model steps — keeping
+            # this counter honest is what makes the devtel
+            # draft/target step ratio meaningful per flavor
+            bumps.append(("spec_draft_steps", k_const))
+        # per-lane acceptance telemetry: the host controller
+        # (inference/spec_controller.py) deltas these each dispatch
+        # to re-bucket lanes across the pre-built k ladder
+        bumps.append(("spec_lane_accepted", acc_live))
+        bumps.append(("spec_lane_ticks", act))
+        for name, delta in bumps:
             var = sv[f"{state_prefix}{name}"]
             layers.assign(layers.elementwise_add(var, delta),
                           output=var)
         layers.assign(new_step, output=stepv)
         layers.assign(new_act, output=act)
         layers.assign(new_fin, output=fin)
+
+    def _draft_keepalive(sv):
+        # adaptive k=0 rung, model drafts only: run ONE cached draft
+        # step at the current position (output dead-coded by XLA)
+        # purely to keep the draft KV cache hole-free. Without it a
+        # lane parked at k=0 advances its counter past positions the
+        # draft never processed, and every later re-promotion to
+        # k>0 proposes from a holey cache — the same permanent-hole
+        # failure mode as skipping the j==k cache-fill step.
+        dd, dH = draft.d_model, draft.n_heads
+        stepv = sv[f"{state_prefix}step"]
+        tok_buf = sv[f"{state_prefix}tok_buf"]
+        positions = layers.cast(layers.range(0, maxT, 1), "int64")
+        posf = layers.cast(positions, "float32")
+        dpos_table = layers.assign(
+            T._position_encoding(max(seq_len, maxT), dd)[:maxT])
+        step2 = layers.reshape(stepv, [rows, 1])
+        t_mask = layers.cast(layers.equal(positions, step2),
+                             "float32")                    # [R,maxT]
+        cur_tok = layers.reduce_sum(
+            layers.elementwise_mul(tok_buf,
+                                   layers.cast(t_mask, "int64")),
+            dim=1, keep_dim=True)                          # [R,1]
+        x = layers.embedding(cur_tok, size=[vocab, dd],
+                             param_attr=ParamAttr(
+                                 name=f"{draft.prefix}tgt_word_emb"))
+        x = layers.unsqueeze(x, [1])
+        x = layers.scale(x, scale=dd ** 0.5)
+        pos_e = layers.matmul(t_mask, dpos_table)
+        x = layers.elementwise_add(x, layers.unsqueeze(pos_e, [1]))
+        dbias = layers.reshape(
+            layers.scale(layers.cast(layers.greater_than(
+                posf, layers.cast(step2, "float32")), "float32"),
+                scale=-1e9),
+            [rows, 1, 1, maxT])
+        wm = layers.reshape(t_mask, [rows, 1, maxT, 1])
+        km = layers.reshape(
+            layers.elementwise_sub(
+                layers.fill_constant([rows, maxT], "float32", 1.0),
+                t_mask),
+            [rows, 1, maxT, 1])
+        dcaches = [
+            _DenseLaneCache(sv[f"{state_prefix}draft_self_k{li}"],
+                            sv[f"{state_prefix}draft_self_v{li}"],
+                            wm, km)
+            for li in range(draft.n_layers)]
+        dcross = [(sv[f"{state_prefix}draft_cross_k{li}"],
+                   sv[f"{state_prefix}draft_cross_v{li}"])
+                  for li in range(draft.n_layers)]
+        cached_decoder_step(x, dcaches, dcross, dbias, dd, dH,
+                            draft.d_inner, prefix=draft.prefix)
+
+    def _k0_body(sv):
+        # graceful k->0 degradation: the plain (non-speculative) step
+        # body — one target step, one token — plus the draft-cache
+        # keepalive for model drafts. Spec scalar/lane counters are
+        # deliberately NOT bumped (nothing proposed, nothing
+        # verified); only the per-k tick counter records residency.
+        if draft.kind == "model":
+            _draft_keepalive(sv)
+        _step_body(sv)
+        _tel_add(sv, devtel.spec_k_logical(0),
+                 layers.fill_constant([1], "int64", 1.0))
 
     body = _spec_step_body if spec else _step_body
 
@@ -2435,13 +2857,19 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
     # empty queue sets min_active = 0 and the burst drains the pool.
     # One specialization per admission flavor x bucket (0: no
     # admission). ---------------------------------------------------
-    def _build_serve(tier, A):
+    def _build_serve(tier, A, step_body=None):
         def pre(sv):
             if A > 0:
                 admit_bodies[tier](sv, A)
-        return _serve_program(pre)
+        return _serve_program(pre, step_body)
 
-    def _serve_program(pre_body):
+    def _serve_program(pre_body, step_body=None):
+        # step_body overrides the bundle's default tick body — the
+        # adaptive-k serve variants swap in _spec_step_body(k=kv) or
+        # _k0_body while sharing the SAME slot-state specs, so
+        # controller re-bucketing is pure program selection (all
+        # executables built up front, zero steady-state compiles)
+        step_body = body if step_body is None else step_body
         prog = fluid.Program()
         with fluid.program_guard(prog, fluid.Program()):
             sv = _mark_ownership(
@@ -2489,7 +2917,7 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
             cond = _serve_cond()
             w = layers.While(cond)
             with w.block():
-                body(sv)
+                step_body(sv)
                 layers.increment(k, 1)
                 _serve_cond(cond=cond)
             # devtel: classify THIS burst's exit exactly once, after
@@ -2702,6 +3130,33 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         for p in range(2 * n_layers + 2):
             serves[("chunked", p)] = _serve_program(
                 lambda sv, _p=p: _chunk_phase_body(sv, _p))
+    if spec and draft.k_options:
+        # --- adaptive-k serve variants: for every non-default rung
+        # of the ladder, rebuild each (admission x bucket) flavor
+        # with the tick body pinned at that k. Keyed ("k", kv,
+        # base_key); serve_feed_spec recurses to the base key, and
+        # every variant declares the SAME slot-state specs, so the
+        # host controller re-buckets lanes by pure program selection
+        # — the executable count is bounded at build time
+        # (|ladder|-1 extra copies of the non-chunked serve set) and
+        # steady state compiles NOTHING. k decisions stay host
+        # policy: no new device predicate is minted here (the burst
+        # cond is the same lane_active_mask-marked one).
+        base_keys = [bk for bk in serves
+                     if not (isinstance(bk, tuple)
+                             and bk[0] == "chunked")]
+        for kv in draft.k_options:
+            if kv == draft.k:
+                continue
+            kv_body = (_k0_body if kv == 0
+                       else (lambda sv, _k=kv:
+                             _spec_step_body(sv, _k)))
+            for bk in base_keys:
+                tier, A = (bk, 0) if bk == 0 else (
+                    ("miss", bk) if isinstance(bk, int)
+                    else bk)
+                serves[("k", kv, bk)] = _build_serve(
+                    tier, A, step_body=kv_body)
 
     # --- COW block copy (paged only): gather the SHARED source rows
     # and masked-write them into freshly allocated EXCLUSIVE blocks —
@@ -2769,8 +3224,12 @@ def build_decode_step_program(seq_len=16, max_out_len=16, d_model=64,
         state["seed"] = f"{state_prefix}seed"
     if spec:
         for c in ("spec_proposed", "spec_accepted", "spec_emitted",
-                  "spec_draft_steps", "spec_target_steps"):
+                  "spec_draft_steps", "spec_target_steps",
+                  "spec_lane_accepted", "spec_lane_ticks"):
             state[c] = f"{state_prefix}{c}"
+        if draft.k_options:
+            state.update(devtel.spec_k_state_entries(
+                state_prefix, draft.k_options))
     # devtel counters join the state map (and therefore the PTA150
     # counter-presence sweep) under their logical names
     state.update(devtel.state_entries(state_prefix, paged))

@@ -1,0 +1,193 @@
+"""Draft distillation — train a speculative DRAFT model on the
+TARGET's own greedy outputs and softened logits.
+
+Reference counterpart: the reference trains every model against task
+labels only (tests/unittests/dist_transformer.py:1138 transformer
+training loop); distillation composes the same program machinery — a
+teacher-forced forward of BOTH models in one program, soft-label
+``softmax_with_cross_entropy`` (operators/softmax_with_cross_entropy_
+op.cc:32 documents the soft_label path) — into the loop the reference
+never built.
+
+Why this exists (PERF.md "Speculative decoding"): task-training leaves
+the draft's CONTENT tokens at chance agreement with the target — both
+tiny models learn "emit EOS at the planted position" but their
+pre-EOS distributions are independently noisy, so measured acceptance
+collapses off the memorized pool.  The acceptance probability ``a``
+IS the speculation win (threshold a > c_spec/c_1), and ``a`` is
+maximized not by matching the DATA but by matching the TARGET — which
+is exactly the distillation objective:
+
+    loss = hard_w * CE(d_logits, argmax t_logits)            (greedy)
+         + (1-hard_w) * T^2 * CE(d_logits/T, softmax(t_logits/T))
+
+The teacher stream is the target's OWN greedy decode of real prompts
+(not the task labels), so the draft learns the distribution it will
+actually be verified against at serve time, including the target's
+mistakes.  The whole loop is in-repo and CPU-cheap: teacher rollouts
+come from the caller's decode program, gradients flow ONLY into the
+``draft.prefix``-named params (the teacher probs are stop_gradient
+and minimize() takes an explicit parameter_list), and the K inner
+steps per rollout batch ride ``Executor.run_steps`` (one scan
+dispatch instead of K host round-trips).
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+__all__ = ["build_distill_program", "distill_draft"]
+
+
+def build_distill_program(draft, *, seq_len, max_out_len, d_model,
+                          n_heads, n_layers, d_inner, vocab,
+                          temperature=2.0, hard_weight=0.5,
+                          learning_rate=0.005):
+    """Build the distillation training program: a teacher-forced
+    TARGET forward (is_test, params shared by name with the serving
+    bundle's scope) producing softened probs + greedy labels, and a
+    DRAFT forward (``draft.prefix``-named params) trained against
+    both.  Returns ``(main, startup, loss, agree)`` where ``agree``
+    fetches the per-batch argmax agreement — the in-program
+    acceptance proxy (greedy spec acceptance IS argmax agreement on
+    the accepted prefix).
+
+    Feeds: ``src_ids`` [B, seq_len] and ``tgt_ids`` [B, max_out_len]
+    — the teacher-forced decoder input, i.e. the target's own greedy
+    stream shifted right behind ``start_id`` (see ``distill_draft``).
+
+    Reference counterpart: tests/unittests/dist_transformer.py:1138
+    (transformer train program assembly); the soft-label CE is
+    operators/softmax_with_cross_entropy_op.cc:32.
+    """
+    from . import transformer as T
+
+    if draft.kind != "model":
+        raise ValueError("distillation needs a model draft "
+                         f"(draft.kind={draft.kind!r})")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = layers.data("src_ids", shape=[seq_len], dtype="int64")
+        tgt = layers.data("tgt_ids", shape=[max_out_len],
+                          dtype="int64")
+
+        def _forward(p, dm, nh, dinner):
+            enc = T._embed(src, vocab, dm, max(seq_len, max_out_len),
+                           0.0, True, f"{p}src_word_emb")
+            for li in range(n_layers):
+                enc = T.encoder_layer(enc, dm, nh, dinner, 0.0, True,
+                                      name=f"{p}enc{li}")
+            dec = T._embed(tgt, vocab, dm, max(seq_len, max_out_len),
+                           0.0, True, f"{p}tgt_word_emb")
+            for li in range(n_layers):
+                dec = T.decoder_layer(dec, enc, dm, nh, dinner, 0.0,
+                                      True, name=f"{p}dec{li}")
+            return layers.fc(dec, vocab, num_flatten_dims=2,
+                             bias_attr=False,
+                             param_attr=f"{p}logits.w")
+
+        t_logits = _forward("", d_model, n_heads, d_inner)
+        d_logits = _forward(draft.prefix, draft.d_model,
+                            draft.n_heads, draft.d_inner)
+        # teacher signals are CONSTANTS to the backward pass: the
+        # stop_gradient marks drop every target op from the grad op
+        # path (backward.py _collect_no_grad), so only draft grads
+        # are ever computed — not just ignored at apply time
+        t_soft = layers.softmax(
+            layers.scale(t_logits, scale=1.0 / float(temperature)))
+        t_soft.stop_gradient = True
+        t_hard = layers.cast(layers.argmax(t_logits, axis=-1),
+                             "int64")
+        t_hard.stop_gradient = True
+        soft_ce = layers.softmax_with_cross_entropy(
+            layers.scale(d_logits, scale=1.0 / float(temperature)),
+            t_soft, soft_label=True)
+        hard_ce = layers.softmax_with_cross_entropy(
+            d_logits, layers.unsqueeze(t_hard, [2]))
+        hw = float(hard_weight)
+        # T^2 restores the soft term's gradient scale (Hinton et al.;
+        # grads through softmax(z/T) shrink by 1/T^2)
+        loss = layers.mean(layers.elementwise_add(
+            layers.scale(hard_ce, scale=hw),
+            layers.scale(soft_ce,
+                         scale=(1.0 - hw) * float(temperature) ** 2)))
+        agree = layers.mean(layers.cast(
+            layers.equal(layers.cast(
+                layers.argmax(d_logits, axis=-1), "int64"), t_hard),
+            "float32"))
+        draft_params = [p for p in main.all_parameters()
+                        if p.name.startswith(draft.prefix)]
+        if not draft_params:
+            raise ValueError(
+                f"no params under draft prefix {draft.prefix!r}")
+        fluid.optimizer.Adam(learning_rate=learning_rate).minimize(
+            loss, parameter_list=draft_params)
+    return main, startup, loss, agree
+
+
+def distill_draft(executor, scope, draft, decode_fn, prompts_fn, *,
+                  seq_len, max_out_len, d_model, n_heads, n_layers,
+                  d_inner, vocab, start_id, end_id, rounds=20,
+                  batch=8, inner_steps=4, temperature=2.0,
+                  hard_weight=0.5, learning_rate=0.005, seed=0):
+    """Run the distillation loop against a live scope (the serving
+    bundle's — target params are read in place, draft params are
+    updated in place, so the NEXT server built on this scope serves
+    the distilled draft with no copy step).
+
+    ``decode_fn(srcs) -> [B, max_out_len] int64`` is the caller's
+    greedy decode of the TARGET (the whole-loop oracle program or a
+    server round-trip); ``prompts_fn(rng, n) -> [n, seq_len]`` draws
+    training prompts.  Each round rolls out one teacher batch, then
+    takes ``inner_steps`` optimizer steps on it as ONE
+    ``Executor.run_steps`` scan dispatch.
+
+    Returns a dict: per-round ``agree`` trajectory plus first/last —
+    the before/after the PERF.md satellite records.
+
+    Reference counterpart: tests/unittests/dist_transformer.py:1138
+    (train loop); run_steps is core/executor.py:1081.
+    """
+    main, startup, loss, agree = build_distill_program(
+        draft, seq_len=seq_len, max_out_len=max_out_len,
+        d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_inner=d_inner, vocab=vocab, temperature=temperature,
+        hard_weight=hard_weight, learning_rate=learning_rate)
+    # The startup program carries init ops for EVERY param the main
+    # program declares — including the trained TARGET's.  Running it
+    # straight into the live scope would silently re-randomize the
+    # teacher (and the serving bundle reading the same scope), so run
+    # it into a throwaway scope and copy over ONLY the vars the live
+    # scope lacks (the draft's fresh Adam moments, typically).
+    from ..core.scope import Scope
+
+    tmp = Scope()
+    executor.run(startup, scope=tmp)
+    for name in tmp.local_var_names():
+        have = scope.find_var(name)
+        if have is not None and have.get_tensor().value() is not None:
+            continue
+        val = tmp.find_var(name).get_tensor().value()
+        if val is not None:
+            scope.var(name).get_tensor().set(val)
+    rng = np.random.RandomState(seed)
+    traj = []
+    for _ in range(int(rounds)):
+        srcs = np.asarray(prompts_fn(rng, batch), np.int64)
+        out = np.asarray(decode_fn(srcs), np.int64)
+        # sentinel-normalized rows (-1 after EOS) teacher-force as
+        # end_id — the target's own post-EOS convention
+        out = np.where(out < 0, end_id, out)
+        tgt_in = np.concatenate(
+            [np.full((len(srcs), 1), start_id, np.int64),
+             out[:, :-1]], axis=1)
+        feed = {"src_ids": srcs, "tgt_ids": tgt_in}
+        fetched = executor.run_steps(
+            main, feed=feed, fetch_list=[loss, agree],
+            steps=int(inner_steps), scope=scope)
+        # [K]-stacked fetches; keep the LAST inner step's agreement
+        traj.append(float(np.asarray(fetched[1]).reshape(-1)[-1]))
+    return {"agree": traj,
+            "agree_first": traj[0] if traj else None,
+            "agree_last": traj[-1] if traj else None}

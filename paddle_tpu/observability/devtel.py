@@ -52,7 +52,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 __all__ = ["TEL_MARK", "DECODE_STEPS_VAR", "CounterSpec",
            "BUNDLE_COUNTERS", "HOST_COUNTERS", "counter_specs",
            "state_entries", "declare_decode_steps",
-           "DeviceTelemetry", "EXIT_REASONS"]
+           "spec_k_counter_specs", "spec_k_state_entries",
+           "spec_k_logical", "DeviceTelemetry", "EXIT_REASONS"]
 
 # name mark on device-telemetry counter persistables: checker PTA180
 # requires every var carrying it to be an int64, concretely-shaped,
@@ -216,6 +217,39 @@ def state_entries(prefix: str, paged: bool,
             for c in bundle_counters(paged, chunked)}
 
 
+_SPEC_K_STEM = "tel_spec_ticks_k"
+
+
+def spec_k_logical(k: int) -> str:
+    """Logical name of the per-k speculative tick counter: bumped once
+    per step-body invocation of the serve variant built at draft
+    length k, so windows over these counters show which rungs of the
+    adaptive-k ladder actually ran on-device (the controller's
+    decisions, observed from the device side). Reference counterpart:
+    the profiler event-name table (platform/profiler.h:166)."""
+    return f"{_SPEC_K_STEM}{int(k)}"
+
+
+def spec_k_counter_specs(prefix: str,
+                         k_options: Iterable[int]) -> Dict[str, tuple]:
+    """Slot-state spec entries for the adaptive-speculation per-k tick
+    counters, one per rung of the bundle's k ladder — same @TEL-marked
+    [1] int64 RMW contract as counter_specs (checker PTA180 covers
+    them identically). Reference counterpart: none — the reference
+    fast-decode path has no draft-length ladder
+    (operators/math/sequence2batch.h:47)."""
+    return {f"{prefix}{spec_k_logical(k)}{TEL_MARK}": ((1,), "int64")
+            for k in k_options}
+
+
+def spec_k_state_entries(prefix: str,
+                         k_options: Iterable[int]) -> Dict[str, str]:
+    """logical -> var name entries for ``DecodeStepBundle.state``
+    covering the per-k tick counters (see spec_k_counter_specs)."""
+    return {spec_k_logical(k): f"{prefix}{spec_k_logical(k)}{TEL_MARK}"
+            for k in k_options}
+
+
 def declare_decode_steps(block):
     """Create the fixed-name whole-loop tick counter (the ONE copy of
     the create_var + fill_constant plumbing both whole-loop builders
@@ -260,6 +294,18 @@ class DeviceTelemetry:
                           if c.logical in state]
         self._metric_by_logical = {
             c.logical: c.metric for c in BUNDLE_COUNTERS}
+        # adaptive-speculation per-k tick counters are parametrized by
+        # the bundle's k ladder (spec_k_counter_specs), so they join
+        # dynamically: sorted by k for a stable fetch order
+        spec_k = sorted(
+            (logical for logical in state
+             if logical.startswith(_SPEC_K_STEM)),
+            key=lambda s: int(s[len(_SPEC_K_STEM):]))
+        for logical in spec_k:
+            self._counters.append((logical, state[logical]))
+            self._metric_by_logical[logical] = \
+                f"paddle_tpu_devtel_spec_ticks_k" \
+                f"{logical[len(_SPEC_K_STEM):]}_total"
         self.totals: Dict[str, int] = {
             logical: 0 for logical, _ in self._counters}
         self._base: Dict[str, int] = dict(self.totals)
@@ -311,8 +357,8 @@ class DeviceTelemetry:
         window() snapshot: raw counters under their stat keys plus
         the derived mean live-lane occupancy."""
         by_logical = {c.logical: c.stat for c in BUNDLE_COUNTERS}
-        out = {by_logical[logical]: window[logical]
-               for logical, _ in self._counters}
+        out = {by_logical.get(logical, logical[len("tel_"):]):
+               window[logical] for logical, _ in self._counters}
         ticks = window.get("tel_ticks", 0)
         occ = window.get("tel_occupancy", 0)
         out["mean_live_lanes"] = (round(occ / ticks, 4)

@@ -389,6 +389,79 @@ class TestRulesMatchGSPMD:
 
 
 # ---------------------------------------------------------------------------
+# head-interleaved fused qkv (r19 satellite): the exact decomposition
+# chain cached_decoder_step builds, pinned against GSPMD
+# ---------------------------------------------------------------------------
+class TestInterleavedQKV:
+    """The r17 leftover closed by ``qkv_interleaved``: with the fused
+    qkv columns ``[H, 3, Dh]``-major, a dim-1 column shard on the
+    weight must carry through matmul → reshape (major-carry onto the
+    HEAD axis) → split on the local 3-axis → squeeze → transpose and
+    land head-sharded, with zero reshard events.  The contiguous
+    ``[3, H, Dh]``-major layout fails at the very first split (it
+    slices ACROSS tp shard boundaries) — which is why it deliberately
+    stays replicated (ShardingConfig docstring)."""
+
+    R, D, H, DH = 8, 16, 4, 4  # 3D = 48, tp=2 divides H
+
+    def _chain(self, interleaved):
+        """Build cached_decoder_step's qkv decomposition through the
+        real layer path; returns (main, out_var, facts)."""
+        main, startup, g = _guarded()
+        R, D, H, DH = self.R, self.D, self.H, self.DH
+        with g:
+            x = _data("x", (R, 1, D))
+            w = _data("w", (D, 3 * D), {1: "tp"})
+            qkv = layers.matmul(x, w)  # [R,1,3D]
+            if interleaved:
+                z = layers.reshape(qkv, [R, 1, H, 3, DH])
+                zq = layers.split(z, 3, dim=3)[0]
+                out = layers.transpose(layers.squeeze(zq, axes=[3]),
+                                       perm=[0, 2, 1, 3])
+            else:
+                qv = layers.split(qkv, 3, dim=2)[0]  # [R,1,D]
+                z = layers.reshape(qv, [R, 1, H, DH])
+                out = layers.transpose(z, perm=[0, 2, 1, 3])
+        absint.set_mesh(main, MESH)
+        facts = absint.analyze(main)
+        assert facts.converged
+        return main, out, facts
+
+    def test_interleaved_carries_head_shard_matches_gspmd(self):
+        import jax.numpy as jnp
+
+        R, D, H, DH = self.R, self.D, self.H, self.DH
+        _, out, facts = self._chain(interleaved=True)
+        spec = facts.spec(out.name)
+
+        def fn(a, b):
+            z = (a @ b).reshape(R, 1, H, 3, DH)
+            zq = jnp.split(z, 3, axis=3)[0]
+            return jnp.transpose(jnp.squeeze(zq, 3), (0, 2, 1, 3))
+
+        want = _jax_out_pspec(
+            fn,
+            [np.zeros((R, 1, D), np.float32),
+             np.zeros((D, 3 * D), np.float32)],
+            [(None, None, None), (None, "tp")], 4)
+        assert _spec_to_pspec(spec, 4) == want == \
+            (None, "tp", None, None)
+        # the whole decomposition is LOCAL under the column shard
+        assert not [es for es in facts.collective_events
+                    if es.event.kind == "reshard"]
+
+    def test_contiguous_split_forces_reshard(self):
+        _, out, facts = self._chain(interleaved=False)
+        # the fused-axis split crosses tp shard boundaries: the rule
+        # records the forced reshard and drops the placement — the
+        # reason the contiguous layout ships replicated
+        reshards = [es for es in facts.collective_events
+                    if es.event.kind == "reshard"]
+        assert reshards and reshards[0].event.axes == ("tp",)
+        assert facts.spec(out.name).axes() == ()
+
+
+# ---------------------------------------------------------------------------
 # PTA160: sharding contradiction / implicit reshard
 # ---------------------------------------------------------------------------
 class TestPTA160:
