@@ -583,39 +583,44 @@ def release_sites() -> Dict[Tuple[str, str], List[str]]:
 #   session_close close_session releasing a pinned entry
 #   server_close  close() draining lanes, jobs, and handoff refs
 #   handoff       disagg prefill->decode ownership transfer
-# "cancel" (the front-door tentpole) is DELIBERATELY not declared
-# yet: when cancellation lands it must extend these contracts, and
-# PTA201 will flag every tag until its release sites register — that
-# is the designed failure mode, not an oversight.
+#   cancel        client cancellation / deadline expiry (the r20
+#                 front door): a queued, chunking, or LIVE request is
+#                 torn down mid-hold at the next burst boundary.
+#                 Deadline expiry RIDES this exit (a deadline miss is
+#                 a server-initiated cancel — same release path, a
+#                 different recorded reason), so one exit covers both
+#                 and a tag with no cancel site leaks once per
+#                 abandoned request until admission wedges.
 register_acquire_release(
     "block_table", acquire="HostBlockPool.alloc",
     release="HostBlockPool.decref",
-    exits=("retire", "preempt", "server_close"),
+    exits=("retire", "preempt", "cancel", "server_close"),
     resource="HostBlockPool")
 register_acquire_release(
     "host_indices", acquire="PromptPrefixCache.acquire_fresh",
     release="PromptPrefixCache.release",
-    exits=("retire", "abort", "invalidate", "server_close"),
+    exits=("retire", "abort", "invalidate", "cancel",
+           "server_close"),
     resource="PromptPrefixCache")
 register_acquire_release(
     "prompt_entry_ref", acquire="PromptPrefixCache.acquire_hit",
     release="PromptPrefixCache.release",
-    exits=("retire", "session_close", "server_close"),
+    exits=("retire", "session_close", "cancel", "server_close"),
     resource="PromptPrefixCache")
 register_acquire_release(
     "cow_src", acquire="RadixBlockTree.acquire",
     release="RadixBlockTree.release",
-    exits=("retire", "preempt", "evict", "server_close"),
+    exits=("retire", "preempt", "evict", "cancel", "server_close"),
     resource="HostBlockPool")
 register_acquire_release(
     "cow_dst", acquire="HostBlockPool.alloc",
     release="HostBlockPool.decref",
-    exits=("retire", "preempt", "server_close"),
+    exits=("retire", "preempt", "cancel", "server_close"),
     resource="HostBlockPool")
 register_acquire_release(
     "chunk_cursor", acquire="PromptPrefixCache.acquire_fresh",
     release="PromptPrefixCache.release",
-    exits=("handoff", "abort", "server_close"),
+    exits=("handoff", "abort", "cancel", "server_close"),
     resource="PromptPrefixCache")
 
 

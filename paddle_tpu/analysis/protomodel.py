@@ -275,6 +275,18 @@ def block_pool_protocol(n_blocks: int = 2, n_lanes: int = 2,
             pool.decref(b)
         lane["blocks"], lane["shared"] = [], []
 
+    def cancel(lane, pool):
+        # the r20 cancel/deadline exit (_cancel_lane_locked): a
+        # SEPARATE closure with the same unwinding as retirement —
+        # kept distinct so the dropped-decref-on-cancel mutation
+        # test can seed a bug in THIS path alone and the explorer
+        # names `cancel[i]` in the minimal counterexample trace
+        for b in reversed(lane["shared"]):
+            pool.decref(b)
+        for b in reversed(lane["blocks"]):
+            pool.decref(b)
+        lane["blocks"], lane["shared"] = [], []
+
     actions: List[Action] = []
     for li in range(n_lanes):
         def alloc(s, li=li):
@@ -316,6 +328,15 @@ def block_pool_protocol(n_blocks: int = 2, n_lanes: int = 2,
             lambda s, li=li: bool(s["lanes"][li]["blocks"]
                                   or s["lanes"][li]["shared"]),
             do_retire))
+
+        def do_cancel(s, li=li):
+            cancel(s["lanes"][li], s["pool"])
+
+        actions.append(Action(
+            f"cancel[{li}]",
+            lambda s, li=li: bool(s["lanes"][li]["blocks"]
+                                  or s["lanes"][li]["shared"]),
+            do_cancel))
 
     def holds_of(s):
         holds: Dict[int, int] = {}
@@ -408,6 +429,18 @@ def prefix_cache_protocol(n_entries: int = 1, n_prompts: int = 2,
             f"release[{ci}]",
             lambda s, ci=ci: s["clients"][ci] is not None,
             release))
+
+        def cancel(s, ci=ci):
+            # r20 cancel exit: a torn-down holder drops its entry
+            # ref exactly like retirement (separate closure for the
+            # mutation test — see block_pool_protocol)
+            s["cache"].release(s["clients"][ci])
+            s["clients"][ci] = None
+
+        actions.append(Action(
+            f"cancel[{ci}]",
+            lambda s, ci=ci: s["clients"][ci] is not None,
+            cancel))
     if with_abort:
         for p in prompts:
             def invalidate(s, p=p):
@@ -541,6 +574,24 @@ def radix_protocol(n_blocks: int = 3, n_lanes: int = 2,
             lambda s, li=li: s["lanes"][li]["tokens"] is not None,
             do_retire))
 
+        def do_cancel(s, li=li):
+            # r20 cancel exit: tree-aware release of the shared
+            # prefix + reversed decref of the exclusive tail — the
+            # same unwinding _cancel_lane_locked routes through
+            # _free_lane_locked (separate closure for the mutation
+            # test)
+            lane = s["lanes"][li]
+            s["tree"].release(lane["shared"])
+            for b in reversed(lane["blocks"]):
+                s["pool"].decref(b)
+            lane.update(blocks=[], shared=[], tokens=None,
+                        inserted=False)
+
+        actions.append(Action(
+            f"cancel[{li}]",
+            lambda s, li=li: s["lanes"][li]["tokens"] is not None,
+            do_cancel))
+
     def evict(s):
         s["tree"].evict(1)
 
@@ -636,6 +687,23 @@ def session_protocol(n_entries: int, n_prompts: int,
             f"harvest[{si}]",
             lambda s, si=si: s["sessions"][si]["st"] == "active",
             harvest))
+
+        def cancel(s, si=si):
+            # r20 cancel exit on an ACTIVE (mid-turn) session: the
+            # lane's entry ref drops (_cancel_lane_locked) and the
+            # turn never harvests — the session itself survives and
+            # re-requests, so the state returns to "want". Pinned
+            # sessions are untouched (their pin releases only via
+            # close_session), so the infeasible-config deadlock
+            # witness and session_feasible's verdict are unchanged.
+            sess = s["sessions"][si]
+            s["cache"].release(sess["entry"])
+            sess.update(st="want", entry=None)
+
+        actions.append(Action(
+            f"cancel[{si}]",
+            lambda s, si=si: s["sessions"][si]["st"] == "active",
+            cancel))
         if allow_close:
             def close(s, si=si):
                 sess = s["sessions"][si]

@@ -20,14 +20,16 @@ from .predictor import (AnalysisPredictor, PaddlePredictor, PaddleTensor,
                         ZeroCopyTensor, create_paddle_predictor)
 from .spec_controller import SpecController, choose_draft_placement
 from .serving import (AdmissionInfeasible, BlockPoolExhausted,
-                      ContinuousGenerationServer,
-                      GenerationServer, InferenceServer,
-                      PagedBeamDecoder,
-                      PagedContinuousGenerationServer, ServerClosed,
-                      ServerQuiesced, apply_eos_sentinel,
+                      ContinuousGenerationServer, DeadlineExceeded,
+                      GenerationReply, GenerationServer,
+                      InferenceServer, PagedBeamDecoder,
+                      PagedContinuousGenerationServer,
+                      RequestCancelled, ServerClosed,
+                      ServerQuiesced, ServingUnavailable,
+                      StreamingReply, apply_eos_sentinel,
                       count_generated_tokens, default_batch_buckets)
-from .runtime import (AdmissionError, ModelRegistry, Router,
-                      ServingRuntime)
+from .runtime import (AdmissionError, DeadlineUnmeetable,
+                      ModelRegistry, Router, ServingRuntime)
 
 __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "AnalysisPredictor", "PaddlePredictor", "PaddleTensor",
@@ -38,8 +40,10 @@ __all__ = ["AnalysisConfig", "NativeConfig", "PaddleDType",
            "GenerationServer", "ContinuousGenerationServer",
            "PagedContinuousGenerationServer", "PagedBeamDecoder",
            "BlockPoolExhausted", "AdmissionInfeasible",
+           "ServingUnavailable", "RequestCancelled",
+           "DeadlineExceeded", "StreamingReply", "GenerationReply",
            "ServerClosed", "ServerQuiesced", "apply_eos_sentinel",
            "count_generated_tokens", "default_batch_buckets",
            "ServingRuntime", "ModelRegistry", "Router",
-           "AdmissionError", "SpecController",
+           "AdmissionError", "DeadlineUnmeetable", "SpecController",
            "choose_draft_placement"]

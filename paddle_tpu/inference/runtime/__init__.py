@@ -34,11 +34,13 @@ from typing import Optional
 from .placement import (MeshPlacement, ReplicaSet, place_scope_on_device,
                         plan_mesh)
 from .registry import ModelHandle, ModelRegistry, server_fingerprint
-from .router import AdmissionError, Router, TenantConfig
+from .router import (AdmissionError, DeadlineUnmeetable, Router,
+                     TenantConfig)
 from .stats import RuntimeStats
 
 __all__ = ["ServingRuntime", "ModelRegistry", "ModelHandle",
-           "Router", "TenantConfig", "AdmissionError", "RuntimeStats",
+           "Router", "TenantConfig", "AdmissionError",
+           "DeadlineUnmeetable", "RuntimeStats",
            "server_fingerprint", "MeshPlacement", "ReplicaSet",
            "plan_mesh", "place_scope_on_device"]
 
@@ -103,8 +105,12 @@ class ServingRuntime:
     def add_tenant(self, name: str, **cfg) -> TenantConfig:
         return self.router.add_tenant(name, **cfg)
 
-    def submit(self, tenant: str, model: str, payload):
-        return self.router.submit(tenant, model, payload)
+    def submit(self, tenant: str, model: str, payload,
+               deadline_ms: Optional[float] = None,
+               n_tokens: Optional[int] = None):
+        return self.router.submit(tenant, model, payload,
+                                  deadline_ms=deadline_ms,
+                                  n_tokens=n_tokens)
 
     def infer(self, tenant: str, model: str, payload,
               timeout: Optional[float] = 60.0):

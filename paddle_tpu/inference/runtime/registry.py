@@ -152,11 +152,14 @@ class ModelHandle:
             return runner.executor
         return self.server.executor
 
-    def submit(self, payload):
+    def submit(self, payload, **kw):
         """Forward one request payload verbatim to the server's
         submit (a feed dict for InferenceServer/GenerationServer, a
-        prompt row for ContinuousGenerationServer)."""
-        return self.server.submit(payload)
+        prompt row for ContinuousGenerationServer). Keyword arguments
+        (the Router's deadline_ms propagation, stream=...) forward
+        unmodified — a server without the parameter fails LOUDLY
+        (TypeError) rather than silently dropping an SLO."""
+        return self.server.submit(payload, **kw)
 
     def stats(self, reset: bool = False) -> dict:
         return self.server.stats(reset=reset)

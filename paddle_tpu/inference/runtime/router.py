@@ -48,28 +48,57 @@ import time
 from concurrent import futures
 from typing import Dict, Optional
 
+from ...models.decode_engine import ServingUnavailable
 from ...observability import flight as obs_flight
 from ...observability import metrics as obs_metrics
 from ...observability import tracing as obs_tracing
 from ...observability.metrics import Histogram
-from ..serving import ServerClosed, ServerQuiesced, _pct_dict
+from ..serving import DeadlineExceeded, _pct_dict
 
-__all__ = ["AdmissionError", "Router", "TenantConfig"]
+__all__ = ["AdmissionError", "DeadlineUnmeetable", "Router",
+           "TenantConfig"]
+
+# pressure rejections tell the client when capacity plausibly
+# returns: one DRR pass / token-bucket refill granularity
+_RETRY_AFTER_MS = {"rate-limited": 100.0, "queue-full": 20.0}
 
 
-class AdmissionError(RuntimeError):
+class AdmissionError(ServingUnavailable):
     """Named request rejection at the front door. `reason` is
     machine-readable: rate-limited | queue-full | unknown-tenant |
-    unknown-model | router-closed. No direct reference counterpart
-    (the reference serves one tenant per process; see the Router
-    docstring)."""
+    unknown-model | router-closed | deadline-unmeetable. Part of the
+    ServingUnavailable taxonomy: clients and the router itself
+    dispatch on the type and its `retryable`/`retry_after_ms`
+    attributes ONLY — pressure rejections (rate-limited, queue-full)
+    are retryable, configuration/terminal ones are not. No direct
+    reference counterpart (the reference serves one tenant per
+    process; see the Router docstring)."""
 
     def __init__(self, reason: str, detail: str = ""):
         self.reason = reason
+        self.retryable = reason in _RETRY_AFTER_MS
+        self.retry_after_ms = _RETRY_AFTER_MS.get(reason)
         msg = f"admission rejected ({reason})"
         if detail:
             msg += f": {detail}"
         super().__init__(msg)
+
+
+class DeadlineUnmeetable(AdmissionError):
+    """Deadline-aware shed: the costmodel-estimated completion time
+    exceeds the request's ``deadline_ms``, so admitting it would burn
+    slots/blocks on a response nobody can use — rejected BEFORE it
+    occupies anything. ``retryable`` is True when only the current
+    backlog makes the deadline unmeetable (the same request can
+    succeed against an idle server), False when the service-time
+    estimate ALONE exceeds the deadline. No reference counterpart
+    (see AdmissionError)."""
+
+    def __init__(self, detail: str = "", retryable: bool = False,
+                 retry_after_ms: Optional[float] = None):
+        super().__init__("deadline-unmeetable", detail)
+        self.retryable = bool(retryable)
+        self.retry_after_ms = retry_after_ms
 
 
 class TenantConfig:
@@ -122,14 +151,19 @@ class TenantConfig:
 
 class _Routed:
     __slots__ = ("model", "payload", "reply", "t_submit", "t_dispatch",
-                 "rid", "trace")
+                 "rid", "trace", "deadline")
 
-    def __init__(self, model, payload):
+    def __init__(self, model, payload, deadline=None):
         self.model = model
         self.payload = payload
         self.reply = futures.Future()
         self.t_submit = time.monotonic()
         self.t_dispatch = None
+        # absolute monotonic completion deadline (None = no SLO):
+        # checked again at dispatch — a request that expired while
+        # QUEUED is failed typed instead of forwarded, and the live
+        # remainder propagates to the server's own deadline teardown
+        self.deadline = deadline
         # observability: request id (metrics level and up — names the
         # request in flight-recorder incident reports) and the span
         # Trace (trace level only; the router owns its lifecycle)
@@ -140,8 +174,8 @@ class _Routed:
 class _TenantState:
     __slots__ = ("cfg", "queue", "tokens", "t_refill", "deficit",
                  "admitted", "rejected_rate", "rejected_queue",
-                 "completed", "failed", "slo_violations",
-                 "latencies", "queue_ms", "ttft")
+                 "rejected_deadline", "completed", "failed",
+                 "slo_violations", "latencies", "queue_ms", "ttft")
 
     def __init__(self, cfg: TenantConfig):
         self.cfg = cfg
@@ -152,6 +186,7 @@ class _TenantState:
         self.admitted = 0
         self.rejected_rate = 0
         self.rejected_queue = 0
+        self.rejected_deadline = 0
         self.completed = 0
         self.failed = 0
         self.slo_violations = 0
@@ -270,11 +305,36 @@ class Router:
         return tc
 
     # --- request path -------------------------------------------------
-    def submit(self, tenant: str, model: str, payload):
+    def submit(self, tenant: str, model: str, payload,
+               deadline_ms: Optional[float] = None,
+               n_tokens: Optional[int] = None):
         """Admit one request for `tenant` against model alias `model`;
         returns a future. Rejections raise AdmissionError
         synchronously — callers see WHY at the call site instead of a
-        timeout later."""
+        timeout later.
+
+        ``deadline_ms`` is a completion SLO relative to now. Two
+        things happen: (1) deadline-aware SHED — when the target
+        server exposes a calibrated costmodel estimate
+        (``expected_service_ms``; ContinuousGenerationServer does)
+        and estimated service x (1 + backlog/max_inflight) exceeds
+        the deadline, the request is rejected HERE with the typed
+        ``DeadlineUnmeetable`` before it occupies a queue slot, a
+        lane, or a KV block — under overload the box spends capacity
+        only on requests that can still meet their SLO (goodput
+        degrades linearly instead of collapsing; bench.py frontdoor
+        pins the shed-vs-noshed ratio). (2) PROPAGATION — an admitted
+        deadline rides the request: expiry while queued fails it
+        typed at dispatch, and the live remainder forwards into the
+        server's own burst-boundary teardown. ``n_tokens`` refines
+        the estimate for requests expected to generate fewer than
+        max_out_len tokens."""
+        deadline = None
+        if deadline_ms is not None:
+            if deadline_ms <= 0:
+                raise ValueError(
+                    f"deadline_ms must be > 0, got {deadline_ms}")
+            deadline = time.monotonic() + deadline_ms / 1e3
         with self._cv:
             if self._closed:
                 raise AdmissionError("router-closed", "")
@@ -284,7 +344,7 @@ class Router:
                     "unknown-tenant",
                     f"{tenant!r}; known: {sorted(self._tenants)}")
             try:
-                self._registry.get(model)
+                handle = self._registry.get(model)
             except KeyError as e:
                 raise AdmissionError("unknown-model", str(e)) from None
             cfg = state.cfg
@@ -297,6 +357,21 @@ class Router:
                     "queue-full",
                     f"tenant {tenant!r} queue at max_queue="
                     f"{cfg.max_queue}")
+            if deadline_ms is not None:
+                est = self._estimate_wait_locked(model, handle,
+                                                 n_tokens)
+                if est is not None:
+                    service_ms, wait_ms = est
+                    if wait_ms > deadline_ms:
+                        state.rejected_deadline += 1
+                        raise DeadlineUnmeetable(
+                            f"estimated completion {wait_ms:.0f} ms "
+                            f"(service {service_ms:.0f} ms + backlog)"
+                            f" > deadline_ms={deadline_ms:g}",
+                            # meetable on an idle box: worth retrying
+                            # once the backlog clears
+                            retryable=service_ms <= deadline_ms,
+                            retry_after_ms=service_ms)
             if cfg.rate is not None:
                 now = time.monotonic()
                 state.tokens = min(
@@ -310,7 +385,7 @@ class Router:
                         f"tenant {tenant!r} exceeds {cfg.rate:g} "
                         f"req/s (burst {cfg.burst:g})")
                 state.tokens -= 1.0
-            req = _Routed(model, payload)
+            req = _Routed(model, payload, deadline=deadline)
             req.trace = obs_tracing.start_request(
                 owner="router", tenant=tenant, model=model)
             if req.trace is not None:
@@ -325,6 +400,30 @@ class Router:
     def infer(self, tenant: str, model: str, payload,
               timeout: Optional[float] = 60.0):
         return self.submit(tenant, model, payload).result(timeout)
+
+    def _estimate_wait_locked(self, model, handle, n_tokens):
+        """(service_ms, completion_ms) estimate for one more request
+        against `model`, or None when unknowable (server without a
+        costmodel estimator, or estimator not yet calibrated — an
+        uncalibrated front door must not shed anyone). Completion =
+        service x (1 + backlog/max_inflight): the server decodes
+        max_inflight-ish requests concurrently, so each max_inflight
+        of backlog ahead adds roughly one service time of wait.
+        Called under _cv."""
+        est_fn = getattr(handle.server, "expected_service_ms", None)
+        if est_fn is None:
+            return None
+        try:
+            service_ms = est_fn(n_tokens)
+        except Exception:
+            return None
+        if service_ms is None or service_ms <= 0:
+            return None
+        ahead = self._inflight.get(model, 0) + sum(
+            1 for t in self._tenants.values()
+            for r in t.queue if r.model == model)
+        cap = max(1, int(getattr(handle, "max_inflight", 1)))
+        return service_ms, service_ms * (1.0 + ahead / cap)
 
     # --- scheduler ----------------------------------------------------
     def _urgency(self, state: _TenantState, now: float) -> float:
@@ -420,21 +519,40 @@ class Router:
 
     def _try_forward(self, state: _TenantState, req: _Routed) -> bool:
         """One forward attempt. True = request handled (forwarded or
-        terminally failed); False = the server was quiescing/closed
-        (typed, never matched on message text) and the caller should
-        retry after re-resolving the alias."""
+        terminally failed); False = the server raised a RETRYABLE
+        ServingUnavailable (quiescing/closed mid-swap — typed
+        dispatch on the taxonomy, never matched on message text) and
+        the caller should retry after re-resolving the alias."""
         try:
             handle = self._registry.get(req.model)
         except KeyError as e:
             self._finish_error(state, req, e)
             return True
+        kw = {}
+        if req.deadline is not None:
+            left_ms = (req.deadline - time.monotonic()) * 1e3
+            if left_ms <= 0:
+                # expired while queued: fail typed, never forward —
+                # forwarding would spend a lane on a dead request
+                self._finish_error(state, req, DeadlineExceeded(
+                    "deadline_ms expired while queued at the "
+                    "router"))
+                return True
+            if getattr(handle.server, "_cancel_request", None) \
+                    is not None:
+                # propagate the LIVE remainder into the server's own
+                # burst-boundary deadline teardown
+                kw["deadline_ms"] = left_ms
         try:
             # park the request trace in the ambient context so the
             # server's submit adopts it instead of opening its own
             with obs_tracing.request_context(req.trace):
-                inner = handle.submit(req.payload)
-        except (ServerQuiesced, ServerClosed):
-            return False
+                inner = handle.submit(req.payload, **kw)
+        except ServingUnavailable as e:
+            if e.retryable:
+                return False
+            self._finish_error(state, req, e)
+            return True
         except BaseException as e:
             self._finish_error(state, req, e)
             return True
@@ -559,6 +677,9 @@ class Router:
                     ("paddle_tpu_tenant_rejected_total",
                      {**lab, "reason": "queue-full"},
                      st.rejected_queue),
+                    ("paddle_tpu_tenant_rejected_total",
+                     {**lab, "reason": "deadline-unmeetable"},
+                     st.rejected_deadline),
                     ("paddle_tpu_tenant_completed_total", lab,
                      st.completed),
                     ("paddle_tpu_tenant_failed_total", lab,
@@ -592,8 +713,10 @@ class Router:
                     "target_p99_ms": cfg.target_p99_ms,
                     "queue_depth": len(st.queue),
                     "admitted": st.admitted,
-                    "rejected": {"rate-limited": st.rejected_rate,
-                                 "queue-full": st.rejected_queue},
+                    "rejected": {
+                        "rate-limited": st.rejected_rate,
+                        "queue-full": st.rejected_queue,
+                        "deadline-unmeetable": st.rejected_deadline},
                     "completed": st.completed,
                     "failed": st.failed,
                     "slo_violations": st.slo_violations,
@@ -603,7 +726,8 @@ class Router:
                 }
                 if reset:
                     st.admitted = st.rejected_rate = 0
-                    st.rejected_queue = st.completed = 0
+                    st.rejected_queue = st.rejected_deadline = 0
+                    st.completed = 0
                     st.failed = st.slo_violations = 0
                     st.latencies.clear()
                     st.queue_ms.clear()

@@ -70,7 +70,8 @@ from ..models.decode_engine import POOL_MARK as dec_POOL_MARK
 from ..models.decode_engine import (AdmissionInfeasible,
                                     BlockLifetimeError,
                                     BlockPoolExhausted, HostBlockPool,
-                                    PromptPrefixCache, RadixBlockTree)
+                                    PromptPrefixCache, RadixBlockTree,
+                                    ServingUnavailable)
 from ..observability import costmodel as obs_costmodel
 from ..observability import devtel as obs_devtel
 from ..observability import metrics as obs_metrics
@@ -138,22 +139,179 @@ def _pad_axis(arr: np.ndarray, axis: int, size: int) -> np.ndarray:
 _Reply = futures.Future
 
 
-class ServerQuiesced(RuntimeError):
+class ServerQuiesced(ServingUnavailable):
     """submit() hit a server that stopped ACCEPTING but is still
     draining its queue (ModelRegistry hot swap: quiesce -> drain ->
     close). Distinct from ServerClosed so routing layers can
     re-resolve the model alias and retry instead of failing the
-    request. No direct reference counterpart: the reference swaps
-    models by restarting predictor processes, so it never needs an
-    accepting/draining distinction."""
+    request; ``retryable=True`` with a short ``retry_after_ms`` (the
+    swap flip is milliseconds away). No direct reference counterpart:
+    the reference swaps models by restarting predictor processes, so
+    it never needs an accepting/draining distinction."""
+
+    retryable = True
+    retry_after_ms = 2.0
 
 
-class ServerClosed(RuntimeError):
+class ServerClosed(ServingUnavailable):
     """submit() hit a server whose close() already ran. Typed (not a
     bare RuntimeError) so the Router's swap-transparency retry can
     catch it by TYPE — matching on message substrings would silently
-    retry unrelated errors. No direct reference counterpart (see
+    retry unrelated errors; retryable because under the registry's
+    warm-then-flip discipline a closed server means the alias already
+    points at its replacement. No direct reference counterpart (see
     ServerQuiesced)."""
+
+    retryable = True
+    retry_after_ms = 2.0
+
+
+class RequestCancelled(ServingUnavailable):
+    """The terminal outcome of ``reply.cancel()``: the request was
+    torn down (dequeued, or its lane retired at the next burst
+    boundary with every block / prompt-entry / radix hold released —
+    the PTA201 ``cancel`` exit) before producing a full response.
+    NOT retryable: the caller asked for exactly this. Reference
+    counterpart: none — the reference's synchronous predictors
+    (inference/api/analysis_predictor.cc Run) cannot abandon a
+    request mid-flight."""
+
+    retryable = False
+
+
+class DeadlineExceeded(ServingUnavailable):
+    """A request's ``deadline_ms`` budget expired before completion:
+    queued past its deadline (shed before occupying a slot) or still
+    decoding at a burst boundary past it (server-initiated cancel —
+    rides the same PTA201 ``cancel`` release path as
+    ``RequestCancelled``). NOT retryable as-is: the same request
+    under the same deadline sheds again; callers must relax the SLO
+    or retry against spare capacity. Reference counterpart: none
+    (see RequestCancelled)."""
+
+    retryable = False
+
+
+class GenerationReply(futures.Future):
+    """Whole-response future for one generation request, with a
+    cancel() that actually frees device state: the stdlib
+    ``Future.cancel`` only flips a client-side flag, but an abandoned
+    generation keeps burning a lane, KV blocks, and radix holds until
+    it finishes — so this subclass routes cancel() through the owning
+    server, which retires the lane at the next burst boundary and
+    releases every hold through the PTA201 ``cancel`` release sites.
+    The reply then fails with ``RequestCancelled``. Returns True when
+    the cancellation was accepted (the request was still queued or
+    live under the scheduler lock), False when the outcome was
+    already decided. Reference counterpart: none — the reference's
+    predictors are synchronous (inference/api/analysis_predictor.cc
+    Run); request teardown is the async front door's addition."""
+
+    _gen_server = None
+    _gen_req = None
+
+    def cancel(self):
+        srv, req = self._gen_server, self._gen_req
+        if srv is not None and req is not None:
+            return srv._cancel_request(req, "cancelled")
+        return super().cancel()
+
+
+class StreamingReply:
+    """Per-token delivery handle returned by ``submit(stream=True)``
+    (the front door's Orca-style iteration-level surface; SURVEY §7's
+    AsyncExecutor/RPC-server capability, reference
+    inference/api/api_impl.cc:71 NativePaddlePredictor::Run — there
+    one blocking call per whole response).
+
+    Iterating yields ``(seq, token)`` pairs as bursts land: ``seq``
+    is a monotone 0-based sequence number, ``token`` a python int.
+    Tokens are delivered from the per-burst host readback the
+    scheduler already performs — streaming adds NO fetches and NO
+    programs (zero steady-state compiles is unchanged). Iteration
+    ends after the final token; ``finish_reason`` then reads "eos" |
+    "length" | "cancelled" | "deadline" | "error".
+
+    Byte-parity contract (pinned in tests and per bench leg): the
+    concatenation of the streamed tokens equals the generated region
+    ``row[1:1+n]`` of the sentinel-normalized row the whole-response
+    path returns for the same submit (``n`` =
+    ``count_generated_tokens``; position 0 is the GO token, the tail
+    past the terminator is the -1 sentinel — neither is streamed),
+    and ``result(timeout)`` returns that same full row.
+
+    ``cancel()`` tears the request down exactly like
+    ``GenerationReply.cancel`` (iteration then ends with
+    finish_reason "cancelled" and ``result`` raises
+    ``RequestCancelled``). ``ttft_s`` is the client-observed
+    first-token wall-clock instant minus submit time (the bench's
+    streamed-TTFT measure). Thread-safe: one scheduler produces,
+    any number of consumer threads may iterate (each event is
+    delivered once)."""
+
+    def __init__(self, server):
+        self._cond = threading.Condition()
+        self._events = collections.deque()  # (seq, int token)
+        self._fin = None        # finish_reason once decided
+        self._exc = None
+        self._server = server
+        self._req = None        # backref set by submit()
+        self._future = None     # the underlying GenerationReply
+        self.t_submit = time.monotonic()
+        self.t_first = None     # wall instant the first token landed
+
+    # --- consumer side -----------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        with self._cond:
+            while not self._events and self._fin is None:
+                self._cond.wait()
+            if self._events:
+                return self._events.popleft()
+            raise StopIteration
+
+    def result(self, timeout: Optional[float] = None):
+        """The whole sentinel-normalized row (identical to the
+        non-streaming future's result; raises RequestCancelled /
+        DeadlineExceeded / the dispatch error on teardown)."""
+        return self._future.result(timeout)
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def cancel(self) -> bool:
+        return self._server._cancel_request(self._req, "cancelled")
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        with self._cond:
+            return self._fin
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        with self._cond:
+            if self.t_first is None:
+                return None
+            return self.t_first - self.t_submit
+
+    # --- producer side (scheduler thread, OUTSIDE the server lock) ---
+    def _push(self, first_seq: int, toks) -> None:
+        now = time.monotonic()
+        with self._cond:
+            if self.t_first is None:
+                self.t_first = now
+            for i, t in enumerate(toks):
+                self._events.append((first_seq + i, int(t)))
+            self._cond.notify_all()
+
+    def _finish(self, reason: str, exc=None) -> None:
+        with self._cond:
+            if self._fin is None:
+                self._fin = reason
+                self._exc = exc
+            self._cond.notify_all()
 
 
 def _call_scheduling_hook(server, hook, arg, hook_name, fallback):
@@ -902,10 +1060,13 @@ class GenerationServer(InferenceServer):
 
 class _GenRequest:
     __slots__ = ("src", "reply", "t_arrival", "t_first", "t_admit",
-                 "trace", "seed", "session", "harvest", "radix")
+                 "trace", "seed", "session", "harvest", "radix",
+                 "stream", "stream_cb", "deadline", "cancel_reason",
+                 "finalized", "emitted", "n_streamed")
 
     def __init__(self, src, reply, trace=None, seed=0, session=None,
-                 harvest=True):
+                 harvest=True, stream=None, stream_cb=None,
+                 deadline=None):
         self.src = src
         self.reply = reply
         self.t_arrival = time.monotonic()
@@ -924,6 +1085,25 @@ class _GenRequest:
         # admission-time radix plan (hist tokens, resume step, history
         # length), written by the paged scheduler under its lock
         self.radix = None
+        # r20 front door: per-token delivery + teardown. `stream` is
+        # the StreamingReply handle (None = whole-response only),
+        # `stream_cb` the callback form; `emitted` is the highest
+        # tok_buf POSITION already delivered (0 = only the GO token
+        # exists — never streamed) and survives preemption, so the
+        # byte-exact re-decode resumes delivery without duplicates;
+        # `n_streamed` is the monotone sequence-number base handed to
+        # stream_cb. `deadline` is an absolute time.monotonic()
+        # instant; `cancel_reason` ("cancelled" | "deadline") is the
+        # one-way teardown mark, and `finalized` is the scheduler's
+        # under-lock commit that the reply's outcome is decided (the
+        # cancel/retire race arbiter).
+        self.stream = stream
+        self.stream_cb = stream_cb
+        self.deadline = deadline
+        self.cancel_reason = None
+        self.finalized = False
+        self.emitted = 0
+        self.n_streamed = 0
 
 
 class ContinuousGenerationServer:
@@ -1184,6 +1364,10 @@ class ContinuousGenerationServer:
         self._n_tokens = 0
         self._n_ticks = 0
         self._occ_sum = 0.0
+        # r20 front-door teardown counters: client cancels vs
+        # deadline expiries (queued sheds + live-lane teardowns both)
+        self._n_cancelled = 0
+        self._n_deadline = 0
         # fixed-bucket histograms — same O(1)-memory contract as
         # InferenceServer (observability/metrics)
         self._latencies = Histogram("paddle_tpu_request_latency_ms")
@@ -1255,11 +1439,17 @@ class ContinuousGenerationServer:
             bg = self._background_abort_locked()
             if bg is not None:
                 pending.append(bg)
+            for r in pending:
+                r.finalized = True
             self._flush_requests_locked(pending)
             self._cv.notify_all()
         for r in pending:
-            r.reply.set_exception(
-                ServerClosed("ContinuousGenerationServer closed"))
+            exc = ServerClosed("ContinuousGenerationServer closed")
+            self._finish_stream(r, "error", exc)
+            try:
+                r.reply.set_exception(exc)
+            except futures.InvalidStateError:
+                pass
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
@@ -1272,13 +1462,36 @@ class ContinuousGenerationServer:
 
     # --- request path -------------------------------------------------
     def submit(self, src_ids, seed=None, session_id=None,
-               extend_tokens=None, n_best=1):
+               extend_tokens=None, n_best=1, stream=False,
+               stream_cb=None, deadline_ms=None):
         """Enqueue one prompt row. ``seed`` keys the request's
         emission noise on sampled/speculative bundles (ignored by
         plain greedy ones); None derives it from the prompt CONTENT
         (crc32), so identical prompts sample identical streams and
         the served tokens are invariant to admission order — the
         bit-repro contract tests pin.
+
+        The r20 front door adds:
+
+        * ``stream=True`` — returns a ``StreamingReply`` instead of a
+          future: tokens are delivered per BURST from the host
+          readback the scheduler already performs (monotone sequence
+          numbers, EOS/finish markers, byte-parity with the
+          whole-response row — see StreamingReply). TTFT becomes
+          first-burst latency. On speculative bundles each burst
+          delivers the accepted runs of its ticks.
+        * ``stream_cb`` — callback form: ``cb(tokens, first_seq,
+          finish_reason)`` is invoked from the scheduler thread
+          (outside the scheduler lock) with a fresh int64 chunk and
+          the sequence number of its first token; the final call
+          carries an empty chunk and the finish reason. The normal
+          whole-response future is still returned.
+        * ``deadline_ms`` — a completion SLO relative to now: if the
+          request is still queued or still decoding once it expires,
+          it is torn down at the next planning/burst boundary (every
+          block/prompt-entry/radix hold released through the PTA201
+          ``cancel`` exit) and the reply fails with the typed,
+          non-retryable ``DeadlineExceeded``.
 
         Paged bundles additionally unlock (raising elsewhere):
 
@@ -1325,6 +1538,16 @@ class ContinuousGenerationServer:
             raise ValueError(
                 "extend_tokens extends an existing chat session; "
                 "pass session_id")
+        if (stream or stream_cb is not None) and n_best > 1:
+            raise ValueError(
+                "streaming delivers ONE ordered token sequence; "
+                "n_best fan-out returns whole-response futures — "
+                "submit the branches separately to stream them")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms}")
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
         if seed is None:
             import zlib
 
@@ -1336,10 +1559,20 @@ class ContinuousGenerationServer:
             if trace is None:
                 trace = obs_tracing.start_request(owner="server",
                                                   server=self._obs_id)
-            reqs.append(_GenRequest(arr, _Reply(), trace=trace,
-                                    seed=int(seed) + i,
-                                    session=session_id,
-                                    harvest=(n_best == 1)))
+            reply = GenerationReply()
+            sreply = StreamingReply(self) if stream else None
+            req = _GenRequest(arr, reply, trace=trace,
+                              seed=int(seed) + i,
+                              session=session_id,
+                              harvest=(n_best == 1),
+                              stream=sreply, stream_cb=stream_cb,
+                              deadline=deadline)
+            reply._gen_server = self
+            reply._gen_req = req
+            if sreply is not None:
+                sreply._req = req
+                sreply._future = reply
+            reqs.append(req)
         with self._cv:
             if self._closed:
                 raise ServerClosed(
@@ -1358,6 +1591,8 @@ class ContinuousGenerationServer:
             if self._t_first_arrival is None:
                 self._t_first_arrival = reqs[0].t_arrival
             self._cv.notify_all()
+        if stream:
+            return reqs[0].stream
         return reqs[0].reply if n_best == 1 \
             else [r.reply for r in reqs]
 
@@ -1371,6 +1606,179 @@ class ContinuousGenerationServer:
         token row out (same contract as GenerationServer.generate for
         a single row)."""
         return self.submit(src_ids, seed=seed).result(timeout)
+
+    def expected_service_ms(self, n_tokens=None) -> Optional[float]:
+        """Costmodel-backed completion-latency estimate for ONE
+        request decoding ``n_tokens`` (default: the bundle's
+        max_out_len): the expected wall of one TICK of the key-0
+        serve While (observability/costmodel.py throughput fit over
+        this server's own dispatches — expected_ms costs the While
+        BODY once, and the achieved-rate samples it divides by are
+        tick-flops x ticks over the burst's wall, so per-burst host
+        overhead is already amortized INTO the per-tick figure) times
+        the ticks the request needs. Do not divide by steps_per_tick
+        on top: that re-counts the burst grouping the calibration
+        already folded in and runs the estimate steps_per_tick-x low
+        — low enough that a Router deadline stated as a multiple of
+        this estimate never sheds (bench.py frontdoor caught it).
+        None until the costmodel is calibrated (an uncalibrated
+        estimator must not shed anyone). Lanes decode in lockstep, so
+        co-residency does not stretch a request's own burst count —
+        queue wait is the CALLER's (Router's) term. Reference
+        counterpart: none — the reference has no service-time model
+        (its deploy apps time requests after the fact)."""
+        snap = obs_costmodel.lookup(self.bundle.serves[0]) or {}
+        per_tick = obs_costmodel.expected_ms(snap.get("flops"))
+        if per_tick is None:
+            return None
+        toks = self.bundle.max_out_len if n_tokens is None \
+            else max(1, int(n_tokens))
+        ticks = math.ceil(toks / max(1, self._toks_per_tick))
+        return per_tick * ticks
+
+    # --- cancellation / deadline teardown (r20 front door) ------------
+    def _cancel_request(self, req, reason: str) -> bool:
+        """Client-thread half of cancel()/deadline teardown: mark the
+        request under the scheduler lock and wake the loop. All state
+        release happens ON the scheduler thread — queued requests are
+        shed at the next planning pass (_shed_cancelled_locked), live
+        lanes at the next burst boundary (_cancel_lane_locked) — so
+        every pool mutation keeps the existing single-writer
+        discipline. False = the outcome was already decided."""
+        with self._cv:
+            if req is None or req.finalized:
+                return False
+            if req.cancel_reason is None:
+                req.cancel_reason = reason
+            self._cv.notify_all()
+        return True
+
+    def _expired_locked(self, req, now: float) -> Optional[str]:
+        """The request's teardown reason, minting "deadline" on
+        expiry. Called under _cv."""
+        reason = req.cancel_reason
+        if reason is None and req.deadline is not None \
+                and now > req.deadline:
+            reason = req.cancel_reason = "deadline"
+        return reason
+
+    def _count_cancel_locked(self, reason: str):
+        if reason == "deadline":
+            self._n_deadline += 1
+        else:
+            self._n_cancelled += 1
+
+    def _drop_queued_locked(self, req):
+        """Hook: a QUEUED request is being shed (cancel/deadline) —
+        drop per-request bookkeeping it may hold without a lane
+        (paged: a disagg handoff entry ref). Called under _cv."""
+
+    def _shed_cancelled_locked(self, now: float):
+        """Remove cancelled / deadline-expired requests from the
+        queue before admission planning — they must never occupy a
+        slot. The PTA201 ``cancel`` release site for queue-held refs
+        (via the _drop_queued_locked hook; the paged override extends
+        this to the in-flight chunked-prefill job). Returns the
+        (req, reason) list the caller finalizes OUTSIDE the lock."""
+        out = []
+        if not self._queue:
+            return out
+        kept = collections.deque()
+        for req in self._queue:
+            reason = self._expired_locked(req, now)
+            if reason is None:
+                kept.append(req)
+            else:
+                req.finalized = True
+                self._drop_queued_locked(req)
+                self._count_cancel_locked(reason)
+                out.append((req, reason))
+        self._queue = kept
+        return out
+
+    def _cancel_lane_locked(self, slot, req, reason: str):
+        """Burst-boundary teardown of one LIVE lane whose request
+        was cancelled or ran past its deadline: the PTA201 ``cancel``
+        release site for every lane-held tag — routes through
+        _release_lane, so the paged _free_lane_locked decrefs KV
+        blocks (block_table / cow_dst), radix holds (cow_src) and
+        the lane's prompt-entry ref exactly as retirement does.
+        Harvest is skipped: a torn-down turn must not extend session
+        history. Called under _cv."""
+        req.harvest = False
+        req.finalized = True
+        self._release_lane(slot, req)
+        self._lanes[slot] = None
+        self._paused.discard(slot)
+        self._count_cancel_locked(reason)
+
+    def _deliver_stream(self, req, first_seq: int, chunk):
+        """Push one burst's fresh tokens to the request's streaming
+        surfaces. Scheduler thread, OUTSIDE the lock (stream_cb is
+        user code and StreamingReply waiters run done-callbacks)."""
+        if req.stream is not None:
+            req.stream._push(first_seq, chunk)
+        if req.stream_cb is not None:
+            try:
+                req.stream_cb(chunk, first_seq, None)
+            except Exception as e:
+                if not self._hook_warned:
+                    self._hook_warned = True
+                    import warnings
+
+                    warnings.warn(
+                        f"stream_cb raised ({type(e).__name__}: {e});"
+                        f" further failures are silent")
+
+    def _finish_stream(self, req, reason: str, exc=None):
+        """Terminal stream event (scheduler thread, outside the
+        lock): ends StreamingReply iteration and makes the final
+        stream_cb call (empty chunk + finish reason). getattr, not
+        attribute access: scheduler white-box tests (and any
+        admit_select-style hook consumer) drive this path with
+        minimal request fakes that predate the streaming fields."""
+        stream = getattr(req, "stream", None)
+        if stream is not None:
+            stream._finish(reason, exc)
+        stream_cb = getattr(req, "stream_cb", None)
+        if stream_cb is not None:
+            try:
+                stream_cb(np.empty(0, np.int64),
+                          getattr(req, "n_streamed", 0), reason)
+            except Exception:
+                pass
+
+    def _finalize_cancelled(self, cancels):
+        """Fail torn-down requests with the typed taxonomy error and
+        seal their observability record (OUTSIDE the lock): the span
+        tree carries the cancel/shed reason and the request is
+        retained as a flight-recorder incident — exactly the
+        requests an operator will ask about."""
+        for req, reason in cancels:
+            if reason == "deadline":
+                exc = DeadlineExceeded(
+                    "deadline_ms expired before completion; request "
+                    "torn down at the burst boundary")
+            else:
+                exc = RequestCancelled("request cancelled by client")
+            self._finish_stream(req, reason, exc)
+            try:
+                req.reply.set_exception(exc)
+            except futures.InvalidStateError:
+                pass
+            if req.trace is not None \
+                    and req.trace.owner == "server":
+                req.trace.finish(status="cancelled", reason=reason,
+                                 error=repr(exc))
+            elif obs_metrics.metrics_on():
+                from ..observability import flight as obs_flight
+
+                obs_flight.RECORDER.record(
+                    {"request_id":
+                         obs_tracing.TRACER.next_request_id(),
+                     "status": "cancelled", "reason": reason,
+                     "server": self._obs_id,
+                     "error": repr(exc)}, incident=True)
 
     # --- scheduler ----------------------------------------------------
     def _pop_next(self):
@@ -1510,7 +1918,11 @@ class ContinuousGenerationServer:
 
     def _fail_requests(self, failures):
         for req, exc in failures:
-            req.reply.set_exception(exc)
+            self._finish_stream(req, "error", exc)
+            try:
+                req.reply.set_exception(exc)
+            except futures.InvalidStateError:
+                pass
             if req.trace is not None and req.trace.owner == "server":
                 req.trace.finish(status="error", error=repr(exc))
 
@@ -1524,6 +1936,8 @@ class ContinuousGenerationServer:
                     self._cv.wait()
                 if not self._running:
                     return
+                cancels = self._shed_cancelled_locked(
+                    time.monotonic())
                 admits = self._plan_admissions_locked(failures)
                 drain = not self._queue
                 # empty queue: let the burst run — the device loop
@@ -1534,6 +1948,7 @@ class ContinuousGenerationServer:
                     self._busy = True  # drain() waits on this
             # failing futures fires their done-callbacks synchronously
             # — never under the scheduler lock
+            self._finalize_cancelled(cancels)
             self._fail_requests(failures)
             if run:
                 try:
@@ -1624,13 +2039,19 @@ class ContinuousGenerationServer:
                          for slot, r in enumerate(self._lanes)
                          if r is not None]
                 for slot, r in lanes:
+                    r.finalized = True
                     self._release_lane(slot, r)
                 self._lanes = [None] * self.n_slots
                 bg_req = self._background_abort_locked()
             if bg_req is not None:
+                bg_req.finalized = True
                 lanes = lanes + [(None, bg_req)]
             for _slot, r in lanes:
-                r.reply.set_exception(e)
+                self._finish_stream(r, "error", e)
+                try:
+                    r.reply.set_exception(e)
+                except futures.InvalidStateError:
+                    pass
                 if r.trace is not None and r.trace.owner == "server":
                     r.trace.finish(status="error", error=repr(e))
             return
@@ -1638,6 +2059,8 @@ class ContinuousGenerationServer:
         tok_buf, step, active, _fin = outs[:4]  # [4:] = spec counters
         done_t = time.monotonic()
         retired = []
+        cancels = []
+        stream_out = []
         with self._cv:
             occupied = 0
             for slot in range(self.n_slots):
@@ -1647,7 +2070,17 @@ class ContinuousGenerationServer:
                 occupied += 1
                 if req.t_first is None:
                     req.t_first = done_t  # first token just landed
-                if active[slot] == 0 and slot not in self._paused:
+                retiring = active[slot] == 0 \
+                    and slot not in self._paused
+                reason = self._expired_locked(req, done_t)
+                if reason is not None and not retiring:
+                    # burst-boundary teardown: a finished result
+                    # always wins over a same-tick cancel, a doomed
+                    # live lane never decodes another burst
+                    self._cancel_lane_locked(slot, req, reason)
+                    cancels.append((req, reason))
+                    continue
+                if retiring:
                     # EOS emitted (or buffer full): retire NOW, free
                     # the slot for the next arrival
                     toks = apply_eos_sentinel(
@@ -1663,6 +2096,7 @@ class ContinuousGenerationServer:
                         self._n_tokens += ntok
                     self._n_done += 1
                     self._t_last_done = done_t
+                    req.finalized = True
                     self._release_lane(slot, req)
                     self._lanes[slot] = None
                     if req.trace is not None:
@@ -1671,13 +2105,47 @@ class ContinuousGenerationServer:
                             req.t_admit if req.t_admit is not None
                             else req.t_arrival,
                             done_t, slot=slot, tokens=ntok)
-                    retired.append((req, toks))
+                    fin = "eos" if (ntok < toks.shape[0]
+                                    and toks[ntok] == self._end_id) \
+                        else "length"
+                    retired.append((req, toks, fin))
+                    # stream through the terminator: positions
+                    # emitted+1..ntok (row is already sentinel-
+                    # normalized, so nothing past ntok is real)
+                    hi, row = ntok, toks
+                else:
+                    # live lane: step[slot] is the NEXT write
+                    # position, so step-1 is the newest valid token.
+                    # Position 0 is the GO token — never streamed.
+                    # Preempted-and-readmitted lanes re-decode the
+                    # same prefix byte-exactly (greedy + per-position
+                    # seed folding), so the monotone `emitted` mark
+                    # suppresses duplicates for free.
+                    hi, row = int(step[slot]) - 1, tok_buf[slot]
+                if (req.stream is not None
+                        or req.stream_cb is not None) \
+                        and hi > req.emitted:
+                    chunk = np.asarray(
+                        row[req.emitted + 1:hi + 1]).astype(np.int64)
+                    stream_out.append((req, req.n_streamed, chunk))
+                    req.n_streamed += len(chunk)
+                    req.emitted = hi
             self._n_ticks += 1
             self._occ_sum += occupied / self.n_slots
-        for req, toks in retired:
-            req.reply.set_result(toks)
+        # ordered delivery, OUTSIDE the lock: every streamed token of
+        # a burst lands before its finish marker, which lands before
+        # the whole-response future resolves
+        for req, first_seq, chunk in stream_out:
+            self._deliver_stream(req, first_seq, chunk)
+        for req, toks, fin in retired:
+            self._finish_stream(req, fin)
+            try:
+                req.reply.set_result(toks)
+            except futures.InvalidStateError:
+                pass
             if req.trace is not None and req.trace.owner == "server":
                 req.trace.finish()
+        self._finalize_cancelled(cancels)
 
     def _absorb_spec_counters(self, outs) -> dict:
         """Read the fetched device-side speculative counters
@@ -1895,6 +2363,12 @@ class ContinuousGenerationServer:
                 "retired_per_s": (
                     round(self._n_done / done_span, 1)
                     if done_span else None),
+                # r20 teardowns (lifetime, like requests/completed):
+                # every count released its holds through the PTA201
+                # `cancel` exit — leak checks gauge-assert against
+                # the pool stats, these explain WHY lanes vanished
+                "cancelled": self._n_cancelled,
+                "deadline_expired": self._n_deadline,
             }
             spec = self._speculative_stats_locked()
             if spec is not None:
@@ -1942,6 +2416,10 @@ class ContinuousGenerationServer:
                 ("paddle_tpu_server_ticks_total", lab, self._n_ticks),
                 ("paddle_tpu_server_tokens_total", lab,
                  self._n_tokens),
+                ("paddle_tpu_server_cancelled_total", lab,
+                 self._n_cancelled),
+                ("paddle_tpu_server_deadline_expired_total", lab,
+                 self._n_deadline),
                 ("paddle_tpu_request_latency_ms", lab,
                  self._latencies),
                 ("paddle_tpu_request_ttft_ms", lab, self._ttft),
@@ -2758,6 +3236,29 @@ class PagedContinuousGenerationServer(ContinuousGenerationServer):
             e = self._handoff.pop(id(r), None)
             if e is not None:
                 self._prefix.release(e)
+
+    def _drop_queued_locked(self, req):
+        """PTA201 ``cancel`` release site (queue-held refs): a shed
+        request that came back through a disaggregated handoff still
+        holds the filled entry resident — drop that ref."""
+        e = self._handoff.pop(id(req), None)
+        if e is not None:
+            self._prefix.release(e)
+
+    def _shed_cancelled_locked(self, now: float):
+        out = super()._shed_cancelled_locked(now)
+        job = self._prefill_job
+        if job is not None:
+            reason = self._expired_locked(job["req"], now)
+            if reason is not None:
+                # a part-written chunk job: abort releases AND
+                # invalidates the entry (same as a mid-chunk error),
+                # so the prompt can never hit stale cross-KV
+                req = self._background_abort_locked()
+                req.finalized = True
+                self._count_cancel_locked(reason)
+                out.append((req, reason))
+        return out
 
     # --- burst planning: coverage, pausing, hard exhaustion ----------
     def _grow_blocks_locked(self, slot, upto_pos):
@@ -3651,6 +4152,10 @@ for _tag in ("block_table", "cow_dst"):
                                   f"{_P}._plan_burst_locked")
     _absint.register_release_site(_tag, "server_close",
                                   f"{_P}._flush_requests_locked")
+    # r20 cancel/deadline teardown of a live lane: routes through
+    # _release_lane -> _free_lane_locked, the same reversed decref
+    _absint.register_release_site(_tag, "cancel",
+                                  f"{_P}._cancel_lane_locked")
 # radix-shared chains: tree-aware release on every lane exit, plus
 # the watermark/pressure eviction rungs dropping the tree's own refs
 _absint.register_release_site("cow_src", "retire",
@@ -3661,6 +4166,8 @@ _absint.register_release_site("cow_src", "evict",
                               f"{_P}._alloc_block_locked")
 _absint.register_release_site("cow_src", "server_close",
                               f"{_P}._flush_requests_locked")
+_absint.register_release_site("cow_src", "cancel",
+                              f"{_P}._cancel_lane_locked")
 # fresh prompt entries: released on retirement, on admission backout
 # (invalidate), on abandoned-prefill abort, and at close
 _absint.register_release_site("host_indices", "retire",
@@ -3671,6 +4178,8 @@ _absint.register_release_site("host_indices", "invalidate",
                               f"{_P}._plan_admissions_locked")
 _absint.register_release_site("host_indices", "server_close",
                               f"{_P}._flush_requests_locked")
+_absint.register_release_site("host_indices", "cancel",
+                              f"{_P}._cancel_lane_locked")
 # refcounted hit refs: lane ref drops at retirement; the session PIN
 # (ref transferred by _harvest_session_locked) drops at close_session
 _absint.register_release_site("prompt_entry_ref", "retire",
@@ -3679,6 +4188,12 @@ _absint.register_release_site("prompt_entry_ref", "session_close",
                               f"{_P}.close_session")
 _absint.register_release_site("prompt_entry_ref", "server_close",
                               f"{_P}._flush_requests_locked")
+# lane ref on cancel rides _cancel_lane_locked; a handoff ref on a
+# shed queued request drops in _drop_queued_locked
+_absint.register_release_site("prompt_entry_ref", "cancel",
+                              f"{_P}._cancel_lane_locked")
+_absint.register_release_site("prompt_entry_ref", "cancel",
+                              f"{_P}._drop_queued_locked")
 # chunked-prefill cursor entries: ownership hands off to the decode
 # lane (or the disagg inbox) on completion, releases on abort/close
 _absint.register_release_site("chunk_cursor", "handoff",
@@ -3691,13 +4206,19 @@ _absint.register_release_site("chunk_cursor", "abort",
                               f"{_P}._disagg_fail")
 _absint.register_release_site("chunk_cursor", "server_close",
                               f"{_P}._flush_requests_locked")
+# cancel/deadline on the in-flight chunk job: the shed pass aborts
+# it (release + invalidate, same as a mid-chunk error)
+_absint.register_release_site("chunk_cursor", "cancel",
+                              f"{_P}._shed_cancelled_locked")
 del _P, _tag
 
 
 __all__ = ["InferenceServer", "GenerationServer",
            "ContinuousGenerationServer",
            "PagedContinuousGenerationServer", "PagedBeamDecoder",
-           "BlockPoolExhausted", "AdmissionInfeasible",
+           "ServingUnavailable", "BlockPoolExhausted",
+           "AdmissionInfeasible", "RequestCancelled",
+           "DeadlineExceeded", "StreamingReply", "GenerationReply",
            "ProgramRunner", "ServerQuiesced", "ServerClosed",
            "apply_eos_sentinel", "count_generated_tokens",
            "default_batch_buckets"]

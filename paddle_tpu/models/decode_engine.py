@@ -3390,7 +3390,32 @@ def build_beam_decode_program(seq_len=16, max_out_len=16, d_model=64,
 # Host-side allocation policy (plain Python; the device only sees the
 # tables the scheduler writes into the scope).
 # ---------------------------------------------------------------------------
-class BlockPoolExhausted(RuntimeError):
+class ServingUnavailable(RuntimeError):
+    """Base of the serving-layer rejection taxonomy: every named
+    condition under which the front door cannot take (or keep) a
+    request derives from this ONE class, carrying the machine-readable
+    retry contract — ``retryable`` (may the caller resubmit the same
+    request and expect a different outcome?) and ``retry_after_ms``
+    (earliest point a retry is worth attempting, ``None`` = no
+    estimate). Retry logic anywhere above (runtime Router, clients)
+    dispatches on ``isinstance`` + these attributes ONLY — never on
+    message text (the r20 taxonomy contract; message-substring
+    matching is what this base exists to delete).
+
+    Subclasses: ``BlockPoolExhausted``/``ServerQuiesced``/
+    ``ServerClosed`` (transient, retryable), ``AdmissionInfeasible``
+    (config can never admit — not retryable), the Router's
+    ``AdmissionError`` family including the deadline-shed rejection
+    (retryability depends on the reason). Reference counterpart: none
+    — the reference's serving errors are bare PADDLE_ENFORCE strings
+    (inference/api/analysis_predictor.cc); a typed retry contract is
+    the multi-tenant front-door tier this layer adds."""
+
+    retryable = False
+    retry_after_ms = None
+
+
+class BlockPoolExhausted(ServingUnavailable):
     """The shared KV block pool (or the prompt-entry pool) cannot
     satisfy an allocation AND nothing in flight can ever free one —
     a NAMED, RETRYABLE error (``retryable=True``): the caller may
@@ -3400,9 +3425,10 @@ class BlockPoolExhausted(RuntimeError):
     pausing, never by this error."""
 
     retryable = True
+    retry_after_ms = 50.0
 
 
-class AdmissionInfeasible(RuntimeError):
+class AdmissionInfeasible(ServingUnavailable):
     """The serving CONFIGURATION (not transient load) can never admit
     this request: the liveness capacity model
     (analysis/liveness.py ``session_feasibility``, validated against
@@ -3895,8 +3921,8 @@ __all__ = ["CacheConfig", "SamplingConfig", "DraftConfig",
            "POOL_MARK", "LANE_AXIS",
            "tp_param_placements", "annotate_sharded_program",
            "place_sharded_bundle", "place_sharded_program",
-           "BlockPoolExhausted", "BlockLifetimeError",
-           "AdmissionInfeasible",
+           "ServingUnavailable", "BlockPoolExhausted",
+           "BlockLifetimeError", "AdmissionInfeasible",
            "HostBlockPool", "RadixBlockTree",
            "PromptPrefixCache", "build_greedy_decode_program",
            "build_incremental_decode_program",

@@ -105,6 +105,58 @@ class TestAllocatorProtocolsExhaustive:
         assert r.n_states > 500
 
 
+class TestCancelExit:
+    """r20 front door: the cancel/deadline teardown joins every
+    allocator machine's action alphabet. The green sweeps above now
+    cover cancel in every interleaving; these pin that the cancel
+    actions exist as SEPARATE closures and that the explorer really
+    watches the path — a seeded dropped-decref-on-cancel mutation
+    must fail with a minimal trace that NAMES the cancel action."""
+
+    def test_cancel_actions_present_on_every_machine(self):
+        for proto in (protomodel.block_pool_protocol(),
+                      protomodel.prefix_cache_protocol(),
+                      protomodel.radix_protocol(),
+                      protomodel.session_protocol(2, 2, True)):
+            assert any(a.name.startswith("cancel[")
+                       for a in proto.actions), proto.name
+
+    def test_seeded_dropped_decref_on_cancel_is_caught(self):
+        proto = protomodel.block_pool_protocol(
+            n_blocks=2, n_lanes=2, pages=1)
+        idx, act = next(
+            (i, a) for i, a in enumerate(proto.actions)
+            if a.name == "cancel[0]")
+
+        def leaky(s):
+            lane = s["lanes"][0]
+            for b in reversed(lane["shared"]):
+                s["pool"].decref(b)
+            # seeded BUG: the exclusive chain is forgotten without
+            # its decrefs — the one-leak-per-occurrence failure the
+            # PTA201 cancel obligation exists to prevent
+            lane["blocks"], lane["shared"] = [], []
+
+        proto.actions[idx] = protomodel.Action(
+            "cancel[0]", act.guard, leaky)
+        r = protomodel.explore(proto)
+        assert not r.ok
+        assert r.counterexample.kind == "invariant", \
+            r.counterexample.format()
+        assert "cancel[0]" in r.counterexample.trace
+        # BFS minimality: alloc then the buggy cancel, nothing more
+        assert r.counterexample.trace == ("alloc[0]", "cancel[0]"), \
+            r.counterexample.trace
+
+    def test_session_cancel_returns_entry_and_reopens_want(self):
+        # an infeasible pin config stays infeasible WITH cancel in
+        # the alphabet (cancel unwinds active turns, never pins), and
+        # the minimal wedge trace is unchanged
+        r = protomodel.explore(protomodel.session_protocol(1, 2))
+        assert not r.ok and r.counterexample.kind == "deadlock"
+        assert len(r.counterexample.trace) == 2
+
+
 class TestSessionPinningGrid:
     """THE cross-validation the module exists for: the declarative
     session-capacity predicate vs exhaustive exploration, on every
